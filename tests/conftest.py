@@ -1,0 +1,16 @@
+"""Test bootstrap: run everything on a virtual 8-device CPU mesh.
+
+The reference's tests run the whole engine against an embedded unistore
+(pkg/testkit/mockstore.go:49) so no real cluster is needed; our analog is
+JAX CPU with xla_force_host_platform_device_count=8 so multi-chip sharding
+paths execute without TPU hardware. Must be set before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,250 @@
+"""Expression kernel tests (reference model: pkg/expression/builtin_*_vec.go
+unit tests and pkg/util/chunk/chunk_test.go)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu import DECIMAL, FLOAT64, INT64, STRING, DATE
+from tidb_tpu.chunk import HostBlock, block_to_batch, column_from_values
+from tidb_tpu.expression import ColumnRef, Func, Literal, bind_expr, compile_expr
+
+
+def make_batch(cols, types):
+    block = HostBlock.from_columns(
+        {k: column_from_values(v, types[k]) for k, v in cols.items()}
+    )
+    dicts = {
+        k: c.dictionary
+        for k, c in block.columns.items()
+        if c.dictionary is not None
+    }
+    return block_to_batch(block), {k: t for k, t in types.items()}, dicts, block.nrows
+
+
+def run(expr, cols, types):
+    batch, schema, dicts, n = make_batch(cols, types)
+    bound = bind_expr(expr, schema)
+    out = compile_expr(bound, dicts)(batch)
+    return np.asarray(out.data)[:n], np.asarray(out.valid)[:n], bound.type
+
+
+def col(name):
+    return ColumnRef(name=name)
+
+
+def lit(v):
+    return Literal(value=v)
+
+
+def f(op, *args):
+    return Func(op=op, args=tuple(args))
+
+
+class TestArith:
+    def test_add_int(self):
+        d, v, t = run(
+            f("add", col("a"), col("b")),
+            {"a": [1, 2, None], "b": [10, 20, 30]},
+            {"a": INT64, "b": INT64},
+        )
+        assert t == INT64
+        np.testing.assert_array_equal(d[:2], [11, 22])
+        np.testing.assert_array_equal(v, [True, True, False])
+
+    def test_decimal_mul_scale(self):
+        # 1.50 * 0.10 = 0.1500 (scale 2 * scale 2 -> scale 4)
+        d, v, t = run(
+            f("mul", col("p"), col("d")),
+            {"p": [1.50], "d": [0.10]},
+            {"p": DECIMAL(2), "d": DECIMAL(2)},
+        )
+        assert t == DECIMAL(4)
+        assert d[0] == 1500
+
+    def test_decimal_add_rescale(self):
+        d, v, t = run(
+            f("add", col("a"), col("b")),
+            {"a": [1.5], "b": [0.25]},
+            {"a": DECIMAL(1), "b": DECIMAL(2)},
+        )
+        assert t == DECIMAL(2)
+        assert d[0] == 175
+
+    def test_div_null_on_zero(self):
+        d, v, t = run(
+            f("div", col("a"), col("b")),
+            {"a": [10, 10], "b": [4, 0]},
+            {"a": INT64, "b": INT64},
+        )
+        assert t == FLOAT64
+        assert d[0] == 2.5
+        assert not v[1]
+
+
+class TestLogic:
+    def test_three_valued_and(self):
+        d, v, _ = run(
+            f("and", f("gt", col("a"), lit(0)), f("gt", col("b"), lit(0))),
+            {"a": [1, 1, -1, None], "b": [1, None, None, None]},
+            {"a": INT64, "b": INT64},
+        )
+        # true, null, false (a>0 false dominates), null
+        assert d[0] and v[0]
+        assert not v[1]
+        assert not d[2] and v[2]
+        assert not v[3]
+
+    def test_case_when(self):
+        d, v, _ = run(
+            f("case", f("lt", col("a"), lit(0)), lit(-1), f("gt", col("a"), lit(0)), lit(1), lit(0)),
+            {"a": [-5, 7, 0, None]},
+            {"a": INT64},
+        )
+        np.testing.assert_array_equal(d[:3], [-1, 1, 0])
+        assert v[3] and d[3] == 0  # null cond -> false -> ELSE
+
+
+class TestStrings:
+    def test_eq_and_order(self):
+        d, v, _ = run(
+            f("eq", col("s"), lit("banana")),
+            {"s": ["apple", "banana", "cherry", None]},
+            {"s": STRING},
+        )
+        np.testing.assert_array_equal(d[:3], [False, True, False])
+        assert not v[3]
+
+        d, _, _ = run(
+            f("lt", col("s"), lit("bb")),
+            {"s": ["apple", "banana", "cherry"]},
+            {"s": STRING},
+        )
+        np.testing.assert_array_equal(d, [True, True, False])
+
+    def test_like(self):
+        d, _, _ = run(
+            f("like", col("s"), lit("%an%")),
+            {"s": ["banana", "cherry", "mango"]},
+            {"s": STRING},
+        )
+        np.testing.assert_array_equal(d, [True, False, True])
+
+    def test_in_strings(self):
+        d, _, _ = run(
+            f("in", col("s"), lit("a"), lit("c")),
+            {"s": ["a", "b", "c"]},
+            {"s": STRING},
+        )
+        np.testing.assert_array_equal(d, [True, False, True])
+
+
+class TestDates:
+    def test_extract(self):
+        d, _, _ = run(
+            f("year", col("d")),
+            {"d": ["1994-01-01", "1998-12-31", "1970-01-01", "2024-02-29"]},
+            {"d": DATE},
+        )
+        np.testing.assert_array_equal(d, [1994, 1998, 1970, 2024])
+        d, _, _ = run(
+            f("month", col("d")),
+            {"d": ["1994-01-01", "1998-12-31", "2024-02-29"]},
+            {"d": DATE},
+        )
+        np.testing.assert_array_equal(d, [1, 12, 2])
+        d, _, _ = run(
+            f("day", col("d")),
+            {"d": ["1994-01-15", "1998-12-31", "2024-02-29"]},
+            {"d": DATE},
+        )
+        np.testing.assert_array_equal(d, [15, 31, 29])
+
+    def test_date_compare_literal(self):
+        from tidb_tpu.dtypes import date_to_days
+
+        d, _, _ = run(
+            f("lt", col("d"), lit(int(date_to_days("1995-01-01")))),
+            {"d": ["1994-06-01", "1996-01-01"]},
+            {"d": DATE},
+        )
+        np.testing.assert_array_equal(d, [True, False])
+
+
+class TestMisc:
+    def test_cast_string_to_float(self):
+        d, _, t = run(
+            Func(op="cast", args=(col("s"),), type=FLOAT64),
+            {"s": ["1.5", "2", "-3.25"]},
+            {"s": STRING},
+        )
+        np.testing.assert_allclose(d, [1.5, 2.0, -3.25])
+
+    def test_coalesce(self):
+        d, v, _ = run(
+            f("coalesce", col("a"), col("b")),
+            {"a": [None, 2, None], "b": [7, 9, None]},
+            {"a": INT64, "b": INT64},
+        )
+        np.testing.assert_array_equal(d[:2], [7, 2])
+        assert not v[2]
+
+
+class TestReviewFixes:
+    """Regressions from the first code review pass."""
+
+    def test_float_mod(self):
+        d, v, _ = run(
+            f("mod", col("a"), col("b")),
+            {"a": [5.5, -5.0], "b": [2.0, 3.0]},
+            {"a": FLOAT64, "b": FLOAT64},
+        )
+        np.testing.assert_allclose(d, [1.5, -2.0])
+
+    def test_intdiv_mod_truncate_toward_zero(self):
+        d, _, t = run(
+            f("intdiv", col("a"), col("b")),
+            {"a": [-7, 7], "b": [2, 2]},
+            {"a": INT64, "b": INT64},
+        )
+        assert t == INT64
+        np.testing.assert_array_equal(d, [-3, 3])
+        d, _, _ = run(
+            f("mod", col("a"), col("b")),
+            {"a": [-5, 5], "b": [3, -3]},
+            {"a": INT64, "b": INT64},
+        )
+        np.testing.assert_array_equal(d, [-2, 2])
+
+    def test_intdiv_decimal_is_integer(self):
+        d, _, t = run(
+            f("intdiv", col("a"), col("b")),
+            {"a": [5.00], "b": [2.00]},
+            {"a": DECIMAL(2), "b": DECIMAL(2)},
+        )
+        assert t == INT64
+        assert d[0] == 2
+
+    def test_date_vs_string_literal(self):
+        d, _, _ = run(
+            f("lt", col("d"), lit("1995-01-01")),
+            {"d": ["1994-06-01", "1996-01-01"]},
+            {"d": DATE},
+        )
+        np.testing.assert_array_equal(d, [True, False])
+
+    def test_string_eq_null_literal(self):
+        d, v, _ = run(
+            f("eq", col("s"), lit(None)),
+            {"s": ["None", "a"]},
+            {"s": STRING},
+        )
+        np.testing.assert_array_equal(v, [False, False])
+
+    def test_in_with_null(self):
+        d, v, _ = run(
+            f("in", col("a"), lit(1), lit(None)),
+            {"a": [1, 2]},
+            {"a": INT64},
+        )
+        assert d[0] and v[0]
+        assert not v[1]  # no match + NULL in list -> NULL

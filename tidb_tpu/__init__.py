@@ -1,0 +1,40 @@
+"""tidb_tpu — a TPU-native distributed SQL engine.
+
+A brand-new framework with the capabilities of TiDB (reference:
+/root/reference, pure Go), re-designed TPU-first:
+
+- Columnar batches are structs-of-arrays of fixed-width jax arrays with
+  validity bitmasks (the reference's Arrow-format ``chunk.Chunk``,
+  pkg/util/chunk/chunk.go:34, becomes ``DeviceBatch``).
+- Vectorized expression evaluation (reference ``VecExpr``,
+  pkg/expression/expression.go:116) compiles expression trees into jitted
+  XLA kernels over whole columns.
+- Relational operators (reference pkg/executor volcano-with-batches engine)
+  are pure functions Batch -> Batch composed into a single jitted program
+  per plan fragment — the analog of unistore's fused closure executor
+  (pkg/store/mockstore/unistore/cophandler/closure_exec.go:165).
+- MPP exchange (reference PhysicalExchangeSender, HashPartition/Broadcast/
+  PassThrough, pkg/planner/core/fragment.go:47) maps to jax.lax collectives
+  (all_to_all / all_gather / identity) under shard_map on an ICI mesh.
+- Dynamic shapes are banished: fixed row-capacity tiles + validity masks,
+  sort-based group-by and join algorithms, jit cache keyed by
+  (plan fingerprint, shape bucket).
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# SQL semantics need 64-bit ints (BIGINT, scaled decimals). Enable globally
+# before any tracing happens.
+_jax.config.update("jax_enable_x64", True)
+
+from tidb_tpu.dtypes import (  # noqa: F401
+    SQLType,
+    INT64,
+    FLOAT64,
+    BOOL,
+    DATE,
+    STRING,
+    DECIMAL,
+)

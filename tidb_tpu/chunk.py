@@ -1,0 +1,184 @@
+"""Columnar batches: host side (numpy) and device side (jax pytrees).
+
+Reference: pkg/util/chunk — Apache Arrow-format Chunk (chunk.go:34) with
+Column{nullBitmap, offsets, data} (column.go:63) and a sel vector. The TPU
+design keeps the same information with static shapes:
+
+- ``HostColumn``: numpy data + bool validity (+ sorted string dictionary).
+- ``HostBlock``: a set of named HostColumns with a row count — the unit of
+  storage (a table partition holds a list of blocks).
+- ``DevCol`` / ``Batch``: jax pytrees. ``Batch.row_valid`` plays the role of
+  the reference's sel vector: filters do not compact, they mask. Row
+  capacity is padded to a fixed tile ladder so XLA compiles one program per
+  (plan, shape bucket) — the analog of the reference's plan cache
+  (pkg/planner/core/plan_cache.go:231) interacting with paging sizes
+  (pkg/util/paging/paging.go:25).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.dtypes import Kind, SQLType
+
+# Fixed tile ladder (rows). Mirrors the reference's paging growth
+# 128 -> 50k (pkg/util/paging/paging.go:25-28) but with powers of two so a
+# handful of compiled programs cover all sizes.
+_MIN_CAPACITY = 256
+
+
+def pad_capacity(n: int) -> int:
+    """Smallest power-of-two tile >= n (>= _MIN_CAPACITY)."""
+    cap = _MIN_CAPACITY
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """Numpy-backed column. ``dictionary`` is present iff type is STRING;
+    it is sorted, so code order == binary collation order."""
+
+    type: SQLType
+    data: np.ndarray
+    valid: np.ndarray
+    dictionary: Optional[np.ndarray] = None  # np.array of str objects
+
+    def __post_init__(self) -> None:
+        assert self.data.shape == self.valid.shape
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def decode(self) -> np.ndarray:
+        """Materialize logical values (object array with None for NULL)."""
+        out = np.empty(len(self.data), dtype=object)
+        for i in range(len(self.data)):
+            if not self.valid[i]:
+                out[i] = None
+            elif self.type.kind == Kind.STRING:
+                out[i] = str(self.dictionary[self.data[i]])
+            elif self.type.kind == Kind.DECIMAL:
+                out[i] = int(self.data[i]) / (10 ** self.type.scale)
+            elif self.type.kind == Kind.BOOL:
+                out[i] = bool(self.data[i])
+            elif self.type.kind == Kind.FLOAT:
+                out[i] = float(self.data[i])
+            else:
+                out[i] = int(self.data[i])
+        return out
+
+
+def encode_strings(values: List[Optional[str]]) -> HostColumn:
+    """Dictionary-encode a string column. The dictionary is sorted so that
+    integer code comparisons implement binary-collation string comparisons
+    on device (reference collation engine: pkg/util/collate)."""
+    valid = np.array([v is not None for v in values], dtype=bool)
+    present = sorted({v for v in values if v is not None})
+    dictionary = np.array(present, dtype=object)
+    lookup = {v: i for i, v in enumerate(present)}
+    codes = np.array([lookup[v] if v is not None else 0 for v in values], dtype=np.int32)
+    from tidb_tpu.dtypes import STRING
+
+    return HostColumn(STRING, codes, valid, dictionary)
+
+
+def column_from_values(values: List, typ: SQLType) -> HostColumn:
+    if typ.kind == Kind.STRING:
+        return encode_strings(values)
+    valid = np.array([v is not None for v in values], dtype=bool)
+    if typ.kind == Kind.DECIMAL:
+        data = np.array(
+            [round(float(v) * 10**typ.scale) if v is not None else 0 for v in values],
+            dtype=np.int64,
+        )
+    elif typ.kind == Kind.DATE:
+        from tidb_tpu.dtypes import date_to_days
+
+        data = np.array(
+            [date_to_days(v) if isinstance(v, str) else (v or 0) for v in values],
+            dtype=np.int32,
+        )
+    else:
+        data = np.array([v if v is not None else 0 for v in values], dtype=typ.np_dtype)
+    return HostColumn(typ, data, valid)
+
+
+@dataclasses.dataclass
+class HostBlock:
+    """A batch of rows on the host: the storage unit of a table partition."""
+
+    columns: Dict[str, HostColumn]
+    nrows: int
+
+    @staticmethod
+    def from_columns(columns: Dict[str, HostColumn]) -> "HostBlock":
+        n = len(next(iter(columns.values()))) if columns else 0
+        for c in columns.values():
+            assert len(c) == n
+        return HostBlock(columns, n)
+
+
+# ---------------------------------------------------------------------------
+# Device side
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DevCol:
+    data: jax.Array
+    valid: jax.Array  # bool, True = not NULL
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Batch:
+    """Device-side batch: dict of columns + row validity (the sel vector)."""
+
+    cols: Dict[str, DevCol]
+    row_valid: jax.Array  # bool [capacity]
+
+    @property
+    def capacity(self) -> int:
+        return self.row_valid.shape[0]
+
+    def with_cols(self, cols: Dict[str, DevCol]) -> "Batch":
+        return Batch(cols, self.row_valid)
+
+    def nrows(self) -> jax.Array:
+        return jnp.sum(self.row_valid.astype(jnp.int32))
+
+
+def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
+    """Pad a host block to a static tile and move it to device layout."""
+    cap = capacity or pad_capacity(block.nrows)
+    pad = cap - block.nrows
+    cols = {}
+    for name, col in block.columns.items():
+        data = np.pad(col.data, (0, pad))
+        valid = np.pad(col.valid, (0, pad))
+        cols[name] = DevCol(jnp.asarray(data), jnp.asarray(valid))
+    row_valid = np.zeros(cap, dtype=bool)
+    row_valid[: block.nrows] = True
+    return Batch(cols, jnp.asarray(row_valid))
+
+
+def batch_to_block(
+    batch: Batch, types: Dict[str, SQLType], dicts: Dict[str, Optional[np.ndarray]]
+) -> HostBlock:
+    """Pull a device batch back to host and compact out invalid rows."""
+    row_valid = np.asarray(batch.row_valid)
+    idx = np.nonzero(row_valid)[0]
+    cols = {}
+    for name, dc in batch.cols.items():
+        data = np.asarray(dc.data)[idx]
+        valid = np.asarray(dc.valid)[idx]
+        cols[name] = HostColumn(types[name], data, valid, dicts.get(name))
+    return HostBlock(cols, len(idx))

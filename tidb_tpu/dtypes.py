@@ -1,0 +1,119 @@
+"""SQL type system mapped to TPU-friendly physical representations.
+
+Reference: pkg/types (Datum pkg/types/datum.go:66, MyDecimal
+pkg/types/mydecimal.go:236, Time/Duration, FieldType coercion). We keep the
+logical SQL types but choose physical representations that XLA tiles well:
+
+| SQL type      | device representation                                    |
+|---------------|----------------------------------------------------------|
+| BIGINT        | int64                                                    |
+| DOUBLE        | float64 (x64 enabled; TPU computes f64 via passes)       |
+| BOOLEAN       | bool                                                     |
+| DATE          | int32 days since 1970-01-01                              |
+| DECIMAL(p,s)  | scaled int64 (value * 10^s) — SF100 SUMs fit in i64 when |
+|               | accumulated as f64/i64 pairs; see aggregate.py           |
+| VARCHAR/CHAR  | int32 dictionary code; dictionary is sorted so code      |
+|               | order == lexicographic (utf8mb4_bin) order               |
+
+Every column carries a validity mask (True = not NULL), the reference's
+null bitmap (pkg/util/chunk/column.go:63).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+class Kind(enum.Enum):
+    INT = "int"
+    FLOAT = "float"
+    BOOL = "bool"
+    DATE = "date"
+    DECIMAL = "decimal"
+    STRING = "string"
+    NULL = "null"  # type of bare NULL literal before coercion
+
+
+@dataclasses.dataclass(frozen=True)
+class SQLType:
+    kind: Kind
+    # decimal scale (digits after the point); 0 for non-decimals.
+    scale: int = 0
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return {
+            Kind.INT: np.dtype(np.int64),
+            Kind.FLOAT: np.dtype(np.float64),
+            Kind.BOOL: np.dtype(np.bool_),
+            Kind.DATE: np.dtype(np.int32),
+            Kind.DECIMAL: np.dtype(np.int64),
+            Kind.STRING: np.dtype(np.int32),
+            Kind.NULL: np.dtype(np.int64),
+        }[self.kind]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (Kind.INT, Kind.FLOAT, Kind.DECIMAL, Kind.BOOL)
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == Kind.STRING
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        if self.kind == Kind.DECIMAL:
+            return f"DECIMAL(s={self.scale})"
+        return self.kind.name
+
+
+INT64 = SQLType(Kind.INT)
+FLOAT64 = SQLType(Kind.FLOAT)
+BOOL = SQLType(Kind.BOOL)
+DATE = SQLType(Kind.DATE)
+STRING = SQLType(Kind.STRING)
+NULLTYPE = SQLType(Kind.NULL)
+
+
+def DECIMAL(scale: int) -> SQLType:
+    return SQLType(Kind.DECIMAL, scale=scale)
+
+
+def common_type(a: SQLType, b: SQLType) -> SQLType:
+    """Result type of a binary arithmetic/comparison between a and b.
+
+    Mirrors the reference's numeric coercion (pkg/expression type inference):
+    FLOAT dominates; DECIMAL dominates INT; comparing decimals of different
+    scale promotes to the larger scale.
+    """
+    if a.kind == Kind.NULL:
+        return b
+    if b.kind == Kind.NULL:
+        return a
+    if a == b:
+        return a
+    kinds = {a.kind, b.kind}
+    if Kind.FLOAT in kinds:
+        return FLOAT64
+    if Kind.DECIMAL in kinds:
+        return DECIMAL(max(a.scale, b.scale))
+    if kinds <= {Kind.INT, Kind.BOOL}:
+        return INT64
+    if Kind.DATE in kinds and Kind.INT in kinds:
+        return INT64
+    if Kind.STRING in kinds:
+        # string vs numeric comparison: coerce via float (MySQL semantics),
+        # handled at plan time; default here keeps the numeric side.
+        return FLOAT64
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def date_to_days(s: str) -> int:
+    """'YYYY-MM-DD' -> int32 days since epoch."""
+    return (np.datetime64(s, "D") - np.datetime64("1970-01-01", "D")).astype(int)
+
+
+def days_to_date(d: int) -> str:
+    return str(np.datetime64("1970-01-01", "D") + int(d))

@@ -1,0 +1,8 @@
+from tidb_tpu.expression.expr import (  # noqa: F401
+    Expr,
+    ColumnRef,
+    Literal,
+    Func,
+    bind_expr,
+)
+from tidb_tpu.expression.kernels import compile_expr, DictContext  # noqa: F401

@@ -1,0 +1,178 @@
+"""Scalar expression trees + type binding.
+
+Reference: pkg/expression — Expression interface (expression.go:165) and the
+vectorized VecExpr interface (expression.go:116) with 296 builtin function
+classes (builtin.go:599). Here an expression is a small immutable tree;
+binding resolves column types and infers result types (the reference's
+FieldType inference in pkg/types); compilation (kernels.py) turns the tree
+into a jax function over a whole Batch — the vectorized path is the only
+path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.dtypes import (
+    BOOL,
+    DATE,
+    DECIMAL,
+    FLOAT64,
+    INT64,
+    NULLTYPE,
+    STRING,
+    Kind,
+    SQLType,
+    common_type,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    type: Optional[SQLType] = dataclasses.field(default=None, compare=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str = ""
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    value: object = None
+
+    def __repr__(self) -> str:
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Func(Expr):
+    op: str = ""
+    args: Tuple[Expr, ...] = ()
+
+    def __repr__(self) -> str:
+        return f"{self.op}({', '.join(map(repr, self.args))})"
+
+
+ARITH = {"add", "sub", "mul", "div", "intdiv", "mod"}
+COMPARE = {"eq", "ne", "lt", "le", "gt", "ge"}
+LOGIC = {"and", "or"}
+
+
+def literal_type(value: object) -> SQLType:
+    if value is None:
+        return NULLTYPE
+    if isinstance(value, bool):
+        return BOOL
+    if isinstance(value, int):
+        return INT64
+    if isinstance(value, float):
+        return FLOAT64
+    if isinstance(value, str):
+        return STRING
+    raise TypeError(f"unsupported literal {value!r}")
+
+
+def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
+    """Resolve column types and infer result types bottom-up."""
+    if isinstance(e, ColumnRef):
+        if e.name not in schema:
+            raise KeyError(f"unknown column {e.name!r}; have {sorted(schema)}")
+        return ColumnRef(type=schema[e.name], name=e.name)
+    if isinstance(e, Literal):
+        return Literal(type=e.type or literal_type(e.value), value=e.value)
+    assert isinstance(e, Func)
+    args = tuple(bind_expr(a, schema) for a in e.args)
+    args = _coerce_date_literals(e.op, args)
+    t = _infer(e.op, args, e.type)
+    return Func(type=t, op=e.op, args=args)
+
+
+def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
+    """MySQL coerces date-string literals when compared with DATE columns:
+    `d < '1995-01-01'` compares as dates, not strings."""
+    if op not in COMPARE and op not in {"in", "add", "sub"}:
+        return args
+    if not any(a.type is not None and a.type.kind == Kind.DATE for a in args):
+        return args
+    from tidb_tpu.dtypes import date_to_days
+
+    out = []
+    for a in args:
+        if (
+            isinstance(a, Literal)
+            and a.type is not None
+            and a.type.kind == Kind.STRING
+            and isinstance(a.value, str)
+        ):
+            out.append(Literal(type=DATE, value=int(date_to_days(a.value))))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLType:
+    ts = [a.type for a in args]
+    if op in COMPARE or op in LOGIC or op in {
+        "not", "isnull", "isnotnull", "like", "in", "istrue",
+    }:
+        return BOOL
+    if op == "cast":
+        assert declared is not None, "cast needs a declared target type"
+        return declared
+    if op in {"add", "sub"}:
+        t = common_type(ts[0], ts[1])
+        # DATE +/- INT days stays DATE.
+        if Kind.DATE in (ts[0].kind, ts[1].kind):
+            return DATE
+        return t
+    if op == "mul":
+        t = common_type(ts[0], ts[1])
+        if t.kind == Kind.DECIMAL:
+            return DECIMAL(ts[0].scale + ts[1].scale)
+        return t
+    if op == "div":
+        return FLOAT64
+    if op == "intdiv":
+        # MySQL DIV always yields an integer regardless of operand types.
+        return INT64
+    if op == "mod":
+        return common_type(ts[0], ts[1])
+    if op == "neg":
+        return ts[0]
+    if op in {"coalesce", "ifnull"}:
+        t = ts[0]
+        for u in ts[1:]:
+            t = common_type(t, u) if (t.kind != u.kind or t != u) else t
+        return t
+    if op == "case":
+        # args = [cond0, val0, cond1, val1, ..., else]
+        vals = [ts[i] for i in range(1, len(ts), 2)]
+        if len(ts) % 2 == 1:
+            vals.append(ts[-1])
+        t = vals[0]
+        for u in vals[1:]:
+            t = common_type(t, u) if t != u else t
+        return t
+    if op in {"year", "month", "day", "length"}:
+        return INT64
+    if op == "substr":
+        return STRING
+    raise NotImplementedError(f"type inference for op {op!r}")
+
+
+def walk_columns(e: Expr, out: Optional[set] = None) -> set:
+    """Set of column names referenced by e (used by column pruning,
+    reference rule columnPruner, pkg/planner/core/optimizer.go:98)."""
+    if out is None:
+        out = set()
+    if isinstance(e, ColumnRef):
+        out.add(e.name)
+    elif isinstance(e, Func):
+        for a in e.args:
+            walk_columns(a, out)
+    return out
