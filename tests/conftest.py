@@ -9,8 +9,26 @@ paths execute without TPU hardware. Must be set before jax imports.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep TPU tunnel out of tests
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's sitecustomize registers a TPU-tunnel PJRT plugin in
+# every interpreter; its backend init serializes processes on the tunnel
+# even when JAX_PLATFORMS=cpu. Deregister the factory before any jax op
+# initializes backends so tests run pure-CPU and in parallel.
+try:
+    import jax as _jax
+    from jax._src import xla_bridge as _xb
+
+    # sitecustomize imported jax before this file ran, so the env var was
+    # already latched — update the live config too.
+    _jax.config.update("jax_platforms", "cpu")
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass

@@ -1,0 +1,161 @@
+"""End-to-end TPC-H Q1/Q6 with hand-built plans, golden-checked against a
+numpy reference over the same data (SURVEY.md §7 phase 3)."""
+
+import numpy as np
+
+from tidb_tpu.chunk import batch_to_block
+from tidb_tpu.dtypes import date_to_days
+from tidb_tpu.executor import AggDesc, filter_batch, group_aggregate, order_by
+from tidb_tpu.expression import ColumnRef, Func, Literal, bind_expr, compile_expr
+from tidb_tpu.storage import Catalog, scan_table
+from tidb_tpu.bench import load_tpch
+
+
+def F(op, *args):
+    return Func(op=op, args=tuple(args))
+
+
+def C(name):
+    return ColumnRef(name=name)
+
+
+def L(v):
+    return Literal(value=v)
+
+
+def setup_catalog():
+    cat = Catalog()
+    load_tpch(cat, sf=0.002, tables=["orders", "lineitem"], seed=7)
+    return cat
+
+
+def test_q1_golden():
+    cat = setup_catalog()
+    li = cat.table("tpch", "lineitem")
+    cols = [
+        "l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+        "l_discount", "l_tax", "l_shipdate",
+    ]
+    batch, dicts = scan_table(li, cols)
+    types = li.schema.types
+
+    cutoff = int(date_to_days("1998-12-01")) - 90
+    pred = bind_expr(F("le", C("l_shipdate"), L(cutoff)), types)
+    disc_price = bind_expr(
+        F("mul", C("l_extendedprice"), F("sub", L(1), C("l_discount"))), types
+    )
+    charge = bind_expr(
+        F("mul", F("mul", C("l_extendedprice"), F("sub", L(1), C("l_discount"))),
+          F("add", L(1), C("l_tax"))), types,
+    )
+
+    b = filter_batch(batch, compile_expr(pred, dicts))
+    keys = [compile_expr(bind_expr(C(k), types), dicts) for k in ("l_returnflag", "l_linestatus")]
+    aggs = [
+        AggDesc("sum", compile_expr(bind_expr(C("l_quantity"), types), dicts), "sum_qty"),
+        AggDesc("sum", compile_expr(bind_expr(C("l_extendedprice"), types), dicts), "sum_base"),
+        AggDesc("sum", compile_expr(disc_price, dicts), "sum_disc"),
+        AggDesc("sum", compile_expr(charge, dicts), "sum_charge"),
+        AggDesc("avg", compile_expr(bind_expr(C("l_quantity"), types), dicts), "avg_qty"),
+        AggDesc("avg", compile_expr(bind_expr(C("l_discount"), types), dicts), "avg_disc"),
+        AggDesc("count", None, "cnt"),
+    ]
+    out, ng = group_aggregate(b, keys, aggs, 16, key_names=["l_returnflag", "l_linestatus"])
+    out = order_by(out, [lambda bb: bb.cols["l_returnflag"], lambda bb: bb.cols["l_linestatus"]], [False, False])
+
+    from tidb_tpu.dtypes import STRING, INT64, FLOAT64, DECIMAL
+    res = batch_to_block(
+        out,
+        {
+            "l_returnflag": STRING, "l_linestatus": STRING,
+            "sum_qty": DECIMAL(2), "sum_base": DECIMAL(2),
+            "sum_disc": DECIMAL(4), "sum_charge": DECIMAL(6),
+            "avg_qty": FLOAT64, "avg_disc": FLOAT64, "cnt": INT64,
+        },
+        {"l_returnflag": dicts["l_returnflag"], "l_linestatus": dicts["l_linestatus"]},
+    )
+
+    # ---- numpy golden over the same host data ----
+    blk = li.blocks()[0]
+    ship = blk.columns["l_shipdate"].data
+    mask = ship <= cutoff
+    rf = blk.columns["l_returnflag"].data[mask]
+    ls = blk.columns["l_linestatus"].data[mask]
+    qty = blk.columns["l_quantity"].data[mask]
+    price = blk.columns["l_extendedprice"].data[mask]
+    disc = blk.columns["l_discount"].data[mask]
+    tax = blk.columns["l_tax"].data[mask]
+    rf_dict = blk.columns["l_returnflag"].dictionary
+    ls_dict = blk.columns["l_linestatus"].dictionary
+
+    expected = {}
+    for rfc in range(len(rf_dict)):
+        for lsc in range(len(ls_dict)):
+            m = (rf == rfc) & (ls == lsc)
+            if not m.any():
+                continue
+            dp = price[m] * (10000 - disc[m] * 100)  # scale 2 * scale-4 factor
+            expected[(str(rf_dict[rfc]), str(ls_dict[lsc]))] = (
+                qty[m].sum(),
+                price[m].sum(),
+                dp.sum() // 100,  # to scale 4... computed below instead
+                int(m.sum()),
+            )
+
+    got_rows = {}
+    dec = {n: res.columns[n].decode() for n in res.columns}
+    for i in range(res.nrows):
+        key = (dec["l_returnflag"][i], dec["l_linestatus"][i])
+        got_rows[key] = (
+            round(dec["sum_qty"][i] * 100),
+            round(dec["sum_base"][i] * 100),
+            dec["sum_disc"][i],
+            dec["cnt"][i],
+        )
+
+    assert set(got_rows) == set(expected)
+    for key, (eq, ep, _ed, ec) in expected.items():
+        gq, gp, gd, gc = got_rows[key]
+        assert gq == eq, (key, gq, eq)
+        assert gp == ep, (key, gp, ep)
+        assert gc == ec
+        # disc price: scale-4 decimal, exact integer compare
+        m = (rf == np.where(rf_dict == key[0])[0][0]) & (
+            ls == np.where(ls_dict == key[1])[0][0]
+        )
+        exact = (price[m].astype(object) * (100 - disc[m].astype(object))).sum()
+        assert round(gd * 10**4) == exact, (key, gd, exact)
+
+
+def test_q6_golden():
+    cat = setup_catalog()
+    li = cat.table("tpch", "lineitem")
+    cols = ["l_shipdate", "l_discount", "l_quantity", "l_extendedprice"]
+    batch, dicts = scan_table(li, cols)
+    types = li.schema.types
+
+    pred = bind_expr(
+        F("and",
+          F("and",
+            F("ge", C("l_shipdate"), L("1994-01-01")),
+            F("lt", C("l_shipdate"), L("1995-01-01"))),
+          F("and",
+            F("and", F("ge", C("l_discount"), L(0.05)), F("le", C("l_discount"), L(0.07))),
+            F("lt", C("l_quantity"), L(24)))),
+        types,
+    )
+    revenue = bind_expr(F("mul", C("l_extendedprice"), C("l_discount")), types)
+    b = filter_batch(batch, compile_expr(pred, dicts))
+    out, _ = group_aggregate(b, [], [AggDesc("sum", compile_expr(revenue, dicts), "rev")], 4)
+    got = int(np.asarray(out.cols["rev"].data)[0])
+
+    blk = li.blocks()[0]
+    ship = blk.columns["l_shipdate"].data
+    disc = blk.columns["l_discount"].data
+    qty = blk.columns["l_quantity"].data
+    price = blk.columns["l_extendedprice"].data
+    d0, d1 = int(date_to_days("1994-01-01")), int(date_to_days("1995-01-01"))
+    m = (ship >= d0) & (ship < d1) & (disc >= 5) & (disc <= 7) & (qty < 2400)
+    expected = int((price[m].astype(object) * disc[m].astype(object)).sum())
+    assert got == expected
+    assert int(np.asarray(out.cols["rev"].valid)[0]) == (1 if m.any() else 0)

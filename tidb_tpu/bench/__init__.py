@@ -1,0 +1,1 @@
+from tidb_tpu.bench.tpch import load_tpch  # noqa: F401

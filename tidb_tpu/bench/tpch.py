@@ -1,0 +1,186 @@
+"""TPC-H schema + synthetic data generator.
+
+Columns/types follow the TPC-H spec (the reference's benchmark ladder in
+BASELINE.json runs Q1/Q6/Q3/Q5/Q18 against the same schema). Data is
+synthetic-but-faithful: matching key cardinalities and value ranges so
+query selectivities are realistic; correctness is checked against a numpy
+reference computation over the *same* generated data, so exact dbgen
+content is not required.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from tidb_tpu.chunk import HostBlock, HostColumn
+from tidb_tpu.dtypes import DATE, DECIMAL, INT64, STRING, date_to_days
+from tidb_tpu.storage import Catalog, Table, TableSchema
+
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+_NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+_D_LO = int(date_to_days("1992-01-01"))
+_D_HI = int(date_to_days("1998-08-02"))
+
+
+def _dict_col(values: np.ndarray, universe) -> HostColumn:
+    """Build a STRING column from integer codes into a fixed universe."""
+    uni = np.array(sorted(universe), dtype=object)
+    order = np.argsort(np.array(list(universe), dtype=object), kind="stable")
+    # map original universe index -> sorted code
+    remap = np.empty(len(universe), dtype=np.int32)
+    remap[order] = np.arange(len(universe), dtype=np.int32)
+    codes = remap[values]
+    return HostColumn(STRING, codes.astype(np.int32), np.ones(len(values), bool), uni)
+
+
+def _num(data, typ) -> HostColumn:
+    return HostColumn(typ, data, np.ones(len(data), bool))
+
+
+def _dec(value_cents: np.ndarray, scale=2) -> HostColumn:
+    return HostColumn(DECIMAL(scale), value_cents.astype(np.int64), np.ones(len(value_cents), bool))
+
+
+def gen_lineitem(sf: float, rng: np.random.Generator, n_orders: int) -> HostBlock:
+    n = int(6_000_000 * sf)
+    orderkey = rng.integers(1, n_orders + 1, n).astype(np.int64)
+    n_parts = max(int(200_000 * sf), 1000)
+    n_supps = max(int(10_000 * sf), 100)
+    cols = {
+        "l_orderkey": _num(orderkey, INT64),
+        "l_partkey": _num(rng.integers(1, n_parts + 1, n).astype(np.int64), INT64),
+        "l_suppkey": _num(rng.integers(1, n_supps + 1, n).astype(np.int64), INT64),
+        "l_linenumber": _num(rng.integers(1, 8, n).astype(np.int64), INT64),
+        "l_quantity": _dec(rng.integers(1, 51, n) * 100),
+        "l_extendedprice": _dec(rng.integers(90_000, 10_500_000, n)),
+        "l_discount": _dec(rng.integers(0, 11, n)),
+        "l_tax": _dec(rng.integers(0, 9, n)),
+        "l_returnflag": _dict_col(rng.integers(0, 3, n), ["A", "N", "R"]),
+        "l_linestatus": _dict_col(rng.integers(0, 2, n), ["F", "O"]),
+        "l_shipdate": _num(rng.integers(_D_LO, _D_HI, n).astype(np.int32), DATE),
+        "l_commitdate": _num(rng.integers(_D_LO, _D_HI, n).astype(np.int32), DATE),
+        "l_receiptdate": _num(rng.integers(_D_LO, _D_HI, n).astype(np.int32), DATE),
+        "l_shipmode": _dict_col(rng.integers(0, len(_SHIPMODES), n), _SHIPMODES),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_orders(sf: float, rng: np.random.Generator) -> HostBlock:
+    n = int(1_500_000 * sf)
+    n_cust = max(int(150_000 * sf), 100)
+    cols = {
+        "o_orderkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
+        "o_custkey": _num(rng.integers(1, n_cust + 1, n).astype(np.int64), INT64),
+        "o_orderstatus": _dict_col(rng.integers(0, 3, n), ["F", "O", "P"]),
+        "o_totalprice": _dec(rng.integers(90_000, 50_000_000, n)),
+        "o_orderdate": _num(rng.integers(_D_LO, _D_HI - 151, n).astype(np.int32), DATE),
+        "o_orderpriority": _dict_col(rng.integers(0, len(_PRIORITIES), n), _PRIORITIES),
+        "o_shippriority": _num(np.zeros(n, dtype=np.int64), INT64),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_customer(sf: float, rng: np.random.Generator) -> HostBlock:
+    n = max(int(150_000 * sf), 100)
+    cols = {
+        "c_custkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
+        "c_nationkey": _num(rng.integers(0, 25, n).astype(np.int64), INT64),
+        "c_mktsegment": _dict_col(rng.integers(0, len(_SEGMENTS), n), _SEGMENTS),
+        "c_acctbal": _dec(rng.integers(-99_999, 1_000_000, n)),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_supplier(sf: float, rng: np.random.Generator) -> HostBlock:
+    n = max(int(10_000 * sf), 100)
+    cols = {
+        "s_suppkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
+        "s_nationkey": _num(rng.integers(0, 25, n).astype(np.int64), INT64),
+        "s_acctbal": _dec(rng.integers(-99_999, 1_000_000, n)),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_nation() -> HostBlock:
+    cols = {
+        "n_nationkey": _num(np.arange(25, dtype=np.int64), INT64),
+        "n_name": _dict_col(np.arange(25), [n for n, _ in _NATIONS]),
+        "n_regionkey": _num(np.array([r for _, r in _NATIONS], dtype=np.int64), INT64),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_region() -> HostBlock:
+    cols = {
+        "r_regionkey": _num(np.arange(5, dtype=np.int64), INT64),
+        "r_name": _dict_col(np.arange(5), _REGIONS),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_part(sf: float, rng: np.random.Generator) -> HostBlock:
+    n = max(int(200_000 * sf), 1000)
+    brands = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+    containers = ["SM CASE", "SM BOX", "SM PACK", "LG CASE", "LG BOX", "MED BAG", "JUMBO PKG"]
+    cols = {
+        "p_partkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
+        "p_brand": _dict_col(rng.integers(0, len(brands), n), brands),
+        "p_size": _num(rng.integers(1, 51, n).astype(np.int64), INT64),
+        "p_container": _dict_col(rng.integers(0, len(containers), n), containers),
+        "p_retailprice": _dec(rng.integers(90_000, 200_000, n)),
+    }
+    return HostBlock.from_columns(cols)
+
+
+_SCHEMAS: Dict[str, TableSchema] = {}
+
+
+def _schema_of(block: HostBlock) -> TableSchema:
+    return TableSchema([(n, c.type) for n, c in block.columns.items()])
+
+
+def load_tpch(
+    catalog: Catalog,
+    sf: float = 0.01,
+    db: str = "tpch",
+    seed: int = 0,
+    tables: Optional[list] = None,
+) -> None:
+    """Generate and load TPC-H tables into the catalog."""
+    rng = np.random.default_rng(seed)
+    catalog.create_database(db, if_not_exists=True)
+    orders = gen_orders(sf, rng)
+    gens = {
+        "orders": lambda: orders,
+        "lineitem": lambda: gen_lineitem(sf, rng, orders.nrows),
+        "customer": lambda: gen_customer(sf, rng),
+        "supplier": lambda: gen_supplier(sf, rng),
+        "nation": gen_nation,
+        "region": gen_region,
+        "part": lambda: gen_part(sf, rng),
+    }
+    for name, gen in gens.items():
+        if tables is not None and name not in tables:
+            continue
+        block = gen()
+        t = catalog.create_table(db, name, _schema_of(block), if_not_exists=True)
+        if t.nrows == 0:
+            # bypass dictionary merge (fresh table, dicts already sorted)
+            t.dictionaries.update(
+                {n: c.dictionary for n, c in block.columns.items() if c.dictionary is not None}
+            )
+            t.replace_blocks([block])

@@ -1,0 +1,4 @@
+from tidb_tpu.executor.aggregate import AggDesc, group_aggregate  # noqa: F401
+from tidb_tpu.executor.sort import order_by, limit as limit_op, top_n  # noqa: F401
+from tidb_tpu.executor.join import equi_join  # noqa: F401
+from tidb_tpu.executor.project import project, filter_batch  # noqa: F401

@@ -1,0 +1,181 @@
+"""Sort-based group aggregation with static shapes.
+
+Reference: the parallel hash aggregate with partial/final workers
+(pkg/executor/aggregate/agg_hash_executor.go:60-91) and StreamAggExec
+(agg_stream_executor.go:32). Hash tables need dynamic shapes, so the TPU
+design is the StreamAgg path made total: sort rows by group key
+(lax.sort tiles well on TPU), derive segment ids from key-change flags,
+then segment_sum/min/max into a fixed-capacity group table. The
+partial/final split of the reference maps to per-device local aggregation
+followed by an all_to_all repartition of group keys and a final aggregation
+(parallel/exchange.py), exactly mirroring agg partial workers -> shuffle ->
+final workers.
+
+Group capacity is a static parameter; the kernel returns the true group
+count so the host can detect overflow and retry at the next capacity tile
+(the analog of the reference's spill escalation, aggregate/agg_spill.go,
+which we replace with recompile-at-larger-tile).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+@dataclasses.dataclass(frozen=True)
+class AggDesc:
+    """An aggregate: func in {sum,count,avg,min,max,first}, over arg_fn.
+
+    count with arg_fn=None is COUNT(*). ``sum_as_float`` forces float
+    accumulation (AVG over ints / DOUBLE sums).
+    """
+
+    func: str
+    arg: Optional[ExprFn]
+    out_name: str
+    distinct: bool = False
+
+
+def group_aggregate(
+    batch: Batch,
+    key_fns: Sequence[ExprFn],
+    aggs: Sequence[AggDesc],
+    group_capacity: int,
+    key_names: Optional[Sequence[str]] = None,
+) -> Tuple[Batch, jax.Array]:
+    """Returns (group batch, true group count).
+
+    The group batch has one row per group (padded to group_capacity):
+    key columns first (named key_names or k0..kn), then one column per agg.
+    """
+    cap = batch.capacity
+    key_names = list(key_names or [f"k{i}" for i in range(len(key_fns))])
+
+    keys = [fn(batch) for fn in key_fns]
+    # Pre-evaluate agg args on the unsorted batch; we sort indices instead
+    # of every column (one gather per used array).
+    arg_cols = [a.arg(batch) if a.arg is not None else None for a in aggs]
+
+    # --- sort by (row_valid first, then key-null flag, then key value) ---
+    # NULL group keys form one group of their own (MySQL groups NULLs
+    # together); grouping output order is unspecified, so null-group
+    # placement among groups is free.
+    operands: List[jax.Array] = [~batch.row_valid]
+    for k in keys:
+        operands.append(~k.valid)
+        operands.append(jnp.where(k.valid, k.data, jnp.zeros_like(k.data)))
+    sorted_ops = jax.lax.sort(
+        operands + [jnp.arange(cap, dtype=jnp.int32)], num_keys=len(operands)
+    )
+    perm = sorted_ops[-1]
+    srow_valid = ~sorted_ops[0]
+
+    # key change flags over the sorted order
+    flags = jnp.zeros(cap, dtype=jnp.bool_)
+    i = 1
+    for k in keys:
+        for arr in (sorted_ops[i], sorted_ops[i + 1]):
+            flags = flags | (arr != jnp.roll(arr, 1))
+        i += 2
+    flags = flags.at[0].set(True)
+    flags = flags & srow_valid
+    seg = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    ngroups = jnp.max(jnp.where(srow_valid, seg, -1)) + 1
+    # invalid rows -> segment group_capacity-1? No: give them an overflow
+    # segment id == group_capacity so segment_* with num_segments=capacity
+    # drops them.
+    seg = jnp.where(srow_valid, seg, group_capacity)
+
+    group_valid = jnp.arange(group_capacity) < ngroups
+
+    # --- group key columns: value at first row of each segment ---
+    first_idx = (
+        jnp.full(group_capacity + 1, cap - 1, dtype=jnp.int32)
+        .at[seg]
+        .min(jnp.arange(cap, dtype=jnp.int32), mode="drop")[:group_capacity]
+    )
+
+    out_cols = {}
+    for name, k in zip(key_names, keys):
+        kd = k.data[perm][first_idx]
+        kv = k.valid[perm][first_idx] & group_valid
+        out_cols[name] = DevCol(jnp.where(group_valid, kd, jnp.zeros_like(kd)), kv)
+
+    # --- aggregates ---
+    num_segments = group_capacity + 1  # +1 overflow slot for invalid rows
+    for a, col in zip(aggs, arg_cols):
+        if a.func == "count" and col is None:
+            vals = jnp.ones(cap, dtype=jnp.int64)
+            contrib = srow_valid
+            s = jax.ops.segment_sum(
+                jnp.where(contrib, vals, 0), seg, num_segments=num_segments
+            )[:group_capacity]
+            out_cols[a.out_name] = DevCol(s, group_valid)
+            continue
+
+        data = col.data[perm]
+        valid = col.valid[perm] & srow_valid
+        if a.func == "count":
+            s = jax.ops.segment_sum(
+                valid.astype(jnp.int64), seg, num_segments=num_segments
+            )[:group_capacity]
+            out_cols[a.out_name] = DevCol(s, group_valid)
+        elif a.func in ("sum", "avg"):
+            zero = jnp.zeros((), dtype=data.dtype)
+            s = jax.ops.segment_sum(
+                jnp.where(valid, data, zero), seg, num_segments=num_segments
+            )[:group_capacity]
+            cnt = jax.ops.segment_sum(
+                valid.astype(jnp.int64), seg, num_segments=num_segments
+            )[:group_capacity]
+            # SUM over an all-NULL / empty group is NULL (MySQL)
+            v = (cnt > 0) & group_valid
+            if a.func == "sum":
+                out_cols[a.out_name] = DevCol(s, v)
+            else:
+                denom = jnp.where(cnt == 0, 1, cnt)
+                out_cols[a.out_name] = DevCol(
+                    s.astype(jnp.float64) / denom.astype(jnp.float64), v
+                )
+        elif a.func in ("min", "max"):
+            if a.func == "min":
+                big = _type_max(data.dtype)
+                s = jax.ops.segment_min(
+                    jnp.where(valid, data, big), seg, num_segments=num_segments
+                )[:group_capacity]
+            else:
+                small = _type_min(data.dtype)
+                s = jax.ops.segment_max(
+                    jnp.where(valid, data, small), seg, num_segments=num_segments
+                )[:group_capacity]
+            cnt = jax.ops.segment_sum(
+                valid.astype(jnp.int32), seg, num_segments=num_segments
+            )[:group_capacity]
+            out_cols[a.out_name] = DevCol(s, (cnt > 0) & group_valid)
+        elif a.func == "first":
+            d = data[first_idx]
+            out_cols[a.out_name] = DevCol(d, col.valid[perm][first_idx] & group_valid)
+        else:
+            raise NotImplementedError(f"agg func {a.func!r}")
+
+    return Batch(out_cols, group_valid), ngroups
+
+
+def _type_max(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype=dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def _type_min(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype=dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype=dtype)

@@ -1,0 +1,121 @@
+"""Equi-joins with static shapes: sort the build side, binary-search from
+the probe side, expand matches into a fixed-capacity output.
+
+Reference: HashJoinExec with build/probe workers
+(pkg/executor/join/join.go:125,117,91) and the row-emit strategies in
+join/joiner.go (inner, left outer, semi, anti). A device hash table needs
+dynamic shapes, so the TPU formulation is:
+
+  build:  sort build rows by key (lax.sort, invalid/NULL keys sink)
+  probe:  lo/hi = searchsorted(build_keys, probe_key, left/right)
+          counts = hi - lo                      (0 for NULL/invalid)
+  expand: out_slot j -> probe row = searchsorted(cumsum(counts), j, right)
+          build row  = lo[probe] + (j - cum[probe-1])
+
+Everything is a fixed-size gather/scan; the true match total is returned
+so the host retries at the next output-capacity tile on overflow — the
+static-shape analog of the reference's spillable hashRowContainer
+(join/hash_table.go).
+
+Join types: inner, left (outer), semi, anti. Semi/anti never expand —
+they just mask probe rows, like the reference's semi joiners.
+
+Multi-column keys are packed into one i64 by the planner (dictionary codes
+and small ints shift-packed); collisions are impossible because pack
+layouts are chosen from column value ranges.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+def _keys_of(batch: Batch, key_fn: ExprFn) -> Tuple[jax.Array, jax.Array]:
+    k = key_fn(batch)
+    valid = k.valid & batch.row_valid
+    return k.data.astype(jnp.int64), valid
+
+
+def equi_join(
+    build: Batch,
+    probe: Batch,
+    build_key: ExprFn,
+    probe_key: ExprFn,
+    out_capacity: int,
+    join_type: str = "inner",
+    build_prefix: str = "",
+    probe_prefix: str = "",
+) -> Tuple[Batch, jax.Array]:
+    """Returns (joined batch, true output row count).
+
+    For semi/anti the result is the probe batch with a refined row_valid
+    (and the true surviving row count); out_capacity is ignored.
+    For left joins, unmatched probe rows emit once with NULL build columns.
+    """
+    bkey, bvalid = _keys_of(build, build_key)
+    pkey, pvalid = _keys_of(probe, probe_key)
+    bcap = build.capacity
+
+    if join_type in ("semi", "anti"):
+        sort_out = jax.lax.sort([~bvalid, bkey], num_keys=2)
+        skey = jnp.where(~sort_out[0], sort_out[1], jnp.iinfo(jnp.int64).max)
+        lo = jnp.searchsorted(skey, pkey, side="left")
+        hi = jnp.searchsorted(skey, pkey, side="right")
+        matched = (hi > lo) & pvalid
+        keep = matched if join_type == "semi" else (~matched & probe.row_valid & pvalid)
+        if join_type == "anti":
+            # NULL probe key in NOT IN/anti: row never matches but with a
+            # NULL key the comparison is NULL -> row is dropped too (the
+            # null-aware anti-join case, reference join/joiner.go). Plain
+            # NOT EXISTS keeps it; planner selects via null_aware flag.
+            keep = keep | (~pvalid & probe.row_valid)
+        out = Batch(probe.cols, probe.row_valid & keep)
+        return out, jnp.sum(out.row_valid.astype(jnp.int64))
+
+    # ---- inner / left: sort build side, carry permutation ----
+    sort_out = jax.lax.sort(
+        [~bvalid, bkey, jnp.arange(bcap, dtype=jnp.int32)], num_keys=2
+    )
+    svalid = ~sort_out[0]
+    skey = jnp.where(svalid, sort_out[1], jnp.iinfo(jnp.int64).max)
+    sperm = sort_out[2]
+
+    lo = jnp.searchsorted(skey, pkey, side="left")
+    hi = jnp.searchsorted(skey, pkey, side="right")
+    counts = jnp.where(pvalid & probe.row_valid, hi - lo, 0)
+    if join_type == "left":
+        emit = jnp.where(probe.row_valid, jnp.maximum(counts, 1), 0)
+    else:
+        emit = counts
+
+    cum = jnp.cumsum(emit)
+    total = cum[-1] if cum.shape[0] else jnp.zeros((), jnp.int64)
+    # out slot j -> probe row
+    slots = jnp.arange(out_capacity, dtype=jnp.int64)
+    prow = jnp.searchsorted(cum, slots, side="right")
+    prow_c = jnp.clip(prow, 0, probe.capacity - 1)
+    base = cum[prow_c] - emit[prow_c]
+    offset = slots - base
+    out_valid = slots < total
+
+    brow_sorted = jnp.clip(lo[prow_c] + offset, 0, bcap - 1)
+    brow = sperm[brow_sorted]
+    bmatched = offset < counts[prow_c]  # false only for left-join null row
+
+    cols: Dict[str, DevCol] = {}
+    for name, c in probe.cols.items():
+        cols[probe_prefix + name] = DevCol(
+            c.data[prow_c], c.valid[prow_c] & out_valid
+        )
+    for name, c in build.cols.items():
+        cols[build_prefix + name] = DevCol(
+            c.data[brow], c.valid[brow] & out_valid & bmatched
+        )
+    return Batch(cols, out_valid), total

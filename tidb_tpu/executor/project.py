@@ -1,0 +1,26 @@
+"""Projection and selection as Batch -> Batch functions.
+
+Reference: ProjectionExec (pkg/executor/projection.go:60) and SelectionExec
+(pkg/executor/executor.go:1526). On TPU a filter never compacts — it ANDs
+into ``row_valid`` (the sel-vector model of pkg/util/chunk) and XLA fuses it
+into neighbouring kernels; compaction happens only at host materialization
+or before expensive blocking ops (see sort.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+def project(batch: Batch, outputs: Dict[str, ExprFn]) -> Batch:
+    return Batch({name: fn(batch) for name, fn in outputs.items()}, batch.row_valid)
+
+
+def filter_batch(batch: Batch, pred: ExprFn) -> Batch:
+    c = pred(batch)
+    keep = c.valid & c.data.astype(bool)  # NULL predicate drops the row
+    return Batch(batch.cols, batch.row_valid & keep)

@@ -1,0 +1,73 @@
+"""ORDER BY / TOP-N / LIMIT with static shapes.
+
+Reference: SortExec's parallel multi-way merge sort
+(pkg/executor/sortexec/sort.go:38, parallel_sort_worker.go:31), TopNExec
+(topn.go:31) and LimitExec (executor.go:1307). On TPU a single lax.sort
+over the whole tile replaces the worker/merge machinery (the sort network
+is the parallelism); TopN = sort + limit mask; spill never happens on
+device — oversized sorts are partitioned across the mesh and merged
+(parallel/exchange.py), or staged through host RAM.
+
+Sort keys encode direction and MySQL null ordering (NULLs first ASC,
+last DESC) by key transforms, so one ascending lax.sort handles all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+def _directional_operands(batch: Batch, key_fns, descs) -> List[jax.Array]:
+    """Build ascending-sort operands implementing direction + null order.
+    Invalid rows always sink to the end."""
+    ops: List[jax.Array] = [~batch.row_valid]
+    for fn, desc in zip(key_fns, descs):
+        k = fn(batch)
+        valid = k.valid & batch.row_valid
+        # MySQL: NULLs sort first ascending, last descending. Ascending
+        # lax.sort puts False before True, so NULL rows need null_key False
+        # for ASC (valid) and True for DESC (~valid).
+        null_key = ~valid if desc else valid
+        data = k.data
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            dirdata = -data if desc else data
+        elif data.dtype == jnp.bool_:
+            dirdata = data ^ desc
+        else:
+            dirdata = -data.astype(jnp.int64) if desc else data
+        ops.append(null_key)
+        ops.append(jnp.where(valid, dirdata, jnp.zeros_like(dirdata)))
+    return ops
+
+
+def sort_permutation(batch: Batch, key_fns, descs) -> jax.Array:
+    cap = batch.capacity
+    ops = _directional_operands(batch, key_fns, descs)
+    out = jax.lax.sort(ops + [jnp.arange(cap, dtype=jnp.int32)], num_keys=len(ops))
+    return out[-1]
+
+
+def order_by(batch: Batch, key_fns, descs) -> Batch:
+    """Fully sort the batch (valid rows first, in key order)."""
+    perm = sort_permutation(batch, key_fns, descs)
+    cols = {n: DevCol(c.data[perm], c.valid[perm]) for n, c in batch.cols.items()}
+    return Batch(cols, batch.row_valid[perm])
+
+
+def limit(batch: Batch, k: int, offset: int = 0) -> Batch:
+    """Keep rows [offset, offset+k) in current row order (LimitExec)."""
+    pos = jnp.cumsum(batch.row_valid.astype(jnp.int64)) - 1  # rank of each valid row
+    keep = batch.row_valid & (pos >= offset) & (pos < offset + k)
+    return Batch(batch.cols, keep)
+
+
+def top_n(batch: Batch, key_fns, descs, k: int, offset: int = 0) -> Batch:
+    """ORDER BY ... LIMIT k: sort then mask (TopNExec topn.go:31)."""
+    return limit(order_by(batch, key_fns, descs), k, offset)

@@ -1,0 +1,3 @@
+from tidb_tpu.storage.table import Table, TableSchema  # noqa: F401
+from tidb_tpu.storage.catalog import Catalog  # noqa: F401
+from tidb_tpu.storage.scan import scan_table  # noqa: F401

@@ -1,0 +1,78 @@
+"""Catalog: databases and tables, versioned.
+
+Reference: pkg/infoschema (InfoSchema interface.go:26 — immutable versioned
+snapshot of schema objects) + pkg/meta (schema encoded in KV). In-process
+we keep it direct: a dict of databases with a global schema version bumped
+on every DDL, which the session layer uses for plan-cache invalidation
+(the analog of the schema-version checks in domain.SchemaValidator).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from tidb_tpu.storage.table import Table, TableSchema
+
+
+class Catalog:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.schema_version = 0
+        self._dbs: Dict[str, Dict[str, Table]] = {"test": {}}
+
+    def create_database(self, name: str, if_not_exists: bool = False) -> None:
+        name = name.lower()
+        with self._lock:
+            if name in self._dbs:
+                if if_not_exists:
+                    return
+                raise ValueError(f"database {name!r} exists")
+            self._dbs[name] = {}
+            self.schema_version += 1
+
+    def drop_database(self, name: str) -> None:
+        with self._lock:
+            self._dbs.pop(name.lower(), None)
+            self.schema_version += 1
+
+    def create_table(
+        self, db: str, name: str, schema: TableSchema, if_not_exists: bool = False
+    ) -> Table:
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if db not in self._dbs:
+                raise ValueError(f"unknown database {db!r}")
+            if name in self._dbs[db]:
+                if if_not_exists:
+                    return self._dbs[db][name]
+                raise ValueError(f"table {name!r} exists")
+            t = Table(name, schema)
+            self._dbs[db][name] = t
+            self.schema_version += 1
+            return t
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False) -> None:
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if name not in self._dbs.get(db, {}):
+                if if_exists:
+                    return
+                raise ValueError(f"unknown table {db}.{name}")
+            del self._dbs[db][name]
+            self.schema_version += 1
+
+    def table(self, db: str, name: str) -> Table:
+        try:
+            return self._dbs[db.lower()][name.lower()]
+        except KeyError:
+            raise ValueError(f"unknown table {db}.{name}") from None
+
+    def tables(self, db: str) -> List[str]:
+        return sorted(self._dbs.get(db.lower(), {}))
+
+    def databases(self) -> List[str]:
+        return sorted(self._dbs)
+
+    def has_table(self, db: str, name: str) -> bool:
+        return name.lower() in self._dbs.get(db.lower(), {})

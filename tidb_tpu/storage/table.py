@@ -1,0 +1,169 @@
+"""Host-side columnar table store.
+
+Reference seam: the engine runs against kv.Storage (pkg/kv/kv.go:681) with
+unistore's MVCC-over-badger as the embedded implementation
+(pkg/store/mockstore/unistore/tikv/mvcc.go:51); rows are encoded via
+rowcodec (pkg/util/rowcodec/encoder.go:30). The TPU-native store skips the
+KV encoding entirely: tables live as columnar HostBlocks (Arrow layout)
+partitioned for the device mesh, the direct analog of TiFlash's columnar
+replica. MVCC-lite: every write produces a new immutable version (list of
+blocks is copy-on-write); snapshots pin a version, so readers never block
+writers (the reference's snapshot isolation at the storage layer).
+
+String dictionaries are table-global per column: appends merge and remap
+codes so a whole column always shares one sorted dictionary — this is what
+makes device-side string compares/joins pure integer ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import HostBlock, HostColumn, column_from_values
+from tidb_tpu.dtypes import Kind, SQLType
+
+
+@dataclasses.dataclass
+class TableSchema:
+    # ordered (name, type); names stored lowercase
+    columns: List[Tuple[str, SQLType]]
+    primary_key: Optional[List[str]] = None
+
+    @property
+    def names(self) -> List[str]:
+        return [n for n, _ in self.columns]
+
+    @property
+    def types(self) -> Dict[str, SQLType]:
+        return dict(self.columns)
+
+
+def _merge_dictionaries(
+    old: Optional[np.ndarray], new: Optional[np.ndarray]
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+    """Merge two sorted dicts; return (merged, old_remap, new_remap)."""
+    old = old if old is not None else np.array([], dtype=object)
+    new = new if new is not None else np.array([], dtype=object)
+    merged = np.array(sorted(set(old.tolist()) | set(new.tolist())), dtype=object)
+    lookup = {v: i for i, v in enumerate(merged.tolist())}
+    old_remap = (
+        np.array([lookup[v] for v in old.tolist()], dtype=np.int32)
+        if len(old)
+        else None
+    )
+    new_remap = (
+        np.array([lookup[v] for v in new.tolist()], dtype=np.int32)
+        if len(new)
+        else None
+    )
+    return merged, old_remap, new_remap
+
+
+class Table:
+    def __init__(self, name: str, schema: TableSchema):
+        self.name = name
+        self.schema = schema
+        self._lock = threading.Lock()
+        self.version = 0
+        # version -> list of blocks (copy-on-write)
+        self._versions: Dict[int, List[HostBlock]] = {0: []}
+        # table-global sorted dictionary per string column
+        self.dictionaries: Dict[str, np.ndarray] = {
+            n: np.array([], dtype=object)
+            for n, t in schema.columns
+            if t.kind == Kind.STRING
+        }
+
+    # -- read --------------------------------------------------------------
+    def blocks(self, version: Optional[int] = None) -> List[HostBlock]:
+        v = self.version if version is None else version
+        return self._versions[v]
+
+    @property
+    def nrows(self) -> int:
+        return sum(b.nrows for b in self.blocks())
+
+    # -- write -------------------------------------------------------------
+    def append_block(self, block: HostBlock) -> int:
+        """Append rows; returns the new version id."""
+        with self._lock:
+            block = self._align_dictionaries(block)
+            new_blocks = list(self._versions[self.version]) + [block]
+            self.version += 1
+            self._versions[self.version] = new_blocks
+            return self.version
+
+    def append_rows(self, rows: Sequence[Sequence]) -> int:
+        cols = {}
+        for i, (name, typ) in enumerate(self.schema.columns):
+            cols[name] = column_from_values([r[i] for r in rows], typ)
+        return self.append_block(HostBlock.from_columns(cols))
+
+    def delete_where(self, keep_mask_per_block: List[np.ndarray]) -> int:
+        """Replace current version with masked blocks (DELETE)."""
+        with self._lock:
+            new_blocks = []
+            for block, keep in zip(self._versions[self.version], keep_mask_per_block):
+                if keep.all():
+                    new_blocks.append(block)
+                    continue
+                idx = np.nonzero(keep)[0]
+                cols = {
+                    n: HostColumn(c.type, c.data[idx], c.valid[idx], c.dictionary)
+                    for n, c in block.columns.items()
+                }
+                new_blocks.append(HostBlock(cols, len(idx)))
+            self.version += 1
+            self._versions[self.version] = [b for b in new_blocks if b.nrows > 0]
+            return self.version
+
+    def replace_blocks(self, blocks: List[HostBlock]) -> int:
+        with self._lock:
+            self.version += 1
+            self._versions[self.version] = blocks
+            return self.version
+
+    # -- dictionary maintenance -------------------------------------------
+    def _align_dictionaries(self, block: HostBlock) -> HostBlock:
+        """Merge the block's per-column dictionaries into the table-global
+        ones, remapping codes in the new block AND in existing blocks when
+        the global dictionary grows (copy-on-write remap)."""
+        out_cols = dict(block.columns)
+        for name, t in self.schema.columns:
+            if t.kind != Kind.STRING:
+                continue
+            col = block.columns[name]
+            merged, old_remap, new_remap = _merge_dictionaries(
+                self.dictionaries.get(name), col.dictionary
+            )
+            if old_remap is not None and len(self.dictionaries[name]) and not np.array_equal(
+                old_remap, np.arange(len(old_remap), dtype=np.int32)
+            ):
+                # existing codes shift: remap all existing blocks (rare
+                # after bulk load; appends are batched)
+                cur = self._versions[self.version]
+                remapped = []
+                for b in cur:
+                    c = b.columns[name]
+                    nc = HostColumn(c.type, old_remap[c.data], c.valid, merged)
+                    cols = dict(b.columns)
+                    cols[name] = nc
+                    remapped.append(HostBlock(cols, b.nrows))
+                self._versions[self.version] = remapped
+            else:
+                # still update dictionary refs on existing blocks
+                for b in self._versions[self.version]:
+                    b.columns[name] = HostColumn(
+                        b.columns[name].type,
+                        b.columns[name].data,
+                        b.columns[name].valid,
+                        merged,
+                    )
+            data = new_remap[col.data] if new_remap is not None else col.data
+            out_cols[name] = HostColumn(col.type, data.astype(np.int32), col.valid, merged)
+            self.dictionaries[name] = merged
+        return HostBlock(out_cols, block.nrows)
