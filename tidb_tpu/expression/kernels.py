@@ -93,6 +93,101 @@ def compile_expr(e: Expr, dicts: Optional[DictContext] = None) -> _CompiledExpr:
     return fn
 
 
+def expr_dictionary(e: Expr, dicts: DictContext) -> np.ndarray:
+    """The dictionary a string-typed expression's output codes refer to.
+    Deterministic and shared with compilation (string_expr)."""
+    return string_expr(e, dicts)[1]
+
+
+def string_expr(e: Expr, dicts: DictContext):
+    """Compile a string-typed expression to (fn yielding codes, dictionary).
+
+    Computed string values (CASE/COALESCE over string columns and
+    literals) get a merged sorted dictionary; each branch's codes are
+    remapped via a host-built LUT gathered on device."""
+    if isinstance(e, ColumnRef):
+        if e.name not in dicts:
+            raise NotImplementedError(f"string column {e.name} has no dictionary")
+        return _compile(e, dicts), dicts[e.name]
+    if isinstance(e, Literal):
+        if e.value is None:
+            def _null(b):
+                z = jnp.zeros(b.capacity, dtype=jnp.int32)
+                return DevCol(z, jnp.zeros(b.capacity, dtype=bool))
+            return _null, np.array([], dtype=object)
+        d = np.array([str(e.value)], dtype=object)
+
+        def _lit(b):
+            return DevCol(
+                jnp.zeros(b.capacity, dtype=jnp.int32),
+                jnp.ones(b.capacity, dtype=bool),
+            )
+
+        return _lit, d
+    if isinstance(e, Func) and e.op in ("case", "coalesce", "ifnull"):
+        if e.op == "case":
+            args = list(e.args)
+            has_else = len(args) % 2 == 1
+            else_e = args.pop() if has_else else None
+            conds = [args[i] for i in range(0, len(args), 2)]
+            vals = [args[i] for i in range(1, len(args), 2)]
+        else:
+            conds, vals, else_e = None, list(e.args), None
+        branches = vals + ([else_e] if else_e is not None else [])
+        compiled = [string_expr(v, dicts) for v in branches]
+        merged = np.array(
+            sorted({s for _, d in compiled for s in d.tolist()}), dtype=object
+        )
+        luts = [
+            jnp.asarray(
+                np.searchsorted(merged, d).astype(np.int32)
+                if len(d)
+                else np.zeros(1, np.int32)
+            )
+            for _, d in compiled
+        ]
+
+        def remap(fn, lut):
+            def g(b):
+                c = fn(b)
+                codes = jnp.clip(c.data, 0, lut.shape[0] - 1)
+                return DevCol(lut[codes], c.valid)
+            return g
+
+        rfns = [remap(fn, lut) for (fn, _), lut in zip(compiled, luts)]
+        if e.op == "case":
+            cond_fns = [_compile(c, dicts) for c in conds]
+            else_fn = rfns[-1] if else_e is not None else None
+            val_fns = rfns[: len(vals)]
+
+            def _case(b):
+                if else_fn is not None:
+                    ec = else_fn(b)
+                    out_d, out_v = ec.data, ec.valid
+                else:
+                    out_d = jnp.zeros(b.capacity, dtype=jnp.int32)
+                    out_v = jnp.zeros(b.capacity, dtype=bool)
+                for cf, vf in zip(reversed(cond_fns), reversed(val_fns)):
+                    c, v = cf(b), vf(b)
+                    take = c.valid & c.data.astype(bool)
+                    out_d = jnp.where(take, v.data, out_d)
+                    out_v = jnp.where(take, v.valid, out_v)
+                return DevCol(out_d, out_v)
+
+            return _case, merged
+
+        def _coal(b):
+            cols = [f(b) for f in rfns]
+            out_d, out_v = cols[-1].data, cols[-1].valid
+            for c in reversed(cols[:-1]):
+                out_d = jnp.where(c.valid, c.data, out_d)
+                out_v = c.valid | out_v
+            return DevCol(out_d, out_v)
+
+        return _coal, merged
+    raise NotImplementedError(f"string-valued expression {e!r}")
+
+
 def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     if isinstance(e, ColumnRef):
         name = e.name
@@ -126,8 +221,12 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         (a,) = [_compile(x, dicts) for x in e.args]
         return lambda b: DevCol(a(b).valid, jnp.ones_like(a(b).valid))
     if op in ("coalesce", "ifnull"):
+        if e.type is not None and e.type.kind == Kind.STRING:
+            return string_expr(e, dicts)[0]
         return _compile_coalesce(e, dicts)
     if op == "case":
+        if e.type is not None and e.type.kind == Kind.STRING:
+            return string_expr(e, dicts)[0]
         return _compile_case(e, dicts)
     if op == "cast":
         return _compile_cast(e, dicts)
@@ -191,9 +290,26 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
     if op in COMPARE and _is_string_col(eb) and isinstance(ea, Literal):
         return _compile_strcmp(e, dicts, flipped=True)
     if op in COMPARE and _is_string_col(ea) and _is_string_col(eb):
-        # column vs column: only sound when both share one dictionary
-        # (the planner aligns join-key dictionaries at scan time).
-        pass
+        # general string comparison: remap both sides into a merged sorted
+        # dictionary, then compare codes as integers.
+        fa_s, da = string_expr(ea, dicts)
+        fb_s, db = string_expr(eb, dicts)
+        merged = np.array(sorted(set(da.tolist()) | set(db.tolist())), dtype=object)
+        lut_a = jnp.asarray(np.searchsorted(merged, da).astype(np.int64) if len(da) else np.zeros(1, np.int64))
+        lut_b = jnp.asarray(np.searchsorted(merged, db).astype(np.int64) if len(db) else np.zeros(1, np.int64))
+
+        def _strstr(b):
+            a, c = fa_s(b), fb_s(b)
+            x = lut_a[jnp.clip(a.data, 0, lut_a.shape[0] - 1)]
+            y = lut_b[jnp.clip(c.data, 0, lut_b.shape[0] - 1)]
+            valid = a.valid & c.valid
+            d = {
+                "eq": x == y, "ne": x != y, "lt": x < y,
+                "le": x <= y, "gt": x > y, "ge": x >= y,
+            }[op]
+            return DevCol(d, valid)
+
+        return _strstr
 
     fa, fb = _compile(ea, dicts), _compile(eb, dicts)
     ta, tb = ea.type, eb.type
@@ -282,9 +398,7 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
     if flipped:
         op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
     assert isinstance(lit, Literal)
-    if not isinstance(col, ColumnRef) or col.name not in dicts:
-        raise NotImplementedError("string compare requires a dict column")
-    f = _compile(col, dicts)
+    f, dictionary = string_expr(col, dicts)
     if lit.value is None:
         # comparison with NULL is NULL for every row
         def _nullcmp(b):
@@ -293,7 +407,6 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
             return DevCol(z, z)
 
         return _nullcmp
-    dictionary = dicts[col.name]
     pos, exact = _string_literal_code(dictionary, str(lit.value))
 
     def _cmp(b):
@@ -409,8 +522,7 @@ def _compile_cast(e: Func, dicts: DictContext) -> _CompiledExpr:
 
     if src.kind == Kind.STRING and dst.kind in (Kind.FLOAT, Kind.INT, Kind.DECIMAL):
         # host LUT over the dictionary: string -> numeric
-        assert isinstance(a, ColumnRef) and a.name in dicts
-        dictionary = dicts[a.name]
+        f, dictionary = string_expr(a, dicts)
 
         def _tonum(s):
             try:
@@ -419,7 +531,11 @@ def _compile_cast(e: Func, dicts: DictContext) -> _CompiledExpr:
                 m = re.match(r"\s*-?\d+(\.\d+)?", s)
                 return float(m.group(0)) if m else 0.0
 
-        lut = np.array([_tonum(s) for s in dictionary], dtype=np.float64)
+        lut = (
+            np.array([_tonum(s) for s in dictionary], dtype=np.float64)
+            if len(dictionary)
+            else np.zeros(1, dtype=np.float64)
+        )
         if dst.kind == Kind.INT:
             lut_j = jnp.asarray(np.round(lut).astype(np.int64))
         elif dst.kind == Kind.DECIMAL:
@@ -429,7 +545,7 @@ def _compile_cast(e: Func, dicts: DictContext) -> _CompiledExpr:
 
         def _cast_s(b):
             c = f(b)
-            return DevCol(lut_j[c.data], c.valid)
+            return DevCol(lut_j[jnp.clip(c.data, 0, lut_j.shape[0] - 1)], c.valid)
 
         return _cast_s
 
@@ -478,15 +594,12 @@ def _compile_like(e: Func, dicts: DictContext) -> _CompiledExpr:
 
 def _compile_strlut(e: Func, dicts: DictContext, pyfn, out_dtype) -> _CompiledExpr:
     (col,) = e.args
-    if not isinstance(col, ColumnRef) or col.name not in dicts:
-        raise NotImplementedError("string LUT op requires a base dict column")
-    dictionary = dicts[col.name]
+    f, dictionary = string_expr(col, dicts)
     lut = jnp.asarray(
         np.array([pyfn(str(s)) for s in dictionary]).astype(np.dtype(out_dtype))
         if len(dictionary)
         else np.zeros(1, dtype=np.dtype(out_dtype))
     )
-    f = _compile(col, dicts)
 
     def _lutf(b):
         c = f(b)

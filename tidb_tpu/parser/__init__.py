@@ -1,0 +1,2 @@
+from tidb_tpu.parser.sqlparse import parse, parse_expr, ParseError  # noqa: F401
+from tidb_tpu.parser import ast  # noqa: F401

@@ -1,0 +1,196 @@
+"""SQL AST nodes.
+
+Reference: pkg/parser/ast (~21.7k LoC of node types for full MySQL). This
+framework's grammar targets the analytical + DML/DDL subset the engine
+executes; nodes are plain dataclasses consumed by the planner
+(tidb_tpu/planner). Expression nodes reuse tidb_tpu.expression.expr types
+where possible; parser-only sugar (BETWEEN, aggregate calls, subqueries,
+stars) gets its own nodes and is desugared during planning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from tidb_tpu.dtypes import SQLType
+
+
+# ---- expressions (parser-level; planner lowers to expression.expr) -------
+
+
+@dataclasses.dataclass
+class Name:
+    """Possibly-qualified column reference: [table.]column."""
+
+    table: Optional[str]
+    column: str
+
+
+@dataclasses.dataclass
+class Const:
+    value: object
+    type_hint: Optional[SQLType] = None  # DATE '...' etc.
+
+
+@dataclasses.dataclass
+class Call:
+    """Scalar function or operator application."""
+
+    op: str
+    args: List[object]
+    # CAST target
+    cast_type: Optional[SQLType] = None
+
+
+@dataclasses.dataclass
+class AggCall:
+    func: str  # sum/count/avg/min/max/group_concat
+    arg: Optional[object]  # None for COUNT(*)
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class Star:
+    table: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryExpr:
+    query: "Select"
+    # modifier: None (scalar), "exists", "in", "not in", "not exists"
+    modifier: Optional[str] = None
+    lhs: Optional[object] = None  # for IN
+
+
+@dataclasses.dataclass
+class Interval:
+    value: object
+    unit: str  # day/month/year
+
+
+# ---- table references ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class TableRef:
+    db: Optional[str]
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRef:
+    query: "Select"
+    alias: str
+
+
+@dataclasses.dataclass
+class Join:
+    kind: str  # inner/left/cross
+    left: object
+    right: object
+    on: Optional[object] = None
+
+
+# ---- statements ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SelectItem:
+    expr: object
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OrderItem:
+    expr: object
+    desc: bool = False
+
+
+@dataclasses.dataclass
+class Select:
+    items: List[SelectItem]
+    from_: Optional[object]  # TableRef | SubqueryRef | Join | None
+    where: Optional[object] = None
+    group_by: List[object] = dataclasses.field(default_factory=list)
+    having: Optional[object] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class ColumnDef:
+    name: str
+    type: SQLType
+    not_null: bool = False
+    primary_key: bool = False
+
+
+@dataclasses.dataclass
+class CreateTable:
+    db: Optional[str]
+    name: str
+    columns: List[ColumnDef]
+    primary_key: List[str]
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropTable:
+    db: Optional[str]
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateDatabase:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropDatabase:
+    name: str
+
+
+@dataclasses.dataclass
+class UseDatabase:
+    name: str
+
+
+@dataclasses.dataclass
+class Insert:
+    db: Optional[str]
+    table: str
+    columns: Optional[List[str]]
+    rows: List[List[object]]  # rows of Const/expressions
+
+
+@dataclasses.dataclass
+class Delete:
+    db: Optional[str]
+    table: str
+    where: Optional[object] = None
+
+
+@dataclasses.dataclass
+class Update:
+    db: Optional[str]
+    table: str
+    sets: List[Tuple[str, object]]
+    where: Optional[object] = None
+
+
+@dataclasses.dataclass
+class Explain:
+    stmt: object
+    analyze: bool = False
+
+
+@dataclasses.dataclass
+class Show:
+    what: str  # "tables" | "databases"
+    db: Optional[str] = None

@@ -1,0 +1,680 @@
+"""Hand-written SQL lexer + recursive-descent/Pratt parser.
+
+Reference: pkg/parser — a 16,207-line goyacc grammar (parser.y) + lexer
+(lexer.go). This framework needs the analytical/DML/DDL subset the engine
+executes, so a compact Pratt parser replaces the generated LALR tables
+(SURVEY.md §2.9 explicitly allows a hand-written parser for the subset).
+MySQL-isms covered: backquoted identifiers, # / -- / C-style comments,
+case-insensitive keywords, `LIMIT m, n`, DATE/INTERVAL literals,
+IS [NOT] NULL, [NOT] BETWEEN/IN/LIKE/EXISTS, COUNT(DISTINCT ...).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from tidb_tpu.dtypes import BOOL, DATE, DECIMAL, FLOAT64, INT64, STRING, SQLType
+from tidb_tpu.parser import ast
+
+
+class ParseError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|\#[^\n]*|--[^\n]*|/\*.*?\*/)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
+  | (?P<bq>`[^`]*`)
+  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>])
+  | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "is", "null", "like",
+    "between", "exists", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "outer", "cross", "on", "using",
+    "distinct", "all", "asc", "desc", "true", "false", "interval",
+    "create", "table", "database", "drop", "insert", "into", "values",
+    "delete", "update", "set", "use", "explain", "analyze", "show",
+    "tables", "databases", "if", "primary", "key", "div", "mod",
+    "union", "date", "extract", "count", "sum", "avg", "min", "max",
+}
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind  # num, str, id, kw, op
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self.kind}:{self.text}"
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise ParseError(f"bad character {sql[i]!r} at {i}")
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        kind = m.lastgroup
+        if kind == "bq":
+            out.append(Token("id", text[1:-1], m.start()))
+        elif kind == "id":
+            low = text.lower()
+            out.append(Token("kw" if low in KEYWORDS else "id", low if low in KEYWORDS else text, m.start()))
+        elif kind == "str":
+            q = text[0]
+            body = text[1:-1].replace(q + q, q)
+            body = re.sub(r"\\(.)", lambda mm: {"n": "\n", "t": "\t", "0": "\0"}.get(mm.group(1), mm.group(1)), body)
+            out.append(Token("str", body, m.start()))
+        else:
+            out.append(Token(kind, text, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+_TYPE_MAP = {
+    "int": INT64, "integer": INT64, "bigint": INT64, "smallint": INT64,
+    "tinyint": INT64, "double": FLOAT64, "float": FLOAT64, "real": FLOAT64,
+    "varchar": STRING, "char": STRING, "text": STRING, "string": STRING,
+    "date": DATE, "datetime": DATE, "boolean": BOOL, "bool": BOOL,
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def advance(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        return self.cur.kind == "kw" and self.cur.text in kws
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "op" and self.cur.text in ops
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.advance()
+            return True
+        return False
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.advance()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()}, got {self.cur.text!r} at {self.cur.pos}")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r}, got {self.cur.text!r} at {self.cur.pos}")
+
+    def expect_ident(self) -> str:
+        t = self.cur
+        if t.kind == "id" or (t.kind == "kw" and t.text in ("date", "key", "tables", "databases", "count", "sum", "avg", "min", "max")):
+            self.advance()
+            return t.text
+        raise ParseError(f"expected identifier, got {t.text!r} at {t.pos}")
+
+    # -- entry -------------------------------------------------------------
+    def parse_stmt(self):
+        if self.at_kw("select"):
+            return self.parse_select()
+        if self.at_kw("explain"):
+            self.advance()
+            analyze = self.accept_kw("analyze")
+            return ast.Explain(self.parse_stmt(), analyze=analyze)
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("drop"):
+            return self.parse_drop()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("delete"):
+            return self.parse_delete()
+        if self.at_kw("update"):
+            return self.parse_update()
+        if self.at_kw("use"):
+            self.advance()
+            return ast.UseDatabase(self.expect_ident())
+        if self.at_kw("show"):
+            self.advance()
+            if self.accept_kw("tables"):
+                return ast.Show("tables")
+            if self.accept_kw("databases"):
+                return ast.Show("databases")
+            raise ParseError("SHOW supports TABLES | DATABASES")
+        raise ParseError(f"unsupported statement start {self.cur.text!r}")
+
+    # -- SELECT ------------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_table_refs()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        group_by: List[object] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept_kw("having") else None
+        order_by: List[ast.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = offset = None
+        if self.accept_kw("limit"):
+            a = self.parse_int()
+            if self.accept_op(","):
+                offset, limit = a, self.parse_int()
+            elif self.accept_kw("offset"):
+                limit, offset = a, self.parse_int()
+            else:
+                limit = a
+        return ast.Select(
+            items=items, from_=from_, where=where, group_by=group_by,
+            having=having, order_by=order_by, limit=limit, offset=offset,
+            distinct=distinct,
+        )
+
+    def parse_int(self) -> int:
+        t = self.cur
+        if t.kind != "num":
+            raise ParseError(f"expected integer at {t.pos}")
+        self.advance()
+        return int(t.text)
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star())
+        # table.* ?
+        if self.cur.kind == "id" and self.toks[self.i + 1].kind == "op" and self.toks[self.i + 1].text == "." and self.toks[self.i + 2].text == "*":
+            t = self.advance().text
+            self.advance()
+            self.advance()
+            return ast.SelectItem(ast.Star(table=t))
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "id":
+            alias = self.advance().text
+        return ast.SelectItem(e, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        return ast.OrderItem(e, desc)
+
+    # -- FROM --------------------------------------------------------------
+    def parse_table_refs(self):
+        left = self.parse_table_factor()
+        while True:
+            if self.accept_op(","):
+                right = self.parse_table_factor()
+                left = ast.Join("cross", left, right, None)
+                continue
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+                self.expect_kw("join")
+            elif self.accept_kw("cross"):
+                kind = "cross"
+                self.expect_kw("join")
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "right"
+            elif self.accept_kw("join"):
+                kind = "inner"
+            else:
+                return left
+            right = self.parse_table_factor()
+            on = None
+            if self.accept_kw("on"):
+                on = self.parse_expr()
+            if kind == "right":
+                # normalize: a RIGHT JOIN b == b LEFT JOIN a
+                left = ast.Join("left", right, left, on)
+            else:
+                left = ast.Join(kind, left, right, on)
+
+    def parse_table_factor(self):
+        if self.accept_op("("):
+            if self.at_kw("select"):
+                q = self.parse_select()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.expect_ident()
+                return ast.SubqueryRef(q, alias)
+            refs = self.parse_table_refs()
+            self.expect_op(")")
+            return refs
+        name = self.expect_ident()
+        db = None
+        if self.accept_op("."):
+            db, name = name, self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "id":
+            alias = self.advance().text
+        return ast.TableRef(db, name, alias)
+
+    # -- expressions (Pratt) ----------------------------------------------
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        e = self.parse_and()
+        while self.accept_kw("or") or self.accept_op("||"):
+            e = ast.Call("or", [e, self.parse_and()])
+        return e
+
+    def parse_and(self):
+        e = self.parse_not()
+        while self.accept_kw("and") or self.accept_op("&&"):
+            e = ast.Call("and", [e, self.parse_not()])
+        return e
+
+    def parse_not(self):
+        if self.accept_kw("not"):
+            return ast.Call("not", [self.parse_not()])
+        return self.parse_predicate()
+
+    def parse_predicate(self):
+        e = self.parse_additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.advance().text
+                opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
+                rhs = self.parse_additive()
+                e = ast.Call(opname, [e, rhs])
+                continue
+            if self.at_kw("is"):
+                self.advance()
+                neg = self.accept_kw("not")
+                self.expect_kw("null")
+                e = ast.Call("isnotnull" if neg else "isnull", [e])
+                continue
+            neg = False
+            save = self.i
+            if self.accept_kw("not"):
+                neg = True
+            if self.accept_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                r = ast.Call("and", [ast.Call("ge", [e, lo]), ast.Call("le", [e, hi])])
+                e = ast.Call("not", [r]) if neg else r
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    e = ast.SubqueryExpr(q, "not in" if neg else "in", lhs=e)
+                else:
+                    vals = [self.parse_expr()]
+                    while self.accept_op(","):
+                        vals.append(self.parse_expr())
+                    self.expect_op(")")
+                    r = ast.Call("in", [e] + vals)
+                    e = ast.Call("not", [r]) if neg else r
+                continue
+            if self.accept_kw("like"):
+                pat = self.parse_additive()
+                r = ast.Call("like", [e, pat])
+                e = ast.Call("not", [r]) if neg else r
+                continue
+            if neg:
+                self.i = save
+            return e
+
+    def parse_additive(self):
+        e = self.parse_multiplicative()
+        while True:
+            if self.accept_op("+"):
+                rhs = self.parse_multiplicative()
+                e = self._maybe_interval("add", e, rhs)
+            elif self.accept_op("-"):
+                rhs = self.parse_multiplicative()
+                e = self._maybe_interval("sub", e, rhs)
+            else:
+                return e
+
+    def _maybe_interval(self, op, lhs, rhs):
+        if isinstance(rhs, ast.Interval):
+            return ast.Call("date_" + op, [lhs, rhs])
+        return ast.Call(op, [lhs, rhs])
+
+    def parse_multiplicative(self):
+        e = self.parse_unary()
+        while True:
+            if self.accept_op("*"):
+                e = ast.Call("mul", [e, self.parse_unary()])
+            elif self.accept_op("/"):
+                e = ast.Call("div", [e, self.parse_unary()])
+            elif self.accept_kw("div"):
+                e = ast.Call("intdiv", [e, self.parse_unary()])
+            elif self.accept_op("%") or self.accept_kw("mod"):
+                e = ast.Call("mod", [e, self.parse_unary()])
+            else:
+                return e
+
+    def parse_unary(self):
+        if self.accept_op("-"):
+            return ast.Call("neg", [self.parse_unary()])
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self):
+        t = self.cur
+        if t.kind == "num":
+            self.advance()
+            if re.fullmatch(r"\d+", t.text):
+                return ast.Const(int(t.text))
+            return ast.Const(float(t.text))
+        if t.kind == "str":
+            self.advance()
+            return ast.Const(t.text)
+        if self.at_kw("null"):
+            self.advance()
+            return ast.Const(None)
+        if self.at_kw("true"):
+            self.advance()
+            return ast.Const(True)
+        if self.at_kw("false"):
+            self.advance()
+            return ast.Const(False)
+        if self.at_kw("date"):
+            # DATE 'yyyy-mm-dd' literal
+            if self.toks[self.i + 1].kind == "str":
+                self.advance()
+                return ast.Const(self.advance().text, type_hint=DATE)
+            # else fall through: DATE(...) function or identifier
+        if self.at_kw("interval"):
+            self.advance()
+            v = self.parse_unary()
+            unit = self.expect_ident()
+            if isinstance(v, ast.Const) and isinstance(v.value, str):
+                v = ast.Const(int(v.value))
+            return ast.Interval(v, unit.lower())
+        if self.at_kw("case"):
+            return self.parse_case()
+        if self.at_kw("cast"):
+            self.advance()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            typ = self.parse_type()
+            self.expect_op(")")
+            return ast.Call("cast", [e], cast_type=typ)
+        if self.at_kw("exists"):
+            self.advance()
+            self.expect_op("(")
+            q = self.parse_select()
+            self.expect_op(")")
+            return ast.SubqueryExpr(q, "exists")
+        if self.at_kw("extract"):
+            self.advance()
+            self.expect_op("(")
+            unit = self.expect_ident().lower()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.Call(unit, [e])
+        if self.at_kw("count", "sum", "avg", "min", "max"):
+            func = self.advance().text
+            self.expect_op("(")
+            distinct = self.accept_kw("distinct")
+            if func == "count" and self.accept_op("*"):
+                self.expect_op(")")
+                return ast.AggCall("count", None, False)
+            arg = self.parse_expr()
+            self.expect_op(")")
+            return ast.AggCall(func, arg, distinct)
+        if self.accept_op("("):
+            if self.at_kw("select"):
+                q = self.parse_select()
+                self.expect_op(")")
+                return ast.SubqueryExpr(q, None)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "id" or t.kind == "kw":
+            name = self.expect_ident()
+            if self.accept_op("("):
+                args = []
+                if not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.Call(name.lower(), args)
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return ast.Name(name, col)
+            return ast.Name(None, name)
+        raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def parse_case(self):
+        self.expect_kw("case")
+        args: List[object] = []
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            if operand is not None:
+                cond = ast.Call("eq", [operand, cond])
+            self.expect_kw("then")
+            val = self.parse_expr()
+            args.extend([cond, val])
+        if self.accept_kw("else"):
+            args.append(self.parse_expr())
+        self.expect_kw("end")
+        return ast.Call("case", args)
+
+    def parse_type(self) -> SQLType:
+        name = self.expect_ident().lower()
+        if name == "decimal" or name == "numeric":
+            scale = 0
+            if self.accept_op("("):
+                self.parse_int()
+                if self.accept_op(","):
+                    scale = self.parse_int()
+                self.expect_op(")")
+            return DECIMAL(scale)
+        if name in ("signed", "unsigned"):
+            return INT64
+        t = _TYPE_MAP.get(name)
+        if t is None:
+            raise ParseError(f"unknown type {name!r}")
+        if self.accept_op("("):
+            self.parse_int()
+            self.expect_op(")")
+        return t
+
+    # -- DDL / DML ---------------------------------------------------------
+    def parse_create(self):
+        self.expect_kw("create")
+        if self.accept_kw("database"):
+            ine = self._if_not_exists()
+            return ast.CreateDatabase(self.expect_ident(), ine)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        db, name = self._qualified_name()
+        self.expect_op("(")
+        cols: List[ast.ColumnDef] = []
+        pk: List[str] = []
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                pk.append(self.expect_ident())
+                while self.accept_op(","):
+                    pk.append(self.expect_ident())
+                self.expect_op(")")
+            else:
+                cname = self.expect_ident()
+                ctype = self.parse_type()
+                cd = ast.ColumnDef(cname, ctype)
+                while True:
+                    if self.accept_kw("not"):
+                        self.expect_kw("null")
+                        cd.not_null = True
+                    elif self.accept_kw("null"):
+                        pass
+                    elif self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        cd.primary_key = True
+                        pk.append(cname)
+                    elif self.at_kw("key"):
+                        self.advance()
+                    else:
+                        break
+                cols.append(cd)
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.CreateTable(db, name, cols, pk, ine)
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def _qualified_name(self) -> Tuple[Optional[str], str]:
+        a = self.expect_ident()
+        if self.accept_op("."):
+            return a, self.expect_ident()
+        return None, a
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        if self.accept_kw("database"):
+            return ast.DropDatabase(self.expect_ident())
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        db, name = self._qualified_name()
+        return ast.DropTable(db, name, if_exists)
+
+    def parse_insert(self):
+        self.expect_kw("insert")
+        self.accept_kw("into")
+        db, name = self._qualified_name()
+        columns = None
+        if self.accept_op("("):
+            columns = [self.expect_ident()]
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.accept_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ast.Insert(db, name, columns, rows)
+
+    def parse_delete(self):
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        db, name = self._qualified_name()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return ast.Delete(db, name, where)
+
+    def parse_update(self):
+        self.expect_kw("update")
+        db, name = self._qualified_name()
+        self.expect_kw("set")
+        sets = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            sets.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return ast.Update(db, name, sets, where)
+
+
+def parse(sql: str):
+    """Parse one or more ;-separated statements; returns a list."""
+    p = Parser(sql)
+    stmts = []
+    while p.cur.kind != "eof":
+        if p.accept_op(";"):
+            continue
+        stmts.append(p.parse_stmt())
+        if p.cur.kind not in ("eof",) and not p.at_op(";"):
+            raise ParseError(f"trailing input at {p.cur.pos}: {p.cur.text!r}")
+    return stmts
+
+
+def parse_expr(sql: str):
+    p = Parser(sql)
+    e = p.parse_expr()
+    if p.cur.kind != "eof":
+        raise ParseError(f"trailing input at {p.cur.pos}")
+    return e

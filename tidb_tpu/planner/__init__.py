@@ -1,0 +1,2 @@
+from tidb_tpu.planner.logical import build_select, PlanError  # noqa: F401
+from tidb_tpu.planner import logical as nodes  # noqa: F401

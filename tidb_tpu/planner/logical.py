@@ -1,0 +1,672 @@
+"""AST -> logical plan: name resolution, aggregate extraction, pushdown.
+
+Reference: pkg/planner/core/logical_plan_builder.go (AST -> logical ops),
+expression_rewriter.go (subqueries), and the fixed-order logical rule list
+(optimizer.go:98-123). This builder applies the high-value rules inline:
+
+- column pruning (columnPruner): scans read only referenced columns
+- predicate pushdown (ppdSolver): WHERE conjuncts sink below joins to the
+  side whose columns they reference; equi-conjuncts in ON become join keys
+- projection elimination: additive projections keep base columns so ORDER
+  BY can reference non-selected columns (MySQL scoping)
+
+Internal column names are ``qualifier.column`` — unique across the plan,
+used directly as device Batch column names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tidb_tpu.dtypes import BOOL, DATE, INT64, Kind, SQLType
+from tidb_tpu.expression.expr import ColumnRef, Expr, Func, Literal
+from tidb_tpu.parser import ast
+
+
+class PlanError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class OutCol:
+    """One column of a plan node's schema."""
+
+    qualifier: Optional[str]  # table alias; None for computed columns
+    name: str  # bare column name or output alias
+    internal: str  # unique name used in device batches
+    type: SQLType
+
+
+class Schema:
+    def __init__(self, cols: List[OutCol]):
+        self.cols = cols
+
+    def resolve(self, table: Optional[str], name: str) -> OutCol:
+        name_l = name.lower()
+        matches = [
+            c
+            for c in self.cols
+            if c.name.lower() == name_l
+            and (table is None or (c.qualifier or "").lower() == table.lower())
+        ]
+        if not matches:
+            raise PlanError(f"unknown column {table + '.' if table else ''}{name}")
+        if len(matches) > 1:
+            # identical internal name means the same column seen twice
+            if len({m.internal for m in matches}) > 1:
+                raise PlanError(f"ambiguous column {name}")
+        return matches[0]
+
+    def types(self) -> Dict[str, SQLType]:
+        return {c.internal: c.type for c in self.cols}
+
+    def __iter__(self):
+        return iter(self.cols)
+
+
+class LayeredSchema(Schema):
+    """MySQL ORDER BY scoping: select aliases shadow base columns of the
+    same name; base columns remain reachable when no alias matches."""
+
+    def __init__(self, *layers: Schema):
+        super().__init__([c for l in layers for c in l.cols])
+        self.layers = layers
+
+    def resolve(self, table: Optional[str], name: str) -> OutCol:
+        last_err = None
+        for layer in self.layers:
+            try:
+                return layer.resolve(table, name)
+            except PlanError as e:
+                last_err = e
+        raise last_err
+
+
+# ---------------------------------------------------------------------------
+# Logical operators
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LogicalPlan:
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Scan(LogicalPlan):
+    db: str
+    table: str  # catalog table name
+    alias: str  # qualifier
+    columns: List[str]  # pruned, bare storage names (internal = alias.name)
+
+
+@dataclasses.dataclass
+class Selection(LogicalPlan):
+    child: LogicalPlan
+    predicate: Expr  # bound
+
+
+@dataclasses.dataclass
+class Projection(LogicalPlan):
+    child: LogicalPlan
+    exprs: List[Tuple[str, Expr]]  # (internal out name, bound expr)
+    additive: bool = False  # keep child columns too
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalPlan):
+    child: LogicalPlan
+    group_exprs: List[Tuple[str, Expr]]  # (internal key name, bound expr)
+    aggs: List[Tuple[str, str, Optional[Expr], bool]]  # (name, func, arg, distinct)
+
+
+@dataclasses.dataclass
+class JoinPlan(LogicalPlan):
+    kind: str  # inner/left/semi/anti/cross
+    left: LogicalPlan
+    right: LogicalPlan
+    # bound equi keys (left expr, right expr); may be empty for cross
+    equi_keys: List[Tuple[Expr, Expr]]
+    residual: Optional[Expr] = None
+    null_aware: bool = False  # NOT IN semantics
+
+
+@dataclasses.dataclass
+class Sort(LogicalPlan):
+    child: LogicalPlan
+    keys: List[Tuple[Expr, bool]]  # (bound expr, desc)
+
+
+@dataclasses.dataclass
+class Limit(LogicalPlan):
+    child: LogicalPlan
+    count: int
+    offset: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Expression binding (parser AST -> bound expression.Expr)
+# ---------------------------------------------------------------------------
+
+
+_GENSYM = [0]
+
+
+def gensym(prefix: str) -> str:
+    _GENSYM[0] += 1
+    return f"_{prefix}{_GENSYM[0]}"
+
+
+class ExprBinder:
+    """Lowers parser expression AST to bound expression trees against a
+    schema. Aggregate calls and subqueries must have been rewritten out
+    before binding (SelectBuilder does that)."""
+
+    def __init__(self, schema: Schema, subquery_executor=None):
+        self.schema = schema
+        self.subquery_executor = subquery_executor
+
+    def bind(self, e) -> Expr:
+        from tidb_tpu.expression.expr import bind_expr
+
+        lowered = self.lower(e)
+        return bind_expr(lowered, self.schema.types())
+
+    def lower(self, e) -> Expr:
+        if isinstance(e, ast.Name):
+            c = self.schema.resolve(e.table, e.column)
+            return ColumnRef(name=c.internal)
+        if isinstance(e, ast.Const):
+            t = e.type_hint
+            return Literal(type=t, value=e.value)
+        if isinstance(e, ast.Interval):
+            raise PlanError("INTERVAL outside date arithmetic")
+        if isinstance(e, ast.SubqueryExpr):
+            if self.subquery_executor is None:
+                raise PlanError("subquery not supported in this context")
+            return self.subquery_executor(e)
+        if isinstance(e, ast.AggCall):
+            raise PlanError(
+                f"aggregate {e.func}() not allowed here (no GROUP BY context)"
+            )
+        if isinstance(e, ast.Call):
+            return self.lower_call(e)
+        raise PlanError(f"cannot bind {e!r}")
+
+    def lower_call(self, e: ast.Call) -> Expr:
+        op = e.op
+        if op in ("date_add", "date_sub"):
+            base, iv = e.args
+            assert isinstance(iv, ast.Interval)
+            days = self._interval_days(iv)
+            return Func(
+                op="add" if op == "date_add" else "sub",
+                args=(self.lower(base), Literal(type=INT64, value=days)),
+            )
+        if op == "cast":
+            return Func(op="cast", args=(self.lower(e.args[0]),), type=e.cast_type)
+        if op in ("substring", "substr"):
+            raise PlanError("SUBSTRING not yet supported on device")
+        args = tuple(self.lower(a) for a in e.args)
+        return Func(op=op, args=args)
+
+    @staticmethod
+    def _interval_days(iv: ast.Interval) -> int:
+        v = iv.value
+        if isinstance(v, ast.Const):
+            v = v.value
+        v = int(v)
+        if iv.unit == "day":
+            return v
+        if iv.unit == "month":
+            return v * 30  # calendar-exact month arithmetic: later round
+        if iv.unit == "year":
+            return v * 365
+        raise PlanError(f"unsupported interval unit {iv.unit}")
+
+
+# ---------------------------------------------------------------------------
+# SELECT builder
+# ---------------------------------------------------------------------------
+
+
+def _conjuncts(e):
+    if isinstance(e, ast.Call) and e.op == "and":
+        return _conjuncts(e.args[0]) + _conjuncts(e.args[1])
+    return [e]
+
+
+def _ast_columns(e, out: set):
+    """Collect (table, column) names referenced by a parser expression."""
+    if isinstance(e, ast.Name):
+        out.add((e.table.lower() if e.table else None, e.column.lower()))
+    elif isinstance(e, ast.Call):
+        for a in e.args:
+            _ast_columns(a, out)
+    elif isinstance(e, ast.AggCall):
+        if e.arg is not None:
+            _ast_columns(e.arg, out)
+    elif isinstance(e, ast.SubqueryExpr):
+        if e.lhs is not None:
+            _ast_columns(e.lhs, out)
+        # correlated references inside subquery are handled separately
+    elif isinstance(e, ast.Interval):
+        pass
+    return out
+
+
+class SelectBuilder:
+    """Builds a logical plan for one SELECT. ``resolver`` maps
+    (db, table) -> (schema columns, types); ``subquery_planner`` plans a
+    nested SELECT and returns its plan (used by IN/EXISTS/scalar)."""
+
+    def __init__(self, catalog, current_db: str, subquery_value_fn=None):
+        self.catalog = catalog
+        self.db = current_db
+        # subquery_value_fn(select_ast) -> Literal  (executes scalar subq)
+        self.subquery_value_fn = subquery_value_fn
+        self.semi_joins: List[Tuple[ast.SubqueryExpr, str]] = []
+
+    # -- FROM --------------------------------------------------------------
+    def build_from(self, node) -> LogicalPlan:
+        if node is None:
+            raise PlanError("SELECT without FROM not planned here")
+        if isinstance(node, ast.TableRef):
+            db = node.db or self.db
+            t = self.catalog.table(db, node.name)
+            alias = (node.alias or node.name).lower()
+            cols = [
+                OutCol(alias, n, f"{alias}.{n}", typ)
+                for n, typ in t.schema.columns
+            ]
+            return Scan(Schema(cols), db, node.name.lower(), alias, [n for n, _ in t.schema.columns])
+        if isinstance(node, ast.SubqueryRef):
+            inner = build_select(node.query, self.catalog, self.db, self.subquery_value_fn)
+            alias = node.alias.lower()
+            cols = [
+                OutCol(alias, c.name, f"{alias}.{c.name}", c.type)
+                for c in inner.schema
+            ]
+            ren = Projection(
+                Schema(cols),
+                inner,
+                [(f"{alias}.{c.name}", ColumnRef(type=c.type, name=c.internal)) for c in inner.schema],
+            )
+            return ren
+        if isinstance(node, ast.Join):
+            left = self.build_from(node.left)
+            right = self.build_from(node.right)
+            schema = Schema(list(left.schema.cols) + list(right.schema.cols))
+            if node.kind == "cross" or node.on is None:
+                if node.kind == "left":
+                    raise PlanError("LEFT JOIN requires ON")
+                return JoinPlan(schema, "cross", left, right, [], None)
+            return self._build_join(node.kind, left, right, node.on, schema)
+        raise PlanError(f"unsupported FROM clause {node!r}")
+
+    def _build_join(self, kind, left, right, on, schema) -> JoinPlan:
+        lq = {(c.qualifier or "").lower() for c in left.schema}
+        rq = {(c.qualifier or "").lower() for c in right.schema}
+
+        def side_of(e) -> Optional[str]:
+            cols = _ast_columns(e, set())
+            quals = set()
+            for tbl, col in cols:
+                if tbl is not None:
+                    quals.add("l" if tbl in lq else ("r" if tbl in rq else "?"))
+                else:
+                    inl = inr = False
+                    try:
+                        left.schema.resolve(None, col)
+                        inl = True
+                    except PlanError:
+                        pass
+                    try:
+                        right.schema.resolve(None, col)
+                        inr = True
+                    except PlanError:
+                        pass
+                    if inl and inr:
+                        quals.add("?")
+                    elif inl:
+                        quals.add("l")
+                    elif inr:
+                        quals.add("r")
+                    else:
+                        quals.add("?")
+            if quals <= {"l"}:
+                return "l"
+            if quals <= {"r"}:
+                return "r"
+            return None
+
+        equi: List[Tuple[Expr, Expr]] = []
+        residual: List = []
+        pushd_l: List = []
+        pushd_r: List = []
+        lb = ExprBinder(left.schema)
+        rb = ExprBinder(right.schema)
+        for c in _conjuncts(on):
+            if isinstance(c, ast.Call) and c.op == "eq":
+                s0, s1 = side_of(c.args[0]), side_of(c.args[1])
+                if s0 == "l" and s1 == "r":
+                    equi.append((lb.bind(c.args[0]), rb.bind(c.args[1])))
+                    continue
+                if s0 == "r" and s1 == "l":
+                    equi.append((lb.bind(c.args[1]), rb.bind(c.args[0])))
+                    continue
+            s = side_of(c)
+            if kind == "inner" and s == "l":
+                pushd_l.append(c)
+                continue
+            if s == "r" and kind in ("inner", "left"):
+                # left join: right-only ON conjunct filters the build side
+                pushd_r.append(c)
+                continue
+            residual.append(c)
+
+        if pushd_l:
+            pred = _and_all(pushd_l)
+            left = Selection(left.schema, left, ExprBinder(left.schema).bind(pred))
+        if pushd_r:
+            pred = _and_all(pushd_r)
+            right = Selection(right.schema, right, ExprBinder(right.schema).bind(pred))
+        schema = Schema(list(left.schema.cols) + list(right.schema.cols))
+        if not equi:
+            if kind == "inner":
+                res = ExprBinder(schema).bind(on) if residual else None
+                return JoinPlan(schema, "cross", left, right, [], res)
+            raise PlanError("non-equi LEFT JOIN not supported")
+        res_bound = ExprBinder(schema).bind(_and_all(residual)) if residual else None
+        if kind == "left" and res_bound is not None:
+            raise PlanError("LEFT JOIN with residual ON conditions not yet supported")
+        return JoinPlan(schema, kind, left, right, equi, res_bound)
+
+
+def _and_all(conj: List):
+    e = conj[0]
+    for c in conj[1:]:
+        e = ast.Call("and", [e, c])
+    return e
+
+
+def build_select(
+    sel: ast.Select, catalog, current_db: str, subquery_value_fn=None
+) -> LogicalPlan:
+    """Full SELECT lowering: FROM -> WHERE (with pushdown + IN/EXISTS to
+    semi/anti joins) -> AGG -> HAVING -> additive projection -> SORT ->
+    LIMIT -> final projection."""
+    b = SelectBuilder(catalog, current_db, subquery_value_fn)
+
+    if sel.from_ is None:
+        return _build_tableless(sel, subquery_value_fn)
+
+    plan = b.build_from(sel.from_)
+
+    # ---- WHERE ----
+    if sel.where is not None:
+        plan = _apply_where(b, plan, sel.where, subquery_value_fn, catalog, current_db)
+
+    # ---- aggregate detection ----
+    agg_calls: List[ast.AggCall] = []
+
+    def find_aggs(e):
+        if isinstance(e, ast.AggCall):
+            agg_calls.append(e)
+        elif isinstance(e, ast.Call):
+            for a in e.args:
+                find_aggs(a)
+
+    # expand stars first
+    items: List[ast.SelectItem] = []
+    for it in sel.items:
+        if isinstance(it.expr, ast.Star):
+            for c in plan.schema:
+                if it.expr.table is None or (c.qualifier or "").lower() == it.expr.table.lower():
+                    items.append(
+                        ast.SelectItem(ast.Name(c.qualifier, c.name), None)
+                    )
+            continue
+        items.append(it)
+
+    for it in items:
+        find_aggs(it.expr)
+    if sel.having is not None:
+        find_aggs(sel.having)
+    for oi in sel.order_by:
+        find_aggs(oi.expr)
+
+    grouped = bool(sel.group_by) or bool(agg_calls)
+
+    # resolve GROUP BY ordinals / aliases
+    group_by = []
+    for g in sel.group_by:
+        if isinstance(g, ast.Const) and isinstance(g.value, int):
+            idx = g.value - 1
+            if not 0 <= idx < len(items):
+                raise PlanError(f"GROUP BY position {g.value} out of range")
+            group_by.append(items[idx].expr)
+        elif isinstance(g, ast.Name) and g.table is None:
+            alias_match = next(
+                (it.expr for it in items if (it.alias or "").lower() == g.column.lower()),
+                None,
+            )
+            group_by.append(alias_match if alias_match is not None else g)
+        else:
+            group_by.append(g)
+
+    if grouped:
+        plan, rewrite = _build_aggregate(b, plan, group_by, agg_calls)
+    else:
+        rewrite = None
+
+    binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+
+    def lower_item(e):
+        e2 = _rewrite_aggs(e, rewrite) if rewrite else e
+        return binder.bind(e2)
+
+    # ---- additive projection: select outputs + hidden order keys ----
+    out_names: List[str] = []
+    proj_exprs: List[Tuple[str, Expr]] = []
+    display: List[str] = []
+    used = set()
+    for i, it in enumerate(items):
+        disp = it.alias or _display_name(it.expr)
+        name = disp.lower()
+        if name in used:
+            name = f"{name}#{i}"
+        used.add(name)
+        bound = lower_item(it.expr)
+        proj_exprs.append((name, bound))
+        out_names.append(name)
+        display.append(disp)
+
+    # schema after additive projection: child cols + outputs
+    add_cols = list(plan.schema.cols) + [
+        OutCol(None, n, n, e.type) for n, e in proj_exprs
+    ]
+    # select aliases shadow child columns of the same bare name for ORDER BY
+    proj = Projection(Schema(add_cols), plan, proj_exprs, additive=True)
+
+    out_schema = Schema([OutCol(None, n, n, e.type) for n, e in proj_exprs])
+
+    # ---- HAVING (after projection so select aliases are in scope) ----
+    if sel.having is not None:
+        hb = ExprBinder(
+            LayeredSchema(out_schema, plan.schema), _scalar_subq(subquery_value_fn)
+        )
+        h = _rewrite_aggs(sel.having, rewrite) if rewrite else sel.having
+        proj = Selection(proj.schema, proj, hb.bind(h))
+
+    # ---- DISTINCT (group-by over outputs; applies before ORDER BY) ----
+    if sel.distinct:
+        dk = [(n, ColumnRef(type=e.type, name=n)) for n, e in proj_exprs]
+        plan = Aggregate(out_schema, proj, dk, [])
+        sort_schema = LayeredSchema(out_schema)
+    else:
+        plan = proj
+        sort_schema = LayeredSchema(out_schema, plan.child.schema if isinstance(plan, Projection) else plan.schema)
+
+    # ---- ORDER BY ----
+    if sel.order_by:
+        ob = ExprBinder(sort_schema, _scalar_subq(subquery_value_fn))
+        keys = []
+        for oi in sel.order_by:
+            e = oi.expr
+            if isinstance(e, ast.Const) and isinstance(e.value, int):
+                e = ast.Name(None, out_names[e.value - 1])
+            e2 = _rewrite_aggs(e, rewrite) if rewrite else e
+            keys.append((ob.bind(e2), oi.desc))
+        plan = Sort(plan.schema, plan, keys)
+
+    # ---- LIMIT ----
+    if sel.limit is not None:
+        plan = Limit(plan.schema, plan, sel.limit, sel.offset or 0)
+
+    # ---- final projection to the select list ----
+    final_cols = [
+        OutCol(None, disp, n, e.type)
+        for disp, (n, e) in zip(display, proj_exprs)
+    ]
+    plan = Projection(
+        Schema(final_cols),
+        plan,
+        [(n, ColumnRef(type=e.type, name=n)) for n, e in proj_exprs],
+    )
+    return plan
+
+
+def _display_name(e) -> str:
+    if isinstance(e, ast.Name):
+        return e.column
+    if isinstance(e, ast.AggCall):
+        inner = "*" if e.arg is None else _display_name(e.arg)
+        d = "distinct " if e.distinct else ""
+        return f"{e.func}({d}{inner})"
+    if isinstance(e, ast.Const):
+        return repr(e.value)
+    if isinstance(e, ast.Call):
+        return f"{e.op}(...)" if len(e.args) > 2 else e.op
+    return "expr"
+
+
+def _scalar_subq(subquery_value_fn):
+    if subquery_value_fn is None:
+        return None
+
+    def run(e: ast.SubqueryExpr):
+        if e.modifier is None:
+            return subquery_value_fn(e.query)
+        raise PlanError("IN/EXISTS subquery only supported in WHERE")
+
+    return run
+
+
+def _apply_where(b, plan, where, subquery_value_fn, catalog, db):
+    """Split WHERE conjuncts: IN/EXISTS subqueries become semi/anti joins;
+    plain predicates become Selections (single-table pushdown happens
+    naturally since we're below the joins already built — full PPD into
+    join subtrees is done by the fragment compiler later)."""
+    plain: List = []
+    for c in _conjuncts(where):
+        if isinstance(c, ast.SubqueryExpr) and c.modifier in ("in", "not in", "exists", "not exists"):
+            plan = _subquery_semijoin(b, plan, c, subquery_value_fn, catalog, db)
+        elif isinstance(c, ast.Call) and c.op == "not" and isinstance(c.args[0], ast.SubqueryExpr):
+            sq = c.args[0]
+            mod = {"in": "not in", "exists": "not exists"}[sq.modifier]
+            plan = _subquery_semijoin(
+                b, plan, ast.SubqueryExpr(sq.query, mod, sq.lhs), subquery_value_fn, catalog, db
+            )
+        else:
+            plain.append(c)
+    if plain:
+        binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        plan = Selection(plan.schema, plan, binder.bind(_and_all(plain)))
+    return plan
+
+
+def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db):
+    """Uncorrelated IN/EXISTS -> semi/anti join (reference: decorrelation
+    + semi-join rewrite in expression_rewriter.go)."""
+    inner = build_select(sq.query, catalog, db, subquery_value_fn)
+    if sq.modifier in ("exists", "not exists"):
+        raise PlanError("EXISTS subqueries need correlation support (later)")
+    # IN: probe side = plan, build side = inner's single output column
+    if len(inner.schema.cols) != 1:
+        raise PlanError("IN subquery must select exactly one column")
+    lhs_bound = ExprBinder(plan.schema).bind(sq.lhs)
+    rhs_col = inner.schema.cols[0]
+    kind = "semi" if sq.modifier == "in" else "anti"
+    return JoinPlan(
+        plan.schema,
+        kind,
+        plan,
+        inner,
+        [(lhs_bound, ColumnRef(type=rhs_col.type, name=rhs_col.internal))],
+        None,
+        null_aware=(sq.modifier == "not in"),
+    )
+
+
+def _rewrite_aggs(e, rewrite: Dict):
+    """Replace AggCall / group-expr subtrees with references to aggregate
+    output columns."""
+    key = _ast_key(e)
+    if key in rewrite:
+        name, typ = rewrite[key]
+        return ast.Name(None, name)
+    if isinstance(e, ast.Call):
+        return ast.Call(e.op, [_rewrite_aggs(a, rewrite) for a in e.args], e.cast_type)
+    if isinstance(e, ast.AggCall):
+        raise PlanError("aggregate expression not in rewrite map (nested aggs?)")
+    return e
+
+
+def _ast_key(e) -> str:
+    return repr(e)
+
+
+def _build_aggregate(b, plan, group_by, agg_calls):
+    """Insert Aggregate node; return (plan, rewrite map ast-key ->
+    (output internal name, type))."""
+    binder = ExprBinder(plan.schema)
+    rewrite: Dict[str, Tuple[str, SQLType]] = {}
+    group_exprs: List[Tuple[str, Expr]] = []
+    for i, g in enumerate(group_by):
+        bound = binder.bind(g)
+        name = gensym("g")
+        # expose under the source column name when it's a plain column so
+        # ORDER BY / outer references resolve
+        group_exprs.append((name, bound))
+        rewrite[_ast_key(g)] = (name, bound.type)
+
+    aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
+    seen: Dict[str, str] = {}
+    from tidb_tpu.dtypes import FLOAT64, DECIMAL
+
+    for call in agg_calls:
+        key = _ast_key(call)
+        if key in rewrite:
+            continue
+        name = gensym("a")
+        arg = binder.bind(call.arg) if call.arg is not None else None
+        if call.func == "count":
+            t = INT64
+        elif call.func == "avg":
+            t = FLOAT64
+        elif call.func in ("min", "max", "sum"):
+            t = arg.type
+        else:
+            raise PlanError(f"unsupported aggregate {call.func}")
+        aggs.append((name, call.func, arg, call.distinct))
+        rewrite[key] = (name, t)
+
+    out_cols = [OutCol(None, n, n, e.type) for n, e in group_exprs]
+    for (n, f, a, d) in aggs:
+        t = next(t for (nn, t) in rewrite.values() if nn == n)
+        out_cols.append(OutCol(None, n, n, t))
+
+    agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
+    return agg_plan, rewrite

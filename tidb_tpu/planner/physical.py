@@ -1,0 +1,297 @@
+"""Physical execution of logical plans.
+
+Reference: pkg/executor/builder.go (executorBuilder.build dispatching plan
+types to executors) + the volcano Open/Next/Close loop. The TPU engine has
+no iterator protocol: each operator is a whole-batch device function and
+the interpreter walks the plan bottom-up, the way unistore's closure
+executor fuses a whole DAG into one callable (cophandler/closure_exec.go).
+
+Dynamic result sizes (group counts, join fan-out) are handled by the
+static-capacity + retry pattern: run at a capacity tile, read the true
+count (one scalar transfer), recompile at the next tile on overflow
+(SURVEY.md §7 "hard parts" #3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol, pad_capacity
+from tidb_tpu.dtypes import Kind, SQLType
+from tidb_tpu.executor import (
+    AggDesc,
+    equi_join,
+    filter_batch,
+    group_aggregate,
+    limit_op,
+    order_by,
+)
+from tidb_tpu.expression import compile_expr
+from tidb_tpu.expression.expr import ColumnRef, Expr
+from tidb_tpu.planner import logical as L
+from tidb_tpu.storage import scan_table
+
+Dicts = Dict[str, np.ndarray]
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+class PhysicalExecutor:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
+        return self._exec(plan)
+
+    # ------------------------------------------------------------------
+    def _exec(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
+        if isinstance(plan, L.Scan):
+            t = self.catalog.table(plan.db, plan.table)
+            batch, dicts = scan_table(t, plan.columns)
+            renamed = Batch(
+                {f"{plan.alias}.{n}": c for n, c in batch.cols.items()},
+                batch.row_valid,
+            )
+            return renamed, {f"{plan.alias}.{n}": d for n, d in dicts.items()}
+
+        if isinstance(plan, L.Selection):
+            batch, dicts = self._exec(plan.child)
+            fn = compile_expr(plan.predicate, dicts)
+            return filter_batch(batch, fn), dicts
+
+        if isinstance(plan, L.Projection):
+            batch, dicts = self._exec(plan.child)
+            out_cols = {}
+            out_dicts: Dicts = {}
+            if plan.additive:
+                out_cols.update(batch.cols)
+                out_dicts.update(dicts)
+            for name, e in plan.exprs:
+                out_cols[name] = compile_expr(e, dicts)(batch)
+                d = _expr_dict(e, dicts)
+                if d is not None:
+                    out_dicts[name] = d
+            return Batch(out_cols, batch.row_valid), out_dicts
+
+        if isinstance(plan, L.Aggregate):
+            return self._exec_aggregate(plan)
+
+        if isinstance(plan, L.JoinPlan):
+            return self._exec_join(plan)
+
+        if isinstance(plan, L.Sort):
+            batch, dicts = self._exec(plan.child)
+            key_fns = [compile_expr(e, dicts) for e, _ in plan.keys]
+            descs = [d for _, d in plan.keys]
+            return order_by(batch, key_fns, descs), dicts
+
+        if isinstance(plan, L.Limit):
+            batch, dicts = self._exec(plan.child)
+            return limit_op(batch, plan.count, plan.offset), dicts
+
+        raise ExecError(f"no physical impl for {type(plan).__name__}")
+
+    # ------------------------------------------------------------------
+    def _exec_aggregate(self, plan: L.Aggregate) -> Tuple[Batch, Dicts]:
+        batch, dicts = self._exec(plan.child)
+        key_fns = [compile_expr(e, dicts) for _, e in plan.group_exprs]
+        key_names = [n for n, _ in plan.group_exprs]
+        descs = []
+        for name, func, arg, distinct in plan.aggs:
+            if distinct:
+                raise ExecError("DISTINCT aggregates not yet supported")
+            fn = compile_expr(arg, dicts) if arg is not None else None
+            descs.append(AggDesc(func, fn, name))
+
+        cap = 1024
+        max_cap = max(pad_capacity(batch.capacity), 1024)
+        while True:
+            out, ngroups = group_aggregate(batch, key_fns, descs, cap, key_names)
+            n = int(ngroups)
+            if n <= cap:
+                break
+            cap = max(cap * 8, pad_capacity(n))
+            if cap > max_cap:
+                cap = max_cap
+        # MySQL: scalar aggregation over empty input yields exactly one
+        # row — COUNT is 0 (valid), SUM/MIN/MAX/AVG are NULL.
+        if not plan.group_exprs and n == 0:
+            rv = jnp.zeros(out.capacity, dtype=bool).at[0].set(True)
+            cols = {}
+            for (name, func, _arg, _d) in plan.aggs:
+                c = out.cols[name]
+                if func == "count":
+                    first_true = jnp.zeros_like(c.valid).at[0].set(True)
+                    cols[name] = DevCol(jnp.zeros_like(c.data), first_true)
+                else:
+                    cols[name] = DevCol(c.data, jnp.zeros_like(c.valid))
+            out = Batch(cols, rv)
+
+        out_dicts: Dicts = {}
+        for (kname, e) in plan.group_exprs:
+            d = _expr_dict(e, dicts)
+            if d is not None:
+                out_dicts[kname] = d
+        for (name, func, arg, _d) in plan.aggs:
+            if func in ("min", "max", "first") and arg is not None:
+                d = _expr_dict(arg, dicts)
+                if d is not None:
+                    out_dicts[name] = d
+        return out, out_dicts
+
+    # ------------------------------------------------------------------
+    def _exec_join(self, plan: L.JoinPlan) -> Tuple[Batch, Dicts]:
+        left_batch, ldicts = self._exec(plan.left)
+        right_batch, rdicts = self._exec(plan.right)
+        dicts = {**ldicts, **rdicts}
+
+        if plan.kind == "cross":
+            out, _total = _cross_join(left_batch, right_batch)
+            if plan.residual is not None:
+                out = filter_batch(out, compile_expr(plan.residual, dicts))
+            return out, dicts
+
+        # ---- key compilation (with string-dictionary alignment) ----
+        lkeys, rkeys = [], []
+        for le, re_ in plan.equi_keys:
+            lf, rf = _align_key_fns(le, re_, ldicts, rdicts)
+            lkeys.append(lf)
+            rkeys.append(rf)
+        if len(lkeys) == 1:
+            lkey, rkey = lkeys[0], rkeys[0]
+            verify = None
+        else:
+            if plan.kind != "inner":
+                raise ExecError("multi-key non-inner join not yet supported")
+            # hash-combine keys; collisions removed by a verify filter
+            lkey = _hash_combine(lkeys)
+            rkey = _hash_combine(rkeys)
+            verify = (lkeys, rkeys)
+
+        # join sides: reference picks build side by cost; we build on the
+        # smaller batch for inner joins (probe = larger).
+        kind = plan.kind
+        build_b, probe_b = right_batch, left_batch
+        build_k, probe_k = rkey, lkey
+        if kind == "inner" and left_batch.capacity < right_batch.capacity:
+            build_b, probe_b = left_batch, right_batch
+            build_k, probe_k = lkey, rkey
+
+        if kind in ("semi", "anti"):
+            out, _total = equi_join(
+                build_b, probe_b, build_k, probe_k, 0, kind,
+            )
+            if plan.null_aware and kind == "anti":
+                # NOT IN: empty result if build side contains a NULL key;
+                # probe NULL keys never pass.
+                bk = build_k(build_b)
+                has_null = jnp.any(~bk.valid & build_b.row_valid)
+                pk = probe_k(out)
+                keep = out.row_valid & ~has_null & pk.valid
+                out = Batch(out.cols, keep)
+            return out, dicts
+
+        cap = pad_capacity(max(probe_b.capacity, 1024))
+        max_cap = 1 << 26
+        while True:
+            out, total = equi_join(
+                build_b, probe_b, build_k, probe_k, cap, kind,
+            )
+            t = int(total)
+            if t <= cap:
+                break
+            cap = pad_capacity(t)
+            if cap > max_cap:
+                raise ExecError(f"join result too large ({t} rows)")
+        if verify is not None:
+            lk, rk = verify
+            def vf(b):
+                ok = jnp.ones(b.capacity, dtype=bool)
+                vv = jnp.ones(b.capacity, dtype=bool)
+                for lf, rf in zip(lk, rk):
+                    a, c = lf(b), rf(b)
+                    ok = ok & (a.data == c.data)
+                    vv = vv & a.valid & c.valid
+                return DevCol(ok, vv)
+            out = filter_batch(out, vf)
+        if plan.residual is not None:
+            out = filter_batch(out, compile_expr(plan.residual, dicts))
+        return out, dicts
+
+
+def _expr_dict(e: Expr, dicts: Dicts) -> Optional[np.ndarray]:
+    """Dictionary of a string-valued output expr (shared with the
+    compiler's string_expr so codes and dictionary always agree)."""
+    if e.type is None or e.type.kind != Kind.STRING:
+        return None
+    from tidb_tpu.expression.kernels import expr_dictionary
+
+    return expr_dictionary(e, dicts)
+
+
+def _align_key_fns(le: Expr, re_: Expr, ldicts: Dicts, rdicts: Dicts):
+    """Compile join key exprs; for STRING keys, remap both sides' codes
+    into a merged dictionary so integer equality == string equality."""
+    if le.type is not None and le.type.kind == Kind.STRING:
+        if not isinstance(le, ColumnRef) or not isinstance(re_, ColumnRef):
+            raise ExecError("string join keys must be plain columns")
+        ld = ldicts.get(le.name)
+        rd = rdicts.get(re_.name)
+        if ld is None or rd is None:
+            raise ExecError("string join keys need dictionaries")
+        merged = np.array(sorted(set(ld.tolist()) | set(rd.tolist())), dtype=object)
+        lut_l = jnp.asarray(np.searchsorted(merged, ld).astype(np.int64) if len(ld) else np.zeros(1, np.int64))
+        lut_r = jnp.asarray(np.searchsorted(merged, rd).astype(np.int64) if len(rd) else np.zeros(1, np.int64))
+        lname, rname = le.name, re_.name
+
+        def lf(b: Batch) -> DevCol:
+            c = b.cols[lname]
+            return DevCol(lut_l[jnp.clip(c.data, 0, lut_l.shape[0] - 1)], c.valid)
+
+        def rf(b: Batch) -> DevCol:
+            c = b.cols[rname]
+            return DevCol(lut_r[jnp.clip(c.data, 0, lut_r.shape[0] - 1)], c.valid)
+
+        return lf, rf
+    lfn = compile_expr(le, ldicts)
+    rfn = compile_expr(re_, rdicts)
+    return lfn, rfn
+
+
+def _hash_combine(key_fns):
+    def f(b: Batch) -> DevCol:
+        h = jnp.zeros(b.capacity, dtype=jnp.int64)
+        valid = jnp.ones(b.capacity, dtype=bool)
+        for fn in key_fns:
+            c = fn(b)
+            k = c.data.astype(jnp.int64)
+            h = (h * jnp.int64(-7046029254386353131)) ^ (
+                k + jnp.int64(-9061461749304837403) + (h << 6) + (h >> 2)
+            )
+            valid = valid & c.valid
+        return DevCol(h, valid)
+
+    return f
+
+
+def _cross_join(left: Batch, right: Batch):
+    """Nested-loop cross join via broadcast (small sides only)."""
+    lcap, rcap = left.capacity, right.capacity
+    if lcap * rcap > (1 << 24):
+        raise ExecError("cross join too large")
+    li = jnp.repeat(jnp.arange(lcap), rcap)
+    ri = jnp.tile(jnp.arange(rcap), lcap)
+    cols = {}
+    for n, c in left.cols.items():
+        cols[n] = DevCol(c.data[li], c.valid[li])
+    for n, c in right.cols.items():
+        cols[n] = DevCol(c.data[ri], c.valid[ri])
+    rv = left.row_valid[li] & right.row_valid[ri]
+    total = jnp.sum(rv.astype(jnp.int64))
+    return Batch(cols, rv), total

@@ -1,0 +1,1 @@
+from tidb_tpu.session.session import Session, Result  # noqa: F401

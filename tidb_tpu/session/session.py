@@ -1,0 +1,277 @@
+"""Session: parse -> plan -> execute -> result, plus DDL/DML dispatch.
+
+Reference: pkg/session (session.ExecuteStmt session.go:2001 driving
+Compile -> runStmt -> ExecStmt.Exec) and pkg/testkit (TestKit.MustExec /
+MustQuery against an embedded store, testkit.go:71) — this class is both:
+the embedded single-process session AND the test harness entry point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import batch_to_block, column_from_values, HostBlock
+from tidb_tpu.dtypes import Kind, SQLType
+from tidb_tpu.parser import ast, parse
+from tidb_tpu.planner import build_select
+from tidb_tpu.planner.logical import ExprBinder, Schema
+from tidb_tpu.planner.physical import PhysicalExecutor
+from tidb_tpu.storage import Catalog, scan_table
+from tidb_tpu.storage.table import TableSchema
+from tidb_tpu.storage.scan import clear_scan_cache
+
+
+@dataclasses.dataclass
+class Result:
+    columns: List[str]
+    rows: List[Tuple]
+    affected: int = 0
+    elapsed_s: float = 0.0
+
+    def sorted(self) -> List[Tuple]:
+        return sorted(self.rows, key=lambda r: tuple((v is None, str(v)) for v in r))
+
+
+class Session:
+    def __init__(self, catalog: Optional[Catalog] = None, db: str = "test"):
+        self.catalog = catalog or Catalog()
+        self.db = db
+        self.executor = PhysicalExecutor(self.catalog)
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str) -> Result:
+        stmts = parse(sql)
+        res = Result([], [])
+        for s in stmts:
+            res = self._execute_stmt(s)
+        return res
+
+    # test-kit style helpers (reference pkg/testkit/testkit.go:144,167)
+    def must_exec(self, sql: str) -> Result:
+        return self.execute(sql)
+
+    def must_query(self, sql: str, expected: Optional[Sequence[Tuple]] = None) -> Result:
+        r = self.execute(sql)
+        if expected is not None:
+            got = [tuple(row) for row in r.rows]
+            exp = [tuple(row) for row in expected]
+            assert got == exp, f"query mismatch:\n got: {got}\n exp: {exp}"
+        return r
+
+    # ------------------------------------------------------------------
+    def _execute_stmt(self, s) -> Result:
+        t0 = time.perf_counter()
+        if isinstance(s, ast.Select):
+            r = self._run_select(s)
+        elif isinstance(s, ast.CreateTable):
+            schema = TableSchema(
+                [(c.name.lower(), c.type) for c in s.columns],
+                primary_key=[c.lower() for c in s.primary_key] or None,
+            )
+            self.catalog.create_table(s.db or self.db, s.name, schema, s.if_not_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.DropTable):
+            self.catalog.drop_table(s.db or self.db, s.name, s.if_exists)
+            clear_scan_cache()
+            r = Result([], [])
+        elif isinstance(s, ast.CreateDatabase):
+            self.catalog.create_database(s.name, s.if_not_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.DropDatabase):
+            self.catalog.drop_database(s.name)
+            r = Result([], [])
+        elif isinstance(s, ast.UseDatabase):
+            if s.name.lower() not in [d.lower() for d in self.catalog.databases()]:
+                raise ValueError(f"unknown database {s.name}")
+            self.db = s.name.lower()
+            r = Result([], [])
+        elif isinstance(s, ast.Insert):
+            r = self._run_insert(s)
+        elif isinstance(s, ast.Delete):
+            r = self._run_delete(s)
+        elif isinstance(s, ast.Update):
+            r = self._run_update(s)
+        elif isinstance(s, ast.Explain):
+            r = self._run_explain(s)
+        elif isinstance(s, ast.Show):
+            if s.what == "tables":
+                r = Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
+            else:
+                r = Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        else:
+            raise ValueError(f"unsupported statement {type(s).__name__}")
+        r.elapsed_s = time.perf_counter() - t0
+        return r
+
+    # ------------------------------------------------------------------
+    def _scalar_subquery(self, q: ast.Select):
+        """Execute an uncorrelated scalar subquery; returns a Literal."""
+        from tidb_tpu.expression.expr import Literal
+
+        r = self._run_select(q)
+        if len(r.columns) != 1:
+            raise ValueError("scalar subquery must return one column")
+        if len(r.rows) == 0:
+            return Literal(value=None)
+        if len(r.rows) > 1:
+            raise ValueError("scalar subquery returned more than one row")
+        return Literal(value=r.rows[0][0])
+
+    def _run_select(self, s: ast.Select) -> Result:
+        plan = build_select(s, self.catalog, self.db, self._scalar_subquery)
+        batch, dicts = self.executor.run(plan)
+        types = {c.internal: c.type for c in plan.schema}
+        block = batch_to_block(batch, types, dicts)
+        names = [c.name for c in plan.schema]
+        internals = [c.internal for c in plan.schema]
+        decoded = {i: block.columns[i].decode() for i in internals}
+        rows = [
+            tuple(decoded[i][r] for i in internals) for r in range(block.nrows)
+        ]
+        return Result(names, rows)
+
+    # ------------------------------------------------------------------
+    def _run_insert(self, s: ast.Insert) -> Result:
+        t = self.catalog.table(s.db or self.db, s.table)
+        names = t.schema.names
+        cols = [c.lower() for c in s.columns] if s.columns else names
+        unknown = set(cols) - set(names)
+        if unknown:
+            raise ValueError(f"unknown columns {sorted(unknown)}")
+        rows = []
+        for row in s.rows:
+            if len(row) != len(cols):
+                raise ValueError("VALUES arity mismatch")
+            vals = {c: self._const_value(v) for c, v in zip(cols, row)}
+            rows.append([vals.get(n) for n in names])
+        t.append_rows(rows)
+        clear_scan_cache()
+        return Result([], [], affected=len(rows))
+
+    @staticmethod
+    def _const_value(e):
+        if isinstance(e, ast.Const):
+            return e.value
+        if isinstance(e, ast.Call) and e.op == "neg" and isinstance(e.args[0], ast.Const):
+            return -e.args[0].value
+        raise ValueError("INSERT VALUES must be literals")
+
+    def _run_delete(self, s: ast.Delete) -> Result:
+        t = self.catalog.table(s.db or self.db, s.table)
+        blocks = t.blocks()
+        if s.where is None:
+            affected = t.nrows
+            t.replace_blocks([])
+            clear_scan_cache()
+            return Result([], [], affected=affected)
+        masks, affected = self._eval_where_per_block(t, s.where)
+        t.delete_where([~m for m in masks])
+        clear_scan_cache()
+        return Result([], [], affected=affected)
+
+    def _run_update(self, s: ast.Update) -> Result:
+        t = self.catalog.table(s.db or self.db, s.table)
+        # evaluate via a SELECT of all columns with updated expressions,
+        # then rewrite the table (columnar copy-on-write update).
+        alias = t.name
+        sets = {c.lower(): e for c, e in s.sets}
+        items = []
+        for n, _typ in t.schema.columns:
+            if n in sets:
+                items.append(ast.SelectItem(sets[n], alias=n))
+            else:
+                items.append(ast.SelectItem(ast.Name(None, n), alias=n))
+        sel = ast.Select(
+            items=items,
+            from_=ast.TableRef(s.db, s.table, None),
+            where=None,
+        )
+        # rows not matching WHERE keep original values: implement as
+        # CASE WHEN where THEN new ELSE old END per updated column
+        if s.where is not None:
+            new_items = []
+            for it in items:
+                if it.alias in sets:
+                    new_items.append(
+                        ast.SelectItem(
+                            ast.Call("case", [s.where, it.expr, ast.Name(None, it.alias)]),
+                            alias=it.alias,
+                        )
+                    )
+                else:
+                    new_items.append(it)
+            sel = dataclasses.replace(sel, items=new_items)
+        r = self._run_select(sel)
+        rows = [list(row) for row in r.rows]
+        # count affected
+        if s.where is None:
+            affected = len(rows)
+        else:
+            _masks, affected = self._eval_where_per_block(t, s.where)
+        t.replace_blocks([])
+        if rows:
+            t.append_rows(rows)
+        clear_scan_cache()
+        return Result([], [], affected=affected)
+
+    def _eval_where_per_block(self, t, where):
+        """Evaluate WHERE over each block on host via a filtered scan;
+        returns per-block keep masks for matching rows + count."""
+        sel = ast.Select(
+            items=[ast.SelectItem(where, alias="_m")],
+            from_=ast.TableRef(None, t.name, None),
+        )
+        # plan against this table's db: resolve by search
+        db = next(d for d in self.catalog.databases() if self.catalog.has_table(d, t.name))
+        plan = build_select(sel, self.catalog, db, self._scalar_subquery)
+        batch, dicts = self.executor.run(plan)
+        internal = plan.schema.cols[0].internal
+        c = batch.cols[internal]
+        m = np.asarray(c.data & c.valid & batch.row_valid)
+        # batch rows follow block concatenation order
+        masks = []
+        off = 0
+        for b in t.blocks():
+            masks.append(m[off : off + b.nrows].astype(bool))
+            off += b.nrows
+        return masks, int(m[: off].sum())
+
+    # ------------------------------------------------------------------
+    def _run_explain(self, s: ast.Explain) -> Result:
+        if not isinstance(s.stmt, ast.Select):
+            raise ValueError("EXPLAIN supports SELECT")
+        plan = build_select(s.stmt, self.catalog, self.db, self._scalar_subquery)
+        lines = []
+        _render_plan(plan, 0, lines)
+        return Result(["plan"], [(l,) for l in lines])
+
+
+def _render_plan(plan, depth, out: List[str]):
+    from tidb_tpu.planner import logical as L
+
+    pad = "  " * depth
+    name = type(plan).__name__
+    detail = ""
+    if isinstance(plan, L.Scan):
+        detail = f" table={plan.db}.{plan.table} cols={len(plan.columns)}"
+    elif isinstance(plan, L.Selection):
+        detail = f" pred={plan.predicate!r}"
+    elif isinstance(plan, L.Aggregate):
+        detail = f" groups={[n for n, _ in plan.group_exprs]} aggs={[f'{f}({n})' for n, f, _, _ in plan.aggs]}"
+    elif isinstance(plan, L.JoinPlan):
+        detail = f" kind={plan.kind} keys={len(plan.equi_keys)}"
+    elif isinstance(plan, L.Sort):
+        detail = f" keys={len(plan.keys)}"
+    elif isinstance(plan, L.Limit):
+        detail = f" limit={plan.count} offset={plan.offset}"
+    elif isinstance(plan, L.Projection):
+        detail = f" exprs={[n for n, _ in plan.exprs]}{' +base' if plan.additive else ''}"
+    out.append(pad + name + detail)
+    for attr in ("child", "left", "right"):
+        c = getattr(plan, attr, None)
+        if c is not None:
+            _render_plan(c, depth + 1, out)
