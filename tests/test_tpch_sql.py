@@ -1,0 +1,254 @@
+"""TPC-H benchmark ladder (BASELINE.json configs) through the SQL surface,
+golden-checked against plain-Python computation over the decoded data."""
+
+import math
+from collections import defaultdict
+
+import pytest
+
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture(scope="module")
+def sess():
+    cat = Catalog()
+    load_tpch(cat, sf=0.002, seed=11)
+    s = Session(cat, db="tpch")
+    return s
+
+
+def decode_table(sess, name):
+    t = sess.catalog.table("tpch", name)
+    rows = []
+    blocks = t.blocks()
+    cols = t.schema.names
+    data = {c: [] for c in cols}
+    for b in blocks:
+        for c in cols:
+            data[c].extend(b.columns[c].decode().tolist())
+    n = sum(b.nrows for b in blocks)
+    return data, n
+
+
+def days(s):
+    from tidb_tpu.dtypes import date_to_days
+
+    return int(date_to_days(s))
+
+
+def test_q1(sess):
+    r = sess.must_query(
+        "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+        "sum(l_extendedprice) as sum_base_price, "
+        "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
+        "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
+        "avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price, "
+        "avg(l_discount) as avg_disc, count(*) as count_order "
+        "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
+        "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+    )
+    li, n = decode_table(sess, "lineitem")
+    cutoff = days("1998-12-01") - 90  # DATE decodes to int days
+    agg = defaultdict(lambda: [0.0, 0.0, 0.0, 0.0, 0])
+    for i in range(n):
+        sd = li["l_shipdate"][i]
+        if sd > cutoff:
+            continue
+        key = (li["l_returnflag"][i], li["l_linestatus"][i])
+        a = agg[key]
+        q, p, d, t = (
+            li["l_quantity"][i],
+            li["l_extendedprice"][i],
+            li["l_discount"][i],
+            li["l_tax"][i],
+        )
+        a[0] += q
+        a[1] += p
+        a[2] += p * (1 - d)
+        a[3] += p * (1 - d) * (1 + t)
+        a[4] += 1
+    expected = []
+    for key in sorted(agg):
+        a = agg[key]
+        expected.append(
+            (key[0], key[1], round(a[0], 2), round(a[1], 2), round(a[2], 4),
+             round(a[3], 6), a[0] / a[4], a[1] / a[4], None, a[4])
+        )
+    assert len(r.rows) == len(expected)
+    for got, exp in zip(r.rows, expected):
+        assert got[0] == exp[0] and got[1] == exp[1]
+        assert math.isclose(got[2], exp[2], abs_tol=0.01)
+        assert math.isclose(got[3], exp[3], abs_tol=0.01)
+        assert math.isclose(got[4], exp[4], rel_tol=1e-12, abs_tol=1e-4)
+        assert math.isclose(got[5], exp[5], rel_tol=1e-12, abs_tol=1e-6)
+        assert math.isclose(got[6], exp[6], rel_tol=1e-9)
+        assert math.isclose(got[7], exp[7], rel_tol=1e-9)
+        assert got[9] == exp[9]
+
+
+def test_q6(sess):
+    r = sess.must_query(
+        "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+        "where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
+        "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+    )
+    li, n = decode_table(sess, "lineitem")
+    d0, d1 = days("1994-01-01"), days("1995-01-01")
+    exp = 0.0
+    for i in range(n):
+        if (
+            d0 <= li["l_shipdate"][i] < d1
+            and 0.05 <= li["l_discount"][i] <= 0.07
+            and li["l_quantity"][i] < 24
+        ):
+            exp += li["l_extendedprice"][i] * li["l_discount"][i]
+    assert math.isclose(r.rows[0][0], round(exp, 4), abs_tol=0.01)
+
+
+def test_q3(sess):
+    r = sess.must_query(
+        "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue, "
+        "o_orderdate, o_shippriority "
+        "from customer, orders, lineitem "
+        "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+        "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+        "and l_shipdate > date '1995-03-15' "
+        "group by l_orderkey, o_orderdate, o_shippriority "
+        "order by revenue desc, o_orderdate limit 10"
+    )
+    cust, nc = decode_table(sess, "customer")
+    orders, no = decode_table(sess, "orders")
+    li, nl = decode_table(sess, "lineitem")
+    building = {
+        cust["c_custkey"][i] for i in range(nc) if cust["c_mktsegment"][i] == "BUILDING"
+    }
+    cut = days("1995-03-15")
+    okeys = {}
+    for i in range(no):
+        if orders["o_custkey"][i] in building and orders["o_orderdate"][i] < cut:
+            okeys[orders["o_orderkey"][i]] = (
+                orders["o_orderdate"][i],
+                orders["o_shippriority"][i],
+            )
+    agg = defaultdict(float)
+    for i in range(nl):
+        ok = li["l_orderkey"][i]
+        if ok in okeys and li["l_shipdate"][i] > cut:
+            agg[(ok, okeys[ok][0], okeys[ok][1])] += li["l_extendedprice"][i] * (
+                1 - li["l_discount"][i]
+            )
+    expected = sorted(
+        ((k[0], round(v, 4), k[1], k[2]) for k, v in agg.items()),
+        key=lambda t: (-t[1], t[2]),
+    )[:10]
+    got = [(a, round(b, 4), c, d) for a, b, c, d in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0] and g[2] == e[2] and g[3] == e[3]
+        assert math.isclose(g[1], e[1], abs_tol=0.01)
+
+
+def test_q5(sess):
+    r = sess.must_query(
+        "select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue "
+        "from customer, orders, lineitem, supplier, nation, region "
+        "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+        "and l_suppkey = s_suppkey and c_nationkey = s_nationkey "
+        "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+        "and r_name = 'ASIA' "
+        "and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01' "
+        "group by n_name order by revenue desc"
+    )
+    cust, nc = decode_table(sess, "customer")
+    orders, no = decode_table(sess, "orders")
+    li, nl = decode_table(sess, "lineitem")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    reg, nr = decode_table(sess, "region")
+    asia = {reg["r_regionkey"][i] for i in range(nr) if reg["r_name"][i] == "ASIA"}
+    nkey_name = {
+        nat["n_nationkey"][i]: nat["n_name"][i]
+        for i in range(nn)
+        if nat["n_regionkey"][i] in asia
+    }
+    cust_nation = {cust["c_custkey"][i]: cust["c_nationkey"][i] for i in range(nc)}
+    supp_nation = {supp["s_suppkey"][i]: supp["s_nationkey"][i] for i in range(ns)}
+    d0, d1 = days("1994-01-01"), days("1995-01-01")
+    order_cust = {}
+    for i in range(no):
+        if d0 <= orders["o_orderdate"][i] < d1:
+            order_cust[orders["o_orderkey"][i]] = orders["o_custkey"][i]
+    agg = defaultdict(float)
+    for i in range(nl):
+        ok = li["l_orderkey"][i]
+        if ok not in order_cust:
+            continue
+        ck = order_cust[ok]
+        sk = li["l_suppkey"][i]
+        cn = cust_nation.get(ck)
+        sn = supp_nation.get(sk)
+        if cn is None or sn is None or cn != sn or sn not in nkey_name:
+            continue
+        agg[nkey_name[sn]] += li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+    expected = sorted(
+        ((k, round(v, 4)) for k, v in agg.items()), key=lambda t: -t[1]
+    )
+    got = [(a, round(b, 4)) for a, b in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0]
+        assert math.isclose(g[1], e[1], abs_tol=0.01)
+
+
+def test_q18(sess):
+    thresh = 120
+    r = sess.must_query(
+        "select c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity) "
+        "from customer, orders, lineitem "
+        "where o_orderkey in (select l_orderkey from lineitem group by l_orderkey "
+        f"having sum(l_quantity) > {thresh}) "
+        "and c_custkey = o_custkey and o_orderkey = l_orderkey "
+        "group by c_custkey, o_orderkey, o_orderdate, o_totalprice "
+        "order by o_totalprice desc, o_orderdate limit 100"
+    )
+    orders, no = decode_table(sess, "orders")
+    li, nl = decode_table(sess, "lineitem")
+    qty = defaultdict(float)
+    for i in range(nl):
+        qty[li["l_orderkey"][i]] += li["l_quantity"][i]
+    big = {k for k, v in qty.items() if v > thresh}
+    order_info = {
+        orders["o_orderkey"][i]: (
+            orders["o_custkey"][i],
+            orders["o_orderdate"][i],
+            orders["o_totalprice"][i],
+        )
+        for i in range(no)
+    }
+    expected = []
+    for ok in big:
+        if ok in order_info:
+            ck, od, tp = order_info[ok]
+            expected.append((ck, ok, od, tp, round(qty[ok], 2)))
+    expected.sort(key=lambda t: (-t[3], t[2]))
+    expected = expected[:100]
+    got = [(a, b, c, d, round(e, 2)) for a, b, c, d, e in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0] and g[1] == e[1] and g[3] == e[3]
+        assert math.isclose(g[4], e[4], abs_tol=0.01)
+
+
+def test_q10_left_style(sess):
+    """Q10-shaped: join + group by over customer returns."""
+    r = sess.must_query(
+        "select c_custkey, sum(l_extendedprice * (1 - l_discount)) as revenue "
+        "from customer, orders, lineitem "
+        "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+        "and l_returnflag = 'R' "
+        "group by c_custkey order by revenue desc limit 20"
+    )
+    assert len(r.rows) <= 20
+    assert all(row[1] is None or row[1] >= 0 for row in r.rows)

@@ -42,6 +42,10 @@ class AggDesc:
     arg: Optional[ExprFn]
     out_name: str
     distinct: bool = False
+    # decimal scale of the argument: AVG divides the float result by
+    # 10**arg_scale to return true values (SUM keeps the scaled int,
+    # typed DECIMAL(scale) by the planner).
+    arg_scale: int = 0
 
 
 def group_aggregate(
@@ -141,10 +145,10 @@ def group_aggregate(
             if a.func == "sum":
                 out_cols[a.out_name] = DevCol(s, v)
             else:
-                denom = jnp.where(cnt == 0, 1, cnt)
-                out_cols[a.out_name] = DevCol(
-                    s.astype(jnp.float64) / denom.astype(jnp.float64), v
-                )
+                denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+                if a.arg_scale:
+                    denom = denom * (10**a.arg_scale)
+                out_cols[a.out_name] = DevCol(s.astype(jnp.float64) / denom, v)
         elif a.func in ("min", "max"):
             if a.func == "min":
                 big = _type_max(data.dtype)

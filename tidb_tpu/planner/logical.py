@@ -565,26 +565,126 @@ def _scalar_subq(subquery_value_fn):
 
 
 def _apply_where(b, plan, where, subquery_value_fn, catalog, db):
-    """Split WHERE conjuncts: IN/EXISTS subqueries become semi/anti joins;
-    plain predicates become Selections (single-table pushdown happens
-    naturally since we're below the joins already built — full PPD into
-    join subtrees is done by the fragment compiler later)."""
+    """Split WHERE conjuncts: IN/EXISTS subqueries become semi/anti
+    joins; plain predicates run through cross-join elimination (reference
+    ppdSolver + joinReOrderSolver, optimizer.go:98-123): single-relation
+    conjuncts sink onto their relation, eq-conjuncts linking two
+    relations of a comma-join become inner-join keys, the rest filter on
+    top."""
     plain: List = []
+    subq: List = []
     for c in _conjuncts(where):
         if isinstance(c, ast.SubqueryExpr) and c.modifier in ("in", "not in", "exists", "not exists"):
-            plan = _subquery_semijoin(b, plan, c, subquery_value_fn, catalog, db)
+            subq.append(c)
         elif isinstance(c, ast.Call) and c.op == "not" and isinstance(c.args[0], ast.SubqueryExpr):
             sq = c.args[0]
             mod = {"in": "not in", "exists": "not exists"}[sq.modifier]
-            plan = _subquery_semijoin(
-                b, plan, ast.SubqueryExpr(sq.query, mod, sq.lhs), subquery_value_fn, catalog, db
-            )
+            subq.append(ast.SubqueryExpr(sq.query, mod, sq.lhs))
         else:
             plain.append(c)
     if plain:
-        binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
-        plan = Selection(plan.schema, plan, binder.bind(_and_all(plain)))
+        plan = _reorder_joins(plan, plain, subquery_value_fn)
+    for c in subq:
+        plan = _subquery_semijoin(b, plan, c, subquery_value_fn, catalog, db)
     return plan
+
+
+def _flatten_cross(p: LogicalPlan) -> List[LogicalPlan]:
+    if isinstance(p, JoinPlan) and p.kind == "cross" and p.residual is None:
+        return _flatten_cross(p.left) + _flatten_cross(p.right)
+    return [p]
+
+
+def _rels_of(conj, rels: List[LogicalPlan]) -> Optional[set]:
+    """Which relations a conjunct's columns come from; None if a column
+    is unresolvable (shouldn't happen for bound-checked input)."""
+    cols = _ast_columns(conj, set())
+    out = set()
+    for tbl, col in cols:
+        found = None
+        for i, r in enumerate(rels):
+            try:
+                r.schema.resolve(tbl, col)
+                found = i if found is None else found
+                if found != i:
+                    # ambiguous across relations: unqualified name in two
+                    return None
+            except PlanError:
+                continue
+        if found is None:
+            return None
+        out.add(found)
+    return out
+
+
+def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
+    rels = _flatten_cross(plan)
+    if len(rels) == 1:
+        binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        return Selection(plan.schema, plan, binder.bind(_and_all(conjuncts)))
+
+    rel_filters: Dict[int, List] = {}
+    edges: List[Tuple[int, int, object, object]] = []  # (ri, rj, ast_i, ast_j)
+    post: List = []
+    for c in conjuncts:
+        rs = _rels_of(c, rels)
+        if rs is not None and len(rs) == 1:
+            rel_filters.setdefault(next(iter(rs)), []).append(c)
+            continue
+        if (
+            isinstance(c, ast.Call)
+            and c.op == "eq"
+            and rs is not None
+            and len(rs) == 2
+        ):
+            s0 = _rels_of(c.args[0], rels)
+            s1 = _rels_of(c.args[1], rels)
+            if s0 is not None and s1 is not None and len(s0) == 1 and len(s1) == 1 and s0 != s1:
+                edges.append((next(iter(s0)), next(iter(s1)), c.args[0], c.args[1]))
+                continue
+        post.append(c)
+
+    # sink single-relation filters (predicate pushdown)
+    for i, fs in rel_filters.items():
+        r = rels[i]
+        binder = ExprBinder(r.schema, _scalar_subq(subquery_value_fn))
+        rels[i] = Selection(r.schema, r, binder.bind(_and_all(fs)))
+
+    # greedy join tree: start from relation 0, pull in connected relations
+    joined = {0}
+    cur = rels[0]
+    remaining = set(range(1, len(rels)))
+    while remaining:
+        # all edges between the joined set and one new relation
+        candidates: Dict[int, List[Tuple[object, object]]] = {}
+        for (ri, rj, ei, ej) in edges:
+            if ri in joined and rj in remaining:
+                candidates.setdefault(rj, []).append((ei, ej))
+            elif rj in joined and ri in remaining:
+                candidates.setdefault(ri, []).append((ej, ei))
+        if not candidates:
+            nxt = min(remaining)
+            r = rels[nxt]
+            schema = Schema(list(cur.schema.cols) + list(r.schema.cols))
+            cur = JoinPlan(schema, "cross", cur, r, [], None)
+            joined.add(nxt)
+            remaining.discard(nxt)
+            continue
+        # join the relation with the most keys first (most selective)
+        nxt = max(candidates, key=lambda k: len(candidates[k]))
+        r = rels[nxt]
+        lb = ExprBinder(cur.schema)
+        rb = ExprBinder(r.schema)
+        keys = [(lb.bind(ei), rb.bind(ej)) for ei, ej in candidates[nxt]]
+        schema = Schema(list(cur.schema.cols) + list(r.schema.cols))
+        cur = JoinPlan(schema, "inner", cur, r, keys, None)
+        joined.add(nxt)
+        remaining.discard(nxt)
+
+    if post:
+        binder = ExprBinder(cur.schema, _scalar_subq(subquery_value_fn))
+        cur = Selection(cur.schema, cur, binder.bind(_and_all(post)))
+    return cur
 
 
 def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db):

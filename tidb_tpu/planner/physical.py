@@ -107,7 +107,8 @@ class PhysicalExecutor:
             if distinct:
                 raise ExecError("DISTINCT aggregates not yet supported")
             fn = compile_expr(arg, dicts) if arg is not None else None
-            descs.append(AggDesc(func, fn, name))
+            scale = arg.type.scale if arg is not None and arg.type.kind == Kind.DECIMAL else 0
+            descs.append(AggDesc(func, fn, name, arg_scale=scale))
 
         cap = 1024
         max_cap = max(pad_capacity(batch.capacity), 1024)
