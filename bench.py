@@ -1,0 +1,170 @@
+"""Benchmark: TPC-H on the device engine vs a vectorized-numpy CPU baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference baseline (BASELINE.md) is TiDB's own embedded CPU engine
+(unistore/mocktikv vectorized coprocessor); a vectorized numpy
+implementation of the same query over the same data stands in for it
+here (same columnar layout, single CPU core — generous to the baseline
+since numpy's C kernels are at least as fast as the Go engine's
+per-chunk loops).
+
+Usage: python bench.py [--sf 1.0] [--query q1|q6|q18] [--repeat 5] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def numpy_q1(blk, cutoff):
+    ship = blk["l_shipdate"]
+    m = ship <= cutoff
+    rf = blk["l_returnflag"][m].astype(np.int64)
+    ls = blk["l_linestatus"][m].astype(np.int64)
+    qty = blk["l_quantity"][m]
+    price = blk["l_extendedprice"][m]
+    disc = blk["l_discount"][m]
+    tax = blk["l_tax"][m]
+    key = rf * 2 + ls
+    nk = 6
+    disc_price = price * (100 - disc)
+    charge = disc_price * (100 + tax)
+    out = {
+        "sum_qty": np.bincount(key, qty, minlength=nk),
+        "sum_base": np.bincount(key, price, minlength=nk),
+        "sum_disc": np.bincount(key, disc_price, minlength=nk),
+        "sum_charge": np.bincount(key, charge, minlength=nk),
+        "cnt": np.bincount(key, minlength=nk),
+    }
+    out["avg_qty"] = out["sum_qty"] / np.maximum(out["cnt"], 1)
+    out["avg_base"] = out["sum_base"] / np.maximum(out["cnt"], 1)
+    return out
+
+
+def numpy_q6(blk, d0, d1):
+    ship = blk["l_shipdate"]
+    m = (
+        (ship >= d0)
+        & (ship < d1)
+        & (blk["l_discount"] >= 5)
+        & (blk["l_discount"] <= 7)
+        & (blk["l_quantity"] < 2400)
+    )
+    return (blk["l_extendedprice"][m] * blk["l_discount"][m]).sum()
+
+
+def numpy_q18(blk, thresh):
+    ok = blk["l_orderkey"]
+    qty = blk["l_quantity"]
+    sums = np.bincount(ok, qty)
+    big = np.nonzero(sums > thresh)[0]
+    return big, sums[big]
+
+
+Q1_SQL = (
+    "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+    "sum(l_extendedprice) as sum_base_price, "
+    "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
+    "avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price, "
+    "avg(l_discount) as avg_disc, count(*) as count_order "
+    "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+)
+Q6_SQL = (
+    "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+    "where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
+    "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+)
+Q18_SQL = (
+    "select o_orderkey, sum(l_quantity) from lineitem, orders "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderkey having sum(l_quantity) > 1250 "
+    "order by sum(l_quantity) desc limit 100"
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--query", default="q1", choices=["q1", "q6", "q18"])
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--quick", action="store_true", help="sf=0.01 sanity run")
+    args = ap.parse_args()
+    if args.quick:
+        args.sf = 0.01
+
+    from tidb_tpu.bench import load_tpch
+    from tidb_tpu.dtypes import date_to_days
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+
+    cat = Catalog()
+    t0 = time.perf_counter()
+    tables = ["orders", "lineitem"]
+    load_tpch(cat, sf=args.sf, tables=tables, seed=1)
+    gen_s = time.perf_counter() - t0
+    sess = Session(cat, db="tpch")
+    li = cat.table("tpch", "lineitem")
+    nrows = li.nrows
+
+    sql = {"q1": Q1_SQL, "q6": Q6_SQL, "q18": Q18_SQL}[args.query]
+
+    # device engine (includes host->device on first run; cached after)
+    sess.execute(sql)  # warmup: compile + scan cache
+    times = []
+    for _ in range(args.repeat):
+        t0 = time.perf_counter()
+        sess.execute(sql)
+        times.append(time.perf_counter() - t0)
+    dev_s = float(np.median(times))
+
+    # numpy baseline over the same host-resident columns
+    blk = {}
+    b = li.blocks()[0]
+    for c in (
+        "l_shipdate l_returnflag l_linestatus l_quantity l_extendedprice "
+        "l_discount l_tax l_orderkey".split()
+    ):
+        blk[c] = b.columns[c].data
+    base_times = []
+    cutoff = int(date_to_days("1998-12-01")) - 90
+    d0, d1 = int(date_to_days("1994-01-01")), int(date_to_days("1995-01-01"))
+    for _ in range(max(args.repeat, 2)):
+        t0 = time.perf_counter()
+        if args.query == "q1":
+            numpy_q1(blk, cutoff)
+        elif args.query == "q6":
+            numpy_q6(blk, d0, d1)
+        else:
+            numpy_q18(blk, 12500)
+        base_times.append(time.perf_counter() - t0)
+    base_s = float(np.median(base_times))
+
+    value = nrows / dev_s
+    baseline = nrows / base_s
+    result = {
+        "metric": f"tpch_{args.query}_sf{args.sf:g}_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(value / baseline, 3),
+        "detail": {
+            "rows": nrows,
+            "device_median_s": round(dev_s, 4),
+            "numpy_baseline_s": round(base_s, 4),
+            "datagen_s": round(gen_s, 2),
+            "repeat": args.repeat,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""Mesh runtime tests on the virtual 8-device CPU mesh (reference model:
+unistore's in-proc MPP exchange tests — full shuffle without a cluster,
+SURVEY.md §4 "multi-node without a cluster")."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tidb_tpu.chunk import Batch, DevCol, HostBlock, block_to_batch, column_from_values
+from tidb_tpu.dtypes import INT64
+from tidb_tpu.executor import AggDesc, group_aggregate
+from tidb_tpu.parallel import (
+    broadcast_join,
+    distributed_group_aggregate,
+    hash_repartition,
+    make_mesh,
+    partitioned_join,
+    shard_batch,
+)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N
+    return make_mesh(N)
+
+
+def make_global_batch(n_rows, n_keys, seed=0, cap_per_dev=256):
+    rng = np.random.default_rng(seed)
+    g = rng.integers(0, n_keys, n_rows).astype(np.int64)
+    v = rng.integers(0, 100, n_rows).astype(np.int64)
+    block = HostBlock.from_columns(
+        {
+            "g": column_from_values(g.tolist(), INT64),
+            "v": column_from_values(v.tolist(), INT64),
+        }
+    )
+    batch = block_to_batch(block, cap_per_dev * N)
+    return batch, g, v
+
+
+def colfn(n):
+    return lambda b: b.cols[n]
+
+
+class TestRepartition:
+    def test_preserves_rows_and_colocates(self, mesh):
+        batch, g, v = make_global_batch(1000, 16)
+        sharded = shard_batch(batch, mesh)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
+        )
+        def step(b):
+            out, dropped = hash_repartition(b, colfn("g"), N, 512)
+            return out, dropped
+
+        out, dropped = step(sharded)
+        assert int(dropped) == 0
+        rv = np.asarray(out.row_valid)
+        gd = np.asarray(out.cols["g"].data)
+        vd = np.asarray(out.cols["v"].data)
+        # all rows survive with their values
+        got = sorted(zip(gd[rv].tolist(), vd[rv].tolist()))
+        exp = sorted(zip(g.tolist(), v.tolist()))
+        assert got == exp
+        # equal keys land on one device
+        per_dev = np.asarray(out.row_valid).reshape(N, -1)
+        gd2 = gd.reshape(N, -1)
+        seen = {}
+        for d in range(N):
+            for key in np.unique(gd2[d][per_dev[d]]):
+                assert seen.setdefault(int(key), d) == d
+
+    def test_overflow_detected(self, mesh):
+        batch, g, v = make_global_batch(1000, 1)  # all rows to one device
+        sharded = shard_batch(batch, mesh)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
+        )
+        def step(b):
+            out, dropped = hash_repartition(b, colfn("g"), N, 64)
+            return out, dropped
+
+        _out, dropped = step(sharded)
+        assert int(dropped) == 1000 - 64 * N or int(dropped) > 0
+
+
+class TestDistributedAgg:
+    def test_matches_single_device(self, mesh):
+        batch, g, v = make_global_batch(2000, 23, seed=3)
+        sharded = shard_batch(batch, mesh)
+        aggs = [
+            AggDesc("sum", colfn("v"), "s"),
+            AggDesc("count", None, "c"),
+            AggDesc("avg", colfn("v"), "m"),
+            AggDesc("min", colfn("v"), "lo"),
+            AggDesc("max", colfn("v"), "hi"),
+        ]
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
+        )
+        def step(b):
+            out, ng, dropped = distributed_group_aggregate(
+                b, [colfn("g")], aggs, 256, N, key_names=["g"]
+            )
+            return out, ng, dropped
+
+        out, ng, dropped = step(sharded)
+        assert int(dropped) == 0
+        rv = np.asarray(out.row_valid)
+        rows = {}
+        for i in np.nonzero(rv)[0]:
+            key = int(np.asarray(out.cols["g"].data)[i])
+            assert key not in rows, "group split across devices!"
+            rows[key] = (
+                int(np.asarray(out.cols["s"].data)[i]),
+                int(np.asarray(out.cols["c"].data)[i]),
+                float(np.asarray(out.cols["m"].data)[i]),
+                int(np.asarray(out.cols["lo"].data)[i]),
+                int(np.asarray(out.cols["hi"].data)[i]),
+            )
+        # golden
+        exp = {}
+        for key in np.unique(g):
+            m = g == key
+            exp[int(key)] = (
+                int(v[m].sum()), int(m.sum()), float(v[m].mean()),
+                int(v[m].min()), int(v[m].max()),
+            )
+        assert rows == exp
+
+    def test_scalar_agg(self, mesh):
+        batch, g, v = make_global_batch(500, 5, seed=4)
+        sharded = shard_batch(batch, mesh)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
+        )
+        def step(b):
+            return distributed_group_aggregate(b, [], [AggDesc("sum", colfn("v"), "s")], 64, N)
+
+        out, _ng, _dropped = step(sharded)
+        # replicated result: read shard 0 row 0
+        assert int(np.asarray(out.cols["s"].data)[0]) == int(v.sum())
+
+
+class TestDistributedJoin:
+    def _sides(self, seed=5):
+        rng = np.random.default_rng(seed)
+        bk = np.arange(64, dtype=np.int64)
+        bv = rng.integers(0, 1000, 64).astype(np.int64)
+        pk = rng.integers(0, 96, 800).astype(np.int64)
+        pv = rng.integers(0, 1000, 800).astype(np.int64)
+        build = block_to_batch(
+            HostBlock.from_columns(
+                {"bk": column_from_values(bk.tolist(), INT64),
+                 "bv": column_from_values(bv.tolist(), INT64)}
+            ),
+            32 * N,
+        )
+        probe = block_to_batch(
+            HostBlock.from_columns(
+                {"pk": column_from_values(pk.tolist(), INT64),
+                 "pv": column_from_values(pv.tolist(), INT64)}
+            ),
+            128 * N,
+        )
+        expected = sorted(
+            (int(k), int(pv[i]), int(bv[k]))
+            for i, k in enumerate(pk)
+            if k < 64
+        )
+        return build, probe, expected
+
+    def test_partitioned_join(self, mesh):
+        build, probe, expected = self._sides()
+        sb, sp = shard_batch(build, mesh), shard_batch(probe, mesh)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P(), P())
+        )
+        def step(b, p):
+            return partitioned_join(
+                p, b, colfn("pk"), colfn("bk"), N, 1024, 1024, "inner"
+            )
+
+        out, total, dropped = step(sb, sp)
+        assert int(dropped) == 0
+        assert int(total) == len(expected)
+        rv = np.asarray(out.row_valid)
+        got = sorted(
+            zip(
+                np.asarray(out.cols["pk"].data)[rv].tolist(),
+                np.asarray(out.cols["pv"].data)[rv].tolist(),
+                np.asarray(out.cols["bv"].data)[rv].tolist(),
+            )
+        )
+        assert got == expected
+
+    def test_broadcast_join(self, mesh):
+        build, probe, expected = self._sides(seed=6)
+        sb, sp = shard_batch(build, mesh), shard_batch(probe, mesh)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P())
+        )
+        def step(b, p):
+            return broadcast_join(b, p, colfn("bk"), colfn("pk"), 1024, "inner")
+
+        out, total = step(sb, sp)
+        assert int(total) == len(expected)
+        rv = np.asarray(out.row_valid)
+        got = sorted(
+            zip(
+                np.asarray(out.cols["pk"].data)[rv].tolist(),
+                np.asarray(out.cols["pv"].data)[rv].tolist(),
+                np.asarray(out.cols["bv"].data)[rv].tolist(),
+            )
+        )
+        assert got == expected

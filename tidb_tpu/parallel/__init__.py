@@ -1,0 +1,10 @@
+from tidb_tpu.parallel.mesh import make_mesh, shard_batch, unshard_batch  # noqa: F401
+from tidb_tpu.parallel.exchange import (  # noqa: F401
+    hash_repartition,
+    broadcast_gather,
+)
+from tidb_tpu.parallel.fragment import (  # noqa: F401
+    distributed_group_aggregate,
+    partitioned_join,
+    broadcast_join,
+)

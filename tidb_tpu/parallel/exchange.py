@@ -1,0 +1,116 @@
+"""Exchange operators: the MPP shuffle as XLA collectives.
+
+Reference: ExchangeSender/ExchangeReceiver with HashPartition / Broadcast /
+PassThrough types (pkg/planner/core/physical_plans.go:1706, executed by
+unistore's exchSenderExec/exchRecvExec over MPPDataPacket tunnels,
+cophandler/mpp_exec.go:597,711). The TPU formulation (SURVEY.md §2.7 —
+"the single most important mapping"):
+
+  HashPartition  -> per-device bucketization + lax.all_to_all over ICI
+  Broadcast      -> lax.all_gather of the (small) side
+  PassThrough    -> identity (results collected at the root host)
+
+All functions here run INSIDE shard_map: they see the per-device shard of
+a row-sharded Batch and use collectives over the mesh axis. Buckets have
+a static per-destination capacity; the true sent-row count is psum'd and
+returned so the host can detect overflow and retry at a larger tile
+(same pattern as the single-chip operators).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+_MIX = jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
+
+
+def _mix_hash(x: jax.Array) -> jax.Array:
+    """64-bit finalizer so small consecutive keys spread across devices."""
+    h = x.astype(jnp.int64) * _MIX
+    h = h ^ (h >> 29)
+    h = h * jnp.int64(-4658895280553007687)  # 0xBF58476D1CE4E5B9
+    h = h ^ (h >> 32)
+    return h & jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+
+def partition_of(key: DevCol, n: int) -> jax.Array:
+    """Destination device for each row; NULL keys all go to device 0
+    (they form one group / never match in joins, but must colocate)."""
+    h = _mix_hash(key.data) % n
+    return jnp.where(key.valid, h, 0)
+
+
+def hash_repartition(
+    batch: Batch,
+    key_fn: ExprFn,
+    n_devices: int,
+    bucket_capacity: int,
+    axis: str = "d",
+) -> Tuple[Batch, jax.Array]:
+    """Redistribute rows so equal keys colocate. Per-shard view:
+
+    1. target[i] = mix(key[i]) % n                  (hash partition fn)
+    2. sort rows by target; slot = rank within bucket
+    3. scatter into an [n, B] send buffer (overflow slots drop)
+    4. lax.all_to_all exchanges bucket j to device j
+    5. flatten received [n, B] to a new local batch of capacity n*B
+
+    Returns (new local batch, global count of dropped rows) — nonzero
+    drop means retry with a larger bucket_capacity.
+    """
+    n, B = n_devices, bucket_capacity
+    cap = batch.capacity
+    k = key_fn(batch)
+    target = partition_of(k, n)
+    # invalid rows go to a virtual overflow bucket n (never sent)
+    target = jnp.where(batch.row_valid, target, n)
+
+    sorted_t, perm = jax.lax.sort(
+        [target.astype(jnp.int32), jnp.arange(cap, dtype=jnp.int32)], num_keys=1
+    )
+    start = jnp.searchsorted(sorted_t, jnp.arange(n + 1, dtype=jnp.int32))
+    slot = jnp.arange(cap, dtype=jnp.int32) - start[jnp.clip(sorted_t, 0, n)]
+    fits = (slot < B) & (sorted_t < n)
+    buf_idx = jnp.clip(sorted_t, 0, n - 1) * B + jnp.clip(slot, 0, B - 1)
+
+    sent = jnp.sum(fits.astype(jnp.int64))
+    valid_rows = jnp.sum((target < n).astype(jnp.int64))
+    dropped = jax.lax.psum(valid_rows - sent, axis)
+
+    def scatter(arr: jax.Array) -> jax.Array:
+        src = arr[perm]
+        buf = jnp.zeros((n * B,), dtype=arr.dtype)
+        buf = buf.at[jnp.where(fits, buf_idx, n * B)].set(src, mode="drop")
+        return buf.reshape(n, B)
+
+    new_cols = {}
+    for name, c in batch.cols.items():
+        d = jax.lax.all_to_all(scatter(c.data), axis, 0, 0)
+        v = jax.lax.all_to_all(scatter(c.valid), axis, 0, 0)
+        new_cols[name] = DevCol(d.reshape(n * B), v.reshape(n * B))
+    rv_send = jnp.zeros((n * B,), dtype=jnp.bool_)
+    rv_send = rv_send.at[jnp.where(fits, buf_idx, n * B)].set(True, mode="drop")
+    rv = jax.lax.all_to_all(rv_send.reshape(n, B), axis, 0, 0).reshape(n * B)
+    return Batch(new_cols, rv), dropped
+
+
+def broadcast_gather(batch: Batch, axis: str = "d") -> Batch:
+    """Broadcast exchange: every device receives all rows (for small
+    build sides of joins — the reference's Broadcast ExchangeType)."""
+
+    def gather(arr: jax.Array) -> jax.Array:
+        g = jax.lax.all_gather(arr, axis)  # [n, cap]
+        return g.reshape(-1)
+
+    cols = {
+        name: DevCol(gather(c.data), gather(c.valid))
+        for name, c in batch.cols.items()
+    }
+    return Batch(cols, gather(batch.row_valid))

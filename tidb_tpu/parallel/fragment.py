@@ -1,0 +1,186 @@
+"""Distributed plan fragments: partial/final aggregation and joins.
+
+Reference: the MPP fragment execution model — plans cut at exchange
+boundaries (pkg/planner/core/fragment.go:47,149), HashAgg split into
+partial and final stages across the shuffle (the reference does the same
+split *within* one node via partial/final workers,
+aggregate/agg_hash_executor.go:60-91; MPP does it across nodes), and
+shuffled hash join (join keys hash-partitioned to colocate).
+
+Everything here runs inside shard_map over the mesh axis. The composition
+
+    scan shard -> filter -> partial agg -> all_to_all -> final agg
+
+is the TPU rendering of TiDB's canonical MPP pipeline
+TableScan -> Selection -> HashAgg(partial) -> ExchangeSender(hash) ->
+ExchangeReceiver -> HashAgg(final).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+from tidb_tpu.executor.aggregate import AggDesc, group_aggregate
+from tidb_tpu.executor.join import equi_join
+from tidb_tpu.parallel.exchange import broadcast_gather, hash_repartition
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+def _colfn(name: str) -> ExprFn:
+    return lambda b: b.cols[name]
+
+
+def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[str, str, List[str], int]]]:
+    """Split aggregates into partial-stage descriptors and final-stage
+    combine rules: (final func name, out name, partial col names, scale)."""
+    partial: List[AggDesc] = []
+    final: List[Tuple[str, str, List[str], int]] = []
+    for i, a in enumerate(aggs):
+        if a.func == "count":
+            pname = f"_p{i}"
+            partial.append(AggDesc("count", a.arg, pname))
+            final.append(("sum", a.out_name, [pname], 0))
+        elif a.func == "sum":
+            pname = f"_p{i}"
+            partial.append(AggDesc("sum", a.arg, pname))
+            final.append(("sum", a.out_name, [pname], 0))
+        elif a.func in ("min", "max"):
+            pname = f"_p{i}"
+            partial.append(AggDesc(a.func, a.arg, pname))
+            final.append((a.func, a.out_name, [pname], 0))
+        elif a.func == "avg":
+            sname, cname = f"_ps{i}", f"_pc{i}"
+            partial.append(AggDesc("sum", a.arg, sname))
+            partial.append(AggDesc("count", a.arg, cname))
+            final.append(("avg2", a.out_name, [sname, cname], a.arg_scale))
+        else:
+            raise NotImplementedError(f"distributed agg {a.func}")
+    return partial, final
+
+
+def distributed_group_aggregate(
+    local: Batch,
+    key_fns: Sequence[ExprFn],
+    aggs: Sequence[AggDesc],
+    group_capacity: int,
+    n_devices: int,
+    axis: str = "d",
+    key_names: Optional[Sequence[str]] = None,
+) -> Tuple[Batch, jax.Array, jax.Array]:
+    """Partial agg on each shard, hash-exchange of group rows, final agg.
+    Result: each device holds a disjoint subset of groups (hash-sharded),
+    padded to group_capacity. Returns (local result batch, global group
+    count upper bound, dropped row count from the exchange)."""
+    key_names = list(key_names or [f"k{i}" for i in range(len(key_fns))])
+    partial, final = _partial_descs(aggs)
+
+    part_batch, _ng = group_aggregate(local, key_fns, partial, group_capacity, key_names)
+
+    if key_fns:
+        # exchange partial groups so equal keys colocate
+        def exch_key(b: Batch) -> DevCol:
+            h = jnp.zeros(b.capacity, dtype=jnp.int64)
+            valid = jnp.ones(b.capacity, dtype=jnp.bool_)
+            for kn in key_names:
+                c = b.cols[kn]
+                h = h * jnp.int64(1000003) ^ c.data.astype(jnp.int64) * 2 + c.valid
+            return DevCol(h, valid)
+
+        exchanged, dropped = hash_repartition(
+            part_batch, exch_key, n_devices, group_capacity, axis
+        )
+    else:
+        # scalar agg: all partials to device 0 conceptually == all_gather
+        exchanged = broadcast_gather(part_batch, axis)
+        dropped = jnp.zeros((), jnp.int64)
+
+    fkeys = [_colfn(n) for n in key_names]
+    fdescs: List[AggDesc] = []
+    post_avg: List[Tuple[str, str, str, int]] = []
+    for func, out, pnames, scale in final:
+        if func == "avg2":
+            fdescs.append(AggDesc("sum", _colfn(pnames[0]), f"_fs_{out}"))
+            fdescs.append(AggDesc("sum", _colfn(pnames[1]), f"_fc_{out}"))
+            post_avg.append((out, f"_fs_{out}", f"_fc_{out}", scale))
+        else:
+            fdescs.append(AggDesc(func, _colfn(pnames[0]), out))
+    fin, ng = group_aggregate(exchanged, fkeys, fdescs, group_capacity, key_names)
+
+    cols = dict(fin.cols)
+    for out, sn, cn, scale in post_avg:
+        s, c = cols[sn], cols[cn]
+        denom = jnp.where(c.data == 0, 1, c.data).astype(jnp.float64)
+        if scale:
+            denom = denom * (10**scale)
+        cols[out] = DevCol(s.data.astype(jnp.float64) / denom, s.valid & (c.data > 0))
+    for out, sn, cn, _ in post_avg:
+        cols.pop(sn, None)
+        cols.pop(cn, None)
+
+    if not key_fns:
+        # scalar: every device now has all partials; result is replicated —
+        # keep it valid only on one logical row (row 0 of each shard; host
+        # reads shard 0).
+        pass
+
+    # pmax (not psum) for the scalar case: the broadcast made every shard
+    # compute the same single group; pmax also proves replication to jax.
+    total_groups = jax.lax.psum(ng, axis) if key_fns else jax.lax.pmax(ng, axis)
+    return Batch(cols, fin.row_valid), total_groups, dropped
+
+
+def partitioned_join(
+    left: Batch,
+    right: Batch,
+    left_key: ExprFn,
+    right_key: ExprFn,
+    n_devices: int,
+    bucket_capacity: int,
+    out_capacity: int,
+    join_type: str = "inner",
+    axis: str = "d",
+) -> Tuple[Batch, jax.Array, jax.Array]:
+    """Shuffled hash join: both sides hash-partitioned on the join key so
+    matching rows colocate, then a local join per device (the reference's
+    HashPartition MPP join). Returns (local join result, global true
+    output count, dropped exchange rows)."""
+    lex, d1 = hash_repartition(left, left_key, n_devices, bucket_capacity, axis)
+    rex, d2 = hash_repartition(right, right_key, n_devices, bucket_capacity, axis)
+    out, total = equi_join(
+        rex, lex, right_key_after(right_key), left_key_after(left_key),
+        out_capacity, join_type,
+    )
+    return out, jax.lax.psum(total, axis), d1 + d2
+
+
+def left_key_after(key_fn: ExprFn) -> ExprFn:
+    # keys are recomputable on the exchanged batch (same column names)
+    return key_fn
+
+
+def right_key_after(key_fn: ExprFn) -> ExprFn:
+    return key_fn
+
+
+def broadcast_join(
+    build: Batch,
+    probe: Batch,
+    build_key: ExprFn,
+    probe_key: ExprFn,
+    out_capacity: int,
+    join_type: str = "inner",
+    axis: str = "d",
+) -> Tuple[Batch, jax.Array]:
+    """Broadcast the (small) build side to every device, join locally with
+    the probe shard (the reference's Broadcast MPP join for small tables).
+    """
+    full_build = broadcast_gather(build, axis)
+    out, total = equi_join(
+        full_build, probe, build_key, probe_key, out_capacity, join_type
+    )
+    return out, jax.lax.psum(total, axis)
