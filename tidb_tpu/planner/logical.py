@@ -150,12 +150,12 @@ class Limit(LogicalPlan):
 # ---------------------------------------------------------------------------
 
 
-_GENSYM = [0]
-
-
-def gensym(prefix: str) -> str:
-    _GENSYM[0] += 1
-    return f"_{prefix}{_GENSYM[0]}"
+# Aggregate output columns use per-node-indexed names (_g0.., _a0..):
+# deterministic across parses (the plan cache fingerprints plan reprs,
+# pkg/planner/core/plan_cache.go analog) and collision-free because
+# aggregate outputs are always re-projected before meeting another
+# namespace (FROM-subqueries rename to alias.col; semi joins keep only
+# probe columns).
 
 
 class ExprBinder:
@@ -535,6 +535,77 @@ def build_select(
         plan,
         [(n, ColumnRef(type=e.type, name=n)) for n, e in proj_exprs],
     )
+    # column pruning over the finished tree (reference columnPruner)
+    plan = prune_plan(plan, {c.internal for c in plan.schema.cols})
+    return plan
+
+
+def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
+    """Column pruning (reference rule columnPruner, optimizer.go:98):
+    walk top-down with the set of internal names the parent needs; scans
+    read only referenced columns."""
+    from tidb_tpu.expression.expr import walk_columns
+
+    if isinstance(plan, Scan):
+        keep = [
+            n for n in plan.columns if f"{plan.alias}.{n}" in required
+        ] or plan.columns[:1]  # keep one column for row count
+        cols = [c for c in plan.schema.cols if c.name in keep]
+        return Scan(Schema(cols), plan.db, plan.table, plan.alias, keep)
+    if isinstance(plan, Selection):
+        need = set(required) | walk_columns(plan.predicate)
+        child = prune_plan(plan.child, need)
+        return Selection(child.schema, child, plan.predicate)
+    if isinstance(plan, Projection):
+        exprs = [(n, e) for n, e in plan.exprs if n in required] or plan.exprs[:1]
+        need = set()
+        for _n, e in exprs:
+            need |= walk_columns(e)
+        if plan.additive:
+            produced = {n for n, _ in plan.exprs}
+            need |= {r for r in required if r not in produced}
+        child = prune_plan(plan.child, need)
+        sch = Schema([c for c in plan.schema.cols if c.internal in required or c.internal in {n for n, _ in exprs}])
+        return Projection(sch, child, exprs, plan.additive)
+    if isinstance(plan, Aggregate):
+        need = set()
+        for _n, e in plan.group_exprs:
+            need |= walk_columns(e)
+        for _n, _f, a, _d in plan.aggs:
+            if a is not None:
+                need |= walk_columns(a)
+        child = prune_plan(plan.child, need)
+        return Aggregate(plan.schema, child, plan.group_exprs, plan.aggs)
+    if isinstance(plan, JoinPlan):
+        lcols = {c.internal for c in plan.left.schema.cols}
+        rcols = {c.internal for c in plan.right.schema.cols}
+        lneed = {r for r in required if r in lcols}
+        rneed = {r for r in required if r in rcols}
+        for le, re_ in plan.equi_keys:
+            lneed |= walk_columns(le)
+            rneed |= walk_columns(re_)
+        if plan.residual is not None:
+            res_cols = walk_columns(plan.residual)
+            lneed |= res_cols & lcols
+            rneed |= res_cols & rcols
+        left = prune_plan(plan.left, lneed)
+        right = prune_plan(plan.right, rneed)
+        if plan.kind in ("semi", "anti"):
+            sch = left.schema
+        else:
+            sch = Schema(list(left.schema.cols) + list(right.schema.cols))
+        return JoinPlan(
+            sch, plan.kind, left, right, plan.equi_keys, plan.residual, plan.null_aware
+        )
+    if isinstance(plan, Sort):
+        need = set(required)
+        for e, _d in plan.keys:
+            need |= walk_columns(e)
+        child = prune_plan(plan.child, need)
+        return Sort(child.schema, child, plan.keys)
+    if isinstance(plan, Limit):
+        child = prune_plan(plan.child, required)
+        return Limit(child.schema, child, plan.count, plan.offset)
     return plan
 
 
@@ -736,9 +807,7 @@ def _build_aggregate(b, plan, group_by, agg_calls):
     group_exprs: List[Tuple[str, Expr]] = []
     for i, g in enumerate(group_by):
         bound = binder.bind(g)
-        name = gensym("g")
-        # expose under the source column name when it's a plain column so
-        # ORDER BY / outer references resolve
+        name = f"_g{i}"
         group_exprs.append((name, bound))
         rewrite[_ast_key(g)] = (name, bound.type)
 
@@ -750,7 +819,7 @@ def _build_aggregate(b, plan, group_by, agg_calls):
         key = _ast_key(call)
         if key in rewrite:
             continue
-        name = gensym("a")
+        name = f"_a{len(aggs)}"
         arg = binder.bind(call.arg) if call.arg is not None else None
         if call.func == "count":
             t = INT64

@@ -245,6 +245,9 @@ class Session:
         if not isinstance(s.stmt, ast.Select):
             raise ValueError("EXPLAIN supports SELECT")
         plan = build_select(s.stmt, self.catalog, self.db, self._scalar_subquery)
+        if s.analyze:
+            _out, _dicts, lines = self.executor.run_analyze(plan)
+            return Result(["plan"], [(l,) for l in lines])
         lines = []
         _render_plan(plan, 0, lines)
         return Result(["plan"], [(l,) for l in lines])
