@@ -1,0 +1,158 @@
+"""Aux subsystem tests: sysvars, tracing, transactions, failpoints,
+ANALYZE, LOAD DATA (reference: pkg/sessionctx/variable tests, txntest,
+failpoint-enabled tests, statistics tests)."""
+
+import math
+import os
+import tempfile
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("create table t (a bigint, b varchar(10))")
+    sess.execute("insert into t values (1, 'x'), (2, 'y'), (2, 'z')")
+    return sess
+
+
+class TestSysVars:
+    def test_set_and_select(self, s):
+        s.execute("set tidb_mem_quota_query = 1073741824")
+        r = s.must_query("select @@tidb_mem_quota_query")
+        assert r.rows == [(1073741824,)]
+
+    def test_global_vs_session(self, s):
+        s2 = Session(s.catalog)
+        s.execute("set global tidb_tpu_group_capacity = 2048")
+        assert s2.must_query("select @@tidb_tpu_group_capacity").rows == [(2048,)]
+        s2.execute("set tidb_tpu_group_capacity = 512")
+        assert s2.must_query("select @@tidb_tpu_group_capacity").rows == [(512,)]
+        assert s.must_query("select @@tidb_tpu_group_capacity").rows == [(2048,)]
+
+    def test_validation(self, s):
+        with pytest.raises(Exception):
+            s.execute("set tidb_mem_quota_query = 1")
+        with pytest.raises(Exception):
+            s.execute("set version = 'nope'")
+
+    def test_show_variables_like(self, s):
+        r = s.must_query("show variables like 'tidb_tpu%'")
+        names = [row[0] for row in r.rows]
+        assert "tidb_tpu_min_tile" in names and "tidb_tpu_group_capacity" in names
+
+    def test_tableless_select(self, s):
+        r = s.must_query("select 1 + 2, 'const' = 'const', @@version_comment")
+        assert r.rows[0][0] == 3
+
+
+class TestTrace:
+    def test_trace_select(self, s):
+        r = s.execute("trace select count(*) from t")
+        ops = [row[0].strip() for row in r.rows]
+        assert any("plan" in o for o in ops)
+        assert any("run" in o or "execute" in o for o in ops)
+
+
+class TestTxn:
+    def test_read_own_writes_and_commit(self, s):
+        s.execute("begin")
+        s.execute("insert into t values (9, 'w')")
+        assert s.must_query("select count(*) from t").rows == [(4,)]
+        # another session must not see it yet
+        s2 = Session(s.catalog)
+        assert s2.must_query("select count(*) from t").rows == [(3,)]
+        s.execute("commit")
+        assert s2.must_query("select count(*) from t").rows == [(4,)]
+
+    def test_rollback(self, s):
+        s.execute("begin")
+        s.execute("delete from t where a = 1")
+        assert s.must_query("select count(*) from t").rows == [(2,)]
+        s.execute("rollback")
+        assert s.must_query("select count(*) from t").rows == [(3,)]
+
+    def test_repeatable_read(self, s):
+        s.execute("begin")
+        assert s.must_query("select count(*) from t").rows == [(3,)]
+        s2 = Session(s.catalog)
+        s2.execute("insert into t values (7, 'q')")
+        # snapshot: still 3 inside the txn
+        assert s.must_query("select count(*) from t").rows == [(3,)]
+        s.execute("commit")
+        assert s.must_query("select count(*) from t").rows == [(4,)]
+
+    def test_write_conflict(self, s):
+        s.execute("begin")
+        s.execute("insert into t values (5, 'c')")
+        s2 = Session(s.catalog)
+        s2.execute("insert into t values (6, 'd')")
+        with pytest.raises(RuntimeError, match="conflict"):
+            s.execute("commit")
+
+
+class TestFailpoint:
+    def test_inject_error(self, s):
+        failpoint.enable("session/before-commit", RuntimeError("boom"))
+        try:
+            s.execute("begin")
+            s.execute("insert into t values (8, 'f')")
+            with pytest.raises(RuntimeError, match="boom"):
+                s.execute("commit")
+        finally:
+            failpoint.disable_all()
+
+
+class TestAnalyze:
+    def test_analyze_table(self, s):
+        s.execute("analyze table t")
+        t = s.catalog.table("test", "t")
+        st = t.stats["a"]
+        assert st.row_count == 3 and st.ndv == 2 and st.null_count == 0
+        assert st.min_val == 1 and st.max_val == 2
+        top = dict(t.stats["b"].topn)
+        assert top == {"x": 1, "y": 1, "z": 1}
+
+    def test_analyze_with_nulls(self, s):
+        s.execute("insert into t values (null, null)")
+        s.execute("analyze table t")
+        st = s.catalog.table("test", "t").stats["a"]
+        assert st.null_count == 1 and st.ndv == 2
+
+
+class TestLoadData:
+    def test_load_tsv(self, s):
+        with tempfile.NamedTemporaryFile("w", suffix=".tsv", delete=False) as f:
+            f.write("10\thello\n11\tworld\n\\N\tnullrow\n")
+            path = f.name
+        try:
+            r = s.execute(f"load data infile '{path}' into table t")
+            assert r.affected == 3
+            rows = s.must_query("select a, b from t where b in ('hello','world','nullrow') order by b").rows
+            assert rows == [(10, "hello"), (None, "nullrow"), (11, "world")]
+        finally:
+            os.unlink(path)
+
+    def test_load_pipe_sep(self, s):
+        with tempfile.NamedTemporaryFile("w", suffix=".tbl", delete=False) as f:
+            f.write("20|pipe|\n")  # dbgen trailing separator
+            path = f.name
+        try:
+            r = s.execute(
+                f"load data infile '{path}' into table t fields terminated by '|'"
+            )
+            assert r.affected == 1
+            assert s.must_query("select a from t where b = 'pipe'").rows == [(20,)]
+        finally:
+            os.unlink(path)
+
+
+class TestExplainAnalyze:
+    def test_explain_analyze(self, s):
+        r = s.execute("explain analyze select b, count(*) from t group by b")
+        text = "\n".join(row[0] for row in r.rows)
+        assert "Aggregate" in text and "rows=" in text and "time=" in text

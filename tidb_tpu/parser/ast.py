@@ -192,5 +192,42 @@ class Explain:
 
 @dataclasses.dataclass
 class Show:
-    what: str  # "tables" | "databases"
-    db: Optional[str] = None
+    what: str  # "tables" | "databases" | "variables"
+    db: Optional[str] = None  # for variables: LIKE pattern
+
+
+@dataclasses.dataclass
+class SetVariable:
+    name: str
+    value: object
+    scope: str = "session"
+
+
+@dataclasses.dataclass
+class SysVarRef:
+    name: str
+    scope: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Trace:
+    stmt: object
+
+
+@dataclasses.dataclass
+class TxnControl:
+    op: str  # begin | commit | rollback
+
+
+@dataclasses.dataclass
+class AnalyzeTable:
+    db: Optional[str]
+    name: str
+
+
+@dataclasses.dataclass
+class LoadData:
+    db: Optional[str]
+    table: str
+    path: str
+    sep: str = "\t"

@@ -28,6 +28,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
   | (?P<bq>`[^`]*`)
+  | (?P<sysvar>@@[A-Za-z_][A-Za-z0-9_.$]*)
   | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>])
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
     """,
@@ -44,6 +45,9 @@ KEYWORDS = {
     "delete", "update", "set", "use", "explain", "analyze", "show",
     "tables", "databases", "if", "primary", "key", "div", "mod",
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
+    "global", "session", "variables", "trace", "begin", "commit",
+    "rollback", "start", "transaction", "analyze", "load", "data",
+    "infile", "fields", "terminated", "lines", "ignore", "rows",
 }
 
 
@@ -73,6 +77,8 @@ def tokenize(sql: str) -> List[Token]:
         kind = m.lastgroup
         if kind == "bq":
             out.append(Token("id", text[1:-1], m.start()))
+        elif kind == "sysvar":
+            out.append(Token("sysvar", text[2:], m.start()))
         elif kind == "id":
             low = text.lower()
             out.append(Token("kw" if low in KEYWORDS else "id", low if low in KEYWORDS else text, m.start()))
@@ -170,8 +176,105 @@ class Parser:
                 return ast.Show("tables")
             if self.accept_kw("databases"):
                 return ast.Show("databases")
-            raise ParseError("SHOW supports TABLES | DATABASES")
+            if self.accept_kw("global"):
+                self.expect_kw("variables")
+                return ast.Show("variables", db=self._show_like())
+            if self.accept_kw("session"):
+                self.expect_kw("variables")
+                return ast.Show("variables", db=self._show_like())
+            if self.accept_kw("variables"):
+                return ast.Show("variables", db=self._show_like())
+            raise ParseError("SHOW supports TABLES | DATABASES | VARIABLES")
+        if self.at_kw("set"):
+            return self.parse_set()
+        if self.at_kw("trace"):
+            self.advance()
+            return ast.Trace(self.parse_stmt())
+        if self.at_kw("begin"):
+            self.advance()
+            return ast.TxnControl("begin")
+        if self.at_kw("start"):
+            self.advance()
+            self.expect_kw("transaction")
+            return ast.TxnControl("begin")
+        if self.at_kw("commit"):
+            self.advance()
+            return ast.TxnControl("commit")
+        if self.at_kw("rollback"):
+            self.advance()
+            return ast.TxnControl("rollback")
+        if self.at_kw("analyze"):
+            self.advance()
+            self.expect_kw("table")
+            db, name = self._qualified_name()
+            return ast.AnalyzeTable(db, name)
+        if self.at_kw("load"):
+            return self.parse_load()
         raise ParseError(f"unsupported statement start {self.cur.text!r}")
+
+    def _show_like(self):
+        if self.accept_kw("like"):
+            t = self.cur
+            if t.kind != "str":
+                raise ParseError("SHOW VARIABLES LIKE expects a string")
+            self.advance()
+            return t.text
+        return None
+
+    def parse_set(self):
+        self.expect_kw("set")
+        scope = "session"
+        if self.accept_kw("global"):
+            scope = "global"
+        else:
+            self.accept_kw("session")
+        name = self._set_var_name()
+        self.expect_op("=")
+        val = self.parse_expr()
+        if not isinstance(val, ast.Const):
+            if isinstance(val, ast.Name):  # bareword values like utf8mb4
+                val = ast.Const(val.column)
+            elif isinstance(val, ast.Call) and val.op == "neg" and isinstance(val.args[0], ast.Const):
+                val = ast.Const(-val.args[0].value)
+            else:
+                raise ParseError("SET value must be a literal")
+        return ast.SetVariable(name, val.value, scope)
+
+    def _set_var_name(self) -> str:
+        # @@[global.|session.]name or bare name
+        t = self.cur
+        if t.kind == "sysvar":
+            self.advance()
+            rest = t.text
+            for pre in ("global.", "session."):
+                if rest.lower().startswith(pre):
+                    return rest[len(pre):]
+            return rest
+        return self.expect_ident()
+
+    def parse_load(self):
+        self.expect_kw("load")
+        self.expect_kw("data")
+        self.accept_kw("local")
+        self.expect_kw("infile")
+        t = self.cur
+        if t.kind != "str":
+            raise ParseError("LOAD DATA INFILE expects a path string")
+        self.advance()
+        path = t.text
+        self.expect_kw("into")
+        self.expect_kw("table")
+        db, name = self._qualified_name()
+        sep = "\t"
+        if self.accept_kw("fields"):
+            self.expect_kw("terminated")
+            self.expect_kw("by")
+            st = self.cur
+            if st.kind != "str":
+                raise ParseError("FIELDS TERMINATED BY expects a string")
+            self.advance()
+            sep = st.text
+        return ast.LoadData(db, name, path, sep)
 
     # -- SELECT ------------------------------------------------------------
     def parse_select(self) -> ast.Select:
@@ -419,6 +522,14 @@ class Parser:
 
     def parse_primary(self):
         t = self.cur
+        if t.kind == "sysvar":
+            self.advance()
+            rest = t.text
+            scope = None
+            for pre in ("global.", "session."):
+                if rest.lower().startswith(pre):
+                    scope, rest = pre[:-1], rest[len(pre):]
+            return ast.SysVarRef(rest, scope)
         if t.kind == "num":
             self.advance()
             if re.fullmatch(r"\d+", t.text):

@@ -400,7 +400,8 @@ def build_select(
     b = SelectBuilder(catalog, current_db, subquery_value_fn)
 
     if sel.from_ is None:
-        return _build_tableless(sel, subquery_value_fn)
+        # tableless SELECT is evaluated on the host by the session layer
+        raise PlanError("tableless SELECT handled by session")
 
     plan = b.build_from(sel.from_)
 

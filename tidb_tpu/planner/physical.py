@@ -115,8 +115,11 @@ class PlanCompiler:
     of EXPLAIN ANALYZE (reference RuntimeStatsColl,
     pkg/util/execdetails/execdetails.go:1273)."""
 
-    def __init__(self, catalog, instrument: bool = False):
+    def __init__(self, catalog, instrument: bool = False, resolver=None):
         self.catalog = catalog
+        self.resolver = resolver or (
+            lambda db, tbl: (catalog.table(db, tbl), catalog.table(db, tbl).version)
+        )
         self._next_id = 0
         self.scans: List[ScanSite] = []
         self.sized: List[int] = []
@@ -176,7 +179,7 @@ class PlanCompiler:
             self.scans.append(
                 ScanSite(nid, plan.db, plan.table, plan.alias, plan.columns)
             )
-            t = self.catalog.table(plan.db, plan.table)
+            t, _v = self.resolver(plan.db, plan.table)
             dicts = {
                 f"{plan.alias}.{n}": d
                 for n, d in t.dictionaries.items()
@@ -414,6 +417,15 @@ class PhysicalExecutor:
         self.catalog = catalog
         # fingerprint + versions -> CompiledQuery
         self._cache: Dict[tuple, CompiledQuery] = {}
+        # session hook: (db, table) -> (Table, version) — lets snapshot
+        # transactions pin versions / substitute shadow tables.
+        self.table_hook = None
+
+    def _resolve(self, db: str, table: str):
+        if self.table_hook is not None:
+            return self.table_hook(db, table)
+        t = self.catalog.table(db, table)
+        return t, t.version
 
     def _cache_key(self, plan: L.LogicalPlan) -> tuple:
         fp = plan_fingerprint(plan)
@@ -421,7 +433,8 @@ class PhysicalExecutor:
 
         def walk(p):
             if isinstance(p, L.Scan):
-                versions.append((p.db, p.table, self.catalog.table(p.db, p.table).version))
+                t, v = self._resolve(p.db, p.table)
+                versions.append((p.db, p.table, id(t), v))
             for attr in ("child", "left", "right"):
                 c = getattr(p, attr, None)
                 if c is not None:
@@ -433,8 +446,8 @@ class PhysicalExecutor:
     def _fetch_inputs(self, cq: CompiledQuery) -> Dict[int, Batch]:
         inputs = {}
         for s in cq.scans:
-            t = self.catalog.table(s.db, s.table)
-            batch, _d = scan_table(t, s.columns)
+            t, v = self._resolve(s.db, s.table)
+            batch, _d = scan_table(t, s.columns, version=v)
             inputs[s.node_id] = batch
         return inputs
 
@@ -460,7 +473,7 @@ class PhysicalExecutor:
         key = self._cache_key(plan)
         cq = self._cache.get(key)
         if cq is None:
-            compiler = PlanCompiler(self.catalog)
+            compiler = PlanCompiler(self.catalog, resolver=self._resolve)
             cq = compiler.compile(plan)
             if len(self._cache) > 256:
                 self._cache.clear()
@@ -485,7 +498,7 @@ class PhysicalExecutor:
 
     def run_analyze(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts, List[str]]:
         """EXPLAIN ANALYZE: instrumented single run with per-node stats."""
-        compiler = PlanCompiler(self.catalog, instrument=True)
+        compiler = PlanCompiler(self.catalog, instrument=True, resolver=self._resolve)
         cq = compiler.compile(plan)
         inputs = self._fetch_inputs(cq)
         out, _caps = self._discover(cq, inputs)

@@ -41,6 +41,86 @@ class Session:
         self.catalog = catalog or Catalog()
         self.db = db
         self.executor = PhysicalExecutor(self.catalog)
+        from tidb_tpu.utils import SysVars, Tracer
+
+        if not hasattr(self.catalog, "global_sysvars"):
+            self.catalog.global_sysvars = {}
+        self.vars = SysVars(self.catalog.global_sysvars)
+        self.tracer = Tracer()
+        # Snapshot transaction state (reference: LazyTxn pkg/session/txn.go:50
+        # buffering writes in a memdb; here a shadow Table per written table
+        # gives read-your-own-writes, and commit swaps blocks in after an
+        # optimistic version check — first committer wins, the analog of
+        # 2PC prewrite conflict detection).
+        self._txn = None
+        self.executor.table_hook = self._resolve_table_for_read
+
+    # -- transaction plumbing ------------------------------------------
+    def _resolve_table_for_read(self, db: str, name: str):
+        """Returns (table, version) the executor should scan."""
+        t = self.catalog.table(db, name)
+        if self._txn is None:
+            return t, t.version
+        key = (db.lower(), name.lower())
+        shadow = self._txn["shadows"].get(key)
+        if shadow is not None:
+            return shadow, shadow.version
+        pinned = self._txn["pins"].setdefault(key, t.version)
+        return t, pinned
+
+    def _resolve_table_for_write(self, db: str, name: str):
+        t = self.catalog.table(db, name)
+        if self._txn is None:
+            return t
+        key = (db.lower(), name.lower())
+        shadow = self._txn["shadows"].get(key)
+        if shadow is None:
+            from tidb_tpu.storage.table import Table
+
+            pinned = self._txn["pins"].setdefault(key, t.version)
+            shadow = Table(t.name, t.schema)
+            shadow._versions = {0: list(t.blocks(pinned))}
+            shadow.dictionaries = dict(t.dictionaries)
+            self._txn["shadows"][key] = shadow
+            self._txn["base_versions"][key] = t.version
+        return shadow
+
+    def _run_txn_control(self, s) -> Result:
+        from tidb_tpu.utils import failpoint
+
+        if s.op == "begin":
+            if self._txn is not None:
+                self._commit_txn()  # MySQL: BEGIN implicitly commits
+            self._txn = {"pins": {}, "shadows": {}, "base_versions": {}}
+        elif s.op == "commit":
+            self._commit_txn()
+        elif s.op == "rollback":
+            self._txn = None
+        return Result([], [])
+
+    def _commit_txn(self) -> None:
+        from tidb_tpu.utils import failpoint
+
+        if self._txn is None:
+            return
+        txn, self._txn = self._txn, None
+        failpoint.inject("session/before-commit")
+        # optimistic conflict check then swap (first committer wins)
+        for key, shadow in txn["shadows"].items():
+            db, name = key
+            base = self.catalog.table(db, name)
+            if base.version != txn["base_versions"][key]:
+                raise RuntimeError(
+                    f"write conflict on {db}.{name}: "
+                    "table changed since transaction start"
+                )
+        for key, shadow in txn["shadows"].items():
+            db, name = key
+            base = self.catalog.table(db, name)
+            base.replace_blocks(shadow.blocks())
+            base.dictionaries = shadow.dictionaries
+        if txn["shadows"]:
+            clear_scan_cache()
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -98,14 +178,123 @@ class Session:
         elif isinstance(s, ast.Explain):
             r = self._run_explain(s)
         elif isinstance(s, ast.Show):
-            if s.what == "tables":
-                r = Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
-            else:
-                r = Result(["Databases"], [(d,) for d in self.catalog.databases()])
+            r = self._run_show(s)
+        elif isinstance(s, ast.SetVariable):
+            self.vars.set(s.name, s.value, s.scope)
+            r = Result([], [])
+        elif isinstance(s, ast.Trace):
+            self.tracer.enabled = True
+            self.tracer.reset()
+            try:
+                with self.tracer.span("execute"):
+                    self._execute_stmt(s.stmt)
+            finally:
+                self.tracer.enabled = False
+            r = Result(["operation", "startTS", "duration"], self.tracer.rows())
+        elif isinstance(s, ast.TxnControl):
+            r = self._run_txn_control(s)
+        elif isinstance(s, ast.AnalyzeTable):
+            r = self._run_analyze_table(s)
+        elif isinstance(s, ast.LoadData):
+            r = self._run_load_data(s)
         else:
             raise ValueError(f"unsupported statement {type(s).__name__}")
         r.elapsed_s = time.perf_counter() - t0
         return r
+
+    # ------------------------------------------------------------------
+    def _run_show(self, s: ast.Show) -> Result:
+        if s.what == "tables":
+            return Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
+        if s.what == "databases":
+            return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        # variables
+        import fnmatch
+
+        pat = s.db
+        rows = []
+        for name, val in self.vars.all().items():
+            if pat is None or fnmatch.fnmatch(name, pat.replace("%", "*")):
+                if isinstance(val, bool):
+                    val = "ON" if val else "OFF"
+                rows.append((name, str(val)))
+        return Result(["Variable_name", "Value"], rows)
+
+    def _run_analyze_table(self, s: ast.AnalyzeTable) -> Result:
+        from tidb_tpu.stats import analyze_table
+
+        t = self.catalog.table(s.db or self.db, s.name)
+        analyze_table(t)
+        return Result([], [])
+
+    def _run_load_data(self, s: ast.LoadData) -> Result:
+        t = self._resolve_table_for_write(s.db or self.db, s.table)
+        from tidb_tpu.storage.loader import load_file
+
+        n = load_file(t, s.path, sep=s.sep)
+        clear_scan_cache()
+        return Result([], [], affected=n)
+
+    def _eval_const_expr(self, e):
+        """Host evaluation for tableless SELECTs (reference: expression
+        folding in the projection over a one-row dual table)."""
+        if isinstance(e, ast.Const):
+            return e.value
+        if isinstance(e, ast.SysVarRef):
+            v = self.vars.get(e.name)
+            return ("ON" if v else "OFF") if isinstance(v, bool) else v
+        if isinstance(e, ast.SubqueryExpr) and e.modifier is None:
+            from tidb_tpu.expression.expr import Literal
+
+            lit = self._scalar_subquery(e.query)
+            return lit.value
+        if isinstance(e, ast.Call):
+            args = [self._eval_const_expr(a) for a in e.args]
+            if any(a is None for a in args) and e.op not in ("isnull", "isnotnull", "coalesce"):
+                return None
+            import operator as op_
+
+            table = {
+                "add": op_.add, "sub": op_.sub, "mul": op_.mul,
+                "eq": op_.eq, "ne": op_.ne, "lt": op_.lt, "le": op_.le,
+                "gt": op_.gt, "ge": op_.ge,
+            }
+            if e.op in table:
+                return table[e.op](args[0], args[1])
+            if e.op == "div":
+                return None if args[1] in (0, None) else args[0] / args[1]
+            if e.op == "neg":
+                return -args[0]
+            if e.op == "not":
+                return not args[0]
+            if e.op in ("and",):
+                return bool(args[0]) and bool(args[1])
+            if e.op in ("or",):
+                return bool(args[0]) or bool(args[1])
+            if e.op == "coalesce":
+                return next((a for a in args if a is not None), None)
+            if e.op == "isnull":
+                return args[0] is None
+            if e.op == "isnotnull":
+                return args[0] is not None
+            if e.op == "cast":
+                return args[0]
+        raise ValueError(f"cannot evaluate {e!r} without a table")
+
+    def _run_tableless(self, s: ast.Select) -> Result:
+        names = []
+        vals = []
+        for i, it in enumerate(s.items):
+            from tidb_tpu.planner.logical import _display_name
+
+            names.append(it.alias or _display_name(it.expr))
+            vals.append(self._eval_const_expr(it.expr))
+        rows = [tuple(vals)]
+        if s.where is not None and not self._eval_const_expr(s.where):
+            rows = []
+        if s.limit is not None:
+            rows = rows[s.offset or 0 : (s.offset or 0) + s.limit]
+        return Result(names, rows)
 
     # ------------------------------------------------------------------
     def _scalar_subquery(self, q: ast.Select):
@@ -122,10 +311,17 @@ class Session:
         return Literal(value=r.rows[0][0])
 
     def _run_select(self, s: ast.Select) -> Result:
-        plan = build_select(s, self.catalog, self.db, self._scalar_subquery)
-        batch, dicts = self.executor.run(plan)
+        if s.from_ is None:
+            return self._run_tableless(s)
+        # spans mirror the reference's (session.ExecuteStmt ->
+        # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
+        with self.tracer.span("session.plan"):
+            plan = build_select(s, self.catalog, self.db, self._scalar_subquery)
+        with self.tracer.span("executor.run"):
+            batch, dicts = self.executor.run(plan)
         types = {c.internal: c.type for c in plan.schema}
-        block = batch_to_block(batch, types, dicts)
+        with self.tracer.span("session.materialize"):
+            block = batch_to_block(batch, types, dicts)
         names = [c.name for c in plan.schema]
         internals = [c.internal for c in plan.schema]
         decoded = {i: block.columns[i].decode() for i in internals}
@@ -136,7 +332,7 @@ class Session:
 
     # ------------------------------------------------------------------
     def _run_insert(self, s: ast.Insert) -> Result:
-        t = self.catalog.table(s.db or self.db, s.table)
+        t = self._resolve_table_for_write(s.db or self.db, s.table)
         names = t.schema.names
         cols = [c.lower() for c in s.columns] if s.columns else names
         unknown = set(cols) - set(names)
@@ -161,7 +357,7 @@ class Session:
         raise ValueError("INSERT VALUES must be literals")
 
     def _run_delete(self, s: ast.Delete) -> Result:
-        t = self.catalog.table(s.db or self.db, s.table)
+        t = self._resolve_table_for_write(s.db or self.db, s.table)
         blocks = t.blocks()
         if s.where is None:
             affected = t.nrows
@@ -174,7 +370,7 @@ class Session:
         return Result([], [], affected=affected)
 
     def _run_update(self, s: ast.Update) -> Result:
-        t = self.catalog.table(s.db or self.db, s.table)
+        t = self._resolve_table_for_write(s.db or self.db, s.table)
         # evaluate via a SELECT of all columns with updated expressions,
         # then rewrite the table (columnar copy-on-write update).
         alias = t.name

@@ -1,0 +1,1 @@
+from tidb_tpu.stats.collect import ColumnStats, analyze_table  # noqa: F401

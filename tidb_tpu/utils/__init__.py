@@ -1,0 +1,4 @@
+from tidb_tpu.utils.sysvar import SysVars, SYSVAR_DEFS  # noqa: F401
+from tidb_tpu.utils.memtrack import MemoryTracker, QuotaExceeded  # noqa: F401
+from tidb_tpu.utils.tracing import Tracer, span  # noqa: F401
+from tidb_tpu.utils import failpoint  # noqa: F401

@@ -1,0 +1,56 @@
+"""Failpoint-style fault injection.
+
+Reference: pingcap/failpoint with 587 inject sites enabled by code
+rewrite (Makefile failpoint-enable) + kv.FaultInjectedStore
+(pkg/kv/fault_injection.go). Python needs no rewrite step: `inject(name)`
+is a no-op unless a test enabled the failpoint, in which case it raises,
+returns a value, or calls a hook — the same three actions the reference's
+`failpoint.Inject` callbacks implement.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+_lock = threading.Lock()
+_active: Dict[str, object] = {}
+
+
+class FailpointError(RuntimeError):
+    pass
+
+
+def enable(name: str, action: object) -> None:
+    """action: an Exception instance/class to raise, a callable hook, or
+    a value to return from inject()."""
+    with _lock:
+        _active[name] = action
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+def disable_all() -> None:
+    with _lock:
+        _active.clear()
+
+
+def inject(name: str, default=None):
+    """Call at a site. Returns `default` (or the enabled value)."""
+    action = _active.get(name)
+    if action is None:
+        return default
+    if isinstance(action, type) and issubclass(action, BaseException):
+        raise action(f"failpoint {name}")
+    if isinstance(action, BaseException):
+        raise action
+    if callable(action):
+        return action()
+    return action
+
+
+def is_enabled(name: str) -> bool:
+    return name in _active

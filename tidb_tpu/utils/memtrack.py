@@ -1,0 +1,73 @@
+"""Per-query memory accounting with a quota.
+
+Reference: pkg/util/memory — Tracker tree (tracker.go:74) with an
+ActionOnExceed escalation chain (action.go:30) that spills or cancels.
+On TPU all intermediate sizes are STATIC at compile time (capacity tiles
+x dtype widths), so instead of runtime tracking we *pre-account* every
+node's output bytes during plan compilation and reject/shrink before
+launching — an admission-control formulation of the same contract. The
+escalation chain maps to: (1) try smaller capacity tiles, (2) fail the
+query with a quota error (the reference's cancel action); host-RAM
+staging (the spill analog) is the planned escape hatch for oversized
+sorts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+class QuotaExceeded(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class MemoryTracker:
+    label: str
+    quota_bytes: Optional[int] = None
+    consumed: int = 0
+    peak: int = 0
+    children: List["MemoryTracker"] = dataclasses.field(default_factory=list)
+    parent: Optional["MemoryTracker"] = None
+
+    def child(self, label: str) -> "MemoryTracker":
+        c = MemoryTracker(label, parent=self)
+        self.children.append(c)
+        return c
+
+    def consume(self, nbytes: int) -> None:
+        t = self
+        while t is not None:
+            t.consumed += nbytes
+            t.peak = max(t.peak, t.consumed)
+            if t.quota_bytes is not None and t.consumed > t.quota_bytes:
+                raise QuotaExceeded(
+                    f"memory quota exceeded at {t.label}: "
+                    f"{t.consumed} > {t.quota_bytes} bytes"
+                )
+            t = t.parent
+
+    def release(self, nbytes: int) -> None:
+        t = self
+        while t is not None:
+            t.consumed -= nbytes
+            t = t.parent
+
+    def report(self, depth: int = 0) -> List[str]:
+        lines = [
+            "  " * depth
+            + f"{self.label}: peak={self.peak} consumed={self.consumed}"
+            + (f" quota={self.quota_bytes}" if self.quota_bytes else "")
+        ]
+        for c in self.children:
+            lines.extend(c.report(depth + 1))
+        return lines
+
+
+def batch_bytes(capacity: int, col_dtypes: Dict[str, object]) -> int:
+    """Static size of a Batch: data + validity per column + row mask."""
+    total = capacity  # row_valid
+    for dt in col_dtypes.values():
+        total += capacity * (getattr(dt, "itemsize", 8) + 1)
+    return total

@@ -1,0 +1,114 @@
+"""System variables with SESSION/GLOBAL scope.
+
+Reference: pkg/sessionctx/variable (444 sysvars, sysvar.go definitions
+with scopes, validation and setter hooks; globals persisted in
+mysql.global_variables). This engine defines the subset that has meaning
+on TPU — memory quota, capacity-tile policy, mesh knobs — plus MySQL
+compatibility variables the wire protocol needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class SysVarDef:
+    name: str
+    default: object
+    scope: str = "both"  # session | global | both | readonly
+    validate: Optional[Callable[[object], object]] = None
+    description: str = ""
+
+
+def _int_range(lo, hi):
+    def v(x):
+        x = int(x)
+        if not lo <= x <= hi:
+            raise ValueError(f"value {x} out of range [{lo},{hi}]")
+        return x
+
+    return v
+
+
+def _bool(x):
+    if isinstance(x, str):
+        return x.strip().lower() in ("1", "on", "true", "yes")
+    return bool(x)
+
+
+SYSVAR_DEFS: Dict[str, SysVarDef] = {
+    v.name: v
+    for v in [
+        # engine knobs (analogs of tidb_vars.go entries)
+        SysVarDef("tidb_mem_quota_query", 8 << 30, "both", _int_range(16 << 20, 1 << 40),
+                  "per-query device-memory budget in bytes (reference tidb_mem_quota_query)"),
+        SysVarDef("tidb_tpu_min_tile", 256, "both", _int_range(64, 1 << 22),
+                  "smallest row-capacity tile (reference paging min size, paging.go:25)"),
+        SysVarDef("tidb_tpu_group_capacity", 1024, "both", _int_range(16, 1 << 24),
+                  "initial group-table capacity before overflow retry"),
+        SysVarDef("tidb_allow_mpp", True, "both", _bool,
+                  "allow multi-device fragment plans (reference tidb_allow_mpp)"),
+        SysVarDef("tidb_broadcast_join_threshold_size", 1 << 20, "both", _int_range(0, 1 << 34),
+                  "max build-side bytes for broadcast (vs hash-partition) joins"),
+        SysVarDef("tidb_executor_concurrency", 1, "both", _int_range(1, 256),
+                  "accepted for compatibility; device kernels are already parallel"),
+        SysVarDef("tidb_enable_plan_cache", True, "both", _bool,
+                  "cache jitted plans keyed by fingerprint + shapes"),
+        # MySQL compatibility
+        SysVarDef("autocommit", True, "both", _bool),
+        SysVarDef("sql_mode", "STRICT_TRANS_TABLES", "both"),
+        SysVarDef("time_zone", "UTC", "both"),
+        SysVarDef("max_allowed_packet", 64 << 20, "both", _int_range(1024, 1 << 30)),
+        SysVarDef("version", "8.0.11-tidb-tpu-0.1.0", "readonly"),
+        SysVarDef("version_comment", "tidb_tpu TPU-native SQL engine", "readonly"),
+        SysVarDef("character_set_connection", "utf8mb4", "both"),
+        SysVarDef("collation_connection", "utf8mb4_bin", "both"),
+        SysVarDef("tx_isolation", "REPEATABLE-READ", "both"),
+        SysVarDef("transaction_isolation", "REPEATABLE-READ", "both"),
+    ]
+}
+
+
+class SysVars:
+    """Session view over globals; SET GLOBAL updates the shared store."""
+
+    def __init__(self, globals_store: Optional[Dict[str, object]] = None):
+        self._globals = globals_store if globals_store is not None else {}
+        self._session: Dict[str, object] = {}
+
+    def get(self, name: str):
+        name = name.lower()
+        if name in self._session:
+            return self._session[name]
+        if name in self._globals:
+            return self._globals[name]
+        d = SYSVAR_DEFS.get(name)
+        if d is None:
+            raise KeyError(f"unknown system variable {name!r}")
+        return d.default
+
+    def set(self, name: str, value, scope: str = "session"):
+        name = name.lower()
+        d = SYSVAR_DEFS.get(name)
+        if d is None:
+            raise KeyError(f"unknown system variable {name!r}")
+        if d.scope == "readonly":
+            raise ValueError(f"variable {name} is read-only")
+        if d.validate is not None:
+            value = d.validate(value)
+        if scope == "global":
+            if d.scope == "session":
+                raise ValueError(f"variable {name} is session-scoped")
+            self._globals[name] = value
+        else:
+            if d.scope == "global":
+                raise ValueError(f"variable {name} is global-scoped; use SET GLOBAL")
+            self._session[name] = value
+
+    def all(self) -> Dict[str, object]:
+        out = {}
+        for name in sorted(SYSVAR_DEFS):
+            out[name] = self.get(name)
+        return out

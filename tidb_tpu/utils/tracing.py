@@ -1,0 +1,70 @@
+"""Span tracing through the query path.
+
+Reference: pkg/util/tracing/util.go:21 (opentracing spans opened at
+session.ExecuteStmt, Compiler.Compile, distsql.Select, rendered by
+TRACE SELECT, pkg/executor/trace.go). Here: a per-session Tracer records
+(name, start, duration, depth); the session opens spans around parse /
+plan / execute / materialize, and `TRACE <select>` returns them as rows.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start_s: float
+    dur_s: float
+    depth: int
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+        self._depth = 0
+        self._t0: Optional[float] = None
+        self.enabled = False
+
+    def reset(self) -> None:
+        self.spans = []
+        self._depth = 0
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        if self._t0 is None:
+            self.reset()
+        start = time.perf_counter()
+        self._depth += 1
+        depth = self._depth
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            self.spans.append(
+                Span(name, start - self._t0, time.perf_counter() - start, depth)
+            )
+
+    def rows(self):
+        out = []
+        for s in sorted(self.spans, key=lambda s: s.start_s):
+            out.append(
+                ("  " * (s.depth - 1) + s.name, f"{s.start_s*1e3:.3f}ms", f"{s.dur_s*1e3:.3f}ms")
+            )
+        return out
+
+
+# module-level convenience tracer used when no session is involved
+_global = Tracer()
+
+
+def span(name: str):
+    return _global.span(name)
