@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native loader shared library.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -std=c++17 -shared -fPIC -o ../tidb_tpu/storage/_native.so loader.cpp
+echo "built tidb_tpu/storage/_native.so"

@@ -156,3 +156,37 @@ class TestExplainAnalyze:
         r = s.execute("explain analyze select b, count(*) from t group by b")
         text = "\n".join(row[0] for row in r.rows)
         assert "Aggregate" in text and "rows=" in text and "time=" in text
+
+
+class TestNativeLoader:
+    def test_native_vs_python(self, s):
+        """Native C++ loader produces identical results to the Python path."""
+        from tidb_tpu.storage import native as nat
+
+        if nat._load() is None:
+            pytest.skip("native loader unavailable")
+        sess = Session()
+        sess.execute(
+            "create table n (i bigint, f double, s varchar(20), d date, "
+            "m decimal(10,2), b boolean)"
+        )
+        with tempfile.NamedTemporaryFile("w", suffix=".tbl", delete=False) as f:
+            f.write("1|1.5|abc|1994-01-01|12.345|1|\n")
+            f.write("-2|\\N|x y|2024-02-29|-0.5|0|\n")
+            f.write("\\N|2e3||1970-01-01|99999999.99|\\N|\n")
+            path = f.name
+        try:
+            r = sess.execute(
+                f"load data infile '{path}' into table n fields terminated by '|'"
+            )
+            assert r.affected == 3
+            rows = sess.must_query(
+                "select i, f, s, d, m, b from n order by d"
+            ).rows
+            assert rows[0][0] is None and rows[0][1] == 2000.0 and rows[0][2] is None
+            assert rows[0][4] == 99999999.99
+            assert rows[1] == (1, 1.5, "abc", 8766, 12.35, True)  # .345 rounds to .35
+            assert rows[2][0] == -2 and rows[2][1] is None and rows[2][2] == "x y"
+            assert rows[2][4] == -0.5
+        finally:
+            os.unlink(path)

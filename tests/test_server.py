@@ -1,0 +1,138 @@
+"""MySQL wire protocol tests with a minimal raw-socket client
+(reference: pkg/server tests driving the protocol directly)."""
+
+import socket
+import struct
+
+import pytest
+
+from tidb_tpu.server import Server
+from tidb_tpu.server import protocol as P
+
+
+class MiniClient:
+    """Just enough of the client side: handshake + COM_QUERY text results."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.io = P.PacketIO(self.sock)
+        greeting = self.io.read_packet()
+        assert greeting[0] == 0x0A, "expected handshake v10"
+        self.server_version = greeting[1:greeting.index(b"\x00", 1)].decode()
+        # HandshakeResponse41: caps, max packet, charset, 23 zeros, user, auth
+        caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+        body = struct.pack("<I", caps) + struct.pack("<I", 1 << 24) + bytes([0xFF])
+        body += b"\x00" * 23 + b"root\x00" + bytes([0])
+        self.io.write_packet(body)
+        ok = self.io.read_packet()
+        assert ok[0] == 0x00, f"auth failed: {ok!r}"
+
+    def _lenenc(self, data, pos):
+        v = data[pos]
+        if v < 251:
+            return v, pos + 1
+        if v == 0xFC:
+            return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+        if v == 0xFD:
+            return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+        return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+
+    def query(self, sql):
+        self.io.reset_seq()
+        self.io.write_packet(b"\x03" + sql.encode())
+        first = self.io.read_packet()
+        if first[0] == 0xFF:
+            errno = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(f"server error {errno}: {first[9:].decode()}")
+        if first[0] == 0x00:
+            affected, pos = self._lenenc(first, 1)
+            return {"affected": affected, "rows": None}
+        ncols, _ = self._lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            colpkt = self.io.read_packet()
+            pos = 0
+            vals = []
+            for _f in range(6):
+                ln, pos = self._lenenc(colpkt, pos)
+                vals.append(colpkt[pos:pos + ln])
+                pos += ln
+            names.append(vals[4].decode())
+        eof = self.io.read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self.io.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            row = []
+            pos = 0
+            while pos < len(pkt):
+                if pkt[pos] == 0xFB:
+                    row.append(None)
+                    pos += 1
+                else:
+                    ln, pos = self._lenenc(pkt, pos)
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(tuple(row))
+        return {"columns": names, "rows": rows}
+
+    def close(self):
+        try:
+            self.io.reset_seq()
+            self.io.write_packet(b"\x01")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server(port=0)  # ephemeral port
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_handshake_and_ddl_dml(server):
+    c = MiniClient(server.port)
+    assert "tidb-tpu" in c.server_version
+    r = c.query("create table w (a bigint, b varchar(10), d date)")
+    assert r["rows"] is None
+    r = c.query("insert into w values (1, 'x', '2024-01-15'), (2, null, null)")
+    assert r["affected"] == 2
+    r = c.query("select a, b, d from w order by a")
+    assert r["columns"] == ["a", "b", "d"]
+    assert r["rows"] == [("1", "x", "2024-01-15"), ("2", None, None)]
+    c.close()
+
+
+def test_error_keeps_connection(server):
+    c = MiniClient(server.port)
+    with pytest.raises(RuntimeError, match="server error"):
+        c.query("select * from no_such_table")
+    r = c.query("select 1 + 1")
+    assert r["rows"] == [("2",)]
+    c.close()
+
+
+def test_aggregates_and_decimals(server):
+    c = MiniClient(server.port)
+    c.query("create table m (v decimal(10,2))")
+    c.query("insert into m values (1.50), (2.25), (null)")
+    r = c.query("select count(*), sum(v), avg(v) from m")
+    assert r["rows"][0][0] == "3"
+    assert r["rows"][0][1] == "3.75"
+    c.close()
+
+
+def test_two_connections_share_catalog(server):
+    c1 = MiniClient(server.port)
+    c2 = MiniClient(server.port)
+    c1.query("create table shared (x bigint)")
+    c1.query("insert into shared values (42)")
+    r = c2.query("select x from shared")
+    assert r["rows"] == [("42",)]
+    c1.close()
+    c2.close()

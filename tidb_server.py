@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""tidb_tpu server binary.
+
+Reference: cmd/tidb-server/main.go — flags (main.go:200-262), store
+registry (registerStores main.go:397), server start (createServer
+main.go:895). The TPU engine is the only store ("--store=tpu" is the
+default and the point); data can be bootstrapped from TPC-H datagen or
+loaded via LOAD DATA INFILE / INSERT over the wire.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="TPU-native MySQL-compatible SQL engine")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("-P", "--port", type=int, default=4000)
+    ap.add_argument("--store", default="tpu", choices=["tpu"],
+                    help="storage/compute engine (TPU device engine)")
+    ap.add_argument("--tpch", type=float, default=None, metavar="SF",
+                    help="bootstrap with TPC-H data at scale factor SF")
+    args = ap.parse_args()
+
+    from tidb_tpu.server import Server
+    from tidb_tpu.storage import Catalog
+
+    catalog = Catalog()
+    if args.tpch:
+        from tidb_tpu.bench import load_tpch
+
+        print(f"generating TPC-H sf={args.tpch} ...", flush=True)
+        load_tpch(catalog, sf=args.tpch)
+    srv = Server(catalog, host=args.host, port=args.port)
+    print(f"tidb_tpu listening on {args.host}:{srv.port} (store={args.store})", flush=True)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from tidb_tpu.server.server import Server  # noqa: F401

@@ -1,0 +1,205 @@
+"""MySQL client/server wire protocol (text protocol subset).
+
+Reference: pkg/server — handshake + dispatch (conn.go:1009,1247), result
+encoding (conn.go:2228,2286). Implements protocol 4.1 text protocol:
+handshake v10, any-password auth (the embedded engine trusts local
+clients, like the reference with auth disabled), COM_QUERY/PING/QUIT/
+INIT_DB, OK/ERR/EOF and text resultsets. Enough for the mysql CLI,
+drivers and BI tools speaking the classic protocol.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+from tidb_tpu.dtypes import Kind, SQLType, days_to_date
+
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_TRANSACTIONS = 0x2000
+
+SERVER_STATUS_AUTOCOMMIT = 0x0002
+
+MYSQL_TYPE_DOUBLE = 5
+MYSQL_TYPE_NULL = 6
+MYSQL_TYPE_LONGLONG = 8
+MYSQL_TYPE_DATE = 10
+MYSQL_TYPE_VAR_STRING = 253
+MYSQL_TYPE_NEWDECIMAL = 246
+MYSQL_TYPE_TINY = 1
+
+
+def lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenenc_str(s: bytes) -> bytes:
+    return lenenc_int(len(s)) + s
+
+
+class PacketIO:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def reset_seq(self) -> None:
+        self.seq = 0
+
+    def read_packet(self) -> Optional[bytes]:
+        hdr = self._read_n(4)
+        if hdr is None:
+            return None
+        length = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        body = self._read_n(length)
+        return body
+
+    def _read_n(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def write_packet(self, payload: bytes) -> None:
+        out = b""
+        while True:
+            part = payload[: 0xFFFFFF]
+            payload = payload[0xFFFFFF:]
+            out += struct.pack("<I", len(part))[:3] + bytes([self.seq])
+            out += part
+            self.seq = (self.seq + 1) & 0xFF
+            if len(part) < 0xFFFFFF:
+                break
+        self.sock.sendall(out)
+
+
+def handshake_v10(conn_id: int, server_version: str) -> bytes:
+    caps = (
+        CLIENT_PROTOCOL_41
+        | CLIENT_SECURE_CONNECTION
+        | CLIENT_PLUGIN_AUTH
+        | CLIENT_CONNECT_WITH_DB
+        | CLIENT_TRANSACTIONS
+    )
+    salt = b"12345678"
+    salt2 = b"901234567890\x00"
+    p = b"\x0a"  # protocol version
+    p += server_version.encode() + b"\x00"
+    p += struct.pack("<I", conn_id)
+    p += salt + b"\x00"
+    p += struct.pack("<H", caps & 0xFFFF)
+    p += bytes([0xFF])  # charset: utf8mb4
+    p += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+    p += struct.pack("<H", (caps >> 16) & 0xFFFF)
+    p += bytes([21])  # auth data length
+    p += b"\x00" * 10
+    p += salt2
+    p += b"mysql_native_password\x00"
+    return p
+
+
+def parse_handshake_response(body: bytes) -> Tuple[str, Optional[str]]:
+    """Returns (username, database)."""
+    caps = struct.unpack("<I", body[:4])[0]
+    i = 4 + 4 + 1 + 23  # caps, max packet, charset, reserved
+    end = body.index(b"\x00", i)
+    user = body[i:end].decode("utf-8", "replace")
+    i = end + 1
+    # auth response
+    if caps & CLIENT_SECURE_CONNECTION:
+        alen = body[i]
+        i += 1 + alen
+    else:
+        end = body.index(b"\x00", i)
+        i = end + 1
+    db = None
+    if caps & CLIENT_CONNECT_WITH_DB and i < len(body):
+        try:
+            end = body.index(b"\x00", i)
+        except ValueError:
+            end = len(body)
+        db = body[i:end].decode("utf-8", "replace") or None
+    return user, db
+
+
+def ok_packet(affected: int = 0, last_insert_id: int = 0, info: str = "") -> bytes:
+    return (
+        b"\x00"
+        + lenenc_int(affected)
+        + lenenc_int(last_insert_id)
+        + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+        + struct.pack("<H", 0)
+        + info.encode()
+    )
+
+
+def err_packet(errno: int, message: str, sqlstate: str = "HY000") -> bytes:
+    return (
+        b"\xff"
+        + struct.pack("<H", errno)
+        + b"#"
+        + sqlstate.encode()[:5].ljust(5, b"0")
+        + message.encode("utf-8", "replace")[:1024]
+    )
+
+
+def eof_packet() -> bytes:
+    return b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+
+
+def _mysql_type(t: Optional[SQLType]) -> int:
+    if t is None:
+        return MYSQL_TYPE_VAR_STRING
+    return {
+        Kind.INT: MYSQL_TYPE_LONGLONG,
+        Kind.FLOAT: MYSQL_TYPE_DOUBLE,
+        Kind.BOOL: MYSQL_TYPE_TINY,
+        Kind.DATE: MYSQL_TYPE_DATE,
+        Kind.DECIMAL: MYSQL_TYPE_NEWDECIMAL,
+        Kind.STRING: MYSQL_TYPE_VAR_STRING,
+        Kind.NULL: MYSQL_TYPE_NULL,
+    }.get(t.kind, MYSQL_TYPE_VAR_STRING)
+
+
+def column_def(name: str, t: Optional[SQLType]) -> bytes:
+    p = lenenc_str(b"def")
+    p += lenenc_str(b"")  # schema
+    p += lenenc_str(b"")  # table
+    p += lenenc_str(b"")  # org table
+    p += lenenc_str(name.encode())
+    p += lenenc_str(name.encode())
+    p += bytes([0x0C])
+    p += struct.pack("<H", 0xFF)  # charset utf8mb4
+    p += struct.pack("<I", 255)  # display length
+    p += bytes([_mysql_type(t)])
+    p += struct.pack("<H", 0)  # flags
+    p += bytes([t.scale if t and t.kind == Kind.DECIMAL else 0x1F])
+    p += b"\x00\x00"
+    return p
+
+
+def format_value(v, t: Optional[SQLType]) -> Optional[bytes]:
+    if v is None:
+        return None
+    if t is not None and t.kind == Kind.DATE and isinstance(v, (int,)):
+        return days_to_date(v).encode()
+    if isinstance(v, bool):
+        return b"1" if v else b"0"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return str(v).encode()
+        return repr(v).encode()
+    return str(v).encode()

@@ -1,0 +1,126 @@
+"""Threaded MySQL-protocol server over the embedded engine.
+
+Reference: pkg/server/server.go:429 (Server.Run accept loop) +
+conn.go:1009 (clientConn.Run read-dispatch loop), one goroutine per
+connection; here one thread per connection, all sharing the catalog (the
+device engine serializes on the single jit dispatch path, matching one
+TPU chip per process; multi-chip serving shards sessions across hosts).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from tidb_tpu.server import protocol as P
+from tidb_tpu.session import Result, Session
+from tidb_tpu.storage import Catalog
+
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+
+
+class Server:
+    def __init__(self, catalog: Optional[Catalog] = None, host: str = "127.0.0.1", port: int = 4000):
+        self.catalog = catalog or Catalog()
+        self.host = host
+        self.port = port
+        self._next_conn_id = [0]
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                outer._handle_conn(self.request)
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = TCP((host, port), Handler)
+        self.port = self._tcp.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._tcp.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        th = threading.Thread(target=self.serve_forever, daemon=True)
+        th.start()
+        return th
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # ------------------------------------------------------------------
+    def _handle_conn(self, sock: socket.socket) -> None:
+        io = P.PacketIO(sock)
+        with self._lock:
+            self._next_conn_id[0] += 1
+            conn_id = self._next_conn_id[0]
+        sess = Session(self.catalog)
+        version = str(sess.vars.get("version"))
+        io.write_packet(P.handshake_v10(conn_id, version))
+        body = io.read_packet()
+        if body is None:
+            return
+        try:
+            _user, db = P.parse_handshake_response(body)
+            if db:
+                sess.db = db.lower()
+        except Exception:
+            pass
+        io.write_packet(P.ok_packet())
+
+        while True:
+            io.reset_seq()
+            body = io.read_packet()
+            if body is None or not body:
+                return
+            cmd, payload = body[0], body[1:]
+            try:
+                if cmd == COM_QUIT:
+                    return
+                if cmd == COM_PING:
+                    io.write_packet(P.ok_packet())
+                elif cmd == COM_INIT_DB:
+                    sess.execute(f"use `{payload.decode()}`")
+                    io.write_packet(P.ok_packet())
+                elif cmd == COM_QUERY:
+                    sql = payload.decode("utf-8", "replace")
+                    self._run_query(io, sess, sql)
+                elif cmd == COM_FIELD_LIST:
+                    io.write_packet(P.eof_packet())
+                else:
+                    io.write_packet(
+                        P.err_packet(1047, f"unsupported command {cmd:#x}")
+                    )
+            except Exception as e:  # error -> ERR packet, connection lives
+                try:
+                    io.write_packet(P.err_packet(1105, str(e)))
+                except OSError:
+                    return
+
+    def _run_query(self, io: P.PacketIO, sess: Session, sql: str) -> None:
+        r = sess.execute(sql)
+        if not r.columns:
+            io.write_packet(P.ok_packet(affected=r.affected))
+            return
+        types = getattr(r, "types", None) or [None] * len(r.columns)
+        io.write_packet(P.lenenc_int(len(r.columns)))
+        for name, t in zip(r.columns, types):
+            io.write_packet(P.column_def(name, t))
+        io.write_packet(P.eof_packet())
+        for row in r.rows:
+            payload = b""
+            for v, t in zip(row, types):
+                fv = P.format_value(v, t)
+                payload += b"\xfb" if fv is None else P.lenenc_str(fv)
+            io.write_packet(payload)
+        io.write_packet(P.eof_packet())

@@ -31,6 +31,7 @@ class Result:
     rows: List[Tuple]
     affected: int = 0
     elapsed_s: float = 0.0
+    types: Optional[List[SQLType]] = None  # per-column, for wire encoding
 
     def sorted(self) -> List[Tuple]:
         return sorted(self.rows, key=lambda r: tuple((v is None, str(v)) for v in r))
@@ -328,7 +329,7 @@ class Session:
         rows = [
             tuple(decoded[i][r] for i in internals) for r in range(block.nrows)
         ]
-        return Result(names, rows)
+        return Result(names, rows, types=[c.type for c in plan.schema])
 
     # ------------------------------------------------------------------
     def _run_insert(self, s: ast.Insert) -> Result:

@@ -1,0 +1,132 @@
+"""ctypes bindings for the native loader (native/loader.cpp).
+
+Builds the shared library on demand with g++ (no pybind11 in the image;
+ctypes avoids any build-time Python dependency). Arrays are wrapped as
+numpy views over the C++ vectors and copied once into HostColumns.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from tidb_tpu.chunk import HostBlock, HostColumn, encode_strings
+from tidb_tpu.dtypes import Kind
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_native.so")
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native", "loader.cpp")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+_TYPECODE = {
+    Kind.INT: 0,
+    Kind.FLOAT: 1,
+    Kind.STRING: 2,
+    Kind.DATE: 3,
+    Kind.DECIMAL: 4,
+    Kind.BOOL: 5,
+}
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            try:
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-o", _SO, _SRC,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.tt_parse_file.restype = ctypes.c_void_p
+        lib.tt_parse_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.tt_error.restype = ctypes.c_char_p
+        lib.tt_error.argtypes = [ctypes.c_void_p]
+        lib.tt_nrows.restype = ctypes.c_int64
+        lib.tt_nrows.argtypes = [ctypes.c_void_p]
+        for name in ("tt_col_i64", "tt_col_stroffsets"):
+            getattr(lib, name).restype = ctypes.POINTER(ctypes.c_int64)
+            getattr(lib, name).argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tt_col_f64.restype = ctypes.POINTER(ctypes.c_double)
+        lib.tt_col_f64.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tt_col_valid.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.tt_col_valid.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tt_col_strbytes.restype = ctypes.POINTER(ctypes.c_char)
+        lib.tt_col_strbytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.tt_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_load(table, path: str, sep: str) -> Optional[int]:
+    """Parse with the C++ loader and append to the table. Returns None if
+    the native library is unavailable (caller falls back to Python)."""
+    lib = _load()
+    if lib is None or len(sep) != 1:
+        return None
+    names = table.schema.names
+    types = [t for _, t in table.schema.columns]
+    n = len(names)
+    codes = (ctypes.c_int * n)(*[_TYPECODE[t.kind] for t in types])
+    scales = (ctypes.c_int * n)(*[t.scale for t in types])
+    h = lib.tt_parse_file(path.encode(), sep.encode(), n, codes, scales)
+    try:
+        err = lib.tt_error(h)
+        if err:
+            raise ValueError(f"native load: {err.decode()}")
+        nrows = lib.tt_nrows(h)
+        if nrows == 0:
+            return 0
+        cols = {}
+        for i, (name, typ) in enumerate(zip(names, types)):
+            valid = np.ctypeslib.as_array(lib.tt_col_valid(h, i), (nrows,)).astype(bool)
+            if typ.kind == Kind.STRING:
+                blen = ctypes.c_int64()
+                bptr = lib.tt_col_strbytes(h, i, ctypes.byref(blen))
+                raw = ctypes.string_at(bptr, blen.value)
+                offs = np.ctypeslib.as_array(lib.tt_col_stroffsets(h, i), (nrows + 1,))
+                values = [
+                    raw[offs[r]: offs[r + 1]].decode("utf-8", "replace")
+                    if valid[r]
+                    else None
+                    for r in range(nrows)
+                ]
+                cols[name] = encode_strings(values)
+            elif typ.kind == Kind.FLOAT:
+                data = np.ctypeslib.as_array(lib.tt_col_f64(h, i), (nrows,)).copy()
+                cols[name] = HostColumn(typ, data, valid.copy())
+            else:
+                data = np.ctypeslib.as_array(lib.tt_col_i64(h, i), (nrows,)).copy()
+                data = data.astype(typ.np_dtype)
+                cols[name] = HostColumn(typ, data, valid.copy())
+        table.append_block(HostBlock.from_columns(cols))
+        return int(nrows)
+    finally:
+        lib.tt_free(h)
