@@ -1,0 +1,85 @@
+"""Window function tests (reference: pkg/executor window tests +
+pkg/planner window building)."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("create table e (dept varchar(10), name varchar(10), sal bigint)")
+    sess.execute(
+        "insert into e values "
+        "('eng', 'a', 100), ('eng', 'b', 200), ('eng', 'c', 200), "
+        "('ops', 'd', 50), ('ops', 'e', 150), ('hr', 'f', 75)"
+    )
+    return sess
+
+
+def test_row_number(s):
+    r = s.must_query(
+        "select dept, name, row_number() over (partition by dept order by sal desc, name) "
+        "from e order by dept, 3"
+    )
+    assert r.rows == [
+        ("eng", "b", 1), ("eng", "c", 2), ("eng", "a", 3),
+        ("hr", "f", 1),
+        ("ops", "e", 1), ("ops", "d", 2),
+    ]
+
+
+def test_rank_dense_rank(s):
+    r = s.must_query(
+        "select name, rank() over (partition by dept order by sal desc), "
+        "dense_rank() over (partition by dept order by sal desc) "
+        "from e where dept = 'eng' order by sal desc, name"
+    )
+    assert r.rows == [("b", 1, 1), ("c", 1, 1), ("a", 3, 2)]
+
+
+def test_partition_aggregate(s):
+    r = s.must_query(
+        "select name, sum(sal) over (partition by dept), "
+        "count(*) over (partition by dept), "
+        "avg(sal) over (partition by dept), "
+        "max(sal) over (partition by dept) "
+        "from e order by dept, name"
+    )
+    eng = [row for row in r.rows if row[0] in ("a", "b", "c")]
+    assert all(row[1] == 500 and row[2] == 3 and row[4] == 200 for row in eng)
+    assert abs(eng[0][3] - 500 / 3) < 1e-9
+
+
+def test_running_sum(s):
+    r = s.must_query(
+        "select name, sum(sal) over (partition by dept order by name) "
+        "from e where dept = 'eng' order by name"
+    )
+    assert r.rows == [("a", 100), ("b", 300), ("c", 500)]
+
+
+def test_lag_lead(s):
+    r = s.must_query(
+        "select name, lag(sal) over (partition by dept order by name), "
+        "lead(sal) over (partition by dept order by name) "
+        "from e where dept = 'eng' order by name"
+    )
+    assert r.rows == [("a", None, 200), ("b", 100, 200), ("c", 200, None)]
+
+
+def test_global_window_no_partition(s):
+    r = s.must_query(
+        "select name, sum(sal) over () from e order by name limit 2"
+    )
+    assert r.rows == [("a", 775), ("b", 775)]
+
+
+def test_window_over_group_by(s):
+    r = s.must_query(
+        "select dept, sum(sal) as total, "
+        "rank() over (order by sum(sal) desc) as rnk "
+        "from e group by dept order by rnk"
+    )
+    assert r.rows == [("eng", 500, 1), ("ops", 200, 2), ("hr", 75, 3)]

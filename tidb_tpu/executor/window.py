@@ -1,0 +1,207 @@
+"""Window functions with static shapes.
+
+Reference: WindowExec (pkg/executor/window.go:32) and PipelinedWindowExec
+(pipelined_window.go:38); the reference parallelizes via ShuffleExec
+hash-repartitioning partitions to workers (shuffle.go:56-86). On TPU one
+lax.sort by (partition, order) keys + segment-indexed prefix ops handles
+every partition simultaneously — the shuffle is unnecessary on one chip
+and becomes hash_repartition over the mesh for the distributed case.
+
+Supported: row_number, rank, dense_rank, lag, lead, and sum/count/avg/
+min/max as window aggregates — over the whole partition without ORDER BY,
+or as running (rows unbounded-preceding..current) with ORDER BY.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+ExprFn = Callable[[Batch], DevCol]
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowDesc:
+    func: str  # row_number|rank|dense_rank|lag|lead|sum|count|avg|min|max
+    arg: Optional[ExprFn]
+    out_name: str
+    offset: int = 1  # for lag/lead
+    arg_scale: int = 0
+    # True when the OVER clause has ORDER BY: aggregate becomes a running
+    # (rows unbounded-preceding..current) computation, else whole-partition.
+    running: bool = False
+
+
+def _seg_gather(values, seg, first_idx):
+    return values[first_idx[seg]]
+
+
+def window_op(
+    batch: Batch,
+    part_fns: Sequence[ExprFn],
+    order_fns: Sequence[ExprFn],
+    order_descs: Sequence[bool],
+    descs: Sequence[WindowDesc],
+) -> Batch:
+    cap = batch.capacity
+    idx32 = jnp.arange(cap, dtype=jnp.int32)
+
+    # ---- global sort by (valid, partition keys, order keys) ----
+    operands: List[jax.Array] = [~batch.row_valid]
+    n_part_ops = 0
+    for fn in part_fns:
+        k = fn(batch)
+        operands.append(~k.valid)
+        operands.append(jnp.where(k.valid, k.data, jnp.zeros_like(k.data)))
+        n_part_ops += 2
+    for fn, desc in zip(order_fns, order_descs):
+        k = fn(batch)
+        valid = k.valid
+        nullk = ~valid if desc else valid
+        data = k.data
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            d = -data if desc else data
+        elif data.dtype == jnp.bool_:
+            d = data ^ desc
+        else:
+            d = -data.astype(jnp.int64) if desc else data.astype(jnp.int64)
+        operands.append(nullk)
+        operands.append(jnp.where(valid, d, jnp.zeros_like(d)))
+    sorted_ops = jax.lax.sort(operands + [idx32], num_keys=len(operands))
+    perm = sorted_ops[-1]
+    srow_valid = ~sorted_ops[0]
+
+    # partition segment ids over the sorted order
+    part_change = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for i in range(1, 1 + n_part_ops):
+        arr = sorted_ops[i]
+        part_change = part_change | (arr != jnp.roll(arr, 1))
+    part_change = part_change.at[0].set(True)
+    seg = jnp.cumsum((part_change & srow_valid).astype(jnp.int32)) - 1
+    seg = jnp.where(srow_valid, seg, cap)  # invalid rows -> overflow seg
+
+    # peer-group change (partition change OR any order key change)
+    peer_change = part_change
+    for i in range(1 + n_part_ops, len(operands)):
+        arr = sorted_ops[i]
+        peer_change = peer_change | (arr != jnp.roll(arr, 1))
+    peer_change = peer_change.at[0].set(True)
+
+    num_segments = cap + 1
+    first_idx = (
+        jnp.full(num_segments, cap - 1, dtype=jnp.int32)
+        .at[seg]
+        .min(idx32, mode="drop")
+    )
+    seg_c = jnp.clip(seg, 0, cap)
+
+    new_cols = {}
+    inv = jnp.zeros(cap, dtype=jnp.int32).at[perm].set(idx32)
+    for d in descs:
+        col = _compute(d, batch, perm, srow_valid, seg_c, first_idx, peer_change, cap)
+        # scatter back to original row positions
+        new_cols[d.out_name] = DevCol(col.data[inv], col.valid[inv])
+
+    cols = dict(batch.cols)
+    cols.update(new_cols)
+    return Batch(cols, batch.row_valid)
+
+
+def _compute(d: WindowDesc, batch, perm, srow_valid, seg, first_idx, peer_change, cap):
+    idx = jnp.arange(cap, dtype=jnp.int64)
+    pos = idx - first_idx[seg]
+    if d.func == "row_number":
+        return DevCol(pos + 1, srow_valid)
+    if d.func == "rank":
+        peer_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(peer_change, idx, 0)
+        )
+        return DevCol(peer_start - first_idx[seg] + 1, srow_valid)
+    if d.func == "dense_rank":
+        c = jnp.cumsum(peer_change.astype(jnp.int64))
+        return DevCol(c - c[first_idx[seg]] + 1, srow_valid)
+
+    if d.arg is None:  # COUNT(*) OVER ...
+        data = jnp.ones(cap, dtype=jnp.int64)
+        valid = srow_valid
+    else:
+        arg = d.arg(batch)
+        data = arg.data[perm]
+        valid = arg.valid[perm] & srow_valid
+
+    if d.func in ("lag", "lead"):
+        off = d.offset if d.func == "lag" else -d.offset
+        src = jnp.clip(idx - off, 0, cap - 1)
+        same_seg = seg[src] == seg
+        in_range = (idx - off >= 0) & (idx - off < cap)
+        ok = same_seg & in_range & srow_valid
+        return DevCol(
+            jnp.where(ok, data[src], jnp.zeros_like(data[src])),
+            ok & valid[src],
+        )
+
+    # whole-partition aggregates via segment reduce; running variants via
+    # prefix ops offset by the segment start.
+    zero = jnp.zeros((), dtype=data.dtype)
+    if d.func in ("sum", "avg", "count"):
+        contrib = (
+            valid.astype(jnp.int64)
+            if d.func == "count"
+            else jnp.where(valid, data, zero)
+        )
+        if d.running:
+            c = jnp.cumsum(contrib)
+            run = c - jnp.where(first_idx[seg] > 0, c[jnp.clip(first_idx[seg] - 1, 0, cap - 1)], 0)
+            cnt_c = jnp.cumsum(valid.astype(jnp.int64))
+            cnt = cnt_c - jnp.where(first_idx[seg] > 0, cnt_c[jnp.clip(first_idx[seg] - 1, 0, cap - 1)], 0)
+        else:
+            s = jax.ops.segment_sum(contrib, seg, num_segments=cap + 1)
+            run = s[seg]
+            cn = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap + 1)
+            cnt = cn[seg]
+        if d.func == "count":
+            return DevCol(cnt if d.running else run, srow_valid)
+        if d.func == "sum":
+            return DevCol(run, srow_valid & (cnt > 0))
+        denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+        if d.arg_scale:
+            denom = denom * (10**d.arg_scale)
+        return DevCol(run.astype(jnp.float64) / denom, srow_valid & (cnt > 0))
+    if d.func in ("min", "max"):
+        big = _sentinel(data.dtype, d.func == "min")
+        masked = jnp.where(valid, data, big)
+        if d.running:
+            op = jnp.minimum if d.func == "min" else jnp.maximum
+
+            # segmented scan: (value, segment-start flag) pairs reset the
+            # accumulator at every partition boundary
+            def comb(a, b):
+                av, af = a
+                bv, bf = b
+                return jnp.where(bf, bv, op(av, bv)), af | bf
+
+            seg_start = first_idx[seg] == jnp.arange(cap, dtype=jnp.int32)
+            scanned, _ = jax.lax.associative_scan(comb, (masked, seg_start))
+            run = scanned
+            cnt = jnp.cumsum(valid.astype(jnp.int64))
+            cnt = cnt - jnp.where(first_idx[seg] > 0, cnt[jnp.clip(first_idx[seg] - 1, 0, cap - 1)], 0)
+        else:
+            red = jax.ops.segment_min if d.func == "min" else jax.ops.segment_max
+            s = red(masked, seg, num_segments=cap + 1)
+            run = s[seg]
+            cn = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap + 1)
+            cnt = cn[seg]
+        return DevCol(run, srow_valid & (cnt > 0))
+    raise NotImplementedError(f"window func {d.func}")
+
+
+def _sentinel(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if is_min else info.min, dtype=dtype)

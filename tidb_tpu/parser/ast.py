@@ -56,6 +56,15 @@ class Star:
 
 
 @dataclasses.dataclass
+class WindowCall:
+    func: str  # row_number|rank|dense_rank|lag|lead|sum|count|avg|min|max
+    arg: Optional[object]
+    partition_by: List[object]
+    order_by: List["OrderItem"]
+    offset: int = 1  # lag/lead distance
+
+
+@dataclasses.dataclass
 class SubqueryExpr:
     query: "Select"
     # modifier: None (scalar), "exists", "in", "not in", "not exists"

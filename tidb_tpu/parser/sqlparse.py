@@ -48,7 +48,10 @@ KEYWORDS = {
     "global", "session", "variables", "trace", "begin", "commit",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
+    "over", "partition",
 }
+
+_WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
 
 
 class Token:
@@ -590,9 +593,13 @@ class Parser:
             distinct = self.accept_kw("distinct")
             if func == "count" and self.accept_op("*"):
                 self.expect_op(")")
+                if self.at_kw("over"):
+                    return self._parse_over(func, None)
                 return ast.AggCall("count", None, False)
             arg = self.parse_expr()
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self._parse_over(func, arg)
             return ast.AggCall(func, arg, distinct)
         if self.accept_op("("):
             if self.at_kw("select"):
@@ -611,12 +618,38 @@ class Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
+                if name.lower() in _WINDOW_ONLY_FUNCS:
+                    offset = 1
+                    if name.lower() in ("lag", "lead") and len(args) > 1:
+                        o = args[1]
+                        if isinstance(o, ast.Const):
+                            offset = int(o.value)
+                    arg = args[0] if args else None
+                    return self._parse_over(name.lower(), arg, offset)
                 return ast.Call(name.lower(), args)
             if self.accept_op("."):
                 col = self.expect_ident()
                 return ast.Name(name, col)
             return ast.Name(None, name)
         raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _parse_over(self, func: str, arg, offset: int = 1):
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition = []
+        order = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.parse_order_item())
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        self.expect_op(")")
+        return ast.WindowCall(func, arg, partition, order, offset)
 
     def parse_case(self):
         self.expect_kw("case")

@@ -133,6 +133,16 @@ class JoinPlan(LogicalPlan):
 
 
 @dataclasses.dataclass
+class Window(LogicalPlan):
+    """One OVER spec; descs: (out name, func, bound arg, offset, running)."""
+
+    child: LogicalPlan
+    partition_exprs: List[Expr]
+    order_exprs: List[Tuple[Expr, bool]]
+    descs: List[Tuple[str, str, Optional[Expr], int, bool]]
+
+
+@dataclasses.dataclass
 class Sort(LogicalPlan):
     child: LogicalPlan
     keys: List[Tuple[Expr, bool]]  # (bound expr, desc)
@@ -418,6 +428,14 @@ def build_select(
         elif isinstance(e, ast.Call):
             for a in e.args:
                 find_aggs(a)
+        elif isinstance(e, ast.WindowCall):
+            # `sum(sum(x)) over (...)`: the inner AggCall forces grouping
+            if e.arg is not None:
+                find_aggs(e.arg)
+            for p in e.partition_by:
+                find_aggs(p)
+            for oi in e.order_by:
+                find_aggs(oi.expr)
 
     # expand stars first
     items: List[ast.SelectItem] = []
@@ -460,7 +478,22 @@ def build_select(
     if grouped:
         plan, rewrite = _build_aggregate(b, plan, group_by, agg_calls)
     else:
-        rewrite = None
+        rewrite = {}
+
+    # ---- window functions (after aggregation, reference WindowExec) ----
+    win_calls: List[ast.WindowCall] = []
+
+    def find_wins(e):
+        if isinstance(e, ast.WindowCall):
+            win_calls.append(e)
+        elif isinstance(e, ast.Call):
+            for a in e.args:
+                find_wins(a)
+
+    for it in items:
+        find_wins(it.expr)
+    if win_calls:
+        plan = _build_windows(plan, win_calls, rewrite)
 
     binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
 
@@ -604,6 +637,19 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
             need |= walk_columns(e)
         child = prune_plan(plan.child, need)
         return Sort(child.schema, child, plan.keys)
+    if isinstance(plan, Window):
+        need = {r for r in required if not r.startswith("_w")}
+        for e in plan.partition_exprs:
+            need |= walk_columns(e)
+        for e, _d in plan.order_exprs:
+            need |= walk_columns(e)
+        for _n, _f, a, _o, _r in plan.descs:
+            if a is not None:
+                need |= walk_columns(a)
+        child = prune_plan(plan.child, need)
+        return Window(
+            plan.schema, child, plan.partition_exprs, plan.order_exprs, plan.descs
+        )
     if isinstance(plan, Limit):
         child = prune_plan(plan.child, required)
         return Limit(child.schema, child, plan.count, plan.offset)
@@ -783,8 +829,8 @@ def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog
 
 
 def _rewrite_aggs(e, rewrite: Dict):
-    """Replace AggCall / group-expr subtrees with references to aggregate
-    output columns."""
+    """Replace AggCall / WindowCall / group-expr subtrees with references
+    to their computed output columns."""
     key = _ast_key(e)
     if key in rewrite:
         name, typ = rewrite[key]
@@ -793,7 +839,67 @@ def _rewrite_aggs(e, rewrite: Dict):
         return ast.Call(e.op, [_rewrite_aggs(a, rewrite) for a in e.args], e.cast_type)
     if isinstance(e, ast.AggCall):
         raise PlanError("aggregate expression not in rewrite map (nested aggs?)")
+    if isinstance(e, ast.WindowCall):
+        raise PlanError("window expression not in rewrite map")
     return e
+
+
+def _build_windows(plan, win_calls: List[ast.WindowCall], rewrite: Dict) -> LogicalPlan:
+    """Insert one Window node per distinct OVER spec; register outputs in
+    the rewrite map (reference: logical window building in
+    logical_plan_builder.go buildWindowFunctions)."""
+    from tidb_tpu.dtypes import FLOAT64, INT64
+
+    specs: Dict[str, Tuple[ast.WindowCall, List[ast.WindowCall]]] = {}
+    order: List[str] = []
+    for call in win_calls:
+        key = _ast_key(call)
+        if key in rewrite:
+            continue
+        spec_key = repr(call.partition_by) + "||" + repr(call.order_by)
+        if spec_key not in specs:
+            specs[spec_key] = (call, [])
+            order.append(spec_key)
+        specs[spec_key][1].append(call)
+
+    widx = 0
+    for spec_key in order:
+        proto, calls = specs[spec_key]
+        binder = ExprBinder(plan.schema)
+
+        def lower(e):
+            e2 = _rewrite_aggs(e, rewrite) if rewrite else e
+            return binder.bind(e2)
+
+        part_exprs = [lower(p) for p in proto.partition_by]
+        order_exprs = [(lower(oi.expr), oi.desc) for oi in proto.order_by]
+        running = bool(proto.order_by)
+        descs: List[Tuple[str, str, Optional[Expr], int, bool]] = []
+        new_cols = list(plan.schema.cols)
+        for call in calls:
+            key = _ast_key(call)
+            if key in rewrite:
+                continue
+            name = f"_w{widx}"
+            widx += 1
+            arg = lower(call.arg) if call.arg is not None else None
+            if call.func in ("row_number", "rank", "dense_rank", "count"):
+                t = INT64
+            elif call.func == "avg":
+                t = FLOAT64
+            elif call.func in ("sum", "min", "max", "lag", "lead"):
+                if arg is None:
+                    raise PlanError(f"{call.func} window needs an argument")
+                t = arg.type
+            else:
+                raise PlanError(f"unsupported window function {call.func}")
+            if call.func in ("row_number", "rank", "dense_rank") and not proto.order_by:
+                raise PlanError(f"{call.func}() requires ORDER BY in its OVER clause")
+            descs.append((name, call.func, arg, call.offset, running))
+            rewrite[key] = (name, t)
+            new_cols.append(OutCol(None, name, name, t))
+        plan = Window(Schema(new_cols), plan, part_exprs, order_exprs, descs)
+    return plan
 
 
 def _ast_key(e) -> str:

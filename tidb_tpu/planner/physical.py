@@ -95,6 +95,10 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
             parts.append(p.kind + repr(p.equi_keys) + repr(p.residual) + str(p.null_aware))
         elif isinstance(p, L.Sort):
             parts.append(repr(p.keys))
+        elif isinstance(p, L.Window):
+            parts.append(
+                repr(p.partition_exprs) + repr(p.order_exprs) + repr(p.descs)
+            )
         elif isinstance(p, L.Limit):
             parts.append(f"{p.count},{p.offset}")
         for attr in ("child", "left", "right"):
@@ -244,6 +248,39 @@ class PlanCompiler:
                 return order_by(b, key_fns, descs), needs
 
             return fn_sort, dicts
+
+        if isinstance(plan, L.Window):
+            from tidb_tpu.executor.window import WindowDesc, window_op
+
+            child, dicts = self._build(plan.child)
+            part_fns = [compile_expr(e, dicts) for e in plan.partition_exprs]
+            order_fns = [compile_expr(e, dicts) for e, _ in plan.order_exprs]
+            order_descs = [d for _, d in plan.order_exprs]
+            wdescs = []
+            out_dicts = dict(dicts)
+            for name, func, arg, offset, running in plan.descs:
+                fn = compile_expr(arg, dicts) if arg is not None else None
+                scale = (
+                    arg.type.scale
+                    if arg is not None and arg.type.kind == Kind.DECIMAL
+                    else 0
+                )
+                wdescs.append(
+                    WindowDesc(func, fn, name, offset, scale, running)
+                )
+                if func in ("lag", "lead", "min", "max") and arg is not None:
+                    d = _expr_dict(arg, dicts)
+                    if d is not None:
+                        out_dicts[name] = d
+
+            def fn_win(inputs, caps):
+                b, needs = child(inputs, caps)
+                return (
+                    window_op(b, part_fns, order_fns, order_descs, wdescs),
+                    needs,
+                )
+
+            return fn_win, out_dicts
 
         if isinstance(plan, L.Limit):
             child, dicts = self._build(plan.child)
@@ -546,6 +583,8 @@ def _node_label(plan: L.LogicalPlan) -> str:
         return f"Join kind={plan.kind} keys={len(plan.equi_keys)}"
     if isinstance(plan, L.Sort):
         return f"Sort keys={len(plan.keys)}"
+    if isinstance(plan, L.Window):
+        return f"Window funcs={[f for _, f, _, _, _ in plan.descs]} parts={len(plan.partition_exprs)}"
     if isinstance(plan, L.Limit):
         return f"Limit limit={plan.count} offset={plan.offset}"
     if isinstance(plan, L.Projection):
