@@ -180,3 +180,46 @@ class TestExplain:
         r = s.must_query("explain select c, count(*) from t where a > 1 group by c")
         text = "\n".join(row[0] for row in r.rows)
         assert "Aggregate" in text and "Scan" in text and "Selection" in text
+
+
+class TestUnionCte:
+    def test_union_all(self, s):
+        r = s.must_query(
+            "select a from t where a <= 1 union all select b from t where a = 1 order by 1"
+        )
+        assert r.rows == [(1,), (10,)]
+
+    def test_union_distinct(self, s):
+        r = s.must_query("select a from t union select a from t order by a")
+        assert r.rows == [(None,), (1,), (2,), (3,), (4,)]
+
+    def test_union_type_coercion(self, s):
+        r = s.must_query("select a from t where a = 1 union all select 2.5")
+        vals = sorted(v for v, in r.rows)
+        assert vals == [1.0, 2.5]
+
+    def test_union_strings_merge_dicts(self, s):
+        r = s.must_query(
+            "select c from t where c = 'x' union all select 'new' order by 1"
+        )
+        assert [v for v, in r.rows] == ["new", "x", "x"]
+
+    def test_with_cte(self, s):
+        r = s.must_query(
+            "with big as (select a, b from t where b >= 20) "
+            "select count(*), sum(b) from big"
+        )
+        assert r.rows == [(3, 100)]
+
+    def test_with_cte_joined(self, s):
+        r = s.must_query(
+            "with x as (select c, count(*) as n from t group by c) "
+            "select t.a, x.n from t join x on t.c = x.c where t.a = 1"
+        )
+        assert r.rows == [(1, 2)]
+
+    def test_cte_column_aliases(self, s):
+        r = s.must_query(
+            "with m (k, v) as (select a, b from t where a = 2) select k, v from m"
+        )
+        assert r.rows == [(2, 20)]

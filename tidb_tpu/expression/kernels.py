@@ -263,10 +263,9 @@ def _compile_literal(e: Literal) -> _CompiledExpr:
 
         phys, np_dt = (date_to_days(v) if isinstance(v, str) else int(v)), jnp.int32
     elif t.kind == Kind.STRING:
-        # A bare string literal only appears under comparisons/LIKE which
-        # special-case it; reaching here means it is used as a value, which
-        # needs a dictionary — handled by the projection layer.
-        raise NotImplementedError("bare string literal outside comparison")
+        # string literal as a value: codes into its own one-entry
+        # dictionary (string_expr supplies the dictionary to consumers)
+        return string_expr(e, {})[0]
     else:
         phys, np_dt = int(v), jnp.int64
 

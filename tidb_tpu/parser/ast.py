@@ -131,6 +131,21 @@ class Select:
 
 
 @dataclasses.dataclass
+class Union:
+    selects: List["Select"]
+    all: bool = False
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclasses.dataclass
+class With:
+    ctes: List[Tuple[str, object]]  # (name, Select|Union)
+    body: object  # Select | Union
+
+
+@dataclasses.dataclass
 class ColumnDef:
     name: str
     type: SQLType

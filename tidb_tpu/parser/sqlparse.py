@@ -22,6 +22,21 @@ class ParseError(ValueError):
     pass
 
 
+def dataclasses_replace_items(q, cols):
+    import dataclasses as _dc
+
+    items = [
+        _dc.replace(it, alias=c) for it, c in zip(q.items, cols)
+    ]
+    return _dc.replace(q, items=items)
+
+
+def dataclasses_replace(obj, **kw):
+    import dataclasses as _dc
+
+    return _dc.replace(obj, **kw)
+
+
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+|\#[^\n]*|--[^\n]*|/\*.*?\*/)
@@ -48,7 +63,7 @@ KEYWORDS = {
     "global", "session", "variables", "trace", "begin", "commit",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
-    "over", "partition",
+    "over", "partition", "with", "recursive", "local",
 }
 
 _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
@@ -154,8 +169,10 @@ class Parser:
 
     # -- entry -------------------------------------------------------------
     def parse_stmt(self):
-        if self.at_kw("select"):
-            return self.parse_select()
+        if self.at_kw("select") or self.at_op("("):
+            return self.parse_select_or_union()
+        if self.at_kw("with"):
+            return self.parse_with()
         if self.at_kw("explain"):
             self.advance()
             analyze = self.accept_kw("analyze")
@@ -279,7 +296,85 @@ class Parser:
             sep = st.text
         return ast.LoadData(db, name, path, sep)
 
-    # -- SELECT ------------------------------------------------------------
+    # -- SELECT / UNION / WITH --------------------------------------------
+    def parse_select_or_union(self):
+        first = self._parse_select_block()
+        if not self.at_kw("union"):
+            return first
+        selects = [first]
+        is_all = True
+        while self.accept_kw("union"):
+            if self.accept_kw("all"):
+                part_all = True
+            else:
+                self.accept_kw("distinct")
+                part_all = False
+            is_all = is_all and part_all
+            selects.append(self._parse_select_block())
+        order_by: List[ast.OrderItem] = []
+        limit = offset = None
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        if self.accept_kw("limit"):
+            a = self.parse_int()
+            if self.accept_op(","):
+                offset, limit = a, self.parse_int()
+            elif self.accept_kw("offset"):
+                limit, offset = a, self.parse_int()
+            else:
+                limit = a
+        # MySQL: a trailing ORDER BY/LIMIT after the last unparenthesized
+        # branch belongs to the whole UNION, but the greedy SELECT parser
+        # already attached it to that branch — move it up.
+        last = selects[-1]
+        if not order_by and isinstance(last, ast.Select) and last.order_by:
+            order_by, last = last.order_by, dataclasses_replace(last, order_by=[])
+            selects[-1] = last
+        if limit is None and isinstance(last, ast.Select) and last.limit is not None:
+            limit, offset = last.limit, last.offset
+            selects[-1] = dataclasses_replace(last, limit=None, offset=None)
+        return ast.Union(selects, is_all, order_by, limit, offset)
+
+    def _parse_select_block(self):
+        if self.accept_op("("):
+            s = self.parse_select_or_union()
+            self.expect_op(")")
+            return s
+        return self.parse_select()
+
+    def parse_with(self):
+        self.expect_kw("with")
+        if self.accept_kw("recursive"):
+            raise ParseError("recursive CTEs not yet supported")
+        ctes = []
+        while True:
+            name = self.expect_ident()
+            if self.accept_op("("):
+                # column list — accepted and applied as aliases
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+            else:
+                cols = None
+            self.expect_kw("as")
+            self.expect_op("(")
+            q = self.parse_select_or_union()
+            self.expect_op(")")
+            if cols is not None and isinstance(q, ast.Select):
+                items = q.items
+                if len(cols) != len(items):
+                    raise ParseError("CTE column list arity mismatch")
+                q = dataclasses_replace_items(q, cols)
+            ctes.append((name.lower(), q))
+            if not self.accept_op(","):
+                break
+        body = self.parse_select_or_union()
+        return ast.With(ctes, body)
+
     def parse_select(self) -> ast.Select:
         self.expect_kw("select")
         distinct = False
@@ -395,8 +490,8 @@ class Parser:
 
     def parse_table_factor(self):
         if self.accept_op("("):
-            if self.at_kw("select"):
-                q = self.parse_select()
+            if self.at_kw("select") or self.at_kw("with"):
+                q = self.parse_with() if self.at_kw("with") else self.parse_select_or_union()
                 self.expect_op(")")
                 self.accept_kw("as")
                 alias = self.expect_ident()

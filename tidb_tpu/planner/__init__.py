@@ -1,2 +1,2 @@
-from tidb_tpu.planner.logical import build_select, PlanError  # noqa: F401
+from tidb_tpu.planner.logical import build_select, build_query, PlanError  # noqa: F401
 from tidb_tpu.planner import logical as nodes  # noqa: F401

@@ -94,6 +94,12 @@ class LogicalPlan:
 
 
 @dataclasses.dataclass
+class OneRow(LogicalPlan):
+    """Single-row, zero-column source — the dual table for tableless
+    SELECTs (reference: TableDual plan)."""
+
+
+@dataclasses.dataclass
 class Scan(LogicalPlan):
     db: str
     table: str  # catalog table name
@@ -153,6 +159,15 @@ class Limit(LogicalPlan):
     child: LogicalPlan
     count: int
     offset: int = 0
+
+
+@dataclasses.dataclass
+class UnionAll(LogicalPlan):
+    """Bag union by position; children are projections onto _u{i} names
+    with casts to the common types (reference UnionExec,
+    pkg/executor/unionexec)."""
+
+    children: List[LogicalPlan] = dataclasses.field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -267,22 +282,40 @@ def _ast_columns(e, out: set):
 
 
 class SelectBuilder:
-    """Builds a logical plan for one SELECT. ``resolver`` maps
-    (db, table) -> (schema columns, types); ``subquery_planner`` plans a
-    nested SELECT and returns its plan (used by IN/EXISTS/scalar)."""
+    """Builds a logical plan for one SELECT. ``ctes`` maps CTE names to
+    their parser ASTs (resolved before catalog tables, like the
+    reference's CTE name scope)."""
 
-    def __init__(self, catalog, current_db: str, subquery_value_fn=None):
+    def __init__(self, catalog, current_db: str, subquery_value_fn=None, ctes=None):
         self.catalog = catalog
         self.db = current_db
         # subquery_value_fn(select_ast) -> Literal  (executes scalar subq)
         self.subquery_value_fn = subquery_value_fn
-        self.semi_joins: List[Tuple[ast.SubqueryExpr, str]] = []
+        self.ctes = ctes or {}
 
     # -- FROM --------------------------------------------------------------
     def build_from(self, node) -> LogicalPlan:
         if node is None:
             raise PlanError("SELECT without FROM not planned here")
         if isinstance(node, ast.TableRef):
+            if node.db is None and node.name.lower() in self.ctes:
+                inner = build_query(
+                    self.ctes[node.name.lower()], self.catalog, self.db,
+                    self.subquery_value_fn, self.ctes,
+                )
+                alias = (node.alias or node.name).lower()
+                cols = [
+                    OutCol(alias, c.name, f"{alias}.{c.name}", c.type)
+                    for c in inner.schema
+                ]
+                return Projection(
+                    Schema(cols),
+                    inner,
+                    [
+                        (f"{alias}.{c.name}", ColumnRef(type=c.type, name=c.internal))
+                        for c in inner.schema
+                    ],
+                )
             db = node.db or self.db
             t = self.catalog.table(db, node.name)
             alias = (node.alias or node.name).lower()
@@ -292,7 +325,9 @@ class SelectBuilder:
             ]
             return Scan(Schema(cols), db, node.name.lower(), alias, [n for n, _ in t.schema.columns])
         if isinstance(node, ast.SubqueryRef):
-            inner = build_select(node.query, self.catalog, self.db, self.subquery_value_fn)
+            inner = build_query(
+                node.query, self.catalog, self.db, self.subquery_value_fn, self.ctes
+            )
             alias = node.alias.lower()
             cols = [
                 OutCol(alias, c.name, f"{alias}.{c.name}", c.type)
@@ -401,23 +436,93 @@ def _and_all(conj: List):
     return e
 
 
+def build_query(
+    stmt, catalog, current_db: str, subquery_value_fn=None, ctes=None
+) -> LogicalPlan:
+    """Top-level query lowering: SELECT | UNION | WITH."""
+    if isinstance(stmt, ast.With):
+        merged = dict(ctes or {})
+        for name, q in stmt.ctes:
+            merged[name] = q
+        return build_query(stmt.body, catalog, current_db, subquery_value_fn, merged)
+    if isinstance(stmt, ast.Union):
+        return _build_union(stmt, catalog, current_db, subquery_value_fn, ctes)
+    return build_select(stmt, catalog, current_db, subquery_value_fn, ctes)
+
+
+def _build_union(u: ast.Union, catalog, db, subquery_value_fn, ctes) -> LogicalPlan:
+    from tidb_tpu.dtypes import common_type
+
+    plans = [build_query(s, catalog, db, subquery_value_fn, ctes) for s in u.selects]
+    arity = len(plans[0].schema.cols)
+    for p in plans[1:]:
+        if len(p.schema.cols) != arity:
+            raise PlanError("UNION branches have different column counts")
+    names = [c.name for c in plans[0].schema.cols]
+    targets = []
+    for i in range(arity):
+        t = plans[0].schema.cols[i].type
+        for p in plans[1:]:
+            u_t = p.schema.cols[i].type
+            if u_t != t:
+                t = common_type(t, u_t)
+        targets.append(t)
+    children = []
+    for p in plans:
+        exprs = []
+        for i, tgt in enumerate(targets):
+            c = p.schema.cols[i]
+            ref = ColumnRef(type=c.type, name=c.internal)
+            e: Expr = ref if c.type == tgt else Func(type=tgt, op="cast", args=(ref,))
+            exprs.append((f"_u{i}", e))
+        sch = Schema([OutCol(None, names[i], f"_u{i}", targets[i]) for i in range(arity)])
+        children.append(Projection(sch, p, exprs))
+    out_schema = Schema(
+        [OutCol(None, names[i], f"_u{i}", targets[i]) for i in range(arity)]
+    )
+    plan: LogicalPlan = UnionAll(out_schema, children)
+    if not u.all:
+        plan = Aggregate(
+            out_schema,
+            plan,
+            [(f"_u{i}", ColumnRef(type=targets[i], name=f"_u{i}")) for i in range(arity)],
+            [],
+        )
+        # rename group keys back to _u names: Aggregate outputs use the
+        # given key names, which are already _u{i}
+    if u.order_by:
+        ob = ExprBinder(out_schema)
+        keys = []
+        for oi in u.order_by:
+            e = oi.expr
+            if isinstance(e, ast.Const) and isinstance(e.value, int):
+                e = ast.Name(None, names[e.value - 1])
+            keys.append((ob.bind(e), oi.desc))
+        plan = Sort(out_schema, plan, keys)
+    if u.limit is not None:
+        plan = Limit(out_schema, plan, u.limit, u.offset or 0)
+    return plan
+
+
 def build_select(
-    sel: ast.Select, catalog, current_db: str, subquery_value_fn=None
+    sel: ast.Select, catalog, current_db: str, subquery_value_fn=None, ctes=None
 ) -> LogicalPlan:
     """Full SELECT lowering: FROM -> WHERE (with pushdown + IN/EXISTS to
     semi/anti joins) -> AGG -> HAVING -> additive projection -> SORT ->
     LIMIT -> final projection."""
-    b = SelectBuilder(catalog, current_db, subquery_value_fn)
+    b = SelectBuilder(catalog, current_db, subquery_value_fn, ctes)
 
     if sel.from_ is None:
-        # tableless SELECT is evaluated on the host by the session layer
-        raise PlanError("tableless SELECT handled by session")
-
-    plan = b.build_from(sel.from_)
+        plan = OneRow(Schema([]))
+    else:
+        plan = b.build_from(sel.from_)
 
     # ---- WHERE ----
-    if sel.where is not None:
+    if sel.where is not None and not isinstance(plan, OneRow):
         plan = _apply_where(b, plan, sel.where, subquery_value_fn, catalog, current_db)
+    elif sel.where is not None:
+        binder0 = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        plan = Selection(plan.schema, plan, binder0.bind(sel.where))
 
     # ---- aggregate detection ----
     agg_calls: List[ast.AggCall] = []
@@ -653,6 +758,11 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
     if isinstance(plan, Limit):
         child = prune_plan(plan.child, required)
         return Limit(child.schema, child, plan.count, plan.offset)
+    if isinstance(plan, UnionAll):
+        # children always produce the full _u column set (positional union)
+        all_u = {c.internal for c in plan.schema.cols}
+        children = [prune_plan(c, all_u) for c in plan.children]
+        return UnionAll(plan.schema, children)
     return plan
 
 
@@ -808,7 +918,7 @@ def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
 def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db):
     """Uncorrelated IN/EXISTS -> semi/anti join (reference: decorrelation
     + semi-join rewrite in expression_rewriter.go)."""
-    inner = build_select(sq.query, catalog, db, subquery_value_fn)
+    inner = build_query(sq.query, catalog, db, subquery_value_fn, b.ctes)
     if sq.modifier in ("exists", "not exists"):
         raise PlanError("EXISTS subqueries need correlation support (later)")
     # IN: probe side = plan, build side = inner's single output column

@@ -101,13 +101,21 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
             )
         elif isinstance(p, L.Limit):
             parts.append(f"{p.count},{p.offset}")
-        for attr in ("child", "left", "right"):
-            c = getattr(p, attr, None)
-            if c is not None:
-                walk(c)
+        for c in _plan_children(p):
+            walk(c)
 
     walk(plan)
     return "|".join(parts)
+
+
+def _plan_children(p) -> List[L.LogicalPlan]:
+    out = []
+    for attr in ("child", "left", "right"):
+        c = getattr(p, attr, None)
+        if c is not None:
+            out.append(c)
+    out.extend(getattr(p, "children", []) or [])
+    return out
 
 
 class PlanCompiler:
@@ -178,6 +186,14 @@ class PlanCompiler:
 
     # ------------------------------------------------------------------
     def _build_node(self, plan: L.LogicalPlan):
+        if isinstance(plan, L.OneRow):
+
+            def fn_one(inputs, caps):
+                rv = jnp.zeros(256, dtype=bool).at[0].set(True)
+                return Batch({}, rv), {}
+
+            return fn_one, {}
+
         if isinstance(plan, L.Scan):
             nid = self.fresh_id()
             self.scans.append(
@@ -291,6 +307,59 @@ class PlanCompiler:
                 return limit_op(b, k, off), needs
 
             return fn_lim, dicts
+
+        if isinstance(plan, L.UnionAll):
+            built = [self._build(c) for c in plan.children]
+            fns = [f for f, _ in built]
+            child_dicts = [d for _, d in built]
+            internals = [c.internal for c in plan.schema.cols]
+            types = {c.internal: c.type for c in plan.schema.cols}
+            # merge dictionaries per string output column; per-child LUTs
+            out_dicts: Dicts = {}
+            luts: Dict[str, List[Optional[jax.Array]]] = {}
+            for name in internals:
+                if types[name].kind != Kind.STRING:
+                    continue
+                ds = [cd.get(name) for cd in child_dicts]
+                merged = np.array(
+                    sorted({s for d in ds if d is not None for s in d.tolist()}),
+                    dtype=object,
+                )
+                out_dicts[name] = merged
+                luts[name] = [
+                    jnp.asarray(
+                        np.searchsorted(merged, d).astype(np.int32)
+                        if d is not None and len(d)
+                        else np.zeros(1, np.int32)
+                    )
+                    for d in ds
+                ]
+
+            def fn_union(inputs, caps):
+                needs: Dict[int, jax.Array] = {}
+                batches = []
+                for f in fns:
+                    b, n = f(inputs, caps)
+                    needs.update(n)
+                    batches.append(b)
+                cols = {}
+                for name in internals:
+                    datas, valids = [], []
+                    for ci, b in enumerate(batches):
+                        c = b.cols[name]
+                        d = c.data
+                        if name in luts:
+                            lut = luts[name][ci]
+                            d = lut[jnp.clip(d, 0, lut.shape[0] - 1)]
+                        datas.append(d)
+                        valids.append(c.valid)
+                    cols[name] = DevCol(
+                        jnp.concatenate(datas), jnp.concatenate(valids)
+                    )
+                rv = jnp.concatenate([b.row_valid for b in batches])
+                return Batch(cols, rv), needs
+
+            return fn_union, out_dicts
 
         raise ExecError(f"no physical impl for {type(plan).__name__}")
 
@@ -472,10 +541,8 @@ class PhysicalExecutor:
             if isinstance(p, L.Scan):
                 t, v = self._resolve(p.db, p.table)
                 versions.append((p.db, p.table, id(t), v))
-            for attr in ("child", "left", "right"):
-                c = getattr(p, attr, None)
-                if c is not None:
-                    walk(c)
+            for c in _plan_children(p):
+                walk(c)
 
         walk(plan)
         return (fp, tuple(versions))
@@ -592,6 +659,8 @@ def _node_label(plan: L.LogicalPlan) -> str:
             f"Projection exprs={[n for n, _ in plan.exprs]}"
             + (" +base" if plan.additive else "")
         )
+    if isinstance(plan, L.UnionAll):
+        return f"UnionAll branches={len(plan.children)}"
     return name
 
 

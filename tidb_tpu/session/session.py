@@ -17,7 +17,7 @@ import numpy as np
 from tidb_tpu.chunk import batch_to_block, column_from_values, HostBlock
 from tidb_tpu.dtypes import Kind, SQLType
 from tidb_tpu.parser import ast, parse
-from tidb_tpu.planner import build_select
+from tidb_tpu.planner import build_query
 from tidb_tpu.planner.logical import ExprBinder, Schema
 from tidb_tpu.planner.physical import PhysicalExecutor
 from tidb_tpu.storage import Catalog, scan_table
@@ -146,7 +146,7 @@ class Session:
     # ------------------------------------------------------------------
     def _execute_stmt(self, s) -> Result:
         t0 = time.perf_counter()
-        if isinstance(s, ast.Select):
+        if isinstance(s, (ast.Select, ast.Union, ast.With)):
             r = self._run_select(s)
         elif isinstance(s, ast.CreateTable):
             schema = TableSchema(
@@ -311,13 +311,13 @@ class Session:
             raise ValueError("scalar subquery returned more than one row")
         return Literal(value=r.rows[0][0])
 
-    def _run_select(self, s: ast.Select) -> Result:
-        if s.from_ is None:
+    def _run_select(self, s) -> Result:
+        if isinstance(s, ast.Select) and s.from_ is None:
             return self._run_tableless(s)
         # spans mirror the reference's (session.ExecuteStmt ->
         # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
         with self.tracer.span("session.plan"):
-            plan = build_select(s, self.catalog, self.db, self._scalar_subquery)
+            plan = build_query(s, self.catalog, self.db, self._scalar_subquery)
         with self.tracer.span("executor.run"):
             batch, dicts = self.executor.run(plan)
         types = {c.internal: c.type for c in plan.schema}
@@ -424,7 +424,7 @@ class Session:
         )
         # plan against this table's db: resolve by search
         db = next(d for d in self.catalog.databases() if self.catalog.has_table(d, t.name))
-        plan = build_select(sel, self.catalog, db, self._scalar_subquery)
+        plan = build_query(sel, self.catalog, db, self._scalar_subquery)
         batch, dicts = self.executor.run(plan)
         internal = plan.schema.cols[0].internal
         c = batch.cols[internal]
@@ -439,9 +439,9 @@ class Session:
 
     # ------------------------------------------------------------------
     def _run_explain(self, s: ast.Explain) -> Result:
-        if not isinstance(s.stmt, ast.Select):
-            raise ValueError("EXPLAIN supports SELECT")
-        plan = build_select(s.stmt, self.catalog, self.db, self._scalar_subquery)
+        if not isinstance(s.stmt, (ast.Select, ast.Union, ast.With)):
+            raise ValueError("EXPLAIN supports SELECT/UNION/WITH")
+        plan = build_query(s.stmt, self.catalog, self.db, self._scalar_subquery)
         if s.analyze:
             _out, _dicts, lines = self.executor.run_analyze(plan)
             return Result(["plan"], [(l,) for l in lines])
