@@ -1,0 +1,194 @@
+"""Correlated and uncorrelated subqueries (reference:
+pkg/planner/core/expression_rewriter.go semi-join rewrites and
+decorrelateSolver in optimizer.go:98-123; null-aware anti join in
+pkg/executor/join/joiner.go)."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.must_exec("create database if not exists test")
+    s.must_exec(
+        "create table emp (id int, dept int, salary int, name varchar(20))"
+    )
+    s.must_exec(
+        "insert into emp values (1, 10, 100, 'a'), (2, 10, 200, 'b'), "
+        "(3, 20, 150, 'c'), (4, 20, 50, 'd'), (5, 30, 300, 'e'), "
+        "(6, null, 75, 'f')"
+    )
+    s.must_exec("create table dept (id int, dname varchar(20))")
+    s.must_exec("insert into dept values (10, 'x'), (20, 'y'), (40, 'z')")
+    return s
+
+
+def test_uncorrelated_in(sess):
+    r = sess.must_query(
+        "select id from emp where dept in (select id from dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [1, 2, 3, 4]
+
+
+def test_uncorrelated_not_in_null_aware(sess):
+    # dept has no NULLs -> rows with emp.dept NULL are dropped (NULL NOT IN
+    # (...) is UNKNOWN), rows 5 survive
+    r = sess.must_query(
+        "select id from emp where dept not in (select id from dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [5]
+    # now a NULL in the build side: NOT IN returns no rows at all
+    sess.must_exec("insert into dept values (null, 'w')")
+    r = sess.must_query(
+        "select id from emp where dept not in (select id from dept)"
+    )
+    assert r.rows == []
+
+
+def test_uncorrelated_exists(sess):
+    r = sess.must_query(
+        "select count(*) from emp where exists (select 1 from dept where id = 40)"
+    )
+    assert r.rows[0][0] == 6
+    r = sess.must_query(
+        "select count(*) from emp where exists (select 1 from dept where id = 99)"
+    )
+    assert r.rows[0][0] == 0
+    r = sess.must_query(
+        "select count(*) from emp where not exists (select 1 from dept where id = 99)"
+    )
+    assert r.rows[0][0] == 6
+
+
+def test_correlated_exists(sess):
+    r = sess.must_query(
+        "select id from emp e where exists "
+        "(select 1 from dept d where d.id = e.dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [1, 2, 3, 4]
+
+
+def test_correlated_not_exists(sess):
+    # NULL dept never matches -> NOT EXISTS keeps it (3-valued logic only
+    # bites for NOT IN)
+    r = sess.must_query(
+        "select id from emp e where not exists "
+        "(select 1 from dept d where d.id = e.dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [5, 6]
+
+
+def test_correlated_exists_with_filter(sess):
+    r = sess.must_query(
+        "select id from emp e where exists "
+        "(select 1 from dept d where d.id = e.dept and d.dname = 'x') "
+        "order by id"
+    )
+    assert [t[0] for t in r.rows] == [1, 2]
+
+
+def test_correlated_in(sess):
+    r = sess.must_query(
+        "select e.id from emp e where e.dept in "
+        "(select d.id from dept d where d.id = e.dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [1, 2, 3, 4]
+
+
+def test_correlated_scalar_avg(sess):
+    # employees above their department average
+    r = sess.must_query(
+        "select id from emp e where salary > "
+        "(select avg(salary) from emp e2 where e2.dept = e.dept) order by id"
+    )
+    assert [t[0] for t in r.rows] == [2, 3]
+
+
+def test_correlated_scalar_in_arithmetic(sess):
+    # TPC-H Q17 pattern: compare against a scaled aggregate
+    r = sess.must_query(
+        "select id from emp e where salary < "
+        "(select 0.5 * max(salary) from emp e2 where e2.dept = e.dept) "
+        "order by id"
+    )
+    assert [t[0] for t in r.rows] == [4]
+
+
+def test_correlated_scalar_count_empty_group(sess):
+    # count over an empty correlated set is 0, not NULL
+    r = sess.must_query(
+        "select id from emp e where "
+        "(select count(*) from dept d where d.id = e.dept) = 0 order by id"
+    )
+    assert [t[0] for t in r.rows] == [5, 6]
+
+
+def test_scalar_uncorrelated_still_works(sess):
+    r = sess.must_query(
+        "select id from emp where salary > (select avg(salary) from emp) "
+        "order by id"
+    )
+    # avg = 875/6 = 145.83 -> salaries 200, 150, 300 qualify
+    assert [t[0] for t in r.rows] == [2, 3, 5]
+
+
+def test_exists_respects_limit_zero(sess):
+    r = sess.must_query(
+        "select count(*) from emp where exists (select 1 from dept limit 0)"
+    )
+    assert r.rows[0][0] == 0
+
+
+def test_correlated_not_in_rejected(sess):
+    with pytest.raises(Exception, match="NOT IN"):
+        sess.execute(
+            "select id from emp e where dept not in "
+            "(select d.id from dept d where d.id = e.dept)"
+        )
+
+
+def test_tpch_q21_q22_shapes(sess):
+    """Nested EXISTS + NOT EXISTS in one WHERE (the Q21 shape)."""
+    r = sess.must_query(
+        "select e.id from emp e where "
+        "exists (select 1 from emp e2 where e2.dept = e.dept and e2.id <> e.id) "
+        "and not exists (select 1 from emp e3 where e3.dept = e.dept "
+        "and e3.salary > e.salary) order by e.id"
+    )
+    # depts with >1 member: 10 (1,2), 20 (3,4); top earners: 2 and 3
+    assert [t[0] for t in r.rows] == [2, 3]
+
+
+def test_exists_aggregate_subquery_always_true(sess):
+    """An aggregate subquery without GROUP BY returns exactly one row,
+    so EXISTS over it is unconditionally true (MySQL semantics)."""
+    r = sess.must_query(
+        "select count(*) from emp where exists "
+        "(select count(*) from dept d where d.id = emp.dept)"
+    )
+    assert r.rows[0][0] == 6
+    r = sess.must_query(
+        "select count(*) from emp where not exists "
+        "(select count(*) from dept d where d.id = emp.dept)"
+    )
+    assert r.rows[0][0] == 0
+
+
+def test_correlated_scalar_count_in_expression(sess):
+    """count nested in arithmetic still folds to 0 over empty groups."""
+    r = sess.must_query(
+        "select id from emp e where "
+        "(select count(*) * 1 from dept d where d.id = e.dept) = 0 "
+        "order by id"
+    )
+    assert [t[0] for t in r.rows] == [5, 6]
+
+
+def test_correlated_in_aggregate_rejected(sess):
+    with pytest.raises(Exception, match="aggregate"):
+        sess.execute(
+            "select id from emp e where id in "
+            "(select max(d.id) from dept d where d.id = e.dept)"
+        )

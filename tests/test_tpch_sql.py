@@ -252,3 +252,122 @@ def test_q10_left_style(sess):
     )
     assert len(r.rows) <= 20
     assert all(row[1] is None or row[1] >= 0 for row in r.rows)
+
+
+def test_q4(sess):
+    """Q4: EXISTS correlated subquery (reference: TPC-H Q4; planner
+    rewrite mirrors expression_rewriter.go semi-join conversion)."""
+    r = sess.must_query(
+        "select o_orderpriority, count(*) as order_count from orders "
+        "where o_orderdate >= date '1993-07-01' "
+        "and o_orderdate < date '1993-10-01' "
+        "and exists (select * from lineitem where l_orderkey = o_orderkey "
+        "and l_commitdate < l_receiptdate) "
+        "group by o_orderpriority order by o_orderpriority"
+    )
+    orders, no = decode_table(sess, "orders")
+    li, nl = decode_table(sess, "lineitem")
+    ok_set = {
+        li["l_orderkey"][i]
+        for i in range(nl)
+        if li["l_commitdate"][i] < li["l_receiptdate"][i]
+    }
+    d0, d1 = days("1993-07-01"), days("1993-10-01")
+    cnt = defaultdict(int)
+    for i in range(no):
+        od = orders["o_orderdate"][i]
+        if d0 <= od < d1 and orders["o_orderkey"][i] in ok_set:
+            cnt[orders["o_orderpriority"][i]] += 1
+    expected = sorted(cnt.items())
+    assert [(p, c) for p, c in r.rows] == expected
+
+
+def test_q17(sess):
+    """Q17: correlated scalar aggregate subquery (decorrelated to a
+    left join on l_partkey group aggregates)."""
+    r = sess.must_query(
+        "select sum(l_extendedprice) / 7.0 as avg_yearly "
+        "from lineitem, part "
+        "where p_partkey = l_partkey and p_brand = 'Brand#23' "
+        "and p_container = 'MED BAG' "
+        "and l_quantity < (select 0.2 * avg(l_quantity) from lineitem "
+        "where l_partkey = p_partkey)"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    part, np_ = decode_table(sess, "part")
+    part_ok = {
+        part["p_partkey"][i]
+        for i in range(np_)
+        if part["p_brand"][i] == "Brand#23"
+        and part["p_container"][i] == "MED BAG"
+    }
+    sums = defaultdict(float)
+    counts = defaultdict(int)
+    for i in range(nl):
+        pk = li["l_partkey"][i]
+        sums[pk] += li["l_quantity"][i]
+        counts[pk] += 1
+    total = 0.0
+    for i in range(nl):
+        pk = li["l_partkey"][i]
+        if pk in part_ok and li["l_quantity"][i] < 0.2 * sums[pk] / counts[pk]:
+            total += li["l_extendedprice"][i]
+    expected = total / 7.0
+    got = r.rows[0][0]
+    if expected == 0:
+        assert got is None or got == 0
+    else:
+        assert math.isclose(got, expected, rel_tol=1e-6)
+
+
+def test_q21(sess):
+    """Q21: EXISTS + NOT EXISTS with non-equality residual correlation
+    (the hardest subquery shape in TPC-H; grouped by s_suppkey since the
+    toy generator carries no s_name)."""
+    r = sess.must_query(
+        "select s_suppkey, count(*) as numwait "
+        "from supplier, lineitem l1, orders, nation "
+        "where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey "
+        "and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate "
+        "and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA' "
+        "and exists (select * from lineitem l2 where "
+        "l2.l_orderkey = l1.l_orderkey and l2.l_suppkey <> l1.l_suppkey) "
+        "and not exists (select * from lineitem l3 where "
+        "l3.l_orderkey = l1.l_orderkey and l3.l_suppkey <> l1.l_suppkey "
+        "and l3.l_receiptdate > l3.l_commitdate) "
+        "group by s_suppkey order by numwait desc, s_suppkey limit 100"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    supp, ns = decode_table(sess, "supplier")
+    nation, nn = decode_table(sess, "nation")
+    saudi = {
+        nation["n_nationkey"][i]
+        for i in range(nn)
+        if nation["n_name"][i] == "SAUDI ARABIA"
+    }
+    s_nat = {supp["s_suppkey"][i]: supp["s_nationkey"][i] for i in range(ns)}
+    status_f = {
+        orders["o_orderkey"][i]
+        for i in range(no)
+        if orders["o_orderstatus"][i] == "F"
+    }
+    by_order = defaultdict(list)
+    for i in range(nl):
+        by_order[li["l_orderkey"][i]].append(i)
+    cnt = defaultdict(int)
+    for i in range(nl):
+        sk = li["l_suppkey"][i]
+        okey = li["l_orderkey"][i]
+        if s_nat.get(sk) not in saudi or okey not in status_f:
+            continue
+        if not (li["l_receiptdate"][i] > li["l_commitdate"][i]):
+            continue
+        others = [j for j in by_order[okey] if li["l_suppkey"][j] != sk]
+        if not others:
+            continue
+        if any(li["l_receiptdate"][j] > li["l_commitdate"][j] for j in others):
+            continue
+        cnt[sk] += 1
+    expected = sorted(cnt.items(), key=lambda t: (-t[1], t[0]))[:100]
+    assert [(a, b) for a, b in r.rows] == expected

@@ -292,6 +292,9 @@ class SelectBuilder:
         # subquery_value_fn(select_ast) -> Literal  (executes scalar subq)
         self.subquery_value_fn = subquery_value_fn
         self.ctes = ctes or {}
+        # deterministic per-query naming for decorrelated scalar columns
+        # (plan reprs key the jit cache, so names must be parse-stable)
+        self._dsq_counter = 0
 
     # -- FROM --------------------------------------------------------------
     def build_from(self, node) -> LogicalPlan:
@@ -794,13 +797,16 @@ def _scalar_subq(subquery_value_fn):
 
 def _apply_where(b, plan, where, subquery_value_fn, catalog, db):
     """Split WHERE conjuncts: IN/EXISTS subqueries become semi/anti
-    joins; plain predicates run through cross-join elimination (reference
-    ppdSolver + joinReOrderSolver, optimizer.go:98-123): single-relation
-    conjuncts sink onto their relation, eq-conjuncts linking two
-    relations of a comma-join become inner-join keys, the rest filter on
-    top."""
+    joins; conjuncts containing a correlated scalar subquery are
+    decorrelated into a left join on the correlation keys (reference
+    decorrelateSolver, optimizer.go:98-123); plain predicates run
+    through cross-join elimination (ppdSolver + joinReOrderSolver):
+    single-relation conjuncts sink onto their relation, eq-conjuncts
+    linking two relations of a comma-join become inner-join keys, the
+    rest filter on top."""
     plain: List = []
     subq: List = []
+    corr_scalar: List = []
     for c in _conjuncts(where):
         if isinstance(c, ast.SubqueryExpr) and c.modifier in ("in", "not in", "exists", "not exists"):
             subq.append(c)
@@ -808,12 +814,19 @@ def _apply_where(b, plan, where, subquery_value_fn, catalog, db):
             sq = c.args[0]
             mod = {"in": "not in", "exists": "not exists"}[sq.modifier]
             subq.append(ast.SubqueryExpr(sq.query, mod, sq.lhs))
+        elif any(
+            _is_correlated(s.query, plan.schema, b)
+            for s in _scalar_subqs_in(c, [])
+        ):
+            corr_scalar.append(c)
         else:
             plain.append(c)
     if plain:
         plan = _reorder_joins(plan, plain, subquery_value_fn)
     for c in subq:
         plan = _subquery_semijoin(b, plan, c, subquery_value_fn, catalog, db)
+    for c in corr_scalar:
+        plan = _decorrelate_scalar(b, plan, c, subquery_value_fn, catalog, db)
     return plan
 
 
@@ -915,26 +928,377 @@ def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
     return cur
 
 
+# -- correlated subquery support (reference: decorrelateSolver +
+# expression_rewriter.go semi-join / scalar-agg rewrites) -------------------
+
+
+def _scalar_subqs_in(e, out: List) -> List:
+    """Collect scalar (modifier=None) SubqueryExprs one level deep."""
+    if isinstance(e, ast.SubqueryExpr):
+        if e.modifier is None:
+            out.append(e)
+        if e.lhs is not None:
+            _scalar_subqs_in(e.lhs, out)
+    elif isinstance(e, ast.Call):
+        for a in e.args:
+            _scalar_subqs_in(a, out)
+    return out
+
+
+def _replace_node(e, target, repl):
+    """Rebuild expression AST with the (identity-matched) target node
+    replaced."""
+    if e is target:
+        return repl
+    if isinstance(e, ast.Call):
+        return ast.Call(e.op, [_replace_node(a, target, repl) for a in e.args], e.cast_type)
+    return e
+
+
+def _has_agg(e) -> bool:
+    if isinstance(e, ast.AggCall):
+        return True
+    if isinstance(e, ast.Call):
+        return any(_has_agg(a) for a in e.args)
+    return False
+
+
+def _inner_from_schema(q: ast.Select, b) -> Optional[Schema]:
+    if q.from_ is None:
+        return None
+    cache = getattr(b, "_ifs_cache", None)
+    if cache is None:
+        cache = b._ifs_cache = {}
+    key = id(q)
+    if key not in cache:
+        inner_b = SelectBuilder(b.catalog, b.db, b.subquery_value_fn, b.ctes)
+        cache[key] = inner_b.build_from(q.from_).schema
+    return cache[key]
+
+
+def _is_correlated(q: ast.Select, outer_schema: Schema, b) -> bool:
+    """True if q.where references columns resolvable only in the outer
+    scope (one level; inner scope shadows outer, standard SQL)."""
+    if q.from_ is None or q.where is None:
+        return False
+    try:
+        inner_schema = _inner_from_schema(q, b)
+    except PlanError:
+        return False
+    for tbl, col in _ast_columns(q.where, set()):
+        try:
+            inner_schema.resolve(tbl, col)
+        except PlanError:
+            try:
+                outer_schema.resolve(tbl, col)
+                return True
+            except PlanError:
+                pass
+    return False
+
+
+def _corr_split(q: ast.Select, outer_schema: Schema, b):
+    """Split q.where by correlation.
+
+    Returns (corr_pairs, kept_where, residuals, extra_items):
+    corr_pairs is a list of (outer_ast, inner_ast) from conjuncts of the
+    form ``inner_expr = outer_expr``; kept_where is the AND of the
+    purely inner conjuncts (or None); residuals are the remaining
+    correlated conjuncts with their inner column references rewritten to
+    ``_cr{j}`` names, and extra_items the (alias, inner Name) pairs the
+    subquery must additionally project so those residuals can evaluate
+    on the joined row (reference: other-conditions on semi joins,
+    joiner.go)."""
+    inner_schema = _inner_from_schema(q, b)
+
+    def scope(e) -> str:
+        has_inner = has_outer = False
+        for tbl, col in _ast_columns(e, set()):
+            try:
+                inner_schema.resolve(tbl, col)
+                has_inner = True
+                continue
+            except PlanError:
+                pass
+            try:
+                outer_schema.resolve(tbl, col)
+                has_outer = True
+            except PlanError:
+                raise PlanError(f"unknown column {col} in subquery")
+        if has_inner and has_outer:
+            return "mixed"
+        if has_outer:
+            return "outer"
+        return "inner"  # includes constant-only
+
+    extra_items: List[Tuple[str, ast.Name]] = []
+    cr_map: Dict[Tuple[Optional[str], str], str] = {}
+
+    def rewrite_inner(e):
+        if isinstance(e, ast.Name):
+            try:
+                inner_schema.resolve(e.table, e.column)
+            except PlanError:
+                return e  # outer reference, binds over the joined schema
+            key = (e.table.lower() if e.table else None, e.column.lower())
+            if key not in cr_map:
+                alias = f"_cr{len(cr_map)}"
+                cr_map[key] = alias
+                extra_items.append((alias, e))
+            return ast.Name(None, cr_map[key])
+        if isinstance(e, ast.Call):
+            return ast.Call(e.op, [rewrite_inner(a) for a in e.args], e.cast_type)
+        return e
+
+    corr_pairs: List[Tuple[object, object]] = []
+    kept: List = []
+    residuals: List = []
+    for c in _conjuncts(q.where) if q.where is not None else []:
+        if _scalar_subqs_in(c, []) or isinstance(c, ast.SubqueryExpr):
+            kept.append(c)  # nested subqueries resolve in their own pass
+            continue
+        s = scope(c)
+        if s == "inner":
+            kept.append(c)
+            continue
+        if isinstance(c, ast.Call) and c.op == "eq":
+            s0, s1 = scope(c.args[0]), scope(c.args[1])
+            if s0 == "inner" and s1 == "outer":
+                corr_pairs.append((c.args[1], c.args[0]))
+                continue
+            if s0 == "outer" and s1 == "inner":
+                corr_pairs.append((c.args[0], c.args[1]))
+                continue
+        residuals.append(rewrite_inner(c))
+    return corr_pairs, (_and_all(kept) if kept else None), residuals, extra_items
+
+
+def _check_simple_subquery(q: ast.Select, what: str) -> None:
+    if q.group_by or q.having or q.order_by or q.limit is not None:
+        raise PlanError(
+            f"correlated {what} subquery with GROUP BY/HAVING/ORDER/LIMIT "
+            "not supported"
+        )
+
+
+def _items_aggregate(q: ast.Select) -> bool:
+    return any(
+        not isinstance(it.expr, ast.Star) and _has_agg(it.expr)
+        for it in q.items
+    )
+
+
+def _empty_group_value(e):
+    """Value of an aggregate output expression over an EMPTY group:
+    count -> 0, other aggs -> NULL, NULL propagating through arithmetic
+    (MySQL scalar-subquery-with-no-rows semantics). Returns None for
+    NULL or when the expression can't be folded."""
+    if isinstance(e, ast.AggCall):
+        return 0 if e.func == "count" else None
+    if isinstance(e, ast.Const):
+        return e.value
+    if isinstance(e, ast.Call):
+        args = [_empty_group_value(a) for a in e.args]
+        if e.op == "coalesce":
+            return next((a for a in args if a is not None), None)
+        if any(a is None for a in args):
+            return None
+        if e.op == "add":
+            return args[0] + args[1]
+        if e.op == "sub":
+            return args[0] - args[1]
+        if e.op == "mul":
+            return args[0] * args[1]
+        if e.op == "div":
+            return None if args[1] == 0 else args[0] / args[1]
+        if e.op == "neg":
+            return -args[0]
+    return None
+
+
+def _bind_corr_keys(ob: "ExprBinder", corr_pairs, inner_cols) -> List[Tuple[Expr, Expr]]:
+    return [
+        (ob.bind(oe), ColumnRef(type=c.type, name=c.internal))
+        for (oe, _ie), c in zip(corr_pairs, inner_cols)
+    ]
+
+
+def _bind_residuals(outer_schema, inner_schema, residuals, subquery_value_fn):
+    if not residuals:
+        return None
+    joined = Schema(list(outer_schema.cols) + list(inner_schema.cols))
+    return ExprBinder(joined, _scalar_subq(subquery_value_fn)).bind(
+        _and_all(residuals)
+    )
+
+
 def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db):
-    """Uncorrelated IN/EXISTS -> semi/anti join (reference: decorrelation
-    + semi-join rewrite in expression_rewriter.go)."""
-    inner = build_query(sq.query, catalog, db, subquery_value_fn, b.ctes)
+    """IN/EXISTS (correlated or not) -> semi/anti join (reference:
+    decorrelation + semi-join rewrite in expression_rewriter.go)."""
+    q = sq.query
+    correlated = _is_correlated(q, plan.schema, b)
+
     if sq.modifier in ("exists", "not exists"):
-        raise PlanError("EXISTS subqueries need correlation support (later)")
+        if not q.group_by and _items_aggregate(q):
+            # An aggregate subquery without GROUP BY yields exactly one
+            # row regardless of its input (even an empty, even a
+            # correlated one) -> EXISTS is unconditionally true.
+            want = sq.modifier == "exists"
+            return plan if want else Limit(plan.schema, plan, 0, 0)
+        if not correlated:
+            # Evaluate once: COUNT(*) over the subquery as a derived table
+            # (keeps GROUP BY/HAVING/LIMIT semantics intact).
+            if subquery_value_fn is None:
+                raise PlanError("EXISTS subquery needs a session context")
+            cnt_q = ast.Select(
+                items=[ast.SelectItem(ast.AggCall("count", None), alias="_c")],
+                from_=ast.SubqueryRef(dataclasses.replace(q, order_by=[]), "_ex"),
+            )
+            n = subquery_value_fn(cnt_q).value
+            hit = (n or 0) > 0
+            want = sq.modifier == "exists"
+            return plan if hit == want else Limit(plan.schema, plan, 0, 0)
+        _check_simple_subquery(q, "EXISTS")
+        corr_pairs, kept, residuals, extra = _corr_split(q, plan.schema, b)
+        if not corr_pairs:
+            raise PlanError(
+                "correlated EXISTS needs at least one equality correlation"
+            )
+        inner_q = dataclasses.replace(
+            q,
+            items=[
+                ast.SelectItem(ie, alias=f"_ck{i}")
+                for i, (_oe, ie) in enumerate(corr_pairs)
+            ]
+            + [ast.SelectItem(ie, alias=al) for al, ie in extra],
+            where=kept,
+            distinct=False,
+        )
+        inner = build_query(inner_q, catalog, db, subquery_value_fn, b.ctes)
+        ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        keys = _bind_corr_keys(ob, corr_pairs, inner.schema.cols)
+        res = _bind_residuals(plan.schema, inner.schema, residuals, subquery_value_fn)
+        kind = "semi" if sq.modifier == "exists" else "anti"
+        return JoinPlan(plan.schema, kind, plan, inner, keys, res)
+
     # IN: probe side = plan, build side = inner's single output column
-    if len(inner.schema.cols) != 1:
+    corr_pairs: List[Tuple[object, object]] = []
+    inner_q = q
+    if correlated:
+        if sq.modifier == "not in":
+            raise PlanError(
+                "correlated NOT IN not supported (use NOT EXISTS)"
+            )
+        _check_simple_subquery(q, "IN")
+        if _items_aggregate(q):
+            raise PlanError(
+                "aggregate in correlated IN subquery not supported "
+                "(rewrite as a comparison with the scalar subquery)"
+            )
+        corr_pairs, kept, residuals, extra = _corr_split(q, plan.schema, b)
+        if len(q.items) != 1:
+            raise PlanError("IN subquery must select exactly one column")
+        inner_q = dataclasses.replace(
+            q,
+            items=list(q.items)
+            + [
+                ast.SelectItem(ie, alias=f"_ck{i}")
+                for i, (_oe, ie) in enumerate(corr_pairs)
+            ]
+            + [ast.SelectItem(ie, alias=al) for al, ie in extra],
+            where=kept,
+            distinct=False,
+        )
+    else:
+        residuals, extra = [], []
+    inner = build_query(inner_q, catalog, db, subquery_value_fn, b.ctes)
+    if len(inner.schema.cols) != 1 + len(corr_pairs) + len(extra):
         raise PlanError("IN subquery must select exactly one column")
-    lhs_bound = ExprBinder(plan.schema).bind(sq.lhs)
+    ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+    lhs_bound = ob.bind(sq.lhs)
     rhs_col = inner.schema.cols[0]
     kind = "semi" if sq.modifier == "in" else "anti"
+    keys = [(lhs_bound, ColumnRef(type=rhs_col.type, name=rhs_col.internal))]
+    keys += _bind_corr_keys(ob, corr_pairs, inner.schema.cols[1 : 1 + len(corr_pairs)])
+    res = _bind_residuals(plan.schema, inner.schema, residuals, subquery_value_fn)
     return JoinPlan(
         plan.schema,
         kind,
         plan,
         inner,
-        [(lhs_bound, ColumnRef(type=rhs_col.type, name=rhs_col.internal))],
-        None,
+        keys,
+        res,
         null_aware=(sq.modifier == "not in"),
+    )
+
+
+def _decorrelate_scalar(b, plan, conjunct, subquery_value_fn, catalog, db):
+    """``expr CMP (SELECT agg(...) FROM t WHERE t.k = outer.k)`` ->
+    left join onto ``SELECT k, agg(...) FROM t GROUP BY k`` and rewrite
+    the comparison against the joined value column (reference:
+    decorrelateSolver's agg-pull-up, logical Apply -> join conversion).
+
+    An outer row with no matching group sees NULL (COUNT sees 0), which
+    matches MySQL's empty-scalar-subquery semantics."""
+    subqs = [
+        s
+        for s in _scalar_subqs_in(conjunct, [])
+        if _is_correlated(s.query, plan.schema, b)
+    ]
+    if len(subqs) != 1:
+        raise PlanError("only one correlated scalar subquery per predicate")
+    sq = subqs[0]
+    q = sq.query
+    _check_simple_subquery(q, "scalar")
+    if len(q.items) != 1:
+        raise PlanError("scalar subquery must select exactly one column")
+    if not _has_agg(q.items[0].expr):
+        raise PlanError(
+            "correlated scalar subquery must aggregate (else it can "
+            "return multiple rows per outer row)"
+        )
+    corr_pairs, kept, residuals, _extra = _corr_split(q, plan.schema, b)
+    if not corr_pairs:
+        raise PlanError("correlated scalar subquery has no correlation keys")
+    if residuals:
+        raise PlanError(
+            "correlated scalar subquery supports only equality correlation"
+        )
+    n = b._dsq_counter
+    b._dsq_counter += 1
+    ck = [f"_dsq{n}_ck{i}" for i in range(len(corr_pairs))]
+    sv = f"_dsq{n}_v"
+    derived = ast.Select(
+        items=[
+            ast.SelectItem(ie, alias=ck[i])
+            for i, (_oe, ie) in enumerate(corr_pairs)
+        ]
+        + [ast.SelectItem(q.items[0].expr, alias=sv)],
+        from_=q.from_,
+        where=kept,
+        group_by=[ie for (_oe, ie) in corr_pairs],
+    )
+    inner = build_query(derived, catalog, db, subquery_value_fn, b.ctes)
+    ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+    keys = _bind_corr_keys(ob, corr_pairs, inner.schema.cols)
+    joined = Schema(list(plan.schema.cols) + list(inner.schema.cols))
+    jp = JoinPlan(joined, "left", plan, inner, keys, None)
+    # An outer row with no matching group sees the aggregate's
+    # empty-group value: NULL for most, but COUNT-driven expressions
+    # fold to a non-NULL constant (count()=0) which the left join's NULL
+    # must be coalesced to. Safe because such expressions are also
+    # never NULL for matching groups.
+    ref: object = ast.Name(None, sv)
+    empty_v = _empty_group_value(q.items[0].expr)
+    if empty_v is not None:
+        ref = ast.Call("coalesce", [ref, ast.Const(empty_v)])
+    new_pred = _replace_node(conjunct, sq, ref)
+    jb = ExprBinder(joined, _scalar_subq(subquery_value_fn))
+    sel = Selection(joined, jp, jb.bind(new_pred))
+    return Projection(
+        plan.schema,
+        sel,
+        [(c.internal, ColumnRef(type=c.type, name=c.internal)) for c in plan.schema],
     )
 
 

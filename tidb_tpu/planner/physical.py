@@ -452,8 +452,8 @@ class PlanCompiler:
             lkey, rkey = lkeys[0], rkeys[0]
             verify = None
         else:
-            if plan.kind != "inner":
-                raise ExecError("multi-key non-inner join not yet supported")
+            if plan.kind not in ("inner", "semi", "anti"):
+                raise ExecError("multi-key outer join not yet supported")
             lkey = _hash_combine(lkeys)
             rkey = _hash_combine(rkeys)
             verify = (lkeys, rkeys)
@@ -463,19 +463,68 @@ class PlanCompiler:
         res = compile_expr(plan.residual, dicts) if plan.residual is not None else None
 
         if kind in ("semi", "anti"):
+            if verify is None and res is None:
 
-            def fn_semi(inputs, caps):
+                def fn_semi(inputs, caps):
+                    lb, n1 = left(inputs, caps)
+                    rb, n2 = right(inputs, caps)
+                    out, _t = equi_join(rb, lb, rkey, lkey, 0, kind)
+                    if null_aware and kind == "anti":
+                        bk = rkey(rb)
+                        has_null = jnp.any(~bk.valid & rb.row_valid)
+                        pk = lkey(out)
+                        out = Batch(out.cols, out.row_valid & ~has_null & pk.valid)
+                    return out, {**n1, **n2}
+
+                return fn_semi, {**ldicts}
+
+            # Semi/anti with multiple keys and/or a residual predicate
+            # (correlated EXISTS): hash-combined keys can collide and
+            # residuals need both sides' columns, so expand via an inner
+            # join carrying a probe row id, verify every key pair exactly,
+            # apply the residual, then mask the probe batch by surviving
+            # row ids (an exact single-key semi join).
+            if null_aware:
+                raise ExecError("null-aware multi-key anti join not supported")
+            nid = self.fresh_id()
+            self.sized.append(nid)
+            self.defaults[nid] = 0
+            lks_rks = verify
+
+            def fn_semi_multi(inputs, caps):
                 lb, n1 = left(inputs, caps)
                 rb, n2 = right(inputs, caps)
-                out, _t = equi_join(rb, lb, rkey, lkey, 0, kind)
-                if null_aware and kind == "anti":
-                    bk = rkey(rb)
-                    has_null = jnp.any(~bk.valid & rb.row_valid)
-                    pk = lkey(out)
-                    out = Batch(out.cols, out.row_valid & ~has_null & pk.valid)
-                return out, {**n1, **n2}
+                rid = jnp.arange(lb.capacity, dtype=jnp.int64)
+                lb2 = Batch(
+                    {**lb.cols, "_srowid": DevCol(rid, lb.row_valid)},
+                    lb.row_valid,
+                )
+                cap = caps[nid] or pad_capacity(max(lb.capacity, 1024))
+                j, total = equi_join(rb, lb2, rkey, lkey, cap, "inner")
+                if lks_rks is not None:
+                    lks, rks = lks_rks
 
-            return fn_semi, {**ldicts}
+                    def vf(bb):
+                        ok = jnp.ones(bb.capacity, dtype=bool)
+                        for lf2, rf2 in zip(lks, rks):
+                            a, c = lf2(bb), rf2(bb)
+                            ok = ok & (a.data == c.data) & a.valid & c.valid
+                        return DevCol(ok, jnp.ones(bb.capacity, dtype=bool))
+
+                    j = filter_batch(j, vf)
+                if res is not None:
+                    j = filter_batch(j, res)
+                ridc = lambda b: b.cols["_srowid"]
+                out, _t = equi_join(j, lb2, ridc, ridc, 0, kind)
+                out = Batch(
+                    {k: v for k, v in out.cols.items() if k != "_srowid"},
+                    out.row_valid,
+                )
+                needs = {**n1, **n2}
+                needs[nid] = total
+                return out, needs
+
+            return fn_semi_multi, {**ldicts}
 
         nid = self.fresh_id()
         self.sized.append(nid)
