@@ -88,6 +88,16 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
     assert isinstance(e, Func)
     args = tuple(bind_expr(a, schema) for a in e.args)
     args = _coerce_date_literals(e.op, args)
+    if e.op == "neg" and isinstance(args[0], Literal):
+        v = args[0].value
+        if isinstance(v, str):
+            try:
+                f = float(v)
+                v = int(f) if f == int(f) else f
+            except ValueError:
+                v = 0  # MySQL: non-numeric string coerces to 0; -0 = 0
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return Literal(type=literal_type(-v), value=-v)
     t = _infer(e.op, args, e.type)
     return Func(type=t, op=e.op, args=args)
 
@@ -95,7 +105,7 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
 def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
     """MySQL coerces date-string literals when compared with DATE columns:
     `d < '1995-01-01'` compares as dates, not strings."""
-    if op not in COMPARE and op not in {"in", "add", "sub"}:
+    if op not in COMPARE and op not in {"in", "add", "sub", "datediff"}:
         return args
     if not any(a.type is not None and a.type.kind == Kind.DATE for a in args):
         return args
@@ -158,10 +168,41 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         for u in vals[1:]:
             t = common_type(t, u) if t != u else t
         return t
-    if op in {"year", "month", "day", "length"}:
+    if op in {
+        "year", "month", "day", "dayofweek", "weekday", "dayofyear",
+        "quarter", "length", "char_length", "ascii", "locate", "sign",
+        "datediff", "floor", "ceil",
+    }:
         return INT64
-    if op == "substr":
+    if op in {
+        "substr", "substring", "upper", "lower", "trim", "ltrim", "rtrim",
+        "replace", "left", "right", "reverse", "lpad", "rpad", "repeat",
+        "concat", "concat_ws",
+    }:
         return STRING
+    if op in {
+        "sqrt", "exp", "ln", "log", "log2", "log10", "radians", "degrees",
+        "sin", "cos", "tan", "asin", "acos", "atan", "cot", "atan2", "pow",
+        "pi",
+    }:
+        return FLOAT64
+    if op == "abs":
+        return ts[0]
+    if op in {"greatest", "least"}:
+        t = ts[0]
+        for u in ts[1:]:
+            t = common_type(t, u)
+        return t
+    if op in {"round", "truncate"}:
+        digits = 0
+        if len(args) > 1 and isinstance(args[1], Literal) and args[1].value is not None:
+            digits = int(args[1].value)
+        t0 = ts[0]
+        if t0.kind == Kind.FLOAT:
+            return FLOAT64
+        if t0.kind == Kind.DECIMAL and digits > 0:
+            return DECIMAL(digits)
+        return INT64
     raise NotImplementedError(f"type inference for op {op!r}")
 
 

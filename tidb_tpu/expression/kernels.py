@@ -124,6 +124,31 @@ def string_expr(e: Expr, dicts: DictContext):
             )
 
         return _lit, d
+    if isinstance(e, Func) and e.op in _STR_TRANSFORMS:
+        for a in e.args[1:]:
+            if not isinstance(a, Literal):
+                raise NotImplementedError(
+                    f"{e.op}: non-literal extra arguments not supported"
+                )
+        fn, d = string_expr(e.args[0], dicts)
+        pyfn = _str_transform_pyfn(e)
+        vals = [str(pyfn(str(s))) for s in d.tolist()]
+        new_dict = np.array(sorted(set(vals)), dtype=object)
+        lut = jnp.asarray(
+            np.searchsorted(new_dict, np.array(vals, dtype=object)).astype(np.int32)
+            if vals
+            else np.zeros(1, np.int32)
+        )
+
+        def _tf(b):
+            c = fn(b)
+            return DevCol(lut[jnp.clip(c.data, 0, lut.shape[0] - 1)], c.valid)
+
+        return _tf, new_dict
+    if isinstance(e, Func) and e.op == "concat":
+        return _concat_expr(e, dicts)
+    if isinstance(e, Func) and e.op == "concat_ws":
+        return _concat_ws_expr(e, dicts)
     if isinstance(e, Func) and e.op in ("case", "coalesce", "ifnull"):
         if e.op == "case":
             args = list(e.args)
@@ -188,6 +213,184 @@ def string_expr(e: Expr, dicts: DictContext):
     raise NotImplementedError(f"string-valued expression {e!r}")
 
 
+# String->string builtins evaluated on the dictionary: O(|dict|) host work
+# regardless of row count, codes remapped on device (reference: the
+# per-row builtin_string_vec.go loops; the dictionary makes them LUTs).
+_STR_TRANSFORMS = {
+    "upper", "lower", "trim", "ltrim", "rtrim", "replace", "substring",
+    "left", "right", "reverse", "lpad", "rpad", "repeat",
+}
+
+
+def _str_transform_pyfn(e: Func):
+    op = e.op
+    ex = [a.value for a in e.args[1:]]
+    if op == "upper":
+        return lambda s: s.upper()
+    if op == "lower":
+        return lambda s: s.lower()
+    if op == "trim":
+        return lambda s: s.strip()
+    if op == "ltrim":
+        return lambda s: s.lstrip()
+    if op == "rtrim":
+        return lambda s: s.rstrip()
+    if op == "reverse":
+        return lambda s: s[::-1]
+    if op == "replace":
+        frm, to = str(ex[0]), str(ex[1])
+        return lambda s: s.replace(frm, to) if frm else s
+    if op == "left":
+        n = max(int(ex[0]), 0)
+        return lambda s: s[:n]
+    if op == "right":
+        n = max(int(ex[0]), 0)
+        return lambda s: s[-n:] if n else ""
+    if op == "repeat":
+        n = max(int(ex[0]), 0)
+        return lambda s: s * n
+    if op == "lpad":
+        n, pad = int(ex[0]), str(ex[1])
+        def _lpad(s):
+            if len(s) >= n or not pad:
+                return s[:n]
+            fill = (pad * n)[: n - len(s)]
+            return fill + s
+        return _lpad
+    if op == "rpad":
+        n, pad = int(ex[0]), str(ex[1])
+        def _rpad(s):
+            if len(s) >= n or not pad:
+                return s[:n]
+            return s + (pad * n)[: n - len(s)]
+        return _rpad
+    if op == "substring":
+        pos = int(ex[0])
+        ln = int(ex[1]) if len(ex) > 1 else None
+        def _sub(s):
+            if pos > 0:
+                i = pos - 1
+            elif pos < 0:
+                i = max(len(s) + pos, 0)
+            else:
+                return ""  # MySQL: SUBSTRING(s, 0) = ''
+            if ln is None:
+                return s[i:]
+            return s[i : i + max(ln, 0)]
+        return _sub
+    raise AssertionError(op)
+
+
+def _string_parts(args, dicts: DictContext, what: str):
+    """(fn, dictionary) per argument; non-string literals coerce to
+    text, non-string columns are rejected (no per-row host work)."""
+    from tidb_tpu.dtypes import Kind as _K
+
+    parts = []
+    for a in args:
+        if a.type is not None and a.type.kind == _K.STRING:
+            parts.append(string_expr(a, dicts))
+        elif isinstance(a, Literal):
+            v = a.value
+            lit = Literal(type=None, value=None if v is None else _fmt_scalar(v, a.type))
+            parts.append(string_expr(lit, {}))
+        else:
+            raise NotImplementedError(
+                f"{what} over non-string columns: CAST ... AS CHAR first"
+            )
+    return parts
+
+
+def _mixed_radix(parts_sizes):
+    strides = []
+    acc = 1
+    for s in reversed(parts_sizes):
+        strides.append(acc)
+        acc *= s
+    strides.reverse()
+    return strides, acc
+
+
+def _concat_expr(e: Func, dicts: DictContext):
+    """CONCAT over string expressions and literals: the output dictionary
+    is the (deduped) mixed-radix product of the input dictionaries; codes
+    combine arithmetically on device and remap through one LUT."""
+    parts = _string_parts(e.args, dicts, "CONCAT")
+    sizes = [max(len(d), 1) for _, d in parts]
+    strides, total = _mixed_radix(sizes)
+    if total > (1 << 20):
+        raise NotImplementedError(
+            f"CONCAT dictionary product too large ({total} combos)"
+        )
+    strs = [[str(x) for x in d.tolist()] or [""] for _, d in parts]
+    combos = [""]
+    for ss in strs:
+        combos = [c + s for c in combos for s in ss]
+    merged = np.array(sorted(set(combos)), dtype=object)
+    lut = jnp.asarray(np.searchsorted(merged, np.array(combos, dtype=object)).astype(np.int32))
+
+    def _cc(b):
+        idx = jnp.zeros(b.capacity, dtype=jnp.int64)
+        valid = jnp.ones(b.capacity, dtype=bool)
+        for (fn, d), size, stride in zip(parts, sizes, strides):
+            c = fn(b)
+            idx = idx + jnp.clip(c.data, 0, size - 1).astype(jnp.int64) * stride
+            valid = valid & c.valid
+        return DevCol(lut[idx], valid)
+
+    return _cc, merged
+
+
+def _concat_ws_expr(e: Func, dicts: DictContext):
+    """CONCAT_WS(sep, ...): NULL arguments are SKIPPED, not propagated
+    (MySQL semantics); each argument gets an extra dictionary slot
+    meaning NULL, and the combo table joins the non-NULL values."""
+    sep_e = e.args[0]
+    if not isinstance(sep_e, Literal):
+        raise NotImplementedError("CONCAT_WS separator must be a literal")
+    if sep_e.value is None:
+        # NULL separator -> NULL result
+        def _null(b):
+            z = jnp.zeros(b.capacity, dtype=jnp.int32)
+            return DevCol(z, jnp.zeros(b.capacity, dtype=bool))
+
+        return _null, np.array([], dtype=object)
+    sep = str(sep_e.value)
+    parts = _string_parts(e.args[1:], dicts, "CONCAT_WS")
+    sizes = [len(d) + 1 for _, d in parts]  # last slot = NULL
+    strides, total = _mixed_radix(sizes)
+    if total > (1 << 20):
+        raise NotImplementedError(
+            f"CONCAT_WS dictionary product too large ({total} combos)"
+        )
+    options = [[str(x) for x in d.tolist()] + [None] for _, d in parts]
+    combos: list = [[]]
+    for opts in options:
+        combos = [c + [o] for c in combos for o in opts]
+    joined = [sep.join(v for v in c if v is not None) for c in combos]
+    merged = np.array(sorted(set(joined)), dtype=object)
+    lut = jnp.asarray(np.searchsorted(merged, np.array(joined, dtype=object)).astype(np.int32))
+
+    def _cw(b):
+        idx = jnp.zeros(b.capacity, dtype=jnp.int64)
+        for (fn, d), size, stride in zip(parts, sizes, strides):
+            c = fn(b)
+            null_slot = size - 1
+            code = jnp.where(
+                c.valid, jnp.clip(c.data, 0, max(null_slot - 1, 0)), null_slot
+            )
+            idx = idx + code.astype(jnp.int64) * stride
+        return DevCol(lut[idx], jnp.ones(b.capacity, dtype=bool))
+
+    return _cw, merged
+
+
+def _fmt_scalar(v, t: Optional[SQLType]) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v)) if abs(v) < 1e15 else repr(v)
+    return str(v)
+
+
 def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     if isinstance(e, ColumnRef):
         name = e.name
@@ -234,10 +437,55 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         return _compile_like(e, dicts)
     if op == "in":
         return _compile_in(e, dicts)
-    if op in ("year", "month", "day"):
+    if op in (
+        "year", "month", "day", "dayofweek", "weekday", "dayofyear", "quarter",
+    ):
         return _compile_extract(e, dicts)
+    if op == "datediff":
+        fa, fb = (_compile(a, dicts) for a in e.args)
+
+        def _dd(b):
+            a, c = fa(b), fb(b)
+            return DevCol(
+                a.data.astype(jnp.int64) - c.data.astype(jnp.int64),
+                a.valid & c.valid,
+            )
+
+        return _dd
     if op == "length":
-        return _compile_strlut(e, dicts, lambda s: len(s), jnp.int64)
+        return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
+    if op == "char_length":
+        return _compile_strlut(e.args[0], dicts, lambda s: len(s), jnp.int64)
+    if op == "ascii":
+        return _compile_strlut(
+            e.args[0], dicts, lambda s: ord(s[0]) if s else 0, jnp.int64
+        )
+    if op == "locate":
+        s, sub = e.args
+        if not isinstance(sub, Literal):
+            raise NotImplementedError("LOCATE needle must be a literal")
+        if sub.value is None:
+            return lambda b: DevCol(
+                jnp.zeros(b.capacity, dtype=jnp.int64),
+                jnp.zeros(b.capacity, dtype=bool),
+            )
+        needle = str(sub.value)
+        return _compile_strlut(s, dicts, lambda v: v.find(needle) + 1, jnp.int64)
+    if op in _STR_TRANSFORMS or op in ("concat", "concat_ws"):
+        return string_expr(e, dicts)[0]
+    if op in _MATH_UNARY_FLOAT or op in (
+        "abs", "sign", "floor", "ceil", "round", "truncate",
+    ):
+        return _compile_math(e, dicts)
+    if op in ("pow", "atan2", "log"):
+        return _compile_math2(e, dicts)
+    if op == "pi":
+        return lambda b: DevCol(
+            jnp.full(b.capacity, np.pi, dtype=jnp.float64),
+            jnp.ones(b.capacity, dtype=bool),
+        )
+    if op in ("greatest", "least"):
+        return _compile_extremum(e, dicts)
     raise NotImplementedError(f"compile op {op!r}")
 
 
@@ -519,6 +767,28 @@ def _compile_cast(e: Func, dicts: DictContext) -> _CompiledExpr:
     f = _compile(a, dicts)
     src, dst = a.type, e.type
 
+    if src.kind == Kind.STRING and dst.kind == Kind.DATE:
+        # parse the dictionary once on host; bad dates -> NULL
+        f, dictionary = string_expr(a, dicts)
+        from tidb_tpu.dtypes import date_to_days
+
+        days = np.zeros(max(len(dictionary), 1), dtype=np.int32)
+        ok = np.zeros(max(len(dictionary), 1), dtype=bool)
+        for i, s in enumerate(dictionary.tolist()):
+            try:
+                days[i] = date_to_days(str(s))
+                ok[i] = True
+            except Exception:
+                pass
+        days_j, ok_j = jnp.asarray(days), jnp.asarray(ok)
+
+        def _cast_d(b):
+            c = f(b)
+            codes = jnp.clip(c.data, 0, days_j.shape[0] - 1)
+            return DevCol(days_j[codes], c.valid & ok_j[codes])
+
+        return _cast_d
+
     if src.kind == Kind.STRING and dst.kind in (Kind.FLOAT, Kind.INT, Kind.DECIMAL):
         # host LUT over the dictionary: string -> numeric
         f, dictionary = string_expr(a, dicts)
@@ -584,15 +854,11 @@ def _compile_like(e: Func, dicts: DictContext) -> _CompiledExpr:
     negate = False
     rx = _like_to_regex(str(pat.value))
     return _compile_strlut(
-        Func(op="lut", args=(col,), type=e.type),
-        dicts,
-        lambda s: bool(rx.match(s)) != negate,
-        jnp.bool_,
+        col, dicts, lambda s: bool(rx.match(s)) != negate, jnp.bool_
     )
 
 
-def _compile_strlut(e: Func, dicts: DictContext, pyfn, out_dtype) -> _CompiledExpr:
-    (col,) = e.args
+def _compile_strlut(col: Expr, dicts: DictContext, pyfn, out_dtype) -> _CompiledExpr:
     f, dictionary = string_expr(col, dicts)
     lut = jnp.asarray(
         np.array([pyfn(str(s)) for s in dictionary]).astype(np.dtype(out_dtype))
@@ -615,12 +881,7 @@ def _compile_in(e: Func, dicts: DictContext) -> _CompiledExpr:
     lits = [l for l in lits if l.value is not None]
     if _is_string_col(col):
         vals = set(str(l.value) for l in lits)
-        match_fn = _compile_strlut(
-            Func(op="lut", args=(col,), type=e.type),
-            dicts,
-            lambda s: s in vals,
-            jnp.bool_,
-        )
+        match_fn = _compile_strlut(col, dicts, lambda s: s in vals, jnp.bool_)
     else:
         f = _compile(col, dicts)
         t = col.type
@@ -652,6 +913,170 @@ def _compile_in(e: Func, dicts: DictContext) -> _CompiledExpr:
     return _in
 
 
+# math builtins (reference: pkg/expression/builtin_math_vec.go)
+_MATH_UNARY_FLOAT = {
+    "sqrt", "exp", "ln", "log2", "log10", "radians", "degrees",
+    "sin", "cos", "tan", "asin", "acos", "atan", "cot",
+}
+
+
+def _compile_math(e: Func, dicts: DictContext) -> _CompiledExpr:
+    op = e.op
+    a0 = e.args[0]
+    f = _compile(a0, dicts)
+    src = a0.type
+
+    if op in _MATH_UNARY_FLOAT:
+        def _mf(b):
+            c = f(b)
+            x = _to_float(c.data, src)
+            valid = c.valid
+            if op == "sqrt":
+                valid = valid & (x >= 0)  # MySQL: SQRT(neg) -> NULL
+                d = jnp.sqrt(jnp.maximum(x, 0.0))
+            elif op == "exp":
+                d = jnp.exp(x)
+            elif op in ("ln", "log2", "log10"):
+                valid = valid & (x > 0)
+                xs = jnp.where(x > 0, x, 1.0)
+                d = {
+                    "ln": jnp.log(xs),
+                    "log2": jnp.log2(xs),
+                    "log10": jnp.log10(xs),
+                }[op]
+            elif op == "radians":
+                d = x * (np.pi / 180.0)
+            elif op == "degrees":
+                d = x * (180.0 / np.pi)
+            elif op == "cot":
+                d = 1.0 / jnp.tan(x)
+            else:
+                d = getattr(jnp, op)(x)
+            return DevCol(d, valid)
+
+        return _mf
+
+    if op == "abs":
+        return lambda b: (lambda c: DevCol(jnp.abs(c.data), c.valid))(f(b))
+    if op == "sign":
+        def _sgn(b):
+            c = f(b)
+            return DevCol(jnp.sign(c.data).astype(jnp.int64), c.valid)
+        return _sgn
+
+    if op in ("floor", "ceil"):
+        def _fc(b):
+            c = f(b)
+            d = c.data
+            if src.kind == Kind.FLOAT:
+                d = (jnp.floor(d) if op == "floor" else jnp.ceil(d)).astype(jnp.int64)
+            elif src.kind == Kind.DECIMAL:
+                q = 10 ** src.scale
+                d = d // q if op == "floor" else -((-d) // q)
+            else:
+                d = d.astype(jnp.int64)
+            return DevCol(d, c.valid)
+        return _fc
+
+    # round/truncate with optional digits literal (default 0); rounding is
+    # half-away-from-zero for exact types, matching MySQL DECIMAL rules.
+    digits = 0
+    if len(e.args) > 1:
+        if not isinstance(e.args[1], Literal):
+            raise NotImplementedError(
+                f"{op.upper()} digits must be a literal"
+            )
+        if e.args[1].value is None:
+            # MySQL: ROUND(x, NULL) is NULL for every row
+            ndt = jnp.float64 if e.type.kind == Kind.FLOAT else jnp.int64
+            return lambda b: DevCol(
+                jnp.zeros(b.capacity, dtype=ndt),
+                jnp.zeros(b.capacity, dtype=bool),
+            )
+        digits = int(e.args[1].value)
+    trunc = op == "truncate"
+
+    def _round(b):
+        c = f(b)
+        d = c.data
+        if src.kind == Kind.FLOAT:
+            factor = 10.0 ** digits
+            x = d * factor
+            if trunc:
+                x = jnp.trunc(x)
+            else:
+                x = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+            return DevCol(x / factor, c.valid)
+        s = src.scale if src.kind == Kind.DECIMAL else 0
+        if digits >= s:
+            out = _rescale(d.astype(jnp.int64), max(digits, 0) - s if src.kind == Kind.DECIMAL else 0)
+            return DevCol(out, c.valid)
+        q = 10 ** (s - digits)
+        av = jnp.abs(d.astype(jnp.int64))
+        mag = av // q if trunc else (av + q // 2) // q
+        out = jnp.sign(d).astype(jnp.int64) * mag
+        # out is at scale `digits`; the inferred type is DECIMAL(digits)
+        # for digits>0, else INT64 (scale 0) -> undo negative scales
+        if digits < 0:
+            out = out * (10 ** -digits)
+        return DevCol(out, c.valid)
+
+    return _round
+
+
+def _compile_math2(e: Func, dicts: DictContext) -> _CompiledExpr:
+    op = e.op
+    if op == "log" and len(e.args) == 1:
+        return _compile_math(Func(op="ln", args=e.args, type=e.type), dicts)
+    fa, fb = (_compile(a, dicts) for a in e.args)
+    ta, tb = e.args[0].type, e.args[1].type
+
+    def _m2(b):
+        a, c = fa(b), fb(b)
+        x, y = _to_float(a.data, ta), _to_float(c.data, tb)
+        valid = a.valid & c.valid
+        if op == "pow":
+            d = jnp.power(x, y)
+        elif op == "atan2":
+            d = jnp.arctan2(x, y)
+        else:  # log(base, x) = ln(x)/ln(base)
+            valid = valid & (x > 0) & (x != 1.0) & (y > 0)
+            d = jnp.log(jnp.where(y > 0, y, 1.0)) / jnp.log(
+                jnp.where((x > 0) & (x != 1.0), x, 2.0)
+            )
+        return DevCol(d, valid)
+
+    return _m2
+
+
+def _compile_extremum(e: Func, dicts: DictContext) -> _CompiledExpr:
+    """GREATEST/LEAST: all args aligned at the inferred common type;
+    NULL if any argument is NULL (MySQL semantics)."""
+    fns = [_compile(a, dicts) for a in e.args]
+    types = [a.type for a in e.args]
+    target = e.type
+    pick = jnp.maximum if e.op == "greatest" else jnp.minimum
+
+    def _conv(data, t):
+        if target.kind == Kind.FLOAT:
+            return _to_float(data, t)
+        if target.kind == Kind.DECIMAL:
+            s = t.scale if t.kind == Kind.DECIMAL else 0
+            return _rescale(data.astype(jnp.int64), target.scale - s)
+        return data.astype(jnp.int64)
+
+    def _ext(b):
+        cols = [f(b) for f in fns]
+        out = _conv(cols[0].data, types[0])
+        valid = cols[0].valid
+        for c, t in zip(cols[1:], types[1:]):
+            out = pick(out, _conv(c.data, t))
+            valid = valid & c.valid
+        return DevCol(out, valid)
+
+    return _ext
+
+
 def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:
     """YEAR/MONTH/DAY from days-since-epoch, branchless civil calendar
     (integer algorithm; computes on device with no host round-trip)."""
@@ -661,7 +1086,8 @@ def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:
 
     def _ext(b):
         c = f(b)
-        z = c.data.astype(jnp.int64) + 719468
+        days = c.data.astype(jnp.int64)
+        z = days + 719468
         # jnp // already floors (unlike C), so no negative-z adjustment.
         era = z // 146097
         doe = z - era * 146097
@@ -672,7 +1098,27 @@ def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:
         d = doy - (153 * mp + 2) // 5 + 1
         m = jnp.where(mp < 10, mp + 3, mp - 9)
         y = jnp.where(m <= 2, y + 1, y)
-        out = {"year": y, "month": m, "day": d}[part]
+        if part == "year":
+            out = y
+        elif part == "month":
+            out = m
+        elif part == "day":
+            out = d
+        elif part == "quarter":
+            out = (m + 2) // 3
+        elif part == "dayofweek":
+            # 1970-01-01 was a Thursday; MySQL numbers Sunday=1..Saturday=7
+            out = (days + 4) % 7 + 1
+        elif part == "weekday":
+            # MySQL WEEKDAY: Monday=0..Sunday=6
+            out = (days + 3) % 7
+        else:  # dayofyear: days since Jan 1 of the civil year y
+            y2 = y - 1
+            era2 = y2 // 400
+            yoe2 = y2 - era2 * 400
+            doe2 = yoe2 * 365 + yoe2 // 4 - yoe2 // 100 + 306
+            jan1 = era2 * 146097 + doe2 - 719468
+            out = days - jan1 + 1
         return DevCol(out.astype(jnp.int64), c.valid)
 
     return _ext

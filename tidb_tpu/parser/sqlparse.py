@@ -68,6 +68,10 @@ KEYWORDS = {
 
 _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
 
+# keywords that may also appear as function names in expression position
+# (MySQL grammar does the same disambiguation, parser.y sysFuncCall rules)
+_FUNC_KEYWORDS = {"mod", "left", "right", "if"}
+
 
 class Token:
     __slots__ = ("kind", "text", "pos")
@@ -704,6 +708,20 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if (
+            t.kind == "kw"
+            and t.text in _FUNC_KEYWORDS
+            and self.toks[self.i + 1].text == "("
+        ):
+            name = self.advance().text
+            self.expect_op("(")
+            args = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.Call(name.lower(), args)
         if t.kind == "id" or t.kind == "kw":
             name = self.expect_ident()
             if self.accept_op("("):

@@ -219,8 +219,22 @@ class ExprBinder:
             return self.lower_call(e)
         raise PlanError(f"cannot bind {e!r}")
 
+    # name aliases normalized before compilation (reference: the alias
+    # rows in pkg/expression/builtin.go funcs registry)
+    _FN_ALIASES = {
+        "substr": "substring",
+        "mid": "substring",
+        "ucase": "upper",
+        "lcase": "lower",
+        "character_length": "char_length",
+        "ceiling": "ceil",
+        "power": "pow",
+        "dayofmonth": "day",
+        "lengthb": "length",
+    }
+
     def lower_call(self, e: ast.Call) -> Expr:
-        op = e.op
+        op = self._FN_ALIASES.get(e.op, e.op)
         if op in ("date_add", "date_sub"):
             base, iv = e.args
             assert isinstance(iv, ast.Interval)
@@ -231,8 +245,35 @@ class ExprBinder:
             )
         if op == "cast":
             return Func(op="cast", args=(self.lower(e.args[0]),), type=e.cast_type)
-        if op in ("substring", "substr"):
-            raise PlanError("SUBSTRING not yet supported on device")
+        if op == "if":
+            if len(e.args) != 3:
+                raise PlanError("IF takes 3 arguments")
+            return Func(op="case", args=tuple(self.lower(a) for a in e.args))
+        if op == "nullif":
+            a, bb = (self.lower(x) for x in e.args)
+            return Func(op="case", args=(Func(op="eq", args=(a, bb)), Literal(value=None), a))
+        if op == "instr":
+            s, sub = (self.lower(x) for x in e.args)
+            return Func(op="locate", args=(s, sub))
+        if op == "locate":
+            sub, s = (self.lower(x) for x in e.args[:2])
+            if len(e.args) > 2:
+                raise PlanError("LOCATE with start position not supported")
+            return Func(op="locate", args=(s, sub))
+        if op == "concat_ws":
+            # NULL arguments are skipped (not propagated), so this stays
+            # a distinct op down to the kernel.
+            return Func(op="concat_ws", args=tuple(self.lower(x) for x in e.args))
+        if op == "date":
+            return self.lower(e.args[0])
+        if op in ("curdate", "current_date"):
+            import datetime
+
+            from tidb_tpu.dtypes import DATE as _DATE, date_to_days
+
+            return Literal(
+                type=_DATE, value=int(date_to_days(datetime.date.today().isoformat()))
+            )
         args = tuple(self.lower(a) for a in e.args)
         return Func(op=op, args=args)
 

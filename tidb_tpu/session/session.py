@@ -250,6 +250,13 @@ class Session:
             lit = self._scalar_subquery(e.query)
             return lit.value
         if isinstance(e, ast.Call):
+            known = {
+                "add", "sub", "mul", "div", "neg", "not", "and", "or",
+                "eq", "ne", "lt", "le", "gt", "ge",
+                "coalesce", "isnull", "isnotnull", "cast",
+            }
+            if e.op not in known:
+                return self._device_const_eval(e)
             args = [self._eval_const_expr(a) for a in e.args]
             if any(a is None for a in args) and e.op not in ("isnull", "isnotnull", "coalesce"):
                 return None
@@ -280,7 +287,51 @@ class Session:
                 return args[0] is not None
             if e.op == "cast":
                 return args[0]
-        raise ValueError(f"cannot evaluate {e!r} without a table")
+        return self._device_const_eval(e)
+
+    def _device_const_eval(self, e):
+        """Evaluate a column-free expression through the engine's own
+        kernels on a one-row batch (the dual-table analog; reference:
+        TableDual + expression folding)."""
+        import jax.numpy as jnp
+
+        from tidb_tpu.chunk import Batch
+        from tidb_tpu.dtypes import Kind, days_to_date
+        from tidb_tpu.expression.kernels import compile_expr, string_expr
+        from tidb_tpu.planner.logical import ExprBinder, Schema
+
+        bound = ExprBinder(Schema([]), self._subq_executor_for_binding()).bind(e)
+        b = Batch({}, jnp.ones(1, dtype=bool))
+        if bound.type is not None and bound.type.kind == Kind.STRING:
+            fn, d = string_expr(bound, {})
+            c = fn(b)
+            if not bool(c.valid[0]) or not len(d):
+                return None
+            return str(d[int(c.data[0])])
+        c = compile_expr(bound, {})(b)
+        if not bool(c.valid[0]):
+            return None
+        v = c.data[0].item()
+        t = bound.type
+        if t is None:
+            return v
+        if t.kind == Kind.DECIMAL:
+            return v / 10**t.scale
+        if t.kind == Kind.DATE:
+            return days_to_date(int(v))
+        if t.kind == Kind.BOOL:
+            return bool(v)
+        return v
+
+    def _subq_executor_for_binding(self):
+        from tidb_tpu.parser import ast as _ast
+
+        def run(e):
+            if isinstance(e, _ast.SubqueryExpr) and e.modifier is None:
+                return self._scalar_subquery(e.query)
+            raise ValueError("IN/EXISTS subquery not supported here")
+
+        return run
 
     def _run_tableless(self, s: ast.Select) -> Result:
         names = []
