@@ -32,9 +32,12 @@ from tidb_tpu.dtypes import Kind, SQLType
 _MIN_CAPACITY = 256
 
 
-def pad_capacity(n: int) -> int:
-    """Smallest power-of-two tile >= n (>= _MIN_CAPACITY)."""
-    cap = _MIN_CAPACITY
+def pad_capacity(n: int, floor: int = _MIN_CAPACITY) -> int:
+    """Smallest power-of-two tile >= n (>= floor; default batch tile).
+
+    The single tiling ladder for the engine: batch tiles use the default
+    floor, capacity knobs (group/join tables) pass a smaller one."""
+    cap = floor
     while cap < n:
         cap *= 2
     return cap
@@ -173,12 +176,19 @@ def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
 def batch_to_block(
     batch: Batch, types: Dict[str, SQLType], dicts: Dict[str, Optional[np.ndarray]]
 ) -> HostBlock:
-    """Pull a device batch back to host and compact out invalid rows."""
-    row_valid = np.asarray(batch.row_valid)
-    idx = np.nonzero(row_valid)[0]
+    """Pull a device batch back to host and compact out invalid rows.
+
+    Fetches everything in ONE device->host transfer (device->host round
+    trips are latency-bound on a TPU tunnel, so N column-wise pulls would
+    cost N round trips)."""
+    fetched = jax.device_get(
+        (batch.row_valid, {n: (dc.data, dc.valid) for n, dc in batch.cols.items()})
+    )
+    row_valid, host_cols = fetched
+    idx = np.nonzero(np.asarray(row_valid))[0]
     cols = {}
-    for name, dc in batch.cols.items():
-        data = np.asarray(dc.data)[idx]
-        valid = np.asarray(dc.valid)[idx]
-        cols[name] = HostColumn(types[name], data, valid, dicts.get(name))
+    for name, (data, valid) in host_cols.items():
+        cols[name] = HostColumn(
+            types[name], np.asarray(data)[idx], np.asarray(valid)[idx], dicts.get(name)
+        )
     return HostBlock(cols, len(idx))

@@ -73,13 +73,22 @@ def distributed_group_aggregate(
     key_names: Optional[Sequence[str]] = None,
 ) -> Tuple[Batch, jax.Array, jax.Array]:
     """Partial agg on each shard, hash-exchange of group rows, final agg.
-    Result: each device holds a disjoint subset of groups (hash-sharded),
-    padded to group_capacity. Returns (local result batch, global group
-    count upper bound, dropped row count from the exchange)."""
+    Result: each device holds a disjoint subset of groups (hash-sharded)
+    in a slot table of 2*group_capacity rows (group_aggregate's keyed
+    output capacity; the exchange buckets stay group_capacity per device,
+    overfills are counted in `dropped`). Returns (local result batch,
+    global group count upper bound, dropped row count from the
+    exchange)."""
     key_names = list(key_names or [f"k{i}" for i in range(len(key_fns))])
     partial, final = _partial_descs(aggs)
 
-    part_batch, _ng = group_aggregate(local, key_fns, partial, group_capacity, key_names)
+    # part_ng carries the partial stage's overflow signal (slots+1 when
+    # its hash table overflowed); folded into the returned group-count
+    # bound below so the host retries at a larger tile instead of
+    # silently losing the unassigned rows' contributions
+    part_batch, part_ng = group_aggregate(
+        local, key_fns, partial, group_capacity, key_names
+    )
 
     if key_fns:
         # exchange partial groups so equal keys colocate
@@ -131,6 +140,9 @@ def distributed_group_aggregate(
     # pmax (not psum) for the scalar case: the broadcast made every shard
     # compute the same single group; pmax also proves replication to jax.
     total_groups = jax.lax.psum(ng, axis) if key_fns else jax.lax.pmax(ng, axis)
+    # a partial-stage overflow anywhere (part_ng = slots+1 > 2*capacity)
+    # must surface to the host even though the final stage fit
+    total_groups = jnp.maximum(total_groups, jax.lax.pmax(part_ng, axis))
     return Batch(cols, fin.row_valid), total_groups, dropped
 
 

@@ -75,6 +75,10 @@ class CompiledQuery:
     jitted: Optional[Callable] = None
     caps: Optional[Dict[int, int]] = None
     input_shape_key: Optional[tuple] = None
+    # set when a post-shrink steady run overflowed (e.g. a probe chain no
+    # longer fit the smaller hash table): discovery stops shrinking caps
+    # for this plan so grow/shrink cannot oscillate
+    no_shrink: bool = False
 
 
 def plan_fingerprint(plan: L.LogicalPlan) -> str:
@@ -384,11 +388,14 @@ class PlanCompiler:
             descs.append(AggDesc(func, fn, name, arg_scale=scale))
         scalar = not plan.group_exprs
         agg_names = [(n, f) for n, f, _a, _d in plan.aggs]
+        key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
 
         def fn_agg(inputs, caps):
             b, needs = child(inputs, caps)
             cap = caps[nid]
-            out, ngroups = group_aggregate(b, key_fns, descs, cap, key_names)
+            out, ngroups = group_aggregate(
+                b, key_fns, descs, cap, key_names, key_widths=key_widths
+            )
             if scalar:
                 # MySQL: scalar aggregation over empty input yields one
                 # row: COUNT=0 valid, others NULL (branchless form).
@@ -567,6 +574,12 @@ class PlanCompiler:
 _MAX_JOIN_CAP = 1 << 26
 
 
+def _cap_tile(n: int) -> int:
+    """Power-of-two tile >= n for capacity knobs (floor 16 — unlike batch
+    tiles, small group/join tables benefit from staying small)."""
+    return pad_capacity(n, floor=16)
+
+
 class PhysicalExecutor:
     def __init__(self, catalog):
         self.catalog = catalog
@@ -604,22 +617,44 @@ class PhysicalExecutor:
             inputs[s.node_id] = batch
         return inputs
 
-    def _discover(self, cq: CompiledQuery, inputs) -> Tuple[Batch, Dict[int, int]]:
+    def _discover(
+        self, cq: CompiledQuery, inputs, jit: bool = True
+    ) -> Tuple[Batch, Dict[int, int]]:
+        """Find the capacity vector. Each iteration compiles the whole plan
+        at the candidate caps and fetches only the cardinality scalars in a
+        single device->host round trip (transfers on a TPU tunnel are
+        latency-bound, ~the same cost for 8 bytes as for 32MB). jit=False
+        runs op-by-op for the instrumented EXPLAIN ANALYZE path."""
         caps = dict(cq.caps or cq.default_caps)
         for nid, c in caps.items():
             if c == 0:  # join knobs start at the dominant input tile
                 caps[nid] = _join_default(inputs, cq)
         while True:
-            out, needs = cq.fn(inputs, caps)
+            frozen = dict(caps)
+            fn = cq.fn
+            if jit:
+                jitted = jax.jit(lambda i, _f=fn, _c=frozen: _f(i, _c))
+            else:
+                jitted = lambda i, _f=fn, _c=frozen: _f(i, _c)
+            out, needs = jitted(inputs)
+            needs_host = jax.device_get(needs)
             bumped = False
-            for nid, true_n in needs.items():
+            for nid, true_n in needs_host.items():
                 n = int(true_n)
                 if n > caps[nid]:
-                    caps[nid] = pad_capacity(n)
+                    caps[nid] = _cap_tile(n)
                     if caps[nid] > _MAX_JOIN_CAP:
                         raise ExecError(f"result too large at node {nid}: {n} rows")
                     bumped = True
             if not bumped:
+                # shrink every knob to the tight tile of its true
+                # cardinality: small group tables unlock the scatter-free
+                # masked aggregation path, and join/exchange tiles stop
+                # inheriting the (huge) default of their input capacity
+                if not cq.no_shrink:
+                    for nid, true_n in needs_host.items():
+                        if nid in caps:
+                            caps[nid] = min(caps[nid], _cap_tile(int(true_n)))
                 return out, caps
 
     def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
@@ -637,24 +672,48 @@ class PhysicalExecutor:
 
         if cq.jitted is not None and cq.input_shape_key == shape_key:
             out, needs = cq.jitted(inputs)
-            if not _overflowed(needs, cq.caps):
-                return _device_compact(out), cq.out_dicts
+            # ONE device->host round trip: output batch + cardinality
+            # scalars together. Also warms each array's host-value cache so
+            # the session's materialization re-reads are free.
+            needs_host = jax.device_get((needs, out))[0]
+            if not _overflowed(needs_host, cq.caps):
+                return out, cq.out_dicts
             # data grew past a tile: rediscover
             cq.jitted = None
 
-        out, caps = self._discover(cq, inputs)
-        cq.caps = dict(caps)
-        cq.input_shape_key = shape_key
-        fn, frozen = cq.fn, dict(caps)
-        cq.jitted = jax.jit(lambda inputs: fn(inputs, frozen))
-        return _device_compact(out), cq.out_dicts
+        for _attempt in range(8):
+            out, caps = self._discover(cq, inputs)
+            nvalid = int(jax.device_get(_count_valid(out.row_valid)))
+            out_cap = min(_cap_tile(max(nvalid, 1)), out.capacity)
+            cq.caps = dict(caps)
+            cq.caps[_OUT_NODE] = out_cap
+            cq.input_shape_key = shape_key
+            fn, frozen = cq.fn, dict(caps)
+            cq.jitted = jax.jit(
+                lambda i, _f=fn, _c=frozen, _oc=out_cap: _steady_step(_f, _c, _oc, i)
+            )
+            # compile + run the steady program now so every later run is a
+            # single launch + single fetch
+            out, needs = cq.jitted(inputs)
+            needs_host = jax.device_get((needs, out))[0]
+            if not _overflowed(needs_host, cq.caps):
+                return out, cq.out_dicts
+            # the post-shrink steady run overflowed: stop shrinking this
+            # plan's caps and rediscover from the grown values
+            cq.jitted = None
+            cq.no_shrink = True
+            for nid, n in needs_host.items():
+                if nid in caps and int(n) > caps[nid]:
+                    caps[nid] = _cap_tile(int(n))
+            cq.caps = dict(caps)
+        raise ExecError("capacity discovery did not converge")
 
     def run_analyze(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts, List[str]]:
         """EXPLAIN ANALYZE: instrumented single run with per-node stats."""
         compiler = PlanCompiler(self.catalog, instrument=True, resolver=self._resolve)
         cq = compiler.compile(plan)
         inputs = self._fetch_inputs(cq)
-        out, _caps = self._discover(cq, inputs)
+        out, _caps = self._discover(cq, inputs, jit=False)
         lines = []
         for nid, depth, label in compiler.node_labels:
             st = compiler.stats.get(nid)
@@ -667,8 +726,23 @@ class PhysicalExecutor:
         return out, cq.out_dicts, lines
 
 
-def _overflowed(needs: Dict[int, jax.Array], caps: Dict[int, int]) -> bool:
-    for nid, true_n in needs.items():
+# pseudo node id for the final output's compaction capacity
+_OUT_NODE = -1
+
+
+def _steady_step(fn, caps, out_cap, inputs):
+    """Steady-state whole-query program: plan + output compaction + output
+    cardinality, all in one XLA launch."""
+    out, needs = fn(inputs, caps)
+    needs = dict(needs)
+    needs[_OUT_NODE] = jnp.sum(out.row_valid.astype(jnp.int32))
+    if out_cap < out.capacity:
+        out = _compact_impl(out, out_cap)
+    return out, needs
+
+
+def _overflowed(needs_host: Dict[int, np.ndarray], caps: Dict[int, int]) -> bool:
+    for nid, true_n in needs_host.items():
         cap = caps.get(nid, 0)
         if cap and int(true_n) > cap:
             return True
@@ -733,17 +807,21 @@ def _compact_impl(batch: Batch, out_cap: int) -> Batch:
     return Batch(cols, (~sorted_ops[0][:out_cap].astype(bool)))
 
 
-_compact_jit = jax.jit(_compact_impl, static_argnames="out_cap")
-
-
-def _device_compact(batch: Batch) -> Batch:
-    """Shrink a sparse batch before host materialization (the analog of
-    the reference's chunk write path trimming to requiredRows)."""
-    n = int(_count_valid(batch.row_valid))
-    out_cap = pad_capacity(max(n, 1))
-    if out_cap >= batch.capacity:
-        return batch
-    return _compact_jit(batch, out_cap)
+def _key_width(e: Expr, dicts: Dicts):
+    """(bit width, bias) of a group key's packed encoding when a sound
+    static bound exists (enables the scatter-free packed aggregation
+    path); None otherwise."""
+    kind = e.type.kind if e.type is not None else None
+    if kind == Kind.STRING:
+        d = _expr_dict(e, dicts)
+        if d is None:
+            return None
+        return (max(1, int(len(d)).bit_length()), 0)
+    if kind == Kind.DATE:
+        return (33, 1 << 31)
+    if kind == Kind.BOOL:
+        return (2, 0)
+    return None
 
 
 def _expr_dict(e: Expr, dicts: Dicts) -> Optional[np.ndarray]:
