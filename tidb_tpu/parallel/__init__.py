@@ -7,4 +7,5 @@ from tidb_tpu.parallel.fragment import (  # noqa: F401
     distributed_group_aggregate,
     partitioned_join,
     broadcast_join,
+    repartition_pair,
 )

@@ -71,6 +71,7 @@ def distributed_group_aggregate(
     n_devices: int,
     axis: str = "d",
     key_names: Optional[Sequence[str]] = None,
+    key_widths=None,
 ) -> Tuple[Batch, jax.Array, jax.Array]:
     """Partial agg on each shard, hash-exchange of group rows, final agg.
     Result: each device holds a disjoint subset of groups (hash-sharded)
@@ -87,7 +88,7 @@ def distributed_group_aggregate(
     # bound below so the host retries at a larger tile instead of
     # silently losing the unassigned rows' contributions
     part_batch, part_ng = group_aggregate(
-        local, key_fns, partial, group_capacity, key_names
+        local, key_fns, partial, group_capacity, key_names, key_widths=key_widths
     )
 
     if key_fns:
@@ -118,7 +119,9 @@ def distributed_group_aggregate(
             post_avg.append((out, f"_fs_{out}", f"_fc_{out}", scale))
         else:
             fdescs.append(AggDesc(func, _colfn(pnames[0]), out))
-    fin, ng = group_aggregate(exchanged, fkeys, fdescs, group_capacity, key_names)
+    fin, ng = group_aggregate(
+        exchanged, fkeys, fdescs, group_capacity, key_names, key_widths=key_widths
+    )
 
     cols = dict(fin.cols)
     for out, sn, cn, scale in post_avg:
@@ -146,6 +149,24 @@ def distributed_group_aggregate(
     return Batch(cols, fin.row_valid), total_groups, dropped
 
 
+def repartition_pair(
+    left: Batch,
+    right: Batch,
+    left_key: ExprFn,
+    right_key: ExprFn,
+    n_devices: int,
+    bucket_capacity: int,
+    axis: str = "d",
+) -> Tuple[Batch, Batch, jax.Array]:
+    """Hash-partition both join sides on their keys so equal keys
+    colocate (the MPP HashPartition exchange applied to a join pair).
+    Returns (left', right', global dropped rows). The single shared
+    composition used by both partitioned_join and the planner."""
+    lex, d1 = hash_repartition(left, left_key, n_devices, bucket_capacity, axis)
+    rex, d2 = hash_repartition(right, right_key, n_devices, bucket_capacity, axis)
+    return lex, rex, d1 + d2
+
+
 def partitioned_join(
     left: Batch,
     right: Batch,
@@ -161,13 +182,14 @@ def partitioned_join(
     matching rows colocate, then a local join per device (the reference's
     HashPartition MPP join). Returns (local join result, global true
     output count, dropped exchange rows)."""
-    lex, d1 = hash_repartition(left, left_key, n_devices, bucket_capacity, axis)
-    rex, d2 = hash_repartition(right, right_key, n_devices, bucket_capacity, axis)
+    lex, rex, dropped = repartition_pair(
+        left, right, left_key, right_key, n_devices, bucket_capacity, axis
+    )
     out, total = equi_join(
         rex, lex, right_key_after(right_key), left_key_after(left_key),
         out_capacity, join_type,
     )
-    return out, jax.lax.psum(total, axis), d1 + d2
+    return out, jax.lax.psum(total, axis), dropped
 
 
 def left_key_after(key_fn: ExprFn) -> ExprFn:

@@ -79,6 +79,9 @@ class CompiledQuery:
     # longer fit the smaller hash table): discovery stops shrinking caps
     # for this plan so grow/shrink cannot oscillate
     no_shrink: bool = False
+    # mesh mode: distribution of the root output ('shard' = row-partitioned
+    # over the mesh axis, 'repl' = identical on every device)
+    out_tag: str = "shard"
 
 
 def plan_fingerprint(plan: L.LogicalPlan) -> str:
@@ -131,7 +134,9 @@ class PlanCompiler:
     of EXPLAIN ANALYZE (reference RuntimeStatsColl,
     pkg/util/execdetails/execdetails.go:1273)."""
 
-    def __init__(self, catalog, instrument: bool = False, resolver=None):
+    def __init__(
+        self, catalog, instrument: bool = False, resolver=None, mesh_n: Optional[int] = None
+    ):
         self.catalog = catalog
         self.resolver = resolver or (
             lambda db, tbl: (catalog.table(db, tbl), catalog.table(db, tbl).version)
@@ -144,10 +149,42 @@ class PlanCompiler:
         self.node_labels: List[Tuple[int, int, str]] = []  # (nid, depth, label)
         self.stats: Dict[int, Dict[str, float]] = {}
         self._depth = 0
+        # mesh mode: plan functions run per-shard inside shard_map over a
+        # mesh_n-device axis. Every node output carries a distribution tag
+        # ('shard' = row-partitioned over the mesh, 'repl' = identical on
+        # every device); _tag holds the tag of the most recently built
+        # node (stack discipline: a parent reads it right after building
+        # each child). The mapping mirrors the reference's MPP task types
+        # (pkg/planner/core/fragment.go:47): sharded scan fragments,
+        # exchange at aggregation/join boundaries, singleton (gathered)
+        # fragments for order-sensitive operators.
+        self.mesh_n = mesh_n
+        self._tag = "shard"
 
     def fresh_id(self) -> int:
         self._next_id += 1
         return self._next_id
+
+    def _gathered(self, fn, tag):
+        """Wrap fn so its output is replicated on every device (the
+        reference's PassThrough/singleton exchange)."""
+        if self.mesh_n is None or tag == "repl":
+            return fn
+        from tidb_tpu.parallel import broadcast_gather
+
+        def g(inputs, caps):
+            b, needs = fn(inputs, caps)
+            return broadcast_gather(b), needs
+
+        return g
+
+    def _gather_child(self, child):
+        """Singleton-fragment transition for order-sensitive operators
+        (Sort/Window/Limit): gather the child, mark output replicated."""
+        child = self._gathered(child, self._tag)
+        if self.mesh_n:
+            self._tag = "repl"
+        return child
 
     def _build(self, plan: L.LogicalPlan):
         nid = self.fresh_id()
@@ -179,9 +216,11 @@ class PlanCompiler:
         return timed
 
     def compile(self, plan: L.LogicalPlan) -> CompiledQuery:
+        self._tag = "shard"
         fn, dicts = self._build(plan)
         return CompiledQuery(
             fn=fn,
+            out_tag=self._tag,
             scans=self.scans,
             sized_nodes=self.sized,
             default_caps=dict(self.defaults),
@@ -196,6 +235,7 @@ class PlanCompiler:
                 rv = jnp.zeros(256, dtype=bool).at[0].set(True)
                 return Batch({}, rv), {}
 
+            self._tag = "repl"
             return fn_one, {}
 
         if isinstance(plan, L.Scan):
@@ -221,6 +261,7 @@ class PlanCompiler:
                     {},
                 )
 
+            self._tag = "shard"
             return fn_scan, dicts
 
         if isinstance(plan, L.Selection):
@@ -260,6 +301,7 @@ class PlanCompiler:
 
         if isinstance(plan, L.Sort):
             child, dicts = self._build(plan.child)
+            child = self._gather_child(child)
             key_fns = [compile_expr(e, dicts) for e, _ in plan.keys]
             descs = [d for _, d in plan.keys]
 
@@ -273,6 +315,7 @@ class PlanCompiler:
             from tidb_tpu.executor.window import WindowDesc, window_op
 
             child, dicts = self._build(plan.child)
+            child = self._gather_child(child)
             part_fns = [compile_expr(e, dicts) for e in plan.partition_exprs]
             order_fns = [compile_expr(e, dicts) for e, _ in plan.order_exprs]
             order_descs = [d for _, d in plan.order_exprs]
@@ -304,6 +347,7 @@ class PlanCompiler:
 
         if isinstance(plan, L.Limit):
             child, dicts = self._build(plan.child)
+            child = self._gather_child(child)
             k, off = plan.count, plan.offset
 
             def fn_lim(inputs, caps):
@@ -313,7 +357,19 @@ class PlanCompiler:
             return fn_lim, dicts
 
         if isinstance(plan, L.UnionAll):
-            built = [self._build(c) for c in plan.children]
+            built, ctags = [], []
+            for c in plan.children:
+                built.append(self._build(c))
+                ctags.append(self._tag)
+            if self.mesh_n and not all(t == "shard" for t in ctags):
+                # mixed distribution: gather everything, emit replicated
+                built = [
+                    (self._gathered(f, t), d)
+                    for (f, d), t in zip(built, ctags)
+                ]
+                self._tag = "repl"
+            else:
+                self._tag = "shard" if self.mesh_n else self._tag
             fns = [f for f, _ in built]
             child_dicts = [d for _, d in built]
             internals = [c.internal for c in plan.schema.cols]
@@ -370,6 +426,7 @@ class PlanCompiler:
     # ------------------------------------------------------------------
     def _build_aggregate(self, plan: L.Aggregate):
         child, dicts = self._build(plan.child)
+        child_tag = self._tag
         nid = self.fresh_id()
         self.sized.append(nid)
         self.defaults[nid] = 1024
@@ -389,13 +446,29 @@ class PlanCompiler:
         scalar = not plan.group_exprs
         agg_names = [(n, f) for n, f, _a, _d in plan.aggs]
         key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
+        mesh_n = self.mesh_n if child_tag == "shard" else None
+        if mesh_n:
+            # partial agg per shard -> all_to_all of group rows -> final
+            # agg; groups end hash-sharded (keyed) / replicated (scalar)
+            self._tag = "repl" if scalar else "shard"
 
         def fn_agg(inputs, caps):
             b, needs = child(inputs, caps)
             cap = caps[nid]
-            out, ngroups = group_aggregate(
-                b, key_fns, descs, cap, key_names, key_widths=key_widths
-            )
+            if mesh_n:
+                from tidb_tpu.parallel import distributed_group_aggregate
+
+                out, total, dropped = distributed_group_aggregate(
+                    b, key_fns, descs, cap, mesh_n,
+                    key_names=key_names, key_widths=key_widths,
+                )
+                ngroups = jnp.maximum(
+                    total, (dropped > 0).astype(total.dtype) * (2 * cap + 1)
+                )
+            else:
+                out, ngroups = group_aggregate(
+                    b, key_fns, descs, cap, key_names, key_widths=key_widths
+                )
             if scalar:
                 # MySQL: scalar aggregation over empty input yields one
                 # row: COUNT=0 valid, others NULL (branchless form).
@@ -434,10 +507,22 @@ class PlanCompiler:
     # ------------------------------------------------------------------
     def _build_join(self, plan: L.JoinPlan):
         left, ldicts = self._build(plan.left)
+        ltag = self._tag
         right, rdicts = self._build(plan.right)
+        rtag = self._tag
         dicts = {**ldicts, **rdicts}
+        mesh = self.mesh_n
+
+        def _gather_both():
+            nonlocal left, right, ltag, rtag
+            left = self._gathered(left, ltag)
+            right = self._gathered(right, rtag)
+            ltag = rtag = "repl"
+            self._tag = "repl"
 
         if plan.kind == "cross":
+            if mesh:
+                _gather_both()
             res = compile_expr(plan.residual, dicts) if plan.residual is not None else None
 
             def fn_cross(inputs, caps):
@@ -471,17 +556,40 @@ class PlanCompiler:
 
         if kind in ("semi", "anti"):
             if verify is None and res is None:
+                part_nid = None
+                build_sharded = rtag == "shard"
+                if mesh:
+                    if ltag == "repl" and rtag == "shard":
+                        # replicated probe vs sharded build: gather build
+                        right = self._gathered(right, rtag)
+                        rtag, build_sharded = "repl", False
+                    if ltag == "shard" and rtag == "shard":
+                        # repartition both sides on the join key so equal
+                        # keys colocate (MPP HashPartition exchange)
+                        part_nid = self.fresh_id()
+                        self.sized.append(part_nid)
+                        self.defaults[part_nid] = 0
+                    self._tag = ltag
 
                 def fn_semi(inputs, caps):
                     lb, n1 = left(inputs, caps)
                     rb, n2 = right(inputs, caps)
+                    needs = {**n1, **n2}
+                    if part_nid is not None:
+                        from tidb_tpu.parallel import repartition_pair
+
+                        B = caps[part_nid]
+                        lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
+                        needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
                     out, _t = equi_join(rb, lb, rkey, lkey, 0, kind)
                     if null_aware and kind == "anti":
                         bk = rkey(rb)
                         has_null = jnp.any(~bk.valid & rb.row_valid)
+                        if mesh and build_sharded:
+                            has_null = jax.lax.pmax(has_null, "d")
                         pk = lkey(out)
                         out = Batch(out.cols, out.row_valid & ~has_null & pk.valid)
-                    return out, {**n1, **n2}
+                    return out, needs
 
                 return fn_semi, {**ldicts}
 
@@ -493,6 +601,9 @@ class PlanCompiler:
             # row ids (an exact single-key semi join).
             if null_aware:
                 raise ExecError("null-aware multi-key anti join not supported")
+            if mesh:
+                # row-id re-join must see both sides whole: run replicated
+                _gather_both()
             nid = self.fresh_id()
             self.sized.append(nid)
             self.defaults[nid] = 0
@@ -533,6 +644,27 @@ class PlanCompiler:
 
             return fn_semi_multi, {**ldicts}
 
+        part_nid = None
+        forced_swap = False
+        if mesh:
+            if ltag == "repl" and rtag == "shard":
+                if kind == "inner":
+                    # broadcast-style: replicated left is the build side
+                    forced_swap = True
+                    self._tag = "shard"
+                else:
+                    # outer probe must see every build row: gather build
+                    right = self._gathered(right, rtag)
+                    rtag = "repl"
+                    self._tag = "repl"
+            elif ltag == "shard" and rtag == "shard":
+                part_nid = self.fresh_id()
+                self.sized.append(part_nid)
+                self.defaults[part_nid] = 0
+                self._tag = "shard"
+            else:
+                # rtag repl: build side already everywhere (broadcast join)
+                self._tag = ltag
         nid = self.fresh_id()
         self.sized.append(nid)
         self.defaults[nid] = 0  # resolved at first execution from probe cap
@@ -540,8 +672,17 @@ class PlanCompiler:
         def fn_join(inputs, caps):
             lb, n1 = left(inputs, caps)
             rb, n2 = right(inputs, caps)
+            extra_needs = {}
+            if part_nid is not None:
+                from tidb_tpu.parallel import repartition_pair
+
+                B = caps[part_nid]
+                lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
+                extra_needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
             build_b, probe_b, build_k, probe_k = rb, lb, rkey, lkey
-            if kind == "inner" and lb.capacity < rb.capacity:
+            if forced_swap or (
+                kind == "inner" and not mesh and lb.capacity < rb.capacity
+            ):
                 build_b, probe_b, build_k, probe_k = lb, rb, lkey, rkey
             cap = caps[nid] or pad_capacity(max(probe_b.capacity, 1024))
             out, total = equi_join(build_b, probe_b, build_k, probe_k, cap, kind)
@@ -581,13 +722,26 @@ def _cap_tile(n: int) -> int:
 
 
 class PhysicalExecutor:
-    def __init__(self, catalog):
+    """Runs compiled plans. With mesh_devices=N, every plan compiles to a
+    single shard_map program over an N-device mesh: scans row-sharded
+    (the Region data-parallel analog), aggregation/joins exchanged via
+    all_to_all/all_gather collectives (the MPP HashPartition/Broadcast
+    exchanges, pkg/store/mockstore/unistore/cophandler/mpp_exec.go:597),
+    order-sensitive operators on gathered singleton fragments."""
+
+    def __init__(self, catalog, mesh_devices: Optional[int] = None):
         self.catalog = catalog
         # fingerprint + versions -> CompiledQuery
         self._cache: Dict[tuple, CompiledQuery] = {}
         # session hook: (db, table) -> (Table, version) — lets snapshot
         # transactions pin versions / substitute shadow tables.
         self.table_hook = None
+        self.mesh = None
+        self.mesh_n = mesh_devices
+        if mesh_devices:
+            from tidb_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(mesh_devices)
 
     def _resolve(self, db: str, table: str):
         if self.table_hook is not None:
@@ -609,13 +763,53 @@ class PhysicalExecutor:
         walk(plan)
         return (fp, tuple(versions))
 
-    def _fetch_inputs(self, cq: CompiledQuery) -> Dict[int, Batch]:
+    def _fetch_inputs(self, cq: CompiledQuery, mesh=None) -> Dict[int, Batch]:
         inputs = {}
         for s in cq.scans:
             t, v = self._resolve(s.db, s.table)
-            batch, _d = scan_table(t, s.columns, version=v)
+            batch, _d = scan_table(t, s.columns, version=v, mesh=mesh)
             inputs[s.node_id] = batch
         return inputs
+
+    def _make_program(self, cq: CompiledQuery, frozen_caps: Dict[int, int]):
+        """The whole-query callable over global inputs: plain plan fn on
+        one device, or the shard_map-wrapped SPMD program on a mesh (the
+        entire fragment tree is ONE collective XLA program — exchanges
+        are all_to_all/all_gather inside, not RPCs)."""
+        fn = cq.fn
+        if self.mesh is None:
+            return lambda i, _f=fn, _c=frozen_caps: _f(i, _c)
+        from jax.sharding import PartitionSpec as P
+
+        n = self.mesh_n
+
+        def local(i, _f=fn, _c=frozen_caps):
+            b, needs = _f(i, _c)
+            # pmax proves replication of the cardinality scalars to
+            # shard_map AND takes the per-shard max for sizing knobs
+            needs = {k: jax.lax.pmax(v, "d") for k, v in needs.items()}
+            return b, needs
+
+        sm = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P("d"),), out_specs=(P("d"), P())
+        )
+        if cq.out_tag == "repl":
+            from jax.sharding import NamedSharding
+
+            repl = NamedSharding(self.mesh, P())
+
+            def run_repl(i):
+                b, needs = sm(i)
+                # replicated output: every shard emitted an identical full
+                # copy; reshard (so the slice is legal for any mesh size)
+                # and keep the first copy
+                b = jax.tree.map(
+                    lambda a: jax.sharding.reshard(a, repl)[: a.shape[0] // n], b
+                )
+                return b, needs
+
+            return run_repl
+        return sm
 
     def _discover(
         self, cq: CompiledQuery, inputs, jit: bool = True
@@ -628,13 +822,17 @@ class PhysicalExecutor:
         caps = dict(cq.caps or cq.default_caps)
         for nid, c in caps.items():
             if c == 0:  # join knobs start at the dominant input tile
-                caps[nid] = _join_default(inputs, cq)
+                d = _join_default(inputs, cq)
+                if jit and self.mesh_n:
+                    d = _cap_tile(max(d // self.mesh_n, 1024))
+                caps[nid] = d
         while True:
             frozen = dict(caps)
-            fn = cq.fn
             if jit:
-                jitted = jax.jit(lambda i, _f=fn, _c=frozen: _f(i, _c))
+                jitted = jax.jit(self._make_program(cq, frozen))
             else:
+                # eager single-device path (EXPLAIN ANALYZE instrumentation)
+                fn = cq.fn
                 jitted = lambda i, _f=fn, _c=frozen: _f(i, _c)
             out, needs = jitted(inputs)
             needs_host = jax.device_get(needs)
@@ -661,13 +859,15 @@ class PhysicalExecutor:
         key = self._cache_key(plan)
         cq = self._cache.get(key)
         if cq is None:
-            compiler = PlanCompiler(self.catalog, resolver=self._resolve)
+            compiler = PlanCompiler(
+                self.catalog, resolver=self._resolve, mesh_n=self.mesh_n
+            )
             cq = compiler.compile(plan)
             if len(self._cache) > 256:
                 self._cache.clear()
             self._cache[key] = cq
 
-        inputs = self._fetch_inputs(cq)
+        inputs = self._fetch_inputs(cq, mesh=self.mesh)
         shape_key = tuple(sorted((nid, b.capacity) for nid, b in inputs.items()))
 
         if cq.jitted is not None and cq.input_shape_key == shape_key:
@@ -688,9 +888,11 @@ class PhysicalExecutor:
             cq.caps = dict(caps)
             cq.caps[_OUT_NODE] = out_cap
             cq.input_shape_key = shape_key
-            fn, frozen = cq.fn, dict(caps)
+            program = self._make_program(cq, dict(caps))
             cq.jitted = jax.jit(
-                lambda i, _f=fn, _c=frozen, _oc=out_cap: _steady_step(_f, _c, _oc, i)
+                lambda i, _p=program, _oc=out_cap: _steady_step(
+                    _p, _oc, i, mesh=self.mesh
+                )
             )
             # compile + run the steady program now so every later run is a
             # single launch + single fetch
@@ -712,7 +914,7 @@ class PhysicalExecutor:
         """EXPLAIN ANALYZE: instrumented single run with per-node stats."""
         compiler = PlanCompiler(self.catalog, instrument=True, resolver=self._resolve)
         cq = compiler.compile(plan)
-        inputs = self._fetch_inputs(cq)
+        inputs = self._fetch_inputs(cq)  # unsharded: eager single-device
         out, _caps = self._discover(cq, inputs, jit=False)
         lines = []
         for nid, depth, label in compiler.node_labels:
@@ -730,13 +932,21 @@ class PhysicalExecutor:
 _OUT_NODE = -1
 
 
-def _steady_step(fn, caps, out_cap, inputs):
-    """Steady-state whole-query program: plan + output compaction + output
-    cardinality, all in one XLA launch."""
-    out, needs = fn(inputs, caps)
+def _steady_step(program, out_cap, inputs, mesh=None):
+    """Steady-state whole-query program: plan (possibly a shard_map SPMD
+    program) + output compaction + output cardinality, in one XLA launch.
+    Compaction runs on the global (post-shard_map) arrays; on a mesh the
+    result is resharded to replicated first (the compaction gather is not
+    expressible over a row-sharded operand)."""
+    out, needs = program(inputs)
     needs = dict(needs)
     needs[_OUT_NODE] = jnp.sum(out.row_valid.astype(jnp.int32))
     if out_cap < out.capacity:
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            out = jax.tree.map(lambda a: jax.sharding.reshard(a, repl), out)
         out = _compact_impl(out, out_cap)
     return out, needs
 

@@ -38,10 +38,19 @@ class Result:
 
 
 class Session:
-    def __init__(self, catalog: Optional[Catalog] = None, db: str = "test"):
+    def __init__(
+        self,
+        catalog: Optional[Catalog] = None,
+        db: str = "test",
+        mesh_devices: Optional[int] = None,
+    ):
+        """mesh_devices=N runs every query as one SPMD shard_map program
+        over an N-device mesh (sharded scans, all_to_all exchanges) — the
+        MPP mode of the reference (tidb_allow_mpp); None = single device.
+        """
         self.catalog = catalog or Catalog()
         self.db = db
-        self.executor = PhysicalExecutor(self.catalog)
+        self.executor = PhysicalExecutor(self.catalog, mesh_devices=mesh_devices)
         from tidb_tpu.utils import SysVars, Tracer
 
         if not hasattr(self.catalog, "global_sysvars"):

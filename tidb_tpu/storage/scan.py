@@ -53,18 +53,35 @@ def scan_table(
     columns: Sequence[str],
     capacity: Optional[int] = None,
     version: Optional[int] = None,
+    mesh=None,
 ) -> Tuple[Batch, Dict[str, np.ndarray]]:
-    """Returns (device batch, dictionaries for the scanned columns)."""
+    """Returns (device batch, dictionaries for the scanned columns).
+
+    With a mesh, the batch is placed row-sharded over the mesh axis (the
+    Region data-parallel scan analog, SURVEY.md §2.7) and the capacity is
+    padded to a multiple of the mesh size; cached per (version, columns,
+    capacity, mesh)."""
     v = table.version if version is None else version
     cols = tuple(columns)
     blocks = table.blocks(v)
     n = sum(b.nrows for b in blocks)
     cap = capacity or pad_capacity(n)
-    key = (id(table), v, cols, cap)
+    mesh_n = None
+    if mesh is not None:
+        mesh_n = int(mesh.devices.size)
+        if cap % mesh_n:
+            # equal per-shard tiles for any mesh size (a doubling loop
+            # would never terminate for non-power-of-two meshes)
+            cap = mesh_n * pad_capacity(-(-cap // mesh_n), floor=32)
+    key = (id(table), v, cols, cap, mesh_n)
     dicts = {c: table.dictionaries[c] for c in cols if c in table.dictionaries}
     if key in _scan_cache:
         return _scan_cache[key], dicts
     block = concat_blocks(blocks, cols, table.schema)
     batch = block_to_batch(block, cap)
+    if mesh is not None:
+        from tidb_tpu.parallel.mesh import shard_batch
+
+        batch = shard_batch(batch, mesh)
     _scan_cache[key] = batch
     return batch, dicts
