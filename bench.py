@@ -9,6 +9,15 @@ here (same columnar layout, single CPU core — generous to the baseline
 since numpy's C kernels are at least as fast as the Go engine's
 per-chunk loops).
 
+Robustness (round-2 hardening): the default invocation is a *supervisor*
+that never imports jax itself. It runs the measurement in a child
+process; if the TPU/axon backend fails to initialize or crashes
+mid-run (round 1 died with "Unable to initialize backend 'axon'"), it
+retries once and then falls back to a pure-CPU child. Whatever happens,
+the supervisor prints the JSON result line — annotated with the backend
+actually used and per-attempt diagnostics — and exits 0 as long as any
+measurement succeeded.
+
 Usage: python bench.py [--sf 1.0] [--query q1|q6|q18] [--repeat 5] [--quick]
 """
 
@@ -16,13 +25,41 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+Q1_SQL = (
+    "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+    "sum(l_extendedprice) as sum_base_price, "
+    "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
+    "avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price, "
+    "avg(l_discount) as avg_disc, count(*) as count_order "
+    "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+)
+Q6_SQL = (
+    "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+    "where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
+    "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+)
+Q18_SQL = (
+    "select o_orderkey, sum(l_quantity) from lineitem, orders "
+    "where o_orderkey = l_orderkey "
+    "group by o_orderkey having sum(l_quantity) > 1250 "
+    "order by sum(l_quantity) desc limit 100"
+)
+QUERIES = {"q1": Q1_SQL, "q6": Q6_SQL, "q18": Q18_SQL}
 
 
-def numpy_q1(blk, cutoff):
+# ---------------------------------------------------------------------------
+# numpy oracle/baseline kernels (child-side)
+# ---------------------------------------------------------------------------
+
+
+def numpy_q1(np, blk, cutoff):
     ship = blk["l_shipdate"]
     m = ship <= cutoff
     rf = blk["l_returnflag"][m].astype(np.int64)
@@ -47,7 +84,7 @@ def numpy_q1(blk, cutoff):
     return out
 
 
-def numpy_q6(blk, d0, d1):
+def numpy_q6(np, blk, d0, d1):
     ship = blk["l_shipdate"]
     m = (
         (ship >= d0)
@@ -59,7 +96,7 @@ def numpy_q6(blk, d0, d1):
     return (blk["l_extendedprice"][m] * blk["l_discount"][m]).sum()
 
 
-def numpy_q18(blk, thresh):
+def numpy_q18(np, blk, thresh):
     ok = blk["l_orderkey"]
     qty = blk["l_quantity"]
     sums = np.bincount(ok, qty)
@@ -67,43 +104,43 @@ def numpy_q18(blk, thresh):
     return big, sums[big]
 
 
-Q1_SQL = (
-    "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
-    "sum(l_extendedprice) as sum_base_price, "
-    "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
-    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
-    "avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price, "
-    "avg(l_discount) as avg_disc, count(*) as count_order "
-    "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
-    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
-)
-Q6_SQL = (
-    "select sum(l_extendedprice * l_discount) as revenue from lineitem "
-    "where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
-    "and l_discount between 0.05 and 0.07 and l_quantity < 24"
-)
-Q18_SQL = (
-    "select o_orderkey, sum(l_quantity) from lineitem, orders "
-    "where o_orderkey = l_orderkey "
-    "group by o_orderkey having sum(l_quantity) > 1250 "
-    "order by sum(l_quantity) desc limit 100"
-)
+# ---------------------------------------------------------------------------
+# child: actually measure (imports jax via tidb_tpu)
+# ---------------------------------------------------------------------------
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--sf", type=float, default=1.0)
-    ap.add_argument("--query", default="q1", choices=["q1", "q6", "q18"])
-    ap.add_argument("--repeat", type=int, default=5)
-    ap.add_argument("--quick", action="store_true", help="sf=0.01 sanity run")
-    args = ap.parse_args()
-    if args.quick:
-        args.sf = 0.01
+def _force_cpu_in_process() -> None:
+    """Make this interpreter CPU-only even though sitecustomize may have
+    registered a TPU-tunnel PJRT plugin already (same trick as
+    tests/conftest.py)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        import jax as _jax
+        from jax._src import xla_bridge as _xb
+
+        _jax.config.update("jax_platforms", "cpu")
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+    except Exception:
+        pass
+
+
+def measure(args) -> int:
+    if os.environ.get("TIDB_TPU_BENCH_CPU") == "1":
+        _force_cpu_in_process()
+
+    import numpy as np
 
     from tidb_tpu.bench import load_tpch
     from tidb_tpu.dtypes import date_to_days
     from tidb_tpu.session import Session
     from tidb_tpu.storage import Catalog
+
+    import jax
+
+    backend = jax.default_backend()
 
     cat = Catalog()
     t0 = time.perf_counter()
@@ -114,7 +151,7 @@ def main() -> int:
     li = cat.table("tpch", "lineitem")
     nrows = li.nrows
 
-    sql = {"q1": Q1_SQL, "q6": Q6_SQL, "q18": Q18_SQL}[args.query]
+    sql = QUERIES[args.query]
 
     # device engine (includes host->device on first run; cached after)
     sess.execute(sql)  # warmup: compile + scan cache
@@ -139,11 +176,11 @@ def main() -> int:
     for _ in range(max(args.repeat, 2)):
         t0 = time.perf_counter()
         if args.query == "q1":
-            numpy_q1(blk, cutoff)
+            numpy_q1(np, blk, cutoff)
         elif args.query == "q6":
-            numpy_q6(blk, d0, d1)
+            numpy_q6(np, blk, d0, d1)
         else:
-            numpy_q18(blk, 12500)
+            numpy_q18(np, blk, 125000)
         base_times.append(time.perf_counter() - t0)
     base_s = float(np.median(base_times))
 
@@ -160,10 +197,120 @@ def main() -> int:
             "numpy_baseline_s": round(base_s, 4),
             "datagen_s": round(gen_s, 2),
             "repeat": args.repeat,
+            "backend": backend,
         },
     }
     print(json.dumps(result))
     return 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor: run the measurement in a child, retry, fall back to CPU
+# ---------------------------------------------------------------------------
+
+
+def _run_child(argv, env, timeout_s):
+    """Run one measurement attempt; return (result_dict|None, attempt_info)."""
+    t0 = time.perf_counter()
+    info = {"backend": "cpu" if env.get("TIDB_TPU_BENCH_CPU") == "1" else "tpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_measure", *argv],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        info["rc"] = proc.returncode
+        info["seconds"] = round(time.perf_counter() - t0, 1)
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line), info
+                    except json.JSONDecodeError:
+                        continue
+            info["error"] = "child exited 0 but printed no JSON"
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            info["error"] = " | ".join(tail[-4:])[-800:]
+    except subprocess.TimeoutExpired:
+        info["rc"] = -1
+        info["seconds"] = round(time.perf_counter() - t0, 1)
+        info["error"] = f"timeout after {timeout_s}s"
+    except Exception as exc:  # supervisor must never die
+        info["rc"] = -2
+        info["seconds"] = round(time.perf_counter() - t0, 1)
+        info["error"] = f"{type(exc).__name__}: {exc}"
+    return None, info
+
+
+def supervise(args, passthrough) -> int:
+    attempts = []
+    tpu_timeout = int(os.environ.get("TIDB_TPU_BENCH_TIMEOUT", "900"))
+
+    plans = []
+    if not args.cpu:
+        plans.append(("tpu", tpu_timeout))
+    plans.append(("cpu", tpu_timeout))
+
+    result = None
+    for i, (backend, timeout_s) in enumerate(plans):
+        env = dict(os.environ)
+        if backend == "cpu":
+            env["TIDB_TPU_BENCH_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        result, info = _run_child(passthrough, env, timeout_s)
+        attempts.append(info)
+        if result is not None:
+            break
+        # A fast TPU failure is likely a transient tunnel/init error:
+        # retry once before giving up on the backend.
+        if backend == "tpu" and info.get("seconds", 0) < 120 and i == 0:
+            time.sleep(10)
+            result, info2 = _run_child(passthrough, env, timeout_s)
+            attempts.append(info2)
+            if result is not None:
+                break
+
+    if result is None:
+        print(
+            json.dumps(
+                {
+                    "metric": f"tpch_{args.query}_sf{args.sf:g}_rows_per_sec",
+                    "value": 0,
+                    "unit": "rows/s",
+                    "vs_baseline": 0,
+                    "detail": {"error": "all attempts failed", "attempts": attempts},
+                }
+            )
+        )
+        return 1
+
+    result.setdefault("detail", {})["attempts"] = attempts
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--query", default="q1", choices=sorted(QUERIES))
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--quick", action="store_true", help="sf=0.01 sanity run")
+    ap.add_argument("--cpu", action="store_true", help="skip TPU, measure on CPU")
+    ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.quick:
+        args.sf = 0.01
+
+    if args._measure:
+        return measure(args)
+
+    passthrough = ["--sf", str(args.sf), "--query", args.query, "--repeat", str(args.repeat)]
+    return supervise(args, passthrough)
 
 
 if __name__ == "__main__":
