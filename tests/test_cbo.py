@@ -1,0 +1,173 @@
+"""Cost-based optimization: statistics drive join order, broadcast
+exchange choice, and EXPLAIN estimates.
+
+Reference: pkg/planner/cardinality/selectivity.go (histogram/NDV
+selectivity), rule_join_reorder.go (cost-driven order),
+exhaust_physical_plans.go (broadcast-vs-shuffle MPP join). VERDICT round
+1 criterion: a Q5-shaped 6-way join picks the small side to broadcast
+and EXPLAIN prints est-rows per node.
+"""
+
+import pytest
+
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.planner.cardinality import est_rows, gather_stats, selectivity
+from tidb_tpu.planner.logical import JoinPlan, Scan, build_query
+from tidb_tpu.parser import parse
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+Q5 = (
+    "select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue "
+    "from customer, orders, lineitem, supplier, nation, region "
+    "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+    "and l_suppkey = s_suppkey and c_nationkey = s_nationkey "
+    "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+    "and r_name = 'ASIA' "
+    "and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01' "
+    "group by n_name order by revenue desc"
+)
+
+
+@pytest.fixture(scope="module")
+def sess():
+    cat = Catalog()
+    load_tpch(
+        cat,
+        sf=0.01,
+        tables=["orders", "lineitem", "customer", "supplier", "nation", "region"],
+        seed=3,
+    )
+    s = Session(cat, db="tpch")
+    for t in ["lineitem", "orders", "customer", "supplier", "nation", "region"]:
+        s.execute(f"analyze table {t}")
+    return s
+
+
+def _plan_of(sess, sql):
+    st = parse(sql)
+    st = st[0] if isinstance(st, list) else st
+    return build_query(st, sess.catalog, "tpch", sess._scalar_subquery)
+
+
+def _joins(plan, out=None):
+    out = [] if out is None else out
+    if isinstance(plan, JoinPlan):
+        out.append(plan)
+    for a in ("child", "left", "right"):
+        c = getattr(plan, a, None)
+        if c is not None:
+            _joins(c, out)
+    for c in getattr(plan, "children", []) or []:
+        _joins(c, out)
+    return out
+
+
+def _scans_in_order(plan, out=None):
+    out = [] if out is None else out
+    if isinstance(plan, Scan):
+        out.append(plan.table)
+    for a in ("child", "left", "right"):
+        c = getattr(plan, a, None)
+        if c is not None:
+            _scans_in_order(c, out)
+    return out
+
+
+def test_explain_prints_estimates(sess):
+    r = sess.must_query("explain " + Q5)
+    lines = [row[0] for row in r.rows]
+    assert all("est=" in l for l in lines), lines
+    # the filtered region scan estimates ~1 row; lineitem its full count
+    li = sess.catalog.table("tpch", "lineitem")
+    scan_lines = [l for l in lines if "Scan" in l and "lineitem" in l]
+    assert scan_lines and f"est={li.nrows}" in scan_lines[0]
+
+
+def test_q5_join_order_small_first(sess):
+    """Cost-driven reorder starts from the filtered tiny relations and
+    joins lineitem (largest) last — i.e. lineitem sits at depth 1 of the
+    join spine, not at the bottom."""
+    plan = _plan_of(sess, Q5)
+    joins = _joins(plan)
+    assert len(joins) == 5
+    # top join's right side should be the biggest relation (lineitem);
+    # the deepest subtree should contain region/nation (smallest)
+    top = joins[0]
+    right_tables = _scans_in_order(top.right)
+    assert right_tables == ["lineitem"]
+    deepest = _scans_in_order(joins[-1])
+    assert set(deepest) <= {"region", "nation", "supplier"}
+
+
+def test_q5_broadcast_choice(sess):
+    """The small accumulated side is marked for broadcast against the
+    large lineitem side."""
+    plan = _plan_of(sess, Q5)
+    top = _joins(plan)[0]
+    assert top.broadcast == "left"
+
+
+def test_selectivity_histogram_range(sess):
+    """Date range selectivity comes from the histogram, not the 1/3
+    pseudo rate: a one-year slice of a 6.5-year uniform range estimates
+    ~15%, far from 33%."""
+    plan = _plan_of(
+        sess,
+        "select count(*) from orders "
+        "where o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'",
+    )
+    smap = gather_stats(plan, sess.catalog)
+    n = est_rows(plan, sess.catalog, smap)
+    orders = sess.catalog.table("tpch", "orders")
+    actual = None
+    r = sess.must_query(
+        "select count(*) from orders where o_orderdate >= date '1994-01-01' "
+        "and o_orderdate < date '1995-01-01'"
+    )
+    actual = r.rows[0][0]
+    # estimate within 2x of the true count and well under the pseudo 1/3
+    assert actual / 2 <= _agg_input_est(plan) <= actual * 2
+    assert _agg_input_est(plan) < orders.nrows / 4
+
+
+def _agg_input_est(plan):
+    # est of the Selection feeding the aggregate
+    from tidb_tpu.planner.logical import Selection
+
+    cur = plan
+    while cur is not None:
+        if isinstance(cur, Selection):
+            return cur.est
+        cur = getattr(cur, "child", None)
+    raise AssertionError("no Selection in plan")
+
+
+def test_eq_selectivity_uses_ndv(sess):
+    plan = _plan_of(
+        sess, "select count(*) from supplier where s_suppkey = 17"
+    )
+    est_rows(plan, sess.catalog)
+    assert _agg_input_est(plan) <= 2  # 1/NDV of a unique key -> ~1 row
+
+
+def test_broadcast_join_mesh_parity(sess):
+    """The broadcast-join path produces identical results on the 8-device
+    mesh (all_gather of the small side instead of all_to_all of both)."""
+    mesh = Session(sess.catalog, db="tpch", mesh_devices=8)
+    sql = (
+        "select n_name, count(*) from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name "
+        "order by count(*) desc, n_name limit 5"
+    )
+    plan = _plan_of(sess, sql)
+    assert any(j.broadcast for j in _joins(plan))
+    a = sess.must_query(sql)
+    b = mesh.must_query(sql)
+    assert a.rows == b.rows
+    c = sess.must_query(Q5)
+    d = mesh.must_query(Q5)
+    assert len(c.rows) == len(d.rows)
+    for x, y in zip(c.rows, d.rows):
+        assert x[0] == y[0]
+        assert abs(x[1] - y[1]) < 0.02

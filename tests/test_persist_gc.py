@@ -1,0 +1,111 @@
+"""Durability (save/load snapshot) and MVCC version GC.
+
+Reference: BR full backup (br/pkg/task/backup.go) for persistence; the
+GC worker safepoint contract (pkg/store/gcworker/gc_worker.go:194,371)
+for version pruning. VERDICT round-1 criteria: a restart test reloads
+the catalog; a long UPDATE loop holds steady memory.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog, load_catalog, save_catalog
+
+
+def test_save_load_roundtrip(tmp_path):
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("create database shop")
+    s.execute("use shop")
+    s.execute(
+        "create table items (id bigint, name varchar(32), price decimal(10,2), "
+        "added date, score double)"
+    )
+    s.execute(
+        "insert into items values (1,'apple',1.25,'2024-01-31',0.5),"
+        "(2,'pear',null,'2023-06-01',null),(3,null,3.5,null,2.25)"
+    )
+    q = "select id, name, price, added, score from items order by id"
+    before = s.must_query(q).rows
+
+    save_catalog(cat, str(tmp_path / "snap"))
+
+    cat2 = load_catalog(str(tmp_path / "snap"))
+    s2 = Session(cat2, db="shop")
+    after = s2.must_query(q).rows
+    assert after == before
+    # the restored store is writable and queryable
+    s2.execute("insert into items values (4,'fig',9.99,'2025-05-05',1.0)")
+    assert s2.must_query("select count(*) from items").rows == [(4,)]
+
+
+def test_update_loop_holds_versions_steady():
+    s = Session(Catalog())
+    s.execute("create table t (k bigint, v bigint)")
+    s.execute("insert into t values (1, 0), (2, 0)")
+    t = s.catalog.table("test", "t")
+    for i in range(300):
+        s.execute(f"update t set v = {i} where k = 1")
+    # GC keeps only current + previous (no pins active)
+    assert len(t._versions) <= 2, len(t._versions)
+    assert s.must_query("select v from t where k = 1").rows == [(299,)]
+
+
+def test_pinned_snapshot_survives_gc():
+    s = Session(Catalog())
+    s.execute("create table t (k bigint, v bigint)")
+    s.execute("insert into t values (1, 10)")
+    writer = Session(s.catalog)
+    s.execute("begin")
+    assert s.must_query("select v from t").rows == [(10,)]  # pins snapshot
+    for i in range(20):
+        writer.execute(f"update t set v = {100 + i} where k = 1")
+    # the reader's snapshot version is pinned through the writer churn
+    assert s.must_query("select v from t").rows == [(10,)]
+    s.execute("rollback")
+    assert s.must_query("select v from t").rows == [(119,)]
+    t = s.catalog.table("test", "t")
+    writer.execute("update t set v = 1 where k = 1")
+    assert len(t._versions) <= 2
+
+
+# ---- point/range access (reference: point_get.go:132 + ranger) ------------
+
+
+def test_point_and_range_pk_access():
+    s = Session(Catalog())
+    s.execute("create table p (k bigint primary key, v bigint)")
+    s.execute(
+        "insert into p values " + ",".join(f"({i},{i * 3})" for i in range(500))
+    )
+    assert s.must_query("select v from p where k = 42").rows == [(126,)]
+    assert s.must_query(
+        "select count(*), sum(v) from p where k between 10 and 14"
+    ).rows == [(5, 180)]
+    assert s.must_query("select v from p where k = 9999").rows == []
+    # compiled plan carries the range: scan site fetches a tiny batch
+    from tidb_tpu.parser import parse
+    from tidb_tpu.planner import build_query
+    from tidb_tpu.planner.physical import PlanCompiler
+
+    st = parse("select v from p where k = 42")
+    st = st[0] if isinstance(st, list) else st
+    plan = build_query(st, s.catalog, "test", s._scalar_subquery)
+    comp = PlanCompiler(s.catalog)
+    cq = comp.compile(plan)
+    assert comp.scans[0].pk_range == ("k", 42, 42)
+
+
+def test_pk_update_touches_only_matching_rows():
+    s = Session(Catalog())
+    s.execute("create table u (k bigint primary key, v bigint, d decimal(8,2))")
+    s.execute(
+        "insert into u values " + ",".join(f"({i},{i},{i}.5)" for i in range(100))
+    )
+    s.execute("update u set v = v * 10, d = 0.25 where k = 7")
+    assert s.must_query("select v, d from u where k = 7").rows == [(70, 0.25)]
+    assert s.must_query("select v, d from u where k = 8").rows == [(8, 8.5)]
+    assert s.must_query("select count(*), sum(v) from u").rows[0][0] == 100
+    # NULL assignment through the columnar path
+    s.execute("update u set v = null where k = 3")
+    assert s.must_query("select v from u where k = 3").rows == [(None,)]

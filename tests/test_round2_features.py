@@ -1,0 +1,178 @@
+"""Round-2 feature coverage: recursive CTEs, DISTINCT aggregates,
+calendar-exact interval arithmetic, wide decimal SUM accumulation, and
+the drop/recreate cache-aliasing regression."""
+
+import math
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture()
+def sess():
+    return Session(Catalog())
+
+
+# ---- recursive CTEs (reference: pkg/executor/cte.go:70) -------------------
+
+
+def test_recursive_cte_sequence(sess):
+    r = sess.must_query(
+        "with recursive nums(n) as (select 1 union all "
+        "select n + 1 from nums where n < 10) "
+        "select sum(n), count(*), max(n) from nums"
+    )
+    assert r.rows == [(55, 10, 10)]
+
+
+def test_recursive_cte_fib(sess):
+    r = sess.must_query(
+        "with recursive fib(a, b) as (select 1, 1 union all "
+        "select b, a + b from fib where b < 100) select max(b) from fib"
+    )
+    assert r.rows == [(144,)]
+
+
+def test_recursive_cte_hierarchy(sess):
+    sess.execute("create table emp (id bigint, mgr bigint)")
+    sess.execute(
+        "insert into emp values (1, null), (2, 1), (3, 1), (4, 2), (5, 4), (6, 3)"
+    )
+    r = sess.must_query(
+        "with recursive sub(id) as (select id from emp where id = 2 "
+        "union all select e.id from emp e, sub where e.mgr = sub.id) "
+        "select id from sub order by id"
+    )
+    assert [x[0] for x in r.rows] == [2, 4, 5]
+
+
+def test_recursive_cte_union_distinct_cycle_terminates(sess):
+    sess.execute("create table g (src bigint, dst bigint)")
+    sess.execute("insert into g values (1,2),(2,3),(3,1),(3,4)")
+    r = sess.must_query(
+        "with recursive reach(node) as (select 1 union "
+        "select g.dst from g, reach where g.src = reach.node) "
+        "select node from reach order by node"
+    )
+    assert [x[0] for x in r.rows] == [1, 2, 3, 4]
+
+
+def test_recursive_cte_depth_guard(sess):
+    with pytest.raises(Exception, match="iterations"):
+        sess.execute(
+            "with recursive inf(n) as (select 1 union all "
+            "select n + 1 from inf) select count(*) from inf"
+        )
+
+
+# ---- DISTINCT aggregates --------------------------------------------------
+
+
+def test_count_distinct(sess):
+    sess.execute("create table t (g varchar(8), x bigint)")
+    sess.execute(
+        "insert into t values ('a',1),('a',1),('a',2),('b',5),('b',null),"
+        "('b',5),('c',null)"
+    )
+    r = sess.must_query(
+        "select g, count(distinct x), count(*), sum(x) from t group by g order by g"
+    )
+    assert r.rows == [("a", 2, 3, 4), ("b", 1, 3, 10), ("c", 0, 1, None)]
+    r = sess.must_query("select count(distinct x) from t")
+    assert r.rows == [(3,)]
+    r = sess.must_query("select sum(distinct x) from t")
+    assert r.rows == [(8,)]
+    r = sess.must_query("select avg(distinct x) from t")
+    assert r.rows[0][0] == pytest.approx(8 / 3)
+
+
+# ---- calendar-exact interval arithmetic -----------------------------------
+
+
+def test_month_interval_exact(sess):
+    sess.execute("create table d (i bigint, dt date)")
+    sess.execute(
+        "insert into d values (1,'1998-03-31'),(2,'1996-02-29'),(3,'1995-12-15')"
+    )
+    from tidb_tpu.dtypes import date_to_days
+
+    r = sess.must_query(
+        "select i, date_sub(dt, interval 1 month), "
+        "date_add(dt, interval 1 year) from d order by i"
+    )
+    assert r.rows[0][1] == date_to_days("1998-02-28")  # clamped, not -30d
+    assert r.rows[0][2] == date_to_days("1999-03-31")
+    assert r.rows[1][1] == date_to_days("1996-01-29")
+    assert r.rows[1][2] == date_to_days("1997-02-28")  # leap -> clamp
+    assert r.rows[2][1] == date_to_days("1995-11-15")
+    r = sess.must_query("select date '1998-12-01' - interval 3 month")
+    assert r.rows == [("1998-09-01",)]
+
+
+# ---- wide decimal SUM (no int64 wraparound) -------------------------------
+
+
+def test_wide_decimal_sum_no_overflow(sess):
+    # scale-6 values: ~9.2e12 each scaled; 2000 rows of 9e14 scaled-6
+    # would wrap int64 via the naive path at ~1e4 rows x 1e15
+    sess.execute("create table w (v decimal(20, 2))")
+    n = 200
+    big = 92_000_000_000_000.25  # 9.2e13; scaled-6 product ~9.2e19 > 2^63
+    sess.execute(
+        "insert into w values " + ",".join(f"({big})" for _ in range(n))
+    )
+    r = sess.must_query("select sum(v * 1.0000 * 1.0000) from w")
+    got = r.rows[0][0]
+    assert got == pytest.approx(big * n, rel=1e-12)
+
+
+# ---- drop/recreate aliasing regression ------------------------------------
+
+
+def test_drop_recreate_no_stale_cache(sess):
+    for i in range(6):
+        sess.execute("drop table if exists r")
+        sess.execute("create table r (x bigint)")
+        sess.execute(f"insert into r values ({i}), ({i + 10})")
+        r = sess.must_query("select sum(x) from r")
+        assert r.rows == [(2 * i + 10,)], i
+
+
+# ---- ROWS window frames ---------------------------------------------------
+
+
+def test_rows_frame_sum_count(sess):
+    sess.execute("create table wf (g varchar(4), x bigint)")
+    sess.execute(
+        "insert into wf values ('a',1),('a',2),('a',3),('a',4),('b',10),('b',20)"
+    )
+    r = sess.must_query(
+        "select g, x, "
+        "sum(x) over (partition by g order by x rows between 1 preceding and 1 following), "
+        "count(*) over (partition by g order by x rows between 1 preceding and current row), "
+        "sum(x) over (partition by g order by x rows between unbounded preceding and 1 following), "
+        "sum(x) over (partition by g order by x rows 2 preceding) "
+        "from wf order by g, x"
+    )
+    assert r.rows == [
+        ("a", 1, 3, 1, 3, 1),
+        ("a", 2, 6, 2, 6, 3),
+        ("a", 3, 9, 2, 10, 6),
+        ("a", 4, 7, 2, 10, 9),
+        ("b", 10, 30, 1, 30, 10),
+        ("b", 20, 30, 2, 30, 30),
+    ]
+
+
+def test_rows_frame_unbounded_equivalents(sess):
+    sess.execute("create table wf2 (x bigint)")
+    sess.execute("insert into wf2 values (1),(2),(3)")
+    r = sess.must_query(
+        "select x, "
+        "sum(x) over (order by x rows between unbounded preceding and current row), "
+        "sum(x) over (order by x rows between unbounded preceding and unbounded following) "
+        "from wf2 order by x"
+    )
+    assert r.rows == [(1, 1, 6), (2, 3, 6), (3, 6, 6)]

@@ -371,3 +371,682 @@ def test_q21(sess):
         cnt[sk] += 1
     expected = sorted(cnt.items(), key=lambda t: (-t[1], t[0]))[:100]
     assert [(a, b) for a, b in r.rows] == expected
+
+
+def test_q2(sess):
+    """Q2: correlated scalar MIN subquery over partsupp (decorrelated to a
+    grouped-min left join; reference shape: expression_rewriter.go)."""
+    r = sess.must_query(
+        "select s_acctbal, s_name, n_name, p_partkey, p_mfgr "
+        "from part, supplier, partsupp, nation, region "
+        "where p_partkey = ps_partkey and s_suppkey = ps_suppkey "
+        "and p_size = 15 and p_type like '%BRASS' "
+        "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+        "and r_name = 'EUROPE' "
+        "and ps_supplycost = (select min(ps_supplycost) from partsupp, supplier, "
+        "nation, region where p_partkey = ps_partkey and s_suppkey = ps_suppkey "
+        "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+        "and r_name = 'EUROPE') "
+        "order by s_acctbal desc, n_name, s_name, p_partkey limit 100"
+    )
+    part, np_ = decode_table(sess, "part")
+    supp, ns = decode_table(sess, "supplier")
+    ps, nps = decode_table(sess, "partsupp")
+    nat, nn = decode_table(sess, "nation")
+    reg, nr = decode_table(sess, "region")
+    europe = {reg["r_regionkey"][i] for i in range(nr) if reg["r_name"][i] == "EUROPE"}
+    nat_info = {
+        nat["n_nationkey"][i]: nat["n_name"][i]
+        for i in range(nn)
+        if nat["n_regionkey"][i] in europe
+    }
+    s_info = {
+        supp["s_suppkey"][i]: (
+            supp["s_acctbal"][i],
+            supp["s_name"][i],
+            supp["s_nationkey"][i],
+        )
+        for i in range(ns)
+    }
+    # min supplycost per part over european suppliers
+    min_cost = {}
+    for i in range(nps):
+        sk = ps["ps_suppkey"][i]
+        if sk not in s_info or s_info[sk][2] not in nat_info:
+            continue
+        pk = ps["ps_partkey"][i]
+        c = ps["ps_supplycost"][i]
+        if pk not in min_cost or c < min_cost[pk]:
+            min_cost[pk] = c
+    p_ok = {
+        part["p_partkey"][i]: part["p_mfgr"][i]
+        for i in range(np_)
+        if part["p_size"][i] == 15 and part["p_type"][i].endswith("BRASS")
+    }
+    expected = []
+    for i in range(nps):
+        pk, sk = ps["ps_partkey"][i], ps["ps_suppkey"][i]
+        if pk not in p_ok or sk not in s_info:
+            continue
+        bal, sname, snat = s_info[sk]
+        if snat not in nat_info:
+            continue
+        if ps["ps_supplycost"][i] != min_cost.get(pk):
+            continue
+        expected.append((bal, sname, nat_info[snat], pk, p_ok[pk]))
+    expected.sort(key=lambda t: (-t[0], t[2], t[1], t[3]))
+    expected = expected[:100]
+    got = [(round(a, 2), b, c, d, e) for a, b, c, d, e in r.rows]
+    expected = [(round(a, 2), b, c, d, e) for a, b, c, d, e in expected]
+    assert got == expected
+
+
+def test_q7(sess):
+    """Q7: two nation aliases, OR of name pairs, EXTRACT(YEAR), derived
+    table with aliased expression columns."""
+    r = sess.must_query(
+        "select supp_nation, cust_nation, l_year, sum(volume) as revenue "
+        "from (select n1.n_name as supp_nation, n2.n_name as cust_nation, "
+        "extract(year from l_shipdate) as l_year, "
+        "l_extendedprice * (1 - l_discount) as volume "
+        "from supplier, lineitem, orders, customer, nation n1, nation n2 "
+        "where s_suppkey = l_suppkey and o_orderkey = l_orderkey "
+        "and c_custkey = o_custkey and s_nationkey = n1.n_nationkey "
+        "and c_nationkey = n2.n_nationkey "
+        "and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY') "
+        "or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE')) "
+        "and l_shipdate between date '1995-01-01' and date '1996-12-31'"
+        ") as shipping "
+        "group by supp_nation, cust_nation, l_year "
+        "order by supp_nation, cust_nation, l_year"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    cust, nc = decode_table(sess, "customer")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    import datetime
+
+    nname = {nat["n_nationkey"][i]: nat["n_name"][i] for i in range(nn)}
+    s_nat = {supp["s_suppkey"][i]: supp["s_nationkey"][i] for i in range(ns)}
+    c_nat = {cust["c_custkey"][i]: cust["c_nationkey"][i] for i in range(nc)}
+    o_cust = {orders["o_orderkey"][i]: orders["o_custkey"][i] for i in range(no)}
+    d0, d1 = days("1995-01-01"), days("1996-12-31")
+    epoch = datetime.date(1970, 1, 1)
+    agg = defaultdict(float)
+    for i in range(nl):
+        if not (d0 <= li["l_shipdate"][i] <= d1):
+            continue
+        sn = nname.get(s_nat.get(li["l_suppkey"][i]))
+        ck = o_cust.get(li["l_orderkey"][i])
+        cn = nname.get(c_nat.get(ck)) if ck is not None else None
+        pair = (sn, cn)
+        if pair not in (("FRANCE", "GERMANY"), ("GERMANY", "FRANCE")):
+            continue
+        y = (epoch + datetime.timedelta(days=li["l_shipdate"][i])).year
+        agg[(sn, cn, y)] += li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+    expected = sorted((k[0], k[1], k[2], round(v, 4)) for k, v in agg.items())
+    got = [(a, b, c, round(d, 4)) for a, b, c, d in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[:3] == e[:3]
+        assert math.isclose(g[3], e[3], abs_tol=0.02)
+
+
+def test_q8(sess):
+    """Q8: market-share CASE aggregation over a two-level derived table."""
+    r = sess.must_query(
+        "select o_year, sum(case when nation = 'BRAZIL' then volume else 0 end) "
+        "/ sum(volume) as mkt_share "
+        "from (select extract(year from o_orderdate) as o_year, "
+        "l_extendedprice * (1 - l_discount) as volume, n2.n_name as nation "
+        "from part, supplier, lineitem, orders, customer, nation n1, nation n2, region "
+        "where p_partkey = l_partkey and s_suppkey = l_suppkey "
+        "and l_orderkey = o_orderkey and o_custkey = c_custkey "
+        "and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey "
+        "and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey "
+        "and o_orderdate between date '1995-01-01' and date '1996-12-31' "
+        "and p_type = 'ECONOMY ANODIZED STEEL') as all_nations "
+        "group by o_year order by o_year"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    cust, nc = decode_table(sess, "customer")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    reg, nr = decode_table(sess, "region")
+    part, np_ = decode_table(sess, "part")
+    import datetime
+
+    america = {reg["r_regionkey"][i] for i in range(nr) if reg["r_name"][i] == "AMERICA"}
+    nat_region = {nat["n_nationkey"][i]: nat["n_regionkey"][i] for i in range(nn)}
+    nname = {nat["n_nationkey"][i]: nat["n_name"][i] for i in range(nn)}
+    c_nat = {cust["c_custkey"][i]: cust["c_nationkey"][i] for i in range(nc)}
+    s_nat = {supp["s_suppkey"][i]: supp["s_nationkey"][i] for i in range(ns)}
+    p_ok = {
+        part["p_partkey"][i]
+        for i in range(np_)
+        if part["p_type"][i] == "ECONOMY ANODIZED STEEL"
+    }
+    d0, d1 = days("1995-01-01"), days("1996-12-31")
+    o_info = {}
+    for i in range(no):
+        if d0 <= orders["o_orderdate"][i] <= d1:
+            o_info[orders["o_orderkey"][i]] = (
+                orders["o_custkey"][i],
+                orders["o_orderdate"][i],
+            )
+    epoch = datetime.date(1970, 1, 1)
+    num = defaultdict(float)
+    den = defaultdict(float)
+    for i in range(nl):
+        if li["l_partkey"][i] not in p_ok:
+            continue
+        oi = o_info.get(li["l_orderkey"][i])
+        if oi is None:
+            continue
+        ck, od = oi
+        cn = c_nat.get(ck)
+        if cn is None or nat_region.get(cn) not in america:
+            continue
+        sn = s_nat.get(li["l_suppkey"][i])
+        if sn is None:
+            continue
+        y = (epoch + datetime.timedelta(days=od)).year
+        vol = li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+        den[y] += vol
+        if nname.get(sn) == "BRAZIL":
+            num[y] += vol
+    expected = [(y, num[y] / den[y]) for y in sorted(den)]
+    got = r.rows
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0]
+        assert math.isclose(g[1], e[1], rel_tol=1e-9, abs_tol=1e-12)
+
+
+def test_q9(sess):
+    """Q9: profit by nation and year; LIKE '%green%' on p_name, partsupp
+    double-key join."""
+    r = sess.must_query(
+        "select nation, o_year, sum(amount) as sum_profit "
+        "from (select n_name as nation, "
+        "extract(year from o_orderdate) as o_year, "
+        "l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount "
+        "from part, supplier, lineitem, partsupp, orders, nation "
+        "where s_suppkey = l_suppkey and ps_suppkey = l_suppkey "
+        "and ps_partkey = l_partkey and p_partkey = l_partkey "
+        "and o_orderkey = l_orderkey and s_nationkey = n_nationkey "
+        "and p_name like '%green%') as profit "
+        "group by nation, o_year order by nation, o_year desc"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    part, np_ = decode_table(sess, "part")
+    ps, nps = decode_table(sess, "partsupp")
+    import datetime
+
+    nname = {nat["n_nationkey"][i]: nat["n_name"][i] for i in range(nn)}
+    s_nat = {supp["s_suppkey"][i]: supp["s_nationkey"][i] for i in range(ns)}
+    p_ok = {part["p_partkey"][i] for i in range(np_) if "green" in part["p_name"][i]}
+    ps_cost = {
+        (ps["ps_partkey"][i], ps["ps_suppkey"][i]): ps["ps_supplycost"][i]
+        for i in range(nps)
+    }
+    o_date = {orders["o_orderkey"][i]: orders["o_orderdate"][i] for i in range(no)}
+    epoch = datetime.date(1970, 1, 1)
+    agg = defaultdict(float)
+    for i in range(nl):
+        pk = li["l_partkey"][i]
+        if pk not in p_ok:
+            continue
+        sk = li["l_suppkey"][i]
+        cost = ps_cost.get((pk, sk))
+        od = o_date.get(li["l_orderkey"][i])
+        sn = s_nat.get(sk)
+        if cost is None or od is None or sn is None or sn not in nname:
+            continue
+        y = (epoch + datetime.timedelta(days=od)).year
+        amount = li["l_extendedprice"][i] * (1 - li["l_discount"][i]) - cost * li["l_quantity"][i]
+        agg[(nname[sn], y)] += amount
+    expected = sorted(
+        ((k[0], k[1], round(v, 4)) for k, v in agg.items()),
+        key=lambda t: (t[0], -t[1]),
+    )
+    got = [(a, b, round(c, 4)) for a, b, c in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[:2] == e[:2]
+        assert math.isclose(g[2], e[2], abs_tol=0.05)
+
+
+def test_q10(sess):
+    """Q10 (full form): returned-item reporting with customer details."""
+    r = sess.must_query(
+        "select c_custkey, c_name, "
+        "sum(l_extendedprice * (1 - l_discount)) as revenue, c_acctbal, "
+        "n_name, c_address, c_phone, c_comment "
+        "from customer, orders, lineitem, nation "
+        "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+        "and o_orderdate >= date '1993-10-01' "
+        "and o_orderdate < date '1994-01-01' "
+        "and l_returnflag = 'R' and c_nationkey = n_nationkey "
+        "group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment "
+        "order by revenue desc, c_custkey limit 20"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    cust, nc = decode_table(sess, "customer")
+    nat, nn = decode_table(sess, "nation")
+    nname = {nat["n_nationkey"][i]: nat["n_name"][i] for i in range(nn)}
+    c_info = {
+        cust["c_custkey"][i]: (
+            cust["c_name"][i],
+            cust["c_acctbal"][i],
+            nname[cust["c_nationkey"][i]],
+            cust["c_address"][i],
+            cust["c_phone"][i],
+            cust["c_comment"][i],
+        )
+        for i in range(nc)
+    }
+    d0, d1 = days("1993-10-01"), days("1994-01-01")
+    o_cust = {
+        orders["o_orderkey"][i]: orders["o_custkey"][i]
+        for i in range(no)
+        if d0 <= orders["o_orderdate"][i] < d1
+    }
+    agg = defaultdict(float)
+    for i in range(nl):
+        if li["l_returnflag"][i] != "R":
+            continue
+        ck = o_cust.get(li["l_orderkey"][i])
+        if ck is None:
+            continue
+        agg[ck] += li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+    expected = []
+    for ck, rev in agg.items():
+        nm, bal, nnm, addr, ph, cm = c_info[ck]
+        expected.append((ck, nm, round(rev, 4), bal, nnm, addr, ph, cm))
+    expected.sort(key=lambda t: (-t[2], t[0]))
+    expected = expected[:20]
+    got = [(a, b, round(c, 4), d, e, f, g, h) for a, b, c, d, e, f, g, h in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0] and g[1] == e[1] and g[4:] == e[4:]
+        assert math.isclose(g[2], e[2], abs_tol=0.02)
+        assert math.isclose(g[3], e[3], abs_tol=0.01)
+
+
+def test_q11(sess):
+    """Q11: HAVING against an uncorrelated scalar subquery."""
+    r = sess.must_query(
+        "select ps_partkey, sum(ps_supplycost * ps_availqty) as value "
+        "from partsupp, supplier, nation "
+        "where ps_suppkey = s_suppkey and s_nationkey = n_nationkey "
+        "and n_name = 'GERMANY' "
+        "group by ps_partkey having "
+        "sum(ps_supplycost * ps_availqty) > ("
+        "select sum(ps_supplycost * ps_availqty) * 0.005 "
+        "from partsupp, supplier, nation "
+        "where ps_suppkey = s_suppkey and s_nationkey = n_nationkey "
+        "and n_name = 'GERMANY') "
+        "order by value desc, ps_partkey"
+    )
+    ps, nps = decode_table(sess, "partsupp")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    germany = {
+        nat["n_nationkey"][i] for i in range(nn) if nat["n_name"][i] == "GERMANY"
+    }
+    s_ok = {supp["s_suppkey"][i] for i in range(ns) if supp["s_nationkey"][i] in germany}
+    agg = defaultdict(float)
+    total = 0.0
+    for i in range(nps):
+        if ps["ps_suppkey"][i] in s_ok:
+            v = ps["ps_supplycost"][i] * ps["ps_availqty"][i]
+            agg[ps["ps_partkey"][i]] += v
+            total += v
+    thresh = total * 0.005
+    expected = sorted(
+        ((k, round(v, 4)) for k, v in agg.items() if v > thresh),
+        key=lambda t: (-t[1], t[0]),
+    )
+    got = [(a, round(b, 4)) for a, b in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[0] == e[0]
+        assert math.isclose(g[1], e[1], abs_tol=0.02)
+
+
+def test_q12(sess):
+    """Q12: CASE-sum by ship mode over an IN list."""
+    r = sess.must_query(
+        "select l_shipmode, "
+        "sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH' "
+        "then 1 else 0 end) as high_line_count, "
+        "sum(case when o_orderpriority <> '1-URGENT' and o_orderpriority <> '2-HIGH' "
+        "then 1 else 0 end) as low_line_count "
+        "from orders, lineitem where o_orderkey = l_orderkey "
+        "and l_shipmode in ('MAIL', 'SHIP') "
+        "and l_commitdate < l_receiptdate and l_shipdate < l_commitdate "
+        "and l_receiptdate >= date '1994-01-01' and l_receiptdate < date '1995-01-01' "
+        "group by l_shipmode order by l_shipmode"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    orders, no = decode_table(sess, "orders")
+    o_pri = {orders["o_orderkey"][i]: orders["o_orderpriority"][i] for i in range(no)}
+    d0, d1 = days("1994-01-01"), days("1995-01-01")
+    hi = defaultdict(int)
+    lo = defaultdict(int)
+    for i in range(nl):
+        if li["l_shipmode"][i] not in ("MAIL", "SHIP"):
+            continue
+        if not (li["l_commitdate"][i] < li["l_receiptdate"][i]):
+            continue
+        if not (li["l_shipdate"][i] < li["l_commitdate"][i]):
+            continue
+        if not (d0 <= li["l_receiptdate"][i] < d1):
+            continue
+        pri = o_pri.get(li["l_orderkey"][i])
+        if pri is None:
+            continue
+        if pri in ("1-URGENT", "2-HIGH"):
+            hi[li["l_shipmode"][i]] += 1
+        else:
+            lo[li["l_shipmode"][i]] += 1
+        hi.setdefault(li["l_shipmode"][i], 0)
+        lo.setdefault(li["l_shipmode"][i], 0)
+    expected = sorted((m, hi[m], lo[m]) for m in set(hi) | set(lo))
+    assert [(a, b, c) for a, b, c in r.rows] == expected
+
+
+def test_q13(sess):
+    """Q13: LEFT OUTER JOIN with a NOT LIKE filter on the inner side,
+    then a second aggregation over the per-customer counts."""
+    r = sess.must_query(
+        "select c_count, count(*) as custdist from "
+        "(select c_custkey, count(o_orderkey) as c_count "
+        "from customer left outer join orders on "
+        "c_custkey = o_custkey and o_comment not like '%special%requests%' "
+        "group by c_custkey) as c_orders "
+        "group by c_count order by custdist desc, c_count desc"
+    )
+    orders, no = decode_table(sess, "orders")
+    cust, nc = decode_table(sess, "customer")
+    import re
+
+    pat = re.compile(r"special.*requests")
+    cnt = {cust["c_custkey"][i]: 0 for i in range(nc)}
+    for i in range(no):
+        if pat.search(orders["o_comment"][i]):
+            continue
+        ck = orders["o_custkey"][i]
+        if ck in cnt:
+            cnt[ck] += 1
+    dist = defaultdict(int)
+    for v in cnt.values():
+        dist[v] += 1
+    expected = sorted(((c, d) for c, d in dist.items()), key=lambda t: (-t[1], -t[0]))
+    assert [(a, b) for a, b in r.rows] == expected
+
+
+def test_q14(sess):
+    """Q14: promo revenue ratio (CASE + LIKE prefix inside SUM)."""
+    r = sess.must_query(
+        "select 100.00 * sum(case when p_type like 'PROMO%' "
+        "then l_extendedprice * (1 - l_discount) else 0 end) "
+        "/ sum(l_extendedprice * (1 - l_discount)) as promo_revenue "
+        "from lineitem, part where l_partkey = p_partkey "
+        "and l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    part, np_ = decode_table(sess, "part")
+    p_type = {part["p_partkey"][i]: part["p_type"][i] for i in range(np_)}
+    d0, d1 = days("1995-09-01"), days("1995-10-01")
+    num = den = 0.0
+    for i in range(nl):
+        if not (d0 <= li["l_shipdate"][i] < d1):
+            continue
+        t = p_type.get(li["l_partkey"][i])
+        if t is None:
+            continue
+        v = li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+        den += v
+        if t.startswith("PROMO"):
+            num += v
+    expected = 100.0 * num / den
+    assert math.isclose(r.rows[0][0], expected, rel_tol=1e-9)
+
+
+def test_q15(sess):
+    """Q15: CTE view + equality with a scalar MAX over the view."""
+    r = sess.must_query(
+        "with revenue as (select l_suppkey as supplier_no, "
+        "sum(l_extendedprice * (1 - l_discount)) as total_revenue "
+        "from lineitem where l_shipdate >= date '1996-01-01' "
+        "and l_shipdate < date '1996-04-01' group by l_suppkey) "
+        "select s_suppkey, s_name, total_revenue "
+        "from supplier, revenue where s_suppkey = supplier_no "
+        "and total_revenue = (select max(total_revenue) from revenue) "
+        "order by s_suppkey"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    supp, ns = decode_table(sess, "supplier")
+    d0, d1 = days("1996-01-01"), days("1996-04-01")
+    rev = defaultdict(float)
+    for i in range(nl):
+        if d0 <= li["l_shipdate"][i] < d1:
+            rev[li["l_suppkey"][i]] += li["l_extendedprice"][i] * (
+                1 - li["l_discount"][i]
+            )
+    mx = max(rev.values())
+    s_name = {supp["s_suppkey"][i]: supp["s_name"][i] for i in range(ns)}
+    expected = sorted(
+        (sk, s_name[sk], round(v, 4))
+        for sk, v in rev.items()
+        if math.isclose(v, mx, rel_tol=0, abs_tol=1e-9) and sk in s_name
+    )
+    got = [(a, b, round(c, 4)) for a, b, c in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[:2] == e[:2]
+        assert math.isclose(g[2], e[2], abs_tol=0.02)
+
+
+def test_q16(sess):
+    """Q16: COUNT(DISTINCT), NOT LIKE, and NOT IN subquery."""
+    r = sess.must_query(
+        "select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt "
+        "from partsupp, part where p_partkey = ps_partkey "
+        "and p_brand <> 'Brand#45' and p_type not like 'MEDIUM POLISHED%' "
+        "and p_size in (49, 14, 23, 45, 19, 3, 36, 9) "
+        "and ps_suppkey not in (select s_suppkey from supplier where "
+        "s_comment like '%Customer%Complaints%') "
+        "group by p_brand, p_type, p_size "
+        "order by supplier_cnt desc, p_brand, p_type, p_size"
+    )
+    ps, nps = decode_table(sess, "partsupp")
+    part, np_ = decode_table(sess, "part")
+    supp, ns = decode_table(sess, "supplier")
+    import re
+
+    pat = re.compile(r"Customer.*Complaints")
+    bad_supp = {
+        supp["s_suppkey"][i] for i in range(ns) if pat.search(supp["s_comment"][i])
+    }
+    sizes = {49, 14, 23, 45, 19, 3, 36, 9}
+    p_info = {}
+    for i in range(np_):
+        if (
+            part["p_brand"][i] != "Brand#45"
+            and not part["p_type"][i].startswith("MEDIUM POLISHED")
+            and part["p_size"][i] in sizes
+        ):
+            p_info[part["p_partkey"][i]] = (
+                part["p_brand"][i],
+                part["p_type"][i],
+                part["p_size"][i],
+            )
+    groups = defaultdict(set)
+    for i in range(nps):
+        pk = ps["ps_partkey"][i]
+        sk = ps["ps_suppkey"][i]
+        if pk in p_info and sk not in bad_supp:
+            groups[p_info[pk]].add(sk)
+    expected = sorted(
+        ((k[0], k[1], k[2], len(v)) for k, v in groups.items()),
+        key=lambda t: (-t[3], t[0], t[1], t[2]),
+    )
+    assert [(a, b, c, d) for a, b, c, d in r.rows] == expected
+
+
+def test_q19(sess):
+    """Q19: disjunction of three conjunctive predicate groups."""
+    r = sess.must_query(
+        "select sum(l_extendedprice * (1 - l_discount)) as revenue "
+        "from lineitem, part where "
+        "(p_partkey = l_partkey and p_brand = 'Brand#12' "
+        "and p_container in ('SM CASE', 'SM BOX', 'SM PACK') "
+        "and l_quantity >= 1 and l_quantity <= 11 "
+        "and p_size between 1 and 5 "
+        "and l_shipmode in ('AIR', 'REG AIR') "
+        "and l_shipinstruct = 'DELIVER IN PERSON') "
+        "or (p_partkey = l_partkey and p_brand = 'Brand#23' "
+        "and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK') "
+        "and l_quantity >= 10 and l_quantity <= 20 "
+        "and p_size between 1 and 10 "
+        "and l_shipmode in ('AIR', 'REG AIR') "
+        "and l_shipinstruct = 'DELIVER IN PERSON') "
+        "or (p_partkey = l_partkey and p_brand = 'Brand#34' "
+        "and p_container in ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG') "
+        "and l_quantity >= 20 and l_quantity <= 30 "
+        "and p_size between 1 and 15 "
+        "and l_shipmode in ('AIR', 'REG AIR') "
+        "and l_shipinstruct = 'DELIVER IN PERSON')"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    part, np_ = decode_table(sess, "part")
+    p_info = {
+        part["p_partkey"][i]: (
+            part["p_brand"][i],
+            part["p_container"][i],
+            part["p_size"][i],
+        )
+        for i in range(np_)
+    }
+    total = 0.0
+    hit = 0
+    for i in range(nl):
+        pi = p_info.get(li["l_partkey"][i])
+        if pi is None:
+            continue
+        brand, cont, size = pi
+        q = li["l_quantity"][i]
+        if li["l_shipmode"][i] not in ("AIR", "REG AIR"):
+            continue
+        if li["l_shipinstruct"][i] != "DELIVER IN PERSON":
+            continue
+        ok = (
+            (brand == "Brand#12" and cont in ("SM CASE", "SM BOX", "SM PACK")
+             and 1 <= q <= 11 and 1 <= size <= 5)
+            or (brand == "Brand#23" and cont in ("MED BAG", "MED BOX", "MED PKG", "MED PACK")
+                and 10 <= q <= 20 and 1 <= size <= 10)
+            or (brand == "Brand#34" and cont in ("LG CASE", "LG BOX", "LG PACK", "LG PKG")
+                and 20 <= q <= 30 and 1 <= size <= 15)
+        )
+        if ok:
+            total += li["l_extendedprice"][i] * (1 - li["l_discount"][i])
+            hit += 1
+    got = r.rows[0][0]
+    if hit == 0:
+        assert got is None or got == 0
+    else:
+        assert math.isclose(got, total, rel_tol=1e-9)
+
+
+def test_q20(sess):
+    """Q20: nested IN subqueries with a correlated scalar (0.5 * SUM)."""
+    r = sess.must_query(
+        "select s_name, s_address from supplier, nation "
+        "where s_suppkey in (select ps_suppkey from partsupp where "
+        "ps_partkey in (select p_partkey from part where p_name like 'forest%') "
+        "and ps_availqty > (select 0.5 * sum(l_quantity) from lineitem "
+        "where l_partkey = ps_partkey and l_suppkey = ps_suppkey "
+        "and l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01')) "
+        "and s_nationkey = n_nationkey and n_name = 'CANADA' "
+        "order by s_name"
+    )
+    li, nl = decode_table(sess, "lineitem")
+    supp, ns = decode_table(sess, "supplier")
+    nat, nn = decode_table(sess, "nation")
+    part, np_ = decode_table(sess, "part")
+    ps, nps = decode_table(sess, "partsupp")
+    forest = {part["p_partkey"][i] for i in range(np_) if part["p_name"][i].startswith("forest")}
+    d0, d1 = days("1994-01-01"), days("1995-01-01")
+    shipped = defaultdict(float)
+    for i in range(nl):
+        if d0 <= li["l_shipdate"][i] < d1:
+            shipped[(li["l_partkey"][i], li["l_suppkey"][i])] += li["l_quantity"][i]
+    good_supp = set()
+    for i in range(nps):
+        pk, sk = ps["ps_partkey"][i], ps["ps_suppkey"][i]
+        if pk not in forest:
+            continue
+        key = (pk, sk)
+        half = 0.5 * shipped[key] if key in shipped else None
+        # NULL comparison semantics: no lineitem rows -> SUM is NULL ->
+        # ps_availqty > NULL is unknown -> row filtered out
+        if half is not None and ps["ps_availqty"][i] > half:
+            good_supp.add(sk)
+    canada = {nat["n_nationkey"][i] for i in range(nn) if nat["n_name"][i] == "CANADA"}
+    expected = sorted(
+        (supp["s_name"][i], supp["s_address"][i])
+        for i in range(ns)
+        if supp["s_suppkey"][i] in good_supp and supp["s_nationkey"][i] in canada
+    )
+    assert [(a, b) for a, b in r.rows] == expected
+
+
+def test_q22(sess):
+    """Q22: SUBSTRING country codes, uncorrelated AVG subquery, NOT EXISTS."""
+    r = sess.must_query(
+        "select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal "
+        "from (select substring(c_phone, 1, 2) as cntrycode, c_acctbal "
+        "from customer where substring(c_phone, 1, 2) in "
+        "('13', '31', '23', '29', '30', '18', '17') "
+        "and c_acctbal > (select avg(c_acctbal) from customer "
+        "where c_acctbal > 0.00 and substring(c_phone, 1, 2) in "
+        "('13', '31', '23', '29', '30', '18', '17')) "
+        "and not exists (select * from orders where o_custkey = c_custkey)"
+        ") as custsale group by cntrycode order by cntrycode"
+    )
+    orders, no = decode_table(sess, "orders")
+    cust, nc = decode_table(sess, "customer")
+    codes = {"13", "31", "23", "29", "30", "18", "17"}
+    in_code = [cust["c_phone"][i][:2] in codes for i in range(nc)]
+    pos = [
+        cust["c_acctbal"][i]
+        for i in range(nc)
+        if in_code[i] and cust["c_acctbal"][i] > 0
+    ]
+    avg_bal = sum(pos) / len(pos)
+    has_orders = {orders["o_custkey"][i] for i in range(no)}
+    cnt = defaultdict(int)
+    tot = defaultdict(float)
+    for i in range(nc):
+        if not in_code[i] or cust["c_acctbal"][i] <= avg_bal:
+            continue
+        if cust["c_custkey"][i] in has_orders:
+            continue
+        cc = cust["c_phone"][i][:2]
+        cnt[cc] += 1
+        tot[cc] += cust["c_acctbal"][i]
+    expected = sorted((cc, cnt[cc], round(tot[cc], 2)) for cc in cnt)
+    got = [(a, b, round(c, 2)) for a, b, c in r.rows]
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        assert g[:2] == e[:2]
+        assert math.isclose(g[2], e[2], abs_tol=0.02)

@@ -31,6 +31,41 @@ _NATIONS = [
     ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
 ]
 _REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_SHIPINSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+_P_COLORS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cream", "cyan", "dark",
+    "forest", "frosted", "green", "grey", "honeydew", "hot", "indian", "ivory",
+    "khaki", "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+]
+_P_TYPE1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+_P_TYPE2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+_P_TYPE3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+_P_TYPES = [f"{a} {b} {c}" for a in _P_TYPE1 for b in _P_TYPE2 for c in _P_TYPE3]
+# Comment universes: small fixed vocabularies so dictionaries stay compact;
+# a handful of entries match the LIKE patterns the queries probe for
+# (Q13 '%special%requests%', Q16 '%Customer%Complaints%').
+_O_COMMENTS = [
+    "carefully ironic deposits wake furiously",
+    "quickly bold accounts nag blithely",
+    "special packages among the requests detect slyly",
+    "express special pending requests are final deposits",
+    "silent foxes boost across the ironic accounts",
+    "pending theodolites haggle quickly",
+    "special deposits cajole; even requests sleep",
+    "regular ideas use slyly after the furious dependencies",
+    "ironic pinto beans integrate carefully",
+    "asymptotes above the slow requests sleep finally",
+]
+_S_COMMENTS = [
+    "blithely regular packages nag slyly",
+    "Customer accounts sleep; Complaints about furious deposits",
+    "carefully even asymptotes are about the requests",
+    "Customer deposits wake Complaints among ironic foxes",
+    "quickly final theodolites detect against the ideas",
+    "furiously pending accounts use among the excuses",
+]
 
 _D_LO = int(date_to_days("1992-01-01"))
 _D_HI = int(date_to_days("1998-08-02"))
@@ -55,15 +90,31 @@ def _dec(value_cents: np.ndarray, scale=2) -> HostColumn:
     return HostColumn(DECIMAL(scale), value_cents.astype(np.int64), np.ones(len(value_cents), bool))
 
 
+def _unique_str_col(strings) -> HostColumn:
+    """STRING column from per-row strings (dictionary = sorted uniques)."""
+    arr = np.array(strings, dtype=object)
+    uni, codes = np.unique(arr, return_inverse=True)
+    return HostColumn(STRING, codes.astype(np.int32), np.ones(len(arr), bool), uni)
+
+
+def _supp_for_part(partkey: np.ndarray, j: np.ndarray, n_supps: int) -> np.ndarray:
+    """The TPC-H partsupp relationship: part pk is supplied by exactly the
+    4 suppliers at offsets j=0..3 of this formula, so lineitem's
+    (l_partkey, l_suppkey) pairs always hit partsupp."""
+    return (partkey + j * (n_supps // 4 + 1)) % n_supps + 1
+
+
 def gen_lineitem(sf: float, rng: np.random.Generator, n_orders: int) -> HostBlock:
     n = int(6_000_000 * sf)
     orderkey = rng.integers(1, n_orders + 1, n).astype(np.int64)
     n_parts = max(int(200_000 * sf), 1000)
     n_supps = max(int(10_000 * sf), 100)
+    partkey = rng.integers(1, n_parts + 1, n).astype(np.int64)
+    suppkey = _supp_for_part(partkey, rng.integers(0, 4, n), n_supps)
     cols = {
         "l_orderkey": _num(orderkey, INT64),
-        "l_partkey": _num(rng.integers(1, n_parts + 1, n).astype(np.int64), INT64),
-        "l_suppkey": _num(rng.integers(1, n_supps + 1, n).astype(np.int64), INT64),
+        "l_partkey": _num(partkey, INT64),
+        "l_suppkey": _num(suppkey.astype(np.int64), INT64),
         "l_linenumber": _num(rng.integers(1, 8, n).astype(np.int64), INT64),
         "l_quantity": _dec(rng.integers(1, 51, n) * 100),
         "l_extendedprice": _dec(rng.integers(90_000, 10_500_000, n)),
@@ -75,6 +126,7 @@ def gen_lineitem(sf: float, rng: np.random.Generator, n_orders: int) -> HostBloc
         "l_commitdate": _num(rng.integers(_D_LO, _D_HI, n).astype(np.int32), DATE),
         "l_receiptdate": _num(rng.integers(_D_LO, _D_HI, n).astype(np.int32), DATE),
         "l_shipmode": _dict_col(rng.integers(0, len(_SHIPMODES), n), _SHIPMODES),
+        "l_shipinstruct": _dict_col(rng.integers(0, len(_SHIPINSTRUCT), n), _SHIPINSTRUCT),
     }
     return HostBlock.from_columns(cols)
 
@@ -90,27 +142,50 @@ def gen_orders(sf: float, rng: np.random.Generator) -> HostBlock:
         "o_orderdate": _num(rng.integers(_D_LO, _D_HI - 151, n).astype(np.int32), DATE),
         "o_orderpriority": _dict_col(rng.integers(0, len(_PRIORITIES), n), _PRIORITIES),
         "o_shippriority": _num(np.zeros(n, dtype=np.int64), INT64),
+        "o_comment": _dict_col(rng.integers(0, len(_O_COMMENTS), n), _O_COMMENTS),
     }
     return HostBlock.from_columns(cols)
 
 
 def gen_customer(sf: float, rng: np.random.Generator) -> HostBlock:
     n = max(int(150_000 * sf), 100)
+    nationkey = rng.integers(0, 25, n).astype(np.int64)
+    # phone country code = nationkey + 10 (TPC-H spec clause 4.2.2.9)
+    p1 = rng.integers(100, 1000, n)
+    p2 = rng.integers(100, 1000, n)
+    p3 = rng.integers(1000, 10000, n)
+    phones = [
+        f"{nationkey[i] + 10}-{p1[i]}-{p2[i]}-{p3[i]}" for i in range(n)
+    ]
     cols = {
         "c_custkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
-        "c_nationkey": _num(rng.integers(0, 25, n).astype(np.int64), INT64),
+        "c_name": _unique_str_col([f"Customer#{i:09d}" for i in range(1, n + 1)]),
+        "c_address": _unique_str_col([f"Addr {i:07d}" for i in range(1, n + 1)]),
+        "c_nationkey": _num(nationkey, INT64),
+        "c_phone": _unique_str_col(phones),
         "c_mktsegment": _dict_col(rng.integers(0, len(_SEGMENTS), n), _SEGMENTS),
         "c_acctbal": _dec(rng.integers(-99_999, 1_000_000, n)),
+        "c_comment": _dict_col(rng.integers(0, len(_O_COMMENTS), n), _O_COMMENTS),
     }
     return HostBlock.from_columns(cols)
 
 
 def gen_supplier(sf: float, rng: np.random.Generator) -> HostBlock:
     n = max(int(10_000 * sf), 100)
+    nationkey = rng.integers(0, 25, n).astype(np.int64)
+    p1 = rng.integers(100, 1000, n)
+    p2 = rng.integers(100, 1000, n)
+    p3 = rng.integers(1000, 10000, n)
     cols = {
         "s_suppkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
-        "s_nationkey": _num(rng.integers(0, 25, n).astype(np.int64), INT64),
+        "s_name": _unique_str_col([f"Supplier#{i:09d}" for i in range(1, n + 1)]),
+        "s_address": _unique_str_col([f"SAddr {i:07d}" for i in range(1, n + 1)]),
+        "s_nationkey": _num(nationkey, INT64),
+        "s_phone": _unique_str_col(
+            [f"{nationkey[i] + 10}-{p1[i]}-{p2[i]}-{p3[i]}" for i in range(n)]
+        ),
         "s_acctbal": _dec(rng.integers(-99_999, 1_000_000, n)),
+        "s_comment": _dict_col(rng.integers(0, len(_S_COMMENTS), n), _S_COMMENTS),
     }
     return HostBlock.from_columns(cols)
 
@@ -136,12 +211,35 @@ def gen_part(sf: float, rng: np.random.Generator) -> HostBlock:
     n = max(int(200_000 * sf), 1000)
     brands = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
     containers = ["SM CASE", "SM BOX", "SM PACK", "LG CASE", "LG BOX", "MED BAG", "JUMBO PKG"]
+    c1 = rng.integers(0, len(_P_COLORS), n)
+    c2 = rng.integers(0, len(_P_COLORS), n)
+    names = [f"{_P_COLORS[c1[i]]} {_P_COLORS[c2[i]]}" for i in range(n)]
     cols = {
         "p_partkey": _num(np.arange(1, n + 1, dtype=np.int64), INT64),
+        "p_name": _unique_str_col(names),
+        "p_mfgr": _dict_col(rng.integers(0, 5, n), [f"Manufacturer#{i}" for i in range(1, 6)]),
         "p_brand": _dict_col(rng.integers(0, len(brands), n), brands),
+        "p_type": _dict_col(rng.integers(0, len(_P_TYPES), n), _P_TYPES),
         "p_size": _num(rng.integers(1, 51, n).astype(np.int64), INT64),
         "p_container": _dict_col(rng.integers(0, len(containers), n), containers),
         "p_retailprice": _dec(rng.integers(90_000, 200_000, n)),
+    }
+    return HostBlock.from_columns(cols)
+
+
+def gen_partsupp(sf: float, rng: np.random.Generator) -> HostBlock:
+    n_parts = max(int(200_000 * sf), 1000)
+    n_supps = max(int(10_000 * sf), 100)
+    pk = np.repeat(np.arange(1, n_parts + 1, dtype=np.int64), 4)
+    j = np.tile(np.arange(4, dtype=np.int64), n_parts)
+    sk = _supp_for_part(pk, j, n_supps)
+    n = len(pk)
+    cols = {
+        "ps_partkey": _num(pk, INT64),
+        "ps_suppkey": _num(sk.astype(np.int64), INT64),
+        "ps_availqty": _num(rng.integers(1, 10_000, n).astype(np.int64), INT64),
+        "ps_supplycost": _dec(rng.integers(100, 100_100, n)),
+        "ps_comment": _dict_col(rng.integers(0, len(_O_COMMENTS), n), _O_COMMENTS),
     }
     return HostBlock.from_columns(cols)
 
@@ -172,6 +270,7 @@ def load_tpch(
         "nation": gen_nation,
         "region": gen_region,
         "part": lambda: gen_part(sf, rng),
+        "partsupp": lambda: gen_partsupp(sf, rng),
     }
     for name, gen in gens.items():
         if tables is not None and name not in tables:

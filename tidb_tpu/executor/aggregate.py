@@ -62,6 +62,13 @@ class AggDesc:
     # 10**arg_scale to return true values (SUM keeps the scaled int,
     # typed DECIMAL(scale) by the planner).
     arg_scale: int = 0
+    # wide accumulation for overflow-prone decimal sums (scale >= 4
+    # products): the scaled-i64 argument is split into 30-bit lo and
+    # high limbs, each summed exactly in int64 (safe to 2^31 rows of
+    # 2^47-scale values), then recombined in float64 — no silent int64
+    # wraparound at TPC-H SF100 scale. Reference: MyDecimal's 30-digit
+    # fixed-point accumulators (pkg/types/mydecimal.go:236).
+    wide: bool = False
 
 
 def _next_pow2(n: int) -> int:
@@ -276,6 +283,10 @@ def group_aggregate(
     scatter-free packed fast path when all keys qualify and the widths
     sum to <= 62 bits.
     """
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("executor/aggregate")
     cap = batch.capacity
     key_names = list(key_names or [f"k{i}" for i in range(len(key_fns))])
 
@@ -417,7 +428,17 @@ def _run_aggs(batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=
             s = red("sum", ones, valid, jnp.int64(0))
             out_cols[a.out_name] = DevCol(s, group_valid)
         elif a.func in ("sum", "avg"):
-            s = red("sum", data, valid, jnp.zeros((), data.dtype))
+            if a.wide and not jnp.issubdtype(data.dtype, jnp.floating):
+                d64 = data.astype(jnp.int64)
+                lo = d64 & jnp.int64((1 << 30) - 1)
+                hi = d64 >> 30  # arithmetic shift: hi*2^30 + lo == d64
+                s_lo = red("sum", lo, valid, jnp.int64(0))
+                s_hi = red("sum", hi, valid, jnp.int64(0))
+                s = s_hi.astype(jnp.float64) * float(1 << 30) + s_lo.astype(
+                    jnp.float64
+                )
+            else:
+                s = red("sum", data, valid, jnp.zeros((), data.dtype))
             cnt = red("sum", ones, valid, jnp.int64(0))
             # SUM over an all-NULL / empty group is NULL (MySQL)
             v = (cnt > 0) & group_valid
@@ -426,6 +447,9 @@ def _run_aggs(batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=
             else:
                 denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
                 if a.arg_scale:
+                    # DECIMAL data is in scaled units whether the device
+                    # dtype is int64 or (wide-sum) float64 — always
+                    # descale by 10^scale
                     denom = denom * (10**a.arg_scale)
                 out_cols[a.out_name] = DevCol(s.astype(jnp.float64) / denom, v)
         elif a.func in ("min", "max"):

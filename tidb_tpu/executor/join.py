@@ -59,6 +59,10 @@ def equi_join(
     (and the true surviving row count); out_capacity is ignored.
     For left joins, unmatched probe rows emit once with NULL build columns.
     """
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("executor/join")
     bkey, bvalid = _keys_of(build, build_key)
     pkey, pvalid = _keys_of(probe, probe_key)
     bcap = build.capacity

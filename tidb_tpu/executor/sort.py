@@ -56,6 +56,10 @@ def sort_permutation(batch: Batch, key_fns, descs) -> jax.Array:
 
 def order_by(batch: Batch, key_fns, descs) -> Batch:
     """Fully sort the batch (valid rows first, in key order)."""
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("executor/sort")
     perm = sort_permutation(batch, key_fns, descs)
     cols = {n: DevCol(c.data[perm], c.valid[perm]) for n, c in batch.cols.items()}
     return Batch(cols, batch.row_valid[perm])

@@ -35,6 +35,14 @@ class WindowDesc:
     # True when the OVER clause has ORDER BY: aggregate becomes a running
     # (rows unbounded-preceding..current) computation, else whole-partition.
     running: bool = False
+    # explicit ROWS frame (lo, hi) row offsets relative to the current
+    # row, None = unbounded side; overrides `running` when present.
+    # Computed as differences of global prefix sums clamped to the
+    # partition bounds — one cumsum serves every row's window
+    # (reference: per-frame re-aggregation in pkg/executor/window.go
+    # slidingWindowAggFunc; prefix-sum differencing is the O(1)-per-row
+    # TPU form).
+    frame: Optional[tuple] = None
 
 
 def _seg_gather(values, seg, first_idx):
@@ -154,7 +162,31 @@ def _compute(d: WindowDesc, batch, perm, srow_valid, seg, first_idx, peer_change
             if d.func == "count"
             else jnp.where(valid, data, zero)
         )
-        if d.running:
+        if d.frame is not None:
+            lo, hi = d.frame
+            idx32 = jnp.arange(cap, dtype=jnp.int32)
+            start = first_idx[seg]
+            last_idx = (
+                jnp.zeros(cap + 1, dtype=jnp.int32)
+                .at[seg]
+                .max(idx32, mode="drop")
+            )
+            end = last_idx[seg]
+            loi = start if lo is None else jnp.maximum(idx32 + lo, start)
+            hii = end if hi is None else jnp.minimum(idx32 + hi, end)
+            empty = hii < loi
+            c = jnp.cumsum(contrib)
+            cnt_c = jnp.cumsum(valid.astype(jnp.int64))
+
+            def rng(pref, a, b):
+                left = jnp.where(
+                    a > 0, pref[jnp.clip(a - 1, 0, cap - 1)], 0
+                )
+                return pref[jnp.clip(b, 0, cap - 1)] - left
+
+            run = jnp.where(empty, 0, rng(c, loi, hii))
+            cnt = jnp.where(empty, 0, rng(cnt_c, loi, hii))
+        elif d.running:
             c = jnp.cumsum(contrib)
             run = c - jnp.where(first_idx[seg] > 0, c[jnp.clip(first_idx[seg] - 1, 0, cap - 1)], 0)
             cnt_c = jnp.cumsum(valid.astype(jnp.int64))

@@ -188,6 +188,8 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         return FLOAT64
     if op == "abs":
         return ts[0]
+    if op == "add_months":
+        return DATE
     if op in {"greatest", "least"}:
         t = ts[0]
         for u in ts[1:]:

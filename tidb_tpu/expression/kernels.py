@@ -441,6 +441,8 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         "year", "month", "day", "dayofweek", "weekday", "dayofyear", "quarter",
     ):
         return _compile_extract(e, dicts)
+    if op == "add_months":
+        return _compile_add_months(e, dicts)
     if op == "datediff":
         fa, fb = (_compile(a, dicts) for a in e.args)
 
@@ -1075,6 +1077,60 @@ def _compile_extremum(e: Func, dicts: DictContext) -> _CompiledExpr:
         return DevCol(out, valid)
 
     return _ext
+
+
+def _civil_from_days(days):
+    """days-since-epoch -> (y, m, d), branchless civil calendar (same
+    algorithm as _compile_extract; Howard Hinnant's public-domain
+    civil_from_days)."""
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    """(y, m, d) -> days-since-epoch (inverse of _civil_from_days)."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _compile_add_months(e: Func, dicts: DictContext) -> _CompiledExpr:
+    """MySQL-exact month arithmetic on device: shift by N months, clamp
+    day-of-month to the target month length (reference:
+    pkg/types/time.go AddDate semantics; no 30-day approximation)."""
+    col, nexpr = e.args
+    f = _compile(col, dicts)
+    fn = _compile(nexpr, dicts)
+    _MLEN = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+
+    def _am(b):
+        c = f(b)
+        n = fn(b)
+        days = c.data.astype(jnp.int64)
+        y, m, d = _civil_from_days(days)
+        total = y * 12 + (m - 1) + n.data.astype(jnp.int64)
+        y2 = total // 12
+        m2 = total % 12 + 1
+        leap = (y2 % 4 == 0) & ((y2 % 100 != 0) | (y2 % 400 == 0))
+        mlen = _MLEN[m2 - 1] + jnp.where((m2 == 2) & leap, 1, 0)
+        d2 = jnp.minimum(d, mlen)
+        out = _days_from_civil(y2, m2, d2)
+        return DevCol(out.astype(c.data.dtype), c.valid & n.valid)
+
+    return _am
 
 
 def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:

@@ -65,6 +65,10 @@ def hash_repartition(
     Returns (new local batch, global count of dropped rows) — nonzero
     drop means retry with a larger bucket_capacity.
     """
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("exchange/repartition")
     n, B = n_devices, bucket_capacity
     cap = batch.capacity
     k = key_fn(batch)
@@ -104,6 +108,10 @@ def hash_repartition(
 def broadcast_gather(batch: Batch, axis: str = "d") -> Batch:
     """Broadcast exchange: every device receives all rows (for small
     build sides of joins — the reference's Broadcast ExchangeType)."""
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("exchange/gather")
 
     def gather(arr: jax.Array) -> jax.Array:
         g = jax.lax.all_gather(arr, axis)  # [n, cap]

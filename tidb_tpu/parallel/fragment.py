@@ -47,7 +47,7 @@ def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[s
             final.append(("sum", a.out_name, [pname], 0))
         elif a.func == "sum":
             pname = f"_p{i}"
-            partial.append(AggDesc("sum", a.arg, pname))
+            partial.append(AggDesc("sum", a.arg, pname, wide=a.wide))
             final.append(("sum", a.out_name, [pname], 0))
         elif a.func in ("min", "max"):
             pname = f"_p{i}"
@@ -55,7 +55,7 @@ def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[s
             final.append((a.func, a.out_name, [pname], 0))
         elif a.func == "avg":
             sname, cname = f"_ps{i}", f"_pc{i}"
-            partial.append(AggDesc("sum", a.arg, sname))
+            partial.append(AggDesc("sum", a.arg, sname, wide=a.wide))
             partial.append(AggDesc("count", a.arg, cname))
             final.append(("avg2", a.out_name, [sname, cname], a.arg_scale))
         else:

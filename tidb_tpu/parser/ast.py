@@ -62,6 +62,10 @@ class WindowCall:
     partition_by: List[object]
     order_by: List["OrderItem"]
     offset: int = 1  # lag/lead distance
+    # ROWS frame as (lo, hi) row offsets relative to the current row;
+    # None = unbounded in that direction; whole field None = no frame
+    # clause (default framing semantics)
+    frame: Optional[Tuple[Optional[int], Optional[int]]] = None
 
 
 @dataclasses.dataclass
@@ -143,6 +147,7 @@ class Union:
 class With:
     ctes: List[Tuple[str, object]]  # (name, Select|Union)
     body: object  # Select | Union
+    recursive: bool = False
 
 
 @dataclasses.dataclass

@@ -64,6 +64,7 @@ KEYWORDS = {
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
     "over", "partition", "with", "recursive", "local",
+    "unbounded", "preceding", "following", "current", "row",
 }
 
 _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
@@ -164,9 +165,17 @@ class Parser:
         if not self.accept_op(op):
             raise ParseError(f"expected {op!r}, got {self.cur.text!r} at {self.cur.pos}")
 
+    # soft keywords: reserved only where their grammar needs them, usable
+    # as identifiers elsewhere (MySQL keeps these non-reserved; globally
+    # reserving them would break tables with e.g. a `current` column)
+    _SOFT_KW = (
+        "date", "key", "tables", "databases", "count", "sum", "avg", "min",
+        "max", "unbounded", "preceding", "following", "current", "row",
+    )
+
     def expect_ident(self) -> str:
         t = self.cur
-        if t.kind == "id" or (t.kind == "kw" and t.text in ("date", "key", "tables", "databases", "count", "sum", "avg", "min", "max")):
+        if t.kind == "id" or (t.kind == "kw" and t.text in self._SOFT_KW):
             self.advance()
             return t.text
         raise ParseError(f"expected identifier, got {t.text!r} at {t.pos}")
@@ -351,8 +360,7 @@ class Parser:
 
     def parse_with(self):
         self.expect_kw("with")
-        if self.accept_kw("recursive"):
-            raise ParseError("recursive CTEs not yet supported")
+        recursive = bool(self.accept_kw("recursive"))
         ctes = []
         while True:
             name = self.expect_ident()
@@ -368,16 +376,22 @@ class Parser:
             self.expect_op("(")
             q = self.parse_select_or_union()
             self.expect_op(")")
-            if cols is not None and isinstance(q, ast.Select):
-                items = q.items
-                if len(cols) != len(items):
+            if cols is not None:
+                target = q.selects[0] if isinstance(q, ast.Union) else q
+                if not isinstance(target, ast.Select):
+                    raise ParseError("CTE column list needs a SELECT body")
+                if len(cols) != len(target.items):
                     raise ParseError("CTE column list arity mismatch")
-                q = dataclasses_replace_items(q, cols)
+                renamed = dataclasses_replace_items(target, cols)
+                if isinstance(q, ast.Union):
+                    q = dataclasses_replace(q, selects=[renamed] + q.selects[1:])
+                else:
+                    q = renamed
             ctes.append((name.lower(), q))
             if not self.accept_op(","):
                 break
         body = self.parse_select_or_union()
-        return ast.With(ctes, body)
+        return ast.With(ctes, body, recursive=recursive)
 
     def parse_select(self) -> ast.Select:
         self.expect_kw("select")
@@ -761,8 +775,57 @@ class Parser:
             order.append(self.parse_order_item())
             while self.accept_op(","):
                 order.append(self.parse_order_item())
+        frame = None
+        if self.accept_kw("rows"):
+            if self.accept_kw("between"):
+                lo = self._parse_frame_bound(is_start=True)
+                self.expect_kw("and")
+                hi = self._parse_frame_bound(is_start=False)
+                # MySQL ER_WINDOW_FRAME_ILLEGAL: start must not be after
+                # end (silently-empty frames would yield wrong results)
+                if lo is not None and hi is not None and lo > hi:
+                    raise ParseError("window frame start cannot follow its end")
+            else:
+                # short form: only UNBOUNDED PRECEDING / n PRECEDING /
+                # CURRENT ROW are legal starts (end is CURRENT ROW)
+                lo = self._parse_frame_bound(is_start=True)
+                if lo is not None and lo > 0:
+                    raise ParseError(
+                        "FOLLOWING frame start requires BETWEEN ... AND ..."
+                    )
+                hi = 0
+            frame = (lo, hi)
         self.expect_op(")")
-        return ast.WindowCall(func, arg, partition, order, offset)
+        return ast.WindowCall(func, arg, partition, order, offset, frame)
+
+    def _parse_frame_bound(self, is_start: bool = True):
+        """ROWS frame bound -> row offset relative to the current row:
+        negative = preceding, positive = following, 0 = current row,
+        None = unbounded (preceding for the start bound, following for
+        the end bound; the illegal crossings are rejected)."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                if not is_start:
+                    raise ParseError("UNBOUNDED PRECEDING is only a frame start")
+                return None
+            if self.accept_kw("following"):
+                if is_start:
+                    raise ParseError("UNBOUNDED FOLLOWING is only a frame end")
+                return None
+            raise ParseError("expected PRECEDING or FOLLOWING")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return 0
+        tok = self.cur
+        if tok.kind != "num":
+            raise ParseError(f"expected frame bound at {tok.pos}")
+        self.advance()
+        n = int(tok.text)
+        if self.accept_kw("preceding"):
+            return -n
+        if self.accept_kw("following"):
+            return n
+        raise ParseError("expected PRECEDING or FOLLOWING")
 
     def parse_case(self):
         self.expect_kw("case")

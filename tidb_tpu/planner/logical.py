@@ -136,16 +136,25 @@ class JoinPlan(LogicalPlan):
     equi_keys: List[Tuple[Expr, Expr]]
     residual: Optional[Expr] = None
     null_aware: bool = False  # NOT IN semantics
+    # cost-based mesh exchange choice ('left'/'right'/None): the named
+    # side is estimated small enough to replicate (broadcast join)
+    # instead of hash-repartitioning both sides. Set from ANALYZE stats
+    # (cardinality.py); part of the plan fingerprint since it changes
+    # the compiled exchange. Reference: broadcast-vs-shuffle MPP join in
+    # pkg/planner/core/exhaust_physical_plans.go.
+    broadcast: Optional[str] = None
 
 
 @dataclasses.dataclass
 class Window(LogicalPlan):
-    """One OVER spec; descs: (out name, func, bound arg, offset, running)."""
+    """One OVER spec; descs: (out name, func, bound arg, offset, running,
+    frame) where frame is a (lo, hi) ROWS offset pair (None = unbounded
+    side) or None for default framing."""
 
     child: LogicalPlan
     partition_exprs: List[Expr]
     order_exprs: List[Tuple[Expr, bool]]
-    descs: List[Tuple[str, str, Optional[Expr], int, bool]]
+    descs: List[Tuple[str, str, Optional[Expr], int, bool, Optional[tuple]]]
 
 
 @dataclasses.dataclass
@@ -238,6 +247,23 @@ class ExprBinder:
         if op in ("date_add", "date_sub"):
             base, iv = e.args
             assert isinstance(iv, ast.Interval)
+            sign = 1 if op == "date_add" else -1
+            months = self._interval_months(iv)
+            if months is not None:
+                # calendar-exact month/year arithmetic (MySQL clamps the
+                # day-of-month; the reference does exact calendar math in
+                # pkg/types/time.go AddDate) — fold on host for constant
+                # dates, device kernel otherwise
+                lowered = self.lower(base)
+                if isinstance(lowered, Literal) and isinstance(lowered.value, int):
+                    return Literal(
+                        type=lowered.type or DATE,
+                        value=_add_months_host(lowered.value, sign * months),
+                    )
+                return Func(
+                    op="add_months",
+                    args=(lowered, Literal(type=INT64, value=sign * months)),
+                )
             days = self._interval_days(iv)
             return Func(
                 op="add" if op == "date_add" else "sub",
@@ -278,6 +304,20 @@ class ExprBinder:
         return Func(op=op, args=args)
 
     @staticmethod
+    def _interval_months(iv: ast.Interval) -> Optional[int]:
+        """Months for month/year units (calendar-exact path); None for
+        day-based units."""
+        v = iv.value
+        if isinstance(v, ast.Const):
+            v = v.value
+        v = int(v)
+        if iv.unit == "month":
+            return v
+        if iv.unit == "year":
+            return v * 12
+        return None
+
+    @staticmethod
     def _interval_days(iv: ast.Interval) -> int:
         v = iv.value
         if isinstance(v, ast.Const):
@@ -285,16 +325,34 @@ class ExprBinder:
         v = int(v)
         if iv.unit == "day":
             return v
-        if iv.unit == "month":
-            return v * 30  # calendar-exact month arithmetic: later round
-        if iv.unit == "year":
-            return v * 365
+        if iv.unit == "week":
+            return v * 7
         raise PlanError(f"unsupported interval unit {iv.unit}")
 
 
 # ---------------------------------------------------------------------------
 # SELECT builder
 # ---------------------------------------------------------------------------
+
+
+def _add_months_host(days: int, months: int) -> int:
+    """MySQL ADDDATE month semantics on a days-since-epoch int: exact
+    calendar shift with day-of-month clamped to the target month's
+    length (1998-03-31 - 1 month = 1998-02-28)."""
+    import datetime
+
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+    total = d.year * 12 + (d.month - 1) + months
+    y, m = divmod(total, 12)
+    m += 1
+    # clamp to month length via day-1-of-next-month minus one day
+    if m == 12:
+        nxt = datetime.date(y + 1, 1, 1)
+    else:
+        nxt = datetime.date(y, m + 1, 1)
+    last = (nxt - datetime.timedelta(days=1)).day
+    nd = datetime.date(y, m, min(d.day, last))
+    return (nd - datetime.date(1970, 1, 1)).days
 
 
 def _conjuncts(e):
@@ -468,9 +526,19 @@ class SelectBuilder:
                 return JoinPlan(schema, "cross", left, right, [], res)
             raise PlanError("non-equi LEFT JOIN not supported")
         res_bound = ExprBinder(schema).bind(_and_all(residual)) if residual else None
-        if kind == "left" and res_bound is not None:
-            raise PlanError("LEFT JOIN with residual ON conditions not yet supported")
-        return JoinPlan(schema, kind, left, right, equi, res_bound)
+        # cost-based broadcast pick (outer joins may only replicate the
+        # build side — the probe side must stay sharded)
+        from tidb_tpu.planner import cardinality as C
+
+        smap = C.StatsMap()
+        smap.cols.update(C.gather_stats(left, self.catalog).cols)
+        smap.cols.update(C.gather_stats(right, self.catalog).cols)
+        el = C.est_rows(left, self.catalog, smap)
+        er = C.est_rows(right, self.catalog, smap)
+        bcast = _broadcast_choice(el, er)
+        if kind != "inner" and bcast == "left":
+            bcast = None
+        return JoinPlan(schema, kind, left, right, equi, res_bound, broadcast=bcast)
 
 
 def _and_all(conj: List):
@@ -488,6 +556,15 @@ def build_query(
         merged = dict(ctes or {})
         for name, q in stmt.ctes:
             merged[name] = q
+        if subquery_value_fn is not None:
+            # Scalar subqueries under this WITH run through the session
+            # executor in a fresh build; inject the CTE scope so they can
+            # reference the views (e.g. TPC-H Q15's max over the CTE).
+            inner_fn = subquery_value_fn
+
+            def subquery_value_fn(q, _ctes=None, _inner=inner_fn, _m=merged):
+                return _inner(q, _ctes if _ctes is not None else _m)
+
         return build_query(stmt.body, catalog, current_db, subquery_value_fn, merged)
     if isinstance(stmt, ast.Union):
         return _build_union(stmt, catalog, current_db, subquery_value_fn, ctes)
@@ -778,7 +855,8 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         else:
             sch = Schema(list(left.schema.cols) + list(right.schema.cols))
         return JoinPlan(
-            sch, plan.kind, left, right, plan.equi_keys, plan.residual, plan.null_aware
+            sch, plan.kind, left, right, plan.equi_keys, plan.residual,
+            plan.null_aware, plan.broadcast,
         )
     if isinstance(plan, Sort):
         need = set(required)
@@ -792,7 +870,7 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
             need |= walk_columns(e)
         for e, _d in plan.order_exprs:
             need |= walk_columns(e)
-        for _n, _f, a, _o, _r in plan.descs:
+        for _n, _f, a, _o, _r, _fr in plan.descs:
             if a is not None:
                 need |= walk_columns(a)
         child = prune_plan(plan.child, need)
@@ -863,7 +941,7 @@ def _apply_where(b, plan, where, subquery_value_fn, catalog, db):
         else:
             plain.append(c)
     if plain:
-        plan = _reorder_joins(plan, plain, subquery_value_fn)
+        plan = _reorder_joins(plan, plain, subquery_value_fn, catalog)
     for c in subq:
         plan = _subquery_semijoin(b, plan, c, subquery_value_fn, catalog, db)
     for c in corr_scalar:
@@ -899,7 +977,21 @@ def _rels_of(conj, rels: List[LogicalPlan]) -> Optional[set]:
     return out
 
 
-def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
+def _broadcast_choice(est_left: float, est_right: float) -> Optional[str]:
+    """Mesh exchange pick: broadcast the side small enough that an
+    all_gather of it beats an all_to_all of both sides (reference:
+    broadcast-vs-shuffle MPP join cost in exhaust_physical_plans.go;
+    our threshold plays the role of tidb_broadcast_join_threshold_count)."""
+    from tidb_tpu.planner.cardinality import BROADCAST_ROW_LIMIT
+
+    if est_right <= BROADCAST_ROW_LIMIT and est_right * 4 <= est_left:
+        return "right"
+    if est_left <= BROADCAST_ROW_LIMIT and est_left * 4 <= est_right:
+        return "left"
+    return None
+
+
+def _reorder_joins(plan, conjuncts, subquery_value_fn, catalog=None) -> LogicalPlan:
     rels = _flatten_cross(plan)
     if len(rels) == 1:
         binder = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
@@ -932,10 +1024,30 @@ def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
         binder = ExprBinder(r.schema, _scalar_subq(subquery_value_fn))
         rels[i] = Selection(r.schema, r, binder.bind(_and_all(fs)))
 
-    # greedy join tree: start from relation 0, pull in connected relations
-    joined = {0}
-    cur = rels[0]
-    remaining = set(range(1, len(rels)))
+    # cost-driven greedy join tree (reference: join reorder consuming
+    # cardinality estimates, pkg/planner/core/rule_join_reorder.go +
+    # cardinality/selectivity.go): start from the smallest estimated
+    # relation; at each step join the connected relation that minimizes
+    # the estimated result size. Falls back to structural heuristics
+    # when no stats exist (estimates then come from pseudo rates).
+    from tidb_tpu.planner import cardinality as C
+
+    smap = C.StatsMap()
+    rel_est: Dict[int, float] = {}
+    for i, r in enumerate(rels):
+        if catalog is not None:
+            sub = C.gather_stats(r, catalog)
+            smap.cols.update(sub.cols)
+    for i, r in enumerate(rels):
+        rel_est[i] = (
+            C.est_rows(r, catalog, smap) if catalog is not None else 1000.0
+        )
+
+    start = min(range(len(rels)), key=lambda i: (rel_est[i], i))
+    joined = {start}
+    cur = rels[start]
+    cur_est = rel_est[start]
+    remaining = set(range(len(rels))) - joined
     while remaining:
         # all edges between the joined set and one new relation
         candidates: Dict[int, List[Tuple[object, object]]] = {}
@@ -945,21 +1057,33 @@ def _reorder_joins(plan, conjuncts, subquery_value_fn) -> LogicalPlan:
             elif rj in joined and ri in remaining:
                 candidates.setdefault(ri, []).append((ej, ei))
         if not candidates:
-            nxt = min(remaining)
+            nxt = min(remaining, key=lambda i: (rel_est[i], i))
             r = rels[nxt]
             schema = Schema(list(cur.schema.cols) + list(r.schema.cols))
             cur = JoinPlan(schema, "cross", cur, r, [], None)
+            cur_est = cur_est * rel_est[nxt]
             joined.add(nxt)
             remaining.discard(nxt)
             continue
-        # join the relation with the most keys first (most selective)
-        nxt = max(candidates, key=lambda k: len(candidates[k]))
+        # bind each candidate's keys and estimate its join size; pick min
+        bound: Dict[int, List[Tuple[Expr, Expr]]] = {}
+        cand_est: Dict[int, float] = {}
+        for k, pairs in candidates.items():
+            lb = ExprBinder(cur.schema)
+            rb = ExprBinder(rels[k].schema)
+            keys = [(lb.bind(ei), rb.bind(ej)) for ei, ej in pairs]
+            bound[k] = keys
+            cand_est[k] = C.est_join(cur_est, rel_est[k], keys, "inner", smap)
+        nxt = min(
+            candidates,
+            key=lambda k: (cand_est[k], -len(candidates[k]), k),
+        )
         r = rels[nxt]
-        lb = ExprBinder(cur.schema)
-        rb = ExprBinder(r.schema)
-        keys = [(lb.bind(ei), rb.bind(ej)) for ei, ej in candidates[nxt]]
+        keys = bound[nxt]
         schema = Schema(list(cur.schema.cols) + list(r.schema.cols))
-        cur = JoinPlan(schema, "inner", cur, r, keys, None)
+        bcast = _broadcast_choice(cur_est, rel_est[nxt])
+        cur = JoinPlan(schema, "inner", cur, r, keys, None, broadcast=bcast)
+        cur_est = cand_est[nxt]
         joined.add(nxt)
         remaining.discard(nxt)
 
@@ -1410,7 +1534,21 @@ def _build_windows(plan, win_calls: List[ast.WindowCall], rewrite: Dict) -> Logi
                 raise PlanError(f"unsupported window function {call.func}")
             if call.func in ("row_number", "rank", "dense_rank") and not proto.order_by:
                 raise PlanError(f"{call.func}() requires ORDER BY in its OVER clause")
-            descs.append((name, call.func, arg, call.offset, running))
+            frame = call.frame
+            call_running = running
+            if frame is not None:
+                if call.func in ("row_number", "rank", "dense_rank", "lag", "lead"):
+                    frame = None  # frame clause is ignored for ranking funcs
+                elif frame == (None, 0):
+                    frame, call_running = None, True  # running aggregate
+                elif frame == (None, None):
+                    frame, call_running = None, False  # whole partition
+                elif call.func in ("min", "max"):
+                    raise PlanError(
+                        "MIN/MAX window frames support only UNBOUNDED "
+                        "PRECEDING starts"
+                    )
+            descs.append((name, call.func, arg, call.offset, call_running, frame))
             rewrite[key] = (name, t)
             new_cols.append(OutCol(None, name, name, t))
         plan = Window(Schema(new_cols), plan, part_exprs, order_exprs, descs)
@@ -1459,5 +1597,62 @@ def _build_aggregate(b, plan, group_by, agg_calls):
         t = next(t for (nn, t) in rewrite.values() if nn == n)
         out_cols.append(OutCol(None, n, n, t))
 
-    agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
+    if any(d for (_n, _f, _a, d) in aggs):
+        agg_plan = _expand_distinct_aggs(plan, group_exprs, aggs, out_cols)
+    else:
+        agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
     return agg_plan, rewrite
+
+
+def _expand_distinct_aggs(plan, group_exprs, aggs, out_cols):
+    """Rewrite Aggregate-with-DISTINCT into two stacked Aggregates:
+    inner groups by (keys, distinct arg) — collapsing duplicates — and
+    pre-aggregates the non-distinct functions; the outer re-aggregates.
+    The reference evaluates DISTINCT inside each agg function's update
+    path (pkg/executor/aggfuncs count_distinct); on TPU a second grouped
+    pass is one more fused XLA reduction, so the rewrite is free of
+    per-row set probes and reuses the scatter-free group-by kernels.
+    """
+    from tidb_tpu.expression.expr import ColumnRef
+
+    d_args = {}
+    for (_n, _f, a, d) in aggs:
+        if d:
+            d_args[repr(a)] = a
+    if len(d_args) > 1:
+        raise PlanError(
+            "multiple different DISTINCT aggregate arguments not supported"
+        )
+    dx = next(iter(d_args.values()))
+    dname = "_dx"
+
+    inner_groups = list(group_exprs) + [(dname, dx)]
+    inner_aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
+    final_aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
+    for (name, func, arg, d) in aggs:
+        if d:
+            # duplicates are collapsed by the inner group-by; COUNT/SUM/AVG
+            # over the (now unique, NULL-preserving) _dx column give the
+            # DISTINCT semantics, NULLs skipped by the agg kernels.
+            final_aggs.append((name, func, ColumnRef(dx.type, dname), False))
+            continue
+        pn = f"_p{len(inner_aggs)}"
+        if func == "count":
+            inner_aggs.append((pn, "count", arg, False))
+            final_aggs.append((name, "sum", ColumnRef(INT64, pn), False))
+        elif func in ("sum", "min", "max"):
+            inner_aggs.append((pn, func, arg, False))
+            final_aggs.append((name, func, ColumnRef(arg.type, pn), False))
+        else:
+            raise PlanError(
+                f"{func.upper()} cannot be combined with DISTINCT aggregates"
+            )
+
+    inner_cols = [OutCol(None, n, n, e.type) for n, e in inner_groups]
+    for (pn, f, a, _d) in inner_aggs:
+        t = INT64 if f == "count" else a.type
+        inner_cols.append(OutCol(None, pn, pn, t))
+    inner = Aggregate(Schema(inner_cols), plan, inner_groups, inner_aggs)
+
+    final_groups = [(n, ColumnRef(e.type, n)) for n, e in group_exprs]
+    return Aggregate(Schema(out_cols), inner, final_groups, final_aggs)

@@ -93,6 +93,9 @@ class Server:
                     sess.execute(f"use `{payload.decode()}`")
                     io.write_packet(P.ok_packet())
                 elif cmd == COM_QUERY:
+                    from tidb_tpu.utils.failpoint import inject
+
+                    inject("server/dispatch-query")
                     sql = payload.decode("utf-8", "replace")
                     self._run_query(io, sess, sql)
                 elif cmd == COM_FIELD_LIST:

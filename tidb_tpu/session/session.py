@@ -9,6 +9,7 @@ the embedded single-process session AND the test harness entry point.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -63,6 +64,12 @@ class Session:
         # optimistic version check — first committer wins, the analog of
         # 2PC prewrite conflict detection).
         self._txn = None
+        from tidb_tpu.utils.sqlkiller import SQLKiller
+
+        # KILL QUERY support (reference pkg/util/sqlkiller): executor
+        # polls at safepoints; .kill() from any thread aborts the stmt
+        self.killer = SQLKiller()
+        self.executor.kill_check = self.killer.check
         self.executor.table_hook = self._resolve_table_for_read
 
     # -- transaction plumbing ------------------------------------------
@@ -75,7 +82,11 @@ class Session:
         shadow = self._txn["shadows"].get(key)
         if shadow is not None:
             return shadow, shadow.version
-        pinned = self._txn["pins"].setdefault(key, t.version)
+        if key not in self._txn["pins"]:
+            self._txn["pins"][key] = t.version
+            t.pin(t.version)  # GC safepoint: snapshot survives writers
+            self._txn.setdefault("pin_objs", []).append((t, t.version))
+        pinned = self._txn["pins"][key]
         return t, pinned
 
     def _resolve_table_for_write(self, db: str, name: str):
@@ -99,13 +110,17 @@ class Session:
         from tidb_tpu.utils import failpoint
 
         if s.op == "begin":
+            failpoint.inject("session/begin-txn")
             if self._txn is not None:
                 self._commit_txn()  # MySQL: BEGIN implicitly commits
             self._txn = {"pins": {}, "shadows": {}, "base_versions": {}}
         elif s.op == "commit":
             self._commit_txn()
         elif s.op == "rollback":
-            self._txn = None
+            txn, self._txn = self._txn, None
+            if txn:
+                for t, v in txn.get("pin_objs", []):
+                    t.unpin(v)
         return Result([], [])
 
     def _commit_txn(self) -> None:
@@ -114,23 +129,29 @@ class Session:
         if self._txn is None:
             return
         txn, self._txn = self._txn, None
-        failpoint.inject("session/before-commit")
-        # optimistic conflict check then swap (first committer wins)
-        for key, shadow in txn["shadows"].items():
-            db, name = key
-            base = self.catalog.table(db, name)
-            if base.version != txn["base_versions"][key]:
-                raise RuntimeError(
-                    f"write conflict on {db}.{name}: "
-                    "table changed since transaction start"
-                )
-        for key, shadow in txn["shadows"].items():
-            db, name = key
-            base = self.catalog.table(db, name)
-            base.replace_blocks(shadow.blocks())
-            base.dictionaries = shadow.dictionaries
-        if txn["shadows"]:
-            clear_scan_cache()
+        try:
+            failpoint.inject("session/before-commit")
+            # optimistic conflict check then swap (first committer wins)
+            for key, shadow in txn["shadows"].items():
+                db, name = key
+                base = self.catalog.table(db, name)
+                failpoint.inject("session/commit-conflict-check")
+                if base.version != txn["base_versions"][key]:
+                    raise RuntimeError(
+                        f"write conflict on {db}.{name}: "
+                        "table changed since transaction start"
+                    )
+            failpoint.inject("session/commit-apply")
+            for key, shadow in txn["shadows"].items():
+                db, name = key
+                base = self.catalog.table(db, name)
+                base.replace_blocks(shadow.blocks())
+                base.dictionaries = shadow.dictionaries
+            if txn["shadows"]:
+                clear_scan_cache()
+        finally:
+            for t, v in txn.get("pin_objs", []):
+                t.unpin(v)
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -154,7 +175,17 @@ class Session:
 
     # ------------------------------------------------------------------
     def _execute_stmt(self, s) -> Result:
+        from tidb_tpu.utils import failpoint
+
         t0 = time.perf_counter()
+        self.killer.clear()
+        failpoint.inject("session/stmt-start")
+        try:
+            self.executor.quota_bytes = int(
+                self.vars.get("tidb_mem_quota_query") or 0
+            )
+        except Exception:
+            self.executor.quota_bytes = None
         if isinstance(s, (ast.Select, ast.Union, ast.With)):
             r = self._run_select(s)
         elif isinstance(s, ast.CreateTable):
@@ -238,6 +269,9 @@ class Session:
         return Result([], [])
 
     def _run_load_data(self, s: ast.LoadData) -> Result:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dml/load")
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         from tidb_tpu.storage.loader import load_file
 
@@ -358,11 +392,130 @@ class Session:
         return Result(names, rows)
 
     # ------------------------------------------------------------------
-    def _scalar_subquery(self, q: ast.Select):
-        """Execute an uncorrelated scalar subquery; returns a Literal."""
+    # Recursive CTEs: iterative materialization (reference: CTEExec's
+    # seed/recursive iteration, pkg/executor/cte.go:70). Each recursive
+    # CTE is evaluated to a fixpoint into a scratch catalog table; the
+    # body then plans against a plain SELECT over that table.
+    _CTE_MAX_RECURSION = 1000  # mysql cte_max_recursion_depth default
+
+    def _run_recursive_with(self, s, outer_ctes=None) -> Result:
+        merged = dict(outer_ctes or {})
+        scratch: List[Tuple[str, str]] = []
+        try:
+            for name, q in s.ctes:
+                if isinstance(q, ast.Union) and any(
+                    _refs_table(sel, name) for sel in q.selects
+                ):
+                    merged[name] = self._materialize_recursive(
+                        name, q, merged, scratch
+                    )
+                else:
+                    merged[name] = q
+            return self._run_select(s.body, merged)
+        finally:
+            for db, t in scratch:
+                try:
+                    self.catalog.drop_table(db, t, if_exists=True)
+                except Exception:
+                    pass
+
+    def _materialize_recursive(self, name, q, scope, scratch):
+        from tidb_tpu.dtypes import INT64
+        from tidb_tpu.storage.table import TableSchema
+
+        seeds = [sel for sel in q.selects if not _refs_table(sel, name)]
+        steps = [sel for sel in q.selects if _refs_table(sel, name)]
+        if not seeds:
+            raise ValueError(f"recursive CTE {name!r} has no seed SELECT")
+        seed_ast = seeds[0] if len(seeds) == 1 else ast.Union(seeds, q.all)
+        r = self._run_select(seed_ast, dict(scope))
+        col_names = list(r.columns)
+        types = [
+            t if (t is not None and t.kind != Kind.NULL) else INT64
+            for t in (r.types or [INT64] * len(col_names))
+        ]
+        rows = list(r.rows)
+        if not q.all:
+            seen = set()
+            uniq = []
+            for row in rows:
+                if row not in seen:
+                    seen.add(row)
+                    uniq.append(row)
+            rows = uniq
+        else:
+            seen = None
+
+        db = "_cte_scratch"
+        self.catalog.create_database(db, if_not_exists=True)
+        # process-unique scratch names: the scratch database is shared
+        # across sessions of one catalog, so a per-session counter would
+        # collide under concurrent server connections
+        tname = f"{name}_{next(_cte_scratch_seq)}"
+        schema = TableSchema(list(zip(col_names, types)))
+        tbl = self.catalog.create_table(db, tname, schema)
+        scratch.append((db, tname))
+        if rows:
+            tbl.append_rows(rows)
+
+        # the working (delta) table feeds each recursive step; ONE table
+        # reused across iterations (content replacement) so the plan/jit
+        # caches hit — a fresh table per iteration would recompile the
+        # step program every round
+        wname = f"{tname}_w"
+        scratch.append((db, wname))
+        wt = self.catalog.create_table(db, wname, schema)
+        working = rows
+        ref_ast = ast.Select(
+            items=[
+                ast.SelectItem(ast.Name(None, c), alias=c) for c in col_names
+            ],
+            from_=ast.TableRef(db, wname),
+        )
+        iters = 0
+        while working:
+            iters += 1
+            if iters > self._CTE_MAX_RECURSION:
+                raise ValueError(
+                    f"recursive CTE {name!r} exceeded "
+                    f"{self._CTE_MAX_RECURSION} iterations"
+                )
+            from tidb_tpu.utils.failpoint import inject
+
+            inject("cte/iterate")
+            wt.clear_rows()
+            wt.append_rows(working)
+            scope2 = dict(scope)
+            scope2[name] = ref_ast
+            new_rows = []
+            for st in steps:
+                r2 = self._run_select(st, scope2)
+                new_rows.extend(r2.rows)
+            if seen is not None:
+                fresh = []
+                for row in new_rows:
+                    if row not in seen:
+                        seen.add(row)
+                        fresh.append(row)
+                new_rows = fresh
+            if new_rows:
+                tbl.append_rows(new_rows)
+            working = new_rows
+
+        return ast.Select(
+            items=[
+                ast.SelectItem(ast.Name(None, c), alias=c) for c in col_names
+            ],
+            from_=ast.TableRef(db, tname),
+        )
+
+    # ------------------------------------------------------------------
+    def _scalar_subquery(self, q: ast.Select, ctes=None):
+        """Execute an uncorrelated scalar subquery; returns a Literal.
+        ``ctes`` carries the enclosing WITH scope, if any."""
         from tidb_tpu.expression.expr import Literal
 
-        r = self._run_select(q)
+        r = self._run_select(q, ctes)
         if len(r.columns) != 1:
             raise ValueError("scalar subquery must return one column")
         if len(r.rows) == 0:
@@ -371,13 +524,15 @@ class Session:
             raise ValueError("scalar subquery returned more than one row")
         return Literal(value=r.rows[0][0])
 
-    def _run_select(self, s) -> Result:
+    def _run_select(self, s, ctes=None) -> Result:
+        if isinstance(s, ast.With) and s.recursive:
+            return self._run_recursive_with(s, ctes)
         if isinstance(s, ast.Select) and s.from_ is None:
             return self._run_tableless(s)
         # spans mirror the reference's (session.ExecuteStmt ->
         # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
         with self.tracer.span("session.plan"):
-            plan = build_query(s, self.catalog, self.db, self._scalar_subquery)
+            plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
         with self.tracer.span("executor.run"):
             batch, dicts = self.executor.run(plan)
         types = {c.internal: c.type for c in plan.schema}
@@ -393,6 +548,9 @@ class Session:
 
     # ------------------------------------------------------------------
     def _run_insert(self, s: ast.Insert) -> Result:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dml/insert")
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         names = t.schema.names
         cols = [c.lower() for c in s.columns] if s.columns else names
@@ -418,6 +576,9 @@ class Session:
         raise ValueError("INSERT VALUES must be literals")
 
     def _run_delete(self, s: ast.Delete) -> Result:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dml/delete")
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         blocks = t.blocks()
         if s.where is None:
@@ -431,11 +592,18 @@ class Session:
         return Result([], [], affected=affected)
 
     def _run_update(self, s: ast.Update) -> Result:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dml/update")
         t = self._resolve_table_for_write(s.db or self.db, s.table)
-        # evaluate via a SELECT of all columns with updated expressions,
-        # then rewrite the table (columnar copy-on-write update).
-        alias = t.name
         sets = {c.lower(): e for c, e in s.sets}
+        fast = self._try_columnar_update(t, s, sets)
+        if fast is not None:
+            return fast
+        # fallback: evaluate via a SELECT of all columns with updated
+        # expressions, then rewrite the table (string-typed SET columns
+        # need dictionary merging, which only the append path does).
+        alias = t.name
         items = []
         for n, _typ in t.schema.columns:
             if n in sets:
@@ -475,6 +643,81 @@ class Session:
         clear_scan_cache()
         return Result([], [], affected=affected)
 
+    def _try_columnar_update(self, t, s: ast.Update, sets) -> Optional[Result]:
+        """Block-targeted columnar UPDATE: scatter new values for the SET
+        columns into copies of only the touched blocks — O(touched data),
+        not a whole-table rewrite through Python rows (reference: the
+        write path touches only affected keys, pkg/executor/update.go).
+        Returns None to fall back (string SET columns: dictionary merge
+        needs the append path)."""
+        types = t.schema.types
+        if any(
+            c not in types or types[c].kind == Kind.STRING for c in sets
+        ):
+            return None
+        if s.where is None or not t.blocks():
+            return None
+        try:
+            masks, affected = self._eval_where_per_block(t, s.where)
+        except Exception:
+            return None
+        if affected == 0:
+            return Result([], [], affected=0)
+        # new values for matching rows only, cast to the column type,
+        # in scan (block-concatenation) order
+        set_cols = list(sets)
+        items = [
+            ast.SelectItem(
+                ast.Call("cast", [sets[c]], types[c]), alias=f"_s{i}"
+            )
+            for i, c in enumerate(set_cols)
+        ]
+        sel = ast.Select(
+            items=items,
+            from_=ast.TableRef(s.db, s.table, None),
+            where=s.where,
+        )
+        db = s.db or self.db
+        try:
+            plan = build_query(sel, self.catalog, db, self._scalar_subquery)
+            batch, _dicts = self.executor.run(plan)
+        except Exception:
+            return None
+        rv = np.asarray(batch.row_valid)
+        order = np.nonzero(rv)[0]
+        internals = [c.internal for c in plan.schema.cols]
+        new_data = {}
+        new_valid = {}
+        for c, internal in zip(set_cols, internals):
+            dc = batch.cols[internal]
+            new_data[c] = np.asarray(dc.data)[order]
+            new_valid[c] = np.asarray(dc.valid)[order]
+        if len(order) != affected:
+            return None  # alignment lost (unexpected compaction) — fall back
+        new_blocks = []
+        consumed = 0
+        for block, m in zip(t.blocks(), masks):
+            hit = int(m.sum())
+            if hit == 0:
+                new_blocks.append(block)
+                continue
+            pos = np.nonzero(m)[0]
+            cols = dict(block.columns)
+            for c in set_cols:
+                src = block.columns[c]
+                data = src.data.copy()
+                valid = src.valid.copy()
+                data[pos] = new_data[c][consumed : consumed + hit].astype(
+                    data.dtype
+                )
+                valid[pos] = new_valid[c][consumed : consumed + hit]
+                cols[c] = dataclasses.replace(src, data=data, valid=valid)
+            consumed += hit
+            new_blocks.append(HostBlock(cols, block.nrows))
+        t.replace_blocks(new_blocks)
+        clear_scan_cache()
+        return Result([], [], affected=affected)
+
     def _eval_where_per_block(self, t, where):
         """Evaluate WHERE over each block on host via a filtered scan;
         returns per-block keep masks for matching rows + count."""
@@ -505,9 +748,31 @@ class Session:
         if s.analyze:
             _out, _dicts, lines = self.executor.run_analyze(plan)
             return Result(["plan"], [(l,) for l in lines])
+        from tidb_tpu.planner.cardinality import est_rows
+
+        est_rows(plan, self.catalog)  # annotates .est per node
         lines = []
         _render_plan(plan, 0, lines)
         return Result(["plan"], [(l,) for l in lines])
+
+
+_cte_scratch_seq = itertools.count(1)
+
+
+def _refs_table(node, name: str) -> bool:
+    """Does this AST subtree reference table ``name`` (unqualified)?"""
+    import dataclasses as _dc
+
+    if isinstance(node, ast.TableRef):
+        if node.db is None and node.name.lower() == name.lower():
+            return True
+    if _dc.is_dataclass(node) and not isinstance(node, type):
+        for f in _dc.fields(node):
+            if _refs_table(getattr(node, f.name), name):
+                return True
+    elif isinstance(node, (list, tuple)):
+        return any(_refs_table(x, name) for x in node)
+    return False
 
 
 def _render_plan(plan, depth, out: List[str]):
@@ -524,14 +789,21 @@ def _render_plan(plan, depth, out: List[str]):
         detail = f" groups={[n for n, _ in plan.group_exprs]} aggs={[f'{f}({n})' for n, f, _, _ in plan.aggs]}"
     elif isinstance(plan, L.JoinPlan):
         detail = f" kind={plan.kind} keys={len(plan.equi_keys)}"
+        if plan.broadcast:
+            detail += f" broadcast={plan.broadcast}"
     elif isinstance(plan, L.Sort):
         detail = f" keys={len(plan.keys)}"
     elif isinstance(plan, L.Limit):
         detail = f" limit={plan.count} offset={plan.offset}"
     elif isinstance(plan, L.Projection):
         detail = f" exprs={[n for n, _ in plan.exprs]}{' +base' if plan.additive else ''}"
+    est = getattr(plan, "est", None)
+    if est is not None:
+        detail += f" est={est:.0f}"
     out.append(pad + name + detail)
     for attr in ("child", "left", "right"):
         c = getattr(plan, attr, None)
         if c is not None:
             _render_plan(c, depth + 1, out)
+    for c in getattr(plan, "children", []) or []:
+        _render_plan(c, depth + 1, out)

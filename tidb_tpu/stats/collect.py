@@ -84,6 +84,9 @@ def analyze_table(table) -> Dict[str, ColumnStats]:
     """ANALYZE TABLE: exact per-column stats, stored on the table
     (reference: stats tables mysql.stats_histograms etc. via the stats
     handle, pkg/statistics/handle)."""
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("stats/analyze")
     stats: Dict[str, ColumnStats] = {}
     for name, typ in table.schema.columns:
         batch, dicts = scan_table(table, [name])

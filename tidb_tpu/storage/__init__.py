@@ -1,3 +1,4 @@
 from tidb_tpu.storage.table import Table, TableSchema  # noqa: F401
 from tidb_tpu.storage.catalog import Catalog  # noqa: F401
 from tidb_tpu.storage.scan import scan_table  # noqa: F401
+from tidb_tpu.storage.persist import save_catalog, load_catalog  # noqa: F401

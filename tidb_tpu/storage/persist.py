@@ -1,0 +1,97 @@
+"""Catalog persistence: snapshot the store to disk and reload on start.
+
+Reference: nothing survives restart in round 1; the reference persists
+everything through TiKV/badger (pkg/store/mockstore/unistore over
+badger) and backs up via BR (br/pkg/task/backup.go). The TPU-native
+store is columnar host RAM, so persistence is a columnar snapshot:
+one .npz per table (data + validity per column, dictionaries as object
+arrays) plus a JSON manifest of schemas — the moral analog of a BR
+full backup of the current snapshot version (historical MVCC versions
+are not persisted, matching BR's snapshot semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+from tidb_tpu.chunk import HostBlock, HostColumn
+from tidb_tpu.dtypes import Kind, SQLType
+from tidb_tpu.storage.catalog import Catalog
+from tidb_tpu.storage.scan import concat_blocks
+from tidb_tpu.storage.table import TableSchema
+
+_MANIFEST = "manifest.json"
+
+
+def _type_to_json(t: SQLType) -> Dict:
+    return {"kind": t.kind.value, "scale": t.scale}
+
+
+def _type_from_json(d: Dict) -> SQLType:
+    return SQLType(Kind(d["kind"]), scale=d.get("scale", 0))
+
+
+def save_catalog(catalog: Catalog, path: str) -> None:
+    """Write a full snapshot of every table's current version."""
+    os.makedirs(path, exist_ok=True)
+    manifest = {"dbs": {}}
+    for db in catalog.databases():
+        if db.startswith("_"):  # scratch schemas (recursive CTE temps)
+            continue
+        manifest["dbs"][db] = {}
+        for name in catalog.tables(db):
+            t = catalog.table(db, name)
+            manifest["dbs"][db][name] = {
+                "columns": [
+                    [n, _type_to_json(ty)] for n, ty in t.schema.columns
+                ],
+                "primary_key": t.schema.primary_key,
+            }
+            cols = t.schema.names
+            block = concat_blocks(t.blocks(), cols, t.schema)
+            arrays = {}
+            for c in cols:
+                hc = block.columns[c]
+                arrays[f"{c}.data"] = hc.data
+                arrays[f"{c}.valid"] = hc.valid
+                if hc.dictionary is not None:
+                    arrays[f"{c}.dict"] = hc.dictionary
+            fn = os.path.join(path, f"{db}.{name}.npz")
+            np.savez_compressed(fn, **arrays)
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+
+
+def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
+    """Rebuild a catalog from a snapshot directory."""
+    catalog = catalog or Catalog()
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    for db, tables in manifest["dbs"].items():
+        catalog.create_database(db, if_not_exists=True)
+        for name, meta in tables.items():
+            schema = TableSchema(
+                [(n, _type_from_json(tj)) for n, tj in meta["columns"]],
+                primary_key=meta.get("primary_key"),
+            )
+            t = catalog.create_table(db, name, schema, if_not_exists=True)
+            data = np.load(
+                os.path.join(path, f"{db}.{name}.npz"), allow_pickle=True
+            )
+            cols = {}
+            for n, ty in schema.columns:
+                d = data[f"{n}.data"]
+                v = data[f"{n}.valid"]
+                dic = None
+                if f"{n}.dict" in data:
+                    dic = data[f"{n}.dict"]
+                    t.dictionaries[n] = dic
+                cols[n] = HostColumn(ty, d, v, dic)
+            block = HostBlock.from_columns(cols)
+            if block.nrows:
+                t.replace_blocks([block])
+    return catalog

@@ -18,8 +18,15 @@ import numpy as np
 from tidb_tpu.chunk import Batch, HostBlock, HostColumn, block_to_batch, pad_capacity
 from tidb_tpu.storage.table import Table
 
-# (table id, version, cols, capacity, sharding) -> Batch
-_scan_cache: Dict[tuple, Batch] = {}
+# (table uid, version, cols, capacity, sharding) -> Batch. Keyed by the
+# process-unique Table.uid (NOT id(): CPython reuses freed addresses, and
+# a drop/create cycle would alias a new table onto stale device arrays).
+# LRU-bounded; inserting a new version evicts older versions of the same
+# table (the copr-cache invalidation analog).
+from collections import OrderedDict
+
+_scan_cache: "OrderedDict[tuple, Batch]" = OrderedDict()
+_SCAN_CACHE_MAX = 64
 
 
 def clear_scan_cache() -> None:
@@ -61,6 +68,9 @@ def scan_table(
     Region data-parallel scan analog, SURVEY.md §2.7) and the capacity is
     padded to a multiple of the mesh size; cached per (version, columns,
     capacity, mesh)."""
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("storage/scan")
     v = table.version if version is None else version
     cols = tuple(columns)
     blocks = table.blocks(v)
@@ -73,9 +83,11 @@ def scan_table(
             # equal per-shard tiles for any mesh size (a doubling loop
             # would never terminate for non-power-of-two meshes)
             cap = mesh_n * pad_capacity(-(-cap // mesh_n), floor=32)
-    key = (id(table), v, cols, cap, mesh_n)
+    uid = getattr(table, "uid", None) or id(table)
+    key = (uid, v, cols, cap, mesh_n)
     dicts = {c: table.dictionaries[c] for c in cols if c in table.dictionaries}
     if key in _scan_cache:
+        _scan_cache.move_to_end(key)
         return _scan_cache[key], dicts
     block = concat_blocks(blocks, cols, table.schema)
     batch = block_to_batch(block, cap)
@@ -83,5 +95,10 @@ def scan_table(
         from tidb_tpu.parallel.mesh import shard_batch
 
         batch = shard_batch(batch, mesh)
+    # drop cached batches of older versions of this table
+    for k in [k for k in _scan_cache if k[0] == uid and k[1] != v]:
+        del _scan_cache[k]
+    while len(_scan_cache) >= _SCAN_CACHE_MAX:
+        _scan_cache.popitem(last=False)
     _scan_cache[key] = batch
     return batch, dicts

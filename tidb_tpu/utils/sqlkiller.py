@@ -1,0 +1,33 @@
+"""Query kill switch.
+
+Reference: pkg/util/sqlkiller/sqlkiller.go:41 — an atomic flag the
+executor polls at safepoints; KILL QUERY sets it and the running
+statement aborts with ErrQueryInterrupted. Here the safepoints are the
+host-side control points of the engine (statement start, each capacity-
+discovery iteration, result materialization) — device programs
+themselves are short-lived single XLA launches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class QueryKilled(RuntimeError):
+    pass
+
+
+class SQLKiller:
+    def __init__(self) -> None:
+        self._killed = threading.Event()
+
+    def kill(self) -> None:
+        """Signal the running statement to abort (thread-safe)."""
+        self._killed.set()
+
+    def clear(self) -> None:
+        self._killed.clear()
+
+    def check(self) -> None:
+        if self._killed.is_set():
+            raise QueryKilled("query interrupted (killed)")
