@@ -51,7 +51,23 @@ Q18_SQL = (
     "group by o_orderkey having sum(l_quantity) > 1250 "
     "order by sum(l_quantity) desc limit 100"
 )
-QUERIES = {"q1": Q1_SQL, "q6": Q6_SQL, "q18": Q18_SQL}
+Q5_SQL = (
+    "select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue "
+    "from customer, orders, lineitem, supplier, nation, region "
+    "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+    "and l_suppkey = s_suppkey and c_nationkey = s_nationkey "
+    "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+    "and r_name = 'ASIA' "
+    "and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01' "
+    "group by n_name order by revenue desc"
+)
+QUERIES = {"q1": Q1_SQL, "q5": Q5_SQL, "q6": Q6_SQL, "q18": Q18_SQL}
+_TABLES = {
+    "q1": ["orders", "lineitem"],
+    "q6": ["orders", "lineitem"],
+    "q18": ["orders", "lineitem"],
+    "q5": ["orders", "lineitem", "customer", "supplier", "nation", "region"],
+}
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +120,44 @@ def numpy_q18(np, blk, thresh):
     return big, sums[big]
 
 
+def numpy_q5(np, cat, d0, d1):
+    """Vectorized Q5 over raw columns (dense 1..N keys -> array lookups)."""
+
+    def cols(t):
+        tt = cat.table("tpch", t)
+        b = tt.blocks()[0]
+        return {n: c for n, c in b.columns.items()}
+
+    reg = cols("region")
+    nat = cols("nation")
+    cust = cols("customer")
+    supp = cols("supplier")
+    orders = cols("orders")
+    li = cols("lineitem")
+    asia_code = np.searchsorted(
+        np.asarray(reg["r_name"].dictionary, dtype=object), "ASIA"
+    )
+    asia = set(reg["r_regionkey"].data[reg["r_name"].data == asia_code].tolist())
+    nat_in = np.array([rk in asia for rk in nat["n_regionkey"].data])
+    n_nat = len(nat_in)
+    cust_nation = np.zeros(int(cust["c_custkey"].data.max()) + 1, dtype=np.int64)
+    cust_nation[cust["c_custkey"].data] = cust["c_nationkey"].data
+    supp_nation = np.zeros(int(supp["s_suppkey"].data.max()) + 1, dtype=np.int64)
+    supp_nation[supp["s_suppkey"].data] = supp["s_nationkey"].data
+    om = (orders["o_orderdate"].data >= d0) & (orders["o_orderdate"].data < d1)
+    ord_cust = np.zeros(int(orders["o_orderkey"].data.max()) + 2, dtype=np.int64)
+    ord_ok = np.zeros(int(orders["o_orderkey"].data.max()) + 2, dtype=bool)
+    ord_cust[orders["o_orderkey"].data[om]] = orders["o_custkey"].data[om]
+    ord_ok[orders["o_orderkey"].data[om]] = True
+    lo = li["l_orderkey"].data
+    ls = li["l_suppkey"].data
+    cn = cust_nation[ord_cust[lo]]
+    sn = supp_nation[ls]
+    m = ord_ok[lo] & (cn == sn) & nat_in[np.clip(sn, 0, n_nat - 1)]
+    rev = li["l_extendedprice"].data[m] * (100 - li["l_discount"].data[m])
+    return np.bincount(sn[m], rev, minlength=n_nat)
+
+
 # ---------------------------------------------------------------------------
 # child: actually measure (imports jax via tidb_tpu)
 # ---------------------------------------------------------------------------
@@ -144,7 +198,7 @@ def measure(args) -> int:
 
     cat = Catalog()
     t0 = time.perf_counter()
-    tables = ["orders", "lineitem"]
+    tables = _TABLES[args.query]
     load_tpch(cat, sf=args.sf, tables=tables, seed=1)
     gen_s = time.perf_counter() - t0
     sess = Session(cat, db="tpch")
@@ -179,6 +233,8 @@ def measure(args) -> int:
             numpy_q1(np, blk, cutoff)
         elif args.query == "q6":
             numpy_q6(np, blk, d0, d1)
+        elif args.query == "q5":
+            numpy_q5(np, cat, d0, d1)
         else:
             numpy_q18(np, blk, 125000)
         base_times.append(time.perf_counter() - t0)

@@ -1,0 +1,110 @@
+"""ALTER TABLE (versioned schema change) + information_schema.
+
+Reference: online schema change state machine (pkg/ddl/index.go:545 in
+spirit — MVCC-lite versions make concurrent readers safe), virtual
+memtables (pkg/infoschema/interface.go:26). VERDICT round-1 criteria:
+ALTER while a session reads; information_schema.columns works.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture()
+def sess():
+    return Session(Catalog())
+
+
+def test_alter_add_column_with_default(sess):
+    sess.execute("create table t (k bigint primary key, v bigint)")
+    sess.execute("insert into t values (1, 10), (2, 20)")
+    sess.execute("alter table t add column nm varchar(16) default 'unk'")
+    assert sess.must_query("select k, v, nm from t order by k").rows == [
+        (1, 10, "unk"),
+        (2, 20, "unk"),
+    ]
+    sess.execute("insert into t values (3, 30, 'c')")
+    assert sess.must_query("select nm from t where k = 3").rows == [("c",)]
+
+
+def test_alter_add_nullable_then_filter(sess):
+    sess.execute("create table t (k bigint)")
+    sess.execute("insert into t values (1), (2)")
+    sess.execute("alter table t add column x bigint")
+    assert sess.must_query("select k, x from t order by k").rows == [
+        (1, None),
+        (2, None),
+    ]
+    sess.execute("insert into t values (3, 33)")
+    assert sess.must_query("select k from t where x is not null").rows == [(3,)]
+    assert sess.must_query("select count(*) from t where x is null").rows == [(2,)]
+
+
+def test_alter_drop_column(sess):
+    sess.execute("create table t (k bigint primary key, a bigint, b bigint)")
+    sess.execute("insert into t values (1, 2, 3)")
+    sess.execute("alter table t drop column a")
+    assert sess.must_query("select * from t").rows == [(1, 3)]
+    with pytest.raises(Exception):
+        sess.execute("select a from t")
+    with pytest.raises(Exception, match="primary key"):
+        sess.execute("alter table t drop column k")
+
+
+def test_alter_while_snapshot_reader_pinned(sess):
+    sess.execute("create table t (k bigint)")
+    sess.execute("insert into t values (1), (2)")
+    reader = Session(sess.catalog)
+    reader.execute("begin")
+    assert reader.must_query("select count(*) from t").rows == [(2,)]
+    sess.execute("alter table t add column z bigint default 9")
+    sess.execute("insert into t values (5, 50)")
+    # pinned snapshot: pre-ALTER blocks NULL-fill the new column and the
+    # new row is invisible
+    assert reader.must_query("select k, z from t order by k").rows == [
+        (1, None),
+        (2, None),
+    ]
+    reader.execute("rollback")
+    assert sess.must_query("select k, z from t order by k").rows == [
+        (1, 9),
+        (2, 9),
+        (5, 50),
+    ]
+
+
+def test_information_schema(sess):
+    sess.execute("create database app")
+    sess.execute("create table app.users (id bigint primary key, nm varchar(8))")
+    sess.execute("insert into app.users values (1, 'a'), (2, 'b')")
+    r = sess.must_query(
+        "select table_name, table_rows from information_schema.tables "
+        "where table_schema = 'app'"
+    )
+    assert r.rows == [("users", 2)]
+    r = sess.must_query(
+        "select column_name, ordinal_position, data_type "
+        "from information_schema.columns where table_name = 'users' "
+        "order by ordinal_position"
+    )
+    assert r.rows == [("id", 1, "int"), ("nm", 2, "string")]
+    r = sess.must_query(
+        "select count(*) from information_schema.schemata "
+        "where schema_name = 'app'"
+    )
+    assert r.rows == [(1,)]
+
+
+def test_alter_add_not_null_fills_type_default(sess):
+    sess.execute("create table t (k bigint)")
+    sess.execute("insert into t values (1), (2)")
+    sess.execute("alter table t add column c bigint not null")
+    sess.execute("alter table t add column s varchar(8) not null")
+    assert sess.must_query("select k, c, s from t order by k").rows == [
+        (1, 0, ""),
+        (2, 0, ""),
+    ]
+    sess.execute("alter table t add column d bigint default 7 not null")
+    assert sess.must_query("select d from t where k = 1").rows == [(7,)]

@@ -136,3 +136,159 @@ def test_two_connections_share_catalog(server):
     assert r["rows"] == [("42",)]
     c1.close()
     c2.close()
+
+
+class PreparedClient(MiniClient):
+    """Binary-protocol extension: COM_STMT_PREPARE / EXECUTE / CLOSE
+    (reference: conn_stmt.go client side as exercised by real drivers)."""
+
+    MYSQL_TYPE = {
+        int: 8,      # LONGLONG
+        float: 5,    # DOUBLE
+        str: 253,    # VAR_STRING
+        type(None): 6,
+    }
+
+    def prepare(self, sql):
+        self.io.reset_seq()
+        self.io.write_packet(b"\x16" + sql.encode())
+        first = self.io.read_packet()
+        assert first[0] == 0x00, first
+        stmt_id = struct.unpack_from("<I", first, 1)[0]
+        ncols = struct.unpack_from("<H", first, 5)[0]
+        nparams = struct.unpack_from("<H", first, 7)[0]
+        for _ in range(nparams):
+            self.io.read_packet()
+        if nparams:
+            eof = self.io.read_packet()
+            assert eof[0] == 0xFE
+        for _ in range(ncols):
+            self.io.read_packet()
+        if ncols:
+            self.io.read_packet()
+        return stmt_id, nparams
+
+    def execute(self, stmt_id, params, send_types=True):
+        self.io.reset_seq()
+        payload = b"\x17" + struct.pack("<I", stmt_id) + b"\x00" + struct.pack("<I", 1)
+        n = len(params)
+        if n:
+            bitmap = bytearray((n + 7) // 8)
+            for i, v in enumerate(params):
+                if v is None:
+                    bitmap[i // 8] |= 1 << (i % 8)
+            payload += bytes(bitmap)
+            payload += b"\x01" if send_types else b"\x00"
+            if send_types:
+                for v in params:
+                    payload += struct.pack("<H", self.MYSQL_TYPE[type(v)])
+            for v in params:
+                if v is None:
+                    continue
+                if isinstance(v, int):
+                    payload += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    payload += struct.pack("<d", v)
+                else:
+                    b = str(v).encode()
+                    payload += bytes([len(b)]) + b
+        self.io.write_packet(payload)
+        return self._read_binary_resultset()
+
+    def _read_binary_resultset(self):
+        first = self.io.read_packet()
+        if first[0] == 0xFF:
+            errno = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(f"server error {errno}: {first[9:].decode()}")
+        if first[0] == 0x00 and len(first) < 9:
+            affected, _ = self._lenenc(first, 1)
+            return {"affected": affected, "rows": None}
+        ncols, _ = self._lenenc(first, 0)
+        names, mtypes = [], []
+        for _ in range(ncols):
+            colpkt = self.io.read_packet()
+            pos = 0
+            vals = []
+            for _f in range(6):
+                ln, pos = self._lenenc(colpkt, pos)
+                vals.append(colpkt[pos:pos + ln])
+                pos += ln
+            names.append(vals[4].decode())
+            mtypes.append(colpkt[pos + 7])  # fixed-len part: type byte
+        eof = self.io.read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self.io.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            rows.append(self._decode_binary_row(pkt, ncols, mtypes))
+        return {"columns": names, "rows": rows}
+
+    def _decode_binary_row(self, pkt, ncols, mtypes):
+        nb = (ncols + 7 + 2) // 8
+        bitmap = pkt[1:1 + nb]
+        pos = 1 + nb
+        row = []
+        for i, mt in enumerate(mtypes):
+            if bitmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                row.append(None)
+                continue
+            if mt == 8:  # LONGLONG
+                row.append(struct.unpack_from("<q", pkt, pos)[0])
+                pos += 8
+            elif mt == 5:  # DOUBLE
+                row.append(struct.unpack_from("<d", pkt, pos)[0])
+                pos += 8
+            elif mt == 1:  # TINY (bool)
+                row.append(struct.unpack_from("<b", pkt, pos)[0])
+                pos += 1
+            elif mt == 10:  # DATE
+                ln = pkt[pos]
+                pos += 1
+                y, mo, d = struct.unpack_from("<HBB", pkt, pos)
+                row.append(f"{y:04d}-{mo:02d}-{d:02d}")
+                pos += ln
+            else:  # VAR_STRING / NEWDECIMAL
+                ln, pos = self._lenenc(pkt, pos)
+                row.append(pkt[pos:pos + ln].decode())
+                pos += ln
+        return tuple(row)
+
+
+def test_prepared_statements_binary_protocol(server):
+    c = PreparedClient(server.port)
+    c.query("create table ps (k bigint primary key, v double, nm varchar(16), d date)")
+    sid, np_ = c.prepare("insert into ps values (?, ?, ?, ?)")
+    assert np_ == 4
+    c.execute(sid, [1, 1.5, "alpha", "2024-03-31"])
+    c.execute(sid, [2, None, "beta's", None])
+    r = c.query("select count(*) from ps")
+    assert r["rows"] == [("2",)]
+
+    sid2, np2 = c.prepare("select k, v, nm, d from ps where k = ?")
+    assert np2 == 1
+    r = c.execute(sid2, [1])
+    assert r["rows"] == [(1, 1.5, "alpha", "2024-03-31")]
+    r = c.execute(sid2, [2])
+    assert r["rows"] == [(2, None, "beta's", None)]  # NULLs + quote escape
+    # reuse with another parameter; placeholder inside a string literal
+    sid3, np3 = c.prepare("select nm from ps where nm <> '?' and k = ?")
+    assert np3 == 1
+    r = c.execute(sid3, [1])
+    assert r["rows"] == [("alpha",)]
+    c.close()
+
+
+def test_prepared_reexecute_without_types(server):
+    """Real drivers send parameter types only on the first execute; the
+    server must reuse them (new-params-bound flag = 0)."""
+    c = PreparedClient(server.port)
+    c.query("create table ps2 (k bigint primary key, v bigint)")
+    c.query("insert into ps2 values (1, 10), (2, 20), (42, 420)")
+    sid, _ = c.prepare("select v from ps2 where k = ?")
+    r = c.execute(sid, [1])  # first execute: types sent
+    assert r["rows"] == [(10,)]
+    r = c.execute(sid, [42], send_types=False)  # re-execute: no types
+    assert r["rows"] == [(420,)]
+    c.close()

@@ -1,0 +1,96 @@
+"""Streamed (paged) aggregation: tables larger than the device tile
+budget execute chunk-by-chunk with host-RAM staging.
+
+Reference: the spill/paging machinery (agg_spill.go, paging.go:25);
+VERDICT round-1 criterion #2: aggregation over an input exceeding one
+device tile runs and matches the whole-table answer.
+"""
+
+import pytest
+
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture(scope="module")
+def sess():
+    cat = Catalog()
+    load_tpch(cat, sf=0.01, seed=5, tables=["orders", "lineitem"])
+    s = Session(cat, db="tpch")
+    yield s
+    failpoint.disable_all()
+
+
+Q1 = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), "
+    "avg(l_extendedprice), count(*) from lineitem "
+    "where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+
+
+def _set_stream(sess, rows):
+    sess.execute(f"set tidb_tpu_stream_rows = {rows}")
+
+
+def test_streamed_group_agg_matches_whole_table(sess):
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(Q1).rows
+    _set_stream(sess, 7000)  # 60k-row lineitem -> ~9 chunks
+    hits = []
+    failpoint.enable("executor/stream-chunk", lambda: hits.append(1))
+    try:
+        streamed = sess.must_query(Q1).rows
+    finally:
+        failpoint.disable("executor/stream-chunk")
+    assert len(hits) >= 8  # actually chunked
+    assert len(full) == len(streamed)
+    for a, b in zip(full, streamed):
+        assert a[0] == b[0] and a[1] == b[1] and a[4] == b[4]
+        assert abs(a[2] - b[2]) < 1e-6
+        assert abs(a[3] - b[3]) < 1e-9
+    _set_stream(sess, 2_000_000)
+
+
+def test_streamed_scalar_agg(sess):
+    q = (
+        "select sum(l_extendedprice * l_discount), count(*), "
+        "min(l_shipdate), max(l_shipdate) from lineitem "
+        "where l_discount between 0.05 and 0.07"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 5000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert full[0][1:] == streamed[0][1:]
+    assert abs(full[0][0] - streamed[0][0]) < 0.01
+
+
+def test_streamed_agg_under_having_and_join(sess):
+    """The streamed aggregate's Staged result composes with the rest of
+    the plan (semi join + HAVING + ORDER BY above it)."""
+    q = (
+        "select count(*) from orders where o_orderkey in "
+        "(select l_orderkey from lineitem group by l_orderkey "
+        "having sum(l_quantity) > 150)"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 7000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert full == streamed
+
+
+def test_streamed_distinct_agg(sess):
+    q = "select l_returnflag, count(distinct l_shipmode) from lineitem group by l_returnflag order by l_returnflag"
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 7000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert full == streamed

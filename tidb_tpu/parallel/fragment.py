@@ -63,6 +63,40 @@ def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[s
     return partial, final
 
 
+def build_final_stage(key_names, final):
+    """Final-merge stage descriptors shared by the distributed (mesh)
+    and streamed (chunked) aggregation paths: key column readers, final
+    AggDescs (avg split into sum+count), and post-division rules."""
+    fkeys = [_colfn(n) for n in key_names]
+    fdescs: List[AggDesc] = []
+    post_avg: List[Tuple[str, str, str, int]] = []
+    for func, out, pnames, scale in final:
+        if func == "avg2":
+            fdescs.append(AggDesc("sum", _colfn(pnames[0]), f"_fs_{out}"))
+            fdescs.append(AggDesc("sum", _colfn(pnames[1]), f"_fc_{out}"))
+            post_avg.append((out, f"_fs_{out}", f"_fc_{out}", scale))
+        else:
+            fdescs.append(AggDesc(func, _colfn(pnames[0]), out))
+    return fkeys, fdescs, post_avg
+
+
+def apply_post_avg(cols, post_avg):
+    """AVG = SUM(partial sums) / SUM(partial counts), descaled for
+    decimal args; drops the helper columns."""
+    for out, sn, cn, scale in post_avg:
+        s, c = cols[sn], cols[cn]
+        denom = jnp.where(c.data == 0, 1, c.data).astype(jnp.float64)
+        if scale:
+            denom = denom * (10**scale)
+        cols[out] = DevCol(
+            s.data.astype(jnp.float64) / denom, s.valid & (c.data > 0)
+        )
+    for _out, sn, cn, _ in post_avg:
+        cols.pop(sn, None)
+        cols.pop(cn, None)
+    return cols
+
+
 def distributed_group_aggregate(
     local: Batch,
     key_fns: Sequence[ExprFn],
@@ -109,30 +143,11 @@ def distributed_group_aggregate(
         exchanged = broadcast_gather(part_batch, axis)
         dropped = jnp.zeros((), jnp.int64)
 
-    fkeys = [_colfn(n) for n in key_names]
-    fdescs: List[AggDesc] = []
-    post_avg: List[Tuple[str, str, str, int]] = []
-    for func, out, pnames, scale in final:
-        if func == "avg2":
-            fdescs.append(AggDesc("sum", _colfn(pnames[0]), f"_fs_{out}"))
-            fdescs.append(AggDesc("sum", _colfn(pnames[1]), f"_fc_{out}"))
-            post_avg.append((out, f"_fs_{out}", f"_fc_{out}", scale))
-        else:
-            fdescs.append(AggDesc(func, _colfn(pnames[0]), out))
+    fkeys, fdescs, post_avg = build_final_stage(key_names, final)
     fin, ng = group_aggregate(
         exchanged, fkeys, fdescs, group_capacity, key_names, key_widths=key_widths
     )
-
-    cols = dict(fin.cols)
-    for out, sn, cn, scale in post_avg:
-        s, c = cols[sn], cols[cn]
-        denom = jnp.where(c.data == 0, 1, c.data).astype(jnp.float64)
-        if scale:
-            denom = denom * (10**scale)
-        cols[out] = DevCol(s.data.astype(jnp.float64) / denom, s.valid & (c.data > 0))
-    for out, sn, cn, _ in post_avg:
-        cols.pop(sn, None)
-        cols.pop(cn, None)
+    cols = apply_post_avg(dict(fin.cols), post_avg)
 
     if not key_fns:
         # scalar: every device now has all partials; result is replicated —

@@ -28,6 +28,43 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return jax.make_mesh((n,), (AXIS,), devices=devs[:n])
 
 
+def init_multihost(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: Optional[int] = None,
+) -> Mesh:
+    """Bring up the cross-host runtime (DCN analog) and return the
+    GLOBAL mesh spanning every process's devices.
+
+    Reference: cross-store MPP dispatch (pkg/store/copr/mpp.go:93) +
+    cluster membership via PD/etcd. JAX's multi-controller model
+    replaces both: every host runs the same program, jax.distributed
+    wires the processes together (coordinator = the PD analog), and
+    collectives ride ICI within a slice / DCN across slices with no
+    engine change — the mesh axis simply spans more devices.
+
+    For CPU-based testing set JAX_PLATFORMS=cpu and
+    xla_force_host_platform_device_count before calling; each process
+    contributes its local devices to the global mesh.
+    """
+    if local_device_count is not None:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={local_device_count}"
+            ).strip()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return make_mesh()
+
+
 def batch_spec() -> P:
     return P(AXIS)
 

@@ -175,6 +175,16 @@ class DropTable:
 
 
 @dataclasses.dataclass
+class AlterTable:
+    db: Optional[str]
+    name: str
+    action: str  # 'add' | 'drop'
+    column: Optional[ColumnDef] = None  # for add
+    col_name: Optional[str] = None  # for drop
+    default: Optional[object] = None  # ADD COLUMN ... DEFAULT <const>
+
+
+@dataclasses.dataclass
 class CreateDatabase:
     name: str
     if_not_exists: bool = False

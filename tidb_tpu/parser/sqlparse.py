@@ -44,7 +44,7 @@ _TOKEN_RE = re.compile(
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
   | (?P<bq>`[^`]*`)
   | (?P<sysvar>@@[A-Za-z_][A-Za-z0-9_.$]*)
-  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>])
+  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>?])
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
     """,
     re.VERBOSE | re.DOTALL,
@@ -60,7 +60,7 @@ KEYWORDS = {
     "delete", "update", "set", "use", "explain", "analyze", "show",
     "tables", "databases", "if", "primary", "key", "div", "mod",
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
-    "global", "session", "variables", "trace", "begin", "commit",
+    "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
     "over", "partition", "with", "recursive", "local",
@@ -171,6 +171,7 @@ class Parser:
     _SOFT_KW = (
         "date", "key", "tables", "databases", "count", "sum", "avg", "min",
         "max", "unbounded", "preceding", "following", "current", "row",
+        "column", "add", "default", "alter",
     )
 
     def expect_ident(self) -> str:
@@ -192,6 +193,8 @@ class Parser:
             return ast.Explain(self.parse_stmt(), analyze=analyze)
         if self.at_kw("create"):
             return self.parse_create()
+        if self.at_kw("alter"):
+            return self.parse_alter()
         if self.at_kw("drop"):
             return self.parse_drop()
         if self.at_kw("insert"):
@@ -908,6 +911,36 @@ class Parser:
                 break
         self.expect_op(")")
         return ast.CreateTable(db, name, cols, pk, ine)
+
+    def parse_alter(self):
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        db, name = self._qualified_name()
+        if self.accept_kw("add"):
+            self.accept_kw("column")
+            cname = self.expect_ident()
+            ctype = self.parse_type()
+            default = None
+            not_null = False
+            while True:  # NOT NULL / DEFAULT in either order (MySQL)
+                if self.accept_kw("not"):
+                    self.expect_kw("null")
+                    not_null = True
+                elif self.accept_kw("null"):
+                    pass
+                elif self.accept_kw("default"):
+                    d = self.parse_primary()
+                    if not isinstance(d, ast.Const):
+                        raise ParseError("DEFAULT must be a constant")
+                    default = d.value
+                else:
+                    break
+            cd = ast.ColumnDef(cname, ctype, not_null=not_null)
+            return ast.AlterTable(db, name, "add", column=cd, default=default)
+        if self.accept_kw("drop"):
+            self.accept_kw("column")
+            return ast.AlterTable(db, name, "drop", col_name=self.expect_ident())
+        raise ParseError("ALTER TABLE supports ADD COLUMN / DROP COLUMN")
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("if"):

@@ -171,6 +171,18 @@ class Limit(LogicalPlan):
 
 
 @dataclasses.dataclass
+class Staged(LogicalPlan):
+    """A pre-computed device batch injected into a plan — the output of
+    an out-of-band execution stage (streamed aggregation over a table
+    too large for one device tile). The physical compiler treats it as
+    a constant source; the nonce keeps plan-cache keys unique."""
+
+    batch: object = None  # device Batch
+    dicts: Optional[Dict] = None
+    nonce: int = 0
+
+
+@dataclasses.dataclass
 class UnionAll(LogicalPlan):
     """Bag union by position; children are projections onto _u{i} names
     with casts to the common types (reference UnionExec,

@@ -131,6 +131,8 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
             )
         elif isinstance(p, L.Limit):
             parts.append(f"{p.count},{p.offset}")
+        elif isinstance(p, L.Staged):
+            parts.append(f"staged#{p.nonce}")
         for c in _plan_children(p):
             walk(c)
 
@@ -239,6 +241,49 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
     if lo is None or hi is None:
         return None
     return (pkcol, lo, hi)
+
+
+
+def build_agg_parts(plan: "L.Aggregate", dicts):
+    """Compile an Aggregate node's pieces: (key fns, key names, packed key
+    widths, AggDescs). Shared by the in-plan aggregation node and the
+    streamed (chunked) execution path."""
+    key_fns = [compile_expr(e, dicts) for _, e in plan.group_exprs]
+    key_names = [n for n, _ in plan.group_exprs]
+    descs = []
+    for name, func, arg, distinct in plan.aggs:
+        if distinct:
+            raise ExecError("DISTINCT aggregates not yet supported")
+        fn = compile_expr(arg, dicts) if arg is not None else None
+        scale = (
+            arg.type.scale
+            if arg is not None and arg.type.kind == Kind.DECIMAL
+            else 0
+        )
+        # scale-4+ decimal products (price*(1-disc)*(1+tax)) overflow
+        # int64 accumulation at SF100 row counts: use the dual-lane
+        # wide accumulator (AggDesc.wide)
+        wide = func in ("sum", "avg") and scale >= 4
+        descs.append(AggDesc(func, fn, name, arg_scale=scale, wide=wide))
+    key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
+    return key_fns, key_names, key_widths, descs
+
+
+
+def agg_out_dicts(plan: "L.Aggregate", dicts) -> Dicts:
+    """Dictionaries surviving an aggregation: group keys and
+    min/max/first outputs over dictionary-coded columns."""
+    out_dicts: Dicts = {}
+    for (kname, e) in plan.group_exprs:
+        d = _expr_dict(e, dicts)
+        if d is not None:
+            out_dicts[kname] = d
+    for (name, func, arg, _d) in plan.aggs:
+        if func in ("min", "max", "first") and arg is not None:
+            d = _expr_dict(arg, dicts)
+            if d is not None:
+                out_dicts[name] = d
+    return out_dicts
 
 
 class PlanCompiler:
@@ -358,6 +403,16 @@ class PlanCompiler:
 
             self._tag = "repl"
             return fn_one, {}
+
+        if isinstance(plan, L.Staged):
+            batch = plan.batch
+            sdicts = dict(plan.dicts or {})
+
+            def fn_staged(inputs, caps, _b=batch):
+                return _b, {}
+
+            self._tag = "repl"
+            return fn_staged, sdicts
 
         if isinstance(plan, L.Scan):
             nid = self.fresh_id()
@@ -564,26 +619,9 @@ class PlanCompiler:
         self.sized.append(nid)
         self.defaults[nid] = 1024
         self.widths[nid] = _schema_width(plan.schema)
-        key_fns = [compile_expr(e, dicts) for _, e in plan.group_exprs]
-        key_names = [n for n, _ in plan.group_exprs]
-        descs = []
-        for name, func, arg, distinct in plan.aggs:
-            if distinct:
-                raise ExecError("DISTINCT aggregates not yet supported")
-            fn = compile_expr(arg, dicts) if arg is not None else None
-            scale = (
-                arg.type.scale
-                if arg is not None and arg.type.kind == Kind.DECIMAL
-                else 0
-            )
-            # scale-4+ decimal products (price*(1-disc)*(1+tax)) overflow
-            # int64 accumulation at SF100 row counts: use the dual-lane
-            # wide accumulator (AggDesc.wide)
-            wide = func in ("sum", "avg") and scale >= 4
-            descs.append(AggDesc(func, fn, name, arg_scale=scale, wide=wide))
+        key_fns, key_names, key_widths, descs = build_agg_parts(plan, dicts)
         scalar = not plan.group_exprs
         agg_names = [(n, f) for n, f, _a, _d in plan.aggs]
-        key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
         mesh_n = self.mesh_n if child_tag == "shard" else None
         if mesh_n:
             # partial agg per shard -> all_to_all of group rows -> final
@@ -630,17 +668,7 @@ class PlanCompiler:
             needs[nid] = ngroups
             return out, needs
 
-        out_dicts: Dicts = {}
-        for (kname, e) in plan.group_exprs:
-            d = _expr_dict(e, dicts)
-            if d is not None:
-                out_dicts[kname] = d
-        for (name, func, arg, _d) in plan.aggs:
-            if func in ("min", "max", "first") and arg is not None:
-                d = _expr_dict(arg, dicts)
-                if d is not None:
-                    out_dicts[name] = d
-        return fn_agg, out_dicts
+        return fn_agg, agg_out_dicts(plan, dicts)
 
     # ------------------------------------------------------------------
     def _build_distributed_topn(self, plan: L.Limit):
@@ -1018,6 +1046,9 @@ class PhysicalExecutor:
         # per-query device-memory budget in bytes (tidb_mem_quota_query);
         # session refreshes it per statement. None/0 = unlimited.
         self.quota_bytes = None
+        # row threshold above which aggregate inputs execute chunked
+        # through host RAM (tidb_tpu_stream_rows); None/0 disables
+        self.stream_rows = 2_000_000
         # kill safepoint hook (utils/sqlkiller): raises to abort
         self.kill_check = None
         self.mesh = None
@@ -1070,10 +1101,8 @@ class PhysicalExecutor:
                 # pin-then-verify closes the resolve/pin window: once a
                 # pin lands on a still-present version, GC keeps it.
                 for _ in range(8):
-                    t.pin(v)
-                    if t.has_version(v):
+                    if t.pin_verified(v):
                         break
-                    t.unpin(v)
                     t, v = self._resolve(s.db, s.table)
                 else:
                     raise ExecError(f"snapshot of {s.db}.{s.table} vanished")
@@ -1216,6 +1245,11 @@ class PhysicalExecutor:
                 return out, caps
 
     def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
+        from tidb_tpu.planner.streamed import try_streamed
+
+        streamed = try_streamed(self, plan)
+        if streamed is not None:
+            return streamed
         key = self._cache_key(plan)
         cq = self._cache.get(key)
         if cq is not None:

@@ -1,0 +1,241 @@
+"""Streamed (paged) aggregation: the spill analog.
+
+Reference: the engine never requires a table to fit one buffer — blocking
+operators spill to disk (pkg/executor/aggregate/agg_spill.go, sortexec
+spill, pkg/util/paging/paging.go progressive paging). On TPU the scarce
+resource is HBM and the staging medium is host RAM: when an aggregation's
+input table exceeds the device tile budget, the pre-aggregation pipeline
+(scan -> filter -> project) runs CHUNK BY CHUNK on device, each chunk is
+partially aggregated (the same partial/final split the mesh path uses
+across devices — here applied across time), only the tiny partial group
+rows accumulate on device, and one final aggregation merges them.
+
+The streamed Aggregate's result is injected back into the plan as a
+Staged node, and the remainder of the plan (HAVING / ORDER BY / joins
+above the aggregate) executes normally — so any plan shape whose large
+table feeds an aggregation benefits, not just bare GROUP BY queries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tidb_tpu.chunk import Batch, DevCol, HostBlock, block_to_batch, pad_capacity
+from tidb_tpu.executor.aggregate import AggDesc, _next_pow2, group_aggregate
+from tidb_tpu.parallel.fragment import (
+    _partial_descs,
+    apply_post_avg,
+    build_final_stage,
+)
+from tidb_tpu.planner import logical as L
+
+_STAGED_NONCE = [0]
+
+
+def _pipeline_below(plan) -> Optional[Tuple[L.Aggregate, list]]:
+    """Find the lowest Aggregate whose input subtree is a pure
+    scan pipeline (Scan with optional Selection/Projection on top).
+    Returns (agg_node, [nodes from agg child down to scan]) or None."""
+    found = None
+
+    def walk(p):
+        nonlocal found
+        for c in _children(p):
+            walk(c)
+        if found is None and isinstance(p, L.Aggregate):
+            chain = []
+            cur = p.child
+            while isinstance(cur, (L.Selection, L.Projection)):
+                chain.append(cur)
+                cur = cur.child
+            if isinstance(cur, L.Scan):
+                chain.append(cur)
+                found = (p, chain)
+
+    walk(plan)
+    return found
+
+
+def _children(p):
+    out = []
+    for attr in ("child", "left", "right"):
+        c = getattr(p, attr, None)
+        if c is not None:
+            out.append(c)
+    out.extend(getattr(p, "children", []) or [])
+    return out
+
+
+def _replace_node(plan, target, repl):
+    if plan is target:
+        return repl
+    kw = {}
+    for attr in ("child", "left", "right"):
+        c = getattr(plan, attr, None)
+        if c is not None:
+            kw[attr] = _replace_node(c, target, repl)
+    ch = getattr(plan, "children", None)
+    if ch:
+        kw["children"] = [_replace_node(c, target, repl) for c in ch]
+    if not kw:
+        return plan
+    return dataclasses.replace(plan, **kw)
+
+
+def _chunk_blocks(table, version, columns, chunk_rows: int):
+    """Yield HostBlocks of <= chunk_rows rows over the table's blocks
+    (numpy views — no copies until device transfer)."""
+    for b in table.blocks(version):
+        n = b.nrows
+        for a in range(0, n, chunk_rows):
+            z = min(a + chunk_rows, n)
+            cols = {
+                name: dataclasses.replace(
+                    c, data=c.data[a:z], valid=c.valid[a:z]
+                )
+                for name, c in b.columns.items()
+                if name in columns
+            }
+            yield HostBlock(cols, z - a)
+
+
+def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
+    """Execute `plan` with a streamed aggregate when it qualifies:
+    single-device, lowest Aggregate over a pure scan pipeline, and the
+    scanned table larger than executor.stream_rows. Returns None when
+    the normal whole-table path should run."""
+    threshold = getattr(executor, "stream_rows", None)
+    if not threshold or executor.mesh is not None:
+        return None
+    m = _pipeline_below(plan)
+    if m is None:
+        return None
+    agg, chain = m
+    scan = chain[-1]
+    t, v = executor._resolve(scan.db, scan.table)
+    if t.nrows <= threshold:
+        return None
+
+    from tidb_tpu.planner.physical import (
+        PlanCompiler,
+        agg_out_dicts,
+        build_agg_parts,
+    )
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("executor/stream-start")
+    # compile the pre-aggregation pipeline once; its only input is the
+    # scan site, fed one chunk at a time
+    comp = PlanCompiler(executor.catalog, resolver=executor._resolve)
+    pipe_fn, dicts = comp._build(agg.child)
+    if comp.sized:
+        return None  # pipeline has capacity knobs (unexpected): bail
+    assert len(comp.scans) == 1
+    site = comp.scans[0]
+
+    key_fns, key_names, key_widths, descs = build_agg_parts(agg, dicts)
+    partial, final = _partial_descs(descs)
+
+    for _ in range(8):
+        if t.pin_verified(v):
+            break
+        t, v = executor._resolve(scan.db, scan.table)
+    else:
+        return None  # snapshot churned away repeatedly: run unpaged
+    try:
+        chunk_rows = max(int(threshold), 1)
+        cap = 1024
+        partial_batches: List[Batch] = []
+        for hb in _chunk_blocks(t, v, site.columns, chunk_rows):
+            inject("executor/stream-chunk")
+            if executor.kill_check is not None:
+                executor.kill_check()
+            chunk = block_to_batch(hb)
+            piped, _needs = pipe_fn({site.node_id: chunk}, {})
+            while True:
+                out, ng = group_aggregate(
+                    piped, key_fns, partial, cap, key_names,
+                    key_widths=key_widths,
+                )
+                ngi = int(jax.device_get(ng))
+                slots = _next_pow2(max(2 * cap, 16)) if key_fns else cap
+                if key_fns and ngi > slots:
+                    cap = cap * 2  # partial table overflowed: retry bigger
+                    continue
+                break
+            partial_batches.append(out)
+    finally:
+        t.unpin(v)
+
+    combined = _concat_batches(partial_batches)
+
+    # final merge: shared with the mesh path's final stage (fragment.py)
+    fkeys, fdescs, post_avg = build_final_stage(key_names, final)
+    fcap = max(cap, 1024)
+    while True:
+        fin, ng = group_aggregate(
+            combined, fkeys, fdescs, fcap, key_names, key_widths=key_widths
+        )
+        ngi = int(jax.device_get(ng))
+        slots = _next_pow2(max(2 * fcap, 16)) if fkeys else fcap
+        if fkeys and ngi > slots:
+            fcap *= 2
+            continue
+        break
+
+    cols = apply_post_avg(dict(fin.cols), post_avg)
+    result = Batch(
+        {n: cols[n] for n in [c.internal for c in agg.schema]}, fin.row_valid
+    )
+
+    if not key_fns:
+        # scalar aggregate over possibly-empty input: ensure one row
+        # (COUNT=0, others NULL) like the in-plan aggregation node
+        any_group = jnp.any(result.row_valid)
+        first = jnp.zeros(result.capacity, dtype=bool).at[0].set(True)
+        rv = jnp.where(any_group, result.row_valid, first)
+        cols2 = {}
+        agg_funcs = {n: f for n, f, _a, _d in agg.aggs}
+        for n, c in result.cols.items():
+            if agg_funcs.get(n) == "count":
+                cols2[n] = DevCol(
+                    jnp.where(any_group, c.data, jnp.zeros_like(c.data)),
+                    jnp.where(any_group, c.valid, first),
+                )
+            else:
+                cols2[n] = DevCol(
+                    c.data, jnp.where(any_group, c.valid, jnp.zeros_like(c.valid))
+                )
+        result = Batch(cols2, rv)
+
+    _STAGED_NONCE[0] += 1
+    staged = L.Staged(
+        agg.schema,
+        batch=result,
+        dicts=agg_out_dicts(agg, dicts),
+        nonce=_STAGED_NONCE[0],
+    )
+    if plan is agg:
+        new_plan = staged
+    else:
+        new_plan = _replace_node(plan, agg, staged)
+    return executor.run(new_plan)
+
+
+def _concat_batches(batches: List[Batch]) -> Batch:
+    if len(batches) == 1:
+        return batches[0]
+    names = list(batches[0].cols)
+    cols = {}
+    for n in names:
+        cols[n] = DevCol(
+            jnp.concatenate([b.cols[n].data for b in batches]),
+            jnp.concatenate([b.cols[n].valid for b in batches]),
+        )
+    rv = jnp.concatenate([b.row_valid for b in batches])
+    return Batch(cols, rv)

@@ -24,6 +24,10 @@ COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 
 class Server:
@@ -78,6 +82,11 @@ class Server:
             pass
         io.write_packet(P.ok_packet())
 
+        # prepared statements: per-connection registry (reference:
+        # conn_stmt.go handleStmtPrepare/Execute at conn.go:1999)
+        stmts = {}
+        next_stmt_id = [0]
+
         while True:
             io.reset_seq()
             body = io.read_packet()
@@ -100,6 +109,38 @@ class Server:
                     self._run_query(io, sess, sql)
                 elif cmd == COM_FIELD_LIST:
                     io.write_packet(P.eof_packet())
+                elif cmd == COM_STMT_PREPARE:
+                    sql = payload.decode("utf-8", "replace")
+                    nparams = P.count_placeholders(sql)
+                    next_stmt_id[0] += 1
+                    sid = next_stmt_id[0]
+                    stmts[sid] = [sql, nparams, None]  # [sql, n, param types]
+                    io.write_packet(P.stmt_prepare_ok(sid, 0, nparams))
+                    if nparams:
+                        for _ in range(nparams):
+                            io.write_packet(P.column_def("?", None))
+                        io.write_packet(P.eof_packet())
+                elif cmd == COM_STMT_EXECUTE:
+                    import struct as _st
+
+                    sid = _st.unpack_from("<I", payload, 0)[0]
+                    if sid not in stmts:
+                        io.write_packet(P.err_packet(1243, "unknown stmt"))
+                        continue
+                    sql, nparams, ptypes = stmts[sid]
+                    _sid, params, ptypes = P.parse_stmt_execute(
+                        payload, nparams, ptypes
+                    )
+                    stmts[sid][2] = ptypes
+                    bound = P.bind_placeholders(sql, params)
+                    self._run_query(io, sess, bound, binary=True)
+                elif cmd == COM_STMT_CLOSE:
+                    import struct as _st
+
+                    stmts.pop(_st.unpack_from("<I", payload, 0)[0], None)
+                    # no response by protocol
+                elif cmd == COM_STMT_RESET:
+                    io.write_packet(P.ok_packet())
                 else:
                     io.write_packet(
                         P.err_packet(1047, f"unsupported command {cmd:#x}")
@@ -110,7 +151,9 @@ class Server:
                 except OSError:
                     return
 
-    def _run_query(self, io: P.PacketIO, sess: Session, sql: str) -> None:
+    def _run_query(
+        self, io: P.PacketIO, sess: Session, sql: str, binary: bool = False
+    ) -> None:
         r = sess.execute(sql)
         if not r.columns:
             io.write_packet(P.ok_packet(affected=r.affected))
@@ -120,10 +163,14 @@ class Server:
         for name, t in zip(r.columns, types):
             io.write_packet(P.column_def(name, t))
         io.write_packet(P.eof_packet())
-        for row in r.rows:
-            payload = b""
-            for v, t in zip(row, types):
-                fv = P.format_value(v, t)
-                payload += b"\xfb" if fv is None else P.lenenc_str(fv)
-            io.write_packet(payload)
+        if binary:
+            for row in r.rows:
+                io.write_packet(P.binary_row(row, types))
+        else:
+            for row in r.rows:
+                payload = b""
+                for v, t in zip(row, types):
+                    fv = P.format_value(v, t)
+                    payload += b"\xfb" if fv is None else P.lenenc_str(fv)
+                io.write_packet(payload)
         io.write_packet(P.eof_packet())

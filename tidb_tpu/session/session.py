@@ -186,6 +186,12 @@ class Session:
             )
         except Exception:
             self.executor.quota_bytes = None
+        try:
+            self.executor.stream_rows = int(
+                self.vars.get("tidb_tpu_stream_rows") or 0
+            ) or None
+        except Exception:
+            pass
         if isinstance(s, (ast.Select, ast.Union, ast.With)):
             r = self._run_select(s)
         elif isinstance(s, ast.CreateTable):
@@ -197,6 +203,22 @@ class Session:
             r = Result([], [])
         elif isinstance(s, ast.DropTable):
             self.catalog.drop_table(s.db or self.db, s.name, s.if_exists)
+            clear_scan_cache()
+            r = Result([], [])
+        elif isinstance(s, ast.AlterTable):
+            failpoint.inject("ddl/alter-table")
+            t = self.catalog.table(s.db or self.db, s.name)
+            if s.action == "add":
+                default = s.default
+                if default is None and s.column.not_null:
+                    # MySQL fills the type default for NOT NULL adds
+                    default = (
+                        "" if s.column.type.kind == Kind.STRING else 0
+                    )
+                t.alter_add_column(s.column.name, s.column.type, default)
+            else:
+                t.alter_drop_column(s.col_name)
+            self.catalog.schema_version += 1
             clear_scan_cache()
             r = Result([], [])
         elif isinstance(s, ast.CreateDatabase):

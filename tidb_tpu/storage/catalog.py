@@ -63,10 +63,85 @@ class Catalog:
             self.schema_version += 1
 
     def table(self, db: str, name: str) -> Table:
+        if db.lower() == "information_schema":
+            return self._infoschema_table(name.lower())
         try:
             return self._dbs[db.lower()][name.lower()]
         except KeyError:
             raise ValueError(f"unknown table {db}.{name}") from None
+
+    # -- information_schema virtual tables ---------------------------------
+    # (reference: pkg/infoschema virtual memtables, interface.go:26 +
+    # infoschema_reader.go; synthesized fresh per access so they always
+    # reflect the live catalog)
+    _IS_TABLES = ("tables", "columns", "schemata")
+
+    def _infoschema_table(self, name: str) -> Table:
+        # memoized per catalog state: a fresh Table per call would carry
+        # a fresh uid, defeating the executor's plan/scan caches and
+        # paying a full jit per information_schema statement
+        state = (name, self.schema_version, self._data_fingerprint())
+        cache = getattr(self, "_is_table_cache", None)
+        if cache is None:
+            cache = self._is_table_cache = {}
+        hit = cache.get(name)
+        if hit is not None and hit[0] == state:
+            return hit[1]
+        t = self._build_infoschema_table(name)
+        cache[name] = (state, t)
+        return t
+
+    def _data_fingerprint(self) -> tuple:
+        with self._lock:
+            return tuple(
+                (db, tn, t.version)
+                for db in sorted(self._dbs)
+                for tn, t in sorted(self._dbs[db].items())
+            )
+
+    def _build_infoschema_table(self, name: str) -> Table:
+        from tidb_tpu.dtypes import INT64, STRING
+
+        if name == "tables":
+            schema = TableSchema(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("table_rows", INT64)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        rows.append((db, tn, self._dbs[db][tn].nrows))
+        elif name == "columns":
+            schema = TableSchema(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("column_name", STRING), ("ordinal_position", INT64),
+                 ("data_type", STRING)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        for i, (cn, ct) in enumerate(
+                            self._dbs[db][tn].schema.columns, 1
+                        ):
+                            rows.append((db, tn, cn, i, repr(ct).lower()))
+        elif name == "schemata":
+            schema = TableSchema([("schema_name", STRING)])
+            with self._lock:
+                rows = [
+                    (db,) for db in sorted(self._dbs) if not db.startswith("_")
+                ]
+        else:
+            raise ValueError(f"unknown table information_schema.{name}")
+        t = Table(name, schema)
+        if rows:
+            t.append_rows(rows)
+        return t
 
     def tables(self, db: str) -> List[str]:
         return sorted(self._dbs.get(db.lower(), {}))
@@ -75,4 +150,6 @@ class Catalog:
         return sorted(self._dbs)
 
     def has_table(self, db: str, name: str) -> bool:
+        if db.lower() == "information_schema":
+            return name.lower() in self._IS_TABLES
         return name.lower() in self._dbs.get(db.lower(), {})

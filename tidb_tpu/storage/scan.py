@@ -47,11 +47,28 @@ def concat_blocks(blocks, columns: Sequence[str], schema=None) -> HostBlock:
         }
         return HostBlock(cols, 0)
     cols = {}
+    types = schema.types if schema is not None else {}
     for name in columns:
-        first = blocks[0].columns[name]
-        data = np.concatenate([b.columns[name].data for b in blocks])
-        valid = np.concatenate([b.columns[name].valid for b in blocks])
-        cols[name] = HostColumn(first.type, data, valid, first.dictionary)
+        have = [b for b in blocks if name in b.columns]
+        first = have[0].columns[name] if have else None
+        typ = first.type if first is not None else types[name]
+
+        def col_of(b):
+            c = b.columns.get(name)
+            if c is not None:
+                return c.data, c.valid
+            # block predates ALTER ADD COLUMN: reads see NULL
+            return (
+                np.zeros(b.nrows, dtype=typ.np_dtype),
+                np.zeros(b.nrows, dtype=bool),
+            )
+
+        parts = [col_of(b) for b in blocks]
+        data = np.concatenate([d for d, _ in parts])
+        valid = np.concatenate([v for _, v in parts])
+        cols[name] = HostColumn(
+            typ, data, valid, first.dictionary if first is not None else None
+        )
     return HostBlock(cols, sum(b.nrows for b in blocks))
 
 

@@ -174,7 +174,67 @@ class Table:
             self._gc_versions()
             return self.version
 
+    # -- schema evolution (reference: online schema change, the F1 state
+    # machine at pkg/ddl/index.go:545; MVCC-lite makes it cheap here:
+    # the new version's blocks carry the new column, pinned snapshots
+    # keep reading their old blocks and old schema semantics) ---------------
+    def alter_add_column(self, name: str, typ: SQLType, default=None) -> int:
+        name = name.lower()
+        with self._lock:
+            if name in (n for n, _ in self.schema.columns):
+                raise ValueError(f"column {name!r} exists")
+            new_schema = TableSchema(
+                self.schema.columns + [(name, typ)], self.schema.primary_key
+            )
+            new_blocks = []
+            for b in self._versions[self.version]:
+                col = column_from_values([default] * b.nrows, typ)
+                cols = dict(b.columns)
+                cols[name] = col
+                new_blocks.append(HostBlock(cols, b.nrows))
+            self.schema = new_schema
+            if typ.kind == Kind.STRING:
+                d = new_blocks[0].columns[name].dictionary if new_blocks else None
+                self.dictionaries[name] = (
+                    d if d is not None else np.array([], dtype=object)
+                )
+            self.version += 1
+            self._versions[self.version] = new_blocks
+            self._gc_versions()
+            return self.version
+
+    def alter_drop_column(self, name: str) -> int:
+        name = name.lower()
+        with self._lock:
+            if name not in (n for n, _ in self.schema.columns):
+                raise ValueError(f"unknown column {name!r}")
+            pk = self.schema.primary_key
+            if pk and name in pk:
+                raise ValueError("cannot drop a primary key column")
+            self.schema = TableSchema(
+                [(n, t) for n, t in self.schema.columns if n != name], pk
+            )
+            self.dictionaries.pop(name, None)
+            # blocks keep the column physically; pruned scans never read
+            # it and the next rewrite drops it (lazy column GC)
+            self.version += 1
+            self._versions[self.version] = list(
+                self._versions[self.version - 1]
+            )
+            self._gc_versions()
+            return self.version
+
     # -- point/range access (reference: point_get.go:132 + ranger) ---------
+    def pin_verified(self, version: int) -> bool:
+        """Pin `version` and confirm it still exists (pin-then-verify:
+        once a pin lands on a present version, GC keeps it). Returns
+        False — with the pin released — when the version vanished."""
+        self.pin(version)
+        if self.has_version(version):
+            return True
+        self.unpin(version)
+        return False
+
     def pin_current(self) -> int:
         """Atomically pin and return the current version (no resolve/pin
         race with concurrent committers + GC)."""

@@ -48,6 +48,10 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "smallest row-capacity tile (reference paging min size, paging.go:25)"),
         SysVarDef("tidb_tpu_group_capacity", 1024, "both", _int_range(16, 1 << 24),
                   "initial group-table capacity before overflow retry"),
+        SysVarDef("tidb_tpu_stream_rows", 2_000_000, "both", _int_range(0, 1 << 40),
+                  "aggregate inputs above this many rows execute chunked "
+                  "through host RAM (spill analog; reference paging + "
+                  "agg_spill.go)"),
         SysVarDef("tidb_allow_mpp", True, "both", _bool,
                   "allow multi-device fragment plans (reference tidb_allow_mpp)"),
         SysVarDef("tidb_broadcast_join_threshold_size", 1 << 20, "both", _int_range(0, 1 << 34),
