@@ -1,0 +1,77 @@
+"""Metrics, slow-query log, and statement summary.
+
+Reference: pkg/metrics (Prometheus collectors), slow log read back as
+INFORMATION_SCHEMA.SLOW_QUERY (pkg/executor/slow_query.go), and
+per-digest statement summary (statement_summary.go:73). VERDICT round-1
+missing #9.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils.metrics import REGISTRY, sql_digest
+
+
+@pytest.fixture()
+def sess():
+    return Session(Catalog())
+
+
+def test_sql_digest_normalizes_literals():
+    a = sql_digest("SELECT * FROM t WHERE a = 5 AND s = 'x'")
+    b = sql_digest("select  *  from t where a = 99 and s = 'zzz'")
+    assert a == b
+    assert "?" in a and "5" not in a
+
+
+def test_statement_summary_aggregates(sess):
+    # distinctive shape so the digest is unique even though the summary
+    # registry is process-global across the test suite
+    sess.execute("create table obs_t (a bigint, bb bigint)")
+    sess.execute("insert into obs_t values (1, 7),(2, 8)")
+    for i in range(3):
+        sess.execute(f"select sum(a + bb) from obs_t where a > {i}")
+    digest = sql_digest("select sum(a + bb) from obs_t where a > 0")
+    r = sess.must_query(
+        "select exec_count from information_schema.statements_summary "
+        f"where digest_text = '{digest}'"
+    )
+    assert r.rows and r.rows[0][0] >= 3  # three literals, one digest
+
+
+def test_slow_log_threshold(sess):
+    sess.execute("create table t (a bigint)")
+    sess.execute("insert into t values (1)")
+    sess.execute("set tidb_slow_log_threshold = 0")  # log everything
+    sess.execute("select count(*) from t")
+    r = sess.must_query(
+        "select count(*) from information_schema.slow_query "
+        "where query like 'select count%'"
+    )
+    assert r.rows[0][0] >= 1
+    # high threshold: fast statements stay out
+    sess.execute("set tidb_slow_log_threshold = 2000000")
+    before = sess.must_query(
+        "select count(*) from information_schema.slow_query"
+    ).rows[0][0]
+    sess.execute("select count(*) from t")
+    after = sess.must_query(
+        "select count(*) from information_schema.slow_query"
+    ).rows[0][0]
+    assert after == before
+
+
+def test_metrics_counters_and_prometheus_render(sess):
+    sess.execute("create table t (a bigint)")
+    sess.execute("insert into t values (1)")
+    sess.execute("select a from t")
+    sess.execute("select a from t")  # plan cache hit
+    r = sess.must_query(
+        "select value from information_schema.metrics "
+        "where name = 'tidb_tpu_plan_cache_hits_total'"
+    )
+    assert r.rows and r.rows[0][0] >= 1
+    text = REGISTRY.render()
+    assert "# TYPE tidb_tpu_statements_total counter" in text
+    assert "tidb_tpu_query_duration_seconds_count" in text

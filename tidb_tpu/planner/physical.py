@@ -1250,11 +1250,15 @@ class PhysicalExecutor:
         streamed = try_streamed(self, plan)
         if streamed is not None:
             return streamed
+        from tidb_tpu.utils.metrics import REGISTRY
+
         key = self._cache_key(plan)
         cq = self._cache.get(key)
         if cq is not None:
             self._cache.move_to_end(key)
+            REGISTRY.counter("tidb_tpu_plan_cache_hits_total").inc()
         else:
+            REGISTRY.counter("tidb_tpu_plan_cache_misses_total").inc()
             compiler = PlanCompiler(
                 self.catalog, resolver=self._resolve, mesh_n=self.mesh_n
             )

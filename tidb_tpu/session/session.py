@@ -158,7 +158,23 @@ class Session:
         stmts = parse(sql)
         res = Result([], [])
         for s in stmts:
-            res = self._execute_stmt(s)
+            if len(stmts) == 1:
+                # per-statement text; multi-statement batches fall back
+                # to AST-type digests rather than mis-attributing the
+                # whole batch text to each statement
+                try:
+                    s._source_sql = sql
+                except Exception:
+                    pass
+            try:
+                res = self._execute_stmt(s)
+            except Exception:
+                from tidb_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "tidb_tpu_statement_errors_total", "failed statements"
+                ).inc()
+                raise
         return res
 
     # test-kit style helpers (reference pkg/testkit/testkit.go:144,167)
@@ -178,6 +194,15 @@ class Session:
         from tidb_tpu.utils import failpoint
 
         t0 = time.perf_counter()
+        self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
+        try:
+            return self._execute_stmt_inner(s, t0)
+        finally:
+            self._stmt_depth -= 1
+
+    def _execute_stmt_inner(self, s, t0) -> Result:
+        from tidb_tpu.utils import failpoint
+
         self.killer.clear()
         failpoint.inject("session/stmt-start")
         try:
@@ -263,7 +288,31 @@ class Session:
         else:
             raise ValueError(f"unsupported statement {type(s).__name__}")
         r.elapsed_s = time.perf_counter() - t0
+        if self._stmt_depth == 1:
+            # nested statements (TRACE's inner stmt) are not re-observed
+            self._observe_stmt(s, r.elapsed_s)
         return r
+
+    def _observe_stmt(self, s, elapsed_s: float) -> None:
+        """Metrics + slow log + statement summary (reference:
+        pkg/metrics collectors, slow_query.go, stmtsummary)."""
+        from tidb_tpu.utils.metrics import REGISTRY, SLOW_LOG, STMT_SUMMARY
+
+        REGISTRY.counter(
+            "tidb_tpu_statements_total", "statements executed"
+        ).inc()
+        REGISTRY.histogram(
+            "tidb_tpu_query_duration_seconds", "statement latency"
+        ).observe(elapsed_s)
+        sql = getattr(s, "_source_sql", None) or type(s).__name__
+        STMT_SUMMARY.record(sql, elapsed_s)
+        try:
+            v = self.vars.get("tidb_slow_log_threshold")
+            thresh_ms = 300 if v is None else int(v)  # 0 = log everything
+        except Exception:
+            thresh_ms = 300
+        if elapsed_s * 1000.0 >= thresh_ms:
+            SLOW_LOG.record(sql, elapsed_s)
 
     # ------------------------------------------------------------------
     def _run_show(self, s: ast.Show) -> Result:

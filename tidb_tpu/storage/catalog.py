@@ -74,9 +74,17 @@ class Catalog:
     # (reference: pkg/infoschema virtual memtables, interface.go:26 +
     # infoschema_reader.go; synthesized fresh per access so they always
     # reflect the live catalog)
-    _IS_TABLES = ("tables", "columns", "schemata")
+    _IS_TABLES = (
+        "tables", "columns", "schemata", "slow_query",
+        "statements_summary", "metrics",
+    )
 
     def _infoschema_table(self, name: str) -> Table:
+        if name in ("slow_query", "statements_summary", "metrics"):
+            # live diagnostic views: contents change per statement, so
+            # memoizing would serve stale data — rebuilt per access
+            # (diagnostics are rare; cache churn is acceptable there)
+            return self._build_infoschema_table(name)
         # memoized per catalog state: a fresh Table per call would carry
         # a fresh uid, defeating the executor's plan/scan caches and
         # paying a full jit per information_schema statement
@@ -136,6 +144,32 @@ class Catalog:
                 rows = [
                     (db,) for db in sorted(self._dbs) if not db.startswith("_")
                 ]
+        elif name == "slow_query":
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.metrics import SLOW_LOG
+
+            schema = TableSchema(
+                [("time", FLOAT64), ("query", STRING), ("query_time", FLOAT64)]
+            )
+            rows = SLOW_LOG.rows()
+        elif name == "statements_summary":
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.metrics import STMT_SUMMARY
+
+            schema = TableSchema(
+                [("digest_text", STRING), ("exec_count", INT64),
+                 ("sum_latency", FLOAT64), ("max_latency", FLOAT64),
+                 ("sample_text", STRING)]
+            )
+            rows = STMT_SUMMARY.rows()
+        elif name == "metrics":
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.metrics import REGISTRY
+
+            schema = TableSchema(
+                [("name", STRING), ("kind", STRING), ("value", FLOAT64)]
+            )
+            rows = REGISTRY.rows()
         else:
             raise ValueError(f"unknown table information_schema.{name}")
         t = Table(name, schema)

@@ -1,0 +1,182 @@
+"""Metrics, slow-query log, and statement summary.
+
+Reference: pkg/metrics (Prometheus collectors per subsystem, registered
+at cmd/tidb-server/main.go:282), pkg/executor/slow_query.go (the slow
+log read back as INFORMATION_SCHEMA.SLOW_QUERY), and
+pkg/util/stmtsummary/statement_summary.go:73 (per-digest aggregated
+statement stats). Single-process rendering: a plain in-memory registry
+with Prometheus text exposition, a ring-buffer slow log, and a
+digest-keyed summary map — all queryable through information_schema
+virtual tables so the SQL surface matches the reference's.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (seconds)."""
+
+    BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.counts = [0] * (len(self.BUCKETS) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self.BUCKETS):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name, help_)
+            return m
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(name, help_)
+            return m
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: List[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {name} counter")
+                out.append(f"{name} {m.value:g}")
+            else:
+                out.append(f"# TYPE {name} histogram")
+                acc = 0
+                for b, c in zip(m.BUCKETS, m.counts):
+                    acc += c
+                    out.append(f'{name}_bucket{{le="{b:g}"}} {acc}')
+                out.append(f'{name}_bucket{{le="+Inf"}} {m.total}')
+                out.append(f"{name}_sum {m.sum:g}")
+                out.append(f"{name}_count {m.total}")
+        return "\n".join(out) + "\n"
+
+    def rows(self) -> List[Tuple[str, str, float]]:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for name, m in items:
+            if isinstance(m, Counter):
+                out.append((name, "counter", float(m.value)))
+            else:
+                out.append((name + "_count", "histogram", float(m.total)))
+                out.append((name + "_sum", "histogram", float(m.sum)))
+        return out
+
+
+REGISTRY = Registry()
+
+
+def sql_digest(sql: str) -> str:
+    """Normalize a statement for summary grouping: literals -> '?',
+    whitespace collapsed, lowercased keywords (reference: parser
+    digester.go)."""
+    try:
+        from tidb_tpu.parser.sqlparse import tokenize
+
+        parts = []
+        for t in tokenize(sql):
+            if t.kind in ("num", "str"):
+                parts.append("?")
+            elif t.kind == "eof":
+                break
+            else:
+                parts.append(t.text.lower() if t.kind == "kw" else t.text)
+        return " ".join(parts)
+    except Exception:
+        return re.sub(r"\s+", " ", sql.strip())[:512]
+
+
+class SlowLog:
+    """Ring buffer of statements slower than the threshold (reference:
+    slow-query log + INFORMATION_SCHEMA.SLOW_QUERY round trip)."""
+
+    def __init__(self, capacity: int = 256):
+        self._buf = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, sql: str, seconds: float) -> None:
+        with self._lock:
+            self._buf.append((time.time(), sql[:2048], seconds))
+
+    def rows(self) -> List[Tuple[float, str, float]]:
+        with self._lock:
+            return list(self._buf)
+
+
+class StmtSummary:
+    """Per-digest aggregated statement stats (reference:
+    statement_summary.go:73)."""
+
+    def __init__(self, capacity: int = 512):
+        self._capacity = capacity
+        self._map: Dict[str, list] = {}
+        self._lock = threading.Lock()
+
+    def record(self, sql: str, seconds: float) -> None:
+        d = sql_digest(sql)
+        with self._lock:
+            ent = self._map.get(d)
+            if ent is None:
+                if len(self._map) >= self._capacity:
+                    # evict the least-executed digest
+                    victim = min(self._map, key=lambda k: self._map[k][0])
+                    del self._map[victim]
+                ent = self._map[d] = [0, 0.0, 0.0, sql[:256]]
+            ent[0] += 1
+            ent[1] += seconds
+            ent[2] = max(ent[2], seconds)
+
+    def rows(self) -> List[Tuple[str, int, float, float, str]]:
+        with self._lock:
+            return [
+                (d, n, s, mx, sample)
+                for d, (n, s, mx, sample) in sorted(self._map.items())
+            ]
+
+
+SLOW_LOG = SlowLog()
+STMT_SUMMARY = StmtSummary()

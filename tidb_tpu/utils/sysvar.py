@@ -48,6 +48,9 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "smallest row-capacity tile (reference paging min size, paging.go:25)"),
         SysVarDef("tidb_tpu_group_capacity", 1024, "both", _int_range(16, 1 << 24),
                   "initial group-table capacity before overflow retry"),
+        SysVarDef("tidb_slow_log_threshold", 300, "both", _int_range(0, 1 << 31),
+                  "statements slower than this many ms land in the slow "
+                  "log (information_schema.slow_query)"),
         SysVarDef("tidb_tpu_stream_rows", 2_000_000, "both", _int_range(0, 1 << 40),
                   "aggregate inputs above this many rows execute chunked "
                   "through host RAM (spill analog; reference paging + "
