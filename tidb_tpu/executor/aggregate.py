@@ -111,7 +111,7 @@ def _key_components(k: DevCol):
         h = (
             hv
             ^ jnp.asarray(_mix64(hm.astype(jnp.uint64))).astype(jnp.int64)
-            ^ (he * jnp.int64(0x9E3779B97F4A7C15))
+            ^ (he * jnp.int64(-7046029254386353131))  # 0x9E3779B97F4A7C15
         )
         h = h + nanf.astype(jnp.int64)
         return [dd, nanf], h
@@ -262,6 +262,37 @@ def _packed_group_assign(
     return seg, uniq, count, over
 
 
+def _needs_rep(a: AggDesc) -> bool:
+    """DISTINCT changes the result only for sum/avg/count (min/max/first
+    are duplicate-insensitive, reference pkg/executor/aggfuncs)."""
+    return a.distinct and a.func in ("sum", "avg", "count") and a.arg is not None
+
+
+def _distinct_reps(keys, aggs, arg_cols, row_valid, slots):
+    """Per-DISTINCT-agg representative-row masks: one second claim-loop
+    pass per distinct argument over (group keys + argument) dedupes the
+    (group, value) pairs; the pair slot's claiming row is the single
+    contributor. Returns ({agg index: bool mask}, overflow | None).
+    The reference dedupes with per-group hash sets inside each agg
+    function's update path (pkg/executor/aggfuncs count distinct); here
+    the dedup is one more data-parallel probe loop, so the whole
+    DISTINCT aggregation stays a single fused XLA program."""
+    reps = {}
+    over = None
+    cap = row_valid.shape[0]
+    rid = jnp.arange(cap, dtype=jnp.int32)
+    for i, (a, col) in enumerate(zip(aggs, arg_cols)):
+        if not _needs_rep(a) or col is None:
+            continue
+        pseg, pclaimer, _png, pover = group_assign(
+            list(keys) + [col], row_valid, slots
+        )
+        cl = pclaimer[jnp.minimum(pseg, slots - 1)]
+        reps[i] = (pseg < slots) & (cl == rid)
+        over = pover if over is None else (over | pover)
+    return reps, over
+
+
 def group_aggregate(
     batch: Batch,
     key_fns: Sequence[ExprFn],
@@ -292,6 +323,32 @@ def group_aggregate(
 
     keys = [fn(batch) for fn in key_fns]
     arg_cols = [a.arg(batch) if a.arg is not None else None for a in aggs]
+
+    # DISTINCT dedup masks (and their pair-table overflow, folded into the
+    # reported group count so the host's capacity-discovery loop retries
+    # at a larger tile when distinct pairs outgrow the table).
+    # The pair table shares the group-capacity knob: when distinct pairs
+    # far outnumber groups the group table grows along with the pair
+    # table (wasted slots of the same order as the pair table itself, and
+    # the output tile re-shrinks after discovery) — accepted coupling to
+    # keep one capacity signal per plan node; only the multi-distinct
+    # kernel path pays it (single DISTINCT uses the stacked rewrite with
+    # independently-sized nodes, planner/logical._expand_distinct_aggs).
+    reps: dict = {}
+    dover = None
+    pair_slots = _next_pow2(max(2 * group_capacity, 16))
+    if any(_needs_rep(a) for a in aggs):
+        reps, dover = _distinct_reps(
+            keys, aggs, arg_cols, batch.row_valid, pair_slots
+        )
+
+    def fold_distinct_overflow(ngroups):
+        if dover is None:
+            return ngroups
+        return jnp.maximum(
+            ngroups,
+            jnp.where(dover, jnp.int64(pair_slots + 1), jnp.int64(0)),
+        )
 
     packable = (
         keys
@@ -333,9 +390,10 @@ def group_aggregate(
         )
         red = _masked_backend(seg, slots) if slots <= 128 else None
         out = _run_aggs(
-            batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red
+            batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red,
+            reps=reps,
         )
-        return out, ngroups
+        return out, fold_distinct_overflow(ngroups)
 
     if keys:
         slots = _next_pow2(max(2 * group_capacity, 16))
@@ -370,8 +428,11 @@ def group_aggregate(
 
     red = _masked_backend(seg, slots) if slots <= 128 else None
     return (
-        _run_aggs(batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red),
-        ngroups,
+        _run_aggs(
+            batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red,
+            reps=reps,
+        ),
+        fold_distinct_overflow(ngroups),
     )
 
 
@@ -408,15 +469,19 @@ def _masked_backend(seg, slots):
     return red
 
 
-def _run_aggs(batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=None):
+def _run_aggs(
+    batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=None,
+    reps=None,
+):
     """Compute all aggregates into the slot table. One implementation of
     the MySQL aggregate semantics (NULL rules, AVG decimal scale),
-    parameterized over the reduction backend."""
+    parameterized over the reduction backend. `reps` maps agg index to a
+    DISTINCT representative-row mask (_distinct_reps)."""
     if red is None:
         red = _segment_backend(seg, slots)
     srow_valid = seg < slots
     ones = jnp.ones_like(seg, dtype=jnp.int64)
-    for a, col in zip(aggs, arg_cols):
+    for i, (a, col) in enumerate(zip(aggs, arg_cols)):
         if a.func == "count" and col is None:
             s = red("sum", ones, srow_valid, jnp.int64(0))
             out_cols[a.out_name] = DevCol(s, group_valid)
@@ -424,6 +489,8 @@ def _run_aggs(batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=
 
         data = col.data
         valid = col.valid & srow_valid
+        if reps and i in reps:
+            valid = valid & reps[i]
         if a.func == "count":
             s = red("sum", ones, valid, jnp.int64(0))
             out_cols[a.out_name] = DevCol(s, group_valid)

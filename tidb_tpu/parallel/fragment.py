@@ -35,6 +35,19 @@ def _colfn(name: str) -> ExprFn:
     return lambda b: b.cols[name]
 
 
+def _combined_key_hash(cols, cap: int) -> DevCol:
+    """Order-sensitive hash of several key columns for exchange routing.
+    NULLs are canonicalized (data zeroed, validity mixed in) so equal SQL
+    keys — including NULL keys, whose stored data is unspecified — hash
+    identically on every device; otherwise a NULL-key group would split
+    across devices and emit duplicate result rows."""
+    h = jnp.zeros(cap, dtype=jnp.int64)
+    for c in cols:
+        hv = jnp.where(c.valid, c.data.astype(jnp.int64), jnp.int64(0))
+        h = h * jnp.int64(1000003) ^ (hv * 2 + c.valid)
+    return DevCol(h, jnp.ones(cap, dtype=jnp.bool_))
+
+
 def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[str, str, List[str], int]]]:
     """Split aggregates into partial-stage descriptors and final-stage
     combine rules: (final func name, out name, partial col names, scale)."""
@@ -115,6 +128,44 @@ def distributed_group_aggregate(
     global group count upper bound, dropped row count from the
     exchange)."""
     key_names = list(key_names or [f"k{i}" for i in range(len(key_fns))])
+
+    if any(a.distinct for a in aggs):
+        # DISTINCT defeats the partial/final decomposition (partial sums
+        # of duplicated values can't be deduped after the fact). Instead
+        # colocate each group wholly on one device by hash-repartitioning
+        # the RAW rows on the group keys, then run the full aggregation
+        # (with its claim-loop dedup) locally — the reference's
+        # ExchangePartition-then-complete-agg MPP mode
+        # (pkg/planner/core "1-phase" agg under MPP).
+        if key_fns:
+
+            def exch_rows_key(b: Batch) -> DevCol:
+                return _combined_key_hash(
+                    [fn(b) for fn in key_fns], b.capacity
+                )
+
+            B = max(group_capacity, (2 * local.capacity) // n_devices, 16)
+            exchanged, dropped = hash_repartition(
+                local, exch_rows_key, n_devices, B, axis
+            )
+            fin, ng = group_aggregate(
+                exchanged, key_fns, aggs, group_capacity, key_names,
+                key_widths=key_widths,
+            )
+            return Batch(dict(fin.cols), fin.row_valid), jax.lax.psum(ng, axis), dropped
+        # scalar DISTINCT: every device needs every row to dedupe
+        # globally — gather, compute replicated
+        gathered = broadcast_gather(local, axis)
+        fin, ng = group_aggregate(
+            gathered, key_fns, aggs, group_capacity, key_names,
+            key_widths=key_widths,
+        )
+        return (
+            Batch(dict(fin.cols), fin.row_valid),
+            jax.lax.pmax(ng, axis),
+            jnp.zeros((), jnp.int64),
+        )
+
     partial, final = _partial_descs(aggs)
 
     # part_ng carries the partial stage's overflow signal (slots+1 when
@@ -128,12 +179,9 @@ def distributed_group_aggregate(
     if key_fns:
         # exchange partial groups so equal keys colocate
         def exch_key(b: Batch) -> DevCol:
-            h = jnp.zeros(b.capacity, dtype=jnp.int64)
-            valid = jnp.ones(b.capacity, dtype=jnp.bool_)
-            for kn in key_names:
-                c = b.cols[kn]
-                h = h * jnp.int64(1000003) ^ c.data.astype(jnp.int64) * 2 + c.valid
-            return DevCol(h, valid)
+            return _combined_key_hash(
+                [b.cols[kn] for kn in key_names], b.capacity
+            )
 
         exchanged, dropped = hash_repartition(
             part_batch, exch_key, n_devices, group_capacity, axis

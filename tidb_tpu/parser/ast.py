@@ -48,6 +48,9 @@ class AggCall:
     func: str  # sum/count/avg/min/max/group_concat
     arg: Optional[object]  # None for COUNT(*)
     distinct: bool = False
+    separator: str = ","  # GROUP_CONCAT separator
+    # GROUP_CONCAT(... ORDER BY e [DESC], ...): ((expr, desc), ...)
+    order_by: tuple = ()
 
 
 @dataclasses.dataclass

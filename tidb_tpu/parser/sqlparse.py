@@ -60,6 +60,7 @@ KEYWORDS = {
     "delete", "update", "set", "use", "explain", "analyze", "show",
     "tables", "databases", "if", "primary", "key", "div", "mod",
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
+    "group_concat", "separator",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -703,7 +704,7 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return ast.Call(unit, [e])
-        if self.at_kw("count", "sum", "avg", "min", "max"):
+        if self.at_kw("count", "sum", "avg", "min", "max", "group_concat"):
             func = self.advance().text
             self.expect_op("(")
             distinct = self.accept_kw("distinct")
@@ -713,6 +714,35 @@ class Parser:
                     return self._parse_over(func, None)
                 return ast.AggCall("count", None, False)
             arg = self.parse_expr()
+            if func == "group_concat":
+                # GROUP_CONCAT(expr [ORDER BY e [ASC|DESC], ...]
+                #              [SEPARATOR 'sep'])  (MySQL grammar)
+                order_by = []
+                if self.accept_kw("order"):
+                    self.expect_kw("by")
+                    while True:
+                        e = self.parse_expr()
+                        desc = False
+                        if self.accept_kw("desc"):
+                            desc = True
+                        else:
+                            self.accept_kw("asc")
+                        order_by.append((e, desc))
+                        if not self.accept_op(","):
+                            break
+                sep = ","
+                if self.accept_kw("separator"):
+                    tok = self.advance()
+                    if tok.kind != "str":
+                        raise ParseError(
+                            f"SEPARATOR expects a string literal, got {tok.text!r}"
+                        )
+                    sep = tok.text
+                self.expect_op(")")
+                return ast.AggCall(
+                    func, arg, distinct, separator=sep,
+                    order_by=tuple(order_by),
+                )
             self.expect_op(")")
             if self.at_kw("over"):
                 return self._parse_over(func, arg)

@@ -1,0 +1,210 @@
+"""Host-assisted aggregation: GROUP_CONCAT.
+
+String concatenation produces variable-length output — inherently host
+work (the device engine's strings are fixed-width dictionary codes).
+The reference runs GROUP_CONCAT row-at-a-time inside the engine
+(pkg/executor/aggfuncs func_group_concat.go); here the heavy part —
+scanning, filtering, projecting the agg inputs — still runs as one
+fused device program, and only the per-group concatenation loop runs on
+host over the (already reduced) projected columns. The aggregated
+result is injected back into the plan as a Staged node (same mechanism
+as streamed aggregation), so HAVING / ORDER BY / joins above the
+aggregate execute normally on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import (
+    HostBlock,
+    HostColumn,
+    batch_to_block,
+    block_to_batch,
+    encode_strings,
+)
+from tidb_tpu.dtypes import Kind, days_to_date
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.streamed import _STAGED_NONCE, _replace_node, _children
+
+
+def _find_gc_agg(plan) -> Optional[L.Aggregate]:
+    found = None
+
+    def walk(p):
+        nonlocal found
+        for c in _children(p):
+            walk(c)
+        if found is None and isinstance(p, L.Aggregate) and p.gc_meta:
+            found = p
+
+    walk(plan)
+    return found
+
+
+def _format_value(v, t) -> str:
+    """MySQL string rendering of a value inside GROUP_CONCAT."""
+    if t.kind == Kind.DATE:
+        return days_to_date(int(v))
+    if t.kind == Kind.DECIMAL:
+        return f"{v:.{t.scale}f}"
+    if t.kind == Kind.BOOL:
+        return "1" if v else "0"
+    if isinstance(v, float):
+        import math
+
+        if math.isfinite(v) and abs(v) < 1e15 and v == int(v):
+            return str(int(v))
+        return repr(v)
+    return str(v)
+
+
+def try_host_agg(executor, plan):
+    """Execute `plan` when it contains a GROUP_CONCAT aggregate:
+    device-run the aggregate's input projection, host-reduce the groups
+    (all aggregates of the node in one pass), stage the result, re-run
+    the remaining plan. Returns None when no GROUP_CONCAT is present."""
+    agg = _find_gc_agg(plan)
+    if agg is None:
+        return None
+
+    gc_meta = agg.gc_meta or {}
+
+    # ---- 1. device-side projection of everything the reduction needs
+    exprs: List[Tuple[str, object]] = []
+    for n, e in agg.group_exprs:
+        exprs.append((n, e))
+    argname: Dict[int, str] = {}
+    for i, (_n, _f, a, _d) in enumerate(agg.aggs):
+        if a is not None:
+            argname[i] = f"_x{i}"
+            exprs.append((f"_x{i}", a))
+    ordnames: Dict[str, List[Tuple[str, bool]]] = {}
+    for name, (_sep, obs) in gc_meta.items():
+        lst = []
+        for j, (e, desc) in enumerate(obs):
+            nm = f"_o_{name}_{j}"
+            exprs.append((nm, e))
+            lst.append((nm, desc))
+        ordnames[name] = lst
+    outc = [L.OutCol(None, nm, nm, e.type) for nm, e in exprs]
+    sub = L.Projection(L.Schema(outc), agg.child, list(exprs))
+    batch, dicts = executor.run(sub)
+    types = {nm: e.type for nm, e in exprs}
+    block = batch_to_block(batch, types, dicts)
+    decoded = {nm: block.columns[nm].decode() for nm, _ in exprs}
+
+    # ---- 2. host group-by reduction
+    keys = [n for n, _ in agg.group_exprs]
+    groups: Dict[tuple, int] = {}
+    order: List[tuple] = []
+    rows_of: List[List[int]] = []
+    for r in range(block.nrows):
+        k = tuple(decoded[n][r] for n in keys)
+        gi = groups.get(k)
+        if gi is None:
+            gi = groups[k] = len(order)
+            order.append(k)
+            rows_of.append([])
+        rows_of[gi].append(r)
+    if not keys and not order:
+        # scalar aggregate over empty input still yields one row
+        order.append(())
+        rows_of.append([])
+
+    out_vals: Dict[str, List] = {n: [] for n in keys}
+    for i, (name, _f, _a, _d) in enumerate(agg.aggs):
+        out_vals[name] = []
+    for gi, k in enumerate(order):
+        for n, kv in zip(keys, k):
+            out_vals[n].append(kv)
+        rs = rows_of[gi]
+        for i, (name, func, a, distinct) in enumerate(agg.aggs):
+            if func == "count" and a is None:
+                out_vals[name].append(len(rs))
+                continue
+            col = decoded[argname[i]]
+            vals = [(col[r], r) for r in rs if col[r] is not None]
+            if func == "group_concat":
+                sep, _obs = gc_meta[name]
+                obs = ordnames[name]
+                if obs:
+                    import functools
+
+                    def cmp(x, y, _obs=obs):
+                        for nm, desc in _obs:
+                            ax, ay = decoded[nm][x[1]], decoded[nm][y[1]]
+                            # MySQL sorts NULLs first ascending
+                            kx = (ax is not None, ax)
+                            ky = (ay is not None, ay)
+                            if kx != ky:
+                                lt = kx < ky
+                                return (1 if desc else -1) if lt else (-1 if desc else 1)
+                        return 0
+
+                    vals = sorted(vals, key=functools.cmp_to_key(cmp))
+                if distinct:
+                    seen = set()
+                    vals = [
+                        v for v in vals
+                        if not (v[0] in seen or seen.add(v[0]))
+                    ]
+                at = types[argname[i]]
+                out_vals[name].append(
+                    sep.join(_format_value(v, at) for v, _r in vals)
+                    if vals
+                    else None
+                )
+                continue
+            vs = [v for v, _r in vals]
+            if distinct:
+                vs = list(dict.fromkeys(vs))
+            if func == "count":
+                out_vals[name].append(len(vs))
+            elif not vs:
+                out_vals[name].append(None)
+            elif func == "sum":
+                out_vals[name].append(sum(vs))
+            elif func == "avg":
+                out_vals[name].append(sum(vs) / len(vs))
+            elif func == "min":
+                out_vals[name].append(min(vs))
+            elif func == "max":
+                out_vals[name].append(max(vs))
+            else:
+                raise NotImplementedError(f"host agg {func}")
+
+    # ---- 3. stage the reduced table back onto the device
+    cols: Dict[str, HostColumn] = {}
+    sdicts = {}
+    for c in agg.schema:
+        vals = out_vals[c.internal]
+        t = c.type
+        if t.kind == Kind.STRING:
+            hc = encode_strings([v for v in vals])
+            hc = HostColumn(t, hc.data, hc.valid, hc.dictionary)
+            sdicts[c.internal] = hc.dictionary
+        else:
+            valid = np.array([v is not None for v in vals], dtype=bool)
+            if t.kind == Kind.DECIMAL:
+                data = np.array(
+                    [0 if v is None else int(round(v * 10**t.scale)) for v in vals],
+                    dtype=np.int64,
+                )
+            else:
+                data = np.array(
+                    [0 if v is None else v for v in vals],
+                    dtype=t.np_dtype,
+                )
+            hc = HostColumn(t, data, valid)
+        cols[c.internal] = hc
+    result = block_to_batch(HostBlock(cols, len(order)))
+
+    _STAGED_NONCE[0] += 1
+    staged = L.Staged(
+        agg.schema, batch=result, dicts=sdicts, nonce=_STAGED_NONCE[0]
+    )
+    new_plan = staged if plan is agg else _replace_node(plan, agg, staged)
+    return executor.run(new_plan)

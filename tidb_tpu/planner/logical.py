@@ -125,6 +125,11 @@ class Aggregate(LogicalPlan):
     child: LogicalPlan
     group_exprs: List[Tuple[str, Expr]]  # (internal key name, bound expr)
     aggs: List[Tuple[str, str, Optional[Expr], bool]]  # (name, func, arg, distinct)
+    # GROUP_CONCAT extras per agg name: (separator, ((bound expr, desc), ...)).
+    # Presence of any entry routes the node through the host-assisted
+    # aggregation stage (planner/hostagg.py) — string concatenation is
+    # inherently host work (variable-length output).
+    gc_meta: Optional[Dict[str, Tuple[str, tuple]]] = None
 
 
 @dataclasses.dataclass
@@ -846,8 +851,11 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         for _n, _f, a, _d in plan.aggs:
             if a is not None:
                 need |= walk_columns(a)
+        for _sep, obs in (plan.gc_meta or {}).values():
+            for e, _desc in obs:
+                need |= walk_columns(e)
         child = prune_plan(plan.child, need)
-        return Aggregate(plan.schema, child, plan.group_exprs, plan.aggs)
+        return dataclasses.replace(plan, child=child)
     if isinstance(plan, JoinPlan):
         lcols = {c.internal for c in plan.left.schema.cols}
         rcols = {c.internal for c in plan.right.schema.cols}
@@ -1585,7 +1593,8 @@ def _build_aggregate(b, plan, group_by, agg_calls):
 
     aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
     seen: Dict[str, str] = {}
-    from tidb_tpu.dtypes import FLOAT64, DECIMAL
+    gc_meta: Dict[str, Tuple[str, tuple]] = {}
+    from tidb_tpu.dtypes import FLOAT64, DECIMAL, STRING
 
     for call in agg_calls:
         key = _ast_key(call)
@@ -1599,6 +1608,12 @@ def _build_aggregate(b, plan, group_by, agg_calls):
             t = FLOAT64
         elif call.func in ("min", "max", "sum"):
             t = arg.type
+        elif call.func == "group_concat":
+            t = STRING
+            gc_meta[name] = (
+                call.separator,
+                tuple((binder.bind(e), d) for e, d in call.order_by),
+            )
         else:
             raise PlanError(f"unsupported aggregate {call.func}")
         aggs.append((name, call.func, arg, call.distinct))
@@ -1609,8 +1624,23 @@ def _build_aggregate(b, plan, group_by, agg_calls):
         t = next(t for (nn, t) in rewrite.values() if nn == n)
         out_cols.append(OutCol(None, n, n, t))
 
-    if any(d for (_n, _f, _a, d) in aggs):
-        agg_plan = _expand_distinct_aggs(plan, group_exprs, aggs, out_cols)
+    if gc_meta:
+        # GROUP_CONCAT runs host-assisted (hostagg.py) which computes
+        # every aggregate of the node in one pass — DISTINCT included, so
+        # no stacked rewrite
+        agg_plan = Aggregate(
+            Schema(out_cols), plan, group_exprs, aggs, gc_meta=gc_meta
+        )
+    elif any(d for (_n, _f, _a, d) in aggs):
+        d_args = {repr(a) for (_n, _f, a, d) in aggs if d}
+        if len(d_args) > 1:
+            # multiple different DISTINCT arguments: the stacked-rewrite
+            # trick needs one shared dedup key, so fall through to the
+            # kernel's per-agg representative-row dedup
+            # (executor/aggregate._distinct_reps)
+            agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
+        else:
+            agg_plan = _expand_distinct_aggs(plan, group_exprs, aggs, out_cols)
     else:
         agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
     return agg_plan, rewrite
@@ -1625,22 +1655,23 @@ def _expand_distinct_aggs(plan, group_exprs, aggs, out_cols):
     pass is one more fused XLA reduction, so the rewrite is free of
     per-row set probes and reuses the scatter-free group-by kernels.
     """
+    from tidb_tpu.dtypes import FLOAT64
     from tidb_tpu.expression.expr import ColumnRef
 
     d_args = {}
     for (_n, _f, a, d) in aggs:
         if d:
             d_args[repr(a)] = a
-    if len(d_args) > 1:
-        raise PlanError(
-            "multiple different DISTINCT aggregate arguments not supported"
-        )
+    assert len(d_args) == 1, "multi-distinct handled by the kernel path"
     dx = next(iter(d_args.values()))
     dname = "_dx"
 
     inner_groups = list(group_exprs) + [(dname, dx)]
     inner_aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
     final_aggs: List[Tuple[str, str, Optional[Expr], bool]] = []
+    # (out name, Σsum col, Σcount col, arg type) for non-distinct AVGs:
+    # re-assembled as a division in a Projection above the outer agg
+    avg_fixups: List[Tuple[str, str, str, SQLType]] = []
     for (name, func, arg, d) in aggs:
         if d:
             # duplicates are collapsed by the inner group-by; COUNT/SUM/AVG
@@ -1655,6 +1686,18 @@ def _expand_distinct_aggs(plan, group_exprs, aggs, out_cols):
         elif func in ("sum", "min", "max"):
             inner_aggs.append((pn, func, arg, False))
             final_aggs.append((name, func, ColumnRef(arg.type, pn), False))
+        elif func == "avg":
+            # AVG across the two stacked aggregates = Σ(partial sums) /
+            # Σ(partial counts); the division happens in a Projection on
+            # top (the reference's partial/final avg split,
+            # pkg/executor/aggfuncs avg partial result)
+            cn = f"_p{len(inner_aggs) + 1}"
+            inner_aggs.append((pn, "sum", arg, False))
+            inner_aggs.append((cn, "count", arg, False))
+            fs, fc = f"_fs{name}", f"_fc{name}"
+            final_aggs.append((fs, "sum", ColumnRef(arg.type, pn), False))
+            final_aggs.append((fc, "sum", ColumnRef(INT64, cn), False))
+            avg_fixups.append((name, fs, fc, arg.type))
         else:
             raise PlanError(
                 f"{func.upper()} cannot be combined with DISTINCT aggregates"
@@ -1667,4 +1710,30 @@ def _expand_distinct_aggs(plan, group_exprs, aggs, out_cols):
     inner = Aggregate(Schema(inner_cols), plan, inner_groups, inner_aggs)
 
     final_groups = [(n, ColumnRef(e.type, n)) for n, e in group_exprs]
-    return Aggregate(Schema(out_cols), inner, final_groups, final_aggs)
+    if not avg_fixups:
+        return Aggregate(Schema(out_cols), inner, final_groups, final_aggs)
+
+    outer_cols = [OutCol(None, n, n, e.type) for n, e in final_groups]
+    for (n, f, a, _d) in final_aggs:
+        t = INT64 if f == "count" else a.type
+        outer_cols.append(OutCol(None, n, n, t))
+    outer = Aggregate(Schema(outer_cols), inner, final_groups, final_aggs)
+
+    fix = {name: (fs, fc, t) for name, fs, fc, t in avg_fixups}
+    proj_exprs: List[Tuple[str, Expr]] = []
+    for oc in out_cols:
+        if oc.name in fix:
+            fs, fc, at = fix[oc.name]
+            proj_exprs.append(
+                (
+                    oc.name,
+                    Func(
+                        type=FLOAT64,
+                        op="div",
+                        args=(ColumnRef(at, fs), ColumnRef(INT64, fc)),
+                    ),
+                )
+            )
+        else:
+            proj_exprs.append((oc.name, ColumnRef(oc.type, oc.name)))
+    return Projection(Schema(out_cols), outer, proj_exprs)

@@ -252,8 +252,6 @@ def build_agg_parts(plan: "L.Aggregate", dicts):
     key_names = [n for n, _ in plan.group_exprs]
     descs = []
     for name, func, arg, distinct in plan.aggs:
-        if distinct:
-            raise ExecError("DISTINCT aggregates not yet supported")
         fn = compile_expr(arg, dicts) if arg is not None else None
         scale = (
             arg.type.scale
@@ -264,7 +262,13 @@ def build_agg_parts(plan: "L.Aggregate", dicts):
         # int64 accumulation at SF100 row counts: use the dual-lane
         # wide accumulator (AggDesc.wide)
         wide = func in ("sum", "avg") and scale >= 4
-        descs.append(AggDesc(func, fn, name, arg_scale=scale, wide=wide))
+        # DISTINCT is a no-op for min/max (duplicate-insensitive); for
+        # sum/avg/count the kernel dedupes via representative-row masks
+        # (executor/aggregate._distinct_reps)
+        d = bool(distinct) and func in ("sum", "avg", "count") and arg is not None
+        descs.append(
+            AggDesc(func, fn, name, distinct=d, arg_scale=scale, wide=wide)
+        )
     key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
     return key_fns, key_names, key_widths, descs
 
@@ -1245,8 +1249,12 @@ class PhysicalExecutor:
                 return out, caps
 
     def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
+        from tidb_tpu.planner.hostagg import try_host_agg
         from tidb_tpu.planner.streamed import try_streamed
 
+        hosted = try_host_agg(self, plan)
+        if hosted is not None:
+            return hosted
         streamed = try_streamed(self, plan)
         if streamed is not None:
             return streamed
@@ -1320,6 +1328,23 @@ class PhysicalExecutor:
 
     def run_analyze(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts, List[str]]:
         """EXPLAIN ANALYZE: instrumented single run with per-node stats."""
+        from tidb_tpu.planner.hostagg import _find_gc_agg, try_host_agg
+
+        if _find_gc_agg(plan) is not None:
+            # GROUP_CONCAT aggregates execute host-assisted — per-node
+            # device instrumentation doesn't apply; report the plan shape
+            # with timing of the whole statement instead of crashing in
+            # the device compiler (which has no string-concat kernel)
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out, dicts = try_host_agg(self, plan)
+            dt = (_time.perf_counter() - t0) * 1000
+            lines = [
+                f"HostAssistedAggregate(GROUP_CONCAT)  time={dt:.2f}ms "
+                "(per-node stats unavailable on the host-assisted path)"
+            ]
+            return out, dicts, lines
         compiler = PlanCompiler(self.catalog, instrument=True, resolver=self._resolve)
         cq = compiler.compile(plan)
         inputs = self._fetch_inputs(cq)  # unsharded: eager single-device

@@ -139,6 +139,10 @@ def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
     site = comp.scans[0]
 
     key_fns, key_names, key_widths, descs = build_agg_parts(agg, dicts)
+    if any(a.distinct for a in descs):
+        # DISTINCT can't be split into partial sums across chunks (dedup
+        # must see all rows of a group at once): run unpaged
+        return None
     partial, final = _partial_descs(descs)
 
     for _ in range(8):
