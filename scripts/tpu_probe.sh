@@ -1,0 +1,41 @@
+#!/bin/bash
+# Background TPU-tunnel prober: when the flaky axon tunnel comes back,
+# capture real-TPU bench measurements (bench.py caches them in
+# BENCH_TPU_CACHE.json for the round-end driver run). Exits once all
+# target configs have cached TPU results.
+cd /root/repo
+LOG=/tmp/tpu_probe.log
+echo "$(date +%T) prober start" >> $LOG
+for i in $(seq 1 40); do
+  # fast liveness probe: devices() within 150s means the tunnel is up
+  if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date +%T) tunnel UP (probe $i)" >> $LOG
+    for spec in "q1 1" "q6 10" "q18 1"; do
+      set -- $spec
+      if python - "$1" "$2" <<'PY'
+import json, sys
+try:
+    c = json.load(open("BENCH_TPU_CACHE.json"))
+    sys.exit(0 if f"{sys.argv[1]}_sf{sys.argv[2]}" in c else 1)
+except Exception:
+    sys.exit(1)
+PY
+      then echo "$(date +%T) $1 sf$2 already cached" >> $LOG; continue; fi
+      echo "$(date +%T) running bench $1 sf$2" >> $LOG
+      TIDB_TPU_BENCH_TIMEOUT=1500 timeout 1800 python bench.py --query "$1" --sf "$2" >> $LOG 2>&1
+    done
+    if python - <<'PY'
+import json, sys
+try:
+    c = json.load(open("BENCH_TPU_CACHE.json"))
+    sys.exit(0 if all(k in c for k in ("q1_sf1","q6_sf10","q18_sf1")) else 1)
+except Exception:
+    sys.exit(1)
+PY
+    then echo "$(date +%T) all configs cached; prober done" >> $LOG; exit 0; fi
+  else
+    echo "$(date +%T) tunnel down (probe $i)" >> $LOG
+  fi
+  sleep 600
+done
+echo "$(date +%T) prober gave up" >> $LOG

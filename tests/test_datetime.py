@@ -1,0 +1,157 @@
+"""DATETIME / TIME types and temporal builtins.
+
+Reference: pkg/types/time.go (coreTime, AddDate), pkg/types Duration, and
+the builtin time family (pkg/expression/builtin_time_vec.go). Device
+layout: DATETIME = int64 micros since epoch, TIME = signed int64 micros;
+comparisons/sorts/interval arithmetic are plain int64 ops, calendar math
+uses the branchless civil-date kernels.
+"""
+
+import pytest
+
+from tidb_tpu.dtypes import Kind, micros_to_datetime, micros_to_time
+from tidb_tpu.session.session import Session
+
+
+def _fmt(r):
+    rows = []
+    for row in r.rows:
+        out = []
+        for v, t in zip(row, r.types or [None] * len(row)):
+            if t is not None and t.kind == Kind.DATETIME and isinstance(v, int):
+                out.append(micros_to_datetime(v))
+            elif t is not None and t.kind == Kind.TIME and isinstance(v, int):
+                out.append(micros_to_time(v))
+            else:
+                out.append(v)
+        rows.append(tuple(out))
+    return rows
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create table e (id int, ts datetime, d date, t time)")
+    s.execute(
+        "insert into e values "
+        "(1,'2024-02-29 13:45:30','2024-02-29','13:45:30'),"
+        "(2,'2024-03-01 00:00:00','2024-03-01','00:00:01'),"
+        "(3,'1969-12-31 23:59:59','1969-12-31','23:59:59'),"
+        "(4,null,null,null)"
+    )
+    return s
+
+
+def test_extract_parts(s):
+    r = s.execute(
+        "select id, year(ts), month(ts), day(ts), hour(ts), minute(ts), "
+        "second(ts) from e where id=1"
+    )
+    assert r.rows == [(1, 2024, 2, 29, 13, 45, 30)]
+
+
+def test_pre_epoch_time_parts(s):
+    # negative micros: floor-div/mod keep calendar semantics
+    r = s.execute("select year(ts), hour(ts), second(ts) from e where id=3")
+    assert r.rows == [(1969, 23, 59)]
+
+
+def test_string_literal_coercion(s):
+    assert s.execute(
+        "select id from e where ts >= '2024-03-01' order by id"
+    ).rows == [(2,)]
+    assert s.execute(
+        "select id from e where ts > '2024-02-29 13:00:00' order by id"
+    ).rows == [(1,), (2,)]
+
+
+def test_date_vs_datetime_comparison(s):
+    # DATE promotes to midnight: true whenever ts has a time-of-day
+    assert s.execute("select id from e where d < ts order by id").rows == [
+        (1,),
+        (3,),
+    ]
+    assert s.execute(
+        "select id, date(ts) = d from e where id in (1,2) order by id"
+    ).rows == [(1, True), (2, True)]
+
+
+def test_time_column_parts(s):
+    assert s.execute(
+        "select id, hour(t), minute(t), second(t) from e where id=3"
+    ).rows == [(3, 23, 59, 59)]
+
+
+def test_interval_arithmetic(s):
+    assert _fmt(s.execute("select ts + interval 1 day from e where id=1")) == [
+        ("2024-03-01 13:45:30",)
+    ]
+    assert _fmt(s.execute("select ts + interval 2 hour from e where id=3")) == [
+        ("1970-01-01 01:59:59",)
+    ]
+    assert _fmt(
+        s.execute("select date_add(ts, interval 1 month) from e where id=1")
+    ) == [("2024-03-29 13:45:30",)]
+    assert _fmt(
+        s.execute("select date_sub(ts, interval 90 minute) from e where id=2")
+    ) == [("2024-02-29 22:30:00",)]
+
+
+def test_casts(s):
+    assert _fmt(
+        s.execute("select cast('2021-05-06 07:08:09' as datetime) from e where id=1")
+    ) == [("2021-05-06 07:08:09",)]
+    assert _fmt(s.execute("select cast(d as datetime) from e where id=2")) == [
+        ("2024-03-01 00:00:00",)
+    ]
+    assert s.execute(
+        "select cast(ts as date) = d from e where id=1"
+    ).rows == [(True,)]
+
+
+def test_aggregates_and_order(s):
+    assert _fmt(s.execute("select max(ts), min(ts) from e")) == [
+        ("2024-03-01 00:00:00", "1969-12-31 23:59:59")
+    ]
+    assert s.execute(
+        "select id from e where ts is not null order by ts desc limit 1"
+    ).rows == [(2,)]
+    assert s.execute("select count(*) from e where ts is null").rows == [(1,)]
+
+
+def test_group_by_datetime(s):
+    s.execute("insert into e values (5,'2024-02-29 13:45:30','2024-02-29','13:45:30')")
+    r = s.execute(
+        "select ts, count(*) from e where ts is not null "
+        "group by ts order by ts limit 1"
+    )
+    assert r.rows[0][1] == 1  # 1969 row is unique
+
+
+def test_datediff_mixed(s):
+    assert s.execute("select datediff(ts, d) from e where id=1").rows == [(0,)]
+
+
+def test_now_is_datetime():
+    s = Session()
+    r = s.execute("select now() >= '2026-01-01 00:00:00'")
+    assert r.rows == [(True,)]
+
+
+def test_mesh_parity():
+    q = (
+        "select d, count(*), max(ts) from e where ts is not null "
+        "group by d order by d"
+    )
+    rows = []
+    for mesh in (None, 8):
+        s = Session(mesh_devices=mesh)
+        s.execute("create table e (id int, ts datetime, d date)")
+        s.execute(
+            "insert into e values "
+            "(1,'2024-02-29 13:45:30','2024-02-29'),"
+            "(2,'2024-02-29 15:00:00','2024-02-29'),"
+            "(3,'2024-03-01 00:00:00','2024-03-01')"
+        )
+        rows.append(s.execute(q).rows)
+    assert rows[0] == rows[1]

@@ -114,6 +114,26 @@ def column_from_values(values: List, typ: SQLType) -> HostColumn:
             [date_to_days(v) if isinstance(v, str) else (v or 0) for v in values],
             dtype=np.int32,
         )
+    elif typ.kind == Kind.DATETIME:
+        from tidb_tpu.dtypes import datetime_to_micros
+
+        data = np.array(
+            [
+                datetime_to_micros(v) if isinstance(v, str) else (v or 0)
+                for v in values
+            ],
+            dtype=np.int64,
+        )
+    elif typ.kind == Kind.TIME:
+        from tidb_tpu.dtypes import time_to_micros
+
+        data = np.array(
+            [
+                time_to_micros(v) if isinstance(v, str) else (v or 0)
+                for v in values
+            ],
+            dtype=np.int64,
+        )
     else:
         data = np.array([v if v is not None else 0 for v in values], dtype=typ.np_dtype)
     return HostColumn(typ, data, valid)

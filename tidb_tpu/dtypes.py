@@ -32,6 +32,13 @@ class Kind(enum.Enum):
     FLOAT = "float"
     BOOL = "bool"
     DATE = "date"
+    # DATETIME/TIMESTAMP: int64 microseconds since the unix epoch (the
+    # reference packs year..microsecond into a uint64 coreTime,
+    # pkg/types/time.go; a flat micro count is the TPU-friendly layout —
+    # comparisons, sorts, and interval arithmetic are plain int64 ops)
+    DATETIME = "datetime"
+    # TIME (duration): int64 microseconds, signed (pkg/types Duration)
+    TIME = "time"
     DECIMAL = "decimal"
     STRING = "string"
     NULL = "null"  # type of bare NULL literal before coercion
@@ -50,6 +57,8 @@ class SQLType:
             Kind.FLOAT: np.dtype(np.float64),
             Kind.BOOL: np.dtype(np.bool_),
             Kind.DATE: np.dtype(np.int32),
+            Kind.DATETIME: np.dtype(np.int64),
+            Kind.TIME: np.dtype(np.int64),
             Kind.DECIMAL: np.dtype(np.int64),
             Kind.STRING: np.dtype(np.int32),
             Kind.NULL: np.dtype(np.int64),
@@ -73,8 +82,13 @@ INT64 = SQLType(Kind.INT)
 FLOAT64 = SQLType(Kind.FLOAT)
 BOOL = SQLType(Kind.BOOL)
 DATE = SQLType(Kind.DATE)
+DATETIME = SQLType(Kind.DATETIME)
+TIME = SQLType(Kind.TIME)
 STRING = SQLType(Kind.STRING)
 NULLTYPE = SQLType(Kind.NULL)
+
+US_PER_DAY = 86_400_000_000
+US_PER_SECOND = 1_000_000
 
 
 def DECIMAL(scale: int) -> SQLType:
@@ -95,6 +109,10 @@ def common_type(a: SQLType, b: SQLType) -> SQLType:
     if a == b:
         return a
     kinds = {a.kind, b.kind}
+    if kinds == {Kind.DATE, Kind.DATETIME}:
+        # comparing a DATE with a DATETIME promotes the date to midnight
+        # (MySQL temporal comparison, pkg/types/time.go Compare)
+        return DATETIME
     if Kind.FLOAT in kinds:
         return FLOAT64
     if Kind.DECIMAL in kinds:
@@ -102,6 +120,10 @@ def common_type(a: SQLType, b: SQLType) -> SQLType:
     if kinds <= {Kind.INT, Kind.BOOL}:
         return INT64
     if Kind.DATE in kinds and Kind.INT in kinds:
+        return INT64
+    if Kind.DATETIME in kinds and Kind.INT in kinds:
+        return INT64
+    if Kind.TIME in kinds and Kind.INT in kinds:
         return INT64
     if Kind.STRING in kinds:
         # string vs numeric comparison: coerce via float (MySQL semantics),
@@ -117,3 +139,49 @@ def date_to_days(s: str) -> int:
 
 def days_to_date(d: int) -> str:
     return str(np.datetime64("1970-01-01", "D") + int(d))
+
+
+def datetime_to_micros(s: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> int64 microseconds since epoch."""
+    s = s.strip().replace(" ", "T")
+    if "T" not in s:
+        s += "T00:00:00"
+    return int(
+        (np.datetime64(s, "us") - np.datetime64("1970-01-01T00:00:00", "us"))
+        .astype(np.int64)
+    )
+
+
+def micros_to_datetime(us: int) -> str:
+    """int64 micros -> 'YYYY-MM-DD HH:MM:SS[.ffffff]' (MySQL text form)."""
+    dt = np.datetime64("1970-01-01T00:00:00", "us") + np.timedelta64(int(us), "us")
+    txt = str(dt).replace("T", " ")
+    if txt.endswith(".000000"):
+        txt = txt[:-7]
+    return txt
+
+
+def time_to_micros(s: str) -> int:
+    """'[-]HH:MM:SS[.ffffff]' -> signed int64 microseconds (Duration)."""
+    s = s.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = s.split(":")
+    if len(parts) == 2:
+        parts = parts + ["0"]
+    h, m = int(parts[0]), int(parts[1])
+    sec = float(parts[2])
+    us = ((h * 60 + m) * 60) * US_PER_SECOND + int(round(sec * US_PER_SECOND))
+    return -us if neg else us
+
+
+def micros_to_time(us: int) -> str:
+    us = int(us)
+    sign = "-" if us < 0 else ""
+    us = abs(us)
+    h, rem = divmod(us, 3600 * US_PER_SECOND)
+    m, rem = divmod(rem, 60 * US_PER_SECOND)
+    s, frac = divmod(rem, US_PER_SECOND)
+    base = f"{sign}{h:02d}:{m:02d}:{s:02d}"
+    return f"{base}.{frac:06d}" if frac else base

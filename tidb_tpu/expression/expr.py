@@ -103,13 +103,34 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
 
 
 def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
-    """MySQL coerces date-string literals when compared with DATE columns:
-    `d < '1995-01-01'` compares as dates, not strings."""
+    """MySQL coerces temporal-string literals when compared with temporal
+    columns: `d < '1995-01-01'` compares as dates (and datetimes / times),
+    not strings."""
     if op not in COMPARE and op not in {"in", "add", "sub", "datediff"}:
         return args
-    if not any(a.type is not None and a.type.kind == Kind.DATE for a in args):
+    kinds = {a.type.kind for a in args if a.type is not None}
+    temporal = kinds & {Kind.DATE, Kind.DATETIME, Kind.TIME}
+    if not temporal:
         return args
-    from tidb_tpu.dtypes import date_to_days
+    from tidb_tpu.dtypes import (
+        DATETIME,
+        TIME,
+        date_to_days,
+        datetime_to_micros,
+        time_to_micros,
+    )
+
+    # target temporal kind: DATETIME wins over DATE; TIME only vs TIME
+    if Kind.DATETIME in temporal:
+        conv = lambda s: Literal(type=DATETIME, value=int(datetime_to_micros(s)))
+    elif Kind.DATE in temporal:
+        conv = lambda s: (
+            Literal(type=DATETIME, value=int(datetime_to_micros(s)))
+            if (" " in s.strip() or "T" in s)
+            else Literal(type=DATE, value=int(date_to_days(s)))
+        )
+    else:
+        conv = lambda s: Literal(type=TIME, value=int(time_to_micros(s)))
 
     out = []
     for a in args:
@@ -119,7 +140,7 @@ def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
             and a.type.kind == Kind.STRING
             and isinstance(a.value, str)
         ):
-            out.append(Literal(type=DATE, value=int(date_to_days(a.value))))
+            out.append(conv(a.value))
         else:
             out.append(a)
     return tuple(out)
@@ -136,10 +157,22 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         return declared
     if op in {"add", "sub"}:
         t = common_type(ts[0], ts[1])
-        # DATE +/- INT days stays DATE.
+        # DATETIME +/- INT days stays DATETIME; DATE +/- INT stays DATE.
+        if Kind.DATETIME in (ts[0].kind, ts[1].kind):
+            return SQLType(Kind.DATETIME)
         if Kind.DATE in (ts[0].kind, ts[1].kind):
             return DATE
+        if Kind.TIME in (ts[0].kind, ts[1].kind):
+            return SQLType(Kind.TIME)
         return t
+    if op == "add_us":
+        # sub-day interval arithmetic always yields DATETIME for
+        # date/datetime bases, TIME for time bases
+        if ts[0].kind == Kind.TIME:
+            return SQLType(Kind.TIME)
+        return SQLType(Kind.DATETIME)
+    if op == "date_part_days":
+        return DATE
     if op == "mul":
         t = common_type(ts[0], ts[1])
         if t.kind == Kind.DECIMAL:
@@ -170,7 +203,8 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         return t
     if op in {
         "year", "month", "day", "dayofweek", "weekday", "dayofyear",
-        "quarter", "length", "char_length", "ascii", "locate", "sign",
+        "quarter", "hour", "minute", "second", "microsecond",
+        "length", "char_length", "ascii", "locate", "sign",
         "datediff", "floor", "ceil",
     }:
         return INT64
@@ -188,6 +222,8 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         return FLOAT64
     if op == "abs":
         return ts[0]
+    if op == "add_months" and ts[0] is not None and ts[0].kind == Kind.DATETIME:
+        return SQLType(Kind.DATETIME)
     if op == "add_months":
         return DATE
     if op in {"greatest", "least"}:

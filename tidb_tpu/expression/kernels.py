@@ -64,6 +64,16 @@ def _numeric_align(a, ta: SQLType, b, tb: SQLType, target: SQLType):
         sa = ta.scale if ta.kind == Kind.DECIMAL else 0
         sb = tb.scale if tb.kind == Kind.DECIMAL else 0
         return _rescale(a, target.scale - sa), _rescale(b, target.scale - sb)
+    if target.kind == Kind.DATETIME:
+        # DATE promotes to midnight micros; an INT operand is a day count
+        # (INTERVAL n DAY lowers to add(base, n)) and scales the same way
+        from tidb_tpu.dtypes import US_PER_DAY
+
+        def _cv(x, t):
+            x = x.astype(jnp.int64)
+            return x if t.kind == Kind.DATETIME else x * US_PER_DAY
+
+        return _cv(a, ta), _cv(b, tb)
     # INT-ish: keep 64-bit (DATE int32 promotes)
     return a.astype(jnp.int64), b.astype(jnp.int64)
 
@@ -441,15 +451,48 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         "year", "month", "day", "dayofweek", "weekday", "dayofyear", "quarter",
     ):
         return _compile_extract(e, dicts)
+    if op in ("hour", "minute", "second", "microsecond"):
+        return _compile_time_part(e, dicts)
     if op == "add_months":
         return _compile_add_months(e, dicts)
+    if op == "add_us":
+        # DATETIME/TIME +/- a literal microsecond count (sub-day INTERVAL
+        # units lower to this; DATE operands promote to midnight)
+        fa, fb = (_compile(a, dicts) for a in e.args)
+        ta = e.args[0].type
+
+        def _aus(b):
+            a, c = fa(b), fb(b)
+            return DevCol(
+                _to_micros(a.data, ta) + c.data.astype(jnp.int64),
+                a.valid & c.valid,
+            )
+
+        return _aus
+    if op == "date_part_days":
+        # DATE(datetime_expr): truncate micros to days
+        (f,) = [_compile(a, dicts) for a in e.args]
+        st = e.args[0].type
+
+        def _dpd(b):
+            c = f(b)
+            if st is not None and st.kind == Kind.DATETIME:
+                from tidb_tpu.dtypes import US_PER_DAY
+
+                return DevCol(
+                    (c.data // US_PER_DAY).astype(jnp.int32), c.valid
+                )
+            return c
+
+        return _dpd
     if op == "datediff":
         fa, fb = (_compile(a, dicts) for a in e.args)
+        ta, tb = (a.type for a in e.args)
 
         def _dd(b):
             a, c = fa(b), fb(b)
             return DevCol(
-                a.data.astype(jnp.int64) - c.data.astype(jnp.int64),
+                _to_days(a.data, ta) - _to_days(c.data, tb),
                 a.valid & c.valid,
             )
 
@@ -495,9 +538,17 @@ def _compile_literal(e: Literal) -> _CompiledExpr:
     t = e.type
     v = e.value
     if v is None:
+        # typed NULL (e.g. the NULL left side of a FULL OUTER JOIN's
+        # anti branch): carry the declared type's physical dtype so
+        # union concatenation doesn't promote the column
+        np_dt = (
+            jnp.int64
+            if t is None or t.kind == Kind.NULL
+            else t.np_dtype
+        )
 
         def _null(b):
-            z = jnp.zeros(b.capacity, dtype=jnp.int64)
+            z = jnp.zeros(b.capacity, dtype=np_dt)
             return DevCol(z, jnp.zeros(b.capacity, dtype=bool))
 
         return _null
@@ -512,6 +563,16 @@ def _compile_literal(e: Literal) -> _CompiledExpr:
         from tidb_tpu.dtypes import date_to_days
 
         phys, np_dt = (date_to_days(v) if isinstance(v, str) else int(v)), jnp.int32
+    elif t.kind == Kind.DATETIME:
+        from tidb_tpu.dtypes import datetime_to_micros
+
+        phys = datetime_to_micros(v) if isinstance(v, str) else int(v)
+        np_dt = jnp.int64
+    elif t.kind == Kind.TIME:
+        from tidb_tpu.dtypes import time_to_micros
+
+        phys = time_to_micros(v) if isinstance(v, str) else int(v)
+        np_dt = jnp.int64
     elif t.kind == Kind.STRING:
         # string literal as a value: codes into its own one-entry
         # dictionary (string_expr supplies the dictionary to consumers)
@@ -790,6 +851,49 @@ def _compile_cast(e: Func, dicts: DictContext) -> _CompiledExpr:
             return DevCol(days_j[codes], c.valid & ok_j[codes])
 
         return _cast_d
+
+    if src.kind == Kind.STRING and dst.kind in (Kind.DATETIME, Kind.TIME):
+        # parse the dictionary once on host; bad values -> NULL
+        f, dictionary = string_expr(a, dicts)
+        from tidb_tpu.dtypes import datetime_to_micros, time_to_micros
+
+        parse = datetime_to_micros if dst.kind == Kind.DATETIME else time_to_micros
+        us = np.zeros(max(len(dictionary), 1), dtype=np.int64)
+        ok = np.zeros(max(len(dictionary), 1), dtype=bool)
+        for i, s in enumerate(dictionary.tolist()):
+            try:
+                us[i] = parse(str(s))
+                ok[i] = True
+            except Exception:
+                pass
+        us_j, ok_j = jnp.asarray(us), jnp.asarray(ok)
+
+        def _cast_dt(b):
+            c = f(b)
+            codes = jnp.clip(c.data, 0, us_j.shape[0] - 1)
+            return DevCol(us_j[codes], c.valid & ok_j[codes])
+
+        return _cast_dt
+
+    if src.kind == Kind.DATE and dst.kind == Kind.DATETIME:
+
+        def _cast_d2dt(b):
+            from tidb_tpu.dtypes import US_PER_DAY
+
+            c = f(b)
+            return DevCol(c.data.astype(jnp.int64) * US_PER_DAY, c.valid)
+
+        return _cast_d2dt
+
+    if src.kind == Kind.DATETIME and dst.kind == Kind.DATE:
+
+        def _cast_dt2d(b):
+            from tidb_tpu.dtypes import US_PER_DAY
+
+            c = f(b)
+            return DevCol((c.data // US_PER_DAY).astype(jnp.int32), c.valid)
+
+        return _cast_dt2d
 
     if src.kind == Kind.STRING and dst.kind in (Kind.FLOAT, Kind.INT, Kind.DECIMAL):
         # host LUT over the dictionary: string -> numeric
@@ -1116,10 +1220,17 @@ def _compile_add_months(e: Func, dicts: DictContext) -> _CompiledExpr:
     fn = _compile(nexpr, dicts)
     _MLEN = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
 
+    is_dt = col.type is not None and col.type.kind == Kind.DATETIME
+
     def _am(b):
+        from tidb_tpu.dtypes import US_PER_DAY
+
         c = f(b)
         n = fn(b)
-        days = c.data.astype(jnp.int64)
+        raw = c.data.astype(jnp.int64)
+        # DATETIME: month-shift the calendar day, carry time of day
+        days = raw // US_PER_DAY if is_dt else raw
+        tod = raw % US_PER_DAY if is_dt else None
         y, m, d = _civil_from_days(days)
         total = y * 12 + (m - 1) + n.data.astype(jnp.int64)
         y2 = total // 12
@@ -1128,9 +1239,62 @@ def _compile_add_months(e: Func, dicts: DictContext) -> _CompiledExpr:
         mlen = _MLEN[m2 - 1] + jnp.where((m2 == 2) & leap, 1, 0)
         d2 = jnp.minimum(d, mlen)
         out = _days_from_civil(y2, m2, d2)
+        if is_dt:
+            out = out * US_PER_DAY + tod
         return DevCol(out.astype(c.data.dtype), c.valid & n.valid)
 
     return _am
+
+
+def _to_days(data, t):
+    """Temporal value -> days-since-epoch (DATETIME truncates micros)."""
+    if t is not None and t.kind == Kind.DATETIME:
+        from tidb_tpu.dtypes import US_PER_DAY
+
+        return data.astype(jnp.int64) // US_PER_DAY
+    return data.astype(jnp.int64)
+
+
+def _to_micros(data, t):
+    """Temporal value -> micros-since-epoch (DATE promotes to midnight)."""
+    if t is not None and t.kind == Kind.DATE:
+        from tidb_tpu.dtypes import US_PER_DAY
+
+        return data.astype(jnp.int64) * US_PER_DAY
+    return data.astype(jnp.int64)
+
+
+def _compile_time_part(e: Func, dicts: DictContext) -> _CompiledExpr:
+    """HOUR/MINUTE/SECOND/MICROSECOND of a DATETIME (time of day) or
+    TIME (duration components, sign dropped like MySQL's HOUR())."""
+    part = e.op
+    (col,) = e.args
+    f = _compile(col, dicts)
+    t = col.type
+
+    def _tp(b):
+        from tidb_tpu.dtypes import US_PER_DAY, US_PER_SECOND
+
+        c = f(b)
+        us = c.data.astype(jnp.int64)
+        if t is not None and t.kind == Kind.DATETIME:
+            us = us % US_PER_DAY  # time of day (floor mod: correct pre-1970)
+        elif t is not None and t.kind == Kind.TIME:
+            us = jnp.abs(us)
+        else:
+            # DATE (or numeric) argument has no time part: MySQL returns 0
+            us = jnp.zeros_like(us)
+        if part == "hour":
+            out = us // (3600 * US_PER_SECOND)
+        elif part == "minute":
+            out = (us // (60 * US_PER_SECOND)) % 60
+        elif part == "second":
+            out = (us // US_PER_SECOND) % 60
+        else:  # microsecond
+            out = us % US_PER_SECOND
+        return DevCol(out, c.valid)
+
+    return _tp
 
 
 def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:
@@ -1142,7 +1306,7 @@ def _compile_extract(e: Func, dicts: DictContext) -> _CompiledExpr:
 
     def _ext(b):
         c = f(b)
-        days = c.data.astype(jnp.int64)
+        days = _to_days(c.data, col.type)
         z = days + 719468
         # jnp // already floors (unlike C), so no negative-z adjustment.
         era = z // 146097

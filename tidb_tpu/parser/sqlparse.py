@@ -14,7 +14,9 @@ from __future__ import annotations
 import re
 from typing import List, Optional, Tuple
 
-from tidb_tpu.dtypes import BOOL, DATE, DECIMAL, FLOAT64, INT64, STRING, SQLType
+from tidb_tpu.dtypes import (
+    BOOL, DATE, DATETIME, DECIMAL, FLOAT64, INT64, STRING, TIME, SQLType,
+)
 from tidb_tpu.parser import ast
 
 
@@ -54,7 +56,7 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "in", "is", "null", "like",
     "between", "exists", "case", "when", "then", "else", "end", "cast",
-    "join", "inner", "left", "right", "outer", "cross", "on", "using",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on", "using",
     "distinct", "all", "asc", "desc", "true", "false", "interval",
     "create", "table", "database", "drop", "insert", "into", "values",
     "delete", "update", "set", "use", "explain", "analyze", "show",
@@ -121,7 +123,8 @@ _TYPE_MAP = {
     "int": INT64, "integer": INT64, "bigint": INT64, "smallint": INT64,
     "tinyint": INT64, "double": FLOAT64, "float": FLOAT64, "real": FLOAT64,
     "varchar": STRING, "char": STRING, "text": STRING, "string": STRING,
-    "date": DATE, "datetime": DATE, "boolean": BOOL, "bool": BOOL,
+    "date": DATE, "datetime": DATETIME, "timestamp": DATETIME,
+    "time": TIME, "boolean": BOOL, "bool": BOOL,
 }
 
 
@@ -496,6 +499,10 @@ class Parser:
                 self.accept_kw("outer")
                 self.expect_kw("join")
                 kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "full"
             elif self.accept_kw("join"):
                 kind = "inner"
             else:

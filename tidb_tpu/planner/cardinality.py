@@ -114,6 +114,13 @@ def _encode_literal(value, typ: Optional[SQLType]):
             return int(date_to_days(value))
         except Exception:
             return None
+    if typ.kind == Kind.DATETIME and isinstance(value, str):
+        try:
+            from tidb_tpu.dtypes import datetime_to_micros
+
+            return int(datetime_to_micros(value))
+        except Exception:
+            return None
     if isinstance(value, (int, float)):
         return value
     return None  # strings handled via TopN only

@@ -48,6 +48,14 @@ def _format_value(v, t) -> str:
     """MySQL string rendering of a value inside GROUP_CONCAT."""
     if t.kind == Kind.DATE:
         return days_to_date(int(v))
+    if t.kind == Kind.DATETIME:
+        from tidb_tpu.dtypes import micros_to_datetime
+
+        return micros_to_datetime(int(v))
+    if t.kind == Kind.TIME:
+        from tidb_tpu.dtypes import micros_to_time
+
+        return micros_to_time(int(v))
     if t.kind == Kind.DECIMAL:
         return f"{v:.{t.scale}f}"
     if t.kind == Kind.BOOL:

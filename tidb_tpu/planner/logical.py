@@ -281,6 +281,14 @@ class ExprBinder:
                     op="add_months",
                     args=(lowered, Literal(type=INT64, value=sign * months)),
                 )
+            us = self._interval_micros(iv)
+            if us is not None:
+                # sub-day units always promote the result to DATETIME
+                sign2 = 1 if op == "date_add" else -1
+                return Func(
+                    op="add_us",
+                    args=(self.lower(base), Literal(type=INT64, value=sign2 * us)),
+                )
             days = self._interval_days(iv)
             return Func(
                 op="add" if op == "date_add" else "sub",
@@ -308,7 +316,9 @@ class ExprBinder:
             # a distinct op down to the kernel.
             return Func(op="concat_ws", args=tuple(self.lower(x) for x in e.args))
         if op == "date":
-            return self.lower(e.args[0])
+            # DATE(x): truncates DATETIME to its calendar day; identity on
+            # DATE (kernel dispatches on the bound argument type)
+            return Func(op="date_part_days", args=(self.lower(e.args[0]),))
         if op in ("curdate", "current_date"):
             import datetime
 
@@ -316,6 +326,30 @@ class ExprBinder:
 
             return Literal(
                 type=_DATE, value=int(date_to_days(datetime.date.today().isoformat()))
+            )
+        if op in ("now", "current_timestamp", "sysdate", "localtimestamp"):
+            import datetime
+
+            from tidb_tpu.dtypes import DATETIME as _DT, datetime_to_micros
+
+            return Literal(
+                type=_DT,
+                value=int(
+                    datetime_to_micros(
+                        datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+                    )
+                ),
+            )
+        if op in ("curtime", "current_time"):
+            import datetime
+
+            from tidb_tpu.dtypes import TIME as _TIME, time_to_micros
+
+            return Literal(
+                type=_TIME,
+                value=int(
+                    time_to_micros(datetime.datetime.now().strftime("%H:%M:%S"))
+                ),
             )
         args = tuple(self.lower(a) for a in e.args)
         return Func(op=op, args=args)
@@ -345,6 +379,24 @@ class ExprBinder:
         if iv.unit == "week":
             return v * 7
         raise PlanError(f"unsupported interval unit {iv.unit}")
+
+    @staticmethod
+    def _interval_micros(iv: ast.Interval):
+        """Microseconds for sub-day units (hour/minute/second/microsecond);
+        None for day-or-larger units."""
+        from tidb_tpu.dtypes import US_PER_SECOND
+
+        v = iv.value
+        if isinstance(v, ast.Const):
+            v = v.value
+        v = int(v)
+        scale = {
+            "hour": 3600 * US_PER_SECOND,
+            "minute": 60 * US_PER_SECOND,
+            "second": US_PER_SECOND,
+            "microsecond": 1,
+        }.get(iv.unit)
+        return None if scale is None else v * scale
 
 
 # ---------------------------------------------------------------------------
@@ -463,11 +515,53 @@ class SelectBuilder:
             right = self.build_from(node.right)
             schema = Schema(list(left.schema.cols) + list(right.schema.cols))
             if node.kind == "cross" or node.on is None:
-                if node.kind == "left":
-                    raise PlanError("LEFT JOIN requires ON")
+                if node.kind in ("left", "full"):
+                    raise PlanError(f"{node.kind.upper()} JOIN requires ON")
                 return JoinPlan(schema, "cross", left, right, [], None)
+            if node.kind == "full":
+                return self._build_full_join(left, right, node.on, schema)
             return self._build_join(node.kind, left, right, node.on, schema)
         raise PlanError(f"unsupported FROM clause {node!r}")
+
+    def _build_full_join(self, left, right, on, schema):
+        """FULL OUTER JOIN as LEFT JOIN ∪ (right ANTI left with NULL
+        left columns). The reference emits both-unmatched rows from one
+        hash join via its joiner strategies (pkg/executor/join/joiner.go);
+        on TPU the two branches are two fused static-shape programs and
+        the union is a concat — no per-row emit state machine. ON must be
+        pure equi-conjuncts (single-side ON predicates gate matching
+        without filtering rows, which the rewrite can't express)."""
+        lj = self._build_join("left", left, right, on, schema)
+        if lj.residual is not None or lj.left is not left or lj.right is not right:
+            raise PlanError(
+                "FULL OUTER JOIN supports only equality ON conditions "
+                "between the two sides"
+            )
+        anti_keys = [(r, l) for (l, r) in lj.equi_keys]
+        aj = JoinPlan(right.schema, "anti", right, left, anti_keys)
+        nl = len(left.schema.cols)
+        ucols, exprs_l, exprs_a = [], [], []
+        for i, c in enumerate(schema.cols):
+            ucols.append(OutCol(c.qualifier, c.name, f"_u{i}", c.type))
+            exprs_l.append((f"_u{i}", ColumnRef(type=c.type, name=c.internal)))
+            exprs_a.append(
+                (
+                    f"_u{i}",
+                    Literal(type=c.type, value=None)
+                    if i < nl
+                    else ColumnRef(type=c.type, name=c.internal),
+                )
+            )
+        psch = Schema(
+            [
+                OutCol(None, f"_u{i}", f"_u{i}", c.type)
+                for i, c in enumerate(schema.cols)
+            ]
+        )
+        return UnionAll(
+            Schema(ucols),
+            [Projection(psch, lj, exprs_l), Projection(psch, aj, exprs_a)],
+        )
 
     def _build_join(self, kind, left, right, on, schema) -> JoinPlan:
         lq = {(c.qualifier or "").lower() for c in left.schema}

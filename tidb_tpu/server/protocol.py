@@ -28,6 +28,8 @@ MYSQL_TYPE_DOUBLE = 5
 MYSQL_TYPE_NULL = 6
 MYSQL_TYPE_LONGLONG = 8
 MYSQL_TYPE_DATE = 10
+MYSQL_TYPE_TIME = 11
+MYSQL_TYPE_DATETIME = 12
 MYSQL_TYPE_VAR_STRING = 253
 MYSQL_TYPE_NEWDECIMAL = 246
 MYSQL_TYPE_TINY = 1
@@ -168,6 +170,8 @@ def _mysql_type(t: Optional[SQLType]) -> int:
         Kind.FLOAT: MYSQL_TYPE_DOUBLE,
         Kind.BOOL: MYSQL_TYPE_TINY,
         Kind.DATE: MYSQL_TYPE_DATE,
+        Kind.DATETIME: MYSQL_TYPE_DATETIME,
+        Kind.TIME: MYSQL_TYPE_TIME,
         Kind.DECIMAL: MYSQL_TYPE_NEWDECIMAL,
         Kind.STRING: MYSQL_TYPE_VAR_STRING,
         Kind.NULL: MYSQL_TYPE_NULL,
@@ -196,6 +200,14 @@ def format_value(v, t: Optional[SQLType]) -> Optional[bytes]:
         return None
     if t is not None and t.kind == Kind.DATE and isinstance(v, (int,)):
         return days_to_date(v).encode()
+    if t is not None and t.kind == Kind.DATETIME and isinstance(v, int):
+        from tidb_tpu.dtypes import micros_to_datetime
+
+        return micros_to_datetime(v).encode()
+    if t is not None and t.kind == Kind.TIME and isinstance(v, int):
+        from tidb_tpu.dtypes import micros_to_time
+
+        return micros_to_time(v).encode()
     if isinstance(v, bool):
         return b"1" if v else b"0"
     if isinstance(v, float):
@@ -371,6 +383,25 @@ def binary_row(row, types) -> bytes:
         elif kind == Kind.DATE and isinstance(v, int):
             d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
             vals += bytes([4]) + struct.pack("<HBB", d.year, d.month, d.day)
+        elif kind == Kind.DATETIME and isinstance(v, int):
+            dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+                microseconds=int(v)
+            )
+            vals += bytes([11]) + struct.pack(
+                "<HBBBBBI", dt.year, dt.month, dt.day, dt.hour, dt.minute,
+                dt.second, dt.microsecond,
+            )
+        elif kind == Kind.TIME and isinstance(v, int):
+            neg, us = (1, -int(v)) if v < 0 else (0, int(v))
+            from tidb_tpu.dtypes import US_PER_DAY, US_PER_SECOND
+
+            days, rem = divmod(us, US_PER_DAY)
+            h, rem = divmod(rem, 3600 * US_PER_SECOND)
+            m, rem = divmod(rem, 60 * US_PER_SECOND)
+            s, frac = divmod(rem, US_PER_SECOND)
+            vals += bytes([12]) + struct.pack(
+                "<BIBBBI", neg, days, h, m, s, frac
+            )
         elif kind == Kind.DECIMAL:
             vals += lenenc_str(format_value(v, t) or b"")
         else:

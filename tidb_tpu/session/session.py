@@ -433,6 +433,14 @@ class Session:
             return v / 10**t.scale
         if t.kind == Kind.DATE:
             return days_to_date(int(v))
+        if t.kind == Kind.DATETIME:
+            from tidb_tpu.dtypes import micros_to_datetime
+
+            return micros_to_datetime(int(v))
+        if t.kind == Kind.TIME:
+            from tidb_tpu.dtypes import micros_to_time
+
+            return micros_to_time(int(v))
         if t.kind == Kind.BOOL:
             return bool(v)
         return v

@@ -91,6 +91,8 @@ def native_load(table, path: str, sep: str) -> Optional[int]:
     lib = _load()
     if lib is None or len(sep) != 1:
         return None
+    if any(t.kind not in _TYPECODE for _n, t in table.schema.columns):
+        return None  # e.g. DATETIME/TIME: python parser handles these
     names = table.schema.names
     types = [t for _, t in table.schema.columns]
     n = len(names)
