@@ -168,6 +168,26 @@ class CreateTable:
     columns: List[ColumnDef]
     primary_key: List[str]
     if_not_exists: bool = False
+    # in-definition secondary indexes: (index name, [cols])
+    indexes: List[tuple] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class CreateIndex:
+    db: Optional[str]
+    table: str
+    name: str
+    columns: List[str]
+    if_not_exists: bool = False
+    unique: bool = False
+
+
+@dataclasses.dataclass
+class DropIndex:
+    db: Optional[str]
+    table: str
+    name: str
+    if_exists: bool = False
 
 
 @dataclasses.dataclass

@@ -62,7 +62,7 @@ KEYWORDS = {
     "delete", "update", "set", "use", "explain", "analyze", "show",
     "tables", "databases", "if", "primary", "key", "div", "mod",
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
-    "group_concat", "separator",
+    "group_concat", "separator", "index", "unique",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -175,7 +175,7 @@ class Parser:
     _SOFT_KW = (
         "date", "key", "tables", "databases", "count", "sum", "avg", "min",
         "max", "unbounded", "preceding", "following", "current", "row",
-        "column", "add", "default", "alter",
+        "column", "add", "default", "alter", "index", "unique", "separator",
     )
 
     def expect_ident(self) -> str:
@@ -911,12 +911,28 @@ class Parser:
         if self.accept_kw("database"):
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
+        unique = self.accept_kw("unique")
+        if unique and not self.at_kw("index"):
+            raise ParseError("expected INDEX after UNIQUE")
+        if self.accept_kw("index"):
+            # CREATE [UNIQUE] INDEX [IF NOT EXISTS] name ON tbl (cols)
+            ine = self._if_not_exists()
+            iname = self.expect_ident()
+            self.expect_kw("on")
+            db, tname = self._qualified_name()
+            self.expect_op("(")
+            icols = [self.expect_ident()]
+            while self.accept_op(","):
+                icols.append(self.expect_ident())
+            self.expect_op(")")
+            return ast.CreateIndex(db, tname, iname, icols, ine, unique)
         self.expect_kw("table")
         ine = self._if_not_exists()
         db, name = self._qualified_name()
         self.expect_op("(")
         cols: List[ast.ColumnDef] = []
         pk: List[str] = []
+        indexes: List[tuple] = []
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -925,6 +941,30 @@ class Parser:
                 while self.accept_op(","):
                     pk.append(self.expect_ident())
                 self.expect_op(")")
+            elif self.at_kw("index", "key") and (
+                self.toks[self.i + 1].text == "("
+                or (
+                    self.toks[self.i + 1].kind == "id"
+                    and self.toks[self.i + 2].text == "("
+                )
+            ):
+                # INDEX/KEY [name] (cols) table element — only when a
+                # '(' follows, so columns NAMED `key`/`index` still parse
+                # as column definitions (`key int` has no paren next)
+                self.advance()
+                iname = (
+                    self.expect_ident() if self.cur.kind == "id" else None
+                )
+                self.expect_op("(")
+                icols = [self.expect_ident()]
+                while self.accept_op(","):
+                    icols.append(self.expect_ident())
+                self.expect_op(")")
+                base = iname or f"idx_{'_'.join(icols)}"
+                name_i, n = base, 2
+                while any(name_i == x for x, _ in indexes):
+                    name_i, n = f"{base}_{n}", n + 1
+                indexes.append((name_i, icols))
             else:
                 cname = self.expect_ident()
                 ctype = self.parse_type()
@@ -947,7 +987,7 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        return ast.CreateTable(db, name, cols, pk, ine)
+        return ast.CreateTable(db, name, cols, pk, ine, indexes=indexes)
 
     def parse_alter(self):
         self.expect_kw("alter")
@@ -996,6 +1036,15 @@ class Parser:
         self.expect_kw("drop")
         if self.accept_kw("database"):
             return ast.DropDatabase(self.expect_ident())
+        if self.accept_kw("index"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            iname = self.expect_ident()
+            self.expect_kw("on")
+            db, tname = self._qualified_name()
+            return ast.DropIndex(db, tname, iname, if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -152,20 +152,40 @@ def _plan_children(p) -> List[L.LogicalPlan]:
 
 
 def _extract_pk_range(pred, scan: "L.Scan", resolver):
-    """Predicate -> (pk col, lo, hi) raw-encoded range when the scan's
-    single-column integer-like PK is bounded on both sides (the point-get
-    / bounded-range case). Remaining conjuncts still filter the fetched
-    batch, so over-extraction is impossible."""
+    """Predicate -> (col, lo, hi) raw-encoded range over the best access
+    path: the single-column PK or any single-leading-column secondary
+    index whose column is bounded on both sides by the predicate (the
+    point-get / IndexRangeScan case, pkg/executor/point_get.go:132 +
+    pkg/util/ranger). When several candidates qualify the narrowest
+    range wins. Remaining conjuncts still filter the fetched batch, so
+    over-extraction is impossible."""
     try:
         t, _v = resolver(scan.db, scan.table)
     except Exception:
         return None
+    candidates = []
     pk = t.schema.primary_key
-    if not pk or len(pk) != 1:
-        return None
-    pkcol = pk[0]
+    if pk and len(pk) == 1:
+        candidates.append(pk[0])
+    for icols in getattr(t, "indexes", {}).values():
+        if icols and icols[0] not in candidates:
+            candidates.append(icols[0])
+    best = None
+    for col in candidates:
+        r = _extract_col_range(pred, scan, t, col)
+        if r is None:
+            continue
+        width = r[2] - r[1]
+        if best is None or width < best[0]:
+            best = (width, r)
+    return best[1] if best else None
+
+
+def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
     typ = t.schema.types.get(pkcol)
-    if typ is None or typ.kind not in (Kind.INT, Kind.DATE, Kind.DECIMAL):
+    if typ is None or typ.kind not in (
+        Kind.INT, Kind.DATE, Kind.DECIMAL, Kind.DATETIME,
+    ):
         return None
     internal = f"{scan.alias}.{pkcol}"
     from tidb_tpu.expression.expr import ColumnRef, Func, Literal

@@ -102,6 +102,8 @@ class Session:
             shadow = Table(t.name, t.schema)
             shadow._versions = {0: list(t.blocks(pinned))}
             shadow.dictionaries = dict(t.dictionaries)
+            shadow.indexes = dict(t.indexes)
+            shadow.unique_indexes = set(t.unique_indexes)
             self._txn["shadows"][key] = shadow
             self._txn["base_versions"][key] = t.version
         return shadow
@@ -152,6 +154,37 @@ class Session:
         finally:
             for t, v in txn.get("pin_objs", []):
                 t.unpin(v)
+
+    # ------------------------------------------------------------------
+    def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
+        """Register an index on a table: validate columns, reject dup
+        names, warm the sorted permutation (the backfill analog), and —
+        for UNIQUE — verify existing data has no duplicates (reference:
+        ADD UNIQUE INDEX fails on existing dup keys)."""
+        import numpy as np
+
+        iname = name.lower()
+        if iname in t.indexes:
+            raise ValueError(f"index {name} already exists")
+        cols = [c.lower() for c in columns]
+        unknown = set(cols) - set(t.schema.names)
+        if unknown:
+            raise ValueError(f"unknown columns {sorted(unknown)}")
+        if unique:
+            if len(cols) != 1:
+                raise ValueError("UNIQUE indexes support a single column")
+            svals, _perm, nvalid = t._sorted_index(cols[0])
+            if nvalid and len(np.unique(svals[:nvalid])) != nvalid:
+                raise ValueError(
+                    f"cannot create unique index {name}: duplicate entries "
+                    f"in column {cols[0]}"
+                )
+        t.indexes[iname] = cols
+        if unique:
+            t.unique_indexes.add(iname)
+        # warm the physical index now so the first query doesn't pay the
+        # argsort
+        t._sorted_index(cols[0])
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -224,7 +257,37 @@ class Session:
                 [(c.name.lower(), c.type) for c in s.columns],
                 primary_key=[c.lower() for c in s.primary_key] or None,
             )
+            existed = (
+                s.if_not_exists
+                and self.catalog.has_table(s.db or self.db, s.name)
+            )
             self.catalog.create_table(s.db or self.db, s.name, schema, s.if_not_exists)
+            if not existed:
+                # IF NOT EXISTS on a pre-existing table is a full no-op:
+                # in-definition indexes must not mutate the live table
+                t = self.catalog.table(s.db or self.db, s.name)
+                for iname, icols in s.indexes:
+                    self._add_index(t, iname, icols, unique=False)
+            r = Result([], [])
+        elif isinstance(s, ast.CreateIndex):
+            failpoint.inject("ddl/create-index")
+            t = self.catalog.table(s.db or self.db, s.table)
+            if s.name.lower() in t.indexes:
+                if not s.if_not_exists:
+                    raise ValueError(f"index {s.name} already exists")
+            else:
+                self._add_index(t, s.name, s.columns, unique=s.unique)
+                self.catalog.schema_version += 1
+            r = Result([], [])
+        elif isinstance(s, ast.DropIndex):
+            t = self.catalog.table(s.db or self.db, s.table)
+            if s.name.lower() not in t.indexes:
+                if not s.if_exists:
+                    raise ValueError(f"unknown index {s.name}")
+            else:
+                del t.indexes[s.name.lower()]
+                t.unique_indexes.discard(s.name.lower())
+                self.catalog.schema_version += 1
             r = Result([], [])
         elif isinstance(s, ast.DropTable):
             self.catalog.drop_table(s.db or self.db, s.name, s.if_exists)
@@ -831,7 +894,7 @@ class Session:
 
         est_rows(plan, self.catalog)  # annotates .est per node
         lines = []
-        _render_plan(plan, 0, lines)
+        _render_plan(plan, 0, lines, catalog=self.catalog)
         return Result(["plan"], [(l,) for l in lines])
 
 
@@ -854,7 +917,7 @@ def _refs_table(node, name: str) -> bool:
     return False
 
 
-def _render_plan(plan, depth, out: List[str]):
+def _render_plan(plan, depth, out: List[str], catalog=None):
     from tidb_tpu.planner import logical as L
 
     pad = "  " * depth
@@ -864,6 +927,19 @@ def _render_plan(plan, depth, out: List[str]):
         detail = f" table={plan.db}.{plan.table} cols={len(plan.columns)}"
     elif isinstance(plan, L.Selection):
         detail = f" pred={plan.predicate!r}"
+        if catalog is not None and isinstance(plan.child, L.Scan):
+            from tidb_tpu.planner.physical import _extract_pk_range
+
+            r = _extract_pk_range(
+                plan.predicate,
+                plan.child,
+                lambda db, tb: (catalog.table(db, tb), 0),
+            )
+            if r is not None:
+                col, lo, hi = r
+                detail += (
+                    f" access=IndexRangeScan({col} in [{lo}, {hi}])"
+                )
     elif isinstance(plan, L.Aggregate):
         detail = f" groups={[n for n, _ in plan.group_exprs]} aggs={[f'{f}({n})' for n, f, _, _ in plan.aggs]}"
     elif isinstance(plan, L.JoinPlan):
@@ -883,6 +959,6 @@ def _render_plan(plan, depth, out: List[str]):
     for attr in ("child", "left", "right"):
         c = getattr(plan, attr, None)
         if c is not None:
-            _render_plan(c, depth + 1, out)
+            _render_plan(c, depth + 1, out, catalog=catalog)
     for c in getattr(plan, "children", []) or []:
-        _render_plan(c, depth + 1, out)
+        _render_plan(c, depth + 1, out, catalog=catalog)

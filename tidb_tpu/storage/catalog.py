@@ -75,7 +75,7 @@ class Catalog:
     # infoschema_reader.go; synthesized fresh per access so they always
     # reflect the live catalog)
     _IS_TABLES = (
-        "tables", "columns", "schemata", "slow_query",
+        "tables", "columns", "schemata", "statistics", "slow_query",
         "statements_summary", "metrics",
     )
 
@@ -138,6 +138,28 @@ class Catalog:
                             self._dbs[db][tn].schema.columns, 1
                         ):
                             rows.append((db, tn, cn, i, repr(ct).lower()))
+        elif name == "statistics":
+            # index metadata (MySQL information_schema.statistics /
+            # SHOW INDEX; reference pkg/infoschema/tables.go)
+            schema = TableSchema(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("index_name", STRING), ("seq_in_index", INT64),
+                 ("column_name", STRING), ("non_unique", INT64)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        t0 = self._dbs[db][tn]
+                        pk = t0.schema.primary_key or []
+                        for i, cn in enumerate(pk, 1):
+                            rows.append((db, tn, "primary", i, cn, 0))
+                        for iname in sorted(t0.indexes):
+                            nu = 0 if iname in t0.unique_indexes else 1
+                            for i, cn in enumerate(t0.indexes[iname], 1):
+                                rows.append((db, tn, iname, i, cn, nu))
         elif name == "schemata":
             schema = TableSchema([("schema_name", STRING)])
             with self._lock:

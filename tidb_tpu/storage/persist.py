@@ -50,6 +50,8 @@ def save_catalog(catalog: Catalog, path: str) -> None:
                     [n, _type_to_json(ty)] for n, ty in t.schema.columns
                 ],
                 "primary_key": t.schema.primary_key,
+                "indexes": t.indexes,
+                "unique_indexes": sorted(t.unique_indexes),
             }
             cols = t.schema.names
             block = concat_blocks(t.blocks(), cols, t.schema)
@@ -79,6 +81,10 @@ def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
                 primary_key=meta.get("primary_key"),
             )
             t = catalog.create_table(db, name, schema, if_not_exists=True)
+            t.indexes = {
+                k: list(v) for k, v in (meta.get("indexes") or {}).items()
+            }
+            t.unique_indexes = set(meta.get("unique_indexes") or [])
             data = np.load(
                 os.path.join(path, f"{db}.{name}.npz"), allow_pickle=True
             )

@@ -90,6 +90,17 @@ class Table:
             for n, t in schema.columns
             if t.kind == Kind.STRING
         }
+        # named secondary indexes: name -> ordered column list. The
+        # physical structure is the lazily-built per-(version, col)
+        # sorted permutation (_sorted_index) — immutable versions make
+        # index maintenance a cache fill, not a write-path cost
+        # (reference: pkg/ddl/index.go:545 backfill; here the "backfill"
+        # is one argsort on first use).
+        self.indexes: Dict[str, List[str]] = {}
+        # names in `indexes` that carry a UNIQUE constraint (single-col
+        # only); enforced on append (duplicate-key errors, reference
+        # kv.ErrKeyExists on unique index writes)
+        self.unique_indexes: set = set()
 
     # -- read --------------------------------------------------------------
     def blocks(self, version: Optional[int] = None) -> List[HostBlock]:
@@ -127,11 +138,40 @@ class Table:
         """Append rows; returns the new version id."""
         with self._lock:
             block = self._align_dictionaries(block)
+            self._check_unique(block)
             new_blocks = list(self._versions[self.version]) + [block]
             self.version += 1
             self._versions[self.version] = new_blocks
             self._gc_versions()
             return self.version
+
+    def _check_unique(self, block: HostBlock) -> None:
+        """Duplicate-key check for UNIQUE indexes (single leading column;
+        NULLs permitted any number of times, MySQL semantics). Caller
+        holds _lock."""
+        for iname in self.unique_indexes:
+            cols = self.indexes.get(iname)
+            if not cols:
+                continue
+            col = cols[0]
+            c = block.columns.get(col)
+            if c is None:
+                continue
+            vals = c.data[c.valid]
+            if len(vals) != len(np.unique(vals)):
+                raise ValueError(
+                    f"duplicate entry for unique index {iname!r} ({col})"
+                )
+            if len(vals):
+                svals, _perm, nvalid = self._sorted_index(col)
+                pos = np.searchsorted(svals[:nvalid], vals)
+                hit = (pos < nvalid) & (
+                    svals[np.minimum(pos, max(nvalid - 1, 0))] == vals
+                )
+                if nvalid and hit.any():
+                    raise ValueError(
+                        f"duplicate entry for unique index {iname!r} ({col})"
+                    )
 
     def append_rows(self, rows: Sequence[Sequence]) -> int:
         cols = {}
