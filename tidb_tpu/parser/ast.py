@@ -208,6 +208,28 @@ class AlterTable:
 
 
 @dataclasses.dataclass
+class CreateUser:
+    name: str
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropUser:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class GrantStmt:
+    privs: tuple  # lowercase priv names, or ('all',)
+    db: str  # '*' for global
+    table: str  # '*' for db-level
+    user: str
+    revoke: bool = False
+
+
+@dataclasses.dataclass
 class CreateDatabase:
     name: str
     if_not_exists: bool = False

@@ -46,7 +46,7 @@ _TOKEN_RE = re.compile(
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
   | (?P<bq>`[^`]*`)
   | (?P<sysvar>@@[A-Za-z_][A-Za-z0-9_.$]*)
-  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>?])
+  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>?@])
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
     """,
     re.VERBOSE | re.DOTALL,
@@ -63,6 +63,8 @@ KEYWORDS = {
     "tables", "databases", "if", "primary", "key", "div", "mod",
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
     "group_concat", "separator", "index", "unique",
+    "user", "grant", "revoke", "identified", "privileges", "to", "grants",
+    "for",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -176,6 +178,7 @@ class Parser:
         "date", "key", "tables", "databases", "count", "sum", "avg", "min",
         "max", "unbounded", "preceding", "following", "current", "row",
         "column", "add", "default", "alter", "index", "unique", "separator",
+        "user", "to", "for", "grants", "privileges",
     )
 
     def expect_ident(self) -> str:
@@ -224,7 +227,20 @@ class Parser:
                 return ast.Show("variables", db=self._show_like())
             if self.accept_kw("variables"):
                 return ast.Show("variables", db=self._show_like())
-            raise ParseError("SHOW supports TABLES | DATABASES | VARIABLES")
+            if self.accept_kw("grants"):
+                user = None
+                if self.accept_kw("for"):
+                    user = self._user_name()
+                return ast.Show("grants", db=user)
+            if self.accept_kw("index"):
+                self.expect_kw("from")
+                db, name = self._qualified_name()
+                return ast.Show("index", db=f"{db or ''}.{name}")
+            raise ParseError(
+                "SHOW supports TABLES | DATABASES | VARIABLES | GRANTS | INDEX"
+            )
+        if self.at_kw("grant", "revoke"):
+            return self.parse_grant_revoke()
         if self.at_kw("set"):
             return self.parse_set()
         if self.at_kw("trace"):
@@ -906,11 +922,66 @@ class Parser:
         return t
 
     # -- DDL / DML ---------------------------------------------------------
+    def _user_name(self) -> str:
+        """'u'[@'host'] — host accepted and ignored (single-host grants)."""
+        t = self.cur
+        if t.kind in ("str", "id") or (t.kind == "kw" and t.text in self._SOFT_KW):
+            self.advance()
+            name = t.text
+        else:
+            raise ParseError(f"expected user name, got {t.text!r} at {t.pos}")
+        if self.accept_op("@"):
+            h = self.advance()
+            if h.kind not in ("str", "id", "op"):
+                raise ParseError(f"bad host {h.text!r}")
+        return name
+
+    def parse_grant_revoke(self):
+        revoke = self.cur.text == "revoke"
+        self.advance()
+        privs = []
+        if self.accept_kw("all"):
+            self.accept_kw("privileges")
+            privs = ["all"]
+        else:
+            while True:
+                t = self.advance()
+                privs.append(t.text.lower())
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("on")
+        # *.* | db.* | [db.]tbl
+        if self.accept_op("*"):
+            self.expect_op(".")
+            self.expect_op("*")
+            db, tbl = "*", "*"
+        else:
+            a = self.expect_ident()
+            if self.accept_op("."):
+                db = a
+                tbl = "*" if self.accept_op("*") else self.expect_ident()
+            else:
+                db, tbl = "", a  # current database, resolved by session
+        self.expect_kw("from" if revoke else "to")
+        user = self._user_name()
+        return ast.GrantStmt(tuple(privs), db, tbl, user, revoke=revoke)
+
     def parse_create(self):
         self.expect_kw("create")
         if self.accept_kw("database"):
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
+        if self.accept_kw("user"):
+            ine = self._if_not_exists()
+            name = self._user_name()
+            pw = ""
+            if self.accept_kw("identified"):
+                self.expect_kw("by")
+                t = self.advance()
+                if t.kind != "str":
+                    raise ParseError("IDENTIFIED BY expects a string")
+                pw = t.text
+            return ast.CreateUser(name, pw, ine)
         unique = self.accept_kw("unique")
         if unique and not self.at_kw("index"):
             raise ParseError("expected INDEX after UNIQUE")
@@ -1036,6 +1107,12 @@ class Parser:
         self.expect_kw("drop")
         if self.accept_kw("database"):
             return ast.DropDatabase(self.expect_ident())
+        if self.accept_kw("user"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropUser(self._user_name(), if_exists)
         if self.accept_kw("index"):
             if_exists = False
             if self.accept_kw("if"):

@@ -88,7 +88,30 @@ class PacketIO:
         self.sock.sendall(out)
 
 
-def handshake_v10(conn_id: int, server_version: str) -> bytes:
+def new_scramble() -> bytes:
+    """20 random non-zero bytes — per-connection challenge (a fixed salt
+    would make the challenge-response replayable)."""
+    import os
+
+    out = bytearray()
+    while len(out) < 20:
+        out += bytes(b for b in os.urandom(24) if b not in (0, 0x24))
+    return bytes(out[:20])
+
+
+def scramble_from_handshake(pkt: bytes) -> bytes:
+    """Client side: extract the 20-byte scramble from a handshake_v10
+    packet (salt part 1 + part 2)."""
+    i = 1 + pkt.index(b"\x00", 1) + 4  # proto ver, version string, conn id
+    part1 = pkt[i : i + 8]
+    i += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10  # salt1, NUL, caps, cs, status, caps2, alen, filler
+    part2 = pkt[i : i + 12]
+    return part1 + part2
+
+
+def handshake_v10(
+    conn_id: int, server_version: str, scramble: Optional[bytes] = None
+) -> bytes:
     caps = (
         CLIENT_PROTOCOL_41
         | CLIENT_SECURE_CONNECTION
@@ -96,8 +119,8 @@ def handshake_v10(conn_id: int, server_version: str) -> bytes:
         | CLIENT_CONNECT_WITH_DB
         | CLIENT_TRANSACTIONS
     )
-    salt = b"12345678"
-    salt2 = b"901234567890\x00"
+    scramble = scramble or SCRAMBLE
+    salt, salt2 = scramble[:8], scramble[8:20] + b"\x00"
     p = b"\x0a"  # protocol version
     p += server_version.encode() + b"\x00"
     p += struct.pack("<I", conn_id)
@@ -113,8 +136,14 @@ def handshake_v10(conn_id: int, server_version: str) -> bytes:
     return p
 
 
-def parse_handshake_response(body: bytes) -> Tuple[str, Optional[str]]:
-    """Returns (username, database)."""
+#: scramble sent in handshake_v10 (salt + salt2 minus trailing NUL)
+SCRAMBLE = b"12345678" + b"901234567890"
+
+
+def parse_handshake_response(
+    body: bytes,
+) -> Tuple[str, Optional[str], bytes]:
+    """Returns (username, database, auth_response bytes)."""
     caps = struct.unpack("<I", body[:4])[0]
     i = 4 + 4 + 1 + 23  # caps, max packet, charset, reserved
     end = body.index(b"\x00", i)
@@ -123,9 +152,11 @@ def parse_handshake_response(body: bytes) -> Tuple[str, Optional[str]]:
     # auth response
     if caps & CLIENT_SECURE_CONNECTION:
         alen = body[i]
+        auth = body[i + 1 : i + 1 + alen]
         i += 1 + alen
     else:
         end = body.index(b"\x00", i)
+        auth = body[i:end]
         i = end + 1
     db = None
     if caps & CLIENT_CONNECT_WITH_DB and i < len(body):
@@ -134,7 +165,7 @@ def parse_handshake_response(body: bytes) -> Tuple[str, Optional[str]]:
         except ValueError:
             end = len(body)
         db = body[i:end].decode("utf-8", "replace") or None
-    return user, db
+    return user, db, auth
 
 
 def ok_packet(affected: int = 0, last_insert_id: int = 0, info: str = "") -> bytes:

@@ -70,16 +70,29 @@ class Server:
             conn_id = self._next_conn_id[0]
         sess = Session(self.catalog)
         version = str(sess.vars.get("version"))
-        io.write_packet(P.handshake_v10(conn_id, version))
+        scramble = P.new_scramble()
+        io.write_packet(P.handshake_v10(conn_id, version, scramble))
         body = io.read_packet()
         if body is None:
             return
         try:
-            _user, db = P.parse_handshake_response(body)
-            if db:
-                sess.db = db.lower()
+            user, db, auth = P.parse_handshake_response(body)
         except Exception:
-            pass
+            io.write_packet(P.err_packet(1045, "malformed handshake"))
+            return
+        # real authentication (reference: pkg/privilege auth at
+        # clientConn.openSessionAndDoAuth) — mysql_native_password
+        # against the catalog's user store
+        if not self.catalog.users.authenticate(user, scramble, auth):
+            io.write_packet(
+                P.err_packet(
+                    1045, f"Access denied for user '{user}'@'%'", "28000"
+                )
+            )
+            return
+        sess.user = user.lower()
+        if db:
+            sess.db = db.lower()
         io.write_packet(P.ok_packet())
 
         # prepared statements: per-connection registry (reference:

@@ -44,6 +44,7 @@ class Session:
         catalog: Optional[Catalog] = None,
         db: str = "test",
         mesh_devices: Optional[int] = None,
+        user: str = "root",
     ):
         """mesh_devices=N runs every query as one SPMD shard_map program
         over an N-device mesh (sharded scans, all_to_all exchanges) — the
@@ -51,6 +52,11 @@ class Session:
         """
         self.catalog = catalog or Catalog()
         self.db = db
+        self.user = user
+        if not hasattr(self.catalog, "users"):  # pre-UserStore pickles
+            from tidb_tpu.utils.privilege import UserStore
+
+            self.catalog.users = UserStore()
         self.executor = PhysicalExecutor(self.catalog, mesh_devices=mesh_devices)
         from tidb_tpu.utils import SysVars, Tracer
 
@@ -233,11 +239,89 @@ class Session:
         finally:
             self._stmt_depth -= 1
 
+    # -- privilege enforcement -----------------------------------------
+    def _check_priv(self, priv: str, db: str, table: str = "*") -> None:
+        if not self.catalog.users.check(self.user, priv, db, table):
+            raise PermissionError(
+                f"{priv.upper()} command denied to user {self.user!r} "
+                f"for table {db}.{table}"
+            )
+
+    def _require_super(self) -> None:
+        if not self.catalog.users.is_super(self.user):
+            raise PermissionError(
+                f"user {self.user!r} lacks administrative privileges"
+            )
+
+    def _ast_tables(self, node, out=None):
+        """All TableRefs in a statement tree (generic dataclass walk)."""
+        if out is None:
+            out = []
+        if isinstance(node, ast.TableRef):
+            out.append(node)
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            for f in dataclasses.fields(node):
+                self._ast_tables(getattr(node, f.name), out)
+        elif isinstance(node, (list, tuple)):
+            for x in node:
+                self._ast_tables(x, out)
+        return out
+
+    def _enforce_privileges(self, s) -> None:
+        """Statement -> required privileges (reference: the visitor in
+        pkg/planner/core/planbuilder.go collecting visitInfo, checked by
+        pkg/privilege). Super users skip the walk."""
+        users = self.catalog.users
+        if users.is_super(self.user):
+            return
+        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.Explain)):
+            for tr in self._ast_tables(s):
+                db = (tr.db or self.db).lower()
+                # CTE names / derived tables aren't catalog tables
+                if self.catalog.has_table(db, tr.name):
+                    self._check_priv("select", db, tr.name.lower())
+            return
+        if isinstance(s, (ast.Insert, ast.Update, ast.Delete, ast.LoadData)):
+            priv = {
+                ast.Insert: "insert",
+                ast.Update: "update",
+                ast.Delete: "delete",
+                ast.LoadData: "insert",
+            }[type(s)]
+            self._check_priv(priv, (s.db or self.db).lower(), s.table.lower())
+            # any table READ inside the statement (subqueries in VALUES /
+            # SET / WHERE) needs SELECT — otherwise INSERT-only users
+            # could exfiltrate other tables through a subquery
+            for tr in self._ast_tables(s):
+                db = (tr.db or self.db).lower()
+                if self.catalog.has_table(db, tr.name):
+                    self._check_priv("select", db, tr.name.lower())
+        elif isinstance(s, ast.CreateTable):
+            self._check_priv("create", (s.db or self.db).lower())
+        elif isinstance(s, ast.DropTable):
+            self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
+        elif isinstance(s, ast.AlterTable):
+            self._check_priv("alter", (s.db or self.db).lower(), s.name.lower())
+        elif isinstance(s, (ast.CreateIndex, ast.DropIndex)):
+            self._check_priv("index", (s.db or self.db).lower(), s.table.lower())
+        elif isinstance(s, (ast.CreateDatabase, ast.DropDatabase)):
+            self._check_priv(
+                "create" if isinstance(s, ast.CreateDatabase) else "drop",
+                s.name.lower(),
+            )
+        elif isinstance(s, (ast.CreateUser, ast.DropUser, ast.GrantStmt)):
+            self._require_super()
+        elif isinstance(s, ast.AnalyzeTable):
+            self._check_priv("select", (s.db or self.db).lower(), s.name.lower())
+        # SHOW / SET / txn control / USE are unrestricted (SHOW GRANTS
+        # FOR another user re-checks inside its handler)
+
     def _execute_stmt_inner(self, s, t0) -> Result:
         from tidb_tpu.utils import failpoint
 
         self.killer.clear()
         failpoint.inject("session/stmt-start")
+        self._enforce_privileges(s)
         try:
             self.executor.quota_bytes = int(
                 self.vars.get("tidb_mem_quota_query") or 0
@@ -308,6 +392,19 @@ class Session:
                 t.alter_drop_column(s.col_name)
             self.catalog.schema_version += 1
             clear_scan_cache()
+            r = Result([], [])
+        elif isinstance(s, ast.CreateUser):
+            self.catalog.users.create_user(s.name, s.password, s.if_not_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.DropUser):
+            self.catalog.users.drop_user(s.name, s.if_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.GrantStmt):
+            db = s.db if s.db else self.db
+            if s.revoke:
+                self.catalog.users.revoke(set(s.privs), db, s.table, s.user)
+            else:
+                self.catalog.users.grant(set(s.privs), db, s.table, s.user)
             r = Result([], [])
         elif isinstance(s, ast.CreateDatabase):
             self.catalog.create_database(s.name, s.if_not_exists)
@@ -383,6 +480,36 @@ class Session:
             return Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        if s.what == "grants":
+            user = (s.db or self.user).lower()
+            if user != self.user.lower():
+                self._require_super()
+            return Result(
+                [f"Grants for {user}@%"],
+                [(g,) for g in self.catalog.users.show_grants(user)],
+            )
+        if s.what == "index":
+            db, name = s.db.split(".", 1)
+            db = db or self.db
+            if not self.catalog.users.is_super(self.user) and not any(
+                self.catalog.users.check(self.user, p, db.lower(), name.lower())
+                for p in ("select", "insert", "update", "delete", "index")
+            ):
+                raise PermissionError(
+                    f"SHOW INDEX denied to user {self.user!r} on {db}.{name}"
+                )
+            t = self.catalog.table(db, name)
+            rows = []
+            for i, cn in enumerate(t.schema.primary_key or [], 1):
+                rows.append((name, "primary", i, cn, 0))
+            for iname in sorted(t.indexes):
+                nu = 0 if iname in t.unique_indexes else 1
+                for i, cn in enumerate(t.indexes[iname], 1):
+                    rows.append((name, iname, i, cn, nu))
+            return Result(
+                ["Table", "Key_name", "Seq_in_index", "Column_name", "Non_unique"],
+                rows,
+            )
         # variables
         import fnmatch
 

@@ -17,9 +17,15 @@ from tidb_tpu.storage.table import Table, TableSchema
 
 class Catalog:
     def __init__(self) -> None:
+        from tidb_tpu.utils.privilege import UserStore
+
         self._lock = threading.Lock()
         self.schema_version = 0
         self._dbs: Dict[str, Dict[str, Table]] = {"test": {}}
+        # account + grant store (reference: mysql.user et al cached by
+        # pkg/privilege); lives on the catalog so every session/server
+        # over the same store shares one authority
+        self.users = UserStore()
 
     def create_database(self, name: str, if_not_exists: bool = False) -> None:
         name = name.lower()

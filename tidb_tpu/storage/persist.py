@@ -39,6 +39,9 @@ def save_catalog(catalog: Catalog, path: str) -> None:
     """Write a full snapshot of every table's current version."""
     os.makedirs(path, exist_ok=True)
     manifest = {"dbs": {}}
+    users = getattr(catalog, "users", None)
+    if users is not None:
+        manifest["users"] = users.to_manifest()
     for db in catalog.databases():
         if db.startswith("_"):  # scratch schemas (recursive CTE temps)
             continue
@@ -73,6 +76,10 @@ def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
     catalog = catalog or Catalog()
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
+    if manifest.get("users"):
+        from tidb_tpu.utils.privilege import UserStore
+
+        catalog.users = UserStore.from_manifest(manifest["users"])
     for db, tables in manifest["dbs"].items():
         catalog.create_database(db, if_not_exists=True)
         for name, meta in tables.items():
