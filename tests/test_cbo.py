@@ -171,3 +171,66 @@ def test_broadcast_join_mesh_parity(sess):
     for x, y in zip(c.rows, d.rows):
         assert x[0] == y[0]
         assert abs(x[1] - y[1]) < 0.02
+
+
+class TestAutoAnalyze:
+    """Auto-analyze: modify counters drive stats refresh (reference
+    pkg/statistics/handle/autoanalyze/autoanalyze.go:264)."""
+
+    def test_dml_triggers_analyze(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table aa (a int)")
+        t = s.catalog.table("test", "aa")
+        assert getattr(t, "stats", None) is None
+        s.execute(
+            "insert into aa values " + ",".join(f"({i % 7})" for i in range(150))
+        )
+        assert t.stats is not None and t.stats["a"].ndv == 7
+
+    def test_small_changes_do_not_churn(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table aa (a int)")
+        s.execute(
+            "insert into aa values " + ",".join(f"({i})" for i in range(100))
+        )
+        t = s.catalog.table("test", "aa")
+        ver = t.stats_version
+        s.execute("insert into aa values (1)")
+        assert t.stats_version == ver
+
+    def test_disabled_by_sysvar_and_handle_tick(self):
+        from tidb_tpu.session.session import Session
+        from tidb_tpu.stats.handle import StatsHandle
+
+        s = Session()
+        s.execute("set global tidb_enable_auto_analyze = 0")
+        s.execute("create table aa (a int)")
+        s.execute(
+            "insert into aa values " + ",".join(f"({i})" for i in range(100))
+        )
+        t = s.catalog.table("test", "aa")
+        assert getattr(t, "stats", None) is None
+        h = StatsHandle(s.catalog)
+        assert h.tick() == 0  # daemon honors the disable sysvar
+        s.execute("set global tidb_enable_auto_analyze = 1")
+        assert h.tick() >= 1
+        assert t.stats is not None
+
+    def test_manual_analyze_resets_counter(self):
+        from tidb_tpu.session.session import Session
+        from tidb_tpu.stats.handle import needs_analyze
+
+        s = Session()
+        s.execute("set global tidb_enable_auto_analyze = 0")
+        s.execute("create table aa (a int)")
+        s.execute(
+            "insert into aa values " + ",".join(f"({i})" for i in range(100))
+        )
+        t = s.catalog.table("test", "aa")
+        assert needs_analyze(t, 0.5)
+        s.execute("analyze table aa")
+        assert not needs_analyze(t, 0.5)

@@ -49,8 +49,13 @@ class Server:
 
         self._tcp = TCP((host, port), Handler)
         self.port = self._tcp.server_address[1]
+        # background stats owner (reference: domain's stats handle loop)
+        from tidb_tpu.stats.handle import StatsHandle
+
+        self.stats_handle = StatsHandle(self.catalog, interval_s=30.0)
 
     def serve_forever(self) -> None:
+        self.stats_handle.start()
         self._tcp.serve_forever()
 
     def start_background(self) -> threading.Thread:
@@ -59,6 +64,7 @@ class Server:
         return th
 
     def shutdown(self) -> None:
+        self.stats_handle.stop()
         self._tcp.shutdown()
         self._tcp.server_close()
 

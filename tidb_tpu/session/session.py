@@ -153,7 +153,9 @@ class Session:
             for key, shadow in txn["shadows"].items():
                 db, name = key
                 base = self.catalog.table(db, name)
-                base.replace_blocks(shadow.blocks())
+                base.replace_blocks(
+                    shadow.blocks(), modified_rows=shadow.modify_count
+                )
                 base.dictionaries = shadow.dictionaries
             if txn["shadows"]:
                 clear_scan_cache()
@@ -235,9 +237,33 @@ class Session:
         t0 = time.perf_counter()
         self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
         try:
-            return self._execute_stmt_inner(s, t0)
+            res = self._execute_stmt_inner(s, t0)
+            self._maybe_auto_analyze(s)
+            return res
         finally:
             self._stmt_depth -= 1
+
+    def _maybe_auto_analyze(self, s) -> None:
+        """Statement-boundary auto-analyze check (reference: the stats
+        handle's modify-counter-driven HandleAutoAnalyze,
+        pkg/statistics/handle/autoanalyze/autoanalyze.go:264). Runs only
+        after committed DML — inside a transaction the base table hasn't
+        changed yet."""
+        if self._txn is not None or not isinstance(
+            s, (ast.Insert, ast.Update, ast.Delete, ast.LoadData)
+        ):
+            return
+        try:
+            if not self.vars.get("tidb_enable_auto_analyze"):
+                return
+            raw = self.vars.get("tidb_auto_analyze_ratio")
+            ratio = 0.5 if raw is None else float(raw)
+            from tidb_tpu.stats.handle import maybe_auto_analyze
+
+            t = self.catalog.table(s.db or self.db, s.table)
+            maybe_auto_analyze(t, ratio)
+        except Exception:
+            pass  # stats refresh must never fail the DML
 
     # -- privilege enforcement -----------------------------------------
     def _check_priv(self, priv: str, db: str, table: str = "*") -> None:
@@ -852,7 +878,7 @@ class Session:
         blocks = t.blocks()
         if s.where is None:
             affected = t.nrows
-            t.replace_blocks([])
+            t.replace_blocks([], modified_rows=affected)
             clear_scan_cache()
             return Result([], [], affected=affected)
         masks, affected = self._eval_where_per_block(t, s.where)
@@ -906,7 +932,7 @@ class Session:
             affected = len(rows)
         else:
             _masks, affected = self._eval_where_per_block(t, s.where)
-        t.replace_blocks([])
+        t.replace_blocks([], modified_rows=affected)
         if rows:
             t.append_rows(rows)
         clear_scan_cache()
@@ -983,7 +1009,7 @@ class Session:
                 cols[c] = dataclasses.replace(src, data=data, valid=valid)
             consumed += hit
             new_blocks.append(HostBlock(cols, block.nrows))
-        t.replace_blocks(new_blocks)
+        t.replace_blocks(new_blocks, modified_rows=affected)
         clear_scan_cache()
         return Result([], [], affected=affected)
 

@@ -128,4 +128,6 @@ def analyze_table(table) -> Dict[str, ColumnStats]:
         )
     table.stats = stats
     table.stats_version = table.version
+    # reset the auto-analyze counter (manual ANALYZE counts too)
+    table.analyzed_modify = getattr(table, "modify_count", 0)
     return stats

@@ -101,6 +101,11 @@ class Table:
         # only); enforced on append (duplicate-key errors, reference
         # kv.ErrKeyExists on unique index writes)
         self.unique_indexes: set = set()
+        # rows changed since the last ANALYZE — drives auto-analyze
+        # (reference: stats handle modify counters feeding
+        # pkg/statistics/handle/autoanalyze/autoanalyze.go:264)
+        self.modify_count = 0
+        self.analyzed_modify = 0  # modify_count when last analyzed
 
     # -- read --------------------------------------------------------------
     def blocks(self, version: Optional[int] = None) -> List[HostBlock]:
@@ -140,6 +145,7 @@ class Table:
             block = self._align_dictionaries(block)
             self._check_unique(block)
             new_blocks = list(self._versions[self.version]) + [block]
+            self.modify_count += block.nrows
             self.version += 1
             self._versions[self.version] = new_blocks
             self._gc_versions()
@@ -182,6 +188,9 @@ class Table:
     def delete_where(self, keep_mask_per_block: List[np.ndarray]) -> int:
         """Replace current version with masked blocks (DELETE)."""
         with self._lock:
+            self.modify_count += sum(
+                int((~k).sum()) for k in keep_mask_per_block
+            )
             new_blocks = []
             for block, keep in zip(self._versions[self.version], keep_mask_per_block):
                 if keep.all():
@@ -198,8 +207,20 @@ class Table:
             self._gc_versions()
             return self.version
 
-    def replace_blocks(self, blocks: List[HostBlock]) -> int:
+    def replace_blocks(
+        self, blocks: List[HostBlock], modified_rows: Optional[int] = None
+    ) -> int:
+        """modified_rows: how many rows this replacement actually
+        changed (UPDATE affected count, txn shadow's modify_count).
+        None falls back to the conservative max(old, new) — callers who
+        know the real count should pass it, or every point UPDATE on a
+        big table trips the auto-analyze ratio."""
         with self._lock:
+            if modified_rows is None:
+                old = sum(b.nrows for b in self._versions[self.version])
+                new = sum(b.nrows for b in blocks)
+                modified_rows = max(old, new)
+            self.modify_count += int(modified_rows)
             self.version += 1
             self._versions[self.version] = blocks
             self._gc_versions()

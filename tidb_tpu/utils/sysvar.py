@@ -32,6 +32,16 @@ def _int_range(lo, hi):
     return v
 
 
+def _float_range(lo, hi):
+    def v(x):
+        x = float(x)
+        if not lo <= x <= hi:
+            raise ValueError(f"value {x} out of range [{lo},{hi}]")
+        return x
+
+    return v
+
+
 def _bool(x):
     if isinstance(x, str):
         return x.strip().lower() in ("1", "on", "true", "yes")
@@ -63,6 +73,12 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "accepted for compatibility; device kernels are already parallel"),
         SysVarDef("tidb_enable_plan_cache", True, "both", _bool,
                   "cache jitted plans keyed by fingerprint + shapes"),
+        SysVarDef("tidb_enable_auto_analyze", True, "both", _bool,
+                  "refresh table statistics automatically once enough "
+                  "rows changed (reference autoanalyze.go)"),
+        SysVarDef("tidb_auto_analyze_ratio", 0.5, "both", _float_range(0.0, 1.0),
+                  "modified-rows / total-rows ratio that triggers "
+                  "auto-analyze (reference tidb_auto_analyze_ratio)"),
         # MySQL compatibility
         SysVarDef("autocommit", True, "both", _bool),
         SysVarDef("sql_mode", "STRICT_TRANS_TABLES", "both"),
