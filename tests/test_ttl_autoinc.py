@@ -1,0 +1,156 @@
+"""AUTO_INCREMENT, TTL tables, and max_execution_time runaway control.
+
+Reference: pkg/meta/autoid (allocator), pkg/ttl (job manager + workers),
+max_execution_time + pkg/domain/resourcegroup/runaway.go.
+"""
+
+import pytest
+
+from tidb_tpu.session.session import Session
+from tidb_tpu.utils.sqlkiller import QueryKilled
+from tidb_tpu.utils.ttl import TTLWorker, expire_table
+
+
+class TestAutoIncrement:
+    def test_alloc_and_observe(self):
+        s = Session()
+        s.execute("create table ai (id int primary key auto_increment, v varchar(8))")
+        s.execute("insert into ai (v) values ('a'),('b')")
+        s.execute("insert into ai values (10, 'x')")
+        s.execute("insert into ai (v) values ('c')")
+        assert s.execute("select id, v from ai order by id").rows == [
+            (1, "a"), (2, "b"), (10, "x"), (11, "c"),
+        ]
+        assert s.last_insert_id == 11
+
+    def test_null_means_allocate(self):
+        s = Session()
+        s.execute("create table ai (id int auto_increment, v int)")
+        s.execute("insert into ai values (null, 5)")
+        assert s.execute("select id from ai").rows == [(1,)]
+
+    def test_two_autoinc_rejected(self):
+        s = Session()
+        with pytest.raises(ValueError):
+            s.execute(
+                "create table bad (a int auto_increment, b int auto_increment)"
+            )
+
+    def test_persist_roundtrip(self, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        s = Session()
+        s.execute("create table ai (id int auto_increment, v int)")
+        s.execute("insert into ai values (null, 1)")
+        save_catalog(s.catalog, str(tmp_path / "snap"))
+        cat2 = load_catalog(str(tmp_path / "snap"))
+        s2 = Session(catalog=cat2)
+        s2.execute("insert into ai values (null, 2)")
+        assert s2.execute("select id from ai order by id").rows == [(1,), (2,)]
+
+
+class TestTTL:
+    def test_expire(self):
+        s = Session()
+        s.execute(
+            "create table ev (id int, ts datetime) ttl = ts + interval 1 day"
+        )
+        s.execute(
+            "insert into ev values (1,'2020-01-01 00:00:00'),"
+            "(2,'2999-01-01 00:00:00'),(3,null)"
+        )
+        w = TTLWorker(s.catalog)
+        assert w.tick() == 1
+        # NULL TTL values and future rows survive
+        assert s.execute("select id from ev order by id").rows == [(2,), (3,)]
+        assert w.tick() == 0  # idempotent
+
+    def test_date_column(self):
+        s = Session()
+        s.execute("create table ev (id int, d date) ttl = d + interval 1 week")
+        s.execute("insert into ev values (1,'2000-01-01'),(2,'2999-01-01')")
+        t = s.catalog.table("test", "ev")
+        assert expire_table(t) == 1
+        assert s.execute("select id from ev").rows == [(2,)]
+
+    def test_bad_ttl_column_rejected(self):
+        s = Session()
+        with pytest.raises(ValueError):
+            s.execute("create table ev (id int) ttl = id + interval 1 day")
+
+    def test_persist_roundtrip(self, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        s = Session()
+        s.execute(
+            "create table ev (id int, ts datetime) ttl = ts + interval 2 hour"
+        )
+        save_catalog(s.catalog, str(tmp_path / "snap"))
+        cat2 = load_catalog(str(tmp_path / "snap"))
+        assert cat2.table("test", "ev").ttl == ("ts", 2, "hour")
+
+
+class TestMaxExecutionTime:
+    def test_runaway_killed(self):
+        s = Session()
+        s.execute("create table big (a int)")
+        s.execute(
+            "insert into big values " + ",".join(f"({i})" for i in range(20000))
+        )
+        s.execute("set max_execution_time = 1")
+        with pytest.raises(QueryKilled):
+            s.execute(
+                "select count(*) from big b1, big b2 where b1.a + 0 = b2.a + 1"
+            )
+        s.execute("set max_execution_time = 0")
+        # limit cleared: statement completes
+        s.execute("select count(*) from big")
+
+
+def test_column_default_values():
+    s = Session()
+    s.execute("create table d (a int, b int default 5, c varchar(4) default 'x')")
+    s.execute("insert into d (a) values (1)")
+    s.execute("insert into d values (2, null, null)")  # explicit NULL stays NULL
+    assert s.execute("select * from d order by a").rows == [
+        (1, 5, "x"), (2, None, None),
+    ]
+
+
+def test_session_functions():
+    s = Session()
+    s.execute("create table ai (id int auto_increment, v int)")
+    s.execute("insert into ai (v) values (9)")
+    assert s.execute(
+        "select last_insert_id(), database(), current_user()"
+    ).rows == [(1, "test", "root@%")]
+
+
+def test_failed_ddl_leaves_no_table():
+    s = Session()
+    with pytest.raises(ValueError):
+        s.execute("create table bad (a int auto_increment, b int auto_increment)")
+    assert not s.catalog.has_table("test", "bad")
+
+
+def test_ttl_concurrent_insert_race():
+    import threading
+
+    s = Session()
+    s.execute("create table ev (id int, ts datetime) ttl = ts + interval 1 day")
+    t = s.catalog.table("test", "ev")
+    stop, n = [False], [0]
+
+    def inserter():
+        s2 = Session(catalog=s.catalog)
+        while not stop[0]:
+            s2.execute(f"insert into ev values ({n[0]}, '2999-01-01 00:00:00')")
+            n[0] += 1
+
+    th = threading.Thread(target=inserter)
+    th.start()
+    for _ in range(25):
+        expire_table(t)
+    stop[0] = True
+    th.join()
+    assert s.execute("select count(*) from ev").rows == [(n[0],)]

@@ -1,25 +1,32 @@
 #!/usr/bin/env python
 """tidb_tpu server binary.
 
-Reference: cmd/tidb-server/main.go — flags (main.go:200-262), store
+Reference: cmd/tidb-server/main.go — flags (main.go:200-262), TOML config
+(pkg/config/config.go, loaded by InitializeConfig main.go:275), store
 registry (registerStores main.go:397), server start (createServer
-main.go:895). The TPU engine is the only store ("--store=tpu" is the
-default and the point); data can be bootstrapped from TPC-H datagen or
-loaded via LOAD DATA INFILE / INSERT over the wire.
+main.go:895), graceful shutdown (main.go:330-341). Layers: built-in
+defaults <- --config TOML <- CLI flags. With --path the catalog loads
+from the snapshot directory on boot and persists back on shutdown
+(the durability story; storage/persist.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description="TPU-native MySQL-compatible SQL engine")
-    ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("-P", "--port", type=int, default=4000)
-    ap.add_argument("--store", default="tpu", choices=["tpu"],
+    ap.add_argument("--config", default=None, metavar="FILE",
+                    help="TOML config file (pkg/config analog)")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("-P", "--port", type=int, default=None)
+    ap.add_argument("--path", default=None,
+                    help="persistence dir: load on boot, snapshot on shutdown")
+    ap.add_argument("--store", default=None, choices=["tpu"],
                     help="storage/compute engine (TPU device engine)")
     ap.add_argument("--tpch", type=float, default=None, metavar="SF",
                     help="bootstrap with TPC-H data at scale factor SF")
@@ -27,20 +34,52 @@ def main() -> int:
 
     from tidb_tpu.server import Server
     from tidb_tpu.storage import Catalog
+    from tidb_tpu.utils.config import Config
+
+    cfg = Config.from_toml(args.config) if args.config else Config()
+    cfg = cfg.override(
+        host=args.host, port=args.port, path=args.path, store=args.store
+    )
 
     catalog = Catalog()
+    if cfg.path and os.path.exists(os.path.join(cfg.path, "manifest.json")):
+        from tidb_tpu.storage.persist import load_catalog
+
+        print(f"loading catalog from {cfg.path} ...", flush=True)
+        load_catalog(cfg.path, catalog)
+    cfg.apply_variables(catalog)
     if args.tpch:
         from tidb_tpu.bench import load_tpch
 
         print(f"generating TPC-H sf={args.tpch} ...", flush=True)
         load_tpch(catalog, sf=args.tpch)
-    srv = Server(catalog, host=args.host, port=args.port)
-    print(f"tidb_tpu listening on {args.host}:{srv.port} (store={args.store})", flush=True)
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
+    srv = Server(catalog, host=cfg.host, port=cfg.port)
+    srv.stats_handle.interval_s = cfg.auto_analyze_interval_s
+    print(
+        f"tidb_tpu listening on {cfg.host}:{srv.port} (store={cfg.store})",
+        flush=True,
+    )
+
+    def on_sigterm(*_):
+        # TCPServer.shutdown() blocks until serve_forever() returns, and
+        # the signal handler runs ON serve_forever's thread — stop the
+        # accept loop from a helper thread; the main thread then falls
+        # out of serve_forever() and persists below
+        import threading
+
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
-        pass
+        srv.shutdown()
+    if cfg.path:
+        from tidb_tpu.storage.persist import save_catalog
+
+        print(f"snapshotting catalog to {cfg.path} ...", flush=True)
+        save_catalog(catalog, cfg.path)
     return 0
 
 

@@ -159,6 +159,8 @@ class ColumnDef:
     type: SQLType
     not_null: bool = False
     primary_key: bool = False
+    auto_increment: bool = False
+    default: object = None  # DEFAULT <const> (None = no default)
 
 
 @dataclasses.dataclass
@@ -170,6 +172,9 @@ class CreateTable:
     if_not_exists: bool = False
     # in-definition secondary indexes: (index name, [cols])
     indexes: List[tuple] = dataclasses.field(default_factory=list)
+    # TTL table option: (column, interval value, unit) — rows whose
+    # column is older than NOW() - interval are purged by the TTL worker
+    ttl: Optional[tuple] = None
 
 
 @dataclasses.dataclass

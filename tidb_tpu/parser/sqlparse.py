@@ -64,7 +64,7 @@ KEYWORDS = {
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
     "group_concat", "separator", "index", "unique",
     "user", "grant", "revoke", "identified", "privileges", "to", "grants",
-    "for",
+    "for", "auto_increment", "ttl",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -76,7 +76,7 @@ _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
 
 # keywords that may also appear as function names in expression position
 # (MySQL grammar does the same disambiguation, parser.y sysFuncCall rules)
-_FUNC_KEYWORDS = {"mod", "left", "right", "if"}
+_FUNC_KEYWORDS = {"mod", "left", "right", "if", "database", "user"}
 
 
 class Token:
@@ -1052,13 +1052,43 @@ class Parser:
                         pk.append(cname)
                     elif self.at_kw("key"):
                         self.advance()
+                    elif self.accept_kw("auto_increment"):
+                        cd.auto_increment = True
+                    elif self.accept_kw("default"):
+                        d = self.parse_primary()
+                        if isinstance(d, ast.Call) and d.op == "neg" and isinstance(d.args[0], ast.Const):
+                            d = ast.Const(-d.args[0].value)
+                        if not isinstance(d, ast.Const):
+                            raise ParseError("DEFAULT must be a constant")
+                        cd.default = d.value
                     else:
                         break
                 cols.append(cd)
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        return ast.CreateTable(db, name, cols, pk, ine, indexes=indexes)
+        ttl = None
+        # table options: TTL = col + INTERVAL n unit  (reference: TiDB
+        # TTL table option, pkg/ttl)
+        while self.cur.kind == "kw":
+            if self.accept_kw("ttl"):
+                self.expect_op("=")
+                tcol = self.expect_ident()
+                self.expect_op("+")
+                self.expect_kw("interval")
+                t = self.advance()
+                if t.kind != "num":
+                    raise ParseError(
+                        f"TTL interval expects a number, got {t.text!r} at {t.pos}"
+                    )
+                iv = int(t.text)
+                unit = self.expect_ident().lower().rstrip("s")
+                ttl = (tcol, iv, unit)
+            else:
+                break
+        return ast.CreateTable(
+            db, name, cols, pk, ine, indexes=indexes, ttl=ttl
+        )
 
     def parse_alter(self):
         self.expect_kw("alter")

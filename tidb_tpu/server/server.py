@@ -51,11 +51,14 @@ class Server:
         self.port = self._tcp.server_address[1]
         # background stats owner (reference: domain's stats handle loop)
         from tidb_tpu.stats.handle import StatsHandle
+        from tidb_tpu.utils.ttl import TTLWorker
 
         self.stats_handle = StatsHandle(self.catalog, interval_s=30.0)
+        self.ttl_worker = TTLWorker(self.catalog, interval_s=60.0)
 
     def serve_forever(self) -> None:
         self.stats_handle.start()
+        self.ttl_worker.start()
         self._tcp.serve_forever()
 
     def start_background(self) -> threading.Thread:
@@ -64,6 +67,7 @@ class Server:
         return th
 
     def shutdown(self) -> None:
+        self.ttl_worker.stop()
         self.stats_handle.stop()
         self._tcp.shutdown()
         self._tcp.server_close()
@@ -175,7 +179,12 @@ class Server:
     ) -> None:
         r = sess.execute(sql)
         if not r.columns:
-            io.write_packet(P.ok_packet(affected=r.affected))
+            io.write_packet(
+                P.ok_packet(
+                    affected=r.affected,
+                    last_insert_id=int(getattr(sess, "last_insert_id", 0)),
+                )
+            )
             return
         types = getattr(r, "types", None) or [None] * len(r.columns)
         io.write_packet(P.lenenc_int(len(r.columns)))

@@ -60,8 +60,6 @@ class Session:
         self.executor = PhysicalExecutor(self.catalog, mesh_devices=mesh_devices)
         from tidb_tpu.utils import SysVars, Tracer
 
-        if not hasattr(self.catalog, "global_sysvars"):
-            self.catalog.global_sysvars = {}
         self.vars = SysVars(self.catalog.global_sysvars)
         self.tracer = Tracer()
         # Snapshot transaction state (reference: LazyTxn pkg/session/txn.go:50
@@ -77,6 +75,7 @@ class Session:
         self.killer = SQLKiller()
         self.executor.kill_check = self.killer.check
         self.executor.table_hook = self._resolve_table_for_read
+        self.last_insert_id = 0
 
     # -- transaction plumbing ------------------------------------------
     def _resolve_table_for_read(self, db: str, name: str):
@@ -342,12 +341,46 @@ class Session:
         # SHOW / SET / txn control / USE are unrestricted (SHOW GRANTS
         # FOR another user re-checks inside its handler)
 
+    def _resolve_session_funcs(self, node):
+        """Fold session-state functions (LAST_INSERT_ID(), DATABASE(),
+        CURRENT_USER()) to constants before planning (the reference
+        evaluates these against sessionVars, builtin_info.go)."""
+        if isinstance(node, SQLType):
+            return node
+        if isinstance(node, ast.Call) and not node.args:
+            op = node.op.lower()
+            if op == "last_insert_id":
+                return ast.Const(int(self.last_insert_id))
+            if op in ("database", "schema"):
+                return ast.Const(self.db)
+            if op in ("current_user", "session_user", "user"):
+                return ast.Const(f"{self.user}@%")
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            for f in dataclasses.fields(node):
+                setattr(
+                    node, f.name, self._resolve_session_funcs(getattr(node, f.name))
+                )
+            return node
+        if isinstance(node, list):
+            return [self._resolve_session_funcs(x) for x in node]
+        if isinstance(node, tuple):
+            return tuple(self._resolve_session_funcs(x) for x in node)
+        return node
+
     def _execute_stmt_inner(self, s, t0) -> Result:
         from tidb_tpu.utils import failpoint
 
-        self.killer.clear()
+        try:
+            limit_ms = int(self.vars.get("max_execution_time") or 0)
+        except Exception:
+            limit_ms = 0
+        self.killer.clear(
+            deadline=(time.monotonic() + limit_ms / 1000.0) if limit_ms else 0.0
+        )
         failpoint.inject("session/stmt-start")
         self._enforce_privileges(s)
+        if isinstance(s, (ast.Select, ast.Union, ast.With)):
+            s = self._resolve_session_funcs(s)
         try:
             self.executor.quota_bytes = int(
                 self.vars.get("tidb_mem_quota_query") or 0
@@ -367,6 +400,23 @@ class Session:
                 [(c.name.lower(), c.type) for c in s.columns],
                 primary_key=[c.lower() for c in s.primary_key] or None,
             )
+            # validate table options BEFORE creating anything — a DDL
+            # error must not leave a half-created table behind
+            auto = [c for c in s.columns if c.auto_increment]
+            if auto and (len(auto) > 1 or auto[0].type.kind != Kind.INT):
+                raise ValueError("one integer AUTO_INCREMENT column per table")
+            ttl_opt = None
+            if s.ttl is not None:
+                tcol, iv, unit = s.ttl
+                tcol = tcol.lower()
+                ct = schema.types.get(tcol)
+                if ct is None or ct.kind not in (Kind.DATE, Kind.DATETIME):
+                    raise ValueError(
+                        "TTL column must be an existing DATE/DATETIME column"
+                    )
+                if unit not in ("day", "week", "month", "hour", "minute", "second"):
+                    raise ValueError(f"unsupported TTL unit {unit!r}")
+                ttl_opt = (tcol, int(iv), unit)
             existed = (
                 s.if_not_exists
                 and self.catalog.has_table(s.db or self.db, s.name)
@@ -378,6 +428,14 @@ class Session:
                 t = self.catalog.table(s.db or self.db, s.name)
                 for iname, icols in s.indexes:
                     self._add_index(t, iname, icols, unique=False)
+                if auto:
+                    t.autoinc_col = auto[0].name.lower()
+                t.ttl = ttl_opt
+                t.defaults = {
+                    c.name.lower(): c.default
+                    for c in s.columns
+                    if c.default is not None
+                }
             r = Result([], [])
         elif isinstance(s, ast.CreateIndex):
             failpoint.inject("ddl/create-index")
@@ -857,7 +915,22 @@ class Session:
             if len(row) != len(cols):
                 raise ValueError("VALUES arity mismatch")
             vals = {c: self._const_value(v) for c, v in zip(cols, row)}
-            rows.append([vals.get(n) for n in names])
+            dflt = getattr(t, "defaults", None) or {}
+            rows.append(
+                [vals[n] if n in vals else dflt.get(n) for n in names]
+            )
+        ac = t.autoinc_col
+        if ac is not None:
+            ai = names.index(ac)
+            explicit = [r[ai] for r in rows if r[ai] is not None]
+            if explicit:
+                t.observe_autoid(max(explicit))
+            missing = [r for r in rows if r[ai] is None]
+            if missing:
+                start = t.next_autoid(len(missing))
+                for k, r in enumerate(missing):
+                    r[ai] = start + k
+                self.last_insert_id = start
         t.append_rows(rows)
         clear_scan_cache()
         return Result([], [], affected=len(rows))

@@ -26,6 +26,8 @@ class Catalog:
         # pkg/privilege); lives on the catalog so every session/server
         # over the same store shares one authority
         self.users = UserStore()
+        # shared GLOBAL sysvar store (mysql.global_variables analog)
+        self.global_sysvars: Dict[str, object] = {}
 
     def create_database(self, name: str, if_not_exists: bool = False) -> None:
         name = name.lower()

@@ -55,6 +55,8 @@ def save_catalog(catalog: Catalog, path: str) -> None:
                 "primary_key": t.schema.primary_key,
                 "indexes": t.indexes,
                 "unique_indexes": sorted(t.unique_indexes),
+                "autoinc": [t.autoinc_col, t.autoinc_next],
+                "ttl": list(t.ttl) if t.ttl else None,
             }
             cols = t.schema.names
             block = concat_blocks(t.blocks(), cols, t.schema)
@@ -92,6 +94,11 @@ def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
                 k: list(v) for k, v in (meta.get("indexes") or {}).items()
             }
             t.unique_indexes = set(meta.get("unique_indexes") or [])
+            ai = meta.get("autoinc")
+            if ai:
+                t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])
+            if meta.get("ttl"):
+                t.ttl = tuple(meta["ttl"])
             data = np.load(
                 os.path.join(path, f"{db}.{name}.npz"), allow_pickle=True
             )

@@ -106,6 +106,12 @@ class Table:
         # pkg/statistics/handle/autoanalyze/autoanalyze.go:264)
         self.modify_count = 0
         self.analyzed_modify = 0  # modify_count when last analyzed
+        # AUTO_INCREMENT allocator state (reference pkg/meta/autoid
+        # batch allocator — single-process, so a plain counter)
+        self.autoinc_col: Optional[str] = None
+        self.autoinc_next = 1
+        # TTL option (col, interval value, unit) — pkg/ttl analog
+        self.ttl: Optional[tuple] = None
 
     # -- read --------------------------------------------------------------
     def blocks(self, version: Optional[int] = None) -> List[HostBlock]:
@@ -179,6 +185,20 @@ class Table:
                         f"duplicate entry for unique index {iname!r} ({col})"
                     )
 
+    def next_autoid(self, n: int = 1) -> int:
+        """Allocate n consecutive AUTO_INCREMENT ids; returns the first."""
+        with self._lock:
+            start = self.autoinc_next
+            self.autoinc_next += n
+            return start
+
+    def observe_autoid(self, maxval: int) -> None:
+        """Explicitly-inserted ids advance the allocator past them
+        (MySQL keeps AUTO_INCREMENT > any stored value)."""
+        with self._lock:
+            if maxval >= self.autoinc_next:
+                self.autoinc_next = int(maxval) + 1
+
     def append_rows(self, rows: Sequence[Sequence]) -> int:
         cols = {}
         for i, (name, typ) in enumerate(self.schema.columns):
@@ -186,14 +206,23 @@ class Table:
         return self.append_block(HostBlock.from_columns(cols))
 
     def delete_where(self, keep_mask_per_block: List[np.ndarray]) -> int:
-        """Replace current version with masked blocks (DELETE)."""
+        """Replace current version with masked blocks (DELETE). Blocks
+        appended concurrently after the caller computed its masks are
+        kept whole — masks only ever apply to the blocks they were
+        computed from (a shorter mask list must never drop the tail)."""
         with self._lock:
             self.modify_count += sum(
                 int((~k).sum()) for k in keep_mask_per_block
             )
+            cur = self._versions[self.version]
             new_blocks = []
-            for block, keep in zip(self._versions[self.version], keep_mask_per_block):
-                if keep.all():
+            for i, block in enumerate(cur):
+                keep = (
+                    keep_mask_per_block[i]
+                    if i < len(keep_mask_per_block)
+                    else None
+                )
+                if keep is None or keep.all():
                     new_blocks.append(block)
                     continue
                 idx = np.nonzero(keep)[0]
@@ -206,6 +235,40 @@ class Table:
             self._versions[self.version] = [b for b in new_blocks if b.nrows > 0]
             self._gc_versions()
             return self.version
+
+    def purge_expired(self, col: str, cutoff: int) -> int:
+        """TTL expiry: atomically delete rows whose `col` < cutoff
+        (NULLs survive). Snapshot, mask, and swap under ONE lock hold so
+        a concurrent INSERT can neither lose its block nor be masked by
+        stale positions (pkg/ttl scan/delete jobs run transactionally
+        for the same reason)."""
+        with self._lock:
+            removed = 0
+            new_blocks = []
+            for block in self._versions[self.version]:
+                c = block.columns.get(col)
+                if c is None:
+                    new_blocks.append(block)
+                    continue
+                expired = c.valid & (c.data.astype(np.int64) < cutoff)
+                n = int(expired.sum())
+                if not n:
+                    new_blocks.append(block)
+                    continue
+                removed += n
+                idx = np.nonzero(~expired)[0]
+                cols = {
+                    nm: HostColumn(cc.type, cc.data[idx], cc.valid[idx], cc.dictionary)
+                    for nm, cc in block.columns.items()
+                }
+                if len(idx):
+                    new_blocks.append(HostBlock(cols, len(idx)))
+            if removed:
+                self.modify_count += removed
+                self.version += 1
+                self._versions[self.version] = new_blocks
+                self._gc_versions()
+            return removed
 
     def replace_blocks(
         self, blocks: List[HostBlock], modified_rows: Optional[int] = None

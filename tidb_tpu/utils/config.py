@@ -1,0 +1,66 @@
+"""Layered configuration: defaults <- TOML file <- CLI flags.
+
+Reference: pkg/config/config.go (TOML config, 1,568 LoC) overridden by
+cmd/tidb-server flags (main.go:200-262, overrideConfig). The TPU engine
+keeps the same three layers over the subset of knobs that exist here;
+global sysvar defaults can also be seeded from the file's [variables]
+table (the reference persists globals in mysql.global_variables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class Config:
+    host: str = "127.0.0.1"
+    port: int = 4000
+    # persistence directory: catalog loads from it on boot and snapshots
+    # back on graceful shutdown (reference --path / storage bootstrap)
+    path: Optional[str] = None
+    store: str = "tpu"
+    # mesh size for SPMD sessions (None = single device)
+    mesh_devices: Optional[int] = None
+    # background stats loop interval (seconds)
+    auto_analyze_interval_s: float = 30.0
+    # seed values for GLOBAL sysvars ([variables] table in the TOML)
+    variables: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        import tomllib
+
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: Dict) -> "Config":
+        cfg = cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown config keys {sorted(unknown)}")
+        for k, v in raw.items():
+            setattr(cfg, k, v)
+        return cfg
+
+    def override(self, **kw) -> "Config":
+        """CLI-flag layer: non-None values win over the file."""
+        out = dataclasses.replace(self)
+        for k, v in kw.items():
+            if v is not None:
+                setattr(out, k, v)
+        return out
+
+    def apply_variables(self, catalog) -> None:
+        """Seed GLOBAL sysvars from the [variables] config table."""
+        if not self.variables:
+            return
+        from tidb_tpu.utils.sysvar import SysVars
+
+        sv = SysVars(catalog.global_sysvars)
+        for name, val in self.variables.items():
+            sv.set(name, val, scope="global")

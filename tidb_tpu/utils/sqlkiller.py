@@ -11,6 +11,7 @@ themselves are short-lived single XLA launches.
 from __future__ import annotations
 
 import threading
+import time
 
 
 class QueryKilled(RuntimeError):
@@ -20,14 +21,23 @@ class QueryKilled(RuntimeError):
 class SQLKiller:
     def __init__(self) -> None:
         self._killed = threading.Event()
+        # wall-clock deadline for the current statement (runaway-query
+        # control, reference max_execution_time +
+        # pkg/domain/resourcegroup/runaway.go); None = no limit
+        self.deadline: float = 0.0
 
     def kill(self) -> None:
         """Signal the running statement to abort (thread-safe)."""
         self._killed.set()
 
-    def clear(self) -> None:
+    def clear(self, deadline: float = 0.0) -> None:
         self._killed.clear()
+        self.deadline = deadline
 
     def check(self) -> None:
         if self._killed.is_set():
             raise QueryKilled("query interrupted (killed)")
+        if self.deadline and time.monotonic() > self.deadline:
+            raise QueryKilled(
+                "query interrupted (max_execution_time exceeded)"
+            )

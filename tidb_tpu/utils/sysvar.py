@@ -79,6 +79,9 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
         SysVarDef("tidb_auto_analyze_ratio", 0.5, "both", _float_range(0.0, 1.0),
                   "modified-rows / total-rows ratio that triggers "
                   "auto-analyze (reference tidb_auto_analyze_ratio)"),
+        SysVarDef("max_execution_time", 0, "both", _int_range(0, 1 << 31),
+                  "per-statement wall-clock limit in ms (0 = unlimited); "
+                  "runaway statements abort at the next kill safepoint"),
         # MySQL compatibility
         SysVarDef("autocommit", True, "both", _bool),
         SysVarDef("sql_mode", "STRICT_TRANS_TABLES", "both"),
