@@ -1,0 +1,196 @@
+"""DXF: distributed background task framework.
+
+Reference: pkg/disttask/framework — scheduler/executor state machines
+(proto/task.go:44, proto/step.go), system-table persistence
+(framework/storage), subtask rebalance on executor death, and the
+import/add-index pipelines built on it (pkg/disttask/importinto,
+pkg/ddl/backfilling_dist_*).
+"""
+
+import json
+import time
+
+import pytest
+
+import tidb_tpu.dxf.tasks  # noqa: F401  (registers built-in task types)
+from tidb_tpu.dxf import (
+    SubtaskState,
+    TaskExecutor,
+    TaskManager,
+    TaskState,
+    register_task_type,
+)
+from tidb_tpu.dxf.framework import HEARTBEAT_TTL_S
+from tidb_tpu.session.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a int, b varchar(8))")
+    s.execute(
+        "insert into t values "
+        + ",".join(f"({i % 9},'v{i % 4}')" for i in range(500))
+    )
+    return s
+
+
+def test_distributed_analyze(sess):
+    m = TaskManager(sess.catalog)
+    tid = m.submit("analyze", {"db": "test", "table": "t"})
+    assert m.run_to_completion(tid, executors=3) == "succeed"
+    t = sess.catalog.table("test", "t")
+    assert sorted(t.stats) == ["a", "b"] and t.stats["a"].ndv == 9
+
+
+def test_chunked_import_exact(sess, tmp_path):
+    path = str(tmp_path / "data.tsv")
+    with open(path, "w") as f:
+        for i in range(5000):
+            f.write(f"{i}\tx{i % 7}\n")
+    sess.execute("create table imp (a int, b varchar(8))")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "imp", "path": path, "chunk_bytes": 8192},
+    )
+    assert m.run_to_completion(tid, executors=4) == "succeed"
+    assert sess.execute("select count(*), sum(a) from imp").rows == [
+        (5000, sum(range(5000)))
+    ]
+
+
+def test_index_backfill(sess):
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "index_backfill",
+        {"db": "test", "table": "t", "column": "a", "index": "ia"},
+    )
+    assert m.run_to_completion(tid) == "succeed"
+    assert sess.catalog.table("test", "t").indexes == {"ia": ["a"]}
+
+
+def test_owner_failover_resume(sess, tmp_path):
+    path = str(tmp_path / "data.tsv")
+    with open(path, "w") as f:
+        for i in range(3000):
+            f.write(f"{i}\ty\n")
+    sess.execute("create table imp2 (a int, b varchar(8))")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "imp2", "path": path, "chunk_bytes": 8192},
+    )
+    m.schedule_once()  # plan subtasks
+    TaskExecutor(m, "solo").run_one()  # partially execute, then "crash"
+    m2 = TaskManager(sess.catalog)  # new owner over the same store
+    assert m2.task_state(tid) == TaskState.RUNNING.value
+    assert m2.run_to_completion(tid, executors=2) == "succeed"
+    assert sess.execute("select count(*) from imp2").rows == [(3000,)]
+
+
+def test_failed_subtask_fails_task(sess):
+    def bad_run(meta, catalog):
+        raise RuntimeError("boom")
+
+    register_task_type(
+        "always_fails", lambda m, c: [{"i": 1}, {"i": 2}], bad_run
+    )
+    m = TaskManager(sess.catalog)
+    tid = m.submit("always_fails", {})
+    assert m.run_to_completion(tid) == "failed"
+    assert "boom" in m.tasks[tid]["error"]
+
+
+def test_dead_executor_rebalance(sess, monkeypatch):
+    """A claimed-but-silent subtask goes back to the pool once the
+    heartbeat expires (scheduler-side failure detection)."""
+    monkeypatch.setattr("tidb_tpu.dxf.framework.HEARTBEAT_TTL_S", 0.05)
+    done = []
+    register_task_type(
+        "rebal",
+        lambda m, c: [{"i": 0}],
+        lambda m, c: (done.append(m["i"]), {"ok": 1})[1],
+    )
+    m = TaskManager(sess.catalog)
+    tid = m.submit("rebal", {})
+    m.schedule_once()
+    # dead executor claims the subtask and never reports
+    claimed = m.claim_subtask("dead-node")
+    assert claimed is not None
+    time.sleep(0.1)
+    m.schedule_once()  # heartbeat expired -> back to pending
+    sid = claimed["id"]
+    assert m.subtasks[sid]["state"] == SubtaskState.PENDING.value
+    assert m.run_to_completion(tid) == "succeed"
+    assert done == [0]
+
+
+def test_system_tables_queryable(sess):
+    m = TaskManager(sess.catalog)
+    tid = m.submit("analyze", {"db": "test", "table": "t"})
+    m.run_to_completion(tid, executors=2)
+    rows = sess.execute(
+        "select type, state from mysql.tidb_global_task"
+    ).rows
+    assert ("analyze", "succeed") in rows
+    sub = sess.execute(
+        "select count(*) from mysql.tidb_background_subtask "
+        "where state = 'succeed'"
+    ).rows
+    assert sub[0][0] >= 2  # one per column
+
+
+def test_bad_planner_fails_task_not_scheduler(sess):
+    m = TaskManager(sess.catalog)
+    bad = m.submit(
+        "import", {"db": "test", "table": "t", "path": "/no/such/file"}
+    )
+    good = m.submit("analyze", {"db": "test", "table": "t"})
+    assert m.run_to_completion(good, executors=2) == "succeed"
+    assert m.task_state(bad) == TaskState.FAILED.value
+    assert "planner" in m.tasks[bad]["error"]
+
+
+def test_empty_import_succeeds(sess, tmp_path):
+    path = tmp_path / "empty.tsv"
+    path.write_text("")
+    sess.execute("create table emp (a int)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit("import", {"db": "test", "table": "emp", "path": str(path)})
+    assert m.run_to_completion(tid) == "succeed"
+
+
+def test_multibyte_chunk_boundaries(sess, tmp_path):
+    path = tmp_path / "uni.tsv"
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(2000):
+            f.write(f"{i}\té中{i % 5}\n")  # multi-byte strings
+    sess.execute("create table uni (a int, b varchar(16))")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "uni", "path": str(path), "chunk_bytes": 4096},
+    )
+    assert m.run_to_completion(tid, executors=3) == "succeed"
+    assert sess.execute("select count(*), sum(a) from uni").rows == [
+        (2000, sum(range(2000)))
+    ]
+
+
+def test_slow_subtask_not_double_executed(sess, monkeypatch):
+    """The heartbeat ticker keeps long runners alive past the TTL, and
+    fencing drops a late report from a rebalanced executor."""
+    monkeypatch.setattr("tidb_tpu.dxf.framework.HEARTBEAT_TTL_S", 0.2)
+    runs = []
+
+    def slow_run(meta, catalog):
+        runs.append(meta["i"])
+        time.sleep(0.6)  # 3x the TTL
+        return {"ok": 1}
+
+    register_task_type("slow", lambda m, c: [{"i": 0}], slow_run)
+    m = TaskManager(sess.catalog)
+    tid = m.submit("slow", {})
+    assert m.run_to_completion(tid, executors=2, timeout_s=30) == "succeed"
+    assert runs == [0]  # ran exactly once despite TTL << runtime

