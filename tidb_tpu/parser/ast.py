@@ -315,6 +315,21 @@ class AnalyzeTable:
 
 
 @dataclasses.dataclass
+class BackupRestore:
+    restore: bool
+    db: Optional[str]  # None = all databases
+    path: str
+
+
+@dataclasses.dataclass
+class ImportInto:
+    db: Optional[str]
+    table: str
+    path: str
+    sep: str = "\t"
+
+
+@dataclasses.dataclass
 class LoadData:
     db: Optional[str]
     table: str

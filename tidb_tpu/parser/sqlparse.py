@@ -64,7 +64,7 @@ KEYWORDS = {
     "union", "date", "extract", "count", "sum", "avg", "min", "max",
     "group_concat", "separator", "index", "unique",
     "user", "grant", "revoke", "identified", "privileges", "to", "grants",
-    "for", "auto_increment", "ttl",
+    "for", "auto_increment", "ttl", "backup", "restore", "import",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -179,6 +179,7 @@ class Parser:
         "max", "unbounded", "preceding", "following", "current", "row",
         "column", "add", "default", "alter", "index", "unique", "separator",
         "user", "to", "for", "grants", "privileges",
+        "backup", "restore", "import", "ttl",
     )
 
     def expect_ident(self) -> str:
@@ -241,6 +242,34 @@ class Parser:
             )
         if self.at_kw("grant", "revoke"):
             return self.parse_grant_revoke()
+        if self.at_kw("backup", "restore"):
+            # BACKUP DATABASE <db>|* TO 'dir' / RESTORE ... FROM 'dir'
+            restore = self.advance().text == "restore"
+            self.expect_kw("database")
+            db = None if self.accept_op("*") else self.expect_ident()
+            self.expect_kw("from" if restore else "to")
+            t = self.advance()
+            if t.kind != "str":
+                raise ParseError("BACKUP/RESTORE expects a string path")
+            return ast.BackupRestore(restore, db, t.text)
+        if self.at_kw("import"):
+            # IMPORT INTO t FROM 'file' [FIELDS TERMINATED BY 'sep']
+            self.advance()
+            self.expect_kw("into")
+            db, name = self._qualified_name()
+            self.expect_kw("from")
+            t = self.advance()
+            if t.kind != "str":
+                raise ParseError("IMPORT INTO expects a string path")
+            sep = "\t"
+            if self.accept_kw("fields"):
+                self.expect_kw("terminated")
+                self.expect_kw("by")
+                st = self.advance()
+                if st.kind != "str":
+                    raise ParseError("TERMINATED BY expects a string")
+                sep = st.text
+            return ast.ImportInto(db, name, t.text, sep)
         if self.at_kw("set"):
             return self.parse_set()
         if self.at_kw("trace"):

@@ -336,6 +336,10 @@ class Session:
             )
         elif isinstance(s, (ast.CreateUser, ast.DropUser, ast.GrantStmt)):
             self._require_super()
+        elif isinstance(s, ast.BackupRestore):
+            self._require_super()
+        elif isinstance(s, ast.ImportInto):
+            self._check_priv("insert", (s.db or self.db).lower(), s.table.lower())
         elif isinstance(s, ast.AnalyzeTable):
             self._check_priv("select", (s.db or self.db).lower(), s.name.lower())
         # SHOW / SET / txn control / USE are unrestricted (SHOW GRANTS
@@ -477,6 +481,39 @@ class Session:
             self.catalog.schema_version += 1
             clear_scan_cache()
             r = Result([], [])
+        elif isinstance(s, ast.BackupRestore):
+            failpoint.inject("br/statement")
+            from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+            dbs = [s.db] if s.db else None
+            if s.restore:
+                load_catalog(s.path, self.catalog, dbs=dbs)
+                clear_scan_cache()
+            else:
+                save_catalog(self.catalog, s.path, dbs=dbs, resume=True)
+            r = Result([], [])
+        elif isinstance(s, ast.ImportInto):
+            # distributed chunked import on the DXF (lightning pipeline
+            # analog, pkg/disttask/importinto)
+            import tidb_tpu.dxf.tasks  # noqa: F401  (register types)
+            from tidb_tpu.dxf import TaskManager
+
+            target = self.catalog.table(s.db or self.db, s.table)
+            before = target.nrows
+            m = TaskManager(self.catalog)
+            tid = m.submit(
+                "import",
+                {
+                    "db": (s.db or self.db), "table": s.table,
+                    "path": s.path, "sep": s.sep,
+                },
+            )
+            state = m.run_to_completion(tid, executors=4)
+            if state != "succeed":
+                raise RuntimeError(
+                    f"IMPORT INTO failed: {m.tasks[tid]['error']}"
+                )
+            r = Result([], [], affected=target.nrows - before)
         elif isinstance(s, ast.CreateUser):
             self.catalog.users.create_user(s.name, s.password, s.if_not_exists)
             r = Result([], [])

@@ -35,15 +35,40 @@ def _type_from_json(d: Dict) -> SQLType:
     return SQLType(Kind(d["kind"]), scale=d.get("scale", 0))
 
 
-def save_catalog(catalog: Catalog, path: str) -> None:
-    """Write a full snapshot of every table's current version."""
+def save_catalog(
+    catalog: Catalog, path: str, dbs=None, resume: bool = False
+) -> int:
+    """Write a snapshot of every table's current version (optionally
+    restricted to `dbs`). With resume=True, tables recorded complete in
+    the checkpoint ledger are skipped — an interrupted backup picks up
+    where it stopped (reference: BR backup checkpoints,
+    br/pkg/checkpoint/backup.go). Returns tables written this run."""
     os.makedirs(path, exist_ok=True)
+    ckpt_path = os.path.join(path, "checkpoint.json")
+    done = {}
+    if resume and os.path.exists(ckpt_path):
+        with open(ckpt_path) as f:
+            # ledger entries carry the table VERSION a file was written
+            # at: a table that changed after its checkpoint re-writes,
+            # so manifest metadata and npz data can't diverge
+            done = {(d, n): v for d, n, v in json.load(f)}
+    written = 0
     manifest = {"dbs": {}}
+    mpath = os.path.join(path, _MANIFEST)
+    if os.path.exists(mpath):
+        # a subset backup into a directory holding a broader one must
+        # not orphan the other databases' data files
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest.setdefault("dbs", {})
     users = getattr(catalog, "users", None)
     if users is not None:
         manifest["users"] = users.to_manifest()
+    want = {d.lower() for d in dbs} if dbs else None
     for db in catalog.databases():
         if db.startswith("_"):  # scratch schemas (recursive CTE temps)
+            continue
+        if want is not None and db.lower() not in want:
             continue
         manifest["dbs"][db] = {}
         for name in catalog.tables(db):
@@ -68,21 +93,38 @@ def save_catalog(catalog: Catalog, path: str) -> None:
                 if hc.dictionary is not None:
                     arrays[f"{c}.dict"] = hc.dictionary
             fn = os.path.join(path, f"{db}.{name}.npz")
+            if done.get((db, name)) == t.version and os.path.exists(fn):
+                continue  # checkpointed at this exact version
+            from tidb_tpu.utils.failpoint import inject
+
+            inject("persist/backup-table")
             np.savez_compressed(fn, **arrays)
+            written += 1
+            done[(db, name)] = t.version
+            with open(ckpt_path, "w") as f:
+                json.dump([[d, n, v] for (d, n), v in sorted(done.items())], f)
     with open(os.path.join(path, _MANIFEST), "w") as f:
         json.dump(manifest, f)
+    # a completed backup needs no checkpoint ledger
+    if os.path.exists(ckpt_path):
+        os.remove(ckpt_path)
+    return written
 
 
-def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
-    """Rebuild a catalog from a snapshot directory."""
+def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
+    """Rebuild a catalog from a snapshot directory (optionally only the
+    named databases — the RESTORE DATABASE path)."""
     catalog = catalog or Catalog()
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
-    if manifest.get("users"):
+    if manifest.get("users") and dbs is None:
         from tidb_tpu.utils.privilege import UserStore
 
         catalog.users = UserStore.from_manifest(manifest["users"])
+    want = {d.lower() for d in dbs} if dbs else None
     for db, tables in manifest["dbs"].items():
+        if want is not None and db.lower() not in want:
+            continue
         catalog.create_database(db, if_not_exists=True)
         for name, meta in tables.items():
             schema = TableSchema(
@@ -112,6 +154,7 @@ def load_catalog(path: str, catalog: Catalog = None) -> Catalog:
                     t.dictionaries[n] = dic
                 cols[n] = HostColumn(ty, d, v, dic)
             block = HostBlock.from_columns(cols)
-            if block.nrows:
-                t.replace_blocks([block])
+            # always replace — restoring an empty snapshot over a live
+            # table must clear it, not silently keep the newer rows
+            t.replace_blocks([block] if block.nrows else [])
     return catalog
