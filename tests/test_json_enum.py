@@ -1,0 +1,175 @@
+"""JSON type + functions, ENUM/SET domains, COLLATE.
+
+Reference: pkg/types/json_binary.go (+ builtin_json_vec.go functions),
+pkg/types enum/set write validation, pkg/util/collate. Device layout:
+all three ride dictionary-coded strings; JSON ops run once per DISTINCT
+value on host (the LIKE cost model) and gather on device.
+"""
+
+import pytest
+
+from tidb_tpu.session.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute(
+        "create table e (st enum('open','closed'), tags set('a','b','c'), "
+        "doc json)"
+    )
+    s.execute(
+        "insert into e values "
+        "('open', 'a,c', '{\"k\": [1, 2, {\"x\": \"y\"}], \"n\": null}'),"
+        "('closed', '', '[10, 20]'),"
+        "('open', 'b', '\"plain\"'),"
+        "(null, null, null)"
+    )
+    return s
+
+
+class TestDomains:
+    def test_enum_rejects_outsiders(self, s):
+        with pytest.raises(ValueError):
+            s.execute("insert into e values ('bogus', 'a', '{}')")
+        s.execute("insert into e values ('closed', 'a', '{}')")  # ok
+
+    def test_set_rejects_non_members_and_dups(self, s):
+        with pytest.raises(ValueError):
+            s.execute("insert into e values ('open', 'a,z', '{}')")
+        with pytest.raises(ValueError):
+            s.execute("insert into e values ('open', 'a,a', '{}')")
+        s.execute("insert into e values ('open', 'c,b', '{}')")  # ok
+
+    def test_json_validated_on_write(self, s):
+        with pytest.raises(ValueError):
+            s.execute("insert into e values ('open', 'a', 'not json')")
+        s.execute("insert into e values ('open', 'a', '[1,2]')")
+
+    def test_null_always_allowed(self, s):
+        s.execute("insert into e values (null, null, null)")
+
+    def test_domains_persist(self, s, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        save_catalog(s.catalog, str(tmp_path / "snap"))
+        cat2 = load_catalog(str(tmp_path / "snap"))
+        s2 = Session(catalog=cat2)
+        with pytest.raises(ValueError):
+            s2.execute("insert into e values ('bogus', 'a', '{}')")
+
+
+class TestJsonFunctions:
+    def test_extract_nested(self, s):
+        r = s.execute(
+            "select json_extract(doc, '$.k[2].x') from e where st = 'open' "
+            "and tags = 'a,c'"
+        )
+        assert r.rows == [('"y"',)]
+
+    def test_unquote(self, s):
+        r = s.execute(
+            "select json_unquote(json_extract(doc, '$.k[2].x')) from e "
+            "where tags = 'a,c'"
+        )
+        assert r.rows == [("y",)]
+
+    def test_missing_path_is_null(self, s):
+        r = s.execute(
+            "select json_extract(doc, '$.nope') from e where tags = 'a,c'"
+        )
+        assert r.rows == [(None,)]
+
+    def test_array_index(self, s):
+        r = s.execute(
+            "select json_extract(doc, '$[1]') from e where st = 'closed'"
+        )
+        assert r.rows == [("20",)]
+
+    def test_type_valid_length(self, s):
+        r = s.execute(
+            "select json_type(doc), json_valid(doc), json_length(doc) "
+            "from e where doc is not null order by json_type(doc)"
+        )
+        assert r.rows == [
+            ("ARRAY", 1, 2), ("OBJECT", 1, 2), ("STRING", 1, 1),
+        ]
+
+    def test_filter_on_extract(self, s):
+        r = s.execute(
+            "select st from e where json_extract(doc, '$.k[0]') = '1'"
+        )
+        assert r.rows == [("open",)]
+
+    def test_json_null_literal_vs_sql_null(self, s):
+        r = s.execute(
+            "select json_extract(doc, '$.n') from e where tags = 'a,c'"
+        )
+        assert r.rows == [("null",)]  # JSON null is the text 'null'
+
+
+class TestCollate:
+    @pytest.fixture()
+    def c(self):
+        s = Session()
+        s.execute("create table c (v varchar(10))")
+        s.execute("insert into c values ('Apple'), ('apple'), ('BANANA')")
+        return s
+
+    def test_ci_equality(self, c):
+        assert c.execute(
+            "select count(*) from c where v collate utf8mb4_general_ci = 'APPLE'"
+        ).rows == [(2,)]
+        assert c.execute("select count(*) from c where v = 'APPLE'").rows == [
+            (0,)
+        ]
+
+    def test_ci_order(self, c):
+        r = c.execute(
+            "select v from c order by v collate utf8mb4_general_ci, v"
+        )
+        assert r.rows == [("Apple",), ("apple",), ("BANANA",)]
+
+    def test_bin_collate_is_identity(self, c):
+        assert c.execute(
+            "select count(*) from c where v collate utf8mb4_bin = 'apple'"
+        ).rows == [(1,)]
+
+    def test_unknown_collation_rejected(self, c):
+        with pytest.raises(Exception):
+            c.execute("select v collate latin1_swedish_xx from c")
+
+
+class TestReviewRegressions:
+    def test_domains_survive_alter(self):
+        s = Session()
+        s.execute("create table t (st enum('open','closed'))")
+        s.execute("alter table t add column x int")
+        with pytest.raises(ValueError):
+            s.execute("insert into t values ('bogus', 1)")
+        s.execute("alter table t drop column x")
+        with pytest.raises(ValueError):
+            s.execute("insert into t values ('bogus')")
+
+    def test_ci_like_in_between(self):
+        s = Session()
+        s.execute("create table c (v varchar(10))")
+        s.execute("insert into c values ('Alice'), ('bob')")
+        ci = "v collate utf8mb4_general_ci"
+        assert s.execute(
+            f"select count(*) from c where {ci} like 'ALICE'"
+        ).rows == [(1,)]
+        assert s.execute(
+            f"select count(*) from c where {ci} in ('ALICE','X')"
+        ).rows == [(1,)]
+        assert s.execute(
+            f"select count(*) from c where {ci} between 'AL' and 'AM'"
+        ).rows == [(1,)]
+
+    def test_json_multipath_rejected_and_length_path(self):
+        s = Session()
+        s.execute("create table j (doc json)")
+        s.execute('insert into j values (\'{"a":1,"b":[1,2,3]}\')')
+        with pytest.raises(Exception):
+            s.execute("select json_extract(doc, '$.a', '$.b') from j")
+        assert s.execute("select json_length(doc, '$.b') from j").rows == [(3,)]

@@ -205,13 +205,14 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "year", "month", "day", "dayofweek", "weekday", "dayofyear",
         "quarter", "hour", "minute", "second", "microsecond",
         "length", "char_length", "ascii", "locate", "sign",
+        "json_valid", "json_length",
         "datediff", "floor", "ceil",
     }:
         return INT64
     if op in {
         "substr", "substring", "upper", "lower", "trim", "ltrim", "rtrim",
         "replace", "left", "right", "reverse", "lpad", "rpad", "repeat",
-        "concat", "concat_ws",
+        "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
     }:
         return STRING
     if op in {

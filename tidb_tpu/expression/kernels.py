@@ -134,25 +134,42 @@ def string_expr(e: Expr, dicts: DictContext):
             )
 
         return _lit, d
-    if isinstance(e, Func) and e.op in _STR_TRANSFORMS:
+    if isinstance(e, Func) and (
+        e.op in _STR_TRANSFORMS or e.op in _JSON_STR_FUNCS
+    ):
+        # string->string ops as dictionary transforms: run the python
+        # function once per DISTINCT value on host (O(|dict|)), gather
+        # codes on device — the LIKE cost model. A pyfn returning None
+        # yields SQL NULL via the ok-mask (JSON missing paths; reference
+        # pkg/types/json_binary.go walks rows, the dictionary makes it a
+        # compile-time LUT here).
         for a in e.args[1:]:
             if not isinstance(a, Literal):
                 raise NotImplementedError(
                     f"{e.op}: non-literal extra arguments not supported"
                 )
         fn, d = string_expr(e.args[0], dicts)
-        pyfn = _str_transform_pyfn(e)
-        vals = [str(pyfn(str(s))) for s in d.tolist()]
-        new_dict = np.array(sorted(set(vals)), dtype=object)
-        lut = jnp.asarray(
-            np.searchsorted(new_dict, np.array(vals, dtype=object)).astype(np.int32)
-            if vals
-            else np.zeros(1, np.int32)
+        pyfn = (
+            _json_pyfn(e) if e.op in _JSON_STR_FUNCS else _str_transform_pyfn(e)
         )
+        outs = [pyfn(str(v)) for v in d.tolist()]
+        present = sorted({str(o) for o in outs if o is not None})
+        new_dict = np.array(present, dtype=object)
+        codes = np.array(
+            [
+                np.searchsorted(new_dict, str(o)) if o is not None else 0
+                for o in outs
+            ],
+            dtype=np.int32,
+        )
+        okm = np.array([o is not None for o in outs], dtype=bool)
+        lut = jnp.asarray(codes if len(codes) else np.zeros(1, np.int32))
+        ok_j = jnp.asarray(okm if len(okm) else np.ones(1, bool))
 
         def _tf(b):
             c = fn(b)
-            return DevCol(lut[jnp.clip(c.data, 0, lut.shape[0] - 1)], c.valid)
+            cl = jnp.clip(c.data, 0, lut.shape[0] - 1)
+            return DevCol(lut[cl], c.valid & ok_j[cl])
 
         return _tf, new_dict
     if isinstance(e, Func) and e.op == "concat":
@@ -226,6 +243,94 @@ def string_expr(e: Expr, dicts: DictContext):
 # String->string builtins evaluated on the dictionary: O(|dict|) host work
 # regardless of row count, codes remapped on device (reference: the
 # per-row builtin_string_vec.go loops; the dictionary makes them LUTs).
+_JSON_MISSING = object()
+
+
+def _json_path_get(doc, path: str):
+    """Walk a MySQL-ish JSON path ($.a.b[0], $[1]."q k")."""
+    if not path.startswith("$"):
+        return _JSON_MISSING
+    toks = re.findall(
+        r'\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\."([^"]+)"', path[1:]
+    )
+    consumed = sum(len(m) for m in re.findall(
+        r'\.[A-Za-z_][A-Za-z0-9_]*|\[\d+\]|\."[^"]+"', path[1:]
+    ))
+    if consumed != len(path) - 1:
+        return _JSON_MISSING  # unparsable path
+    cur = doc
+    for name, idx, qname in toks:
+        key = name or qname
+        if key:
+            if isinstance(cur, dict) and key in cur:
+                cur = cur[key]
+            else:
+                return _JSON_MISSING
+        else:
+            i = int(idx)
+            if isinstance(cur, list) and i < len(cur):
+                cur = cur[i]
+            else:
+                return _JSON_MISSING
+    return cur
+
+
+_JSON_STR_FUNCS = {"json_extract", "json_unquote", "json_type"}
+
+
+def _json_pyfn(e: Func):
+    import json as _json
+
+    op = e.op
+    if op == "json_extract":
+        if len(e.args) != 2:
+            raise NotImplementedError(
+                "json_extract supports exactly one path"
+            )
+        path = str(e.args[1].value)
+
+        def f(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return None
+            v = _json_path_get(doc, path)
+            return None if v is _JSON_MISSING else _json.dumps(v)
+
+        return f
+    if op == "json_unquote":
+        def f(s):
+            if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+                try:
+                    return str(_json.loads(s))
+                except Exception:
+                    return s
+            return s
+
+        return f
+    # json_type
+    def f(s):
+        try:
+            v = _json.loads(s)
+        except Exception:
+            return None
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return "BOOLEAN"
+        if isinstance(v, int):
+            return "INTEGER"
+        if isinstance(v, float):
+            return "DOUBLE"
+        if isinstance(v, str):
+            return "STRING"
+        if isinstance(v, list):
+            return "ARRAY"
+        return "OBJECT"
+
+    return f
+
+
 _STR_TRANSFORMS = {
     "upper", "lower", "trim", "ltrim", "rtrim", "replace", "substring",
     "left", "right", "reverse", "lpad", "rpad", "repeat",
@@ -497,6 +602,38 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             )
 
         return _dd
+    if op == "json_valid":
+        import json as _json
+
+        def _jv(s):
+            try:
+                _json.loads(s)
+                return 1
+            except Exception:
+                return 0
+
+        return _compile_strlut(e.args[0], dicts, _jv, jnp.int64)
+    if op == "json_length":
+        import json as _json
+
+        jpath = None
+        if len(e.args) > 1:
+            if not isinstance(e.args[1], Literal):
+                raise NotImplementedError("json_length path must be literal")
+            jpath = str(e.args[1].value)
+
+        def _jl(s):
+            try:
+                v = _json.loads(s)
+            except Exception:
+                return 0
+            if jpath is not None:
+                v = _json_path_get(v, jpath)
+                if v is _JSON_MISSING:
+                    return 0
+            return len(v) if isinstance(v, (list, dict)) else 1
+
+        return _compile_strlut(e.args[0], dicts, _jl, jnp.int64)
     if op == "length":
         return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
     if op == "char_length":
@@ -516,7 +653,9 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             )
         needle = str(sub.value)
         return _compile_strlut(s, dicts, lambda v: v.find(needle) + 1, jnp.int64)
-    if op in _STR_TRANSFORMS or op in ("concat", "concat_ws"):
+    if op in _STR_TRANSFORMS or op in (
+        "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
+    ):
         return string_expr(e, dicts)[0]
     if op in _MATH_UNARY_FLOAT or op in (
         "abs", "sign", "floor", "ceil", "round", "truncate",

@@ -161,6 +161,9 @@ class ColumnDef:
     primary_key: bool = False
     auto_increment: bool = False
     default: object = None  # DEFAULT <const> (None = no default)
+    enum_members: tuple = ()  # ENUM('a','b'): allowed values
+    set_members: tuple = ()   # SET('a','b'): allowed comma-set members
+    is_json: bool = False     # JSON column (validated on write)
 
 
 @dataclasses.dataclass

@@ -65,6 +65,7 @@ KEYWORDS = {
     "group_concat", "separator", "index", "unique",
     "user", "grant", "revoke", "identified", "privileges", "to", "grants",
     "for", "auto_increment", "ttl", "backup", "restore", "import",
+    "collate",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -690,7 +691,18 @@ class Parser:
             return ast.Call("neg", [self.parse_unary()])
         if self.accept_op("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        # expr COLLATE <name>: _ci collations compare case-folded,
+        # _bin is the engine default (dictionary order IS binary order)
+        while self.accept_kw("collate"):
+            cname = self.expect_ident().lower()
+            if cname.endswith("_ci"):
+                e = ast.Call("_collate_ci", [e])
+            elif cname.endswith("_bin") or cname == "binary":
+                pass  # binary collation is the native behavior
+            else:
+                raise ParseError(f"unsupported collation {cname!r}")
+        return e
 
     def parse_primary(self):
         t = self.cur
@@ -931,7 +943,19 @@ class Parser:
         return ast.Call("case", args)
 
     def parse_type(self) -> SQLType:
-        name = self.expect_ident().lower()
+        t, _meta = self.parse_type_full()
+        return t
+
+    def parse_type_full(self):
+        """(SQLType, meta) — meta carries ENUM/SET member lists and the
+        JSON marker (these ride on the schema, not the device type: on
+        device all three are dictionary-coded strings)."""
+        if self.at_kw("set"):  # SET('a','b') column type (kw elsewhere)
+            self.advance()
+            name = "set"
+        else:
+            name = self.expect_ident().lower()
+        meta = {}
         if name == "decimal" or name == "numeric":
             scale = 0
             if self.accept_op("("):
@@ -939,16 +963,32 @@ class Parser:
                 if self.accept_op(","):
                     scale = self.parse_int()
                 self.expect_op(")")
-            return DECIMAL(scale)
+            return DECIMAL(scale), meta
         if name in ("signed", "unsigned"):
-            return INT64
+            return INT64, meta
+        if name in ("enum", "set"):
+            self.expect_op("(")
+            members = []
+            while True:
+                tok = self.advance()
+                if tok.kind != "str":
+                    raise ParseError(f"{name.upper()} members must be strings")
+                members.append(tok.text)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            meta["enum" if name == "enum" else "set"] = tuple(members)
+            return STRING, meta
+        if name == "json":
+            meta["json"] = True
+            return STRING, meta
         t = _TYPE_MAP.get(name)
         if t is None:
             raise ParseError(f"unknown type {name!r}")
         if self.accept_op("("):
             self.parse_int()
             self.expect_op(")")
-        return t
+        return t, meta
 
     # -- DDL / DML ---------------------------------------------------------
     def _user_name(self) -> str:
@@ -1067,8 +1107,11 @@ class Parser:
                 indexes.append((name_i, icols))
             else:
                 cname = self.expect_ident()
-                ctype = self.parse_type()
+                ctype, tmeta = self.parse_type_full()
                 cd = ast.ColumnDef(cname, ctype)
+                cd.enum_members = tmeta.get("enum", ())
+                cd.set_members = tmeta.get("set", ())
+                cd.is_json = bool(tmeta.get("json"))
                 while True:
                     if self.accept_kw("not"):
                         self.expect_kw("null")

@@ -303,6 +303,32 @@ class ExprBinder:
         if op == "nullif":
             a, bb = (self.lower(x) for x in e.args)
             return Func(op="case", args=(Func(op="eq", args=(a, bb)), Literal(value=None), a))
+        if op in (
+            "eq", "ne", "lt", "le", "gt", "ge", "like", "in", "between",
+        ) and any(
+            isinstance(a, ast.Call) and a.op == "_collate_ci" for a in e.args
+        ):
+            # a CI-collated operand makes the whole COMPARISON case-
+            # insensitive (MySQL collation coercion): fold ALL sides.
+            # String literals lower-case at plan time (LIKE patterns and
+            # IN lists must stay literals for the kernel LUTs).
+            def _strip(x):
+                return (
+                    x.args[0]
+                    if isinstance(x, ast.Call) and x.op == "_collate_ci"
+                    else x
+                )
+
+            def _fold(a):
+                low = self.lower(_strip(a))
+                if isinstance(low, Literal) and isinstance(low.value, str):
+                    return Literal(type=low.type, value=low.value.lower())
+                return Func(op="lower", args=(low,))
+
+            return Func(op=op, args=tuple(_fold(a) for a in e.args))
+        if op == "_collate_ci":
+            # utf8mb4_general_ci ~ compare case-folded (explicit COLLATE)
+            return Func(op="lower", args=(self.lower(e.args[0]),))
         if op == "instr":
             s, sub = (self.lower(x) for x in e.args)
             return Func(op="locate", args=(s, sub))

@@ -403,6 +403,17 @@ class Session:
             schema = TableSchema(
                 [(c.name.lower(), c.type) for c in s.columns],
                 primary_key=[c.lower() for c in s.primary_key] or None,
+                enums={
+                    c.name.lower(): tuple(c.enum_members)
+                    for c in s.columns if c.enum_members
+                } or None,
+                sets={
+                    c.name.lower(): tuple(c.set_members)
+                    for c in s.columns if c.set_members
+                } or None,
+                json_cols=tuple(
+                    c.name.lower() for c in s.columns if c.is_json
+                ),
             )
             # validate table options BEFORE creating anything — a DDL
             # error must not leave a half-created table behind

@@ -82,6 +82,9 @@ def save_catalog(
                 "unique_indexes": sorted(t.unique_indexes),
                 "autoinc": [t.autoinc_col, t.autoinc_next],
                 "ttl": list(t.ttl) if t.ttl else None,
+                "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
+                "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
+                "json_cols": list(t.schema.json_cols),
             }
             cols = t.schema.names
             block = concat_blocks(t.blocks(), cols, t.schema)
@@ -130,6 +133,13 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
             schema = TableSchema(
                 [(n, _type_from_json(tj)) for n, tj in meta["columns"]],
                 primary_key=meta.get("primary_key"),
+                enums={
+                    k: tuple(v) for k, v in (meta.get("enums") or {}).items()
+                } or None,
+                sets={
+                    k: tuple(v) for k, v in (meta.get("sets") or {}).items()
+                } or None,
+                json_cols=tuple(meta.get("json_cols") or ()),
             )
             t = catalog.create_table(db, name, schema, if_not_exists=True)
             t.indexes = {

@@ -33,6 +33,14 @@ class TableSchema:
     # ordered (name, type); names stored lowercase
     columns: List[Tuple[str, SQLType]]
     primary_key: Optional[List[str]] = None
+    # value-domain constraints riding on the schema (the device type for
+    # all three is dictionary-coded STRING; reference pkg/types enum/set
+    # + json_binary validation happens at write encoding):
+    #   enums: col -> allowed values; sets: col -> allowed members
+    #   (comma-joined subsets); json_cols: cols validated as JSON
+    enums: Optional[Dict[str, tuple]] = None
+    sets: Optional[Dict[str, tuple]] = None
+    json_cols: tuple = ()
 
     @property
     def names(self) -> List[str]:
@@ -148,6 +156,7 @@ class Table:
     def append_block(self, block: HostBlock) -> int:
         """Append rows; returns the new version id."""
         with self._lock:
+            self._check_domains(block)
             block = self._align_dictionaries(block)
             self._check_unique(block)
             new_blocks = list(self._versions[self.version]) + [block]
@@ -156,6 +165,46 @@ class Table:
             self._versions[self.version] = new_blocks
             self._gc_versions()
             return self.version
+
+    def _check_domains(self, block: HostBlock) -> None:
+        """ENUM/SET membership + JSON validity on write (caller holds
+        _lock). Values are still dictionary codes here only after
+        alignment, so this runs on the incoming block's own dict."""
+        sch = self.schema
+        constraints = (sch.enums or {}), (sch.sets or {}), sch.json_cols
+        if not any(constraints):
+            return
+        import json as _json
+
+        def col_values(name):
+            c = block.columns.get(name)
+            if c is None or c.dictionary is None:
+                return []
+            seen = set(int(x) for x in np.unique(c.data[c.valid]))
+            return [str(c.dictionary[i]) for i in seen if i < len(c.dictionary)]
+
+        for name, allowed in (sch.enums or {}).items():
+            for v in col_values(name):
+                if v not in allowed:
+                    raise ValueError(
+                        f"invalid ENUM value {v!r} for column {name}"
+                    )
+        for name, allowed in (sch.sets or {}).items():
+            for v in col_values(name):
+                members = [m for m in v.split(",") if m]
+                bad = set(members) - set(allowed)
+                if bad or len(members) != len(set(members)):
+                    raise ValueError(
+                        f"invalid SET value {v!r} for column {name}"
+                    )
+        for name in sch.json_cols:
+            for v in col_values(name):
+                try:
+                    _json.loads(v)
+                except Exception:
+                    raise ValueError(
+                        f"invalid JSON value for column {name}: {v[:60]!r}"
+                    )
 
     def _check_unique(self, block: HostBlock) -> None:
         """Duplicate-key check for UNIQUE indexes (single leading column;
@@ -307,8 +356,8 @@ class Table:
         with self._lock:
             if name in (n for n, _ in self.schema.columns):
                 raise ValueError(f"column {name!r} exists")
-            new_schema = TableSchema(
-                self.schema.columns + [(name, typ)], self.schema.primary_key
+            new_schema = dataclasses.replace(
+                self.schema, columns=self.schema.columns + [(name, typ)]
             )
             new_blocks = []
             for b in self._versions[self.version]:
@@ -335,8 +384,20 @@ class Table:
             pk = self.schema.primary_key
             if pk and name in pk:
                 raise ValueError("cannot drop a primary key column")
-            self.schema = TableSchema(
-                [(n, t) for n, t in self.schema.columns if n != name], pk
+            self.schema = dataclasses.replace(
+                self.schema,
+                columns=[(n, t) for n, t in self.schema.columns if n != name],
+                enums={
+                    k: v for k, v in (self.schema.enums or {}).items()
+                    if k != name
+                } or None,
+                sets={
+                    k: v for k, v in (self.schema.sets or {}).items()
+                    if k != name
+                } or None,
+                json_cols=tuple(
+                    c for c in self.schema.json_cols if c != name
+                ),
             )
             self.dictionaries.pop(name, None)
             # blocks keep the column physically; pruned scans never read
