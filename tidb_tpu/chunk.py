@@ -199,6 +199,19 @@ def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
     return Batch(cols, jnp.asarray(row_valid))
 
 
+def materialize_rows(batch, schema_cols, dicts):
+    """Device batch -> python row tuples for a plan schema (one fetch,
+    vectorized decode). The single implementation behind the session's
+    result materialization and the engine-RPC response encoder."""
+    types = {c.internal: c.type for c in schema_cols}
+    block = batch_to_block(batch, types, dicts)
+    internals = [c.internal for c in schema_cols]
+    decoded = {i: block.columns[i].decode() for i in internals}
+    return [
+        tuple(decoded[i][r] for i in internals) for r in range(block.nrows)
+    ]
+
+
 def batch_to_block(
     batch: Batch, types: Dict[str, SQLType], dicts: Dict[str, Optional[np.ndarray]]
 ) -> HostBlock:

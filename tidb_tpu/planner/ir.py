@@ -1,0 +1,238 @@
+"""Serializable plan IR — the tipb.DAGRequest analog.
+
+Reference: the pushdown IR crossing the compute boundary —
+`tipb.DAGRequest` with its `Executor` tree (TableScan/Selection/
+Aggregation/TopN/Join/ExchangeSender/...) and `Expr` protobufs, built by
+`pkg/planner/core/plan_to_pb.go:88,245` and shipped via
+`kv.Request.Data` (pkg/kv/kv.go:523) to the coprocessor / MPP engine.
+
+TPU-native shape: the bound LOGICAL plan serializes to a JSON-stable
+tree (expressions included); the device engine deserializes and
+compiles it to XLA exactly as if it had been built in-process — the
+seam a multi-host frontend/engine split plugs into (see
+tidb_tpu/server/engine_rpc.py for the loopback transport, the
+unistore `RPCClient.SendRequest` short-circuit analog, rpc.go:64).
+
+Staged nodes (device-resident batches) are deliberately NOT
+serializable — they never cross the boundary, matching the reference
+where intermediate MPP data moves as chunks, not plans.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tidb_tpu.dtypes import Kind, SQLType
+from tidb_tpu.expression.expr import ColumnRef, Expr, Func, Literal
+from tidb_tpu.planner import logical as L
+
+IR_VERSION = 1
+
+
+# -- types ------------------------------------------------------------------
+
+
+def _type_to_ir(t: Optional[SQLType]):
+    if t is None:
+        return None
+    return {"k": t.kind.value, "s": t.scale}
+
+
+def _type_from_ir(d) -> Optional[SQLType]:
+    if d is None:
+        return None
+    return SQLType(Kind(d["k"]), scale=d.get("s", 0))
+
+
+# -- expressions ------------------------------------------------------------
+
+
+def expr_to_ir(e: Optional[Expr]):
+    if e is None:
+        return None
+    if isinstance(e, ColumnRef):
+        return {"x": "col", "t": _type_to_ir(e.type), "name": e.name}
+    if isinstance(e, Literal):
+        return {"x": "lit", "t": _type_to_ir(e.type), "v": e.value}
+    if isinstance(e, Func):
+        return {
+            "x": "fn", "t": _type_to_ir(e.type), "op": e.op,
+            "args": [expr_to_ir(a) for a in e.args],
+        }
+    raise ValueError(f"unserializable expression {type(e).__name__}")
+
+
+def expr_from_ir(d) -> Optional[Expr]:
+    if d is None:
+        return None
+    x = d["x"]
+    if x == "col":
+        return ColumnRef(type=_type_from_ir(d["t"]), name=d["name"])
+    if x == "lit":
+        return Literal(type=_type_from_ir(d["t"]), value=d["v"])
+    if x == "fn":
+        return Func(
+            type=_type_from_ir(d["t"]), op=d["op"],
+            args=tuple(expr_from_ir(a) for a in d["args"]),
+        )
+    raise ValueError(f"bad expression tag {x!r}")
+
+
+def _schema_to_ir(sch: L.Schema):
+    return [
+        [c.qualifier, c.name, c.internal, _type_to_ir(c.type)]
+        for c in sch.cols
+    ]
+
+
+def _schema_from_ir(cols) -> L.Schema:
+    return L.Schema(
+        [L.OutCol(q, n, i, _type_from_ir(t)) for q, n, i, t in cols]
+    )
+
+
+# -- plan nodes -------------------------------------------------------------
+
+
+def plan_to_ir(p: L.LogicalPlan) -> Dict:
+    """Bound logical plan -> JSON-stable dict (the DAGRequest)."""
+    sch = _schema_to_ir(p.schema)
+    if isinstance(p, L.OneRow):
+        return {"n": "one_row", "schema": sch}
+    if isinstance(p, L.Scan):
+        return {
+            "n": "scan", "schema": sch, "db": p.db, "table": p.table,
+            "alias": p.alias, "columns": list(p.columns),
+        }
+    if isinstance(p, L.Selection):
+        return {
+            "n": "selection", "schema": sch,
+            "child": plan_to_ir(p.child), "pred": expr_to_ir(p.predicate),
+        }
+    if isinstance(p, L.Projection):
+        return {
+            "n": "projection", "schema": sch,
+            "child": plan_to_ir(p.child), "additive": p.additive,
+            "exprs": [[n, expr_to_ir(e)] for n, e in p.exprs],
+        }
+    if isinstance(p, L.Aggregate):
+        if p.gc_meta:
+            raise ValueError(
+                "GROUP_CONCAT plans execute host-assisted; they do not "
+                "cross the engine boundary"
+            )
+        return {
+            "n": "aggregate", "schema": sch, "child": plan_to_ir(p.child),
+            "groups": [[n, expr_to_ir(e)] for n, e in p.group_exprs],
+            "aggs": [
+                [n, f, expr_to_ir(a), bool(d)] for n, f, a, d in p.aggs
+            ],
+        }
+    if isinstance(p, L.JoinPlan):
+        return {
+            "n": "join", "schema": sch, "kind": p.kind,
+            "left": plan_to_ir(p.left), "right": plan_to_ir(p.right),
+            "equi": [
+                [expr_to_ir(l), expr_to_ir(r)] for l, r in p.equi_keys
+            ],
+            "residual": expr_to_ir(p.residual),
+            "null_aware": p.null_aware, "broadcast": p.broadcast,
+        }
+    if isinstance(p, L.Sort):
+        return {
+            "n": "sort", "schema": sch, "child": plan_to_ir(p.child),
+            "keys": [[expr_to_ir(e), bool(d)] for e, d in p.keys],
+        }
+    if isinstance(p, L.Limit):
+        return {
+            "n": "limit", "schema": sch, "child": plan_to_ir(p.child),
+            "count": p.count, "offset": p.offset,
+        }
+    if isinstance(p, L.Window):
+        return {
+            "n": "window", "schema": sch, "child": plan_to_ir(p.child),
+            "partition": [expr_to_ir(e) for e in p.partition_exprs],
+            "order": [[expr_to_ir(e), bool(d)] for e, d in p.order_exprs],
+            "descs": [
+                [n, f, expr_to_ir(a), off, bool(run),
+                 list(frame) if frame is not None else None]
+                for n, f, a, off, run, frame in p.descs
+            ],
+        }
+    if isinstance(p, L.UnionAll):
+        return {
+            "n": "union_all", "schema": sch,
+            "children": [plan_to_ir(c) for c in p.children],
+        }
+    raise ValueError(f"unserializable plan node {type(p).__name__}")
+
+
+def plan_from_ir(d: Dict) -> L.LogicalPlan:
+    n = d["n"]
+    sch = _schema_from_ir(d["schema"])
+    if n == "one_row":
+        return L.OneRow(sch)
+    if n == "scan":
+        return L.Scan(sch, d["db"], d["table"], d["alias"], list(d["columns"]))
+    if n == "selection":
+        return L.Selection(sch, plan_from_ir(d["child"]), expr_from_ir(d["pred"]))
+    if n == "projection":
+        return L.Projection(
+            sch, plan_from_ir(d["child"]),
+            [(nm, expr_from_ir(e)) for nm, e in d["exprs"]],
+            additive=d.get("additive", False),
+        )
+    if n == "aggregate":
+        return L.Aggregate(
+            sch, plan_from_ir(d["child"]),
+            [(nm, expr_from_ir(e)) for nm, e in d["groups"]],
+            [
+                (nm, f, expr_from_ir(a), bool(dd))
+                for nm, f, a, dd in d["aggs"]
+            ],
+        )
+    if n == "join":
+        return L.JoinPlan(
+            sch, d["kind"], plan_from_ir(d["left"]), plan_from_ir(d["right"]),
+            [(expr_from_ir(l), expr_from_ir(r)) for l, r in d["equi"]],
+            expr_from_ir(d.get("residual")),
+            bool(d.get("null_aware")), d.get("broadcast"),
+        )
+    if n == "sort":
+        return L.Sort(
+            sch, plan_from_ir(d["child"]),
+            [(expr_from_ir(e), bool(dd)) for e, dd in d["keys"]],
+        )
+    if n == "limit":
+        return L.Limit(
+            sch, plan_from_ir(d["child"]), d["count"], d.get("offset", 0)
+        )
+    if n == "window":
+        return L.Window(
+            sch, plan_from_ir(d["child"]),
+            [expr_from_ir(e) for e in d["partition"]],
+            [(expr_from_ir(e), bool(dd)) for e, dd in d["order"]],
+            [
+                (nm, f, expr_from_ir(a), off, bool(run),
+                 tuple(frame) if frame is not None else None)
+                for nm, f, a, off, run, frame in d["descs"]
+            ],
+        )
+    if n == "union_all":
+        return L.UnionAll(sch, [plan_from_ir(c) for c in d["children"]])
+    raise ValueError(f"bad plan tag {n!r}")
+
+
+def serialize_plan(p: L.LogicalPlan) -> bytes:
+    import json
+
+    return json.dumps({"v": IR_VERSION, "plan": plan_to_ir(p)}).encode()
+
+
+def deserialize_plan(data: bytes) -> L.LogicalPlan:
+    import json
+
+    d = json.loads(data.decode())
+    if d.get("v") != IR_VERSION:
+        raise ValueError(f"unsupported IR version {d.get('v')}")
+    return plan_from_ir(d["plan"])

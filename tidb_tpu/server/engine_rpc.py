@@ -1,0 +1,219 @@
+"""Frontend <-> device-engine RPC seam over the plan IR.
+
+Reference: the `kv.Client.Send(kv.Request{Data: tipb.DAGRequest})`
+contract (pkg/kv/kv.go:523) — the frontend serializes the pushdown plan
+and a remote engine executes it, streaming chunks back. unistore proves
+the whole SQL stack runs against that seam with an in-process loopback
+(`RPCClient.SendRequest`, pkg/store/mockstore/unistore/rpc.go:64).
+
+Here: EngineServer owns the catalog + device engine and serves
+length-prefixed JSON frames over TCP; EngineClient serializes a bound
+logical plan with planner/ir.py and gets rows back. A frontend process
+with no data of its own can plan SQL and execute it on a separate
+engine process — the multi-host frontend/engine split.
+
+Protocol safety: every request carries a correlation id echoed in the
+response (a desynced stream is detected, the connection is poisoned
+rather than returning the wrong query's rows); frames are capped; an
+optional shared secret authenticates connections (the reference guards
+this interior seam with cluster TLS certs — a bearer secret is the
+dependency-free analog)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+from tidb_tpu.planner.ir import IR_VERSION, plan_from_ir, plan_to_ir
+
+#: hard frame cap — a bogus length header must not buffer gigabytes
+MAX_FRAME = 64 << 20
+
+
+def _send_frame(sock, payload: bytes) -> None:
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame of {len(payload)}B exceeds {MAX_FRAME}B")
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        part = sock.recv(4 - len(hdr))
+        if not part:
+            return None
+        hdr += part
+    (n,) = struct.unpack("<I", hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n}B exceeds {MAX_FRAME}B")
+    out = b""
+    while len(out) < n:
+        part = sock.recv(min(1 << 20, n - len(out)))
+        if not part:
+            return None
+        out += part
+    return out
+
+
+class EngineServer:
+    """Device-engine side: executes serialized plans over its catalog.
+    Each connection gets its own PhysicalExecutor (the per-connection
+    Session pattern of server.py — executors' plan caches are not
+    thread-safe by design)."""
+
+    def __init__(
+        self,
+        catalog,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: Optional[str] = None,
+    ):
+        self.catalog = catalog
+        self.secret = secret
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                from tidb_tpu.planner.physical import PhysicalExecutor
+
+                executor = PhysicalExecutor(outer.catalog)
+                authed = outer.secret is None
+                while True:
+                    try:
+                        frame = _recv_frame(self.request)
+                    except ValueError:
+                        return  # oversized frame: drop the connection
+                    if frame is None:
+                        return
+                    req_id = None
+                    try:
+                        req = json.loads(frame.decode())
+                        req_id = req.get("id")
+                        if not authed:
+                            if req.get("auth") != outer.secret:
+                                _send_frame(
+                                    self.request,
+                                    json.dumps(
+                                        {
+                                            "id": req_id, "ok": False,
+                                            "error": "authentication failed",
+                                        }
+                                    ).encode(),
+                                )
+                                return
+                            authed = True
+                            if "plan" not in req:
+                                _send_frame(
+                                    self.request,
+                                    json.dumps(
+                                        {"id": req_id, "ok": True}
+                                    ).encode(),
+                                )
+                                continue
+                        resp = outer._execute(executor, req)
+                    except Exception as e:
+                        resp = json.dumps(
+                            {
+                                "id": req_id, "ok": False,
+                                "error": f"{type(e).__name__}: {e}",
+                            }
+                        ).encode()
+                    _send_frame(self.request, resp)
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = TCP((host, port), Handler)
+        self.port = self._tcp.server_address[1]
+
+    def _execute(self, executor, req) -> bytes:
+        from tidb_tpu.chunk import materialize_rows
+
+        if req.get("v") != IR_VERSION:
+            raise ValueError(f"unsupported IR version {req.get('v')}")
+        plan = plan_from_ir(req["plan"])
+        batch, dicts = executor.run(plan)
+        rows = materialize_rows(batch, list(plan.schema), dicts)
+        return json.dumps(
+            {
+                "id": req.get("id"),
+                "ok": True,
+                "columns": [c.name for c in plan.schema],
+                "rows": rows,
+            }
+        ).encode()
+
+    def start_background(self) -> threading.Thread:
+        th = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+        th.start()
+        return th
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+class EngineClient:
+    """Frontend side: holds only schemas; data lives on the engine."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        secret: Optional[str] = None,
+        timeout_s: float = 60.0,
+    ):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._secret = secret
+        self._next_id = 0
+        self._dead = False
+        if secret is not None:
+            # authenticate eagerly so bad credentials fail at connect
+            resp = self._call({"auth": secret})
+            if not resp.get("ok"):
+                raise PermissionError(resp.get("error", "auth failed"))
+
+    def _call(self, req: dict) -> dict:
+        """One correlated request/response. Any transport error or id
+        mismatch poisons the connection — a desynced stream must never
+        hand one query another query's rows."""
+        if self._dead:
+            raise ConnectionError("engine connection is poisoned; reconnect")
+        self._next_id += 1
+        req = dict(req)
+        req["id"] = self._next_id
+        if self._secret is not None:
+            req["auth"] = self._secret
+        try:
+            _send_frame(self._sock, json.dumps(req).encode())
+            frame = _recv_frame(self._sock)
+        except Exception:
+            self._dead = True
+            self._sock.close()
+            raise
+        if frame is None:
+            self._dead = True
+            raise ConnectionError("engine closed the connection")
+        resp = json.loads(frame.decode())
+        if resp.get("id") != self._next_id:
+            self._dead = True
+            self._sock.close()
+            raise ConnectionError(
+                f"response id {resp.get('id')} != request id {self._next_id}"
+            )
+        return resp
+
+    def execute_plan(self, plan) -> Tuple[List[str], List[tuple]]:
+        resp = self._call({"v": IR_VERSION, "plan": plan_to_ir(plan)})
+        if not resp.get("ok"):
+            raise RuntimeError(f"engine error: {resp.get('error')}")
+        return resp["columns"], [tuple(r) for r in resp["rows"]]
+
+    def close(self) -> None:
+        self._dead = True
+        self._sock.close()

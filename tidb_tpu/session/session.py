@@ -936,15 +936,11 @@ class Session:
             plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
         with self.tracer.span("executor.run"):
             batch, dicts = self.executor.run(plan)
-        types = {c.internal: c.type for c in plan.schema}
+        from tidb_tpu.chunk import materialize_rows
+
         with self.tracer.span("session.materialize"):
-            block = batch_to_block(batch, types, dicts)
+            rows = materialize_rows(batch, list(plan.schema), dicts)
         names = [c.name for c in plan.schema]
-        internals = [c.internal for c in plan.schema]
-        decoded = {i: block.columns[i].decode() for i in internals}
-        rows = [
-            tuple(decoded[i][r] for i in internals) for r in range(block.nrows)
-        ]
         return Result(names, rows, types=[c.type for c in plan.schema])
 
     # ------------------------------------------------------------------
