@@ -62,6 +62,7 @@ Q5_SQL = (
     "group by n_name order by revenue desc"
 )
 QUERIES = {"q1": Q1_SQL, "q5": Q5_SQL, "q6": Q6_SQL, "q18": Q18_SQL}
+# ladder #5: TPC-DS Q95 (correlated subqueries + multi-join)
 _TABLES = {
     "q1": ["orders", "lineitem"],
     "q6": ["orders", "lineitem"],
@@ -198,6 +199,44 @@ def measure(args) -> int:
 
     cat = Catalog()
     t0 = time.perf_counter()
+    if args.query == "q95":
+        from tidb_tpu.bench.tpcds import Q95_SQL, load_tpcds, numpy_q95
+
+        load_tpcds(cat, sf=args.sf, seed=1)
+        gen_s = time.perf_counter() - t0
+        sess = Session(cat, db="test")
+        nrows = cat.table("test", "web_sales").nrows
+        sql = Q95_SQL
+        sess.execute(sql)  # warmup
+        times = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            sess.execute(sql)
+            times.append(time.perf_counter() - t0)
+        dev_s = float(np.median(times))
+        base_times = []
+        for _ in range(max(args.repeat, 2)):
+            t0 = time.perf_counter()
+            numpy_q95(cat)
+            base_times.append(time.perf_counter() - t0)
+        base_s = float(np.median(base_times))
+        value = nrows / dev_s
+        baseline = nrows / base_s
+        print(json.dumps({
+            "metric": f"tpcds_q95_sf{args.sf:g}_rows_per_sec",
+            "value": round(value, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(value / baseline, 3),
+            "detail": {
+                "rows": nrows,
+                "device_median_s": round(dev_s, 4),
+                "numpy_baseline_s": round(base_s, 4),
+                "datagen_s": round(gen_s, 2),
+                "repeat": args.repeat,
+                "backend": backend,
+            },
+        }))
+        return 0
     tables = _TABLES[args.query]
     load_tpch(cat, sf=args.sf, tables=tables, seed=1)
     gen_s = time.perf_counter() - t0
@@ -429,7 +468,7 @@ def supervise(args, passthrough) -> int:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=1.0)
-    ap.add_argument("--query", default="q1", choices=sorted(QUERIES))
+    ap.add_argument("--query", default="q1", choices=sorted(QUERIES) + ["q95"])
     ap.add_argument("--repeat", type=int, default=5)
     ap.add_argument("--quick", action="store_true", help="sf=0.01 sanity run")
     ap.add_argument("--cpu", action="store_true", help="skip TPU, measure on CPU")

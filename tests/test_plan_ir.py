@@ -205,3 +205,20 @@ class TestRPCSafety:
             t.join()
         srv.shutdown()
         assert not errs
+
+
+def test_secreted_client_works_with_open_server(sess):
+    """Mismatched secret config must not brick the connection: a client
+    carrying a secret interoperates with a server that requires none."""
+    srv = EngineServer(sess.catalog, port=0)
+    srv.start_background()
+    try:
+        client = EngineClient("127.0.0.1", srv.port, secret="anything")
+        plan = build_query(
+            parse(QUERIES[0])[0], sess.catalog, "test", sess._scalar_subquery
+        )
+        cols, rows = client.execute_plan(plan)
+        assert len(rows) == 2
+        client.close()
+    finally:
+        srv.shutdown()

@@ -94,7 +94,11 @@ class EngineServer:
                         req = json.loads(frame.decode())
                         req_id = req.get("id")
                         if not authed:
-                            if req.get("auth") != outer.secret:
+                            import hmac
+
+                            if not hmac.compare_digest(
+                                str(req.get("auth") or ""), outer.secret
+                            ):
                                 _send_frame(
                                     self.request,
                                     json.dumps(
@@ -106,15 +110,15 @@ class EngineServer:
                                 )
                                 return
                             authed = True
-                            if "plan" not in req:
-                                _send_frame(
-                                    self.request,
-                                    json.dumps(
-                                        {"id": req_id, "ok": True}
-                                    ).encode(),
-                                )
-                                continue
-                        resp = outer._execute(executor, req)
+                        if "plan" not in req:
+                            # handshake/ping frame — fine whether or not
+                            # this server requires a secret (a secreted
+                            # client must interoperate with an open server)
+                            resp = json.dumps(
+                                {"id": req_id, "ok": True}
+                            ).encode()
+                        else:
+                            resp = outer._execute(executor, req)
                     except Exception as e:
                         resp = json.dumps(
                             {
@@ -122,7 +126,23 @@ class EngineServer:
                                 "error": f"{type(e).__name__}: {e}",
                             }
                         ).encode()
-                    _send_frame(self.request, resp)
+                    try:
+                        _send_frame(self.request, resp)
+                    except ValueError:
+                        # success payload larger than MAX_FRAME: report
+                        # instead of dropping the connection silently
+                        _send_frame(
+                            self.request,
+                            json.dumps(
+                                {
+                                    "id": req_id, "ok": False,
+                                    "error": (
+                                        f"result exceeds {MAX_FRAME} bytes; "
+                                        "narrow the query"
+                                    ),
+                                }
+                            ).encode(),
+                        )
 
         class TCP(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -174,8 +194,13 @@ class EngineClient:
         self._dead = False
         if secret is not None:
             # authenticate eagerly so bad credentials fail at connect
-            resp = self._call({"auth": secret})
+            try:
+                resp = self._call({"auth": secret})
+            except Exception:
+                self._sock.close()
+                raise
             if not resp.get("ok"):
+                self._sock.close()
                 raise PermissionError(resp.get("error", "auth failed"))
 
     def _call(self, req: dict) -> dict:
@@ -189,8 +214,15 @@ class EngineClient:
         req["id"] = self._next_id
         if self._secret is not None:
             req["auth"] = self._secret
+        payload = json.dumps(req).encode()
+        if len(payload) > MAX_FRAME:
+            # nothing was written: the stream is still synchronized, so
+            # don't poison the connection over a local size check
+            raise ValueError(
+                f"request of {len(payload)}B exceeds {MAX_FRAME}B"
+            )
         try:
-            _send_frame(self._sock, json.dumps(req).encode())
+            _send_frame(self._sock, payload)
             frame = _recv_frame(self._sock)
         except Exception:
             self._dead = True

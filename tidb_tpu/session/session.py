@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tidb_tpu.chunk import batch_to_block, column_from_values, HostBlock
+from tidb_tpu.chunk import column_from_values, materialize_rows, HostBlock
 from tidb_tpu.dtypes import Kind, SQLType
 from tidb_tpu.parser import ast, parse
 from tidb_tpu.planner import build_query
@@ -936,8 +936,6 @@ class Session:
             plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
         with self.tracer.span("executor.run"):
             batch, dicts = self.executor.run(plan)
-        from tidb_tpu.chunk import materialize_rows
-
         with self.tracer.span("session.materialize"):
             rows = materialize_rows(batch, list(plan.schema), dicts)
         names = [c.name for c in plan.schema]
