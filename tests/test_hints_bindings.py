@@ -1,0 +1,139 @@
+"""Optimizer hints (/*+ ... */) and SQL plan bindings.
+
+Reference: pkg/parser/hintparser.y (hint grammar), planner hint
+handling (BROADCAST_JOIN et al), and pkg/bindinfo (digest-matched hint
+sets applied to unhinted statements).
+"""
+
+import pytest
+
+from tidb_tpu.parser import parse
+from tidb_tpu.planner import build_query
+from tidb_tpu.planner import logical as L
+from tidb_tpu.session.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create table big (k int, v int)")
+    s.execute("create table small (k int, name varchar(8))")
+    s.execute(
+        "insert into big values " + ",".join(f"({i % 50},{i})" for i in range(5000))
+    )
+    s.execute(
+        "insert into small values " + ",".join(f"({i},'n{i}')" for i in range(50))
+    )
+    s.execute("analyze table big")
+    s.execute("analyze table small")
+    return s
+
+
+def _bcasts(s, sql):
+    plan = build_query(parse(sql)[0], s.catalog, "test", s._scalar_subquery)
+    out = []
+
+    def walk(p):
+        if isinstance(p, L.JoinPlan):
+            out.append(p.broadcast)
+        for a in ("child", "left", "right"):
+            c = getattr(p, a, None)
+            if c is not None:
+                walk(c)
+        for c in getattr(p, "children", []) or []:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+JOIN = "select * from big join small on big.k = small.k"
+
+
+class TestHints:
+    def test_cost_based_default(self, s):
+        assert _bcasts(s, JOIN) == ["right"]  # small side replicates
+
+    def test_no_broadcast_hint(self, s):
+        assert _bcasts(s, f"select /*+ NO_BROADCAST_JOIN() */ {JOIN[7:]}") == [None]
+
+    def test_force_side(self, s):
+        assert _bcasts(s, f"select /*+ BROADCAST_JOIN(big) */ {JOIN[7:]}") == [
+            "left"
+        ]
+
+    def test_unknown_hint_ignored(self, s):
+        assert _bcasts(s, f"select /*+ NOT_A_HINT(x) */ {JOIN[7:]}") == ["right"]
+
+    def test_hinted_results_identical(self, s):
+        plain = s.execute(JOIN + " order by big.v limit 5").rows
+        hinted = s.execute(
+            f"select /*+ NO_BROADCAST_JOIN() */ {JOIN[7:]} order by big.v limit 5"
+        ).rows
+        assert plain == hinted
+
+    def test_max_execution_time_hint(self, s):
+        import time
+
+        from tidb_tpu.utils import failpoint
+        from tidb_tpu.utils.sqlkiller import QueryKilled
+
+        # deterministic: slow the scan past the 1ms deadline; the next
+        # executor kill-safepoint must abort the statement
+        failpoint.enable("storage/scan", lambda: time.sleep(0.05))
+        try:
+            with pytest.raises(QueryKilled):
+                s.execute(
+                    "select /*+ MAX_EXECUTION_TIME(1) */ count(*), sum(v) "
+                    "from big where v > 1"
+                )
+        finally:
+            failpoint.disable("storage/scan")
+        s.execute("select count(*) from big")  # deadline was per-statement
+
+
+class TestBindings:
+    def test_binding_injects_hints(self, s):
+        s.execute(
+            f"create binding for {JOIN} using "
+            f"select /*+ NO_BROADCAST_JOIN() */ {JOIN[7:]}"
+        )
+        assert len(s.execute("show bindings").rows) == 1
+        # matched statement executes correctly with injected hints
+        r = s.execute(JOIN + " order by big.v limit 3")
+        assert len(r.rows) == 3
+        # literal-normalized digest: different constants still match
+        s.execute(JOIN + " order by big.v limit 5")
+        s.execute(f"drop binding for {JOIN}")
+        assert s.execute("show bindings").rows == []
+
+    def test_binding_requires_hints(self, s):
+        with pytest.raises(ValueError):
+            s.execute(f"create binding for {JOIN} using {JOIN}")
+
+    def test_binding_requires_super(self, s):
+        s.execute("create user pleb")
+        pleb = Session(catalog=s.catalog, user="pleb")
+        with pytest.raises(PermissionError):
+            pleb.execute(
+                f"create binding for {JOIN} using "
+                f"select /*+ NO_BROADCAST_JOIN() */ {JOIN[7:]}"
+            )
+
+    def test_mesh_executes_hinted_plan(self):
+        sm = Session(mesh_devices=8)
+        sm.execute("create table a (k int, v int)")
+        sm.execute("create table b (k int, n int)")
+        sm.execute(
+            "insert into a values " + ",".join(f"({i % 9},{i})" for i in range(300))
+        )
+        sm.execute("insert into b values " + ",".join(f"({i},{i})" for i in range(9)))
+        plain = sm.execute(
+            "select a.k, sum(a.v), max(b.n) from a join b on a.k = b.k "
+            "group by a.k order by a.k"
+        ).rows
+        hinted = sm.execute(
+            "select /*+ NO_BROADCAST_JOIN() */ a.k, sum(a.v), max(b.n) "
+            "from a join b on a.k = b.k group by a.k order by a.k"
+        ).rows
+        assert plain == hinted

@@ -92,16 +92,25 @@ class TestTTL:
 
 class TestMaxExecutionTime:
     def test_runaway_killed(self):
+        import time
+
+        from tidb_tpu.utils import failpoint
+
         s = Session()
         s.execute("create table big (a int)")
         s.execute(
             "insert into big values " + ",".join(f"({i})" for i in range(20000))
         )
         s.execute("set max_execution_time = 1")
-        with pytest.raises(QueryKilled):
-            s.execute(
-                "select count(*) from big b1, big b2 where b1.a + 0 = b2.a + 1"
-            )
+        # deterministic: a slow scan guarantees the deadline has passed
+        # by the executor's next kill-safepoint (a raw cross join can
+        # finish under 1ms once XLA's compile caches are warm)
+        failpoint.enable("storage/scan", lambda: time.sleep(0.05))
+        try:
+            with pytest.raises(QueryKilled):
+                s.execute("select count(*), sum(a) from big where a > 1")
+        finally:
+            failpoint.disable("storage/scan")
         s.execute("set max_execution_time = 0")
         # limit cleared: statement completes
         s.execute("select count(*) from big")

@@ -135,6 +135,8 @@ class Select:
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    # optimizer hints: ((name, (args...)), ...) from /*+ ... */
+    hints: tuple = ()
 
 
 @dataclasses.dataclass
@@ -315,6 +317,13 @@ class TxnControl:
 class AnalyzeTable:
     db: Optional[str]
     name: str
+
+
+@dataclasses.dataclass
+class CreateBinding:
+    for_sql: str
+    using_sql: str
+    drop: bool = False
 
 
 @dataclasses.dataclass

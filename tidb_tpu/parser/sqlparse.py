@@ -41,7 +41,8 @@ def dataclasses_replace(obj, **kw):
 
 _TOKEN_RE = re.compile(
     r"""
-    (?P<ws>\s+|\#[^\n]*|--[^\n]*|/\*.*?\*/)
+    (?P<hint>/\*\+.*?\*/)
+  | (?P<ws>\s+|\#[^\n]*|--[^\n]*|/\*.*?\*/)
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
   | (?P<bq>`[^`]*`)
@@ -65,7 +66,7 @@ KEYWORDS = {
     "group_concat", "separator", "index", "unique",
     "user", "grant", "revoke", "identified", "privileges", "to", "grants",
     "for", "auto_increment", "ttl", "backup", "restore", "import",
-    "collate",
+    "collate", "binding", "bindings",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -104,7 +105,9 @@ def tokenize(sql: str) -> List[Token]:
             continue
         text = m.group()
         kind = m.lastgroup
-        if kind == "bq":
+        if kind == "hint":
+            out.append(Token("hint", text[3:-2].strip(), m.start()))
+        elif kind == "bq":
             out.append(Token("id", text[1:-1], m.start()))
         elif kind == "sysvar":
             out.append(Token("sysvar", text[2:], m.start()))
@@ -133,7 +136,16 @@ _TYPE_MAP = {
 
 class Parser:
     def __init__(self, sql: str):
-        self.toks = tokenize(sql)
+        self.sql = sql  # raw text (binding statements capture substrings)
+        toks = tokenize(sql)
+        # hints are only honored right after the SELECT verb (the one
+        # position parse_select consumes them); anywhere else /*+ ... */
+        # degrades to a comment, as before hint tokens existed
+        self.toks = [
+            t for j, t in enumerate(toks)
+            if t.kind != "hint"
+            or (j > 0 and toks[j - 1].kind == "kw" and toks[j - 1].text == "select")
+        ]
         self.i = 0
 
     # -- token helpers -----------------------------------------------------
@@ -229,6 +241,8 @@ class Parser:
                 return ast.Show("variables", db=self._show_like())
             if self.accept_kw("variables"):
                 return ast.Show("variables", db=self._show_like())
+            if self.accept_kw("bindings"):
+                return ast.Show("bindings")
             if self.accept_kw("grants"):
                 user = None
                 if self.accept_kw("for"):
@@ -446,8 +460,25 @@ class Parser:
         body = self.parse_select_or_union()
         return ast.With(ctes, body, recursive=recursive)
 
+    @staticmethod
+    def _parse_hints(text: str) -> tuple:
+        """'/*+ NAME(a, 1) NAME2() */' inner text -> ((name, (args...)), ...)
+        (reference: pkg/parser/hintparser.y; unknown hints are kept and
+        ignored downstream, like MySQL warns-and-continues)."""
+        out = []
+        for m in re.finditer(r"([A-Za-z_][A-Za-z0-9_]*)\s*\(([^)]*)\)", text):
+            name = m.group(1).lower()
+            args = tuple(
+                a.strip().strip("'\"`") for a in m.group(2).split(",") if a.strip()
+            )
+            out.append((name, args))
+        return tuple(out)
+
     def parse_select(self) -> ast.Select:
         self.expect_kw("select")
+        hints = ()
+        if self.cur.kind == "hint":
+            hints = self._parse_hints(self.advance().text)
         distinct = False
         if self.accept_kw("distinct"):
             distinct = True
@@ -485,7 +516,7 @@ class Parser:
         return ast.Select(
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
-            distinct=distinct,
+            distinct=distinct, hints=hints,
         )
 
     def parse_int(self) -> int:
@@ -1040,6 +1071,20 @@ class Parser:
         if self.accept_kw("database"):
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
+        if self.accept_kw("binding"):
+            # CREATE BINDING FOR <stmt> USING <stmt-with-hints>
+            self.expect_kw("for")
+            start = self.cur.pos
+            self.parse_select_or_union()
+            if not self.at_kw("using"):
+                raise ParseError("expected USING in CREATE BINDING")
+            for_sql = self.sql[start : self.cur.pos]
+            self.advance()  # using
+            ustart = self.cur.pos
+            self.parse_select_or_union()
+            return ast.CreateBinding(
+                for_sql.strip(), self.sql[ustart : self.cur.pos].strip()
+            )
         if self.accept_kw("user"):
             ine = self._if_not_exists()
             name = self._user_name()
@@ -1209,6 +1254,13 @@ class Parser:
         self.expect_kw("drop")
         if self.accept_kw("database"):
             return ast.DropDatabase(self.expect_ident())
+        if self.accept_kw("binding"):
+            self.expect_kw("for")
+            start = self.cur.pos
+            self.parse_select_or_union()
+            return ast.CreateBinding(
+                self.sql[start : self.cur.pos].strip(), "", drop=True
+            )
         if self.accept_kw("user"):
             if_exists = False
             if self.accept_kw("if"):

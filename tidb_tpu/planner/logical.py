@@ -480,12 +480,18 @@ class SelectBuilder:
     their parser ASTs (resolved before catalog tables, like the
     reference's CTE name scope)."""
 
-    def __init__(self, catalog, current_db: str, subquery_value_fn=None, ctes=None):
+    def __init__(
+        self, catalog, current_db: str, subquery_value_fn=None, ctes=None,
+        hints=(),
+    ):
         self.catalog = catalog
         self.db = current_db
         # subquery_value_fn(select_ast) -> Literal  (executes scalar subq)
         self.subquery_value_fn = subquery_value_fn
         self.ctes = ctes or {}
+        # optimizer hints ((name, (args...)), ...) from /*+ ... */
+        # (reference pkg/parser/hintparser.y + planner hint handling)
+        self.hints = tuple(hints or ())
         # deterministic per-query naming for decorrelated scalar columns
         # (plan reprs key the jit cache, so names must be parse-stable)
         self._dsq_counter = 0
@@ -589,6 +595,26 @@ class SelectBuilder:
             [Projection(psch, lj, exprs_l), Projection(psch, aj, exprs_a)],
         )
 
+    def _apply_join_hints(self, left, right, bcast):
+        """BROADCAST_JOIN(alias): force-replicate the named side;
+        NO_BROADCAST_JOIN(): force hash repartition. Unknown hints are
+        ignored (MySQL warns-and-continues)."""
+        if not self.hints:
+            return bcast
+        lq = {(c.qualifier or "").lower() for c in left.schema}
+        rq = {(c.qualifier or "").lower() for c in right.schema}
+        for name, args in self.hints:
+            if name == "no_broadcast_join":
+                return None
+            if name == "broadcast_join":
+                for a in args:
+                    a = a.lower()
+                    if a in rq:
+                        return "right"
+                    if a in lq:
+                        return "left"
+        return bcast
+
     def _build_join(self, kind, left, right, on, schema) -> JoinPlan:
         lq = {(c.qualifier or "").lower() for c in left.schema}
         rq = {(c.qualifier or "").lower() for c in right.schema}
@@ -673,6 +699,7 @@ class SelectBuilder:
         el = C.est_rows(left, self.catalog, smap)
         er = C.est_rows(right, self.catalog, smap)
         bcast = _broadcast_choice(el, er)
+        bcast = self._apply_join_hints(left, right, bcast)
         if kind != "inner" and bcast == "left":
             bcast = None
         return JoinPlan(schema, kind, left, right, equi, res_bound, broadcast=bcast)
@@ -768,7 +795,10 @@ def build_select(
     """Full SELECT lowering: FROM -> WHERE (with pushdown + IN/EXISTS to
     semi/anti joins) -> AGG -> HAVING -> additive projection -> SORT ->
     LIMIT -> final projection."""
-    b = SelectBuilder(catalog, current_db, subquery_value_fn, ctes)
+    b = SelectBuilder(
+        catalog, current_db, subquery_value_fn, ctes,
+        hints=getattr(sel, "hints", ()),
+    )
 
     if sel.from_ is None:
         plan = OneRow(Schema([]))

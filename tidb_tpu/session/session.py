@@ -334,7 +334,9 @@ class Session:
                 "create" if isinstance(s, ast.CreateDatabase) else "drop",
                 s.name.lower(),
             )
-        elif isinstance(s, (ast.CreateUser, ast.DropUser, ast.GrantStmt)):
+        elif isinstance(
+            s, (ast.CreateUser, ast.DropUser, ast.GrantStmt, ast.CreateBinding)
+        ):
             self._require_super()
         elif isinstance(s, ast.BackupRestore):
             self._require_super()
@@ -492,6 +494,34 @@ class Session:
             self.catalog.schema_version += 1
             clear_scan_cache()
             r = Result([], [])
+        elif isinstance(s, ast.CreateBinding):
+            self._require_super()
+            from tidb_tpu.utils.metrics import sql_digest
+
+            if not hasattr(self.catalog, "bindings"):
+                self.catalog.bindings = {}
+            digest = sql_digest(s.for_sql)
+            if s.drop:
+                self.catalog.bindings.pop(digest, None)
+            else:
+                if not isinstance(parse(s.for_sql)[0], ast.Select):
+                    raise ValueError(
+                        "bindings currently apply to plain SELECT "
+                        "statements only"
+                    )
+                using = parse(s.using_sql)[0]
+                hints = tuple(getattr(using, "hints", ()) or ())
+                if not hints:
+                    raise ValueError(
+                        "CREATE BINDING: the USING statement carries no "
+                        "/*+ ... */ hints"
+                    )
+                self.catalog.bindings[digest] = {
+                    "for_sql": s.for_sql,
+                    "using_sql": s.using_sql,
+                    "hints": hints,
+                }
+            r = Result([], [])
         elif isinstance(s, ast.BackupRestore):
             failpoint.inject("br/statement")
             from tidb_tpu.storage.persist import load_catalog, save_catalog
@@ -612,6 +642,12 @@ class Session:
             return Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        if s.what == "bindings":
+            rows = [
+                (e["for_sql"], e["using_sql"])
+                for e in getattr(self.catalog, "bindings", {}).values()
+            ]
+            return Result(["Original_sql", "Bind_sql"], rows)
         if s.what == "grants":
             user = (s.db or self.user).lower()
             if user != self.user.lower():
@@ -925,21 +961,61 @@ class Session:
             raise ValueError("scalar subquery returned more than one row")
         return Literal(value=r.rows[0][0])
 
+    def _apply_binding(self, s):
+        """SQL plan binding: a CREATE BINDING whose normalized digest
+        matches this statement injects its hints (reference:
+        pkg/bindinfo digest-matched hint sets)."""
+        src = getattr(s, "_source_sql", None)
+        bindings = getattr(self.catalog, "bindings", None)
+        if not src or not bindings or not isinstance(s, ast.Select):
+            return s
+        from tidb_tpu.utils.metrics import sql_digest
+
+        entry = bindings.get(sql_digest(src))
+        if entry is None:
+            return s
+        s.hints = tuple(entry["hints"]) or s.hints
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "tidb_tpu_binding_hits_total", "statements matched to bindings"
+        ).inc()
+        return s
+
     def _run_select(self, s, ctes=None) -> Result:
         if isinstance(s, ast.With) and s.recursive:
             return self._run_recursive_with(s, ctes)
         if isinstance(s, ast.Select) and s.from_ is None:
             return self._run_tableless(s)
-        # spans mirror the reference's (session.ExecuteStmt ->
-        # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
-        with self.tracer.span("session.plan"):
-            plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
-        with self.tracer.span("executor.run"):
-            batch, dicts = self.executor.run(plan)
-        with self.tracer.span("session.materialize"):
-            rows = materialize_rows(batch, list(plan.schema), dicts)
-        names = [c.name for c in plan.schema]
-        return Result(names, rows, types=[c.type for c in plan.schema])
+        s = self._apply_binding(s)
+        # per-statement engine hints (session-scoped, reset after)
+        old_stream = self.executor.stream_rows
+        for name, args in getattr(s, "hints", ()) or ():
+            if name == "stream_rows" and args:
+                try:
+                    self.executor.stream_rows = int(args[0]) or None
+                except ValueError:
+                    pass
+            elif name == "max_execution_time" and args:
+                try:
+                    import time as _t
+
+                    self.killer.deadline = _t.monotonic() + int(args[0]) / 1000
+                except ValueError:
+                    pass
+        try:
+            # spans mirror the reference's (session.ExecuteStmt ->
+            # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
+            with self.tracer.span("session.plan"):
+                plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
+            with self.tracer.span("executor.run"):
+                batch, dicts = self.executor.run(plan)
+            with self.tracer.span("session.materialize"):
+                rows = materialize_rows(batch, list(plan.schema), dicts)
+            names = [c.name for c in plan.schema]
+            return Result(names, rows, types=[c.type for c in plan.schema])
+        finally:
+            self.executor.stream_rows = old_stream
 
     # ------------------------------------------------------------------
     def _run_insert(self, s: ast.Insert) -> Result:

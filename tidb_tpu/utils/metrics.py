@@ -121,6 +121,11 @@ def sql_digest(sql: str) -> str:
         for t in tokenize(sql):
             if t.kind in ("num", "str"):
                 parts.append("?")
+            elif t.kind == "hint" or (t.kind == "op" and t.text == ";"):
+                # hints and statement separators are not semantic: the
+                # hinted and unhinted forms of a query share one digest
+                # (reference digester strips hints)
+                continue
             elif t.kind == "eof":
                 break
             else:
