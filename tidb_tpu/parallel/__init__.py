@@ -1,6 +1,7 @@
 from tidb_tpu.parallel.mesh import make_mesh, shard_batch, unshard_batch  # noqa: F401
 from tidb_tpu.parallel.exchange import (  # noqa: F401
     hash_repartition,
+    range_repartition,
     broadcast_gather,
 )
 from tidb_tpu.parallel.fragment import (  # noqa: F401

@@ -69,12 +69,72 @@ def hash_repartition(
     from tidb_tpu.utils.failpoint import inject
 
     inject("exchange/repartition")
-    n, B = n_devices, bucket_capacity
-    cap = batch.capacity
+    n = n_devices
     k = key_fn(batch)
     target = partition_of(k, n)
     # invalid rows go to a virtual overflow bucket n (never sent)
     target = jnp.where(batch.row_valid, target, n)
+    return exchange_by_target(batch, target, n, bucket_capacity, axis)
+
+
+def range_repartition(
+    batch: Batch,
+    rank_vals: jax.Array,
+    n_devices: int,
+    bucket_capacity: int,
+    axis: str = "d",
+) -> Tuple[Batch, jax.Array]:
+    """Range-partition rows by a scalar ranking value using sampled
+    splitters: device i receives every row whose rank falls in the i-th
+    global range, so locally sorted shards concatenate to a total order
+    — the distributed ORDER BY exchange (reference: range-partitioned
+    ShuffleExec + the external-sort splitter pass in
+    pkg/lightning/backend/external; classic sample sort).
+
+    Splitters are computed collectively (identical on every device):
+    each shard contributes n evenly-spaced local quantiles of its valid
+    ranks; the gathered candidates' global quantiles become the n-1 cut
+    points. Equal ranks always land in one bucket (ties stay local)."""
+
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("exchange/range-repartition")
+    n = n_devices
+    cap = batch.capacity
+    v = jnp.where(batch.row_valid, rank_vals, jnp.inf)
+    srt = jnp.sort(v)
+    nvalid = jnp.sum(batch.row_valid.astype(jnp.int32))
+    pos = jnp.clip((jnp.arange(1, n + 1) * nvalid) // (n + 1), 0, cap - 1)
+    samples = srt[pos]
+    allsamp = jnp.sort(jax.lax.all_gather(samples, axis).reshape(-1))
+    m = allsamp.shape[0]
+    spos = jnp.clip((jnp.arange(1, n) * m) // n, 0, m - 1)
+    splitters = allsamp[spos]
+    target = jnp.searchsorted(splitters, rank_vals, side="right").astype(
+        jnp.int32
+    )
+    target = jnp.where(batch.row_valid, target, n)
+    out, dropped = exchange_by_target(batch, target, n, bucket_capacity, axis)
+    # max rows any device actually received: the TRUE bucket-capacity
+    # need — reported so the host can SHRINK the exchange tile toward
+    # O(rows/n) instead of pinning it at the discovery default
+    max_recv = jax.lax.pmax(
+        jnp.sum(out.row_valid.astype(jnp.int64)), axis
+    )
+    return out, dropped, max_recv
+
+
+def exchange_by_target(
+    batch: Batch,
+    target: jax.Array,
+    n: int,
+    bucket_capacity: int,
+    axis: str = "d",
+) -> Tuple[Batch, jax.Array]:
+    """all_to_all exchange of rows to explicit per-row target devices
+    (bucket n = drop). Shared by hash and range repartition."""
+    B = bucket_capacity
+    cap = batch.capacity
 
     sorted_t, perm = jax.lax.sort(
         [target.astype(jnp.int32), jnp.arange(cap, dtype=jnp.int32)], num_keys=1
