@@ -75,3 +75,54 @@ def test_metrics_counters_and_prometheus_render(sess):
     text = REGISTRY.render()
     assert "# TYPE tidb_tpu_statements_total counter" in text
     assert "tidb_tpu_query_duration_seconds_count" in text
+
+
+class TestHTTPStatus:
+    """Side HTTP port: /status /metrics /schema /settings (reference
+    pkg/server/http_status.go)."""
+
+    @pytest.fixture()
+    def srv(self):
+        import time
+
+        from tidb_tpu.server.http_status import StatusServer
+        from tidb_tpu.session.session import Session
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        s = Session(catalog=cat)
+        s.execute("create table t (a int primary key, b varchar(8))")
+        s.execute("insert into t values (1,'x')")
+        srv = StatusServer(cat, port=0)
+        srv.start_background()
+        time.sleep(0.1)
+        yield srv
+        srv.shutdown()
+
+    def _get(self, srv, path):
+        import urllib.request
+
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10
+        ).read().decode()
+
+    def test_status(self, srv):
+        import json
+
+        assert "tidb-tpu" in json.loads(self._get(srv, "/status"))["version"]
+
+    def test_metrics_prometheus_text(self, srv):
+        body = self._get(srv, "/metrics")
+        assert "tidb_tpu_" in body and "# TYPE" in body
+
+    def test_schema_endpoints(self, srv):
+        import json
+
+        assert json.loads(self._get(srv, "/schema"))["test"] == ["t"]
+        t = json.loads(self._get(srv, "/schema/test/t"))
+        assert t["primary_key"] == ["a"] and t["rows"] == 1
+
+    def test_settings(self, srv):
+        import json
+
+        assert "tidb_mem_quota_query" in json.loads(self._get(srv, "/settings"))

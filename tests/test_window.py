@@ -83,3 +83,69 @@ def test_window_over_group_by(s):
         "from e group by dept order by rnk"
     )
     assert r.rows == [("eng", 500, 1), ("ops", 200, 2), ("hr", 75, 3)]
+
+
+class TestValueAndDistributionFuncs:
+    """NTILE / FIRST_VALUE / LAST_VALUE / NTH_VALUE / PERCENT_RANK /
+    CUME_DIST with MySQL default framing (reference
+    pkg/executor/aggfuncs window value functions)."""
+
+    @pytest.fixture()
+    def s(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table w (g int, v int)")
+        s.execute(
+            "insert into w values (1,10),(1,20),(1,20),(1,40),"
+            "(2,5),(2,7),(2,7)"
+        )
+        return s
+
+    def test_ntile(self, s):
+        r = s.execute(
+            "select g, v, ntile(2) over (partition by g order by v) "
+            "from w order by g, v"
+        )
+        assert [x[2] for x in r.rows] == [1, 1, 2, 2, 1, 1, 2]
+
+    def test_first_last_value(self, s):
+        r = s.execute(
+            "select g, first_value(v) over (partition by g order by v), "
+            "last_value(v) over (partition by g order by v) "
+            "from w order by g, v"
+        )
+        assert [x[1:] for x in r.rows] == [
+            (10, 10), (10, 20), (10, 20), (10, 40),
+            (5, 5), (5, 7), (5, 7),
+        ]
+
+    def test_nth_value_null_until_in_frame(self, s):
+        r = s.execute(
+            "select g, v, nth_value(v, 2) over (partition by g order by v) "
+            "from w order by g, v"
+        )
+        # first row of each partition: the 2nd row is outside its frame
+        assert [x[2] for x in r.rows] == [None, 20, 20, 20, None, 7, 7]
+
+    def test_percent_rank_cume_dist(self, s):
+        r = s.execute(
+            "select g, v, percent_rank() over (partition by g order by v), "
+            "cume_dist() over (partition by g order by v) "
+            "from w order by g, v"
+        )
+        pr = [round(x[2], 4) for x in r.rows]
+        cd = [round(x[3], 4) for x in r.rows]
+        assert pr == [0.0, 0.3333, 0.3333, 1.0, 0.0, 0.5, 0.5]
+        assert cd == [0.25, 0.75, 0.75, 1.0, 0.3333, 1.0, 1.0]
+
+    def test_require_order_by(self, s):
+        with pytest.raises(Exception):
+            s.execute("select ntile(2) over (partition by g) from w")
+
+    def test_value_funcs_reject_explicit_frames(self, s):
+        with pytest.raises(Exception):
+            s.execute(
+                "select first_value(v) over (partition by g order by v "
+                "rows between 1 preceding and current row) from w"
+            )

@@ -26,6 +26,8 @@ def main() -> int:
     ap.add_argument("-P", "--port", type=int, default=None)
     ap.add_argument("--path", default=None,
                     help="persistence dir: load on boot, snapshot on shutdown")
+    ap.add_argument("--status-port", type=int, default=None,
+                    help="HTTP status/metrics port (reference :10080)")
     ap.add_argument("--store", default=None, choices=["tpu"],
                     help="storage/compute engine (TPU device engine)")
     ap.add_argument("--tpch", type=float, default=None, metavar="SF",
@@ -54,7 +56,8 @@ def main() -> int:
         print(f"generating TPC-H sf={args.tpch} ...", flush=True)
         load_tpch(catalog, sf=args.tpch)
 
-    srv = Server(catalog, host=cfg.host, port=cfg.port)
+    sp = args.status_port if args.status_port is not None else cfg.status_port
+    srv = Server(catalog, host=cfg.host, port=cfg.port, status_port=sp)
     srv.stats_handle.interval_s = cfg.auto_analyze_interval_s
     print(
         f"tidb_tpu listening on {cfg.host}:{srv.port} (store={cfg.store})",

@@ -108,10 +108,37 @@ def window_op(
     )
     seg_c = jnp.clip(seg, 0, cap)
 
+    # shared per-sort-order arrays (computed once, used by several
+    # window functions): last row index per partition, peer-group ids,
+    # last row index per peer group, and each peer group's start index
+    idx64 = jnp.arange(cap, dtype=jnp.int64)
+    last_idx = (
+        jnp.full(cap + 1, 0, dtype=jnp.int64)
+        .at[jnp.where(srow_valid, seg_c, cap)]
+        .max(idx64, mode="drop")[seg_c]
+    )
+    pg = jnp.cumsum(peer_change.astype(jnp.int64))
+    pgc = jnp.clip(pg, 0, cap)
+    peer_last = (
+        jnp.full(cap + 1, 0, dtype=jnp.int64)
+        .at[jnp.where(srow_valid, pgc, cap)]
+        .max(idx64, mode="drop")[pgc]
+    )
+    peer_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(peer_change, idx64, 0)
+    )
+    aux = {
+        "last_idx": last_idx, "peer_last": peer_last,
+        "peer_start": peer_start,
+    }
+
     new_cols = {}
     inv = jnp.zeros(cap, dtype=jnp.int32).at[perm].set(idx32)
     for d in descs:
-        col = _compute(d, batch, perm, srow_valid, seg_c, first_idx, peer_change, cap)
+        col = _compute(
+            d, batch, perm, srow_valid, seg_c, first_idx, peer_change, cap,
+            aux,
+        )
         # scatter back to original row positions
         new_cols[d.out_name] = DevCol(col.data[inv], col.valid[inv])
 
@@ -120,19 +147,46 @@ def window_op(
     return Batch(cols, batch.row_valid)
 
 
-def _compute(d: WindowDesc, batch, perm, srow_valid, seg, first_idx, peer_change, cap):
+def _compute(
+    d: WindowDesc, batch, perm, srow_valid, seg, first_idx, peer_change,
+    cap, aux,
+):
     idx = jnp.arange(cap, dtype=jnp.int64)
     pos = idx - first_idx[seg]
     if d.func == "row_number":
         return DevCol(pos + 1, srow_valid)
     if d.func == "rank":
-        peer_start = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(peer_change, idx, 0)
-        )
-        return DevCol(peer_start - first_idx[seg] + 1, srow_valid)
+        return DevCol(aux["peer_start"] - first_idx[seg] + 1, srow_valid)
     if d.func == "dense_rank":
         c = jnp.cumsum(peer_change.astype(jnp.int64))
         return DevCol(c - c[first_idx[seg]] + 1, srow_valid)
+
+    if d.func in ("ntile", "percent_rank", "cume_dist"):
+        nrows = aux["last_idx"] - first_idx[seg] + 1
+        if d.func == "ntile":
+            n = jnp.int64(d.offset)
+            # MySQL: first (rows % n) buckets get one extra row
+            base = nrows // n
+            rem = nrows % n
+            big = rem * (base + 1)
+            bucket = jnp.where(
+                pos < big,
+                pos // jnp.maximum(base + 1, 1),
+                rem + (pos - big) // jnp.maximum(base, 1),
+            )
+            return DevCol(bucket + 1, srow_valid)
+        if d.func == "percent_rank":
+            rank = aux["peer_start"] - first_idx[seg] + 1
+            denom = jnp.maximum(nrows - 1, 1).astype(jnp.float64)
+            return DevCol(
+                (rank - 1).astype(jnp.float64) / denom, srow_valid
+            )
+        # cume_dist: peers' LAST position / partition rows
+        return DevCol(
+            (aux["peer_last"] - first_idx[seg] + 1).astype(jnp.float64)
+            / jnp.maximum(nrows, 1).astype(jnp.float64),
+            srow_valid,
+        )
 
     if d.arg is None:  # COUNT(*) OVER ...
         data = jnp.ones(cap, dtype=jnp.int64)
@@ -151,6 +205,24 @@ def _compute(d: WindowDesc, batch, perm, srow_valid, seg, first_idx, peer_change
         return DevCol(
             jnp.where(ok, data[src], jnp.zeros_like(data[src])),
             ok & valid[src],
+        )
+
+    if d.func in ("first_value", "last_value", "nth_value"):
+        # MySQL default frame is RANGE UNBOUNDED PRECEDING..CURRENT ROW:
+        # the frame ends at the current row's LAST PEER
+        peer_last = aux["peer_last"]
+        if d.func == "first_value":
+            src = first_idx[seg].astype(jnp.int64)
+        elif d.func == "nth_value":
+            # NULL until the nth row has entered the frame
+            src = first_idx[seg].astype(jnp.int64) + (d.offset - 1)
+        else:
+            src = peer_last
+        ok = srow_valid & (src <= peer_last) & (src >= 0)
+        srcc = jnp.clip(src, 0, cap - 1)
+        return DevCol(
+            jnp.where(ok, data[srcc], jnp.zeros_like(data[srcc])),
+            ok & valid[srcc],
         )
 
     # whole-partition aggregates via segment reduce; running variants via

@@ -74,7 +74,11 @@ KEYWORDS = {
     "unbounded", "preceding", "following", "current", "row",
 }
 
-_WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
+_WINDOW_ONLY_FUNCS = {
+    "row_number", "rank", "dense_rank", "lag", "lead",
+    "ntile", "first_value", "last_value", "nth_value",
+    "percent_rank", "cume_dist",
+}
 
 # keywords that may also appear as function names in expression position
 # (MySQL grammar does the same disambiguation, parser.y sysFuncCall rules)
@@ -874,13 +878,43 @@ class Parser:
                         args.append(self.parse_expr())
                 self.expect_op(")")
                 if name.lower() in _WINDOW_ONLY_FUNCS:
+                    low = name.lower()
                     offset = 1
-                    if name.lower() in ("lag", "lead") and len(args) > 1:
+                    if low in ("lag", "lead") and len(args) > 1:
                         o = args[1]
                         if isinstance(o, ast.Const):
                             offset = int(o.value)
+                    if low == "nth_value":
+                        # MySQL: exactly two args, N a positive constant
+                        if len(args) != 2:
+                            raise ParseError(
+                                "NTH_VALUE expects (expr, N)"
+                            )
+                        o = args[1]
+                        if (
+                            not isinstance(o, ast.Const)
+                            or not isinstance(o.value, int)
+                            or o.value < 1
+                        ):
+                            raise ParseError(
+                                "NTH_VALUE's N must be a positive integer "
+                                "constant"
+                            )
+                        offset = int(o.value)
                     arg = args[0] if args else None
-                    return self._parse_over(name.lower(), arg, offset)
+                    if low == "ntile":
+                        # NTILE(n): the bucket count rides in offset
+                        if (
+                            not args
+                            or not isinstance(args[0], ast.Const)
+                            or not isinstance(args[0].value, int)
+                            or args[0].value < 1
+                        ):
+                            raise ParseError(
+                                "NTILE expects a positive integer constant"
+                            )
+                        offset, arg = int(args[0].value), None
+                    return self._parse_over(low, arg, offset)
                 return ast.Call(name.lower(), args)
             if self.accept_op("."):
                 col = self.expect_ident()

@@ -1692,23 +1692,37 @@ def _build_windows(plan, win_calls: List[ast.WindowCall], rewrite: Dict) -> Logi
             name = f"_w{widx}"
             widx += 1
             arg = lower(call.arg) if call.arg is not None else None
-            if call.func in ("row_number", "rank", "dense_rank", "count"):
+            if call.func in ("row_number", "rank", "dense_rank", "count", "ntile"):
                 t = INT64
-            elif call.func == "avg":
+            elif call.func in ("avg", "percent_rank", "cume_dist"):
                 t = FLOAT64
-            elif call.func in ("sum", "min", "max", "lag", "lead"):
+            elif call.func in (
+                "sum", "min", "max", "lag", "lead",
+                "first_value", "last_value", "nth_value",
+            ):
                 if arg is None:
                     raise PlanError(f"{call.func} window needs an argument")
                 t = arg.type
             else:
                 raise PlanError(f"unsupported window function {call.func}")
-            if call.func in ("row_number", "rank", "dense_rank") and not proto.order_by:
+            if call.func in (
+                "row_number", "rank", "dense_rank", "ntile",
+                "percent_rank", "cume_dist",
+            ) and not proto.order_by:
                 raise PlanError(f"{call.func}() requires ORDER BY in its OVER clause")
             frame = call.frame
             call_running = running
             if frame is not None:
-                if call.func in ("row_number", "rank", "dense_rank", "lag", "lead"):
+                if call.func in (
+                    "row_number", "rank", "dense_rank", "lag", "lead",
+                    "ntile", "percent_rank", "cume_dist",
+                ):
                     frame = None  # frame clause is ignored for ranking funcs
+                elif call.func in ("first_value", "last_value", "nth_value"):
+                    raise PlanError(
+                        f"{call.func} with an explicit frame is not "
+                        "supported (default framing only)"
+                    )
                 elif frame == (None, 0):
                     frame, call_running = None, True  # running aggregate
                 elif frame == (None, None):

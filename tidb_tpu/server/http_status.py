@@ -1,0 +1,115 @@
+"""HTTP status API: /status, /metrics, /schema, /settings.
+
+Reference: pkg/server/http_status.go — the side port serving liveness
+(`/status`), Prometheus metrics (`/metrics`), schema introspection
+(`/schema`, backed by infoschema), and settings. pprof endpoints are
+Go-specific; the Python analog exposes the same operational surface
+over the same paths.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class StatusServer:
+    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 10080):
+        self.catalog = catalog
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body, ctype="application/json"):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?")[0].rstrip("/") or "/status"
+                    if path == "/status":
+                        from tidb_tpu import __version__ as ver
+                    else:
+                        ver = None
+                    if path == "/status":
+                        self._send(200, json.dumps(
+                            {
+                                "connections": 0,
+                                "version": f"8.0.11-tidb-tpu-{ver}",
+                                "git_hash": "embedded",
+                            }
+                        ))
+                    elif path == "/metrics":
+                        from tidb_tpu.utils.metrics import REGISTRY
+
+                        self._send(
+                            200, REGISTRY.render(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/schema":
+                        out = {}
+                        for db in outer.catalog.databases():
+                            if db.startswith("_"):
+                                continue
+                            out[db] = outer.catalog.tables(db)
+                        self._send(200, json.dumps(out))
+                    elif path.startswith("/schema/"):
+                        parts = path.split("/")[2:]
+                        db = parts[0]
+                        if len(parts) == 1:
+                            self._send(
+                                200, json.dumps(outer.catalog.tables(db))
+                            )
+                        else:
+                            t = outer.catalog.table(db, parts[1])
+                            self._send(200, json.dumps(
+                                {
+                                    "name": t.name,
+                                    "columns": [
+                                        {"name": n, "type": repr(ty).lower()}
+                                        for n, ty in t.schema.columns
+                                    ],
+                                    "primary_key": t.schema.primary_key,
+                                    "indexes": t.indexes,
+                                    "rows": t.nrows,
+                                }
+                            ))
+                    elif path == "/settings":
+                        from tidb_tpu.utils.sysvar import SysVars
+
+                        sv = SysVars(outer.catalog.global_sysvars)
+                        self._send(200, json.dumps(
+                            {k: str(v) for k, v in sv.all().items()}
+                        ))
+                    else:
+                        self._send(404, json.dumps({"error": "not found"}))
+                except Exception as e:
+                    self._send(500, json.dumps({"error": str(e)}))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._started = False
+
+    def start_background(self) -> threading.Thread:
+        self._started = True
+        th = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="http-status",
+        )
+        th.start()
+        return th
+
+    def shutdown(self) -> None:
+        # BaseServer.shutdown() blocks on an event only serve_forever
+        # sets — never call it if serving never started
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()

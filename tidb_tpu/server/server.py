@@ -31,7 +31,13 @@ COM_STMT_RESET = 0x1A
 
 
 class Server:
-    def __init__(self, catalog: Optional[Catalog] = None, host: str = "127.0.0.1", port: int = 4000):
+    def __init__(
+        self,
+        catalog: Optional[Catalog] = None,
+        host: str = "127.0.0.1",
+        port: int = 4000,
+        status_port: Optional[int] = None,
+    ):
         self.catalog = catalog or Catalog()
         self.host = host
         self.port = port
@@ -55,10 +61,21 @@ class Server:
 
         self.stats_handle = StatsHandle(self.catalog, interval_s=30.0)
         self.ttl_worker = TTLWorker(self.catalog, interval_s=60.0)
+        # side HTTP port: /status /metrics /schema /settings (reference
+        # pkg/server/http_status.go); None disables
+        self.status_server = None
+        if status_port is not None:
+            from tidb_tpu.server.http_status import StatusServer
+
+            self.status_server = StatusServer(
+                self.catalog, host=host, port=status_port
+            )
 
     def serve_forever(self) -> None:
         self.stats_handle.start()
         self.ttl_worker.start()
+        if self.status_server is not None:
+            self.status_server.start_background()
         self._tcp.serve_forever()
 
     def start_background(self) -> threading.Thread:
@@ -67,6 +84,8 @@ class Server:
         return th
 
     def shutdown(self) -> None:
+        if self.status_server is not None:
+            self.status_server.shutdown()
         self.ttl_worker.stop()
         self.stats_handle.stop()
         self._tcp.shutdown()

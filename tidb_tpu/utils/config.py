@@ -17,6 +17,8 @@ from typing import Dict, Optional
 class Config:
     host: str = "127.0.0.1"
     port: int = 4000
+    # HTTP status/metrics side port (None disables; reference :10080)
+    status_port: Optional[int] = None
     # persistence directory: catalog loads from it on boot and snapshots
     # back on graceful shutdown (reference --path / storage bootstrap)
     path: Optional[str] = None
