@@ -1,0 +1,179 @@
+"""INSERT...SELECT, CREATE TABLE AS SELECT, INTERSECT/EXCEPT, REPLACE.
+
+Reference: pkg/executor/insert.go (+SelectionExec source), replace.go,
+and the MySQL 8.0.31 set operations (parser setOpr grammar). Set ops
+ride the group-by kernel, so NULL rows compare equal (SQL set
+semantics) without a special join path.
+"""
+
+import pytest
+
+from tidb_tpu.session.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create table a (x int, y varchar(4))")
+    s.execute(
+        "insert into a values (1,'p'),(2,'q'),(null,'n'),(11,'p'),(12,'q')"
+    )
+    s.execute("create table c (x int)")
+    s.execute("insert into c values (1),(11),(null)")
+    return s
+
+
+class TestInsertSelect:
+    def test_basic(self, s):
+        s.execute("insert into a select x + 100, upper(y) from a where x < 10")
+        assert s.execute(
+            "select x, y from a where x > 100 order by x"
+        ).rows == [(101, "P"), (102, "Q")]
+
+    def test_column_subset(self, s):
+        s.execute("insert into a (x) select x + 200 from c where x is not null")
+        assert s.execute(
+            "select x, y from a where x > 200 order by x"
+        ).rows == [(201, None), (211, None)]
+
+    def test_arity_mismatch(self, s):
+        with pytest.raises(ValueError):
+            s.execute("insert into a select x from c")
+
+    def test_autoinc_filled(self):
+        s = Session()
+        s.execute("create table t (id int auto_increment, v int)")
+        s.execute("create table src (v int)")
+        s.execute("insert into src values (7),(8)")
+        s.execute("insert into t (v) select v from src")
+        assert s.execute("select id, v from t order by id").rows == [
+            (1, 7), (2, 8),
+        ]
+
+
+class TestCreateTableAsSelect:
+    def test_schema_derived(self, s):
+        s.execute("create table b as select x, upper(y) as yy from a where x > 10")
+        assert s.execute("select * from b order by x").rows == [
+            (11, "P"), (12, "Q"),
+        ]
+        t = s.catalog.table("test", "b")
+        assert t.schema.names == ["x", "yy"]
+
+    def test_exists_guard(self, s):
+        s.execute("create table b as select x from a")
+        with pytest.raises(ValueError):
+            s.execute("create table b as select x from a")
+        s.execute("create table if not exists b as select y from a")  # no-op
+        assert s.catalog.table("test", "b").schema.names == ["x"]
+
+
+class TestSetOps:
+    def test_intersect_with_nulls(self, s):
+        # NULL = NULL under set semantics (both sides contain a NULL row)
+        assert s.execute(
+            "select x from a intersect select x from c order by x"
+        ).rows == [(None,), (1,), (11,)]
+
+    def test_except(self, s):
+        assert s.execute(
+            "select x from a except select x from c order by x"
+        ).rows == [(2,), (12,)]
+
+    def test_chained_and_tail(self, s):
+        assert s.execute(
+            "select x from a except select x from c except select 2 order by x"
+        ).rows == [(12,)]
+        assert s.execute(
+            "select x from a intersect select x from c order by x desc limit 1"
+        ).rows == [(11,)]
+
+    def test_multi_column(self, s):
+        assert s.execute(
+            "select x, y from a intersect select x, y from a where x > 1 "
+            "order by x"
+        ).rows == [(2, "q"), (11, "p"), (12, "q")]
+
+    def test_distinct_semantics(self, s):
+        s.execute("insert into a values (1,'p'),(1,'p')")  # duplicates
+        assert s.execute(
+            "select x from a intersect select x from c order by x"
+        ).rows == [(None,), (1,), (11,)]
+
+    def test_all_rejected(self, s):
+        with pytest.raises(Exception):
+            s.execute("select x from a intersect all select x from c")
+
+    def test_mesh_parity(self):
+        sm, s1 = Session(mesh_devices=8), Session()
+        for ss in (sm, s1):
+            ss.execute("create table a (x int)")
+            ss.execute("create table b (x int)")
+            ss.execute(
+                "insert into a values "
+                + ",".join(f"({i % 40})" for i in range(400))
+            )
+            ss.execute(
+                "insert into b values "
+                + ",".join(f"({i % 25})" for i in range(100))
+            )
+        for q in [
+            "select x from a intersect select x from b order by x",
+            "select x from a except select x from b order by x",
+        ]:
+            assert sm.execute(q).rows == s1.execute(q).rows, q
+
+
+class TestReplace:
+    def test_replace_by_pk(self, s):
+        s.execute("create table r (k int primary key, v varchar(4))")
+        s.execute("insert into r values (1,'a'),(2,'b')")
+        s.execute("replace into r values (1,'z'),(3,'c')")
+        assert s.execute("select * from r order by k").rows == [
+            (1, "z"), (2, "b"), (3, "c"),
+        ]
+
+    def test_replace_by_unique_string_key(self, s):
+        s.execute("create table u2 (k varchar(4), v int)")
+        s.execute("create unique index uk on u2 (k)")
+        s.execute("insert into u2 values ('a',1)")
+        s.execute("replace into u2 values ('a',9),('b',2)")
+        assert s.execute("select * from u2 order by k").rows == [
+            ("a", 9), ("b", 2),
+        ]
+
+    def test_replace_without_keys_is_plain_insert(self, s):
+        s.execute("create table nk (v int)")
+        s.execute("insert into nk values (1)")
+        s.execute("replace into nk values (1)")
+        assert s.execute("select count(*) from nk").rows == [(2,)]
+
+
+class TestReviewRegressions:
+    def test_ctas_requires_select_privilege(self):
+        s = Session()
+        s.execute("create table a (x int)")
+        s.execute("insert into a values (1)")
+        s.execute("create user bob")
+        s.execute("grant create on test.* to bob")
+        bob = Session(catalog=s.catalog, user="bob")
+        with pytest.raises(PermissionError):
+            bob.execute("create table leak as select x from a")
+
+    def test_tableless_ctas(self):
+        s = Session()
+        s.execute("create table t1 as select 1 as a, 'x' as b")
+        assert s.execute("select * from t1").rows == [(1, "x")]
+        assert s.catalog.table("test", "t1").schema.names == ["a", "b"]
+
+    def test_replace_composite_pk_rejected(self):
+        s = Session()
+        s.execute("create table cp (a int, b int, v int, primary key (a, b))")
+        with pytest.raises(NotImplementedError):
+            s.execute("replace into cp values (1,1,9)")
+
+    def test_replace_intra_statement_keeps_last(self):
+        s = Session()
+        s.execute("create table r (k int primary key, v varchar(4))")
+        s.execute("replace into r values (1,'a'),(1,'b')")
+        assert s.execute("select * from r").rows == [(1, "b")]

@@ -180,6 +180,8 @@ class CreateTable:
     # TTL table option: (column, interval value, unit) — rows whose
     # column is older than NOW() - interval are purged by the TTL worker
     ttl: Optional[tuple] = None
+    # CREATE TABLE ... AS SELECT: source query (columns derived)
+    as_query: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -261,6 +263,23 @@ class Insert:
     table: str
     columns: Optional[List[str]]
     rows: List[List[object]]  # rows of Const/expressions
+    # INSERT ... SELECT: source query instead of VALUES rows
+    query: Optional[object] = None
+    # REPLACE INTO semantics: delete PK/unique-key conflicts first
+    replace: bool = False
+
+
+@dataclasses.dataclass
+class SetOp:
+    """INTERSECT / EXCEPT between two query blocks (MySQL 8.0.31+;
+    DISTINCT semantics)."""
+
+    op: str  # 'intersect' | 'except'
+    left: object
+    right: object
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -66,7 +66,7 @@ KEYWORDS = {
     "group_concat", "separator", "index", "unique",
     "user", "grant", "revoke", "identified", "privileges", "to", "grants",
     "for", "auto_increment", "ttl", "backup", "restore", "import",
-    "collate", "binding", "bindings",
+    "collate", "binding", "bindings", "intersect", "except",
     "global", "session", "variables", "trace", "begin", "commit", "alter", "column", "add", "default",
     "rollback", "start", "transaction", "analyze", "load", "data",
     "infile", "fields", "terminated", "lines", "ignore", "rows",
@@ -208,6 +208,13 @@ class Parser:
 
     # -- entry -------------------------------------------------------------
     def parse_stmt(self):
+        if self.cur.kind == "id" and self.cur.text.lower() == "replace":
+            # REPLACE INTO ... (statement position only; replace() stays
+            # a plain function elsewhere)
+            self.advance()
+            stmt = self.parse_insert(skip_verb=True)
+            stmt.replace = True
+            return stmt
         if self.at_kw("select") or self.at_op("("):
             return self.parse_select_or_union()
         if self.at_kw("with"):
@@ -381,20 +388,7 @@ class Parser:
         return ast.LoadData(db, name, path, sep)
 
     # -- SELECT / UNION / WITH --------------------------------------------
-    def parse_select_or_union(self):
-        first = self._parse_select_block()
-        if not self.at_kw("union"):
-            return first
-        selects = [first]
-        is_all = True
-        while self.accept_kw("union"):
-            if self.accept_kw("all"):
-                part_all = True
-            else:
-                self.accept_kw("distinct")
-                part_all = False
-            is_all = is_all and part_all
-            selects.append(self._parse_select_block())
+    def _order_limit_tail(self):
         order_by: List[ast.OrderItem] = []
         limit = offset = None
         if self.accept_kw("order"):
@@ -410,6 +404,51 @@ class Parser:
                 limit, offset = a, self.parse_int()
             else:
                 limit = a
+        return order_by, limit, offset
+
+    def parse_select_or_union(self):
+        first = self._parse_select_block()
+        while self.at_kw("intersect", "except"):
+            op = self.advance().text
+            if self.accept_kw("all"):
+                raise ParseError(f"{op.upper()} ALL is not supported")
+            self.accept_kw("distinct")
+            right = self._parse_select_block()
+            first = ast.SetOp(op, first, right)
+        if isinstance(first, ast.SetOp):
+            order_by, limit, offset = self._order_limit_tail()
+            # the greedy SELECT parser attaches a trailing ORDER BY/LIMIT
+            # to the last branch; it belongs to the whole set operation
+            # (same hoist as the UNION path below)
+            last = first.right
+            if not order_by and isinstance(last, ast.Select) and last.order_by:
+                order_by = last.order_by
+                first.right = dataclasses_replace(last, order_by=[])
+            last = first.right
+            if (
+                limit is None
+                and isinstance(last, ast.Select)
+                and last.limit is not None
+            ):
+                limit, offset = last.limit, last.offset
+                first.right = dataclasses_replace(
+                    last, limit=None, offset=None
+                )
+            first.order_by, first.limit, first.offset = order_by, limit, offset
+            return first
+        if not self.at_kw("union"):
+            return first
+        selects = [first]
+        is_all = True
+        while self.accept_kw("union"):
+            if self.accept_kw("all"):
+                part_all = True
+            else:
+                self.accept_kw("distinct")
+                part_all = False
+            is_all = is_all and part_all
+            selects.append(self._parse_select_block())
+        order_by, limit, offset = self._order_limit_tail()
         # MySQL: a trailing ORDER BY/LIMIT after the last unparenthesized
         # branch belongs to the whole UNION, but the greedy SELECT parser
         # already attached it to that branch — move it up.
@@ -1148,6 +1187,14 @@ class Parser:
         self.expect_kw("table")
         ine = self._if_not_exists()
         db, name = self._qualified_name()
+        if self.accept_kw("as") or self.at_kw("select", "with"):
+            # CREATE TABLE ... AS SELECT (columns derived from the query)
+            q = (
+                self.parse_with()
+                if self.at_kw("with")
+                else self.parse_select_or_union()
+            )
+            return ast.CreateTable(db, name, [], [], ine, as_query=q)
         self.expect_op("(")
         cols: List[ast.ColumnDef] = []
         pk: List[str] = []
@@ -1318,8 +1365,9 @@ class Parser:
         db, name = self._qualified_name()
         return ast.DropTable(db, name, if_exists)
 
-    def parse_insert(self):
-        self.expect_kw("insert")
+    def parse_insert(self, skip_verb: bool = False):
+        if not skip_verb:
+            self.expect_kw("insert")
         self.accept_kw("into")
         db, name = self._qualified_name()
         columns = None
@@ -1328,6 +1376,13 @@ class Parser:
             while self.accept_op(","):
                 columns.append(self.expect_ident())
             self.expect_op(")")
+        if self.at_kw("select", "with"):
+            q = (
+                self.parse_with()
+                if self.at_kw("with")
+                else self.parse_select_or_union()
+            )
+            return ast.Insert(db, name, columns, [], query=q)
         self.expect_kw("values")
         rows = []
         while True:

@@ -732,7 +732,96 @@ def build_query(
         return build_query(stmt.body, catalog, current_db, subquery_value_fn, merged)
     if isinstance(stmt, ast.Union):
         return _build_union(stmt, catalog, current_db, subquery_value_fn, ctes)
+    if isinstance(stmt, ast.SetOp):
+        return _build_setop(stmt, catalog, current_db, subquery_value_fn, ctes)
     return build_select(stmt, catalog, current_db, subquery_value_fn, ctes)
+
+
+def _build_setop(so: ast.SetOp, catalog, db, subquery_value_fn, ctes) -> LogicalPlan:
+    """INTERSECT / EXCEPT (DISTINCT set semantics) via the group-by
+    kernel: tag each side, union, group by every column counting the
+    side tags, filter. NULLs group together (SQL set semantics treats
+    NULL rows as equal — the claim-loop group kernel already does),
+    which a join-based rewrite would get wrong. Reference:
+    pkg/parser grammar setOpr + the executor's hash-based set ops."""
+    from tidb_tpu.dtypes import INT64 as _I64, common_type
+
+    plans = [
+        build_query(so.left, catalog, db, subquery_value_fn, ctes),
+        build_query(so.right, catalog, db, subquery_value_fn, ctes),
+    ]
+    arity = len(plans[0].schema.cols)
+    if len(plans[1].schema.cols) != arity:
+        raise PlanError(f"{so.op.upper()} branches have different column counts")
+    names = [c.name for c in plans[0].schema.cols]
+    targets = []
+    for i in range(arity):
+        t = plans[0].schema.cols[i].type
+        u_t = plans[1].schema.cols[i].type
+        targets.append(t if u_t == t else common_type(t, u_t))
+    children = []
+    for side, p in enumerate(plans):
+        exprs = []
+        for i, tgt in enumerate(targets):
+            c = p.schema.cols[i]
+            ref = ColumnRef(type=c.type, name=c.internal)
+            e: Expr = ref if c.type == tgt else Func(type=tgt, op="cast", args=(ref,))
+            exprs.append((f"_u{i}", e))
+        exprs.append(("_sl", Literal(type=_I64, value=1 if side == 0 else 0)))
+        exprs.append(("_sr", Literal(type=_I64, value=0 if side == 0 else 1)))
+        sch = Schema(
+            [OutCol(None, names[i], f"_u{i}", targets[i]) for i in range(arity)]
+            + [OutCol(None, "_sl", "_sl", _I64), OutCol(None, "_sr", "_sr", _I64)]
+        )
+        children.append(Projection(sch, p, exprs))
+    u_schema = children[0].schema
+    plan: LogicalPlan = UnionAll(u_schema, children)
+    groups = [
+        (f"_u{i}", ColumnRef(type=targets[i], name=f"_u{i}"))
+        for i in range(arity)
+    ]
+    aggs = [
+        ("_cl", "sum", ColumnRef(type=_I64, name="_sl"), False),
+        ("_cr", "sum", ColumnRef(type=_I64, name="_sr"), False),
+    ]
+    agg_schema = Schema(
+        [OutCol(None, names[i], f"_u{i}", targets[i]) for i in range(arity)]
+        + [OutCol(None, "_cl", "_cl", _I64), OutCol(None, "_cr", "_cr", _I64)]
+    )
+    plan = Aggregate(agg_schema, plan, groups, aggs)
+    zero = Literal(type=_I64, value=0)
+    left_present = Func(
+        type=None, op="gt", args=(ColumnRef(type=_I64, name="_cl"), zero)
+    )
+    right_cond = Func(
+        type=None,
+        op="gt" if so.op == "intersect" else "eq",
+        args=(ColumnRef(type=_I64, name="_cr"), zero),
+    )
+    pred = Func(type=None, op="and", args=(left_present, right_cond))
+    from tidb_tpu.expression.expr import bind_expr
+
+    pred = bind_expr(pred, agg_schema.types())
+    plan = Selection(agg_schema, plan, pred)
+    out_schema = Schema(
+        [OutCol(None, names[i], f"_u{i}", targets[i]) for i in range(arity)]
+    )
+    plan = Projection(
+        out_schema, plan,
+        [(f"_u{i}", ColumnRef(type=targets[i], name=f"_u{i}")) for i in range(arity)],
+    )
+    if so.order_by:
+        ob = ExprBinder(out_schema)
+        keys = []
+        for oi in so.order_by:
+            e = oi.expr
+            if isinstance(e, ast.Const) and isinstance(e.value, int):
+                e = ast.Name(None, names[e.value - 1])
+            keys.append((ob.bind(e), oi.desc))
+        plan = Sort(out_schema, plan, keys)
+    if so.limit is not None:
+        plan = Limit(out_schema, plan, so.limit, so.offset or 0)
+    return plan
 
 
 def _build_union(u: ast.Union, catalog, db, subquery_value_fn, ctes) -> LogicalPlan:

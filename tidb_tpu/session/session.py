@@ -299,7 +299,7 @@ class Session:
         users = self.catalog.users
         if users.is_super(self.user):
             return
-        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.Explain)):
+        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp, ast.Explain)):
             for tr in self._ast_tables(s):
                 db = (tr.db or self.db).lower()
                 # CTE names / derived tables aren't catalog tables
@@ -323,6 +323,13 @@ class Session:
                     self._check_priv("select", db, tr.name.lower())
         elif isinstance(s, ast.CreateTable):
             self._check_priv("create", (s.db or self.db).lower())
+            # CTAS reads its source: require SELECT on every table
+            # (otherwise a CREATE-only user exfiltrates data)
+            if s.as_query is not None:
+                for tr in self._ast_tables(s.as_query):
+                    db = (tr.db or self.db).lower()
+                    if self.catalog.has_table(db, tr.name):
+                        self._check_priv("select", db, tr.name.lower())
         elif isinstance(s, ast.DropTable):
             self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
         elif isinstance(s, ast.AlterTable):
@@ -385,7 +392,7 @@ class Session:
         )
         failpoint.inject("session/stmt-start")
         self._enforce_privileges(s)
-        if isinstance(s, (ast.Select, ast.Union, ast.With)):
+        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
             s = self._resolve_session_funcs(s)
         try:
             self.executor.quota_bytes = int(
@@ -399,8 +406,44 @@ class Session:
             ) or None
         except Exception:
             pass
-        if isinstance(s, (ast.Select, ast.Union, ast.With)):
+        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
             r = self._run_select(s)
+        elif isinstance(s, ast.CreateTable) and s.as_query is not None:
+            # CREATE TABLE ... AS SELECT: schema derived from the query.
+            # Existence check FIRST — don't execute a potentially huge
+            # query only to throw the result away.
+            if self.catalog.has_table(s.db or self.db, s.name):
+                if s.if_not_exists:
+                    return Result([], [])
+                raise ValueError(f"table {s.name} exists")
+            res = self._run_select(self._resolve_session_funcs(s.as_query))
+            from tidb_tpu.dtypes import INT64 as _I
+
+            types = res.types
+            if types is None:
+                # infer from the first row (tableless SELECTs)
+                from tidb_tpu.expression.expr import literal_type
+
+                first = res.rows[0] if res.rows else ()
+                types = [
+                    literal_type(v) if v is not None else _I for v in first
+                ] or [_I] * len(res.columns)
+            cols = []
+            seen = set()
+            for name, typ in zip(res.columns, types):
+                n = name.lower()
+                if n in seen or not n.isidentifier():
+                    n = f"col_{len(cols)}"
+                seen.add(n)
+                cols.append((n, typ if typ is not None else _I))
+            self.catalog.create_table(
+                s.db or self.db, s.name, TableSchema(cols), False
+            )
+            t = self.catalog.table(s.db or self.db, s.name)
+            if res.rows:
+                t.append_rows([list(r) for r in res.rows])
+            clear_scan_cache()
+            r = Result([], [], affected=len(res.rows))
         elif isinstance(s, ast.CreateTable):
             schema = TableSchema(
                 [(c.name.lower(), c.type) for c in s.columns],
@@ -1029,6 +1072,20 @@ class Session:
         if unknown:
             raise ValueError(f"unknown columns {sorted(unknown)}")
         rows = []
+        if s.query is not None:
+            # INSERT ... SELECT: run the source query, map by position
+            res = self._run_select(self._resolve_session_funcs(s.query))
+            if res.columns and len(res.columns) != len(cols):
+                raise ValueError(
+                    f"INSERT ... SELECT arity mismatch: {len(res.columns)} "
+                    f"columns for {len(cols)} targets"
+                )
+            dflt = getattr(t, "defaults", None) or {}
+            for row in res.rows:
+                vals = dict(zip(cols, row))
+                rows.append(
+                    [vals[n] if n in vals else dflt.get(n) for n in names]
+                )
         for row in s.rows:
             if len(row) != len(cols):
                 raise ValueError("VALUES arity mismatch")
@@ -1037,6 +1094,8 @@ class Session:
             rows.append(
                 [vals[n] if n in vals else dflt.get(n) for n in names]
             )
+        if getattr(s, "replace", False):
+            self._replace_conflicts(t, names, rows)
         ac = t.autoinc_col
         if ac is not None:
             ai = names.index(ac)
@@ -1052,6 +1111,76 @@ class Session:
         t.append_rows(rows)
         clear_scan_cache()
         return Result([], [], affected=len(rows))
+
+    def _replace_conflicts(self, t, names, rows) -> None:
+        """REPLACE INTO: delete existing rows whose PK or any UNIQUE key
+        collides with an incoming row, then the normal append inserts
+        the replacements (reference: pkg/executor/replace.go — delete
+        then insert under one statement)."""
+        import numpy as np
+
+        key_cols = []
+        pk = t.schema.primary_key
+        if pk and len(pk) > 1:
+            raise NotImplementedError(
+                "REPLACE INTO on a composite primary key is not supported"
+            )
+        if pk and len(pk) == 1:
+            key_cols.append(pk[0])
+        for iname in t.unique_indexes:
+            c = t.indexes.get(iname)
+            if c and c[0] not in key_cols:
+                key_cols.append(c[0])
+        if not key_cols or not rows:
+            return
+        # MySQL REPLACE keeps the LAST row when one statement carries
+        # duplicate keys — dedupe incoming rows before the append
+        for col in key_cols:
+            i = names.index(col)
+            seen = set()
+            kept = []
+            for r in reversed(rows):
+                k = r[i]
+                if k is not None and k in seen:
+                    continue
+                if k is not None:
+                    seen.add(k)
+                kept.append(r)
+            rows[:] = list(reversed(kept))
+        for col in key_cols:
+            i = names.index(col)
+            incoming = {r[i] for r in rows if r[i] is not None}
+            if not incoming:
+                continue
+            typ = t.schema.types[col]
+            from tidb_tpu.dtypes import Kind as _K
+
+            if typ.kind == _K.STRING:
+                keep_masks = []
+                for b in t.blocks():
+                    c = b.columns[col]
+                    if c.dictionary is None or not len(c.dictionary):
+                        keep_masks.append(np.ones(b.nrows, dtype=bool))
+                        continue
+                    vals = c.dictionary[np.clip(c.data, 0, len(c.dictionary) - 1)]
+                    hit = np.array(
+                        [bool(v) and str(x) in incoming for v, x in zip(c.valid, vals)]
+                    )
+                    keep_masks.append(~hit)
+            else:
+                from tidb_tpu.chunk import column_from_values
+
+                enc = column_from_values(sorted(incoming), typ)
+                targets = np.sort(enc.data)
+                keep_masks = []
+                for b in t.blocks():
+                    c = b.columns[col]
+                    pos = np.searchsorted(targets, c.data)
+                    pos = np.clip(pos, 0, len(targets) - 1)
+                    hit = c.valid & (targets[pos] == c.data)
+                    keep_masks.append(~hit)
+            if any((~m).any() for m in keep_masks):
+                t.delete_where(keep_masks)
 
     @staticmethod
     def _const_value(e):

@@ -225,14 +225,16 @@ class Table:
                 )
             if len(vals):
                 svals, _perm, nvalid = self._sorted_index(col)
-                pos = np.searchsorted(svals[:nvalid], vals)
-                hit = (pos < nvalid) & (
-                    svals[np.minimum(pos, max(nvalid - 1, 0))] == vals
-                )
-                if nvalid and hit.any():
-                    raise ValueError(
-                        f"duplicate entry for unique index {iname!r} ({col})"
+                if nvalid:
+                    pos = np.searchsorted(svals[:nvalid], vals)
+                    hit = (pos < nvalid) & (
+                        svals[np.minimum(pos, nvalid - 1)] == vals
                     )
+                    if hit.any():
+                        raise ValueError(
+                            f"duplicate entry for unique index {iname!r} "
+                            f"({col})"
+                        )
 
     def next_autoid(self, n: int = 1) -> int:
         """Allocate n consecutive AUTO_INCREMENT ids; returns the first."""
