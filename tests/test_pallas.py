@@ -1,0 +1,60 @@
+"""Pallas slot-table aggregation kernel (opt-in; interpret-mode tests).
+
+The kernel runs in a SUBPROCESS because tests/conftest.py deregisters
+non-CPU backend factories (to keep the TPU tunnel out of tests), which
+breaks pallas's TPU-lowering registration at import time in this
+process. A clean CPU child imports pallas fine and runs the kernel in
+interpret mode against the float64 jnp oracle.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+CHILD = textwrap.dedent(
+    """
+    import sys; sys.path.insert(0, "/root/repo")
+    import tidb_tpu
+    import numpy as np, jax.numpy as jnp
+    from tidb_tpu.executor.pallas_kernels import (
+        slot_sums_f32, slot_sums_reference,
+    )
+
+    rng = np.random.default_rng(7)
+    for (A, N, S) in [(1, 100, 4), (4, 3000, 8), (10, 5000, 12), (2, 1024, 6)]:
+        vals = jnp.asarray(rng.integers(0, 100, (A, N)).astype(np.float32))
+        contrib = jnp.asarray(rng.random((A, N)) < 0.8)
+        # seg includes the overflow slot S (dropped rows)
+        seg = jnp.asarray(rng.integers(0, S + 1, N).astype(np.int32))
+        got = slot_sums_f32(vals, contrib, seg, S, interpret=True)
+        exp = slot_sums_reference(vals, contrib, seg, S).astype(jnp.float32)
+        assert got.shape == (A, S), got.shape
+        assert bool(jnp.allclose(got, exp, rtol=1e-6)), (A, N, S)
+    # exact counting: values=1 contributions count rows per slot exactly
+    ones = jnp.ones((1, 4096), jnp.float32)
+    contrib = jnp.ones((1, 4096), bool)
+    seg = jnp.asarray((np.arange(4096) % 3).astype(np.int32))
+    got = slot_sums_f32(ones, contrib, seg, 3, interpret=True)
+    assert got.tolist() == [[1366.0, 1365.0, 1365.0]], got.tolist()
+    print("PALLAS_OK")
+    """
+)
+
+
+def test_slot_sums_interpret_matches_oracle():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", CHILD],
+        capture_output=True, text=True, timeout=600, cwd="/tmp", env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "PALLAS_OK" in out.stdout
+
+
+def test_disabled_by_default():
+    from tidb_tpu.executor.pallas_kernels import pallas_enabled
+
+    assert not pallas_enabled()

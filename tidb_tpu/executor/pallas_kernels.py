@@ -1,0 +1,103 @@
+"""Pallas TPU kernels for aggregation hot loops (opt-in).
+
+The engine's default lowering leaves fusion to XLA, which already fuses
+scan→filter→project→reduce chains well. The one shape XLA lowers
+sub-optimally is the small-slot-table aggregation (`_masked_backend` in
+executor/aggregate.py): S slots × A aggregates become S·A separate
+full-array masked reductions — up to ~60 HBM passes for TPC-H Q1.
+This kernel computes the whole [A, S] slot table in ONE pass over the
+rows: grid over row tiles, VMEM accumulators, one-hot dot per tile
+(reference hot loop: the per-group accumulation inside
+pkg/executor/aggregate/agg_hash_partial_worker.go).
+
+Numerics: accumulation is float32 inside the kernel. That is exact for
+COUNTs and for int32-range values, but NOT bit-identical to the
+engine's float64/int64 semantics — so the kernel is **opt-in**
+(`TIDB_TPU_PALLAS=1`), wired only where the engine can tolerate or
+compensate, and every use is verified against the jnp path in interpret
+mode (tests/test_pallas.py). On-hardware validation happens whenever
+the TPU tunnel is reachable; until then the flag defaults off.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: row-tile size per grid step (lane-width multiple)
+TILE = 1024
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("TIDB_TPU_PALLAS", "0") == "1"
+
+
+def _slot_sums_kernel(vals_ref, onehot_ref, out_ref):
+    """One grid step: out[A, S] += vals[A, T] @ onehot[T, S].
+
+    The one-hot matmul runs on the MXU; masked/invalid rows arrive as
+    all-zero one-hot columns, so they contribute nothing.
+    """
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    out_ref[:, :] += jnp.dot(
+        vals_ref[:, :], onehot_ref[:, :],
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("slots", "interpret"))
+def slot_sums_f32(values, contrib, seg, slots: int, interpret: bool = False):
+    """[A, N] values + [A, N] contrib masks + [N] slot ids -> [A, slots]
+    float32 sums, one pass over the rows.
+
+    Rows with seg outside [0, slots) are dropped (the engine's overflow
+    slot convention)."""
+    from jax.experimental import pallas as pl
+
+    a, n = values.shape
+    pad = (-n) % TILE
+    if pad:
+        values = jnp.pad(values, ((0, 0), (0, pad)))
+        contrib = jnp.pad(contrib, ((0, 0), (0, pad)))
+        seg = jnp.pad(seg, (0, pad), constant_values=slots)
+    n_padded = n + pad
+    grid = n_padded // TILE
+
+    masked = jnp.where(contrib, values.astype(jnp.float32), 0.0)
+    # one-hot per row tile is built OUTSIDE the kernel (XLA fuses the
+    # compare into the pallas operand stream); invalid slots -> all-zero
+    onehot = (
+        seg[:, None] == jnp.arange(slots, dtype=seg.dtype)[None, :]
+    ).astype(jnp.float32)
+
+    return pl.pallas_call(
+        _slot_sums_kernel,
+        out_shape=jax.ShapeDtypeStruct((a, slots), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((a, TILE), lambda i: (0, i)),
+            pl.BlockSpec((TILE, slots), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((a, slots), lambda i: (0, 0)),
+        interpret=interpret,
+    )(masked, onehot)
+
+
+def slot_sums_reference(values, contrib, seg, slots: int):
+    """jnp oracle with identical drop semantics (float64 accumulate)."""
+    masked = jnp.where(contrib, values.astype(jnp.float64), 0.0)
+    onehot = (
+        seg[:, None] == jnp.arange(slots, dtype=seg.dtype)[None, :]
+    ).astype(jnp.float64)
+    return masked @ onehot
