@@ -12,9 +12,11 @@ import subprocess
 import sys
 import textwrap
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 CHILD = textwrap.dedent(
     """
-    import sys; sys.path.insert(0, "/root/repo")
+    import sys; sys.path.insert(0, REPO_PATH)
     import tidb_tpu
     import numpy as np, jax.numpy as jnp
     from tidb_tpu.executor.pallas_kernels import (
@@ -47,7 +49,7 @@ def test_slot_sums_interpret_matches_oracle():
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     out = subprocess.run(
-        [sys.executable, "-c", CHILD],
+        [sys.executable, "-c", CHILD.replace("REPO_PATH", repr(REPO))],
         capture_output=True, text=True, timeout=600, cwd="/tmp", env=env,
     )
     assert out.returncode == 0, out.stderr[-2000:]
@@ -58,3 +60,50 @@ def test_disabled_by_default():
     from tidb_tpu.executor.pallas_kernels import pallas_enabled
 
     assert not pallas_enabled()
+
+
+SQL_CHILD = textwrap.dedent(
+    """
+    import sys; sys.path.insert(0, REPO_PATH)
+    import tidb_tpu
+    from tidb_tpu.session.session import Session
+
+    s = Session()
+    s.execute("create table t (g int, v int, f double)")
+    s.execute(
+        "insert into t values "
+        + ",".join(
+            f"({i % 5},{i},{i / 4})" for i in range(2000)
+        )
+    )
+    r = s.execute(
+        "select g, count(*), sum(v), avg(f) from t group by g order by g"
+    )
+    exp = []
+    for g in range(5):
+        xs = [i for i in range(2000) if i % 5 == g]
+        exp.append((g, len(xs), sum(xs), sum(i / 4 for i in xs) / len(xs)))
+    for got, want in zip(r.rows, exp):
+        assert got[0] == want[0] and got[1] == want[1], (got, want)
+        assert abs(got[2] - want[2]) <= abs(want[2]) * 1e-6, (got, want)
+        assert abs(got[3] - want[3]) <= abs(want[3]) * 1e-5, (got, want)
+    print("PALLAS_SQL_OK")
+    """
+)
+
+
+def test_enabled_path_through_sql():
+    """TIDB_TPU_PALLAS=1 (+interpret escape hatch off-TPU) routes
+    SUM/COUNT/AVG slot accumulation through the kernel; group results
+    match the exact expectations within f32 tolerance."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["TIDB_TPU_PALLAS"] = "1"
+    env["TIDB_TPU_PALLAS_INTERPRET"] = "1"
+    out = subprocess.run(
+        [sys.executable, "-c", SQL_CHILD.replace("REPO_PATH", repr(REPO))],
+        capture_output=True, text=True, timeout=600, cwd="/tmp", env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "PALLAS_SQL_OK" in out.stdout

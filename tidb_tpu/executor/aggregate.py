@@ -469,6 +469,61 @@ def _masked_backend(seg, slots):
     return red
 
 
+def _try_pallas_slot_sums(aggs, arg_cols, seg, slots, srow_valid, reps):
+    """Opt-in (TIDB_TPU_PALLAS=1) one-pass slot accumulation for the
+    non-wide SUM/COUNT/AVG aggregates: stacks their (value, contrib)
+    pairs and calls the Pallas kernel once. Returns {lane index ->
+    (sum f32 [slots], count i64-ish)} keyed by agg index, or None when
+    disabled/unavailable (the jnp path runs as before). float32
+    accumulation: experimental, see pallas_kernels.py numerics note."""
+    import os
+
+    try:
+        from tidb_tpu.executor.pallas_kernels import (
+            pallas_enabled,
+            slot_sums_f32,
+        )
+
+        if not pallas_enabled() or slots > 128:
+            return None
+        # the kernel only lowers on TPU; interpret mode is the CPU/test
+        # escape hatch. A lowering failure inside the steady jitted plan
+        # would be uncatchable, so gate by backend up front.
+        interp = os.environ.get("TIDB_TPU_PALLAS_INTERPRET") == "1"
+        if not interp and jax.default_backend() != "tpu":
+            return None
+    except Exception:
+        return None
+    lanes = []  # (agg index, kind: 'cnt'|'sum', values, contrib)
+    for i, (a, col) in enumerate(zip(aggs, arg_cols)):
+        if a.func not in ("count", "sum", "avg") or a.wide:
+            continue
+        if col is None:
+            lanes.append((i, "cnt", jnp.ones_like(seg, jnp.float32), srow_valid))
+            continue
+        contrib = col.valid & srow_valid
+        if reps and i in reps:
+            contrib = contrib & reps[i]
+        if a.func in ("sum", "avg"):
+            lanes.append((i, "sum", col.data.astype(jnp.float32), contrib))
+        if a.func in ("count", "avg"):
+            lanes.append((i, "cnt", jnp.ones_like(seg, jnp.float32), contrib))
+    if not lanes:
+        return None
+    try:
+        vals = jnp.stack([v for _i, _k, v, _c in lanes])
+        contribs = jnp.stack([c for _i, _k, _v, c in lanes])
+        sums = slot_sums_f32(
+            vals, contribs, seg.astype(jnp.int32), slots, interpret=interp
+        )
+    except Exception:
+        return None  # pallas unavailable on this backend: jnp path
+    out = {}
+    for lane, (i, kind, _v, _c) in enumerate(lanes):
+        out.setdefault(i, {})[kind] = sums[lane]
+    return out
+
+
 def _run_aggs(
     batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=None,
     reps=None,
@@ -481,9 +536,16 @@ def _run_aggs(
         red = _segment_backend(seg, slots)
     srow_valid = seg < slots
     ones = jnp.ones_like(seg, dtype=jnp.int64)
+    pallas_pre = _try_pallas_slot_sums(
+        aggs, arg_cols, seg, slots, srow_valid, reps
+    )
     for i, (a, col) in enumerate(zip(aggs, arg_cols)):
+        pre = (pallas_pre or {}).get(i)
         if a.func == "count" and col is None:
-            s = red("sum", ones, srow_valid, jnp.int64(0))
+            if pre is not None:
+                s = jnp.round(pre["cnt"]).astype(jnp.int64)
+            else:
+                s = red("sum", ones, srow_valid, jnp.int64(0))
             out_cols[a.out_name] = DevCol(s, group_valid)
             continue
 
@@ -492,7 +554,10 @@ def _run_aggs(
         if reps and i in reps:
             valid = valid & reps[i]
         if a.func == "count":
-            s = red("sum", ones, valid, jnp.int64(0))
+            if pre is not None:
+                s = jnp.round(pre["cnt"]).astype(jnp.int64)
+            else:
+                s = red("sum", ones, valid, jnp.int64(0))
             out_cols[a.out_name] = DevCol(s, group_valid)
         elif a.func in ("sum", "avg"):
             if a.wide and not jnp.issubdtype(data.dtype, jnp.floating):
@@ -504,9 +569,19 @@ def _run_aggs(
                 s = s_hi.astype(jnp.float64) * float(1 << 30) + s_lo.astype(
                     jnp.float64
                 )
+            elif pre is not None:
+                ps = pre["sum"]
+                s = (
+                    jnp.round(ps).astype(data.dtype)
+                    if not jnp.issubdtype(data.dtype, jnp.floating)
+                    else ps.astype(data.dtype)
+                )
             else:
                 s = red("sum", data, valid, jnp.zeros((), data.dtype))
-            cnt = red("sum", ones, valid, jnp.int64(0))
+            if pre is not None and "cnt" in pre:
+                cnt = jnp.round(pre["cnt"]).astype(jnp.int64)
+            else:
+                cnt = red("sum", ones, valid, jnp.int64(0))
             # SUM over an all-NULL / empty group is NULL (MySQL)
             v = (cnt > 0) & group_valid
             if a.func == "sum":

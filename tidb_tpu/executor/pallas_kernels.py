@@ -10,13 +10,15 @@ rows: grid over row tiles, VMEM accumulators, one-hot dot per tile
 (reference hot loop: the per-group accumulation inside
 pkg/executor/aggregate/agg_hash_partial_worker.go).
 
-Numerics: accumulation is float32 inside the kernel. That is exact for
-COUNTs and for int32-range values, but NOT bit-identical to the
-engine's float64/int64 semantics — so the kernel is **opt-in**
-(`TIDB_TPU_PALLAS=1`), wired only where the engine can tolerate or
-compensate, and every use is verified against the jnp path in interpret
-mode (tests/test_pallas.py). On-hardware validation happens whenever
-the TPU tunnel is reachable; until then the flag defaults off.
+Numerics: accumulation is float32 inside the kernel — exact only for
+integer magnitudes below 2^24 per accumulator (f32 mantissa), NOT
+bit-identical to the engine's float64/int64 semantics. The kernel is
+therefore **opt-in** (`TIDB_TPU_PALLAS=1`): aggregate._run_aggs routes
+non-wide SUM/COUNT/AVG slot accumulation through it when enabled,
+falling back to the jnp path everywhere else, and every use is
+verified against the float64 oracle in interpret mode
+(tests/test_pallas.py). On-hardware validation happens whenever the
+TPU tunnel is reachable; until then the flag defaults off.
 """
 
 from __future__ import annotations
@@ -26,7 +28,6 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 #: row-tile size per grid step (lane-width multiple)
 TILE = 1024
@@ -36,13 +37,15 @@ def pallas_enabled() -> bool:
     return os.environ.get("TIDB_TPU_PALLAS", "0") == "1"
 
 
-def _slot_sums_kernel(vals_ref, onehot_ref, out_ref):
-    """One grid step: out[A, S] += vals[A, T] @ onehot[T, S].
+def _slot_sums_kernel(slots, vals_ref, seg_ref, out_ref):
+    """One grid step: out[A, S] += vals[A, T] @ onehot(seg)[T, S].
 
-    The one-hot matmul runs on the MXU; masked/invalid rows arrive as
-    all-zero one-hot columns, so they contribute nothing.
+    The one-hot is built IN-KERNEL from the tile's seg ids (iota
+    compare), so only vals (4·A B/row) and seg (4 B/row) cross HBM —
+    one true pass. The matmul runs on the MXU; dropped rows (seg
+    outside [0, S)) produce all-zero one-hot columns.
     """
-    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental import pallas as pl
 
     i = pl.program_id(0)
 
@@ -50,9 +53,13 @@ def _slot_sums_kernel(vals_ref, onehot_ref, out_ref):
     def _init():
         out_ref[:, :] = jnp.zeros_like(out_ref)
 
+    seg = seg_ref[0, :]  # [T]
+    onehot = (
+        seg[:, None]
+        == jax.lax.broadcasted_iota(seg.dtype, (seg.shape[0], slots), 1)
+    ).astype(jnp.float32)
     out_ref[:, :] += jnp.dot(
-        vals_ref[:, :], onehot_ref[:, :],
-        preferred_element_type=jnp.float32,
+        vals_ref[:, :], onehot, preferred_element_type=jnp.float32
     )
 
 
@@ -74,24 +81,22 @@ def slot_sums_f32(values, contrib, seg, slots: int, interpret: bool = False):
     n_padded = n + pad
     grid = n_padded // TILE
 
+    import functools as _ft
+
     masked = jnp.where(contrib, values.astype(jnp.float32), 0.0)
-    # one-hot per row tile is built OUTSIDE the kernel (XLA fuses the
-    # compare into the pallas operand stream); invalid slots -> all-zero
-    onehot = (
-        seg[:, None] == jnp.arange(slots, dtype=seg.dtype)[None, :]
-    ).astype(jnp.float32)
+    seg2d = seg.astype(jnp.int32).reshape(1, n_padded)
 
     return pl.pallas_call(
-        _slot_sums_kernel,
+        _ft.partial(_slot_sums_kernel, slots),
         out_shape=jax.ShapeDtypeStruct((a, slots), jnp.float32),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((a, TILE), lambda i: (0, i)),
-            pl.BlockSpec((TILE, slots), lambda i: (i, 0)),
+            pl.BlockSpec((1, TILE), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((a, slots), lambda i: (0, 0)),
         interpret=interpret,
-    )(masked, onehot)
+    )(masked, seg2d)
 
 
 def slot_sums_reference(values, contrib, seg, slots: int):
