@@ -192,3 +192,118 @@ def test_correlated_in_aggregate_rejected(sess):
             "select id from emp e where id in "
             "(select max(d.id) from dept d where d.id = e.dept)"
         )
+
+
+class TestMarkJoins:
+    """IN/EXISTS subqueries in VALUE positions (select items, CASE,
+    DML WHERE) via mark joins — the reference's LeftOuterSemiJoin with
+    a mark column (expression_rewriter.go). The mark's validity carries
+    the three-valued IN NULL semantics."""
+
+    @pytest.fixture()
+    def s(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table t (a int, b varchar(6))")
+        s.execute("insert into t values (1,'x'),(2,'y'),(3,'x'),(null,'z')")
+        s.execute("create table u (a int)")
+        s.execute("insert into u values (1),(3)")
+        s.execute("create table un (a int)")
+        s.execute("insert into un values (1),(null)")
+        return s
+
+    def test_in_as_value(self, s):
+        assert s.execute(
+            "select a, a in (select a from u) from t order by a"
+        ).rows == [(None, None), (1, True), (2, False), (3, True)]
+
+    def test_three_valued_null_semantics(self, s):
+        # build side contains NULL: no-match becomes NULL, not False
+        assert s.execute(
+            "select a, a in (select a from un) from t order by a"
+        ).rows == [(None, None), (1, True), (2, None), (3, None)]
+        assert s.execute(
+            "select a, a not in (select a from un) from t order by a"
+        ).rows == [(None, None), (1, False), (2, None), (3, None)]
+
+    def test_case_when_in(self, s):
+        assert s.execute(
+            "select case when a in (select a from u) then 'in' else 'out' "
+            "end from t order by a"
+        ).rows == [("out",), ("in",), ("out",), ("in",)]
+
+    def test_update_where_in_subquery(self, s):
+        r = s.execute("update t set b = 'm' where a in (select a from u)")
+        assert r.affected == 2
+        assert s.execute(
+            "select b from t where a is not null order by a"
+        ).rows == [("m",), ("y",), ("m",)]
+
+    def test_delete_where_in_subquery(self, s):
+        r = s.execute("delete from t where a in (select a from u)")
+        assert r.affected == 2
+        assert s.execute("select count(*) from t").rows == [(2,)]
+
+    def test_correlated_exists_as_value(self, s):
+        assert s.execute(
+            "select exists (select 1 from u where u.a = t.a) from t "
+            "order by t.a"
+        ).rows == [(False,), (True,), (False,), (True,)]
+
+    def test_aggregate_over_mark(self, s):
+        assert s.execute(
+            "select count(*), sum(a in (select a from u)) from t"
+        ).rows == [(4, 2)]
+
+    def test_uncorrelated_exists_folds(self, s):
+        assert s.execute(
+            "select a, exists (select 1 from u) from t where a = 1"
+        ).rows == [(1, True)]
+        assert s.execute(
+            "select a, not exists (select 1 from u where a > 100) from t "
+            "where a = 1"
+        ).rows == [(1, True)]
+
+    def test_mesh_parity(self):
+        from tidb_tpu.session.session import Session
+
+        sm, s1 = Session(mesh_devices=8), Session()
+        for ss in (sm, s1):
+            ss.execute("create table t (a int)")
+            ss.execute("create table u (a int)")
+            ss.execute(
+                "insert into t values "
+                + ",".join(f"({i % 50})" for i in range(400))
+            )
+            ss.execute(
+                "insert into u values " + ",".join(f"({i})" for i in range(25))
+            )
+        q = "select a, a in (select a from u) from t order by a limit 60"
+        assert sm.execute(q).rows == s1.execute(q).rows
+
+    def test_in_empty_set_is_false_even_for_null(self, s):
+        s.execute("create table e (a int)")
+        assert s.execute(
+            "select a, a in (select a from e), a not in (select a from e) "
+            "from t order by a"
+        ).rows == [
+            (None, False, True), (1, False, True), (2, False, True),
+            (3, False, True),
+        ]
+
+    def test_exists_respects_having_and_limit(self, s):
+        assert s.execute(
+            "select a, exists (select count(*) from u having count(*) > 100) "
+            "from t where a = 1"
+        ).rows == [(1, False)]
+        assert s.execute(
+            "select a, exists (select count(*) from u limit 0) from t "
+            "where a = 1"
+        ).rows == [(1, False)]
+
+    def test_tableless_exists(self, s):
+        s.execute("create table e (a int)")
+        assert s.execute(
+            "select exists (select 1 from u), not exists (select a from e)"
+        ).rows == [(True, True)]

@@ -550,6 +550,9 @@ def _run_aggs(
             continue
 
         data = col.data
+        if data.dtype == jnp.bool_ and a.func in ("sum", "avg", "min", "max"):
+            # SUM(bool_expr) etc.: MySQL treats booleans as 0/1 ints
+            data = data.astype(jnp.int64)
         valid = col.valid & srow_valid
         if reps and i in reps:
             valid = valid & reps[i]

@@ -52,6 +52,8 @@ def equi_join(
     join_type: str = "inner",
     build_prefix: str = "",
     probe_prefix: str = "",
+    mark_name: str = "_mark",
+    mark_three_valued: bool = True,
 ) -> Tuple[Batch, jax.Array]:
     """Returns (joined batch, true output row count).
 
@@ -67,12 +69,34 @@ def equi_join(
     pkey, pvalid = _keys_of(probe, probe_key)
     bcap = build.capacity
 
-    if join_type in ("semi", "anti"):
+    if join_type in ("semi", "anti", "mark"):
         sort_out = jax.lax.sort([~bvalid, bkey], num_keys=2)
         skey = jnp.where(~sort_out[0], sort_out[1], jnp.iinfo(jnp.int64).max)
         lo = jnp.searchsorted(skey, pkey, side="left")
         hi = jnp.searchsorted(skey, pkey, side="right")
         matched = (hi > lo) & pvalid
+        if join_type == "mark":
+            # mark join: every probe row survives and gains a boolean
+            # column holding the (three-valued) IN/EXISTS result — the
+            # reference's mark join for subqueries in value positions
+            # (expression_rewriter.go's LeftOuterSemiJoin). With
+            # mark_three_valued (IN semantics): no-match is NULL when
+            # the probe key is NULL or the build side contains a NULL.
+            build_has_null = jnp.any(build.row_valid & ~bvalid)
+            build_empty = ~jnp.any(build.row_valid)
+            if mark_three_valued:
+                # x IN (empty set) is FALSE even for NULL x (MySQL);
+                # otherwise a no-match is NULL when the probe key is
+                # NULL or the build side contains a NULL
+                mvalid = probe.row_valid & (
+                    matched | build_empty | (pvalid & ~build_has_null)
+                )
+            else:  # EXISTS: always two-valued
+                mvalid = probe.row_valid
+            cols = dict(probe.cols)
+            cols[mark_name] = DevCol(matched, mvalid)
+            out = Batch(cols, probe.row_valid)
+            return out, jnp.sum(out.row_valid.astype(jnp.int64))
         keep = matched if join_type == "semi" else (~matched & probe.row_valid & pvalid)
         if join_type == "anti":
             # NULL probe key in NOT IN/anti: row never matches but with a

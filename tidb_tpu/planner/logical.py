@@ -148,6 +148,9 @@ class JoinPlan(LogicalPlan):
     # the compiled exchange. Reference: broadcast-vs-shuffle MPP join in
     # pkg/planner/core/exhaust_physical_plans.go.
     broadcast: Optional[str] = None
+    # mark join only: name of the boolean result column appended to the
+    # probe schema (expression_rewriter.go LeftOuterSemiJoin analog)
+    mark_name: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -901,6 +904,26 @@ def build_select(
         binder0 = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
         plan = Selection(plan.schema, plan, binder0.bind(sel.where))
 
+    # ---- IN/EXISTS in value positions -> mark joins ----
+    if not isinstance(plan, OneRow):
+        _mk_counter = [0]
+        new_items = []
+        changed = False
+        for it in sel.items:
+            if isinstance(it.expr, ast.Star) or isinstance(it.expr, ast.Name):
+                new_items.append(it)
+                continue
+            e2, plan = attach_value_subqueries(
+                b, plan, it.expr, subquery_value_fn, catalog, current_db,
+                _mk_counter,
+            )
+            if e2 is not it.expr:
+                it = dataclasses.replace(it, expr=e2)
+                changed = True
+            new_items.append(it)
+        if changed:
+            sel = dataclasses.replace(sel, items=new_items)
+
     # ---- aggregate detection ----
     agg_calls: List[ast.AggCall] = []
 
@@ -1111,11 +1134,16 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         right = prune_plan(plan.right, rneed)
         if plan.kind in ("semi", "anti"):
             sch = left.schema
+        elif plan.kind == "mark":
+            sch = Schema(
+                list(left.schema.cols)
+                + [c for c in plan.schema.cols if c.internal == plan.mark_name]
+            )
         else:
             sch = Schema(list(left.schema.cols) + list(right.schema.cols))
         return JoinPlan(
             sch, plan.kind, left, right, plan.equi_keys, plan.residual,
-            plan.null_aware, plan.broadcast,
+            plan.null_aware, plan.broadcast, plan.mark_name,
         )
     if isinstance(plan, Sort):
         need = set(required)
@@ -1168,7 +1196,28 @@ def _scalar_subq(subquery_value_fn):
     def run(e: ast.SubqueryExpr):
         if e.modifier is None:
             return subquery_value_fn(e.query)
-        raise PlanError("IN/EXISTS subquery only supported in WHERE")
+        if e.modifier in ("exists", "not exists"):
+            # uncorrelated EXISTS in a scalar position (e.g. tableless
+            # SELECT): COUNT over a derived table keeps GROUP BY /
+            # HAVING / LIMIT semantics
+            from tidb_tpu.dtypes import BOOL as _BOOL
+
+            cnt_q = ast.Select(
+                items=[
+                    ast.SelectItem(ast.AggCall("count", None), alias="_c")
+                ],
+                from_=ast.SubqueryRef(
+                    dataclasses.replace(e.query, order_by=[]), "_ex"
+                ),
+            )
+            n = subquery_value_fn(cnt_q).value
+            hit = (n or 0) > 0
+            return Literal(
+                type=_BOOL, value=hit if e.modifier == "exists" else not hit
+            )
+        raise PlanError(
+            "IN/EXISTS subquery not supported in this position"
+        )
 
     return run
 
@@ -1556,6 +1605,124 @@ def _bind_residuals(outer_schema, inner_schema, residuals, subquery_value_fn):
     )
 
 
+def attach_value_subqueries(b, plan, node, subquery_value_fn, catalog, db, counter):
+    """Rewrite IN/EXISTS subqueries appearing in VALUE positions (select
+    items, CASE conditions, DML WHERE item evaluation) into mark joins:
+    the probe keeps every row and gains a boolean (three-valued for IN)
+    result column (reference: expression_rewriter.go building
+    LeftOuterSemiJoin with a mark). Returns (rewritten ast node, plan).
+
+    Uncorrelated EXISTS folds to a constant. NOT wrappers become NOT of
+    the mark — the mark's validity carries the NULL semantics, so the
+    3-valued negation is free."""
+    if isinstance(node, ast.SubqueryExpr) and node.modifier in (
+        "in", "not in", "exists", "not exists",
+    ):
+        plan, ref = _make_mark(
+            b, plan, node, subquery_value_fn, catalog, db, counter
+        )
+        return ref, plan
+    if isinstance(node, ast.Call):
+        new_args = []
+        for a in node.args:
+            a2, plan = attach_value_subqueries(
+                b, plan, a, subquery_value_fn, catalog, db, counter
+            )
+            new_args.append(a2)
+        if new_args != list(node.args):
+            node = dataclasses.replace(node, args=new_args)
+        return node, plan
+    if isinstance(node, ast.AggCall) and node.arg is not None:
+        a2, plan = attach_value_subqueries(
+            b, plan, node.arg, subquery_value_fn, catalog, db, counter
+        )
+        if a2 is not node.arg:
+            node = dataclasses.replace(node, arg=a2)
+        return node, plan
+    return node, plan
+
+
+def _make_mark(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db, counter):
+    """One IN/EXISTS value-position subquery -> (plan with mark join,
+    replacement ast node)."""
+    q = sq.query
+    negate = sq.modifier in ("not in", "not exists")
+    exists = sq.modifier in ("exists", "not exists")
+    correlated = _is_correlated(q, plan.schema, b)
+
+    def maybe_not(e):
+        return ast.Call("not", [e]) if negate else e
+
+    if exists and not correlated:
+        if (
+            not q.group_by and _items_aggregate(q)
+            and q.having is None and q.limit is None
+        ):
+            # bare aggregate: always exactly one row
+            return plan, ast.Const(not negate)
+        if subquery_value_fn is None:
+            raise PlanError("EXISTS subquery needs a session context")
+        cnt_q = ast.Select(
+            items=[ast.SelectItem(ast.AggCall("count", None), alias="_c")],
+            from_=ast.SubqueryRef(dataclasses.replace(q, order_by=[]), "_ex"),
+        )
+        n = subquery_value_fn(cnt_q).value
+        return plan, ast.Const(((n or 0) > 0) != negate)
+
+    counter[0] += 1
+    mark = f"_mk{counter[0]}"
+    from tidb_tpu.dtypes import BOOL as _BOOL
+
+    if exists:
+        _check_simple_subquery(q, "EXISTS")
+        corr_pairs, kept, residuals, extra = _corr_split(q, plan.schema, b)
+        if not corr_pairs or residuals:
+            raise PlanError(
+                "correlated EXISTS in value position needs exactly "
+                "equality correlations"
+            )
+        inner_q = dataclasses.replace(
+            q,
+            items=[
+                ast.SelectItem(ie, alias=f"_ck{i}")
+                for i, (_oe, ie) in enumerate(corr_pairs)
+            ],
+            where=kept,
+            distinct=False,
+        )
+        inner = build_query(inner_q, catalog, db, subquery_value_fn, b.ctes)
+        ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        keys = _bind_corr_keys(ob, corr_pairs, inner.schema.cols)
+        three = False
+    else:
+        if correlated:
+            raise PlanError(
+                "correlated IN in value position not supported "
+                "(rewrite as EXISTS)"
+            )
+        _check_simple_subquery(q, "IN")
+        inner = build_query(q, catalog, db, subquery_value_fn, b.ctes)
+        if len(inner.schema.cols) != 1:
+            raise PlanError("IN subquery must return one column")
+        ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+        lhs = ob.bind(sq.lhs)
+        c0 = inner.schema.cols[0]
+        keys = [(lhs, ColumnRef(type=c0.type, name=c0.internal))]
+        three = True
+    if len(keys) != 1:
+        raise PlanError(
+            "value-position subqueries support one correlation key"
+        )
+    sch = Schema(
+        list(plan.schema.cols) + [OutCol(None, mark, mark, _BOOL)]
+    )
+    plan = JoinPlan(
+        sch, "mark", plan, inner, keys,
+        null_aware=three, mark_name=mark,
+    )
+    return plan, maybe_not(ast.Name(None, mark))
+
+
 def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog, db):
     """IN/EXISTS (correlated or not) -> semi/anti join (reference:
     decorrelation + semi-join rewrite in expression_rewriter.go)."""
@@ -1563,10 +1730,13 @@ def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog
     correlated = _is_correlated(q, plan.schema, b)
 
     if sq.modifier in ("exists", "not exists"):
-        if not q.group_by and _items_aggregate(q):
-            # An aggregate subquery without GROUP BY yields exactly one
-            # row regardless of its input (even an empty, even a
-            # correlated one) -> EXISTS is unconditionally true.
+        if (
+            not q.group_by and _items_aggregate(q)
+            and q.having is None and q.limit is None
+        ):
+            # A bare aggregate subquery (no GROUP BY/HAVING/LIMIT)
+            # yields exactly one row regardless of its input (even an
+            # empty, even a correlated one) -> EXISTS is always true.
             want = sq.modifier == "exists"
             return plan if want else Limit(plan.schema, plan, 0, 0)
         if not correlated:
@@ -1861,6 +2031,8 @@ def _build_aggregate(b, plan, group_by, agg_calls):
             t = FLOAT64
         elif call.func in ("min", "max", "sum"):
             t = arg.type
+            if call.func == "sum" and t is not None and t.kind == Kind.BOOL:
+                t = INT64  # MySQL: SUM over booleans counts (0/1 ints)
         elif call.func == "group_concat":
             t = STRING
             gc_meta[name] = (

@@ -845,6 +845,35 @@ class PlanCompiler:
         null_aware = plan.null_aware
         res = compile_expr(plan.residual, dicts) if plan.residual is not None else None
 
+        if kind == "mark":
+            # mark join: probe rows survive, gaining a boolean IN/EXISTS
+            # result column (three-valued under null_aware — the IN
+            # semantics; two-valued for EXISTS)
+            if verify is not None or res is not None:
+                raise ExecError(
+                    "mark join supports a single equality key and no "
+                    "residual conditions"
+                )
+            mark = getattr(plan, "mark_name", None) or plan.schema.cols[-1].internal
+            three = null_aware
+            if mesh:
+                # replicate the build side: every shard marks its own
+                # probe rows against the full build set
+                right = self._gathered(right, rtag)
+                rtag = "repl"
+                self._tag = ltag
+
+            def fn_mark(inputs, caps):
+                lb, n1 = left(inputs, caps)
+                rb, n2 = right(inputs, caps)
+                out, _t = equi_join(
+                    rb, lb, rkey, lkey, 0, "mark",
+                    mark_name=mark, mark_three_valued=three,
+                )
+                return out, {**n1, **n2}
+
+            return fn_mark, {**ldicts}
+
         if kind in ("semi", "anti"):
             if verify is None and res is None:
                 part_nid = None

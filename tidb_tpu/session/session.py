@@ -847,11 +847,35 @@ class Session:
         return v
 
     def _subq_executor_for_binding(self):
+        import dataclasses as _dc
+
         from tidb_tpu.parser import ast as _ast
 
         def run(e):
             if isinstance(e, _ast.SubqueryExpr) and e.modifier is None:
                 return self._scalar_subquery(e.query)
+            if isinstance(e, _ast.SubqueryExpr) and e.modifier in (
+                "exists", "not exists",
+            ):
+                # uncorrelated EXISTS in a scalar (tableless) position:
+                # COUNT over a derived table preserves HAVING/LIMIT
+                from tidb_tpu.dtypes import BOOL as _BOOL
+                from tidb_tpu.expression.expr import Literal as _Lit
+
+                cnt_q = _ast.Select(
+                    items=[
+                        _ast.SelectItem(_ast.AggCall("count", None), alias="_c")
+                    ],
+                    from_=_ast.SubqueryRef(
+                        _dc.replace(e.query, order_by=[]), "_ex"
+                    ),
+                )
+                n = self._scalar_subquery(cnt_q).value
+                hit = (n or 0) > 0
+                return _Lit(
+                    type=_BOOL,
+                    value=hit if e.modifier == "exists" else not hit,
+                )
             raise ValueError("IN/EXISTS subquery not supported here")
 
         return run
