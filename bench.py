@@ -166,20 +166,10 @@ def numpy_q5(np, cat, d0, d1):
 
 def _force_cpu_in_process() -> None:
     """Make this interpreter CPU-only even though sitecustomize may have
-    registered a TPU-tunnel PJRT plugin already (same trick as
-    tests/conftest.py)."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    try:
-        import jax as _jax
-        from jax._src import xla_bridge as _xb
+    registered a TPU-tunnel PJRT plugin already."""
+    from tidb_tpu.utils.backend import force_cpu
 
-        _jax.config.update("jax_platforms", "cpu")
-        for _name in list(getattr(_xb, "_backend_factories", {})):
-            if _name != "cpu":
-                _xb._backend_factories.pop(_name, None)
-    except Exception:
-        pass
+    force_cpu()
 
 
 def measure(args) -> int:

@@ -1,0 +1,83 @@
+"""JAX backend fallback for the flaky TPU tunnel.
+
+The environment force-registers an 'axon' PJRT plugin (the TPU tunnel)
+whose init can fail OR hang for hours. Every CPU-forcing site must do
+the same three things, in this order, each independently best-effort:
+set JAX_PLATFORMS=cpu, drop the tunnel env var (pallas paths consult
+it), update live jax config, and deregister non-cpu backend factories
+(the force-registered plugin otherwise wins even with
+JAX_PLATFORMS=cpu). tests/conftest.py and tests/_multihost_worker.py
+inline the same sequence because they run before tidb_tpu is
+importable — keep them in sync with this helper.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu() -> None:
+    """Make this interpreter CPU-only regardless of registered plugins."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:  # separate block: a config failure must not skip deregistration
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+def probe_accelerator(timeout_s: int = 120) -> bool:
+    """Can a fresh process initialize the configured JAX backend?
+    Probed in a throwaway subprocess (its own session, output to
+    devnull) so a hung tunnel cannot hang US — the child's whole
+    process group is killed on timeout."""
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        try:
+            return proc.wait(timeout=timeout_s) == 0
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except Exception:
+                proc.kill()
+            proc.wait(timeout=10)
+            return False
+    except Exception:
+        return False
+
+
+def ensure_live_backend(timeout_s: int = 120) -> None:
+    """Fall back to CPU iff the configured accelerator backend cannot
+    initialize (fail or hang). A healthy accelerator — explicit or
+    autodetected — is left alone."""
+    try:
+        from jax._src import xla_bridge as xb
+
+        if xb.backends_are_initialized():
+            return
+    except Exception:
+        pass
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return
+    if probe_accelerator(timeout_s):
+        return
+    force_cpu()
