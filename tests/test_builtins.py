@@ -225,3 +225,36 @@ def test_neg_string_literal(sess):
 def test_instr_null_needle(sess):
     r = sess.must_query("select instr(s, null) from t where i = 5")
     assert r.rows[0][0] is None
+
+
+def test_field_function():
+    from tidb_tpu.session.session import Session
+
+    s = Session()
+    s.execute("create table t (a int, b varchar(4), d decimal(10,2), dt date)")
+    s.execute(
+        "insert into t values (1,'y',1.50,'2024-05-01'),"
+        "(2,'x',2.25,'2024-06-01'),(3,'z',3.00,'2024-07-01'),"
+        "(null,null,null,null)"
+    )
+    # 1-based position among the values; 0 for absent AND for NULL
+    assert s.execute(
+        "select field(a, 2, 1), field(b, 'x') from t order by a"
+    ).rows == [(0, 0), (2, 0), (1, 1), (0, 0)]
+    assert s.execute(
+        "select a from t order by field(b, 'y', 'x'), a"
+    ).rows == [(None,), (3,), (1,), (2,)]
+    # physical encodings: scaled decimals, epoch-day dates
+    assert s.execute(
+        "select field(d, 2.25, 1.50) from t order by a"
+    ).rows == [(0,), (2,), (1,), (0,)]
+    assert s.execute(
+        "select field(dt, '2024-06-01') from t order by a"
+    ).rows == [(0,), (0,), (1,), (0,)]
+    # NULL needles never match; string needles coerce numerically
+    assert s.execute("select field(b, null) from t").rows == [
+        (0,), (0,), (0,), (0,)
+    ]
+    assert s.execute(
+        "select field(a, '2') from t order by a"
+    ).rows == [(0,), (0,), (1,), (0,)]

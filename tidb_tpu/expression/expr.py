@@ -205,7 +205,7 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "year", "month", "day", "dayofweek", "weekday", "dayofyear",
         "quarter", "hour", "minute", "second", "microsecond",
         "length", "char_length", "ascii", "locate", "sign",
-        "json_valid", "json_length",
+        "json_valid", "json_length", "field",
         "datediff", "floor", "ceil",
     }:
         return INT64

@@ -634,6 +634,69 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             return len(v) if isinstance(v, (list, dict)) else 1
 
         return _compile_strlut(e.args[0], dicts, _jl, jnp.int64)
+    if op == "field":
+        # FIELD(x, v1, v2, ...): 1-based index of x among the values,
+        # 0 when absent or when x is NULL; NULL needles never match
+        # (builtin_string.go fieldFunctionClass)
+        x = e.args[0]
+        needles = []  # (original 1-based position, value)
+        for pos, a in enumerate(e.args[1:], 1):
+            if not isinstance(a, Literal):
+                raise NotImplementedError("FIELD values must be literals")
+            if a.value is None:
+                continue  # a NULL needle matches nothing
+            needles.append((pos, a.value))
+        if _is_string_col(x):
+            sn = {str(v): pos for pos, v in reversed(needles)}
+            inner = _compile_strlut(
+                x, dicts, lambda s: sn.get(s, 0), jnp.int64
+            )
+
+            def _sfield(b):
+                c = inner(b)
+                # FIELD(NULL, ...) is 0, not NULL (MySQL)
+                return DevCol(
+                    jnp.where(c.valid, c.data, jnp.int64(0)),
+                    jnp.ones_like(c.valid),
+                )
+
+            return _sfield
+        fx = _compile(x, dicts)
+        t = x.type
+
+        def _phys(v):
+            # encode needles in the column's physical representation
+            # (the _compile_in conversion: scaled decimals, epoch days,
+            # MySQL numeric coercion of strings)
+            if t is not None and t.kind == Kind.DECIMAL:
+                return round(float(v) * 10**t.scale)
+            if t is not None and t.kind == Kind.DATE:
+                from tidb_tpu.dtypes import date_to_days
+
+                return date_to_days(v) if isinstance(v, str) else int(v)
+            if t is not None and t.kind == Kind.DATETIME:
+                from tidb_tpu.dtypes import datetime_to_micros
+
+                return datetime_to_micros(v) if isinstance(v, str) else int(v)
+            if isinstance(v, str):
+                try:
+                    return float(v)  # MySQL double coercion
+                except ValueError:
+                    return 0.0
+            return v
+
+        pneedles = [(pos, _phys(v)) for pos, v in needles]
+
+        def _field(b):
+            c = fx(b)
+            out = jnp.zeros(b.capacity, dtype=jnp.int64)
+            for pos, v in reversed(pneedles):
+                out = jnp.where(
+                    c.valid & (c.data == v), jnp.int64(pos), out
+                )
+            return DevCol(out, jnp.ones(b.capacity, dtype=bool))
+
+        return _field
     if op == "length":
         return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
     if op == "char_length":
