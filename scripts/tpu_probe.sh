@@ -6,7 +6,7 @@
 cd /root/repo
 LOG=/tmp/tpu_probe.log
 echo "$(date +%T) prober start" >> $LOG
-for i in $(seq 1 40); do
+for i in $(seq 1 60); do
   # fast liveness probe: devices() within 150s means the tunnel is up
   if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date +%T) tunnel UP (probe $i)" >> $LOG

@@ -307,3 +307,91 @@ class TestMarkJoins:
         assert s.execute(
             "select exists (select 1 from u), not exists (select a from e)"
         ).rows == [(True, True)]
+
+
+class TestValuePositionScalarsAndQuantified:
+    """Correlated scalar subqueries in select items (agg-pull-up left
+    join), ANY/ALL quantified comparisons, HAVING subqueries via the
+    derived-table wrap, CONVERT()."""
+
+    @pytest.fixture()
+    def s(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table t (a int, b int)")
+        s.execute("insert into t values (1,10),(1,20),(2,30),(3,40)")
+        s.execute("create table u (a int, v int)")
+        s.execute("insert into u values (1,100),(1,200),(3,300)")
+        return s
+
+    def test_correlated_scalar_in_items(self, s):
+        assert s.execute(
+            "select distinct a, (select count(*) from u where u.a = t.a) c "
+            "from t order by a"
+        ).rows == [(1, 2), (2, 0), (3, 1)]
+        assert s.execute(
+            "select distinct a, (select sum(v) from u where u.a = t.a) sv "
+            "from t order by a"
+        ).rows == [(1, 300), (2, None), (3, 300)]
+
+    def test_correlated_scalar_in_arithmetic(self, s):
+        assert s.execute(
+            "select a, b + (select count(*) from u where u.a = t.a) "
+            "from t order by a, b"
+        ).rows == [(1, 12), (1, 22), (2, 30), (3, 41)]
+
+    def test_quantified_comparisons(self, s):
+        assert s.execute(
+            "select distinct a from t where a = any (select a from u) order by a"
+        ).rows == [(1,), (3,)]
+        assert s.execute(
+            "select distinct a from t where a <> all (select a from u) order by a"
+        ).rows == [(2,)]
+        assert s.execute(
+            "select distinct a from t where a < all (select a from u) order by a"
+        ).rows == []
+        assert s.execute(
+            "select distinct a from t where a >= all (select a from u) order by a"
+        ).rows == [(3,)]
+
+    def test_quantified_empty_null_and_derived_semantics(self, s):
+        s.execute("create table e (a int)")
+        s.execute("create table un (a int)")
+        s.execute("insert into un values (2),(null)")
+        # ALL over the empty set is TRUE; ANY is FALSE
+        assert s.execute(
+            "select distinct a from t where a < all (select a from e) order by a"
+        ).rows == [(1,), (2,), (3,)]
+        assert s.execute(
+            "select a from t where a > any (select a from e)"
+        ).rows == []
+        # a NULL in the set poisons undecided comparisons (3-valued)
+        assert s.execute(
+            "select a from t where a < all (select a from un)"
+        ).rows == []
+        # the subquery's own ORDER BY/LIMIT is honored (derived table):
+        # with LIMIT 1 the set is {1}, without it {1,1,3}
+        assert s.execute(
+            "select distinct a from t where "
+            "a >= all (select a from u order by a limit 1) order by a"
+        ).rows == [(1,), (2,), (3,)]
+        assert s.execute(
+            "select distinct a from t where "
+            "a >= all (select a from u) order by a"
+        ).rows == [(3,)]
+
+    def test_having_subqueries(self, s):
+        assert s.execute(
+            "select a from t group by a having a in (select a from u) "
+            "order by a"
+        ).rows == [(1,), (3,)]
+        assert s.execute(
+            "select a, sum(b) sb from t group by a having sb > 15 "
+            "and a not in (select a from u) order by a"
+        ).rows == [(2, 30)]
+
+    def test_convert_is_cast(self, s):
+        assert s.execute(
+            "select convert(a, double) from t where a = 2"
+        ).rows == [(2.0,)]

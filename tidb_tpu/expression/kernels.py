@@ -90,6 +90,32 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
 
 
+def literal_phys(v, t):
+    """Literal -> the column's physical on-device encoding (shared by
+    IN / FIELD / eq-literal paths; scaled decimals, epoch days/micros,
+    MySQL double coercion of string-vs-numeric)."""
+    if t is not None and t.kind == Kind.DECIMAL:
+        return round(float(v) * 10**t.scale)
+    if t is not None and t.kind == Kind.DATE:
+        from tidb_tpu.dtypes import date_to_days
+
+        return date_to_days(v) if isinstance(v, str) else int(v)
+    if t is not None and t.kind == Kind.DATETIME:
+        from tidb_tpu.dtypes import datetime_to_micros
+
+        return datetime_to_micros(v) if isinstance(v, str) else int(v)
+    if t is not None and t.kind == Kind.TIME:
+        from tidb_tpu.dtypes import time_to_micros
+
+        return time_to_micros(v) if isinstance(v, str) else int(v)
+    if isinstance(v, str):
+        try:
+            return float(v)  # MySQL double coercion
+        except ValueError:
+            return 0.0
+    return v
+
+
 def _string_literal_code(dictionary: np.ndarray, value: str):
     """(code position, exact_match) for a literal against a sorted dict."""
     pos = int(np.searchsorted(dictionary, value))
@@ -647,10 +673,27 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
                 continue  # a NULL needle matches nothing
             needles.append((pos, a.value))
         if _is_string_col(x):
-            sn = {str(v): pos for pos, v in reversed(needles)}
-            inner = _compile_strlut(
-                x, dicts, lambda s: sn.get(s, 0), jnp.int64
-            )
+            if all(isinstance(v, str) for _p, v in needles):
+                sn = {str(v): pos for pos, v in reversed(needles)}
+                lut_fn = lambda s: sn.get(s, 0)
+            else:
+                # mixed string/numeric arguments compare as doubles
+                # (MySQL coercion)
+                def _f(xv):
+                    try:
+                        return float(xv)
+                    except (TypeError, ValueError):
+                        return 0.0
+
+                def lut_fn(sv, _n=needles):
+                    for pos, v in _n:
+                        if (isinstance(v, str) and v == sv) or (
+                            not isinstance(v, str) and _f(sv) == _f(v)
+                        ):
+                            return pos
+                    return 0
+
+            inner = _compile_strlut(x, dicts, lut_fn, jnp.int64)
 
             def _sfield(b):
                 c = inner(b)
@@ -663,29 +706,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             return _sfield
         fx = _compile(x, dicts)
         t = x.type
-
-        def _phys(v):
-            # encode needles in the column's physical representation
-            # (the _compile_in conversion: scaled decimals, epoch days,
-            # MySQL numeric coercion of strings)
-            if t is not None and t.kind == Kind.DECIMAL:
-                return round(float(v) * 10**t.scale)
-            if t is not None and t.kind == Kind.DATE:
-                from tidb_tpu.dtypes import date_to_days
-
-                return date_to_days(v) if isinstance(v, str) else int(v)
-            if t is not None and t.kind == Kind.DATETIME:
-                from tidb_tpu.dtypes import datetime_to_micros
-
-                return datetime_to_micros(v) if isinstance(v, str) else int(v)
-            if isinstance(v, str):
-                try:
-                    return float(v)  # MySQL double coercion
-                except ValueError:
-                    return 0.0
-            return v
-
-        pneedles = [(pos, _phys(v)) for pos, v in needles]
+        pneedles = [(pos, literal_phys(v, t)) for pos, v in needles]
 
         def _field(b):
             c = fx(b)
@@ -1193,17 +1214,7 @@ def _compile_in(e: Func, dicts: DictContext) -> _CompiledExpr:
     else:
         f = _compile(col, dicts)
         t = col.type
-        phys = []
-        for l in lits:
-            v = l.value
-            if t.kind == Kind.DECIMAL:
-                phys.append(round(float(v) * 10**t.scale))
-            elif t.kind == Kind.DATE:
-                from tidb_tpu.dtypes import date_to_days
-
-                phys.append(date_to_days(v) if isinstance(v, str) else int(v))
-            else:
-                phys.append(v)
+        phys = [literal_phys(l.value, t) for l in lits]
         consts = jnp.asarray(np.array(phys)) if phys else None
 
         def match_fn(b):

@@ -675,6 +675,69 @@ class Parser:
             e = ast.Call("and", [e, self.parse_not()])
         return e
 
+    @staticmethod
+    def _quantified(opname: str, quant: str, lhs, q):
+        """<op> ANY/ALL (subquery) rewrites (MySQL quantified compares):
+        = ANY -> IN, <> ALL -> NOT IN. Ordering comparisons compare
+        against MIN/MAX over the subquery AS A DERIVED TABLE (its own
+        GROUP BY / LIMIT semantics preserved), with a CASE implementing
+        the full 3-valued semantics: ALL over an empty set is TRUE (ANY
+        is FALSE), a violated bound decides immediately, and otherwise
+        a NULL anywhere in the set makes the result NULL."""
+        if quant in ("any", "some"):
+            if opname == "eq":
+                return ast.SubqueryExpr(q, "in", lhs=lhs)
+            agg = {"lt": "max", "le": "max", "gt": "min", "ge": "min"}.get(opname)
+            if agg is None:  # <> ANY: true unless all values equal lhs
+                raise ParseError("<> ANY is not supported; use NOT IN or MIN/MAX")
+        else:  # all
+            if opname == "ne":
+                return ast.SubqueryExpr(q, "not in", lhs=lhs)
+            agg = {"lt": "min", "le": "min", "gt": "max", "ge": "max"}.get(opname)
+            if agg is None:
+                raise ParseError("= ALL is not supported; use IN with a single row")
+        item = q.items[0] if isinstance(q, ast.Select) else None
+        if item is None:
+            raise ParseError("quantified comparison needs a plain SELECT")
+        q2 = dataclasses_replace(
+            q, items=[ast.SelectItem(item.expr, alias="_qc")]
+        )
+
+        def agg_subq(func, over_col):
+            inner = ast.Select(
+                items=[
+                    ast.SelectItem(
+                        ast.AggCall(
+                            func,
+                            ast.Name(None, "_qc") if over_col else None,
+                        ),
+                        alias="_a",
+                    )
+                ],
+                from_=ast.SubqueryRef(q2, "_qd"),
+            )
+            return ast.SubqueryExpr(inner, None)
+
+        bound = agg_subq(agg, True)
+        c_all = agg_subq("count", False)
+        c_nn = agg_subq("count", True)
+        cmp_e = ast.Call(opname, [lhs, bound])
+        empty = ast.Call("eq", [c_all, ast.Const(0)])
+        has_null = ast.Call("gt", [c_all, c_nn])
+        if quant == "all":
+            return ast.Call("case", [
+                empty, ast.Const(True),
+                ast.Call("not", [cmp_e]), ast.Const(False),
+                has_null, ast.Const(None),
+                ast.Const(True),
+            ])
+        return ast.Call("case", [
+            empty, ast.Const(False),
+            cmp_e, ast.Const(True),
+            has_null, ast.Const(None),
+            ast.Const(False),
+        ])
+
     def parse_not(self):
         if self.accept_kw("not"):
             return ast.Call("not", [self.parse_not()])
@@ -686,6 +749,18 @@ class Parser:
             if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
                 op = self.advance().text
                 opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
+                # quantified comparison: <op> ANY/SOME/ALL (subquery)
+                if (
+                    self.cur.kind in ("id", "kw")
+                    and self.cur.text.lower() in ("any", "some", "all")
+                    and self.toks[self.i + 1].text == "("
+                ):
+                    quant = self.advance().text.lower()
+                    self.expect_op("(")
+                    q = self.parse_select_or_union()
+                    self.expect_op(")")
+                    e = self._quantified(opname, quant, e, q)
+                    continue
                 rhs = self.parse_additive()
                 e = ast.Call(opname, [e, rhs])
                 continue
@@ -825,6 +900,16 @@ class Parser:
             self.expect_op("(")
             e = self.parse_expr()
             self.expect_kw("as")
+            typ = self.parse_type()
+            self.expect_op(")")
+            return ast.Call("cast", [e], cast_type=typ)
+        if self.cur.kind == "id" and self.cur.text.lower() == "convert" \
+                and self.toks[self.i + 1].text == "(":
+            # CONVERT(expr, type) — the cast in function clothing
+            self.advance()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_op(",")
             typ = self.parse_type()
             self.expect_op(")")
             return ast.Call("cast", [e], cast_type=typ)

@@ -881,12 +881,44 @@ def _build_union(u: ast.Union, catalog, db, subquery_value_fn, ctes) -> LogicalP
     return plan
 
 
+def _expr_has_modifier_subq(e) -> bool:
+    if isinstance(e, ast.SubqueryExpr):
+        return e.modifier is not None
+    if isinstance(e, ast.Call):
+        return any(_expr_has_modifier_subq(a) for a in e.args)
+    if isinstance(e, ast.AggCall) and e.arg is not None:
+        return _expr_has_modifier_subq(e.arg)
+    return False
+
+
 def build_select(
     sel: ast.Select, catalog, current_db: str, subquery_value_fn=None, ctes=None
 ) -> LogicalPlan:
     """Full SELECT lowering: FROM -> WHERE (with pushdown + IN/EXISTS to
     semi/anti joins) -> AGG -> HAVING -> additive projection -> SORT ->
     LIMIT -> final projection."""
+    # HAVING with IN/EXISTS subqueries: wrap as a derived table so the
+    # subquery conjuncts run through the ordinary WHERE machinery over
+    # the aggregated output (reference: HAVING lowers to a Selection
+    # above the aggregation either way; the wrap reuses semi/mark joins
+    # instead of a post-agg special case). Conjuncts must reference
+    # select-list aliases, as MySQL HAVING requires for outer scoping.
+    if sel.having is not None and _expr_has_modifier_subq(sel.having):
+        subq_conjs, plain_conjs = [], []
+        for c in _conjuncts(sel.having):
+            (subq_conjs if _expr_has_modifier_subq(c) else plain_conjs).append(c)
+        inner = dataclasses.replace(
+            sel,
+            having=_and_all(plain_conjs) if plain_conjs else None,
+            order_by=[], limit=None, offset=None,
+        )
+        outer = ast.Select(
+            items=[ast.SelectItem(ast.Star())],
+            from_=ast.SubqueryRef(inner, "_hv"),
+            where=_and_all(subq_conjs),
+            order_by=sel.order_by, limit=sel.limit, offset=sel.offset,
+        )
+        return build_select(outer, catalog, current_db, subquery_value_fn, ctes)
     b = SelectBuilder(
         catalog, current_db, subquery_value_fn, ctes,
         hints=getattr(sel, "hints", ()),
@@ -1622,6 +1654,18 @@ def attach_value_subqueries(b, plan, node, subquery_value_fn, catalog, db, count
             b, plan, node, subquery_value_fn, catalog, db, counter
         )
         return ref, plan
+    if (
+        isinstance(node, ast.SubqueryExpr)
+        and node.modifier is None
+        and _is_correlated(node.query, plan.schema, b)
+    ):
+        # correlated SCALAR subquery in a value position: the same
+        # agg-pull-up left join as the WHERE path, but the joined value
+        # column replaces the expression directly
+        plan, ref = _attach_corr_scalar(
+            b, plan, node, subquery_value_fn, catalog, db
+        )
+        return ref, plan
     if isinstance(node, ast.Call):
         new_args = []
         for a in node.args:
@@ -1826,22 +1870,11 @@ def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog
     )
 
 
-def _decorrelate_scalar(b, plan, conjunct, subquery_value_fn, catalog, db):
-    """``expr CMP (SELECT agg(...) FROM t WHERE t.k = outer.k)`` ->
-    left join onto ``SELECT k, agg(...) FROM t GROUP BY k`` and rewrite
-    the comparison against the joined value column (reference:
-    decorrelateSolver's agg-pull-up, logical Apply -> join conversion).
-
-    An outer row with no matching group sees NULL (COUNT sees 0), which
-    matches MySQL's empty-scalar-subquery semantics."""
-    subqs = [
-        s
-        for s in _scalar_subqs_in(conjunct, [])
-        if _is_correlated(s.query, plan.schema, b)
-    ]
-    if len(subqs) != 1:
-        raise PlanError("only one correlated scalar subquery per predicate")
-    sq = subqs[0]
+def _attach_corr_scalar(b, plan, sq, subquery_value_fn, catalog, db):
+    """Correlated aggregate scalar subquery -> left join onto the
+    grouped-by-correlation-keys derived table. Returns (joined plan,
+    replacement ast) — the caller decides whether the value feeds a
+    predicate (WHERE) or a projection (value position)."""
     q = sq.query
     _check_simple_subquery(q, "scalar")
     if len(q.items) != 1:
@@ -1886,13 +1919,34 @@ def _decorrelate_scalar(b, plan, conjunct, subquery_value_fn, catalog, db):
     empty_v = _empty_group_value(q.items[0].expr)
     if empty_v is not None:
         ref = ast.Call("coalesce", [ref, ast.Const(empty_v)])
+    return jp, ref
+
+
+def _decorrelate_scalar(b, plan, conjunct, subquery_value_fn, catalog, db):
+    """``expr CMP (SELECT agg(...) FROM t WHERE t.k = outer.k)`` ->
+    left join onto ``SELECT k, agg(...) FROM t GROUP BY k`` and rewrite
+    the comparison against the joined value column (reference:
+    decorrelateSolver's agg-pull-up, logical Apply -> join conversion).
+
+    An outer row with no matching group sees NULL (COUNT sees 0), which
+    matches MySQL's empty-scalar-subquery semantics."""
+    subqs = [
+        s
+        for s in _scalar_subqs_in(conjunct, [])
+        if _is_correlated(s.query, plan.schema, b)
+    ]
+    if len(subqs) != 1:
+        raise PlanError("only one correlated scalar subquery per predicate")
+    sq = subqs[0]
+    orig_schema = plan.schema
+    jp, ref = _attach_corr_scalar(b, plan, sq, subquery_value_fn, catalog, db)
     new_pred = _replace_node(conjunct, sq, ref)
-    jb = ExprBinder(joined, _scalar_subq(subquery_value_fn))
-    sel = Selection(joined, jp, jb.bind(new_pred))
+    jb = ExprBinder(jp.schema, _scalar_subq(subquery_value_fn))
+    sel = Selection(jp.schema, jp, jb.bind(new_pred))
     return Projection(
-        plan.schema,
+        orig_schema,
         sel,
-        [(c.internal, ColumnRef(type=c.type, name=c.internal)) for c in plan.schema],
+        [(c.internal, ColumnRef(type=c.type, name=c.internal)) for c in orig_schema],
     )
 
 
