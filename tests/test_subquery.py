@@ -395,3 +395,48 @@ class TestValuePositionScalarsAndQuantified:
         assert s.execute(
             "select convert(a, double) from t where a = 2"
         ).rows == [(2.0,)]
+
+
+class TestRowValues:
+    """Row-value constructors under = / <> / IN (MySQL row
+    comparisons); NOT IN over rows is rejected (its per-column
+    3-valued NULL semantics don't fit the multi-key anti join)."""
+
+    @pytest.fixture()
+    def s(self):
+        from tidb_tpu.session.session import Session
+
+        s = Session()
+        s.execute("create table t (a int, b int)")
+        s.execute("insert into t values (1,10),(2,20),(3,30),(1,99)")
+        s.execute("create table u (x int, y int)")
+        s.execute("insert into u values (1,10),(3,30)")
+        return s
+
+    def test_row_in_subquery(self, s):
+        assert s.execute(
+            "select a, b from t where (a, b) in (select x, y from u) order by a"
+        ).rows == [(1, 10), (3, 30)]
+
+    def test_row_eq_ne(self, s):
+        assert s.execute(
+            "select a, b from t where (a, b) = (1, 10)"
+        ).rows == [(1, 10)]
+        assert s.execute(
+            "select a, b from t where (a, b) <> (1, 10) order by a, b"
+        ).rows == [(1, 99), (2, 20), (3, 30)]
+
+    def test_row_in_literal_list(self, s):
+        assert s.execute(
+            "select a, b from t where (a, b) in ((1,10),(2,20)) order by a"
+        ).rows == [(1, 10), (2, 20)]
+
+    def test_row_not_in_rejected(self, s):
+        with pytest.raises(Exception):
+            s.execute("select 1 from t where (a,b) not in (select x,y from u)")
+
+    def test_arity_mismatch_rejected(self, s):
+        with pytest.raises(Exception):
+            s.execute("select 1 from t where (a, b) in (select x from u)")
+        with pytest.raises(Exception):
+            s.execute("select 1 from t where (a, b) = (1, 2, 3)")

@@ -54,6 +54,14 @@ class AggCall:
 
 
 @dataclasses.dataclass
+class RowExpr:
+    """Row-value constructor (a, b, ...) — valid only directly under
+    =/<>/IN, where the planner expands it columnwise."""
+
+    items: List[object] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class Star:
     table: Optional[str] = None
 

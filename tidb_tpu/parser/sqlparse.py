@@ -676,6 +676,15 @@ class Parser:
         return e
 
     @staticmethod
+    def _row_eq(lhs_items, rhs_items):
+        """Columnwise AND-of-equalities for row-value comparisons."""
+        conj = None
+        for le, re_ in zip(lhs_items, rhs_items):
+            c = ast.Call("eq", [le, re_])
+            conj = c if conj is None else ast.Call("and", [conj, c])
+        return conj
+
+    @staticmethod
     def _quantified(opname: str, quant: str, lhs, q):
         """<op> ANY/ALL (subquery) rewrites (MySQL quantified compares):
         = ANY -> IN, <> ALL -> NOT IN. Ordering comparisons compare
@@ -762,6 +771,20 @@ class Parser:
                     e = self._quantified(opname, quant, e, q)
                     continue
                 rhs = self.parse_additive()
+                if isinstance(e, ast.RowExpr) or isinstance(rhs, ast.RowExpr):
+                    if (
+                        not isinstance(e, ast.RowExpr)
+                        or not isinstance(rhs, ast.RowExpr)
+                        or len(e.items) != len(rhs.items)
+                        or opname not in ("eq", "ne")
+                    ):
+                        raise ParseError(
+                            "row values support only (a,b) = / <> (c,d) "
+                            "of equal arity"
+                        )
+                    conj = self._row_eq(e.items, rhs.items)
+                    e = ast.Call("not", [conj]) if opname == "ne" else conj
+                    continue
                 e = ast.Call(opname, [e, rhs])
                 continue
             if self.at_kw("is"):
@@ -792,6 +815,25 @@ class Parser:
                     while self.accept_op(","):
                         vals.append(self.parse_expr())
                     self.expect_op(")")
+                    if isinstance(e, ast.RowExpr):
+                        # (a,b) IN ((1,2),(3,4)) -> OR of row equalities
+                        disj = None
+                        for v in vals:
+                            if (
+                                not isinstance(v, ast.RowExpr)
+                                or len(v.items) != len(e.items)
+                            ):
+                                raise ParseError(
+                                    "row-value IN list needs rows of "
+                                    "matching arity"
+                                )
+                            conj = self._row_eq(e.items, v.items)
+                            disj = (
+                                conj if disj is None
+                                else ast.Call("or", [disj, conj])
+                            )
+                        e = ast.Call("not", [disj]) if neg else disj
+                        continue
                     r = ast.Call("in", [e] + vals)
                     e = ast.Call("not", [r]) if neg else r
                 continue
@@ -976,6 +1018,14 @@ class Parser:
                 self.expect_op(")")
                 return ast.SubqueryExpr(q, None)
             e = self.parse_expr()
+            if self.at_op(","):
+                # row-value constructor (a, b, ...): meaningful only
+                # directly under =/<>/IN, expanded by the planner
+                items = [e]
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.RowExpr(items)
             self.expect_op(")")
             return e
         if (

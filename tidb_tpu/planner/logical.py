@@ -1823,6 +1823,10 @@ def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog
     corr_pairs: List[Tuple[object, object]] = []
     inner_q = q
     if correlated:
+        if isinstance(sq.lhs, ast.RowExpr):
+            raise PlanError(
+                "correlated row-value IN not supported (use EXISTS)"
+            )
         if sq.modifier == "not in":
             raise PlanError(
                 "correlated NOT IN not supported (use NOT EXISTS)"
@@ -1850,12 +1854,35 @@ def _subquery_semijoin(b, plan, sq: ast.SubqueryExpr, subquery_value_fn, catalog
     else:
         residuals, extra = [], []
     inner = build_query(inner_q, catalog, db, subquery_value_fn, b.ctes)
+    ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
+    kind = "semi" if sq.modifier == "in" else "anti"
+    if isinstance(sq.lhs, ast.RowExpr):
+        # (a, b) IN (SELECT x, y ...): one equality key per column
+        if corr_pairs:
+            raise PlanError("correlated row-value IN not supported")
+        if sq.modifier == "not in":
+            # row-value NOT IN needs per-column 3-valued NULL handling
+            # the multi-key anti join can't express — refuse rather
+            # than silently dropping NULL semantics
+            raise PlanError(
+                "row-value NOT IN is not supported (rewrite as NOT EXISTS)"
+            )
+        ncols = len(sq.lhs.items)
+        if len(inner.schema.cols) != ncols + len(extra):
+            raise PlanError("row-value IN subquery arity mismatch")
+        keys = [
+            (ob.bind(le), ColumnRef(type=c.type, name=c.internal))
+            for le, c in zip(sq.lhs.items, inner.schema.cols[:ncols])
+        ]
+        res = _bind_residuals(
+            plan.schema, inner.schema, residuals, subquery_value_fn
+        )
+        # NOT IN was rejected above: this is always a plain semi join
+        return JoinPlan(plan.schema, "semi", plan, inner, keys, res)
     if len(inner.schema.cols) != 1 + len(corr_pairs) + len(extra):
         raise PlanError("IN subquery must select exactly one column")
-    ob = ExprBinder(plan.schema, _scalar_subq(subquery_value_fn))
     lhs_bound = ob.bind(sq.lhs)
     rhs_col = inner.schema.cols[0]
-    kind = "semi" if sq.modifier == "in" else "anti"
     keys = [(lhs_bound, ColumnRef(type=rhs_col.type, name=rhs_col.internal))]
     keys += _bind_corr_keys(ob, corr_pairs, inner.schema.cols[1 : 1 + len(corr_pairs)])
     res = _bind_residuals(plan.schema, inner.schema, residuals, subquery_value_fn)
