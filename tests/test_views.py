@@ -1,0 +1,215 @@
+"""Views: CREATE [OR REPLACE] VIEW / DROP VIEW / expansion in queries.
+
+Reference: view DDL in pkg/ddl (CreateView) and query-time inlining in
+pkg/planner/core/logical_plan_builder.go BuildDataSourceFromView — the
+definition is stored as SELECT text and re-planned per use against the
+view's own database.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a int, b int, c varchar(20))")
+    s.execute(
+        "insert into t values (1, 10, 'x'), (2, 20, 'y'), (3, 30, 'x'), "
+        "(4, 40, 'z')"
+    )
+    return s
+
+
+class TestViewBasics:
+    def test_select_from_view(self, sess):
+        sess.execute("create view v as select a, b from t where b >= 20")
+        assert sess.execute("select * from v order by a").rows == [
+            (2, 20), (3, 30), (4, 40)
+        ]
+
+    def test_view_with_column_list(self, sess):
+        sess.execute("create view v (x, y) as select a, b * 2 from t")
+        assert sess.execute(
+            "select x, y from v where x <= 2 order by x"
+        ).rows == [(1, 20), (2, 40)]
+
+    def test_view_alias_and_join(self, sess):
+        sess.execute("create view v as select a, c from t")
+        rows = sess.execute(
+            "select v1.a, v2.a from v v1 join v v2 on v1.c = v2.c "
+            "where v1.a < v2.a order by v1.a"
+        ).rows
+        assert rows == [(1, 3)]
+
+    def test_aggregate_over_view(self, sess):
+        sess.execute("create view v as select a, b, c from t")
+        assert sess.execute(
+            "select c, sum(b) s from v group by c order by c"
+        ).rows == [("x", 40), ("y", 20), ("z", 40)]
+
+    def test_view_over_view(self, sess):
+        sess.execute("create view v1 as select a, b from t where a > 1")
+        sess.execute("create view v2 as select a from v1 where b < 40")
+        assert sess.execute("select * from v2 order by a").rows == [(2,), (3,)]
+
+    def test_view_sees_fresh_data(self, sess):
+        sess.execute("create view v as select count(*) n from t")
+        assert sess.execute("select n from v").rows == [(4,)]
+        sess.execute("insert into t values (5, 50, 'w')")
+        assert sess.execute("select n from v").rows == [(5,)]
+
+    def test_or_replace(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(ValueError, match="exists"):
+            sess.execute("create view v as select b from t")
+        sess.execute("create or replace view v as select b from t")
+        assert sess.execute("select * from v order by b").rows[0] == (10,)
+
+    def test_cte_shadows_view(self, sess):
+        sess.execute("create view v as select a from t")
+        rows = sess.execute(
+            "with v as (select 99 a) select a from v"
+        ).rows
+        assert rows == [(99,)]
+
+
+class TestViewErrors:
+    def test_unknown_source_at_create(self, sess):
+        with pytest.raises(Exception, match="unknown table"):
+            sess.execute("create view v as select * from nosuch")
+
+    def test_column_list_arity(self, sess):
+        with pytest.raises(ValueError, match="column list"):
+            sess.execute("create view v (x) as select a, b from t")
+
+    def test_duplicate_output_names(self, sess):
+        with pytest.raises(ValueError, match="duplicate column"):
+            sess.execute("create view v as select a, a from t")
+
+    def test_recursive_definition_rejected(self, sess):
+        sess.execute("create view v1 as select a from t")
+        sess.execute("create view v2 as select a from v1")
+        # OR REPLACE validates the new body against the OLD v1, so the
+        # redefinition itself succeeds — the cycle it introduces is
+        # caught by the expansion stack at use
+        sess.execute("create or replace view v1 as select a from v2")
+        with pytest.raises(Exception, match="recursively defined"):
+            sess.execute("select * from v1")
+
+    def test_dml_on_view_rejected(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(ValueError, match="view"):
+            sess.execute("insert into v values (9)")
+        with pytest.raises(ValueError, match="view"):
+            sess.execute("delete from v where a = 1")
+
+    def test_drop_table_on_view_rejected(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(ValueError, match="DROP VIEW"):
+            sess.execute("drop table v")
+        sess.execute("drop view v")
+        with pytest.raises(ValueError, match="unknown view"):
+            sess.execute("drop view v")
+        sess.execute("drop view if exists v")
+
+    def test_create_table_name_collision(self, sess):
+        sess.execute("create view v as select a from t")
+        with pytest.raises(ValueError, match="view"):
+            sess.execute("create table v (x int)")
+
+
+class TestViewShowAndPersist:
+    def test_show_tables_and_create_view(self, sess):
+        sess.execute("create view v as select a from t")
+        names = [r[0] for r in sess.execute("show tables").rows]
+        assert names == ["t", "v"]
+        rows = sess.execute("show create view v").rows
+        assert rows[0][0] == "v"
+        assert "select a from t" in rows[0][1].lower()
+        rows = sess.execute("show create table t").rows
+        assert rows[0][0] == "t" and "`a` bigint" in rows[0][1]
+        assert sess.execute(
+            "select table_name, table_rows from information_schema.tables "
+            "where table_schema = 'test' order by table_name"
+        ).rows == [("t", 4), ("v", 0)]
+
+    def test_persist_roundtrip(self, sess, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        sess.execute("create view v (x) as select a from t where b > 15")
+        save_catalog(sess.catalog, str(tmp_path))
+        s2 = Session(load_catalog(str(tmp_path)))
+        assert s2.execute("select x from v order by x").rows == [
+            (2,), (3,), (4,)
+        ]
+
+
+class TestViewPrivileges:
+    def test_definer_semantics(self, sess):
+        sess.execute("create user u1 identified by ''")
+        sess.execute("create view v as select a from t")
+        sess.execute("grant select on test.v to u1")
+        s2 = Session(sess.catalog, user="u1")
+        # u1 may read the view without any grant on the base table
+        assert s2.execute("select * from v order by a").rows[0] == (1,)
+        with pytest.raises(PermissionError):
+            s2.execute("select * from t")
+
+    def test_view_select_denied_without_grant(self, sess):
+        sess.execute("create user u2 identified by ''")
+        sess.execute("create view v as select a from t")
+        s2 = Session(sess.catalog, user="u2")
+        with pytest.raises(PermissionError):
+            s2.execute("select * from v")
+
+    def test_no_exfiltration_via_insert_select(self, sess):
+        sess.execute("create user u4 identified by ''")
+        sess.execute("create view v as select a from t")
+        sess.execute("create table sink (a int)")
+        sess.execute("grant insert on test.sink to u4")
+        sess.execute("grant select on test.sink to u4")
+        s2 = Session(sess.catalog, user="u4")
+        with pytest.raises(PermissionError):
+            s2.execute("insert into sink select a from v")
+
+    def test_cross_db_view_with_scalar_subquery(self, sess):
+        # the body's bare table refs AND its scalar subqueries must
+        # resolve against the view's db, not the session's current db
+        sess.execute("create database other")
+        sess.execute("create table other.t (a int)")
+        sess.execute("insert into other.t values (7), (8)")
+        sess.execute(
+            "create view other.vmax as "
+            "select a from t where a = (select max(a) from t)"
+        )
+        assert sess.execute("select * from other.vmax").rows == [(8,)]
+
+    def test_cte_name_shadowing_is_scoped(self, sess):
+        # a CTE named t2 inside a derived table must not stop the OUTER
+        # scalar-subquery ref to base table t2 from being anchored to
+        # the view's db (scope-aware qualification)
+        sess.execute("create database db2")
+        sess.execute("create table db2.t2 (a int)")
+        sess.execute("insert into db2.t2 values (5), (6)")
+        sess.execute(
+            "create view db2.vx as select (select max(a) from t2) m, q "
+            "from (with t2 as (select 1 q) select q from t2) d"
+        )
+        assert sess.execute("select * from db2.vx").rows == [(6, 1)]
+
+    def test_infoschema_columns_lists_views(self, sess):
+        sess.execute("create view v (x, y) as select a, c from t")
+        rows = sess.execute(
+            "select column_name, data_type from information_schema.columns "
+            "where table_name = 'v' order by ordinal_position"
+        ).rows
+        assert rows == [("x", "int"), ("y", "string")]
+
+    def test_create_view_needs_select_on_source(self, sess):
+        sess.execute("create user u3 identified by ''")
+        sess.execute("grant create on test.* to u3")
+        s2 = Session(sess.catalog, user="u3")
+        with pytest.raises(PermissionError):
+            s2.execute("create view leak as select a from t")

@@ -218,6 +218,29 @@ class DropTable:
 
 
 @dataclasses.dataclass
+class CreateView:
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS <select>. The view body
+    is stored as SQL text and re-planned per use (reference: view
+    definitions kept as SELECT text in TableInfo.View,
+    pkg/parser/model + pkg/planner/core/logical_plan_builder.go
+    BuildDataSourceFromView)."""
+
+    db: Optional[str]
+    name: str
+    columns: Optional[List[str]]  # explicit column-name list, or None
+    query_sql: str  # the SELECT body, verbatim
+    query: object = None  # parsed body (validation + arity checks)
+    or_replace: bool = False
+
+
+@dataclasses.dataclass
+class DropView:
+    db: Optional[str]
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class AlterTable:
     db: Optional[str]
     name: str

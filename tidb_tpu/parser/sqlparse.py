@@ -263,8 +263,20 @@ class Parser:
                 self.expect_kw("from")
                 db, name = self._qualified_name()
                 return ast.Show("index", db=f"{db or ''}.{name}")
+            if self.accept_kw("create"):
+                what = (
+                    "create_view"
+                    if self._at_ident("view")
+                    else "create_table" if self.at_kw("table") else None
+                )
+                if what is None:
+                    raise ParseError("SHOW CREATE supports TABLE | VIEW")
+                self.advance()
+                db, name = self._qualified_name()
+                return ast.Show(what, db=f"{db or ''}.{name}")
             raise ParseError(
-                "SHOW supports TABLES | DATABASES | VARIABLES | GRANTS | INDEX"
+                "SHOW supports TABLES | DATABASES | VARIABLES | GRANTS | "
+                "INDEX | CREATE TABLE/VIEW"
             )
         if self.at_kw("grant", "revoke"):
             return self.parse_grant_revoke()
@@ -1274,8 +1286,41 @@ class Parser:
         user = self._user_name()
         return ast.GrantStmt(tuple(privs), db, tbl, user, revoke=revoke)
 
+    def _at_ident(self, word: str) -> bool:
+        return self.cur.kind == "id" and self.cur.text.lower() == word
+
     def parse_create(self):
         self.expect_kw("create")
+        or_replace = False
+        if self.accept_kw("or"):
+            # OR REPLACE is only valid before VIEW ('view'/'replace' stay
+            # plain identifiers everywhere else, like REPLACE INTO)
+            if not self._at_ident("replace"):
+                raise ParseError("expected REPLACE after CREATE OR")
+            self.advance()
+            if not self._at_ident("view"):
+                raise ParseError("expected VIEW after CREATE OR REPLACE")
+            or_replace = True
+        if self._at_ident("view"):
+            self.advance()
+            db, name = self._qualified_name()
+            cols = None
+            if self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+            self.expect_kw("as")
+            start = self.cur.pos
+            q = (
+                self.parse_with()
+                if self.at_kw("with")
+                else self.parse_select_or_union()
+            )
+            return ast.CreateView(
+                db, name, cols, self.sql[start : self.cur.pos].strip(),
+                query=q, or_replace=or_replace,
+            )
         if self.accept_kw("database"):
             ine = self._if_not_exists()
             return ast.CreateDatabase(self.expect_ident(), ine)
@@ -1468,6 +1513,14 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self._at_ident("view"):
+            self.advance()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            db, name = self._qualified_name()
+            return ast.DropView(db, name, if_exists)
         if self.accept_kw("database"):
             return ast.DropDatabase(self.expect_ident())
         if self.accept_kw("binding"):

@@ -17,6 +17,7 @@ used directly as device Batch column names.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tidb_tpu.dtypes import BOOL, DATE, INT64, Kind, SQLType
@@ -478,6 +479,35 @@ def _ast_columns(e, out: set):
     return out
 
 
+# per-thread stack of views currently being inlined (cycle/depth guard)
+_VIEW_EXPANSION = threading.local()
+
+
+def qualify_view_body(node, db: str, cte_names: frozenset = frozenset()):
+    """Attach an explicit db qualifier to every bare TableRef in a view
+    body, so the stored SELECT text resolves identically no matter which
+    database the referencing session is in (scalar subqueries execute
+    through the session executor against the session's CURRENT db —
+    qualifiers anchor them to the view's db). CTE names are tracked
+    scope-aware: a WITH's names shadow tables only inside that WITH's
+    subtree, not across the whole body."""
+    if isinstance(node, ast.With):
+        inner = cte_names | {name.lower() for name, _q in node.ctes}
+        for _name, q in node.ctes:
+            qualify_view_body(q, db, inner)
+        qualify_view_body(node.body, db, inner)
+        return
+    if isinstance(node, ast.TableRef):
+        if node.db is None and node.name.lower() not in cte_names:
+            node.db = db
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            qualify_view_body(getattr(node, f.name), db, cte_names)
+    elif isinstance(node, (list, tuple)):
+        for x in node:
+            qualify_view_body(x, db, cte_names)
+
+
 class SelectBuilder:
     """Builds a logical plan for one SELECT. ``ctes`` maps CTE names to
     their parser ASTs (resolved before catalog tables, like the
@@ -523,6 +553,11 @@ class SelectBuilder:
                     ],
                 )
             db = node.db or self.db
+            vdef = self.catalog.view_def(db, node.name) if hasattr(
+                self.catalog, "view_def"
+            ) else None
+            if vdef is not None:
+                return self._expand_view(db, node, vdef)
             t = self.catalog.table(db, node.name)
             alias = (node.alias or node.name).lower()
             cols = [
@@ -557,6 +592,57 @@ class SelectBuilder:
                 return self._build_full_join(left, right, node.on, schema)
             return self._build_join(node.kind, left, right, node.on, schema)
         raise PlanError(f"unsupported FROM clause {node!r}")
+
+    def _expand_view(self, db: str, node, vdef) -> LogicalPlan:
+        """Inline a view reference: re-parse the stored SELECT text and
+        plan it as a derived table under the view's (aliased) name.
+        The body resolves against the VIEW's database and an empty CTE
+        scope (a view cannot see the outer statement's CTEs), mirroring
+        the reference's BuildDataSourceFromView
+        (pkg/planner/core/logical_plan_builder.go). A thread-local
+        expansion stack rejects definition cycles that OR REPLACE can
+        introduce after creation."""
+        from tidb_tpu.parser.sqlparse import parse as _parse
+
+        sql_text, vcols = vdef
+        key = f"{db.lower()}.{node.name.lower()}"
+        stack = getattr(_VIEW_EXPANSION, "stack", None)
+        if stack is None:
+            stack = _VIEW_EXPANSION.stack = []
+        if key in stack:
+            raise PlanError(f"view {key} is recursively defined")
+        if len(stack) >= 16:
+            raise PlanError("view nesting too deep (limit 16)")
+        stack.append(key)
+        try:
+            stmts = _parse(sql_text)
+            qualify_view_body(stmts[0], db)
+            inner = build_query(
+                stmts[0], self.catalog, db, self.subquery_value_fn, None
+            )
+        finally:
+            stack.pop()
+        alias = (node.alias or node.name).lower()
+        names = (
+            list(vcols) if vcols else [c.name for c in inner.schema]
+        )
+        if len(names) != len(inner.schema.cols):
+            raise PlanError(
+                f"view {key} declares {len(names)} columns but its "
+                f"SELECT yields {len(inner.schema.cols)}"
+            )
+        cols = [
+            OutCol(alias, n, f"{alias}.{n}", c.type)
+            for n, c in zip(names, inner.schema)
+        ]
+        return Projection(
+            Schema(cols),
+            inner,
+            [
+                (f"{alias}.{n}", ColumnRef(type=c.type, name=c.internal))
+                for n, c in zip(names, inner.schema)
+            ],
+        )
 
     def _build_full_join(self, left, right, on, schema):
         """FULL OUTER JOIN as LEFT JOIN ∪ (right ANTI left with NULL

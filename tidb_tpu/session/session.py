@@ -302,8 +302,13 @@ class Session:
         if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp, ast.Explain)):
             for tr in self._ast_tables(s):
                 db = (tr.db or self.db).lower()
-                # CTE names / derived tables aren't catalog tables
-                if self.catalog.has_table(db, tr.name):
+                # CTE names / derived tables aren't catalog tables.
+                # Views check SELECT on the VIEW name only — underlying
+                # tables were checked against the creator at CREATE VIEW
+                # (definer semantics, the MySQL default).
+                if self.catalog.has_table(db, tr.name) or (
+                    self.catalog.has_view(db, tr.name)
+                ):
                     self._check_priv("select", db, tr.name.lower())
             return
         if isinstance(s, (ast.Insert, ast.Update, ast.Delete, ast.LoadData)):
@@ -316,10 +321,12 @@ class Session:
             self._check_priv(priv, (s.db or self.db).lower(), s.table.lower())
             # any table READ inside the statement (subqueries in VALUES /
             # SET / WHERE) needs SELECT — otherwise INSERT-only users
-            # could exfiltrate other tables through a subquery
+            # could exfiltrate other tables (or views) through a subquery
             for tr in self._ast_tables(s):
                 db = (tr.db or self.db).lower()
-                if self.catalog.has_table(db, tr.name):
+                if self.catalog.has_table(db, tr.name) or (
+                    self.catalog.has_view(db, tr.name)
+                ):
                     self._check_priv("select", db, tr.name.lower())
         elif isinstance(s, ast.CreateTable):
             self._check_priv("create", (s.db or self.db).lower())
@@ -328,9 +335,24 @@ class Session:
             if s.as_query is not None:
                 for tr in self._ast_tables(s.as_query):
                     db = (tr.db or self.db).lower()
-                    if self.catalog.has_table(db, tr.name):
+                    if self.catalog.has_table(db, tr.name) or (
+                        self.catalog.has_view(db, tr.name)
+                    ):
                         self._check_priv("select", db, tr.name.lower())
         elif isinstance(s, ast.DropTable):
+            self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
+        elif isinstance(s, ast.CreateView):
+            self._check_priv("create", (s.db or self.db).lower())
+            # the creator must be able to read every source table NOW —
+            # later readers of the view inherit this check's result.
+            # Bare refs resolve against the VIEW's db, like expansion.
+            for tr in self._ast_tables(s.query):
+                db = (tr.db or s.db or self.db).lower()
+                if self.catalog.has_table(db, tr.name) or (
+                    self.catalog.has_view(db, tr.name)
+                ):
+                    self._check_priv("select", db, tr.name.lower())
+        elif isinstance(s, ast.DropView):
             self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
         elif isinstance(s, ast.AlterTable):
             self._check_priv("alter", (s.db or self.db).lower(), s.name.lower())
@@ -521,6 +543,37 @@ class Session:
             self.catalog.drop_table(s.db or self.db, s.name, s.if_exists)
             clear_scan_cache()
             r = Result([], [])
+        elif isinstance(s, ast.CreateView):
+            db = (s.db or self.db).lower()
+            if self.catalog.has_view(db, s.name) and not s.or_replace:
+                raise ValueError(f"view {s.name} exists")
+            # plan the body NOW so unknown tables/columns, arity and
+            # ambiguity surface at CREATE time (MySQL does the same);
+            # the stored text is re-planned per use. Qualify bare refs
+            # with the view's db first — validation must see the same
+            # resolution the expansion path will use (scalar subqueries
+            # execute against the session's current db otherwise).
+            from tidb_tpu.planner.logical import qualify_view_body
+
+            qualify_view_body(s.query, db)
+            plan = build_query(s.query, self.catalog, db, self._scalar_subquery)
+            names = [
+                c.lower() for c in (s.columns or [])
+            ] or [c.name for c in plan.schema.cols]
+            if s.columns and len(s.columns) != len(plan.schema.cols):
+                raise ValueError(
+                    f"view column list has {len(s.columns)} names but "
+                    f"SELECT yields {len(plan.schema.cols)} columns"
+                )
+            if len(set(names)) != len(names):
+                raise ValueError("duplicate column name in view")
+            self.catalog.create_view(
+                db, s.name, s.query_sql, s.columns, s.or_replace
+            )
+            r = Result([], [])
+        elif isinstance(s, ast.DropView):
+            self.catalog.drop_view(s.db or self.db, s.name, s.if_exists)
+            r = Result([], [])
         elif isinstance(s, ast.AlterTable):
             failpoint.inject("ddl/alter-table")
             t = self.catalog.table(s.db or self.db, s.name)
@@ -682,7 +735,12 @@ class Session:
     # ------------------------------------------------------------------
     def _run_show(self, s: ast.Show) -> Result:
         if s.what == "tables":
-            return Result(["Tables"], [(t,) for t in self.catalog.tables(self.db)])
+            # base tables and views interleave in one sorted listing,
+            # like MySQL SHOW TABLES
+            names = sorted(
+                self.catalog.tables(self.db) + self.catalog.views(self.db)
+            )
+            return Result(["Tables"], [(t,) for t in names])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
         if s.what == "bindings":
@@ -698,6 +756,34 @@ class Session:
             return Result(
                 [f"Grants for {user}@%"],
                 [(g,) for g in self.catalog.users.show_grants(user)],
+            )
+        if s.what in ("create_table", "create_view"):
+            db, name = s.db.split(".", 1)
+            db = db or self.db
+            if not self.catalog.users.is_super(self.user) and not any(
+                self.catalog.users.check(self.user, p, db.lower(), name.lower())
+                for p in ("select", "insert", "update", "delete")
+            ):
+                raise PermissionError(
+                    f"SHOW CREATE denied to user {self.user!r} on {db}.{name}"
+                )
+            if s.what == "create_view":
+                vdef = self.catalog.view_def(db, name)
+                if vdef is None:
+                    raise ValueError(f"unknown view {db}.{name}")
+                sql_text, vcols = vdef
+                collist = f" ({', '.join(vcols)})" if vcols else ""
+                return Result(
+                    ["View", "Create View"],
+                    [(name.lower(),
+                      f"CREATE VIEW `{name.lower()}`{collist} AS {sql_text}")],
+                )
+            from tidb_tpu.tools.dump import create_table_sql
+
+            t = self.catalog.table(db, name)
+            return Result(
+                ["Table", "Create Table"],
+                [(name.lower(), create_table_sql(t).rstrip(";"))],
             )
         if s.what == "index":
             db, name = s.db.split(".", 1)

@@ -22,6 +22,11 @@ class Catalog:
         self._lock = threading.Lock()
         self.schema_version = 0
         self._dbs: Dict[str, Dict[str, Table]] = {"test": {}}
+        # views: db -> name -> (select SQL text, explicit column names or
+        # None). Stored as text and re-planned per use, like the
+        # reference's TableInfo.View SELECT text
+        # (pkg/planner/core/logical_plan_builder.go BuildDataSourceFromView)
+        self._views: Dict[str, Dict[str, tuple]] = {"test": {}}
         # account + grant store (reference: mysql.user et al cached by
         # pkg/privilege); lives on the catalog so every session/server
         # over the same store shares one authority
@@ -37,11 +42,13 @@ class Catalog:
                     return
                 raise ValueError(f"database {name!r} exists")
             self._dbs[name] = {}
+            self._views[name] = {}
             self.schema_version += 1
 
     def drop_database(self, name: str) -> None:
         with self._lock:
             self._dbs.pop(name.lower(), None)
+            self._views.pop(name.lower(), None)
             self.schema_version += 1
 
     def create_table(
@@ -55,6 +62,8 @@ class Catalog:
                 if if_not_exists:
                     return self._dbs[db][name]
                 raise ValueError(f"table {name!r} exists")
+            if name in self._views.get(db, {}):
+                raise ValueError(f"view {name!r} exists")
             t = Table(name, schema)
             self._dbs[db][name] = t
             self.schema_version += 1
@@ -64,6 +73,10 @@ class Catalog:
         db, name = db.lower(), name.lower()
         with self._lock:
             if name not in self._dbs.get(db, {}):
+                if name in self._views.get(db, {}):
+                    raise ValueError(
+                        f"{db}.{name} is a view (use DROP VIEW)"
+                    )
                 if if_exists:
                     return
                 raise ValueError(f"unknown table {db}.{name}")
@@ -76,7 +89,80 @@ class Catalog:
         try:
             return self._dbs[db.lower()][name.lower()]
         except KeyError:
+            if name.lower() in self._views.get(db.lower(), {}):
+                raise ValueError(
+                    f"{db}.{name} is a view, not a base table"
+                ) from None
             raise ValueError(f"unknown table {db}.{name}") from None
+
+    # -- views -------------------------------------------------------------
+    def create_view(
+        self, db: str, name: str, sql: str, columns=None,
+        or_replace: bool = False,
+    ) -> None:
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if db not in self._dbs:
+                raise ValueError(f"unknown database {db!r}")
+            if name in self._dbs[db]:
+                raise ValueError(f"table {name!r} exists")
+            if name in self._views[db] and not or_replace:
+                raise ValueError(f"view {name!r} exists")
+            self._views[db][name] = (
+                sql, tuple(c.lower() for c in columns) if columns else None
+            )
+            self.schema_version += 1
+
+    def drop_view(self, db: str, name: str, if_exists: bool = False) -> None:
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if name not in self._views.get(db, {}):
+                if if_exists:
+                    return
+                raise ValueError(f"unknown view {db}.{name}")
+            del self._views[db][name]
+            self.schema_version += 1
+
+    def view_def(self, db: str, name: str):
+        """(sql, columns-or-None) for a view, else None."""
+        return self._views.get(db.lower(), {}).get(name.lower())
+
+    def has_view(self, db: str, name: str) -> bool:
+        return name.lower() in self._views.get(db.lower(), {})
+
+    def views(self, db: str) -> List[str]:
+        return sorted(self._views.get(db.lower(), {}))
+
+    def _view_columns(self, db: str, name: str):
+        """[(col, type)] of a view, by planning its body (how the
+        reference fills information_schema.columns for views). Views
+        whose body can't be planned standalone (e.g. scalar subqueries,
+        which need a session executor) yield no columns rather than
+        failing the whole listing."""
+        vdef = self.view_def(db, name)
+        if vdef is None:
+            return []
+        # reentrancy guard: a view over information_schema.columns would
+        # otherwise recurse through this very listing
+        if getattr(self, "_planning_view_cols", False):
+            return []
+        self._planning_view_cols = True
+        sql_text, vcols = vdef
+        try:
+            from tidb_tpu.parser.sqlparse import parse as _parse
+            from tidb_tpu.planner.logical import (
+                build_query, qualify_view_body,
+            )
+
+            stmt = _parse(sql_text)[0]
+            qualify_view_body(stmt, db)
+            plan = build_query(stmt, self, db, None)
+            names = list(vcols) if vcols else [c.name for c in plan.schema]
+            return list(zip(names, [c.type for c in plan.schema.cols]))
+        except Exception:
+            return []
+        finally:
+            self._planning_view_cols = False
 
     # -- information_schema virtual tables ---------------------------------
     # (reference: pkg/infoschema virtual memtables, interface.go:26 +
@@ -130,6 +216,8 @@ class Catalog:
                         continue
                     for tn in sorted(self._dbs[db]):
                         rows.append((db, tn, self._dbs[db][tn].nrows))
+                    for vn in sorted(self._views.get(db, {})):
+                        rows.append((db, vn, 0))
         elif name == "columns":
             schema = TableSchema(
                 [("table_schema", STRING), ("table_name", STRING),
@@ -146,6 +234,10 @@ class Catalog:
                             self._dbs[db][tn].schema.columns, 1
                         ):
                             rows.append((db, tn, cn, i, repr(ct).lower()))
+            for db in sorted(self._views):
+                for vn in sorted(self._views.get(db, {})):
+                    for i, (cn, ct) in enumerate(self._view_columns(db, vn), 1):
+                        rows.append((db, vn, cn, i, repr(ct).lower()))
         elif name == "statistics":
             # index metadata (MySQL information_schema.statistics /
             # SHOW INDEX; reference pkg/infoschema/tables.go)

@@ -65,6 +65,14 @@ def save_catalog(
     if users is not None:
         manifest["users"] = users.to_manifest()
     want = {d.lower() for d in dbs} if dbs else None
+    manifest.setdefault("views", {})
+    for db in catalog.databases():
+        if db.startswith("_") or (want is not None and db.lower() not in want):
+            continue
+        manifest["views"][db] = {}
+        for vn in catalog.views(db):
+            vsql, vcols = catalog.view_def(db, vn)
+            manifest["views"][db][vn] = [vsql, list(vcols) if vcols else None]
     for db in catalog.databases():
         if db.startswith("_"):  # scratch schemas (recursive CTE temps)
             continue
@@ -167,4 +175,10 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
             # always replace — restoring an empty snapshot over a live
             # table must clear it, not silently keep the newer rows
             t.replace_blocks([block] if block.nrows else [])
+    for db, views in manifest.get("views", {}).items():
+        if want is not None and db.lower() not in want:
+            continue
+        catalog.create_database(db, if_not_exists=True)
+        for vn, (vsql, vcols) in views.items():
+            catalog.create_view(db, vn, vsql, vcols, or_replace=True)
     return catalog
