@@ -1,0 +1,280 @@
+"""CHECK constraints, FOREIGN KEYs (RESTRICT), and SAVEPOINTs.
+
+Reference: constraint checks in the write path (pkg/table/tables.go
+CheckRowConstraint), FK enforcement (pkg/executor FK checks/cascades —
+RESTRICT only here), savepoints (pkg/session savepoint support).
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    return Session()
+
+
+class TestCheck:
+    def test_basic_check(self, sess):
+        sess.execute("create table t (a int, b int, check (a > 0))")
+        sess.execute("insert into t values (1, 2)")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("insert into t values (0, 5)")
+        assert sess.execute("select count(*) from t").rows == [(1,)]
+
+    def test_null_passes(self, sess):
+        # SQL: CHECK fails only on FALSE; UNKNOWN (NULL) passes
+        sess.execute("create table t (a int, check (a > 0))")
+        sess.execute("insert into t values (null)")
+        assert sess.execute("select count(*) from t").rows == [(1,)]
+
+    def test_named_and_multi_column(self, sess):
+        sess.execute(
+            "create table t (lo int, hi int, "
+            "constraint ordered check (lo <= hi))"
+        )
+        sess.execute("insert into t values (1, 5)")
+        with pytest.raises(ValueError, match="ordered"):
+            sess.execute("insert into t values (9, 5)")
+
+    def test_column_level_check(self, sess):
+        sess.execute("create table t (pct int check (pct between 0 and 100))")
+        sess.execute("insert into t values (50)")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("insert into t values (101)")
+
+    def test_check_on_update(self, sess):
+        sess.execute("create table t (a int, check (a < 10))")
+        sess.execute("insert into t values (5)")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("update t set a = 20 where a = 5")
+        assert sess.execute("select a from t").rows == [(5,)]
+
+    def test_check_with_strings_and_in(self, sess):
+        sess.execute(
+            "create table t (s varchar(10), check (s in ('a', 'b')))"
+        )
+        sess.execute("insert into t values ('a')")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("insert into t values ('c')")
+
+    def test_unknown_column_rejected_at_create(self, sess):
+        with pytest.raises(ValueError, match="unknown columns"):
+            sess.execute("create table t (a int, check (b > 0))")
+
+    def test_atomic_multi_row_insert(self, sess):
+        sess.execute("create table t (a int, check (a > 0))")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("insert into t values (1), (2), (-1)")
+        assert sess.execute("select count(*) from t").rows == [(0,)]
+
+    def test_drop_column_guard(self, sess):
+        sess.execute("create table t (a int, b int, check (a > 0))")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("alter table t drop column a")
+        sess.execute("alter table t drop column b")
+
+
+class TestForeignKey:
+    @pytest.fixture()
+    def fk(self, sess):
+        sess.execute("create table parent (id int primary key, v int)")
+        sess.execute("insert into parent values (1, 10), (2, 20)")
+        sess.execute(
+            "create table child (id int, pid int, "
+            "foreign key (pid) references parent (id))"
+        )
+        return sess
+
+    def test_child_insert(self, fk):
+        fk.execute("insert into child values (100, 1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            fk.execute("insert into child values (101, 99)")
+        fk.execute("insert into child values (102, null)")  # NULL FK ok
+
+    def test_parent_delete_restricted(self, fk):
+        fk.execute("insert into child values (100, 1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            fk.execute("delete from parent where id = 1")
+        fk.execute("delete from parent where id = 2")  # unreferenced: ok
+        fk.execute("delete from child where id = 100")
+        fk.execute("delete from parent where id = 1")  # now unreferenced
+
+    def test_parent_update_restricted(self, fk):
+        fk.execute("insert into child values (100, 1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            fk.execute("update parent set id = 5 where id = 1")
+        fk.execute("update parent set v = 99 where id = 1")  # non-key ok
+
+    def test_child_update_checked(self, fk):
+        fk.execute("insert into child values (100, 1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            fk.execute("update child set pid = 42 where id = 100")
+        fk.execute("update child set pid = 2 where id = 100")
+
+    def test_drop_parent_blocked(self, fk):
+        with pytest.raises(ValueError, match="referenced by"):
+            fk.execute("drop table parent")
+        fk.execute("drop table child")
+        fk.execute("drop table parent")
+
+    def test_self_referential(self, sess):
+        sess.execute(
+            "create table emp (id int primary key, mgr int, "
+            "foreign key (mgr) references emp (id))"
+        )
+        # a manager inserted in the same statement is a valid target
+        sess.execute("insert into emp values (1, null), (2, 1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("insert into emp values (3, 77)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("delete from emp where id = 1")
+        sess.execute("delete from emp")  # full truncate removes both sides
+
+    def test_column_level_references(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute("insert into p values (7)")
+        sess.execute("create table c (pid int references p (id))")
+        sess.execute("insert into c values (7)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("insert into c values (8)")
+
+    def test_unknown_parent_at_create(self, sess):
+        with pytest.raises(ValueError, match="unknown table"):
+            sess.execute(
+                "create table c (pid int, "
+                "foreign key (pid) references ghost (id))"
+            )
+
+    def test_bare_numeric_check_is_sql_truthy(self, sess):
+        # CHECK (a) fails on 0, like MySQL's boolean coercion
+        sess.execute("create table t (a int, check (a))")
+        sess.execute("insert into t values (1)")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("insert into t values (0)")
+
+    def test_replace_cannot_orphan_children(self, sess):
+        sess.execute("create table p (id int primary key, code int)")
+        sess.execute("insert into p values (1, 10)")
+        sess.execute(
+            "create table c (x int, foreign key (x) references p (code))"
+        )
+        sess.execute("insert into c values (10)")
+        # replacing pk=1 would swap code 10 -> 20, dangling the child
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("replace into p values (1, 20)")
+        assert sess.execute("select code from p").rows == [(10,)]
+        sess.execute("replace into p values (1, 10)")  # same code: fine
+
+    def test_drop_database_blocked_by_external_child(self, sess):
+        sess.execute("create database pdb")
+        sess.execute("create table pdb.p (id int primary key)")
+        sess.execute(
+            "create table c (x int, foreign key (x) references pdb.p (id))"
+        )
+        with pytest.raises(ValueError, match="referenced by"):
+            sess.execute("drop database pdb")
+        sess.execute("drop table c")
+        sess.execute("drop database pdb")
+
+    def test_persist_roundtrip(self, fk, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        fk.execute("insert into child values (100, 1)")
+        save_catalog(fk.catalog, str(tmp_path))
+        s2 = Session(load_catalog(str(tmp_path)))
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            s2.execute("insert into child values (101, 99)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            s2.execute("delete from parent where id = 1")
+
+    def test_show_create_table_lists_constraints(self, fk):
+        out = fk.execute("show create table child").rows[0][1]
+        assert "foreign key (pid) references test.parent (id)" in out
+
+
+class TestSavepoint:
+    def test_rollback_to(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("begin")
+        sess.execute("insert into t values (1)")
+        sess.execute("savepoint s1")
+        sess.execute("insert into t values (2)")
+        assert sess.execute("select count(*) from t").rows == [(2,)]
+        sess.execute("rollback to savepoint s1")
+        assert sess.execute("select count(*) from t").rows == [(1,)]
+        sess.execute("commit")
+        assert sess.execute("select a from t").rows == [(1,)]
+
+    def test_nested_savepoints(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("begin")
+        sess.execute("savepoint s1")
+        sess.execute("insert into t values (1)")
+        sess.execute("savepoint s2")
+        sess.execute("insert into t values (2)")
+        sess.execute("rollback to s1")  # destroys s2 as well
+        assert sess.execute("select count(*) from t").rows == [(0,)]
+        with pytest.raises(ValueError, match="does not exist"):
+            sess.execute("rollback to s2")
+        sess.execute("rollback")
+
+    def test_savepoint_before_first_write(self, sess):
+        # table first touched AFTER the savepoint: rollback forgets the
+        # shadow entirely
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (7)")
+        sess.execute("begin")
+        sess.execute("savepoint s1")
+        sess.execute("delete from t")
+        sess.execute("rollback to s1")
+        assert sess.execute("select a from t").rows == [(7,)]
+        sess.execute("commit")
+
+    def test_release(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("begin")
+        sess.execute("savepoint s1")
+        sess.execute("insert into t values (1)")
+        sess.execute("release savepoint s1")
+        with pytest.raises(ValueError, match="does not exist"):
+            sess.execute("rollback to s1")
+        sess.execute("commit")
+        assert sess.execute("select count(*) from t").rows == [(1,)]
+
+    def test_unknown_savepoint(self, sess):
+        sess.execute("begin")
+        with pytest.raises(ValueError, match="does not exist"):
+            sess.execute("rollback to nope")
+        sess.execute("rollback")
+
+    def test_savepoint_outside_txn_noop(self, sess):
+        sess.execute("savepoint sx")  # MySQL: silent no-op in autocommit
+
+    def test_rollback_to_keeps_conflict_baseline(self, sess):
+        # a shadow rebuilt after ROLLBACK TO SAVEPOINT must still
+        # conflict with commits that landed since the txn's first touch
+        sess.execute("create table t (a int)")
+        sess.execute("begin")
+        sess.execute("savepoint s1")
+        sess.execute("insert into t values (1)")
+        other = Session(sess.catalog)
+        other.execute("insert into t values (99)")  # concurrent commit
+        sess.execute("rollback to s1")
+        sess.execute("insert into t values (2)")  # shadow rebuilt
+        with pytest.raises(RuntimeError, match="write conflict"):
+            sess.execute("commit")
+        assert other.execute("select a from t").rows == [(99,)]
+
+    def test_redeclare_moves(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("begin")
+        sess.execute("insert into t values (1)")
+        sess.execute("savepoint s1")
+        sess.execute("insert into t values (2)")
+        sess.execute("savepoint s1")  # moves s1 here
+        sess.execute("insert into t values (3)")
+        sess.execute("rollback to s1")
+        assert sess.execute("select count(*) from t").rows == [(2,)]
+        sess.execute("rollback")

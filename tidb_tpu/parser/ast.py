@@ -190,6 +190,10 @@ class CreateTable:
     ttl: Optional[tuple] = None
     # CREATE TABLE ... AS SELECT: source query (columns derived)
     as_query: Optional[object] = None
+    # CHECK constraints: (name, expression SQL text, parsed expression)
+    checks: List[tuple] = dataclasses.field(default_factory=list)
+    # FOREIGN KEYs: (name, column, ref_db-or-None, ref_table, ref_column)
+    fks: List[tuple] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -360,7 +364,8 @@ class Trace:
 
 @dataclasses.dataclass
 class TxnControl:
-    op: str  # begin | commit | rollback
+    op: str  # begin | commit | rollback | savepoint | rollback_to | release
+    name: Optional[str] = None  # savepoint name for the last three
 
 
 @dataclasses.dataclass

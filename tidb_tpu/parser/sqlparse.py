@@ -325,7 +325,20 @@ class Parser:
             return ast.TxnControl("commit")
         if self.at_kw("rollback"):
             self.advance()
+            if self.accept_kw("to"):
+                if self._at_ident("savepoint"):
+                    self.advance()
+                return ast.TxnControl("rollback_to", self.expect_ident())
             return ast.TxnControl("rollback")
+        if self._at_ident("savepoint"):
+            self.advance()
+            return ast.TxnControl("savepoint", self.expect_ident())
+        if self._at_ident("release"):
+            self.advance()
+            if not self._at_ident("savepoint"):
+                raise ParseError("expected SAVEPOINT after RELEASE")
+            self.advance()
+            return ast.TxnControl("release", self.expect_ident())
         if self.at_kw("analyze"):
             self.advance()
             self.expect_kw("table")
@@ -1379,8 +1392,63 @@ class Parser:
         cols: List[ast.ColumnDef] = []
         pk: List[str] = []
         indexes: List[tuple] = []
+        checks: List[tuple] = []
+        fks: List[tuple] = []
+
+        def _parse_check(cname):
+            self.expect_op("(")
+            start = self.cur.pos
+            expr = self.parse_expr()
+            end = self.cur.pos
+            self.expect_op(")")
+            nm = cname or f"chk_{len(checks) + 1}"
+            checks.append((nm, self.sql[start:end].strip(), expr))
+
+        def _parse_fk(cname):
+            # FOREIGN KEY (col) REFERENCES tbl (col)
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            if not self._at_ident("references"):
+                raise ParseError("expected REFERENCES in FOREIGN KEY")
+            self.advance()
+            rdb, rtbl = self._qualified_name()
+            self.expect_op("(")
+            rcol = self.expect_ident()
+            self.expect_op(")")
+            nm = cname or f"fk_{len(fks) + 1}"
+            fks.append((nm, col, rdb, rtbl, rcol))
+
         while True:
-            if self.accept_kw("primary"):
+            if self._at_ident("constraint"):
+                self.advance()
+                cname = (
+                    self.expect_ident()
+                    if self.cur.kind == "id"
+                    and self.cur.text.lower() not in ("check", "foreign")
+                    else None
+                )
+                if self._at_ident("check"):
+                    self.advance()
+                    _parse_check(cname)
+                elif self._at_ident("foreign"):
+                    self.advance()
+                    self.expect_kw("key")
+                    _parse_fk(cname)
+                else:
+                    raise ParseError(
+                        "CONSTRAINT supports CHECK | FOREIGN KEY"
+                    )
+            elif self._at_ident("check") and self.toks[self.i + 1].text == "(":
+                self.advance()
+                _parse_check(None)
+            elif self._at_ident("foreign") and (
+                self.toks[self.i + 1].text.lower() == "key"
+            ):
+                self.advance()
+                self.expect_kw("key")
+                _parse_fk(None)
+            elif self.accept_kw("primary"):
                 self.expect_kw("key")
                 self.expect_op("(")
                 pk.append(self.expect_ident())
@@ -1439,6 +1507,19 @@ class Parser:
                         if not isinstance(d, ast.Const):
                             raise ParseError("DEFAULT must be a constant")
                         cd.default = d.value
+                    elif self._at_ident("check"):
+                        self.advance()
+                        _parse_check(None)
+                    elif self._at_ident("references"):
+                        # column-level FK shorthand
+                        self.advance()
+                        rdb, rtbl = self._qualified_name()
+                        self.expect_op("(")
+                        rcol = self.expect_ident()
+                        self.expect_op(")")
+                        fks.append(
+                            (f"fk_{len(fks) + 1}", cname, rdb, rtbl, rcol)
+                        )
                     else:
                         break
                 cols.append(cd)
@@ -1465,7 +1546,8 @@ class Parser:
             else:
                 break
         return ast.CreateTable(
-            db, name, cols, pk, ine, indexes=indexes, ttl=ttl
+            db, name, cols, pk, ine, indexes=indexes, ttl=ttl,
+            checks=checks, fks=fks,
         )
 
     def parse_alter(self):

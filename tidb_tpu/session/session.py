@@ -103,14 +103,26 @@ class Session:
         if shadow is None:
             from tidb_tpu.storage.table import Table
 
-            pinned = self._txn["pins"].setdefault(key, t.version)
+            pinned = self._txn["pins"].get(key)
+            if pinned is None:
+                pinned = self._txn["pins"][key] = t.version
+                t.pin(t.version)  # survive GC until commit/rollback
+                self._txn.setdefault("pin_objs", []).append((t, t.version))
             shadow = Table(t.name, t.schema)
             shadow._versions = {0: list(t.blocks(pinned))}
             shadow.dictionaries = dict(t.dictionaries)
             shadow.indexes = dict(t.indexes)
             shadow.unique_indexes = set(t.unique_indexes)
+            shadow.autoinc_col = t.autoinc_col
+            shadow.autoinc_next = t.autoinc_next
+            shadow.checks = list(t.checks)
+            shadow.fks = list(t.fks)
             self._txn["shadows"][key] = shadow
-            self._txn["base_versions"][key] = t.version
+            # conflict baseline = version at FIRST touch in this txn —
+            # a shadow rebuilt after ROLLBACK TO SAVEPOINT must not
+            # adopt a newer version (it would mask concurrent commits
+            # and overwrite them at commit time)
+            self._txn["base_versions"].setdefault(key, pinned)
         return shadow
 
     def _run_txn_control(self, s) -> Result:
@@ -120,7 +132,10 @@ class Session:
             failpoint.inject("session/begin-txn")
             if self._txn is not None:
                 self._commit_txn()  # MySQL: BEGIN implicitly commits
-            self._txn = {"pins": {}, "shadows": {}, "base_versions": {}}
+            self._txn = {
+                "pins": {}, "shadows": {}, "base_versions": {},
+                "savepoints": [],
+            }
         elif s.op == "commit":
             self._commit_txn()
         elif s.op == "rollback":
@@ -128,7 +143,67 @@ class Session:
             if txn:
                 for t, v in txn.get("pin_objs", []):
                     t.unpin(v)
+        elif s.op == "savepoint":
+            # outside a transaction this is a no-op, like MySQL under
+            # autocommit (reference: pkg/session savepoint handling,
+            # pkg/sessionctx/sessionstates)
+            if self._txn is not None:
+                sps = self._txn.setdefault("savepoints", [])
+                name = s.name.lower()
+                # re-declaring a name moves it (MySQL: old one deleted)
+                sps[:] = [x for x in sps if x[0] != name]
+                sps.append((name, self._txn_snapshot()))
+        elif s.op == "rollback_to":
+            self._rollback_to_savepoint(s.name.lower())
+        elif s.op == "release":
+            if self._txn is not None:
+                sps = self._txn.get("savepoints", [])
+                idx = [i for i, (n, _) in enumerate(sps) if n == s.name.lower()]
+                if not idx:
+                    raise ValueError(f"SAVEPOINT {s.name} does not exist")
+                # TiDB semantics: deletes the named savepoint and every
+                # later one; the transaction state is untouched
+                del sps[idx[0]:]
         return Result([], [])
+
+    def _txn_snapshot(self) -> dict:
+        """Per-shadow restore state for a savepoint: block lists are
+        immutable, so capturing them is O(#tables)."""
+        return {
+            key: (
+                list(shadow.blocks()),
+                shadow.modify_count,
+                dict(shadow.dictionaries),
+                shadow.autoinc_next,
+            )
+            for key, shadow in self._txn["shadows"].items()
+        }
+
+    def _rollback_to_savepoint(self, name: str) -> None:
+        if self._txn is None:
+            raise ValueError(f"SAVEPOINT {name} does not exist")
+        sps = self._txn.get("savepoints", [])
+        idx = [i for i, (n, _) in enumerate(sps) if n == name]
+        if not idx:
+            raise ValueError(f"SAVEPOINT {name} does not exist")
+        _, snap = sps[idx[0]]
+        # the named savepoint survives; later ones are destroyed (MySQL)
+        del sps[idx[0] + 1:]
+        for key in list(self._txn["shadows"]):
+            if key not in snap:
+                # table first touched after the savepoint: forget the
+                # shadow (reads fall back to the pinned base). pins and
+                # base_versions survive — a rebuilt shadow must keep the
+                # original snapshot AND conflict baseline
+                del self._txn["shadows"][key]
+                continue
+            shadow = self._txn["shadows"][key]
+            blocks, modify, dicts, autoinc = snap[key]
+            shadow.replace_blocks(blocks)
+            shadow.modify_count = modify
+            shadow.dictionaries = dict(dicts)
+            shadow.autoinc_next = autoinc
+        clear_scan_cache()
 
     def _commit_txn(self) -> None:
         from tidb_tpu.utils import failpoint
@@ -156,6 +231,9 @@ class Session:
                     shadow.blocks(), modified_rows=shadow.modify_count
                 )
                 base.dictionaries = shadow.dictionaries
+                base.autoinc_next = max(
+                    base.autoinc_next, shadow.autoinc_next
+                )
             if txn["shadows"]:
                 clear_scan_cache()
         finally:
@@ -487,6 +565,35 @@ class Session:
             auto = [c for c in s.columns if c.auto_increment]
             if auto and (len(auto) > 1 or auto[0].type.kind != Kind.INT):
                 raise ValueError("one integer AUTO_INCREMENT column per table")
+            colnames = {c.name.lower() for c in s.columns}
+            for nm, _txt, expr in s.checks:
+                from tidb_tpu.utils.checkeval import check_columns
+
+                missing = check_columns(expr) - colnames
+                if missing:
+                    raise ValueError(
+                        f"CHECK {nm!r} references unknown columns "
+                        f"{sorted(missing)}"
+                    )
+            fks_resolved = []
+            for nm, col, rdb, rtbl, rcol in s.fks:
+                rdb = (rdb or s.db or self.db).lower()
+                rtbl, rcol, col = rtbl.lower(), rcol.lower(), col.lower()
+                if col not in colnames:
+                    raise ValueError(f"FOREIGN KEY column {col!r} unknown")
+                if rdb == (s.db or self.db).lower() and rtbl == s.name.lower():
+                    if rcol not in colnames:
+                        raise ValueError(
+                            f"FOREIGN KEY references unknown column {rcol!r}"
+                        )
+                else:
+                    pt = self.catalog.table(rdb, rtbl)  # raises if missing
+                    if rcol not in pt.schema.names:
+                        raise ValueError(
+                            f"FOREIGN KEY references unknown column "
+                            f"{rdb}.{rtbl}.{rcol}"
+                        )
+                fks_resolved.append((nm, col, rdb, rtbl, rcol))
             ttl_opt = None
             if s.ttl is not None:
                 tcol, iv, unit = s.ttl
@@ -513,6 +620,8 @@ class Session:
                 if auto:
                     t.autoinc_col = auto[0].name.lower()
                 t.ttl = ttl_opt
+                t.checks = [(nm, txt) for nm, txt, _e in s.checks]
+                t.fks = fks_resolved
                 t.defaults = {
                     c.name.lower(): c.default
                     for c in s.columns
@@ -586,6 +695,28 @@ class Session:
                     )
                 t.alter_add_column(s.column.name, s.column.type, default)
             else:
+                cn = s.col_name.lower()
+                from tidb_tpu.utils.checkeval import check_columns
+
+                for nm, ex in self._check_exprs_for(t):
+                    if cn in check_columns(ex):
+                        raise ValueError(
+                            f"cannot drop column {cn!r}: used by CHECK {nm!r}"
+                        )
+                for nm, col, rdb, rtbl, rcol in t.fks:
+                    if cn == col:
+                        raise ValueError(
+                            f"cannot drop column {cn!r}: used by "
+                            f"FOREIGN KEY {nm!r}"
+                        )
+                for cdb, ctn, nm, _c, rcol in self._fk_children(
+                    s.db or self.db, s.name
+                ):
+                    if cn == rcol:
+                        raise ValueError(
+                            f"cannot drop column {cn!r}: referenced by "
+                            f"FOREIGN KEY {nm!r} on {cdb}.{ctn}"
+                        )
                 t.alter_drop_column(s.col_name)
             self.catalog.schema_version += 1
             clear_scan_cache()
@@ -833,7 +964,30 @@ class Session:
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         from tidb_tpu.storage.loader import load_file
 
+        constrained = bool(t.checks or t.fks)
+        saved = list(t.blocks()) if constrained else None
         n = load_file(t, s.path, sep=s.sep)
+        if constrained and n:
+            # the bulk loader appends whole blocks — validate the loaded
+            # region afterwards and roll the append back on violation
+            names = t.schema.names
+            loaded = []
+            seen = 0
+            for b in t.blocks():
+                if seen + b.nrows <= sum(x.nrows for x in saved):
+                    seen += b.nrows
+                    continue
+                dec = [b.columns[c].decode() for c in names]
+                ok = [b.columns[c].valid for c in names]
+                for i in range(b.nrows):
+                    loaded.append(
+                        [d[i] if o[i] else None for d, o in zip(dec, ok)]
+                    )
+            try:
+                self._enforce_write_constraints(t, s.db or self.db, loaded)
+            except Exception:
+                t.replace_blocks(saved, modified_rows=n)
+                raise
         clear_scan_cache()
         return Result([], [], affected=n)
 
@@ -1171,6 +1325,104 @@ class Session:
             self.executor.stream_rows = old_stream
 
     # ------------------------------------------------------------------
+    # -- CHECK / FOREIGN KEY enforcement -------------------------------
+    def _check_exprs_for(self, t):
+        exprs = getattr(t, "_check_exprs", None)
+        if exprs is None or len(exprs) != len(t.checks):
+            from tidb_tpu.parser.sqlparse import parse_expr
+
+            exprs = t._check_exprs = [
+                (nm, parse_expr(txt)) for nm, txt in t.checks
+            ]
+        return exprs
+
+    def _column_values(self, db: str, name: str, col: str) -> set:
+        """All non-NULL values of a column at this session's read
+        snapshot (host decode — constraint batches are small)."""
+        t, version = self._resolve_table_for_read(db, name)
+        out = set()
+        for b in t.blocks(version):
+            c = b.columns[col]
+            dec = c.decode()
+            for ok, v in zip(c.valid, dec):
+                if ok:
+                    out.add(v)
+        return out
+
+    def _enforce_write_constraints(self, t, db: str, rows) -> None:
+        """CHECK + child-side FOREIGN KEY validation over fully-formed
+        Python rows, BEFORE they are encoded/appended (reference:
+        pkg/table/tables.go CheckRowConstraint + FK existence checks in
+        the executor's write path). A CHECK passes on TRUE/UNKNOWN and
+        fails only on FALSE, per SQL."""
+        names = t.schema.names
+        if t.checks:
+            from tidb_tpu.utils.checkeval import _truth, eval_check
+
+            for nm, ex in self._check_exprs_for(t):
+                for r in rows:
+                    if _truth(eval_check(ex, dict(zip(names, r)))) is False:
+                        raise ValueError(
+                            f"CHECK constraint {nm!r} violated"
+                        )
+        for nm, col, rdb, rtbl, rcol in t.fks:
+            i = names.index(col)
+            vals = {r[i] for r in rows if r[i] is not None}
+            if not vals:
+                continue
+            parent = self._column_values(rdb, rtbl, rcol)
+            if rdb == db.lower() and rtbl == t.name:
+                # self-referential FK: keys arriving in this same batch
+                # are valid targets (MySQL checks post-statement state)
+                j = names.index(rcol)
+                parent |= {r[j] for r in rows if r[j] is not None}
+            missing = vals - parent
+            if missing:
+                raise ValueError(
+                    f"FOREIGN KEY {nm!r} violated: "
+                    f"{sorted(missing)[:3]!r} not in {rdb}.{rtbl}.{rcol}"
+                )
+
+    def _fk_children(self, db: str, name: str):
+        """[(child_db, child_table, fk_name, fk_col, ref_col)] of every
+        FK in the catalog referencing db.name. The reverse map is cached
+        on the catalog per schema version — point DML must not pay an
+        all-tables walk just to learn there are no FKs."""
+        cat = self.catalog
+        cache = getattr(cat, "_fk_child_cache", None)
+        if cache is None or cache[0] != cat.schema_version:
+            rev: dict = {}
+            for d in cat.databases():
+                for tn in cat.tables(d):
+                    t2 = cat.table(d, tn)
+                    for nm, col, rdb, rtbl, rcol in getattr(t2, "fks", ()):
+                        rev.setdefault((rdb, rtbl), []).append(
+                            (d, tn, nm, col, rcol)
+                        )
+            cache = cat._fk_child_cache = (cat.schema_version, rev)
+        return cache[1].get((db.lower(), name.lower()), [])
+
+    def _enforce_parent_constraints(
+        self, db: str, name: str, remaining: dict
+    ) -> None:
+        """RESTRICT semantics for deletes/updates on an FK parent:
+        every child reference must still resolve against the parent's
+        post-statement values (``remaining``: ref_col -> value set)."""
+        for cdb, ctn, nm, col, rcol in self._fk_children(db, name):
+            if rcol not in remaining:
+                continue
+            child_vals = self._column_values(cdb, ctn, col)
+            if cdb == db.lower() and ctn == name.lower():
+                # self-FK: the child side shrinks with the parent — the
+                # caller's remaining set for the fk column is the truth
+                child_vals = remaining.get(col, child_vals)
+            dangling = child_vals - remaining[rcol]
+            if dangling:
+                raise ValueError(
+                    f"FOREIGN KEY {nm!r} on {cdb}.{ctn} restricts this "
+                    f"statement: {sorted(dangling)[:3]!r} still referenced"
+                )
+
     def _run_insert(self, s: ast.Insert) -> Result:
         from tidb_tpu.utils.failpoint import inject
 
@@ -1204,8 +1456,6 @@ class Session:
             rows.append(
                 [vals[n] if n in vals else dflt.get(n) for n in names]
             )
-        if getattr(s, "replace", False):
-            self._replace_conflicts(t, names, rows)
         ac = t.autoinc_col
         if ac is not None:
             ai = names.index(ac)
@@ -1218,7 +1468,45 @@ class Session:
                 for k, r in enumerate(missing):
                     r[ai] = start + k
                 self.last_insert_id = start
+        # constraints run over the final values (after autoinc fill) and
+        # BEFORE the REPLACE delete — a failing row must not leave the
+        # statement half-applied
+        self._enforce_write_constraints(t, s.db or self.db, rows)
+        replace = getattr(s, "replace", False)
+        children = (
+            self._fk_children(s.db or self.db, s.table) if replace else []
+        )
+        saved = (list(t.blocks()), dict(t.dictionaries)) if children else None
+        if replace:
+            self._replace_conflicts(t, names, rows)
         t.append_rows(rows)
+        if children:
+            # REPLACE deletes conflicting rows: the parent value set may
+            # have shrunk — enforce RESTRICT on the post-statement state
+            # and roll the whole statement back on violation
+            need = {rc for _, _, _, _, rc in children}
+            need |= {
+                c for cd, ct, _, c, _ in children
+                if cd == (s.db or self.db).lower() and ct == t.name
+            }
+            remaining = {}
+            for col in need:
+                vals = set()
+                for b in t.blocks():
+                    c = b.columns[col]
+                    dec = c.decode()
+                    for ok, v in zip(c.valid, dec):
+                        if ok:
+                            vals.add(v)
+                remaining[col] = vals
+            try:
+                self._enforce_parent_constraints(
+                    s.db or self.db, s.table, remaining
+                )
+            except Exception:
+                t.replace_blocks(saved[0], modified_rows=len(rows))
+                t.dictionaries = saved[1]
+                raise
         clear_scan_cache()
         return Result([], [], affected=len(rows))
 
@@ -1304,14 +1592,40 @@ class Session:
         from tidb_tpu.utils.failpoint import inject
 
         inject("dml/delete")
-        t = self._resolve_table_for_write(s.db or self.db, s.table)
+        db = s.db or self.db
+        t = self._resolve_table_for_write(db, s.table)
+        children = self._fk_children(db, s.table)
         blocks = t.blocks()
         if s.where is None:
+            if children:
+                self._enforce_parent_constraints(
+                    db, s.table,
+                    {c: set() for c in t.schema.names},
+                )
             affected = t.nrows
             t.replace_blocks([], modified_rows=affected)
             clear_scan_cache()
             return Result([], [], affected=affected)
         masks, affected = self._eval_where_per_block(t, s.where)
+        if children and affected:
+            # post-delete values for every column a child references
+            # (and, for self-FKs, the child column itself)
+            need = {rc for _, _, _, _, rc in children}
+            need |= {
+                c for cd, ct, _, c, _ in children
+                if cd == db.lower() and ct == t.name
+            }
+            remaining = {}
+            for col in need:
+                vals = set()
+                for b, m in zip(blocks, masks):
+                    c = b.columns[col]
+                    dec = c.decode()
+                    for ok, dead, v in zip(c.valid, m, dec):
+                        if ok and not dead:
+                            vals.add(v)
+                remaining[col] = vals
+            self._enforce_parent_constraints(db, s.table, remaining)
         t.delete_where([~m for m in masks])
         clear_scan_cache()
         return Result([], [], affected=affected)
@@ -1357,6 +1671,27 @@ class Session:
             sel = dataclasses.replace(sel, items=new_items)
         r = self._run_select(sel)
         rows = [list(row) for row in r.rows]
+        db = s.db or self.db
+        # ``rows`` is the table's complete post-statement image: child
+        # FK + CHECK validate the new rows, parent-side RESTRICT
+        # validates children against the new value sets
+        self._enforce_write_constraints(t, db, rows)
+        children = self._fk_children(db, s.table)
+        if children:
+            names = t.schema.names
+            need = {rc for _, _, _, _, rc in children}
+            need |= {
+                c for cd, ct, _, c, _ in children
+                if cd == db.lower() and ct == t.name
+            }
+            remaining = {
+                col: {
+                    row[names.index(col)] for row in rows
+                    if row[names.index(col)] is not None
+                }
+                for col in need
+            }
+            self._enforce_parent_constraints(db, s.table, remaining)
         # count affected
         if s.where is None:
             affected = len(rows)
@@ -1381,6 +1716,22 @@ class Session:
         ):
             return None
         if s.where is None or not t.blocks():
+            return None
+        relevant: set = set()
+        if t.checks:
+            from tidb_tpu.utils.checkeval import check_columns
+
+            for _nm, ex in self._check_exprs_for(t):
+                relevant |= check_columns(ex)
+        relevant |= {col for _nm, col, *_ in t.fks}
+        relevant |= {
+            rc for _, _, _, _, rc in
+            self._fk_children(s.db or self.db, s.table)
+        }
+        if relevant & set(sets):
+            # a constrained column is being SET: constraint checks need
+            # fully-formed rows — use the rewrite path, which
+            # materializes them anyway
             return None
         try:
             masks, affected = self._eval_where_per_block(t, s.where)

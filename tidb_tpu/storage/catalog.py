@@ -46,9 +46,23 @@ class Catalog:
             self.schema_version += 1
 
     def drop_database(self, name: str) -> None:
+        name = name.lower()
         with self._lock:
-            self._dbs.pop(name.lower(), None)
-            self._views.pop(name.lower(), None)
+            # an OUTSIDE child referencing any table in this db blocks
+            # the drop (children inside the db vanish with it)
+            for d2, tabs in self._dbs.items():
+                if d2 == name:
+                    continue
+                for tn2, t2 in tabs.items():
+                    for nm, _c, rdb, rtbl, _rc in getattr(t2, "fks", ()):
+                        if rdb == name and rtbl in self._dbs.get(name, {}):
+                            raise ValueError(
+                                f"cannot drop database {name}: {name}.{rtbl} "
+                                f"is referenced by FOREIGN KEY {nm!r} on "
+                                f"{d2}.{tn2}"
+                            )
+            self._dbs.pop(name, None)
+            self._views.pop(name, None)
             self.schema_version += 1
 
     def create_table(
@@ -80,6 +94,16 @@ class Catalog:
                 if if_exists:
                     return
                 raise ValueError(f"unknown table {db}.{name}")
+            for d2, tabs in self._dbs.items():
+                for tn2, t2 in tabs.items():
+                    if d2 == db and tn2 == name:
+                        continue  # self-referential FK never blocks
+                    for nm, _col, rdb, rtbl, _rc in getattr(t2, "fks", ()):
+                        if rdb == db and rtbl == name:
+                            raise ValueError(
+                                f"cannot drop {db}.{name}: referenced by "
+                                f"FOREIGN KEY {nm!r} on {d2}.{tn2}"
+                            )
             del self._dbs[db][name]
             self.schema_version += 1
 

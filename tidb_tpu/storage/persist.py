@@ -90,6 +90,8 @@ def save_catalog(
                 "unique_indexes": sorted(t.unique_indexes),
                 "autoinc": [t.autoinc_col, t.autoinc_next],
                 "ttl": list(t.ttl) if t.ttl else None,
+                "checks": [list(c) for c in t.checks] or None,
+                "fks": [list(f) for f in t.fks] or None,
                 "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
                 "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
                 "json_cols": list(t.schema.json_cols),
@@ -159,6 +161,8 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
                 t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])
             if meta.get("ttl"):
                 t.ttl = tuple(meta["ttl"])
+            t.checks = [tuple(c) for c in (meta.get("checks") or [])]
+            t.fks = [tuple(f) for f in (meta.get("fks") or [])]
             data = np.load(
                 os.path.join(path, f"{db}.{name}.npz"), allow_pickle=True
             )

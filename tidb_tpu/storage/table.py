@@ -120,6 +120,14 @@ class Table:
         self.autoinc_next = 1
         # TTL option (col, interval value, unit) — pkg/ttl analog
         self.ttl: Optional[tuple] = None
+        # CHECK constraints [(name, expr SQL text)] — enforced on the
+        # session write path (reference: constraint checks in
+        # pkg/table/tables.go CheckRowConstraint)
+        self.checks: list = []
+        # FOREIGN KEYs [(name, col, ref_db, ref_table, ref_col)] —
+        # RESTRICT-only enforcement on both child and parent writes
+        # (reference: pkg/executor/fktest + pkg/table FK checks)
+        self.fks: list = []
 
     # -- read --------------------------------------------------------------
     def blocks(self, version: Optional[int] = None) -> List[HostBlock]:

@@ -70,6 +70,13 @@ def create_table_sql(t) -> str:
     for iname, cols in sorted(t.indexes.items()):
         kw = "unique index" if iname in t.unique_indexes else "index"
         parts.append(f"{kw} {iname} (" + ", ".join(cols) + ")")
+    for nm, txt in t.checks:
+        parts.append(f"constraint {nm} check ({txt})")
+    for nm, col, rdb, rtbl, rcol in t.fks:
+        parts.append(
+            f"constraint {nm} foreign key ({col}) "
+            f"references {rdb}.{rtbl} ({rcol})"
+        )
     opts = ""
     if t.ttl:
         col, iv, unit = t.ttl

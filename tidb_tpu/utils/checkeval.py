@@ -1,0 +1,150 @@
+"""Host-side 3-valued row evaluator for CHECK constraint expressions.
+
+CHECK constraints run on the WRITE path over small Python row batches
+(INSERT VALUES / UPDATE rewrites), before values are encoded into
+device columns — a jitted kernel would pay a compile per insert shape
+for work that is O(rows) host arithmetic. SQL semantics: a CHECK passes
+when the predicate is TRUE or UNKNOWN (NULL) and fails only on FALSE
+(reference: CHECK enforcement in the write path, pkg/table/tables.go
+CheckRowConstraint + pkg/expression evaluation).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Optional
+
+from tidb_tpu.parser import ast
+
+
+class CheckEvalError(ValueError):
+    """The expression uses a construct CHECK does not allow."""
+
+
+_CMP = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+}
+
+_ARITH = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b if b != 0 else None,  # SQL: x/0 is NULL
+    "mod": lambda a, b: a % b if b != 0 else None,
+}
+
+
+def _truth(v) -> Optional[bool]:
+    """SQL boolean coercion: NULL -> UNKNOWN, 0/0.0/'' -> FALSE."""
+    return None if v is None else bool(v)
+
+
+def eval_check(e, row: dict) -> Optional[bool]:
+    """Evaluate a parsed CHECK expression against one row (column name ->
+    Python value, None = NULL). Returns True/False/None (UNKNOWN)."""
+    if isinstance(e, ast.Const):
+        return e.value
+    if isinstance(e, ast.Name):
+        col = e.column.lower()
+        if col not in row:
+            raise CheckEvalError(f"unknown column {col!r} in CHECK")
+        return row[col]
+    if not isinstance(e, ast.Call):
+        raise CheckEvalError(
+            f"unsupported construct in CHECK: {type(e).__name__}"
+        )
+    op = e.op
+    if op == "and":
+        a, b = (_truth(eval_check(x, row)) for x in e.args)
+        if a is False or b is False:
+            return False
+        if a is None or b is None:
+            return None
+        return True
+    if op == "or":
+        a, b = (_truth(eval_check(x, row)) for x in e.args)
+        if a is True or b is True:
+            return True
+        if a is None or b is None:
+            return None
+        return False
+    if op == "not":
+        v = _truth(eval_check(e.args[0], row))
+        return None if v is None else not v
+    if op == "isnull":
+        return eval_check(e.args[0], row) is None
+    if op == "isnotnull":
+        return eval_check(e.args[0], row) is not None
+    if op == "neg":
+        v = eval_check(e.args[0], row)
+        return None if v is None else -v
+    if op == "in":
+        lhs = eval_check(e.args[0], row)
+        if lhs is None:
+            return None
+        vals = [eval_check(a, row) for a in e.args[1:]]
+        if lhs in [v for v in vals if v is not None]:
+            return True
+        return None if any(v is None for v in vals) else False
+    if op == "like":
+        a, p = (eval_check(x, row) for x in e.args)
+        if a is None or p is None:
+            return None
+        # SQL LIKE -> fnmatch: % -> *, _ -> ?  (escape fnmatch specials)
+        pat = (
+            str(p).replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+            .replace("%", "*").replace("_", "?")
+        )
+        return fnmatch.fnmatchcase(str(a), pat)
+    if op == "coalesce":
+        for a in e.args:
+            v = eval_check(a, row)
+            if v is not None:
+                return v
+        return None
+    if op in _CMP:
+        a, b = (eval_check(x, row) for x in e.args)
+        if a is None or b is None:
+            return None
+        if isinstance(a, bool):
+            a = int(a)
+        if isinstance(b, bool):
+            b = int(b)
+        try:
+            return _CMP[op](a, b)
+        except TypeError:
+            raise CheckEvalError(
+                f"CHECK comparison between incompatible values {a!r}, {b!r}"
+            )
+    if op in _ARITH:
+        a, b = (eval_check(x, row) for x in e.args)
+        if a is None or b is None:
+            return None
+        try:
+            return _ARITH[op](a, b)
+        except TypeError:
+            raise CheckEvalError(
+                f"CHECK arithmetic on incompatible values {a!r}, {b!r}"
+            )
+    raise CheckEvalError(f"unsupported function {op!r} in CHECK")
+
+
+def check_columns(e, out=None) -> set:
+    """Column names referenced by a CHECK expression."""
+    if out is None:
+        out = set()
+    if isinstance(e, ast.Name):
+        out.add(e.column.lower())
+    elif isinstance(e, ast.Call):
+        for a in e.args:
+            check_columns(a, out)
+    elif not isinstance(e, ast.Const):
+        raise CheckEvalError(
+            f"unsupported construct in CHECK: {type(e).__name__}"
+        )
+    return out
