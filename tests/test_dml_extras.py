@@ -1,0 +1,199 @@
+"""TRUNCATE, DESCRIBE / SHOW COLUMNS, INSERT IGNORE, and
+INSERT ... ON DUPLICATE KEY UPDATE.
+
+Reference: TRUNCATE in the DDL layer (pkg/ddl), IGNORE + ON DUPLICATE
+KEY in the insert executor (pkg/executor/insert.go onDuplicateUpdate).
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    return Session()
+
+
+class TestTruncate:
+    def test_truncate_resets_autoinc(self, sess):
+        sess.execute(
+            "create table t (id int primary key auto_increment, v int)"
+        )
+        sess.execute("insert into t (v) values (1), (2)")
+        sess.execute("truncate table t")
+        assert sess.execute("select count(*) from t").rows == [(0,)]
+        sess.execute("insert into t (v) values (9)")
+        assert sess.execute("select id from t").rows == [(1,)]
+
+    def test_truncate_without_table_kw(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        sess.execute("truncate t")
+        assert sess.execute("select count(*) from t").rows == [(0,)]
+
+    def test_truncate_fk_parent_blocked(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute("insert into p values (1)")
+        sess.execute("create table c (x int references p (id))")
+        sess.execute("insert into c values (1)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("truncate table p")
+        sess.execute("truncate table c")
+        sess.execute("truncate table p")
+
+    def test_truncate_requires_drop_priv(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("create user u identified by ''")
+        sess.execute("grant select on test.t to u")
+        s2 = Session(sess.catalog, user="u")
+        with pytest.raises(PermissionError):
+            s2.execute("truncate table t")
+
+
+class TestDescribe:
+    def test_describe(self, sess):
+        sess.execute(
+            "create table t (id int primary key, v int default 5, "
+            "s varchar(10), unique index us (s), index iv (v))"
+        )
+        rows = sess.execute("describe t").rows
+        assert [r[0] for r in rows] == ["id", "v", "s"]
+        by = {r[0]: r for r in rows}
+        assert by["id"][3] == "PRI"
+        assert by["s"][3] == "UNI"
+        assert by["v"][3] == "MUL"
+        assert by["v"][4] == "5"
+        assert sess.execute("desc t").rows == rows
+        assert sess.execute("show columns from t").rows == rows
+
+
+class TestInsertIgnore:
+    def test_ignore_duplicates(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert into t values (1, 10)")
+        r = sess.execute("insert ignore into t values (1, 99), (2, 20)")
+        assert r.affected == 1
+        assert sess.execute("select id, v from t order by id").rows == [
+            (1, 10), (2, 20)
+        ]
+
+    def test_ignore_batch_internal_dup(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert ignore into t values (1, 10), (1, 20)")
+        assert sess.execute("select v from t").rows == [(10,)]
+
+    def test_ignore_check_and_fk(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute("insert into p values (1)")
+        sess.execute(
+            "create table t (a int check (a > 0), pid int references p (id))"
+        )
+        r = sess.execute(
+            "insert ignore into t values (1, 1), (-5, 1), (2, 99)"
+        )
+        assert r.affected == 1
+        assert sess.execute("select a, pid from t").rows == [(1, 1)]
+
+
+class TestIgnoreOnDupInterplay:
+    def test_ignore_with_on_dup_updates(self, sess):
+        # IGNORE must not swallow the update path: dup keys go to
+        # ON DUPLICATE KEY UPDATE, not to the ignore filter
+        sess.execute("create table t (a int primary key, b varchar(10))")
+        sess.execute("insert into t values (1, 'old')")
+        sess.execute(
+            "insert ignore into t values (1, 'new') "
+            "on duplicate key update b = values(b)"
+        )
+        assert sess.execute("select a, b from t").rows == [(1, "new")]
+
+    def test_ignore_self_fk_in_batch(self, sess):
+        sess.execute(
+            "create table emp (id int primary key, mgr int, "
+            "foreign key (mgr) references emp (id))"
+        )
+        r = sess.execute(
+            "insert ignore into emp values (3, null), (4, 3), (5, 99)"
+        )
+        assert r.affected == 2
+        assert sess.execute("select id from emp order by id").rows == [
+            (3,), (4,)
+        ]
+
+    def test_truncate_autoinc_reset_survives_txn(self, sess):
+        sess.execute(
+            "create table t (id int primary key auto_increment, v int)"
+        )
+        sess.execute("insert into t (v) values (1), (2), (3)")
+        sess.execute("begin")
+        sess.execute("truncate table t")
+        sess.execute("commit")
+        sess.execute("insert into t (v) values (9)")
+        assert sess.execute("select id from t").rows == [(1,)]
+
+
+class TestOnDuplicateKeyUpdate:
+    def test_basic_upsert(self, sess):
+        sess.execute("create table t (id int primary key, cnt int)")
+        sess.execute("insert into t values (1, 5)")
+        r = sess.execute(
+            "insert into t values (1, 0), (2, 7) "
+            "on duplicate key update cnt = cnt + 1"
+        )
+        assert r.affected == 3  # 1 insert + 2 for the update
+        assert sess.execute("select id, cnt from t order by id").rows == [
+            (1, 6), (2, 7)
+        ]
+
+    def test_values_function(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert into t values (1, 10)")
+        sess.execute(
+            "insert into t values (1, 42) "
+            "on duplicate key update v = values(v)"
+        )
+        assert sess.execute("select v from t").rows == [(42,)]
+
+    def test_unique_index_conflict(self, sess):
+        sess.execute(
+            "create table t (id int primary key, email varchar(20), "
+            "hits int, unique index ue (email))"
+        )
+        sess.execute("insert into t values (1, 'a@x', 0)")
+        sess.execute(
+            "insert into t values (2, 'a@x', 0) "
+            "on duplicate key update hits = hits + 1"
+        )
+        rows = sess.execute("select id, email, hits from t").rows
+        assert rows == [(1, "a@x", 1)]  # id stays, hits bumped
+
+    def test_batch_internal_chain(self, sess):
+        sess.execute("create table t (id int primary key, n int)")
+        r = sess.execute(
+            "insert into t values (1, 1), (1, 1), (1, 1) "
+            "on duplicate key update n = n + 1"
+        )
+        assert sess.execute("select n from t").rows == [(3,)]
+        assert r.affected == 5  # 1 insert + 2 updates x 2
+
+    def test_unsupported_expr_clear_error(self, sess):
+        sess.execute("create table t (id int primary key, b varchar(10))")
+        sess.execute("insert into t values (1, 'x')")
+        with pytest.raises(ValueError, match="ON DUPLICATE KEY UPDATE"):
+            sess.execute(
+                "insert into t values (1, 'y') "
+                "on duplicate key update b = concat(b, '!')"
+            )
+
+    def test_upsert_respects_check(self, sess):
+        sess.execute(
+            "create table t (id int primary key, v int, check (v < 100))"
+        )
+        sess.execute("insert into t values (1, 99)")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute(
+                "insert into t values (1, 0) "
+                "on duplicate key update v = v + 10"
+            )
+        assert sess.execute("select v from t").rows == [(99,)]

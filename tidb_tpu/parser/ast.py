@@ -302,6 +302,17 @@ class Insert:
     query: Optional[object] = None
     # REPLACE INTO semantics: delete PK/unique-key conflicts first
     replace: bool = False
+    # INSERT IGNORE: skip (don't fail) constraint/duplicate violations
+    ignore: bool = False
+    # ON DUPLICATE KEY UPDATE assignments [(col, expr)]; exprs may use
+    # VALUES(col) for the incoming row's value
+    on_dup: Optional[List[tuple]] = None
+
+
+@dataclasses.dataclass
+class TruncateTable:
+    db: Optional[str]
+    name: str
 
 
 @dataclasses.dataclass

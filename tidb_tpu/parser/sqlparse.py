@@ -82,7 +82,7 @@ _WINDOW_ONLY_FUNCS = {
 
 # keywords that may also appear as function names in expression position
 # (MySQL grammar does the same disambiguation, parser.y sysFuncCall rules)
-_FUNC_KEYWORDS = {"mod", "left", "right", "if", "database", "user"}
+_FUNC_KEYWORDS = {"mod", "left", "right", "if", "database", "user", "values"}
 
 
 class Token:
@@ -238,10 +238,24 @@ class Parser:
         if self.at_kw("use"):
             self.advance()
             return ast.UseDatabase(self.expect_ident())
+        if self._at_ident("truncate"):
+            self.advance()
+            self.accept_kw("table")
+            db, name = self._qualified_name()
+            return ast.TruncateTable(db, name)
+        if self._at_ident("describe") or self.at_kw("desc"):
+            self.advance()
+            db, name = self._qualified_name()
+            return ast.Show("columns", db=f"{db or ''}.{name}")
         if self.at_kw("show"):
             self.advance()
             if self.accept_kw("tables"):
                 return ast.Show("tables")
+            if self._at_ident("columns") or self._at_ident("fields"):
+                self.advance()
+                self.expect_kw("from")
+                db, name = self._qualified_name()
+                return ast.Show("columns", db=f"{db or ''}.{name}")
             if self.accept_kw("databases"):
                 return ast.Show("databases")
             if self.accept_kw("global"):
@@ -1455,16 +1469,20 @@ class Parser:
                 while self.accept_op(","):
                     pk.append(self.expect_ident())
                 self.expect_op(")")
-            elif self.at_kw("index", "key") and (
-                self.toks[self.i + 1].text == "("
+            elif (
+                self.at_kw("index", "key")
+                or (self.at_kw("unique") and self.toks[self.i + 1].text.lower() in ("index", "key"))
+            ) and (
+                self.toks[self.i + (2 if self.at_kw("unique") else 1)].text == "("
                 or (
-                    self.toks[self.i + 1].kind == "id"
-                    and self.toks[self.i + 2].text == "("
+                    self.toks[self.i + (2 if self.at_kw("unique") else 1)].kind == "id"
+                    and self.toks[self.i + (3 if self.at_kw("unique") else 2)].text == "("
                 )
             ):
-                # INDEX/KEY [name] (cols) table element — only when a
-                # '(' follows, so columns NAMED `key`/`index` still parse
-                # as column definitions (`key int` has no paren next)
+                # [UNIQUE] INDEX/KEY [name] (cols) table element — only
+                # when a '(' follows, so columns NAMED `key`/`index`
+                # still parse as column definitions
+                elem_unique = self.accept_kw("unique")
                 self.advance()
                 iname = (
                     self.expect_ident() if self.cur.kind == "id" else None
@@ -1476,9 +1494,9 @@ class Parser:
                 self.expect_op(")")
                 base = iname or f"idx_{'_'.join(icols)}"
                 name_i, n = base, 2
-                while any(name_i == x for x, _ in indexes):
+                while any(name_i == x for x, *_ in indexes):
                     name_i, n = f"{base}_{n}", n + 1
-                indexes.append((name_i, icols))
+                indexes.append((name_i, icols, elem_unique))
             else:
                 cname = self.expect_ident()
                 ctype, tmeta = self.parse_type_full()
@@ -1638,6 +1656,7 @@ class Parser:
     def parse_insert(self, skip_verb: bool = False):
         if not skip_verb:
             self.expect_kw("insert")
+        ignore = self.accept_kw("ignore")
         self.accept_kw("into")
         db, name = self._qualified_name()
         columns = None
@@ -1652,7 +1671,7 @@ class Parser:
                 if self.at_kw("with")
                 else self.parse_select_or_union()
             )
-            return ast.Insert(db, name, columns, [], query=q)
+            return ast.Insert(db, name, columns, [], query=q, ignore=ignore)
         self.expect_kw("values")
         rows = []
         while True:
@@ -1664,7 +1683,23 @@ class Parser:
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return ast.Insert(db, name, columns, rows)
+        on_dup = None
+        if self.accept_kw("on"):
+            if not self._at_ident("duplicate"):
+                raise ParseError("expected DUPLICATE after ON")
+            self.advance()
+            self.expect_kw("key")
+            self.expect_kw("update")
+            on_dup = []
+            while True:
+                col = self.expect_ident()
+                self.expect_op("=")
+                on_dup.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+        return ast.Insert(
+            db, name, columns, rows, ignore=ignore, on_dup=on_dup
+        )
 
     def parse_delete(self):
         self.expect_kw("delete")

@@ -231,9 +231,11 @@ class Session:
                     shadow.blocks(), modified_rows=shadow.modify_count
                 )
                 base.dictionaries = shadow.dictionaries
-                base.autoinc_next = max(
-                    base.autoinc_next, shadow.autoinc_next
-                )
+                # the conflict check above proved the base is unchanged
+                # since first touch, so the shadow's allocator state is
+                # authoritative — direct assign (not max) keeps TRUNCATE's
+                # AUTO_INCREMENT reset effective through COMMIT
+                base.autoinc_next = shadow.autoinc_next
             if txn["shadows"]:
                 clear_scan_cache()
         finally:
@@ -418,6 +420,9 @@ class Session:
                     ):
                         self._check_priv("select", db, tr.name.lower())
         elif isinstance(s, ast.DropTable):
+            self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
+        elif isinstance(s, ast.TruncateTable):
+            # MySQL requires DROP for TRUNCATE (it is DDL)
             self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
         elif isinstance(s, ast.CreateView):
             self._check_priv("create", (s.db or self.db).lower())
@@ -615,8 +620,8 @@ class Session:
                 # IF NOT EXISTS on a pre-existing table is a full no-op:
                 # in-definition indexes must not mutate the live table
                 t = self.catalog.table(s.db or self.db, s.name)
-                for iname, icols in s.indexes:
-                    self._add_index(t, iname, icols, unique=False)
+                for iname, icols, *uq in s.indexes:
+                    self._add_index(t, iname, icols, unique=bool(uq and uq[0]))
                 if auto:
                     t.autoinc_col = auto[0].name.lower()
                 t.ttl = ttl_opt
@@ -682,6 +687,18 @@ class Session:
             r = Result([], [])
         elif isinstance(s, ast.DropView):
             self.catalog.drop_view(s.db or self.db, s.name, s.if_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.TruncateTable):
+            db = s.db or self.db
+            t = self._resolve_table_for_write(db, s.name)
+            children = self._fk_children(db, s.name)
+            if children:
+                self._enforce_parent_constraints(
+                    db, s.name, {c: set() for c in t.schema.names}
+                )
+            t.replace_blocks([], modified_rows=t.nrows)
+            t.autoinc_next = 1  # TRUNCATE resets AUTO_INCREMENT (DDL)
+            clear_scan_cache()
             r = Result([], [])
         elif isinstance(s, ast.AlterTable):
             failpoint.inject("ddl/alter-table")
@@ -887,6 +904,33 @@ class Session:
             return Result(
                 [f"Grants for {user}@%"],
                 [(g,) for g in self.catalog.users.show_grants(user)],
+            )
+        if s.what == "columns":
+            db, name = s.db.split(".", 1)
+            db = db or self.db
+            t = self.catalog.table(db, name)
+            pk = set(t.schema.primary_key or [])
+            uni = {
+                t.indexes[i][0] for i in t.unique_indexes if t.indexes.get(i)
+            }
+            mul = {
+                cols[0] for i, cols in t.indexes.items()
+                if cols and i not in t.unique_indexes
+            }
+            dflt = getattr(t, "defaults", None) or {}
+            rows = [
+                (
+                    n,
+                    repr(ty).lower(),
+                    "NO" if n in pk else "YES",  # PKs are implicitly NOT NULL
+                    "PRI" if n in pk else
+                    "UNI" if n in uni else "MUL" if n in mul else "",
+                    None if dflt.get(n) is None else str(dflt[n]),
+                )
+                for n, ty in t.schema.columns
+            ]
+            return Result(
+                ["Field", "Type", "Null", "Key", "Default"], rows
             )
         if s.what in ("create_table", "create_view"):
             db, name = s.db.split(".", 1)
@@ -1423,6 +1467,244 @@ class Session:
                     f"statement: {sorted(dangling)[:3]!r} still referenced"
                 )
 
+    def _unique_key_cols(self, t):
+        """Single-column conflict keys: PK (when single) + single-column
+        UNIQUE indexes — the same key set REPLACE INTO uses."""
+        out = []
+        pk = t.schema.primary_key
+        if pk and len(pk) == 1:
+            out.append(pk[0])
+        for iname in sorted(t.unique_indexes):
+            c = t.indexes.get(iname)
+            if c and c[0] not in out:
+                out.append(c[0])
+        return out
+
+    def _filter_ignore(self, t, db: str, names, rows, skip_unique=False):
+        """INSERT IGNORE: drop (instead of fail) rows that violate a
+        CHECK, a FOREIGN KEY, or duplicate a PK/UNIQUE key against
+        existing data or earlier rows of the same statement (reference:
+        IGNORE handling in the insert executor, pkg/executor/insert.go).
+        skip_unique: ON DUPLICATE KEY UPDATE already resolved key
+        conflicts — filtering them again would drop the updated rows."""
+        from tidb_tpu.utils.checkeval import _truth, eval_check
+
+        checks = self._check_exprs_for(t) if t.checks else []
+        fk_parents = []
+        for _nm, col, rdb, rtbl, rcol in t.fks:
+            parent = self._column_values(rdb, rtbl, rcol)
+            self_fk = rdb == db.lower() and rtbl == t.name
+            fk_parents.append(
+                (names.index(col), parent,
+                 names.index(rcol) if self_fk else None)
+            )
+        key_state = (
+            []
+            if skip_unique
+            else [
+                (names.index(kc), self._column_values(db, t.name, kc), set())
+                for kc in self._unique_key_cols(t)
+            ]
+        )
+        kept = []
+        for r in rows:
+            rowd = dict(zip(names, r))
+            if any(
+                _truth(eval_check(ex, rowd)) is False for _nm, ex in checks
+            ):
+                continue
+            if any(
+                r[i] is not None and r[i] not in parent
+                for i, parent, _ri in fk_parents
+            ):
+                continue
+            dup = False
+            for i, existing, seen in key_state:
+                v = r[i]
+                if v is not None and (v in existing or v in seen):
+                    dup = True
+                    break
+            if dup:
+                continue
+            for i, _existing, seen in key_state:
+                if r[i] is not None:
+                    seen.add(r[i])
+            for _i, parent, ri in fk_parents:
+                # self-FK: a KEPT row's key becomes a valid parent for
+                # later rows of this same statement (mirrors the strict
+                # path's in-batch semantics)
+                if ri is not None and r[ri] is not None:
+                    parent.add(r[ri])
+            kept.append(r)
+        return kept
+
+    @staticmethod
+    def _eval_on_dup(assigns, names, old, incoming):
+        """One ON DUPLICATE KEY UPDATE application: evaluate assignment
+        expressions against the existing row, with VALUES(col) denoting
+        the incoming row's value. Later assignments see earlier results
+        (MySQL's left-to-right semantics)."""
+        from tidb_tpu.utils.checkeval import eval_check
+
+        def subst(e):
+            if (
+                isinstance(e, ast.Call)
+                and e.op == "values"
+                and len(e.args) == 1
+                and isinstance(e.args[0], ast.Name)
+            ):
+                return ast.Const(
+                    incoming[names.index(e.args[0].column.lower())]
+                )
+            if isinstance(e, ast.Call):
+                return dataclasses.replace(
+                    e, args=[subst(a) for a in e.args]
+                )
+            return e
+
+        from tidb_tpu.utils.checkeval import CheckEvalError
+
+        new = list(old)
+        env = dict(zip(names, old))
+        for c, e in assigns:
+            try:
+                v = eval_check(subst(e), env)
+            except CheckEvalError as err:
+                raise ValueError(
+                    "ON DUPLICATE KEY UPDATE supports literals, columns, "
+                    f"VALUES(col), arithmetic and comparisons: {err}"
+                ) from None
+            new[names.index(c)] = v
+            env[c] = v
+        return new
+
+    def _apply_on_dup(self, t, db: str, names, rows, assigns):
+        """Resolve INSERT ... ON DUPLICATE KEY UPDATE into (pending rows
+        to append, existing-row keys to delete, update count). Existing
+        conflicting rows are fetched, updated, re-appended; statement-
+        internal duplicates update the pending row in place (reference:
+        pkg/executor/insert.go onDuplicateUpdate)."""
+        key_cols = self._unique_key_cols(t)
+        assigns = [(c.lower(), e) for c, e in assigns]
+        for c, _e in assigns:
+            if c not in names:
+                raise ValueError(f"unknown column {c!r} in ON DUPLICATE KEY")
+        if not key_cols:
+            return list(rows), {}, 0
+        ki = {kc: names.index(kc) for kc in key_cols}
+        incoming_keys = {
+            kc: {r[ki[kc]] for r in rows if r[ki[kc]] is not None}
+            for kc in key_cols
+        }
+        # fetch existing rows that conflict with any incoming key —
+        # key columns are scanned first so non-matching blocks skip the
+        # full-row decode entirely
+        fetched = []
+        existing = {kc: {} for kc in key_cols}
+        for b in t.blocks():
+            kdec = {kc: b.columns[kc].decode() for kc in key_cols}
+            kok = {kc: b.columns[kc].valid for kc in key_cols}
+            hits = [
+                i
+                for i in range(b.nrows)
+                if any(
+                    kok[kc][i] and kdec[kc][i] in incoming_keys[kc]
+                    for kc in key_cols
+                )
+            ]
+            if not hits:
+                continue
+            dec = {c: b.columns[c].decode() for c in names}
+            ok = {c: b.columns[c].valid for c in names}
+            for i in hits:
+                rowv = [dec[c][i] if ok[c][i] else None for c in names]
+                idx = len(fetched)
+                fetched.append(rowv)
+                for kc in key_cols:
+                    if rowv[ki[kc]] is not None:
+                        existing[kc][rowv[ki[kc]]] = idx
+        pending, pkey = [], {kc: {} for kc in key_cols}
+        # origin: id(pending row) -> [(key col, old value)] of the
+        # existing row it replaces — the caller deletes old rows only
+        # for pending rows that actually get appended (INSERT IGNORE
+        # may drop an updated row; its old row must then survive)
+        origin: dict = {}
+        n_upd = 0
+        consumed = set()
+        for row in rows:
+            target = None
+            for kc in key_cols:
+                v = row[ki[kc]]
+                if v is None:
+                    continue
+                if v in pkey[kc]:
+                    target = ("p", pkey[kc][v])
+                    break
+                fi = existing[kc].get(v)
+                if fi is not None and fi not in consumed:
+                    target = ("e", fi)
+                    break
+            if target is None:
+                idx = len(pending)
+                pending.append(row)
+                for kc in key_cols:
+                    if row[ki[kc]] is not None:
+                        pkey[kc][row[ki[kc]]] = idx
+                continue
+            n_upd += 1
+            if target[0] == "e":
+                fi = target[1]
+                consumed.add(fi)
+                old = fetched[fi]
+                new = self._eval_on_dup(assigns, names, old, row)
+                origin[id(new)] = [
+                    (kc, old[ki[kc]])
+                    for kc in key_cols
+                    if old[ki[kc]] is not None
+                ]
+                idx = len(pending)
+                pending.append(new)
+                for kc in key_cols:
+                    if new[ki[kc]] is not None:
+                        pkey[kc][new[ki[kc]]] = idx
+            else:
+                pi = target[1]
+                old = pending[pi]
+                new = self._eval_on_dup(assigns, names, old, row)
+                if id(old) in origin:
+                    origin[id(new)] = origin.pop(id(old))
+                for kc in key_cols:
+                    ov = old[ki[kc]]
+                    if ov is not None and pkey[kc].get(ov) == pi:
+                        del pkey[kc][ov]
+                pending[pi] = new
+                for kc in key_cols:
+                    if new[ki[kc]] is not None:
+                        pkey[kc][new[ki[kc]]] = pi
+        return pending, origin, n_upd
+
+    def _delete_rows_by_keys(self, t, del_keys: dict) -> None:
+        """Delete rows whose key column holds one of the given values
+        (host decode — ON DUPLICATE KEY batches are small)."""
+        for col, values in del_keys.items():
+            if not values:
+                continue
+            keep = []
+            for b in t.blocks():
+                c = b.columns[col]
+                dec = c.decode()
+                keep.append(
+                    np.array(
+                        [
+                            not (o and v in values)
+                            for o, v in zip(c.valid, dec)
+                        ],
+                        dtype=bool,
+                    )
+                )
+            if any((~m).any() for m in keep):
+                t.delete_where(keep)
+
     def _run_insert(self, s: ast.Insert) -> Result:
         from tidb_tpu.utils.failpoint import inject
 
@@ -1471,23 +1753,56 @@ class Session:
         # constraints run over the final values (after autoinc fill) and
         # BEFORE the REPLACE delete — a failing row must not leave the
         # statement half-applied
-        self._enforce_write_constraints(t, s.db or self.db, rows)
+        db = s.db or self.db
+        n_upd = 0
+        n_incoming = len(rows)
+        origin: dict = {}
+        if s.on_dup:
+            rows, origin, n_upd = self._apply_on_dup(
+                t, db, names, rows, s.on_dup
+            )
+        if getattr(s, "ignore", False):
+            before = len(rows)
+            rows = self._filter_ignore(
+                t, db, names, rows, skip_unique=bool(s.on_dup)
+            )
+            n_incoming -= before - len(rows)
+        else:
+            self._enforce_write_constraints(t, db, rows)
+        # delete old rows only for updated rows that survived filtering
+        del_keys: dict = {}
+        for r in rows:
+            for kc, v in origin.get(id(r), ()):
+                del_keys.setdefault(kc, set()).add(v)
         replace = getattr(s, "replace", False)
+        mutates_existing = replace or any(del_keys.values())
         children = (
-            self._fk_children(s.db or self.db, s.table) if replace else []
+            self._fk_children(db, s.table) if mutates_existing else []
         )
-        saved = (list(t.blocks()), dict(t.dictionaries)) if children else None
-        if replace:
-            self._replace_conflicts(t, names, rows)
-        t.append_rows(rows)
+        saved = (
+            (list(t.blocks()), dict(t.dictionaries))
+            if mutates_existing else None
+        )
+        try:
+            if replace:
+                self._replace_conflicts(t, names, rows)
+            if any(del_keys.values()):
+                self._delete_rows_by_keys(t, del_keys)
+            t.append_rows(rows)
+        except Exception:
+            if saved is not None:
+                t.replace_blocks(saved[0], modified_rows=len(rows))
+                t.dictionaries = saved[1]
+            raise
         if children:
-            # REPLACE deletes conflicting rows: the parent value set may
-            # have shrunk — enforce RESTRICT on the post-statement state
-            # and roll the whole statement back on violation
+            # REPLACE / ON DUPLICATE KEY delete or rewrite existing
+            # rows: the parent value set may have shrunk — enforce
+            # RESTRICT on the post-statement state and roll the whole
+            # statement back on violation
             need = {rc for _, _, _, _, rc in children}
             need |= {
                 c for cd, ct, _, c, _ in children
-                if cd == (s.db or self.db).lower() and ct == t.name
+                if cd == db.lower() and ct == t.name
             }
             remaining = {}
             for col in need:
@@ -1500,15 +1815,16 @@ class Session:
                             vals.add(v)
                 remaining[col] = vals
             try:
-                self._enforce_parent_constraints(
-                    s.db or self.db, s.table, remaining
-                )
+                self._enforce_parent_constraints(db, s.table, remaining)
             except Exception:
                 t.replace_blocks(saved[0], modified_rows=len(rows))
                 t.dictionaries = saved[1]
                 raise
         clear_scan_cache()
-        return Result([], [], affected=len(rows))
+        # MySQL: each plain insert counts 1, each ON DUPLICATE update 2
+        # (n_incoming = incoming rows surviving IGNORE; each update
+        # consumed one incoming row and counts twice)
+        return Result([], [], affected=n_incoming + n_upd)
 
     def _replace_conflicts(self, t, names, rows) -> None:
         """REPLACE INTO: delete existing rows whose PK or any UNIQUE key
