@@ -68,6 +68,63 @@ class TestDescribe:
         assert sess.execute("show columns from t").rows == rows
 
 
+class TestPrimaryKeyUniqueness:
+    def test_duplicate_pk_rejected(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert into t values (1, 10)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("insert into t values (1, 20)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("insert into t values (2, 1), (2, 2)")
+        assert sess.execute("select count(*) from t").rows == [(1,)]
+
+    def test_string_pk(self, sess):
+        sess.execute("create table t (k varchar(10) primary key, v int)")
+        sess.execute("insert into t values ('a', 1)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("insert into t values ('a', 2)")
+        sess.execute("insert into t values ('b', 2)")
+
+    def test_replace_and_upsert_still_allowed(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert into t values (1, 10)")
+        sess.execute("replace into t values (1, 20)")
+        sess.execute(
+            "insert into t values (1, 0) on duplicate key update v = 30"
+        )
+        assert sess.execute("select v from t").rows == [(30,)]
+
+    def test_insert_select_checked(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("create table src (id int, v int)")
+        sess.execute("insert into src values (5, 1), (5, 2)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("insert into t select id, v from src")
+
+    def test_encoded_domain_batch_dups(self, sess):
+        # distinct Python floats that round to the same stored decimal
+        # must collide (the check runs in the encoded domain)
+        sess.execute("create table d (id decimal(10,2) primary key, v int)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("insert into d values (1.001, 1), (1.002, 2)")
+
+    def test_update_creating_pk_dup_rolls_back(self, sess):
+        sess.execute("create table t (id int primary key, v int)")
+        sess.execute("insert into t values (1, 10), (2, 20)")
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("update t set id = 9")
+        # with WHERE (the columnar fast path's home turf) too
+        with pytest.raises(ValueError, match="primary key"):
+            sess.execute("update t set id = 9 where v > 0")
+        assert sess.execute("select id, v from t order by id").rows == [
+            (1, 10), (2, 20)
+        ]
+        sess.execute("update t set id = 9 where v = 10")  # unique new key
+        assert sess.execute("select id from t order by id").rows == [
+            (2,), (9,)
+        ]
+
+
 class TestInsertIgnore:
     def test_ignore_duplicates(self, sess):
         sess.execute("create table t (id int primary key, v int)")

@@ -2013,9 +2013,18 @@ class Session:
             affected = len(rows)
         else:
             _masks, affected = self._eval_where_per_block(t, s.where)
+        saved_blocks = list(t.blocks())
+        saved_dicts = dict(t.dictionaries)
         t.replace_blocks([], modified_rows=affected)
         if rows:
-            t.append_rows(rows)
+            try:
+                t.append_rows(rows)
+            except Exception:
+                # e.g. the SET created duplicate PK/UNIQUE keys — the
+                # rewrite must not leave the table emptied
+                t.replace_blocks(saved_blocks, modified_rows=affected)
+                t.dictionaries = saved_dicts
+                raise
         clear_scan_cache()
         return Result([], [], affected=affected)
 
@@ -2044,6 +2053,9 @@ class Session:
             rc for _, _, _, _, rc in
             self._fk_children(s.db or self.db, s.table)
         }
+        # PK/UNIQUE columns: the scatter path bypasses append-time
+        # uniqueness checks, so key-touching SETs take the rewrite path
+        relevant |= set(self._unique_key_cols(t))
         if relevant & set(sets):
             # a constrained column is being SET: constraint checks need
             # fully-formed rows — use the rewrite path, which

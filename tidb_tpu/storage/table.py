@@ -215,21 +215,30 @@ class Table:
                     )
 
     def _check_unique(self, block: HostBlock) -> None:
-        """Duplicate-key check for UNIQUE indexes (single leading column;
-        NULLs permitted any number of times, MySQL semantics). Caller
-        holds _lock."""
-        for iname in self.unique_indexes:
-            cols = self.indexes.get(iname)
-            if not cols:
-                continue
-            col = cols[0]
+        """Duplicate-key check for UNIQUE indexes and a single-column
+        PRIMARY KEY (NULLs permitted any number of times for UNIQUE,
+        MySQL semantics). Works in the encoded domain, so values that
+        encode equal (e.g. decimals rounding to the same scale) collide
+        correctly. Composite PKs are not enforced. Caller holds _lock.
+        REPLACE / ON DUPLICATE KEY delete their conflicts before the
+        append, so they pass untouched (reference: uniqueness on the
+        mutation path, pkg/table/tables.go AddRecord)."""
+        keys = [
+            (f"unique index {i!r}", self.indexes[i][0])
+            for i in self.unique_indexes
+            if self.indexes.get(i)
+        ]
+        pk = self.schema.primary_key
+        if pk and len(pk) == 1:
+            keys.append(("primary key", pk[0]))
+        for label, col in keys:
             c = block.columns.get(col)
             if c is None:
                 continue
             vals = c.data[c.valid]
             if len(vals) != len(np.unique(vals)):
                 raise ValueError(
-                    f"duplicate entry for unique index {iname!r} ({col})"
+                    f"duplicate entry for {label} ({col})"
                 )
             if len(vals):
                 svals, _perm, nvalid = self._sorted_index(col)
@@ -240,8 +249,7 @@ class Table:
                     )
                     if hit.any():
                         raise ValueError(
-                            f"duplicate entry for unique index {iname!r} "
-                            f"({col})"
+                            f"duplicate entry for {label} ({col})"
                         )
 
     def next_autoid(self, n: int = 1) -> int:
