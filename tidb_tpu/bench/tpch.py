@@ -247,8 +247,23 @@ def gen_partsupp(sf: float, rng: np.random.Generator) -> HostBlock:
 _SCHEMAS: Dict[str, TableSchema] = {}
 
 
-def _schema_of(block: HostBlock) -> TableSchema:
-    return TableSchema([(n, c.type) for n, c in block.columns.items()])
+# standard TPC-H single-column primary keys (lineitem/partsupp have
+# composite PKs the generator does not guarantee; they stay undeclared)
+_PKS = {
+    "region": ["r_regionkey"],
+    "nation": ["n_nationkey"],
+    "part": ["p_partkey"],
+    "supplier": ["s_suppkey"],
+    "customer": ["c_custkey"],
+    "orders": ["o_orderkey"],
+}
+
+
+def _schema_of(block: HostBlock, name: str = "") -> TableSchema:
+    return TableSchema(
+        [(n, c.type) for n, c in block.columns.items()],
+        primary_key=_PKS.get(name),
+    )
 
 
 def load_tpch(
@@ -276,7 +291,7 @@ def load_tpch(
         if tables is not None and name not in tables:
             continue
         block = gen()
-        t = catalog.create_table(db, name, _schema_of(block), if_not_exists=True)
+        t = catalog.create_table(db, name, _schema_of(block, name), if_not_exists=True)
         if t.nrows == 0:
             # bypass dictionary merge (fresh table, dicts already sorted)
             t.dictionaries.update(

@@ -45,6 +45,30 @@ ExprFn = Callable[[Batch], DevCol]
 # full and the host retries at the next tile
 _MAX_PROBES = 64
 
+# reported in place of the group count when a row's key falls outside the
+# compile-time-baked packed-key bounds (int-column widths come from
+# Table.col_bounds and data may have grown since): the executor recompiles
+# the plan with fresh bounds (physical.StaleWidthsError) instead of
+# bumping capacity tiles
+WIDTH_STALE = 1 << 60
+
+
+def _pack_keys(keys, key_widths, row_valid):
+    """Pack key columns into one int64 (biased limbs, 0 = NULL) and
+    verify every valid row's limb fits its baked width. Returns
+    (packed [cap] int64, stale bool scalar)."""
+    cap = row_valid.shape[0]
+    packed = jnp.zeros(cap, dtype=jnp.int64)
+    stale = jnp.zeros((), dtype=bool)
+    off = 0
+    for (w, b), k in zip(key_widths, keys):
+        limb = jnp.where(k.valid, k.data.astype(jnp.int64) + (b + 1), 0)
+        bad = k.valid & ((limb < 1) | (limb > ((1 << w) - 1)))
+        stale = stale | jnp.any(row_valid & bad)
+        packed = packed | (limb << off)
+        off += w
+    return packed, stale
+
 
 @dataclasses.dataclass(frozen=True)
 class AggDesc:
@@ -219,12 +243,7 @@ def _packed_group_assign(
     """
     cap = row_valid.shape[0]
     sent = jnp.int64(2**63 - 1)
-    packed = jnp.zeros(cap, dtype=jnp.int64)
-    off = 0
-    for (w, b), k in zip(key_widths, keys):
-        limb = jnp.where(k.valid, k.data.astype(jnp.int64) + (b + 1), 0)
-        packed = packed | (limb << off)
-        off += w
+    packed, stale = _pack_keys(keys, key_widths, row_valid)
     packed = jnp.where(row_valid, packed, sent)
 
     def cond(s):
@@ -259,7 +278,79 @@ def _packed_group_assign(
     # mask with row_valid too: invalid rows carry the sentinel, which
     # also fills unclaimed uniq slots and would otherwise match one
     seg = jnp.where(row_valid & jnp.any(eq, axis=1), seg, slots)
-    return seg, uniq, count, over
+    return seg, uniq, count, over, stale
+
+
+def _dense_compact_group_aggregate(
+    batch, keys, key_widths, aggs, arg_cols, slots, dense_bits,
+    key_names, reps, fold_distinct_overflow,
+):
+    """Aggregation over the full dense packed-key domain followed by a
+    cumsum compaction of occupied slots into the `slots` output tile.
+    For high-cardinality keys the claim loop needs O(probe-chain) full
+    scatter passes; this costs one segment scatter per agg over the dense
+    domain plus ~2 passes per output column to compact. Reports the true
+    group count — when it exceeds `slots` the host bumps the capacity
+    knob and re-jits exactly like the probed paths (results here stay
+    correct regardless; only the compaction tile was too small)."""
+    cap = batch.capacity
+    dense = 1 << dense_bits
+    packed, stale = _pack_keys(keys, key_widths, batch.row_valid)
+    # invalid / stale-width rows -> `dense`, out of range for every
+    # dense-domain scatter below (scatter drops OOB indices under jit)
+    seg = jnp.where(
+        batch.row_valid & (packed < dense), packed, dense
+    ).astype(jnp.int32)
+
+    occ_n = jax.ops.segment_sum(
+        batch.row_valid.astype(jnp.int64), seg, num_segments=dense
+    )
+    occupied = occ_n > 0
+    ngroups = jnp.sum(occupied).astype(jnp.int64)
+    ngroups = jnp.where(stale, jnp.int64(WIDTH_STALE), ngroups)
+
+    # dense-domain key reconstruction
+    sid = jnp.arange(dense, dtype=jnp.int64)
+    out_cols = {}
+    off = 0
+    for name, k, (w, b) in zip(key_names, keys, key_widths):
+        limb = (sid >> off) & ((1 << w) - 1)
+        off += w
+        kv = (limb != 0) & occupied
+        kd = (limb - (b + 1)).astype(k.data.dtype)
+        out_cols[name] = DevCol(jnp.where(kv, kd, jnp.zeros_like(kd)), kv)
+
+    claimer = None
+    if any(a.func == "first" for a in aggs):
+        claimer = (
+            jnp.full(dense, cap, dtype=jnp.int32)
+            .at[seg]
+            .min(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        )
+    cl = (
+        jnp.minimum(claimer, cap - 1)
+        if claimer is not None
+        else jnp.zeros(dense, dtype=jnp.int32)
+    )
+
+    red = _segment_backend(seg, dense, num_segments=dense)
+    wide = _run_aggs(
+        batch, aggs, arg_cols, seg, dense, occupied, cl, out_cols, red,
+        reps=reps,
+    )
+
+    # compact occupied dense slots into the output tile, in slot-id
+    # (ascending key) order
+    pos = jnp.where(occupied, jnp.cumsum(occupied) - 1, slots)
+    cols = {}
+    for name, c in wide.cols.items():
+        nd = jnp.zeros(slots, dtype=c.data.dtype).at[pos].set(
+            c.data, mode="drop"
+        )
+        nv = jnp.zeros(slots, dtype=bool).at[pos].set(c.valid, mode="drop")
+        cols[name] = DevCol(nd, nv)
+    row_valid = jnp.arange(slots) < jnp.minimum(ngroups, slots)
+    return Batch(cols, row_valid), fold_distinct_overflow(ngroups)
 
 
 def _needs_rep(a: AggDesc) -> bool:
@@ -350,20 +441,39 @@ def group_aggregate(
             jnp.where(dover, jnp.int64(pair_slots + 1), jnp.int64(0)),
         )
 
-    packable = (
+    widths_ok = (
         keys
-        and group_capacity <= 256
         and key_widths is not None
         and all(w is not None for w in key_widths)
         and sum(w for w, _b in key_widths) <= 62
     )
+    dense_bits = sum(w for w, _b in key_widths) if widths_ok else 99
+    packable = widths_ok and group_capacity <= 256
+
+    if (
+        widths_ok
+        and dense_bits <= 23
+        and (1 << dense_bits) <= max(4 * cap, 1 << 16)
+    ):
+        # the whole packed-key domain fits a dense table (and is not
+        # wildly sparser than the batch): slot id == packed key, so
+        # assignment needs no probe loop at all — one segment scatter
+        # per agg plus a cumsum compaction into the output tile. The
+        # probed paths below cost one full-array pass PER GROUP (packed
+        # loop) or per probe-chain step (claim loop).
+        slots = _next_pow2(max(2 * group_capacity, 16))
+        return _dense_compact_group_aggregate(
+            batch, keys, key_widths, aggs, arg_cols, slots, dense_bits,
+            key_names, reps, fold_distinct_overflow,
+        )
 
     if packable:
         slots = _next_pow2(max(2 * group_capacity, 16))
-        seg, uniq, count, over = _packed_group_assign(
+        seg, uniq, count, over, stale = _packed_group_assign(
             keys, key_widths, batch.row_valid, slots
         )
         ngroups = jnp.where(over, jnp.int64(slots + 1), count.astype(jnp.int64))
+        ngroups = jnp.where(stale, jnp.int64(WIDTH_STALE), ngroups)
         occupied = jnp.arange(slots) < count
         group_valid = occupied
         # reconstruct key columns arithmetically from the packed table
@@ -388,7 +498,7 @@ def group_aggregate(
             if claimer is not None
             else jnp.zeros(slots, dtype=jnp.int32)
         )
-        red = _masked_backend(seg, slots) if slots <= 128 else None
+        red = _pick_backend(seg, slots)
         out = _run_aggs(
             batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red,
             reps=reps,
@@ -426,7 +536,7 @@ def group_aggregate(
         kv = k.valid[cl] & group_valid
         out_cols[name] = DevCol(jnp.where(group_valid, kd, jnp.zeros_like(kd)), kv)
 
-    red = _masked_backend(seg, slots) if slots <= 128 else None
+    red = _pick_backend(seg, slots)
     return (
         _run_aggs(
             batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red,
@@ -436,10 +546,12 @@ def group_aggregate(
     )
 
 
-def _segment_backend(seg, slots):
+def _segment_backend(seg, slots, num_segments=None):
     """Aggregate reductions via jax.ops.segment_* (scatter) — the general
-    path for large slot counts."""
-    num_segments = slots + 1  # +1 overflow slot for invalid rows
+    path. Default table is slots+1 (overflow slot for invalid rows);
+    the dense path passes its own domain size (out-of-range ids are
+    dropped by the scatter)."""
+    ns = (slots + 1) if num_segments is None else num_segments
 
     def red(op, vals, contrib, ident):
         masked = jnp.where(contrib, vals, ident)
@@ -448,7 +560,7 @@ def _segment_backend(seg, slots):
             "min": jax.ops.segment_min,
             "max": jax.ops.segment_max,
         }[op]
-        return seg_op(masked, seg, num_segments=num_segments)[:slots]
+        return seg_op(masked, seg, num_segments=ns)[:slots]
 
     return red
 
@@ -457,16 +569,31 @@ def _masked_backend(seg, slots):
     """Aggregate reductions as fused masked full-array reductions, one
     accumulator per (slot, agg) — scatter-free. TPU scatter costs ~20x a
     fused masked reduction at small slot counts, so this is the fast path
-    whenever the slot table is small."""
+    there when the slot table is small. The optimization barrier pins the
+    reduction inputs: without it XLA fuses the producer expression tree
+    (decimal products, filters, the claim loop) into EVERY per-slot
+    reduction, recomputing it slots*aggs times — measured 35x slowdown on
+    whole-query Q1."""
     ops = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
 
     def red(op, vals, contrib, ident):
         f = ops[op]
+        vals, contrib = jax.lax.optimization_barrier((vals, contrib))
         return jnp.stack(
             [f(jnp.where(contrib & (seg == s), vals, ident)) for s in range(slots)]
         )
 
     return red
+
+
+def _pick_backend(seg, slots):
+    """Small slot tables: masked reductions on TPU (scatter there costs
+    ~20x a fused reduction), segment_* scatter elsewhere (CPU XLA lowers
+    segment_sum to a fast serial scatter; the masked path is ~20x slower
+    there even with the barrier). Large tables: always segment."""
+    if slots <= 128 and jax.default_backend() == "tpu":
+        return _masked_backend(seg, slots)
+    return None
 
 
 def _try_pallas_slot_sums(aggs, arg_cols, seg, slots, srow_valid, reps):

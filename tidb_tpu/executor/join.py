@@ -27,12 +27,13 @@ layouts are chosen from column value ranges.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from tidb_tpu.chunk import Batch, DevCol
+from tidb_tpu.executor.aggregate import WIDTH_STALE
 
 ExprFn = Callable[[Batch], DevCol]
 
@@ -41,6 +42,31 @@ def _keys_of(batch: Batch, key_fn: ExprFn) -> Tuple[jax.Array, jax.Array]:
     k = key_fn(batch)
     valid = k.valid & batch.row_valid
     return k.data.astype(jnp.int64), valid
+
+
+def _dense_span(build_bounds, bcap: int, pcap: int) -> Optional[int]:
+    """Static dense-table span for a bounded build key, or None when the
+    domain is too large/sparse for direct indexing to pay off."""
+    if build_bounds is None:
+        return None
+    lo, hi = build_bounds
+    span = int(hi) - int(lo) + 1
+    if span <= 0 or span > (1 << 24) or span > 4 * (bcap + pcap):
+        return None
+    return span
+
+
+def _dense_build(bkey, bvalid, lo: int, hi: int, span: int):
+    """(build offsets with OOB -> span, in-range mask, stale scalar).
+    Bounds are compile-time constants from Table.col_bounds; a valid
+    build key outside them means the data grew past the baked bounds —
+    reported via the WIDTH_STALE sentinel so the host recompiles (the
+    same contract as aggregate._pack_keys). Probe keys outside the
+    bounds simply never match, which is already correct."""
+    bin_ = bvalid & (bkey >= lo) & (bkey <= hi)
+    stale = jnp.any(bvalid & ~bin_)
+    boff = jnp.where(bin_, bkey - lo, span)
+    return boff, bin_, stale
 
 
 def equi_join(
@@ -54,13 +80,23 @@ def equi_join(
     probe_prefix: str = "",
     mark_name: str = "_mark",
     mark_three_valued: bool = True,
+    build_bounds: Optional[Tuple[int, int]] = None,
+    build_unique: bool = False,
 ) -> Tuple[Batch, jax.Array]:
     """Returns (joined batch, true output row count).
 
     For semi/anti the result is the probe batch with a refined row_valid
     (and the true surviving row count); out_capacity is ignored.
     For left joins, unmatched probe rows emit once with NULL build columns.
-    """
+
+    build_bounds: static (min, max) of the build key (Table.col_bounds
+    via the planner) — enables dense direct indexing instead of
+    sort + searchsorted: existence scatters for semi/anti/mark, and a
+    1:1 row table for inner/left when the planner proves the build key
+    unique (build_unique: PK / unique index / GROUP BY output).
+    Both bounds and uniqueness are runtime-verified; violations report
+    the WIDTH_STALE sentinel in place of the row count and the executor
+    recompiles with fresh bounds."""
 
     from tidb_tpu.utils.failpoint import inject
 
@@ -68,6 +104,77 @@ def equi_join(
     bkey, bvalid = _keys_of(build, build_key)
     pkey, pvalid = _keys_of(probe, probe_key)
     bcap = build.capacity
+    span = _dense_span(build_bounds, bcap, probe.capacity)
+
+    if join_type in ("semi", "anti", "mark") and span is not None:
+        lo, hi = build_bounds
+        boff, _bin, stale = _dense_build(bkey, bvalid, lo, hi, span)
+        occ = jnp.zeros(span, dtype=bool).at[boff].set(True, mode="drop")
+        pin = pvalid & (pkey >= lo) & (pkey <= hi)
+        poff = jnp.clip(pkey - lo, 0, span - 1)
+        matched = pin & occ[jnp.where(pin, poff, 0)]
+        if join_type == "mark":
+            build_has_null = jnp.any(build.row_valid & ~bvalid)
+            build_empty = ~jnp.any(build.row_valid)
+            if mark_three_valued:
+                mvalid = probe.row_valid & (
+                    matched | build_empty | (pvalid & ~build_has_null)
+                )
+            else:
+                mvalid = probe.row_valid
+            cols = dict(probe.cols)
+            cols[mark_name] = DevCol(matched, mvalid)
+            out = Batch(cols, probe.row_valid)
+        else:
+            keep = (
+                matched
+                if join_type == "semi"
+                else (~matched & probe.row_valid & pvalid)
+            )
+            if join_type == "anti":
+                keep = keep | (~pvalid & probe.row_valid)
+            out = Batch(probe.cols, probe.row_valid & keep)
+        total = jnp.sum(out.row_valid.astype(jnp.int64))
+        return out, jnp.where(stale, jnp.int64(WIDTH_STALE), total)
+
+    if join_type in ("inner", "left") and span is not None and build_unique:
+        lo, hi = build_bounds
+        boff, bin_, stale = _dense_build(bkey, bvalid, lo, hi, span)
+        rows = jnp.arange(bcap, dtype=jnp.int32)
+        rowtab = (
+            jnp.full(span, -1, dtype=jnp.int32).at[boff].max(rows, mode="drop")
+        )
+        cnt = (
+            jnp.zeros(span, dtype=jnp.int32)
+            .at[boff]
+            .add(jnp.int32(1), mode="drop")
+        )
+        stale = stale | jnp.any(cnt > 1)  # planner-asserted uniqueness broken
+        pin = pvalid & (pkey >= lo) & (pkey <= hi)
+        poff = jnp.clip(pkey - lo, 0, span - 1)
+        brow_ = rowtab[jnp.where(pin, poff, 0)]
+        matched = pin & (brow_ >= 0)
+        brow = jnp.clip(brow_, 0, bcap - 1)
+        # 1:1 with the probe side: the output IS the probe batch (same
+        # capacity, row_valid refined) plus gathered build columns — no
+        # expansion pass, no compaction
+        if join_type == "inner":
+            out_valid = probe.row_valid & matched
+            bmatched = out_valid
+        else:
+            out_valid = probe.row_valid
+            bmatched = matched
+        cols: Dict[str, DevCol] = {}
+        for name, c in probe.cols.items():
+            cols[probe_prefix + name] = DevCol(c.data, c.valid & out_valid)
+        for name, c in build.cols.items():
+            cols[build_prefix + name] = DevCol(
+                c.data[brow], c.valid[brow] & out_valid & bmatched
+            )
+        total = jnp.sum(out_valid.astype(jnp.int64))
+        return Batch(cols, out_valid), jnp.where(
+            stale, jnp.int64(WIDTH_STALE), total
+        )
 
     if join_type in ("semi", "anti", "mark"):
         sort_out = jax.lax.sort([~bvalid, bkey], num_keys=2)

@@ -41,6 +41,7 @@ from tidb_tpu.executor import (
     limit_op,
     order_by,
 )
+from tidb_tpu.executor.aggregate import WIDTH_STALE as _WIDTH_STALE
 from tidb_tpu.expression import compile_expr
 from tidb_tpu.expression.expr import ColumnRef, Expr
 from tidb_tpu.planner import logical as L
@@ -53,6 +54,56 @@ PlanFn = Callable[[Dict[int, Batch], Dict[int, int]], Tuple[Batch, Dict[int, jax
 
 class ExecError(RuntimeError):
     pass
+
+
+class StaleWidthsError(RuntimeError):
+    """A compiled program's baked key-width bounds no longer cover the
+    data (rows grew past the bounds observed at compile time). The
+    executor recompiles the plan against fresh Table.col_bounds."""
+
+
+# reserved dicts-map key prefix for integer-column value bounds (column
+# names never contain NUL); see Table.col_bounds and _key_width
+_BOUNDS_PREFIX = "\x00b\x00"
+# reserved prefix marking a column as unique-valued (single-column PK /
+# unique index at scan, GROUP BY key of a single-key aggregate). Joins
+# use it to prove a 1:1 build side (dense unique join); it survives
+# row-filtering operators and is stripped where rows can duplicate.
+_UNIQ_PREFIX = "\x00u\x00"
+
+
+def _strip_uniq(dicts: Dicts) -> Dicts:
+    return {k: v for k, v in dicts.items() if not k.startswith(_UNIQ_PREFIX)}
+
+
+class _LazyBounds:
+    """Deferred Table.col_bounds lookup pinned to a (table, col, version):
+    scans emit one per integer column, but the min/max host pass only
+    runs if a packed-aggregation or dense-join site consumes it (the
+    Table caches the result per version for repeat consumers)."""
+
+    __slots__ = ("table", "col", "version")
+
+    def __init__(self, table, col, version):
+        self.table = table
+        self.col = col
+        self.version = version
+
+    def get(self):
+        return self.table.col_bounds(self.col, self.version)
+
+
+def _resolve_bounds(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, _LazyBounds):
+        return entry.get()
+    return entry
+
+
+def _stale_only(total):
+    """Pass the WIDTH_STALE sentinel through a needs slot, 0 otherwise."""
+    return jnp.where(total >= _WIDTH_STALE, total, jnp.int64(0))
 
 
 @dataclasses.dataclass
@@ -302,6 +353,13 @@ def agg_out_dicts(plan: "L.Aggregate", dicts) -> Dicts:
         d = _expr_dict(e, dicts)
         if d is not None:
             out_dicts[kname] = d
+        if isinstance(e, ColumnRef):
+            cb = dicts.get(_BOUNDS_PREFIX + e.name)
+            if cb is not None:
+                out_dicts[_BOUNDS_PREFIX + kname] = cb
+    if len(plan.group_exprs) == 1:
+        # a single GROUP BY key is unique in the aggregate's output
+        out_dicts[_UNIQ_PREFIX + plan.group_exprs[0][0]] = True
     for (name, func, arg, _d) in plan.aggs:
         if func in ("min", "max", "first") and arg is not None:
             d = _expr_dict(arg, dicts)
@@ -353,6 +411,19 @@ class PlanCompiler:
     def fresh_id(self) -> int:
         self._next_id += 1
         return self._next_id
+
+    def _stale_sentinel_node(self, props) -> Optional[int]:
+        """Semi/anti/mark joins have no output-capacity knob, so a dense
+        build side gets a dedicated sized node whose `needs` carries only
+        the WIDTH_STALE sentinel (0 otherwise) back to the discovery
+        loop."""
+        if props[0] is None:
+            return None
+        nid = self.fresh_id()
+        self.sized.append(nid)
+        self.defaults[nid] = 16
+        self.widths[nid] = 8
+        return nid
 
     def _gathered(self, fn, tag):
         """Wrap fn so its output is replicated on every device (the
@@ -407,13 +478,17 @@ class PlanCompiler:
     def compile(self, plan: L.LogicalPlan) -> CompiledQuery:
         self._tag = "shard"
         fn, dicts = self._build(plan)
+        # bounds/uniqueness entries are compile-time plumbing; result
+        # consumers (materialization, the RPC seam) expect name ->
+        # dictionary only (all reserved prefixes start with NUL)
+        out = {k: v for k, v in dicts.items() if not k.startswith("\x00")}
         return CompiledQuery(
             fn=fn,
             out_tag=self._tag,
             scans=self.scans,
             sized_nodes=self.sized,
             default_caps=dict(self.defaults),
-            out_dicts=dicts,
+            out_dicts=out,
             widths=dict(self.widths),
         )
 
@@ -452,6 +527,27 @@ class PlanCompiler:
                 for n, d in t.dictionaries.items()
                 if n in plan.columns
             }
+            # integer-column value bounds ride the dicts map under a
+            # reserved key (columns can't contain NUL): they give the
+            # packed-aggregation paths sound static widths for int keys.
+            # Programs verify them at run time (aggregate._pack_keys), so
+            # jit reuse across versions stays sound after data growth.
+            # Entries are lazy (resolved by _resolve_bounds at the group/
+            # join key that consumes them): a wide scan never pays the
+            # full-column min/max host pass for unused columns.
+            for n in plan.columns:
+                dicts[_BOUNDS_PREFIX + f"{plan.alias}.{n}"] = _LazyBounds(
+                    t, n, _v
+                )
+            pk = t.schema.primary_key
+            uniq_cols = set([pk[0]] if pk and len(pk) == 1 else [])
+            for iname in t.unique_indexes:
+                icols = t.indexes.get(iname) or []
+                if len(icols) == 1:
+                    uniq_cols.add(icols[0])
+            for n in plan.columns:
+                if n in uniq_cols:
+                    dicts[_UNIQ_PREFIX + f"{plan.alias}.{n}"] = True
             alias = plan.alias
 
             def fn_scan(inputs, caps, _nid=nid, _alias=alias):
@@ -490,6 +586,12 @@ class PlanCompiler:
                 d = _expr_dict(e, dicts)
                 if d is not None:
                     out_dicts[n] = d
+                if isinstance(e, ColumnRef):
+                    cb = dicts.get(_BOUNDS_PREFIX + e.name)
+                    if cb is not None:
+                        out_dicts[_BOUNDS_PREFIX + n] = cb
+                    if dicts.get(_UNIQ_PREFIX + e.name):
+                        out_dicts[_UNIQ_PREFIX + n] = True
             additive = plan.additive
 
             def fn_proj(inputs, caps):
@@ -824,16 +926,20 @@ class PlanCompiler:
                     out = filter_batch(out, res)
                 return out, {**n1, **n2}
 
-            return fn_cross, dicts
+            return fn_cross, _strip_uniq(dicts)
 
         lkeys, rkeys = [], []
         for le, re_ in plan.equi_keys:
             lf, rf = _align_key_fns(le, re_, ldicts, rdicts)
             lkeys.append(lf)
             rkeys.append(rf)
+        lprops = rprops = ((None, False))
         if len(lkeys) == 1:
             lkey, rkey = lkeys[0], rkeys[0]
             verify = None
+            le0, re0 = plan.equi_keys[0]
+            lprops = _join_key_props(le0, ldicts)
+            rprops = _join_key_props(re0, rdicts)
         else:
             if plan.kind not in ("inner", "semi", "anti", "left"):
                 raise ExecError("multi-key outer join not yet supported")
@@ -862,15 +968,20 @@ class PlanCompiler:
                 right = self._gathered(right, rtag)
                 rtag = "repl"
                 self._tag = ltag
+            snid = self._stale_sentinel_node(rprops)
 
             def fn_mark(inputs, caps):
                 lb, n1 = left(inputs, caps)
                 rb, n2 = right(inputs, caps)
-                out, _t = equi_join(
+                out, t = equi_join(
                     rb, lb, rkey, lkey, 0, "mark",
                     mark_name=mark, mark_three_valued=three,
+                    build_bounds=rprops[0],
                 )
-                return out, {**n1, **n2}
+                needs = {**n1, **n2}
+                if snid is not None:
+                    needs[snid] = _stale_only(t)
+                return out, needs
 
             return fn_mark, {**ldicts}
 
@@ -895,6 +1006,8 @@ class PlanCompiler:
                         self.defaults[part_nid] = 0
                     self._tag = ltag
 
+                snid = self._stale_sentinel_node(rprops)
+
                 def fn_semi(inputs, caps):
                     lb, n1 = left(inputs, caps)
                     rb, n2 = right(inputs, caps)
@@ -905,7 +1018,11 @@ class PlanCompiler:
                         B = caps[part_nid]
                         lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
                         needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
-                    out, _t = equi_join(rb, lb, rkey, lkey, 0, kind)
+                    out, _t = equi_join(
+                        rb, lb, rkey, lkey, 0, kind, build_bounds=rprops[0]
+                    )
+                    if snid is not None:
+                        needs[snid] = _stale_only(_t)
                     if null_aware and kind == "anti":
                         bk = rkey(rb)
                         has_null = jnp.any(~bk.valid & rb.row_valid)
@@ -1036,7 +1153,7 @@ class PlanCompiler:
                 needs[nid2] = total2
                 return out, needs
 
-            return fn_left_multi, dicts
+            return fn_left_multi, _strip_uniq(dicts)
 
         part_nid = None
         forced_swap = False
@@ -1086,12 +1203,17 @@ class PlanCompiler:
                 lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
                 extra_needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
             build_b, probe_b, build_k, probe_k = rb, lb, rkey, lkey
+            build_props = rprops
             if forced_swap or (
                 kind == "inner" and not mesh and lb.capacity < rb.capacity
             ):
                 build_b, probe_b, build_k, probe_k = lb, rb, lkey, rkey
+                build_props = lprops
             cap = caps[nid] or pad_capacity(max(probe_b.capacity, 1024))
-            out, total = equi_join(build_b, probe_b, build_k, probe_k, cap, kind)
+            out, total = equi_join(
+                build_b, probe_b, build_k, probe_k, cap, kind,
+                build_bounds=build_props[0], build_unique=build_props[1],
+            )
             if verify is not None:
                 lk, rk = verify
 
@@ -1111,7 +1233,7 @@ class PlanCompiler:
             needs[nid] = total
             return out, needs
 
-        return fn_join, dicts
+        return fn_join, _strip_uniq(dicts)
 
 
 # ---------------------------------------------------------------------------
@@ -1149,9 +1271,11 @@ class PhysicalExecutor:
         # per-query device-memory budget in bytes (tidb_mem_quota_query);
         # session refreshes it per statement. None/0 = unlimited.
         self.quota_bytes = None
-        # row threshold above which aggregate inputs execute chunked
-        # through host RAM (tidb_tpu_stream_rows); None/0 disables
-        self.stream_rows = 2_000_000
+        # aggregate inputs execute chunked through host RAM when the scan
+        # working set overruns device memory (tidb_tpu_stream_rows):
+        # -1 = auto (bytes-based vs the device budget), >0 = explicit row
+        # threshold, None/0 = never stream
+        self.stream_rows = -1
         # kill safepoint hook (utils/sqlkiller): raises to abort
         self.kill_check = None
         self.mesh = None
@@ -1330,6 +1454,11 @@ class PhysicalExecutor:
             bumped = False
             for nid, true_n in needs_host.items():
                 n = int(true_n)
+                if n >= _WIDTH_STALE:
+                    # baked packed-key bounds no longer cover the data:
+                    # capacity bumps can't fix this — recompile the plan
+                    # against fresh Table.col_bounds (run()'s retry loop)
+                    raise StaleWidthsError()
                 if n > caps[nid]:
                     failpoint.inject("executor/cap-overflow")
                     caps[nid] = _cap_tile(n)
@@ -1350,36 +1479,46 @@ class PhysicalExecutor:
     def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
         from tidb_tpu.planner.hostagg import try_host_agg
         from tidb_tpu.planner.streamed import try_streamed
-
-        hosted = try_host_agg(self, plan)
-        if hosted is not None:
-            return hosted
-        streamed = try_streamed(self, plan)
-        if streamed is not None:
-            return streamed
         from tidb_tpu.utils.metrics import REGISTRY
 
-        key = self._cache_key(plan)
-        cq = self._cache.get(key)
-        if cq is not None:
-            self._cache.move_to_end(key)
-            REGISTRY.counter("tidb_tpu_plan_cache_hits_total").inc()
-        else:
-            REGISTRY.counter("tidb_tpu_plan_cache_misses_total").inc()
-            compiler = PlanCompiler(
-                self.catalog, resolver=self._resolve, mesh_n=self.mesh_n
-            )
-            cq = compiler.compile(plan)
-            while len(self._cache) >= 256:
-                self._cache.popitem(last=False)
-            self._cache[key] = cq
+        # stale-width retry: programs bake integer key bounds as static
+        # widths and verify them at run time; growth past them recompiles
+        # against fresh bounds (bounded — bounds re-read each attempt)
+        for _stale_attempt in range(4):
+            try:
+                hosted = try_host_agg(self, plan)
+                if hosted is not None:
+                    return hosted
+                streamed = try_streamed(self, plan)
+                if streamed is not None:
+                    return streamed
 
-        pins = []
-        try:
-            return self._run_pinned(cq, pins)
-        finally:
-            for t, v in pins:
-                t.unpin(v)
+                key = self._cache_key(plan)
+                cq = self._cache.get(key)
+                if cq is not None:
+                    self._cache.move_to_end(key)
+                    REGISTRY.counter("tidb_tpu_plan_cache_hits_total").inc()
+                else:
+                    REGISTRY.counter("tidb_tpu_plan_cache_misses_total").inc()
+                    compiler = PlanCompiler(
+                        self.catalog, resolver=self._resolve, mesh_n=self.mesh_n
+                    )
+                    cq = compiler.compile(plan)
+                    while len(self._cache) >= 256:
+                        self._cache.popitem(last=False)
+                    self._cache[key] = cq
+
+                pins = []
+                try:
+                    return self._run_pinned(cq, pins)
+                finally:
+                    for t, v in pins:
+                        t.unpin(v)
+            except StaleWidthsError:
+                key = self._cache_key(plan)
+                self._cache.pop(key, None)
+                getattr(self, "_stream_plans", {}).pop(key, None)
+        raise ExecError("packed key widths did not stabilize after recompiles")
 
     def _run_pinned(self, cq: CompiledQuery, pins) -> Tuple[Batch, Dicts]:
         inputs = self._fetch_inputs(cq, mesh=self.mesh, pins=pins)
@@ -1552,13 +1691,23 @@ def _compact_impl(batch: Batch, out_cap: int) -> Batch:
 def _key_width(e: Expr, dicts: Dicts):
     """(bit width, bias) of a group key's packed encoding when a sound
     static bound exists (enables the scatter-free packed aggregation
-    path); None otherwise."""
+    path); None otherwise. Integer-typed plain columns take their width
+    from the storage layer's value bounds (Table.col_bounds, riding the
+    dicts map) — these are exact at compile time and runtime-verified in
+    the kernel, so growth past them re-plans instead of mis-grouping."""
     kind = e.type.kind if e.type is not None else None
     if kind == Kind.STRING:
         d = _expr_dict(e, dicts)
         if d is None:
             return None
         return (max(1, int(len(d)).bit_length()), 0)
+    if isinstance(e, ColumnRef):
+        cb = _resolve_bounds(dicts.get(_BOUNDS_PREFIX + e.name))
+        if cb is not None:
+            lo, hi = cb
+            w = int(hi - lo + 1).bit_length()
+            if w <= 40:
+                return (w, -lo)
     if kind == Kind.DATE:
         return (33, 1 << 31)
     if kind == Kind.BOOL:
@@ -1574,6 +1723,21 @@ def _expr_dict(e: Expr, dicts: Dicts) -> Optional[np.ndarray]:
     from tidb_tpu.expression.kernels import expr_dictionary
 
     return expr_dictionary(e, dicts)
+
+
+def _join_key_props(e: Expr, dicts: Dicts):
+    """(bounds, unique) of a join key column for the dense join paths.
+    STRING keys are excluded: their codes are remapped into a merged
+    dictionary by _align_key_fns, so the storage-level code bounds no
+    longer describe the values the kernel sees."""
+    if not isinstance(e, ColumnRef):
+        return (None, False)
+    if e.type is not None and e.type.kind == Kind.STRING:
+        return (None, False)
+    return (
+        _resolve_bounds(dicts.get(_BOUNDS_PREFIX + e.name)),
+        bool(dicts.get(_UNIQ_PREFIX + e.name)),
+    )
 
 
 def _align_key_fns(le: Expr, re_: Expr, ldicts: Dicts, rdicts: Dicts):

@@ -480,6 +480,37 @@ class Table:
         cache[key] = (svals, perm, nvalid)
         return cache[key]
 
+    def col_bounds(self, col: str, version: Optional[int] = None):
+        """(min, max) of a column's valid integer-typed values at the
+        given version, cached per (version, col), or None (no valid rows
+        / non-integer device dtype). Consumed by the planner's packed
+        aggregation width bounds (_key_width); compiled programs bake
+        these as static constants and runtime-verify them, so stale
+        bounds after growth are caught, never silently wrong."""
+        v = self.version if version is None else version
+        cache = getattr(self, "_bounds_cache", None)
+        if cache is None:
+            cache = self._bounds_cache = {}
+        key = (v, col)
+        if key in cache:
+            return cache[key]
+        lo = hi = None
+        for b in self.blocks(v):
+            c = b.columns.get(col)
+            if c is None or not np.issubdtype(c.data.dtype, np.integer):
+                lo = hi = None
+                break
+            vals = c.data[c.valid]
+            if len(vals):
+                blo, bhi = int(vals.min()), int(vals.max())
+                lo = blo if lo is None else min(lo, blo)
+                hi = bhi if hi is None else max(hi, bhi)
+        out = None if lo is None else (lo, hi)
+        if len(cache) > 32:
+            cache.clear()
+        cache[key] = out
+        return out
+
     def range_rows(self, col: str, lo, hi, version: Optional[int] = None) -> np.ndarray:
         """Row indices (concat order) with lo <= col <= hi, NULLs
         excluded. O(log n) searchsorted over the sorted index."""

@@ -61,10 +61,11 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
         SysVarDef("tidb_slow_log_threshold", 300, "both", _int_range(0, 1 << 31),
                   "statements slower than this many ms land in the slow "
                   "log (information_schema.slow_query)"),
-        SysVarDef("tidb_tpu_stream_rows", 2_000_000, "both", _int_range(0, 1 << 40),
-                  "aggregate inputs above this many rows execute chunked "
-                  "through host RAM (spill analog; reference paging + "
-                  "agg_spill.go)"),
+        SysVarDef("tidb_tpu_stream_rows", -1, "both", _int_range(-1, 1 << 40),
+                  "aggregate inputs execute chunked through host RAM "
+                  "(spill analog; reference paging + agg_spill.go): -1 = "
+                  "auto (when the scan overruns device memory), >0 = row "
+                  "threshold, 0 = never"),
         SysVarDef("tidb_allow_mpp", True, "both", _bool,
                   "allow multi-device fragment plans (reference tidb_allow_mpp)"),
         SysVarDef("tidb_broadcast_join_threshold_size", 1 << 20, "both", _int_range(0, 1 << 34),
