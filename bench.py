@@ -195,6 +195,9 @@ def measure(args) -> int:
         load_tpcds(cat, sf=args.sf, seed=1)
         gen_s = time.perf_counter() - t0
         sess = Session(cat, db="test")
+        # benchmark machines have tens of GB of device/host memory; the
+        # conservative 8GB default admission quota is for servers
+        sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
         nrows = cat.table("test", "web_sales").nrows
         sql = Q95_SQL
         sess.execute(sql)  # warmup
@@ -231,6 +234,11 @@ def measure(args) -> int:
     load_tpch(cat, sf=args.sf, tables=tables, seed=1)
     gen_s = time.perf_counter() - t0
     sess = Session(cat, db="tpch")
+    sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
+    for tname in tables:
+        # reference benchmark methodology: ANALYZE before measuring so
+        # the CBO sizes join tiles from real stats
+        sess.execute(f"analyze table {tname}")
     li = cat.table("tpch", "lineitem")
     nrows = li.nrows
 

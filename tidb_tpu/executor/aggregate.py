@@ -96,7 +96,7 @@ class AggDesc:
 
 
 def _next_pow2(n: int) -> int:
-    return pad_capacity(n, floor=1)
+    return pad_capacity(n, floor=1, pow2=True)
 
 
 def _key_components(k: DevCol):
@@ -333,10 +333,9 @@ def _dense_compact_group_aggregate(
         else jnp.zeros(dense, dtype=jnp.int32)
     )
 
-    red = _segment_backend(seg, dense, num_segments=dense)
     wide = _run_aggs(
-        batch, aggs, arg_cols, seg, dense, occupied, cl, out_cols, red,
-        reps=reps,
+        batch, aggs, arg_cols, seg, dense, occupied, cl, out_cols, None,
+        reps=reps, num_segments=dense,
     )
 
     # compact occupied dense slots into the output tile, in slot-id
@@ -394,11 +393,15 @@ def group_aggregate(
 ) -> Tuple[Batch, jax.Array]:
     """Returns (group batch, reported group count).
 
-    The group batch has one row per occupied hash slot (capacity
-    2*group_capacity for keyed aggregation, group_capacity for scalar);
-    key columns first (named key_names or k0..kn), then one agg column
-    each. The reported count is the true group count, or slots+1 when the
-    table overflowed (host: bump the tile and re-jit).
+    The group batch has one row per group; its capacity depends on the
+    path (2*group_capacity hash-slot tile for the probed keyed paths,
+    1x for dense compaction, group_capacity for scalar) — callers must
+    size overflow checks from the RETURNED batch's capacity, never from
+    a 2x assumption. Key columns first (named key_names or k0..kn),
+    then one agg column each. The reported count is the true group
+    count, a value above the output capacity when the table overflowed
+    (host: bump the tile and re-jit), or WIDTH_STALE when baked key
+    bounds no longer cover the data (host: recompile with fresh bounds).
 
     key_widths: per-key (bit width, bias) for keys whose packed encoding
     ``data + bias + 1`` (0 = NULL) provably fits the width — enables the
@@ -460,8 +463,11 @@ def group_aggregate(
         # assignment needs no probe loop at all — one segment scatter
         # per agg plus a cumsum compaction into the output tile. The
         # probed paths below cost one full-array pass PER GROUP (packed
-        # loop) or per probe-chain step (claim loop).
-        slots = _next_pow2(max(2 * group_capacity, 16))
+        # loop) or per probe-chain step (claim loop). Output tile is 1x
+        # the capacity knob (not the hash paths' 2x): compaction needs no
+        # load-factor headroom, and downstream operators (sorts
+        # especially) pay per-capacity for every pass.
+        slots = _next_pow2(max(group_capacity, 16))
         return _dense_compact_group_aggregate(
             batch, keys, key_widths, aggs, arg_cols, slots, dense_bits,
             key_names, reps, fold_distinct_overflow,
@@ -544,25 +550,6 @@ def group_aggregate(
         ),
         fold_distinct_overflow(ngroups),
     )
-
-
-def _segment_backend(seg, slots, num_segments=None):
-    """Aggregate reductions via jax.ops.segment_* (scatter) — the general
-    path. Default table is slots+1 (overflow slot for invalid rows);
-    the dense path passes its own domain size (out-of-range ids are
-    dropped by the scatter)."""
-    ns = (slots + 1) if num_segments is None else num_segments
-
-    def red(op, vals, contrib, ident):
-        masked = jnp.where(contrib, vals, ident)
-        seg_op = {
-            "sum": jax.ops.segment_sum,
-            "min": jax.ops.segment_min,
-            "max": jax.ops.segment_max,
-        }[op]
-        return seg_op(masked, seg, num_segments=ns)[:slots]
-
-    return red
 
 
 def _masked_backend(seg, slots):
@@ -651,29 +638,64 @@ def _try_pallas_slot_sums(aggs, arg_cols, seg, slots, srow_valid, reps):
     return out
 
 
+_SEG_OPS = {
+    "sum": jax.ops.segment_sum,
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _exec_reqs(reqs, red, seg, slots, num_segments):
+    """Execute a list of (op, vals, contrib, ident) reduction requests,
+    one segment scatter per lane. (Stacking same-op lanes into one
+    [n, L] scatter was measured 2x SLOWER on CPU XLA: the stack
+    materializes an n x L intermediate because producers don't fuse into
+    scatter operands, costing more traffic than the shared seg reads
+    save.)"""
+    if red is not None:
+        return [red(op, v, c, i) for (op, v, c, i) in reqs]
+    ns = (slots + 1) if num_segments is None else num_segments
+    return [
+        _SEG_OPS[op](jnp.where(c, v, ident), seg, num_segments=ns)[:slots]
+        for (op, v, c, ident) in reqs
+    ]
+
+
 def _run_aggs(
     batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red=None,
-    reps=None,
+    reps=None, num_segments=None,
 ):
     """Compute all aggregates into the slot table. One implementation of
     the MySQL aggregate semantics (NULL rules, AVG decimal scale),
     parameterized over the reduction backend. `reps` maps agg index to a
-    DISTINCT representative-row mask (_distinct_reps)."""
-    if red is None:
-        red = _segment_backend(seg, slots)
+    DISTINCT representative-row mask (_distinct_reps). Runs in three
+    phases — collect reduction requests, execute them (batched), then
+    assemble output columns — so independent lanes share scatter passes."""
     srow_valid = seg < slots
     ones = jnp.ones_like(seg, dtype=jnp.int64)
     pallas_pre = _try_pallas_slot_sums(
         aggs, arg_cols, seg, slots, srow_valid, reps
     )
+    reqs = []
+
+    def req(op, vals, contrib, ident):
+        reqs.append((op, vals, contrib, ident))
+        return len(reqs) - 1
+
+    assemble = []  # callables taking the executed results list
+
+    def emit(name, fn):
+        assemble.append((name, fn))
+
     for i, (a, col) in enumerate(zip(aggs, arg_cols)):
         pre = (pallas_pre or {}).get(i)
         if a.func == "count" and col is None:
             if pre is not None:
                 s = jnp.round(pre["cnt"]).astype(jnp.int64)
+                out_cols[a.out_name] = DevCol(s, group_valid)
             else:
-                s = red("sum", ones, srow_valid, jnp.int64(0))
-            out_cols[a.out_name] = DevCol(s, group_valid)
+                rid = req("sum", ones, srow_valid, jnp.int64(0))
+                emit(a.out_name, lambda R, rid=rid: DevCol(R[rid], group_valid))
             continue
 
         data = col.data
@@ -686,55 +708,95 @@ def _run_aggs(
         if a.func == "count":
             if pre is not None:
                 s = jnp.round(pre["cnt"]).astype(jnp.int64)
+                out_cols[a.out_name] = DevCol(s, group_valid)
             else:
-                s = red("sum", ones, valid, jnp.int64(0))
-            out_cols[a.out_name] = DevCol(s, group_valid)
+                rid = req("sum", ones, valid, jnp.int64(0))
+                emit(a.out_name, lambda R, rid=rid: DevCol(R[rid], group_valid))
         elif a.func in ("sum", "avg"):
             if a.wide and not jnp.issubdtype(data.dtype, jnp.floating):
                 d64 = data.astype(jnp.int64)
                 lo = d64 & jnp.int64((1 << 30) - 1)
                 hi = d64 >> 30  # arithmetic shift: hi*2^30 + lo == d64
-                s_lo = red("sum", lo, valid, jnp.int64(0))
-                s_hi = red("sum", hi, valid, jnp.int64(0))
-                s = s_hi.astype(jnp.float64) * float(1 << 30) + s_lo.astype(
-                    jnp.float64
-                )
+                rlo = req("sum", lo, valid, jnp.int64(0))
+                rhi = req("sum", hi, valid, jnp.int64(0))
+
+                def mk_s(R, rlo=rlo, rhi=rhi):
+                    return R[rhi].astype(jnp.float64) * float(1 << 30) + R[
+                        rlo
+                    ].astype(jnp.float64)
+
             elif pre is not None:
                 ps = pre["sum"]
-                s = (
+                s_pre = (
                     jnp.round(ps).astype(data.dtype)
                     if not jnp.issubdtype(data.dtype, jnp.floating)
                     else ps.astype(data.dtype)
                 )
+
+                def mk_s(R, s_pre=s_pre):
+                    return s_pre
+
             else:
-                s = red("sum", data, valid, jnp.zeros((), data.dtype))
+                rs = req("sum", data, valid, jnp.zeros((), data.dtype))
+
+                def mk_s(R, rs=rs):
+                    return R[rs]
+
             if pre is not None and "cnt" in pre:
-                cnt = jnp.round(pre["cnt"]).astype(jnp.int64)
+                cnt_pre = jnp.round(pre["cnt"]).astype(jnp.int64)
+
+                def mk_cnt(R, cnt_pre=cnt_pre):
+                    return cnt_pre
+
             else:
-                cnt = red("sum", ones, valid, jnp.int64(0))
-            # SUM over an all-NULL / empty group is NULL (MySQL)
-            v = (cnt > 0) & group_valid
+                rc = req("sum", ones, valid, jnp.int64(0))
+
+                def mk_cnt(R, rc=rc):
+                    return R[rc]
+
             if a.func == "sum":
-                out_cols[a.out_name] = DevCol(s, v)
+
+                def fin(R, mk_s=mk_s, mk_cnt=mk_cnt):
+                    cnt = mk_cnt(R)
+                    # SUM over an all-NULL / empty group is NULL (MySQL)
+                    return DevCol(mk_s(R), (cnt > 0) & group_valid)
+
             else:
-                denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
-                if a.arg_scale:
-                    # DECIMAL data is in scaled units whether the device
-                    # dtype is int64 or (wide-sum) float64 — always
-                    # descale by 10^scale
-                    denom = denom * (10**a.arg_scale)
-                out_cols[a.out_name] = DevCol(s.astype(jnp.float64) / denom, v)
+                scale = a.arg_scale
+
+                def fin(R, mk_s=mk_s, mk_cnt=mk_cnt, scale=scale):
+                    cnt = mk_cnt(R)
+                    denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+                    if scale:
+                        # DECIMAL data is in scaled units whether the
+                        # device dtype is int64 or (wide-sum) float64 —
+                        # always descale by 10^scale
+                        denom = denom * (10**scale)
+                    return DevCol(
+                        mk_s(R).astype(jnp.float64) / denom,
+                        (cnt > 0) & group_valid,
+                    )
+
+            emit(a.out_name, fin)
         elif a.func in ("min", "max"):
             ident = _type_max(data.dtype) if a.func == "min" else _type_min(data.dtype)
-            s = red(a.func, data, valid, ident)
-            cnt = red("sum", ones, valid, jnp.int64(0))
-            out_cols[a.out_name] = DevCol(s, (cnt > 0) & group_valid)
+            rs = req(a.func, data, valid, ident)
+            rc = req("sum", ones, valid, jnp.int64(0))
+            emit(
+                a.out_name,
+                lambda R, rs=rs, rc=rc: DevCol(
+                    R[rs], (R[rc] > 0) & group_valid
+                ),
+            )
         elif a.func == "first":
             d = data[cl]
             out_cols[a.out_name] = DevCol(d, col.valid[cl] & group_valid)
         else:
             raise NotImplementedError(f"agg func {a.func!r}")
 
+    results = _exec_reqs(reqs, red, seg, slots, num_segments)
+    for name, fn in assemble:
+        out_cols[name] = fn(results)
     return Batch(out_cols, group_valid)
 
 

@@ -157,7 +157,10 @@ def equi_join(
         brow = jnp.clip(brow_, 0, bcap - 1)
         # 1:1 with the probe side: the output IS the probe batch (same
         # capacity, row_valid refined) plus gathered build columns — no
-        # expansion pass, no compaction
+        # expansion pass. When capacity discovery has shrunk the output
+        # tile below the probe tile (selective join), compact surviving
+        # rows into it so downstream operators (and the memory budget)
+        # pay for matches, not for the probe capacity.
         if join_type == "inner":
             out_valid = probe.row_valid & matched
             bmatched = out_valid
@@ -172,9 +175,25 @@ def equi_join(
                 c.data[brow], c.valid[brow] & out_valid & bmatched
             )
         total = jnp.sum(out_valid.astype(jnp.int64))
-        return Batch(cols, out_valid), jnp.where(
-            stale, jnp.int64(WIDTH_STALE), total
-        )
+        total = jnp.where(stale, jnp.int64(WIDTH_STALE), total)
+        if 0 < out_capacity < probe.capacity:
+            pos = jnp.where(
+                out_valid, jnp.cumsum(out_valid) - 1, out_capacity
+            )
+            ccols = {
+                name: DevCol(
+                    jnp.zeros(out_capacity, dtype=c.data.dtype)
+                    .at[pos]
+                    .set(c.data, mode="drop"),
+                    jnp.zeros(out_capacity, dtype=bool)
+                    .at[pos]
+                    .set(c.valid, mode="drop"),
+                )
+                for name, c in cols.items()
+            }
+            rv = jnp.arange(out_capacity) < jnp.minimum(total, out_capacity)
+            return Batch(ccols, rv), total
+        return Batch(cols, out_valid), total
 
     if join_type in ("semi", "anti", "mark"):
         sort_out = jax.lax.sort([~bvalid, bkey], num_keys=2)

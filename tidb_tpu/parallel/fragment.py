@@ -168,8 +168,8 @@ def distributed_group_aggregate(
 
     partial, final = _partial_descs(aggs)
 
-    # part_ng carries the partial stage's overflow signal (slots+1 when
-    # its hash table overflowed); folded into the returned group-count
+    # part_ng carries the partial stage's overflow signal (a count above
+    # its output tile when the table overflowed); folded into the group-count
     # bound below so the host retries at a larger tile instead of
     # silently losing the unassigned rows' contributions
     part_batch, part_ng = group_aggregate(
@@ -206,8 +206,9 @@ def distributed_group_aggregate(
     # pmax (not psum) for the scalar case: the broadcast made every shard
     # compute the same single group; pmax also proves replication to jax.
     total_groups = jax.lax.psum(ng, axis) if key_fns else jax.lax.pmax(ng, axis)
-    # a partial-stage overflow anywhere (part_ng = slots+1 > 2*capacity)
-    # must surface to the host even though the final stage fit
+    # a partial-stage overflow anywhere (part_ng above the partial output
+    # tile, hence above the capacity knob) must surface to the host even
+    # though the final stage fit
     total_groups = jnp.maximum(total_groups, jax.lax.pmax(part_ng, axis))
     return Batch(cols, fin.row_valid), total_groups, dropped
 

@@ -139,6 +139,10 @@ class CompiledQuery:
     out_tag: str = "shard"
     # per sized-node estimated row width in bytes (for quota admission)
     widths: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # (scan node id, column) pairs whose validity was folded into the row
+    # mask because the column held no NULLs at compile time; re-checked
+    # at fetch, violation -> StaleWidthsError recompile
+    nonnull: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
 
 
 
@@ -378,8 +382,15 @@ class PlanCompiler:
     pkg/util/execdetails/execdetails.go:1273)."""
 
     def __init__(
-        self, catalog, instrument: bool = False, resolver=None, mesh_n: Optional[int] = None
+        self, catalog, instrument: bool = False, resolver=None,
+        mesh_n: Optional[int] = None, conservative: bool = False,
     ):
+        # conservative=True drops every runtime-verified compile-time
+        # assumption (int-column bounds, unique marks, NULL-free folding,
+        # assumed top-k widths): the executor's stale-retry loop falls
+        # back to it when assumptions keep failing (e.g. a duplicate in a
+        # column the planner believed unique), guaranteeing termination.
+        self.conservative = conservative
         self.catalog = catalog
         self.resolver = resolver or (
             lambda db, tbl: (catalog.table(db, tbl), catalog.table(db, tbl).version)
@@ -393,6 +404,7 @@ class PlanCompiler:
         # (pkg/util/memory/tracker.go:74 as admission control)
         self.widths: Dict[int, int] = {}
         self.instrument = instrument
+        self.nonnull: List[Tuple[int, str]] = []
         self.node_labels: List[Tuple[int, int, str]] = []  # (nid, depth, label)
         self.stats: Dict[int, Dict[str, float]] = {}
         self._depth = 0
@@ -490,6 +502,7 @@ class PlanCompiler:
             default_caps=dict(self.defaults),
             out_dicts=out,
             widths=dict(self.widths),
+            nonnull=list(self.nonnull),
         )
 
     # ------------------------------------------------------------------
@@ -535,26 +548,47 @@ class PlanCompiler:
             # Entries are lazy (resolved by _resolve_bounds at the group/
             # join key that consumes them): a wide scan never pays the
             # full-column min/max host pass for unused columns.
-            for n in plan.columns:
-                dicts[_BOUNDS_PREFIX + f"{plan.alias}.{n}"] = _LazyBounds(
-                    t, n, _v
-                )
+            if not self.conservative:
+                for n in plan.columns:
+                    dicts[_BOUNDS_PREFIX + f"{plan.alias}.{n}"] = _LazyBounds(
+                        t, n, _v
+                    )
             pk = t.schema.primary_key
             uniq_cols = set([pk[0]] if pk and len(pk) == 1 else [])
             for iname in t.unique_indexes:
                 icols = t.indexes.get(iname) or []
                 if len(icols) == 1:
                     uniq_cols.add(icols[0])
+            if self.conservative:
+                uniq_cols = set()
             for n in plan.columns:
                 if n in uniq_cols:
                     dicts[_UNIQ_PREFIX + f"{plan.alias}.{n}"] = True
             alias = plan.alias
+            # NULL-free columns: fold the per-column validity mask into
+            # the row mask so XLA constant-folds every downstream
+            # `valid & ...` (measured ~25% of Q1's memory traffic was
+            # validity loads/ANDs over columns that never hold NULLs).
+            # The assumption is re-checked host-side at every fetch
+            # (_run_pinned) and a violation recompiles via the stale path.
+            nonnull = [] if self.conservative else [
+                n for n in plan.columns if not t.col_has_nulls(n, _v)
+            ]
+            self.nonnull.extend((nid, n) for n in nonnull)
+            nonnull_set = frozenset(nonnull)
 
-            def fn_scan(inputs, caps, _nid=nid, _alias=alias):
+            def fn_scan(inputs, caps, _nid=nid, _alias=alias, _nn=nonnull_set):
                 raw = inputs[_nid]
                 return (
                     Batch(
-                        {f"{_alias}.{n}": c for n, c in raw.cols.items()},
+                        {
+                            f"{_alias}.{n}": (
+                                DevCol(c.data, raw.row_valid)
+                                if n in _nn
+                                else c
+                            )
+                            for n, c in raw.cols.items()
+                        },
                         raw.row_valid,
                     ),
                     {},
@@ -706,10 +740,8 @@ class PlanCompiler:
             return fn_win, out_dicts
 
         if isinstance(plan, L.Limit):
-            if self.mesh_n and isinstance(plan.child, L.Sort):
-                r = self._build_distributed_topn(plan)
-                if r is not None:
-                    return r
+            if isinstance(plan.child, L.Sort) and plan.count is not None:
+                return self._build_topn(plan)
             child, dicts = self._build(plan.child)
             child = self._gather_child(child)
             k, off = plan.count, plan.offset
@@ -847,32 +879,109 @@ class PlanCompiler:
         return fn_agg, agg_out_dicts(plan, dicts)
 
     # ------------------------------------------------------------------
-    def _build_distributed_topn(self, plan: L.Limit):
-        """ORDER BY ... LIMIT n over the mesh without gathering the whole
-        dataset: each shard sorts locally and keeps its top (n+offset)
-        rows in a SMALL static tile, only those tiles all_gather, and a
-        final sort+limit runs on the (mesh x tile) rows — per-device
-        memory O(n x mesh) instead of O(total rows). This replaces the
-        round-1 broadcast_gather Sort path for the TopN shape (reference:
-        TopNExec pushed to each region + root merge,
-        pkg/executor/sortexec/topn.go:31, VERDICT round-1 weak #2)."""
+    def _topn_widths(self, keys, dicts):
+        """Per-key (bit width, bias) for the packed top-k encoding, or
+        None when the keys don't pack into <= 62 bits. Unlike the
+        aggregation widths, integer-typed keys WITHOUT bounds (e.g. SUM
+        outputs) get an assumed 40-bit width — runtime-verified, and
+        dropped by the conservative recompile if values exceed it."""
+        out = []
+        total = 0
+        for e, _d in keys:
+            w = _key_width(e, dicts)
+            if w is None and not self.conservative:
+                kind = e.type.kind if e.type is not None else None
+                if kind in (Kind.INT, Kind.DECIMAL, Kind.DATETIME, Kind.TIME):
+                    w = (40, 1 << 39)  # covers |v| < 2^39
+            if w is None:
+                return None
+            total += w[0]
+            out.append(w)
+        return out if total <= 62 else None
+
+    def _build_topn(self, plan: L.Limit):
+        """ORDER BY ... LIMIT n without sorting the dataset.
+
+        Fast path: when every sort key packs into one int64 (dictionary
+        codes, dates, bounded/assumed-width ints — desc keys keep their
+        limb, asc keys flip it, so bigger packed == earlier row and
+        MySQL NULL ordering falls out of the 0-limb), the top (n+offset)
+        rows come from ONE jax.lax.top_k over the packed key: O(rows log
+        n) and no gather of the full dataset. On a mesh each shard
+        top-k's locally, only the n-row tiles all_gather, and a final
+        top-k runs on mesh x n rows (reference: TopNExec pushed to each
+        region + root merge, pkg/executor/sortexec/topn.go:31).
+
+        Fallback (unpackable keys): full local sort + head tile, same
+        shard/merge structure."""
         sort = plan.child
         inner, dicts = self._build(sort.child)
         if self._tag != "shard":
-            # child already replicated: nothing to save; let the normal
-            # gathered path handle it (we must rebuild, so signal None
-            # only when no state was mutated — inner build is idempotent
-            # apart from node ids, which are display-only)
             inner = self._gathered(inner, self._tag)
             self._tag = "repl"
         key_fns = [compile_expr(e, dicts) for e, _ in sort.keys]
         descs = [d for _, d in sort.keys]
         n = plan.count + (plan.offset or 0)
-        tile = pad_capacity(max(n, 1), floor=32)
         k, off = plan.count, plan.offset
-        mesh_on = self._tag == "shard"
-        if mesh_on:
+        mesh_on = bool(self.mesh_n) and self._tag == "shard"
+        if self.mesh_n:
             self._tag = "repl"
+
+        widths = self._topn_widths(sort.keys, dicts) if n <= 4096 else None
+        if widths is not None:
+            total_bits = sum(w for w, _b in widths)
+            snid = self.fresh_id()
+            self.sized.append(snid)
+            self.defaults[snid] = 16
+            self.widths[snid] = 8
+
+            def pack(b):
+                packed = jnp.zeros(b.capacity, dtype=jnp.int64)
+                stale = jnp.zeros((), dtype=bool)
+                offb = total_bits
+                for (w, bias), f, d in zip(widths, key_fns, descs):
+                    offb -= w
+                    kcol = f(b)
+                    limb = jnp.where(
+                        kcol.valid,
+                        kcol.data.astype(jnp.int64) + (bias + 1),
+                        0,
+                    )
+                    bad = kcol.valid & ((limb < 1) | (limb > ((1 << w) - 1)))
+                    stale = stale | jnp.any(b.row_valid & bad)
+                    enc = limb if d else ((1 << w) - 1) - limb
+                    packed = packed | (enc << offb)
+                # invalid rows sink below every real row (packed >= 0)
+                return jnp.where(b.row_valid, packed, -1), stale
+
+            def take(b, packed, kk):
+                _vals, idx = jax.lax.top_k(packed, kk)
+                cols = {
+                    nm: DevCol(c.data[idx], c.valid[idx])
+                    for nm, c in b.cols.items()
+                }
+                return Batch(cols, b.row_valid[idx])
+
+            def fn_topk(inputs, caps):
+                b, needs = inner(inputs, caps)
+                packed, stale = pack(b)
+                head = take(b, packed, min(n, b.capacity))
+                if mesh_on:
+                    from tidb_tpu.parallel import broadcast_gather
+
+                    head = broadcast_gather(head)
+                    p2, st2 = pack(head)
+                    stale = stale | st2
+                    head = take(head, p2, min(n, head.capacity))
+                needs = dict(needs)
+                needs[snid] = jnp.where(
+                    stale, jnp.int64(_WIDTH_STALE), jnp.int64(0)
+                )
+                return limit_op(head, k, off), needs
+
+            return fn_topk, dicts
+
+        tile = pad_capacity(max(n, 1), floor=32)
 
         def fn_topn(inputs, caps):
             b, needs = inner(inputs, caps)
@@ -1245,8 +1354,9 @@ _MAX_JOIN_CAP = 1 << 26
 
 def _cap_tile(n: int) -> int:
     """Power-of-two tile >= n for capacity knobs (floor 16 — unlike batch
-    tiles, small group/join tables benefit from staying small)."""
-    return pad_capacity(n, floor=16)
+    tiles, small group/join tables benefit from staying small; group
+    slot counts derived from these are used as bitmask moduli)."""
+    return pad_capacity(n, floor=16, pow2=True)
 
 
 class PhysicalExecutor:
@@ -1317,7 +1427,9 @@ class PhysicalExecutor:
         walk(plan)
         return (fp, tuple(versions))
 
-    def _fetch_inputs(self, cq: CompiledQuery, mesh=None, pins=None) -> Dict[int, Batch]:
+    def _fetch_inputs(
+        self, cq: CompiledQuery, mesh=None, pins=None, resolved=None
+    ) -> Dict[int, Batch]:
         inputs = {}
         for s in cq.scans:
             t, v = self._resolve(s.db, s.table)
@@ -1334,6 +1446,8 @@ class PhysicalExecutor:
                 else:
                     raise ExecError(f"snapshot of {s.db}.{s.table} vanished")
                 pins.append((t, v))
+            if resolved is not None:
+                resolved[s.node_id] = (t, v)
             if s.pk_range is not None and mesh is None:
                 from tidb_tpu.chunk import block_to_batch
 
@@ -1483,25 +1597,29 @@ class PhysicalExecutor:
 
         # stale-width retry: programs bake integer key bounds as static
         # widths and verify them at run time; growth past them recompiles
-        # against fresh bounds (bounded — bounds re-read each attempt)
+        # against fresh bounds. The last attempts compile conservatively
+        # (no runtime-verified assumptions) so even an assumption the
+        # data permanently violates terminates.
         for _stale_attempt in range(4):
+            conservative = _stale_attempt >= 2
             try:
                 hosted = try_host_agg(self, plan)
                 if hosted is not None:
                     return hosted
-                streamed = try_streamed(self, plan)
+                streamed = try_streamed(self, plan, conservative=conservative)
                 if streamed is not None:
                     return streamed
 
                 key = self._cache_key(plan)
-                cq = self._cache.get(key)
+                cq = None if conservative else self._cache.get(key)
                 if cq is not None:
                     self._cache.move_to_end(key)
                     REGISTRY.counter("tidb_tpu_plan_cache_hits_total").inc()
                 else:
                     REGISTRY.counter("tidb_tpu_plan_cache_misses_total").inc()
                     compiler = PlanCompiler(
-                        self.catalog, resolver=self._resolve, mesh_n=self.mesh_n
+                        self.catalog, resolver=self._resolve,
+                        mesh_n=self.mesh_n, conservative=conservative,
                     )
                     cq = compiler.compile(plan)
                     while len(self._cache) >= 256:
@@ -1517,11 +1635,23 @@ class PhysicalExecutor:
             except StaleWidthsError:
                 key = self._cache_key(plan)
                 self._cache.pop(key, None)
-                getattr(self, "_stream_plans", {}).pop(key, None)
+                sp = getattr(self, "_stream_plans", {})
+                sp.pop((key, False), None)
+                sp.pop((key, True), None)
         raise ExecError("packed key widths did not stabilize after recompiles")
 
     def _run_pinned(self, cq: CompiledQuery, pins) -> Tuple[Batch, Dicts]:
-        inputs = self._fetch_inputs(cq, mesh=self.mesh, pins=pins)
+        resolved = {}
+        inputs = self._fetch_inputs(
+            cq, mesh=self.mesh, pins=pins, resolved=resolved
+        )
+        # compile-time NULL-free assumptions: columns whose validity mask
+        # was folded away must still be NULL-free at the fetched version
+        # (host-side O(1) after the table's per-version cache warms)
+        for nid, col in cq.nonnull:
+            t, v = resolved[nid]
+            if t.col_has_nulls(col, v):
+                raise StaleWidthsError()
         shape_key = tuple(sorted((nid, b.capacity) for nid, b in inputs.items()))
 
         if cq.jitted is not None and cq.input_shape_key == shape_key:

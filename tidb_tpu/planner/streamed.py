@@ -148,10 +148,11 @@ class _StreamPlan:
     per-op dispatch at 2M rows was ~4x slower than the jitted program)."""
 
     def __init__(self, pipe_fn, dicts, site, key_fns, key_names, key_widths,
-                 partial, final):
+                 partial, final, nonnull=()):
         self.pipe_fn = pipe_fn
         self.dicts = dicts
         self.site = site
+        self.nonnull = list(nonnull)
         self.key_fns = key_fns
         self.key_names = key_names
         self.key_widths = key_widths
@@ -189,20 +190,23 @@ class _StreamPlan:
         return j
 
 
-def _stream_plan(executor, plan, agg) -> Optional[_StreamPlan]:
+def _stream_plan(executor, plan, agg, conservative=False) -> Optional[_StreamPlan]:
     from tidb_tpu.planner.physical import PlanCompiler, build_agg_parts
 
     cache = getattr(executor, "_stream_plans", None)
     if cache is None:
         cache = executor._stream_plans = {}
-    key = executor._cache_key(plan)
+    key = (executor._cache_key(plan), conservative)
     if key in cache:
         return cache[key]
     while len(cache) >= 32:
         cache.pop(next(iter(cache)))
     # compile the pre-aggregation pipeline once; its only input is the
     # scan site, fed one chunk at a time
-    comp = PlanCompiler(executor.catalog, resolver=executor._resolve)
+    comp = PlanCompiler(
+        executor.catalog, resolver=executor._resolve,
+        conservative=conservative,
+    )
     pipe_fn, dicts = comp._build(agg.child)
     entry = None
     if not comp.sized and len(comp.scans) == 1:
@@ -214,13 +218,13 @@ def _stream_plan(executor, plan, agg) -> Optional[_StreamPlan]:
             partial, final = _partial_descs(descs)
             entry = _StreamPlan(
                 pipe_fn, dicts, site, key_fns, key_names, key_widths,
-                partial, final,
+                partial, final, nonnull=comp.nonnull,
             )
     cache[key] = entry
     return entry
 
 
-def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
+def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, dict]]:
     """Execute `plan` with a streamed aggregate when it qualifies:
     single-device, lowest Aggregate over a pure scan pipeline, and the
     scanned table too large for the device. stream_rows: -1 = auto
@@ -254,7 +258,7 @@ def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
     from tidb_tpu.utils.failpoint import inject
 
     inject("executor/stream-start")
-    sp = _stream_plan(executor, plan, agg)
+    sp = _stream_plan(executor, plan, agg, conservative=conservative)
     if sp is None:
         return None
     site, key_fns, key_names, key_widths, dicts = (
@@ -268,6 +272,10 @@ def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
     else:
         return None  # snapshot churned away repeatedly: run unpaged
     try:
+        # NULL-free folding assumptions must hold at the pinned version
+        for _nid, coln in sp.nonnull:
+            if t.col_has_nulls(coln, v):
+                raise StaleWidthsError()
         # one fixed tile for every chunk: all chunks share one compiled
         # program (the last, shorter chunk pads up to the same tile)
         chunk_tile = pad_capacity(chunk_rows)
@@ -283,8 +291,10 @@ def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
                 ngi = int(jax.device_get(ng))
                 if ngi >= WIDTH_STALE:
                     raise StaleWidthsError()
-                slots = _next_pow2(max(2 * cap, 16)) if key_fns else cap
-                if key_fns and ngi > slots:
+                # overflow whenever the true group count exceeds the
+                # batch the kernel emitted (tile size differs by path:
+                # 2x cap for hash tables, 1x for dense compaction)
+                if key_fns and ngi > out.capacity:
                     cap = cap * 2  # partial table overflowed: retry bigger
                     continue
                 break
@@ -302,8 +312,7 @@ def try_streamed(executor, plan) -> Optional[Tuple[Batch, dict]]:
         ngi = int(jax.device_get(ng))
         if ngi >= WIDTH_STALE:
             raise StaleWidthsError()
-        slots = _next_pow2(max(2 * fcap, 16)) if sp.key_names else fcap
-        if sp.key_names and ngi > slots:
+        if sp.key_names and ngi > fin.capacity:
             fcap *= 2
             continue
         break

@@ -511,6 +511,41 @@ class Table:
         cache[key] = out
         return out
 
+    def col_has_nulls(self, col: str, version: Optional[int] = None) -> bool:
+        """Whether the column holds any NULL at the given version, cached
+        per (version, col). Compiled programs fold the validity mask of
+        NULL-free columns into the row mask; the executor re-checks this
+        at fetch time and recompiles when a later version gained NULLs."""
+        v = self.version if version is None else version
+        cache = getattr(self, "_nulls_cache", None)
+        if cache is None:
+            cache = self._nulls_cache = {}
+        key = (v, col)
+        if key in cache:
+            return cache[key]
+        has = False
+        for b in self.blocks(v):
+            c = b.columns.get(col)
+            if c is None:
+                has = True
+                break
+            # memoized on the immutable column object: versions share
+            # unchanged blocks, so each block's mask is walked once ever
+            cv = getattr(c, "_all_valid", None)
+            if cv is None:
+                cv = bool(c.valid.all())
+                try:
+                    c._all_valid = cv
+                except Exception:
+                    pass
+            if not cv:
+                has = True
+                break
+        if len(cache) > 64:
+            cache.clear()
+        cache[key] = has
+        return has
+
     def range_rows(self, col: str, lo, hi, version: Optional[int] = None) -> np.ndarray:
         """Row indices (concat order) with lo <= col <= hi, NULLs
         excluded. O(log n) searchsorted over the sorted index."""
