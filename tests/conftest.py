@@ -32,3 +32,22 @@ try:
             _xb._backend_factories.pop(_name, None)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: scale-tier tests (SF0.1+ TPC-H parity, forced-spill runs); "
+        "skipped unless RUN_SLOW=1 or -m slow",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    if os.environ.get("RUN_SLOW") == "1" or "slow" in config.getoption("-m", ""):
+        return
+    skip = _pytest.mark.skip(reason="scale tier: set RUN_SLOW=1 or -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

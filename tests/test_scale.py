@@ -1,0 +1,96 @@
+"""Scale-tier (@slow) runs: TPC-H at SF0.1+ with quotas small enough
+that the streamed (spill-analog) paths actually engage, parity-checked
+against vectorized numpy oracles.
+
+Reference: realtikvtest runs SF-sized workloads; VERDICT round-2 item #9
+(scale-tier tests) and #3 (sort/join spill parity: Q18 under a memory
+budget that forces staging).
+
+Run with RUN_SLOW=1 python -m pytest tests/test_scale.py -q
+(SF via TIDB_TPU_SCALE_SF, default 1.0 for the Q18 budget test).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils import failpoint
+
+SF = float(os.environ.get("TIDB_TPU_SCALE_SF", "1"))
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def sess():
+    cat = Catalog()
+    load_tpch(cat, sf=SF, seed=7, tables=["orders", "lineitem"])
+    s = Session(cat, db="tpch")
+    for t in ("orders", "lineitem"):
+        s.execute(f"analyze table {t}")
+    yield s
+    failpoint.disable_all()
+
+
+def _li_cols(sess, *names):
+    t = sess.catalog.table("tpch", "lineitem")
+    out = {n: np.concatenate([b.columns[n].data for b in t.blocks()]) for n in names}
+    return out
+
+
+def test_q18_forced_staging_parity(sess):
+    """Q18 (join + 1.5M-group agg + TopN) with a chunk budget that forces
+    the big scan through the streamed join+agg path; results must match
+    both the unpaged run and a numpy oracle."""
+    q = (
+        "select o_orderkey, sum(l_quantity) q from lineitem, orders "
+        "where o_orderkey = l_orderkey "
+        "group by o_orderkey having sum(l_quantity) > 250 "
+        "order by q desc, o_orderkey limit 100"
+    )
+    sess.execute("set tidb_tpu_stream_rows = 0")
+    full = sess.must_query(q).rows
+
+    hits = []
+    failpoint.enable("executor/stream-chunk", lambda: hits.append(1))
+    try:
+        # ~8 chunks at SF1
+        sess.execute(f"set tidb_tpu_stream_rows = {max(int(SF * 750_000), 10_000)}")
+        staged = sess.must_query(q).rows
+    finally:
+        failpoint.disable("executor/stream-chunk")
+        sess.execute("set tidb_tpu_stream_rows = 0")
+    assert len(hits) > 1, "expected the streamed path to chunk the scan"
+    assert staged == full
+
+    # numpy oracle
+    li = _li_cols(sess, "l_orderkey", "l_quantity")
+    ok = sess.catalog.table("tpch", "orders")
+    okeys = np.concatenate([b.columns["o_orderkey"].data for b in ok.blocks()])
+    sums = np.bincount(li["l_orderkey"], li["l_quantity"])
+    present = np.zeros(max(len(sums), int(okeys.max()) + 1), dtype=bool)
+    present[okeys] = True
+    keys = np.nonzero((sums > 25000) & present[: len(sums)])[0]
+    pairs = sorted(
+        [(int(k), sums[k] / 100.0) for k in keys], key=lambda p: (-p[1], p[0])
+    )[:100]
+    got = [(int(a), float(b)) for a, b in staged]
+    assert got == pairs
+
+
+def test_q1_streamed_parity(sess):
+    q = (
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
+        "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+    )
+    sess.execute("set tidb_tpu_stream_rows = 0")
+    full = sess.must_query(q).rows
+    sess.execute(f"set tidb_tpu_stream_rows = {max(int(SF * 600_000), 10_000)}")
+    staged = sess.must_query(q).rows
+    sess.execute("set tidb_tpu_stream_rows = 0")
+    assert staged == full

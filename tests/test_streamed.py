@@ -94,3 +94,93 @@ def test_streamed_distinct_agg(sess):
     streamed = sess.must_query(q).rows
     _set_stream(sess, 2_000_000)
     assert full == streamed
+
+
+def test_streamed_join_pipeline(sess):
+    """Round-3: the streamed pipeline may contain joins — the big scan
+    chunks through the join against a device-resident build side
+    (reference: spillable hash join, join/hash_table.go row container)."""
+    q = (
+        "select o_orderkey, sum(l_quantity) q from lineitem, orders "
+        "where o_orderkey = l_orderkey group by o_orderkey "
+        "having sum(l_quantity) > 100 order by q desc, o_orderkey limit 7"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    hits = []
+    failpoint.enable("executor/stream-chunk", lambda: hits.append(1))
+    try:
+        _set_stream(sess, 7000)
+        streamed = sess.must_query(q).rows
+    finally:
+        failpoint.disable("executor/stream-chunk")
+        _set_stream(sess, 2_000_000)
+    assert len(hits) > 1, "expected multiple chunks through the join"
+    assert full == streamed
+
+
+def test_streamed_left_join_scalar(sess):
+    q = (
+        "select count(*), sum(l_quantity) from lineitem "
+        "left join orders on o_orderkey = l_orderkey"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 7000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert full == streamed
+
+
+def test_streamed_semi_join_probe_chunked(sess):
+    """Semi joins chunk only the probe side: per-chunk membership tests
+    against the full build set stay exact."""
+    q = (
+        "select l_returnflag, count(*) from lineitem "
+        "where l_orderkey in (select o_orderkey from orders "
+        "where o_orderdate >= date '1995-01-01') "
+        "group by l_returnflag order by l_returnflag"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 7000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert full == streamed
+
+
+def test_streamed_full_order_by(sess):
+    """Out-of-HBM full ORDER BY: chunked device pipeline + host-staged
+    merge (reference: sortexec disk-spill partitions + merge)."""
+    q = (
+        "select l_orderkey, l_extendedprice from lineitem, orders "
+        "where o_orderkey = l_orderkey "
+        "order by l_extendedprice desc, l_orderkey"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    hits = []
+    failpoint.enable("executor/stream-sort", lambda: hits.append(1))
+    try:
+        _set_stream(sess, 7000)
+        streamed = sess.must_query(q).rows
+    finally:
+        failpoint.disable("executor/stream-sort")
+        _set_stream(sess, 2_000_000)
+    assert hits, "expected the streamed sort path"
+    assert streamed == full
+
+
+def test_streamed_order_by_null_keys(sess):
+    """NULL ordering through the host merge (NULLs first asc, last
+    desc), exercised with an expression key that can be NULL."""
+    q = (
+        "select l_orderkey, nullif(l_linenumber, 3) k from lineitem "
+        "order by k desc, l_orderkey"
+    )
+    _set_stream(sess, 2_000_000)
+    full = sess.must_query(q).rows
+    _set_stream(sess, 7000)
+    streamed = sess.must_query(q).rows
+    _set_stream(sess, 2_000_000)
+    assert streamed == full

@@ -1636,8 +1636,8 @@ class PhysicalExecutor:
                 key = self._cache_key(plan)
                 self._cache.pop(key, None)
                 sp = getattr(self, "_stream_plans", {})
-                sp.pop((key, False), None)
-                sp.pop((key, True), None)
+                for k in [k for k in sp if k[0] == key]:
+                    sp.pop(k, None)
         raise ExecError("packed key widths did not stabilize after recompiles")
 
     def _run_pinned(self, cq: CompiledQuery, pins) -> Tuple[Batch, Dicts]:

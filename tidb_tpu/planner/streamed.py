@@ -42,10 +42,38 @@ from tidb_tpu.planner import logical as L
 _STAGED_NONCE = [0]
 
 
-def _pipeline_below(plan) -> Optional[Tuple[L.Aggregate, list]]:
-    """Find the lowest Aggregate whose input subtree is a pure
-    scan pipeline (Scan with optional Selection/Projection on top).
-    Returns (agg_node, [nodes from agg child down to scan]) or None."""
+def _collect_pipeline_scans(p, scans, flags, chunkable=True) -> bool:
+    """Walk a streaming pipeline (Selection/Projection chains over Scans
+    composed with equi-joins) collecting (scan, chunkable) pairs.
+
+    A scan is CHUNKABLE when splitting it into row chunks and unioning
+    the per-chunk pipeline outputs equals running the whole pipeline:
+    either side of an inner join distributes over row-union, but only
+    the probe (left) side of left/semi/anti/mark joins does — chunking
+    the build side would change unmatched-row semantics per chunk.
+    Returns False when the subtree contains anything else (the shape
+    doesn't stream)."""
+    while isinstance(p, (L.Selection, L.Projection)):
+        p = p.child
+    if isinstance(p, L.Scan):
+        scans.append(p)
+        flags.append(chunkable)
+        return True
+    if isinstance(p, L.JoinPlan):
+        if p.kind == "inner":
+            return _collect_pipeline_scans(
+                p.left, scans, flags, chunkable
+            ) and _collect_pipeline_scans(p.right, scans, flags, chunkable)
+        if p.kind in ("left", "semi", "anti", "mark"):
+            return _collect_pipeline_scans(
+                p.left, scans, flags, chunkable
+            ) and _collect_pipeline_scans(p.right, scans, flags, False)
+    return False
+
+
+def _pipeline_below(plan) -> Optional[Tuple[L.Aggregate, list, list]]:
+    """Find the lowest Aggregate whose input subtree is a streaming
+    pipeline. Returns (agg_node, scans, chunkable_flags) or None."""
     found = None
 
     def walk(p):
@@ -53,17 +81,81 @@ def _pipeline_below(plan) -> Optional[Tuple[L.Aggregate, list]]:
         for c in _children(p):
             walk(c)
         if found is None and isinstance(p, L.Aggregate):
-            chain = []
-            cur = p.child
-            while isinstance(cur, (L.Selection, L.Projection)):
-                chain.append(cur)
-                cur = cur.child
-            if isinstance(cur, L.Scan):
-                chain.append(cur)
-                found = (p, chain)
+            scans, flags = [], []
+            if _collect_pipeline_scans(p.child, scans, flags) and scans:
+                found = (p, scans, flags)
 
     walk(plan)
     return found
+
+
+def _pick_big_scan(executor, scans, flags):
+    """(index, (table, version) list) of the largest chunkable scan."""
+    resolved = [executor._resolve(s.db, s.table) for s in scans]
+    big_i = None
+    for i, ok in enumerate(flags):
+        if ok and (
+            big_i is None or resolved[i][0].nrows > resolved[big_i][0].nrows
+        ):
+            big_i = i
+    return big_i, resolved
+
+
+def _stream_sizing(executor, scans, resolved, big_i, threshold):
+    """(chunk_rows, should_stream): budget math shared by the agg and
+    sort streaming paths. Auto mode streams when the whole working set
+    (big scan + resident sides, ~4x for intermediates) overruns the
+    device budget, and sizes chunks from the budget REMAINING after the
+    resident sides. Explicit thresholds chunk at that row count."""
+    t, v = resolved[big_i]
+    big = scans[big_i]
+    budget = _device_budget()
+    rb = _row_bytes(t, v, big.columns)
+    others_bytes = sum(
+        ot.nrows * _row_bytes(ot, ov, s.columns)
+        for i, (s, (ot, ov)) in enumerate(zip(scans, resolved))
+        if i != big_i
+    )
+    if others_bytes * 4 > budget:
+        return None, False  # resident join sides don't fit: run unpaged
+    if threshold == -1:
+        if (t.nrows * rb + others_bytes) * 4 <= budget:
+            return None, False
+        avail = max(budget - 4 * others_bytes, budget // 8)
+        chunk_rows = max(1 << 16, min(1 << 24, _pow2_floor(avail // (4 * rb))))
+    else:
+        if t.nrows <= threshold:
+            return None, False
+        chunk_rows = max(int(threshold), 1)
+    return chunk_rows, True
+
+
+def _fetch_resident(executor, site, st, sv):
+    """One resident (non-chunked) site's device batch, honoring PK-range
+    pushdown like PhysicalExecutor._fetch_inputs."""
+    from tidb_tpu.storage import scan_table
+
+    if site.pk_range is not None:
+        col, lo, hi = site.pk_range
+        idx = st.range_rows(col, lo, hi, version=sv)
+        return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
+    batch, _d = scan_table(st, site.columns, version=sv)
+    return batch
+
+
+def _expr_column_refs(e, out) -> None:
+    """Collect ColumnRef names from an expression tree."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    if isinstance(e, ColumnRef):
+        out.add(e.name)
+        return
+    if dataclasses.is_dataclass(e):
+        for f in dataclasses.fields(e):
+            val = getattr(e, f.name)
+            for item in val if isinstance(val, (list, tuple)) else [val]:
+                if dataclasses.is_dataclass(item):
+                    _expr_column_refs(item, out)
 
 
 def _children(p):
@@ -142,16 +234,19 @@ def _pow2_floor(n: int) -> int:
 
 class _StreamPlan:
     """Cached compiled artifacts for one streamed plan: the pre-agg
-    pipeline + agg descriptors, and jitted chunk/final programs keyed by
-    (capacity, tile) so repeated executes and same-shape chunks reuse one
-    XLA compilation (the first cut re-built and ran everything eagerly —
-    per-op dispatch at 2M rows was ~4x slower than the jitted program)."""
+    pipeline (which may contain joins: the big scan streams through in
+    chunks while the other scans' batches stay device-resident) + agg
+    descriptors, and jitted chunk/final programs keyed by the capacity
+    vector so repeated executes and same-shape chunks reuse one XLA
+    compilation."""
 
-    def __init__(self, pipe_fn, dicts, site, key_fns, key_names, key_widths,
-                 partial, final, nonnull=()):
+    def __init__(self, pipe_fn, dicts, big_site, other_sites, sized,
+                 key_fns, key_names, key_widths, partial, final, nonnull=()):
         self.pipe_fn = pipe_fn
         self.dicts = dicts
-        self.site = site
+        self.big_site = big_site
+        self.other_sites = other_sites
+        self.sized = list(sized)  # pipeline capacity-knob node ids (joins)
         self.nonnull = list(nonnull)
         self.key_fns = key_fns
         self.key_names = key_names
@@ -159,18 +254,23 @@ class _StreamPlan:
         self.partial = partial
         self.final = final
         self.jits = {}
+        self.caps = None  # sticky discovered pipeline capacities
 
-    def chunk_step(self, cap: int):
-        j = self.jits.get(("partial", cap))
+    def chunk_step(self, cap: int, caps: dict):
+        key = ("partial", cap, tuple(sorted(caps.items())))
+        j = self.jits.get(key)
         if j is None:
-            def step(chunk, _cap=cap):
-                piped, _needs = self.pipe_fn({self.site.node_id: chunk}, {})
-                return group_aggregate(
+            frozen = dict(caps)
+
+            def step(inputs, _cap=cap, _caps=frozen):
+                piped, needs = self.pipe_fn(inputs, _caps)
+                out, ng = group_aggregate(
                     piped, self.key_fns, self.partial, _cap, self.key_names,
                     key_widths=self.key_widths,
                 )
+                return out, ng, needs
 
-            j = self.jits[("partial", cap)] = jax.jit(step)
+            j = self.jits[key] = jax.jit(step)
         return j
 
     def final_step(self, fcap: int):
@@ -190,34 +290,50 @@ class _StreamPlan:
         return j
 
 
-def _stream_plan(executor, plan, agg, conservative=False) -> Optional[_StreamPlan]:
+def _stream_plan(executor, plan, agg, big_scan, conservative=False):
     from tidb_tpu.planner.physical import PlanCompiler, build_agg_parts
 
     cache = getattr(executor, "_stream_plans", None)
     if cache is None:
         cache = executor._stream_plans = {}
-    key = (executor._cache_key(plan), conservative)
+    # the big-scan identity is part of the key: table growth can flip
+    # which scan streams, and a stale entry would pin/load the wrong one
+    key = (
+        executor._cache_key(plan),
+        (big_scan.db, big_scan.table, big_scan.alias),
+        conservative,
+    )
     if key in cache:
         return cache[key]
     while len(cache) >= 32:
         cache.pop(next(iter(cache)))
-    # compile the pre-aggregation pipeline once; its only input is the
-    # scan site, fed one chunk at a time
+    # compile the pre-aggregation pipeline once; the big scan's site is
+    # fed one chunk at a time, every other site its full batch
     comp = PlanCompiler(
         executor.catalog, resolver=executor._resolve,
         conservative=conservative,
     )
     pipe_fn, dicts = comp._build(agg.child)
     entry = None
-    if not comp.sized and len(comp.scans) == 1:
-        site = comp.scans[0]
+    big_site = next(
+        (
+            s
+            for s in comp.scans
+            if (s.db, s.table, s.alias)
+            == (big_scan.db, big_scan.table, big_scan.alias)
+        ),
+        None,
+    )
+    if big_site is not None and big_site.pk_range is None:
+        others = [s for s in comp.scans if s is not big_site]
         key_fns, key_names, key_widths, descs = build_agg_parts(agg, dicts)
         if not any(a.distinct for a in descs):
             # DISTINCT can't be split into partial sums across chunks
             # (dedup must see all rows of a group at once): run unpaged
             partial, final = _partial_descs(descs)
             entry = _StreamPlan(
-                pipe_fn, dicts, site, key_fns, key_names, key_widths,
+                pipe_fn, dicts, big_site, others, comp.sized,
+                key_fns, key_names, key_widths,
                 partial, final, nonnull=comp.nonnull,
             )
     cache[key] = entry
@@ -226,71 +342,105 @@ def _stream_plan(executor, plan, agg, conservative=False) -> Optional[_StreamPla
 
 def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, dict]]:
     """Execute `plan` with a streamed aggregate when it qualifies:
-    single-device, lowest Aggregate over a pure scan pipeline, and the
-    scanned table too large for the device. stream_rows: -1 = auto
-    (stream when the scan working set overruns the device memory
-    budget), >0 = explicit row threshold, 0/None = never stream."""
+    single-device, lowest Aggregate over a streaming pipeline
+    (Selection/Projection chains + equi-joins over scans), with the
+    largest chunkable table too big for the device. The big scan streams
+    through the whole pipeline (including joins against the resident
+    small sides) chunk by chunk — the TPU analog of the reference's
+    spill-to-disk join/agg executors. stream_rows: -1 = auto (stream
+    when the working set overruns the device memory budget), >0 =
+    explicit row threshold, 0/None = never stream."""
     threshold = getattr(executor, "stream_rows", None)
     if not threshold or executor.mesh is not None:
         return None
     m = _pipeline_below(plan)
     if m is None:
         return None
-    agg, chain = m
-    scan = chain[-1]
-    t, v = executor._resolve(scan.db, scan.table)
-    if threshold == -1:
-        rb = _row_bytes(t, v, scan.columns)
-        budget = _device_budget()
-        # ~4x the raw scan: filter/projection intermediates + the
-        # double-buffered copy during compaction
-        if t.nrows * rb * 4 <= budget:
-            return None
-        # budget-derived chunk size; the floor is small enough never to
-        # override the budget for any plausible row width
-        chunk_rows = max(1 << 16, min(1 << 24, _pow2_floor(budget // (4 * rb))))
-    else:
-        if t.nrows <= threshold:
-            return None
-        chunk_rows = max(int(threshold), 1)
+    agg, scans, flags = m
+
+    # the streamed scan: largest chunkable table
+    big_i, resolved = _pick_big_scan(executor, scans, flags)
+    if big_i is None:
+        return None
+    big_scan = scans[big_i]
+    t, v = resolved[big_i]
+    chunk_rows, should = _stream_sizing(
+        executor, scans, resolved, big_i, threshold
+    )
+    if not should:
+        return None
 
     from tidb_tpu.planner.physical import StaleWidthsError, agg_out_dicts
     from tidb_tpu.utils.failpoint import inject
 
     inject("executor/stream-start")
-    sp = _stream_plan(executor, plan, agg, conservative=conservative)
+    sp = _stream_plan(executor, plan, agg, big_scan, conservative=conservative)
     if sp is None:
         return None
-    site, key_fns, key_names, key_widths, dicts = (
-        sp.site, sp.key_fns, sp.key_names, sp.key_widths, sp.dicts
+    key_fns, key_names, key_widths, dicts = (
+        sp.key_fns, sp.key_names, sp.key_widths, sp.dicts
     )
 
-    for _ in range(8):
-        if t.pin_verified(v):
-            break
-        t, v = executor._resolve(scan.db, scan.table)
-    else:
-        return None  # snapshot churned away repeatedly: run unpaged
+    # pin one snapshot of every scanned table for the whole statement
+    pins = []
     try:
-        # NULL-free folding assumptions must hold at the pinned version
-        for _nid, coln in sp.nonnull:
-            if t.col_has_nulls(coln, v):
+        site_tables = {}
+        for s in [sp.big_site] + sp.other_sites:
+            st, sv = executor._resolve(s.db, s.table)
+            for _ in range(8):
+                if st.pin_verified(sv):
+                    break
+                st, sv = executor._resolve(s.db, s.table)
+            else:
+                return None  # snapshot churned away repeatedly: unpaged
+            pins.append((st, sv))
+            site_tables[s.node_id] = (st, sv)
+        t, v = site_tables[sp.big_site.node_id]
+        # NULL-free folding assumptions must hold at the pinned versions
+        for nid, coln in sp.nonnull:
+            st, sv = site_tables.get(nid, (None, None))
+            if st is not None and st.col_has_nulls(coln, sv):
                 raise StaleWidthsError()
+        # resident small-side batches, fetched once (device-cached)
+        inputs_base = {}
+        for s in sp.other_sites:
+            st, sv = site_tables[s.node_id]
+            inputs_base[s.node_id] = _fetch_resident(executor, s, st, sv)
+
         # one fixed tile for every chunk: all chunks share one compiled
         # program (the last, shorter chunk pads up to the same tile)
         chunk_tile = pad_capacity(chunk_rows)
         cap = 1024
+        # pipeline capacity knobs (join output tiles): start at the
+        # chunk tile (or the previous execute's discovered vector), grow
+        # on per-chunk overflow like the discovery loop
+        caps = dict(sp.caps) if sp.caps else {
+            nid: chunk_tile for nid in sp.sized
+        }
         partial_batches: List[Batch] = []
-        for hb in _chunk_blocks(t, v, site.columns, chunk_rows):
+        for hb in _chunk_blocks(t, v, sp.big_site.columns, chunk_rows):
             inject("executor/stream-chunk")
             if executor.kill_check is not None:
                 executor.kill_check()
             chunk = block_to_batch(hb, capacity=chunk_tile)
-            while True:
-                out, ng = sp.chunk_step(cap)(chunk)
-                ngi = int(jax.device_get(ng))
+            inputs = dict(inputs_base)
+            inputs[sp.big_site.node_id] = chunk
+            for _retry in range(24):
+                out, ng, needs = sp.chunk_step(cap, caps)(inputs)
+                got = jax.device_get((ng, needs))
+                ngi = int(got[0])
                 if ngi >= WIDTH_STALE:
                     raise StaleWidthsError()
+                bumped = False
+                for nid, n in got[1].items():
+                    n = int(n)
+                    if n >= WIDTH_STALE:
+                        raise StaleWidthsError()
+                    if nid in caps and n > caps[nid]:
+                        caps[nid] = pad_capacity(n, floor=16, pow2=True)
+                        bumped = True
+                if bumped:
+                    continue
                 # overflow whenever the true group count exceeds the
                 # batch the kernel emitted (tile size differs by path:
                 # 2x cap for hash tables, 1x for dense compaction)
@@ -298,9 +448,13 @@ def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, di
                     cap = cap * 2  # partial table overflowed: retry bigger
                     continue
                 break
+            else:
+                raise StaleWidthsError()  # capacities never converged
             partial_batches.append(out)
+        sp.caps = dict(caps)  # discovered capacities stick for reuse
     finally:
-        t.unpin(v)
+        for pt, pv in pins:
+            pt.unpin(pv)
 
     combined = _concat_batches(partial_batches)
 
@@ -354,6 +508,247 @@ def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, di
     else:
         new_plan = _replace_node(plan, agg, staged)
     return executor.run(new_plan)
+
+
+class _SortStreamPlan:
+    """Cached compiled artifacts for one streamed full ORDER BY: the
+    chunked pipeline, its sort-key expressions, and jitted chunk
+    programs — repeated executes reuse one XLA compilation, and the
+    discovered capacity vector sticks across executes."""
+
+    def __init__(self, pipe_fn, dicts, big_site, other_sites, sized,
+                 key_fns, nonnull):
+        self.pipe_fn = pipe_fn
+        self.dicts = dicts
+        self.big_site = big_site
+        self.other_sites = other_sites
+        self.sized = list(sized)
+        self.key_fns = key_fns
+        self.nonnull = list(nonnull)
+        self.jits = {}
+        self.caps = None
+
+
+def _sort_stream_plan(executor, plan, sort, big_scan, conservative=False):
+    from tidb_tpu.expression import compile_expr
+    from tidb_tpu.planner.physical import PlanCompiler
+
+    cache = getattr(executor, "_stream_plans", None)
+    if cache is None:
+        cache = executor._stream_plans = {}
+    key = (
+        executor._cache_key(plan),
+        ("sort", big_scan.db, big_scan.table, big_scan.alias),
+        conservative,
+    )
+    if key in cache:
+        return cache[key]
+    while len(cache) >= 32:
+        cache.pop(next(iter(cache)))
+    entry = None
+    # compile the whole plan MINUS the Sort: projections above it apply
+    # per chunk; the host merge only reorders rows. Sort keys must still
+    # be computable on that pipeline's output — a pruning projection
+    # above the Sort may have dropped a hidden ORDER BY column, in which
+    # case this path declines (the in-device path still handles it).
+    inner_plan = _replace_node(plan, sort, sort.child)
+    schema_names = {c.internal for c in inner_plan.schema}
+    refs = set()
+    for e, _d in sort.keys:
+        _expr_column_refs(e, refs)
+    if refs <= schema_names:
+        comp = PlanCompiler(
+            executor.catalog, resolver=executor._resolve,
+            conservative=conservative,
+        )
+        pipe_fn, dicts = comp._build(inner_plan)
+        big_site = next(
+            (
+                s
+                for s in comp.scans
+                if (s.db, s.table, s.alias)
+                == (big_scan.db, big_scan.table, big_scan.alias)
+            ),
+            None,
+        )
+        if big_site is not None and big_site.pk_range is None:
+            key_fns = [compile_expr(e, dicts) for e, _ in sort.keys]
+            entry = _SortStreamPlan(
+                pipe_fn, dicts, big_site,
+                [s for s in comp.scans if s is not big_site],
+                comp.sized, key_fns, comp.nonnull,
+            )
+    cache[key] = entry
+    return entry
+
+
+def try_streamed_sort(executor, plan, conservative=False):
+    """Out-of-HBM full ORDER BY: when the ROOT of a plan is a Sort (with
+    optional Projections above) over a streaming pipeline whose big scan
+    exceeds the device budget, the pipeline runs chunk-by-chunk on
+    device, each chunk's (pre-sorted) key+payload columns stage to host
+    RAM, and the host merges the sorted runs into the final row order.
+    Returns (column internal names, ordered numpy column dict, row
+    count) or None. Reference: sortexec's disk-spill partitions + merge
+    (pkg/executor/sortexec/sort_partition.go) — here HBM is the scarce
+    buffer and host RAM the staging medium.
+
+    LIMIT shapes never reach this path (the packed top-k keeps them
+    in-device); this is for full-result sorts whose OUTPUT itself
+    exceeds device memory, so rows are delivered host-side."""
+    threshold = getattr(executor, "stream_rows", None)
+    if not threshold or executor.mesh is not None:
+        return None
+    # peel Projections above the root Sort; the peeled projections apply
+    # per chunk (inner_plan below), so sort keys referencing columns THEY
+    # prune are checked against the pipeline schema before engaging
+    node = plan
+    while isinstance(node, L.Projection):
+        node = node.child
+    if not isinstance(node, L.Sort):
+        return None
+    sort = node
+    scans, flags = [], []
+    if not _collect_pipeline_scans(sort.child, scans, flags) or not scans:
+        return None
+    big_i, resolved = _pick_big_scan(executor, scans, flags)
+    if big_i is None:
+        return None
+    big_scan = scans[big_i]
+    chunk_rows, should = _stream_sizing(
+        executor, scans, resolved, big_i, threshold
+    )
+    if not should:
+        return None
+
+    from tidb_tpu.planner.physical import StaleWidthsError
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("executor/stream-sort")
+    sp = _sort_stream_plan(
+        executor, plan, sort, big_scan, conservative=conservative
+    )
+    if sp is None:
+        return None
+    big_site = sp.big_site
+    key_descs = [d for _, d in sort.keys]
+    out_names = [c.internal for c in plan.schema]
+
+    pins = []
+    try:
+        site_tables = {}
+        for s in [sp.big_site] + sp.other_sites:
+            st, sv = executor._resolve(s.db, s.table)
+            for _ in range(8):
+                if st.pin_verified(sv):
+                    break
+                st, sv = executor._resolve(s.db, s.table)
+            else:
+                return None
+            pins.append((st, sv))
+            site_tables[s.node_id] = (st, sv)
+        t, v = site_tables[big_site.node_id]
+        for nid, coln in sp.nonnull:
+            st, sv = site_tables.get(nid, (None, None))
+            if st is not None and st.col_has_nulls(coln, sv):
+                raise StaleWidthsError()
+        inputs_base = {}
+        for s in sp.other_sites:
+            st, sv = site_tables[s.node_id]
+            inputs_base[s.node_id] = _fetch_resident(executor, s, st, sv)
+
+        chunk_tile = pad_capacity(chunk_rows)
+        caps = dict(sp.caps) if sp.caps else {
+            nid: chunk_tile for nid in sp.sized
+        }
+        host_runs = []  # per chunk: (row mask, key arrays, col arrays)
+
+        def step_for(caps_t):
+            j = sp.jits.get(caps_t)
+            if j is None:
+                frozen = dict(caps)
+
+                def step(inputs, _caps=frozen):
+                    b, needs = sp.pipe_fn(inputs, _caps)
+                    keys = [f(b) for f in sp.key_fns]
+                    return b, keys, needs
+
+                j = sp.jits[caps_t] = jax.jit(step)
+            return j
+
+        for hb in _chunk_blocks(t, v, big_site.columns, chunk_rows):
+            inject("executor/stream-chunk")
+            if executor.kill_check is not None:
+                executor.kill_check()
+            chunk = block_to_batch(hb, capacity=chunk_tile)
+            inputs = dict(inputs_base)
+            inputs[big_site.node_id] = chunk
+            for _retry in range(24):
+                b, keys, needs = step_for(tuple(sorted(caps.items())))(inputs)
+                needs_host = jax.device_get(needs)
+                bumped = False
+                for nid, n in needs_host.items():
+                    n = int(n)
+                    if n >= WIDTH_STALE:
+                        raise StaleWidthsError()
+                    if nid in caps and n > caps[nid]:
+                        caps[nid] = pad_capacity(n, floor=16, pow2=True)
+                        bumped = True
+                if not bumped:
+                    break
+            else:
+                raise StaleWidthsError()
+            # stage this chunk's valid rows to host RAM
+            rv, kd, cd = jax.device_get(
+                (
+                    b.row_valid,
+                    [(k.data, k.valid) for k in keys],
+                    {
+                        n: (b.cols[n].data, b.cols[n].valid)
+                        for n in out_names
+                    },
+                )
+            )
+            host_runs.append((rv, kd, cd))
+        sp.caps = dict(caps)  # discovered capacities stick for reuse
+    finally:
+        for pt, pv in pins:
+            pt.unpin(pv)
+
+    # host merge: stable lexsort over the staged runs (numpy's C sort —
+    # the "disk merge" analog with host RAM as the spill medium)
+    mask = np.concatenate([r[0] for r in host_runs])
+    sort_cols = []
+    for ki in range(len(sp.key_fns)):
+        kdat = np.concatenate([r[1][ki][0] for r in host_runs])[mask]
+        kval = np.concatenate([r[1][ki][1] for r in host_runs])[mask]
+        sort_cols.append((kdat, kval))
+    order = np.arange(int(mask.sum()))
+    # np.lexsort sorts by its LAST array first: build
+    # [val_kN, rank_kN, ..., val_k0, rank_k0] so key 0's NULL-rank is
+    # most significant, then key 0's value, then key 1... Each key gets
+    # an explicit NULL-rank array (MySQL: NULLs first asc, last desc) —
+    # no in-band sentinel values that could collide with real data.
+    lex = []
+    for (kdat, kval), desc in zip(sort_cols, key_descs):
+        if desc:
+            rank = np.where(kval, 0, 1)  # NULLs last
+            val = -kdat.astype(np.float64) if np.issubdtype(
+                kdat.dtype, np.floating
+            ) else -kdat.astype(np.int64)
+        else:
+            rank = np.where(kval, 1, 0)  # NULLs first
+            val = kdat
+        val = np.where(kval, val, 0)
+        lex = [val, rank] + lex
+    if lex:
+        order = np.lexsort(lex)
+    cols = {}
+    for n in out_names:
+        dat = np.concatenate([r[2][n][0] for r in host_runs])[mask][order]
+        val = np.concatenate([r[2][n][1] for r in host_runs])[mask][order]
+        cols[n] = (dat, val)
+    return out_names, cols, int(mask.sum()), sp.dicts
 
 
 def _concat_batches(batches: List[Batch]) -> Batch:

@@ -1360,6 +1360,9 @@ class Session:
             with self.tracer.span("session.plan"):
                 plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
             with self.tracer.span("executor.run"):
+                hs = self._try_host_sorted(plan)
+                if hs is not None:
+                    return hs
                 batch, dicts = self.executor.run(plan)
             with self.tracer.span("session.materialize"):
                 rows = materialize_rows(batch, list(plan.schema), dicts)
@@ -1367,6 +1370,39 @@ class Session:
             return Result(names, rows, types=[c.type for c in plan.schema])
         finally:
             self.executor.stream_rows = old_stream
+
+    def _try_host_sorted(self, plan):
+        """Out-of-HBM full ORDER BY (planner/streamed.try_streamed_sort):
+        the device pipeline stages sorted-run columns to host RAM and the
+        final row order materializes host-side, so the result never needs
+        to fit device memory. Returns a Result or None."""
+        from tidb_tpu.chunk import HostColumn
+        from tidb_tpu.planner.physical import StaleWidthsError
+        from tidb_tpu.planner.streamed import try_streamed_sort
+
+        hs = None
+        try:
+            hs = try_streamed_sort(self.executor, plan)
+        except StaleWidthsError:
+            try:
+                hs = try_streamed_sort(self.executor, plan, conservative=True)
+            except StaleWidthsError:
+                hs = None
+        if hs is None:
+            return None
+        names_int, cols, _n, sdicts = hs
+        types = {c.internal: c.type for c in plan.schema}
+        decoded = {
+            n: HostColumn(
+                types[n], cols[n][0], cols[n][1], sdicts.get(n)
+            ).decode()
+            for n in names_int
+        }
+        rows = [
+            tuple(decoded[n][r] for n in names_int) for r in range(_n)
+        ]
+        names = [c.name for c in plan.schema]
+        return Result(names, rows, types=[c.type for c in plan.schema])
 
     # ------------------------------------------------------------------
     # -- CHECK / FOREIGN KEY enforcement -------------------------------
