@@ -87,12 +87,19 @@ class TestTxn:
         assert s.must_query("select count(*) from t").rows == [(4,)]
 
     def test_write_conflict(self, s):
-        s.execute("begin")
-        s.execute("insert into t values (5, 'c')")
-        s2 = Session(s.catalog)
-        s2.execute("insert into t values (6, 'd')")
-        with pytest.raises(RuntimeError, match="conflict"):
-            s.execute("commit")
+        # optimistic mode: first committer wins, second aborts (the
+        # pessimistic default would make s2 BLOCK on s's table lock)
+        s.execute("set tidb_txn_mode = 'optimistic'")
+        try:
+            s.execute("begin")
+            s.execute("insert into t values (5, 'c')")
+            s2 = Session(s.catalog)
+            s2.execute("set tidb_txn_mode = 'optimistic'")
+            s2.execute("insert into t values (6, 'd')")
+            with pytest.raises(RuntimeError, match="conflict"):
+                s.execute("commit")
+        finally:
+            s.execute("set tidb_txn_mode = 'pessimistic'")
 
 
 class TestFailpoint:

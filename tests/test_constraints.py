@@ -255,17 +255,24 @@ class TestSavepoint:
     def test_rollback_to_keeps_conflict_baseline(self, sess):
         # a shadow rebuilt after ROLLBACK TO SAVEPOINT must still
         # conflict with commits that landed since the txn's first touch
+        # (optimistic mode: under the pessimistic default the other
+        # session would block on the table lock instead)
         sess.execute("create table t (a int)")
-        sess.execute("begin")
-        sess.execute("savepoint s1")
-        sess.execute("insert into t values (1)")
+        sess.execute("set tidb_txn_mode = 'optimistic'")
         other = Session(sess.catalog)
-        other.execute("insert into t values (99)")  # concurrent commit
-        sess.execute("rollback to s1")
-        sess.execute("insert into t values (2)")  # shadow rebuilt
-        with pytest.raises(RuntimeError, match="write conflict"):
-            sess.execute("commit")
-        assert other.execute("select a from t").rows == [(99,)]
+        other.execute("set tidb_txn_mode = 'optimistic'")
+        try:
+            sess.execute("begin")
+            sess.execute("savepoint s1")
+            sess.execute("insert into t values (1)")
+            other.execute("insert into t values (99)")  # concurrent commit
+            sess.execute("rollback to s1")
+            sess.execute("insert into t values (2)")  # shadow rebuilt
+            with pytest.raises(RuntimeError, match="write conflict"):
+                sess.execute("commit")
+            assert other.execute("select a from t").rows == [(99,)]
+        finally:
+            sess.execute("set tidb_txn_mode = 'pessimistic'")
 
     def test_redeclare_moves(self, sess):
         sess.execute("create table t (a int)")

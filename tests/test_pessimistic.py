@@ -1,0 +1,151 @@
+"""Pessimistic transactions: blocking table locks, SELECT ... FOR
+UPDATE, deadlock detection.
+
+Reference: the pessimistic txn path takes locks per DML statement and
+blocks conflicting writers (pkg/session/txn.go:50, LockKeys in
+pkg/store/driver/txn/txn_driver.go); the wait-for-graph deadlock
+detector aborts one member of a cycle
+(pkg/store/mockstore/unistore/tikv/detector.go). VERDICT round-2 item
+#4: interleaved writers must serialize instead of aborting.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture()
+def cat():
+    c = Catalog()
+    s = Session(c, db="test")
+    s.execute("create table acc (id int primary key, bal int)")
+    s.execute("insert into acc values (1, 100), (2, 200)")
+    return c
+
+
+def _bg(fn):
+    th = threading.Thread(target=fn, daemon=True)
+    th.start()
+    return th
+
+
+def test_blocked_writer_serializes(cat):
+    s1, s2 = Session(cat), Session(cat)
+    s1.execute("begin")
+    s1.execute("update acc set bal = bal + 10 where id = 1")
+    done = []
+
+    def w2():
+        s2.execute("begin")
+        s2.execute("update acc set bal = bal + 5 where id = 1")
+        s2.execute("commit")
+        done.append(1)
+
+    th = _bg(w2)
+    time.sleep(0.5)
+    assert not done, "conflicting writer must block, not abort"
+    s1.execute("commit")
+    th.join(timeout=15)
+    assert done, "blocked writer must resume after the lock releases"
+    # both updates applied -> no lost update, no write-conflict abort
+    r = s1.execute("select bal from acc where id = 1")
+    assert r.rows == [(115,)]
+
+
+def test_select_for_update_blocks_writer(cat):
+    s1, s2 = Session(cat), Session(cat)
+    s1.execute("begin")
+    assert s1.execute("select bal from acc where id = 2 for update").rows == [
+        (200,)
+    ]
+    t0 = time.monotonic()
+    done = []
+
+    def w2():
+        s2.execute("update acc set bal = 0 where id = 2")  # autocommit
+        done.append(time.monotonic() - t0)
+
+    th = _bg(w2)
+    time.sleep(0.4)
+    assert not done
+    s1.execute("commit")
+    th.join(timeout=15)
+    assert done and done[0] >= 0.3
+
+
+def test_deadlock_detected_and_victim_rolled_back(cat):
+    s1, s2 = Session(cat), Session(cat)
+    s1.execute("create table b (id int primary key, v int)")
+    s1.execute("insert into b values (1, 1)")
+    s1.execute("begin")
+    s1.execute("update acc set bal = bal + 1 where id = 1")
+    s2.execute("begin")
+    s2.execute("update b set v = v + 1 where id = 1")
+    errs = []
+
+    def w2():
+        try:
+            s2.execute("update acc set bal = bal + 1 where id = 2")
+            s2.execute("commit")
+        except Exception as e:
+            errs.append(str(e))
+
+    th = _bg(w2)
+    time.sleep(0.4)
+    deadlocked = False
+    try:
+        s1.execute("update b set v = v + 1 where id = 1")  # closes cycle
+        s1.execute("commit")
+    except Exception as e:
+        deadlocked = "Deadlock" in str(e)
+    th.join(timeout=20)
+    assert deadlocked or any("Deadlock" in e for e in errs)
+    # the victim's txn was rolled back; survivors can proceed
+    s3 = Session(cat)
+    s3.execute("update b set v = 100 where id = 1")
+    assert s3.execute("select v from b").rows == [(100,)]
+
+
+def test_lock_wait_timeout(cat):
+    s1, s2 = Session(cat), Session(cat)
+    s1.execute("begin")
+    s1.execute("update acc set bal = 1 where id = 1")
+    s2.execute("set innodb_lock_wait_timeout = 1")
+    t0 = time.monotonic()
+    with pytest.raises(Exception, match="Lock wait timeout"):
+        s2.execute("update acc set bal = 2 where id = 1")
+    assert time.monotonic() - t0 < 10
+    s1.execute("rollback")
+
+
+def test_autocommit_writers_no_lost_update(cat):
+    """Concurrent single-statement UPDATEs (read-modify-write) must all
+    apply — the statement-scoped lock closes the race the optimistic
+    path left open for autocommit writers."""
+    sessions = [Session(cat) for _ in range(4)]
+    n_each = 5
+
+    def w(s):
+        for _ in range(n_each):
+            s.execute("update acc set bal = bal + 1 where id = 2")
+
+    threads = [_bg(lambda s=s: w(s)) for s in sessions]
+    for th in threads:
+        th.join(timeout=60)
+    r = sessions[0].execute("select bal from acc where id = 2")
+    assert r.rows == [(200 + 4 * n_each,)]
+
+
+def test_optimistic_mode_still_aborts(cat):
+    s1, s2 = Session(cat), Session(cat)
+    for s in (s1, s2):
+        s.execute("set tidb_txn_mode = 'optimistic'")
+    s1.execute("begin")
+    s1.execute("update acc set bal = 1 where id = 1")
+    s2.execute("update acc set bal = 2 where id = 1")  # wins immediately
+    with pytest.raises(RuntimeError, match="conflict"):
+        s1.execute("commit")

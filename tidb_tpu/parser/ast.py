@@ -145,6 +145,9 @@ class Select:
     distinct: bool = False
     # optimizer hints: ((name, (args...)), ...) from /*+ ... */
     hints: tuple = ()
+    # SELECT ... FOR UPDATE / LOCK IN SHARE MODE: pessimistic row locks
+    # on the read tables (reference: pkg/executor SelectLockExec)
+    for_update: bool = False
 
 
 @dataclasses.dataclass

@@ -595,10 +595,27 @@ class Parser:
                 limit, offset = a, self.parse_int()
             else:
                 limit = a
+        for_update = False
+        if self.at_kw("for") and self.toks[self.i + 1].text.lower() == "update":
+            self.advance()
+            self.advance()
+            for_update = True
+        elif (
+            self.cur.kind == "id" and self.cur.text.lower() == "lock"
+        ):  # LOCK IN SHARE MODE: read lock (same table lock here)
+            self.advance()
+            self.expect_kw("in")
+            for word in ("share", "mode"):
+                if self.cur.text.lower() != word:
+                    raise ParseError(
+                        f"expected {word.upper()} at {self.cur.pos}"
+                    )
+                self.advance()
+            for_update = True
         return ast.Select(
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
-            distinct=distinct, hints=hints,
+            distinct=distinct, hints=hints, for_update=for_update,
         )
 
     def parse_int(self) -> int:

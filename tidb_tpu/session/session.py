@@ -26,6 +26,7 @@ from tidb_tpu.storage.table import TableSchema
 from tidb_tpu.storage.scan import clear_scan_cache
 
 
+
 @dataclasses.dataclass
 class Result:
     columns: List[str]
@@ -125,6 +126,143 @@ class Session:
             self._txn["base_versions"].setdefault(key, pinned)
         return shadow
 
+    # -- pessimistic locking (reference: LockKeys in the pessimistic txn
+    # path, pkg/store/driver/txn/txn_driver.go; deadlock detector
+    # unistore/tikv/detector.go) --------------------------------------
+    def _pessimistic(self) -> bool:
+        return str(self.vars.get("tidb_txn_mode") or "").lower() == "pessimistic"
+
+    def _lock_manager(self):
+        return self.catalog.lock_manager
+
+    def _with_write_locks(self, tables, fn):
+        """Run a DML statement holding pessimistic locks on its target
+        tables. Explicit transaction: locks persist until COMMIT/
+        ROLLBACK and the table's read snapshot advances to the current
+        committed version at first lock (the for_update_ts semantics —
+        a writer that blocked behind another txn resumes against the
+        winner's committed rows, so interleaved writers SERIALIZE
+        instead of aborting). Autocommit: the lock spans just this
+        statement, closing the read-modify-write race between
+        concurrent single-statement writers. A deadlock rolls the whole
+        transaction back (InnoDB victim semantics) and re-raises."""
+        from tidb_tpu.storage.locks import DeadlockError, next_txn_id
+
+        lm = self._lock_manager()
+        try:
+            timeout = float(self.vars.get("innodb_lock_wait_timeout") or 50)
+        except Exception:
+            timeout = 50.0
+        keys = [(d.lower(), n.lower()) for d, n in tables]
+        if self._txn is not None:
+            if not self._pessimistic():
+                return fn()  # optimistic txns buffer in shadows, lock-free
+            txn_id = self._txn.setdefault("txn_id", next_txn_id())
+            locked = self._txn.setdefault("locked", set())
+            try:
+                for k in keys:
+                    if k in locked:
+                        continue
+                    lm.acquire(
+                        txn_id, k, timeout=timeout,
+                        kill_check=self.killer.check,
+                    )
+                    locked.add(k)
+                    self._advance_snapshot(k)
+            except DeadlockError:
+                self._abort_txn()
+                raise
+            return fn()
+        # autocommit (BOTH modes): a statement-scoped table lock — the
+        # statement mutates the base table directly, so it must exclude
+        # pessimistic lock holders AND committers (which take the same
+        # locks in _commit_txn) or its read-modify-write races
+        sid = next_txn_id()
+        try:
+            for k in sorted(keys):
+                lm.acquire(
+                    sid, k, timeout=timeout, kill_check=self.killer.check
+                )
+            return fn()
+        finally:
+            lm.release_all(sid)
+
+    def _advance_snapshot(self, key) -> None:
+        """After acquiring a table's pessimistic lock: advance this
+        txn's snapshot of it to the CURRENT committed version (nobody
+        else can write it while we hold the lock). Skipped once a shadow
+        exists — rewriting a table we already wrote would lose our own
+        writes; the commit-time version check still guards that case."""
+        if self._txn is None or key in self._txn["shadows"]:
+            return
+        db, name = key
+        t = self.catalog.table(db, name)
+        cur = t.version
+        if self._txn["pins"].get(key) == cur:
+            self._txn["base_versions"][key] = cur
+            return
+        t.pin(cur)
+        self._txn.setdefault("pin_objs", []).append((t, cur))
+        self._txn["pins"][key] = cur
+        self._txn["base_versions"][key] = cur
+
+    def _abort_txn(self) -> None:
+        """Roll back the active transaction (deadlock victim path)."""
+        txn, self._txn = self._txn, None
+        if not txn:
+            return
+        for t, v in txn.get("pin_objs", []):
+            t.unpin(v)
+        if txn.get("txn_id"):
+            self._lock_manager().release_all(txn["txn_id"])
+
+    def _from_tables(self, ref) -> list:
+        """Base (db, table) pairs under a FROM clause (for FOR UPDATE
+        locking); subquery refs contribute their inner FROMs."""
+        out = []
+
+        def walk(r):
+            if r is None:
+                return
+            if isinstance(r, ast.TableRef):
+                try:
+                    self.catalog.table(r.db or self.db, r.name)
+                except Exception:
+                    return  # view / unknown: nothing lockable
+                out.append((r.db or self.db, r.name))
+            elif isinstance(r, ast.Join):
+                walk(r.left)
+                walk(r.right)
+            elif isinstance(r, ast.SubqueryRef):
+                walk(getattr(r.query, "from_", None))
+
+        walk(ref)
+        return out
+
+    def _for_update_tables(self, s) -> list:
+        """Tables to lock for FOR UPDATE, searching every Select block
+        of a query (the parser sets the flag on the inner block of
+        WITH/UNION/INTERSECT wrappers)."""
+        out = []
+
+        def walk(q):
+            if isinstance(q, ast.Select):
+                if q.for_update:
+                    out.extend(self._from_tables(q.from_))
+            elif isinstance(q, ast.Union):
+                for sub in q.selects:
+                    walk(sub)
+            elif isinstance(q, ast.SetOp):
+                walk(q.left)
+                walk(q.right)
+            elif isinstance(q, ast.With):
+                for _n, cq in q.ctes:
+                    walk(cq)
+                walk(q.body)
+
+        walk(s)
+        return out
+
     def _run_txn_control(self, s) -> Result:
         from tidb_tpu.utils import failpoint
 
@@ -139,10 +277,7 @@ class Session:
         elif s.op == "commit":
             self._commit_txn()
         elif s.op == "rollback":
-            txn, self._txn = self._txn, None
-            if txn:
-                for t, v in txn.get("pin_objs", []):
-                    t.unpin(v)
+            self._abort_txn()
         elif s.op == "savepoint":
             # outside a transaction this is a no-op, like MySQL under
             # autocommit (reference: pkg/session savepoint handling,
@@ -211,36 +346,70 @@ class Session:
         if self._txn is None:
             return
         txn, self._txn = self._txn, None
+        commit_id = None
         try:
             failpoint.inject("session/before-commit")
-            # optimistic conflict check then swap (first committer wins)
-            for key, shadow in txn["shadows"].items():
-                db, name = key
-                base = self.catalog.table(db, name)
-                failpoint.inject("session/commit-conflict-check")
-                if base.version != txn["base_versions"][key]:
-                    raise RuntimeError(
-                        f"write conflict on {db}.{name}: "
-                        "table changed since transaction start"
+            # Commit takes the lock-manager locks of every written table
+            # (sorted — no lock-order cycles between committers; a
+            # pessimistic txn already holds its own, so acquire no-ops).
+            # This excludes autocommit writers and pessimistic holders
+            # for the whole check+apply span; the catalog commit mutex
+            # additionally serializes optimistic committers' check+apply
+            # so neither can interleave between the other's check and
+            # apply (lost update).
+            if txn["shadows"]:
+                commit_id = txn.get("txn_id")
+                if commit_id is None:
+                    from tidb_tpu.storage.locks import next_txn_id
+
+                    commit_id = next_txn_id()
+                lm = self._lock_manager()
+                try:
+                    timeout = float(
+                        self.vars.get("innodb_lock_wait_timeout") or 50
                     )
-            failpoint.inject("session/commit-apply")
-            for key, shadow in txn["shadows"].items():
-                db, name = key
-                base = self.catalog.table(db, name)
-                base.replace_blocks(
-                    shadow.blocks(), modified_rows=shadow.modify_count
-                )
-                base.dictionaries = shadow.dictionaries
-                # the conflict check above proved the base is unchanged
-                # since first touch, so the shadow's allocator state is
-                # authoritative — direct assign (not max) keeps TRUNCATE's
-                # AUTO_INCREMENT reset effective through COMMIT
-                base.autoinc_next = shadow.autoinc_next
+                except Exception:
+                    timeout = 50.0
+                for k in sorted(txn["shadows"].keys()):
+                    lm.acquire(
+                        commit_id, k, timeout=timeout,
+                        kill_check=self.killer.check,
+                    )
+            with self.catalog._commit_mu:
+                # optimistic conflict check then swap (first committer
+                # wins)
+                for key, shadow in txn["shadows"].items():
+                    db, name = key
+                    base = self.catalog.table(db, name)
+                    failpoint.inject("session/commit-conflict-check")
+                    if base.version != txn["base_versions"][key]:
+                        raise RuntimeError(
+                            f"write conflict on {db}.{name}: "
+                            "table changed since transaction start"
+                        )
+                failpoint.inject("session/commit-apply")
+                for key, shadow in txn["shadows"].items():
+                    db, name = key
+                    base = self.catalog.table(db, name)
+                    base.replace_blocks(
+                        shadow.blocks(), modified_rows=shadow.modify_count
+                    )
+                    base.dictionaries = shadow.dictionaries
+                    # the conflict check above proved the base is
+                    # unchanged since first touch, so the shadow's
+                    # allocator state is authoritative — direct assign
+                    # (not max) keeps TRUNCATE's AUTO_INCREMENT reset
+                    # effective through COMMIT
+                    base.autoinc_next = shadow.autoinc_next
             if txn["shadows"]:
                 clear_scan_cache()
         finally:
             for t, v in txn.get("pin_objs", []):
                 t.unpin(v)
+            if commit_id is not None or txn.get("txn_id"):
+                self._lock_manager().release_all(
+                    commit_id if commit_id is not None else txn["txn_id"]
+                )
 
     # ------------------------------------------------------------------
     def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
@@ -512,7 +681,14 @@ class Session:
         except Exception:
             pass
         if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
-            r = self._run_select(s)
+            fu = self._for_update_tables(s)
+            if fu:
+                # SELECT ... FOR UPDATE (possibly inside WITH/UNION
+                # branches): lock the read tables before planning so the
+                # snapshot advances under the lock (ref SelectLockExec)
+                r = self._with_write_locks(fu, lambda: self._run_select(s))
+            else:
+                r = self._run_select(s)
         elif isinstance(s, ast.CreateTable) and s.as_query is not None:
             # CREATE TABLE ... AS SELECT: schema derived from the query.
             # Existence check FIRST — don't execute a potentially huge
@@ -689,17 +865,21 @@ class Session:
             self.catalog.drop_view(s.db or self.db, s.name, s.if_exists)
             r = Result([], [])
         elif isinstance(s, ast.TruncateTable):
-            db = s.db or self.db
-            t = self._resolve_table_for_write(db, s.name)
-            children = self._fk_children(db, s.name)
-            if children:
-                self._enforce_parent_constraints(
-                    db, s.name, {c: set() for c in t.schema.names}
-                )
-            t.replace_blocks([], modified_rows=t.nrows)
-            t.autoinc_next = 1  # TRUNCATE resets AUTO_INCREMENT (DDL)
-            clear_scan_cache()
-            r = Result([], [])
+            def _truncate(db=s.db or self.db):
+                t = self._resolve_table_for_write(db, s.name)
+                children = self._fk_children(db, s.name)
+                if children:
+                    self._enforce_parent_constraints(
+                        db, s.name, {c: set() for c in t.schema.names}
+                    )
+                t.replace_blocks([], modified_rows=t.nrows)
+                t.autoinc_next = 1  # TRUNCATE resets AUTO_INCREMENT (DDL)
+                clear_scan_cache()
+                return Result([], [])
+
+            r = self._with_write_locks(
+                [(s.db or self.db, s.name)], _truncate
+            )
         elif isinstance(s, ast.AlterTable):
             failpoint.inject("ddl/alter-table")
             t = self.catalog.table(s.db or self.db, s.name)
@@ -824,11 +1004,17 @@ class Session:
             self.db = s.name.lower()
             r = Result([], [])
         elif isinstance(s, ast.Insert):
-            r = self._run_insert(s)
+            r = self._with_write_locks(
+                [(s.db or self.db, s.table)], lambda: self._run_insert(s)
+            )
         elif isinstance(s, ast.Delete):
-            r = self._run_delete(s)
+            r = self._with_write_locks(
+                [(s.db or self.db, s.table)], lambda: self._run_delete(s)
+            )
         elif isinstance(s, ast.Update):
-            r = self._run_update(s)
+            r = self._with_write_locks(
+                [(s.db or self.db, s.table)], lambda: self._run_update(s)
+            )
         elif isinstance(s, ast.Explain):
             r = self._run_explain(s)
         elif isinstance(s, ast.Show):
@@ -850,7 +1036,9 @@ class Session:
         elif isinstance(s, ast.AnalyzeTable):
             r = self._run_analyze_table(s)
         elif isinstance(s, ast.LoadData):
-            r = self._run_load_data(s)
+            r = self._with_write_locks(
+                [(s.db or self.db, s.table)], lambda: self._run_load_data(s)
+            )
         else:
             raise ValueError(f"unsupported statement {type(s).__name__}")
         r.elapsed_s = time.perf_counter() - t0

@@ -33,6 +33,13 @@ class Catalog:
         self.users = UserStore()
         # shared GLOBAL sysvar store (mysql.global_variables analog)
         self.global_sysvars: Dict[str, object] = {}
+        # pessimistic lock manager + commit mutex: shared by every
+        # session over this store (storage/locks.py; the mutex closes
+        # the optimistic check/apply race between concurrent commits)
+        from tidb_tpu.storage.locks import LockManager
+
+        self.lock_manager = LockManager()
+        self._commit_mu = threading.Lock()
 
     def create_database(self, name: str, if_not_exists: bool = False) -> None:
         name = name.lower()

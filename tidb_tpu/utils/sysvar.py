@@ -48,6 +48,16 @@ def _bool(x):
     return bool(x)
 
 
+def _enum(*allowed):
+    def v(x):
+        s = str(x).strip().lower()
+        if s not in allowed:
+            raise ValueError(f"value {x!r} not in {allowed}")
+        return s
+
+    return v
+
+
 SYSVAR_DEFS: Dict[str, SysVarDef] = {
     v.name: v
     for v in [
@@ -68,6 +78,14 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "threshold, 0 = never"),
         SysVarDef("tidb_allow_mpp", True, "both", _bool,
                   "allow multi-device fragment plans (reference tidb_allow_mpp)"),
+        SysVarDef("tidb_txn_mode", "pessimistic", "both",
+                  _enum("pessimistic", "optimistic"),
+                  "transaction mode: pessimistic takes blocking table "
+                  "locks per DML statement (reference default); "
+                  "optimistic is first-committer-wins"),
+        SysVarDef("innodb_lock_wait_timeout", 50, "both", _int_range(1, 3600),
+                  "seconds a pessimistic lock wait blocks before error "
+                  "1205 (reference innodb_lock_wait_timeout)"),
         SysVarDef("tidb_broadcast_join_threshold_size", 1 << 20, "both", _int_range(0, 1 << 34),
                   "max build-side bytes for broadcast (vs hash-partition) joins"),
         SysVarDef("tidb_executor_concurrency", 1, "both", _int_range(1, 256),
