@@ -200,3 +200,30 @@ class TestWireAuth:
         ok, sock = self._connect(server.port, "root")
         assert ok[0] == 0x00
         sock.close()
+
+
+class TestShowMetadataPrivileges:
+    """DESCRIBE / SHOW COLUMNS / SHOW CREATE require some privilege on
+    the table (MySQL visitInfo rule; ADVICE round-2 #5)."""
+
+    @pytest.fixture()
+    def env(self):
+        cat = Catalog()
+        root = Session(cat, db="test", user="root")
+        root.execute("create table secret (a int, b int)")
+        root.execute("create table open_t (a int)")
+        root.execute("create user alice identified by 'pw'")
+        root.execute("grant select on test.open_t to alice")
+        return cat
+
+    def test_describe_denied_without_priv(self, env):
+        alice = Session(env, db="test", user="alice")
+        with pytest.raises(PermissionError):
+            alice.execute("describe secret")
+        with pytest.raises(PermissionError):
+            alice.execute("show create table secret")
+
+    def test_describe_allowed_with_any_priv(self, env):
+        alice = Session(env, db="test", user="alice")
+        assert alice.execute("describe open_t").rows
+        assert alice.execute("show create table open_t").rows

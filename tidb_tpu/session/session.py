@@ -391,16 +391,17 @@ class Session:
                 for key, shadow in txn["shadows"].items():
                     db, name = key
                     base = self.catalog.table(db, name)
-                    base.replace_blocks(
-                        shadow.blocks(), modified_rows=shadow.modify_count
+                    # atomic: blocks + dictionaries + allocator swap
+                    # under one table-lock acquisition (direct autoinc
+                    # assign, not max: the conflict check proved the
+                    # base unchanged since first touch, so TRUNCATE's
+                    # AUTO_INCREMENT reset survives COMMIT)
+                    base.install_commit(
+                        shadow.blocks(),
+                        shadow.dictionaries,
+                        shadow.autoinc_next,
+                        shadow.modify_count,
                     )
-                    base.dictionaries = shadow.dictionaries
-                    # the conflict check above proved the base is
-                    # unchanged since first touch, so the shadow's
-                    # allocator state is authoritative — direct assign
-                    # (not max) keeps TRUNCATE's AUTO_INCREMENT reset
-                    # effective through COMMIT
-                    base.autoinc_next = shadow.autoinc_next
             if txn["shadows"]:
                 clear_scan_cache()
         finally:
@@ -525,6 +526,21 @@ class Session:
         if not self.catalog.users.is_super(self.user):
             raise PermissionError(
                 f"user {self.user!r} lacks administrative privileges"
+            )
+
+    def _require_some_table_priv(
+        self, db: str, name: str, what: str, extra: tuple = ()
+    ) -> None:
+        """MySQL visitInfo rule for metadata statements (SHOW CREATE /
+        COLUMNS / INDEX): ANY privilege on the table suffices."""
+        if self.catalog.users.is_super(self.user):
+            return
+        if not any(
+            self.catalog.users.check(self.user, p, db.lower(), name.lower())
+            for p in ("select", "insert", "update", "delete") + extra
+        ):
+            raise PermissionError(
+                f"{what} denied to user {self.user!r} on {db}.{name}"
             )
 
     def _ast_tables(self, node, out=None):
@@ -1096,6 +1112,7 @@ class Session:
         if s.what == "columns":
             db, name = s.db.split(".", 1)
             db = db or self.db
+            self._require_some_table_priv(db, name, "SHOW COLUMNS")
             t = self.catalog.table(db, name)
             pk = set(t.schema.primary_key or [])
             uni = {
@@ -1123,13 +1140,7 @@ class Session:
         if s.what in ("create_table", "create_view"):
             db, name = s.db.split(".", 1)
             db = db or self.db
-            if not self.catalog.users.is_super(self.user) and not any(
-                self.catalog.users.check(self.user, p, db.lower(), name.lower())
-                for p in ("select", "insert", "update", "delete")
-            ):
-                raise PermissionError(
-                    f"SHOW CREATE denied to user {self.user!r} on {db}.{name}"
-                )
+            self._require_some_table_priv(db, name, "SHOW CREATE")
             if s.what == "create_view":
                 vdef = self.catalog.view_def(db, name)
                 if vdef is None:
@@ -1151,13 +1162,9 @@ class Session:
         if s.what == "index":
             db, name = s.db.split(".", 1)
             db = db or self.db
-            if not self.catalog.users.is_super(self.user) and not any(
-                self.catalog.users.check(self.user, p, db.lower(), name.lower())
-                for p in ("select", "insert", "update", "delete", "index")
-            ):
-                raise PermissionError(
-                    f"SHOW INDEX denied to user {self.user!r} on {db}.{name}"
-                )
+            self._require_some_table_priv(
+                db, name, "SHOW INDEX", extra=("index",)
+            )
             t = self.catalog.table(db, name)
             rows = []
             for i, cn in enumerate(t.schema.primary_key or [], 1):

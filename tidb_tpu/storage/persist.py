@@ -104,7 +104,20 @@ def save_catalog(
                 arrays[f"{c}.data"] = hc.data
                 arrays[f"{c}.valid"] = hc.valid
                 if hc.dictionary is not None:
-                    arrays[f"{c}.dict"] = hc.dictionary
+                    # UTF-8 bytes + offsets, NOT an object array: object
+                    # arrays pickle inside the npz, and unpickling a
+                    # crafted snapshot executes arbitrary code — the
+                    # reference BR format (protobuf + SST) never
+                    # deserializes executable payloads either. (Offsets
+                    # rather than fixed-width unicode: numpy 'U' arrays
+                    # silently strip trailing NULs, corrupting values.)
+                    enc = [x.encode("utf-8") for x in hc.dictionary]
+                    arrays[f"{c}.dictbuf"] = np.frombuffer(
+                        b"".join(enc), dtype=np.uint8
+                    )
+                    arrays[f"{c}.dictoff"] = np.cumsum(
+                        [0] + [len(e) for e in enc], dtype=np.int64
+                    )
             fn = os.path.join(path, f"{db}.{name}.npz")
             if done.get((db, name)) == t.version and os.path.exists(fn):
                 continue  # checkpointed at this exact version
@@ -163,17 +176,36 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
                 t.ttl = tuple(meta["ttl"])
             t.checks = [tuple(c) for c in (meta.get("checks") or [])]
             t.fks = [tuple(f) for f in (meta.get("fks") or [])]
-            data = np.load(
-                os.path.join(path, f"{db}.{name}.npz"), allow_pickle=True
-            )
+            # allow_pickle stays OFF: a snapshot directory is data, and
+            # must never be able to execute code on RESTORE
+            data = np.load(os.path.join(path, f"{db}.{name}.npz"))
             cols = {}
             for n, ty in schema.columns:
                 d = data[f"{n}.data"]
                 v = data[f"{n}.valid"]
                 dic = None
-                if f"{n}.dict" in data:
-                    dic = data[f"{n}.dict"]
+                if f"{n}.dictbuf" in data:
+                    buf = data[f"{n}.dictbuf"].tobytes()
+                    off = data[f"{n}.dictoff"]
+                    dic = np.array(
+                        [
+                            buf[off[i]:off[i + 1]].decode("utf-8")
+                            for i in range(len(off) - 1)
+                        ],
+                        dtype=object,
+                    )
                     t.dictionaries[n] = dic
+                elif f"{n}.dict" in data:
+                    # snapshots from before the offsets format stored a
+                    # pickled object array; np.load without allow_pickle
+                    # rejects those at access time — surface a clear
+                    # re-export message instead of a numpy internals
+                    # error
+                    raise ValueError(
+                        f"snapshot {path} uses the old pickled dictionary "
+                        "format; re-export it with BACKUP from the "
+                        "version that wrote it"
+                    )
                 cols[n] = HostColumn(ty, d, v, dic)
             block = HostBlock.from_columns(cols)
             # always replace — restoring an empty snapshot over a live

@@ -337,6 +337,26 @@ class Table:
                 self._gc_versions()
             return removed
 
+    def install_commit(
+        self,
+        blocks: List[HostBlock],
+        dictionaries: dict,
+        autoinc_next: int,
+        modified_rows: int,
+    ) -> int:
+        """Atomically install a transaction's committed state: blocks,
+        string dictionaries, and the AUTO_INCREMENT allocator swap under
+        one lock acquisition, so a concurrent reader can never observe
+        new blocks with old dictionaries (or vice versa) mid-commit."""
+        with self._lock:
+            self.modify_count += int(modified_rows)
+            self.version += 1
+            self._versions[self.version] = list(blocks)
+            self.dictionaries = dict(dictionaries)
+            self.autoinc_next = int(autoinc_next)
+            self._gc_versions()
+            return self.version
+
     def replace_blocks(
         self, blocks: List[HostBlock], modified_rows: Optional[int] = None
     ) -> int:
@@ -470,10 +490,11 @@ class Table:
         else:
             data = np.zeros(0, dtype=np.int64)
             valid = np.zeros(0, dtype=bool)
-        # NULL keys sort to the end and are excluded from range hits
-        keyed = np.where(valid, data, np.iinfo(np.int64).max)
-        perm = np.argsort(keyed, kind="stable")
-        svals = keyed[perm]
+        # NULL keys sort to the end via an explicit rank key — not an
+        # in-band int64-max sentinel, which a real key equal to int64
+        # max would collide with (lookups/uniqueness would miss it)
+        perm = np.lexsort((data, np.where(valid, 0, 1)))
+        svals = data[perm]
         nvalid = int(valid.sum())
         if len(cache) > 8:  # a few live (version, col) indexes
             cache.clear()
