@@ -1,0 +1,110 @@
+"""Prepared statements: parameterized plan cache.
+
+Reference: pkg/planner/core/plan_cache.go:231 — EXECUTE binds new
+parameter values into the CACHED physical plan instead of re-planning;
+VERDICT round-2 item #7 (repeat-EXECUTE latency ~ steady-state jit
+call). Parameters the compiler cannot parameterize (LIKE patterns,
+IN sets, strings, pushed PK ranges) bake into the plan and a change in
+them replans — never returns stale results.
+"""
+
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture()
+def sess():
+    s = Session(Catalog(), db="test")
+    s.execute("create table t (a int primary key, b double, s varchar(20))")
+    s.execute(
+        "insert into t values (1, 1.5, 'x'), (2, 2.5, 'y'), "
+        "(3, 3.5, 'x'), (4, 4.5, 'z')"
+    )
+    return s
+
+
+def test_runtime_param_reuses_compiled_plan(sess):
+    sess.execute("prepare p from 'select a from t where b > ? order by a'")
+    sess.execute("set @v = 2.0")
+    assert sess.execute("execute p using @v").rows == [(2,), (3,), (4,)]
+    ent = sess._prepared["p"]
+    assert 0 in ent["runtime"] and ent["cq"] is not None
+    cq_first = ent["cq"]
+    sess.execute("set @v = 4.0")
+    assert sess.execute("execute p using @v").rows == [(4,)]
+    assert sess._prepared["p"]["cq"] is cq_first, "must reuse the compiled plan"
+
+
+def test_repeat_execute_latency_is_steady_state(sess):
+    sess.execute("prepare p from 'select a from t where b > ? order by a'")
+    sess.execute("set @v = 1.0")
+    sess.execute("execute p using @v")  # compile
+    lat = []
+    for v in (2.0, 3.0, 0.5, 4.0, 1.5):
+        sess.user_vars["v"] = v
+        t0 = time.perf_counter()
+        sess.execute("execute p using @v")
+        lat.append(time.perf_counter() - t0)
+    # the real guarantee is plan identity (asserted in
+    # test_runtime_param_reuses_compiled_plan); the latency bound is a
+    # loose sanity ceiling so the test never flakes on a loaded host
+    assert sorted(lat)[len(lat) // 2] < 0.5, lat
+
+
+def test_baked_string_param_replans_not_stale(sess):
+    sess.execute("prepare p from 'select a from t where s like ? order by a'")
+    sess.execute("set @p = 'x'")
+    assert sess.execute("execute p using @p").rows == [(1,), (3,)]
+    sess.execute("set @p = 'z'")
+    assert sess.execute("execute p using @p").rows == [(4,)]
+
+
+def test_pk_param_stays_baked_for_range_pushdown(sess):
+    sess.execute("prepare p from 'select b from t where a = ?'")
+    sess.execute("set @k = 2")
+    assert sess.execute("execute p using @k").rows == [(2.5,)]
+    sess.execute("set @k = 4")
+    assert sess.execute("execute p using @k").rows == [(4.5,)]
+
+
+def test_schema_change_invalidates(sess):
+    sess.execute("prepare p from 'select a from t where b > ? order by a'")
+    sess.execute("set @v = 2.0")
+    sess.execute("execute p using @v")
+    sess.execute("alter table t add column c int default 7")
+    assert sess.execute("execute p using @v").rows == [(2,), (3,), (4,)]
+
+
+def test_deallocate_and_errors(sess):
+    sess.execute("prepare p from 'select ?'")
+    with pytest.raises(Exception):
+        sess.execute("execute p")  # missing parameter
+    sess.execute("deallocate prepare p")
+    with pytest.raises(Exception):
+        sess.execute("execute p using @v")
+
+
+def test_dml_prepared(sess):
+    sess.execute("prepare ins from 'insert into t (a, b, s) values (?, ?, ?)'")
+    sess.execute("set @a = 10")
+    sess.execute("set @b = 9.5")
+    sess.execute("set @s = 'w'")
+    sess.execute("execute ins using @a, @b, @s")
+    assert sess.execute("select b from t where a = 10").rows == [(9.5,)]
+    sess.user_vars["a"] = 11
+    sess.execute("execute ins using @a, @b, @s")
+    assert sess.execute("select count(*) from t where b = 9.5").rows == [(2,)]
+
+
+def test_limit_placeholder_textual_fallback(sess):
+    # LIMIT ? can't parameterize as an expression: PREPARE falls back to
+    # textual binding so wire clients doing pagination keep working
+    sess.execute("prepare p from 'select a from t order by a limit ?'")
+    sess.execute("set @n = 2")
+    assert sess.execute("execute p using @n").rows == [(1,), (2,)]
+    sess.execute("set @n = 3")
+    assert sess.execute("execute p using @n").rows == [(1,), (2,), (3,)]

@@ -44,8 +44,24 @@ class ColumnRef(Expr):
 @dataclasses.dataclass(frozen=True)
 class Literal(Expr):
     value: object = None
+    # prepared-statement parameter slot (0-based '?' index): the generic
+    # compile path reads the value from a runtime input instead of
+    # baking it, so one compiled program serves every EXECUTE (reference
+    # plan_cache.go:231 parameterized plans). Compile-time consumers
+    # (LIKE patterns, dictionary merges, pushed PK ranges) bake the
+    # value and REGISTER the slot (kernels.baked_value) so the session
+    # replans when that parameter changes. None = plain literal.
+    param_slot: Optional[int] = dataclasses.field(default=None, compare=False)
 
     def __repr__(self) -> str:
+        # value INCLUDED even for parameter slots: the executor's
+        # fingerprint cache must never hand a program whose baked
+        # constants came from other bound values to a different EXECUTE.
+        # The prepared-statement fast path reuses compiled plans by
+        # holding the CompiledQuery directly (session.execute_prepared),
+        # not through the fingerprint.
+        if self.param_slot is not None:
+            return f"?p{self.param_slot}={self.value!r}"
         return repr(self.value)
 
 
@@ -84,7 +100,11 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
             raise KeyError(f"unknown column {e.name!r}; have {sorted(schema)}")
         return ColumnRef(type=schema[e.name], name=e.name)
     if isinstance(e, Literal):
-        return Literal(type=e.type or literal_type(e.value), value=e.value)
+        return Literal(
+            type=e.type or literal_type(e.value),
+            value=e.value,
+            param_slot=e.param_slot,
+        )
     assert isinstance(e, Func)
     args = tuple(bind_expr(a, schema) for a in e.args)
     args = _coerce_date_literals(e.op, args)

@@ -18,6 +18,7 @@ code on device — O(|dict|) host work regardless of row count.
 from __future__ import annotations
 
 import re
+import threading
 from typing import Callable, Dict, Optional
 
 import jax.numpy as jnp
@@ -90,6 +91,91 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
 
 
+# ---------------------------------------------------------------------------
+# prepared-statement parameters (reference: pkg/planner/core/plan_cache.go:231
+# parameterized plans). A Literal carrying param_slot compiles — in the
+# generic value path — to a read of a runtime input made visible during
+# tracing by param_scope, so one compiled program serves every EXECUTE.
+# Compile-time consumers of literal VALUES (LIKE patterns, IN sets,
+# dictionary merges, ROUND digits, pushed PK ranges, ...) call
+# baked_value()/note_baked_param() instead: the active registry records
+# the slot as BAKED and the session replans when that parameter changes.
+# The registry is also fed by the generic path itself whenever no
+# param_scope is active (e.g. a host-assisted or streamed stage that
+# didn't thread parameters): baked-by-default keeps every untracked
+# execution path sound.
+# ---------------------------------------------------------------------------
+
+_param_tls = threading.local()
+
+
+class param_scope:
+    """Makes bound parameter scalars (slot -> array) visible to compiled
+    literal readers for the duration of a trace/eager execution."""
+
+    def __init__(self, values):
+        self.values = values or {}
+
+    def __enter__(self):
+        self._old = getattr(_param_tls, "vals", None)
+        _param_tls.vals = self.values
+        return self
+
+    def __exit__(self, *exc):
+        _param_tls.vals = self._old
+
+
+class param_registry:
+    """Collects, across one statement execution, which parameter slots
+    were read as runtime inputs vs baked into the compiled artifact."""
+
+    def __init__(self):
+        self.runtime = set()
+        self.baked = set()
+
+    def __enter__(self):
+        self._old = getattr(_param_tls, "reg", None)
+        _param_tls.reg = self
+        return self
+
+    def __exit__(self, *exc):
+        _param_tls.reg = self._old
+
+
+def note_baked_param(e) -> None:
+    slot = getattr(e, "param_slot", None)
+    if slot is not None:
+        reg = getattr(_param_tls, "reg", None)
+        if reg is not None:
+            reg.baked.add(slot)
+
+
+def _note_runtime_param(slot: int) -> None:
+    reg = getattr(_param_tls, "reg", None)
+    if reg is not None:
+        reg.runtime.add(slot)
+
+
+def baked_value(e):
+    """Read a literal's value for compile-time use, registering its
+    parameter slot (if any) as baked."""
+    note_baked_param(e)
+    return e.value
+
+
+def phys_dtype(t):
+    """numpy/jnp dtype of a literal's physical device encoding."""
+    if t is None:
+        return jnp.float64
+    if t.kind == Kind.FLOAT:
+        return jnp.float64
+    if t.kind == Kind.BOOL:
+        return jnp.bool_
+    if t.kind == Kind.DATE:
+        return jnp.int32
+    return jnp.int64
+
+
 def literal_phys(v, t):
     """Literal -> the column's physical on-device encoding (shared by
     IN / FIELD / eq-literal paths; scaled decimals, epoch days/micros,
@@ -146,6 +232,7 @@ def string_expr(e: Expr, dicts: DictContext):
             raise NotImplementedError(f"string column {e.name} has no dictionary")
         return _compile(e, dicts), dicts[e.name]
     if isinstance(e, Literal):
+        note_baked_param(e)
         if e.value is None:
             def _null(b):
                 z = jnp.zeros(b.capacity, dtype=jnp.int32)
@@ -313,7 +400,7 @@ def _json_pyfn(e: Func):
             raise NotImplementedError(
                 "json_extract supports exactly one path"
             )
-        path = str(e.args[1].value)
+        path = str(baked_value(e.args[1]))
 
         def f(s):
             try:
@@ -365,7 +452,7 @@ _STR_TRANSFORMS = {
 
 def _str_transform_pyfn(e: Func):
     op = e.op
-    ex = [a.value for a in e.args[1:]]
+    ex = [baked_value(a) for a in e.args[1:]]
     if op == "upper":
         return lambda s: s.upper()
     if op == "lower":
@@ -432,7 +519,7 @@ def _string_parts(args, dicts: DictContext, what: str):
         if a.type is not None and a.type.kind == _K.STRING:
             parts.append(string_expr(a, dicts))
         elif isinstance(a, Literal):
-            v = a.value
+            v = baked_value(a)
             lit = Literal(type=None, value=None if v is None else _fmt_scalar(v, a.type))
             parts.append(string_expr(lit, {}))
         else:
@@ -489,6 +576,7 @@ def _concat_ws_expr(e: Func, dicts: DictContext):
     sep_e = e.args[0]
     if not isinstance(sep_e, Literal):
         raise NotImplementedError("CONCAT_WS separator must be a literal")
+    note_baked_param(sep_e)
     if sep_e.value is None:
         # NULL separator -> NULL result
         def _null(b):
@@ -646,7 +734,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         if len(e.args) > 1:
             if not isinstance(e.args[1], Literal):
                 raise NotImplementedError("json_length path must be literal")
-            jpath = str(e.args[1].value)
+            jpath = str(baked_value(e.args[1]))
 
         def _jl(s):
             try:
@@ -669,7 +757,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         for pos, a in enumerate(e.args[1:], 1):
             if not isinstance(a, Literal):
                 raise NotImplementedError("FIELD values must be literals")
-            if a.value is None:
+            if baked_value(a) is None:
                 continue  # a NULL needle matches nothing
             needles.append((pos, a.value))
         if _is_string_col(x):
@@ -730,6 +818,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         s, sub = e.args
         if not isinstance(sub, Literal):
             raise NotImplementedError("LOCATE needle must be a literal")
+        note_baked_param(sub)
         if sub.value is None:
             return lambda b: DevCol(
                 jnp.zeros(b.capacity, dtype=jnp.int64),
@@ -760,6 +849,44 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
 def _compile_literal(e: Literal) -> _CompiledExpr:
     t = e.type
     v = e.value
+    if (
+        e.param_slot is not None
+        and v is not None
+        and t is not None
+        and t.kind not in (Kind.STRING, Kind.NULL)
+    ):
+        # runtime parameter slot: the CANONICAL numeric value (python
+        # int/float as an array) arrives as a traced input (param_scope)
+        # and the physical transform — decimal scaling, dtype — runs
+        # inside the program, so one compiled plan serves every bound
+        # value. Without an active scope (or a non-numeric binding) the
+        # baked value runs AND the slot registers as baked, so any
+        # execution path that didn't thread parameters stays sound.
+        slot = e.param_slot
+        np_dt = phys_dtype(t)
+        baked = np.asarray(literal_phys(v, t), dtype=np_dt)
+        scale = t.scale if t.kind == Kind.DECIMAL else None
+
+        def _param(b):
+            vals = getattr(_param_tls, "vals", None)
+            pv = vals.get(slot) if vals else None
+            if pv is None:
+                note_baked_param(e)
+                arr = jnp.asarray(baked, dtype=np_dt)
+            else:
+                _note_runtime_param(slot)
+                raw = jnp.asarray(pv)
+                if scale is not None:
+                    arr = jnp.round(
+                        raw.astype(jnp.float64) * (10**scale)
+                    ).astype(jnp.int64)
+                else:
+                    arr = raw.astype(np_dt)
+            data = jnp.broadcast_to(arr, (b.capacity,))
+            return DevCol(data, jnp.ones(b.capacity, dtype=bool))
+
+        return _param
+    note_baked_param(e)
     if v is None:
         # typed NULL (e.g. the NULL left side of a FULL OUTER JOIN's
         # anti branch): carry the declared type's physical dtype so
@@ -932,6 +1059,7 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
         op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
     assert isinstance(lit, Literal)
     f, dictionary = string_expr(col, dicts)
+    note_baked_param(lit)
     if lit.value is None:
         # comparison with NULL is NULL for every row
         def _nullcmp(b):
@@ -1181,7 +1309,7 @@ def _compile_like(e: Func, dicts: DictContext) -> _CompiledExpr:
     col, pat = e.args
     assert isinstance(pat, Literal), "LIKE pattern must be a literal"
     negate = False
-    rx = _like_to_regex(str(pat.value))
+    rx = _like_to_regex(str(baked_value(pat)))
     return _compile_strlut(
         col, dicts, lambda s: bool(rx.match(s)) != negate, jnp.bool_
     )
@@ -1206,6 +1334,8 @@ def _compile_strlut(col: Expr, dicts: DictContext, pyfn, out_dtype) -> _Compiled
 def _compile_in(e: Func, dicts: DictContext) -> _CompiledExpr:
     col, *lits = e.args
     # MySQL: x IN (a, b, NULL) is TRUE on match, otherwise NULL.
+    for l in lits:
+        note_baked_param(l)
     has_null = any(l.value is None for l in lits)
     lits = [l for l in lits if l.value is not None]
     if _is_string_col(col):
@@ -1305,6 +1435,7 @@ def _compile_math(e: Func, dicts: DictContext) -> _CompiledExpr:
             raise NotImplementedError(
                 f"{op.upper()} digits must be a literal"
             )
+        note_baked_param(e.args[1])
         if e.args[1].value is None:
             # MySQL: ROUND(x, NULL) is NULL for every row
             ndt = jnp.float64 if e.type.kind == Kind.FLOAT else jnp.int64

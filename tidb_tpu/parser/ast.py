@@ -31,6 +31,10 @@ class Name:
 class Const:
     value: object
     type_hint: Optional[SQLType] = None  # DATE '...' etc.
+    # set for '?' placeholders (0-based): prepared statements bind the
+    # value per EXECUTE, and the compiled plan reads it as a runtime
+    # input where safe (expression param slots)
+    param_index: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -356,6 +360,23 @@ class Explain:
 class Show:
     what: str  # "tables" | "databases" | "variables"
     db: Optional[str] = None  # for variables: LIKE pattern
+
+
+@dataclasses.dataclass
+class PrepareStmt:
+    name: str
+    sql: str
+
+
+@dataclasses.dataclass
+class ExecuteStmt:
+    name: str
+    using: List[str] = dataclasses.field(default_factory=list)  # @vars
+
+
+@dataclasses.dataclass
+class DeallocateStmt:
+    name: str
 
 
 @dataclasses.dataclass

@@ -151,6 +151,7 @@ class Parser:
             or (j > 0 and toks[j - 1].kind == "kw" and toks[j - 1].text == "select")
         ]
         self.i = 0
+        self._param_count = 0  # '?' placeholders seen (prepared stmts)
 
     # -- token helpers -----------------------------------------------------
     @property
@@ -243,6 +244,34 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if self._at_ident("prepare"):
+            # PREPARE name FROM '<sql>'
+            self.advance()
+            name = self.expect_ident()
+            self.expect_kw("from")
+            t = self.cur
+            if t.kind != "str":
+                raise ParseError(f"expected statement string at {t.pos}")
+            self.advance()
+            return ast.PrepareStmt(name.lower(), t.text)
+        if self._at_ident("execute"):
+            self.advance()
+            name = self.expect_ident()
+            using = []
+            if self.at_kw("using") or self._at_ident("using"):
+                self.advance()
+                while True:
+                    self.expect_op("@")
+                    using.append(self.expect_ident().lower())
+                    if not self.accept_op(","):
+                        break
+            return ast.ExecuteStmt(name.lower(), using)
+        if self._at_ident("deallocate"):
+            self.advance()
+            if not self._at_ident("prepare"):
+                raise ParseError("expected PREPARE after DEALLOCATE")
+            self.advance()
+            return ast.DeallocateStmt(self.expect_ident().lower())
         if self._at_ident("describe") or self.at_kw("desc"):
             self.advance()
             db, name = self._qualified_name()
@@ -373,6 +402,23 @@ class Parser:
 
     def parse_set(self):
         self.expect_kw("set")
+        if self.at_op("@"):
+            # SET @name = <literal> (user variable; EXECUTE ... USING)
+            self.advance()
+            uname = self.expect_ident().lower()
+            self.expect_op("=")
+            val = self.parse_expr()
+            if (
+                isinstance(val, ast.Call)
+                and val.op == "neg"
+                and len(val.args) == 1
+                and isinstance(val.args[0], ast.Const)
+                and isinstance(val.args[0].value, (int, float))
+            ):
+                val = ast.Const(-val.args[0].value)
+            if not isinstance(val, ast.Const):
+                raise ParseError("user variables accept literal values")
+            return ast.SetVariable("@" + uname, val.value, "user")
         scope = "session"
         if self.accept_kw("global"):
             scope = "global"
@@ -969,6 +1015,12 @@ class Parser:
         if t.kind == "str":
             self.advance()
             return ast.Const(t.text)
+        if t.kind == "op" and t.text == "?":
+            # prepared-statement placeholder; value bound per EXECUTE
+            self.advance()
+            idx = self._param_count
+            self._param_count += 1
+            return ast.Const(None, param_index=idx)
         if self.at_kw("null"):
             self.advance()
             return ast.Const(None)

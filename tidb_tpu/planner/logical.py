@@ -234,7 +234,10 @@ class ExprBinder:
             return ColumnRef(name=c.internal)
         if isinstance(e, ast.Const):
             t = e.type_hint
-            return Literal(type=t, value=e.value)
+            return Literal(
+                type=t, value=e.value,
+                param_slot=getattr(e, "param_index", None),
+            )
         if isinstance(e, ast.Interval):
             raise PlanError("INTERVAL outside date arithmetic")
         if isinstance(e, ast.SubqueryExpr):

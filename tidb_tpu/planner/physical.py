@@ -284,7 +284,11 @@ def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
                 and isinstance(c.args[1], Literal)
                 and isinstance(c.args[2], Literal)
             ):
-                x, y = scaled(c.args[1].value), scaled(c.args[2].value)
+                from tidb_tpu.expression.kernels import baked_value
+
+                x, y = scaled(baked_value(c.args[1])), scaled(
+                    baked_value(c.args[2])
+                )
                 if x is not None and y is not None:
                     xl, yh = bound_lo(x, False), bound_hi(y, False)
                     lo = xl if lo is None else max(lo, xl)
@@ -298,7 +302,9 @@ def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
             a, b, op = b, a, flip[op]
         else:
             continue
-        x = scaled(b.value)
+        from tidb_tpu.expression.kernels import baked_value
+
+        x = scaled(baked_value(b))
         if x is None:
             continue
         if op == "eq":
@@ -1388,6 +1394,10 @@ class PhysicalExecutor:
         self.stream_rows = -1
         # kill safepoint hook (utils/sqlkiller): raises to abort
         self.kill_check = None
+        # prepared-statement parameter bindings for the CURRENT statement
+        # (slot -> numpy scalar in physical encoding); the session sets
+        # them before run(). Empty for plain statements.
+        self.param_values: Dict[int, object] = {}
         self.mesh = None
         self.mesh_n = mesh_devices
         if mesh_devices:
@@ -1400,6 +1410,14 @@ class PhysicalExecutor:
             return self.table_hook(db, table)
         t = self.catalog.table(db, table)
         return t, t.version
+
+    def _params(self) -> Dict[int, "jax.Array"]:
+        """Current prepared-statement bindings as device scalars (the
+        second argument of every compiled program). Mesh programs never
+        see runtime parameters (values are baked there)."""
+        if not self.param_values or self.mesh is not None:
+            return {}
+        return {k: jnp.asarray(v) for k, v in self.param_values.items()}
 
     def _cache_key(self, plan: L.LogicalPlan) -> tuple:
         fp = plan_fingerprint(plan)
@@ -1461,13 +1479,23 @@ class PhysicalExecutor:
         return inputs
 
     def _make_program(self, cq: CompiledQuery, frozen_caps: Dict[int, int]):
-        """The whole-query callable over global inputs: plain plan fn on
-        one device, or the shard_map-wrapped SPMD program on a mesh (the
-        entire fragment tree is ONE collective XLA program — exchanges
-        are all_to_all/all_gather inside, not RPCs)."""
+        """The whole-query callable over (inputs, params): plain plan fn
+        on one device, or the shard_map-wrapped SPMD program on a mesh
+        (the entire fragment tree is ONE collective XLA program —
+        exchanges are all_to_all/all_gather inside, not RPCs). `params`
+        is the prepared-statement parameter dict (slot -> scalar array),
+        made visible to compiled literal readers during tracing; empty
+        for plain statements, and always empty on a mesh (the session
+        bakes parameter values into mesh plans)."""
         fn = cq.fn
         if self.mesh is None:
-            return lambda i, _f=fn, _c=frozen_caps: _f(i, _c)
+            from tidb_tpu.expression.kernels import param_scope
+
+            def prog(i, p, _f=fn, _c=frozen_caps):
+                with param_scope(p):
+                    return _f(i, _c)
+
+            return prog
         from jax.sharding import PartitionSpec as P
 
         n = self.mesh_n
@@ -1487,7 +1515,7 @@ class PhysicalExecutor:
 
             repl = NamedSharding(self.mesh, P())
 
-            def run_repl(i):
+            def run_repl(i, _p=None):
                 b, needs = sm(i)
                 # replicated output: every shard emitted an identical full
                 # copy; reshard (so the slice is legal for any mesh size)
@@ -1498,7 +1526,7 @@ class PhysicalExecutor:
                 return b, needs
 
             return run_repl
-        return sm
+        return lambda i, _p=None, _sm=sm: _sm(i)
 
     def _admit(self, cq: CompiledQuery, inputs, caps) -> None:
         """Quota admission: pre-account every static buffer (scan batches
@@ -1562,8 +1590,8 @@ class PhysicalExecutor:
             else:
                 # eager single-device path (EXPLAIN ANALYZE instrumentation)
                 fn = cq.fn
-                jitted = lambda i, _f=fn, _c=frozen: _f(i, _c)
-            out, needs = jitted(inputs)
+                jitted = lambda i, _p, _f=fn, _c=frozen: _f(i, _c)
+            out, needs = jitted(inputs, self._params())
             needs_host = jax.device_get(needs)
             bumped = False
             for nid, true_n in needs_host.items():
@@ -1655,7 +1683,7 @@ class PhysicalExecutor:
         shape_key = tuple(sorted((nid, b.capacity) for nid, b in inputs.items()))
 
         if cq.jitted is not None and cq.input_shape_key == shape_key:
-            out, needs = cq.jitted(inputs)
+            out, needs = cq.jitted(inputs, self._params())
             # ONE device->host round trip: output batch + cardinality
             # scalars together. Also warms each array's host-value cache so
             # the session's materialization re-reads are free.
@@ -1674,13 +1702,13 @@ class PhysicalExecutor:
             cq.input_shape_key = shape_key
             program = self._make_program(cq, dict(caps))
             cq.jitted = jax.jit(
-                lambda i, _p=program, _oc=out_cap: _steady_step(
-                    _p, _oc, i, mesh=self.mesh
+                lambda i, pv, _p=program, _oc=out_cap: _steady_step(
+                    _p, _oc, i, pv, mesh=self.mesh
                 )
             )
             # compile + run the steady program now so every later run is a
             # single launch + single fetch
-            out, needs = cq.jitted(inputs)
+            out, needs = cq.jitted(inputs, self._params())
             needs_host = jax.device_get((needs, out))[0]
             if not _overflowed(needs_host, cq.caps):
                 return out, cq.out_dicts
@@ -1733,13 +1761,13 @@ class PhysicalExecutor:
 _OUT_NODE = -1
 
 
-def _steady_step(program, out_cap, inputs, mesh=None):
+def _steady_step(program, out_cap, inputs, params=None, mesh=None):
     """Steady-state whole-query program: plan (possibly a shard_map SPMD
     program) + output compaction + output cardinality, in one XLA launch.
     Compaction runs on the global (post-shard_map) arrays; on a mesh the
     result is resharded to replicated first (the compaction gather is not
     expressible over a row-sharded operand)."""
-    out, needs = program(inputs)
+    out, needs = program(inputs, params)
     needs = dict(needs)
     needs[_OUT_NODE] = jnp.sum(out.row_valid.astype(jnp.int32))
     if out_cap < out.capacity:

@@ -260,14 +260,17 @@ class _StreamPlan:
         key = ("partial", cap, tuple(sorted(caps.items())))
         j = self.jits.get(key)
         if j is None:
+            from tidb_tpu.expression.kernels import param_scope
+
             frozen = dict(caps)
 
-            def step(inputs, _cap=cap, _caps=frozen):
-                piped, needs = self.pipe_fn(inputs, _caps)
-                out, ng = group_aggregate(
-                    piped, self.key_fns, self.partial, _cap, self.key_names,
-                    key_widths=self.key_widths,
-                )
+            def step(inputs, params, _cap=cap, _caps=frozen):
+                with param_scope(params):
+                    piped, needs = self.pipe_fn(inputs, _caps)
+                    out, ng = group_aggregate(
+                        piped, self.key_fns, self.partial, _cap,
+                        self.key_names, key_widths=self.key_widths,
+                    )
                 return out, ng, needs
 
             j = self.jits[key] = jax.jit(step)
@@ -426,7 +429,9 @@ def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, di
             inputs = dict(inputs_base)
             inputs[sp.big_site.node_id] = chunk
             for _retry in range(24):
-                out, ng, needs = sp.chunk_step(cap, caps)(inputs)
+                out, ng, needs = sp.chunk_step(cap, caps)(
+                    inputs, executor._params()
+                )
                 got = jax.device_get((ng, needs))
                 ngi = int(got[0])
                 if ngi >= WIDTH_STALE:
@@ -666,11 +671,14 @@ def try_streamed_sort(executor, plan, conservative=False):
         def step_for(caps_t):
             j = sp.jits.get(caps_t)
             if j is None:
+                from tidb_tpu.expression.kernels import param_scope
+
                 frozen = dict(caps)
 
-                def step(inputs, _caps=frozen):
-                    b, needs = sp.pipe_fn(inputs, _caps)
-                    keys = [f(b) for f in sp.key_fns]
+                def step(inputs, params, _caps=frozen):
+                    with param_scope(params):
+                        b, needs = sp.pipe_fn(inputs, _caps)
+                        keys = [f(b) for f in sp.key_fns]
                     return b, keys, needs
 
                 j = sp.jits[caps_t] = jax.jit(step)
@@ -684,7 +692,9 @@ def try_streamed_sort(executor, plan, conservative=False):
             inputs = dict(inputs_base)
             inputs[big_site.node_id] = chunk
             for _retry in range(24):
-                b, keys, needs = step_for(tuple(sorted(caps.items())))(inputs)
+                b, keys, needs = step_for(tuple(sorted(caps.items())))(
+                    inputs, executor._params()
+                )
                 needs_host = jax.device_get(needs)
                 bumped = False
                 for nid, n in needs_host.items():

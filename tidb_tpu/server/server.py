@@ -156,6 +156,11 @@ class Server:
                     nparams = P.count_placeholders(sql)
                     next_stmt_id[0] += 1
                     sid = next_stmt_id[0]
+                    # session-level parameterized plan (plan_cache.go
+                    # analog): EXECUTE binds values as runtime inputs of
+                    # the cached compiled plan instead of re-planning
+                    # re-rendered SQL text
+                    sess.prepare(f"__c{sid}", sql)
                     stmts[sid] = [sql, nparams, None]  # [sql, n, param types]
                     io.write_packet(P.stmt_prepare_ok(sid, 0, nparams))
                     if nparams:
@@ -174,12 +179,17 @@ class Server:
                         payload, nparams, ptypes
                     )
                     stmts[sid][2] = ptypes
-                    bound = P.bind_placeholders(sql, params)
-                    self._run_query(io, sess, bound, binary=True)
+                    r = sess.execute_prepared(f"__c{sid}", params)
+                    self._write_result(io, r, binary=True, sess=sess)
                 elif cmd == COM_STMT_CLOSE:
                     import struct as _st
 
-                    stmts.pop(_st.unpack_from("<I", payload, 0)[0], None)
+                    csid = _st.unpack_from("<I", payload, 0)[0]
+                    if stmts.pop(csid, None) is not None:
+                        try:
+                            sess.deallocate(f"__c{csid}")
+                        except ValueError:
+                            pass
                     # no response by protocol
                 elif cmd == COM_STMT_RESET:
                     io.write_packet(P.ok_packet())
@@ -197,6 +207,11 @@ class Server:
         self, io: P.PacketIO, sess: Session, sql: str, binary: bool = False
     ) -> None:
         r = sess.execute(sql)
+        self._write_result(io, r, binary=binary, sess=sess)
+
+    def _write_result(
+        self, io: P.PacketIO, r, binary: bool = False, sess=None
+    ) -> None:
         if not r.columns:
             io.write_packet(
                 P.ok_packet(

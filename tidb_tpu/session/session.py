@@ -39,6 +39,69 @@ class Result:
         return sorted(self.rows, key=lambda r: tuple((v is None, str(v)) for v in r))
 
 
+def _walk_dataclasses(obj, fn, _seen=None):
+    """Generic pre-order walk over a dataclass tree (lists/tuples/dicts
+    descended); fn(node) on every dataclass instance."""
+    if _seen is None:
+        _seen = set()
+    if isinstance(obj, (list, tuple)):
+        for x in obj:
+            _walk_dataclasses(x, fn, _seen)
+        return
+    if isinstance(obj, dict):
+        for x in obj.values():
+            _walk_dataclasses(x, fn, _seen)
+        return
+    if not dataclasses.is_dataclass(obj) or isinstance(obj, type):
+        return
+    if id(obj) in _seen:
+        return
+    _seen.add(id(obj))
+    fn(obj)
+    for f in dataclasses.fields(obj):
+        _walk_dataclasses(getattr(obj, f.name), fn, _seen)
+
+
+def _count_params(stmt) -> int:
+    mx = [-1]
+
+    def see(n):
+        idx = getattr(n, "param_index", None)
+        if isinstance(n, ast.Const) and idx is not None:
+            mx[0] = max(mx[0], idx)
+
+    _walk_dataclasses(stmt, see)
+    return mx[0] + 1
+
+
+def _bind_ast_params(stmt, values) -> None:
+    """Write EXECUTE's values into the template's '?' Const nodes (in
+    place — the template is session-private)."""
+
+    def see(n):
+        idx = getattr(n, "param_index", None)
+        if isinstance(n, ast.Const) and idx is not None:
+            n.value = values[idx]
+            n.type_hint = None
+
+    _walk_dataclasses(stmt, see)
+
+
+def _collect_param_literals(plan) -> dict:
+    """slot -> bound Literal surviving in a logical plan (their types
+    drive the host-side encode of later EXECUTE bindings)."""
+    from tidb_tpu.expression.expr import Literal as _Lit
+
+    out = {}
+
+    def see(n):
+        if isinstance(n, _Lit) and n.param_slot is not None:
+            out.setdefault(n.param_slot, n)
+
+    _walk_dataclasses(plan, see)
+    return out
+
+
 class Session:
     def __init__(
         self,
@@ -77,6 +140,12 @@ class Session:
         self.executor.kill_check = self.killer.check
         self.executor.table_hook = self._resolve_table_for_read
         self.last_insert_id = 0
+        # prepared statements (reference: pkg/planner/core/plan_cache.go
+        # parameterized plans): name -> entry with the parsed template,
+        # cached logical plan, and runtime/baked parameter-slot split
+        self._prepared = {}
+        self.user_vars = {}
+        self._last_plan = None
 
     # -- transaction plumbing ------------------------------------------
     def _resolve_table_for_read(self, db: str, name: str):
@@ -262,6 +331,163 @@ class Session:
 
         walk(s)
         return out
+
+    # -- prepared statements (parameterized plan cache) ----------------
+    # Reference: pkg/planner/core/plan_cache.go:231 — EXECUTE reuses the
+    # compiled plan with new parameter values bound as runtime inputs.
+    # Slots the compiler could not parameterize (LIKE patterns, IN sets,
+    # string dictionary lookups, pushed PK ranges, any stage that ran
+    # without the parameter scope) register as BAKED: a change in those
+    # values replans; changes in runtime slots re-run the same jitted
+    # program with new scalars.
+    def prepare(self, name: str, sql: str) -> None:
+        try:
+            stmts = parse(sql)
+        except Exception:
+            # placeholders in positions the grammar can't hold as
+            # expressions (LIMIT ? / OFFSET ?): fall back to textual
+            # binding — EXECUTE renders literals into the SQL and runs
+            # the statement pipeline (the pre-parameterized behavior)
+            from tidb_tpu.server.protocol import count_placeholders
+
+            self._prepared[name.lower()] = {
+                "textual": sql,
+                "nparams": count_placeholders(sql),
+            }
+            return
+        if len(stmts) != 1:
+            raise ValueError("PREPARE expects exactly one statement")
+        nparams = _count_params(stmts[0])
+        self._prepared[name.lower()] = {
+            "ast": stmts[0],
+            "nparams": nparams,
+            "plan": None,
+        }
+
+    def deallocate(self, name: str) -> None:
+        if self._prepared.pop(name.lower(), None) is None:
+            raise ValueError(f"unknown prepared statement {name}")
+
+    @staticmethod
+    def _canonical_param(v):
+        """Numeric canonical encoding for a runtime slot binding, or
+        None when the value can only bake (strings, NULL, bool)."""
+        if isinstance(v, bool) or v is None:
+            return None
+        if isinstance(v, int):
+            return np.asarray(v, dtype=np.int64)
+        if isinstance(v, float):
+            return np.asarray(v, dtype=np.float64)
+        return None
+
+    def execute_prepared(self, name: str, values) -> Result:
+        from tidb_tpu.expression.kernels import param_registry
+        from tidb_tpu.planner.physical import StaleWidthsError
+
+        ent = self._prepared.get(name.lower())
+        if ent is None:
+            raise ValueError(f"unknown prepared statement {name}")
+        values = list(values)
+        if len(values) != ent["nparams"]:
+            raise ValueError(
+                f"statement expects {ent['nparams']} parameters, "
+                f"got {len(values)}"
+            )
+        if "textual" in ent:
+            from tidb_tpu.server.protocol import bind_placeholders
+
+            return self.execute(bind_placeholders(ent["textual"], values))
+        types_sig = tuple(type(v).__name__ for v in values)
+
+        # fast path: the held CompiledQuery re-runs with new runtime-slot
+        # values as jitted-program inputs — no parse, no plan, no trace
+        if (
+            ent.get("plan") is not None
+            and ent.get("schema_version") == self.catalog.schema_version
+            and ent.get("types_sig") == types_sig
+            and all(values[i] == ent["values"][i] for i in ent["baked"])
+        ):
+            self._enforce_privileges(ent["ast"])
+            cq = ent.get("cq")
+            # the cq's baked dictionaries key on table versions: reuse
+            # only while the fingerprint key (which carries them) holds
+            if cq is not None and self.executor._cache_key(ent["plan"]) == ent["ckey"]:
+                # same slot set as the slow-path trace: a different
+                # params pytree structure would force a jax retrace
+                pv = {
+                    i: self._canonical_param(values[i])
+                    for i in ent["pv_slots"]
+                }
+                self.executor.param_values = pv
+                try:
+                    fu = ent.get("for_update") or []
+                    run = lambda: self._materialize_prepared(ent, cq)
+                    return (
+                        self._with_write_locks(fu, run) if fu else run()
+                    )
+                except StaleWidthsError:
+                    ent["plan"] = None  # fall through to replan below
+                finally:
+                    self.executor.param_values = {}
+
+        # slow path: substitute values into the template and run the
+        # full statement pipeline, capturing which slots stayed runtime.
+        # Numeric values are offered as runtime bindings during the
+        # compile so eligible literals trace as program inputs.
+        s = ent["ast"]
+        # mesh sessions never thread runtime params (_params() is empty
+        # there): every slot bakes and EXECUTE replans per value change
+        mesh = self.executor.mesh_n is not None
+        _bind_ast_params(s, values)
+        self._last_plan = None
+        pv = {}
+        if not mesh:
+            for i, v in enumerate(values):
+                c = self._canonical_param(v)
+                if c is not None:
+                    pv[i] = c
+        self.executor.param_values = pv
+        try:
+            with param_registry() as reg:
+                r = self._execute_stmt(s)
+        finally:
+            self.executor.param_values = {}
+        plan = self._last_plan
+        runtime = set()
+        cq = ckey = None
+        if plan is not None and not mesh:
+            lits = _collect_param_literals(plan)
+            runtime = (reg.runtime - reg.baked) & set(lits) & set(pv)
+            if runtime:
+                ckey = self.executor._cache_key(plan)
+                cq = self.executor._cache.get(ckey)
+        ent.update(
+            pv_slots=set(pv),
+            plan=plan if (runtime and cq is not None) else None,
+            cq=cq,
+            ckey=ckey,
+            runtime=runtime,
+            baked=set(range(ent["nparams"])) - runtime,
+            values=list(values),
+            types_sig=types_sig,
+            schema_version=self.catalog.schema_version,
+            for_update=self._for_update_tables(s)
+            if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp))
+            else [],
+        )
+        return r
+
+    def _materialize_prepared(self, ent, cq) -> Result:
+        pins = []
+        try:
+            batch, dicts = self.executor._run_pinned(cq, pins)
+        finally:
+            for t, v in pins:
+                t.unpin(v)
+        plan = ent["plan"]
+        rows = materialize_rows(batch, list(plan.schema), dicts)
+        names = [c.name for c in plan.schema]
+        return Result(names, rows, types=[c.type for c in plan.schema])
 
     def _run_txn_control(self, s) -> Result:
         from tidb_tpu.utils import failpoint
@@ -545,16 +771,11 @@ class Session:
 
     def _ast_tables(self, node, out=None):
         """All TableRefs in a statement tree (generic dataclass walk)."""
-        if out is None:
-            out = []
-        if isinstance(node, ast.TableRef):
-            out.append(node)
-        if dataclasses.is_dataclass(node) and not isinstance(node, type):
-            for f in dataclasses.fields(node):
-                self._ast_tables(getattr(node, f.name), out)
-        elif isinstance(node, (list, tuple)):
-            for x in node:
-                self._ast_tables(x, out)
+        out = [] if out is None else out
+        _walk_dataclasses(
+            node,
+            lambda n: out.append(n) if isinstance(n, ast.TableRef) else None,
+        )
         return out
 
     def _enforce_privileges(self, s) -> None:
@@ -1036,7 +1257,23 @@ class Session:
         elif isinstance(s, ast.Show):
             r = self._run_show(s)
         elif isinstance(s, ast.SetVariable):
-            self.vars.set(s.name, s.value, s.scope)
+            if s.scope == "user":
+                self.user_vars[s.name.lstrip("@")] = s.value
+            else:
+                self.vars.set(s.name, s.value, s.scope)
+            r = Result([], [])
+        elif isinstance(s, ast.PrepareStmt):
+            self.prepare(s.name, s.sql)
+            r = Result([], [])
+        elif isinstance(s, ast.ExecuteStmt):
+            vals = []
+            for v in s.using:
+                if v not in self.user_vars:
+                    raise ValueError(f"user variable @{v} is not set")
+                vals.append(self.user_vars[v])
+            r = self.execute_prepared(s.name, vals)
+        elif isinstance(s, ast.DeallocateStmt):
+            self.deallocate(s.name)
             r = Result([], [])
         elif isinstance(s, ast.Trace):
             self.tracer.enabled = True
@@ -1554,6 +1791,7 @@ class Session:
             # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
             with self.tracer.span("session.plan"):
                 plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
+            self._last_plan = plan  # prepared-statement plan capture
             with self.tracer.span("executor.run"):
                 hs = self._try_host_sorted(plan)
                 if hs is not None:
