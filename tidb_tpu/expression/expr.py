@@ -108,6 +108,19 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
     assert isinstance(e, Func)
     args = tuple(bind_expr(a, schema) for a in e.args)
     args = _coerce_date_literals(e.op, args)
+    if e.op == "time_to_sec" and args:
+        a0 = args[0]
+        if (
+            isinstance(a0, Literal)
+            and a0.type is not None
+            and a0.type.kind == Kind.STRING
+            and isinstance(a0.value, str)
+        ):
+            from tidb_tpu.dtypes import TIME as _T, time_to_micros
+
+            args = (
+                Literal(type=_T, value=int(time_to_micros(a0.value))),
+            ) + args[1:]
     if e.op == "neg" and isinstance(args[0], Literal):
         v = args[0].value
         if isinstance(v, str):
@@ -227,12 +240,36 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "length", "char_length", "ascii", "locate", "sign",
         "json_valid", "json_length", "field",
         "datediff", "floor", "ceil",
+        "to_days", "week", "weekofyear", "unix_timestamp", "time_to_sec",
+        "timestampdiff", "ord", "bit_length", "crc32",
+        "find_in_set", "regexp_instr", "interval_fn",
     }:
         return INT64
+    if op in {"regexp", "regexp_like"}:
+        return BOOL
+    if op in {"from_days", "last_day", "makedate"}:
+        from tidb_tpu.dtypes import DATE as _D
+
+        return _D
+    if op == "from_unixtime":
+        return SQLType(Kind.DATETIME)
+    if op == "sec_to_time":
+        return SQLType(Kind.TIME)
+    if op == "str_to_date":
+        # format literal decides DATE vs DATETIME (time tokens present)
+        fmt = args[1].value if isinstance(args[1], Literal) else ""
+        from tidb_tpu.dtypes import DATE as _D
+
+        if any(tok in str(fmt) for tok in ("%H", "%i", "%s", "%S", "%T", "%r", "%f", "%h", "%I", "%k", "%l", "%p")):
+            return SQLType(Kind.DATETIME)
+        return _D
     if op in {
         "substr", "substring", "upper", "lower", "trim", "ltrim", "rtrim",
         "replace", "left", "right", "reverse", "lpad", "rpad", "repeat",
         "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
+        "quote", "insert_str", "regexp_substr", "regexp_replace",
+        "md5", "sha1", "sha2", "hex_str", "dayname", "monthname",
+        "date_format", "substring_index", "hex", "bin", "oct",
     }:
         return STRING
     if op in {

@@ -202,6 +202,23 @@ def literal_phys(v, t):
     return v
 
 
+# keep in sync with planner.physical._BOUNDS_PREFIX (defined there; not
+# imported to avoid a kernels <- physical cycle)
+_BOUNDS_PREFIX_K = "\x00b\x00"
+
+
+def _int_bounds(e, dicts):
+    """(lo, hi) bounds of a plain integer column from the dicts map's
+    reserved entries (Table.col_bounds via the planner), or None."""
+    if not isinstance(e, ColumnRef):
+        return None
+    ent = dicts.get(_BOUNDS_PREFIX_K + e.name)
+    if ent is None:
+        return None
+    get = getattr(ent, "get", None)
+    return get() if callable(get) else ent
+
+
 def _string_literal_code(dictionary: np.ndarray, value: str):
     """(code position, exact_match) for a literal against a sorted dict."""
     pos = int(np.searchsorted(dictionary, value))
@@ -285,6 +302,122 @@ def string_expr(e: Expr, dicts: DictContext):
             return DevCol(lut[cl], c.valid & ok_j[cl])
 
         return _tf, new_dict
+    if isinstance(e, Func) and e.op in ("dayname", "monthname"):
+        # date -> name: device index math + a fixed sorted dictionary
+        names = (
+            ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+             "Saturday", "Sunday"]
+            if e.op == "dayname"
+            else ["January", "February", "March", "April", "May", "June",
+                  "July", "August", "September", "October", "November",
+                  "December"]
+        )
+        new_dict = np.array(sorted(names), dtype=object)
+        idx_to_code = jnp.asarray(
+            np.searchsorted(new_dict, np.array(names, dtype=object)).astype(
+                np.int32
+            )
+        )
+        f = _compile(e.args[0], dicts)
+        t0 = e.args[0].type
+        is_day = e.op == "dayname"
+
+        def _dn(b):
+            c = f(b)
+            days = _to_days(c.data, t0)
+            if is_day:
+                idx = (days + 3) % 7  # Monday=0 matches names order
+            else:
+                _y, m, _d = _civil_from_days(days)
+                idx = m - 1
+            return DevCol(idx_to_code[idx], c.valid)
+
+        return _dn, new_dict
+    if isinstance(e, Func) and e.op in ("hex", "bin", "oct"):
+        t0 = e.args[0].type
+        if t0 is not None and t0.kind == Kind.STRING:
+            if e.op != "hex":
+                raise NotImplementedError(f"{e.op.upper()} of a string")
+            return string_expr(
+                Func(type=e.type, op="hex_str", args=e.args), dicts
+            )
+        if isinstance(e.args[0], Literal):
+            # e.g. HEX(-5): negation folds post-lowering, so the const
+            # arrives here as a bound literal
+            v = baked_value(e.args[0])
+            if v is None:
+                lit = Literal(type=e.type, value=None)
+            else:
+                fmt0 = {"hex": "X", "bin": "b", "oct": "o"}[e.op]
+                iv = int(v)
+                if iv < 0:
+                    iv &= (1 << 64) - 1
+                lit = Literal(type=e.type, value=format(iv, fmt0))
+            return string_expr(lit, dicts)
+        # bounded integer column -> base-converted strings via a range
+        # LUT (bounds from Table.col_bounds riding the dicts map; see
+        # planner.physical._BOUNDS_PREFIX)
+        cb = _int_bounds(e.args[0], dicts)
+        if cb is None or cb[1] - cb[0] > (1 << 16):
+            raise NotImplementedError(
+                f"{e.op.upper()} needs a string or narrowly-bounded "
+                "integer column"
+            )
+        lo, hi = int(cb[0]), int(cb[1])
+        fmt = {"hex": "X", "bin": "b", "oct": "o"}[e.op]
+        # negatives render as 64-bit two's complement, like MySQL
+        outs = [
+            format(v & ((1 << 64) - 1) if v < 0 else v, fmt)
+            for v in range(lo, hi + 1)
+        ]
+        new_dict = np.array(sorted(set(outs)), dtype=object)
+        codes = np.searchsorted(new_dict, np.array(outs, dtype=object))
+        lut = jnp.asarray(codes.astype(np.int32))
+        f = _compile(e.args[0], dicts)
+
+        def _i2s(b):
+            c = f(b)
+            idx = jnp.clip(c.data.astype(jnp.int64) - lo, 0, hi - lo)
+            return DevCol(lut[idx], c.valid)
+
+        return _i2s, new_dict
+    if isinstance(e, Func) and e.op == "date_format":
+        # DATE_FORMAT over a bounded practical range: precomputed
+        # day->string LUT for 1900-01-01..2155-12-31 (the engine's
+        # supported formatting window; values outside clamp)
+        import datetime as _dt
+
+        raw_fmt = str(baked_value(e.args[1]))
+        t0 = e.args[0].type
+        if t0 is not None and t0.kind == Kind.DATETIME and any(
+            tok in raw_fmt
+            for tok in ("%H", "%i", "%s", "%S", "%T", "%r", "%f", "%h",
+                        "%I", "%k", "%l", "%p")
+        ):
+            # the LUT is day-granular; rendering time-of-day tokens as
+            # midnight would silently return wrong data
+            raise NotImplementedError(
+                "DATE_FORMAT with time tokens over DATETIME"
+            )
+        fmt = _mysql_fmt_to_py(raw_fmt)
+        f = _compile(e.args[0], dicts)
+        lo = _dt.date(1900, 1, 1).toordinal() - _dt.date(1970, 1, 1).toordinal()
+        hi = _dt.date(2155, 12, 31).toordinal() - _dt.date(1970, 1, 1).toordinal()
+        epoch = _dt.date(1970, 1, 1).toordinal()
+        outs = [
+            _dt.date.fromordinal(epoch + d).strftime(fmt)
+            for d in range(lo, hi + 1)
+        ]
+        new_dict = np.array(sorted(set(outs)), dtype=object)
+        codes = np.searchsorted(new_dict, np.array(outs, dtype=object))
+        lut = jnp.asarray(codes.astype(np.int32))
+
+        def _df(b):
+            c = f(b)
+            days = jnp.clip(_to_days(c.data, t0), lo, hi) - lo
+            return DevCol(lut[days], c.valid)
+
+        return _df, new_dict
     if isinstance(e, Func) and e.op == "concat":
         return _concat_expr(e, dicts)
     if isinstance(e, Func) and e.op == "concat_ws":
@@ -447,6 +580,8 @@ def _json_pyfn(e: Func):
 _STR_TRANSFORMS = {
     "upper", "lower", "trim", "ltrim", "rtrim", "replace", "substring",
     "left", "right", "reverse", "lpad", "rpad", "repeat",
+    "quote", "insert_str", "regexp_substr", "regexp_replace",
+    "md5", "sha1", "sha2", "hex_str", "substring_index",
 }
 
 
@@ -506,6 +641,63 @@ def _str_transform_pyfn(e: Func):
                 return s[i:]
             return s[i : i + max(ln, 0)]
         return _sub
+    if op == "substring_index":
+        delim, cnt = str(ex[0]), int(ex[1])
+
+        def _si(s):
+            if cnt == 0 or not delim:
+                return ""
+            parts = s.split(delim)
+            if cnt > 0:
+                return delim.join(parts[:cnt])
+            return delim.join(parts[cnt:])
+
+        return _si
+    if op == "quote":
+        return lambda s: "'" + s.replace("\\", "\\\\").replace("'", "\\'") + "'"
+    if op == "insert_str":
+        pos, ln, repl = int(ex[0]), int(ex[1]), str(ex[2])
+
+        def _ins(s):
+            if pos < 1 or pos > len(s):
+                return s
+            if ln < 0 or pos - 1 + ln >= len(s):
+                return s[: pos - 1] + repl  # MySQL: replace to the end
+            return s[: pos - 1] + repl + s[pos - 1 + ln:]
+
+        return _ins
+    if op == "regexp_substr":
+        rx = re.compile(str(ex[0]))
+
+        def _rs(s):
+            m = rx.search(s)
+            return m.group(0) if m else None  # no match -> SQL NULL
+
+        return _rs
+    if op == "regexp_replace":
+        rx = re.compile(str(ex[0]))
+        # MySQL capture refs are $N; python's re wants \N
+        repl = re.sub(r"\$(\d)", r"\\\1", str(ex[1]))
+        return lambda s: rx.sub(repl, s)
+    if op == "md5":
+        import hashlib
+
+        return lambda s: hashlib.md5(s.encode()).hexdigest()
+    if op == "sha1":
+        import hashlib
+
+        return lambda s: hashlib.sha1(s.encode()).hexdigest()
+    if op == "sha2":
+        import hashlib
+
+        bits = int(ex[0]) if ex else 256
+        algo = {224: "sha224", 256: "sha256", 384: "sha384", 512: "sha512",
+                0: "sha256"}.get(bits)
+        if algo is None:
+            return lambda s: None  # MySQL: invalid hash length -> NULL
+        return lambda s: getattr(hashlib, algo)(s.encode()).hexdigest()
+    if op == "hex_str":
+        return lambda s: s.encode().hex().upper()
     raise AssertionError(op)
 
 
@@ -810,10 +1002,83 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
     if op == "char_length":
         return _compile_strlut(e.args[0], dicts, lambda s: len(s), jnp.int64)
+    if op == "bit_length":
+        return _compile_strlut(
+            e.args[0], dicts, lambda s: len(s.encode()) * 8, jnp.int64
+        )
     if op == "ascii":
         return _compile_strlut(
-            e.args[0], dicts, lambda s: ord(s[0]) if s else 0, jnp.int64
+            e.args[0], dicts, lambda s: s.encode()[0] if s else 0, jnp.int64
         )
+    if op == "ord":
+        # MySQL ORD: leading byte sequence value of the first character
+        def _ord(s):
+            if not s:
+                return 0
+            bs = s[0].encode()
+            v = 0
+            for byte in bs:
+                v = v * 256 + byte
+            return v
+
+        return _compile_strlut(e.args[0], dicts, _ord, jnp.int64)
+    if op == "crc32":
+        import zlib
+
+        return _compile_strlut(
+            e.args[0], dicts, lambda s: zlib.crc32(s.encode()), jnp.int64
+        )
+    if op == "find_in_set":
+        needle_e, setcol = e.args
+        if not isinstance(needle_e, Literal):
+            raise NotImplementedError("FIND_IN_SET needle must be a literal")
+        needle = baked_value(needle_e)
+        if needle is None:
+            return lambda b: DevCol(
+                jnp.zeros(b.capacity, dtype=jnp.int64),
+                jnp.zeros(b.capacity, dtype=bool),
+            )
+        nv = str(needle)
+
+        def _fis(s):
+            parts = s.split(",")
+            return parts.index(nv) + 1 if nv in parts else 0
+
+        return _compile_strlut(setcol, dicts, _fis, jnp.int64)
+    if op in ("regexp", "regexp_like"):
+        col, pat = e.args[0], e.args[1]
+        if not isinstance(pat, Literal):
+            raise NotImplementedError("REGEXP pattern must be a literal")
+        rx = re.compile(str(baked_value(pat)))
+        return _compile_strlut(
+            col, dicts, lambda s: rx.search(s) is not None, jnp.bool_
+        )
+    if op == "regexp_instr":
+        col, pat = e.args[0], e.args[1]
+        if not isinstance(pat, Literal):
+            raise NotImplementedError("REGEXP pattern must be a literal")
+        rx = re.compile(str(baked_value(pat)))
+
+        def _ri(s):
+            m = rx.search(s)
+            return (m.start() + 1) if m else 0
+
+        return _compile_strlut(col, dicts, _ri, jnp.int64)
+    if op == "interval_fn":
+        # INTERVAL(N, a, b, ...): index of the last arg <= N (args
+        # assumed ascending, per MySQL); NULL N -> -1
+        fns = [_compile(a, dicts) for a in e.args]
+
+        def _ivl(b):
+            n = fns[0](b)
+            cnt = jnp.zeros(b.capacity, dtype=jnp.int64)
+            for f in fns[1:]:
+                c = f(b)
+                le = c.valid & (c.data.astype(jnp.float64) <= n.data.astype(jnp.float64))
+                cnt = cnt + le.astype(jnp.int64)
+            return DevCol(jnp.where(n.valid, cnt, -1), jnp.ones(b.capacity, bool))
+
+        return _ivl
     if op == "locate":
         s, sub = e.args
         if not isinstance(sub, Literal):
@@ -828,6 +1093,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         return _compile_strlut(s, dicts, lambda v: v.find(needle) + 1, jnp.int64)
     if op in _STR_TRANSFORMS or op in (
         "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
+        "dayname", "monthname", "date_format", "hex", "bin", "oct",
     ):
         return string_expr(e, dicts)[0]
     if op in _MATH_UNARY_FLOAT or op in (
@@ -843,6 +1109,14 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         )
     if op in ("greatest", "least"):
         return _compile_extremum(e, dicts)
+    if op in (
+        "to_days", "from_days", "last_day", "week", "weekofyear",
+        "makedate", "unix_timestamp", "from_unixtime", "time_to_sec",
+        "sec_to_time", "timestampdiff",
+    ):
+        return _compile_date_misc(e, dicts)
+    if op == "str_to_date":
+        return _compile_str_to_date(e, dicts)
     raise NotImplementedError(f"compile op {op!r}")
 
 
@@ -1553,6 +1827,224 @@ def _days_from_civil(y, m, d):
     doy = (153 * mp + 2) // 5 + d - 1
     doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
     return era * 146097 + doe - 719468
+
+
+# MySQL day 0 of TO_DAYS/FROM_DAYS is year 0; the engine's epoch is
+# 1970-01-01, which is day 719528 in that reckoning
+_MYSQL_DAY0 = 719528
+
+
+def _compile_date_misc(e: Func, dicts: DictContext) -> _CompiledExpr:
+    """Calendar builtins that reduce to civil-date arithmetic on device
+    (reference: pkg/expression/builtin_time.go families)."""
+    op = e.op
+    from tidb_tpu.dtypes import US_PER_DAY
+
+    fns = [_compile(a, dicts) for a in e.args]
+    t0 = e.args[0].type if e.args else None
+
+    def unary(fn):
+        def _f(b):
+            c = fns[0](b)
+            data, valid = fn(c)
+            return DevCol(data, valid & c.valid)
+
+        return _f
+
+    if op == "to_days":
+        return unary(lambda c: (
+            (_to_days(c.data, t0) + _MYSQL_DAY0).astype(jnp.int64),
+            jnp.ones_like(c.valid),
+        ))
+    if op == "from_days":
+        return unary(lambda c: (
+            (c.data.astype(jnp.int64) - _MYSQL_DAY0).astype(jnp.int32),
+            jnp.ones_like(c.valid),
+        ))
+    if op == "last_day":
+        def _ld(c):
+            days = _to_days(c.data, t0)
+            y, m, _d = _civil_from_days(days)
+            y2 = jnp.where(m == 12, y + 1, y)
+            m2 = jnp.where(m == 12, 1, m + 1)
+            out = _days_from_civil(y2, m2, jnp.ones_like(m2)) - 1
+            return out.astype(jnp.int32), jnp.ones_like(c.valid)
+
+        return unary(_ld)
+    if op in ("week", "weekofyear"):
+        # weekofyear == WEEK(d, 3): ISO 8601 week number. WEEK(d)
+        # defaults to mode 0 (Sunday-start, weeks counted from 0).
+        def _week(c):
+            days = _to_days(c.data, t0)
+            y, _m, _d = _civil_from_days(days)
+            jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+            if op == "weekofyear":
+                # ISO: week containing the year's first Thursday is 1
+                dow = (days + 3) % 7  # Monday=0
+                thursday = days - dow + 3
+                ty, _tm, _td = _civil_from_days(thursday)
+                tjan1 = _days_from_civil(
+                    ty, jnp.ones_like(ty), jnp.ones_like(ty)
+                )
+                out = (thursday - tjan1) // 7 + 1
+            else:
+                # mode 0: weeks start Sunday; days before the first
+                # Sunday are week 0
+                jdow = (jan1 + 4) % 7  # Sunday=0
+                first_sunday = jan1 + (7 - jdow) % 7
+                out = jnp.where(
+                    days < first_sunday, 0, (days - first_sunday) // 7 + 1
+                )
+            return out.astype(jnp.int64), jnp.ones_like(c.valid)
+
+        return unary(_week)
+    if op == "makedate":
+        def _md(b):
+            cy, cn = fns[0](b), fns[1](b)
+            y = cy.data.astype(jnp.int64)
+            n = cn.data.astype(jnp.int64)
+            out = _days_from_civil(
+                y, jnp.ones_like(y), jnp.ones_like(y)
+            ) + n - 1
+            valid = cy.valid & cn.valid & (n >= 1)
+            return DevCol(out.astype(jnp.int32), valid)
+
+        return _md
+    if op == "unix_timestamp":
+        return unary(lambda c: (
+            _to_micros(c.data, t0) // 1_000_000,
+            jnp.ones_like(c.valid),
+        ))
+    if op == "from_unixtime":
+        return unary(lambda c: (
+            (c.data.astype(jnp.int64) * 1_000_000),
+            jnp.ones_like(c.valid),
+        ))
+    if op == "time_to_sec":
+        return unary(lambda c: (
+            c.data.astype(jnp.int64) // 1_000_000,
+            jnp.ones_like(c.valid),
+        ))
+    if op == "sec_to_time":
+        return unary(lambda c: (
+            c.data.astype(jnp.int64) * 1_000_000,
+            jnp.ones_like(c.valid),
+        ))
+    if op == "timestampdiff":
+        unit = str(baked_value(e.args[0])).lower()
+        fa, fb = fns[1], fns[2]
+        ta, tb = e.args[1].type, e.args[2].type
+
+        def _tsd(b):
+            a, c = fa(b), fb(b)
+            ua, ub = _to_micros(a.data, ta), _to_micros(c.data, tb)
+            if unit in ("microsecond", "second", "minute", "hour", "day", "week"):
+                div = {
+                    "microsecond": 1,
+                    "second": 1_000_000,
+                    "minute": 60_000_000,
+                    "hour": 3_600_000_000,
+                    "day": US_PER_DAY,
+                    "week": 7 * US_PER_DAY,
+                }[unit]
+                out = (ub - ua) // div
+                # MySQL truncates toward zero, jnp // floors
+                out = jnp.where(
+                    (ub < ua) & ((ub - ua) % div != 0), out + 1, out
+                )
+            else:  # month / quarter / year: civil month distance,
+                # decremented when the partial month is incomplete
+                da, db_ = ua // US_PER_DAY, ub // US_PER_DAY
+                ya, ma, dda = _civil_from_days(da)
+                yb, mb, ddb = _civil_from_days(db_)
+                months = (yb - ya) * 12 + (mb - ma)
+                toa, tob = ua % US_PER_DAY, ub % US_PER_DAY
+                fwd = (ddb < dda) | ((ddb == dda) & (tob < toa))
+                bwd = (ddb > dda) | ((ddb == dda) & (tob > toa))
+                months = jnp.where(
+                    (months > 0) & fwd, months - 1,
+                    jnp.where((months < 0) & bwd, months + 1, months),
+                )
+                out = {
+                    "month": months,
+                    "quarter": months // 3,
+                    "year": months // 12,
+                }.get(unit)
+                if out is None:
+                    raise NotImplementedError(f"TIMESTAMPDIFF unit {unit}")
+                if unit in ("quarter", "year"):
+                    d = 3 if unit == "quarter" else 12
+                    out = jnp.where(
+                        (months < 0) & (months % d != 0), out + 1, out
+                    )
+            return DevCol(out.astype(jnp.int64), a.valid & c.valid)
+
+        return _tsd
+    raise NotImplementedError(op)
+
+
+_MYSQL_FMT = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%d": "%d", "%H": "%H",
+    "%i": "%M", "%s": "%S", "%S": "%S", "%M": "%B", "%b": "%b",
+    "%a": "%a", "%W": "%A", "%p": "%p", "%f": "%f", "%j": "%j",
+    "%T": "%H:%M:%S", "%r": "%I:%M:%S %p", "%%": "%%", "%h": "%I",
+    "%I": "%I", "%e": "%d", "%c": "%m", "%k": "%H", "%l": "%I",
+}
+
+
+def _mysql_fmt_to_py(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%" and i + 1 < len(fmt):
+            tok = fmt[i:i + 2]
+            py = _MYSQL_FMT.get(tok)
+            if py is None:
+                raise NotImplementedError(f"date format token {tok}")
+            out.append(py)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _compile_str_to_date(e: Func, dicts: DictContext) -> _CompiledExpr:
+    """STR_TO_DATE over a string column: per-dictionary-entry strptime
+    on the host, gathered by code on device (the LIKE-LUT pattern)."""
+    import datetime as _dt
+
+    col, fmt_e = e.args
+    pyfmt = _mysql_fmt_to_py(str(baked_value(fmt_e)))
+    is_dt = e.type is not None and e.type.kind == Kind.DATETIME
+    from tidb_tpu.dtypes import date_to_days, datetime_to_micros
+
+    def _parse(s):
+        try:
+            d = _dt.datetime.strptime(s, pyfmt)
+        except ValueError:
+            return np.iinfo(np.int64).min  # NULL marker
+        if is_dt:
+            return int(datetime_to_micros(d.strftime("%Y-%m-%d %H:%M:%S.%f")))
+        return int(date_to_days(d.strftime("%Y-%m-%d")))
+
+    f, dictionary = string_expr(col, dicts)
+    vals = np.array(
+        [_parse(str(s)) for s in dictionary], dtype=np.int64
+    ) if len(dictionary) else np.zeros(1, dtype=np.int64)
+    lut = jnp.asarray(vals)
+    bad = jnp.asarray(vals == np.iinfo(np.int64).min)
+    out_dt = jnp.int64 if is_dt else jnp.int32
+
+    def _std(b):
+        c = f(b)
+        codes = jnp.clip(c.data, 0, lut.shape[0] - 1)
+        return DevCol(
+            lut[codes].astype(out_dt), c.valid & ~bad[codes]
+        )
+
+    return _std
 
 
 def _compile_add_months(e: Func, dicts: DictContext) -> _CompiledExpr:

@@ -82,7 +82,9 @@ _WINDOW_ONLY_FUNCS = {
 
 # keywords that may also appear as function names in expression position
 # (MySQL grammar does the same disambiguation, parser.y sysFuncCall rules)
-_FUNC_KEYWORDS = {"mod", "left", "right", "if", "database", "user", "values"}
+_FUNC_KEYWORDS = {
+    "mod", "left", "right", "if", "database", "user", "values", "insert",
+}
 
 
 class Token:
@@ -944,6 +946,14 @@ class Parser:
                 r = ast.Call("like", [e, pat])
                 e = ast.Call("not", [r]) if neg else r
                 continue
+            if self.cur.kind == "id" and self.cur.text.lower() in (
+                "regexp", "rlike"
+            ):
+                self.advance()
+                pat = self.parse_additive()
+                r = ast.Call("regexp", [e, pat])
+                e = ast.Call("not", [r]) if neg else r
+                continue
             if neg:
                 self.i = save
             return e
@@ -1038,6 +1048,14 @@ class Parser:
             # else fall through: DATE(...) function or identifier
         if self.at_kw("interval"):
             self.advance()
+            if self.at_op("("):
+                # INTERVAL(N, a, b, ...) comparison function
+                self.advance()
+                args = [self.parse_expr()]
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.Call("interval_fn", args)
             v = self.parse_unary()
             unit = self.expect_ident()
             if isinstance(v, ast.Const) and isinstance(v.value, str):
@@ -1152,6 +1170,25 @@ class Parser:
             return ast.Call(name.lower(), args)
         if t.kind == "id" or t.kind == "kw":
             name = self.expect_ident()
+            if name.lower() == "position" and self.at_op("("):
+                # POSITION(x IN s) — the IN here is grammar, not the
+                # set-membership operator
+                self.advance()
+                x = self.parse_additive()
+                self.expect_kw("in")
+                s_arg = self.parse_expr()
+                self.expect_op(")")
+                return ast.Call("locate", [x, s_arg])
+            if name.lower() == "timestampdiff" and self.at_op("("):
+                # TIMESTAMPDIFF(unit, a, b): bareword unit
+                self.advance()
+                unit = self.expect_ident().lower()
+                self.expect_op(",")
+                a = self.parse_expr()
+                self.expect_op(",")
+                b = self.parse_expr()
+                self.expect_op(")")
+                return ast.Call("timestampdiff", [ast.Const(unit), a, b])
             if self.accept_op("("):
                 args = []
                 if not self.at_op(")"):

@@ -264,10 +264,111 @@ class ExprBinder:
         "power": "pow",
         "dayofmonth": "day",
         "lengthb": "length",
+        "adddate": "date_add",
+        "subdate": "date_sub",
+        "rlike": "regexp",
+        "insert": "insert_str",
+        "octet_length": "length",
+        "utc_timestamp": "now",
+        "curtime": "current_time",
+        "lastday": "last_day",
     }
+
+    @staticmethod
+    def _const_arg(x):
+        """ast.Const of x, folding a leading unary minus; None if not
+        constant (pre-bind normalization for const-only builtins)."""
+        if isinstance(x, ast.Const):
+            return x
+        if (
+            isinstance(x, ast.Call)
+            and x.op == "neg"
+            and len(x.args) == 1
+            and isinstance(x.args[0], ast.Const)
+            and isinstance(x.args[0].value, (int, float))
+        ):
+            return ast.Const(-x.args[0].value)
+        return None
 
     def lower_call(self, e: ast.Call) -> Expr:
         op = self._FN_ALIASES.get(e.op, e.op)
+        if op in ("conv", "char"):
+            consts = [self._const_arg(a) for a in e.args]
+            if any(c is None for c in consts):
+                raise PlanError(f"{op.upper()} supports constant arguments only")
+            e = ast.Call(op, consts)
+        if op in ("date_add", "date_sub") and len(e.args) == 2 and not isinstance(
+            e.args[1], ast.Interval
+        ):
+            # ADDDATE(d, n) / SUBDATE(d, n): bare N means N days
+            e = ast.Call(op, [e.args[0], ast.Interval(e.args[1], "day")])
+        if op == "strcmp" and len(e.args) == 2:
+            # STRCMP(a, b) -> CASE WHEN a < b THEN -1 WHEN a = b THEN 0
+            # ELSE 1 (NULL propagation via the comparisons)
+            a, b = e.args
+            return self.lower(
+                ast.Call(
+                    "case",
+                    [
+                        ast.Call("lt", [a, b]), ast.Const(-1),
+                        ast.Call("eq", [a, b]), ast.Const(0),
+                        ast.Const(1),
+                    ],
+                )
+            )
+        if op == "space" and len(e.args) == 1 and isinstance(e.args[0], ast.Const):
+            if e.args[0].value is None:
+                return self.lower(ast.Const(None))
+            n = max(int(e.args[0].value), 0)
+            return self.lower(ast.Const(" " * n))
+        if op == "elt" and len(e.args) >= 2:
+            # ELT(n, s1, s2, ...) -> CASE WHEN n=1 THEN s1 ... ELSE NULL
+            n = e.args[0]
+            args = []
+            for i, sv in enumerate(e.args[1:], 1):
+                args.extend([ast.Call("eq", [n, ast.Const(i)]), sv])
+            args.append(ast.Const(None))
+            return self.lower(ast.Call("case", args))
+        if op in ("hex", "bin", "oct") and len(e.args) == 1:
+            a0 = e.args[0]
+            if isinstance(a0, ast.Const) and a0.value is None:
+                return self.lower(ast.Const(None))
+            if isinstance(a0, ast.Const) and isinstance(a0.value, int):
+                fmt = {"hex": "X", "bin": "b", "oct": "o"}[op]
+                v = a0.value
+                if v < 0:  # MySQL: 64-bit two's complement
+                    v &= (1 << 64) - 1
+                return self.lower(ast.Const(format(v, fmt)))
+            # column args resolve by type at compile (string -> byte-hex
+            # transform, bounded int -> range LUT)
+        if op == "conv" and len(e.args) == 3 and all(
+            isinstance(a, ast.Const) for a in e.args
+        ):
+            v, fb, tb = (a.value for a in e.args)
+            if v is None or fb is None or tb is None:
+                return self.lower(ast.Const(None))
+            try:
+                n = int(str(v), int(fb))
+            except (TypeError, ValueError):
+                return self.lower(ast.Const(None))
+            if n < 0:  # MySQL: 64-bit two's complement
+                n &= (1 << 64) - 1
+            digs = "0123456789abcdefghijklmnopqrstuvwxyz"
+            tb = int(tb)
+            out = ""
+            m = n
+            while True:
+                out = digs[m % tb] + out
+                m //= tb
+                if m == 0:
+                    break
+            return self.lower(ast.Const(out.upper()))
+        if op == "char" and all(isinstance(a, ast.Const) for a in e.args):
+            if any(a.value is None for a in e.args):
+                return self.lower(ast.Const(None))
+            return self.lower(
+                ast.Const("".join(chr(int(a.value)) for a in e.args))
+            )
         if op in ("date_add", "date_sub"):
             base, iv = e.args
             assert isinstance(iv, ast.Interval)
