@@ -151,6 +151,9 @@ class HostBlock:
 
     columns: Dict[str, HostColumn]
     nrows: int
+    # partition id for blocks of a partitioned table (Table.split_by_
+    # partition tags appends); None = unpartitioned
+    part_id: Optional[int] = None
 
     @staticmethod
     def from_columns(columns: Dict[str, HostColumn]) -> "HostBlock":

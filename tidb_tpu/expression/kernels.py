@@ -219,6 +219,16 @@ def _int_bounds(e, dicts):
     return get() if callable(get) else ent
 
 
+def _null_col(dtype):
+    def _f(b):
+        return DevCol(
+            jnp.zeros(b.capacity, dtype=dtype),
+            jnp.zeros(b.capacity, dtype=bool),
+        )
+
+    return _f
+
+
 def _string_literal_code(dictionary: np.ndarray, value: str):
     """(code position, exact_match) for a literal against a sorted dict."""
     pos = int(np.searchsorted(dictionary, value))
@@ -387,7 +397,11 @@ def string_expr(e: Expr, dicts: DictContext):
         # supported formatting window; values outside clamp)
         import datetime as _dt
 
-        raw_fmt = str(baked_value(e.args[1]))
+        raw_fmt_v = baked_value(e.args[1])
+        if raw_fmt_v is None:
+            f0, d0 = string_expr(Literal(type=e.type, value=None), dicts)
+            return f0, d0
+        raw_fmt = str(raw_fmt_v)
         t0 = e.args[0].type
         if t0 is not None and t0.kind == Kind.DATETIME and any(
             tok in raw_fmt
@@ -667,6 +681,8 @@ def _str_transform_pyfn(e: Func):
 
         return _ins
     if op == "regexp_substr":
+        if ex[0] is None:
+            return lambda s: None
         rx = re.compile(str(ex[0]))
 
         def _rs(s):
@@ -675,6 +691,8 @@ def _str_transform_pyfn(e: Func):
 
         return _rs
     if op == "regexp_replace":
+        if ex[0] is None or ex[1] is None:
+            return lambda s: None
         rx = re.compile(str(ex[0]))
         # MySQL capture refs are $N; python's re wants \N
         repl = re.sub(r"\$(\d)", r"\\\1", str(ex[1]))
@@ -1049,7 +1067,10 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         col, pat = e.args[0], e.args[1]
         if not isinstance(pat, Literal):
             raise NotImplementedError("REGEXP pattern must be a literal")
-        rx = re.compile(str(baked_value(pat)))
+        pv = baked_value(pat)
+        if pv is None:
+            return _null_col(jnp.bool_)  # MySQL: NULL pattern -> NULL
+        rx = re.compile(str(pv))
         return _compile_strlut(
             col, dicts, lambda s: rx.search(s) is not None, jnp.bool_
         )
@@ -1057,7 +1078,10 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         col, pat = e.args[0], e.args[1]
         if not isinstance(pat, Literal):
             raise NotImplementedError("REGEXP pattern must be a literal")
-        rx = re.compile(str(baked_value(pat)))
+        pv = baked_value(pat)
+        if pv is None:
+            return _null_col(jnp.int64)
+        rx = re.compile(str(pv))
 
         def _ri(s):
             m = rx.search(s)
@@ -1873,12 +1897,22 @@ def _compile_date_misc(e: Func, dicts: DictContext) -> _CompiledExpr:
         return unary(_ld)
     if op in ("week", "weekofyear"):
         # weekofyear == WEEK(d, 3): ISO 8601 week number. WEEK(d)
-        # defaults to mode 0 (Sunday-start, weeks counted from 0).
+        # defaults to mode 0 (Sunday-start, weeks counted from 0);
+        # WEEK(d, 3) maps to the ISO path, other modes are rejected
+        # rather than silently computed as mode 0.
+        iso = op == "weekofyear"
+        if op == "week" and len(e.args) > 1:
+            mode = baked_value(e.args[1])
+            if mode == 3:
+                iso = True
+            elif mode not in (0, None):
+                raise NotImplementedError(f"WEEK mode {mode}")
+
         def _week(c):
             days = _to_days(c.data, t0)
             y, _m, _d = _civil_from_days(days)
             jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
-            if op == "weekofyear":
+            if iso:
                 # ISO: week containing the year's first Thursday is 1
                 dow = (days + 3) % 7  # Monday=0
                 thursday = days - dow + 3
@@ -2016,7 +2050,13 @@ def _compile_str_to_date(e: Func, dicts: DictContext) -> _CompiledExpr:
     import datetime as _dt
 
     col, fmt_e = e.args
-    pyfmt = _mysql_fmt_to_py(str(baked_value(fmt_e)))
+    fmt_v = baked_value(fmt_e)
+    if fmt_v is None:
+        return lambda b: DevCol(
+            jnp.zeros(b.capacity, dtype=jnp.int64),
+            jnp.zeros(b.capacity, dtype=bool),
+        )
+    pyfmt = _mysql_fmt_to_py(str(fmt_v))
     is_dt = e.type is not None and e.type.kind == Kind.DATETIME
     from tidb_tpu.dtypes import date_to_days, datetime_to_micros
 

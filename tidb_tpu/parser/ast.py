@@ -201,6 +201,9 @@ class CreateTable:
     checks: List[tuple] = dataclasses.field(default_factory=list)
     # FOREIGN KEYs: (name, column, ref_db-or-None, ref_table, ref_column)
     fks: List[tuple] = dataclasses.field(default_factory=list)
+    # ("range", col, [(pname, upper_const_or_None), ...]) |
+    # ("hash", col, nparts) | None
+    partition: Optional[tuple] = None
 
 
 @dataclasses.dataclass

@@ -1651,6 +1651,53 @@ class Parser:
                 break
         self.expect_op(")")
         ttl = None
+        partition = None
+        # PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n)|
+        # MAXVALUE, ...) | PARTITION BY HASH (col) PARTITIONS n
+        if self.at_kw("partition"):
+            self.advance()
+            self.expect_kw("by")
+            kindw = self.expect_ident().lower()
+            if kindw == "range":
+                self.expect_op("(")
+                pcol = self.expect_ident().lower()
+                self.expect_op(")")
+                self.expect_op("(")
+                parts = []
+                while True:
+                    self.expect_kw("partition")
+                    pname = self.expect_ident().lower()
+                    self.expect_kw("values")
+                    if not (self.cur.kind == "id" and self.cur.text.lower() == "less"):
+                        raise ParseError("expected VALUES LESS THAN")
+                    self.advance()
+                    if not (self.cur.kind == "id" and self.cur.text.lower() == "than"):
+                        raise ParseError("expected THAN")
+                    self.advance()
+                    if self.cur.kind == "id" and self.cur.text.lower() == "maxvalue":
+                        self.advance()
+                        upper = None
+                    else:
+                        self.expect_op("(")
+                        ue = self.parse_expr()
+                        self.expect_op(")")
+                        upper = ue
+                    parts.append((pname, upper))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                partition = ("range", pcol, parts)
+            elif kindw == "hash":
+                self.expect_op("(")
+                pcol = self.expect_ident().lower()
+                self.expect_op(")")
+                if not self._at_ident("partitions"):
+                    raise ParseError("expected PARTITIONS n")
+                self.advance()
+                n = self.parse_int()
+                partition = ("hash", pcol, n)
+            else:
+                raise ParseError(f"unsupported partitioning {kindw!r}")
         # table options: TTL = col + INTERVAL n unit  (reference: TiDB
         # TTL table option, pkg/ttl)
         while self.cur.kind == "kw":
@@ -1671,7 +1718,7 @@ class Parser:
                 break
         return ast.CreateTable(
             db, name, cols, pk, ine, indexes=indexes, ttl=ttl,
-            checks=checks, fks=fks,
+            checks=checks, fks=fks, partition=partition,
         )
 
     def parse_alter(self):

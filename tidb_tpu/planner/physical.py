@@ -117,6 +117,10 @@ class ScanSite:
     # (pk column, lo, hi) in raw encoded units — the fetch gathers only
     # matching rows via the table's sorted index instead of a full scan
     pk_range: Optional[Tuple[str, int, int]] = None
+    # partition pruning (reference partitionProcessor,
+    # pkg/planner/core/rule_partition_processor.go): partition ids the
+    # predicate can reach; None = all partitions scan
+    partitions: Optional[Tuple[int, ...]] = None
 
 
 @dataclasses.dataclass
@@ -206,6 +210,49 @@ def _plan_children(p) -> List[L.LogicalPlan]:
 
 
 
+def _prune_partitions(pred, scan: "L.Scan", resolver):
+    """Partition ids of `scan`'s table the predicate can reach, or None
+    (all). Range partitioning prunes by bound comparison against the
+    VALUES LESS THAN ladder; hash partitioning prunes on equality.
+    Reference: partitionProcessor (rule_partition_processor.go)."""
+    try:
+        t, _v = resolver(scan.db, scan.table)
+    except Exception:
+        return None
+    part = getattr(t, "partition", None)
+    if part is None or pred is None:
+        return None
+    pcol = part[1]
+    r = _extract_col_range(pred, scan, t, pcol, open_ok=True)
+    if r is None:
+        return None
+    _col, lo, hi = r
+    if lo is not None and hi is not None and lo > hi:
+        return ()
+    nparts = t.npartitions()
+    if part[0] == "hash":
+        # hash pruning needs a small CLOSED range (point lookups mostly)
+        n = int(part[2])
+        if lo is None or hi is None or hi - lo + 1 >= n:
+            return None
+        return tuple(sorted({(v % n + n) % n for v in range(lo, hi + 1)}))
+    uppers = [u for _n, u in part[2]]
+    keep = []
+    lower = None
+    for i, u in enumerate(uppers):
+        # partition i holds [lower, u)
+        p_lo = lower
+        p_hi = None if u is None else u - 1
+        lo_ok = lo is None or p_hi is None or lo <= p_hi
+        hi_ok = hi is None or p_lo is None or hi >= p_lo
+        if lo_ok and hi_ok:
+            keep.append(i)
+        lower = u
+    if len(keep) == nparts:
+        return None
+    return tuple(keep)
+
+
 def _extract_pk_range(pred, scan: "L.Scan", resolver):
     """Predicate -> (col, lo, hi) raw-encoded range over the best access
     path: the single-column PK or any single-leading-column secondary
@@ -236,7 +283,7 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
     return best[1] if best else None
 
 
-def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
+def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str, open_ok=False):
     typ = t.schema.types.get(pkcol)
     if typ is None or typ.kind not in (
         Kind.INT, Kind.DATE, Kind.DECIMAL, Kind.DATETIME,
@@ -254,7 +301,18 @@ def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
 
     def scaled(v):
         """Literal -> exact value in raw encoded units (float; fractional
-        when the literal falls between representable values)."""
+        when the literal falls between representable values). DATE/
+        DATETIME literals may still carry their source string (typed
+        temporal literals skip the string-vs-temporal coercion)."""
+        if isinstance(v, str) and typ.kind in (Kind.DATE, Kind.DATETIME):
+            from tidb_tpu.dtypes import date_to_days, datetime_to_micros
+
+            try:
+                if typ.kind == Kind.DATE:
+                    return float(date_to_days(v))
+                return float(datetime_to_micros(v))
+            except Exception:
+                return None
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             return None
         if typ.kind == Kind.DECIMAL:
@@ -319,7 +377,9 @@ def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str):
         else:
             y = bound_lo(x, op == "gt")
             lo = y if lo is None else max(lo, y)
-    if lo is None or hi is None:
+    if not open_ok and (lo is None or hi is None):
+        return None
+    if lo is None and hi is None:
         return None
     return (pkcol, lo, hi)
 
@@ -534,12 +594,22 @@ class PlanCompiler:
 
         if isinstance(plan, L.Scan):
             nid = self.fresh_id()
+            parts = getattr(self, "_pending_parts", None)
+            self._pending_parts = None
             self.scans.append(
                 ScanSite(
                     nid, plan.db, plan.table, plan.alias, plan.columns,
                     pk_range=getattr(self, "_pending_range", None),
+                    partitions=parts,
                 )
             )
+            if parts is not None and self.node_labels:
+                # surface pruning in EXPLAIN: the Scan is a leaf, so its
+                # label is the most recently appended
+                lnid, ldepth, ltext = self.node_labels[-1]
+                self.node_labels[-1] = (
+                    lnid, ldepth, ltext + f" partitions={list(parts)}"
+                )
             t, _v = self.resolver(plan.db, plan.table)
             dicts = {
                 f"{plan.alias}.{n}": d
@@ -608,8 +678,13 @@ class PlanCompiler:
                 self._pending_range = _extract_pk_range(
                     plan.predicate, plan.child, self.resolver
                 )
+            if isinstance(plan.child, L.Scan):
+                self._pending_parts = _prune_partitions(
+                    plan.predicate, plan.child, self.resolver
+                )
             child, dicts = self._build(plan.child)
             self._pending_range = None
+            self._pending_parts = None
             pred = compile_expr(plan.predicate, dicts)
 
             def fn_sel(inputs, caps):
@@ -1474,7 +1549,10 @@ class PhysicalExecutor:
                 block = t.gather_rows(idx, s.columns, version=v)
                 inputs[s.node_id] = block_to_batch(block)
             else:
-                batch, _d = scan_table(t, s.columns, version=v, mesh=mesh)
+                batch, _d = scan_table(
+                    t, s.columns, version=v, mesh=mesh,
+                    partitions=s.partitions,
+                )
                 inputs[s.node_id] = batch
         return inputs
 

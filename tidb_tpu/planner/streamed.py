@@ -139,7 +139,9 @@ def _fetch_resident(executor, site, st, sv):
         col, lo, hi = site.pk_range
         idx = st.range_rows(col, lo, hi, version=sv)
         return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
-    batch, _d = scan_table(st, site.columns, version=sv)
+    batch, _d = scan_table(
+        st, site.columns, version=sv, partitions=site.partitions
+    )
     return batch
 
 
@@ -184,10 +186,10 @@ def _replace_node(plan, target, repl):
     return dataclasses.replace(plan, **kw)
 
 
-def _chunk_blocks(table, version, columns, chunk_rows: int):
+def _chunk_blocks(table, version, columns, chunk_rows: int, partitions=None):
     """Yield HostBlocks of <= chunk_rows rows over the table's blocks
     (numpy views — no copies until device transfer)."""
-    for b in table.blocks(version):
+    for b in table.blocks(version, partitions=partitions):
         n = b.nrows
         for a in range(0, n, chunk_rows):
             z = min(a + chunk_rows, n)
@@ -421,7 +423,10 @@ def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, di
             nid: chunk_tile for nid in sp.sized
         }
         partial_batches: List[Batch] = []
-        for hb in _chunk_blocks(t, v, sp.big_site.columns, chunk_rows):
+        for hb in _chunk_blocks(
+            t, v, sp.big_site.columns, chunk_rows,
+            partitions=sp.big_site.partitions,
+        ):
             inject("executor/stream-chunk")
             if executor.kill_check is not None:
                 executor.kill_check()
@@ -684,7 +689,10 @@ def try_streamed_sort(executor, plan, conservative=False):
                 j = sp.jits[caps_t] = jax.jit(step)
             return j
 
-        for hb in _chunk_blocks(t, v, big_site.columns, chunk_rows):
+        for hb in _chunk_blocks(
+            t, v, big_site.columns, chunk_rows,
+            partitions=big_site.partitions,
+        ):
             inject("executor/stream-chunk")
             if executor.kill_check is not None:
                 executor.kill_check()

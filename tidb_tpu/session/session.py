@@ -187,6 +187,7 @@ class Session:
             shadow.autoinc_next = t.autoinc_next
             shadow.checks = list(t.checks)
             shadow.fks = list(t.fks)
+            shadow.partition = t.partition
             self._txn["shadows"][key] = shadow
             # conflict baseline = version at FIRST touch in this txn —
             # a shadow rebuilt after ROLLBACK TO SAVEPOINT must not
@@ -1024,6 +1025,9 @@ class Session:
                 if unit not in ("day", "week", "month", "hour", "minute", "second"):
                     raise ValueError(f"unsupported TTL unit {unit!r}")
                 ttl_opt = (tcol, int(iv), unit)
+            part_meta = None
+            if s.partition is not None:
+                part_meta = self._encode_partition(schema, s.partition)
             existed = (
                 s.if_not_exists
                 and self.catalog.has_table(s.db or self.db, s.name)
@@ -1038,6 +1042,7 @@ class Session:
                 if auto:
                     t.autoinc_col = auto[0].name.lower()
                 t.ttl = ttl_opt
+                t.partition = part_meta
                 t.checks = [(nm, txt) for nm, txt, _e in s.checks]
                 t.fks = fks_resolved
                 t.defaults = {
@@ -1836,6 +1841,58 @@ class Session:
         ]
         names = [c.name for c in plan.schema]
         return Result(names, rows, types=[c.type for c in plan.schema])
+
+    def _encode_partition(self, schema, part):
+        """AST partition spec -> table metadata with raw-encoded RANGE
+        bounds (days for DATE columns, scaled ints for DECIMAL).
+        Reference: pkg/table/tables/partition.go bound evaluation."""
+        from tidb_tpu.dtypes import date_to_days, datetime_to_micros
+
+        kind, pcol, spec = part
+        pcol = pcol.lower()
+        ptype = schema.types.get(pcol)
+        if ptype is None:
+            raise ValueError(f"unknown partition column {pcol!r}")
+        if ptype.kind not in (Kind.INT, Kind.DATE, Kind.DATETIME, Kind.DECIMAL):
+            raise ValueError(
+                "partitioning needs an integer-encoded column "
+                f"({pcol!r} is {ptype.kind.value})"
+            )
+        if kind == "hash":
+            n = int(spec)
+            if n < 1:
+                raise ValueError("PARTITIONS must be >= 1")
+            return ("hash", pcol, n)
+        parts = []
+        prev = None
+        for pname, upper in spec:
+            if upper is None:
+                enc = None
+            else:
+                c = ExprBinder._const_arg(upper)
+                if c is None:
+                    raise ValueError(
+                        "VALUES LESS THAN expects a constant"
+                    )
+                v = c.value
+                if ptype.kind == Kind.DATE and isinstance(v, str):
+                    enc = int(date_to_days(v))
+                elif ptype.kind == Kind.DATETIME and isinstance(v, str):
+                    enc = int(datetime_to_micros(v))
+                elif ptype.kind == Kind.DECIMAL:
+                    enc = round(float(v) * 10**ptype.scale)
+                else:
+                    enc = int(v)
+                if prev is not None and enc <= prev:
+                    raise ValueError(
+                        "VALUES LESS THAN must be strictly increasing"
+                    )
+                prev = enc
+            parts.append((pname.lower(), enc))
+        nones = [i for i, (_n, u) in enumerate(parts) if u is None]
+        if nones and nones != [len(parts) - 1]:
+            raise ValueError("MAXVALUE must be the last partition")
+        return ("range", pcol, parts)
 
     # ------------------------------------------------------------------
     # -- CHECK / FOREIGN KEY enforcement -------------------------------
@@ -2670,6 +2727,21 @@ def _render_plan(plan, depth, out: List[str], catalog=None):
                 col, lo, hi = r
                 detail += (
                     f" access=IndexRangeScan({col} in [{lo}, {hi}])"
+                )
+            from tidb_tpu.planner.physical import _prune_partitions
+
+            pp = _prune_partitions(
+                plan.predicate,
+                plan.child,
+                lambda db, tb: (catalog.table(db, tb), 0),
+            )
+            if pp is not None:
+                names = catalog.table(
+                    plan.child.db, plan.child.table
+                ).partition_names()
+                detail += (
+                    " partitions="
+                    + "[" + ",".join(names[i] for i in pp) + "]"
                 )
     elif isinstance(plan, L.Aggregate):
         detail = f" groups={[n for n, _ in plan.group_exprs]} aggs={[f'{f}({n})' for n, f, _, _ in plan.aggs]}"

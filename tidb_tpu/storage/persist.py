@@ -90,6 +90,12 @@ def save_catalog(
                 "unique_indexes": sorted(t.unique_indexes),
                 "autoinc": [t.autoinc_col, t.autoinc_next],
                 "ttl": list(t.ttl) if t.ttl else None,
+                "partition": (
+                    [t.partition[0], t.partition[1],
+                     t.partition[2] if t.partition[0] == "hash"
+                     else [list(x) for x in t.partition[2]]]
+                    if getattr(t, "partition", None) else None
+                ),
                 "checks": [list(c) for c in t.checks] or None,
                 "fks": [list(f) for f in t.fks] or None,
                 "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
@@ -174,6 +180,13 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
                 t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])
             if meta.get("ttl"):
                 t.ttl = tuple(meta["ttl"])
+            if meta.get("partition"):
+                pk_, pc_, spec_ = meta["partition"]
+                t.partition = (
+                    pk_, pc_,
+                    int(spec_) if pk_ == "hash"
+                    else [tuple(x) for x in spec_],
+                )
             t.checks = [tuple(c) for c in (meta.get("checks") or [])]
             t.fks = [tuple(f) for f in (meta.get("fks") or [])]
             # allow_pickle stays OFF: a snapshot directory is data, and
@@ -210,7 +223,9 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
             block = HostBlock.from_columns(cols)
             # always replace — restoring an empty snapshot over a live
             # table must clear it, not silently keep the newer rows
-            t.replace_blocks([block] if block.nrows else [])
+            t.replace_blocks(
+                t.split_by_partition(block) if block.nrows else []
+            )
     for db, views in manifest.get("views", {}).items():
         if want is not None and db.lower() not in want:
             continue

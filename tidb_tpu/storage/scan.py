@@ -78,6 +78,7 @@ def scan_table(
     capacity: Optional[int] = None,
     version: Optional[int] = None,
     mesh=None,
+    partitions=None,
 ) -> Tuple[Batch, Dict[str, np.ndarray]]:
     """Returns (device batch, dictionaries for the scanned columns).
 
@@ -90,7 +91,7 @@ def scan_table(
     inject("storage/scan")
     v = table.version if version is None else version
     cols = tuple(columns)
-    blocks = table.blocks(v)
+    blocks = table.blocks(v, partitions=partitions)
     n = sum(b.nrows for b in blocks)
     cap = capacity or pad_capacity(n)
     mesh_n = None
@@ -101,7 +102,8 @@ def scan_table(
             # would never terminate for non-power-of-two meshes)
             cap = mesh_n * pad_capacity(-(-cap // mesh_n), floor=32)
     uid = getattr(table, "uid", None) or id(table)
-    key = (uid, v, cols, cap, mesh_n)
+    pkey = tuple(sorted(partitions)) if partitions is not None else None
+    key = (uid, v, cols, cap, mesh_n, pkey)
     dicts = {c: table.dictionaries[c] for c in cols if c in table.dictionaries}
     if key in _scan_cache:
         _scan_cache.move_to_end(key)

@@ -128,11 +128,93 @@ class Table:
         # RESTRICT-only enforcement on both child and parent writes
         # (reference: pkg/executor/fktest + pkg/table FK checks)
         self.fks: list = []
+        # partitioning (reference: pkg/table/tables/partition.go):
+        # ("range", col, [(pname, upper-or-None raw-encoded)]) or
+        # ("hash", col, nparts) or None. Appended blocks are SPLIT by
+        # partition (each HostBlock carries part_id), so pruned scans
+        # skip whole blocks — the region-pruning analog
+        # (partitionProcessor, pkg/planner/core/rule_partition_processor.go)
+        self.partition: Optional[tuple] = None
+
+    # -- partitioning --------------------------------------------------
+    def npartitions(self) -> int:
+        if self.partition is None:
+            return 1
+        if self.partition[0] == "hash":
+            return int(self.partition[2])
+        return len(self.partition[2])
+
+    def partition_names(self) -> list:
+        if self.partition is None:
+            return []
+        if self.partition[0] == "hash":
+            return [f"p{i}" for i in range(int(self.partition[2]))]
+        return [n for n, _u in self.partition[2]]
+
+    def partition_of(self, values: np.ndarray) -> np.ndarray:
+        """Partition id per raw-encoded partition-column value."""
+        kind = self.partition[0]
+        if kind == "hash":
+            n = int(self.partition[2])
+            return (values.astype(np.int64) % n + n) % n
+        uppers = [u for _n, u in self.partition[2]]
+        bounds = [u for u in uppers if u is not None]
+        pid = np.searchsorted(
+            np.asarray(bounds, dtype=np.int64), values.astype(np.int64),
+            side="right",
+        )
+        if uppers and uppers[-1] is None:
+            return np.minimum(pid, len(uppers) - 1)
+        if (pid >= len(uppers)).any():
+            raise ValueError(
+                "Table has no partition for value "
+                f"{int(values[pid.argmax()])}"
+            )
+        return pid
+
+    def split_by_partition(self, block: HostBlock) -> List[HostBlock]:
+        """Split an incoming block into per-partition blocks (each tagged
+        with part_id); unpartitioned tables pass through."""
+        if self.partition is None or block.nrows == 0:
+            return [block]
+        import dataclasses as _dc
+
+        pcol = self.partition[1]
+        c = block.columns.get(pcol)
+        if c is None:
+            raise ValueError(f"partition column {pcol!r} missing")
+        # MySQL: NULL keys land in the lowest RANGE partition; only
+        # valid values go through the ladder (a ladder of negative
+        # bounds must not reject NULLs via the 0 placeholder)
+        pid = np.zeros(block.nrows, dtype=np.int64)
+        if c.valid.any():
+            pid[c.valid] = self.partition_of(c.data[c.valid])
+        out = []
+        for p in sorted(set(pid.tolist())):
+            m = pid == p
+            cols = {
+                n: _dc.replace(col, data=col.data[m], valid=col.valid[m])
+                for n, col in block.columns.items()
+            }
+            nb = HostBlock(cols, int(m.sum()))
+            nb.part_id = int(p)
+            out.append(nb)
+        return out
 
     # -- read --------------------------------------------------------------
-    def blocks(self, version: Optional[int] = None) -> List[HostBlock]:
+    def blocks(
+        self, version: Optional[int] = None, partitions=None
+    ) -> List[HostBlock]:
+        """partitions: iterable of partition ids to keep (pruned scan) —
+        None scans everything."""
         v = self.version if version is None else version
-        return self._versions[v]
+        bs = self._versions[v]
+        if partitions is None:
+            return bs
+        keep = set(partitions)
+        # untagged blocks (e.g. rebuilt by UPDATE paths) always scan:
+        # pruning may only skip blocks PROVEN to belong elsewhere
+        return [b for b in bs if b.part_id is None or b.part_id in keep]
 
     @property
     def nrows(self) -> int:
@@ -167,7 +249,9 @@ class Table:
             self._check_domains(block)
             block = self._align_dictionaries(block)
             self._check_unique(block)
-            new_blocks = list(self._versions[self.version]) + [block]
+            new_blocks = list(self._versions[self.version]) + (
+                self.split_by_partition(block)
+            )
             self.modify_count += block.nrows
             self.version += 1
             self._versions[self.version] = new_blocks

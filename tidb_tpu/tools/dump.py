@@ -78,9 +78,40 @@ def create_table_sql(t) -> str:
             f"references {rdb}.{rtbl} ({rcol})"
         )
     opts = ""
+    part = getattr(t, "partition", None)
+    if part is not None:
+        if part[0] == "hash":
+            opts += f" partition by hash ({part[1]}) partitions {part[2]}"
+        else:
+            ptype = t.schema.types.get(part[1])
+
+            def _bound_sql(u):
+                if u is None:
+                    return "maxvalue"
+                if ptype is not None and ptype.kind == Kind.DATE:
+                    import datetime as _dt
+
+                    d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(u))
+                    return f"(date '{d.isoformat()}')"
+                if ptype is not None and ptype.kind == Kind.DATETIME:
+                    import datetime as _dt
+
+                    dtv = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                        microseconds=int(u)
+                    )
+                    return f"('{dtv.strftime('%Y-%m-%d %H:%M:%S')}')"
+                if ptype is not None and ptype.kind == Kind.DECIMAL:
+                    return f"({int(u) / 10**ptype.scale})"
+                return f"({u})"
+
+            decls = ", ".join(
+                f"partition {n} values less than {_bound_sql(u)}"
+                for n, u in part[2]
+            )
+            opts += f" partition by range ({part[1]}) ({decls})"
     if t.ttl:
         col, iv, unit = t.ttl
-        opts = f" ttl = {col} + interval {iv} {unit}"
+        opts += f" ttl = {col} + interval {iv} {unit}"
     return (
         f"CREATE TABLE `{t.name}` (\n  " + ",\n  ".join(parts) + f"\n){opts};"
     )
