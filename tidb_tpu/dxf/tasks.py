@@ -141,9 +141,16 @@ def _backfill_run(meta, catalog) -> dict:
 
 def _backfill_finalize(meta, results, catalog) -> None:
     t = catalog.table(meta["db"], meta["table"])
-    name = meta.get("index", f"idx_{meta['column']}")
-    t.indexes[name.lower()] = [meta["column"].lower()]
-    t._sorted_index(meta["column"].lower())  # install (merge step)
+    name = meta.get("index", f"idx_{meta['column']}").lower()
+    col = meta["column"].lower()
+    # same F1 ladder as the session path (session._add_index): register
+    # write_only (writers maintain), reorg (merge/warm), then public
+    t.indexes[name] = [col]
+    t.index_states[name] = "write_only"
+    t.index_states[name] = "write_reorg"
+    t._sorted_index(col)  # install (merge step)
+    t.index_states[name] = "public"
+    t.bump_version()  # schema barrier for in-flight transactions
 
 
 register_task_type("analyze", _analyze_plan, _analyze_run, _analyze_finalize)

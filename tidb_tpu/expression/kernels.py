@@ -1902,10 +1902,14 @@ def _compile_date_misc(e: Func, dicts: DictContext) -> _CompiledExpr:
         # rather than silently computed as mode 0.
         iso = op == "weekofyear"
         if op == "week" and len(e.args) > 1:
+            if not isinstance(e.args[1], Literal):
+                raise NotImplementedError("WEEK mode must be a literal")
             mode = baked_value(e.args[1])
+            if mode is None:
+                return _null_col(jnp.int64)  # MySQL: NULL mode -> NULL
             if mode == 3:
                 iso = True
-            elif mode not in (0, None):
+            elif mode != 0:
                 raise NotImplementedError(f"WEEK mode {mode}")
 
         def _week(c):
@@ -2051,11 +2055,9 @@ def _compile_str_to_date(e: Func, dicts: DictContext) -> _CompiledExpr:
 
     col, fmt_e = e.args
     fmt_v = baked_value(fmt_e)
+    is_dt0 = e.type is not None and e.type.kind == Kind.DATETIME
     if fmt_v is None:
-        return lambda b: DevCol(
-            jnp.zeros(b.capacity, dtype=jnp.int64),
-            jnp.zeros(b.capacity, dtype=bool),
-        )
+        return _null_col(jnp.int64 if is_dt0 else jnp.int32)
     pyfmt = _mysql_fmt_to_py(str(fmt_v))
     is_dt = e.type is not None and e.type.kind == Kind.DATETIME
     from tidb_tpu.dtypes import date_to_days, datetime_to_micros

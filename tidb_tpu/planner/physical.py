@@ -269,7 +269,12 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
     pk = t.schema.primary_key
     if pk and len(pk) == 1:
         candidates.append(pk[0])
-    for icols in getattr(t, "indexes", {}).values():
+    idx_map = (
+        t.public_indexes()
+        if hasattr(t, "public_indexes")
+        else getattr(t, "indexes", {})
+    )
+    for icols in idx_map.values():
         if icols and icols[0] not in candidates:
             candidates.append(icols[0])
     best = None
@@ -632,6 +637,10 @@ class PlanCompiler:
             pk = t.schema.primary_key
             uniq_cols = set([pk[0]] if pk and len(pk) == 1 else [])
             for iname in t.unique_indexes:
+                # a unique index not yet PUBLIC may still cover
+                # unvalidated duplicate rows: no uniqueness proofs
+                if hasattr(t, "index_state") and t.index_state(iname) != "public":
+                    continue
                 icols = t.indexes.get(iname) or []
                 if len(icols) == 1:
                     uniq_cols.add(icols[0])

@@ -182,6 +182,7 @@ class Session:
             shadow._versions = {0: list(t.blocks(pinned))}
             shadow.dictionaries = dict(t.dictionaries)
             shadow.indexes = dict(t.indexes)
+            shadow.index_states = dict(t.index_states)
             shadow.unique_indexes = set(t.unique_indexes)
             shadow.autoinc_col = t.autoinc_col
             shadow.autoinc_next = t.autoinc_next
@@ -641,11 +642,27 @@ class Session:
 
     # ------------------------------------------------------------------
     def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
-        """Register an index on a table: validate columns, reject dup
-        names, warm the sorted permutation (the backfill analog), and —
-        for UNIQUE — verify existing data has no duplicates (reference:
-        ADD UNIQUE INDEX fails on existing dup keys)."""
+        """ADD INDEX through the F1 online schema-state ladder
+        (reference: pkg/ddl/index.go:545 — None -> WriteOnly ->
+        WriteReorg -> Public; DeleteOnly is vacuous because indexes are
+        derived per-version sorted permutations, so deletes can never
+        strand index entries).
+
+        The index registers in WRITE_ONLY first: from that instant every
+        concurrent writer maintains it (uniqueness enforced on appends),
+        while readers still ignore it. The backfill — duplicate
+        validation for UNIQUE plus warming the sorted permutation — then
+        runs WITHOUT any table lock in WRITE_REORG; concurrent DML
+        during the reorg stays correct because writes are checked
+        against the live snapshot and the derived index of any newer
+        version rebuilds from that version's data. Only after the
+        backfill validates does the state flip to PUBLIC, where the
+        planner may use it (index selection and dense-join uniqueness
+        proofs consult public indexes only). Validation failure rolls
+        the registration back."""
         import numpy as np
+
+        from tidb_tpu.utils import failpoint
 
         iname = name.lower()
         if iname in t.indexes:
@@ -654,21 +671,43 @@ class Session:
         unknown = set(cols) - set(t.schema.names)
         if unknown:
             raise ValueError(f"unknown columns {sorted(unknown)}")
-        if unique:
-            if len(cols) != 1:
-                raise ValueError("UNIQUE indexes support a single column")
-            svals, _perm, nvalid = t._sorted_index(cols[0])
-            if nvalid and len(np.unique(svals[:nvalid])) != nvalid:
-                raise ValueError(
-                    f"cannot create unique index {name}: duplicate entries "
-                    f"in column {cols[0]}"
-                )
-        t.indexes[iname] = cols
-        if unique:
-            t.unique_indexes.add(iname)
-        # warm the physical index now so the first query doesn't pay the
-        # argsort
-        t._sorted_index(cols[0])
+        if unique and len(cols) != 1:
+            raise ValueError("UNIQUE indexes support a single column")
+
+        # -- state: WRITE_ONLY — writers maintain, readers ignore
+        with t._lock:
+            t.indexes[iname] = cols
+            t.index_states[iname] = "write_only"
+            if unique:
+                t.unique_indexes.add(iname)
+        try:
+            failpoint.inject("ddl/index-write-only")
+            # -- state: WRITE_REORG — lock-free backfill over a snapshot
+            t.index_states[iname] = "write_reorg"
+            failpoint.inject("ddl/index-write-reorg")
+            if unique:
+                svals, _perm, nvalid = t._sorted_index(cols[0])
+                if nvalid and len(np.unique(svals[:nvalid])) != nvalid:
+                    raise ValueError(
+                        f"cannot create unique index {name}: duplicate "
+                        f"entries in column {cols[0]}"
+                    )
+            # warm the physical index so the first query doesn't pay
+            # the argsort (the backfill write step)
+            t._sorted_index(cols[0])
+            failpoint.inject("ddl/index-before-public")
+        except BaseException:
+            with t._lock:  # roll the registration back
+                t.indexes.pop(iname, None)
+                t.index_states.pop(iname, None)
+                t.unique_indexes.discard(iname)
+            raise
+        # -- state: PUBLIC — the planner may read it
+        t.index_states[iname] = "public"
+        # schema barrier: in-flight transactions whose shadow predates
+        # the index must conflict at commit, not install rows that were
+        # never checked against it
+        t.bump_version()
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -1068,7 +1107,9 @@ class Session:
                     raise ValueError(f"unknown index {s.name}")
             else:
                 del t.indexes[s.name.lower()]
+                t.index_states.pop(s.name.lower(), None)
                 t.unique_indexes.discard(s.name.lower())
+                t.bump_version()
                 self.catalog.schema_version += 1
             r = Result([], [])
         elif isinstance(s, ast.DropTable):

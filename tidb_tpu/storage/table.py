@@ -128,6 +128,16 @@ class Table:
         # RESTRICT-only enforcement on both child and parent writes
         # (reference: pkg/executor/fktest + pkg/table FK checks)
         self.fks: list = []
+        # online-DDL schema states per index (reference: the F1 state
+        # machine None -> DeleteOnly -> WriteOnly -> WriteReorg -> Public,
+        # pkg/ddl/index.go:545). Missing entry = "public" (pre-existing
+        # indexes). WRITE path maintains an index in ANY registered
+        # state (uniqueness enforced from write_only on); READ paths
+        # (planner index selection, dense-join uniqueness proofs) only
+        # consume PUBLIC indexes. DeleteOnly is vacuous here: indexes
+        # are derived per-version sorted permutations, so deletions
+        # never leave stale entries behind.
+        self.index_states: Dict[str, str] = {}
         # partitioning (reference: pkg/table/tables/partition.go):
         # ("range", col, [(pname, upper-or-None raw-encoded)]) or
         # ("hash", col, nparts) or None. Appended blocks are SPLIT by
@@ -135,6 +145,32 @@ class Table:
         # skip whole blocks — the region-pruning analog
         # (partitionProcessor, pkg/planner/core/rule_partition_processor.go)
         self.partition: Optional[tuple] = None
+
+    # -- online DDL ----------------------------------------------------
+    def index_state(self, name: str) -> str:
+        return self.index_states.get(name.lower(), "public")
+
+    def public_indexes(self) -> Dict[str, List[str]]:
+        """Indexes the planner may READ (schema state public)."""
+        return {
+            n: cols
+            for n, cols in self.indexes.items()
+            if self.index_state(n) == "public"
+        }
+
+    def bump_version(self) -> int:
+        """Schema-change barrier: republish the same blocks under a new
+        version so transactions whose shadow predates the change fail
+        their commit-time conflict check instead of installing rows that
+        skipped the new constraints (the 'Information schema is changed'
+        abort of the reference)."""
+        with self._lock:
+            self.version += 1
+            self._versions[self.version] = list(
+                self._versions[self.version - 1]
+            )
+            self._gc_versions()
+            return self.version
 
     # -- partitioning --------------------------------------------------
     def npartitions(self) -> int:

@@ -89,17 +89,19 @@ def create_table_sql(t) -> str:
                 if u is None:
                     return "maxvalue"
                 if ptype is not None and ptype.kind == Kind.DATE:
-                    import datetime as _dt
+                    from tidb_tpu.dtypes import days_to_date
 
-                    d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(u))
-                    return f"(date '{d.isoformat()}')"
+                    return f"(date '{days_to_date(int(u))}')"
                 if ptype is not None and ptype.kind == Kind.DATETIME:
                     import datetime as _dt
 
                     dtv = _dt.datetime(1970, 1, 1) + _dt.timedelta(
                         microseconds=int(u)
                     )
-                    return f"('{dtv.strftime('%Y-%m-%d %H:%M:%S')}')"
+                    # keep sub-second precision: a dump/restore cycle
+                    # must not move rows across partitions
+                    txt = dtv.strftime("%Y-%m-%d %H:%M:%S.%f").rstrip("0").rstrip(".")
+                    return f"('{txt}')"
                 if ptype is not None and ptype.kind == Kind.DECIMAL:
                     return f"({int(u) / 10**ptype.scale})"
                 return f"({u})"
