@@ -8,6 +8,7 @@ RESTRICT only here), savepoints (pkg/session savepoint support).
 import pytest
 
 from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
 
 
 @pytest.fixture()
@@ -285,3 +286,73 @@ class TestSavepoint:
         sess.execute("rollback to s1")
         assert sess.execute("select count(*) from t").rows == [(2,)]
         sess.execute("rollback")
+
+
+class TestFKReferentialActions:
+    """ON DELETE CASCADE / SET NULL (reference:
+    pkg/executor/foreign_key.go FKCascadeExec); RESTRICT stays the
+    default, and ON UPDATE actions are rejected at DDL."""
+
+    @pytest.fixture()
+    def env(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table p (id int primary key, v int)")
+        s.execute(
+            "create table c (id int, pid int, constraint fc foreign key "
+            "(pid) references p (id) on delete cascade)"
+        )
+        s.execute(
+            "create table g (id int, cid int, constraint fg foreign key "
+            "(cid) references c (id) on delete cascade)"
+        )
+        s.execute(
+            "create table n (id int, pid int, constraint fn foreign key "
+            "(pid) references p (id) on delete set null)"
+        )
+        s.execute("insert into p values (1, 10), (2, 20)")
+        s.execute("insert into c values (100, 1), (101, 1), (102, 2)")
+        s.execute("insert into g values (1000, 100), (1001, 102)")
+        s.execute("insert into n values (5, 1), (6, 2)")
+        return cat, s
+
+    def test_cascade_transitive_and_set_null(self, env):
+        _cat, s = env
+        s.execute("delete from p where id = 1")
+        assert s.execute("select id from c order by id").rows == [(102,)]
+        assert s.execute("select id from g order by id").rows == [(1001,)]
+        assert s.execute("select id, pid from n order by id").rows == [
+            (5, None), (6, 2),
+        ]
+
+    def test_truncate_cascades(self, env):
+        _cat, s = env
+        s.execute("truncate table p")
+        assert s.execute("select count(*) from c").rows == [(0,)]
+        assert s.execute("select count(*) from g").rows == [(0,)]
+        assert s.execute("select pid from n where pid is not null").rows == []
+
+    def test_update_stays_restrict(self, env):
+        _cat, s = env
+        with pytest.raises(ValueError, match="restricts"):
+            s.execute("update p set id = 9 where id = 1")
+
+    def test_on_update_cascade_rejected_at_ddl(self, env):
+        _cat, s = env
+        with pytest.raises(Exception, match="ON UPDATE"):
+            s.execute(
+                "create table bad (id int, pid int, constraint fb foreign "
+                "key (pid) references p (id) on update cascade)"
+            )
+
+    def test_show_create_and_persistence(self, env, tmp_path):
+        cat, s = env
+        ddl = s.execute("show create table c").rows[0][1]
+        assert "on delete cascade" in ddl
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        save_catalog(cat, str(tmp_path))
+        cat2 = load_catalog(str(tmp_path))
+        s2 = Session(cat2, db="test")
+        s2.execute("delete from p where id = 1")
+        assert s2.execute("select id from c order by id").rows == [(102,)]

@@ -49,6 +49,28 @@ def _seg_gather(values, seg, first_idx):
     return values[first_idx[seg]]
 
 
+def _lex_searchsorted(S, K, s_t, k_t, side: str):
+    """Vectorized binary search over rows sorted lexicographically by
+    (S, K): per-target insertion points for (s_t, k_t). jnp.searchsorted
+    is single-key only; this is the same O(n log n) ladder of gathers,
+    which tiles fine on TPU."""
+    n = S.shape[0]
+    lo = jnp.zeros(s_t.shape, dtype=jnp.int32)
+    hi = jnp.full(s_t.shape, n, dtype=jnp.int32)
+    for _ in range(max(int(n).bit_length(), 1)):
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, n - 1)
+        sm, km = S[midc], K[midc]
+        if side == "left":
+            go = (sm < s_t) | ((sm == s_t) & (km < k_t))
+        else:
+            go = (sm < s_t) | ((sm == s_t) & (km <= k_t))
+        go = go & (lo < hi)
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where(go, hi, mid)
+    return lo
+
+
 def window_op(
     batch: Batch,
     part_fns: Sequence[ExprFn],
@@ -131,6 +153,20 @@ def window_op(
         "last_idx": last_idx, "peer_last": peer_last,
         "peer_start": peer_start,
     }
+    if len(order_fns) == 1:
+        # normalized (ascending-monotone) order key for RANGE value
+        # frames: DESC keys were pre-negated above, so value deltas keep
+        # their sign; NULL keys collapse to -inf (all NULLs are peers
+        # and any offset window over a NULL row spans exactly the NULLs)
+        base = 1 + n_part_ops
+        nullk_s = sorted_ops[base].astype(bool)
+        kvalid = ~nullk_s if order_descs[0] else nullk_s
+        kv = sorted_ops[base + 1].astype(jnp.float64)
+        # NULLs must keep the per-partition key array MONOTONE for the
+        # binary search: they sort first under ASC (-inf) but LAST
+        # under DESC (+inf in the negated domain)
+        ninf = jnp.inf if order_descs[0] else -jnp.inf
+        aux["range_key"] = jnp.where(kvalid, kv, ninf)
 
     new_cols = {}
     inv = jnp.zeros(cap, dtype=jnp.int32).at[perm].set(idx32)
@@ -235,7 +271,6 @@ def _compute(
             else jnp.where(valid, data, zero)
         )
         if d.frame is not None:
-            lo, hi = d.frame
             idx32 = jnp.arange(cap, dtype=jnp.int32)
             start = first_idx[seg]
             last_idx = (
@@ -244,8 +279,35 @@ def _compute(
                 .max(idx32, mode="drop")
             )
             end = last_idx[seg]
-            loi = start if lo is None else jnp.maximum(idx32 + lo, start)
-            hii = end if hi is None else jnp.minimum(idx32 + hi, end)
+            if len(d.frame) == 3:
+                # RANGE value frame: bounds are the row positions whose
+                # ORDER BY key falls within [key+lo_off, key+hi_off],
+                # found by lexicographic (partition, key) binary search
+                # over the sorted arrays (searchsorted has no multi-key
+                # form). Reference: pkg/executor/window.go range frames.
+                _tag, flo, fhi = d.frame
+                k = aux["range_key"]
+                if flo is None:
+                    loi = start
+                else:
+                    t_lo = k if flo == "cur" else k + flo
+                    loi = _lex_searchsorted(
+                        seg, k, seg, t_lo, side="left"
+                    ).astype(jnp.int32)
+                if fhi is None:
+                    hii = end
+                else:
+                    t_hi = k if fhi == "cur" else k + fhi
+                    hii = (
+                        _lex_searchsorted(seg, k, seg, t_hi, side="right")
+                        - 1
+                    ).astype(jnp.int32)
+                loi = jnp.maximum(loi, start)
+                hii = jnp.minimum(hii, end)
+            else:
+                lo, hi = d.frame
+                loi = start if lo is None else jnp.maximum(idx32 + lo, start)
+                hii = end if hi is None else jnp.minimum(idx32 + hi, end)
             empty = hii < loi
             c = jnp.cumsum(contrib)
             cnt_c = jnp.cumsum(valid.astype(jnp.int64))

@@ -204,6 +204,8 @@ class CreateTable:
     # ("range", col, [(pname, upper_const_or_None), ...]) |
     # ("hash", col, nparts) | None
     partition: Optional[tuple] = None
+    # fk name -> ON DELETE action ("restrict" | "cascade" | "set_null")
+    fk_actions: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -1276,8 +1276,89 @@ class Parser:
                     )
                 hi = 0
             frame = (lo, hi)
+        elif self.cur.kind == "id" and self.cur.text.lower() == "range":
+            # RANGE value frames: offsets against the (single) ORDER BY
+            # key value, numeric or INTERVAL for temporal keys
+            self.advance()
+            if self.accept_kw("between"):
+                rlo = self._parse_range_bound(is_start=True)
+                self.expect_kw("and")
+                rhi = self._parse_range_bound(is_start=False)
+            else:
+                rlo = self._parse_range_bound(is_start=True)
+                if self._range_bound_order(rlo) > 0:
+                    raise ParseError(
+                        "FOLLOWING frame start requires BETWEEN ... AND ..."
+                    )
+                rhi = "cur"
+            lo_o = (
+                float("-inf") if rlo is None else self._range_bound_order(rlo)
+            )
+            hi_o = (
+                float("inf") if rhi is None else self._range_bound_order(rhi)
+            )
+            if lo_o > hi_o:
+                raise ParseError("window frame start cannot follow its end")
+            frame = ("range", rlo, rhi)
         self.expect_op(")")
         return ast.WindowCall(func, arg, partition, order, offset, frame)
+
+    def _parse_range_bound(self, is_start: bool):
+        """RANGE frame bound: None = unbounded, 'cur' = current row
+        (peers), ('num', signed value) or ('interval', signed n, unit) —
+        PRECEDING negative, FOLLOWING positive."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                if not is_start:
+                    raise ParseError("UNBOUNDED PRECEDING is only a frame start")
+                return None
+            if self.accept_kw("following"):
+                if is_start:
+                    raise ParseError("UNBOUNDED FOLLOWING is only a frame end")
+                return None
+            raise ParseError("expected PRECEDING or FOLLOWING")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return "cur"
+        if self.accept_kw("interval"):
+            t = self.advance()
+            if t.kind not in ("num", "str"):
+                raise ParseError("INTERVAL expects a number")
+            n = int(float(t.text))
+            unit = self.expect_ident().lower().rstrip("s")
+            sign = self._frame_dir()
+            return ("interval", sign * n, unit)
+        t = self.cur
+        if t.kind != "num":
+            raise ParseError(f"expected a frame offset at {t.pos}")
+        self.advance()
+        v = float(t.text)
+        sign = self._frame_dir()
+        return ("num", sign * v)
+
+    @staticmethod
+    def _range_bound_order(bound) -> float:
+        """Comparable magnitude of a RANGE bound for start<=end
+        validation (ER_WINDOW_FRAME_ILLEGAL): unbounded handled by the
+        caller's bound direction, intervals compare in seconds."""
+        if bound == "cur":
+            return 0.0
+        if bound[0] == "num":
+            return float(bound[1])
+        _i, n, unit = bound
+        secs = {
+            "microsecond": 1e-6, "second": 1.0, "minute": 60.0,
+            "hour": 3600.0, "day": 86400.0, "week": 604800.0,
+            "month": 2.6e6, "year": 3.15e7,
+        }.get(unit, 1.0)
+        return float(n) * secs
+
+    def _frame_dir(self) -> int:
+        if self.accept_kw("preceding"):
+            return -1
+        if self.accept_kw("following"):
+            return 1
+        raise ParseError("expected PRECEDING or FOLLOWING")
 
     def _parse_frame_bound(self, is_start: bool = True):
         """ROWS frame bound -> row offset relative to the current row:
@@ -1514,6 +1595,7 @@ class Parser:
         indexes: List[tuple] = []
         checks: List[tuple] = []
         fks: List[tuple] = []
+        fk_actions: dict = {}
 
         def _parse_check(cname):
             self.expect_op("(")
@@ -1524,8 +1606,46 @@ class Parser:
             nm = cname or f"chk_{len(checks) + 1}"
             checks.append((nm, self.sql[start:end].strip(), expr))
 
+        def _parse_fk_actions():
+            # [ON DELETE action] [ON UPDATE action] in either order
+            odel = oupd = "restrict"
+            while self.at_kw("on"):
+                self.advance()
+                which = self.cur.text.lower()
+                if which not in ("delete", "update"):
+                    raise ParseError("expected DELETE or UPDATE after ON")
+                self.advance()
+                if self._at_ident("cascade"):
+                    self.advance()
+                    act = "cascade"
+                elif self.at_kw("set"):
+                    self.advance()
+                    self.expect_kw("null")
+                    act = "set_null"
+                elif self._at_ident("restrict") or self._at_ident("no"):
+                    if self._at_ident("no"):
+                        self.advance()
+                        if not self._at_ident("action"):
+                            raise ParseError("expected ACTION after NO")
+                    self.advance()
+                    act = "restrict"
+                else:
+                    raise ParseError(
+                        "expected CASCADE, SET NULL, RESTRICT or NO ACTION"
+                    )
+                if which == "delete":
+                    odel = act
+                else:
+                    oupd = act
+            if oupd != "restrict":
+                raise ParseError(
+                    "ON UPDATE CASCADE/SET NULL is not supported "
+                    "(RESTRICT semantics apply)"
+                )
+            return odel, oupd
+
         def _parse_fk(cname):
-            # FOREIGN KEY (col) REFERENCES tbl (col)
+            # FOREIGN KEY (col) REFERENCES tbl (col) [ON DELETE action]
             self.expect_op("(")
             col = self.expect_ident()
             self.expect_op(")")
@@ -1537,7 +1657,9 @@ class Parser:
             rcol = self.expect_ident()
             self.expect_op(")")
             nm = cname or f"fk_{len(fks) + 1}"
+            odel, _oupd = _parse_fk_actions()
             fks.append((nm, col, rdb, rtbl, rcol))
+            fk_actions[nm.lower()] = odel
 
         while True:
             if self._at_ident("constraint"):
@@ -1641,9 +1763,10 @@ class Parser:
                         self.expect_op("(")
                         rcol = self.expect_ident()
                         self.expect_op(")")
-                        fks.append(
-                            (f"fk_{len(fks) + 1}", cname, rdb, rtbl, rcol)
-                        )
+                        nm0 = f"fk_{len(fks) + 1}"
+                        odel0, _o = _parse_fk_actions()
+                        fks.append((nm0, cname, rdb, rtbl, rcol))
+                        fk_actions[nm0.lower()] = odel0
                     else:
                         break
                 cols.append(cd)
@@ -1719,6 +1842,7 @@ class Parser:
         return ast.CreateTable(
             db, name, cols, pk, ine, indexes=indexes, ttl=ttl,
             checks=checks, fks=fks, partition=partition,
+            fk_actions=fk_actions,
         )
 
     def parse_alter(self):

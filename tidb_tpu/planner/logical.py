@@ -2242,6 +2242,13 @@ def _build_windows(plan, win_calls: List[ast.WindowCall], rewrite: Dict) -> Logi
                 raise PlanError(f"{call.func}() requires ORDER BY in its OVER clause")
             frame = call.frame
             call_running = running
+            if (
+                frame is not None
+                and len(frame) == 3
+                and frame[0] == "range"
+                and call.func in ("sum", "avg", "count")
+            ):
+                frame = _encode_range_frame(call, frame, order_exprs)
             if frame is not None:
                 if call.func in (
                     "row_number", "rank", "dense_rank", "lag", "lead",
@@ -2267,6 +2274,61 @@ def _build_windows(plan, win_calls: List[ast.WindowCall], rewrite: Dict) -> Logi
             new_cols.append(OutCol(None, name, name, t))
         plan = Window(Schema(new_cols), plan, part_exprs, order_exprs, descs)
     return plan
+
+
+def _encode_range_frame(call, frame, order_exprs):
+    """Resolve a parsed RANGE frame against the (single) ORDER BY key:
+    numeric offsets scale to the key's physical encoding (DECIMAL scaled
+    ints), INTERVAL offsets to days (DATE) or micros (DATETIME/TIME).
+    Variable-length units (MONTH/YEAR) are rejected — their width
+    depends on the anchor date. Reference: pkg/executor/window.go range
+    frame bound evaluation."""
+    if call.func not in ("sum", "avg", "count"):
+        raise PlanError(
+            "RANGE offset frames support SUM/AVG/COUNT aggregates"
+        )
+    if len(order_exprs) != 1:
+        raise PlanError("RANGE offset frames need exactly one ORDER BY key")
+    ktype = order_exprs[0][0].type
+    if ktype is None:
+        raise PlanError("RANGE frame ORDER BY key has no type")
+
+    _US = {
+        "microsecond": 1, "second": 1_000_000, "minute": 60_000_000,
+        "hour": 3_600_000_000, "day": 86_400_000_000,
+        "week": 7 * 86_400_000_000,
+    }
+
+    def enc(bound):
+        if bound is None or bound == "cur":
+            return bound
+        tag = bound[0]
+        if tag == "num":
+            v = float(bound[1])
+            if ktype.kind == Kind.DECIMAL:
+                return v * 10**ktype.scale
+            if ktype.kind in (Kind.INT, Kind.FLOAT):
+                return v
+            if ktype.kind == Kind.DATE:
+                return v  # bare N over a DATE key counts days (MySQL)
+            raise PlanError(
+                "numeric RANGE offsets need a numeric ORDER BY key"
+            )
+        _i, n, unit = bound
+        if unit not in _US:
+            raise PlanError(
+                f"RANGE INTERVAL unit {unit!r} is variable-length; "
+                "use DAY or smaller"
+            )
+        if ktype.kind == Kind.DATE:
+            if unit not in ("day", "week"):
+                raise PlanError("DATE keys take DAY/WEEK RANGE offsets")
+            return float(n * (1 if unit == "day" else 7))
+        if ktype.kind in (Kind.DATETIME, Kind.TIME):
+            return float(n * _US[unit])
+        raise PlanError("INTERVAL offsets need a temporal ORDER BY key")
+
+    return ("range", enc(frame[1]), enc(frame[2]))
 
 
 def _ast_key(e) -> str:

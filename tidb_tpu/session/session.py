@@ -188,6 +188,7 @@ class Session:
             shadow.autoinc_next = t.autoinc_next
             shadow.checks = list(t.checks)
             shadow.fks = list(t.fks)
+            shadow.fk_actions = dict(getattr(t, "fk_actions", {}))
             shadow.partition = t.partition
             self._txn["shadows"][key] = shadow
             # conflict baseline = version at FIRST touch in this txn —
@@ -1084,6 +1085,11 @@ class Session:
                 t.partition = part_meta
                 t.checks = [(nm, txt) for nm, txt, _e in s.checks]
                 t.fks = fks_resolved
+                t.fk_actions = {
+                    nm.lower(): act
+                    for nm, act in (getattr(s, "fk_actions", {}) or {}).items()
+                    if act != "restrict"
+                }
                 t.defaults = {
                     c.name.lower(): c.default
                     for c in s.columns
@@ -1151,11 +1157,24 @@ class Session:
             def _truncate(db=s.db or self.db):
                 t = self._resolve_table_for_write(db, s.name)
                 children = self._fk_children(db, s.name)
-                if children:
-                    self._enforce_parent_constraints(
-                        db, s.name, {c: set() for c in t.schema.names}
-                    )
+                undo = []
+                self._fk_undo_snapshot(undo, t)
+                saved_auto = t.autoinc_next
+                # truncate FIRST, then referential actions against the
+                # post-statement state; any failure (nested RESTRICT)
+                # restores every touched table — the statement is atomic
                 t.replace_blocks([], modified_rows=t.nrows)
+                try:
+                    if children:
+                        self._enforce_parent_constraints(
+                            db, s.name,
+                            {c: set() for c in t.schema.names},
+                            actions=True, undo=undo,
+                        )
+                except BaseException:
+                    self._fk_undo_restore(undo)
+                    t.autoinc_next = saved_auto
+                    raise
                 t.autoinc_next = 1  # TRUNCATE resets AUTO_INCREMENT (DDL)
                 clear_scan_cache()
                 return Result([], [])
@@ -1189,7 +1208,7 @@ class Session:
                             f"cannot drop column {cn!r}: used by "
                             f"FOREIGN KEY {nm!r}"
                         )
-                for cdb, ctn, nm, _c, rcol in self._fk_children(
+                for cdb, ctn, nm, _c, rcol, _act in self._fk_children(
                     s.db or self.db, s.name
                 ):
                     if cn == rcol:
@@ -2006,20 +2025,45 @@ class Session:
             for d in cat.databases():
                 for tn in cat.tables(d):
                     t2 = cat.table(d, tn)
+                    acts = getattr(t2, "fk_actions", {})
                     for nm, col, rdb, rtbl, rcol in getattr(t2, "fks", ()):
                         rev.setdefault((rdb, rtbl), []).append(
-                            (d, tn, nm, col, rcol)
+                            (d, tn, nm, col, rcol,
+                             acts.get(nm.lower(), "restrict"))
                         )
             cache = cat._fk_child_cache = (cat.schema_version, rev)
         return cache[1].get((db.lower(), name.lower()), [])
 
+    def _fk_undo_snapshot(self, undo, t) -> None:
+        """Record a table's pre-statement state once per statement so a
+        failure ANYWHERE in a referential-action chain restores every
+        touched table (MySQL: the whole statement rolls back)."""
+        if undo is not None and all(u[0] is not t for u in undo):
+            undo.append((t, list(t.blocks()), dict(t.dictionaries)))
+
+    @staticmethod
+    def _fk_undo_restore(undo) -> None:
+        for t, blocks, dicts in undo:
+            t.replace_blocks(blocks, modified_rows=0)
+            t.dictionaries = dicts
+        clear_scan_cache()
+
     def _enforce_parent_constraints(
-        self, db: str, name: str, remaining: dict
+        self, db: str, name: str, remaining: dict, actions: bool = False,
+        _depth: int = 0, undo=None,
     ) -> None:
-        """RESTRICT semantics for deletes/updates on an FK parent:
-        every child reference must still resolve against the parent's
-        post-statement values (``remaining``: ref_col -> value set)."""
-        for cdb, ctn, nm, col, rcol in self._fk_children(db, name):
+        """FK enforcement for deletes/updates on an FK parent against
+        the post-statement values (``remaining``: ref_col -> value set).
+        actions=False (UPDATE paths): RESTRICT always — ON UPDATE
+        referential actions are unsupported at DDL, so RESTRICT is the
+        declared semantics. actions=True (DELETE/TRUNCATE): each child
+        FK's declared ON DELETE action applies — RESTRICT raises,
+        CASCADE deletes the referencing child rows (recursively),
+        SET NULL nulls the child key column. Reference:
+        pkg/executor/foreign_key.go (FKCascadeExec / FKCheckExec)."""
+        if _depth > 10:
+            raise ValueError("FOREIGN KEY cascade recursion too deep")
+        for cdb, ctn, nm, col, rcol, odel in self._fk_children(db, name):
             if rcol not in remaining:
                 continue
             child_vals = self._column_values(cdb, ctn, col)
@@ -2028,11 +2072,84 @@ class Session:
                 # caller's remaining set for the fk column is the truth
                 child_vals = remaining.get(col, child_vals)
             dangling = child_vals - remaining[rcol]
-            if dangling:
+            if not dangling:
+                continue
+            if not actions or odel == "restrict":
                 raise ValueError(
                     f"FOREIGN KEY {nm!r} on {cdb}.{ctn} restricts this "
                     f"statement: {sorted(dangling)[:3]!r} still referenced"
                 )
+            if odel == "set_null":
+                self._null_child_keys(cdb, ctn, col, dangling, _depth, undo)
+            else:  # cascade
+                self._cascade_delete(cdb, ctn, col, dangling, _depth, undo)
+
+    def _child_block_mask(self, block, col, values):
+        """Boolean mask of rows whose decoded `col` value is in
+        `values` (NULLs never match)."""
+        import numpy as np
+
+        c = block.columns[col]
+        dec = c.decode()
+        hit = np.fromiter(
+            (bool(ok) and v in values for ok, v in zip(c.valid, dec)),
+            dtype=bool, count=block.nrows,
+        )
+        return hit
+
+    def _fk_recheck_children(self, cdb, ctn, depth, undo) -> None:
+        """After mutating a child (cascade delete / set null), its own
+        children may dangle: recurse with the post-mutation value sets
+        of every column they reference."""
+        ref_cols = {
+            rcol2 for _cd, _ct, _nm, _c, rcol2, _a in self._fk_children(cdb, ctn)
+        }
+        if ref_cols:
+            remaining = {
+                rc: self._column_values(cdb, ctn, rc) for rc in ref_cols
+            }
+            self._enforce_parent_constraints(
+                cdb, ctn, remaining, actions=True, _depth=depth + 1,
+                undo=undo,
+            )
+
+    def _null_child_keys(self, cdb, ctn, col, values, depth, undo) -> None:
+        """ON DELETE SET NULL: clear the child FK column where it
+        referenced a deleted parent key, then re-check the child's own
+        children (the nulled column's value set shrank)."""
+        t = self._resolve_table_for_write(cdb, ctn)
+        self._fk_undo_snapshot(undo, t)
+        new_blocks = []
+        changed = 0
+        for b in t.blocks():
+            hit = self._child_block_mask(b, col, values)
+            if not hit.any():
+                new_blocks.append(b)
+                continue
+            cols = dict(b.columns)
+            c = cols[col]
+            cols[col] = dataclasses.replace(c, valid=c.valid & ~hit)
+            new_blocks.append(dataclasses.replace(b, columns=cols))
+            changed += int(hit.sum())
+        if changed:
+            t.replace_blocks(new_blocks, modified_rows=changed)
+            clear_scan_cache()
+            self._fk_recheck_children(cdb, ctn, depth, undo)
+
+    def _cascade_delete(self, cdb, ctn, col, values, depth, undo) -> None:
+        """ON DELETE CASCADE: remove child rows referencing deleted
+        parent keys (Table.delete_where), then apply the child's own
+        ON DELETE actions for its children (recursively)."""
+        t = self._resolve_table_for_write(cdb, ctn)
+        self._fk_undo_snapshot(undo, t)
+        keep_masks = [
+            ~self._child_block_mask(b, col, values) for b in t.blocks()
+        ]
+        if all(m.all() for m in keep_masks):
+            return
+        t.delete_where(keep_masks)
+        clear_scan_cache()
+        self._fk_recheck_children(cdb, ctn, depth, undo)
 
     def _unique_key_cols(self, t):
         """Single-column conflict keys: PK (when single) + single-column
@@ -2366,9 +2483,9 @@ class Session:
             # rows: the parent value set may have shrunk — enforce
             # RESTRICT on the post-statement state and roll the whole
             # statement back on violation
-            need = {rc for _, _, _, _, rc in children}
+            need = {rc for _, _, _, _, rc, _a in children}
             need |= {
-                c for cd, ct, _, c, _ in children
+                c for cd, ct, _, c, _, _a in children
                 if cd == db.lower() and ct == t.name
             }
             remaining = {}
@@ -2480,22 +2597,29 @@ class Session:
         children = self._fk_children(db, s.table)
         blocks = t.blocks()
         if s.where is None:
-            if children:
-                self._enforce_parent_constraints(
-                    db, s.table,
-                    {c: set() for c in t.schema.names},
-                )
             affected = t.nrows
+            undo = []
+            self._fk_undo_snapshot(undo, t)
             t.replace_blocks([], modified_rows=affected)
+            try:
+                if children:
+                    self._enforce_parent_constraints(
+                        db, s.table,
+                        {c: set() for c in t.schema.names},
+                        actions=True, undo=undo,
+                    )
+            except BaseException:
+                self._fk_undo_restore(undo)
+                raise
             clear_scan_cache()
             return Result([], [], affected=affected)
         masks, affected = self._eval_where_per_block(t, s.where)
         if children and affected:
             # post-delete values for every column a child references
             # (and, for self-FKs, the child column itself)
-            need = {rc for _, _, _, _, rc in children}
+            need = {rc for _, _, _, _, rc, _a in children}
             need |= {
-                c for cd, ct, _, c, _ in children
+                c for cd, ct, _, c, _, _a in children
                 if cd == db.lower() and ct == t.name
             }
             remaining = {}
@@ -2508,8 +2632,20 @@ class Session:
                         if ok and not dead:
                             vals.add(v)
                 remaining[col] = vals
-            self._enforce_parent_constraints(db, s.table, remaining)
+        # delete FIRST so referential actions (incl. self-FK cascades)
+        # run against the post-statement state; restore every touched
+        # table if a nested RESTRICT fires mid-chain
+        undo = []
+        self._fk_undo_snapshot(undo, t)
         t.delete_where([~m for m in masks])
+        try:
+            if children and affected:
+                self._enforce_parent_constraints(
+                    db, s.table, remaining, actions=True, undo=undo
+                )
+        except BaseException:
+            self._fk_undo_restore(undo)
+            raise
         clear_scan_cache()
         return Result([], [], affected=affected)
 
@@ -2562,9 +2698,9 @@ class Session:
         children = self._fk_children(db, s.table)
         if children:
             names = t.schema.names
-            need = {rc for _, _, _, _, rc in children}
+            need = {rc for _, _, _, _, rc, _a in children}
             need |= {
-                c for cd, ct, _, c, _ in children
+                c for cd, ct, _, c, _, _a in children
                 if cd == db.lower() and ct == t.name
             }
             remaining = {
@@ -2617,7 +2753,7 @@ class Session:
                 relevant |= check_columns(ex)
         relevant |= {col for _nm, col, *_ in t.fks}
         relevant |= {
-            rc for _, _, _, _, rc in
+            rc for _, _, _, _, rc, _a in
             self._fk_children(s.db or self.db, s.table)
         }
         # PK/UNIQUE columns: the scatter path bypasses append-time

@@ -98,6 +98,7 @@ def save_catalog(
                 ),
                 "checks": [list(c) for c in t.checks] or None,
                 "fks": [list(f) for f in t.fks] or None,
+                "fk_actions": dict(getattr(t, "fk_actions", {})) or None,
                 "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
                 "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
                 "json_cols": list(t.schema.json_cols),
@@ -189,6 +190,7 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
                 )
             t.checks = [tuple(c) for c in (meta.get("checks") or [])]
             t.fks = [tuple(f) for f in (meta.get("fks") or [])]
+            t.fk_actions = dict(meta.get("fk_actions") or {})
             # allow_pickle stays OFF: a snapshot directory is data, and
             # must never be able to execute code on RESTORE
             data = np.load(os.path.join(path, f"{db}.{name}.npz"))

@@ -124,10 +124,12 @@ class Table:
         # session write path (reference: constraint checks in
         # pkg/table/tables.go CheckRowConstraint)
         self.checks: list = []
-        # FOREIGN KEYs [(name, col, ref_db, ref_table, ref_col)] —
-        # RESTRICT-only enforcement on both child and parent writes
-        # (reference: pkg/executor/fktest + pkg/table FK checks)
+        # FOREIGN KEYs [(name, col, ref_db, ref_table, ref_col)];
+        # fk_actions: name -> ON DELETE action ("cascade"/"set_null");
+        # missing = RESTRICT (reference: pkg/executor/foreign_key.go
+        # FKCascadeExec / FKCheckExec)
         self.fks: list = []
+        self.fk_actions: Dict[str, str] = {}
         # online-DDL schema states per index (reference: the F1 state
         # machine None -> DeleteOnly -> WriteOnly -> WriteReorg -> Public,
         # pkg/ddl/index.go:545). Missing entry = "public" (pre-existing

@@ -73,9 +73,14 @@ def create_table_sql(t) -> str:
     for nm, txt in t.checks:
         parts.append(f"constraint {nm} check ({txt})")
     for nm, col, rdb, rtbl, rcol in t.fks:
+        act = getattr(t, "fk_actions", {}).get(nm.lower())
+        suffix = {
+            "cascade": " on delete cascade",
+            "set_null": " on delete set null",
+        }.get(act, "")
         parts.append(
             f"constraint {nm} foreign key ({col}) "
-            f"references {rdb}.{rtbl} ({rcol})"
+            f"references {rdb}.{rtbl} ({rcol}){suffix}"
         )
     opts = ""
     part = getattr(t, "partition", None)
