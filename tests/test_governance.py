@@ -125,3 +125,95 @@ def test_failpoint_site_inventory():
         for m in re.finditer(r'inject\("([^"]+)"', p.read_text()):
             sites.add(m.group(1))
     assert len(sites) >= 20, sorted(sites)
+
+
+class TestRound3Failpoints:
+    """Fault injection at the round-3 sites (VERDICT round-2 weak #8:
+    storage GC, lock manager, FK cascades, persistence writes)."""
+
+    def test_persist_crash_mid_backup_resumes(self, tmp_path):
+        from tidb_tpu.storage import Catalog
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table a (x int)")
+        s.execute("create table b (x int)")
+        s.execute("insert into a values (1)")
+        s.execute("insert into b values (2)")
+        calls = []
+
+        def boom():
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("disk full")
+
+        failpoint.enable("persist/backup-table", boom)
+        try:
+            with pytest.raises(RuntimeError, match="disk full"):
+                save_catalog(cat, str(tmp_path))
+        finally:
+            failpoint.disable("persist/backup-table")
+        # resume completes the interrupted backup from the ledger
+        save_catalog(cat, str(tmp_path), resume=True)
+        cat2 = load_catalog(str(tmp_path))
+        s2 = Session(cat2, db="test")
+        assert s2.execute("select x from a").rows == [(1,)]
+        assert s2.execute("select x from b").rows == [(2,)]
+
+    def test_gc_site_fires_and_pinned_survive(self):
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table t (x int)")
+        t = cat.table("test", "t")
+        s.execute("insert into t values (-1)")
+        pinned = t.version
+        t.pin(pinned)
+        hits = []
+        failpoint.enable("storage/gc-drop-version", lambda: hits.append(1))
+        try:
+            for i in range(5):
+                s.execute(f"insert into t values ({i})")
+        finally:
+            failpoint.disable("storage/gc-drop-version")
+            t.unpin(pinned)
+        assert hits, "version GC must run under repeated writes"
+        assert pinned in t._versions, "pinned snapshot must survive GC"
+
+    def test_cascade_failpoint_error_restores_all_tables(self):
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table p (id int primary key)")
+        s.execute(
+            "create table c (id int, pid int, constraint fc foreign key "
+            "(pid) references p (id) on delete cascade)"
+        )
+        s.execute("insert into p values (1)")
+        s.execute("insert into c values (10, 1)")
+        failpoint.enable("fk/cascade-delete", RuntimeError("crash mid-cascade"))
+        try:
+            with pytest.raises(RuntimeError, match="mid-cascade"):
+                s.execute("delete from p where id = 1")
+        finally:
+            failpoint.disable("fk/cascade-delete")
+        # the whole statement rolled back: both tables intact
+        assert s.execute("select count(*) from p").rows == [(1,)]
+        assert s.execute("select count(*) from c").rows == [(1,)]
+
+    def test_lock_acquire_site(self):
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table t (x int)")
+        hits = []
+        failpoint.enable("locks/acquire", lambda: hits.append(1))
+        try:
+            s.execute("insert into t values (1)")
+        finally:
+            failpoint.disable("locks/acquire")
+        assert hits, "autocommit DML must pass through the lock manager"

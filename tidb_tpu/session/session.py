@@ -402,6 +402,9 @@ class Session:
             return self.execute(bind_placeholders(ent["textual"], values))
         types_sig = tuple(type(v).__name__ for v in values)
 
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("session/execute-prepared")
         # fast path: the held CompiledQuery re-runs with new runtime-slot
         # values as jitted-program inputs — no parse, no plan, no trace
         if (
@@ -2140,6 +2143,9 @@ class Session:
         """ON DELETE CASCADE: remove child rows referencing deleted
         parent keys (Table.delete_where), then apply the child's own
         ON DELETE actions for its children (recursively)."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("fk/cascade-delete")
         t = self._resolve_table_for_write(cdb, ctn)
         self._fk_undo_snapshot(undo, t)
         keep_masks = [

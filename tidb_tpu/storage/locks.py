@@ -77,6 +77,9 @@ class LockManager:
         """Block until `txn_id` holds `key`. Raises DeadlockError when
         waiting would close a cycle in the wait-for graph, or
         LockWaitTimeout after `timeout` seconds."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("locks/acquire")
         deadline = time.monotonic() + timeout
         with self._mu:
             while True:
@@ -88,6 +91,7 @@ class LockManager:
                     return
                 if self._would_deadlock(txn_id, owner):
                     self._waits.pop(txn_id, None)
+                    inject("locks/deadlock-detected")
                     raise DeadlockError()
                 self._waits[txn_id] = owner
                 remaining = deadline - time.monotonic()

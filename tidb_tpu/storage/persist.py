@@ -43,6 +43,8 @@ def save_catalog(
     the checkpoint ledger are skipped — an interrupted backup picks up
     where it stopped (reference: BR backup checkpoints,
     br/pkg/checkpoint/backup.go). Returns tables written this run."""
+    from tidb_tpu.utils.failpoint import inject
+
     os.makedirs(path, exist_ok=True)
     ckpt_path = os.path.join(path, "checkpoint.json")
     done = {}
@@ -128,14 +130,13 @@ def save_catalog(
             fn = os.path.join(path, f"{db}.{name}.npz")
             if done.get((db, name)) == t.version and os.path.exists(fn):
                 continue  # checkpointed at this exact version
-            from tidb_tpu.utils.failpoint import inject
-
             inject("persist/backup-table")
             np.savez_compressed(fn, **arrays)
             written += 1
             done[(db, name)] = t.version
             with open(ckpt_path, "w") as f:
                 json.dump([[d, n, v] for (d, n), v in sorted(done.items())], f)
+    inject("persist/before-manifest")
     with open(os.path.join(path, _MANIFEST), "w") as f:
         json.dump(manifest, f)
     # a completed backup needs no checkpoint ledger
@@ -147,6 +148,9 @@ def save_catalog(
 def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
     """Rebuild a catalog from a snapshot directory (optionally only the
     named databases — the RESTORE DATABASE path)."""
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("persist/restore-start")
     catalog = catalog or Catalog()
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)

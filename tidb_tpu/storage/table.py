@@ -277,8 +277,12 @@ class Table:
         statements resolve their version before fetching), and any
         pinned snapshot. Without this every UPDATE leaked its whole
         pre-image forever (VERDICT round-1 weak #4)."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("storage/gc-versions")
         keep = {self.version, self.version - 1} | set(self._pins)
         for v in [v for v in self._versions if v not in keep]:
+            inject("storage/gc-drop-version")
             del self._versions[v]
 
     def append_block(self, block: HostBlock) -> int:
@@ -470,6 +474,9 @@ class Table:
         string dictionaries, and the AUTO_INCREMENT allocator swap under
         one lock acquisition, so a concurrent reader can never observe
         new blocks with old dictionaries (or vice versa) mid-commit."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("storage/install-commit")
         with self._lock:
             self.modify_count += int(modified_rows)
             self.version += 1
