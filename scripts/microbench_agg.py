@@ -1,0 +1,118 @@
+"""Microbenchmark the aggregation primitives on the live backend.
+
+Isolates: segment_sum scatter vs masked reductions vs one-hot matmul,
+in i64/f64 (x64 emulated on TPU) vs i32/f32 — to find where Q1's
+633ms/600k rows goes and what the fix is worth.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 20  # ~1M rows
+S = 64  # slots
+
+rng = np.random.default_rng(0)
+seg_np = rng.integers(0, S, N)
+val_np = rng.integers(0, 10000, N)
+
+
+def timeit(name, fn, *args):
+    fn(*args)  # compile
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / reps * 1000
+    print(f"{name:55s} {dt:8.2f} ms")
+    return dt
+
+
+def main():
+    print("backend:", jax.default_backend())
+    for dtype_v, dtype_s in [
+        (jnp.int64, "i64"),
+        (jnp.float64, "f64"),
+        (jnp.int32, "i32"),
+        (jnp.float32, "f32"),
+    ]:
+        seg = jnp.asarray(seg_np, dtype=jnp.int32)
+        vals = jnp.asarray(val_np, dtype=dtype_v)
+
+        @jax.jit
+        def seg_sum(v, s):
+            return jax.ops.segment_sum(v, s, num_segments=S)
+
+        @jax.jit
+        def masked(v, s):
+            return jnp.stack([jnp.sum(jnp.where(s == k, v, 0)) for k in range(S)])
+
+        @jax.jit
+        def onehot_mm(v, s):
+            oh = jax.nn.one_hot(s, S, dtype=jnp.float32)
+            return v.astype(jnp.float32) @ oh
+
+        timeit(f"segment_sum {dtype_s} N=1M S=64", seg_sum, vals, seg)
+        timeit(f"masked reductions {dtype_s}", masked, vals, seg)
+        timeit(f"one-hot matmul f32 (from {dtype_s})", onehot_mm, vals, seg)
+
+    # elementwise passes: the Q1 expression tree (decimal mults)
+    for dtype_v, dtype_s in [(jnp.int64, "i64"), (jnp.float64, "f64"),
+                             (jnp.int32, "i32"), (jnp.float32, "f32")]:
+        a = jnp.asarray(val_np, dtype=dtype_v)
+
+        @jax.jit
+        def mults(x):
+            y = x * 2 + 1
+            for _ in range(8):
+                y = y * x + x
+            return y.sum()
+
+        timeit(f"8x fused mult-add {dtype_s}", mults, a)
+
+    # while_loop latency: 64-iteration claim-loop shape
+    x = jnp.asarray(val_np, dtype=jnp.int64)
+
+    @jax.jit
+    def loop64(v):
+        def body(s):
+            acc, it = s
+            return acc + jnp.sum(v * it), it + 1
+
+        def cond(s):
+            return s[1] < 64
+
+        return jax.lax.while_loop(cond, body, (jnp.int64(0), jnp.int64(0)))[0]
+
+    timeit("while_loop 64 iters x full-array sum i64", loop64, x)
+
+    # gather: k.data[cl] patterns
+    idx = jnp.asarray(rng.integers(0, N, N), dtype=jnp.int32)
+
+    @jax.jit
+    def gather(v, i):
+        return v[i].sum()
+
+    timeit("random gather 1M i64", gather, x, idx)
+    timeit("random gather 1M f32", gather, x.astype(jnp.float32), idx)
+
+    # scatter-min claim pattern
+    @jax.jit
+    def scatmin(s, r):
+        c = jnp.full(S + 1, 1 << 50, dtype=jnp.int64)
+        return c.at[s].min(r, mode="drop")
+
+    timeit("scatter-min 1M -> 64 slots i64", scatmin, seg, x)
+
+
+main()

@@ -1,0 +1,117 @@
+"""Profile steady-state Q1: where does the per-query time go on TPU?
+
+Decomposes sess.execute into parse/plan, input fetch, jitted call,
+device->host fetch, and host materialization by timing the pieces
+directly. Run on TPU (default) or CPU (JAX_PLATFORMS=cpu).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+SF = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+
+Q1 = (
+    "select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, "
+    "sum(l_extendedprice) as sum_base_price, "
+    "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
+    "avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price, "
+    "avg(l_discount) as avg_disc, count(*) as count_order "
+    "from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+)
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    cat = Catalog()
+    t0 = time.perf_counter()
+    load_tpch(cat, sf=SF, tables=["orders", "lineitem"], seed=1)
+    print(f"datagen: {time.perf_counter()-t0:.2f}s", flush=True)
+    sess = Session(cat, db="tpch")
+    sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
+    sess.execute("analyze table lineitem")
+    t0 = time.perf_counter()
+    sess.execute(Q1)
+    print(f"first execute (compile+discovery): {time.perf_counter()-t0:.2f}s", flush=True)
+
+    # steady state, whole statement
+    for i in range(3):
+        t0 = time.perf_counter()
+        sess.execute(Q1)
+        print(f"steady execute #{i}: {time.perf_counter()-t0:.3f}s", flush=True)
+
+    # now decompose: grab the executor internals
+    ex = sess.executor
+    from tidb_tpu.parser import parse as parse_sql
+    from tidb_tpu.planner.logical import build_query
+
+    t0 = time.perf_counter()
+    stmts = parse_sql(Q1)
+    t_parse = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    plan = build_query(stmts[0], cat, "tpch", sess._scalar_subquery)
+    t_plan = time.perf_counter() - t0
+    print(f"parse: {t_parse*1000:.1f}ms  plan: {t_plan*1000:.1f}ms", flush=True)
+
+    key = ex._cache_key(plan)
+    cq = ex._cache.get(key)
+    print("plan-cache hit:", cq is not None, "jitted:", cq is not None and cq.jitted is not None, flush=True)
+    if cq is None:
+        return
+
+    pins = []
+    t0 = time.perf_counter()
+    resolved = {}
+    inputs = ex._fetch_inputs(cq, mesh=ex.mesh, pins=pins, resolved=resolved)
+    t_fetch = time.perf_counter() - t0
+    print(f"fetch_inputs: {t_fetch*1000:.1f}ms", flush=True)
+
+    for nid, col in cq.nonnull:
+        t, v = resolved[nid]
+        t.col_has_nulls(col, v)
+
+    params = ex._params()
+    # jitted call: dispatch only
+    t0 = time.perf_counter()
+    out, needs = cq.jitted(inputs, params)
+    t_dispatch = time.perf_counter() - t0
+    # block until done
+    t0 = time.perf_counter()
+    jax.block_until_ready(out.cols[list(out.cols)[0]].data)
+    t_compute = time.perf_counter() - t0
+    print(f"jitted dispatch: {t_dispatch*1000:.1f}ms  device compute (block): {t_compute*1000:.1f}ms", flush=True)
+
+    t0 = time.perf_counter()
+    needs_host = jax.device_get((needs, out))[0]
+    t_get = time.perf_counter() - t0
+    print(f"device_get(needs+out): {t_get*1000:.1f}ms", flush=True)
+    for t, v in pins:
+        t.unpin(v)
+
+    # repeat the pure jit call a few times, timed with block_until_ready
+    for i in range(3):
+        t0 = time.perf_counter()
+        out, needs = cq.jitted(inputs, params)
+        jax.block_until_ready(needs)
+        jax.block_until_ready(out.row_valid)
+        print(f"pure jitted run #{i}: {(time.perf_counter()-t0)*1000:.1f}ms", flush=True)
+
+    # and what does the session spend AFTER run()? time _run_select pieces
+    t0 = time.perf_counter()
+    r = sess.execute(Q1)
+    t_total = time.perf_counter() - t0
+    print(f"final whole execute: {t_total:.3f}s rows={len(r.rows)}", flush=True)
+
+
+main()
