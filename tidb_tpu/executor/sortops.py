@@ -1,0 +1,190 @@
+"""Sort-based relational kernels: the TPU-native answer to hash tables.
+
+TPU microbenchmarks (scripts/microbench_agg.py, TPU v5e, 1M rows) put the
+primitive costs at:
+
+    lax.sort (1-3 operands)      ~3-6 ms      regular strided passes
+    cumsum / segmented scan      ~3 ms        regular
+    row-gather [N, L] matrix     ~4 ms        amortizes over lanes
+    masked reduction (<=128)     ~1.4 ms      fused, no data movement
+    jnp.searchsorted (N probes)  ~160 ms      log N rounds of random gather
+    segment_sum scatter          ~64 ms       serialized scatter
+    scatter-min                  ~130 ms      serialized scatter
+
+so anything built on scatter or per-row binary search is 20-50x slower
+than a formulation built on sort + prefix scan. The reference's hash
+aggregate (pkg/executor/aggregate/agg_hash_executor.go) and hash join
+(pkg/executor/join/hash_table.go) therefore map to SORTS here, not to
+device hash tables:
+
+  - group-by = lexicographic sort of key components with the row id as
+    the final key, segment boundaries from adjacent-row comparison,
+    aggregates as cumulative-sum differences at segment ends;
+  - searchsorted(a, q) for large q = one merged sort of a ++ q plus a
+    rank subtraction, then one pack-sort to restore query order — three
+    regular sorts instead of len(q) binary searches.
+
+Both keep every op regular (sorts, scans, small gathers), report true
+cardinalities for the host's capacity-discovery protocol, and compile to
+a single fused XLA program like the rest of the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tidb_tpu.chunk import Batch, DevCol
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def merge_searchsorted(
+    sorted_keys: jax.Array, queries: jax.Array, side: str
+) -> jax.Array:
+    """jnp.searchsorted(sorted_keys, queries, side) computed with sorts.
+
+    For each query q: side='left' returns #keys < q, side='right'
+    #keys <= q. The merged sort's tie tag orders queries before (left)
+    or after (right) equal keys; a query's insertion point is then its
+    merged position minus its rank among queries. A final single-operand
+    sort of packed (query id, result) pairs restores query order without
+    a scatter. Exact for full-range int64 keys.
+    """
+    n = sorted_keys.shape[0]
+    m = queries.shape[0]
+    tq = 0 if side == "left" else 1
+    tk = 1 - tq
+    keys = jnp.concatenate([sorted_keys, queries])
+    tags = jnp.concatenate(
+        [
+            jnp.full(n, tk, dtype=jnp.int32),
+            jnp.full(m, tq, dtype=jnp.int32),
+        ]
+    )
+    qid = jnp.concatenate(
+        [
+            jnp.zeros(n, dtype=jnp.int32),  # ignored: tag marks non-query
+            jnp.arange(m, dtype=jnp.int32),
+        ]
+    )
+    _sk, st, sq = jax.lax.sort([keys, tags, qid], num_keys=2)
+    is_q = st == tq
+    nq_incl = jnp.cumsum(is_q.astype(jnp.int32))
+    res = jnp.arange(n + m, dtype=jnp.int32) - (nq_incl - 1)
+    packed = jnp.where(
+        is_q,
+        (sq.astype(jnp.int64) << 32) | res.astype(jnp.int64),
+        _I64_MAX,
+    )
+    back = jax.lax.sort([packed], num_keys=1)[0][:m]
+    return (back & jnp.int64(0xFFFFFFFF)).astype(queries.dtype)
+
+
+def run_ends(sorted_keys: jax.Array) -> jax.Array:
+    """For each position j of a sorted array: the end (exclusive) of the
+    run of values equal to sorted_keys[j] — a reversed cumulative min of
+    run-boundary positions. With this, hi = run_ends[lo] replaces the
+    second (side='right') searchsorted of an equi-probe."""
+    n = sorted_keys.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    nxt = jnp.where(
+        jnp.concatenate(
+            [sorted_keys[1:] != sorted_keys[:-1], jnp.ones(1, dtype=bool)]
+        ),
+        idx + 1,
+        n,
+    )
+    return jnp.flip(jax.lax.cummin(jnp.flip(nxt)))
+
+
+def _seg_scan(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
+    """Inclusive segmented scan: runs of rows between boundary flags are
+    scanned independently. Standard segmented-scan semiring over
+    (value, started-a-new-segment) pairs; associative, so lax's log-depth
+    associative_scan applies."""
+
+    def combine(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, op(av, bv)), ab | bb
+
+    v, _b = jax.lax.associative_scan(combine, (vals, boundary))
+    return v
+
+
+def sort_group_aggregate(
+    batch: Batch,
+    keys: Sequence[DevCol],
+    aggs,
+    arg_cols,
+    slots: int,
+    key_names: Sequence[str],
+    reps=None,
+) -> Tuple[Batch, jax.Array]:
+    """Keyed aggregation by lexicographic sort, replacing the claim-loop
+    hash table on TPU (see module docstring). Returns (group batch with
+    capacity `slots`, true group count) under the same overflow protocol
+    as group_aggregate: a count above `slots` makes the host bump the
+    capacity knob and re-jit; results in the returned batch are correct
+    whenever the count fits.
+
+    Groups come out in ascending key order (NULLs first) — a stable,
+    mesh-friendly order that downstream distributed merges rely on.
+    DISTINCT rep masks (`reps`, in original row order) are permuted
+    through the sort like every other contribution mask.
+    """
+    from tidb_tpu.executor.aggregate import _run_sorted_aggs, _sort_components
+
+    cap = batch.capacity
+    comps: List[jax.Array] = [(~batch.row_valid).astype(jnp.int8)]
+    for k in keys:
+        comps.extend(_sort_components(k))
+    rowid = jnp.arange(cap, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(comps + [rowid], num_keys=len(comps) + 1)
+    s_comps, perm = sorted_all[:-1], sorted_all[-1]
+    valid_s = s_comps[0] == 0  # invalid rows sort last (first key)
+
+    first = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    diff = jnp.zeros(cap, dtype=bool)
+    for c in s_comps[1:]:
+        diff = diff | jnp.concatenate([jnp.ones(1, dtype=bool), c[1:] != c[:-1]])
+    boundary = valid_s & (first | diff)
+    ngroups = jnp.sum(boundary.astype(jnp.int64))
+    nvalid = jnp.sum(valid_s.astype(jnp.int32))
+
+    # segment start positions, compacted into the `slots` tile by a sort
+    # (scatter-free); ends follow by shifting, the last real group ending
+    # at nvalid
+    spos = jnp.where(boundary, jnp.arange(cap, dtype=jnp.int32), cap)
+    if slots > cap:
+        spos = jnp.concatenate(
+            [spos, jnp.full(slots - cap, cap, dtype=jnp.int32)]
+        )
+    starts = jax.lax.sort([spos], num_keys=1)[0][:slots]
+    ends = jnp.minimum(
+        jnp.concatenate([starts[1:], jnp.full(1, cap, dtype=jnp.int32)]),
+        nvalid,
+    )
+    group_valid = jnp.arange(slots) < jnp.minimum(ngroups, slots)
+    starts_c = jnp.minimum(starts, cap - 1)
+
+    # key output columns: component values at segment starts
+    out_cols = {}
+    ci = 1
+    for name, k in zip(key_names, keys):
+        ncomp = len(_sort_components(k))
+        kvalid_s = s_comps[ci] == 0  # first component is ~valid
+        kdata_s = s_comps[ci + 1]
+        ci += ncomp
+        kd = kdata_s[starts_c].astype(k.data.dtype)
+        kv = kvalid_s[starts_c] & group_valid
+        out_cols[name] = DevCol(jnp.where(group_valid, kd, jnp.zeros_like(kd)), kv)
+
+    out = _run_sorted_aggs(
+        batch, aggs, arg_cols, perm, valid_s, boundary,
+        starts_c, ends, group_valid, out_cols, reps=reps,
+    )
+    return out, ngroups

@@ -345,14 +345,25 @@ class Delete:
     db: Optional[str]
     table: str
     where: Optional[object] = None
+    # multi-table forms (DELETE t1, t2 FROM <refs> / DELETE FROM t USING
+    # <refs>): targets name the tables rows are removed from (db, name —
+    # `name` may be an alias bound in from_refs); from_refs is the joined
+    # row source. Reference: multi-table delete resolution in
+    # pkg/planner/core/logical_plan_builder.go (buildDelete).
+    targets: Optional[List[Tuple[Optional[str], str]]] = None
+    from_refs: Optional[object] = None
 
 
 @dataclasses.dataclass
 class Update:
     db: Optional[str]
     table: str
-    sets: List[Tuple[str, object]]
+    sets: List[Tuple[str, object]]  # col may be "qualifier.col" in multi form
     where: Optional[object] = None
+    # multi-table form (UPDATE t1 JOIN t2 ... SET ...): the joined row
+    # source; db/table are unused when set. Reference: buildUpdate's
+    # multiple-table handling (pkg/planner/core/logical_plan_builder.go).
+    from_refs: Optional[object] = None
 
 
 @dataclasses.dataclass

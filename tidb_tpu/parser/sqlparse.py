@@ -1978,26 +1978,79 @@ class Parser:
             db, name, columns, rows, ignore=ignore, on_dup=on_dup
         )
 
+    def _delete_target(self):
+        """One DELETE target: [db.]name[.*] — the trailing .* is noise
+        MySQL accepts (DELETE t1.* FROM ...)."""
+        db, name = None, self.expect_ident()
+        if self.accept_op("."):
+            if self.at_op("*"):
+                self.advance()
+                return db, name
+            db, name = name, self.expect_ident()
+        if self.accept_op("."):
+            self.expect_op("*")
+        return db, name
+
     def parse_delete(self):
         self.expect_kw("delete")
+        if self.accept_kw("from"):
+            db, name = self._qualified_name()
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.expect_ident()
+            elif self.cur.kind == "id":
+                alias = self.advance().text
+            if self.accept_kw("using"):
+                # DELETE FROM t USING t JOIN u ... : rows of t matched by
+                # the joined source are deleted
+                refs = self.parse_table_refs()
+                where = self.parse_expr() if self.accept_kw("where") else None
+                return ast.Delete(
+                    None, name, where,
+                    targets=[(db, alias or name)], from_refs=refs,
+                )
+            where = self.parse_expr() if self.accept_kw("where") else None
+            if alias is not None:
+                # single-table with alias: route through the multi-table
+                # machinery so WHERE sees the alias qualifier
+                return ast.Delete(
+                    None, name, where,
+                    targets=[(db, alias)],
+                    from_refs=ast.TableRef(db, name, alias),
+                )
+            return ast.Delete(db, name, where)
+        # DELETE t1[, t2] FROM <joined refs> [WHERE ...]
+        targets = [self._delete_target()]
+        while self.accept_op(","):
+            targets.append(self._delete_target())
         self.expect_kw("from")
-        db, name = self._qualified_name()
+        refs = self.parse_table_refs()
         where = self.parse_expr() if self.accept_kw("where") else None
-        return ast.Delete(db, name, where)
+        return ast.Delete(None, targets[0][1], where, targets=targets, from_refs=refs)
 
     def parse_update(self):
         self.expect_kw("update")
-        db, name = self._qualified_name()
+        refs = self.parse_table_refs()
         self.expect_kw("set")
         sets = []
+        qualified = False
         while True:
             col = self.expect_ident()
+            if self.accept_op("."):
+                col = col + "." + self.expect_ident()
+                qualified = True
             self.expect_op("=")
             sets.append((col, self.parse_expr()))
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("where") else None
-        return ast.Update(db, name, sets, where)
+        if (
+            isinstance(refs, ast.TableRef)
+            and refs.alias is None
+            and not qualified
+        ):
+            return ast.Update(refs.db, refs.name, sets, where)
+        return ast.Update(None, "", sets, where, from_refs=refs)
 
 
 def parse(sql: str):

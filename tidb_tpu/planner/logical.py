@@ -24,6 +24,25 @@ from tidb_tpu.dtypes import BOOL, DATE, INT64, Kind, SQLType
 from tidb_tpu.expression.expr import ColumnRef, Expr, Func, Literal
 from tidb_tpu.parser import ast
 
+# virtual row-handle column for multi-table DML (analog of _tidb_rowid):
+# exposed only on scans whose alias is in the expose_rowid() scope's set
+# (the DML's target tables), so joined read-only tables keep partition
+# pruning / index-range access, and star expansion filters it by name
+ROWID_NAME = "_tidb_rowid"
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_EXPOSE_ROWID = _contextvars.ContextVar("expose_rowid", default=frozenset())
+
+
+@_contextlib.contextmanager
+def expose_rowid(aliases):
+    tok = _EXPOSE_ROWID.set(frozenset(a.lower() for a in aliases))
+    try:
+        yield
+    finally:
+        _EXPOSE_ROWID.reset(tok)
+
 
 class PlanError(ValueError):
     pass
@@ -668,7 +687,14 @@ class SelectBuilder:
                 OutCol(alias, n, f"{alias}.{n}", typ)
                 for n, typ in t.schema.columns
             ]
-            return Scan(Schema(cols), db, node.name.lower(), alias, [n for n, _ in t.schema.columns])
+            names = [n for n, _ in t.schema.columns]
+            if alias in _EXPOSE_ROWID.get():
+                # virtual scan-order row handle for multi-table DML
+                # (reference: _tidb_rowid, pkg/tablecodec). Only visible
+                # inside session-built DML plans, never to star expansion.
+                cols.append(OutCol(alias, ROWID_NAME, f"{alias}.{ROWID_NAME}", INT64))
+                names.append(ROWID_NAME)
+            return Scan(Schema(cols), db, node.name.lower(), alias, names)
         if isinstance(node, ast.SubqueryRef):
             inner = build_query(
                 node.query, self.catalog, self.db, self.subquery_value_fn, self.ctes
@@ -1169,6 +1195,8 @@ def build_select(
     for it in sel.items:
         if isinstance(it.expr, ast.Star):
             for c in plan.schema:
+                if c.name == ROWID_NAME:
+                    continue  # DML row handles are never star-visible
                 if it.expr.table is None or (c.qualifier or "").lower() == it.expr.table.lower():
                     items.append(
                         ast.SelectItem(ast.Name(c.qualifier, c.name), None)

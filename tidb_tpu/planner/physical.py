@@ -215,6 +215,10 @@ def _prune_partitions(pred, scan: "L.Scan", resolver):
     (all). Range partitioning prunes by bound comparison against the
     VALUES LESS THAN ladder; hash partitioning prunes on equality.
     Reference: partitionProcessor (rule_partition_processor.go)."""
+    if "_tidb_rowid" in scan.columns:
+        # multi-table DML handle scans: row ids address the FULL block
+        # concatenation, so the scan must never see a partition subset
+        return None
     try:
         t, _v = resolver(scan.db, scan.table)
     except Exception:
@@ -261,6 +265,10 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
     pkg/util/ranger). When several candidates qualify the narrowest
     range wins. Remaining conjuncts still filter the fetched batch, so
     over-extraction is impossible."""
+    if "_tidb_rowid" in scan.columns:
+        # DML handle scans address full-scan row positions; an index
+        # range fetch would renumber them
+        return None
     try:
         t, _v = resolver(scan.db, scan.table)
     except Exception:

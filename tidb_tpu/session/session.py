@@ -848,7 +848,24 @@ class Session:
                 ast.Delete: "delete",
                 ast.LoadData: "insert",
             }[type(s)]
-            self._check_priv(priv, (s.db or self.db).lower(), s.table.lower())
+            if isinstance(s, ast.Update) and s.from_refs is not None:
+                refs, per = self._update_targets(s)
+                for alias in per:
+                    tr = refs[alias]
+                    self._check_priv(
+                        priv, (tr.db or self.db).lower(), tr.name.lower()
+                    )
+            elif isinstance(s, ast.Delete) and s.targets is not None:
+                refs = self._refs_map(s.from_refs)
+                for _tdb, name in s.targets:
+                    tr = refs.get(name.lower())
+                    nm = tr.name.lower() if tr is not None else name.lower()
+                    ndb = ((tr.db if tr else None) or self.db).lower()
+                    self._check_priv(priv, ndb, nm)
+            else:
+                self._check_priv(
+                    priv, (s.db or self.db).lower(), s.table.lower()
+                )
             # any table READ inside the statement (subqueries in VALUES /
             # SET / WHERE) needs SELECT — otherwise INSERT-only users
             # could exfiltrate other tables (or views) through a subquery
@@ -1314,11 +1331,11 @@ class Session:
             )
         elif isinstance(s, ast.Delete):
             r = self._with_write_locks(
-                [(s.db or self.db, s.table)], lambda: self._run_delete(s)
+                self._dml_lock_tables(s), lambda: self._run_delete(s)
             )
         elif isinstance(s, ast.Update):
             r = self._with_write_locks(
-                [(s.db or self.db, s.table)], lambda: self._run_update(s)
+                self._dml_lock_tables(s), lambda: self._run_update(s)
             )
         elif isinstance(s, ast.Explain):
             r = self._run_explain(s)
@@ -2598,10 +2615,11 @@ class Session:
         from tidb_tpu.utils.failpoint import inject
 
         inject("dml/delete")
+        if s.targets is not None:
+            return self._run_delete_multi(s)
         db = s.db or self.db
         t = self._resolve_table_for_write(db, s.table)
         children = self._fk_children(db, s.table)
-        blocks = t.blocks()
         if s.where is None:
             affected = t.nrows
             undo = []
@@ -2620,6 +2638,26 @@ class Session:
             clear_scan_cache()
             return Result([], [], affected=affected)
         masks, affected = self._eval_where_per_block(t, s.where)
+        return self._delete_masked(t, db, s.table, masks, affected)
+
+    def _delete_masked(
+        self, t, db, table_name, masks, affected, undo=None, deferred=None
+    ) -> Result:
+        """Apply per-block delete masks (True = remove) with the full
+        referential-action protocol: compute post-delete remaining value
+        sets for FK parents, delete first so cascades see the
+        post-statement state, restore every touched table if a nested
+        RESTRICT fires.
+
+        Multi-table DELETE passes `undo` (shared restore list) and
+        `deferred` (a list collecting referential-action thunks): all
+        explicit target deletions then happen BEFORE any cascade runs, so
+        a cascade into another target's table can never shift row
+        positions a later mask still refers to (positions were captured
+        against the pre-statement state)."""
+        children = self._fk_children(db, table_name)
+        blocks = t.blocks()
+        remaining = None
         if children and affected:
             # post-delete values for every column a child references
             # (and, for self-FKs, the child column itself)
@@ -2641,14 +2679,22 @@ class Session:
         # delete FIRST so referential actions (incl. self-FK cascades)
         # run against the post-statement state; restore every touched
         # table if a nested RESTRICT fires mid-chain
-        undo = []
+        shared_undo = undo is not None
+        undo = undo if shared_undo else []
         self._fk_undo_snapshot(undo, t)
         t.delete_where([~m for m in masks])
-        try:
+
+        def actions():
             if children and affected:
                 self._enforce_parent_constraints(
-                    db, s.table, remaining, actions=True, undo=undo
+                    db, table_name, remaining, actions=True, undo=undo
                 )
+
+        if deferred is not None:
+            deferred.append(actions)
+            return Result([], [], affected=affected)
+        try:
+            actions()
         except BaseException:
             self._fk_undo_restore(undo)
             raise
@@ -2659,6 +2705,8 @@ class Session:
         from tidb_tpu.utils.failpoint import inject
 
         inject("dml/update")
+        if s.from_refs is not None:
+            return self._run_update_multi(s)
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         sets = {c.lower(): e for c, e in s.sets}
         fast = self._try_columnar_update(t, s, sets)
@@ -2852,6 +2900,257 @@ class Session:
             masks.append(m[off : off + b.nrows].astype(bool))
             off += b.nrows
         return masks, int(m[: off].sum())
+
+    # -- multi-table DML -----------------------------------------------
+    def _dml_lock_tables(self, s) -> list:
+        """(db, table) write-lock list of an UPDATE/DELETE — the target
+        tables, resolving multi-table forms through their from_refs."""
+        if isinstance(s, ast.Update) and s.from_refs is not None:
+            refs, per = self._update_targets(s)
+            return [
+                ((refs[a].db or self.db), refs[a].name) for a in per
+            ]
+        if isinstance(s, ast.Delete) and s.targets is not None:
+            refs = self._refs_map(s.from_refs)
+            out = []
+            for tdb, name in s.targets:
+                tr = refs.get(name.lower())
+                if tr is not None:
+                    out.append(((tr.db or self.db), tr.name))
+                else:
+                    out.append((tdb or self.db, name))
+            return out
+        return [(s.db or self.db, s.table)]
+
+    def _refs_map(self, refs) -> dict:
+        """alias (lowercased) -> TableRef for every TOP-LEVEL base table
+        of a from_refs join tree. Does not descend into derived tables
+        (SubqueryRef) — tables inside them are legal row sources but
+        never DML targets or SET-column binding candidates."""
+        out = {}
+
+        def walk(node):
+            if isinstance(node, ast.TableRef):
+                out[(node.alias or node.name).lower()] = node
+            elif isinstance(node, ast.Join):
+                walk(node.left)
+                walk(node.right)
+            # SubqueryRef: stop
+
+        walk(refs)
+        return out
+
+    def _update_targets(self, s: ast.Update):
+        """Resolve the SET list of a multi-table UPDATE: returns
+        {alias: [(column, expr)]} with unqualified columns bound to the
+        unique base table that has them (reference: buildUpdateLists'
+        column resolution, pkg/planner/core/logical_plan_builder.go)."""
+        refs = self._refs_map(s.from_refs)
+        per: dict = {}
+        for col, e in s.sets:
+            if "." in col:
+                alias, c = col.split(".", 1)
+                alias = alias.lower()
+                if alias not in refs:
+                    raise ValueError(f"unknown table {alias!r} in UPDATE SET")
+            else:
+                cands = []
+                for a, tr in refs.items():
+                    db = (tr.db or self.db).lower()
+                    if self.catalog.has_table(db, tr.name):
+                        t = self.catalog.table(db, tr.name)
+                        if col.lower() in t.schema.types:
+                            cands.append(a)
+                if len(cands) != 1:
+                    raise ValueError(
+                        f"column {col!r} in UPDATE SET is "
+                        + ("ambiguous" if cands else "unknown")
+                    )
+                alias, c = cands[0], col
+            per.setdefault(alias, []).append((c.lower(), e))
+        return refs, per
+
+    def _run_update_multi(self, s: ast.Update) -> Result:
+        """UPDATE over a joined row source (UPDATE t1 JOIN t2 ...). One
+        SELECT over the join computes, per matched row, each target
+        table's scan-order row handle (the virtual _tidb_rowid column)
+        plus the SET expressions evaluated in join scope; each target row
+        is then updated once — the first matching join row wins, MySQL's
+        multiple-match rule (reference: pkg/executor/update.go dupKey
+        handling). The table rewrite reuses the single-table fallback
+        protocol: full row image, constraint + FK validation, atomic
+        replace with rollback."""
+        from tidb_tpu.planner.logical import ROWID_NAME, expose_rowid
+
+        refs, per = self._update_targets(s)
+        aliases = list(per)
+        items = []
+        for i, alias in enumerate(aliases):
+            tr = refs[alias]
+            db = (tr.db or self.db).lower()
+            t = self.catalog.table(db, tr.name)
+            items.append(
+                ast.SelectItem(ast.Name(alias, ROWID_NAME), alias=f"_h{i}")
+            )
+            for j, (c, e) in enumerate(per[alias]):
+                typ = t.schema.types.get(c)
+                if typ is None:
+                    raise ValueError(f"unknown column {alias}.{c}")
+                if typ.kind != Kind.STRING:
+                    # cast to the column type on device; string values
+                    # come back as Python strings and re-encode on append
+                    e = ast.Call("cast", [e], typ)
+                items.append(ast.SelectItem(e, alias=f"_v{i}_{j}"))
+        sel = ast.Select(items=items, from_=s.from_refs, where=s.where)
+        with expose_rowid(aliases):
+            r = self._run_select(sel)
+
+        # column offsets of each target's handle/value slots in the rows
+        offs = {}
+        pos = 0
+        for i, alias in enumerate(aliases):
+            offs[alias] = pos
+            pos += 1 + len(per[alias])
+
+        affected = 0
+        for alias in aliases:
+            tr = refs[alias]
+            db = (tr.db or self.db).lower()
+            t = self._resolve_table_for_write(db, tr.name)
+            base = offs[alias]
+            nsets = len(per[alias])
+            new_by_handle: dict = {}
+            for row in r.rows:
+                h = row[base]
+                if h is None or h in new_by_handle:
+                    continue  # no-match row (outer join) / first match wins
+                new_by_handle[int(h)] = row[base + 1 : base + 1 + nsets]
+            if not new_by_handle:
+                continue
+            # full decoded row image with new values applied at handles
+            names = t.schema.names
+            cidx = {n: k for k, n in enumerate(names)}
+            rows = []
+            for b in t.blocks():
+                decs = [b.columns[n].decode() for n in names]
+                vals = [b.columns[n].valid for n in names]
+                for k in range(b.nrows):
+                    rows.append(
+                        [
+                            decs[c][k] if vals[c][k] else None
+                            for c in range(len(names))
+                        ]
+                    )
+            for h, new in new_by_handle.items():
+                if not (0 <= h < len(rows)):
+                    raise ValueError(f"stale row handle {h} in UPDATE")
+                for (c, _e), v in zip(per[alias], new):
+                    rows[h][cidx[c]] = v
+            self._enforce_write_constraints(t, db, rows)
+            children = self._fk_children(db, tr.name)
+            if children:
+                need = {rc for _, _, _, _, rc, _a in children}
+                need |= {
+                    c for cd, ct, _, c, _, _a in children
+                    if cd == db and ct == t.name
+                }
+                remaining = {
+                    col: {
+                        row[cidx[col]] for row in rows
+                        if row[cidx[col]] is not None
+                    }
+                    for col in need
+                }
+                self._enforce_parent_constraints(db, tr.name, remaining)
+            saved_blocks = list(t.blocks())
+            saved_dicts = dict(t.dictionaries)
+            t.replace_blocks([], modified_rows=len(new_by_handle))
+            if rows:
+                try:
+                    t.append_rows(rows)
+                except Exception:
+                    t.replace_blocks(
+                        saved_blocks, modified_rows=len(new_by_handle)
+                    )
+                    t.dictionaries = saved_dicts
+                    raise
+            affected += len(new_by_handle)
+        clear_scan_cache()
+        return Result([], [], affected=affected)
+
+    def _run_delete_multi(self, s: ast.Delete) -> Result:
+        """DELETE t1[, t2] FROM <join> / DELETE FROM t USING <join>: one
+        SELECT over the join collects each target's matched row handles;
+        each target then runs the same masked-delete + referential-action
+        protocol as single-table DELETE (reference: buildDelete's
+        multi-table path, pkg/planner/core/logical_plan_builder.go)."""
+        from tidb_tpu.planner.logical import ROWID_NAME, expose_rowid
+
+        refs = self._refs_map(s.from_refs)
+        resolved = []
+        for tdb, name in s.targets:
+            alias = name.lower()
+            if alias not in refs:
+                # target named by real table name while FROM uses aliases
+                cands = [
+                    a for a, tr in refs.items()
+                    if tr.name.lower() == alias
+                    and (tdb is None or (tr.db or self.db).lower() == tdb.lower())
+                ]
+                if len(cands) != 1:
+                    raise ValueError(f"unknown DELETE target {name!r}")
+                alias = cands[0]
+            resolved.append(alias)
+        # the same table listed twice deletes once
+        seen = set()
+        resolved = [a for a in resolved if not (a in seen or seen.add(a))]
+        items = [
+            ast.SelectItem(
+                ast.Name(a, ROWID_NAME), alias=f"_h{i}"
+            )
+            for i, a in enumerate(resolved)
+        ]
+        sel = ast.Select(items=items, from_=s.from_refs, where=s.where)
+        with expose_rowid(resolved):
+            r = self._run_select(sel)
+
+        # Phase A: all explicit target deletions against pre-statement
+        # row positions; Phase B: referential actions afterwards, so a
+        # cascade into a later target's table can't shift its handles.
+        total = 0
+        undo: list = []
+        deferred: list = []
+        try:
+            for i, alias in enumerate(resolved):
+                tr = refs[alias]
+                db = (tr.db or self.db).lower()
+                t = self._resolve_table_for_write(db, tr.name)
+                handles = {
+                    int(row[i]) for row in r.rows if row[i] is not None
+                }
+                if not handles:
+                    continue
+                hs = np.fromiter(handles, dtype=np.int64)
+                masks = []
+                base = 0
+                for b in t.blocks():
+                    m = np.zeros(b.nrows, dtype=bool)
+                    local = hs[(hs >= base) & (hs < base + b.nrows)] - base
+                    m[local] = True
+                    masks.append(m)
+                    base += b.nrows
+                self._delete_masked(
+                    t, db, tr.name, masks, len(handles),
+                    undo=undo, deferred=deferred,
+                )
+                total += len(handles)
+            for actions in deferred:
+                actions()
+        except BaseException:
+            self._fk_undo_restore(undo)
+            raise
+        clear_scan_cache()
+        return Result([], [], affected=total)
 
     # ------------------------------------------------------------------
     def _run_explain(self, s: ast.Explain) -> Result:

@@ -108,7 +108,23 @@ def scan_table(
     if key in _scan_cache:
         _scan_cache.move_to_end(key)
         return _scan_cache[key], dicts
-    block = concat_blocks(blocks, cols, table.schema)
+    rowid = [c for c in cols if c == "_tidb_rowid"]
+    block = concat_blocks(
+        blocks, [c for c in cols if c != "_tidb_rowid"], table.schema
+    )
+    if rowid:
+        # virtual scan-order row handle (multi-table DML): position in
+        # the version's block concatenation — the same coordinates
+        # delete_where / columnar-update masks address
+        from tidb_tpu.chunk import HostColumn
+        from tidb_tpu.dtypes import INT64
+
+        block.columns["_tidb_rowid"] = HostColumn(
+            INT64,
+            np.arange(block.nrows, dtype=np.int64),
+            np.ones(block.nrows, dtype=bool),
+            None,
+        )
     batch = block_to_batch(block, cap)
     if mesh is not None:
         from tidb_tpu.parallel.mesh import shard_batch
