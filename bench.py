@@ -433,13 +433,54 @@ def _store_tpu_cache(args, result) -> None:
         pass  # caching is best-effort; never fail the bench over it
 
 
+def _tpu_tunnel_up(timeout_s: int = 90) -> bool:
+    """Cheap probe: can a fresh process see the TPU at all? The tunnel
+    flaps; when it's down, jax.devices() hangs forever — probing for
+    90s beats burning the full measurement timeout to learn the same
+    thing (BENCH_r02's 900s mystery timeout, diagnosed: backend init)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        return proc.returncode == 0
+    except Exception:
+        return False
+
+
 def supervise(args, passthrough) -> int:
     attempts = []
     tpu_timeout = int(os.environ.get("TIDB_TPU_BENCH_TIMEOUT", "900"))
 
     plans = []
     if not args.cpu:
-        plans.append(("tpu", tpu_timeout))
+        if _tpu_tunnel_up():
+            plans.append(("tpu", tpu_timeout))
+        else:
+            attempts.append(
+                {
+                    "backend": "tpu",
+                    "rc": -1,
+                    "seconds": 0,
+                    "error": "tunnel probe failed: jax.devices() hung/errored",
+                }
+            )
+            cached = _load_tpu_cache(args)
+            if cached is not None:
+                # report the cached hardware number (full provenance)
+                # rather than degrading the headline to the CPU fallback
+                result = dict(cached)
+                d = dict(result.get("detail", {}))
+                d["cached_tpu_result"] = True
+                d["current_version"] = _code_version()
+                d["version_match"] = (
+                    d.get("captured_at_version") == d["current_version"]
+                )
+                d["tunnel_attempts_now"] = attempts
+                result["detail"] = d
+                print(json.dumps(result))
+                return 0
     plans.append(("cpu", tpu_timeout))
 
     result = None

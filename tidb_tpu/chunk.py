@@ -18,6 +18,7 @@ design keeps the same information with static shapes:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, List, Optional
 
 import jax
@@ -145,6 +146,9 @@ def column_from_values(values: List, typ: SQLType) -> HostColumn:
     return HostColumn(typ, data, valid)
 
 
+_block_uid = itertools.count(1)
+
+
 @dataclasses.dataclass
 class HostBlock:
     """A batch of rows on the host: the storage unit of a table partition."""
@@ -154,6 +158,10 @@ class HostBlock:
     # partition id for blocks of a partitioned table (Table.split_by_
     # partition tags appends); None = unpartitioned
     part_id: Optional[int] = None
+    # process-unique immutable-block identity: version deltas (log
+    # backup) diff block lists by uid instead of object identity, which
+    # GC could recycle
+    uid: int = dataclasses.field(default_factory=lambda: next(_block_uid))
 
     @staticmethod
     def from_columns(columns: Dict[str, HostColumn]) -> "HostBlock":

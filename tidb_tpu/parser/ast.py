@@ -440,6 +440,24 @@ class BackupRestore:
 
 
 @dataclasses.dataclass
+class BackupLog:
+    """BACKUP LOG TO 'uri' | BACKUP LOG STOP | BACKUP LOG STATUS — the
+    log-backup stream controls (reference: br log start/stop/status,
+    br/pkg/task/stream.go)."""
+
+    action: str  # 'start' | 'stop' | 'status'
+    uri: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RestorePoint:
+    """RESTORE POINT FROM 'uri' UNTIL <unix ts> — PiTR replay."""
+
+    uri: str
+    until_ts: float
+
+
+@dataclasses.dataclass
 class ImportInto:
     db: Optional[str]
     table: str

@@ -327,7 +327,34 @@ class Parser:
             return self.parse_grant_revoke()
         if self.at_kw("backup", "restore"):
             # BACKUP DATABASE <db>|* TO 'dir' / RESTORE ... FROM 'dir'
-            restore = self.advance().text == "restore"
+            # BACKUP LOG TO 'uri' / RESTORE POINT FROM 'uri' UNTIL <ts>
+            restore = self.advance().text.lower() == "restore"
+            if not restore and self._at_ident("log"):
+                self.advance()
+                if self._at_ident("stop"):
+                    self.advance()
+                    return ast.BackupLog("stop")
+                if self._at_ident("status"):
+                    self.advance()
+                    return ast.BackupLog("status")
+                self.expect_kw("to")
+                t = self.advance()
+                if t.kind != "str":
+                    raise ParseError("BACKUP LOG expects a string URI")
+                return ast.BackupLog("start", t.text)
+            if restore and self._at_ident("point"):
+                self.advance()
+                self.expect_kw("from")
+                t = self.advance()
+                if t.kind != "str":
+                    raise ParseError("RESTORE POINT expects a string URI")
+                if not self._at_ident("until"):
+                    raise ParseError("RESTORE POINT requires UNTIL <unix ts>")
+                self.advance()
+                ts = self.advance()
+                if ts.kind != "num":
+                    raise ParseError("UNTIL expects a numeric unix timestamp")
+                return ast.RestorePoint(t.text, float(ts.text))
             self.expect_kw("database")
             db = None if self.accept_op("*") else self.expect_ident()
             self.expect_kw("from" if restore else "to")

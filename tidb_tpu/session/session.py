@@ -917,7 +917,7 @@ class Session:
             s, (ast.CreateUser, ast.DropUser, ast.GrantStmt, ast.CreateBinding)
         ):
             self._require_super()
-        elif isinstance(s, ast.BackupRestore):
+        elif isinstance(s, (ast.BackupRestore, ast.BackupLog, ast.RestorePoint)):
             self._require_super()
         elif isinstance(s, ast.ImportInto):
             self._check_priv("insert", (s.db or self.db).lower(), s.table.lower())
@@ -1279,6 +1279,37 @@ class Session:
             else:
                 save_catalog(self.catalog, s.path, dbs=dbs, resume=True)
             r = Result([], [])
+        elif isinstance(s, ast.BackupLog):
+            from tidb_tpu.storage.logbackup import LogBackupTask
+
+            task = getattr(self.catalog, "log_backup", None)
+            if s.action == "start":
+                if task is not None:
+                    raise ValueError("a log backup task is already running")
+                task = LogBackupTask(self.catalog, s.uri)
+                task.start()
+                self.catalog.log_backup = task
+                r = Result([], [])
+            elif s.action == "stop":
+                if task is None:
+                    raise ValueError("no log backup task is running")
+                task.stop()
+                self.catalog.log_backup = None
+                r = Result([], [])
+            else:  # status
+                rows = []
+                if task is not None:
+                    task.advance()
+                    rows.append(
+                        ("running", task.uri, round(task.checkpoint_ts, 3))
+                    )
+                r = Result(["state", "storage", "checkpoint_ts"], rows)
+        elif isinstance(s, ast.RestorePoint):
+            from tidb_tpu.storage.logbackup import restore_point_in_time
+
+            n = restore_point_in_time(s.uri, self.catalog, s.until_ts)
+            clear_scan_cache()
+            r = Result(["tables_restored"], [(n,)])
         elif isinstance(s, ast.ImportInto):
             # distributed chunked import on the DXF (lightning pipeline
             # analog, pkg/disttask/importinto)

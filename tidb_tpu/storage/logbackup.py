@@ -1,0 +1,321 @@
+"""Log backup + point-in-time restore (PiTR).
+
+Reference: br's log backup — TiKV streams every change into external
+storage while a checkpoint "advancer" tracks the timestamp below which
+the log is complete (br/pkg/streamhelper/advancer.go); `br restore
+point` replays base snapshot + log up to a target ts
+(br/pkg/task/stream.go). The columnar-store analog:
+
+- subscription: every Table version publish notifies the task (the
+  `Table.on_commit` seam), which PINS the version — GC keeps pinned
+  snapshots, exactly the reference's log-backup-holds-the-GC-safepoint
+  contract — and queues it for capture.
+- segments: the advancer (`advance()`, called by a background thread or
+  explicitly) drains the queue in commit order and writes one segment
+  per version to external storage: the FIRST capture of a table is a
+  full column image (the reference's initial scan); later versions are
+  block deltas — immutable storage blocks diffed by uid, so an UPDATE
+  that rewrote one block ships one block, not the table.
+- checkpoint: `checkpoint_ts` = the capture timestamp below which every
+  queued change has been persisted; SHOW-able like the advancer's
+  checkpoint.
+- PiTR: `restore_point_in_time` replays, per table, the last full
+  segment at-or-before the target ts plus every delta after it, then
+  republishes the blocks.
+
+Timestamps are commit wall-clock (time.time() at publish) — the analog
+of TSO commit ts for a single-writer store.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import HostBlock, HostColumn
+from tidb_tpu.storage.external import ExternalStorage, open_storage
+from tidb_tpu.storage.persist import (
+    _type_from_json,
+    _type_to_json,
+    decode_dict_arrays,
+    encode_dict_arrays,
+)
+
+
+def _block_arrays(b: HostBlock, prefix: str, arrays: dict, meta: dict) -> None:
+    cols = {}
+    for c, hc in b.columns.items():
+        arrays[f"{prefix}.{c}.data"] = hc.data
+        arrays[f"{prefix}.{c}.valid"] = hc.valid
+        cols[c] = _type_to_json(hc.type)
+        if hc.dictionary is not None:
+            encode_dict_arrays(hc.dictionary, f"{prefix}.{c}", arrays)
+    meta[prefix] = {
+        "cols": cols,
+        "nrows": int(b.nrows),
+        "part_id": b.part_id,
+        "uid": int(b.uid),
+    }
+
+
+def _block_from_arrays(prefix: str, bm: dict, data) -> HostBlock:
+    cols = {}
+    for c, tj in bm["cols"].items():
+        d = data[f"{prefix}.{c}.data"]
+        v = data[f"{prefix}.{c}.valid"]
+        dic = decode_dict_arrays(data, f"{prefix}.{c}")
+        cols[c] = HostColumn(_type_from_json(tj), d, v, dic)
+    blk = HostBlock(cols, int(bm["nrows"]), part_id=bm.get("part_id"))
+    return blk
+
+
+class LogBackupTask:
+    """One running log-backup stream into an external storage URI."""
+
+    def __init__(self, catalog, uri: str, interval_s: float = 0.0):
+        self.catalog = catalog
+        self.uri = uri
+        self.storage: ExternalStorage = open_storage(uri)
+        self._lock = threading.Lock()
+        self._queue: List[Tuple[float, str, str, object, int]] = []
+        # resume sequence numbering after any prior stream into this
+        # storage — restarting at 1 would overwrite the old stream's
+        # early segments and orphan its deltas
+        existing = self.storage.list("log/")
+        self._seq = max(
+            (int(fn.split("/")[1].split("-")[0]) for fn in existing),
+            default=0,
+        )
+        self._captured: Dict[Tuple[str, str], List[int]] = {}  # -> block uids
+        self._hooked: set = set()
+        self.checkpoint_ts: float = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.interval_s = interval_s
+
+    # -- subscription ---------------------------------------------------
+    def _hook_tables(self) -> None:
+        for db in self.catalog.databases():
+            if db.startswith("_"):
+                continue
+            for name in self.catalog.tables(db):
+                t = self.catalog.table(db, name)
+                key = (db.lower(), name.lower())
+                if key in self._hooked:
+                    continue
+                self._hooked.add(key)
+
+                def cb(table, version, _db=db, _name=name):
+                    # runs under the table lock with a pin already taken
+                    with self._lock:
+                        self._queue.append(
+                            (time.time(), _db, _name, table, version)
+                        )
+
+                cb._logbackup_task = self  # stop() filters by this tag
+                t.on_commit.append(cb)
+                # initial scan: capture the current state as the stream
+                # start (pin so GC keeps it until advance())
+                t.pin(t.version)
+                with self._lock:
+                    self._queue.append(
+                        (time.time(), db, name, t, t.version)
+                    )
+
+    def _unhook(self) -> None:
+        for db in self.catalog.databases():
+            if db.startswith("_"):
+                continue
+            for name in self.catalog.tables(db):
+                t = self.catalog.table(db, name)
+                t.on_commit = [
+                    cb for cb in t.on_commit
+                    if getattr(cb, "_logbackup_task", None) is not self
+                ]
+        # release pins still queued (nothing will capture them now)
+        with self._lock:
+            batch, self._queue = self._queue, []
+        for _ts, _db, _name, t, version in batch:
+            t.unpin(version)
+
+    def start(self) -> None:
+        self._hook_tables()
+        try:
+            self.advance()
+        except BaseException:
+            # a failed initial capture must not leave orphan hooks
+            # pinning every future version of every table
+            self._unhook()
+            raise
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="logbackup-advancer"
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.advance()
+            except Exception:
+                pass  # advancer retries next tick; stream stays pinned
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.advance()  # final drain
+        finally:
+            self._unhook()
+
+    # -- the advancer ---------------------------------------------------
+    def advance(self) -> int:
+        """Drain queued versions to storage in commit order; returns
+        segments written. Also subscribes tables created since the last
+        advance (their first capture is a full image). A failed segment
+        write REQUEUES the remaining batch (pins intact) so the stream
+        loses nothing and retries on the next tick — the advancer only
+        moves the checkpoint past durably-written segments."""
+        self._hook_tables()
+        with self._lock:
+            batch = self._queue
+            self._queue = []
+        written = 0
+        for i, (ts, db, name, t, version) in enumerate(batch):
+            try:
+                self._write_segment(ts, db, name, t, version)
+            except BaseException:
+                with self._lock:
+                    self._queue = batch[i:] + self._queue
+                raise
+            t.unpin(version)
+            written += 1
+            self.checkpoint_ts = ts
+        return written
+
+    def _write_segment(self, ts, db, name, t, version) -> None:
+        key = (db.lower(), name.lower())
+        try:
+            blocks = t.blocks(version)
+        except KeyError:
+            return  # version GC'd before hook pinned (unhooked window)
+        uids = [b.uid for b in blocks]
+        prev = self._captured.get(key)
+        arrays: dict = {}
+        meta: dict = {
+            "ts": ts,
+            "db": db,
+            "table": name,
+            "version": version,
+            "schema": {
+                "columns": [
+                    [n, _type_to_json(ty)] for n, ty in t.schema.columns
+                ],
+                "primary_key": t.schema.primary_key,
+            },
+            "order": uids,
+            "blocks": {},
+        }
+        if prev is None:
+            meta["kind"] = "full"
+            ship = blocks
+        else:
+            meta["kind"] = "delta"
+            have = set(prev)
+            ship = [b for b in blocks if b.uid not in have]
+        for b in ship:
+            _block_arrays(b, f"b{b.uid}", arrays, meta["blocks"])
+        self._seq += 1
+        # ts in the name: restore filters segments by timestamp from the
+        # listing alone, fetching only what it will replay
+        seg = f"log/{self._seq:08d}-{ts:.6f}.npz"
+        arrays["_meta"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        )
+        self.storage.write_npz(seg, **arrays)
+        self._captured[key] = uids
+
+
+def restore_point_in_time(uri: str, catalog, until_ts: float) -> int:
+    """Replay a log-backup stream to the state at `until_ts`: per table,
+    the last full segment at-or-before the ts, plus every later delta up
+    to it. Returns tables restored. Reference: `br restore point`
+    (br/pkg/task/stream.go RunStreamRestore)."""
+    from tidb_tpu.storage.table import TableSchema
+
+    storage = open_storage(uri)
+    segs = []
+    for fn in storage.list("log/"):
+        # filter on the timestamp embedded in the name before fetching
+        # any data — a point restore never downloads segments past its ts
+        base = fn.split("/")[1].rsplit(".npz", 1)[0]
+        parts = base.split("-", 1)
+        if len(parts) == 2:
+            try:
+                if float(parts[1]) > until_ts:
+                    continue
+            except ValueError:
+                pass
+        data = storage.read_npz(fn)
+        meta = json.loads(data["_meta"].tobytes().decode("utf-8"))
+        if meta["ts"] <= until_ts:
+            segs.append((meta, data))
+    segs.sort(key=lambda md: (md[0]["ts"], md[0]["version"]))
+    # per table: blocks by uid, replayed in order
+    state: Dict[Tuple[str, str], dict] = {}
+    for meta, data in segs:
+        key = (meta["db"].lower(), meta["table"].lower())
+        st = state.setdefault(key, {"blocks": {}})
+        if meta["kind"] == "full":
+            st["blocks"] = {}
+        for prefix, bm in meta["blocks"].items():
+            st["blocks"][int(bm["uid"])] = _block_from_arrays(
+                prefix, bm, data
+            )
+        st["order"] = meta["order"]
+        st["schema"] = meta["schema"]
+        st["db"], st["table"] = meta["db"], meta["table"]
+    restored = 0
+    for key, st in state.items():
+        schema = TableSchema(
+            [(n, _type_from_json(tj)) for n, tj in st["schema"]["columns"]],
+            primary_key=st["schema"].get("primary_key"),
+        )
+        catalog.create_database(st["db"], if_not_exists=True)
+        t = catalog.create_table(
+            st["db"], st["table"], schema, if_not_exists=True
+        )
+        missing = [u for u in st["order"] if u not in st["blocks"]]
+        if missing:
+            raise ValueError(
+                f"log stream for {st['db']}.{st['table']} is missing "
+                f"blocks {missing}: segments lost or stream started after "
+                "those blocks were written"
+            )
+        blocks = [st["blocks"][u] for u in st["order"]]
+        # normalize string dictionaries: blocks from different segments
+        # may carry different (superset) snapshots of the table-global
+        # dictionary; dictionary growth is append-only between remaps and
+        # every remap re-ships all blocks, so the longest dict decodes
+        # every restored block's codes
+        dicts: Dict[str, np.ndarray] = {}
+        for b in blocks:
+            for c, hc in b.columns.items():
+                if hc.dictionary is not None and len(hc.dictionary) >= len(
+                    dicts.get(c, ())
+                ):
+                    dicts[c] = hc.dictionary
+        for b in blocks:
+            for c, d in dicts.items():
+                hc = b.columns[c]
+                b.columns[c] = HostColumn(hc.type, hc.data, hc.valid, d)
+        t.replace_blocks(blocks)
+        for c, d in dicts.items():
+            t.dictionaries[c] = d
+        restored += 1
+    return restored

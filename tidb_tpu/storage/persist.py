@@ -35,6 +35,39 @@ def _type_from_json(d: Dict) -> SQLType:
     return SQLType(Kind(d["kind"]), scale=d.get("scale", 0))
 
 
+def encode_dict_arrays(dictionary, prefix: str, arrays: Dict) -> None:
+    """Store a string dictionary as UTF-8 bytes + offsets under
+    `{prefix}.dictbuf` / `{prefix}.dictoff` — NOT an object array: object
+    arrays pickle inside the npz, and unpickling a crafted snapshot
+    executes arbitrary code; the reference BR format (protobuf + SST)
+    never deserializes executable payloads either. (Offsets rather than
+    fixed-width unicode: numpy 'U' arrays silently strip trailing NULs,
+    corrupting values.) Shared by BR snapshots and log-backup segments."""
+    enc = [x.encode("utf-8") for x in dictionary]
+    arrays[f"{prefix}.dictbuf"] = np.frombuffer(
+        b"".join(enc) or b"\x00", dtype=np.uint8
+    )
+    arrays[f"{prefix}.dictoff"] = np.cumsum(
+        [0] + [len(e) for e in enc], dtype=np.int64
+    )
+
+
+def decode_dict_arrays(data, prefix: str):
+    """Inverse of encode_dict_arrays; None when the prefix has no
+    dictionary."""
+    if f"{prefix}.dictbuf" not in data:
+        return None
+    buf = data[f"{prefix}.dictbuf"].tobytes()
+    off = data[f"{prefix}.dictoff"]
+    return np.array(
+        [
+            buf[off[i]:off[i + 1]].decode("utf-8")
+            for i in range(len(off) - 1)
+        ],
+        dtype=object,
+    )
+
+
 def save_catalog(
     catalog: Catalog, path: str, dbs=None, resume: bool = False
 ) -> int:
@@ -43,25 +76,25 @@ def save_catalog(
     the checkpoint ledger are skipped — an interrupted backup picks up
     where it stopped (reference: BR backup checkpoints,
     br/pkg/checkpoint/backup.go). Returns tables written this run."""
+    from tidb_tpu.storage.external import open_storage
     from tidb_tpu.utils.failpoint import inject
 
-    os.makedirs(path, exist_ok=True)
-    ckpt_path = os.path.join(path, "checkpoint.json")
+    store = open_storage(path)
     done = {}
-    if resume and os.path.exists(ckpt_path):
-        with open(ckpt_path) as f:
-            # ledger entries carry the table VERSION a file was written
-            # at: a table that changed after its checkpoint re-writes,
-            # so manifest metadata and npz data can't diverge
-            done = {(d, n): v for d, n, v in json.load(f)}
+    if resume and store.exists("checkpoint.json"):
+        # ledger entries carry the table VERSION a file was written
+        # at: a table that changed after its checkpoint re-writes,
+        # so manifest metadata and npz data can't diverge
+        done = {
+            (d, n): v
+            for d, n, v in json.loads(store.read_file("checkpoint.json"))
+        }
     written = 0
     manifest = {"dbs": {}}
-    mpath = os.path.join(path, _MANIFEST)
-    if os.path.exists(mpath):
+    if store.exists(_MANIFEST):
         # a subset backup into a directory holding a broader one must
         # not orphan the other databases' data files
-        with open(mpath) as f:
-            manifest = json.load(f)
+        manifest = json.loads(store.read_file(_MANIFEST))
         manifest.setdefault("dbs", {})
     users = getattr(catalog, "users", None)
     if users is not None:
@@ -113,47 +146,37 @@ def save_catalog(
                 arrays[f"{c}.data"] = hc.data
                 arrays[f"{c}.valid"] = hc.valid
                 if hc.dictionary is not None:
-                    # UTF-8 bytes + offsets, NOT an object array: object
-                    # arrays pickle inside the npz, and unpickling a
-                    # crafted snapshot executes arbitrary code — the
-                    # reference BR format (protobuf + SST) never
-                    # deserializes executable payloads either. (Offsets
-                    # rather than fixed-width unicode: numpy 'U' arrays
-                    # silently strip trailing NULs, corrupting values.)
-                    enc = [x.encode("utf-8") for x in hc.dictionary]
-                    arrays[f"{c}.dictbuf"] = np.frombuffer(
-                        b"".join(enc), dtype=np.uint8
-                    )
-                    arrays[f"{c}.dictoff"] = np.cumsum(
-                        [0] + [len(e) for e in enc], dtype=np.int64
-                    )
-            fn = os.path.join(path, f"{db}.{name}.npz")
-            if done.get((db, name)) == t.version and os.path.exists(fn):
+                    encode_dict_arrays(hc.dictionary, c, arrays)
+            fn = f"{db}.{name}.npz"
+            if done.get((db, name)) == t.version and store.exists(fn):
                 continue  # checkpointed at this exact version
             inject("persist/backup-table")
-            np.savez_compressed(fn, **arrays)
+            store.write_npz(fn, **arrays)
             written += 1
             done[(db, name)] = t.version
-            with open(ckpt_path, "w") as f:
-                json.dump([[d, n, v] for (d, n), v in sorted(done.items())], f)
+            store.write_file(
+                "checkpoint.json",
+                json.dumps(
+                    [[d, n, v] for (d, n), v in sorted(done.items())]
+                ).encode("utf-8"),
+            )
     inject("persist/before-manifest")
-    with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump(manifest, f)
+    store.write_file(_MANIFEST, json.dumps(manifest).encode("utf-8"))
     # a completed backup needs no checkpoint ledger
-    if os.path.exists(ckpt_path):
-        os.remove(ckpt_path)
+    store.delete("checkpoint.json")
     return written
 
 
 def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
     """Rebuild a catalog from a snapshot directory (optionally only the
     named databases — the RESTORE DATABASE path)."""
+    from tidb_tpu.storage.external import open_storage
     from tidb_tpu.utils.failpoint import inject
 
     inject("persist/restore-start")
+    store = open_storage(path)
     catalog = catalog or Catalog()
-    with open(os.path.join(path, _MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = json.loads(store.read_file(_MANIFEST))
     if manifest.get("users") and dbs is None:
         from tidb_tpu.utils.privilege import UserStore
 
@@ -197,22 +220,13 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
             t.fk_actions = dict(meta.get("fk_actions") or {})
             # allow_pickle stays OFF: a snapshot directory is data, and
             # must never be able to execute code on RESTORE
-            data = np.load(os.path.join(path, f"{db}.{name}.npz"))
+            data = store.read_npz(f"{db}.{name}.npz")
             cols = {}
             for n, ty in schema.columns:
                 d = data[f"{n}.data"]
                 v = data[f"{n}.valid"]
-                dic = None
-                if f"{n}.dictbuf" in data:
-                    buf = data[f"{n}.dictbuf"].tobytes()
-                    off = data[f"{n}.dictoff"]
-                    dic = np.array(
-                        [
-                            buf[off[i]:off[i + 1]].decode("utf-8")
-                            for i in range(len(off) - 1)
-                        ],
-                        dtype=object,
-                    )
+                dic = decode_dict_arrays(data, n)
+                if dic is not None:
                     t.dictionaries[n] = dic
                 elif f"{n}.dict" in data:
                     # snapshots from before the offsets format stored a

@@ -92,6 +92,12 @@ class Table:
         # pinned version — the safepoint contract of the reference's GC
         # worker (pkg/store/gcworker/gc_worker.go:194,371).
         self._pins: Dict[int, int] = {}
+        # commit observers: called under the lock with (table, version)
+        # after each version publish — the log-backup subscription seam
+        # (reference: TiKV change-log observers feeding br's log backup,
+        # br/pkg/streamhelper). See _gc_versions for the pin contract.
+        self.on_commit: list = []
+        self._last_notified = 0
         # table-global sorted dictionary per string column
         self.dictionaries: Dict[str, np.ndarray] = {
             n: np.array([], dtype=object)
@@ -284,6 +290,26 @@ class Table:
         for v in [v for v in self._versions if v not in keep]:
             inject("storage/gc-drop-version")
             del self._versions[v]
+        # commit observers (log backup): _gc_versions runs under the
+        # table lock immediately after every version publish, so it is
+        # the one choke point that sees each new version. Each observer
+        # gets a pin taken on its behalf (it can't call pin() here — the
+        # lock is not reentrant) and must unpin after capturing.
+        if self.on_commit and self.version != self._last_notified:
+            v = self.version
+            self._last_notified = v
+            for cb in list(self.on_commit):
+                self._pins[v] = self._pins.get(v, 0) + 1
+                try:
+                    cb(self, v)
+                except Exception:
+                    # an observer must never fail the write path; give
+                    # back the pin it will now never release
+                    n = self._pins.get(v, 0) - 1
+                    if n <= 0:
+                        self._pins.pop(v, None)
+                    else:
+                        self._pins[v] = n
 
     def append_block(self, block: HostBlock) -> int:
         """Append rows; returns the new version id."""
@@ -751,7 +777,7 @@ class Table:
                     nc = HostColumn(c.type, old_remap[c.data], c.valid, merged)
                     cols = dict(b.columns)
                     cols[name] = nc
-                    remapped.append(HostBlock(cols, b.nrows))
+                    remapped.append(HostBlock(cols, b.nrows, part_id=b.part_id))
                 self._versions[self.version] = remapped
             else:
                 # still update dictionary refs on existing blocks
