@@ -449,6 +449,25 @@ def _tpu_tunnel_up(timeout_s: int = 90) -> bool:
         return False
 
 
+def _cached_tpu_result(args, attempts):
+    """The most recent real-TPU measurement of this (query, sf), dressed
+    with full provenance (the measurement's code version vs the code
+    being benchmarked now, plus the failed attempts that led here) — the
+    fallback when the flaky tunnel is down, clearly labeled rather than
+    degrading the headline to the CPU number."""
+    cached = _load_tpu_cache(args)
+    if cached is None:
+        return None
+    result = dict(cached)
+    d = dict(result.get("detail", {}))
+    d["cached_tpu_result"] = True
+    d["current_version"] = _code_version()
+    d["version_match"] = d.get("captured_at_version") == d["current_version"]
+    d["tunnel_attempts_now"] = attempts
+    result["detail"] = d
+    return result
+
+
 def supervise(args, passthrough) -> int:
     attempts = []
     tpu_timeout = int(os.environ.get("TIDB_TPU_BENCH_TIMEOUT", "900"))
@@ -466,20 +485,9 @@ def supervise(args, passthrough) -> int:
                     "error": "tunnel probe failed: jax.devices() hung/errored",
                 }
             )
-            cached = _load_tpu_cache(args)
+            cached = _cached_tpu_result(args, attempts)
             if cached is not None:
-                # report the cached hardware number (full provenance)
-                # rather than degrading the headline to the CPU fallback
-                result = dict(cached)
-                d = dict(result.get("detail", {}))
-                d["cached_tpu_result"] = True
-                d["current_version"] = _code_version()
-                d["version_match"] = (
-                    d.get("captured_at_version") == d["current_version"]
-                )
-                d["tunnel_attempts_now"] = attempts
-                result["detail"] = d
-                print(json.dumps(result))
+                print(json.dumps(cached))
                 return 0
     plans.append(("cpu", tpu_timeout))
 
@@ -503,25 +511,11 @@ def supervise(args, passthrough) -> int:
             if result is not None:
                 break
         if backend == "tpu" and result is None:
-            # The TPU tunnel flaps (round 1 died on it entirely). If an
-            # earlier run of THIS code captured a real TPU measurement,
-            # report that — clearly labeled as cached, with the failed
-            # attempts attached — rather than degrading the headline to
-            # the CPU fallback number.
-            cached = _load_tpu_cache(args)
+            # The TPU tunnel flaps (round 1 died on it entirely): fall
+            # back to the cached hardware measurement if one exists.
+            cached = _cached_tpu_result(args, attempts)
             if cached is not None:
-                result = dict(cached)
-                d = dict(result.get("detail", {}))
-                d["cached_tpu_result"] = True
-                # full provenance: the measurement's code version vs the
-                # code being benchmarked now — a mismatch means the number
-                # was captured on an earlier commit of this round
-                d["current_version"] = _code_version()
-                d["version_match"] = d.get("captured_at_version") == d[
-                    "current_version"
-                ]
-                d["tunnel_attempts_now"] = attempts
-                result["detail"] = d
+                result = cached
                 break
 
     if result is None:

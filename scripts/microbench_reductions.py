@@ -1,0 +1,168 @@
+"""Microbenchmark candidate Q1-style grouped-reduction strategies on the
+live backend: where do 74ms go at SF1, and what is the floor?
+
+Shapes mirror Q1 SF1: 6M rows, 8 dense slots, ~8 sum lanes of
+int64-scaled decimals plus a count.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(os.environ.get("MB_N", str(6_000_000)))
+SLOTS = 8
+LANES = 8
+
+print("backend:", jax.default_backend(), flush=True)
+
+rng = np.random.default_rng(0)
+seg_np = rng.integers(0, 6, N)
+vals_np = rng.integers(0, 10_000_000, (LANES, N))
+valid_np = rng.random(N) < 0.98
+
+seg = jnp.asarray(seg_np, dtype=jnp.int32)
+vals64 = jnp.asarray(vals_np, dtype=jnp.int64)
+vals32 = jnp.asarray(vals_np, dtype=jnp.int32)
+valsf32 = jnp.asarray(vals_np, dtype=jnp.float32)
+valsf64 = jnp.asarray(vals_np, dtype=jnp.float64)
+valid = jnp.asarray(valid_np)
+
+
+def timeit(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))  # compile
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:44s} {np.median(ts)*1e3:8.2f} ms", flush=True)
+    return out
+
+
+@jax.jit
+def plain_sum_i64(v):
+    return jnp.sum(v, axis=1)
+
+
+@jax.jit
+def plain_sum_i32(v):
+    return jnp.sum(v, axis=1)
+
+
+@jax.jit
+def plain_sum_f32(v):
+    return jnp.sum(v, axis=1)
+
+
+@jax.jit
+def masked_per_slot(v, seg, valid):
+    # current _masked_backend shape: per (slot, lane) fused masked reduction
+    v, valid = jax.lax.optimization_barrier((v, valid))
+    outs = []
+    for lane in range(LANES):
+        outs.append(
+            jnp.stack(
+                [
+                    jnp.sum(jnp.where(valid & (seg == s), v[lane], 0))
+                    for s in range(SLOTS)
+                ]
+            )
+        )
+    return jnp.stack(outs)
+
+
+@jax.jit
+def segment_scatter(v, seg, valid):
+    s = jnp.where(valid, seg, SLOTS)
+    return jnp.stack(
+        [
+            jax.ops.segment_sum(v[lane], s, num_segments=SLOTS + 1)
+            for lane in range(LANES)
+        ]
+    )
+
+
+@jax.jit
+def onehot_matmul_f32(v, seg, valid):
+    # [N, SLOTS] one-hot (f32) x [N, LANES] -> [SLOTS, LANES] on the MXU
+    oh = (seg[:, None] == jnp.arange(SLOTS)[None, :]) & valid[:, None]
+    return jax.lax.dot_general(
+        oh.astype(jnp.float32),
+        v.T,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@jax.jit
+def onehot_matmul_exact_i64(v, seg, valid):
+    """Exact int64 grouped sums on the MXU: split each value into 16-bit
+    limbs, accumulate each limb as f32 matmuls over row chunks small
+    enough that every partial sum stays exactly representable, then
+    recombine in int64."""
+    oh = ((seg[:, None] == jnp.arange(SLOTS)[None, :]) & valid[:, None]).astype(
+        jnp.float32
+    )
+    total = jnp.zeros((SLOTS, LANES), dtype=jnp.int64)
+    # 16-bit limbs: limb < 2^16; chunk of 128 rows keeps partial sums
+    # < 2^23 (exact in f32); accumulate chunk results in int64 via a
+    # reshape to [n_chunks, chunk, ...] batch matmul
+    CH = 128
+    n = v.shape[1]
+    nch = n // CH
+    vv = v[:, : nch * CH].reshape(LANES, nch, CH)
+    ohh = oh[: nch * CH].reshape(nch, CH, SLOTS)
+    for shift in (0, 16, 32):
+        limb = ((vv >> shift) & 0xFFFF).astype(jnp.float32)
+        # [nch, CH, SLOTS]^T x [LANES, nch, CH] -> per-chunk [nch, SLOTS, LANES]
+        part = jax.lax.dot_general(
+            ohh,
+            limb,
+            (((1,), (2,)), ((0,), (1,))),
+        )  # [nch, SLOTS, LANES]
+        total = total + (part.astype(jnp.int64).sum(axis=0) << shift)
+    return total
+
+
+@jax.jit
+def bincount_style(v, seg, valid):
+    # jnp .at[].add scatter
+    s = jnp.where(valid, seg, SLOTS)
+    acc = jnp.zeros((LANES, SLOTS + 1), dtype=jnp.int64)
+    for lane in range(LANES):
+        acc = acc.at[lane, s].add(v[lane])
+    return acc
+
+
+timeit("plain sum i64 (8 lanes)", plain_sum_i64, vals64)
+timeit("plain sum i32 (8 lanes)", plain_sum_i32, vals32)
+timeit("plain sum f32 (8 lanes)", plain_sum_f32, valsf32)
+try:
+    timeit("plain sum f64 (8 lanes)", jax.jit(lambda v: jnp.sum(v, axis=1)), valsf64)
+except Exception as e:
+    print("f64 sum failed:", e)
+r_masked = timeit("masked per-slot (current TPU path)", masked_per_slot, vals64, seg, valid)
+r_seg = timeit("segment_sum scatter", segment_scatter, vals64, seg, valid)
+r_mm = timeit("one-hot matmul f32 (inexact)", onehot_matmul_f32, valsf32, seg, valid)
+r_exact = timeit("one-hot matmul exact i64 (limbs)", onehot_matmul_exact_i64, vals64, seg, valid)
+
+# correctness of the exact path vs numpy
+ref = np.zeros((SLOTS, LANES), dtype=np.int64)
+m = valid_np
+for s in range(SLOTS):
+    sel = m & (seg_np == s)
+    ref[s] = vals_np[:, sel].sum(axis=1)
+got = np.asarray(r_exact)
+n_used = (N // 128) * 128
+ref2 = np.zeros((SLOTS, LANES), dtype=np.int64)
+m2 = m[:n_used]
+for s in range(SLOTS):
+    sel = m2 & (seg_np[:n_used] == s)
+    ref2[s] = vals_np[:, :n_used][:, sel].sum(axis=1)
+print("exact-matmul correct:", bool((got == ref2).all()), flush=True)

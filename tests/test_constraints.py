@@ -356,3 +356,97 @@ class TestFKReferentialActions:
         s2 = Session(cat2, db="test")
         s2.execute("delete from p where id = 1")
         assert s2.execute("select id from c order by id").rows == [(102,)]
+
+
+class TestCompositeKeys:
+    """Multi-column PK/UNIQUE enforcement across the whole conflict
+    surface: plain INSERT, INSERT IGNORE, ON DUPLICATE KEY UPDATE, and
+    REPLACE INTO (reference: the unique-key list walked by
+    pkg/executor/replace.go removeRow; AddRecord duplicate checks in
+    pkg/table/tables.go)."""
+
+    def test_composite_pk_enforced(self, sess):
+        sess.execute("create table t (a int, b int, c int, primary key (a, b))")
+        sess.execute("insert into t values (1, 1, 10), (1, 2, 20)")
+        with pytest.raises(ValueError, match="duplicate"):
+            sess.execute("insert into t values (1, 2, 99)")
+        # same first column, different second: NOT a duplicate
+        sess.execute("insert into t values (1, 3, 30)")
+        assert sess.execute("select count(*) from t").rows == [(3,)]
+
+    def test_composite_unique_index(self, sess):
+        sess.execute("create table t (a int, b int, v int)")
+        sess.execute("create unique index uab on t (a, b)")
+        sess.execute("insert into t values (1, 1, 10), (1, 2, 20), (2, 1, 30)")
+        with pytest.raises(ValueError, match="duplicate"):
+            sess.execute("insert into t values (2, 1, 99)")
+        # a NULL in any component exempts the row, repeatedly
+        sess.execute("insert into t values (2, null, 1), (2, null, 2)")
+        assert sess.execute("select count(*) from t").rows == [(5,)]
+
+    def test_composite_insert_ignore(self, sess):
+        sess.execute("create table t (a int, b int, v int, primary key (a, b))")
+        sess.execute("insert into t values (1, 1, 10)")
+        sess.execute("insert ignore into t values (1, 1, 99), (1, 2, 20)")
+        assert sess.execute(
+            "select a, b, v from t order by a, b"
+        ).rows == [(1, 1, 10), (1, 2, 20)]
+
+    def test_composite_on_duplicate_key(self, sess):
+        sess.execute("create table t (a int, b int, v int, primary key (a, b))")
+        sess.execute("insert into t values (1, 1, 10), (1, 2, 20)")
+        r = sess.execute(
+            "insert into t values (1, 1, 99), (3, 3, 30) "
+            "on duplicate key update v = values(v)"
+        )
+        assert r.affected == 3  # one update (2) + one insert (1)
+        assert sess.execute(
+            "select a, b, v from t order by a, b"
+        ).rows == [(1, 1, 99), (1, 2, 20), (3, 3, 30)]
+
+    def test_composite_replace_into(self, sess):
+        sess.execute("create table t (a int, b int, v int)")
+        sess.execute("create unique index uab on t (a, b)")
+        sess.execute("insert into t values (1, 1, 10), (1, 2, 20)")
+        sess.execute("replace into t values (1, 1, 99)")
+        assert sess.execute(
+            "select a, b, v from t order by a, b"
+        ).rows == [(1, 1, 99), (1, 2, 20)]
+        # statement-internal duplicate keys: last one wins
+        sess.execute("replace into t values (5, 5, 1), (5, 5, 2)")
+        assert sess.execute(
+            "select v from t where a = 5 and b = 5"
+        ).rows == [(2,)]
+
+    def test_composite_pk_string_component(self, sess):
+        sess.execute(
+            "create table t (k varchar(10), n int, v int, primary key (k, n))"
+        )
+        sess.execute("insert into t values ('x', 1, 10), ('y', 1, 20)")
+        with pytest.raises(ValueError, match="duplicate"):
+            sess.execute("insert into t values ('x', 1, 99)")
+        sess.execute("replace into t values ('x', 1, 99)")
+        assert sess.execute(
+            "select v from t where k = 'x' and n = 1"
+        ).rows == [(99,)]
+
+    def test_pk_rejects_null_components(self, sess):
+        # MySQL: PRIMARY KEY implies NOT NULL on every component
+        sess.execute("create table t (a int, b int, primary key (a, b))")
+        with pytest.raises(ValueError, match="cannot be null"):
+            sess.execute("insert into t values (1, null)")
+        sess.execute("create table u (a int primary key)")
+        with pytest.raises(ValueError, match="cannot be null"):
+            sess.execute("insert into u values (null)")
+
+    def test_composite_unique_index_over_altered_blocks(self, sess):
+        # blocks written before ALTER ADD COLUMN lack the new column;
+        # CREATE UNIQUE INDEX over it must treat those rows as NULL
+        # (exempt), not crash
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1), (1)")
+        sess.execute("alter table t add column b int")
+        sess.execute("create unique index uab on t (a, b)")
+        sess.execute("insert into t values (1, 2)")
+        with pytest.raises(ValueError, match="duplicate"):
+            sess.execute("insert into t values (1, 2)")

@@ -1,0 +1,67 @@
+"""Scale-tier (@slow) TPC-H parity: ALL 22 ladder queries at SF0.1 with a
+memory quota small enough that the streamed (spill-analog) agg and
+host-staged sort paths actually engage, golden-checked by the same
+plain-Python oracles as the default-tier run.
+
+Reference: realtikvtest runs SF-sized workloads against the real engine
+(VERDICT round-2 item #9). The queries and oracles live in
+tests/test_tpch_sql.py; this driver re-runs that module in a child
+pytest with TIDB_TPU_TPCH_SF / TIDB_TPU_TPCH_QUOTA set, so the whole
+22-query surface is exercised at scale without duplicating oracles.
+
+Run with RUN_SLOW=1 python -m pytest tests/test_scale_tpch22.py -q
+(SF via TIDB_TPU_SCALE22_SF, default 0.1; quota via
+TIDB_TPU_SCALE22_QUOTA, default 48MB — small enough at SF0.1 that Q1's
+aggregation goes through the streamed path and Q18's sort is staged).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tier(sf: str, quota: str | None, extra: list | None = None) -> None:
+    env = dict(os.environ)
+    env["TIDB_TPU_TPCH_SF"] = sf
+    if quota:
+        env["TIDB_TPU_TPCH_QUOTA"] = quota
+    else:
+        env.pop("TIDB_TPU_TPCH_QUOTA", None)
+    env.pop("RUN_SLOW", None)  # the child runs the default tier only
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_tpch_sql.py", "-q",
+         *(extra or [])],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=5400,
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stdout or "").splitlines()[-40:])
+        raise AssertionError(
+            f"SF{sf} tier failed (quota={quota}):\n{tail}\n{proc.stderr[-2000:]}"
+        )
+
+
+def test_tpch22_sf01_small_quota():
+    """All 22 queries at SF0.1 under a quota that forces the streamed
+    aggregation / staged sort paths wherever they apply."""
+    sf = os.environ.get("TIDB_TPU_SCALE22_SF", "0.1")
+    quota = os.environ.get("TIDB_TPU_SCALE22_QUOTA", str(48 << 20))
+    _run_tier(sf, quota)
+
+
+def test_tpch22_sf01_default_quota():
+    """Same 22 queries at SF0.1 with the default quota: the in-HBM path
+    at a size where tiling decisions matter. Parity across BOTH quota
+    tiers means the spill path and the resident path agree with the
+    oracles independently."""
+    sf = os.environ.get("TIDB_TPU_SCALE22_SF", "0.1")
+    _run_tier(sf, None)

@@ -166,11 +166,16 @@ class TestReviewRegressions:
         assert s.execute("select * from t1").rows == [(1, "x")]
         assert s.catalog.table("test", "t1").schema.names == ["a", "b"]
 
-    def test_replace_composite_pk_rejected(self):
+    def test_replace_composite_pk_replaces(self):
+        # formerly NotImplementedError; composite conflict keys are now
+        # first-class across REPLACE/IGNORE/ON DUP (round-3)
         s = Session()
         s.execute("create table cp (a int, b int, v int, primary key (a, b))")
-        with pytest.raises(NotImplementedError):
-            s.execute("replace into cp values (1,1,9)")
+        s.execute("insert into cp values (1,1,1), (1,2,2)")
+        s.execute("replace into cp values (1,1,9)")
+        assert s.execute("select a,b,v from cp order by a,b").rows == [
+            (1, 1, 9), (1, 2, 2)
+        ]
 
     def test_replace_intra_statement_keeps_last(self):
         s = Session()

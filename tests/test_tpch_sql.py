@@ -1,7 +1,14 @@
 """TPC-H benchmark ladder (BASELINE.json configs) through the SQL surface,
-golden-checked against plain-Python computation over the decoded data."""
+golden-checked against plain-Python computation over the decoded data.
+
+Scale tier: TIDB_TPU_TPCH_SF overrides the scale factor (default 0.002)
+and TIDB_TPU_TPCH_QUOTA sets a per-query memory quota in bytes — a quota
+small enough that the streamed (spill-analog) aggregation and host-merged
+sort paths engage turns this same 22-query module into the SF0.1+ parity
+suite (driven by tests/test_scale_tpch22.py under the slow marker)."""
 
 import math
+import os
 from collections import defaultdict
 
 import pytest
@@ -10,12 +17,17 @@ from tidb_tpu.bench import load_tpch
 from tidb_tpu.session import Session
 from tidb_tpu.storage import Catalog
 
+_SF = float(os.environ.get("TIDB_TPU_TPCH_SF", "0.002"))
+_QUOTA = os.environ.get("TIDB_TPU_TPCH_QUOTA")
+
 
 @pytest.fixture(scope="module")
 def sess():
     cat = Catalog()
-    load_tpch(cat, sf=0.002, seed=11)
+    load_tpch(cat, sf=_SF, seed=11)
     s = Session(cat, db="tpch")
+    if _QUOTA:
+        s.execute(f"set tidb_mem_quota_query = {int(_QUOTA)}")
     return s
 
 

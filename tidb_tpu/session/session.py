@@ -675,8 +675,6 @@ class Session:
         unknown = set(cols) - set(t.schema.names)
         if unknown:
             raise ValueError(f"unknown columns {sorted(unknown)}")
-        if unique and len(cols) != 1:
-            raise ValueError("UNIQUE indexes support a single column")
 
         # -- state: WRITE_ONLY — writers maintain, readers ignore
         with t._lock:
@@ -690,11 +688,24 @@ class Session:
             t.index_states[iname] = "write_reorg"
             failpoint.inject("ddl/index-write-reorg")
             if unique:
-                svals, _perm, nvalid = t._sorted_index(cols[0])
-                if nvalid and len(np.unique(svals[:nvalid])) != nvalid:
+                if len(cols) == 1:
+                    svals, _perm, nvalid = t._sorted_index(cols[0])
+                    dup = nvalid and len(np.unique(svals[:nvalid])) != nvalid
+                else:
+                    # _sorted_composite skips blocks predating an ALTER
+                    # ADD COLUMN of an indexed column (those rows read
+                    # as NULL -> exempt) and exempts NULL components —
+                    # duplicates are adjacent equals in the sorted view
+                    sv = t._sorted_composite(tuple(cols))
+                    dup = (
+                        sv is not None
+                        and len(sv) > 1
+                        and bool((sv[1:] == sv[:-1]).any())
+                    )
+                if dup:
                     raise ValueError(
                         f"cannot create unique index {name}: duplicate "
-                        f"entries in column {cols[0]}"
+                        f"entries in columns ({', '.join(cols)})"
                     )
             # warm the physical index so the first query doesn't pay
             # the argsort (the backfill write step)
@@ -1300,9 +1311,11 @@ class Session:
                 rows = []
                 if task is not None:
                     task.advance()
-                    rows.append(
-                        ("running", task.uri, round(task.checkpoint_ts, 3))
-                    )
+                    # exact ts, never rounded down: operators feed this
+                    # into RESTORE POINT ... UNTIL, and a truncated value
+                    # would exclude the newest segment the checkpoint
+                    # claims is durable
+                    rows.append(("running", task.uri, task.checkpoint_ts))
                 r = Result(["state", "storage", "checkpoint_ts"], rows)
         elif isinstance(s, ast.RestorePoint):
             from tidb_tpu.storage.logbackup import restore_point_in_time
@@ -2030,6 +2043,20 @@ class Session:
                     out.add(v)
         return out
 
+    def _key_tuple_values(self, db: str, name: str, cols) -> set:
+        """All fully-non-NULL key tuples of the given column set at this
+        session's read snapshot (host decode — conflict batches are
+        small)."""
+        t, version = self._resolve_table_for_read(db, name)
+        out = set()
+        for b in t.blocks(version):
+            decs = [b.columns[c].decode() for c in cols]
+            oks = [b.columns[c].valid for c in cols]
+            for i in range(b.nrows):
+                if all(ok[i] for ok in oks):
+                    out.add(tuple(d[i] for d in decs))
+        return out
+
     def _enforce_write_constraints(self, t, db: str, rows) -> None:
         """CHECK + child-side FOREIGN KEY validation over fully-formed
         Python rows, BEFORE they are encoded/appended (reference:
@@ -2205,18 +2232,37 @@ class Session:
         clear_scan_cache()
         self._fk_recheck_children(cdb, ctn, depth, undo)
 
-    def _unique_key_cols(self, t):
-        """Single-column conflict keys: PK (when single) + single-column
-        UNIQUE indexes — the same key set REPLACE INTO uses."""
+    def _unique_key_sets(self, t):
+        """Conflict keys as ordered column tuples: the PK plus every
+        UNIQUE index, single- or multi-column — the key set REPLACE INTO
+        and ON DUPLICATE KEY resolve against (reference: the unique-key
+        list walked by pkg/executor/replace.go removeRow)."""
         out = []
         pk = t.schema.primary_key
-        if pk and len(pk) == 1:
-            out.append(pk[0])
+        if pk:
+            out.append(tuple(pk))
         for iname in sorted(t.unique_indexes):
             c = t.indexes.get(iname)
-            if c and c[0] not in out:
-                out.append(c[0])
+            if c and tuple(c) not in out:
+                out.append(tuple(c))
         return out
+
+    def _unique_key_cols(self, t):
+        """Flattened union of all conflict-key columns (any arity)."""
+        out = []
+        for ks in self._unique_key_sets(t):
+            for c in ks:
+                if c not in out:
+                    out.append(c)
+        return out
+
+    @staticmethod
+    def _row_key(row, idxs):
+        """A row's value under one key set: a tuple of component values,
+        or None when any component is NULL (MySQL: NULL never
+        conflicts)."""
+        vals = tuple(row[i] for i in idxs)
+        return None if any(v is None for v in vals) else vals
 
     def _filter_ignore(self, t, db: str, names, rows, skip_unique=False):
         """INSERT IGNORE: drop (instead of fail) rows that violate a
@@ -2240,8 +2286,12 @@ class Session:
             []
             if skip_unique
             else [
-                (names.index(kc), self._column_values(db, t.name, kc), set())
-                for kc in self._unique_key_cols(t)
+                (
+                    tuple(names.index(c) for c in ks),
+                    self._key_tuple_values(db, t.name, ks),
+                    set(),
+                )
+                for ks in self._unique_key_sets(t)
             ]
         )
         kept = []
@@ -2257,16 +2307,17 @@ class Session:
             ):
                 continue
             dup = False
-            for i, existing, seen in key_state:
-                v = r[i]
+            for idxs, existing, seen in key_state:
+                v = self._row_key(r, idxs)
                 if v is not None and (v in existing or v in seen):
                     dup = True
                     break
             if dup:
                 continue
-            for i, _existing, seen in key_state:
-                if r[i] is not None:
-                    seen.add(r[i])
+            for idxs, _existing, seen in key_state:
+                v = self._row_key(r, idxs)
+                if v is not None:
+                    seen.add(v)
             for _i, parent, ri in fk_parents:
                 # self-FK: a KEPT row's key becomes a valid parent for
                 # later rows of this same statement (mirrors the strict
@@ -2322,33 +2373,41 @@ class Session:
         conflicting rows are fetched, updated, re-appended; statement-
         internal duplicates update the pending row in place (reference:
         pkg/executor/insert.go onDuplicateUpdate)."""
-        key_cols = self._unique_key_cols(t)
+        key_sets = self._unique_key_sets(t)
         assigns = [(c.lower(), e) for c, e in assigns]
         for c, _e in assigns:
             if c not in names:
                 raise ValueError(f"unknown column {c!r} in ON DUPLICATE KEY")
-        if not key_cols:
+        if not key_sets:
             return list(rows), {}, 0
-        ki = {kc: names.index(kc) for kc in key_cols}
+        ki = {ks: tuple(names.index(c) for c in ks) for ks in key_sets}
         incoming_keys = {
-            kc: {r[ki[kc]] for r in rows if r[ki[kc]] is not None}
-            for kc in key_cols
+            ks: {
+                v
+                for r in rows
+                if (v := self._row_key(r, ki[ks])) is not None
+            }
+            for ks in key_sets
         }
         # fetch existing rows that conflict with any incoming key —
         # key columns are scanned first so non-matching blocks skip the
         # full-row decode entirely
         fetched = []
-        existing = {kc: {} for kc in key_cols}
+        existing = {ks: {} for ks in key_sets}
+        kcols = sorted({c for ks in key_sets for c in ks})
         for b in t.blocks():
-            kdec = {kc: b.columns[kc].decode() for kc in key_cols}
-            kok = {kc: b.columns[kc].valid for kc in key_cols}
+            kdec = {c: b.columns[c].decode() for c in kcols}
+            kok = {c: b.columns[c].valid for c in kcols}
+
+            def bkey(i, ks):
+                if not all(kok[c][i] for c in ks):
+                    return None
+                return tuple(kdec[c][i] for c in ks)
+
             hits = [
                 i
                 for i in range(b.nrows)
-                if any(
-                    kok[kc][i] and kdec[kc][i] in incoming_keys[kc]
-                    for kc in key_cols
-                )
+                if any(bkey(i, ks) in incoming_keys[ks] for ks in key_sets)
             ]
             if not hits:
                 continue
@@ -2358,10 +2417,11 @@ class Session:
                 rowv = [dec[c][i] if ok[c][i] else None for c in names]
                 idx = len(fetched)
                 fetched.append(rowv)
-                for kc in key_cols:
-                    if rowv[ki[kc]] is not None:
-                        existing[kc][rowv[ki[kc]]] = idx
-        pending, pkey = [], {kc: {} for kc in key_cols}
+                for ks in key_sets:
+                    v = self._row_key(rowv, ki[ks])
+                    if v is not None:
+                        existing[ks][v] = idx
+        pending, pkey = [], {ks: {} for ks in key_sets}
         # origin: id(pending row) -> [(key col, old value)] of the
         # existing row it replaces — the caller deletes old rows only
         # for pending rows that actually get appended (INSERT IGNORE
@@ -2371,23 +2431,24 @@ class Session:
         consumed = set()
         for row in rows:
             target = None
-            for kc in key_cols:
-                v = row[ki[kc]]
+            for ks in key_sets:
+                v = self._row_key(row, ki[ks])
                 if v is None:
                     continue
-                if v in pkey[kc]:
-                    target = ("p", pkey[kc][v])
+                if v in pkey[ks]:
+                    target = ("p", pkey[ks][v])
                     break
-                fi = existing[kc].get(v)
+                fi = existing[ks].get(v)
                 if fi is not None and fi not in consumed:
                     target = ("e", fi)
                     break
             if target is None:
                 idx = len(pending)
                 pending.append(row)
-                for kc in key_cols:
-                    if row[ki[kc]] is not None:
-                        pkey[kc][row[ki[kc]]] = idx
+                for ks in key_sets:
+                    v = self._row_key(row, ki[ks])
+                    if v is not None:
+                        pkey[ks][v] = idx
                 continue
             n_upd += 1
             if target[0] == "e":
@@ -2396,46 +2457,52 @@ class Session:
                 old = fetched[fi]
                 new = self._eval_on_dup(assigns, names, old, row)
                 origin[id(new)] = [
-                    (kc, old[ki[kc]])
-                    for kc in key_cols
-                    if old[ki[kc]] is not None
+                    (ks, v)
+                    for ks in key_sets
+                    if (v := self._row_key(old, ki[ks])) is not None
                 ]
                 idx = len(pending)
                 pending.append(new)
-                for kc in key_cols:
-                    if new[ki[kc]] is not None:
-                        pkey[kc][new[ki[kc]]] = idx
+                for ks in key_sets:
+                    v = self._row_key(new, ki[ks])
+                    if v is not None:
+                        pkey[ks][v] = idx
             else:
                 pi = target[1]
                 old = pending[pi]
                 new = self._eval_on_dup(assigns, names, old, row)
                 if id(old) in origin:
                     origin[id(new)] = origin.pop(id(old))
-                for kc in key_cols:
-                    ov = old[ki[kc]]
-                    if ov is not None and pkey[kc].get(ov) == pi:
-                        del pkey[kc][ov]
+                for ks in key_sets:
+                    ov = self._row_key(old, ki[ks])
+                    if ov is not None and pkey[ks].get(ov) == pi:
+                        del pkey[ks][ov]
                 pending[pi] = new
-                for kc in key_cols:
-                    if new[ki[kc]] is not None:
-                        pkey[kc][new[ki[kc]]] = pi
+                for ks in key_sets:
+                    v = self._row_key(new, ki[ks])
+                    if v is not None:
+                        pkey[ks][v] = pi
         return pending, origin, n_upd
 
     def _delete_rows_by_keys(self, t, del_keys: dict) -> None:
-        """Delete rows whose key column holds one of the given values
-        (host decode — ON DUPLICATE KEY batches are small)."""
-        for col, values in del_keys.items():
+        """Delete rows whose key set (column tuple) holds one of the
+        given value tuples (host decode — ON DUPLICATE KEY batches are
+        small)."""
+        for cols, values in del_keys.items():
             if not values:
                 continue
             keep = []
             for b in t.blocks():
-                c = b.columns[col]
-                dec = c.decode()
+                decs = [b.columns[c].decode() for c in cols]
+                oks = [b.columns[c].valid for c in cols]
                 keep.append(
                     np.array(
                         [
-                            not (o and v in values)
-                            for o, v in zip(c.valid, dec)
+                            not (
+                                all(ok[i] for ok in oks)
+                                and tuple(d[i] for d in decs) in values
+                            )
+                            for i in range(b.nrows)
                         ],
                         dtype=bool,
                     )
@@ -2566,73 +2633,89 @@ class Session:
 
     def _replace_conflicts(self, t, names, rows) -> None:
         """REPLACE INTO: delete existing rows whose PK or any UNIQUE key
-        collides with an incoming row, then the normal append inserts
-        the replacements (reference: pkg/executor/replace.go — delete
-        then insert under one statement)."""
+        — single- or multi-column — collides with an incoming row, then
+        the normal append inserts the replacements (reference:
+        pkg/executor/replace.go — delete then insert under one
+        statement)."""
         import numpy as np
 
-        key_cols = []
-        pk = t.schema.primary_key
-        if pk and len(pk) > 1:
-            raise NotImplementedError(
-                "REPLACE INTO on a composite primary key is not supported"
-            )
-        if pk and len(pk) == 1:
-            key_cols.append(pk[0])
-        for iname in t.unique_indexes:
-            c = t.indexes.get(iname)
-            if c and c[0] not in key_cols:
-                key_cols.append(c[0])
-        if not key_cols or not rows:
+        key_sets = self._unique_key_sets(t)
+        if not key_sets or not rows:
             return
         # MySQL REPLACE keeps the LAST row when one statement carries
         # duplicate keys — dedupe incoming rows before the append
-        for col in key_cols:
-            i = names.index(col)
+        for ks in key_sets:
+            idxs = tuple(names.index(c) for c in ks)
             seen = set()
             kept = []
             for r in reversed(rows):
-                k = r[i]
+                k = self._row_key(r, idxs)
                 if k is not None and k in seen:
                     continue
                 if k is not None:
                     seen.add(k)
                 kept.append(r)
             rows[:] = list(reversed(kept))
-        for col in key_cols:
-            i = names.index(col)
-            incoming = {r[i] for r in rows if r[i] is not None}
+        for ks in key_sets:
+            idxs = tuple(names.index(c) for c in ks)
+            incoming = {
+                v for r in rows if (v := self._row_key(r, idxs)) is not None
+            }
             if not incoming:
                 continue
-            typ = t.schema.types[col]
-            from tidb_tpu.dtypes import Kind as _K
-
-            if typ.kind == _K.STRING:
-                keep_masks = []
-                for b in t.blocks():
-                    c = b.columns[col]
-                    if c.dictionary is None or not len(c.dictionary):
-                        keep_masks.append(np.ones(b.nrows, dtype=bool))
-                        continue
-                    vals = c.dictionary[np.clip(c.data, 0, len(c.dictionary) - 1)]
-                    hit = np.array(
-                        [bool(v) and str(x) in incoming for v, x in zip(c.valid, vals)]
-                    )
-                    keep_masks.append(~hit)
+            if len(ks) == 1:
+                keep_masks = self._replace_masks_single(t, ks[0], {
+                    v[0] for v in incoming
+                })
             else:
-                from tidb_tpu.chunk import column_from_values
-
-                enc = column_from_values(sorted(incoming), typ)
-                targets = np.sort(enc.data)
                 keep_masks = []
                 for b in t.blocks():
-                    c = b.columns[col]
-                    pos = np.searchsorted(targets, c.data)
-                    pos = np.clip(pos, 0, len(targets) - 1)
-                    hit = c.valid & (targets[pos] == c.data)
+                    decs = [b.columns[c].decode() for c in ks]
+                    oks = [b.columns[c].valid for c in ks]
+                    hit = np.array(
+                        [
+                            all(ok[i] for ok in oks)
+                            and tuple(d[i] for d in decs) in incoming
+                            for i in range(b.nrows)
+                        ],
+                        dtype=bool,
+                    )
                     keep_masks.append(~hit)
             if any((~m).any() for m in keep_masks):
                 t.delete_where(keep_masks)
+
+    def _replace_masks_single(self, t, col: str, incoming: set):
+        """Vectorized keep-masks for a single-column conflict key."""
+        import numpy as np
+
+        typ = t.schema.types[col]
+        from tidb_tpu.dtypes import Kind as _K
+
+        if typ.kind == _K.STRING:
+            keep_masks = []
+            for b in t.blocks():
+                c = b.columns[col]
+                if c.dictionary is None or not len(c.dictionary):
+                    keep_masks.append(np.ones(b.nrows, dtype=bool))
+                    continue
+                vals = c.dictionary[np.clip(c.data, 0, len(c.dictionary) - 1)]
+                hit = np.array(
+                    [bool(v) and str(x) in incoming for v, x in zip(c.valid, vals)]
+                )
+                keep_masks.append(~hit)
+            return keep_masks
+        from tidb_tpu.chunk import column_from_values
+
+        enc = column_from_values(sorted(incoming), typ)
+        targets = np.sort(enc.data)
+        keep_masks = []
+        for b in t.blocks():
+            c = b.columns[col]
+            pos = np.searchsorted(targets, c.data)
+            pos = np.clip(pos, 0, len(targets) - 1)
+            hit = c.valid & (targets[pos] == c.data)
+            keep_masks.append(~hit)
+        return keep_masks
 
     @staticmethod
     def _const_value(e):

@@ -41,8 +41,12 @@ from tidb_tpu.storage.external import ExternalStorage, open_storage
 from tidb_tpu.storage.persist import (
     _type_from_json,
     _type_to_json,
+    apply_table_meta,
     decode_dict_arrays,
     encode_dict_arrays,
+    schema_from_meta,
+    schemas_equivalent,
+    table_meta_to_json,
 )
 
 
@@ -81,6 +85,11 @@ class LogBackupTask:
         self.uri = uri
         self.storage: ExternalStorage = open_storage(uri)
         self._lock = threading.Lock()
+        # serializes whole advance() drains: the background advancer
+        # thread and a foreground STATUS/stop both call advance(), and
+        # _seq/_captured updates must not interleave (same-name segment
+        # overwrites, deltas diffed against stale uids)
+        self._advance_mu = threading.Lock()
         self._queue: List[Tuple[float, str, str, object, int]] = []
         # resume sequence numbering after any prior stream into this
         # storage — restarting at 1 would overwrite the old stream's
@@ -91,7 +100,11 @@ class LogBackupTask:
             default=0,
         )
         self._captured: Dict[Tuple[str, str], List[int]] = {}  # -> block uids
-        self._hooked: set = set()
+        # (db, name) -> Table.uid of the OBJECT we hooked: a table
+        # dropped and recreated under the same name is a fresh object
+        # that must be re-hooked (and re-captured in full), or every
+        # post-recreate write silently vanishes from the stream
+        self._hooked: Dict[Tuple[str, str], int] = {}
         self.checkpoint_ts: float = time.time()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -105,9 +118,15 @@ class LogBackupTask:
             for name in self.catalog.tables(db):
                 t = self.catalog.table(db, name)
                 key = (db.lower(), name.lower())
-                if key in self._hooked:
+                if self._hooked.get(key) == t.uid:
                     continue
-                self._hooked.add(key)
+                recreated = key in self._hooked
+                self._hooked[key] = t.uid
+                if recreated:
+                    # the stream restarts for this table: the next
+                    # segment must be a full image of the new object,
+                    # not a delta against the dropped one's blocks
+                    self._captured.pop(key, None)
 
                 def cb(table, version, _db=db, _name=name):
                     # runs under the table lock with a pin already taken
@@ -119,12 +138,13 @@ class LogBackupTask:
                 cb._logbackup_task = self  # stop() filters by this tag
                 t.on_commit.append(cb)
                 # initial scan: capture the current state as the stream
-                # start (pin so GC keeps it until advance())
-                t.pin(t.version)
+                # start. pin_current() pins and reports ONE version
+                # atomically — reading t.version again here could see a
+                # concurrent commit's newer version, leaking the pin
+                # (advance() would then unpin a version it never pinned)
+                v = t.pin_current()
                 with self._lock:
-                    self._queue.append(
-                        (time.time(), db, name, t, t.version)
-                    )
+                    self._queue.append((time.time(), db, name, t, v))
 
     def _unhook(self) -> None:
         for db in self.catalog.databases():
@@ -181,22 +201,23 @@ class LogBackupTask:
         write REQUEUES the remaining batch (pins intact) so the stream
         loses nothing and retries on the next tick — the advancer only
         moves the checkpoint past durably-written segments."""
-        self._hook_tables()
-        with self._lock:
-            batch = self._queue
-            self._queue = []
-        written = 0
-        for i, (ts, db, name, t, version) in enumerate(batch):
-            try:
-                self._write_segment(ts, db, name, t, version)
-            except BaseException:
-                with self._lock:
-                    self._queue = batch[i:] + self._queue
-                raise
-            t.unpin(version)
-            written += 1
-            self.checkpoint_ts = ts
-        return written
+        with self._advance_mu:
+            self._hook_tables()
+            with self._lock:
+                batch = self._queue
+                self._queue = []
+            written = 0
+            for i, (ts, db, name, t, version) in enumerate(batch):
+                try:
+                    self._write_segment(ts, db, name, t, version)
+                except BaseException:
+                    with self._lock:
+                        self._queue = batch[i:] + self._queue
+                    raise
+                t.unpin(version)
+                written += 1
+                self.checkpoint_ts = ts
+            return written
 
     def _write_segment(self, ts, db, name, t, version) -> None:
         key = (db.lower(), name.lower())
@@ -212,12 +233,7 @@ class LogBackupTask:
             "db": db,
             "table": name,
             "version": version,
-            "schema": {
-                "columns": [
-                    [n, _type_to_json(ty)] for n, ty in t.schema.columns
-                ],
-                "primary_key": t.schema.primary_key,
-            },
+            "schema": table_meta_to_json(t),
             "order": uids,
             "blocks": {},
         }
@@ -246,8 +262,6 @@ def restore_point_in_time(uri: str, catalog, until_ts: float) -> int:
     the last full segment at-or-before the ts, plus every later delta up
     to it. Returns tables restored. Reference: `br restore point`
     (br/pkg/task/stream.go RunStreamRestore)."""
-    from tidb_tpu.storage.table import TableSchema
-
     storage = open_storage(uri)
     segs = []
     for fn in storage.list("log/"):
@@ -282,14 +296,22 @@ def restore_point_in_time(uri: str, catalog, until_ts: float) -> int:
         st["db"], st["table"] = meta["db"], meta["table"]
     restored = 0
     for key, st in state.items():
-        schema = TableSchema(
-            [(n, _type_from_json(tj)) for n, tj in st["schema"]["columns"]],
-            primary_key=st["schema"].get("primary_key"),
-        )
+        schema = schema_from_meta(st["schema"])
         catalog.create_database(st["db"], if_not_exists=True)
+        if catalog.has_table(st["db"], st["table"]) and not (
+            schemas_equivalent(
+                catalog.table(st["db"], st["table"]).schema, schema
+            )
+        ):
+            # the live table's schema diverged from the stream (DDL
+            # after the backup): the restored state wins wholesale —
+            # keeping the live schema over stream-shaped blocks would
+            # corrupt every later read of the changed columns
+            catalog.drop_table(st["db"], st["table"])
         t = catalog.create_table(
             st["db"], st["table"], schema, if_not_exists=True
         )
+        apply_table_meta(t, st["schema"])
         missing = [u for u in st["order"] if u not in st["blocks"]]
         if missing:
             raise ValueError(

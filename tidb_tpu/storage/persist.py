@@ -68,6 +68,94 @@ def decode_dict_arrays(data, prefix: str):
     )
 
 
+def table_meta_to_json(t) -> Dict:
+    """Full table metadata as a JSON-safe dict: columns/PK plus the
+    state a restore must reconstruct (indexes, AUTO_INCREMENT, TTL,
+    partitioning, CHECKs, FKs, domains). Shared by BR snapshot
+    manifests and log-backup segment headers so neither format silently
+    drops constraint state."""
+    return {
+        "columns": [[n, _type_to_json(ty)] for n, ty in t.schema.columns],
+        "primary_key": t.schema.primary_key,
+        "indexes": t.indexes,
+        "unique_indexes": sorted(t.unique_indexes),
+        "autoinc": [t.autoinc_col, t.autoinc_next],
+        "ttl": list(t.ttl) if t.ttl else None,
+        "partition": (
+            [t.partition[0], t.partition[1],
+             t.partition[2] if t.partition[0] == "hash"
+             else [list(x) for x in t.partition[2]]]
+            if getattr(t, "partition", None) else None
+        ),
+        "checks": [list(c) for c in t.checks] or None,
+        "fks": [list(f) for f in t.fks] or None,
+        "fk_actions": dict(getattr(t, "fk_actions", {})) or None,
+        "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
+        "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
+        "json_cols": list(t.schema.json_cols),
+    }
+
+
+def schema_from_meta(meta: Dict) -> TableSchema:
+    return TableSchema(
+        [(n, _type_from_json(tj)) for n, tj in meta["columns"]],
+        primary_key=meta.get("primary_key"),
+        enums={
+            k: tuple(v) for k, v in (meta.get("enums") or {}).items()
+        } or None,
+        sets={
+            k: tuple(v) for k, v in (meta.get("sets") or {}).items()
+        } or None,
+        json_cols=tuple(meta.get("json_cols") or ()),
+    )
+
+
+def apply_table_meta(t, meta: Dict) -> None:
+    """Reapply the non-schema table state from table_meta_to_json. The
+    backup's state wins wholesale: state ABSENT from the meta is
+    cleared, not kept — a live TTL surviving a restore from a TTL-less
+    backup would silently delete restored rows."""
+    t.indexes = {
+        k: list(v) for k, v in (meta.get("indexes") or {}).items()
+    }
+    t.unique_indexes = set(meta.get("unique_indexes") or [])
+    ai = meta.get("autoinc")
+    if ai:
+        t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])
+    t.ttl = tuple(meta["ttl"]) if meta.get("ttl") else None
+    if meta.get("partition"):
+        pk_, pc_, spec_ = meta["partition"]
+        t.partition = (
+            pk_, pc_,
+            int(spec_) if pk_ == "hash" else [tuple(x) for x in spec_],
+        )
+    else:
+        t.partition = None
+    t.checks = [tuple(c) for c in (meta.get("checks") or [])]
+    t.fks = [tuple(f) for f in (meta.get("fks") or [])]
+    t.fk_actions = dict(meta.get("fk_actions") or {})
+
+
+def schemas_equivalent(a, b) -> bool:
+    """Whether two TableSchemas describe the same physical shape AND
+    constraint identity (columns, PK, domains) — the restore-in-place
+    guard: anything short of full equivalence drops + recreates, since
+    installing backup-shaped blocks under a diverged live schema
+    corrupts reads (and a diverged PK can make restored rows violate
+    constraints the backup's engine never enforced)."""
+
+    def norm(s):
+        return (
+            [(n, ty.kind, ty.scale) for n, ty in s.columns],
+            tuple(s.primary_key or ()),
+            {k: tuple(v) for k, v in (s.enums or {}).items()},
+            {k: tuple(v) for k, v in (s.sets or {}).items()},
+            tuple(s.json_cols or ()),
+        )
+
+    return norm(a) == norm(b)
+
+
 def save_catalog(
     catalog: Catalog, path: str, dbs=None, resume: bool = False
 ) -> int:
@@ -116,28 +204,7 @@ def save_catalog(
         manifest["dbs"][db] = {}
         for name in catalog.tables(db):
             t = catalog.table(db, name)
-            manifest["dbs"][db][name] = {
-                "columns": [
-                    [n, _type_to_json(ty)] for n, ty in t.schema.columns
-                ],
-                "primary_key": t.schema.primary_key,
-                "indexes": t.indexes,
-                "unique_indexes": sorted(t.unique_indexes),
-                "autoinc": [t.autoinc_col, t.autoinc_next],
-                "ttl": list(t.ttl) if t.ttl else None,
-                "partition": (
-                    [t.partition[0], t.partition[1],
-                     t.partition[2] if t.partition[0] == "hash"
-                     else [list(x) for x in t.partition[2]]]
-                    if getattr(t, "partition", None) else None
-                ),
-                "checks": [list(c) for c in t.checks] or None,
-                "fks": [list(f) for f in t.fks] or None,
-                "fk_actions": dict(getattr(t, "fk_actions", {})) or None,
-                "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
-                "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
-                "json_cols": list(t.schema.json_cols),
-            }
+            manifest["dbs"][db][name] = table_meta_to_json(t)
             cols = t.schema.names
             block = concat_blocks(t.blocks(), cols, t.schema)
             arrays = {}
@@ -187,37 +254,17 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
             continue
         catalog.create_database(db, if_not_exists=True)
         for name, meta in tables.items():
-            schema = TableSchema(
-                [(n, _type_from_json(tj)) for n, tj in meta["columns"]],
-                primary_key=meta.get("primary_key"),
-                enums={
-                    k: tuple(v) for k, v in (meta.get("enums") or {}).items()
-                } or None,
-                sets={
-                    k: tuple(v) for k, v in (meta.get("sets") or {}).items()
-                } or None,
-                json_cols=tuple(meta.get("json_cols") or ()),
-            )
+            schema = schema_from_meta(meta)
+            if catalog.has_table(db, name) and not schemas_equivalent(
+                catalog.table(db, name).schema, schema
+            ):
+                # restoring over a table whose schema has since diverged
+                # (e.g. ALTER after the backup): the snapshot's schema
+                # wins — keeping the live schema while installing
+                # snapshot-shaped blocks would corrupt the table
+                catalog.drop_table(db, name)
             t = catalog.create_table(db, name, schema, if_not_exists=True)
-            t.indexes = {
-                k: list(v) for k, v in (meta.get("indexes") or {}).items()
-            }
-            t.unique_indexes = set(meta.get("unique_indexes") or [])
-            ai = meta.get("autoinc")
-            if ai:
-                t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])
-            if meta.get("ttl"):
-                t.ttl = tuple(meta["ttl"])
-            if meta.get("partition"):
-                pk_, pc_, spec_ = meta["partition"]
-                t.partition = (
-                    pk_, pc_,
-                    int(spec_) if pk_ == "hash"
-                    else [tuple(x) for x in spec_],
-                )
-            t.checks = [tuple(c) for c in (meta.get("checks") or [])]
-            t.fks = [tuple(f) for f in (meta.get("fks") or [])]
-            t.fk_actions = dict(meta.get("fk_actions") or {})
+            apply_table_meta(t, meta)
             # allow_pickle stays OFF: a snapshot directory is data, and
             # must never be able to execute code on RESTORE
             data = store.read_npz(f"{db}.{name}.npz")

@@ -367,42 +367,154 @@ class Table:
                     )
 
     def _check_unique(self, block: HostBlock) -> None:
-        """Duplicate-key check for UNIQUE indexes and a single-column
-        PRIMARY KEY (NULLs permitted any number of times for UNIQUE,
-        MySQL semantics). Works in the encoded domain, so values that
-        encode equal (e.g. decimals rounding to the same scale) collide
-        correctly. Composite PKs are not enforced. Caller holds _lock.
+        """Duplicate-key check for UNIQUE indexes and the PRIMARY KEY,
+        single- or multi-column. A NULL in any UNIQUE-key component
+        exempts the row, any number of times; a NULL in any PRIMARY KEY
+        component is rejected outright (MySQL: PK implies NOT NULL).
+        Works in the encoded domain, so values that encode equal (e.g.
+        decimals rounding to the same scale) collide correctly. Caller
+        holds _lock.
         REPLACE / ON DUPLICATE KEY delete their conflicts before the
         append, so they pass untouched (reference: uniqueness on the
         mutation path, pkg/table/tables.go AddRecord)."""
         keys = [
-            (f"unique index {i!r}", self.indexes[i][0])
+            (f"unique index {i!r}", list(self.indexes[i]))
             for i in self.unique_indexes
             if self.indexes.get(i)
         ]
         pk = self.schema.primary_key
-        if pk and len(pk) == 1:
-            keys.append(("primary key", pk[0]))
-        for label, col in keys:
-            c = block.columns.get(col)
-            if c is None:
-                continue
-            vals = c.data[c.valid]
-            if len(vals) != len(np.unique(vals)):
-                raise ValueError(
-                    f"duplicate entry for {label} ({col})"
-                )
-            if len(vals):
-                svals, _perm, nvalid = self._sorted_index(col)
-                if nvalid:
-                    pos = np.searchsorted(svals[:nvalid], vals)
-                    hit = (pos < nvalid) & (
-                        svals[np.minimum(pos, nvalid - 1)] == vals
+        if pk:
+            keys.append(("primary key", list(pk)))
+            for c in pk:
+                hc = block.columns.get(c)
+                if hc is not None and not hc.valid.all():
+                    raise ValueError(
+                        f"column {c!r} cannot be null (primary key)"
                     )
-                    if hit.any():
-                        raise ValueError(
-                            f"duplicate entry for {label} ({col})"
-                        )
+        for label, cols in keys:
+            if any(c not in block.columns for c in cols):
+                continue
+            if len(cols) == 1:
+                self._check_unique_single(label, cols[0], block)
+            else:
+                self._check_unique_composite(label, cols, block)
+
+    def _check_unique_single(self, label: str, col: str, block) -> None:
+        c = block.columns[col]
+        vals = c.data[c.valid]
+        if len(vals) != len(np.unique(vals)):
+            raise ValueError(f"duplicate entry for {label} ({col})")
+        if len(vals):
+            svals, _perm, nvalid = self._sorted_index(col)
+            if nvalid:
+                pos = np.searchsorted(svals[:nvalid], vals)
+                hit = (pos < nvalid) & (
+                    svals[np.minimum(pos, nvalid - 1)] == vals
+                )
+                if hit.any():
+                    raise ValueError(
+                        f"duplicate entry for {label} ({col})"
+                    )
+
+    @staticmethod
+    def _key_matrix(columns: dict, cols) -> np.ndarray:
+        """[n, k] canonical int64 key matrix over fully-valid rows only
+        (any NULL component exempts the row from uniqueness). Encoded
+        values are per-table comparable here: dictionary codes are
+        aligned before the check, decimals/dates are already ints, and
+        floats go through their (sign-folded) bit pattern so equal
+        values land on equal rows."""
+        n = len(next(iter(columns.values())).data)
+        allv = np.ones(n, dtype=bool)
+        parts = []
+        for c in cols:
+            hc = columns[c]
+            allv &= hc.valid
+            d = hc.data
+            if np.issubdtype(d.dtype, np.floating):
+                d64 = d.astype(np.float64, copy=True)
+                d64[d64 == 0.0] = 0.0  # -0.0 folds to +0.0
+                part = d64.view(np.int64)
+            elif d.dtype == np.bool_:
+                part = d.astype(np.int64)
+            else:
+                part = d.astype(np.int64, copy=False)
+            parts.append(part)
+        mat = np.stack(parts, axis=1)
+        return mat[allv]
+
+    @staticmethod
+    def _rows_view(m: np.ndarray) -> np.ndarray:
+        """Structured (void) row view of a [n, k] key matrix: one
+        comparable/sortable scalar per row. The single place this idiom
+        lives — block-side and stored-side views must stay identical or
+        the searchsorted membership check silently breaks."""
+        return np.ascontiguousarray(m).view(
+            [("", m.dtype)] * m.shape[1]
+        ).ravel()
+
+    def _check_unique_composite(self, label: str, cols, block) -> None:
+        new = self._key_matrix(block.columns, cols)
+        if not len(new):
+            return
+        new_v = self._rows_view(new)
+        if len(np.unique(new_v)) != len(new_v):
+            raise ValueError(
+                f"duplicate entry for {label} ({', '.join(cols)})"
+            )
+        old_v = self._sorted_composite(tuple(cols))
+        if old_v is not None and len(old_v):
+            # new-vs-existing membership only: a duplicate already
+            # inside the stored data (e.g. an index added over loose
+            # data) must not start rejecting unrelated appends
+            pos = np.searchsorted(old_v, new_v)
+            hit = (pos < len(old_v)) & (old_v[np.minimum(pos, len(old_v) - 1)] == new_v)
+            if hit.any():
+                raise ValueError(
+                    f"duplicate entry for {label} ({', '.join(cols)})"
+                )
+
+    def _sorted_composite(self, cols: tuple):
+        """Sorted structured row-view of a composite key over the current
+        version's blocks, cached per cols with the covered block-uid
+        prefix — the composite analog of _sorted_index. Row-at-a-time
+        appends extend the stored prefix (appends add blocks, never
+        reorder them), so each check keys only the NEW blocks and does
+        one two-run merge sort instead of rebuilding and re-sorting the
+        whole table's key matrix."""
+        cache = getattr(self, "_comp_cache", None)
+        if cache is None:
+            cache = self._comp_cache = {}
+        blocks = [
+            b for b in self._versions[self.version]
+            if all(c in b.columns for c in cols)
+        ]
+        uids = tuple(b.uid for b in blocks)
+        hit = cache.get(cols)
+        if hit is not None and hit[0] == uids:
+            return hit[1]
+        if hit is not None and hit[0] == uids[: len(hit[0])]:
+            base = hit[1]
+            fresh = blocks[len(hit[0]):]
+        else:
+            base = None
+            fresh = blocks
+        mats = [m for b in fresh if len(m := self._key_matrix(b.columns, cols))]
+        if mats:
+            add = np.sort(self._rows_view(np.concatenate(mats)))
+            if base is not None and len(base):
+                # two sorted runs: stable mergesort is O(n) here
+                out = np.sort(
+                    np.concatenate([base, add]), kind="stable"
+                )
+            else:
+                out = add
+        else:
+            out = base
+        if len(cache) > 8:
+            cache.clear()
+        cache[cols] = (uids, out)
+        return out
 
     def next_autoid(self, n: int = 1) -> int:
         """Allocate n consecutive AUTO_INCREMENT ids; returns the first."""
