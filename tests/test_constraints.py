@@ -450,3 +450,52 @@ class TestCompositeKeys:
         sess.execute("insert into t values (1, 2)")
         with pytest.raises(ValueError, match="duplicate"):
             sess.execute("insert into t values (1, 2)")
+
+    def test_composite_key_date_component(self, sess):
+        # raw-vs-encoded regression: DATE/DECIMAL key components must
+        # conflict through REPLACE / IGNORE / ON DUP (the raw string
+        # '1994-01-01' and the stored day int are the same key)
+        sess.execute(
+            "create table t (dt date, n int, v int, primary key (dt, n))"
+        )
+        sess.execute("insert into t values ('1994-01-01', 1, 10)")
+        sess.execute("replace into t values ('1994-01-01', 1, 99)")
+        assert sess.execute("select v from t").rows == [(99,)]
+        sess.execute("insert ignore into t values ('1994-01-01', 1, 50)")
+        assert sess.execute("select v from t").rows == [(99,)]
+        sess.execute(
+            "insert into t values ('1994-01-01', 1, 77) "
+            "on duplicate key update v = values(v)"
+        )
+        assert sess.execute("select v from t").rows == [(77,)]
+
+    def test_composite_key_decimal_component(self, sess):
+        sess.execute(
+            "create table t (d decimal(6,2), n int, v int, "
+            "primary key (d, n))"
+        )
+        sess.execute("insert into t values (1.25, 1, 10)")
+        with pytest.raises(ValueError, match="duplicate"):
+            sess.execute("insert into t values (1.25, 1, 20)")
+        sess.execute("replace into t values (1.25, 1, 30)")
+        assert sess.execute("select v from t").rows == [(30,)]
+
+    def test_composite_key_string_unseen_values(self, sess):
+        # two DIFFERENT strings the dictionary has never seen must not
+        # collide with each other; the SAME unseen string must dedupe
+        sess.execute(
+            "create table t (k varchar(8), n int, v int, primary key (k, n))"
+        )
+        sess.execute("replace into t values ('aa', 1, 1), ('bb', 1, 2)")
+        assert sess.execute("select count(*) from t").rows == [(2,)]
+        sess.execute("replace into t values ('cc', 1, 3), ('cc', 1, 4)")
+        assert sess.execute(
+            "select v from t where k = 'cc'"
+        ).rows == [(4,)]
+
+    def test_insert_ignore_null_pk_component_dropped(self, sess):
+        # IGNORE demotes the NULL-PK error to a dropped row; the valid
+        # row in the same statement still lands
+        sess.execute("create table t (a int, b int, v int, primary key (a, b))")
+        sess.execute("insert ignore into t values (1, null, 9), (2, 2, 8)")
+        assert sess.execute("select a, b, v from t").rows == [(2, 2, 8)]

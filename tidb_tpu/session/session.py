@@ -2043,20 +2043,6 @@ class Session:
                     out.add(v)
         return out
 
-    def _key_tuple_values(self, db: str, name: str, cols) -> set:
-        """All fully-non-NULL key tuples of the given column set at this
-        session's read snapshot (host decode — conflict batches are
-        small)."""
-        t, version = self._resolve_table_for_read(db, name)
-        out = set()
-        for b in t.blocks(version):
-            decs = [b.columns[c].decode() for c in cols]
-            oks = [b.columns[c].valid for c in cols]
-            for i in range(b.nrows):
-                if all(ok[i] for ok in oks):
-                    out.add(tuple(d[i] for d in decs))
-        return out
-
     def _enforce_write_constraints(self, t, db: str, rows) -> None:
         """CHECK + child-side FOREIGN KEY validation over fully-formed
         Python rows, BEFORE they are encoded/appended (reference:
@@ -2256,13 +2242,95 @@ class Session:
                     out.append(c)
         return out
 
+    def _incoming_key_matrix(self, t, cols, names, rows, ext_state=None):
+        """Encode incoming raw rows' key components into the TABLE's
+        encoded domain and return (key matrix, all-valid mask) aligned
+        to the rows. This is the one place raw SQL values ('1994-01-01',
+        Decimal strings, dictionary strings) meet stored encodings —
+        comparing raw against decoded was the classic conflict-key bug
+        (dates/decimals never matched). Strings map through the table
+        dictionary; strings the table has never seen get per-statement
+        provisional codes (distinct per distinct string, stable across
+        calls via ext_state) so they conflict among themselves but never
+        with stored rows."""
+        from tidb_tpu.chunk import HostColumn, column_from_values
+        from tidb_tpu.dtypes import Kind as _K
+        from tidb_tpu.storage.table import Table
+
+        columns = {}
+        for c in cols:
+            i = names.index(c)
+            vals = [r[i] for r in rows]
+            typ = t.schema.types[c]
+            if typ.kind == _K.STRING:
+                lut = None
+                if ext_state is not None:
+                    # the dictionary lut is per statement, not per call:
+                    # row_keys() re-encodes single rows repeatedly and
+                    # must not rebuild a large dictionary index each time
+                    lut = ext_state.get(("lut", c))
+                if lut is None:
+                    d = t.dictionaries.get(c)
+                    lut = (
+                        {str(x): j for j, x in enumerate(d)}
+                        if d is not None else {}
+                    )
+                    if ext_state is not None:
+                        ext_state[("lut", c)] = lut
+                ext = (
+                    ext_state.setdefault(c, {})
+                    if ext_state is not None else {}
+                )
+                codes = np.zeros(len(vals), dtype=np.int64)
+                valid = np.zeros(len(vals), dtype=bool)
+                for j, v in enumerate(vals):
+                    if v is None:
+                        continue
+                    sv = str(v)
+                    valid[j] = True
+                    code = lut.get(sv)
+                    if code is None:
+                        # provisional: above any real int32 code
+                        code = ext.setdefault(sv, (1 << 40) + len(ext))
+                    codes[j] = code
+                columns[c] = HostColumn(typ, codes, valid)
+            else:
+                columns[c] = column_from_values(vals, typ)
+        return Table._key_matrix_full(columns, cols)
+
+    def _incoming_key_views(self, t, key_sets, names, rows, ext_state):
+        """Per key set: (per-row structured key view, all-valid mask,
+        sorted valid-key array for vectorized membership)."""
+        from tidb_tpu.storage.table import Table
+
+        out = {}
+        for ks in key_sets:
+            mat, allv = self._incoming_key_matrix(
+                t, ks, names, rows, ext_state
+            )
+            view = Table._rows_view(mat)
+            out[ks] = (view, allv, np.sort(view[allv]))
+        return out
+
     @staticmethod
-    def _row_key(row, idxs):
-        """A row's value under one key set: a tuple of component values,
-        or None when any component is NULL (MySQL: NULL never
-        conflicts)."""
-        vals = tuple(row[i] for i in idxs)
-        return None if any(v is None for v in vals) else vals
+    def _block_key_hits(b, ks, sorted_keys):
+        """(per-row hit mask, per-row key view, all-valid mask) of one
+        stored block against a sorted incoming key array — vectorized
+        searchsorted membership in the encoded domain."""
+        from tidb_tpu.storage.table import Table
+
+        if any(c not in b.columns for c in ks):
+            z = np.zeros(b.nrows, dtype=bool)
+            return z, None, z
+        bmat, ballv = Table._key_matrix_full(b.columns, ks)
+        bview = Table._rows_view(bmat)
+        if not len(sorted_keys):
+            return np.zeros(b.nrows, dtype=bool), bview, ballv
+        pos = np.clip(
+            np.searchsorted(sorted_keys, bview), 0, len(sorted_keys) - 1
+        )
+        hit = ballv & (sorted_keys[pos] == bview)
+        return hit, bview, ballv
 
     def _filter_ignore(self, t, db: str, names, rows, skip_unique=False):
         """INSERT IGNORE: drop (instead of fail) rows that violate a
@@ -2282,20 +2350,36 @@ class Session:
                 (names.index(col), parent,
                  names.index(rcol) if self_fk else None)
             )
-        key_state = (
-            []
-            if skip_unique
-            else [
-                (
-                    tuple(names.index(c) for c in ks),
-                    self._key_tuple_values(db, t.name, ks),
-                    set(),
-                )
-                for ks in self._unique_key_sets(t)
+        # IGNORE demotes errors to dropped rows: a NULL in any PK
+        # component would be rejected by the append-time NOT NULL check
+        # and fail the whole statement — drop such rows here instead
+        # (MySQL: IGNORE turns the error into a warning)
+        pk = t.schema.primary_key
+        if pk and rows:
+            pk_idx = [names.index(c) for c in pk if c in names]
+            rows = [
+                r for r in rows if all(r[i] is not None for i in pk_idx)
             ]
-        )
+        key_state = []
+        if not skip_unique and rows:
+            key_sets = self._unique_key_sets(t)
+            inc = self._incoming_key_views(t, key_sets, names, rows, {})
+            for ks in key_sets:
+                view, allv, _sorted = inc[ks]
+                # vectorized membership against the write target's cached
+                # sorted composite view (encoded domain on both sides —
+                # same data the append-time unique check will consult)
+                stored = t._sorted_composite(tuple(ks))
+                if stored is not None and len(stored):
+                    pos = np.clip(
+                        np.searchsorted(stored, view), 0, len(stored) - 1
+                    )
+                    in_table = allv & (stored[pos] == view)
+                else:
+                    in_table = np.zeros(len(rows), dtype=bool)
+                key_state.append((view, allv, in_table, set()))
         kept = []
-        for r in rows:
+        for j, r in enumerate(rows):
             rowd = dict(zip(names, r))
             if any(
                 _truth(eval_check(ex, rowd)) is False for _nm, ex in checks
@@ -2307,17 +2391,17 @@ class Session:
             ):
                 continue
             dup = False
-            for idxs, existing, seen in key_state:
-                v = self._row_key(r, idxs)
-                if v is not None and (v in existing or v in seen):
+            for view, allv, in_table, seen in key_state:
+                if allv[j] and (
+                    in_table[j] or view[j].tobytes() in seen
+                ):
                     dup = True
                     break
             if dup:
                 continue
-            for idxs, _existing, seen in key_state:
-                v = self._row_key(r, idxs)
-                if v is not None:
-                    seen.add(v)
+            for view, allv, _in_table, seen in key_state:
+                if allv[j]:
+                    seen.add(view[j].tobytes())
             for _i, parent, ri in fk_parents:
                 # self-FK: a KEPT row's key becomes a valid parent for
                 # later rows of this same statement (mirrors the strict
@@ -2380,36 +2464,53 @@ class Session:
                 raise ValueError(f"unknown column {c!r} in ON DUPLICATE KEY")
         if not key_sets:
             return list(rows), {}, 0
-        ki = {ks: tuple(names.index(c) for c in ks) for ks in key_sets}
-        incoming_keys = {
-            ks: {
-                v
-                for r in rows
-                if (v := self._row_key(r, ki[ks])) is not None
-            }
-            for ks in key_sets
-        }
+        # encoded-domain keys on BOTH sides: incoming raw values are
+        # encoded into the table's domain (dates to day ints, decimals
+        # to scaled ints, strings to dictionary codes), stored rows are
+        # keyed directly from their encoded blocks — raw-vs-decoded
+        # comparison is exactly the mismatch that made typed key
+        # components never conflict. ext_state keeps provisional codes
+        # for unseen strings stable across the per-row re-encodings of
+        # updated rows below.
+        ext_state: dict = {}
+        inc = self._incoming_key_views(t, key_sets, names, rows, ext_state)
+
+        def inc_key(j, ks):
+            view, allv, _s = inc[ks]
+            return view[j].tobytes() if allv[j] else None
+
+        def row_keys(row):
+            """Encoded keys of one (possibly updated) row, per key set:
+            {ks: (bytes key or None, structured scalar or None)}."""
+            out = {}
+            for ks in key_sets:
+                mat, allv = self._incoming_key_matrix(
+                    t, ks, names, [row], ext_state
+                )
+                if allv[0]:
+                    from tidb_tpu.storage.table import Table
+
+                    v = Table._rows_view(mat)[0]
+                    out[ks] = (v.tobytes(), v)
+                else:
+                    out[ks] = (None, None)
+            return out
+
         # fetch existing rows that conflict with any incoming key —
-        # key columns are scanned first so non-matching blocks skip the
-        # full-row decode entirely
+        # vectorized encoded-key membership per block; only hit rows get
+        # the full decode
         fetched = []
         existing = {ks: {} for ks in key_sets}
-        kcols = sorted({c for ks in key_sets for c in ks})
         for b in t.blocks():
-            kdec = {c: b.columns[c].decode() for c in kcols}
-            kok = {c: b.columns[c].valid for c in kcols}
-
-            def bkey(i, ks):
-                if not all(kok[c][i] for c in ks):
-                    return None
-                return tuple(kdec[c][i] for c in ks)
-
-            hits = [
-                i
-                for i in range(b.nrows)
-                if any(bkey(i, ks) in incoming_keys[ks] for ks in key_sets)
-            ]
-            if not hits:
+            hit_any = np.zeros(b.nrows, dtype=bool)
+            per_ks = {}
+            for ks in key_sets:
+                hit, bview, ballv = self._block_key_hits(b, ks, inc[ks][2])
+                if bview is not None:
+                    per_ks[ks] = (bview, ballv)
+                hit_any |= hit
+            hits = np.nonzero(hit_any)[0]
+            if not len(hits):
                 continue
             dec = {c: b.columns[c].decode() for c in names}
             ok = {c: b.columns[c].valid for c in names}
@@ -2417,10 +2518,9 @@ class Session:
                 rowv = [dec[c][i] if ok[c][i] else None for c in names]
                 idx = len(fetched)
                 fetched.append(rowv)
-                for ks in key_sets:
-                    v = self._row_key(rowv, ki[ks])
-                    if v is not None:
-                        existing[ks][v] = idx
+                for ks, (bview, ballv) in per_ks.items():
+                    if ballv[i]:
+                        existing[ks][bview[i].tobytes()] = idx
         pending, pkey = [], {ks: {} for ks in key_sets}
         # origin: id(pending row) -> [(key col, old value)] of the
         # existing row it replaces — the caller deletes old rows only
@@ -2429,10 +2529,10 @@ class Session:
         origin: dict = {}
         n_upd = 0
         consumed = set()
-        for row in rows:
+        for j, row in enumerate(rows):
             target = None
             for ks in key_sets:
-                v = self._row_key(row, ki[ks])
+                v = inc_key(j, ks)
                 if v is None:
                     continue
                 if v in pkey[ks]:
@@ -2446,7 +2546,7 @@ class Session:
                 idx = len(pending)
                 pending.append(row)
                 for ks in key_sets:
-                    v = self._row_key(row, ki[ks])
+                    v = inc_key(j, ks)
                     if v is not None:
                         pkey[ks][v] = idx
                 continue
@@ -2456,57 +2556,44 @@ class Session:
                 consumed.add(fi)
                 old = fetched[fi]
                 new = self._eval_on_dup(assigns, names, old, row)
+                old_keys = row_keys(old)
                 origin[id(new)] = [
-                    (ks, v)
-                    for ks in key_sets
-                    if (v := self._row_key(old, ki[ks])) is not None
+                    (ks, scalar)
+                    for ks, (kb, scalar) in old_keys.items()
+                    if kb is not None
                 ]
                 idx = len(pending)
                 pending.append(new)
-                for ks in key_sets:
-                    v = self._row_key(new, ki[ks])
-                    if v is not None:
-                        pkey[ks][v] = idx
+                for ks, (kb, _scalar) in row_keys(new).items():
+                    if kb is not None:
+                        pkey[ks][kb] = idx
             else:
                 pi = target[1]
                 old = pending[pi]
                 new = self._eval_on_dup(assigns, names, old, row)
                 if id(old) in origin:
                     origin[id(new)] = origin.pop(id(old))
-                for ks in key_sets:
-                    ov = self._row_key(old, ki[ks])
-                    if ov is not None and pkey[ks].get(ov) == pi:
-                        del pkey[ks][ov]
+                for ks, (kb, _scalar) in row_keys(old).items():
+                    if kb is not None and pkey[ks].get(kb) == pi:
+                        del pkey[ks][kb]
                 pending[pi] = new
-                for ks in key_sets:
-                    v = self._row_key(new, ki[ks])
-                    if v is not None:
-                        pkey[ks][v] = pi
+                for ks, (kb, _scalar) in row_keys(new).items():
+                    if kb is not None:
+                        pkey[ks][kb] = pi
         return pending, origin, n_upd
 
     def _delete_rows_by_keys(self, t, del_keys: dict) -> None:
-        """Delete rows whose key set (column tuple) holds one of the
-        given value tuples (host decode — ON DUPLICATE KEY batches are
-        small)."""
+        """Delete rows matching the given encoded key scalars per key
+        set (column tuple) — vectorized searchsorted over each block's
+        encoded key view."""
         for cols, values in del_keys.items():
             if not values:
                 continue
+            tgt = np.sort(np.array(list(values)))
             keep = []
             for b in t.blocks():
-                decs = [b.columns[c].decode() for c in cols]
-                oks = [b.columns[c].valid for c in cols]
-                keep.append(
-                    np.array(
-                        [
-                            not (
-                                all(ok[i] for ok in oks)
-                                and tuple(d[i] for d in decs) in values
-                            )
-                            for i in range(b.nrows)
-                        ],
-                        dtype=bool,
-                    )
-                )
+                hit, _bview, _ballv = self._block_key_hits(b, cols, tgt)
+                keep.append(~hit)
             if any((~m).any() for m in keep):
                 t.delete_where(keep)
 
@@ -2575,10 +2662,13 @@ class Session:
         else:
             self._enforce_write_constraints(t, db, rows)
         # delete old rows only for updated rows that survived filtering
+        # (encoded key scalars, deduped via their byte image — numpy
+        # void scalars are not reliably hashable)
         del_keys: dict = {}
         for r in rows:
             for kc, v in origin.get(id(r), ()):
-                del_keys.setdefault(kc, set()).add(v)
+                del_keys.setdefault(kc, {})[v.tobytes()] = v
+        del_keys = {kc: list(d.values()) for kc, d in del_keys.items()}
         replace = getattr(s, "replace", False)
         mutates_existing = replace or any(del_keys.values())
         children = (
@@ -2636,86 +2726,47 @@ class Session:
         — single- or multi-column — collides with an incoming row, then
         the normal append inserts the replacements (reference:
         pkg/executor/replace.go — delete then insert under one
-        statement)."""
-        import numpy as np
-
+        statement). All matching happens in the encoded domain (dates as
+        day ints, decimals as scaled ints, strings as dictionary codes),
+        vectorized per block."""
         key_sets = self._unique_key_sets(t)
         if not key_sets or not rows:
             return
+        ext_state: dict = {}
         # MySQL REPLACE keeps the LAST row when one statement carries
         # duplicate keys — dedupe incoming rows before the append
         for ks in key_sets:
-            idxs = tuple(names.index(c) for c in ks)
+            mat, allv = self._incoming_key_matrix(
+                t, ks, names, rows, ext_state
+            )
+            from tidb_tpu.storage.table import Table
+
+            view = Table._rows_view(mat)
             seen = set()
             kept = []
-            for r in reversed(rows):
-                k = self._row_key(r, idxs)
+            for j in range(len(rows) - 1, -1, -1):
+                k = view[j].tobytes() if allv[j] else None
                 if k is not None and k in seen:
                     continue
                 if k is not None:
                     seen.add(k)
-                kept.append(r)
+                kept.append(rows[j])
             rows[:] = list(reversed(kept))
         for ks in key_sets:
-            idxs = tuple(names.index(c) for c in ks)
-            incoming = {
-                v for r in rows if (v := self._row_key(r, idxs)) is not None
-            }
-            if not incoming:
+            _mat, allv = self._incoming_key_matrix(
+                t, ks, names, rows, ext_state
+            )
+            from tidb_tpu.storage.table import Table
+
+            srt = np.sort(Table._rows_view(_mat)[allv])
+            if not len(srt):
                 continue
-            if len(ks) == 1:
-                keep_masks = self._replace_masks_single(t, ks[0], {
-                    v[0] for v in incoming
-                })
-            else:
-                keep_masks = []
-                for b in t.blocks():
-                    decs = [b.columns[c].decode() for c in ks]
-                    oks = [b.columns[c].valid for c in ks]
-                    hit = np.array(
-                        [
-                            all(ok[i] for ok in oks)
-                            and tuple(d[i] for d in decs) in incoming
-                            for i in range(b.nrows)
-                        ],
-                        dtype=bool,
-                    )
-                    keep_masks.append(~hit)
-            if any((~m).any() for m in keep_masks):
-                t.delete_where(keep_masks)
-
-    def _replace_masks_single(self, t, col: str, incoming: set):
-        """Vectorized keep-masks for a single-column conflict key."""
-        import numpy as np
-
-        typ = t.schema.types[col]
-        from tidb_tpu.dtypes import Kind as _K
-
-        if typ.kind == _K.STRING:
             keep_masks = []
             for b in t.blocks():
-                c = b.columns[col]
-                if c.dictionary is None or not len(c.dictionary):
-                    keep_masks.append(np.ones(b.nrows, dtype=bool))
-                    continue
-                vals = c.dictionary[np.clip(c.data, 0, len(c.dictionary) - 1)]
-                hit = np.array(
-                    [bool(v) and str(x) in incoming for v, x in zip(c.valid, vals)]
-                )
+                hit, _bv, _bav = self._block_key_hits(b, ks, srt)
                 keep_masks.append(~hit)
-            return keep_masks
-        from tidb_tpu.chunk import column_from_values
-
-        enc = column_from_values(sorted(incoming), typ)
-        targets = np.sort(enc.data)
-        keep_masks = []
-        for b in t.blocks():
-            c = b.columns[col]
-            pos = np.searchsorted(targets, c.data)
-            pos = np.clip(pos, 0, len(targets) - 1)
-            hit = c.valid & (targets[pos] == c.data)
-            keep_masks.append(~hit)
-        return keep_masks
+            if any((~m).any() for m in keep_masks):
+                t.delete_where(keep_masks)
 
     @staticmethod
     def _const_value(e):

@@ -13,6 +13,7 @@ variants are the planned path for >HBM tables.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -24,6 +25,13 @@ from tidb_tpu.storage.scan import scan_table
 
 N_BUCKETS = 64
 N_TOPN = 16
+
+#: above this many rows ANALYZE samples instead of sorting the full
+#: column — the reference's row_sampler.go sampling regime; exact stats
+#: below it. One device sort of the full column per column is fine at
+#: millions of rows but superlinear pain at SF10+ (23 columns x 64M
+#: sorts measured ~19min on the CPU fallback).
+SAMPLE_CAP = int(os.environ.get("TIDB_TPU_ANALYZE_SAMPLE", str(2 << 20)))
 
 
 @dataclasses.dataclass
@@ -68,6 +76,9 @@ def _column_stats_kernel(data, valid, row_valid):
     seg = jnp.cumsum(changed.astype(jnp.int64)) - 1
     seg = jnp.where(is_valid_pos, seg, cap)
     freq = jax.ops.segment_sum(is_valid_pos.astype(jnp.int64), seg.astype(jnp.int32), num_segments=cap + 1)[:cap]
+    # singleton count: values seen exactly once — feeds the Haas-Stokes
+    # NDV scale-up when these stats come from a sample
+    f1 = jnp.sum((freq == 1).astype(jnp.int64))
     first_idx = (
         jnp.full(cap + 1, cap - 1, dtype=jnp.int32)
         .at[seg.astype(jnp.int32)]
@@ -77,7 +88,7 @@ def _column_stats_kernel(data, valid, row_valid):
     top_vals = s[first_idx[topi]]
     mn = s[0]
     mx = s[jnp.clip(count - 1, 0, cap - 1)]
-    return nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx
+    return nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx, f1
 
 
 def analyze_table(table, columns=None) -> Dict[str, ColumnStats]:
@@ -91,14 +102,73 @@ def analyze_table(table, columns=None) -> Dict[str, ColumnStats]:
     if columns is not None and not columns:
         return dict(getattr(table, "stats", None) or {})  # nothing to do
     stats: Dict[str, ColumnStats] = {}
+    # pin ONE version for the whole pass: a concurrent DELETE between
+    # the nrows computation and a later column's concat would otherwise
+    # shrink the arrays under sample_idx (IndexError), and a concurrent
+    # INSERT would silently sample different physical rows per column
+    version = table.pin_current()
+    try:
+        return _analyze_at_version(table, version, columns, stats)
+    finally:
+        table.unpin(version)
+
+
+def _analyze_at_version(table, version, columns, stats):
+    blocks = table.blocks(version)
+    nrows = sum(b.nrows for b in blocks)
+    sampled = nrows > SAMPLE_CAP
+    if sampled:
+        # one shared uniform sample of row positions across all columns
+        # (deterministic per table version, so repeat ANALYZE agrees)
+        rng = np.random.default_rng(
+            (getattr(table, "uid", 0) * 1_000_003 + version) & 0x7FFFFFFF
+        )
+        sample_idx = np.sort(rng.choice(nrows, SAMPLE_CAP, replace=False))
+        ratio = nrows / SAMPLE_CAP
     for name, typ in table.schema.columns:
         if columns is not None and name not in columns:
             continue
-        batch, dicts = scan_table(table, [name])
-        col = batch.cols[name]
-        nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx = (
-            _column_stats_kernel(col.data, col.valid, batch.row_valid)
-        )
+        if sampled:
+            data_parts, valid_parts = [], []
+            for b in blocks:
+                hc = b.columns.get(name)
+                if hc is None:
+                    # block predates ALTER ADD COLUMN: reads see NULL
+                    data_parts.append(
+                        np.zeros(b.nrows, dtype=np.int64)
+                    )
+                    valid_parts.append(np.zeros(b.nrows, dtype=bool))
+                else:
+                    data_parts.append(hc.data)
+                    valid_parts.append(hc.valid)
+            data_h = np.concatenate(data_parts)[sample_idx]
+            valid_h = np.concatenate(valid_parts)[sample_idx]
+            # decode through the PINNED blocks' dictionary, not the live
+            # table dict: a concurrent append can grow-and-remap the
+            # sorted dictionary, shifting the codes these blocks hold
+            pinned_dict = next(
+                (
+                    b.columns[name].dictionary
+                    for b in blocks
+                    if name in b.columns
+                    and b.columns[name].dictionary is not None
+                ),
+                None,
+            )
+            dicts = {name: pinned_dict} if pinned_dict is not None else {}
+            nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx, f1 = (
+                _column_stats_kernel(
+                    jnp.asarray(data_h),
+                    jnp.asarray(valid_h),
+                    jnp.ones(len(data_h), dtype=bool),
+                )
+            )
+        else:
+            batch, dicts = scan_table(table, [name], version=version)
+            col = batch.cols[name]
+            nulls, count, ndv, bounds, bcounts, topf, top_vals, mn, mx, f1 = (
+                _column_stats_kernel(col.data, col.valid, batch.row_valid)
+            )
         count_i = int(count)
         dictionary = dicts.get(name)
 
@@ -116,21 +186,48 @@ def analyze_table(table, columns=None) -> Dict[str, ColumnStats]:
                 return float(v)
             return int(v)
 
-        topn = [
-            (decode(v), int(f))
-            for v, f in zip(np.asarray(top_vals), np.asarray(topf))
-            if int(f) > 0
-        ]
-        stats[name] = ColumnStats(
-            row_count=count_i + int(nulls),
-            null_count=int(nulls),
-            ndv=int(ndv),
-            bounds=np.asarray(bounds),
-            bucket_counts=np.asarray(bcounts),
-            topn=topn,
-            min_val=decode(mn),
-            max_val=decode(mx),
-        )
+        if sampled:
+            # scale sample counts to the table; NDV via first-order
+            # Haas-Stokes: D = d + (N/n - 1) * f1, clamped to [d, N]
+            # (reference estimator role: FMSketch/row sampling,
+            # pkg/statistics/fmsketch.go + row_sampler.go)
+            d = int(ndv)
+            est_ndv = min(
+                max(d, int(d + (ratio - 1.0) * int(f1))), nrows
+            )
+            topn = [
+                (decode(v), int(round(int(f) * ratio)))
+                for v, f in zip(np.asarray(top_vals), np.asarray(topf))
+                if int(f) > 0
+            ]
+            stats[name] = ColumnStats(
+                row_count=nrows,
+                null_count=int(round(int(nulls) * ratio)),
+                ndv=est_ndv,
+                bounds=np.asarray(bounds),
+                bucket_counts=(
+                    np.asarray(bcounts).astype(np.float64) * ratio
+                ).astype(np.int64),
+                topn=topn,
+                min_val=decode(mn),
+                max_val=decode(mx),
+            )
+        else:
+            topn = [
+                (decode(v), int(f))
+                for v, f in zip(np.asarray(top_vals), np.asarray(topf))
+                if int(f) > 0
+            ]
+            stats[name] = ColumnStats(
+                row_count=count_i + int(nulls),
+                null_count=int(nulls),
+                ndv=int(ndv),
+                bounds=np.asarray(bounds),
+                bucket_counts=np.asarray(bcounts),
+                topn=topn,
+                min_val=decode(mn),
+                max_val=decode(mx),
+            )
     # merge + publish under the table lock: concurrent per-column
     # analyze subtasks (DXF distributed analyze) must not lose each
     # other's columns in a read-modify-write race
@@ -141,7 +238,7 @@ def analyze_table(table, columns=None) -> Dict[str, ColumnStats]:
             table.stats = merged
         else:
             table.stats = stats
-        table.stats_version = table.version
+        table.stats_version = version  # the version these stats reflect
         # reset the auto-analyze counter (manual ANALYZE counts too)
         table.analyzed_modify = getattr(table, "modify_count", 0)
     return table.stats

@@ -417,13 +417,13 @@ class Table:
                     )
 
     @staticmethod
-    def _key_matrix(columns: dict, cols) -> np.ndarray:
-        """[n, k] canonical int64 key matrix over fully-valid rows only
-        (any NULL component exempts the row from uniqueness). Encoded
-        values are per-table comparable here: dictionary codes are
-        aligned before the check, decimals/dates are already ints, and
-        floats go through their (sign-folded) bit pattern so equal
-        values land on equal rows."""
+    def _key_matrix_full(columns: dict, cols):
+        """([n, k] canonical int64 key matrix, [n] all-components-valid
+        mask) over EVERY row, aligned to the input. Encoded values are
+        per-table comparable here: dictionary codes are aligned before
+        the check, decimals/dates are already ints, and floats go
+        through their (sign-folded) bit pattern so equal values land on
+        equal rows."""
         n = len(next(iter(columns.values())).data)
         allv = np.ones(n, dtype=bool)
         parts = []
@@ -441,6 +441,16 @@ class Table:
                 part = d.astype(np.int64, copy=False)
             parts.append(part)
         mat = np.stack(parts, axis=1)
+        # NULL components zero out so equal SQL rows give equal matrix
+        # rows regardless of the garbage under an invalid value
+        mat = np.where(allv[:, None], mat, 0)
+        return mat, allv
+
+    @staticmethod
+    def _key_matrix(columns: dict, cols) -> np.ndarray:
+        """[m, k] key matrix over fully-valid rows only (any NULL key
+        component exempts the row from uniqueness)."""
+        mat, allv = Table._key_matrix_full(columns, cols)
         return mat[allv]
 
     @staticmethod
