@@ -1,0 +1,38 @@
+#!/bin/bash
+# Relentless TPU capture: probe the flaky tunnel every 20s; when it
+# answers, fire one bench attempt for the given (query, sf). Stop as
+# soon as a LIVE tpu measurement lands in BENCH_TPU_CACHE.json (the
+# supervisor stamps captured_at_version on success). Partial XLA
+# compiles persist in .jax_cache, so even a killed attempt advances the
+# next one.
+# Usage: tpu_bench_retry.sh <query> <sf> <repeat> <max_minutes>
+cd /root/repo || exit 1
+Q=${1:-q1}; SF=${2:-10}; REP=${3:-3}; MAXMIN=${4:-120}
+KEY="${Q}_sf${SF}"
+have() {
+  python - "$KEY" <<'EOF'
+import json, sys
+try:
+    c = json.load(open("BENCH_TPU_CACHE.json"))
+    e = c.get(sys.argv[1])
+    ok = e and e["detail"].get("backend") == "tpu"
+    sys.exit(0 if ok else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+deadline=$(( $(date +%s) + MAXMIN * 60 ))
+n=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if have; then echo "CAPTURED $KEY"; exit 0; fi
+  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    n=$((n+1))
+    echo "=== attempt $n $(date -u +%H:%M:%S): tunnel up, benching $Q sf$SF"
+    TIDB_TPU_BENCH_TIMEOUT=600 timeout 700 python bench.py \
+      --query "$Q" --sf "$SF" --repeat "$REP" 2>&1 | tail -1
+  else
+    sleep 20
+  fi
+done
+echo "deadline reached without a live $KEY capture"
+exit 1

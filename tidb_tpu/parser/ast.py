@@ -105,6 +105,28 @@ class TableRef:
     db: Optional[str]
     name: str
     alias: Optional[str] = None
+    # stale read: `AS OF TIMESTAMP <expr>` (TiDB staleness clause);
+    # resolved by the session to a historical table version
+    as_of: Optional[object] = None
+
+
+def iter_table_refs(node):
+    """Yield every TableRef reachable in a statement tree — FROM clauses
+    at any depth, including subqueries in expressions. One walker shared
+    by stale-read collection, PLAN REPLAYER table capture, and any
+    future whole-statement table census (a hand-rolled per-shape walker
+    silently misses the next AST node added)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TableRef):
+            yield n
+            continue
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                stack.append(getattr(n, f.name))
+        elif isinstance(n, (list, tuple)):
+            stack.extend(n)
 
 
 @dataclasses.dataclass
@@ -370,6 +392,16 @@ class Update:
 class Explain:
     stmt: object
     analyze: bool = False
+
+
+@dataclasses.dataclass
+class PlanReplayer:
+    """PLAN REPLAYER DUMP EXPLAIN <stmt>: capture everything needed to
+    reproduce this plan elsewhere (reference:
+    pkg/server/handler/optimizor/plan_replayer.go)."""
+
+    stmt: object
+    sql_text: str = ""
 
 
 @dataclasses.dataclass

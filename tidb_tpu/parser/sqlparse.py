@@ -246,6 +246,23 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if (
+            self._at_ident("plan")
+            and self.toks[self.i + 1].kind == "id"
+            and self.toks[self.i + 1].text.lower() == "replayer"
+        ):
+            # PLAN REPLAYER DUMP EXPLAIN <stmt>
+            self.advance()  # plan
+            self.advance()  # replayer
+            if not self._at_ident("dump"):
+                raise ParseError(
+                    f"expected DUMP after PLAN REPLAYER at {self.cur.pos}"
+                )
+            self.advance()
+            self.expect_kw("explain")
+            pos0 = self.cur.pos
+            inner = self.parse_stmt()
+            return ast.PlanReplayer(inner, sql_text=self.sql[pos0:].strip())
         if self._at_ident("prepare"):
             # PREPARE name FROM '<sql>'
             self.advance()
@@ -783,12 +800,33 @@ class Parser:
         db = None
         if self.accept_op("."):
             db, name = name, self.expect_ident()
+        as_of = None
+        # stale read: `FROM t AS OF TIMESTAMP <expr>` — must be probed
+        # before alias parsing ("AS OF" vs "AS <alias>"; TiDB grammar,
+        # pkg/parser staleness clause)
+        if (
+            self.at_kw("as")
+            and self.toks[self.i + 1].text.lower() == "of"
+        ):
+            self.advance()  # as
+            self.advance()  # of
+            # TIMESTAMP lexes as an identifier (type name), not a kw
+            if not (
+                self.cur.kind == "id"
+                and self.cur.text.lower() == "timestamp"
+            ):
+                raise ParseError(
+                    f"expected TIMESTAMP after AS OF, got "
+                    f"{self.cur.text!r} at {self.cur.pos}"
+                )
+            self.advance()
+            as_of = self.parse_unary()
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
         elif self.cur.kind == "id":
             alias = self.advance().text
-        return ast.TableRef(db, name, alias)
+        return ast.TableRef(db, name, alias, as_of=as_of)
 
     # -- expressions (Pratt) ----------------------------------------------
     def parse_expr(self):

@@ -580,7 +580,65 @@ def _add_months_host(days: int, months: int) -> int:
 def _conjuncts(e):
     if isinstance(e, ast.Call) and e.op == "and":
         return _conjuncts(e.args[0]) + _conjuncts(e.args[1])
+    f = _factor_dnf(e)
+    if f is not None:
+        return f
     return [e]
+
+
+def _disjuncts(e):
+    if isinstance(e, ast.Call) and e.op == "or":
+        return _disjuncts(e.args[0]) + _disjuncts(e.args[1])
+    return [e]
+
+
+def _factor_dnf(e):
+    """Common-conjunct extraction from a disjunction:
+    (A and X) or (A and Y) -> [A, (X or Y)]. Surfaces equi-join
+    conjuncts buried in every branch of a DNF predicate (TPC-H Q19's
+    `p_partkey = l_partkey and ...` repeated per brand-group), so the
+    planner sees a hash-joinable key instead of a cross join (reference:
+    expression.ExtractFiltersFromDNF, pkg/expression/util.go). Returns
+    None when nothing factors."""
+    if not (isinstance(e, ast.Call) and e.op == "or"):
+        return None
+    branches = [_conjuncts_flat(b) for b in _disjuncts(e)]
+    if len(branches) < 2:
+        return None
+    first = branches[0]
+    common = [
+        c for c in first if all(any(c == d for d in b) for b in branches[1:])
+    ]
+    if not common:
+        return None
+    rest_branches = []
+    for b in branches:
+        rest = [c for c in b if not any(c == k for k in common)]
+        rest_branches.append(rest)
+    out = list(common)
+    if all(rest for rest in rest_branches):
+        ors = [_and_all(rest) for rest in rest_branches]
+        o = ors[0]
+        for nxt in ors[1:]:
+            o = ast.Call("or", [o, nxt])
+        out.append(o)
+    # else: some branch is exactly the common set -> the disjunction is
+    # implied by `common` alone (A or (A and X) == A)
+    return out
+
+
+def _conjuncts_flat(e):
+    """_conjuncts WITHOUT recursive DNF factoring (cycle guard)."""
+    if isinstance(e, ast.Call) and e.op == "and":
+        return _conjuncts_flat(e.args[0]) + _conjuncts_flat(e.args[1])
+    return [e]
+
+
+def _and_all(cs):
+    out = cs[0]
+    for c in cs[1:]:
+        out = ast.Call("and", [out, c])
+    return out
 
 
 def _ast_columns(e, out: set):

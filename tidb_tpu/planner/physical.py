@@ -1752,6 +1752,21 @@ class PhysicalExecutor:
                 pins = []
                 try:
                     return self._run_pinned(cq, pins)
+                except ExecError as e:
+                    # quota admission rejected the unpaged plan: retry
+                    # with streaming FORCED — the aggregate's own
+                    # working set fit the budget, but join tiles above
+                    # it did not (the reference escalates the same way:
+                    # memory-tracker pressure triggers spill actions,
+                    # pkg/util/memory/action.go)
+                    if "memory quota exceeded" in str(e):
+                        forced = try_streamed(
+                            self, plan, conservative=conservative,
+                            force=True,
+                        )
+                        if forced is not None:
+                            return forced
+                    raise
                 finally:
                     for t, v in pins:
                         t.unpin(v)

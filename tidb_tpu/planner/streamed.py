@@ -55,6 +55,12 @@ def _collect_pipeline_scans(p, scans, flags, chunkable=True) -> bool:
     doesn't stream)."""
     while isinstance(p, (L.Selection, L.Projection)):
         p = p.child
+    if isinstance(p, L.Staged):
+        # an already-staged (streamed lower aggregate) result: resident
+        # and closed over by the compiled pipeline — a valid leaf, never
+        # the chunked side. Lets a SECOND aggregate above a staged one
+        # stream too (Q18's outer GROUP BY over the HAVING subquery).
+        return True
     if isinstance(p, L.Scan):
         scans.append(p)
         flags.append(chunkable)
@@ -101,15 +107,26 @@ def _pick_big_scan(executor, scans, flags):
     return big_i, resolved
 
 
-def _stream_sizing(executor, scans, resolved, big_i, threshold):
+def _stream_sizing(executor, scans, resolved, big_i, threshold, force=False):
     """(chunk_rows, should_stream): budget math shared by the agg and
     sort streaming paths. Auto mode streams when the whole working set
     (big scan + resident sides, ~4x for intermediates) overruns the
     device budget, and sizes chunks from the budget REMAINING after the
-    resident sides. Explicit thresholds chunk at that row count."""
+    resident sides. Explicit thresholds chunk at that row count.
+    force: stream even when this aggregate's own working set fits —
+    the quota-admission retry path, where the WHOLE plan (join tiles
+    above this aggregate) blew the budget."""
     t, v = resolved[big_i]
     big = scans[big_i]
     budget = _device_budget()
+    # the admission quota (tidb_mem_quota_query) caps the working set
+    # below physical memory: streaming must engage at the quota, not at
+    # HBM exhaustion, or small-quota queries die at admission instead
+    # of spilling (reference: spill triggers on the memory tracker's
+    # quota, pkg/executor/aggregate/agg_spill.go)
+    q = getattr(executor, "quota_bytes", None)
+    if q:
+        budget = min(budget, int(q))
     rb = _row_bytes(t, v, big.columns)
     others_bytes = sum(
         ot.nrows * _row_bytes(ot, ov, s.columns)
@@ -118,11 +135,15 @@ def _stream_sizing(executor, scans, resolved, big_i, threshold):
     )
     if others_bytes * 4 > budget:
         return None, False  # resident join sides don't fit: run unpaged
-    if threshold == -1:
-        if (t.nrows * rb + others_bytes) * 4 <= budget:
+    if threshold == -1 or force:
+        if not force and (t.nrows * rb + others_bytes) * 4 <= budget:
             return None, False
         avail = max(budget - 4 * others_bytes, budget // 8)
-        chunk_rows = max(1 << 16, min(1 << 24, _pow2_floor(avail // (4 * rb))))
+        chunk_rows = max(1 << 14, min(1 << 24, _pow2_floor(avail // (4 * rb))))
+        if force and chunk_rows * rb * 4 > budget:
+            # even one minimal chunk overruns the quota: streaming
+            # cannot save this query — let admission's rejection stand
+            return None, False
     else:
         if t.nrows <= threshold:
             return None, False
@@ -345,7 +366,9 @@ def _stream_plan(executor, plan, agg, big_scan, conservative=False):
     return entry
 
 
-def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, dict]]:
+def try_streamed(
+    executor, plan, conservative=False, force=False
+) -> Optional[Tuple[Batch, dict]]:
     """Execute `plan` with a streamed aggregate when it qualifies:
     single-device, lowest Aggregate over a streaming pipeline
     (Selection/Projection chains + equi-joins over scans), with the
@@ -370,7 +393,7 @@ def try_streamed(executor, plan, conservative=False) -> Optional[Tuple[Batch, di
     big_scan = scans[big_i]
     t, v = resolved[big_i]
     chunk_rows, should = _stream_sizing(
-        executor, scans, resolved, big_i, threshold
+        executor, scans, resolved, big_i, threshold, force=force
     )
     if not should:
         return None

@@ -146,14 +146,46 @@ class Session:
         self._prepared = {}
         self.user_vars = {}
         self._last_plan = None
+        # stale-read state: per-statement AS OF TIMESTAMP map
+        # ((db, table) -> epoch ts) and whether the current top-level
+        # statement is read-only (tidb_read_staleness applies only then)
+        self._stmt_as_of: dict = {}
+        self._stale_ok = False
 
     # -- transaction plumbing ------------------------------------------
     def _resolve_table_for_read(self, db: str, name: str):
         """Returns (table, version) the executor should scan."""
         t = self.catalog.table(db, name)
+        key = (db.lower(), name.lower())
+        # stale read (reference: sessiontxn staleness providers):
+        # AS OF TIMESTAMP on the table ref, else tidb_read_staleness on
+        # read-only autocommit statements
+        as_of_ts = self._stmt_as_of.get(key)
+        clamp = False
+        if as_of_ts is None and self._txn is None and self._stale_ok:
+            try:
+                staleness = int(self.vars.get("tidb_read_staleness") or 0)
+            except Exception:
+                staleness = 0
+            if staleness < 0:
+                as_of_ts = time.time() + staleness
+                # the reference picks a usable ts inside
+                # [now+staleness, now]; a table younger than the window
+                # reads its earliest retained state, never errors
+                clamp = True
+        if as_of_ts is not None:
+            if self._txn is not None:
+                raise ValueError(
+                    "stale read is not allowed inside a transaction"
+                )
+            return t, t.version_at(as_of_ts, clamp_oldest=clamp)
         if self._txn is None:
             return t, t.version
-        key = (db.lower(), name.lower())
+        if self._rc_isolation() and key not in self._txn["shadows"]:
+            # READ COMMITTED provider: every statement reads the newest
+            # committed version, not the txn-start snapshot (reference:
+            # sessiontxn/isolation/readcommitted.go)
+            return t, t.version
         shadow = self._txn["shadows"].get(key)
         if shadow is not None:
             return shadow, shadow.version
@@ -201,6 +233,62 @@ class Session:
     # -- pessimistic locking (reference: LockKeys in the pessimistic txn
     # path, pkg/store/driver/txn/txn_driver.go; deadlock detector
     # unistore/tikv/detector.go) --------------------------------------
+    def _collect_as_of(self, s) -> dict:
+        """Collect `AS OF TIMESTAMP` table refs across the whole
+        statement tree; returns {(db, table): epoch ts}. The resolver is
+        keyed by table NAME, so one statement mixing stale and current
+        refs of the same table (or two different timestamps) cannot be
+        honored — that raises instead of silently resolving both refs
+        to one version."""
+        out: dict = {}
+        plain: set = set()
+
+        def ts_of(expr) -> float:
+            v = self._const_value(expr)
+            if isinstance(v, (int, float)):
+                return float(v)
+            if isinstance(v, str):
+                try:
+                    return float(v)
+                except ValueError:
+                    import datetime as _dt
+
+                    return _dt.datetime.fromisoformat(v).timestamp()
+            raise ValueError(
+                f"cannot evaluate AS OF TIMESTAMP expression: {expr!r}"
+            )
+
+        for ref in ast.iter_table_refs(s):
+            key = ((ref.db or self.db).lower(), ref.name.lower())
+            if ref.as_of is None:
+                plain.add(key)
+            else:
+                ts = ts_of(ref.as_of)
+                if out.get(key, ts) != ts:
+                    raise ValueError(
+                        f"multiple AS OF TIMESTAMP values for table "
+                        f"{key[1]!r} in one statement are not supported"
+                    )
+                out[key] = ts
+        conflict = plain & set(out)
+        if conflict:
+            raise ValueError(
+                "mixing AS OF TIMESTAMP and current-version references "
+                f"to the same table {sorted(conflict)[0][1]!r} in one "
+                "statement is not supported"
+            )
+        return out
+
+    def _rc_isolation(self) -> bool:
+        try:
+            return str(
+                self.vars.get("transaction_isolation")
+                or self.vars.get("tx_isolation")
+                or ""
+            ).upper() == "READ-COMMITTED"
+        except Exception:
+            return False
+
     def _pessimistic(self) -> bool:
         return str(self.vars.get("tidb_txn_mode") or "").lower() == "pessimistic"
 
@@ -975,7 +1063,30 @@ class Session:
         )
         failpoint.inject("session/stmt-start")
         self._enforce_privileges(s)
-        if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+        is_read = isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp))
+        if self._stmt_depth == 1:
+            # tidb_read_staleness applies to top-level read statements
+            # only — the SELECT half of INSERT..SELECT must see fresh
+            # data (reference: staleness providers gate on read-only)
+            self._stale_ok = is_read
+            inner = s
+            while isinstance(inner, (ast.Explain, ast.PlanReplayer, ast.Trace)):
+                inner = inner.stmt
+            if isinstance(inner, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+                self._stmt_as_of = self._collect_as_of(inner)
+            else:
+                self._stmt_as_of = {}
+                if any(
+                    r.as_of is not None for r in ast.iter_table_refs(inner)
+                ):
+                    # the reference rejects stale read in DML; silently
+                    # reading FRESH data where the user asked for
+                    # historical would be worse than an error
+                    raise ValueError(
+                        "AS OF TIMESTAMP is only allowed in read-only "
+                        "statements"
+                    )
+        if is_read:
             s = self._resolve_session_funcs(s)
         try:
             self.executor.quota_bytes = int(
@@ -1383,6 +1494,8 @@ class Session:
             )
         elif isinstance(s, ast.Explain):
             r = self._run_explain(s)
+        elif isinstance(s, ast.PlanReplayer):
+            r = self._run_plan_replayer(s)
         elif isinstance(s, ast.Show):
             r = self._run_show(s)
         elif isinstance(s, ast.SetVariable):
@@ -1390,6 +1503,18 @@ class Session:
                 self.user_vars[s.name.lstrip("@")] = s.value
             else:
                 self.vars.set(s.name, s.value, s.scope)
+                if s.name.lower() == "tidb_gc_life_time":
+                    # side effect: the storage GC horizon is engine-wide.
+                    # The sysvar is GLOBAL-only (set() above enforces
+                    # that), so the global store — not a session
+                    # override — is the value to apply
+                    from tidb_tpu.storage.table import set_gc_life
+
+                    set_gc_life(
+                        float(
+                            self.vars._globals.get("tidb_gc_life_time", 0)
+                        )
+                    )
             r = Result([], [])
         elif isinstance(s, ast.PrepareStmt):
             self.prepare(s.name, s.sql)
@@ -3318,6 +3443,21 @@ class Session:
         return Result([], [], affected=total)
 
     # ------------------------------------------------------------------
+    def _run_plan_replayer(self, s: ast.PlanReplayer) -> Result:
+        """PLAN REPLAYER DUMP EXPLAIN <stmt>: zip of schema DDL, stats,
+        variables, bindings, the SQL and its EXPLAIN (reference:
+        optimizor/plan_replayer.go). Returns the zip path."""
+        from tidb_tpu.utils.planreplayer import dump_plan_replayer
+
+        explain = self._run_explain(ast.Explain(s.stmt))
+        tables: list = []
+        for ref in ast.iter_table_refs(s.stmt):
+            key = ((ref.db or self.db).lower(), ref.name.lower())
+            if key not in tables and self.catalog.has_table(*key):
+                tables.append(key)
+        fn = dump_plan_replayer(self, s.sql_text, tables, explain.rows)
+        return Result(["File"], [(fn,)])
+
     def _run_explain(self, s: ast.Explain) -> Result:
         if not isinstance(s.stmt, (ast.Select, ast.Union, ast.With)):
             raise ValueError("EXPLAIN supports SELECT/UNION/WITH")

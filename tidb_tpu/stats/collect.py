@@ -129,20 +129,28 @@ def _analyze_at_version(table, version, columns, stats):
         if columns is not None and name not in columns:
             continue
         if sampled:
+            # gather ONLY the sampled rows per block (sample_idx is
+            # sorted; split it into per-block ranges) — concatenating
+            # whole columns first would copy O(total rows) per column
+            # at exactly the scale that triggers sampling
             data_parts, valid_parts = [], []
+            off = 0
+            lo = 0
             for b in blocks:
+                hi = np.searchsorted(sample_idx, off + b.nrows)
+                local = sample_idx[lo:hi] - off
                 hc = b.columns.get(name)
                 if hc is None:
                     # block predates ALTER ADD COLUMN: reads see NULL
-                    data_parts.append(
-                        np.zeros(b.nrows, dtype=np.int64)
-                    )
-                    valid_parts.append(np.zeros(b.nrows, dtype=bool))
+                    data_parts.append(np.zeros(len(local), dtype=np.int64))
+                    valid_parts.append(np.zeros(len(local), dtype=bool))
                 else:
-                    data_parts.append(hc.data)
-                    valid_parts.append(hc.valid)
-            data_h = np.concatenate(data_parts)[sample_idx]
-            valid_h = np.concatenate(valid_parts)[sample_idx]
+                    data_parts.append(hc.data[local])
+                    valid_parts.append(hc.valid[local])
+                off += b.nrows
+                lo = hi
+            data_h = np.concatenate(data_parts)
+            valid_h = np.concatenate(valid_parts)
             # decode through the PINNED blocks' dictionary, not the live
             # table dict: a concurrent append can grow-and-remap the
             # sorted dictionary, shifting the codes these blocks hold

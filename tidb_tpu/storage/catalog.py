@@ -201,11 +201,11 @@ class Catalog:
     # reflect the live catalog)
     _IS_TABLES = (
         "tables", "columns", "schemata", "statistics", "slow_query",
-        "statements_summary", "metrics",
+        "statements_summary", "metrics", "top_sql",
     )
 
     def _infoschema_table(self, name: str) -> Table:
-        if name in ("slow_query", "statements_summary", "metrics"):
+        if name in ("slow_query", "statements_summary", "metrics", "top_sql"):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
             # (diagnostics are rare; cache churn is acceptable there)
@@ -323,6 +323,26 @@ class Catalog:
                 [("name", STRING), ("kind", STRING), ("value", FLOAT64)]
             )
             rows = REGISTRY.rows()
+        elif name == "top_sql":
+            # TopSQL analog (reference: pkg/util/topsql — per-digest CPU
+            # time ranking shipped to a collector): here, per-digest
+            # cumulative engine time ranked hottest-first. One process =
+            # one "instance"; the collector round-trip is the
+            # statements-summary store itself.
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.metrics import STMT_SUMMARY
+
+            schema = TableSchema(
+                [("rank", INT64), ("digest_text", STRING),
+                 ("exec_count", INT64), ("sum_latency", FLOAT64),
+                 ("avg_latency", FLOAT64), ("max_latency", FLOAT64),
+                 ("sample_text", STRING)]
+            )
+            ranked = sorted(STMT_SUMMARY.rows(), key=lambda r: -r[2])[:30]
+            rows = [
+                (i + 1, d, n, s, s / max(n, 1), m, txt)
+                for i, (d, n, s, m, txt) in enumerate(ranked)
+            ]
         else:
             raise ValueError(f"unknown table information_schema.{name}")
         t = Table(name, schema)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,6 +76,18 @@ def _merge_dictionaries(
 _table_uid_counter = itertools.count(1)
 
 
+#: MVCC history retention in seconds (tidb_gc_life_time analog).
+#: 0 keeps only what pins/current require — stale reads then only reach
+#: versions that happen to survive; set via
+#: `SET GLOBAL tidb_gc_life_time = <seconds>` to enable a real window.
+GC_LIFE_S: float = 0.0
+
+
+def set_gc_life(seconds: float) -> None:
+    global GC_LIFE_S
+    GC_LIFE_S = max(0.0, float(seconds))
+
+
 class Table:
     def __init__(self, name: str, schema: TableSchema):
         self.name = name
@@ -88,6 +101,10 @@ class Table:
         self.version = 0
         # version -> list of blocks (copy-on-write)
         self._versions: Dict[int, List[HostBlock]] = {0: []}
+        # version -> publish wall-clock ts (the single-writer TSO
+        # analog): stale reads (AS OF TIMESTAMP / tidb_read_staleness)
+        # resolve a timestamp to the newest version at-or-before it
+        self.version_ts: Dict[int, float] = {0: time.time()}
         # snapshot pins: version -> refcount. GC (below) never drops a
         # pinned version — the safepoint contract of the reference's GC
         # worker (pkg/store/gcworker/gc_worker.go:194,371).
@@ -280,16 +297,30 @@ class Table:
     def _gc_versions(self) -> None:
         """Drop historical versions nobody can read anymore: keep the
         current version, the immediately previous one (in-flight
-        statements resolve their version before fetching), and any
-        pinned snapshot. Without this every UPDATE leaked its whole
-        pre-image forever (VERDICT round-1 weak #4)."""
+        statements resolve their version before fetching), any pinned
+        snapshot, and — when a GC life window is configured
+        (tidb_gc_life_time analog) — every version published inside it,
+        which is what stale reads resolve against. Without this every
+        UPDATE leaked its whole pre-image forever (VERDICT round-1 weak
+        #4)."""
         from tidb_tpu.utils.failpoint import inject
 
         inject("storage/gc-versions")
+        # stamp the just-published version (this runs under the table
+        # lock immediately after every version bump)
+        self.version_ts.setdefault(self.version, time.time())
         keep = {self.version, self.version - 1} | set(self._pins)
+        life = GC_LIFE_S
+        if life > 0:
+            horizon = time.time() - life
+            keep |= {
+                v for v, ts in self.version_ts.items() if ts >= horizon
+            }
         for v in [v for v in self._versions if v not in keep]:
             inject("storage/gc-drop-version")
             del self._versions[v]
+        for v in [v for v in self.version_ts if v not in self._versions]:
+            del self.version_ts[v]
         # commit observers (log backup): _gc_versions runs under the
         # table lock immediately after every version publish, so it is
         # the one choke point that sees each new version. Each observer
@@ -310,6 +341,31 @@ class Table:
                         self._pins.pop(v, None)
                     else:
                         self._pins[v] = n
+
+    def version_at(self, ts: float, clamp_oldest: bool = False) -> int:
+        """Newest version published at-or-before `ts` that is still
+        readable (stale read resolution). Raises when the snapshot has
+        been GC'd — the reference's 'GC life time is shorter than
+        transaction duration' error. clamp_oldest: resolve to the oldest
+        retained version instead of raising — tidb_read_staleness picks
+        a USABLE timestamp within [now+staleness, now] (a table younger
+        than the window reads its earliest state), while explicit AS OF
+        stays strict."""
+        with self._lock:
+            cands = [
+                v
+                for v, t0 in self.version_ts.items()
+                if t0 <= ts and v in self._versions
+            ]
+            if not cands:
+                if clamp_oldest and self._versions:
+                    return min(self._versions)
+                raise ValueError(
+                    f"snapshot of {self.name!r} at ts {ts:.3f} is "
+                    "unavailable: older than the GC safepoint (raise "
+                    "tidb_gc_life_time) or before table creation"
+                )
+            return max(cands)
 
     def append_block(self, block: HostBlock) -> int:
         """Append rows; returns the new version id."""

@@ -49,11 +49,13 @@ def _bool(x):
 
 
 def _enum(*allowed):
+    lut = {a.lower(): a for a in allowed}
+
     def v(x):
         s = str(x).strip().lower()
-        if s not in allowed:
+        if s not in lut:
             raise ValueError(f"value {x!r} not in {allowed}")
-        return s
+        return lut[s]  # canonical casing as declared
 
     return v
 
@@ -110,8 +112,19 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
         SysVarDef("version_comment", "tidb_tpu TPU-native SQL engine", "readonly"),
         SysVarDef("character_set_connection", "utf8mb4", "both"),
         SysVarDef("collation_connection", "utf8mb4_bin", "both"),
-        SysVarDef("tx_isolation", "REPEATABLE-READ", "both"),
-        SysVarDef("transaction_isolation", "REPEATABLE-READ", "both"),
+        SysVarDef("tx_isolation", "REPEATABLE-READ", "both",
+                  _enum("REPEATABLE-READ", "READ-COMMITTED")),
+        SysVarDef("transaction_isolation", "REPEATABLE-READ", "both",
+                  _enum("REPEATABLE-READ", "READ-COMMITTED")),
+        SysVarDef("tidb_read_staleness", 0, "both", _int_range(-86400, 0),
+                  "negative seconds: autocommit reads resolve against "
+                  "the newest table version at now+staleness (reference "
+                  "tidb_read_staleness stale reads)"),
+        SysVarDef("tidb_gc_life_time", 0, "global", _int_range(0, 86400 * 7),
+                  "seconds of MVCC version history every table retains "
+                  "for stale reads / AS OF TIMESTAMP (reference "
+                  "tidb_gc_life_time; 0 = keep only pinned snapshots). "
+                  "GLOBAL-only: it drives the engine-wide GC horizon"),
     ]
 }
 
