@@ -116,3 +116,42 @@ class TestReadCommitted:
         # REPEATABLE-READ (default): snapshot pinned at first read
         assert s1.execute("select count(*) from t").rows == [(1,)]
         s1.execute("rollback")
+
+
+class TestStalenessEdges:
+    def test_infoschema_immune_to_staleness(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("set tidb_read_staleness = -1")
+        try:
+            rows = sess.execute(
+                "select table_name from information_schema.tables"
+            ).rows
+        finally:
+            sess.execute("set tidb_read_staleness = 0")
+        assert any(r[0] == "t" for r in rows)
+
+    def test_tx_isolation_alias_mirrors(self):
+        cat = Catalog()
+        s1 = Session(cat)
+        s2 = Session(cat)
+        s1.execute("create table t (a int)")
+        s1.execute("insert into t values (1)")
+        # the LEGACY alias must drive the RC provider too
+        s1.execute("set tx_isolation = 'READ-COMMITTED'")
+        s1.execute("begin")
+        assert s1.execute("select count(*) from t").rows == [(1,)]
+        s2.execute("insert into t values (2)")
+        assert s1.execute("select count(*) from t").rows == [(2,)]
+        s1.execute("rollback")
+
+    def test_staleness_clamps_young_table(self, sess):
+        # a table created inside the staleness window reads its earliest
+        # retained state instead of erroring (usable-timestamp rule)
+        sess.execute("create table fresh (a int)")
+        sess.execute("insert into fresh values (1)")
+        sess.execute("set tidb_read_staleness = -3600")
+        try:
+            rows = sess.execute("select count(*) from fresh").rows
+        finally:
+            sess.execute("set tidb_read_staleness = 0")
+        assert rows[0][0] in (0, 1)  # oldest retained state, no error

@@ -395,6 +395,24 @@ class Explain:
 
 
 @dataclasses.dataclass
+class ResourceGroupDDL:
+    """CREATE/ALTER/DROP RESOURCE GROUP (reference: TiDB resource
+    control DDL, pkg/ddl resource group jobs)."""
+
+    action: str  # 'create' | 'alter' | 'drop'
+    name: str
+    ru_per_sec: Optional[int] = None
+    burstable: Optional[bool] = None
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class SetResourceGroup:
+    name: str
+
+
+@dataclasses.dataclass
 class PlanReplayer:
     """PLAN REPLAYER DUMP EXPLAIN <stmt>: capture everything needed to
     reproduce this plan elsewhere (reference:

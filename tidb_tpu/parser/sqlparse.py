@@ -465,6 +465,11 @@ class Parser:
             if not isinstance(val, ast.Const):
                 raise ParseError("user variables accept literal values")
             return ast.SetVariable("@" + uname, val.value, "user")
+        if self._at_ident("resource"):
+            # SET RESOURCE GROUP <name>: bind this session to a group
+            self.advance()
+            self._expect_ident_kw("group")
+            return ast.SetResourceGroup(self.expect_ident())
         scope = "session"
         if self.accept_kw("global"):
             scope = "global"
@@ -1568,6 +1573,35 @@ class Parser:
     def _at_ident(self, word: str) -> bool:
         return self.cur.kind == "id" and self.cur.text.lower() == word
 
+    def _expect_ident_kw(self, word: str) -> None:
+        """Expect a word that may lex as EITHER identifier or keyword
+        (e.g. GROUP in RESOURCE GROUP)."""
+        if self.cur.text.lower() != word:
+            raise ParseError(
+                f"expected {word.upper()}, got {self.cur.text!r} "
+                f"at {self.cur.pos}"
+            )
+        self.advance()
+
+    def _resource_group_options(self):
+        """[RU_PER_SEC = n] [BURSTABLE] in any order."""
+        ru = None
+        burst = None
+        while True:
+            if self._at_ident("ru_per_sec"):
+                self.advance()
+                self.accept_op("=")
+                t = self.advance()
+                try:
+                    ru = int(t.text)
+                except ValueError:
+                    raise ParseError("RU_PER_SEC expects an integer")
+            elif self._at_ident("burstable"):
+                self.advance()
+                burst = True
+            else:
+                return ru, burst
+
     def parse_create(self):
         self.expect_kw("create")
         or_replace = False
@@ -1628,6 +1662,16 @@ class Parser:
                     raise ParseError("IDENTIFIED BY expects a string")
                 pw = t.text
             return ast.CreateUser(name, pw, ine)
+        if self._at_ident("resource"):
+            self.advance()
+            self._expect_ident_kw("group")
+            ine = self._if_not_exists()
+            name = self.expect_ident()
+            ru, burst = self._resource_group_options()
+            return ast.ResourceGroupDDL(
+                "create", name, ru_per_sec=ru,
+                burstable=bool(burst), if_not_exists=ine,
+            )
         unique = self.accept_kw("unique")
         if unique and not self.at_kw("index"):
             raise ParseError("expected INDEX after UNIQUE")
@@ -1912,6 +1956,14 @@ class Parser:
 
     def parse_alter(self):
         self.expect_kw("alter")
+        if self._at_ident("resource"):
+            self.advance()
+            self._expect_ident_kw("group")
+            name = self.expect_ident()
+            ru, burst = self._resource_group_options()
+            return ast.ResourceGroupDDL(
+                "alter", name, ru_per_sec=ru, burstable=burst
+            )
         self.expect_kw("table")
         db, name = self._qualified_name()
         if self.accept_kw("add"):
@@ -1955,6 +2007,16 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self._at_ident("resource"):
+            self.advance()
+            self._expect_ident_kw("group")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.ResourceGroupDDL(
+                "drop", self.expect_ident(), if_exists=if_exists
+            )
         if self._at_ident("view"):
             self.advance()
             if_exists = False

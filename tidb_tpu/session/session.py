@@ -151,6 +151,12 @@ class Session:
         # statement is read-only (tidb_read_staleness applies only then)
         self._stmt_as_of: dict = {}
         self._stale_ok = False
+        # RU governance binding (SET RESOURCE GROUP <name>)
+        self.resource_group = "default"
+        if not hasattr(self.catalog, "resource_groups"):  # old pickles
+            from tidb_tpu.utils.resgroup import ResourceGroupManager
+
+            self.catalog.resource_groups = ResourceGroupManager()
 
     # -- transaction plumbing ------------------------------------------
     def _resolve_table_for_read(self, db: str, name: str):
@@ -161,6 +167,16 @@ class Session:
         # AS OF TIMESTAMP on the table ref, else tidb_read_staleness on
         # read-only autocommit statements
         as_of_ts = self._stmt_as_of.get(key)
+        if db.lower() == "information_schema":
+            # virtual diagnostic tables are rebuilt fresh per access —
+            # staleness would resolve them to their empty version-0
+            # state (the reference never applies staleness to memtables)
+            if as_of_ts is not None:
+                raise ValueError(
+                    "AS OF TIMESTAMP is not supported on "
+                    "information_schema tables"
+                )
+            return t, t.version
         clamp = False
         if as_of_ts is None and self._txn is None and self._stale_ok:
             try:
@@ -280,11 +296,11 @@ class Session:
         return out
 
     def _rc_isolation(self) -> bool:
+        # tx_isolation mirrors transaction_isolation on SET (sysvar.py),
+        # so one lookup covers both spellings
         try:
             return str(
-                self.vars.get("transaction_isolation")
-                or self.vars.get("tx_isolation")
-                or ""
+                self.vars.get("transaction_isolation") or ""
             ).upper() == "READ-COMMITTED"
         except Exception:
             return False
@@ -854,12 +870,33 @@ class Session:
 
         t0 = time.perf_counter()
         self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
+        top = self._stmt_depth == 1
         try:
+            if top and self.resource_group != "default":
+                # RU governance: block while this session's group has a
+                # negative bucket (previous statements overdrew it) —
+                # reference: resource-control token-bucket gating.
+                # Inside the try: a kill/timeout during the wait must
+                # still unwind _stmt_depth or the session is corrupted.
+                self.catalog.resource_groups.acquire(
+                    self.resource_group, kill_check=self.killer.check
+                )
+                # billing starts AFTER the gate: charging the throttle
+                # wait itself as RU would re-overdraw the bucket and
+                # the group would never converge to its fill rate
+                t0 = time.perf_counter()
             res = self._execute_stmt_inner(s, t0)
             self._maybe_auto_analyze(s)
             return res
         finally:
             self._stmt_depth -= 1
+            if top:
+                try:
+                    self.catalog.resource_groups.debit(
+                        self.resource_group, time.perf_counter() - t0
+                    )
+                except Exception:
+                    pass  # billing must never fail the statement
 
     def _maybe_auto_analyze(self, s) -> None:
         """Statement-boundary auto-analyze check (reference: the stats
@@ -1496,6 +1533,23 @@ class Session:
             r = self._run_explain(s)
         elif isinstance(s, ast.PlanReplayer):
             r = self._run_plan_replayer(s)
+        elif isinstance(s, ast.ResourceGroupDDL):
+            rg = self.catalog.resource_groups
+            if s.action == "create":
+                rg.create(
+                    s.name, s.ru_per_sec, bool(s.burstable),
+                    if_not_exists=s.if_not_exists,
+                )
+            elif s.action == "alter":
+                rg.alter(s.name, s.ru_per_sec, s.burstable)
+            else:
+                rg.drop(s.name, if_exists=s.if_exists)
+            r = Result([], [])
+        elif isinstance(s, ast.SetResourceGroup):
+            # validate the group exists before binding
+            self.catalog.resource_groups.get(s.name)
+            self.resource_group = s.name.lower()
+            r = Result([], [])
         elif isinstance(s, ast.Show):
             r = self._run_show(s)
         elif isinstance(s, ast.SetVariable):

@@ -37,6 +37,12 @@ class Catalog:
         # session over this store (storage/locks.py; the mutex closes
         # the optimistic check/apply race between concurrent commits)
         from tidb_tpu.storage.locks import LockManager
+        from tidb_tpu.utils.resgroup import ResourceGroupManager
+
+        # RU governance: named groups with token buckets, shared by
+        # every session over this store (reference: resource control,
+        # pkg/domain/resourcegroup)
+        self.resource_groups = ResourceGroupManager()
 
         self.lock_manager = LockManager()
         self._commit_mu = threading.Lock()
@@ -201,11 +207,14 @@ class Catalog:
     # reflect the live catalog)
     _IS_TABLES = (
         "tables", "columns", "schemata", "statistics", "slow_query",
-        "statements_summary", "metrics", "top_sql",
+        "statements_summary", "metrics", "top_sql", "resource_groups",
     )
 
     def _infoschema_table(self, name: str) -> Table:
-        if name in ("slow_query", "statements_summary", "metrics", "top_sql"):
+        if name in (
+            "slow_query", "statements_summary", "metrics", "top_sql",
+            "resource_groups",
+        ):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
             # (diagnostics are rare; cache churn is acceptable there)
@@ -323,6 +332,15 @@ class Catalog:
                 [("name", STRING), ("kind", STRING), ("value", FLOAT64)]
             )
             rows = REGISTRY.rows()
+        elif name == "resource_groups":
+            from tidb_tpu.dtypes import FLOAT64
+
+            schema = TableSchema(
+                [("name", STRING), ("ru_per_sec", INT64),
+                 ("burstable", STRING), ("consumed_ru", FLOAT64),
+                 ("queries", INT64)]
+            )
+            rows = self.resource_groups.rows()
         elif name == "top_sql":
             # TopSQL analog (reference: pkg/util/topsql — per-digest CPU
             # time ranking shipped to a collector): here, per-digest

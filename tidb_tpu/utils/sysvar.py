@@ -156,14 +156,22 @@ class SysVars:
             raise ValueError(f"variable {name} is read-only")
         if d.validate is not None:
             value = d.validate(value)
+        # MySQL keeps the legacy alias and the canonical name in sync
+        names = (
+            ("tx_isolation", "transaction_isolation")
+            if name in ("tx_isolation", "transaction_isolation")
+            else (name,)
+        )
         if scope == "global":
             if d.scope == "session":
                 raise ValueError(f"variable {name} is session-scoped")
-            self._globals[name] = value
+            for n in names:
+                self._globals[n] = value
         else:
             if d.scope == "global":
                 raise ValueError(f"variable {name} is global-scoped; use SET GLOBAL")
-            self._session[name] = value
+            for n in names:
+                self._session[n] = value
 
     def all(self) -> Dict[str, object]:
         out = {}
