@@ -254,3 +254,52 @@ class TestOnDuplicateKeyUpdate:
                 "on duplicate key update v = v + 10"
             )
         assert sess.execute("select v from t").rows == [(99,)]
+
+
+class TestColumnarStringUpdate:
+    """UPDATE SET <string col> = '<existing value>' stays columnar
+    (dictionary-code scatter, no whole-table rewrite); an unseen value
+    falls back to the rewrite path (dictionary remap). Reference: the
+    per-key delta write path, pkg/executor/update.go."""
+
+    def test_existing_value_scatter(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table t (id int, status varchar(10))")
+        s.execute(
+            "insert into t values (1, 'open'), (2, 'open'), (3, 'done')"
+        )
+        t = s.catalog.table("test", "t")
+        blocks_before = [b.uid for b in t.blocks()]
+        r = s.execute("update t set status = 'done' where id = 1")
+        assert r.affected == 1
+        assert s.execute(
+            "select id, status from t order by id"
+        ).rows == [(1, "done"), (2, "open"), (3, "done")]
+        # columnar path: the untouched-block structure survives (the
+        # rewrite path would collapse everything into one fresh block)
+        assert len(t.blocks()) == len(blocks_before)
+
+    def test_unseen_value_falls_back(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table t (id int, status varchar(10))")
+        s.execute("insert into t values (1, 'open'), (2, 'open')")
+        r = s.execute("update t set status = 'closed' where id = 2")
+        assert r.affected == 1
+        assert s.execute(
+            "select id, status from t order by id"
+        ).rows == [(1, "open"), (2, "closed")]
+
+    def test_mixed_string_and_numeric_set(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table t (id int, n int, status varchar(10))")
+        s.execute("insert into t values (1, 10, 'open'), (2, 20, 'done')")
+        s.execute("update t set status = 'done', n = n + 5 where id = 1")
+        assert s.execute(
+            "select id, n, status from t order by id"
+        ).rows == [(1, 15, "done"), (2, 20, "done")]

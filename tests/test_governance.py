@@ -280,3 +280,40 @@ class TestResourceGroups:
         s = Session()
         with pytest.raises(ValueError, match="unknown resource group"):
             s.execute("set resource group nope")
+
+    def test_dropped_bound_group_degrades_gracefully(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create resource group g1 ru_per_sec = 100")
+        s.execute("set resource group g1")
+        s.execute("drop resource group g1")
+        # the session must not wedge: statements run unthrottled and
+        # rebinding works
+        assert s.execute("select 1").rows == [(1,)]
+        s.execute("set resource group default")
+
+    def test_zero_rate_rejected_and_burstable_revocable(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        with pytest.raises(ValueError, match="RU_PER_SEC"):
+            s.execute("create resource group z ru_per_sec = 0")
+        s.execute("create resource group b ru_per_sec = 100 burstable")
+        s.execute("alter resource group b burstable = false")
+        rows = s.execute(
+            "select burstable from information_schema.resource_groups "
+            "where name = 'b'"
+        ).rows
+        assert rows == [("NO",)]
+
+    def test_nonliteral_string_set_falls_back(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table t (id int, st varchar(16))")
+        s.execute("insert into t values (1, 'a'), (2, 'b')")
+        s.execute("update t set st = concat(st, 'x') where id = 1")
+        assert s.execute(
+            "select id, st from t order by id"
+        ).rows == [(1, "ax"), (2, "b")]

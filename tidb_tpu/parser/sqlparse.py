@@ -1599,6 +1599,11 @@ class Parser:
             elif self._at_ident("burstable"):
                 self.advance()
                 burst = True
+                if self.accept_op("="):
+                    # BURSTABLE = TRUE|FALSE: the only way ALTER can
+                    # REVOKE burstability
+                    t = self.advance()
+                    burst = t.text.lower() in ("true", "1", "on")
             else:
                 return ru, burst
 

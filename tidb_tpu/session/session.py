@@ -871,6 +871,7 @@ class Session:
         t0 = time.perf_counter()
         self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
         top = self._stmt_depth == 1
+        bill_t0 = t0
         try:
             if top and self.resource_group != "default":
                 # RU governance: block while this session's group has a
@@ -878,22 +879,23 @@ class Session:
                 # reference: resource-control token-bucket gating.
                 # Inside the try: a kill/timeout during the wait must
                 # still unwind _stmt_depth or the session is corrupted.
+                bill_t0 = None  # a raise mid-wait must not bill the wait
                 self.catalog.resource_groups.acquire(
                     self.resource_group, kill_check=self.killer.check
                 )
                 # billing starts AFTER the gate: charging the throttle
                 # wait itself as RU would re-overdraw the bucket and
                 # the group would never converge to its fill rate
-                t0 = time.perf_counter()
-            res = self._execute_stmt_inner(s, t0)
+                bill_t0 = time.perf_counter()
+            res = self._execute_stmt_inner(s, bill_t0)
             self._maybe_auto_analyze(s)
             return res
         finally:
             self._stmt_depth -= 1
-            if top:
+            if top and bill_t0 is not None:
                 try:
                     self.catalog.resource_groups.debit(
-                        self.resource_group, time.perf_counter() - t0
+                        self.resource_group, time.perf_counter() - bill_t0
                     )
                 except Exception:
                     pass  # billing must never fail the statement
@@ -3134,13 +3136,29 @@ class Session:
         columns into copies of only the touched blocks — O(touched data),
         not a whole-table rewrite through Python rows (reference: the
         write path touches only affected keys, pkg/executor/update.go).
-        Returns None to fall back (string SET columns: dictionary merge
-        needs the append path)."""
+        String SET columns stay columnar when every SET expression is a
+        constant already present in the column's dictionary (the common
+        `SET status = 'done'` shape): the scatter writes dictionary
+        codes, no remap. A constant the dictionary has never seen needs
+        the sorted-merge remap — that falls back to the rewrite path."""
         types = t.schema.types
-        if any(
-            c not in types or types[c].kind == Kind.STRING for c in sets
-        ):
+        if any(c not in types for c in sets):
             return None
+        str_codes = {}
+        for c, e in sets.items():
+            if types[c].kind != Kind.STRING:
+                continue
+            try:
+                v = self._const_value(e)
+            except Exception:
+                return None  # non-literal string SET: rewrite path
+            d = t.dictionaries.get(c)
+            if not isinstance(v, str) or d is None or not len(d):
+                return None
+            pos = int(np.searchsorted(d, v))
+            if pos >= len(d) or str(d[pos]) != v:
+                return None  # unseen value: needs a dictionary remap
+            str_codes[c] = pos
         if s.where is None or not t.blocks():
             return None
         relevant: set = set()
@@ -3169,36 +3187,41 @@ class Session:
         if affected == 0:
             return Result([], [], affected=0)
         # new values for matching rows only, cast to the column type,
-        # in scan (block-concatenation) order
-        set_cols = list(sets)
-        items = [
-            ast.SelectItem(
-                ast.Call("cast", [sets[c]], types[c]), alias=f"_s{i}"
-            )
-            for i, c in enumerate(set_cols)
-        ]
-        sel = ast.Select(
-            items=items,
-            from_=ast.TableRef(s.db, s.table, None),
-            where=s.where,
-        )
-        db = s.db or self.db
-        try:
-            plan = build_query(sel, self.catalog, db, self._scalar_subquery)
-            batch, _dicts = self.executor.run(plan)
-        except Exception:
-            return None
-        rv = np.asarray(batch.row_valid)
-        order = np.nonzero(rv)[0]
-        internals = [c.internal for c in plan.schema.cols]
+        # in scan (block-concatenation) order. Constant string SETs
+        # don't need the SELECT at all: their dictionary code scatters
+        # directly.
+        set_cols = [c for c in sets if c not in str_codes]
         new_data = {}
         new_valid = {}
-        for c, internal in zip(set_cols, internals):
-            dc = batch.cols[internal]
-            new_data[c] = np.asarray(dc.data)[order]
-            new_valid[c] = np.asarray(dc.valid)[order]
-        if len(order) != affected:
-            return None  # alignment lost (unexpected compaction) — fall back
+        if set_cols:
+            items = [
+                ast.SelectItem(
+                    ast.Call("cast", [sets[c]], types[c]), alias=f"_s{i}"
+                )
+                for i, c in enumerate(set_cols)
+            ]
+            sel = ast.Select(
+                items=items,
+                from_=ast.TableRef(s.db, s.table, None),
+                where=s.where,
+            )
+            db = s.db or self.db
+            try:
+                plan = build_query(
+                    sel, self.catalog, db, self._scalar_subquery
+                )
+                batch, _dicts = self.executor.run(plan)
+            except Exception:
+                return None
+            rv = np.asarray(batch.row_valid)
+            order = np.nonzero(rv)[0]
+            internals = [c.internal for c in plan.schema.cols]
+            for c, internal in zip(set_cols, internals):
+                dc = batch.cols[internal]
+                new_data[c] = np.asarray(dc.data)[order]
+                new_valid[c] = np.asarray(dc.valid)[order]
+            if len(order) != affected:
+                return None  # alignment lost — fall back
         new_blocks = []
         consumed = 0
         for block, m in zip(t.blocks(), masks):
@@ -3216,6 +3239,13 @@ class Session:
                     data.dtype
                 )
                 valid[pos] = new_valid[c][consumed : consumed + hit]
+                cols[c] = dataclasses.replace(src, data=data, valid=valid)
+            for c, code in str_codes.items():
+                src = block.columns[c]
+                data = src.data.copy()
+                valid = src.valid.copy()
+                data[pos] = np.asarray(code, dtype=data.dtype)
+                valid[pos] = True
                 cols[c] = dataclasses.replace(src, data=data, valid=valid)
             consumed += hit
             new_blocks.append(HostBlock(cols, block.nrows))

@@ -48,8 +48,16 @@ class ResourceGroupManager:
             "default": ResourceGroup("default", None, True)
         }
 
+    @staticmethod
+    def _check_rate(ru_per_sec):
+        # 0 would alias the unlimited sentinel's falsy checks — and a
+        # zero fill rate means "never run", which is a DROP, not a group
+        if ru_per_sec is not None and ru_per_sec < 1:
+            raise ValueError("RU_PER_SEC must be >= 1")
+
     def create(self, name, ru_per_sec, burstable, if_not_exists=False):
         name = name.lower()
+        self._check_rate(ru_per_sec)
         with self._lock:
             if name in self.groups:
                 if if_not_exists:
@@ -58,6 +66,7 @@ class ResourceGroupManager:
             self.groups[name] = ResourceGroup(name, ru_per_sec, burstable)
 
     def alter(self, name, ru_per_sec=None, burstable=None):
+        self._check_rate(ru_per_sec)
         with self._lock:
             g = self.groups.get(name.lower())
             if g is None:
@@ -88,8 +97,13 @@ class ResourceGroupManager:
     def acquire(self, name: str, kill_check=None, max_wait_s: float = 60.0):
         """Block while the group's bucket is negative (prior statements
         overdrew it). Returns the seconds waited — surfaced in the slow
-        log the way the reference reports RU wait time."""
-        g = self.get(name)
+        log the way the reference reports RU wait time. A group dropped
+        while sessions were still bound to it degrades to no-throttle
+        (the session can then SET RESOURCE GROUP to rebind) rather than
+        wedging every subsequent statement."""
+        g = self.groups.get(name.lower())
+        if g is None:
+            return 0.0
         t0 = time.monotonic()
         while True:
             with self._lock:

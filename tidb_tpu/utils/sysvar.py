@@ -103,8 +103,69 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
         SysVarDef("max_execution_time", 0, "both", _int_range(0, 1 << 31),
                   "per-statement wall-clock limit in ms (0 = unlimited); "
                   "runaway statements abort at the next kill safepoint"),
+        # concurrency knobs: accepted for compatibility — device kernels
+        # are already parallel, so these validate + round-trip but the
+        # executor does not fan out host threads per statement
+        SysVarDef("tidb_hash_join_concurrency", -1, "both", _int_range(-1, 256)),
+        SysVarDef("tidb_index_lookup_concurrency", -1, "both", _int_range(-1, 256)),
+        SysVarDef("tidb_index_serial_scan_concurrency", 1, "both", _int_range(1, 256)),
+        SysVarDef("tidb_distsql_scan_concurrency", 15, "both", _int_range(1, 256)),
+        SysVarDef("tidb_build_stats_concurrency", 4, "both", _int_range(1, 256)),
+        SysVarDef("tidb_projection_concurrency", -1, "both", _int_range(-1, 256)),
+        SysVarDef("tidb_window_concurrency", -1, "both", _int_range(-1, 256)),
+        # engine-behavior flags accepted for compatibility (always-on or
+        # by-design-different behaviors documented per entry)
+        SysVarDef("tidb_enable_vectorized_expression", True, "both", _bool,
+                  "always on: every expression lowers to fused XLA kernels"),
+        SysVarDef("tidb_enable_clustered_index", "ON", "both",
+                  _enum("ON", "OFF", "INT_ONLY"),
+                  "accepted; storage is columnar with sorted-permutation "
+                  "indexes, clustering is implicit"),
+        SysVarDef("tidb_enable_async_commit", True, "both", _bool,
+                  "accepted; single-process commits are atomic swaps"),
+        SysVarDef("tidb_enable_1pc", True, "both", _bool),
+        SysVarDef("tidb_row_format_version", 2, "both", _int_range(1, 2)),
+        SysVarDef("tidb_enable_chunk_rpc", True, "both", _bool),
+        SysVarDef("tidb_opt_agg_push_down", False, "both", _bool),
+        SysVarDef("tidb_opt_distinct_agg_push_down", False, "both", _bool),
+        SysVarDef("tidb_enable_index_merge", True, "both", _bool),
+        SysVarDef("tidb_enable_stmt_summary", True, "both", _bool),
+        SysVarDef("tidb_enable_collect_execution_info", True, "both", _bool),
+        SysVarDef("tidb_retry_limit", 10, "both", _int_range(0, 1000)),
+        SysVarDef("tidb_constraint_check_in_place", True, "both", _bool,
+                  "always in place: uniqueness checks run on the append "
+                  "path, there is no deferred prewrite"),
+        SysVarDef("tidb_ddl_error_count_limit", 512, "both", _int_range(1, 1 << 20)),
+        SysVarDef("tidb_max_chunk_size", 1024, "both", _int_range(32, 1 << 20)),
+        SysVarDef("tidb_init_chunk_size", 32, "both", _int_range(1, 32)),
         # MySQL compatibility
         SysVarDef("autocommit", True, "both", _bool),
+        SysVarDef("sql_select_limit", 2 ** 64 - 1, "both", _int_range(0, 2 ** 64 - 1)),
+        SysVarDef("wait_timeout", 28800, "both", _int_range(0, 31536000)),
+        SysVarDef("interactive_timeout", 28800, "both", _int_range(1, 31536000)),
+        SysVarDef("net_write_timeout", 60, "both", _int_range(1, 31536000)),
+        SysVarDef("net_read_timeout", 30, "both", _int_range(1, 31536000)),
+        SysVarDef("lower_case_table_names", 2, "readonly"),
+        SysVarDef("default_storage_engine", "InnoDB", "readonly"),
+        SysVarDef("character_set_server", "utf8mb4", "both"),
+        SysVarDef("character_set_client", "utf8mb4", "both"),
+        SysVarDef("character_set_results", "utf8mb4", "both"),
+        SysVarDef("character_set_database", "utf8mb4", "both"),
+        SysVarDef("collation_server", "utf8mb4_bin", "both"),
+        SysVarDef("collation_database", "utf8mb4_bin", "both"),
+        SysVarDef("system_time_zone", "UTC", "readonly"),
+        SysVarDef("init_connect", "", "both"),
+        SysVarDef("license", "Apache License 2.0", "readonly"),
+        SysVarDef("port", 4000, "readonly"),
+        SysVarDef("socket", "", "readonly"),
+        SysVarDef("innodb_buffer_pool_size", 134217728, "readonly"),
+        SysVarDef("max_connections", 0, "both", _int_range(0, 100000)),
+        SysVarDef("sql_safe_updates", False, "both", _bool),
+        SysVarDef("foreign_key_checks", True, "both", _bool,
+                  "accepted; FK RESTRICT/CASCADE enforcement is active "
+                  "whenever constraints exist"),
+        SysVarDef("unique_checks", True, "both", _bool),
+        SysVarDef("group_concat_max_len", 1024, "both", _int_range(4, 1 << 30)),
         SysVarDef("sql_mode", "STRICT_TRANS_TABLES", "both"),
         SysVarDef("time_zone", "UTC", "both"),
         SysVarDef("max_allowed_packet", 64 << 20, "both", _int_range(1024, 1 << 30)),
