@@ -184,3 +184,59 @@ def test_streamed_order_by_null_keys(sess):
     streamed = sess.must_query(q).rows
     _set_stream(sess, 2_000_000)
     assert streamed == full
+
+
+class TestGraceHashPartitioned:
+    """Both-sides-big spill: grace-hash co-partitioning of self-joins
+    (reference: partitioned hash join spill, pkg/executor/join
+    hash_table spill + sort_partition.go)."""
+
+    def _mk(self, n=400_000):
+        import numpy as np
+
+        from tidb_tpu.chunk import HostBlock, column_from_values
+        from tidb_tpu.dtypes import INT64
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table e (k int, g int, v int)")
+        rng = np.random.default_rng(5)
+        t = s.catalog.table("test", "e")
+        t.replace_blocks([
+            HostBlock.from_columns({
+                "k": column_from_values(
+                    rng.integers(0, 40_000, n).tolist(), INT64
+                ),
+                "g": column_from_values(
+                    rng.integers(0, 7, n).tolist(), INT64
+                ),
+                "v": column_from_values(list(range(n)), INT64),
+            })
+        ])
+        return s
+
+    def test_partitioned_semi_join_parity(self):
+        from tidb_tpu.utils import failpoint
+
+        s = self._mk()
+        sql = (
+            "select g, count(*) from e a "
+            "where exists (select * from e b where b.k = a.k and b.v <> a.v) "
+            "group by g order by g"
+        )
+        expect = s.execute(sql).rows
+        hits = []
+        failpoint.enable("executor/partition-start", lambda: hits.append(1))
+        failpoint.enable("executor/partition-feed", lambda: hits.append(2))
+        try:
+            # the 16MB sysvar floor: both 400k-row self-join sides are
+            # "big" against it, forcing the grace-hash path
+            s.execute("set tidb_mem_quota_query = 16777216")
+            got = s.execute(sql).rows
+        finally:
+            failpoint.disable("executor/partition-start")
+            failpoint.disable("executor/partition-feed")
+            s.execute(f"set tidb_mem_quota_query = {64 << 30}")
+        assert got == expect
+        assert 1 in hits, "grace-hash path must engage under the quota"
+        assert hits.count(2) >= 2, "expected multiple hash partitions"

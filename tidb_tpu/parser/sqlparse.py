@@ -1603,7 +1603,16 @@ class Parser:
                     # BURSTABLE = TRUE|FALSE: the only way ALTER can
                     # REVOKE burstability
                     t = self.advance()
-                    burst = t.text.lower() in ("true", "1", "on")
+                    word = t.text.lower()
+                    if word in ("true", "1", "on"):
+                        burst = True
+                    elif word in ("false", "0", "off"):
+                        burst = False
+                    else:
+                        raise ParseError(
+                            f"BURSTABLE expects TRUE or FALSE, got "
+                            f"{t.text!r} at {t.pos}"
+                        )
             else:
                 return ru, burst
 

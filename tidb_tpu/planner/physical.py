@@ -1669,12 +1669,31 @@ class PhysicalExecutor:
 
         failpoint.inject("executor/before-discover")
         caps = dict(cq.caps or cq.default_caps)
+        defaulted = []
         for nid, c in caps.items():
             if c == 0:  # join knobs start at the dominant input tile
                 d = _join_default(inputs, cq)
                 if jit and self.mesh_n:
                     d = _cap_tile(max(d // self.mesh_n, 1024))
                 caps[nid] = d
+                defaulted.append(nid)
+        if self.quota_bytes and defaulted:
+            # under a memory quota, DEFAULT tiles must not fail
+            # admission on their own: start small enough to fit and let
+            # the overflow loop grow each knob only as the data proves
+            # necessary — every growth re-admits, so a genuinely
+            # over-quota cardinality still errors with the tracker
+            # report (reference: quota actions escalate before failing,
+            # pkg/util/memory/action.go). Only _join_default guesses are
+            # clamped — capacities a previous execution DISCOVERED are
+            # known-needed; re-clamping them would force a re-discovery
+            # launch on every run
+            share = max(int(self.quota_bytes) // (4 * len(caps)), 1)
+            for nid in defaulted:
+                w = cq.widths.get(nid, 64)
+                lim = _cap_tile(max(share // (2 * max(w, 1)), 1024))
+                if caps[nid] > lim:
+                    caps[nid] = lim
         while True:
             if self.kill_check is not None:
                 self.kill_check()
@@ -1715,7 +1734,7 @@ class PhysicalExecutor:
 
     def run(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts]:
         from tidb_tpu.planner.hostagg import try_host_agg
-        from tidb_tpu.planner.streamed import try_streamed
+        from tidb_tpu.planner.streamed import try_partitioned, try_streamed
         from tidb_tpu.utils.metrics import REGISTRY
 
         # stale-width retry: programs bake integer key bounds as static
@@ -1732,6 +1751,11 @@ class PhysicalExecutor:
                 streamed = try_streamed(self, plan, conservative=conservative)
                 if streamed is not None:
                     return streamed
+                parted = try_partitioned(
+                    self, plan, conservative=conservative
+                )
+                if parted is not None:
+                    return parted
 
                 key = self._cache_key(plan)
                 cq = None if conservative else self._cache.get(key)
@@ -1764,6 +1788,11 @@ class PhysicalExecutor:
                             self, plan, conservative=conservative,
                             force=True,
                         )
+                        if forced is None:
+                            forced = try_partitioned(
+                                self, plan, conservative=conservative,
+                                force=True,
+                            )
                         if forced is not None:
                             return forced
                     raise

@@ -438,56 +438,83 @@ def try_streamed(
         # one fixed tile for every chunk: all chunks share one compiled
         # program (the last, shorter chunk pads up to the same tile)
         chunk_tile = pad_capacity(chunk_rows)
-        cap = 1024
-        # pipeline capacity knobs (join output tiles): start at the
-        # chunk tile (or the previous execute's discovered vector), grow
-        # on per-chunk overflow like the discovery loop
-        caps = dict(sp.caps) if sp.caps else {
-            nid: chunk_tile for nid in sp.sized
-        }
-        partial_batches: List[Batch] = []
-        for hb in _chunk_blocks(
-            t, v, sp.big_site.columns, chunk_rows,
-            partitions=sp.big_site.partitions,
-        ):
-            inject("executor/stream-chunk")
-            if executor.kill_check is not None:
-                executor.kill_check()
-            chunk = block_to_batch(hb, capacity=chunk_tile)
-            inputs = dict(inputs_base)
-            inputs[sp.big_site.node_id] = chunk
-            for _retry in range(24):
-                out, ng, needs = sp.chunk_step(cap, caps)(
-                    inputs, executor._params()
-                )
-                got = jax.device_get((ng, needs))
-                ngi = int(got[0])
-                if ngi >= WIDTH_STALE:
-                    raise StaleWidthsError()
-                bumped = False
-                for nid, n in got[1].items():
-                    n = int(n)
-                    if n >= WIDTH_STALE:
-                        raise StaleWidthsError()
-                    if nid in caps and n > caps[nid]:
-                        caps[nid] = pad_capacity(n, floor=16, pow2=True)
-                        bumped = True
-                if bumped:
-                    continue
-                # overflow whenever the true group count exceeds the
-                # batch the kernel emitted (tile size differs by path:
-                # 2x cap for hash tables, 1x for dense compaction)
-                if key_fns and ngi > out.capacity:
-                    cap = cap * 2  # partial table overflowed: retry bigger
-                    continue
-                break
-            else:
-                raise StaleWidthsError()  # capacities never converged
-            partial_batches.append(out)
-        sp.caps = dict(caps)  # discovered capacities stick for reuse
+
+        def feeds():
+            for hb in _chunk_blocks(
+                t, v, sp.big_site.columns, chunk_rows,
+                partitions=sp.big_site.partitions,
+            ):
+                inject("executor/stream-chunk")
+                chunk = block_to_batch(hb, capacity=chunk_tile)
+                inputs = dict(inputs_base)
+                inputs[sp.big_site.node_id] = chunk
+                yield inputs
+
+        partial_batches, cap = _drain_partials(
+            executor, sp, feeds(), key_fns, default_tile=chunk_tile
+        )
     finally:
         for pt, pv in pins:
             pt.unpin(pv)
+
+    return _finalize_partials(
+        executor, plan, agg, sp, partial_batches, cap, dicts, key_fns
+    )
+
+
+def _drain_partials(executor, sp, feeds, key_fns, default_tile):
+    """Run the compiled pipeline + partial aggregation over each input
+    feed (one chunk or one hash partition), growing capacity knobs on
+    overflow exactly like the discovery loop. Returns (partial batches,
+    final partial-table cap)."""
+    from tidb_tpu.planner.physical import StaleWidthsError
+
+    cap = 1024
+    caps = dict(sp.caps) if sp.caps else {
+        nid: default_tile for nid in sp.sized
+    }
+    partial_batches: List[Batch] = []
+    for inputs in feeds:
+        if executor.kill_check is not None:
+            executor.kill_check()
+        for _retry in range(24):
+            out, ng, needs = sp.chunk_step(cap, caps)(
+                inputs, executor._params()
+            )
+            got = jax.device_get((ng, needs))
+            ngi = int(got[0])
+            if ngi >= WIDTH_STALE:
+                raise StaleWidthsError()
+            bumped = False
+            for nid, n in got[1].items():
+                n = int(n)
+                if n >= WIDTH_STALE:
+                    raise StaleWidthsError()
+                if nid in caps and n > caps[nid]:
+                    caps[nid] = pad_capacity(n, floor=16, pow2=True)
+                    bumped = True
+            if bumped:
+                continue
+            # overflow whenever the true group count exceeds the
+            # batch the kernel emitted (tile size differs by path:
+            # 2x cap for hash tables, 1x for dense compaction)
+            if key_fns and ngi > out.capacity:
+                cap = cap * 2  # partial table overflowed: retry bigger
+                continue
+            break
+        else:
+            raise StaleWidthsError()  # capacities never converged
+        partial_batches.append(out)
+    sp.caps = dict(caps)  # discovered capacities stick for reuse
+    return partial_batches, cap
+
+
+def _finalize_partials(
+    executor, plan, agg, sp, partial_batches, cap, dicts, key_fns
+):
+    """Merge partial aggregates into the final stage, inject the result
+    as a Staged node, and run the remainder of the plan."""
+    from tidb_tpu.planner.physical import StaleWidthsError, agg_out_dicts
 
     combined = _concat_batches(partial_batches)
 
@@ -541,6 +568,302 @@ def try_streamed(
     else:
         new_plan = _replace_node(plan, agg, staged)
     return executor.run(new_plan)
+
+
+def _trace_col(p, name: str):
+    """Descend Selection/Projection/Join chains to the Scan producing
+    internal column `name`; returns (scan, bare column) or None (the
+    column is computed, not a bare scan column)."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    while True:
+        if isinstance(p, L.Selection):
+            p = p.child
+            continue
+        if isinstance(p, L.Projection):
+            m = dict(p.exprs)
+            e = m.get(name)
+            if e is None:
+                if p.additive:
+                    p = p.child
+                    continue
+                return None
+            if isinstance(e, ColumnRef):
+                name = e.name
+                p = p.child
+                continue
+            return None
+        if isinstance(p, L.Scan):
+            pref = p.alias + "."
+            if name.startswith(pref) and name[len(pref):] in p.columns:
+                return p, name[len(pref):]
+            return None
+        if isinstance(p, L.JoinPlan):
+            hit = _trace_col(p.left, name)
+            return hit if hit is not None else _trace_col(p.right, name)
+        return None
+
+
+def _derive_partition_cols(p, big_aliases: set, out: dict) -> bool:
+    """Walk the join tree assigning one hash-partition column to every
+    big scan via the equi keys of joins whose BOTH subtrees hold big
+    scans (the grace-hash co-partitioning condition). Returns False when
+    any such join cannot be co-partitioned (non-equi, null-aware NOT IN,
+    or a key that does not trace to a bare big-scan column)."""
+    from tidb_tpu.expression.expr import ColumnRef
+
+    def walk(p) -> Optional[set]:
+        if isinstance(p, (L.Selection, L.Projection)):
+            return walk(p.child)
+        if isinstance(p, L.Scan):
+            return {p.alias} if p.alias in big_aliases else set()
+        if isinstance(p, L.Staged):
+            return set()
+        if isinstance(p, L.JoinPlan):
+            lb = walk(p.left)
+            rb = walk(p.right)
+            if lb is None or rb is None:
+                return None
+            if lb and rb:
+                if (
+                    p.null_aware
+                    or not p.equi_keys
+                    or p.kind not in ("inner", "left", "semi", "anti", "mark")
+                ):
+                    return None
+                lk, rk = p.equi_keys[0]
+                if not (
+                    isinstance(lk, ColumnRef) and isinstance(rk, ColumnRef)
+                ):
+                    return None
+                for key, side, bigs in ((lk, p.left, lb), (rk, p.right, rb)):
+                    hit = _trace_col(side, key.name)
+                    if hit is None:
+                        return None
+                    scan, col = hit
+                    if scan.alias not in big_aliases:
+                        # the join key lives on a small scan while this
+                        # subtree holds a DIFFERENT big one: that big is
+                        # not co-partitioned by this join
+                        return None
+                    if out.get(scan.alias, col) != col:
+                        return None  # conflicting partition columns
+                    out[scan.alias] = col
+            return lb | rb
+        return None
+
+    return walk(p) is not None
+
+
+def _partition_assignment(t, v, col: str, K: int, partitions=None):
+    """Per-block arrays of hash-partition ids for `col` (NULLs land in
+    partition 0 — they never equi-match, and probe-side NULL rows must
+    still appear exactly once)."""
+    out = []
+    for b in t.blocks(v, partitions=partitions):
+        hc = b.columns.get(col)
+        if hc is None:
+            out.append(np.zeros(b.nrows, dtype=np.int64))
+            continue
+        vals = hc.data
+        if np.issubdtype(vals.dtype, np.floating):
+            v64 = vals.astype(np.float64, copy=True)
+            v64[v64 == 0.0] = 0.0  # -0.0 equi-matches 0.0: same partition
+            vals = v64.view(np.int64)
+        h = vals.astype(np.uint64, copy=False) * np.uint64(
+            0x9E3779B97F4A7C15
+        )
+        part = ((h >> np.uint64(33)) % np.uint64(K)).astype(np.int64)
+        part[~hc.valid] = 0
+        out.append(part)
+    return out
+
+
+def _gather_partition(t, v, columns, assign, k, partitions=None) -> HostBlock:
+    """One hash partition of a table as a single HostBlock."""
+    cols: dict = {c: ([], []) for c in columns}
+    dicts: dict = {}
+    n = 0
+    for b, pa in zip(t.blocks(v, partitions=partitions), assign):
+        idx = np.nonzero(pa == k)[0]
+        n += len(idx)
+        for c in columns:
+            hc = b.columns.get(c)
+            if hc is None:
+                cols[c][0].append(np.zeros(len(idx), dtype=np.int64))
+                cols[c][1].append(np.zeros(len(idx), dtype=bool))
+            else:
+                cols[c][0].append(hc.data[idx])
+                cols[c][1].append(hc.valid[idx])
+                if hc.dictionary is not None:
+                    dicts[c] = hc.dictionary
+    from tidb_tpu.chunk import HostColumn
+
+    types = t.schema.types
+    built = {
+        c: HostColumn(
+            types[c],
+            np.concatenate(d) if d else np.zeros(0, dtype=np.int64),
+            np.concatenate(vm) if vm else np.zeros(0, dtype=bool),
+            dicts.get(c),
+        )
+        for c, (d, vm) in cols.items()
+    }
+    return HostBlock(built, n)
+
+
+def try_partitioned(
+    executor, plan, conservative=False, force=False
+) -> Optional[Tuple[Batch, dict]]:
+    """Grace-hash spill: when TWO OR MORE pipeline tables exceed the
+    memory budget (lineitem self-joins in EXISTS chains, partsupp
+    vs partsupp minima), hash-partition every big table on its equi-join
+    key into K co-partitions, run the whole compiled pipeline + partial
+    aggregation once per partition, and final-merge — the TPU analog of
+    the reference's spill-to-disk partitioned hash join
+    (pkg/executor/join hash_table spill). Single-big shapes use
+    try_streamed (row chunking, no key requirement); this path needs
+    key co-location, which row chunks cannot give the build side."""
+    threshold = getattr(executor, "stream_rows", None)
+    if not threshold or executor.mesh is not None:
+        return None
+    m = _pipeline_below(plan)
+    if m is None:
+        return None
+    agg, scans, flags = m
+    if any(s.alias is None for s in scans):
+        return None
+    budget = _device_budget()
+    q = getattr(executor, "quota_bytes", None)
+    if q:
+        budget = min(budget, int(q))
+    resolved = [executor._resolve(s.db, s.table) for s in scans]
+    sizes = [
+        t.nrows * _row_bytes(t, v, s.columns)
+        for s, (t, v) in zip(scans, resolved)
+    ]
+    # auto mode: a table is "big" when its working set overruns the
+    # budget. force mode (the unpaged plan ALREADY failed admission):
+    # partition anything that meaningfully contributes, since join tiles
+    # — not raw scan bytes — blew the budget
+    bar = budget // 8 if force else budget // 4
+    bigs = [i for i, sz in enumerate(sizes) if sz > bar]
+    if len(bigs) < 2:
+        return None  # zero/one big side: try_streamed's territory
+    big_aliases = {scans[i].alias for i in bigs}
+    partcols: dict = {}
+    if not _derive_partition_cols(agg.child, big_aliases, partcols):
+        return None
+    if set(partcols) != big_aliases:
+        return None  # some big scan never meets another big via a key
+    # dictionary-coded partition keys (strings/enums) hash per-table
+    # CODES: comparable only when every co-partitioned scan reads the
+    # SAME table (self-joins share one table-global dictionary). A
+    # cross-table string key would send equal values to different
+    # partitions — decline rather than silently drop matches.
+    if len({scans[i].table.lower() for i in bigs}) > 1:
+        for i in bigs:
+            t_i, v_i = resolved[i]
+            if t_i.dictionaries.get(partcols[scans[i].alias]) is not None:
+                return None
+    big_bytes = sum(sizes[i] for i in bigs)
+    K = 2
+    while K < 64 and (big_bytes * 4) // K > budget:
+        K *= 2
+
+    from tidb_tpu.planner.physical import StaleWidthsError
+    from tidb_tpu.utils.failpoint import inject
+
+    sp = _stream_plan(
+        executor, plan, agg, scans[bigs[0]], conservative=conservative
+    )
+    if sp is None:
+        return None
+    all_sites = [sp.big_site] + sp.other_sites
+    if any(
+        s.pk_range is not None
+        for s in all_sites
+        if s.alias in partcols
+    ):
+        return None  # index-range pushdown on a partitioned site
+    dicts, key_fns = sp.dicts, sp.key_fns
+
+    pins = []
+    try:
+        site_tables = {}
+        for s in all_sites:
+            st, sv = executor._resolve(s.db, s.table)
+            for _ in range(8):
+                if st.pin_verified(sv):
+                    break
+                st, sv = executor._resolve(s.db, s.table)
+            else:
+                return None
+            pins.append((st, sv))
+            site_tables[s.node_id] = (st, sv)
+        for nid, coln in sp.nonnull:
+            st, sv = site_tables.get(nid, (None, None))
+            if st is not None and st.col_has_nulls(coln, sv):
+                raise StaleWidthsError()
+
+        # per-site partition assignment + tile (max partition size)
+        assigns = {}
+        tiles = {}
+        resident = {}
+        part_bytes = 0
+        for s in all_sites:
+            st, sv = site_tables[s.node_id]
+            if s.alias in partcols:
+                a = _partition_assignment(
+                    st, sv, partcols[s.alias], K, partitions=s.partitions
+                )
+                counts = np.zeros(K, dtype=np.int64)
+                for pa in a:
+                    counts += np.bincount(pa, minlength=K)
+                assigns[s.node_id] = a
+                tiles[s.node_id] = pad_capacity(int(counts.max()) or 1)
+                part_bytes += tiles[s.node_id] * _row_bytes(
+                    st, sv, s.columns
+                )
+        # key skew check: a hot key can put ~everything in one partition
+        # — running that would silently defeat the quota; decline and
+        # let admission's rejection (with its tracker report) stand
+        if part_bytes * 4 > budget * 2:
+            return None
+        for s in all_sites:
+            st, sv = site_tables[s.node_id]
+            if s.alias not in partcols:
+                resident[s.node_id] = _fetch_resident(executor, s, st, sv)
+
+        inject("executor/partition-start")  # the path is committed
+
+        def feeds():
+            for k in range(K):
+                inject("executor/partition-feed")
+                inputs = dict(resident)
+                for s in all_sites:
+                    if s.node_id in assigns:
+                        st, sv = site_tables[s.node_id]
+                        hb = _gather_partition(
+                            st, sv, s.columns, assigns[s.node_id], k,
+                            partitions=s.partitions,
+                        )
+                        inputs[s.node_id] = block_to_batch(
+                            hb, capacity=tiles[s.node_id]
+                        )
+                yield inputs
+
+        partial_batches, cap = _drain_partials(
+            executor, sp, feeds(), key_fns,
+            default_tile=max(tiles.values()),
+        )
+    finally:
+        for pt, pv in pins:
+            pt.unpin(pv)
+
+    return _finalize_partials(
+        executor, plan, agg, sp, partial_batches, cap, dicts, key_fns
+    )
 
 
 class _SortStreamPlan:
