@@ -222,3 +222,41 @@ def test_secreted_client_works_with_open_server(sess):
         client.close()
     finally:
         srv.shutdown()
+
+
+class TestSchemaLease:
+    """Schema-version validation on the RPC seam (reference: domain
+    schema lease — 'Information schema is out of date')."""
+
+    @pytest.fixture()
+    def engine(self, sess):
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        yield srv
+        srv.shutdown()
+
+    def test_stale_schema_version_rejected(self, sess, engine):
+        from tidb_tpu.server.engine_rpc import SchemaOutOfDateError
+
+        client = EngineClient("127.0.0.1", engine.port)
+        try:
+            plan = build_query(
+                parse("select count(*) from t")[0], sess.catalog, "test",
+                sess._scalar_subquery,
+            )
+            v = sess.catalog.schema_version
+            cols, rows = client.execute_plan(plan, schema_version=v)
+            assert rows  # matching lease executes
+            # DDL on the engine side moves the schema version: the old
+            # lease must be rejected, the refreshed one accepted
+            sess.execute("create table lease_probe (x int)")
+            with pytest.raises(SchemaOutOfDateError, match="out of date"):
+                client.execute_plan(plan, schema_version=v)
+            cols, rows = client.execute_plan(
+                plan, schema_version=sess.catalog.schema_version
+            )
+            assert rows
+            # versionless requests keep working (lease check is opt-in)
+            client.execute_plan(plan)
+        finally:
+            client.close()

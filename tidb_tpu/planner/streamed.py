@@ -649,6 +649,15 @@ def _derive_partition_cols(p, big_aliases: set, out: dict) -> bool:
                     if out.get(scan.alias, col) != col:
                         return None  # conflicting partition columns
                     out[scan.alias] = col
+            elif rb and not lb and p.kind in ("left", "anti", "mark"):
+                # partitioned bigs ONLY on the build side while the
+                # PRESERVED/probe side is resident (replicated to every
+                # partition feed): an unmatched probe row would be
+                # left-NULL/anti-emitted once PER FEED — duplicated
+                # results. (inner/semi stay correct: a probe row's
+                # matches all live in one partition; cross unions
+                # cleanly; bigs-on-probe-side is fine for every kind.)
+                return None
             return lb | rb
         return None
 
@@ -656,36 +665,45 @@ def _derive_partition_cols(p, big_aliases: set, out: dict) -> bool:
 
 
 def _partition_assignment(t, v, col: str, K: int, partitions=None):
-    """Per-block arrays of hash-partition ids for `col` (NULLs land in
-    partition 0 — they never equi-match, and probe-side NULL rows must
-    still appear exactly once)."""
+    """Per-block (stable partition-sorted row order, K+1 slice starts,
+    per-partition counts): ONE argsort pass per block yields every
+    partition's row indices as a slice — gathering K partitions costs
+    O(N log N) total, not K full scans. NULLs land in partition 0 (they
+    never equi-match, and probe-side NULL rows must still appear exactly
+    once)."""
     out = []
     for b in t.blocks(v, partitions=partitions):
         hc = b.columns.get(col)
         if hc is None:
-            out.append(np.zeros(b.nrows, dtype=np.int64))
-            continue
-        vals = hc.data
-        if np.issubdtype(vals.dtype, np.floating):
-            v64 = vals.astype(np.float64, copy=True)
-            v64[v64 == 0.0] = 0.0  # -0.0 equi-matches 0.0: same partition
-            vals = v64.view(np.int64)
-        h = vals.astype(np.uint64, copy=False) * np.uint64(
-            0x9E3779B97F4A7C15
-        )
-        part = ((h >> np.uint64(33)) % np.uint64(K)).astype(np.int64)
-        part[~hc.valid] = 0
-        out.append(part)
+            part = np.zeros(b.nrows, dtype=np.int64)
+        else:
+            vals = hc.data
+            if np.issubdtype(vals.dtype, np.floating):
+                v64 = vals.astype(np.float64, copy=True)
+                v64[v64 == 0.0] = 0.0  # -0.0 equi-matches 0.0
+                vals = v64.view(np.int64)
+            h = vals.astype(np.uint64, copy=False) * np.uint64(
+                0x9E3779B97F4A7C15
+            )
+            part = ((h >> np.uint64(33)) % np.uint64(K)).astype(np.int64)
+            part[~hc.valid] = 0
+        order = np.argsort(part, kind="stable")
+        counts = np.bincount(part, minlength=K)
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        out.append((order, starts, counts))
     return out
 
 
 def _gather_partition(t, v, columns, assign, k, partitions=None) -> HostBlock:
-    """One hash partition of a table as a single HostBlock."""
+    """One hash partition of a table as a single HostBlock (slicing the
+    precomputed partition-sorted order)."""
     cols: dict = {c: ([], []) for c in columns}
     dicts: dict = {}
     n = 0
-    for b, pa in zip(t.blocks(v, partitions=partitions), assign):
-        idx = np.nonzero(pa == k)[0]
+    for b, (order, starts, _counts) in zip(
+        t.blocks(v, partitions=partitions), assign
+    ):
+        idx = order[starts[k]:starts[k + 1]]
         n += len(idx)
         for c in columns:
             hc = b.columns.get(c)
@@ -756,16 +774,27 @@ def try_partitioned(
         return None
     if set(partcols) != big_aliases:
         return None  # some big scan never meets another big via a key
-    # dictionary-coded partition keys (strings/enums) hash per-table
-    # CODES: comparable only when every co-partitioned scan reads the
-    # SAME table (self-joins share one table-global dictionary). A
-    # cross-table string key would send equal values to different
-    # partitions — decline rather than silently drop matches.
-    if len({scans[i].table.lower() for i in bigs}) > 1:
-        for i in bigs:
-            t_i, v_i = resolved[i]
-            if t_i.dictionaries.get(partcols[scans[i].alias]) is not None:
-                return None
+    # partition hashing happens on the RAW stored representation, so all
+    # co-partitioned keys must share one representation:
+    # - dictionary codes are per-table (self-joins share one dict; a
+    #   cross-table string key would split equal values), and
+    # - numeric keys must agree on (kind, scale): the compare kernels
+    #   rescale decimal(10,2) vs decimal(10,4) to match, but raw scaled
+    #   ints 500 vs 50000 hash apart.
+    # Decline rather than silently drop matches.
+    key_types = set()
+    for i in bigs:
+        t_i, _v_i = resolved[i]
+        col = partcols[scans[i].alias]
+        ty = t_i.schema.types[col]
+        key_types.add((ty.kind, ty.scale))
+        if (
+            t_i.dictionaries.get(col) is not None
+            and len({scans[j].table.lower() for j in bigs}) > 1
+        ):
+            return None
+    if len(key_types) > 1:
+        return None
     big_bytes = sum(sizes[i] for i in bigs)
     K = 2
     while K < 64 and (big_bytes * 4) // K > budget:
@@ -818,8 +847,8 @@ def try_partitioned(
                     st, sv, partcols[s.alias], K, partitions=s.partitions
                 )
                 counts = np.zeros(K, dtype=np.int64)
-                for pa in a:
-                    counts += np.bincount(pa, minlength=K)
+                for _order, _starts, c in a:
+                    counts += c
                 assigns[s.node_id] = a
                 tiles[s.node_id] = pad_capacity(int(counts.max()) or 1)
                 part_bytes += tiles[s.node_id] * _row_bytes(

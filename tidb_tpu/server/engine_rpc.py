@@ -34,6 +34,14 @@ from tidb_tpu.planner.ir import IR_VERSION, plan_from_ir, plan_to_ir
 MAX_FRAME = 64 << 20
 
 
+class SchemaOutOfDateError(RuntimeError):
+    """The frontend planned against a schema version the engine has
+    moved past (or not yet reached) — the analog of the domain schema
+    lease check ('Information schema is out of date',
+    pkg/domain/domain.go lease validation). The frontend must reload
+    schemas and re-plan."""
+
+
 def _send_frame(sock, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame of {len(payload)}B exceeds {MAX_FRAME}B")
@@ -156,6 +164,16 @@ class EngineServer:
 
         if req.get("v") != IR_VERSION:
             raise ValueError(f"unsupported IR version {req.get('v')}")
+        if "schema_v" in req:
+            # schema-lease validation: a plan bound against stale
+            # schemas must not execute — name/column resolution could
+            # silently hit the wrong physical layout
+            engine_v = getattr(self.catalog, "schema_version", 0)
+            if int(req["schema_v"]) != int(engine_v):
+                raise SchemaOutOfDateError(
+                    f"schema out of date: engine at version {engine_v}, "
+                    f"client planned at {req['schema_v']}; reload schemas"
+                )
         plan = plan_from_ir(req["plan"])
         batch, dicts = executor.run(plan)
         rows = materialize_rows(batch, list(plan.schema), dicts)
@@ -240,10 +258,18 @@ class EngineClient:
             )
         return resp
 
-    def execute_plan(self, plan) -> Tuple[List[str], List[tuple]]:
-        resp = self._call({"v": IR_VERSION, "plan": plan_to_ir(plan)})
+    def execute_plan(
+        self, plan, schema_version: Optional[int] = None
+    ) -> Tuple[List[str], List[tuple]]:
+        req = {"v": IR_VERSION, "plan": plan_to_ir(plan)}
+        if schema_version is not None:
+            req["schema_v"] = int(schema_version)
+        resp = self._call(req)
         if not resp.get("ok"):
-            raise RuntimeError(f"engine error: {resp.get('error')}")
+            err = str(resp.get("error", ""))
+            if "SchemaOutOfDateError" in err:
+                raise SchemaOutOfDateError(err)
+            raise RuntimeError(f"engine error: {err}")
         return resp["columns"], [tuple(r) for r in resp["rows"]]
 
     def close(self) -> None:
