@@ -75,6 +75,8 @@ class Func(Expr):
 
 
 ARITH = {"add", "sub", "mul", "div", "intdiv", "mod"}
+#: bitwise binary ops: operands coerce to BIGINT (MySQL semantics)
+BITOPS = {"bit_and", "bit_or", "bit_xor", "shl", "shr"}
 COMPARE = {"eq", "ne", "lt", "le", "gt", "ge"}
 LOGIC = {"and", "or"}
 
@@ -218,6 +220,8 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         return INT64
     if op == "mod":
         return common_type(ts[0], ts[1])
+    if op in BITOPS or op == "bit_neg":
+        return INT64
     if op == "neg":
         return ts[0]
     if op in {"coalesce", "ifnull"}:

@@ -28,6 +28,7 @@ from tidb_tpu.chunk import Batch, DevCol
 from tidb_tpu.dtypes import FLOAT64, Kind, SQLType
 from tidb_tpu.expression.expr import (
     ARITH,
+    BITOPS,
     COMPARE,
     ColumnRef,
     Expr,
@@ -53,6 +54,23 @@ def _to_float(data, t: SQLType):
     if t.kind == Kind.DECIMAL:
         return data.astype(jnp.float64) / (10**t.scale)
     return data.astype(jnp.float64)
+
+
+def _to_bigint(data, t: SQLType):
+    """Coerce one operand to BIGINT the way MySQL does for bit
+    operators: decimals/floats round HALF AWAY FROM ZERO (the engine's
+    DECIMAL rounding rule — jnp.round's half-to-even would turn
+    2.5 & 7 into 2). Decimals stay in exact integer math: a float64
+    round-trip would lose the low-order bits a bit operator reads."""
+    if t is not None and t.kind == Kind.DECIMAL and t.scale:
+        d = data.astype(jnp.int64)
+        q = jnp.int64(10 ** t.scale)
+        return jnp.sign(d) * ((jnp.abs(d) + q // 2) // q)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return (jnp.sign(data) * jnp.floor(jnp.abs(data) + 0.5)).astype(
+            jnp.int64
+        )
+    return data.astype(jnp.int64)
 
 
 def _numeric_align(a, ta: SQLType, b, tb: SQLType, target: SQLType):
@@ -841,8 +859,17 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     assert isinstance(e, Func)
     op = e.op
 
-    if op in ARITH or op in COMPARE:
+    if op in ARITH or op in COMPARE or op in BITOPS:
         return _compile_binary(e, dicts)
+    if op == "bit_neg":
+        (a,) = [_compile(x, dicts) for x in e.args]
+        ta = e.args[0].type
+
+        def _bneg(b):
+            c = a(b)
+            return DevCol(~_to_bigint(c.data, ta), c.valid)
+
+        return _bneg
     if op in ("and", "or"):
         return _compile_logic(e, dicts)
     if op == "not":
@@ -1282,13 +1309,17 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
         # align operands at their common type; equal decimal scales cancel
         # in the quotient and are preserved in the remainder.
         target = common_type(ta, tb)
+    elif op in BITOPS:
+        target = None  # each operand coerces to BIGINT independently
     else:
         target = e.type
 
     def _bin(b):
         a, c = fa(b), fb(b)
         valid = a.valid & c.valid
-        if target is None:
+        if op in BITOPS:
+            x, y = _to_bigint(a.data, ta), _to_bigint(c.data, tb)
+        elif target is None:
             x, y = a.data, c.data
         elif op == "div":
             x, y = _to_float(a.data, ta), _to_float(c.data, tb)
@@ -1298,6 +1329,24 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
             x, y = _numeric_align(a.data, ta, c.data, tb, target)
         if op == "add":
             d = x + y
+        elif op == "bit_and":
+            d = x & y
+        elif op == "bit_or":
+            d = x | y
+        elif op == "bit_xor":
+            d = x ^ y
+        elif op in ("shl", "shr"):
+            # MySQL: shift counts outside [0, 63] yield 0, not UB
+            inrange = (y >= 0) & (y < 64)
+            ys = jnp.where(inrange, y, 0)
+            d = jnp.where(
+                inrange,
+                (x << ys) if op == "shl" else
+                # logical (unsigned) right shift, MySQL semantics
+                ((x.astype(jnp.uint64) >> ys.astype(jnp.uint64))
+                 .astype(jnp.int64)),
+                0,
+            )
         elif op == "sub":
             d = x - y
         elif op == "mul":

@@ -47,7 +47,7 @@ _TOKEN_RE = re.compile(
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")
   | (?P<bq>`[^`]*`)
   | (?P<sysvar>@@[A-Za-z_][A-Za-z0-9_.$]*)
-  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|[-+*/%(),.;=<>?@])
+  | (?P<op><=>|<>|!=|>=|<=|\|\||&&|<<|>>|[-+*/%(),.;=<>?@&|^~])
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
     """,
     re.VERBOSE | re.DOTALL,
@@ -926,8 +926,33 @@ class Parser:
             return ast.Call("not", [self.parse_not()])
         return self.parse_predicate()
 
-    def parse_predicate(self):
+    # MySQL bit-operator precedence (high to low): ~ (unary), ^,
+    # * / %, + -, << >>, &, |, then comparisons
+    def parse_bitor(self):
+        e = self.parse_bitand()
+        while self.at_op("|"):
+            self.advance()
+            e = ast.Call("bit_or", [e, self.parse_bitand()])
+        return e
+
+    def parse_bitand(self):
+        e = self.parse_shift()
+        while self.at_op("&"):
+            self.advance()
+            e = ast.Call("bit_and", [e, self.parse_shift()])
+        return e
+
+    def parse_shift(self):
         e = self.parse_additive()
+        while self.at_op("<<", ">>"):
+            op = self.advance().text
+            e = ast.Call(
+                "shl" if op == "<<" else "shr", [e, self.parse_additive()]
+            )
+        return e
+
+    def parse_predicate(self):
+        e = self.parse_bitor()
         while True:
             if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
                 op = self.advance().text
@@ -944,7 +969,7 @@ class Parser:
                     self.expect_op(")")
                     e = self._quantified(opname, quant, e, q)
                     continue
-                rhs = self.parse_additive()
+                rhs = self.parse_bitor()
                 if isinstance(e, ast.RowExpr) or isinstance(rhs, ast.RowExpr):
                     if (
                         not isinstance(e, ast.RowExpr)
@@ -972,9 +997,9 @@ class Parser:
             if self.accept_kw("not"):
                 neg = True
             if self.accept_kw("between"):
-                lo = self.parse_additive()
+                lo = self.parse_bitor()
                 self.expect_kw("and")
-                hi = self.parse_additive()
+                hi = self.parse_bitor()
                 r = ast.Call("and", [ast.Call("ge", [e, lo]), ast.Call("le", [e, hi])])
                 e = ast.Call("not", [r]) if neg else r
                 continue
@@ -1012,7 +1037,7 @@ class Parser:
                     e = ast.Call("not", [r]) if neg else r
                 continue
             if self.accept_kw("like"):
-                pat = self.parse_additive()
+                pat = self.parse_bitor()
                 r = ast.Call("like", [e, pat])
                 e = ast.Call("not", [r]) if neg else r
                 continue
@@ -1020,7 +1045,7 @@ class Parser:
                 "regexp", "rlike"
             ):
                 self.advance()
-                pat = self.parse_additive()
+                pat = self.parse_bitor()
                 r = ast.Call("regexp", [e, pat])
                 e = ast.Call("not", [r]) if neg else r
                 continue
@@ -1046,24 +1071,33 @@ class Parser:
         return ast.Call(op, [lhs, rhs])
 
     def parse_multiplicative(self):
-        e = self.parse_unary()
+        e = self.parse_xor()
         while True:
             if self.accept_op("*"):
-                e = ast.Call("mul", [e, self.parse_unary()])
+                e = ast.Call("mul", [e, self.parse_xor()])
             elif self.accept_op("/"):
-                e = ast.Call("div", [e, self.parse_unary()])
+                e = ast.Call("div", [e, self.parse_xor()])
             elif self.accept_kw("div"):
-                e = ast.Call("intdiv", [e, self.parse_unary()])
+                e = ast.Call("intdiv", [e, self.parse_xor()])
             elif self.accept_op("%") or self.accept_kw("mod"):
-                e = ast.Call("mod", [e, self.parse_unary()])
+                e = ast.Call("mod", [e, self.parse_xor()])
             else:
                 return e
+
+    def parse_xor(self):
+        e = self.parse_unary()
+        while self.at_op("^"):
+            self.advance()
+            e = ast.Call("bit_xor", [e, self.parse_unary()])
+        return e
 
     def parse_unary(self):
         if self.accept_op("-"):
             return ast.Call("neg", [self.parse_unary()])
         if self.accept_op("+"):
             return self.parse_unary()
+        if self.accept_op("~"):
+            return ast.Call("bit_neg", [self.parse_unary()])
         e = self.parse_primary()
         # expr COLLATE <name>: _ci collations compare case-folded,
         # _bin is the engine default (dictionary order IS binary order)

@@ -30,12 +30,42 @@ _CMP = {
     "ge": lambda a, b: a >= b,
 }
 
+def _bigint(v):
+    """MySQL bit-op operand coercion: round half away from zero."""
+    import math
+
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, float):
+        return int(math.floor(abs(v) + 0.5)) * (1 if v >= 0 else -1)
+    return int(v)
+
+
+_I64_MASK = (1 << 64) - 1
+
+
+def _shift(a, b, left: bool):
+    if b < 0 or b >= 64:
+        return 0  # MySQL: out-of-range shift counts yield 0
+    u = _bigint(a) & _I64_MASK
+    u = (u << b) if left else (u >> b)
+    u &= _I64_MASK
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
 _ARITH = {
     "add": lambda a, b: a + b,
     "sub": lambda a, b: a - b,
     "mul": lambda a, b: a * b,
     "div": lambda a, b: a / b if b != 0 else None,  # SQL: x/0 is NULL
     "mod": lambda a, b: a % b if b != 0 else None,
+    # bitwise family (the flags = flags | 1 upsert idiom and
+    # CHECK (a & 1 = 1) constraints run through this host evaluator)
+    "bit_and": lambda a, b: _bigint(a) & _bigint(b),
+    "bit_or": lambda a, b: _bigint(a) | _bigint(b),
+    "bit_xor": lambda a, b: _bigint(a) ^ _bigint(b),
+    "shl": lambda a, b: _shift(a, _bigint(b), True),
+    "shr": lambda a, b: _shift(a, _bigint(b), False),
 }
 
 
@@ -83,6 +113,9 @@ def eval_check(e, row: dict) -> Optional[bool]:
     if op == "neg":
         v = eval_check(e.args[0], row)
         return None if v is None else -v
+    if op == "bit_neg":
+        v = eval_check(e.args[0], row)
+        return None if v is None else ~_bigint(v)
     if op == "in":
         lhs = eval_check(e.args[0], row)
         if lhs is None:
