@@ -337,13 +337,16 @@ class TestFKReferentialActions:
         with pytest.raises(ValueError, match="restricts"):
             s.execute("update p set id = 9 where id = 1")
 
-    def test_on_update_cascade_rejected_at_ddl(self, env):
+    def test_on_update_cascade_accepted_at_ddl(self, env):
+        # formerly rejected; ON UPDATE actions are first-class now
+        # (TestFKOnUpdateActions covers the runtime semantics)
         _cat, s = env
-        with pytest.raises(Exception, match="ON UPDATE"):
-            s.execute(
-                "create table bad (id int, pid int, constraint fb foreign "
-                "key (pid) references p (id) on update cascade)"
-            )
+        s.execute(
+            "create table okc (id int, pid int, constraint fb foreign "
+            "key (pid) references p (id) on update cascade)"
+        )
+        t = _cat.table("test", "okc")
+        assert t.fk_update_actions.get("fb") == "cascade"
 
     def test_show_create_and_persistence(self, env, tmp_path):
         cat, s = env
@@ -499,3 +502,122 @@ class TestCompositeKeys:
         sess.execute("create table t (a int, b int, v int, primary key (a, b))")
         sess.execute("insert ignore into t values (1, null, 9), (2, 2, 8)")
         assert sess.execute("select a, b, v from t").rows == [(2, 2, 8)]
+
+
+class TestFKOnUpdateActions:
+    """ON UPDATE CASCADE / SET NULL referential actions
+    (reference: pkg/executor/foreign_key.go onUpdate handling)."""
+
+    def test_on_update_cascade_rewrites_child_keys(self, sess):
+        sess.execute("create table p (id int primary key, v int)")
+        sess.execute(
+            "create table c (x int, pid int, constraint f foreign key "
+            "(pid) references p (id) on update cascade)"
+        )
+        sess.execute("insert into p values (1, 10), (2, 20)")
+        sess.execute("insert into c values (100, 1), (101, 1), (102, 2)")
+        sess.execute("update p set id = 7 where id = 1")
+        assert sess.execute(
+            "select x, pid from c order by x"
+        ).rows == [(100, 7), (101, 7), (102, 2)]
+        # chain intact: further updates keep cascading
+        sess.execute("update p set id = id + 100")
+        assert sorted(
+            r[1] for r in sess.execute("select x, pid from c").rows
+        ) == [102, 107, 107]
+
+    def test_on_update_set_null(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (x int, pid int, constraint f foreign key "
+            "(pid) references p (id) on update set null)"
+        )
+        sess.execute("insert into p values (1), (2)")
+        sess.execute("insert into c values (100, 1), (101, 2)")
+        sess.execute("update p set id = 9 where id = 1")
+        assert sess.execute(
+            "select x, pid from c order by x"
+        ).rows == [(100, None), (101, 2)]
+
+    def test_on_update_restrict_default(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (pid int, constraint f foreign key (pid) "
+            "references p (id))"
+        )
+        sess.execute("insert into p values (1)")
+        sess.execute("insert into c values (1)")
+        with pytest.raises(ValueError, match="restricts"):
+            sess.execute("update p set id = 2 where id = 1")
+
+    def test_on_update_cascade_rollback_on_failure(self, sess):
+        from tidb_tpu.utils import failpoint
+
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (pid int, constraint f foreign key (pid) "
+            "references p (id) on update cascade)"
+        )
+        sess.execute("insert into p values (1)")
+        sess.execute("insert into c values (1)")
+        failpoint.enable("fk/cascade-update", RuntimeError("boom"))
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                sess.execute("update p set id = 2 where id = 1")
+        finally:
+            failpoint.disable("fk/cascade-update")
+        # the whole statement rolled back: parent AND child intact
+        assert sess.execute("select id from p").rows == [(1,)]
+        assert sess.execute("select pid from c").rows == [(1,)]
+
+    def test_self_fk_on_update_set_null(self, sess):
+        # self-FK: the SET NULL must survive the table rewrite
+        sess.execute(
+            "create table e (id int primary key, mgr int, constraint fm "
+            "foreign key (mgr) references e (id) on update set null)"
+        )
+        sess.execute("insert into e values (1, null), (2, 1)")
+        sess.execute("update e set id = 9 where id = 1")
+        assert sess.execute(
+            "select id, mgr from e order by id"
+        ).rows == [(2, None), (9, None)]
+
+    def test_set_null_not_leaked_when_restrict_sibling_fires(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c1 (pid int, constraint f1 foreign key (pid) "
+            "references p (id) on update set null)"
+        )
+        sess.execute(
+            "create table c2 (pid int, constraint f2 foreign key (pid) "
+            "references p (id))"
+        )
+        sess.execute("insert into p values (1)")
+        sess.execute("insert into c1 values (1)")
+        sess.execute("insert into c2 values (1)")
+        with pytest.raises(ValueError, match="restricts"):
+            sess.execute("update p set id = 2 where id = 1")
+        # the RESTRICT sibling aborted the statement; c1 must be intact
+        assert sess.execute("select pid from c1").rows == [(1,)]
+
+    def test_cascade_to_null_nulls_child(self, sess):
+        sess.execute("create table p (id int primary key, r int)")
+        sess.execute(
+            "create table c (rid int, constraint f foreign key (rid) "
+            "references p (r) on update cascade)"
+        )
+        sess.execute("insert into p values (1, 5)")
+        sess.execute("insert into c values (5)")
+        sess.execute("update p set r = null where id = 1")
+        assert sess.execute("select rid from c").rows == [(None,)]
+
+    def test_partial_rewrite_of_nonunique_key_is_ambiguous(self, sess):
+        sess.execute("create table p (pk int primary key, r int)")
+        sess.execute(
+            "create table c (rid int, constraint f foreign key (rid) "
+            "references p (r) on update cascade)"
+        )
+        sess.execute("insert into p values (1, 7), (2, 7)")
+        sess.execute("insert into c values (7)")
+        with pytest.raises(ValueError, match="ambiguous"):
+            sess.execute("update p set r = 8 where pk = 1")

@@ -228,6 +228,8 @@ class CreateTable:
     partition: Optional[tuple] = None
     # fk name -> ON DELETE action ("restrict" | "cascade" | "set_null")
     fk_actions: dict = dataclasses.field(default_factory=dict)
+    # fk name -> ON UPDATE action (same value domain)
+    fk_update_actions: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -1753,6 +1753,7 @@ class Parser:
         checks: List[tuple] = []
         fks: List[tuple] = []
         fk_actions: dict = {}
+        fk_update_actions: dict = {}
 
         def _parse_check(cname):
             self.expect_op("(")
@@ -1794,11 +1795,6 @@ class Parser:
                     odel = act
                 else:
                     oupd = act
-            if oupd != "restrict":
-                raise ParseError(
-                    "ON UPDATE CASCADE/SET NULL is not supported "
-                    "(RESTRICT semantics apply)"
-                )
             return odel, oupd
 
         def _parse_fk(cname):
@@ -1814,9 +1810,10 @@ class Parser:
             rcol = self.expect_ident()
             self.expect_op(")")
             nm = cname or f"fk_{len(fks) + 1}"
-            odel, _oupd = _parse_fk_actions()
+            odel, oupd = _parse_fk_actions()
             fks.append((nm, col, rdb, rtbl, rcol))
             fk_actions[nm.lower()] = odel
+            fk_update_actions[nm.lower()] = oupd
 
         while True:
             if self._at_ident("constraint"):
@@ -1921,9 +1918,10 @@ class Parser:
                         rcol = self.expect_ident()
                         self.expect_op(")")
                         nm0 = f"fk_{len(fks) + 1}"
-                        odel0, _o = _parse_fk_actions()
+                        odel0, oupd0 = _parse_fk_actions()
                         fks.append((nm0, cname, rdb, rtbl, rcol))
                         fk_actions[nm0.lower()] = odel0
+                        fk_update_actions[nm0.lower()] = oupd0
                     else:
                         break
                 cols.append(cd)
@@ -1999,7 +1997,7 @@ class Parser:
         return ast.CreateTable(
             db, name, cols, pk, ine, indexes=indexes, ttl=ttl,
             checks=checks, fks=fks, partition=partition,
-            fk_actions=fk_actions,
+            fk_actions=fk_actions, fk_update_actions=fk_update_actions,
         )
 
     def parse_alter(self):

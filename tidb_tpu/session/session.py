@@ -237,6 +237,9 @@ class Session:
             shadow.checks = list(t.checks)
             shadow.fks = list(t.fks)
             shadow.fk_actions = dict(getattr(t, "fk_actions", {}))
+            shadow.fk_update_actions = dict(
+                getattr(t, "fk_update_actions", {})
+            )
             shadow.partition = t.partition
             self._txn["shadows"][key] = shadow
             # conflict baseline = version at FIRST touch in this txn —
@@ -1271,6 +1274,13 @@ class Session:
                     for nm, act in (getattr(s, "fk_actions", {}) or {}).items()
                     if act != "restrict"
                 }
+                t.fk_update_actions = {
+                    nm.lower(): act
+                    for nm, act in (
+                        getattr(s, "fk_update_actions", {}) or {}
+                    ).items()
+                    if act != "restrict"
+                }
                 t.defaults = {
                     c.name.lower(): c.default
                     for c in s.columns
@@ -2295,22 +2305,36 @@ class Session:
 
     def _enforce_parent_constraints(
         self, db: str, name: str, remaining: dict, actions: bool = False,
-        _depth: int = 0, undo=None,
+        _depth: int = 0, undo=None, update_acts: Optional[dict] = None,
     ) -> None:
         """FK enforcement for deletes/updates on an FK parent against
         the post-statement values (``remaining``: ref_col -> value set).
-        actions=False (UPDATE paths): RESTRICT always — ON UPDATE
-        referential actions are unsupported at DDL, so RESTRICT is the
-        declared semantics. actions=True (DELETE/TRUNCATE): each child
-        FK's declared ON DELETE action applies — RESTRICT raises,
-        CASCADE deletes the referencing child rows (recursively),
-        SET NULL nulls the child key column. Reference:
-        pkg/executor/foreign_key.go (FKCascadeExec / FKCheckExec)."""
+        actions=True (DELETE/TRUNCATE): each child FK's declared
+        ON DELETE action applies — RESTRICT raises, CASCADE deletes the
+        referencing child rows (recursively), SET NULL nulls the child
+        key column. update_acts (UPDATE paths): map of
+        (child_db, child_table, fk_name) -> the FK's ON UPDATE action;
+        RESTRICT raises, SET NULL nulls, CASCADE is skipped here — the
+        caller rewrites child keys from its old->new pairing. Neither
+        set: RESTRICT always. Reference: pkg/executor/foreign_key.go
+        (FKCascadeExec / FKCheckExec)."""
         if _depth > 10:
             raise ValueError("FOREIGN KEY cascade recursion too deep")
         for cdb, ctn, nm, col, rcol, odel in self._fk_children(db, name):
             if rcol not in remaining:
                 continue
+            if update_acts is not None:
+                act = update_acts.get((cdb, ctn, nm), "restrict")
+                if act in ("cascade", "set_null"):
+                    # the caller applies both AFTER installing the new
+                    # parent image: mutating children pre-install would
+                    # be lost for self-FKs (the post-image rows were
+                    # computed first) and would leak on a later RESTRICT
+                    continue
+            elif actions:
+                act = odel
+            else:
+                act = "restrict"
             child_vals = self._column_values(cdb, ctn, col)
             if cdb == db.lower() and ctn == name.lower():
                 # self-FK: the child side shrinks with the parent — the
@@ -2319,14 +2343,14 @@ class Session:
             dangling = child_vals - remaining[rcol]
             if not dangling:
                 continue
-            if not actions or odel == "restrict":
+            if act == "restrict":
                 raise ValueError(
                     f"FOREIGN KEY {nm!r} on {cdb}.{ctn} restricts this "
                     f"statement: {sorted(dangling)[:3]!r} still referenced"
                 )
-            if odel == "set_null":
+            if act == "set_null":
                 self._null_child_keys(cdb, ctn, col, dangling, _depth, undo)
-            else:  # cascade
+            else:  # cascade (delete paths only)
                 self._cascade_delete(cdb, ctn, col, dangling, _depth, undo)
 
     def _child_block_mask(self, block, col, values):
@@ -2374,6 +2398,52 @@ class Session:
             cols = dict(b.columns)
             c = cols[col]
             cols[col] = dataclasses.replace(c, valid=c.valid & ~hit)
+            new_blocks.append(dataclasses.replace(b, columns=cols))
+            changed += int(hit.sum())
+        if changed:
+            t.replace_blocks(new_blocks, modified_rows=changed)
+            clear_scan_cache()
+            self._fk_recheck_children(cdb, ctn, depth, undo)
+
+    def _cascade_update_child(
+        self, cdb, ctn, col, mapping: dict, depth, undo
+    ) -> None:
+        """ON UPDATE CASCADE: rewrite child FK values old -> new from
+        the parent's key rewrite, then RESTRICT-recheck the child's own
+        children against its new value sets (a grandchild FK onto the
+        rewritten column must still resolve). Reference:
+        pkg/executor/foreign_key.go onUpdate cascade."""
+        from tidb_tpu.chunk import column_from_values
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("fk/cascade-update")
+        if not mapping:
+            return
+        t = self._resolve_table_for_write(cdb, ctn)
+        typ = t.schema.types[col]
+        if typ.kind == Kind.STRING:
+            raise ValueError(
+                "ON UPDATE CASCADE is not supported for string FK "
+                "columns (dictionary remap); use RESTRICT or SET NULL"
+            )
+        self._fk_undo_snapshot(undo, t)
+        olds = list(mapping)
+        enc_old = column_from_values(olds, typ).data
+        enc_new = column_from_values([mapping[o] for o in olds], typ).data
+        order = np.argsort(enc_old, kind="stable")
+        so, sn = enc_old[order], enc_new[order]
+        new_blocks = []
+        changed = 0
+        for b in t.blocks():
+            c = b.columns[col]
+            pos = np.clip(np.searchsorted(so, c.data), 0, len(so) - 1)
+            hit = c.valid & (so[pos] == c.data)
+            if not hit.any():
+                new_blocks.append(b)
+                continue
+            data = np.where(hit, sn[pos], c.data).astype(c.data.dtype)
+            cols = dict(b.columns)
+            cols[col] = dataclasses.replace(c, data=data)
             new_blocks.append(dataclasses.replace(b, columns=cols))
             changed += int(hit.sum())
         if changed:
@@ -3092,12 +3162,22 @@ class Session:
         rows = [list(row) for row in r.rows]
         db = s.db or self.db
         # ``rows`` is the table's complete post-statement image: child
-        # FK + CHECK validate the new rows, parent-side RESTRICT
-        # validates children against the new value sets
+        # FK + CHECK validate the new rows, parent-side constraints
+        # validate children against the new value sets (each child FK's
+        # ON UPDATE action applies: RESTRICT raises, SET NULL nulls,
+        # CASCADE rewrites child keys from the old->new pairing)
         self._enforce_write_constraints(t, db, rows)
         children = self._fk_children(db, s.table)
+        undo: list = []
+        cascade_maps: list = []
         if children:
             names = t.schema.names
+            upd_acts = {}
+            for cdb, ctn, nm, ccol, rcol, _odel in children:
+                ct2 = self.catalog.table(cdb, ctn)
+                upd_acts[(cdb, ctn, nm)] = getattr(
+                    ct2, "fk_update_actions", {}
+                ).get(nm, "restrict")
             need = {rc for _, _, _, _, rc, _a in children}
             need |= {
                 c for cd, ct, _, c, _, _a in children
@@ -3110,7 +3190,17 @@ class Session:
                 }
                 for col in need
             }
-            self._enforce_parent_constraints(db, s.table, remaining)
+            action_children = [
+                c for c in children
+                if upd_acts[(c[0], c[1], c[2])] in ("cascade", "set_null")
+            ]
+            if action_children:
+                cascade_maps = self._fk_update_plans(
+                    t, names, rows, action_children, upd_acts, remaining
+                )
+            self._enforce_parent_constraints(
+                db, s.table, remaining, update_acts=upd_acts, undo=undo
+            )
         # count affected
         if s.where is None:
             affected = len(rows)
@@ -3119,17 +3209,93 @@ class Session:
         saved_blocks = list(t.blocks())
         saved_dicts = dict(t.dictionaries)
         t.replace_blocks([], modified_rows=affected)
-        if rows:
-            try:
+        try:
+            if rows:
                 t.append_rows(rows)
-            except Exception:
-                # e.g. the SET created duplicate PK/UNIQUE keys — the
-                # rewrite must not leave the table emptied
-                t.replace_blocks(saved_blocks, modified_rows=affected)
-                t.dictionaries = saved_dicts
-                raise
+            for kind, cdb, ctn, ccol, payload in cascade_maps:
+                if kind == "cascade":
+                    self._cascade_update_child(
+                        cdb, ctn, ccol, payload, 0, undo
+                    )
+                else:  # set_null (incl. cascades whose new key is NULL)
+                    self._null_child_keys(cdb, ctn, ccol, payload, 0, undo)
+        except Exception:
+            # e.g. the SET created duplicate PK/UNIQUE keys, or a
+            # cascade failed downstream — the whole statement rolls
+            # back, children included
+            t.replace_blocks(saved_blocks, modified_rows=affected)
+            t.dictionaries = saved_dicts
+            self._fk_undo_restore(undo)
+            raise
         clear_scan_cache()
         return Result([], [], affected=affected)
+
+    def _fk_update_plans(
+        self, t, names, rows, action_children, upd_acts, remaining
+    ):
+        """Post-install child actions for ON UPDATE CASCADE/SET NULL:
+        [("cascade", cdb, ctn, child_col, {old: new}) |
+         ("set_null", cdb, ctn, child_col, {old values to null})].
+        The rewrite SELECT emits rows in scan (block-concatenation)
+        order, so pre-image row i corresponds to post-image row i. A
+        length mismatch, or one old key paired with TWO different
+        outcomes (rewritten in one parent row, kept or rewritten
+        differently in another — possible only when the referenced
+        column is not unique), aborts rather than guessing. A cascade
+        whose new key is NULL becomes a SET NULL on the child (writing
+        the encoded null sentinel with valid=True would fabricate key
+        0)."""
+        old_cols: dict = {}
+        for rc in {c[4] for c in action_children}:
+            vals: list = []
+            for b in t.blocks():
+                hc = b.columns[rc]
+                dec = hc.decode()
+                vals.extend(
+                    dec[i] if hc.valid[i] else None
+                    for i in range(b.nrows)
+                )
+            old_cols[rc] = vals
+        out = []
+        for cdb, ctn, nm, ccol, rcol, _odel in action_children:
+            act = upd_acts[(cdb, ctn, nm)]
+            olds = old_cols[rcol]
+            if act == "set_null":
+                dangling = {o for o in olds if o is not None} - remaining[
+                    rcol
+                ]
+                if dangling:
+                    out.append(("set_null", cdb, ctn, ccol, dangling))
+                continue
+            if len(olds) != len(rows):
+                raise ValueError(
+                    "ON UPDATE CASCADE: cannot align pre/post images "
+                    f"for {rcol!r} (row set changed size)"
+                )
+            idx = names.index(rcol)
+            pairs: dict = {}
+            for old, row in zip(olds, rows):
+                if old is None:
+                    continue
+                pairs.setdefault(old, set()).add(row[idx])
+            mapping: dict = {}
+            null_olds: set = set()
+            for old, news in pairs.items():
+                if len(news) > 1:
+                    raise ValueError(
+                        f"ON UPDATE CASCADE: ambiguous rewrite of "
+                        f"{rcol!r} value {old!r}"
+                    )
+                new = next(iter(news))
+                if new is None:
+                    null_olds.add(old)
+                elif new != old:
+                    mapping[old] = new
+            if mapping:
+                out.append(("cascade", cdb, ctn, ccol, mapping))
+            if null_olds:
+                out.append(("set_null", cdb, ctn, ccol, null_olds))
+        return out
 
     def _try_columnar_update(self, t, s: ast.Update, sets) -> Optional[Result]:
         """Block-targeted columnar UPDATE: scatter new values for the SET

@@ -90,6 +90,9 @@ def table_meta_to_json(t) -> Dict:
         "checks": [list(c) for c in t.checks] or None,
         "fks": [list(f) for f in t.fks] or None,
         "fk_actions": dict(getattr(t, "fk_actions", {})) or None,
+        "fk_update_actions": dict(
+            getattr(t, "fk_update_actions", {})
+        ) or None,
         "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
         "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
         "json_cols": list(t.schema.json_cols),
@@ -134,6 +137,7 @@ def apply_table_meta(t, meta: Dict) -> None:
     t.checks = [tuple(c) for c in (meta.get("checks") or [])]
     t.fks = [tuple(f) for f in (meta.get("fks") or [])]
     t.fk_actions = dict(meta.get("fk_actions") or {})
+    t.fk_update_actions = dict(meta.get("fk_update_actions") or {})
 
 
 def schemas_equivalent(a, b) -> bool:

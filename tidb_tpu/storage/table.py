@@ -153,6 +153,8 @@ class Table:
         # FKCascadeExec / FKCheckExec)
         self.fks: list = []
         self.fk_actions: Dict[str, str] = {}
+        # same, for ON UPDATE (referenced-key rewrites propagate)
+        self.fk_update_actions: Dict[str, str] = {}
         # online-DDL schema states per index (reference: the F1 state
         # machine None -> DeleteOnly -> WriteOnly -> WriteReorg -> Public,
         # pkg/ddl/index.go:545). Missing entry = "public" (pre-existing
