@@ -621,3 +621,16 @@ class TestFKOnUpdateActions:
         sess.execute("insert into c values (7)")
         with pytest.raises(ValueError, match="ambiguous"):
             sess.execute("update p set r = 8 where pk = 1")
+
+    def test_mixed_case_constraint_name_cascades(self, sess):
+        # fk_update_actions is keyed lowercase; a mixed-case constraint
+        # name must not silently degrade CASCADE to RESTRICT
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (pid int, constraint MyFK foreign key (pid) "
+            "references p (id) on update cascade)"
+        )
+        sess.execute("insert into p values (1)")
+        sess.execute("insert into c values (1)")
+        sess.execute("update p set id = 3 where id = 1")
+        assert sess.execute("select pid from c").rows == [(3,)]

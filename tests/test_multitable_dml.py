@@ -218,3 +218,48 @@ class TestMultiDMLIntegrity:
         assert sess.execute("select max(salary) from emp").rows == [(0,)]
         sess.execute("rollback")
         assert sess.execute("select max(salary) from emp").rows == [(400,)]
+
+
+class TestMultiTableFKOnUpdate:
+    """UPDATE ... JOIN honors FK ON UPDATE actions like the single-table
+    path (reference: pkg/executor/foreign_key.go onUpdate)."""
+
+    def test_join_update_cascades(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table p (id int primary key, tag int)")
+        s.execute("create table d (tag int)")
+        s.execute(
+            "create table c (pid int, constraint f foreign key (pid) "
+            "references p (id) on update cascade)"
+        )
+        s.execute("insert into p values (1, 5), (2, 6)")
+        s.execute("insert into d values (5)")
+        s.execute("insert into c values (1), (2)")
+        s.execute(
+            "update p join d on p.tag = d.tag set p.id = p.id + 100"
+        )
+        assert sorted(
+            r[0] for r in s.execute("select pid from c").rows
+        ) == [2, 101]
+
+    def test_join_update_restrict_still_raises(self):
+        import pytest
+
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table p (id int primary key, tag int)")
+        s.execute("create table d (tag int)")
+        s.execute(
+            "create table c (pid int, constraint f foreign key (pid) "
+            "references p (id))"
+        )
+        s.execute("insert into p values (1, 5)")
+        s.execute("insert into d values (5)")
+        s.execute("insert into c values (1)")
+        with pytest.raises(ValueError, match="restricts"):
+            s.execute(
+                "update p join d on p.tag = d.tag set p.id = 9"
+            )

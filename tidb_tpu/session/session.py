@@ -2405,6 +2405,29 @@ class Session:
             clear_scan_cache()
             self._fk_recheck_children(cdb, ctn, depth, undo)
 
+    def _fk_upd_acts(self, children) -> dict:
+        """(child_db, child_table, fk_name) -> declared ON UPDATE action
+        for every child FK. The action dicts are keyed by LOWERCASED fk
+        name (session DDL lowers them); looking up with the original-
+        case name would silently degrade CASCADE to RESTRICT."""
+        out = {}
+        for cdb, ctn, nm, _cc, _rc, _a in children:
+            ct = self.catalog.table(cdb, ctn)
+            out[(cdb, ctn, nm)] = getattr(
+                ct, "fk_update_actions", {}
+            ).get(nm.lower(), "restrict")
+        return out
+
+    def _apply_fk_update_plans(self, cascade_maps, undo) -> None:
+        """Dispatch the post-install child actions from
+        _fk_update_plans (shared by the single- and multi-table UPDATE
+        paths)."""
+        for kind, cdb, ctn, ccol, payload in cascade_maps:
+            if kind == "cascade":
+                self._cascade_update_child(cdb, ctn, ccol, payload, 0, undo)
+            else:  # set_null (incl. cascades whose new key is NULL)
+                self._null_child_keys(cdb, ctn, ccol, payload, 0, undo)
+
     def _cascade_update_child(
         self, cdb, ctn, col, mapping: dict, depth, undo
     ) -> None:
@@ -3172,12 +3195,7 @@ class Session:
         cascade_maps: list = []
         if children:
             names = t.schema.names
-            upd_acts = {}
-            for cdb, ctn, nm, ccol, rcol, _odel in children:
-                ct2 = self.catalog.table(cdb, ctn)
-                upd_acts[(cdb, ctn, nm)] = getattr(
-                    ct2, "fk_update_actions", {}
-                ).get(nm, "restrict")
+            upd_acts = self._fk_upd_acts(children)
             need = {rc for _, _, _, _, rc, _a in children}
             need |= {
                 c for cd, ct, _, c, _, _a in children
@@ -3212,20 +3230,17 @@ class Session:
         try:
             if rows:
                 t.append_rows(rows)
-            for kind, cdb, ctn, ccol, payload in cascade_maps:
-                if kind == "cascade":
-                    self._cascade_update_child(
-                        cdb, ctn, ccol, payload, 0, undo
-                    )
-                else:  # set_null (incl. cascades whose new key is NULL)
-                    self._null_child_keys(cdb, ctn, ccol, payload, 0, undo)
+            self._apply_fk_update_plans(cascade_maps, undo)
         except Exception:
             # e.g. the SET created duplicate PK/UNIQUE keys, or a
             # cascade failed downstream — the whole statement rolls
-            # back, children included
+            # back, children included. Undo restores FIRST: a self-FK
+            # child snapshot in `undo` was taken post-append, and
+            # re-installing it after saved_blocks would resurrect the
+            # updated parent image the rollback just removed
+            self._fk_undo_restore(undo)
             t.replace_blocks(saved_blocks, modified_rows=affected)
             t.dictionaries = saved_dicts
-            self._fk_undo_restore(undo)
             raise
         clear_scan_cache()
         return Result([], [], affected=affected)
@@ -3588,7 +3603,10 @@ class Session:
                     rows[h][cidx[c]] = v
             self._enforce_write_constraints(t, db, rows)
             children = self._fk_children(db, tr.name)
+            undo: list = []
+            cascade_maps: list = []
             if children:
+                upd_acts = self._fk_upd_acts(children)
                 need = {rc for _, _, _, _, rc, _a in children}
                 need |= {
                     c for cd, ct, _, c, _, _a in children
@@ -3601,19 +3619,38 @@ class Session:
                     }
                     for col in need
                 }
-                self._enforce_parent_constraints(db, tr.name, remaining)
+                action_children = [
+                    c for c in children
+                    if upd_acts[(c[0], c[1], c[2])]
+                    in ("cascade", "set_null")
+                ]
+                if action_children:
+                    # rows[] was built FROM t.blocks() in scan order, so
+                    # the pre/post alignment is exact by construction
+                    cascade_maps = self._fk_update_plans(
+                        t, names, rows, action_children, upd_acts,
+                        remaining,
+                    )
+                self._enforce_parent_constraints(
+                    db, tr.name, remaining, update_acts=upd_acts,
+                    undo=undo,
+                )
             saved_blocks = list(t.blocks())
             saved_dicts = dict(t.dictionaries)
             t.replace_blocks([], modified_rows=len(new_by_handle))
-            if rows:
-                try:
+            try:
+                if rows:
                     t.append_rows(rows)
-                except Exception:
-                    t.replace_blocks(
-                        saved_blocks, modified_rows=len(new_by_handle)
-                    )
-                    t.dictionaries = saved_dicts
-                    raise
+                self._apply_fk_update_plans(cascade_maps, undo)
+            except Exception:
+                # undo first: a self-FK snapshot taken post-append must
+                # not overwrite the parent rollback (see _run_update)
+                self._fk_undo_restore(undo)
+                t.replace_blocks(
+                    saved_blocks, modified_rows=len(new_by_handle)
+                )
+                t.dictionaries = saved_dicts
+                raise
             affected += len(new_by_handle)
         clear_scan_cache()
         return Result([], [], affected=affected)
