@@ -317,3 +317,63 @@ class TestResourceGroups:
         assert s.execute(
             "select id, st from t order by id"
         ).rows == [(1, "ax"), (2, "b")]
+
+
+class TestProcesslistAndKill:
+    """SHOW PROCESSLIST + KILL <id> over the catalog session registry
+    (reference: the server connection registry, pkg/server/server.go;
+    kill routing via util/sqlkiller)."""
+
+    def test_processlist_lists_sessions(self):
+        cat = Catalog()
+        s1 = Session(cat)
+        s2 = Session(cat)
+        s2.execute("create database d2")
+        s2.execute("use d2")
+        rows = s1.execute("show processlist").rows
+        ids = {r[0] for r in rows}
+        assert s1.conn_id in ids and s2.conn_id in ids
+        by_id = {r[0]: r for r in rows}
+        # the session RUNNING the statement shows it; the idle one sleeps
+        assert by_id[s1.conn_id][3] == "Query"
+        assert "processlist" in by_id[s1.conn_id][5]
+        assert by_id[s2.conn_id][3] == "Sleep"
+        assert by_id[s2.conn_id][2] == "d2"
+
+    def test_kill_by_connection_id(self):
+        from tidb_tpu.utils.sqlkiller import QueryKilled
+
+        cat = Catalog()
+        s1 = Session(cat)
+        s2 = Session(cat)
+        s2.execute("create table t (a int)")
+        s2.execute("insert into t values (1)")
+
+        def stall():
+            s1.execute(f"kill query {s2.conn_id}")
+
+        failpoint.enable("executor/before-discover", stall)
+        try:
+            with pytest.raises(QueryKilled):
+                s2.execute("select sum(a) from t where a > 0")
+        finally:
+            failpoint.disable("executor/before-discover")
+        # the killed session recovers
+        assert s2.execute("select count(*) from t").rows == [(1,)]
+
+    def test_kill_unknown_id(self):
+        s = Session()
+        with pytest.raises(ValueError, match="unknown connection"):
+            s.execute("kill 999999")
+
+    def test_kill_connection_closes_session(self):
+        cat = Catalog()
+        s1 = Session(cat)
+        s2 = Session(cat)
+        s1.execute(f"kill connection {s2.conn_id}")
+        with pytest.raises(ConnectionError, match="was killed"):
+            s2.execute("select 1")
+        # KILL QUERY does NOT close: the session keeps working
+        s3 = Session(cat)
+        s1.execute(f"kill query {s3.conn_id}")
+        assert s3.execute("select 1").rows == [(1,)]

@@ -263,3 +263,25 @@ class TestMultiTableFKOnUpdate:
             s.execute(
                 "update p join d on p.tag = d.tag set p.id = 9"
             )
+
+    def test_join_update_atomic_across_targets(self):
+        import pytest
+
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table a (id int primary key, v int)")
+        s.execute("create table b (id int primary key, v int)")
+        s.execute("insert into a values (1, 10)")
+        s.execute("insert into b values (1, 20), (2, 30)")
+        # target a updates fine; target b's SET collides on its PK ->
+        # the WHOLE statement must roll back, including a
+        with pytest.raises(Exception):
+            s.execute(
+                "update a join b on a.id = b.id "
+                "set a.v = 99, b.id = 2"
+            )
+        assert s.execute("select v from a").rows == [(10,)]
+        assert sorted(
+            r[0] for r in s.execute("select id from b").rows
+        ) == [1, 2]

@@ -426,8 +426,17 @@ class PlanReplayer:
 
 @dataclasses.dataclass
 class Show:
-    what: str  # "tables" | "databases" | "variables"
+    what: str  # "tables" | "databases" | "variables" | "processlist" | ...
     db: Optional[str] = None  # for variables: LIKE pattern
+
+
+@dataclasses.dataclass
+class Kill:
+    """KILL [QUERY | CONNECTION] <id> (reference: pkg/server kill
+    handling via util/sqlkiller)."""
+
+    conn_id: int
+    query_only: bool = False
 
 
 @dataclasses.dataclass

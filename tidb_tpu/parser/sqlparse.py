@@ -246,6 +246,23 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if self._at_ident("kill"):
+            # KILL [QUERY | CONNECTION] <connection id>
+            self.advance()
+            query_only = False
+            if self._at_ident("query"):
+                self.advance()
+                query_only = True
+            elif self._at_ident("connection"):
+                self.advance()
+            t = self.advance()
+            try:
+                cid = int(t.text)
+            except ValueError:
+                raise ParseError(
+                    f"KILL expects a numeric connection id, got {t.text!r}"
+                )
+            return ast.Kill(cid, query_only=query_only)
         if (
             self._at_ident("plan")
             and self.toks[self.i + 1].kind == "id"
@@ -316,6 +333,9 @@ class Parser:
                 return ast.Show("variables", db=self._show_like())
             if self.accept_kw("bindings"):
                 return ast.Show("bindings")
+            if self._at_ident("processlist"):
+                self.advance()
+                return ast.Show("processlist")
             if self.accept_kw("grants"):
                 user = None
                 if self.accept_kw("for"):

@@ -153,6 +153,24 @@ class Session:
         self._stale_ok = False
         # RU governance binding (SET RESOURCE GROUP <name>)
         self.resource_group = "default"
+        # processlist registry: catalog-wide id -> weakref(Session) so
+        # SHOW PROCESSLIST / KILL <id> see every live session over this
+        # store without keeping dead ones alive (reference: the server's
+        # clientConn registry, pkg/server/server.go)
+        import itertools as _it
+        import weakref as _wr
+
+        reg = getattr(self.catalog, "_session_registry", None)
+        if reg is None:
+            # WeakValueDictionary: dead sessions drop out on collection
+            # (a server creating one session per request must not grow
+            # the registry forever)
+            reg = self.catalog._session_registry = _wr.WeakValueDictionary()
+            self.catalog._conn_counter = _it.count(1)
+        self.conn_id = next(self.catalog._conn_counter)
+        reg[self.conn_id] = self
+        self._current_stmt: Optional[tuple] = None  # (sql text, t0)
+        self._killed_conn = False  # KILL CONNECTION marks, execute raises
         if not hasattr(self.catalog, "resource_groups"):  # old pickles
             from tidb_tpu.utils.resgroup import ResourceGroupManager
 
@@ -833,6 +851,10 @@ class Session:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
+        if self._killed_conn:
+            raise ConnectionError(
+                f"connection {self.conn_id} was killed"
+            )
         stmts = parse(sql)
         res = Result([], [])
         for s in stmts:
@@ -874,6 +896,10 @@ class Session:
         t0 = time.perf_counter()
         self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
         top = self._stmt_depth == 1
+        if top:
+            self._current_stmt = (
+                getattr(s, "_source_sql", type(s).__name__), time.time()
+            )
         bill_t0 = t0
         try:
             if top and self.resource_group != "default":
@@ -895,6 +921,8 @@ class Session:
             return res
         finally:
             self._stmt_depth -= 1
+            if top:
+                self._current_stmt = None
             if top and bill_t0 is not None:
                 try:
                     self.catalog.resource_groups.debit(
@@ -1100,9 +1128,16 @@ class Session:
             limit_ms = int(self.vars.get("max_execution_time") or 0)
         except Exception:
             limit_ms = 0
-        self.killer.clear(
-            deadline=(time.monotonic() + limit_ms / 1000.0) if limit_ms else 0.0
-        )
+        if self._stmt_depth == 1:
+            # TOP-LEVEL statements only: a nested statement (TRACE's
+            # inner stmt, EXECUTE binding) clearing the flag would
+            # silently swallow a KILL that landed mid-statement, and
+            # would also reset the statement deadline
+            self.killer.clear(
+                deadline=(
+                    time.monotonic() + limit_ms / 1000.0
+                ) if limit_ms else 0.0
+            )
         failpoint.inject("session/stmt-start")
         self._enforce_privileges(s)
         is_read = isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp))
@@ -1557,6 +1592,19 @@ class Session:
             else:
                 rg.drop(s.name, if_exists=s.if_exists)
             r = Result([], [])
+        elif isinstance(s, ast.Kill):
+            reg = getattr(self.catalog, "_session_registry", {})
+            target = reg.get(s.conn_id)
+            if target is None:
+                raise ValueError(f"unknown connection id {s.conn_id}")
+            # both forms abort the in-flight statement at its next kill
+            # safepoint; KILL CONNECTION additionally closes the
+            # session — every later execute on it fails (reference:
+            # pkg/server kill handling)
+            target.killer.kill()
+            if not s.query_only:
+                target._killed_conn = True
+            r = Result([], [])
         elif isinstance(s, ast.SetResourceGroup):
             # validate the group exists before binding
             self.catalog.resource_groups.get(s.name)
@@ -1693,6 +1741,27 @@ class Session:
             ]
             return Result(
                 ["Field", "Type", "Null", "Key", "Default"], rows
+            )
+        if s.what == "processlist":
+            rows = []
+            reg = getattr(self.catalog, "_session_registry", {})
+            for cid in sorted(reg):
+                sess2 = reg.get(cid)  # weak dict: may vanish mid-walk
+                if sess2 is None:
+                    continue
+                cur = sess2._current_stmt
+                rows.append(
+                    (
+                        cid,
+                        sess2.user,
+                        sess2.db,
+                        "Query" if cur is not None else "Sleep",
+                        int(time.time() - cur[1]) if cur else 0,
+                        str(cur[0])[:100] if cur else None,
+                    )
+                )
+            return Result(
+                ["Id", "User", "db", "Command", "Time", "Info"], rows
             )
         if s.what in ("create_table", "create_view"):
             db, name = s.db.split(".", 1)
@@ -2417,6 +2486,42 @@ class Session:
                 ct, "fk_update_actions", {}
             ).get(nm.lower(), "restrict")
         return out
+
+    def _fk_update_guard(self, t, db, name, names, rows, undo):
+        """Parent-key rewrite guard, shared by the single- and
+        multi-table UPDATE paths: RESTRICT-checks children against the
+        post-image value sets (honoring each FK's ON UPDATE action) and
+        returns the post-install cascade/set-null plans."""
+        children = self._fk_children(db, name)
+        if not children:
+            return []
+        upd_acts = self._fk_upd_acts(children)
+        need = {rc for _, _, _, _, rc, _a in children}
+        need |= {
+            c for cd, ct, _, c, _, _a in children
+            if cd == db.lower() and ct == t.name
+        }
+        remaining = {
+            col: {
+                row[names.index(col)] for row in rows
+                if row[names.index(col)] is not None
+            }
+            for col in need
+        }
+        action_children = [
+            c for c in children
+            if upd_acts[(c[0], c[1], c[2])] in ("cascade", "set_null")
+        ]
+        cascade_maps = (
+            self._fk_update_plans(
+                t, names, rows, action_children, upd_acts, remaining
+            )
+            if action_children else []
+        )
+        self._enforce_parent_constraints(
+            db, name, remaining, update_acts=upd_acts, undo=undo
+        )
+        return cascade_maps
 
     def _apply_fk_update_plans(self, cascade_maps, undo) -> None:
         """Dispatch the post-install child actions from
@@ -3190,35 +3295,10 @@ class Session:
         # ON UPDATE action applies: RESTRICT raises, SET NULL nulls,
         # CASCADE rewrites child keys from the old->new pairing)
         self._enforce_write_constraints(t, db, rows)
-        children = self._fk_children(db, s.table)
         undo: list = []
-        cascade_maps: list = []
-        if children:
-            names = t.schema.names
-            upd_acts = self._fk_upd_acts(children)
-            need = {rc for _, _, _, _, rc, _a in children}
-            need |= {
-                c for cd, ct, _, c, _, _a in children
-                if cd == db.lower() and ct == t.name
-            }
-            remaining = {
-                col: {
-                    row[names.index(col)] for row in rows
-                    if row[names.index(col)] is not None
-                }
-                for col in need
-            }
-            action_children = [
-                c for c in children
-                if upd_acts[(c[0], c[1], c[2])] in ("cascade", "set_null")
-            ]
-            if action_children:
-                cascade_maps = self._fk_update_plans(
-                    t, names, rows, action_children, upd_acts, remaining
-                )
-            self._enforce_parent_constraints(
-                db, s.table, remaining, update_acts=upd_acts, undo=undo
-            )
+        cascade_maps = self._fk_update_guard(
+            t, db, s.table, t.schema.names, rows, undo
+        )
         # count affected
         if s.where is None:
             affected = len(rows)
@@ -3568,90 +3648,68 @@ class Session:
             pos += 1 + len(per[alias])
 
         affected = 0
-        for alias in aliases:
-            tr = refs[alias]
-            db = (tr.db or self.db).lower()
-            t = self._resolve_table_for_write(db, tr.name)
-            base = offs[alias]
-            nsets = len(per[alias])
-            new_by_handle: dict = {}
-            for row in r.rows:
-                h = row[base]
-                if h is None or h in new_by_handle:
-                    continue  # no-match row (outer join) / first match wins
-                new_by_handle[int(h)] = row[base + 1 : base + 1 + nsets]
-            if not new_by_handle:
-                continue
-            # full decoded row image with new values applied at handles
-            names = t.schema.names
-            cidx = {n: k for k, n in enumerate(names)}
-            rows = []
-            for b in t.blocks():
-                decs = [b.columns[n].decode() for n in names]
-                vals = [b.columns[n].valid for n in names]
-                for k in range(b.nrows):
-                    rows.append(
-                        [
-                            decs[c][k] if vals[c][k] else None
-                            for c in range(len(names))
-                        ]
-                    )
-            for h, new in new_by_handle.items():
-                if not (0 <= h < len(rows)):
-                    raise ValueError(f"stale row handle {h} in UPDATE")
-                for (c, _e), v in zip(per[alias], new):
-                    rows[h][cidx[c]] = v
-            self._enforce_write_constraints(t, db, rows)
-            children = self._fk_children(db, tr.name)
-            undo: list = []
-            cascade_maps: list = []
-            if children:
-                upd_acts = self._fk_upd_acts(children)
-                need = {rc for _, _, _, _, rc, _a in children}
-                need |= {
-                    c for cd, ct, _, c, _, _a in children
-                    if cd == db and ct == t.name
-                }
-                remaining = {
-                    col: {
-                        row[cidx[col]] for row in rows
-                        if row[cidx[col]] is not None
-                    }
-                    for col in need
-                }
-                action_children = [
-                    c for c in children
-                    if upd_acts[(c[0], c[1], c[2])]
-                    in ("cascade", "set_null")
-                ]
-                if action_children:
-                    # rows[] was built FROM t.blocks() in scan order, so
-                    # the pre/post alignment is exact by construction
-                    cascade_maps = self._fk_update_plans(
-                        t, names, rows, action_children, upd_acts,
-                        remaining,
-                    )
-                self._enforce_parent_constraints(
-                    db, tr.name, remaining, update_acts=upd_acts,
-                    undo=undo,
+        # statement-level rollback state: a failure on the SECOND target
+        # must also restore the first target and its FK cascades (the
+        # statement is atomic across every table it touches)
+        stmt_undo: list = []
+        saved: list = []  # (table, blocks, dicts, modified_rows)
+        try:
+            for alias in aliases:
+                tr = refs[alias]
+                db = (tr.db or self.db).lower()
+                t = self._resolve_table_for_write(db, tr.name)
+                base = offs[alias]
+                nsets = len(per[alias])
+                new_by_handle: dict = {}
+                for row in r.rows:
+                    h = row[base]
+                    if h is None or h in new_by_handle:
+                        continue  # no-match (outer join) / first match wins
+                    new_by_handle[int(h)] = row[base + 1 : base + 1 + nsets]
+                if not new_by_handle:
+                    continue
+                # full decoded row image with new values applied at handles
+                names = t.schema.names
+                cidx = {n: k for k, n in enumerate(names)}
+                rows = []
+                for b in t.blocks():
+                    decs = [b.columns[n].decode() for n in names]
+                    vals = [b.columns[n].valid for n in names]
+                    for k in range(b.nrows):
+                        rows.append(
+                            [
+                                decs[c][k] if vals[c][k] else None
+                                for c in range(len(names))
+                            ]
+                        )
+                for h, new in new_by_handle.items():
+                    if not (0 <= h < len(rows)):
+                        raise ValueError(f"stale row handle {h} in UPDATE")
+                    for (c, _e), v in zip(per[alias], new):
+                        rows[h][cidx[c]] = v
+                self._enforce_write_constraints(t, db, rows)
+                # rows[] was built FROM t.blocks() in scan order, so the
+                # pre/post alignment the guard needs is exact
+                cascade_maps = self._fk_update_guard(
+                    t, db, tr.name, names, rows, stmt_undo
                 )
-            saved_blocks = list(t.blocks())
-            saved_dicts = dict(t.dictionaries)
-            t.replace_blocks([], modified_rows=len(new_by_handle))
-            try:
+                saved.append(
+                    (t, list(t.blocks()), dict(t.dictionaries),
+                     len(new_by_handle))
+                )
+                t.replace_blocks([], modified_rows=len(new_by_handle))
                 if rows:
                     t.append_rows(rows)
-                self._apply_fk_update_plans(cascade_maps, undo)
-            except Exception:
-                # undo first: a self-FK snapshot taken post-append must
-                # not overwrite the parent rollback (see _run_update)
-                self._fk_undo_restore(undo)
-                t.replace_blocks(
-                    saved_blocks, modified_rows=len(new_by_handle)
-                )
-                t.dictionaries = saved_dicts
-                raise
-            affected += len(new_by_handle)
+                self._apply_fk_update_plans(cascade_maps, stmt_undo)
+                affected += len(new_by_handle)
+        except Exception:
+            # undo first (child snapshots may be post-append), then the
+            # targets in reverse order — see _run_update's ordering note
+            self._fk_undo_restore(stmt_undo)
+            for t2, blocks2, dicts2, mod2 in reversed(saved):
+                t2.replace_blocks(blocks2, modified_rows=mod2)
+                t2.dictionaries = dicts2
+            raise
         clear_scan_cache()
         return Result([], [], affected=affected)
 
