@@ -21,10 +21,27 @@ PY
 }
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
+  # Never bench while a test suite holds the CPU: the numpy-baseline
+  # phase runs on the same single core and a concurrent pytest would
+  # inflate vs_baseline dishonestly. conftest.py writes a per-pid lock
+  # for every pytest session and refreshes its mtime per test; ignore
+  # locks idle >30min (crashed runs). While we bench, /tmp/bench.lock
+  # tells a newly-starting suite to wait for us instead.
+  if [ -n "$(find /tmp -maxdepth 1 -name 'suite.lock.*' -mmin -30 2>/dev/null)" ]; then
+    sleep 20; continue
+  fi
   if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     sleep 20; continue
   fi
   echo "=== $(date -u +%H:%M:%S) tunnel up"
+  touch /tmp/bench.lock
+  trap 'rm -f /tmp/bench.lock' EXIT
+  # re-check AFTER claiming bench.lock: a suite that started during the
+  # ~45s tunnel probe has written its lock by now; one side always sees
+  # the other (its conftest waits on bench.lock from here on)
+  if [ -n "$(find /tmp -maxdepth 1 -name 'suite.lock.*' -mmin -30 2>/dev/null)" ]; then
+    rm -f /tmp/bench.lock; sleep 20; continue
+  fi
   if ! have_bench q1_sf10; then
     echo "--- bench q1 sf10"
     TIDB_TPU_BENCH_TIMEOUT=600 timeout 700 python bench.py --query q1 --sf 10 --repeat 3 2>&1 | tail -1
@@ -45,7 +62,9 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     TIDB_TPU_BENCH_TIMEOUT=900 timeout 1000 python bench.py --query q95 --sf 1 --repeat 3 2>&1 | tail -1
   else
     echo "=== ALL ARTIFACTS CAPTURED"
+    rm -f /tmp/bench.lock
     exit 0
   fi
+  rm -f /tmp/bench.lock
 done
 echo "deadline reached"

@@ -496,12 +496,39 @@ class TestCompositeKeys:
             "select v from t where k = 'cc'"
         ).rows == [(4,)]
 
-    def test_insert_ignore_null_pk_component_dropped(self, sess):
-        # IGNORE demotes the NULL-PK error to a dropped row; the valid
-        # row in the same statement still lands
+    def test_insert_ignore_null_pk_takes_implicit_default(self, sess):
+        # MySQL IGNORE demotes the NULL-PK error to a warning and
+        # inserts the column's IMPLICIT default (0 for ints) — the row
+        # is kept, not dropped (advisor r3)
         sess.execute("create table t (a int, b int, v int, primary key (a, b))")
         sess.execute("insert ignore into t values (1, null, 9), (2, 2, 8)")
-        assert sess.execute("select a, b, v from t").rows == [(2, 2, 8)]
+        assert sess.execute(
+            "select a, b, v from t order by a"
+        ).rows == [(1, 0, 9), (2, 2, 8)]
+        # a second NULL in the same slot now COLLIDES with the implicit
+        # default already stored — that duplicate is dropped
+        sess.execute("insert ignore into t values (1, null, 7)")
+        assert sess.execute(
+            "select v from t where a = 1"
+        ).rows == [(9,)]
+        # string PK component: implicit default is ''
+        sess.execute(
+            "create table s (k varchar(8), n int, v int, primary key (k, n))"
+        )
+        sess.execute("insert ignore into s values (null, 1, 5)")
+        assert sess.execute("select k, n, v from s").rows == [("", 1, 5)]
+
+    def test_insert_ignore_null_pk_with_on_dup_updates(self, sess):
+        # the implicit-default fill happens BEFORE ON DUPLICATE KEY
+        # matching, so a NULL-keyed row updates the implicit-default row
+        # (MySQL semantics) instead of erroring or being dropped
+        sess.execute("create table t (a int, b int, v int, primary key (a, b))")
+        sess.execute("insert into t values (1, 0, 5)")
+        sess.execute(
+            "insert ignore into t values (1, null, 9) "
+            "on duplicate key update v = 99"
+        )
+        assert sess.execute("select a, b, v from t").rows == [(1, 0, 99)]
 
 
 class TestFKOnUpdateActions:

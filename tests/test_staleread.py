@@ -155,3 +155,144 @@ class TestStalenessEdges:
         finally:
             sess.execute("set tidb_read_staleness = 0")
         assert rows[0][0] in (0, 1)  # oldest retained state, no error
+
+
+class TestPreparedStaleRead:
+    """Advisor r3 (medium): EXECUTE is the top-level statement, so the
+    depth-1 AS OF collection used to see only the EXECUTE node and
+    prepared stale reads silently returned CURRENT data."""
+
+    def test_prepared_as_of_sees_history(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        time.sleep(0.02)
+        ts_mid = time.time()
+        time.sleep(0.02)
+        sess.execute("insert into t values (2)")
+        sess.execute(
+            f"prepare p from 'select count(*) from t as of timestamp {ts_mid}'"
+        )
+        # repeated EXECUTEs: the first plans, later ones may hit the
+        # compiled fast path — both must resolve the historical version
+        for _ in range(3):
+            assert sess.execute("execute p").rows == [(1,)]
+        sess.execute("insert into t values (3)")
+        for _ in range(2):
+            assert sess.execute("execute p").rows == [(1,)]
+        assert sess.execute("select count(*) from t").rows == [(3,)]
+
+    def test_prepared_read_staleness_applies(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        t = sess.catalog.table(sess.db, "t")
+        old = t.version
+        sess.execute("insert into t values (2)")
+        # deterministic window: backdate every version at-or-before the
+        # first insert so `now - 60` resolves exactly to it, regardless
+        # of host timing (a timing-guarded assert would pass vacuously
+        # on a slow host)
+        for v in list(t.version_ts):
+            if v <= old:
+                t.version_ts[v] = time.time() - 120
+        assert t.version_at(time.time() - 60, clamp_oldest=True) == old
+        sess.execute("prepare p from 'select count(*) from t'")
+        sess.execute("set tidb_read_staleness = -60")
+        try:
+            assert sess.execute("execute p").rows == [(1,)]
+        finally:
+            sess.execute("set tidb_read_staleness = 0")
+        assert sess.execute("execute p").rows == [(2,)]
+
+    def test_prepared_dml_as_of_rejected(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        ts = time.time()
+        sess.execute(
+            "prepare p from "
+            f"'insert into t select a from t as of timestamp {ts}'"
+        )
+        with pytest.raises(ValueError, match="read-only"):
+            sess.execute("execute p")
+
+    def test_prepared_as_of_param_rebinds(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        time.sleep(0.02)
+        ts1 = time.time()
+        time.sleep(0.02)
+        sess.execute("insert into t values (2)")
+        time.sleep(0.02)
+        ts2 = time.time()
+        sess.execute("prepare p from 'select count(*) from t as of timestamp ?'")
+        sess.user_vars["a"] = ts1
+        sess.user_vars["b"] = ts2
+        r1 = sess.execute("execute p using @a").rows
+        r2 = sess.execute("execute p using @b").rows
+        assert (r1, r2) == ([(1,)], [(2,)])
+
+    def test_prepared_as_of_rebinds_after_use(self, sess):
+        # a USE between EXECUTEs must replan: unqualified refs resolve
+        # against the CURRENT db, and the (db, table)-keyed as-of map
+        # must follow (code-review r4 finding)
+        sess.execute("create database d2")
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        time.sleep(0.02)
+        ts = time.time()
+        time.sleep(0.02)
+        sess.execute("insert into t values (2)")
+        sess.execute("create table d2.t (a int)")
+        sess.execute("insert into d2.t values (10), (20), (30)")
+        sess.execute(
+            f"prepare p from 'select count(*) from t as of timestamp {ts} "
+            "where a > ?'"
+        )
+        sess.user_vars["z"] = 0
+        assert sess.execute("execute p using @z").rows == [(1,)]
+        db0 = sess.db
+        sess.execute("use d2")
+        try:
+            with pytest.raises(ValueError):
+                # d2.t was created after ts: resolving it at ts errors —
+                # proof the re-bound db (not the stale d1 plan) is read
+                sess.execute("execute p using @z")
+        finally:
+            sess.execute(f"use {db0}")
+        assert sess.execute("execute p using @z").rows == [(1,)]
+
+
+class TestSessionTimeZone:
+    def test_naive_literal_uses_session_offset(self, sess):
+        import datetime as dt
+
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        time.sleep(0.02)
+        ts_mid = time.time()
+        time.sleep(0.02)
+        sess.execute("insert into t values (2)")
+        # express ts_mid as a naive literal in +02:00 — with the session
+        # tz honored it resolves back to the same instant
+        lit = dt.datetime.fromtimestamp(
+            ts_mid, dt.timezone(dt.timedelta(hours=2))
+        ).replace(tzinfo=None).isoformat()
+        sess.execute("set time_zone = '+02:00'")
+        try:
+            r = sess.execute(
+                f"select count(*) from t as of timestamp '{lit}'"
+            )
+        finally:
+            sess.execute("set time_zone = 'UTC'")
+        assert r.rows == [(1,)]
+
+    def test_unknown_time_zone_raises(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1)")
+        sess.execute("set time_zone = 'No/Such_Zone'")
+        try:
+            with pytest.raises(ValueError, match="time zone"):
+                sess.execute(
+                    "select * from t as of timestamp '2026-01-01 00:00:00'"
+                )
+        finally:
+            sess.execute("set time_zone = 'UTC'")

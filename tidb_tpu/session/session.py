@@ -151,6 +151,12 @@ class Session:
         # statement is read-only (tidb_read_staleness applies only then)
         self._stmt_as_of: dict = {}
         self._stale_ok = False
+        # EXECUTE dispatch marker: the depth gate below keeps nested
+        # statements (TRACE inner stmt) from clobbering stale-read
+        # state, but a prepared statement dispatched via SQL EXECUTE is
+        # semantically top-level even at depth 2 — without this flag its
+        # AS OF refs would silently read CURRENT data
+        self._prepared_dispatch = False
         # RU governance binding (SET RESOURCE GROUP <name>)
         self.resource_group = "default"
         # processlist registry: catalog-wide id -> weakref(Session) so
@@ -270,6 +276,34 @@ class Session:
     # -- pessimistic locking (reference: LockKeys in the pessimistic txn
     # path, pkg/store/driver/txn/txn_driver.go; deadlock detector
     # unistore/tikv/detector.go) --------------------------------------
+    def _session_tzinfo(self):
+        """tzinfo for the session time_zone sysvar: 'UTC' (default),
+        '+HH:MM'/'-HH:MM' offsets, IANA names via zoneinfo, or 'SYSTEM'
+        (host local). Unrecognized values raise — silently interpreting
+        a literal in the wrong zone would shift every stale read by the
+        offset (the silent-wrong-data hazard)."""
+        import datetime as _dt
+
+        tz = str(self.vars.get("time_zone") or "UTC").strip()
+        up = tz.upper()
+        if up in ("UTC", "GMT"):
+            return _dt.timezone.utc
+        if up == "SYSTEM":
+            return _dt.datetime.now().astimezone().tzinfo
+        if tz and tz[0] in "+-":
+            try:
+                hh, _sep, mm = tz[1:].partition(":")
+                off = _dt.timedelta(hours=int(hh), minutes=int(mm or 0))
+                return _dt.timezone(-off if tz[0] == "-" else off)
+            except ValueError:
+                raise ValueError(f"Unknown or incorrect time zone: {tz!r}")
+        try:
+            import zoneinfo
+
+            return zoneinfo.ZoneInfo(tz)
+        except Exception:
+            raise ValueError(f"Unknown or incorrect time zone: {tz!r}")
+
     def _collect_as_of(self, s) -> dict:
         """Collect `AS OF TIMESTAMP` table refs across the whole
         statement tree; returns {(db, table): epoch ts}. The resolver is
@@ -290,7 +324,16 @@ class Session:
                 except ValueError:
                     import datetime as _dt
 
-                    return _dt.datetime.fromisoformat(v).timestamp()
+                    dt = _dt.datetime.fromisoformat(v)
+                    if dt.tzinfo is None:
+                        # naive literals resolve in the session
+                        # time_zone (default UTC), never the host's —
+                        # version_ts is epoch-stamped, so a host-local
+                        # interpretation would shift every stale read by
+                        # the TZ offset (reference: types.ParseTime with
+                        # sessionctx time zone)
+                        dt = dt.replace(tzinfo=self._session_tzinfo())
+                    return dt.timestamp()
             raise ValueError(
                 f"cannot evaluate AS OF TIMESTAMP expression: {expr!r}"
             )
@@ -524,16 +567,44 @@ class Session:
         if "textual" in ent:
             from tidb_tpu.server.protocol import bind_placeholders
 
-            return self.execute(bind_placeholders(ent["textual"], values))
+            self._prepared_dispatch = True
+            try:
+                return self.execute(bind_placeholders(ent["textual"], values))
+            finally:
+                self._prepared_dispatch = False
         types_sig = tuple(type(v).__name__ for v in values)
 
         from tidb_tpu.utils.failpoint import inject
 
         inject("session/execute-prepared")
+        # stale-read state for the compiled fast path: no _execute_stmt
+        # runs there, so collect AS OF / read-only-ness from the prepared
+        # AST here — _fetch_inputs resolves versions through
+        # _resolve_table_for_read at run time, which consults this state.
+        # An `AS OF TIMESTAMP ?` param is a baked slot, so the fast path
+        # only fires when the AST already holds the current value.
+        # fast-path eligibility, computed ONCE: the db guard matters
+        # because unqualified refs resolve against the CURRENT db at
+        # execute time (slow-path semantics), so a USE since planning
+        # must force a replan — both for data resolution and for the
+        # (db, table)-keyed _stmt_as_of map collected below
+        fast_eligible = (
+            ent.get("plan") is not None and ent.get("db") == self.db
+        )
+        if fast_eligible:
+            p_ast = ent["ast"]
+            if isinstance(p_ast, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+                self._stale_ok = True
+                # has_as_of is structural (recorded at plan time): the
+                # common no-AS-OF EXECUTE skips the AST walk entirely
+                self._stmt_as_of = (
+                    self._collect_as_of(p_ast)
+                    if ent.get("has_as_of") else {}
+                )
         # fast path: the held CompiledQuery re-runs with new runtime-slot
         # values as jitted-program inputs — no parse, no plan, no trace
         if (
-            ent.get("plan") is not None
+            fast_eligible
             and ent.get("schema_version") == self.catalog.schema_version
             and ent.get("types_sig") == types_sig
             and all(values[i] == ent["values"][i] for i in ent["baked"])
@@ -578,10 +649,12 @@ class Session:
                 if c is not None:
                     pv[i] = c
         self.executor.param_values = pv
+        self._prepared_dispatch = True
         try:
             with param_registry() as reg:
                 r = self._execute_stmt(s)
         finally:
+            self._prepared_dispatch = False
             self.executor.param_values = {}
         plan = self._last_plan
         runtime = set()
@@ -593,6 +666,10 @@ class Session:
                 ckey = self.executor._cache_key(plan)
                 cq = self.executor._cache.get(ckey)
         ent.update(
+            db=self.db,
+            has_as_of=any(
+                r.as_of is not None for r in ast.iter_table_refs(s)
+            ),
             pv_slots=set(pv),
             plan=plan if (runtime and cq is not None) else None,
             cq=cq,
@@ -1141,7 +1218,9 @@ class Session:
         failpoint.inject("session/stmt-start")
         self._enforce_privileges(s)
         is_read = isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp))
-        if self._stmt_depth == 1:
+        dispatch = self._stmt_depth == 1 or self._prepared_dispatch
+        self._prepared_dispatch = False
+        if dispatch:
             # tidb_read_staleness applies to top-level read statements
             # only — the SELECT half of INSERT..SELECT must see fresh
             # data (reference: staleness providers gate on read-only)
@@ -2711,6 +2790,38 @@ class Session:
         hit = ballv & (sorted_keys[pos] == bview)
         return hit, bview, ballv
 
+    def _fill_ignore_null_pk(self, t, names, rows):
+        """INSERT IGNORE: a NULL in a PK component (post-autoinc fill)
+        takes the column's IMPLICIT default — 0 / '' / zero-temporal —
+        so row counts match MySQL (pkg/table/column.go GetZeroValue
+        under stmtctx.TruncateAsWarning). Must run BEFORE ON DUPLICATE
+        KEY matching: the filled key participates in dup detection (a
+        NULL-keyed row can UPDATE the implicit-default row). Kinds with
+        no implicit default here drop the row."""
+        pk = t.schema.primary_key
+        if not pk or not rows:
+            return rows
+        zero = {
+            Kind.INT: 0, Kind.FLOAT: 0.0, Kind.BOOL: False,
+            Kind.DECIMAL: 0, Kind.STRING: "", Kind.DATE: 0,
+            Kind.DATETIME: 0, Kind.TIME: 0,
+        }
+        pk_idx = [
+            (names.index(c), zero.get(t.schema.types[c].kind))
+            for c in pk if c in names
+        ]
+        fixed = []
+        for r in rows:
+            if any(r[i] is None and z is None for i, z in pk_idx):
+                continue
+            if any(r[i] is None for i, _z in pk_idx):
+                r = list(r)
+                for i, z in pk_idx:
+                    if r[i] is None:
+                        r[i] = z
+            fixed.append(r)
+        return fixed
+
     def _filter_ignore(self, t, db: str, names, rows, skip_unique=False):
         """INSERT IGNORE: drop (instead of fail) rows that violate a
         CHECK, a FOREIGN KEY, or duplicate a PK/UNIQUE key against
@@ -2729,16 +2840,6 @@ class Session:
                 (names.index(col), parent,
                  names.index(rcol) if self_fk else None)
             )
-        # IGNORE demotes errors to dropped rows: a NULL in any PK
-        # component would be rejected by the append-time NOT NULL check
-        # and fail the whole statement — drop such rows here instead
-        # (MySQL: IGNORE turns the error into a warning)
-        pk = t.schema.primary_key
-        if pk and rows:
-            pk_idx = [names.index(c) for c in pk if c in names]
-            rows = [
-                r for r in rows if all(r[i] is not None for i in pk_idx)
-            ]
         key_state = []
         if not skip_unique and rows:
             key_sets = self._unique_key_sets(t)
@@ -3026,6 +3127,8 @@ class Session:
         # statement half-applied
         db = s.db or self.db
         n_upd = 0
+        if getattr(s, "ignore", False):
+            rows = self._fill_ignore_null_pk(t, names, rows)
         n_incoming = len(rows)
         origin: dict = {}
         if s.on_dup:

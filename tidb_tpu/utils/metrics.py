@@ -182,6 +182,12 @@ class StmtSummary:
                 for d, (n, s, mx, sample) in sorted(self._map.items())
             ]
 
+    def reset(self) -> None:
+        """Clear all digests (the statements_summary clear analog,
+        reference: stmtsummary Clear)."""
+        with self._lock:
+            self._map.clear()
+
 
 SLOW_LOG = SlowLog()
 STMT_SUMMARY = StmtSummary()
