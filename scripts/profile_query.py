@@ -1,0 +1,51 @@
+"""Profile any ladder query: compile vs steady-state split + EXPLAIN.
+
+Usage: python scripts/profile_query.py q18 1.0 [--explain]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+sys.path.insert(0, "/root/repo")
+import bench as B
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+def main():
+    q = sys.argv[1] if len(sys.argv) > 1 else "q18"
+    sf = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+    print("backend:", jax.default_backend(), flush=True)
+    cat = Catalog()
+    t0 = time.perf_counter()
+    load_tpch(cat, sf=sf, tables=B._TABLES[q], seed=1)
+    print(f"datagen: {time.perf_counter()-t0:.2f}s", flush=True)
+    sess = Session(cat, db="tpch")
+    sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
+    t0 = time.perf_counter()
+    for t in B._TABLES[q]:
+        sess.execute(f"analyze table {t}")
+    print(f"analyze: {time.perf_counter()-t0:.2f}s", flush=True)
+    sql = B.QUERIES[q]
+    if "--explain" in sys.argv:
+        for row in sess.execute("explain " + sql).rows:
+            print("  ", row[0], flush=True)
+    t0 = time.perf_counter()
+    r = sess.execute(sql)
+    print(f"first execute: {time.perf_counter()-t0:.2f}s ({len(r.rows)} rows)",
+          flush=True)
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        sess.execute(sql)
+        times.append(time.perf_counter() - t0)
+    print("steady:", " ".join(f"{t:.3f}s" for t in times), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Decompose a ladder query's steady-state execute on the current backend.
+
+Usage: python scripts/profile_steady.py q6 1.0
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, "/root/repo")
+
+import jax
+
+import bench as B
+from tidb_tpu.bench import load_tpch
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+def main():
+    q = sys.argv[1]
+    sf = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+    print("backend:", jax.default_backend(), flush=True)
+    cat = Catalog()
+    load_tpch(cat, sf=sf, tables=B._TABLES[q], seed=1)
+    sess = Session(cat, db="tpch")
+    sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
+    for t in B._TABLES[q]:
+        sess.execute(f"analyze table {t}")
+    sql = B.QUERIES[q]
+    sess.execute(sql)
+    sess.execute(sql)
+
+    from tidb_tpu.parser import parse as parse_sql
+    from tidb_tpu.planner.logical import build_query
+
+    ex = sess.executor
+    t0 = time.perf_counter(); stmts = parse_sql(sql); t_parse = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    plan = build_query(stmts[0], cat, "tpch", sess._scalar_subquery)
+    t_plan = time.perf_counter() - t0
+    key = ex._cache_key(plan)
+    cq = ex._cache.get(key)
+    print(f"parse {t_parse*1e3:.1f}ms  plan {t_plan*1e3:.1f}ms  cache_hit={cq is not None}", flush=True)
+    if cq is None:
+        return
+    pins = []
+    resolved = {}
+    t0 = time.perf_counter()
+    inputs = ex._fetch_inputs(cq, mesh=ex.mesh, pins=pins, resolved=resolved)
+    t_fetch = time.perf_counter() - t0
+    for nid, col in cq.nonnull:
+        t, v = resolved[nid]
+        t.col_has_nulls(col, v)
+    params = ex._params()
+    print(f"fetch {t_fetch*1e3:.1f}ms", flush=True)
+    for i in range(3):
+        t0 = time.perf_counter()
+        out, needs = cq.jitted(inputs, params)
+        jax.block_until_ready(jax.tree_util.tree_leaves((out, needs)))
+        print(f"jitted run #{i}: {(time.perf_counter()-t0)*1e3:.1f}ms", flush=True)
+    t0 = time.perf_counter()
+    host = jax.device_get((needs, out))
+    print(f"device_get: {(time.perf_counter()-t0)*1e3:.1f}ms", flush=True)
+    for t, v in pins:
+        t.unpin(v)
+    # whole statement again for comparison
+    t0 = time.perf_counter()
+    r = sess.execute(sql)
+    print(f"whole execute: {(time.perf_counter()-t0)*1e3:.1f}ms rows={len(r.rows)}", flush=True)
+
+
+main()

@@ -1,0 +1,161 @@
+"""Aggregation pushdown through joins, selection sinking, and
+bounded-sum narrowing.
+
+Reference: TiDB's rule_aggregation_push_down.go (partial-agg pushdown;
+this build pushes the FULL aggregate exactly under a join-side
+uniqueness proof — suits whole-plan XLA compilation), plus the
+fetch-time re-verification contract of planner/physical.py
+(CompiledQuery.bound_checks, mirroring the nonnull recheck).
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database apd")
+    s.execute("use apd")
+    return s
+
+
+def _plan(sess, sql):
+    return "\n".join(r[0] for r in sess.execute("explain " + sql).rows)
+
+
+class TestAggPushdown:
+    def setup_tables(self, sess):
+        sess.execute("create table o (ok int primary key, flag int)")
+        sess.execute("create table l (lk int, qty int)")
+        sess.execute(
+            "insert into o values (1, 0), (2, 1), (3, 0), (5, 1)"
+        )
+        sess.execute(
+            "insert into l values (1, 10), (1, 20), (2, 5), (3, 7), "
+            "(4, 99), (null, 50)"
+        )
+
+    def test_pushes_below_join_and_matches(self, sess):
+        self.setup_tables(sess)
+        sql = (
+            "select ok, sum(qty) from l, o where ok = lk "
+            "group by ok order by ok"
+        )
+        plan = _plan(sess, sql)
+        # the Aggregate must sit BELOW the join (over the l scan)
+        assert plan.index("JoinPlan") < plan.index("Aggregate")
+        assert sess.execute(sql).rows == [(1, 30), (2, 5), (3, 7)]
+
+    def test_having_sinks_below_join(self, sess):
+        self.setup_tables(sess)
+        sql = (
+            "select ok, sum(qty) from l, o where ok = lk "
+            "group by ok having sum(qty) > 8 order by ok"
+        )
+        plan = _plan(sess, sql)
+        assert plan.index("JoinPlan") < plan.index("Selection")
+        assert sess.execute(sql).rows == [(1, 30)]
+
+    def test_count_star_pushdown_exact(self, sess):
+        self.setup_tables(sess)
+        sql = (
+            "select ok, count(*) from l, o where ok = lk "
+            "group by ok order by ok"
+        )
+        assert sess.execute(sql).rows == [(1, 2), (2, 1), (3, 1)]
+
+    def test_no_pushdown_when_side_not_unique(self, sess):
+        # o2.ok is NOT unique: the join can duplicate l rows, so the
+        # aggregate must stay above the join (sum counts each match)
+        sess.execute("create table o2 (ok int, flag int)")
+        sess.execute("create table l2 (lk int, qty int)")
+        sess.execute("insert into o2 values (1, 0), (1, 1), (2, 0)")
+        sess.execute("insert into l2 values (1, 10), (2, 5)")
+        sql = (
+            "select ok, sum(qty) from l2, o2 where ok = lk "
+            "group by ok order by ok"
+        )
+        plan = _plan(sess, sql)
+        assert plan.index("Aggregate") < plan.index("JoinPlan")
+        assert sess.execute(sql).rows == [(1, 20), (2, 5)]
+
+    def test_no_pushdown_with_args_from_both_sides(self, sess):
+        self.setup_tables(sess)
+        sql = (
+            "select ok, sum(qty + flag) from l, o where ok = lk "
+            "group by ok order by ok"
+        )
+        plan = _plan(sess, sql)
+        assert plan.index("Aggregate") < plan.index("JoinPlan")
+        assert sess.execute(sql).rows == [(1, 30), (2, 6), (3, 7)]
+
+    def test_pushdown_groups_from_push_side_extra_key(self, sess):
+        self.setup_tables(sess)
+        # extra group key from the push side alongside the join key
+        sql = (
+            "select ok, qty, count(*) from l, o where ok = lk "
+            "group by ok, qty order by ok, qty"
+        )
+        assert sess.execute(sql).rows == [
+            (1, 10, 1), (1, 20, 1), (2, 5, 1), (3, 7, 1)
+        ]
+
+    def test_left_join_not_pushed(self, sess):
+        self.setup_tables(sess)
+        sql = (
+            "select ok, sum(qty) from o left join l on ok = lk "
+            "group by ok order by ok"
+        )
+        plan = _plan(sess, sql)
+        assert plan.index("Aggregate") < plan.index("JoinPlan")
+        rows = sess.execute(sql).rows
+        assert rows == [(1, 30), (2, 5), (3, 7), (5, None)]
+
+
+class TestBoundedSumNarrowing:
+    def test_scale4_sum_exact_after_growth(self, sess):
+        # decimal(scale 2) * decimal(scale 2) -> scale-4 sum; small
+        # bounds prove single-lane accumulation, then an insert grows
+        # the bounds past the baked interval -> recompile, stays exact
+        sess.execute(
+            "create table t (p decimal(10,2), d decimal(10,2))"
+        )
+        sess.execute(
+            "insert into t values (10.00, 0.05), (20.00, 0.07)"
+        )
+        q = "select sum(p * d) from t"
+        assert float(sess.execute(q).rows[0][0]) == pytest.approx(1.9)
+        # growth: values far beyond the compile-time column bounds (but
+        # with per-element products still inside int64 — element-level
+        # decimal range is a separate, pre-existing limit)
+        sess.execute("insert into t values (3000000.00, 1.00)")
+        got = float(sess.execute(q).rows[0][0])
+        assert got == pytest.approx(3000000.0 + 1.9, rel=1e-12)
+        # and the sum stays exact for repeated large rows (the narrow
+        # proof must NOT survive the bound growth)
+        sess.execute("insert into t values (3000000.00, 1.00)")
+        got = float(sess.execute(q).rows[0][0])
+        assert got == pytest.approx(6000000.0 + 1.9, rel=1e-12)
+
+    def test_cascade_through_two_joins(self, sess):
+        # fact ⨝ dim1 ⨝ dim2, both dims unique: the aggregate cascades
+        # below BOTH joins (group key via two equivalence hops)
+        sess.execute("create table f (k1 int, v int)")
+        sess.execute("create table d1 (k1 int primary key)")
+        sess.execute("create table d2 (k1 int primary key)")
+        sess.execute("insert into f values (1, 10), (1, 20), (2, 5), (9, 1)")
+        sess.execute("insert into d1 values (1), (2), (3)")
+        sess.execute("insert into d2 values (1), (2)")
+        sql = (
+            "select f.k1, sum(v) from f, d1, d2 "
+            "where f.k1 = d1.k1 and f.k1 = d2.k1 "
+            "group by f.k1 order by f.k1"
+        )
+        plan = _plan(sess, sql)
+        # Aggregate below every JoinPlan line
+        agg_at = plan.index("Aggregate")
+        assert all(j < agg_at for j in
+                   [i for i in range(len(plan)) if plan.startswith("JoinPlan", i)])
+        assert sess.execute(sql).rows == [(1, 30), (2, 5)]

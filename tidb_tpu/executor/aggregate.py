@@ -555,6 +555,7 @@ def group_aggregate(
         )
         ngroups = jnp.where(overflow, jnp.int64(slots + 1), true_ng)
         occupied = claimer < cap
+        red = _pick_backend(seg, slots)
     else:
         # scalar aggregation: one group at slot 0
         slots = group_capacity
@@ -568,6 +569,7 @@ def group_aggregate(
         )
         occupied = claimer < cap
         ngroups = jnp.sum(occupied.astype(jnp.int64))
+        red = _scalar_backend(slots)
 
     group_valid = occupied
     cl = jnp.minimum(claimer, cap - 1)
@@ -579,7 +581,6 @@ def group_aggregate(
         kv = k.valid[cl] & group_valid
         out_cols[name] = DevCol(jnp.where(group_valid, kd, jnp.zeros_like(kd)), kv)
 
-    red = _pick_backend(seg, slots)
     return (
         _run_aggs(
             batch, aggs, arg_cols, seg, slots, group_valid, cl, out_cols, red,
@@ -587,6 +588,23 @@ def group_aggregate(
         ),
         fold_distinct_overflow(ngroups),
     )
+
+
+def _scalar_backend(slots):
+    """Scalar (no GROUP BY) reductions: exactly one group lives at slot
+    0, so each lane is ONE fused full-array jnp reduction. A segment
+    scatter here lowers to a serial element loop on CPU XLA (~5x a
+    fused reduction at 6M rows) and costs ~20x on TPU; no barrier —
+    with a single reduction per lane, fusing the producer expression in
+    is exactly what we want."""
+    ops = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
+
+    def red(op, vals, contrib, ident):
+        top = ops[op](jnp.where(contrib, vals, ident))
+        out = jnp.full((slots,), ident, dtype=top.dtype)
+        return out.at[0].set(top)
+
+    return red
 
 
 def _masked_backend(seg, slots):

@@ -1382,8 +1382,246 @@ def build_select(
         plan,
         [(n, ColumnRef(type=e.type, name=n)) for n, e in proj_exprs],
     )
+    # aggregation pushdown through joins + post-agg selection sinking
+    # (reference rule_aggregation_push_down.go; exactness conditions in
+    # _try_push_agg) — before pruning so the narrowed sides prune harder
+    plan = push_aggs_through_joins(plan, catalog)
+    plan = sink_selections(plan)
     # column pruning over the finished tree (reference columnPruner)
     plan = prune_plan(plan, {c.internal for c in plan.schema.cols})
+    return plan
+
+
+def _rebuild_children(plan: LogicalPlan, fn) -> LogicalPlan:
+    """Apply fn to every direct child plan, rebuilding the node."""
+    if isinstance(plan, (Scan, OneRow, Staged)):
+        return plan
+    if isinstance(plan, JoinPlan):
+        return dataclasses.replace(plan, left=fn(plan.left), right=fn(plan.right))
+    if isinstance(plan, UnionAll):
+        return dataclasses.replace(plan, children=[fn(c) for c in plan.children])
+    if hasattr(plan, "child"):
+        return dataclasses.replace(plan, child=fn(plan.child))
+    return plan
+
+
+def _key_unique_on(plan: LogicalPlan, key_internals, catalog) -> bool:
+    """True when `plan` provably yields at most one row per distinct
+    value tuple of key_internals: a PK / public unique index on a scan
+    (looked through Selections and renaming Projections), or an
+    Aggregate whose full group-key set is covered. The join-side
+    uniqueness proof behind aggregation pushdown (reference:
+    rule_aggregation_push_down.go checkAnyCountAndSum preconditions)."""
+    keys = list(key_internals)
+    p = plan
+    while True:
+        if isinstance(p, Selection):
+            p = p.child  # filtering can't break uniqueness
+            continue
+        if isinstance(p, Projection):
+            m = {
+                n: e.name for n, e in p.exprs if isinstance(e, ColumnRef)
+            }
+            nxt = []
+            for k in keys:
+                if k in m:
+                    nxt.append(m[k])
+                elif p.additive:
+                    nxt.append(k)
+                else:
+                    return False
+            keys = nxt
+            p = p.child
+            continue
+        break
+    if isinstance(p, Aggregate):
+        gnames = {n for n, _ in p.group_exprs}
+        return bool(gnames) and gnames.issubset(set(keys))
+    if not isinstance(p, Scan):
+        return False
+    cols = []
+    pre = f"{p.alias}."
+    for k in keys:
+        if not k.startswith(pre):
+            return False
+        cols.append(k[len(pre):])
+    try:
+        t = catalog.table(p.db, p.table)
+    except Exception:
+        return False
+    pk = t.schema.primary_key
+    if pk and set(pk).issubset(cols):
+        return True
+    for iname in getattr(t, "unique_indexes", ()):
+        if hasattr(t, "index_state") and t.index_state(iname) != "public":
+            continue
+        icols = t.indexes.get(iname) or []
+        if icols and set(icols).issubset(cols):
+            return True
+    return False
+
+
+def _try_push_agg(agg: Aggregate, catalog) -> Optional[LogicalPlan]:
+    """Aggregate over inner Join -> Join over Aggregate, EXACTLY, when:
+      1. every agg argument references one join side only (the push
+         side), and gc_meta is absent;
+      2. every group expr references the push side, or is a ColumnRef
+         equal (via an equi key) to a push-side key column;
+      3. every push-side equi key appears among the (rewritten) group
+         exprs — all rows of a group share one join key; and
+      4. the other side is provably unique on its equi-key tuple — each
+         group matches at most one row, so no contribution duplicates.
+    Under 3+4 the join becomes a per-group existence filter + column
+    extension, which commutes with the aggregation (including count(*):
+    per-group joined-row count == push-side row count). Reference:
+    rule_aggregation_push_down.go (TiDB pushes a PARTIAL agg and
+    re-aggregates; with the uniqueness proof the single aggregate is
+    exact, which suits whole-plan XLA compilation better)."""
+    j = agg.child
+    if (
+        not isinstance(j, JoinPlan)
+        or j.kind != "inner"
+        or j.residual is not None
+        or j.null_aware
+        or j.mark_name is not None
+        or not j.equi_keys
+        or agg.gc_meta
+    ):
+        return None
+    if not all(
+        isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
+        for l, r in j.equi_keys
+    ):
+        return None
+    from tidb_tpu.expression.expr import walk_columns
+
+    left_names = {c.internal for c in j.left.schema.cols}
+    right_names = {c.internal for c in j.right.schema.cols}
+    arg_cols: set = set()
+    for _n, _f, a, _d in agg.aggs:
+        if a is not None:
+            arg_cols |= walk_columns(a)
+    if arg_cols and arg_cols.issubset(left_names):
+        sides = ["left"]
+    elif arg_cols and arg_cols.issubset(right_names):
+        sides = ["right"]
+    elif not arg_cols:
+        sides = ["left", "right"]  # COUNT(*)-only: either side may work
+    else:
+        return None
+
+    for side in sides:
+        push, other = (j.left, j.right) if side == "left" else (j.right, j.left)
+        push_names = left_names if side == "left" else right_names
+        pairs = [
+            ((l, r) if side == "left" else (r, l)) for l, r in j.equi_keys
+        ]  # (push key, other key)
+        other_to_push = {ok.name: pk for pk, ok in pairs}
+        new_groups = []
+        ok = True
+        for n, g in agg.group_exprs:
+            gcols = walk_columns(g)
+            if gcols.issubset(push_names):
+                new_groups.append((n, g))
+            elif isinstance(g, ColumnRef) and g.name in other_to_push:
+                new_groups.append((n, other_to_push[g.name]))
+            else:
+                ok = False
+                break
+        if not ok:
+            continue
+        gmap = {
+            g.name: n for n, g in new_groups if isinstance(g, ColumnRef)
+        }
+        if not all(pk.name in gmap for pk, _ok2 in pairs):
+            continue
+        if not _key_unique_on(other, [okk.name for _pk, okk in pairs], catalog):
+            continue
+
+        agg_cols = []
+        agg_types = {c.internal: c.type for c in agg.schema.cols}
+        for n, g in new_groups:
+            agg_cols.append(OutCol(None, n, n, g.type))
+        for n, _f, _a, _d in agg.aggs:
+            agg_cols.append(OutCol(None, n, n, agg_types[n]))
+        new_agg = Aggregate(Schema(agg_cols), push, new_groups, agg.aggs)
+        new_keys = []
+        for pk, okk in pairs:
+            kref = ColumnRef(type=pk.type, name=gmap[pk.name])
+            new_keys.append(
+                (kref, okk) if side == "left" else (okk, kref)
+            )
+        nl, nr = (new_agg, other) if side == "left" else (other, new_agg)
+        # broadcast choice reset: side sizes changed fundamentally
+        return JoinPlan(
+            Schema(list(nl.schema.cols) + list(nr.schema.cols)),
+            "inner", nl, nr, new_keys, None,
+        )
+    return None
+
+
+def _push_agg_cascade(agg: Aggregate, catalog) -> Optional[LogicalPlan]:
+    """Push once, then re-try the pushed Aggregate against ITS join
+    child — multi-join chains (fact ⨝ dim1 ⨝ dim2) push all the way
+    down when every hop satisfies the exactness conditions."""
+    pushed = _try_push_agg(agg, catalog)
+    if pushed is None:
+        return None
+    for side in ("left", "right"):
+        child = getattr(pushed, side)
+        if isinstance(child, Aggregate):
+            deeper = _push_agg_cascade(child, catalog)
+            if deeper is not None:
+                return dataclasses.replace(pushed, **{side: deeper})
+    return pushed
+
+
+def push_aggs_through_joins(plan: LogicalPlan, catalog) -> LogicalPlan:
+    plan = _rebuild_children(
+        plan, lambda c: push_aggs_through_joins(c, catalog)
+    )
+    if isinstance(plan, Aggregate):
+        pushed = _push_agg_cascade(plan, catalog)
+        if pushed is not None:
+            return pushed
+    return plan
+
+
+def sink_selections(plan: LogicalPlan) -> LogicalPlan:
+    """Post-build selection sinking: a Selection lands as low as its
+    column footprint allows — through additive Projections and to one
+    side of an inner join (the HAVING-below-join shape that aggregation
+    pushdown exposes). WHERE conjuncts already sank during FROM build;
+    this pass covers predicates created above joins afterwards."""
+    plan = _rebuild_children(plan, sink_selections)
+    if not isinstance(plan, Selection):
+        return plan
+    from tidb_tpu.expression.expr import walk_columns
+
+    pred_cols = walk_columns(plan.predicate)
+    child = plan.child
+    if isinstance(child, Projection) and child.additive:
+        produced = {n for n, _ in child.exprs}
+        if not (pred_cols & produced):
+            inner = sink_selections(
+                Selection(child.child.schema, child.child, plan.predicate)
+            )
+            return Projection(
+                child.schema, inner, child.exprs, child.additive
+            )
+    if isinstance(child, JoinPlan) and child.kind == "inner":
+        left_names = {c.internal for c in child.left.schema.cols}
+        right_names = {c.internal for c in child.right.schema.cols}
+        if pred_cols and pred_cols.issubset(left_names):
+            nl = sink_selections(
+                Selection(child.left.schema, child.left, plan.predicate)
+            )
+            return dataclasses.replace(child, left=nl)
+        if pred_cols and pred_cols.issubset(right_names):
+            nr = sink_selections(
+                Selection(child.right.schema, child.right, plan.predicate)
+            )
+            return dataclasses.replace(child, right=nr)
     return plan
 
 

@@ -76,18 +76,41 @@ def _strip_uniq(dicts: Dicts) -> Dicts:
     return {k: v for k, v in dicts.items() if not k.startswith(_UNIQ_PREFIX)}
 
 
+def _merge_join_dicts(ldicts: Dicts, rdicts: Dicts, lu: bool, ru: bool) -> Dicts:
+    """Join output dictionaries with SELECTIVE uniqueness survival: an
+    inner join duplicates one side's rows only when the OTHER side's
+    equi key repeats, so a provably-unique build key (ru for the left
+    side's entries, lu for the right's) preserves that side's
+    uniqueness proofs. Keeps chained star joins (Q5's
+    region->nation->supplier->lineitem) on the dense 1:1 join path
+    instead of degrading to probe-chain hashing after the first hop."""
+    out: Dicts = {}
+    for k, v in ldicts.items():
+        if k.startswith(_UNIQ_PREFIX) and not ru:
+            continue
+        out[k] = v
+    for k, v in rdicts.items():
+        if k.startswith(_UNIQ_PREFIX) and not lu:
+            continue
+        out[k] = v
+    return out
+
+
 class _LazyBounds:
     """Deferred Table.col_bounds lookup pinned to a (table, col, version):
     scans emit one per integer column, but the min/max host pass only
     runs if a packed-aggregation or dense-join site consumes it (the
     Table caches the result per version for repeat consumers)."""
 
-    __slots__ = ("table", "col", "version")
+    __slots__ = ("table", "col", "version", "nid")
 
-    def __init__(self, table, col, version):
+    def __init__(self, table, col, version, nid=None):
         self.table = table
         self.col = col
         self.version = version
+        # scan node id: lets consumers that bake these bounds register a
+        # fetch-time re-check against the scan's resolved version
+        self.nid = nid
 
     def get(self):
         return self.table.col_bounds(self.col, self.version)
@@ -147,6 +170,13 @@ class CompiledQuery:
     # mask because the column held no NULLs at compile time; re-checked
     # at fetch, violation -> StaleWidthsError recompile
     nonnull: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # (scan node id, column, lo, hi): compile-time column bounds that
+    # proved a decimal SUM safe for single-lane int64 accumulation
+    # (AggDesc.wide narrowing); re-checked at fetch like nonnull —
+    # growth past the baked interval recompiles, never silently wraps
+    bound_checks: List[Tuple[int, str, int, int]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 
@@ -398,10 +428,69 @@ def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str, open_ok=False):
 
 
 
-def build_agg_parts(plan: "L.Aggregate", dicts):
+def _expr_abs_bound(e: Expr, dicts: Dicts):
+    """Max-abs of an expression's SCALED integer representation via
+    interval arithmetic over storage column bounds, or None (unbounded /
+    unsupported shape). Returns (bound, [contributing _LazyBounds]).
+    Sound only while every referenced column stays inside its
+    compile-time bounds — callers must register a fetch-time re-check
+    for each returned entry (CompiledQuery.bound_checks)."""
+    import math
+
+    from tidb_tpu.expression.expr import Func, Literal
+
+    kind = e.type.kind if e.type is not None else None
+    if kind not in (Kind.INT, Kind.DECIMAL, Kind.BOOL):
+        return None
+    scale = e.type.scale if kind == Kind.DECIMAL else 0
+    if isinstance(e, ColumnRef):
+        entry = dicts.get(_BOUNDS_PREFIX + e.name)
+        cb = _resolve_bounds(entry)
+        if cb is None or not isinstance(entry, _LazyBounds):
+            return None
+        return (max(abs(int(cb[0])), abs(int(cb[1]))), [entry])
+    if isinstance(e, Literal):
+        if e.param_slot is not None:
+            return None  # value changes per EXECUTE; no static bound
+        v = e.value
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            return None
+        return (int(math.ceil(abs(v) * 10 ** scale)) + 1, [])
+    if isinstance(e, Func) and e.op in ("add", "sub", "mul", "neg"):
+        subs = [_expr_abs_bound(a, dicts) for a in e.args]
+        if any(s is None for s in subs):
+            return None
+        if e.op == "neg":
+            return subs[0]
+        (b1, c1), (b2, c2) = subs
+
+        def sc(a):
+            return (
+                a.type.scale
+                if a.type is not None and a.type.kind == Kind.DECIMAL
+                else 0
+            )
+
+        s1, s2 = sc(e.args[0]), sc(e.args[1])
+        if e.op == "mul":
+            # scaled product == product of scaled operands at result
+            # scale s1+s2; a result rescaled DOWN is only smaller
+            return (b1 * b2, c1 + c2)
+        if scale < max(s1, s2):
+            return None  # add/sub never narrows scale; bail if odd
+        return (b1 * 10 ** (scale - s1) + b2 * 10 ** (scale - s2), c1 + c2)
+    return None
+
+
+def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
     """Compile an Aggregate node's pieces: (key fns, key names, packed key
     widths, AggDescs). Shared by the in-plan aggregation node and the
-    streamed (chunked) execution path."""
+    streamed (chunked) execution path. With a compiler, wide decimal
+    sums whose arguments are provably small (interval arithmetic over
+    storage bounds) drop to single-lane int64 accumulation, halving the
+    reduction passes; the proof is re-checked at every fetch."""
     key_fns = [compile_expr(e, dicts) for _, e in plan.group_exprs]
     key_names = [n for n, _ in plan.group_exprs]
     descs = []
@@ -416,6 +505,19 @@ def build_agg_parts(plan: "L.Aggregate", dicts):
         # int64 accumulation at SF100 row counts: use the dual-lane
         # wide accumulator (AggDesc.wide)
         wide = func in ("sum", "avg") and scale >= 4
+        if wide and compiler is not None:
+            r = _expr_abs_bound(arg, dicts)
+            # 2^31 rows is past any single-program tile (int32 row
+            # indexing); bound * 2^31 < 2^62 proves no int64 wraparound
+            if r is not None and r[0] < (1 << 31) and all(
+                lb.nid is not None for lb in r[1]
+            ):
+                for lb in r[1]:
+                    cb = lb.get()
+                    compiler.bound_checks.append(
+                        (lb.nid, lb.col, int(cb[0]), int(cb[1]))
+                    )
+                wide = False
         # DISTINCT is a no-op for min/max (duplicate-insensitive); for
         # sum/avg/count the kernel dedupes via representative-row masks
         # (executor/aggregate._distinct_reps)
@@ -484,6 +586,7 @@ class PlanCompiler:
         self.widths: Dict[int, int] = {}
         self.instrument = instrument
         self.nonnull: List[Tuple[int, str]] = []
+        self.bound_checks: List[Tuple[int, str, int, int]] = []
         self.node_labels: List[Tuple[int, int, str]] = []  # (nid, depth, label)
         self.stats: Dict[int, Dict[str, float]] = {}
         self._depth = 0
@@ -582,6 +685,7 @@ class PlanCompiler:
             out_dicts=out,
             widths=dict(self.widths),
             nonnull=list(self.nonnull),
+            bound_checks=list(self.bound_checks),
         )
 
     # ------------------------------------------------------------------
@@ -640,7 +744,7 @@ class PlanCompiler:
             if not self.conservative:
                 for n in plan.columns:
                     dicts[_BOUNDS_PREFIX + f"{plan.alias}.{n}"] = _LazyBounds(
-                        t, n, _v
+                        t, n, _v, nid
                     )
             pk = t.schema.primary_key
             uniq_cols = set([pk[0]] if pk and len(pk) == 1 else [])
@@ -925,7 +1029,9 @@ class PlanCompiler:
         self.sized.append(nid)
         self.defaults[nid] = 1024
         self.widths[nid] = _schema_width(plan.schema)
-        key_fns, key_names, key_widths, descs = build_agg_parts(plan, dicts)
+        key_fns, key_names, key_widths, descs = build_agg_parts(
+            plan, dicts, compiler=self
+        )
         scalar = not plan.group_exprs
         agg_names = [(n, f) for n, f, _a, _d in plan.aggs]
         mesh_n = self.mesh_n if child_tag == "shard" else None
@@ -1141,6 +1247,7 @@ class PlanCompiler:
             lkeys.append(lf)
             rkeys.append(rf)
         lprops = rprops = ((None, False))
+        chosen = None
         if len(lkeys) == 1:
             lkey, rkey = lkeys[0], rkeys[0]
             verify = None
@@ -1150,9 +1257,33 @@ class PlanCompiler:
         else:
             if plan.kind not in ("inner", "semi", "anti", "left"):
                 raise ExecError("multi-key outer join not yet supported")
-            lkey = _hash_combine(lkeys)
-            rkey = _hash_combine(rkeys)
-            verify = (lkeys, rkeys)
+            # multi-key inner join: when one pair's key is provably
+            # unique on its side, join on THAT pair alone (dense 1:1
+            # path) and let the verify filter apply the remaining
+            # equalities post-join — the unique key already guarantees
+            # <= 1 match per probe row, so no hash-combine collisions
+            # and no probe-chain expansion. (Q5's customer join:
+            # c_custkey unique, c_nationkey = s_nationkey demoted.)
+            if plan.kind == "inner":
+                for i, (le0, re0) in enumerate(plan.equi_keys):
+                    lp = _join_key_props(le0, ldicts)
+                    rp = _join_key_props(re0, rdicts)
+                    if lp[1] or rp[1]:
+                        chosen, lprops, rprops = i, lp, rp
+                        break
+            if chosen is not None:
+                lkey, rkey = lkeys[chosen], rkeys[chosen]
+                # the join itself enforces the chosen pair's equality
+                # exactly (dense 1:1 / searchsorted, runtime-verified):
+                # verify only the demoted pairs
+                verify = (
+                    [f for j, f in enumerate(lkeys) if j != chosen],
+                    [f for j, f in enumerate(rkeys) if j != chosen],
+                )
+            else:
+                lkey = _hash_combine(lkeys)
+                rkey = _hash_combine(rkeys)
+                verify = (lkeys, rkeys)
 
         kind = plan.kind
         null_aware = plan.null_aware
@@ -1440,6 +1571,14 @@ class PlanCompiler:
             needs[nid] = total
             return out, needs
 
+        if kind == "inner" and (len(plan.equi_keys) == 1 or chosen is not None):
+            # inner join keyed (or chosen-keyed) on a single pair: a
+            # unique build key can't duplicate the other side's rows, so
+            # that side's uniqueness survives; the verify filter for
+            # demoted pairs only drops rows and can't duplicate either
+            return fn_join, _merge_join_dicts(
+                ldicts, rdicts, lprops[1], rprops[1]
+            )
         return fn_join, _strip_uniq(dicts)
 
 
@@ -1818,6 +1957,14 @@ class PhysicalExecutor:
         for nid, col in cq.nonnull:
             t, v = resolved[nid]
             if t.col_has_nulls(col, v):
+                raise StaleWidthsError()
+        # compile-time bounds that narrowed a wide sum: the fetched
+        # version must still fit the baked interval or single-lane
+        # accumulation could silently wrap — recompile instead
+        for nid, col, lo, hi in cq.bound_checks:
+            t, v = resolved[nid]
+            cb = t.col_bounds(col, v)
+            if cb is not None and (cb[0] < lo or cb[1] > hi):
                 raise StaleWidthsError()
         shape_key = tuple(sorted((nid, b.capacity) for nid, b in inputs.items()))
 
