@@ -1,0 +1,238 @@
+"""ALTER TABLE MODIFY/CHANGE COLUMN, RENAME COLUMN/TABLE.
+
+Reference: onModifyColumn + the write-reorg backfill
+(pkg/ddl/column.go:518), onRenameTable (pkg/ddl/table.go). The columnar
+analog converts immutable blocks lock-free and retries the atomic swap
+when concurrent DML published a newer version (delta-only reconvert) —
+see Table.alter_modify_column.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database altc")
+    s.execute("use altc")
+    yield s
+    failpoint.disable_all()
+
+
+class TestModifyColumn:
+    def test_int_to_decimal_and_back(self, sess):
+        sess.execute("create table t (a int, b int)")
+        sess.execute("insert into t values (1, 10), (2, -3), (null, 0)")
+        sess.execute("alter table t modify a decimal(10,2)")
+        rows = sess.execute("select a, b from t order by b").rows
+        assert [(None if a is None else float(a), b) for a, b in rows] == [
+            (2.0, -3), (None, 0), (1.0, 10)
+        ]
+        # decimal -> int rounds half away from zero
+        sess.execute("update t set a = 2.5 where b = -3")
+        sess.execute("alter table t modify a int")
+        rows = sess.execute("select a, b from t order by b").rows
+        assert rows == [(3, -3), (None, 0), (1, 10)]
+
+    def test_decimal_scale_change_rounds(self, sess):
+        sess.execute("create table t (a decimal(10,3), k int)")
+        sess.execute(
+            "insert into t values (1.2345, 1), (1.005, 2), (-1.0005, 3)"
+        )
+        # parser/encoding rounds inserts to scale 3 first: 1.234|1.005|-1.001
+        sess.execute("alter table t modify a decimal(10,2)")
+        rows = sess.execute("select a from t order by k").rows
+        assert [float(r[0]) for r in rows] == [1.23, 1.01, -1.0]
+        sess.execute("alter table t modify a decimal(10,4)")
+        rows = sess.execute("select a from t order by k").rows
+        assert [float(r[0]) for r in rows] == [1.23, 1.01, -1.0]
+
+    def test_int_string_roundtrip(self, sess):
+        sess.execute("create table t (a int, k int)")
+        sess.execute("insert into t values (42, 1), (-7, 2), (null, 3)")
+        sess.execute("alter table t modify a varchar(20)")
+        assert sess.execute("select a from t order by k").rows == [
+            ("42",), ("-7",), (None,)
+        ]
+        assert sess.execute(
+            "select a from t where a = '42'"
+        ).rows == [("42",)]
+        sess.execute("alter table t modify a bigint")
+        assert sess.execute("select a from t order by k").rows == [
+            (42,), (-7,), (None,)
+        ]
+
+    def test_bad_string_to_int_aborts_clean(self, sess):
+        sess.execute("create table t (a varchar(10))")
+        sess.execute("insert into t values ('12'), ('oops')")
+        with pytest.raises(ValueError, match="Truncated|incorrect"):
+            sess.execute("alter table t modify a int")
+        # no visible state change: still a string column
+        assert sess.execute("select a from t order by a").rows == [
+            ("12",), ("oops",)
+        ]
+
+    def test_date_datetime_roundtrip(self, sess):
+        sess.execute("create table t (d date)")
+        sess.execute("insert into t values ('2024-03-05')")
+        sess.execute("alter table t modify d datetime")
+        # midnight-exact: comparisons and formatting see the instant
+        assert sess.execute(
+            "select count(*) from t where d = '2024-03-05 00:00:00'"
+        ).rows == [(1,)]
+        assert sess.execute(
+            "select year(d), month(d), day(d), hour(d) from t"
+        ).rows == [(2024, 3, 5, 0)]
+        sess.execute("alter table t modify d date")
+        assert sess.execute(
+            "select count(*) from t where d = '2024-03-05'"
+        ).rows == [(1,)]
+
+    def test_change_renames_and_converts(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (5)")
+        sess.execute("alter table t change a b decimal(8,2)")
+        assert float(sess.execute("select b from t").rows[0][0]) == 5.0
+        cols = [r[0] for r in sess.execute("show columns from t").rows]
+        assert cols == ["b"]
+
+    def test_not_null_with_nulls_rejected(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (1), (null)")
+        with pytest.raises(ValueError, match="NULL"):
+            sess.execute("alter table t modify a bigint not null")
+
+    def test_unique_index_dup_after_narrowing_aborts(self, sess):
+        sess.execute("create table t (a decimal(10,2))")
+        sess.execute("create unique index ua on t (a)")
+        sess.execute("insert into t values (1.24), (1.21)")
+        with pytest.raises(ValueError, match="Duplicate"):
+            sess.execute("alter table t modify a decimal(10,1)")
+        # aborted BEFORE publish: still scale 2, both rows distinct
+        rows = sess.execute("select a from t order by a").rows
+        assert [float(r[0]) for r in rows] == [1.21, 1.24]
+
+    def test_fk_and_check_guards(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (x int, pid int, "
+            "constraint f foreign key (pid) references p (id))"
+        )
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("alter table c modify pid varchar(10)")
+        with pytest.raises(ValueError, match="FOREIGN KEY"):
+            sess.execute("alter table p modify id varchar(10)")
+        sess.execute("create table ck (a int, check (a > 0))")
+        with pytest.raises(ValueError, match="CHECK"):
+            sess.execute("alter table ck modify a varchar(10)")
+
+    def test_concurrent_dml_during_reorg_retries(self, sess):
+        sess.execute("create table t (a int, k int)")
+        sess.execute("insert into t values (1, 1), (2, 2)")
+        state = {"fired": False}
+
+        def racing_dml():
+            if not state["fired"]:
+                state["fired"] = True
+                # a concurrent writer lands between snapshot and swap:
+                # the reorg must retry and convert the delta block too
+                s2 = Session(sess.catalog, db="altc")
+                s2.execute("insert into t values (3, 3)")
+
+        failpoint.enable("ddl/modify-column-reorg", racing_dml)
+        try:
+            sess.execute("alter table t modify a decimal(10,2)")
+        finally:
+            failpoint.disable("ddl/modify-column-reorg")
+        assert state["fired"]
+        rows = sess.execute("select a from t order by k").rows
+        assert [float(r[0]) for r in rows] == [1.0, 2.0, 3.0]
+
+    def test_indexes_survive_modify(self, sess):
+        sess.execute("create table t (a int, b int)")
+        sess.execute("create index ia on t (a)")
+        sess.execute("insert into t values (3, 1), (1, 2), (2, 3)")
+        sess.execute("alter table t modify a decimal(6,1)")
+        rows = sess.execute("select a from t order by a").rows
+        assert [float(r[0]) for r in rows] == [1.0, 2.0, 3.0]
+        assert sess.catalog.table("altc", "t").indexes["ia"] == ["a"]
+
+
+class TestRename:
+    def test_rename_column_metadata_only(self, sess):
+        sess.execute("create table t (a int, b varchar(5))")
+        sess.execute("insert into t values (1, 'x')")
+        sess.execute("create index ib on t (b)")
+        sess.execute("alter table t rename column b to c")
+        assert sess.execute("select c from t").rows == [("x",)]
+        assert sess.catalog.table("altc", "t").indexes["ib"] == ["c"]
+        with pytest.raises(Exception):
+            sess.execute("select b from t")
+
+    def test_alter_rename_table(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("insert into t values (7)")
+        sess.execute("alter table t rename to t2")
+        assert sess.execute("select a from t2").rows == [(7,)]
+        with pytest.raises(Exception):
+            sess.execute("select a from t")
+
+    def test_rename_table_statement_updates_fks(self, sess):
+        sess.execute("create table p (id int primary key)")
+        sess.execute(
+            "create table c (pid int, "
+            "constraint f foreign key (pid) references p (id))"
+        )
+        sess.execute("insert into p values (1)")
+        sess.execute("insert into c values (1)")
+        sess.execute("rename table p to parent")
+        # FK now points at the new name: violations still caught
+        with pytest.raises(ValueError):
+            sess.execute("insert into c values (99)")
+        sess.execute("insert into c values (1)")
+        assert sess.execute("select count(*) from c").rows == [(2,)]
+
+    def test_rename_table_multi_pair_atomic(self, sess):
+        sess.execute("create table a1 (x int)")
+        sess.execute("create table b1 (x int)")
+        sess.execute("insert into a1 values (1)")
+        # second pair fails (target exists) -> first pair rolls back
+        with pytest.raises(ValueError):
+            sess.execute("rename table a1 to a2, b1 to a2")
+        assert sess.execute("select x from a1").rows == [(1,)]
+
+    def test_swap_via_three_way_rename(self, sess):
+        sess.execute("create table x (v int)")
+        sess.execute("create table y (v int)")
+        sess.execute("insert into x values (1)")
+        sess.execute("insert into y values (2)")
+        sess.execute("rename table x to tmp, y to x, tmp to y")
+        assert sess.execute("select v from x").rows == [(2,)]
+        assert sess.execute("select v from y").rows == [(1,)]
+
+
+class TestReviewRegressions:
+    def test_default_follows_change_rename(self, sess):
+        sess.execute("create table t (a int default 5, b int)")
+        sess.execute("alter table t change a a2 varchar(10)")
+        sess.execute("insert into t (b) values (1)")
+        assert sess.execute("select a2 from t").rows == [("5",)]
+
+    def test_alter_rename_needs_drop_create(self, sess):
+        sess.execute("create table t (a int)")
+        sess.execute("create user u1 identified by ''")
+        sess.execute("grant alter on altc.* to u1")
+        s2 = Session(sess.catalog, db="altc")
+        s2.user = "u1"
+        with pytest.raises(PermissionError):
+            s2.execute("alter table t rename to t9")
+
+    def test_huge_string_to_int_out_of_range(self, sess):
+        sess.execute("create table t (a varchar(32))")
+        sess.execute("insert into t values ('99999999999999999999999')")
+        with pytest.raises(ValueError, match="Out of range|Truncated"):
+            sess.execute("alter table t modify a bigint")
+        assert sess.execute("select count(*) from t").rows == [(1,)]

@@ -284,10 +284,20 @@ class DropView:
 class AlterTable:
     db: Optional[str]
     name: str
-    action: str  # 'add' | 'drop'
-    column: Optional[ColumnDef] = None  # for add
-    col_name: Optional[str] = None  # for drop
+    # 'add' | 'drop' | 'modify' | 'change' | 'rename_col' | 'rename'
+    action: str
+    column: Optional[ColumnDef] = None  # for add / modify / change
+    col_name: Optional[str] = None  # for drop / change (old) / rename_col
     default: Optional[object] = None  # ADD COLUMN ... DEFAULT <const>
+    new_name: Optional[str] = None  # rename_col / rename target
+
+
+@dataclasses.dataclass
+class RenameTable:
+    """RENAME TABLE a TO b [, c TO d] (reference: pkg/ddl/table.go
+    onRenameTable; here a catalog-level move with FK/child fixups)."""
+
+    pairs: list  # [((db, name), (db, name)), ...]
 
 
 @dataclasses.dataclass

@@ -246,6 +246,17 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if self._at_ident("rename"):
+            self.advance()
+            self.expect_kw("table")
+            pairs = []
+            while True:
+                src = self._qualified_name()
+                self._expect_ident_kw("to")
+                pairs.append((src, self._qualified_name()))
+                if not self.accept_op(","):
+                    break
+            return ast.RenameTable(pairs)
         if self._at_ident("kill"):
             # KILL [QUERY | CONNECTION] <connection id>
             self.advance()
@@ -2034,29 +2045,63 @@ class Parser:
         db, name = self._qualified_name()
         if self.accept_kw("add"):
             self.accept_kw("column")
-            cname = self.expect_ident()
-            ctype = self.parse_type()
-            default = None
-            not_null = False
-            while True:  # NOT NULL / DEFAULT in either order (MySQL)
-                if self.accept_kw("not"):
-                    self.expect_kw("null")
-                    not_null = True
-                elif self.accept_kw("null"):
-                    pass
-                elif self.accept_kw("default"):
-                    d = self.parse_primary()
-                    if not isinstance(d, ast.Const):
-                        raise ParseError("DEFAULT must be a constant")
-                    default = d.value
-                else:
-                    break
-            cd = ast.ColumnDef(cname, ctype, not_null=not_null)
+            cd, default = self._alter_column_tail(self.expect_ident())
             return ast.AlterTable(db, name, "add", column=cd, default=default)
         if self.accept_kw("drop"):
             self.accept_kw("column")
             return ast.AlterTable(db, name, "drop", col_name=self.expect_ident())
-        raise ParseError("ALTER TABLE supports ADD COLUMN / DROP COLUMN")
+        if self._at_ident("modify"):
+            self.advance()
+            self.accept_kw("column")
+            cd, default = self._alter_column_tail(self.expect_ident())
+            return ast.AlterTable(db, name, "modify", column=cd, default=default)
+        if self._at_ident("change"):
+            self.advance()
+            self.accept_kw("column")
+            old = self.expect_ident()
+            cd, default = self._alter_column_tail(self.expect_ident())
+            return ast.AlterTable(
+                db, name, "change", column=cd, col_name=old, default=default
+            )
+        if self._at_ident("rename"):
+            self.advance()
+            if self.accept_kw("column"):
+                old = self.expect_ident()
+                self._expect_ident_kw("to")
+                return ast.AlterTable(
+                    db, name, "rename_col", col_name=old,
+                    new_name=self.expect_ident(),
+                )
+            # TO/AS optional (MySQL); both always lex as keywords
+            self.accept_kw("to") or self.accept_kw("as")
+            return ast.AlterTable(
+                db, name, "rename", new_name=self.expect_ident()
+            )
+        raise ParseError(
+            "ALTER TABLE supports ADD/DROP/MODIFY/CHANGE COLUMN, "
+            "RENAME COLUMN, RENAME TO"
+        )
+
+    def _alter_column_tail(self, cname: str):
+        """<type> [NOT NULL | NULL | DEFAULT <const>]* after a column
+        name in ADD/MODIFY/CHANGE COLUMN."""
+        ctype = self.parse_type()
+        default = None
+        not_null = False
+        while True:  # NOT NULL / DEFAULT in either order (MySQL)
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            elif self.accept_kw("null"):
+                pass
+            elif self.accept_kw("default"):
+                d = self.parse_primary()
+                if not isinstance(d, ast.Const):
+                    raise ParseError("DEFAULT must be a constant")
+                default = d.value
+            else:
+                break
+        return ast.ColumnDef(cname, ctype, not_null=not_null), default
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("if"):

@@ -847,6 +847,147 @@ class Session:
                 )
 
     # ------------------------------------------------------------------
+    def _guard_column_refs(self, t, db, tname, cn: str, verb: str) -> None:
+        """Refuse column DDL that would break CHECK/FK bookkeeping
+        (reference: modify-column prechecks in pkg/ddl/column.go)."""
+        from tidb_tpu.utils.checkeval import check_columns
+
+        for nm, ex in self._check_exprs_for(t):
+            if cn in check_columns(ex):
+                raise ValueError(
+                    f"cannot {verb} column {cn!r}: used by CHECK {nm!r}"
+                )
+        for nm, col, rdb, rtbl, rcol in t.fks:
+            if cn == col:
+                raise ValueError(
+                    f"cannot {verb} column {cn!r}: used by FOREIGN KEY {nm!r}"
+                )
+        for cdb, ctn, nm, _c, rcol, _act in self._fk_children(db, tname):
+            if cn == rcol:
+                raise ValueError(
+                    f"cannot {verb} column {cn!r}: referenced by "
+                    f"FOREIGN KEY {nm!r} on {cdb}.{ctn}"
+                )
+
+    def _run_modify_column(self, t, s) -> None:
+        """ALTER TABLE MODIFY/CHANGE COLUMN (reference: onModifyColumn,
+        pkg/ddl/column.go:518). Lossless (same kind+scale) changes are
+        metadata-only (+ optional rename); lossy changes run the online
+        block-conversion reorg in storage (alter_modify_column docstring
+        maps it onto the F1 write-reorg phase). Uniqueness of covering
+        UNIQUE indexes is re-validated post-conversion — a narrowing
+        that collapses two distinct values into one duplicate aborts."""
+        import numpy as np
+
+        from tidb_tpu.storage import convert as CV
+
+        old_name = (s.col_name or s.column.name).lower()
+        new_name = s.column.name.lower()
+        types = t.schema.types
+        if old_name not in types:
+            raise ValueError(f"unknown column {old_name!r}")
+        if new_name != old_name and new_name in types:
+            raise ValueError(f"column {new_name!r} exists")
+        old_t, new_t = types[old_name], s.column.type
+        enums = t.schema.enums or {}
+        sets_ = t.schema.sets or {}
+        if old_name in enums or old_name in sets_ or old_name in t.schema.json_cols:
+            raise ValueError(
+                "MODIFY COLUMN on ENUM/SET/JSON columns is not supported"
+            )
+        if s.column.not_null:
+            for b in t.blocks():
+                if not bool(b.columns[old_name].valid.all()):
+                    raise ValueError(
+                        f"column {old_name!r} contains NULLs: cannot "
+                        "add NOT NULL"
+                    )
+        if CV.meta_only(old_t, new_t):
+            if new_name != old_name:
+                self._guard_column_refs(
+                    t, s.db or self.db, s.name, old_name, "rename"
+                )
+                t.alter_rename_column(old_name, new_name)
+            else:
+                t.bump_version()  # schema barrier for display-only change
+        else:
+            self._guard_column_refs(
+                t, s.db or self.db, s.name, old_name, "modify"
+            )
+            pk = t.schema.primary_key
+            if pk and old_name in pk:
+                raise ValueError(
+                    "MODIFY COLUMN with data conversion on a PRIMARY KEY "
+                    "column is not supported"
+                )
+            conv = CV.make_converter(old_t, new_t, old_name)
+
+            def validate(new_blocks, _t=t, _new=new_name, _old=old_name):
+                # pre-publish: a narrowing can merge previously-distinct
+                # values under a covering UNIQUE index — abort with no
+                # visible state instead of installing duplicates
+                for iname in list(_t.unique_indexes):
+                    cols = [
+                        _new if c == _old else c
+                        for c in (_t.indexes.get(iname) or [])
+                    ]
+                    if _new not in cols:
+                        continue
+                    datas, valid = [], None
+                    for c in cols:
+                        parts = [b.columns[c] for b in new_blocks]
+                        if not parts:
+                            break
+                        d = np.concatenate([p.data for p in parts])
+                        v = np.concatenate([p.valid for p in parts])
+                        datas.append(d)
+                        valid = v if valid is None else (valid & v)
+                    if not datas or valid is None or not valid.any():
+                        continue
+                    keyed = [d[valid] for d in datas]
+                    order = np.lexsort(keyed[::-1])
+                    dup = False
+                    if len(order) > 1:
+                        eq = np.ones(len(order) - 1, dtype=bool)
+                        for d in keyed:
+                            ds = d[order]
+                            eq &= ds[1:] == ds[:-1]
+                        dup = bool(eq.any())
+                    if dup:
+                        raise ValueError(
+                            f"Duplicate entry under unique index "
+                            f"{iname!r} after MODIFY COLUMN conversion"
+                        )
+
+            t.alter_modify_column(
+                old_name, new_t, conv,
+                rename_to=new_name if new_name != old_name else None,
+                validate=validate,
+            )
+        # column DEFAULT follows the column: explicit clause wins; an
+        # existing default migrates across the rename and casts to the
+        # new type (MySQL keeps and converts defaults on MODIFY)
+        dflt = getattr(t, "defaults", None)
+        if dflt is None:
+            dflt = t.defaults = {}
+        if s.default is not None:
+            dflt.pop(old_name, None)
+            dflt[new_name] = s.default
+        elif old_name in dflt:
+            v = dflt.pop(old_name)
+            nk = new_t.kind
+            try:
+                if nk == Kind.STRING:
+                    v = str(v)
+                elif nk in (Kind.INT, Kind.BOOL) and not isinstance(v, bool):
+                    v = int(round(float(v)))
+                elif nk in (Kind.DECIMAL, Kind.FLOAT):
+                    v = float(v)
+                dflt[new_name] = v
+            except (ValueError, TypeError):
+                pass  # unconvertible default: dropped, not corrupted
+
+    # ------------------------------------------------------------------
     def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
         """ADD INDEX through the F1 online schema-state ladder
         (reference: pkg/ddl/index.go:545 — None -> WriteOnly ->
@@ -1152,6 +1293,18 @@ class Session:
             self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
         elif isinstance(s, ast.AlterTable):
             self._check_priv("alter", (s.db or self.db).lower(), s.name.lower())
+            if s.action == "rename":
+                # same gate as the RENAME TABLE statement: the operation
+                # is identical, so the privilege must be too
+                self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
+                self._check_priv("create", (s.db or self.db).lower())
+        elif isinstance(s, ast.RenameTable):
+            # MySQL: ALTER+DROP on the source, CREATE+INSERT on the
+            # target; the alter+drop pair is the enforced core here
+            for (sdb, sname), (ddb, dname) in s.pairs:
+                self._check_priv("alter", (sdb or self.db).lower(), sname.lower())
+                self._check_priv("drop", (sdb or self.db).lower(), sname.lower())
+                self._check_priv("create", (ddb or self.db).lower())
         elif isinstance(s, (ast.CreateIndex, ast.DropIndex)):
             self._check_priv("index", (s.db or self.db).lower(), s.table.lower())
         elif isinstance(s, (ast.CreateDatabase, ast.DropDatabase)):
@@ -1458,6 +1611,24 @@ class Session:
         elif isinstance(s, ast.DropView):
             self.catalog.drop_view(s.db or self.db, s.name, s.if_exists)
             r = Result([], [])
+        elif isinstance(s, ast.RenameTable):
+            failpoint.inject("ddl/rename-table")
+            # MySQL RENAME TABLE is atomic across its pairs: validate
+            # every source/target first, then move; a later-pair
+            # failure rolls earlier moves back
+            done = []
+            try:
+                for (sdb, sname), (ddb, dname) in s.pairs:
+                    self.catalog.rename_table(
+                        sdb or self.db, sname, ddb or self.db, dname
+                    )
+                    done.append(((sdb or self.db, sname), (ddb or self.db, dname)))
+            except Exception:
+                for (sdb, sname), (ddb, dname) in reversed(done):
+                    self.catalog.rename_table(ddb, dname, sdb, sname)
+                raise
+            clear_scan_cache()
+            r = Result([], [])
         elif isinstance(s, ast.TruncateTable):
             def _truncate(db=s.db or self.db):
                 t = self._resolve_table_for_write(db, s.name)
@@ -1498,6 +1669,17 @@ class Session:
                         "" if s.column.type.kind == Kind.STRING else 0
                     )
                 t.alter_add_column(s.column.name, s.column.type, default)
+            elif s.action in ("modify", "change"):
+                self._run_modify_column(t, s)
+            elif s.action == "rename_col":
+                self._guard_column_refs(
+                    t, s.db or self.db, s.name, s.col_name.lower(), "rename"
+                )
+                t.alter_rename_column(s.col_name, s.new_name)
+            elif s.action == "rename":
+                self.catalog.rename_table(
+                    s.db or self.db, s.name, s.db or self.db, s.new_name
+                )
             else:
                 cn = s.col_name.lower()
                 from tidb_tpu.utils.checkeval import check_columns

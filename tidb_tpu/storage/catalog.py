@@ -120,6 +120,40 @@ class Catalog:
             del self._dbs[db][name]
             self.schema_version += 1
 
+    def rename_table(
+        self, db: str, name: str, new_db: str, new_name: str
+    ) -> None:
+        """RENAME TABLE / ALTER TABLE RENAME (reference: onRenameTable,
+        pkg/ddl/table.go): a catalog-level move; FOREIGN KEY references
+        on children (and the table's own self-references) follow the
+        new name, matching MySQL's automatic FK definition update."""
+        db, name = db.lower(), name.lower()
+        new_db, new_name = new_db.lower(), new_name.lower()
+        with self._lock:
+            if name not in self._dbs.get(db, {}):
+                raise ValueError(f"unknown table {db}.{name}")
+            if new_db not in self._dbs:
+                raise ValueError(f"unknown database {new_db}")
+            if new_name in self._dbs[new_db] or new_name in self._views.get(
+                new_db, {}
+            ):
+                raise ValueError(f"table {new_db}.{new_name} exists")
+            t = self._dbs[db].pop(name)
+            t.name = new_name
+            self._dbs[new_db][new_name] = t
+            for tabs in self._dbs.values():
+                for t2 in tabs.values():
+                    fks = getattr(t2, "fks", None)
+                    if not fks:
+                        continue
+                    t2.fks = [
+                        (nm, col, new_db, new_name, rcol)
+                        if (rdb, rtbl) == (db, name)
+                        else (nm, col, rdb, rtbl, rcol)
+                        for nm, col, rdb, rtbl, rcol in fks
+                    ]
+            self.schema_version += 1
+
     def table(self, db: str, name: str) -> Table:
         if db.lower() == "information_schema":
             return self._infoschema_table(name.lower())

@@ -782,6 +782,157 @@ class Table:
             self._gc_versions()
             return self.version
 
+    def alter_modify_column(
+        self, name: str, new_type: SQLType, convert, rename_to=None,
+        validate=None,
+    ) -> int:
+        """Online column type change (reference: onModifyColumn,
+        pkg/ddl/column.go:518 and its write-reorg backfill). The
+        columnar analog of the F1 ladder: blocks are immutable, so the
+        conversion runs LOCK-FREE over a snapshot's blocks (the
+        write-reorg phase), caching results by block uid; the swap
+        retries when concurrent DML published a newer version —
+        converting only the delta blocks — and installs schema + data
+        atomically. Writers never see a half-typed column: until the
+        swap they write the old type (their blocks join the delta), and
+        the swap is a single version publish.
+
+        convert(HostColumn, table_dictionary) -> HostColumn of new_type
+        (raises ValueError on lossy-violation rows, aborting the DDL
+        with no visible state)."""
+        name = name.lower()
+        new_name = (rename_to or name).lower()
+        converted: Dict[int, HostColumn] = {}
+        while True:
+            with self._lock:
+                v = self.version
+                blocks = list(self._versions[v])
+                src_dict = self.dictionaries.get(name)
+            from tidb_tpu.utils.failpoint import inject
+
+            inject("ddl/modify-column-reorg")
+            for b in blocks:  # lock-free backfill over the snapshot
+                if b.uid not in converted:
+                    converted[b.uid] = convert(b.columns[name], src_dict)
+            with self._lock:
+                if self.version != v:
+                    continue  # concurrent DML: convert the delta, retry
+                if new_type.kind == Kind.STRING:
+                    # one table-global dictionary: merge every block's
+                    allv: set = set()
+                    for b in blocks:
+                        d = converted[b.uid].dictionary
+                        if d is not None:
+                            allv.update(d.tolist())
+                    merged = np.array(sorted(allv), dtype=object)
+                    lookup = {s: i for i, s in enumerate(merged.tolist())}
+                    for b in blocks:
+                        c = converted[b.uid]
+                        if c.dictionary is None or not len(c.dictionary):
+                            converted[b.uid] = HostColumn(
+                                c.type, c.data, c.valid, merged
+                            )
+                            continue
+                        remap = np.array(
+                            [lookup[s] for s in c.dictionary.tolist()],
+                            dtype=np.int64,
+                        )
+                        codes = np.clip(c.data, 0, len(c.dictionary) - 1)
+                        converted[b.uid] = HostColumn(
+                            c.type, remap[codes], c.valid, merged
+                        )
+                new_blocks = []
+                for b in blocks:
+                    cols = {}
+                    for n, c in b.columns.items():
+                        if n == name:
+                            cols[new_name] = converted[b.uid]
+                        else:
+                            cols[n] = c
+                    new_blocks.append(
+                        HostBlock(cols, b.nrows, part_id=b.part_id)
+                    )
+                if validate is not None:
+                    # pre-publish validation (e.g. unique-index dup
+                    # check after a narrowing): a raise here aborts the
+                    # DDL with NO visible state — the write-reorg
+                    # rollback of the reference's ladder
+                    validate(new_blocks)
+                self.schema = dataclasses.replace(
+                    self.schema,
+                    columns=[
+                        (new_name, new_type) if n == name else (n, t)
+                        for n, t in self.schema.columns
+                    ],
+                    primary_key=(
+                        [new_name if c == name else c
+                         for c in self.schema.primary_key]
+                        if self.schema.primary_key else None
+                    ),
+                )
+                self.dictionaries.pop(name, None)
+                if new_type.kind == Kind.STRING:
+                    self.dictionaries[new_name] = (
+                        new_blocks[0].columns[new_name].dictionary
+                        if new_blocks else np.array([], dtype=object)
+                    )
+                for iname, cols_ in list(self.indexes.items()):
+                    self.indexes[iname] = [
+                        new_name if c == name else c for c in cols_
+                    ]
+                self.version += 1
+                self.version_ts[self.version] = time.time()
+                self._versions[self.version] = new_blocks
+                self._gc_versions()
+                return self.version
+
+    def alter_rename_column(self, old: str, new: str) -> int:
+        """Pure-metadata column rename (reference: RENAME COLUMN,
+        pkg/ddl/column.go renameColumn): schema entry, block column
+        maps, dictionary key, index column lists, PK — one version
+        publish, no data movement."""
+        old, new = old.lower(), new.lower()
+        with self._lock:
+            names = [n for n, _ in self.schema.columns]
+            if old not in names:
+                raise ValueError(f"unknown column {old!r}")
+            if new in names:
+                raise ValueError(f"column {new!r} exists")
+            ren = lambda n: new if n == old else n
+            self.schema = dataclasses.replace(
+                self.schema,
+                columns=[(ren(n), t) for n, t in self.schema.columns],
+                primary_key=(
+                    [ren(c) for c in self.schema.primary_key]
+                    if self.schema.primary_key else None
+                ),
+                enums=(
+                    {ren(k): v for k, v in self.schema.enums.items()}
+                    if self.schema.enums else None
+                ),
+                sets=(
+                    {ren(k): v for k, v in self.schema.sets.items()}
+                    if self.schema.sets else None
+                ),
+                json_cols=tuple(ren(c) for c in self.schema.json_cols),
+            )
+            if old in self.dictionaries:
+                self.dictionaries[new] = self.dictionaries.pop(old)
+            for iname, cols_ in list(self.indexes.items()):
+                self.indexes[iname] = [ren(c) for c in cols_]
+            dflt = getattr(self, "defaults", None)
+            if dflt and old in dflt:
+                dflt[new] = dflt.pop(old)
+            new_blocks = []
+            for b in self._versions[self.version]:
+                cols = {ren(n): c for n, c in b.columns.items()}
+                new_blocks.append(HostBlock(cols, b.nrows, part_id=b.part_id))
+            self.version += 1
+            self.version_ts[self.version] = time.time()
+            self._versions[self.version] = new_blocks
+            self._gc_versions()
+            return self.version
+
     # -- point/range access (reference: point_get.go:132 + ranger) ---------
     def pin_verified(self, version: int) -> bool:
         """Pin `version` and confirm it still exists (pin-then-verify:
