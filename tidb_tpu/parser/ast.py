@@ -293,6 +293,17 @@ class AlterTable:
 
 
 @dataclasses.dataclass
+class AdminStmt:
+    """ADMIN CHECK TABLE t[, ...] / ADMIN CHECK INDEX t idx / ADMIN
+    SHOW DDL JOBS (reference: pkg/executor/admin.go:46,
+    pkg/parser AdminStmt)."""
+
+    op: str  # 'check_table' | 'check_index' | 'show_ddl'
+    tables: list = dataclasses.field(default_factory=list)  # [(db, name)]
+    index: Optional[str] = None
+
+
+@dataclasses.dataclass
 class RenameTable:
     """RENAME TABLE a TO b [, c TO d] (reference: pkg/ddl/table.go
     onRenameTable; here a catalog-level move with FK/child fixups)."""

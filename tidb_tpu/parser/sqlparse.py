@@ -246,6 +246,34 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if self._at_ident("admin"):
+            self.advance()
+            word = self.cur.text.lower()  # CHECK/SHOW may lex as kw
+            if word == "check":
+                self.advance()
+                if self.accept_kw("table"):
+                    tables = [self._qualified_name()]
+                    while self.accept_op(","):
+                        tables.append(self._qualified_name())
+                    return ast.AdminStmt("check_table", tables)
+                if self.accept_kw("index"):
+                    tbl = self._qualified_name()
+                    if self.cur.text.lower() == "primary":  # kw, not id
+                        self.advance()
+                        return ast.AdminStmt(
+                            "check_index", [tbl], index="primary"
+                        )
+                    return ast.AdminStmt(
+                        "check_index", [tbl], index=self.expect_ident()
+                    )
+                raise ParseError("ADMIN CHECK supports TABLE / INDEX")
+            if word == "show":
+                self.advance()
+                self._expect_ident_kw("ddl")
+                if self._at_ident("jobs"):
+                    self.advance()
+                return ast.AdminStmt("show_ddl")
+            raise ParseError("ADMIN supports CHECK TABLE/INDEX, SHOW DDL")
         if self._at_ident("rename"):
             self.advance()
             self.expect_kw("table")

@@ -847,6 +847,215 @@ class Session:
                 )
 
     # ------------------------------------------------------------------
+    def _run_admin(self, s) -> Result:
+        """ADMIN CHECK TABLE / ADMIN CHECK INDEX / ADMIN SHOW DDL
+        (reference: pkg/executor/admin.go:46 — CheckTableExec walks
+        every index row-range against the table region; here derived
+        per-version indexes make the check a fresh recompute from raw
+        block data cross-validated against the cached bookkeeping, plus
+        the invariants only the write path normally guards: PK/unique
+        key sets, FK closure, partition tagging, dictionary code
+        ranges). Inconsistency raises; a clean catalog returns empty."""
+        if s.op == "show_ddl":
+            # DDL executes synchronously in-process: the job queue is
+            # always empty — report the schema version (ShowDDLExec)
+            return Result(
+                ["SCHEMA_VER", "RUNNING_JOBS", "SELF_ID"],
+                [(self.catalog.schema_version, "", "tidb-tpu-0")],
+            )
+        problems: list = []
+        for db0, name in s.tables:
+            db = (db0 or self.db).lower()
+            # the session's read snapshot (txn pins/shadows, RC), so
+            # the FK closure check compares child and parent at ONE
+            # consistent point instead of mixed versions
+            t, ver = self._resolve_table_for_read(db, name)
+            if s.op == "check_index":
+                iname = s.index.lower()
+                if iname == "primary":
+                    cols = list(t.schema.primary_key or [])
+                    if not cols:
+                        raise ValueError(f"table {name} has no PRIMARY KEY")
+                elif iname in t.indexes:
+                    if (
+                        hasattr(t, "index_state")
+                        and t.index_state(iname) != "public"
+                    ):
+                        raise ValueError(
+                            f"index {s.index} is not public yet"
+                        )
+                    cols = t.indexes[iname]
+                else:
+                    raise ValueError(f"index {s.index} does not exist")
+                unique = iname == "primary" or iname in t.unique_indexes
+                problems += self._admin_check_key(
+                    t, f"{db}.{name}", iname, cols, unique, ver
+                )
+            else:
+                problems += self._admin_check_table(t, db, name, ver)
+        if problems:
+            raise ValueError(
+                "admin check failed: " + "; ".join(problems[:5])
+            )
+        return Result([], [])
+
+    def _admin_check_key(self, t, qname, iname, cols, unique, ver) -> list:
+        """One key set: fresh duplicate/NULL detection from raw blocks
+        + cross-validation of any cached sorted bookkeeping."""
+        import numpy as np
+
+        from tidb_tpu.storage.table import Table as _T
+
+        problems = []
+        blocks = [
+            b for b in t.blocks(ver) if all(c in b.columns for c in cols)
+        ]
+        if iname == "primary":
+            for b in blocks:
+                for c in cols:
+                    if not bool(b.columns[c].valid.all()):
+                        problems.append(
+                            f"{qname}: NULL in PRIMARY KEY column {c}"
+                        )
+                        break
+        mats = [m for b in blocks if len(m := _T._key_matrix(b.columns, tuple(cols)))]
+        fresh = (
+            np.sort(_T._rows_view(np.concatenate(mats))) if mats else None
+        )
+        if unique and fresh is not None and len(fresh) > 1:
+            if bool((fresh[1:] == fresh[:-1]).any()):
+                problems.append(
+                    f"{qname}: duplicate entries under {iname} "
+                    f"({', '.join(cols)})"
+                )
+        # cached bookkeeping must agree with the fresh recompute
+        if len(cols) == 1:
+            ent = (getattr(t, "_idx_cache", {}) or {}).get(
+                (ver, cols[0])
+            )
+            if ent is not None:
+                svals, perm, nvalid = ent
+                data = (
+                    np.concatenate([b.columns[cols[0]].data for b in blocks])
+                    if blocks else np.zeros(0, dtype=np.int64)
+                )
+                valid = (
+                    np.concatenate([b.columns[cols[0]].valid for b in blocks])
+                    if blocks else np.zeros(0, dtype=bool)
+                )
+                p2 = np.lexsort((data, np.where(valid, 0, 1)))
+                if (
+                    int(valid.sum()) != nvalid
+                    or len(svals) != len(data)
+                    or not np.array_equal(data[p2], svals)
+                ):
+                    problems.append(
+                        f"{qname}: cached index {iname} disagrees with "
+                        "block data"
+                    )
+        else:
+            hit = (getattr(t, "_comp_cache", {}) or {}).get(tuple(cols))
+            if hit is not None and hit[0] == tuple(b.uid for b in blocks):
+                cached = hit[1]
+                if (cached is None) != (fresh is None) or (
+                    fresh is not None
+                    and (
+                        len(cached) != len(fresh)
+                        or not np.array_equal(cached, fresh)
+                    )
+                ):
+                    problems.append(
+                        f"{qname}: cached composite view {iname} "
+                        "disagrees with block data"
+                    )
+        return problems
+
+    def _admin_check_table(self, t, db, name, ver) -> list:
+        import numpy as np
+
+        problems = []
+        qname = f"{db}.{name}"
+        pk = t.schema.primary_key
+        if pk:
+            problems += self._admin_check_key(
+                t, qname, "primary", list(pk), True, ver
+            )
+        for iname, cols in t.indexes.items():
+            if hasattr(t, "index_state") and t.index_state(iname) != "public":
+                continue
+            problems += self._admin_check_key(
+                t, qname, iname, cols, iname in t.unique_indexes, ver
+            )
+        # dictionary code ranges
+        types = t.schema.types
+        for b in t.blocks(ver):
+            for cn, c in b.columns.items():
+                typ = types.get(cn)
+                if typ is None or typ.kind != Kind.STRING:
+                    continue
+                d = t.dictionaries.get(cn)
+                nd = len(d) if d is not None else 0
+                codes = c.data[c.valid]
+                if len(codes) and (
+                    int(codes.min()) < 0 or int(codes.max()) >= nd
+                ):
+                    problems.append(
+                        f"{qname}: string codes out of dictionary range "
+                        f"in column {cn}"
+                    )
+        # FK closure: every non-NULL child value has a parent
+        for nm, col, rdb, rtbl, rcol in t.fks:
+            try:
+                parent = self._column_values(rdb, rtbl, rcol)
+            except Exception:
+                problems.append(
+                    f"{qname}: FK {nm} parent {rdb}.{rtbl} missing"
+                )
+                continue
+            for b in t.blocks(ver):
+                c = b.columns.get(col)
+                if c is None:
+                    continue
+                # distinct values only (write-path pattern): decode once,
+                # set-difference against the parent set
+                dec = c.decode()
+                vals = {v for ok, v in zip(c.valid.tolist(), dec) if ok}
+                if vals - parent:
+                    problems.append(
+                        f"{qname}: FK {nm} value without parent in "
+                        f"{rdb}.{rtbl}.{rcol}"
+                    )
+                    break
+        # partition tagging: every row sits in the block its tag claims
+        if t.partition is not None:
+            pcol = t.partition[1]
+            for b in t.blocks(ver):
+                c = b.columns.get(pcol)
+                if c is None:
+                    continue
+                vals = c.data[c.valid]
+                if not len(vals):
+                    continue
+                try:
+                    pids = t.partition_of(vals)
+                except ValueError:
+                    problems.append(
+                        f"{qname}: row outside every partition range"
+                    )
+                    continue
+                # untagged blocks are LEGITIMATE (UPDATE fast paths
+                # rebuild without tags; scans always read them) — only
+                # a tag that contradicts its rows is corruption
+                if b.part_id is not None and bool(
+                    (pids != b.part_id).any()
+                ):
+                    problems.append(
+                        f"{qname}: rows tagged partition "
+                        f"{b.part_id} belong elsewhere"
+                    )
+        return problems
+
+    # ------------------------------------------------------------------
     def _guard_column_refs(self, t, db, tname, cn: str, verb: str) -> None:
         """Refuse column DDL that would break CHECK/FK bookkeeping
         (reference: modify-column prechecks in pkg/ddl/column.go)."""
@@ -1298,6 +1507,8 @@ class Session:
                 # is identical, so the privilege must be too
                 self._check_priv("drop", (s.db or self.db).lower(), s.name.lower())
                 self._check_priv("create", (s.db or self.db).lower())
+        elif isinstance(s, ast.AdminStmt):
+            self._require_super()
         elif isinstance(s, ast.RenameTable):
             # MySQL: ALTER+DROP on the source, CREATE+INSERT on the
             # target; the alter+drop pair is the enforced core here
@@ -1611,6 +1822,8 @@ class Session:
         elif isinstance(s, ast.DropView):
             self.catalog.drop_view(s.db or self.db, s.name, s.if_exists)
             r = Result([], [])
+        elif isinstance(s, ast.AdminStmt):
+            r = self._run_admin(s)
         elif isinstance(s, ast.RenameTable):
             failpoint.inject("ddl/rename-table")
             # MySQL RENAME TABLE is atomic across its pairs: validate

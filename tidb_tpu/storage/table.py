@@ -371,10 +371,15 @@ class Table:
 
     def append_block(self, block: HostBlock) -> int:
         """Append rows; returns the new version id."""
+        from tidb_tpu.utils.failpoint import inject
+
         with self._lock:
             self._check_domains(block)
             block = self._align_dictionaries(block)
-            self._check_unique(block)
+            # failpoint: simulate a buggy write path that skips unique
+            # maintenance — the corruption ADMIN CHECK TABLE must catch
+            if not inject("storage/append-skip-unique", False):
+                self._check_unique(block)
             new_blocks = list(self._versions[self.version]) + (
                 self.split_by_partition(block)
             )
