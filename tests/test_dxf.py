@@ -194,3 +194,163 @@ def test_slow_subtask_not_double_executed(sess, monkeypatch):
     tid = m.submit("slow", {})
     assert m.run_to_completion(tid, executors=2, timeout_s=30) == "succeed"
     assert runs == [0]  # ran exactly once despite TTL << runtime
+
+
+def test_backfill_merges_subtask_runs(sess, tmp_path):
+    """The per-block sorted runs are REAL work: the finalizer k-way
+    merges them into the installed derived-index cache, byte-identical
+    to a fresh argsort (ADMIN CHECK cross-validates the same way)."""
+    import numpy as np
+
+    sess.execute("create table bf (k int, v int)")
+    # several appends -> several blocks, interleaved values + NULLs
+    for lo in (300, 0, 600):
+        sess.execute(
+            "insert into bf values "
+            + ", ".join(f"({(lo + i) % 701}, {i})" for i in range(250))
+        )
+    sess.execute("insert into bf values (null, 1), (null, 2)")
+    t = sess.catalog.table("test", "bf")
+    assert len(t.blocks()) >= 4
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "index_backfill",
+        {"db": "test", "table": "bf", "column": "k", "index": "ik",
+         "spill_dir": str(tmp_path)},
+    )
+    assert m.run_to_completion(tid, executors=3) == "succeed"
+    t = sess.catalog.table("test", "bf")
+    assert t.indexes["ik"] == ["k"] and t.index_state("ik") == "public"
+    # the merged install must be present for the CURRENT version and
+    # agree exactly with a fresh recompute
+    ent = t._idx_cache.get((t.version, "k"))
+    assert ent is not None, "merge did not install the index cache"
+    svals, perm, nvalid = ent
+    data = np.concatenate([b.columns["k"].data for b in t.blocks()])
+    valid = np.concatenate([b.columns["k"].valid for b in t.blocks()])
+    fresh = np.lexsort((data, np.where(valid, 0, 1)))
+    assert nvalid == int(valid.sum())
+    assert np.array_equal(data[fresh], svals)
+    assert np.array_equal(data[perm], svals)  # perm consistent too
+    sess.execute("admin check index bf ik")  # bookkeeping cross-check
+    # and the index actually serves queries
+    assert sess.execute("select v from bf where k = 700").rows != []
+
+
+def test_import_ingests_sorted_index(sess, tmp_path):
+    """IMPORT INTO with an existing index: subtask runs merge into an
+    installed cache — sorted-index-ready with no post-hoc argsort."""
+    import numpy as np
+
+    path = str(tmp_path / "d.tsv")
+    rows = [(i * 37) % 9991 for i in range(6000)]
+    with open(path, "w") as f:
+        for i, k in enumerate(rows):
+            f.write(f"{k}\t{i}\n")
+    sess.execute("create table si (k int, v int)")
+    sess.execute("create index ik on si (k)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "si", "path": path, "chunk_bytes": 8192,
+         "spill_dir": str(tmp_path)},
+    )
+    assert m.run_to_completion(tid, executors=4) == "succeed"
+    t = sess.catalog.table("test", "si")
+    assert sess.execute("select count(*) from si").rows == [(6000,)]
+    ent = t._idx_cache.get((t.version, "k"))
+    assert ent is not None, "import did not ingest the sorted index"
+    svals, perm, nvalid = ent
+    data = np.concatenate([b.columns["k"].data for b in t.blocks()])
+    assert nvalid == 6000 and np.array_equal(np.sort(data), svals)
+    sess.execute("admin check index si ik")
+    assert sess.execute("select count(*) from si where k = 37").rows[0][0] >= 1
+
+
+def test_extsort_merge_matches_lexsort():
+    """Unit: k-way merge == one global lexsort, ties + NULLs included."""
+    import numpy as np
+
+    from tidb_tpu.dxf import extsort
+
+    rng = np.random.default_rng(7)
+    chunks = []
+    off = 0
+    all_data, all_valid = [], []
+    for n in (17, 1, 64, 33):
+        data = rng.integers(0, 9, n)
+        valid = rng.random(n) > 0.2
+        chunks.append(extsort.sort_run(data, valid, off))
+        all_data.append(data)
+        all_valid.append(valid)
+        off += n
+    merged = extsort.merge_runs(chunks)
+    svals, rank, rows = merged
+    data = np.concatenate(all_data)
+    valid = np.concatenate(all_valid)
+    ref = np.lexsort((data, np.where(valid, 0, 1)))
+    assert np.array_equal(rows, ref)  # STABLE: exact permutation match
+    assert np.array_equal(svals, data[ref])
+
+
+def test_backfill_unknown_column_fails_cleanly(sess):
+    sess.execute("create table bfc (a int)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "index_backfill",
+        {"db": "test", "table": "bfc", "column": "nope", "index": "ix"},
+    )
+    state = m.run_to_completion(tid, executors=1)
+    assert state in ("failed", "reverted")
+    t = sess.catalog.table("test", "bfc")
+    assert "ix" not in t.indexes  # no phantom write_only registration
+
+
+def test_backfill_existing_index_refused(sess):
+    sess.execute("create table bfe (a int)")
+    sess.execute("create index ia on bfe (a)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "index_backfill",
+        {"db": "test", "table": "bfe", "column": "a", "index": "ia"},
+    )
+    assert m.run_to_completion(tid, executors=1) in ("failed", "reverted")
+    t = sess.catalog.table("test", "bfe")
+    # the pre-existing PUBLIC index is untouched
+    assert t.indexes["ia"] == ["a"] and t.index_state("ia") == "public"
+
+
+def test_backfill_failed_subtask_reverts_registration(sess, tmp_path):
+    from tidb_tpu.utils import failpoint
+
+    sess.execute("create table bff (a int)")
+    sess.execute("insert into bff values (1), (2)")
+    m = TaskManager(sess.catalog)
+
+    # make every run raise: the reverter must clear the registration
+    import tidb_tpu.dxf.tasks as tasks_mod
+
+    orig = tasks_mod._backfill_run
+
+    def bad_run(meta, catalog):
+        raise OSError("disk full")
+
+    register_task_type(
+        "index_backfill", tasks_mod._backfill_plan, bad_run,
+        tasks_mod._backfill_finalize, reverter=tasks_mod._backfill_revert,
+    )
+    try:
+        tid = m.submit(
+            "index_backfill",
+            {"db": "test", "table": "bff", "column": "a", "index": "iz",
+             "spill_dir": str(tmp_path)},
+        )
+        assert m.run_to_completion(tid, executors=1) in ("failed", "reverted")
+    finally:
+        register_task_type(
+            "index_backfill", tasks_mod._backfill_plan, orig,
+            tasks_mod._backfill_finalize,
+            reverter=tasks_mod._backfill_revert,
+        )
+    t = sess.catalog.table("test", "bff")
+    assert "iz" not in t.indexes and "iz" not in t.index_states

@@ -1,0 +1,144 @@
+"""External-sort runs and vectorized k-way merge for DXF backfill and
+IMPORT INTO sorted ingest.
+
+Reference: lightning's external backend — EncodeAndSort writes per-chunk
+sorted KV files, MergeOverlappingFiles k-way merges them, Ingest
+installs (br/pkg/lightning/backend/external/merge.go:39,
+pkg/disttask/importinto steps). The columnar analog: every subtask
+sorts ITS block(s) into a run file (sorted values + permutation), and
+the finalizer merges K sorted runs with a vectorized pairwise stable
+merge — O(n log k) searchsorted passes, no Python per-row heap — then
+installs the result as the table's derived sorted-index cache entry, so
+the first query after the DDL/IMPORT pays no argsort.
+
+Sort key = (null-rank, value): NULLs rank last, matching
+Table._sorted_index's lexsort exactly (the install target).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _rows_view(m: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(m).view([("", m.dtype)] * m.shape[1]).ravel()
+
+
+def _key_matrix(svals: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    """[n, 2] (rank, value) in one dtype so the void row-view compares
+    lexicographically — rank first, value second, like the lexsort."""
+    dt = np.result_type(svals.dtype, np.int8)
+    return np.column_stack([rank.astype(dt), svals.astype(dt)])
+
+
+def sort_run(
+    data: np.ndarray, valid: np.ndarray, row_offset: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort one block's column: (sorted values, null-rank per sorted
+    element, GLOBAL row ids). The distributed EncodeAndSort step."""
+    rank = np.where(valid, 0, 1).astype(np.int8)
+    perm = np.lexsort((data, rank))
+    return data[perm], rank[perm], (perm + row_offset).astype(np.int64)
+
+
+def write_run(path: str, data, valid, row_offset: int) -> dict:
+    """Spill one sorted run to disk; returns its manifest entry."""
+    svals, rank, rows = sort_run(
+        np.asarray(data), np.asarray(valid), row_offset
+    )
+    np.savez(path, svals=svals, rank=rank, rows=rows)
+    return {"run": path, "n": int(len(svals)), "nvalid": int((rank == 0).sum())}
+
+
+def read_run(path: str):
+    with np.load(path) as z:
+        return z["svals"], z["rank"], z["rows"]
+
+
+def merge_two(a, b):
+    """Stable vectorized merge of two sorted runs (a precedes b: a wins
+    ties, preserving global row order for equal keys)."""
+    (sa, ra, pa), (sb, rb, pb) = a, b
+    ka = _rows_view(_key_matrix(sa, ra))
+    kb = _rows_view(_key_matrix(sb, rb))
+    pos_a = np.arange(len(ka)) + np.searchsorted(kb, ka, side="left")
+    pos_b = np.arange(len(kb)) + np.searchsorted(ka, kb, side="right")
+    n = len(ka) + len(kb)
+    svals = np.empty(n, dtype=np.result_type(sa.dtype, sb.dtype))
+    rank = np.empty(n, dtype=np.int8)
+    rows = np.empty(n, dtype=np.int64)
+    svals[pos_a], svals[pos_b] = sa, sb
+    rank[pos_a], rank[pos_b] = ra, rb
+    rows[pos_a], rows[pos_b] = pa, pb
+    return svals, rank, rows
+
+
+def merge_runs(runs: List[tuple]) -> Optional[tuple]:
+    """K-way merge by pairwise rounds: log2(k) vectorized passes.
+    Runs must be in global row order (run i's rows precede run i+1's)
+    for tie stability."""
+    runs = [r for r in runs if r is not None and len(r[0])]
+    if not runs:
+        return None
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            nxt.append(merge_two(runs[i], runs[i + 1]))
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]
+
+
+def install_sorted_index(
+    table, col: str, merged, version: int, bump: bool = False
+) -> bool:
+    """Install a merged run as the derived sorted-index cache entry for
+    (version, col) — the Ingest step. Returns False when the table has
+    moved past `version` (caller re-plans the delta) or the merged row
+    count no longer matches (stale runs). bump=True additionally
+    publishes a schema-barrier version (same blocks) in the SAME lock
+    acquisition and installs the cache under THAT version — the
+    backfill finalizer's flip-to-public must not orphan the merge on a
+    version it immediately supersedes."""
+    with table._lock:
+        if table.version != version:
+            return False
+        total = sum(b.nrows for b in table.blocks(version))
+        if merged is None:
+            if total:
+                return False
+            svals = np.zeros(0, dtype=np.int64)
+            perm = np.zeros(0, dtype=np.int64)
+            nvalid = 0
+        else:
+            svals, rank, perm = merged
+            if len(svals) != total:
+                return False
+            nvalid = int((rank == 0).sum())
+        if bump:
+            import time
+
+            table.version += 1
+            table._versions[table.version] = list(table._versions[version])
+            table.version_ts.setdefault(table.version, time.time())
+            table._gc_versions()
+            version = table.version
+        cache = getattr(table, "_idx_cache", None)
+        if cache is None:
+            cache = table._idx_cache = {}
+        cache[(version, col)] = (svals, perm, nvalid)
+        return True
+
+
+def cleanup_runs(manifests: List[dict]) -> None:
+    for m in manifests or []:
+        p = (m or {}).get("run")
+        if p:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass

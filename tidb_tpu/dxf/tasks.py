@@ -4,19 +4,22 @@ index backfill.
 Reference mappings:
 - "analyze": ANALYZE pushdown split per column (the reference splits
   per region/column group; pkg/executor/analyze.go workers).
-- "import": IMPORT INTO via chunked file ingest — the lightning
-  pipeline (mydump chunk -> encode -> ingest, pkg/disttask/importinto
-  steps Init -> EncodeAndSort -> ... -> Done) collapsed to chunk-load
-  subtasks + a commit finalizer. Each subtask parses its byte range
-  independently, so the job spreads over executors and resumes from
-  the subtask ledger after a crash.
-- "index_backfill": CREATE INDEX backfill split per block range
-  (pkg/ddl/backfilling_dist_scheduler.go); the finalizer installs the
-  index (one argsort over immutable versions — the merge step).
+- "import": IMPORT INTO through the lightning external-backend shape
+  (pkg/disttask/importinto Init -> EncodeAndSort -> MergeSort ->
+  Ingest): each subtask parses its byte range into a STAGED block file
+  plus sorted runs for indexed columns (dxf/extsort.py); the finalizer
+  appends the staged blocks and k-way merges the runs into installed
+  sorted-index caches — no post-hoc argsort. Crash-resume re-stages
+  unfinished chunks from the subtask ledger with no double-append.
+- "index_backfill": CREATE INDEX backfill split per block
+  (pkg/ddl/backfilling_dist_scheduler.go): subtasks spill per-block
+  sorted runs, the finalizer k-way merges them into the derived
+  sorted-index cache under the F1 state ladder.
 """
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 from tidb_tpu.dxf.framework import register_task_type
@@ -59,7 +62,12 @@ def _import_plan(meta, catalog) -> List[dict]:
     (mydump chunking: every subtask owns a self-contained byte range)."""
     import os
 
+    import uuid
+
     path = meta["path"]
+    # per-task nonce: spill files of concurrent tasks over same-named
+    # tables (or the same table twice) must never collide
+    nonce = meta.setdefault("nonce", uuid.uuid4().hex[:12])
     chunk = int(meta.get("chunk_bytes", 1 << 20))
     size = os.path.getsize(path)
     subtasks = []
@@ -76,6 +84,8 @@ def _import_plan(meta, catalog) -> List[dict]:
                     "db": meta["db"], "table": meta["table"],
                     "path": path, "start": start, "end": end,
                     "sep": meta.get("sep", "\t"),
+                    "spill_dir": meta.get("spill_dir"),
+                    "nonce": nonce,
                 }
             )
             start = end
@@ -83,10 +93,15 @@ def _import_plan(meta, catalog) -> List[dict]:
 
 
 def _import_run(meta, catalog) -> dict:
-    """Parse one byte range and append it (idempotence note: a re-run
-    after a crash re-appends only because the subtask ledger showed it
-    unfinished — matching lightning's chunk checkpoints)."""
-    from tidb_tpu.storage.loader import load_rows_python
+    """EncodeAndSort: parse one byte range into a STAGED block file
+    (never appended here — re-runs after a crash just re-stage, the
+    lightning chunk-checkpoint property without double-append risk),
+    plus a sorted run per single-column numeric/temporal index so the
+    finalizer's Ingest needs no post-hoc argsort."""
+    import numpy as np
+
+    from tidb_tpu.dxf import extsort
+    from tidb_tpu.storage.loader import parse_block
 
     t = catalog.table(meta["db"], meta["table"])
     # binary seek/read: start/end are BYTE offsets (text-mode seek on
@@ -98,63 +113,286 @@ def _import_run(meta, catalog) -> dict:
     lines = [
         ln for ln in data.decode("utf-8", errors="replace").splitlines() if ln
     ]
-    n = load_rows_python(t, lines, meta["sep"])
-    return {"rows": n}
+    block = parse_block(t, lines, meta["sep"])
+    if block is None:
+        return {"rows": 0, "staged": None}
+    d = _spill_dir(meta)
+    tag = f"im_{meta['db']}_{meta['table']}_{meta.get('nonce', '0')}_{meta['start']}"
+    staged = os.path.join(d, f"{tag}.npz")
+    arrs = {}
+    for name, c in block.columns.items():
+        arrs[f"d_{name}"] = c.data
+        arrs[f"v_{name}"] = c.valid
+        if c.dictionary is not None:
+            # unicode dtype, NOT object: loads without allow_pickle
+            arrs[f"s_{name}"] = c.dictionary.astype(str)
+    np.savez(staged, **arrs)
+    runs = []
+    for iname, cols in t.indexes.items():
+        if len(cols) != 1:
+            continue
+        c = block.columns.get(cols[0])
+        if c is None or c.dictionary is not None:
+            continue  # string codes remap on dict alignment: skip
+        rp = os.path.join(d, f"{tag}_{cols[0]}.npz")
+        man = extsort.write_run(rp, c.data, c.valid, 0)
+        man["col"] = cols[0]
+        runs.append(man)
+    return {"rows": block.nrows, "staged": staged, "runs": runs,
+            "start": meta["start"]}
 
 
 def _import_finalize(meta, results, catalog) -> None:
-    from tidb_tpu.storage.scan import clear_scan_cache
+    """Ingest: append staged blocks in chunk order, then k-way merge the
+    per-chunk sorted runs with runs over pre-existing blocks and install
+    each index's derived cache (MergeOverlappingFiles -> ingest,
+    br/pkg/lightning/backend/external/merge.go:39)."""
+    import numpy as np
 
+    from tidb_tpu.dxf import extsort
+    from tidb_tpu.storage.scan import clear_scan_cache
+    from tidb_tpu.chunk import HostBlock, HostColumn
+
+    t = catalog.table(meta["db"], meta["table"])
+    staged = sorted(
+        (r for r in results if r and r.get("staged")),
+        key=lambda r: r.get("start", 0),
+    )
+    types = t.schema.types
+    appended = []  # (chunk result, landed uids)
+    for r in staged:
+        # idempotence fence for owner-failover re-runs: a staged file
+        # that no longer exists was ingested by a previous finalize
+        # attempt — append THEN unlink, per chunk (the same
+        # crash-window contract as the old per-subtask append ledger)
+        if not os.path.exists(r["staged"]):
+            continue
+        with np.load(r["staged"]) as z:
+            cols = {}
+            for name in t.schema.names:
+                if f"d_{name}" not in z:
+                    continue
+                dic = (
+                    z[f"s_{name}"].astype(object)
+                    if f"s_{name}" in z else None
+                )
+                cols[name] = HostColumn(
+                    types[name], z[f"d_{name}"], z[f"v_{name}"], dic
+                )
+        if cols:
+            b = HostBlock.from_columns(cols)
+            _v, uids = t.append_block_uids(b)
+            appended.append((r, uids))
+        try:
+            os.unlink(r["staged"])
+        except OSError:
+            pass
+    # Ingest the merged sorted indexes (unpartitioned, numeric single
+    # col — string codes were remapped by dictionary alignment and
+    # partition split re-distributes rows; those fall back to the
+    # on-demand derived argsort)
+    if t.partition is None:
+        run_by_uid: dict = {}  # (col, uid) -> run manifest
+        for r, uids in appended:
+            for man in r.get("runs") or []:
+                if len(uids) == 1:  # unpartitioned: one landed block
+                    run_by_uid[(man["col"], uids[0])] = man
+        cols_with_runs = {c for (c, _u) in run_by_uid}
+        for col in cols_with_runs:
+            while True:
+                version = t.version
+                blocks = list(t.blocks(version))
+                runs = []
+                off = 0
+                for b in blocks:
+                    c = b.columns.get(col)
+                    if c is None:
+                        runs = None
+                        break
+                    man = run_by_uid.get((col, b.uid))
+                    if (
+                        man is not None
+                        and man["n"] == b.nrows
+                        and os.path.exists(man["run"])
+                    ):
+                        # the staged run IS this block's sort: re-offset
+                        svals, rank, rows = extsort.read_run(man["run"])
+                        runs.append((svals, rank, rows + off))
+                    else:
+                        # pre-existing or concurrent block: delta sort
+                        runs.append(extsort.sort_run(c.data, c.valid, off))
+                    off += b.nrows
+                if runs is None:
+                    break
+                merged = extsort.merge_runs(runs)
+                if extsort.install_sorted_index(t, col, merged, version):
+                    break
+        for r, _u in appended:
+            extsort.cleanup_runs(r.get("runs"))
     clear_scan_cache()
 
 
 # -- index backfill ---------------------------------------------------------
 
 
+def _spill_dir(meta) -> str:
+    import tempfile
+
+    d = meta.get("spill_dir") or os.path.join(
+        tempfile.gettempdir(), "tidb_tpu_extsort"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def _backfill_plan(meta, catalog) -> List[dict]:
+    """One subtask per block, pinned to the planning snapshot: each
+    carries its block uid + global row offset so run files merge in
+    global row order (pkg/ddl/backfilling_dist_scheduler.go splits by
+    region range the same way)."""
     t = catalog.table(meta["db"], meta["table"])
-    nblocks = max(len(t.blocks()), 1)
-    return [
-        {"db": meta["db"], "table": meta["table"], "column": meta["column"],
-         "block": i}
-        for i in range(nblocks)
-    ]
+    col = meta["column"].lower()
+    if col not in t.schema.names:
+        raise ValueError(f"unknown column {col!r}")
+    name = meta.get("index", f"idx_{col}").lower()
+    # register WRITE_ONLY before planning: every writer from this
+    # instant maintains the (derived) index; readers ignore it.
+    # An existing index (any state) must never be demoted/stomped.
+    with t._lock:
+        if name in t.indexes:
+            raise ValueError(f"index {name} already exists")
+        t.indexes[name] = [col]
+        t.index_states[name] = "write_only"
+    version = t.version
+    subtasks = []
+    off = 0
+    for i, b in enumerate(t.blocks(version)):
+        subtasks.append({
+            "db": meta["db"], "table": meta["table"], "column": col,
+            "block_uid": b.uid, "block": i, "offset": off,
+            "version": version, "spill_dir": meta.get("spill_dir"),
+        })
+        off += b.nrows
+    return subtasks or [{
+        "db": meta["db"], "table": meta["table"], "column": col,
+        "block_uid": -1, "block": 0, "offset": 0, "version": version,
+        "spill_dir": meta.get("spill_dir"),
+    }]
 
 
 def _backfill_run(meta, catalog) -> dict:
-    """Per-block partial sort — the distributed backfill read+sort step.
-    (The final argsort in the finalizer reuses these results morally;
-    physically the sorted-index cache is one argsort over the immutable
-    version, so the merge is the cache fill.)"""
-    import numpy as np
+    """EncodeAndSort: sort THIS block's column into a spilled run file
+    (dxf/extsort.py). The real distributed work — wall time scales with
+    executor count because each run sorts independently."""
+    from tidb_tpu.dxf import extsort
 
     t = catalog.table(meta["db"], meta["table"])
-    blocks = t.blocks()
-    if meta["block"] >= len(blocks):
-        return {"rows": 0}
-    c = blocks[meta["block"]].columns.get(meta["column"])
+    blocks = {b.uid: b for b in t.blocks(meta["version"])} if t.has_version(
+        meta["version"]
+    ) else {}
+    b = blocks.get(meta["block_uid"])
+    if b is None:
+        return {"rows": 0, "run": None}
+    c = b.columns.get(meta["column"])
     if c is None:
-        return {"rows": 0}
-    np.argsort(c.data, kind="stable")  # the backfill scan+sort work
-    return {"rows": int(c.data.shape[0])}
+        return {"rows": 0, "run": None}
+    path = os.path.join(
+        _spill_dir(meta),
+        f"bf_{meta['db']}_{meta['table']}_{meta['column']}_"
+        f"{meta['block_uid']}.npz",
+    )
+    man = extsort.write_run(path, c.data, c.valid, meta["offset"])
+    man["rows"] = man["n"]
+    man["uid"] = meta["block_uid"]
+    return man
 
 
 def _backfill_finalize(meta, results, catalog) -> None:
+    """MergeSort + Ingest: k-way merge the spilled runs (global row
+    order) and install the result as the derived sorted-index cache for
+    the snapshot version; blocks appended since the snapshot (WRITE_ONLY
+    writers) sort as delta runs here. Then flip PUBLIC."""
+    from tidb_tpu.dxf import extsort
+
     t = catalog.table(meta["db"], meta["table"])
     name = meta.get("index", f"idx_{meta['column']}").lower()
     col = meta["column"].lower()
-    # same F1 ladder as the session path (session._add_index): register
-    # write_only (writers maintain), reorg (merge/warm), then public
-    t.indexes[name] = [col]
-    t.index_states[name] = "write_only"
     t.index_states[name] = "write_reorg"
-    t._sorted_index(col)  # install (merge step)
-    t.index_states[name] = "public"
-    t.bump_version()  # schema barrier for in-flight transactions
+    try:
+        for _attempt in range(64):
+            version = t.version
+            blocks = list(t.blocks(version))
+            have = {
+                r["uid"]: r for r in results
+                if r and r.get("run") and os.path.exists(r["run"])
+            }
+            runs = []
+            off = 0
+            for b in blocks:
+                r = have.get(b.uid)
+                if r is not None and r.get("n") == b.nrows:
+                    svals, rank, rows = extsort.read_run(r["run"])
+                    # re-offset: the block may have shifted position
+                    rows = rows - (rows.min() if len(rows) else 0) + off
+                    runs.append((svals, rank, rows))
+                else:
+                    # delta block (WRITE_ONLY-era append or rewrite):
+                    # sort it here — small next to the planned snapshot
+                    c = b.columns.get(col)
+                    if c is not None:
+                        runs.append(extsort.sort_run(c.data, c.valid, off))
+                off += b.nrows
+            merged = extsort.merge_runs(runs)
+            # install + schema-barrier bump in ONE lock acquisition:
+            # the public flip must not orphan the merge on a version it
+            # immediately supersedes
+            if extsort.install_sorted_index(t, col, merged, version, bump=True):
+                break  # version held: ingest landed
+        else:
+            raise RuntimeError(
+                f"backfill of {col!r} did not converge (column dropped "
+                "mid-reorg or version churn)"
+            )
+        t.index_states[name] = "public"
+    except BaseException:
+        with t._lock:  # roll the registration back
+            t.indexes.pop(name, None)
+            t.index_states.pop(name, None)
+        raise
+    finally:
+        extsort.cleanup_runs(results)
 
 
 register_task_type("analyze", _analyze_plan, _analyze_run, _analyze_finalize)
 register_task_type("import", _import_plan, _import_run, _import_finalize)
+def _backfill_revert(meta, catalog) -> None:
+    """Failed/reverting backfill: drop the WRITE_ONLY registration the
+    planner installed (finalize never ran, so nothing went public) and
+    sweep any spilled run files."""
+    import glob
+
+    try:
+        t = catalog.table(meta["db"], meta["table"])
+        name = meta.get(
+            "index", f"idx_{meta['column'].lower()}"
+        ).lower()
+        with t._lock:
+            if t.index_states.get(name) in ("write_only", "write_reorg"):
+                t.indexes.pop(name, None)
+                t.index_states.pop(name, None)
+    except Exception:
+        pass
+    for p in glob.glob(os.path.join(
+        _spill_dir(meta),
+        f"bf_{meta['db']}_{meta['table']}_{meta['column'].lower()}_*.npz",
+    )):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
 register_task_type(
-    "index_backfill", _backfill_plan, _backfill_run, _backfill_finalize
+    "index_backfill", _backfill_plan, _backfill_run, _backfill_finalize,
+    reverter=_backfill_revert,
 )

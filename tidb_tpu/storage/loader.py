@@ -30,7 +30,10 @@ def _parse_value(text: str, typ):
     return text  # STRING / DATE handled by column_from_values
 
 
-def load_rows_python(table, lines: List[str], sep: str) -> int:
+def parse_block(table, lines: List[str], sep: str) -> Optional[HostBlock]:
+    """Parse text rows into an (unappended) HostBlock — the Encode step
+    shared by direct LOAD DATA and the DXF import pipeline's staged
+    EncodeAndSort subtasks."""
     names = table.schema.names
     types = [t for _, t in table.schema.columns]
     cols: List[List] = [[] for _ in names]
@@ -50,12 +53,18 @@ def load_rows_python(table, lines: List[str], sep: str) -> int:
             cols[i].append(_parse_value(text, typ))
         n += 1
     if n == 0:
-        return 0
-    block = HostBlock.from_columns(
+        return None
+    return HostBlock.from_columns(
         {name: column_from_values(vals, typ) for name, vals, typ in zip(names, cols, types)}
     )
+
+
+def load_rows_python(table, lines: List[str], sep: str) -> int:
+    block = parse_block(table, lines, sep)
+    if block is None:
+        return 0
     table.append_block(block)
-    return n
+    return block.nrows
 
 
 def load_file(table, path: str, sep: str = "\t") -> int:

@@ -371,6 +371,15 @@ class Table:
 
     def append_block(self, block: HostBlock) -> int:
         """Append rows; returns the new version id."""
+        v, _uids = self.append_block_uids(block)
+        return v
+
+    def append_block_uids(self, block: HostBlock):
+        """Append rows; returns (new version id, uids of the landed
+        blocks). The uid list lets bulk-ingest finalizers (DXF import)
+        match their pre-sorted runs to the exact blocks that landed —
+        dictionary alignment and partition split may rebuild the
+        incoming block under fresh uids."""
         from tidb_tpu.utils.failpoint import inject
 
         with self._lock:
@@ -380,14 +389,13 @@ class Table:
             # maintenance — the corruption ADMIN CHECK TABLE must catch
             if not inject("storage/append-skip-unique", False):
                 self._check_unique(block)
-            new_blocks = list(self._versions[self.version]) + (
-                self.split_by_partition(block)
-            )
+            landed = self.split_by_partition(block)
+            new_blocks = list(self._versions[self.version]) + landed
             self.modify_count += block.nrows
             self.version += 1
             self._versions[self.version] = new_blocks
             self._gc_versions()
-            return self.version
+            return self.version, [b.uid for b in landed]
 
     def _check_domains(self, block: HostBlock) -> None:
         """ENUM/SET membership + JSON validity on write (caller holds
