@@ -144,6 +144,11 @@ class ScanSite:
     # pkg/planner/core/rule_partition_processor.go): partition ids the
     # predicate can reach; None = all partitions scan
     partitions: Optional[Tuple[int, ...]] = None
+    # index-merge UNION reader (pkg/executor/index_merge_reader.go:88):
+    # OR-of-indexable-ranges — the fetch unions each range's sorted-
+    # index row ids (dedup via np.unique) and gathers once; the
+    # original predicate still filters, so over-approximation is safe
+    merge_ranges: Optional[Tuple[Tuple[str, int, int], ...]] = None
 
 
 @dataclasses.dataclass
@@ -303,6 +308,21 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
         t, _v = resolver(scan.db, scan.table)
     except Exception:
         return None
+    candidates = _index_candidates(t)
+    best = None
+    for col in candidates:
+        r = _extract_col_range(pred, scan, t, col)
+        if r is None:
+            continue
+        width = r[2] - r[1]
+        if best is None or width < best[0]:
+            best = (width, r)
+    return best[1] if best else None
+
+
+def _index_candidates(t) -> list:
+    """Single-column access paths: the one-column PK plus leading
+    columns of PUBLIC indexes (shared by range and merge extraction)."""
     candidates = []
     pk = t.schema.primary_key
     if pk and len(pk) == 1:
@@ -315,15 +335,69 @@ def _extract_pk_range(pred, scan: "L.Scan", resolver):
     for icols in idx_map.values():
         if icols and icols[0] not in candidates:
             candidates.append(icols[0])
-    best = None
-    for col in candidates:
-        r = _extract_col_range(pred, scan, t, col)
-        if r is None:
+    return candidates
+
+
+def _extract_index_merge(pred, scan: "L.Scan", resolver):
+    """OR-of-indexable-ranges -> tuple of (col, lo, hi) whose UNION
+    covers every accepting row (the IndexMerge union reader,
+    pkg/executor/index_merge_reader.go:88). Sound because each
+    disjunct's range over-approximates that disjunct and the original
+    predicate re-filters the fetched batch; extraction fails — full
+    scan — if ANY disjunct is not range-expressible on an indexed
+    column (a non-indexable disjunct could accept rows outside every
+    range). AND-of-ranges (intersection) needs no special reader here:
+    the single-range path takes the narrowest conjunct and the filter
+    applies the rest."""
+    from tidb_tpu.expression.expr import Func
+
+    if "_tidb_rowid" in scan.columns:
+        return None
+    try:
+        t, _v = resolver(scan.db, scan.table)
+    except Exception:
+        return None
+    candidates = _index_candidates(t)
+    if not candidates:
+        return None
+
+    def conjs(e):
+        if isinstance(e, Func) and e.op == "and":
+            return conjs(e.args[0]) + conjs(e.args[1])
+        return [e]
+
+    def disjuncts(e):
+        if isinstance(e, Func) and e.op == "or":
+            return disjuncts(e.args[0]) + disjuncts(e.args[1])
+        return [e]
+
+    # one OR-shaped conjunct suffices: the other conjuncts only filter
+    # further, so the union over this OR stays a superset of the result
+    for c in conjs(pred):
+        ds = disjuncts(c)
+        if len(ds) < 2:
             continue
-        width = r[2] - r[1]
-        if best is None or width < best[0]:
-            best = (width, r)
-    return best[1] if best else None
+        ranges = []
+        for d in ds:
+            best = None
+            for col in candidates:
+                r = _extract_col_range(d, scan, t, col, open_ok=True)
+                if r is not None:
+                    # open sides take searchsorted-safe extremes: the
+                    # union reader only needs a superset per disjunct
+                    col_, lo, hi = r
+                    lo = -(1 << 62) if lo is None else lo
+                    hi = (1 << 62) if hi is None else hi
+                    width = hi - lo
+                    if best is None or width < best[0]:
+                        best = (width, (col_, lo, hi))
+            if best is None:
+                ranges = None
+                break
+            ranges.append(best[1])
+        if ranges:
+            return tuple(ranges)
+    return None
 
 
 def _extract_col_range(pred, scan: "L.Scan", t, pkcol: str, open_ok=False):
@@ -718,8 +792,10 @@ class PlanCompiler:
                     nid, plan.db, plan.table, plan.alias, plan.columns,
                     pk_range=getattr(self, "_pending_range", None),
                     partitions=parts,
+                    merge_ranges=getattr(self, "_pending_merge", None),
                 )
             )
+            self._pending_merge = None
             if parts is not None and self.node_labels:
                 # surface pruning in EXPLAIN: the Scan is a leaf, so its
                 # label is the most recently appended
@@ -799,12 +875,17 @@ class PlanCompiler:
                 self._pending_range = _extract_pk_range(
                     plan.predicate, plan.child, self.resolver
                 )
+                if self._pending_range is None:
+                    self._pending_merge = _extract_index_merge(
+                        plan.predicate, plan.child, self.resolver
+                    )
             if isinstance(plan.child, L.Scan):
                 self._pending_parts = _prune_partitions(
                     plan.predicate, plan.child, self.resolver
                 )
             child, dicts = self._build(plan.child)
             self._pending_range = None
+            self._pending_merge = None
             self._pending_parts = None
             pred = compile_expr(plan.predicate, dicts)
 
@@ -1702,6 +1783,18 @@ class PhysicalExecutor:
 
                 col, lo, hi = s.pk_range
                 idx = t.range_rows(col, lo, hi, version=v)
+                block = t.gather_rows(idx, s.columns, version=v)
+                inputs[s.node_id] = block_to_batch(block)
+            elif s.merge_ranges is not None and mesh is None:
+                from tidb_tpu.chunk import block_to_batch
+
+                # index-merge UNION: each disjunct's sorted-index row
+                # ids, deduped+ordered by np.unique, gathered ONCE
+                ids = [
+                    t.range_rows(col, lo, hi, version=v)
+                    for col, lo, hi in s.merge_ranges
+                ]
+                idx = np.unique(np.concatenate(ids))
                 block = t.gather_rows(idx, s.columns, version=v)
                 inputs[s.node_id] = block_to_batch(block)
             else:

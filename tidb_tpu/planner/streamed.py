@@ -160,6 +160,15 @@ def _fetch_resident(executor, site, st, sv):
         col, lo, hi = site.pk_range
         idx = st.range_rows(col, lo, hi, version=sv)
         return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
+    if getattr(site, "merge_ranges", None) is not None:
+        # index-merge union reader, same as the unstreamed fetch — a
+        # memory-pressured plan needs the narrowed fetch MOST
+        ids = [
+            st.range_rows(col, lo, hi, version=sv)
+            for col, lo, hi in site.merge_ranges
+        ]
+        idx = np.unique(np.concatenate(ids))
+        return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
     batch, _d = scan_table(
         st, site.columns, version=sv, partitions=site.partitions
     )

@@ -4358,6 +4358,23 @@ def _render_plan(plan, depth, out: List[str], catalog=None):
                 detail += (
                     f" access=IndexRangeScan({col} in [{lo}, {hi}])"
                 )
+            else:
+                from tidb_tpu.planner.physical import _extract_index_merge
+
+                mr = _extract_index_merge(
+                    plan.predicate,
+                    plan.child,
+                    lambda db, tb: (catalog.table(db, tb), 0),
+                )
+                if mr is not None:
+                    def b(v, open_s):
+                        return open_s if abs(v) >= (1 << 62) else v
+
+                    spans = " | ".join(
+                        f"{c}[{b(lo, '-inf')},{b(hi, 'inf')}]"
+                        for c, lo, hi in mr
+                    )
+                    detail += f" access=IndexMerge(union: {spans})"
             from tidb_tpu.planner.physical import _prune_partitions
 
             pp = _prune_partitions(
