@@ -123,3 +123,47 @@ def test_mesh_dml_visibility(sessions):
     assert mesh.execute("select count(*) from dml.t").rows[0][0] == 2
     mesh.execute("insert into dml.t values (3, 3.5)")
     assert mesh.execute("select sum(a) from dml.t").rows[0][0] == 6
+
+
+class TestExchangeSkewNegotiation:
+    """Region-balance analog (pkg/store/copr/batch_coprocessor.go): the
+    exchange reports the TRUE hot-bucket size, so a skewed key costs at
+    most ONE capacity bump during discovery and the steady state never
+    recompiles."""
+
+    def test_skewed_key_no_steady_recompile(self):
+        from tidb_tpu.utils import failpoint
+
+        cat = Catalog()
+        s = Session(cat, db="test")
+        mesh = Session(cat, db="test", mesh_devices=N_DEV)
+        s.execute("create table f (k int, v int)")
+        s.execute("create table d (k int primary key, w int)")
+        # 90% of fact rows share ONE key: a worst-case hot bucket
+        rows = ", ".join(
+            f"({7 if i % 10 else i}, {i})" for i in range(4000)
+        )
+        s.execute(f"insert into f values {rows}")
+        s.execute(
+            "insert into d values "
+            + ", ".join(f"({i}, {i})" for i in range(4000))
+        )
+        sql = (
+            "select count(*), sum(v + w) from f join d on f.k = d.k"
+        )
+        bumps: list = []
+        failpoint.enable("executor/cap-overflow", lambda: bumps.append(1))
+        try:
+            r1 = mesh.execute(sql).rows
+            discovery_bumps = len(bumps)
+            bumps.clear()
+            r2 = mesh.execute(sql).rows
+            steady_bumps = len(bumps)
+        finally:
+            failpoint.disable("executor/cap-overflow")
+        assert r1 == r2 == s.execute(sql).rows
+        # true-need reporting: the hot bucket is sized in at most one
+        # bump per knob during discovery...
+        assert discovery_bumps <= 2, discovery_bumps
+        # ...and the steady state replays the cached program untouched
+        assert steady_bumps == 0, steady_bumps

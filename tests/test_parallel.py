@@ -59,7 +59,7 @@ class TestRepartition:
             jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
         )
         def step(b):
-            out, dropped = hash_repartition(b, colfn("g"), N, 512)
+            out, dropped, need = hash_repartition(b, colfn("g"), N, 512)
             return out, dropped
 
         out, dropped = step(sharded)
@@ -85,14 +85,18 @@ class TestRepartition:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
+            jax.shard_map,
+            mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P()),
         )
         def step(b):
-            out, dropped = hash_repartition(b, colfn("g"), N, 64)
-            return out, dropped
+            out, dropped, need = hash_repartition(b, colfn("g"), N, 64)
+            return out, dropped, need
 
-        _out, dropped = step(sharded)
+        _out, dropped, need = step(sharded)
         assert int(dropped) == 1000 - 64 * N or int(dropped) > 0
+        # the region-balance analog: the exchange reports the TRUE
+        # hot-bucket size so the host retries at the exact capacity
+        assert int(need) == 1000
 
 
 class TestDistributedAgg:
@@ -112,7 +116,7 @@ class TestDistributedAgg:
             jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
         )
         def step(b):
-            out, ng, dropped = distributed_group_aggregate(
+            out, ng, dropped, _need = distributed_group_aggregate(
                 b, [colfn("g")], aggs, 256, N, key_names=["g"]
             )
             return out, ng, dropped
@@ -150,7 +154,7 @@ class TestDistributedAgg:
             jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
         )
         def step(b):
-            return distributed_group_aggregate(b, [], [AggDesc("sum", colfn("v"), "s")], 64, N)
+            return distributed_group_aggregate(b, [], [AggDesc("sum", colfn("v"), "s")], 64, N)[:3]
 
         out, _ng, _dropped = step(sharded)
         # replicated result: read shard 0 row 0

@@ -53,7 +53,7 @@ def hash_repartition(
     n_devices: int,
     bucket_capacity: int,
     axis: str = "d",
-) -> Tuple[Batch, jax.Array]:
+) -> Tuple[Batch, jax.Array, jax.Array]:
     """Redistribute rows so equal keys colocate. Per-shard view:
 
     1. target[i] = mix(key[i]) % n                  (hash partition fn)
@@ -62,8 +62,8 @@ def hash_repartition(
     4. lax.all_to_all exchanges bucket j to device j
     5. flatten received [n, B] to a new local batch of capacity n*B
 
-    Returns (new local batch, global count of dropped rows) — nonzero
-    drop means retry with a larger bucket_capacity.
+    Returns (new local batch, global dropped rows, true per-bucket
+    need) — nonzero drop means retry at `need` (see exchange_by_target).
     """
 
     from tidb_tpu.utils.failpoint import inject
@@ -83,7 +83,7 @@ def range_repartition(
     n_devices: int,
     bucket_capacity: int,
     axis: str = "d",
-) -> Tuple[Batch, jax.Array]:
+) -> Tuple[Batch, jax.Array, jax.Array]:
     """Range-partition rows by a scalar ranking value using sampled
     splitters: device i receives every row whose rank falls in the i-th
     global range, so locally sorted shards concatenate to a total order
@@ -114,14 +114,12 @@ def range_repartition(
         jnp.int32
     )
     target = jnp.where(batch.row_valid, target, n)
-    out, dropped = exchange_by_target(batch, target, n, bucket_capacity, axis)
-    # max rows any device actually received: the TRUE bucket-capacity
-    # need — reported so the host can SHRINK the exchange tile toward
-    # O(rows/n) instead of pinning it at the discovery default
-    max_recv = jax.lax.pmax(
-        jnp.sum(out.row_valid.astype(jnp.int64)), axis
+    out, dropped, need = exchange_by_target(
+        batch, target, n, bucket_capacity, axis
     )
-    return out, dropped, max_recv
+    # `need` is exact on BOTH sides: the true per-bucket requirement on
+    # overflow AND the shrink target when over-provisioned
+    return out, dropped, need
 
 
 def exchange_by_target(
@@ -130,9 +128,18 @@ def exchange_by_target(
     n: int,
     bucket_capacity: int,
     axis: str = "d",
-) -> Tuple[Batch, jax.Array]:
+) -> Tuple[Batch, jax.Array, jax.Array]:
     """all_to_all exchange of rows to explicit per-row target devices
-    (bucket n = drop). Shared by hash and range repartition."""
+    (bucket n = drop). Shared by hash and range repartition.
+
+    Returns (new local batch, globally dropped rows, TRUE per-bucket
+    need): `need` is the max over destinations of the global row count
+    headed there — the region-balance analog
+    (pkg/store/copr/batch_coprocessor.go balances tasks by actual
+    region sizes). On overflow the host retries at exactly `need`
+    instead of doubling blindly, so a hot key costs ONE recompile, not
+    log2(hot/B); in steady state the plan-cache keeps the discovered
+    capacity and nothing recompiles."""
     B = bucket_capacity
     cap = batch.capacity
 
@@ -147,6 +154,10 @@ def exchange_by_target(
     sent = jnp.sum(fits.astype(jnp.int64))
     valid_rows = jnp.sum((target < n).astype(jnp.int64))
     dropped = jax.lax.psum(valid_rows - sent, axis)
+    # per-destination global sizes: local bucket counts (start deltas),
+    # psum'd — one [n] vector over ICI, negligible next to the exchange
+    local_counts = (start[1 : n + 1] - start[:n]).astype(jnp.int64)
+    need = jnp.max(jax.lax.psum(local_counts, axis))
 
     def scatter(arr: jax.Array) -> jax.Array:
         src = arr[perm]
@@ -162,7 +173,7 @@ def exchange_by_target(
     rv_send = jnp.zeros((n * B,), dtype=jnp.bool_)
     rv_send = rv_send.at[jnp.where(fits, buf_idx, n * B)].set(True, mode="drop")
     rv = jax.lax.all_to_all(rv_send.reshape(n, B), axis, 0, 0).reshape(n * B)
-    return Batch(new_cols, rv), dropped
+    return Batch(new_cols, rv), dropped, need
 
 
 def broadcast_gather(batch: Batch, axis: str = "d") -> Batch:

@@ -145,14 +145,19 @@ def distributed_group_aggregate(
                 )
 
             B = max(group_capacity, (2 * local.capacity) // n_devices, 16)
-            exchanged, dropped = hash_repartition(
+            exchanged, dropped, need = hash_repartition(
                 local, exch_rows_key, n_devices, B, axis
             )
             fin, ng = group_aggregate(
                 exchanged, key_fns, aggs, group_capacity, key_names,
                 key_widths=key_widths,
             )
-            return Batch(dict(fin.cols), fin.row_valid), jax.lax.psum(ng, axis), dropped
+            return (
+                Batch(dict(fin.cols), fin.row_valid),
+                jax.lax.psum(ng, axis),
+                dropped,
+                need,
+            )
         # scalar DISTINCT: every device needs every row to dedupe
         # globally — gather, compute replicated
         gathered = broadcast_gather(local, axis)
@@ -163,6 +168,7 @@ def distributed_group_aggregate(
         return (
             Batch(dict(fin.cols), fin.row_valid),
             jax.lax.pmax(ng, axis),
+            jnp.zeros((), jnp.int64),
             jnp.zeros((), jnp.int64),
         )
 
@@ -183,13 +189,14 @@ def distributed_group_aggregate(
                 [b.cols[kn] for kn in key_names], b.capacity
             )
 
-        exchanged, dropped = hash_repartition(
+        exchanged, dropped, need = hash_repartition(
             part_batch, exch_key, n_devices, group_capacity, axis
         )
     else:
         # scalar agg: all partials to device 0 conceptually == all_gather
         exchanged = broadcast_gather(part_batch, axis)
         dropped = jnp.zeros((), jnp.int64)
+        need = jnp.zeros((), jnp.int64)
 
     fkeys, fdescs, post_avg = build_final_stage(key_names, final)
     fin, ng = group_aggregate(
@@ -210,7 +217,7 @@ def distributed_group_aggregate(
     # tile, hence above the capacity knob) must surface to the host even
     # though the final stage fit
     total_groups = jnp.maximum(total_groups, jax.lax.pmax(part_ng, axis))
-    return Batch(cols, fin.row_valid), total_groups, dropped
+    return Batch(cols, fin.row_valid), total_groups, dropped, need
 
 
 def repartition_pair(
@@ -221,14 +228,15 @@ def repartition_pair(
     n_devices: int,
     bucket_capacity: int,
     axis: str = "d",
-) -> Tuple[Batch, Batch, jax.Array]:
+) -> Tuple[Batch, Batch, jax.Array, jax.Array]:
     """Hash-partition both join sides on their keys so equal keys
     colocate (the MPP HashPartition exchange applied to a join pair).
-    Returns (left', right', global dropped rows). The single shared
-    composition used by both partitioned_join and the planner."""
-    lex, d1 = hash_repartition(left, left_key, n_devices, bucket_capacity, axis)
-    rex, d2 = hash_repartition(right, right_key, n_devices, bucket_capacity, axis)
-    return lex, rex, d1 + d2
+    Returns (left', right', global dropped rows, true per-bucket need
+    over BOTH sides — the retry-at-exact-size signal). The single
+    shared composition used by both partitioned_join and the planner."""
+    lex, d1, n1 = hash_repartition(left, left_key, n_devices, bucket_capacity, axis)
+    rex, d2, n2 = hash_repartition(right, right_key, n_devices, bucket_capacity, axis)
+    return lex, rex, d1 + d2, jnp.maximum(n1, n2)
 
 
 def partitioned_join(
@@ -246,7 +254,7 @@ def partitioned_join(
     matching rows colocate, then a local join per device (the reference's
     HashPartition MPP join). Returns (local join result, global true
     output count, dropped exchange rows)."""
-    lex, rex, dropped = repartition_pair(
+    lex, rex, dropped, _need = repartition_pair(
         left, right, left_key, right_key, n_devices, bucket_capacity, axis
     )
     out, total = equi_join(

@@ -383,11 +383,13 @@ def _extract_index_merge(pred, scan: "L.Scan", resolver):
             for col in candidates:
                 r = _extract_col_range(d, scan, t, col, open_ok=True)
                 if r is not None:
-                    # open sides take searchsorted-safe extremes: the
-                    # union reader only needs a superset per disjunct
+                    # open sides take FULL int64 extremes — the
+                    # union reader must never under-approximate, and
+                    # values beyond any smaller sentinel would be
+                    # silently excluded by the inclusive range fetch
                     col_, lo, hi = r
-                    lo = -(1 << 62) if lo is None else lo
-                    hi = (1 << 62) if hi is None else hi
+                    lo = -(1 << 63) if lo is None else lo
+                    hi = (1 << 63) - 1 if hi is None else hi
                     width = hi - lo
                     if best is None or width < best[0]:
                         best = (width, (col_, lo, hi))
@@ -965,16 +967,15 @@ class PlanCompiler:
                     isnull = b.row_valid & ~k0.valid
                     rank = jnp.where(isnull, null_rank, dird)
                     B = caps[nid]
-                    ex, dropped, max_recv = range_repartition(
+                    ex, dropped, xneed = range_repartition(
                         b, rank, mesh_n, B, "d"
                     )
                     needs = dict(needs)
-                    # report the true per-bucket occupancy so discovery
-                    # can SHRINK B toward rows/n (reporting B itself
-                    # would pin the tile at its default forever)
-                    needs[nid] = jnp.where(
-                        dropped > 0, jnp.int64(2 * B + 1), max_recv
-                    )
+                    # xneed is the exact per-bucket requirement in BOTH
+                    # directions: discovery shrinks an over-provisioned
+                    # tile toward rows/n and grows an overflowed one to
+                    # the true hot-bucket size in one step
+                    needs[nid] = xneed
                     return order_by(ex, key_fns, descs), needs
 
                 # output stays sharded (range-partitioned + locally
@@ -1127,12 +1128,12 @@ class PlanCompiler:
             if mesh_n:
                 from tidb_tpu.parallel import distributed_group_aggregate
 
-                out, total, dropped = distributed_group_aggregate(
+                out, total, dropped, xneed = distributed_group_aggregate(
                     b, key_fns, descs, cap, mesh_n,
                     key_names=key_names, key_widths=key_widths,
                 )
                 ngroups = jnp.maximum(
-                    total, (dropped > 0).astype(total.dtype) * (2 * cap + 1)
+                    total, (dropped > 0).astype(total.dtype) * xneed
                 )
             else:
                 out, ngroups = group_aggregate(
@@ -1435,8 +1436,13 @@ class PlanCompiler:
                         from tidb_tpu.parallel import repartition_pair
 
                         B = caps[part_nid]
-                        lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
-                        needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
+                        lb, rb, drp, xneed = repartition_pair(
+                            lb, rb, lkey, rkey, mesh, B
+                        )
+                        # overflow reports the TRUE per-bucket need: a
+                        # hot key costs ONE recompile at the exact
+                        # size, not a doubling ladder
+                        needs[part_nid] = jnp.where(drp > 0, xneed, B)
                     out, _t = equi_join(
                         rb, lb, rkey, lkey, 0, kind, build_bounds=rprops[0]
                     )
@@ -1619,8 +1625,10 @@ class PlanCompiler:
                 from tidb_tpu.parallel import repartition_pair
 
                 B = caps[part_nid]
-                lb, rb, drp = repartition_pair(lb, rb, lkey, rkey, mesh, B)
-                extra_needs[part_nid] = jnp.where(drp > 0, 2 * B + 1, B)
+                lb, rb, drp, xneed = repartition_pair(
+                    lb, rb, lkey, rkey, mesh, B
+                )
+                extra_needs[part_nid] = jnp.where(drp > 0, xneed, B)
             build_b, probe_b, build_k, probe_k = rb, lb, rkey, lkey
             build_props = rprops
             if forced_swap or (
@@ -1778,25 +1786,11 @@ class PhysicalExecutor:
                 pins.append((t, v))
             if resolved is not None:
                 resolved[s.node_id] = (t, v)
-            if s.pk_range is not None and mesh is None:
-                from tidb_tpu.chunk import block_to_batch
-
-                col, lo, hi = s.pk_range
-                idx = t.range_rows(col, lo, hi, version=v)
-                block = t.gather_rows(idx, s.columns, version=v)
-                inputs[s.node_id] = block_to_batch(block)
-            elif s.merge_ranges is not None and mesh is None:
-                from tidb_tpu.chunk import block_to_batch
-
-                # index-merge UNION: each disjunct's sorted-index row
-                # ids, deduped+ordered by np.unique, gathered ONCE
-                ids = [
-                    t.range_rows(col, lo, hi, version=v)
-                    for col, lo, hi in s.merge_ranges
-                ]
-                idx = np.unique(np.concatenate(ids))
-                block = t.gather_rows(idx, s.columns, version=v)
-                inputs[s.node_id] = block_to_batch(block)
+            narrowed = (
+                fetch_site_rows(t, s, v) if mesh is None else None
+            )
+            if narrowed is not None:
+                inputs[s.node_id] = narrowed
             else:
                 batch, _d = scan_table(
                     t, s.columns, version=v, mesh=mesh,
@@ -2165,6 +2159,27 @@ def _overflowed(needs_host: Dict[int, np.ndarray], caps: Dict[int, int]) -> bool
         if cap and int(true_n) > cap:
             return True
     return False
+
+
+def fetch_site_rows(t, site, version):
+    """Narrowed host fetch for one scan site: PK range or index-merge
+    union (shared by PhysicalExecutor._fetch_inputs and the streamed
+    path's _fetch_resident — one implementation, no drift). Returns a
+    device Batch or None when the site has no narrowing."""
+    from tidb_tpu.chunk import block_to_batch
+
+    if site.pk_range is not None:
+        col, lo, hi = site.pk_range
+        idx = t.range_rows(col, lo, hi, version=version)
+        return block_to_batch(t.gather_rows(idx, site.columns, version=version))
+    if getattr(site, "merge_ranges", None) is not None:
+        ids = [
+            t.range_rows(col, lo, hi, version=version)
+            for col, lo, hi in site.merge_ranges
+        ]
+        idx = np.unique(np.concatenate(ids))
+        return block_to_batch(t.gather_rows(idx, site.columns, version=version))
+    return None
 
 
 def _join_default(inputs, cq) -> int:

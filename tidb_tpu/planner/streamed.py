@@ -156,19 +156,11 @@ def _fetch_resident(executor, site, st, sv):
     pushdown like PhysicalExecutor._fetch_inputs."""
     from tidb_tpu.storage import scan_table
 
-    if site.pk_range is not None:
-        col, lo, hi = site.pk_range
-        idx = st.range_rows(col, lo, hi, version=sv)
-        return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
-    if getattr(site, "merge_ranges", None) is not None:
-        # index-merge union reader, same as the unstreamed fetch — a
-        # memory-pressured plan needs the narrowed fetch MOST
-        ids = [
-            st.range_rows(col, lo, hi, version=sv)
-            for col, lo, hi in site.merge_ranges
-        ]
-        idx = np.unique(np.concatenate(ids))
-        return block_to_batch(st.gather_rows(idx, site.columns, version=sv))
+    from tidb_tpu.planner.physical import fetch_site_rows
+
+    narrowed = fetch_site_rows(st, site, sv)
+    if narrowed is not None:
+        return narrowed
     batch, _d = scan_table(
         st, site.columns, version=sv, partitions=site.partitions
     )
