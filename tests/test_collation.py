@@ -1,0 +1,165 @@
+"""Per-column collation: sort keys drive comparisons and ORDER BY.
+
+Reference: pkg/util/collate/collate.go:66 (Collator interface — Compare
+and Key per collation). The columnar analog builds dense collation-rank
+LUTs over the dictionary at compile time: rank comparison IS the
+collation comparison, one gather per row on device.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.utils import collate
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database coll")
+    s.execute("use coll")
+    return s
+
+
+class TestCollatorKeys:
+    def test_general_ci_keys(self):
+        kf = collate.key_fn("utf8mb4_general_ci")
+        assert kf("abc") == kf("ABC") == kf("AbC")
+        assert kf("a") != kf("b")
+        assert kf("trail  ") == kf("trail")  # PAD SPACE
+        assert kf("Ä") == kf("ä")
+
+    def test_unicode_ci_keys(self):
+        kf = collate.key_fn("utf8mb4_unicode_ci")
+        assert kf("é") == kf("e") == kf("E")
+        assert kf("Å") == kf("a")
+
+    def test_binary_identity(self):
+        assert collate.is_binary("utf8mb4_bin")
+        assert collate.is_binary(None)
+        assert not collate.is_binary("utf8mb4_general_ci")
+
+    def test_unknown_collation_rejected(self):
+        with pytest.raises(ValueError, match="Unknown collation"):
+            collate.validate("klingon_ci")
+
+
+class TestCIColumn:
+    def setup_t(self, sess):
+        sess.execute(
+            "create table t (s varchar(16) collate utf8mb4_general_ci, "
+            "k int)"
+        )
+        sess.execute(
+            "insert into t values ('Apple', 1), ('apple', 2), "
+            "('BANANA', 3), ('banana', 4), ('_under', 5), ('Zebra', 6)"
+        )
+
+    def test_ci_equality_literal(self, sess):
+        self.setup_t(sess)
+        assert sess.execute(
+            "select k from t where s = 'APPLE' order by k"
+        ).rows == [(1,), (2,)]
+        assert sess.execute(
+            "select count(*) from t where s <> 'banana'"
+        ).rows == [(4,)]
+
+    def test_ci_range_literal(self, sess):
+        self.setup_t(sess)
+        # general_ci compares by UPPER key: 'APPLE' < 'B' while
+        # 'BANANA', 'ZEBRA', '_UNDER' ('_' = 0x5F > 'B') are not
+        assert sess.execute(
+            "select count(*) from t where s < 'b'"
+        ).rows == [(2,)]  # Apple, apple
+
+    def test_ci_order_by_rank(self, sess):
+        self.setup_t(sess)
+        rows = [r[0] for r in sess.execute(
+            "select s from t order by s, k"
+        ).rows]
+        # collation order by UPPER key ('_UNDER' sorts LAST: 0x5F
+        # follows 'Z'), case-variants adjacent with stored-order ties
+        assert rows == [
+            "Apple", "apple", "BANANA", "banana", "Zebra", "_under"
+        ]
+        # binary order would put 'Zebra' before '_under' ('Z' < '_')
+        # and all lowercase after all uppercase — assert we did NOT
+        binary_order = sorted(rows)
+        assert rows != binary_order
+
+    def test_ci_column_vs_column(self, sess):
+        sess.execute(
+            "create table a (x varchar(8) collate utf8mb4_general_ci)"
+        )
+        sess.execute("create table b (y varchar(8))")
+        sess.execute("insert into a values ('HELLO'), ('world')")
+        sess.execute("insert into b values ('hello'), ('WORLD'), ('zzz')")
+        assert sess.execute(
+            "select count(*) from a, b where x = y"
+        ).rows == [(2,)]
+
+    def test_charset_default_is_binary(self, sess):
+        # the REFERENCE's default: utf8mb4 ships utf8mb4_bin (TiDB
+        # new_collations off), so a bare charset clause stays binary
+        sess.execute(
+            "create table c (s varchar(8) character set utf8mb4)"
+        )
+        t = sess.catalog.table("coll", "c")
+        assert t.schema.types["s"].collation is None
+
+    def test_explicit_bin_collate_overrides_charset(self, sess):
+        sess.execute(
+            "create table cb (s varchar(8) character set utf8mb4 "
+            "collate utf8mb4_bin)"
+        )
+        sess.execute("insert into cb values ('A'), ('a')")
+        assert sess.execute(
+            "select count(*) from cb where s = 'a'"
+        ).rows == [(1,)]
+
+    def test_expr_collate_bin_on_ci_column(self, sess):
+        sess.execute(
+            "create table eb (s varchar(8) collate utf8mb4_general_ci)"
+        )
+        sess.execute("insert into eb values ('A'), ('a')")
+        assert sess.execute(
+            "select count(*) from eb where s = 'a'"
+        ).rows == [(2,)]
+        assert sess.execute(
+            "select count(*) from eb where s collate utf8mb4_bin = 'a'"
+        ).rows == [(1,)]
+
+    def test_tidb_snapshot_session_time_travel(self, sess):
+        import time
+
+        sess.execute("set global tidb_gc_life_time = 600")
+        sess.execute("create table tt (a int)")
+        sess.execute("insert into tt values (1)")
+        time.sleep(0.02)
+        ts = time.time()
+        time.sleep(0.02)
+        sess.execute("insert into tt values (2)")
+        sess.execute(f"set tidb_snapshot = {ts}")
+        try:
+            assert sess.execute("select count(*) from tt").rows == [(1,)]
+            with pytest.raises(ValueError, match="tidb_snapshot"):
+                sess.execute("insert into tt values (3)")
+        finally:
+            sess.execute("set tidb_snapshot = ''")
+        assert sess.execute("select count(*) from tt").rows == [(2,)]
+        sess.execute("set global tidb_gc_life_time = 0")
+
+    def test_unicode_ci_accents(self, sess):
+        sess.execute(
+            "create table u (s varchar(8) collate utf8mb4_unicode_ci)"
+        )
+        sess.execute("insert into u values ('café'), ('CAFE'), ('other')")
+        assert sess.execute(
+            "select count(*) from u where s = 'cafe'"
+        ).rows == [(2,)]
+
+    def test_binary_column_unaffected(self, sess):
+        sess.execute("create table bz (s varchar(8))")
+        sess.execute("insert into bz values ('A'), ('a')")
+        assert sess.execute(
+            "select count(*) from bz where s = 'a'"
+        ).rows == [(1,)]

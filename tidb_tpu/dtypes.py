@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Optional
 
 import numpy as np
 
@@ -49,6 +50,13 @@ class SQLType:
     kind: Kind
     # decimal scale (digits after the point); 0 for non-decimals.
     scale: int = 0
+    # STRING columns: collation name, or None = binary (the native
+    # dictionary order). compare=False: collation affects COMPARISON
+    # semantics, not type identity — INT64 == INT64 regardless
+    # (reference: pkg/util/collate/collate.go Collator per column).
+    collation: Optional[str] = dataclasses.field(
+        default=None, compare=False
+    )
 
     @property
     def np_dtype(self) -> np.dtype:

@@ -187,6 +187,9 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "not", "isnull", "isnotnull", "like", "in", "istrue",
     }:
         return BOOL
+    if op == "_force_bin":
+        # explicit binary COLLATE: same kind, collation dropped
+        return STRING
     if op == "cast":
         assert declared is not None, "cast needs a declared target type"
         return declared

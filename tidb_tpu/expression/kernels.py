@@ -292,6 +292,8 @@ def string_expr(e: Expr, dicts: DictContext):
             )
 
         return _lit, d
+    if isinstance(e, Func) and e.op == "_force_bin":
+        return string_expr(e.args[0], dicts)  # passthrough marker
     if isinstance(e, Func) and (
         e.op in _STR_TRANSFORMS or e.op in _JSON_STR_FUNCS
     ):
@@ -1043,6 +1045,26 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             return DevCol(out, jnp.ones(b.capacity, dtype=bool))
 
         return _field
+    if op == "_force_bin":
+        return _compile(e.args[0], dicts)
+    if op == "_collation_rank":
+        # ORDER BY on a CI-collated column: sort by the dense collation
+        # rank of each value (equal-under-collation values tie; the
+        # stable sort keeps their stored order)
+        f, dictionary = string_expr(e.args[0], dicts)
+        coll = (
+            e.args[0].type.collation
+            if e.args[0].type is not None else None
+        )
+        lut, _keys, _kf = _collation_rank_lut(dictionary, coll)
+
+        def _rank(b):
+            c = f(b)
+            return DevCol(
+                lut[jnp.clip(c.data, 0, lut.shape[0] - 1)], c.valid
+            )
+
+        return _rank
     if op == "length":
         return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
     if op == "char_length":
@@ -1276,12 +1298,19 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
         return _compile_strcmp(e, dicts, flipped=True)
     if op in COMPARE and _is_string_col(ea) and _is_string_col(eb):
         # general string comparison: remap both sides into a merged sorted
-        # dictionary, then compare codes as integers.
+        # dictionary, then compare codes as integers. A CI collation on
+        # EITHER side makes the comparison CI (MySQL collation coercion):
+        # the merge happens in sort-KEY space, so equal-under-collation
+        # values land on equal merged codes.
+        from tidb_tpu.utils import collate as _coll
+
+        coll = (ea.type.collation if ea.type is not None else None) or (
+            eb.type.collation if eb.type is not None else None
+        )
         fa_s, da = string_expr(ea, dicts)
         fb_s, db = string_expr(eb, dicts)
-        merged = np.array(sorted(set(da.tolist()) | set(db.tolist())), dtype=object)
-        lut_a = jnp.asarray(np.searchsorted(merged, da).astype(np.int64) if len(da) else np.zeros(1, np.int64))
-        lut_b = jnp.asarray(np.searchsorted(merged, db).astype(np.int64) if len(db) else np.zeros(1, np.int64))
+        _m, la, lb = _coll.merge_rank_luts(da, db, coll)
+        lut_a, lut_b = jnp.asarray(la), jnp.asarray(lb)
 
         def _strstr(b):
             a, c = fa_s(b), fb_s(b)
@@ -1399,6 +1428,26 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
     return _bin
 
 
+def _collation_rank_lut(dictionary, coll):
+    """(rank LUT array, sorted distinct key list) for a CI-collated
+    dictionary: rank[code] = dense rank of the entry's collation sort
+    key — equal keys share a rank, so rank comparison IS the collation
+    comparison (reference: collate.go Key()-based compares)."""
+    import bisect
+
+    from tidb_tpu.utils import collate as _coll
+
+    kf = _coll.key_fn(coll)
+    if not len(dictionary):
+        return jnp.zeros(1, jnp.int64), [], kf
+    keys = sorted({kf(str(s)) for s in dictionary})
+    ranks = np.array(
+        [bisect.bisect_left(keys, kf(str(s))) for s in dictionary],
+        dtype=np.int64,
+    )
+    return jnp.asarray(ranks), keys, kf
+
+
 def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr:
     op = e.op
     col, lit = (e.args[1], e.args[0]) if flipped else (e.args[0], e.args[1])
@@ -1415,11 +1464,26 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
             return DevCol(z, z)
 
         return _nullcmp
-    pos, exact = _string_literal_code(dictionary, str(lit.value))
+    from tidb_tpu.utils import collate as _coll
+
+    coll = col.type.collation if col.type is not None else None
+    rank_lut = None
+    if not _coll.is_binary(coll):
+        # CI column: compare dense collation ranks, not raw codes
+        import bisect
+
+        rank_lut, keys, kf = _collation_rank_lut(dictionary, coll)
+        kl = kf(str(lit.value))
+        pos = bisect.bisect_left(keys, kl)
+        exact = pos < len(keys) and keys[pos] == kl
+    else:
+        pos, exact = _string_literal_code(dictionary, str(lit.value))
 
     def _cmp(b):
         c = f(b)
         code = c.data
+        if rank_lut is not None:
+            code = rank_lut[jnp.clip(code, 0, rank_lut.shape[0] - 1)]
         if op == "eq":
             d = (code == pos) if exact else jnp.zeros_like(code, dtype=bool)
         elif op == "ne":

@@ -1165,7 +1165,8 @@ class Parser:
             if cname.endswith("_ci"):
                 e = ast.Call("_collate_ci", [e])
             elif cname.endswith("_bin") or cname == "binary":
-                pass  # binary collation is the native behavior
+                # marker: overrides a CI COLUMN collation back to binary
+                e = ast.Call("_collate_bin", [e])
             else:
                 raise ParseError(f"unsupported collation {cname!r}")
         return e
@@ -1945,6 +1946,9 @@ class Parser:
                 cd.enum_members = tmeta.get("enum", ())
                 cd.set_members = tmeta.get("set", ())
                 cd.is_json = bool(tmeta.get("json"))
+                col_collate = None   # explicit COLLATE (always wins)
+                col_charset = None   # CHARACTER SET (its default applies
+                                     # only when no COLLATE is given)
                 while True:
                     if self.accept_kw("not"):
                         self.expect_kw("null")
@@ -1966,6 +1970,22 @@ class Parser:
                         if not isinstance(d, ast.Const):
                             raise ParseError("DEFAULT must be a constant")
                         cd.default = d.value
+                    elif self.accept_kw("collate"):
+                        from tidb_tpu.utils import collate as _coll
+
+                        col_collate = _coll.validate(self.expect_ident())
+                    elif self._at_ident("character") or self._at_ident("charset"):
+                        if self._at_ident("character"):
+                            self.advance()
+                            self.expect_kw("set")
+                        else:
+                            self.advance()
+                        from tidb_tpu.utils import collate as _coll
+
+                        cs = self.expect_ident().lower()
+                        if cs not in _coll.CHARSET_DEFAULTS:
+                            raise ParseError(f"unknown character set {cs!r}")
+                        col_charset = cs
                     elif self._at_ident("check"):
                         self.advance()
                         _parse_check(None)
@@ -1983,6 +2003,21 @@ class Parser:
                         fk_update_actions[nm0.lower()] = oupd0
                     else:
                         break
+                # collation resolution: explicit COLLATE always wins
+                # (including binary, which must be able to OVERRIDE a
+                # charset default); otherwise the charset's default
+                if ctype.kind.value == "string":
+                    from tidb_tpu.utils import collate as _coll
+
+                    eff = (
+                        col_collate
+                        if col_collate is not None
+                        else _coll.CHARSET_DEFAULTS.get(col_charset or "")
+                    )
+                    if eff is not None and not _coll.is_binary(eff):
+                        import dataclasses as _dc
+
+                        cd.type = ctype = _dc.replace(ctype, collation=eff)
                 cols.append(cd)
             if not self.accept_op(","):
                 break

@@ -456,6 +456,12 @@ class ExprBinder:
         if op == "_collate_ci":
             # utf8mb4_general_ci ~ compare case-folded (explicit COLLATE)
             return Func(op="lower", args=(self.lower(e.args[0]),))
+        if op == "_collate_bin":
+            # explicit binary COLLATE: wrap in a passthrough whose
+            # INFERRED type is collation-free STRING (bind_expr re-types
+            # bare ColumnRefs from the schema, so a type-strip on the
+            # ref itself would not survive binding)
+            return Func(op="_force_bin", args=(self.lower(e.args[0]),))
         if op == "instr":
             s, sub = (self.lower(x) for x in e.args)
             return Func(op="locate", args=(s, sub))
@@ -1365,7 +1371,24 @@ def build_select(
             if isinstance(e, ast.Const) and isinstance(e.value, int):
                 e = ast.Name(None, out_names[e.value - 1])
             e2 = _rewrite_aggs(e, rewrite) if rewrite else e
-            keys.append((ob.bind(e2), oi.desc))
+            bound = ob.bind(e2)
+            # per-column collation drives ORDER BY: a CI-collated string
+            # key sorts by its dense collation rank (collate.go Key()
+            # semantics), not by binary dictionary order
+            if (
+                bound.type is not None
+                and bound.type.kind == Kind.STRING
+                and bound.type.collation is not None
+            ):
+                from tidb_tpu.utils import collate as _coll
+
+                if not _coll.is_binary(bound.type.collation):
+                    from tidb_tpu.dtypes import INT64 as _I64
+
+                    bound = Func(
+                        op="_collation_rank", args=(bound,), type=_I64
+                    )
+            keys.append((bound, oi.desc))
         plan = Sort(plan.schema, plan, keys)
 
     # ---- LIMIT ----

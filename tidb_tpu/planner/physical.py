@@ -2302,9 +2302,16 @@ def _align_key_fns(le: Expr, re_: Expr, ldicts: Dicts, rdicts: Dicts):
         rd = rdicts.get(re_.name)
         if ld is None or rd is None:
             raise ExecError("string join keys need dictionaries")
-        merged = np.array(sorted(set(ld.tolist()) | set(rd.tolist())), dtype=object)
-        lut_l = jnp.asarray(np.searchsorted(merged, ld).astype(np.int64) if len(ld) else np.zeros(1, np.int64))
-        lut_r = jnp.asarray(np.searchsorted(merged, rd).astype(np.int64) if len(rd) else np.zeros(1, np.int64))
+        # collation coercion: a CI collation on EITHER side makes the
+        # join key CI — merge in sort-KEY space so equal-under-collation
+        # values land on equal merged codes (collate.go Key() semantics)
+        from tidb_tpu.utils import collate as _coll
+
+        coll = le.type.collation or (
+            re_.type.collation if re_.type is not None else None
+        )
+        _m, ll, lr = _coll.merge_rank_luts(ld, rd, coll)
+        lut_l, lut_r = jnp.asarray(ll), jnp.asarray(lr)
         lname, rname = le.name, re_.name
 
         def lf(b: Batch) -> DevCol:

@@ -202,6 +202,14 @@ class Session:
                 )
             return t, t.version
         clamp = False
+        if as_of_ts is None and self._txn is None:
+            # tidb_snapshot: a session-wide historical read point (the
+            # reference rejects writes while it is set — see
+            # _resolve_table_for_write); applies to every read until
+            # cleared, independent of tidb_read_staleness
+            snap = self._tidb_snapshot_ts()
+            if snap is not None:
+                as_of_ts = snap
         if as_of_ts is None and self._txn is None and self._stale_ok:
             try:
                 staleness = int(self.vars.get("tidb_read_staleness") or 0)
@@ -236,7 +244,30 @@ class Session:
         pinned = self._txn["pins"][key]
         return t, pinned
 
+    def _tidb_snapshot_ts(self):
+        """Epoch ts of the session's tidb_snapshot, or None. Accepts an
+        epoch number or a datetime literal in the session time_zone."""
+        raw = self.vars.get("tidb_snapshot")
+        if raw in (None, "", 0):
+            return None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            import datetime as _dt
+
+            dt = _dt.datetime.fromisoformat(str(raw))
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=self._session_tzinfo())
+            return dt.timestamp()
+
     def _resolve_table_for_write(self, db: str, name: str):
+        if self._tidb_snapshot_ts() is not None:
+            # reference: "can not execute write statement when
+            # 'tidb_snapshot' is set"
+            raise ValueError(
+                "can not execute write statement when 'tidb_snapshot' "
+                "is set"
+            )
         t = self.catalog.table(db, name)
         if self._txn is None:
             return t

@@ -1,0 +1,125 @@
+"""Collation engine: sort keys for MySQL collations.
+
+Reference: pkg/util/collate/collate.go:66 — the Collator interface
+(Compare / Key / KeyWithoutTrimRightSpace) with per-collation
+implementations (binCollator, generalCICollator, unicodeCICollator...).
+The columnar analog: a collation is a SORT-KEY function over strings;
+the engine compares/sorts dictionary-coded columns through dense rank
+LUTs built from these keys at compile time (one host pass over the
+dictionary, zero per-row device cost beyond a gather).
+
+Semantics implemented:
+- *_bin / binary: identity (code order IS binary order — native).
+- *_general_ci: per-character simple uppercase mapping (MySQL's
+  general_ci compares by uppercasing each character) + PAD SPACE
+  (trailing spaces ignored, like the reference's Key()).
+- *_unicode_ci / *_0900_ai_ci: accent- and case-insensitive via NFKD
+  decomposition with combining marks stripped, then casefold + PAD
+  SPACE ('é' == 'e', 'ß' == 'ss' per casefold).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Callable, Optional
+
+# collation name -> key function; None = binary (identity fast path)
+_REGISTRY: dict = {}
+
+
+def _pad(s: str) -> str:
+    """PAD SPACE attribute: trailing spaces are insignificant."""
+    return s.rstrip(" ")
+
+
+def _general_ci_key(s: str) -> str:
+    return _pad(s).upper()
+
+
+def _unicode_ci_key(s: str) -> str:
+    d = unicodedata.normalize("NFKD", _pad(s))
+    return "".join(
+        c for c in d if not unicodedata.combining(c)
+    ).casefold()
+
+
+def _bin_key(s: str) -> str:
+    return s
+
+
+for _name in (
+    "utf8mb4_general_ci", "utf8_general_ci", "utf8mb3_general_ci",
+    "latin1_general_ci", "latin1_swedish_ci", "ascii_general_ci",
+):
+    _REGISTRY[_name] = _general_ci_key
+for _name in (
+    "utf8mb4_unicode_ci", "utf8_unicode_ci", "utf8mb4_0900_ai_ci",
+    "utf8mb4_unicode_520_ci",
+):
+    _REGISTRY[_name] = _unicode_ci_key
+for _name in (
+    "binary", "utf8mb4_bin", "utf8_bin", "utf8mb3_bin", "latin1_bin",
+    "ascii_bin", "utf8mb4_0900_bin",
+):
+    _REGISTRY[_name] = None
+
+#: charset -> its default collation. These are the REFERENCE's (TiDB)
+#: defaults — new_collations_enabled_on_first_bootstrap=false ships
+#: *_bin for every charset (pkg/parser/charset; MySQL 8.0 would pick
+#: utf8mb4_0900_ai_ci) — so dumps restore with identical comparison
+#: semantics.
+CHARSET_DEFAULTS = {
+    "utf8mb4": "utf8mb4_bin",
+    "utf8": "utf8_bin",
+    "utf8mb3": "utf8mb3_bin",
+    "latin1": "latin1_bin",
+    "ascii": "ascii_bin",
+    "binary": "binary",
+}
+
+
+def known(name: str) -> bool:
+    return name.lower() in _REGISTRY
+
+
+def is_binary(name: Optional[str]) -> bool:
+    return name is None or _REGISTRY.get(name.lower(), _bin_key) is None
+
+
+def key_fn(name: Optional[str]) -> Callable[[str], str]:
+    """Sort-key function for a collation name (identity for binary /
+    unknown names — unknown should be rejected at DDL time)."""
+    if name is None:
+        return _bin_key
+    f = _REGISTRY.get(name.lower())
+    return _bin_key if f is None else f
+
+
+def validate(name: str) -> str:
+    n = name.lower()
+    if n not in _REGISTRY:
+        raise ValueError(f"Unknown collation: {name!r}")
+    return n
+
+
+def merge_rank_luts(da, db, coll):
+    """Merge two dictionaries in collation-KEY space: returns
+    (merged sorted key array, lut_a, lut_b) where lut_x[code] is the
+    merged rank of dictionary x's entry — equal-under-collation values
+    land on equal ranks. The ONE implementation behind string compare
+    kernels and join-key alignment."""
+    import numpy as np
+
+    kf = key_fn(coll)
+    ka = [kf(str(s)) for s in (da.tolist() if da is not None else [])]
+    kb = [kf(str(s)) for s in (db.tolist() if db is not None else [])]
+    merged = np.array(sorted(set(ka) | set(kb)), dtype=object)
+    lut_a = (
+        np.searchsorted(merged, np.array(ka, dtype=object)).astype(np.int64)
+        if ka else np.zeros(1, np.int64)
+    )
+    lut_b = (
+        np.searchsorted(merged, np.array(kb, dtype=object)).astype(np.int64)
+        if kb else np.zeros(1, np.int64)
+    )
+    return merged, lut_a, lut_b

@@ -186,6 +186,96 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "for stale reads / AS OF TIMESTAMP (reference "
                   "tidb_gc_life_time; 0 = keep only pinned snapshots). "
                   "GLOBAL-only: it drives the engine-wide GC horizon"),
+        # ---- driver/BI connect-time compatibility tier (reference:
+        # pkg/sessionctx/variable/sysvar.go; clients SET/SELECT these on
+        # connect — JDBC, mysql-connector, .NET, BI tools) ----
+        SysVarDef("auto_increment_increment", 1, "both", _int_range(1, 65535)),
+        SysVarDef("auto_increment_offset", 1, "both", _int_range(1, 65535)),
+        SysVarDef("big_tables", False, "both", _bool),
+        SysVarDef("block_encryption_mode", "aes-128-ecb", "both"),
+        SysVarDef("bulk_insert_buffer_size", 8388608, "both"),
+        SysVarDef("character_set_filesystem", "binary", "both"),
+        SysVarDef("default_collation_for_utf8mb4", "utf8mb4_bin", "both"),
+        SysVarDef("concurrent_insert", "AUTO", "readonly"),
+        SysVarDef("connect_timeout", 10, "both", _int_range(2, 31536000)),
+        SysVarDef("datadir", "/tmp/tidb_tpu", "readonly"),
+        SysVarDef("default_authentication_plugin", "mysql_native_password", "readonly"),
+        SysVarDef("default_week_format", 0, "both", _int_range(0, 7)),
+        SysVarDef("delay_key_write", "ON", "both"),
+        SysVarDef("div_precision_increment", 4, "both", _int_range(0, 30)),
+        SysVarDef("event_scheduler", "OFF", "both"),
+        SysVarDef("explicit_defaults_for_timestamp", True, "both", _bool),
+        SysVarDef("flush", False, "both", _bool),
+        SysVarDef("have_openssl", "DISABLED", "readonly"),
+        SysVarDef("have_ssl", "DISABLED", "readonly"),
+        SysVarDef("hostname", "tidb-tpu", "readonly"),
+        SysVarDef("innodb_file_per_table", True, "readonly"),
+        SysVarDef("join_buffer_size", 262144, "both"),
+        SysVarDef("key_buffer_size", 8388608, "both"),
+        SysVarDef("last_insert_id", 0, "session", _int_range(0, 2 ** 63 - 1)),
+        SysVarDef("long_query_time", 10.0, "both"),
+        SysVarDef("max_heap_table_size", 16777216, "both"),
+        SysVarDef("max_join_size", 2 ** 64 - 1, "both"),
+        SysVarDef("max_length_for_sort_data", 1024, "both"),
+        SysVarDef("max_prepared_stmt_count", -1, "global"),
+        SysVarDef("max_sort_length", 1024, "both"),
+        SysVarDef("max_sp_recursion_depth", 0, "both", _int_range(0, 255)),
+        SysVarDef("max_user_connections", 0, "both", _int_range(0, 4294967295)),
+        SysVarDef("myisam_sort_buffer_size", 8388608, "both"),
+        SysVarDef("net_buffer_length", 16384, "both"),
+        SysVarDef("net_retry_count", 10, "both", _int_range(1, 4294967295)),
+        SysVarDef("old_passwords", 0, "both", _int_range(0, 2)),
+        SysVarDef("optimizer_switch", "", "both"),
+        SysVarDef("performance_schema", False, "readonly", _bool),
+        SysVarDef("profiling", False, "both", _bool),
+        SysVarDef("protocol_version", 10, "readonly"),
+        SysVarDef("query_cache_size", 0, "readonly"),
+        SysVarDef("query_cache_type", "OFF", "readonly"),
+        SysVarDef("rand_seed1", 0, "session"),
+        SysVarDef("rand_seed2", 0, "session"),
+        SysVarDef("read_buffer_size", 131072, "both"),
+        SysVarDef("read_rnd_buffer_size", 262144, "both"),
+        SysVarDef("skip_networking", False, "readonly", _bool),
+        SysVarDef("sort_buffer_size", 262144, "both"),
+        SysVarDef("sql_auto_is_null", False, "both", _bool),
+        SysVarDef("sql_big_selects", True, "both", _bool),
+        SysVarDef("sql_buffer_result", False, "both", _bool),
+        SysVarDef("sql_log_bin", True, "both", _bool),
+        SysVarDef("sql_log_off", False, "both", _bool),
+        SysVarDef("sql_notes", True, "both", _bool),
+        SysVarDef("sql_quote_show_create", True, "both", _bool),
+        SysVarDef("sql_warnings", False, "both", _bool),
+        SysVarDef("ssl_ca", "", "readonly"),
+        SysVarDef("ssl_cert", "", "readonly"),
+        SysVarDef("ssl_key", "", "readonly"),
+        SysVarDef("table_definition_cache", -1, "both"),
+        SysVarDef("thread_cache_size", -1, "both"),
+        SysVarDef("timestamp", 0.0, "session"),
+        SysVarDef("tmp_table_size", 16777216, "both"),
+        SysVarDef("tmpdir", "/tmp", "readonly"),
+        SysVarDef("transaction_alloc_block_size", 8192, "both"),
+        SysVarDef("transaction_prealloc_size", 4096, "both"),
+        SysVarDef("tx_read_only", False, "both", _bool),
+        SysVarDef("transaction_read_only", False, "both", _bool),
+        SysVarDef("unique_subquery_cache", True, "both", _bool),
+        SysVarDef("version_compile_machine", "tpu", "readonly"),
+        SysVarDef("version_compile_os", "Linux", "readonly"),
+        SysVarDef("warning_count", 0, "readonly"),
+        SysVarDef("error_count", 0, "readonly"),
+        # tidb-prefixed compatibility knobs drivers/tools probe
+        SysVarDef("tidb_allow_batch_cop", 1, "both", _int_range(0, 2)),
+        SysVarDef("tidb_batch_insert", False, "both", _bool),
+        SysVarDef("tidb_current_ts", 0, "readonly"),
+        SysVarDef("tidb_enable_cascades_planner", False, "both", _bool),
+        SysVarDef("tidb_enable_fast_analyze", False, "both", _bool),
+        SysVarDef("tidb_enable_noop_functions", False, "both", _bool),
+        SysVarDef("tidb_enable_parallel_apply", False, "both", _bool),
+        SysVarDef("tidb_enable_window_function", True, "both", _bool),
+        SysVarDef("tidb_force_priority", "NO_PRIORITY", "both"),
+        SysVarDef("tidb_index_join_batch_size", 25000, "both"),
+        SysVarDef("tidb_skip_utf8_check", False, "both", _bool),
+        SysVarDef("tidb_snapshot", "", "session"),
+        SysVarDef("tidb_wait_split_region_finish", True, "both", _bool),
     ]
 }
 
@@ -218,10 +308,12 @@ class SysVars:
         if d.validate is not None:
             value = d.validate(value)
         # MySQL keeps the legacy alias and the canonical name in sync
-        names = (
-            ("tx_isolation", "transaction_isolation")
-            if name in ("tx_isolation", "transaction_isolation")
-            else (name,)
+        _ALIASES = (
+            ("tx_isolation", "transaction_isolation"),
+            ("tx_read_only", "transaction_read_only"),
+        )
+        names = next(
+            (pair for pair in _ALIASES if name in pair), (name,)
         )
         if scope == "global":
             if d.scope == "session":
