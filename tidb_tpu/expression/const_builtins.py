@@ -1,0 +1,41 @@
+"""Constant-foldable presentation builtins (value-dependent string
+output that cannot ride a static dictionary over columns).
+
+Reference: the corresponding builtin classes in pkg/expression
+(builtin_string.go FORMAT/EXPORT_SET/MAKE_SET, builtin_miscellaneous.go
+INET_NTOA); here they fold at plan time when every argument is a
+literal — the planner raises a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+
+def fold_const(op: str, vals: list):
+    if any(v is None for v in vals):
+        return None
+    if op == "format":
+        x = float(vals[0])
+        d = max(int(vals[1]), 0)
+        s = f"{x:,.{d}f}"
+        return s
+    if op == "inet_ntoa":
+        v = int(vals[0])
+        if not 0 <= v <= 0xFFFFFFFF:
+            return None
+        return ".".join(str((v >> s) & 0xFF) for s in (24, 16, 8, 0))
+    if op == "export_set":
+        bits = int(vals[0])
+        on, off = str(vals[1]), str(vals[2])
+        sep = str(vals[3]) if len(vals) > 3 else ","
+        n = int(vals[4]) if len(vals) > 4 else 64
+        n = max(0, min(n, 64))
+        return sep.join(
+            on if (bits >> i) & 1 else off for i in range(n)
+        )
+    if op == "make_set":
+        bits = int(vals[0])
+        items = [str(v) for v in vals[1:]]
+        return ",".join(
+            s for i, s in enumerate(items) if (bits >> i) & 1
+        )
+    raise AssertionError(op)

@@ -146,7 +146,12 @@ def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
     kinds = {a.type.kind for a in args if a.type is not None}
     temporal = kinds & {Kind.DATE, Kind.DATETIME, Kind.TIME}
     if not temporal:
-        return args
+        if op == "datediff":
+            # DATEDIFF('2024-03-05', '2024-03-01'): string literals ARE
+            # the dates — without this, two strings compare as 0
+            temporal = {Kind.DATE}
+        else:
+            return args
     from tidb_tpu.dtypes import (
         DATETIME,
         TIME,
@@ -250,8 +255,27 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "to_days", "week", "weekofyear", "unix_timestamp", "time_to_sec",
         "timestampdiff", "ord", "bit_length", "crc32",
         "find_in_set", "regexp_instr", "interval_fn",
+        "inet_aton", "json_depth", "period_add", "period_diff",
+        "uuid_short",
     }:
         return INT64
+    if op == "is_uuid":
+        return BOOL
+    if op in {"soundex", "to_base64", "from_base64", "json_quote",
+              "json_unquote", "weight_string", "format", "inet_ntoa",
+              "uuid", "export_set", "make_set", "unhex", "json_keys"}:
+        return STRING
+    if op == "json_contains":
+        return BOOL
+    if op in {"sleep", "benchmark"}:
+        return INT64
+    if op == "rand":
+        return FLOAT64
+    if op in {"addtime", "subtime"}:
+        # MySQL: result type follows the first argument
+        if ts and ts[0] is not None and ts[0].kind == Kind.DATETIME:
+            return SQLType(Kind.DATETIME)
+        return SQLType(Kind.TIME)
     if op in {"regexp", "regexp_like"}:
         return BOOL
     if op in {"from_days", "last_day", "makedate"}:

@@ -555,7 +555,7 @@ def _json_path_get(doc, path: str):
     return cur
 
 
-_JSON_STR_FUNCS = {"json_extract", "json_unquote", "json_type"}
+_JSON_STR_FUNCS = {"json_extract", "json_unquote", "json_type", "json_keys"}
 
 
 def _json_pyfn(e: Func):
@@ -576,6 +576,17 @@ def _json_pyfn(e: Func):
                 return None
             v = _json_path_get(doc, path)
             return None if v is _JSON_MISSING else _json.dumps(v)
+
+        return f
+    if op == "json_keys":
+        def f(s):
+            try:
+                v = _json.loads(s)
+            except Exception:
+                return None
+            if not isinstance(v, dict):
+                return None
+            return _json.dumps(list(v.keys()))
 
         return f
     if op == "json_unquote":
@@ -616,6 +627,8 @@ _STR_TRANSFORMS = {
     "left", "right", "reverse", "lpad", "rpad", "repeat",
     "quote", "insert_str", "regexp_substr", "regexp_replace",
     "md5", "sha1", "sha2", "hex_str", "substring_index",
+    "soundex", "to_base64", "from_base64", "json_quote",
+    "weight_string", "unhex",
 }
 
 
@@ -634,6 +647,79 @@ def _str_transform_pyfn(e: Func):
         return lambda s: s.rstrip()
     if op == "reverse":
         return lambda s: s[::-1]
+    if op == "soundex":
+        def _soundex(s):
+            # classic Soundex (builtin_string.go soundex): letter +
+            # 3 digits, adjacent duplicates collapsed, vowels dropped
+            codes = {"b": "1", "f": "1", "p": "1", "v": "1",
+                     "c": "2", "g": "2", "j": "2", "k": "2", "q": "2",
+                     "s": "2", "x": "2", "z": "2",
+                     "d": "3", "t": "3", "l": "4",
+                     "m": "5", "n": "5", "r": "6"}
+            letters = [c for c in s.lower() if c.isalpha()]
+            if not letters:
+                return ""
+            out = letters[0].upper()
+            prev = codes.get(letters[0], "")
+            for c in letters[1:]:
+                d = codes.get(c, "")
+                if d and d != prev:
+                    out += d
+                prev = d
+            return (out + "000")[:4]
+
+        return _soundex
+    if op == "unhex":
+        def _unhex(s):
+            try:
+                return bytes.fromhex(s).decode("utf-8", errors="replace")
+            except ValueError:
+                return ""
+
+        return _unhex
+    if op == "to_base64":
+        import base64
+
+        return lambda s: base64.b64encode(s.encode()).decode()
+    if op == "from_base64":
+        import base64
+
+        def _fb64(s):
+            try:
+                return base64.b64decode(s.encode(), validate=True).decode(
+                    "utf-8", errors="replace"
+                )
+            except Exception:
+                return ""  # MySQL returns NULL; dictionary LUTs carry
+                # values only — documented divergence
+
+        return _fb64
+    if op == "json_quote":
+        import json as _json
+
+        return lambda s: _json.dumps(s)
+    if op == "json_unquote":
+        import json as _json
+
+        def _junq(s):
+            try:
+                v = _json.loads(s)
+                return v if isinstance(v, str) else s
+            except Exception:
+                return s
+
+        return _junq
+    if op == "weight_string":
+        # the collation sort key itself (reference WEIGHT_STRING reveals
+        # the Key() bytes; here the key IS a string)
+        from tidb_tpu.utils import collate as _coll
+
+        coll = (
+            e.args[0].type.collation
+            if e.args[0].type is not None else None
+        )
+        kf = _coll.key_fn(coll)
+        return lambda s: kf(s)
     if op == "replace":
         frm, to = str(ex[0]), str(ex[1])
         return lambda s: s.replace(frm, to) if frm else s
@@ -955,6 +1041,35 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             )
 
         return _dd
+    if op == "json_contains":
+        import json as _json
+
+        cand = baked_value(e.args[1])
+        path = baked_value(e.args[2]) if len(e.args) > 2 else None
+
+        def _contains(s):
+            try:
+                doc = _json.loads(s)
+                target = _json.loads(str(cand))
+            except Exception:
+                return False
+            if path and str(path).startswith("$."):
+                for part in str(path)[2:].split("."):
+                    if isinstance(doc, dict) and part in doc:
+                        doc = doc[part]
+                    else:
+                        return False
+
+            def has(d, t):
+                if d == t:
+                    return True
+                if isinstance(d, list):
+                    return any(has(x, t) for x in d)
+                return False
+
+            return has(doc, target)
+
+        return _compile_strlut(e.args[0], dicts, _contains, jnp.bool_)
     if op == "json_valid":
         import json as _json
 
@@ -1065,6 +1180,66 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             )
 
         return _rank
+    if op == "is_uuid":
+        import re as _re
+
+        _uuid_re = _re.compile(
+            r"^[0-9a-f]{8}-?[0-9a-f]{4}-?[0-9a-f]{4}-?[0-9a-f]{4}-?"
+            r"[0-9a-f]{12}$", _re.I,
+        )
+        return _compile_strlut(
+            e.args[0], dicts, lambda s: bool(_uuid_re.match(s)), jnp.bool_
+        )
+    if op == "inet_aton":
+        def _aton(s):
+            parts = s.split(".")
+            if not 1 <= len(parts) <= 4 or not all(
+                p.isdigit() and int(p) <= 255 for p in parts
+            ):
+                return 0  # MySQL: NULL; LUT carries values only
+            # MySQL short forms: leading parts fill the TOP bytes, the
+            # last part fills everything remaining ('1.2' = 1<<24 | 2)
+            v = 0
+            for p in parts[:-1]:
+                v = (v << 8) | int(p)
+            return (v << (8 * (5 - len(parts)))) | int(parts[-1])
+
+        return _compile_strlut(e.args[0], dicts, _aton, jnp.int64)
+    if op == "json_depth":
+        import json as _json
+
+        def _depth(s):
+            try:
+                v = _json.loads(s)
+            except Exception:
+                return 0
+
+            def d(x):
+                if isinstance(x, dict):
+                    return 1 + max((d(v2) for v2 in x.values()), default=0)
+                if isinstance(x, list):
+                    return 1 + max((d(v2) for v2 in x), default=0)
+                return 1
+
+            return d(v)
+
+        return _compile_strlut(e.args[0], dicts, _depth, jnp.int64)
+    if op in ("period_add", "period_diff"):
+        fa, fb = (_compile(a, dicts) for a in e.args)
+
+        def _period(b, _op=op):
+            a, c = fa(b), fb(b)
+            y1, m1 = a.data // 100, a.data % 100
+            months1 = y1 * 12 + (m1 - 1)
+            if _op == "period_add":
+                t = months1 + c.data
+                d = (t // 12) * 100 + (t % 12) + 1
+            else:
+                y2, m2 = c.data // 100, c.data % 100
+                d = months1 - (y2 * 12 + (m2 - 1))
+            return DevCol(d.astype(jnp.int64), a.valid & c.valid)
+
+        return _period
     if op == "length":
         return _compile_strlut(e.args[0], dicts, lambda s: len(s.encode()), jnp.int64)
     if op == "char_length":
@@ -1166,7 +1341,8 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         return _compile_strlut(s, dicts, lambda v: v.find(needle) + 1, jnp.int64)
     if op in _STR_TRANSFORMS or op in (
         "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
-        "dayname", "monthname", "date_format", "hex", "bin", "oct",
+        "json_keys", "dayname", "monthname", "date_format",
+        "hex", "bin", "oct",
     ):
         return string_expr(e, dicts)[0]
     if op in _MATH_UNARY_FLOAT or op in (

@@ -1237,10 +1237,25 @@ class Parser:
             return ast.Call("cast", [e], cast_type=typ)
         if self.cur.kind == "id" and self.cur.text.lower() == "convert" \
                 and self.toks[self.i + 1].text == "(":
-            # CONVERT(expr, type) — the cast in function clothing
+            # CONVERT(expr, type) — the cast in function clothing;
+            # CONVERT(expr USING charset) — charset conversion (all
+            # strings are utf8 internally: identity + collation reset
+            # to the target charset's default)
             self.advance()
             self.expect_op("(")
             e = self.parse_expr()
+            if self.cur.text.lower() == "using":
+                self.advance()
+                from tidb_tpu.utils import collate as _coll
+
+                cs = self.expect_ident().lower()
+                if cs not in _coll.CHARSET_DEFAULTS:
+                    raise ParseError(f"unknown character set {cs!r}")
+                self.expect_op(")")
+                dflt = _coll.CHARSET_DEFAULTS[cs]
+                if _coll.is_binary(dflt):
+                    return ast.Call("_collate_bin", [e])
+                return ast.Call("_collate_ci", [e])
             self.expect_op(",")
             typ = self.parse_type()
             self.expect_op(")")

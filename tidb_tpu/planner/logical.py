@@ -20,7 +20,7 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from tidb_tpu.dtypes import BOOL, DATE, INT64, Kind, SQLType
+from tidb_tpu.dtypes import BOOL, DATE, INT64, STRING, Kind, SQLType
 from tidb_tpu.expression.expr import ColumnRef, Expr, Func, Literal
 from tidb_tpu.parser import ast
 
@@ -453,6 +453,87 @@ class ExprBinder:
                 return Func(op="lower", args=(low,))
 
             return Func(op=op, args=tuple(_fold(a) for a in e.args))
+        if op == "rand":
+            import random as _random
+
+            args_l = [self.lower(a) for a in e.args]
+            rng = (
+                _random.Random(args_l[0].value)
+                if args_l and isinstance(args_l[0], Literal)
+                else _random
+            )
+            from tidb_tpu.dtypes import FLOAT64 as _F64
+
+            return Literal(type=_F64, value=rng.random())
+        if op == "sleep":
+            import time as _time
+
+            a = self.lower(e.args[0])
+            if isinstance(a, Literal) and isinstance(a.value, (int, float)):
+                _time.sleep(min(max(float(a.value), 0.0), 300.0))
+            return Literal(type=INT64, value=0)
+        if op == "benchmark":
+            # evaluated-for-timing in MySQL; here the whole plan is one
+            # compiled program — accept and return the 0 contract
+            return Literal(type=INT64, value=0)
+        if op in ("uuid", "uuid_short"):
+            # volatile generators fold at plan time: statements re-plan
+            # per parse, so each STATEMENT gets a fresh value (per-ROW
+            # uuids over a table would defeat dictionary coding — the
+            # reference's per-row semantics are deliberately relaxed)
+            import uuid as _uuid
+
+            if op == "uuid":
+                return Literal(type=STRING, value=str(_uuid.uuid4()))
+            return Literal(
+                type=INT64, value=_uuid.uuid4().int & ((1 << 62) - 1)
+            )
+        if op in ("format", "inet_ntoa", "export_set", "make_set"):
+            # constant-foldable presentation builtins (value-dependent
+            # string output cannot ride a static dictionary over columns)
+            args_l = [self.lower(a) for a in e.args]
+            if all(isinstance(a, Literal) for a in args_l):
+                from tidb_tpu.expression.const_builtins import fold_const
+
+                return Literal(
+                    type=STRING, value=fold_const(op, [a.value for a in args_l])
+                )
+            raise PlanError(
+                f"{op.upper()} supports constant arguments only (string "
+                "results over columns need value-dependent dictionaries)"
+            )
+        if op in ("addtime", "subtime"):
+            a0 = self.lower(e.args[0])
+            a1 = self.lower(e.args[1])
+            from tidb_tpu.dtypes import time_to_micros
+
+            if isinstance(a0, Literal) and isinstance(a0.value, str):
+                from tidb_tpu.dtypes import (
+                    DATETIME as _DT, TIME as _TT, datetime_to_micros,
+                )
+
+                s0 = a0.value
+                if " " in s0.strip() or "T" in s0:
+                    a0 = Literal(
+                        type=_DT, value=int(datetime_to_micros(s0))
+                    )
+                else:
+                    a0 = Literal(type=_TT, value=int(time_to_micros(s0)))
+
+            if isinstance(a1, Literal) and isinstance(a1.value, str):
+                us = int(time_to_micros(a1.value))
+            elif isinstance(a1, Literal) and a1.type is not None and a1.type.kind == Kind.TIME:
+                us = int(a1.value)
+            else:
+                raise PlanError(
+                    f"{op.upper()} needs a literal time as its second "
+                    "argument"
+                )
+            if op == "subtime":
+                us = -us
+            return Func(
+                op="add_us", args=(a0, Literal(type=INT64, value=us))
+            )
         if op == "_collate_ci":
             # utf8mb4_general_ci ~ compare case-folded (explicit COLLATE)
             return Func(op="lower", args=(self.lower(e.args[0]),))

@@ -1581,6 +1581,14 @@ class Session:
                 return ast.Const(self.db)
             if op in ("current_user", "session_user", "user"):
                 return ast.Const(f"{self.user}@%")
+            if op == "connection_id":
+                return ast.Const(int(self.conn_id))
+            if op == "found_rows":
+                return ast.Const(int(getattr(self, "_found_rows", 0)))
+            if op == "version":
+                return ast.Const(str(self.vars.get("version")))
+            if op == "row_count":
+                return ast.Const(int(getattr(self, "_last_affected", -1)))
         if dataclasses.is_dataclass(node) and not isinstance(node, type):
             for f in dataclasses.fields(node):
                 setattr(
