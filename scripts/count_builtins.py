@@ -106,7 +106,6 @@ CALLS = {
   "count": "count(*) from t", "count_distinct": "count(distinct a) from t",
   "sum": "sum(a) from t", "avg": "avg(a) from t", "min": "min(a) from t",
   "max": "max(a) from t", "group_concat": "group_concat(s) from t",
-  "bit_and_agg": "1 from t", "stddev": "1 from t",  # placeholders skip
   # operators-as-builtins
   "like_op": "'abc' like 'a%'", "in_op": "1 in (1, 2)",
   "between_op": "2 between 1 and 3", "is_true": "1 is true",
@@ -128,7 +127,7 @@ CALLS = {
 
 ok, fail = [], []
 for name, frag in sorted(CALLS.items()):
-    sql = f"select {frag}" if " from " in frag else f"select {frag}"
+    sql = f"select {frag}"
     try:
         s.execute(sql)
         ok.append(name)

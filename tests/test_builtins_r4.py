@@ -133,3 +133,18 @@ class TestMiscAdditions:
         assert 0.0 <= float(v) < 1.0
         assert q1(s, "select sleep(0)") == 0
         assert q1(s, "select benchmark(10, 1)") == 0
+
+    def test_found_rows_row_count_wired(self, s):
+        s.execute("create table fr (x int)")
+        s.execute("insert into fr values (1), (2), (3)")
+        assert q1(s, "select row_count()") == 3
+        s.execute("select * from fr where x > 1")
+        assert q1(s, "select found_rows()") == 2
+        s.execute("update fr set x = 9 where x > 1")
+        assert q1(s, "select row_count()") == 2
+
+    def test_is_uuid_mysql_forms(self, s):
+        u = "12345678-1234-1234-1234-123456789012"
+        assert q1(s, f"select is_uuid('{u}')") is True
+        assert q1(s, f"select is_uuid('{u.replace('-', '')}')") is True
+        assert q1(s, "select is_uuid('12345678-123412341234123456789012')") is False

@@ -698,17 +698,6 @@ def _str_transform_pyfn(e: Func):
         import json as _json
 
         return lambda s: _json.dumps(s)
-    if op == "json_unquote":
-        import json as _json
-
-        def _junq(s):
-            try:
-                v = _json.loads(s)
-                return v if isinstance(v, str) else s
-            except Exception:
-                return s
-
-        return _junq
     if op == "weight_string":
         # the collation sort key itself (reference WEIGHT_STRING reveals
         # the Key() bytes; here the key IS a string)
@@ -1044,6 +1033,10 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     if op == "json_contains":
         import json as _json
 
+        if not all(isinstance(a, Literal) for a in e.args[1:]):
+            raise NotImplementedError(
+                "JSON_CONTAINS candidate/path must be literals"
+            )
         cand = baked_value(e.args[1])
         path = baked_value(e.args[2]) if len(e.args) > 2 else None
 
@@ -1183,9 +1176,11 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     if op == "is_uuid":
         import re as _re
 
+        # MySQL: fully-dashed, dash-free, or braced fully-dashed only
         _uuid_re = _re.compile(
-            r"^[0-9a-f]{8}-?[0-9a-f]{4}-?[0-9a-f]{4}-?[0-9a-f]{4}-?"
-            r"[0-9a-f]{12}$", _re.I,
+            r"^(\{[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-"
+            r"[0-9a-f]{12}\}|[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-"
+            r"[0-9a-f]{4}-[0-9a-f]{12}|[0-9a-f]{32})$", _re.I,
         )
         return _compile_strlut(
             e.args[0], dicts, lambda s: bool(_uuid_re.match(s)), jnp.bool_

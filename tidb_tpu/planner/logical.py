@@ -454,6 +454,11 @@ class ExprBinder:
 
             return Func(op=op, args=tuple(_fold(a) for a in e.args))
         if op == "rand":
+            # DIVERGENCE (like uuid below): folds ONCE at plan time, so
+            # every row of a statement sees the same value — per-row
+            # volatile functions would defeat whole-plan compilation.
+            # ORDER BY rand() therefore does not shuffle; a seed column
+            # argument is not supported.
             import random as _random
 
             args_l = [self.lower(a) for a in e.args]

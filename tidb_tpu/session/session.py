@@ -1376,6 +1376,13 @@ class Session:
                 bill_t0 = time.perf_counter()
             res = self._execute_stmt_inner(s, bill_t0)
             self._maybe_auto_analyze(s)
+            if top:
+                # FOUND_ROWS()/ROW_COUNT() session state (builtin_info.go)
+                if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+                    self._found_rows = len(res.rows)
+                    self._last_affected = -1
+                else:
+                    self._last_affected = int(getattr(res, "affected", 0) or 0)
             return res
         finally:
             self._stmt_depth -= 1
