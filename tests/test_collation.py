@@ -163,3 +163,21 @@ class TestCIColumn:
         assert sess.execute(
             "select count(*) from bz where s = 'a'"
         ).rows == [(1,)]
+
+
+class TestShowStatements:
+    def test_show_collation(self, sess):
+        rows = sess.execute("show collation").rows
+        names = [r[0] for r in rows]
+        assert "utf8mb4_general_ci" in names and "utf8mb4_bin" in names
+        rows2 = sess.execute("show collation like 'utf8mb4%'").rows
+        assert all(r[0].startswith("utf8mb4") for r in rows2)
+
+    def test_show_character_set(self, sess):
+        rows = sess.execute("show character set").rows
+        d = {r[0]: r[2] for r in rows}
+        assert d["utf8mb4"] == "utf8mb4_bin"
+
+    def test_show_engines(self, sess):
+        rows = sess.execute("show engines").rows
+        assert rows[0][0] == "InnoDB" and rows[0][1] == "DEFAULT"

@@ -384,6 +384,19 @@ class Parser:
                 self.expect_kw("from")
                 db, name = self._qualified_name()
                 return ast.Show("index", db=f"{db or ''}.{name}")
+            if self._at_ident("collation"):
+                self.advance()
+                return ast.Show("collation", db=self._show_like())
+            if self._at_ident("character") or self._at_ident("charset"):
+                if self._at_ident("character"):
+                    self.advance()
+                    self.expect_kw("set")
+                else:
+                    self.advance()
+                return ast.Show("charset", db=self._show_like())
+            if self._at_ident("engines"):
+                self.advance()
+                return ast.Show("engines")
             if self.accept_kw("create"):
                 what = (
                     "create_view"

@@ -2220,6 +2220,49 @@ class Session:
             return Result(["Tables"], [(t,) for t in names])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        if s.what == "collation":
+            # reference: SHOW COLLATION over the collate registry
+            from tidb_tpu.utils import collate as _coll
+            from tidb_tpu.utils.checkeval import sql_like_match
+
+            pat = s.db or "%"
+            rows = []
+            for i, name in enumerate(sorted(_coll._REGISTRY), 1):
+                if not sql_like_match(name, pat, ci=True):
+                    continue
+                rows.append((
+                    name, name.split("_")[0], i,
+                    "Yes" if name in _coll.CHARSET_DEFAULTS.values() else "",
+                    "Yes", 1, "PAD SPACE" if name.endswith("_ci") else "NO PAD",
+                ))
+            return Result(
+                ["Collation", "Charset", "Id", "Default", "Compiled",
+                 "Sortlen", "Pad_attribute"], rows,
+            )
+        if s.what == "charset":
+            from tidb_tpu.utils import collate as _coll
+            from tidb_tpu.utils.checkeval import sql_like_match
+
+            maxlen = {"utf8mb4": 4, "utf8": 3, "utf8mb3": 3,
+                      "latin1": 1, "ascii": 1, "binary": 1}
+            pat = s.db or "%"
+            rows = [
+                (cs, f"{cs} (utf8 internal)", dflt, maxlen.get(cs, 4))
+                for cs, dflt in sorted(_coll.CHARSET_DEFAULTS.items())
+                if sql_like_match(cs, pat, ci=True)
+            ]
+            return Result(
+                ["Charset", "Description", "Default collation", "Maxlen"],
+                rows,
+            )
+        if s.what == "engines":
+            return Result(
+                ["Engine", "Support", "Comment", "Transactions", "XA",
+                 "Savepoints"],
+                [("InnoDB", "DEFAULT",
+                  "tidb_tpu columnar XLA engine (InnoDB-compatible surface)",
+                  "YES", "NO", "YES")],
+            )
         if s.what == "bindings":
             rows = [
                 (e["for_sql"], e["using_sql"])
@@ -2324,12 +2367,12 @@ class Session:
                 rows,
             )
         # variables
-        import fnmatch
+        from tidb_tpu.utils.checkeval import sql_like_match
 
         pat = s.db
         rows = []
         for name, val in self.vars.all().items():
-            if pat is None or fnmatch.fnmatch(name, pat.replace("%", "*")):
+            if pat is None or sql_like_match(name, pat, ci=True):
                 if isinstance(val, bool):
                     val = "ON" if val else "OFF"
                 rows.append((name, str(val)))

@@ -12,6 +12,20 @@ CheckRowConstraint + pkg/expression evaluation).
 from __future__ import annotations
 
 import fnmatch
+
+
+def sql_like_match(value: str, pattern: str, ci: bool = False) -> bool:
+    """SQL LIKE semantics over fnmatch: % -> *, _ -> ? with fnmatch
+    metacharacters escaped; ci=True folds case (SHOW ... LIKE is
+    case-insensitive in MySQL). The ONE LIKE->fnmatch translation —
+    CHECK evaluation and every SHOW filter share it."""
+    pat = (
+        pattern.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+        .replace("%", "*").replace("_", "?")
+    )
+    if ci:
+        return fnmatch.fnmatchcase(value.lower(), pat.lower())
+    return fnmatch.fnmatchcase(value, pat)
 from typing import Optional
 
 from tidb_tpu.parser import ast
@@ -128,12 +142,7 @@ def eval_check(e, row: dict) -> Optional[bool]:
         a, p = (eval_check(x, row) for x in e.args)
         if a is None or p is None:
             return None
-        # SQL LIKE -> fnmatch: % -> *, _ -> ?  (escape fnmatch specials)
-        pat = (
-            str(p).replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
-            .replace("%", "*").replace("_", "?")
-        )
-        return fnmatch.fnmatchcase(str(a), pat)
+        return sql_like_match(str(a), str(p))
     if op == "coalesce":
         for a in e.args:
             v = eval_check(a, row)
