@@ -165,6 +165,151 @@ class TestCIColumn:
         ).rows == [(1,)]
 
 
+class TestCIGrouping:
+    """GROUP BY / DISTINCT / MIN / MAX over CI collations group and
+    order by collation rank (reference collate.go Key() drives both
+    compare and hash — round-4 verdict's documented divergence, closed)."""
+
+    def setup_t(self, sess):
+        sess.execute(
+            "create table g (s varchar(16) collate utf8mb4_general_ci, "
+            "k int)"
+        )
+        sess.execute(
+            "insert into g values ('Ann', 1), ('ANN', 2), ('ann', 4), "
+            "('Bob', 8), ('BOB', 16), ('carl', 32)"
+        )
+
+    def test_group_by_merges_case_variants(self, sess):
+        self.setup_t(sess)
+        rows = sess.execute(
+            "select s, sum(k), count(*) from g group by s order by s"
+        ).rows
+        assert [(r[1], r[2]) for r in rows] == [(7, 3), (24, 2), (32, 1)]
+        # representative values are group members, case-insensitively
+        # equal to the class ('ANN' the binary-least of the Ann class)
+        assert [r[0].upper() for r in rows] == ["ANN", "BOB", "CARL"]
+
+    def test_distinct_merges_case_variants(self, sess):
+        self.setup_t(sess)
+        rows = sess.execute("select distinct s from g order by s").rows
+        assert [r[0].upper() for r in rows] == ["ANN", "BOB", "CARL"]
+
+    def test_count_distinct_ci(self, sess):
+        self.setup_t(sess)
+        assert sess.execute(
+            "select count(distinct s) from g"
+        ).rows == [(3,)]
+
+    def test_min_max_ci_rank_order(self, sess):
+        # under general_ci: min is the ANN class, max the CARL class —
+        # binary code order would make '_' sort before letters wrongly
+        self.setup_t(sess)
+        sess.execute("insert into g values ('_z', 64)")
+        (mn, mx), = sess.execute("select min(s), max(s) from g").rows
+        assert mn.upper() == "ANN" and mx.upper() == "_Z"
+
+    def test_group_by_binary_column_untouched(self, sess):
+        sess.execute("create table gb (s varchar(8), k int)")
+        sess.execute("insert into gb values ('A', 1), ('a', 2)")
+        rows = sess.execute(
+            "select s, sum(k) from gb group by s order by s"
+        ).rows
+        assert rows == [("A", 1), ("a", 2)]
+
+    def test_group_by_ci_with_having(self, sess):
+        self.setup_t(sess)
+        rows = sess.execute(
+            "select s, count(*) from g group by s "
+            "having count(*) > 1 order by s"
+        ).rows
+        assert [(r[0].upper(), r[1]) for r in rows] == [
+            ("ANN", 3), ("BOB", 2)
+        ]
+
+    def test_group_output_binary_compare(self, sess):
+        # the rep dictionary must stay BINARY-sorted: a binary-collated
+        # compare over the group output uses searchsorted on it
+        sess.execute(
+            "create table gc (s varchar(8) collate utf8mb4_general_ci, "
+            "k int)"
+        )
+        sess.execute("insert into gc values ('B', 1), ('a', 2)")
+        rows = sess.execute(
+            "select * from (select s, sum(k) sk from gc group by s) t "
+            "where s collate utf8mb4_bin = 'B'"
+        ).rows
+        assert rows == [("B", 1)]
+        rows = sess.execute(
+            "select s from (select s from gc group by s) t "
+            "order by s collate utf8mb4_bin"
+        ).rows
+        assert [r[0] for r in rows] == ["B", "a"]
+
+    def test_min_max_returns_real_member(self, sess):
+        # MIN/MAX decode to actual dictionary codes: downstream binary
+        # compares and joins on the result still work
+        self.setup_t(sess)
+        rows = sess.execute(
+            "select * from (select max(s) m from g) t where m = 'carl'"
+        ).rows
+        assert rows == [("carl",)]
+
+    def test_ci_group_minmax_streamed(self, sess):
+        # the partial/final split must keep rank-composed values across
+        # chunks and decode only at the final stage (fragment.py
+        # _partial_descs post threading)
+        self.setup_t(sess)
+        full = sess.execute(
+            "select s, min(s), max(s), sum(k) from g group by s order by s"
+        ).rows
+        sess.execute("set tidb_tpu_stream_rows = 2")
+        try:
+            streamed = sess.execute(
+                "select s, min(s), max(s), sum(k) from g "
+                "group by s order by s"
+            ).rows
+        finally:
+            sess.execute("set tidb_tpu_stream_rows = 0")
+        assert streamed == full
+        assert [(r[0].upper(), r[3]) for r in full] == [
+            ("ANN", 7), ("BOB", 24), ("CARL", 32)
+        ]
+
+    def test_ci_group_minmax_mesh(self):
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        single = Session(cat)
+        single.execute("create database collm")
+        for s in (single,):
+            s.execute("use collm")
+        single.execute(
+            "create table g (s varchar(16) collate utf8mb4_general_ci, "
+            "k int)"
+        )
+        single.execute(
+            "insert into g values ('Ann', 1), ('ANN', 2), ('ann', 4), "
+            "('Bob', 8), ('BOB', 16), ('carl', 32)"
+        )
+        mesh = Session(cat, db="collm", mesh_devices=8)
+        q = "select s, min(s), max(s), sum(k) from g group by s order by s"
+        assert mesh.execute(q).rows == single.execute(q).rows
+
+    def test_unicode_ci_group_accents(self, sess):
+        sess.execute(
+            "create table ua (s varchar(8) collate utf8mb4_unicode_ci, "
+            "k int)"
+        )
+        sess.execute(
+            "insert into ua values ('café', 1), ('CAFE', 2), ('tea', 4)"
+        )
+        rows = sess.execute(
+            "select s, sum(k) from ua group by s order by s"
+        ).rows
+        assert [r[1] for r in rows] == [3, 4]
+
+
 class TestShowStatements:
     def test_show_collation(self, sess):
         rows = sess.execute("show collation").rows

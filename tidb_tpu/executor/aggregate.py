@@ -93,6 +93,12 @@ class AggDesc:
     # wraparound at TPC-H SF100 scale. Reference: MyDecimal's 30-digit
     # fixed-point accumulators (pkg/types/mydecimal.go:236).
     wide: bool = False
+    # post-reduction decode applied to min/max results (e.g. CI-collated
+    # string MIN composes rank*D+code so the reduction orders by
+    # collation; post extracts the original dict code). Skipped at the
+    # partial stage of a split aggregation — only the final stage
+    # decodes (parallel/fragment._partial_descs).
+    post: Optional[Callable] = None
 
 
 def _next_pow2(n: int) -> int:
@@ -961,8 +967,9 @@ def _run_aggs(
             rc = req("sum", ones, valid, jnp.int64(0))
             emit(
                 a.out_name,
-                lambda R, rs=rs, rc=rc: DevCol(
-                    R[rs], (R[rc] > 0) & group_valid
+                lambda R, rs=rs, rc=rc, p=a.post: DevCol(
+                    p(R[rs]) if p is not None else R[rs],
+                    (R[rc] > 0) & group_valid,
                 ),
             )
         elif a.func == "first":

@@ -48,29 +48,36 @@ def _combined_key_hash(cols, cap: int) -> DevCol:
     return DevCol(h, jnp.ones(cap, dtype=jnp.bool_))
 
 
-def _partial_descs(aggs: Sequence[AggDesc]) -> Tuple[List[AggDesc], List[Tuple[str, str, List[str], int]]]:
+def _partial_descs(
+    aggs: Sequence[AggDesc],
+) -> Tuple[List[AggDesc], List[Tuple[str, str, List[str], int, object]]]:
     """Split aggregates into partial-stage descriptors and final-stage
-    combine rules: (final func name, out name, partial col names, scale)."""
+    combine rules: (final func name, out name, partial col names, scale,
+    post-decode callable or None)."""
     partial: List[AggDesc] = []
-    final: List[Tuple[str, str, List[str], int]] = []
+    final: List[Tuple[str, str, List[str], int, object]] = []
     for i, a in enumerate(aggs):
         if a.func == "count":
             pname = f"_p{i}"
             partial.append(AggDesc("count", a.arg, pname))
-            final.append(("sum", a.out_name, [pname], 0))
+            final.append(("sum", a.out_name, [pname], 0, None))
         elif a.func == "sum":
             pname = f"_p{i}"
             partial.append(AggDesc("sum", a.arg, pname, wide=a.wide))
-            final.append(("sum", a.out_name, [pname], 0))
+            final.append(("sum", a.out_name, [pname], 0, None))
         elif a.func in ("min", "max"):
+            # the partial stage keeps encoded values (a.post decodes
+            # e.g. CI-string rank*D+code back to a dict code); only the
+            # FINAL reduction decodes, so cross-chunk combines still
+            # order by the encoded comparison key
             pname = f"_p{i}"
             partial.append(AggDesc(a.func, a.arg, pname))
-            final.append((a.func, a.out_name, [pname], 0))
+            final.append((a.func, a.out_name, [pname], 0, a.post))
         elif a.func == "avg":
             sname, cname = f"_ps{i}", f"_pc{i}"
             partial.append(AggDesc("sum", a.arg, sname, wide=a.wide))
             partial.append(AggDesc("count", a.arg, cname))
-            final.append(("avg2", a.out_name, [sname, cname], a.arg_scale))
+            final.append(("avg2", a.out_name, [sname, cname], a.arg_scale, None))
         else:
             raise NotImplementedError(f"distributed agg {a.func}")
     return partial, final
@@ -83,13 +90,13 @@ def build_final_stage(key_names, final):
     fkeys = [_colfn(n) for n in key_names]
     fdescs: List[AggDesc] = []
     post_avg: List[Tuple[str, str, str, int]] = []
-    for func, out, pnames, scale in final:
+    for func, out, pnames, scale, post in final:
         if func == "avg2":
             fdescs.append(AggDesc("sum", _colfn(pnames[0]), f"_fs_{out}"))
             fdescs.append(AggDesc("sum", _colfn(pnames[1]), f"_fc_{out}"))
             post_avg.append((out, f"_fs_{out}", f"_fc_{out}", scale))
         else:
-            fdescs.append(AggDesc(func, _colfn(pnames[0]), out))
+            fdescs.append(AggDesc(func, _colfn(pnames[0]), out, post=post))
     return fkeys, fdescs, post_avg
 
 

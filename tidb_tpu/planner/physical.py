@@ -569,6 +569,19 @@ def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
     reduction passes; the proof is re-checked at every fetch."""
     key_fns = [compile_expr(e, dicts) for _, e in plan.group_exprs]
     key_names = [n for n, _ in plan.group_exprs]
+    key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
+    # collation-correct grouping: dict-coded string keys under a CI
+    # collation group by their dense collation RANK (equal-under-
+    # collation entries share a rank) instead of the binary dict code,
+    # so GROUP BY name merges 'Ann'/'ANN' under *_ci like MySQL
+    # (reference pkg/util/collate/collate.go:66 — Key() drives hash).
+    # agg_out_dicts applies the matching rank->representative dict.
+    for i, (_n, e) in enumerate(plan.group_exprs):
+        lr = _collation_rank(e, dicts)
+        if lr is None:
+            continue
+        key_fns[i] = _rank_wrap(key_fns[i], jnp.asarray(lr[0]))
+        key_widths[i] = (max(1, int(len(lr[1])).bit_length()), 0)
     descs = []
     for name, func, arg, distinct in plan.aggs:
         fn = compile_expr(arg, dicts) if arg is not None else None
@@ -598,11 +611,87 @@ def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
         # sum/avg/count the kernel dedupes via representative-row masks
         # (executor/aggregate._distinct_reps)
         d = bool(distinct) and func in ("sum", "avg", "count") and arg is not None
+        # MIN/MAX over CI-collated strings must order by collation, not
+        # binary code: compose cmp_rank*D + code so the int reduction
+        # picks the collation extreme; AggDesc.post decodes the winning
+        # member's original dict code (output dict unchanged). COUNT
+        # (DISTINCT s) dedupes by equality class; plain COUNT reads
+        # only validity and `first` is a row passthrough — both keep
+        # raw codes.
+        post = None
+        if func in ("min", "max") and arg is not None:
+            cw = _collation_compose(arg, dicts)
+            if cw is not None:
+                fn, post = cw[0](fn), cw[1]
+        elif func == "count" and distinct and arg is not None:
+            lr = _collation_rank(arg, dicts)
+            if lr is not None:
+                fn = _rank_wrap(fn, jnp.asarray(lr[0]))
         descs.append(
-            AggDesc(func, fn, name, distinct=d, arg_scale=scale, wide=wide)
+            AggDesc(
+                func, fn, name, distinct=d, arg_scale=scale, wide=wide,
+                post=post,
+            )
         )
-    key_widths = [_key_width(e, dicts) for _, e in plan.group_exprs]
     return key_fns, key_names, key_widths, descs
+
+
+def _collation_compose(e: Expr, dicts):
+    """For a CI-collated dict-coded string expr: (wrapper making the
+    compiled fn yield cmp_rank*D + code, post decoding code) so MIN/MAX
+    order by collation while returning a real dictionary code. None
+    when binary / no dictionary."""
+    if e.type is None or e.type.kind != Kind.STRING or not e.type.collation:
+        return None
+    from tidb_tpu.utils import collate as _coll
+
+    if _coll.is_binary(e.type.collation):
+        return None
+    d = _expr_dict(e, dicts)
+    if d is None or not len(d):
+        return None
+    from tidb_tpu.expression.kernels import _collation_rank_lut
+
+    cr, _keys, _kf = _collation_rank_lut(d, e.type.collation)
+    D = int(len(d))
+
+    def wrap(fn):
+        def composed(b: Batch) -> DevCol:
+            c = fn(b)
+            code = jnp.clip(c.data.astype(jnp.int64), 0, D - 1)
+            return DevCol(cr[code] * D + code, c.valid)
+
+        return composed
+
+    return wrap, (lambda v: v % D)
+
+
+def _collation_rank(e: Expr, dicts):
+    """(jnp rank LUT, representative dict) for a dict-coded string expr
+    under a non-binary collation; None when binary/no dictionary."""
+    if e.type is None or e.type.kind != Kind.STRING or not e.type.collation:
+        return None
+    from tidb_tpu.utils import collate as _coll
+
+    if _coll.is_binary(e.type.collation):
+        return None
+    d = _expr_dict(e, dicts)
+    if d is None:
+        return None
+    lr = _coll.rank_lut(d, e.type.collation)
+    if lr is None or len(lr[0]) == 0:
+        return None
+    return lr  # (np lut, rep) — callers upload the LUT only when used
+
+
+def _rank_wrap(fn, jlut):
+    def wrapped(b: Batch) -> DevCol:
+        c = fn(b)
+        return DevCol(
+            jlut[jnp.clip(c.data, 0, jlut.shape[0] - 1)], c.valid
+        )
+
+    return wrapped
 
 
 
@@ -613,7 +702,13 @@ def agg_out_dicts(plan: "L.Aggregate", dicts) -> Dicts:
     for (kname, e) in plan.group_exprs:
         d = _expr_dict(e, dicts)
         if d is not None:
-            out_dicts[kname] = d
+            # CI-collated keys group (and emit codes) in rank space:
+            # publish the matching rank->representative dictionary
+            # (build_agg_parts applies the mirror-image rank LUT)
+            lr = _collation_rank(e, dicts)
+            out_dicts[kname] = d if lr is None else lr[1]
+            if lr is not None:
+                continue  # code bounds describe the pre-rank codes
         if isinstance(e, ColumnRef):
             cb = dicts.get(_BOUNDS_PREFIX + e.name)
             if cb is not None:
@@ -623,6 +718,8 @@ def agg_out_dicts(plan: "L.Aggregate", dicts) -> Dicts:
         out_dicts[_UNIQ_PREFIX + plan.group_exprs[0][0]] = True
     for (name, func, arg, _d) in plan.aggs:
         if func in ("min", "max", "first") and arg is not None:
+            # min/max decode back to original dict codes (AggDesc.post),
+            # so the original dictionary stays correct under CI too
             d = _expr_dict(arg, dicts)
             if d is not None:
                 out_dicts[name] = d

@@ -102,6 +102,54 @@ def validate(name: str) -> str:
     return n
 
 
+def rank_lut(d, coll):
+    """Group-key LUT over ONE dictionary: returns (lut, rep) where
+    equal-under-collation entries share lut[code], and rep is a
+    BINARY-SORTED dictionary of one representative per class (the
+    binary-least member — MySQL permits any group member as the
+    displayed GROUP BY value) with lut[code] its class's position in
+    rep. Keeping rep binary-sorted keeps every downstream consumer
+    that assumes sorted dictionaries (literal-compare searchsorted,
+    binary ORDER BY on codes, nested re-aggregation) sound. Grouping
+    by lut[code] instead of code is the columnar analog of hashing on
+    Collator.Key() (reference pkg/util/collate/collate.go:66 — Key()
+    drives both compare and hash); unlike the *comparison* rank LUTs
+    (merge_rank_luts, kernels._collation_rank_lut) the codes here are
+    NOT in collation order — only equality structure matters.
+    Returns None for binary collations (identity). Memoized by
+    (dictionary identity, collation): plan compilation asks for the
+    same LUT from several sites (group keys, output dicts, arg
+    wraps) and dictionaries are table-global and immutable."""
+    import numpy as np
+
+    if is_binary(coll):
+        return None
+    key = (id(d), (coll or "").lower())
+    hit = _RANK_CACHE.get(key)
+    if hit is not None and hit[0] is d:
+        return hit[1]
+    f = key_fn(coll)
+    entries = [str(s) for s in d.tolist()]
+    keys = [f(s) for s in entries]
+    rep_of: dict = {}  # collation key -> binary-least member
+    for s, k in zip(entries, keys):
+        if k not in rep_of or s < rep_of[k]:
+            rep_of[k] = s
+    rep_sorted = sorted(rep_of.values())
+    idx = {s: i for i, s in enumerate(rep_sorted)}
+    lut = np.array([idx[rep_of[k]] for k in keys], dtype=np.int64)
+    out = (lut, np.array(rep_sorted, dtype=object))
+    while len(_RANK_CACHE) >= 32:
+        _RANK_CACHE.pop(next(iter(_RANK_CACHE)))
+    # the cached strong ref to `d` keeps its id from being reused
+    _RANK_CACHE[key] = (d, out)
+    return out
+
+
+# (id(dict), collation) -> (dict strong ref, (lut, rep)); see rank_lut
+_RANK_CACHE: dict = {}
+
+
 def merge_rank_luts(da, db, coll):
     """Merge two dictionaries in collation-KEY space: returns
     (merged sorted key array, lut_a, lut_b) where lut_x[code] is the
