@@ -234,13 +234,24 @@ class TestOnDuplicateKeyUpdate:
         assert sess.execute("select n from t").rows == [(3,)]
         assert r.affected == 5  # 1 insert + 2 updates x 2
 
+    def test_string_func_in_on_dup(self, sess):
+        # concat and friends run through the shared host evaluator
+        # (checkeval._SCALAR, added with generated columns)
+        sess.execute("create table t (id int primary key, b varchar(10))")
+        sess.execute("insert into t values (1, 'x')")
+        sess.execute(
+            "insert into t values (1, 'y') "
+            "on duplicate key update b = concat(b, '!')"
+        )
+        assert sess.execute("select b from t").rows == [("x!",)]
+
     def test_unsupported_expr_clear_error(self, sess):
         sess.execute("create table t (id int primary key, b varchar(10))")
         sess.execute("insert into t values (1, 'x')")
         with pytest.raises(ValueError, match="ON DUPLICATE KEY UPDATE"):
             sess.execute(
                 "insert into t values (1, 'y') "
-                "on duplicate key update b = concat(b, '!')"
+                "on duplicate key update b = md5(b)"
             )
 
     def test_upsert_respects_check(self, sess):

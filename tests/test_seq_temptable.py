@@ -1,0 +1,254 @@
+"""SEQUENCE objects and local TEMPORARY tables.
+
+Reference: pkg/ddl/sequence.go:30 (onCreateSequence) + pkg/meta/autoid
+(sequence allocator); pkg/table/temptable/ddl.go (local temporary
+tables living in session state, shadowing the shared schema by name).
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database sq")
+    s.execute("use sq")
+    return s
+
+
+class TestSequence:
+    def test_nextval_lastval(self, sess):
+        sess.execute("create sequence s1")
+        assert sess.execute("select nextval(s1)").rows == [(1,)]
+        assert sess.execute("select nextval(s1)").rows == [(2,)]
+        assert sess.execute("select lastval(s1)").rows == [(2,)]
+
+    def test_lastval_before_first_nextval_is_null(self, sess):
+        sess.execute("create sequence s2")
+        assert sess.execute("select lastval(s2)").rows == [(None,)]
+
+    def test_start_increment(self, sess):
+        sess.execute("create sequence s3 start with 10 increment by 5")
+        assert sess.execute("select nextval(s3)").rows == [(10,)]
+        assert sess.execute("select nextval(s3)").rows == [(15,)]
+
+    def test_setval(self, sess):
+        sess.execute("create sequence s4")
+        sess.execute("select setval(s4, 100)")
+        assert sess.execute("select nextval(s4)").rows == [(101,)]
+
+    def test_maxvalue_exhaustion(self, sess):
+        sess.execute("create sequence s5 start with 1 maxvalue 2")
+        sess.execute("select nextval(s5)")
+        sess.execute("select nextval(s5)")
+        with pytest.raises(ValueError, match="run out"):
+            sess.execute("select nextval(s5)")
+
+    def test_cycle_wraps_to_minvalue(self, sess):
+        sess.execute(
+            "create sequence s6 start with 2 minvalue 1 maxvalue 3 cycle"
+        )
+        vals = [
+            sess.execute("select nextval(s6)").rows[0][0] for _ in range(4)
+        ]
+        assert vals == [2, 3, 1, 2]
+
+    def test_descending(self, sess):
+        sess.execute(
+            "create sequence sd increment by -2 start with 0 maxvalue 0"
+        )
+        assert sess.execute("select nextval(sd)").rows == [(0,)]
+        assert sess.execute("select nextval(sd)").rows == [(-2,)]
+
+    def test_insert_values_advances_per_row(self, sess):
+        sess.execute("create sequence sid")
+        sess.execute("create table t (id int, v int)")
+        sess.execute(
+            "insert into t values (nextval(sid), 10), (nextval(sid), 20)"
+        )
+        assert sess.execute("select id from t order by id").rows == [
+            (1,), (2,)
+        ]
+
+    def test_drop_sequence(self, sess):
+        sess.execute("create sequence sg")
+        sess.execute("drop sequence sg")
+        with pytest.raises(ValueError, match="unknown sequence"):
+            sess.execute("select nextval(sg)")
+        sess.execute("drop sequence if exists sg")
+        with pytest.raises(ValueError, match="unknown sequence"):
+            sess.execute("drop sequence sg")
+
+    def test_name_collision_with_table(self, sess):
+        sess.execute("create table nt (a int)")
+        with pytest.raises(ValueError, match="exists"):
+            sess.execute("create sequence nt")
+
+    def test_information_schema(self, sess):
+        sess.execute(
+            "create sequence si start with 7 increment by 3 maxvalue 99"
+        )
+        rows = sess.execute(
+            "select sequence_name, start_value, increment, max_value "
+            "from information_schema.sequences "
+            "where sequence_schema = 'sq' and sequence_name = 'si'"
+        ).rows
+        assert rows == [("si", 7, 3, 99)]
+
+    def test_persist_roundtrip(self, sess, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog, save_catalog
+
+        sess.execute("create sequence sp start with 5")
+        sess.execute("select nextval(sp)")  # state: next = 6
+        save_catalog(
+            getattr(sess.catalog, "_base", sess.catalog), str(tmp_path)
+        )
+        cat2 = load_catalog(str(tmp_path))
+        s2 = Session(cat2, db="sq")
+        assert s2.execute("select nextval(sp)").rows == [(6,)]
+
+    def test_lastval_is_per_session(self, sess):
+        sess.execute("create sequence sl")
+        sess.execute("select nextval(sl)")
+        other = Session(
+            getattr(sess.catalog, "_base", sess.catalog), db="sq"
+        )
+        assert other.execute("select lastval(sl)").rows == [(None,)]
+        # but the allocator is shared
+        assert other.execute("select nextval(sl)").rows == [(2,)]
+
+
+class TestTemporaryTable:
+    def test_basic_create_insert(self, sess):
+        sess.execute("create temporary table tt (a int, b varchar(8))")
+        sess.execute("insert into tt values (1, 'x'), (2, 'y')")
+        assert sess.execute(
+            "select b from tt where a = 2"
+        ).rows == [("y",)]
+
+    def test_invisible_to_other_sessions(self, sess):
+        sess.execute("create temporary table tp (a int)")
+        sess.execute("insert into tp values (1)")
+        other = Session(
+            getattr(sess.catalog, "_base", sess.catalog), db="sq"
+        )
+        with pytest.raises(ValueError, match="unknown table"):
+            other.execute("select * from tp")
+
+    def test_not_in_show_tables(self, sess):
+        sess.execute("create temporary table th (a int)")
+        names = [r[0] for r in sess.execute("show tables").rows]
+        assert "th" not in names
+
+    def test_shadows_permanent(self, sess):
+        sess.execute("create table sh (a int)")
+        sess.execute("insert into sh values (100)")
+        sess.execute("create temporary table sh (a int)")
+        sess.execute("insert into sh values (1)")
+        assert sess.execute("select a from sh").rows == [(1,)]
+        # other sessions still see the permanent table
+        other = Session(
+            getattr(sess.catalog, "_base", sess.catalog), db="sq"
+        )
+        assert other.execute("select a from sh").rows == [(100,)]
+        sess.execute("drop temporary table sh")
+        assert sess.execute("select a from sh").rows == [(100,)]
+
+    def test_drop_table_prefers_temp(self, sess):
+        sess.execute("create table dp (a int)")
+        sess.execute("create temporary table dp (a int)")
+        sess.execute("drop table dp")  # drops the temp shadow
+        assert sess.execute("select count(*) from dp").rows == [(0,)]
+        sess.execute("drop table dp")  # now the permanent one
+        with pytest.raises(ValueError, match="unknown table"):
+            sess.execute("select * from dp")
+
+    def test_drop_temporary_only(self, sess):
+        sess.execute("create table od (a int)")
+        with pytest.raises(ValueError, match="unknown temporary"):
+            sess.execute("drop temporary table od")
+        sess.execute("drop temporary table if exists od")
+        assert sess.execute("select count(*) from od").rows == [(0,)]
+
+    def test_join_temp_with_permanent(self, sess):
+        sess.execute("create table base (k int, v varchar(8))")
+        sess.execute("insert into base values (1, 'one'), (2, 'two')")
+        sess.execute("create temporary table pick (k int)")
+        sess.execute("insert into pick values (2)")
+        assert sess.execute(
+            "select v from base join pick on base.k = pick.k"
+        ).rows == [("two",)]
+
+    def test_temp_with_generated_and_autoinc(self, sess):
+        sess.execute(
+            "create temporary table tg (id int primary key auto_increment, "
+            "a int, d int as (a * 2) stored)"
+        )
+        sess.execute("insert into tg (a) values (5)")
+        assert sess.execute("select id, d from tg").rows == [(1, 10)]
+
+    def test_temp_txn_commit(self, sess):
+        sess.execute("create temporary table tx (a int)")
+        sess.execute("begin")
+        sess.execute("insert into tx values (1)")
+        sess.execute("commit")
+        assert sess.execute("select a from tx").rows == [(1,)]
+
+    def test_update_delete_on_temp(self, sess):
+        sess.execute("create temporary table ud (a int, b int)")
+        sess.execute("insert into ud values (1, 10), (2, 20)")
+        sess.execute("update ud set b = 99 where a = 1")
+        sess.execute("delete from ud where a = 2")
+        assert sess.execute("select a, b from ud").rows == [(1, 99)]
+
+    def test_ctas_ignores_temp_shadow(self, sess):
+        # a temp table shadowing the name must neither block a
+        # permanent CTAS nor receive its rows (review finding r5)
+        sess.execute("create temporary table cx (y int)")
+        sess.execute("insert into cx values (7)")
+        sess.execute("create table cx as select 1 as z")
+        # the session still resolves the TEMP table by name
+        assert sess.execute("select y from cx").rows == [(7,)]
+        other = Session(
+            getattr(sess.catalog, "_base", sess.catalog), db="sq"
+        )
+        assert other.execute("select z from cx").rows == [(1,)]
+
+    def test_create_temporary_as_select(self, sess):
+        sess.execute("create table src2 (v int)")
+        sess.execute("insert into src2 values (3), (4)")
+        sess.execute(
+            "create temporary table tsel as select v * 10 as w from src2"
+        )
+        assert sess.execute("select w from tsel order by w").rows == [
+            (30,), (40,)
+        ]
+        names = [r[0] for r in sess.execute("show tables").rows]
+        assert "tsel" not in names
+
+    def test_temp_ine_unknown_db_still_errors(self, sess):
+        with pytest.raises(ValueError, match="unknown database"):
+            sess.execute(
+                "create temporary table if not exists nosuchdb.tt (a int)"
+            )
+
+    def test_table_sequence_namespace_both_ways(self, sess):
+        sess.execute("create sequence ns1")
+        with pytest.raises(ValueError, match="exists"):
+            sess.execute("create table ns1 (a int)")
+        with pytest.raises(ValueError, match="exists"):
+            sess.execute("create view ns1 as select 1")
+
+    def test_backup_excludes_temp(self, sess, tmp_path):
+        from tidb_tpu.storage.persist import load_catalog
+
+        sess.execute("create table perm (a int)")
+        sess.execute("insert into perm values (1)")
+        sess.execute("create temporary table tback (a int)")
+        sess.execute(f"backup database sq to '{tmp_path}'")
+        cat2 = load_catalog(str(tmp_path))
+        assert cat2.has_table("sq", "perm")
+        assert not cat2.has_table("sq", "tback")

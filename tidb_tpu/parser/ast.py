@@ -203,6 +203,12 @@ class ColumnDef:
     enum_members: tuple = ()  # ENUM('a','b'): allowed values
     set_members: tuple = ()   # SET('a','b'): allowed comma-set members
     is_json: bool = False     # JSON column (validated on write)
+    # GENERATED ALWAYS AS (expr): (expr SQL text, parsed expr, stored?).
+    # Reference: pkg/ddl/generated_column.go:125; both VIRTUAL and
+    # STORED materialize on write here (generated expressions are
+    # required deterministic, so eager evaluation is observationally
+    # identical), the flag is kept for SHOW CREATE fidelity.
+    generated: object = None
 
 
 @dataclasses.dataclass
@@ -230,6 +236,9 @@ class CreateTable:
     fk_actions: dict = dataclasses.field(default_factory=dict)
     # fk name -> ON UPDATE action (same value domain)
     fk_update_actions: dict = dataclasses.field(default_factory=dict)
+    # CREATE TEMPORARY TABLE: session-scoped, shadows base tables by
+    # name (reference: pkg/table/temptable/ddl.go local temp tables)
+    temporary: bool = False
 
 
 @dataclasses.dataclass
@@ -252,6 +261,32 @@ class DropIndex:
 
 @dataclasses.dataclass
 class DropTable:
+    db: Optional[str]
+    name: str
+    if_exists: bool = False
+    # DROP TEMPORARY TABLE: only session-local temp tables qualify
+    temporary: bool = False
+
+
+@dataclasses.dataclass
+class CreateSequence:
+    """CREATE SEQUENCE (reference: pkg/ddl/sequence.go:30
+    onCreateSequence; pkg/meta/autoid sequence allocator). Options
+    mirror the reference's sequence defaults."""
+
+    db: Optional[str]
+    name: str
+    start: int = 1
+    increment: int = 1
+    minvalue: Optional[int] = None
+    maxvalue: Optional[int] = None
+    cycle: bool = False
+    cache: int = 1000
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropSequence:
     db: Optional[str]
     name: str
     if_exists: bool = False

@@ -1823,6 +1823,12 @@ class Parser:
                 icols.append(self.expect_ident())
             self.expect_op(")")
             return ast.CreateIndex(db, tname, iname, icols, ine, unique)
+        if self._at_ident("sequence"):
+            return self._parse_create_sequence()
+        temporary = False
+        if self._at_ident("temporary"):
+            self.advance()
+            temporary = True
         self.expect_kw("table")
         ine = self._if_not_exists()
         db, name = self._qualified_name()
@@ -1833,7 +1839,9 @@ class Parser:
                 if self.at_kw("with")
                 else self.parse_select_or_union()
             )
-            return ast.CreateTable(db, name, [], [], ine, as_query=q)
+            return ast.CreateTable(
+                db, name, [], [], ine, as_query=q, temporary=temporary
+            )
         self.expect_op("(")
         cols: List[ast.ColumnDef] = []
         pk: List[str] = []
@@ -2014,6 +2022,8 @@ class Parser:
                         if cs not in _coll.CHARSET_DEFAULTS:
                             raise ParseError(f"unknown character set {cs!r}")
                         col_charset = cs
+                    elif self._at_generated_clause():
+                        cd.generated = self._parse_generated_clause()
                     elif self._at_ident("check"):
                         self.advance()
                         _parse_check(None)
@@ -2120,7 +2130,62 @@ class Parser:
             db, name, cols, pk, ine, indexes=indexes, ttl=ttl,
             checks=checks, fks=fks, partition=partition,
             fk_actions=fk_actions, fk_update_actions=fk_update_actions,
+            temporary=temporary,
         )
+
+    def _parse_create_sequence(self):
+        """CREATE SEQUENCE [IF NOT EXISTS] name [START [WITH] n]
+        [INCREMENT [BY] n] [MINVALUE n | NOMINVALUE] [MAXVALUE n |
+        NOMAXVALUE] [CACHE n | NOCACHE] [CYCLE | NOCYCLE] — the
+        reference's option grammar (pkg/parser sequence options)."""
+        self.advance()  # 'sequence'
+        ine = self._if_not_exists()
+        db, name = self._qualified_name()
+        seq = ast.CreateSequence(db, name, if_not_exists=ine)
+
+        def _int(allow_neg=True):
+            neg = allow_neg and self.accept_op("-")
+            t = self.cur
+            if t.kind != "num":
+                raise ParseError(f"expected number at {t.pos}")
+            self.advance()
+            return -int(t.text) if neg else int(t.text)
+
+        while True:
+            if self._at_ident("start") or self.at_kw("start"):
+                self.advance()
+                self.accept_kw("with")
+                seq.start = _int()
+            elif self._at_ident("increment"):
+                self.advance()
+                if self._at_ident("by") or self.at_kw("by"):
+                    self.advance()
+                seq.increment = _int()
+                if seq.increment == 0:
+                    raise ParseError("INCREMENT must be non-zero")
+            elif self._at_ident("minvalue"):
+                self.advance()
+                seq.minvalue = _int()
+            elif self._at_ident("maxvalue"):
+                self.advance()
+                seq.maxvalue = _int()
+            elif self._at_ident("nominvalue") or self._at_ident("nomaxvalue"):
+                self.advance()
+            elif self._at_ident("cache"):
+                self.advance()
+                seq.cache = _int(allow_neg=False)
+            elif self._at_ident("nocache"):
+                self.advance()
+                seq.cache = 0
+            elif self._at_ident("cycle"):
+                self.advance()
+                seq.cycle = True
+            elif self._at_ident("nocycle"):
+                self.advance()
+                seq.cycle = False
+            else:
+                break
+        return seq
 
     def parse_alter(self):
         self.expect_kw("alter")
@@ -2174,11 +2239,13 @@ class Parser:
         )
 
     def _alter_column_tail(self, cname: str):
-        """<type> [NOT NULL | NULL | DEFAULT <const>]* after a column
+        """<type> [NOT NULL | NULL | DEFAULT <const> |
+        [GENERATED ALWAYS] AS (expr) [VIRTUAL|STORED]]* after a column
         name in ADD/MODIFY/CHANGE COLUMN."""
         ctype = self.parse_type()
         default = None
         not_null = False
+        generated = None
         while True:  # NOT NULL / DEFAULT in either order (MySQL)
             if self.accept_kw("not"):
                 self.expect_kw("null")
@@ -2190,9 +2257,43 @@ class Parser:
                 if not isinstance(d, ast.Const):
                     raise ParseError("DEFAULT must be a constant")
                 default = d.value
+            elif self._at_generated_clause():
+                generated = self._parse_generated_clause()
             else:
                 break
-        return ast.ColumnDef(cname, ctype, not_null=not_null), default
+        cd = ast.ColumnDef(cname, ctype, not_null=not_null)
+        cd.generated = generated
+        return cd, default
+
+    def _at_generated_clause(self) -> bool:
+        return self._at_ident("generated") or (
+            self.at_kw("as") and self.toks[self.i + 1].text == "("
+        )
+
+    def _parse_generated_clause(self):
+        """[GENERATED ALWAYS] AS (expr) [VIRTUAL|STORED] ->
+        (expr SQL text, parsed expr, stored?). Shared by the CREATE
+        TABLE column loop and ALTER ADD/MODIFY/CHANGE column tails."""
+        if self._at_ident("generated"):
+            self.advance()
+            if not self._at_ident("always"):
+                raise ParseError("expected ALWAYS after GENERATED")
+            self.advance()
+            self.expect_kw("as")
+        else:
+            self.advance()
+        self.expect_op("(")
+        gstart = self.cur.pos
+        gexpr = self.parse_expr()
+        gend = self.cur.pos
+        self.expect_op(")")
+        stored = False
+        if self._at_ident("stored"):
+            self.advance()
+            stored = True
+        elif self._at_ident("virtual"):
+            self.advance()
+        return (self.sql[gstart:gend].strip(), gexpr, stored)
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("if"):
@@ -2251,13 +2352,25 @@ class Parser:
             self.expect_kw("on")
             db, tname = self._qualified_name()
             return ast.DropIndex(db, tname, iname, if_exists)
+        if self._at_ident("sequence"):
+            self.advance()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            db, name = self._qualified_name()
+            return ast.DropSequence(db, name, if_exists)
+        temporary = False
+        if self._at_ident("temporary"):
+            self.advance()
+            temporary = True
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):
             self.expect_kw("exists")
             if_exists = True
         db, name = self._qualified_name()
-        return ast.DropTable(db, name, if_exists)
+        return ast.DropTable(db, name, if_exists, temporary=temporary)
 
     def parse_insert(self, skip_verb: bool = False):
         if not skip_verb:

@@ -102,6 +102,71 @@ def _collect_param_literals(plan) -> dict:
     return out
 
 
+class _SessionCatalog:
+    """Session-scoped catalog view: LOCAL TEMPORARY tables shadow base
+    tables by name for this session only (reference:
+    pkg/table/temptable/ddl.go — local temp tables live in session
+    state, and an infoschema wrapper resolves them before the shared
+    schema). Every other attribute (users, sysvars, locks, sequences,
+    the `_dbs` map, ...) delegates to the shared base catalog, so
+    sessions over the same store still share one authority. Temp
+    tables are invisible to `tables()` (SHOW TABLES / BACKUP / dump do
+    not see them, matching MySQL) but win name resolution in
+    `table()`/`has_table()`."""
+
+    __slots__ = ("_base", "_temp")
+
+    def __init__(self, base):
+        object.__setattr__(self, "_base", base)
+        object.__setattr__(self, "_temp", {})
+
+    def __getattr__(self, n):
+        return getattr(object.__getattribute__(self, "_base"), n)
+
+    def __setattr__(self, n, v):
+        setattr(object.__getattribute__(self, "_base"), n, v)
+
+    def table(self, db: str, name: str):
+        t = self._temp.get((db.lower(), name.lower()))
+        return t if t is not None else self._base.table(db, name)
+
+    def has_table(self, db: str, name: str) -> bool:
+        return (db.lower(), name.lower()) in self._temp or (
+            self._base.has_table(db, name)
+        )
+
+    def create_temp_table(self, db: str, name: str, schema):
+        from tidb_tpu.storage.table import Table
+
+        db, name = db.lower(), name.lower()
+        if db not in self._base._dbs:
+            raise ValueError(f"unknown database {db!r}")
+        key = (db, name)
+        if key in self._temp:
+            raise ValueError(f"temporary table {name!r} exists")
+        t = Table(name, schema)
+        self._temp[key] = t
+        # plan caches key on schema_version: a later DROP must not
+        # serve plans compiled against the shadowing temp table
+        self._base.schema_version += 1
+        return t
+
+    def drop_table(
+        self, db: str, name: str, if_exists: bool = False,
+        temporary_only: bool = False,
+    ) -> None:
+        key = (db.lower(), name.lower())
+        if key in self._temp:
+            del self._temp[key]
+            self._base.schema_version += 1
+            return
+        if temporary_only:
+            if if_exists:
+                return
+            raise ValueError(f"unknown temporary table {db}.{name}")
+        self._base.drop_table(db, name, if_exists)
+
+
 class Session:
     def __init__(
         self,
@@ -114,7 +179,10 @@ class Session:
         over an N-device mesh (sharded scans, all_to_all exchanges) — the
         MPP mode of the reference (tidb_allow_mpp); None = single device.
         """
-        self.catalog = catalog or Catalog()
+        base = catalog or Catalog()
+        if isinstance(base, _SessionCatalog):
+            base = base._base  # don't stack overlays across sessions
+        self.catalog = _SessionCatalog(base)
         self.db = db
         self.user = user
         if not hasattr(self.catalog, "users"):  # pre-UserStore pickles
@@ -296,6 +364,8 @@ class Session:
                 getattr(t, "fk_update_actions", {})
             )
             shadow.partition = t.partition
+            shadow.defaults = dict(getattr(t, "defaults", None) or {})
+            shadow.generated = list(getattr(t, "generated", None) or [])
             self._txn["shadows"][key] = shadow
             # conflict baseline = version at FIRST touch in this txn —
             # a shadow rebuilt after ROLLBACK TO SAVEPOINT must not
@@ -1097,6 +1167,16 @@ class Session:
                 raise ValueError(
                     f"cannot {verb} column {cn!r}: used by CHECK {nm!r}"
                 )
+        if verb == "rename":
+            # a rename would orphan the stored expression text; MODIFY
+            # (type conversion) is allowed — dependents recompute after
+            # the reorg (_run_modify_column)
+            for gc, ex in self._gen_exprs_for(t):
+                if cn in check_columns(ex):
+                    raise ValueError(
+                        f"cannot {verb} column {cn!r}: used by "
+                        f"generated column {gc!r}"
+                    )
         for nm, col, rdb, rtbl, rcol in t.fks:
             if cn == col:
                 raise ValueError(
@@ -1126,6 +1206,25 @@ class Session:
         types = t.schema.types
         if old_name not in types:
             raise ValueError(f"unknown column {old_name!r}")
+        self._reject_generated_targets(t, [old_name], "MODIFY")
+        if getattr(s.column, "generated", None) is not None:
+            # MySQL error 3106: changing a base column into a generated
+            # column with MODIFY/CHANGE is not supported
+            raise ValueError(
+                "cannot convert a column to GENERATED with MODIFY/CHANGE"
+            )
+        if new_name != old_name:
+            # a rename (CHANGE) would orphan dependent generated
+            # expression text — guard BOTH the meta-only and the
+            # conversion paths before any state is published
+            from tidb_tpu.utils.checkeval import check_columns as _gcc
+
+            for gc, ex in self._gen_exprs_for(t):
+                if old_name in _gcc(ex):
+                    raise ValueError(
+                        f"cannot rename column {old_name!r}: used by "
+                        f"generated column {gc!r}"
+                    )
         if new_name != old_name and new_name in types:
             raise ValueError(f"column {new_name!r} exists")
         old_t, new_t = types[old_name], s.column.type
@@ -1226,6 +1325,16 @@ class Session:
                 dflt[new_name] = v
             except (ValueError, TypeError):
                 pass  # unconvertible default: dropped, not corrupted
+        # stored generated columns depending on the converted column
+        # recompute through the reorg (reference: modify-column reorg
+        # re-evaluates dependent generated columns,
+        # pkg/ddl/generated_column.go + column.go:518)
+        from tidb_tpu.utils.checkeval import check_columns as _gc_cols
+
+        if any(
+            old_name in _gc_cols(ex) for _c, ex in self._gen_exprs_for(t)
+        ):
+            self._recompute_generated(t)
 
     # ------------------------------------------------------------------
     def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
@@ -1561,6 +1670,11 @@ class Session:
                 "create" if isinstance(s, ast.CreateDatabase) else "drop",
                 s.name.lower(),
             )
+        elif isinstance(s, (ast.CreateSequence, ast.DropSequence)):
+            self._check_priv(
+                "create" if isinstance(s, ast.CreateSequence) else "drop",
+                (s.db or self.db).lower(),
+            )
         elif isinstance(
             s, (ast.CreateUser, ast.DropUser, ast.GrantStmt, ast.CreateBinding)
         ):
@@ -1574,12 +1688,47 @@ class Session:
         # SHOW / SET / txn control / USE are unrestricted (SHOW GRANTS
         # FOR another user re-checks inside its handler)
 
+    def _seq_func(self, e):
+        """Evaluate NEXTVAL/LASTVAL/SETVAL (reference: sequence function
+        builtins over pkg/meta/autoid's sequence allocator). LASTVAL is
+        per-session per-sequence, like the reference's sessionVars
+        SequenceState."""
+        op = e.op.lower()
+        a = e.args[0] if e.args else None
+        if isinstance(a, ast.Name):
+            db, name = (a.table or self.db), a.column
+        elif isinstance(a, ast.Const) and isinstance(a.value, str):
+            db, name = self.db, a.value
+        else:
+            raise ValueError(f"{op.upper()} needs a sequence name")
+        seq = self.catalog.sequence(db, name)
+        key = (db.lower(), name.lower())
+        lv = getattr(self, "_seq_lastval", None)
+        if lv is None:
+            lv = self._seq_lastval = {}
+        if op == "nextval":
+            v = seq.nextval()
+            lv[key] = v
+            return v
+        if op == "lastval":
+            return lv.get(key)
+        if len(e.args) < 2:
+            raise ValueError("SETVAL needs (sequence, value)")
+        return seq.setval(self._const_value(e.args[1]))
+
     def _resolve_session_funcs(self, node):
         """Fold session-state functions (LAST_INSERT_ID(), DATABASE(),
         CURRENT_USER()) to constants before planning (the reference
-        evaluates these against sessionVars, builtin_info.go)."""
+        evaluates these against sessionVars, builtin_info.go). Sequence
+        functions fold ONCE per statement here — a multi-row SELECT
+        NEXTVAL(s) yields one value; per-row advancement applies in
+        INSERT ... VALUES via _const_value."""
         if isinstance(node, SQLType):
             return node
+        if isinstance(node, ast.Call) and node.op.lower() in (
+            "nextval", "lastval", "setval"
+        ):
+            return ast.Const(self._seq_func(node))
         if isinstance(node, ast.Call) and not node.args:
             op = node.op.lower()
             if op == "last_insert_id":
@@ -1678,8 +1827,23 @@ class Session:
         elif isinstance(s, ast.CreateTable) and s.as_query is not None:
             # CREATE TABLE ... AS SELECT: schema derived from the query.
             # Existence check FIRST — don't execute a potentially huge
-            # query only to throw the result away.
-            if self.catalog.has_table(s.db or self.db, s.name):
+            # query only to throw the result away. Resolve against the
+            # catalog the new table will live in: the shared base for a
+            # permanent CTAS (a session temp table shadowing the name
+            # must neither block nor receive the rows), the session
+            # overlay for CREATE TEMPORARY ... AS.
+            ctas_cat = (
+                self.catalog
+                if s.temporary
+                else getattr(self.catalog, "_base", self.catalog)
+            )
+            if (
+                s.temporary
+                and ((s.db or self.db).lower(), s.name.lower())
+                in self.catalog._temp
+            ) or (not s.temporary and ctas_cat.has_table(
+                s.db or self.db, s.name
+            )):
                 if s.if_not_exists:
                     return Result([], [])
                 raise ValueError(f"table {s.name} exists")
@@ -1703,10 +1867,15 @@ class Session:
                     n = f"col_{len(cols)}"
                 seen.add(n)
                 cols.append((n, typ if typ is not None else _I))
-            self.catalog.create_table(
-                s.db or self.db, s.name, TableSchema(cols), False
-            )
-            t = self.catalog.table(s.db or self.db, s.name)
+            if s.temporary:
+                t = self.catalog.create_temp_table(
+                    s.db or self.db, s.name, TableSchema(cols)
+                )
+            else:
+                ctas_cat.create_table(
+                    s.db or self.db, s.name, TableSchema(cols), False
+                )
+                t = ctas_cat.table(s.db or self.db, s.name)
             if res.rows:
                 t.append_rows([list(r) for r in res.rows])
             clear_scan_cache()
@@ -1733,6 +1902,7 @@ class Session:
             if auto and (len(auto) > 1 or auto[0].type.kind != Kind.INT):
                 raise ValueError("one integer AUTO_INCREMENT column per table")
             colnames = {c.name.lower() for c in s.columns}
+            gen_meta = self._validate_generated(s, auto, colnames)
             for nm, _txt, expr in s.checks:
                 from tidb_tpu.utils.checkeval import check_columns
 
@@ -1776,15 +1946,64 @@ class Session:
             part_meta = None
             if s.partition is not None:
                 part_meta = self._encode_partition(schema, s.partition)
-            existed = (
-                s.if_not_exists
-                and self.catalog.has_table(s.db or self.db, s.name)
-            )
-            self.catalog.create_table(s.db or self.db, s.name, schema, s.if_not_exists)
+            if s.temporary:
+                if s.partition is not None or ttl_opt is not None:
+                    raise ValueError(
+                        "temporary tables do not support partitioning/TTL"
+                    )
+                if fks_resolved:
+                    # MySQL: FOREIGN KEYs are not supported on temporary
+                    # tables (silently dropped there; rejected here)
+                    raise ValueError(
+                        "temporary tables do not support FOREIGN KEYs"
+                    )
+                db_l = (s.db or self.db).lower()
+                if db_l not in self.catalog._dbs:
+                    # IF NOT EXISTS never excuses a bad database name
+                    raise ValueError(f"unknown database {db_l!r}")
+                t = None
+                if (db_l, s.name.lower()) in self.catalog._temp:
+                    if not s.if_not_exists:
+                        raise ValueError(
+                            f"temporary table {s.name!r} exists"
+                        )
+                else:
+                    t = self.catalog.create_temp_table(
+                        db_l, s.name, schema
+                    )
+                if t is not None:
+                    for iname, icols, *uq in s.indexes:
+                        self._add_index(
+                            t, iname, icols, unique=bool(uq and uq[0])
+                        )
+                    if auto:
+                        t.autoinc_col = auto[0].name.lower()
+                    t.checks = [(nm, txt) for nm, txt, _e in s.checks]
+                    t.defaults = {
+                        c.name.lower(): c.default
+                        for c in s.columns
+                        if c.default is not None
+                    }
+                    if gen_meta:
+                        t.generated = gen_meta
+                existed = True  # the permanent-path block below is N/A
+                base_cat = None
+            else:
+                # permanent path: resolve through the BASE catalog — a
+                # session temp table may shadow the name, and the new
+                # permanent table must not inherit its identity
+                base_cat = getattr(self.catalog, "_base", self.catalog)
+                existed = (
+                    s.if_not_exists
+                    and base_cat.has_table(s.db or self.db, s.name)
+                )
+                self.catalog.create_table(
+                    s.db or self.db, s.name, schema, s.if_not_exists
+                )
             if not existed:
                 # IF NOT EXISTS on a pre-existing table is a full no-op:
                 # in-definition indexes must not mutate the live table
-                t = self.catalog.table(s.db or self.db, s.name)
+                t = base_cat.table(s.db or self.db, s.name)
                 for iname, icols, *uq in s.indexes:
                     self._add_index(t, iname, icols, unique=bool(uq and uq[0]))
                 if auto:
@@ -1810,6 +2029,8 @@ class Session:
                     for c in s.columns
                     if c.default is not None
                 }
+                if gen_meta:
+                    t.generated = gen_meta
             r = Result([], [])
         elif isinstance(s, ast.CreateIndex):
             failpoint.inject("ddl/create-index")
@@ -1834,7 +2055,10 @@ class Session:
                 self.catalog.schema_version += 1
             r = Result([], [])
         elif isinstance(s, ast.DropTable):
-            self.catalog.drop_table(s.db or self.db, s.name, s.if_exists)
+            self.catalog.drop_table(
+                s.db or self.db, s.name, s.if_exists,
+                temporary_only=s.temporary,
+            )
             clear_scan_cache()
             r = Result([], [])
         elif isinstance(s, ast.CreateView):
@@ -1921,13 +2145,16 @@ class Session:
             failpoint.inject("ddl/alter-table")
             t = self.catalog.table(s.db or self.db, s.name)
             if s.action == "add":
-                default = s.default
-                if default is None and s.column.not_null:
-                    # MySQL fills the type default for NOT NULL adds
-                    default = (
-                        "" if s.column.type.kind == Kind.STRING else 0
-                    )
-                t.alter_add_column(s.column.name, s.column.type, default)
+                if getattr(s.column, "generated", None) is not None:
+                    self._alter_add_generated(t, s)
+                else:
+                    default = s.default
+                    if default is None and s.column.not_null:
+                        # MySQL fills the type default for NOT NULL adds
+                        default = (
+                            "" if s.column.type.kind == Kind.STRING else 0
+                        )
+                    t.alter_add_column(s.column.name, s.column.type, default)
             elif s.action in ("modify", "change"):
                 self._run_modify_column(t, s)
             elif s.action == "rename_col":
@@ -1948,6 +2175,12 @@ class Session:
                         raise ValueError(
                             f"cannot drop column {cn!r}: used by CHECK {nm!r}"
                         )
+                for gc, ex in self._gen_exprs_for(t):
+                    if cn in check_columns(ex):
+                        raise ValueError(
+                            f"cannot drop column {cn!r}: used by "
+                            f"generated column {gc!r}"
+                        )
                 for nm, col, rdb, rtbl, rcol in t.fks:
                     if cn == col:
                         raise ValueError(
@@ -1963,6 +2196,11 @@ class Session:
                             f"FOREIGN KEY {nm!r} on {cdb}.{ctn}"
                         )
                 t.alter_drop_column(s.col_name)
+                gen = getattr(t, "generated", None)
+                if gen:
+                    # dropping a generated column removes its rule
+                    t.generated = [g for g in gen if g[0] != cn]
+                    t._gen_exprs = None
             self.catalog.schema_version += 1
             clear_scan_cache()
             r = Result([], [])
@@ -1999,11 +2237,14 @@ class Session:
             from tidb_tpu.storage.persist import load_catalog, save_catalog
 
             dbs = [s.db] if s.db else None
+            # BR operates on the SHARED base catalog: session temp
+            # tables must neither ride into backups nor shadow restores
+            bcat = getattr(self.catalog, "_base", self.catalog)
             if s.restore:
-                load_catalog(s.path, self.catalog, dbs=dbs)
+                load_catalog(s.path, bcat, dbs=dbs)
                 clear_scan_cache()
             else:
-                save_catalog(self.catalog, s.path, dbs=dbs, resume=True)
+                save_catalog(bcat, s.path, dbs=dbs, resume=True)
             r = Result([], [])
         elif isinstance(s, ast.BackupLog):
             from tidb_tpu.storage.logbackup import LogBackupTask
@@ -2035,7 +2276,10 @@ class Session:
         elif isinstance(s, ast.RestorePoint):
             from tidb_tpu.storage.logbackup import restore_point_in_time
 
-            n = restore_point_in_time(s.uri, self.catalog, s.until_ts)
+            n = restore_point_in_time(
+                s.uri, getattr(self.catalog, "_base", self.catalog),
+                s.until_ts,
+            )
             clear_scan_cache()
             r = Result(["tables_restored"], [(n,)])
         elif isinstance(s, ast.ImportInto):
@@ -2072,6 +2316,21 @@ class Session:
                 self.catalog.users.revoke(set(s.privs), db, s.table, s.user)
             else:
                 self.catalog.users.grant(set(s.privs), db, s.table, s.user)
+            r = Result([], [])
+        elif isinstance(s, ast.CreateSequence):
+            from tidb_tpu.storage.sequence import Sequence
+
+            seq = Sequence(
+                s.name.lower(), start=s.start, increment=s.increment,
+                minvalue=s.minvalue, maxvalue=s.maxvalue, cycle=s.cycle,
+                cache=s.cache,
+            )
+            self.catalog.create_sequence(
+                s.db or self.db, s.name, seq, s.if_not_exists
+            )
+            r = Result([], [])
+        elif isinstance(s, ast.DropSequence):
+            self.catalog.drop_sequence(s.db or self.db, s.name, s.if_exists)
             r = Result([], [])
         elif isinstance(s, ast.CreateDatabase):
             self.catalog.create_database(s.name, s.if_not_exists)
@@ -2416,6 +2675,11 @@ class Session:
             except Exception:
                 t.replace_blocks(saved, modified_rows=n)
                 raise
+        if n and getattr(t, "generated", None):
+            # the bulk loader appends raw blocks; re-evaluate generated
+            # columns over the table (values in the file are ignored,
+            # like a restore)
+            self._recompute_generated(t)
         clear_scan_cache()
         return Result([], [], affected=n)
 
@@ -2852,6 +3116,202 @@ class Session:
                 (nm, parse_expr(txt)) for nm, txt in t.checks
             ]
         return exprs
+
+    # -- generated columns ---------------------------------------------
+    # Reference: pkg/ddl/generated_column.go:125 (findDependedColumnNames
+    # + dependency validation) and pkg/table/tables.go stored-generated
+    # evaluation on the write path. Both VIRTUAL and STORED materialize
+    # on write here — generated expressions are required deterministic,
+    # so eager evaluation is observationally identical; the flag is kept
+    # for SHOW CREATE / information_schema fidelity.
+    def _validate_generated(self, s, auto, colnames):
+        """Validate generated-column clauses of a CREATE TABLE; returns
+        the [(col, expr text, stored)] metadata list (definition order,
+        which is also a valid evaluation order)."""
+        if not any(c.generated is not None for c in s.columns):
+            return []
+        from tidb_tpu.utils.checkeval import (
+            CheckEvalError, check_columns, validate_expr_ops,
+        )
+
+        ai_name = auto[0].name.lower() if auto else None
+        gen_names = {
+            c.name.lower() for c in s.columns if c.generated is not None
+        }
+        base_cols = colnames - gen_names
+        pk_cols = {p.lower() for p in s.primary_key}
+        earlier_gen: set = set()
+        meta = []
+        for c in s.columns:
+            n = c.name.lower()
+            if c.generated is None:
+                continue
+            txt, expr, stored = c.generated
+            try:
+                validate_expr_ops(expr)
+            except CheckEvalError as ex:
+                raise ValueError(f"generated column {n!r}: {ex}") from None
+            deps = check_columns(expr)
+            bad = deps - base_cols - earlier_gen
+            if bad:
+                # MySQL: a generated column may reference base columns
+                # anywhere but generated columns only if defined EARLIER
+                raise ValueError(
+                    f"generated column {n!r} references unknown or "
+                    f"later generated columns {sorted(bad)}"
+                )
+            if ai_name is not None and ai_name in deps:
+                raise ValueError(
+                    f"generated column {n!r} cannot depend on the "
+                    "AUTO_INCREMENT column"
+                )
+            if c.default is not None:
+                raise ValueError(
+                    f"generated column {n!r} cannot have a DEFAULT value"
+                )
+            if c.auto_increment:
+                raise ValueError(
+                    f"generated column {n!r} cannot be AUTO_INCREMENT"
+                )
+            if not stored and n in pk_cols:
+                raise ValueError(
+                    "virtual generated column cannot be a PRIMARY KEY "
+                    "(make it STORED)"
+                )
+            earlier_gen.add(n)
+            meta.append((n, txt, bool(stored)))
+        return meta
+
+    def _gen_exprs_for(self, t):
+        """[(col, parsed expr)] for a table's generated columns, parse
+        cached on the table (same idiom as _check_exprs_for)."""
+        gen = getattr(t, "generated", None) or []
+        cache = getattr(t, "_gen_exprs", None)
+        if cache is None or len(cache) != len(gen):
+            from tidb_tpu.parser.sqlparse import parse_expr
+
+            cache = t._gen_exprs = [
+                (col, parse_expr(txt)) for col, txt, _st in gen
+            ]
+        return cache
+
+    def _gen_coerce(self, v, typ):
+        if v is None:
+            return None
+        k = typ.kind
+        try:
+            if k == Kind.STRING:
+                return v if isinstance(v, str) else str(v)
+            if k == Kind.BOOL:
+                return bool(v)
+            if k == Kind.INT:
+                return int(round(float(v))) if not isinstance(v, bool) else int(v)
+            if k in (Kind.DECIMAL, Kind.FLOAT):
+                return float(v)
+        except (ValueError, TypeError):
+            return None
+        return v
+
+    def _fill_generated(self, t, rows) -> None:
+        """Compute generated columns into fully-formed Python rows (in
+        place), definition order so later generated columns may read
+        earlier ones."""
+        gen = self._gen_exprs_for(t)
+        if not gen or not rows:
+            return
+        from tidb_tpu.utils.checkeval import eval_check
+
+        names = t.schema.names
+        types = t.schema.types
+        idx = {n: i for i, n in enumerate(names)}
+        for r in rows:
+            vals = dict(zip(names, r))
+            for col, ex in gen:
+                v = self._gen_coerce(eval_check(ex, vals), types[col])
+                vals[col] = v
+                r[idx[col]] = v
+
+    def _reject_generated_targets(self, t, cols, verb: str) -> None:
+        gen = getattr(t, "generated", None) or []
+        hit = {c for c, _txt, _st in gen} & set(cols)
+        if hit:
+            raise ValueError(
+                f"cannot {verb} generated column(s) {sorted(hit)}"
+            )
+
+    def _recompute_generated(self, t) -> None:
+        """Re-evaluate every generated column over the whole table (host
+        rebuild, the same full-image protocol as the UPDATE fallback) —
+        run after a MODIFY COLUMN reorg converts a dependency."""
+        gen = self._gen_exprs_for(t)
+        if not gen or not t.blocks():
+            return
+        names = t.schema.names
+        rows = []
+        for b in t.blocks():
+            decs = [b.columns[n].decode() for n in names]
+            vals = [b.columns[n].valid for n in names]
+            for k in range(b.nrows):
+                rows.append(
+                    [
+                        decs[c][k] if vals[c][k] else None
+                        for c in range(len(names))
+                    ]
+                )
+        self._fill_generated(t, rows)
+        saved_blocks = list(t.blocks())
+        saved_dicts = dict(t.dictionaries)
+        t.replace_blocks([], modified_rows=len(rows))
+        try:
+            if rows:
+                t.append_rows(rows)
+        except Exception:
+            t.replace_blocks(saved_blocks, modified_rows=len(rows))
+            t.dictionaries = saved_dicts
+            raise
+        clear_scan_cache()
+
+    def _alter_add_generated(self, t, s) -> None:
+        """ALTER TABLE ADD COLUMN ... [GENERATED ALWAYS] AS (expr):
+        validate deps against existing columns, install the rule, and
+        backfill existing rows by evaluation (the write-reorg analog of
+        the stored-generated ADD, pkg/ddl/generated_column.go)."""
+        from tidb_tpu.utils.checkeval import (
+            CheckEvalError, check_columns, validate_expr_ops,
+        )
+
+        cd = s.column
+        n = cd.name.lower()
+        txt, expr, stored = cd.generated
+        if s.default is not None or cd.default is not None:
+            # same rule as the CREATE TABLE path
+            raise ValueError(
+                f"generated column {n!r} cannot have a DEFAULT value"
+            )
+        try:
+            validate_expr_ops(expr)
+        except CheckEvalError as ex:
+            raise ValueError(f"generated column {n!r}: {ex}") from None
+        deps = check_columns(expr)
+        bad = deps - set(t.schema.names)
+        if bad:
+            raise ValueError(
+                f"generated column {n!r} references unknown columns "
+                f"{sorted(bad)}"
+            )
+        if t.autoinc_col and t.autoinc_col in deps:
+            raise ValueError(
+                f"generated column {n!r} cannot depend on the "
+                "AUTO_INCREMENT column"
+            )
+        # existing generated columns are all defined earlier, so
+        # appending the new rule keeps the list dependency-ordered
+        t.alter_add_column(cd.name, cd.type, None)
+        gen = list(getattr(t, "generated", None) or [])
+        gen.append((n, txt, bool(stored)))
+        t.generated = gen
+        t._gen_exprs = None
+        self._recompute_generated(t)
 
     def _column_values(self, db: str, name: str, col: str) -> set:
         """All non-NULL values of a column at this session's read
@@ -3594,6 +4054,22 @@ class Session:
             rows.append(
                 [vals[n] if n in vals else dflt.get(n) for n in names]
             )
+        gen_cols = {c for c, *_ in getattr(t, "generated", None) or []}
+        if gen_cols:
+            # MySQL: inserting a value into a generated column is only
+            # allowed when it is DEFAULT/NULL (computed instead)
+            tgt = [(names.index(c), c) for c in gen_cols if c in cols]
+            for r in rows:
+                for gi, gc in tgt:
+                    if r[gi] is not None:
+                        raise ValueError(
+                            f"the value specified for generated column "
+                            f"{gc!r} is not allowed"
+                        )
+            if s.on_dup:
+                self._reject_generated_targets(
+                    t, [c.lower() for c, _e in s.on_dup], "assign"
+                )
         ac = t.autoinc_col
         if ac is not None:
             ai = names.index(ac)
@@ -3606,6 +4082,10 @@ class Session:
                 for k, r in enumerate(missing):
                     r[ai] = start + k
                 self.last_insert_id = start
+        # generated columns compute over the final base values — before
+        # ON DUPLICATE KEY (key lookups may hit an indexed generated
+        # column) and re-computed after its assignments below
+        self._fill_generated(t, rows)
         # constraints run over the final values (after autoinc fill) and
         # BEFORE the REPLACE delete — a failing row must not leave the
         # statement half-applied
@@ -3619,6 +4099,7 @@ class Session:
             rows, origin, n_upd = self._apply_on_dup(
                 t, db, names, rows, s.on_dup
             )
+            self._fill_generated(t, rows)
         if getattr(s, "ignore", False):
             before = len(rows)
             rows = self._filter_ignore(
@@ -3734,12 +4215,17 @@ class Session:
             if any((~m).any() for m in keep_masks):
                 t.delete_where(keep_masks)
 
-    @staticmethod
-    def _const_value(e):
+    def _const_value(self, e):
         if isinstance(e, ast.Const):
             return e.value
         if isinstance(e, ast.Call) and e.op == "neg" and isinstance(e.args[0], ast.Const):
             return -e.args[0].value
+        if isinstance(e, ast.Call) and e.op.lower() in (
+            "nextval", "lastval", "setval"
+        ):
+            # per-ROW evaluation: INSERT VALUES (nextval(s)), (nextval(s))
+            # advances once per row, like the reference
+            return self._seq_func(e)
         raise ValueError("INSERT VALUES must be literals")
 
     def _run_delete(self, s: ast.Delete) -> Result:
@@ -3840,6 +4326,7 @@ class Session:
             return self._run_update_multi(s)
         t = self._resolve_table_for_write(s.db or self.db, s.table)
         sets = {c.lower(): e for c, e in s.sets}
+        self._reject_generated_targets(t, sets, "SET")
         fast = self._try_columnar_update(t, s, sets)
         if fast is not None:
             return fast
@@ -3875,6 +4362,7 @@ class Session:
             sel = dataclasses.replace(sel, items=new_items)
         r = self._run_select(sel)
         rows = [list(row) for row in r.rows]
+        self._fill_generated(t, rows)
         db = s.db or self.db
         # ``rows`` is the table's complete post-statement image: child
         # FK + CHECK validate the new rows, parent-side constraints
@@ -4020,6 +4508,13 @@ class Session:
             rc for _, _, _, _, rc, _a in
             self._fk_children(s.db or self.db, s.table)
         }
+        # generated-column dependencies: a SET on a base column must
+        # recompute dependents, which needs the full-row rewrite path
+        if getattr(t, "generated", None):
+            from tidb_tpu.utils.checkeval import check_columns
+
+            for _col, ex in self._gen_exprs_for(t):
+                relevant |= check_columns(ex)
         # PK/UNIQUE columns: the scatter path bypasses append-time
         # uniqueness checks, so key-touching SETs take the rewrite path
         relevant |= set(self._unique_key_cols(t))
@@ -4274,6 +4769,10 @@ class Session:
                         raise ValueError(f"stale row handle {h} in UPDATE")
                     for (c, _e), v in zip(per[alias], new):
                         rows[h][cidx[c]] = v
+                self._reject_generated_targets(
+                    t, [c for c, _e in per[alias]], "SET"
+                )
+                self._fill_generated(t, rows)
                 self._enforce_write_constraints(t, db, rows)
                 # rows[] was built FROM t.blocks() in scan order, so the
                 # pre/post alignment the guard needs is exact

@@ -91,6 +91,10 @@ class Catalog:
                 raise ValueError(f"table {name!r} exists")
             if name in self._views.get(db, {}):
                 raise ValueError(f"view {name!r} exists")
+            if name in self._seqs.get(db, {}):
+                # sequences share the schema-object namespace
+                # (reference: pkg/ddl/sequence.go)
+                raise ValueError(f"sequence {name!r} exists")
             t = Table(name, schema)
             self._dbs[db][name] = t
             self.schema_version += 1
@@ -177,6 +181,8 @@ class Catalog:
                 raise ValueError(f"unknown database {db!r}")
             if name in self._dbs[db]:
                 raise ValueError(f"table {name!r} exists")
+            if name in self._seqs.get(db, {}):
+                raise ValueError(f"sequence {name!r} exists")
             if name in self._views[db] and not or_replace:
                 raise ValueError(f"view {name!r} exists")
             self._views[db][name] = (
@@ -193,6 +199,50 @@ class Catalog:
                 raise ValueError(f"unknown view {db}.{name}")
             del self._views[db][name]
             self.schema_version += 1
+
+    # -- sequences ---------------------------------------------------------
+    # (reference: pkg/ddl/sequence.go:30 — sequences are schema objects
+    # in the same namespace as tables/views)
+    @property
+    def _seqs(self):
+        s = getattr(self, "_sequences", None)
+        if s is None:
+            s = self._sequences = {}
+        return s
+
+    def create_sequence(self, db: str, name: str, seq, if_not_exists=False):
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if db not in self._dbs:
+                raise ValueError(f"unknown database {db!r}")
+            if name in self._dbs[db] or name in self._views.get(db, {}):
+                raise ValueError(f"table or view {name!r} exists")
+            if name in self._seqs.setdefault(db, {}):
+                if if_not_exists:
+                    return self._seqs[db][name]
+                raise ValueError(f"sequence {name!r} exists")
+            self._seqs[db][name] = seq
+            self.schema_version += 1
+            return seq
+
+    def drop_sequence(self, db: str, name: str, if_exists=False) -> None:
+        db, name = db.lower(), name.lower()
+        with self._lock:
+            if name not in self._seqs.get(db, {}):
+                if if_exists:
+                    return
+                raise ValueError(f"unknown sequence {db}.{name}")
+            del self._seqs[db][name]
+            self.schema_version += 1
+
+    def sequence(self, db: str, name: str):
+        s = self._seqs.get(db.lower(), {}).get(name.lower())
+        if s is None:
+            raise ValueError(f"unknown sequence {db}.{name}")
+        return s
+
+    def sequences(self, db: str) -> List[str]:
+        return sorted(self._seqs.get(db.lower(), {}))
 
     def view_def(self, db: str, name: str):
         """(sql, columns-or-None) for a view, else None."""
@@ -242,6 +292,7 @@ class Catalog:
     _IS_TABLES = (
         "tables", "columns", "schemata", "statistics", "slow_query",
         "statements_summary", "metrics", "top_sql", "resource_groups",
+        "sequences",
     )
 
     def _infoschema_table(self, name: str) -> Table:
@@ -334,6 +385,25 @@ class Catalog:
                             nu = 0 if iname in t0.unique_indexes else 1
                             for i, cn in enumerate(t0.indexes[iname], 1):
                                 rows.append((db, tn, iname, i, cn, nu))
+        elif name == "sequences":
+            # "start_value" (not the reference's START): START is a
+            # reserved word in this parser and would be unselectable
+            schema = TableSchema(
+                [("sequence_schema", STRING), ("sequence_name", STRING),
+                 ("start_value", INT64), ("increment", INT64),
+                 ("min_value", INT64), ("max_value", INT64),
+                 ("cycle", INT64), ("cache", INT64)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._seqs):
+                    for sn in sorted(self._seqs[db]):
+                        m = self._seqs[db][sn].meta()
+                        rows.append(
+                            (db, sn, m["start"], m["increment"],
+                             m["minvalue"], m["maxvalue"],
+                             int(m["cycle"]), m["cache"])
+                        )
         elif name == "schemata":
             schema = TableSchema([("schema_name", STRING)])
             with self._lock:

@@ -96,6 +96,10 @@ def table_meta_to_json(t) -> Dict:
         "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
         "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
         "json_cols": list(t.schema.json_cols),
+        "defaults": dict(getattr(t, "defaults", None) or {}) or None,
+        "generated": [
+            list(g) for g in (getattr(t, "generated", None) or [])
+        ] or None,
     }
 
 
@@ -138,6 +142,11 @@ def apply_table_meta(t, meta: Dict) -> None:
     t.fks = [tuple(f) for f in (meta.get("fks") or [])]
     t.fk_actions = dict(meta.get("fk_actions") or {})
     t.fk_update_actions = dict(meta.get("fk_update_actions") or {})
+    t.defaults = dict(meta.get("defaults") or {})
+    t.generated = [
+        (g[0], g[1], bool(g[2])) for g in (meta.get("generated") or [])
+    ]
+    t._gen_exprs = None
 
 
 def schemas_equivalent(a, b) -> bool:
@@ -193,6 +202,7 @@ def save_catalog(
         manifest["users"] = users.to_manifest()
     want = {d.lower() for d in dbs} if dbs else None
     manifest.setdefault("views", {})
+    manifest.setdefault("sequences", {})
     for db in catalog.databases():
         if db.startswith("_") or (want is not None and db.lower() not in want):
             continue
@@ -200,6 +210,10 @@ def save_catalog(
         for vn in catalog.views(db):
             vsql, vcols = catalog.view_def(db, vn)
             manifest["views"][db][vn] = [vsql, list(vcols) if vcols else None]
+        manifest["sequences"][db] = {
+            sn: catalog.sequence(db, sn).meta()
+            for sn in catalog.sequences(db)
+        }
     for db in catalog.databases():
         if db.startswith("_"):  # scratch schemas (recursive CTE temps)
             continue
@@ -303,4 +317,16 @@ def load_catalog(path: str, catalog: Catalog = None, dbs=None) -> Catalog:
         catalog.create_database(db, if_not_exists=True)
         for vn, (vsql, vcols) in views.items():
             catalog.create_view(db, vn, vsql, vcols, or_replace=True)
+    for db, seqs in manifest.get("sequences", {}).items():
+        if want is not None and db.lower() not in want:
+            continue
+        catalog.create_database(db, if_not_exists=True)
+        from tidb_tpu.storage.sequence import Sequence
+
+        for sn, meta in seqs.items():
+            try:
+                catalog.drop_sequence(db, sn, if_exists=True)
+            except Exception:
+                pass
+            catalog.create_sequence(db, sn, Sequence.from_meta(sn, meta))
     return catalog

@@ -1,0 +1,114 @@
+"""SEQUENCE objects: monotonic value allocators with cycle support.
+
+Reference: pkg/ddl/sequence.go:30 (onCreateSequence) + pkg/meta/autoid
+(the sequence allocator: batched cache allocation against meta-KV,
+SequenceAllocator.Alloc). In-process the allocation batch is a lock
+instead of a KV round-trip; `cache` is kept as metadata (SHOW CREATE
+parity) — all allocations are exact, so cached-vs-uncached is
+unobservable single-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class SequenceExhausted(ValueError):
+    pass
+
+
+class Sequence:
+    def __init__(
+        self,
+        name: str,
+        start: int = 1,
+        increment: int = 1,
+        minvalue: Optional[int] = None,
+        maxvalue: Optional[int] = None,
+        cycle: bool = False,
+        cache: int = 1000,
+    ):
+        if increment == 0:
+            raise ValueError("sequence INCREMENT must be non-zero")
+        self.name = name
+        self.increment = int(increment)
+        # reference defaults: ascending sequences run [1, 2^63-1],
+        # descending [-2^63+1, -1] (pkg/parser/model sequence defaults)
+        if increment > 0:
+            self.minvalue = int(minvalue) if minvalue is not None else 1
+            self.maxvalue = (
+                int(maxvalue) if maxvalue is not None else (1 << 63) - 1
+            )
+        else:
+            self.minvalue = (
+                int(minvalue) if minvalue is not None else -(1 << 63) + 1
+            )
+            self.maxvalue = int(maxvalue) if maxvalue is not None else -1
+        if self.minvalue > self.maxvalue:
+            raise ValueError("sequence MINVALUE exceeds MAXVALUE")
+        self.start = int(start) if start is not None else self.minvalue
+        if not (self.minvalue <= self.start <= self.maxvalue):
+            raise ValueError("sequence START outside [MINVALUE, MAXVALUE]")
+        self.cycle = bool(cycle)
+        self.cache = int(cache)
+        self._next: Optional[int] = self.start  # None = exhausted
+        self._lock = threading.Lock()
+
+    def nextval(self) -> int:
+        with self._lock:
+            if self._next is None:
+                raise SequenceExhausted(
+                    f"sequence {self.name!r} has run out"
+                )
+            v = self._next
+            n = v + self.increment
+            if n > self.maxvalue or n < self.minvalue:
+                if self.cycle:
+                    # reference: cycling restarts from MINVALUE
+                    # (ascending) / MAXVALUE (descending), not START
+                    n = self.minvalue if self.increment > 0 else self.maxvalue
+                else:
+                    n = None
+            self._next = n
+            return v
+
+    def setval(self, v: int) -> int:
+        """SETVAL(seq, v): the next nextval returns a value past v
+        (reference: sequence setval semantics — sets the current value;
+        out-of-range re-arms exhaustion/cycle on the next call)."""
+        with self._lock:
+            n = int(v) + self.increment
+            if n > self.maxvalue or n < self.minvalue:
+                if self.cycle:
+                    n = self.minvalue if self.increment > 0 else self.maxvalue
+                else:
+                    n = None
+            self._next = n
+            return int(v)
+
+    def meta(self) -> dict:
+        with self._lock:
+            return {
+                "start": self.start,
+                "increment": self.increment,
+                "minvalue": self.minvalue,
+                "maxvalue": self.maxvalue,
+                "cycle": self.cycle,
+                "cache": self.cache,
+                "next": self._next,
+            }
+
+    @classmethod
+    def from_meta(cls, name: str, m: dict) -> "Sequence":
+        s = cls(
+            name,
+            start=m["start"],
+            increment=m["increment"],
+            minvalue=m["minvalue"],
+            maxvalue=m["maxvalue"],
+            cycle=m["cycle"],
+            cache=m.get("cache", 1000),
+        )
+        s._next = m.get("next", s.start)
+        return s

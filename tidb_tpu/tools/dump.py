@@ -62,6 +62,12 @@ def create_table_sql(t) -> str:
             decl = _TYPE_SQL.get(ty.kind, "varchar(255)")
         if n == t.autoinc_col:
             decl += " auto_increment"
+        for gc, gtxt, gstored in getattr(t, "generated", None) or []:
+            if gc == n:
+                decl += (
+                    f" generated always as ({gtxt}) "
+                    + ("stored" if gstored else "virtual")
+                )
         parts.append(f"`{n}` {decl}")
     if t.schema.primary_key:
         parts.append(
@@ -139,27 +145,37 @@ def _decoded_rows(t):
 
 
 def dump_table_sql(t, out_path: str, batch_rows: int = 500) -> int:
-    """Write schema + INSERT batches for one table; returns row count."""
+    """Write schema + INSERT batches for one table; returns row count.
+    Generated columns are omitted from the INSERTs (mysqldump does the
+    same): the restore recomputes them, and inserting explicit values
+    into generated columns is rejected."""
     n = 0
+    gen = {c for c, *_ in (getattr(t, "generated", None) or [])}
+    names = t.schema.names
+    keep = [i for i, c in enumerate(names) if c not in gen]
+    collist = (
+        " (" + ", ".join(f"`{names[i]}`" for i in keep) + ")" if gen else ""
+    )
     with open(out_path, "w", encoding="utf-8") as f:
         f.write(create_table_sql(t) + "\n")
         batch: List[str] = []
         for row, types in _decoded_rows(t):
             batch.append(
                 "(" + ", ".join(
-                    _sql_literal(v, ty) for v, ty in zip(row, types)
+                    _sql_literal(row[i], types[i]) for i in keep
                 ) + ")"
             )
             n += 1
             if len(batch) >= batch_rows:
                 f.write(
-                    f"INSERT INTO `{t.name}` VALUES\n"
+                    f"INSERT INTO `{t.name}`{collist} VALUES\n"
                     + ",\n".join(batch) + ";\n"
                 )
                 batch = []
         if batch:
             f.write(
-                f"INSERT INTO `{t.name}` VALUES\n" + ",\n".join(batch) + ";\n"
+                f"INSERT INTO `{t.name}`{collist} VALUES\n"
+                + ",\n".join(batch) + ";\n"
             )
     return n
 

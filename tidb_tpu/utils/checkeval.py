@@ -173,7 +173,108 @@ def eval_check(e, row: dict) -> Optional[bool]:
             raise CheckEvalError(
                 f"CHECK arithmetic on incompatible values {a!r}, {b!r}"
             )
+    if op == "case":
+        # [c1, v1, c2, v2, ..., else?] (kernels.py CASE layout)
+        args = list(e.args)
+        else_e = args.pop() if len(args) % 2 == 1 else None
+        for i in range(0, len(args), 2):
+            if _truth(eval_check(args[i], row)) is True:
+                return eval_check(args[i + 1], row)
+        return eval_check(else_e, row) if else_e is not None else None
+    if op == "if":
+        c = _truth(eval_check(e.args[0], row))
+        return eval_check(e.args[1] if c is True else e.args[2], row)
+    if op == "ifnull":
+        v = eval_check(e.args[0], row)
+        return eval_check(e.args[1], row) if v is None else v
+    if op == "nullif":
+        a, b = (eval_check(x, row) for x in e.args)
+        return None if a == b else a
+    if op in _SCALAR:
+        vals = [eval_check(a, row) for a in e.args]
+        return _SCALAR[op](vals)
     raise CheckEvalError(f"unsupported function {op!r} in CHECK")
+
+
+def _s_concat(vals):
+    if any(v is None for v in vals):
+        return None
+    return "".join(_sqlstr(v) for v in vals)
+
+
+def _sqlstr(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return str(v)
+
+
+def _null_in(f):
+    """Wrap an all-args scalar: any NULL argument yields NULL."""
+
+    def g(vals):
+        if any(v is None for v in vals):
+            return None
+        return f(vals)
+
+    return g
+
+
+def _substr(vals):
+    s, pos = _sqlstr(vals[0]), int(vals[1])
+    ln = int(vals[2]) if len(vals) > 2 else None
+    if pos == 0:
+        return ""
+    i = pos - 1 if pos > 0 else len(s) + pos
+    if i < 0:
+        return ""
+    out = s[i:]
+    if ln is not None:
+        out = out[: max(ln, 0)]
+    return out
+
+
+# Scalar functions shared by CHECK constraints and generated-column
+# evaluation (reference: the deterministic builtin subset allowed in
+# generated column expressions, pkg/ddl/generated_column.go:125 +
+# pkg/expression/util.go IsAllowedInGeneratedColumn). All NULL-in ->
+# NULL-out except where noted.
+_SCALAR = {
+    "concat": _s_concat,
+    "upper": _null_in(lambda v: _sqlstr(v[0]).upper()),
+    "ucase": _null_in(lambda v: _sqlstr(v[0]).upper()),
+    "lower": _null_in(lambda v: _sqlstr(v[0]).lower()),
+    "lcase": _null_in(lambda v: _sqlstr(v[0]).lower()),
+    "length": _null_in(lambda v: len(_sqlstr(v[0]).encode())),
+    "char_length": _null_in(lambda v: len(_sqlstr(v[0]))),
+    "character_length": _null_in(lambda v: len(_sqlstr(v[0]))),
+    "substr": _null_in(_substr),
+    "substring": _null_in(_substr),
+    "left": _null_in(lambda v: _sqlstr(v[0])[: max(int(v[1]), 0)]),
+    "right": _null_in(
+        lambda v: _sqlstr(v[0])[-max(int(v[1]), 0):] if int(v[1]) > 0 else ""
+    ),
+    "trim": _null_in(lambda v: _sqlstr(v[0]).strip(" ")),
+    "abs": _null_in(lambda v: abs(v[0])),
+    "round": _null_in(
+        lambda v: _mysql_round(v[0], int(v[1]) if len(v) > 1 else 0)
+    ),
+    "floor": _null_in(lambda v: int(__import__("math").floor(v[0]))),
+    "ceil": _null_in(lambda v: int(__import__("math").ceil(v[0]))),
+    "ceiling": _null_in(lambda v: int(__import__("math").ceil(v[0]))),
+    "least": _null_in(lambda v: min(v)),
+    "greatest": _null_in(lambda v: max(v)),
+}
+
+
+def _mysql_round(x, d: int):
+    """Round half away from zero (MySQL), not banker's rounding."""
+    import math
+
+    m = 10.0**d
+    r = math.floor(abs(x) * m + 0.5) / m * (1 if x >= 0 else -1)
+    return int(r) if d <= 0 and not isinstance(x, float) else r
 
 
 def check_columns(e, out=None) -> set:
@@ -190,3 +291,36 @@ def check_columns(e, out=None) -> set:
             f"unsupported construct in CHECK: {type(e).__name__}"
         )
     return out
+
+
+_STRUCT_OPS = frozenset(
+    {
+        "and", "or", "not", "isnull", "isnotnull", "neg", "bit_neg",
+        "in", "like", "coalesce", "case", "if", "ifnull", "nullif",
+    }
+)
+
+
+def validate_expr_ops(e) -> None:
+    """Statically verify every node of an expression is evaluable by
+    eval_check — used at DDL time so a generated column / CHECK with an
+    unsupported function is rejected at CREATE, not at first INSERT
+    (the reference whitelists generated-column builtins the same way,
+    pkg/expression/util.go IsAllowedInGeneratedColumn). Raises
+    CheckEvalError on the first unsupported construct."""
+    if isinstance(e, (ast.Const, ast.Name)):
+        return
+    if not isinstance(e, ast.Call):
+        raise CheckEvalError(
+            f"unsupported construct: {type(e).__name__}"
+        )
+    op = e.op
+    if (
+        op not in _CMP
+        and op not in _ARITH
+        and op not in _SCALAR
+        and op not in _STRUCT_OPS
+    ):
+        raise CheckEvalError(f"unsupported function {op!r}")
+    for a in e.args:
+        validate_expr_ops(a)
