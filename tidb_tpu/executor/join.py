@@ -35,6 +35,14 @@ import jax.numpy as jnp
 from tidb_tpu.chunk import Batch, DevCol
 from tidb_tpu.executor.aggregate import WIDTH_STALE
 
+
+def _fr_count(mask):
+    """Valid-row count via fastreduce (GEMV on CPU, jnp.sum elsewhere —
+    the backend gate lives inside fastreduce.count)."""
+    from tidb_tpu.executor.fastreduce import count
+
+    return count(mask)
+
 ExprFn = Callable[[Batch], DevCol]
 
 
@@ -172,7 +180,7 @@ def equi_join(
             if join_type == "anti":
                 keep = keep | (~pvalid & probe.row_valid)
             out = Batch(probe.cols, probe.row_valid & keep)
-        total = jnp.sum(out.row_valid.astype(jnp.int64))
+        total = _fr_count(out.row_valid)
         return out, jnp.where(stale, jnp.int64(WIDTH_STALE), total)
 
     if join_type in ("inner", "left") and span is not None and build_unique:
@@ -259,7 +267,7 @@ def equi_join(
             cols = dict(probe.cols)
             cols[mark_name] = DevCol(matched, mvalid)
             out = Batch(cols, probe.row_valid)
-            return out, jnp.sum(out.row_valid.astype(jnp.int64))
+            return out, _fr_count(out.row_valid)
         keep = matched if join_type == "semi" else (~matched & probe.row_valid & pvalid)
         if join_type == "anti":
             # NULL probe key in NOT IN/anti: row never matches but with a
@@ -268,7 +276,7 @@ def equi_join(
             # NOT EXISTS keeps it; planner selects via null_aware flag.
             keep = keep | (~pvalid & probe.row_valid)
         out = Batch(probe.cols, probe.row_valid & keep)
-        return out, jnp.sum(out.row_valid.astype(jnp.int64))
+        return out, _fr_count(out.row_valid)
 
     # ---- inner / left: sort build side, carry permutation ----
     sort_out = jax.lax.sort(

@@ -63,7 +63,15 @@ def _partial_descs(
             final.append(("sum", a.out_name, [pname], 0, None))
         elif a.func == "sum":
             pname = f"_p{i}"
-            partial.append(AggDesc("sum", a.arg, pname, wide=a.wide))
+            # pack_bound holds at the partial stage (per-row bound);
+            # the FINAL stage sums partial sums, whose bound is not
+            # per-row — it stays unpacked (default None)
+            partial.append(
+                AggDesc(
+                    "sum", a.arg, pname, wide=a.wide,
+                    pack_bound=a.pack_bound,
+                )
+            )
             final.append(("sum", a.out_name, [pname], 0, None))
         elif a.func in ("min", "max"):
             # the partial stage keeps encoded values (a.post decodes
@@ -75,7 +83,12 @@ def _partial_descs(
             final.append((a.func, a.out_name, [pname], 0, a.post))
         elif a.func == "avg":
             sname, cname = f"_ps{i}", f"_pc{i}"
-            partial.append(AggDesc("sum", a.arg, sname, wide=a.wide))
+            partial.append(
+                AggDesc(
+                    "sum", a.arg, sname, wide=a.wide,
+                    pack_bound=a.pack_bound,
+                )
+            )
             partial.append(AggDesc("count", a.arg, cname))
             final.append(("avg2", a.out_name, [sname, cname], a.arg_scale, None))
         else:

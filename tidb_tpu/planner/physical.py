@@ -594,10 +594,14 @@ def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
         # int64 accumulation at SF100 row counts: use the dual-lane
         # wide accumulator (AggDesc.wide)
         wide = func in ("sum", "avg") and scale >= 4
-        if wide and compiler is not None:
+        pack_bound = None
+        if func in ("sum", "avg") and compiler is not None and arg is not None:
             r = _expr_abs_bound(arg, dicts)
             # 2^31 rows is past any single-program tile (int32 row
-            # indexing); bound * 2^31 < 2^62 proves no int64 wraparound
+            # indexing); bound * 2^31 < 2^62 proves no int64 wraparound.
+            # The same proof funds the packed (sum,count) single-pass
+            # reduction (AggDesc.pack_bound) for ALL integer sums —
+            # re-verified against live storage bounds at every fetch.
             if r is not None and r[0] < (1 << 31) and all(
                 lb.nid is not None for lb in r[1]
             ):
@@ -607,6 +611,7 @@ def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
                         (lb.nid, lb.col, int(cb[0]), int(cb[1]))
                     )
                 wide = False
+                pack_bound = int(r[0])
         # DISTINCT is a no-op for min/max (duplicate-insensitive); for
         # sum/avg/count the kernel dedupes via representative-row masks
         # (executor/aggregate._distinct_reps)
@@ -630,7 +635,7 @@ def build_agg_parts(plan: "L.Aggregate", dicts, compiler=None):
         descs.append(
             AggDesc(
                 func, fn, name, distinct=d, arg_scale=scale, wide=wide,
-                post=post,
+                post=post, pack_bound=pack_bound,
             )
         )
     return key_fns, key_names, key_widths, descs
@@ -970,6 +975,24 @@ class PlanCompiler:
             return fn_scan, dicts
 
         if isinstance(plan, L.Selection):
+            if (
+                isinstance(plan.child, L.Aggregate)
+                and plan.child.group_exprs
+                and not plan.child.gc_meta
+            ):
+                names = {n for n, _ in plan.child.group_exprs} | {
+                    n for n, _f, _a, _d in plan.child.aggs
+                }
+                pc = _bound_pred_cols(plan.predicate)
+                if pc is not None and pc <= names:
+                    # HAVING fusion: evaluate the predicate inside the
+                    # aggregation kernel — the dense path then compacts
+                    # only surviving groups, so the discovered output
+                    # tile (and every downstream operator's capacity)
+                    # shrinks to the survivor count
+                    return self._build_aggregate(
+                        plan.child, post_pred=plan.predicate
+                    )
             if isinstance(plan.child, L.Scan) and not self.mesh_n:
                 self._pending_range = _extract_pk_range(
                     plan.predicate, plan.child, self.resolver
@@ -1201,7 +1224,7 @@ class PlanCompiler:
         raise ExecError(f"no physical impl for {type(plan).__name__}")
 
     # ------------------------------------------------------------------
-    def _build_aggregate(self, plan: L.Aggregate):
+    def _build_aggregate(self, plan: L.Aggregate, post_pred=None):
         child, dicts = self._build(plan.child)
         child_tag = self._tag
         nid = self.fresh_id()
@@ -1214,6 +1237,11 @@ class PlanCompiler:
         scalar = not plan.group_exprs
         agg_names = [(n, f) for n, f, _a, _d in plan.aggs]
         mesh_n = self.mesh_n if child_tag == "shard" else None
+        post_fn = (
+            compile_expr(post_pred, agg_out_dicts(plan, dicts))
+            if post_pred is not None
+            else None
+        )
         if mesh_n:
             # partial agg per shard -> all_to_all of group rows -> final
             # agg; groups end hash-sharded (keyed) / replicated (scalar)
@@ -1232,9 +1260,19 @@ class PlanCompiler:
                 ngroups = jnp.maximum(
                     total, (dropped > 0).astype(total.dtype) * xneed
                 )
+                if post_fn is not None:
+                    # distributed path: the fused HAVING applies as a
+                    # row mask on the final (hash-sharded) groups —
+                    # semantically the Selection node it replaced
+                    c = post_fn(out)
+                    out = Batch(
+                        out.cols,
+                        out.row_valid & c.valid & (c.data != 0),
+                    )
             else:
                 out, ngroups = group_aggregate(
-                    b, key_fns, descs, cap, key_names, key_widths=key_widths
+                    b, key_fns, descs, cap, key_names,
+                    key_widths=key_widths, post_filter=post_fn,
                 )
             if scalar:
                 # MySQL: scalar aggregation over empty input yields one
@@ -2239,7 +2277,7 @@ def _steady_step(program, out_cap, inputs, params=None, mesh=None):
     expressible over a row-sharded operand)."""
     out, needs = program(inputs, params)
     needs = dict(needs)
-    needs[_OUT_NODE] = jnp.sum(out.row_valid.astype(jnp.int32))
+    needs[_OUT_NODE] = _count_valid(out.row_valid)
     if out_cap < out.capacity:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -2319,7 +2357,9 @@ def _node_label(plan: L.LogicalPlan) -> str:
 
 @jax.jit
 def _count_valid(row_valid: jax.Array) -> jax.Array:
-    return jnp.sum(row_valid.astype(jnp.int32))
+    from tidb_tpu.executor.fastreduce import count
+
+    return count(row_valid)
 
 
 def _compact_impl(batch: Batch, out_cap: int) -> Batch:
@@ -2335,6 +2375,37 @@ def _compact_impl(batch: Batch, out_cap: int) -> Batch:
         n: DevCol(c.data[perm], c.valid[perm]) for n, c in batch.cols.items()
     }
     return Batch(cols, (~sorted_ops[0][:out_cap].astype(bool)))
+
+
+def _bound_pred_cols(e):
+    """Column names referenced by a bound predicate, or None when the
+    tree contains nodes other than ColumnRef/Func/Literal (bail from
+    HAVING fusion rather than guess)."""
+    from tidb_tpu.expression.expr import Func, Literal
+
+    out: set = set()
+
+    def walk(x):
+        if isinstance(x, ColumnRef):
+            out.add(x.name)
+        elif isinstance(x, Func):
+            for a in x.args:
+                if isinstance(a, (ColumnRef, Func, Literal)):
+                    walk(a)
+                elif isinstance(a, Expr):
+                    raise _PredBail
+        elif not isinstance(x, Literal):
+            raise _PredBail
+
+    try:
+        walk(e)
+    except _PredBail:
+        return None
+    return out
+
+
+class _PredBail(Exception):
+    pass
 
 
 def _key_width(e: Expr, dicts: Dicts):
