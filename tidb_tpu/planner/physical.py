@@ -216,6 +216,10 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
                 + repr(p.residual)
                 + str(p.null_aware)
                 + str(p.broadcast)
+                # mark joins: the mark column name is part of the output
+                # schema — without it two same-shaped IN-subqueries would
+                # collide in the subtree memo (_build)
+                + str(getattr(p, "mark_name", None))
             )
         elif isinstance(p, L.Sort):
             parts.append(repr(p.keys))
@@ -227,11 +231,56 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
             parts.append(f"{p.count},{p.offset}")
         elif isinstance(p, L.Staged):
             parts.append(f"staged#{p.nonce}")
-        for c in _plan_children(p):
+        kids = _plan_children(p)
+        # child count disambiguates flat vs nested n-ary nodes
+        # (UnionAll([U([A,B]),C]) vs UnionAll([U([A,B,C])]))
+        parts.append(f"#{len(kids)}")
+        for c in kids:
             walk(c)
 
     walk(plan)
     return "|".join(parts)
+
+
+def _worth_sharing(plan) -> bool:
+    """Subtrees worth memoizing for common-subtree sharing: a join or
+    aggregate anywhere beneath (cheap nodes cost less than the
+    fingerprint), and never a bare Scan root (pending pushdown state)."""
+    if isinstance(plan, L.Scan):
+        return False
+
+    def heavy(p):
+        if isinstance(p, (L.JoinPlan, L.Aggregate, L.Window, L.Sort)):
+            return True
+        return any(heavy(c) for c in _plan_children(p))
+
+    return heavy(plan)
+
+
+def _share_result(fn, registry=None):
+    """Per-trace result memo: when the same compiled subtree fn is
+    invoked twice with the same (inputs, caps) — two call sites sharing
+    one memo entry — the second call returns the FIRST call's traced
+    arrays, so the jaxpr (and the compiled program) contains one copy
+    of the subtree's work. Keyed by inputs-dict identity (fresh per
+    trace/execution) + the static caps; holds only the latest entry."""
+    memo: list = []
+
+    def shared(inputs, caps):
+        # (registered in the compiler's _share_memos; the root fn wipes
+        # every memo after each invocation — see compile())
+        capskey = tuple(sorted(caps.items()))
+        for (kin, kcaps), v in memo:
+            if kin is inputs and kcaps == capskey:
+                return v
+        v = fn(inputs, caps)
+        del memo[:]
+        memo.append(((inputs, capskey), v))
+        return v
+
+    if registry is not None:
+        registry.append(memo)
+    return shared
 
 
 def _plan_children(p) -> List[L.LogicalPlan]:
@@ -765,6 +814,9 @@ class PlanCompiler:
         self.instrument = instrument
         self.nonnull: List[Tuple[int, str]] = []
         self.bound_checks: List[Tuple[int, str, int, int]] = []
+        # fingerprint -> (shared fn, dicts, tag): see _build
+        self._subtree_memo: dict = {}
+        self._share_memos: list = []  # per-trace result memos to wipe
         self.node_labels: List[Tuple[int, int, str]] = []  # (nid, depth, label)
         self.stats: Dict[int, Dict[str, float]] = {}
         self._depth = 0
@@ -819,6 +871,23 @@ class PlanCompiler:
         return child
 
     def _build(self, plan: L.LogicalPlan):
+        # Common-subtree sharing: structurally identical subtrees that
+        # contain a join or aggregate (inlined WITH/CTE references used
+        # from several IN-subqueries — Q95's ws_wh shape) compile ONCE;
+        # the second call site reuses the first's traced result, so the
+        # XLA program contains one copy of the work. (Reference: CTE
+        # materialization, pkg/planner/core/logical_plan_builder.go
+        # buildWith — there a disk spool, here graph sharing inside one
+        # program.) Bare scans never memoize: their build consumes the
+        # caller's pending range/partition pushdown state.
+        fp = None
+        if _worth_sharing(plan):
+            fp = plan_fingerprint(plan)
+            hit = self._subtree_memo.get(fp)
+            if hit is not None:
+                fn, dicts, tag = hit
+                self._tag = tag
+                return fn, dicts
         nid = self.fresh_id()
         self.node_labels.append((nid, self._depth, _node_label(plan)))
         self._depth += 1
@@ -826,6 +895,22 @@ class PlanCompiler:
         self._depth -= 1
         if self.instrument:
             fn = self._wrap(nid, fn)
+        if fp is not None:
+            fn = _share_result(fn, registry=self._share_memos)
+            self._subtree_memo[fp] = (fn, dicts, self._tag)
+        if self._depth == 0 and self._share_memos:
+            # root of the build (compile() and the streamed pipeline
+            # builder both enter here at depth 0): wipe every per-trace
+            # result memo after each invocation — a retained entry would
+            # pin the previous run's input batches or leak tracers
+            inner, memos = fn, list(self._share_memos)
+
+            def fn(inputs, caps, _f=inner, _m=memos):
+                try:
+                    return _f(inputs, caps)
+                finally:
+                    for mm in _m:
+                        del mm[:]
         return fn, dicts
 
     def _wrap(self, nid: int, fn):
