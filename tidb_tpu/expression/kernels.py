@@ -555,7 +555,154 @@ def _json_path_get(doc, path: str):
     return cur
 
 
-_JSON_STR_FUNCS = {"json_extract", "json_unquote", "json_type", "json_keys"}
+_JSON_STR_FUNCS = {
+    "json_extract", "json_unquote", "json_type", "json_keys",
+    # mutation family (reference pkg/expression/builtin_json.go): the
+    # doc rides a dictionary column; paths and new values are baked
+    # constants, so each function is one host pass over the dictionary
+    "json_set", "json_insert", "json_replace", "json_remove",
+    "json_merge_patch", "json_merge_preserve", "json_merge",
+    "json_array_append", "json_array_insert", "json_pretty",
+    "json_search",
+}
+
+
+def _json_path_parts(path: str):
+    """'$.a[0].b' -> ['a', 0, 'b']; '$' -> []. Raises on wildcards."""
+    import re as _re
+
+    if not path.startswith("$"):
+        raise NotImplementedError(f"bad JSON path {path!r}")
+    if "*" in path:
+        raise NotImplementedError("JSON path wildcards")
+    parts: list = []
+    pos = 0
+    body = path[1:]
+    # segments must tile the whole path — a partial match would silently
+    # mutate the wrong location (MySQL raises ER_INVALID_JSON_PATH)
+    seg = _re.compile(r"\.(\w+)|\.\"([^\"]+)\"|\[(\d+)\]")
+    while pos < len(body):
+        m = seg.match(body, pos)
+        if m is None:
+            raise NotImplementedError(f"invalid JSON path {path!r}")
+        if m.group(3) is not None:
+            parts.append(int(m.group(3)))
+        else:
+            parts.append(m.group(1) or m.group(2))
+        pos = m.end()
+    return parts
+
+
+def _json_set_path(doc, parts, value, mode):
+    """Set/insert/replace `value` at `parts` in doc (in place where
+    possible); mode in {'set','insert','replace','array_insert',
+    'array_append'}. MySQL semantics: missing intermediate paths are
+    created only for trailing member sets; out-of-range array indexes
+    append."""
+    if not parts:
+        return value if mode in ("set", "replace") else doc
+    cur = doc
+    for p in parts[:-1]:
+        nxt = None
+        if isinstance(p, int):
+            if isinstance(cur, list) and p < len(cur):
+                nxt = cur[p]
+        elif isinstance(cur, dict) and p in cur:
+            nxt = cur[p]
+        if nxt is None or not isinstance(nxt, (dict, list)):
+            return doc  # unreachable path: no-op (MySQL)
+        cur = nxt
+    last = parts[-1]
+    if mode == "array_append":
+        tgt = None
+        if isinstance(last, int):
+            tgt = cur[last] if isinstance(cur, list) and last < len(cur) else None
+        elif isinstance(cur, dict):
+            tgt = cur.get(last)
+        if tgt is None:
+            return doc
+        if isinstance(tgt, list):
+            tgt.append(value)
+        else:  # autowrap scalar
+            cur[last] = [tgt, value]
+        return doc
+    if isinstance(last, int):
+        if not isinstance(cur, list):
+            return doc
+        if mode == "array_insert":
+            cur.insert(min(last, len(cur)), value)
+        elif last < len(cur):
+            if mode in ("set", "replace"):
+                cur[last] = value
+        elif mode in ("set", "insert"):
+            cur.append(value)
+    else:
+        if not isinstance(cur, dict):
+            return doc
+        exists = last in cur
+        if (
+            mode == "set"
+            or (mode == "insert" and not exists)
+            or (mode == "replace" and exists)
+        ):
+            cur[last] = value
+    return doc
+
+
+def _json_remove_path(doc, parts):
+    if not parts:
+        return doc
+    cur = doc
+    for p in parts[:-1]:
+        if isinstance(p, int):
+            if not (isinstance(cur, list) and p < len(cur)):
+                return doc
+            cur = cur[p]
+        else:
+            if not (isinstance(cur, dict) and p in cur):
+                return doc
+            cur = cur[p]
+    last = parts[-1]
+    if isinstance(last, int):
+        if isinstance(cur, list) and last < len(cur):
+            del cur[last]
+    elif isinstance(cur, dict):
+        cur.pop(last, None)
+    return doc
+
+
+def _json_merge_patch(a, b):
+    """RFC 7396 (reference json_merge_patch)."""
+    if not isinstance(b, dict):
+        return b
+    if not isinstance(a, dict):
+        a = {}
+    out = dict(a)
+    for k, v in b.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _json_merge_patch(out.get(k), v)
+    return out
+
+
+def _json_merge_preserve(a, b):
+    """MySQL JSON_MERGE_PRESERVE: arrays concatenate, objects merge
+    recursively, scalars wrap into arrays."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _json_merge_preserve(out[k], v) if k in out else v
+        return out
+    la = a if isinstance(a, list) else [a]
+    lb = b if isinstance(b, list) else [b]
+    return la + lb
+
+
+def _json_const(v):
+    """A baked argument as a JSON value: strings stay strings (MySQL
+    treats non-JSON-typed args as literal strings)."""
+    return v
 
 
 def _json_pyfn(e: Func):
@@ -599,6 +746,109 @@ def _json_pyfn(e: Func):
             return s
 
         return f
+    if op in ("json_set", "json_insert", "json_replace", "json_remove",
+              "json_array_append", "json_array_insert"):
+        mode = {
+            "json_set": "set", "json_insert": "insert",
+            "json_replace": "replace", "json_array_append": "array_append",
+            "json_array_insert": "array_insert",
+        }.get(op)
+        if op == "json_remove":
+            paths = [
+                _json_path_parts(str(baked_value(a))) for a in e.args[1:]
+            ]
+
+            def f(s):
+                try:
+                    doc = _json.loads(s)
+                except Exception:
+                    return None
+                for parts in paths:
+                    doc = _json_remove_path(doc, parts)
+                return _json.dumps(doc)
+
+            return f
+        rest = e.args[1:]
+        if len(rest) % 2:
+            raise NotImplementedError(f"{op} needs (path, value) pairs")
+        pairs = [
+            (_json_path_parts(str(baked_value(rest[i]))),
+             _json_const(baked_value(rest[i + 1])))
+            for i in range(0, len(rest), 2)
+        ]
+
+        def f(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return None
+            for parts, val in pairs:
+                doc = _json_set_path(doc, parts, val, mode)
+            return _json.dumps(doc)
+
+        return f
+    if op in ("json_merge_patch", "json_merge_preserve", "json_merge"):
+        merge = (
+            _json_merge_patch if op == "json_merge_patch"
+            else _json_merge_preserve
+        )
+        others = []
+        for a in e.args[1:]:
+            try:
+                others.append(_json.loads(str(baked_value(a))))
+            except Exception:
+                others.append(None)
+
+        def f(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return None
+            for o in others:
+                doc = merge(doc, o)
+            return _json.dumps(doc)
+
+        return f
+    if op == "json_pretty":
+        def f(s):
+            try:
+                return _json.dumps(_json.loads(s), indent=2)
+            except Exception:
+                return None
+
+        return f
+    if op == "json_search":
+        # JSON_SEARCH(doc, 'one'|'all', search_str): path of matching
+        # string values ('one' -> first, 'all' -> array of paths)
+        one = str(baked_value(e.args[1])).lower() != "all"
+        needle = str(baked_value(e.args[2]))
+        from tidb_tpu.utils.checkeval import sql_like_match
+
+        def f(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return None
+            hits: list = []
+
+            def walk(v, path):
+                if isinstance(v, str) and sql_like_match(v, needle):
+                    hits.append(path)
+                elif isinstance(v, dict):
+                    for k, vv in v.items():
+                        walk(vv, f'{path}.{k}')
+                elif isinstance(v, list):
+                    for i, vv in enumerate(v):
+                        walk(vv, f"{path}[{i}]")
+
+            walk(doc, "$")
+            if not hits:
+                return None
+            if one:
+                return _json.dumps(hits[0])
+            return _json.dumps(hits if len(hits) > 1 else hits[0])
+
+        return f
     # json_type
     def f(s):
         try:
@@ -629,7 +879,32 @@ _STR_TRANSFORMS = {
     "md5", "sha1", "sha2", "hex_str", "substring_index",
     "soundex", "to_base64", "from_base64", "json_quote",
     "weight_string", "unhex",
+    # binary-yielding transforms: bytes ride latin-1-mapped strings (a
+    # lossless byte<->str bijection; HEX()/decrypt round-trips exactly)
+    "aes_encrypt", "aes_decrypt", "compress", "uncompress",
+    "inet6_aton", "inet6_ntoa", "uuid_to_bin", "bin_to_uuid",
 }
+
+
+def _b2s(b: bytes) -> str:
+    return b.decode("latin-1")
+
+
+def _s2b(s: str) -> bytes:
+    try:
+        return s.encode("latin-1")
+    except UnicodeEncodeError:
+        return s.encode("utf-8")
+
+
+def _mysql_aes_key(key: bytes, bits: int = 128) -> bytes:
+    """MySQL's key folding: XOR the key bytes cyclically into a
+    bits/8-byte buffer (reference pkg/util/encrypt/aes.go DeriveKeyMySQL)."""
+    n = bits // 8
+    out = bytearray(n)
+    for i, b in enumerate(key):
+        out[i % n] ^= b
+    return bytes(out)
 
 
 def _str_transform_pyfn(e: Func):
@@ -698,6 +973,119 @@ def _str_transform_pyfn(e: Func):
         import json as _json
 
         return lambda s: _json.dumps(s)
+    if op in ("aes_encrypt", "aes_decrypt"):
+        # MySQL default block_encryption_mode = aes-128-ecb with PKCS7
+        # padding (reference pkg/expression/builtin_encryption.go +
+        # pkg/util/encrypt); ciphertext rides a latin-1 byte-string
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes,
+        )
+
+        key = _mysql_aes_key(_s2b(str(ex[0])))
+
+        if op == "aes_encrypt":
+            def _aes_e(s):
+                data = _s2b(s)
+                pad = 16 - len(data) % 16
+                data += bytes([pad]) * pad
+                enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+                return _b2s(enc.update(data) + enc.finalize())
+
+            return _aes_e
+
+        def _aes_d(s):
+            data = _s2b(s)
+            if not data or len(data) % 16:
+                return None  # MySQL: NULL on malformed ciphertext
+            dec = Cipher(algorithms.AES(key), modes.ECB()).decryptor()
+            out = dec.update(data) + dec.finalize()
+            pad = out[-1] if out else 0
+            if not (1 <= pad <= 16) or out[-pad:] != bytes([pad]) * pad:
+                return None
+            # mirror _s2b (latin-1-first): round-trips every latin-1-
+            # encodable plaintext exactly; >U+00FF inputs took the utf-8
+            # fallback on encrypt and come back byte-identical but
+            # latin-1-rendered (documented carrier divergence)
+            return _b2s(out[:-pad])
+
+        return _aes_d
+    if op == "compress":
+        import struct
+        import zlib
+
+        def _comp(s):
+            data = _s2b(s)
+            if not data:
+                return ""  # MySQL: empty in, empty out
+            # MySQL format: 4-byte LE uncompressed length + deflate
+            return _b2s(struct.pack("<I", len(data)) + zlib.compress(data))
+
+        return _comp
+    if op == "uncompress":
+        import struct
+        import zlib
+
+        def _uncomp(s):
+            data = _s2b(s)
+            if not data:
+                return ""
+            if len(data) <= 4:
+                return None
+            try:
+                n = struct.unpack("<I", data[:4])[0]
+                out = zlib.decompress(data[4:])
+            except Exception:
+                return None
+            if len(out) != n:
+                return None
+            return _b2s(out)  # mirrors _s2b's latin-1-first mapping
+
+        return _uncomp
+    if op == "inet6_aton":
+        import ipaddress
+
+        def _i6a(s):
+            try:
+                return _b2s(ipaddress.ip_address(s).packed)
+            except ValueError:
+                return None
+
+        return _i6a
+    if op == "inet6_ntoa":
+        import ipaddress
+
+        def _i6n(s):
+            b = _s2b(s)
+            try:
+                if len(b) == 4:
+                    return str(ipaddress.IPv4Address(b))
+                if len(b) == 16:
+                    return str(ipaddress.IPv6Address(b))
+            except ValueError:
+                pass
+            return None
+
+        return _i6n
+    if op == "uuid_to_bin":
+        import uuid as _uuid
+
+        def _u2b(s):
+            try:
+                return _b2s(_uuid.UUID(s).bytes)
+            except ValueError:
+                return None
+
+        return _u2b
+    if op == "bin_to_uuid":
+        import uuid as _uuid
+
+        def _bu(s):
+            b = _s2b(s)
+            if len(b) != 16:
+                return None
+            return str(_uuid.UUID(bytes=b))
+
+        return _bu
     if op == "weight_string":
         # the collation sort key itself (reference WEIGHT_STRING reveals
         # the Key() bytes; here the key IS a string)
@@ -947,6 +1335,23 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             return DevCol(~_to_bigint(c.data, ta), c.valid)
 
         return _bneg
+    if op == "bit_count":
+        (a,) = [_compile(x, dicts) for x in e.args]
+        ta = e.args[0].type
+
+        def _bcnt(b):
+            c = a(b)
+            u = _to_bigint(c.data, ta).astype(jnp.uint64)
+            # SWAR popcount over 64 bits
+            u = u - ((u >> 1) & jnp.uint64(0x5555555555555555))
+            u = (u & jnp.uint64(0x3333333333333333)) + (
+                (u >> 2) & jnp.uint64(0x3333333333333333)
+            )
+            u = (u + (u >> 4)) & jnp.uint64(0x0F0F0F0F0F0F0F0F)
+            n = (u * jnp.uint64(0x0101010101010101)) >> 56
+            return DevCol(n.astype(jnp.int64), c.valid)
+
+        return _bcnt
     if op in ("and", "or"):
         return _compile_logic(e, dicts)
     if op == "not":
@@ -1185,6 +1590,41 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
         return _compile_strlut(
             e.args[0], dicts, lambda s: bool(_uuid_re.match(s)), jnp.bool_
         )
+    if op in ("is_ipv4", "is_ipv6", "is_ipv4_compat", "is_ipv4_mapped"):
+        import ipaddress
+
+        def _ipfn(s, _op=op):
+            if _op == "is_ipv4":
+                try:
+                    ipaddress.IPv4Address(s)
+                    return True
+                except ValueError:
+                    return False
+            if _op == "is_ipv6":
+                try:
+                    ipaddress.IPv6Address(s)
+                    return True
+                except ValueError:
+                    return False
+            # *_compat / *_mapped take the BINARY form (INET6_ATON output)
+            b = _s2b(s)
+            if len(b) != 16:
+                return False
+            if _op == "is_ipv4_compat":
+                return b[:12] == b"\x00" * 12 and b[12:] != b"\x00\x00\x00\x00"
+            return b[:10] == b"\x00" * 10 and b[10:12] == b"\xff\xff"
+
+        return _compile_strlut(e.args[0], dicts, _ipfn, jnp.bool_)
+    if op == "uncompressed_length":
+        import struct
+
+        def _ul(s):
+            b = _s2b(s)
+            if len(b) <= 4:
+                return 0
+            return struct.unpack("<I", b[:4])[0]
+
+        return _compile_strlut(e.args[0], dicts, _ul, jnp.int64)
     if op == "inet_aton":
         def _aton(s):
             parts = s.split(".")
@@ -1219,6 +1659,71 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             return d(v)
 
         return _compile_strlut(e.args[0], dicts, _depth, jnp.int64)
+    if op == "json_contains_path":
+        import json as _json
+
+        one = str(baked_value(e.args[1])).lower() != "all"
+        paths = [_json_path_parts(str(baked_value(a))) for a in e.args[2:]]
+
+        def _jcp(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return False
+            hits = []
+            for parts in paths:
+                cur, ok = doc, True
+                for p in parts:
+                    if isinstance(p, int):
+                        if isinstance(cur, list) and p < len(cur):
+                            cur = cur[p]
+                        else:
+                            ok = False
+                            break
+                    elif isinstance(cur, dict) and p in cur:
+                        cur = cur[p]
+                    else:
+                        ok = False
+                        break
+                hits.append(ok)
+            return any(hits) if one else all(hits)
+
+        return _compile_strlut(e.args[0], dicts, _jcp, jnp.bool_)
+    if op == "json_storage_size":
+        import json as _json
+
+        def _jss(s):
+            try:
+                return len(_json.dumps(_json.loads(s)).encode())
+            except Exception:
+                return 0
+
+        return _compile_strlut(e.args[0], dicts, _jss, jnp.int64)
+    if op == "json_overlaps":
+        import json as _json
+
+        try:
+            other = _json.loads(str(baked_value(e.args[1])))
+        except Exception:
+            other = None
+
+        def _jov(s):
+            try:
+                doc = _json.loads(s)
+            except Exception:
+                return False
+            a, b = doc, other
+            if isinstance(a, list) and isinstance(b, list):
+                return any(x in b for x in a)
+            if isinstance(a, dict) and isinstance(b, dict):
+                return any(k in b and b[k] == v for k, v in a.items())
+            if isinstance(a, list):
+                return b in a
+            if isinstance(b, list):
+                return a in b
+            return a == b
+
+        return _compile_strlut(e.args[0], dicts, _jov, jnp.bool_)
     if op in ("period_add", "period_diff"):
         fa, fb = (_compile(a, dicts) for a in e.args)
 
@@ -1334,9 +1839,8 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
             )
         needle = str(sub.value)
         return _compile_strlut(s, dicts, lambda v: v.find(needle) + 1, jnp.int64)
-    if op in _STR_TRANSFORMS or op in (
-        "concat", "concat_ws", "json_extract", "json_unquote", "json_type",
-        "json_keys", "dayname", "monthname", "date_format",
+    if op in _STR_TRANSFORMS or op in _JSON_STR_FUNCS or op in (
+        "concat", "concat_ws", "dayname", "monthname", "date_format",
         "hex", "bin", "oct",
     ):
         return string_expr(e, dicts)[0]

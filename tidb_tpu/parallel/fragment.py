@@ -73,6 +73,10 @@ def _partial_descs(
                 )
             )
             final.append(("sum", a.out_name, [pname], 0, None))
+        elif a.func == "first":
+            pname = f"_p{i}"
+            partial.append(AggDesc("first", a.arg, pname))
+            final.append(("first", a.out_name, [pname], 0, None))
         elif a.func in ("min", "max"):
             # the partial stage keeps encoded values (a.post decodes
             # e.g. CI-string rank*D+code back to a dict code); only the

@@ -1061,6 +1061,21 @@ class Parser:
             if self.at_kw("is"):
                 self.advance()
                 neg = self.accept_kw("not")
+                if self.at_kw("true", "false") or self._at_ident("unknown"):
+                    # IS [NOT] TRUE/FALSE/UNKNOWN (3-valued truth tests)
+                    which = self.advance().text.lower()
+                    if which == "unknown":
+                        r = ast.Call("isnull", [e])
+                    else:
+                        # IS is never NULL: NULL input yields FALSE
+                        cmp_op = "ne" if which == "true" else "eq"
+                        r = ast.Call(
+                            "if",
+                            [ast.Call("isnull", [e]), ast.Const(False),
+                             ast.Call(cmp_op, [e, ast.Const(0)])],
+                        )
+                    e = ast.Call("not", [r]) if neg else r
+                    continue
                 self.expect_kw("null")
                 e = ast.Call("isnotnull" if neg else "isnull", [e])
                 continue
@@ -1381,13 +1396,50 @@ class Parser:
                 b = self.parse_expr()
                 self.expect_op(")")
                 return ast.Call("timestampdiff", [ast.Const(unit), a, b])
+            if name.lower() == "timestampadd" and self.at_op("("):
+                # TIMESTAMPADD(unit, n, d) == DATE_ADD(d, INTERVAL n unit)
+                self.advance()
+                unit = self.expect_ident().lower()
+                self.expect_op(",")
+                n = self.parse_expr()
+                self.expect_op(",")
+                d = self.parse_expr()
+                self.expect_op(")")
+                return ast.Call("date_add", [d, ast.Interval(n, unit)])
             if self.accept_op("("):
                 args = []
+                distinct_fn = False
+                if name.lower() in (
+                    "json_arrayagg", "json_objectagg", "any_value",
+                    "variance", "var_pop", "var_samp", "std", "stddev",
+                    "stddev_pop", "stddev_samp",
+                ):
+                    distinct_fn = self.accept_kw("distinct")
                 if not self.at_op(")"):
                     args.append(self.parse_expr())
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
+                low0 = name.lower()
+                if low0 == "json_arrayagg" and len(args) == 1:
+                    return ast.AggCall(
+                        "json_arrayagg", args[0], distinct_fn,
+                        separator="\x00json_array",
+                    )
+                if low0 == "json_objectagg" and len(args) == 2:
+                    # the KEY expr rides the order-by slot (projected
+                    # alongside by the host-assisted aggregation)
+                    return ast.AggCall(
+                        "json_objectagg", args[1], False,
+                        separator="\x00json_object",
+                        order_by=((args[0], False),),
+                    )
+                if low0 in (
+                    "any_value", "variance", "var_pop", "var_samp",
+                    "std", "stddev", "stddev_pop", "stddev_samp",
+                ) and len(args) == 1:
+                    # expanded by planner (_rewrite_derived_aggs)
+                    return ast.AggCall(low0, args[0], distinct_fn)
                 if name.lower() in _WINDOW_ONLY_FUNCS:
                     low = name.lower()
                     offset = 1

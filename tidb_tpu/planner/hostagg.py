@@ -69,6 +69,21 @@ def _format_value(v, t) -> str:
     return str(v)
 
 
+def _json_cell(v, t):
+    """SQL value -> JSON-embeddable python value (JSON_ARRAYAGG/
+    JSON_OBJECTAGG rendering: numbers native, temporals as their MySQL
+    strings, SQL NULL as JSON null; strings embed as JSON strings —
+    documented divergence for JSON-typed columns, which MySQL nests as
+    documents)."""
+    if v is None:
+        return None
+    if t.kind in (Kind.DATE, Kind.DATETIME, Kind.TIME, Kind.DECIMAL):
+        return _format_value(v, t)
+    if isinstance(v, (bool, int, float)):
+        return v
+    return str(v)
+
+
 def try_host_agg(executor, plan):
     """Execute `plan` when it contains a GROUP_CONCAT aggregate:
     device-run the aggregate's input projection, host-reduce the groups
@@ -134,6 +149,32 @@ def try_host_agg(executor, plan):
                 out_vals[name].append(len(rs))
                 continue
             col = decoded[argname[i]]
+            if func == "json_arrayagg":
+                import json as _json
+
+                # SQL NULLs become JSON nulls (MySQL keeps them)
+                at = types[argname[i]]
+                out_vals[name].append(
+                    _json.dumps([_json_cell(col[r], at) for r in rs])
+                    if rs else None
+                )
+                continue
+            if func == "json_objectagg":
+                import json as _json
+
+                kcol_name = ordnames[name][0][0]
+                kcol = decoded[kcol_name]
+                at = types[argname[i]]
+                obj = {}
+                for r in rs:
+                    if kcol[r] is None:
+                        raise ValueError(
+                            "JSON documents may not contain NULL member "
+                            "names"
+                        )
+                    obj[str(kcol[r])] = _json_cell(col[r], at)
+                out_vals[name].append(_json.dumps(obj) if rs else None)
+                continue
             vals = [(col[r], r) for r in rs if col[r] is not None]
             if func == "group_concat":
                 sep, _obs = gc_meta[name]
@@ -181,6 +222,8 @@ def try_host_agg(executor, plan):
                 out_vals[name].append(min(vs))
             elif func == "max":
                 out_vals[name].append(max(vs))
+            elif func == "first":
+                out_vals[name].append(vs[0] if vs else None)
             else:
                 raise NotImplementedError(f"host agg {func}")
 

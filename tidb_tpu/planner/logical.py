@@ -291,6 +291,9 @@ class ExprBinder:
         "utc_timestamp": "now",
         "curtime": "current_time",
         "lastday": "last_day",
+        "localtime": "now",
+        "sha": "sha1",
+        "mid": "substring",
     }
 
     @staticmethod
@@ -595,6 +598,184 @@ class ExprBinder:
                 value=int(
                     time_to_micros(datetime.datetime.now().strftime("%H:%M:%S"))
                 ),
+            )
+        if op == "utc_date":
+            import datetime
+
+            from tidb_tpu.dtypes import DATE as _DATE, date_to_days
+
+            return Literal(
+                type=_DATE,
+                value=int(date_to_days(
+                    datetime.datetime.now(datetime.timezone.utc)
+                    .date().isoformat()
+                )),
+            )
+        if op == "utc_time":
+            import datetime
+
+            from tidb_tpu.dtypes import TIME as _TIME, time_to_micros
+
+            return Literal(
+                type=_TIME,
+                value=int(time_to_micros(
+                    datetime.datetime.now(datetime.timezone.utc)
+                    .strftime("%H:%M:%S")
+                )),
+            )
+        if op == "timestamp" and len(e.args) == 1:
+            # TIMESTAMP(x): cast to DATETIME
+            from tidb_tpu.dtypes import DATETIME as _DT
+
+            return Func(
+                op="cast", args=(self.lower(e.args[0]),), type=_DT
+            )
+        if op == "maketime" and len(e.args) == 3:
+            consts = [self._const_arg(a) for a in e.args]
+            if any(c is None for c in consts):
+                raise PlanError("MAKETIME supports constant arguments only")
+            from tidb_tpu.dtypes import TIME as _TIME
+
+            h, m, sec = (int(c.value) for c in consts)
+            sign = -1 if h < 0 else 1
+            total = abs(h) * 3600 + m * 60 + sec
+            return Literal(type=_TIME, value=sign * total * 1_000_000)
+        if op == "get_format" and len(e.args) == 2:
+            kind = str(getattr(e.args[0], "column", e.args[0])).lower()
+            if isinstance(e.args[0], ast.Const):
+                kind = str(e.args[0].value).lower()
+            elif isinstance(e.args[0], ast.Name):
+                kind = e.args[0].column.lower()
+            loc = (
+                str(e.args[1].value).lower()
+                if isinstance(e.args[1], ast.Const) else "iso"
+            )
+            fmts = {
+                ("date", "iso"): "%Y-%m-%d", ("date", "usa"): "%m.%d.%Y",
+                ("date", "eur"): "%d.%m.%Y", ("date", "jis"): "%Y-%m-%d",
+                ("date", "internal"): "%Y%m%d",
+                ("time", "iso"): "%H:%i:%s", ("time", "usa"): "%h:%i:%s %p",
+                ("time", "eur"): "%H.%i.%s", ("time", "jis"): "%H:%i:%s",
+                ("time", "internal"): "%H%i%s",
+                ("datetime", "iso"): "%Y-%m-%d %H:%i:%s",
+                ("datetime", "usa"): "%Y-%m-%d %H.%i.%s",
+                ("datetime", "eur"): "%Y-%m-%d %H.%i.%s",
+                ("datetime", "jis"): "%Y-%m-%d %H:%i:%s",
+                ("datetime", "internal"): "%Y%m%d%H%i%s",
+            }
+            from tidb_tpu.dtypes import STRING as _S
+
+            v = fmts.get((kind, loc))
+            return Literal(type=_S, value=v)
+        if op == "to_seconds" and len(e.args) == 1:
+            # TO_SECONDS(date) = TO_DAYS * 86400 (date-granular; the
+            # DATETIME time-of-day component follows to_days semantics)
+            return self.lower(
+                ast.Call(
+                    "add",
+                    [
+                        ast.Call(
+                            "mul",
+                            [ast.Call("to_days", [e.args[0]]),
+                             ast.Const(86400)],
+                        ),
+                        ast.Const(0),
+                    ],
+                )
+            )
+        if op == "yearweek" and len(e.args) == 1:
+            # YEARWEEK(d) = YEAR*100 + WEEK (mode-0 weeks; boundary
+            # weeks where the week belongs to the adjacent year follow
+            # WEEK()'s mode-0 result)
+            return self.lower(
+                ast.Call(
+                    "add",
+                    [
+                        ast.Call("mul", [ast.Call("year", [e.args[0]]),
+                                         ast.Const(100)]),
+                        ast.Call("week", [e.args[0]]),
+                    ],
+                )
+            )
+        if op == "name_const" and len(e.args) == 2:
+            return self.lower(e.args[1])
+        if op == "time" and len(e.args) == 1:
+            from tidb_tpu.dtypes import TIME as _T
+
+            return Func(op="cast", args=(self.lower(e.args[0]),), type=_T)
+        if op in ("format_bytes", "format_nano_time", "password"):
+            c = self._const_arg(e.args[0]) if e.args else None
+            if c is None:
+                raise PlanError(f"{op.upper()} supports constant arguments only")
+            from tidb_tpu.dtypes import STRING as _S
+
+            v = c.value
+            if v is None:
+                return Literal(type=_S, value=None)
+            if op == "password":
+                # deprecated double-SHA1 (*hex) form
+                import hashlib as _h
+
+                d = _h.sha1(_h.sha1(str(v).encode()).digest()).hexdigest()
+                return Literal(type=_S, value="*" + d.upper())
+            units = (
+                ["B", "KiB", "MiB", "GiB", "TiB", "PiB"]
+                if op == "format_bytes"
+                else ["ns", "µs", "ms", "s"]
+            )
+            step = 1024.0 if op == "format_bytes" else 1000.0
+            x = float(v)
+            i = 0
+            while abs(x) >= step and i < len(units) - 1:
+                x /= step
+                i += 1
+            return Literal(type=_S, value=f"{x:.2f} {units[i]}")
+        if op in ("json_array", "json_object"):
+            import json as _json
+
+            consts = [self._const_arg(a) for a in e.args]
+            if any(c is None for c in consts):
+                raise PlanError(
+                    f"{op.upper()} supports constant arguments only"
+                )
+            from tidb_tpu.dtypes import STRING as _S
+
+            vs = [c.value for c in consts]
+            if op == "json_array":
+                return Literal(type=_S, value=_json.dumps(vs))
+            if len(vs) % 2:
+                raise PlanError("JSON_OBJECT needs key/value pairs")
+            if any(vs[i] is None for i in range(0, len(vs), 2)):
+                raise PlanError(
+                    "JSON documents may not contain NULL member names"
+                )
+            return Literal(
+                type=_S,
+                value=_json.dumps(
+                    {str(vs[i]): vs[i + 1] for i in range(0, len(vs), 2)}
+                ),
+            )
+        if op in ("charset", "collation", "coercibility"):
+            # pre-binding: argument types are unknown here; report the
+            # connection charset like the reference does for the
+            # overwhelmingly common string case (connector handshakes
+            # SELECT these on literals)
+            from tidb_tpu.dtypes import INT64 as _I64, STRING as _S
+
+            a0 = e.args[0] if e.args else None
+            is_num = isinstance(a0, ast.Const) and isinstance(
+                a0.value, (int, float)
+            ) and not isinstance(a0.value, bool)
+            if op == "coercibility":
+                return Literal(
+                    type=_I64, value=4 if isinstance(a0, ast.Const) else 2
+                )
+            if op == "charset":
+                return Literal(
+                    type=_S, value="binary" if is_num else "utf8mb4"
+                )
+            return Literal(
+                type=_S, value="binary" if is_num else "utf8mb4_bin"
             )
         args = tuple(self.lower(a) for a in e.args)
         return Func(op=op, args=args)
@@ -1257,6 +1438,112 @@ def _expr_has_modifier_subq(e) -> bool:
     return False
 
 
+def _rewrite_derived_aggs(sel) -> None:
+    """AST-level expansion of derived aggregates (reference: the
+    var/stddev aggfuncs, pkg/executor/aggfuncs/func_varpop.go et al —
+    there incremental accumulators, here algebraic rewrites over
+    SUM/COUNT so the whole family rides the existing kernels):
+
+      VAR_POP(x)    -> sum(x*x)/n - (sum(x)/n)^2
+      VAR_SAMP(x)   -> (sum(x*x) - sum(x)^2/n) / (n-1)
+      STDDEV_POP(x) -> sqrt(var_pop)   STDDEV_SAMP -> sqrt(var_samp)
+      ANY_VALUE(x)  -> x when ungrouped, first-per-group when grouped
+
+    n=0 (and n-1=0 for the sample forms) divides by zero, which is SQL
+    NULL — matching MySQL's NULL over empty/singleton groups."""
+    var_funcs = {
+        "variance": "pop", "var_pop": "pop", "var_samp": "samp",
+        "std": "pop_sqrt", "stddev": "pop_sqrt",
+        "stddev_pop": "pop_sqrt", "stddev_samp": "samp_sqrt",
+    }
+    # grouped = explicit GROUP BY or implicit one-group aggregation
+    # (ANY_VALUE(a) alongside COUNT(*) must aggregate, like MySQL)
+    has_other_agg = [False]
+
+    def scan(node):
+        if isinstance(node, (ast.Select, ast.Union, ast.SubqueryExpr)):
+            return
+        if isinstance(node, ast.AggCall) and node.func not in (
+            "any_value",
+        ):
+            has_other_agg[0] = True
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            for f in dataclasses.fields(node):
+                scan(getattr(node, f.name))
+        elif isinstance(node, (list, tuple)):
+            for x in node:
+                scan(x)
+
+    for it in sel.items:
+        scan(it.expr)
+    if sel.having is not None:
+        scan(sel.having)
+    grouped = bool(sel.group_by) or has_other_agg[0]
+
+    def rw(node):
+        if isinstance(node, (ast.Select, ast.Union, ast.SubqueryExpr)):
+            # subqueries rewrite against their OWN group-by context
+            # when they are planned
+            return node
+        if isinstance(node, ast.AggCall) and node.func in var_funcs:
+            kind = var_funcs[node.func]
+            x = rw(node.arg)
+            d = node.distinct
+            sx = ast.AggCall("sum", x, d)
+            sxx = ast.AggCall("sum", ast.Call("mul", [x, x]), d)
+            n = ast.AggCall("count", x, d)
+            if kind.startswith("pop"):
+                mean = ast.Call("div", [sx, n])
+                v = ast.Call(
+                    "sub",
+                    [ast.Call("div", [sxx, n]),
+                     ast.Call("mul", [mean, mean])],
+                )
+            else:
+                v = ast.Call(
+                    "div",
+                    [ast.Call(
+                        "sub",
+                        [sxx, ast.Call("div", [ast.Call("mul", [sx, sx]), n])],
+                    ),
+                     ast.Call("sub", [n, ast.Const(1)])],
+                )
+            if kind.endswith("sqrt"):
+                # clamp tiny negative rounding residue before sqrt
+                v = ast.Call("sqrt", [ast.Call("greatest", [v, ast.Const(0)])])
+            return v
+        if isinstance(node, ast.AggCall) and node.func == "any_value":
+            inner = rw(node.arg)
+            return (
+                ast.AggCall("first", inner, False) if grouped else inner
+            )
+        if isinstance(node, ast.Call) and node.op == "any_value" and node.args:
+            inner = rw(node.args[0])
+            return (
+                ast.AggCall("first", inner, False) if grouped else inner
+            )
+        if (
+            dataclasses.is_dataclass(node)
+            and not isinstance(node, type)
+            and not node.__dataclass_params__.frozen  # SQLType et al
+        ):
+            for f in dataclasses.fields(node):
+                setattr(node, f.name, rw(getattr(node, f.name)))
+            return node
+        if isinstance(node, list):
+            return [rw(x) for x in node]
+        if isinstance(node, tuple):
+            return tuple(rw(x) for x in node)
+        return node
+
+    for it in sel.items:
+        it.expr = rw(it.expr)
+    if sel.having is not None:
+        sel.having = rw(sel.having)
+    if sel.order_by:
+        sel.order_by = rw(list(sel.order_by))
+
+
 def build_select(
     sel: ast.Select, catalog, current_db: str, subquery_value_fn=None, ctes=None
 ) -> LogicalPlan:
@@ -1269,6 +1556,7 @@ def build_select(
     # above the aggregation either way; the wrap reuses semi/mark joins
     # instead of a post-agg special case). Conjuncts must reference
     # select-list aliases, as MySQL HAVING requires for outer scoping.
+    _rewrite_derived_aggs(sel)
     if sel.having is not None and _expr_has_modifier_subq(sel.having):
         subq_conjs, plain_conjs = [], []
         for c in _conjuncts(sel.having):
@@ -2795,11 +3083,17 @@ def _build_aggregate(b, plan, group_by, agg_calls):
             t = INT64
         elif call.func == "avg":
             t = FLOAT64
-        elif call.func in ("min", "max", "sum"):
+        elif call.func in ("min", "max", "sum", "first"):
             t = arg.type
             if call.func == "sum" and t is not None and t.kind == Kind.BOOL:
                 t = INT64  # MySQL: SUM over booleans counts (0/1 ints)
-        elif call.func == "group_concat":
+        elif call.func in (
+            "group_concat", "json_arrayagg", "json_objectagg"
+        ):
+            # string-producing aggregates run host-assisted (hostagg.py);
+            # json_objectagg carries its KEY expression in the order-by
+            # slot (projected alongside, marker separator selects the
+            # rendering)
             t = STRING
             gc_meta[name] = (
                 call.separator,

@@ -102,6 +102,19 @@ def _collect_param_literals(plan) -> dict:
     return out
 
 
+def _release_session_locks(base_catalog, conn_id: int) -> None:
+    """weakref.finalize hook: a dying session releases its advisory
+    locks (MySQL releases GET_LOCK locks on connection end)."""
+    cv = getattr(base_catalog, "_user_locks_cv", None)
+    reg = getattr(base_catalog, "_user_locks", None)
+    if cv is None or reg is None:
+        return
+    with cv:
+        for name in [k for k, v in reg.items() if v[0] == conn_id]:
+            del reg[name]
+        cv.notify_all()
+
+
 class _SessionCatalog:
     """Session-scoped catalog view: LOCAL TEMPORARY tables shadow base
     tables by name for this session only (reference:
@@ -1716,6 +1729,93 @@ class Session:
             raise ValueError("SETVAL needs (sequence, value)")
         return seq.setval(self._const_value(e.args[1]))
 
+    def _user_lock_func(self, e):
+        """GET_LOCK / RELEASE_LOCK / IS_FREE_LOCK / IS_USED_LOCK /
+        RELEASE_ALL_LOCKS — named advisory locks shared by every session
+        over the catalog (reference: builtin_miscellaneous.go over the
+        advisory-lock table; locks are re-entrant per session and die
+        with it). Returns the MySQL int/NULL result."""
+        import threading
+        import time as _time
+
+        op = e.op.lower()
+        base = getattr(self.catalog, "_base", self.catalog)
+        reg = getattr(base, "_user_locks", None)
+        if reg is None:
+            reg = base._user_locks = {}  # name -> [conn_id, count]
+            base._user_locks_cv = threading.Condition()
+        cv = base._user_locks_cv
+
+        def argval(i):
+            a = e.args[i]
+            if isinstance(a, ast.Const):
+                return a.value
+            if isinstance(a, ast.Name):
+                return a.column
+            raise ValueError(f"{op.upper()} needs literal arguments")
+
+        def held_set():
+            held = getattr(self, "_held_user_locks", None)
+            if held is None:
+                held = self._held_user_locks = set()
+                import weakref as _wr
+
+                # register exactly once, at first touch of lock state
+                _wr.finalize(
+                    self, _release_session_locks, base, self.conn_id
+                )
+            return held
+
+        if op == "release_all_locks":
+            held_set()
+            with cv:
+                n = 0
+                for name in [
+                    k for k, v in reg.items() if v[0] == self.conn_id
+                ]:
+                    n += reg[name][1]
+                    del reg[name]
+                cv.notify_all()
+            self._held_user_locks.clear()
+            return n
+        name = str(argval(0)).lower()
+        if op == "get_lock":
+            timeout = float(argval(1)) if len(e.args) > 1 else 0.0
+            deadline = _time.monotonic() + max(timeout, 0.0)
+            with cv:
+                while True:
+                    holder = reg.get(name)
+                    if holder is None or holder[0] == self.conn_id:
+                        if holder is None:
+                            reg[name] = [self.conn_id, 1]
+                        else:
+                            holder[1] += 1  # re-entrant
+                        held_set().add(name)
+                        return 1
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return 0
+                    cv.wait(min(remaining, 0.1))
+        if op == "release_lock":
+            with cv:
+                holder = reg.get(name)
+                if holder is None:
+                    return None  # lock was never held
+                if holder[0] != self.conn_id:
+                    return 0  # held by another session
+                holder[1] -= 1
+                if holder[1] <= 0:
+                    del reg[name]
+                    cv.notify_all()
+                return 1
+        if op == "is_free_lock":
+            with cv:
+                return 0 if name in reg else 1
+        # is_used_lock: connection id of the holder, or NULL
+        with cv:
+            holder = reg.get(name)
+            return holder[0] if holder is not None else None
+
     def _resolve_session_funcs(self, node):
         """Fold session-state functions (LAST_INSERT_ID(), DATABASE(),
         CURRENT_USER()) to constants before planning (the reference
@@ -1729,14 +1829,40 @@ class Session:
             "nextval", "lastval", "setval"
         ):
             return ast.Const(self._seq_func(node))
+        if isinstance(node, ast.Call) and node.op.lower() in (
+            "get_lock", "release_lock", "is_free_lock", "is_used_lock",
+            "release_all_locks",
+        ):
+            return ast.Const(self._user_lock_func(node))
+        if isinstance(node, ast.Call) and node.op.lower() == "random_bytes":
+            # folded ONCE per statement (like NEXTVAL in SELECT) —
+            # documented divergence from MySQL's per-row evaluation
+            import os as _os
+
+            n = node.args[0].value if node.args and isinstance(
+                node.args[0], ast.Const
+            ) else 1
+            n = int(n)
+            if not (1 <= n <= 1024):
+                raise ValueError(
+                    "Data length out of range for random_bytes (1..1024)"
+                )
+            return ast.Const(_os.urandom(n).decode("latin-1"))
         if isinstance(node, ast.Call) and not node.args:
             op = node.op.lower()
             if op == "last_insert_id":
                 return ast.Const(int(self.last_insert_id))
             if op in ("database", "schema"):
                 return ast.Const(self.db)
-            if op in ("current_user", "session_user", "user"):
+            if op in ("current_user", "session_user", "user", "system_user"):
                 return ast.Const(f"{self.user}@%")
+            if op == "current_role":
+                return ast.Const("NONE")
+            if op == "tidb_version":
+                return ast.Const(
+                    f"tidb-tpu {self.vars.get('version')}\n"
+                    "Edition: tpu-native (jax/XLA)"
+                )
             if op == "connection_id":
                 return ast.Const(int(self.conn_id))
             if op == "found_rows":
