@@ -292,3 +292,97 @@ def test_prepared_reexecute_without_types(server):
     r = c.execute(sid, [42], send_types=False)  # re-execute: no types
     assert r["rows"] == [(420,)]
     c.close()
+
+
+class CursorClient(PreparedClient):
+    """COM_STMT_EXECUTE with CURSOR_TYPE_READ_ONLY + COM_STMT_FETCH
+    (reference: conn_stmt.go:153-155 useCursor — forward-only read-only
+    server-side cursors, the JDBC setFetchSize path)."""
+
+    def execute_cursor(self, stmt_id, params=()):
+        self.io.reset_seq()
+        payload = (
+            b"\x17" + struct.pack("<I", stmt_id) + b"\x01"  # READ_ONLY
+            + struct.pack("<I", 1)
+        )
+        assert not params  # cursor tests use parameterless statements
+        self.io.write_packet(payload)
+        first = self.io.read_packet()
+        assert first[0] not in (0xFF,), first
+        ncols, _ = self._lenenc(first, 0)
+        names, mtypes = [], []
+        for _ in range(ncols):
+            colpkt = self.io.read_packet()
+            pos = 0
+            vals = []
+            for _f in range(6):
+                ln, pos = self._lenenc(colpkt, pos)
+                vals.append(colpkt[pos:pos + ln])
+                pos += ln
+            names.append(vals[4].decode())
+            mtypes.append(colpkt[pos + 7])
+        eof = self.io.read_packet()
+        assert eof[0] == 0xFE
+        status = struct.unpack_from("<H", eof, 3)[0]
+        assert status & 0x0040, hex(status)  # SERVER_STATUS_CURSOR_EXISTS
+        return names, mtypes
+
+    def fetch(self, stmt_id, n, mtypes):
+        self.io.reset_seq()
+        self.io.write_packet(
+            b"\x1c" + struct.pack("<I", stmt_id) + struct.pack("<I", n)
+        )
+        rows = []
+        while True:
+            pkt = self.io.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                status = struct.unpack_from("<H", pkt, 3)[0]
+                return rows, bool(status & 0x0080)  # LAST_ROW_SENT
+            rows.append(self._decode_binary_row(pkt, len(mtypes), mtypes))
+
+
+def test_cursor_fetch(server):
+    c = CursorClient(server.port)
+    try:
+        c.query("create database if not exists curdb")
+        c.query("use curdb")
+        c.query("create table ct (a int)")
+        c.query("insert into ct values (1), (2), (3), (4), (5)")
+        sid, _np = c.prepare("select a from ct order by a")
+        names, mtypes = c.execute_cursor(sid)
+        assert names == ["a"]
+        rows, last = c.fetch(sid, 2, mtypes)
+        assert rows == [(1,), (2,)] and not last
+        rows, last = c.fetch(sid, 2, mtypes)
+        assert rows == [(3,), (4,)] and not last
+        rows, last = c.fetch(sid, 2, mtypes)
+        assert rows == [(5,)] and last
+        # drained cursor: a further fetch errors cleanly
+        c.io.reset_seq()
+        c.io.write_packet(b"\x1c" + struct.pack("<I", sid) + struct.pack("<I", 1))
+        pkt = c.io.read_packet()
+        assert pkt[0] == 0xFF
+        # plain execute on the same statement still works (no cursor)
+        r = c.execute(sid, [])
+        assert r["rows"] == [(1,), (2,), (3,), (4,), (5,)]
+    finally:
+        c.close()
+
+
+def test_cursor_reset_discards(server):
+    c = CursorClient(server.port)
+    try:
+        c.query("create database if not exists curdb2")
+        c.query("use curdb2")
+        c.query("create table ct (a int)")
+        c.query("insert into ct values (1), (2)")
+        sid, _np = c.prepare("select a from ct order by a")
+        _names, mtypes = c.execute_cursor(sid)
+        c.io.reset_seq()
+        c.io.write_packet(b"\x1a" + struct.pack("<I", sid))  # STMT_RESET
+        assert c.io.read_packet()[0] == 0x00
+        c.io.reset_seq()
+        c.io.write_packet(b"\x1c" + struct.pack("<I", sid) + struct.pack("<I", 1))
+        assert c.io.read_packet()[0] == 0xFF  # cursor gone
+    finally:
+        c.close()

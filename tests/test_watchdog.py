@@ -1,0 +1,125 @@
+"""Instance watchdogs: memory alarm, expensive-query log, server
+memory limit (reference: pkg/util/memoryusagealarm,
+pkg/util/expensivequery, pkg/util/servermemorylimit/servermemorylimit.go:51).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils.watchdog import (
+    InstanceWatchdog, host_memory, parse_mem_limit,
+)
+
+
+def test_host_memory_and_limit_parse():
+    rss, total = host_memory()
+    assert rss > 0 and total > rss
+    assert parse_mem_limit("0", total) == 0
+    assert parse_mem_limit("50%", 1000) == 500
+    assert parse_mem_limit("12345", total) == 12345
+    assert parse_mem_limit("", total) == 0
+
+
+def test_expensive_query_logged():
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("set global tidb_expensive_query_time_threshold = 0")
+    wd = InstanceWatchdog(cat, interval=0.05)  # sample manually
+
+    done = []
+
+    def runner():
+        s.execute("select sleep(1.2)")
+        done.append(1)
+
+    t = threading.Thread(target=runner)
+    t.start()
+    hits = 0
+    for _ in range(40):
+        time.sleep(0.05)
+        wd.sample()
+        if wd.expensive_seen:
+            hits += 1
+            break
+    t.join()
+    assert hits, "expensive query was never flagged"
+    from tidb_tpu.utils.metrics import SLOW_LOG
+
+    assert any("[expensive_query]" in r[1] for r in SLOW_LOG.rows())
+
+
+def test_memory_limit_kills_top_consumer():
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("set global tidb_server_memory_limit = 1")  # always breached
+    wd = InstanceWatchdog(cat, interval=0.05)
+    cat._watchdog = wd  # registered view for information_schema
+
+    errors = []
+
+    def runner():
+        try:
+            s.execute("select sleep(5)")
+        except Exception as e:
+            errors.append(str(e))
+
+    t = threading.Thread(target=runner)
+    t.start()
+    for _ in range(60):
+        time.sleep(0.05)
+        if wd.kill_records:
+            break
+        wd.sample()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert wd.kill_records and wd.kill_records[0]["conn_id"] == s.conn_id
+    assert errors and "interrupted" in errors[0]
+    # observable through information_schema
+    s.killer.clear()
+    rows = s.execute(
+        "select op, conn_id from information_schema.memory_usage_ops_history"
+    ).rows
+    assert ("kill", s.conn_id) in rows
+
+
+def test_memory_usage_table():
+    cat = Catalog()
+    s = Session(cat)
+    r = s.execute(
+        "select memory_total, memory_current from "
+        "information_schema.memory_usage"
+    ).rows
+    assert r[0][0] > r[0][1] > 0
+
+
+def test_set_knob_starts_daemon():
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("set global tidb_memory_usage_alarm_ratio = 0.9")
+    base = getattr(s.catalog, "_base", s.catalog)
+    wd = getattr(base, "_watchdog", None)
+    assert wd is not None and wd.is_alive()
+    wd.stop_flag.set()
+
+
+def test_kill_interrupts_sleep():
+    cat = Catalog()
+    s = Session(cat)
+    errors = []
+
+    def runner():
+        try:
+            s.execute("select sleep(10)")
+        except Exception as e:
+            errors.append(str(e))
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(0.3)
+    s.killer.kill()
+    t.join(timeout=5)
+    assert not t.is_alive() and errors and "interrupted" in errors[0]

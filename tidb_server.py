@@ -59,6 +59,9 @@ def main() -> int:
     sp = args.status_port if args.status_port is not None else cfg.status_port
     srv = Server(catalog, host=cfg.host, port=cfg.port, status_port=sp)
     srv.stats_handle.interval_s = cfg.auto_analyze_interval_s
+    from tidb_tpu.utils.watchdog import ensure_watchdog
+
+    ensure_watchdog(catalog)  # memory alarm / expensive-query / mem-limit
     print(
         f"tidb_tpu listening on {cfg.host}:{srv.port} (store={cfg.store})",
         flush=True,

@@ -600,7 +600,13 @@ def _json_set_path(doc, parts, value, mode):
     created only for trailing member sets; out-of-range array indexes
     append."""
     if not parts:
-        return value if mode in ("set", "replace") else doc
+        if mode in ("set", "replace"):
+            return value
+        if mode == "array_append":
+            # root append: MySQL appends to a root array, autowraps a
+            # root scalar/object
+            return doc + [value] if isinstance(doc, list) else [doc, value]
+        return doc
     cur = doc
     for p in parts[:-1]:
         nxt = None
@@ -836,7 +842,11 @@ def _json_pyfn(e: Func):
                     hits.append(path)
                 elif isinstance(v, dict):
                     for k, vv in v.items():
-                        walk(vv, f'{path}.{k}')
+                        seg = (
+                            f".{k}" if re.fullmatch(r"\w+", k)
+                            else f'."{k}"'
+                        )
+                        walk(vv, path + seg)
                 elif isinstance(v, list):
                     for i, vv in enumerate(v):
                         walk(vv, f"{path}[{i}]")

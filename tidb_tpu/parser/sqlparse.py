@@ -1408,13 +1408,6 @@ class Parser:
                 return ast.Call("date_add", [d, ast.Interval(n, unit)])
             if self.accept_op("("):
                 args = []
-                distinct_fn = False
-                if name.lower() in (
-                    "json_arrayagg", "json_objectagg", "any_value",
-                    "variance", "var_pop", "var_samp", "std", "stddev",
-                    "stddev_pop", "stddev_samp",
-                ):
-                    distinct_fn = self.accept_kw("distinct")
                 if not self.at_op(")"):
                     args.append(self.parse_expr())
                     while self.accept_op(","):
@@ -1423,7 +1416,7 @@ class Parser:
                 low0 = name.lower()
                 if low0 == "json_arrayagg" and len(args) == 1:
                     return ast.AggCall(
-                        "json_arrayagg", args[0], distinct_fn,
+                        "json_arrayagg", args[0], False,
                         separator="\x00json_array",
                     )
                 if low0 == "json_objectagg" and len(args) == 2:
@@ -1438,8 +1431,9 @@ class Parser:
                     "any_value", "variance", "var_pop", "var_samp",
                     "std", "stddev", "stddev_pop", "stddev_samp",
                 ) and len(args) == 1:
-                    # expanded by planner (_rewrite_derived_aggs)
-                    return ast.AggCall(low0, args[0], distinct_fn)
+                    # expanded by planner (_rewrite_derived_aggs);
+                    # DISTINCT is not accepted here, like MySQL
+                    return ast.AggCall(low0, args[0], False)
                 if name.lower() in _WINDOW_ONLY_FUNCS:
                     low = name.lower()
                     offset = 1

@@ -474,11 +474,12 @@ class ExprBinder:
 
             return Literal(type=_F64, value=rng.random())
         if op == "sleep":
-            import time as _time
+            from tidb_tpu.utils.sqlkiller import interruptible_sleep
 
             a = self.lower(e.args[0])
             if isinstance(a, Literal) and isinstance(a.value, (int, float)):
-                _time.sleep(min(max(float(a.value), 0.0), 300.0))
+                # killable: KILL QUERY / watchdogs abort a SLEEP mid-wait
+                interruptible_sleep(min(max(float(a.value), 0.0), 300.0))
             return Literal(type=INT64, value=0)
         if op == "benchmark":
             # evaluated-for-timing in MySQL; here the whole plan is one

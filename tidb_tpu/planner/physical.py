@@ -2077,6 +2077,18 @@ class PhysicalExecutor:
         footprint is known up front, so over-quota queries are rejected
         with a tracker report instead of being killed mid-flight."""
         quota = self.quota_bytes
+        # working-set estimate (inputs + operator tiles) — always
+        # computed: the instance watchdog ranks sessions by it when the
+        # server memory limit is breached (servermemorylimit.go:51)
+        ws = 0
+        for _nid, b in inputs.items():
+            nb = b.capacity
+            for dc in b.cols.values():
+                nb += b.capacity * (dc.data.dtype.itemsize + 1)
+            ws += nb
+        for nid, cap in caps.items():
+            ws += 2 * cap * cq.widths.get(nid, 64)
+        self.last_working_set = ws
         if not quota:
             return
         from tidb_tpu.utils.failpoint import inject

@@ -189,8 +189,20 @@ def err_packet(errno: int, message: str, sqlstate: str = "HY000") -> bytes:
     )
 
 
-def eof_packet() -> bytes:
-    return b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+#: server status flags for cursors (reference: conn_stmt.go useCursor —
+#: EXECUTE with CURSOR_TYPE_READ_ONLY answers column defs only, rows
+#: stream through COM_STMT_FETCH)
+SERVER_STATUS_CURSOR_EXISTS = 0x0040
+SERVER_STATUS_LAST_ROW_SENT = 0x0080
+CURSOR_TYPE_READ_ONLY = 0x01
+
+
+def eof_packet(status: int = 0) -> bytes:
+    return (
+        b"\xfe"
+        + struct.pack("<H", 0)
+        + struct.pack("<H", SERVER_STATUS_AUTOCOMMIT | status)
+    )
 
 
 def _mysql_type(t: Optional[SQLType]) -> int:

@@ -28,6 +28,7 @@ COM_STMT_EXECUTE = 0x17
 COM_STMT_SEND_LONG_DATA = 0x18
 COM_STMT_CLOSE = 0x19
 COM_STMT_RESET = 0x1A
+COM_STMT_FETCH = 0x1C
 
 
 class Server:
@@ -174,13 +175,59 @@ class Server:
                     if sid not in stmts:
                         io.write_packet(P.err_packet(1243, "unknown stmt"))
                         continue
-                    sql, nparams, ptypes = stmts[sid]
+                    sql, nparams, ptypes = stmts[sid][:3]
                     _sid, params, ptypes = P.parse_stmt_execute(
                         payload, nparams, ptypes
                     )
                     stmts[sid][2] = ptypes
                     r = sess.execute_prepared(f"__c{sid}", params)
-                    self._write_result(io, r, binary=True, sess=sess)
+                    # CURSOR_TYPE_READ_ONLY: buffer the resultset and
+                    # answer column defs only; rows stream through
+                    # COM_STMT_FETCH (reference conn_stmt.go:153
+                    # useCursor — JDBC setFetchSize & BI tools)
+                    flags = payload[4] if len(payload) > 4 else 0
+                    if (flags & P.CURSOR_TYPE_READ_ONLY) and r.columns:
+                        types = (
+                            getattr(r, "types", None)
+                            or [None] * len(r.columns)
+                        )
+                        while len(stmts[sid]) < 4:
+                            stmts[sid].append(None)
+                        stmts[sid][3] = [list(r.rows), types, 0]
+                        io.write_packet(P.lenenc_int(len(r.columns)))
+                        for name, t in zip(r.columns, types):
+                            io.write_packet(P.column_def(name, t))
+                        io.write_packet(
+                            P.eof_packet(P.SERVER_STATUS_CURSOR_EXISTS)
+                        )
+                    else:
+                        self._write_result(io, r, binary=True, sess=sess)
+                elif cmd == COM_STMT_FETCH:
+                    import struct as _st
+
+                    fsid = _st.unpack_from("<I", payload, 0)[0]
+                    nfetch = _st.unpack_from("<I", payload, 4)[0]
+                    ent = stmts.get(fsid)
+                    cur = ent[3] if ent is not None and len(ent) > 3 else None
+                    if cur is None:
+                        io.write_packet(
+                            P.err_packet(1243, "no open cursor for stmt")
+                        )
+                        continue
+                    rows, types, pos = cur
+                    chunk = rows[pos : pos + max(nfetch, 1)]
+                    for row in chunk:
+                        io.write_packet(P.binary_row(row, types))
+                    cur[2] = pos + len(chunk)
+                    if cur[2] >= len(rows):
+                        ent[3] = None  # drained: close the cursor
+                        io.write_packet(
+                            P.eof_packet(P.SERVER_STATUS_LAST_ROW_SENT)
+                        )
+                    else:
+                        io.write_packet(
+                            P.eof_packet(P.SERVER_STATUS_CURSOR_EXISTS)
+                        )
                 elif cmd == COM_STMT_CLOSE:
                     import struct as _st
 
@@ -192,6 +239,12 @@ class Server:
                             pass
                     # no response by protocol
                 elif cmd == COM_STMT_RESET:
+                    import struct as _st
+
+                    rsid = _st.unpack_from("<I", payload, 0)[0]
+                    ent = stmts.get(rsid)
+                    if ent is not None and len(ent) > 3:
+                        ent[3] = None  # drop any open cursor
                     io.write_packet(P.ok_packet())
                 else:
                     io.write_packet(

@@ -1480,6 +1480,11 @@ class Session:
             self._current_stmt = (
                 getattr(s, "_source_sql", type(s).__name__), time.time()
             )
+            from tidb_tpu.utils import sqlkiller as _sk
+
+            # host-side blocking builtins (SLEEP) poll this session's
+            # killer via the thread-local — KILL/watchdogs reach them
+            _sk.set_current(self.killer)
         bill_t0 = t0
         try:
             if top and self.resource_group != "default":
@@ -1795,6 +1800,7 @@ class Session:
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0:
                         return 0
+                    self.killer.check()  # KILL / watchdogs abort waits
                     cv.wait(min(remaining, 0.1))
         if op == "release_lock":
             with cv:
@@ -2522,6 +2528,16 @@ class Session:
                 self.user_vars[s.name.lstrip("@")] = s.value
             else:
                 self.vars.set(s.name, s.value, s.scope)
+                if s.name.lower() in (
+                    "tidb_server_memory_limit",
+                    "tidb_memory_usage_alarm_ratio",
+                    "tidb_expensive_query_time_threshold",
+                ):
+                    # the instance watchdog starts lazily at first touch
+                    # of its knobs (memoryusagealarm/servermemorylimit)
+                    from tidb_tpu.utils.watchdog import ensure_watchdog
+
+                    ensure_watchdog(self.catalog)
                 if s.name.lower() == "tidb_gc_life_time":
                     # side effect: the storage GC horizon is engine-wide.
                     # The sysvar is GLOBAL-only (set() above enforces

@@ -292,13 +292,13 @@ class Catalog:
     _IS_TABLES = (
         "tables", "columns", "schemata", "statistics", "slow_query",
         "statements_summary", "metrics", "top_sql", "resource_groups",
-        "sequences",
+        "sequences", "memory_usage", "memory_usage_ops_history",
     )
 
     def _infoschema_table(self, name: str) -> Table:
         if name in (
             "slow_query", "statements_summary", "metrics", "top_sql",
-            "resource_groups",
+            "resource_groups", "memory_usage", "memory_usage_ops_history",
         ):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
@@ -385,6 +385,53 @@ class Catalog:
                             nu = 0 if iname in t0.unique_indexes else 1
                             for i, cn in enumerate(t0.indexes[iname], 1):
                                 rows.append((db, tn, iname, i, cn, nu))
+        elif name == "memory_usage":
+            # instance memory snapshot (reference:
+            # information_schema.memory_usage over the watchdog state)
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.watchdog import (
+                gvar, host_memory, parse_mem_limit,
+            )
+
+            rss, total = host_memory()
+            wd = getattr(self, "_watchdog", None)
+            limit = parse_mem_limit(
+                gvar(self, "tidb_server_memory_limit", "0"), total
+            )
+            schema = TableSchema(
+                [("memory_total", INT64), ("memory_limit", INT64),
+                 ("memory_current", INT64),
+                 ("memory_usage_alarm_ratio", FLOAT64),
+                 ("alarm_records", INT64), ("watchdog_samples", INT64)]
+            )
+            rows = [(
+                total, limit, rss,
+                float(gvar(self, "tidb_memory_usage_alarm_ratio", 0.7)),
+                len(wd.alarm_records) if wd else 0,
+                wd.samples if wd else 0,
+            )]
+        elif name == "memory_usage_ops_history":
+            # watchdog actions: instance-limit kills + alarm records
+            from tidb_tpu.dtypes import FLOAT64
+
+            wd = getattr(self, "_watchdog", None)
+            schema = TableSchema(
+                [("time", FLOAT64), ("op", STRING), ("conn_id", INT64),
+                 ("memory_current", INT64), ("memory_limit", INT64),
+                 ("sql_text", STRING)]
+            )
+            rows = []
+            if wd is not None:
+                for r in wd.alarm_records:
+                    rows.append(
+                        (r["time"], "alarm", 0, r["rss"],
+                         int(r["ratio"] * r["total"]), "")
+                    )
+                for r in wd.kill_records:
+                    rows.append(
+                        (r["time"], "kill", r["conn_id"], r["rss"],
+                         r["limit"], r["sql"])
+                    )
         elif name == "sequences":
             # "start_value" (not the reference's START): START is a
             # reserved word in this parser and would be unselectable

@@ -41,3 +41,32 @@ class SQLKiller:
             raise QueryKilled(
                 "query interrupted (max_execution_time exceeded)"
             )
+
+
+# The killer of the statement currently executing on THIS thread
+# (set by Session._execute_stmt): host-side blocking builtins (SLEEP,
+# GET_LOCK waits) poll it so KILL and the instance watchdogs can abort
+# them — the reference's sqlkiller is likewise reachable from any
+# executor goroutine.
+_current = threading.local()
+
+
+def set_current(killer) -> None:
+    _current.killer = killer
+
+
+def current_check() -> None:
+    k = getattr(_current, "killer", None)
+    if k is not None:
+        k.check()
+
+
+def interruptible_sleep(seconds: float) -> None:
+    """time.sleep in 50ms slices, polling the current killer."""
+    deadline = time.monotonic() + seconds
+    while True:
+        current_check()
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(remaining, 0.05))

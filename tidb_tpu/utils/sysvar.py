@@ -279,6 +279,314 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
     ]
 }
 
+# round-5 compatibility surface (reference sysvar.go defaults,
+# prioritized by what mysql-connector / JDBC / mysqlclient / common
+# ORMs SET or SELECT at connect time). ADDITIVE ONLY: an entry above
+# (with its validator/scope/default) always wins over a compat entry
+# of the same name. Entries without a validator round-trip any value;
+# behavioral knobs with no analog here validate + persist only.
+_COMPAT_VARS = [
+            # -- MySQL connector handshake set ----------------------
+            ("character_set_client", "utf8mb4", "both", None),
+            ("character_set_connection", "utf8mb4", "both", None),
+            ("character_set_results", "utf8mb4", "both", None),
+            ("character_set_server", "utf8mb4", "both", None),
+            ("character_set_database", "utf8mb4", "both", None),
+            ("character_set_system", "utf8mb3", "readonly", None),
+            ("character_set_filesystem", "binary", "both", None),
+            ("collation_connection", "utf8mb4_bin", "both", None),
+            ("collation_database", "utf8mb4_bin", "both", None),
+            ("collation_server", "utf8mb4_bin", "both", None),
+            ("init_connect", "", "global", None),
+            ("interactive_timeout", 28800, "both", _int_range(1, 31536000)),
+            ("wait_timeout", 28800, "both", _int_range(0, 31536000)),
+            ("net_read_timeout", 30, "both", _int_range(1, 31536000)),
+            ("net_write_timeout", 60, "both", _int_range(1, 31536000)),
+            ("net_buffer_length", 16384, "readonly", None),
+            ("max_allowed_packet", 67108864, "both", _int_range(1024, 1 << 30)),
+            ("sql_mode", "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES,"
+             "NO_ZERO_IN_DATE,NO_ZERO_DATE,ERROR_FOR_DIVISION_BY_ZERO,"
+             "NO_ENGINE_SUBSTITUTION", "both", None),
+            ("sql_select_limit", 18446744073709551615, "both", None),
+            ("sql_safe_updates", False, "both", _bool),
+            ("sql_notes", True, "both", _bool),
+            ("sql_warnings", False, "both", _bool),
+            ("sql_log_bin", True, "session", _bool),
+            ("sql_buffer_result", False, "both", _bool),
+            ("sql_quote_show_create", True, "both", _bool),
+            ("sql_auto_is_null", False, "both", _bool),
+            ("sql_big_selects", True, "both", _bool),
+            ("sql_require_primary_key", False, "both", _bool),
+            ("autocommit", True, "both", _bool),
+            ("auto_increment_increment", 1, "both", _int_range(1, 65535)),
+            ("auto_increment_offset", 1, "both", _int_range(1, 65535)),
+            ("tx_isolation", "REPEATABLE-READ", "both", None),
+            ("transaction_isolation", "REPEATABLE-READ", "both", None),
+            ("tx_read_only", False, "both", _bool),
+            ("transaction_read_only", False, "both", _bool),
+            ("default_storage_engine", "InnoDB", "both", None),
+            ("default_tmp_storage_engine", "InnoDB", "both", None),
+            ("storage_engine", "InnoDB", "both", None),
+            ("lower_case_table_names", 2, "readonly", None),
+            ("system_time_zone", "UTC", "readonly", None),
+            ("explicit_defaults_for_timestamp", True, "both", _bool),
+            ("group_concat_max_len", 1048576, "both", _int_range(4, 1 << 34)),
+            ("max_connections", 0, "global", _int_range(0, 100000)),
+            ("max_user_connections", 0, "both", _int_range(0, 100000)),
+            ("max_prepared_stmt_count", -1, "global", None),
+            ("max_sort_length", 1024, "both", _int_range(4, 8388608)),
+            ("max_sp_recursion_depth", 0, "both", _int_range(0, 255)),
+            ("thread_pool_size", 16, "readonly", None),
+            ("performance_schema", False, "readonly", _bool),
+            ("query_cache_type", "OFF", "readonly", None),
+            ("query_cache_size", 0, "readonly", None),
+            ("have_openssl", "YES", "readonly", None),
+            ("have_ssl", "YES", "readonly", None),
+            ("have_query_cache", "NO", "readonly", None),
+            ("have_profiling", "NO", "readonly", None),
+            ("hostname", "tidb-tpu", "readonly", None),
+            ("port", 4000, "readonly", None),
+            ("socket", "", "readonly", None),
+            ("datadir", "/tmp/tidb-tpu", "readonly", None),
+            ("license", "Apache License 2.0", "readonly", None),
+            ("protocol_version", 10, "readonly", None),
+            ("version_comment", "TiDB-on-TPU Server (Apache License 2.0)",
+             "readonly", None),
+            ("version_compile_machine", "x86_64", "readonly", None),
+            ("version_compile_os", "Linux", "readonly", None),
+            ("innodb_buffer_pool_size", 134217728, "readonly", None),
+            ("innodb_flush_log_at_trx_commit", 1, "both", None),
+            ("innodb_file_per_table", True, "readonly", _bool),
+            ("innodb_read_only", False, "readonly", _bool),
+            ("innodb_strict_mode", True, "both", _bool),
+            ("foreign_key_checks", True, "both", _bool),
+            ("unique_checks", True, "both", _bool),
+            ("old_passwords", 0, "both", None),
+            ("default_password_lifetime", 0, "global", None),
+            ("default_authentication_plugin", "mysql_native_password",
+             "readonly", None),
+            ("validate_password.enable", False, "global", _bool),
+            ("secure_auth", True, "readonly", _bool),
+            ("local_infile", False, "global", _bool),
+            ("log_bin", False, "readonly", _bool),
+            ("binlog_format", "ROW", "both", None),
+            ("binlog_row_image", "FULL", "both", None),
+            ("block_encryption_mode", "aes-128-ecb", "both", None),
+            ("div_precision_increment", 4, "both", _int_range(0, 30)),
+            ("lc_time_names", "en_US", "both", None),
+            ("lc_messages", "en_US", "both", None),
+            ("timestamp", 0, "session", None),
+            ("rand_seed1", 0, "session", None),
+            ("rand_seed2", 0, "session", None),
+            ("pseudo_thread_id", 0, "session", None),
+            ("warning_count", 0, "readonly", None),
+            ("error_count", 0, "readonly", None),
+            ("last_insert_id", 0, "session", None),
+            ("identity", 0, "session", None),
+            ("insert_id", 0, "session", None),
+            ("profiling", False, "both", _bool),
+            ("profiling_history_size", 15, "both", None),
+            ("optimizer_switch", "index_merge=on", "both", None),
+            ("optimizer_trace", "enabled=off,one_line=off", "both", None),
+            ("max_heap_table_size", 16777216, "both", None),
+            ("tmp_table_size", 16777216, "both", None),
+            ("table_definition_cache", -1, "global", None),
+            ("table_open_cache", 2000, "global", None),
+            ("open_files_limit", 5000, "readonly", None),
+            ("read_buffer_size", 131072, "both", None),
+            ("read_rnd_buffer_size", 262144, "both", None),
+            ("sort_buffer_size", 262144, "both", None),
+            ("join_buffer_size", 262144, "both", None),
+            ("bulk_insert_buffer_size", 8388608, "both", None),
+            ("long_query_time", 10.0, "both", _float_range(0.0, 31536000.0)),
+            ("log_queries_not_using_indexes", False, "global", _bool),
+            ("event_scheduler", "OFF", "global", None),
+            ("low_priority_updates", False, "both", _bool),
+            ("completion_type", "NO_CHAIN", "both", None),
+            ("concurrent_insert", "AUTO", "global", None),
+            ("delay_key_write", "ON", "global", None),
+            ("flush", False, "global", _bool),
+            ("keep_files_on_create", False, "both", _bool),
+            ("new", False, "both", _bool),
+            ("old", False, "readonly", _bool),
+            ("big_tables", False, "both", _bool),
+            ("check_proxy_users", False, "global", _bool),
+            # -- TiDB compatibility set -----------------------------
+            ("tidb_current_ts", 0, "readonly", None),
+            ("tidb_last_txn_info", "", "readonly", None),
+            ("tidb_last_query_info", "", "readonly", None),
+            ("tidb_config", "", "readonly", None),
+            ("tidb_general_log", False, "global", _bool),
+            ("tidb_pprof_sql_cpu", False, "global", _bool),
+            ("tidb_record_plan_in_slow_log", True, "both", _bool),
+            ("tidb_enable_slow_log", True, "global", _bool),
+            ("tidb_check_mb4_value_in_utf8", True, "global", _bool),
+            ("tidb_opt_write_row_id", False, "session", _bool),
+            ("tidb_batch_insert", False, "session", _bool),
+            ("tidb_batch_delete", False, "session", _bool),
+            ("tidb_batch_commit", False, "session", _bool),
+            ("tidb_dml_batch_size", 0, "both", _int_range(0, 1 << 31)),
+            ("tidb_backoff_lock_fast", 10, "both", None),
+            ("tidb_backoff_weight", 2, "both", None),
+            ("tidb_ddl_reorg_worker_cnt", 4, "both", _int_range(1, 256)),
+            ("tidb_ddl_reorg_batch_size", 256, "both", _int_range(32, 10240)),
+            ("tidb_ddl_reorg_priority", "PRIORITY_LOW", "both", None),
+            ("tidb_enable_ddl", True, "global", _bool),
+            ("tidb_scatter_region", "", "global", None),
+            ("tidb_disable_txn_auto_retry", True, "both", _bool),
+            ("tidb_enable_streaming", False, "session", _bool),
+            ("tidb_enable_rate_limit_action", False, "both", _bool),
+            ("tidb_allow_batch_cop", 1, "both", _int_range(0, 2)),
+            ("tidb_allow_fallback_to_tikv", "", "both", None),
+            ("tidb_enable_tiflash_read_for_write_stmt", True, "both", _bool),
+            ("tidb_isolation_read_engines", "tikv,tiflash,tidb", "both", None),
+            ("tidb_metric_scheme_ttl", 60, "global", None),
+            ("tidb_enable_telemetry", False, "global", _bool),
+            ("tidb_enable_extended_stats", False, "both", _bool),
+            ("tidb_stats_load_sync_wait", 100, "both", None),
+            ("tidb_analyze_version", 2, "both", _int_range(1, 2)),
+            ("tidb_stats_cache_mem_quota", 0, "global", None),
+            ("tidb_mem_quota_analyze", -1, "global", None),
+            ("tidb_enable_fast_analyze", False, "both", _bool),
+            ("tidb_persist_analyze_options", True, "global", _bool),
+            ("tidb_opt_prefer_range_scan", False, "both", _bool),
+            ("tidb_opt_limit_push_down_threshold", 100, "both", None),
+            ("tidb_opt_enable_correlation_adjustment", True, "both", _bool),
+            ("tidb_opt_correlation_threshold", 0.9, "both",
+             _float_range(0.0, 1.0)),
+            ("tidb_opt_correlation_exp_factor", 1, "both", None),
+            ("tidb_opt_cpu_factor", 3.0, "both", None),
+            ("tidb_opt_copcpu_factor", 3.0, "both", None),
+            ("tidb_opt_network_factor", 1.0, "both", None),
+            ("tidb_opt_scan_factor", 1.5, "both", None),
+            ("tidb_opt_desc_factor", 3.0, "both", None),
+            ("tidb_opt_seek_factor", 20.0, "both", None),
+            ("tidb_opt_memory_factor", 0.001, "both", None),
+            ("tidb_opt_disk_factor", 1.5, "both", None),
+            ("tidb_opt_concurrency_factor", 3.0, "both", None),
+            ("tidb_opt_insubq_to_join_and_agg", True, "both", _bool),
+            ("tidb_enable_cascades_planner", False, "both", _bool),
+            ("tidb_enable_outer_join_reorder", True, "both", _bool),
+            ("tidb_enable_null_aware_anti_join", True, "both", _bool),
+            ("tidb_opt_join_reorder_threshold", 0, "both",
+             _int_range(0, 63)),
+            ("tidb_enable_noop_functions", "OFF", "both", None),
+            ("tidb_enable_noop_variables", True, "global", _bool),
+            ("tidb_enable_list_partition", True, "both", _bool),
+            ("tidb_enable_table_partition", "ON", "both", None),
+            ("tidb_partition_prune_mode", "dynamic", "both", None),
+            ("tidb_enable_global_index", False, "global", _bool),
+            ("tidb_enable_foreign_key", True, "global", _bool),
+            ("foreign_key_checks_tidb", True, "both", _bool),
+            ("tidb_super_read_only", False, "global", _bool),
+            ("tidb_restricted_read_only", False, "global", _bool),
+            ("tidb_gc_enable", True, "global", _bool),
+            ("tidb_gc_run_interval", "10m0s", "global", None),
+            ("tidb_gc_max_wait_time", 86400, "global", None),
+            ("tidb_gc_scan_lock_mode", "LEGACY", "global", None),
+            ("tidb_gc_concurrency", -1, "global", None),
+            ("tidb_enable_gogc_tuner", True, "global", _bool),
+            ("tidb_server_memory_limit", "80%", "global", None),
+            ("tidb_server_memory_limit_gc_trigger", 0.7, "global", None),
+            ("tidb_server_memory_limit_sess_min_size", 134217728,
+             "global", None),
+            ("tidb_enable_tmp_storage_on_oom", True, "global", _bool),
+            ("tidb_tmp_table_max_size", 67108864, "both", None),
+            ("tidb_mem_oom_action", "CANCEL", "global", None),
+            ("tidb_nontransactional_ignore_error", False, "both", _bool),
+            ("tidb_max_delta_schema_count", 1024, "global", None),
+            ("tidb_enable_point_get_cache", False, "both", _bool),
+            ("tidb_enable_ordered_result_mode", False, "both", _bool),
+            ("tidb_enable_pseudo_for_outdated_stats", False, "both", _bool),
+            ("tidb_enable_prepared_plan_cache", True, "both", _bool),
+            ("tidb_prepared_plan_cache_size", 100, "both",
+             _int_range(1, 100000)),
+            ("tidb_enable_non_prepared_plan_cache", False, "both", _bool),
+            ("tidb_plan_cache_max_plan_size", 2097152, "global", None),
+            ("tidb_ignore_prepared_cache_close_stmt", False, "both", _bool),
+            ("tidb_enable_new_cost_interface", True, "both", _bool),
+            ("tidb_cost_model_version", 2, "both", _int_range(1, 2)),
+            ("tidb_index_join_double_read_penalty_cost_rate", 0.0,
+             "both", None),
+            ("tidb_opt_force_inline_cte", False, "both", _bool),
+            ("tidb_enable_reuse_chunk", True, "both", _bool),
+            ("tidb_store_batch_size", 4, "both", None),
+            ("tidb_committer_concurrency", 128, "global", None),
+            ("tidb_enable_batch_dml", False, "global", _bool),
+            ("tidb_mem_quota_binding_cache", 67108864, "global", None),
+            ("tidb_enable_mutation_checker", True, "both", _bool),
+            ("tidb_txn_assertion_level", "FAST", "both", None),
+            ("tidb_rc_read_check_ts", False, "both", _bool),
+            ("tidb_rc_write_check_ts", False, "both", _bool),
+            ("tidb_sysdate_is_now", False, "both", _bool),
+            ("tidb_table_cache_lease", 3, "global", None),
+            ("tidb_top_sql_max_time_series_count", 100, "global", None),
+            ("tidb_top_sql_max_meta_count", 5000, "global", None),
+            ("tidb_enable_top_sql", False, "global", _bool),
+            ("tidb_enable_historical_stats", True, "global", _bool),
+            ("tidb_enable_plan_replayer_capture", True, "global", _bool),
+            ("tidb_enable_resource_control", True, "global", _bool),
+            ("tidb_resource_control_strict_mode", True, "global", _bool),
+            ("tidb_load_based_replica_read_threshold", "1s", "both", None),
+            ("tidb_low_resolution_tso", False, "both", _bool),
+            ("tidb_replica_read", "leader", "both", None),
+            ("tidb_adaptive_closest_read_threshold", 4096, "both", None),
+            ("tidb_use_plan_baselines", True, "both", _bool),
+            ("tidb_evolve_plan_baselines", False, "both", _bool),
+            ("tidb_capture_plan_baselines", "OFF", "global", None),
+            ("tidb_auto_analyze_start_time", "00:00 +0000", "global", None),
+            ("tidb_auto_analyze_end_time", "23:59 +0000", "global", None),
+            ("tidb_auto_analyze_partition_batch_size", 128, "global", None),
+            ("tidb_max_auto_analyze_time", 43200, "global", None),
+            ("tidb_read_staleness", 0, "session", None),
+            ("tidb_expensive_query_time_threshold", 60, "global",
+             _int_range(0, 1 << 31)),
+            ("tidb_memory_usage_alarm_ratio", 0.7, "global",
+             _float_range(0.0, 1.0)),
+            ("tidb_memory_usage_alarm_keep_record_num", 5, "global", None),
+            ("tidb_memory_debug_mode_min_heap_inuse", 0, "both", None),
+            ("tidb_memory_debug_mode_alarm_ratio", 0, "both", None),
+            ("tidb_opt_range_max_size", 67108864, "both", None),
+            ("tidb_opt_advanced_join_hint", True, "both", _bool),
+            ("tidb_opt_use_invisible_indexes", False, "session", _bool),
+            ("tidb_shard_allocate_step", 9223372036854775807, "both", None),
+            ("tidb_generate_binary_plan", True, "global", _bool),
+            ("tidb_external_ts", 0, "global", None),
+            ("tidb_enable_external_ts_read", False, "both", _bool),
+            ("tidb_ttl_job_enable", True, "global", _bool),
+            ("tidb_ttl_scan_batch_size", 500, "global", None),
+            ("tidb_ttl_delete_batch_size", 100, "global", None),
+            ("tidb_ttl_delete_rate_limit", 0, "global", None),
+            ("tidb_ttl_running_tasks", -1, "global", None),
+            ("tidb_stmt_summary_max_stmt_count", 3000, "global", None),
+            ("tidb_stmt_summary_max_sql_length", 4096, "global", None),
+            ("tidb_stmt_summary_refresh_interval", 1800, "global", None),
+            ("tidb_stmt_summary_history_size", 24, "global", None),
+            ("tidb_stmt_summary_internal_query", False, "global", _bool),
+            ("tidb_enable_column_tracking", True, "global", _bool),
+            ("tidb_track_aggregate_memory_usage", True, "both", _bool),
+            ("tidb_tso_client_batch_max_wait_time", 0.0, "global", None),
+            ("tidb_enable_tso_follower_proxy", False, "global", _bool),
+            ("tidb_query_log_max_len", 4096, "global", None),
+            ("tidb_hashagg_partial_concurrency", -1, "both", None),
+            ("tidb_hashagg_final_concurrency", -1, "both", None),
+            ("tidb_streamagg_concurrency", 1, "both", None),
+            ("tidb_merge_join_concurrency", 1, "both", None),
+            ("tidb_index_lookup_join_concurrency", -1, "both", None),
+            ("tidb_index_merge_intersection_concurrency", -1, "both", None),
+            ("tidb_enable_index_merge_join", False, "both", _bool),
+            ("tidb_mpp_store_fail_ttl", "60s", "both", None),
+            ("tidb_enforce_mpp", False, "session", _bool),
+            ("tidb_opt_broadcast_cartesian_join", 1, "both", None),
+            ("tidb_mpp_version", -1, "both", None),
+            ("tidb_max_tiflash_threads", -1, "both", None),
+            ("tidb_min_paging_size", 128, "both", None),
+            ("tidb_max_paging_size", 50000, "both", None),
+]
+
+for _n, _d, _sc, _v in _COMPAT_VARS:
+    SYSVAR_DEFS.setdefault(_n, SysVarDef(_n, _d, _sc, _v))
+
 
 class SysVars:
     """Session view over globals; SET GLOBAL updates the shared store."""
