@@ -72,6 +72,28 @@ def masked(v, c, s):
 
 _m, masked_ms = timed(masked, vals, contrib, seg)
 
+# ---- kernel #2: streaming prefix sum vs XLA cumsum -----------------
+from tidb_tpu.executor.pallas_kernels import prefix_sum_i32
+
+PN = int(os.environ.get("PV_PN", str(8_388_608)))
+mask = jnp.asarray(rng.random(PN) < 0.3)
+ps_out, ps_ms = timed(lambda m: prefix_sum_i32(m), mask)
+xla_out, xla_ms = timed(
+    jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32))), mask
+)
+prefix_ok = bool((np.asarray(ps_out) == np.asarray(xla_out)).all())
+out.update(
+    {
+        "prefix_n": PN,
+        "prefix_kernel_ms": round(ps_ms, 3),
+        "prefix_xla_cumsum_ms": round(xla_ms, 3),
+        "prefix_numerics_ok": prefix_ok,
+        "prefix_kernel_beats_xla": bool(ps_ms < xla_ms),
+    }
+)
+print("prefix sum:", ps_ms, "ms vs xla", xla_ms, "ms, ok:", prefix_ok,
+      flush=True)
+
 ref64 = np.asarray(ref_out)
 got = np.asarray(kernel_out)
 rel = np.abs(got - ref64) / np.maximum(np.abs(ref64), 1.0)

@@ -107,3 +107,85 @@ def test_enabled_path_through_sql():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "PALLAS_SQL_OK" in out.stdout
+
+
+class TestPrefixSum:
+    """Kernel #2: streaming prefix sum (interpret-mode parity; hardware
+    validation rides scripts/pallas_validate.py at the next tunnel
+    window). Same clean-child pattern as the slot-sum tests: the axon
+    plugin breaks pallas lowering registration in-process."""
+
+    CHILD2 = textwrap.dedent(
+        """
+        import sys; sys.path.insert(0, REPO_PATH)
+        import tidb_tpu
+        import numpy as np, jax.numpy as jnp
+        from tidb_tpu.executor.pallas_kernels import (
+            prefix_sum_i32, prefix_sum_reference,
+        )
+
+        rng = np.random.default_rng(11)
+        for n in (100, 1024, 3001, 5000, 8192):
+            x = jnp.asarray(rng.random(n) < 0.3)
+            got = prefix_sum_i32(x, interpret=True)
+            want = prefix_sum_reference(x)
+            assert got.shape == want.shape, (got.shape, want.shape)
+            assert (np.asarray(got) == np.asarray(want)).all(), n
+        xi = jnp.asarray(rng.integers(0, 5, 3001).astype(np.int32))
+        assert (
+            np.asarray(prefix_sum_i32(xi, interpret=True))
+            == np.asarray(prefix_sum_reference(xi))
+        ).all()
+        print("PREFIX_OK")
+        """
+    )
+
+    def test_parity(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, "-c",
+             self.CHILD2.replace("REPO_PATH", repr(REPO))],
+            capture_output=True, text=True, timeout=600, cwd="/tmp",
+            env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "PREFIX_OK" in out.stdout
+
+    def test_dense_compaction_uses_kernel(self):
+        # end-to-end in a clean child: dense-path GROUP BY compacts
+        # identically with the Pallas scan (interpret) and jnp
+        child = textwrap.dedent(
+            """
+            import sys; sys.path.insert(0, REPO_PATH)
+            import os
+            import tidb_tpu
+            from tidb_tpu.session import Session
+
+            def run():
+                s = Session()
+                s.execute("create table t (k int, v int)")
+                rows = ", ".join(f"({i % 97}, {i})" for i in range(500))
+                s.execute(f"insert into t values {rows}")
+                return s.execute(
+                    "select k, sum(v) from t group by k order by k"
+                ).rows
+
+            base = run()
+            os.environ["TIDB_TPU_PALLAS"] = "1"
+            os.environ["TIDB_TPU_PALLAS_INTERPRET"] = "1"
+            assert run() == base
+            print("COMPACT_OK")
+            """
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, "-c", child.replace("REPO_PATH", repr(REPO))],
+            capture_output=True, text=True, timeout=600, cwd="/tmp",
+            env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "COMPACT_OK" in out.stdout

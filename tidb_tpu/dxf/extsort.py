@@ -142,3 +142,92 @@ def cleanup_runs(manifests: List[dict]) -> None:
                 os.unlink(p)
             except OSError:
                 pass
+
+
+# -- round-5 widening: dict columns, composite keys, partitions ------------
+# (reference: br/pkg/lightning/backend/external/merge.go:39 — the merge
+# step handles arbitrary encoded keys; here dictionary codes remap
+# MONOTONICALLY on alignment — merged dictionaries stay sorted — so
+# chunk-sorted runs remain sorted after the code remap, and
+# lexicographic composite order is invariant under per-field monotone
+# maps.)
+
+
+def _dict_lut(local_dict, table_dict) -> np.ndarray:
+    """local-code -> table-code LUT (both dictionaries sorted, so the
+    map is monotone and sorted runs stay sorted). The ONE place the
+    remap is built — remap_codes and remap_comp_fields share it."""
+    loc = np.array([str(x) for x in local_dict], dtype=object)
+    tab = np.asarray(table_dict, dtype=object)
+    return np.searchsorted(tab, loc).astype(np.int64)
+
+
+def remap_codes(svals, rank, local_dict, table_dict):
+    """Remap a dict-coded run's LOCAL codes to the table-global
+    dictionary; NULL entries (rank != 0) carry arbitrary values and are
+    clipped, never looked up meaningfully."""
+    if local_dict is None or not len(local_dict):
+        return svals
+    lut = _dict_lut(local_dict, table_dict)
+    clipped = np.clip(svals, 0, len(lut) - 1)
+    return np.where(rank == 0, lut[clipped], svals)
+
+
+def write_comp_run(path: str, mat: np.ndarray) -> dict:
+    """Spill one chunk's SORTED composite key matrix ([m, k] int64,
+    valid-only rows, lexicographically sorted)."""
+    order = np.lexsort(mat.T[::-1]) if len(mat) else np.zeros(0, np.int64)
+    np.savez(path, mat=mat[order])
+    return {"run": path, "n": int(len(mat))}
+
+
+def read_comp_run(path: str) -> np.ndarray:
+    with np.load(path) as z:
+        return z["mat"]
+
+
+def remap_comp_fields(mat: np.ndarray, dict_fields: dict, table_dicts):
+    """Per-field monotone code remap of a composite key matrix
+    (dict_fields: field index -> local dictionary entries)."""
+    if not dict_fields:
+        return mat
+    mat = mat.copy()
+    for fi, local in dict_fields.items():
+        fi = int(fi)
+        lut = _dict_lut(local, table_dicts[fi])
+        mat[:, fi] = lut[np.clip(mat[:, fi], 0, len(lut) - 1)]
+    return mat
+
+
+def merge_sorted_views(views) -> Optional[np.ndarray]:
+    """Merge sorted structured row views: one stable sort of the
+    concatenation — numpy's timsort exploits the pre-sorted runs."""
+    views = [v for v in views if v is not None and len(v)]
+    if not views:
+        return None
+    if len(views) == 1:
+        return views[0]
+    return np.sort(np.concatenate(views), kind="stable")
+
+
+def install_composite_index(table, cols: tuple, merged_view, version: int) -> bool:
+    """Install a merged composite key view as the _comp_cache entry
+    (the structure _check_unique_composite consults), keyed by the
+    version's covering block uids. Returns False when the table moved."""
+    with table._lock:
+        if table.version != version:
+            return False
+        blocks = [
+            b for b in table._versions[version]
+            if all(c in b.columns for c in cols)
+        ]
+        uids = tuple(b.uid for b in blocks)
+        cache = getattr(table, "_comp_cache", None)
+        if cache is None:
+            cache = table._comp_cache = {}
+        cache[tuple(cols)] = (
+            uids,
+            merged_view if merged_view is not None
+            else _rows_view(np.zeros((0, len(cols)), np.int64)),
+        )
+        return True

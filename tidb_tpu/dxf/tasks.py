@@ -19,6 +19,7 @@ Reference mappings:
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import List
 
@@ -127,17 +128,74 @@ def _import_run(meta, catalog) -> dict:
             # unicode dtype, NOT object: loads without allow_pickle
             arrs[f"s_{name}"] = c.dictionary.astype(str)
     np.savez(staged, **arrs)
+    # EncodeAndSort covers every index shape (round-5 widening):
+    # - partitioned tables split runs per partition AT STAGE TIME,
+    #   mirroring split_by_partition's masks so each run matches one
+    #   landed block (ascending pid order, within-partition row order
+    #   preserved by boolean masking);
+    # - dict-coded (string) columns stage LOCAL codes + the local
+    #   dictionary; the finalizer remaps monotonically to the aligned
+    #   table dictionary (sorted-dict merges keep code order);
+    # - composite keys stage the sorted [m, k] key matrix (the
+    #   _comp_cache structure), remapped per dict field at ingest.
+    if t.partition is not None:
+        pcol = t.partition[1]
+        pc = block.columns.get(pcol)
+        if pc is None or pc.dictionary is not None:
+            # dict-coded partition column: stage-time LOCAL codes and
+            # append-time ALIGNED codes can route rows to different
+            # partitions, so per-partition runs could be matched to the
+            # wrong landed blocks — stage the block only, indexes fall
+            # back to the on-demand delta sort
+            return {"rows": block.nrows, "staged": staged, "runs": [],
+                    "start": meta["start"]}
+        pid = np.zeros(block.nrows, dtype=np.int64)
+        if pc.valid.any():
+            pid[pc.valid] = t.partition_of(pc.data[pc.valid])
+        masks = [(int(p), pid == p) for p in sorted(set(pid.tolist()))]
+    else:
+        masks = [(0, np.ones(block.nrows, dtype=bool))]
     runs = []
     for iname, cols in t.indexes.items():
-        if len(cols) != 1:
+        if any(block.columns.get(c) is None for c in cols):
             continue
-        c = block.columns.get(cols[0])
-        if c is None or c.dictionary is not None:
-            continue  # string codes remap on dict alignment: skip
-        rp = os.path.join(d, f"{tag}_{cols[0]}.npz")
-        man = extsort.write_run(rp, c.data, c.valid, 0)
-        man["col"] = cols[0]
-        runs.append(man)
+        for pi, (_p, m) in enumerate(masks):
+            if len(cols) == 1:
+                c = block.columns[cols[0]]
+                rp = os.path.join(d, f"{tag}_p{pi}_{cols[0]}.npz")
+                man = extsort.write_run(rp, c.data[m], c.valid[m], 0)
+                man["col"] = cols[0]
+                man["part_index"] = pi
+                if c.dictionary is not None:
+                    man["local_dict"] = [
+                        str(x) for x in c.dictionary.tolist()
+                    ]
+                runs.append(man)
+            else:
+                from tidb_tpu.storage.table import Table as _T
+
+                sub = {
+                    n: dataclasses.replace(
+                        cc, data=cc.data[m], valid=cc.valid[m]
+                    )
+                    for n, cc in block.columns.items()
+                }
+                mat = _T._key_matrix(sub, cols)
+                rp = os.path.join(
+                    d, f"{tag}_p{pi}_c_{'_'.join(cols)}.npz"
+                )
+                man = extsort.write_comp_run(rp, mat)
+                man["comp"] = list(cols)
+                man["part_index"] = pi
+                man["block_rows"] = int(m.sum())
+                dfields = {
+                    str(fi): [str(x) for x in sub[c].dictionary.tolist()]
+                    for fi, c in enumerate(cols)
+                    if sub[c].dictionary is not None
+                }
+                if dfields:
+                    man["dict_fields"] = dfields
+                runs.append(man)
     return {"rows": block.nrows, "staged": staged, "runs": runs,
             "start": meta["start"]}
 
@@ -187,48 +245,97 @@ def _import_finalize(meta, results, catalog) -> None:
             os.unlink(r["staged"])
         except OSError:
             pass
-    # Ingest the merged sorted indexes (unpartitioned, numeric single
-    # col — string codes were remapped by dictionary alignment and
-    # partition split re-distributes rows; those fall back to the
-    # on-demand derived argsort)
-    if t.partition is None:
-        run_by_uid: dict = {}  # (col, uid) -> run manifest
-        for r, uids in appended:
-            for man in r.get("runs") or []:
-                if len(uids) == 1:  # unpartitioned: one landed block
-                    run_by_uid[(man["col"], uids[0])] = man
-        cols_with_runs = {c for (c, _u) in run_by_uid}
-        for col in cols_with_runs:
-            while True:
-                version = t.version
-                blocks = list(t.blocks(version))
-                runs = []
-                off = 0
-                for b in blocks:
-                    c = b.columns.get(col)
-                    if c is None:
-                        runs = None
-                        break
-                    man = run_by_uid.get((col, b.uid))
-                    if (
-                        man is not None
-                        and man["n"] == b.nrows
-                        and os.path.exists(man["run"])
-                    ):
-                        # the staged run IS this block's sort: re-offset
-                        svals, rank, rows = extsort.read_run(man["run"])
-                        runs.append((svals, rank, rows + off))
-                    else:
-                        # pre-existing or concurrent block: delta sort
-                        runs.append(extsort.sort_run(c.data, c.valid, off))
-                    off += b.nrows
-                if runs is None:
+    # Ingest the merged sorted indexes. Round-5 widening: dict-coded
+    # columns remap run codes monotonically to the aligned table
+    # dictionary, composite keys merge sorted key-matrix views into the
+    # _comp_cache structure, and partitioned tables match per-partition
+    # runs to their landed blocks by split order.
+    run_by_uid: dict = {}   # (col, uid) -> single-col run manifest
+    comp_by_uid: dict = {}  # (cols tuple, uid) -> composite manifest
+    for r, uids in appended:
+        for man in r.get("runs") or []:
+            pi = man.get("part_index", 0)
+            if pi >= len(uids):
+                continue  # stage/append split disagreed: fall back
+            uid = uids[pi]
+            if "comp" in man:
+                comp_by_uid[(tuple(man["comp"]), uid)] = man
+            else:
+                run_by_uid[(man["col"], uid)] = man
+    cols_with_runs = {c for (c, _u) in run_by_uid}
+    for col in cols_with_runs:
+        tdict = t.dictionaries.get(col)
+        while True:
+            version = t.version
+            blocks = list(t.blocks(version))
+            runs = []
+            off = 0
+            for b in blocks:
+                c = b.columns.get(col)
+                if c is None:
+                    runs = None
                     break
-                merged = extsort.merge_runs(runs)
-                if extsort.install_sorted_index(t, col, merged, version):
-                    break
-        for r, _u in appended:
-            extsort.cleanup_runs(r.get("runs"))
+                man = run_by_uid.get((col, b.uid))
+                if (
+                    man is not None
+                    and man["n"] == b.nrows
+                    and os.path.exists(man["run"])
+                ):
+                    # the staged run IS this block's sort: re-offset
+                    # (and remap local dict codes to the table dict —
+                    # monotone, so the run stays sorted)
+                    svals, rank, rows = extsort.read_run(man["run"])
+                    if man.get("local_dict") is not None:
+                        svals = extsort.remap_codes(
+                            svals, rank, man["local_dict"], tdict
+                        )
+                    runs.append((svals, rank, rows + off))
+                else:
+                    # pre-existing or concurrent block: delta sort
+                    runs.append(extsort.sort_run(c.data, c.valid, off))
+                off += b.nrows
+            if runs is None:
+                break
+            merged = extsort.merge_runs(runs)
+            if extsort.install_sorted_index(t, col, merged, version):
+                break
+    comp_keys = {ck for (ck, _u) in comp_by_uid}
+    for cols in comp_keys:
+        tdicts = [t.dictionaries.get(c) for c in cols]
+        while True:
+            version = t.version
+            views = []
+            for b in t.blocks(version):
+                if any(c not in b.columns for c in cols):
+                    continue
+                man = comp_by_uid.get((cols, b.uid))
+                if (
+                    man is not None
+                    and man.get("block_rows") == b.nrows
+                    and os.path.exists(man["run"])
+                ):
+                    mat = extsort.read_comp_run(man["run"])
+                    mat = extsort.remap_comp_fields(
+                        mat, man.get("dict_fields") or {}, tdicts
+                    )
+                    views.append(extsort._rows_view(mat))
+                else:
+                    from tidb_tpu.storage.table import Table as _T
+
+                    views.append(
+                        np.sort(
+                            extsort._rows_view(
+                                _T._key_matrix(b.columns, cols)
+                            )
+                        )
+                    )
+            merged_view = extsort.merge_sorted_views(views)
+            if extsort.install_composite_index(
+                t, cols, merged_view, version
+            ):
+                break
+    for r, _u in appended:
+        extsort.cleanup_runs(r.get("runs"))
     clear_scan_cache()
 
 

@@ -106,3 +106,65 @@ def slot_sums_reference(values, contrib, seg, slots: int):
         seg[:, None] == jnp.arange(slots, dtype=seg.dtype)[None, :]
     ).astype(jnp.float64)
     return masked @ onehot
+
+
+# ---------------------------------------------------------------------------
+# kernel #2: streaming prefix sum (compaction positions)
+# ---------------------------------------------------------------------------
+# The dense aggregation path compacts surviving groups with
+# `cumsum(occupied)` over the whole dense domain (executor/aggregate.py
+# _dense_compact_group_aggregate) — up to 2^23 elements per statement.
+# XLA lowers big cumsums to a log-depth associative scan: ~2·log2(n)
+# full HBM passes (≈46 passes at 8M). A TPU Pallas grid is SEQUENTIAL,
+# so a running carry in SMEM turns the scan into ONE pass: each tile
+# cumsums in VMEM (VPU), adds the carry, and forwards carry+tile_total.
+# Expected hardware delta (written claim, to be validated in the next
+# tunnel window by scripts/pallas_validate.py): ~10-20x for the scan op
+# at 8M rows (one 34MB pass vs tens), worth ~1-2ms of Q18's dense
+# compaction per statement on v5e-class HBM.
+# Reference seam: the spill/compaction machinery this accelerates is
+# the analog of pkg/util/chunk row-container compaction.
+
+
+def _prefix_sum_kernel(x_ref, out_ref, carry_ref):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[0] = jnp.int32(0)
+
+    t = x_ref[0, :]
+    c = jnp.cumsum(t, dtype=jnp.int32)
+    out_ref[0, :] = c + carry_ref[0]
+    carry_ref[0] = carry_ref[0] + c[-1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def prefix_sum_i32(x, interpret: bool = False):
+    """Inclusive int32 prefix sum over a 1-D int/bool array in ONE
+    sequential-grid pass (running carry in SMEM scratch)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x.shape[0]
+    xi = x.astype(jnp.int32)
+    pad = (-n) % TILE
+    if pad:
+        xi = jnp.pad(xi, (0, pad))
+    npad = n + pad
+    out = pl.pallas_call(
+        _prefix_sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, npad), jnp.int32),
+        grid=(npad // TILE,),
+        in_specs=[pl.BlockSpec((1, TILE), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, TILE), lambda i: (0, i)),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(xi.reshape(1, npad))
+    return out[0, :n]
+
+
+def prefix_sum_reference(x):
+    return jnp.cumsum(x.astype(jnp.int32))
