@@ -591,7 +591,10 @@ def group_aggregate(
     )
     dense_ok = (
         widths_ok
-        and dense_bits <= 23
+        and dense_bits <= 26  # 2^26 domain = 536MB/lane: SF10 orderkeys
+        # stay on the dense path (the claim loop's serial probe passes
+        # are catastrophic at 60M rows); the 4*cap guard below still
+        # bounds the domain-to-batch waste
         and (1 << dense_bits) <= max(4 * cap, 1 << 16)
     )
     if use_sorted and not (dense_ok and dense_bits <= 7):
