@@ -80,10 +80,13 @@ def merge_runs(runs: List[tuple]) -> Optional[tuple]:
     """K-way merge by pairwise rounds: log2(k) vectorized passes.
     Runs must be in global row order (run i's rows precede run i+1's)
     for tie stability."""
+    from tidb_tpu.utils.failpoint import inject
+
     runs = [r for r in runs if r is not None and len(r[0])]
     if not runs:
         return None
     while len(runs) > 1:
+        inject("extsort/merge-round")
         nxt = []
         for i in range(0, len(runs) - 1, 2):
             nxt.append(merge_two(runs[i], runs[i + 1]))
@@ -202,6 +205,9 @@ def remap_comp_fields(mat: np.ndarray, dict_fields: dict, table_dicts):
 def merge_sorted_views(views) -> Optional[np.ndarray]:
     """Merge sorted structured row views: one stable sort of the
     concatenation — numpy's timsort exploits the pre-sorted runs."""
+    from tidb_tpu.utils.failpoint import inject
+
+    inject("extsort/merge-views")
     views = [v for v in views if v is not None and len(v)]
     if not views:
         return None

@@ -3385,6 +3385,9 @@ class Session:
         """Re-evaluate every generated column over the whole table (host
         rebuild, the same full-image protocol as the UPDATE fallback) —
         run after a MODIFY COLUMN reorg converts a dependency."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("ddl/generated-recompute")
         gen = self._gen_exprs_for(t)
         if not gen or not t.blocks():
             return

@@ -56,6 +56,9 @@ class Sequence:
         self._lock = threading.Lock()
 
     def nextval(self) -> int:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("sequence/nextval")
         with self._lock:
             if self._next is None:
                 raise SequenceExhausted(

@@ -829,6 +829,7 @@ class Table:
                     converted[b.uid] = convert(b.columns[name], src_dict)
             with self._lock:
                 if self.version != v:
+                    inject("ddl/modify-column-delta-retry")
                     continue  # concurrent DML: convert the delta, retry
                 if new_type.kind == Kind.STRING:
                     # one table-global dictionary: merge every block's

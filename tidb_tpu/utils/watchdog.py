@@ -90,9 +90,13 @@ class InstanceWatchdog(threading.Thread):
         return gvar(self.catalog, name, default)
 
     def run(self) -> None:  # pragma: no cover - loop plumbing
+        from tidb_tpu.utils.failpoint import FailpointError
+
         while not self.stop_flag.wait(self.interval):
             try:
                 self.sample()
+            except FailpointError:
+                raise  # injected faults must be observable in tests
             except Exception:
                 pass  # the watchdog must never take the engine down
 
@@ -101,7 +105,10 @@ class InstanceWatchdog(threading.Thread):
         return [s for s in list(reg.values()) if s is not None]
 
     def sample(self) -> None:
+        from tidb_tpu.utils.failpoint import inject
         from tidb_tpu.utils.metrics import REGISTRY
+
+        inject("watchdog/sample")
 
         self.samples += 1
         now = time.time()
