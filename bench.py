@@ -406,14 +406,15 @@ def _cache_key(args) -> str:
     return f"{args.query}_sf{args.sf:g}"
 
 
-def _load_tpu_cache(args):
+def _load_tpu_cache(args, exact_only: bool = False):
     """Most recent successful real-TPU measurement of this (query, sf),
-    captured by an earlier bench run while the TPU tunnel was up. Falls
-    back to the same query at the LARGEST other cached sf — the cached
-    entry carries its own sf in the metric name, so the report stays
-    honest — because a real hardware number at a neighboring scale
-    factor says more about the TPU engine than a CPU-backend number at
-    the requested one."""
+    captured by an earlier bench run while the TPU tunnel was up.
+    exact_only=True returns None unless the REQUESTED sf is cached —
+    used to decide whether the cache may be the HEADLINE: a cached
+    capture at a different sf (or an old code version) rides along as
+    detail.stale_tpu_reference instead, and the headline is measured
+    LIVE at the requested config (round-4 verdict: a stale
+    different-config capture must not be the headline)."""
     try:
         with open(_TPU_CACHE) as f:
             cache = json.load(f)
@@ -422,6 +423,8 @@ def _load_tpu_cache(args):
     exact = cache.get(_cache_key(args))
     if exact is not None:
         return exact
+    if exact_only:
+        return None
     prefix = f"{args.query}_sf"
     best_sf, best = None, None
     for k, v in cache.items():
@@ -469,15 +472,21 @@ def _tpu_tunnel_up(timeout_s: int = 90) -> bool:
         return False
 
 
-def _cached_tpu_result(args, attempts):
+def _cached_tpu_result(args, attempts, exact_only: bool = False):
     """The most recent real-TPU measurement of this (query, sf), dressed
     with full provenance (the measurement's code version vs the code
-    being benchmarked now, plus the failed attempts that led here) — the
-    fallback when the flaky tunnel is down, clearly labeled rather than
-    degrading the headline to the CPU number."""
-    cached = _load_tpu_cache(args)
+    being benchmarked now, plus the failed attempts that led here).
+    exact_only=True additionally requires the capture's CODE VERSION to
+    match HEAD — only a same-config, same-code hardware capture may be
+    the headline; anything staler becomes detail.stale_tpu_reference
+    under a live measurement."""
+    cached = _load_tpu_cache(args, exact_only=exact_only)
     if cached is None:
         return None
+    if exact_only:
+        cap_v = cached.get("detail", {}).get("captured_at_version")
+        if cap_v != _code_version():
+            return None
     result = dict(cached)
     d = dict(result.get("detail", {}))
     d["cached_tpu_result"] = True
@@ -505,7 +514,7 @@ def supervise(args, passthrough) -> int:
                     "error": "tunnel probe failed: jax.devices() hung/errored",
                 }
             )
-            cached = _cached_tpu_result(args, attempts)
+            cached = _cached_tpu_result(args, attempts, exact_only=True)
             if cached is not None:
                 print(json.dumps(cached))
                 return 0
@@ -532,8 +541,9 @@ def supervise(args, passthrough) -> int:
                 break
         if backend == "tpu" and result is None:
             # The TPU tunnel flaps (round 1 died on it entirely): fall
-            # back to the cached hardware measurement if one exists.
-            cached = _cached_tpu_result(args, attempts)
+            # back to the cached hardware measurement at this exact
+            # config if one exists.
+            cached = _cached_tpu_result(args, attempts, exact_only=True)
             if cached is not None:
                 result = cached
                 break
@@ -556,6 +566,19 @@ def supervise(args, passthrough) -> int:
     detail["attempts"] = attempts
     if detail.get("backend") == "tpu" and not detail.get("cached_tpu_result"):
         _store_tpu_cache(args, result)
+    elif detail.get("backend") != "tpu":
+        # a stale/different-config hardware capture rides along as a
+        # labeled REFERENCE, never as the headline
+        ref = _load_tpu_cache(args)
+        if ref is not None:
+            detail["stale_tpu_reference"] = {
+                "metric": ref.get("metric"),
+                "value": ref.get("value"),
+                "vs_baseline": ref.get("vs_baseline"),
+                "captured_at_version": ref.get("detail", {}).get(
+                    "captured_at_version"
+                ),
+            }
     print(json.dumps(result))
     return 0
 
