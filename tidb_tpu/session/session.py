@@ -20,6 +20,7 @@ from tidb_tpu.dtypes import Kind, SQLType
 from tidb_tpu.parser import ast, parse
 from tidb_tpu.planner import build_query
 from tidb_tpu.planner.logical import ExprBinder, Schema
+from tidb_tpu.session.ddl import DDLMixin
 from tidb_tpu.planner.physical import PhysicalExecutor
 from tidb_tpu.storage import Catalog, scan_table
 from tidb_tpu.storage.table import TableSchema
@@ -180,7 +181,7 @@ class _SessionCatalog:
         self._base.drop_table(db, name, if_exists)
 
 
-class Session:
+class Session(DDLMixin):
     def __init__(
         self,
         catalog: Optional[Catalog] = None,
@@ -1169,267 +1170,6 @@ class Session:
                     )
         return problems
 
-    # ------------------------------------------------------------------
-    def _guard_column_refs(self, t, db, tname, cn: str, verb: str) -> None:
-        """Refuse column DDL that would break CHECK/FK bookkeeping
-        (reference: modify-column prechecks in pkg/ddl/column.go)."""
-        from tidb_tpu.utils.checkeval import check_columns
-
-        for nm, ex in self._check_exprs_for(t):
-            if cn in check_columns(ex):
-                raise ValueError(
-                    f"cannot {verb} column {cn!r}: used by CHECK {nm!r}"
-                )
-        if verb == "rename":
-            # a rename would orphan the stored expression text; MODIFY
-            # (type conversion) is allowed — dependents recompute after
-            # the reorg (_run_modify_column)
-            for gc, ex in self._gen_exprs_for(t):
-                if cn in check_columns(ex):
-                    raise ValueError(
-                        f"cannot {verb} column {cn!r}: used by "
-                        f"generated column {gc!r}"
-                    )
-        for nm, col, rdb, rtbl, rcol in t.fks:
-            if cn == col:
-                raise ValueError(
-                    f"cannot {verb} column {cn!r}: used by FOREIGN KEY {nm!r}"
-                )
-        for cdb, ctn, nm, _c, rcol, _act in self._fk_children(db, tname):
-            if cn == rcol:
-                raise ValueError(
-                    f"cannot {verb} column {cn!r}: referenced by "
-                    f"FOREIGN KEY {nm!r} on {cdb}.{ctn}"
-                )
-
-    def _run_modify_column(self, t, s) -> None:
-        """ALTER TABLE MODIFY/CHANGE COLUMN (reference: onModifyColumn,
-        pkg/ddl/column.go:518). Lossless (same kind+scale) changes are
-        metadata-only (+ optional rename); lossy changes run the online
-        block-conversion reorg in storage (alter_modify_column docstring
-        maps it onto the F1 write-reorg phase). Uniqueness of covering
-        UNIQUE indexes is re-validated post-conversion — a narrowing
-        that collapses two distinct values into one duplicate aborts."""
-        import numpy as np
-
-        from tidb_tpu.storage import convert as CV
-
-        old_name = (s.col_name or s.column.name).lower()
-        new_name = s.column.name.lower()
-        types = t.schema.types
-        if old_name not in types:
-            raise ValueError(f"unknown column {old_name!r}")
-        self._reject_generated_targets(t, [old_name], "MODIFY")
-        if getattr(s.column, "generated", None) is not None:
-            # MySQL error 3106: changing a base column into a generated
-            # column with MODIFY/CHANGE is not supported
-            raise ValueError(
-                "cannot convert a column to GENERATED with MODIFY/CHANGE"
-            )
-        if new_name != old_name:
-            # a rename (CHANGE) would orphan dependent generated
-            # expression text — guard BOTH the meta-only and the
-            # conversion paths before any state is published
-            from tidb_tpu.utils.checkeval import check_columns as _gcc
-
-            for gc, ex in self._gen_exprs_for(t):
-                if old_name in _gcc(ex):
-                    raise ValueError(
-                        f"cannot rename column {old_name!r}: used by "
-                        f"generated column {gc!r}"
-                    )
-        if new_name != old_name and new_name in types:
-            raise ValueError(f"column {new_name!r} exists")
-        old_t, new_t = types[old_name], s.column.type
-        enums = t.schema.enums or {}
-        sets_ = t.schema.sets or {}
-        if old_name in enums or old_name in sets_ or old_name in t.schema.json_cols:
-            raise ValueError(
-                "MODIFY COLUMN on ENUM/SET/JSON columns is not supported"
-            )
-        if s.column.not_null:
-            for b in t.blocks():
-                if not bool(b.columns[old_name].valid.all()):
-                    raise ValueError(
-                        f"column {old_name!r} contains NULLs: cannot "
-                        "add NOT NULL"
-                    )
-        if CV.meta_only(old_t, new_t):
-            if new_name != old_name:
-                self._guard_column_refs(
-                    t, s.db or self.db, s.name, old_name, "rename"
-                )
-                t.alter_rename_column(old_name, new_name)
-            else:
-                t.bump_version()  # schema barrier for display-only change
-        else:
-            self._guard_column_refs(
-                t, s.db or self.db, s.name, old_name, "modify"
-            )
-            pk = t.schema.primary_key
-            if pk and old_name in pk:
-                raise ValueError(
-                    "MODIFY COLUMN with data conversion on a PRIMARY KEY "
-                    "column is not supported"
-                )
-            conv = CV.make_converter(old_t, new_t, old_name)
-
-            def validate(new_blocks, _t=t, _new=new_name, _old=old_name):
-                # pre-publish: a narrowing can merge previously-distinct
-                # values under a covering UNIQUE index — abort with no
-                # visible state instead of installing duplicates
-                for iname in list(_t.unique_indexes):
-                    cols = [
-                        _new if c == _old else c
-                        for c in (_t.indexes.get(iname) or [])
-                    ]
-                    if _new not in cols:
-                        continue
-                    datas, valid = [], None
-                    for c in cols:
-                        parts = [b.columns[c] for b in new_blocks]
-                        if not parts:
-                            break
-                        d = np.concatenate([p.data for p in parts])
-                        v = np.concatenate([p.valid for p in parts])
-                        datas.append(d)
-                        valid = v if valid is None else (valid & v)
-                    if not datas or valid is None or not valid.any():
-                        continue
-                    keyed = [d[valid] for d in datas]
-                    order = np.lexsort(keyed[::-1])
-                    dup = False
-                    if len(order) > 1:
-                        eq = np.ones(len(order) - 1, dtype=bool)
-                        for d in keyed:
-                            ds = d[order]
-                            eq &= ds[1:] == ds[:-1]
-                        dup = bool(eq.any())
-                    if dup:
-                        raise ValueError(
-                            f"Duplicate entry under unique index "
-                            f"{iname!r} after MODIFY COLUMN conversion"
-                        )
-
-            t.alter_modify_column(
-                old_name, new_t, conv,
-                rename_to=new_name if new_name != old_name else None,
-                validate=validate,
-            )
-        # column DEFAULT follows the column: explicit clause wins; an
-        # existing default migrates across the rename and casts to the
-        # new type (MySQL keeps and converts defaults on MODIFY)
-        dflt = getattr(t, "defaults", None)
-        if dflt is None:
-            dflt = t.defaults = {}
-        if s.default is not None:
-            dflt.pop(old_name, None)
-            dflt[new_name] = s.default
-        elif old_name in dflt:
-            v = dflt.pop(old_name)
-            nk = new_t.kind
-            try:
-                if nk == Kind.STRING:
-                    v = str(v)
-                elif nk in (Kind.INT, Kind.BOOL) and not isinstance(v, bool):
-                    v = int(round(float(v)))
-                elif nk in (Kind.DECIMAL, Kind.FLOAT):
-                    v = float(v)
-                dflt[new_name] = v
-            except (ValueError, TypeError):
-                pass  # unconvertible default: dropped, not corrupted
-        # stored generated columns depending on the converted column
-        # recompute through the reorg (reference: modify-column reorg
-        # re-evaluates dependent generated columns,
-        # pkg/ddl/generated_column.go + column.go:518)
-        from tidb_tpu.utils.checkeval import check_columns as _gc_cols
-
-        if any(
-            old_name in _gc_cols(ex) for _c, ex in self._gen_exprs_for(t)
-        ):
-            self._recompute_generated(t)
-
-    # ------------------------------------------------------------------
-    def _add_index(self, t, name: str, columns, unique: bool = False) -> None:
-        """ADD INDEX through the F1 online schema-state ladder
-        (reference: pkg/ddl/index.go:545 — None -> WriteOnly ->
-        WriteReorg -> Public; DeleteOnly is vacuous because indexes are
-        derived per-version sorted permutations, so deletes can never
-        strand index entries).
-
-        The index registers in WRITE_ONLY first: from that instant every
-        concurrent writer maintains it (uniqueness enforced on appends),
-        while readers still ignore it. The backfill — duplicate
-        validation for UNIQUE plus warming the sorted permutation — then
-        runs WITHOUT any table lock in WRITE_REORG; concurrent DML
-        during the reorg stays correct because writes are checked
-        against the live snapshot and the derived index of any newer
-        version rebuilds from that version's data. Only after the
-        backfill validates does the state flip to PUBLIC, where the
-        planner may use it (index selection and dense-join uniqueness
-        proofs consult public indexes only). Validation failure rolls
-        the registration back."""
-        import numpy as np
-
-        from tidb_tpu.utils import failpoint
-
-        iname = name.lower()
-        if iname in t.indexes:
-            raise ValueError(f"index {name} already exists")
-        cols = [c.lower() for c in columns]
-        unknown = set(cols) - set(t.schema.names)
-        if unknown:
-            raise ValueError(f"unknown columns {sorted(unknown)}")
-
-        # -- state: WRITE_ONLY — writers maintain, readers ignore
-        with t._lock:
-            t.indexes[iname] = cols
-            t.index_states[iname] = "write_only"
-            if unique:
-                t.unique_indexes.add(iname)
-        try:
-            failpoint.inject("ddl/index-write-only")
-            # -- state: WRITE_REORG — lock-free backfill over a snapshot
-            t.index_states[iname] = "write_reorg"
-            failpoint.inject("ddl/index-write-reorg")
-            if unique:
-                if len(cols) == 1:
-                    svals, _perm, nvalid = t._sorted_index(cols[0])
-                    dup = nvalid and len(np.unique(svals[:nvalid])) != nvalid
-                else:
-                    # _sorted_composite skips blocks predating an ALTER
-                    # ADD COLUMN of an indexed column (those rows read
-                    # as NULL -> exempt) and exempts NULL components —
-                    # duplicates are adjacent equals in the sorted view
-                    sv = t._sorted_composite(tuple(cols))
-                    dup = (
-                        sv is not None
-                        and len(sv) > 1
-                        and bool((sv[1:] == sv[:-1]).any())
-                    )
-                if dup:
-                    raise ValueError(
-                        f"cannot create unique index {name}: duplicate "
-                        f"entries in columns ({', '.join(cols)})"
-                    )
-            # warm the physical index so the first query doesn't pay
-            # the argsort (the backfill write step)
-            t._sorted_index(cols[0])
-            failpoint.inject("ddl/index-before-public")
-        except BaseException:
-            with t._lock:  # roll the registration back
-                t.indexes.pop(iname, None)
-                t.index_states.pop(iname, None)
-                t.unique_indexes.discard(iname)
-            raise
-        # -- state: PUBLIC — the planner may read it
-        t.index_states[iname] = "public"
-        # schema barrier: in-flight transactions whose shadow predates
-        # the index must conflict at commit, not install rows that were
-        # never checked against it
-        t.bump_version()
-
-    # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
         if self._killed_conn:
             raise ConnectionError(
@@ -3195,60 +2935,6 @@ class Session:
         names = [c.name for c in plan.schema]
         return Result(names, rows, types=[c.type for c in plan.schema])
 
-    def _encode_partition(self, schema, part):
-        """AST partition spec -> table metadata with raw-encoded RANGE
-        bounds (days for DATE columns, scaled ints for DECIMAL).
-        Reference: pkg/table/tables/partition.go bound evaluation."""
-        from tidb_tpu.dtypes import date_to_days, datetime_to_micros
-
-        kind, pcol, spec = part
-        pcol = pcol.lower()
-        ptype = schema.types.get(pcol)
-        if ptype is None:
-            raise ValueError(f"unknown partition column {pcol!r}")
-        if ptype.kind not in (Kind.INT, Kind.DATE, Kind.DATETIME, Kind.DECIMAL):
-            raise ValueError(
-                "partitioning needs an integer-encoded column "
-                f"({pcol!r} is {ptype.kind.value})"
-            )
-        if kind == "hash":
-            n = int(spec)
-            if n < 1:
-                raise ValueError("PARTITIONS must be >= 1")
-            return ("hash", pcol, n)
-        parts = []
-        prev = None
-        for pname, upper in spec:
-            if upper is None:
-                enc = None
-            else:
-                c = ExprBinder._const_arg(upper)
-                if c is None:
-                    raise ValueError(
-                        "VALUES LESS THAN expects a constant"
-                    )
-                v = c.value
-                if ptype.kind == Kind.DATE and isinstance(v, str):
-                    enc = int(date_to_days(v))
-                elif ptype.kind == Kind.DATETIME and isinstance(v, str):
-                    enc = int(datetime_to_micros(v))
-                elif ptype.kind == Kind.DECIMAL:
-                    enc = round(float(v) * 10**ptype.scale)
-                else:
-                    enc = int(v)
-                if prev is not None and enc <= prev:
-                    raise ValueError(
-                        "VALUES LESS THAN must be strictly increasing"
-                    )
-                prev = enc
-            parts.append((pname.lower(), enc))
-        nones = [i for i, (_n, u) in enumerate(parts) if u is None]
-        if nones and nones != [len(parts) - 1]:
-            raise ValueError("MAXVALUE must be the last partition")
-        return ("range", pcol, parts)
-
-    # ------------------------------------------------------------------
-    # -- CHECK / FOREIGN KEY enforcement -------------------------------
     def _check_exprs_for(self, t):
         exprs = getattr(t, "_check_exprs", None)
         if exprs is None or len(exprs) != len(t.checks):
@@ -3266,197 +2952,6 @@ class Session:
     # on write here — generated expressions are required deterministic,
     # so eager evaluation is observationally identical; the flag is kept
     # for SHOW CREATE / information_schema fidelity.
-    def _validate_generated(self, s, auto, colnames):
-        """Validate generated-column clauses of a CREATE TABLE; returns
-        the [(col, expr text, stored)] metadata list (definition order,
-        which is also a valid evaluation order)."""
-        if not any(c.generated is not None for c in s.columns):
-            return []
-        from tidb_tpu.utils.checkeval import (
-            CheckEvalError, check_columns, validate_expr_ops,
-        )
-
-        ai_name = auto[0].name.lower() if auto else None
-        gen_names = {
-            c.name.lower() for c in s.columns if c.generated is not None
-        }
-        base_cols = colnames - gen_names
-        pk_cols = {p.lower() for p in s.primary_key}
-        earlier_gen: set = set()
-        meta = []
-        for c in s.columns:
-            n = c.name.lower()
-            if c.generated is None:
-                continue
-            txt, expr, stored = c.generated
-            try:
-                validate_expr_ops(expr)
-            except CheckEvalError as ex:
-                raise ValueError(f"generated column {n!r}: {ex}") from None
-            deps = check_columns(expr)
-            bad = deps - base_cols - earlier_gen
-            if bad:
-                # MySQL: a generated column may reference base columns
-                # anywhere but generated columns only if defined EARLIER
-                raise ValueError(
-                    f"generated column {n!r} references unknown or "
-                    f"later generated columns {sorted(bad)}"
-                )
-            if ai_name is not None and ai_name in deps:
-                raise ValueError(
-                    f"generated column {n!r} cannot depend on the "
-                    "AUTO_INCREMENT column"
-                )
-            if c.default is not None:
-                raise ValueError(
-                    f"generated column {n!r} cannot have a DEFAULT value"
-                )
-            if c.auto_increment:
-                raise ValueError(
-                    f"generated column {n!r} cannot be AUTO_INCREMENT"
-                )
-            if not stored and n in pk_cols:
-                raise ValueError(
-                    "virtual generated column cannot be a PRIMARY KEY "
-                    "(make it STORED)"
-                )
-            earlier_gen.add(n)
-            meta.append((n, txt, bool(stored)))
-        return meta
-
-    def _gen_exprs_for(self, t):
-        """[(col, parsed expr)] for a table's generated columns, parse
-        cached on the table (same idiom as _check_exprs_for)."""
-        gen = getattr(t, "generated", None) or []
-        cache = getattr(t, "_gen_exprs", None)
-        if cache is None or len(cache) != len(gen):
-            from tidb_tpu.parser.sqlparse import parse_expr
-
-            cache = t._gen_exprs = [
-                (col, parse_expr(txt)) for col, txt, _st in gen
-            ]
-        return cache
-
-    def _gen_coerce(self, v, typ):
-        if v is None:
-            return None
-        k = typ.kind
-        try:
-            if k == Kind.STRING:
-                return v if isinstance(v, str) else str(v)
-            if k == Kind.BOOL:
-                return bool(v)
-            if k == Kind.INT:
-                return int(round(float(v))) if not isinstance(v, bool) else int(v)
-            if k in (Kind.DECIMAL, Kind.FLOAT):
-                return float(v)
-        except (ValueError, TypeError):
-            return None
-        return v
-
-    def _fill_generated(self, t, rows) -> None:
-        """Compute generated columns into fully-formed Python rows (in
-        place), definition order so later generated columns may read
-        earlier ones."""
-        gen = self._gen_exprs_for(t)
-        if not gen or not rows:
-            return
-        from tidb_tpu.utils.checkeval import eval_check
-
-        names = t.schema.names
-        types = t.schema.types
-        idx = {n: i for i, n in enumerate(names)}
-        for r in rows:
-            vals = dict(zip(names, r))
-            for col, ex in gen:
-                v = self._gen_coerce(eval_check(ex, vals), types[col])
-                vals[col] = v
-                r[idx[col]] = v
-
-    def _reject_generated_targets(self, t, cols, verb: str) -> None:
-        gen = getattr(t, "generated", None) or []
-        hit = {c for c, _txt, _st in gen} & set(cols)
-        if hit:
-            raise ValueError(
-                f"cannot {verb} generated column(s) {sorted(hit)}"
-            )
-
-    def _recompute_generated(self, t) -> None:
-        """Re-evaluate every generated column over the whole table (host
-        rebuild, the same full-image protocol as the UPDATE fallback) —
-        run after a MODIFY COLUMN reorg converts a dependency."""
-        from tidb_tpu.utils.failpoint import inject
-
-        inject("ddl/generated-recompute")
-        gen = self._gen_exprs_for(t)
-        if not gen or not t.blocks():
-            return
-        names = t.schema.names
-        rows = []
-        for b in t.blocks():
-            decs = [b.columns[n].decode() for n in names]
-            vals = [b.columns[n].valid for n in names]
-            for k in range(b.nrows):
-                rows.append(
-                    [
-                        decs[c][k] if vals[c][k] else None
-                        for c in range(len(names))
-                    ]
-                )
-        self._fill_generated(t, rows)
-        saved_blocks = list(t.blocks())
-        saved_dicts = dict(t.dictionaries)
-        t.replace_blocks([], modified_rows=len(rows))
-        try:
-            if rows:
-                t.append_rows(rows)
-        except Exception:
-            t.replace_blocks(saved_blocks, modified_rows=len(rows))
-            t.dictionaries = saved_dicts
-            raise
-        clear_scan_cache()
-
-    def _alter_add_generated(self, t, s) -> None:
-        """ALTER TABLE ADD COLUMN ... [GENERATED ALWAYS] AS (expr):
-        validate deps against existing columns, install the rule, and
-        backfill existing rows by evaluation (the write-reorg analog of
-        the stored-generated ADD, pkg/ddl/generated_column.go)."""
-        from tidb_tpu.utils.checkeval import (
-            CheckEvalError, check_columns, validate_expr_ops,
-        )
-
-        cd = s.column
-        n = cd.name.lower()
-        txt, expr, stored = cd.generated
-        if s.default is not None or cd.default is not None:
-            # same rule as the CREATE TABLE path
-            raise ValueError(
-                f"generated column {n!r} cannot have a DEFAULT value"
-            )
-        try:
-            validate_expr_ops(expr)
-        except CheckEvalError as ex:
-            raise ValueError(f"generated column {n!r}: {ex}") from None
-        deps = check_columns(expr)
-        bad = deps - set(t.schema.names)
-        if bad:
-            raise ValueError(
-                f"generated column {n!r} references unknown columns "
-                f"{sorted(bad)}"
-            )
-        if t.autoinc_col and t.autoinc_col in deps:
-            raise ValueError(
-                f"generated column {n!r} cannot depend on the "
-                "AUTO_INCREMENT column"
-            )
-        # existing generated columns are all defined earlier, so
-        # appending the new rule keeps the list dependency-ordered
-        t.alter_add_column(cd.name, cd.type, None)
-        gen = list(getattr(t, "generated", None) or [])
-        gen.append((n, txt, bool(stored)))
-        t.generated = gen
-        t._gen_exprs = None
-        self._recompute_generated(t)
 
     def _column_values(self, db: str, name: str, col: str) -> set:
         """All non-NULL values of a column at this session's read
