@@ -1,0 +1,219 @@
+"""Change data capture (CDC) — row-level change events into a sink.
+
+Reference: pkg/tidb-binlog/ (pump client publishing row changes at
+commit) and TiCDC's changefeed model (incremental events + resolved-ts
+watermarks). The columnar analog is storage/cdc.py: version diffs in
+the immutable-block domain, PK-matched into INSERT/UPDATE/DELETE events
+with before/after images.
+"""
+
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.storage.cdc import Changefeed, read_events
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture
+def sess():
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute("create table t (id int primary key, v varchar(16))")
+    s.execute("insert into t values (1, 'one'), (2, 'two')")
+    return s
+
+
+def _rows(events, typ):
+    return [e for e in events if e["type"] == typ]
+
+
+class TestChangefeedEvents:
+    def test_insert_update_delete_events(self, sess):
+        uri = "memory://cdc1"
+        sess.execute(f"changefeed start to '{uri}'")
+        # pre-existing rows do NOT stream (incremental from start-ts)
+        sess.execute("insert into t values (3, 'three')")
+        sess.execute("update t set v = 'TWO' where id = 2")
+        sess.execute("delete from t where id = 1")
+        sess.execute("changefeed status")  # advances
+        events = read_events(uri)
+        ins = _rows(events, "INSERT")
+        assert [e["after"] for e in ins] == [{"id": 3, "v": "three"}]
+        upd = _rows(events, "UPDATE")
+        assert len(upd) == 1
+        assert upd[0]["before"] == {"id": 2, "v": "two"}
+        assert upd[0]["after"] == {"id": 2, "v": "TWO"}
+        dele = _rows(events, "DELETE")
+        assert [e["before"] for e in dele] == [{"id": 1, "v": "one"}]
+        assert _rows(events, "RESOLVED"), "resolved watermark missing"
+        sess.execute("changefeed stop")
+
+    def test_no_initial_dump_and_checkpoint_advances(self, sess):
+        uri = "memory://cdc2"
+        sess.execute(f"changefeed start to '{uri}'")
+        r = sess.execute("changefeed status")
+        cp0 = r.rows[0][2]
+        assert read_events(uri) == []  # nothing changed, nothing shipped
+        sess.execute("insert into t values (9, 'nine')")
+        time.sleep(0.005)
+        r = sess.execute("changefeed status")
+        assert r.rows[0][0] == "running"
+        assert r.rows[0][2] > cp0  # checkpoint moved past the commit
+        sess.execute("changefeed stop")
+
+    def test_block_rewrite_emits_only_touched_rows(self, sess):
+        # one multi-row block; deleting one row rewrites the block but
+        # must emit exactly ONE delete (identical surviving rows match)
+        sess.execute(
+            "insert into t values (10,'a'),(11,'b'),(12,'c'),(13,'d')"
+        )
+        uri = "memory://cdc3"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("delete from t where id = 11")
+        sess.execute("changefeed status")
+        events = read_events(uri)
+        assert [e["before"]["id"] for e in _rows(events, "DELETE")] == [11]
+        assert _rows(events, "INSERT") == []
+        assert _rows(events, "UPDATE") == []
+        sess.execute("changefeed stop")
+
+    def test_table_created_after_start_streams_inserts(self, sess):
+        uri = "memory://cdc4"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("create table u (a int primary key)")
+        sess.execute("insert into u values (7)")
+        sess.execute("changefeed status")
+        events = [e for e in read_events(uri)
+                  if e.get("table", "").lower() == "u"]
+        assert {e["after"]["a"] for e in _rows(events, "INSERT")} == {7}
+        sess.execute("changefeed stop")
+
+    def test_drop_table_emits_ddl(self, sess):
+        uri = "memory://cdc5"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("drop table t")
+        sess.execute("changefeed status")
+        ddl = _rows(read_events(uri), "DDL")
+        assert any(e.get("query") == "DROP TABLE" and e["table"] == "t"
+                   for e in ddl)
+        sess.execute("changefeed stop")
+
+    def test_alter_emits_ddl_event(self, sess):
+        uri = "memory://cdc6"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("alter table t add column w int")
+        sess.execute("insert into t values (5, 'five', 50)")
+        sess.execute("changefeed status")
+        events = read_events(uri)
+        assert _rows(events, "DDL"), "ALTER must emit a DDL event"
+        ins = _rows(events, "INSERT")
+        assert {"id": 5, "v": "five", "w": 50} in [e["after"] for e in ins]
+        sess.execute("changefeed stop")
+
+    def test_no_pk_full_row_identity(self, sess):
+        sess.execute("create table n (x int, y int)")
+        sess.execute("insert into n values (1, 10), (2, 20)")
+        uri = "memory://cdc7"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("update n set y = 21 where x = 2")
+        sess.execute("changefeed status")
+        events = [e for e in read_events(uri)
+                  if e.get("table", "").lower() == "n"]
+        # full-row identity: a changed row is DELETE(old)+INSERT(new)
+        assert [e["before"] for e in _rows(events, "DELETE")] == [
+            {"x": 2, "y": 20}
+        ]
+        assert [e["after"] for e in _rows(events, "INSERT")] == [
+            {"x": 2, "y": 21}
+        ]
+        sess.execute("changefeed stop")
+
+    def test_multi_statement_batch_single_resolved(self, sess):
+        uri = "memory://cdc8"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("insert into t values (21, 'u')")
+        sess.execute("insert into t values (22, 'v')")
+        sess.execute("changefeed status")
+        events = read_events(uri)
+        assert len(_rows(events, "INSERT")) == 2
+        # one drain -> one watermark at the latest commit ts
+        assert len(_rows(events, "RESOLVED")) == 1
+        sess.execute("changefeed stop")
+
+
+class TestChangefeedRecovery:
+    def test_failed_sink_write_requeues(self, sess):
+        uri = "memory://cdc9"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("insert into t values (31, 'x')")
+        failpoint.enable("cdc/sink-write", failpoint.FailpointError)
+        try:
+            with pytest.raises(Exception):
+                sess.execute("changefeed status")
+        finally:
+            failpoint.disable("cdc/sink-write")
+        assert read_events(uri) == []  # nothing half-written
+        sess.execute("changefeed status")  # retry drains the queue
+        events = read_events(uri)
+        assert [e["after"]["id"] for e in _rows(events, "INSERT")] == [31]
+        sess.execute("changefeed stop")
+
+    def test_stop_unhooks_and_unpins(self, sess):
+        cat = sess.catalog
+        t = cat.table("d", "t")
+        uri = "memory://cdc10"
+        sess.execute(f"changefeed start to '{uri}'")
+        assert any(getattr(cb, "_cdc_feed", None) for cb in t.on_commit)
+        sess.execute("changefeed stop")
+        assert not any(getattr(cb, "_cdc_feed", None) for cb in t.on_commit)
+        assert not t._pins, "stop must release every pin"
+
+    def test_read_events_until_ts(self, sess):
+        uri = "memory://cdc11"
+        sess.execute(f"changefeed start to '{uri}'")
+        sess.execute("insert into t values (41, 'a')")
+        sess.execute("changefeed status")
+        time.sleep(0.01)
+        mid = time.time()
+        time.sleep(0.01)
+        sess.execute("insert into t values (42, 'b')")
+        sess.execute("changefeed status")
+        sess.execute("changefeed stop")
+        ids = [e["after"]["id"]
+               for e in _rows(read_events(uri, until_ts=mid), "INSERT")]
+        assert ids == [41]
+
+    def test_double_start_rejected(self, sess):
+        sess.execute("changefeed start to 'memory://cdc12'")
+        with pytest.raises(ValueError):
+            sess.execute("changefeed start to 'memory://cdc13'")
+        sess.execute("changefeed stop")
+        with pytest.raises(ValueError):
+            sess.execute("changefeed stop")
+
+
+class TestChangefeedAPI:
+    def test_background_advancer_thread(self):
+        cat = Catalog()
+        s = Session(cat)
+        s.execute("create database d")
+        s.execute("use d")
+        s.execute("create table t (id int primary key)")
+        feed = Changefeed(cat, "memory://cdc14", interval_s=0.02)
+        feed.start()
+        try:
+            s.execute("insert into t values (1)")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if read_events("memory://cdc14"):
+                    break
+                time.sleep(0.02)
+            ins = _rows(read_events("memory://cdc14"), "INSERT")
+            assert [e["after"]["id"] for e in ins] == [1]
+        finally:
+            feed.stop()

@@ -1,0 +1,227 @@
+"""Engine pool failover + failed-engine prober (MPP resilience analog).
+
+Reference: GlobalMPPFailedStoreProber (pkg/store/copr/mpp_probe.go:33)
+detect/recover semantics, ExecutorWithRetry + RecoveryHandler
+(pkg/executor/internal/mpp/recovery_handler.go:26) retry-on-surviving-
+stores. TPU analog in server/engine_pool.py over the plan IR seam.
+"""
+
+import time
+
+import pytest
+
+from tidb_tpu.parser.sqlparse import parse
+from tidb_tpu.planner.logical import build_query
+from tidb_tpu.server.engine_pool import (
+    EngineEndpoint,
+    FailedEngineProber,
+    PooledEngineClient,
+)
+from tidb_tpu.server.engine_rpc import EngineServer, SchemaOutOfDateError
+from tidb_tpu.session.session import Session
+from tidb_tpu.utils import failpoint
+
+Q = "select b, count(*) from t group by b order by b"
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a int, b varchar(8))")
+    s.execute("insert into t values (1,'x'),(2,'y'),(3,'x')")
+    return s
+
+
+def _plan(sess, q=Q):
+    return build_query(
+        parse(q)[0], sess.catalog, "test", sess._scalar_subquery
+    )
+
+
+def _server(sess):
+    srv = EngineServer(sess.catalog, port=0)
+    srv.start_background()
+    return srv
+
+
+EXPECT = [("x", 2), ("y", 1)]
+
+
+class TestPoolDispatch:
+    def test_round_robin_over_alive_engines(self, sess):
+        s1, s2 = _server(sess), _server(sess)
+        pool = PooledEngineClient(
+            [("127.0.0.1", s1.port), ("127.0.0.1", s2.port)]
+        )
+        try:
+            for _ in range(4):
+                cols, rows = pool.execute_plan(_plan(sess))
+                assert sorted(rows) == EXPECT
+            # both endpoints stayed alive and in rotation
+            assert len(pool.alive_endpoints()) == 2
+        finally:
+            pool.close()
+            s1.shutdown()
+            s2.shutdown()
+
+    def test_failover_on_dead_engine(self, sess):
+        s1, s2 = _server(sess), _server(sess)
+        pool = PooledEngineClient(
+            [("127.0.0.1", s1.port), ("127.0.0.1", s2.port)]
+        )
+        try:
+            s1.shutdown()  # first dispatch target dies
+            for _ in range(3):  # every call still answers
+                cols, rows = pool.execute_plan(_plan(sess))
+                assert sorted(rows) == EXPECT
+            # the dead endpoint was quarantined by the prober
+            failed = pool.prober.failed_endpoints()
+            assert [ep.port for ep in failed] == [s1.port]
+            assert ep_state(pool, s1.port) is False
+        finally:
+            pool.close()
+            s2.shutdown()
+
+    def test_all_engines_down_raises(self, sess):
+        s1 = _server(sess)
+        pool = PooledEngineClient([("127.0.0.1", s1.port)], max_retry=2)
+        try:
+            s1.shutdown()
+            with pytest.raises(ConnectionError, match="no alive engine"):
+                pool.execute_plan(_plan(sess))
+        finally:
+            pool.close()
+
+    def test_execution_error_does_not_fail_over(self, sess):
+        """A plan that errors on the engine (missing table) must raise,
+        not quarantine the engine: it would fail identically on every
+        replica."""
+        s1 = _server(sess)
+        other = Session()
+        other.execute("create table t (a int, b varchar(8))")
+        other.execute("create table only_here (z int)")
+        pool = PooledEngineClient([("127.0.0.1", s1.port)])
+        try:
+            plan = _plan(other, "select z from only_here")
+            with pytest.raises(RuntimeError):
+                pool.execute_plan(plan)
+            assert len(pool.alive_endpoints()) == 1  # still alive
+        finally:
+            pool.close()
+            s1.shutdown()
+
+    def test_schema_out_of_date_propagates(self, sess):
+        s1 = _server(sess)
+        pool = PooledEngineClient([("127.0.0.1", s1.port)])
+        try:
+            with pytest.raises(SchemaOutOfDateError):
+                pool.execute_plan(_plan(sess), schema_version=10**9)
+            assert len(pool.alive_endpoints()) == 1
+        finally:
+            pool.close()
+            s1.shutdown()
+
+
+def ep_state(pool, port):
+    for ep in pool.endpoints:
+        if ep.port == port:
+            return ep.alive
+    raise AssertionError(f"no endpoint on port {port}")
+
+
+class TestProber:
+    def test_recovery_after_restart(self, sess):
+        s1, s2 = _server(sess), _server(sess)
+        prober = FailedEngineProber(initial_backoff_s=0.01)
+        pool = PooledEngineClient(
+            [("127.0.0.1", s1.port), ("127.0.0.1", s2.port)],
+            prober=prober,
+        )
+        try:
+            port1 = s1.port
+            s1.shutdown()
+            pool.execute_plan(_plan(sess))  # triggers detect
+            assert ep_state(pool, port1) is False
+            # engine comes back on the SAME address (store restart)
+            time.sleep(0.02)
+            s1b = EngineServer(sess.catalog, port=port1)
+            s1b.start_background()
+            try:
+                deadline = time.time() + 5
+                while time.time() < deadline and not ep_state(pool, port1):
+                    prober.probe_once()
+                    time.sleep(0.02)
+                assert ep_state(pool, port1) is True
+                assert prober.failed_endpoints() == []
+                # recovered endpoint serves traffic again
+                for _ in range(2):
+                    cols, rows = pool.execute_plan(_plan(sess))
+                    assert sorted(rows) == EXPECT
+            finally:
+                s1b.shutdown()
+        finally:
+            pool.close()
+            s2.shutdown()
+
+    def test_probe_backoff_doubles_until_cap(self):
+        prober = FailedEngineProber(
+            initial_backoff_s=1.0, max_backoff_s=4.0
+        )
+        ep = EngineEndpoint("127.0.0.1", 1)  # nothing listens
+        prober.detect(ep)
+        assert ep.probe_backoff_s == 1.0
+        t0 = ep.next_probe
+        prober.probe_once(now=t0)  # due -> ping fails -> backoff doubles
+        assert ep.probe_backoff_s == 2.0
+        prober.probe_once(now=ep.next_probe)
+        assert ep.probe_backoff_s == 4.0
+        prober.probe_once(now=ep.next_probe)
+        assert ep.probe_backoff_s == 4.0  # capped
+
+    def test_probe_respects_backoff_window(self):
+        prober = FailedEngineProber(initial_backoff_s=3600.0)
+        ep = EngineEndpoint("127.0.0.1", 1)
+        prober.detect(ep)
+        # not due yet: probe_once must not ping (failpoint would count)
+        calls = []
+        failpoint.enable("engine/probe-fail", lambda: calls.append(1))
+        try:
+            prober.probe_once()
+            assert calls == []
+        finally:
+            failpoint.disable("engine/probe-fail")
+
+    def test_detect_idempotent(self):
+        prober = FailedEngineProber()
+        ep = EngineEndpoint("127.0.0.1", 1)
+        prober.detect(ep)
+        prober.detect(ep)
+        assert len(prober.failed_endpoints()) == 1
+        assert ep.detect_count == 1
+
+    def test_background_prober_thread(self, sess):
+        s1 = _server(sess)
+        prober = FailedEngineProber(
+            initial_backoff_s=0.01, interval_s=0.02
+        )
+        pool = PooledEngineClient(
+            [("127.0.0.1", s1.port)], prober=prober
+        )
+        try:
+            port1 = s1.port
+            s1.shutdown()
+            with pytest.raises(ConnectionError):
+                pool.execute_plan(_plan(sess))
+            s1b = EngineServer(sess.catalog, port=port1)
+            s1b.start_background()
+            try:
+                deadline = time.time() + 5
+                while time.time() < deadline and not ep_state(pool, port1):
+                    time.sleep(0.02)  # daemon thread recovers it
+                assert ep_state(pool, port1) is True
+                cols, rows = pool.execute_plan(_plan(sess))
+                assert sorted(rows) == EXPECT
+            finally:
+                s1b.shutdown()
+        finally:
+            pool.close()

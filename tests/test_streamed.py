@@ -269,3 +269,67 @@ class TestGraceHashPartitioned:
             failpoint.disable("executor/partition-start")
             s.execute(f"set tidb_mem_quota_query = {64 << 30}")
         assert not hits, "must not grace-hash a resident-probe anti join"
+
+
+class TestDeviceResidentStreaming:
+    """Round-5: streaming that fits the RAW columns on device pays
+    host->device ONCE (scan cache) and slices chunk windows on device —
+    intermediates stay chunk-bounded without re-transfer per execute
+    (on the TPU tunnel that transfer was 50-70s/run at SF10). A small
+    admission quota still forces host chunking: the quota bounds the
+    DEVICE working set, resident columns included."""
+
+    def test_explicit_threshold_uses_device_slices(self, sess):
+        _set_stream(sess, 2_000_000)
+        full = sess.must_query(Q1).rows
+        _set_stream(sess, 7000)
+        dev_hits, host_chunks = [], []
+        failpoint.enable(
+            "executor/stream-chunk-device", lambda: dev_hits.append(1)
+        )
+        failpoint.enable(
+            "executor/stream-chunk", lambda: host_chunks.append(1)
+        )
+        try:
+            streamed = sess.must_query(Q1).rows
+        finally:
+            failpoint.disable("executor/stream-chunk-device")
+            failpoint.disable("executor/stream-chunk")
+        assert len(dev_hits) >= 8, "device-resident mode must engage"
+        assert len(dev_hits) == len(host_chunks)  # same chunk count seam
+        assert len(full) == len(streamed)
+        for a, b in zip(full, streamed):
+            assert a[0] == b[0] and a[1] == b[1] and a[4] == b[4]
+            assert abs(a[2] - b[2]) < 1e-6
+        _set_stream(sess, 2_000_000)
+
+    def test_quota_still_forces_host_chunking(self):
+        """Under a quota smaller than the raw columns x2.5, streaming
+        must chunk from host — keeping the device working set at the
+        quota is the whole point of quota-forced streaming. Needs a
+        table whose scanned columns x2.5 exceed the 16MB quota floor:
+        sf=0.05 lineitem (300K rows x 33 scanned B/row ~= 9.9MB ->
+        x2.5 ~= 24.8MB)."""
+        from tidb_tpu.bench import load_tpch
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        load_tpch(cat, sf=0.05, seed=6, tables=["lineitem"])
+        s = Session(cat, db="tpch")
+        _set_stream(s, 20000)
+        s.execute("set tidb_mem_quota_query = 16777216")  # the floor
+        dev_hits = []
+        failpoint.enable(
+            "executor/stream-chunk-device", lambda: dev_hits.append(1)
+        )
+        try:
+            streamed = s.must_query(Q1).rows
+        finally:
+            failpoint.disable("executor/stream-chunk-device")
+            s.execute(f"set tidb_mem_quota_query = {64 << 30}")
+        _set_stream(s, 2_000_000)
+        full = s.must_query(Q1).rows
+        assert dev_hits == [], "16MB quota must not pin columns resident"
+        assert len(full) == len(streamed)
+        for a, b in zip(full, streamed):
+            assert a[0] == b[0] and a[1] == b[1] and a[4] == b[4]

@@ -30,6 +30,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.storage.table import TableSchema
 
 
@@ -74,7 +75,7 @@ class TaskManager:
 
     def __init__(self, catalog):
         self.catalog = catalog
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("dxf.manager")
         self._ensure_tables()
         self._load()
 

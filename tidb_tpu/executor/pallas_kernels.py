@@ -90,11 +90,17 @@ def slot_sums_f32(values, contrib, seg, slots: int, interpret: bool = False):
         _ft.partial(_slot_sums_kernel, slots),
         out_shape=jax.ShapeDtypeStruct((a, slots), jnp.float32),
         grid=(grid,),
+        # index-map literals MUST be i32-typed: under the engine's
+        # jax_enable_x64 a plain 0 traces as i64 and the Mosaic module
+        # gets a mixed (i64, i32) index function — the tunnel's compile
+        # helper rejects it (round-5 hardware validation)
         in_specs=[
-            pl.BlockSpec((a, TILE), lambda i: (0, i)),
-            pl.BlockSpec((1, TILE), lambda i: (0, i)),
+            pl.BlockSpec((a, TILE), lambda i: (jnp.int32(0), i)),
+            pl.BlockSpec((1, TILE), lambda i: (jnp.int32(0), i)),
         ],
-        out_specs=pl.BlockSpec((a, slots), lambda i: (0, 0)),
+        out_specs=pl.BlockSpec(
+            (a, slots), lambda i: (jnp.int32(0), jnp.int32(0))
+        ),
         interpret=interpret,
     )(masked, seg2d)
 
@@ -126,7 +132,24 @@ def slot_sums_reference(values, contrib, seg, slots: int):
 # the analog of pkg/util/chunk row-container compaction.
 
 
+#: prefix-scan block geometry: each grid step scans R_SCAN x C_SCAN =
+#: 128K elements, so 8M elements need only 64 sequential steps (the
+#: first cut used 1024-wide tiles -> 8192 steps whose fixed per-step
+#: cost ate the one-pass win: 736ms, barely under XLA's 756ms).
+R_SCAN = 128
+C_SCAN = 1024
+
+
 def _prefix_sum_kernel(x_ref, out_ref, carry_ref):
+    """Hierarchical in-block inclusive scan, all on the MXU:
+    1. scan each row of the [R, C] block:    t @ upper_C   (R*C^2 MACs)
+    2. exclusive-scan the R row totals:      totals @ strict_upper_R
+    3. add row offsets + the running SMEM carry from earlier blocks.
+
+    Mosaic has no cumsum lowering and no dynamic_slice (round-5
+    hardware validation), so scans are triangular matmuls and totals
+    are full sums — nothing indexes an array element. f32 is exact
+    here: block sums <= R*C = 2^17 << 2^24 for 0/1 mask inputs."""
     from jax.experimental import pallas as pl
 
     i = pl.program_id(0)
@@ -135,35 +158,67 @@ def _prefix_sum_kernel(x_ref, out_ref, carry_ref):
     def _init():
         carry_ref[0] = jnp.int32(0)
 
-    t = x_ref[0, :]
-    c = jnp.cumsum(t, dtype=jnp.int32)
-    out_ref[0, :] = c + carry_ref[0]
-    carry_ref[0] = carry_ref[0] + c[-1]
+    t = x_ref[:, :].astype(jnp.float32)  # [R, C]
+    r, c = t.shape
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    coli = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    upper_c = (rowi <= coli).astype(jnp.float32)
+    # HIGHEST on both matmuls: default MXU bf16 input truncation
+    # rounds values above 256, and the contract covers small ints
+    # (per-block sums < 2^24), not just 0/1 masks
+    row_scan = jnp.dot(
+        t, upper_c, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    totals = jnp.sum(t, axis=1)  # [R]
+    ri = jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
+    rj = jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
+    strict_upper_r = (ri < rj).astype(jnp.float32)
+    # HIGHEST precision: the MXU's default bf16 input truncation
+    # rounds totals above 256 (e.g. 300 needs 9 mantissa bits) — the
+    # round-5 hardware run caught exactly that (interpret passed,
+    # hardware diverged). The 0/1-input matmul above is bf16-exact.
+    offs = jnp.dot(
+        totals[None, :], strict_upper_r,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).reshape(r)  # exclusive row offsets
+    block = (row_scan + offs[:, None]).astype(jnp.int32)
+    out_ref[:, :] = block + carry_ref[0]
+    carry_ref[0] = carry_ref[0] + jnp.sum(t).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def prefix_sum_i32(x, interpret: bool = False):
-    """Inclusive int32 prefix sum over a 1-D int/bool array in ONE
-    sequential-grid pass (running carry in SMEM scratch)."""
+    """Inclusive int32 prefix sum over a 1-D bool/small-int array in
+    ONE sequential-grid pass (running carry in SMEM scratch). The
+    in-block scan accumulates in f32 on the MXU, exact while per-BLOCK
+    sums stay below 2^24 — blocks are R_SCAN*C_SCAN = 131072 elements,
+    so values up to ~128 are safe; the engine's only use is 0/1
+    compaction masks, far inside the bound."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = x.shape[0]
     xi = x.astype(jnp.int32)
-    pad = (-n) % TILE
+    block = R_SCAN * C_SCAN
+    pad = (-n) % block
     if pad:
         xi = jnp.pad(xi, (0, pad))
     npad = n + pad
+    rows = npad // C_SCAN
     out = pl.pallas_call(
         _prefix_sum_kernel,
-        out_shape=jax.ShapeDtypeStruct((1, npad), jnp.int32),
-        grid=(npad // TILE,),
-        in_specs=[pl.BlockSpec((1, TILE), lambda i: (0, i))],
-        out_specs=pl.BlockSpec((1, TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, C_SCAN), jnp.int32),
+        grid=(rows // R_SCAN,),
+        in_specs=[pl.BlockSpec((R_SCAN, C_SCAN),
+                               lambda i: (i, jnp.int32(0)))],
+        out_specs=pl.BlockSpec((R_SCAN, C_SCAN),
+                               lambda i: (i, jnp.int32(0))),
         scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
         interpret=interpret,
-    )(xi.reshape(1, npad))
-    return out[0, :n]
+    )(xi.reshape(rows, C_SCAN))
+    return out.reshape(npad)[:n]
 
 
 def prefix_sum_reference(x):

@@ -575,6 +575,16 @@ class RestorePoint:
 
 
 @dataclasses.dataclass
+class ChangefeedStmt:
+    """CHANGEFEED START TO 'uri' | CHANGEFEED STOP | CHANGEFEED STATUS —
+    row-level change capture into a sink (reference: pkg/tidb-binlog/
+    pump publishing + TiCDC's changefeed CLI; storage/cdc.py)."""
+
+    action: str  # 'start' | 'stop' | 'status'
+    uri: Optional[str] = None
+
+
+@dataclasses.dataclass
 class ImportInto:
     db: Optional[str]
     table: str

@@ -274,6 +274,24 @@ class Parser:
                     self.advance()
                 return ast.AdminStmt("show_ddl")
             raise ParseError("ADMIN supports CHECK TABLE/INDEX, SHOW DDL")
+        if self._at_ident("changefeed"):
+            # CHANGEFEED START TO 'uri' / STOP / STATUS (CDC controls)
+            self.advance()
+            word = self.cur.text.lower()
+            if word == "stop":
+                self.advance()
+                return ast.ChangefeedStmt("stop")
+            if word == "status":
+                self.advance()
+                return ast.ChangefeedStmt("status")
+            if word == "start":
+                self.advance()
+                self.expect_kw("to")
+                t = self.advance()
+                if t.kind != "str":
+                    raise ParseError("CHANGEFEED START expects a string URI")
+                return ast.ChangefeedStmt("start", t.text)
+            raise ParseError("CHANGEFEED supports START TO | STOP | STATUS")
         if self._at_ident("rename"):
             self.advance()
             self.expect_kw("table")

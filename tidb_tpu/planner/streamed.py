@@ -108,11 +108,13 @@ def _pick_big_scan(executor, scans, flags):
 
 
 def _stream_sizing(executor, scans, resolved, big_i, threshold, force=False):
-    """(chunk_rows, should_stream): budget math shared by the agg and
-    sort streaming paths. Auto mode streams when the whole working set
-    (big scan + resident sides, ~4x for intermediates) overruns the
-    device budget, and sizes chunks from the budget REMAINING after the
-    resident sides. Explicit thresholds chunk at that row count.
+    """(chunk_rows, should_stream, ctx): budget math shared by the agg
+    and sort streaming paths. Auto mode streams when the whole working
+    set (big scan + resident sides, times an intermediates multiplier)
+    overruns the device budget, and sizes chunks from the budget
+    REMAINING after the resident sides. Explicit thresholds chunk at
+    that row count. `ctx` carries the computed (budget, others_bytes,
+    rb) so callers (the device-resident gate) never re-derive them.
     force: stream even when this aggregate's own working set fits —
     the quota-admission retry path, where the WHOLE plan (join tiles
     above this aggregate) blew the budget."""
@@ -133,22 +135,33 @@ def _stream_sizing(executor, scans, resolved, big_i, threshold, force=False):
         for i, (s, (ot, ov)) in enumerate(zip(scans, resolved))
         if i != big_i
     )
+    ctx = {"budget": budget, "others_bytes": others_bytes, "rb": rb}
     if others_bytes * 4 > budget:
-        return None, False  # resident join sides don't fit: run unpaged
+        # resident join sides don't fit: run unpaged
+        return None, False, ctx
+    # intermediates multiplier: a single-scan plan (scan->filter->proj->
+    # agg, no join sides) keeps only a couple of row-width temporaries
+    # live in the fused program. Join plans materialize gathered
+    # columns per probe stage — a deep chain (TPC-H Q5's 6-way) peaks
+    # far above 4x: the round-5 hardware run at est. 10.6GB against a
+    # 13.6GB budget crashed the TPU worker, so join plans hold 6x and
+    # stream (device-resident when the raw columns fit) instead of
+    # gambling the whole worker on resident execution
+    mult = 2 if others_bytes == 0 and len(scans) == 1 else 6
     if threshold == -1 or force:
-        if not force and (t.nrows * rb + others_bytes) * 4 <= budget:
-            return None, False
+        if not force and (t.nrows * rb + others_bytes) * mult <= budget:
+            return None, False, ctx
         avail = max(budget - 4 * others_bytes, budget // 8)
         chunk_rows = max(1 << 14, min(1 << 24, _pow2_floor(avail // (4 * rb))))
         if force and chunk_rows * rb * 4 > budget:
             # even one minimal chunk overruns the quota: streaming
             # cannot save this query — let admission's rejection stand
-            return None, False
+            return None, False, ctx
     else:
         if t.nrows <= threshold:
-            return None, False
+            return None, False, ctx
         chunk_rows = max(int(threshold), 1)
-    return chunk_rows, True
+    return chunk_rows, True, ctx
 
 
 def _fetch_resident(executor, site, st, sv):
@@ -225,14 +238,35 @@ def _chunk_blocks(table, version, columns, chunk_rows: int, partitions=None):
             yield HostBlock(cols, z - a)
 
 
+# HBM per chip by device_kind, for runtimes that don't report
+# memory_stats (the axon tunnel returns None). Sized at 85% of physical
+# to leave runtime headroom.
+_HBM_BY_KIND = {
+    "TPU v5 lite": 16 << 30,   # v5e (one core per chip)
+    "TPU v4": 32 << 30,        # megacore: one device per chip
+    "TPU v4 lite": 8 << 30,    # v4i
+    # v2/v3 expose each CORE as a device with half the chip's HBM
+    "TPU v3": 16 << 30,
+    "TPU v2": 8 << 30,
+}
+
+
 def _device_budget() -> int:
     """Device memory available for one query's working set. TPU: the
-    runtime reports bytes_limit. CPU backend (tests / fallback): stage
-    through host RAM past a fixed 4GB budget."""
+    runtime reports bytes_limit; when it doesn't (the tunnel transport
+    strips memory_stats), fall back to the chip's known HBM size —
+    treating a 16GB v5e as a 4GB device forced SF10 onto the streamed
+    path and re-paid the full tunnel h2d on every execute (round-5
+    hardware capture: 73.7s/run, ~0.13x). CPU backend (tests /
+    fallback): stage through host RAM past a fixed 4GB budget."""
     try:
-        ms = jax.local_devices()[0].memory_stats()
+        d = jax.local_devices()[0]
+        ms = d.memory_stats()
         if ms and ms.get("bytes_limit"):
             return int(ms["bytes_limit"])
+        if d.platform == "tpu":
+            hbm = _HBM_BY_KIND.get(getattr(d, "device_kind", ""), 16 << 30)
+            return int(hbm * 0.85)
     except Exception:
         pass
     return 4 << 30
@@ -393,7 +427,7 @@ def try_streamed(
         return None
     big_scan = scans[big_i]
     t, v = resolved[big_i]
-    chunk_rows, should = _stream_sizing(
+    chunk_rows, should, sizing = _stream_sizing(
         executor, scans, resolved, big_i, threshold, force=force
     )
     if not should:
@@ -440,7 +474,51 @@ def try_streamed(
         # program (the last, shorter chunk pads up to the same tile)
         chunk_tile = pad_capacity(chunk_rows)
 
+        # device-resident streaming: when the big table's RAW columns
+        # fit comfortably in the budget but the per-chunk pipeline's
+        # intermediates are what forced streaming, transfer the table
+        # ONCE (through the scan cache — repeats re-use it) and slice
+        # chunk windows on device. Streaming then bounds COMPUTE
+        # intermediates without re-paying host->device per execute —
+        # on the TPU tunnel that transfer was 50-70s per run at SF10.
+        # The reference's paging equally re-reads from the store, not
+        # from the client (pkg/store/copr paging). A small admission
+        # quota caps sizing's budget, so quota-forced streaming keeps
+        # chunking from host — the quota's purpose.
+        big_bytes = t.nrows * sizing["rb"]
+        device_resident = (
+            big_bytes * 2.5 + sizing["others_bytes"] * 4
+            <= sizing["budget"]
+        )
+
         def feeds():
+            if device_resident:
+                from tidb_tpu.storage import scan_table
+
+                full, _fd = scan_table(
+                    t, big_scan.columns, version=v,
+                    partitions=sp.big_site.partitions,
+                )
+                cap = full.capacity
+                for a in range(0, cap, chunk_tile):
+                    inject("executor/stream-chunk")
+                    inject("executor/stream-chunk-device")
+                    z = min(a + chunk_tile, cap)
+                    pad = chunk_tile - (z - a)
+                    cols = {}
+                    for name, c in full.cols.items():
+                        d, vl = c.data[a:z], c.valid[a:z]
+                        if pad:
+                            d = jnp.pad(d, (0, pad))
+                            vl = jnp.pad(vl, (0, pad))
+                        cols[name] = DevCol(d, vl)
+                    rv = full.row_valid[a:z]
+                    if pad:
+                        rv = jnp.pad(rv, (0, pad))
+                    inputs = dict(inputs_base)
+                    inputs[sp.big_site.node_id] = Batch(cols, rv)
+                    yield inputs
+                return
             for hb in _chunk_blocks(
                 t, v, sp.big_site.columns, chunk_rows,
                 partitions=sp.big_site.partitions,
@@ -1001,7 +1079,7 @@ def try_streamed_sort(executor, plan, conservative=False):
     if big_i is None:
         return None
     big_scan = scans[big_i]
-    chunk_rows, should = _stream_sizing(
+    chunk_rows, should, _sz = _stream_sizing(
         executor, scans, resolved, big_i, threshold
     )
     if not should:

@@ -1,0 +1,267 @@
+"""Engine pool: multi-engine dispatch with failure probing + recovery.
+
+Reference: the MPP resilience triplet —
+- `GlobalMPPFailedStoreProber` (pkg/store/copr/mpp_probe.go:33): a
+  registry of TiFlash stores that failed dispatch; each is probed
+  periodically with backoff and returns to rotation after a successful
+  liveness check.
+- `ExecutorWithRetry` + `RecoveryHandler`
+  (pkg/executor/internal/mpp/recovery_handler.go:26): an MPP run that
+  died from a store failure is retried against the surviving stores,
+  bounded by a retry budget.
+- dispatch itself (`DispatchMPPTask`, pkg/store/copr/mpp.go:93) picks
+  among healthy stores.
+
+TPU-native shape: engines are `EngineServer` processes behind the plan
+IR seam (server/engine_rpc.py — the kv.Client.Send analog). The pool
+round-robins plans over alive engines, a transport failure quarantines
+the endpoint into the prober (exponential-backoff pings via the
+protocol's handshake frame), and the plan retries on the next alive
+engine. `SchemaOutOfDateError` is a *planning* staleness signal, not a
+liveness failure — it propagates so the frontend re-plans, matching
+the reference where lease expiry never marks a store failed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from tidb_tpu.server.engine_rpc import (
+    EngineClient,
+    SchemaOutOfDateError,
+)
+from tidb_tpu.utils.failpoint import inject
+
+
+class EngineEndpoint:
+    """One engine address + its liveness state."""
+
+    def __init__(self, host: str, port: int, secret: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self.alive = True
+        self.failed_since: Optional[float] = None
+        self.next_probe: float = 0.0
+        self.probe_backoff_s: float = 0.0
+        self.detect_count = 0
+        self.recover_count = 0
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def __repr__(self) -> str:
+        state = "alive" if self.alive else "failed"
+        return f"EngineEndpoint({self.address}, {state})"
+
+
+class FailedEngineProber:
+    """Quarantine + recovery detection for failed engines.
+
+    `detect()` moves an endpoint out of rotation; `probe_once()` pings
+    every quarantined endpoint whose backoff has elapsed (doubling up
+    to `max_backoff_s`) and returns the ones that answered, which are
+    already back in rotation when it returns. With `interval_s` > 0 a
+    daemon thread probes continuously (the reference's prober
+    goroutine; detect/recover semantics of mpp_probe.go:33)."""
+
+    def __init__(
+        self,
+        initial_backoff_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+        probe_timeout_s: float = 2.0,
+        interval_s: float = 0.0,
+    ):
+        self.initial_backoff_s = initial_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.probe_timeout_s = probe_timeout_s
+        self._lock = threading.Lock()
+        self._failed: List[EngineEndpoint] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, args=(interval_s,), daemon=True,
+                name="engine-prober",
+            )
+            self._thread.start()
+
+    def detect(self, ep: EngineEndpoint) -> None:
+        """Mark an endpoint failed (idempotent) and schedule its first
+        probe after the initial backoff."""
+        with self._lock:
+            if not ep.alive:
+                return
+            ep.alive = False
+            ep.failed_since = time.time()
+            ep.detect_count += 1
+            ep.probe_backoff_s = self.initial_backoff_s
+            ep.next_probe = time.time() + ep.probe_backoff_s
+            self._failed.append(ep)
+
+    def failed_endpoints(self) -> List[EngineEndpoint]:
+        with self._lock:
+            return list(self._failed)
+
+    def probe_once(self, now: Optional[float] = None
+                   ) -> List[EngineEndpoint]:
+        """Ping due endpoints; recovered ones return to rotation and
+        are returned. Failed pings double the endpoint's backoff."""
+        now = time.time() if now is None else now
+        with self._lock:
+            due = [ep for ep in self._failed if ep.next_probe <= now]
+        recovered = []
+        for ep in due:
+            if self._ping(ep):
+                with self._lock:
+                    ep.alive = True
+                    ep.failed_since = None
+                    ep.recover_count += 1
+                    self._failed = [e for e in self._failed if e is not ep]
+                recovered.append(ep)
+            else:
+                with self._lock:
+                    ep.probe_backoff_s = min(
+                        ep.probe_backoff_s * 2 or self.initial_backoff_s,
+                        self.max_backoff_s,
+                    )
+                    ep.next_probe = now + ep.probe_backoff_s
+        return recovered
+
+    def _ping(self, ep: EngineEndpoint) -> bool:
+        if inject("engine/probe-fail"):
+            return False
+        try:
+            c = EngineClient(
+                ep.host, ep.port, secret=ep.secret,
+                timeout_s=self.probe_timeout_s,
+            )
+        except Exception:
+            return False
+        try:
+            resp = c._call({})  # handshake/ping frame
+            return bool(resp.get("ok"))
+        except Exception:
+            return False
+        finally:
+            c.close()
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.probe_once()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class PooledEngineClient:
+    """Dispatch plans over a pool of engines with failover.
+
+    A transport failure (connect error, poisoned stream, engine gone)
+    quarantines the endpoint via the prober and the SAME plan retries
+    on the next alive engine — the ExecutorWithRetry/RecoveryHandler
+    loop. Engine-side *execution* errors (bad plan, unknown table) and
+    SchemaOutOfDateError propagate without failover: they would fail
+    identically everywhere."""
+
+    def __init__(
+        self,
+        endpoints: List[Tuple[str, int]],
+        secret: Optional[str] = None,
+        prober: Optional[FailedEngineProber] = None,
+        max_retry: int = 3,
+    ):
+        if not endpoints:
+            raise ValueError("engine pool needs at least one endpoint")
+        self.endpoints = [
+            EngineEndpoint(h, p, secret) for h, p in endpoints
+        ]
+        self.prober = prober or FailedEngineProber()
+        self.max_retry = max_retry
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._conns = {}  # endpoint -> EngineClient
+        # one mutex per endpoint: EngineClient's socket protocol is a
+        # strict request/response stream — two threads interleaving
+        # frames on it would desync ids and poison a healthy engine
+        self._conn_locks = {}
+
+    def alive_endpoints(self) -> List[EngineEndpoint]:
+        return [ep for ep in self.endpoints if ep.alive]
+
+    def _next_alive(self) -> Optional[EngineEndpoint]:
+        with self._lock:
+            alive = [ep for ep in self.endpoints if ep.alive]
+            if not alive:
+                return None
+            ep = alive[self._rr % len(alive)]
+            self._rr += 1
+            return ep
+
+    def _ep_lock(self, ep: EngineEndpoint) -> threading.Lock:
+        with self._lock:
+            lk = self._conn_locks.get(ep)
+            if lk is None:
+                lk = self._conn_locks[ep] = threading.Lock()
+            return lk
+
+    def _conn(self, ep: EngineEndpoint) -> EngineClient:
+        c = self._conns.get(ep)
+        if c is None or c._dead:
+            c = EngineClient(ep.host, ep.port, secret=ep.secret)
+            self._conns[ep] = c
+        return c
+
+    def execute_plan(
+        self, plan, schema_version: Optional[int] = None
+    ) -> Tuple[List[str], List[tuple]]:
+        last_err: Optional[Exception] = None
+        for _attempt in range(max(self.max_retry, 1)):
+            # give quarantined engines their shot at recovery before
+            # declaring the pool exhausted (probe respects backoff)
+            if not self.alive_endpoints():
+                self.prober.probe_once()
+            ep = self._next_alive()
+            if ep is None:
+                break
+            try:
+                inject("engine/dispatch")
+                with self._ep_lock(ep):
+                    conn = self._conn(ep)
+                    return conn.execute_plan(plan, schema_version)
+            except SchemaOutOfDateError:
+                raise  # re-plan, don't fail over
+            except RuntimeError:
+                raise  # engine-side execution error: same everywhere
+            except (ValueError, PermissionError):
+                # client-local and deterministic (oversized request
+                # frame, bad credentials): would fail identically on
+                # every engine — never quarantine a healthy one for it
+                raise
+            except Exception as e:  # transport: quarantine + fail over
+                last_err = e
+                with self._ep_lock(ep):
+                    self._conns.pop(ep, None)
+                self.prober.detect(ep)
+        raise ConnectionError(
+            f"no alive engine after {self.max_retry} attempts "
+            f"({len(self.endpoints)} endpoints, all quarantined); "
+            f"last error: {last_err}"
+        )
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._conns.clear()
+        self.prober.stop()

@@ -2145,6 +2145,37 @@ class Session(DDLMixin):
                     # claims is durable
                     rows.append(("running", task.uri, task.checkpoint_ts))
                 r = Result(["state", "storage", "checkpoint_ts"], rows)
+        elif isinstance(s, ast.ChangefeedStmt):
+            from tidb_tpu.storage.cdc import Changefeed
+
+            # feed lives on the SHARED base catalog (like log backup):
+            # session temp tables never enter the stream
+            bcat = getattr(self.catalog, "_base", self.catalog)
+            feed = getattr(bcat, "changefeed", None)
+            if s.action == "start":
+                if feed is not None:
+                    raise ValueError("a changefeed is already running")
+                feed = Changefeed(bcat, s.uri)
+                feed.start()
+                bcat.changefeed = feed
+                r = Result([], [])
+            elif s.action == "stop":
+                if feed is None:
+                    raise ValueError("no changefeed is running")
+                feed.stop()
+                bcat.changefeed = None
+                r = Result([], [])
+            else:  # status
+                rows = []
+                if feed is not None:
+                    feed.advance()
+                    rows.append((
+                        "running", feed.sink_uri, feed.checkpoint_ts,
+                        feed.events_emitted,
+                    ))
+                r = Result(
+                    ["state", "sink", "checkpoint_ts", "events"], rows
+                )
         elif isinstance(s, ast.RestorePoint):
             from tidb_tpu.storage.logbackup import restore_point_in_time
 

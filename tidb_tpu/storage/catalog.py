@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.storage.table import Table, TableSchema
 
 
@@ -19,7 +20,7 @@ class Catalog:
     def __init__(self) -> None:
         from tidb_tpu.utils.privilege import UserStore
 
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("catalog")
         self.schema_version = 0
         self._dbs: Dict[str, Dict[str, Table]] = {"test": {}}
         # views: db -> name -> (select SQL text, explicit column names or
@@ -45,7 +46,7 @@ class Catalog:
         self.resource_groups = ResourceGroupManager()
 
         self.lock_manager = LockManager()
-        self._commit_mu = threading.Lock()
+        self._commit_mu = racecheck.make_lock("catalog.commit")
 
     def create_database(self, name: str, if_not_exists: bool = False) -> None:
         name = name.lower()

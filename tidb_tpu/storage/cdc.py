@@ -1,0 +1,402 @@
+"""Change data capture (CDC) — the binlog/TiCDC analog.
+
+Reference: pkg/tidb-binlog/ (legacy pump client publishing row-change
+binlogs at commit) and TiCDC's changefeed model (incremental row events
++ resolved-ts watermarks into a sink). The columnar-store analog rides
+the same `Table.on_commit` seam as log backup (storage/logbackup.py),
+but instead of shipping storage blocks it emits LOGICAL row events:
+
+- subscription: each hooked table pins its current version as the
+  changefeed *baseline*. Every later commit pins the new version and
+  queues (ts, table, old_version, new_version).
+- advance(): drains the queue in commit order. For each pair of
+  versions the diff is computed in the immutable-block domain: blocks
+  present in both versions are untouched (their rows cannot have
+  changed), so only removed/added blocks decode. Removed rows and
+  added rows are then matched by primary key (full-row identity when
+  the table has no PK — MySQL row-based binlog semantics): matched
+  pairs with differing values become UPDATE (before+after images),
+  unmatched removed rows DELETE, unmatched added rows INSERT. A block
+  rewrite that kept a row intact produces no event.
+- schema changes between versions emit a DDL event carrying the new
+  table meta; tables created after the feed started stream their rows
+  as INSERTs from an empty baseline (TiCDC's new-table semantics).
+- after every drained batch a RESOLVED event records the timestamp
+  below which the sink is complete — the checkpoint-ts watermark.
+
+Sink format: numbered JSONL segments (`cdc/{seq:08d}.jsonl`) on the
+external-storage abstraction; one JSON object per line, in the spirit
+of TiCDC's open-protocol file sink. `read_events` replays a sink.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.utils import racecheck
+from tidb_tpu.storage.external import ExternalStorage, open_storage
+from tidb_tpu.storage.persist import table_meta_to_json
+from tidb_tpu.utils.failpoint import inject
+
+
+def _decoded_rows(blocks, cols: List[str]) -> List[tuple]:
+    """All rows of `blocks` as tuples of Python values, column order
+    `cols`. Vectorized per column (HostColumn.decode), assembled per
+    block."""
+    rows: List[tuple] = []
+    for b in blocks:
+        if b.nrows == 0:
+            continue
+        decoded = [b.columns[c].decode() if c in b.columns else
+                   np.full(b.nrows, None, dtype=object) for c in cols]
+        rows.extend(zip(*decoded))
+    return rows
+
+
+def _jsonable(v):
+    if v is None or isinstance(v, (str, bool)):
+        return v
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    return str(v)
+
+
+class Changefeed:
+    """One changefeed streaming row events from a catalog into a sink."""
+
+    def __init__(self, catalog, sink_uri: str, feed_id: str = "cf-1",
+                 interval_s: float = 0.0):
+        self.catalog = catalog
+        self.feed_id = feed_id
+        self.sink_uri = sink_uri
+        self.storage: ExternalStorage = open_storage(sink_uri)
+        self._lock = racecheck.make_lock("cdc.queue")  # queue + baseline maps
+        self._advance_mu = racecheck.make_lock("cdc.advance")  # serialize whole drains
+        # (ts, db, name, table, new_version) in commit order
+        self._queue: List[Tuple[float, str, str, object, int]] = []
+        # (db,name) -> (table_obj, baseline_version, schema_json_str);
+        # the object reference (not a uid) lets stop()/drop handling
+        # unpin without a catalog search after the table is dropped
+        self._baseline: Dict[Tuple[str, str], Tuple[object, int, str]] = {}
+        existing = self.storage.list("cdc/")
+        self._seq = max(
+            (int(fn.split("/")[1].split(".")[0]) for fn in existing),
+            default=0,
+        )
+        self.checkpoint_ts: float = time.time()
+        self.events_emitted = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.interval_s = interval_s
+        # tables hooked before start() are replicated incrementally from
+        # the feed's start-ts (TiCDC semantics: no initial dump); tables
+        # discovered after stream their rows as INSERTs from creation
+        self._started = False
+
+    # -- subscription ----------------------------------------------------
+    def _hook_tables(self) -> None:
+        for db in self.catalog.databases():
+            if db.startswith("_"):
+                continue
+            for name in self.catalog.tables(db):
+                t = self.catalog.table(db, name)
+                key = (db.lower(), name.lower())
+                base = self._baseline.get(key)
+                if base is not None and base[0].uid == t.uid:
+                    continue
+                recreated = base is not None
+                if recreated:
+                    # dropped+recreated under the same name: fresh object,
+                    # re-baseline from empty so its rows stream as INSERTs
+                    self._release_baseline(key)
+
+                def cb(table, version, _db=db, _name=name):
+                    # runs under the table lock; the commit pinned for us
+                    with self._lock:
+                        self._queue.append(
+                            (time.time(), _db, _name, table, version)
+                        )
+
+                cb._cdc_feed = self  # stop() filters by this tag
+                t.on_commit.append(cb)
+                v = t.pin_current()
+                with self._lock:
+                    self._baseline[key] = (
+                        t, v, json.dumps(table_meta_to_json(t))
+                    )
+                    if self._started and (recreated or base is None):
+                        # stream the table's current rows as INSERTs: the
+                        # feed covers it from (re)creation, not from an
+                        # unobservable earlier point
+                        self._queue.append((time.time(), db, name, t, v))
+
+    def _release_baseline(self, key) -> None:
+        base = self._baseline.pop(key, None)
+        if base is not None:
+            base[0].unpin(base[1])
+
+    def start(self) -> None:
+        self._hook_tables()
+        self._started = True
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f"cdc-{self.feed_id}"
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.advance()
+            except Exception:
+                pass  # retry next tick; queue and pins are intact
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.advance()  # final drain
+        finally:
+            self._unhook()
+
+    def _unhook(self) -> None:
+        for db in self.catalog.databases():
+            if db.startswith("_"):
+                continue
+            for name in self.catalog.tables(db):
+                t = self.catalog.table(db, name)
+                t.on_commit = [
+                    cb for cb in t.on_commit
+                    if getattr(cb, "_cdc_feed", None) is not self
+                ]
+        with self._lock:
+            batch, self._queue = self._queue, []
+            baselines, self._baseline = dict(self._baseline), {}
+        for _ts, _db, _name, t, version in batch:
+            t.unpin(version)
+        for (_db, _name), (tb, version, _schema) in baselines.items():
+            tb.unpin(version)
+
+    # -- the advancer ----------------------------------------------------
+    def advance(self) -> int:
+        """Drain queued commits into sink events; returns events written.
+        A failed sink write requeues the remainder with pins intact —
+        the checkpoint only advances past durably-written segments."""
+        with self._advance_mu:
+            self._hook_tables()
+            # tables dropped since the last drain: emit a DDL-drop event
+            # and release the baseline pin (TiCDC emits the drop and
+            # stops tracking the table)
+            live = {
+                (db.lower(), nm.lower())
+                for db in self.catalog.databases()
+                if not db.startswith("_")
+                for nm in self.catalog.tables(db)
+            }
+            with self._lock:
+                gone = [k for k in self._baseline if k not in live]
+            drop_events = []
+            for k in gone:
+                drop_events.append({
+                    "type": "DDL", "db": k[0], "table": k[1],
+                    "ts": time.time(), "query": "DROP TABLE",
+                })
+                # baseline released only after the segment is durable: a
+                # failed sink write re-detects the drop next advance
+            with self._lock:
+                batch = self._queue
+                self._queue = []
+            # drop events for vanished tables supersede their queued
+            # commits (the table object is gone from the catalog; its
+            # queued versions only need their pins released)
+            gone_set = set(gone)
+            stale = [e for e in batch
+                     if (e[1].lower(), e[2].lower()) in gone_set]
+            batch = [e for e in batch
+                     if (e[1].lower(), e[2].lower()) not in gone_set]
+            for _ts, _db, _name, t, version in stale:
+                t.unpin(version)
+            if not batch and not drop_events:
+                return 0
+            events: List[dict] = drop_events
+            done: List[Tuple[object, int, Tuple[str, str], str]] = []
+            # Coalesce per table: one drain diffs baseline -> LAST
+            # queued version and releases the intermediate pins. This
+            # is both the row-level truth and a correctness point: the
+            # engine's columnar UPDATE commits as delete+append (two
+            # versions), and diffing the transient middle state would
+            # report every surviving row as DELETE+INSERT. The net
+            # diff pairs identical rows away and emits the one UPDATE.
+            grouped: Dict[Tuple, List] = {}
+            order: List[Tuple] = []
+            for e in batch:
+                gk = (e[1].lower(), e[2].lower(), e[3].uid)
+                if gk not in grouped:
+                    grouped[gk] = []
+                    order.append(gk)
+                grouped[gk].append(e)
+            try:
+                for gk in order:
+                    entries = grouped[gk]
+                    ts, db, name, t, version = entries[-1]
+                    key = (db.lower(), name.lower())
+                    base = self._baseline.get(key)
+                    if base is not None and base[0].uid == t.uid and any(
+                        e[4] == base[1] for e in entries
+                    ):
+                        # the group contains this table's initial
+                        # capture; a commit that raced in behind it
+                        # must not coalesce the full dump away — dump
+                        # every row at the LATEST version instead
+                        base = None
+                    if base is not None and base[0].uid != t.uid:
+                        # the table was dropped (and possibly recreated)
+                        # after these commits queued: the DROP event and
+                        # the new object's initial capture cover it —
+                        # just release the orphan pins
+                        for _ts, _db, _nm, ot, ov in entries:
+                            ot.unpin(ov)
+                        continue
+                    evs, new_schema = self._diff_events(
+                        ts, db, name, t, version, base
+                    )
+                    # intermediate versions: events are superseded by
+                    # the net diff; pins release once the segment lands
+                    events.extend(evs)
+                    done.append((t, version, key, new_schema,
+                                 [e[4] for e in entries[:-1]]))
+            except BaseException:
+                with self._lock:
+                    self._queue = batch + self._queue
+                raise
+            resolved_ts = batch[-1][0] if batch else drop_events[-1]["ts"]
+            events.append({"type": "RESOLVED", "ts": resolved_ts})
+            payload = "\n".join(
+                json.dumps(e, separators=(",", ":")) for e in events
+            ).encode("utf-8") + b"\n"
+            self._seq += 1
+            try:
+                inject("cdc/sink-write")
+                self.storage.write_file(
+                    f"cdc/{self._seq:08d}.jsonl", payload
+                )
+            except BaseException:
+                self._seq -= 1
+                with self._lock:
+                    self._queue = batch + self._queue
+                raise
+            # segment durable: move baselines forward, release old and
+            # intermediate pins
+            for k in gone:
+                self._release_baseline(k)
+            for t, version, key, new_schema, mids in done:
+                with self._lock:
+                    base = self._baseline.get(key)
+                    self._baseline[key] = (t, version, new_schema)
+                for mv in mids:
+                    t.unpin(mv)
+                if base is not None and base[0].uid == t.uid \
+                        and base[1] != version:
+                    t.unpin(base[1])
+            self.checkpoint_ts = resolved_ts
+            self.events_emitted += len(events)
+            return len(events)
+
+    def _diff_events(self, ts, db, name, t, version, base):
+        """Row events between `base` (the effective prior state —
+        stored baseline or the previous entry of this drain) and
+        `version`, plus the new schema json (caller installs it after
+        the segment is durable)."""
+        schema_json = json.dumps(table_meta_to_json(t))
+        try:
+            new_blocks = t.blocks(version)
+        except KeyError:
+            return [], schema_json  # version GC'd in an unhooked window
+        events: List[dict] = []
+        head = {"db": db, "table": name, "ts": ts}
+        if base is None or base[0].uid != t.uid or base[1] == version:
+            # initial capture (or re-created table): every row INSERTs
+            cols = list(t.schema.names)
+            for row in _decoded_rows(new_blocks, cols):
+                events.append({**head, "type": "INSERT",
+                               "after": {c: _jsonable(v) for c, v in
+                                         zip(cols, row)}})
+            return events, schema_json
+        old_version = base[1]
+        if base[2] != schema_json:
+            events.append({**head, "type": "DDL",
+                           "schema": json.loads(schema_json)})
+        try:
+            old_blocks = t.blocks(old_version)
+        except KeyError:
+            old_blocks = []
+        old_uids = {b.uid for b in old_blocks}
+        new_uids = {b.uid for b in new_blocks}
+        removed = [b for b in old_blocks if b.uid not in new_uids]
+        added = [b for b in new_blocks if b.uid not in old_uids]
+        if not removed and not added:
+            return events, schema_json
+        cols = list(t.schema.names)
+        # decode against the OLD schema for removed blocks: a concurrent
+        # ALTER means old blocks may lack new columns (filled with None)
+        old_rows = _decoded_rows(removed, cols)
+        new_rows = _decoded_rows(added, cols)
+        pk = t.schema.primary_key
+        if pk:
+            idx = [cols.index(c) for c in pk]
+            kf = lambda r: tuple(r[i] for i in idx)  # noqa: E731
+        else:
+            kf = lambda r: r  # full-row identity  # noqa: E731
+        old_by_key: Dict[tuple, List[tuple]] = {}
+        for r in old_rows:
+            old_by_key.setdefault(kf(r), []).append(r)
+        for r in new_rows:
+            k = kf(r)
+            stack = old_by_key.get(k)
+            if stack:
+                before = stack.pop()
+                if not stack:
+                    del old_by_key[k]
+                if before != r:
+                    events.append({**head, "type": "UPDATE",
+                                   "before": {c: _jsonable(v) for c, v in
+                                              zip(cols, before)},
+                                   "after": {c: _jsonable(v) for c, v in
+                                             zip(cols, r)}})
+                # identical row in a rewritten block: no event
+            else:
+                events.append({**head, "type": "INSERT",
+                               "after": {c: _jsonable(v) for c, v in
+                                         zip(cols, r)}})
+        for stack in old_by_key.values():
+            for r in stack:
+                events.append({**head, "type": "DELETE",
+                               "before": {c: _jsonable(v) for c, v in
+                                          zip(cols, r)}})
+        return events, schema_json
+
+
+def read_events(sink_uri: str, until_ts: Optional[float] = None
+                ) -> List[dict]:
+    """Replay a sink's event stream in order (segment, line). Events
+    after `until_ts` (exclusive of RESOLVED watermarks past it) are
+    dropped — a consumer replays to a point in time."""
+    storage = open_storage(sink_uri)
+    events: List[dict] = []
+    for fn in sorted(storage.list("cdc/")):
+        for line in storage.read_file(fn).decode("utf-8").splitlines():
+            if not line:
+                continue
+            e = json.loads(line)
+            if until_ts is not None and e.get("ts", 0) > until_ts:
+                continue
+            events.append(e)
+    return events

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.chunk import HostBlock, HostColumn
 from tidb_tpu.storage.external import ExternalStorage, open_storage
 from tidb_tpu.storage.persist import (
@@ -84,12 +85,12 @@ class LogBackupTask:
         self.catalog = catalog
         self.uri = uri
         self.storage: ExternalStorage = open_storage(uri)
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("logbackup.queue")
         # serializes whole advance() drains: the background advancer
         # thread and a foreground STATUS/stop both call advance(), and
         # _seq/_captured updates must not interleave (same-name segment
         # overwrites, deltas diffed against stale uids)
-        self._advance_mu = threading.Lock()
+        self._advance_mu = racecheck.make_lock("logbackup.advance")
         self._queue: List[Tuple[float, str, str, object, int]] = []
         # resume sequence numbering after any prior stream into this
         # storage — restarting at 1 would overwrite the old stream's

@@ -11,6 +11,8 @@ unobservable single-process.
 from __future__ import annotations
 
 import threading
+
+from tidb_tpu.utils import racecheck
 from typing import Optional
 
 
@@ -53,7 +55,7 @@ class Sequence:
         self.cycle = bool(cycle)
         self.cache = int(cache)
         self._next: Optional[int] = self.start  # None = exhausted
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("sequence")
 
     def nextval(self) -> int:
         from tidb_tpu.utils.failpoint import inject

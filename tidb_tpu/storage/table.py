@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.chunk import HostBlock, HostColumn, column_from_values
 from tidb_tpu.dtypes import Kind, SQLType
 
@@ -92,7 +93,7 @@ class Table:
     def __init__(self, name: str, schema: TableSchema):
         self.name = name
         self.schema = schema
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("table")
         # process-unique id: cache keys must survive CPython reusing a
         # freed Table's memory address (id()) for a new Table — a
         # drop/create cycle at the same address with an equal version

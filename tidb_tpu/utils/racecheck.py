@@ -1,0 +1,165 @@
+"""Lock-order race/deadlock detection (the race-detector analog).
+
+Reference: the Go build runs `make race` (ut --race, Makefile:192) and
+guards race-only code with pkg/util/israce; TiKV-side lock deadlocks
+are caught at runtime by unistore's wait-for detector
+(pkg/store/mockstore/unistore/tikv/detector.go). Python under the GIL
+has no torn reads for the Go detector to catch — the race class that
+DOES exist here is *lock-order inversion* between the engine's mutexes
+(table lock vs catalog lock vs advancer mutexes), which deadlocks two
+threads exactly like the reference's txn wait cycles.
+
+`make_lock(name)` returns a plain threading.Lock unless
+TIDB_TPU_RACECHECK=1 (or `enable()` was called), in which case it
+returns an order-tracked wrapper: every acquisition records the
+(held-class -> acquiring-class) edges; an edge that REVERSES an edge
+seen anywhere earlier in the process is a potential deadlock and
+raises LockOrderError with both stacks' lock names. The check is by
+lock *class* (the `name` passed at construction), matching how
+deadlock cycles are reasoned about, and the edge graph is global —
+single test runs catch inversions exercised on any thread, the same
+way one `--race` CI run guards the whole repo.
+
+Self-deadlock (re-acquiring the same non-reentrant class in one
+thread) is also reported — under a plain Lock it would hang forever.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Set, Tuple
+
+
+class LockOrderError(RuntimeError):
+    pass
+
+
+_enabled = os.environ.get("TIDB_TPU_RACECHECK", "0") == "1"
+_graph_mu = threading.Lock()
+#: lock-class -> set of lock-classes acquired while it was held
+_edges: Dict[str, Set[str]] = {}
+#: where each recorded edge was first seen (for the report)
+_edge_origin: Dict[Tuple[str, str], str] = {}
+_held = threading.local()
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Clear the recorded edge graph (test isolation)."""
+    with _graph_mu:
+        _edges.clear()
+        _edge_origin.clear()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _held_stack() -> List[str]:
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+class TrackedLock:
+    """Order-tracking wrapper with the Lock/context-manager protocol."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lk = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        stack = _held_stack()
+        if self.name in stack:
+            raise LockOrderError(
+                f"self-deadlock: lock class '{self.name}' re-acquired "
+                f"while held (stack: {stack})"
+            )
+        for held in stack:
+            self._record_edge(held, self.name, stack)
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            stack.append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        elif self.name in stack:
+            # out-of-LIFO release is legal for Lock; drop the entry
+            stack.remove(self.name)
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._lk.locked()
+
+    @staticmethod
+    def _record_edge(held: str, acquiring: str, stack) -> None:
+        if held == acquiring:
+            return
+        with _graph_mu:
+            fwd = _edges.setdefault(held, set())
+            if acquiring in fwd:
+                return  # known-consistent order
+            # the reversal check BEFORE recording: if `held` is
+            # REACHABLE from `acquiring` through recorded edges, adding
+            # held->acquiring closes a cycle — N threads interleaving
+            # the N paths deadlock (direct reversal is the 2-cycle;
+            # BFS catches table->A->B->table style 3+-cycles too)
+            seen, frontier = {acquiring}, [acquiring]
+            while frontier:
+                node = frontier.pop()
+                for nxt in _edges.get(node, ()):
+                    if nxt == held:
+                        origin = _edge_origin.get((node, held), "?")
+                        raise LockOrderError(
+                            f"lock-order inversion: acquiring "
+                            f"'{acquiring}' while holding {stack}, but "
+                            f"'{node}' -> '{held}' was recorded at "
+                            f"{origin}, making '{held}' reachable from "
+                            f"'{acquiring}' — interleaving threads "
+                            "deadlock on this cycle"
+                        )
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            fwd.add(acquiring)
+            import traceback
+
+            frame = traceback.extract_stack(limit=6)[0]
+            _edge_origin[(held, acquiring)] = (
+                f"{frame.filename}:{frame.lineno}"
+            )
+
+
+def make_lock(name: str):
+    """A mutex for lock class `name`: plain threading.Lock normally,
+    TrackedLock under race checking."""
+    if _enabled:
+        return TrackedLock(name)
+    return threading.Lock()
+
+
+def edge_graph() -> Dict[str, Set[str]]:
+    with _graph_mu:
+        return {k: set(v) for k, v in _edges.items()}
