@@ -173,6 +173,46 @@ CALLS = {
   "format_bytes": "format_bytes(1048576)", "format_nano_time": "format_nano_time(1000000)",
   "password_fn": "password('x')", "octet_length": "octet_length('ab')",
   "is_false_op": "0 is false",
+  # operator classes — the reference registers these as builtin
+  # function classes too (ast.EQ/ast.Plus/ast.LogicAnd/... in
+  # pkg/expression/builtin.go), so they count toward the 296
+  "op_eq": "1 = 1", "op_ne": "1 <> 2", "op_lt": "1 < 2",
+  "op_le": "1 <= 2", "op_gt": "2 > 1", "op_ge": "2 >= 1",
+  "op_nulleq": "NULL <=> NULL", "op_plus": "1 + 2", "op_minus": "3 - 1",
+  "op_mul": "2 * 3", "op_div": "7 / 2", "op_intdiv": "7 div 2",
+  "op_mod": "7 % 3", "op_unaryminus": "-a from t",
+  "op_and": "1 and 1", "op_or": "0 or 1", "op_xor": "1 xor 0",
+  "op_not": "not 0", "op_like": "'abc' like 'a%'",
+  "op_in": "1 in (1, 2)",
+  "op_case": "case when 1 = 1 then 'y' else 'n' end",
+  "op_isnull": "NULL is null",
+  "date_literal": "date '2024-01-01'",
+  "time_literal": "time '10:00:00'",
+  "timestamp_literal": "timestamp '2024-01-01 10:00:00'",
+  # previously-implemented functions the probe never listed
+  "character_length": "character_length('abc')",
+  "row_constructor": "(1, 2) = (1, 2)",
+  # round-5 misc/info/legacy-crypto family (expression/miscfuncs.py)
+  "vitess_hash": "vitess_hash(1123)", "tidb_shard": "tidb_shard(1123)",
+  "convert_tz": "convert_tz('2024-01-01 12:00:00', '+00:00', '+08:00')",
+  "timediff": "timediff('10:00:00', '08:30:00')",
+  "time_format": "time_format('10:30:45', '%H:%i')",
+  "translate": "translate('abc', 'ab', 'xy')",
+  "sm3": "sm3('abc')",
+  "validate_password_strength": "validate_password_strength('Str0ng!x')",
+  "encode": "encode('s', 'p')", "decode": "decode(encode('s', 'p'), 'p')",
+  "des_encrypt": "des_encrypt('x')", "des_decrypt": "des_decrypt('x')",
+  "encrypt": "encrypt('x')", "old_password": "old_password('x')",
+  "load_file": "load_file('/nope')",
+  "master_pos_wait": "master_pos_wait('f', 4)",
+  "tidb_parse_tso": "tidb_parse_tso(449217004453888000)",
+  "tidb_parse_tso_logical": "tidb_parse_tso_logical(449217004453888001)",
+  "tidb_current_tso": "tidb_current_tso()",
+  "tidb_is_ddl_owner": "tidb_is_ddl_owner()",
+  "tidb_bounded_staleness":
+      "tidb_bounded_staleness('2024-01-01 00:00:00', '2024-01-02 00:00:00')",
+  "tidb_encode_sql_digest": "tidb_encode_sql_digest('select 1')",
+  "tidb_decode_sql_digests": "tidb_decode_sql_digests('[]')",
 }
 
 ok, fail = [], []

@@ -141,7 +141,7 @@ def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
     """MySQL coerces temporal-string literals when compared with temporal
     columns: `d < '1995-01-01'` compares as dates (and datetimes / times),
     not strings."""
-    if op not in COMPARE and op not in {"in", "add", "sub", "datediff"}:
+    if op not in COMPARE and op not in {"in", "add", "sub", "datediff", "nulleq"}:
         return args
     kinds = {a.type.kind for a in args if a.type is not None}
     temporal = kinds & {Kind.DATE, Kind.DATETIME, Kind.TIME}
@@ -189,7 +189,7 @@ def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:
 def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLType:
     ts = [a.type for a in args]
     if op in COMPARE or op in LOGIC or op in {
-        "not", "isnull", "isnotnull", "like", "in", "istrue",
+        "not", "isnull", "isnotnull", "like", "in", "istrue", "nulleq",
     }:
         return BOOL
     if op == "_force_bin":

@@ -1334,7 +1334,7 @@ def _compile(e: Expr, dicts: DictContext) -> _CompiledExpr:
     assert isinstance(e, Func)
     op = e.op
 
-    if op in ARITH or op in COMPARE or op in BITOPS:
+    if op in ARITH or op in COMPARE or op in BITOPS or op == "nulleq":
         return _compile_binary(e, dicts)
     if op == "bit_neg":
         (a,) = [_compile(x, dicts) for x in e.args]
@@ -1977,11 +1977,11 @@ def _is_string_col(e: Expr) -> bool:
 def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
     op, (ea, eb) = e.op, e.args
     # string comparisons: column vs literal -> integer code compare.
-    if op in COMPARE and _is_string_col(ea) and isinstance(eb, Literal):
+    if (op in COMPARE or op == "nulleq") and _is_string_col(ea) and isinstance(eb, Literal):
         return _compile_strcmp(e, dicts, flipped=False)
-    if op in COMPARE and _is_string_col(eb) and isinstance(ea, Literal):
+    if (op in COMPARE or op == "nulleq") and _is_string_col(eb) and isinstance(ea, Literal):
         return _compile_strcmp(e, dicts, flipped=True)
-    if op in COMPARE and _is_string_col(ea) and _is_string_col(eb):
+    if (op in COMPARE or op == "nulleq") and _is_string_col(ea) and _is_string_col(eb):
         # general string comparison: remap both sides into a merged sorted
         # dictionary, then compare codes as integers. A CI collation on
         # EITHER side makes the comparison CI (MySQL collation coercion):
@@ -2005,7 +2005,11 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
             d = {
                 "eq": x == y, "ne": x != y, "lt": x < y,
                 "le": x <= y, "gt": x > y, "ge": x >= y,
+                "nulleq": x == y,
             }[op]
+            if op == "nulleq":
+                d = (valid & d) | (~a.valid & ~c.valid)
+                return DevCol(d, jnp.ones_like(valid))
             return DevCol(d, valid)
 
         return _strstr
@@ -2014,7 +2018,7 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
     ta, tb = ea.type, eb.type
     from tidb_tpu.dtypes import common_type
 
-    if op in COMPARE:
+    if op in COMPARE or op == "nulleq":
         if _is_string_col(ea) and _is_string_col(eb):
             target = None  # compare raw codes
         else:
@@ -2090,7 +2094,7 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
                 q = x // ys
                 q = q + ((x % ys != 0) & ((x < 0) ^ (ys < 0)))
                 d = x - q * ys
-        elif op == "eq":
+        elif op in ("eq", "nulleq"):
             d = x == y
         elif op == "ne":
             d = x != y
@@ -2108,6 +2112,11 @@ def _compile_binary(e: Func, dicts: DictContext) -> _CompiledExpr:
             d = d.astype(jnp.int32)
         if op == "sub" and e.type and e.type.kind == Kind.DATE:
             d = d.astype(jnp.int32)
+        if op == "nulleq":
+            # null-safe equality (<=>): never NULL — TRUE when both
+            # operands are NULL, FALSE when exactly one is
+            d = (valid & d) | (~a.valid & ~c.valid)
+            valid = jnp.ones_like(valid)
         return DevCol(d, valid)
 
     return _bin
@@ -2142,6 +2151,14 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
     f, dictionary = string_expr(col, dicts)
     note_baked_param(lit)
     if lit.value is None:
+        if op == "nulleq":
+            # col <=> NULL: TRUE exactly where the column is NULL
+            def _nullsafe(b):
+                c = f(b)
+                return DevCol(~c.valid, jnp.ones_like(c.valid))
+
+            return _nullsafe
+
         # comparison with NULL is NULL for every row
         def _nullcmp(b):
             c = f(b)
@@ -2169,7 +2186,7 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
         code = c.data
         if rank_lut is not None:
             code = rank_lut[jnp.clip(code, 0, rank_lut.shape[0] - 1)]
-        if op == "eq":
+        if op in ("eq", "nulleq"):
             d = (code == pos) if exact else jnp.zeros_like(code, dtype=bool)
         elif op == "ne":
             d = (code != pos) if exact else jnp.ones_like(code, dtype=bool)
@@ -2183,6 +2200,10 @@ def _compile_strcmp(e: Func, dicts: DictContext, flipped: bool) -> _CompiledExpr
             d = code >= pos
         else:  # pragma: no cover
             raise AssertionError(op)
+        if op == "nulleq":
+            # non-NULL literal: TRUE only where the column is non-NULL
+            # and equal; never NULL itself
+            return DevCol(d & c.valid, jnp.ones_like(c.valid))
         return DevCol(d, c.valid)
 
     return _cmp

@@ -526,6 +526,14 @@ class SysVarRef:
 
 
 @dataclasses.dataclass
+class UserVarRef:
+    """@name in an expression — session user variable read (reference:
+    getVar, pkg/expression/builtin_other.go)."""
+
+    name: str
+
+
+@dataclasses.dataclass
 class Trace:
     stmt: object
 

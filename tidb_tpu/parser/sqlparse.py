@@ -928,9 +928,23 @@ class Parser:
         return self.parse_or()
 
     def parse_or(self):
-        e = self.parse_and()
+        e = self.parse_logical_xor()
         while self.accept_kw("or") or self.accept_op("||"):
-            e = ast.Call("or", [e, self.parse_and()])
+            e = ast.Call("or", [e, self.parse_logical_xor()])
+        return e
+
+    def parse_logical_xor(self):
+        # MySQL precedence: OR < XOR < AND (the bitwise ^ level keeps
+        # the separate parse_xor name further down)
+        e = self.parse_and()
+        while self._at_ident("xor"):
+            # logical XOR: (a != 0) != (b != 0), NULL-propagating
+            self.advance()
+            r = self.parse_and()
+            e = ast.Call("ne", [
+                ast.Call("ne", [e, ast.Const(0)]),
+                ast.Call("ne", [r, ast.Const(0)]),
+            ])
         return e
 
     def parse_and(self):
@@ -1044,6 +1058,13 @@ class Parser:
     def parse_predicate(self):
         e = self.parse_bitor()
         while True:
+            if self.at_op("<=>"):
+                # null-safe equality: its own kernel op (TRUE when both
+                # NULL, FALSE when exactly one is, never NULL) — a
+                # desugar would re-evaluate both operands three times
+                self.advance()
+                e = ast.Call("nulleq", [e, self.parse_bitor()])
+                continue
             if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
                 op = self.advance().text
                 opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
@@ -1219,6 +1240,10 @@ class Parser:
 
     def parse_primary(self):
         t = self.cur
+        if t.kind == "op" and t.text == "@":
+            # @name: session user variable read (SET @x = ... writes it)
+            self.advance()
+            return ast.UserVarRef(self.expect_ident().lower())
         if t.kind == "sysvar":
             self.advance()
             rest = t.text
@@ -1256,6 +1281,18 @@ class Parser:
                 self.advance()
                 return ast.Const(self.advance().text, type_hint=DATE)
             # else fall through: DATE(...) function or identifier
+        if (
+            self.cur.kind in ("kw", "id")
+            and self.cur.text.lower() in ("time", "timestamp")
+            and self.toks[self.i + 1].kind == "str"
+        ):
+            # TIME 'hh:mm:ss' / TIMESTAMP 'yyyy-mm-dd hh:mm:ss' literals
+            kind = self.cur.text.lower()
+            self.advance()
+            return ast.Const(
+                self.advance().text,
+                type_hint=TIME if kind == "time" else DATETIME,
+            )
         if self.at_kw("interval"):
             self.advance()
             if self.at_op("("):

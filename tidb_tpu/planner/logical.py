@@ -704,6 +704,42 @@ class ExprBinder:
             from tidb_tpu.dtypes import TIME as _T
 
             return Func(op="cast", args=(self.lower(e.args[0]),), type=_T)
+        from tidb_tpu.expression.miscfuncs import CONST_FNS as _MISC
+
+        if op in _MISC:
+            # misc/info/legacy-crypto family (expression/miscfuncs.py):
+            # const-folded like the rest of the connector-facing misc
+            # functions below. Arguments lower first so nested foldable
+            # calls (DECODE(ENCODE(x, p), p)) reduce to Literals.
+            vals = []
+            for a in e.args:
+                c = self._const_arg(a)
+                if c is not None:
+                    vals.append(c.value)
+                    continue
+                low = self.lower(a)
+                if isinstance(low, Literal):
+                    vals.append(low.value)
+                    continue
+                raise PlanError(
+                    f"{op.upper()} supports constant arguments only"
+                )
+            from tidb_tpu.dtypes import INT64 as _I64, STRING as _S
+
+            fn, kind = _MISC[op]
+            # every function in this family NULL-propagates (MySQL misc
+            # semantics) — short-circuit so impls skip per-arg checks
+            try:
+                v = None if any(x is None for x in vals) else fn(*vals)
+            except (TypeError, ValueError, ArithmeticError) as ex:
+                raise PlanError(
+                    f"Incorrect arguments to {op.upper()}: {ex}"
+                )
+            if kind == "int":
+                return Literal(
+                    type=_I64, value=None if v is None else int(v)
+                )
+            return Literal(type=_S, value=None if v is None else str(v))
         if op in ("format_bytes", "format_nano_time", "password"):
             c = self._const_arg(e.args[0]) if e.args else None
             if c is None:

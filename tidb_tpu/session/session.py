@@ -1594,6 +1594,49 @@ class Session(DDLMixin):
                     "Data length out of range for random_bytes (1..1024)"
                 )
             return ast.Const(_os.urandom(n).decode("latin-1"))
+        if isinstance(node, ast.UserVarRef):
+            return ast.Const(self.user_vars.get(node.name))
+        if isinstance(node, ast.Call) and node.op.lower() in (
+            "tidb_encode_sql_digest", "tidb_decode_sql_digests",
+        ):
+            from tidb_tpu.utils.metrics import sql_digest
+
+            op2 = node.op.lower()
+            a0 = node.args[0] if node.args else None
+            if not isinstance(a0, ast.Const):
+                raise ValueError(f"{op2.upper()} supports constant arguments only")
+            if a0.value is None:
+                return ast.Const(None)
+            if op2 == "tidb_encode_sql_digest":
+                import hashlib as _h
+
+                return ast.Const(
+                    _h.sha256(sql_digest(str(a0.value)).encode()).hexdigest()
+                )
+            # decode: map digests back to normalized texts via this
+            # session's statement summary (reference resolves through
+            # the cluster stmt summary tables)
+            import json as _json
+
+            try:
+                digests = _json.loads(str(a0.value))
+            except Exception:
+                return ast.Const(None)
+            if not isinstance(digests, list):
+                return ast.Const(None)
+            import hashlib as _h
+
+            from tidb_tpu.utils.metrics import STMT_SUMMARY
+
+            # summary keys ARE the normalized texts (sql_digest);
+            # the wire digest is their sha256
+            by_digest = {
+                _h.sha256(str(norm).encode()).hexdigest(): str(norm)
+                for norm, _n, _s, _mx, _sample in STMT_SUMMARY.rows()
+            }
+            return ast.Const(
+                _json.dumps([by_digest.get(str(d)) for d in digests])
+            )
         if isinstance(node, ast.Call) and not node.args:
             op = node.op.lower()
             if op == "last_insert_id":
@@ -2745,7 +2788,13 @@ class Session(DDLMixin):
     # seed/recursive iteration, pkg/executor/cte.go:70). Each recursive
     # CTE is evaluated to a fixpoint into a scratch catalog table; the
     # body then plans against a plain SELECT over that table.
-    _CTE_MAX_RECURSION = 1000  # mysql cte_max_recursion_depth default
+    @property
+    def _CTE_MAX_RECURSION(self) -> int:
+        # the real cte_max_recursion_depth sysvar (mysql default 1000)
+        try:
+            return int(self.vars.get("cte_max_recursion_depth") or 1000)
+        except Exception:
+            return 1000
 
     def _run_recursive_with(self, s, outer_ctes=None) -> Result:
         merged = dict(outer_ctes or {})

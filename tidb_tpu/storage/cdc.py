@@ -249,6 +249,12 @@ class Changefeed:
                     ts, db, name, t, version = entries[-1]
                     key = (db.lower(), name.lower())
                     base = self._baseline.get(key)
+                    # the initial-capture entry REUSES the baseline's
+                    # pin (one pin, one release): its version must not
+                    # also count as an intermediate, or the baseline
+                    # branch below double-unpins a pin that may be
+                    # shared with log backup / stale readers
+                    base_v = base[1] if base is not None else None
                     if base is not None and base[0].uid == t.uid and any(
                         e[4] == base[1] for e in entries
                     ):
@@ -272,7 +278,8 @@ class Changefeed:
                     # the net diff; pins release once the segment lands
                     events.extend(evs)
                     done.append((t, version, key, new_schema,
-                                 [e[4] for e in entries[:-1]]))
+                                 [e[4] for e in entries[:-1]
+                                  if e[4] != base_v]))
             except BaseException:
                 with self._lock:
                     self._queue = batch + self._queue
