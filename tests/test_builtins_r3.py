@@ -28,7 +28,7 @@ def q1(s, sql):
 class TestDate:
     def test_to_from_days(self, s):
         assert q1(s, "select to_days(d) from t") == 728732
-        assert q1(s, "select from_days(728732) from t") == 9204
+        assert q1(s, "select from_days(728732) from t") == "1995-03-15"
         assert q1(s, "select to_days(from_days(728732)) from t") == 728732
 
     def test_week_numbers(self, s):
@@ -40,8 +40,8 @@ class TestDate:
         assert r.rows == [(0, 52)]
 
     def test_last_day_makedate(self, s):
-        assert q1(s, "select last_day(d) from t") == 9220  # 1995-03-31
-        assert q1(s, "select makedate(1995, 74) from t") == 9204
+        assert q1(s, "select last_day(d) from t") == "1995-03-31"
+        assert q1(s, "select makedate(1995, 74) from t") == "1995-03-15"
 
     def test_names(self, s):
         assert q1(s, "select dayname(d) from t") == "Wednesday"
@@ -56,7 +56,7 @@ class TestDate:
         )
 
     def test_str_to_date(self, s):
-        assert q1(s, "select str_to_date('1995-03-15', '%Y-%m-%d') from t") == 9204
+        assert q1(s, "select str_to_date('1995-03-15', '%Y-%m-%d') from t") == "1995-03-15"
         # unparseable -> NULL
         assert q1(s, "select str_to_date('nope', '%Y-%m-%d') from t") is None
 
@@ -80,11 +80,11 @@ class TestDate:
 
     def test_time_sec(self, s):
         assert q1(s, "select time_to_sec('10:30:00') from t") == 37800
-        assert q1(s, "select sec_to_time(3661) from t") == 3661000000
+        assert q1(s, "select sec_to_time(3661) from t") == "01:01:01"
 
     def test_adddate_numeric(self, s):
-        assert q1(s, "select adddate(d, 16) from t") == 9220
-        assert q1(s, "select subdate(d, interval 1 month) from t") == 9176
+        assert q1(s, "select adddate(d, 16) from t") == "1995-03-31"
+        assert q1(s, "select subdate(d, interval 1 month) from t") == "1995-02-15"
 
 
 class TestStringInt:

@@ -1,0 +1,120 @@
+"""Golden SQL end-to-end suite: .test files replayed against .result.
+
+Reference: tests/integrationtest/t/*.test -> r/*.result driven by
+run-tests.sh, with a record mode that regenerates expectations
+(tests/integrationtest/README.md). Same workflow here:
+
+- `tests/golden/t/<name>.test`: SQL statements, one per line or
+  multi-line terminated by ';'. Lines starting with `--` or `#` are
+  comments. `--error` on its own line means the NEXT statement must
+  fail (any error), matching mysql-test's `--error` directive.
+- `tests/golden/r/<name>.result`: the statement echoed, then its
+  column header and rows tab-separated (NULL for SQL NULL), exactly
+  as this runner formats them.
+- Record mode: `GOLDEN_RECORD=1 pytest tests/test_golden.py`
+  regenerates every .result from the live engine; the diff is then
+  reviewed like any code change.
+
+Each .test file runs in a FRESH session+catalog (test isolation like
+testkit's CreateMockStore-per-suite)."""
+
+import os
+import pathlib
+
+import pytest
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
+RECORD = os.environ.get("GOLDEN_RECORD") == "1"
+
+
+def _statements(text):
+    """Yield (stmt, expect_error) from a .test file."""
+    expect_error = False
+    buf = []
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if s.startswith("--"):
+            if s == "--error":
+                expect_error = True
+            continue  # other directives/comments ignored
+        buf.append(line)
+        if s.endswith(";"):
+            stmt = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            yield stmt, expect_error
+            expect_error = False
+    if buf:
+        yield "\n".join(buf), expect_error
+
+
+def _fmt_value(v):
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        # trim float noise the way the mysql client presents it
+        s = f"{v:.10g}"
+        return s
+    return str(v)
+
+
+def _run_file(path: pathlib.Path) -> str:
+    from tidb_tpu.session import Session
+
+    sess = Session()
+    out = []
+    for stmt, expect_error in _statements(path.read_text()):
+        out.append(stmt + ";")
+        try:
+            r = sess.execute(stmt)
+        except Exception as e:
+            if expect_error:
+                out.append(f"ERROR: {type(e).__name__}")
+                continue
+            raise AssertionError(
+                f"{path.name}: statement failed unexpectedly:\n"
+                f"{stmt}\n{type(e).__name__}: {e}"
+            )
+        if expect_error:
+            raise AssertionError(
+                f"{path.name}: statement expected to error but "
+                f"succeeded:\n{stmt}"
+            )
+        if r is not None and getattr(r, "columns", None):
+            out.append("\t".join(r.columns))
+            for row in r.rows:
+                out.append("\t".join(_fmt_value(v) for v in row))
+    return "\n".join(out) + "\n"
+
+
+def _cases():
+    return sorted(p.stem for p in (GOLDEN / "t").glob("*.test"))
+
+
+@pytest.mark.parametrize("name", _cases())
+def test_golden(name):
+    tfile = GOLDEN / "t" / f"{name}.test"
+    rfile = GOLDEN / "r" / f"{name}.result"
+    got = _run_file(tfile)
+    if RECORD:
+        rfile.parent.mkdir(parents=True, exist_ok=True)
+        rfile.write_text(got)
+        pytest.skip(f"recorded {rfile}")
+    assert rfile.exists(), (
+        f"no expected result for {name}; run GOLDEN_RECORD=1 to record"
+    )
+    want = rfile.read_text()
+    if got != want:
+        import difflib
+
+        diff = "\n".join(
+            difflib.unified_diff(
+                want.splitlines(), got.splitlines(),
+                fromfile=f"r/{name}.result", tofile="actual", lineterm="",
+            )
+        )
+        raise AssertionError(f"golden mismatch for {name}:\n{diff}")

@@ -102,11 +102,11 @@ def test_month_interval_exact(sess):
         "select i, date_sub(dt, interval 1 month), "
         "date_add(dt, interval 1 year) from d order by i"
     )
-    assert r.rows[0][1] == date_to_days("1998-02-28")  # clamped, not -30d
-    assert r.rows[0][2] == date_to_days("1999-03-31")
-    assert r.rows[1][1] == date_to_days("1996-01-29")
-    assert r.rows[1][2] == date_to_days("1997-02-28")  # leap -> clamp
-    assert r.rows[2][1] == date_to_days("1995-11-15")
+    assert r.rows[0][1] == "1998-02-28"  # clamped, not -30d
+    assert r.rows[0][2] == "1999-03-31"
+    assert r.rows[1][1] == "1996-01-29"
+    assert r.rows[1][2] == "1997-02-28"  # leap -> clamp
+    assert r.rows[2][1] == "1995-11-15"
     r = sess.must_query("select date '1998-12-01' - interval 3 month")
     assert r.rows == [("1998-09-01",)]
 

@@ -44,6 +44,12 @@ def decode_table(sess, name):
     return data, n
 
 
+def _d2s(day_int):
+    from tidb_tpu.dtypes import days_to_date
+
+    return days_to_date(int(day_int))
+
+
 def days(s):
     from tidb_tpu.dtypes import date_to_days
 
@@ -141,7 +147,8 @@ def test_q3(sess):
     for i in range(no):
         if orders["o_custkey"][i] in building and orders["o_orderdate"][i] < cut:
             okeys[orders["o_orderkey"][i]] = (
-                orders["o_orderdate"][i],
+                # engine results present DATE as 'YYYY-MM-DD'
+                _d2s(orders["o_orderdate"][i]),
                 orders["o_shippriority"][i],
             )
     agg = defaultdict(float)

@@ -216,14 +216,56 @@ def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
     return Batch(cols, jnp.asarray(row_valid))
 
 
+def present_temporals(col: "HostColumn"):
+    """decode() + MySQL string presentation for temporal kinds — the
+    user-facing result seam (decode() itself stays raw ints for
+    internal consumers). Vectorized via numpy datetime64 for
+    DATE/DATETIME; TIME (rare in results) loops only over its rows."""
+    k = col.type.kind
+    if k not in (Kind.DATE, Kind.DATETIME, Kind.TIME):
+        return col.decode()
+    n = len(col.data)
+    out = np.empty(n, dtype=object)
+    if k == Kind.DATE:
+        out[:] = np.datetime_as_string(
+            col.data.astype("datetime64[D]"), unit="D"
+        )
+    elif k == Kind.DATETIME:
+        micros = col.data.astype(np.int64)
+        secs = np.datetime_as_string(
+            (micros // 1_000_000).astype("datetime64[s]"), unit="s"
+        )
+        secs = np.char.replace(secs, "T", " ")
+        frac = micros % 1_000_000
+        out[:] = secs
+        nz = frac != 0
+        if nz.any():
+            from tidb_tpu.dtypes import micros_to_datetime
+
+            idx = np.nonzero(nz)[0]
+            for i in idx:
+                out[i] = micros_to_datetime(int(micros[i]))
+    else:
+        from tidb_tpu.dtypes import micros_to_time
+
+        out[:] = [micros_to_time(int(v)) for v in col.data]
+    out[~col.valid] = None
+    return out
+
+
 def materialize_rows(batch, schema_cols, dicts):
     """Device batch -> python row tuples for a plan schema (one fetch,
     vectorized decode). The single implementation behind the session's
-    result materialization and the engine-RPC response encoder."""
+    result materialization and the engine-RPC response encoder.
+    Temporal columns present as MySQL-formatted strings HERE — the
+    user-facing seam — while decode() stays raw (day/micros ints) for
+    internal consumers (oracles, dump, CDC diffing)."""
     types = {c.internal: c.type for c in schema_cols}
     block = batch_to_block(batch, types, dicts)
     internals = [c.internal for c in schema_cols]
-    decoded = {i: block.columns[i].decode() for i in internals}
+    decoded = {
+        i: present_temporals(block.columns[i]) for i in internals
+    }
     return [
         tuple(decoded[i][r] for i in internals) for r in range(block.nrows)
     ]

@@ -153,6 +153,9 @@ class TaskManager:
 
     # -- task submission ----------------------------------------------
     def submit(self, task_type: str, meta: dict) -> str:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dxf/submit")
         if task_type not in _TASK_TYPES:
             raise ValueError(f"unknown task type {task_type!r}")
         tid = uuid.uuid4().hex[:12]
@@ -275,6 +278,9 @@ class TaskManager:
         return None
 
     def heartbeat(self, subtask_id: str) -> None:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("dxf/heartbeat")
         with self._lock:
             s = self.subtasks.get(subtask_id)
             if s is not None:

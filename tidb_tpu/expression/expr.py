@@ -110,6 +110,7 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
     assert isinstance(e, Func)
     args = tuple(bind_expr(a, schema) for a in e.args)
     args = _coerce_date_literals(e.op, args)
+    args = _coerce_numeric_string_literals(e.op, args)
     if e.op == "time_to_sec" and args:
         a0 = args[0]
         if (
@@ -135,6 +136,64 @@ def bind_expr(e: Expr, schema: Dict[str, SQLType]) -> Expr:
             return Literal(type=literal_type(-v), value=-v)
     t = _infer(e.op, args, e.type)
     return Func(type=t, op=e.op, args=args)
+
+
+def _mysql_numeric_prefix(sv: str):
+    """MySQL string->number coercion: the longest numeric prefix
+    ('12abc' -> 12, '2' -> 2, 'abc' -> 0, '1.5e2x' -> 150.0)."""
+    import re as _re
+
+    m = _re.match(
+        r"\s*[-+]?(\d+\.?\d*([eE][-+]?\d+)?|\.\d+([eE][-+]?\d+)?)",
+        sv,
+    )
+    if not m:
+        return 0
+    f = float(m.group(0))
+    import math as _math
+
+    if not _math.isfinite(f):
+        return f  # '1e999' coerces to a huge double (MySQL), stays float
+    return int(f) if f == int(f) and "e" not in m.group(0).lower() \
+        and "." not in m.group(0) else f
+
+
+_NUMERIC_KINDS = {Kind.INT, Kind.FLOAT, Kind.DECIMAL, Kind.BOOL}
+
+
+def _coerce_numeric_string_literals(
+    op: str, args: Tuple[Expr, ...]
+) -> Tuple[Expr, ...]:
+    """String literals coerce to their numeric prefix in arithmetic
+    (MySQL: '3' * a = 3a, 'abc' + 1 = 1) and in comparisons whose
+    other operand is numeric (1 = '1' is TRUE, 'abc' = 0 is TRUE) —
+    without this the binder's common-type path treated every string
+    literal as 0 and numeric-vs-string compares were always false.
+    String-vs-string comparison is untouched (collation compare)."""
+    if op in COMPARE or op == "nulleq":
+        other_kinds = {
+            a.type.kind for a in args
+            if a.type is not None and not (
+                isinstance(a, Literal) and isinstance(a.value, str)
+            )
+        }
+        if not (other_kinds & _NUMERIC_KINDS):
+            return args
+    elif op not in ARITH:
+        return args
+    out = []
+    for a in args:
+        if (
+            isinstance(a, Literal)
+            and a.type is not None
+            and a.type.kind == Kind.STRING
+            and isinstance(a.value, str)
+        ):
+            v = _mysql_numeric_prefix(a.value)
+            out.append(Literal(type=literal_type(v), value=v))
+        else:
+            out.append(a)
+    return tuple(out)
 
 
 def _coerce_date_literals(op: str, args: Tuple[Expr, ...]) -> Tuple[Expr, ...]:

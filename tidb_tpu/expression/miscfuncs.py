@@ -86,7 +86,12 @@ def _parse_offset(tz: str) -> Optional[timedelta]:
         h, m = int(parts[0]), int(parts[1])
     except ValueError:
         return None
-    if not (0 <= h <= 13 and 0 <= m <= 59):
+    # MySQL 8.0.19+ permits -13:59 .. +14:00
+    if not (0 <= m <= 59):
+        return None
+    if h > 14 or (h == 14 and (m != 0 or sign < 0)) or (
+        sign < 0 and h > 13
+    ):
         return None
     return sign * timedelta(hours=h, minutes=m)
 

@@ -160,6 +160,9 @@ class EngineServer:
         self.port = self._tcp.server_address[1]
 
     def _execute(self, executor, req) -> bytes:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("engine/execute")
         from tidb_tpu.chunk import materialize_rows
 
         if req.get("v") != IR_VERSION:

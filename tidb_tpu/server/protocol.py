@@ -423,6 +423,28 @@ def binary_row(row, types) -> bytes:
             vals += struct.pack("<b", 1 if v else 0)
         elif kind == Kind.FLOAT:
             vals += struct.pack("<d", float(v))
+        elif kind == Kind.DATE and isinstance(v, str):
+            # result materialization presents temporals as strings now
+            y, mo, dd = (int(x) for x in v.split("-"))
+            vals += bytes([4]) + struct.pack("<HBB", y, mo, dd)
+        elif kind == Kind.DATETIME and isinstance(v, str):
+            date_part, _, time_part = v.partition(" ")
+            y, mo, dd = (int(x) for x in date_part.split("-"))
+            hh, mi, sec = (time_part or "0:0:0").split(":")
+            fs = float(sec)
+            vals += bytes([11]) + struct.pack(
+                "<HBBBBBI", y, mo, dd, int(hh), int(mi), int(fs),
+                int(round((fs - int(fs)) * 1e6)),
+            )
+        elif kind == Kind.TIME and isinstance(v, str):
+            neg = 1 if v.startswith("-") else 0
+            hh, mi, sec = v.lstrip("-").split(":")
+            fs = float(sec)
+            total_h = int(hh)
+            vals += bytes([12]) + struct.pack(
+                "<BIBBBI", neg, total_h // 24, total_h % 24, int(mi),
+                int(fs), int(round((fs - int(fs)) * 1e6)),
+            )
         elif kind == Kind.DATE and isinstance(v, int):
             d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
             vals += bytes([4]) + struct.pack("<HBB", d.year, d.month, d.day)

@@ -1810,6 +1810,9 @@ class Session(DDLMixin):
                 json_cols=tuple(
                     c.name.lower() for c in s.columns if c.is_json
                 ),
+                not_null=tuple(
+                    c.name.lower() for c in s.columns if c.not_null
+                ),
             )
             # validate table options BEFORE creating anything — a DDL
             # error must not leave a half-created table behind
@@ -2657,11 +2660,14 @@ class Session(DDLMixin):
                 "add", "sub", "mul", "div", "neg", "not", "and", "or",
                 "eq", "ne", "lt", "le", "gt", "ge",
                 "coalesce", "isnull", "isnotnull", "cast",
+                "concat", "concat_ws",
             }
             if e.op not in known:
                 return self._device_const_eval(e)
             args = [self._eval_const_expr(a) for a in e.args]
-            if any(a is None for a in args) and e.op not in ("isnull", "isnotnull", "coalesce"):
+            if any(a is None for a in args) and e.op not in (
+                "isnull", "isnotnull", "coalesce", "concat_ws",
+            ):
                 return None
             import operator as op_
 
@@ -2670,6 +2676,27 @@ class Session(DDLMixin):
                 "eq": op_.eq, "ne": op_.ne, "lt": op_.lt, "le": op_.le,
                 "gt": op_.gt, "ge": op_.ge,
             }
+            _cmp_ops = ("eq", "ne", "lt", "le", "gt", "ge")
+            if (
+                e.op in ("add", "sub", "mul", "div")
+                or (
+                    e.op in _cmp_ops
+                    and any(isinstance(a, str) for a in args)
+                    and any(
+                        isinstance(a, (int, float))
+                        and not isinstance(a, bool)
+                        for a in args
+                    )
+                )
+            ) and any(isinstance(a, str) for a in args):
+                # MySQL coerces a string's numeric prefix in arithmetic
+                # and in comparisons against a numeric operand
+                from tidb_tpu.expression.expr import _mysql_numeric_prefix
+
+                args = [
+                    _mysql_numeric_prefix(a) if isinstance(a, str) else a
+                    for a in args
+                ]
             if e.op in table:
                 return table[e.op](args[0], args[1])
             if e.op == "div":
@@ -2682,6 +2709,22 @@ class Session(DDLMixin):
                 return bool(args[0]) and bool(args[1])
             if e.op in ("or",):
                 return bool(args[0]) or bool(args[1])
+            if e.op in ("concat", "concat_ws"):
+                def _cs(v):
+                    if isinstance(v, bool):
+                        return "1" if v else "0"
+                    if isinstance(v, float) and v == int(v):
+                        return str(int(v))
+                    return str(v)
+
+                if e.op == "concat":
+                    return "".join(_cs(a) for a in args)
+                sep = args[0]
+                if sep is None:
+                    return None
+                return _cs(sep).join(
+                    _cs(a) for a in args[1:] if a is not None
+                )
             if e.op == "coalesce":
                 return next((a for a in args if a is not None), None)
             if e.op == "isnull":
@@ -2689,7 +2732,39 @@ class Session(DDLMixin):
             if e.op == "isnotnull":
                 return args[0] is not None
             if e.op == "cast":
-                return args[0]
+                v = args[0]
+                tgt = getattr(e, "cast_type", None)
+                if tgt is not None and isinstance(v, str):
+                    from tidb_tpu.dtypes import Kind as _K
+                    from tidb_tpu.expression.expr import (
+                        _mysql_numeric_prefix,
+                    )
+
+                    if tgt.kind == _K.INT:
+                        f = float(_mysql_numeric_prefix(v))
+                        # MySQL rounds half away from zero, string or not
+                        import math as _m0
+
+                        return int(
+                            _m0.floor(f + 0.5) if f >= 0
+                            else _m0.ceil(f - 0.5)
+                        )
+                    if tgt.kind == _K.FLOAT:
+                        return float(_mysql_numeric_prefix(v))
+                if tgt is not None and isinstance(v, float) \
+                        and not isinstance(v, bool):
+                    from tidb_tpu.dtypes import Kind as _K2
+
+                    if tgt.kind == _K2.INT:
+                        # MySQL CAST(12.7 AS SIGNED) rounds half away
+                        # from zero, not truncates
+                        import math as _m
+
+                        return int(
+                            _m.floor(v + 0.5) if v >= 0
+                            else _m.ceil(v - 0.5)
+                        )
+                return v
         return self._device_const_eval(e)
 
     def _device_const_eval(self, e):
@@ -3002,11 +3077,13 @@ class Session(DDLMixin):
         if hs is None:
             return None
         names_int, cols, _n, sdicts = hs
+        from tidb_tpu.chunk import present_temporals
+
         types = {c.internal: c.type for c in plan.schema}
         decoded = {
-            n: HostColumn(
+            n: present_temporals(HostColumn(
                 types[n], cols[n][0], cols[n][1], sdicts.get(n)
-            ).decode()
+            ))
             for n in names_int
         }
         rows = [

@@ -10,6 +10,8 @@ on every DDL, which the session layer uses for plan-cache invalidation
 from __future__ import annotations
 
 import threading
+
+from tidb_tpu.utils.failpoint import inject
 from typing import Dict, List, Optional
 
 from tidb_tpu.utils import racecheck
@@ -82,6 +84,7 @@ class Catalog:
     def create_table(
         self, db: str, name: str, schema: TableSchema, if_not_exists: bool = False
     ) -> Table:
+        inject("catalog/create-table")
         db, name = db.lower(), name.lower()
         with self._lock:
             if db not in self._dbs:
@@ -102,6 +105,7 @@ class Catalog:
             return t
 
     def drop_table(self, db: str, name: str, if_exists: bool = False) -> None:
+        inject("catalog/drop-table")
         db, name = db.lower(), name.lower()
         with self._lock:
             if name not in self._dbs.get(db, {}):

@@ -221,6 +221,9 @@ class LogBackupTask:
             return written
 
     def _write_segment(self, ts, db, name, t, version) -> None:
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("logbackup/write-segment")
         key = (db.lower(), name.lower())
         try:
             blocks = t.blocks(version)

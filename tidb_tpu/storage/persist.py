@@ -94,6 +94,7 @@ def table_meta_to_json(t) -> Dict:
             getattr(t, "fk_update_actions", {})
         ) or None,
         "enums": {k: list(v) for k, v in (t.schema.enums or {}).items()} or None,
+        "not_null": list(t.schema.not_null or ()) or None,
         "sets": {k: list(v) for k, v in (t.schema.sets or {}).items()} or None,
         "json_cols": list(t.schema.json_cols),
         "defaults": dict(getattr(t, "defaults", None) or {}) or None,
@@ -107,6 +108,7 @@ def schema_from_meta(meta: Dict) -> TableSchema:
     return TableSchema(
         [(n, _type_from_json(tj)) for n, tj in meta["columns"]],
         primary_key=meta.get("primary_key"),
+        not_null=tuple(meta.get("not_null") or ()),
         enums={
             k: tuple(v) for k, v in (meta.get("enums") or {}).items()
         } or None,

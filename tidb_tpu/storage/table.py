@@ -43,6 +43,9 @@ class TableSchema:
     enums: Optional[Dict[str, tuple]] = None
     sets: Optional[Dict[str, tuple]] = None
     json_cols: tuple = ()
+    # columns declared NOT NULL (MySQL strict-mode write rejection;
+    # PK columns are enforced separately on the key path)
+    not_null: tuple = ()
 
     @property
     def names(self) -> List[str]:
@@ -398,11 +401,20 @@ class Table:
             self._gc_versions()
             return self.version, [b.uid for b in landed]
 
+    def _check_not_null(self, block: HostBlock) -> None:
+        """NOT NULL enforcement on every block-install path (append,
+        UPDATE rewrite, txn commit) — MySQL strict-mode semantics."""
+        for name in self.schema.not_null or ():
+            c = block.columns.get(name)
+            if c is not None and not bool(c.valid.all()):
+                raise ValueError(f"Column {name!r} cannot be null")
+
     def _check_domains(self, block: HostBlock) -> None:
         """ENUM/SET membership + JSON validity on write (caller holds
         _lock). Values are still dictionary codes here only after
         alignment, so this runs on the incoming block's own dict."""
         sch = self.schema
+        self._check_not_null(block)
         constraints = (sch.enums or {}), (sch.sets or {}), sch.json_cols
         if not any(constraints):
             return
@@ -697,6 +709,8 @@ class Table:
         from tidb_tpu.utils.failpoint import inject
 
         inject("storage/install-commit")
+        for b in blocks:
+            self._check_not_null(b)
         with self._lock:
             self.modify_count += int(modified_rows)
             self.version += 1
@@ -714,6 +728,8 @@ class Table:
         None falls back to the conservative max(old, new) — callers who
         know the real count should pass it, or every point UPDATE on a
         big table trips the auto-analyze ratio."""
+        for b in blocks:
+            self._check_not_null(b)
         with self._lock:
             if modified_rows is None:
                 old = sum(b.nrows for b in self._versions[self.version])

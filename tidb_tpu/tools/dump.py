@@ -23,18 +23,16 @@ def _sql_literal(v, t) -> str:
         return "NULL"
     if t.kind == Kind.STRING:
         return "'" + str(v).replace("\\", "\\\\").replace("'", "''") + "'"
-    if t.kind == Kind.DATE:
-        from tidb_tpu.dtypes import days_to_date
+    if t.kind in (Kind.DATE, Kind.DATETIME, Kind.TIME):
+        if isinstance(v, str):  # decode() now presents temporal strings
+            return f"'{v}'"
+        from tidb_tpu.dtypes import (
+            days_to_date, micros_to_datetime, micros_to_time,
+        )
 
-        return f"'{days_to_date(int(v))}'"
-    if t.kind == Kind.DATETIME:
-        from tidb_tpu.dtypes import micros_to_datetime
-
-        return f"'{micros_to_datetime(int(v))}'"
-    if t.kind == Kind.TIME:
-        from tidb_tpu.dtypes import micros_to_time
-
-        return f"'{micros_to_time(int(v))}'"
+        conv = {Kind.DATE: days_to_date, Kind.DATETIME: micros_to_datetime,
+                Kind.TIME: micros_to_time}[t.kind]
+        return f"'{conv(int(v))}'"
     if t.kind == Kind.BOOL:
         return "1" if v else "0"
     if t.kind == Kind.DECIMAL:
