@@ -213,6 +213,7 @@ CALLS = {
       "tidb_bounded_staleness('2024-01-01 00:00:00', '2024-01-02 00:00:00')",
   "tidb_encode_sql_digest": "tidb_encode_sql_digest('select 1')",
   "tidb_decode_sql_digests": "tidb_decode_sql_digests('[]')",
+  "op_ilike": "'ABC' ilike 'abc'",
 }
 
 ok, fail = [], []

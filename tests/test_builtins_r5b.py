@@ -168,3 +168,16 @@ class TestUserVariables:
         assert sess.execute(
             "select a from t where a > @n"
         ).rows == [(15,)]
+
+
+class TestIlike:
+    def test_ilike_shapes(self, sess):
+        sess.execute("create table il (v varchar(16))")
+        sess.execute("insert into il values ('Apple'), ('BANANA'), ('cherry')")
+        assert sess.execute(
+            "select v from il where v ilike 'a%' order by v"
+        ).rows == [("Apple",)]
+        assert sess.execute(
+            "select v from il where v not ilike '%AN%' order by v"
+        ).rows == [("Apple",), ("cherry",)]
+        assert sess.execute("select 'ABC' ilike 'abc'").rows == [(True,)]

@@ -273,7 +273,17 @@ class Parser:
                 if self._at_ident("jobs"):
                     self.advance()
                 return ast.AdminStmt("show_ddl")
-            raise ParseError("ADMIN supports CHECK TABLE/INDEX, SHOW DDL")
+            if word == "checksum":
+                self.advance()
+                self.expect_kw("table")
+                tables = [self._qualified_name()]
+                while self.accept_op(","):
+                    tables.append(self._qualified_name())
+                return ast.AdminStmt("checksum_table", tables)
+            raise ParseError(
+                "ADMIN supports CHECK TABLE/INDEX, SHOW DDL, "
+                "CHECKSUM TABLE"
+            )
         if self._at_ident("changefeed"):
             # CHANGEFEED START TO 'uri' / STOP / STATUS (CDC controls)
             self.advance()
@@ -1165,6 +1175,20 @@ class Parser:
             if self.accept_kw("like"):
                 pat = self.parse_bitor()
                 r = ast.Call("like", [e, pat])
+                e = ast.Call("not", [r]) if neg else r
+                continue
+            if self._at_ident("ilike"):
+                # case-insensitive LIKE (reference ast.Ilike): desugars
+                # through LOWER on the column (a dictionary LUT remap)
+                # with the pattern literal lowercased at parse time —
+                # the LIKE kernel's pattern-is-literal contract holds
+                self.advance()
+                pat = self.parse_bitor()
+                if isinstance(pat, ast.Const) and isinstance(
+                    pat.value, str
+                ):
+                    pat = ast.Const(pat.value.lower())
+                r = ast.Call("like", [ast.Call("lower", [e]), pat])
                 e = ast.Call("not", [r]) if neg else r
                 continue
             if self.cur.kind == "id" and self.cur.text.lower() in (

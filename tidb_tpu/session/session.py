@@ -978,6 +978,8 @@ class Session(DDLMixin):
                 ["SCHEMA_VER", "RUNNING_JOBS", "SELF_ID"],
                 [(self.catalog.schema_version, "", "tidb-tpu-0")],
             )
+        if s.op == "checksum_table":
+            return self._admin_checksum(s)
         problems: list = []
         for db0, name in s.tables:
             db = (db0 or self.db).lower()
@@ -1084,6 +1086,84 @@ class Session(DDLMixin):
                         "disagrees with block data"
                     )
         return problems
+
+    def _admin_checksum(self, s) -> Result:
+        """ADMIN CHECKSUM TABLE t[, ...] — order-independent 64-bit
+        checksum per table (reference: AdminChecksumTable,
+        pkg/parser/ast/misc.go:2323; TiDB reports crc64-xor over
+        encoded KV pairs). Columnar analog: per row, a mix of every
+        column's LOGICAL value (dictionary codes hash through the
+        dictionary's bytes, so the checksum is stable across dictionary
+        remaps and compaction), XOR-folded over rows — the same
+        replication-verify use the reference serves."""
+        import numpy as np
+
+        def _mix(x):
+            # splitmix64 finalizer over uint64 arrays
+            x = (x + np.uint64(0x9E3779B97F4A7C15))
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            return x ^ (x >> np.uint64(31))
+
+        import zlib
+
+        rows = []
+        for db0, name in s.tables:
+            db = (db0 or self.db).lower()
+            t, ver = self._resolve_table_for_read(db, name)
+            total = np.uint64(0)
+            nrows = 0
+            nbytes = 0
+            with np.errstate(over="ignore", invalid="ignore"):
+                for b in t.blocks(ver):
+                    if b.nrows == 0:
+                        continue
+                    acc = np.zeros(b.nrows, dtype=np.uint64)
+                    for ci, cname in enumerate(t.schema.names):
+                        c = b.columns.get(cname)
+                        if c is None:
+                            continue
+                        nbytes += c.data.nbytes
+                        if c.dictionary is not None:
+                            dh = np.array(
+                                [
+                                    zlib.crc32(str(v).encode())
+                                    for v in c.dictionary
+                                ],
+                                dtype=np.uint64,
+                            ) if len(c.dictionary) else np.zeros(
+                                1, dtype=np.uint64
+                            )
+                            codes = np.clip(
+                                c.data.astype(np.int64), 0,
+                                max(len(c.dictionary) - 1, 0),
+                            )
+                            vals = dh[codes]
+                        elif c.data.dtype.itemsize == 8:
+                            # 8-byte ints AND floats: reinterpret bits —
+                            # value-casting floats truncated 1.5 and 1.2
+                            # to the same int
+                            vals = c.data.view(np.uint64)
+                        else:
+                            vals = c.data.astype(np.int64).astype(
+                                np.uint64
+                            )
+                        h = _mix(
+                            vals + np.uint64((ci + 1) * 0x9E3779B9)
+                        )
+                        # NULL contributes a fixed marker, not the data
+                        h = np.where(
+                            c.valid, h, np.uint64(0xDEADBEEF) + np.uint64(ci)
+                        )
+                        acc = _mix(acc ^ h)
+                    total ^= np.bitwise_xor.reduce(acc)
+                    nrows += b.nrows
+            rows.append((db, name.lower(), int(total), nrows, nbytes))
+        return Result(
+            ["Db_name", "Table_name", "Checksum_crc64_xor",
+             "Total_kvs", "Total_bytes"],
+            rows,
+        )
 
     def _admin_check_table(self, t, db, name, ver) -> list:
         import numpy as np
@@ -2654,6 +2734,20 @@ class Session(DDLMixin):
             from tidb_tpu.expression.expr import Literal
 
             lit = self._scalar_subquery(e.query)
+            if lit.type is not None and lit.value is not None:
+                from tidb_tpu.dtypes import (
+                    Kind as _K, days_to_date, micros_to_datetime,
+                    micros_to_time,
+                )
+
+                # present temporals for the tableless surface (the
+                # BOUND path keeps the typed raw literal)
+                if lit.type.kind == _K.DATE:
+                    return days_to_date(int(lit.value))
+                if lit.type.kind == _K.DATETIME:
+                    return micros_to_datetime(int(lit.value))
+                if lit.type.kind == _K.TIME:
+                    return micros_to_time(int(lit.value))
             return lit.value
         if isinstance(e, ast.Call):
             known = {
@@ -2683,9 +2777,7 @@ class Session(DDLMixin):
                     e.op in _cmp_ops
                     and any(isinstance(a, str) for a in args)
                     and any(
-                        isinstance(a, (int, float))
-                        and not isinstance(a, bool)
-                        for a in args
+                        isinstance(a, (int, float, bool)) for a in args
                     )
                 )
             ) and any(isinstance(a, str) for a in args):
@@ -2713,7 +2805,10 @@ class Session(DDLMixin):
                 def _cs(v):
                     if isinstance(v, bool):
                         return "1" if v else "0"
-                    if isinstance(v, float) and v == int(v):
+                    import math as _mf
+
+                    if isinstance(v, float) and _mf.isfinite(v) \
+                            and v == int(v):
                         return str(int(v))
                     return str(v)
 
@@ -2995,7 +3090,25 @@ class Session(DDLMixin):
             return Literal(value=None)
         if len(r.rows) > 1:
             raise ValueError("scalar subquery returned more than one row")
-        return Literal(value=r.rows[0][0])
+        v = r.rows[0][0]
+        t = (r.types[0] if getattr(r, "types", None) else None)
+        if t is not None and v is not None:
+            from tidb_tpu.dtypes import (
+                Kind as _K, date_to_days, datetime_to_micros,
+                time_to_micros,
+            )
+
+            # temporal results present as strings; re-encode to the raw
+            # typed form so the literal composes like a temporal column
+            # (a string literal's numeric prefix would turn a datetime
+            # into its year under arithmetic)
+            if t.kind == _K.DATE and isinstance(v, str):
+                return Literal(type=t, value=int(date_to_days(v)))
+            if t.kind == _K.DATETIME and isinstance(v, str):
+                return Literal(type=t, value=int(datetime_to_micros(v)))
+            if t.kind == _K.TIME and isinstance(v, str):
+                return Literal(type=t, value=int(time_to_micros(v)))
+        return Literal(value=v)
 
     def _apply_binding(self, s):
         """SQL plan binding: a CREATE BINDING whose normalized digest

@@ -119,15 +119,12 @@ class ResourceGroupManager:
                 )
             time.sleep(0.01)
 
-    def _rg_inject(self):
-        from tidb_tpu.utils.failpoint import inject
-
-        inject("resgroup/debit")
-
     def debit(self, name: str, elapsed_s: float, result_bytes: int = 0):
         """Post-statement RU consumption: the bucket may go negative —
         the NEXT statement in the group then waits it out."""
-        self._rg_inject()
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("resgroup/debit")
         g = self.groups.get(name.lower())
         if g is None:  # group dropped mid-statement: nothing to bill
             return 0.0
