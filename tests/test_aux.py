@@ -192,7 +192,7 @@ class TestNativeLoader:
             ).rows
             assert rows[0][0] is None and rows[0][1] == 2000.0 and rows[0][2] is None
             assert rows[0][4] == 99999999.99
-            assert rows[1] == (1, 1.5, "abc", 8766, 12.35, True)  # .345 rounds to .35
+            assert rows[1] == (1, 1.5, "abc", "1994-01-01", 12.35, True)  # .345 rounds to .35
             assert rows[2][0] == -2 and rows[2][1] is None and rows[2][2] == "x y"
             assert rows[2][4] == -0.5
         finally:
