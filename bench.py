@@ -402,6 +402,30 @@ def _code_version() -> str:
         return "unknown"
 
 
+#: working-tree dirt that does NOT change engine code: the capture
+#: loop's own artifacts and the driver's bookkeeping
+_BENIGN_DIRT = (
+    "BENCH_TPU_CACHE.json", "PROGRESS.jsonl", "PALLAS_TPU.json",
+)
+
+
+def _dirty_paths():
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, timeout=10, cwd=os.path.dirname(
+                os.path.abspath(__file__)
+            ),
+        )
+        return sorted(
+            line[3:].strip()
+            for line in proc.stdout.decode().splitlines()
+            if line.strip()
+        )
+    except Exception:
+        return None  # unknown: treated as NOT benign
+
+
 def _cache_key(args) -> str:
     return f"{args.query}_sf{args.sf:g}"
 
@@ -449,6 +473,7 @@ def _store_tpu_cache(args, result) -> None:
         d = entry.setdefault("detail", {})
         d["captured_unix"] = int(time.time())
         d["captured_at_version"] = _code_version()
+        d["captured_dirty_paths"] = _dirty_paths()
         cache[_cache_key(args)] = entry
         with open(_TPU_CACHE, "w") as f:
             json.dump(cache, f, indent=1)
@@ -483,14 +508,27 @@ def _cached_tpu_result(args, attempts, exact_only: bool = False):
     cached = _load_tpu_cache(args, exact_only=exact_only)
     if cached is None:
         return None
+    cur_v = _code_version()
     if exact_only:
-        cap_v = cached.get("detail", {}).get("captured_at_version")
-        if cap_v != _code_version():
+        det = cached.get("detail", {})
+        cap_v = det.get("captured_at_version")
+        # same COMMIT qualifies even when the dirty flags differ — but
+        # ONLY when the capture-time dirt was the capture loop's own
+        # artifacts (recorded at store time and checked against the
+        # allowlist): a capture taken with modified engine code must
+        # never be the headline for the committed code.
+        if cap_v is None or cap_v.split("+")[0] != cur_v.split("+")[0]:
             return None
+        if "+dirty" in cap_v and cap_v != cur_v:
+            dirt = det.get("captured_dirty_paths")
+            if dirt is None or any(
+                p not in _BENIGN_DIRT for p in dirt
+            ):
+                return None
     result = dict(cached)
     d = dict(result.get("detail", {}))
     d["cached_tpu_result"] = True
-    d["current_version"] = _code_version()
+    d["current_version"] = cur_v
     d["version_match"] = d.get("captured_at_version") == d["current_version"]
     d["tunnel_attempts_now"] = attempts
     result["detail"] = d
