@@ -1,12 +1,20 @@
 """Profile any ladder query: compile vs steady-state split + EXPLAIN.
 
-Usage: python scripts/profile_query.py q18 1.0 [--explain]
+Usage: python scripts/profile_query.py {q1|q5|q6|q18|q95} [sf] [--explain] [--tpu]
 """
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--tpu" not in sys.argv:
+    # the sitecustomize-registered tunnel plugin hangs backend init when
+    # the tunnel is down — deregister it before any jax op (bench.py's
+    # child-process trick)
+    from tidb_tpu.utils.backend import force_cpu
+
+    force_cpu()
 
 import jax
 
@@ -18,20 +26,27 @@ from tidb_tpu.storage import Catalog
 
 
 def main():
-    q = sys.argv[1] if len(sys.argv) > 1 else "q18"
-    sf = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+    pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+    q = pos[0] if pos else "q18"
+    sf = float(pos[1]) if len(pos) > 1 else 1.0
     print("backend:", jax.default_backend(), flush=True)
     cat = Catalog()
     t0 = time.perf_counter()
-    load_tpch(cat, sf=sf, tables=B._TABLES[q], seed=1)
+    if q == "q95":
+        from tidb_tpu.bench.tpcds import Q95_SQL, load_tpcds
+
+        load_tpcds(cat, sf=sf, seed=1)
+        tables, sql, db = [], Q95_SQL, "test"
+    else:
+        tables, sql, db = B._TABLES[q], B.QUERIES[q], "tpch"
+        load_tpch(cat, sf=sf, tables=tables, seed=1)
     print(f"datagen: {time.perf_counter()-t0:.2f}s", flush=True)
-    sess = Session(cat, db="tpch")
+    sess = Session(cat, db=db)
     sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
     t0 = time.perf_counter()
-    for t in B._TABLES[q]:
+    for t in tables:
         sess.execute(f"analyze table {t}")
     print(f"analyze: {time.perf_counter()-t0:.2f}s", flush=True)
-    sql = B.QUERIES[q]
     if "--explain" in sys.argv:
         for row in sess.execute("explain " + sql).rows:
             print("  ", row[0], flush=True)

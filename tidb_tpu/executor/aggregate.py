@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from tidb_tpu.chunk import Batch, DevCol, pad_capacity
+from tidb_tpu.utils.backend import is_tpu as _is_tpu
 
 ExprFn = Callable[[Batch], DevCol]
 
@@ -308,7 +309,7 @@ def _prefix_sum(mask):
 
         if pallas_enabled():
             interp = os.environ.get("TIDB_TPU_PALLAS_INTERPRET") == "1"
-            if interp or jax.default_backend() == "tpu":
+            if interp or _is_tpu():
                 return prefix_sum_i32(mask, interpret=interp)
     except Exception:
         pass
@@ -584,10 +585,7 @@ def group_aggregate(
 
     use_sorted = keys and (
         _os.environ.get("TIDB_TPU_SORT_AGG") == "1"
-        or (
-            jax.default_backend() == "tpu"
-            and _os.environ.get("TIDB_TPU_SORT_AGG") != "0"
-        )
+        or (_is_tpu() and _os.environ.get("TIDB_TPU_SORT_AGG") != "0")
     )
     dense_ok = (
         widths_ok
@@ -760,7 +758,7 @@ def _pick_backend(seg, slots):
     import os
 
     forced = os.environ.get("TIDB_TPU_FORCE_MASKED") == "1"
-    if slots <= 128 and (forced or jax.default_backend() == "tpu"):
+    if slots <= 128 and (forced or _is_tpu()):
         return _masked_backend(seg, slots)
     return None
 
@@ -896,7 +894,7 @@ def _try_pallas_slot_sums(aggs, arg_cols, seg, slots, srow_valid, reps):
         # escape hatch. A lowering failure inside the steady jitted plan
         # would be uncatchable, so gate by backend up front.
         interp = os.environ.get("TIDB_TPU_PALLAS_INTERPRET") == "1"
-        if not interp and jax.default_backend() != "tpu":
+        if not interp and not _is_tpu():
             return None
     except Exception:
         return None

@@ -58,7 +58,9 @@ def _use_merge_probe(m: int) -> bool:
 
     if os.environ.get("TIDB_TPU_SORT_AGG") == "1":
         return True
-    return m >= 4096 and _jax.default_backend() == "tpu"
+    from tidb_tpu.utils.backend import is_tpu
+
+    return m >= 4096 and is_tpu()
 
 
 def _probe_lo_hi(skey, pkey, need_hi: bool):

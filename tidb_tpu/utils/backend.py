@@ -18,8 +18,38 @@ import subprocess
 import sys
 
 
+_IS_TPU: bool | None = None
+
+
+def is_tpu() -> bool:
+    """True when the default JAX backend is a TPU device.
+
+    `jax.default_backend()` returns the PJRT *plugin's* platform name —
+    'axon' for this environment's TPU tunnel — so string-comparing it to
+    "tpu" silently disables every TPU-only engine path on the real
+    hardware (round-5 captures: Q18 SF10 ran the serial dense scatter
+    for 9.27s with the sorted path sitting behind exactly this check).
+    `Device.platform` normalizes to "tpu" and is the check proven to
+    work through the tunnel (streamed._device_budget's HBM fallback).
+    Cached: the backend never changes after first use inside a process;
+    force_cpu() resets the cache for interpreters that flip early."""
+    global _IS_TPU
+    if _IS_TPU is None:
+        try:
+            import jax
+
+            _IS_TPU = jax.default_backend() == "tpu" or any(
+                d.platform == "tpu" for d in jax.local_devices()
+            )
+        except Exception:
+            return False  # don't cache a failed probe
+    return _IS_TPU
+
+
 def force_cpu() -> None:
     """Make this interpreter CPU-only regardless of registered plugins."""
+    global _IS_TPU
+    _IS_TPU = False
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     try:
