@@ -1,0 +1,129 @@
+"""Unique-side join narrowing at prune time.
+
+Reference: pkg/planner/core/rule_join_elimination.go (outer-join
+elimination when the inner side is unique on the join key and unused)
+and the semi-join side of rule_semi_join_rewrite.go. The columnar
+analog (logical._try_join_narrow): an inner join whose unique side
+contributes nothing beyond its equi-key columns becomes a SEMI join
+(one existence pass instead of a row table + gathers), with parent
+references to the dropped key columns substituted by the kept side's
+equal keys; a left join in the same shape disappears entirely.
+
+The physical half (physical.py fn_semi_lookup + join.lookup_build_rows):
+multi-key semi/anti with a provably-unique build pair run as a
+probe-aligned 1:1 lookup verifying the demoted equalities — not the
+expand + row-id re-join fallback.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create database jn")
+    s.execute("use jn")
+    s.execute("create table dim (pk int primary key, grp int, pad int)")
+    s.execute(
+        "insert into dim values (1, 10, 0), (2, 20, 0), (3, 30, 0), "
+        "(5, 50, 0)"
+    )
+    s.execute("create table fact (k int, v int)")
+    s.execute(
+        "insert into fact values (1, 100), (1, 101), (2, 200), (4, 400), "
+        "(NULL, 999)"
+    )
+    return s
+
+
+def _plan(sess, sql):
+    return "\n".join(r[0] for r in sess.execute("explain " + sql).rows)
+
+
+class TestInnerToSemi:
+    def test_filter_only_join_becomes_semi(self, sess):
+        sql = "select sum(v) from fact join dim on fact.k = dim.pk"
+        assert "kind=semi" in _plan(sess, sql)
+        assert sess.execute(sql).rows == [(401,)]
+
+    def test_dropped_key_substituted(self, sess):
+        # parent consumes dim.pk — equal to fact.k on surviving rows
+        sql = (
+            "select dim.pk, sum(v) from fact join dim on fact.k = dim.pk "
+            "group by dim.pk order by dim.pk"
+        )
+        assert "kind=semi" in _plan(sess, sql)
+        assert sess.execute(sql).rows == [(1, 201), (2, 200)]
+
+    def test_used_column_blocks_rewrite(self, sess):
+        sql = (
+            "select dim.grp, sum(v) from fact join dim on fact.k = dim.pk "
+            "group by dim.grp order by dim.grp"
+        )
+        assert "kind=semi" not in _plan(sess, sql)
+        assert sess.execute(sql).rows == [(10, 201), (20, 200)]
+
+    def test_non_unique_side_blocks_rewrite(self, sess):
+        # joining fact to itself on the non-unique key must keep the
+        # duplicating inner join ((1,100) matches two fact rows)
+        sql = (
+            "select sum(a.v) from fact a join fact b on a.k = b.k"
+        )
+        assert "kind=semi" not in _plan(sess, sql)
+        # k=1 pairs: (100+101) emitted twice = 402; plus 200 + 400
+        assert sess.execute(sql).rows == [(1002,)]
+
+
+class TestLeftJoinElimination:
+    def test_unused_unique_inner_side_disappears(self, sess):
+        sql = "select sum(v) from fact left join dim on fact.k = dim.pk"
+        assert "JoinPlan" not in _plan(sess, sql)
+        assert sess.execute(sql).rows == [(1800,)]
+
+    def test_consumed_inner_side_keeps_join(self, sess):
+        sql = (
+            "select fact.k, dim.pk from fact left join dim "
+            "on fact.k = dim.pk order by fact.k, dim.pk"
+        )
+        assert "kind=left" in _plan(sess, sql)
+        rows = sess.execute(sql).rows
+        assert rows == [
+            (None, None), (1, 1), (1, 1), (2, 2), (4, None)
+        ]
+
+
+class TestMultiKeySemiLookup:
+    def test_demoted_pair_verified(self, sess):
+        # dim unique on pk; (pk, grp) pair: grp equality demoted to
+        # the verify mask in the lookup path. dim yields (1,100),
+        # (2,200), (3,300), (5,500).
+        sql = (
+            "select fact.k, fact.v from fact "
+            "where (fact.k, fact.v) in (select pk, grp * 10 from dim) "
+            "order by fact.k"
+        )
+        assert sess.execute(sql).rows == [(1, 100), (2, 200)]
+
+    def test_anti_multi_key(self, sess):
+        sql = (
+            "select fact.k, fact.v from fact "
+            "where not exists (select 1 from dim "
+            "where dim.pk = fact.k and dim.grp * 10 = fact.v) "
+            "order by fact.v"
+        )
+        assert sess.execute(sql).rows == [
+            (1, 101), (4, 400), (None, 999)
+        ]
+
+    def test_correlated_exists_residual(self, sess):
+        # single-key EXISTS with an extra non-equi condition: the
+        # residual evaluates on the looked-up unique build row
+        sql = (
+            "select fact.k, fact.v from fact "
+            "where exists (select 1 from dim "
+            "where dim.pk = fact.k and dim.grp < fact.v) "
+            "order by fact.v"
+        )
+        assert sess.execute(sql).rows == [(1, 100), (1, 101), (2, 200)]

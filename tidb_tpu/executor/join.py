@@ -117,6 +117,73 @@ def _dense_build(bkey, bvalid, lo: int, hi: int, span: int):
     return boff, bin_, stale
 
 
+def _dense_unique_lookup(bkey, bvalid, lo: int, hi: int, span: int,
+                         bcap: int, pkey, pvalid):
+    """Dense direct-index lookup into a planner-proven-unique build key:
+    (brow, matched, stale) probe-aligned; stale on outgrown bounds or a
+    uniqueness violation (cnt > 1). Shared by equi_join's inner/left
+    unique path and lookup_build_rows."""
+    boff, _bin, stale = _dense_build(bkey, bvalid, lo, hi, span)
+    rows = jnp.arange(bcap, dtype=jnp.int32)
+    rowtab = (
+        jnp.full(span, -1, dtype=jnp.int32).at[boff].max(rows, mode="drop")
+    )
+    cnt = (
+        jnp.zeros(span, dtype=jnp.int32)
+        .at[boff]
+        .add(jnp.int32(1), mode="drop")
+    )
+    stale = stale | jnp.any(cnt > 1)
+    pin = pvalid & (pkey >= lo) & (pkey <= hi)
+    poff = jnp.clip(pkey - lo, 0, span - 1)
+    brow_ = rowtab[jnp.where(pin, poff, 0)]
+    matched = pin & (brow_ >= 0)
+    return jnp.clip(brow_, 0, bcap - 1), matched, stale
+
+
+def lookup_build_rows(
+    build: Batch,
+    probe: Batch,
+    build_key: ExprFn,
+    probe_key: ExprFn,
+    build_bounds: Optional[Tuple[int, int]] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Probe-aligned row lookup into a build side the planner proved
+    UNIQUE on the key: returns (brow, matched, stale) where brow[i] is
+    the build row matching probe row i (clipped junk where unmatched),
+    matched is the probe-aligned hit mask, and stale flags a broken
+    compile-time assumption (bounds outgrown / uniqueness violated) for
+    the WIDTH_STALE recompile contract. One table build + one probe
+    pass — no expansion, no cumsum; the primitive behind multi-key
+    semi/anti joins with a unique pair (planner demotes the remaining
+    equalities to a verify mask over the gathered build columns)."""
+    bkey, bvalid = _keys_of(build, build_key)
+    pkey, pvalid = _keys_of(probe, probe_key)
+    bcap = build.capacity
+    span = _dense_span(build_bounds, bcap, probe.capacity)
+    if span is not None:
+        lo, hi = build_bounds
+        brow, matched, stale = _dense_unique_lookup(
+            bkey, bvalid, lo, hi, span, bcap, pkey, pvalid
+        )
+        return brow, matched, stale
+    sort_out = jax.lax.sort(
+        [~bvalid, bkey, jnp.arange(bcap, dtype=jnp.int32)], num_keys=2
+    )
+    svalid = ~sort_out[0]
+    skey = jnp.where(svalid, sort_out[1], jnp.iinfo(jnp.int64).max)
+    sperm = sort_out[2]
+    lo, hi = _probe_lo_hi(skey, pkey, need_hi=True)
+    lo_c = jnp.clip(lo, 0, bcap - 1)
+    matched = pvalid & (hi > lo)
+    # planner-asserted uniqueness broken: adjacent equal VALID build
+    # keys. (Probe-derived hi-lo>1 would also fire on garbage probe
+    # lanes equal to the invalid-row int64-max sentinel run — a
+    # spurious stale is a recompile livelock.)
+    stale = jnp.any(svalid[1:] & (sort_out[1][1:] == sort_out[1][:-1]))
+    return sperm[lo_c], matched, stale
+
+
 def equi_join(
     build: Batch,
     probe: Batch,
@@ -187,22 +254,9 @@ def equi_join(
 
     if join_type in ("inner", "left") and span is not None and build_unique:
         lo, hi = build_bounds
-        boff, bin_, stale = _dense_build(bkey, bvalid, lo, hi, span)
-        rows = jnp.arange(bcap, dtype=jnp.int32)
-        rowtab = (
-            jnp.full(span, -1, dtype=jnp.int32).at[boff].max(rows, mode="drop")
+        brow, matched, stale = _dense_unique_lookup(
+            bkey, bvalid, lo, hi, span, bcap, pkey, pvalid
         )
-        cnt = (
-            jnp.zeros(span, dtype=jnp.int32)
-            .at[boff]
-            .add(jnp.int32(1), mode="drop")
-        )
-        stale = stale | jnp.any(cnt > 1)  # planner-asserted uniqueness broken
-        pin = pvalid & (pkey >= lo) & (pkey <= hi)
-        poff = jnp.clip(pkey - lo, 0, span - 1)
-        brow_ = rowtab[jnp.where(pin, poff, 0)]
-        matched = pin & (brow_ >= 0)
-        brow = jnp.clip(brow_, 0, bcap - 1)
         # 1:1 with the probe side: the output IS the probe batch (same
         # capacity, row_valid refined) plus gathered build columns — no
         # expansion pass. When capacity discovery has shrunk the output

@@ -1822,7 +1822,7 @@ def build_select(
     plan = push_aggs_through_joins(plan, catalog)
     plan = sink_selections(plan)
     # column pruning over the finished tree (reference columnPruner)
-    plan = prune_plan(plan, {c.internal for c in plan.schema.cols})
+    plan = prune_plan(plan, {c.internal for c in plan.schema.cols}, catalog)
     return plan
 
 
@@ -2059,10 +2059,107 @@ def sink_selections(plan: LogicalPlan) -> LogicalPlan:
     return plan
 
 
-def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
+_SUBST_KINDS = {Kind.INT, Kind.BOOL, Kind.DATE, Kind.DATETIME, Kind.TIME}
+
+
+def _try_join_narrow(plan, required, catalog):
+    """Inner-join demotion / outer-join elimination at prune time
+    (reference rule_join_elimination.go + the semi-join side of
+    rule_semi_join_rewrite.go, applied in reverse): when one join side
+    is provably unique on its equi-key tuple and the parent consumes
+    NOTHING from it beyond those key columns, the join exists only to
+    filter (inner) or for nothing at all (left outer):
+
+      inner -> semi: the kept side's rows that match survive exactly
+        once either way; parent references to the dropped side's key
+        columns are satisfied by the kept side's key exprs (equal by
+        the join predicate — restricted to exact-equality kinds so the
+        substituted VALUE is identical, not merely comparing equal).
+      left -> eliminated entirely when the parent consumes nothing from
+        the inner side: every probe row survives exactly once.
+
+    Returns a replacement plan (not yet pruned) or None. The payoff is
+    architectural, not just planner cosmetics: a semi join compiles to
+    one existence scatter + mask where inner-unique builds a row table
+    and gathers the build key at every probe position (Q18's post-
+    agg-pushdown join; Q5's region hop)."""
+    if (
+        plan.residual is not None
+        or plan.null_aware
+        or plan.mark_name is not None
+        or not plan.equi_keys
+        or catalog is None
+        or not all(
+            isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
+            for l, r in plan.equi_keys
+        )
+    ):
+        return None
+    lcols = {c.internal for c in plan.left.schema.cols}
+    rcols = {c.internal for c in plan.right.schema.cols}
+    sides = (
+        ("right", "left") if plan.kind == "inner"
+        else ("right",) if plan.kind == "left"
+        else ()
+    )
+    for drop_side in sides:
+        drop, keep = (
+            (plan.right, plan.left) if drop_side == "right"
+            else (plan.left, plan.right)
+        )
+        drop_names = rcols if drop_side == "right" else lcols
+        pairs = [
+            ((r, l) if drop_side == "right" else (l, r))
+            for l, r in plan.equi_keys
+        ]  # (dropped key, kept key)
+        dkey_names = {d.name for d, _k in pairs}
+        needed = {n for n in required if n in drop_names}
+        if not needed <= dkey_names:
+            continue
+        if plan.kind == "left" and needed:
+            continue  # NULL-extended rows would expose the substitution
+        if needed and not all(
+            d.type.kind == k.type.kind and d.type.kind in _SUBST_KINDS
+            for d, k in pairs
+        ):
+            continue
+        if not _key_unique_on(drop, [d.name for d, _k in pairs], catalog):
+            continue
+        if plan.kind == "left":
+            return keep  # == plan.left
+        if drop_side == "right":
+            semi = JoinPlan(
+                plan.left.schema, "semi", plan.left, plan.right,
+                list(plan.equi_keys),
+                broadcast="right" if plan.broadcast == "right" else None,
+            )
+        else:
+            semi = JoinPlan(
+                plan.right.schema, "semi", plan.right, plan.left,
+                [(r, l) for l, r in plan.equi_keys],
+                broadcast="right" if plan.broadcast == "left" else None,
+            )
+        if not needed:
+            return semi
+        alias = [
+            (d.name, ColumnRef(type=k.type, name=k.name))
+            for d, k in pairs
+            if d.name in needed
+        ]
+        sch = Schema(
+            list(semi.schema.cols)
+            + [OutCol(None, n, n, e.type) for n, e in alias]
+        )
+        return Projection(sch, semi, alias, additive=True)
+    return None
+
+
+def prune_plan(plan: LogicalPlan, required: set, catalog=None) -> LogicalPlan:
     """Column pruning (reference rule columnPruner, optimizer.go:98):
     walk top-down with the set of internal names the parent needs; scans
-    read only referenced columns."""
+    read only referenced columns. With a catalog, unique-side joins the
+    parent doesn't otherwise consume narrow to semi joins or disappear
+    (_try_join_narrow)."""
     from tidb_tpu.expression.expr import walk_columns
 
     if isinstance(plan, Scan):
@@ -2073,7 +2170,7 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         return Scan(Schema(cols), plan.db, plan.table, plan.alias, keep)
     if isinstance(plan, Selection):
         need = set(required) | walk_columns(plan.predicate)
-        child = prune_plan(plan.child, need)
+        child = prune_plan(plan.child, need, catalog)
         return Selection(child.schema, child, plan.predicate)
     if isinstance(plan, Projection):
         exprs = [(n, e) for n, e in plan.exprs if n in required] or plan.exprs[:1]
@@ -2083,7 +2180,7 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         if plan.additive:
             produced = {n for n, _ in plan.exprs}
             need |= {r for r in required if r not in produced}
-        child = prune_plan(plan.child, need)
+        child = prune_plan(plan.child, need, catalog)
         sch = Schema([c for c in plan.schema.cols if c.internal in required or c.internal in {n for n, _ in exprs}])
         return Projection(sch, child, exprs, plan.additive)
     if isinstance(plan, Aggregate):
@@ -2096,9 +2193,12 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         for _sep, obs in (plan.gc_meta or {}).values():
             for e, _desc in obs:
                 need |= walk_columns(e)
-        child = prune_plan(plan.child, need)
+        child = prune_plan(plan.child, need, catalog)
         return dataclasses.replace(plan, child=child)
     if isinstance(plan, JoinPlan):
+        narrowed = _try_join_narrow(plan, required, catalog)
+        if narrowed is not None:
+            return prune_plan(narrowed, required, catalog)
         lcols = {c.internal for c in plan.left.schema.cols}
         rcols = {c.internal for c in plan.right.schema.cols}
         lneed = {r for r in required if r in lcols}
@@ -2110,8 +2210,8 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
             res_cols = walk_columns(plan.residual)
             lneed |= res_cols & lcols
             rneed |= res_cols & rcols
-        left = prune_plan(plan.left, lneed)
-        right = prune_plan(plan.right, rneed)
+        left = prune_plan(plan.left, lneed, catalog)
+        right = prune_plan(plan.right, rneed, catalog)
         if plan.kind in ("semi", "anti"):
             sch = left.schema
         elif plan.kind == "mark":
@@ -2129,7 +2229,7 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         need = set(required)
         for e, _d in plan.keys:
             need |= walk_columns(e)
-        child = prune_plan(plan.child, need)
+        child = prune_plan(plan.child, need, catalog)
         return Sort(child.schema, child, plan.keys)
     if isinstance(plan, Window):
         need = {r for r in required if not r.startswith("_w")}
@@ -2140,17 +2240,17 @@ def prune_plan(plan: LogicalPlan, required: set) -> LogicalPlan:
         for _n, _f, a, _o, _r, _fr in plan.descs:
             if a is not None:
                 need |= walk_columns(a)
-        child = prune_plan(plan.child, need)
+        child = prune_plan(plan.child, need, catalog)
         return Window(
             plan.schema, child, plan.partition_exprs, plan.order_exprs, plan.descs
         )
     if isinstance(plan, Limit):
-        child = prune_plan(plan.child, required)
+        child = prune_plan(plan.child, required, catalog)
         return Limit(child.schema, child, plan.count, plan.offset)
     if isinstance(plan, UnionAll):
         # children always produce the full _u column set (positional union)
         all_u = {c.internal for c in plan.schema.cols}
-        children = [prune_plan(c, all_u) for c in plan.children]
+        children = [prune_plan(c, all_u, catalog) for c in plan.children]
         return UnionAll(plan.schema, children)
     return plan
 
