@@ -125,3 +125,215 @@ def test_update_keeps_rows_visible(env):
         "and d < date '2024-01-01'"
     )
     assert s.execute(q).rows == [(61,)]
+
+
+class TestPartitionManagementDDL:
+    """ALTER TABLE ... ADD/DROP/TRUNCATE PARTITION (reference:
+    pkg/ddl/partition.go onAddTablePartition / onDropTablePartition /
+    onTruncateTablePartition — RANGE only, as in the reference)."""
+
+    @pytest.fixture()
+    def env2(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table m (id int, d int) partition by range (d) ("
+            "partition p0 values less than (10), "
+            "partition p1 values less than (20), "
+            "partition p2 values less than (30))"
+        )
+        s.execute(
+            "insert into m values (1, 5), (2, 15), (3, 25), (4, 16)"
+        )
+        return cat, s
+
+    def test_add_partition_extends_range(self, env2):
+        cat, s = env2
+        s.execute(
+            "alter table m add partition ("
+            "partition p3 values less than (40), "
+            "partition pmax values less than maxvalue)"
+        )
+        s.execute("insert into m values (5, 35), (6, 99)")
+        assert s.execute("select count(*) from m").rows == [(6,)]
+        assert s.execute(
+            "select id from m where d >= 30 order by id"
+        ).rows == [(5,), (6,)]
+        assert "partitions=[p3]" in explain_text(
+            s, "select id from m where d between 30 and 39"
+        )
+
+    def test_add_partition_validation(self, env2):
+        cat, s = env2
+        with pytest.raises(Exception, match="increasing"):
+            s.execute(
+                "alter table m add partition "
+                "(partition bad values less than (25))"
+            )
+        with pytest.raises(Exception, match="duplicate"):
+            s.execute(
+                "alter table m add partition "
+                "(partition p1 values less than (40))"
+            )
+        s.execute(
+            "alter table m add partition "
+            "(partition pmax values less than maxvalue)"
+        )
+        with pytest.raises(Exception, match="MAXVALUE"):
+            s.execute(
+                "alter table m add partition "
+                "(partition p9 values less than (99))"
+            )
+
+    def test_drop_partition_removes_rows_and_remaps(self, env2):
+        cat, s = env2
+        s.execute("alter table m drop partition p1")
+        assert s.execute("select id from m order by id").rows == [
+            (1,), (3,)
+        ]
+        # remaining partitions keep working: routing and pruning
+        s.execute("insert into m values (7, 8), (8, 27)")
+        assert s.execute(
+            "select id from m where d >= 20 order by id"
+        ).rows == [(3,), (8,)]
+        assert "partitions=[p2]" in explain_text(
+            s, "select id from m where d >= 20"
+        )
+        t = cat.table("test", "m")
+        assert t.partition_names() == ["p0", "p2"]
+        # part ids remapped: p2 blocks now tagged 1
+        assert {b.part_id for b in t.blocks()} == {0, 1}
+        with pytest.raises(Exception, match="unknown partition"):
+            s.execute("alter table m drop partition nope")
+
+    def test_drop_all_partitions_rejected(self, env2):
+        cat, s = env2
+        with pytest.raises(Exception, match="all partitions"):
+            s.execute("alter table m drop partition p0, p1, p2")
+
+    def test_truncate_partition_keeps_definition(self, env2):
+        cat, s = env2
+        s.execute("alter table m truncate partition p1")
+        assert s.execute("select id from m order by id").rows == [
+            (1,), (3,)
+        ]
+        t = cat.table("test", "m")
+        assert t.partition_names() == ["p0", "p1", "p2"]
+        # the emptied partition still accepts rows
+        s.execute("insert into m values (9, 12)")
+        assert s.execute(
+            "select id from m where d between 10 and 19"
+        ).rows == [(9,)]
+
+    def test_hash_table_rejected(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table h (id int) partition by hash (id) partitions 4"
+        )
+        with pytest.raises(Exception, match="RANGE"):
+            s.execute(
+                "alter table h add partition "
+                "(partition p9 values less than (10))"
+            )
+        with pytest.raises(Exception, match="RANGE"):
+            s.execute("alter table h drop partition p0")
+
+    def test_show_create_reflects_changes(self, env2):
+        cat, s = env2
+        s.execute("alter table m drop partition p0")
+        s.execute(
+            "alter table m add partition "
+            "(partition p3 values less than (40))"
+        )
+        ddl = s.execute("show create table m").rows[0][1]
+        assert "p0" not in ddl
+        assert "p3" in ddl
+
+    def test_update_then_drop_partition_no_ghost_rows(self, env2):
+        # UPDATE/DELETE rebuild blocks; part_id must survive the rebuild
+        # or dropped partitions leave ghost rows behind
+        cat, s = env2
+        s.execute("update m set id = id + 10 where d = 16")
+        s.execute("delete from m where d = 5")
+        s.execute("alter table m drop partition p1")
+        assert s.execute("select id, d from m order by id").rows == [(3, 25)]
+        t = cat.table("test", "m")
+        assert all(b.part_id is not None for b in t.blocks())
+
+    def test_drop_partition_fk_restrict_and_cascade(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table parent (pk int primary key, d int) "
+            "partition by range (d) ("
+            "partition p0 values less than (10), "
+            "partition p1 values less than (20))"
+        )
+        s.execute("insert into parent values (1, 5), (2, 15)")
+        s.execute(
+            "create table child (id int, ref int, "
+            "foreign key (ref) references parent (pk))"
+        )
+        s.execute("insert into child values (100, 2)")
+        # RESTRICT: dropping the partition holding pk=2 must fail whole
+        with pytest.raises(Exception, match="[Ff]oreign|FOREIGN|restrict|child"):
+            s.execute("alter table parent drop partition p1")
+        assert s.execute("select count(*) from parent").rows == [(2,)]
+        t = cat.table("test", "parent")
+        assert t.partition_names() == ["p0", "p1"]  # defs restored
+        # CASCADE: child rows follow the dropped partition
+        s.execute("drop table child")
+        s.execute(
+            "create table child (id int, ref int, foreign key (ref) "
+            "references parent (pk) on delete cascade)"
+        )
+        s.execute("insert into child values (100, 2), (101, 1)")
+        s.execute("alter table parent drop partition p1")
+        assert s.execute("select id from child order by id").rows == [(101,)]
+
+    def test_pinned_snapshot_prunes_with_old_defs(self, env2):
+        cat, s = env2
+        s2 = Session(cat, db="test")
+        s2.execute("begin")
+        assert s2.execute(
+            "select id from m where d >= 20 order by id"
+        ).rows == [(3,)]  # pins the pre-DDL version
+        s.execute("alter table m drop partition p0")
+        # the pinned txn keeps seeing the old defs AND old rows
+        assert s2.execute(
+            "select id from m where d < 10 order by id"
+        ).rows == [(1,)]
+        assert s2.execute(
+            "select id from m where d >= 20 order by id"
+        ).rows == [(3,)]
+        s2.execute("commit")
+        # after commit the new defs apply: p0 rows are gone
+        assert s2.execute("select id from m order by id").rows == [
+            (2,), (3,), (4,)
+        ]
+
+    def test_partition_ddl_rejected_inside_txn(self, env2):
+        cat, s = env2
+        s.execute("begin")
+        with pytest.raises(Exception, match="transaction"):
+            s.execute("alter table m drop partition p1")
+        s.execute("rollback")
+        assert cat.table("test", "m").partition_names() == [
+            "p0", "p1", "p2"
+        ]
+
+    def test_explain_prunes_with_pinned_defs(self, env2):
+        cat, s = env2
+        s2 = Session(cat, db="test")
+        s2.execute("begin")
+        s2.execute("select count(*) from m")  # pin pre-DDL version
+        s.execute("alter table m drop partition p0")
+        # the pinned txn's EXPLAIN shows the defs execution will use
+        assert "partitions=[p0]" in explain_text(
+            s2, "select id from m where d < 10"
+        )
+        s2.execute("commit")
+        assert "partitions=[p0]" not in explain_text(
+            s2, "select id from m where d < 10"
+        )

@@ -320,11 +320,14 @@ class AlterTable:
     db: Optional[str]
     name: str
     # 'add' | 'drop' | 'modify' | 'change' | 'rename_col' | 'rename'
+    # | 'add_partition' | 'drop_partition' | 'truncate_partition'
     action: str
     column: Optional[ColumnDef] = None  # for add / modify / change
     col_name: Optional[str] = None  # for drop / change (old) / rename_col
     default: Optional[object] = None  # ADD COLUMN ... DEFAULT <const>
     new_name: Optional[str] = None  # rename_col / rename target
+    # add_partition: [(name, upper expr | None)]; drop/truncate: [name]
+    partitions: Optional[list] = None
 
 
 @dataclasses.dataclass

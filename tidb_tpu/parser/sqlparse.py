@@ -2198,28 +2198,7 @@ class Parser:
                 pcol = self.expect_ident().lower()
                 self.expect_op(")")
                 self.expect_op("(")
-                parts = []
-                while True:
-                    self.expect_kw("partition")
-                    pname = self.expect_ident().lower()
-                    self.expect_kw("values")
-                    if not (self.cur.kind == "id" and self.cur.text.lower() == "less"):
-                        raise ParseError("expected VALUES LESS THAN")
-                    self.advance()
-                    if not (self.cur.kind == "id" and self.cur.text.lower() == "than"):
-                        raise ParseError("expected THAN")
-                    self.advance()
-                    if self.cur.kind == "id" and self.cur.text.lower() == "maxvalue":
-                        self.advance()
-                        upper = None
-                    else:
-                        self.expect_op("(")
-                        ue = self.parse_expr()
-                        self.expect_op(")")
-                        upper = ue
-                    parts.append((pname, upper))
-                    if not self.accept_op(","):
-                        break
+                parts = self._parse_range_partition_items()
                 self.expect_op(")")
                 partition = ("range", pcol, parts)
             elif kindw == "hash":
@@ -2312,6 +2291,40 @@ class Parser:
                 break
         return seq
 
+    def _parse_range_partition_items(self):
+        """PARTITION p VALUES LESS THAN ((expr)|MAXVALUE)[, ...] —
+        shared by CREATE TABLE ... PARTITION BY RANGE and ALTER TABLE
+        ADD PARTITION."""
+        parts = []
+        while True:
+            self.expect_kw("partition")
+            pname = self.expect_ident().lower()
+            self.expect_kw("values")
+            if not (self.cur.kind == "id" and self.cur.text.lower() == "less"):
+                raise ParseError("expected VALUES LESS THAN")
+            self.advance()
+            if not (self.cur.kind == "id" and self.cur.text.lower() == "than"):
+                raise ParseError("expected THAN")
+            self.advance()
+            if self.cur.kind == "id" and self.cur.text.lower() == "maxvalue":
+                self.advance()
+                upper = None
+            else:
+                self.expect_op("(")
+                ue = self.parse_expr()
+                self.expect_op(")")
+                upper = ue
+            parts.append((pname, upper))
+            if not self.accept_op(","):
+                break
+        return parts
+
+    def _partition_name_list(self):
+        names = [self.expect_ident().lower()]
+        while self.accept_op(","):
+            names.append(self.expect_ident().lower())
+        return names
+
     def parse_alter(self):
         self.expect_kw("alter")
         if self._at_ident("resource"):
@@ -2325,12 +2338,31 @@ class Parser:
         self.expect_kw("table")
         db, name = self._qualified_name()
         if self.accept_kw("add"):
+            if self.accept_kw("partition"):
+                self.expect_op("(")
+                parts = self._parse_range_partition_items()
+                self.expect_op(")")
+                return ast.AlterTable(
+                    db, name, "add_partition", partitions=parts
+                )
             self.accept_kw("column")
             cd, default = self._alter_column_tail(self.expect_ident())
             return ast.AlterTable(db, name, "add", column=cd, default=default)
         if self.accept_kw("drop"):
+            if self.accept_kw("partition"):
+                return ast.AlterTable(
+                    db, name, "drop_partition",
+                    partitions=self._partition_name_list(),
+                )
             self.accept_kw("column")
             return ast.AlterTable(db, name, "drop", col_name=self.expect_ident())
+        if self._at_ident("truncate"):  # "truncate" lexes as an ident
+            self.advance()
+            self.expect_kw("partition")
+            return ast.AlterTable(
+                db, name, "truncate_partition",
+                partitions=self._partition_name_list(),
+            )
         if self._at_ident("modify"):
             self.advance()
             self.accept_kw("column")

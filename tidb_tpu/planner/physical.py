@@ -307,7 +307,12 @@ def _prune_partitions(pred, scan: "L.Scan", resolver):
         t, _v = resolver(scan.db, scan.table)
     except Exception:
         return None
-    part = getattr(t, "partition", None)
+    # defs at the SNAPSHOT version: a pinned reader must prune with the
+    # ladder its blocks were tagged under, not post-ALTER defs
+    try:
+        part = t.partition_defs_at(_v)
+    except AttributeError:
+        part = getattr(t, "partition", None)
     if part is None or pred is None:
         return None
     pcol = part[1]
@@ -317,7 +322,9 @@ def _prune_partitions(pred, scan: "L.Scan", resolver):
     _col, lo, hi = r
     if lo is not None and hi is not None and lo > hi:
         return ()
-    nparts = t.npartitions()
+    nparts = (
+        int(part[2]) if part[0] == "hash" else len(part[2])
+    )
     if part[0] == "hash":
         # hash pruning needs a small CLOSED range (point lookups mostly)
         n = int(part[2])

@@ -2164,6 +2164,64 @@ class Session(DDLMixin):
                 self.catalog.rename_table(
                     s.db or self.db, s.name, s.db or self.db, s.new_name
                 )
+            elif s.action == "add_partition":
+                # reference: pkg/ddl/partition.go onAddTablePartition —
+                # metadata-only for RANGE; bounds encode exactly like
+                # CREATE TABLE's (dates->days, decimals->scaled ints)
+                if t.partition is None or t.partition[0] != "range":
+                    raise ValueError(
+                        "ADD PARTITION requires a RANGE-partitioned table"
+                    )
+                enc = self._encode_partition(
+                    t.schema, ("range", t.partition[1], s.partitions)
+                )
+                t.alter_add_partitions(enc[2])
+            elif s.action in ("drop_partition", "truncate_partition"):
+                # rows vanish like a DELETE: children's ON DELETE
+                # referential actions apply against the post-statement
+                # parent values (the TRUNCATE TABLE pattern above);
+                # any nested RESTRICT restores every touched table.
+                # Rejected inside an explicit transaction: the FK value
+                # sets resolve through the session's pinned snapshot, so
+                # an in-txn check would validate against pre-drop values
+                # (MySQL/TiDB implicitly commit before DDL; erroring is
+                # the safe analog for this engine's snapshot txns)
+                if self._txn is not None:
+                    raise ValueError(
+                        "partition DDL is not allowed inside a "
+                        "transaction; COMMIT first"
+                    )
+                db = s.db or self.db
+
+                def _part_ddl(db=db, t=t):
+                    children = self._fk_children(db, s.name)
+                    undo = []
+                    self._fk_undo_snapshot(undo, t)
+                    saved_defs = t.partition
+                    removed = t.alter_drop_partitions(
+                        s.partitions,
+                        truncate_only=s.action == "truncate_partition",
+                    )
+                    try:
+                        if children and removed:
+                            ref_cols = {
+                                rcol
+                                for _cd, _ct, _nm, _c, rcol, _a in children
+                            }
+                            remaining = {
+                                rc: self._column_values(db, s.name, rc)
+                                for rc in ref_cols
+                            }
+                            self._enforce_parent_constraints(
+                                db, s.name, remaining, actions=True,
+                                undo=undo,
+                            )
+                    except BaseException:
+                        self._fk_undo_restore(undo)
+                        t.partition = saved_defs  # undo covers blocks only
+                        raise
+
+                self._with_write_locks([(db, s.name)], _part_ddl)
             else:
                 cn = s.col_name.lower()
                 from tidb_tpu.utils.checkeval import check_columns
@@ -4501,7 +4559,9 @@ class Session(DDLMixin):
                 valid[pos] = True
                 cols[c] = dataclasses.replace(src, data=data, valid=valid)
             consumed += hit
-            new_blocks.append(HostBlock(cols, block.nrows))
+            new_blocks.append(
+                HostBlock(cols, block.nrows, part_id=block.part_id)
+            )
         t.replace_blocks(new_blocks, modified_rows=affected)
         clear_scan_cache()
         return Result([], [], affected=affected)
@@ -4810,7 +4870,12 @@ class Session(DDLMixin):
 
         est_rows(plan, self.catalog)  # annotates .est per node
         lines = []
-        _render_plan(plan, 0, lines, catalog=self.catalog)
+        # prune display must resolve versions the way execution will
+        # (txn pins / stale reads), or EXPLAIN disagrees with the run
+        _render_plan(
+            plan, 0, lines, catalog=self.catalog,
+            resolver=self._resolve_table_for_read,
+        )
         return Result(["plan"], [(l,) for l in lines])
 
 
@@ -4833,7 +4898,7 @@ def _refs_table(node, name: str) -> bool:
     return False
 
 
-def _render_plan(plan, depth, out: List[str], catalog=None):
+def _render_plan(plan, depth, out: List[str], catalog=None, resolver=None):
     from tidb_tpu.planner import logical as L
 
     pad = "  " * depth
@@ -4875,15 +4940,21 @@ def _render_plan(plan, depth, out: List[str], catalog=None):
                     detail += f" access=IndexMerge(union: {spans})"
             from tidb_tpu.planner.physical import _prune_partitions
 
-            pp = _prune_partitions(
-                plan.predicate,
-                plan.child,
-                lambda db, tb: (catalog.table(db, tb), 0),
-            )
+            def _res(db, tb):
+                if resolver is not None:
+                    return resolver(db, tb)
+                t2 = catalog.table(db, tb)
+                return t2, t2.version
+
+            pp = _prune_partitions(plan.predicate, plan.child, _res)
             if pp is not None:
-                names = catalog.table(
-                    plan.child.db, plan.child.table
-                ).partition_names()
+                t2, v2 = _res(plan.child.db, plan.child.table)
+                defs2 = t2.partition_defs_at(v2)
+                names = (
+                    [f"p{i}" for i in range(int(defs2[2]))]
+                    if defs2[0] == "hash"
+                    else [n for n, _u in defs2[2]]
+                )
                 detail += (
                     " partitions="
                     + "[" + ",".join(names[i] for i in pp) + "]"
@@ -4907,6 +4978,6 @@ def _render_plan(plan, depth, out: List[str], catalog=None):
     for attr in ("child", "left", "right"):
         c = getattr(plan, attr, None)
         if c is not None:
-            _render_plan(c, depth + 1, out, catalog=catalog)
+            _render_plan(c, depth + 1, out, catalog=catalog, resolver=resolver)
     for c in getattr(plan, "children", []) or []:
-        _render_plan(c, depth + 1, out, catalog=catalog)
+        _render_plan(c, depth + 1, out, catalog=catalog, resolver=resolver)

@@ -174,8 +174,36 @@ class Table:
         # ("hash", col, nparts) or None. Appended blocks are SPLIT by
         # partition (each HostBlock carries part_id), so pruned scans
         # skip whole blocks — the region-pruning analog
-        # (partitionProcessor, pkg/planner/core/rule_partition_processor.go)
-        self.partition: Optional[tuple] = None
+        # (partitionProcessor, pkg/planner/core/rule_partition_processor.go).
+        # Defs are VERSIONED (the property setter records history) so a
+        # pinned snapshot prunes with the defs its blocks were tagged
+        # under, not the post-ALTER ones (partition_defs_at).
+        self._partition: Optional[tuple] = None
+        self._partition_history: List[Tuple[int, Optional[tuple]]] = []
+
+    @property
+    def partition(self) -> Optional[tuple]:
+        return self._partition
+
+    @partition.setter
+    def partition(self, defs: Optional[tuple]) -> None:
+        self._partition = defs
+        hist = self._partition_history
+        if not hist or hist[-1][1] != defs:
+            hist.append((self.version, defs))
+
+    def partition_defs_at(self, version: Optional[int]) -> Optional[tuple]:
+        """Partition defs effective at `version` (None = current)."""
+        hist = self._partition_history
+        if version is None or not hist:
+            return self._partition
+        defs = hist[0][1]
+        for v, p in hist:
+            if v <= version:
+                defs = p
+            else:
+                break
+        return defs
 
     # -- online DDL ----------------------------------------------------
     def index_state(self, name: str) -> str:
@@ -655,7 +683,9 @@ class Table:
                     n: HostColumn(c.type, c.data[idx], c.valid[idx], c.dictionary)
                     for n, c in block.columns.items()
                 }
-                new_blocks.append(HostBlock(cols, len(idx)))
+                new_blocks.append(
+                    HostBlock(cols, len(idx), part_id=block.part_id)
+                )
             self.version += 1
             self._versions[self.version] = [b for b in new_blocks if b.nrows > 0]
             self._gc_versions()
@@ -687,7 +717,9 @@ class Table:
                     for nm, cc in block.columns.items()
                 }
                 if len(idx):
-                    new_blocks.append(HostBlock(cols, len(idx)))
+                    new_blocks.append(
+                        HostBlock(cols, len(idx), part_id=block.part_id)
+                    )
             if removed:
                 self.modify_count += removed
                 self.version += 1
@@ -750,6 +782,96 @@ class Table:
             self._gc_versions()
             return self.version
 
+    # -- partition management (reference: pkg/ddl/partition.go
+    # onAddTablePartition / onDropTablePartition /
+    # onTruncateTablePartition; RANGE only, like the reference's
+    # DROP PARTITION). Columnar analog: partition defs are table
+    # metadata, rows live in per-partition tagged blocks, so ADD is
+    # metadata-only, DROP/TRUNCATE drop the tagged blocks in a new
+    # MVCC version (pinned snapshots keep reading theirs). -----------------
+    def alter_add_partitions(self, new_parts: List[Tuple[str, Optional[int]]]) -> int:
+        """Append RANGE partitions (encoded uppers, None = MAXVALUE)."""
+        with self._lock:
+            if self.partition is None or self.partition[0] != "range":
+                raise ValueError(
+                    "ADD PARTITION requires a RANGE-partitioned table"
+                )
+            _kind, pcol, parts = self.partition
+            parts = list(parts)
+            if parts and parts[-1][1] is None:
+                raise ValueError(
+                    "cannot ADD PARTITION after a MAXVALUE partition"
+                )
+            names = {n for n, _u in parts}
+            last = parts[-1][1] if parts else None
+            for i, (n, u) in enumerate(new_parts):
+                n = n.lower()
+                if n in names:
+                    raise ValueError(f"duplicate partition name {n!r}")
+                if u is None and i != len(new_parts) - 1:
+                    raise ValueError("MAXVALUE must be the last partition")
+                if u is not None and last is not None and u <= last:
+                    raise ValueError(
+                        "VALUES LESS THAN must be strictly increasing"
+                    )
+                parts.append((n, u))
+                names.add(n)
+                last = u if u is not None else last
+            self.version += 1
+            self._versions[self.version] = list(
+                self._versions[self.version - 1]
+            )
+            self.partition = ("range", pcol, parts)
+            self._gc_versions()
+            return self.version
+
+    def alter_drop_partitions(
+        self, names: Sequence[str], truncate_only: bool = False
+    ) -> int:
+        """DROP PARTITION (defs removed, later part ids shift down) or
+        TRUNCATE PARTITION (rows dropped, defs kept). Returns removed
+        row count."""
+        with self._lock:
+            if self.partition is None or self.partition[0] != "range":
+                raise ValueError(
+                    "DROP/TRUNCATE PARTITION requires a RANGE-partitioned "
+                    "table"
+                )
+            _kind, pcol, parts = self.partition
+            all_names = [n for n, _u in parts]
+            drop = set()
+            for n in names:
+                n = n.lower()
+                if n not in all_names:
+                    raise ValueError(f"unknown partition {n!r}")
+                drop.add(all_names.index(n))
+            if not truncate_only and len(drop) >= len(parts):
+                raise ValueError("cannot drop all partitions")
+            removed = 0
+            new_blocks = []
+            for b in self._versions[self.version]:
+                if b.part_id in drop:
+                    removed += b.nrows
+                    continue
+                if truncate_only or b.part_id is None:
+                    new_blocks.append(b)
+                    continue
+                shift = sum(1 for j in drop if j < b.part_id)
+                if shift:
+                    b = dataclasses.replace(b, part_id=b.part_id - shift)
+                new_blocks.append(b)
+            self.modify_count += removed
+            self.version += 1
+            self._versions[self.version] = new_blocks
+            if not truncate_only:
+                self.partition = (
+                    "range",
+                    pcol,
+                    [p for i, p in enumerate(parts) if i not in drop],
+                )
+            self._gc_versions()
+            return removed
+
     # -- schema evolution (reference: online schema change, the F1 state
     # machine at pkg/ddl/index.go:545; MVCC-lite makes it cheap here:
     # the new version's blocks carry the new column, pinned snapshots
@@ -767,7 +889,7 @@ class Table:
                 col = column_from_values([default] * b.nrows, typ)
                 cols = dict(b.columns)
                 cols[name] = col
-                new_blocks.append(HostBlock(cols, b.nrows))
+                new_blocks.append(HostBlock(cols, b.nrows, part_id=b.part_id))
             self.schema = new_schema
             if typ.kind == Kind.STRING:
                 d = new_blocks[0].columns[name].dictionary if new_blocks else None
@@ -1153,4 +1275,4 @@ class Table:
             data = new_remap[col.data] if new_remap is not None else col.data
             out_cols[name] = HostColumn(col.type, data.astype(np.int32), col.valid, merged)
             self.dictionaries[name] = merged
-        return HostBlock(out_cols, block.nrows)
+        return HostBlock(out_cols, block.nrows, part_id=block.part_id)
