@@ -94,8 +94,22 @@ def _keys_of(batch: Batch, key_fn: ExprFn) -> Tuple[jax.Array, jax.Array]:
 
 def _dense_span(build_bounds, bcap: int, pcap: int) -> Optional[int]:
     """Static dense-table span for a bounded build key, or None when the
-    domain is too large/sparse for direct indexing to pay off."""
+    domain is too large/sparse for direct indexing to pay off.
+
+    On TPU the dense table builds via scatter — XLA lowers large
+    scatters serially (~7M updates/s measured through the tunnel) while
+    lax.sort runs two orders of magnitude faster per key, so dense only
+    pays for small builds there; CPU keeps dense at every size (its
+    scatter matches np.bincount). TIDB_TPU_SORT_AGG=1 forces the sort
+    path for CPU test coverage of the TPU lowering."""
+    import os
+
+    from tidb_tpu.utils.backend import is_tpu
+
     if build_bounds is None:
+        return None
+    env = os.environ.get("TIDB_TPU_SORT_AGG")
+    if env == "1" or (is_tpu() and env != "0" and bcap > (1 << 16)):
         return None
     lo, hi = build_bounds
     span = int(hi) - int(lo) + 1
@@ -141,6 +155,26 @@ def _dense_unique_lookup(bkey, bvalid, lo: int, hi: int, span: int,
     return jnp.clip(brow_, 0, bcap - 1), matched, stale
 
 
+def _sorted_unique_lookup(bkey, bvalid, bcap: int, pkey, pvalid):
+    """Sorted 1:1 lookup into a planner-proven-unique build key:
+    (brow, matched, stale) probe-aligned. ONE searchsorted + one gather
+    (uniqueness makes `hi` redundant: a hit is an equality at lo).
+    stale must be the build-side adjacent-duplicate check — a
+    probe-derived hi-lo>1 would also fire on garbage probe lanes equal
+    to the invalid-row int64-max sentinel run, and a spurious stale is
+    a recompile livelock."""
+    sort_out = jax.lax.sort(
+        [~bvalid, bkey, jnp.arange(bcap, dtype=jnp.int32)], num_keys=2
+    )
+    svalid = ~sort_out[0]
+    skey = jnp.where(svalid, sort_out[1], jnp.iinfo(jnp.int64).max)
+    lo, _hi = _probe_lo_hi(skey, pkey, need_hi=False)
+    lo_c = jnp.clip(lo, 0, bcap - 1)
+    matched = pvalid & (lo < bcap) & svalid[lo_c] & (skey[lo_c] == pkey)
+    stale = jnp.any(svalid[1:] & (sort_out[1][1:] == sort_out[1][:-1]))
+    return sort_out[2][lo_c], matched, stale
+
+
 def lookup_build_rows(
     build: Batch,
     probe: Batch,
@@ -167,21 +201,7 @@ def lookup_build_rows(
             bkey, bvalid, lo, hi, span, bcap, pkey, pvalid
         )
         return brow, matched, stale
-    sort_out = jax.lax.sort(
-        [~bvalid, bkey, jnp.arange(bcap, dtype=jnp.int32)], num_keys=2
-    )
-    svalid = ~sort_out[0]
-    skey = jnp.where(svalid, sort_out[1], jnp.iinfo(jnp.int64).max)
-    sperm = sort_out[2]
-    lo, hi = _probe_lo_hi(skey, pkey, need_hi=True)
-    lo_c = jnp.clip(lo, 0, bcap - 1)
-    matched = pvalid & (hi > lo)
-    # planner-asserted uniqueness broken: adjacent equal VALID build
-    # keys. (Probe-derived hi-lo>1 would also fire on garbage probe
-    # lanes equal to the invalid-row int64-max sentinel run — a
-    # spurious stale is a recompile livelock.)
-    stale = jnp.any(svalid[1:] & (sort_out[1][1:] == sort_out[1][:-1]))
-    return sperm[lo_c], matched, stale
+    return _sorted_unique_lookup(bkey, bvalid, bcap, pkey, pvalid)
 
 
 def equi_join(
@@ -252,11 +272,21 @@ def equi_join(
         total = _fr_count(out.row_valid)
         return out, jnp.where(stale, jnp.int64(WIDTH_STALE), total)
 
-    if join_type in ("inner", "left") and span is not None and build_unique:
-        lo, hi = build_bounds
-        brow, matched, stale = _dense_unique_lookup(
-            bkey, bvalid, lo, hi, span, bcap, pkey, pvalid
-        )
+    if join_type in ("inner", "left") and build_unique:
+        if span is not None:
+            lo, hi = build_bounds
+            brow, matched, stale = _dense_unique_lookup(
+                bkey, bvalid, lo, hi, span, bcap, pkey, pvalid
+            )
+        else:
+            # unique build without a usable dense span (domain too
+            # large/sparse, or scatter-hostile backend): sorted lookup —
+            # sort the build once, one searchsorted per probe, still 1:1
+            # probe-aligned with NO expansion pass (vs the generic
+            # expand path below that pays cumsum + output re-gather)
+            brow, matched, stale = _sorted_unique_lookup(
+                bkey, bvalid, bcap, pkey, pvalid
+            )
         # 1:1 with the probe side: the output IS the probe batch (same
         # capacity, row_valid refined) plus gathered build columns — no
         # expansion pass. When capacity discovery has shrunk the output
