@@ -337,3 +337,119 @@ class TestPartitionManagementDDL:
         assert "partitions=[p0]" not in explain_text(
             s2, "select id from m where d < 10"
         )
+
+    def test_exchange_partition(self, env2):
+        cat, s = env2
+        s.execute("create table stage (id int, d int)")
+        s.execute("insert into stage values (50, 11), (51, 19)")
+        s.execute("alter table m exchange partition p1 with table stage")
+        # staged rows are now partition p1; old p1 rows moved to stage
+        assert s.execute("select id from m order by id").rows == [
+            (1,), (3,), (50,), (51,)
+        ]
+        assert s.execute("select id from stage order by id").rows == [
+            (2,), (4,)
+        ]
+        assert "partitions=[p1]" in explain_text(
+            s, "select id from m where d between 10 and 19"
+        )
+        assert s.execute(
+            "select id from m where d between 10 and 19 order by id"
+        ).rows == [(50,), (51,)]
+
+    def test_exchange_partition_validation(self, env2):
+        cat, s = env2
+        s.execute("create table stage (id int, d int)")
+        s.execute("insert into stage values (50, 25)")  # routes to p2
+        with pytest.raises(Exception, match="does not match"):
+            s.execute("alter table m exchange partition p1 with table stage")
+        # WITHOUT VALIDATION lets mismatched rows through (MySQL parity)
+        s.execute(
+            "alter table m exchange partition p1 with table stage "
+            "without validation"
+        )
+        assert s.execute("select id from stage order by id").rows == [
+            (2,), (4,)
+        ]
+
+    def test_exchange_partition_schema_mismatch(self, env2):
+        cat, s = env2
+        s.execute("create table bad1 (id int, d varchar(10))")
+        with pytest.raises(Exception, match="definitions"):
+            s.execute("alter table m exchange partition p1 with table bad1")
+        s.execute(
+            "create table bad2 (id int, d int) "
+            "partition by range (d) (partition q values less than (99))"
+        )
+        with pytest.raises(Exception, match="unpartitioned"):
+            s.execute("alter table m exchange partition p1 with table bad2")
+
+    def test_exchange_partition_strings_cross_dictionaries(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table logs (d int, msg varchar(40)) "
+            "partition by range (d) ("
+            "partition a values less than (10), "
+            "partition b values less than (20))"
+        )
+        s.execute(
+            "insert into logs values (1, 'alpha'), (15, 'kappa'), "
+            "(16, 'zeta')"
+        )
+        s.execute("create table stage (d int, msg varchar(40))")
+        s.execute(
+            "insert into stage values (12, 'omega'), (13, 'alpha')"
+        )
+        s.execute("alter table logs exchange partition b with table stage")
+        assert s.execute(
+            "select msg from logs order by d"
+        ).rows == [("alpha",), ("omega",), ("alpha",)]
+        assert s.execute(
+            "select msg from stage order by d"
+        ).rows == [("kappa",), ("zeta",)]
+        # string equality still works across the merged dictionaries
+        assert s.execute(
+            "select count(*) from logs where msg = 'alpha'"
+        ).rows == [(2,)]
+
+    def test_exchange_partition_unique_conflict_rejected(self):
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table m (id int primary key, d int) "
+            "partition by range (d) ("
+            "partition p0 values less than (10), "
+            "partition p1 values less than (20))"
+        )
+        s.execute("insert into m values (5, 1), (6, 15)")
+        s.execute("create table stage (id int primary key, d int)")
+        s.execute("insert into stage values (5, 15)")  # id=5 already in p0
+        with pytest.raises(Exception, match="duplicate"):
+            s.execute("alter table m exchange partition p1 with table stage")
+        assert s.execute("select count(*) from m").rows == [(2,)]
+        assert s.execute("select count(*) from stage").rows == [(1,)]
+
+    def test_exchange_partition_multiblock_dictionary_shift(self):
+        # two staged blocks whose second merge shifts the first block's
+        # codes: the two-pass alignment must keep values stable
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute(
+            "create table t (d int, w varchar(10)) "
+            "partition by range (d) ("
+            "partition a values less than (10), "
+            "partition b values less than (20))"
+        )
+        s.execute("insert into t values (1, 'mmm'), (15, 'zzz')")
+        s.execute("create table stage (d int, w varchar(10))")
+        s.execute("insert into stage values (11, 'omega')")  # block 1
+        s.execute("insert into stage values (12, 'beta')")   # block 2 shifts omega
+        s.execute("alter table t exchange partition b with table stage")
+        assert s.execute("select w from t order by d").rows == [
+            ("mmm",), ("omega",), ("beta",)
+        ]
+        assert s.execute("select w from stage order by d").rows == [("zzz",)]
+        assert s.execute(
+            "select count(*) from t where w = 'omega'"
+        ).rows == [(1,)]

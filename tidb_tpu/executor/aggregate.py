@@ -581,11 +581,11 @@ def group_aggregate(
     # sort (sortops) — the probed hash paths below are built on scatter
     # and per-group reduction loops, both serial on TPU. The dense path
     # keeps priority while its domain fits the masked-reduction unroll.
-    import os as _os
+    from tidb_tpu.utils.backend import sort_path_preference
 
+    _pref = sort_path_preference()
     use_sorted = keys and (
-        _os.environ.get("TIDB_TPU_SORT_AGG") == "1"
-        or (_is_tpu() and _os.environ.get("TIDB_TPU_SORT_AGG") != "0")
+        _pref == "force" or (_is_tpu() and _pref != "avoid")
     )
     dense_ok = (
         widths_ok

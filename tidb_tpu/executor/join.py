@@ -52,15 +52,12 @@ def _use_merge_probe(m: int) -> bool:
     gather measured 161ms at 1M probes vs ~15ms for the three regular
     sorts of the merge formulation. Below the cutoff the extra sorts
     don't pay. TIDB_TPU_SORT_AGG=1 forces it for CPU test coverage."""
-    import os
+    from tidb_tpu.utils.backend import is_tpu, sort_path_preference
 
-    import jax as _jax
-
-    if os.environ.get("TIDB_TPU_SORT_AGG") == "1":
+    pref = sort_path_preference()
+    if pref == "force":
         return True
-    from tidb_tpu.utils.backend import is_tpu
-
-    return m >= 4096 and is_tpu()
+    return m >= 4096 and is_tpu() and pref != "avoid"
 
 
 def _probe_lo_hi(skey, pkey, need_hi: bool):
@@ -102,14 +99,14 @@ def _dense_span(build_bounds, bcap: int, pcap: int) -> Optional[int]:
     pays for small builds there; CPU keeps dense at every size (its
     scatter matches np.bincount). TIDB_TPU_SORT_AGG=1 forces the sort
     path for CPU test coverage of the TPU lowering."""
-    import os
-
-    from tidb_tpu.utils.backend import is_tpu
+    from tidb_tpu.utils.backend import is_tpu, sort_path_preference
 
     if build_bounds is None:
         return None
-    env = os.environ.get("TIDB_TPU_SORT_AGG")
-    if env == "1" or (is_tpu() and env != "0" and bcap > (1 << 16)):
+    pref = sort_path_preference()
+    if pref == "force" or (
+        is_tpu() and pref != "avoid" and bcap > (1 << 16)
+    ):
         return None
     lo, hi = build_bounds
     span = int(hi) - int(lo) + 1

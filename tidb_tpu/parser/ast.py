@@ -328,6 +328,8 @@ class AlterTable:
     new_name: Optional[str] = None  # rename_col / rename target
     # add_partition: [(name, upper expr | None)]; drop/truncate: [name]
     partitions: Optional[list] = None
+    # exchange_partition: (table_db | None, table_name, validate: bool)
+    exchange: Optional[tuple] = None
 
 
 @dataclasses.dataclass

@@ -2363,6 +2363,24 @@ class Parser:
                 db, name, "truncate_partition",
                 partitions=self._partition_name_list(),
             )
+        if self._at_ident("exchange"):
+            self.advance()
+            self.expect_kw("partition")
+            pname = self.expect_ident().lower()
+            self.expect_kw("with")
+            self.expect_kw("table")
+            tdb, tname = self._qualified_name()
+            validate = True
+            if self.accept_kw("with"):
+                self._expect_ident_kw("validation")
+            elif self._at_ident("without"):
+                self.advance()
+                self._expect_ident_kw("validation")
+                validate = False
+            return ast.AlterTable(
+                db, name, "exchange_partition",
+                partitions=[pname], exchange=(tdb, tname, validate),
+            )
         if self._at_ident("modify"):
             self.advance()
             self.accept_kw("column")

@@ -522,3 +522,167 @@ class DDLMixin:
         t.generated = gen
         t._gen_exprs = None
         self._recompute_generated(t)
+
+    # ------------------------------------------------------------------
+    # -- EXCHANGE PARTITION --------------------------------------------
+    @staticmethod
+    def _exchange_schema_mismatch(t, nt):
+        """First structural difference that forbids an exchange, or
+        None. Reference: checkExchangePartition + the table-structure
+        comparison in pkg/ddl/partition.go onExchangeTablePartition."""
+        if nt.partition is not None:
+            return "the WITH TABLE side must be unpartitioned"
+        if list(t.schema.columns) != list(nt.schema.columns):
+            return "column definitions differ"
+        if (t.schema.primary_key or None) != (nt.schema.primary_key or None):
+            return "PRIMARY KEY definitions differ"
+        if set(t.schema.not_null) != set(nt.schema.not_null):
+            return "NOT NULL sets differ"
+        if (t.schema.enums or {}) != (nt.schema.enums or {}):
+            return "ENUM domains differ"
+        if (t.schema.sets or {}) != (nt.schema.sets or {}):
+            return "SET domains differ"
+        if t.indexes != nt.indexes or t.unique_indexes != nt.unique_indexes:
+            return "index definitions differ"
+        if (getattr(t, "generated", None) or []) != (
+            getattr(nt, "generated", None) or []
+        ):
+            return "generated column definitions differ"
+        if [c for _n, c in t.checks] != [c for _n, c in nt.checks]:
+            return "CHECK constraints differ"
+        if t.autoinc_col != nt.autoinc_col:
+            return "AUTO_INCREMENT columns differ"
+        return None
+
+    def _run_exchange_partition(self, t, s) -> None:
+        """ALTER TABLE pt EXCHANGE PARTITION p WITH TABLE nt
+        [WITH|WITHOUT VALIDATION] (reference: pkg/ddl/partition.go:2487
+        onExchangeTablePartition + checkExchangePartitionRecordValidation
+        :3560): swap the partition's blocks with the plain table's,
+        after proving identical structure and (under WITH VALIDATION,
+        the default) that every incoming row routes to exactly that
+        partition. Blocks cross dictionary spaces via each side's
+        _align_dictionaries; both tables restore on any failure."""
+        import dataclasses as _dc
+
+        import numpy as np
+
+        tdb, tname, validate = s.exchange
+        db = s.db or self.db
+        tdb = tdb or db
+        pname = s.partitions[0]
+        if t.partition is None:
+            raise ValueError("EXCHANGE PARTITION requires a partitioned table")
+        names = t.partition_names()
+        if pname not in names:
+            raise ValueError(f"unknown partition {pname!r}")
+        pid = names.index(pname)
+        nt = self.catalog.table(tdb, tname)
+        why = self._exchange_schema_mismatch(t, nt)
+        if why is not None:
+            raise ValueError(
+                f"tables have different definitions: {why}"
+            )
+        if t.fks or nt.fks or self._fk_children(db, s.name) or \
+                self._fk_children(tdb, tname):
+            raise ValueError(
+                "EXCHANGE PARTITION is not allowed on tables with "
+                "foreign keys (MySQL parity)"
+            )
+        pcol = t.partition[1]
+        if validate:
+            for b in nt.blocks():
+                c = b.columns[pcol]
+                pid_of = np.zeros(b.nrows, dtype=np.int64)
+                if c.valid.any():
+                    pid_of[c.valid] = t.partition_of(c.data[c.valid])
+                # NULL keys route to the lowest partition (split parity)
+                if ((pid_of != pid) | (~c.valid & (pid != 0))).any():
+                    raise ValueError(
+                        "found a row that does not match the partition "
+                        f"{pname!r} (use WITHOUT VALIDATION to skip)"
+                    )
+        undo = []
+        self._fk_undo_snapshot(undo, t)
+        self._fk_undo_snapshot(undo, nt)
+        try:
+            # dictionary alignment is order-dependent (a later block's
+            # merge can shift codes handed out earlier), so align in
+            # TWO passes: pass 1 grows each target's global dicts to
+            # the final superset (outputs discarded), pass 2 remaps
+            # against the now-stable dicts
+            for b in nt.blocks():
+                t._align_dictionaries(b)
+            moved_in = [
+                _dc.replace(t._align_dictionaries(b), part_id=pid)
+                for b in nt.blocks()
+            ]
+            # re-read AFTER alignment (pt's own blocks may have been
+            # code-remapped in place), and re-split any untagged block
+            # (legacy data predating tag preservation) so the outgoing
+            # partition's rows can't hide in part_id=None blocks
+            pt_blocks = []
+            for b in t.blocks():
+                if b.part_id is None:
+                    pt_blocks.extend(t.split_by_partition(b))
+                else:
+                    pt_blocks.append(b)
+            keep = [b for b in pt_blocks if b.part_id != pid]
+            out = [b for b in pt_blocks if b.part_id == pid]
+            self._exchange_check_unique(t, keep, moved_in)
+            for b in out:
+                nt._align_dictionaries(b)
+            moved_out = [
+                _dc.replace(nt._align_dictionaries(b), part_id=None)
+                for b in out
+            ]
+            n_in = sum(b.nrows for b in moved_in)
+            n_out = sum(b.nrows for b in moved_out)
+            t.replace_blocks(keep + moved_in, modified_rows=n_in + n_out)
+            nt.replace_blocks(moved_out, modified_rows=n_in + n_out)
+            # AUTO_INCREMENT allocators must stay ahead of both images
+            if t.autoinc_col:
+                hi = max(t.autoinc_next, nt.autoinc_next)
+                t.autoinc_next = nt.autoinc_next = hi
+        except BaseException:
+            self._fk_undo_restore(undo)
+            raise
+
+    @staticmethod
+    def _exchange_check_unique(t, keep, moved_in) -> None:
+        """Incoming rows must not collide with the REMAINING table on
+        the PK or any unique index (replace_blocks installs without the
+        append path's duplicate checks, and nothing forces unique keys
+        to include the partitioning column). Both sides are internally
+        unique already — their own tables enforced that — so only the
+        cross-set intersection needs checking, in t's aligned encoded
+        domain."""
+        import numpy as np
+
+        uniq = [
+            (f"unique index {i!r}", list(t.indexes[i]))
+            for i in sorted(t.unique_indexes)
+            if t.indexes.get(i)
+        ]
+        if t.schema.primary_key:
+            uniq.append(("primary key", list(t.schema.primary_key)))
+        for label, cols in uniq:
+            sides = []
+            for blocks in (keep, moved_in):
+                mats = [
+                    t._key_matrix(b.columns, cols)
+                    for b in blocks
+                    if b.nrows
+                ]
+                mats = [m for m in mats if len(m)]
+                if not mats:
+                    sides.append(None)
+                    continue
+                sides.append(t._rows_view(np.vstack(mats)))
+            if sides[0] is None or sides[1] is None:
+                continue
+            if np.intersect1d(sides[0], sides[1]).size:
+                raise ValueError(
+                    f"EXCHANGE PARTITION would create a duplicate "
+                    f"entry for {label} ({', '.join(cols)})"
+                )

@@ -2176,6 +2176,19 @@ class Session(DDLMixin):
                     t.schema, ("range", t.partition[1], s.partitions)
                 )
                 t.alter_add_partitions(enc[2])
+            elif s.action == "exchange_partition":
+                if self._txn is not None:
+                    raise ValueError(
+                        "partition DDL is not allowed inside a "
+                        "transaction; COMMIT first"
+                    )
+                self._with_write_locks(
+                    [
+                        (s.db or self.db, s.name),
+                        (s.exchange[0] or s.db or self.db, s.exchange[1]),
+                    ],
+                    lambda: self._run_exchange_partition(t, s),
+                )
             elif s.action in ("drop_partition", "truncate_partition"):
                 # rows vanish like a DELETE: children's ON DELETE
                 # referential actions apply against the post-statement

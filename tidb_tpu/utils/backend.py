@@ -46,6 +46,16 @@ def is_tpu() -> bool:
     return _IS_TPU
 
 
+def sort_path_preference() -> str:
+    """One switch for every sort-vs-scatter formulation gate:
+    TIDB_TPU_SORT_AGG=1 -> 'force' (CPU tests cover the TPU lowering),
+    =0 -> 'avoid' (TPU opt-out escape hatch), unset -> 'auto' (backend
+    decides). Gates combine this with is_tpu() and their own size
+    thresholds, but the env-var policy lives here only."""
+    v = os.environ.get("TIDB_TPU_SORT_AGG")
+    return "force" if v == "1" else "avoid" if v == "0" else "auto"
+
+
 def force_cpu() -> None:
     """Make this interpreter CPU-only regardless of registered plugins."""
     global _IS_TPU
