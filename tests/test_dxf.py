@@ -472,3 +472,43 @@ def test_import_string_index_into_prepopulated_table(sess, tmp_path):
     assert sess.execute(
         "select v from pp where s = 'zz'"
     ).rows == [(-1,)]
+
+
+def test_import_list_partition_null_routing(sess, tmp_path):
+    """LIST tables route NULL keys to the NULL-listing partition; the
+    stage-time run split must mirror that or staged runs pair with the
+    wrong landed blocks (round-5 review finding)."""
+    import numpy as np
+
+    path = str(tmp_path / "l.tsv")
+    with open(path, "w") as f:
+        for i in range(300):
+            r = ["1", "2", "\\N"][i % 3]
+            f.write(f"{r}\t{i}\n")
+    sess.execute(
+        "create table lt (r int, v int) partition by list (r) ("
+        "partition a values in (1), "
+        "partition b values in (2), "
+        "partition nulls values in (null))"
+    )
+    sess.execute("create index iv on lt (v)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "lt", "path": path,
+         "chunk_bytes": 2048, "spill_dir": str(tmp_path)},
+    )
+    assert m.run_to_completion(tid, executors=2) == "succeed"
+    t = sess.catalog.table("test", "lt")
+    assert sess.execute("select count(*) from lt").rows == [(300,)]
+    assert sess.execute(
+        "select count(*) from lt where r is null"
+    ).rows == [(100,)]
+    # any ingested index must order the REAL rows (wrong-block pairing
+    # would install a permutation of the wrong values)
+    ent = t._idx_cache.get((t.version, "v"))
+    if ent is not None:
+        svals, _perm, nvalid = ent
+        data = np.concatenate([b.columns["v"].data for b in t.blocks()])
+        assert nvalid == 300 and np.array_equal(np.sort(data), svals)
+    sess.execute("admin check table lt")  # raises on any corruption

@@ -149,7 +149,16 @@ def _import_run(meta, catalog) -> dict:
             # back to the on-demand delta sort
             return {"rows": block.nrows, "staged": staged, "runs": [],
                     "start": meta["start"]}
-        pid = np.zeros(block.nrows, dtype=np.int64)
+        # NULL keys route exactly where split_by_partition routes them
+        # (pid 0 for RANGE/HASH, the NULL-listing LIST partition) — a
+        # divergence here pairs staged runs with the WRONG landed blocks
+        np_id = t.null_partition() if not pc.valid.all() else 0
+        if np_id is None:
+            # no partition accepts NULL: the append will reject this
+            # block anyway; stage without runs
+            return {"rows": block.nrows, "staged": staged, "runs": [],
+                    "start": meta["start"]}
+        pid = np.full(block.nrows, np_id, dtype=np.int64)
         if pc.valid.any():
             pid[pc.valid] = t.partition_of(pc.data[pc.valid])
         masks = [(int(p), pid == p) for p in sorted(set(pid.tolist()))]

@@ -2193,14 +2193,14 @@ class Parser:
             self.advance()
             self.expect_kw("by")
             kindw = self.expect_ident().lower()
-            if kindw == "range":
+            if kindw in ("range", "list"):
                 self.expect_op("(")
                 pcol = self.expect_ident().lower()
                 self.expect_op(")")
                 self.expect_op("(")
                 parts = self._parse_range_partition_items()
                 self.expect_op(")")
-                partition = ("range", pcol, parts)
+                partition = (kindw, pcol, parts)
             elif kindw == "hash":
                 self.expect_op("(")
                 pcol = self.expect_ident().lower()
@@ -2292,16 +2292,28 @@ class Parser:
         return seq
 
     def _parse_range_partition_items(self):
-        """PARTITION p VALUES LESS THAN ((expr)|MAXVALUE)[, ...] —
-        shared by CREATE TABLE ... PARTITION BY RANGE and ALTER TABLE
-        ADD PARTITION."""
+        """PARTITION p VALUES {LESS THAN ((expr)|MAXVALUE) | IN (expr,
+        ...)}[, ...] — shared by CREATE TABLE ... PARTITION BY
+        RANGE/LIST and ALTER TABLE ADD PARTITION. Range items carry the
+        bound expr (None = MAXVALUE); list items carry ("in", [exprs])
+        — _encode_partition validates kind consistency."""
         parts = []
         while True:
             self.expect_kw("partition")
             pname = self.expect_ident().lower()
             self.expect_kw("values")
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                vals = [self.parse_expr()]
+                while self.accept_op(","):
+                    vals.append(self.parse_expr())
+                self.expect_op(")")
+                parts.append((pname, ("in", vals)))
+                if not self.accept_op(","):
+                    break
+                continue
             if not (self.cur.kind == "id" and self.cur.text.lower() == "less"):
-                raise ParseError("expected VALUES LESS THAN")
+                raise ParseError("expected VALUES LESS THAN or VALUES IN")
             self.advance()
             if not (self.cur.kind == "id" and self.cur.text.lower() == "than"):
                 raise ParseError("expected THAN")

@@ -325,6 +325,18 @@ def _prune_partitions(pred, scan: "L.Scan", resolver):
     nparts = (
         int(part[2]) if part[0] == "hash" else len(part[2])
     )
+    if part[0] == "list":
+        keep = []
+        for i, (_n, vals) in enumerate(part[2]):
+            hit = any(
+                v is not None
+                and (lo is None or v >= lo)
+                and (hi is None or v <= hi)
+                for v in vals
+            )
+            if hit:
+                keep.append(i)
+        return None if len(keep) == nparts else tuple(keep)
     if part[0] == "hash":
         # hash pruning needs a small CLOSED range (point lookups mostly)
         n = int(part[2])

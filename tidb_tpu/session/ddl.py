@@ -298,26 +298,59 @@ class DDLMixin:
             if n < 1:
                 raise ValueError("PARTITIONS must be >= 1")
             return ("hash", pcol, n)
+
+        def enc_const(upper, what):
+            c = ExprBinder._const_arg(upper)
+            if c is None:
+                raise ValueError(f"{what} expects a constant")
+            v = c.value
+            if v is None:
+                return None
+            if ptype.kind == Kind.DATE and isinstance(v, str):
+                return int(date_to_days(v))
+            if ptype.kind == Kind.DATETIME and isinstance(v, str):
+                return int(datetime_to_micros(v))
+            if ptype.kind == Kind.DECIMAL:
+                return round(float(v) * 10**ptype.scale)
+            return int(v)
+
+        if kind == "list":
+            # LIST partitioning (reference: pkg/ddl/partition.go
+            # checkAndOverridePartitionID / generateListPartition):
+            # each partition owns an explicit value set; NULL may be
+            # listed in exactly one partition
+            parts = []
+            seen: dict = {}
+            for pname, item in spec:
+                if not (isinstance(item, tuple) and item[0] == "in"):
+                    raise ValueError(
+                        "LIST partitions need VALUES IN (...)"
+                    )
+                vals = []
+                for e in item[1]:
+                    enc = enc_const(e, "VALUES IN")
+                    if enc in seen:
+                        raise ValueError(
+                            f"list value {enc!r} appears in partitions "
+                            f"{seen[enc]!r} and {pname.lower()!r}"
+                        )
+                    seen[enc] = pname.lower()
+                    vals.append(enc)
+                parts.append((pname.lower(), tuple(vals)))
+            return ("list", pcol, parts)
         parts = []
         prev = None
         for pname, upper in spec:
+            if isinstance(upper, tuple) and upper and upper[0] == "in":
+                raise ValueError(
+                    "VALUES IN requires PARTITION BY LIST"
+                )
             if upper is None:
                 enc = None
             else:
-                c = ExprBinder._const_arg(upper)
-                if c is None:
-                    raise ValueError(
-                        "VALUES LESS THAN expects a constant"
-                    )
-                v = c.value
-                if ptype.kind == Kind.DATE and isinstance(v, str):
-                    enc = int(date_to_days(v))
-                elif ptype.kind == Kind.DATETIME and isinstance(v, str):
-                    enc = int(datetime_to_micros(v))
-                elif ptype.kind == Kind.DECIMAL:
-                    enc = round(float(v) * 10**ptype.scale)
-                else:
-                    enc = int(v)
+                enc = enc_const(upper, "VALUES LESS THAN")
+                if enc is None:
+                    raise ValueError("VALUES LESS THAN bound cannot be NULL")
                 if prev is not None and enc <= prev:
                     raise ValueError(
                         "VALUES LESS THAN must be strictly increasing"
@@ -591,13 +624,27 @@ class DDLMixin:
             )
         pcol = t.partition[1]
         if validate:
+            null_pid = t.null_partition()
             for b in nt.blocks():
                 c = b.columns[pcol]
                 pid_of = np.zeros(b.nrows, dtype=np.int64)
                 if c.valid.any():
-                    pid_of[c.valid] = t.partition_of(c.data[c.valid])
-                # NULL keys route to the lowest partition (split parity)
-                if ((pid_of != pid) | (~c.valid & (pid != 0))).any():
+                    try:
+                        pid_of[c.valid] = t.partition_of(c.data[c.valid])
+                    except ValueError:
+                        # a value listed in NO partition is still just a
+                        # mismatch for THIS partition (and WITHOUT
+                        # VALIDATION genuinely lets it through)
+                        raise ValueError(
+                            "found a row that does not match the "
+                            f"partition {pname!r} (use WITHOUT "
+                            "VALIDATION to skip)"
+                        ) from None
+                # NULL keys route where split_by_partition routes them
+                if (
+                    (c.valid & (pid_of != pid))
+                    | (~c.valid & (null_pid != pid))
+                ).any():
                     raise ValueError(
                         "found a row that does not match the partition "
                         f"{pname!r} (use WITHOUT VALIDATION to skip)"

@@ -2166,14 +2166,17 @@ class Session(DDLMixin):
                 )
             elif s.action == "add_partition":
                 # reference: pkg/ddl/partition.go onAddTablePartition —
-                # metadata-only for RANGE; bounds encode exactly like
-                # CREATE TABLE's (dates->days, decimals->scaled ints)
-                if t.partition is None or t.partition[0] != "range":
+                # metadata-only for RANGE/LIST; bounds encode exactly
+                # like CREATE TABLE's (dates->days, decimals->scaled)
+                if t.partition is None or t.partition[0] not in (
+                    "range", "list",
+                ):
                     raise ValueError(
-                        "ADD PARTITION requires a RANGE-partitioned table"
+                        "ADD PARTITION requires a RANGE- or "
+                        "LIST-partitioned table"
                     )
                 enc = self._encode_partition(
-                    t.schema, ("range", t.partition[1], s.partitions)
+                    t.schema, (t.partition[0], t.partition[1], s.partitions)
                 )
                 t.alter_add_partitions(enc[2])
             elif s.action == "exchange_partition":

@@ -136,7 +136,11 @@ def apply_table_meta(t, meta: Dict) -> None:
         pk_, pc_, spec_ = meta["partition"]
         t.partition = (
             pk_, pc_,
-            int(spec_) if pk_ == "hash" else [tuple(x) for x in spec_],
+            int(spec_) if pk_ == "hash"
+            else [
+                (x[0], tuple(x[1])) if pk_ == "list" else tuple(x)
+                for x in spec_
+            ],
         )
     else:
         t.partition = None

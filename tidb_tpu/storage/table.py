@@ -246,12 +246,47 @@ class Table:
             return [f"p{i}" for i in range(int(self.partition[2]))]
         return [n for n, _u in self.partition[2]]
 
+    def null_partition(self) -> Optional[int]:
+        """Partition id NULL keys route to: the lowest partition for
+        RANGE/HASH (MySQL), the partition listing NULL for LIST (None
+        when no partition lists it — NULL rows are then rejected)."""
+        if self.partition is None:
+            return None
+        if self.partition[0] != "list":
+            return 0
+        for i, (_n, vals) in enumerate(self.partition[2]):
+            if any(v is None for v in vals):
+                return i
+        return None
+
     def partition_of(self, values: np.ndarray) -> np.ndarray:
         """Partition id per raw-encoded partition-column value."""
         kind = self.partition[0]
         if kind == "hash":
             n = int(self.partition[2])
             return (values.astype(np.int64) % n + n) % n
+        if kind == "list":
+            flat, pids = [], []
+            for i, (_n, vals) in enumerate(self.partition[2]):
+                for v in vals:
+                    if v is not None:
+                        flat.append(v)
+                        pids.append(i)
+            order = np.argsort(np.asarray(flat, dtype=np.int64))
+            fv = np.asarray(flat, dtype=np.int64)[order]
+            fp = np.asarray(pids, dtype=np.int64)[order]
+            v64 = values.astype(np.int64)
+            pos = np.searchsorted(fv, v64)
+            pos_c = np.minimum(pos, max(len(fv) - 1, 0))
+            ok = (pos < len(fv)) & (fv[pos_c] == v64) if len(fv) else (
+                np.zeros(len(v64), dtype=bool)
+            )
+            if not ok.all():
+                bad = v64[~ok][0]
+                raise ValueError(
+                    f"Table has no partition for value {int(bad)}"
+                )
+            return fp[pos_c]
         uppers = [u for _n, u in self.partition[2]]
         bounds = [u for u in uppers if u is not None]
         pid = np.searchsorted(
@@ -278,10 +313,20 @@ class Table:
         c = block.columns.get(pcol)
         if c is None:
             raise ValueError(f"partition column {pcol!r} missing")
-        # MySQL: NULL keys land in the lowest RANGE partition; only
-        # valid values go through the ladder (a ladder of negative
-        # bounds must not reject NULLs via the 0 placeholder)
-        pid = np.zeros(block.nrows, dtype=np.int64)
+        # MySQL: NULL keys land in the lowest RANGE partition / the
+        # NULL-listing LIST partition; only valid values go through the
+        # ladder (a ladder of negative bounds must not reject NULLs via
+        # the 0 placeholder)
+        if not c.valid.all():
+            np_id = self.null_partition()
+            if np_id is None:
+                raise ValueError(
+                    "Table has no partition for NULL "
+                    f"(no LIST partition lists NULL in {pcol!r})"
+                )
+        else:
+            np_id = 0
+        pid = np.full(block.nrows, np_id, dtype=np.int64)
         if c.valid.any():
             pid[c.valid] = self.partition_of(c.data[c.valid])
         out = []
@@ -789,39 +834,69 @@ class Table:
     # metadata, rows live in per-partition tagged blocks, so ADD is
     # metadata-only, DROP/TRUNCATE drop the tagged blocks in a new
     # MVCC version (pinned snapshots keep reading theirs). -----------------
-    def alter_add_partitions(self, new_parts: List[Tuple[str, Optional[int]]]) -> int:
-        """Append RANGE partitions (encoded uppers, None = MAXVALUE)."""
+    def alter_add_partitions(self, new_parts) -> int:
+        """Append RANGE partitions (encoded uppers, None = MAXVALUE)
+        or LIST partitions (encoded value tuples)."""
         with self._lock:
-            if self.partition is None or self.partition[0] != "range":
+            if self.partition is None or self.partition[0] not in (
+                "range", "list",
+            ):
                 raise ValueError(
-                    "ADD PARTITION requires a RANGE-partitioned table"
+                    "ADD PARTITION requires a RANGE- or LIST-partitioned "
+                    "table"
                 )
-            _kind, pcol, parts = self.partition
+            kind0, pcol, parts = self.partition
             parts = list(parts)
-            if parts and parts[-1][1] is None:
-                raise ValueError(
-                    "cannot ADD PARTITION after a MAXVALUE partition"
-                )
-            names = {n for n, _u in parts}
-            last = parts[-1][1] if parts else None
-            for i, (n, u) in enumerate(new_parts):
-                n = n.lower()
-                if n in names:
-                    raise ValueError(f"duplicate partition name {n!r}")
-                if u is None and i != len(new_parts) - 1:
-                    raise ValueError("MAXVALUE must be the last partition")
-                if u is not None and last is not None and u <= last:
+            names = {n for n, _v in parts}
+            if kind0 == "list":
+                owned = {v for _n, vals in parts for v in vals}
+                for n, vals in new_parts:
+                    n = n.lower()
+                    if not isinstance(vals, tuple):
+                        raise ValueError(
+                            "LIST partitions need VALUES IN (...)"
+                        )
+                    if n in names:
+                        raise ValueError(
+                            f"duplicate partition name {n!r}"
+                        )
+                    clash = owned & set(vals)
+                    if clash:
+                        raise ValueError(
+                            f"list value {sorted(clash, key=repr)[0]!r} "
+                            "already belongs to another partition"
+                        )
+                    parts.append((n, tuple(vals)))
+                    names.add(n)
+                    owned |= set(vals)
+            else:
+                if parts and parts[-1][1] is None:
                     raise ValueError(
-                        "VALUES LESS THAN must be strictly increasing"
+                        "cannot ADD PARTITION after a MAXVALUE partition"
                     )
-                parts.append((n, u))
-                names.add(n)
-                last = u if u is not None else last
+                last = parts[-1][1] if parts else None
+                for i, (n, u) in enumerate(new_parts):
+                    n = n.lower()
+                    if n in names:
+                        raise ValueError(
+                            f"duplicate partition name {n!r}"
+                        )
+                    if u is None and i != len(new_parts) - 1:
+                        raise ValueError(
+                            "MAXVALUE must be the last partition"
+                        )
+                    if u is not None and last is not None and u <= last:
+                        raise ValueError(
+                            "VALUES LESS THAN must be strictly increasing"
+                        )
+                    parts.append((n, u))
+                    names.add(n)
+                    last = u if u is not None else last
             self.version += 1
             self._versions[self.version] = list(
                 self._versions[self.version - 1]
             )
-            self.partition = ("range", pcol, parts)
+            self.partition = (kind0, pcol, parts)
             self._gc_versions()
             return self.version
 
@@ -832,12 +907,14 @@ class Table:
         TRUNCATE PARTITION (rows dropped, defs kept). Returns removed
         row count."""
         with self._lock:
-            if self.partition is None or self.partition[0] != "range":
+            if self.partition is None or self.partition[0] not in (
+                "range", "list",
+            ):
                 raise ValueError(
-                    "DROP/TRUNCATE PARTITION requires a RANGE-partitioned "
-                    "table"
+                    "DROP/TRUNCATE PARTITION requires a RANGE- or "
+                    "LIST-partitioned table"
                 )
-            _kind, pcol, parts = self.partition
+            kind0, pcol, parts = self.partition
             all_names = [n for n, _u in parts]
             drop = set()
             for n in names:
@@ -865,7 +942,7 @@ class Table:
             self._versions[self.version] = new_blocks
             if not truncate_only:
                 self.partition = (
-                    "range",
+                    kind0,
                     pcol,
                     [p for i, p in enumerate(parts) if i not in drop],
                 )

@@ -115,11 +115,22 @@ def create_table_sql(t) -> str:
                     return f"({int(u) / 10**ptype.scale})"
                 return f"({u})"
 
-            decls = ", ".join(
-                f"partition {n} values less than {_bound_sql(u)}"
-                for n, u in part[2]
-            )
-            opts += f" partition by range ({part[1]}) ({decls})"
+            if part[0] == "list":
+                def _val_sql(v):
+                    return "null" if v is None else _bound_sql(v).strip("()")
+
+                decls = ", ".join(
+                    f"partition {n} values in "
+                    "(" + ", ".join(_val_sql(v) for v in vals) + ")"
+                    for n, vals in part[2]
+                )
+                opts += f" partition by list ({part[1]}) ({decls})"
+            else:
+                decls = ", ".join(
+                    f"partition {n} values less than {_bound_sql(u)}"
+                    for n, u in part[2]
+                )
+                opts += f" partition by range ({part[1]}) ({decls})"
     if t.ttl:
         col, iv, unit = t.ttl
         opts += f" ttl = {col} + interval {iv} {unit}"
