@@ -182,3 +182,107 @@ class TestReviewRegressions:
         s.execute("create table r (k int primary key, v varchar(4))")
         s.execute("replace into r values (1,'a'),(1,'b')")
         assert s.execute("select * from r").rows == [(1, "b")]
+
+
+class TestCompatSurface:
+    """Round-5 compat batch: CREATE TABLE LIKE, ALTER TABLE ADD
+    INDEX/KEY/UNIQUE, INSERT ... SET, SHOW TABLE STATUS,
+    information_schema.partitions."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("create database cs")
+        sess.execute("use cs")
+        return sess
+
+    def test_create_table_like(self, s):
+        s.execute("create table parent (pk int primary key)")
+        s.execute(
+            "create table src (id int primary key auto_increment, "
+            "v varchar(8) not null, z int default 7, "
+            "constraint fz foreign key (z) references parent (pk))"
+        )
+        s.execute("create index iv on src (v)")
+        s.execute("insert into parent values (7)")
+        s.execute("insert into src (v) values ('a')")
+        s.execute("create table dst like src")
+        ddl = s.execute("show create table dst").rows[0][1].lower()
+        assert "auto_increment" in ddl and "not null" in ddl
+        assert "default 7" in ddl and "index iv" in ddl
+        assert "foreign key" not in ddl  # MySQL: LIKE drops FKs
+        assert s.execute("select count(*) from dst").rows == [(0,)]
+        s.execute("insert into dst (v) values ('x')")
+        assert s.execute("select id, z from dst").rows == [(1, 7)]
+        with pytest.raises(Exception, match="[Nn]ull|NULL"):
+            s.execute("insert into dst (v) values (NULL)")
+
+    def test_create_table_like_partitioned(self, s):
+        s.execute(
+            "create table ps (k int, d int) partition by list (d) ("
+            "partition a values in (1), partition b values in (2, null))"
+        )
+        s.execute("create table pd like ps")
+        s.execute("insert into pd values (1, 2), (2, NULL)")
+        r = s.execute(
+            "select partition_name, table_rows from "
+            "information_schema.partitions where table_name = 'pd' "
+            "order by partition_ordinal_position"
+        ).rows
+        assert r == [("a", 0), ("b", 2)]
+
+    def test_alter_add_index_forms(self, s):
+        s.execute("create table t (a int, b int, c int)")
+        s.execute("insert into t values (1, 2, 3), (1, 5, 6)")
+        s.execute("alter table t add index ia (a)")
+        s.execute("alter table t add key kb (b)")
+        s.execute("alter table t add unique uc (c)")
+        s.execute("alter table t add unique index ubc (b, c)")
+        idx = {
+            v for r in s.execute("show index from t").rows for v in r
+            if isinstance(v, str)
+        }
+        assert {"ia", "kb", "uc", "ubc"} <= idx
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.execute("alter table t add unique ua (a)")
+
+    def test_insert_set(self, s):
+        s.execute("create table t (a int, b varchar(4) default 'dd')")
+        s.execute("insert into t set a = 5")
+        s.execute("insert ignore into t set a = 6, b = 'x'")
+        assert s.execute("select a, b from t order by a").rows == [
+            (5, "dd"), (6, "x")
+        ]
+
+    def test_show_table_status(self, s):
+        s.execute("create table t (a int)")
+        s.execute("insert into t values (1), (2)")
+        s.execute("create view vw as select a from t")
+        rows = s.execute("show table status").rows
+        names = {r[0]: r for r in rows}
+        assert names["t"][4] == 2  # Rows
+        assert names["vw"][9] == "VIEW"  # Comment
+        only = s.execute("show table status like 't'").rows
+        assert len(only) == 1 and only[0][0] == "t"
+
+    def test_review_fixes(self, s):
+        s.execute("create table u (k int primary key, v int)")
+        s.execute("insert into u set k = 1, v = 2")
+        # SET form composes with ON DUPLICATE (MySQL)
+        s.execute(
+            "insert into u set k = 1, v = 9 on duplicate key update v = 3"
+        )
+        assert s.execute("select v from u").rows == [(3,)]
+        # anonymous index names auto-generate
+        s.execute("alter table u add unique (v)")
+        s.execute("alter table u add index (k, v)")
+        with pytest.raises(Exception, match="[Dd]uplicate"):
+            s.execute("insert into u values (2, 3)")
+        # SHOW TABLE STATUS: uppercase + ci LIKE
+        rows = s.execute("SHOW TABLE STATUS LIKE 'U'").rows
+        assert len(rows) == 1 and rows[0][0] == "u"
+        # backslash-bearing string default survives the DDL round-trip
+        s.execute(r"create table bs (a int, b varchar(8) default 'a\\b')")
+        s.execute("create table bs2 like bs")
+        s.execute("insert into bs2 (a) values (1)")
+        assert s.execute("select b from bs2").rows == [("a\\b",)]

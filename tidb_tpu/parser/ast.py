@@ -239,6 +239,9 @@ class CreateTable:
     # CREATE TEMPORARY TABLE: session-scoped, shadows base tables by
     # name (reference: pkg/table/temptable/ddl.go local temp tables)
     temporary: bool = False
+    # CREATE TABLE ... LIKE source: (db | None, name) — clone the
+    # definition (not data, not FKs — MySQL parity)
+    like: Optional[tuple] = None
 
 
 @dataclasses.dataclass

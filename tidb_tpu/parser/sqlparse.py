@@ -383,6 +383,12 @@ class Parser:
             self.advance()
             if self.accept_kw("tables"):
                 return ast.Show("tables")
+            if self.at_kw("table") and (
+                self.toks[self.i + 1].text.lower() == "status"
+            ):
+                self.advance()  # table
+                self.advance()  # status
+                return ast.Show("table_status", db=self._show_like())
             if self._at_ident("columns") or self._at_ident("fields"):
                 self.advance()
                 self.expect_kw("from")
@@ -1957,6 +1963,26 @@ class Parser:
         self.expect_kw("table")
         ine = self._if_not_exists()
         db, name = self._qualified_name()
+        if self.accept_kw("like"):
+            sdb, sname = self._qualified_name()
+            return ast.CreateTable(
+                db, name, [], [], ine, like=(sdb, sname),
+                temporary=temporary,
+            )
+        if (
+            self.cur.kind == "op"
+            and self.cur.text == "("
+            and self.toks[self.i + 1].kind == "kw"
+            and self.toks[self.i + 1].text == "like"
+        ):
+            self.advance()  # (
+            self.advance()  # like
+            sdb, sname = self._qualified_name()
+            self.expect_op(")")
+            return ast.CreateTable(
+                db, name, [], [], ine, like=(sdb, sname),
+                temporary=temporary,
+            )
         if self.accept_kw("as") or self.at_kw("select", "with"):
             # CREATE TABLE ... AS SELECT (columns derived from the query)
             q = (
@@ -2350,6 +2376,22 @@ class Parser:
         self.expect_kw("table")
         db, name = self._qualified_name()
         if self.accept_kw("add"):
+            if self.at_kw("unique", "index", "key"):
+                unique = self.accept_kw("unique")
+                if not (self.accept_kw("index") or self.accept_kw("key")):
+                    if not unique:
+                        raise ParseError("expected INDEX or KEY")
+                # MySQL allows an anonymous index: name auto-generates
+                # from the first column
+                iname = None if self.at_op("(") else self.expect_ident()
+                self.expect_op("(")
+                icols = [self.expect_ident()]
+                while self.accept_op(","):
+                    icols.append(self.expect_ident())
+                self.expect_op(")")
+                if iname is None:
+                    iname = icols[0]
+                return ast.CreateIndex(db, name, iname, icols, False, unique)
             if self.accept_kw("partition"):
                 self.expect_op("(")
                 parts = self._parse_range_partition_items()
@@ -2578,17 +2620,29 @@ class Parser:
                 else self.parse_select_or_union()
             )
             return ast.Insert(db, name, columns, [], query=q, ignore=ignore)
-        self.expect_kw("values")
-        rows = []
-        while True:
-            self.expect_op("(")
-            row = [self.parse_expr()]
-            while self.accept_op(","):
+        if columns is None and self.accept_kw("set"):
+            # INSERT INTO t SET a = 1, b = 2 (MySQL single-row sugar);
+            # falls through to the shared ON DUPLICATE KEY parsing
+            columns, row = [], []
+            while True:
+                columns.append(self.expect_ident())
+                self.expect_op("=")
                 row.append(self.parse_expr())
-            self.expect_op(")")
-            rows.append(row)
-            if not self.accept_op(","):
-                break
+                if not self.accept_op(","):
+                    break
+            rows = [row]
+        else:
+            self.expect_kw("values")
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+                rows.append(row)
+                if not self.accept_op(","):
+                    break
         on_dup = None
         if self.accept_kw("on"):
             if not self._at_ident("duplicate"):

@@ -1875,6 +1875,43 @@ class Session(DDLMixin):
                 t.append_rows([list(r) for r in res.rows])
             clear_scan_cache()
             r = Result([], [], affected=len(res.rows))
+        elif isinstance(s, ast.CreateTable) and s.like is not None:
+            # CREATE TABLE dst LIKE src (reference: pkg/ddl table.go
+            # CreateTableWithLike): clone the full definition via its
+            # own rendered DDL — minus FOREIGN KEYs (MySQL parity) and
+            # data; defaults and collations follow, AUTO_INCREMENT
+            # restarts
+            sdb, sname = s.like
+            src = self.catalog.table(sdb or self.db, sname)
+            from tidb_tpu.tools.dump import create_table_sql
+
+            lines = create_table_sql(src).rstrip(";").split("\n")
+            lines = [
+                ln for ln in lines if "foreign key" not in ln.lower()
+            ]
+            # the filtered line may leave a dangling comma on its
+            # predecessor; normalize through join/strip
+            body = "\n".join(lines)
+            body = body.replace(",\n)", "\n)")
+            tgt = f"`{s.name.lower()}`"
+            ddl = body.replace(f"CREATE TABLE `{src.name}`", "", 1)
+            ddl = f"CREATE TABLE {tgt}" + ddl
+            if s.if_not_exists and self.catalog.has_table(
+                s.db or self.db, s.name
+            ):
+                r = Result([], [])
+            else:
+                stmt = parse(ddl)[0]
+                stmt = dataclasses.replace(
+                    stmt, db=s.db, temporary=s.temporary
+                )
+                r = self._execute_stmt_inner(stmt, t0)
+                nt = (
+                    self._resolve_table_for_write(s.db or self.db, s.name)
+                    if s.temporary
+                    else self.catalog.table(s.db or self.db, s.name)
+                )
+                nt.defaults = dict(getattr(src, "defaults", {}) or {})
         elif isinstance(s, ast.CreateTable):
             schema = TableSchema(
                 [(c.name.lower(), c.type) for c in s.columns],
@@ -2592,6 +2629,40 @@ class Session(DDLMixin):
             return Result(["Tables"], [(t,) for t in names])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        if s.what == "table_status":
+            # MySQL SHOW TABLE STATUS (reference: infoschema tables
+            # memtable feeding executor/show.go fetchShowTableStatus) —
+            # connectors/BI tools read Name/Rows/Engine/Collation
+            from tidb_tpu.utils.checkeval import sql_like_match
+
+            pat = s.db or "%"
+            cols = [
+                "Name", "Engine", "Version", "Row_format", "Rows",
+                "Avg_row_length", "Data_length", "Auto_increment",
+                "Collation", "Comment",
+            ]
+            rows = []
+            for tn in sorted(self.catalog.tables(self.db)):
+                if not sql_like_match(tn, pat, ci=True):
+                    continue
+                t = self.catalog.table(self.db, tn)
+                n = t.nrows
+                width = sum(
+                    8 if ty.kind != Kind.STRING else 32
+                    for _c, ty in t.schema.columns
+                )
+                rows.append((
+                    tn, "tidb_tpu", 10, "Fixed", n, width, n * width,
+                    t.autoinc_next if t.autoinc_col else None,
+                    "utf8mb4_bin", "",
+                ))
+            for vn in sorted(self.catalog.views(self.db)):
+                if sql_like_match(vn, pat, ci=True):
+                    rows.append((
+                        vn, None, None, None, None, None, None, None,
+                        None, "VIEW",
+                    ))
+            return Result(cols, rows)
         if s.what == "collation":
             # reference: SHOW COLLATION over the collate registry
             from tidb_tpu.utils import collate as _coll

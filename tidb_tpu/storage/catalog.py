@@ -497,6 +497,67 @@ class Catalog:
                  ("queries", INT64)]
             )
             rows = self.resource_groups.rows()
+        elif name == "partitions":
+            # MySQL information_schema.partitions (reference:
+            # pkg/infoschema/tables.go partitionsCols): one row per
+            # partition; unpartitioned tables get one NULL-partition row
+            from tidb_tpu.dtypes import Kind, days_to_date
+
+            schema = TableSchema(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("partition_name", STRING),
+                 ("partition_ordinal_position", INT64),
+                 ("partition_method", STRING),
+                 ("partition_expression", STRING),
+                 ("partition_description", STRING),
+                 ("table_rows", INT64)]
+            )
+            rows = []
+
+            def _desc(t, i):
+                kind, _c, spec = t.partition
+                ptype = t.schema.types.get(t.partition[1])
+
+                def fmt(v):
+                    if v is None:
+                        return "NULL"
+                    if ptype is not None and ptype.kind == Kind.DATE:
+                        return f"'{days_to_date(int(v))}'"
+                    if ptype is not None and ptype.kind == Kind.DECIMAL:
+                        return str(int(v) / 10 ** ptype.scale)
+                    return str(v)
+
+                if kind == "hash":
+                    return None
+                if kind == "list":
+                    return ",".join(fmt(v) for v in spec[i][1])
+                u = spec[i][1]
+                return "MAXVALUE" if u is None else fmt(u)
+
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        t = self._dbs[db][tn]
+                        if t.partition is None:
+                            rows.append(
+                                (db, tn, None, None, None, None, None,
+                                 t.nrows)
+                            )
+                            continue
+                        kind, pcol, _spec = t.partition
+                        per = {}
+                        for b in t.blocks():
+                            per[b.part_id] = (
+                                per.get(b.part_id, 0) + b.nrows
+                            )
+                        for i, pname in enumerate(t.partition_names()):
+                            rows.append(
+                                (db, tn, pname, i + 1, kind.upper(),
+                                 f"`{pcol}`", _desc(t, i),
+                                 per.get(i, 0))
+                            )
         elif name == "top_sql":
             # TopSQL analog (reference: pkg/util/topsql — per-digest CPU
             # time ranking shipped to a collector): here, per-digest

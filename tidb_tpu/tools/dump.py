@@ -58,6 +58,16 @@ def create_table_sql(t) -> str:
             decl = f"decimal(38,{ty.scale})"
         else:
             decl = _TYPE_SQL.get(ty.kind, "varchar(255)")
+        if n in (t.schema.not_null or ()):
+            decl += " not null"
+        dflt = (getattr(t, "defaults", None) or {}).get(n)
+        if dflt is not None:
+            if isinstance(dflt, str):
+                decl += " default " + _sql_literal(dflt, ty)
+            elif isinstance(dflt, bool):
+                decl += f" default {int(dflt)}"
+            elif isinstance(dflt, (int, float)):
+                decl += f" default {dflt}"
         if n == t.autoinc_col:
             decl += " auto_increment"
         for gc, gtxt, gstored in getattr(t, "generated", None) or []:
