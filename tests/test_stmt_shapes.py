@@ -286,3 +286,85 @@ class TestCompatSurface:
         s.execute("create table bs2 like bs")
         s.execute("insert into bs2 (a) values (1)")
         assert s.execute("select b from bs2").rows == [("a\\b",)]
+
+    def test_connector_statements(self, s, tmp_path):
+        s.execute("create table t (a int primary key, b varchar(8))")
+        s.execute("insert into t values (1, 'x'), (2, 'y')")
+        s.execute("set names utf8mb4 collate utf8mb4_general_ci")
+        assert s.execute(
+            "select @@character_set_client"
+        ).rows == [("utf8mb4",)]
+        s.execute("set session transaction isolation level read committed")
+        assert s.execute(
+            "select @@transaction_isolation"
+        ).rows == [("READ-COMMITTED",)]
+        for noop in (
+            "flush privileges", "flush tables", "lock tables t read",
+            "unlock tables",
+        ):
+            assert s.execute(noop).rows == []
+        s.execute("do 1 + 1, sleep(0)")
+        assert s.execute("select a from t order by a for share").rows == [
+            (1,), (2,)
+        ]
+        assert s.execute("show open tables").rows == []
+        st = dict(s.execute("show status like 'Threads%'").rows)
+        assert st["Threads_connected"] == "1"
+        assert len(s.execute("show full processlist").rows) >= 1
+        # DESC <select> = EXPLAIN
+        plan = "\n".join(
+            r[0] for r in s.execute("desc select a from t").rows
+        )
+        assert "Scan" in plan
+        # CHECKSUM TABLE rides the ADMIN CHECKSUM machinery
+        ck = s.execute("checksum table t").rows
+        assert len(ck) == 1 and ck[0][1]
+        opt = s.execute("optimize table t").rows
+        assert opt[-1][3] == "OK"
+
+    def test_into_outfile_and_serial(self, s, tmp_path):
+        s.execute("create table t (a serial, b varchar(4))")
+        s.execute("insert into t (b) values ('x'), (NULL)")
+        out = str(tmp_path / "o.tsv")
+        r = s.execute(f"select a, b from t order by a into outfile '{out}'")
+        assert r.affected == 2
+        assert open(out).read() == "1\tx\n2\t\\N\n"
+        with pytest.raises(Exception, match="exists"):
+            s.execute(f"select a from t into outfile '{out}'")
+        # SERIAL implies AUTO_INCREMENT: NULL generates the next id
+        s.execute("insert into t values (NULL, 'q')")
+        assert s.execute("select max(a) from t").rows == [(3,)]
+
+    def test_show_warnings_lifecycle(self, s):
+        s.execute("create table w (k int primary key)")
+        s.execute("insert ignore into w values (NULL)")
+        assert s.execute("show warnings").rows == [
+            ("Warning", 1048, "Column 'k' cannot be null")
+        ]
+        # diagnostics survive repeated SHOW WARNINGS, clear on the next
+        # ordinary statement
+        assert len(s.execute("show warnings").rows) == 1
+        s.execute("select 1")
+        assert s.execute("show warnings").rows == []
+
+    def test_review_fixes_2(self, s, tmp_path):
+        s.execute("create table t (a int)")
+        s.execute("insert into t values (1), (2)")
+        # UNION writes the outfile too
+        out = str(tmp_path / "u.tsv")
+        r = s.execute(
+            f"select a from t union select 9 into outfile '{out}'"
+        )
+        assert r.affected == 3 and len(open(out).read().splitlines()) == 3
+        # SET NAMES resets collation_connection to the charset default
+        s.execute("set names utf8mb4 collate utf8mb4_general_ci")
+        s.execute("set names latin1")
+        cc = s.execute("select @@collation_connection").rows[0][0]
+        assert "latin1" in cc or cc != "utf8mb4_general_ci"
+        with pytest.raises(Exception, match="[Uu]nknown character set"):
+            s.execute("set names klingon")
+        with pytest.raises(Exception, match="ONLY or WRITE"):
+            s.execute("set session transaction read foo")
+        # outfile existence check fires BEFORE running the query
+        with pytest.raises(Exception, match="exists"):
+            s.execute(f"select a from t into outfile '{out}'")

@@ -171,6 +171,9 @@ class Select:
     distinct: bool = False
     # optimizer hints: ((name, (args...)), ...) from /*+ ... */
     hints: tuple = ()
+    # SELECT ... INTO OUTFILE 'path': write the resultset as TSV
+    # (reference: pkg/executor/select_into.go SelectIntoExec)
+    outfile: object = None
     # SELECT ... FOR UPDATE / LOCK IN SHARE MODE: pessimistic row locks
     # on the read tables (reference: pkg/executor SelectLockExec)
     for_update: bool = False
@@ -385,6 +388,53 @@ class CreateDatabase:
 @dataclasses.dataclass
 class DropDatabase:
     name: str
+
+
+@dataclasses.dataclass
+class SetNames:
+    """SET NAMES <charset> [COLLATE <collation>] — connector handshake
+    statement; maps onto the character_set_* / collation_connection
+    sysvars (reference: pkg/executor/set.go setCharset)."""
+
+    charset: str
+    collation: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SetTransaction:
+    """SET [SESSION|GLOBAL] TRANSACTION ISOLATION LEVEL ... [, READ
+    ONLY|WRITE] (reference: pkg/executor/set.go + sessionctx
+    transaction_isolation)."""
+
+    scope: str
+    isolation: Optional[str] = None
+    access: Optional[str] = None  # 'only' | 'write'
+
+
+@dataclasses.dataclass
+class Do:
+    """DO expr[, ...]: evaluate and discard (side-effect functions
+    like GET_LOCK)."""
+
+    exprs: list
+
+
+@dataclasses.dataclass
+class Noop:
+    """Statements accepted for MySQL-client compatibility with no
+    engine effect (FLUSH ..., LOCK/UNLOCK TABLES — the reference
+    treats table locks as noop with enable-table-lock=false)."""
+
+    what: str
+
+
+@dataclasses.dataclass
+class OptimizeTable:
+    """OPTIMIZE TABLE t[, ...]: recreate+analyze note, MySQL-style
+    resultset (the reference returns the same note via TiDB's
+    'doesn't support optimize' path; here ANALYZE actually runs)."""
+
+    tables: list  # [(db, name)]
 
 
 @dataclasses.dataclass

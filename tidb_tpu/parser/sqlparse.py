@@ -246,6 +246,42 @@ class Parser:
             self.accept_kw("table")
             db, name = self._qualified_name()
             return ast.TruncateTable(db, name)
+        if self._at_ident("do"):
+            self.advance()
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            return ast.Do(exprs)
+        if self._at_ident("flush"):
+            # FLUSH PRIVILEGES/TABLES/STATUS/...: accepted, no effect
+            # (privileges apply immediately here; no table cache)
+            while self.cur.kind != "eof" and not self.at_op(";"):
+                self.advance()
+            return ast.Noop("flush")
+        if self._at_ident("lock"):
+            self.advance()
+            self.expect_kw("tables")
+            while self.cur.kind != "eof" and not self.at_op(";"):
+                self.advance()
+            return ast.Noop("lock_tables")
+        if self._at_ident("unlock"):
+            self.advance()
+            self.expect_kw("tables")
+            return ast.Noop("unlock_tables")
+        if self._at_ident("checksum"):
+            self.advance()
+            self.expect_kw("table")
+            tables = [self._qualified_name()]
+            while self.accept_op(","):
+                tables.append(self._qualified_name())
+            return ast.AdminStmt("checksum_table", tables)
+        if self._at_ident("optimize"):
+            self.advance()
+            self.expect_kw("table")
+            tables = [self._qualified_name()]
+            while self.accept_op(","):
+                tables.append(self._qualified_name())
+            return ast.OptimizeTable(tables)
         if self._at_ident("admin"):
             self.advance()
             word = self.cur.text.lower()  # CHECK/SHOW may lex as kw
@@ -377,10 +413,24 @@ class Parser:
             return ast.DeallocateStmt(self.expect_ident().lower())
         if self._at_ident("describe") or self.at_kw("desc"):
             self.advance()
+            if self.at_kw("select", "with"):
+                return ast.Explain(self.parse_stmt())
             db, name = self._qualified_name()
             return ast.Show("columns", db=f"{db or ''}.{name}")
         if self.at_kw("show"):
             self.advance()
+            if self.at_kw("full"):  # FULL lexes as a keyword (joins)
+                self.advance()  # SHOW FULL PROCESSLIST/COLUMNS/TABLES
+            if self._at_ident("warnings") or self._at_ident("errors"):
+                self.advance()
+                return ast.Show("warnings")
+            if self._at_ident("status"):
+                self.advance()
+                return ast.Show("status", db=self._show_like())
+            if self._at_ident("open"):
+                self.advance()
+                self.expect_kw("tables")
+                return ast.Show("open_tables")
             if self.accept_kw("tables"):
                 return ast.Show("tables")
             if self.at_kw("table") and (
@@ -396,10 +446,11 @@ class Parser:
                 return ast.Show("columns", db=f"{db or ''}.{name}")
             if self.accept_kw("databases"):
                 return ast.Show("databases")
-            if self.accept_kw("global"):
-                self.expect_kw("variables")
-                return ast.Show("variables", db=self._show_like())
-            if self.accept_kw("session"):
+            if self.accept_kw("global") or self.accept_kw("session"):
+                # scope is cosmetic for the memtables behind both
+                if self._at_ident("status"):
+                    self.advance()
+                    return ast.Show("status", db=self._show_like())
                 self.expect_kw("variables")
                 return ast.Show("variables", db=self._show_like())
             if self.accept_kw("variables"):
@@ -576,11 +627,52 @@ class Parser:
             self.advance()
             self._expect_ident_kw("group")
             return ast.SetResourceGroup(self.expect_ident())
+        if self._at_ident("names"):
+            self.advance()
+            charset = self.cur.text
+            self.advance()
+            coll = None
+            if self.accept_kw("collate"):
+                coll = self.cur.text
+                self.advance()
+            return ast.SetNames(charset, coll)
         scope = "session"
         if self.accept_kw("global"):
             scope = "global"
         else:
             self.accept_kw("session")
+        if self.at_kw("transaction"):
+            self.advance()
+            iso = access = None
+            while True:
+                w = self.cur.text.lower()
+                if w == "isolation":
+                    self.advance()
+                    self._expect_ident_kw("level")
+                    w1 = self.cur.text.lower()
+                    self.advance()
+                    if w1 in ("read", "repeatable"):
+                        w2 = self.cur.text.lower()
+                        self.advance()
+                        iso = f"{w1}-{w2}".upper()
+                    else:
+                        iso = w1.upper()
+                elif w == "read":
+                    self.advance()
+                    access = self.cur.text.lower()
+                    if access not in ("only", "write"):
+                        raise ParseError(
+                            "SET TRANSACTION READ expects ONLY or WRITE"
+                        )
+                    self.advance()
+                else:
+                    raise ParseError(
+                        "SET TRANSACTION expects ISOLATION LEVEL or "
+                        "READ ONLY/WRITE"
+                    )
+                if not self.accept_op(","):
+                    break
+            return ast.SetTransaction(scope, iso, access)
         name = self._set_var_name()
         self.expect_op("=")
         val = self.parse_expr()
@@ -799,7 +891,18 @@ class Parser:
             else:
                 limit = a
         for_update = False
-        if self.at_kw("for") and self.toks[self.i + 1].text.lower() == "update":
+        outfile = None
+        if self.accept_kw("into"):
+            if not self._at_ident("outfile"):
+                raise ParseError("expected OUTFILE after INTO")
+            self.advance()
+            if self.cur.kind != "str":
+                raise ParseError("INTO OUTFILE expects a file path string")
+            outfile = self.cur.text
+            self.advance()
+        if self.at_kw("for") and (
+            self.toks[self.i + 1].text.lower() in ("update", "share")
+        ):
             self.advance()
             self.advance()
             for_update = True
@@ -819,6 +922,7 @@ class Parser:
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
             distinct=distinct, hints=hints, for_update=for_update,
+            outfile=outfile,
         )
 
     def parse_int(self) -> int:
@@ -2128,6 +2232,17 @@ class Parser:
                 indexes.append((name_i, icols, elem_unique))
             else:
                 cname = self.expect_ident()
+                if self._at_ident("serial"):
+                    # SERIAL = BIGINT NOT NULL AUTO_INCREMENT UNIQUE
+                    self.advance()
+                    cd = ast.ColumnDef(
+                        cname, INT64, not_null=True, auto_increment=True
+                    )
+                    indexes.append((f"u_{cname}", [cname], True))
+                    cols.append(cd)
+                    if not self.accept_op(","):
+                        break
+                    continue
                 ctype, tmeta = self.parse_type_full()
                 cd = ast.ColumnDef(cname, ctype)
                 cd.enum_members = tmeta.get("enum", ())

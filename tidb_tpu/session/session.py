@@ -258,6 +258,13 @@ class Session(DDLMixin):
         self.conn_id = next(self.catalog._conn_counter)
         reg[self.conn_id] = self
         self._current_stmt: Optional[tuple] = None  # (sql text, t0)
+        # per-statement diagnostics area (SHOW WARNINGS): cleared at
+        # each non-diagnostic statement, rows are (Level, Code, Message)
+        self._warnings: list = []
+        self._stmt_count = 0
+        import time as _time
+
+        self._start_ts = _time.time()
         self._killed_conn = False  # KILL CONNECTION marks, execute raises
         if not hasattr(self.catalog, "resource_groups"):  # old pickles
             from tidb_tpu.utils.resgroup import ResourceGroupManager
@@ -593,6 +600,24 @@ class Session(DDLMixin):
 
         walk(ref)
         return out
+
+    def _take_outfile(self, s):
+        """Pop the INTO OUTFILE path off the statement's final SELECT
+        block (unions/CTEs attach it to their last branch)."""
+        node = s
+        while True:
+            if isinstance(node, ast.With):
+                node = node.body
+            elif isinstance(node, ast.Union):
+                node = node.selects[-1]
+            elif isinstance(node, ast.SetOp):
+                node = node.right
+            else:
+                break
+        f = getattr(node, "outfile", None)
+        if f is not None:
+            node.outfile = None
+        return f
 
     def _for_update_tables(self, s) -> list:
         """Tables to lock for FOR UPDATE, searching every Select block
@@ -1297,6 +1322,13 @@ class Session(DDLMixin):
         self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
         top = self._stmt_depth == 1
         if top:
+            self._stmt_count = getattr(self, "_stmt_count", 0) + 1
+            if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+                self._select_count = getattr(self, "_select_count", 0) + 1
+            # the diagnostics area survives only until the next
+            # non-diagnostic statement (MySQL SHOW WARNINGS semantics)
+            if not (isinstance(s, ast.Show) and s.what == "warnings"):
+                self._warnings = []
             self._current_stmt = (
                 getattr(s, "_source_sql", type(s).__name__), time.time()
             )
@@ -1811,6 +1843,17 @@ class Session(DDLMixin):
         except Exception:
             pass
         if isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+            # SELECT ... INTO OUTFILE (reference: SelectIntoExec,
+            # pkg/executor/select_into.go). The clause parses on the
+            # last SELECT block of a union chain — hoist it here so set
+            # operations write the file too, and existence-check FIRST:
+            # a huge query must not run just to fail on the target path
+            outfile = self._take_outfile(s)
+            if outfile is not None:
+                import os as _os
+
+                if _os.path.exists(outfile):
+                    raise ValueError(f"File '{outfile}' already exists")
             fu = self._for_update_tables(s)
             if fu:
                 # SELECT ... FOR UPDATE (possibly inside WITH/UNION
@@ -1819,6 +1862,14 @@ class Session(DDLMixin):
                 r = self._with_write_locks(fu, lambda: self._run_select(s))
             else:
                 r = self._run_select(s)
+            if outfile is not None:
+                # MySQL default format: tab-separated, \N for NULL
+                with open(outfile, "w", encoding="utf-8") as f:
+                    for row in r.rows:
+                        f.write("\t".join(
+                            r"\N" if v is None else str(v) for v in row
+                        ) + "\n")
+                r = Result([], [], affected=len(r.rows))
         elif isinstance(s, ast.CreateTable) and s.as_query is not None:
             # CREATE TABLE ... AS SELECT: schema derived from the query.
             # Existence check FIRST — don't execute a potentially huge
@@ -2479,10 +2530,73 @@ class Session(DDLMixin):
             self.catalog.drop_database(s.name)
             r = Result([], [])
         elif isinstance(s, ast.UseDatabase):
-            if s.name.lower() not in [d.lower() for d in self.catalog.databases()]:
+            dbl = s.name.lower()
+            if dbl != "information_schema" and dbl not in [
+                d.lower() for d in self.catalog.databases()
+            ]:
                 raise ValueError(f"unknown database {s.name}")
-            self.db = s.name.lower()
+            self.db = dbl
             r = Result([], [])
+        elif isinstance(s, ast.SetNames):
+            # connector handshake (reference: pkg/executor/set.go
+            # setCharset): latch the character_set_*/collation vars;
+            # the engine is utf8mb4-native so this is bookkeeping
+            from tidb_tpu.utils import collate as _coll
+
+            cs = s.charset.lower()
+            coll = (
+                s.collation.lower()
+                if s.collation
+                else _coll.CHARSET_DEFAULTS.get(cs)
+            )
+            if coll is None:
+                raise ValueError(f"Unknown character set: '{cs}'")
+            for v in (
+                "character_set_client", "character_set_connection",
+                "character_set_results",
+            ):
+                self.vars.set(v, cs, "session")
+            self.vars.set("collation_connection", coll, "session")
+            r = Result([], [])
+        elif isinstance(s, ast.SetTransaction):
+            if s.isolation is not None:
+                self.vars.set(
+                    "transaction_isolation", s.isolation, s.scope
+                )
+            if s.access is not None and s.access == "only":
+                self.vars.set("transaction_read_only", 1, s.scope)
+            elif s.access == "write":
+                self.vars.set("transaction_read_only", 0, s.scope)
+            r = Result([], [])
+        elif isinstance(s, ast.Do):
+            # evaluate and discard (side effects like GET_LOCK run)
+            q = ast.Select(
+                items=[
+                    ast.SelectItem(e, alias=f"_do{i}")
+                    for i, e in enumerate(s.exprs)
+                ],
+                from_=None,
+            )
+            self._run_select(self._resolve_session_funcs(q))
+            r = Result([], [])
+        elif isinstance(s, ast.Noop):
+            r = Result([], [])
+        elif isinstance(s, ast.OptimizeTable):
+            rows = []
+            for db_, name_ in s.tables:
+                db_ = db_ or self.db
+                self.catalog.table(db_, name_)  # existence check
+                self._execute_stmt_inner(
+                    ast.AnalyzeTable(db_, name_), t0
+                )
+                full = f"{db_}.{name_}"
+                rows.append((
+                    full, "optimize", "note",
+                    "Table does not support optimize, doing recreate + "
+                    "analyze instead",
+                ))
+                rows.append((full, "optimize", "status", "OK"))
+            r = Result(["Table", "Op", "Msg_type", "Msg_text"], rows)
         elif isinstance(s, ast.Insert):
             r = self._with_write_locks(
                 [(s.db or self.db, s.table)], lambda: self._run_insert(s)
@@ -2629,6 +2743,39 @@ class Session(DDLMixin):
             return Result(["Tables"], [(t,) for t in names])
         if s.what == "databases":
             return Result(["Databases"], [(d,) for d in self.catalog.databases()])
+        if s.what == "warnings":
+            return Result(
+                ["Level", "Code", "Message"], list(self._warnings)
+            )
+        if s.what == "open_tables":
+            return Result(["Database", "Table", "In_use", "Name_locked"], [])
+        if s.what == "status":
+            # minimal MySQL-compatible status variables (reference:
+            # infoschema session_status memtable); monitoring tools read
+            # Uptime/Questions/Threads_connected
+            import time as _time
+
+            from tidb_tpu.utils.checkeval import sql_like_match
+            from tidb_tpu.utils.metrics import REGISTRY as _REG
+
+            pat = s.db or "%"
+            uptime = int(_time.time() - getattr(self, "_start_ts", _time.time()))
+            reg = getattr(self.catalog, "_session_registry", {})
+            alive = sum(1 for cid in list(reg) if reg.get(cid) is not None)
+            stats = [
+                ("Uptime", uptime),
+                ("Threads_connected", max(alive, 1)),
+                ("Questions", getattr(self, "_stmt_count", 0)),
+                ("Com_select", getattr(self, "_select_count", 0)),
+                ("Ssl_cipher", ""),
+            ]
+            return Result(
+                ["Variable_name", "Value"],
+                [
+                    (k, str(v)) for k, v in stats
+                    if sql_like_match(k, pat, ci=True)
+                ],
+            )
         if s.what == "table_status":
             # MySQL SHOW TABLE STATUS (reference: infoschema tables
             # memtable feeding executor/show.go fetchShowTableStatus) —
@@ -3818,6 +3965,10 @@ class Session(DDLMixin):
                 for i, z in pk_idx:
                     if r[i] is None:
                         r[i] = z
+                        self._warnings.append((
+                            "Warning", 1048,
+                            f"Column '{names[i]}' cannot be null",
+                        ))
             fixed.append(r)
         return fixed
 
