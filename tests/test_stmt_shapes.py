@@ -368,3 +368,47 @@ class TestCompatSurface:
         # outfile existence check fires BEFORE running the query
         with pytest.raises(Exception, match="exists"):
             s.execute(f"select a from t into outfile '{out}'")
+
+    def test_multi_spec_alter(self, s):
+        s.execute("create table t (a int, b int)")
+        s.execute("insert into t values (1, 2)")
+        s.execute(
+            "alter table t add column c int default 9, add index ic (c), "
+            "alter column b set default 5, drop index ic, "
+            "add index ic2 (a, c)"
+        )
+        s.execute("insert into t (a) values (3)")
+        assert s.execute("select a, b, c from t order by a").rows == [
+            (1, 2, 9), (3, 5, 9),
+        ]
+        # whole statement rolls back when a later spec fails
+        with pytest.raises(Exception):
+            s.execute(
+                "alter table t add column d int, add column d int"
+            )
+        assert "d" not in [
+            r[0] for r in s.execute("show columns from t").rows
+        ]
+        s.execute("alter table t alter column b drop default")
+        ddl = s.execute("show create table t").rows[0][1].lower()
+        assert "b` bigint" in ddl and "default 5" not in ddl
+
+    def test_alter_drop_index(self, s):
+        s.execute("create table t (a int)")
+        s.execute("alter table t add index ia (a)")
+        s.execute("alter table t drop index ia")
+        assert all(
+            "ia" not in r for r in s.execute("show index from t").rows
+        )
+
+    def test_multi_alter_guards(self, s):
+        s.execute("create table t (a int)")
+        with pytest.raises(Exception, match="combined"):
+            s.execute("alter table t rename to t9, add column b int")
+        with pytest.raises(Exception, match="Invalid default"):
+            s.execute("alter table t add column c int, "
+                      "alter column c set default 'abc'")
+        # negative defaults parse in every DEFAULT position
+        s.execute("alter table t add column d int default -1")
+        s.execute("insert into t (a) values (1)")
+        assert s.execute("select d from t").rows == [(-1,)]

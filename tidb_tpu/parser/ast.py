@@ -339,6 +339,17 @@ class AlterTable:
 
 
 @dataclasses.dataclass
+class MultiAlter:
+    """ALTER TABLE with comma-separated actions (MySQL multi-spec; the
+    reference's multi-schema change, pkg/ddl/multi_schema_change.go).
+    Applied in order with whole-statement rollback on failure."""
+
+    db: Optional[str]
+    name: str
+    specs: list  # AlterTable | CreateIndex | DropIndex
+
+
+@dataclasses.dataclass
 class AdminStmt:
     """ADMIN CHECK TABLE t[, ...] / ADMIN CHECK INDEX t idx / ADMIN
     SHOW DDL JOBS (reference: pkg/executor/admin.go:46,

@@ -2266,12 +2266,7 @@ class Parser:
                     elif self.accept_kw("auto_increment"):
                         cd.auto_increment = True
                     elif self.accept_kw("default"):
-                        d = self.parse_primary()
-                        if isinstance(d, ast.Call) and d.op == "neg" and isinstance(d.args[0], ast.Const):
-                            d = ast.Const(-d.args[0].value)
-                        if not isinstance(d, ast.Const):
-                            raise ParseError("DEFAULT must be a constant")
-                        cd.default = d.value
+                        cd.default = self._default_const().value
                     elif self.accept_kw("collate"):
                         from tidb_tpu.utils import collate as _coll
 
@@ -2490,6 +2485,46 @@ class Parser:
             )
         self.expect_kw("table")
         db, name = self._qualified_name()
+        specs = [self._parse_alter_spec(db, name)]
+        while self.accept_op(","):
+            specs.append(self._parse_alter_spec(db, name))
+        if len(specs) == 1:
+            return specs[0]
+        return ast.MultiAlter(db, name, specs)
+
+    def _default_const(self):
+        """DEFAULT <literal> with negative-number folding — one grammar
+        for every DEFAULT site (column tail, SET DEFAULT)."""
+        neg = self.accept_op("-")
+        d = self.parse_primary()
+        if not isinstance(d, ast.Const):
+            raise ParseError("DEFAULT must be a constant")
+        if neg:
+            if not isinstance(d.value, (int, float)):
+                raise ParseError("DEFAULT must be a constant")
+            d = ast.Const(-d.value)
+        return d
+
+    def _parse_alter_spec(self, db, name):
+        """One comma-separated ALTER TABLE action (MySQL multi-spec /
+        the reference's multi-schema change, pkg/ddl multiSchemaChange)."""
+        if self.accept_kw("alter"):
+            # ALTER [COLUMN] c SET DEFAULT <const> | DROP DEFAULT
+            self.accept_kw("column")
+            cname = self.expect_ident()
+            if self.accept_kw("set"):
+                self.expect_kw("default")
+                d = self._default_const()
+                return ast.AlterTable(
+                    db, name, "set_default", col_name=cname,
+                    default=d.value,
+                )
+            if self.accept_kw("drop"):
+                self.expect_kw("default")
+                return ast.AlterTable(
+                    db, name, "drop_default", col_name=cname
+                )
+            raise ParseError("ALTER COLUMN expects SET/DROP DEFAULT")
         if self.accept_kw("add"):
             if self.at_kw("unique", "index", "key"):
                 unique = self.accept_kw("unique")
@@ -2523,6 +2558,8 @@ class Parser:
                     db, name, "drop_partition",
                     partitions=self._partition_name_list(),
                 )
+            if self.accept_kw("index") or self.accept_kw("key"):
+                return ast.DropIndex(db, name, self.expect_ident())
             self.accept_kw("column")
             return ast.AlterTable(db, name, "drop", col_name=self.expect_ident())
         if self._at_ident("truncate"):  # "truncate" lexes as an ident
@@ -2597,10 +2634,7 @@ class Parser:
             elif self.accept_kw("null"):
                 pass
             elif self.accept_kw("default"):
-                d = self.parse_primary()
-                if not isinstance(d, ast.Const):
-                    raise ParseError("DEFAULT must be a constant")
-                default = d.value
+                default = self._default_const().value
             elif self._at_generated_clause():
                 generated = self._parse_generated_clause()
             else:

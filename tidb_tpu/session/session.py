@@ -2241,8 +2241,39 @@ class Session(DDLMixin):
                             "" if s.column.type.kind == Kind.STRING else 0
                         )
                     t.alter_add_column(s.column.name, s.column.type, default)
+                    if s.default is not None:
+                        # the DEFAULT applies to FUTURE inserts too, not
+                        # just the backfill of existing rows
+                        coerced = self._gen_coerce(
+                            s.default, s.column.type
+                        )
+                        if coerced is None:
+                            raise ValueError(
+                                "Invalid default value for "
+                                f"{s.column.name!r}"
+                            )
+                        if not hasattr(t, "defaults"):
+                            t.defaults = {}
+                        t.defaults[s.column.name.lower()] = coerced
             elif s.action in ("modify", "change"):
                 self._run_modify_column(t, s)
+            elif s.action == "set_default":
+                cn = s.col_name.lower()
+                if cn not in t.schema.types:
+                    raise ValueError(f"unknown column {cn!r}")
+                coerced = self._gen_coerce(s.default, t.schema.types[cn])
+                if coerced is None and s.default is not None:
+                    raise ValueError(f"Invalid default value for {cn!r}")
+                if not hasattr(t, "defaults"):
+                    t.defaults = {}
+                t.defaults[cn] = coerced
+                t.bump_version()
+            elif s.action == "drop_default":
+                cn = s.col_name.lower()
+                if cn not in t.schema.types:
+                    raise ValueError(f"unknown column {cn!r}")
+                getattr(t, "defaults", {}).pop(cn, None)
+                t.bump_version()
             elif s.action == "rename_col":
                 self._guard_column_refs(
                     t, s.db or self.db, s.name, s.col_name.lower(), "rename"
@@ -2364,6 +2395,73 @@ class Session(DDLMixin):
             self.catalog.schema_version += 1
             clear_scan_cache()
             r = Result([], [])
+        elif isinstance(s, ast.MultiAlter):
+            # comma-separated ALTER actions (reference:
+            # pkg/ddl/multi_schema_change.go — atomic): snapshot every
+            # DDL-visible table attribute, apply the specs in order
+            # under the table write lock, restore wholesale if any spec
+            # fails. Specs whose effects escape the one-table snapshot
+            # (RENAME, partition management) are rejected in combination
+            # — the reference's multi-schema change restricts the same
+            # way (table options/renames don't combine)
+            for spec in s.specs:
+                act = getattr(spec, "action", None)
+                if act in (
+                    "rename", "add_partition", "drop_partition",
+                    "truncate_partition", "exchange_partition",
+                ):
+                    raise ValueError(
+                        f"ALTER action {act!r} cannot be combined with "
+                        "other specs in one statement"
+                    )
+            t = self.catalog.table(s.db or self.db, s.name)
+
+            def _multi_alter(t=t):
+                snap = {
+                    "schema": t.schema,
+                    "indexes": {k: list(v) for k, v in t.indexes.items()},
+                    "unique_indexes": set(t.unique_indexes),
+                    "index_states": dict(t.index_states),
+                    "defaults": dict(getattr(t, "defaults", {}) or {}),
+                    "generated": list(getattr(t, "generated", None) or []),
+                    "checks": list(t.checks),
+                    "partition": t.partition,
+                    "autoinc": (t.autoinc_col, t.autoinc_next),
+                    "blocks": list(t.blocks()),
+                    "dictionaries": dict(t.dictionaries),
+                }
+                # nested-statement depth: spec execution must not run
+                # the top-level prologue (killer.clear/deadline reset —
+                # a KILL landing between specs would be swallowed)
+                self._stmt_depth = getattr(self, "_stmt_depth", 0) + 1
+                try:
+                    for spec in s.specs:
+                        self._execute_stmt_inner(spec, t0)
+                except BaseException:
+                    t.schema = snap["schema"]
+                    t.indexes = snap["indexes"]
+                    t.unique_indexes = snap["unique_indexes"]
+                    t.index_states = snap["index_states"]
+                    t.defaults = snap["defaults"]
+                    t.generated = snap["generated"]
+                    t._gen_exprs = None
+                    t.checks = snap["checks"]
+                    t.partition = snap["partition"]
+                    t.autoinc_col, t.autoinc_next = snap["autoinc"]
+                    t.dictionaries = snap["dictionaries"]
+                    t.replace_blocks(snap["blocks"], modified_rows=0)
+                    self.catalog.schema_version += 1
+                    clear_scan_cache()
+                    raise
+                finally:
+                    self._stmt_depth -= 1
+                self.catalog.schema_version += 1
+                clear_scan_cache()
+                return Result([], [])
+
+            r = self._with_write_locks(
+                [(s.db or self.db, s.name)], _multi_alter
+            )
         elif isinstance(s, ast.CreateBinding):
             self._require_super()
             from tidb_tpu.utils.metrics import sql_digest
