@@ -108,3 +108,64 @@ def test_alter_add_not_null_fills_type_default(sess):
     ]
     sess.execute("alter table t add column d bigint default 7 not null")
     assert sess.must_query("select d from t where k = 1").rows == [(7,)]
+
+
+class TestORMIntrospection:
+    """information_schema.table_constraints / key_column_usage /
+    referential_constraints / views — the memtables ORMs (SQLAlchemy,
+    Prisma) introspect (reference: pkg/infoschema/tables.go
+    tableConstraintsCols / keyColumnUsageCols / referConstCols)."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("create database orm")
+        sess.execute("use orm")
+        sess.execute(
+            "create table p (pk int primary key, u int, "
+            "unique index iu (u), "
+            "constraint cpos check (u > 0))"
+        )
+        sess.execute(
+            "create table c (id int, r int, constraint fr foreign key "
+            "(r) references p (pk) on delete cascade on update set null)"
+        )
+        sess.execute("create view v1 as select pk from p")
+        return sess
+
+    def test_table_constraints(self, s):
+        rows = s.execute(
+            "select constraint_name, constraint_type from "
+            "information_schema.table_constraints "
+            "where table_schema = 'orm' order by constraint_name"
+        ).rows
+        assert ("PRIMARY", "PRIMARY KEY") in rows
+        assert ("iu", "UNIQUE") in rows
+        assert ("fr", "FOREIGN KEY") in rows
+        assert ("cpos", "CHECK") in rows
+
+    def test_key_column_usage(self, s):
+        rows = s.execute(
+            "select constraint_name, table_name, column_name, "
+            "referenced_table_name, referenced_column_name from "
+            "information_schema.key_column_usage "
+            "where table_schema = 'orm' order by constraint_name"
+        ).rows
+        assert ("PRIMARY", "p", "pk", None, None) in rows
+        assert ("fr", "c", "r", "p", "pk") in rows
+        assert ("iu", "p", "u", None, None) in rows
+
+    def test_referential_constraints(self, s):
+        rows = s.execute(
+            "select constraint_name, update_rule, delete_rule, "
+            "table_name, referenced_table_name from "
+            "information_schema.referential_constraints"
+        ).rows
+        assert rows == [("fr", "SET NULL", "CASCADE", "c", "p")]
+
+    def test_views(self, s):
+        rows = s.execute(
+            "select table_name, view_definition from "
+            "information_schema.views where table_schema = 'orm'"
+        ).rows
+        assert rows == [("v1", "select pk from p")]

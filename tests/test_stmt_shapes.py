@@ -412,3 +412,25 @@ class TestCompatSurface:
         s.execute("alter table t add column d int default -1")
         s.execute("insert into t (a) values (1)")
         assert s.execute("select d from t").rows == [(-1,)]
+
+    def test_add_column_invalid_default_atomic(self, s):
+        s.execute("create table t (a int)")
+        s.execute("insert into t values (1)")
+        with pytest.raises(Exception, match="Invalid default"):
+            s.execute("alter table t add column c int default 'abc'")
+        assert [r[0] for r in s.execute("show columns from t").rows] == [
+            "a"
+        ]
+
+    def test_drop_partition_then_spec_reports_combination(self, s):
+        s.execute(
+            "create table pt (a int, d int) partition by range (d) ("
+            "partition p0 values less than (10), "
+            "partition p1 values less than (20))"
+        )
+        with pytest.raises(Exception, match="combined"):
+            s.execute("alter table pt drop partition p0, add column b int")
+        assert s.execute(
+            "select count(*) from information_schema.partitions "
+            "where table_name = 'pt'"
+        ).rows == [(2,)]

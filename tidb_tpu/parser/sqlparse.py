@@ -2468,8 +2468,23 @@ class Parser:
         return parts
 
     def _partition_name_list(self):
+        """Comma-separated partition names; a comma followed by another
+        ALTER action keyword ends the list (the spec loop then reports
+        the cannot-combine error instead of a bogus parse failure)."""
         names = [self.expect_ident().lower()]
-        while self.accept_op(","):
+        while True:
+            mark = self.i
+            if not self.accept_op(","):
+                break
+            if (
+                self.cur.kind == "kw"
+                and self.cur.text in ("add", "drop", "alter", "change")
+            ) or any(
+                self._at_ident(w)
+                for w in ("modify", "rename", "truncate", "exchange")
+            ):
+                self.i = mark  # leave the comma for the spec loop
+                break
             names.append(self.expect_ident().lower())
         return names
 

@@ -2235,15 +2235,11 @@ class Session(DDLMixin):
                     self._alter_add_generated(t, s)
                 else:
                     default = s.default
-                    if default is None and s.column.not_null:
-                        # MySQL fills the type default for NOT NULL adds
-                        default = (
-                            "" if s.column.type.kind == Kind.STRING else 0
-                        )
-                    t.alter_add_column(s.column.name, s.column.type, default)
+                    coerced = None
                     if s.default is not None:
-                        # the DEFAULT applies to FUTURE inserts too, not
-                        # just the backfill of existing rows
+                        # validate the literal BEFORE any mutation — an
+                        # invalid default must not leave a half-added
+                        # column behind (MySQL: Invalid default value)
                         coerced = self._gen_coerce(
                             s.default, s.column.type
                         )
@@ -2252,6 +2248,16 @@ class Session(DDLMixin):
                                 "Invalid default value for "
                                 f"{s.column.name!r}"
                             )
+                        default = coerced
+                    if default is None and s.column.not_null:
+                        # MySQL fills the type default for NOT NULL adds
+                        default = (
+                            "" if s.column.type.kind == Kind.STRING else 0
+                        )
+                    t.alter_add_column(s.column.name, s.column.type, default)
+                    if coerced is not None:
+                        # the DEFAULT applies to FUTURE inserts too, not
+                        # just the backfill of existing rows
                         if not hasattr(t, "defaults"):
                             t.defaults = {}
                         t.defaults[s.column.name.lower()] = coerced

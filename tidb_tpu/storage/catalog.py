@@ -437,6 +437,118 @@ class Catalog:
                         (r["time"], "kill", r["conn_id"], r["rss"],
                          r["limit"], r["sql"])
                     )
+        elif name == "table_constraints":
+            # MySQL information_schema.table_constraints (reference:
+            # pkg/infoschema/tables.go tableConstraintsCols) — ORMs
+            # introspect PK/UNIQUE/FK/CHECK presence here
+            schema = TableSchema(
+                [("constraint_schema", STRING),
+                 ("constraint_name", STRING),
+                 ("table_schema", STRING), ("table_name", STRING),
+                 ("constraint_type", STRING)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        t = self._dbs[db][tn]
+                        if t.schema.primary_key:
+                            rows.append(
+                                (db, "PRIMARY", db, tn, "PRIMARY KEY")
+                            )
+                        for iname in sorted(t.unique_indexes):
+                            rows.append((db, iname, db, tn, "UNIQUE"))
+                        for nm, *_rest in t.fks:
+                            rows.append((db, nm, db, tn, "FOREIGN KEY"))
+                        for nm, _txt in t.checks:
+                            rows.append((db, nm, db, tn, "CHECK"))
+        elif name == "key_column_usage":
+            # ORM FK/PK introspection (reference: keyColumnUsageCols)
+            schema = TableSchema(
+                [("constraint_name", STRING), ("table_schema", STRING),
+                 ("table_name", STRING), ("column_name", STRING),
+                 ("ordinal_position", INT64),
+                 ("referenced_table_schema", STRING),
+                 ("referenced_table_name", STRING),
+                 ("referenced_column_name", STRING)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        t = self._dbs[db][tn]
+                        for i, c in enumerate(
+                            t.schema.primary_key or [], 1
+                        ):
+                            rows.append(
+                                ("PRIMARY", db, tn, c, i, None, None,
+                                 None)
+                            )
+                        for iname in sorted(t.unique_indexes):
+                            for i, c in enumerate(
+                                t.indexes.get(iname) or [], 1
+                            ):
+                                rows.append(
+                                    (iname, db, tn, c, i, None, None,
+                                     None)
+                                )
+                        for nm, col, rdb, rtbl, rcol in t.fks:
+                            rows.append(
+                                (nm, db, tn, col, 1, (rdb or db),
+                                 rtbl, rcol)
+                            )
+        elif name == "referential_constraints":
+            # FK actions (reference: referConstCols); ON UPDATE/DELETE
+            # rules surface the engine's registered referential actions
+            schema = TableSchema(
+                [("constraint_schema", STRING),
+                 ("constraint_name", STRING),
+                 ("unique_constraint_schema", STRING),
+                 ("update_rule", STRING), ("delete_rule", STRING),
+                 ("table_name", STRING),
+                 ("referenced_table_name", STRING)]
+            )
+            rows = []
+
+            def rule(act):
+                # unspecified FK actions surface as NO ACTION (MySQL
+                # parity; the engine enforces them as restrict either way)
+                return {
+                    "cascade": "CASCADE", "set_null": "SET NULL",
+                    "restrict": "RESTRICT",
+                }.get(act, "NO ACTION")
+
+            with self._lock:
+                for db in sorted(self._dbs):
+                    if db.startswith("_"):
+                        continue
+                    for tn in sorted(self._dbs[db]):
+                        t = self._dbs[db][tn]
+                        for nm, _col, rdb, rtbl, _rcol in t.fks:
+                            rows.append((
+                                db, nm, (rdb or db),
+                                rule(t.fk_update_actions.get(nm.lower())),
+                                rule(t.fk_actions.get(nm.lower())),
+                                tn, rtbl,
+                            ))
+        elif name == "views":
+            schema = TableSchema(
+                [("table_schema", STRING), ("table_name", STRING),
+                 ("view_definition", STRING), ("definer", STRING)]
+            )
+            rows = []
+            with self._lock:
+                for db in sorted(self._views):
+                    for vn in sorted(self._views.get(db, {})):
+                        vdef = self._views[db][vn]
+                        rows.append(
+                            (db, vn, vdef[0],
+                             vdef[2] if len(vdef) > 2 else "root")
+                        )
         elif name == "sequences":
             # "start_value" (not the reference's START): START is a
             # reserved word in this parser and would be unselectable
