@@ -2476,13 +2476,35 @@ class Parser:
             mark = self.i
             if not self.accept_op(","):
                 break
-            if (
-                self.cur.kind == "kw"
-                and self.cur.text in ("add", "drop", "alter", "change")
-            ) or any(
-                self._at_ident(w)
-                for w in ("modify", "rename", "truncate", "exchange")
-            ):
+            # a partition NAME here is followed by ',' or end-of-spec;
+            # an ACTION word is followed by its own grammar — peek one
+            # token so partitions legitimately named modify/exchange/...
+            # still parse while ', change column ...' ends the list
+            nxt = self.toks[self.i + 1]
+            looks_action = (
+                (
+                    self.cur.kind == "kw"
+                    and self.cur.text in ("add", "drop", "alter")
+                )
+                or (
+                    (self._at_ident("change") or self._at_ident("modify"))
+                    and nxt.kind in ("id", "kw")
+                )
+                or (
+                    self._at_ident("rename")
+                    and nxt.kind == "kw"
+                    and nxt.text in ("to", "as", "column")
+                )
+                or (
+                    (
+                        self._at_ident("truncate")
+                        or self._at_ident("exchange")
+                    )
+                    and nxt.kind == "kw"
+                    and nxt.text == "partition"
+                )
+            )
+            if looks_action:
                 self.i = mark  # leave the comma for the spec loop
                 break
             names.append(self.expect_ident().lower())
