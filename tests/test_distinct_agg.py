@@ -152,3 +152,70 @@ class TestGroupConcat:
         s = Session()
         s.execute("create table e (a int, c varchar(10))")
         assert s.execute("select group_concat(c) from e").rows == [(None,)]
+
+
+class TestRollup:
+    """GROUP BY ... WITH ROLLUP (reference: the planner's rollup expand
+    feeding TiFlash's Expand operator): super-aggregate rows per group
+    prefix, dropped keys NULL, each level exact over the base input."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("create database ru")
+        sess.execute("use ru")
+        sess.execute(
+            "create table sales (region varchar(6), prod varchar(6), "
+            "amt int)"
+        )
+        sess.execute(
+            "insert into sales values ('e','a',1),('e','b',2),"
+            "('w','a',4),('w','b',8),('w','b',16)"
+        )
+        return sess
+
+    def test_two_level_rollup(self, s):
+        rows = s.execute(
+            "select region, prod, sum(amt), count(*) from sales "
+            "group by region, prod with rollup order by region, prod"
+        ).rows
+        assert rows == [
+            (None, None, 31, 5),
+            ("e", None, 3, 2),
+            ("e", "a", 1, 1),
+            ("e", "b", 2, 1),
+            ("w", None, 28, 3),
+            ("w", "a", 4, 1),
+            ("w", "b", 24, 2),
+        ]
+
+    def test_single_key_avg(self, s):
+        rows = s.execute(
+            "select region, avg(amt) from sales group by region "
+            "with rollup order by region"
+        ).rows
+        assert rows == [(None, 6.2), ("e", 1.5), ("w", 28 / 3)]
+
+    def test_having_applies_to_all_levels(self, s):
+        rows = s.execute(
+            "select region, prod, sum(amt) from sales "
+            "group by region, prod with rollup "
+            "having sum(amt) > 20 order by region, prod"
+        ).rows
+        assert rows == [(None, None, 31), ("w", None, 28), ("w", "b", 24)]
+
+    def test_mesh_parity(self, s):
+        from tidb_tpu.session import Session as S2
+
+        mesh = S2(s.catalog, db="ru", mesh_devices=8)
+        q = ("select region, prod, sum(amt) from sales "
+             "group by region, prod with rollup order by region, prod")
+        assert mesh.execute(q).rows == s.execute(q).rows
+
+    def test_rollup_empty_input(self, s):
+        s.execute("create table e (a int, v int)")
+        assert s.execute(
+            "select a, count(*), sum(v) from e group by a with rollup"
+        ).rows == []
+        # plain scalar aggregate still returns its one row
+        assert s.execute("select count(*) from e").rows == [(0,)]

@@ -174,6 +174,8 @@ class Select:
     # SELECT ... INTO OUTFILE 'path': write the resultset as TSV
     # (reference: pkg/executor/select_into.go SelectIntoExec)
     outfile: object = None
+    # GROUP BY ... WITH ROLLUP (super-aggregate rows per key prefix)
+    rollup: bool = False
     # SELECT ... FOR UPDATE / LOCK IN SHARE MODE: pessimistic row locks
     # on the read tables (reference: pkg/executor SelectLockExec)
     for_update: bool = False

@@ -869,11 +869,15 @@ class Parser:
             from_ = self.parse_table_refs()
         where = self.parse_expr() if self.accept_kw("where") else None
         group_by: List[object] = []
+        rollup = False
         if self.accept_kw("group"):
             self.expect_kw("by")
             group_by.append(self.parse_expr())
             while self.accept_op(","):
                 group_by.append(self.parse_expr())
+            if self.accept_kw("with"):
+                self._expect_ident_kw("rollup")
+                rollup = True
         having = self.parse_expr() if self.accept_kw("having") else None
         order_by: List[ast.OrderItem] = []
         if self.accept_kw("order"):
@@ -922,7 +926,7 @@ class Parser:
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
             distinct=distinct, hints=hints, for_update=for_update,
-            outfile=outfile,
+            outfile=outfile, rollup=rollup,
         )
 
     def parse_int(self) -> int:

@@ -1706,7 +1706,10 @@ def build_select(
             group_by.append(g)
 
     if grouped:
-        plan, rewrite = _build_aggregate(b, plan, group_by, agg_calls)
+        plan, rewrite = _build_aggregate(
+            b, plan, group_by, agg_calls,
+            rollup=bool(getattr(sel, "rollup", False)),
+        )
     else:
         rewrite = {}
 
@@ -3193,9 +3196,15 @@ def _ast_key(e) -> str:
     return repr(e)
 
 
-def _build_aggregate(b, plan, group_by, agg_calls):
+def _build_aggregate(b, plan, group_by, agg_calls, rollup=False):
     """Insert Aggregate node; return (plan, rewrite map ast-key ->
-    (output internal name, type))."""
+    (output internal name, type)). rollup=True (GROUP BY ... WITH
+    ROLLUP, reference: pkg/planner/core expand for rollup /
+    pkg/executor with TiFlash Expand): the result is the UNION ALL of
+    the full grouping plus every group-key prefix, dropped keys
+    presented as NULL — each level aggregates the base input
+    independently, which is exact for every supported aggregate and
+    lets common-subtree sharing compile the shared scan once."""
     binder = ExprBinder(plan.schema)
     rewrite: Dict[str, Tuple[str, SQLType]] = {}
     group_exprs: List[Tuple[str, Expr]] = []
@@ -3265,6 +3274,33 @@ def _build_aggregate(b, plan, group_by, agg_calls):
             agg_plan = _expand_distinct_aggs(plan, group_exprs, aggs, out_cols)
     else:
         agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
+    if rollup and group_exprs:
+        gnames = {n for n, _g in group_exprs}
+        agg_refs = [
+            (c.internal, ColumnRef(type=c.type, name=c.internal))
+            for c in agg_plan.schema.cols
+            if c.internal not in gnames
+        ]
+        children = [agg_plan]
+        for j in range(len(group_exprs) - 1, -1, -1):
+            # the grand-total level grouped by NOTHING would emit one
+            # row even over empty input (scalar-aggregate semantics);
+            # MySQL returns an empty set for rollup over no rows, so
+            # group by a constant instead — zero groups when empty
+            sub_groups = group_by[:j] if j else [ast.Const(1)]
+            sub, _ = _build_aggregate(b, plan, sub_groups, agg_calls)
+            exprs = []
+            for i, (n, g) in enumerate(group_exprs):
+                exprs.append((
+                    n,
+                    ColumnRef(type=g.type, name=n)
+                    if i < j
+                    else Literal(type=g.type, value=None),
+                ))
+            children.append(
+                Projection(agg_plan.schema, sub, exprs + agg_refs)
+            )
+        agg_plan = UnionAll(agg_plan.schema, children)
     return agg_plan, rewrite
 
 
