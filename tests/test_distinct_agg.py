@@ -219,3 +219,28 @@ class TestRollup:
         ).rows == []
         # plain scalar aggregate still returns its one row
         assert s.execute("select count(*) from e").rows == [(0,)]
+
+    def test_grouping_function(self, s):
+        s.execute("create table g (a varchar(4), v int)")
+        s.execute("insert into g values ('x', 1), (NULL, 2), ('x', 4)")
+        rows = s.execute(
+            "select a, grouping(a), sum(v) from g group by a with rollup "
+            "order by grouping(a), a"
+        ).rows
+        # the genuine NULL group keeps grouping()=0; the super row is 1
+        assert rows == [(None, 0, 2), ("x", 0, 5), (None, 1, 7)]
+        assert s.execute(
+            "select sum(v) from g group by a with rollup "
+            "having grouping(a) = 1"
+        ).rows == [(7,)]
+        rows = s.execute(
+            "select region, prod, grouping(region), grouping(prod), "
+            "sum(amt) from sales group by region, prod with rollup "
+            "having grouping(region) + grouping(prod) > 0 "
+            "order by region, prod"
+        ).rows
+        assert rows == [
+            (None, None, 1, 1, 31),
+            ("e", None, 0, 1, 3),
+            ("w", None, 0, 1, 28),
+        ]

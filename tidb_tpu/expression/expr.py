@@ -400,6 +400,11 @@ def _infer(op: str, args: Tuple[Expr, ...], declared: Optional[SQLType]) -> SQLT
         if t0.kind == Kind.DECIMAL and digits > 0:
             return DECIMAL(digits)
         return INT64
+    if op == "grouping":
+        raise ValueError(
+            "GROUPING() requires GROUP BY ... WITH ROLLUP and its "
+            "argument must be a single group-key expression"
+        )
     raise NotImplementedError(f"type inference for op {op!r}")
 
 

@@ -3036,6 +3036,11 @@ def _rewrite_aggs(e, rewrite: Dict):
         name, typ = rewrite[key]
         return ast.Name(None, name)
     if isinstance(e, ast.Call):
+        if e.op == "grouping":
+            raise PlanError(
+                "GROUPING() requires GROUP BY ... WITH ROLLUP and its "
+                "argument must be a single group-key expression"
+            )
         return ast.Call(e.op, [_rewrite_aggs(a, rewrite) for a in e.args], e.cast_type)
     if isinstance(e, ast.AggCall):
         raise PlanError("aggregate expression not in rewrite map (nested aggs?)")
@@ -3275,14 +3280,42 @@ def _build_aggregate(b, plan, group_by, agg_calls, rollup=False):
     else:
         agg_plan = Aggregate(Schema(out_cols), plan, group_exprs, aggs)
     if rollup and group_exprs:
+        k = len(group_exprs)
         gnames = {n for n, _g in group_exprs}
         agg_refs = [
             (c.internal, ColumnRef(type=c.type, name=c.internal))
             for c in agg_plan.schema.cols
             if c.internal not in gnames
         ]
-        children = [agg_plan]
-        for j in range(len(group_exprs) - 1, -1, -1):
+        # GROUPING(g): 1 on levels where g was rolled away, 0 where it
+        # grouped — a per-child CONSTANT lane, referenced via the
+        # rewrite map (reference: GROUPING under rollup expand)
+        grp_cols = [
+            OutCol(None, f"_grp{i}", f"_grp{i}", INT64) for i in range(k)
+        ]
+        u_schema = Schema(list(agg_plan.schema.cols) + grp_cols)
+        for i, g_ast in enumerate(group_by):
+            rewrite[_ast_key(ast.Call("grouping", [g_ast]))] = (
+                f"_grp{i}", INT64,
+            )
+
+        def grp_lits(level):
+            return [
+                (
+                    f"_grp{i}",
+                    Literal(type=INT64, value=0 if i < level else 1),
+                )
+                for i in range(k)
+            ]
+
+        full_exprs = [
+            (c.internal, ColumnRef(type=c.type, name=c.internal))
+            for c in agg_plan.schema.cols
+        ]
+        children = [
+            Projection(u_schema, agg_plan, full_exprs + grp_lits(k))
+        ]
+        for j in range(k - 1, -1, -1):
             # the grand-total level grouped by NOTHING would emit one
             # row even over empty input (scalar-aggregate semantics);
             # MySQL returns an empty set for rollup over no rows, so
@@ -3298,9 +3331,9 @@ def _build_aggregate(b, plan, group_by, agg_calls, rollup=False):
                     else Literal(type=g.type, value=None),
                 ))
             children.append(
-                Projection(agg_plan.schema, sub, exprs + agg_refs)
+                Projection(u_schema, sub, exprs + agg_refs + grp_lits(j))
             )
-        agg_plan = UnionAll(agg_plan.schema, children)
+        agg_plan = UnionAll(u_schema, children)
     return agg_plan, rewrite
 
 
