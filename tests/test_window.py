@@ -222,3 +222,79 @@ class TestRangeFrames:
                 "select sum(x) over (order by d range between interval "
                 "1 month preceding and current row) from e2"
             )
+
+
+class TestNamedWindows:
+    """WINDOW w AS (...) named-window clause (MySQL 8 / reference
+    parser WindowSpec): OVER w references resolve at parse time, so
+    every downstream path (planner, mesh) sees ordinary window calls."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("create database nw")
+        sess.execute("use nw")
+        sess.execute("create table t (g int, v int)")
+        sess.execute(
+            "insert into t values (1,10),(1,20),(2,5),(2,15),(2,25)"
+        )
+        return sess
+
+    def test_shared_window(self, s):
+        rows = s.execute(
+            "select g, v, sum(v) over w, rank() over w, "
+            "count(*) over w2 from t "
+            "window w as (partition by g order by v), "
+            "w2 as (partition by g) order by g, v"
+        ).rows
+        assert rows == [
+            (1, 10, 10, 1, 2),
+            (1, 20, 30, 2, 2),
+            (2, 5, 5, 1, 3),
+            (2, 15, 20, 2, 3),
+            (2, 25, 45, 3, 3),
+        ]
+
+    def test_named_window_with_frame(self, s):
+        rows = s.execute(
+            "select g, v, sum(v) over w from t window w as "
+            "(partition by g order by v rows between 1 preceding "
+            "and current row) order by g, v"
+        ).rows
+        assert rows == [
+            (1, 10, 10), (1, 20, 30), (2, 5, 5), (2, 15, 20),
+            (2, 25, 40),
+        ]
+
+    def test_unknown_window_errors(self, s):
+        import pytest as _pt
+
+        with _pt.raises(Exception, match="unknown window"):
+            s.execute("select sum(v) over nope from t")
+
+    def test_table_alias_still_works(self, s):
+        # 'window' is excluded from implicit aliases; others still parse
+        assert s.execute(
+            "select w.v from t w where w.g = 1 order by w.v"
+        ).rows == [(10,), (20,)]
+
+    def test_duplicate_and_scoping(self, s):
+        import pytest as _pt
+
+        with _pt.raises(Exception, match="duplicate window"):
+            s.execute(
+                "select sum(v) over w from t window "
+                "w as (partition by g), w as (order by v)"
+            )
+        # outer ref survives a nested subquery's own resolution
+        rows = s.execute(
+            "select g, sum(v) over w, "
+            "(select max(v) from t) from t "
+            "window w as (partition by g) order by g, v"
+        ).rows
+        assert [r[1] for r in rows] == [30, 30, 45, 45, 45]
+        # soft-keyword window names work on both sides
+        assert s.execute(
+            "select sum(v) over user from t window user as "
+            "(partition by g) order by g, v"
+        ).rows

@@ -81,6 +81,9 @@ class WindowCall:
     # None = unbounded in that direction; whole field None = no frame
     # clause (default framing semantics)
     frame: Optional[Tuple[Optional[int], Optional[int]]] = None
+    # OVER w: unresolved named-window reference, substituted from the
+    # SELECT's WINDOW clause at parse end
+    window_ref: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -852,6 +852,9 @@ class Parser:
         return tuple(out)
 
     def parse_select(self) -> ast.Select:
+        if not hasattr(self, "_pending_win_refs"):
+            self._pending_win_refs = []
+        _win_mark = len(self._pending_win_refs)
         self.expect_kw("select")
         hints = ()
         if self.cur.kind == "hint":
@@ -879,6 +882,17 @@ class Parser:
                 self._expect_ident_kw("rollup")
                 rollup = True
         having = self.parse_expr() if self.accept_kw("having") else None
+        windows = {}
+        if self._at_ident("window"):
+            self.advance()
+            while True:
+                wname = self.expect_ident().lower()
+                if wname in windows:
+                    raise ParseError(f"duplicate window name {wname!r}")
+                self.expect_kw("as")
+                windows[wname] = self._parse_window_spec()
+                if not self.accept_op(","):
+                    break
         order_by: List[ast.OrderItem] = []
         if self.accept_kw("order"):
             self.expect_kw("by")
@@ -922,12 +936,23 @@ class Parser:
                     )
                 self.advance()
             for_update = True
-        return ast.Select(
+        sel = ast.Select(
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
             distinct=distinct, hints=hints, for_update=for_update,
             outfile=outfile, rollup=rollup,
         )
+        # resolve THIS block's OVER w references in place — refs below
+        # _win_mark belong to an enclosing select, refs above it were
+        # already resolved and truncated by nested selects
+        for wc in self._pending_win_refs[_win_mark:]:
+            spec = windows.get(wc.window_ref)
+            if spec is None:
+                raise ParseError(f"unknown window {wc.window_ref!r}")
+            wc.partition_by, wc.order_by, wc.frame = spec
+            wc.window_ref = None
+        del self._pending_win_refs[_win_mark:]
+        return sel
 
     def parse_int(self) -> int:
         t = self.cur
@@ -1043,7 +1068,9 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
-        elif self.cur.kind == "id":
+        elif self.cur.kind == "id" and self.cur.text.lower() != "window":
+            # WINDOW starts the named-window clause, never an implicit
+            # alias (MySQL reserves it in exactly this position)
             alias = self.advance().text
         return ast.TableRef(db, name, alias, as_of=as_of)
 
@@ -1674,6 +1701,24 @@ class Parser:
 
     def _parse_over(self, func: str, arg, offset: int = 1):
         self.expect_kw("over")
+        if not self.at_op("("):
+            # OVER w — named window (resolved against the WINDOW clause
+            # at the end of parse_select; the pending list makes that
+            # O(refs), no tree walk). expect_ident accepts the same
+            # soft keywords the definition side does.
+            ref = self.expect_ident().lower()
+            wc = ast.WindowCall(func, arg, [], [], offset, None)
+            wc.window_ref = ref
+            if not hasattr(self, "_pending_win_refs"):
+                self._pending_win_refs = []
+            self._pending_win_refs.append(wc)
+            return wc
+        partition, order, frame = self._parse_window_spec()
+        return ast.WindowCall(func, arg, partition, order, offset, frame)
+
+    def _parse_window_spec(self):
+        """Parenthesized window spec: ([PARTITION BY ...] [ORDER BY ...]
+        [ROWS|RANGE frame]) — shared by OVER (...) and WINDOW w AS (...)."""
         self.expect_op("(")
         partition = []
         order = []
@@ -1732,7 +1777,7 @@ class Parser:
                 raise ParseError("window frame start cannot follow its end")
             frame = ("range", rlo, rhi)
         self.expect_op(")")
-        return ast.WindowCall(func, arg, partition, order, offset, frame)
+        return partition, order, frame
 
     def _parse_range_bound(self, is_start: bool):
         """RANGE frame bound: None = unbounded, 'cur' = current row
