@@ -434,3 +434,86 @@ class TestCompatSurface:
             "select count(*) from information_schema.partitions "
             "where table_name = 'pt'"
         ).rows == [(2,)]
+
+    def test_check_table_and_aliases(self, s):
+        s.execute("create table t (a int primary key, v int)")
+        s.execute("create index iv on t (v)")
+        s.execute("insert into t values (1, 5)")
+        assert s.execute("check table t").rows == [
+            ("cs.t", "check", "status", "OK")
+        ]
+        assert s.execute("show indexes from t").rows == s.execute(
+            "show index from t"
+        ).rows
+        assert s.execute("show keys from t").rows
+        assert "CREATE DATABASE `cs`" in s.execute(
+            "show create database cs"
+        ).rows[0][1]
+
+    def test_invisible_index(self, s):
+        s.execute("create table t (a int primary key, v int)")
+        s.execute("create index iv on t (v)")
+        s.execute("insert into t values " + ", ".join(
+            f"({i}, {i % 50})" for i in range(1, 2001)))
+        plan = lambda: "\n".join(
+            r[0] for r in s.execute(
+                "explain select a from t where v = 7"
+            ).rows
+        )
+        assert "Index" in plan()
+        s.execute("alter table t alter index iv invisible")
+        assert "Index" not in plan()
+        # still maintained: results identical, and visibility restores
+        assert len(s.execute("select a from t where v = 7").rows) == 40
+        s.execute("alter table t alter index iv visible")
+        assert "Index" in plan()
+
+    def test_read_only_transaction(self, s):
+        s.execute("create table t (a int primary key)")
+        s.execute("insert into t values (1)")
+        s.execute("start transaction read only, with consistent snapshot")
+        assert s.execute("select count(*) from t").rows == [(1,)]
+        with pytest.raises(Exception, match="READ ONLY"):
+            s.execute("insert into t values (2)")
+        s.execute("commit")
+        s.execute("insert into t values (2)")
+        assert s.execute("select count(*) from t").rows == [(2,)]
+        # plain START TRANSACTION is read-write
+        s.execute("start transaction")
+        s.execute("insert into t values (3)")
+        s.execute("commit")
+
+    def test_review_fixes_3(self, s, tmp_path):
+        s.execute("create table u (a int primary key, v int)")
+        s.execute("create index iv on u (v)")
+        s.execute("insert into u values " + ", ".join(
+            f"({i}, {i % 40})" for i in range(1, 2001)))
+        # drop clears visibility state; a recreated index is usable
+        s.execute("alter table u alter index iv invisible")
+        s.execute("drop index iv on u")
+        s.execute("create index iv on u (v)")
+        assert "Index" in "\n".join(
+            r[0] for r in s.execute(
+                "explain select a from u where v = 7"
+            ).rows
+        )
+        # invisibility survives BACKUP/RESTORE
+        s.execute("alter table u alter index iv invisible")
+        s.execute(f"backup database cs to '{tmp_path}/b'")
+        from tidb_tpu.session import Session as S2
+        from tidb_tpu.storage import Catalog as C2
+
+        c2 = C2()
+        s2 = S2(c2, db="cs")
+        s2.execute(f"restore database cs from '{tmp_path}/b'")
+        assert "iv" in c2.table("cs", "u").invisible_indexes
+        # missing table is an Error row, never Corrupt
+        rows = s.execute("check table nope").rows
+        assert rows == [
+            ("cs.nope", "check", "Error", "Table 'cs.nope' doesn't exist")
+        ]
+        # RO txn blocks locking reads too
+        s.execute("start transaction read only")
+        with pytest.raises(Exception, match="READ ONLY"):
+            s.execute("select a from u where a = 1 for update")
+        s.execute("rollback")

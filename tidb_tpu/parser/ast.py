@@ -616,6 +616,7 @@ class Trace:
 class TxnControl:
     op: str  # begin | commit | rollback | savepoint | rollback_to | release
     name: Optional[str] = None  # savepoint name for the last three
+    read_only: bool = False  # START TRANSACTION READ ONLY
 
 
 @dataclasses.dataclass

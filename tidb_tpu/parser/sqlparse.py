@@ -268,6 +268,14 @@ class Parser:
             self.advance()
             self.expect_kw("tables")
             return ast.Noop("unlock_tables")
+        if self._at_ident("check") and self.toks[self.i + 1].kind == "kw" \
+                and self.toks[self.i + 1].text == "table":
+            self.advance()
+            self.expect_kw("table")
+            tables = [self._qualified_name()]
+            while self.accept_op(","):
+                tables.append(self._qualified_name())
+            return ast.AdminStmt("check_table_status", tables)
         if self._at_ident("checksum"):
             self.advance()
             self.expect_kw("table")
@@ -465,7 +473,10 @@ class Parser:
                 if self.accept_kw("for"):
                     user = self._user_name()
                 return ast.Show("grants", db=user)
-            if self.accept_kw("index"):
+            if self.accept_kw("index") or self._at_ident("indexes") \
+                    or self._at_ident("keys"):
+                if self.cur.kind == "id":  # consume the alias word
+                    self.advance()
                 self.expect_kw("from")
                 db, name = self._qualified_name()
                 return ast.Show("index", db=f"{db or ''}.{name}")
@@ -483,6 +494,10 @@ class Parser:
                 self.advance()
                 return ast.Show("engines")
             if self.accept_kw("create"):
+                if self.accept_kw("database"):
+                    return ast.Show(
+                        "create_database", db=self.expect_ident()
+                    )
                 what = (
                     "create_view"
                     if self._at_ident("view")
@@ -565,7 +580,27 @@ class Parser:
         if self.at_kw("start"):
             self.advance()
             self.expect_kw("transaction")
-            return ast.TxnControl("begin")
+            ro = False
+            while True:
+                if self.accept_kw("with"):
+                    # WITH CONSISTENT SNAPSHOT: already the engine's
+                    # only behavior (pinned MVCC snapshot at begin)
+                    self._expect_ident_kw("consistent")
+                    self._expect_ident_kw("snapshot")
+                elif self._at_ident("read"):
+                    self.advance()
+                    acc = self.expect_ident().lower()
+                    if acc == "only":
+                        ro = True
+                    elif acc != "write":
+                        raise ParseError(
+                            "expected READ ONLY or READ WRITE"
+                        )
+                else:
+                    break
+                if not self.accept_op(","):
+                    break
+            return ast.TxnControl("begin", read_only=ro)
         if self.at_kw("commit"):
             self.advance()
             return ast.TxnControl("commit")
@@ -2595,7 +2630,17 @@ class Parser:
         """One comma-separated ALTER TABLE action (MySQL multi-spec /
         the reference's multi-schema change, pkg/ddl multiSchemaChange)."""
         if self.accept_kw("alter"):
+            # ALTER INDEX i {VISIBLE|INVISIBLE} |
             # ALTER [COLUMN] c SET DEFAULT <const> | DROP DEFAULT
+            if self.accept_kw("index"):
+                iname = self.expect_ident().lower()
+                vis = self.expect_ident().lower()
+                if vis not in ("visible", "invisible"):
+                    raise ParseError("expected VISIBLE or INVISIBLE")
+                return ast.AlterTable(
+                    db, name, "index_visibility", col_name=iname,
+                    new_name=vis,
+                )
             self.accept_kw("column")
             cname = self.expect_ident()
             if self.accept_kw("set"):

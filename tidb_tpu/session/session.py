@@ -360,6 +360,10 @@ class Session(DDLMixin):
         t = self.catalog.table(db, name)
         if self._txn is None:
             return t
+        if self._txn.get("read_only"):
+            raise ValueError(
+                "cannot execute statement in a READ ONLY transaction"
+            )
         key = (db.lower(), name.lower())
         shadow = self._txn["shadows"].get(key)
         if shadow is None:
@@ -847,6 +851,7 @@ class Session(DDLMixin):
             self._txn = {
                 "pins": {}, "shadows": {}, "base_versions": {},
                 "savepoints": [],
+                "read_only": bool(getattr(s, "read_only", False)),
             }
         elif s.op == "commit":
             self._commit_txn()
@@ -1005,6 +1010,28 @@ class Session(DDLMixin):
             )
         if s.op == "checksum_table":
             return self._admin_checksum(s)
+        if s.op == "check_table_status":
+            # MySQL CHECK TABLE: status rows instead of ADMIN CHECK's
+            # raise-on-corruption (reference: executor CheckTableExec)
+            rows = []
+            for db0, name in s.tables:
+                db = (db0 or self.db).lower()
+                full = f"{db}.{name.lower()}"
+                if not self.catalog.has_table(db, name):
+                    rows.append((
+                        full, "check", "Error",
+                        f"Table '{full}' doesn't exist",
+                    ))
+                    continue
+                try:
+                    self._run_admin(
+                        ast.AdminStmt("check_table", [(db, name)])
+                    )
+                    rows.append((full, "check", "status", "OK"))
+                except Exception as e:
+                    rows.append((full, "check", "error", str(e)[:200]))
+                    rows.append((full, "check", "error", "Corrupt"))
+            return Result(["Table", "Op", "Msg_type", "Msg_text"], rows)
         problems: list = []
         for db0, name in s.tables:
             db = (db0 or self.db).lower()
@@ -1859,6 +1886,13 @@ class Session(DDLMixin):
                 # SELECT ... FOR UPDATE (possibly inside WITH/UNION
                 # branches): lock the read tables before planning so the
                 # snapshot advances under the lock (ref SelectLockExec)
+                if self._txn is not None and self._txn.get("read_only"):
+                    # MySQL ER_CANT_EXECUTE_IN_READ_ONLY_TRANSACTION:
+                    # locking reads count as writes
+                    raise ValueError(
+                        "cannot execute statement in a READ ONLY "
+                        "transaction"
+                    )
                 r = self._with_write_locks(fu, lambda: self._run_select(s))
             else:
                 r = self._run_select(s)
@@ -2137,6 +2171,7 @@ class Session(DDLMixin):
                 del t.indexes[s.name.lower()]
                 t.index_states.pop(s.name.lower(), None)
                 t.unique_indexes.discard(s.name.lower())
+                t.invisible_indexes.discard(s.name.lower())
                 t.bump_version()
                 self.catalog.schema_version += 1
             r = Result([], [])
@@ -2263,6 +2298,15 @@ class Session(DDLMixin):
                         t.defaults[s.column.name.lower()] = coerced
             elif s.action in ("modify", "change"):
                 self._run_modify_column(t, s)
+            elif s.action == "index_visibility":
+                iname = s.col_name.lower()
+                if iname not in t.indexes:
+                    raise ValueError(f"unknown index {iname!r}")
+                if s.new_name == "invisible":
+                    t.invisible_indexes.add(iname)
+                else:
+                    t.invisible_indexes.discard(iname)
+                t.bump_version()
             elif s.action == "set_default":
                 cn = s.col_name.lower()
                 if cn not in t.schema.types:
@@ -2879,6 +2923,18 @@ class Session(DDLMixin):
                     (k, str(v)) for k, v in stats
                     if sql_like_match(k, pat, ci=True)
                 ],
+            )
+        if s.what == "create_database":
+            name = s.db
+            if name.lower() not in [
+                d.lower() for d in self.catalog.databases()
+            ] and name.lower() != "information_schema":
+                raise ValueError(f"unknown database {name}")
+            return Result(
+                ["Database", "Create Database"],
+                [(name.lower(),
+                  f"CREATE DATABASE `{name.lower()}` "
+                  "/*!40100 DEFAULT CHARACTER SET utf8mb4 */")],
             )
         if s.what == "table_status":
             # MySQL SHOW TABLE STATUS (reference: infoschema tables

@@ -79,6 +79,9 @@ def table_meta_to_json(t) -> Dict:
         "primary_key": t.schema.primary_key,
         "indexes": t.indexes,
         "unique_indexes": sorted(t.unique_indexes),
+        "invisible_indexes": sorted(
+            getattr(t, "invisible_indexes", ()) or ()
+        ),
         "autoinc": [t.autoinc_col, t.autoinc_next],
         "ttl": list(t.ttl) if t.ttl else None,
         "partition": (
@@ -128,6 +131,7 @@ def apply_table_meta(t, meta: Dict) -> None:
         k: list(v) for k, v in (meta.get("indexes") or {}).items()
     }
     t.unique_indexes = set(meta.get("unique_indexes") or [])
+    t.invisible_indexes = set(meta.get("invisible_indexes") or [])
     ai = meta.get("autoinc")
     if ai:
         t.autoinc_col, t.autoinc_next = ai[0], int(ai[1])

@@ -136,6 +136,10 @@ class Table:
         # only); enforced on append (duplicate-key errors, reference
         # kv.ErrKeyExists on unique index writes)
         self.unique_indexes: set = set()
+        # planner-invisible indexes (MySQL ALTER INDEX ... INVISIBLE):
+        # still maintained and uniqueness-enforced, never chosen as an
+        # access path (public_indexes filters them)
+        self.invisible_indexes: set = set()
         # rows changed since the last ANALYZE — drives auto-analyze
         # (reference: stats handle modify counters feeding
         # pkg/statistics/handle/autoanalyze/autoanalyze.go:264)
@@ -210,11 +214,13 @@ class Table:
         return self.index_states.get(name.lower(), "public")
 
     def public_indexes(self) -> Dict[str, List[str]]:
-        """Indexes the planner may READ (schema state public)."""
+        """Indexes the planner may READ (schema state public and not
+        ALTER INDEX ... INVISIBLE)."""
         return {
             n: cols
             for n, cols in self.indexes.items()
             if self.index_state(n) == "public"
+            and n not in self.invisible_indexes
         }
 
     def bump_version(self) -> int:
