@@ -314,3 +314,81 @@ class TestColumnarStringUpdate:
         assert s.execute(
             "select id, n, status from t order by id"
         ).rows == [(1, 15, "done"), (2, 20, "done")]
+
+
+class TestDMLOrderLimit:
+    """Single-table DELETE/UPDATE ... [ORDER BY] LIMIT (MySQL batch-DML
+    form; reference: buildDelete/buildUpdate accept order-by + limit) —
+    the batch-purge loop shape (DELETE ... LIMIT 1000 until 0 rows)."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("create database bl")
+        sess.execute("use bl")
+        sess.execute("create table t (a int primary key, v int)")
+        sess.execute(
+            "insert into t values " + ", ".join(
+                f"({i}, {i % 7})" for i in range(1, 101)
+            )
+        )
+        return sess
+
+    def test_batch_purge_loop(self, s):
+        total = 0
+        while True:
+            n = s.execute("delete from t where v = 3 limit 4").affected
+            total += n
+            if n == 0:
+                break
+        assert total == 14
+        assert s.execute(
+            "select count(*) from t where v = 3"
+        ).rows == [(0,)]
+
+    def test_delete_order_by_limit(self, s):
+        s.execute("delete from t order by a desc limit 3")
+        assert s.execute("select max(a) from t").rows == [(97,)]
+        s.execute("delete from t order by v desc, a asc limit 2")
+        # v=6 rows: a in (6,13,...); two smallest a with v=6 removed
+        assert s.execute(
+            "select count(*) from t where v = 6"
+        ).rows == [(12,)]
+
+    def test_update_order_by_limit(self, s):
+        s.execute("update t set v = -1 order by a desc limit 2")
+        assert s.execute(
+            "select a from t where v = -1 order by a"
+        ).rows == [(99,), (100,)]
+        with pytest.raises(Exception, match="ORDER BY supports plain"):
+            s.execute("delete from t order by a + 1 limit 1")
+
+    def test_txn_and_fk_paths_still_apply(self, s):
+        s.execute(
+            "create table c (id int, r int, "
+            "foreign key (r) references t (a) on delete cascade)"
+        )
+        s.execute("insert into c values (1, 100), (2, 50)")
+        s.execute("delete from t order by a desc limit 1")  # a=100
+        assert s.execute("select id from c").rows == [(2,)]
+        s.execute("begin")
+        s.execute("delete from t order by a desc limit 5")
+        s.execute("rollback")
+        assert s.execute("select count(*) from t").rows == [(99,)]
+
+    def test_desc_nulls_last_and_no_pk_unbound_limit(self, s):
+        s.execute("create table n (a int primary key, v int)")
+        s.execute("insert into n values (1, 5), (2, NULL), (3, 9)")
+        # MySQL: NULLs sort LAST descending — v=9 goes first
+        s.execute("delete from n order by v desc limit 1")
+        assert s.execute("select a from n order by a").rows == [
+            (1,), (2,)
+        ]
+        # and FIRST ascending
+        s.execute("delete from n order by v asc limit 1")
+        assert s.execute("select a from n order by a").rows == [(1,)]
+        # a LIMIT that doesn't bind works without any PRIMARY KEY
+        s.execute("create table nk (x int, y int)")
+        s.execute("insert into nk values (1, 1), (2, 2)")
+        assert s.execute("update nk set y = 0 limit 10").affected == 2
+        assert s.execute("select sum(y) from nk").rows == [(0,)]

@@ -499,6 +499,9 @@ class Delete:
     db: Optional[str]
     table: str
     where: Optional[object] = None
+    # single-table batch form: DELETE ... [ORDER BY ...] [LIMIT n]
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
     # multi-table forms (DELETE t1, t2 FROM <refs> / DELETE FROM t USING
     # <refs>): targets name the tables rows are removed from (db, name —
     # `name` may be an alias bound in from_refs); from_refs is the joined
@@ -514,6 +517,9 @@ class Update:
     table: str
     sets: List[Tuple[str, object]]  # col may be "qualifier.col" in multi form
     where: Optional[object] = None
+    # single-table batch form: UPDATE ... [ORDER BY ...] [LIMIT n]
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
     # multi-table form (UPDATE t1 JOIN t2 ... SET ...): the joined row
     # source; db/table are unused when set. Reference: buildUpdate's
     # multiple-table handling (pkg/planner/core/logical_plan_builder.go).

@@ -2954,6 +2954,20 @@ class Parser:
             self.expect_op("*")
         return db, name
 
+    def _dml_order_limit(self):
+        """[ORDER BY items] [LIMIT n] tail of single-table DELETE/UPDATE
+        (MySQL batch-DML form)."""
+        order_by = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            limit = self.parse_int()
+        return order_by, limit
+
     def parse_delete(self):
         self.expect_kw("delete")
         if self.accept_kw("from"):
@@ -2973,7 +2987,13 @@ class Parser:
                     targets=[(db, alias or name)], from_refs=refs,
                 )
             where = self.parse_expr() if self.accept_kw("where") else None
+            order_by, limit = self._dml_order_limit()
             if alias is not None:
+                if order_by or limit is not None:
+                    raise ParseError(
+                        "DELETE ... ORDER BY/LIMIT does not take a "
+                        "table alias"
+                    )
                 # single-table with alias: route through the multi-table
                 # machinery so WHERE sees the alias qualifier
                 return ast.Delete(
@@ -2981,7 +3001,9 @@ class Parser:
                     targets=[(db, alias)],
                     from_refs=ast.TableRef(db, name, alias),
                 )
-            return ast.Delete(db, name, where)
+            return ast.Delete(
+                db, name, where, order_by=order_by, limit=limit
+            )
         # DELETE t1[, t2] FROM <joined refs> [WHERE ...]
         targets = [self._delete_target()]
         while self.accept_op(","):
@@ -3007,12 +3029,21 @@ class Parser:
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("where") else None
+        order_by, limit = self._dml_order_limit()
         if (
             isinstance(refs, ast.TableRef)
             and refs.alias is None
             and not qualified
         ):
-            return ast.Update(refs.db, refs.name, sets, where)
+            return ast.Update(
+                refs.db, refs.name, sets, where,
+                order_by=order_by, limit=limit,
+            )
+        if order_by or limit is not None:
+            raise ParseError(
+                "UPDATE ... ORDER BY/LIMIT takes a single plain table "
+                "(no alias, no joins)"
+            )
         return ast.Update(None, "", sets, where, from_refs=refs)
 
 

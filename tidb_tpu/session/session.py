@@ -4603,6 +4603,16 @@ class Session(DDLMixin):
         db = s.db or self.db
         t = self._resolve_table_for_write(db, s.table)
         children = self._fk_children(db, s.table)
+        if s.where is None and (s.limit is not None or s.order_by):
+            import numpy as np
+
+            masks = [
+                np.ones(b.nrows, dtype=bool) for b in t.blocks()
+            ]
+            masks, affected = self._dml_order_limit_masks(
+                t, masks, s.order_by, s.limit
+            )
+            return self._delete_masked(t, db, s.table, masks, affected)
         if s.where is None:
             affected = t.nrows
             undo = []
@@ -4621,6 +4631,10 @@ class Session(DDLMixin):
             clear_scan_cache()
             return Result([], [], affected=affected)
         masks, affected = self._eval_where_per_block(t, s.where)
+        if s.limit is not None or s.order_by:
+            masks, affected = self._dml_order_limit_masks(
+                t, masks, s.order_by, s.limit
+            )
         return self._delete_masked(t, db, s.table, masks, affected)
 
     def _delete_masked(
@@ -4691,6 +4705,45 @@ class Session(DDLMixin):
         if s.from_refs is not None:
             return self._run_update_multi(s)
         t = self._resolve_table_for_write(s.db or self.db, s.table)
+        if s.limit is not None or s.order_by:
+            # UPDATE ... [ORDER BY] LIMIT: choose the affected rows
+            # first, then run a plain keyed UPDATE over them (the
+            # columnar fast path and the select-rewrite fallback both
+            # consume an ordinary WHERE)
+            import numpy as np
+
+            if s.where is not None:
+                masks, _n = self._eval_where_per_block(t, s.where)
+            else:
+                masks = [np.ones(b.nrows, dtype=bool) for b in t.blocks()]
+            before = sum(int(m.sum()) for m in masks)
+            masks, affected = self._dml_order_limit_masks(
+                t, masks, s.order_by, s.limit
+            )
+            if affected == before:
+                # LIMIT did not bind: a plain UPDATE, no rewrite needed
+                s = dataclasses.replace(s, order_by=[], limit=None)
+                return self._run_update(s)
+            pk = t.schema.primary_key
+            if not (pk and len(pk) == 1):
+                raise ValueError(
+                    "UPDATE ... ORDER BY/LIMIT requires a "
+                    "single-column PRIMARY KEY"
+                )
+            pkc = pk[0]
+            vals = []
+            for b, m in zip(t.blocks(), masks):
+                dec = b.columns[pkc].decode()
+                vals.extend(dec[i] for i in np.nonzero(m)[0])
+            if not vals:
+                return Result([], [], affected=0)
+            in_pred = ast.Call(
+                "in",
+                [ast.Name(None, pkc)] + [ast.Const(v) for v in vals],
+            )
+            s = dataclasses.replace(
+                s, where=in_pred, order_by=[], limit=None
+            )
         sets = {c.lower(): e for c, e in s.sets}
         self._reject_generated_targets(t, sets, "SET")
         fast = self._try_columnar_update(t, s, sets)
@@ -4963,6 +5016,72 @@ class Session(DDLMixin):
         t.replace_blocks(new_blocks, modified_rows=affected)
         clear_scan_cache()
         return Result([], [], affected=affected)
+
+    def _dml_order_limit_masks(self, t, masks, order_by, limit):
+        """Restrict per-block DML masks (True = affected) to the first
+        `limit` matching rows ordered by `order_by` (MySQL single-table
+        UPDATE/DELETE ... ORDER BY ... LIMIT). Order keys must be plain
+        columns; NULLs sort first ascending (MySQL). Returns (masks,
+        affected)."""
+        import numpy as np
+
+        blocks = t.blocks()
+        total = sum(int(m.sum()) for m in masks)
+        if total == 0 or limit is None or total <= limit:
+            # ORDER BY without a binding LIMIT changes nothing
+            return masks, total
+        bi = np.concatenate([
+            np.full(int(m.sum()), i, dtype=np.int64)
+            for i, m in enumerate(masks)
+        ])
+        ri = np.concatenate([np.nonzero(m)[0] for m in masks])
+        if order_by:
+            # vectorized direction+null key transforms (the
+            # executor/sort.py convention: NULLs first ascending, last
+            # descending), encoded domain — dictionaries are sorted so
+            # string codes order binary-lexicographically
+            keys = []  # np.lexsort order: LAST array is primary
+            for ob in order_by:
+                if not isinstance(ob.expr, ast.Name) or ob.expr.table:
+                    raise ValueError(
+                        "DELETE/UPDATE ... ORDER BY supports plain "
+                        "column names"
+                    )
+                cn = ob.expr.column.lower()
+                if cn not in t.schema.types:
+                    raise ValueError(f"unknown column {cn!r}")
+                data = np.concatenate([
+                    np.asarray(
+                        b.columns[cn].data, dtype=np.float64
+                    )[m]
+                    for b, m in zip(blocks, masks)
+                ])
+                valid = np.concatenate([
+                    b.columns[cn].valid[m]
+                    for b, m in zip(blocks, masks)
+                ])
+                if ob.desc:
+                    nullk = (~valid).astype(np.int8)  # NULLs last
+                    valk = np.where(valid, -data, 0.0)
+                else:
+                    nullk = valid.astype(np.int8)  # NULLs first
+                    valk = np.where(valid, data, 0.0)
+                keys.append((nullk, valk))
+            operands = []
+            for nullk, valk in reversed(keys):
+                operands.append(valk)
+                operands.append(nullk)
+            order = np.lexsort(operands)
+        else:
+            order = np.arange(len(bi))
+        take = order[:limit]
+        out = []
+        for i, m in enumerate(masks):
+            nm = np.zeros_like(m)
+            mine = take[bi[take] == i]
+            nm[ri[mine]] = True
+            out.append(nm)
+        return out, int(len(take))
 
     def _eval_where_per_block(self, t, where):
         """Evaluate WHERE over each block on host via a filtered scan;
