@@ -207,8 +207,16 @@ def measure(args) -> int:
         pass
 
     _phase("backend init (devices query)")
-    backend = jax.default_backend()
-    _phase(f"backend ready: {backend}")
+    # PERF_NOTES forensics: default_backend() returns the PJRT plugin's
+    # name — 'axon' through the TPU tunnel — so `== "tpu"` string
+    # compares (and provenance records) silently mislabel hardware runs.
+    # is_tpu() (Device.platform) is the proven check; keep the raw
+    # plugin name alongside for provenance.
+    from tidb_tpu.utils.backend import is_tpu
+
+    jax_backend = jax.default_backend()
+    backend = "tpu" if is_tpu() else jax_backend
+    _phase(f"backend ready: {backend} (pjrt={jax_backend})")
 
     cat = Catalog()
     t0 = time.perf_counter()
@@ -250,6 +258,7 @@ def measure(args) -> int:
                 "datagen_s": round(gen_s, 2),
                 "repeat": args.repeat,
                 "backend": backend,
+                "pjrt_backend": jax_backend,
             },
         }))
         return 0
@@ -319,6 +328,7 @@ def measure(args) -> int:
             "datagen_s": round(gen_s, 2),
             "repeat": args.repeat,
             "backend": backend,
+            "pjrt_backend": jax_backend,
         },
     }
     print(json.dumps(result))
@@ -535,6 +545,54 @@ def _cached_tpu_result(args, attempts, exact_only: bool = False):
     return result
 
 
+def _result_is_tpu(obj) -> bool:
+    """Was this result (raw, or a driver wrapper with 'parsed') a real
+    hardware capture — not a CPU fallback, not marked fallback?"""
+    if not isinstance(obj, dict):
+        return False
+    detail = obj.get("detail")
+    if detail is None and isinstance(obj.get("parsed"), dict):
+        detail = obj["parsed"].get("detail")
+    detail = detail or {}
+    return detail.get("backend") == "tpu" and not detail.get("fallback")
+
+
+def _write_out(args, result) -> int:
+    """Write the result to --out with backend provenance, refusing to
+    overwrite a real-TPU capture with a CPU-fallback run unless
+    --allow-fallback (the BENCH_r05 mixup: a CPU fallback silently
+    became the official capture). Fallback captures written with
+    --allow-fallback are marked {"fallback": true} so no consumer can
+    mistake them for hardware numbers. Returns process exit code."""
+    detail = result.setdefault("detail", {})
+    if detail.get("backend") != "tpu" and not args.cpu:
+        # TPU was requested but CPU ran: a fallback capture. A
+        # deliberate --cpu baseline is labeled by its backend field
+        # alone — this flag must agree with backend_provenance.fallback.
+        detail["fallback"] = True
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+        except Exception:
+            existing = None
+        if (
+            _result_is_tpu(existing)
+            and not _result_is_tpu(result)
+            and not args.allow_fallback
+        ):
+            print(
+                f"REFUSING to overwrite TPU capture {args.out} with a "
+                f"{detail.get('backend', '?')} fallback run; pass "
+                "--allow-fallback to mark-and-overwrite",
+                file=sys.stderr,
+            )
+            return 1
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
 def supervise(args, passthrough) -> int:
     attempts = []
     tpu_timeout = int(os.environ.get("TIDB_TPU_BENCH_TIMEOUT", "900"))
@@ -602,6 +660,16 @@ def supervise(args, passthrough) -> int:
 
     detail = result.setdefault("detail", {})
     detail["attempts"] = attempts
+    # backend provenance stamped into every emitted result (and thus
+    # every BENCH_*.json the driver or --out captures): what actually
+    # ran, the raw PJRT plugin name, and the code version measured
+    detail["backend_provenance"] = {
+        "backend": detail.get("backend"),
+        "pjrt_backend": detail.get("pjrt_backend"),
+        "code_version": _code_version(),
+        "captured_unix": int(time.time()),
+        "fallback": detail.get("backend") != "tpu" and not args.cpu,
+    }
     if detail.get("backend") == "tpu" and not detail.get("cached_tpu_result"):
         _store_tpu_cache(args, result)
     elif detail.get("backend") != "tpu":
@@ -617,8 +685,11 @@ def supervise(args, passthrough) -> int:
                     "captured_at_version"
                 ),
             }
+    rc = 0
+    if args.out:
+        rc = _write_out(args, result)
     print(json.dumps(result))
-    return 0
+    return rc
 
 
 def main() -> int:
@@ -635,6 +706,17 @@ def main() -> int:
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--quick", action="store_true", help="sf=0.01 sanity run")
     ap.add_argument("--cpu", action="store_true", help="skip TPU, measure on CPU")
+    ap.add_argument(
+        "--out", default=None,
+        help="also write the result JSON (with backend provenance) to "
+        "this BENCH_*.json path; refuses to overwrite a TPU capture "
+        "with a CPU fallback unless --allow-fallback",
+    )
+    ap.add_argument(
+        "--allow-fallback", action="store_true",
+        help="permit --out to overwrite a TPU capture with a CPU "
+        "fallback result (marked {\"fallback\": true})",
+    )
     ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.quick:

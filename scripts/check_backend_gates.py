@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint: no raw `== "tpu"` backend string compares outside utils/backend.py.
+
+PERF_NOTES forensics: `jax.default_backend()` returns the PJRT plugin's
+platform name — 'axon' through this environment's TPU tunnel — so a
+`default_backend() == "tpu"` gate silently disables every TPU-only
+engine path on the real hardware (round-5 captures: Q18 ran the serial
+dense scatter for 9.27s with the sorted path sitting behind exactly
+this check). The one sanctioned check is utils/backend.is_tpu().
+
+Rules:
+  1. anywhere in the repo's .py files: `default_backend() == "tpu"`
+     (or !=) is an error;
+  2. inside the tidb_tpu/ package (engine code), ANY `== "tpu"` /
+     `!= "tpu"` string compare is an error, except in utils/backend.py
+     (the helper's own implementation) or on lines carrying a
+     `# backend-gate-ok` pragma.
+
+Usage: python scripts/check_backend_gates.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+DEFAULT_BACKEND_CMP = re.compile(
+    r"default_backend\(\)\s*[=!]=\s*[\"']tpu[\"']"
+)
+ANY_TPU_CMP = re.compile(r"[=!]=\s*[\"']tpu[\"']")
+PRAGMA = "# backend-gate-ok"
+#: the helper's own implementation, and this lint (its docstring quotes
+#: the offending pattern)
+ALLOWED = {
+    os.path.join("tidb_tpu", "utils", "backend.py"),
+    os.path.join("scripts", "check_backend_gates.py"),
+}
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules"}
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check(root: str):
+    violations = []
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        in_engine = rel.split(os.sep)[0] == "tidb_tpu"
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        if rel in ALLOWED:
+            continue
+        for i, line in enumerate(lines, 1):
+            if PRAGMA in line:
+                continue
+            if DEFAULT_BACKEND_CMP.search(line):
+                violations.append(
+                    (rel, i, "default_backend() string-compared to 'tpu' "
+                     "(always False through the axon tunnel) — use "
+                     "utils.backend.is_tpu()")
+                )
+            elif (
+                in_engine
+                and rel not in ALLOWED
+                and ANY_TPU_CMP.search(line)
+            ):
+                violations.append(
+                    (rel, i, "raw == \"tpu\" compare in engine code — "
+                     "use utils.backend.is_tpu() (or add "
+                     f"{PRAGMA!r} if this is not a backend gate)")
+                )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} backend-gate violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

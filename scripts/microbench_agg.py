@@ -13,6 +13,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from tidb_tpu.utils.backend import backend_label
 import numpy as np
 
 jax.config.update("jax_enable_x64", True)
@@ -39,7 +41,7 @@ def timeit(name, fn, *args):
 
 
 def main():
-    print("backend:", jax.default_backend())
+    print("backend:", backend_label(), flush=True)
     for dtype_v, dtype_s in [
         (jnp.int64, "i64"),
         (jnp.float64, "f64"),

@@ -13,13 +13,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from tidb_tpu.utils.backend import backend_label
 import numpy as np
 
 N = int(os.environ.get("MB_N", str(6_000_000)))
 SLOTS = 8
 LANES = 8
 
-print("backend:", jax.default_backend(), flush=True)
+print("backend:", backend_label(), flush=True)
 
 rng = np.random.default_rng(0)
 seg_np = rng.integers(0, 6, N)

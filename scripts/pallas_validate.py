@@ -24,7 +24,15 @@ N = int(os.environ.get("PV_N", str(6_000_000)))
 SLOTS = 8
 LANES = 8
 
-out = {"backend": jax.default_backend(), "n": N, "slots": SLOTS, "lanes": LANES}
+from tidb_tpu.utils.backend import is_tpu as _is_tpu
+
+out = {
+    # normalized: 'tpu' on hardware even through the axon tunnel
+    # (default_backend() reports the PJRT plugin name — PERF_NOTES)
+    "backend": "tpu" if _is_tpu() else jax.default_backend(),
+    "pjrt_backend": jax.default_backend(),
+    "n": N, "slots": SLOTS, "lanes": LANES,
+}
 print("backend:", out["backend"], flush=True)
 
 rng = np.random.default_rng(0)

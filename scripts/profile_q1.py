@@ -12,6 +12,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+from tidb_tpu.utils.backend import backend_label
 import numpy as np
 
 from tidb_tpu.bench import load_tpch
@@ -33,7 +35,7 @@ Q1 = (
 
 
 def main():
-    print("backend:", jax.default_backend(), flush=True)
+    print("backend:", backend_label(), flush=True)
     cat = Catalog()
     t0 = time.perf_counter()
     load_tpch(cat, sf=SF, tables=["orders", "lineitem"], seed=1)

@@ -18,6 +18,8 @@ if "--tpu" not in sys.argv:
 
 import jax
 
+from tidb_tpu.utils.backend import backend_label
+
 sys.path.insert(0, "/root/repo")
 import bench as B
 from tidb_tpu.bench import load_tpch
@@ -29,7 +31,7 @@ def main():
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     q = pos[0] if pos else "q18"
     sf = float(pos[1]) if len(pos) > 1 else 1.0
-    print("backend:", jax.default_backend(), flush=True)
+    print("backend:", backend_label(), flush=True)
     cat = Catalog()
     t0 = time.perf_counter()
     if q == "q95":

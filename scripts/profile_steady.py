@@ -11,6 +11,8 @@ sys.path.insert(0, "/root/repo")
 
 import jax
 
+from tidb_tpu.utils.backend import backend_label
+
 import bench as B
 from tidb_tpu.bench import load_tpch
 from tidb_tpu.session import Session
@@ -20,7 +22,7 @@ from tidb_tpu.storage import Catalog
 def main():
     q = sys.argv[1]
     sf = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
-    print("backend:", jax.default_backend(), flush=True)
+    print("backend:", backend_label(), flush=True)
     cat = Catalog()
     load_tpch(cat, sf=sf, tables=B._TABLES[q], seed=1)
     sess = Session(cat, db="tpch")

@@ -38,6 +38,12 @@ except Exception:
 
 # distributed bring-up MUST precede anything that initializes the XLA
 # backend — including the tidb_tpu import chain (x64 flag warmup)
+try:
+    # jax 0.4.x CPU: cross-process collectives need an explicit
+    # transport (gloo); newer jax defaults to it and may drop the knob
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(
     coordinator_address=coord, num_processes=nproc, process_id=pid
 )

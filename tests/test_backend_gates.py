@@ -1,0 +1,51 @@
+"""Tier-1 gate for scripts/check_backend_gates.py: the repo stays free
+of raw `== "tpu"` backend string compares (PERF_NOTES forensics: the
+compare is always False through the axon PJRT tunnel, so TPU-only
+engine paths silently never fired on hardware — utils/backend.is_tpu()
+is the one sanctioned check)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_backend_gates.py")
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, LINT, REPO], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"backend-gate violations:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_lint_catches_violations(tmp_path):
+    pkg = tmp_path / "tidb_tpu"
+    pkg.mkdir()
+    (pkg / "bad_gate.py").write_text(
+        'import jax\n'
+        'ON_TPU = jax.default_backend() == "tpu"\n'   # rule 1  # backend-gate-ok
+        'OTHER = backend != "tpu"\n'                  # rule 2
+        'OK = backend == "tpu"  # backend-gate-ok\n'  # pragma exempts
+    )
+    (tmp_path / "outside.py").write_text(
+        'x = store == "tpu"\n'  # outside tidb_tpu/: rule 2 not applied
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "bad_gate.py:2" in proc.stdout
+    assert "bad_gate.py:3" in proc.stdout
+    assert "bad_gate.py:4" not in proc.stdout
+    assert "outside.py" not in proc.stdout
+
+
+def test_is_tpu_is_importable_and_boolean():
+    from tidb_tpu.utils.backend import is_tpu
+
+    assert is_tpu() in (True, False)

@@ -1,0 +1,322 @@
+"""Cross-host DCN fragment scheduler: planning, dispatch, recovery.
+
+Reference: MPP dispatch + probe + retry (pkg/store/copr/mpp.go:93,
+mpp_probe.go:33, pkg/executor/internal/mpp/recovery_handler.go:26).
+These tests run the coordinator against in-process EngineServers (the
+unistore move: full protocol, no cluster); the true 2-process x
+4-device dryrun lives in test_multihost.py.
+"""
+
+import pytest
+
+from tidb_tpu.parallel.dcn import (
+    DCNFragmentScheduler,
+    FragmentLedger,
+    HostHeartbeat,
+)
+from tidb_tpu.parser.sqlparse import parse
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.fragmenter import split_plan
+from tidb_tpu.planner.logical import build_query
+from tidb_tpu.server.engine_pool import FailedEngineProber
+from tidb_tpu.server.engine_rpc import DropConnection, EngineServer
+from tidb_tpu.session.session import Session
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute(
+        "create table t (a int, b varchar(8), c decimal(10,2), d date)"
+    )
+    s.execute(
+        "insert into t values (1,'x',1.50,'1998-01-01'),"
+        "(2,'y',2.25,'1998-02-01'),(3,'x',0.25,'1998-03-01'),"
+        "(4,null,10.00,'1998-01-15'),(null,'z',3.00,null)"
+    )
+    s.execute("create table u (k int, v int)")
+    s.execute("insert into u values (1,10),(2,20),(3,30),(4,40)")
+    return s
+
+
+def _plan(sess, q):
+    return build_query(parse(q)[0], sess.catalog, "test", sess._scalar_subquery)
+
+
+def _servers(sess, n=2):
+    out = []
+    for _ in range(n):
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        out.append(srv)
+    return out
+
+
+GROUPED = "select b, count(*), sum(a) from t group by b order by b"
+
+
+class TestFragmentPlanning:
+    def test_agg_cut_slices_largest_scan(self, sess):
+        frag = split_plan(_plan(sess, GROUPED), sess.catalog)
+        assert frag is not None
+        assert frag.frag_scan.table == "t"
+        # partial wire schema: group key + partial count + partial sum
+        names = [c.internal for c in frag.partial_schema.cols]
+        assert names[0] == "_g0" and len(names) == 3
+        hp = frag.host_plan(1, 3)
+        scans = []
+        from tidb_tpu.planner.fragmenter import _candidate_scans
+
+        _candidate_scans(hp.child, scans)
+        assert [s.frag for s in scans] == [(1, 3)]
+        # the template itself stays unsliced (reusable for any host)
+        assert frag.frag_scan.frag is None
+
+    def test_join_slices_probe_replicates_build(self, sess):
+        q = (
+            "select b, count(*) from t join u on a = k "
+            "group by b order by b"
+        )
+        frag = split_plan(_plan(sess, q), sess.catalog)
+        assert frag is not None
+        assert frag.frag_scan.table == "t"  # larger side sliced
+
+    def test_distinct_agg_falls_back(self, sess):
+        # single-DISTINCT rewrites to stacked aggregates whose inner agg
+        # pins the subtree: no safe slice -> whole-plan dispatch
+        q = "select b, count(distinct a) from t group by b"
+        assert split_plan(_plan(sess, q), sess.catalog) is None
+
+    def test_no_agg_peels_sort_limit(self, sess):
+        frag = split_plan(
+            _plan(sess, "select a, b from t order by a desc limit 3"),
+            sess.catalog,
+        )
+        assert frag is not None
+        assert not isinstance(frag.template, (L.Sort, L.Limit))
+        final = frag.final_builder(
+            L.Staged(frag.partial_schema, batch=None, dicts={}, nonce=0)
+        )
+        # the peeled chain (projection/limit/sort) re-applies above the
+        # staged union, in original order
+        kinds = []
+        node = final
+        while not isinstance(node, L.Staged):
+            kinds.append(type(node).__name__)
+            node = node.child
+        assert "Sort" in kinds and "Limit" in kinds
+        assert kinds.index("Limit") < kinds.index("Sort")
+
+    def test_frag_ir_roundtrip(self, sess):
+        from tidb_tpu.planner.ir import deserialize_plan, serialize_plan
+
+        frag = split_plan(_plan(sess, GROUPED), sess.catalog)
+        hp = frag.host_plan(1, 2)
+        rt = deserialize_plan(serialize_plan(hp))
+        scans = []
+        from tidb_tpu.planner.fragmenter import _candidate_scans
+
+        _candidate_scans(rt.child, scans)
+        assert [s.frag for s in scans] == [(1, 2)]
+
+
+QUERIES = [
+    "select count(*), sum(c), min(a), max(b) from t",
+    "select b, count(*), sum(c), avg(c) from t group by b order by b",
+    "select b, count(*) from t join u on a = k where v < 35 "
+    "group by b order by count(*) desc, b limit 2",
+    "select a, b from t order by a desc limit 3",
+    "select b, count(distinct a) from t group by b order by b",
+    "select avg(a) from t",
+    "select d, count(*) from t group by d order by d",
+]
+
+
+class TestSchedulerParity:
+    def test_two_host_parity(self, sess):
+        srvs = _servers(sess, 2)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs], catalog=sess.catalog
+        )
+        try:
+            for q in QUERIES:
+                exp = sess.must_query(q).rows
+                _cols, got = sched.execute_plan(_plan(sess, q))
+                assert got == exp, f"{q}\n got={got}\n exp={exp}"
+        finally:
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+    def test_partial_agg_crosses_the_wire(self, sess):
+        """The DCN exchange carries PARTIAL rows: each host ships its
+        group partials, not raw rows (partial-agg-before-DCN)."""
+        executed = []
+        failpoint.enable("dcn/fragment-execute", lambda: executed.append(1))
+        srvs = _servers(sess, 2)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs], catalog=sess.catalog
+        )
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+            assert len(executed) == 2  # one fragment per host
+        finally:
+            failpoint.disable("dcn/fragment-execute")
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+
+class TestLedger:
+    def test_exactly_once_fences(self):
+        led = FragmentLedger(2)
+        tok = led.claim(0, "h0")
+        assert led.complete(0, tok, [(1,)]) is True
+        # duplicate redelivery of landed work: dropped
+        assert led.complete(0, tok, [(1,)]) is False
+        # transport loss -> release -> re-dispatch; the zombie original
+        # attempt's late reply must lose to the fence
+        tok1 = led.claim(1, "h0")
+        led.release(1, tok1)
+        tok1b = led.claim(1, "h1")
+        assert led.complete(1, tok1, [(9,)]) is False
+        assert led.complete(1, tok1b, [(2,)]) is True
+        assert led.all_done()
+        assert led.duplicates_dropped == 2
+        assert led.rows() == [(1,), (2,)]
+
+    def test_release_requires_token(self):
+        led = FragmentLedger(1)
+        tok = led.claim(0, "h0")
+        led.release(0, "not-the-token")
+        assert led.pending() == []  # still inflight
+        led.release(0, tok)
+        assert led.pending() == [0]
+
+
+class TestFailureRecovery:
+    def test_worker_death_after_work_before_reply(self, sess):
+        """dcn/result-send death: the fragment's work happened but the
+        reply was lost — re-dispatch onto the survivor must return
+        correct results exactly once (no double counting)."""
+        srvs = _servers(sess, 2)
+        failpoint.enable(
+            "dcn/result-send", failpoint.after_n(1, DropConnection)
+        )
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs],
+            catalog=sess.catalog,
+            prober=FailedEngineProber(initial_backoff_s=30),
+        )
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+            assert len(sched.prober.failed_endpoints()) == 1
+        finally:
+            failpoint.disable("dcn/result-send")
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+    def test_dispatch_lost_redispatches(self, sess):
+        srvs = _servers(sess, 2)
+        failpoint.enable(
+            "dcn/dispatch-lost", failpoint.after_n(1, lambda: True)
+        )
+        redispatched = []
+        failpoint.enable("dcn/redispatch", lambda: redispatched.append(1))
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs],
+            catalog=sess.catalog,
+            prober=FailedEngineProber(initial_backoff_s=30),
+        )
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+            assert len(redispatched) == 1
+        finally:
+            failpoint.disable("dcn/dispatch-lost")
+            failpoint.disable("dcn/redispatch")
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+    def test_duplicate_redelivery_failpoint(self, sess):
+        """The in-vivo fence drill: every completion is immediately
+        redelivered; the second landing must be dropped and results
+        stay correct."""
+        srvs = _servers(sess, 1)
+        failpoint.enable("dcn/duplicate-redelivery", True)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", srvs[0].port)], catalog=sess.catalog
+        )
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+        finally:
+            failpoint.disable("dcn/duplicate-redelivery")
+            sched.close()
+            srvs[0].shutdown()
+
+    def test_heartbeat_quarantines_after_misses(self, sess):
+        srvs = _servers(sess, 2)
+        port1 = srvs[1].port
+        srvs[1].shutdown()
+        prober = FailedEngineProber(initial_backoff_s=30)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", srvs[0].port), ("127.0.0.1", port1)],
+            catalog=sess.catalog, prober=prober,
+        )
+        try:
+            assert sched.heartbeat.beat_once() == []  # 1st miss: suspect
+            lost = sched.heartbeat.beat_once()  # 2nd miss: quarantine
+            assert [ep.port for ep in lost] == [port1]
+            assert [ep.port for ep in prober.failed_endpoints()] == [port1]
+            # the survivor still answers queries (fewer fragments)
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+        finally:
+            sched.close()
+            srvs[0].shutdown()
+
+    def test_heartbeat_timeout_failpoint(self, sess):
+        srvs = _servers(sess, 1)
+        prober = FailedEngineProber(initial_backoff_s=30)
+        hb = HostHeartbeat(
+            sched_endpoints(srvs), prober, miss_threshold=2
+        )
+        failpoint.enable("dcn/heartbeat-timeout", True)
+        try:
+            assert hb.beat_once() == []
+            lost = hb.beat_once()
+            assert len(lost) == 1  # forced misses quarantine a live host
+        finally:
+            failpoint.disable("dcn/heartbeat-timeout")
+            srvs[0].shutdown()
+
+    def test_all_hosts_down_raises(self, sess):
+        srvs = _servers(sess, 1)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", srvs[0].port)], catalog=sess.catalog,
+            max_attempts=2,
+            prober=FailedEngineProber(initial_backoff_s=30),
+        )
+        srvs[0].shutdown()
+        try:
+            with pytest.raises(ConnectionError):
+                sched.execute_plan(_plan(sess, GROUPED))
+        finally:
+            sched.close()
+
+
+def sched_endpoints(srvs):
+    from tidb_tpu.server.engine_pool import EngineEndpoint
+
+    return [EngineEndpoint("127.0.0.1", s.port) for s in srvs]

@@ -1,20 +1,50 @@
-"""Multi-host (DCN analog) bring-up: 2 processes x 4 virtual CPU devices
-form one 8-device mesh via jax.distributed; the SQL parity suite runs
-through it in multi-controller SPMD style.
+"""Multi-host (DCN analog) tests: 2 processes x 4 virtual CPU devices.
+
+Two complementary shapes (both 2-process x 4-device dryruns):
+
+1. multi-controller SPMD — both processes run the same program over one
+   8-device global mesh via jax.distributed (coordinator = PD analog);
+   collectives ride the inter-process transport (DCN on real slices).
+2. coordinator/worker MPP — the DCN fragment scheduler
+   (parallel/dcn.py) dispatches per-host fragment plans over the
+   engine-RPC seam to two worker processes, each executing SPMD on its
+   own 4-device mesh (hierarchical shuffle: ICI within the host,
+   host-staged exchange between), with partial-agg-before-DCN and
+   failure recovery (kill-one-worker retry parity below).
 
 Reference: cross-store MPP dispatch over gRPC (pkg/store/copr/mpp.go:93)
-and PD-coordinated membership — replaced by the JAX distributed runtime
-(coordinator = PD analog), with the engine unchanged: the mesh axis just
-spans two processes and exchange collectives ride the inter-process
-transport (DCN on real slices).
+with PD-coordinated membership, and the MPP recovery loop
+(pkg/executor/internal/mpp/recovery_handler.go:26).
 """
 
 import os
+import re
 import socket
 import subprocess
 import sys
 
 import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+#: the TPC-H subset both dryruns assert parity on: scalar aggregate
+#: (Q6 shape), grouped aggregate with avg (Q1 shape), join + group-by
+#: (Q4/Q18 shape), top-k group-by
+TPCH_QUERIES = [
+    "select sum(l_extendedprice * l_discount) from lineitem "
+    "where l_discount between 0.05 and 0.07 and l_quantity < 24",
+    "select l_returnflag, l_linestatus, sum(l_quantity), "
+    "sum(l_extendedprice), avg(l_discount), count(*) from lineitem "
+    "where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus",
+    "select o_orderpriority, count(*) from orders join lineitem "
+    "on o_orderkey = l_orderkey where l_quantity < 10 "
+    "group by o_orderpriority order by o_orderpriority",
+    "select l_suppkey, count(*) from lineitem group by l_suppkey "
+    "order by count(*) desc, l_suppkey limit 5",
+]
 
 
 def _free_port() -> int:
@@ -25,23 +55,26 @@ def _free_port() -> int:
     return p
 
 
-def test_two_process_mesh_sql_parity():
-    here = os.path.dirname(os.path.abspath(__file__))
-    worker = os.path.join(here, "_multihost_worker.py")
-    coord = f"127.0.0.1:{_free_port()}"
+def _worker_env() -> dict:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # the pytest process forces an 8-device host platform (conftest);
     # each worker must contribute exactly 4
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    return env
+
+
+def test_two_process_mesh_sql_parity():
+    worker = os.path.join(HERE, "_multihost_worker.py")
+    coord = f"127.0.0.1:{_free_port()}"
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(i), "2", coord],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
-            env=env,
+            env=_worker_env(),
         )
         for i in range(2)
     ]
@@ -57,3 +90,119 @@ def test_two_process_mesh_sql_parity():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert "MULTIHOST_OK" in out, out[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# DCN fragment scheduler dryruns (coordinator here, 2 worker processes)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_dcn_worker(extra=()):
+    p = subprocess.Popen(
+        [
+            sys.executable, "-m", "tidb_tpu.parallel.dcn_worker",
+            "--port", "0", "--mesh-devices", "4",
+            "--tpch-sf", "0.002", "--seed", "3",
+            "--tables", "orders,lineitem", *extra,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_worker_env(),
+        cwd=REPO,
+    )
+    line = p.stdout.readline()
+    m = re.match(r"DCN_WORKER_READY port=(\d+)", line)
+    if not m:
+        rest = ""
+        try:
+            rest, _ = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        raise AssertionError(f"worker not ready: {line!r}\n{rest[-3000:]}")
+    return p, int(m.group(1))
+
+
+@pytest.fixture()
+def tpch_single():
+    """Single-process reference session over the same deterministic
+    data every worker loads."""
+    from tidb_tpu.bench import load_tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+
+    cat = Catalog()
+    load_tpch(cat, sf=0.002, seed=3, tables=["orders", "lineitem"])
+    return Session(cat, db="tpch")
+
+
+def _plan(sess, q):
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+
+    return build_query(
+        parse(q)[0], sess.catalog, "tpch", sess._scalar_subquery
+    )
+
+
+def test_dcn_fragment_scheduler_tpch_parity(tpch_single):
+    """2-process x 4-device dryrun: the TPC-H subset runs through the
+    cross-host fragment scheduler with results identical to
+    single-process execution."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+    )
+    try:
+        for q in TPCH_QUERIES:
+            exp = tpch_single.must_query(q).rows
+            _cols, got = sched.execute_plan(_plan(tpch_single, q))
+            assert got == exp, f"{q}\n got={got}\n exp={exp}"
+        # every query fanned out: both hosts stayed in rotation
+        assert len(sched.alive_endpoints()) == 2
+    finally:
+        sched.close()
+        for w in (w1, w2):
+            w.kill()
+
+
+def test_dcn_worker_death_mid_query_retry_parity(tpch_single):
+    """Failpoint-killed worker mid-query: worker 2 hard-exits AFTER
+    computing its first fragment but BEFORE replying (the
+    dcn/result-send site — work done, reply lost). The coordinator must
+    quarantine it, re-dispatch the fragment onto the survivor, and
+    still return correct results exactly once."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_pool import FailedEngineProber
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker(
+        ["--die-on-fragment", "1", "--die-at", "result-send"]
+    )
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        prober=FailedEngineProber(initial_backoff_s=60),
+    )
+    try:
+        q = TPCH_QUERIES[2]  # join + group-by
+        exp = tpch_single.must_query(q).rows
+        _cols, got = sched.execute_plan(_plan(tpch_single, q))
+        assert got == exp, f"\n got={got}\n exp={exp}"
+        # the dead worker was quarantined, and really died via os._exit
+        assert [e.port for e in sched.prober.failed_endpoints()] == [p2]
+        w2.wait(timeout=30)
+        assert w2.returncode == 3
+        # the survivor keeps serving (fewer fragments per query)
+        q2 = TPCH_QUERIES[0]
+        exp2 = tpch_single.must_query(q2).rows
+        _cols, got2 = sched.execute_plan(_plan(tpch_single, q2))
+        assert got2 == exp2
+    finally:
+        sched.close()
+        for w in (w1, w2):
+            w.kill()

@@ -21,6 +21,7 @@ from tidb_tpu.parallel import (
     partitioned_join,
     shard_batch,
 )
+from tidb_tpu.parallel.mesh import shard_map
 
 N = 8
 
@@ -56,7 +57,7 @@ class TestRepartition:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
+            shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P())
         )
         def step(b):
             out, dropped, need = hash_repartition(b, colfn("g"), N, 512)
@@ -85,7 +86,7 @@ class TestRepartition:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P()),
         )
         def step(b):
@@ -113,7 +114,7 @@ class TestDistributedAgg:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
+            shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
         )
         def step(b):
             out, ng, dropped, _need = distributed_group_aggregate(
@@ -151,7 +152,7 @@ class TestDistributedAgg:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
+            shard_map, mesh=mesh, in_specs=P("d"), out_specs=(P("d"), P(), P())
         )
         def step(b):
             return distributed_group_aggregate(b, [], [AggDesc("sum", colfn("v"), "s")], 64, N)[:3]
@@ -195,7 +196,7 @@ class TestDistributedJoin:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P(), P())
+            shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P(), P())
         )
         def step(b, p):
             return partitioned_join(
@@ -221,7 +222,7 @@ class TestDistributedJoin:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P())
+            shard_map, mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P())
         )
         def step(b, p):
             return broadcast_join(b, p, colfn("bk"), colfn("pk"), 1024, "inner")

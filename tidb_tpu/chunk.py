@@ -286,6 +286,11 @@ def batch_to_block(
     idx = np.nonzero(np.asarray(row_valid))[0]
     cols = {}
     for name, (data, valid) in host_cols.items():
+        if name not in types:
+            # additive projections keep base columns in the runtime
+            # batch; only the plan schema's columns materialize (matters
+            # for additive-rooted fragment plans over the RPC seam)
+            continue
         cols[name] = HostColumn(
             types[name], np.asarray(data)[idx], np.asarray(valid)[idx], dicts.get(name)
         )

@@ -66,6 +66,20 @@ def register_task_type(name, planner, runner, finalizer=None, reverter=None):
 HEARTBEAT_TTL_S = 5.0
 
 
+def fence_accepts(owner, state, reporter, running_state) -> bool:
+    """The subtask-ledger idempotence fence (reference: framework/storage
+    subtask state + exec id): a completion report lands iff it comes
+    from the CURRENT owner of the work while the work is still in
+    flight. Late reports from superseded owners (rebalanced / DCN
+    re-dispatched work) and duplicate redeliveries of already-landed
+    work are dropped, so every result is incorporated exactly once.
+    Shared by TaskManager.finish_subtask and the DCN fragment
+    scheduler's ledger (parallel/dcn.py)."""
+    if reporter is not None and owner != reporter:
+        return False
+    return state == running_state
+
+
 class TaskManager:
     """Owner-side state store + scheduler loop over the system tables.
 
@@ -298,12 +312,9 @@ class TaskManager:
             # must not accept that executor's late report (otherwise the
             # work lands twice — the reference fences via subtask state
             # + exec id in framework/storage)
-            if (
-                executor_id is not None
-                and (
-                    s.get("executor_id") != executor_id
-                    or s["state"] != SubtaskState.RUNNING.value
-                )
+            if executor_id is not None and not fence_accepts(
+                s.get("executor_id"), s["state"],
+                executor_id, SubtaskState.RUNNING.value,
             ):
                 return
             s["state"] = (

@@ -10,3 +10,13 @@ from tidb_tpu.parallel.fragment import (  # noqa: F401
     broadcast_join,
     repartition_pair,
 )
+
+
+def __getattr__(name):
+    # the DCN scheduler imports server/planner layers; lazy so the light
+    # mesh helpers above stay importable without pulling the whole stack
+    if name in ("DCNFragmentScheduler", "FragmentLedger", "HostHeartbeat"):
+        from tidb_tpu.parallel import dcn
+
+        return getattr(dcn, name)
+    raise AttributeError(name)

@@ -1,0 +1,465 @@
+"""Cross-host DCN fragment scheduler with failure recovery.
+
+Reference: the MPP dispatch triplet — `DispatchMPPTask` fanning
+fragments across stores (pkg/store/copr/mpp.go:93), the failed-store
+prober quarantining and re-admitting stores (mpp_probe.go:33), and
+`ExecutorWithRetry`/`RecoveryHandler` re-running an MPP query on the
+survivors (pkg/executor/internal/mpp/recovery_handler.go:26).
+
+TPU-native shape (hierarchical comms):
+
+    coordinator ──plan IR──▶ worker host 0: engine over a local device
+        │                        mesh (ICI all_to_all exchanges)
+        ├───────plan IR──────▶ worker host 1: same, rows frag-sliced
+        ◀──partial agg rows──┘
+    final merge + ORDER BY/LIMIT on the coordinator's local engine
+
+planner/fragmenter.py cuts the plan at the topmost Aggregate and slices
+one scan per host; each worker reduces its slice to PARTIAL aggregate
+rows before anything crosses the inter-host link (partial-agg-before-
+DCN), then the coordinator merges partials through the engine's own
+final-aggregate path over a Staged batch. Intra-host parallelism stays
+on the worker's ICI mesh; the coordinator RPC seam is the host-staged
+DCN exchange.
+
+Robustness is part of the subsystem:
+- heartbeat liveness per worker host (HostHeartbeat) feeding the same
+  FailedEngineProber quarantine/backoff machinery the engine pool uses;
+- transport loss during dispatch quarantines the host and re-dispatches
+  the fragment onto a survivor (the slice is data-defined, so any host
+  can compute any fragment);
+- a FragmentLedger built on the DXF subtask-ledger fence
+  (dxf/framework.fence_accepts) incorporates each fragment's rows
+  exactly once — a late or duplicate delivery after re-dispatch is
+  dropped, the work-done-reply-lost ambiguity resolved coordinator-side.
+
+Failpoint sites: dcn/dispatch, dcn/dispatch-lost, dcn/redispatch,
+dcn/heartbeat-timeout, dcn/duplicate-redelivery, dcn/final-stage
+(coordinator) and dcn/fragment-execute, dcn/result-send (worker,
+server/engine_rpc.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.dxf.framework import fence_accepts
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.fragmenter import FragmentPlan, split_plan
+from tidb_tpu.server.engine_pool import (
+    EngineEndpoint,
+    FailedEngineProber,
+    ping_endpoint,
+)
+from tidb_tpu.server.engine_rpc import EngineClient, SchemaOutOfDateError
+from tidb_tpu.utils.failpoint import inject
+
+_STAGED_NONCE = itertools.count(1 << 20)  # disjoint from streamed.py's
+_QUERY_ID = itertools.count(1)
+
+
+class HostHeartbeat:
+    """Per-host liveness: ping every alive endpoint on a cadence;
+    `miss_threshold` consecutive misses quarantine the host into the
+    prober (which owns recovery with exponential backoff). Detection
+    and recovery are deliberately split across the two components the
+    way the reference splits detect (dispatch/probe failures) from
+    recover (mpp_probe.go's prober goroutine)."""
+
+    def __init__(
+        self,
+        endpoints: List[EngineEndpoint],
+        prober: FailedEngineProber,
+        interval_s: float = 0.0,
+        timeout_s: float = 2.0,
+        miss_threshold: int = 2,
+    ):
+        self.endpoints = endpoints
+        self.prober = prober
+        self.timeout_s = timeout_s
+        self.miss_threshold = miss_threshold
+        self._misses: Dict[EngineEndpoint, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, args=(interval_s,), daemon=True,
+                name="dcn-heartbeat",
+            )
+            self._thread.start()
+
+    def beat_once(self) -> List[EngineEndpoint]:
+        """Ping every alive host; returns hosts quarantined this beat."""
+        lost = []
+        for ep in list(self.endpoints):
+            if not ep.alive:
+                continue
+            ok = not inject("dcn/heartbeat-timeout") and ping_endpoint(
+                ep, timeout_s=self.timeout_s
+            )
+            if ok:
+                self._misses[ep] = 0
+                continue
+            self._misses[ep] = self._misses.get(ep, 0) + 1
+            if self._misses[ep] >= self.miss_threshold:
+                self.prober.detect(ep)
+                lost.append(ep)
+        return lost
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.beat_once()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class FragmentLedger:
+    """Exactly-once fragment accounting for one query — the DXF
+    subtask-ledger pattern (dxf/tasks.py staged-file fences,
+    framework.fence_accepts) applied to in-flight MPP fragments. A
+    fragment's rows land iff the delivery carries the token of the
+    CURRENT attempt while the fragment is still inflight; anything else
+    (a zombie host's late reply after re-dispatch, a duplicate
+    redelivery) is counted and dropped."""
+
+    def __init__(self, n_fragments: int):
+        self._lock = threading.Lock()
+        self._recs = {
+            fid: {"state": "pending", "owner": None, "attempts": 0,
+                  "rows": None}
+            for fid in range(n_fragments)
+        }
+        self.duplicates_dropped = 0
+
+    def claim(self, fid: int, host: str) -> str:
+        with self._lock:
+            rec = self._recs[fid]
+            if rec["state"] != "pending":
+                raise RuntimeError(f"fragment {fid} is {rec['state']}")
+            rec["attempts"] += 1
+            rec["state"] = "inflight"
+            rec["owner"] = f"{host}#{rec['attempts']}"
+            return rec["owner"]
+
+    def release(self, fid: int, token: str) -> None:
+        """Transport failure: the attempt is dead, the fragment goes
+        back to pending (only the token holder may release)."""
+        with self._lock:
+            rec = self._recs[fid]
+            if rec["state"] == "inflight" and rec["owner"] == token:
+                rec["state"] = "pending"
+                rec["owner"] = None
+
+    def complete(self, fid: int, token: str, rows: List[tuple]) -> bool:
+        with self._lock:
+            rec = self._recs[fid]
+            if not fence_accepts(rec["owner"], rec["state"], token, "inflight"):
+                self.duplicates_dropped += 1
+                return False
+            rec["state"] = "done"
+            rec["rows"] = rows
+        if inject("dcn/duplicate-redelivery"):
+            # exercise the fence in vivo: redeliver the same result; the
+            # second landing must be dropped
+            assert self.complete(fid, token, rows) is False
+        return True
+
+    def pending(self) -> List[int]:
+        with self._lock:
+            return [
+                fid for fid, r in self._recs.items()
+                if r["state"] == "pending"
+            ]
+
+    def attempts(self, fid: int) -> int:
+        with self._lock:
+            return self._recs[fid]["attempts"]
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return all(r["state"] == "done" for r in self._recs.values())
+
+    def rows(self) -> List[tuple]:
+        """All fragments' rows, fragment order (deterministic)."""
+        with self._lock:
+            out = []
+            for fid in sorted(self._recs):
+                out.extend(self._recs[fid]["rows"] or [])
+            return out
+
+
+class DCNFragmentScheduler:
+    """Coordinator: split a bound logical plan into per-host fragments,
+    dispatch them over the engine-RPC seam, gather partials exactly
+    once, and run the final stage on a local engine."""
+
+    def __init__(
+        self,
+        endpoints: List[Tuple[str, int]],
+        secret: Optional[str] = None,
+        prober: Optional[FailedEngineProber] = None,
+        catalog=None,
+        max_attempts: int = 4,
+        heartbeat_interval_s: float = 0.0,
+        dispatch_timeout_s: float = 600.0,
+    ):
+        if not endpoints:
+            raise ValueError("DCN scheduler needs at least one worker host")
+        self.endpoints = [EngineEndpoint(h, p, secret) for h, p in endpoints]
+        self.prober = prober or FailedEngineProber()
+        self.heartbeat = HostHeartbeat(
+            self.endpoints, self.prober, interval_s=heartbeat_interval_s
+        )
+        self.max_attempts = max_attempts
+        # first dispatch on a fresh worker pays the fragment's XLA
+        # compile; the RPC read must outlast it
+        self.dispatch_timeout_s = dispatch_timeout_s
+        # catalog: schemas/stats for fragment planning and the final
+        # stage's local engine (no data required — the final stage's
+        # only source is the Staged partials batch)
+        if catalog is None:
+            from tidb_tpu.storage import Catalog
+
+            catalog = Catalog()
+        self.catalog = catalog
+        from tidb_tpu.planner.physical import PhysicalExecutor
+
+        self._executor = PhysicalExecutor(catalog)
+        self._lock = threading.Lock()
+        self._conns: Dict[EngineEndpoint, EngineClient] = {}
+        # strict request/response stream per connection: concurrent
+        # fragments to one host serialize on its lock (same invariant as
+        # PooledEngineClient)
+        self._conn_locks: Dict[EngineEndpoint, threading.Lock] = {}
+        self._rr = 0
+
+    # -- host/connection management ------------------------------------
+    def alive_endpoints(self) -> List[EngineEndpoint]:
+        return [ep for ep in self.endpoints if ep.alive]
+
+    def _next_alive(self, exclude=()) -> Optional[EngineEndpoint]:
+        with self._lock:
+            alive = [
+                ep for ep in self.endpoints
+                if ep.alive and ep not in exclude
+            ] or [ep for ep in self.endpoints if ep.alive]
+            if not alive:
+                return None
+            ep = alive[self._rr % len(alive)]
+            self._rr += 1
+            return ep
+
+    def _ep_lock(self, ep: EngineEndpoint) -> threading.Lock:
+        with self._lock:
+            lk = self._conn_locks.get(ep)
+            if lk is None:
+                lk = self._conn_locks[ep] = threading.Lock()
+            return lk
+
+    def _conn(self, ep: EngineEndpoint) -> EngineClient:
+        c = self._conns.get(ep)
+        if c is None or c._dead:
+            c = EngineClient(
+                ep.host, ep.port, secret=ep.secret,
+                timeout_s=self.dispatch_timeout_s,
+            )
+            self._conns[ep] = c
+        return c
+
+    def _drop_conn(self, ep: EngineEndpoint) -> None:
+        c = self._conns.pop(ep, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self.heartbeat.stop()
+        for ep in list(self._conns):
+            self._drop_conn(ep)
+        self.prober.stop()
+
+    # -- dispatch -------------------------------------------------------
+    def _dispatch(self, ep, plan, frag_meta):
+        """One fragment dispatch on one host. Transport failures raise;
+        engine-side execution errors raise RuntimeError (no failover —
+        they reproduce everywhere)."""
+        inject("dcn/dispatch")
+        if inject("dcn/dispatch-lost"):
+            raise ConnectionError("failpoint: dispatch lost in transit")
+        with self._ep_lock(ep):
+            conn = self._conn(ep)
+            try:
+                return conn.execute_plan(plan, frag=frag_meta)
+            except (SchemaOutOfDateError, RuntimeError, ValueError,
+                    PermissionError):
+                raise
+            except Exception:
+                self._drop_conn(ep)
+                raise
+
+    def _quarantine(self, ep: EngineEndpoint) -> None:
+        with self._ep_lock(ep):
+            self._drop_conn(ep)
+        self.prober.detect(ep)
+
+    # -- query execution ------------------------------------------------
+    def execute_plan(self, plan: L.LogicalPlan) -> Tuple[List[str], List[tuple]]:
+        """Run a bound logical plan across the worker hosts. Falls back
+        to whole-plan single-host dispatch when no safe fragment split
+        exists; either path survives worker loss up to max_attempts."""
+        frag = split_plan(plan, self.catalog)
+        if frag is None:
+            return self._execute_single(plan)
+        qid = next(_QUERY_ID)
+        n = max(len(self.alive_endpoints()), 1)
+        ledger = FragmentLedger(n)
+        last_err: Optional[Exception] = None
+        for _round in range(self.max_attempts):
+            pending = ledger.pending()
+            if not pending:
+                break
+            # quarantined hosts get their recovery shot before the pool
+            # is declared exhausted (probe respects backoff)
+            if not self.alive_endpoints():
+                self.prober.probe_once()
+                if not self.alive_endpoints():
+                    break
+            # assign each pending fragment a host; distinct hosts first,
+            # wrap when fragments outnumber survivors
+            assignments = []
+            taken: List[EngineEndpoint] = []
+            for fid in pending:
+                ep = self._next_alive(exclude=taken)
+                if ep is None:
+                    break
+                taken.append(ep)
+                assignments.append((fid, ep))
+            errs: List[Tuple[EngineEndpoint, Exception]] = []
+
+            def run_one(fid: int, ep: EngineEndpoint):
+                token = ledger.claim(fid, ep.address)
+                if ledger.attempts(fid) > 1:
+                    inject("dcn/redispatch")
+                meta = {
+                    "qid": qid, "fid": fid, "n": n,
+                    "attempt": ledger.attempts(fid),
+                }
+                try:
+                    _cols, rows = self._dispatch(
+                        ep, frag.host_plan(fid, n), meta
+                    )
+                except (SchemaOutOfDateError, RuntimeError, ValueError,
+                        PermissionError):
+                    raise  # deterministic: re-raise to the caller thread
+                except Exception as e:  # transport: quarantine + retry
+                    ledger.release(fid, token)
+                    errs.append((ep, e))
+                    return
+                ledger.complete(fid, token, rows)
+
+            fatal: List[Exception] = []
+
+            def runner(fid, ep):
+                try:
+                    run_one(fid, ep)
+                except Exception as e:
+                    fatal.append(e)
+
+            threads = [
+                threading.Thread(
+                    target=runner, args=(fid, ep), daemon=True,
+                    name=f"dcn-q{qid}-f{fid}",
+                )
+                for fid, ep in assignments
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if fatal:
+                raise fatal[0]
+            for ep, e in errs:
+                last_err = e
+                self._quarantine(ep)
+        if not ledger.all_done():
+            raise ConnectionError(
+                f"fragments {ledger.pending()} undispatchable after "
+                f"{self.max_attempts} rounds "
+                f"({len(self.endpoints)} hosts, "
+                f"{len(self.alive_endpoints())} alive); last error: "
+                f"{last_err}"
+            )
+        return self._final_stage(frag, ledger.rows())
+
+    def _execute_single(self, plan) -> Tuple[List[str], List[tuple]]:
+        """Whole-plan dispatch onto one host (shapes with no safe
+        split): the ExecutorWithRetry loop over survivors."""
+        last_err: Optional[Exception] = None
+        for _attempt in range(self.max_attempts):
+            if not self.alive_endpoints():
+                self.prober.probe_once()
+            ep = self._next_alive()
+            if ep is None:
+                break
+            try:
+                inject("dcn/dispatch")
+                if inject("dcn/dispatch-lost"):
+                    raise ConnectionError("failpoint: dispatch lost in transit")
+                with self._ep_lock(ep):
+                    conn = self._conn(ep)
+                    return conn.execute_plan(plan)
+            except (SchemaOutOfDateError, RuntimeError, ValueError,
+                    PermissionError):
+                raise
+            except Exception as e:
+                last_err = e
+                self._quarantine(ep)
+        raise ConnectionError(
+            f"no alive worker host after {self.max_attempts} attempts; "
+            f"last error: {last_err}"
+        )
+
+    # -- final stage ----------------------------------------------------
+    def _final_stage(self, frag: FragmentPlan, rows: List[tuple]):
+        """Coordinator-side merge: stage the gathered partial rows as a
+        device batch and run the final plan (final aggregate + HAVING/
+        projections/ORDER BY/LIMIT) through the ordinary engine — the
+        root MPP fragment executing at the coordinator."""
+        inject("dcn/final-stage")
+        from tidb_tpu.chunk import (
+            HostBlock,
+            block_to_batch,
+            column_from_values,
+            materialize_rows,
+            pad_capacity,
+        )
+
+        cols = {}
+        dicts = {}
+        for i, oc in enumerate(frag.partial_schema.cols):
+            hc = column_from_values([r[i] for r in rows], oc.type)
+            cols[oc.internal] = hc
+            if hc.dictionary is not None:
+                dicts[oc.internal] = hc.dictionary
+        block = HostBlock(cols, len(rows))
+        batch = block_to_batch(block, pad_capacity(max(len(rows), 1)))
+        staged = L.Staged(
+            frag.partial_schema, batch=batch, dicts=dicts,
+            nonce=next(_STAGED_NONCE),
+        )
+        final = frag.final_builder(staged)
+        out, out_dicts = self._executor.run(final)
+        out_rows = materialize_rows(out, list(final.schema), out_dicts)
+        return [c.name for c in final.schema], out_rows

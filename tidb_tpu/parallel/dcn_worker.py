@@ -1,0 +1,118 @@
+"""Worker-host process for the DCN fragment scheduler.
+
+One worker = one EngineServer over a local catalog, executing dispatched
+fragment plans SPMD on its own device mesh (intra-host ICI exchanges).
+Every worker of a job loads identical deterministic data, so any host
+can compute any fragment slice — which is what makes re-dispatch onto
+survivors correct (parallel/dcn.py).
+
+Run as a module:
+
+    python -m tidb_tpu.parallel.dcn_worker \
+        --port 0 --mesh-devices 4 --tpch-sf 0.002 --seed 3 \
+        --tables orders,lineitem
+
+Prints ``DCN_WORKER_READY port=<p>`` on stdout once serving; the parent
+reads the line to learn the bound port.
+
+Fault injection for the kill-one-worker tests: --die-on-fragment K
+arms the worker-side dcn failpoints so the process hard-exits
+(os._exit — no reply frame, no cleanup: real crash semantics) on its
+K-th fragment execution; --die-at picks the site: ``execute`` (before
+the work — the fragment is simply lost) or ``result-send`` (after the
+work, before the reply — the duplicate-redelivery hazard the
+coordinator ledger must fence)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_early(local_devices: int) -> None:
+    """CPU forcing + virtual device count, BEFORE any jax import
+    (mirrors utils/backend.force_cpu — inlined because it must run
+    before tidb_tpu's import chain initializes the backend)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={local_devices}"
+        ).strip()
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--secret", default=None)
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="intra-host mesh width; 0 = single device")
+    ap.add_argument("--cpu", action="store_true", default=True,
+                    help="force the CPU backend (default; dryrun mode)")
+    ap.add_argument("--tpch-sf", type=float, default=0.0,
+                    help="load TPC-H at this scale factor into db 'tpch'")
+    ap.add_argument("--tables", default="orders,lineitem")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--die-on-fragment", type=int, default=0,
+                    help="hard-exit on the K-th dispatched fragment")
+    ap.add_argument("--die-at", choices=["execute", "result-send"],
+                    default="execute")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        _force_cpu_early(max(args.mesh_devices, 1))
+
+    from tidb_tpu.server.engine_rpc import EngineServer
+    from tidb_tpu.storage import Catalog
+    from tidb_tpu.utils import failpoint
+
+    cat = Catalog()
+    if args.tpch_sf > 0:
+        from tidb_tpu.bench import load_tpch
+
+        load_tpch(
+            cat, sf=args.tpch_sf, seed=args.seed,
+            tables=[t for t in args.tables.split(",") if t],
+        )
+
+    if args.die_on_fragment > 0:
+        site = (
+            "dcn/fragment-execute" if args.die_at == "execute"
+            else "dcn/result-send"
+        )
+        failpoint.enable(
+            site,
+            failpoint.after_n(
+                args.die_on_fragment, lambda: os._exit(3)
+            ),
+        )
+
+    srv = EngineServer(
+        cat, host=args.host, port=args.port, secret=args.secret,
+        mesh_devices=args.mesh_devices or None,
+    )
+    print(f"DCN_WORKER_READY port={srv.port}", flush=True)
+    try:
+        srv._tcp.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

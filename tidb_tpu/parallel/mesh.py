@@ -22,6 +22,40 @@ from tidb_tpu.chunk import Batch
 AXIS = "d"
 
 
+# -- jax API compat ---------------------------------------------------------
+# `jax.shard_map` / `jax.sharding.reshard` are the modern spellings; the
+# pinned jax (0.4.x) only has the experimental/constraint forms. One
+# shim here so every SPMD call site (planner/physical.py, tests) works
+# on both — without it the whole mesh mode dies with AttributeError.
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax<0.5: experimental form, whose replication checker predates
+    # rules for `while` (the aggregation claim loop) — disable it; the
+    # engine's out_specs declare the replication contract explicitly
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, **kw):
+        kw.setdefault("check_rep", False)
+        if f is None:
+            return _functools.partial(shard_map, **kw)
+        return _shard_map_exp(f, **kw)
+
+
+def reshard(a, sharding):
+    """jax.sharding.reshard(a, s) on new jax; on old jax a sharding
+    constraint under tracing and a device_put eagerly."""
+    if hasattr(jax.sharding, "reshard"):
+        return jax.sharding.reshard(a, sharding)
+    from jax import core as _core
+
+    if isinstance(a, _core.Tracer):
+        return jax.lax.with_sharding_constraint(a, sharding)
+    return jax.device_put(a, sharding)
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
@@ -57,6 +91,17 @@ def init_multihost(
                 flags
                 + f" --xla_force_host_platform_device_count={local_device_count}"
             ).strip()
+    try:
+        # CPU dryruns need an inter-process collectives transport; jax
+        # 0.4.x defaults to 'none' ("Multiprocess computations aren't
+        # implemented on the CPU backend"). Newer jax picks gloo itself
+        # and drops the knob — hence best-effort.
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -1,0 +1,304 @@
+"""Cross-host MPP fragment planning over the serializable plan IR.
+
+Reference: fragment cutting at exchange boundaries
+(pkg/planner/core/fragment.go:47,149) and the partial/final aggregate
+split the MPP engine runs across stores. Here the cut point is the
+topmost Aggregate: everything below it (scans, filters, joins, the
+PARTIAL aggregation) ships to worker hosts as ordinary plan IR with one
+scan fragment-sliced per host; everything above it (final merge, HAVING,
+projections, ORDER BY, LIMIT) runs on the coordinator's local engine
+over a Staged batch built from the gathered partials. Partial-agg-
+before-DCN is the point: hosts reduce their slice to group rows before
+anything crosses the inter-host link (SURVEY §2.8; the same byte-
+minimizing shape as Enhancing Computation Pushdown, arxiv 2312.15405).
+
+Within a host the fragment still executes on the host's own device mesh
+(ICI all_to_all exchanges, parallel/exchange.py) — the hierarchical
+shuffle: intra-host collectives below, host-staged exchange above.
+
+The decomposition mirrors logical.py's _expand_distinct_aggs idiom:
+  count -> partial count, final sum
+  sum/min/max/first -> partial f, final f
+  avg -> partial sum+count, final sums + a float64 division Projection
+DISTINCT aggregates and shapes without a safely partitionable scan fall
+back to whole-plan dispatch onto a single host (still correct — the
+scheduler's retry/failover applies either way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+from tidb_tpu.dtypes import INT64, FLOAT64, Kind
+from tidb_tpu.expression.expr import ColumnRef, Func, Literal
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.logical import OutCol, Schema
+from tidb_tpu.planner.streamed import _replace_node
+
+
+class Unschedulable(ValueError):
+    """The plan cannot cross the engine seam at all (e.g. GROUP_CONCAT
+    host-assisted shapes) — not even single-host dispatch applies."""
+
+
+@dataclasses.dataclass
+class FragmentPlan:
+    """One query split into per-host fragments + a coordinator stage."""
+
+    #: host-side plan template; per host the frag_scan gets its slice
+    template: L.LogicalPlan
+    #: the Scan inside `template` carrying the (idx, n) fragment slice
+    frag_scan: L.Scan
+    #: schema of the rows each host fragment returns (the exchange wire
+    #: schema: group keys + partial aggregation columns)
+    partial_schema: Schema
+    #: staged-source plan node -> full coordinator plan (final agg merge
+    #: + everything that was above the cut)
+    final_builder: Callable[[L.LogicalPlan], L.LogicalPlan]
+
+    def host_plan(self, idx: int, n_hosts: int) -> L.LogicalPlan:
+        """The plan host `idx` of `n_hosts` executes: the template with
+        the partitioned scan sliced to every n_hosts-th row. The slice
+        is data-defined, not host-defined — a fragment re-dispatched to
+        a survivor host computes the same rows."""
+        sliced = dataclasses.replace(self.frag_scan, frag=(idx, n_hosts))
+        return _replace_node(self.template, self.frag_scan, sliced)
+
+
+# -- partitionable-scan discovery -------------------------------------------
+
+
+def _candidate_scans(p: L.LogicalPlan, out: List[L.Scan]) -> None:
+    """Scans that may be fragment-sliced: the path from the cut child
+    down must cross only row-wise operators (Selection/Projection) and
+    join sides whose rows are independently complete — both sides of an
+    inner/cross join, only the probe (left/preserved) side of
+    left/semi/anti/mark joins. Aggregates, windows, sorts, limits and
+    UnionAll below the cut pin their subtree to whole-data execution."""
+    if isinstance(p, L.Scan):
+        if "_tidb_rowid" not in p.columns:
+            out.append(p)
+        return
+    if isinstance(p, (L.Selection, L.Projection)):
+        _candidate_scans(p.child, out)
+        return
+    if isinstance(p, L.JoinPlan):
+        if p.kind in ("inner", "cross"):
+            _candidate_scans(p.left, out)
+            _candidate_scans(p.right, out)
+        else:  # left/semi/anti/mark: only the preserved/probe side
+            _candidate_scans(p.left, out)
+        return
+    # anything else (Aggregate, Window, Sort, Limit, UnionAll, Staged,
+    # OneRow): no candidates beneath
+
+
+def _pick_frag_scan(lower: L.LogicalPlan, catalog) -> Optional[L.Scan]:
+    cands: List[L.Scan] = []
+    _candidate_scans(lower, cands)
+    if not cands:
+        return None
+    if catalog is None:
+        return cands[0]
+
+    def size(s: L.Scan) -> int:
+        try:
+            return int(catalog.table(s.db, s.table).nrows)
+        except Exception:
+            return 0
+
+    # slice the fact side: the largest table dominates both scan bytes
+    # and partial-agg work (batch_coprocessor.go balances by region
+    # bytes the same way)
+    return max(cands, key=size)
+
+
+def plan_has_frag(p: L.LogicalPlan) -> bool:
+    if isinstance(p, L.Scan):
+        return p.frag is not None
+    for attr in ("child", "left", "right"):
+        c = getattr(p, attr, None)
+        if c is not None and plan_has_frag(c):
+            return True
+    return any(plan_has_frag(c) for c in getattr(p, "children", []) or [])
+
+
+# -- partial/final aggregate decomposition ----------------------------------
+
+
+_COMBINABLE = ("count", "sum", "min", "max", "first", "avg")
+
+
+def _decompose_aggs(agg: L.Aggregate):
+    """(partial aggs+cols, final aggs, avg fixups) or None when a
+    function does not decompose (the caller falls back to single-host).
+    Types follow the binder's rules so the final stage's output schema
+    is bit-identical to the original Aggregate's."""
+    otypes = {c.internal: c.type for c in agg.schema.cols}
+    partial: List[Tuple[str, str, object, bool]] = []
+    pcols: List[OutCol] = []
+    final: List[Tuple[str, str, object, bool]] = []
+    avg_fix: List[Tuple[str, str, str, object]] = []
+    for (name, func, arg, distinct) in agg.aggs:
+        if distinct or func not in _COMBINABLE:
+            return None
+        pn = f"_dp{len(partial)}"
+        if func == "count":
+            partial.append((pn, "count", arg, False))
+            pcols.append(OutCol(None, pn, pn, INT64))
+            final.append((name, "sum", ColumnRef(INT64, pn), False))
+        elif func in ("sum", "min", "max", "first"):
+            t = otypes[name]
+            partial.append((pn, func, arg, False))
+            pcols.append(OutCol(None, pn, pn, t))
+            final.append((name, func, ColumnRef(t, pn), False))
+        else:  # avg: Σ(partial sums) / Σ(partial counts), like
+            # _expand_distinct_aggs' stacked rewrite
+            at = arg.type
+            if at is not None and at.kind not in (
+                Kind.INT, Kind.FLOAT, Kind.DECIMAL, Kind.BOOL
+            ):
+                return None
+            scale = at.scale if at is not None and at.kind == Kind.DECIMAL else 0
+            # DECIMAL partials ride the wire as RAW scaled-unit ints:
+            # exact, and the final division can reproduce the engine's
+            # avg bit-for-bit — s_f64 / (count * 10^scale)_f64, ONE
+            # float division (apply_post_avg's association; dividing a
+            # descaled sum by the count rounds differently in the last
+            # ulp and breaks cross-host result parity)
+            if at is None or at.kind in (Kind.BOOL, Kind.INT) or scale:
+                ptype = INT64
+            else:
+                ptype = at
+            cn = f"_dp{len(partial) + 1}"
+            partial.append((pn, "sum", arg, False))
+            partial.append((cn, "count", arg, False))
+            pcols.append(OutCol(None, pn, pn, ptype))
+            pcols.append(OutCol(None, cn, cn, INT64))
+            fs, fc = f"_dfs{name}", f"_dfc{name}"
+            final.append((fs, "sum", ColumnRef(ptype, pn), False))
+            final.append((fc, "sum", ColumnRef(INT64, cn), False))
+            avg_fix.append((name, fs, fc, ptype, scale))
+    return partial, pcols, final, avg_fix
+
+
+def _final_agg_plan(agg: L.Aggregate, source: L.LogicalPlan,
+                    final, avg_fix) -> L.LogicalPlan:
+    final_groups = [
+        (n, ColumnRef(e.type, n)) for n, e in agg.group_exprs
+    ]
+    if not avg_fix:
+        return L.Aggregate(agg.schema, source, final_groups, list(final))
+    fix = {name: (fs, fc, pt, sc) for name, fs, fc, pt, sc in avg_fix}
+    outer_cols = [OutCol(None, n, n, e.type) for n, e in final_groups]
+    for (n, f, a, _d) in final:
+        outer_cols.append(OutCol(None, n, n, INT64 if f == "count" else a.type))
+    outer = L.Aggregate(Schema(outer_cols), source, final_groups, list(final))
+    proj_exprs = []
+    for oc in agg.schema.cols:
+        if oc.internal in fix:
+            fs, fc, pt, scale = fix[oc.internal]
+            den = ColumnRef(INT64, fc)
+            if scale:
+                den = Func(
+                    type=INT64, op="mul",
+                    args=(den, Literal(type=INT64, value=10 ** scale)),
+                )
+            proj_exprs.append(
+                (
+                    oc.internal,
+                    Func(
+                        type=FLOAT64, op="div",
+                        args=(ColumnRef(pt, fs), den),
+                    ),
+                )
+            )
+        else:
+            proj_exprs.append(
+                (oc.internal, ColumnRef(oc.type, oc.internal))
+            )
+    return L.Projection(agg.schema, outer, proj_exprs)
+
+
+# -- the cut ----------------------------------------------------------------
+
+
+def _find_cut(plan: L.LogicalPlan):
+    """Topmost Aggregate reachable from the root through single-child
+    nodes, or None. The path nodes re-run unchanged on the coordinator."""
+    p = plan
+    while True:
+        if isinstance(p, L.Aggregate):
+            return p
+        if isinstance(
+            p, (L.Selection, L.Projection, L.Sort, L.Limit, L.Window)
+        ):
+            p = p.child
+            continue
+        return None
+
+
+def split_plan(plan: L.LogicalPlan, catalog=None) -> Optional[FragmentPlan]:
+    """Split a bound logical plan into per-host fragments + coordinator
+    stage. Returns None when no safe split exists (caller dispatches the
+    whole plan to one host). Raises Unschedulable for plans that cannot
+    cross the engine seam at all."""
+    agg = _find_cut(plan)
+    if agg is not None and agg.gc_meta:
+        raise Unschedulable(
+            "GROUP_CONCAT plans execute host-assisted; they do not "
+            "cross the engine boundary"
+        )
+
+    if agg is not None:
+        dec = _decompose_aggs(agg)
+        if dec is None:
+            return None
+        partial_aggs, pcols, final, avg_fix = dec
+        frag_scan = _pick_frag_scan(agg.child, catalog)
+        if frag_scan is None:
+            return None
+        group_cols = [
+            OutCol(None, n, n, e.type) for n, e in agg.group_exprs
+        ]
+        partial_schema = Schema(group_cols + pcols)
+        template = L.Aggregate(
+            partial_schema, agg.child, list(agg.group_exprs), partial_aggs
+        )
+
+        def final_builder(source, _plan=plan, _agg=agg, _final=final,
+                          _fix=avg_fix):
+            merged = _final_agg_plan(_agg, source, _final, _fix)
+            return _replace_node(_plan, _agg, merged)
+
+        return FragmentPlan(template, frag_scan, partial_schema, final_builder)
+
+    # no aggregate: peel order-sensitive root operators (and any
+    # row-wise nodes stacked above them) to the coordinator, union the
+    # per-host row fragments beneath them
+
+    def _chain_has_global(p) -> bool:
+        while isinstance(p, (L.Projection, L.Selection)):
+            p = p.child
+        return isinstance(p, (L.Limit, L.Sort))
+
+    peeled: List[L.LogicalPlan] = []
+    lower = plan
+    while isinstance(lower, (L.Limit, L.Sort)) or (
+        isinstance(lower, (L.Projection, L.Selection))
+        and _chain_has_global(lower.child)
+    ):
+        peeled.append(lower)
+        lower = lower.child
+    frag_scan = _pick_frag_scan(lower, catalog)
+    if frag_scan is None:
+        return None
+
+    def final_builder(source, _peeled=tuple(peeled)):
+        out = source
+        for node in reversed(_peeled):
+            out = dataclasses.replace(node, child=out)
+        return out
+
+    return FragmentPlan(lower, frag_scan, lower.schema, final_builder)

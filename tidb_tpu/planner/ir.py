@@ -26,7 +26,11 @@ from tidb_tpu.dtypes import Kind, SQLType
 from tidb_tpu.expression.expr import ColumnRef, Expr, Func, Literal
 from tidb_tpu.planner import logical as L
 
-IR_VERSION = 1
+# v2: Scan gained the semantically-mandatory `frag` fragment slice —
+# an engine that ignored it would scan the full table and the merged
+# final aggregate would count every row n times, so the version check
+# must fence pre-frag engines instead of letting them answer wrongly
+IR_VERSION = 2
 
 
 # -- types ------------------------------------------------------------------
@@ -100,10 +104,15 @@ def plan_to_ir(p: L.LogicalPlan) -> Dict:
     if isinstance(p, L.OneRow):
         return {"n": "one_row", "schema": sch}
     if isinstance(p, L.Scan):
-        return {
+        d = {
             "n": "scan", "schema": sch, "db": p.db, "table": p.table,
             "alias": p.alias, "columns": list(p.columns),
         }
+        if p.frag is not None:
+            # fragment slice rides the IR so a worker engine scans only
+            # its host's disjoint share (the DCN fragment dispatch seam)
+            d["frag"] = [int(p.frag[0]), int(p.frag[1])]
+        return d
     if isinstance(p, L.Selection):
         return {
             "n": "selection", "schema": sch,
@@ -173,7 +182,11 @@ def plan_from_ir(d: Dict) -> L.LogicalPlan:
     if n == "one_row":
         return L.OneRow(sch)
     if n == "scan":
-        return L.Scan(sch, d["db"], d["table"], d["alias"], list(d["columns"]))
+        frag = d.get("frag")
+        return L.Scan(
+            sch, d["db"], d["table"], d["alias"], list(d["columns"]),
+            frag=tuple(frag) if frag is not None else None,
+        )
     if n == "selection":
         return L.Selection(sch, plan_from_ir(d["child"]), expr_from_ir(d["pred"]))
     if n == "projection":

@@ -125,6 +125,12 @@ class Scan(LogicalPlan):
     table: str  # catalog table name
     alias: str  # qualifier
     columns: List[str]  # pruned, bare storage names (internal = alias.name)
+    # cross-host fragment slice (planner/fragmenter.py): (idx, n) takes
+    # every n-th row starting at idx of the version's block concatenation
+    # — the per-host disjoint cover the DCN scheduler dispatches (the
+    # region-partitioned MPP TableScan analog, pkg/store/copr/mpp.go:93).
+    # None = whole-table scan.
+    frag: Optional[Tuple[int, int]] = None
 
 
 @dataclasses.dataclass

@@ -149,6 +149,9 @@ class ScanSite:
     # index row ids (dedup via np.unique) and gathers once; the
     # original predicate still filters, so over-approximation is safe
     merge_ranges: Optional[Tuple[Tuple[str, int, int], ...]] = None
+    # cross-host fragment slice (idx, n): this engine scans only every
+    # n-th row starting at idx (planner/fragmenter.py dispatch)
+    frag: Optional[Tuple[int, int]] = None
 
 
 @dataclasses.dataclass
@@ -203,6 +206,11 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
         parts.append(type(p).__name__)
         if isinstance(p, L.Scan):
             parts.append(f"{p.db}.{p.table} as {p.alias} {sorted(p.columns)}")
+            if p.frag is not None:
+                # two hosts' fragment plans differ ONLY in the slice —
+                # without this the plan cache would serve host 0's scan
+                # to host 1
+                parts.append(f"frag{p.frag[0]}/{p.frag[1]}")
         elif isinstance(p, L.Selection):
             parts.append(repr(p.predicate))
         elif isinstance(p, L.Projection):
@@ -1001,6 +1009,7 @@ class PlanCompiler:
                     pk_range=getattr(self, "_pending_range", None),
                     partitions=parts,
                     merge_ranges=getattr(self, "_pending_merge", None),
+                    frag=plan.frag,
                 )
             )
             self._pending_merge = None
@@ -2112,14 +2121,19 @@ class PhysicalExecutor:
             if resolved is not None:
                 resolved[s.node_id] = (t, v)
             narrowed = (
-                fetch_site_rows(t, s, v) if mesh is None else None
+                fetch_site_rows(t, s, v)
+                # a fragment slice addresses the FULL block concatenation:
+                # index-narrowed gathers would re-number rows and break
+                # the disjoint per-host cover
+                if mesh is None and s.frag is None
+                else None
             )
             if narrowed is not None:
                 inputs[s.node_id] = narrowed
             else:
                 batch, _d = scan_table(
                     t, s.columns, version=v, mesh=mesh,
-                    partitions=s.partitions,
+                    partitions=s.partitions, frag=s.frag,
                 )
                 inputs[s.node_id] = batch
         return inputs
@@ -2153,7 +2167,9 @@ class PhysicalExecutor:
             needs = {k: jax.lax.pmax(v, "d") for k, v in needs.items()}
             return b, needs
 
-        sm = jax.shard_map(
+        from tidb_tpu.parallel.mesh import reshard, shard_map
+
+        sm = shard_map(
             local, mesh=self.mesh, in_specs=(P("d"),), out_specs=(P("d"), P())
         )
         if cq.out_tag == "repl":
@@ -2167,7 +2183,7 @@ class PhysicalExecutor:
                 # copy; reshard (so the slice is legal for any mesh size)
                 # and keep the first copy
                 b = jax.tree.map(
-                    lambda a: jax.sharding.reshard(a, repl)[: a.shape[0] // n], b
+                    lambda a: reshard(a, repl)[: a.shape[0] // n], b
                 )
                 return b, needs
 
@@ -2484,8 +2500,10 @@ def _steady_step(program, out_cap, inputs, params=None, mesh=None):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from tidb_tpu.parallel.mesh import reshard
+
             repl = NamedSharding(mesh, P())
-            out = jax.tree.map(lambda a: jax.sharding.reshard(a, repl), out)
+            out = jax.tree.map(lambda a: reshard(a, repl), out)
         out = _compact_impl(out, out_cap)
     return out, needs
 

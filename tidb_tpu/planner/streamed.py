@@ -62,6 +62,11 @@ def _collect_pipeline_scans(p, scans, flags, chunkable=True) -> bool:
         # stream too (Q18's outer GROUP BY over the HAVING subquery).
         return True
     if isinstance(p, L.Scan):
+        if p.frag is not None:
+            # cross-host fragment slices (fragmenter.py) pin their row
+            # numbering to the whole-plan fetch path; the streamed
+            # re-chunkers don't know the slice and would scan full tables
+            return False
         scans.append(p)
         flags.append(chunkable)
         return True
@@ -260,11 +265,13 @@ def _device_budget() -> int:
     hardware capture: 73.7s/run, ~0.13x). CPU backend (tests /
     fallback): stage through host RAM past a fixed 4GB budget."""
     try:
+        from tidb_tpu.utils.backend import is_tpu
+
         d = jax.local_devices()[0]
         ms = d.memory_stats()
         if ms and ms.get("bytes_limit"):
             return int(ms["bytes_limit"])
-        if d.platform == "tpu":
+        if is_tpu():
             hbm = _HBM_BY_KIND.get(getattr(d, "device_kind", ""), 16 << 30)
             return int(hbm * 0.85)
     except Exception:

@@ -58,6 +58,28 @@ class EngineEndpoint:
         return f"EngineEndpoint({self.address}, {state})"
 
 
+def ping_endpoint(ep: "EngineEndpoint", timeout_s: float = 2.0) -> bool:
+    """One liveness ping over the protocol's handshake frame. Shared by
+    the quarantine prober (recovery detection) and the DCN scheduler's
+    heartbeat (failure detection, parallel/dcn.py) so both sides of the
+    liveness state machine agree on what 'alive' means."""
+    if inject("engine/probe-fail"):
+        return False
+    try:
+        c = EngineClient(
+            ep.host, ep.port, secret=ep.secret, timeout_s=timeout_s
+        )
+    except Exception:
+        return False
+    try:
+        resp = c._call({})  # handshake/ping frame
+        return bool(resp.get("ok"))
+    except Exception:
+        return False
+    finally:
+        c.close()
+
+
 class FailedEngineProber:
     """Quarantine + recovery detection for failed engines.
 
@@ -132,22 +154,7 @@ class FailedEngineProber:
         return recovered
 
     def _ping(self, ep: EngineEndpoint) -> bool:
-        if inject("engine/probe-fail"):
-            return False
-        try:
-            c = EngineClient(
-                ep.host, ep.port, secret=ep.secret,
-                timeout_s=self.probe_timeout_s,
-            )
-        except Exception:
-            return False
-        try:
-            resp = c._call({})  # handshake/ping frame
-            return bool(resp.get("ok"))
-        except Exception:
-            return False
-        finally:
-            c.close()
+        return ping_endpoint(ep, timeout_s=self.probe_timeout_s)
 
     def _loop(self, interval_s: float) -> None:
         while not self._stop.wait(interval_s):

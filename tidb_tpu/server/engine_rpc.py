@@ -42,6 +42,15 @@ class SchemaOutOfDateError(RuntimeError):
     schemas and re-plan."""
 
 
+class DropConnection(BaseException):
+    """Raised by a failpoint to simulate abrupt worker death: the
+    handler closes the connection WITHOUT a response frame, so the
+    coordinator sees a transport loss (the work may or may not have
+    happened — exactly the ambiguity fragment re-dispatch fences
+    against). BaseException so the generic error-reply catch cannot
+    swallow it into a polite error frame."""
+
+
 def _send_frame(sock, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame of {len(payload)}B exceeds {MAX_FRAME}B")
@@ -79,16 +88,23 @@ class EngineServer:
         host: str = "127.0.0.1",
         port: int = 0,
         secret: Optional[str] = None,
+        mesh_devices: Optional[int] = None,
     ):
         self.catalog = catalog
         self.secret = secret
+        # mesh_devices: this engine executes plans SPMD over its local
+        # device mesh (intra-host ICI exchanges) — the worker-host shape
+        # of the hierarchical DCN scheduler (parallel/dcn.py)
+        self.mesh_devices = mesh_devices
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 from tidb_tpu.planner.physical import PhysicalExecutor
 
-                executor = PhysicalExecutor(outer.catalog)
+                executor = PhysicalExecutor(
+                    outer.catalog, mesh_devices=outer.mesh_devices
+                )
                 authed = outer.secret is None
                 while True:
                     try:
@@ -127,6 +143,14 @@ class EngineServer:
                             ).encode()
                         else:
                             resp = outer._execute(executor, req)
+                    except DropConnection:
+                        # failpoint-simulated worker death: no response
+                        # frame — the peer sees the stream close
+                        try:
+                            self.request.close()
+                        except OSError:
+                            pass
+                        return
                     except Exception as e:
                         resp = json.dumps(
                             {
@@ -165,6 +189,12 @@ class EngineServer:
         inject("engine/execute")
         from tidb_tpu.chunk import materialize_rows
 
+        if req.get("frag") is not None:
+            # DCN fragment dispatch: a site before execution (dispatch
+            # received, about to run — death here loses the fragment
+            # cleanly) and one after (dcn/result-send below — death
+            # there loses only the REPLY, the duplicate-redelivery case)
+            inject("dcn/fragment-execute")
         if req.get("v") != IR_VERSION:
             raise ValueError(f"unsupported IR version {req.get('v')}")
         if "schema_v" in req:
@@ -180,14 +210,20 @@ class EngineServer:
         plan = plan_from_ir(req["plan"])
         batch, dicts = executor.run(plan)
         rows = materialize_rows(batch, list(plan.schema), dicts)
-        return json.dumps(
-            {
-                "id": req.get("id"),
-                "ok": True,
-                "columns": [c.name for c in plan.schema],
-                "rows": rows,
-            }
-        ).encode()
+        if req.get("frag") is not None:
+            # mid-shuffle worker death AFTER the work, BEFORE the reply:
+            # the coordinator must re-dispatch, and its ledger must
+            # accept the retry's result exactly once
+            inject("dcn/result-send")
+        resp = {
+            "id": req.get("id"),
+            "ok": True,
+            "columns": [c.name for c in plan.schema],
+            "rows": rows,
+        }
+        if req.get("frag") is not None:
+            resp["frag"] = req["frag"]
+        return json.dumps(resp).encode()
 
     def start_background(self) -> threading.Thread:
         th = threading.Thread(target=self._tcp.serve_forever, daemon=True)
@@ -262,11 +298,16 @@ class EngineClient:
         return resp
 
     def execute_plan(
-        self, plan, schema_version: Optional[int] = None
+        self, plan, schema_version: Optional[int] = None, frag=None
     ) -> Tuple[List[str], List[tuple]]:
         req = {"v": IR_VERSION, "plan": plan_to_ir(plan)}
         if schema_version is not None:
             req["schema_v"] = int(schema_version)
+        if frag is not None:
+            # fragment metadata (query id / fragment id / attempt):
+            # echoed in the response for the coordinator's ledger and
+            # visible to the worker-side dcn/* failpoints
+            req["frag"] = frag
         resp = self._call(req)
         if not resp.get("ok"):
             err = str(resp.get("error", ""))

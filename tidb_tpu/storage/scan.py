@@ -79,20 +79,31 @@ def scan_table(
     version: Optional[int] = None,
     mesh=None,
     partitions=None,
+    frag=None,
 ) -> Tuple[Batch, Dict[str, np.ndarray]]:
     """Returns (device batch, dictionaries for the scanned columns).
 
     With a mesh, the batch is placed row-sharded over the mesh axis (the
     Region data-parallel scan analog, SURVEY.md §2.7) and the capacity is
     padded to a multiple of the mesh size; cached per (version, columns,
-    capacity, mesh)."""
+    capacity, mesh). frag=(idx, n) scans only every n-th row starting at
+    idx of the version's block concatenation — the cross-host fragment
+    slice (disjoint over idx, covering in union; planner/fragmenter.py)."""
     from tidb_tpu.utils.failpoint import inject
 
     inject("storage/scan")
     v = table.version if version is None else version
     cols = tuple(columns)
+    if frag is not None and "_tidb_rowid" in cols:
+        # rowid handles address the FULL block concatenation; a sliced
+        # scan would mislabel slice-local positions as global handles
+        # and DML masks would hit the wrong rows
+        raise ValueError("fragment scans cannot expose _tidb_rowid")
     blocks = table.blocks(v, partitions=partitions)
     n = sum(b.nrows for b in blocks)
+    if frag is not None:
+        fi, fn = int(frag[0]), int(frag[1])
+        n = max((n - fi + fn - 1) // fn, 0) if fn > 0 else n
     cap = capacity or pad_capacity(n)
     mesh_n = None
     if mesh is not None:
@@ -103,7 +114,8 @@ def scan_table(
             cap = mesh_n * pad_capacity(-(-cap // mesh_n), floor=32)
     uid = getattr(table, "uid", None) or id(table)
     pkey = tuple(sorted(partitions)) if partitions is not None else None
-    key = (uid, v, cols, cap, mesh_n, pkey)
+    fkey = (int(frag[0]), int(frag[1])) if frag is not None else None
+    key = (uid, v, cols, cap, mesh_n, pkey, fkey)
     dicts = {c: table.dictionaries[c] for c in cols if c in table.dictionaries}
     if key in _scan_cache:
         _scan_cache.move_to_end(key)
@@ -112,6 +124,17 @@ def scan_table(
     block = concat_blocks(
         blocks, [c for c in cols if c != "_tidb_rowid"], table.schema
     )
+    if frag is not None:
+        import dataclasses as _dc
+
+        fi, fn = int(frag[0]), int(frag[1])
+        block = HostBlock(
+            {
+                name: _dc.replace(c, data=c.data[fi::fn], valid=c.valid[fi::fn])
+                for name, c in block.columns.items()
+            },
+            len(range(fi, block.nrows, fn)),
+        )
     if rowid:
         # virtual scan-order row handle (multi-table DML): position in
         # the version's block concatenation — the same coordinates

@@ -46,6 +46,17 @@ def is_tpu() -> bool:
     return _IS_TPU
 
 
+def backend_label() -> str:
+    """Human-readable backend line for benches/profilers:
+    default_backend() reports the PJRT plugin name ('axon' through the
+    TPU tunnel); is_tpu() (Device.platform) tells the truth on
+    hardware, so hardware runs label as "tpu (pjrt=axon)"."""
+    import jax
+
+    b = jax.default_backend()
+    return f"tpu (pjrt={b})" if is_tpu() and b != "tpu" else b
+
+
 def sort_path_preference() -> str:
     """One switch for every sort-vs-scatter formulation gate:
     TIDB_TPU_SORT_AGG=1 -> 'force' (CPU tests cover the TPU lowering),

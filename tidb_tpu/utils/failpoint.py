@@ -38,13 +38,12 @@ def disable_all() -> None:
         _active.clear()
 
 
-def inject(name: str, default=None):
-    """Call at a site. Returns `default` (or the enabled value)."""
-    action = _active.get(name)
-    if action is None:
-        return default
+def _run_action(action, msg: str):
+    """The four action kinds a site applies: raise an exception class,
+    raise an instance, call a hook, or return a value (shared by
+    inject() and after_n() so the dispatch never drifts)."""
     if isinstance(action, type) and issubclass(action, BaseException):
-        raise action(f"failpoint {name}")
+        raise action(msg)
     if isinstance(action, BaseException):
         raise action
     if callable(action):
@@ -52,5 +51,33 @@ def inject(name: str, default=None):
     return action
 
 
+def inject(name: str, default=None):
+    """Call at a site. Returns `default` (or the enabled value)."""
+    action = _active.get(name)
+    if action is None:
+        return default
+    return _run_action(action, f"failpoint {name}")
+
+
 def is_enabled(name: str) -> bool:
     return name in _active
+
+
+def after_n(n: int, action: object):
+    """An action that fires EXACTLY on the n-th invocation of its site
+    (dormant before and after) — 'die on the K-th fragment' style
+    schedules, the analog of the reference's `Nx`/`xN` failpoint term
+    syntax (pingcap/failpoint terms.go). One-shot so a retry of the
+    failed operation observes a healthy site. Thread-safe."""
+    state = {"count": 0}
+    slock = threading.Lock()
+
+    def fire():
+        with slock:
+            state["count"] += 1
+            due = state["count"] == int(n)
+        if not due:
+            return None
+        return _run_action(action, "failpoint after_n")
+
+    return fire
