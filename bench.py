@@ -180,6 +180,48 @@ def _phase(name: str) -> None:
     print(f"[phase {time.strftime('%H:%M:%S')}] {name}", file=sys.stderr, flush=True)
 
 
+def _metrics_snapshot() -> dict:
+    """{metric_name: (kind, value)} view of the engine registry."""
+    from tidb_tpu.utils.metrics import REGISTRY
+
+    return {name: (kind, val) for name, kind, val in REGISTRY.rows()}
+
+
+def _metrics_delta(before: dict, after: dict) -> dict:
+    """Registry movement across the benchmarked query: what the engine
+    actually did (jit compiles, retraces, transfer bytes, cache hits)
+    alongside the latency headline. Counters/histograms report the
+    delta; gauges (e.g. device-mem high-water — a lifetime max that may
+    not move during the measured window) report their absolute value."""
+    out = {}
+    for name, (kind, v) in sorted(after.items()):
+        if kind == "gauge":
+            if v:
+                out[name] = round(v, 6)
+            continue
+        d = v - before.get(name, ("", 0.0))[1]
+        if d:
+            out[name] = round(d, 6)
+    return out
+
+
+def _emit_metrics(args, result, before: dict, after=None) -> None:
+    """Stamp the per-query registry delta into result.detail and, with
+    --metrics-out, snapshot it to a JSON file next to the bench output."""
+    delta = _metrics_delta(before, after if after is not None else _metrics_snapshot())
+    result.setdefault("detail", {})["engine_metrics"] = delta
+    if getattr(args, "metrics_out", None):
+        with open(args.metrics_out, "w") as f:
+            json.dump(
+                {
+                    "query": args.query,
+                    "sf": args.sf,
+                    "metrics_delta": delta,
+                },
+                f, indent=1,
+            )
+
+
 def measure(args) -> int:
     if os.environ.get("TIDB_TPU_BENCH_CPU") == "1":
         _force_cpu_in_process()
@@ -231,6 +273,7 @@ def measure(args) -> int:
         sess.execute(f"set tidb_mem_quota_query = {64 << 30}")
         nrows = cat.table("test", "web_sales").nrows
         sql = Q95_SQL
+        m0 = _metrics_snapshot()
         sess.execute(sql)  # warmup
         times = []
         for _ in range(args.repeat):
@@ -238,6 +281,7 @@ def measure(args) -> int:
             sess.execute(sql)
             times.append(time.perf_counter() - t0)
         dev_s = float(np.median(times))
+        m_after = _metrics_snapshot()  # before the baseline, like tpch
         base_times = []
         for _ in range(min(max(args.repeat, 2), 3)):
             t0 = time.perf_counter()
@@ -246,7 +290,7 @@ def measure(args) -> int:
         base_s = float(np.median(base_times))
         value = nrows / dev_s
         baseline = nrows / base_s
-        print(json.dumps({
+        result = {
             "metric": f"tpcds_q95_sf{args.sf:g}_rows_per_sec",
             "value": round(value, 1),
             "unit": "rows/s",
@@ -260,7 +304,9 @@ def measure(args) -> int:
                 "backend": backend,
                 "pjrt_backend": jax_backend,
             },
-        }))
+        }
+        _emit_metrics(args, result, m0, m_after)
+        print(json.dumps(result))
         return 0
     tables = _TABLES[args.query]
     _phase("datagen")
@@ -280,6 +326,7 @@ def measure(args) -> int:
 
     # device engine (includes host->device on first run; cached after)
     _phase("warmup execute (h2d + discovery + first jit)")
+    m0 = _metrics_snapshot()
     sess.execute(sql)  # warmup: compile + scan cache
     _phase("steady-state runs")
     times = []
@@ -288,6 +335,7 @@ def measure(args) -> int:
         sess.execute(sql)
         times.append(time.perf_counter() - t0)
     dev_s = float(np.median(times))
+    m_after = _metrics_snapshot()
     _phase("numpy baseline")
 
     # numpy baseline over the same host-resident columns
@@ -331,6 +379,7 @@ def measure(args) -> int:
             "pjrt_backend": jax_backend,
         },
     }
+    _emit_metrics(args, result, m0, m_after)
     print(json.dumps(result))
     return 0
 
@@ -717,6 +766,13 @@ def main() -> int:
         help="permit --out to overwrite a TPU capture with a CPU "
         "fallback result (marked {\"fallback\": true})",
     )
+    ap.add_argument(
+        "--metrics-out", default=None, metavar="FILE",
+        help="snapshot the engine-metrics registry delta across the "
+        "benchmarked query (jit compiles, retraces, transfer bytes, "
+        "tidbtpu_* counters) to this JSON file; the delta is also "
+        "stamped into detail.engine_metrics of the result",
+    )
     ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.quick:
@@ -726,6 +782,8 @@ def main() -> int:
         return measure(args)
 
     passthrough = ["--sf", str(args.sf), "--query", args.query, "--repeat", str(args.repeat)]
+    if args.metrics_out:
+        passthrough += ["--metrics-out", args.metrics_out]
     return supervise(args, passthrough)
 
 

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Lint: every metric registered on the global REGISTRY follows the
+``tidbtpu_<subsystem>_<name>`` naming convention.
+
+Why: metric names are an API — dashboards, alert rules and the BENCH
+metrics snapshots all key on them. A drifting prefix (tidb_tpu_ vs
+tidbtpu_ vs tidbtpu-) silently forks the series. The convention:
+lowercase, ``tidbtpu_`` prefix, then a subsystem token (engine, dcn,
+session, executor, watchdog, ttl, stats, ...), then the metric name.
+
+Scans every ``REGISTRY.counter/gauge/histogram("literal", ...)`` call
+site (multi-line calls included) outside tests/. Non-literal names are
+skipped — there are none today; keep it that way.
+
+Usage: python scripts/check_metric_names.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+CALL = re.compile(
+    r"(?:REGISTRY|_REG)\s*\.\s*(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']"
+)
+NAME = re.compile(r"^tidbtpu_[a-z][a-z0-9]*_[a-z][a-z0-9_]*$")
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules", "tests"}
+SKIP_FILES = {os.path.join("scripts", "check_metric_names.py")}
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check(root: str):
+    violations = []
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        if rel in SKIP_FILES:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in CALL.finditer(text):
+            name = m.group(1)
+            if NAME.match(name):
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            violations.append(
+                (rel, line,
+                 f"metric name {name!r} violates the "
+                 "tidbtpu_<subsystem>_<name> convention")
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} metric-name violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -320,3 +320,114 @@ def sched_endpoints(srvs):
     from tidb_tpu.server.engine_pool import EngineEndpoint
 
     return [EngineEndpoint("127.0.0.1", s.port) for s in srvs]
+
+
+class TestTelemetry:
+    """Trace-context propagation + fragment runtime stats over the
+    engine-RPC seam (coordinator merge in parallel/dcn.py)."""
+
+    def test_fragment_stats_and_spans_merge(self, sess):
+        srvs = _servers(sess, 2)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs], catalog=sess.catalog
+        )
+        sched.tracer.enabled = True
+        sched.tracer.reset()
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+            frags = sched.last_query["fragments"]
+            assert sorted(f["fid"] for f in frags) == [0, 1]
+            for f in frags:
+                assert f["exec_s"] > 0 and f["bytes"] > 0
+                assert f["attempt"] == 1
+                # the worker's spans carry the propagated trace context
+                qid = sched.last_query["qid"]
+                assert any(
+                    f"q{qid}/f{f['fid']}/execute" in s[0]
+                    for s in f["spans"]
+                )
+            # coordinator tracer: every remote span host-labeled, one
+            # execute span per fragment
+            ex = [
+                s for s in sched.tracer.spans
+                if s.name.endswith("/execute")
+            ]
+            assert len(ex) == 2
+            assert all(":" in s.name for s in ex)
+        finally:
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+    def test_spans_survive_worker_retry_without_duplication(self, sess):
+        """dcn/result-send death: the zombie attempt's reply is lost, the
+        retry's reply lands — the merged telemetry must hold each
+        fragment EXACTLY once (the ledger fence gates the span merge)."""
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        srvs = _servers(sess, 2)
+        failpoint.enable(
+            "dcn/result-send", failpoint.after_n(1, DropConnection)
+        )
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs],
+            catalog=sess.catalog,
+            prober=FailedEngineProber(initial_backoff_s=30),
+        )
+        sched.tracer.enabled = True
+        sched.tracer.reset()
+        retries0 = REGISTRY.counter("tidbtpu_dcn_retries").value
+        try:
+            exp = sess.must_query(GROUPED).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED))
+            assert got == exp
+            frags = sched.last_query["fragments"]
+            # exactly once per fragment, even though one was re-dispatched
+            assert sorted(f["fid"] for f in frags) == [0, 1]
+            assert max(f["attempt"] for f in frags) == 2
+            ex = [
+                s for s in sched.tracer.spans
+                if s.name.endswith("/execute")
+            ]
+            assert len(ex) == 2  # no duplicated spans from the retry
+            assert REGISTRY.counter("tidbtpu_dcn_retries").value == retries0 + 1
+        finally:
+            failpoint.disable("dcn/result-send")
+            sched.close()
+            for s in srvs:
+                s.shutdown()
+
+    def test_status_and_dcn_endpoint(self, sess):
+        import json
+        import urllib.request
+
+        from tidb_tpu.server.http_status import StatusServer
+
+        srvs = _servers(sess, 2)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in srvs], catalog=sess.catalog
+        )
+        http = StatusServer(sess.catalog, port=0, dcn=sched)
+        http.start_background()
+        try:
+            sched.execute_plan(_plan(sess, GROUPED))
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/dcn", timeout=10
+                ).read().decode()
+            )
+            assert body["enabled"] is True
+            assert body["alive"] == 2 and len(body["hosts"]) == 2
+            lq = body["last_query"]
+            assert [f["fid"] for f in lq["fragments"]] == [0, 1]
+            assert all(
+                "spans" not in f and f["bytes"] > 0
+                for f in lq["fragments"]
+            )
+        finally:
+            http.shutdown()
+            sched.close()
+            for s in srvs:
+                s.shutdown()

@@ -1,0 +1,81 @@
+"""Engine watch (obs/engine_watch.py): jit-compilation and retrace
+accounting, host<->device transfer bytes, device-memory high-water, and
+the information_schema.TPU_ENGINE surface.
+
+The retrace test is the point: a *shape-polymorphic* query (same plan
+signature, growing input tile) must show up as tidbtpu_engine_retraces —
+the silent recompile that dominates accelerator latency when unobserved.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture()
+def sess():
+    return Session(Catalog())
+
+
+def _counter(name: str) -> float:
+    return REGISTRY.counter(name).value
+
+
+def test_jit_compilations_counted(sess):
+    sess.execute("create table ew1 (a bigint)")
+    sess.execute("insert into ew1 values (1),(2),(3)")
+    before = _counter("tidbtpu_engine_jit_compilations")
+    sess.execute("select sum(a) from ew1 where a > 1")
+    assert _counter("tidbtpu_engine_jit_compilations") > before
+    # a repeat at the same shape reuses the steady program: no new jit
+    again = _counter("tidbtpu_engine_jit_compilations")
+    sess.execute("select sum(a) from ew1 where a > 1")
+    assert _counter("tidbtpu_engine_jit_compilations") == again
+
+
+def test_retrace_counted_for_shape_polymorphic_query(sess):
+    sess.execute("create table ew2 (a bigint)")
+    sess.execute(
+        "insert into ew2 values " + ",".join(f"({i})" for i in range(10))
+    )
+    sess.execute("select sum(a) from ew2")  # first compile at tile 0
+    retraces0 = _counter("tidbtpu_engine_retraces")
+    # grow the table past the padded capacity tile: the SAME plan
+    # signature now traces at a bigger input shape
+    for lo in range(0, 9000, 1000):
+        sess.execute(
+            "insert into ew2 values "
+            + ",".join(f"({i})" for i in range(lo, lo + 1000))
+        )
+    r = sess.must_query("select sum(a) from ew2")
+    assert r.rows[0][0] == sum(range(10)) + sum(range(9000))
+    assert _counter("tidbtpu_engine_retraces") > retraces0
+
+
+def test_transfer_bytes_and_device_mem(sess):
+    sess.execute("create table ew3 (a bigint, b bigint)")
+    sess.execute("insert into ew3 values (1, 2),(3, 4)")
+    h2d0 = _counter("tidbtpu_engine_h2d_bytes")
+    d2h0 = _counter("tidbtpu_engine_d2h_bytes")
+    sess.execute("select a + b from ew3 where a > 0")
+    assert _counter("tidbtpu_engine_h2d_bytes") > h2d0
+    assert _counter("tidbtpu_engine_d2h_bytes") > d2h0
+    assert REGISTRY.gauge(
+        "tidbtpu_engine_device_mem_highwater_bytes"
+    ).value > 0
+
+
+def test_tpu_engine_virtual_table(sess):
+    sess.execute("create table ew4 (a bigint)")
+    sess.execute("insert into ew4 values (41),(42)")
+    sess.execute("select max(a) from ew4 where a > 40")
+    r = sess.must_query(
+        "select query, jit_compilations, h2d_bytes, device_mem_peak_bytes "
+        "from information_schema.tpu_engine "
+        "where query like '%max(a) from ew4%'"
+    )
+    assert r.rows, "the statement's engine record is missing"
+    _q, jits, h2d, mem = r.rows[-1]
+    assert jits >= 1 and h2d > 0 and mem > 0

@@ -1,0 +1,68 @@
+"""Tier-1 gate for scripts/check_failpoints.py: the declared failpoint
+site set (utils/failpoint.py SITES) stays in lockstep with the actual
+inject() call sites, and enable() rejects names that would arm nothing."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_failpoints.py")
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, LINT, REPO], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"failpoint-site violations:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_lint_catches_violations(tmp_path):
+    util = tmp_path / "tidb_tpu" / "utils"
+    util.mkdir(parents=True)
+    (util / "failpoint.py").write_text(
+        'SITES = frozenset({"good/site", "dead/site"})\n'
+    )
+    (tmp_path / "tidb_tpu" / "engine.py").write_text(
+        'from tidb_tpu.utils.failpoint import inject\n'
+        'inject("good/site")\n'
+        'inject("undeclared/site")\n'   # rule 1
+    )
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_x.py").write_text(
+        'failpoint.enable("good/site", True)\n'
+        'failpoint.enable("typod/site", True)\n'  # rule 3
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "undeclared/site" in proc.stdout          # injected, undeclared
+    assert "dead/site" in proc.stdout                # declared, never injected
+    assert "typod/site" in proc.stdout               # enabled, undeclared
+    assert "3 failpoint violation(s)" in proc.stdout  # and nothing else
+
+
+def test_enable_rejects_unknown_site():
+    from tidb_tpu.utils import failpoint
+
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        failpoint.enable("definitely/not-a-site", True)
+
+
+def test_declare_admits_test_local_site():
+    from tidb_tpu.utils import failpoint
+
+    failpoint.declare("testonly/site")
+    try:
+        failpoint.enable("testonly/site", 7)
+        assert failpoint.inject("testonly/site") == 7
+    finally:
+        failpoint.disable("testonly/site")

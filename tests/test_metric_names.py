@@ -1,0 +1,47 @@
+"""Tier-1 gate for scripts/check_metric_names.py: every metric
+registered on the global REGISTRY follows tidbtpu_<subsystem>_<name>
+(dashboards and BENCH metric snapshots key on these names)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_metric_names.py")
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, LINT, REPO], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"metric-name violations:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_lint_catches_violations(tmp_path):
+    pkg = tmp_path / "tidb_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'from tidb_tpu.utils.metrics import REGISTRY\n'
+        'REGISTRY.counter("tidb_tpu_old_style_total").inc()\n'   # bad prefix
+        'REGISTRY.gauge(\n'
+        '    "noprefix_gauge", "help"\n'                          # bad, multiline
+        ').set(1)\n'
+        'REGISTRY.histogram("tidbtpu_engine_good_seconds").observe(1)\n'
+    )
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_y.py").write_text(
+        'REGISTRY.counter("anything_goes_in_tests")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "tidb_tpu_old_style_total" in proc.stdout
+    assert "noprefix_gauge" in proc.stdout
+    assert "tidbtpu_engine_good_seconds" not in proc.stdout
+    assert "test_y.py" not in proc.stdout  # tests/ exempt

@@ -3,14 +3,19 @@
 Reference: pkg/metrics (Prometheus collectors), slow log read back as
 INFORMATION_SCHEMA.SLOW_QUERY (pkg/executor/slow_query.go), and
 per-digest statement summary (statement_summary.go:73). VERDICT round-1
-missing #9.
+missing #9. Round-2 additions: gauges, metric labels, exposition-format
+round trip, /dcn, and the live /status connection count.
 """
+
+import json
+import re
+import urllib.request
 
 import pytest
 
 from tidb_tpu.session import Session
 from tidb_tpu.storage import Catalog
-from tidb_tpu.utils.metrics import REGISTRY, sql_digest
+from tidb_tpu.utils.metrics import REGISTRY, Registry, sql_digest
 
 
 @pytest.fixture()
@@ -69,17 +74,103 @@ def test_metrics_counters_and_prometheus_render(sess):
     sess.execute("select a from t")  # plan cache hit
     r = sess.must_query(
         "select value from information_schema.metrics "
-        "where name = 'tidb_tpu_plan_cache_hits_total'"
+        "where name = 'tidbtpu_executor_plan_cache_hits_total'"
     )
     assert r.rows and r.rows[0][0] >= 1
     text = REGISTRY.render()
-    assert "# TYPE tidb_tpu_statements_total counter" in text
-    assert "tidb_tpu_query_duration_seconds_count" in text
+    assert "# TYPE tidbtpu_session_statements_total counter" in text
+    assert "tidbtpu_session_query_duration_seconds_count" in text
+
+
+class TestGaugesAndLabels:
+    """Satellite: Gauge (set/inc/dec) + metric labels with correct
+    Prometheus text exposition, on a private Registry so the assertions
+    are exact."""
+
+    def test_gauge_set_inc_dec(self):
+        reg = Registry()
+        g = reg.gauge("tidbtpu_test_pool_size", "g")
+        g.set(5)
+        g.inc(2)
+        g.dec(3)
+        assert g.value == 4
+        g.set_max(2)
+        assert g.value == 4  # high-water keeps the max
+        g.set_max(9)
+        assert g.value == 9
+        assert ("tidbtpu_test_pool_size", "gauge", 9.0) in reg.rows()
+        assert "# TYPE tidbtpu_test_pool_size gauge" in reg.render()
+
+    def test_labeled_counter_children_and_escaping(self):
+        reg = Registry()
+        c = reg.counter("tidbtpu_test_dispatches", "d", labels=("host",))
+        c.labels(host="h1").inc()
+        c.labels(host="h1").inc()
+        c.labels(host='we"ird\\h').inc()
+        text = reg.render()
+        assert 'tidbtpu_test_dispatches{host="h1"} 2' in text
+        assert 'tidbtpu_test_dispatches{host="we\\"ird\\\\h"} 1' in text
+        names = [n for n, _k, _v in reg.rows()]
+        assert 'tidbtpu_test_dispatches{host="h1"}' in names
+
+    def test_labeled_histogram_cumulative_buckets(self):
+        reg = Registry()
+        h = reg.histogram("tidbtpu_test_lat_seconds", "h", labels=("op",))
+        h.labels(op="scan").observe(0.003)
+        h.labels(op="scan").observe(0.004)
+        h.labels(op="scan").observe(5.0)
+        text = reg.render()
+        # cumulative le buckets: 0.001 -> 0, 0.005 -> 2, ..., 10 -> 3
+        assert 'tidbtpu_test_lat_seconds_bucket{op="scan",le="0.001"} 0' in text
+        assert 'tidbtpu_test_lat_seconds_bucket{op="scan",le="0.005"} 2' in text
+        assert 'tidbtpu_test_lat_seconds_bucket{op="scan",le="10"} 3' in text
+        assert 'tidbtpu_test_lat_seconds_bucket{op="scan",le="+Inf"} 3' in text
+        assert 'tidbtpu_test_lat_seconds_count{op="scan"} 3' in text
+
+    def test_unknown_label_names_rejected(self):
+        reg = Registry()
+        c = reg.counter("tidbtpu_test_labeled", "c", labels=("host",))
+        with pytest.raises(ValueError, match="unknown label"):
+            c.labels(host="h1", port=8080)
+
+    def test_full_precision_exposition(self):
+        """Byte-scale counters must not lose low-order increments to %g
+        (rate() over scrapes would read zero between 1e5-sized jumps)."""
+        reg = Registry()
+        c = reg.counter("tidbtpu_test_bytes", "b")
+        c.inc(10_737_418_240)  # 10 GiB
+        c.inc(65_536)
+        assert "tidbtpu_test_bytes 10737483776" in reg.render()
+
+    def test_kind_and_label_conflicts_rejected(self):
+        reg = Registry()
+        reg.counter("tidbtpu_test_thing", "c")
+        with pytest.raises(ValueError):
+            reg.gauge("tidbtpu_test_thing", "g")
+        with pytest.raises(ValueError):
+            reg.counter("tidbtpu_test_thing", "c", labels=("x",))
+
+    def test_registry_rows_contract_unchanged(self):
+        """The information_schema METRICS contract: (name, kind, value)
+        triplets, histograms exploded into _count/_sum."""
+        reg = Registry()
+        reg.counter("tidbtpu_test_c", "c").inc(3)
+        reg.histogram("tidbtpu_test_h", "h").observe(0.5)
+        rows = dict((n, (k, v)) for n, k, v in reg.rows())
+        assert rows["tidbtpu_test_c"] == ("counter", 3.0)
+        assert rows["tidbtpu_test_h_count"] == ("histogram", 1.0)
+        assert rows["tidbtpu_test_h_sum"] == ("histogram", 0.5)
+
+
+#: one Prometheus text-format sample line
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s(-?[0-9.e+-]+|NaN)$"
+)
 
 
 class TestHTTPStatus:
-    """Side HTTP port: /status /metrics /schema /settings (reference
-    pkg/server/http_status.go)."""
+    """Side HTTP port: /status /metrics /schema /settings /dcn
+    (reference pkg/server/http_status.go)."""
 
     @pytest.fixture()
     def srv(self):
@@ -93,36 +184,113 @@ class TestHTTPStatus:
         s = Session(catalog=cat)
         s.execute("create table t (a int primary key, b varchar(8))")
         s.execute("insert into t values (1,'x')")
-        srv = StatusServer(cat, port=0)
+        srv = StatusServer(cat, port=0, connections=lambda: 7)
         srv.start_background()
         time.sleep(0.1)
         yield srv
         srv.shutdown()
 
     def _get(self, srv, path):
-        import urllib.request
-
         return urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}{path}", timeout=10
         ).read().decode()
 
-    def test_status(self, srv):
-        import json
-
-        assert "tidb-tpu" in json.loads(self._get(srv, "/status"))["version"]
+    def test_status_reports_live_connections(self, srv):
+        body = json.loads(self._get(srv, "/status"))
+        assert "tidb-tpu" in body["version"]
+        # satellite: no longer hardcoded 0 — wired from the provider
+        assert body["connections"] == 7
 
     def test_metrics_prometheus_text(self, srv):
         body = self._get(srv, "/metrics")
-        assert "tidb_tpu_" in body and "# TYPE" in body
+        assert "tidbtpu_" in body and "# TYPE" in body
+
+    def test_metrics_exposition_round_trip(self, srv):
+        """Every /metrics line parses as Prometheus text format, every
+        histogram's le buckets are cumulative and end at +Inf==count."""
+        body = self._get(srv, "/metrics")
+        buckets = {}
+        counts = {}
+        for line in body.strip().splitlines():
+            if line.startswith("#"):
+                assert re.match(
+                    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                    r"(counter|gauge|histogram)$", line
+                ), line
+                continue
+            m = _SAMPLE.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            name, lb, val = m.group(1), m.group(2) or "", m.group(3)
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]+)"', lb).group(1)
+                rest = re.sub(r',?le="[^"]+"', "", lb)
+                series = name + ("" if rest == "{}" else rest)
+                buckets.setdefault(series, []).append((le, float(val)))
+            elif name.endswith("_count"):
+                counts[name[: -len("_count")] + lb] = float(val)
+        assert buckets, "no histograms exposed"
+        for series, bs in buckets.items():
+            vals = [v for _le, v in bs]
+            assert vals == sorted(vals), f"non-cumulative buckets: {series}"
+            les = [le for le, _v in bs]
+            assert les[-1] == "+Inf"
+            base = series.replace("_bucket", "")
+            assert counts.get(base) == vals[-1], series
 
     def test_schema_endpoints(self, srv):
-        import json
-
         assert json.loads(self._get(srv, "/schema"))["test"] == ["t"]
         t = json.loads(self._get(srv, "/schema/test/t"))
         assert t["primary_key"] == ["a"] and t["rows"] == 1
 
     def test_settings(self, srv):
-        import json
-
         assert "tidb_mem_quota_query" in json.loads(self._get(srv, "/settings"))
+
+    def test_dcn_endpoint_unattached(self, srv):
+        assert json.loads(self._get(srv, "/dcn")) == {"enabled": False}
+
+    def test_dcn_endpoint_attached(self, srv):
+        srv.attach_dcn(lambda: {"enabled": True, "alive": 2})
+        body = json.loads(self._get(srv, "/dcn"))
+        assert body["enabled"] is True and body["alive"] == 2
+
+
+def test_mysql_server_connection_count():
+    """The MySQL-protocol server counts live connections and the status
+    port reports them (satellite: /status hardcoded 0)."""
+    import socket
+    import time
+
+    from tidb_tpu.server.server import Server
+
+    srv = Server(port=0, status_port=0)
+    srv.start_background()
+    try:
+        time.sleep(0.2)
+        assert srv.connections == 0
+        conns = [
+            socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            for _ in range(3)
+        ]
+        try:
+            for c in conns:
+                c.recv(4096)  # handshake arrived: the server counted us
+            deadline = time.time() + 5
+            while srv.connections != 3 and time.time() < deadline:
+                time.sleep(0.05)
+            assert srv.connections == 3
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.status_server.port}/status",
+                    timeout=10,
+                ).read().decode()
+            )
+            assert body["connections"] == 3
+        finally:
+            for c in conns:
+                c.close()
+        deadline = time.time() + 5
+        while srv.connections != 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert srv.connections == 0
+    finally:
+        srv.shutdown()

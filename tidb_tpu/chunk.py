@@ -204,15 +204,20 @@ class Batch:
 
 def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
     """Pad a host block to a static tile and move it to device layout."""
+    from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
     cap = capacity or pad_capacity(block.nrows)
     pad = cap - block.nrows
     cols = {}
+    h2d = cap  # the row-validity mask ships too
     for name, col in block.columns.items():
         data = np.pad(col.data, (0, pad))
         valid = np.pad(col.valid, (0, pad))
+        h2d += data.nbytes + valid.nbytes
         cols[name] = DevCol(jnp.asarray(data), jnp.asarray(valid))
     row_valid = np.zeros(cap, dtype=bool)
     row_valid[: block.nrows] = True
+    ENGINE_WATCH.note_h2d(h2d)
     return Batch(cols, jnp.asarray(row_valid))
 
 

@@ -1,0 +1,2 @@
+"""Observability: engine watch (jit/transfer/memory accounting) and the
+surfaces that expose it (information_schema.TPU_ENGINE, /metrics)."""

@@ -1,0 +1,178 @@
+"""Engine watch: per-query accounting of the TPU engine's silent
+performance killers.
+
+The reference merges per-operator RuntimeStatsColl from cop tasks into
+EXPLAIN ANALYZE and exports Prometheus collectors per subsystem
+(pkg/metrics). For a jit-compiled accelerator engine the equivalent
+blind spots are different: XLA (re)compilations, retraces (a plan whose
+cache key keeps missing because its input shapes keep changing),
+host<->device transfer bytes, and device-memory high-water. "Accelerating
+Presto with GPUs" and the pushdown cost analyses (PAPERS.md) both show
+these dominate accelerated query latency when unobserved.
+
+Accounting model:
+- every counter lands in the global REGISTRY (tidbtpu_engine_*);
+- a thread-local *current query record* additionally captures the same
+  deltas per statement (opened by the session around each top-level
+  statement), and finished records land in a ring buffer surfaced as
+  information_schema.TPU_ENGINE;
+- ``watched_jit(fn, sig)`` wraps ``jax.jit`` so each actual trace (the
+  wrapped python body only runs when XLA compiles) is counted; a second
+  trace for the same plan signature is a *retrace* — the recompile
+  hunter's needle.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+from typing import List, Optional
+
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: plan signatures whose first compile was already seen; a trace for a
+#: member is a retrace. Bounded: reset when it grows past this (the
+#: retrace baseline restarts, which only under-counts).
+_MAX_SIGS = 8192
+
+
+class QueryEngineRecord:
+    """Engine-side resource accounting for one statement."""
+
+    __slots__ = (
+        "qid", "query", "jit_compilations", "retraces", "h2d_bytes",
+        "d2h_bytes", "device_mem_peak_bytes", "duration_s",
+    )
+
+    def __init__(self, qid: int, query: str):
+        self.qid = qid
+        self.query = query
+        self.jit_compilations = 0
+        self.retraces = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.device_mem_peak_bytes = 0
+        self.duration_s = 0.0
+
+
+class EngineWatch:
+    def __init__(self, capacity: int = 256):
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._seen_sigs = set()
+        self._recent = collections.deque(maxlen=capacity)
+        self._qid = itertools.count(1)
+
+    # -- per-query scope (opened by the session per top-level stmt) ----
+    def begin_query(self, query: str) -> None:
+        self._tls.rec = QueryEngineRecord(next(self._qid), str(query)[:256])
+
+    def end_query(self, elapsed_s: float) -> None:
+        rec = getattr(self._tls, "rec", None)
+        self._tls.rec = None
+        if rec is None:
+            return
+        rec.duration_s = float(elapsed_s)
+        with self._lock:
+            self._recent.append(rec)
+
+    def current(self) -> Optional[QueryEngineRecord]:
+        return getattr(self._tls, "rec", None)
+
+    # -- notes (called from the engine hot paths; all O(1)) ------------
+    def note_trace(self, sig) -> None:
+        """One actual jax trace (= one XLA compilation) at a watched
+        site; `sig` is the plan signature whose cache key missed."""
+        with self._lock:
+            if len(self._seen_sigs) > _MAX_SIGS:
+                self._seen_sigs.clear()
+            retrace = sig in self._seen_sigs
+            self._seen_sigs.add(sig)
+        REGISTRY.counter(
+            "tidbtpu_engine_jit_compilations", "XLA compilations"
+        ).inc()
+        if retrace:
+            REGISTRY.counter(
+                "tidbtpu_engine_retraces",
+                "recompiles of an already-compiled plan signature "
+                "(cache-key misses: shape growth, stale widths)",
+            ).inc()
+        rec = self.current()
+        if rec is not None:
+            rec.jit_compilations += 1
+            if retrace:
+                rec.retraces += 1
+
+    def note_h2d(self, nbytes: int) -> None:
+        REGISTRY.counter(
+            "tidbtpu_engine_h2d_bytes", "host->device transfer bytes"
+        ).inc(nbytes)
+        rec = self.current()
+        if rec is not None:
+            rec.h2d_bytes += int(nbytes)
+
+    def note_d2h(self, nbytes: int) -> None:
+        REGISTRY.counter(
+            "tidbtpu_engine_d2h_bytes", "device->host transfer bytes"
+        ).inc(nbytes)
+        rec = self.current()
+        if rec is not None:
+            rec.d2h_bytes += int(nbytes)
+
+    def d2h_batch(self, batch) -> None:
+        """Account a whole fetched device batch (the steady-state
+        single fetch in planner/physical.py)."""
+        try:
+            nb = int(batch.row_valid.nbytes)
+            for dc in batch.cols.values():
+                nb += int(dc.data.nbytes) + int(dc.valid.nbytes)
+        except Exception:
+            return
+        self.note_d2h(nb)
+
+    def note_device_mem(self, nbytes: int) -> None:
+        """Admitted working-set estimate for one launch (scan batches +
+        operator tiles) — the per-query device-memory high-water."""
+        REGISTRY.gauge(
+            "tidbtpu_engine_device_mem_highwater_bytes",
+            "largest admitted per-launch device working set",
+        ).set_max(nbytes)
+        rec = self.current()
+        if rec is not None:
+            rec.device_mem_peak_bytes = max(
+                rec.device_mem_peak_bytes, int(nbytes)
+            )
+
+    # -- surfaces ------------------------------------------------------
+    def rows(self) -> List[tuple]:
+        """information_schema.TPU_ENGINE rows, oldest first."""
+        with self._lock:
+            recs = list(self._recent)
+        return [
+            (
+                r.qid, r.query, r.jit_compilations, r.retraces,
+                r.h2d_bytes, r.d2h_bytes, r.device_mem_peak_bytes,
+                r.duration_s,
+            )
+            for r in recs
+        ]
+
+
+ENGINE_WATCH = EngineWatch()
+
+
+def watched_jit(fn, sig=None, **jit_kwargs):
+    """``jax.jit`` with compile accounting: the wrapped python body runs
+    only when jax actually (re)traces, so each execution of the wrapper
+    is one XLA compilation charged to `sig` (default: the function's
+    identity)."""
+    import jax
+
+    watch_sig = sig if sig is not None else ("fn", id(fn))
+
+    def traced(*a, **k):
+        ENGINE_WATCH.note_trace(watch_sig)
+        return fn(*a, **k)
+
+    return jax.jit(traced, **jit_kwargs)

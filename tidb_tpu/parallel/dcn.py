@@ -56,9 +56,68 @@ from tidb_tpu.server.engine_pool import (
 )
 from tidb_tpu.server.engine_rpc import EngineClient, SchemaOutOfDateError
 from tidb_tpu.utils.failpoint import inject
+from tidb_tpu.utils.metrics import REGISTRY
+from tidb_tpu.utils.tracing import Tracer
 
 _STAGED_NONCE = itertools.count(1 << 20)  # disjoint from streamed.py's
 _QUERY_ID = itertools.count(1)
+
+
+# -- telemetry (tidbtpu_dcn_*: exported at /metrics, summarized at /dcn) ----
+
+
+def _c_dispatches():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_dispatches", "fragment dispatches", labels=("host",)
+    )
+
+
+def _c_retries():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_retries", "fragment re-dispatches after a loss"
+    )
+
+
+def _c_quarantines():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_quarantines", "hosts quarantined", labels=("host",)
+    )
+
+
+def _c_duplicates():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_duplicates_dropped",
+        "late/duplicate fragment deliveries fenced by the ledger",
+    )
+
+
+def _c_bytes_staged():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_bytes_staged",
+        "fragment result bytes staged through the coordinator",
+    )
+
+
+def _c_heartbeat_misses():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_heartbeat_misses", "missed heartbeats", labels=("host",)
+    )
+
+
+def _h_fragment_seconds():
+    return REGISTRY.histogram(
+        "tidbtpu_dcn_fragment_seconds", "per-fragment worker execution time"
+    )
+
+
+def _update_host_gauges(endpoints) -> None:
+    alive = sum(1 for ep in endpoints if ep.alive)
+    REGISTRY.gauge(
+        "tidbtpu_dcn_hosts_alive", "worker hosts in rotation"
+    ).set(alive)
+    REGISTRY.gauge(
+        "tidbtpu_dcn_hosts_quarantined", "worker hosts quarantined"
+    ).set(len(endpoints) - alive)
 
 
 class HostHeartbeat:
@@ -103,10 +162,13 @@ class HostHeartbeat:
             if ok:
                 self._misses[ep] = 0
                 continue
+            _c_heartbeat_misses().labels(host=ep.address).inc()
             self._misses[ep] = self._misses.get(ep, 0) + 1
             if self._misses[ep] >= self.miss_threshold:
-                self.prober.detect(ep)
+                if self.prober.detect(ep):
+                    _c_quarantines().labels(host=ep.address).inc()
                 lost.append(ep)
+        _update_host_gauges(self.endpoints)
         return lost
 
     def _loop(self, interval_s: float) -> None:
@@ -165,6 +227,7 @@ class FragmentLedger:
             rec = self._recs[fid]
             if not fence_accepts(rec["owner"], rec["state"], token, "inflight"):
                 self.duplicates_dropped += 1
+                _c_duplicates().inc()
                 return False
             rec["state"] = "done"
             rec["rows"] = rows
@@ -235,6 +298,13 @@ class DCNFragmentScheduler:
         from tidb_tpu.planner.physical import PhysicalExecutor
 
         self._executor = PhysicalExecutor(catalog)
+        # coordinator-side trace: remote fragment spans merge here,
+        # host-labeled (enable + reset per query to collect)
+        self.tracer = Tracer()
+        #: telemetry of the most recent fragmented query:
+        #: {"qid", "fragments": [{fid, host, attempt, rows, exec_s,
+        #:  bytes, spans}]}
+        self.last_query: Optional[dict] = None
         self._lock = threading.Lock()
         self._conns: Dict[EngineEndpoint, EngineClient] = {}
         # strict request/response stream per connection: concurrent
@@ -294,14 +364,16 @@ class DCNFragmentScheduler:
     def _dispatch(self, ep, plan, frag_meta):
         """One fragment dispatch on one host. Transport failures raise;
         engine-side execution errors raise RuntimeError (no failover —
-        they reproduce everywhere)."""
+        they reproduce everywhere). Returns (cols, rows, resp) — the
+        raw response carries the worker's spans and runtime stats."""
         inject("dcn/dispatch")
+        _c_dispatches().labels(host=ep.address).inc()
         if inject("dcn/dispatch-lost"):
             raise ConnectionError("failpoint: dispatch lost in transit")
         with self._ep_lock(ep):
             conn = self._conn(ep)
             try:
-                return conn.execute_plan(plan, frag=frag_meta)
+                return conn.execute_plan_full(plan, frag=frag_meta)
             except (SchemaOutOfDateError, RuntimeError, ValueError,
                     PermissionError):
                 raise
@@ -312,7 +384,12 @@ class DCNFragmentScheduler:
     def _quarantine(self, ep: EngineEndpoint) -> None:
         with self._ep_lock(ep):
             self._drop_conn(ep)
-        self.prober.detect(ep)
+        # detect() reports whether THIS call made the alive->failed
+        # transition: one host death = one quarantine count, no matter
+        # how many fragment threads observed it
+        if self.prober.detect(ep):
+            _c_quarantines().labels(host=ep.address).inc()
+        _update_host_gauges(self.endpoints)
 
     # -- query execution ------------------------------------------------
     def execute_plan(self, plan: L.LogicalPlan) -> Tuple[List[str], List[tuple]]:
@@ -322,9 +399,49 @@ class DCNFragmentScheduler:
         frag = split_plan(plan, self.catalog)
         if frag is None:
             return self._execute_single(plan)
+        ledger, _infos = self._run_fragments(frag)
+        return self._final_stage(frag, ledger.rows())
+
+    def explain_analyze(
+        self, plan: L.LogicalPlan
+    ) -> Tuple[List[str], List[tuple], List[str]]:
+        """Distributed EXPLAIN ANALYZE: run the fragments, then the
+        final stage INSTRUMENTED, and merge the per-host fragment stats
+        (rows/host, execution times, bytes shipped over DCN) into the
+        coordinator's plan-tree rows — the reference's cop-task
+        RuntimeStatsColl merge, over the engine-RPC seam. Returns
+        (columns, rows, plan lines)."""
+        from tidb_tpu.chunk import materialize_rows
+
+        frag = split_plan(plan, self.catalog)
+        if frag is None:
+            cols, rows = self._execute_single(plan)
+            return cols, rows, [
+                "SingleHostDispatch (no safe fragment split) "
+                f"rows={len(rows)}"
+            ]
+        ledger, infos = self._run_fragments(frag)
+        inject("dcn/final-stage")
+        staged = self._stage_rows(frag, ledger.rows())
+        final = frag.final_builder(staged)
+        out, dicts, lines = self._executor.run_analyze(
+            final, frag_stats=infos
+        )
+        out_rows = materialize_rows(out, list(final.schema), dicts)
+        return [c.name for c in final.schema], out_rows, lines
+
+    def _run_fragments(
+        self, frag: FragmentPlan
+    ) -> Tuple[FragmentLedger, List[dict]]:
+        """Dispatch every fragment exactly once onto the alive hosts,
+        surviving losses up to max_attempts rounds. Returns the
+        completed ledger plus per-fragment telemetry (host, attempt,
+        rows, exec_s, bytes, spans) — only FENCED deliveries contribute,
+        so a retried fragment's stats and spans appear exactly once."""
         qid = next(_QUERY_ID)
         n = max(len(self.alive_endpoints()), 1)
         ledger = FragmentLedger(n)
+        infos: List[dict] = []
         last_err: Optional[Exception] = None
         for _round in range(self.max_attempts):
             pending = ledger.pending()
@@ -352,12 +469,16 @@ class DCNFragmentScheduler:
                 token = ledger.claim(fid, ep.address)
                 if ledger.attempts(fid) > 1:
                     inject("dcn/redispatch")
+                    _c_retries().inc()
                 meta = {
                     "qid": qid, "fid": fid, "n": n,
                     "attempt": ledger.attempts(fid),
+                    # opt the worker into span collection only when the
+                    # coordinator is actually tracing
+                    "trace": bool(self.tracer.enabled),
                 }
                 try:
-                    _cols, rows = self._dispatch(
+                    _cols, rows, resp = self._dispatch(
                         ep, frag.host_plan(fid, n), meta
                     )
                 except (SchemaOutOfDateError, RuntimeError, ValueError,
@@ -367,7 +488,8 @@ class DCNFragmentScheduler:
                     ledger.release(fid, token)
                     errs.append((ep, e))
                     return
-                ledger.complete(fid, token, rows)
+                if ledger.complete(fid, token, rows):
+                    self._note_fragment(infos, fid, ep, meta, resp)
 
             fatal: List[Exception] = []
 
@@ -401,7 +523,40 @@ class DCNFragmentScheduler:
                 f"{len(self.alive_endpoints())} alive); last error: "
                 f"{last_err}"
             )
-        return self._final_stage(frag, ledger.rows())
+        infos.sort(key=lambda f: f["fid"])
+        with self._lock:
+            self.last_query = {"qid": qid, "fragments": infos}
+        _update_host_gauges(self.endpoints)
+        return ledger, infos
+
+    def _note_fragment(self, infos, fid, ep, meta, resp) -> None:
+        """Record one FENCED fragment delivery: counters, the per-query
+        info list, and the host-labeled span merge into the
+        coordinator's tracer."""
+        stats = resp.get("stats") or {}
+        spans = resp.get("spans") or []
+        host = stats.get("host") or ep.address
+        exec_s = float(stats.get("exec_s", 0.0))
+        nbytes = int(resp.get("_nbytes", 0))
+        _c_bytes_staged().inc(nbytes)
+        _h_fragment_seconds().observe(exec_s)
+        info = {
+            "fid": fid, "host": host, "attempt": meta["attempt"],
+            "rows": int(stats.get("rows", 0)), "exec_s": exec_s,
+            "bytes": nbytes, "spans": spans,
+        }
+        with self._lock:
+            infos.append(info)
+        if self.tracer.enabled:
+            # rebase worker-clock span offsets onto the coordinator
+            # timeline: the reply landed NOW, so the fragment's spans
+            # end here and extend backwards by their own extent
+            base_s = 0.0
+            if self.tracer._t0 is not None and spans:
+                now_rel = time.perf_counter() - self.tracer._t0
+                extent = max(float(s[1]) + float(s[2]) for s in spans)
+                base_s = max(now_rel - extent, 0.0)
+            self.tracer.add_remote(spans, label=host, base_s=base_s)
 
     def _execute_single(self, plan) -> Tuple[List[str], List[tuple]]:
         """Whole-plan dispatch onto one host (shapes with no safe
@@ -415,6 +570,7 @@ class DCNFragmentScheduler:
                 break
             try:
                 inject("dcn/dispatch")
+                _c_dispatches().labels(host=ep.address).inc()
                 if inject("dcn/dispatch-lost"):
                     raise ConnectionError("failpoint: dispatch lost in transit")
                 with self._ep_lock(ep):
@@ -432,17 +588,14 @@ class DCNFragmentScheduler:
         )
 
     # -- final stage ----------------------------------------------------
-    def _final_stage(self, frag: FragmentPlan, rows: List[tuple]):
-        """Coordinator-side merge: stage the gathered partial rows as a
-        device batch and run the final plan (final aggregate + HAVING/
-        projections/ORDER BY/LIMIT) through the ordinary engine — the
-        root MPP fragment executing at the coordinator."""
-        inject("dcn/final-stage")
+    def _stage_rows(self, frag: FragmentPlan, rows: List[tuple]) -> L.Staged:
+        """Stage the gathered partial rows as a device batch under the
+        fragment plan's partial schema (the coordinator side of the DCN
+        exchange)."""
         from tidb_tpu.chunk import (
             HostBlock,
             block_to_batch,
             column_from_values,
-            materialize_rows,
             pad_capacity,
         )
 
@@ -455,11 +608,50 @@ class DCNFragmentScheduler:
                 dicts[oc.internal] = hc.dictionary
         block = HostBlock(cols, len(rows))
         batch = block_to_batch(block, pad_capacity(max(len(rows), 1)))
-        staged = L.Staged(
+        return L.Staged(
             frag.partial_schema, batch=batch, dicts=dicts,
             nonce=next(_STAGED_NONCE),
         )
+
+    def _final_stage(self, frag: FragmentPlan, rows: List[tuple]):
+        """Coordinator-side merge: stage the gathered partial rows as a
+        device batch and run the final plan (final aggregate + HAVING/
+        projections/ORDER BY/LIMIT) through the ordinary engine — the
+        root MPP fragment executing at the coordinator."""
+        inject("dcn/final-stage")
+        from tidb_tpu.chunk import materialize_rows
+
+        staged = self._stage_rows(frag, rows)
         final = frag.final_builder(staged)
         out, out_dicts = self._executor.run(final)
         out_rows = materialize_rows(out, list(final.schema), out_dicts)
         return [c.name for c in final.schema], out_rows
+
+    # -- status (the /dcn endpoint's payload) ---------------------------
+    def status(self) -> dict:
+        """Operational snapshot for server/http_status.py's /dcn
+        endpoint: host states plus the most recent query's per-fragment
+        stats (spans elided — they live in the coordinator tracer)."""
+        with self._lock:
+            last = self.last_query
+        if last is not None:
+            last = {
+                "qid": last["qid"],
+                "fragments": [
+                    {k: v for k, v in f.items() if k != "spans"}
+                    for f in last["fragments"]
+                ],
+            }
+        quarantined = [
+            ep.address for ep in self.prober.failed_endpoints()
+        ]
+        return {
+            "enabled": True,
+            "hosts": [
+                {"address": ep.address, "alive": bool(ep.alive)}
+                for ep in self.endpoints
+            ],
+            "alive": len(self.alive_endpoints()),
+            "quarantined": quarantined,
+            "last_query": last,
+        }

@@ -185,6 +185,9 @@ class CompiledQuery:
     bound_checks: List[Tuple[int, str, int, int]] = dataclasses.field(
         default_factory=list
     )
+    # plan signature for the engine watch: a second jit trace for the
+    # same sig is a retrace (obs/engine_watch.py)
+    sig: Optional[object] = None
 
 
 
@@ -2073,6 +2076,16 @@ class PhysicalExecutor:
             return {}
         return {k: jnp.asarray(v) for k, v in self.param_values.items()}
 
+    @staticmethod
+    def watch_sig(key: tuple) -> tuple:
+        """Version-independent plan signature for the engine watch's
+        retrace accounting: _cache_key is (deliberately) version-keyed
+        for plans over string columns, but a recompile of the same
+        logical plan driven by data growth IS the retrace the watch
+        exists to count — so the signature drops the version column."""
+        fp, versions = key
+        return (fp, tuple(v[:3] for v in versions))
+
     def _cache_key(self, plan: L.LogicalPlan) -> tuple:
         fp = plan_fingerprint(plan)
         versions = []
@@ -2210,6 +2223,9 @@ class PhysicalExecutor:
         for nid, cap in caps.items():
             ws += 2 * cap * cq.widths.get(nid, 64)
         self.last_working_set = ws
+        from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+        ENGINE_WATCH.note_device_mem(ws)
         if not quota:
             return
         from tidb_tpu.utils.failpoint import inject
@@ -2279,7 +2295,11 @@ class PhysicalExecutor:
             self._admit(cq, inputs, caps)
             frozen = dict(caps)
             if jit:
-                jitted = jax.jit(self._make_program(cq, frozen))
+                from tidb_tpu.obs.engine_watch import watched_jit
+
+                jitted = watched_jit(
+                    self._make_program(cq, frozen), sig=("discover", cq.sig)
+                )
             else:
                 # eager single-device path (EXPLAIN ANALYZE instrumentation)
                 fn = cq.fn
@@ -2340,14 +2360,15 @@ class PhysicalExecutor:
                 cq = None if conservative else self._cache.get(key)
                 if cq is not None:
                     self._cache.move_to_end(key)
-                    REGISTRY.counter("tidb_tpu_plan_cache_hits_total").inc()
+                    REGISTRY.counter("tidbtpu_executor_plan_cache_hits_total").inc()
                 else:
-                    REGISTRY.counter("tidb_tpu_plan_cache_misses_total").inc()
+                    REGISTRY.counter("tidbtpu_executor_plan_cache_misses_total").inc()
                     compiler = PlanCompiler(
                         self.catalog, resolver=self._resolve,
                         mesh_n=self.mesh_n, conservative=conservative,
                     )
                     cq = compiler.compile(plan)
+                    cq.sig = self.watch_sig(key)
                     while len(self._cache) >= 256:
                         self._cache.popitem(last=False)
                     self._cache[key] = cq
@@ -2408,12 +2429,15 @@ class PhysicalExecutor:
                 raise StaleWidthsError()
         shape_key = tuple(sorted((nid, b.capacity) for nid, b in inputs.items()))
 
+        from tidb_tpu.obs.engine_watch import ENGINE_WATCH, watched_jit
+
         if cq.jitted is not None and cq.input_shape_key == shape_key:
             out, needs = cq.jitted(inputs, self._params())
             # ONE device->host round trip: output batch + cardinality
             # scalars together. Also warms each array's host-value cache so
             # the session's materialization re-reads are free.
             needs_host = jax.device_get((needs, out))[0]
+            ENGINE_WATCH.d2h_batch(out)
             if not _overflowed(needs_host, cq.caps):
                 return out, cq.out_dicts
             # data grew past a tile: rediscover
@@ -2427,15 +2451,17 @@ class PhysicalExecutor:
             cq.caps[_OUT_NODE] = out_cap
             cq.input_shape_key = shape_key
             program = self._make_program(cq, dict(caps))
-            cq.jitted = jax.jit(
+            cq.jitted = watched_jit(
                 lambda i, pv, _p=program, _oc=out_cap: _steady_step(
                     _p, _oc, i, pv, mesh=self.mesh
-                )
+                ),
+                sig=("steady", cq.sig),
             )
             # compile + run the steady program now so every later run is a
             # single launch + single fetch
             out, needs = cq.jitted(inputs, self._params())
             needs_host = jax.device_get((needs, out))[0]
+            ENGINE_WATCH.d2h_batch(out)
             if not _overflowed(needs_host, cq.caps):
                 return out, cq.out_dicts
             # the post-shrink steady run overflowed: stop shrinking this
@@ -2448,8 +2474,16 @@ class PhysicalExecutor:
             cq.caps = dict(caps)
         raise ExecError("capacity discovery did not converge")
 
-    def run_analyze(self, plan: L.LogicalPlan) -> Tuple[Batch, Dicts, List[str]]:
-        """EXPLAIN ANALYZE: instrumented single run with per-node stats."""
+    def run_analyze(
+        self, plan: L.LogicalPlan, frag_stats=None
+    ) -> Tuple[Batch, Dicts, List[str]]:
+        """EXPLAIN ANALYZE: instrumented single run with per-node stats.
+
+        `frag_stats` is the distributed case (parallel/dcn.py): per-host
+        fragment runtime stats gathered from the worker replies, merged
+        into the plan-tree rows beneath the Staged exchange node the way
+        the reference merges cop-task RuntimeStatsColl into the
+        coordinator's plan tree."""
         from tidb_tpu.planner.hostagg import _find_gc_agg, try_host_agg
 
         if _find_gc_agg(plan) is not None:
@@ -2480,7 +2514,50 @@ class PhysicalExecutor:
                 else ""
             )
             lines.append("  " * depth + label + suffix)
+        if frag_stats:
+            lines = _merge_frag_stats(lines, frag_stats)
         return out, cq.out_dicts, lines
+
+
+def _merge_frag_stats(lines: List[str], frag_stats) -> List[str]:
+    """Insert per-host fragment rows into an EXPLAIN ANALYZE plan tree
+    beneath the Staged node (the DCN exchange's coordinator side): one
+    summary row (time min/avg/max across hosts, total rows and bytes
+    shipped) plus one row per fragment (rows/host, execution time,
+    bytes). The distributed analog of the reference's cop-task rows."""
+    frags = sorted(frag_stats, key=lambda f: f.get("fid", 0))
+    times = [float(f.get("exec_s", 0.0)) for f in frags] or [0.0]
+    hosts = sorted({f.get("host", "?") for f in frags})
+    total_bytes = sum(int(f.get("bytes", 0)) for f in frags)
+    total_rows = sum(int(f.get("rows", 0)) for f in frags)
+    summary = (
+        f"DCNFragments fragments={len(frags)} hosts={len(hosts)} "
+        f"rows={total_rows} bytes_shipped={total_bytes} "
+        f"time min={min(times)*1000:.2f}ms "
+        f"avg={(sum(times)/len(times))*1000:.2f}ms "
+        f"max={max(times)*1000:.2f}ms"
+    )
+    per_frag = [
+        (
+            f"Fragment#{f.get('fid')} host={f.get('host', '?')} "
+            f"attempt={f.get('attempt', 1)} rows={f.get('rows', 0)} "
+            f"time={float(f.get('exec_s', 0.0))*1000:.2f}ms "
+            f"bytes={f.get('bytes', 0)}"
+        )
+        for f in frags
+    ]
+    idx = next(
+        (i for i, ln in enumerate(lines) if ln.lstrip().startswith("Staged")),
+        None,
+    )
+    if idx is None:
+        pad = ""
+        insert_at = len(lines)
+    else:
+        pad = " " * (len(lines[idx]) - len(lines[idx].lstrip()) + 2)
+        insert_at = idx + 1
+    block = [pad + summary] + [pad + "  " + pf for pf in per_frag]
+    return lines[:insert_at] + block + lines[insert_at:]
 
 
 # pseudo node id for the final output's compaction capacity

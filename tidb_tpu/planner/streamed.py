@@ -320,12 +320,14 @@ class _StreamPlan:
         self.final = final
         self.jits = {}
         self.caps = None  # sticky discovered pipeline capacities
+        self.sig = None  # plan signature for the engine watch
 
     def chunk_step(self, cap: int, caps: dict):
         key = ("partial", cap, tuple(sorted(caps.items())))
         j = self.jits.get(key)
         if j is None:
             from tidb_tpu.expression.kernels import param_scope
+            from tidb_tpu.obs.engine_watch import watched_jit
 
             frozen = dict(caps)
 
@@ -338,12 +340,16 @@ class _StreamPlan:
                     )
                 return out, ng, needs
 
-            j = self.jits[key] = jax.jit(step)
+            j = self.jits[key] = watched_jit(
+                step, sig=("stream-partial", self.sig)
+            )
         return j
 
     def final_step(self, fcap: int):
         j = self.jits.get(("final", fcap))
         if j is None:
+            from tidb_tpu.obs.engine_watch import watched_jit
+
             fkeys, fdescs, post_avg = build_final_stage(
                 self.key_names, self.final
             )
@@ -354,7 +360,9 @@ class _StreamPlan:
                     key_widths=self.key_widths,
                 )
 
-            j = self.jits[("final", fcap)] = (jax.jit(step), post_avg)
+            j = self.jits[("final", fcap)] = (
+                watched_jit(step, sig=("stream-final", self.sig)), post_avg
+            )
         return j
 
 
@@ -404,6 +412,7 @@ def _stream_plan(executor, plan, agg, big_scan, conservative=False):
                 key_fns, key_names, key_widths,
                 partial, final, nonnull=comp.nonnull,
             )
+            entry.sig = (executor.watch_sig(key[0]), key[1])
     cache[key] = entry
     return entry
 
@@ -998,6 +1007,7 @@ class _SortStreamPlan:
         self.nonnull = list(nonnull)
         self.jits = {}
         self.caps = None
+        self.sig = None  # plan signature for the engine watch
 
 
 def _sort_stream_plan(executor, plan, sort, big_scan, conservative=False):
@@ -1049,6 +1059,7 @@ def _sort_stream_plan(executor, plan, sort, big_scan, conservative=False):
                 [s for s in comp.scans if s is not big_site],
                 comp.sized, key_fns, comp.nonnull,
             )
+            entry.sig = (executor.watch_sig(key[0]), key[1])
     cache[key] = entry
     return entry
 
@@ -1138,6 +1149,7 @@ def try_streamed_sort(executor, plan, conservative=False):
             j = sp.jits.get(caps_t)
             if j is None:
                 from tidb_tpu.expression.kernels import param_scope
+                from tidb_tpu.obs.engine_watch import watched_jit
 
                 frozen = dict(caps)
 
@@ -1147,7 +1159,9 @@ def try_streamed_sort(executor, plan, conservative=False):
                         keys = [f(b) for f in sp.key_fns]
                     return b, keys, needs
 
-                j = sp.jits[caps_t] = jax.jit(step)
+                j = sp.jits[caps_t] = watched_jit(
+                    step, sig=("stream-sort-chunk", sp.sig)
+                )
             return j
 
         for hb in _chunk_blocks(

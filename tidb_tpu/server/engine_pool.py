@@ -111,18 +111,21 @@ class FailedEngineProber:
             )
             self._thread.start()
 
-    def detect(self, ep: EngineEndpoint) -> None:
+    def detect(self, ep: EngineEndpoint) -> bool:
         """Mark an endpoint failed (idempotent) and schedule its first
-        probe after the initial backoff."""
+        probe after the initial backoff. Returns True iff this call
+        performed the alive->failed transition (so callers counting
+        quarantine events count each one exactly once)."""
         with self._lock:
             if not ep.alive:
-                return
+                return False
             ep.alive = False
             ep.failed_since = time.time()
             ep.detect_count += 1
             ep.probe_backoff_s = self.initial_backoff_s
             ep.next_probe = time.time() + ep.probe_backoff_s
             self._failed.append(ep)
+            return True
 
     def failed_endpoints(self) -> List[EngineEndpoint]:
         with self._lock:

@@ -26,6 +26,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time as _time
 from typing import List, Optional, Tuple
 
 from tidb_tpu.planner.ir import IR_VERSION, plan_from_ir, plan_to_ir
@@ -189,7 +190,8 @@ class EngineServer:
         inject("engine/execute")
         from tidb_tpu.chunk import materialize_rows
 
-        if req.get("frag") is not None:
+        frag = req.get("frag")
+        if frag is not None:
             # DCN fragment dispatch: a site before execution (dispatch
             # received, about to run — death here loses the fragment
             # cleanly) and one after (dcn/result-send below — death
@@ -208,9 +210,33 @@ class EngineServer:
                     f"client planned at {req['schema_v']}; reload schemas"
                 )
         plan = plan_from_ir(req["plan"])
-        batch, dicts = executor.run(plan)
-        rows = materialize_rows(batch, list(plan.schema), dicts)
-        if req.get("frag") is not None:
+        tracer = None
+        if frag is not None:
+            # trace context propagated over the RPC seam: the
+            # coordinator's (query id, fragment id) labels every span
+            # this worker records, and the spans ship back in the reply
+            # for host-labeled merge into the coordinator's Tracer.
+            # Span collection is opt-in per dispatch (frag["trace"], set
+            # from the coordinator tracer's enabled flag) so untraced
+            # production queries pay neither the Tracer nor the span
+            # payload in every reply; runtime stats always ship.
+            from tidb_tpu.utils.tracing import Tracer
+
+            tracer = Tracer()  # disabled by default: span() is a no-op
+            if frag.get("trace"):
+                tracer.enabled = True
+                tracer.reset()
+            ctx = f"q{frag.get('qid')}/f{frag.get('fid')}"
+            t_exec0 = _time.perf_counter()
+            with tracer.span(f"{ctx}/execute"):
+                batch, dicts = executor.run(plan)
+            with tracer.span(f"{ctx}/materialize"):
+                rows = materialize_rows(batch, list(plan.schema), dicts)
+            exec_s = _time.perf_counter() - t_exec0
+        else:
+            batch, dicts = executor.run(plan)
+            rows = materialize_rows(batch, list(plan.schema), dicts)
+        if frag is not None:
             # mid-shuffle worker death AFTER the work, BEFORE the reply:
             # the coordinator must re-dispatch, and its ledger must
             # accept the retry's result exactly once
@@ -221,8 +247,22 @@ class EngineServer:
             "columns": [c.name for c in plan.schema],
             "rows": rows,
         }
-        if req.get("frag") is not None:
-            resp["frag"] = req["frag"]
+        if frag is not None:
+            resp["frag"] = frag
+            if tracer.enabled:
+                resp["spans"] = [
+                    [s.name, s.start_s, s.dur_s, s.depth]
+                    for s in tracer.spans
+                ]
+            # no byte count here: the coordinator measures the actual
+            # reply frame length (EngineClient stamps _nbytes), which is
+            # what really crossed the DCN link — and avoids serializing
+            # the row set twice on the reply hot path
+            resp["stats"] = {
+                "rows": len(rows),
+                "exec_s": exec_s,
+                "host": f"{socket.gethostname()}:{self.port}",
+            }
         return json.dumps(resp).encode()
 
     def start_background(self) -> threading.Thread:
@@ -289,6 +329,10 @@ class EngineClient:
             self._dead = True
             raise ConnectionError("engine closed the connection")
         resp = json.loads(frame.decode())
+        if isinstance(resp, dict):
+            # wire-level reply size: the DCN exchange volume a fragment
+            # actually staged through the coordinator
+            resp["_nbytes"] = len(frame)
         if resp.get("id") != self._next_id:
             self._dead = True
             self._sock.close()
@@ -300,12 +344,23 @@ class EngineClient:
     def execute_plan(
         self, plan, schema_version: Optional[int] = None, frag=None
     ) -> Tuple[List[str], List[tuple]]:
+        cols, rows, _resp = self.execute_plan_full(
+            plan, schema_version=schema_version, frag=frag
+        )
+        return cols, rows
+
+    def execute_plan_full(
+        self, plan, schema_version: Optional[int] = None, frag=None
+    ) -> Tuple[List[str], List[tuple], dict]:
+        """execute_plan plus the raw response — fragment dispatches read
+        the worker's span list and runtime stats out of it."""
         req = {"v": IR_VERSION, "plan": plan_to_ir(plan)}
         if schema_version is not None:
             req["schema_v"] = int(schema_version)
         if frag is not None:
-            # fragment metadata (query id / fragment id / attempt):
-            # echoed in the response for the coordinator's ledger and
+            # fragment metadata (query id / fragment id / attempt): the
+            # trace context — echoed in the response for the
+            # coordinator's ledger, labels the worker's spans, and is
             # visible to the worker-side dcn/* failpoints
             req["frag"] = frag
         resp = self._call(req)
@@ -314,7 +369,7 @@ class EngineClient:
             if "SchemaOutOfDateError" in err:
                 raise SchemaOutOfDateError(err)
             raise RuntimeError(f"engine error: {err}")
-        return resp["columns"], [tuple(r) for r in resp["rows"]]
+        return resp["columns"], [tuple(r) for r in resp["rows"]], resp
 
     def close(self) -> None:
         self._dead = True

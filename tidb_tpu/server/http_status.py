@@ -1,10 +1,12 @@
-"""HTTP status API: /status, /metrics, /schema, /settings.
+"""HTTP status API: /status, /metrics, /schema, /settings, /dcn.
 
 Reference: pkg/server/http_status.go — the side port serving liveness
 (`/status`), Prometheus metrics (`/metrics`), schema introspection
 (`/schema`, backed by infoschema), and settings. pprof endpoints are
 Go-specific; the Python analog exposes the same operational surface
-over the same paths.
+over the same paths, plus `/dcn` — the cross-host fragment scheduler's
+operational snapshot (host liveness/quarantine + the last query's
+per-fragment stats; parallel/dcn.py `status()`).
 """
 
 from __future__ import annotations
@@ -16,8 +18,22 @@ from typing import Optional
 
 
 class StatusServer:
-    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 10080):
+    def __init__(
+        self,
+        catalog,
+        host: str = "127.0.0.1",
+        port: int = 10080,
+        connections=None,
+        dcn=None,
+    ):
         self.catalog = catalog
+        # live MySQL-protocol connection count provider (zero-arg
+        # callable wired by server/server.py; the reference reports
+        # Server.ConnectionCount here)
+        self.connections = connections
+        # DCN scheduler status provider: a zero-arg callable or an
+        # object with .status() (parallel/dcn.DCNFragmentScheduler)
+        self.dcn = dcn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -40,13 +56,28 @@ class StatusServer:
                     else:
                         ver = None
                     if path == "/status":
+                        try:
+                            nconn = int(outer.connections()) if callable(
+                                outer.connections
+                            ) else 0
+                        except Exception:
+                            nconn = 0
                         self._send(200, json.dumps(
                             {
-                                "connections": 0,
+                                "connections": nconn,
                                 "version": f"8.0.11-tidb-tpu-{ver}",
                                 "git_hash": "embedded",
                             }
                         ))
+                    elif path == "/dcn":
+                        prov = outer.dcn
+                        if prov is None:
+                            data = {"enabled": False}
+                        elif callable(prov):
+                            data = prov()
+                        else:
+                            data = prov.status()
+                        self._send(200, json.dumps(data))
                     elif path == "/metrics":
                         from tidb_tpu.utils.metrics import REGISTRY
 
@@ -97,6 +128,11 @@ class StatusServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._started = False
+
+    def attach_dcn(self, provider) -> None:
+        """Wire a DCN scheduler (or a zero-arg status callable) after
+        construction — the scheduler usually outlives server boot."""
+        self.dcn = provider
 
     def start_background(self) -> threading.Thread:
         self._started = True

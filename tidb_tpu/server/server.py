@@ -43,6 +43,7 @@ class Server:
         self.host = host
         self.port = port
         self._next_conn_id = [0]
+        self._active_conns = 0
         self._lock = threading.Lock()
         outer = self
 
@@ -69,7 +70,8 @@ class Server:
             from tidb_tpu.server.http_status import StatusServer
 
             self.status_server = StatusServer(
-                self.catalog, host=host, port=status_port
+                self.catalog, host=host, port=status_port,
+                connections=lambda: self.connections,
             )
 
     def serve_forever(self) -> None:
@@ -92,8 +94,24 @@ class Server:
         self._tcp.shutdown()
         self._tcp.server_close()
 
+    @property
+    def connections(self) -> int:
+        """Live client connection count (reference: Server.
+        ConnectionCount feeding the /status handler)."""
+        with self._lock:
+            return self._active_conns
+
     # ------------------------------------------------------------------
     def _handle_conn(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._active_conns += 1
+        try:
+            self._handle_conn_inner(sock)
+        finally:
+            with self._lock:
+                self._active_conns -= 1
+
+    def _handle_conn_inner(self, sock: socket.socket) -> None:
         io = P.PacketIO(sock)
         with self._lock:
             self._next_conn_id[0] += 1

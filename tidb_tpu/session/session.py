@@ -1324,7 +1324,7 @@ class Session(DDLMixin):
                 from tidb_tpu.utils.metrics import REGISTRY
 
                 REGISTRY.counter(
-                    "tidb_tpu_statement_errors_total", "failed statements"
+                    "tidbtpu_session_statement_errors_total", "failed statements"
                 ).inc()
                 raise
         return res
@@ -1359,6 +1359,11 @@ class Session(DDLMixin):
             self._current_stmt = (
                 getattr(s, "_source_sql", type(s).__name__), time.time()
             )
+            # engine watch: per-statement jit/retrace/transfer accounting
+            # (information_schema.TPU_ENGINE, obs/engine_watch.py)
+            from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+            ENGINE_WATCH.begin_query(self._current_stmt[0])
             from tidb_tpu.utils import sqlkiller as _sk
 
             # host-side blocking builtins (SLEEP) poll this session's
@@ -1394,6 +1399,9 @@ class Session(DDLMixin):
             self._stmt_depth -= 1
             if top:
                 self._current_stmt = None
+                from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+                ENGINE_WATCH.end_query(time.perf_counter() - t0)
             if top and bill_t0 is not None:
                 try:
                     self.catalog.resource_groups.debit(
@@ -2865,10 +2873,10 @@ class Session(DDLMixin):
         from tidb_tpu.utils.metrics import REGISTRY, SLOW_LOG, STMT_SUMMARY
 
         REGISTRY.counter(
-            "tidb_tpu_statements_total", "statements executed"
+            "tidbtpu_session_statements_total", "statements executed"
         ).inc()
         REGISTRY.histogram(
-            "tidb_tpu_query_duration_seconds", "statement latency"
+            "tidbtpu_session_query_duration_seconds", "statement latency"
         ).observe(elapsed_s)
         sql = getattr(s, "_source_sql", None) or type(s).__name__
         STMT_SUMMARY.record(sql, elapsed_s)
@@ -3579,7 +3587,7 @@ class Session(DDLMixin):
         from tidb_tpu.utils.metrics import REGISTRY
 
         REGISTRY.counter(
-            "tidb_tpu_binding_hits_total", "statements matched to bindings"
+            "tidbtpu_session_binding_hits_total", "statements matched to bindings"
         ).inc()
         return s
 

@@ -44,7 +44,7 @@ def maybe_auto_analyze(table, ratio: float = 0.5) -> bool:
     from tidb_tpu.utils.metrics import REGISTRY
 
     REGISTRY.counter(
-        "tidb_tpu_auto_analyze_total", "auto-analyze runs"
+        "tidbtpu_stats_auto_analyze_total", "auto-analyze runs"
     ).inc()
     return True
 

@@ -298,12 +298,14 @@ class Catalog:
         "tables", "columns", "schemata", "statistics", "slow_query",
         "statements_summary", "metrics", "top_sql", "resource_groups",
         "sequences", "memory_usage", "memory_usage_ops_history",
+        "tpu_engine",
     )
 
     def _infoschema_table(self, name: str) -> Table:
         if name in (
             "slow_query", "statements_summary", "metrics", "top_sql",
             "resource_groups", "memory_usage", "memory_usage_ops_history",
+            "tpu_engine",
         ):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
@@ -600,6 +602,22 @@ class Catalog:
                 [("name", STRING), ("kind", STRING), ("value", FLOAT64)]
             )
             rows = REGISTRY.rows()
+        elif name == "tpu_engine":
+            # per-query engine accounting: jit compilations, retraces,
+            # host<->device transfer bytes, device-memory high-water
+            # (obs/engine_watch.py — the accelerator-native analog of
+            # the reference's per-statement execdetails)
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+            schema = TableSchema(
+                [("qid", INT64), ("query", STRING),
+                 ("jit_compilations", INT64), ("retraces", INT64),
+                 ("h2d_bytes", INT64), ("d2h_bytes", INT64),
+                 ("device_mem_peak_bytes", INT64),
+                 ("duration", FLOAT64)]
+            )
+            rows = ENGINE_WATCH.rows()
         elif name == "resource_groups":
             from tidb_tpu.dtypes import FLOAT64
 

@@ -16,14 +16,119 @@ from typing import Callable, Dict, Optional
 _lock = threading.Lock()
 _active: Dict[str, object] = {}
 
+#: Every failpoint site the engine defines. A site must be declared here
+#: to be enable()-able, and scripts/check_failpoints.py (tier-1 via
+#: tests/test_failpoint_sites.py) cross-checks this set against the
+#: actual `inject(...)` call sites — a typo'd name in a test can no
+#: longer silently arm nothing (the reference generates its site list
+#: from the failpoint.Inject rewrite step; we lint instead).
+SITES = frozenset({
+    "br/statement",
+    "catalog/create-table",
+    "catalog/drop-table",
+    "cdc/sink-write",
+    "collate/rank-lut",
+    "cte/iterate",
+    "dcn/dispatch",
+    "dcn/dispatch-lost",
+    "dcn/duplicate-redelivery",
+    "dcn/final-stage",
+    "dcn/fragment-execute",
+    "dcn/heartbeat-timeout",
+    "dcn/redispatch",
+    "dcn/result-send",
+    "ddl/alter-table",
+    "ddl/create-index",
+    "ddl/generated-recompute",
+    "ddl/index-before-public",
+    "ddl/index-write-only",
+    "ddl/index-write-reorg",
+    "ddl/modify-column-delta-retry",
+    "ddl/modify-column-reorg",
+    "ddl/rename-table",
+    "dml/delete",
+    "dml/insert",
+    "dml/load",
+    "dml/update",
+    "dxf/heartbeat",
+    "dxf/submit",
+    "engine/dispatch",
+    "engine/execute",
+    "engine/probe-fail",
+    "exchange/gather",
+    "exchange/range-repartition",
+    "exchange/repartition",
+    "executor/admission",
+    "executor/aggregate",
+    "executor/before-discover",
+    "executor/cap-overflow",
+    "executor/join",
+    "executor/partition-feed",
+    "executor/partition-start",
+    "executor/sort",
+    "executor/stream-chunk",
+    "executor/stream-chunk-device",
+    "executor/stream-sort",
+    "executor/stream-start",
+    "extsort/merge-round",
+    "extsort/merge-views",
+    "fk/cascade-delete",
+    "fk/cascade-update",
+    "locks/acquire",
+    "locks/deadlock-detected",
+    "logbackup/write-segment",
+    "persist/backup-table",
+    "persist/before-manifest",
+    "persist/restore-start",
+    "resgroup/debit",
+    "sequence/nextval",
+    "server/dispatch-query",
+    "session/before-commit",
+    "session/begin-txn",
+    "session/commit-apply",
+    "session/commit-conflict-check",
+    "session/execute-prepared",
+    "session/stmt-start",
+    "stats/analyze",
+    "storage/append-skip-unique",
+    "storage/gc-drop-version",
+    "storage/gc-versions",
+    "storage/install-commit",
+    "storage/scan",
+    "watchdog/sample",
+})
+
+#: sites declared at runtime (tests exercising the lint itself or
+#: prototyping a new site before it lands in SITES)
+_extra_sites: set = set()
+
 
 class FailpointError(RuntimeError):
     pass
 
 
+def declare(name: str) -> None:
+    """Declare an out-of-tree site (tests/prototypes). Engine sites
+    belong in SITES."""
+    with _lock:
+        _extra_sites.add(name)
+
+
+def is_declared(name: str) -> bool:
+    return name in SITES or name in _extra_sites
+
+
 def enable(name: str, action: object) -> None:
     """action: an Exception instance/class to raise, a callable hook, or
-    a value to return from inject()."""
+    a value to return from inject(). Rejects undeclared site names — a
+    typo here would otherwise arm nothing and the test would silently
+    pass."""
+    if not is_declared(name):
+        raise ValueError(
+            f"unknown failpoint site {name!r}: declare it in "
+            "utils/failpoint.py SITES (engine sites) or via declare() "
+            "(test-local sites)"
+        )
     with _lock:
         _active[name] = action
 

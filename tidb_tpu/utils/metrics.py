@@ -8,6 +8,13 @@ statement stats). Single-process rendering: a plain in-memory registry
 with Prometheus text exposition, a ring-buffer slow log, and a
 digest-keyed summary map — all queryable through information_schema
 virtual tables so the SQL surface matches the reference's.
+
+Metric naming convention (enforced by scripts/check_metric_names.py):
+``tidbtpu_<subsystem>_<name>`` — e.g. tidbtpu_engine_jit_compilations,
+tidbtpu_dcn_dispatches, tidbtpu_session_statements_total. Counters,
+gauges (set/inc/dec) and fixed-bucket histograms, all optionally
+labeled: ``REGISTRY.counter("tidbtpu_dcn_dispatches", "…",
+labels=("host",)).labels(host=addr).inc()``.
 """
 
 from __future__ import annotations
@@ -19,7 +26,31 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label value escaping (backslash, quote,
+    newline — exposition format spec)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_block(labelnames, labelvalues) -> str:
+    """'{k="v",…}' or '' for the unlabeled case."""
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"'
+        for k, v in zip(labelnames, labelvalues)
+    )
+    return "{" + inner + "}"
+
+
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
@@ -31,8 +62,41 @@ class Counter:
             self.value += n
 
 
+class Gauge:
+    """A value that can go up and down (reference: prometheus Gauge —
+    connection counts, quarantined hosts, memory high-water)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def set_max(self, v: float) -> None:
+        """High-water helper: keep the maximum of the current value and v."""
+        with self._lock:
+            if v > self.value:
+                self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value -= n
+
+
 class Histogram:
     """Fixed-bucket latency histogram (seconds)."""
+
+    kind = "histogram"
 
     BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)
 
@@ -55,24 +119,123 @@ class Histogram:
             self.counts[-1] += 1
 
 
+class MetricFamily:
+    """A labeled metric: one (name, labelnames) family whose children
+    are plain Counter/Gauge/Histogram instances keyed by label values
+    (reference: prometheus client_golang *Vec collectors)."""
+
+    def __init__(self, cls, name: str, help_: str, labelnames: Tuple[str, ...]):
+        self.cls = cls
+        self.kind = cls.kind
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            extra = set(kv) - set(self.labelnames)
+            if extra:
+                raise ValueError(
+                    f"{self.name}: unknown label(s) {sorted(extra)} "
+                    f"(labelnames={self.labelnames})"
+                )
+            try:
+                values = tuple(kv[k] for k in self.labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"{self.name}: missing label {e} "
+                    f"(labelnames={self.labelnames})"
+                ) from None
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values, got {len(values)}"
+            )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self.cls(self.name, self.help)
+            return child
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+def _fmt_value(v: float) -> str:
+    """Full-precision sample rendering: %g truncates to 6 significant
+    digits, which makes byte-scale counters (h2d/d2h bytes) step in
+    ~1e5 increments once they pass 1e10 — rate() over scrapes then
+    reads zero between jumps. Integral values render as integers, the
+    rest via repr (shortest round-trip float), like the official
+    Prometheus clients."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _render_one(out: List[str], name: str, m, labelnames=(), labelvalues=()):
+    lb = _label_block(labelnames, labelvalues)
+    if isinstance(m, (Counter, Gauge)):
+        out.append(f"{name}{lb} {_fmt_value(m.value)}")
+    else:  # Histogram: cumulative le buckets per the exposition format
+        acc = 0
+        for b, c in zip(m.BUCKETS, m.counts):
+            acc += c
+            blb = _label_block(
+                tuple(labelnames) + ("le",), tuple(labelvalues) + (f"{b:g}",)
+            )
+            out.append(f"{name}_bucket{blb} {acc}")
+        blb = _label_block(
+            tuple(labelnames) + ("le",), tuple(labelvalues) + ("+Inf",)
+        )
+        out.append(f"{name}_bucket{blb} {m.total}")
+        out.append(f"{name}_sum{lb} {_fmt_value(m.sum)}")
+        out.append(f"{name}_count{lb} {m.total}")
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
-    def counter(self, name: str, help_: str = "") -> Counter:
+    def _get(self, cls, name: str, help_: str, labels):
+        labels = tuple(labels or ())
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = self._metrics[name] = Counter(name, help_)
-            return m
+                if labels:
+                    m = MetricFamily(cls, name, help_, labels)
+                else:
+                    m = cls(name, help_)
+                self._metrics[name] = m
+                return m
+        # consistency: a name is one kind + one label set, forever
+        existing_kind = getattr(m, "kind", None)
+        if existing_kind != cls.kind:
+            raise ValueError(
+                f"metric {name} already registered as {existing_kind}"
+            )
+        if isinstance(m, MetricFamily) != bool(labels) or (
+            isinstance(m, MetricFamily) and m.labelnames != labels
+        ):
+            raise ValueError(
+                f"metric {name} already registered with different labels"
+            )
+        return m
 
-    def histogram(self, name: str, help_: str = "") -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = self._metrics[name] = Histogram(name, help_)
-            return m
+    def counter(self, name: str, help_: str = "", labels=()) -> Counter:
+        return self._get(Counter, name, help_, labels)
+
+    def gauge(self, name: str, help_: str = "", labels=()) -> Gauge:
+        return self._get(Gauge, name, help_, labels)
+
+    def histogram(self, name: str, help_: str = "", labels=()) -> Histogram:
+        return self._get(Histogram, name, help_, labels)
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -80,30 +243,37 @@ class Registry:
         with self._lock:
             items = sorted(self._metrics.items())
         for name, m in items:
-            if isinstance(m, Counter):
-                out.append(f"# TYPE {name} counter")
-                out.append(f"{name} {m.value:g}")
+            out.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, MetricFamily):
+                for values, child in m.children():
+                    _render_one(out, name, child, m.labelnames, values)
             else:
-                out.append(f"# TYPE {name} histogram")
-                acc = 0
-                for b, c in zip(m.BUCKETS, m.counts):
-                    acc += c
-                    out.append(f'{name}_bucket{{le="{b:g}"}} {acc}')
-                out.append(f'{name}_bucket{{le="+Inf"}} {m.total}')
-                out.append(f"{name}_sum {m.sum:g}")
-                out.append(f"{name}_count {m.total}")
+                _render_one(out, name, m)
         return "\n".join(out) + "\n"
 
     def rows(self) -> List[Tuple[str, str, float]]:
+        """(name, kind, value) triplets for the information_schema
+        METRICS virtual table; labeled children carry their label block
+        in the name column."""
         with self._lock:
             items = sorted(self._metrics.items())
-        out = []
+        out: List[Tuple[str, str, float]] = []
         for name, m in items:
-            if isinstance(m, Counter):
-                out.append((name, "counter", float(m.value)))
-            else:
+            if isinstance(m, MetricFamily):
+                for values, child in m.children():
+                    lb = _label_block(m.labelnames, values)
+                    if isinstance(child, Histogram):
+                        out.append((name + "_count" + lb, "histogram",
+                                    float(child.total)))
+                        out.append((name + "_sum" + lb, "histogram",
+                                    float(child.sum)))
+                    else:
+                        out.append((name + lb, child.kind, float(child.value)))
+            elif isinstance(m, Histogram):
                 out.append((name + "_count", "histogram", float(m.total)))
                 out.append((name + "_sum", "histogram", float(m.sum)))
+            else:
+                out.append((name, m.kind, float(m.value)))
         return out
 
 

@@ -53,6 +53,25 @@ class Tracer:
                 Span(name, start - self._t0, time.perf_counter() - start, depth)
             )
 
+    def add_remote(self, spans, label: str, base_s: float = 0.0) -> None:
+        """Merge spans shipped back from a remote worker (the DCN
+        fragment reply's span list), host-labeled so the coordinator's
+        trace shows where each fragment ran. Accepts Span objects or
+        (name, start_s, dur_s, depth) sequences. Remote start offsets
+        are relative to the worker's own clock; `base_s` rebases them
+        onto this tracer's timeline (the caller knows when the reply
+        landed) so rows()'s start-sorted output doesn't put every
+        remote span at time zero."""
+        for s in spans:
+            if isinstance(s, Span):
+                name, start_s, dur_s, depth = s.name, s.start_s, s.dur_s, s.depth
+            else:
+                name, start_s, dur_s, depth = s
+            self.spans.append(
+                Span(f"{label}:{name}", float(start_s) + float(base_s),
+                     float(dur_s), max(int(depth), 1))
+            )
+
     def rows(self):
         out = []
         for s in sorted(self.spans, key=lambda s: s.start_s):

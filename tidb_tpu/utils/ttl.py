@@ -55,7 +55,7 @@ def expire_table(table, now_unix: Optional[float] = None) -> int:
         from tidb_tpu.utils.metrics import REGISTRY
 
         REGISTRY.counter(
-            "tidb_tpu_ttl_expired_rows_total", "rows purged by TTL"
+            "tidbtpu_ttl_expired_rows_total", "rows purged by TTL"
         ).inc(removed)
     return removed
 
@@ -82,7 +82,7 @@ class TTLWorker:
                     from tidb_tpu.utils.metrics import REGISTRY
 
                     REGISTRY.counter(
-                        "tidb_tpu_ttl_errors_total", "failed TTL sweeps"
+                        "tidbtpu_ttl_errors_total", "failed TTL sweeps"
                     ).inc()
                     continue
         return n

@@ -126,7 +126,7 @@ class InstanceWatchdog(threading.Thread):
             if elapsed >= thr and key not in self.expensive_seen:
                 self.expensive_seen.add(key)
                 REGISTRY.counter(
-                    "tidb_tpu_expensive_queries_total",
+                    "tidbtpu_watchdog_expensive_queries_total",
                     "statements running past the expensive threshold",
                 ).inc()
                 from tidb_tpu.utils.metrics import SLOW_LOG
@@ -150,7 +150,7 @@ class InstanceWatchdog(threading.Thread):
             )
             del self.alarm_records[:-max(keep, 1)]
             REGISTRY.counter(
-                "tidb_tpu_memory_usage_alarms_total",
+                "tidbtpu_watchdog_memory_usage_alarms_total",
                 "instance memory passed the alarm ratio",
             ).inc()
 
@@ -178,7 +178,7 @@ class InstanceWatchdog(threading.Thread):
                 )
                 del self.kill_records[:-64]
                 REGISTRY.counter(
-                    "tidb_tpu_server_memory_limit_kills_total",
+                    "tidbtpu_watchdog_server_memory_limit_kills_total",
                     "statements killed at the instance memory limit",
                 ).inc()
 
