@@ -741,6 +741,164 @@ def supervise(args, passthrough) -> int:
     return rc
 
 
+def measure_multihost_shuffle(args) -> int:
+    """Multihost shuffle-join scenario: a 2-worker x 4-device CPU
+    dryrun runs one repartition-join query BOTH ways — partial-agg
+    staging through the coordinator vs direct worker-to-worker tunnels
+    — and records where the inter-host bytes actually went
+    (bytes_over_coordinator vs bytes_over_tunnels) alongside the
+    timings. This is a DATA-PLANE benchmark, deliberately CPU (the
+    workers are subprocesses; backend provenance is stamped like every
+    other result so no consumer can mistake it for a hardware
+    capture)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import re
+    import statistics
+
+    from tidb_tpu.bench import load_tpch
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+    from tidb_tpu.utils.metrics import REGISTRY
+
+    # 2 CPU worker processes can't chew SF10: cap the dryrun scale
+    sf = args.sf if args.sf <= 1.0 else 0.02
+    seed = 3
+    workers = []
+    try:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        ports = []
+        for _ in range(2):
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tidb_tpu.parallel.dcn_worker",
+                    "--port", "0", "--mesh-devices", "4",
+                    "--tpch-sf", str(sf), "--seed", str(seed),
+                    "--tables", "orders,lineitem",
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            workers.append(p)
+            line = p.stdout.readline()
+            m = re.match(r"DCN_WORKER_READY port=(\d+)", line)
+            if not m:
+                # drain the merged stdout/stderr so a startup crash
+                # (jax init, import error) is diagnosable
+                try:
+                    rest, _ = p.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rest = ""
+                raise RuntimeError(
+                    f"worker not ready: {line!r}\n{rest[-3000:]}"
+                )
+            ports.append(int(m.group(1)))
+
+        cat = Catalog()
+        load_tpch(cat, sf=sf, seed=seed, tables=["orders", "lineitem"])
+        sess = Session(cat, db="tpch")
+        # a true repartition-join shape: neither side pre-aggregates
+        # below the join (Q18's planner rewrites the agg under the
+        # join, which removes the shuffle cut entirely)
+        sql = (
+            "select o_orderpriority, count(*), sum(l_extendedprice) "
+            "from orders join lineitem on o_orderkey = l_orderkey "
+            "where l_quantity < 24 "
+            "group by o_orderpriority order by o_orderpriority"
+        )
+        plan = build_query(
+            parse(sql)[0], cat, "tpch", sess._scalar_subquery
+        )
+
+        def run_mode(mode):
+            sched = DCNFragmentScheduler(
+                [("127.0.0.1", pt) for pt in ports],
+                catalog=cat, shuffle_mode=mode,
+            )
+            try:
+                staged0 = sum(
+                    v for n, _k, v in REGISTRY.rows()
+                    if n.startswith("tidbtpu_dcn_bytes_staged")
+                )
+                tunneled0 = sum(
+                    v for n, _k, v in REGISTRY.rows()
+                    if n.startswith("tidbtpu_shuffle_bytes_total")
+                )
+                times, rows = [], []
+                for _ in range(max(args.repeat, 1)):
+                    t0 = time.perf_counter()
+                    _cols, out = sched.execute_plan(plan)
+                    times.append(time.perf_counter() - t0)
+                    rows = out
+                staged1 = sum(
+                    v for n, _k, v in REGISTRY.rows()
+                    if n.startswith("tidbtpu_dcn_bytes_staged")
+                )
+                tunneled1 = sum(
+                    v for n, _k, v in REGISTRY.rows()
+                    if n.startswith("tidbtpu_shuffle_bytes_total")
+                )
+                return {
+                    "seconds": statistics.median(times),
+                    "rows": len(rows),
+                    "bytes_over_coordinator": staged1 - staged0,
+                    "bytes_over_tunnels": tunneled1 - tunneled0,
+                    "result": rows,
+                }
+            finally:
+                sched.close()
+
+        staged = run_mode("never")
+        tunnel = run_mode("always")
+        assert tunnel["result"] == staged["result"], "mode parity broke"
+        nrows_lineitem = cat.table("tpch", "lineitem").nrows
+        result = {
+            "metric": f"multihost_shuffle_join_sf{sf:g}_rows_per_sec",
+            "value": round(nrows_lineitem / tunnel["seconds"], 2),
+            "unit": "rows/s",
+            "vs_baseline": round(
+                staged["seconds"] / tunnel["seconds"], 4
+            ),
+            "detail": {
+                "backend": "cpu",
+                "scenario": "multihost_shuffle",
+                "workers": 2,
+                "mesh_devices": 4,
+                "sf": sf,
+                "repeat": args.repeat,
+                "staged": {
+                    k: v for k, v in staged.items() if k != "result"
+                },
+                "tunneled": {
+                    k: v for k, v in tunnel.items() if k != "result"
+                },
+                "backend_provenance": {
+                    "backend": "cpu",
+                    "pjrt_backend": "cpu",
+                    "code_version": _code_version(),
+                    "captured_unix": int(time.time()),
+                    # a deliberate CPU data-plane dryrun, not a TPU
+                    # capture that fell back
+                    "fallback": False,
+                },
+            },
+        }
+    finally:
+        for p in workers:
+            p.kill()
+    rc = 0
+    if args.out:
+        args.cpu = True  # deliberate CPU scenario: not a fallback
+        rc = _write_out(args, result)
+    print(json.dumps(result))
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     # SF10 headline: BASELINE.md's ladder runs SF10-SF100 and the north
@@ -773,10 +931,23 @@ def main() -> int:
         "tidbtpu_* counters) to this JSON file; the delta is also "
         "stamped into detail.engine_metrics of the result",
     )
+    ap.add_argument(
+        "--multihost-shuffle", action="store_true",
+        help="run the 2-worker DCN shuffle-join dryrun instead of the "
+        "single-engine ladder: measures a repartition-join query "
+        "(orders JOIN lineitem GROUP BY o_orderpriority — Q18 itself "
+        "pre-aggregates below the join, which removes the shuffle cut) "
+        "with partial-agg coordinator staging vs direct worker-to-"
+        "worker tunnels and records bytes_over_coordinator vs "
+        "bytes_over_tunnels (CPU data-plane scenario; SF capped at "
+        "0.02 unless --sf <= 1)",
+    )
     ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.quick:
         args.sf = 0.01
+    if args.multihost_shuffle:
+        return measure_multihost_shuffle(args)
 
     if args._measure:
         return measure(args)

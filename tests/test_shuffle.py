@@ -1,0 +1,537 @@
+"""Worker-to-worker DCN shuffle service: partitioning, fences,
+backpressure, fragmenter cuts, and in-process end-to-end stages.
+
+Reference: ExchangeSender/ExchangeReceiver HashPartition tunnels
+(unistore cophandler/mpp_exec.go:597,711). These tests run the data
+plane against in-process EngineServers (the unistore move: full
+protocol, no cluster); the true 2-process x 4-device dryruns live in
+test_multihost.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+from tidb_tpu.parallel.shuffle import (
+    PeerDeadError,
+    PeerTunnel,
+    ShuffleStore,
+    ShuffleWaitTimeout,
+    mix_hash_np,
+    partition_rows,
+)
+from tidb_tpu.parser.sqlparse import parse
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.fragmenter import split_plan, split_plan_shuffle
+from tidb_tpu.planner.logical import build_query
+from tidb_tpu.server.engine_pool import FailedEngineProber
+from tidb_tpu.server.engine_rpc import EngineServer
+from tidb_tpu.session.session import Session
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.metrics import (
+    REGISTRY,
+    Registry,
+    counter_delta,
+    counter_snapshot,
+    merge_counter_delta,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a int, b varchar(8), c decimal(10,2))")
+    s.execute(
+        "insert into t values (1,'x',1.50),(2,'y',2.25),(3,'x',0.25),"
+        "(4,null,10.00),(null,'z',3.00),(2,'x',4.75),(7,'y',0.10)"
+    )
+    s.execute("create table u (k int, v int)")
+    s.execute(
+        "insert into u values (1,10),(2,20),(3,30),(4,40),(1,11),(9,90)"
+    )
+    return s
+
+
+def _plan(sess, q):
+    return build_query(parse(q)[0], sess.catalog, "test", sess._scalar_subquery)
+
+
+# ---------------------------------------------------------------------------
+# hash partitioning
+# ---------------------------------------------------------------------------
+
+
+class TestHashPartition:
+    def test_host_hash_matches_device_hash(self):
+        """The host-tier mix (numpy) and the ICI-tier mix
+        (exchange._mix_hash, jax) are the SAME function — the two
+        shuffle levels compose hierarchically."""
+        import jax.numpy as jnp
+
+        from tidb_tpu.parallel.exchange import _mix_hash
+
+        vals = np.array(
+            [0, 1, 2, 3, -1, -7, 10**12, -(10**15), 2**62, 17],
+            dtype=np.int64,
+        )
+        host = mix_hash_np(vals)
+        dev = np.asarray(_mix_hash(jnp.asarray(vals)))
+        assert host.tolist() == dev.tolist()
+        assert (host >= 0).all()
+
+    def test_partition_rows_colocates_equal_keys(self):
+        rows = [(k, i) for i, k in enumerate([1, 2, 1, 3, 2, 1, None, None])]
+        parts = partition_rows(rows, 0, 3)
+        assert sum(len(p) for p in parts) == len(rows)
+        # NULL keys all on partition 0
+        assert all(r[0] is not None for p in parts[1:] for r in p)
+        where = {}
+        for pi, p in enumerate(parts):
+            for r in p:
+                if r[0] is not None:
+                    where.setdefault(r[0], set()).add(pi)
+        assert all(len(ps) == 1 for ps in where.values())
+
+    def test_string_keys_deterministic_across_calls(self):
+        """String keys must hash identically everywhere (python hash()
+        is process-salted and would split a key across producers)."""
+        rows = [("alpha", 1), ("beta", 2), ("alpha", 3), ("gamma", 4)]
+        a = [len(p) for p in partition_rows(rows, 0, 4)]
+        b = [len(p) for p in partition_rows(list(rows), 0, 4)]
+        assert a == b
+        parts = partition_rows(rows, 0, 4)
+        where = {}
+        for pi, p in enumerate(parts):
+            for r in p:
+                where.setdefault(r[0], set()).add(pi)
+        assert len(where["alpha"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# receive store fences (the FragmentLedger pattern on the data plane)
+# ---------------------------------------------------------------------------
+
+
+class TestShuffleStoreFences:
+    def test_duplicate_seq_dropped(self):
+        st = ShuffleStore()
+        st.open("q1", 1, 2)
+        assert st.push("q1", 1, 2, 0, 0, 0, [(1,)]) is True
+        assert st.push("q1", 1, 2, 0, 0, 0, [(1,)]) is False  # retransmit
+        st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
+        st.push("q1", 1, 2, 0, 1, -1, None, nseq=0)
+        out = st.wait("q1", 1, 1, 2, timeout_s=5)
+        assert out[0] == [(1,)]  # landed exactly once
+
+    def test_stale_attempt_fenced(self):
+        st = ShuffleStore()
+        st.open("q1", 2, 1)
+        # a zombie producer still pushing attempt 1 after the stage
+        # restarted must not land anything
+        assert st.push("q1", 1, 2, 0, 0, 0, [("old",)]) is False
+        assert st.push("q1", 2, 1, 0, 0, 0, [("new",)]) is True
+        st.push("q1", 2, 1, 0, 0, -1, None, nseq=1)
+        assert st.wait("q1", 2, 1, 1, timeout_s=5)[0] == [("new",)]
+
+    def test_newer_attempt_resets_stage(self):
+        """Pushes from a fast peer's NEW attempt may arrive before this
+        worker's own re-dispatched task opens the stage: the store
+        resets to the new attempt and discards old-attempt data."""
+        st = ShuffleStore()
+        st.open("q1", 1, 2)
+        st.push("q1", 1, 2, 0, 0, 0, [("old",)])
+        assert st.push("q1", 2, 1, 0, 0, 0, [("new",)]) is True
+        st.push("q1", 2, 1, 0, 0, -1, None, nseq=1)
+        out = st.wait("q1", 2, 1, 1, timeout_s=5)
+        assert out[0] == [("new",)]
+
+    def test_wait_timeout_names_missing_senders(self):
+        st = ShuffleStore()
+        st.open("q1", 1, 2)
+        st.push("q1", 1, 2, 0, 0, 0, [(1,)])
+        st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
+        with pytest.raises(ShuffleWaitTimeout) as ei:
+            st.wait("q1", 1, 1, 2, timeout_s=0.2)
+        assert ei.value.missing == ["side0/sender1"]
+
+    def test_wait_orders_rows_by_sender_then_seq(self):
+        st = ShuffleStore()
+        st.open("q1", 1, 2)
+        st.push("q1", 1, 2, 0, 1, 1, [(31,)])
+        st.push("q1", 1, 2, 0, 1, 0, [(30,)])
+        st.push("q1", 1, 2, 0, 1, -1, None, nseq=2)
+        st.push("q1", 1, 2, 0, 0, 0, [(10,), (11,)])
+        st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
+        out = st.wait("q1", 1, 1, 2, timeout_s=5)
+        assert out[0] == [(10,), (11,), (30,), (31,)]
+
+
+# ---------------------------------------------------------------------------
+# tunnels: backpressure + retransmit dedupe over a real EngineServer
+# ---------------------------------------------------------------------------
+
+
+def _packet(sid, seq, rows, attempt=1, m=2, side=0, sender=0):
+    return {
+        "sid": sid, "attempt": attempt, "m": m, "side": side,
+        "sender": sender, "part": 1, "seq": seq, "rows": rows,
+    }
+
+
+class TestTunnel:
+    def test_backpressure_stalls_and_delivers(self, sess):
+        """A slow receiver + a tiny in-flight window: sends block
+        (counted as tunnel stalls) but every packet still lands."""
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        failpoint.enable("shuffle/recv", lambda: time.sleep(0.05))
+        tun = PeerTunnel(
+            "127.0.0.1", srv.port, None, src="test",
+            max_inflight_bytes=64,  # ~half a packet: window of one
+        )
+        try:
+            for seq in range(6):
+                p = _packet("qbp", seq, [[seq, "x" * 16]])
+                tun.send(p, nbytes=128, nrows=1)
+            tun.send(_packet("qbp", -1, None) | {"nseq": 6}, 32, 0)
+            tun.flush()
+        finally:
+            tun.close()
+            failpoint.disable("shuffle/recv")
+        assert tun.stalls > 0
+        # every packet still landed, exactly once
+        stream = srv.shuffle_worker().store._stages["qbp"].streams[(0, 0)]
+        assert stream.nseq == 6 and len(stream.seqs) == 6
+        srv.shutdown()
+
+    def test_ack_loss_retransmit_lands_exactly_once(self, sess):
+        """shuffle/recv-ack-lost: the receiver stores the packet then
+        drops the connection (ack lost). The tunnel reconnects and
+        retransmits; the seq dedupe drops the duplicate — the packet
+        lands exactly once."""
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        failpoint.enable("shuffle/recv-ack-lost", failpoint.after_n(1, True))
+        dup0 = REGISTRY.counter(
+            "tidbtpu_shuffle_duplicates_dropped",
+            "duplicate-sequence packets dropped by the receiver dedupe",
+        ).value
+        tun = PeerTunnel("127.0.0.1", srv.port, None, src="test")
+        try:
+            tun.send(_packet("qrt", 0, [[42]]), 64, 1)
+            tun.send(_packet("qrt", -1, None) | {"nseq": 1}, 32, 0)
+            tun.flush()
+        finally:
+            tun.close()
+        assert tun.retransmits >= 1
+        store = srv.shuffle_worker().store
+        stream = store._stages["qrt"].streams[(0, 0)]
+        assert stream.seqs[0] == [[42]]  # exactly one copy
+        dup1 = REGISTRY.counter("tidbtpu_shuffle_duplicates_dropped").value
+        assert dup1 >= dup0 + 1
+        srv.shutdown()
+
+    def test_dead_peer_raises(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens here
+        tun = PeerTunnel("127.0.0.1", port, None, src="test")
+        with pytest.raises(PeerDeadError):
+            tun.send(_packet("qx", 0, [[1]]), 64, 1)
+            tun.flush()
+        tun.close()
+
+
+# ---------------------------------------------------------------------------
+# fragmenter shuffle cuts
+# ---------------------------------------------------------------------------
+
+
+GROUPED_JOIN = (
+    "select b, count(*), sum(v) from t join u on a = k "
+    "group by b order by b"
+)
+DISTINCT_GROUP = "select b, count(distinct a) from t group by b order by b"
+
+
+class TestShuffleCuts:
+    def test_repartition_join_cut(self, sess):
+        sp = split_plan_shuffle(_plan(sess, GROUPED_JOIN), sess.catalog)
+        assert sp is not None and sp.kind == "join"
+        assert [s.key for s in sp.sides] == ["t.a", "u.k"]
+        assert [s.tag for s in sp.sides] == [0, 1]
+        # each side slices its own scan, disjointly covering the table
+        p0 = sp.sides[0].host_plan(0, 2)
+        assert isinstance(p0, L.Scan) and p0.frag == (0, 2)
+        # the consumer joins two ShuffleRead exchange leaves
+        reads = []
+
+        def walk(p):
+            if isinstance(p, L.ShuffleRead):
+                reads.append(p.tag)
+            for a in ("child", "left", "right"):
+                c = getattr(p, a, None)
+                if c is not None:
+                    walk(c)
+
+        walk(sp.consumer)
+        assert sorted(reads) == [0, 1]
+
+    def test_groupby_cut_lifts_distinct_fallback(self, sess):
+        plan = _plan(sess, DISTINCT_GROUP)
+        assert split_plan(plan, sess.catalog) is None  # the old fallback
+        sp = split_plan_shuffle(plan, sess.catalog)
+        assert sp is not None and sp.kind == "groupby"
+        assert sp.sides[0].key == "t.b"
+
+    def test_no_cut_for_scalar_distinct(self, sess):
+        plan = _plan(sess, "select count(distinct a) from t")
+        assert split_plan_shuffle(plan, sess.catalog) is None
+
+    def test_no_join_cut_for_null_aware_anti(self, sess):
+        plan = _plan(
+            sess, "select a from t where a not in (select k from u)"
+        )
+        sp = split_plan_shuffle(plan, sess.catalog)
+        # NULL-aware anti needs global build-null knowledge: either no
+        # cut at all, or only a group-by-free plan -> None
+        assert sp is None or sp.kind != "join"
+
+    def test_no_join_cut_for_string_keys(self, sess):
+        sess.execute("create table w (b varchar(8), x int)")
+        sess.execute("insert into w values ('x',1),('y',2)")
+        plan = _plan(
+            sess,
+            "select count(*) from t join w on t.b = w.b",
+        )
+        sp = split_plan_shuffle(plan, sess.catalog)
+        assert sp is None or sp.kind != "join"
+
+
+# ---------------------------------------------------------------------------
+# in-process end-to-end stages
+# ---------------------------------------------------------------------------
+
+
+def _servers(sess, n=2):
+    out = []
+    for _ in range(n):
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        out.append(srv)
+    return out
+
+
+PARITY_QUERIES = [
+    GROUPED_JOIN,
+    "select a, v from t join u on a = k order by a, v",
+    DISTINCT_GROUP,
+    "select b, avg(c), count(*) from t group by b order by b",
+    "select b, count(*) from t where a is not null group by b order by b",
+]
+
+
+class TestShuffleScheduler:
+    def test_parity_always_mode(self, sess):
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+        )
+        try:
+            for q in PARITY_QUERIES:
+                exp = sess.must_query(q).rows
+                _cols, got = sched.execute_plan(_plan(sess, q))
+                assert got == exp, f"{q}\n got={got}\n exp={exp}"
+            last = sched.last_query
+            assert last["shuffle"]["m"] == 2
+        finally:
+            sched.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_auto_mode_prefers_staging_for_small_joins(self, sess):
+        """Cost model: with both sides tiny, auto keeps the partial-agg
+        staging cut (tunnels only pay when neither side is small)."""
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="auto",
+        )
+        try:
+            assert sched._plan_shuffle(_plan(sess, GROUPED_JOIN)) is None
+            # but auto LIFTS the single-host fallback for distinct aggs
+            sp = sched._plan_shuffle(_plan(sess, DISTINCT_GROUP))
+            assert sp is not None and sp.kind == "groupby"
+            exp = sess.must_query(DISTINCT_GROUP).rows
+            _cols, got = sched.execute_plan(_plan(sess, DISTINCT_GROUP))
+            assert got == exp
+        finally:
+            sched.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_stage_retry_on_dead_host(self, sess):
+        """A worker dead before the stage: its task dispatch fails, the
+        suspect is verified (ping) and quarantined, and the WHOLE stage
+        re-runs on the survivor — result parity, landed exactly once."""
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+            prober=FailedEngineProber(initial_backoff_s=60),
+        )
+        try:
+            servers[1].shutdown()  # dies before the stage
+            exp = sess.must_query(GROUPED_JOIN).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED_JOIN))
+            assert got == exp
+            assert len(sched.alive_endpoints()) == 1
+            assert sched.last_query["shuffle"]["attempts"] >= 2
+            assert (
+                REGISTRY.counter("tidbtpu_shuffle_stage_retries").value > 0
+            )
+        finally:
+            sched.close()
+            servers[0].shutdown()
+
+    def test_explain_analyze_renders_shuffle_rows(self, sess):
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+        )
+        try:
+            exp = sess.must_query(GROUPED_JOIN).rows
+            _cols, rows, lines = sched.explain_analyze(
+                _plan(sess, GROUPED_JOIN)
+            )
+            assert rows == exp
+            text = "\n".join(lines)
+            assert "DCNShuffle kind=join partitions=2" in text
+            ex = [
+                ln for ln in lines
+                if ln.lstrip().startswith("ShuffleExchange")
+            ]
+            assert len(ex) == 2
+            assert "bytes_tunneled=" in text
+        finally:
+            sched.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_session_explain_analyze_routes_through_scheduler(self, sess):
+        """Satellite: EXPLAIN ANALYZE of a session statement routes
+        through the attached scheduler (ROADMAP PR 2 open item a)."""
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+        )
+        try:
+            sess.attach_dcn_scheduler(sched)
+            r = sess.must_query("explain analyze " + GROUPED_JOIN)
+            text = "\n".join(row[0] for row in r.rows)
+            assert "DCNShuffle" in text and "ShuffleExchange" in text
+            # staging-cut queries render the fragment rows instead
+            r2 = sess.must_query(
+                "explain analyze select count(*), sum(v) from u"
+            )
+            text2 = "\n".join(row[0] for row in r2.rows)
+            assert "DCNFragments" in text2
+            sess.attach_dcn_scheduler(None)
+            r3 = sess.must_query("explain analyze " + GROUPED_JOIN)
+            assert "DCNShuffle" not in "\n".join(row[0] for row in r3.rows)
+        finally:
+            sess.attach_dcn_scheduler(None)
+            sched.close()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# registry shipping (fleet observability satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryShipping:
+    def test_counter_delta_roundtrip(self):
+        src = Registry()
+        src.counter("tidbtpu_engine_jit_compilations", "x").inc(3)
+        src.counter(
+            "tidbtpu_shuffle_bytes_total", "x", labels=("src", "dst")
+        ).labels(src="a", dst="b").inc(100)
+        delta, snap = counter_delta({}, src)
+        assert sorted(d[0] for d in delta) == [
+            "tidbtpu_engine_jit_compilations",
+            "tidbtpu_shuffle_bytes_total",
+        ]
+        dst = Registry()
+        merge_counter_delta(delta, dst)
+        assert dst.counter("tidbtpu_engine_jit_compilations").value == 3
+        fam = dst.counter(
+            "tidbtpu_shuffle_bytes_total", labels=("src", "dst")
+        )
+        assert fam.labels(src="a", dst="b").value == 100
+        # second delta over an unchanged registry ships nothing
+        delta2, _ = counter_delta(snap, src)
+        assert delta2 == []
+
+    def test_merge_rejects_foreign_names(self):
+        dst = Registry()
+        merge_counter_delta([["python_gc_collections", [], [], 5]], dst)
+        assert counter_snapshot(dst) == {}
+
+    def test_merge_is_exactly_once_per_reply(self):
+        """The shipped delta is disjoint per reply: merging each reply
+        once (behind the ledger fence) never double-counts."""
+        src = Registry()
+        c = src.counter("tidbtpu_engine_retraces", "x")
+        snap = {}
+        dst = Registry()
+        for _ in range(3):
+            c.inc(2)
+            delta, snap = counter_delta(snap, src)
+            merge_counter_delta(delta, dst)
+        assert dst.counter("tidbtpu_engine_retraces").value == 6
+
+
+# ---------------------------------------------------------------------------
+# concurrency: two stages through one store
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_stages_do_not_cross():
+    st = ShuffleStore()
+    errs = []
+
+    def one(sid, val):
+        try:
+            st.open(sid, 1, 1)
+            st.push(sid, 1, 1, 0, 0, 0, [(val,)])
+            st.push(sid, 1, 1, 0, 0, -1, None, nseq=1)
+            out = st.wait(sid, 1, 1, 1, timeout_s=5)
+            assert out[0] == [(val,)]
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=one, args=(f"q{i}", i)) for i in range(6)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs

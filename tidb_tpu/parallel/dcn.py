@@ -48,7 +48,13 @@ from typing import Dict, List, Optional, Tuple
 
 from tidb_tpu.dxf.framework import fence_accepts
 from tidb_tpu.planner import logical as L
-from tidb_tpu.planner.fragmenter import FragmentPlan, split_plan
+from tidb_tpu.planner.fragmenter import (
+    FragmentPlan,
+    ShufflePlan,
+    split_plan,
+    split_plan_shuffle,
+)
+from tidb_tpu.planner.ir import IR_VERSION, plan_to_ir
 from tidb_tpu.server.engine_pool import (
     EngineEndpoint,
     FailedEngineProber,
@@ -56,7 +62,7 @@ from tidb_tpu.server.engine_pool import (
 )
 from tidb_tpu.server.engine_rpc import EngineClient, SchemaOutOfDateError
 from tidb_tpu.utils.failpoint import inject
-from tidb_tpu.utils.metrics import REGISTRY
+from tidb_tpu.utils.metrics import REGISTRY, merge_counter_delta
 from tidb_tpu.utils.tracing import Tracer
 
 _STAGED_NONCE = itertools.count(1 << 20)  # disjoint from streamed.py's
@@ -107,6 +113,28 @@ def _c_heartbeat_misses():
 def _h_fragment_seconds():
     return REGISTRY.histogram(
         "tidbtpu_dcn_fragment_seconds", "per-fragment worker execution time"
+    )
+
+
+def _c_shuffle_stages():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_stages", "worker-to-worker shuffle stages run"
+    )
+
+
+def _c_shuffle_stage_retries():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_stage_retries",
+        "shuffle stages re-run on a survivor set after a peer death",
+    )
+
+
+def _c_shuffle_result_bytes():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_result_bytes",
+        "per-partition consumer result bytes returned to the "
+        "coordinator (NOT shuffle data — that moves worker-to-worker "
+        "and counts under tidbtpu_shuffle_bytes_total)",
     )
 
 
@@ -275,9 +303,33 @@ class DCNFragmentScheduler:
         max_attempts: int = 4,
         heartbeat_interval_s: float = 0.0,
         dispatch_timeout_s: float = 600.0,
+        shuffle_mode: str = "auto",
+        shuffle_min_rows: int = 100_000,
+        shuffle_wait_timeout_s: float = 120.0,
+        shuffle_packet_rows: Optional[int] = None,
+        shuffle_inflight_bytes: Optional[int] = None,
     ):
         if not endpoints:
             raise ValueError("DCN scheduler needs at least one worker host")
+        if shuffle_mode not in ("auto", "always", "never"):
+            raise ValueError(f"bad shuffle_mode {shuffle_mode!r}")
+        # worker-to-worker shuffle policy (PERF_NOTES "Shuffle vs
+        # staging"): "auto" uses direct tunnels when coordinator
+        # staging is unavailable (the single-host fallback lift) or
+        # when neither repartition-join side is small; "always"/"never"
+        # force the choice (tests, benchmarks)
+        self.shuffle_mode = shuffle_mode
+        self.shuffle_min_rows = shuffle_min_rows
+        self.shuffle_wait_timeout_s = shuffle_wait_timeout_s
+        self.shuffle_packet_rows = shuffle_packet_rows
+        self.shuffle_inflight_bytes = shuffle_inflight_bytes
+        # stage ids must be unique per COORDINATOR INSTANCE: qids
+        # restart at 1 after a coordinator restart, and long-lived
+        # workers would otherwise serve a previous incarnation's
+        # buffered partitions for a colliding (sid, attempt)
+        import uuid
+
+        self._sid_prefix = uuid.uuid4().hex[:8]
         self.endpoints = [EngineEndpoint(h, p, secret) for h, p in endpoints]
         self.prober = prober or FailedEngineProber()
         self.heartbeat = HostHeartbeat(
@@ -393,33 +445,51 @@ class DCNFragmentScheduler:
 
     # -- query execution ------------------------------------------------
     def execute_plan(self, plan: L.LogicalPlan) -> Tuple[List[str], List[tuple]]:
-        """Run a bound logical plan across the worker hosts. Falls back
-        to whole-plan single-host dispatch when no safe fragment split
-        exists; either path survives worker loss up to max_attempts."""
-        frag = split_plan(plan, self.catalog)
-        if frag is None:
-            return self._execute_single(plan)
-        ledger, _infos = self._run_fragments(frag)
-        return self._final_stage(frag, ledger.rows())
+        """Run a bound logical plan across the worker hosts. Prefers a
+        worker-to-worker shuffle cut when the policy says tunnels beat
+        coordinator staging, then the partial-agg staging cut, then
+        whole-plan single-host dispatch; every path survives worker
+        loss up to max_attempts."""
+        kind, cut = self._choose_cut(plan)
+        if kind == "shuffle":
+            rows, _infos, _stage = self._run_shuffle(cut)
+            return self._final_stage(cut, rows)
+        if kind == "frag":
+            ledger, _infos = self._run_fragments(cut)
+            return self._final_stage(cut, ledger.rows())
+        return self._execute_single(plan)
 
     def explain_analyze(
         self, plan: L.LogicalPlan
     ) -> Tuple[List[str], List[tuple], List[str]]:
-        """Distributed EXPLAIN ANALYZE: run the fragments, then the
-        final stage INSTRUMENTED, and merge the per-host fragment stats
-        (rows/host, execution times, bytes shipped over DCN) into the
-        coordinator's plan-tree rows — the reference's cop-task
-        RuntimeStatsColl merge, over the engine-RPC seam. Returns
-        (columns, rows, plan lines)."""
+        """Distributed EXPLAIN ANALYZE: run the fragments (or the
+        shuffle stage), then the final stage INSTRUMENTED, and merge
+        the per-host fragment stats (rows/host, execution times, bytes
+        shipped over DCN — plus the Shuffle exchange rows: partition
+        bytes over tunnels, stalls, retransmits) into the coordinator's
+        plan-tree rows — the reference's cop-task RuntimeStatsColl
+        merge, over the engine-RPC seam. Returns (columns, rows, plan
+        lines)."""
         from tidb_tpu.chunk import materialize_rows
 
-        frag = split_plan(plan, self.catalog)
-        if frag is None:
+        kind, cut = self._choose_cut(plan)
+        if kind == "shuffle":
+            rows, infos, stage = self._run_shuffle(cut)
+            inject("dcn/final-stage")
+            staged = self._stage_rows(cut, rows)
+            final = cut.final_builder(staged)
+            out, dicts, lines = self._executor.run_analyze(
+                final, shuffle_stats=(stage, infos)
+            )
+            out_rows = materialize_rows(out, list(final.schema), dicts)
+            return [c.name for c in final.schema], out_rows, lines
+        if kind == "single":
             cols, rows = self._execute_single(plan)
             return cols, rows, [
                 "SingleHostDispatch (no safe fragment split) "
                 f"rows={len(rows)}"
             ]
+        frag = cut
         ledger, infos = self._run_fragments(frag)
         inject("dcn/final-stage")
         staged = self._stage_rows(frag, ledger.rows())
@@ -429,6 +499,213 @@ class DCNFragmentScheduler:
         )
         out_rows = materialize_rows(out, list(final.schema), dicts)
         return [c.name for c in final.schema], out_rows, lines
+
+    # -- worker-to-worker shuffle stages --------------------------------
+    def _choose_cut(self, plan: L.LogicalPlan):
+        """One planning pass deciding the execution path: ("shuffle",
+        ShufflePlan) | ("frag", FragmentPlan) | ("single", None).
+
+        The shuffle-vs-staging cost model: staging ships each row
+        group TWICE through one box (worker->coordinator, then a
+        device round trip) but partial aggregation usually shrinks the
+        exchange to near-nothing first; tunnels ship pre-join rows
+        ONCE, peer to peer, which wins when neither join side is small
+        or when no partial-agg cut exists at all (DISTINCT/high-
+        cardinality GROUP BY — previously a single-host fallback)."""
+        sp = None
+        if self.shuffle_mode != "never":
+            sp = split_plan_shuffle(plan, self.catalog)
+        if sp is not None:
+            if self.shuffle_mode == "always":
+                return "shuffle", sp
+            if sp.kind == "join" and min(
+                s.est_rows for s in sp.sides
+            ) >= self.shuffle_min_rows:
+                # neither side small: repartition over tunnels —
+                # decided without paying the staging planner's pass
+                return "shuffle", sp
+        frag = split_plan(plan, self.catalog)
+        if frag is not None:
+            return "frag", frag
+        if sp is not None:
+            return "shuffle", sp  # lifts the single-host fallback
+        return "single", None
+
+    def _plan_shuffle(self, plan: L.LogicalPlan) -> Optional[ShufflePlan]:
+        """The ShufflePlan the policy would run, or None (introspection
+        helper; the execution paths use _choose_cut directly)."""
+        kind, cut = self._choose_cut(plan)
+        return cut if kind == "shuffle" else None
+
+    def _run_shuffle(
+        self, sp: ShufflePlan
+    ) -> Tuple[List[tuple], List[dict], dict]:
+        """Run one shuffle stage to completion: dispatch a produce+
+        consume task per alive host, each host pushing hash partitions
+        directly to its peers; on a peer death (transport loss to the
+        coordinator, a reported dead tunnel, or a wait timeout) verify
+        the suspects, quarantine them, and re-run the WHOLE stage on
+        the survivor set at the next attempt — receivers fence stale-
+        attempt packets, the per-attempt ledger fences results, so a
+        retried stage lands exactly once."""
+        qid = next(_QUERY_ID)
+        sid = f"{self._sid_prefix}-q{qid}"
+        stage = {
+            "sid": sid, "qid": qid, "kind": sp.kind, "attempts": 0,
+            "m": 0, "bytes_tunneled": 0, "rows_tunneled": 0,
+            "local_rows": 0, "stalls": 0, "retransmits": 0,
+        }
+        last_err: Optional[str] = None
+        for rnd in range(self.max_attempts):
+            if not self.alive_endpoints():
+                self.prober.probe_once()
+            hosts = self.alive_endpoints()
+            if not hosts:
+                break
+            m = len(hosts)
+            attempt = rnd + 1
+            stage["attempts"] = attempt
+            stage["m"] = m
+            inject("shuffle/stage")
+            _c_shuffle_stages().inc()
+            if rnd:
+                inject("shuffle/stage-retry")
+                _c_shuffle_stage_retries().inc()
+            peers = [[ep.host, ep.port] for ep in hosts]
+            ledger = FragmentLedger(m)
+            infos: List[dict] = []
+            suspects: List[str] = []
+            errs: List[str] = []
+            fatal: List[Exception] = []
+
+            def run_part(i: int, ep: EngineEndpoint):
+                token = ledger.claim(i, ep.address)
+                task = {
+                    "sid": sid, "qid": qid, "attempt": attempt, "m": m,
+                    "part": i, "peers": peers, "secret": ep.secret,
+                    "sides": [
+                        {
+                            "tag": s.tag, "key": s.key,
+                            "plan": plan_to_ir(s.host_plan(i, m)),
+                        }
+                        for s in sp.sides
+                    ],
+                    "consumer": plan_to_ir(sp.consumer),
+                    "wait_timeout_s": self.shuffle_wait_timeout_s,
+                    "packet_rows": self.shuffle_packet_rows,
+                    "max_inflight_bytes": self.shuffle_inflight_bytes,
+                    "trace": bool(self.tracer.enabled),
+                }
+                try:
+                    with self._ep_lock(ep):
+                        conn = self._conn(ep)
+                        resp = conn.call(
+                            {"v": IR_VERSION, "shuffle_task": task}
+                        )
+                except (SchemaOutOfDateError, RuntimeError, ValueError,
+                        PermissionError):
+                    # deterministic client-side failures (oversized
+                    # frame, bad auth, stale schema) reproduce on every
+                    # host: fatal, same contract as _dispatch
+                    raise
+                except Exception as e:
+                    ledger.release(i, token)
+                    with self._lock:
+                        suspects.append(ep.address)
+                        errs.append(f"{ep.address}: {e}")
+                    return
+                if not resp.get("ok"):
+                    if resp.get("retryable"):
+                        ledger.release(i, token)
+                        with self._lock:
+                            suspects.extend(resp.get("suspects") or [])
+                            errs.append(str(resp.get("error", "")))
+                        return
+                    raise RuntimeError(
+                        f"engine error: {resp.get('error', '')}"
+                    )
+                rows = [tuple(r) for r in resp["rows"]]
+                if ledger.complete(i, token, rows):
+                    self._note_partition(infos, i, ep, attempt, resp)
+
+            def runner(i, ep):
+                try:
+                    run_part(i, ep)
+                except Exception as e:
+                    fatal.append(e)
+
+            threads = [
+                threading.Thread(
+                    target=runner, args=(i, ep), daemon=True,
+                    name=f"shuffle-q{qid}-p{i}",
+                )
+                for i, ep in enumerate(hosts)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if fatal:
+                raise fatal[0]
+            if ledger.all_done():
+                infos.sort(key=lambda f: f["fid"])
+                for f in infos:
+                    stage["bytes_tunneled"] += f["pushed_bytes"]
+                    stage["rows_tunneled"] += f["pushed_rows"]
+                    stage["local_rows"] += f["local_rows"]
+                    stage["stalls"] += f["stalls"]
+                    stage["retransmits"] += f["retransmits"]
+                with self._lock:
+                    self.last_query = {
+                        "qid": qid, "fragments": infos,
+                        "shuffle": dict(stage),
+                    }
+                _update_host_gauges(self.endpoints)
+                return ledger.rows(), infos, stage
+            if errs:
+                last_err = errs[0]
+            # verify the suspects before the next attempt: a reported
+            # dead tunnel or missing producer is quarantined only when
+            # it really stopped answering (a transient loss retries on
+            # the same set)
+            by_addr = {ep.address: ep for ep in self.endpoints}
+            for addr in sorted(set(suspects)):
+                ep = by_addr.get(addr)
+                if ep is not None and ep.alive and not ping_endpoint(ep):
+                    self._quarantine(ep)
+        raise ConnectionError(
+            f"shuffle stage {sid} undispatchable after "
+            f"{self.max_attempts} attempts ({len(self.endpoints)} hosts, "
+            f"{len(self.alive_endpoints())} alive); last error: {last_err}"
+        )
+
+    def _note_partition(self, infos, part, ep, attempt, resp) -> None:
+        """Record one FENCED per-partition shuffle result: counters,
+        telemetry, shipped worker registry deltas, and the host-labeled
+        span merge."""
+        stats = resp.get("stats") or {}
+        sh = resp.get("shuffle") or {}
+        spans = resp.get("spans") or []
+        host = stats.get("host") or ep.address
+        exec_s = float(stats.get("exec_s", 0.0))
+        nbytes = int(resp.get("_nbytes", 0))
+        _c_shuffle_result_bytes().inc(nbytes)
+        _h_fragment_seconds().observe(exec_s)
+        merge_counter_delta(resp.get("registry"))
+        info = {
+            "fid": part, "host": host, "attempt": attempt,
+            "rows": int(stats.get("rows", 0)), "exec_s": exec_s,
+            "bytes": nbytes,
+            "pushed_bytes": int(sh.get("pushed_bytes", 0)),
+            "pushed_rows": int(sh.get("pushed_rows", 0)),
+            "local_rows": int(sh.get("local_rows", 0)),
+            "stalls": int(sh.get("stalls", 0)),
+            "retransmits": int(sh.get("retransmits", 0)),
+            "spans": spans,
+        }
+        with self._lock:
+            infos.append(info)
+        self._merge_remote_spans(spans, host)
 
     def _run_fragments(
         self, frag: FragmentPlan
@@ -540,6 +817,7 @@ class DCNFragmentScheduler:
         nbytes = int(resp.get("_nbytes", 0))
         _c_bytes_staged().inc(nbytes)
         _h_fragment_seconds().observe(exec_s)
+        merge_counter_delta(resp.get("registry"))
         info = {
             "fid": fid, "host": host, "attempt": meta["attempt"],
             "rows": int(stats.get("rows", 0)), "exec_s": exec_s,
@@ -547,16 +825,20 @@ class DCNFragmentScheduler:
         }
         with self._lock:
             infos.append(info)
-        if self.tracer.enabled:
-            # rebase worker-clock span offsets onto the coordinator
-            # timeline: the reply landed NOW, so the fragment's spans
-            # end here and extend backwards by their own extent
-            base_s = 0.0
-            if self.tracer._t0 is not None and spans:
-                now_rel = time.perf_counter() - self.tracer._t0
-                extent = max(float(s[1]) + float(s[2]) for s in spans)
-                base_s = max(now_rel - extent, 0.0)
-            self.tracer.add_remote(spans, label=host, base_s=base_s)
+        self._merge_remote_spans(spans, host)
+
+    def _merge_remote_spans(self, spans, host: str) -> None:
+        """Rebase worker-clock span offsets onto the coordinator
+        timeline: the reply landed NOW, so the fragment's spans end
+        here and extend backwards by their own extent."""
+        if not self.tracer.enabled:
+            return
+        base_s = 0.0
+        if self.tracer._t0 is not None and spans:
+            now_rel = time.perf_counter() - self.tracer._t0
+            extent = max(float(s[1]) + float(s[2]) for s in spans)
+            base_s = max(now_rel - extent, 0.0)
+        self.tracer.add_remote(spans, label=host, base_s=base_s)
 
     def _execute_single(self, plan) -> Tuple[List[str], List[tuple]]:
         """Whole-plan dispatch onto one host (shapes with no safe
@@ -588,36 +870,23 @@ class DCNFragmentScheduler:
         )
 
     # -- final stage ----------------------------------------------------
-    def _stage_rows(self, frag: FragmentPlan, rows: List[tuple]) -> L.Staged:
-        """Stage the gathered partial rows as a device batch under the
-        fragment plan's partial schema (the coordinator side of the DCN
-        exchange)."""
-        from tidb_tpu.chunk import (
-            HostBlock,
-            block_to_batch,
-            column_from_values,
-            pad_capacity,
+    def _stage_rows(self, cut, rows: List[tuple]) -> L.Staged:
+        """Stage the gathered partial/partition rows as a device batch
+        under the cut's wire schema (the coordinator side of the DCN
+        exchange). `cut` is a FragmentPlan or a ShufflePlan — both
+        carry partial_schema."""
+        from tidb_tpu.parallel.shuffle import stage_rows_as_batch
+
+        return stage_rows_as_batch(
+            cut.partial_schema, rows, next(_STAGED_NONCE)
         )
 
-        cols = {}
-        dicts = {}
-        for i, oc in enumerate(frag.partial_schema.cols):
-            hc = column_from_values([r[i] for r in rows], oc.type)
-            cols[oc.internal] = hc
-            if hc.dictionary is not None:
-                dicts[oc.internal] = hc.dictionary
-        block = HostBlock(cols, len(rows))
-        batch = block_to_batch(block, pad_capacity(max(len(rows), 1)))
-        return L.Staged(
-            frag.partial_schema, batch=batch, dicts=dicts,
-            nonce=next(_STAGED_NONCE),
-        )
-
-    def _final_stage(self, frag: FragmentPlan, rows: List[tuple]):
+    def _final_stage(self, frag, rows: List[tuple]):
         """Coordinator-side merge: stage the gathered partial rows as a
         device batch and run the final plan (final aggregate + HAVING/
         projections/ORDER BY/LIMIT) through the ordinary engine — the
-        root MPP fragment executing at the coordinator."""
+        root MPP fragment executing at the coordinator. `frag` is a
+        FragmentPlan or a ShufflePlan (both carry final_builder)."""
         inject("dcn/final-stage")
         from tidb_tpu.chunk import materialize_rows
 
@@ -635,13 +904,16 @@ class DCNFragmentScheduler:
         with self._lock:
             last = self.last_query
         if last is not None:
-            last = {
+            summary = {
                 "qid": last["qid"],
                 "fragments": [
                     {k: v for k, v in f.items() if k != "spans"}
                     for f in last["fragments"]
                 ],
             }
+            if "shuffle" in last:
+                summary["shuffle"] = last["shuffle"]
+            last = summary
         quarantined = [
             ep.address for ep in self.prober.failed_endpoints()
         ]

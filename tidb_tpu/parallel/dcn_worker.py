@@ -67,9 +67,14 @@ def main(argv=None) -> int:
     ap.add_argument("--tables", default="orders,lineitem")
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--die-on-fragment", type=int, default=0,
-                    help="hard-exit on the K-th dispatched fragment")
-    ap.add_argument("--die-at", choices=["execute", "result-send"],
-                    default="execute")
+                    help="hard-exit on the K-th hit of the --die-at site")
+    ap.add_argument("--die-at",
+                    choices=["execute", "result-send", "shuffle-push",
+                             "shuffle-recv"],
+                    default="execute",
+                    help="where to die: fragment execute / reply send, "
+                    "or mid-shuffle while pushing a partition packet "
+                    "(shuffle-push) / receiving one (shuffle-recv)")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -89,10 +94,12 @@ def main(argv=None) -> int:
         )
 
     if args.die_on_fragment > 0:
-        site = (
-            "dcn/fragment-execute" if args.die_at == "execute"
-            else "dcn/result-send"
-        )
+        site = {
+            "execute": "dcn/fragment-execute",
+            "result-send": "dcn/result-send",
+            "shuffle-push": "shuffle/push",
+            "shuffle-recv": "shuffle/recv",
+        }[args.die_at]
         failpoint.enable(
             site,
             failpoint.after_n(
@@ -103,6 +110,11 @@ def main(argv=None) -> int:
     srv = EngineServer(
         cat, host=args.host, port=args.port, secret=args.secret,
         mesh_devices=args.mesh_devices or None,
+        # worker PROCESS: piggyback this registry's counter deltas on
+        # fragment/shuffle replies so the coordinator /metrics reflects
+        # fleet-wide engine activity (never set in-process — see
+        # EngineServer.ship_registry)
+        ship_registry=True,
     )
     print(f"DCN_WORKER_READY port={srv.port}", flush=True)
     try:

@@ -224,6 +224,30 @@ def _final_agg_plan(agg: L.Aggregate, source: L.LogicalPlan,
 # -- the cut ----------------------------------------------------------------
 
 
+def _peel_global_roots(plan: L.LogicalPlan):
+    """Peel order-sensitive root operators (Limit/Sort, plus any
+    row-wise nodes stacked above them) off the top of the plan: they
+    re-run on the coordinator over the unioned per-host rows. Returns
+    (peeled nodes root-first, remaining subtree). Shared by the
+    staging and shuffle planners so their notion of a cuttable root
+    never diverges."""
+
+    def _chain_has_global(p) -> bool:
+        while isinstance(p, (L.Projection, L.Selection)):
+            p = p.child
+        return isinstance(p, (L.Limit, L.Sort))
+
+    peeled: List[L.LogicalPlan] = []
+    lower = plan
+    while isinstance(lower, (L.Limit, L.Sort)) or (
+        isinstance(lower, (L.Projection, L.Selection))
+        and _chain_has_global(lower.child)
+    ):
+        peeled.append(lower)
+        lower = lower.child
+    return peeled, lower
+
+
 def _find_cut(plan: L.LogicalPlan):
     """Topmost Aggregate reachable from the root through single-child
     nodes, or None. The path nodes re-run unchanged on the coordinator."""
@@ -277,20 +301,7 @@ def split_plan(plan: L.LogicalPlan, catalog=None) -> Optional[FragmentPlan]:
     # no aggregate: peel order-sensitive root operators (and any
     # row-wise nodes stacked above them) to the coordinator, union the
     # per-host row fragments beneath them
-
-    def _chain_has_global(p) -> bool:
-        while isinstance(p, (L.Projection, L.Selection)):
-            p = p.child
-        return isinstance(p, (L.Limit, L.Sort))
-
-    peeled: List[L.LogicalPlan] = []
-    lower = plan
-    while isinstance(lower, (L.Limit, L.Sort)) or (
-        isinstance(lower, (L.Projection, L.Selection))
-        and _chain_has_global(lower.child)
-    ):
-        peeled.append(lower)
-        lower = lower.child
+    peeled, lower = _peel_global_roots(plan)
     frag_scan = _pick_frag_scan(lower, catalog)
     if frag_scan is None:
         return None
@@ -302,3 +313,280 @@ def split_plan(plan: L.LogicalPlan, catalog=None) -> Optional[FragmentPlan]:
         return out
 
     return FragmentPlan(lower, frag_scan, lower.schema, final_builder)
+
+
+# -- shuffle cuts (worker-to-worker exchange; parallel/shuffle.py) ----------
+
+
+@dataclasses.dataclass
+class ShuffleSide:
+    """One producer side of a shuffle exchange: a plan every worker
+    executes over its own fragment slice, whose output rows are hash-
+    partitioned on `key` and pushed to the owning peers."""
+
+    #: producer plan template; per worker the frag_scan gets its slice
+    template: L.LogicalPlan
+    #: the Scan inside `template` carrying the (idx, n) fragment slice
+    frag_scan: L.Scan
+    #: internal column name of the partition key in template.schema
+    key: str
+    #: which ShuffleRead leaf of the consumer this side feeds
+    tag: int
+    #: catalog row estimate of the sliced table (the cost-model input:
+    #: tunnels only beat coordinator staging when the shuffled side is
+    #: large — PERF_NOTES "Shuffle vs staging")
+    est_rows: int = 0
+
+    def host_plan(self, idx: int, n_hosts: int) -> L.LogicalPlan:
+        sliced = dataclasses.replace(self.frag_scan, frag=(idx, n_hosts))
+        return _replace_node(self.template, self.frag_scan, sliced)
+
+
+@dataclasses.dataclass
+class ShufflePlan:
+    """One query cut at a worker-to-worker exchange: producer sides,
+    the per-partition consumer plan (its ShuffleRead leaves stand for
+    the received partitions), and the coordinator stage over the
+    gathered per-partition results."""
+
+    #: "join" (repartition join: both sides shuffled by the join key,
+    #: executor/join.py runs per partition on the receiving host) or
+    #: "groupby" (rows shuffled by group key; each partition owns
+    #: COMPLETE groups, so the ORIGINAL aggregate — distinct included —
+    #: runs per partition and its output is final, lifting the
+    #: single-host fallback for high-cardinality/distinct aggregates)
+    kind: str
+    sides: List[ShuffleSide]
+    #: per-partition worker plan with ShuffleRead(tag) exchange leaves
+    consumer: L.LogicalPlan
+    #: wire schema of the rows each partition's consumer returns
+    partial_schema: Schema
+    #: staged-source plan node -> full coordinator plan
+    final_builder: Callable[[L.LogicalPlan], L.LogicalPlan]
+
+
+#: join kinds whose semantics survive hash partitioning on the first
+#: equi key: equal keys colocate, so inner/left matches and semi/anti
+#: existence checks are complete per partition. Null-aware anti joins
+#: need GLOBAL build-side-null knowledge (NULL build keys colocate on
+#: partition 0 only) and mark joins need it three-valued — excluded.
+_SHUFFLE_JOIN_KINDS = ("inner", "left", "semi", "anti")
+
+
+def _find_shuffle_join(p: L.LogicalPlan):
+    """Descend single-child row-wise nodes to the topmost JoinPlan;
+    returns (path nodes root->join, join) — path re-runs unchanged on
+    the consumer above the exchange. None join = no cut here."""
+    path: List[L.LogicalPlan] = []
+    while isinstance(p, (L.Selection, L.Projection)):
+        path.append(p)
+        p = p.child
+    return path, (p if isinstance(p, L.JoinPlan) else None)
+
+
+def _shuffle_key_of(expr, schema: Schema) -> Optional[str]:
+    """The internal column a side can be hash-partitioned on, or None.
+    Must be a bare column of the side's OUTPUT schema (the producer
+    hashes materialized rows) and not STRING-typed for join keys —
+    handled by the caller (string dictionary codes are per-batch, so
+    only the value itself, not the code, is stable across sides)."""
+    if not isinstance(expr, ColumnRef):
+        return None
+    names = {c.internal for c in schema.cols}
+    return expr.name if expr.name in names else None
+
+
+def _est_rows(scan: L.Scan, catalog) -> int:
+    try:
+        return int(catalog.table(scan.db, scan.table).nrows)
+    except Exception:
+        return 0
+
+
+def _wrap_path(path, inner: L.LogicalPlan) -> L.LogicalPlan:
+    out = inner
+    for node in reversed(path):
+        out = dataclasses.replace(node, child=out)
+    return out
+
+
+def split_plan_shuffle(
+    plan: L.LogicalPlan, catalog=None
+) -> Optional[ShufflePlan]:
+    """Cut a bound plan at a worker-to-worker shuffle exchange.
+
+    Two shapes (repartition-join preferred — it ships pre-join rows
+    once; the group-by cut re-scans unsliced join sides per host):
+
+    1. repartition join — the topmost join under the aggregate cut (or
+       under the peeled root operators) with a partitionable first equi
+       key: BOTH sides fragment-slice their dominant scan, shuffle by
+       the join key, and the join (plus the partial aggregate, when the
+       topmost aggregate decomposes) runs per partition on the
+       receiving worker;
+    2. fragment-sliced GROUP BY — rows shuffled by the first group key,
+       so every partition owns complete groups and the ORIGINAL
+       aggregate (DISTINCT and other non-decomposable functions
+       included) executes per partition with FINAL output.
+
+    Returns None when neither applies (the caller falls back to the
+    partial-agg staging cut or single-host dispatch). Raises
+    Unschedulable for plans that cannot cross the engine seam."""
+    agg = _find_cut(plan)
+    if agg is not None and agg.gc_meta:
+        raise Unschedulable(
+            "GROUP_CONCAT plans execute host-assisted; they do not "
+            "cross the engine boundary"
+        )
+
+    # ---- shape 1: repartition join ----
+    if agg is not None:
+        below = agg.child
+        dec = _decompose_aggs(agg)
+    else:
+        # no aggregate: peel order-sensitive root operators (and
+        # row-wise nodes stacked above them) to the coordinator
+        peeled, below = _peel_global_roots(plan)
+        dec = None
+
+    path, jp = _find_shuffle_join(below)
+    if (
+        jp is not None
+        and jp.kind in _SHUFFLE_JOIN_KINDS
+        and not jp.null_aware
+        and jp.equi_keys
+        and (agg is None or dec is not None)
+    ):
+        le, re_ = jp.equi_keys[0]
+        lkey = _shuffle_key_of(le, jp.left.schema)
+        rkey = _shuffle_key_of(re_, jp.right.schema)
+        string_key = any(
+            k is not None and k.type is not None
+            and k.type.kind == Kind.STRING
+            for k in (le, re_)
+            if isinstance(k, ColumnRef)
+        )
+        lscan = _pick_frag_scan(jp.left, catalog)
+        rscan = _pick_frag_scan(jp.right, catalog)
+        if (
+            lkey is not None and rkey is not None and not string_key
+            and lscan is not None and rscan is not None
+        ):
+            sides = [
+                ShuffleSide(jp.left, lscan, lkey, 0,
+                            _est_rows(lscan, catalog)),
+                ShuffleSide(jp.right, rscan, rkey, 1,
+                            _est_rows(rscan, catalog)),
+            ]
+            jp2 = dataclasses.replace(
+                jp,
+                left=L.ShuffleRead(jp.left.schema, tag=0),
+                right=L.ShuffleRead(jp.right.schema, tag=1),
+            )
+            mid = _wrap_path(path, jp2)
+            if agg is not None:
+                partial_aggs, pcols, final, avg_fix = dec
+                group_cols = [
+                    OutCol(None, n, n, e.type) for n, e in agg.group_exprs
+                ]
+                partial_schema = Schema(group_cols + pcols)
+                consumer = L.Aggregate(
+                    partial_schema, mid, list(agg.group_exprs),
+                    partial_aggs,
+                )
+
+                def final_builder(source, _plan=plan, _agg=agg,
+                                  _final=final, _fix=avg_fix):
+                    merged = _final_agg_plan(_agg, source, _final, _fix)
+                    return _replace_node(_plan, _agg, merged)
+
+                return ShufflePlan(
+                    "join", sides, consumer, partial_schema, final_builder
+                )
+
+            def final_builder(source, _peeled=tuple(peeled)):
+                out = source
+                for node in reversed(_peeled):
+                    out = dataclasses.replace(node, child=out)
+                return out
+
+            consumer = _wrap_path(path, jp2)
+            return ShufflePlan(
+                "join", sides, consumer, below.schema, final_builder
+            )
+
+    # ---- shape 2: fragment-sliced GROUP BY ----
+    if agg is None or not agg.group_exprs:
+        return None
+    cut = _group_stack_cut(agg)
+    if cut is None:
+        return None
+    cut_child, gkey = cut
+    frag_scan = _pick_frag_scan(cut_child, catalog)
+    if frag_scan is None:
+        return None
+    side = ShuffleSide(
+        cut_child, frag_scan, gkey, 0, _est_rows(frag_scan, catalog)
+    )
+    consumer = _replace_node(
+        agg, cut_child, L.ShuffleRead(cut_child.schema, tag=0)
+    )
+
+    def final_builder(source, _plan=plan, _agg=agg):
+        return _replace_node(_plan, _agg, source)
+
+    return ShufflePlan(
+        "groupby", [side], consumer, agg.schema, final_builder
+    )
+
+
+def _group_stack_cut(agg: L.Aggregate):
+    """Bottom of the aggregate stack under `agg` plus the raw-row
+    column the stack's first group key resolves to: (cut child, key
+    internal name) or None.
+
+    DISTINCT aggregates expand into STACKED Aggregates (logical.py
+    _expand_distinct_aggs: inner groups by keys + distinct arg), so
+    the shuffle cut must sit below the WHOLE stack — rows hash-
+    partitioned on the outermost group key make every level's groups
+    complete per partition (deeper stacks group by supersets of the
+    outer keys), and the original aggregate tree then executes per
+    partition with FINAL output. The key must pass through the stack
+    as a bare column (Projections may rename it; anything computed
+    defeats row-level hashing)."""
+    first = agg.group_exprs[0][1]
+    if not isinstance(first, ColumnRef):
+        return None
+    kname = first.name  # in agg.child scope
+    node = agg
+    while True:
+        path = []
+        p = node.child
+        while isinstance(p, (L.Selection, L.Projection)):
+            path.append(p)
+            p = p.child
+        if not isinstance(p, L.Aggregate) or p.gc_meta:
+            break
+        # thread the key column down through the renames
+        k = kname
+        ok = True
+        for q in path:
+            if isinstance(q, L.Projection):
+                e = dict(q.exprs).get(k)
+                if e is None and q.additive:
+                    continue
+                if not isinstance(e, ColumnRef):
+                    ok = False
+                    break
+                k = e.name
+        if not ok:
+            break
+        e = {n: ge for n, ge in p.group_exprs}.get(k)
+        if not isinstance(e, ColumnRef):
+            break
+        kname = e.name
+        node = p
+    cut_child = node.child
+    if kname not in {c.internal for c in cut_child.schema.cols}:
+        return None
+    return cut_child, kname

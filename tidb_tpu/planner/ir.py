@@ -29,8 +29,11 @@ from tidb_tpu.planner import logical as L
 # v2: Scan gained the semantically-mandatory `frag` fragment slice —
 # an engine that ignored it would scan the full table and the merged
 # final aggregate would count every row n times, so the version check
-# must fence pre-frag engines instead of letting them answer wrongly
-IR_VERSION = 2
+# must fence pre-frag engines instead of letting them answer wrongly.
+# v3: ShuffleRead — the worker-to-worker shuffle exchange leaf
+# (parallel/shuffle.py); a pre-shuffle engine cannot resolve it, so the
+# version fence keeps mixed fleets from half-executing a shuffle plan
+IR_VERSION = 3
 
 
 # -- types ------------------------------------------------------------------
@@ -173,6 +176,8 @@ def plan_to_ir(p: L.LogicalPlan) -> Dict:
             "n": "union_all", "schema": sch,
             "children": [plan_to_ir(c) for c in p.children],
         }
+    if isinstance(p, L.ShuffleRead):
+        return {"n": "shuffle_read", "schema": sch, "tag": int(p.tag)}
     raise ValueError(f"unserializable plan node {type(p).__name__}")
 
 
@@ -233,6 +238,8 @@ def plan_from_ir(d: Dict) -> L.LogicalPlan:
         )
     if n == "union_all":
         return L.UnionAll(sch, [plan_from_ir(c) for c in d["children"]])
+    if n == "shuffle_read":
+        return L.ShuffleRead(sch, tag=int(d.get("tag", 0)))
     raise ValueError(f"bad plan tag {n!r}")
 
 

@@ -217,6 +217,18 @@ class Staged(LogicalPlan):
 
 
 @dataclasses.dataclass
+class ShuffleRead(LogicalPlan):
+    """Leaf standing for the receiving worker's shuffle partition of
+    exchange side `tag` — the ExchangeReceiver of the cross-host
+    shuffle service (parallel/shuffle.py). Serializable (unlike Staged:
+    the node carries no data, only the wire schema); the worker
+    substitutes a Staged batch built from its received partition before
+    execution, so the physical compiler never sees it."""
+
+    tag: int = 0
+
+
+@dataclasses.dataclass
 class UnionAll(LogicalPlan):
     """Bag union by position; children are projections onto _u{i} names
     with casts to the common types (reference UnionExec,

@@ -2475,7 +2475,7 @@ class PhysicalExecutor:
         raise ExecError("capacity discovery did not converge")
 
     def run_analyze(
-        self, plan: L.LogicalPlan, frag_stats=None
+        self, plan: L.LogicalPlan, frag_stats=None, shuffle_stats=None
     ) -> Tuple[Batch, Dicts, List[str]]:
         """EXPLAIN ANALYZE: instrumented single run with per-node stats.
 
@@ -2483,7 +2483,9 @@ class PhysicalExecutor:
         fragment runtime stats gathered from the worker replies, merged
         into the plan-tree rows beneath the Staged exchange node the way
         the reference merges cop-task RuntimeStatsColl into the
-        coordinator's plan tree."""
+        coordinator's plan tree. `shuffle_stats` is the worker-to-worker
+        shuffle case: a (stage summary, per-partition infos) pair whose
+        Shuffle exchange rows render the same way."""
         from tidb_tpu.planner.hostagg import _find_gc_agg, try_host_agg
 
         if _find_gc_agg(plan) is not None:
@@ -2516,6 +2518,8 @@ class PhysicalExecutor:
             lines.append("  " * depth + label + suffix)
         if frag_stats:
             lines = _merge_frag_stats(lines, frag_stats)
+        if shuffle_stats:
+            lines = _merge_shuffle_stats(lines, *shuffle_stats)
         return out, cq.out_dicts, lines
 
 
@@ -2546,6 +2550,16 @@ def _merge_frag_stats(lines: List[str], frag_stats) -> List[str]:
         )
         for f in frags
     ]
+    return _insert_below_staged(lines, summary, per_frag)
+
+
+def _insert_below_staged(
+    lines: List[str], summary: str, rows: List[str]
+) -> List[str]:
+    """Splice an exchange block (one summary line + indented per-unit
+    rows) beneath the plan tree's Staged node — the coordinator side
+    of any DCN exchange. Shared by the fragment and shuffle renderers
+    so the anchor/indent rules never diverge."""
     idx = next(
         (i for i, ln in enumerate(lines) if ln.lstrip().startswith("Staged")),
         None,
@@ -2556,8 +2570,42 @@ def _merge_frag_stats(lines: List[str], frag_stats) -> List[str]:
     else:
         pad = " " * (len(lines[idx]) - len(lines[idx].lstrip()) + 2)
         insert_at = idx + 1
-    block = [pad + summary] + [pad + "  " + pf for pf in per_frag]
+    block = [pad + summary] + [pad + "  " + r for r in rows]
     return lines[:insert_at] + block + lines[insert_at:]
+
+
+def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
+    """Insert the worker-to-worker shuffle exchange rows into an
+    EXPLAIN ANALYZE plan tree beneath the Staged node: one DCNShuffle
+    summary (partition count, attempts, tunnel bytes/rows, stalls,
+    retransmits) plus one ShuffleExchange row per partition — the MPP
+    ExchangeSender/ExchangeReceiver rows of the reference's plan tree,
+    rendered coordinator-side from the fenced task replies."""
+    frags = sorted(infos, key=lambda f: f.get("fid", 0))
+    hosts = sorted({f.get("host", "?") for f in frags})
+    total_rows = sum(int(f.get("rows", 0)) for f in frags)
+    summary = (
+        f"DCNShuffle kind={stage.get('kind')} "
+        f"partitions={stage.get('m')} hosts={len(hosts)} "
+        f"attempts={stage.get('attempts')} rows={total_rows} "
+        f"bytes_tunneled={stage.get('bytes_tunneled')} "
+        f"rows_tunneled={stage.get('rows_tunneled')} "
+        f"local_rows={stage.get('local_rows')} "
+        f"stalls={stage.get('stalls')} "
+        f"retransmits={stage.get('retransmits')}"
+    )
+    per_part = [
+        (
+            f"ShuffleExchange part={f.get('fid')} "
+            f"host={f.get('host', '?')} attempt={f.get('attempt', 1)} "
+            f"rows={f.get('rows', 0)} "
+            f"time={float(f.get('exec_s', 0.0))*1000:.2f}ms "
+            f"pushed={f.get('pushed_bytes', 0)}B "
+            f"stalls={f.get('stalls', 0)}"
+        )
+        for f in frags
+    ]
+    return _insert_below_staged(lines, summary, per_part)
 
 
 # pseudo node id for the final output's compaction capacity

@@ -90,6 +90,7 @@ class EngineServer:
         port: int = 0,
         secret: Optional[str] = None,
         mesh_devices: Optional[int] = None,
+        ship_registry: bool = False,
     ):
         self.catalog = catalog
         self.secret = secret
@@ -97,6 +98,21 @@ class EngineServer:
         # device mesh (intra-host ICI exchanges) — the worker-host shape
         # of the hierarchical DCN scheduler (parallel/dcn.py)
         self.mesh_devices = mesh_devices
+        # ship_registry: piggyback this process's counter deltas on
+        # fragment/shuffle replies so the coordinator's registry sees
+        # fleet-wide engine activity. Worker PROCESSES enable this
+        # (parallel/dcn_worker.py); in-process servers must not — they
+        # share the coordinator's registry, and shipping would feed the
+        # merged increments back into the next delta.
+        self.ship_registry = ship_registry
+        self._reg_lock = threading.Lock()
+        self._reg_snapshot: dict = {}
+        # worker-to-worker shuffle service: the store this server's
+        # shuffle_push frames land in plus the task runner
+        # (parallel/shuffle.py); built lazily so plain engine servers
+        # pay nothing
+        self._shuffle = None
+        self._shuffle_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -135,7 +151,13 @@ class EngineServer:
                                 )
                                 return
                             authed = True
-                        if "plan" not in req:
+                        if "shuffle_push" in req:
+                            # peer tunnel frame: a worker pushing one
+                            # hash partition packet of its fragment
+                            resp = outer._shuffle_push(req)
+                        elif "shuffle_task" in req:
+                            resp = outer._shuffle_task(req)
+                        elif "plan" not in req:
                             # handshake/ping frame — fine whether or not
                             # this server requires a secret (a secreted
                             # client must interoperate with an open server)
@@ -263,7 +285,107 @@ class EngineServer:
                 "exec_s": exec_s,
                 "host": f"{socket.gethostname()}:{self.port}",
             }
+            if self.ship_registry:
+                # fleet observability: this process's counter movement
+                # rides the reply; the coordinator merges it behind the
+                # ledger fence (at-most-once: a lost/fenced reply drops
+                # its delta — see utils/metrics.py fleet-merge notes)
+                resp["registry"] = self._registry_delta()
         return json.dumps(resp).encode()
+
+    # -- worker-to-worker shuffle (parallel/shuffle.py) -----------------
+    def shuffle_worker(self):
+        with self._shuffle_lock:
+            if self._shuffle is None:
+                from tidb_tpu.parallel.shuffle import ShuffleWorker
+
+                self._shuffle = ShuffleWorker(
+                    self.catalog,
+                    self_address=f"{socket.gethostname()}:{self.port}",
+                    mesh_devices=self.mesh_devices,
+                )
+            return self._shuffle
+
+    def _shuffle_push(self, req) -> bytes:
+        """A peer worker's tunnel packet: land it in the local store
+        (attempt-fenced, seq-deduped) and ack."""
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("shuffle/recv")
+        p = req["shuffle_push"]
+        accepted = self.shuffle_worker().store.push(
+            p["sid"], int(p["attempt"]), int(p["m"]), int(p["side"]),
+            int(p["sender"]), int(p.get("seq", -1)), p.get("rows"),
+            nseq=p.get("nseq"),
+        )
+        if inject("shuffle/recv-ack-lost"):
+            # packet stored, ack lost: the sender retransmits and the
+            # seq dedupe drops the duplicate — exactly-once on the wire
+            raise DropConnection()
+        return json.dumps(
+            {"id": req.get("id"), "ok": True, "accepted": bool(accepted)}
+        ).encode()
+
+    def _shuffle_task(self, req) -> bytes:
+        """One dispatched shuffle stage task: produce + push + wait +
+        consume (ShuffleWorker.run_task). Retryable stage failures
+        (dead peers, missing producers) reply with a suspect list the
+        coordinator verifies before re-running the stage on the
+        survivor set."""
+        from tidb_tpu.parallel.shuffle import ShuffleAbort
+        from tidb_tpu.utils.tracing import Tracer
+
+        if req.get("v") != IR_VERSION:
+            raise ValueError(f"unsupported IR version {req.get('v')}")
+        spec = req["shuffle_task"]
+        if "schema_v" in req:
+            engine_v = getattr(self.catalog, "schema_version", 0)
+            if int(req["schema_v"]) != int(engine_v):
+                raise SchemaOutOfDateError(
+                    f"schema out of date: engine at version {engine_v}, "
+                    f"client planned at {req['schema_v']}; reload schemas"
+                )
+        tracer = Tracer()
+        if spec.get("trace"):
+            tracer.enabled = True
+            tracer.reset()
+        t0 = _time.perf_counter()
+        try:
+            result = self.shuffle_worker().run_task(spec, tracer=tracer)
+        except ShuffleAbort as e:
+            return json.dumps(
+                {
+                    "id": req.get("id"), "ok": False, "retryable": "shuffle",
+                    "suspects": e.suspects, "error": str(e),
+                }
+            ).encode()
+        exec_s = _time.perf_counter() - t0
+        resp = {
+            "id": req.get("id"),
+            "ok": True,
+            "columns": result["columns"],
+            "rows": result["rows"],
+            "shuffle": result["shuffle"],
+            "stats": {
+                "rows": len(result["rows"]),
+                "exec_s": exec_s,
+                "host": f"{socket.gethostname()}:{self.port}",
+            },
+        }
+        if tracer.enabled:
+            resp["spans"] = [
+                [s.name, s.start_s, s.dur_s, s.depth] for s in tracer.spans
+            ]
+        if self.ship_registry:
+            resp["registry"] = self._registry_delta()
+        return json.dumps(resp).encode()
+
+    def _registry_delta(self):
+        from tidb_tpu.utils.metrics import counter_delta
+
+        with self._reg_lock:
+            delta, self._reg_snapshot = counter_delta(self._reg_snapshot)
+        return delta
 
     def start_background(self) -> threading.Thread:
         th = threading.Thread(target=self._tcp.serve_forever, daemon=True)
@@ -311,7 +433,11 @@ class EngineClient:
         req["id"] = self._next_id
         if self._secret is not None:
             req["auth"] = self._secret
-        payload = json.dumps(req).encode()
+        return self._roundtrip(json.dumps(req).encode())
+
+    def _roundtrip(self, payload: bytes) -> dict:
+        """Ship one already-encoded frame (its "id" must be
+        self._next_id) and read the correlated response."""
         if len(payload) > MAX_FRAME:
             # nothing was written: the stream is still synchronized, so
             # don't poison the connection over a local size check
@@ -340,6 +466,42 @@ class EngineClient:
                 f"response id {resp.get('id')} != request id {self._next_id}"
             )
         return resp
+
+    def call(self, req: dict) -> dict:
+        """One correlated raw request (shuffle task dispatch and other
+        non-plan frames); the caller interprets the response dict."""
+        return self._call(req)
+
+    def shuffle_push(self, packet: dict) -> bool:
+        """Push one shuffle partition packet to this peer; returns the
+        receiver's accepted flag (False = fenced/deduped, which is fine
+        — the data is already accounted for)."""
+        resp = self._call({"shuffle_push": packet})
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"shuffle push rejected: {resp.get('error', '')}"
+            )
+        return bool(resp.get("accepted"))
+
+    def shuffle_push_encoded(self, payload: bytes) -> bool:
+        """shuffle_push over a PRE-ENCODED `{"shuffle_push": {...}}`
+        object: the data plane serializes each row packet exactly once
+        (at enqueue, where the flow-control window is sized) and the
+        correlation id / auth are spliced in at the byte level instead
+        of re-encoding the rows on the tunnel thread."""
+        if self._dead:
+            raise ConnectionError("engine connection is poisoned; reconnect")
+        self._next_id += 1
+        head = b'{"id":%d' % self._next_id
+        if self._secret is not None:
+            head += b',"auth":' + json.dumps(self._secret).encode()
+        # payload is a non-empty JSON object: "{...}" -> splice after "{"
+        resp = self._roundtrip(head + b"," + payload[1:])
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"shuffle push rejected: {resp.get('error', '')}"
+            )
+        return bool(resp.get("accepted"))
 
     def execute_plan(
         self, plan, schema_version: Optional[int] = None, frag=None

@@ -204,6 +204,11 @@ class Session(DDLMixin):
 
             self.catalog.users = UserStore()
         self.executor = PhysicalExecutor(self.catalog, mesh_devices=mesh_devices)
+        # cross-host DCN fragment scheduler (parallel/dcn.py): when
+        # attached, EXPLAIN ANALYZE routes through the distributed
+        # path (per-host fragment rows + Shuffle exchange rows in the
+        # plan tree) instead of the local instrumented run
+        self.dcn_scheduler = None
         from tidb_tpu.utils import SysVars, Tracer
 
         self.vars = SysVars(self.catalog.global_sysvars)
@@ -5384,11 +5389,30 @@ class Session(DDLMixin):
         fn = dump_plan_replayer(self, s.sql_text, tables, explain.rows)
         return Result(["File"], [(fn,)])
 
+    def attach_dcn_scheduler(self, scheduler) -> None:
+        """Attach a DCNFragmentScheduler: EXPLAIN ANALYZE of session
+        statements then routes through scheduler.explain_analyze (the
+        distributed plan tree — per-host fragment rows, Shuffle
+        exchange rows). Pass None to detach."""
+        self.dcn_scheduler = scheduler
+
     def _run_explain(self, s: ast.Explain) -> Result:
         if not isinstance(s.stmt, (ast.Select, ast.Union, ast.With)):
             raise ValueError("EXPLAIN supports SELECT/UNION/WITH")
         plan = build_query(s.stmt, self.catalog, self.db, self._scalar_subquery)
         if s.analyze:
+            sched = getattr(self, "dcn_scheduler", None)
+            if sched is not None:
+                from tidb_tpu.planner.fragmenter import Unschedulable
+
+                try:
+                    _cols, _rows, lines = sched.explain_analyze(plan)
+                    return Result(["plan"], [(l,) for l in lines])
+                except Unschedulable:
+                    # plans that cannot cross the engine seam at all
+                    # (GROUP_CONCAT host-assisted shapes) fall back to
+                    # the local instrumented run
+                    pass
             _out, _dicts, lines = self.executor.run_analyze(plan)
             return Result(["plan"], [(l,) for l in lines])
         from tidb_tpu.planner.cardinality import est_rows

@@ -83,6 +83,16 @@ SITES = frozenset({
     "resgroup/debit",
     "sequence/nextval",
     "server/dispatch-query",
+    "shuffle/consume",
+    "shuffle/open",
+    "shuffle/produce",
+    "shuffle/push",
+    "shuffle/push-lost",
+    "shuffle/recv",
+    "shuffle/recv-ack-lost",
+    "shuffle/stage",
+    "shuffle/stage-retry",
+    "shuffle/wait",
     "session/before-commit",
     "session/begin-txn",
     "session/commit-apply",

@@ -280,6 +280,73 @@ class Registry:
 REGISTRY = Registry()
 
 
+# -- fleet merge (worker -> coordinator registry shipping) ------------------
+#
+# Worker processes export their own /metrics, which leaves the
+# coordinator's registry blind to fleet-wide engine activity (ROADMAP
+# PR 2 open item b). Counter deltas piggyback on fragment/shuffle
+# replies over the engine-RPC seam and merge here: the worker snapshots
+# its counters per reply and ships only the positive deltas. Delivery
+# is AT-MOST-ONCE: the ledger fence guarantees a delta never merges
+# twice, but a reply lost in transit (or fenced as a late duplicate
+# after re-dispatch) drops its delta — the worker advanced its
+# snapshot when it built the reply. Fleet counters may therefore
+# UNDER-count around worker deaths/retries; they never over-count.
+
+
+def counter_snapshot(registry: Registry = REGISTRY) -> Dict[tuple, float]:
+    """(name, labelnames, labelvalues) -> value for every counter."""
+    with registry._lock:
+        items = list(registry._metrics.items())
+    out: Dict[tuple, float] = {}
+    for name, m in items:
+        if isinstance(m, MetricFamily):
+            if m.kind != "counter":
+                continue
+            for values, child in m.children():
+                out[(name, m.labelnames, values)] = float(child.value)
+        elif isinstance(m, Counter):
+            out[(name, (), ())] = float(m.value)
+    return out
+
+
+def counter_delta(
+    prev: Dict[tuple, float], registry: Registry = REGISTRY
+) -> Tuple[List[list], Dict[tuple, float]]:
+    """Positive counter movement since `prev` as JSON-stable rows
+    [[name, [labelnames], [labelvalues], delta], ...] plus the new
+    snapshot to carry forward."""
+    cur = counter_snapshot(registry)
+    delta = [
+        [name, list(lnames), list(lvalues), v - prev.get(key, 0.0)]
+        for key, v in cur.items()
+        for name, lnames, lvalues in (key,)
+        if v - prev.get(key, 0.0) > 0
+    ]
+    return delta, cur
+
+
+def merge_counter_delta(delta, registry: Registry = REGISTRY) -> None:
+    """Fold a shipped counter delta into this process's registry. Only
+    tidbtpu_* names are accepted; a name already registered with a
+    different kind/label set is skipped rather than poisoning the
+    registry (the worker may run newer code than the coordinator)."""
+    for row in delta or ():
+        try:
+            name, lnames, lvalues, d = row
+        except Exception:
+            continue
+        if not isinstance(name, str) or not name.startswith("tidbtpu_"):
+            continue
+        try:
+            c = registry.counter(
+                name, "merged from worker replies", labels=tuple(lnames)
+            )
+            (c.labels(*lvalues) if lnames else c).inc(float(d))
+        except ValueError:
+            continue
+
+
 def sql_digest(sql: str) -> str:
     """Normalize a statement for summary grouping: literals -> '?',
     whitespace collapsed, lowercased keywords (reference: parser
