@@ -815,47 +815,88 @@ def measure_multihost_shuffle(args) -> int:
             parse(sql)[0], cat, "tpch", sess._scalar_subquery
         )
 
-        def run_mode(mode):
+        def _reg_total(prefix):
+            return sum(
+                v for n, _k, v in REGISTRY.rows() if n.startswith(prefix)
+            )
+
+        def run_mode(mode, codec="binary"):
             sched = DCNFragmentScheduler(
                 [("127.0.0.1", pt) for pt in ports],
-                catalog=cat, shuffle_mode=mode,
+                catalog=cat, shuffle_mode=mode, shuffle_codec=codec,
             )
             try:
-                staged0 = sum(
-                    v for n, _k, v in REGISTRY.rows()
-                    if n.startswith("tidbtpu_dcn_bytes_staged")
-                )
-                tunneled0 = sum(
-                    v for n, _k, v in REGISTRY.rows()
-                    if n.startswith("tidbtpu_shuffle_bytes_total")
-                )
+                before = {
+                    p: _reg_total(p)
+                    for p in (
+                        "tidbtpu_dcn_bytes_staged",
+                        "tidbtpu_shuffle_bytes_total",
+                        "tidbtpu_shuffle_encode_seconds",
+                        "tidbtpu_shuffle_decode_seconds",
+                    )
+                }
                 times, rows = [], []
+                rows_tunneled = 0
                 for _ in range(max(args.repeat, 1)):
                     t0 = time.perf_counter()
                     _cols, out = sched.execute_plan(plan)
                     times.append(time.perf_counter() - t0)
                     rows = out
-                staged1 = sum(
-                    v for n, _k, v in REGISTRY.rows()
-                    if n.startswith("tidbtpu_dcn_bytes_staged")
-                )
-                tunneled1 = sum(
-                    v for n, _k, v in REGISTRY.rows()
-                    if n.startswith("tidbtpu_shuffle_bytes_total")
-                )
+                    if mode != "never":
+                        # summed across repeats — the byte counters
+                        # below accumulate across repeats too
+                        rows_tunneled += (sched.last_query or {}).get(
+                            "shuffle", {}
+                        ).get("rows_tunneled", 0)
+                delta = {
+                    p: _reg_total(p) - v0 for p, v0 in before.items()
+                }
+                tunneled = delta["tidbtpu_shuffle_bytes_total"]
                 return {
                     "seconds": statistics.median(times),
                     "rows": len(rows),
-                    "bytes_over_coordinator": staged1 - staged0,
-                    "bytes_over_tunnels": tunneled1 - tunneled0,
+                    "codec": codec if mode != "never" else None,
+                    "bytes_over_coordinator":
+                        delta["tidbtpu_dcn_bytes_staged"],
+                    "bytes_over_tunnels": tunneled,
+                    # wire efficiency of the exchange codec (the A/B
+                    # PERF_NOTES "Shuffle wire format" cites): counters
+                    # ship back from the worker processes via the
+                    # piggybacked registry deltas
+                    "bytes_per_row": (
+                        round(tunneled / rows_tunneled, 2)
+                        if rows_tunneled else None
+                    ),
+                    "encode_seconds": round(
+                        delta["tidbtpu_shuffle_encode_seconds"], 6
+                    ),
+                    "decode_seconds": round(
+                        delta["tidbtpu_shuffle_decode_seconds"], 6
+                    ),
                     "result": rows,
                 }
             finally:
                 sched.close()
 
         staged = run_mode("never")
-        tunnel = run_mode("always")
+        tunnel = run_mode("always")                       # binary codec
+        tunnel_json = run_mode("always", codec="json")    # A/B reference
         assert tunnel["result"] == staged["result"], "mode parity broke"
+        assert tunnel_json["result"] == staged["result"], (
+            "codec parity broke"
+        )
+        codec_ab = {
+            "bytes_binary": tunnel["bytes_over_tunnels"],
+            "bytes_json": tunnel_json["bytes_over_tunnels"],
+            "bytes_ratio": round(
+                tunnel["bytes_over_tunnels"]
+                / max(tunnel_json["bytes_over_tunnels"], 1), 4
+            ),
+            "encode_seconds_binary": tunnel["encode_seconds"],
+            "encode_seconds_json": tunnel_json["encode_seconds"],
+            "decode_seconds_binary": tunnel["decode_seconds"],
+            "decode_seconds_json": tunnel_json["decode_seconds"],
+        }
         nrows_lineitem = cat.table("tpch", "lineitem").nrows
         result = {
             "metric": f"multihost_shuffle_join_sf{sf:g}_rows_per_sec",
@@ -877,6 +918,10 @@ def measure_multihost_shuffle(args) -> int:
                 "tunneled": {
                     k: v for k, v in tunnel.items() if k != "result"
                 },
+                "tunneled_json": {
+                    k: v for k, v in tunnel_json.items() if k != "result"
+                },
+                "codec_ab": codec_ab,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",
@@ -939,7 +984,9 @@ def main() -> int:
         "pre-aggregates below the join, which removes the shuffle cut) "
         "with partial-agg coordinator staging vs direct worker-to-"
         "worker tunnels and records bytes_over_coordinator vs "
-        "bytes_over_tunnels (CPU data-plane scenario; SF capped at "
+        "bytes_over_tunnels, plus the binary-vs-JSON shuffle wire "
+        "codec A/B (bytes per row, encode/decode seconds — "
+        "detail.codec_ab) (CPU data-plane scenario; SF capped at "
         "0.02 unless --sf <= 1)",
     )
     ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Lint: no JSON encode/decode on the shuffle data plane.
+
+Why: the whole point of the binary columnar wire format
+(parallel/wire.py) is that shuffle exchange data never round-trips
+through json.dumps/json.loads — PR 3's row packets cost ~2-5x wire
+bloat plus a Python row interpreter at both ends. The JSON row-packet
+codec survives ONLY as the declared fallback (the ``shuffle_codec=json``
+escape hatch and mixed-version peer negotiation); every such call site
+carries a ``shuffle-json-fallback`` marker comment on its line (or the
+line above). A NEW ``json.dumps``/``json.loads`` inside a data-plane
+send/receive function without the marker fails this lint — the easy
+regression ("just json.dumps the rows here") stays impossible to land
+silently.
+
+Scope: the functions named in HOTPATH below — the producer
+partition/encode/send path, the tunnel sender, the receiver store, the
+binary/JSON push handlers, and the consumer staging path. Control-plane
+frames (task dispatch, acks, replies, EXPLAIN) are deliberately out of
+scope: they are small and JSON is the protocol there.
+
+Usage: python scripts/check_shuffle_hotpath.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+MARKER = "shuffle-json-fallback"
+
+#: file (repo-relative) -> data-plane function/method qualnames whose
+#: bodies must not call json.dumps/json.loads without the marker
+HOTPATH = {
+    os.path.join("tidb_tpu", "parallel", "wire.py"): {
+        "encode_frame", "decode_frame", "splice_id_auth",
+        "column_key_ints", "partition_block",
+    },
+    os.path.join("tidb_tpu", "parallel", "shuffle.py"): {
+        "partition_rows",
+        "stage_rows_as_batch", "stage_payloads_as_batch",
+        "ShuffleStore.push", "ShuffleStore.wait",
+        "PeerTunnel.send", "PeerTunnel._loop",
+        "ShuffleWorker.run_task", "ShuffleWorker._ship_partition",
+        "ShuffleWorker._send_stream",
+    },
+    os.path.join("tidb_tpu", "server", "engine_rpc.py"): {
+        "EngineServer._shuffle_push", "EngineServer._shuffle_push_binary",
+        "EngineClient.shuffle_push", "EngineClient.shuffle_push_encoded",
+    },
+    os.path.join("tidb_tpu", "chunk.py"): {
+        "concat_host_columns", "take_block", "slice_block",
+    },
+}
+
+
+def _json_calls(tree: ast.AST, wanted: set):
+    """Yield (qualname, lineno) for every json.dumps/json.loads call
+    inside a wanted function body (nested defs included)."""
+    out = []
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                walk(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.Call):
+                f = child.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "json"
+                    and f.attr in ("dumps", "loads")
+                ):
+                    qual = ".".join(stack)
+                    # method qualnames are Class.method; plain
+                    # functions match their bare name; nested helpers
+                    # inherit the outermost wanted scope
+                    for w in wanted:
+                        parts = w.split(".")
+                        if (
+                            stack[: len(parts)] == parts
+                            or any(
+                                stack[i : i + len(parts)] == parts
+                                for i in range(len(stack))
+                            )
+                        ):
+                            out.append((qual or w, child.lineno))
+                            break
+            walk(child, stack)
+
+    walk(tree, [])
+    return out
+
+
+def check(root: str):
+    violations = []
+    for rel, wanted in sorted(HOTPATH.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        lines = text.splitlines()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            violations.append((rel, e.lineno or 0, f"unparseable: {e}"))
+            continue
+        for qual, lineno in _json_calls(tree, wanted):
+            window = lines[max(lineno - 8, 0) : lineno]
+            if any(MARKER in ln for ln in window):
+                continue
+            violations.append(
+                (
+                    rel, lineno,
+                    f"json.dumps/loads in shuffle data-plane function "
+                    f"{qual!r} without a '{MARKER}' marker — exchange "
+                    "data must ride the binary columnar codec "
+                    "(parallel/wire.py)",
+                )
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} shuffle hot-path violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,19 +269,40 @@ SHUFFLE_QUERIES = [
     "group by o_orderpriority order by o_orderpriority",
 ]
 
+#: STRING-keyed repartition join (un-gated by the binary columnar wire
+#: format: values hash stably, receivers re-key dictionary codes into a
+#: stage-local unified dictionary). Filters keep the F/O status match
+#: explosion small at SF 0.002.
+STRING_KEY_JOIN = (
+    "select o_orderstatus, count(*) from orders join lineitem "
+    "on o_orderstatus = l_linestatus "
+    "where o_totalprice > 150000 and l_quantity >= 47 "
+    "group by o_orderstatus order by o_orderstatus"
+)
+
 
 def test_dcn_shuffle_repartition_join_parity(tpch_single):
     """2-process x 4-device dryrun of the worker-to-worker shuffle
-    service: repartition join + distinct GROUP BY run with results
-    identical to single-process execution, and the shuffled bytes
-    provably BYPASS the coordinator — tidbtpu_shuffle_bytes_total
-    (incremented only in the worker processes, shipped back via the
-    piggybacked registry deltas) grows, while tidbtpu_dcn_bytes_staged
-    does not move at all."""
-    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
-
+    service: repartition join + distinct GROUP BY + STRING-keyed join
+    run with results identical to single-process execution, the
+    shuffled bytes provably BYPASS the coordinator —
+    tidbtpu_shuffle_bytes_total (incremented only in the worker
+    processes, shipped back via the piggybacked registry deltas) grows,
+    while tidbtpu_dcn_bytes_staged does not move at all — and the
+    binary columnar wire codec puts <= 0.5x the JSON codec's bytes on
+    the tunnels for the same query at row-level result parity."""
     w1, p1 = _spawn_dcn_worker()
     w2, p2 = _spawn_dcn_worker()
+    try:
+        _shuffle_codec_ab_body(tpch_single, p1, p2)
+    finally:
+        for w in (w1, w2):
+            w.kill()
+
+
+def _shuffle_codec_ab_body(tpch_single, p1, p2):
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+
     sched = DCNFragmentScheduler(
         [("127.0.0.1", p1), ("127.0.0.1", p2)],
         catalog=tpch_single.catalog,
@@ -289,11 +310,18 @@ def test_dcn_shuffle_repartition_join_parity(tpch_single):
     )
     staged0 = _counter_total("tidbtpu_dcn_bytes_staged")
     shuffled0 = _counter_total("tidbtpu_shuffle_bytes_total")
+    bytes_binary = {}
     try:
-        for q in SHUFFLE_QUERIES:
+        for q in SHUFFLE_QUERIES + [STRING_KEY_JOIN]:
             exp = tpch_single.must_query(q).rows
             _cols, got = sched.execute_plan(_plan(tpch_single, q))
             assert got == exp, f"{q}\n got={got}\n exp={exp}"
+            bytes_binary[q] = sched.last_query["shuffle"]["bytes_tunneled"]
+            assert sched.last_query["shuffle"]["codec"] == "binary"
+        # the string-keyed join really rode the shuffle path (no
+        # single-host fallback) and really exchanged partition data
+        assert sched.last_query["shuffle"]["kind"] == "join"
+        assert bytes_binary[STRING_KEY_JOIN] > 0
         last = sched.last_query
         assert last["shuffle"]["m"] == 2
         assert last["shuffle"]["bytes_tunneled"] > 0
@@ -309,8 +337,29 @@ def test_dcn_shuffle_repartition_join_parity(tpch_single):
         assert len(sched.alive_endpoints()) == 2
     finally:
         sched.close()
-        for w in (w1, w2):
-            w.kill()
+
+    # codec A/B on the same workers: the JSON escape hatch gives the
+    # same rows while the binary codec's tunnel bytes are <= 0.5x
+    sched_json = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+        shuffle_codec="json",
+    )
+    try:
+        q = SHUFFLE_QUERIES[0]
+        exp = tpch_single.must_query(q).rows
+        _cols, got = sched_json.execute_plan(_plan(tpch_single, q))
+        assert got == exp  # row-level cross-codec parity
+        bytes_json = sched_json.last_query["shuffle"]["bytes_tunneled"]
+        assert sched_json.last_query["shuffle"]["codec"] == "json"
+        assert bytes_json > 0
+        assert bytes_binary[q] <= 0.5 * bytes_json, (
+            f"binary codec shipped {bytes_binary[q]}B vs JSON "
+            f"{bytes_json}B — expected <= 0.5x"
+        )
+    finally:
+        sched_json.close()
 
 
 def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):

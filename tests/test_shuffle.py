@@ -130,7 +130,7 @@ class TestShuffleStoreFences:
         st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
         st.push("q1", 1, 2, 0, 1, -1, None, nseq=0)
         out = st.wait("q1", 1, 1, 2, timeout_s=5)
-        assert out[0] == [(1,)]  # landed exactly once
+        assert out[0] == [[(1,)]]  # landed exactly once
 
     def test_stale_attempt_fenced(self):
         st = ShuffleStore()
@@ -140,7 +140,7 @@ class TestShuffleStoreFences:
         assert st.push("q1", 1, 2, 0, 0, 0, [("old",)]) is False
         assert st.push("q1", 2, 1, 0, 0, 0, [("new",)]) is True
         st.push("q1", 2, 1, 0, 0, -1, None, nseq=1)
-        assert st.wait("q1", 2, 1, 1, timeout_s=5)[0] == [("new",)]
+        assert st.wait("q1", 2, 1, 1, timeout_s=5)[0] == [[("new",)]]
 
     def test_newer_attempt_resets_stage(self):
         """Pushes from a fast peer's NEW attempt may arrive before this
@@ -152,7 +152,7 @@ class TestShuffleStoreFences:
         assert st.push("q1", 2, 1, 0, 0, 0, [("new",)]) is True
         st.push("q1", 2, 1, 0, 0, -1, None, nseq=1)
         out = st.wait("q1", 2, 1, 1, timeout_s=5)
-        assert out[0] == [("new",)]
+        assert out[0] == [[("new",)]]
 
     def test_wait_timeout_names_missing_senders(self):
         st = ShuffleStore()
@@ -163,7 +163,7 @@ class TestShuffleStoreFences:
             st.wait("q1", 1, 1, 2, timeout_s=0.2)
         assert ei.value.missing == ["side0/sender1"]
 
-    def test_wait_orders_rows_by_sender_then_seq(self):
+    def test_wait_orders_payloads_by_sender_then_seq(self):
         st = ShuffleStore()
         st.open("q1", 1, 2)
         st.push("q1", 1, 2, 0, 1, 1, [(31,)])
@@ -172,7 +172,7 @@ class TestShuffleStoreFences:
         st.push("q1", 1, 2, 0, 0, 0, [(10,), (11,)])
         st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
         out = st.wait("q1", 1, 1, 2, timeout_s=5)
-        assert out[0] == [(10,), (11,), (30,), (31,)]
+        assert out[0] == [[(10,), (11,)], [(30,)], [(31,)]]
 
 
 # ---------------------------------------------------------------------------
@@ -309,7 +309,11 @@ class TestShuffleCuts:
         # cut at all, or only a group-by-free plan -> None
         assert sp is None or sp.kind != "join"
 
-    def test_no_join_cut_for_string_keys(self, sess):
+    def test_string_key_join_cut_ungated(self, sess):
+        """String join keys shuffle now (ROADMAP item c): the producer
+        hashes the VALUE (dictionary entry), the receiver re-keys codes
+        against a stage-local unified dictionary, and the consumer join
+        aligns the two sides' dictionaries."""
         sess.execute("create table w (b varchar(8), x int)")
         sess.execute("insert into w values ('x',1),('y',2)")
         plan = _plan(
@@ -317,7 +321,8 @@ class TestShuffleCuts:
             "select count(*) from t join w on t.b = w.b",
         )
         sp = split_plan_shuffle(plan, sess.catalog)
-        assert sp is None or sp.kind != "join"
+        assert sp is not None and sp.kind == "join"
+        assert [s.key for s in sp.sides] == ["t.b", "w.b"]
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +467,144 @@ class TestShuffleScheduler:
 
 
 # ---------------------------------------------------------------------------
+# binary columnar wire codec end to end (parallel/wire.py)
+# ---------------------------------------------------------------------------
+
+
+STRING_JOIN = "select t.b, count(*) from t join w on t.b = w.b group by t.b order by t.b"
+
+
+class TestBinaryCodec:
+    def _with_w(self, sess):
+        sess.execute("create table w (b varchar(8), x int)")
+        sess.execute("insert into w values ('x',1),('y',2),('zz',5)")
+        return sess
+
+    def test_cross_codec_parity_and_fewer_bytes(self, sess):
+        """The same queries through shuffle_codec=binary and =json give
+        identical rows, and the binary frames put fewer bytes on the
+        tunnels."""
+        self._with_w(sess)
+        results = {}
+        for codec in ("binary", "json"):
+            servers = _servers(sess)
+            sched = DCNFragmentScheduler(
+                [("127.0.0.1", s.port) for s in servers],
+                catalog=sess.catalog, shuffle_mode="always",
+                shuffle_codec=codec,
+            )
+            try:
+                for q in PARITY_QUERIES + [STRING_JOIN]:
+                    exp = sess.must_query(q).rows
+                    _cols, got = sched.execute_plan(_plan(sess, q))
+                    assert got == exp, f"[{codec}] {q}\n{got}\n{exp}"
+                results[codec] = dict(sched.last_query["shuffle"])
+            finally:
+                sched.close()
+                for s_ in servers:
+                    s_.shutdown()
+        assert results["binary"]["codec"] == "binary"
+        assert (
+            0
+            < results["binary"]["bytes_tunneled"]
+            < results["json"]["bytes_tunneled"]
+        )
+
+    def test_string_key_repartition_join_parity(self, sess):
+        """A string-keyed join runs THROUGH the shuffle path (no
+        single-host fallback) with result parity: per-batch dictionary
+        codes re-keyed against the stage-local unified dictionary."""
+        self._with_w(sess)
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+        )
+        try:
+            sp = sched._plan_shuffle(_plan(sess, STRING_JOIN))
+            assert sp is not None and sp.kind == "join"
+            exp = sess.must_query(STRING_JOIN).rows
+            _cols, got = sched.execute_plan(_plan(sess, STRING_JOIN))
+            assert got == exp
+            assert sched.last_query["shuffle"]["kind"] == "join"
+        finally:
+            sched.close()
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_corrupt_frame_aborts_stage_nonretryable(self, sess):
+        """shuffle/decode failpoint: a malformed binary frame is
+        rejected by the receiver with an error REPLY — the stage aborts
+        as a non-retryable engine error; the healthy peer is NOT
+        quarantined as a fake death and the stage is NOT retried."""
+        servers = _servers(sess)
+        prober = FailedEngineProber(initial_backoff_s=60)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always", prober=prober,
+        )
+        failpoint.enable(
+            "shuffle/decode", ValueError("failpoint: corrupt frame")
+        )
+        retries0 = REGISTRY.counter("tidbtpu_shuffle_stage_retries").value
+        try:
+            with pytest.raises(RuntimeError, match="rejected"):
+                sched.execute_plan(_plan(sess, GROUPED_JOIN))
+            assert prober.failed_endpoints() == []
+            assert len(sched.alive_endpoints()) == 2
+            assert (
+                REGISTRY.counter("tidbtpu_shuffle_stage_retries").value
+                == retries0
+            )
+            # the stage is poisoned, not the peers: disabling the
+            # failpoint restores service on the same scheduler
+            failpoint.disable("shuffle/decode")
+            exp = sess.must_query(GROUPED_JOIN).rows
+            _cols, got = sched.execute_plan(_plan(sess, GROUPED_JOIN))
+            assert got == exp
+        finally:
+            failpoint.disable("shuffle/decode")
+            sched.close()
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_mixed_codec_peers_interoperate(self, sess):
+        """Mixed-version fleets: one stream rides JSON while the other
+        rides binary frames, and the result still matches — the
+        vectorized column hash is bit-identical to the row fallback
+        (equal keys colocate across codecs) and the consumer stages
+        mixed payload kinds in one stage. Forced by patching the
+        tunnels TOWARD one server to negotiate down."""
+        from tidb_tpu.parallel import shuffle as shuffle_mod
+
+        self._with_w(sess)
+        servers = _servers(sess)
+        json_port = servers[0].port
+        orig = shuffle_mod.PeerTunnel.negotiated_codec
+
+        def one_legacy_peer(self, preferred="binary"):
+            if self.port == json_port:
+                return "json"
+            return orig(self, preferred)
+
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+        )
+        try:
+            shuffle_mod.PeerTunnel.negotiated_codec = one_legacy_peer
+            for q in (GROUPED_JOIN, STRING_JOIN):
+                exp = sess.must_query(q).rows
+                _cols, got = sched.execute_plan(_plan(sess, q))
+                assert got == exp, f"{q}\n{got}\n{exp}"
+        finally:
+            shuffle_mod.PeerTunnel.negotiated_codec = orig
+            sched.close()
+            for s_ in servers:
+                s_.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # registry shipping (fleet observability satellite)
 # ---------------------------------------------------------------------------
 
@@ -523,7 +666,7 @@ def test_concurrent_stages_do_not_cross():
             st.push(sid, 1, 1, 0, 0, 0, [(val,)])
             st.push(sid, 1, 1, 0, 0, -1, None, nseq=1)
             out = st.wait(sid, 1, 1, 1, timeout_s=5)
-            assert out[0] == [(val,)]
+            assert out[0] == [[(val,)]]
         except Exception as e:  # pragma: no cover
             errs.append(e)
 

@@ -1,0 +1,59 @@
+"""Tier-1 gate for scripts/check_shuffle_hotpath.py: the shuffle data
+plane (producer partition/encode/send, tunnel sender, receiver store,
+push handlers, consumer staging) must not grow new json.dumps/json.loads
+call sites — exchange data rides the binary columnar codec
+(parallel/wire.py); JSON survives only at the marked fallback sites."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_shuffle_hotpath.py")
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, LINT, REPO], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"shuffle hot-path violations:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_lint_catches_unmarked_json_on_hotpath(tmp_path):
+    pkg = tmp_path / "tidb_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "shuffle.py").write_text(
+        "import json\n"
+        "class ShuffleStore:\n"
+        "    def push(self, payload):\n"
+        "        return json.loads(payload)\n"  # data plane: violation
+        "class PeerTunnel:\n"
+        "    def send(self, packet):\n"
+        "        # shuffle-json-fallback: declared escape hatch\n"
+        "        return json.dumps(packet)\n"  # marked: allowed
+        "def off_hotpath():\n"
+        "    return json.dumps({})\n"  # not a data-plane function
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout
+    assert "ShuffleStore.push" in proc.stdout
+    assert "PeerTunnel.send" not in proc.stdout
+    assert "off_hotpath" not in proc.stdout
+
+
+def test_lint_flags_unparseable_hotpath_file(tmp_path):
+    pkg = tmp_path / "tidb_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "wire.py").write_text("def broken(:\n")
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "unparseable" in proc.stdout

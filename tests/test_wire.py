@@ -1,0 +1,322 @@
+"""Binary columnar shuffle wire format: frame round trips across all
+SQLTypes, NULL validity, empty partitions, dict-encoded strings, the
+0-row EOF marker, the shared id/auth splice helper, and vectorized
+partition parity with the row fallback (tests the codec seam in
+isolation; end-to-end stages live in test_shuffle.py/test_multihost.py).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import (
+    HostBlock,
+    HostColumn,
+    block_to_rows,
+    column_from_values,
+    concat_host_columns,
+    slice_block,
+    take_block,
+)
+from tidb_tpu.dtypes import (
+    BOOL,
+    DATE,
+    DATETIME,
+    DECIMAL,
+    FLOAT64,
+    INT64,
+    STRING,
+    TIME,
+    Kind,
+)
+from tidb_tpu.parallel import wire
+from tidb_tpu.parallel.shuffle import _key_to_int, partition_rows
+from tidb_tpu.planner.logical import OutCol
+
+
+def _block(colspecs):
+    cols = {n: column_from_values(v, t) for n, t, v in colspecs}
+    n = len(colspecs[0][2]) if colspecs else 0
+    return HostBlock(cols, n), [
+        OutCol(None, n_, n_, t) for n_, t, _v in colspecs
+    ]
+
+
+ALL_TYPES = [
+    ("i", INT64, [1, None, -5, 2 ** 40, 0, 127]),
+    ("f", FLOAT64, [1.5, -0.0, None, 3.0, -2.75, 1e300]),
+    ("b", BOOL, [True, False, None, True, False, True]),
+    ("d", DATE, ["2020-01-01", None, "1999-12-31", "2020-01-01",
+                 "1970-01-01", "2038-01-19"]),
+    ("dt", DATETIME, ["2020-01-01 10:00:00", "2020-01-01 10:00:00.123456",
+                      None, "1970-01-01 00:00:00", "2001-02-03 04:05:06",
+                      "2020-01-01 10:00:00"]),
+    ("t", TIME, ["10:00:00", "-01:02:03", None, "00:00:00.5",
+                 "838:59:59", "00:00:00"]),
+    ("dec", DECIMAL(2), [1.25, None, -3.5, 10.0, 0.01, -0.0]),
+    ("s", STRING, ["alpha", "beta", None, "alpha", "", "Ω-utf8"]),
+]
+
+
+class TestFrameRoundTrip:
+    def test_all_sqltypes_with_nulls(self):
+        blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame("sid-π", 2, 3, 1, 0, 2, 7, blk, schema)
+        pkt = wire.decode_frame(frame)
+        assert (pkt["sid"], pkt["attempt"], pkt["m"]) == ("sid-π", 2, 3)
+        assert (pkt["side"], pkt["sender"], pkt["part"]) == (1, 0, 2)
+        assert pkt["seq"] == 7 and pkt["nseq"] is None
+        got = pkt["block"]
+        assert got.nrows == blk.nrows
+        assert block_to_rows(got, schema) == block_to_rows(blk, schema)
+        # validity survives exactly
+        for n, _t, _v in ALL_TYPES:
+            assert got.columns[n].valid.tolist() == \
+                blk.columns[n].valid.tolist()
+
+    def test_empty_partition(self):
+        blk, schema = _block([(n, t, []) for n, t, _v in ALL_TYPES])
+        frame = wire.encode_frame("s", 1, 2, 0, 0, 1, 0, blk, schema)
+        pkt = wire.decode_frame(frame)
+        assert pkt["block"].nrows == 0
+        assert block_to_rows(pkt["block"], schema) == []
+
+    def test_eof_marker(self):
+        _blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame(
+            "s", 1, 2, 0, 0, 1, -1, None, schema, nseq=5
+        )
+        pkt = wire.decode_frame(frame)
+        assert pkt["block"] is None and pkt["nseq"] == 5
+
+    def test_width_narrowing_is_lossless(self):
+        vals = [0, 1, -128, 127, 300, -40000, 2 ** 31, -(2 ** 62), None]
+        blk, schema = _block([("i", INT64, vals)])
+        frame = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk, schema)
+        got = wire.decode_frame(frame)["block"].columns["i"]
+        assert got.data.dtype == np.int64
+        assert got.data.tolist() == blk.columns["i"].data.tolist()
+        # small-range columns really narrow on the wire
+        small, sch2 = _block([("i", INT64, [1, 2, 3, None])])
+        f2 = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, small, sch2)
+        assert len(f2) < len(frame)
+
+    def test_dictionary_pruned_per_frame(self):
+        """A frame ships only the dictionary entries its rows use —
+        a partition chunk must not re-broadcast the producer batch's
+        whole vocabulary."""
+        col = column_from_values(
+            ["aa", "bb", "cc", "dd"], STRING
+        )
+        blk = HostBlock({"s": col}, 4)
+        schema = [OutCol(None, "s", "s", STRING)]
+        sub = take_block(blk, np.array([1, 3]))
+        frame = wire.encode_frame("s", 1, 2, 0, 0, 1, 0, sub, schema)
+        got = wire.decode_frame(frame)["block"].columns["s"]
+        assert got.dictionary.tolist() == ["bb", "dd"]
+        assert got.decode().tolist() == ["bb", "dd"]
+
+    def test_corrupt_frames_raise_wire_format_error(self):
+        blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame("s", 1, 2, 0, 0, 1, 0, blk, schema)
+        for bad in (
+            frame[:10],                      # truncated header
+            frame[:-3],                      # truncated column buffer
+            frame + b"xx",                   # trailing garbage
+            b"\xc5\x63" + frame[2:],         # future wire version
+            bytes([0x7C]) + frame[1:],       # bad magic
+        ):
+            with pytest.raises(wire.WireFormatError):
+                wire.decode_frame(bad)
+
+    def test_inflated_dictionary_count_rejected_before_alloc(self):
+        """A corrupt u32 dictionary count must fail the length check,
+        never reach np.empty — a multi-GB allocation would invite the
+        OOM killer to fake the peer death this reject path prevents."""
+        import struct as _struct
+
+        col = column_from_values(["a", "b"], STRING)
+        blk = HostBlock({"s": col}, 2)
+        schema = [OutCol(None, "s", "s", STRING)]
+        frame = bytearray(
+            wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk, schema)
+        )
+        # the dict count sits 5 bytes before the first entry's length
+        marker = bytes(frame).rindex(
+            _struct.pack("<I", 1) + b"a"
+        ) - 4
+        assert _struct.unpack_from("<I", frame, marker)[0] == 2
+        _struct.pack_into("<I", frame, marker, 0x7FFFFFFF)
+        with pytest.raises(wire.WireFormatError, match="dictionary count"):
+            wire.decode_frame(bytes(frame))
+
+
+class TestSpliceHelper:
+    def test_json_splice_parses_identically_to_full_dumps(self):
+        """Satellite: the byte-level splice output parses identically
+        to json.dumps of the merged dict."""
+        pkt = {
+            "shuffle_push": {
+                "sid": "q1", "attempt": 1, "m": 2, "side": 0,
+                "sender": 1, "part": 0, "seq": 3,
+                "rows": [[1, "x", None], [2, "y\"{}", 3.5]],
+            }
+        }
+        payload = json.dumps(pkt).encode()
+        out = wire.splice_id_auth(payload, 42, 's"ec{ret')
+        assert json.loads(out) == json.loads(
+            json.dumps({"id": 42, "auth": 's"ec{ret', **pkt})
+        )
+        out2 = wire.splice_id_auth(payload, 7, None)
+        assert json.loads(out2) == {"id": 7, **pkt}
+
+    def test_binary_splice_roundtrip(self):
+        blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame("sid", 1, 2, 0, 0, 1, 0, blk, schema)
+        out = wire.splice_id_auth(frame, 99, "secret-π")
+        pkt = wire.decode_frame(out)
+        assert pkt["id"] == 99 and pkt["auth"] == "secret-π"
+        assert wire.peek_request_id(out) == 99
+        assert wire.peek_auth(out) == "secret-π"
+        # the carried columns are untouched by the splice
+        assert block_to_rows(pkt["block"], schema) == \
+            block_to_rows(blk, schema)
+        # re-splice replaces, never accumulates
+        out2 = wire.splice_id_auth(out, 100, "x")
+        pkt2 = wire.decode_frame(out2)
+        assert pkt2["id"] == 100 and pkt2["auth"] == "x"
+
+
+class TestSecretBinaryPush:
+    def test_spliced_auth_authenticates_first_frame(self):
+        """A binary frame can be the FIRST frame on a secreted
+        connection: the spliced auth section authenticates it, and a
+        wrong secret is rejected before anything lands."""
+        import json as _json
+        import socket
+        import struct
+
+        from tidb_tpu.server.engine_rpc import EngineClient, EngineServer
+        from tidb_tpu.storage import Catalog
+
+        srv = EngineServer(Catalog(), port=0, secret="hunter2")
+        srv.start_background()
+        try:
+            schema = [OutCol(None, "k", "k", INT64)]
+            blk = HostBlock(
+                {"k": column_from_values([1, 2, 3], INT64)}, 3
+            )
+            frame = wire.encode_frame(
+                "qs", 1, 1, 0, 0, 0, 0, blk, schema
+            )
+
+            def push_raw(payload):
+                s = socket.create_connection(("127.0.0.1", srv.port))
+                try:
+                    s.sendall(struct.pack("<I", len(payload)) + payload)
+                    hdr = b""
+                    while len(hdr) < 4:
+                        hdr += s.recv(4 - len(hdr))
+                    (n,) = struct.unpack("<I", hdr)
+                    resp = b""
+                    while len(resp) < n:
+                        resp += s.recv(n - len(resp))
+                    return _json.loads(resp)
+                finally:
+                    s.close()
+
+            ok = push_raw(wire.splice_id_auth(frame, 1, "hunter2"))
+            assert ok["ok"] is True and ok["accepted"] is True
+            bad = push_raw(wire.splice_id_auth(frame, 1, "wrong"))
+            assert bad["ok"] is False and "auth" in bad["error"]
+            # the authed frame landed; the rejected one did not dedupe
+            # it away
+            stream = srv.shuffle_worker().store._stages["qs"].streams[
+                (0, 0)
+            ]
+            assert stream.seqs[0].columns["k"].data.tolist() == [1, 2, 3]
+
+            # the EngineClient path (handshake-authed connection) also
+            # carries binary pushes
+            c = EngineClient("127.0.0.1", srv.port, secret="hunter2")
+            try:
+                eof = wire.encode_frame(
+                    "qs", 1, 1, 0, 0, 0, -1, None, schema, nseq=1
+                )
+                assert c.shuffle_push_encoded(eof) is True
+            finally:
+                c.close()
+        finally:
+            srv.shutdown()
+
+
+class TestVectorizedPartitioning:
+    def test_partition_parity_with_row_fallback_all_types(self):
+        """partition_block (vectorized, columnar) routes every row to
+        the SAME partition as partition_rows (the JSON fallback's
+        per-row loop) for every key type — mixed-codec producers in one
+        stage must colocate equal keys."""
+        blk, schema = _block(ALL_TYPES)
+        rows = block_to_rows(blk, schema)
+        for m in (1, 2, 3, 7):
+            for ki, (name, _t, _v) in enumerate(ALL_TYPES):
+                idxs = wire.partition_block(blk, name, m)
+                got = [[rows[i] for i in idx] for idx in idxs]
+                want = partition_rows(rows, ki, m)
+                assert got == want, (name, m)
+
+    def test_key_ints_match_key_to_int_on_presented_values(self):
+        blk, schema = _block(ALL_TYPES)
+        rows = block_to_rows(blk, schema)
+        for ki, (name, _t, _v) in enumerate(ALL_TYPES):
+            col = blk.columns[name]
+            ints = wire.column_key_ints(col)
+            for r in range(blk.nrows):
+                if not col.valid[r]:
+                    continue
+                assert int(ints[r]) == _key_to_int(rows[r][ki]), (
+                    name, r, rows[r][ki]
+                )
+
+    def test_float_negative_zero_colocates_with_zero(self):
+        col = column_from_values([0.0, -0.0, 1.0], FLOAT64)
+        ints = wire.column_key_ints(col)
+        assert ints[0] == ints[1]
+
+
+class TestColumnConcat:
+    def test_concat_unifies_string_dictionaries(self):
+        a = column_from_values(["x", "z", None], STRING)
+        b = column_from_values(["y", "x"], STRING)
+        out = concat_host_columns(STRING, [a, b])
+        assert out.dictionary.tolist() == ["x", "y", "z"]
+        assert out.decode().tolist() == ["x", "z", None, "y", "x"]
+        # codes are re-keyed: sorted dictionary order is preserved
+        assert sorted(out.dictionary.tolist()) == out.dictionary.tolist()
+
+    def test_concat_handles_empty_and_no_chunks(self):
+        empty = concat_host_columns(STRING, [])
+        assert len(empty) == 0 and empty.dictionary.tolist() == []
+        a = column_from_values([], STRING)
+        b = column_from_values(["q"], STRING)
+        out = concat_host_columns(STRING, [a, b])
+        assert out.decode().tolist() == ["q"]
+        ints = concat_host_columns(INT64, [])
+        assert len(ints) == 0 and ints.data.dtype == np.int64
+
+    def test_concat_numeric(self):
+        a = column_from_values([1, None], INT64)
+        b = column_from_values([3], INT64)
+        out = concat_host_columns(INT64, [a, b])
+        assert out.decode().tolist() == [1, None, 3]
+
+    def test_slice_take_roundtrip(self):
+        blk, schema = _block(ALL_TYPES)
+        rows = block_to_rows(blk, schema)
+        assert block_to_rows(slice_block(blk, 1, 3), schema) == rows[1:3]
+        assert block_to_rows(slice_block(blk, 4, 99), schema) == rows[4:]
+        idx = np.array([5, 0, 2])
+        assert block_to_rows(take_block(blk, idx), schema) == [
+            rows[5], rows[0], rows[2]
+        ]

@@ -171,6 +171,79 @@ class HostBlock:
         return HostBlock(columns, n)
 
 
+def take_block(block: HostBlock, idx: np.ndarray) -> HostBlock:
+    """Rows of a block selected by index array, column-wise (one
+    ``np.take`` per column — the vectorized partition split of the
+    shuffle producer; no Python row loop)."""
+    cols = {
+        n: HostColumn(c.type, c.data[idx], c.valid[idx], c.dictionary)
+        for n, c in block.columns.items()
+    }
+    return HostBlock(cols, len(idx))
+
+
+def slice_block(block: HostBlock, a: int, b: int) -> HostBlock:
+    """Contiguous row range [a, b) of a block as numpy views (packet
+    chunking on the shuffle send path — zero-copy)."""
+    b = min(b, block.nrows)
+    cols = {
+        n: HostColumn(c.type, c.data[a:b], c.valid[a:b], c.dictionary)
+        for n, c in block.columns.items()
+    }
+    return HostBlock(cols, max(b - a, 0))
+
+
+def concat_host_columns(typ: SQLType, chunks: List[HostColumn]) -> HostColumn:
+    """Concatenate column chunks into one HostColumn. For strings the
+    chunks' per-batch dictionaries are unified into ONE sorted
+    stage-local dictionary and every chunk's codes are re-keyed against
+    it — dictionary codes become comparable across senders and across
+    exchange sides (code order still == binary collation order), which
+    is what makes string join keys shuffle-safe (ROADMAP item c)."""
+    if typ.kind != Kind.STRING:
+        if not chunks:
+            return HostColumn(
+                typ,
+                np.zeros(0, dtype=typ.np_dtype),
+                np.zeros(0, dtype=bool),
+            )
+        data = np.concatenate(
+            [np.asarray(c.data, dtype=typ.np_dtype) for c in chunks]
+        )
+        valid = np.concatenate(
+            [np.asarray(c.valid, dtype=bool) for c in chunks]
+        )
+        return HostColumn(typ, data, valid)
+    vocab = set()
+    for c in chunks:
+        if c.dictionary is not None:
+            vocab.update(str(s) for s in c.dictionary.tolist())
+    unified = np.array(sorted(vocab), dtype=object)
+    lut = {v: i for i, v in enumerate(unified.tolist())}
+    datas, valids = [], []
+    for c in chunks:
+        valid = np.asarray(c.valid, dtype=bool)
+        if c.dictionary is not None and len(c.dictionary):
+            mapping = np.array(
+                [lut[str(v)] for v in c.dictionary.tolist()],
+                dtype=np.int32,
+            )
+            codes = mapping[
+                np.clip(np.asarray(c.data), 0, len(c.dictionary) - 1)
+            ]
+        else:
+            codes = np.zeros(len(c.data), dtype=np.int32)
+        datas.append(np.where(valid, codes, 0).astype(np.int32))
+        valids.append(valid)
+    data = (
+        np.concatenate(datas) if datas else np.zeros(0, dtype=np.int32)
+    )
+    valid = (
+        np.concatenate(valids) if valids else np.zeros(0, dtype=bool)
+    )
+    return HostColumn(typ, data, valid, unified)
+
+
 # ---------------------------------------------------------------------------
 # Device side
 # ---------------------------------------------------------------------------
@@ -231,6 +304,10 @@ def present_temporals(col: "HostColumn"):
         return col.decode()
     n = len(col.data)
     out = np.empty(n, dtype=object)
+    if n == 0:
+        # np.datetime_as_string rejects zero-size arrays; a 0-row
+        # shuffle partition legitimately presents an empty column
+        return out
     if k == Kind.DATE:
         out[:] = np.datetime_as_string(
             col.data.astype("datetime64[D]"), unit="D"
@@ -266,7 +343,13 @@ def materialize_rows(batch, schema_cols, dicts):
     user-facing seam — while decode() stays raw (day/micros ints) for
     internal consumers (oracles, dump, CDC diffing)."""
     types = {c.internal: c.type for c in schema_cols}
-    block = batch_to_block(batch, types, dicts)
+    return block_to_rows(batch_to_block(batch, types, dicts), schema_cols)
+
+
+def block_to_rows(block: HostBlock, schema_cols) -> List[tuple]:
+    """Host block -> presented python row tuples (the row half of
+    materialize_rows, reusable for blocks that never touched a device —
+    the shuffle producer's JSON fallback for mixed-version peers)."""
     internals = [c.internal for c in schema_cols]
     decoded = {
         i: present_temporals(block.columns[i]) for i in internals

@@ -308,11 +308,20 @@ class DCNFragmentScheduler:
         shuffle_wait_timeout_s: float = 120.0,
         shuffle_packet_rows: Optional[int] = None,
         shuffle_inflight_bytes: Optional[int] = None,
+        shuffle_codec: str = "binary",
     ):
         if not endpoints:
             raise ValueError("DCN scheduler needs at least one worker host")
         if shuffle_mode not in ("auto", "always", "never"):
             raise ValueError(f"bad shuffle_mode {shuffle_mode!r}")
+        if shuffle_codec not in ("binary", "json"):
+            raise ValueError(f"bad shuffle_codec {shuffle_codec!r}")
+        # exchange wire codec (PERF_NOTES "Shuffle wire format"):
+        # "binary" ships length-prefixed columnar frames built straight
+        # from HostColumn buffers (parallel/wire.py; tunnels still
+        # negotiate down per peer for mixed-version fleets); "json" is
+        # the row-packet escape hatch
+        self.shuffle_codec = shuffle_codec
         # worker-to-worker shuffle policy (PERF_NOTES "Shuffle vs
         # staging"): "auto" uses direct tunnels when coordinator
         # staging is unavailable (the single-host fallback lift) or
@@ -554,6 +563,7 @@ class DCNFragmentScheduler:
             "sid": sid, "qid": qid, "kind": sp.kind, "attempts": 0,
             "m": 0, "bytes_tunneled": 0, "rows_tunneled": 0,
             "local_rows": 0, "stalls": 0, "retransmits": 0,
+            "codec": self.shuffle_codec, "encode_s": 0.0,
         }
         last_err: Optional[str] = None
         for rnd in range(self.max_attempts):
@@ -594,6 +604,7 @@ class DCNFragmentScheduler:
                     "wait_timeout_s": self.shuffle_wait_timeout_s,
                     "packet_rows": self.shuffle_packet_rows,
                     "max_inflight_bytes": self.shuffle_inflight_bytes,
+                    "codec": self.shuffle_codec,
                     "trace": bool(self.tracer.enabled),
                 }
                 try:
@@ -655,6 +666,7 @@ class DCNFragmentScheduler:
                     stage["local_rows"] += f["local_rows"]
                     stage["stalls"] += f["stalls"]
                     stage["retransmits"] += f["retransmits"]
+                    stage["encode_s"] += f.get("encode_s", 0.0)
                 with self._lock:
                     self.last_query = {
                         "qid": qid, "fragments": infos,
@@ -701,6 +713,8 @@ class DCNFragmentScheduler:
             "local_rows": int(sh.get("local_rows", 0)),
             "stalls": int(sh.get("stalls", 0)),
             "retransmits": int(sh.get("retransmits", 0)),
+            "codec": sh.get("codec"),
+            "encode_s": float(sh.get("encode_s", 0.0)),
             "spans": spans,
         }
         with self._lock:

@@ -15,8 +15,13 @@ This module generalizes the intra-host ICI collectives
 `_mix_hash` finalizer) to the DCN tier so the two compose
 hierarchically: within a host, rows move over the device mesh's
 all_to_all; between hosts, the SAME hash (int keys run the identical
-64-bit mix) routes materialized row packets over engine-RPC tunnels
-(server/engine_rpc.py `shuffle_push` frames).
+64-bit mix) routes binary columnar frames (parallel/wire.py) over
+engine-RPC tunnels (server/engine_rpc.py `shuffle_push` frames). The
+producer hashes whole key COLUMNS as numpy arrays and np.takes each
+column by partition — HostColumn in, HostColumn out, no Python row
+tuples on the hot path; the JSON row-packet codec of PR 3 survives
+only as the mixed-version / `shuffle_codec=json` fallback
+(partition_rows + _send_stream below).
 
 Pieces, worker side:
 - ShuffleStore  — receiver state per (stage, attempt): packet streams
@@ -119,6 +124,30 @@ def _c_dups():
     return REGISTRY.counter(
         "tidbtpu_shuffle_duplicates_dropped",
         "duplicate-sequence packets dropped by the receiver dedupe",
+    )
+
+
+def _c_codec_bytes():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_codec_bytes",
+        "shuffle packet bytes encoded, by wire codec",
+        labels=("codec",),
+    )
+
+
+def _c_encode_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_encode_seconds",
+        "producer-side packet encode time, by wire codec",
+        labels=("codec",),
+    )
+
+
+def _c_decode_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_decode_seconds",
+        "receiver-side packet decode time, by wire codec",
+        labels=("codec",),
     )
 
 
@@ -289,26 +318,30 @@ class ShuffleStore:
         side: int,
         sender: int,
         seq: int,
-        rows: Optional[list],
+        payload,
         nseq: Optional[int] = None,
     ) -> bool:
         """Land one packet; returns False when fenced (stale attempt)
-        or deduped (duplicate seq). An EOF packet carries rows=None and
-        nseq=<total data packets in the stream>."""
+        or deduped (duplicate seq). `payload` is codec-shaped: a list
+        of row tuples (JSON packets) or a decoded columnar HostBlock
+        (binary frames) — the store buffers it opaquely and the
+        consumer normalizes at staging time, so one stream can even mix
+        codecs across senders (mixed-version peers). An EOF packet
+        carries payload=None and nseq=<total data packets>."""
         with self._cv:
             st = self._stage(sid, attempt, m)
             if st is None:
                 _c_stale().inc()
                 return False
             stream = st.streams.setdefault((side, sender), _Stream())
-            if rows is None:  # EOF marker — idempotent
+            if payload is None:  # EOF marker — idempotent
                 stream.nseq = int(nseq)
                 self._cv.notify_all()
                 return True
             if seq in stream.seqs:
                 _c_dups().inc()
                 return False
-            stream.seqs[int(seq)] = rows
+            stream.seqs[int(seq)] = payload
             self._cv.notify_all()
             return True
 
@@ -319,12 +352,13 @@ class ShuffleStore:
         n_sides: int,
         m: int,
         timeout_s: float,
-    ) -> Dict[int, List[tuple]]:
+    ) -> Dict[int, list]:
         """Block until every (side, sender) stream of the attempt is
-        complete; returns side -> rows ordered (sender, seq) — a
-        deterministic concatenation, so per-partition execution is
-        reproducible across retries. Raises ShuffleWaitTimeout with
-        the missing senders (the coordinator's death-suspect list)."""
+        complete; returns side -> payload chunks ordered (sender, seq)
+        — a deterministic concatenation order, so per-partition
+        execution is reproducible across retries. Raises
+        ShuffleWaitTimeout with the missing senders (the coordinator's
+        death-suspect list)."""
         inject("shuffle/wait")
         deadline = time.monotonic() + timeout_s
 
@@ -364,14 +398,14 @@ class ShuffleStore:
                 if pin is not None and self._stages.get(sid) is pin:
                     pin.waiters -= 1
             st = self._stages[sid]
-            out: Dict[int, List[tuple]] = {}
+            out: Dict[int, list] = {}
             for side in range(n_sides):
-                rows: List[tuple] = []
+                chunks: list = []
                 for sender in range(m):
                     stream = st.streams[(side, sender)]
                     for seq in range(stream.nseq):
-                        rows.extend(tuple(r) for r in stream.seqs[seq])
-                out[side] = rows
+                        chunks.append(stream.seqs[seq])
+                out[side] = chunks
             return out
 
 
@@ -424,10 +458,46 @@ class PeerTunnel:
         self._dead_fatal = False
         self._closing = False
         self._client = None
+        self._codec: Optional[str] = None
+        self._neg_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"shuffle-tx-{self.address}"
         )
         self._thread.start()
+
+    def negotiated_codec(self, preferred: str = "binary") -> str:
+        """The wire codec this tunnel may use: "binary" when the peer's
+        handshake advertises a compatible wire version, else "json"
+        (mixed-version peers keep interoperating through the row-packet
+        fallback). Negotiated once per tunnel over a throwaway ping
+        connection (the sender thread owns the data connection); an
+        unreachable peer answers `preferred` — the first real send will
+        surface the death through the normal suspect machinery."""
+        if preferred != "binary":
+            return "json"
+        with self._neg_lock:
+            if self._codec is None:
+                from tidb_tpu.parallel.wire import WIRE_VERSION
+                from tidb_tpu.server.engine_rpc import EngineClient
+
+                try:
+                    c = EngineClient(
+                        self.host, self.port, secret=self.secret,
+                        timeout_s=min(self.timeout_s, 10.0),
+                    )
+                    try:
+                        peer_wire = int(c._call({}).get("wire", 0))
+                    finally:
+                        c.close()
+                    # EXACT version match: decode_frame rejects any
+                    # other version, so a skewed peer must degrade to
+                    # the JSON fallback, not trade unreadable frames
+                    self._codec = (
+                        "binary" if peer_wire == WIRE_VERSION else "json"
+                    )
+                except Exception:
+                    self._codec = preferred
+            return self._codec
 
     # -- producer side -------------------------------------------------
     def send(self, packet, nbytes: int, nrows: int) -> None:
@@ -628,6 +698,48 @@ def stage_rows_as_batch(schema, rows: List[tuple], nonce: int):
     return L.Staged(schema, batch=batch, dicts=dicts, nonce=nonce)
 
 
+def stage_payloads_as_batch(schema, payloads: list, nonce: int):
+    """Received shuffle payload chunks -> a Staged device batch by
+    COLUMN CONCATENATION: binary frames arrive as decoded HostBlocks
+    whose columns concatenate directly (string dictionaries unified
+    into one sorted stage-local table, codes re-keyed — join keys
+    comparable across senders and sides); JSON row packets take the
+    column_from_values slow path per chunk. No per-row Python loop
+    touches columnar chunks."""
+    from tidb_tpu.chunk import (
+        HostBlock,
+        block_to_batch,
+        column_from_values,
+        concat_host_columns,
+        pad_capacity,
+    )
+    from tidb_tpu.planner import logical as L
+
+    per_col: Dict[str, list] = {oc.internal: [] for oc in schema.cols}
+    total = 0
+    for pl in payloads:
+        if isinstance(pl, HostBlock):
+            for oc in schema.cols:
+                per_col[oc.internal].append(pl.columns[oc.internal])
+            total += pl.nrows
+        else:  # JSON row packet — the declared fallback's row loop
+            for i, oc in enumerate(schema.cols):
+                per_col[oc.internal].append(
+                    column_from_values([r[i] for r in pl], oc.type)
+                )
+            total += len(pl)
+    cols = {}
+    dicts = {}
+    for oc in schema.cols:
+        hc = concat_host_columns(oc.type, per_col[oc.internal])
+        cols[oc.internal] = hc
+        if hc.dictionary is not None:
+            dicts[oc.internal] = hc.dictionary
+    block = HostBlock(cols, total)
+    batch = block_to_batch(block, pad_capacity(max(total, 1)))
+    return L.Staged(schema, batch=batch, dicts=dicts, nonce=nonce)
+
+
 class ShuffleWorker:
     """Executes one dispatched shuffle task on a worker host. One
     instance per EngineServer; holds the receive store (tunnel
@@ -678,6 +790,7 @@ class ShuffleWorker:
             spec.get("max_inflight_bytes") or DEFAULT_INFLIGHT_BYTES
         )
         wait_timeout = float(spec.get("wait_timeout_s") or 120.0)
+        codec = str(spec.get("codec") or "binary")
         ctx = f"q{spec.get('qid')}/p{part}"
 
         self.store.open(sid, attempt, m)
@@ -693,7 +806,7 @@ class ShuffleWorker:
         stats = {
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
             "stalls": 0, "retransmits": 0, "produced_rows": 0,
-            "per_peer": [],
+            "per_peer": [], "codec": codec, "encode_s": 0.0,
         }
         _nullspan = _NullSpan()
 
@@ -704,20 +817,46 @@ class ShuffleWorker:
             for side in spec["sides"]:
                 tag = int(side["tag"])
                 plan = plan_from_ir(side["plan"])
-                key_idx = [c.internal for c in plan.schema].index(
-                    side["key"]
-                )
+                schema_cols = list(plan.schema)
                 inject("shuffle/produce")
                 with span(f"{ctx}/produce#{tag}"), self._exec_lock:
                     batch, dicts = producer_exec.run(plan)
-                    rows = materialize_rows(batch, list(plan.schema), dicts)
-                stats["produced_rows"] += len(rows)
-                parts = partition_rows(rows, key_idx, m)
+                if codec == "json":
+                    # shuffle-json-fallback: the row-packet escape
+                    # hatch (shuffle_codec=json) materializes and
+                    # partitions Python rows, like PR 3
+                    with self._exec_lock:
+                        rows = materialize_rows(batch, schema_cols, dicts)
+                    key_idx = [c.internal for c in schema_cols].index(
+                        side["key"]
+                    )
+                    stats["produced_rows"] += len(rows)
+                    parts = partition_rows(rows, key_idx, m)
+                    with span(f"{ctx}/push#{tag}"):
+                        for dest, prows in enumerate(parts):
+                            self._send_stream(
+                                sid, attempt, m, tag, part, dest, prows,
+                                peers, secret, tunnels, packet_rows,
+                                inflight, stats,
+                            )
+                    continue
+                # binary hot path: keep the engine's own columnar
+                # layout end to end — hash the key COLUMN (bit-identical
+                # to exchange._mix_hash), np.take each column by
+                # partition, frame-encode straight from HostColumn
+                from tidb_tpu.chunk import batch_to_block, take_block
+                from tidb_tpu.parallel.wire import partition_block
+
+                types = {c.internal: c.type for c in schema_cols}
+                block = batch_to_block(batch, types, dicts)
+                stats["produced_rows"] += block.nrows
+                idxs = partition_block(block, side["key"], m)
                 with span(f"{ctx}/push#{tag}"):
-                    for dest, prows in enumerate(parts):
-                        self._send_stream(
-                            sid, attempt, m, tag, part, dest, prows,
-                            peers, secret, tunnels, packet_rows, inflight,
+                    for dest, idx in enumerate(idxs):
+                        self._ship_partition(
+                            sid, attempt, m, tag, part, dest,
+                            take_block(block, idx), schema_cols, peers,
+                            secret, tunnels, packet_rows, inflight,
                             stats,
                         )
             for t in tunnels.values():
@@ -772,7 +911,7 @@ class ShuffleWorker:
         consumer = plan_from_ir(spec["consumer"])
         reads = _shuffle_read_tags(consumer)
         staged = {
-            tag: stage_rows_as_batch(
+            tag: stage_payloads_as_batch(
                 node.schema, by_side.get(tag, []), next(self._nonce)
             )
             for tag, node in reads.items()
@@ -795,15 +934,10 @@ class ShuffleWorker:
             "shuffle": stats,
         }
 
-    def _send_stream(
-        self, sid, attempt, m, side, sender, dest, rows, peers, secret,
-        tunnels, packet_rows, inflight, stats,
-    ) -> None:
-        """Ship one (side, partition) stream: data packets seq 0..k-1
-        then the EOF marker. Self partitions land directly in the local
-        store (no tunnel, no DCN bytes)."""
-        local = dest == sender
-        if not local and dest not in tunnels:
+    def _tunnel_for(
+        self, dest, peers, sender, secret, tunnels, inflight
+    ) -> PeerTunnel:
+        if dest not in tunnels:
             host, port = peers[dest]
             # src labeled with THIS worker's dial address (peers[sender])
             # so tidbtpu_shuffle_bytes_total{src,dst} uses one identity
@@ -811,6 +945,78 @@ class ShuffleWorker:
             tunnels[dest] = PeerTunnel(
                 host, port, secret, src="%s:%s" % tuple(peers[sender]),
                 max_inflight_bytes=inflight,
+            )
+        return tunnels[dest]
+
+    def _ship_partition(
+        self, sid, attempt, m, side, sender, dest, block, schema_cols,
+        peers, secret, tunnels, packet_rows, inflight, stats,
+    ) -> None:
+        """Ship one columnar partition: binary frames seq 0..k-1 then
+        the EOF frame, each encoded ONCE here in the producer (the
+        encoded bytes size the flow-control window, cross the wire
+        verbatim after the tunnel's byte-level id/auth splice, and an
+        encoding error fails HERE as a non-retryable engine error, not
+        a fake peer death). Self partitions land the HostBlock in the
+        local store with NO serialization at all; a mixed-version peer
+        whose tunnel negotiates down gets the JSON row packets."""
+        from tidb_tpu.chunk import block_to_rows, slice_block
+        from tidb_tpu.parallel.wire import encode_frame
+
+        if dest == sender:
+            if block.nrows:
+                self.store.push(sid, attempt, m, side, sender, 0, block)
+                stats["local_rows"] += block.nrows
+            self.store.push(
+                sid, attempt, m, side, sender, -1, None,
+                nseq=1 if block.nrows else 0,
+            )
+            return
+        tun = self._tunnel_for(
+            dest, peers, secret=secret, sender=sender, tunnels=tunnels,
+            inflight=inflight,
+        )
+        if tun.negotiated_codec("binary") != "binary":
+            self._send_stream(
+                sid, attempt, m, side, sender, dest,
+                block_to_rows(block, schema_cols), peers, secret,
+                tunnels, packet_rows, inflight, stats,
+            )
+            return
+        nchunks = (block.nrows + packet_rows - 1) // packet_rows
+        for seq in range(nchunks):
+            sub = slice_block(
+                block, seq * packet_rows, (seq + 1) * packet_rows
+            )
+            t0 = time.perf_counter()
+            frame = encode_frame(
+                sid, attempt, m, side, sender, dest, seq, sub,
+                schema_cols,
+            )
+            dt = time.perf_counter() - t0
+            stats["encode_s"] += dt
+            _c_encode_seconds().labels(codec="binary").inc(dt)
+            _c_codec_bytes().labels(codec="binary").inc(len(frame))
+            tun.send(frame, len(frame), sub.nrows)
+        eof = encode_frame(
+            sid, attempt, m, side, sender, dest, -1, None, schema_cols,
+            nseq=nchunks,
+        )
+        tun.send(eof, len(eof), 0)
+
+    def _send_stream(
+        self, sid, attempt, m, side, sender, dest, rows, peers, secret,
+        tunnels, packet_rows, inflight, stats,
+    ) -> None:
+        """Ship one (side, partition) ROW stream — the JSON fallback
+        codec (shuffle_codec=json, or a peer that negotiated down):
+        data packets seq 0..k-1 then the EOF marker. Self partitions
+        land directly in the local store (no tunnel, no DCN bytes)."""
+        local = dest == sender
+        if not local:
+            self._tunnel_for(
+                dest, peers, secret=secret, sender=sender,
+                tunnels=tunnels, inflight=inflight,
             )
         chunks = [
             rows[a : a + packet_rows]
@@ -827,12 +1033,18 @@ class ShuffleWorker:
                 "sid": sid, "attempt": attempt, "m": m, "side": side,
                 "sender": sender, "part": dest, "seq": seq, "rows": chunk,
             }
-            # serialized ONCE, here in the producer: the encoded bytes
-            # size the flow-control window, cross the wire verbatim
-            # (EngineClient.shuffle_push_encoded splices id/auth at the
-            # byte level), and an unserializable value fails HERE as a
-            # non-retryable engine error, not a fake peer death
+            # shuffle-json-fallback: serialized ONCE, here in the
+            # producer — the bytes size the flow-control window and
+            # cross the wire verbatim (wire.splice_id_auth stamps
+            # id/auth at the byte level); an unserializable value fails
+            # HERE as a non-retryable engine error, not a fake peer
+            # death
+            t0 = time.perf_counter()
             payload = json.dumps({"shuffle_push": packet}).encode()
+            dt = time.perf_counter() - t0
+            stats["encode_s"] += dt
+            _c_encode_seconds().labels(codec="json").inc(dt)
+            _c_codec_bytes().labels(codec="json").inc(len(payload))
             tunnels[dest].send(payload, len(payload), len(chunk))
         if local:
             self.store.push(
@@ -844,6 +1056,7 @@ class ShuffleWorker:
                 "sender": sender, "part": dest, "seq": -1, "rows": None,
                 "nseq": len(chunks),
             }
+            # shuffle-json-fallback: the row-codec EOF marker
             payload = json.dumps({"shuffle_push": eof}).encode()
             tunnels[dest].send(payload, len(payload), 0)
 

@@ -387,9 +387,11 @@ def _find_shuffle_join(p: L.LogicalPlan):
 def _shuffle_key_of(expr, schema: Schema) -> Optional[str]:
     """The internal column a side can be hash-partitioned on, or None.
     Must be a bare column of the side's OUTPUT schema (the producer
-    hashes materialized rows) and not STRING-typed for join keys —
-    handled by the caller (string dictionary codes are per-batch, so
-    only the value itself, not the code, is stable across sides)."""
+    hashes whole key columns by VALUE — for strings via the dictionary
+    entries, never the per-batch codes, so both sides of a join route
+    equal keys identically; the receiver re-keys codes against a
+    stage-local unified dictionary, parallel/shuffle.py
+    stage_payloads_as_batch)."""
     if not isinstance(expr, ColumnRef):
         return None
     names = {c.internal for c in schema.cols}
@@ -460,16 +462,10 @@ def split_plan_shuffle(
         le, re_ = jp.equi_keys[0]
         lkey = _shuffle_key_of(le, jp.left.schema)
         rkey = _shuffle_key_of(re_, jp.right.schema)
-        string_key = any(
-            k is not None and k.type is not None
-            and k.type.kind == Kind.STRING
-            for k in (le, re_)
-            if isinstance(k, ColumnRef)
-        )
         lscan = _pick_frag_scan(jp.left, catalog)
         rscan = _pick_frag_scan(jp.right, catalog)
         if (
-            lkey is not None and rkey is not None and not string_key
+            lkey is not None and rkey is not None
             and lscan is not None and rscan is not None
         ):
             sides = [

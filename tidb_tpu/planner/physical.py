@@ -2592,7 +2592,9 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
         f"rows_tunneled={stage.get('rows_tunneled')} "
         f"local_rows={stage.get('local_rows')} "
         f"stalls={stage.get('stalls')} "
-        f"retransmits={stage.get('retransmits')}"
+        f"retransmits={stage.get('retransmits')} "
+        f"codec={stage.get('codec', 'json')} "
+        f"encode={float(stage.get('encode_s', 0.0))*1000:.2f}ms"
     )
     per_part = [
         (
@@ -2824,16 +2826,25 @@ def _align_key_fns(le: Expr, re_: Expr, ldicts: Dicts, rdicts: Dicts):
             re_.type.collation if re_.type is not None else None
         )
         _m, ll, lr = _coll.merge_rank_luts(ld, rd, coll)
-        lut_l, lut_r = jnp.asarray(ll), jnp.asarray(lr)
+        lut_l = jnp.asarray(np.asarray(ll, dtype=np.int32))
+        lut_r = jnp.asarray(np.asarray(lr, dtype=np.int32))
         lname, rname = le.name, re_.name
 
+        def _mapped(c: DevCol, lut) -> DevCol:
+            if lut.shape[0] == 0:
+                # an EMPTY dictionary (a 0-row shuffle partition's
+                # staged side): no valid rows exist, so any constant
+                # key works — never index into a size-0 LUT
+                return DevCol(
+                    jnp.zeros(c.data.shape, dtype=jnp.int32), c.valid
+                )
+            return DevCol(lut[jnp.clip(c.data, 0, lut.shape[0] - 1)], c.valid)
+
         def lf(b: Batch) -> DevCol:
-            c = b.cols[lname]
-            return DevCol(lut_l[jnp.clip(c.data, 0, lut_l.shape[0] - 1)], c.valid)
+            return _mapped(b.cols[lname], lut_l)
 
         def rf(b: Batch) -> DevCol:
-            c = b.cols[rname]
-            return DevCol(lut_r[jnp.clip(c.data, 0, lut_r.shape[0] - 1)], c.valid)
+            return _mapped(b.cols[rname], lut_r)
 
         return lf, rf
     lfn = compile_expr(le, ldicts)

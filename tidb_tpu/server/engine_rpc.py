@@ -7,10 +7,17 @@ the whole SQL stack runs against that seam with an in-process loopback
 (`RPCClient.SendRequest`, pkg/store/mockstore/unistore/rpc.go:64).
 
 Here: EngineServer owns the catalog + device engine and serves
-length-prefixed JSON frames over TCP; EngineClient serializes a bound
+length-prefixed frames over TCP; EngineClient serializes a bound
 logical plan with planner/ir.py and gets rows back. A frontend process
 with no data of its own can plan SQL and execute it on a separate
 engine process — the multi-host frontend/engine split.
+
+Two frame types share the stream, discriminated by the first payload
+byte: JSON control/plan frames (first byte ``{``) and binary columnar
+shuffle frames (parallel/wire.py MAGIC) — the shuffle data plane skips
+json.dumps/json.loads entirely. The handshake/ping reply advertises
+the server's wire version so peer tunnels negotiate the codec per
+connection; JSON row packets remain the mixed-version fallback.
 
 Protocol safety: every request carries a correlation id echoed in the
 response (a desynced stream is detected, the connection is poisoned
@@ -29,6 +36,7 @@ import threading
 import time as _time
 from typing import List, Optional, Tuple
 
+from tidb_tpu.parallel import wire
 from tidb_tpu.planner.ir import IR_VERSION, plan_from_ir, plan_to_ir
 
 #: hard frame cap — a bogus length header must not buffer gigabytes
@@ -132,7 +140,38 @@ class EngineServer:
                         return
                     req_id = None
                     try:
+                        if wire.is_binary_frame(frame):
+                            # binary columnar shuffle frame: the data
+                            # plane never round-trips through JSON
+                            req_id = wire.peek_request_id(frame)
+                            if not authed:
+                                import hmac
+
+                                try:
+                                    frame_auth = wire.peek_auth(frame)
+                                except wire.WireFormatError:
+                                    frame_auth = None
+                                if not hmac.compare_digest(
+                                    str(frame_auth or ""), outer.secret
+                                ):
+                                    _send_frame(
+                                        self.request,
+                                        json.dumps(
+                                            {
+                                                "id": req_id, "ok": False,
+                                                "error":
+                                                    "authentication failed",
+                                            }
+                                        ).encode(),
+                                    )
+                                    return
+                                authed = True
+                            resp = outer._shuffle_push_binary(frame)
+                            _send_frame(self.request, resp)
+                            continue
+                        t_dec0 = _time.perf_counter()
                         req = json.loads(frame.decode())
+                        dec_s = _time.perf_counter() - t_dec0
                         req_id = req.get("id")
                         if not authed:
                             import hmac
@@ -152,17 +191,24 @@ class EngineServer:
                                 return
                             authed = True
                         if "shuffle_push" in req:
-                            # peer tunnel frame: a worker pushing one
-                            # hash partition packet of its fragment
-                            resp = outer._shuffle_push(req)
+                            # peer tunnel frame (JSON fallback codec):
+                            # a worker pushing one hash partition row
+                            # packet of its fragment
+                            resp = outer._shuffle_push(req, dec_s)
                         elif "shuffle_task" in req:
                             resp = outer._shuffle_task(req)
                         elif "plan" not in req:
                             # handshake/ping frame — fine whether or not
                             # this server requires a secret (a secreted
-                            # client must interoperate with an open server)
+                            # client must interoperate with an open
+                            # server). Advertises the binary shuffle
+                            # wire version for per-tunnel codec
+                            # negotiation.
                             resp = json.dumps(
-                                {"id": req_id, "ok": True}
+                                {
+                                    "id": req_id, "ok": True,
+                                    "wire": wire.WIRE_VERSION,
+                                }
                             ).encode()
                         else:
                             resp = outer._execute(executor, req)
@@ -306,12 +352,14 @@ class EngineServer:
                 )
             return self._shuffle
 
-    def _shuffle_push(self, req) -> bytes:
-        """A peer worker's tunnel packet: land it in the local store
-        (attempt-fenced, seq-deduped) and ack."""
+    def _shuffle_push(self, req, decode_s: float = 0.0) -> bytes:
+        """A peer worker's JSON-fallback tunnel packet: land the rows
+        in the local store (attempt-fenced, seq-deduped) and ack."""
+        from tidb_tpu.parallel.shuffle import _c_decode_seconds
         from tidb_tpu.utils.failpoint import inject
 
         inject("shuffle/recv")
+        _c_decode_seconds().labels(codec="json").inc(decode_s)
         p = req["shuffle_push"]
         accepted = self.shuffle_worker().store.push(
             p["sid"], int(p["attempt"]), int(p["m"]), int(p["side"]),
@@ -322,8 +370,50 @@ class EngineServer:
             # packet stored, ack lost: the sender retransmits and the
             # seq dedupe drops the duplicate — exactly-once on the wire
             raise DropConnection()
+        # shuffle-json-fallback: the tiny control-plane ack stays JSON
         return json.dumps(
             {"id": req.get("id"), "ok": True, "accepted": bool(accepted)}
+        ).encode()
+
+    def _shuffle_push_binary(self, frame: bytes) -> bytes:
+        """A peer worker's binary columnar tunnel frame: decode the
+        per-column buffers into a HostBlock and land it in the local
+        store. A frame that fails to decode (corruption, version skew
+        inside a negotiated stream — the shuffle/decode failpoint
+        injects both) is REJECTED with an error reply over the live
+        connection: the sender surfaces it as a non-retryable engine
+        error, so a corrupt frame aborts the stage instead of
+        masquerading as a peer death and triggering a pointless
+        stage retry."""
+        from tidb_tpu.parallel.shuffle import _c_decode_seconds
+        from tidb_tpu.utils.failpoint import inject
+
+        inject("shuffle/recv")
+        t0 = _time.perf_counter()
+        try:
+            inject("shuffle/decode")
+            pkt = wire.decode_frame(frame)
+        except Exception as e:
+            # shuffle-json-fallback: the error REPLY is control-plane
+            return json.dumps(
+                {
+                    "id": wire.peek_request_id(frame), "ok": False,
+                    "error": f"ShuffleDecodeError: {e}",
+                }
+            ).encode()
+        _c_decode_seconds().labels(codec="binary").inc(
+            _time.perf_counter() - t0
+        )
+        payload = pkt["block"]
+        accepted = self.shuffle_worker().store.push(
+            pkt["sid"], pkt["attempt"], pkt["m"], pkt["side"],
+            pkt["sender"], pkt["seq"], payload, nseq=pkt["nseq"],
+        )
+        if inject("shuffle/recv-ack-lost"):
+            raise DropConnection()
+        # shuffle-json-fallback: the tiny control-plane ack stays JSON
+        return json.dumps(
+            {"id": pkt["id"], "ok": True, "accepted": bool(accepted)}
         ).encode()
 
     def _shuffle_task(self, req) -> bytes:
@@ -484,19 +574,19 @@ class EngineClient:
         return bool(resp.get("accepted"))
 
     def shuffle_push_encoded(self, payload: bytes) -> bool:
-        """shuffle_push over a PRE-ENCODED `{"shuffle_push": {...}}`
-        object: the data plane serializes each row packet exactly once
-        (at enqueue, where the flow-control window is sized) and the
-        correlation id / auth are spliced in at the byte level instead
-        of re-encoding the rows on the tunnel thread."""
+        """shuffle_push over a PRE-ENCODED packet — a binary columnar
+        frame (parallel/wire.py) or a `{"shuffle_push": {...}}` JSON
+        object: the data plane serializes each packet exactly once (at
+        enqueue, where the flow-control window is sized) and the
+        correlation id / auth are spliced in at the byte level by the
+        shared wire.splice_id_auth helper instead of re-encoding the
+        rows on the tunnel thread."""
         if self._dead:
             raise ConnectionError("engine connection is poisoned; reconnect")
         self._next_id += 1
-        head = b'{"id":%d' % self._next_id
-        if self._secret is not None:
-            head += b',"auth":' + json.dumps(self._secret).encode()
-        # payload is a non-empty JSON object: "{...}" -> splice after "{"
-        resp = self._roundtrip(head + b"," + payload[1:])
+        resp = self._roundtrip(
+            wire.splice_id_auth(payload, self._next_id, self._secret)
+        )
         if not resp.get("ok"):
             raise RuntimeError(
                 f"shuffle push rejected: {resp.get('error', '')}"

@@ -84,6 +84,7 @@ SITES = frozenset({
     "sequence/nextval",
     "server/dispatch-query",
     "shuffle/consume",
+    "shuffle/decode",
     "shuffle/open",
     "shuffle/produce",
     "shuffle/push",
