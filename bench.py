@@ -820,12 +820,18 @@ def measure_multihost_shuffle(args) -> int:
                 v for n, _k, v in REGISTRY.rows() if n.startswith(prefix)
             )
 
-        def run_mode(mode, codec="binary"):
+        def run_mode(mode, codec="binary", pipeline=True):
             sched = DCNFragmentScheduler(
                 [("127.0.0.1", pt) for pt in ports],
                 catalog=cat, shuffle_mode=mode, shuffle_codec=codec,
+                shuffle_pipeline=pipeline,
             )
             try:
+                # one untimed warmup: the workers' persistent executors
+                # pay the producer/consumer XLA compile here, so the
+                # timed repeats (and the mode/pipeline A/Bs) compare
+                # steady-state data-plane behavior, not compile order
+                sched.execute_plan(plan)
                 before = {
                     p: _reg_total(p)
                     for p in (
@@ -833,10 +839,13 @@ def measure_multihost_shuffle(args) -> int:
                         "tidbtpu_shuffle_bytes_total",
                         "tidbtpu_shuffle_encode_seconds",
                         "tidbtpu_shuffle_decode_seconds",
+                        "tidbtpu_shuffle_wait_idle_seconds",
                     )
                 }
                 times, rows = [], []
                 rows_tunneled = 0
+                ttff = 0.0
+                stage_walls = []
                 for _ in range(max(args.repeat, 1)):
                     t0 = time.perf_counter()
                     _cols, out = sched.execute_plan(plan)
@@ -845,17 +854,33 @@ def measure_multihost_shuffle(args) -> int:
                     if mode != "never":
                         # summed across repeats — the byte counters
                         # below accumulate across repeats too
-                        rows_tunneled += (sched.last_query or {}).get(
-                            "shuffle", {}
-                        ).get("rows_tunneled", 0)
+                        lq = sched.last_query or {}
+                        sh = lq.get("shuffle", {})
+                        rows_tunneled += sh.get("rows_tunneled", 0)
+                        ttff = max(ttff, sh.get("ttff_s", 0.0))
+                        # the shuffle STAGE wall-clock: the slowest
+                        # partition's produce+push+wait+stage+consume
+                        # on the workers (excludes dispatch RPC and the
+                        # coordinator's final merge, identical in both
+                        # pipeline modes)
+                        stage_walls.append(max(
+                            (f.get("exec_s", 0.0)
+                             for f in lq.get("fragments", [])),
+                            default=0.0,
+                        ))
                 delta = {
                     p: _reg_total(p) - v0 for p, v0 in before.items()
                 }
                 tunneled = delta["tidbtpu_shuffle_bytes_total"]
                 return {
                     "seconds": statistics.median(times),
+                    "stage_seconds": (
+                        statistics.median(stage_walls)
+                        if stage_walls else None
+                    ),
                     "rows": len(rows),
                     "codec": codec if mode != "never" else None,
+                    "pipeline": pipeline if mode != "never" else None,
                     "bytes_over_coordinator":
                         delta["tidbtpu_dcn_bytes_staged"],
                     "bytes_over_tunnels": tunneled,
@@ -873,18 +898,120 @@ def measure_multihost_shuffle(args) -> int:
                     "decode_seconds": round(
                         delta["tidbtpu_shuffle_decode_seconds"], 6
                     ),
+                    "wait_idle_seconds": round(
+                        delta["tidbtpu_shuffle_wait_idle_seconds"], 6
+                    ),
+                    "time_to_first_frame_seconds": round(ttff, 6),
+                    "rows_tunneled": rows_tunneled,
                     "result": rows,
                 }
             finally:
                 sched.close()
 
         staged = run_mode("never")
-        tunnel = run_mode("always")                       # binary codec
+        tunnel = run_mode("always")                  # binary, pipelined
+        barrier = run_mode("always", pipeline=False)  # pipeline A/B ref
         tunnel_json = run_mode("always", codec="json")    # A/B reference
+
+        def run_pipeline_pairs(pairs):
+            """Interleaved pipelined/barrier timing pairs on two live
+            schedulers: block-sequential A/B timing is dominated by
+            system drift at this stage scale (~10^-1 s); alternating
+            runs sample the same machine state for both modes."""
+            scheds = {
+                mode: DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="always",
+                    shuffle_pipeline=(mode == "pipelined"),
+                )
+                for mode in ("pipelined", "barrier")
+            }
+            out = {
+                mode: {"wall": [], "stage": [], "idle": 0.0, "ttff": 0.0}
+                for mode in scheds
+            }
+            try:
+                for sched in scheds.values():  # warm both
+                    sched.execute_plan(plan)
+                for _ in range(pairs):
+                    for mode, sched in scheds.items():
+                        t0 = time.perf_counter()
+                        _cols, res = sched.execute_plan(plan)
+                        out[mode]["wall"].append(
+                            time.perf_counter() - t0
+                        )
+                        assert res == staged["result"], (
+                            f"pipeline A/B parity broke ({mode})"
+                        )
+                        lq = sched.last_query or {}
+                        sh = lq.get("shuffle", {})
+                        out[mode]["stage"].append(max(
+                            (f.get("exec_s", 0.0)
+                             for f in lq.get("fragments", [])),
+                            default=0.0,
+                        ))
+                        out[mode]["idle"] += sh.get("wait_idle_s", 0.0)
+                        out[mode]["ttff"] = max(
+                            out[mode]["ttff"], sh.get("ttff_s", 0.0)
+                        )
+            finally:
+                for sched in scheds.values():
+                    sched.close()
+            return out
+
+        ab = run_pipeline_pairs(pairs=max(args.repeat, 5))
         assert tunnel["result"] == staged["result"], "mode parity broke"
         assert tunnel_json["result"] == staged["result"], (
             "codec parity broke"
         )
+        assert barrier["result"] == staged["result"], (
+            "pipeline parity broke"
+        )
+        # pipelined vs barrier A/B (PERF_NOTES "Shuffle pipelining"):
+        # same query, same codec, same workers — only the stage shape
+        # differs (overlapped produce/push/decode/stage vs the four
+        # sequential phases). Row counts must match exactly; tunnel
+        # bytes track closely (chunked frames re-prune dictionaries
+        # per chunk, so a small delta is framing overhead, not data).
+        assert barrier["rows_tunneled"] == tunnel["rows_tunneled"], (
+            "pipeline row parity broke"
+        )
+        pipe, barr = ab["pipelined"], ab["barrier"]
+        pipeline_ab = {
+            # stage wall-clock (the slowest worker partition's whole
+            # produce->push->wait->stage->consume): what pipelining
+            # actually restructures — end-to-end seconds additionally
+            # carry the dispatch RPC + coordinator final merge common
+            # to both modes. Medians over interleaved pairs.
+            "pairs": len(pipe["wall"]),
+            "stage_seconds_pipelined": round(
+                statistics.median(pipe["stage"]), 6
+            ),
+            "stage_seconds_barrier": round(
+                statistics.median(barr["stage"]), 6
+            ),
+            "stage_speedup": round(
+                statistics.median(barr["stage"])
+                / max(statistics.median(pipe["stage"]), 1e-9), 4
+            ),
+            "seconds_pipelined": round(
+                statistics.median(pipe["wall"]), 6
+            ),
+            "seconds_barrier": round(
+                statistics.median(barr["wall"]), 6
+            ),
+            "speedup": round(
+                statistics.median(barr["wall"])
+                / max(statistics.median(pipe["wall"]), 1e-9), 4
+            ),
+            "wait_idle_pipelined_s": round(pipe["idle"], 6),
+            "wait_idle_barrier_s": round(barr["idle"], 6),
+            "ttff_pipelined_s": round(pipe["ttff"], 6),
+            "ttff_barrier_s": round(barr["ttff"], 6),
+            "rows_tunneled": tunnel["rows_tunneled"],
+            "bytes_pipelined": tunnel["bytes_over_tunnels"],
+            "bytes_barrier": barrier["bytes_over_tunnels"],
+        }
         codec_ab = {
             "bytes_binary": tunnel["bytes_over_tunnels"],
             "bytes_json": tunnel_json["bytes_over_tunnels"],
@@ -918,10 +1045,14 @@ def measure_multihost_shuffle(args) -> int:
                 "tunneled": {
                     k: v for k, v in tunnel.items() if k != "result"
                 },
+                "tunneled_barrier": {
+                    k: v for k, v in barrier.items() if k != "result"
+                },
                 "tunneled_json": {
                     k: v for k, v in tunnel_json.items() if k != "result"
                 },
                 "codec_ab": codec_ab,
+                "pipeline_ab": pipeline_ab,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",

@@ -35,16 +35,19 @@ MARKER = "shuffle-json-fallback"
 #: bodies must not call json.dumps/json.loads without the marker
 HOTPATH = {
     os.path.join("tidb_tpu", "parallel", "wire.py"): {
-        "encode_frame", "decode_frame", "splice_id_auth",
-        "column_key_ints", "partition_block",
+        "encode_frame", "decode_frame", "decode_header",
+        "splice_id_auth", "column_key_ints", "partition_map",
+        "partition_block",
     },
     os.path.join("tidb_tpu", "parallel", "shuffle.py"): {
         "partition_rows",
         "stage_rows_as_batch", "stage_payloads_as_batch",
-        "ShuffleStore.push", "ShuffleStore.wait",
+        "stage_payloads_incremental",
+        "ShuffleStore.push", "ShuffleStore.admits",
+        "ShuffleStore.wait", "ShuffleStore.wait_side",
         "PeerTunnel.send", "PeerTunnel._loop",
-        "ShuffleWorker.run_task", "ShuffleWorker._ship_partition",
-        "ShuffleWorker._send_stream",
+        "ShuffleWorker.run_task", "ShuffleWorker._ship_side_stream",
+        "ShuffleWorker._ship_partition", "ShuffleWorker._send_stream",
     },
     os.path.join("tidb_tpu", "server", "engine_rpc.py"): {
         "EngineServer._shuffle_push", "EngineServer._shuffle_push_binary",
@@ -52,6 +55,58 @@ HOTPATH = {
     },
     os.path.join("tidb_tpu", "chunk.py"): {
         "concat_host_columns", "take_block", "slice_block",
+        "batch_from_padded",
+    },
+}
+
+#: pipeline-shape guard: function qualname -> {banned callee name:
+#: why}. The pipelined stage must not silently regress to the barrier
+#: shape — the producer's binary path must never materialize the whole
+#: stage as Python rows, and nothing after ShuffleStore waits may bulk-
+#: decode frames or re-grow the concat-then-pad double copy (frames
+#: decode ON ARRIVAL in the push handler; incremental staging writes
+#: each output column once). Unlike the JSON rule there is no marker
+#: escape: these calls are wrong on these paths, period.
+BANNED = {
+    os.path.join("tidb_tpu", "parallel", "shuffle.py"): {
+        "ShuffleWorker._ship_side_stream": {
+            "materialize_rows":
+                "whole-stage row materialization on the binary "
+                "produce path (ship chunk-granularly; block_to_rows "
+                "per packet chunk is the declared mixed-codec "
+                "fallback)",
+        },
+        "ShuffleWorker._ship_partition": {
+            "materialize_rows":
+                "whole-stage row materialization on the binary "
+                "produce path",
+        },
+        "ShuffleWorker.run_task": {
+            "decode_frame":
+                "post-wait bulk decode — binary frames decode on "
+                "arrival in the shuffle_push handler",
+        },
+        "ShuffleStore.wait": {
+            "decode_frame":
+                "post-wait bulk decode — frames decode on arrival",
+        },
+        "ShuffleStore.wait_side": {
+            "decode_frame":
+                "post-wait bulk decode — frames decode on arrival",
+        },
+        "stage_payloads_incremental": {
+            "decode_frame":
+                "staging must consume already-decoded blocks",
+            "concat_host_columns":
+                "concat-then-pad double copy — write each column once "
+                "into capacity-sized buffers",
+            "concatenate":
+                "np.concatenate re-grows the staging double copy — "
+                "write each column once into capacity-sized buffers",
+            "block_to_batch":
+                "block_to_batch re-pads (a second full copy) — use "
+                "batch_from_padded over capacity-sized buffers",
+        },
     },
 }
 
@@ -100,6 +155,45 @@ def _json_calls(tree: ast.AST, wanted: set):
     return out
 
 
+def _banned_calls(tree: ast.AST, banned_map: dict):
+    """Yield (qualname, lineno, callee, why) for every call to a banned
+    function inside a guarded function body (nested defs included)."""
+    out = []
+
+    def callee_name(f):
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        return None
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                walk(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.Call):
+                name = callee_name(child.func)
+                if name is not None:
+                    for qual, banned in banned_map.items():
+                        parts = qual.split(".")
+                        inside = any(
+                            stack[i : i + len(parts)] == parts
+                            for i in range(len(stack))
+                        )
+                        if inside and name in banned:
+                            out.append(
+                                (qual, child.lineno, name, banned[name])
+                            )
+                            break
+            walk(child, stack)
+
+    walk(tree, [])
+    return out
+
+
 def check(root: str):
     violations = []
     for rel, wanted in sorted(HOTPATH.items()):
@@ -125,6 +219,17 @@ def check(root: str):
                     f"{qual!r} without a '{MARKER}' marker — exchange "
                     "data must ride the binary columnar codec "
                     "(parallel/wire.py)",
+                )
+            )
+        for qual, lineno, callee, why in _banned_calls(
+            tree, BANNED.get(rel, {})
+        ):
+            violations.append(
+                (
+                    rel, lineno,
+                    f"{callee}() in {qual!r}: {why} — the pipelined "
+                    "shuffle stage must not regress to the barrier "
+                    "shape",
                 )
             )
     return violations

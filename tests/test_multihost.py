@@ -363,13 +363,16 @@ def _shuffle_codec_ab_body(tpch_single, p1, p2):
 
 
 def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
-    """Failpoint-killed worker MID-SHUFFLE: worker 2 hard-exits on the
-    first partition packet a peer pushes to it (the shuffle/recv site).
+    """Failpoint-killed worker MID-SHUFFLE with PIPELINING ON: worker 2
+    hard-exits on the first partition packet a peer pushes to it (the
+    shuffle/recv site), mid-way through the survivor's chunk-granular
+    pipelined push with frames already decoded-on-arrival on both ends.
     Worker 1's tunnel reports the dead peer, the coordinator verifies
     and quarantines it, re-runs the WHOLE stage on the survivor set
     (attempt 2, m=1 — upstream partitions re-shuffled to the
-    survivors), and the rerun still matches the reference exactly
-    once."""
+    survivors), the dead attempt's partially-decoded stage is fenced
+    out by the attempt bump, and the rerun still matches the reference
+    exactly once."""
     from tidb_tpu.parallel.dcn import DCNFragmentScheduler
     from tidb_tpu.server.engine_pool import FailedEngineProber
 
@@ -381,6 +384,7 @@ def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
         [("127.0.0.1", p1), ("127.0.0.1", p2)],
         catalog=tpch_single.catalog,
         shuffle_mode="always",
+        shuffle_pipeline=True,  # explicit: retry parity WITH overlap
         shuffle_wait_timeout_s=20.0,
         prober=FailedEngineProber(initial_backoff_s=60),
     )
@@ -389,9 +393,10 @@ def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
         exp = tpch_single.must_query(q).rows
         _cols, got = sched.execute_plan(_plan(tpch_single, q))
         assert got == exp, f"\n got={got}\n exp={exp}"
-        # the stage really retried on the survivor set
+        # the stage really retried on the survivor set, pipelined
         assert sched.last_query["shuffle"]["attempts"] >= 2
         assert sched.last_query["shuffle"]["m"] == 1
+        assert sched.last_query["shuffle"]["pipeline"] is True
         assert [e.port for e in sched.prober.failed_endpoints()] == [p2]
         w2.wait(timeout=30)
         assert w2.returncode == 3

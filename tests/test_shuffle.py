@@ -8,6 +8,7 @@ protocol, no cluster); the true 2-process x 4-device dryruns live in
 test_multihost.py.
 """
 
+import re
 import threading
 import time
 
@@ -362,6 +363,30 @@ class TestShuffleScheduler:
                 assert got == exp, f"{q}\n got={got}\n exp={exp}"
             last = sched.last_query
             assert last["shuffle"]["m"] == 2
+            # pipelined by default: the stage reports the overlap stats
+            assert last["shuffle"]["pipeline"] is True
+            assert last["shuffle"]["wait_idle_s"] >= 0.0
+            assert last["shuffle"]["ttff_s"] > 0.0
+        finally:
+            sched.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_parity_pipeline_off_barrier_mode(self, sess):
+        """The pipeline=off escape hatch (like shuffle_codec=json):
+        four sequential phases, same rows."""
+        servers = _servers(sess)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog, shuffle_mode="always",
+            shuffle_pipeline=False,
+        )
+        try:
+            for q in PARITY_QUERIES:
+                exp = sess.must_query(q).rows
+                _cols, got = sched.execute_plan(_plan(sess, q))
+                assert got == exp, f"{q}\n got={got}\n exp={exp}"
+            assert sched.last_query["shuffle"]["pipeline"] is False
         finally:
             sched.close()
             for s in servers:
@@ -432,6 +457,10 @@ class TestShuffleScheduler:
             ]
             assert len(ex) == 2
             assert "bytes_tunneled=" in text
+            # the pipelining telemetry renders on the summary row
+            assert "pipeline=on" in text
+            assert re.search(r"overlap=\d+%", text)
+            assert "wait_idle=" in text and "ttff=" in text
         finally:
             sched.close()
             for s in servers:
@@ -597,11 +626,324 @@ class TestBinaryCodec:
                 exp = sess.must_query(q).rows
                 _cols, got = sched.execute_plan(_plan(sess, q))
                 assert got == exp, f"{q}\n{got}\n{exp}"
+            # the mixed-codec stage ran through the PIPELINED path:
+            # JSON row packets from the legacy peer and binary frames
+            # stage together in incremental mode
+            assert sched.last_query["shuffle"]["pipeline"] is True
         finally:
             shuffle_mod.PeerTunnel.negotiated_codec = orig
             sched.close()
             for s_ in servers:
                 s_.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pipelined shuffle: fences before decode, per-side waits, incremental
+# staging, barrier escape hatch
+# ---------------------------------------------------------------------------
+
+
+def _binary_frame(sid, seq, vals, attempt=1, m=1, side=0, sender=0,
+                  nseq=None):
+    from tidb_tpu.chunk import HostBlock, column_from_values
+    from tidb_tpu.dtypes import INT64
+    from tidb_tpu.parallel import wire
+    from tidb_tpu.planner.logical import OutCol
+
+    schema = [OutCol(None, "k", "k", INT64)]
+    if vals is None:
+        return wire.encode_frame(
+            sid, attempt, m, side, sender, 0, -1, None, schema, nseq=nseq
+        )
+    blk = HostBlock({"k": column_from_values(vals, INT64)}, len(vals))
+    return wire.encode_frame(
+        sid, attempt, m, side, sender, 0, seq, blk, schema
+    )
+
+
+class TestFenceBeforeDecode:
+    """Satellite: eager on-arrival decode vs the exactly-once fences —
+    stale/duplicate binary frames are dropped from the HEADER, before
+    any decode work is spent, and can never double-stage."""
+
+    def test_stale_attempt_fenced_without_decode(self, sess):
+        """With shuffle/decode armed to explode on ANY decode attempt,
+        a stale-attempt frame still acks accepted=False cleanly: the
+        header fence dropped it before decode."""
+        from tidb_tpu.server.engine_rpc import EngineClient, EngineServer
+
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        srv.shuffle_worker().store.open("qfence", 2, 1)
+        stale0 = REGISTRY.counter("tidbtpu_shuffle_stale_dropped").value
+        failpoint.enable(
+            "shuffle/decode", ValueError("failpoint: decode reached")
+        )
+        c = EngineClient("127.0.0.1", srv.port)
+        try:
+            frame = _binary_frame("qfence", 0, [1, 2], attempt=1)
+            assert c.shuffle_push_encoded(frame) is False
+        finally:
+            failpoint.disable("shuffle/decode")
+            c.close()
+            srv.shutdown()
+        assert (
+            REGISTRY.counter("tidbtpu_shuffle_stale_dropped").value
+            >= stale0 + 1
+        )
+
+    def test_duplicate_binary_frame_skips_decode_and_never_double_stages(
+        self, sess
+    ):
+        from tidb_tpu.server.engine_rpc import EngineClient, EngineServer
+
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        dup0 = REGISTRY.counter(
+            "tidbtpu_shuffle_duplicates_dropped"
+        ).value
+        c = EngineClient("127.0.0.1", srv.port)
+        try:
+            frame = _binary_frame("qdup", 0, [7, 8])
+            assert c.shuffle_push_encoded(frame) is True
+            # the retransmit arrives with decode poisoned: the header
+            # dedupe must reject it BEFORE decode, without an error
+            failpoint.enable(
+                "shuffle/decode", ValueError("failpoint: decode reached")
+            )
+            assert c.shuffle_push_encoded(frame) is False
+            failpoint.disable("shuffle/decode")
+            stream = srv.shuffle_worker().store._stages["qdup"].streams[
+                (0, 0)
+            ]
+            assert list(stream.seqs) == [0]  # landed exactly once
+            assert stream.seqs[0].columns["k"].data.tolist() == [7, 8]
+        finally:
+            failpoint.disable("shuffle/decode")
+            c.close()
+            srv.shutdown()
+        assert (
+            REGISTRY.counter("tidbtpu_shuffle_duplicates_dropped").value
+            >= dup0 + 1
+        )
+
+    def test_binary_ack_loss_retransmit_lands_exactly_once(self, sess):
+        """The binary-frame twin of the JSON ack-loss test: stored,
+        ack dropped, tunnel retransmits, header dedupe drops the copy."""
+        from tidb_tpu.server.engine_rpc import EngineServer
+
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        failpoint.enable(
+            "shuffle/recv-ack-lost", failpoint.after_n(1, True)
+        )
+        tun = PeerTunnel("127.0.0.1", srv.port, None, src="test")
+        try:
+            frame = _binary_frame("qbrt", 0, [42, 43])
+            tun.send(frame, len(frame), 2)
+            eof = _binary_frame("qbrt", -1, None, nseq=1)
+            tun.send(eof, len(eof), 0)
+            tun.flush()
+        finally:
+            tun.close()
+            failpoint.disable("shuffle/recv-ack-lost")
+        assert tun.retransmits >= 1
+        stream = srv.shuffle_worker().store._stages["qbrt"].streams[
+            (0, 0)
+        ]
+        assert stream.nseq == 1 and list(stream.seqs) == [0]
+        assert stream.seqs[0].columns["k"].data.tolist() == [42, 43]
+        srv.shutdown()
+
+
+class TestWaitSide:
+    def test_sides_return_as_they_complete(self):
+        import time as _time
+
+        st = ShuffleStore()
+        st.open("q1", 1, 1)
+        # side 1 completes FIRST; side 0 is still in flight
+        st.push("q1", 1, 1, 1, 0, 0, [(10,)])
+        st.push("q1", 1, 1, 1, 0, -1, None, nseq=1)
+        deadline = _time.monotonic() + 5
+        side, chunks, _vocab = st.wait_side("q1", 1, [0, 1], 1, deadline)
+        assert side == 1 and chunks == [[(10,)]]
+        st.push("q1", 1, 1, 0, 0, 0, [(20,)])
+        st.push("q1", 1, 1, 0, 0, -1, None, nseq=1)
+        side, chunks, _vocab = st.wait_side("q1", 1, [0], 1, deadline)
+        assert side == 0 and chunks == [[(20,)]]
+
+    def test_wait_side_timeout_names_missing(self):
+        import time as _time
+
+        st = ShuffleStore()
+        st.open("q1", 1, 2)
+        st.push("q1", 1, 2, 0, 0, 0, [(1,)])
+        st.push("q1", 1, 2, 0, 0, -1, None, nseq=1)
+        with pytest.raises(ShuffleWaitTimeout) as ei:
+            st.wait_side("q1", 1, [0], 2, _time.monotonic() + 0.2)
+        assert ei.value.missing == ["side0/sender1"]
+
+    def test_vocab_accumulates_on_arrival(self):
+        import time as _time
+
+        from tidb_tpu.chunk import HostBlock, column_from_values
+        from tidb_tpu.dtypes import STRING
+
+        st = ShuffleStore()
+        st.open("qv", 1, 2)
+        a = HostBlock(
+            {"s": column_from_values(["x", "z"], STRING)}, 2
+        )
+        b = HostBlock(
+            {"s": column_from_values(["y"], STRING)}, 1
+        )
+        st.push("qv", 1, 2, 0, 0, 0, a)
+        st.push("qv", 1, 2, 0, 0, -1, None, nseq=1)
+        st.push("qv", 1, 2, 0, 1, 0, b)
+        st.push("qv", 1, 2, 0, 1, -1, None, nseq=1)
+        side, chunks, vocab = st.wait_side(
+            "qv", 1, [0], 2, _time.monotonic() + 5
+        )
+        assert side == 0 and len(chunks) == 2
+        assert vocab["s"] == {"x", "y", "z"}
+        # ttff recorded per stream
+        assert st.max_ttff("qv") >= 0.0
+        assert len(st._stages["qv"].ttff) == 2
+
+
+class TestIncrementalStaging:
+    def _schema(self):
+        from tidb_tpu.dtypes import FLOAT64, INT64, STRING
+        from tidb_tpu.planner import logical as L
+        from tidb_tpu.planner.logical import OutCol
+
+        return L.Schema([
+            OutCol(None, "k", "t.k", INT64),
+            OutCol(None, "s", "t.s", STRING),
+            OutCol(None, "f", "t.f", FLOAT64),
+        ])
+
+    def _chunks(self):
+        from tidb_tpu.chunk import HostBlock, column_from_values
+        from tidb_tpu.dtypes import FLOAT64, INT64, STRING
+
+        def blk(ks, ss, fs):
+            return HostBlock(
+                {
+                    "t.k": column_from_values(ks, INT64),
+                    "t.s": column_from_values(ss, STRING),
+                    "t.f": column_from_values(fs, FLOAT64),
+                },
+                len(ks),
+            )
+
+        return [
+            blk([1, None, 3], ["b", "a", None], [1.5, None, -2.0]),
+            blk([4], ["c"], [0.25]),
+            # a JSON row-packet chunk from a mixed-codec peer
+            [(5, "a", 9.0), (None, "d", None)],
+        ]
+
+    def _vocab(self):
+        return {"t.s": {"a", "b", "c"}}  # "d" arrives via the JSON chunk
+
+    def test_parity_with_barrier_stager(self):
+        from tidb_tpu.chunk import materialize_rows
+        from tidb_tpu.parallel.shuffle import (
+            stage_payloads_as_batch,
+            stage_payloads_incremental,
+        )
+
+        schema = self._schema()
+        barrier = stage_payloads_as_batch(schema, self._chunks(), 1)
+        incr = stage_payloads_incremental(
+            schema, self._chunks(), 2, vocab=self._vocab()
+        )
+        rows_b = materialize_rows(
+            barrier.batch, schema.cols, barrier.dicts
+        )
+        rows_i = materialize_rows(incr.batch, schema.cols, incr.dicts)
+        assert rows_i == rows_b
+        assert incr.dicts["t.s"].tolist() == ["a", "b", "c", "d"]
+
+    def test_empty_payloads(self):
+        from tidb_tpu.chunk import materialize_rows
+        from tidb_tpu.parallel.shuffle import stage_payloads_incremental
+
+        schema = self._schema()
+        staged = stage_payloads_incremental(schema, [], 3)
+        assert materialize_rows(
+            staged.batch, schema.cols, staged.dicts
+        ) == []
+
+    def test_keyed_staged_skips_streamed_paths(self, sess, monkeypatch):
+        """Keyed staged plans must take the compiled path only: the
+        streamed/partitioned re-chunkers compile pipelines that never
+        feed staged runtime inputs (a routed plan would KeyError), and
+        their sources are already resident anyway."""
+        from tidb_tpu.chunk import materialize_rows
+        from tidb_tpu.parallel.shuffle import stage_payloads_incremental
+        from tidb_tpu.planner import streamed
+        from tidb_tpu.planner.physical import PhysicalExecutor
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "streamed path entered for a keyed staged plan"
+            )
+
+        monkeypatch.setattr(streamed, "try_streamed", boom)
+        monkeypatch.setattr(streamed, "try_partitioned", boom)
+        schema = self._schema()
+        staged = stage_payloads_incremental(
+            schema, self._chunks(), 20, vocab=self._vocab(),
+            key="shuffle#0",
+        )
+        ex = PhysicalExecutor(sess.catalog)
+        out, dicts = ex.run(staged)
+        assert len(materialize_rows(out, schema.cols, dicts)) == 6
+
+    def test_staged_key_reuses_compiled_consumer(self, sess):
+        """The keyed staged input: two stages of one plan shape (same
+        capacity tile, same dictionary content — the cache key) over
+        DIFFERENT data hit the plan cache instead of recompiling per
+        stage, and each run returns its own stage's rows."""
+        from tidb_tpu.chunk import HostBlock, column_from_values
+        from tidb_tpu.chunk import materialize_rows
+        from tidb_tpu.dtypes import FLOAT64, INT64, STRING
+        from tidb_tpu.parallel.shuffle import stage_payloads_incremental
+        from tidb_tpu.planner.physical import PhysicalExecutor
+
+        schema = self._schema()
+        ex = PhysicalExecutor(sess.catalog)
+        hits = REGISTRY.counter(
+            "tidbtpu_executor_plan_cache_hits_total"
+        )
+        staged1 = stage_payloads_incremental(
+            schema, self._chunks(), 10, vocab=self._vocab(),
+            key="shuffle#0",
+        )
+        ex.run(staged1)
+        h0 = hits.value
+        chunks2 = [
+            HostBlock(
+                {
+                    "t.k": column_from_values([9], INT64),
+                    "t.s": column_from_values(["d"], STRING),
+                    "t.f": column_from_values([0.5], FLOAT64),
+                },
+                1,
+            )
+        ]
+        staged2 = stage_payloads_incremental(
+            schema, chunks2, 11, vocab=self._vocab(), key="shuffle#0"
+        )
+        assert staged2.dicts["t.s"].tolist() == \
+            staged1.dicts["t.s"].tolist()  # same content -> same key
+        out2, d2 = ex.run(staged2)
+        assert hits.value > h0  # same shape -> compiled program reused
+        rows2 = materialize_rows(out2, schema.cols, d2)
+        assert rows2 == [(9, "d", 0.5)]
 
 
 # ---------------------------------------------------------------------------
@@ -649,6 +991,94 @@ class TestRegistryShipping:
             delta, snap = counter_delta(snap, src)
             merge_counter_delta(delta, dst)
         assert dst.counter("tidbtpu_engine_retraces").value == 6
+
+
+# ---------------------------------------------------------------------------
+# clock-offset span rebasing (ROADMAP PR 2 open item c satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestClockOffsetSpans:
+    def test_handshake_samples_clock_offset(self, sess):
+        """Every EngineClient handshake measures the peer clock via the
+        request/reply timestamps (RTT/2 anchor): same-host processes
+        must read a near-zero offset and a sane RTT."""
+        from tidb_tpu.server.engine_rpc import EngineClient, EngineServer
+
+        srv = EngineServer(sess.catalog, port=0)
+        srv.start_background()
+        c = EngineClient("127.0.0.1", srv.port)
+        try:
+            assert c.clock_offset_s is not None
+            assert abs(c.clock_offset_s) < 1.0
+            assert 0.0 <= c.clock_rtt_s < 5.0
+            assert c.server_wire >= 2  # f32 narrowing wire version
+        finally:
+            c.close()
+            srv.shutdown()
+
+    def test_spans_rebase_through_sampled_offset(self, sess):
+        """Worker spans anchor at their TRUE coordinator-relative time:
+        (worker trace_t0 - clock offset - coordinator wall_t0), not at
+        reply receipt."""
+        from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", 1)], catalog=sess.catalog
+        )
+        try:
+            sched.tracer.enabled = True
+            sched.tracer.reset()
+            sched._clock_offsets["w:1"] = 5.0  # worker clock 5s ahead
+            trace_t0 = sched.tracer.wall_t0 + 5.0 + 0.25
+            sched._merge_remote_spans(
+                [["q1/f0/execute", 0.01, 0.2, 1]], "hostX",
+                addr="w:1", trace_t0=trace_t0,
+            )
+            s = sched.tracer.spans[-1]
+            assert s.name == "hostX:q1/f0/execute"
+            # base 0.25 (rebased through the offset) + span's own 0.01
+            assert abs(s.start_s - 0.26) < 1e-6
+            # fallback without an offset sample: reply-receipt anchor
+            sched._merge_remote_spans(
+                [["q1/f1/execute", 0.0, 0.1, 1]], "hostY"
+            )
+            s2 = sched.tracer.spans[-1]
+            assert s2.name == "hostY:q1/f1/execute"
+            assert s2.start_s >= 0.0
+        finally:
+            sched.close()
+
+    def test_remote_spans_anchor_true_offsets_in_process(self, sess):
+        """End to end over a real server: the offset is ~0 (same
+        host), so a worker span's merged start must sit near its true
+        wall-clock position in the coordinator trace — not pinned to
+        the reply-receipt tail."""
+        servers = _servers(sess, 2)
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in servers],
+            catalog=sess.catalog,
+        )
+        sched.tracer.enabled = True
+        sched.tracer.reset()
+        try:
+            q = "select b, count(*), sum(v) from t join u on a = k " \
+                "group by b order by b"
+            sess_rows = sess.must_query(q).rows
+            _cols, got = sched.execute_plan(_plan(sess, q))
+            assert got == sess_rows
+            remote = [
+                s for s in sched.tracer.spans
+                if s.name.endswith("/execute") and ":" in s.name
+            ]
+            assert remote
+            elapsed = time.perf_counter() - sched.tracer._t0
+            for s in remote:
+                assert 0.0 <= s.start_s <= elapsed
+        finally:
+            sched.close()
+            for s_ in servers:
+                s_.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,36 @@ def test_lint_catches_unmarked_json_on_hotpath(tmp_path):
     assert "off_hotpath" not in proc.stdout
 
 
+def test_lint_rejects_barrier_shape_regressions(tmp_path):
+    """The pipeline guard: whole-stage row materialization on the
+    binary produce path and post-wait bulk decode / concat double
+    copies fail the lint even without any json call."""
+    pkg = tmp_path / "tidb_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "shuffle.py").write_text(
+        "import numpy as np\n"
+        "def stage_payloads_incremental(schema, payloads, nonce,\n"
+        "                               vocab=None, key=None):\n"
+        "    return np.concatenate([p.data for p in payloads])\n"
+        "class ShuffleWorker:\n"
+        "    def _ship_side_stream(self, block):\n"
+        "        return materialize_rows(block)\n"
+        "    def run_task(self, spec):\n"
+        "        return decode_frame(spec)\n"
+        "    def _harmless(self, block):\n"
+        "        return materialize_rows(block)\n"  # not a guarded fn
+    )
+    proc = subprocess.run(
+        [sys.executable, LINT, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout
+    assert "concatenate" in proc.stdout
+    assert "materialize_rows" in proc.stdout
+    assert "decode_frame" in proc.stdout
+    assert "_harmless" not in proc.stdout
+
+
 def test_lint_flags_unparseable_hotpath_file(tmp_path):
     pkg = tmp_path / "tidb_tpu" / "parallel"
     pkg.mkdir(parents=True)

@@ -152,6 +152,88 @@ class TestFrameRoundTrip:
             wire.decode_frame(bytes(frame))
 
 
+class TestFloatNarrowing:
+    """Satellite (ROADMAP PR 4 item b): FLOAT64 columns narrow to f32
+    on the wire when the round trip is lossless."""
+
+    def test_f32_exact_values_narrow_and_roundtrip(self):
+        vals = [0.5, -1.25, 1024.0, None, 3.0, -0.0]
+        blk, schema = _block([("f", FLOAT64, vals)])
+        frame = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk, schema)
+        got = wire.decode_frame(frame)["block"].columns["f"]
+        assert got.data.dtype == np.float64  # widened back on decode
+        assert got.data.tolist() == blk.columns["f"].data.tolist()
+        assert got.valid.tolist() == blk.columns["f"].valid.tolist()
+        # a non-narrowable column of the same length costs more bytes
+        wide = [0.1, -1.2345678901234567, 1e300, None, 3.0000000001,
+                2.0 ** -1030]
+        blk2, sch2 = _block([("f", FLOAT64, wide)])
+        frame2 = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk2, sch2)
+        assert len(frame) < len(frame2)
+
+    def test_lossy_values_stay_f64(self):
+        for v in (0.1, 1e300, 1.0 + 2 ** -40):
+            blk, schema = _block([("f", FLOAT64, [v, 1.5])])
+            frame = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk, schema)
+            got = wire.decode_frame(frame)["block"].columns["f"]
+            assert got.data.tolist() == [v, 1.5], v
+
+    def test_nan_inf_narrow_losslessly(self):
+        col = HostColumn(
+            FLOAT64,
+            np.array([np.nan, np.inf, -np.inf, 1.5]),
+            np.ones(4, dtype=bool),
+        )
+        blk = HostBlock({"f": col}, 4)
+        schema = [OutCol(None, "f", "f", FLOAT64)]
+        frame = wire.encode_frame("s", 1, 1, 0, 0, 0, 0, blk, schema)
+        got = wire.decode_frame(frame)["block"].columns["f"]
+        assert np.isnan(got.data[0])
+        assert np.isposinf(got.data[1]) and np.isneginf(got.data[2])
+        assert got.data[3] == 1.5
+
+    def test_partition_parity_unaffected_by_narrowing(self):
+        """Hash routing happens BEFORE encode; an f32-narrowed column
+        still partitions identically to the row fallback."""
+        vals = [0.5, 2.0, 0.5, -8.25, None, 1024.0]
+        blk, schema = _block([("f", FLOAT64, vals)])
+        rows = block_to_rows(blk, schema)
+        for m in (2, 3):
+            idxs = wire.partition_block(blk, "f", m)
+            got = [[rows[i] for i in idx] for idx in idxs]
+            assert got == partition_rows(rows, 0, m)
+
+
+class TestDecodeHeader:
+    def test_header_matches_frame_and_skips_columns(self):
+        blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame("sid-h", 3, 2, 1, 0, 1, 4, blk, schema)
+        hdr = wire.decode_header(frame)
+        assert (hdr["sid"], hdr["attempt"], hdr["m"]) == ("sid-h", 3, 2)
+        assert (hdr["side"], hdr["sender"], hdr["seq"]) == (1, 0, 4)
+        assert hdr["block"] is None and hdr["eof"] is False
+        # a full decode can resume from the parsed header
+        pkt = wire.decode_frame(frame, header=hdr)
+        assert block_to_rows(pkt["block"], schema) == \
+            block_to_rows(blk, schema)
+
+    def test_header_decodes_eof(self):
+        _blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame(
+            "s", 1, 2, 0, 0, 1, -1, None, schema, nseq=7
+        )
+        hdr = wire.decode_header(frame)
+        assert hdr["eof"] is True and hdr["nseq"] == 7
+
+    def test_header_rejects_corruption(self):
+        blk, schema = _block(ALL_TYPES)
+        frame = wire.encode_frame("s", 1, 2, 0, 0, 1, 0, blk, schema)
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_header(frame[:10])
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_header(bytes([0x7C]) + frame[1:])
+
+
 class TestSpliceHelper:
     def test_json_splice_parses_identically_to_full_dumps(self):
         """Satellite: the byte-level splice output parses identically

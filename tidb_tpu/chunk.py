@@ -294,6 +294,32 @@ def block_to_batch(block: HostBlock, capacity: Optional[int] = None) -> Batch:
     return Batch(cols, jnp.asarray(row_valid))
 
 
+def batch_from_padded(
+    columns: Dict[str, HostColumn], nrows: int
+) -> Batch:
+    """Device batch from host columns ALREADY sized to the target tile
+    capacity — the zero-extra-copy staging seam (ROADMAP PR 4 item a):
+    the incremental shuffle stager writes each received chunk straight
+    into capacity-sized buffers, so there is no concat-then-pad double
+    copy here, just the h2d move. Every column must share one length
+    (the capacity); rows past ``nrows`` are pad."""
+    caps = {len(c.data) for c in columns.values()}
+    assert len(caps) == 1, f"ragged staged columns: {sorted(caps)}"
+    cap = caps.pop()
+    assert nrows <= cap
+    from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+    cols = {}
+    h2d = cap  # the row-validity mask ships too
+    for name, col in columns.items():
+        h2d += col.data.nbytes + col.valid.nbytes
+        cols[name] = DevCol(jnp.asarray(col.data), jnp.asarray(col.valid))
+    row_valid = np.zeros(cap, dtype=bool)
+    row_valid[:nrows] = True
+    ENGINE_WATCH.note_h2d(h2d)
+    return Batch(cols, jnp.asarray(row_valid))
+
+
 def present_temporals(col: "HostColumn"):
     """decode() + MySQL string presentation for temporal kinds — the
     user-facing result seam (decode() itself stays raw ints for

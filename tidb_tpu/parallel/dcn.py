@@ -309,6 +309,8 @@ class DCNFragmentScheduler:
         shuffle_packet_rows: Optional[int] = None,
         shuffle_inflight_bytes: Optional[int] = None,
         shuffle_codec: str = "binary",
+        shuffle_pipeline: bool = True,
+        shuffle_produce_chunks: Optional[int] = None,
     ):
         if not endpoints:
             raise ValueError("DCN scheduler needs at least one worker host")
@@ -316,6 +318,15 @@ class DCNFragmentScheduler:
             raise ValueError(f"bad shuffle_mode {shuffle_mode!r}")
         if shuffle_codec not in ("binary", "json"):
             raise ValueError(f"bad shuffle_codec {shuffle_codec!r}")
+        # pipeline=on|off (PERF_NOTES "Shuffle pipelining"): on, workers
+        # overlap produce/push/on-arrival-decode/stage within a stage;
+        # off is the barrier escape hatch (four sequential phases, like
+        # shuffle_codec=json is for the wire format)
+        self.shuffle_pipeline = bool(shuffle_pipeline)
+        # producer sub-slices per side (None = worker default): row-
+        # sliceable sides execute as this many disjoint frag sub-slices
+        # so push overlaps the SAME side's remaining produce
+        self.shuffle_produce_chunks = shuffle_produce_chunks
         # exchange wire codec (PERF_NOTES "Shuffle wire format"):
         # "binary" ships length-prefixed columnar frames built straight
         # from HostColumn buffers (parallel/wire.py; tunnels still
@@ -368,6 +379,10 @@ class DCNFragmentScheduler:
         self.last_query: Optional[dict] = None
         self._lock = threading.Lock()
         self._conns: Dict[EngineEndpoint, EngineClient] = {}
+        #: per-host clock offset (host wall clock minus coordinator
+        #: wall clock), sampled on each connection's handshake — worker
+        #: spans rebase through it instead of the reply-receipt anchor
+        self._clock_offsets: Dict[str, float] = {}
         # strict request/response stream per connection: concurrent
         # fragments to one host serialize on its lock (same invariant as
         # PooledEngineClient)
@@ -405,6 +420,8 @@ class DCNFragmentScheduler:
                 timeout_s=self.dispatch_timeout_s,
             )
             self._conns[ep] = c
+            if c.clock_offset_s is not None:
+                self._clock_offsets[ep.address] = c.clock_offset_s
         return c
 
     def _drop_conn(self, ep: EngineEndpoint) -> None:
@@ -564,6 +581,13 @@ class DCNFragmentScheduler:
             "m": 0, "bytes_tunneled": 0, "rows_tunneled": 0,
             "local_rows": 0, "stalls": 0, "retransmits": 0,
             "codec": self.shuffle_codec, "encode_s": 0.0,
+            # what the workers will actually run: the pipeline needs
+            # the binary codec, so the json escape hatch forces barrier
+            # (mirrors ShuffleWorker.run_task's own gate)
+            "pipeline": (
+                self.shuffle_pipeline and self.shuffle_codec == "binary"
+            ),
+            "wait_idle_s": 0.0, "ttff_s": 0.0, "exec_s": 0.0,
         }
         last_err: Optional[str] = None
         for rnd in range(self.max_attempts):
@@ -605,6 +629,8 @@ class DCNFragmentScheduler:
                     "packet_rows": self.shuffle_packet_rows,
                     "max_inflight_bytes": self.shuffle_inflight_bytes,
                     "codec": self.shuffle_codec,
+                    "pipeline": self.shuffle_pipeline,
+                    "produce_chunks": self.shuffle_produce_chunks,
                     "trace": bool(self.tracer.enabled),
                 }
                 try:
@@ -667,6 +693,11 @@ class DCNFragmentScheduler:
                     stage["stalls"] += f["stalls"]
                     stage["retransmits"] += f["retransmits"]
                     stage["encode_s"] += f.get("encode_s", 0.0)
+                    stage["wait_idle_s"] += f.get("wait_idle_s", 0.0)
+                    stage["exec_s"] += f.get("exec_s", 0.0)
+                    stage["ttff_s"] = max(
+                        stage["ttff_s"], f.get("ttff_s", 0.0)
+                    )
                 with self._lock:
                     self.last_query = {
                         "qid": qid, "fragments": infos,
@@ -715,11 +746,16 @@ class DCNFragmentScheduler:
             "retransmits": int(sh.get("retransmits", 0)),
             "codec": sh.get("codec"),
             "encode_s": float(sh.get("encode_s", 0.0)),
+            "pipeline": bool(sh.get("pipeline", False)),
+            "wait_idle_s": float(sh.get("wait_idle_s", 0.0)),
+            "ttff_s": float(sh.get("ttff_s", 0.0)),
             "spans": spans,
         }
         with self._lock:
             infos.append(info)
-        self._merge_remote_spans(spans, host)
+        self._merge_remote_spans(
+            spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
+        )
 
     def _run_fragments(
         self, frag: FragmentPlan
@@ -839,16 +875,38 @@ class DCNFragmentScheduler:
         }
         with self._lock:
             infos.append(info)
-        self._merge_remote_spans(spans, host)
+        self._merge_remote_spans(
+            spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
+        )
 
-    def _merge_remote_spans(self, spans, host: str) -> None:
+    def _merge_remote_spans(
+        self, spans, host: str, addr: Optional[str] = None,
+        trace_t0: Optional[float] = None,
+    ) -> None:
         """Rebase worker-clock span offsets onto the coordinator
-        timeline: the reply landed NOW, so the fragment's spans end
-        here and extend backwards by their own extent."""
+        timeline. Preferred anchor: the worker ships its tracer's wall
+        clock (``trace_t0``) and the handshake sampled this host's
+        clock offset (request/reply timestamps, RTT/2 anchor) — span
+        starts land at their TRUE coordinator-relative offsets, so
+        in-flight overlap between hosts renders faithfully. Fallback
+        (offset unsampled / old worker): the reply landed NOW, so the
+        spans end here and extend backwards by their own extent."""
         if not self.tracer.enabled:
             return
         base_s = 0.0
-        if self.tracer._t0 is not None and spans:
+        offset = self._clock_offsets.get(addr) if addr else None
+        if (
+            trace_t0 is not None
+            and offset is not None
+            and self.tracer.wall_t0 is not None
+        ):
+            # worker wall clock -> coordinator wall clock -> seconds
+            # since the coordinator tracer's reset
+            base_s = max(
+                float(trace_t0) - float(offset) - self.tracer.wall_t0,
+                0.0,
+            )
+        elif self.tracer._t0 is not None and spans:
             now_rel = time.perf_counter() - self.tracer._t0
             extent = max(float(s[1]) + float(s[2]) for s in spans)
             base_s = max(now_rel - extent, 0.0)
@@ -888,11 +946,14 @@ class DCNFragmentScheduler:
         """Stage the gathered partial/partition rows as a device batch
         under the cut's wire schema (the coordinator side of the DCN
         exchange). `cut` is a FragmentPlan or a ShufflePlan — both
-        carry partial_schema."""
+        carry partial_schema. Keyed staged input: repeated queries of
+        one final-plan shape reuse the compiled final stage instead of
+        paying an XLA compile per query (L.Staged.key)."""
         from tidb_tpu.parallel.shuffle import stage_rows_as_batch
 
         return stage_rows_as_batch(
-            cut.partial_schema, rows, next(_STAGED_NONCE)
+            cut.partial_schema, rows, next(_STAGED_NONCE),
+            key="dcn-final",
         )
 
     def _final_stage(self, frag, rows: List[tuple]):

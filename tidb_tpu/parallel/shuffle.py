@@ -75,6 +75,14 @@ _MAX_STAGES = 64
 #: packet granularity; the coordinator can override per stage
 DEFAULT_INFLIGHT_BYTES = 4 << 20
 DEFAULT_PACKET_ROWS = 2048
+#: pipelined producer sub-slices per side (Scan.frag arithmetic):
+#: chunk k of n re-frags (i, m) -> (i + k*m, n*m), so encode+push+peer
+#: decode of chunk k overlap the device produce of chunk k+1 — the
+#: exchange tail after the LAST produce shrinks to one chunk. 2 is the
+#: measured sweet spot on CPU dryruns (higher counts starve the
+#: shipper thread of the GIL during the rapid-fire sub-dispatches);
+#: raise it on real hardware where produce is device-bound.
+DEFAULT_PRODUCE_CHUNKS = 2
 #: transport retries per packet before the peer is declared dead
 PUSH_RETRIES = 3
 
@@ -148,6 +156,33 @@ def _c_decode_seconds():
         "tidbtpu_shuffle_decode_seconds",
         "receiver-side packet decode time, by wire codec",
         labels=("codec",),
+    )
+
+
+def _c_wait_idle_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_wait_idle_seconds",
+        "seconds consumers spent blocked in ShuffleStore waits with "
+        "no stream work left to overlap (the barrier cost pipelining "
+        "attacks)",
+    )
+
+
+def _c_decode_on_arrival_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_decode_on_arrival_seconds",
+        "binary frame decode time spent in the push handler as frames "
+        "land (overlapping the producers still in flight), after the "
+        "header-only fence check admitted the frame",
+    )
+
+
+def _h_ttff():
+    return REGISTRY.histogram(
+        "tidbtpu_shuffle_time_to_first_frame_seconds",
+        "stage-open to first data frame per (side, sender) stream — "
+        "low when producers ship chunk-granularly instead of after the "
+        "whole side materializes",
     )
 
 
@@ -225,6 +260,13 @@ class ShuffleWaitTimeout(TimeoutError):
         self.missing = missing
 
 
+class WaitInterrupted(Exception):
+    """wait_side's abort() callback fired: the caller's own producer
+    ship failed while the consumer was already waiting (the pipelined
+    task overlaps the two), so the wait must hand control back for the
+    ship error to surface instead of idling to the stage deadline."""
+
+
 class _Stream:
     """One (side, sender) packet stream within a stage attempt."""
 
@@ -239,7 +281,10 @@ class _Stream:
 
 
 class _Stage:
-    __slots__ = ("attempt", "m", "streams", "waiters")
+    __slots__ = (
+        "attempt", "m", "streams", "waiters", "opened_at", "ttff",
+        "vocab",
+    )
 
     def __init__(self, attempt: int, m: int):
         self.attempt = attempt
@@ -248,6 +293,16 @@ class _Stage:
         #: consumer threads blocked in wait() on this stage — never
         #: evict under a waiter's feet
         self.waiters = 0
+        self.opened_at = time.monotonic()
+        #: (side, sender) -> seconds from stage open to the stream's
+        #: first data frame (the pipelining signal: chunk-granular
+        #: producers push early, whole-side producers push late)
+        self.ttff: Dict[Tuple[int, int], float] = {}
+        #: (side, colname) -> running union of string-dictionary
+        #: entries, folded in as columnar frames LAND — by the time a
+        #: side completes, its unified stage dictionary is one sort
+        #: away instead of a full re-scan of every buffered chunk
+        self.vocab: Dict[Tuple[int, str], set] = {}
 
 
 class ShuffleStore:
@@ -342,7 +397,46 @@ class ShuffleStore:
                 _c_dups().inc()
                 return False
             stream.seqs[int(seq)] = payload
+            if (side, sender) not in st.ttff:
+                dt = time.monotonic() - st.opened_at
+                st.ttff[(side, sender)] = dt
+                _h_ttff().observe(dt)
+            cols = getattr(payload, "columns", None)
+            if cols is not None:
+                # columnar frame: fold its (pruned) string dictionaries
+                # into the side's running vocabulary NOW, while other
+                # streams are still in flight — incremental staging
+                # then unifies with one sort instead of re-walking
+                # every buffered chunk after the wait
+                for name, col in cols.items():
+                    if col.dictionary is not None:
+                        st.vocab.setdefault((side, name), set()).update(
+                            col.dictionary.tolist()
+                        )
             self._cv.notify_all()
+            return True
+
+    def admits(
+        self, sid: str, attempt: int, side: int, sender: int, seq: int
+    ) -> bool:
+        """Header-only fence pre-check: would a data frame with this
+        route land? False for a superseded attempt or a duplicate seq
+        (counted like the push-time fences) — the receive handler asks
+        this from decode_header output BEFORE spending decode work on
+        the column payload. Purely advisory: push() re-applies the
+        fences authoritatively, so a race between two identical
+        retransmits still lands exactly once."""
+        with self._cv:
+            st = self._stages.get(sid)
+            if st is None or attempt > st.attempt:
+                return True  # new stage / newer attempt: will reset
+            if attempt < st.attempt:
+                _c_stale().inc()
+                return False
+            stream = st.streams.get((side, sender))
+            if stream is not None and seq in stream.seqs:
+                _c_dups().inc()
+                return False
             return True
 
     def wait(
@@ -408,6 +502,85 @@ class ShuffleStore:
                 out[side] = chunks
             return out
 
+    def _side_complete(self, st: Optional[_Stage], attempt, side, m):
+        if st is None or st.attempt != attempt:
+            return False
+        for sender in range(m):
+            stream = st.streams.get((side, sender))
+            if stream is None or not stream.complete():
+                return False
+        return True
+
+    def wait_side(
+        self,
+        sid: str,
+        attempt: int,
+        pending: List[int],
+        m: int,
+        deadline: float,
+        abort=None,
+    ) -> Tuple[int, list, Dict[str, set]]:
+        """Block until ANY side in ``pending`` has all m streams
+        complete; returns (side, payload chunks ordered (sender, seq),
+        that side's running string vocabularies) — the pipelined
+        consumer stages each side the moment it finishes while the
+        other side is still in flight, instead of barriering on the
+        whole stage like wait(). ``deadline`` is absolute
+        (time.monotonic); on expiry raises ShuffleWaitTimeout naming
+        every missing stream across the still-pending sides."""
+        inject("shuffle/wait")
+        with self._cv:
+            pin = self._stage(sid, attempt, m)
+            if pin is not None:
+                pin.waiters += 1
+            try:
+                while True:
+                    st = self._stages.get(sid)
+                    for side in pending:
+                        if self._side_complete(st, attempt, side, m):
+                            chunks: list = []
+                            for sender in range(m):
+                                stream = st.streams[(side, sender)]
+                                for seq in range(stream.nseq):
+                                    chunks.append(stream.seqs[seq])
+                            vocab = {
+                                name: set(v)
+                                for (s, name), v in st.vocab.items()
+                                if s == side
+                            }
+                            return side, chunks, vocab
+                    if abort is not None and abort():
+                        raise WaitInterrupted()
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        missing = []
+                        for side in pending:
+                            for sender in range(m):
+                                stream = (
+                                    st.streams.get((side, sender))
+                                    if st is not None
+                                    and st.attempt == attempt
+                                    else None
+                                )
+                                if stream is None or not stream.complete():
+                                    missing.append(
+                                        f"side{side}/sender{sender}"
+                                    )
+                        raise ShuffleWaitTimeout(missing)
+                    self._cv.wait(min(left, 0.25))
+            finally:
+                if pin is not None and self._stages.get(sid) is pin:
+                    pin.waiters -= 1
+
+    def max_ttff(self, sid: str) -> float:
+        """Largest stream time-to-first-frame of the stage (0.0 when
+        nothing landed) — the straggler signal run_task reports."""
+        with self._cv:
+            st = self._stages.get(sid)
+            if st is None or not st.ttff:
+                return 0.0
+            return max(st.ttff.values())
+
 
 # -- sender: per-peer tunnel with flow control ------------------------------
 
@@ -441,10 +614,16 @@ class PeerTunnel:
         src: str,
         max_inflight_bytes: int = DEFAULT_INFLIGHT_BYTES,
         timeout_s: float = 30.0,
+        batch_packets: int = 64,
     ):
         self.host, self.port, self.secret = host, port, secret
         self.address = f"{host}:{port}"
         self.src = src
+        # packets pipelined onto the wire per ack round trip (the
+        # byte window bounds the data volume); 1 = strict stop-and-
+        # wait, the pre-pipelining wire discipline the pipeline=off
+        # escape hatch preserves
+        self.batch_packets = max(int(batch_packets), 1)
         self.max_inflight = int(max_inflight_bytes)
         self.timeout_s = timeout_s
         self.bytes_sent = 0
@@ -486,7 +665,9 @@ class PeerTunnel:
                         timeout_s=min(self.timeout_s, 10.0),
                     )
                     try:
-                        peer_wire = int(c._call({}).get("wire", 0))
+                        # the connect-time handshake already cached the
+                        # peer's advertised wire version
+                        peer_wire = int(c.server_wire)
                     finally:
                         c.close()
                     # EXACT version match: decode_frame rejects any
@@ -564,22 +745,42 @@ class PeerTunnel:
                     self._cv.wait(0.05)
                 if self._dead is not None or (self._closing and not self._q):
                     return
-                packet, nbytes, nrows = self._q[0]
+                # take a RUN of pre-encoded packets and pipeline them
+                # onto the wire in ONE write + one in-order ack read —
+                # a synchronous round trip per packet made the ack
+                # latency the dominant serial tail of a push stream.
+                # Packets stay queued until acked (retransmit fodder);
+                # plain-dict packets (tests/tools) go one at a time.
+                batch = []
+                encoded = isinstance(self._q[0][0], (bytes, bytearray))
+                for item in self._q:
+                    if len(batch) >= self.batch_packets:
+                        break
+                    if isinstance(
+                        item[0], (bytes, bytearray)
+                    ) != encoded:
+                        break
+                    batch.append(item)
+                    if not encoded:
+                        break
             err: Optional[Exception] = None
             fatal = False
             for attempt in range(PUSH_RETRIES):
                 try:
-                    inject("shuffle/push")
-                    if inject("shuffle/push-lost"):
-                        raise ConnectionError(
-                            "failpoint: push lost in transit"
-                        )
+                    for _packet, _nb, _nr in batch:
+                        inject("shuffle/push")
+                        if inject("shuffle/push-lost"):
+                            raise ConnectionError(
+                                "failpoint: push lost in transit"
+                            )
                     client = self._connect()
-                    if isinstance(packet, (bytes, bytearray)):
+                    if encoded:
                         # hot path: pre-encoded at enqueue, sent as-is
-                        client.shuffle_push_encoded(bytes(packet))
+                        client.shuffle_push_encoded_many(
+                            [bytes(p) for p, _nb, _nr in batch]
+                        )
                     else:
-                        client.shuffle_push(packet)
+                        client.shuffle_push(batch[0][0])
                     err = None
                     break
                 except (RuntimeError, ValueError, TypeError) as e:
@@ -598,23 +799,30 @@ class PeerTunnel:
                             pass
                         self._client = None
                     if attempt + 1 < PUSH_RETRIES:
-                        self.retransmits += 1
-                        _c_retransmits().inc()
+                        # the whole unacked batch retransmits; the
+                        # receiver's header dedupe lands each exactly
+                        # once
+                        self.retransmits += len(batch)
+                        _c_retransmits().inc(len(batch))
                         time.sleep(0.05 * (attempt + 1))
             with self._cv:
-                self._q.popleft()
-                self._inflight -= nbytes
+                nbytes_acked = nrows_acked = 0
+                for _packet, nbytes, nrows in batch:
+                    self._q.popleft()
+                    self._inflight -= nbytes
+                    nbytes_acked += nbytes
+                    nrows_acked += nrows
                 if err is not None:
                     self._dead = err
                     self._dead_fatal = fatal
                 else:
-                    self.bytes_sent += nbytes
-                    self.rows_sent += nrows
+                    self.bytes_sent += nbytes_acked
+                    self.rows_sent += nrows_acked
                     _c_bytes().labels(src=self.src, dst=self.address).inc(
-                        nbytes
+                        nbytes_acked
                     )
                     _c_rows().labels(src=self.src, dst=self.address).inc(
-                        nrows
+                        nrows_acked
                     )
                 self._cv.notify_all()
 
@@ -653,6 +861,44 @@ def _substitute_reads(plan, staged_by_tag):
     return dataclasses.replace(plan, **kw) if kw else plan
 
 
+def _slice_producer(plan, k: int, n_chunks: int):
+    """Sub-slice a producer side plan for chunk-granular execution:
+    the host's fragment scan ``frag=(i, m)`` (rows i::m) becomes
+    ``frag=(i + k*m, n_chunks*m)`` — the k-th of n_chunks disjoint
+    sub-slices whose union is exactly the host's slice, pure index
+    arithmetic through the existing frag machinery. Returns None when
+    the plan is not row-sliceable (anything beyond a scan/filter/
+    project chain, or no single frag'd scan): aggregates, sorts and
+    joins compute over the WHOLE slice and must not be re-run per
+    sub-slice."""
+    import dataclasses
+
+    from tidb_tpu.planner import logical as L
+
+    scans = []
+
+    def sliceable(p) -> bool:
+        if isinstance(p, L.Scan):
+            scans.append(p)
+            return p.frag is not None
+        if isinstance(p, (L.Selection, L.Projection)):
+            return sliceable(p.child)
+        return False
+
+    if not sliceable(plan) or len(scans) != 1:
+        return None
+    i, m = scans[0].frag
+
+    def rewrite(p):
+        if isinstance(p, L.Scan):
+            return dataclasses.replace(
+                p, frag=(i + k * m, n_chunks * m)
+            )
+        return dataclasses.replace(p, child=rewrite(p.child))
+
+    return rewrite(plan)
+
+
 def _shuffle_read_tags(plan) -> Dict[int, object]:
     """tag -> ShuffleRead node (the consumer's exchange leaves)."""
     from tidb_tpu.planner import logical as L
@@ -674,10 +920,12 @@ def _shuffle_read_tags(plan) -> Dict[int, object]:
     return out
 
 
-def stage_rows_as_batch(schema, rows: List[tuple], nonce: int):
+def stage_rows_as_batch(schema, rows: List[tuple], nonce: int, key=None):
     """Materialized rows -> a Staged device batch under `schema` (the
     receiving side of any host-level exchange; shared with the
-    coordinator's final stage in parallel/dcn.py)."""
+    coordinator's final stage in parallel/dcn.py). With ``key`` the
+    staged batch is a runtime input, so repeated final stages of one
+    plan shape reuse the compiled program (L.Staged.key)."""
     from tidb_tpu.chunk import (
         HostBlock,
         block_to_batch,
@@ -695,10 +943,12 @@ def stage_rows_as_batch(schema, rows: List[tuple], nonce: int):
             dicts[oc.internal] = hc.dictionary
     block = HostBlock(cols, len(rows))
     batch = block_to_batch(block, pad_capacity(max(len(rows), 1)))
-    return L.Staged(schema, batch=batch, dicts=dicts, nonce=nonce)
+    return L.Staged(
+        schema, batch=batch, dicts=dicts, nonce=nonce, key=key
+    )
 
 
-def stage_payloads_as_batch(schema, payloads: list, nonce: int):
+def stage_payloads_as_batch(schema, payloads: list, nonce: int, key=None):
     """Received shuffle payload chunks -> a Staged device batch by
     COLUMN CONCATENATION: binary frames arrive as decoded HostBlocks
     whose columns concatenate directly (string dictionaries unified
@@ -737,7 +987,111 @@ def stage_payloads_as_batch(schema, payloads: list, nonce: int):
             dicts[oc.internal] = hc.dictionary
     block = HostBlock(cols, total)
     batch = block_to_batch(block, pad_capacity(max(total, 1)))
-    return L.Staged(schema, batch=batch, dicts=dicts, nonce=nonce)
+    return L.Staged(
+        schema, batch=batch, dicts=dicts, nonce=nonce, key=key
+    )
+
+
+def stage_payloads_incremental(
+    schema, payloads: list, nonce: int, vocab=None, key=None
+):
+    """Received shuffle payload chunks -> a Staged device batch with
+    each output column WRITTEN ONCE (ROADMAP PR 4 item a): the final
+    buffers are allocated at tile capacity up front (row counts are
+    known from the received frames) and every chunk writes its slice
+    directly — no concat-then-pad double copy, no np.concatenate.
+    String dictionaries come pre-unioned from the store's running
+    per-side vocabularies (``vocab``, folded in as frames ARRIVED), so
+    staging sorts once and remaps codes per chunk. JSON row packets
+    (mixed-codec peers) normalize per chunk through column_from_values
+    — the declared fallback's slow path — contributing their own
+    dictionary entries to the union."""
+    from tidb_tpu.chunk import (
+        HostBlock,
+        HostColumn,
+        batch_from_padded,
+        column_from_values,
+        pad_capacity,
+    )
+    from tidb_tpu.dtypes import Kind
+    from tidb_tpu.planner import logical as L
+
+    vocab = {k: set(v) for k, v in (vocab or {}).items()}
+    blocks: list = []
+    for pl in payloads:
+        if isinstance(pl, HostBlock):
+            # fold any dictionary entries the running vocab missed
+            # (payloads landed via ShuffleStore.push already folded
+            # theirs on arrival — these unions are then no-ops over
+            # the per-chunk pruned dictionaries, not a row-data scan)
+            for cname, col in pl.columns.items():
+                if col.dictionary is not None:
+                    vocab.setdefault(cname, set()).update(
+                        col.dictionary.tolist()
+                    )
+            blocks.append(pl)
+            continue
+        cols = {}
+        for i, oc in enumerate(schema.cols):
+            hc = column_from_values([r[i] for r in pl], oc.type)
+            cols[oc.internal] = hc
+            if hc.dictionary is not None:
+                vocab.setdefault(oc.internal, set()).update(
+                    hc.dictionary.tolist()
+                )
+        blocks.append(HostBlock(cols, len(pl)))
+    total = sum(b.nrows for b in blocks)
+    cap = pad_capacity(max(total, 1))
+    out_cols = {}
+    dicts = {}
+    for oc in schema.cols:
+        name = oc.internal
+        valid = np.zeros(cap, dtype=bool)
+        if oc.type.kind == Kind.STRING:
+            unified = np.array(
+                sorted(str(v) for v in vocab.get(name, set())),
+                dtype=object,
+            )
+            lut = {v: i for i, v in enumerate(unified.tolist())}
+            data = np.zeros(cap, dtype=np.int32)
+            off = 0
+            for b in blocks:
+                c, n = b.columns[name], b.nrows
+                if n:
+                    cvalid = np.asarray(c.valid, dtype=bool)
+                    if c.dictionary is not None and len(c.dictionary):
+                        mapping = np.array(
+                            [lut[str(v)] for v in c.dictionary.tolist()],
+                            dtype=np.int32,
+                        )
+                        codes = mapping[
+                            np.clip(
+                                np.asarray(c.data), 0,
+                                len(c.dictionary) - 1,
+                            )
+                        ]
+                    else:
+                        codes = np.zeros(n, dtype=np.int32)
+                    data[off : off + n] = np.where(cvalid, codes, 0)
+                    valid[off : off + n] = cvalid
+                off += n
+            out_cols[name] = HostColumn(oc.type, data, valid, unified)
+            dicts[name] = unified
+            continue
+        dtype = oc.type.np_dtype
+        data = np.zeros(cap, dtype=dtype)
+        off = 0
+        for b in blocks:
+            c, n = b.columns[name], b.nrows
+            if n:
+                data[off : off + n] = np.asarray(c.data, dtype=dtype)
+                valid[off : off + n] = np.asarray(c.valid, dtype=bool)
+            off += n
+        out_cols[name] = HostColumn(oc.type, data, valid)
+    batch = batch_from_padded(out_cols, total)
+    return L.Staged(
+        schema, batch=batch, dicts=dicts, nonce=nonce, key=key
+    )
 
 
 class ShuffleWorker:
@@ -762,7 +1116,17 @@ class ShuffleWorker:
         self._consumer_exec = None
 
     def run_task(self, spec: dict, tracer=None) -> dict:
-        """The worker half of one shuffle stage:
+        """The worker half of one shuffle stage. Pipelined (the
+        default, ``pipeline=True`` + binary codec): producer sides are
+        shipped CHUNK-GRANULARLY on shipper threads — each produced
+        block is sliced, hash-partitioned and frame-encoded per packet
+        chunk so encode+push (and the peers' on-arrival decode) overlap
+        the NEXT side's produce; the consumer then waits PER SIDE
+        (ShuffleStore.wait_side) and stages each side the moment its
+        streams complete, while the other side is still in flight,
+        through the single-write incremental stager. Barrier mode
+        (``pipeline=False`` escape hatch, or the JSON codec) keeps the
+        four sequential phases of PR 4:
 
         1. open the receive store for (sid, attempt);
         2. run each producer side plan (this worker's fragment slice),
@@ -791,6 +1155,12 @@ class ShuffleWorker:
         )
         wait_timeout = float(spec.get("wait_timeout_s") or 120.0)
         codec = str(spec.get("codec") or "binary")
+        pipeline = (
+            bool(spec.get("pipeline", True)) and codec == "binary"
+        )
+        produce_chunks = max(
+            int(spec.get("produce_chunks") or DEFAULT_PRODUCE_CHUNKS), 1
+        )
         ctx = f"q{spec.get('qid')}/p{part}"
 
         self.store.open(sid, attempt, m)
@@ -803,28 +1173,33 @@ class ShuffleWorker:
                 )
             producer_exec = self._producer_exec
         tunnels: Dict[int, PeerTunnel] = {}
+        tlock = threading.Lock()  # tunnel creation + stats merge
         stats = {
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
             "stalls": 0, "retransmits": 0, "produced_rows": 0,
             "per_peer": [], "codec": codec, "encode_s": 0.0,
+            "pipeline": pipeline, "wait_idle_s": 0.0, "ttff_s": 0.0,
         }
         _nullspan = _NullSpan()
 
         def span(name):
             return tracer.span(name) if tracer is not None else _nullspan
 
+        shippers: List[threading.Thread] = []
+        ship_errs: List[Exception] = []
+        staged: Dict[int, object] = {}
         try:
             for side in spec["sides"]:
                 tag = int(side["tag"])
                 plan = plan_from_ir(side["plan"])
                 schema_cols = list(plan.schema)
                 inject("shuffle/produce")
-                with span(f"{ctx}/produce#{tag}"), self._exec_lock:
-                    batch, dicts = producer_exec.run(plan)
                 if codec == "json":
                     # shuffle-json-fallback: the row-packet escape
                     # hatch (shuffle_codec=json) materializes and
                     # partitions Python rows, like PR 3
+                    with span(f"{ctx}/produce#{tag}"), self._exec_lock:
+                        batch, dicts = producer_exec.run(plan)
                     with self._exec_lock:
                         rows = materialize_rows(batch, schema_cols, dicts)
                     key_idx = [c.internal for c in schema_cols].index(
@@ -848,6 +1223,54 @@ class ShuffleWorker:
                 from tidb_tpu.parallel.wire import partition_block
 
                 types = {c.internal: c.type for c in schema_cols}
+                if pipeline:
+                    # shipper thread fed by a queue of produced
+                    # sub-batches: d2h fetch + partition + encode +
+                    # push of everything enqueued overlaps BOTH the
+                    # same side's next produce chunk and the next
+                    # side's produce (and the peers' on-arrival decode
+                    # of what we push)
+                    import queue as _queue
+
+                    sq: "_queue.Queue" = _queue.Queue()
+                    with tlock:
+                        stats["_live_shippers"] = (
+                            stats.get("_live_shippers", 0) + 1
+                        )
+                    th = threading.Thread(
+                        target=self._ship_side_stream,
+                        args=(
+                            sid, attempt, m, tag, part, sq,
+                            side["key"], schema_cols, peers, secret,
+                            tunnels, tlock, packet_rows, inflight,
+                            stats, ship_errs,
+                        ),
+                        daemon=True,
+                        name=f"shuffle-ship-{sid}-s{tag}",
+                    )
+                    th.start()
+                    shippers.append(th)
+                    # chunk-granular produce: the side executes as
+                    # produce_chunks disjoint frag sub-slices when the
+                    # plan is row-sliceable, so push starts after ONE
+                    # chunk instead of after the whole side
+                    subplans = None
+                    if produce_chunks > 1:
+                        cand = [
+                            _slice_producer(plan, k, produce_chunks)
+                            for k in range(produce_chunks)
+                        ]
+                        if all(c is not None for c in cand):
+                            subplans = cand
+                    for sp in (subplans or [plan]):
+                        with span(f"{ctx}/produce#{tag}"), \
+                                self._exec_lock:
+                            batch, dicts = producer_exec.run(sp)
+                        sq.put((batch, types, dicts))
+                    sq.put(None)  # side EOF sentinel
+                    continue
+                with span(f"{ctx}/produce#{tag}"), self._exec_lock:
+                    batch, dicts = producer_exec.run(plan)
                 block = batch_to_block(batch, types, dicts)
                 stats["produced_rows"] += block.nrows
                 idxs = partition_block(block, side["key"], m)
@@ -859,8 +1282,106 @@ class ShuffleWorker:
                             secret, tunnels, packet_rows, inflight,
                             stats,
                         )
-            for t in tunnels.values():
-                t.flush()
+            consumer = plan_from_ir(spec["consumer"])
+            reads = _shuffle_read_tags(consumer)
+            if not pipeline:
+                # barrier shape: every push acked before the wait
+                # opens (shipper threads exist only in pipelined mode,
+                # so there are no ship_errs to consult here). Local
+                # work is done once the last partition is enqueued, so
+                # BOTH the flush block (waiting for peer acks) and the
+                # store wait are exchange idle.
+                t0 = time.perf_counter()
+                for t in tunnels.values():
+                    t.flush()
+                with span(f"{ctx}/wait"):
+                    by_side = self.store.wait(
+                        sid, attempt, len(spec["sides"]), m,
+                        wait_timeout,
+                    )
+                idle = time.perf_counter() - t0
+                stats["wait_idle_s"] += idle
+                _c_wait_idle_seconds().inc(idle)
+            else:
+                # pipelined: the wait/stage loop starts while our OWN
+                # shippers are still draining — a side whose streams
+                # are all EOF stages (including its h2d move) while the
+                # other side is still in flight AND while our outbound
+                # tail is still crossing the tunnels. abort() hands
+                # control back within a poll tick if a shipper fails,
+                # so a dead peer surfaces promptly, not at the wait
+                # deadline.
+                pending = sorted(int(s["tag"]) for s in spec["sides"])
+                waited = 0.0
+                while pending:
+                    t0 = time.perf_counter()
+                    # the timeout budget charges WAITING only: per-side
+                    # staging between waits must not burn it (barrier
+                    # mode charged wait_timeout purely to its one wait)
+                    deadline = time.monotonic() + max(
+                        wait_timeout - waited, 0.0
+                    )
+                    with span(f"{ctx}/wait"):
+                        done, chunks, vocab = self.store.wait_side(
+                            sid, attempt, pending, m, deadline,
+                            abort=lambda: bool(ship_errs),
+                        )
+                    t1 = time.perf_counter()
+                    waited += t1 - t0
+                    # idle = blocked time with our own shippers already
+                    # drained (wait wall that overlaps our outbound
+                    # push is pipeline WORKING, not idling)
+                    with tlock:
+                        ship_done = stats.get("_ship_done")
+                    idle = (
+                        max(0.0, t1 - max(t0, ship_done))
+                        if ship_done is not None else 0.0
+                    )
+                    stats["wait_idle_s"] += idle
+                    _c_wait_idle_seconds().inc(idle)
+                    pending.remove(done)
+                    node = reads.get(done)
+                    if node is not None:
+                        with span(f"{ctx}/stage#{done}"):
+                            staged[done] = stage_payloads_incremental(
+                                node.schema, chunks,
+                                next(self._nonce), vocab=vocab,
+                                key=f"shuffle#{done}",
+                            )
+                for th in shippers:
+                    th.join()
+                if ship_errs:
+                    raise ship_errs[0]
+                for t in tunnels.values():
+                    t.flush()
+        except WaitInterrupted:
+            # a shipper failed while we were waiting: surface ITS
+            # error with the same taxonomy as the in-try raises (a
+            # raise from an except clause skips sibling handlers)
+            for th in shippers:
+                th.join(timeout=30)
+            self.store.discard(sid)
+            err = ship_errs[0] if ship_errs else None
+            if isinstance(err, PeerDeadError):
+                if err.fatal:
+                    raise RuntimeError(
+                        f"shuffle push to {err.address} rejected: "
+                        f"{err.cause}"
+                    ) from err
+                raise ShuffleAbort("push failed", [err.address]) from err
+            raise err if err is not None else ShuffleAbort(
+                "ship interrupted", []
+            )
+        except ShuffleWaitTimeout as e:
+            # missing "sideS/senderJ" -> suspect peer address J
+            suspects = sorted(
+                {
+                    "%s:%s" % peers[int(s.rsplit("sender", 1)[1])]
+                    for s in e.missing
+                }
+            )
+            self.store.discard(sid)  # a retry runs under a new attempt
+            raise ShuffleAbort("wait timed out", suspects) from e
         except PeerDeadError as e:
             if e.fatal:
                 # engine-side rejection/encoding error: surface the
@@ -870,6 +1391,10 @@ class ShuffleWorker:
                 ) from e
             raise ShuffleAbort("push failed", [e.address]) from e
         finally:
+            for th in shippers:
+                # an error can escape while shippers run: never close
+                # tunnels under an active sender
+                th.join(timeout=30)
             for t in tunnels.values():
                 t.close()
             # authoritative push stats come from the tunnels (only
@@ -887,35 +1412,32 @@ class ShuffleWorker:
                         "retransmits": t.retransmits,
                     }
                 )
-
-        n_sides = len(spec["sides"])
-        try:
-            with span(f"{ctx}/wait"):
-                by_side = self.store.wait(
-                    sid, attempt, n_sides, m, wait_timeout
-                )
-        except ShuffleWaitTimeout as e:
-            # missing "sideS/senderJ" -> suspect peer address J
-            suspects = sorted(
-                {
-                    "%s:%s" % peers[int(s.rsplit("sender", 1)[1])]
-                    for s in e.missing
-                }
-            )
-            self.store.discard(sid)  # a retry runs under a new attempt
-            raise ShuffleAbort("wait timed out", suspects) from e
-        # wait() copied the rows out: free the buffered packets NOW so
-        # the store holds only in-flight stages, not consumed ones
+        stats["ttff_s"] = self.store.max_ttff(sid)
+        stats.pop("_live_shippers", None)
+        stats.pop("_ship_done", None)
+        # the waits copied the rows out: free the buffered packets NOW
+        # so the store holds only in-flight stages, not consumed ones
         self.store.discard(sid)
 
-        consumer = plan_from_ir(spec["consumer"])
-        reads = _shuffle_read_tags(consumer)
-        staged = {
-            tag: stage_payloads_as_batch(
-                node.schema, by_side.get(tag, []), next(self._nonce)
-            )
-            for tag, node in reads.items()
-        }
+        if pipeline:
+            for tag, node in reads.items():
+                if tag not in staged:  # a read with no producer side
+                    staged[tag] = stage_payloads_incremental(
+                        node.schema, [], next(self._nonce),
+                        key=f"shuffle#{tag}",
+                    )
+        else:
+            # barrier escape hatch: the PR 4 stage end to end — bulk
+            # concat staging under a fresh nonce (no compiled-consumer
+            # reuse; the keyed staged input is incremental-mode
+            # machinery)
+            staged = {
+                tag: stage_payloads_as_batch(
+                    node.schema, by_side.get(tag, []),
+                    next(self._nonce),
+                )
+                for tag, node in reads.items()
+            }
         inject("shuffle/consume")
         with span(f"{ctx}/consume"), self._exec_lock:
             # consumer executes single-device: its sources are Staged
@@ -935,7 +1457,8 @@ class ShuffleWorker:
         }
 
     def _tunnel_for(
-        self, dest, peers, sender, secret, tunnels, inflight
+        self, dest, peers, sender, secret, tunnels, inflight,
+        batch_packets: int = 64,
     ) -> PeerTunnel:
         if dest not in tunnels:
             host, port = peers[dest]
@@ -945,8 +1468,157 @@ class ShuffleWorker:
             tunnels[dest] = PeerTunnel(
                 host, port, secret, src="%s:%s" % tuple(peers[sender]),
                 max_inflight_bytes=inflight,
+                batch_packets=batch_packets,
             )
         return tunnels[dest]
+
+    def _ship_side_stream(
+        self, sid, attempt, m, side, sender, sq, key, schema_cols,
+        peers, secret, tunnels, tlock, packet_rows, inflight, stats,
+        errs,
+    ) -> None:
+        """Pipelined producer ship (one side, run on a shipper thread,
+        fed produced sub-batches through queue ``sq`` until the None
+        sentinel): each sub-batch is fetched device->host HERE — the
+        d2h move overlaps the next produce chunk — then its partition
+        map is computed once and the block walked in packet chunks:
+        each chunk is split by destination, frame-encoded and enqueued
+        IMMEDIATELY, so every peer's first frame leaves after one
+        chunk instead of after the whole side (low time-to-first-frame)
+        and destinations interleave fairly. Sequence numbers run
+        continuously across sub-batches; EOFs close each stream with
+        the true frame count once the sentinel arrives. The whole-side
+        row materialization of the barrier path never happens here
+        (lint-enforced by check_shuffle_hotpath.py). Self partitions
+        land HostBlocks in the local store chunk by chunk; a
+        mixed-version peer that negotiated down gets per-chunk JSON
+        row packets. Errors land in ``errs`` for the task thread."""
+        from tidb_tpu.chunk import (
+            batch_to_block,
+            block_to_rows,
+            slice_block,
+            take_block,
+        )
+        from tidb_tpu.parallel.wire import encode_frame, partition_map
+
+        try:
+            seqs = [0] * m
+            local_rows = 0
+            encode_s = 0.0
+            produced = 0
+            # chunks of packet_rows*m keep per-destination frames near
+            # packet_rows rows — framing (and per-frame dictionary/
+            # header overhead) comparable to the barrier producer
+            step = max(int(packet_rows) * max(m, 1), 1)
+            while True:
+                item = sq.get()
+                if item is None:
+                    break
+                batch, types, dicts = item
+                block = batch_to_block(batch, types, dicts)
+                produced += block.nrows
+                pmap = partition_map(block, key, m)
+                for a in range(0, block.nrows, step):
+                    chunk = slice_block(block, a, a + step)
+                    cmap = pmap[a : a + step]
+                    for dest in range(m):
+                        idx = np.nonzero(cmap == dest)[0]
+                        if not len(idx):
+                            continue
+                        sub = take_block(chunk, idx)
+                        seq = seqs[dest]
+                        seqs[dest] += 1
+                        if dest == sender:
+                            self.store.push(
+                                sid, attempt, m, side, sender, seq, sub
+                            )
+                            local_rows += sub.nrows
+                            continue
+                        with tlock:
+                            tun = self._tunnel_for(
+                                dest, peers, secret=secret,
+                                sender=sender, tunnels=tunnels,
+                                inflight=inflight,
+                            )
+                        if tun.negotiated_codec("binary") != "binary":
+                            packet = {
+                                "sid": sid, "attempt": attempt, "m": m,
+                                "side": side, "sender": sender,
+                                "part": dest, "seq": seq,
+                                "rows": block_to_rows(sub, schema_cols),
+                            }
+                            # shuffle-json-fallback: per-chunk row
+                            # packet for a peer that negotiated down
+                            t0 = time.perf_counter()
+                            payload = json.dumps(
+                                {"shuffle_push": packet}
+                            ).encode()
+                            dt = time.perf_counter() - t0
+                            encode_s += dt
+                            _c_encode_seconds().labels(
+                                codec="json"
+                            ).inc(dt)
+                            _c_codec_bytes().labels(codec="json").inc(
+                                len(payload)
+                            )
+                            tun.send(payload, len(payload), sub.nrows)
+                            continue
+                        t0 = time.perf_counter()
+                        frame = encode_frame(
+                            sid, attempt, m, side, sender, dest, seq,
+                            sub, schema_cols,
+                        )
+                        dt = time.perf_counter() - t0
+                        encode_s += dt
+                        _c_encode_seconds().labels(codec="binary").inc(
+                            dt
+                        )
+                        _c_codec_bytes().labels(codec="binary").inc(
+                            len(frame)
+                        )
+                        tun.send(frame, len(frame), sub.nrows)
+            for dest in range(m):
+                if dest == sender:
+                    self.store.push(
+                        sid, attempt, m, side, sender, -1, None,
+                        nseq=seqs[dest],
+                    )
+                    continue
+                with tlock:
+                    tun = self._tunnel_for(
+                        dest, peers, secret=secret, sender=sender,
+                        tunnels=tunnels, inflight=inflight,
+                    )
+                if tun.negotiated_codec("binary") != "binary":
+                    eof = {
+                        "sid": sid, "attempt": attempt, "m": m,
+                        "side": side, "sender": sender, "part": dest,
+                        "seq": -1, "rows": None, "nseq": seqs[dest],
+                    }
+                    # shuffle-json-fallback: the row-codec EOF marker
+                    payload = json.dumps({"shuffle_push": eof}).encode()
+                    tun.send(payload, len(payload), 0)
+                else:
+                    eof = encode_frame(
+                        sid, attempt, m, side, sender, dest, -1, None,
+                        schema_cols, nseq=seqs[dest],
+                    )
+                    tun.send(eof, len(eof), 0)
+            with tlock:
+                stats["local_rows"] += local_rows
+                stats["encode_s"] += encode_s
+                stats["produced_rows"] += produced
+        except Exception as e:
+            errs.append(e)
+        finally:
+            with tlock:
+                stats["_live_shippers"] = (
+                    stats.get("_live_shippers", 1) - 1
+                )
+                if stats["_live_shippers"] <= 0:
+                    # all sides shipped: wait time past this point is
+                    # TRUE consumer idle (nothing left to overlap)
+                    stats["_ship_done"] = time.perf_counter()
 
     def _ship_partition(
         self, sid, attempt, m, side, sender, dest, block, schema_cols,
@@ -972,9 +1644,11 @@ class ShuffleWorker:
                 nseq=1 if block.nrows else 0,
             )
             return
+        # barrier escape hatch: strict stop-and-wait acks, the
+        # pre-pipelining wire discipline
         tun = self._tunnel_for(
             dest, peers, secret=secret, sender=sender, tunnels=tunnels,
-            inflight=inflight,
+            inflight=inflight, batch_packets=1,
         )
         if tun.negotiated_codec("binary") != "binary":
             self._send_stream(
@@ -1014,9 +1688,11 @@ class ShuffleWorker:
         land directly in the local store (no tunnel, no DCN bytes)."""
         local = dest == sender
         if not local:
+            # json fallback codec keeps the PR 3 wire discipline:
+            # stop-and-wait acks, one packet per round trip
             self._tunnel_for(
                 dest, peers, secret=secret, sender=sender,
-                tunnels=tunnels, inflight=inflight,
+                tunnels=tunnels, inflight=inflight, batch_packets=1,
             )
         chunks = [
             rows[a : a + packet_rows]

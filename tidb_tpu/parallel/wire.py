@@ -59,7 +59,11 @@ from tidb_tpu.dtypes import Kind, SQLType
 
 MAGIC = 0xC5
 MAGIC_BYTE = bytes([MAGIC])
-WIRE_VERSION = 1
+#: version 2 added the float32 physical width (FLOAT64 columns narrow
+#: to f32 on the wire when the round trip is lossless); negotiation is
+#: an exact match, so a v1 peer degrades to the JSON fallback instead
+#: of receiving frames whose phys code it cannot decode
+WIRE_VERSION = 2
 
 _FLAG_EOF = 1
 
@@ -76,10 +80,13 @@ _KIND_CODE = {
 _CODE_KIND = {v: k for k, v in _KIND_CODE.items()}
 
 #: physical buffer dtypes (integer columns narrow to the smallest
-#: signed width covering their range; floats/bools ship native)
+#: signed width covering their range; float64 narrows to float32 when
+#: the round trip is lossless; bools ship native). float32 appended
+#: LAST so codes 0-5 stay bit-compatible with wire version 1.
 _PHYS_DTYPES = (
     np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.int32),
     np.dtype(np.int64), np.dtype(np.float64), np.dtype(np.bool_),
+    np.dtype(np.float32),
 )
 _PHYS_CODE = {dt: i for i, dt in enumerate(_PHYS_DTYPES)}
 
@@ -97,9 +104,23 @@ def is_binary_frame(frame: bytes) -> bool:
 
 
 def _narrow(data: np.ndarray) -> np.ndarray:
-    """Smallest signed-int physical width covering the column's range
-    (lossless; the decoder widens back to the logical dtype)."""
-    if data.dtype.kind != "i" or data.size == 0:
+    """Smallest lossless physical width: signed ints narrow to the
+    smallest width covering their range; float64 narrows to float32
+    when every value round-trips bit-exactly (NaN stays NaN, values
+    outside f32 range or with dropped mantissa bits keep f64). The
+    decoder widens back to the logical dtype."""
+    if data.size == 0:
+        return data
+    if data.dtype == np.float64:
+        # out-of-f32-range values overflow to inf in the cast (then
+        # fail the round-trip check and keep f64) — expected, not an
+        # error
+        with np.errstate(over="ignore"):
+            f32 = data.astype(np.float32)
+        back = f32.astype(np.float64)
+        same = (back == data) | (np.isnan(back) & np.isnan(data))
+        return f32 if bool(same.all()) else data
+    if data.dtype.kind != "i":
         return data
     lo = int(data.min())
     hi = int(data.max())
@@ -214,10 +235,15 @@ class _Reader:
         return struct.unpack("<I", self.take(4))[0]
 
 
-def decode_frame(frame: bytes) -> dict:
-    """Parse one binary shuffle frame back into route metadata plus a
-    ``HostBlock`` of the carried columns (``block=None`` for the EOF
-    marker). Raises WireFormatError on anything malformed."""
+def decode_header(frame: bytes) -> dict:
+    """Parse ONLY the fixed route header + sid/auth sections of a
+    binary shuffle frame — no column buffers touched. This is the
+    receiver's fence gate: a stale-attempt or duplicate-seq frame is
+    identified (and dropped) from the header alone, BEFORE any decode
+    work is spent on its payload (the pipelined receive path decodes
+    on arrival, so wasted decode would steal cycles from live
+    streams). Returns the same route dict shape as decode_frame with
+    ``block=None`` plus the internal reader offset under ``_off``."""
     if len(frame) < _FIXED.size:
         raise WireFormatError(f"frame of {len(frame)}B shorter than header")
     (
@@ -235,11 +261,24 @@ def decode_frame(frame: bytes) -> dict:
         "sid": sid, "attempt": attempt, "m": m, "side": side,
         "sender": sender, "part": part, "seq": seq,
         "nseq": None if nseq < 0 else nseq, "id": req_id, "auth": auth,
-        "block": None,
+        "block": None, "eof": bool(flags & _FLAG_EOF),
+        "nrows": nrows, "ncols": ncols, "_off": r.off,
     }
-    if flags & _FLAG_EOF:
-        if out["nseq"] is None:
-            raise WireFormatError("EOF frame without nseq")
+    if out["eof"] and out["nseq"] is None:
+        raise WireFormatError("EOF frame without nseq")
+    return out
+
+
+def decode_frame(frame: bytes, header: Optional[dict] = None) -> dict:
+    """Parse one binary shuffle frame back into route metadata plus a
+    ``HostBlock`` of the carried columns (``block=None`` for the EOF
+    marker). Raises WireFormatError on anything malformed. Pass an
+    already-parsed ``header`` (decode_header) to skip re-reading the
+    route sections — the fence-then-decode receive path."""
+    out = decode_header(frame) if header is None else dict(header)
+    nrows, ncols = out["nrows"], out["ncols"]
+    r = _Reader(frame, out.pop("_off"))
+    if out.pop("eof"):
         return out
     cols = {}
     for _ in range(ncols):
@@ -396,21 +435,29 @@ def column_key_ints(col: HostColumn) -> np.ndarray:
     return ints_u[inv] if len(u) else np.zeros(0, dtype=np.int64)
 
 
-def partition_block(
-    block: HostBlock, key: str, m: int
-) -> List[np.ndarray]:
-    """Vectorized host-tier hash partitioning: the per-row partition of
-    column ``key`` computed over the whole column (mix_hash_np — the
-    same 64-bit finalizer as exchange._mix_hash), returned as one
-    ascending row-index array per partition (``np.take`` fodder). NULL
-    keys all land on partition 0, like exchange.partition_of and the
-    partition_rows fallback."""
+def partition_map(block: HostBlock, key: str, m: int) -> np.ndarray:
+    """Per-row destination partition of column ``key`` as one int64
+    array (mix_hash_np — the same 64-bit finalizer as
+    exchange._mix_hash). NULL keys all land on partition 0, like
+    exchange.partition_of and the partition_rows fallback. Computed
+    ONCE per produced side; the pipelined producer slices this map per
+    packet chunk instead of re-hashing (string/temporal key hashing is
+    per-distinct-value and must not repeat per chunk)."""
     from tidb_tpu.parallel.shuffle import mix_hash_np
 
     col = block.columns[key]
     if block.nrows == 0:
-        return [np.zeros(0, dtype=np.int64) for _ in range(m)]
+        return np.zeros(0, dtype=np.int64)
     ints = column_key_ints(col)
     parts = mix_hash_np(ints) % np.int64(m)
-    parts = np.where(np.asarray(col.valid, dtype=bool), parts, 0)
+    return np.where(np.asarray(col.valid, dtype=bool), parts, 0)
+
+
+def partition_block(
+    block: HostBlock, key: str, m: int
+) -> List[np.ndarray]:
+    """Vectorized host-tier hash partitioning: partition_map expanded
+    to one ascending row-index array per partition (``np.take``
+    fodder)."""
+    parts = partition_map(block, key, m)
     return [np.nonzero(parts == d)[0] for d in range(m)]

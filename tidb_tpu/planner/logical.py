@@ -209,11 +209,21 @@ class Staged(LogicalPlan):
     """A pre-computed device batch injected into a plan — the output of
     an out-of-band execution stage (streamed aggregation over a table
     too large for one device tile). The physical compiler treats it as
-    a constant source; the nonce keeps plan-cache keys unique."""
+    a constant source; the nonce keeps plan-cache keys unique.
+
+    With ``key`` set, the batch becomes a runtime INPUT instead of a
+    baked constant, and the plan cache keys on (key, capacity, column
+    dtypes, dictionary content hash) rather than the nonce — repeated
+    executions of the same plan shape over fresh data (every shuffle
+    stage's consumer) reuse one compiled program instead of paying a
+    full XLA compile per stage. Dictionary content stays part of the
+    cache key because string-key alignment bakes LUTs from it at
+    compile time."""
 
     batch: object = None  # device Batch
     dicts: Optional[Dict] = None
     nonce: int = 0
+    key: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -161,6 +161,12 @@ class CompiledQuery:
     sized_nodes: List[int]  # node ids with a capacity knob
     default_caps: Dict[int, int]
     out_dicts: Dicts
+    # (node id, Staged.key): keyed staged batches fed as runtime inputs
+    # per run — the shuffle consumer's stage partitions, so one compile
+    # serves every stage of the plan shape
+    staged_sites: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list
+    )
     # steady state:
     jitted: Optional[Callable] = None
     caps: Optional[Dict[int, int]] = None
@@ -241,7 +247,51 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
         elif isinstance(p, L.Limit):
             parts.append(f"{p.count},{p.offset}")
         elif isinstance(p, L.Staged):
-            parts.append(f"staged#{p.nonce}")
+            if p.key is not None:
+                # keyed staged input: the batch is a runtime input, so
+                # the fingerprint carries everything the compiled
+                # program bakes in — shape (capacity + column dtypes)
+                # and string dictionary CONTENT (key-alignment LUTs are
+                # compile-time) — and two stages with matching shapes
+                # share one program
+                import hashlib as _hashlib
+
+                b = p.batch
+                # LOGICAL types (kind + scale) must key too: two
+                # DECIMAL scales share one int64 physical dtype but
+                # compile scale-dependent programs, and scan-free
+                # staged plans carry no schema-version entries to
+                # catch an ALTER
+                ltypes = {
+                    c.internal: c.type
+                    for c in getattr(p.schema, "cols", [])
+                }
+
+                def lsig(n):
+                    t = ltypes.get(n)
+                    return (
+                        f"{t.kind.name}s{t.scale}"
+                        if t is not None else "?"
+                    )
+
+                colsig = ",".join(
+                    f"{n}:{dc.data.dtype.str}:{lsig(n)}"
+                    for n, dc in sorted(b.cols.items())
+                )
+                dsig = ";".join(
+                    n + "="
+                    + _hashlib.blake2b(
+                        "\x00".join(map(str, d.tolist())).encode(),
+                        digest_size=8,
+                    ).hexdigest()
+                    for n, d in sorted((p.dicts or {}).items())
+                    if d is not None
+                )
+                parts.append(
+                    f"staged@{p.key}#cap{b.capacity}#{colsig}#{dsig}"
+                )
+            else:
+                parts.append(f"staged#{p.nonce}")
         kids = _plan_children(p)
         # child count disambiguates flat vs nested n-ary nodes
         # (UnionAll([U([A,B]),C]) vs UnionAll([U([A,B,C])]))
@@ -302,6 +352,22 @@ def _plan_children(p) -> List[L.LogicalPlan]:
             out.append(c)
     out.extend(getattr(p, "children", []) or [])
     return out
+
+
+def _staged_inputs(plan) -> Optional[Dict[str, "Batch"]]:
+    """Staged.key -> batch for every keyed staged node in the plan —
+    the runtime inputs a cached compile of this plan shape consumes
+    (None when the plan has none, the overwhelmingly common case)."""
+    out: Dict[str, Batch] = {}
+
+    def walk(p):
+        if isinstance(p, L.Staged) and p.key is not None:
+            out[p.key] = p.batch
+        for c in _plan_children(p):
+            walk(c)
+
+    walk(plan)
+    return out or None
 
 
 
@@ -835,6 +901,9 @@ class PlanCompiler:
         )
         self._next_id = 0
         self.scans: List[ScanSite] = []
+        #: (node id, Staged.key) for keyed staged inputs: the executor
+        #: feeds these batches at run time like scan inputs
+        self.staged_sites: List[Tuple[int, str]] = []
         self.sized: List[int] = []
         self.defaults: Dict[int, int] = {}
         # estimated bytes per row of each sized node's output schema:
@@ -973,6 +1042,7 @@ class PlanCompiler:
             fn=fn,
             out_tag=self._tag,
             scans=self.scans,
+            staged_sites=list(self.staged_sites),
             sized_nodes=self.sized,
             default_caps=dict(self.defaults),
             out_dicts=out,
@@ -995,11 +1065,23 @@ class PlanCompiler:
         if isinstance(plan, L.Staged):
             batch = plan.batch
             sdicts = dict(plan.dicts or {})
+            self._tag = "repl"
+            if plan.key is not None:
+                # runtime staged input: the executor feeds the batch
+                # per run (PhysicalExecutor collects keyed Staged
+                # nodes), so the cached program never pins stage data
+                # and fresh data reuses the compile
+                nid = self.fresh_id()
+                self.staged_sites.append((nid, plan.key))
+
+                def fn_staged_input(inputs, caps, _nid=nid):
+                    return inputs[_nid], {}
+
+                return fn_staged_input, sdicts
 
             def fn_staged(inputs, caps, _b=batch):
                 return _b, {}
 
-            self._tag = "repl"
             return fn_staged, sdicts
 
         if isinstance(plan, L.Scan):
@@ -2113,9 +2195,16 @@ class PhysicalExecutor:
         return (fp, tuple(versions))
 
     def _fetch_inputs(
-        self, cq: CompiledQuery, mesh=None, pins=None, resolved=None
+        self, cq: CompiledQuery, mesh=None, pins=None, resolved=None,
+        staged=None,
     ) -> Dict[int, Batch]:
         inputs = {}
+        for nid, skey in cq.staged_sites:
+            if staged is None or skey not in staged:
+                raise ExecError(
+                    f"keyed staged input {skey!r} missing at run time"
+                )
+            inputs[nid] = staged[skey]
         for s in cq.scans:
             t, v = self._resolve(s.db, s.table)
             if pins is not None:
@@ -2336,6 +2425,13 @@ class PhysicalExecutor:
         from tidb_tpu.planner.streamed import try_partitioned, try_streamed
         from tidb_tpu.utils.metrics import REGISTRY
 
+        # keyed staged inputs (shuffle consumers, the DCN final stage):
+        # their batches are fed at run time through _run_pinned — the
+        # streamed/partitioned re-chunkers compile their own pipelines
+        # and never feed staged sites, so keyed plans must take the
+        # compiled path only (their sources are already resident device
+        # batches; there is nothing to page in anyway)
+        staged = _staged_inputs(plan)
         # stale-width retry: programs bake integer key bounds as static
         # widths and verify them at run time; growth past them recompiles
         # against fresh bounds. The last attempts compile conservatively
@@ -2347,14 +2443,17 @@ class PhysicalExecutor:
                 hosted = try_host_agg(self, plan)
                 if hosted is not None:
                     return hosted
-                streamed = try_streamed(self, plan, conservative=conservative)
-                if streamed is not None:
-                    return streamed
-                parted = try_partitioned(
-                    self, plan, conservative=conservative
-                )
-                if parted is not None:
-                    return parted
+                if staged is None:
+                    streamed = try_streamed(
+                        self, plan, conservative=conservative
+                    )
+                    if streamed is not None:
+                        return streamed
+                    parted = try_partitioned(
+                        self, plan, conservative=conservative
+                    )
+                    if parted is not None:
+                        return parted
 
                 key = self._cache_key(plan)
                 cq = None if conservative else self._cache.get(key)
@@ -2375,15 +2474,17 @@ class PhysicalExecutor:
 
                 pins = []
                 try:
-                    return self._run_pinned(cq, pins)
+                    return self._run_pinned(cq, pins, staged=staged)
                 except ExecError as e:
                     # quota admission rejected the unpaged plan: retry
                     # with streaming FORCED — the aggregate's own
                     # working set fit the budget, but join tiles above
                     # it did not (the reference escalates the same way:
                     # memory-tracker pressure triggers spill actions,
-                    # pkg/util/memory/action.go)
-                    if "memory quota exceeded" in str(e):
+                    # pkg/util/memory/action.go). Keyed staged plans
+                    # never stream (see above): for them the quota
+                    # rejection surfaces as-is.
+                    if staged is None and "memory quota exceeded" in str(e):
                         forced = try_streamed(
                             self, plan, conservative=conservative,
                             force=True,
@@ -2407,10 +2508,13 @@ class PhysicalExecutor:
                     sp.pop(k, None)
         raise ExecError("packed key widths did not stabilize after recompiles")
 
-    def _run_pinned(self, cq: CompiledQuery, pins) -> Tuple[Batch, Dicts]:
+    def _run_pinned(
+        self, cq: CompiledQuery, pins, staged=None
+    ) -> Tuple[Batch, Dicts]:
         resolved = {}
         inputs = self._fetch_inputs(
-            cq, mesh=self.mesh, pins=pins, resolved=resolved
+            cq, mesh=self.mesh, pins=pins, resolved=resolved,
+            staged=staged,
         )
         # compile-time NULL-free assumptions: columns whose validity mask
         # was folded away must still be NULL-free at the fetched version
@@ -2505,7 +2609,9 @@ class PhysicalExecutor:
             return out, dicts, lines
         compiler = PlanCompiler(self.catalog, instrument=True, resolver=self._resolve)
         cq = compiler.compile(plan)
-        inputs = self._fetch_inputs(cq)  # unsharded: eager single-device
+        # unsharded: eager single-device (keyed staged batches fed like
+        # the run() path)
+        inputs = self._fetch_inputs(cq, staged=_staged_inputs(plan))
         out, _caps = self._discover(cq, inputs, jit=False)
         lines = []
         for nid, depth, label in compiler.node_labels:
@@ -2584,6 +2690,15 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
     frags = sorted(infos, key=lambda f: f.get("fid", 0))
     hosts = sorted({f.get("host", "?") for f in frags})
     total_rows = sum(int(f.get("rows", 0)) for f in frags)
+    # overlap: the share of total worker stage time NOT spent blocked
+    # idle in the store waits — the pipelining win made visible (a
+    # barrier stage idles through the whole exchange; a pipelined one
+    # decodes/stages on arrival while producers still run)
+    total_exec = float(stage.get("exec_s", 0.0)) or sum(
+        float(f.get("exec_s", 0.0)) for f in frags
+    )
+    idle = float(stage.get("wait_idle_s", 0.0))
+    overlap = max(0.0, 1.0 - idle / total_exec) if total_exec > 0 else 0.0
     summary = (
         f"DCNShuffle kind={stage.get('kind')} "
         f"partitions={stage.get('m')} hosts={len(hosts)} "
@@ -2594,7 +2709,11 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
         f"stalls={stage.get('stalls')} "
         f"retransmits={stage.get('retransmits')} "
         f"codec={stage.get('codec', 'json')} "
-        f"encode={float(stage.get('encode_s', 0.0))*1000:.2f}ms"
+        f"encode={float(stage.get('encode_s', 0.0))*1000:.2f}ms "
+        f"pipeline={'on' if stage.get('pipeline') else 'off'} "
+        f"overlap={overlap*100:.0f}% "
+        f"wait_idle={idle*1000:.2f}ms "
+        f"ttff={float(stage.get('ttff_s', 0.0))*1000:.2f}ms"
     )
     per_part = [
         (
@@ -2603,7 +2722,9 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
             f"rows={f.get('rows', 0)} "
             f"time={float(f.get('exec_s', 0.0))*1000:.2f}ms "
             f"pushed={f.get('pushed_bytes', 0)}B "
-            f"stalls={f.get('stalls', 0)}"
+            f"stalls={f.get('stalls', 0)} "
+            f"wait_idle={float(f.get('wait_idle_s', 0.0))*1000:.2f}ms "
+            f"ttff={float(f.get('ttff_s', 0.0))*1000:.2f}ms"
         )
         for f in frags
     ]

@@ -204,10 +204,16 @@ class EngineServer:
                             # server). Advertises the binary shuffle
                             # wire version for per-tunnel codec
                             # negotiation.
+                            # "ts" is this host's wall clock at reply
+                            # build: with the client's send/receive
+                            # timestamps it yields the RTT/2-anchored
+                            # clock-offset estimate that rebases worker
+                            # spans onto the coordinator timeline
                             resp = json.dumps(
                                 {
                                     "id": req_id, "ok": True,
                                     "wire": wire.WIRE_VERSION,
+                                    "ts": _time.time(),
                                 }
                             ).encode()
                         else:
@@ -322,6 +328,11 @@ class EngineServer:
                     [s.name, s.start_s, s.dur_s, s.depth]
                     for s in tracer.spans
                 ]
+                # this worker's wall clock at tracer reset: with the
+                # handshake clock-offset sample the coordinator rebases
+                # spans onto its own timeline instead of anchoring at
+                # reply receipt
+                resp["trace_t0"] = tracer.wall_t0
             # no byte count here: the coordinator measures the actual
             # reply frame length (EngineClient stamps _nbytes), which is
             # what really crossed the DCN link — and avoids serializing
@@ -376,23 +387,43 @@ class EngineServer:
         ).encode()
 
     def _shuffle_push_binary(self, frame: bytes) -> bytes:
-        """A peer worker's binary columnar tunnel frame: decode the
-        per-column buffers into a HostBlock and land it in the local
-        store. A frame that fails to decode (corruption, version skew
-        inside a negotiated stream — the shuffle/decode failpoint
-        injects both) is REJECTED with an error reply over the live
-        connection: the sender surfaces it as a non-retryable engine
-        error, so a corrupt frame aborts the stage instead of
-        masquerading as a peer death and triggering a pointless
-        stage retry."""
-        from tidb_tpu.parallel.shuffle import _c_decode_seconds
+        """A peer worker's binary columnar tunnel frame, decoded ON
+        ARRIVAL (the receive half of the shuffle pipeline — decode
+        overlaps the producers still in flight, and ShuffleStore waits
+        return already-decoded blocks). The exactly-once fences run
+        FIRST, off the header alone (wire.decode_header): a
+        stale-attempt or duplicate/retransmitted frame is dropped
+        before any column decode work is spent on it — and therefore
+        can never double-stage. A frame that fails to decode
+        (corruption, version skew inside a negotiated stream — the
+        shuffle/decode failpoint injects both) is REJECTED with an
+        error reply over the live connection: the sender surfaces it
+        as a non-retryable engine error, so a corrupt frame aborts the
+        stage instead of masquerading as a peer death and triggering a
+        pointless stage retry."""
+        from tidb_tpu.parallel.shuffle import (
+            _c_decode_on_arrival_seconds,
+            _c_decode_seconds,
+        )
         from tidb_tpu.utils.failpoint import inject
 
         inject("shuffle/recv")
+        store = self.shuffle_worker().store
         t0 = _time.perf_counter()
         try:
+            hdr = wire.decode_header(frame)
+            if not hdr["eof"] and not store.admits(
+                hdr["sid"], hdr["attempt"], hdr["side"], hdr["sender"],
+                hdr["seq"],
+            ):
+                # fenced from the header — no decode work wasted, and
+                # a retransmit can never double-stage
+                # shuffle-json-fallback: control-plane ack stays JSON
+                return json.dumps(
+                    {"id": hdr["id"], "ok": True, "accepted": False}
+                ).encode()
             inject("shuffle/decode")
-            pkt = wire.decode_frame(frame)
+            pkt = wire.decode_frame(frame, header=hdr)
         except Exception as e:
             # shuffle-json-fallback: the error REPLY is control-plane
             return json.dumps(
@@ -401,11 +432,11 @@ class EngineServer:
                     "error": f"ShuffleDecodeError: {e}",
                 }
             ).encode()
-        _c_decode_seconds().labels(codec="binary").inc(
-            _time.perf_counter() - t0
-        )
+        dec_s = _time.perf_counter() - t0
+        _c_decode_seconds().labels(codec="binary").inc(dec_s)
+        _c_decode_on_arrival_seconds().inc(dec_s)
         payload = pkt["block"]
-        accepted = self.shuffle_worker().store.push(
+        accepted = store.push(
             pkt["sid"], pkt["attempt"], pkt["m"], pkt["side"],
             pkt["sender"], pkt["seq"], payload, nseq=pkt["nseq"],
         )
@@ -466,6 +497,7 @@ class EngineServer:
             resp["spans"] = [
                 [s.name, s.start_s, s.dur_s, s.depth] for s in tracer.spans
             ]
+            resp["trace_t0"] = tracer.wall_t0
         if self.ship_registry:
             resp["registry"] = self._registry_delta()
         return json.dumps(resp).encode()
@@ -501,16 +533,33 @@ class EngineClient:
         self._secret = secret
         self._next_id = 0
         self._dead = False
-        if secret is not None:
-            # authenticate eagerly so bad credentials fail at connect
-            try:
-                resp = self._call({"auth": secret})
-            except Exception:
-                self._sock.close()
-                raise
-            if not resp.get("ok"):
-                self._sock.close()
-                raise PermissionError(resp.get("error", "auth failed"))
+        #: filled by the eager handshake below: the server's advertised
+        #: shuffle wire version (per-tunnel codec negotiation) and a
+        #: clock-offset sample — offset = server_ts - (t0 + t1)/2, the
+        #: classic request/reply RTT/2 anchor (error bounded by RTT/2).
+        #: The DCN scheduler uses the offset to rebase worker span
+        #: clocks onto the coordinator timeline.
+        self.server_wire = 0
+        self.clock_offset_s: Optional[float] = None
+        self.clock_rtt_s: Optional[float] = None
+        # one eager handshake per connection: authenticates (bad
+        # credentials fail at connect), learns the wire version, and
+        # samples the peer clock
+        try:
+            t0 = _time.time()
+            resp = self._call({} if secret is None else {"auth": secret})
+            t1 = _time.time()
+        except Exception:
+            self._sock.close()
+            raise
+        if not resp.get("ok"):
+            self._sock.close()
+            raise PermissionError(resp.get("error", "auth failed"))
+        self.server_wire = int(resp.get("wire", 0))
+        ts = resp.get("ts")
+        if ts is not None:
+            self.clock_rtt_s = t1 - t0
+            self.clock_offset_s = float(ts) - (t0 + t1) / 2.0
 
     def _call(self, req: dict) -> dict:
         """One correlated request/response. Any transport error or id
@@ -581,17 +630,61 @@ class EngineClient:
         correlation id / auth are spliced in at the byte level by the
         shared wire.splice_id_auth helper instead of re-encoding the
         rows on the tunnel thread."""
+        return self.shuffle_push_encoded_many([payload])[0]
+
+    def shuffle_push_encoded_many(self, payloads) -> List[bool]:
+        """Pipelined shuffle push: write EVERY payload's frame onto the
+        socket back to back, THEN read the acks in order — one wire
+        round trip amortized over the batch instead of a synchronous
+        request/response per packet (the per-frame ack latency was the
+        dominant serial tail of a shuffle push stream; the server's
+        per-connection loop replies in order, so request pipelining is
+        safe). Any transport loss or id mismatch poisons the
+        connection; the caller (PeerTunnel) reconnects and retransmits
+        the WHOLE unacked batch — the receiver's seq dedupe makes that
+        exactly-once."""
         if self._dead:
             raise ConnectionError("engine connection is poisoned; reconnect")
-        self._next_id += 1
-        resp = self._roundtrip(
-            wire.splice_id_auth(payload, self._next_id, self._secret)
-        )
-        if not resp.get("ok"):
-            raise RuntimeError(
-                f"shuffle push rejected: {resp.get('error', '')}"
+        ids = []
+        out = bytearray()
+        for payload in payloads:
+            self._next_id += 1
+            ids.append(self._next_id)
+            frame = wire.splice_id_auth(
+                payload, self._next_id, self._secret
             )
-        return bool(resp.get("accepted"))
+            if len(frame) > MAX_FRAME:
+                raise ValueError(
+                    f"request of {len(frame)}B exceeds {MAX_FRAME}B"
+                )
+            out += struct.pack("<I", len(frame)) + frame
+        accepted: List[bool] = []
+        try:
+            self._sock.sendall(out)
+            for want_id in ids:
+                frame = _recv_frame(self._sock)
+                if frame is None:
+                    raise ConnectionError("engine closed the connection")
+                resp = json.loads(frame.decode())
+                if resp.get("id") != want_id:
+                    raise ConnectionError(
+                        f"response id {resp.get('id')} != request id "
+                        f"{want_id}"
+                    )
+                if not resp.get("ok"):
+                    raise RuntimeError(
+                        f"shuffle push rejected: {resp.get('error', '')}"
+                    )
+                accepted.append(bool(resp.get("accepted")))
+        except Exception:
+            # transport loss, id desync, OR an engine-side rejection
+            # mid-batch (replies for the rest of the batch are still
+            # queued on the stream): poison the connection so stale
+            # replies can never correlate to later requests
+            self._dead = True
+            self._sock.close()
+            raise
+        return accepted
 
     def execute_plan(
         self, plan, schema_version: Optional[int] = None, frag=None

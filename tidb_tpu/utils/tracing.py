@@ -28,12 +28,18 @@ class Tracer:
         self.spans: List[Span] = []
         self._depth = 0
         self._t0: Optional[float] = None
+        #: wall-clock time of the last reset(): the cross-process span
+        #: anchor — a remote worker ships its own wall_t0 and the
+        #: coordinator rebases via the handshake-sampled clock offset
+        #: (parallel/dcn.py _merge_remote_spans)
+        self.wall_t0: Optional[float] = None
         self.enabled = False
 
     def reset(self) -> None:
         self.spans = []
         self._depth = 0
         self._t0 = time.perf_counter()
+        self.wall_t0 = time.time()
 
     @contextlib.contextmanager
     def span(self, name: str):
