@@ -220,6 +220,30 @@ def _emit_metrics(args, result, before: dict, after=None) -> None:
                 },
                 f, indent=1,
             )
+    _write_flight_out(args)
+
+
+def _write_flight_out(args) -> None:
+    """--flight-out: snapshot the flight recorder's view of the bench
+    run — per-query phase timelines, the per-digest statements summary
+    (percentiles + mean phase breakdown + engine columns) and the DCN
+    link registry — to a JSON file. The same breakdown
+    information_schema serves, captured for the bench ladder."""
+    path = getattr(args, "flight_out", None)
+    if not path:
+        return
+    from tidb_tpu.obs.flight import FLIGHT, LINKS
+    from tidb_tpu.utils.metrics import STMT_SUMMARY
+
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "flights": FLIGHT.rows(),
+                "statements": STMT_SUMMARY.rows_full(),
+                "links": LINKS.snapshot(),
+            },
+            f, indent=1,
+        )
 
 
 def measure(args) -> int:
@@ -959,6 +983,50 @@ def measure_multihost_shuffle(args) -> int:
                     sched.close()
             return out
 
+        # flight-recorder attribution through the session routing path
+        # (PR 6): the SAME query executed as SQL with the scheduler
+        # ATTACHED — statements_summary picks up the worker-reported
+        # shuffle phase breakdown, and --flight-out snapshots it
+        def run_flight_attributed():
+            from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+            sched = DCNFragmentScheduler(
+                [("127.0.0.1", pt) for pt in ports],
+                catalog=cat, shuffle_mode="always",
+            )
+            sess.attach_dcn_scheduler(sched)
+            try:
+                for _ in range(max(args.repeat, 2)):
+                    sess.execute(sql)
+            finally:
+                sess.attach_dcn_scheduler(None)
+                sched.close()
+            ent = next(
+                (
+                    e for e in STMT_SUMMARY.rows_full()
+                    if e["digest_text"] == sql_digest(sql)
+                ),
+                None,
+            )
+            if ent is None:
+                return None
+            n = max(ent["exec_count"], 1)
+            return {
+                "exec_count": ent["exec_count"],
+                "p50_latency_s": round(ent["p50_latency"], 6),
+                "p99_latency_s": round(ent["p99_latency"], 6),
+                "avg_phase_seconds": {
+                    p: round(v[0] / n, 6)
+                    for p, v in sorted(ent["phases"].items())
+                },
+                "shuffle_bytes": ent["phases"].get(
+                    "shuffle-push", (0.0, 0, 0)
+                )[1],
+                "rows_sent": ent["rows_sent"],
+            }
+
+        flight_breakdown = run_flight_attributed()
+
         ab = run_pipeline_pairs(pairs=max(args.repeat, 5))
         assert tunnel["result"] == staged["result"], "mode parity broke"
         assert tunnel_json["result"] == staged["result"], (
@@ -1053,6 +1121,10 @@ def measure_multihost_shuffle(args) -> int:
                 },
                 "codec_ab": codec_ab,
                 "pipeline_ab": pipeline_ab,
+                # the flight recorder's per-digest view of this query
+                # (phase means, percentiles) — the information_schema.
+                # statements_summary breakdown as the bench sees it
+                "flight": flight_breakdown,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",
@@ -1067,6 +1139,7 @@ def measure_multihost_shuffle(args) -> int:
     finally:
         for p in workers:
             p.kill()
+    _write_flight_out(args)
     rc = 0
     if args.out:
         args.cpu = True  # deliberate CPU scenario: not a fallback
@@ -1108,6 +1181,14 @@ def main() -> int:
         "stamped into detail.engine_metrics of the result",
     )
     ap.add_argument(
+        "--flight-out", default=None, metavar="FILE",
+        help="snapshot the query flight recorder after the run — "
+        "per-query phase timelines, the per-digest statements summary "
+        "(p50/p95/p99 + mean phase breakdown + engine columns) and the "
+        "DCN link registry — to this JSON file (the information_schema "
+        "breakdown, captured for the bench ladder)",
+    )
+    ap.add_argument(
         "--multihost-shuffle", action="store_true",
         help="run the 2-worker DCN shuffle-join dryrun instead of the "
         "single-engine ladder: measures a repartition-join query "
@@ -1133,6 +1214,8 @@ def main() -> int:
     passthrough = ["--sf", str(args.sf), "--query", args.query, "--repeat", str(args.repeat)]
     if args.metrics_out:
         passthrough += ["--metrics-out", args.metrics_out]
+    if args.flight_out:
+        passthrough += ["--flight-out", args.flight_out]
     return supervise(args, passthrough)
 
 

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Lint: flight-recorder phase names declared in obs/flight.py PHASES
+must match the literal ``note_phase(...)`` call sites, and every
+declared phase must be charged somewhere.
+
+Why: the phase vocabulary is an API — statements_summary's avg_*
+columns, the slow-log `# Phases` line and the tidbtpu_flight_phase_
+seconds{phase} series all key on it. ``note_phase`` already rejects
+undeclared names at runtime, but a dead declaration (a phase nothing
+charges) silently rots into an always-zero column; the same pattern as
+scripts/check_failpoints.py for failpoint SITES. Two rules:
+
+  1. every literal ``note_phase("name", ...)`` site in engine code
+     must name a declared phase (the runtime check made static);
+  2. every name in PHASES must have at least one literal
+     ``note_phase("name")`` call site OR be produced by
+     note_shuffle_stage (the shuffle-* quartet is charged there from
+     the worker-reported stage stats).
+
+Usage: python scripts/check_flight_phases.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+NOTE = re.compile(r"\bnote_phase\(\s*[\"']([^\"']+)[\"']")
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules"}
+#: the registry itself (note_shuffle_stage charges the shuffle phases
+#: with literal names — those count as call sites, handled below), the
+#: lint, and the lint's own test quote undeclared names deliberately
+SKIP_FILES = {
+    os.path.join("scripts", "check_flight_phases.py"),
+    os.path.join("tests", "test_flight_phases.py"),
+}
+
+
+def load_phases(root: str):
+    """The PHASES literal, read via the AST (flight.py imports the
+    package, so exec'ing it standalone — the failpoint lint's approach
+    — would need the whole engine importable from the lint)."""
+    path = os.path.join(root, "tidb_tpu", "obs", "flight.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "PHASES"
+            for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise SystemExit(f"PHASES assignment not found in {path}")
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check(root: str):
+    phases = load_phases(root)
+    declared = set(phases)
+    if len(phases) != len(declared):
+        return [("tidb_tpu/obs/flight.py", 1, "duplicate names in PHASES")]
+    violations = []
+    used = {}
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        if rel in SKIP_FILES:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in NOTE.finditer(text):
+            name = m.group(1)
+            line = text.count("\n", 0, m.start()) + 1
+            used.setdefault(name, (rel, line))
+            if name not in declared:
+                violations.append(
+                    (rel, line,
+                     f"undeclared flight phase {name!r} (declare it in "
+                     "tidb_tpu/obs/flight.py PHASES)")
+                )
+    for name in phases:
+        if name not in used:
+            violations.append(
+                ("tidb_tpu/obs/flight.py", 1,
+                 f"declared flight phase {name!r} has no note_phase() "
+                 "call site (dead declaration)")
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} flight-phase violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,15 @@
 #!/usr/bin/env python
 """Lint: every metric registered on the global REGISTRY follows the
-``tidbtpu_<subsystem>_<name>`` naming convention.
+``tidbtpu_<subsystem>_<name>`` naming convention, with the subsystem
+token drawn from the DECLARED registry below.
 
 Why: metric names are an API — dashboards, alert rules and the BENCH
 metrics snapshots all key on them. A drifting prefix (tidb_tpu_ vs
-tidbtpu_ vs tidbtpu-) silently forks the series. The convention:
-lowercase, ``tidbtpu_`` prefix, then a subsystem token (engine, dcn,
-session, executor, watchdog, ttl, stats, ...), then the metric name.
+tidbtpu_ vs tidbtpu-) silently forks the series, and so does a
+drifting subsystem token (tidbtpu_flight_ vs tidbtpu_flights_):
+SUBSYSTEMS is the closed vocabulary (the failpoint-SITES pattern) — a
+new family (e.g. PR 6's ``flight`` and ``link``) is declared here
+FIRST, then used.
 
 Scans every ``REGISTRY.counter/gauge/histogram("literal", ...)`` call
 site (multi-line calls included) outside tests/. Non-literal names are
@@ -22,10 +25,27 @@ import os
 import re
 import sys
 
+#: the declared subsystem vocabulary. dcn = fragment scheduler,
+#: shuffle = worker-to-worker data plane, engine = TPU engine watch,
+#: flight = the query flight recorder, link = per-peer DCN link health
+#: (both PR 6).
+SUBSYSTEMS = frozenset({
+    "dcn",
+    "engine",
+    "executor",
+    "flight",
+    "link",
+    "session",
+    "shuffle",
+    "stats",
+    "ttl",
+    "watchdog",
+})
+
 CALL = re.compile(
     r"(?:REGISTRY|_REG)\s*\.\s*(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']"
 )
-NAME = re.compile(r"^tidbtpu_[a-z][a-z0-9]*_[a-z][a-z0-9_]*$")
+NAME = re.compile(r"^tidbtpu_([a-z][a-z0-9]*)_[a-z][a-z0-9_]*$")
 SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules", "tests"}
 SKIP_FILES = {os.path.join("scripts", "check_metric_names.py")}
 
@@ -51,14 +71,21 @@ def check(root: str):
             continue
         for m in CALL.finditer(text):
             name = m.group(1)
-            if NAME.match(name):
-                continue
+            nm = NAME.match(name)
             line = text.count("\n", 0, m.start()) + 1
-            violations.append(
-                (rel, line,
-                 f"metric name {name!r} violates the "
-                 "tidbtpu_<subsystem>_<name> convention")
-            )
+            if not nm:
+                violations.append(
+                    (rel, line,
+                     f"metric name {name!r} violates the "
+                     "tidbtpu_<subsystem>_<name> convention")
+                )
+            elif nm.group(1) not in SUBSYSTEMS:
+                violations.append(
+                    (rel, line,
+                     f"metric name {name!r} uses undeclared subsystem "
+                     f"{nm.group(1)!r} (declare it in SUBSYSTEMS, "
+                     "scripts/check_metric_names.py)")
+                )
     return violations
 
 

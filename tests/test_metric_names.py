@@ -30,6 +30,10 @@ def test_lint_catches_violations(tmp_path):
         '    "noprefix_gauge", "help"\n'                          # bad, multiline
         ').set(1)\n'
         'REGISTRY.histogram("tidbtpu_engine_good_seconds").observe(1)\n'
+        # well-formed but the subsystem token is not in the declared
+        # SUBSYSTEMS registry (the PR 6 vocabulary lint)
+        'REGISTRY.counter("tidbtpu_flights_undeclared_total").inc()\n'
+        'REGISTRY.counter("tidbtpu_link_frames_total").inc()\n'   # declared
     )
     tests = tmp_path / "tests"
     tests.mkdir()
@@ -44,4 +48,7 @@ def test_lint_catches_violations(tmp_path):
     assert "tidb_tpu_old_style_total" in proc.stdout
     assert "noprefix_gauge" in proc.stdout
     assert "tidbtpu_engine_good_seconds" not in proc.stdout
+    assert "tidbtpu_flights_undeclared_total" in proc.stdout
+    assert "undeclared subsystem" in proc.stdout
+    assert "tidbtpu_link_frames_total" not in proc.stdout
     assert "test_y.py" not in proc.stdout  # tests/ exempt

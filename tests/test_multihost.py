@@ -362,6 +362,108 @@ def _shuffle_codec_ab_body(tpch_single, p1, p2):
         sched_json.close()
 
 
+def test_dcn_flight_recorder_surfaces(tpch_single, tmp_path):
+    """PR 6 acceptance: a 2-process x 4-device shuffle dryrun driven
+    through the SESSION (an attached scheduler now routes fragmentable
+    SELECTs across the fleet, not just EXPLAIN ANALYZE) lands all
+    three flight-recorder surfaces:
+
+    - statements_summary rows with NON-ZERO shuffle-wait phase time
+      and p99 >= p50 (the per-digest streaming histogram);
+    - slow_query rows carrying captured EXPLAIN ANALYZE text (the
+      instrumented lines for an over-threshold EXPLAIN ANALYZE, the
+      plan tree + distributed runtime summary for a routed SELECT),
+      also written to the tidb_slow_query_file sink;
+    - cluster_links rows with per-peer RTT and stall seconds.
+    """
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+    )
+    sess = tpch_single
+    q = SHUFFLE_QUERIES[0]
+    exp = sess.must_query(q).rows  # local reference BEFORE attaching
+    sess.attach_dcn_scheduler(sched)
+    try:
+        sess.execute("set tidb_slow_log_threshold = 0")
+        slow_file = tmp_path / "slow.log"
+        sess.execute(f"set tidb_slow_query_file = '{slow_file}'")
+        for _ in range(3):
+            r = sess.execute(q)
+            assert r.rows == exp  # scheduler-routed result parity
+
+        # -- statements_summary: shuffle phases + percentiles ----------
+        d = sql_digest(q)
+        ent = next(
+            e for e in STMT_SUMMARY.rows_full() if e["digest_text"] == d
+        )
+        assert ent["phases"]["shuffle-wait"][0] > 0
+        assert ent["phases"]["shuffle-produce"][0] > 0
+        assert ent["phases"]["shuffle-push"][1] > 0  # tunneled bytes
+        assert ent["phases"]["fragment-dispatch"][0] > 0
+        assert ent["p99_latency"] >= ent["p50_latency"] > 0
+        r = sess.must_query(
+            "select avg_shuffle_wait, p50_latency, p99_latency,"
+            " shuffle_bytes from information_schema.statements_summary"
+            f" where digest_text = '{d}'"
+        )
+        avg_wait, p50, p99, sbytes = r.rows[0]
+        assert avg_wait > 0 and p99 >= p50 > 0 and sbytes > 0
+
+        # -- slow_query: captured EXPLAIN ANALYZE / plan text ----------
+        sess.execute(f"explain analyze {q}")
+        r = sess.must_query(
+            "select query, plan from information_schema.slow_query"
+            " where plan != ''"
+        )
+        routed_plans = [p for (txt, p) in r.rows if txt == q]
+        assert routed_plans and any(
+            "DCNShuffle" in p for p in routed_plans
+        ), "routed SELECT's capture lacks the distributed summary"
+        ea_plans = [
+            p for (txt, p) in r.rows if txt == f"explain analyze {q}"
+        ]
+        assert ea_plans and any("DCNShuffle" in p for p in ea_plans), (
+            "EXPLAIN ANALYZE capture is not the instrumented text"
+        )
+        text = slow_file.read_text()
+        assert "# Query_time:" in text and "# Phases:" in text
+        assert "# Plan: " in text and "DCNShuffle" in text
+
+        # -- cluster_links: per-peer link health -----------------------
+        sched.heartbeat.beat_once()
+        r = sess.must_query(
+            "select kind, dst, rtt_ms, heartbeat_age_s, stall_seconds,"
+            " bytes, frames, codec from"
+            " information_schema.cluster_links"
+        )
+        controls = [row for row in r.rows if row[0] == "control"]
+        tunnels = [row for row in r.rows if row[0] == "tunnel"]
+        worker_addrs = {f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"}
+        assert worker_addrs <= {row[1] for row in controls}
+        assert any(row[2] > 0 for row in controls)  # handshake RTT
+        assert all(row[3] >= 0 for row in controls)  # heartbeat age
+        # worker-to-worker tunnels merged from fenced shuffle replies:
+        # real bytes/frames per link, stall seconds present (>= 0)
+        assert any(
+            row[1] in worker_addrs and row[5] > 0 and row[6] > 0
+            for row in tunnels
+        )
+        assert all(row[4] >= 0.0 for row in tunnels)
+        assert any(row[7] == "binary" for row in tunnels)
+    finally:
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        for w in (w1, w2):
+            w.kill()
+
+
 def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
     """Failpoint-killed worker MID-SHUFFLE with PIPELINING ON: worker 2
     hard-exits on the first partition packet a peer pushes to it (the

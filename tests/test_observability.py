@@ -254,6 +254,412 @@ class TestHTTPStatus:
         assert body["enabled"] is True and body["alive"] == 2
 
 
+class TestSqlDigestInLists:
+    """Satellite: IN-lists of literals collapse to one digest element
+    (reference digester behavior) so statements_summary does not
+    fragment per literal count."""
+
+    def test_in_list_lengths_share_a_digest(self):
+        a = sql_digest("select * from t where a in (1, 2, 3)")
+        b = sql_digest("select * from t where a in (9)")
+        c = sql_digest("select * from t where a in (1,2,3,4,5,6,7,8)")
+        assert a == b == c
+        assert "( ... )" in a
+
+    def test_string_literals_collapse_too(self):
+        a = sql_digest("select 1 from t where s in ('x', 'y')")
+        b = sql_digest("select 1 from t where s in ('zzz')")
+        assert a == b
+
+    def test_not_in_and_surrounding_structure_kept(self):
+        a = sql_digest("select 1 from t where a not in (1, 2) and b = 3")
+        assert "not in ( ... )" in a and "b = ?" in a
+
+    def test_subquery_and_mixed_lists_do_not_collapse(self):
+        sub = sql_digest("select 1 from t where a in (select a from u)")
+        assert "..." not in sub
+        mixed = sql_digest("select 1 from t where a in (1, b)")
+        assert "..." not in mixed  # non-literal member: structure kept
+
+    def test_summary_rows_do_not_fragment(self, sess):
+        sess.execute("create table obs_inl (a bigint)")
+        sess.execute("insert into obs_inl values (1),(2),(3)")
+        sess.execute("select count(*) from obs_inl where a in (1)")
+        sess.execute("select count(*) from obs_inl where a in (1, 2)")
+        sess.execute("select count(*) from obs_inl where a in (1, 2, 3)")
+        r = sess.must_query(
+            "select exec_count from information_schema.statements_summary"
+            " where digest_text like '%obs_inl where a in ( ... )'"
+        )
+        assert len(r.rows) == 1 and r.rows[0][0] >= 3
+
+
+class TestStreamingHistogram:
+    """Satellite: the statements_summary percentile estimator."""
+
+    def test_quantiles_monotone_and_ordered(self):
+        from tidb_tpu.utils.metrics import StreamingHistogram
+
+        h = StreamingHistogram("t")
+        import random
+
+        rnd = random.Random(7)
+        for _ in range(500):
+            h.observe(rnd.uniform(0.0005, 1.5))
+        qs = [h.quantile(q) for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0)]
+        assert qs == sorted(qs)
+        assert h.quantile(0.99) >= h.quantile(0.5) > 0
+
+    def test_quantile_brackets_true_value(self):
+        from tidb_tpu.utils.metrics import Histogram, StreamingHistogram
+
+        h = StreamingHistogram("t")
+        for _ in range(100):
+            h.observe(0.01)  # all in the (0.005, 0.02] bucket
+        for q in (0.1, 0.5, 0.9):
+            assert 0.005 <= h.quantile(q) <= 0.02
+        # interpolation is linear in rank within the bucket
+        assert h.quantile(0.9) > h.quantile(0.1)
+        assert tuple(StreamingHistogram.BUCKETS) == tuple(Histogram.BUCKETS)
+
+    def test_empty_and_overflow(self):
+        from tidb_tpu.utils.metrics import StreamingHistogram
+
+        h = StreamingHistogram("t")
+        assert h.quantile(0.5) == 0.0
+        h.observe(100.0)  # beyond the last bucket edge
+        assert h.quantile(0.5) >= StreamingHistogram.BUCKETS[-1]
+
+
+class TestFlightRecorder:
+    """Tentpole: always-on per-query phase timelines (obs/flight.py)."""
+
+    def test_ring_bounds(self):
+        from tidb_tpu.obs.flight import FlightRecorder
+
+        f = FlightRecorder(capacity=8)
+        for i in range(50):
+            f.begin(f"select {i}")
+            f.note_phase("parse", 0.001)
+            f.finish(0.01)
+        rows = f.rows()
+        assert len(rows) == 8
+        # oldest evicted: the survivors are the last 8
+        assert [r["sql"] for r in rows] == [
+            f"select {i}" for i in range(42, 50)
+        ]
+
+    def test_thread_safety_under_concurrent_sessions(self):
+        """Each thread's notes land on ITS flight (thread-local
+        current record), and concurrent finishes never corrupt the
+        ring."""
+        import threading
+
+        from tidb_tpu.obs.flight import FlightRecorder
+
+        f = FlightRecorder(capacity=4096)
+        errs = []
+
+        def worker(k):
+            try:
+                for i in range(50):
+                    f.begin(f"w{k}", conn_id=k)
+                    f.note_phase("execute", 0.001 * (k + 1))
+                    f.note_phase("plan", 0.0001)
+                    rec = f.finish(0.01)
+                    assert rec is not None and rec.conn_id == k
+                    assert rec.phases["execute"][0] == pytest.approx(
+                        0.001 * (k + 1)
+                    )
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        rows = f.rows()
+        assert len(rows) == 8 * 50
+        by_conn = {}
+        for r in rows:
+            by_conn.setdefault(r["conn_id"], []).append(r)
+        assert all(len(v) == 50 for v in by_conn.values())
+
+    def test_session_statement_lands_phases_and_engine_join(self):
+        """A real statement's flight carries parse/plan/execute phases
+        and the engine-watch join, and statements_summary's joined
+        columns (p50<=p99, jit compilations, plan-cache attribution)
+        reflect it."""
+        from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+        sess = Session(Catalog())
+        sess.execute("create table obs_fl (a bigint, b bigint)")
+        sess.execute("insert into obs_fl values (1, 2),(3, 4)")
+        for _ in range(3):  # identical text: repeats hit the plan cache
+            sess.execute("select sum(a * b) from obs_fl where a > 0")
+        d = sql_digest("select sum(a * b) from obs_fl where a > 0")
+        ent = next(
+            e for e in STMT_SUMMARY.rows_full() if e["digest_text"] == d
+        )
+        assert ent["exec_count"] >= 3
+        assert 0 < ent["p50_latency"] <= ent["p95_latency"] <= ent["p99_latency"]
+        ph = ent["phases"]
+        for phase in ("parse", "plan", "execute"):
+            assert ph[phase][0] > 0, phase
+        # engine-watch join: the first execution compiled
+        assert ent["jit_compilations"] >= 1
+        assert ent["plan_cache_hits"] + ent["plan_cache_misses"] >= 3
+        assert ent["plan_cache_hits"] >= 1  # repeats reuse the plan
+        assert ent["rows_sent"] >= 3
+        assert ent["plan_digest"]
+        # the same breakdown through the SQL surface
+        r = sess.must_query(
+            "select p50_latency, p99_latency, avg_execute,"
+            " plan_cache_hits, jit_compilations from"
+            f" information_schema.statements_summary"
+            f" where digest_text = '{d}'"
+        )
+        p50, p99, avg_exec, hits, jit = r.rows[0]
+        assert 0 < p50 <= p99 and avg_exec > 0
+        assert hits >= 1 and jit >= 1
+
+    def test_trace_spans_and_flight_phases_agree(self):
+        """TRACE spans and the flight recorder time the same walls:
+        the traced statement's session.plan / executor.run span totals
+        must match its flight's plan / execute phases (both sides of
+        the shared timeline, within scheduling noise)."""
+        from tidb_tpu.obs.flight import FLIGHT
+
+        sess = Session(Catalog())
+        sess.execute("create table obs_tr (a bigint)")
+        sess.execute("insert into obs_tr values (1),(2)")
+        sess.execute("select sum(a) from obs_tr")  # pre-compile
+        sess.execute("trace select sum(a) from obs_tr")
+        flight = FLIGHT.rows()[-1]
+        assert flight["sql"].startswith("trace ")
+        spans = sess.tracer.totals_by_name()
+        ph = flight["phases"]
+        assert spans["session.plan"] == pytest.approx(
+            ph["plan"]["seconds"], rel=0.5, abs=0.01
+        )
+        assert spans["executor.run"] == pytest.approx(
+            ph["execute"]["seconds"], rel=0.5, abs=0.05
+        )
+
+    def test_error_statement_discards_open_flight(self):
+        from tidb_tpu.obs.flight import FLIGHT
+
+        sess = Session(Catalog())
+        with pytest.raises(Exception):
+            sess.execute("select * from obs_no_such_table_xyz")
+        assert FLIGHT.current() is None  # not leaked into the next stmt
+
+
+class TestSlowQueryCapture:
+    """Tentpole surface 2: slow_query grows the phase timeline + plan
+    capture, honoring slow_query_log / tidb_slow_log_threshold /
+    tidb_record_plan_in_slow_log / tidb_slow_query_file."""
+
+    def test_phase_timeline_and_plan_columns(self, sess):
+        sess.execute("create table obs_sq (a bigint)")
+        sess.execute("insert into obs_sq values (1),(2)")
+        sess.execute("set tidb_slow_log_threshold = 0")
+        sess.execute("select count(*) from obs_sq where a > 0")
+        r = sess.must_query(
+            "select query, phases, plan, conn_id from"
+            " information_schema.slow_query"
+            " where query like '%obs_sq where a > 0'"
+        )
+        assert r.rows
+        _q, phases, plan, conn_id = r.rows[-1]
+        assert "execute=" in phases and "plan=" in phases
+        assert "obs_sq" in plan  # captured plan tree scans the table
+        assert conn_id == sess.conn_id
+
+    def test_slow_query_log_switch_gates(self, sess):
+        sess.execute("create table obs_sq2 (a bigint)")
+        sess.execute("insert into obs_sq2 values (1)")
+        sess.execute("set tidb_slow_log_threshold = 0")
+        sess.execute("set slow_query_log = 0")
+        sess.execute("select count(*) from obs_sq2")
+        r = sess.must_query(
+            "select count(*) from information_schema.slow_query"
+            " where query like '%obs_sq2'"
+        )
+        assert r.rows[0][0] == 0
+        sess.execute("set slow_query_log = 1")
+        sess.execute("select count(*) from obs_sq2")
+        r = sess.must_query(
+            "select count(*) from information_schema.slow_query"
+            " where query like '%obs_sq2'"
+        )
+        assert r.rows[0][0] >= 1
+
+    def test_record_plan_switch(self, sess):
+        sess.execute("create table obs_sq3 (a bigint)")
+        sess.execute("insert into obs_sq3 values (1)")
+        sess.execute("set tidb_slow_log_threshold = 0")
+        sess.execute("set tidb_record_plan_in_slow_log = 0")
+        sess.execute("select count(*) from obs_sq3")
+        r = sess.must_query(
+            "select plan from information_schema.slow_query"
+            " where query like '%obs_sq3'"
+        )
+        assert r.rows and r.rows[-1][0] == ""
+        # the switch gates the EXPLAIN ANALYZE capture path too (the
+        # instrumented lines stashed on the flight, not just the
+        # rendered plan tree)
+        sess.execute("explain analyze select count(*) from obs_sq3")
+        r = sess.must_query(
+            "select plan from information_schema.slow_query"
+            " where query like 'explain analyze%obs_sq3'"
+        )
+        assert r.rows and r.rows[-1][0] == ""
+
+    def test_dcn_routing_guards_local_only_scans(self):
+        """An attached scheduler must never see plans that scan
+        coordinator-only state: system schemas and '_'-prefixed
+        internal dbs (recursive-CTE scratch) run locally."""
+        sess = Session(Catalog())
+        sess.execute("create table obs_rt (a bigint)")
+        sess.execute("insert into obs_rt values (1),(2)")
+
+        class TripwireSched:
+            def _choose_cut(self, plan):  # pragma: no cover - tripwire
+                raise AssertionError(
+                    "local-only statement offered to the fleet"
+                )
+
+        sess.attach_dcn_scheduler(TripwireSched())
+        try:
+            r = sess.execute(
+                "select count(*) from information_schema.tables"
+            )
+            assert r.rows
+            r = sess.execute(
+                "with recursive nums(n) as (select 1 union all"
+                " select n + 1 from nums where n < 3)"
+                " select count(*) from nums"
+            )
+            assert r.rows == [(3,)]
+        finally:
+            sess.attach_dcn_scheduler(None)
+
+    def test_dcn_routing_falls_back_locally_on_fleet_failure(self):
+        """A fleet that cannot serve a routed SELECT (all workers
+        lost, a coordinator-only table) must not fail the statement:
+        the local engine takes over, counted under the fallback
+        metric."""
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        sess = Session(Catalog())
+        sess.execute("create table obs_fb (a bigint)")
+        sess.execute("insert into obs_fb values (1),(2),(3)")
+
+        class DeadFleetSched:
+            def _choose_cut(self, plan):
+                return "frag", object()
+
+            def execute_plan(self, plan, cut_hint=None):
+                raise ConnectionError("no alive worker host")
+
+        sess.attach_dcn_scheduler(DeadFleetSched())
+        try:
+            before = REGISTRY.counter(
+                "tidbtpu_session_dcn_route_fallbacks_total"
+            ).value
+            r = sess.execute("select count(*) from obs_fb")
+            assert r.rows == [(3,)]  # served locally
+            after = REGISTRY.counter(
+                "tidbtpu_session_dcn_route_fallbacks_total"
+            ).value
+            assert after == before + 1
+        finally:
+            sess.attach_dcn_scheduler(None)
+
+    def test_slow_query_file_sink(self, sess, tmp_path):
+        path = tmp_path / "slow.log"
+        sess.execute("create table obs_sq4 (a bigint)")
+        sess.execute("insert into obs_sq4 values (1)")
+        sess.execute("set tidb_slow_log_threshold = 0")
+        sess.execute(f"set tidb_slow_query_file = '{path}'")
+        sess.execute("select count(*) from obs_sq4")
+        text = path.read_text()
+        assert "# Time:" in text and "# Query_time:" in text
+        assert "# Phases:" in text and "# Plan:" in text
+        assert "select count(*) from obs_sq4;" in text
+
+    def test_explain_analyze_text_captured(self, sess):
+        """An over-threshold EXPLAIN ANALYZE's slow-log entry carries
+        the instrumented plan lines themselves."""
+        sess.execute("create table obs_sq5 (a bigint)")
+        sess.execute("insert into obs_sq5 values (1),(2),(3)")
+        sess.execute("set tidb_slow_log_threshold = 0")
+        sess.execute("explain analyze select count(*) from obs_sq5")
+        r = sess.must_query(
+            "select plan from information_schema.slow_query"
+            " where query like 'explain analyze%obs_sq5'"
+        )
+        assert r.rows
+        plan = r.rows[-1][0]
+        # run_analyze lines carry runtime stats, not just the tree
+        assert "Aggregate" in plan and "time=" in plan
+
+
+def test_links_endpoint_and_cluster_links_table():
+    """Tentpole surface 3: /links + information_schema.cluster_links
+    read the link registry (control-link health populated here via the
+    registry API; the multihost dryrun exercises the real handshake
+    and tunnel merges)."""
+    import time as _time
+
+    from tidb_tpu.obs.flight import LINKS
+    from tidb_tpu.server.http_status import StatusServer
+
+    LINKS.note_handshake("127.0.0.1:9999", rtt_s=0.002, offset_s=0.0001)
+    LINKS.note_tunnel(
+        "127.0.0.1:9999", "127.0.0.1:9998",
+        {"bytes": 1024, "frames": 3, "rows": 10, "stalls": 1,
+         "stall_s": 0.5, "retransmits": 2, "codec": "binary"},
+    )
+    cat = Catalog()
+    sess = Session(cat)
+    r = sess.must_query(
+        "select src, dst, kind, rtt_ms, stall_seconds, retransmits,"
+        " codec from information_schema.cluster_links"
+        " where dst like '127.0.0.1:999%'"
+    )
+    by_kind = {row[2]: row for row in r.rows}
+    assert by_kind["control"][1] == "127.0.0.1:9999"
+    assert by_kind["control"][3] == pytest.approx(2.0)  # rtt ms
+    assert by_kind["tunnel"][4] == pytest.approx(0.5)   # stall seconds
+    assert by_kind["tunnel"][5] == 2 and by_kind["tunnel"][6] == "binary"
+
+    srv = StatusServer(cat, port=0)
+    srv.start_background()
+    try:
+        _time.sleep(0.1)
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/links", timeout=10
+            ).read().decode()
+        )
+        links = body["links"]
+        assert any(
+            l["kind"] == "tunnel" and l["stall_seconds"] > 0
+            for l in links
+        )
+        assert any(
+            l["kind"] == "control" and l["rtt_ms"] > 0 for l in links
+        )
+    finally:
+        srv.shutdown()
+
+
 def test_mysql_server_connection_count():
     """The MySQL-protocol server counts live connections and the status
     port reports them (satellite: /status hardcoded 0)."""

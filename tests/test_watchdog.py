@@ -52,6 +52,43 @@ def test_expensive_query_logged():
     assert any("[expensive_query]" in r[1] for r in SLOW_LOG.rows())
 
 
+def test_expensive_query_honors_slow_log_switch():
+    """Satellite (PR 6): the watchdog's expensive-query slow-log entry
+    honors the same slow_query_log on/off switch as the session call
+    site (its admission bar stays its own
+    tidb_expensive_query_time_threshold sysvar)."""
+    cat = Catalog()
+    s = Session(cat)
+    s.execute("set global tidb_expensive_query_time_threshold = 0")
+    s.execute("set global slow_query_log = 0")
+    wd = InstanceWatchdog(cat, interval=0.05)
+
+    from tidb_tpu.utils.metrics import SLOW_LOG
+
+    before = len(SLOW_LOG.rows())
+
+    def runner():
+        s.execute("select sleep(0.6)")
+
+    t = threading.Thread(target=runner)
+    t.start()
+    flagged = False
+    for _ in range(40):
+        time.sleep(0.05)
+        wd.sample()
+        if wd.expensive_seen:
+            flagged = True
+            break
+    t.join()
+    # the expensive flag still fires; only the slow-log entry is gated
+    assert flagged
+    assert not any(
+        f"conn={s.conn_id} " in r[1]
+        for r in SLOW_LOG.rows()[before:]
+        if "[expensive_query]" in r[1]
+    )
+
+
 def test_memory_limit_kills_top_consumer():
     cat = Catalog()
     s = Session(cat)

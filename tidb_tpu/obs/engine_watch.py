@@ -166,13 +166,23 @@ def watched_jit(fn, sig=None, **jit_kwargs):
     """``jax.jit`` with compile accounting: the wrapped python body runs
     only when jax actually (re)traces, so each execution of the wrapper
     is one XLA compilation charged to `sig` (default: the function's
-    identity)."""
+    identity). The trace wall additionally lands in the flight
+    recorder's ``compile`` phase — tracing runs synchronously on the
+    statement's thread, so the charge hits the right query."""
+    import time as _time
+
     import jax
+
+    from tidb_tpu.obs.flight import FLIGHT
 
     watch_sig = sig if sig is not None else ("fn", id(fn))
 
     def traced(*a, **k):
         ENGINE_WATCH.note_trace(watch_sig)
-        return fn(*a, **k)
+        t0 = _time.perf_counter()
+        try:
+            return fn(*a, **k)
+        finally:
+            FLIGHT.note_phase("compile", _time.perf_counter() - t0)
 
     return jax.jit(traced, **jit_kwargs)

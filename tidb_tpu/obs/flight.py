@@ -1,0 +1,489 @@
+"""Query flight recorder: always-on per-query phase timelines, and the
+per-peer DCN link health registry.
+
+Reference: the slow-query log with plan capture (pkg/executor/
+slow_query.go writes `# Time/# Query_time/# Plan` records the
+infoschema reads back), stmtsummary's per-digest aggregates
+(pkg/util/stmtsummary/statement_summary.go:73) and Top SQL's
+always-on attribution (pkg/util/topsql). "Accelerating Presto with
+GPUs" (PAPERS.md) shows the accelerator lesson: the next optimization
+is findable only when every query carries a per-stage device-vs-host
+time breakdown.
+
+Accounting model (mirrors obs/engine_watch.py):
+
+- the session opens a *flight* per top-level statement on the executing
+  thread (thread-local current record, like EngineWatch);
+- every layer notes **phase seconds** into the current flight —
+  parse/plan in the session, compile in ``watched_jit``'s traced body,
+  execute/final-merge around the engine run, fragment-dispatch plus the
+  shuffle produce/push/wait/stage breakdown when the statement rides
+  the DCN scheduler (derived from the worker-reported stage stats the
+  PR 3/5 shuffle replies already ship);
+- finished flights land in a bounded ring and feed the three surfaces:
+  information_schema.statements_summary (per-digest percentiles +
+  mean phase breakdown + engine-watch join), information_schema.
+  slow_query (phase timeline + captured plan text), and the
+  tidbtpu_flight_* metric family.
+
+Phase names are a DECLARED registry (``PHASES``), the failpoint-SITES
+pattern: ``note_phase`` rejects undeclared names at runtime and
+scripts/check_flight_phases.py cross-checks the declaration against
+the literal call sites (tier-1 via tests/test_flight_phases.py), so a
+typo'd phase can neither silently fork the breakdown nor rot unused.
+
+``LINKS`` is the sibling registry for per-peer DCN link health
+(information_schema.cluster_links, the /links endpoint): RTT and clock
+offset from the engine-RPC handshake, heartbeat age, and the
+worker-to-worker tunnel telemetry (bytes/frames/rows pushed,
+backpressure stall seconds, retransmits, negotiated codec) merged from
+shuffle replies — DCN regressions become visible per link, not just
+per fleet.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: every phase a flight may charge time to. parse/plan/compile mirror
+#: the reference's session phases; execute/final-merge bracket the
+#: local engine run; fragment-dispatch is the coordinator-side wall of
+#: a DCN-scheduled statement; the shuffle-* quartet is the
+#: worker-reported stage breakdown (produce = engine time below the
+#: exchange, push = partition encode+ship, wait = blocked on peers,
+#: stage = landing received partitions as device batches).
+PHASES = (
+    "parse",
+    "plan",
+    "compile",
+    "execute",
+    "final-merge",
+    "fragment-dispatch",
+    "shuffle-produce",
+    "shuffle-push",
+    "shuffle-wait",
+    "shuffle-stage",
+)
+
+_PHASE_SET = frozenset(PHASES)
+
+
+def _c_queries():
+    return REGISTRY.counter(
+        "tidbtpu_flight_queries_total", "statements the flight recorder closed"
+    )
+
+
+def _c_phase_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_flight_phase_seconds",
+        "cumulative seconds charged per flight phase",
+        labels=("phase",),
+    )
+
+
+def _c_slow_captures():
+    return REGISTRY.counter(
+        "tidbtpu_flight_slow_plan_captures_total",
+        "over-threshold statements whose plan text was captured",
+    )
+
+
+def _h_query_seconds():
+    return REGISTRY.histogram(
+        "tidbtpu_flight_query_seconds", "flight-recorded statement latency"
+    )
+
+
+class QueryFlight:
+    """One statement's structured timeline. ``phases`` maps a declared
+    phase name to [seconds, bytes, retries] (bytes/retries are phase
+    attributes: shuffle-push carries tunneled bytes, fragment-dispatch
+    carries stage retries)."""
+
+    __slots__ = (
+        "qid", "conn_id", "sql", "start_ts", "duration_s", "phases",
+        "plan_cache", "plan_digest", "rows_sent", "plan_text",
+        "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
+        "device_mem_peak_bytes",
+    )
+
+    def __init__(self, qid: int, conn_id: int, sql: str):
+        self.qid = qid
+        self.conn_id = conn_id
+        self.sql = sql
+        self.start_ts = time.time()
+        self.duration_s = 0.0
+        self.phases: Dict[str, list] = {}
+        #: "hit" | "miss" | "" — last compiled-plan-cache outcome the
+        #: executor reported while this flight was current
+        self.plan_cache = ""
+        #: short fingerprint of the executor's compiled-plan cache key
+        #: (process-local grouping; the reference ships a plan digest
+        #: next to the SQL digest in stmtsummary)
+        self.plan_digest = ""
+        self.rows_sent = 0
+        #: captured plan text (EXPLAIN tree, or the full distributed
+        #: EXPLAIN ANALYZE lines when the statement ran instrumented)
+        self.plan_text = ""
+        self.jit_compilations = 0
+        self.retraces = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.device_mem_peak_bytes = 0
+
+    def phase_row(self, name: str) -> list:
+        row = self.phases.get(name)
+        if row is None:
+            row = self.phases[name] = [0.0, 0, 0]
+        return row
+
+    def timeline(self) -> List[tuple]:
+        """(phase, seconds, bytes, retries) in declared order — the
+        slow-query log's `# Phases` line and the /links-free half of
+        the bench --flight-out snapshot."""
+        return [
+            (p, self.phases[p][0], self.phases[p][1], self.phases[p][2])
+            for p in PHASES
+            if p in self.phases
+        ]
+
+
+class FlightRecorder:
+    """Always-on per-statement recorder: thread-local current flight,
+    finished flights in a bounded ring (oldest evicted). All note_*
+    paths are O(1) and lock-free for the current flight (thread-local);
+    only the ring append takes the lock."""
+
+    def __init__(self, capacity: int = 256):
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._recent = collections.deque(maxlen=capacity)
+        self._qid = itertools.count(1)
+
+    # -- statement scope ----------------------------------------------
+    def begin(self, sql: str, conn_id: int = 0) -> QueryFlight:
+        rec = QueryFlight(next(self._qid), int(conn_id), str(sql)[:2048])
+        self._tls.rec = rec
+        return rec
+
+    def current(self) -> Optional[QueryFlight]:
+        return getattr(self._tls, "rec", None)
+
+    def finish(self, duration_s: float) -> Optional[QueryFlight]:
+        """Close the current flight into the ring and return it (the
+        session feeds it to the statement summary / slow log). Returns
+        None when no flight is open (nested statement, engine-internal
+        session)."""
+        rec = self.current()
+        self._tls.rec = None
+        if rec is None:
+            return None
+        rec.duration_s = float(duration_s)
+        _c_queries().inc()
+        _h_query_seconds().observe(rec.duration_s)
+        with self._lock:
+            self._recent.append(rec)
+        return rec
+
+    def discard(self) -> None:
+        """Drop an open flight without recording (statement raised
+        before observation; a half-charged timeline would pollute the
+        per-digest means)."""
+        self._tls.rec = None
+
+    # -- notes ---------------------------------------------------------
+    def note_phase(
+        self, name: str, seconds: float, nbytes: int = 0, retries: int = 0
+    ) -> None:
+        """Charge seconds (and optional bytes/retries) to a DECLARED
+        phase of the current flight. Undeclared names raise — the
+        failpoint-SITES contract: the registry, not the call site,
+        defines the phase vocabulary."""
+        if name not in _PHASE_SET:
+            raise ValueError(
+                f"undeclared flight phase {name!r} (declare it in "
+                "tidb_tpu/obs/flight.py PHASES)"
+            )
+        _c_phase_seconds().labels(phase=name).inc(max(float(seconds), 0.0))
+        rec = self.current()
+        if rec is None:
+            return
+        row = rec.phase_row(name)
+        row[0] += max(float(seconds), 0.0)
+        row[1] += int(nbytes)
+        row[2] += int(retries)
+
+    def phase_seconds(self, name: str) -> float:
+        """Seconds charged so far to ``name`` on the CURRENT flight
+        (0.0 when none is open). Lets a caller that brackets a wall
+        containing nested charges subtract them — e.g. the session's
+        execute window subtracts the compile seconds watched_jit
+        charged inside it, so execute and compile stay additive."""
+        rec = self.current()
+        if rec is None:
+            return 0.0
+        row = rec.phases.get(name)
+        return row[0] if row else 0.0
+
+    def note_plan_cache(self, hit: bool, key=None) -> None:
+        """Compiled-plan-cache outcome from the executor; ``key`` (the
+        cache key) stamps a short plan digest onto the flight."""
+        rec = self.current()
+        if rec is None:
+            return
+        rec.plan_cache = "hit" if hit else "miss"
+        if key is not None:
+            try:
+                rec.plan_digest = "%016x" % (hash(key) & (2 ** 64 - 1))
+            except TypeError:
+                pass  # unhashable key: keep the outcome, skip the digest
+
+    def note_rows_sent(self, n: int) -> None:
+        rec = self.current()
+        if rec is not None:
+            rec.rows_sent = int(n)
+
+    def note_plan_text(self, text: str) -> None:
+        rec = self.current()
+        if rec is not None and text:
+            rec.plan_text = str(text)[:16384]
+
+    def note_engine(self, engine_rec) -> None:
+        """Join the engine-watch record (obs/engine_watch.py) into the
+        current flight — the statements_summary engine columns."""
+        rec = self.current()
+        if rec is None or engine_rec is None:
+            return
+        rec.jit_compilations = int(engine_rec.jit_compilations)
+        rec.retraces = int(engine_rec.retraces)
+        rec.h2d_bytes = int(engine_rec.h2d_bytes)
+        rec.d2h_bytes = int(engine_rec.d2h_bytes)
+        rec.device_mem_peak_bytes = int(engine_rec.device_mem_peak_bytes)
+
+    def note_shuffle_stage(self, stage: dict) -> None:
+        """Attribute one DCN shuffle stage's worker-reported stats
+        (parallel/dcn.py ``stage`` summary) onto the current flight's
+        shuffle phases. Stage retries charge to fragment-dispatch."""
+        if not stage:
+            return
+        self.note_phase(
+            "shuffle-produce", stage.get("produce_s", 0.0),
+        )
+        self.note_phase(
+            "shuffle-push", stage.get("encode_s", 0.0),
+            nbytes=int(stage.get("bytes_tunneled", 0)),
+            retries=int(stage.get("retransmits", 0)),
+        )
+        self.note_phase("shuffle-wait", stage.get("wait_s", 0.0))
+        self.note_phase("shuffle-stage", stage.get("stage_s", 0.0))
+
+    # -- surfaces ------------------------------------------------------
+    def rows(self) -> List[dict]:
+        """Finished flights, oldest first, as plain dicts (the bench
+        --flight-out snapshot; tests)."""
+        with self._lock:
+            recs = list(self._recent)
+        return [
+            {
+                "qid": r.qid,
+                "conn_id": r.conn_id,
+                "sql": r.sql,
+                "start_ts": r.start_ts,
+                "duration_s": r.duration_s,
+                "phases": {
+                    p: {"seconds": s, "bytes": b, "retries": n}
+                    for p, s, b, n in r.timeline()
+                },
+                "plan_cache": r.plan_cache,
+                "rows_sent": r.rows_sent,
+                "jit_compilations": r.jit_compilations,
+                "retraces": r.retraces,
+                "h2d_bytes": r.h2d_bytes,
+                "d2h_bytes": r.d2h_bytes,
+                "device_mem_peak_bytes": r.device_mem_peak_bytes,
+                "plan_captured": bool(r.plan_text),
+            }
+            for r in recs
+        ]
+
+
+FLIGHT = FlightRecorder()
+
+
+# -- per-peer DCN link health ------------------------------------------------
+
+
+def _c_link_bytes():
+    return REGISTRY.counter(
+        "tidbtpu_link_bytes_total",
+        "bytes pushed per worker-to-worker tunnel link",
+        labels=("src", "dst"),
+    )
+
+
+def _c_link_frames():
+    return REGISTRY.counter(
+        "tidbtpu_link_frames_total",
+        "frames/packets pushed per tunnel link",
+        labels=("src", "dst"),
+    )
+
+
+def _c_link_stall_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_link_stall_seconds",
+        "seconds producers spent blocked on tunnel backpressure, per link",
+        labels=("src", "dst"),
+    )
+
+
+def _c_link_retransmits():
+    return REGISTRY.counter(
+        "tidbtpu_link_retransmits_total",
+        "packets retransmitted per tunnel link",
+        labels=("src", "dst"),
+    )
+
+
+def _g_link_rtt():
+    return REGISTRY.gauge(
+        "tidbtpu_link_rtt_seconds",
+        "handshake-sampled round-trip time per control link",
+        labels=("host",),
+    )
+
+
+def _g_link_heartbeat_age():
+    return REGISTRY.gauge(
+        "tidbtpu_link_heartbeat_age_seconds",
+        "seconds since the last successful heartbeat/handshake per host",
+        labels=("host",),
+    )
+
+
+class LinkRegistry:
+    """Coordinator-side aggregation of per-peer link health.
+
+    Two link kinds:
+
+    - ``control``: coordinator -> worker engine-RPC links. RTT and the
+      clock offset come from the connect-time handshake (the PR 5
+      clock sampler); heartbeat age tracks the last successful ping
+      (HostHeartbeat.beat_once) or handshake.
+    - ``tunnel``: worker -> worker shuffle tunnels. Bytes/frames/rows
+      pushed, backpressure stall seconds, retransmits and the
+      negotiated codec are reported by the owning worker in each
+      shuffle reply's ``per_peer`` stats and merged here behind the
+      coordinator's exactly-once ledger fence (a retried stage's
+      tunnels count once).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._control: Dict[str, dict] = {}
+        self._tunnels: Dict[tuple, dict] = {}
+
+    def note_handshake(
+        self, host: str, rtt_s: Optional[float], offset_s: Optional[float]
+    ) -> None:
+        now = time.time()
+        with self._lock:
+            ent = self._control.setdefault(
+                host, {"rtt_s": 0.0, "offset_s": 0.0, "last_seen": now,
+                       "alive": True},
+            )
+            if rtt_s is not None:
+                ent["rtt_s"] = float(rtt_s)
+                _g_link_rtt().labels(host=host).set(float(rtt_s))
+            if offset_s is not None:
+                ent["offset_s"] = float(offset_s)
+            ent["last_seen"] = now
+            ent["alive"] = True
+        # a fresh handshake IS a successful liveness observation
+        _g_link_heartbeat_age().labels(host=host).set(0.0)
+
+    def note_heartbeat(self, host: str, ok: bool) -> None:
+        """One liveness observation. The age gauge updates HERE (not
+        only in the cluster_links read path) so a /metrics-only
+        deployment running the heartbeat loop sees a dead link's age
+        grow: a failed beat stamps the time since the last success."""
+        now = time.time()
+        with self._lock:
+            ent = self._control.setdefault(
+                host, {"rtt_s": 0.0, "offset_s": 0.0, "last_seen": now,
+                       "alive": bool(ok)},
+            )
+            age = 0.0 if ok else max(now - ent["last_seen"], 0.0)
+            if ok:
+                ent["last_seen"] = now
+            ent["alive"] = bool(ok)
+        _g_link_heartbeat_age().labels(host=host).set(age)
+
+    def note_tunnel(self, src: str, dst: str, per_peer: dict) -> None:
+        """Fold one worker-reported tunnel sample (a ``per_peer`` row
+        from a FENCED shuffle reply) into the (src, dst) link."""
+        with self._lock:
+            ent = self._tunnels.setdefault(
+                (src, dst),
+                {"bytes": 0, "frames": 0, "rows": 0, "stalls": 0,
+                 "stall_s": 0.0, "retransmits": 0, "codec": "",
+                 "last_seen": 0.0},
+            )
+            ent["bytes"] += int(per_peer.get("bytes", 0))
+            ent["frames"] += int(per_peer.get("frames", 0))
+            ent["rows"] += int(per_peer.get("rows", 0))
+            ent["stalls"] += int(per_peer.get("stalls", 0))
+            ent["stall_s"] += float(per_peer.get("stall_s", 0.0))
+            ent["retransmits"] += int(per_peer.get("retransmits", 0))
+            ent["codec"] = str(per_peer.get("codec") or ent["codec"])
+            ent["last_seen"] = time.time()
+
+    def rows(self) -> List[tuple]:
+        """information_schema.cluster_links rows: (src, dst, kind,
+        alive, rtt_ms, clock_offset_ms, heartbeat_age_s, bytes, frames,
+        rows, stall_seconds, retransmits, codec)."""
+        now = time.time()
+        out: List[tuple] = []
+        with self._lock:
+            for host in sorted(self._control):
+                ent = self._control[host]
+                age = max(now - ent["last_seen"], 0.0)
+                _g_link_heartbeat_age().labels(host=host).set(age)
+                out.append(
+                    ("coordinator", host, "control",
+                     int(bool(ent["alive"])), ent["rtt_s"] * 1e3,
+                     ent["offset_s"] * 1e3, age, 0, 0, 0, 0.0, 0, "")
+                )
+            for (src, dst) in sorted(self._tunnels):
+                ent = self._tunnels[(src, dst)]
+                out.append(
+                    (src, dst, "tunnel", 1, 0.0, 0.0,
+                     max(now - ent["last_seen"], 0.0), ent["bytes"],
+                     ent["frames"], ent["rows"], ent["stall_s"],
+                     ent["retransmits"], ent["codec"])
+                )
+        return out
+
+    def snapshot(self) -> List[dict]:
+        """The /links endpoint payload (same data as rows(), keyed)."""
+        cols = (
+            "src", "dst", "kind", "alive", "rtt_ms", "clock_offset_ms",
+            "heartbeat_age_s", "bytes", "frames", "rows",
+            "stall_seconds", "retransmits", "codec",
+        )
+        return [dict(zip(cols, r)) for r in self.rows()]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._control.clear()
+            self._tunnels.clear()
+
+
+LINKS = LinkRegistry()

@@ -47,6 +47,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from tidb_tpu.dxf.framework import fence_accepts
+from tidb_tpu.obs.flight import FLIGHT, LINKS
 from tidb_tpu.planner import logical as L
 from tidb_tpu.planner.fragmenter import (
     FragmentPlan,
@@ -187,6 +188,8 @@ class HostHeartbeat:
             ok = not inject("dcn/heartbeat-timeout") and ping_endpoint(
                 ep, timeout_s=self.timeout_s
             )
+            # per-link heartbeat age (information_schema.cluster_links)
+            LINKS.note_heartbeat(ep.address, ok)
             if ok:
                 self._misses[ep] = 0
                 continue
@@ -275,6 +278,14 @@ class FragmentLedger:
     def attempts(self, fid: int) -> int:
         with self._lock:
             return self._recs[fid]["attempts"]
+
+    def total_retries(self) -> int:
+        """Attempts beyond the first, summed over fragments (the
+        flight recorder's fragment-dispatch retry count)."""
+        with self._lock:
+            return sum(
+                max(r["attempts"] - 1, 0) for r in self._recs.values()
+            )
 
     def all_done(self) -> bool:
         with self._lock:
@@ -422,6 +433,11 @@ class DCNFragmentScheduler:
             self._conns[ep] = c
             if c.clock_offset_s is not None:
                 self._clock_offsets[ep.address] = c.clock_offset_s
+            # the handshake's RTT/offset sample doubles as the
+            # control-link health reading (cluster_links, /links)
+            LINKS.note_handshake(
+                ep.address, c.clock_rtt_s, c.clock_offset_s
+            )
         return c
 
     def _drop_conn(self, ep: EngineEndpoint) -> None:
@@ -470,20 +486,65 @@ class DCNFragmentScheduler:
         _update_host_gauges(self.endpoints)
 
     # -- query execution ------------------------------------------------
-    def execute_plan(self, plan: L.LogicalPlan) -> Tuple[List[str], List[tuple]]:
+    def execute_plan(
+        self, plan: L.LogicalPlan, cut_hint=None
+    ) -> Tuple[List[str], List[tuple]]:
         """Run a bound logical plan across the worker hosts. Prefers a
         worker-to-worker shuffle cut when the policy says tunnels beat
         coordinator staging, then the partial-agg staging cut, then
         whole-plan single-host dispatch; every path survives worker
-        loss up to max_attempts."""
-        kind, cut = self._choose_cut(plan)
+        loss up to max_attempts. ``cut_hint`` is a precomputed
+        (kind, cut) from _choose_cut so a caller that already planned
+        the route (session SELECT routing) does not pay the planner
+        pass twice."""
+        kind, cut = cut_hint if cut_hint is not None else self._choose_cut(plan)
         if kind == "shuffle":
-            rows, _infos, _stage = self._run_shuffle(cut)
-            return self._final_stage(cut, rows)
+            t0 = time.perf_counter()
+            rows, infos, stage = self._run_shuffle(cut)
+            self._note_dispatch(
+                t0, infos,
+                retries=max(int(stage.get("attempts", 1)) - 1, 0),
+            )
+            FLIGHT.note_shuffle_stage(stage)
+            return self._timed_final_stage(cut, rows)
         if kind == "frag":
-            ledger, _infos = self._run_fragments(cut)
-            return self._final_stage(cut, ledger.rows())
+            t0 = time.perf_counter()
+            ledger, infos = self._run_fragments(cut)
+            self._note_dispatch(t0, infos, retries=ledger.total_retries())
+            # remote engine row work (summed across hosts, like the
+            # shuffle phases and the reference's cop-task totals)
+            FLIGHT.note_phase(
+                "execute", sum(f.get("exec_s", 0.0) for f in infos)
+            )
+            return self._timed_final_stage(cut, ledger.rows())
         return self._execute_single(plan)
+
+    @staticmethod
+    def _note_dispatch(t0: float, infos, retries: int) -> None:
+        """Flight attribution (obs/flight.py): fragment-dispatch is the
+        coordinator-side OVERHEAD — the dispatch+gather wall minus the
+        critical-path worker execution it blocks on. The worker time
+        itself is charged elsewhere (the shuffle phases, or the frag
+        branch's summed execute), so nothing counts twice."""
+        wall = time.perf_counter() - t0
+        crit = max((f.get("exec_s", 0.0) for f in infos), default=0.0)
+        FLIGHT.note_phase(
+            "fragment-dispatch", max(wall - crit, 0.0), retries=retries
+        )
+
+    def _timed_final_stage(self, cut, rows):
+        """Run the coordinator-local final stage charging its wall to
+        final-merge MINUS any jit traces watched_jit charges to
+        "compile" inside it, so the two phases stay additive."""
+        t1 = time.perf_counter()
+        c0 = FLIGHT.phase_seconds("compile")
+        out = self._final_stage(cut, rows)
+        FLIGHT.note_phase(
+            "final-merge",
+            (time.perf_counter() - t1)
+            - (FLIGHT.phase_seconds("compile") - c0),
+        )
+        return out
 
     def explain_analyze(
         self, plan: L.LogicalPlan
@@ -579,8 +640,10 @@ class DCNFragmentScheduler:
         stage = {
             "sid": sid, "qid": qid, "kind": sp.kind, "attempts": 0,
             "m": 0, "bytes_tunneled": 0, "rows_tunneled": 0,
-            "local_rows": 0, "stalls": 0, "retransmits": 0,
+            "local_rows": 0, "stalls": 0, "stall_s": 0.0,
+            "retransmits": 0,
             "codec": self.shuffle_codec, "encode_s": 0.0,
+            "produce_s": 0.0, "wait_s": 0.0, "stage_s": 0.0,
             # what the workers will actually run: the pipeline needs
             # the binary codec, so the json escape hatch forces barrier
             # (mirrors ShuffleWorker.run_task's own gate)
@@ -691,8 +754,12 @@ class DCNFragmentScheduler:
                     stage["rows_tunneled"] += f["pushed_rows"]
                     stage["local_rows"] += f["local_rows"]
                     stage["stalls"] += f["stalls"]
+                    stage["stall_s"] += f.get("stall_s", 0.0)
                     stage["retransmits"] += f["retransmits"]
                     stage["encode_s"] += f.get("encode_s", 0.0)
+                    stage["produce_s"] += f.get("produce_s", 0.0)
+                    stage["wait_s"] += f.get("wait_s", 0.0)
+                    stage["stage_s"] += f.get("stage_s", 0.0)
                     stage["wait_idle_s"] += f.get("wait_idle_s", 0.0)
                     stage["exec_s"] += f.get("exec_s", 0.0)
                     stage["ttff_s"] = max(
@@ -743,9 +810,13 @@ class DCNFragmentScheduler:
             "pushed_rows": int(sh.get("pushed_rows", 0)),
             "local_rows": int(sh.get("local_rows", 0)),
             "stalls": int(sh.get("stalls", 0)),
+            "stall_s": float(sh.get("stall_s", 0.0)),
             "retransmits": int(sh.get("retransmits", 0)),
             "codec": sh.get("codec"),
             "encode_s": float(sh.get("encode_s", 0.0)),
+            "produce_s": float(sh.get("produce_s", 0.0)),
+            "wait_s": float(sh.get("wait_s", 0.0)),
+            "stage_s": float(sh.get("stage_s", 0.0)),
             "pipeline": bool(sh.get("pipeline", False)),
             "wait_idle_s": float(sh.get("wait_idle_s", 0.0)),
             "ttff_s": float(sh.get("ttff_s", 0.0)),
@@ -753,6 +824,13 @@ class DCNFragmentScheduler:
         }
         with self._lock:
             infos.append(info)
+        # per-peer tunnel health merges once per FENCED reply — the
+        # exactly-once ledger means a retried stage's links count once
+        for pp in sh.get("per_peer") or ():
+            try:
+                LINKS.note_tunnel(ep.address, str(pp.get("dst")), pp)
+            except Exception:
+                pass  # malformed per_peer from a skewed worker
         self._merge_remote_spans(
             spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
         )

@@ -628,7 +628,12 @@ class PeerTunnel:
         self.timeout_s = timeout_s
         self.bytes_sent = 0
         self.rows_sent = 0
+        self.frames_sent = 0
         self.stalls = 0
+        #: cumulative seconds producers spent blocked on this tunnel's
+        #: byte window (backpressure stall WALL, not just a count —
+        #: information_schema.cluster_links reads this per link)
+        self.stall_s = 0.0
         self.retransmits = 0
         self._cv = threading.Condition()
         self._q: "collections.deque" = collections.deque()
@@ -687,6 +692,7 @@ class PeerTunnel:
         verbatim) or a plain dict (tests/tools)."""
         with self._cv:
             stalled = False
+            stall_t0 = 0.0
             while (
                 self._dead is None
                 and self._inflight + nbytes > self.max_inflight
@@ -694,9 +700,18 @@ class PeerTunnel:
             ):
                 if not stalled:
                     stalled = True
+                    stall_t0 = time.perf_counter()
                     self.stalls += 1
                     _c_stalls().labels(dst=self.address).inc()
                 self._cv.wait(0.05)
+            if stalled:
+                dt = time.perf_counter() - stall_t0
+                self.stall_s += dt
+                from tidb_tpu.obs.flight import _c_link_stall_seconds
+
+                _c_link_stall_seconds().labels(
+                    src=self.src, dst=self.address
+                ).inc(dt)
             if self._dead is not None:
                 raise PeerDeadError(
                     self.address, self._dead, fatal=self._dead_fatal
@@ -804,6 +819,11 @@ class PeerTunnel:
                         # once
                         self.retransmits += len(batch)
                         _c_retransmits().inc(len(batch))
+                        from tidb_tpu.obs.flight import _c_link_retransmits
+
+                        _c_link_retransmits().labels(
+                            src=self.src, dst=self.address
+                        ).inc(len(batch))
                         time.sleep(0.05 * (attempt + 1))
             with self._cv:
                 nbytes_acked = nrows_acked = 0
@@ -818,12 +838,27 @@ class PeerTunnel:
                 else:
                     self.bytes_sent += nbytes_acked
                     self.rows_sent += nrows_acked
+                    self.frames_sent += len(batch)
                     _c_bytes().labels(src=self.src, dst=self.address).inc(
                         nbytes_acked
                     )
                     _c_rows().labels(src=self.src, dst=self.address).inc(
                         nrows_acked
                     )
+                    # per-link health family (information_schema.
+                    # cluster_links; counters ship to the coordinator
+                    # via the piggybacked registry deltas)
+                    from tidb_tpu.obs.flight import (
+                        _c_link_bytes,
+                        _c_link_frames,
+                    )
+
+                    _c_link_bytes().labels(
+                        src=self.src, dst=self.address
+                    ).inc(nbytes_acked)
+                    _c_link_frames().labels(
+                        src=self.src, dst=self.address
+                    ).inc(len(batch))
                 self._cv.notify_all()
 
 
@@ -1176,9 +1211,15 @@ class ShuffleWorker:
         tlock = threading.Lock()  # tunnel creation + stats merge
         stats = {
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
-            "stalls": 0, "retransmits": 0, "produced_rows": 0,
+            "stalls": 0, "stall_s": 0.0, "retransmits": 0,
+            "produced_rows": 0,
             "per_peer": [], "codec": codec, "encode_s": 0.0,
             "pipeline": pipeline, "wait_idle_s": 0.0, "ttff_s": 0.0,
+            # flight-recorder phase breakdown (obs/flight.py): engine
+            # time below the exchange, total blocked-in-wait wall
+            # (nonzero even when overlap hides it — wait_idle_s is the
+            # NON-overlapped remainder), and partition staging time
+            "produce_s": 0.0, "wait_s": 0.0, "stage_s": 0.0,
         }
         _nullspan = _NullSpan()
 
@@ -1198,8 +1239,10 @@ class ShuffleWorker:
                     # shuffle-json-fallback: the row-packet escape
                     # hatch (shuffle_codec=json) materializes and
                     # partitions Python rows, like PR 3
+                    t_prod = time.perf_counter()
                     with span(f"{ctx}/produce#{tag}"), self._exec_lock:
                         batch, dicts = producer_exec.run(plan)
+                    stats["produce_s"] += time.perf_counter() - t_prod
                     with self._exec_lock:
                         rows = materialize_rows(batch, schema_cols, dicts)
                     key_idx = [c.internal for c in schema_cols].index(
@@ -1263,14 +1306,20 @@ class ShuffleWorker:
                         if all(c is not None for c in cand):
                             subplans = cand
                     for sp in (subplans or [plan]):
+                        t_prod = time.perf_counter()
                         with span(f"{ctx}/produce#{tag}"), \
                                 self._exec_lock:
                             batch, dicts = producer_exec.run(sp)
+                        stats["produce_s"] += (
+                            time.perf_counter() - t_prod
+                        )
                         sq.put((batch, types, dicts))
                     sq.put(None)  # side EOF sentinel
                     continue
+                t_prod = time.perf_counter()
                 with span(f"{ctx}/produce#{tag}"), self._exec_lock:
                     batch, dicts = producer_exec.run(plan)
+                stats["produce_s"] += time.perf_counter() - t_prod
                 block = batch_to_block(batch, types, dicts)
                 stats["produced_rows"] += block.nrows
                 idxs = partition_block(block, side["key"], m)
@@ -1301,6 +1350,7 @@ class ShuffleWorker:
                     )
                 idle = time.perf_counter() - t0
                 stats["wait_idle_s"] += idle
+                stats["wait_s"] += idle
                 _c_wait_idle_seconds().inc(idle)
             else:
                 # pipelined: the wait/stage loop starts while our OWN
@@ -1328,6 +1378,7 @@ class ShuffleWorker:
                         )
                     t1 = time.perf_counter()
                     waited += t1 - t0
+                    stats["wait_s"] += t1 - t0
                     # idle = blocked time with our own shippers already
                     # drained (wait wall that overlaps our outbound
                     # push is pipeline WORKING, not idling)
@@ -1342,12 +1393,16 @@ class ShuffleWorker:
                     pending.remove(done)
                     node = reads.get(done)
                     if node is not None:
+                        t_stage = time.perf_counter()
                         with span(f"{ctx}/stage#{done}"):
                             staged[done] = stage_payloads_incremental(
                                 node.schema, chunks,
                                 next(self._nonce), vocab=vocab,
                                 key=f"shuffle#{done}",
                             )
+                        stats["stage_s"] += (
+                            time.perf_counter() - t_stage
+                        )
                 for th in shippers:
                     th.join()
                 if ship_errs:
@@ -1404,12 +1459,16 @@ class ShuffleWorker:
                 stats["pushed_bytes"] += t.bytes_sent
                 stats["pushed_rows"] += t.rows_sent
                 stats["stalls"] += t.stalls
+                stats["stall_s"] += t.stall_s
                 stats["retransmits"] += t.retransmits
                 stats["per_peer"].append(
                     {
                         "dst": t.address, "bytes": t.bytes_sent,
-                        "rows": t.rows_sent, "stalls": t.stalls,
+                        "rows": t.rows_sent, "frames": t.frames_sent,
+                        "stalls": t.stalls,
+                        "stall_s": round(t.stall_s, 6),
                         "retransmits": t.retransmits,
+                        "codec": t._codec or stats["codec"],
                     }
                 )
         stats["ttff_s"] = self.store.max_ttff(sid)
@@ -1431,6 +1490,7 @@ class ShuffleWorker:
             # concat staging under a fresh nonce (no compiled-consumer
             # reuse; the keyed staged input is incremental-mode
             # machinery)
+            t_stage = time.perf_counter()
             staged = {
                 tag: stage_payloads_as_batch(
                     node.schema, by_side.get(tag, []),
@@ -1438,6 +1498,7 @@ class ShuffleWorker:
                 )
                 for tag, node in reads.items()
             }
+            stats["stage_s"] += time.perf_counter() - t_stage
         inject("shuffle/consume")
         with span(f"{ctx}/consume"), self._exec_lock:
             # consumer executes single-device: its sources are Staged

@@ -2457,6 +2457,11 @@ class PhysicalExecutor:
 
                 key = self._cache_key(plan)
                 cq = None if conservative else self._cache.get(key)
+                # flight recorder: plan-cache outcome + plan digest for
+                # the statements_summary attribution (obs/flight.py)
+                from tidb_tpu.obs.flight import FLIGHT
+
+                FLIGHT.note_plan_cache(cq is not None, key=key)
                 if cq is not None:
                     self._cache.move_to_end(key)
                     REGISTRY.counter("tidbtpu_executor_plan_cache_hits_total").inc()

@@ -1,4 +1,8 @@
-"""HTTP status API: /status, /metrics, /schema, /settings, /dcn.
+"""HTTP status API: /status, /metrics, /schema, /settings, /dcn, /links.
+
+`/links` (PR 6) serves the per-peer DCN link health registry
+(obs/flight.py LINKS): handshake RTT, heartbeat age, and tunnel
+bytes/stall seconds/retransmits per link.
 
 Reference: pkg/server/http_status.go — the side port serving liveness
 (`/status`), Prometheus metrics (`/metrics`), schema introspection
@@ -78,6 +82,12 @@ class StatusServer:
                         else:
                             data = prov.status()
                         self._send(200, json.dumps(data))
+                    elif path == "/links":
+                        from tidb_tpu.obs.flight import LINKS
+
+                        self._send(
+                            200, json.dumps({"links": LINKS.snapshot()})
+                        )
                     elif path == "/metrics":
                         from tidb_tpu.utils.metrics import REGISTRY
 

@@ -1312,7 +1312,20 @@ class Session(DDLMixin):
             raise ConnectionError(
                 f"connection {self.conn_id} was killed"
             )
+        t_parse = time.perf_counter()
         stmts = parse(sql)
+        parse_s = time.perf_counter() - t_parse
+        if getattr(self, "_stmt_depth", 0) == 0:
+            # the parse wall belongs to the first statement's flight
+            # (the batch parses once); _execute_stmt charges+clears it
+            self._pending_parse_s = parse_s
+        else:
+            # nested execute (prepared-statement rebind): the current
+            # statement's flight is already open — charge it directly
+            # instead of leaking the wall to the NEXT top-level flight
+            from tidb_tpu.obs.flight import FLIGHT as _FLIGHT
+
+            _FLIGHT.note_phase("parse", parse_s)
         res = Result([], [])
         for s in stmts:
             if len(stmts) == 1:
@@ -1369,6 +1382,15 @@ class Session(DDLMixin):
             from tidb_tpu.obs.engine_watch import ENGINE_WATCH
 
             ENGINE_WATCH.begin_query(self._current_stmt[0])
+            # flight recorder: always-on per-statement phase timeline
+            # (obs/flight.py); the batch's parse wall charges here
+            from tidb_tpu.obs.flight import FLIGHT
+
+            FLIGHT.begin(self._current_stmt[0], self.conn_id)
+            parse_s = getattr(self, "_pending_parse_s", 0.0)
+            if parse_s:
+                self._pending_parse_s = 0.0
+                FLIGHT.note_phase("parse", parse_s)
             from tidb_tpu.utils import sqlkiller as _sk
 
             # host-side blocking builtins (SLEEP) poll this session's
@@ -1407,6 +1429,12 @@ class Session(DDLMixin):
                 from tidb_tpu.obs.engine_watch import ENGINE_WATCH
 
                 ENGINE_WATCH.end_query(time.perf_counter() - t0)
+                # error path: _observe_stmt never ran, so an open
+                # flight is half-charged — drop it rather than skew
+                # the per-digest phase means
+                from tidb_tpu.obs.flight import FLIGHT
+
+                FLIGHT.discard()
             if top and bill_t0 is not None:
                 try:
                     self.catalog.resource_groups.debit(
@@ -2869,13 +2897,22 @@ class Session(DDLMixin):
         r.elapsed_s = time.perf_counter() - t0
         if self._stmt_depth == 1:
             # nested statements (TRACE's inner stmt) are not re-observed
-            self._observe_stmt(s, r.elapsed_s)
+            self._observe_stmt(s, r.elapsed_s, r)
         return r
 
-    def _observe_stmt(self, s, elapsed_s: float) -> None:
-        """Metrics + slow log + statement summary (reference:
-        pkg/metrics collectors, slow_query.go, stmtsummary)."""
-        from tidb_tpu.utils.metrics import REGISTRY, SLOW_LOG, STMT_SUMMARY
+    def _observe_stmt(self, s, elapsed_s: float, result=None) -> None:
+        """Metrics + flight recorder + slow log + statement summary
+        (reference: pkg/metrics collectors, slow_query.go,
+        stmtsummary). The finished flight (obs/flight.py) carries the
+        phase timeline and engine-watch join into both stores."""
+        from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+        from tidb_tpu.obs.flight import FLIGHT
+        from tidb_tpu.utils.metrics import (
+            REGISTRY,
+            SLOW_LOG,
+            STMT_SUMMARY,
+            sql_digest,
+        )
 
         REGISTRY.counter(
             "tidbtpu_session_statements_total", "statements executed"
@@ -2884,14 +2921,98 @@ class Session(DDLMixin):
             "tidbtpu_session_query_duration_seconds", "statement latency"
         ).observe(elapsed_s)
         sql = getattr(s, "_source_sql", None) or type(s).__name__
-        STMT_SUMMARY.record(sql, elapsed_s)
+        FLIGHT.note_engine(ENGINE_WATCH.current())
+        if result is not None:
+            FLIGHT.note_rows_sent(len(result.rows))
+        flight = FLIGHT.finish(elapsed_s)
+        digest = sql_digest(sql)  # computed ONCE for both stores
+        STMT_SUMMARY.record(sql, elapsed_s, flight=flight, digest=digest)
+        # slow log: threshold from the sysvar registry (no hardcoded
+        # fallback — SYSVAR_DEFS owns the default), gated on the
+        # slow_query_log on/off switch like the reference
         try:
-            v = self.vars.get("tidb_slow_log_threshold")
-            thresh_ms = 300 if v is None else int(v)  # 0 = log everything
+            if not bool(self.vars.get("slow_query_log")):
+                return
+            thresh_ms = int(self.vars.get("tidb_slow_log_threshold"))
         except Exception:
-            thresh_ms = 300
-        if elapsed_s * 1000.0 >= thresh_ms:
-            SLOW_LOG.record(sql, elapsed_s)
+            return
+        if elapsed_s * 1000.0 < thresh_ms:  # 0 = log everything
+            return
+        phases = ""
+        plan_text = ""
+        if flight is not None:
+            phases = " ".join(
+                f"{p}={sec * 1e3:.3f}ms" for p, sec, _b, _r
+                in flight.timeline()
+            )
+            # tidb_record_plan_in_slow_log gates EVERY capture path,
+            # including the instrumented lines an EXPLAIN ANALYZE
+            # already stashed on the flight
+            if self._record_plan_in_slow_log():
+                plan_text = flight.plan_text or self._capture_slow_plan(s)
+            flight.plan_text = plan_text
+            if plan_text:
+                from tidb_tpu.obs.flight import _c_slow_captures
+
+                _c_slow_captures().inc()
+        SLOW_LOG.record(
+            sql, elapsed_s,
+            digest=digest,
+            conn_id=self.conn_id,
+            phases=phases,
+            plan=plan_text,
+            log_file=self._slow_log_file(),
+        )
+
+    def _record_plan_in_slow_log(self) -> bool:
+        try:
+            return bool(self.vars.get("tidb_record_plan_in_slow_log"))
+        except Exception:
+            return False
+
+    def _slow_log_file(self):
+        """The tidb_slow_query_file sink path — only when the sysvar
+        was EXPLICITLY set (session or global): the reference always
+        writes its default file, but an embedded engine spraying
+        tidb-slow.log into every caller's CWD is a footgun, so the
+        default path is advertised, not armed."""
+        sv = self.vars
+        if (
+            "tidb_slow_query_file" in sv._session
+            or "tidb_slow_query_file" in sv._globals
+        ):
+            return str(sv.get("tidb_slow_query_file")) or None
+        return None
+
+    def _capture_slow_plan(self, s) -> str:
+        """Plan capture for an over-threshold statement (reference:
+        tidb_record_plan_in_slow_log writes the physical plan into the
+        slow-log entry; the caller gates on that switch). The captured
+        plan is the statement's bound plan tree; when the statement
+        rode the DCN scheduler, the distributed stage summary
+        SNAPSHOTTED at routing time is appended (same renderer as
+        EXPLAIN ANALYZE) so the entry reads like the distributed
+        EXPLAIN ANALYZE."""
+        if not isinstance(s, (ast.Select, ast.Union, ast.With, ast.SetOp)):
+            return ""
+        plan = self._last_plan
+        if plan is None:
+            return ""
+        try:
+            lines: List[str] = []
+            _render_plan(
+                plan, 0, lines, catalog=self.catalog,
+                resolver=self._resolve_table_for_read,
+            )
+            if getattr(self, "_last_dcn_routed", False):
+                lines.extend(
+                    _dcn_runtime_lines(
+                        getattr(self, "_last_dcn_snapshot", None)
+                    )
+                )
+            return "\n".join(lines)
+        except Exception:
+            return ""  # plan capture must never fail the statement
 
     # ------------------------------------------------------------------
     def _run_show(self, s: ast.Show) -> Result:
@@ -3617,23 +3738,150 @@ class Session(DDLMixin):
                     self.killer.deadline = _t.monotonic() + int(args[0]) / 1000
                 except ValueError:
                     pass
+        from tidb_tpu.obs.flight import FLIGHT
+
         try:
             # spans mirror the reference's (session.ExecuteStmt ->
             # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
+            t_plan = time.perf_counter()
             with self.tracer.span("session.plan"):
                 plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
+            FLIGHT.note_phase("plan", time.perf_counter() - t_plan)
             self._last_plan = plan  # prepared-statement plan capture
+            routed = self._try_dcn_select(plan)
+            if routed is not None:
+                return routed
+            # the execute wall contains any jit traces watched_jit
+            # charges to "compile" — subtract them so the two phases
+            # stay additive (a first-run statement must not read as
+            # simultaneously compile-bound AND execute-bound)
+            t_exec = time.perf_counter()
+            c0 = FLIGHT.phase_seconds("compile")
             with self.tracer.span("executor.run"):
                 hs = self._try_host_sorted(plan)
                 if hs is not None:
+                    FLIGHT.note_phase(
+                        "execute",
+                        (time.perf_counter() - t_exec)
+                        - (FLIGHT.phase_seconds("compile") - c0),
+                    )
                     return hs
                 batch, dicts = self.executor.run(plan)
+            FLIGHT.note_phase(
+                "execute",
+                (time.perf_counter() - t_exec)
+                - (FLIGHT.phase_seconds("compile") - c0),
+            )
+            t_mat = time.perf_counter()
             with self.tracer.span("session.materialize"):
                 rows = materialize_rows(batch, list(plan.schema), dicts)
+            FLIGHT.note_phase("final-merge", time.perf_counter() - t_mat)
             names = [c.name for c in plan.schema]
             return Result(names, rows, types=[c.type for c in plan.schema])
         finally:
             self.executor.stream_rows = old_stream
+
+    #: schemas whose virtual tables reflect THIS process's state — a
+    #: plan scanning them must never ship to the worker fleet
+    _LOCAL_ONLY_DBS = frozenset(
+        {"information_schema", "mysql", "performance_schema",
+         "metrics_schema"}
+    )
+
+    def _try_dcn_select(self, plan):
+        """Route a SELECT through the attached DCN fragment scheduler
+        (PR 6: attached schedulers execute fragmentable/shuffleable
+        statements across the worker fleet, not just EXPLAIN ANALYZE).
+        Returns a Result, or None to run locally: unattached, inside a
+        transaction or stale read (both need this session's snapshot),
+        system-schema scans, and plans the fragmenter declares
+        single-host (whole-plan dispatch to a worker would read the
+        WORKER's catalog state for shapes the local engine serves
+        fine)."""
+        sched = getattr(self, "dcn_scheduler", None)
+        self._last_dcn_routed = False
+        if sched is None:
+            return None
+        if self._txn is not None or self._stmt_as_of:
+            return None
+        from tidb_tpu.planner import logical as L
+
+        def scan_dbs(p, out):
+            if isinstance(p, L.Scan):
+                out.add(str(p.db).lower())
+            for attr in ("child", "left", "right"):
+                c = getattr(p, attr, None)
+                if c is not None:
+                    scan_dbs(c, out)
+            for c in getattr(p, "children", []) or []:
+                scan_dbs(c, out)
+            return out
+
+        dbs = scan_dbs(plan, set())
+        # "_"-prefixed dbs are coordinator-internal scratch space
+        # (recursive-CTE materialization lands in _cte_scratch) —
+        # workers have never heard of them
+        if any(db.startswith("_") for db in dbs) or (
+            dbs & self._LOCAL_ONLY_DBS
+        ):
+            return None
+        from tidb_tpu.planner.fragmenter import Unschedulable
+
+        try:
+            kind, cut = sched._choose_cut(plan)
+        except Unschedulable:
+            return None
+        if kind == "single":
+            return None
+        from tidb_tpu.utils.memtrack import QuotaExceeded
+        from tidb_tpu.utils.sqlkiller import QueryKilled
+
+        try:
+            cols, rows = sched.execute_plan(plan, cut_hint=(kind, cut))
+        except (QueryKilled, QuotaExceeded):
+            # deliberate aborts (KILL QUERY / max_execution_time /
+            # memory quota) raised during the coordinator-local final
+            # stage must surface immediately — re-running the whole
+            # statement locally would delay the abort by a full second
+            # execution and miscount it as a dispatch failure
+            raise
+        except Exception:
+            # the fleet could not serve it (all workers lost, or a
+            # coordinator-only table the workers never loaded): the
+            # local engine still can. Data-currency across the fleet
+            # remains the attach contract (see attach_dcn_scheduler);
+            # this fallback turns hard routing failures into local
+            # execution, not silent wrongness.
+            from tidb_tpu.utils.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "tidbtpu_session_dcn_route_fallbacks_total",
+                "routed SELECTs that fell back to local execution "
+                "after a fleet dispatch failure",
+            ).inc()
+            return None
+        self._last_dcn_routed = True
+        # snapshot the runtime stats NOW (small dicts, spans elided):
+        # last_query is scheduler-global, so waiting until slow-log
+        # capture would let another session's routed query overwrite
+        # it. Rendering to text stays lazy — _capture_slow_plan runs
+        # only for over-threshold statements.
+        lq = getattr(sched, "last_query", None) or {}
+        snap = {}
+        if lq.get("shuffle"):
+            snap["shuffle"] = dict(lq["shuffle"])
+        if lq.get("fragments"):
+            snap["fragments"] = [
+                {k: v for k, v in f.items() if k != "spans"}
+                for f in lq["fragments"]
+            ]
+        self._last_dcn_snapshot = snap
+        schema_cols = list(plan.schema)
+        types = (
+            [c.type for c in schema_cols]
+            if len(schema_cols) == len(cols) else None
+        )
+        return Result(cols, rows, types=types)
 
     def _try_host_sorted(self, plan):
         """Out-of-HBM full ORDER BY (planner/streamed.try_streamed_sort):
@@ -5391,9 +5639,17 @@ class Session(DDLMixin):
 
     def attach_dcn_scheduler(self, scheduler) -> None:
         """Attach a DCNFragmentScheduler: EXPLAIN ANALYZE of session
-        statements then routes through scheduler.explain_analyze (the
+        statements routes through scheduler.explain_analyze (the
         distributed plan tree — per-host fragment rows, Shuffle
-        exchange rows). Pass None to detach."""
+        exchange rows), and fragmentable/shuffleable SELECTs execute
+        across the worker fleet (PR 6, _try_dcn_select). CONTRACT:
+        attaching asserts the workers hold current copies of the
+        scanned user tables (dcn_worker's deterministic-load model);
+        coordinator-local writes are NOT replicated to the fleet, so
+        a diverged table reads the workers' data. Transactions, stale
+        reads, system schemas and internal dbs always run locally,
+        and a fleet dispatch failure falls back to local execution.
+        Pass None to detach."""
         self.dcn_scheduler = scheduler
 
     def _run_explain(self, s: ast.Explain) -> Result:
@@ -5401,12 +5657,18 @@ class Session(DDLMixin):
             raise ValueError("EXPLAIN supports SELECT/UNION/WITH")
         plan = build_query(s.stmt, self.catalog, self.db, self._scalar_subquery)
         if s.analyze:
+            from tidb_tpu.obs.flight import FLIGHT
+
             sched = getattr(self, "dcn_scheduler", None)
             if sched is not None:
                 from tidb_tpu.planner.fragmenter import Unschedulable
 
                 try:
                     _cols, _rows, lines = sched.explain_analyze(plan)
+                    # the instrumented lines ARE the plan capture: an
+                    # over-threshold EXPLAIN ANALYZE's slow-log entry
+                    # carries the genuine distributed EXPLAIN ANALYZE
+                    FLIGHT.note_plan_text("\n".join(lines))
                     return Result(["plan"], [(l,) for l in lines])
                 except Unschedulable:
                     # plans that cannot cross the engine seam at all
@@ -5414,6 +5676,7 @@ class Session(DDLMixin):
                     # the local instrumented run
                     pass
             _out, _dicts, lines = self.executor.run_analyze(plan)
+            FLIGHT.note_plan_text("\n".join(lines))
             return Result(["plan"], [(l,) for l in lines])
         from tidb_tpu.planner.cardinality import est_rows
 
@@ -5426,6 +5689,30 @@ class Session(DDLMixin):
             resolver=self._resolve_table_for_read,
         )
         return Result(["plan"], [(l,) for l in lines])
+
+
+def _dcn_runtime_lines(lq) -> List[str]:
+    """Distributed runtime summary of one routed query's stats
+    snapshot ({"shuffle": ..., "fragments": [...]}), appended to
+    slow-log plan captures so an over-threshold DCN statement's entry
+    reads like its distributed EXPLAIN ANALYZE without re-running the
+    query instrumented. Rendered LAZILY (the capture path only) by
+    the SAME functions EXPLAIN ANALYZE uses (planner/physical.py
+    _merge_shuffle_stats/_merge_frag_stats over an empty tree) — one
+    DCNShuffle/Fragment# grammar, never two."""
+    from tidb_tpu.planner.physical import (
+        _merge_frag_stats,
+        _merge_shuffle_stats,
+    )
+
+    lq = lq or {}
+    if lq.get("shuffle"):
+        return _merge_shuffle_stats(
+            [], lq["shuffle"], lq.get("fragments") or []
+        )
+    if lq.get("fragments"):
+        return _merge_frag_stats([], lq["fragments"])
+    return []
 
 
 _cte_scratch_seq = itertools.count(1)

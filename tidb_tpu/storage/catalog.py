@@ -298,14 +298,14 @@ class Catalog:
         "tables", "columns", "schemata", "statistics", "slow_query",
         "statements_summary", "metrics", "top_sql", "resource_groups",
         "sequences", "memory_usage", "memory_usage_ops_history",
-        "tpu_engine",
+        "tpu_engine", "cluster_links",
     )
 
     def _infoschema_table(self, name: str) -> Table:
         if name in (
             "slow_query", "statements_summary", "metrics", "top_sql",
             "resource_groups", "memory_usage", "memory_usage_ops_history",
-            "tpu_engine",
+            "tpu_engine", "cluster_links",
         ):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
@@ -577,23 +577,98 @@ class Catalog:
                     (db,) for db in sorted(self._dbs) if not db.startswith("_")
                 ]
         elif name == "slow_query":
+            # PR 6: flight-recorder columns — the per-phase timeline
+            # and the captured plan text (distributed EXPLAIN ANALYZE
+            # for scheduler-routed/instrumented statements) ride along
+            # with the legacy time/query/query_time triple
             from tidb_tpu.dtypes import FLOAT64
             from tidb_tpu.utils.metrics import SLOW_LOG
 
             schema = TableSchema(
-                [("time", FLOAT64), ("query", STRING), ("query_time", FLOAT64)]
+                [("time", FLOAT64), ("query", STRING),
+                 ("query_time", FLOAT64), ("digest", STRING),
+                 ("conn_id", INT64), ("phases", STRING),
+                 ("plan", STRING)]
             )
             rows = SLOW_LOG.rows()
         elif name == "statements_summary":
+            # PR 6: per-digest percentiles (streaming histogram), mean
+            # per-phase breakdown, plan digest/cache attribution and
+            # the engine-watch join (reference: stmtsummary's wide
+            # statement row; "Accelerating Presto with GPUs" — the
+            # device-vs-host breakdown is the optimization compass)
             from tidb_tpu.dtypes import FLOAT64
             from tidb_tpu.utils.metrics import STMT_SUMMARY
 
+            phase_cols = (
+                ("avg_parse", "parse"), ("avg_plan", "plan"),
+                ("avg_compile", "compile"), ("avg_execute", "execute"),
+                ("avg_final_merge", "final-merge"),
+                ("avg_dispatch", "fragment-dispatch"),
+                ("avg_shuffle_produce", "shuffle-produce"),
+                ("avg_shuffle_push", "shuffle-push"),
+                ("avg_shuffle_wait", "shuffle-wait"),
+                ("avg_shuffle_stage", "shuffle-stage"),
+            )
             schema = TableSchema(
                 [("digest_text", STRING), ("exec_count", INT64),
                  ("sum_latency", FLOAT64), ("max_latency", FLOAT64),
-                 ("sample_text", STRING)]
+                 ("p50_latency", FLOAT64), ("p95_latency", FLOAT64),
+                 ("p99_latency", FLOAT64), ("plan_digest", STRING)]
+                + [(cn, FLOAT64) for cn, _p in phase_cols]
+                + [("shuffle_bytes", INT64), ("shuffle_retries", INT64),
+                   ("dispatch_retries", INT64),
+                   ("rows_sent", INT64), ("plan_cache_hits", INT64),
+                   ("plan_cache_misses", INT64),
+                   ("jit_compilations", INT64), ("retraces", INT64),
+                   ("h2d_bytes", INT64), ("d2h_bytes", INT64),
+                   ("device_mem_peak_bytes", INT64),
+                   ("sample_text", STRING)]
             )
-            rows = STMT_SUMMARY.rows()
+            rows = []
+            for e in STMT_SUMMARY.rows_full():
+                n = max(e["exec_count"], 1)
+                ph = e["phases"]
+                rows.append(
+                    (e["digest_text"], e["exec_count"],
+                     e["sum_latency"], e["max_latency"],
+                     e["p50_latency"], e["p95_latency"],
+                     e["p99_latency"], e["plan_digest"])
+                    + tuple(
+                        ph.get(p, (0.0, 0, 0))[0] / n
+                        for _cn, p in phase_cols
+                    )
+                    # shuffle_retries = tunnel retransmits (the
+                    # shuffle-push retries slot); dispatch_retries =
+                    # fragment re-dispatches after worker loss — two
+                    # different data planes, two columns
+                    + (ph.get("shuffle-push", (0.0, 0, 0))[1],
+                       ph.get("shuffle-push", (0.0, 0, 0))[2],
+                       ph.get("fragment-dispatch", (0.0, 0, 0))[2],
+                       e["rows_sent"], e["plan_cache_hits"],
+                       e["plan_cache_misses"], e["jit_compilations"],
+                       e["retraces"], e["h2d_bytes"], e["d2h_bytes"],
+                       e["device_mem_peak_bytes"], e["sample_text"])
+                )
+        elif name == "cluster_links":
+            # PR 6: per-peer DCN link health (obs/flight.py LINKS) —
+            # control links carry the handshake RTT/clock offset and
+            # heartbeat age; tunnel links carry bytes/frames/rows
+            # pushed, backpressure stall seconds, retransmits and the
+            # negotiated codec, merged from fenced shuffle replies
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.obs.flight import LINKS
+
+            schema = TableSchema(
+                [("src", STRING), ("dst", STRING), ("kind", STRING),
+                 ("alive", INT64), ("rtt_ms", FLOAT64),
+                 ("clock_offset_ms", FLOAT64),
+                 ("heartbeat_age_s", FLOAT64), ("bytes", INT64),
+                 ("frames", INT64), ("rows", INT64),
+                 ("stall_seconds", FLOAT64), ("retransmits", INT64),
+                 ("codec", STRING)]
+            )
+            rows = LINKS.rows()
         elif name == "metrics":
             from tidb_tpu.dtypes import FLOAT64
             from tidb_tpu.utils.metrics import REGISTRY

@@ -119,6 +119,40 @@ class Histogram:
             self.counts[-1] += 1
 
 
+class StreamingHistogram(Histogram):
+    """A Histogram that additionally answers quantile queries — the
+    statements_summary p50/p95/p99 estimator (reference: stmtsummary
+    keeps a percentile sketch per digest; Prometheus histogram_quantile
+    does the same interpolation server-side). Same fixed buckets as the
+    exposition Histogram so one latency vocabulary serves both
+    surfaces. O(1) observe, O(buckets) quantile; estimates are
+    monotone in q (p99 >= p95 >= p50 by construction)."""
+
+    def quantile(self, q: float) -> float:
+        """Estimated value at quantile ``q`` in [0, 1]: linear
+        interpolation inside the covering bucket (lower bound = the
+        previous bucket's upper edge, 0 for the first). The overflow
+        bucket has no upper edge; it answers with max(sum/total, last
+        edge) — bounded, and exact for the single-observation case."""
+        q = min(max(float(q), 0.0), 1.0)
+        with self._lock:
+            total = self.total
+            if total == 0:
+                return 0.0
+            rank = q * total
+            acc = 0
+            lo = 0.0
+            for edge, c in zip(self.BUCKETS, self.counts):
+                if acc + c >= rank and c > 0:
+                    frac = (rank - acc) / c
+                    return lo + (edge - lo) * min(max(frac, 0.0), 1.0)
+                acc += c
+                lo = edge
+            # overflow bucket: the mean is the best bounded point
+            # estimate available without per-sample storage
+            return max(self.sum / total, float(self.BUCKETS[-1]))
+
+
 class MetricFamily:
     """A labeled metric: one (name, labelnames) family whose children
     are plain Counter/Gauge/Histogram instances keyed by label values
@@ -347,10 +381,41 @@ def merge_counter_delta(delta, registry: Registry = REGISTRY) -> None:
             continue
 
 
+def _collapse_in_lists(parts: List[str]) -> List[str]:
+    """Collapse ``in ( ? , ? , ? )`` to ``in ( ... )`` so a statement's
+    digest does not fragment per IN-list literal count (reference:
+    digester.go reduces value lists to one `...` element — without
+    this, `a IN (1,2)` and `a IN (1,2,3)` land in different
+    statements_summary rows and the summary store fills with
+    cardinality noise)."""
+    out: List[str] = []
+    i = 0
+    n = len(parts)
+    while i < n:
+        if (
+            parts[i] == "in"
+            and i + 2 < n
+            and parts[i + 1] == "("
+            and parts[i + 2] == "?"
+        ):
+            # only a pure placeholder list collapses; `in (select …)`
+            # and mixed-expression lists keep their structure
+            j = i + 3
+            while j + 1 < n and parts[j] == "," and parts[j + 1] == "?":
+                j += 2
+            if j < n and parts[j] == ")":
+                out.extend(("in", "(", "...", ")"))
+                i = j + 1
+                continue
+        out.append(parts[i])
+        i += 1
+    return out
+
+
 def sql_digest(sql: str) -> str:
     """Normalize a statement for summary grouping: literals -> '?',
-    whitespace collapsed, lowercased keywords (reference: parser
-    digester.go)."""
+    IN-lists of literals -> '(...)', whitespace collapsed, lowercased
+    keywords (reference: parser digester.go)."""
     try:
         from tidb_tpu.parser.sqlparse import tokenize
 
@@ -367,57 +432,201 @@ def sql_digest(sql: str) -> str:
                 break
             else:
                 parts.append(t.text.lower() if t.kind == "kw" else t.text)
-        return " ".join(parts)
+        return " ".join(_collapse_in_lists(parts))
     except Exception:
         return re.sub(r"\s+", " ", sql.strip())[:512]
 
 
 class SlowLog:
     """Ring buffer of statements slower than the threshold (reference:
-    slow-query log + INFORMATION_SCHEMA.SLOW_QUERY round trip)."""
+    slow-query log + INFORMATION_SCHEMA.SLOW_QUERY round trip). Each
+    entry may carry the query's flight-recorder phase timeline and the
+    captured plan text (PR 6); legacy 3-field callers keep working —
+    the extras default empty."""
 
     def __init__(self, capacity: int = 256):
         self._buf = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._file_lock = threading.Lock()
 
-    def record(self, sql: str, seconds: float) -> None:
+    def record(
+        self,
+        sql: str,
+        seconds: float,
+        digest: str = "",
+        conn_id: int = 0,
+        phases: str = "",
+        plan: str = "",
+        log_file: Optional[str] = None,
+    ) -> None:
+        ts = time.time()
         with self._lock:
-            self._buf.append((time.time(), sql[:2048], seconds))
+            self._buf.append(
+                (ts, sql[:2048], seconds, digest[:512], int(conn_id),
+                 phases[:4096], plan[:16384])
+            )
+        if log_file:
+            self._append_file(log_file, ts, sql, seconds, phases, plan)
 
-    def rows(self) -> List[Tuple[float, str, float]]:
+    def _append_file(self, path, ts, sql, seconds, phases, plan) -> None:
+        """The tidb_slow_query_file sink: reference slow-log entry
+        shape (`# Time` / `# Query_time` headers, `# Plan` block, the
+        statement terminated by `;`). Write failures are swallowed —
+        the log file must never fail the statement."""
+        lines = [
+            f"# Time: {time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(ts))}Z",
+            f"# Query_time: {seconds:.6f}",
+        ]
+        if phases:
+            lines.append(f"# Phases: {phases}")
+        if plan:
+            lines.extend("# Plan: " + ln for ln in plan.splitlines())
+        lines.append(sql.rstrip(";") + ";")
+        try:
+            with self._file_lock, open(path, "a", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass
+
+    def rows(self) -> List[tuple]:
+        """(time, query, query_time, digest, conn_id, phases, plan),
+        oldest first. The first three fields are the pre-PR 6 contract
+        (existing consumers index positionally)."""
         with self._lock:
             return list(self._buf)
 
 
+class _StmtEntry:
+    """One digest's aggregates: the legacy count/sum/max/sample plus
+    the PR 6 flight-derived columns (latency percentiles via a
+    streaming histogram, per-phase sums, plan-cache and engine-watch
+    attribution)."""
+
+    __slots__ = (
+        "n", "sum_s", "max_s", "sample", "hist", "phases", "rows_sent",
+        "plan_digest", "plan_cache_hits", "plan_cache_misses",
+        "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
+        "device_mem_peak_bytes",
+    )
+
+    def __init__(self, sample: str):
+        self.n = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+        self.sample = sample
+        self.hist = StreamingHistogram("stmt_latency")
+        #: phase name -> [sum seconds, bytes, retries]
+        self.phases: Dict[str, list] = {}
+        self.rows_sent = 0
+        self.plan_digest = ""
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.jit_compilations = 0
+        self.retraces = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.device_mem_peak_bytes = 0
+
+    def absorb_flight(self, flight) -> None:
+        """Fold one finished QueryFlight (obs/flight.py) in."""
+        for name, (s, b, r) in flight.phases.items():
+            row = self.phases.setdefault(name, [0.0, 0, 0])
+            row[0] += s
+            row[1] += b
+            row[2] += r
+        self.rows_sent += int(flight.rows_sent)
+        if getattr(flight, "plan_digest", ""):
+            self.plan_digest = flight.plan_digest
+        if flight.plan_cache == "hit":
+            self.plan_cache_hits += 1
+        elif flight.plan_cache == "miss":
+            self.plan_cache_misses += 1
+        self.jit_compilations += int(flight.jit_compilations)
+        self.retraces += int(flight.retraces)
+        self.h2d_bytes += int(flight.h2d_bytes)
+        self.d2h_bytes += int(flight.d2h_bytes)
+        self.device_mem_peak_bytes = max(
+            self.device_mem_peak_bytes, int(flight.device_mem_peak_bytes)
+        )
+
+
 class StmtSummary:
     """Per-digest aggregated statement stats (reference:
-    statement_summary.go:73)."""
+    statement_summary.go:73). ``record`` optionally takes the finished
+    flight record; without one, only the legacy latency aggregates
+    move (worker-internal sessions, tests)."""
 
     def __init__(self, capacity: int = 512):
         self._capacity = capacity
-        self._map: Dict[str, list] = {}
+        self._map: Dict[str, _StmtEntry] = {}
         self._lock = threading.Lock()
 
-    def record(self, sql: str, seconds: float) -> None:
-        d = sql_digest(sql)
+    def record(
+        self, sql: str, seconds: float, flight=None,
+        digest: Optional[str] = None,
+    ) -> None:
+        # callers that already digested the text pass it in (the slow
+        # log shares one digest with the summary per statement)
+        d = digest if digest is not None else sql_digest(sql)
         with self._lock:
             ent = self._map.get(d)
             if ent is None:
                 if len(self._map) >= self._capacity:
                     # evict the least-executed digest
-                    victim = min(self._map, key=lambda k: self._map[k][0])
+                    victim = min(self._map, key=lambda k: self._map[k].n)
                     del self._map[victim]
-                ent = self._map[d] = [0, 0.0, 0.0, sql[:256]]
-            ent[0] += 1
-            ent[1] += seconds
-            ent[2] = max(ent[2], seconds)
+                ent = self._map[d] = _StmtEntry(sql[:256])
+            ent.n += 1
+            ent.sum_s += seconds
+            ent.max_s = max(ent.max_s, seconds)
+            ent.hist.observe(seconds)
+            if flight is not None:
+                ent.absorb_flight(flight)
 
     def rows(self) -> List[Tuple[str, int, float, float, str]]:
+        """The pre-PR 6 contract: (digest, count, sum, max, sample) —
+        kept for positional consumers (top_sql ranking, digest
+        decode). The full surface is rows_full()."""
         with self._lock:
             return [
-                (d, n, s, mx, sample)
-                for d, (n, s, mx, sample) in sorted(self._map.items())
+                (d, e.n, e.sum_s, e.max_s, e.sample)
+                for d, e in sorted(self._map.items())
             ]
+
+    def rows_full(self) -> List[dict]:
+        """Extended per-digest dicts for information_schema.
+        statements_summary and the bench --flight-out snapshot:
+        percentiles, mean per-phase seconds, plan-cache and engine
+        columns."""
+        with self._lock:
+            items = sorted(self._map.items())
+            out = []
+            for d, e in items:
+                out.append(
+                    {
+                        "digest_text": d,
+                        "exec_count": e.n,
+                        "sum_latency": e.sum_s,
+                        "max_latency": e.max_s,
+                        "p50_latency": e.hist.quantile(0.50),
+                        "p95_latency": e.hist.quantile(0.95),
+                        "p99_latency": e.hist.quantile(0.99),
+                        "plan_digest": e.plan_digest,
+                        "phases": {
+                            p: list(v) for p, v in e.phases.items()
+                        },
+                        "rows_sent": e.rows_sent,
+                        "plan_cache_hits": e.plan_cache_hits,
+                        "plan_cache_misses": e.plan_cache_misses,
+                        "jit_compilations": e.jit_compilations,
+                        "retraces": e.retraces,
+                        "h2d_bytes": e.h2d_bytes,
+                        "d2h_bytes": e.d2h_bytes,
+                        "device_mem_peak_bytes": e.device_mem_peak_bytes,
+                        "sample_text": e.sample,
+                    }
+                )
+            return out
 
     def reset(self) -> None:
         """Clear all digests (the statements_summary clear analog,

@@ -86,6 +86,18 @@ class Tracer:
             )
         return out
 
+    def totals_by_name(self) -> dict:
+        """Total duration per span name. The cross-check surface
+        between the two timing systems: a TRACE'd statement's
+        session.plan/executor.run span totals and the flight
+        recorder's plan/execute phase charges (obs/flight.py) cover
+        the same walls, so they must agree — tests/test_observability
+        asserts it."""
+        out: dict = {}
+        for s in self.spans:
+            out[s.name] = out.get(s.name, 0.0) + s.dur_s
+        return out
+
 
 # module-level convenience tracer used when no session is involved
 _global = Tracer()

@@ -129,13 +129,23 @@ class InstanceWatchdog(threading.Thread):
                     "tidbtpu_watchdog_expensive_queries_total",
                     "statements running past the expensive threshold",
                 ).inc()
-                from tidb_tpu.utils.metrics import SLOW_LOG
+                # the expensive-query entry rides the slow log, so it
+                # honors the slow_query_log on/off switch like the
+                # session call site. Its admission bar is its OWN
+                # sysvar (tidb_expensive_query_time_threshold, checked
+                # above) — the statement is still RUNNING here, so
+                # comparing the in-flight elapsed against
+                # tidb_slow_log_threshold would suppress entries whose
+                # final elapsed crosses it moments later
+                if bool(self._gvar("slow_query_log", True)):
+                    from tidb_tpu.utils.metrics import SLOW_LOG
 
-                SLOW_LOG.record(
-                    f"[expensive_query] conn={s.conn_id} "
-                    f"elapsed={elapsed:.1f}s sql={str(cur[0])[:200]}",
-                    elapsed,
-                )
+                    SLOW_LOG.record(
+                        f"[expensive_query] conn={s.conn_id} "
+                        f"elapsed={elapsed:.1f}s sql={str(cur[0])[:200]}",
+                        elapsed,
+                        conn_id=s.conn_id,
+                    )
         if len(self.expensive_seen) > 4096:
             self.expensive_seen.clear()
 
