@@ -795,6 +795,12 @@ def measure_multihost_shuffle(args) -> int:
     try:
         env = dict(os.environ)
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        if getattr(args, "racecheck", False):
+            # the whole data plane (ShuffleStore cv, tunnel cv, exec
+            # rlock, metrics) runs order-tracked in the workers: a
+            # clean capture PROVES no lock-order inversion fired under
+            # real produce/push/decode/stage interleaving
+            env["TIDB_TPU_RACECHECK"] = "1"
         ports = []
         for _ in range(2):
             p = subprocess.Popen(
@@ -1121,6 +1127,11 @@ def measure_multihost_shuffle(args) -> int:
                 },
                 "codec_ab": codec_ab,
                 "pipeline_ab": pipeline_ab,
+                # --racecheck: workers ran with TIDB_TPU_RACECHECK=1
+                # (order-tracked locks); a worker inversion raises and
+                # fails the run, so True here means the data plane ran
+                # clean under the detector
+                "racecheck": bool(getattr(args, "racecheck", False)),
                 # the flight recorder's per-digest view of this query
                 # (phase means, percentiles) — the information_schema.
                 # statements_summary breakdown as the bench sees it
@@ -1200,6 +1211,13 @@ def main() -> int:
         "codec A/B (bytes per row, encode/decode seconds — "
         "detail.codec_ab) (CPU data-plane scenario; SF capped at "
         "0.02 unless --sf <= 1)",
+    )
+    ap.add_argument(
+        "--racecheck", action="store_true",
+        help="with --multihost-shuffle: run the worker processes under "
+        "TIDB_TPU_RACECHECK=1 (order-tracked locks, utils/racecheck.py)"
+        " and stamp detail.racecheck so the capture proves the data "
+        "plane ran clean under the lock-order detector",
     )
     ap.add_argument("--_measure", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()

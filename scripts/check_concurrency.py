@@ -1,0 +1,915 @@
+#!/usr/bin/env python
+"""Lint: concurrency discipline for every mutex and thread in the
+engine — the static half of utils/racecheck.py (the `make race` seam).
+
+Reference: the Go build guards the whole repo with one `ut --race` CI
+run (Makefile:192) plus unistore's wait-for deadlock detector. The
+runtime detector here (TIDB_TPU_RACECHECK=1) only sees orders a test
+actually interleaves; this lint proves the invariants statically, so a
+lock added in the MPP data plane is governed the moment it lands.
+
+Four rules over ``tidb_tpu/`` (utils/racecheck.py itself exempt):
+
+1. **no raw locks** — every mutex is constructed through
+   ``racecheck.make_lock/make_rlock/make_condition("class")`` with a
+   literal class name declared in racecheck.LOCK_CLASSES (undeclared
+   construction, non-literal name, and dead declarations all fail) —
+   lock classes are an API like failpoint SITES and metric SUBSYSTEMS.
+2. **no blocking under lock** — inside a ``with <lock>:`` body (or an
+   acquire()/release() span), a call from the declared BLOCKING set
+   (socket round trips, EngineClient RPCs, queue get/put, time.sleep,
+   condition waits, subprocess, the watched_jit compile entry) fails
+   unless the line (or the two above it, or the with-header) carries a
+   ``lock-blocking-ok`` marker justifying it. Waiting on the SAME
+   condition object that is the with-context is the cv idiom and is
+   always allowed. This is the deadlock class the pipelined shuffle
+   actually risks: an ack round trip held under a tunnel lock stalls
+   every producer behind one slow peer.
+3. **static lock-order graph** — nested ``with`` acquisitions per
+   function, plus one level of interprocedural calls (self-methods and
+   same/known-module functions that themselves acquire), fold into a
+   class-level edge graph; any cycle fails. The resulting partial
+   order is emitted into README.md between the lock-hierarchy markers
+   (``--write-doc`` regenerates it; the default run fails on drift) so
+   the hierarchy is reviewable, not tribal.
+4. **thread hygiene** — every ``threading.Thread(...)`` (including the
+   ``super().__init__(...)`` call of a Thread subclass) passes
+   ``daemon=True`` (marker escape: ``thread-non-daemon-ok``) and a
+   ``name=`` whose literal prefix is declared in
+   racecheck.THREAD_NAME_PREFIXES, so /links, the flight recorder and
+   py-spy dumps can attribute threads to subsystems.
+
+Usage: python scripts/check_concurrency.py [root] [--write-doc]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+MARKER_BLOCKING = "lock-blocking-ok"
+MARKER_THREAD = "thread-non-daemon-ok"
+DOC_START = "<!-- lock-hierarchy:start (scripts/check_concurrency.py --write-doc) -->"
+DOC_END = "<!-- lock-hierarchy:end -->"
+
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules"}
+#: the tracked-lock implementation is the one legitimate constructor of
+#: raw threading primitives
+EXEMPT = {os.path.join("tidb_tpu", "utils", "racecheck.py")}
+
+MAKERS = ("make_lock", "make_rlock", "make_condition")
+
+#: with-context / receiver names that denote a mutex ("with <this>:"
+#: opens a lock scope for rule 2/3)
+_LOCKISH = re.compile(
+    r"(lock|mutex|(^|_)mu$|(^|_)cv$|(^|_)lk$)", re.IGNORECASE
+)
+#: queue-ish receivers for the get/put blocking forms (dict.get would
+#: drown the rule otherwise)
+_QUEUEISH = re.compile(r"(^|_)(q|sq|queue)$|queue", re.IGNORECASE)
+
+#: attr/function names that BLOCK (with the reason the rule cites).
+#: A None receiver pattern matches any receiver; otherwise the
+#: receiver's trailing name must match.
+BLOCKING: Dict[str, Tuple[Optional[re.Pattern], str]] = {
+    "sleep": (None, "time.sleep parks the thread with the lock held"),
+    "recv": (None, "socket receive round trip"),
+    "recv_into": (None, "socket receive round trip"),
+    "accept": (None, "socket accept blocks until a peer connects"),
+    "connect": (None, "socket connect round trip"),
+    "create_connection": (None, "socket connect round trip"),
+    "sendall": (None, "socket send can block on the peer's window"),
+    "send": (None, "socket/tunnel send can block (backpressure)"),
+    "call": (None, "EngineClient RPC round trip"),
+    "_call": (None, "EngineClient RPC round trip"),
+    "execute_plan": (None, "EngineClient RPC round trip"),
+    "execute_plan_full": (None, "EngineClient RPC round trip"),
+    "shuffle_push": (None, "tunnel push round trip"),
+    "shuffle_push_encoded": (None, "tunnel push round trip"),
+    "shuffle_push_encoded_many": (
+        None, "pipelined tunnel push: k frames + k acks per round trip"
+    ),
+    "ping_endpoint": (None, "liveness ping round trip"),
+    "wait": (None, "blocking wait (cv/event) with the lock held"),
+    "wait_for": (None, "blocking wait with the lock held"),
+    "wait_side": (None, "ShuffleStore side wait blocks on peers"),
+    "flush": (None, "flush blocks until every queued packet is acked"),
+    "watched_jit": (None, "XLA compile entry (seconds-scale)"),
+    "EngineClient": (None, "connect + handshake round trip"),
+    "get": (_QUEUEISH, "blocking queue get"),
+    "put": (_QUEUEISH, "blocking queue put"),
+    "run": (
+        re.compile(r"^subprocess$"),
+        "subprocess runs a child to completion",
+    ),
+    "check_call": (re.compile(r"^subprocess$"), "subprocess round trip"),
+    "check_output": (re.compile(r"^subprocess$"), "subprocess round trip"),
+}
+
+#: real acquisition edges that sit two or more call levels below the
+#: holding scope, where the one-level interprocedural pass cannot see
+#: them: (held class, then-acquired class, origin). Declared here so
+#: they still participate in cycle detection and appear in the
+#: generated hierarchy instead of being invisible. Each entry is a
+#: claim about runtime order — keep it current with the path it cites.
+DEEP_EDGES: List[Tuple[str, str, str]] = [
+    # _dispatch/heartbeat/final-stage hold the per-connection stream
+    # lock while _conn() notes a fresh handshake's RTT into the link
+    # registry (flight.py LinkRegistry.note_handshake — two call
+    # levels down)
+    ("dcn.conn", "flight.links", "tidb_tpu/parallel/dcn.py"),
+]
+
+
+def load_racecheck(root: str):
+    path = os.path.join(root, "tidb_tpu", "utils", "racecheck.py")
+    spec = importlib.util.spec_from_file_location("_racecheck_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return dict(mod.LOCK_CLASSES), frozenset(mod.THREAD_NAME_PREFIXES)
+
+
+def iter_py(root: str):
+    base = os.path.join(root, "tidb_tpu")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _tail_name(node) -> Optional[str]:
+    """The trailing identifier of an expression: Name -> id,
+    a.b.c -> 'c', f(...) -> tail of f."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _tail_name(node.func)
+    return None
+
+
+def _is_lockish(node) -> bool:
+    n = _tail_name(node)
+    return bool(n) and bool(_LOCKISH.search(n))
+
+
+def _expr_key(node) -> str:
+    """Identity key for 'same lock object' comparison (the cv-wait
+    exemption): the dotted source path of a Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        return _expr_key(node.func) + "(...)"
+    return ast.dump(node)
+
+
+class _FileLint(ast.NodeVisitor):
+    """One file's AST pass: lock constructions, lock scopes with their
+    blocking calls and nested acquisitions, thread constructions, and
+    per-function direct acquisitions (for the one-level interprocedural
+    edge pass)."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.lines = text.splitlines()
+        #: imported-from-threading names (so `Lock()` bare calls count)
+        self.threading_names: Set[str] = set()
+        #: (lineno, kind) raw threading constructions
+        self.raw_locks: List[Tuple[int, str]] = []
+        #: (lineno, maker, class name or None-if-nonliteral)
+        self.makes: List[Tuple[int, str, Optional[str]]] = []
+        #: variable -> lock class, from `<target> = make_*("name")`:
+        #: keys are 'Class.attr' (self._x in class Class), bare names
+        #: (module/function locals), and 'Class.<method>()' for helper
+        #: methods returning a lock (resolved in a second pass)
+        self.lock_vars: Dict[str, str] = {}
+        #: function qualname -> set of lock classes it acquires at any
+        #: depth of its own body (direct withs only); filled by
+        #: finalize() from _fn_acquire_pend once lock_vars is complete
+        self.fn_acquires: Dict[str, Set[str]] = {}
+        #: (qualname, lock expr, enclosing class) acquisitions pended
+        #: until finalize() — resolving at visit time would miss locks
+        #: whose construction site (__init__) is defined BELOW the
+        #: acquiring method in the file
+        self._fn_acquire_pend: List[
+            Tuple[str, ast.expr, Optional[str]]
+        ] = []
+        #: (holder qualname, held classes tuple, with-lineno, body
+        #: calls [(lineno, receiver, attr/name)], nested scopes...)
+        self.scopes: List[dict] = []
+        #: threading.Thread constructions: (lineno, kwargs ast) — both
+        #: direct Thread(...) calls and super().__init__(...) inside a
+        #: Thread subclass (the subclass defines its name/daemon there)
+        self.threads: List[Tuple[int, ast.Call]] = []
+        #: class names in this file that subclass threading.Thread
+        self._thread_classes: Set[str] = set()
+        self._class_stack: List[str] = []
+        self._fn_stack: List[str] = []
+
+    # -- imports --------------------------------------------------------
+    def visit_ImportFrom(self, node):
+        if node.module == "threading":
+            for a in node.names:
+                self.threading_names.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    # -- defs -----------------------------------------------------------
+    def _is_thread_base(self, base) -> bool:
+        if isinstance(base, ast.Attribute):
+            return (
+                isinstance(base.value, ast.Name)
+                and base.value.id == "threading"
+                and base.attr == "Thread"
+            )
+        return isinstance(base, ast.Name) and base.id == "Thread" \
+            and base.id in self.threading_names
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        if any(self._is_thread_base(b) for b in node.bases):
+            self._thread_classes.add(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _qualname(self, fn_name: str) -> str:
+        if self._class_stack:
+            return f"{self._class_stack[-1]}.{fn_name}"
+        return fn_name
+
+    def visit_FunctionDef(self, node):
+        qual = self._qualname(node.name)
+        self._fn_stack.append(qual)
+        self.fn_acquires.setdefault(qual, set())
+        self._scan_acquire_spans(node, qual)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan_acquire_spans(self, node, qual: str) -> None:
+        """Explicit `<lock>.acquire()` ... `<lock>.release()` spans are
+        lock scopes too (rules 2 and 3): walk this function's own
+        statements in source order, open a scope at acquire, record the
+        calls and nested lockish withs of every statement while it is
+        open, close at release (or at function end — the lock is held
+        to the last statement we can see)."""
+        cls = self._class_stack[-1] if self._class_stack else None
+        stmts: List[ast.stmt] = []
+
+        def gather(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.stmt):
+                    stmts.append(child)
+                gather(child)
+
+        gather(node)
+        stmts.sort(key=lambda s: s.lineno)
+        open_spans: Dict[str, dict] = {}
+        for st in stmts:
+            calls = self._calls_in(st)
+            acquires: List[Tuple[str, ast.expr, int]] = []
+            for call in calls:
+                f = call.func
+                if not isinstance(f, ast.Attribute) \
+                        or not _is_lockish(f.value):
+                    continue
+                key = _expr_key(f.value)
+                if f.attr == "acquire":
+                    acquires.append((key, f.value, call.lineno))
+                elif f.attr == "release" and key in open_spans:
+                    self.scopes.append(open_spans.pop(key))
+            for scope in open_spans.values():
+                if isinstance(st, ast.With):
+                    for it in st.items:
+                        if _is_lockish(it.context_expr):
+                            scope["withs"].append(
+                                (st.lineno, it.context_expr)
+                            )
+                for call in calls:
+                    name = _tail_name(call.func)
+                    if name is None or name in ("acquire", "release"):
+                        continue
+                    recv = None
+                    if isinstance(call.func, ast.Attribute):
+                        recv = call.func.value
+                    scope["calls"].append(
+                        (call.lineno, recv, name, call)
+                    )
+            for key, expr, lineno in acquires:
+                # a re-acquire of the same key (acquire in two
+                # branches) closes out the first span — overwriting
+                # would silently drop its recorded calls
+                if key in open_spans:
+                    self.scopes.append(open_spans.pop(key))
+                open_spans[key] = {
+                    "qual": qual,
+                    "cls": cls,
+                    "lineno": lineno,
+                    "locks": [expr],
+                    "calls": [],
+                    "withs": [],
+                }
+                self._fn_acquire_pend.append((qual, expr, cls))
+        self.scopes.extend(open_spans.values())
+
+    # -- constructions --------------------------------------------------
+    def _maker_of(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in MAKERS:
+            return f.attr
+        if isinstance(f, ast.Name) and f.id in MAKERS:
+            return f.id
+        return None
+
+    def visit_Call(self, node):
+        f = node.func
+        # raw threading.Lock/RLock/Condition (+ bare imported names)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "threading":
+            if f.attr in ("Lock", "RLock", "Condition"):
+                self.raw_locks.append((node.lineno, f"threading.{f.attr}"))
+            elif f.attr == "Thread":
+                self.threads.append((node.lineno, node))
+        elif isinstance(f, ast.Name) and f.id in self.threading_names:
+            if f.id in ("Lock", "RLock", "Condition"):
+                self.raw_locks.append((node.lineno, f.id))
+            elif f.id == "Thread":
+                self.threads.append((node.lineno, node))
+        elif (
+            # super().__init__(...) inside a Thread subclass: that call
+            # carries the subclass's daemon=/name= kwargs, so rule 4
+            # applies there (a direct Thread(...) never happens)
+            isinstance(f, ast.Attribute)
+            and f.attr == "__init__"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Name)
+            and f.value.func.id == "super"
+            and self._class_stack
+            and self._class_stack[-1] in self._thread_classes
+        ):
+            self.threads.append((node.lineno, node))
+        maker = self._maker_of(node)
+        if maker is not None:
+            name = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            self.makes.append((node.lineno, maker, name))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # map lock variables to classes: x = make_*("name"),
+        # self._x = make_*("name"), a = b[k] = make_*("name")
+        if isinstance(node.value, ast.Call) \
+                and self._maker_of(node.value) is not None \
+                and node.value.args \
+                and isinstance(node.value.args[0], ast.Constant) \
+                and isinstance(node.value.args[0].value, str):
+            cls_name = node.value.args[0].value
+            for tgt in node.targets:
+                key = self._var_key(tgt)
+                if key is not None:
+                    self.lock_vars[key] = cls_name
+            # a helper method whose body constructs a lock returns that
+            # class ("_ep_lock" pattern): record Class.<method>() too
+            if self._fn_stack and self._class_stack:
+                self.lock_vars.setdefault(
+                    f"{self._fn_stack[-1]}()", cls_name
+                )
+        self.generic_visit(node)
+
+    def _var_key(self, tgt) -> Optional[str]:
+        if isinstance(tgt, ast.Name):
+            # bare locals are scoped to their function: the same local
+            # name bound to different classes in two functions must not
+            # share one file-global entry (it would both fabricate and
+            # drop rule-3 edges, last assignment winning)
+            if self._fn_stack:
+                return f"{self._fn_stack[-1]}:{tgt.id}"
+            return tgt.id
+        if isinstance(tgt, ast.Attribute) and self._class_stack:
+            return f"{self._class_stack[-1]}.{tgt.attr}"
+        if isinstance(tgt, ast.Attribute):
+            return tgt.attr
+        return None
+
+    # -- lock scopes ----------------------------------------------------
+    def visit_With(self, node):
+        lock_items = [
+            it.context_expr for it in node.items
+            if _is_lockish(it.context_expr)
+        ]
+        if lock_items:
+            scope = {
+                "qual": self._fn_stack[-1] if self._fn_stack else "<module>",
+                "cls": self._class_stack[-1] if self._class_stack else None,
+                "lineno": node.lineno,
+                "locks": lock_items,
+                "calls": [],   # (lineno, receiver ast, name)
+                "withs": [],   # nested lockish with items (lineno, expr)
+            }
+            self._collect_scope(node, scope)
+            self.scopes.append(scope)
+            if self._fn_stack:
+                for e in lock_items:
+                    self._fn_acquire_pend.append(
+                        (self._fn_stack[-1], e, scope["cls"])
+                    )
+        self.generic_visit(node)
+
+    def finalize(self) -> None:
+        """Resolve pended acquisitions AFTER the whole file is visited:
+        lock_vars is only complete then. Eager resolution would hand a
+        method defined textually above its class's __init__ an empty
+        acquire set, silently dropping its interprocedural rule-3
+        edges."""
+        for qual, expr, cls in self._fn_acquire_pend:
+            c = self.resolve_lock_class(expr, cls=cls, fn=qual)
+            if c is not None:
+                self.fn_acquires.setdefault(qual, set()).add(c)
+        self._fn_acquire_pend.clear()
+
+    def _classes_of(self, exprs, cls: Optional[str] = None,
+                    fn: Optional[str] = None) -> List[str]:
+        out = []
+        for e in exprs:
+            c = self.resolve_lock_class(e, cls=cls, fn=fn)
+            if c is not None:
+                out.append(c)
+        return out
+
+    def _collect_scope(self, node, scope):
+        """Every call and nested lockish with under this with's body
+        (not descending into nested function defs — they run later,
+        not under the lock)."""
+        for child in node.body:
+            self._walk_stmt(child, scope)
+
+    def _walk_stmt(self, node, scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            for it in node.items:
+                if _is_lockish(it.context_expr):
+                    scope["withs"].append(
+                        (node.lineno, it.context_expr)
+                    )
+        for call in self._calls_in(node):
+            name = _tail_name(call.func)
+            if name is None:
+                continue
+            recv = None
+            if isinstance(call.func, ast.Attribute):
+                recv = call.func.value
+            scope["calls"].append((call.lineno, recv, name, call))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.stmt):
+                self._walk_stmt(child, scope)
+
+    def _calls_in(self, node):
+        """Call nodes directly in this statement's expressions (nested
+        defs/lambdas excluded — they don't run under the lock)."""
+        out = []
+
+        def walk(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.stmt):
+                    continue  # nested statements handled by _walk_stmt
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                walk(child)
+
+        walk(node)
+        return out
+
+    # -- lock class resolution ------------------------------------------
+    def resolve_lock_class(self, expr, cls: Optional[str] = None,
+                           fn: Optional[str] = None) -> Optional[str]:
+        """Lock class of a with-context expression, via the
+        construction-site variable map. Attribute lookups try the
+        enclosing class first; a suffix match across other classes is
+        used only when every candidate agrees (two classes sharing an
+        attr name for different lock classes stay unresolved rather
+        than guessed wrong). Bare names try the enclosing function's
+        scoped entry first, then module level."""
+        if isinstance(expr, ast.Call):
+            n = _tail_name(expr.func)
+            if n is not None:
+                for key, cls_ in self.lock_vars.items():
+                    if key.endswith(f".{n}()") or key == f"{n}()":
+                        return cls_
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if cls is not None:
+                hit = self.lock_vars.get(f"{cls}.{attr}")
+                if hit is not None:
+                    return hit
+            cands = {
+                c for key, c in self.lock_vars.items()
+                if key.endswith(f".{attr}") or key == attr
+            }
+            if len(cands) == 1:
+                return cands.pop()
+            return None
+        if isinstance(expr, ast.Name):
+            if fn is not None:
+                hit = self.lock_vars.get(f"{fn}:{expr.id}")
+                if hit is not None:
+                    return hit
+            return self.lock_vars.get(expr.id)
+        return None
+
+
+def _marker_near(lines: List[str], lineno: int, with_lineno: int,
+                 marker: str) -> bool:
+    """Marker on the call line, in the contiguous comment block
+    directly above it, on the with-header line, or in the contiguous
+    comment block directly above the with header."""
+
+    def hit(ln: int) -> bool:
+        return 1 <= ln <= len(lines) and marker in lines[ln - 1]
+
+    def comment_block_above(ln: int) -> bool:
+        ln -= 1
+        while 1 <= ln <= len(lines) and (
+            lines[ln - 1].lstrip().startswith("#") or not lines[ln - 1].strip()
+        ):
+            if marker in lines[ln - 1]:
+                return True
+            ln -= 1
+        return False
+
+    return (
+        hit(lineno) or comment_block_above(lineno)
+        or hit(with_lineno) or comment_block_above(with_lineno)
+    )
+
+
+def check(root: str, write_doc: bool = False):
+    lock_classes, thread_prefixes = load_racecheck(root)
+    violations: List[Tuple[str, int, str]] = []
+    lints: Dict[str, _FileLint] = {}
+
+    for path in iter_py(root):
+        rel = os.path.relpath(path, root)
+        if rel in EXEMPT:
+            continue
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            violations.append((rel, e.lineno or 0, f"unparseable: {e}"))
+            continue
+        fl = _FileLint(rel, text)
+        fl.visit(tree)
+        fl.finalize()
+        lints[rel] = fl
+
+    # -- rule 1: no raw locks, declared classes only --------------------
+    constructed: Dict[str, Tuple[str, int]] = {}
+    for rel, fl in sorted(lints.items()):
+        for lineno, kind in fl.raw_locks:
+            violations.append(
+                (rel, lineno,
+                 f"raw {kind}() construction — use racecheck."
+                 "make_lock/make_rlock/make_condition with a class "
+                 "declared in LOCK_CLASSES (utils/racecheck.py)")
+            )
+        for lineno, maker, name in fl.makes:
+            if name is None:
+                violations.append(
+                    (rel, lineno,
+                     f"{maker}() with a non-literal lock class — the "
+                     "class name must be a string literal declared in "
+                     "LOCK_CLASSES")
+                )
+            else:
+                constructed.setdefault(name, (rel, lineno))
+                if name not in lock_classes:
+                    violations.append(
+                        (rel, lineno,
+                         f"{maker}({name!r}): lock class is not "
+                         "declared in LOCK_CLASSES "
+                         "(utils/racecheck.py)")
+                    )
+    for name in sorted(lock_classes):
+        if name not in constructed:
+            violations.append(
+                (os.path.join("tidb_tpu", "utils", "racecheck.py"), 0,
+                 f"declared lock class {name!r} has no make_* "
+                 "construction site (dead declaration)")
+            )
+
+    # -- rule 2: no blocking under lock ---------------------------------
+    for rel, fl in sorted(lints.items()):
+        for scope in fl.scopes:
+            ctx_keys = {_expr_key(e) for e in scope["locks"]}
+            for lineno, recv, name, call in scope["calls"]:
+                hit = BLOCKING.get(name)
+                if hit is None:
+                    continue
+                recv_pat, why = hit
+                recv_name = _tail_name(recv) if recv is not None else None
+                if recv_pat is not None and (
+                    recv_name is None or not recv_pat.search(recv_name)
+                ):
+                    continue
+                # the cv idiom: with self._cv: self._cv.wait() releases
+                # the SAME lock while waiting — not blocking-under-lock
+                if name in ("wait", "wait_for") and recv is not None \
+                        and _expr_key(recv) in ctx_keys:
+                    continue
+                if _marker_near(fl.lines, lineno, scope["lineno"],
+                                MARKER_BLOCKING):
+                    continue
+                violations.append(
+                    (rel, lineno,
+                     f"blocking call {name}() under lock "
+                     f"{[_expr_key(e) for e in scope['locks']]} in "
+                     f"{scope['qual']}: {why} — justify with a "
+                     f"'{MARKER_BLOCKING}' marker or move it out of "
+                     "the lock scope")
+                )
+
+    # -- rule 3: static lock-order graph --------------------------------
+    edges: Dict[str, Set[str]] = {}
+    origins: Dict[Tuple[str, str], str] = {}
+    # qualified 'Class.method' -> acquired classes, across all files
+    # (for attribute calls); resolution is deliberately conservative —
+    # a FALSE edge could fail the lint on a cycle that cannot happen
+    qualified_acquires: Dict[str, List[Set[str]]] = {}
+    for rel, fl in lints.items():
+        for qual, classes in fl.fn_acquires.items():
+            if "." in qual:
+                # EVERY defined method counts, acquiring or not: a
+                # same-named method that acquires nothing makes the
+                # name ambiguous (stream.complete() must not inherit
+                # FragmentLedger.complete's lock)
+                qualified_acquires.setdefault(
+                    qual.split(".")[-1], []
+                ).append(set(classes))
+
+    def add_edge(a: str, b: str, where: str):
+        if a == b:
+            return
+        edges.setdefault(a, set()).add(b)
+        origins.setdefault((a, b), where)
+
+    for rel, fl in sorted(lints.items()):
+        for scope in fl.scopes:
+            held = fl._classes_of(
+                scope["locks"], cls=scope["cls"], fn=scope["qual"]
+            )
+            if not held:
+                continue
+            ctx_keys = {_expr_key(e) for e in scope["locks"]}
+            for lineno, expr in scope["withs"]:
+                inner = fl.resolve_lock_class(
+                    expr, cls=scope["cls"], fn=scope["qual"]
+                )
+                if inner is None:
+                    continue
+                for h in held:
+                    add_edge(h, inner, f"{rel}:{lineno}")
+            # one level of interprocedural calls: a call under the lock
+            # to a function that itself acquires adds those edges.
+            # Resolution: self.m() -> this class's m; bare f() -> this
+            # module's f; obj.m() -> only when every Class.m in the
+            # repo acquires the SAME class set (e.g. .inc()/.observe()
+            # all mean metrics.metric) — ambiguity is skipped, not
+            # guessed.
+            for lineno, recv, name, call in scope["calls"]:
+                acq: Optional[Set[str]] = None
+                if recv is None:
+                    acq = fl.fn_acquires.get(name)
+                elif isinstance(recv, ast.Name) and recv.id == "self" \
+                        and scope["cls"]:
+                    acq = fl.fn_acquires.get(f"{scope['cls']}.{name}")
+                else:
+                    # the cv idiom: waiting on the with-context object
+                    # releases the lock — not an acquisition of another
+                    if name in ("wait", "wait_for") \
+                            and _expr_key(recv) in ctx_keys:
+                        continue
+                    cands = qualified_acquires.get(name) or []
+                    if cands and cands[0] and all(
+                        c == cands[0] for c in cands
+                    ):
+                        acq = cands[0]
+                if not acq:
+                    continue
+                for h in held:
+                    for b in acq:
+                        add_edge(h, b, f"{rel}:{lineno}")
+
+    for a, b, where in DEEP_EDGES:
+        # each entry cites the file whose call path creates the edge;
+        # a tree without that file (lint fixtures) isn't making the
+        # claim, so the entry neither applies nor is validated there
+        if not os.path.exists(os.path.join(root, where.split(":")[0])):
+            continue
+        for n in (a, b):
+            if n not in lock_classes:
+                violations.append(
+                    (os.path.join("scripts", "check_concurrency.py"), 0,
+                     f"DEEP_EDGES names undeclared lock class {n!r}")
+                )
+        add_edge(a, b, where)
+
+    cycle = _find_cycle(edges)
+    if cycle is not None:
+        path = " -> ".join(cycle)
+        locs = ", ".join(
+            f"{a}->{b} at {origins.get((a, b), '?')}"
+            for a, b in zip(cycle, cycle[1:])
+        )
+        violations.append(
+            ("(lock-order graph)", 0,
+             f"static lock-order cycle: {path} ({locs}) — interleaving "
+             "threads deadlock on this cycle; establish one order")
+        )
+
+    # -- doc emission / drift check -------------------------------------
+    doc = _render_doc(lock_classes, edges, origins)
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            rd = f.read()
+        if DOC_START in rd and DOC_END in rd:
+            current = rd.split(DOC_START)[1].split(DOC_END)[0]
+            if write_doc:
+                if current.strip() != doc.strip():
+                    new = (
+                        rd.split(DOC_START)[0] + DOC_START + "\n"
+                        + doc + "\n" + DOC_END
+                        + rd.split(DOC_END, 1)[1]
+                    )
+                    with open(readme, "w", encoding="utf-8") as f:
+                        f.write(new)
+            elif current.strip() != doc.strip():
+                violations.append(
+                    ("README.md", 0,
+                     "lock-hierarchy doc section is stale — regenerate "
+                     "with `python scripts/check_concurrency.py "
+                     "--write-doc`")
+                )
+
+    # -- rule 4: thread hygiene -----------------------------------------
+    for rel, fl in sorted(lints.items()):
+        for lineno, call in fl.threads:
+            kwargs = {
+                kw.arg: kw.value for kw in call.keywords
+                if kw.arg is not None
+            }
+            d = kwargs.get("daemon")
+            daemon_true = isinstance(d, ast.Constant) and d.value is True
+            if not daemon_true and not _marker_near(
+                fl.lines, lineno, lineno, MARKER_THREAD
+            ):
+                violations.append(
+                    (rel, lineno,
+                     "threading.Thread without daemon=True — a "
+                     "non-daemon engine thread blocks interpreter "
+                     f"exit; mark deliberate ones '{MARKER_THREAD}'")
+                )
+            name_kw = kwargs.get("name")
+            prefix = _literal_prefix(name_kw)
+            if prefix is None:
+                violations.append(
+                    (rel, lineno,
+                     "threading.Thread without a literal name= — name "
+                     "threads '<prefix>-...' with a prefix declared in "
+                     "racecheck.THREAD_NAME_PREFIXES so /links and the "
+                     "flight recorder can attribute them")
+                )
+            else:
+                fam = prefix.split("-", 1)[0]
+                if fam not in thread_prefixes:
+                    violations.append(
+                        (rel, lineno,
+                         f"thread name prefix {fam!r} (from {prefix!r})"
+                         " is not declared in "
+                         "racecheck.THREAD_NAME_PREFIXES")
+                    )
+    return violations
+
+
+def _literal_prefix(node) -> Optional[str]:
+    """Leading literal text of a thread-name expression: 'x' -> 'x',
+    f"shuffle-tx-{addr}" -> 'shuffle-tx-', anything else -> None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(
+            first.value, str
+        ):
+            return first.value
+    return None
+
+
+def _find_cycle(edges: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """First cycle in the class graph as [a, b, ..., a], or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(edges) | {
+        v for vs in edges.values() for v in vs
+    }}
+    stack: List[str] = []
+
+    def dfs(n) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(edges.get(n, ())):
+            if color[m] == GRAY:
+                i = stack.index(m)
+                return stack[i:] + [m]
+            if color[m] == WHITE:
+                got = dfs(m)
+                if got is not None:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None
+
+
+def _render_doc(lock_classes: Dict[str, str], edges: Dict[str, Set[str]],
+                origins: Dict[Tuple[str, str], str]) -> str:
+    """The reviewable partial order: every declared class with its
+    guard note, then the statically-observed before->after edges."""
+    out = [
+        "",
+        "Declared lock classes (utils/racecheck.py LOCK_CLASSES; "
+        "generated — edit the registry, not this block):",
+        "",
+    ]
+    for name in sorted(lock_classes):
+        out.append(f"- `{name}` — {lock_classes[name]}")
+    out.append("")
+    out.append(
+        "Statically-observed acquisition order (`held` → `then "
+        "acquired`; the graph is verified acyclic):"
+    )
+    out.append("")
+    if not edges:
+        out.append("- (no nested acquisitions observed)")
+    for a in sorted(edges):
+        for b in sorted(edges[a]):
+            # file-only origin: line numbers would go stale on every
+            # unrelated edit (the lint's own error output keeps them)
+            where = origins.get((a, b), "?").rsplit(":", 1)[0]
+            out.append(f"- `{a}` → `{b}` ({where})")
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    write_doc = "--write-doc" in argv
+    argv = [a for a in argv if a != "--write-doc"]
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root, write_doc=write_doc)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} concurrency violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Unified lint runner: discover and run every scripts/check_*.py.
+
+The house lints are standalone `check_<name>.py` scripts that take an
+optional repo root argv and exit 0/1 (check_failpoints,
+check_metric_names, check_flight_phases, check_shuffle_hotpath,
+check_backend_gates, check_concurrency, ...). This runner is the one
+entry point CI and tests/test_lints.py need: a NEW lint dropped into
+scripts/ is discovered and enforced with no new wiring or test file.
+
+Usage:
+  python scripts/lint_all.py [root]   # run all, stop at first failure
+  python scripts/lint_all.py --list   # enumerate discovered lints
+Exit 0 = all clean, non-zero = the first failing lint's exit code.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List
+
+
+def discover(scripts_dir: str) -> List[str]:
+    return sorted(
+        fn for fn in os.listdir(scripts_dir)
+        if fn.startswith("check_") and fn.endswith(".py")
+    )
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    lints = discover(scripts_dir)
+    if "--list" in argv:
+        for fn in lints:
+            print(fn)
+        return 0
+    root = next(
+        (a for a in argv if not a.startswith("-")),
+        os.path.dirname(scripts_dir),
+    )
+    for fn in lints:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(scripts_dir, fn), root],
+            capture_output=True, text=True, timeout=300,
+        )
+        status = "ok" if proc.returncode == 0 else "FAIL"
+        print(f"[{status}] {fn}")
+        if proc.returncode != 0:
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+            return proc.returncode
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,603 @@
+"""Tier-1 gate for the unified lint runner (scripts/lint_all.py) and
+the concurrency lint's four rules (scripts/check_concurrency.py).
+
+One test file guards EVERY discovered scripts/check_*.py — a future
+lint dropped into scripts/ is enforced here with no new test file.
+Each concurrency rule additionally proves it rejects a seeded
+violation (fixture trees through the checker, the test_flight_phases
+pattern) and that its marker/idiom escapes work.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "scripts", "lint_all.py")
+LINT = os.path.join(REPO, "scripts", "check_concurrency.py")
+
+
+def run_lint(root):
+    return subprocess.run(
+        [sys.executable, LINT, str(root)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_all_lints_clean_at_head():
+    proc = subprocess.run(
+        [sys.executable, RUNNER, REPO], capture_output=True, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"lint_all failures:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_runner_lists_every_check_script():
+    proc = subprocess.run(
+        [sys.executable, RUNNER, "--list"], capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    listed = set(proc.stdout.split())
+    on_disk = {
+        fn for fn in os.listdir(os.path.join(REPO, "scripts"))
+        if fn.startswith("check_") and fn.endswith(".py")
+    }
+    assert listed == on_disk
+    assert "check_concurrency.py" in listed
+
+
+def test_runner_fails_on_first_failure(tmp_path):
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_aaa.py").write_text("import sys; sys.exit(0)\n")
+    (scripts / "check_bbb.py").write_text(
+        "print('seeded violation'); import sys; sys.exit(1)\n"
+    )
+    (scripts / "check_ccc.py").write_text("import sys; sys.exit(0)\n")
+    (scripts / "lint_all.py").write_text(
+        open(RUNNER, encoding="utf-8").read()
+    )
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "lint_all.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "[FAIL] check_bbb.py" in proc.stdout
+    assert "seeded violation" in proc.stdout
+    # stopped at the first failure: ccc never ran
+    assert "check_ccc" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# concurrency lint fixtures
+# ---------------------------------------------------------------------------
+
+_RACECHECK_STUB = textwrap.dedent(
+    '''
+    LOCK_CLASSES = {
+        "a": "fixture class a",
+        "b": "fixture class b",
+    }
+    THREAD_NAME_PREFIXES = frozenset({"good"})
+
+    def make_lock(name):
+        pass
+
+    def make_rlock(name):
+        pass
+
+    def make_condition(name):
+        pass
+    '''
+)
+
+
+def make_tree(tmp_path, engine_source, racecheck_src=_RACECHECK_STUB):
+    utils = tmp_path / "tidb_tpu" / "utils"
+    utils.mkdir(parents=True)
+    (utils / "racecheck.py").write_text(racecheck_src)
+    (tmp_path / "tidb_tpu" / "engine.py").write_text(
+        textwrap.dedent(engine_source)
+    )
+    return tmp_path
+
+
+def test_rule1_raw_lock_and_undeclared_class_rejected(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        import threading
+        from tidb_tpu.utils.racecheck import make_lock
+
+        raw = threading.Lock()
+        raw_cv = threading.Condition()
+        ok = make_lock("a")
+        ok2 = make_lock("b")
+        typo = make_lock("not-declared")
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "raw threading.Lock() construction" in proc.stdout
+    assert "raw threading.Condition() construction" in proc.stdout
+    assert "'not-declared'" in proc.stdout
+    # declared + constructed classes are clean
+    assert "make_lock('a')" not in proc.stdout
+
+
+def test_rule1_dead_declaration_and_nonliteral_rejected(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        name = "a"
+        lk = make_lock(name)
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "non-literal lock class" in proc.stdout
+    # neither "a" nor "b" has a literal construction site
+    assert "dead declaration" in proc.stdout
+    assert "'b'" in proc.stdout
+
+
+def test_rule2_blocking_under_lock_needs_marker(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        import time
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("a")
+                self._other = make_lock("b")
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def justified(self):
+                with self._other:
+                    # lock-blocking-ok: fixture justification
+                    time.sleep(1)
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "blocking call sleep() under lock" in proc.stdout
+    assert "S.bad" in proc.stdout
+    assert "S.justified" not in proc.stdout  # marker escape honored
+
+
+def test_rule2_same_object_cv_wait_is_the_idiom(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_condition
+
+        class S:
+            def __init__(self):
+                self._cv = make_condition("a")
+                self._other_cv = make_condition("b")
+
+            def fine(self):
+                with self._cv:
+                    self._cv.wait(0.1)
+
+            def bad(self):
+                with self._cv:
+                    self._other_cv.wait(0.1)
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert "S.fine" not in out     # waiting on the held cv is allowed
+    assert "wait() under lock" in out and "S.bad" in out
+
+
+def test_rule3_static_cycle_detected(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class S:
+            def __init__(self):
+                self._a_lock = make_lock("a")
+                self._b_lock = make_lock("b")
+
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def reversed_(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "static lock-order cycle" in proc.stdout
+    assert "a -> b" in proc.stdout or "b -> a" in proc.stdout
+
+
+def test_rule3_consistent_order_is_clean(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class S:
+            def __init__(self):
+                self._a_lock = make_lock("a")
+                self._b_lock = make_lock("b")
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_rule3_one_level_interprocedural_cycle(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        a_lock = make_lock("a")
+        b_lock = make_lock("b")
+
+        def inner():
+            with a_lock:
+                pass
+
+        def outer():
+            with b_lock:
+                inner()
+
+        def forward():
+            with a_lock:
+                with b_lock:
+                    pass
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "static lock-order cycle" in proc.stdout
+
+
+def test_rule4_thread_hygiene(tmp_path):
+    make_tree(
+        tmp_path,
+        '''
+        import threading
+
+        from tidb_tpu.utils.racecheck import make_lock
+
+        _ = make_lock("a")
+        __ = make_lock("b")
+
+        t1 = threading.Thread(target=print)  # no daemon, no name
+        t2 = threading.Thread(
+            target=print, daemon=True, name="rogue-worker"
+        )
+        t3 = threading.Thread(
+            target=print, daemon=True, name="good-worker"
+        )
+        t4 = threading.Thread(  # thread-non-daemon-ok
+            target=print, daemon=False, name="good-flusher"
+        )
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert "without daemon=True" in out
+    assert "without a literal name=" in out
+    assert "'rogue'" in out           # undeclared prefix
+    assert "good-worker" not in out   # declared prefix is clean
+    # exactly ONE daemon violation (t1): t4's marker escape honored
+    assert out.count("without daemon=True") == 1
+
+
+def test_rule2_acquire_release_span_is_a_lock_scope(tmp_path):
+    """Explicit acquire()/release() spans get the same rule-2
+    treatment as `with` scopes — the lint's coverage claim, not just
+    the common idiom."""
+    make_tree(
+        tmp_path,
+        '''
+        import time
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("a")
+                self._other = make_lock("b")
+
+            def bad(self):
+                self._lock.acquire()
+                time.sleep(1)
+                self._lock.release()
+
+            def fine(self):
+                self._other.acquire()
+                x = 1 + 1
+                self._other.release()
+                time.sleep(x)  # after release: not under the lock
+
+            def branchy(self):
+                if True:
+                    self._lock.acquire()
+                    time.sleep(2)
+                else:
+                    self._lock.acquire()
+                self._lock.release()
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert "blocking call sleep() under lock" in out and "S.bad" in out
+    assert "S.fine" not in out
+    # a re-acquire in another branch must not drop the first span's
+    # recorded calls (span overwrite false negative)
+    assert "S.branchy" in out
+
+
+def test_rule4_thread_subclass_super_init_covered(tmp_path):
+    """A `class X(threading.Thread)` defines its name/daemon in
+    super().__init__ — rule 4 must see that call, or subclasses escape
+    the hygiene contract (the InstanceWatchdog pattern)."""
+    make_tree(
+        tmp_path,
+        '''
+        import threading
+
+        from tidb_tpu.utils.racecheck import make_lock
+
+        _ = make_lock("a")
+        __ = make_lock("b")
+
+        class Rogue(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True, name="rogue-sub")
+
+        class Fine(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True, name="good-sub")
+
+        class NotAThread:
+            def __init__(self):
+                super().__init__()
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert "'rogue'" in out           # subclass kwargs are checked
+    assert "good-sub" not in out      # compliant subclass is clean
+    # plain super().__init__ outside a Thread subclass is ignored
+    assert "NotAThread" not in out and out.count("name=") == 0
+
+
+def test_head_has_no_raw_locks_outside_racecheck():
+    """The acceptance bar, asserted directly: zero raw threading
+    lock constructions under tidb_tpu/ outside utils/racecheck.py."""
+    import re
+
+    pat = re.compile(r"threading\.(Lock|RLock|Condition)\(")
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(
+        os.path.join(REPO, "tidb_tpu")
+    ):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.endswith(os.path.join("utils", "racecheck.py")):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if pat.search(line) and "make_" not in line:
+                        offenders.append(f"{path}:{i}")
+    assert not offenders, offenders
+
+
+def test_rule3_sees_method_defined_above_init(tmp_path):
+    """fn_acquires must resolve AFTER the full file visit: a method
+    using `with self._lock:` textually above the __init__ that
+    constructs the lock still contributes its interprocedural edge
+    (eager resolution dropped it, letting this cycle pass clean)."""
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class Engine:
+            def _bump(self):  # defined ABOVE __init__
+                with self._a_lock:
+                    pass
+
+            def __init__(self):
+                self._a_lock = make_lock("a")
+                self._b_lock = make_lock("b")
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def rev(self):
+                with self._b_lock:
+                    self._bump()
+        ''',
+    )
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "static lock-order cycle" in proc.stdout
+
+
+def _load_checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_cc_test", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rule3_deep_edges_participate_in_cycle_check(tmp_path):
+    """A declared DEEP_EDGES entry (an edge below the one-level
+    interprocedural horizon) completes cycles the scope pass alone
+    cannot see, and undeclared endpoints are themselves violations."""
+    make_tree(
+        tmp_path,
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        class Engine:
+            def __init__(self):
+                self._a_lock = make_lock("a")
+                self._b_lock = make_lock("b")
+
+            def fwd(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        ''',
+    )
+    mod = _load_checker()
+    mod.DEEP_EDGES = [("a", "b", "tidb_tpu/engine.py")]
+    msgs = [m for _, _, m in mod.check(str(tmp_path))]
+    assert any("static lock-order cycle" in m for m in msgs), msgs
+
+    mod.DEEP_EDGES = [("a", "nope", "tidb_tpu/engine.py")]
+    msgs = [m for _, _, m in mod.check(str(tmp_path))]
+    assert any("undeclared lock class 'nope'" in m for m in msgs), msgs
+
+    # an entry citing a file absent from the tree neither applies nor
+    # fails validation (lint fixture trees)
+    mod.DEEP_EDGES = [("a", "nope", "tidb_tpu/not_there.py")]
+    msgs = [m for _, _, m in mod.check(str(tmp_path))]
+    assert not any("undeclared" in m for m in msgs), msgs
+
+
+def test_rule3_bare_local_lock_names_are_function_scoped(tmp_path):
+    """The same bare local name bound to DIFFERENT classes in two
+    functions must not share one file-global lock_vars entry — that
+    fabricated edges (failing the lint on a runtime-impossible cycle)
+    and dropped the first function's real edges."""
+    stub = textwrap.dedent(
+        '''
+        LOCK_CLASSES = {"a": "x", "b": "y", "c": "z"}
+        THREAD_NAME_PREFIXES = frozenset({"good"})
+
+        def make_lock(name):
+            pass
+
+        def make_rlock(name):
+            pass
+
+        def make_condition(name):
+            pass
+        '''
+    )
+    clean = make_tree(
+        tmp_path / "clean",
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        C_LOCK = make_lock("c")
+
+        def f():
+            lk = make_lock("a")
+            with lk:          # a -> c
+                with C_LOCK:
+                    pass
+
+        def g():
+            lk = make_lock("b")
+            with C_LOCK:      # c -> b: no cycle unless f's lk
+                with lk:      # is mislabeled as class b
+                    pass
+        ''',
+        racecheck_src=stub,
+    )
+    proc = run_lint(clean)
+    assert proc.returncode == 0, proc.stdout
+
+    # a REAL inversion through bare locals is still caught
+    stub2 = stub.replace('"b": "y", ', "")
+    bad = make_tree(
+        tmp_path / "bad",
+        '''
+        from tidb_tpu.utils.racecheck import make_lock
+
+        C_LOCK = make_lock("c")
+
+        def f():
+            lk = make_lock("a")
+            with lk:
+                with C_LOCK:
+                    pass
+
+        def g():
+            other_lk = make_lock("a")
+            with C_LOCK:
+                with other_lk:
+                    pass
+        ''',
+        racecheck_src=stub2,
+    )
+    proc = run_lint(bad)
+    assert proc.returncode == 1
+    assert "static lock-order cycle" in proc.stdout
+
+
+def test_failpoint_lint_does_not_poison_sys_modules(tmp_path):
+    """check_failpoints.load_sites registers stub tidb_tpu modules to
+    read SITES without importing jax; the stubs must be removed again
+    or an in-process caller's later REAL `import tidb_tpu.x` breaks
+    (a ModuleType without __path__ is not a package)."""
+    code = textwrap.dedent(
+        f'''
+        import importlib.util, sys
+        spec = importlib.util.spec_from_file_location(
+            "_cf", {os.path.join(REPO, "scripts", "check_failpoints.py")!r}
+        )
+        cf = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cf)
+        sites = cf.load_sites({REPO!r})
+        assert sites, "no failpoint sites loaded"
+        assert "tidb_tpu" not in sys.modules, "stub package leaked"
+        assert "tidb_tpu.utils" not in sys.modules, "stub subpackage leaked"
+        sys.path.insert(0, {REPO!r})
+        import tidb_tpu.utils.metrics  # must be importable afterwards
+        '''
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -373,7 +373,9 @@ class TaskExecutor:
             while not hb_stop.wait(HEARTBEAT_TTL_S / 2):
                 self.manager.heartbeat(s["id"])
 
-        hb = threading.Thread(target=beat, daemon=True)
+        hb = threading.Thread(
+            target=beat, daemon=True, name=f"dxf-heartbeat-{s['id']}"
+        )
         hb.start()
         try:
             result = tt["runner"](json.loads(s["meta"]), self.manager.catalog)

@@ -29,6 +29,7 @@ import itertools
 import threading
 from typing import List, Optional
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.metrics import REGISTRY
 
 #: plan signatures whose first compile was already seen; a trace for a
@@ -59,7 +60,7 @@ class QueryEngineRecord:
 class EngineWatch:
     def __init__(self, capacity: int = 256):
         self._tls = threading.local()
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("engine_watch")
         self._seen_sigs = set()
         self._recent = collections.deque(maxlen=capacity)
         self._qid = itertools.count(1)

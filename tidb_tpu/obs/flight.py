@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.metrics import REGISTRY
 
 #: every phase a flight may charge time to. parse/plan/compile mirror
@@ -163,7 +164,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 256):
         self._tls = threading.local()
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("flight.ring")
         self._recent = collections.deque(maxlen=capacity)
         self._qid = itertools.count(1)
 
@@ -386,7 +387,7 @@ class LinkRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("flight.links")
         self._control: Dict[str, dict] = {}
         self._tunnels: Dict[tuple, dict] = {}
 

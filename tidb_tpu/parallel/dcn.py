@@ -62,6 +62,7 @@ from tidb_tpu.server.engine_pool import (
     ping_endpoint,
 )
 from tidb_tpu.server.engine_rpc import EngineClient, SchemaOutOfDateError
+from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.failpoint import inject
 from tidb_tpu.utils.metrics import REGISTRY, merge_counter_delta
 from tidb_tpu.utils.tracing import Tracer
@@ -226,7 +227,7 @@ class FragmentLedger:
     redelivery) is counted and dropped."""
 
     def __init__(self, n_fragments: int):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("dcn.ledger")
         self._recs = {
             fid: {"state": "pending", "owner": None, "attempts": 0,
                   "rows": None}
@@ -388,7 +389,7 @@ class DCNFragmentScheduler:
         #: {"qid", "fragments": [{fid, host, attempt, rows, exec_s,
         #:  bytes, spans}]}
         self.last_query: Optional[dict] = None
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("dcn.scheduler")
         self._conns: Dict[EngineEndpoint, EngineClient] = {}
         #: per-host clock offset (host wall clock minus coordinator
         #: wall clock), sampled on each connection's handshake — worker
@@ -420,7 +421,9 @@ class DCNFragmentScheduler:
         with self._lock:
             lk = self._conn_locks.get(ep)
             if lk is None:
-                lk = self._conn_locks[ep] = threading.Lock()
+                lk = self._conn_locks[ep] = racecheck.make_lock(
+                    "dcn.conn"
+                )
             return lk
 
     def _conn(self, ep: EngineEndpoint) -> EngineClient:
@@ -464,6 +467,12 @@ class DCNFragmentScheduler:
         _c_dispatches().labels(host=ep.address).inc()
         if inject("dcn/dispatch-lost"):
             raise ConnectionError("failpoint: dispatch lost in transit")
+        # lock-blocking-ok: the per-connection lock EXISTS to hold
+        # across the RPC round trip — EngineClient's socket protocol is
+        # a strict request/response stream. Lock order: a fresh
+        # connection's handshake note in _conn() acquires flight.links
+        # under this lock, declared as dcn.conn -> flight.links in
+        # check_concurrency.DEEP_EDGES
         with self._ep_lock(ep):
             conn = self._conn(ep)
             try:
@@ -697,6 +706,8 @@ class DCNFragmentScheduler:
                     "trace": bool(self.tracer.enabled),
                 }
                 try:
+                    # lock-blocking-ok: per-connection stream lock —
+                    # held across the RPC by design (see _dispatch)
                     with self._ep_lock(ep):
                         conn = self._conn(ep)
                         resp = conn.call(
@@ -1005,6 +1016,8 @@ class DCNFragmentScheduler:
                 _c_dispatches().labels(host=ep.address).inc()
                 if inject("dcn/dispatch-lost"):
                     raise ConnectionError("failpoint: dispatch lost in transit")
+                # lock-blocking-ok: per-connection stream lock — held
+                # across the RPC by design (see _dispatch)
                 with self._ep_lock(ep):
                     conn = self._conn(ep)
                     return conn.execute_plan(plan)

@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.failpoint import inject
 from tidb_tpu.utils.metrics import REGISTRY
 
@@ -319,7 +320,7 @@ class ShuffleStore:
     """
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = racecheck.make_condition("shuffle.store")
         self._stages: "collections.OrderedDict[str, _Stage]" = (
             collections.OrderedDict()
         )
@@ -635,7 +636,7 @@ class PeerTunnel:
         #: information_schema.cluster_links reads this per link)
         self.stall_s = 0.0
         self.retransmits = 0
-        self._cv = threading.Condition()
+        self._cv = racecheck.make_condition("shuffle.tunnel")
         self._q: "collections.deque" = collections.deque()
         self._inflight = 0
         self._dead: Optional[Exception] = None
@@ -643,7 +644,7 @@ class PeerTunnel:
         self._closing = False
         self._client = None
         self._codec: Optional[str] = None
-        self._neg_lock = threading.Lock()
+        self._neg_lock = racecheck.make_lock("shuffle.negotiate")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"shuffle-tx-{self.address}"
         )
@@ -665,6 +666,10 @@ class PeerTunnel:
                 from tidb_tpu.server.engine_rpc import EngineClient
 
                 try:
+                    # lock-blocking-ok: the one-shot negotiation probe
+                    # deliberately holds the per-tunnel lock across its
+                    # throwaway handshake so racing producers get ONE
+                    # answer; the lock is tunnel-private and leaf-level
                     c = EngineClient(
                         self.host, self.port, secret=self.secret,
                         timeout_s=min(self.timeout_s, 10.0),
@@ -1146,7 +1151,7 @@ class ShuffleWorker:
         # per (plan, slice) instead of once per dispatch; their plan
         # caches are not thread-safe, so executor phases serialize on
         # this lock (tunnel pushes and the store wait still overlap)
-        self._exec_lock = threading.RLock()
+        self._exec_lock = racecheck.make_rlock("shuffle.exec")
         self._producer_exec = None
         self._consumer_exec = None
 
@@ -1208,7 +1213,7 @@ class ShuffleWorker:
                 )
             producer_exec = self._producer_exec
         tunnels: Dict[int, PeerTunnel] = {}
-        tlock = threading.Lock()  # tunnel creation + stats merge
+        tlock = racecheck.make_lock("shuffle.tunnels")  # create + stats
         stats = {
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
             "stalls": 0, "stall_s": 0.0, "retransmits": 0,

@@ -32,6 +32,7 @@ from tidb_tpu.server.engine_rpc import (
     EngineClient,
     SchemaOutOfDateError,
 )
+from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.failpoint import inject
 
 
@@ -100,7 +101,7 @@ class FailedEngineProber:
         self.initial_backoff_s = initial_backoff_s
         self.max_backoff_s = max_backoff_s
         self.probe_timeout_s = probe_timeout_s
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("engine_pool.prober")
         self._failed: List[EngineEndpoint] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -197,7 +198,7 @@ class PooledEngineClient:
         self.prober = prober or FailedEngineProber()
         self.max_retry = max_retry
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("engine_pool.pool")
         self._conns = {}  # endpoint -> EngineClient
         # one mutex per endpoint: EngineClient's socket protocol is a
         # strict request/response stream — two threads interleaving
@@ -220,7 +221,9 @@ class PooledEngineClient:
         with self._lock:
             lk = self._conn_locks.get(ep)
             if lk is None:
-                lk = self._conn_locks[ep] = threading.Lock()
+                lk = self._conn_locks[ep] = racecheck.make_lock(
+                    "engine_pool.conn"
+                )
             return lk
 
     def _conn(self, ep: EngineEndpoint) -> EngineClient:
@@ -244,6 +247,9 @@ class PooledEngineClient:
                 break
             try:
                 inject("engine/dispatch")
+                # lock-blocking-ok: the per-endpoint lock EXISTS to
+                # hold across the RPC — EngineClient's socket protocol
+                # is a strict request/response stream; leaf-level lock
                 with self._ep_lock(ep):
                     conn = self._conn(ep)
                     return conn.execute_plan(plan, schema_version)

@@ -38,6 +38,7 @@ from typing import List, Optional, Tuple
 
 from tidb_tpu.parallel import wire
 from tidb_tpu.planner.ir import IR_VERSION, plan_from_ir, plan_to_ir
+from tidb_tpu.utils import racecheck
 
 #: hard frame cap — a bogus length header must not buffer gigabytes
 MAX_FRAME = 64 << 20
@@ -113,14 +114,14 @@ class EngineServer:
         # share the coordinator's registry, and shipping would feed the
         # merged increments back into the next delta.
         self.ship_registry = ship_registry
-        self._reg_lock = threading.Lock()
+        self._reg_lock = racecheck.make_lock("engine_rpc.registry")
         self._reg_snapshot: dict = {}
         # worker-to-worker shuffle service: the store this server's
         # shuffle_push frames land in plus the task runner
         # (parallel/shuffle.py); built lazily so plain engine servers
         # pay nothing
         self._shuffle = None
-        self._shuffle_lock = threading.Lock()
+        self._shuffle_lock = racecheck.make_lock("engine_rpc.shuffle_init")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -510,7 +511,10 @@ class EngineServer:
         return delta
 
     def start_background(self) -> threading.Thread:
-        th = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+        th = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name=f"engine-rpc-{self.port}",
+        )
         th.start()
         return th
 

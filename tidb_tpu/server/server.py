@@ -17,6 +17,7 @@ from typing import Optional
 from tidb_tpu.server import protocol as P
 from tidb_tpu.session import Result, Session
 from tidb_tpu.storage import Catalog
+from tidb_tpu.utils import racecheck
 
 COM_QUIT = 0x01
 COM_INIT_DB = 0x02
@@ -44,7 +45,7 @@ class Server:
         self.port = port
         self._next_conn_id = [0]
         self._active_conns = 0
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("server.conns")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -82,7 +83,10 @@ class Server:
         self._tcp.serve_forever()
 
     def start_background(self) -> threading.Thread:
-        th = threading.Thread(target=self.serve_forever, daemon=True)
+        th = threading.Thread(
+            target=self.serve_forever, daemon=True,
+            name=f"mysql-serve-{self.port}",
+        )
         th.start()
         return th
 

@@ -1660,15 +1660,18 @@ class Session(DDLMixin):
         over the catalog (reference: builtin_miscellaneous.go over the
         advisory-lock table; locks are re-entrant per session and die
         with it). Returns the MySQL int/NULL result."""
-        import threading
         import time as _time
+
+        from tidb_tpu.utils import racecheck
 
         op = e.op.lower()
         base = getattr(self.catalog, "_base", self.catalog)
         reg = getattr(base, "_user_locks", None)
         if reg is None:
             reg = base._user_locks = {}  # name -> [conn_id, count]
-            base._user_locks_cv = threading.Condition()
+            base._user_locks_cv = racecheck.make_condition(
+                "session.user_locks"
+            )
         cv = base._user_locks_cv
 
         def argval(i):

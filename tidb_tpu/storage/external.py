@@ -16,9 +16,9 @@ from __future__ import annotations
 
 import io
 import os
-import threading
 from typing import Dict, List, Optional
 
+from tidb_tpu.utils import racecheck
 
 class ExternalStorage:
     """Flat object namespace: names are /-separated keys."""
@@ -98,7 +98,7 @@ class LocalStorage(ExternalStorage):
 
 
 _MEM_BUCKETS: Dict[str, Dict[str, bytes]] = {}
-_MEM_LOCK = threading.Lock()
+_MEM_LOCK = racecheck.make_lock("storage.external")
 
 
 class MemStorage(ExternalStorage):

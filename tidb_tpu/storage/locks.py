@@ -17,9 +17,10 @@ CheckDeadlock).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional, Set, Tuple
+
+from tidb_tpu.utils import racecheck
 
 LockKey = Tuple[str, str]  # (db, table)
 
@@ -48,7 +49,7 @@ class LockWaitTimeout(RuntimeError):
 
 class LockManager:
     def __init__(self) -> None:
-        self._mu = threading.Condition(threading.Lock())
+        self._mu = racecheck.make_condition("storage.txn_wait")
         # key -> owning txn id
         self._owners: Dict[LockKey, int] = {}
         # txn id -> keys it holds
@@ -119,7 +120,7 @@ class LockManager:
             return set(self._held.get(txn_id, ()))
 
 
-_txn_id_lock = threading.Lock()
+_txn_id_lock = racecheck.make_lock("storage.txn_id")
 _txn_id_next = [1]
 
 

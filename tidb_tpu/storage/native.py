@@ -10,8 +10,9 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from tidb_tpu.utils import racecheck
 
 import numpy as np
 
@@ -21,7 +22,7 @@ from tidb_tpu.dtypes import Kind
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "_native.so")
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native", "loader.cpp")
-_lock = threading.Lock()
+_lock = racecheck.make_lock("storage.native")
 _lib = None
 _build_failed = False
 
@@ -47,6 +48,10 @@ def _load() -> Optional[ctypes.CDLL]:
             and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
         ):
             try:
+                # lock-blocking-ok: the lazy one-shot native build
+                # deliberately holds the module lock so racing loaders
+                # compile once; the lock is leaf-level and every later
+                # call takes the fast already-built path
                 subprocess.run(
                     [
                         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",

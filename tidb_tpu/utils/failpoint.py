@@ -10,10 +10,11 @@ returns a value, or calls a hook — the same three actions the reference's
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Optional
 
-_lock = threading.Lock()
+from tidb_tpu.utils import racecheck
+
+_lock = racecheck.make_lock("failpoint.registry")
 _active: Dict[str, object] = {}
 
 #: Every failpoint site the engine defines. A site must be declared here
@@ -186,7 +187,7 @@ def after_n(n: int, action: object):
     syntax (pingcap/failpoint terms.go). One-shot so a retry of the
     failed operation observes a healthy site. Thread-safe."""
     state = {"count": 0}
-    slock = threading.Lock()
+    slock = racecheck.make_lock("failpoint.site")
 
     def fire():
         with slock:

@@ -21,9 +21,10 @@ from __future__ import annotations
 
 import collections
 import re
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.utils import racecheck
 
 
 def _escape_label_value(v: str) -> str:
@@ -55,7 +56,7 @@ class Counter:
         self.name = name
         self.help = help_
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.metric")
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -72,7 +73,7 @@ class Gauge:
         self.name = name
         self.help = help_
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.metric")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -106,7 +107,7 @@ class Histogram:
         self.counts = [0] * (len(self.BUCKETS) + 1)
         self.sum = 0.0
         self.total = 0
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.metric")
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -165,7 +166,7 @@ class MetricFamily:
         self.help = help_
         self.labelnames = tuple(labelnames)
         self._children: Dict[Tuple[str, ...], object] = {}
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.family")
 
     def labels(self, *values, **kv):
         if kv:
@@ -234,7 +235,7 @@ def _render_one(out: List[str], name: str, m, labelnames=(), labelvalues=()):
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.registry")
         self._metrics: Dict[str, object] = {}
 
     def _get(self, cls, name: str, help_: str, labels):
@@ -446,8 +447,8 @@ class SlowLog:
 
     def __init__(self, capacity: int = 256):
         self._buf = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
-        self._file_lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.slowlog")
+        self._file_lock = racecheck.make_lock("metrics.slowlog_file")
 
     def record(
         self,
@@ -559,7 +560,7 @@ class StmtSummary:
     def __init__(self, capacity: int = 512):
         self._capacity = capacity
         self._map: Dict[str, _StmtEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("metrics.stmt_summary")
 
     def record(
         self, sql: str, seconds: float, flight=None,

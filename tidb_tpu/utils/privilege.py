@@ -11,9 +11,9 @@ wire-auth math is the standard mysql_native_password scramble.
 from __future__ import annotations
 
 import hashlib
-import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+from tidb_tpu.utils import racecheck
 #: grantable privileges (subset of the reference's Priv bitmask,
 #: pkg/parser/mysql/privs.go)
 PRIVS = {
@@ -50,7 +50,7 @@ class UserStore:
     connections against it)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("privilege")
         # user -> {"password": sha1sha1 bytes | None, "grants":
         #          {(db|'*', table|'*'): set of privs | {'all'}}}
         self.users: Dict[str, Dict] = {

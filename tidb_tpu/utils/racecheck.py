@@ -18,10 +18,23 @@ raises LockOrderError with both stacks' lock names. The check is by
 lock *class* (the `name` passed at construction), matching how
 deadlock cycles are reasoned about, and the edge graph is global —
 single test runs catch inversions exercised on any thread, the same
-way one `--race` CI run guards the whole repo.
+way one `--race` CI run guards the whole repo. `make_rlock` and
+`make_condition` are the RLock/Condition analogs (same class
+tracking; an RLock may re-enter the same *instance*, a Condition may
+wait on itself while held).
 
 Self-deadlock (re-acquiring the same non-reentrant class in one
 thread) is also reported — under a plain Lock it would hang forever.
+
+``LOCK_CLASSES`` is the DECLARED registry of every lock class in the
+engine (the failpoint-SITES pattern): make_lock/make_rlock/
+make_condition reject undeclared names, and scripts/
+check_concurrency.py statically cross-checks every construction site
+against this registry, bans raw threading.Lock/RLock/Condition
+constructions outside this module, forbids declared-blocking calls
+under a held lock, and proves the static lock-order graph acyclic.
+``THREAD_NAME_PREFIXES`` is the sibling registry for thread names
+(every threading.Thread must carry a declared, attributable name).
 """
 
 from __future__ import annotations
@@ -29,6 +42,81 @@ from __future__ import annotations
 import os
 import threading
 from typing import Dict, List, Set, Tuple
+
+#: Every lock class the engine constructs, name -> what it guards.
+#: Declared here FIRST (like failpoint SITES and metric SUBSYSTEMS),
+#: then constructed via make_lock/make_rlock/make_condition — the
+#: concurrency lint (scripts/check_concurrency.py) cross-checks both
+#: directions and renders the observed partial order into README.md.
+LOCK_CLASSES: Dict[str, str] = {
+    # storage tier
+    "table": "one table's rows/indexes during DML + shadow-commit swap",
+    "catalog": "the shared schema map (create/drop/alter)",
+    "catalog.commit": "whole-catalog commit serialization",
+    "sequence": "sequence allocator state",
+    "cdc.queue": "changefeed event queue + baseline maps",
+    "cdc.advance": "whole-drain serialization per changefeed",
+    "logbackup.queue": "log-backup event queue",
+    "logbackup.advance": "whole-advance serialization per backup task",
+    "storage.external": "process-global in-memory object-store buckets",
+    "storage.native": "lazy build + load of the native .so",
+    "storage.txn_wait": "pessimistic lock-manager wait state (condition)",
+    "storage.txn_id": "global txn id allocator",
+    # dxf / sessions
+    "dxf.manager": "DXF task/subtask tables",
+    "session.user_locks": "GET_LOCK advisory-lock registry (condition)",
+    # server tier
+    "server.conns": "MySQL server connection counter/ids",
+    "engine_rpc.registry": "per-server shipped-registry delta snapshot",
+    "engine_rpc.shuffle_init": "lazy ShuffleWorker construction",
+    "engine_pool.pool": "engine pool rotation + per-endpoint conn map",
+    "engine_pool.prober": "quarantined-endpoint list",
+    "engine_pool.conn": "one engine connection's request/response stream",
+    # MPP tier
+    "dcn.ledger": "exactly-once fragment ledger records",
+    "dcn.scheduler": "scheduler rotation/suspects/last_query telemetry",
+    "dcn.conn": "one coordinator->worker connection's RPC stream",
+    "shuffle.store": "receiver stage/stream buffers (condition)",
+    "shuffle.tunnel": "one peer tunnel's queue + in-flight window "
+                      "(condition)",
+    "shuffle.negotiate": "per-tunnel one-shot codec negotiation",
+    "shuffle.exec": "worker executor plan caches (reentrant)",
+    "shuffle.tunnels": "per-task tunnel map creation + stats merge",
+    # observability tier
+    "metrics.registry": "the metric name -> collector map",
+    "metrics.family": "one labeled family's children map",
+    "metrics.metric": "one counter/gauge/histogram's value cells",
+    "metrics.slowlog": "slow-query ring buffer",
+    "metrics.slowlog_file": "slow-query file sink appends",
+    "metrics.stmt_summary": "per-digest statement aggregates",
+    "engine_watch": "finished engine-watch records ring",
+    "flight.ring": "finished query-flight ring",
+    "flight.links": "per-peer DCN link health maps",
+    # utils
+    "failpoint.registry": "armed failpoint actions",
+    "failpoint.site": "one after_n() site's invocation counter",
+    "resgroup": "resource-group definitions",
+    "privilege": "user + grant store",
+}
+
+#: Declared thread-name families: every threading.Thread in the engine
+#: must be named "<prefix>-..." (or exactly "<prefix>") with prefix
+#: from this set, so /links, the flight recorder and py-spy dumps can
+#: attribute a thread to its subsystem. Enforced by
+#: scripts/check_concurrency.py (thread-hygiene rule).
+THREAD_NAME_PREFIXES = frozenset({
+    "cdc",
+    "dcn",
+    "dxf",
+    "engine",
+    "http",
+    "logbackup",
+    "mysql",
+    "shuffle",
+    "stats",
+    "ttl",
+    "watchdog",
+})
 
 
 class LockOrderError(RuntimeError):
@@ -41,6 +129,10 @@ _graph_mu = threading.Lock()
 _edges: Dict[str, Set[str]] = {}
 #: where each recorded edge was first seen (for the report)
 _edge_origin: Dict[Tuple[str, str], str] = {}
+#: every class acquired at least once while tracking was on — the
+#: "did this subsystem's locks participate in the run" signal the
+#: stress tests assert (set.add is GIL-atomic)
+_seen: Set[str] = set()
 _held = threading.local()
 
 
@@ -59,6 +151,7 @@ def reset() -> None:
     with _graph_mu:
         _edges.clear()
         _edge_origin.clear()
+        _seen.clear()
 
 
 def enabled() -> bool:
@@ -72,6 +165,76 @@ def _held_stack() -> List[str]:
     return st
 
 
+def _rdepths() -> Dict[int, int]:
+    """Per-thread reentrancy depth per TrackedRLock instance."""
+    d = getattr(_held, "rdepths", None)
+    if d is None:
+        d = _held.rdepths = {}
+    return d
+
+
+def _acquire_site() -> str:
+    """file:line of the acquisition call site — the innermost stack
+    frame OUTSIDE this module. A fixed extract_stack(limit=N)[0] slice
+    reported an arbitrary ancestor frame instead (the deeper the
+    caller, the wronger the report)."""
+    import traceback
+
+    here = os.path.abspath(__file__)
+    for frame in reversed(traceback.extract_stack()):
+        if os.path.abspath(frame.filename) != here:
+            return f"{frame.filename}:{frame.lineno}"
+    return "?"
+
+
+def _check_and_record(acquiring: str) -> None:
+    """Shared acquisition bookkeeping: self-deadlock check against the
+    thread's held stack, then one (held -> acquiring) edge per held
+    class, each cycle-checked against the global graph."""
+    _seen.add(acquiring)
+    stack = _held_stack()
+    if acquiring in stack:
+        raise LockOrderError(
+            f"self-deadlock: lock class '{acquiring}' re-acquired "
+            f"while held (stack: {stack})"
+        )
+    for held in stack:
+        _record_edge(held, acquiring, stack)
+
+
+def _record_edge(held: str, acquiring: str, stack) -> None:
+    if held == acquiring:
+        return
+    with _graph_mu:
+        fwd = _edges.setdefault(held, set())
+        if acquiring in fwd:
+            return  # known-consistent order
+        # the reversal check BEFORE recording: if `held` is
+        # REACHABLE from `acquiring` through recorded edges, adding
+        # held->acquiring closes a cycle — N threads interleaving
+        # the N paths deadlock (direct reversal is the 2-cycle;
+        # BFS catches table->A->B->table style 3+-cycles too)
+        seen, frontier = {acquiring}, [acquiring]
+        while frontier:
+            node = frontier.pop()
+            for nxt in _edges.get(node, ()):
+                if nxt == held:
+                    origin = _edge_origin.get((node, held), "?")
+                    raise LockOrderError(
+                        f"lock-order inversion: acquiring "
+                        f"'{acquiring}' while holding {stack}, but "
+                        f"'{node}' -> '{held}' was recorded at "
+                        f"{origin}, making '{held}' reachable from "
+                        f"'{acquiring}' — interleaving threads "
+                        "deadlock on this cycle"
+                    )
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        fwd.add(acquiring)
+        _edge_origin[(held, acquiring)] = _acquire_site()
+
+
 class TrackedLock:
     """Order-tracking wrapper with the Lock/context-manager protocol."""
 
@@ -80,17 +243,10 @@ class TrackedLock:
         self._lk = threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        stack = _held_stack()
-        if self.name in stack:
-            raise LockOrderError(
-                f"self-deadlock: lock class '{self.name}' re-acquired "
-                f"while held (stack: {stack})"
-            )
-        for held in stack:
-            self._record_edge(held, self.name, stack)
+        _check_and_record(self.name)
         got = self._lk.acquire(blocking, timeout)
         if got:
-            stack.append(self.name)
+            _held_stack().append(self.name)
         return got
 
     def release(self) -> None:
@@ -113,53 +269,157 @@ class TrackedLock:
     def locked(self) -> bool:
         return self._lk.locked()
 
-    @staticmethod
-    def _record_edge(held: str, acquiring: str, stack) -> None:
-        if held == acquiring:
-            return
-        with _graph_mu:
-            fwd = _edges.setdefault(held, set())
-            if acquiring in fwd:
-                return  # known-consistent order
-            # the reversal check BEFORE recording: if `held` is
-            # REACHABLE from `acquiring` through recorded edges, adding
-            # held->acquiring closes a cycle — N threads interleaving
-            # the N paths deadlock (direct reversal is the 2-cycle;
-            # BFS catches table->A->B->table style 3+-cycles too)
-            seen, frontier = {acquiring}, [acquiring]
-            while frontier:
-                node = frontier.pop()
-                for nxt in _edges.get(node, ()):
-                    if nxt == held:
-                        origin = _edge_origin.get((node, held), "?")
-                        raise LockOrderError(
-                            f"lock-order inversion: acquiring "
-                            f"'{acquiring}' while holding {stack}, but "
-                            f"'{node}' -> '{held}' was recorded at "
-                            f"{origin}, making '{held}' reachable from "
-                            f"'{acquiring}' — interleaving threads "
-                            "deadlock on this cycle"
-                        )
-                    if nxt not in seen:
-                        seen.add(nxt)
-                        frontier.append(nxt)
-            fwd.add(acquiring)
-            import traceback
 
-            frame = traceback.extract_stack(limit=6)[0]
-            _edge_origin[(held, acquiring)] = (
-                f"{frame.filename}:{frame.lineno}"
-            )
+class TrackedRLock:
+    """Order-tracked reentrant lock: re-acquiring the SAME instance on
+    one thread is legal (no edges, depth-counted); re-acquiring the
+    same CLASS through a different instance is still a potential
+    deadlock — two threads, two instances, opposite orders."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lk = threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        d = _rdepths()
+        k = id(self)
+        if d.get(k, 0) > 0:  # reentry on this thread
+            got = self._lk.acquire(blocking, timeout)
+            if got:
+                d[k] += 1
+            return got
+        _check_and_record(self.name)
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            d[k] = 1
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        d = _rdepths()
+        k = id(self)
+        if d.get(k, 0) > 1:
+            d[k] -= 1
+            self._lk.release()
+            return
+        d.pop(k, None)
+        stack = _held_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        elif self.name in stack:
+            stack.remove(self.name)
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TrackedCondition:
+    """Order-tracked condition variable. acquire/release track like
+    TrackedLock; wait/wait_for/notify delegate to a real Condition
+    (wait releases and re-acquires the underlying lock internally —
+    the thread is parked meanwhile, so the held-stack entry simply
+    stays put: no other acquisition can happen on this thread)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cv = threading.Condition()
+
+    def acquire(self, *args) -> bool:
+        _check_and_record(self.name)
+        got = self._cv.acquire(*args)
+        if got:
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        elif self.name in stack:
+            stack.remove(self.name)
+        self._cv.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        return self._cv.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        return self._cv.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._cv.notify(n)
+
+    def notify_all(self) -> None:
+        self._cv.notify_all()
+
+
+def _check_declared(name: str) -> None:
+    if name not in LOCK_CLASSES:
+        raise ValueError(
+            f"undeclared lock class {name!r}: declare it in "
+            "tidb_tpu/utils/racecheck.py LOCK_CLASSES (the "
+            "check_concurrency.py lint enforces the same registry "
+            "statically)"
+        )
 
 
 def make_lock(name: str):
-    """A mutex for lock class `name`: plain threading.Lock normally,
-    TrackedLock under race checking."""
+    """A mutex for declared lock class `name`: plain threading.Lock
+    normally (zero overhead), TrackedLock under race checking."""
+    _check_declared(name)
     if _enabled:
         return TrackedLock(name)
     return threading.Lock()
 
 
+def make_rlock(name: str):
+    """A reentrant mutex for declared lock class `name`: plain
+    threading.RLock normally, TrackedRLock under race checking."""
+    _check_declared(name)
+    if _enabled:
+        return TrackedRLock(name)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A condition variable for declared lock class `name`: plain
+    threading.Condition normally, TrackedCondition under race
+    checking."""
+    _check_declared(name)
+    if _enabled:
+        return TrackedCondition(name)
+    return threading.Condition()
+
+
 def edge_graph() -> Dict[str, Set[str]]:
     with _graph_mu:
         return {k: set(v) for k, v in _edges.items()}
+
+
+def seen_classes() -> Set[str]:
+    """Lock classes acquired at least once since the last reset()
+    while tracking was on — participation, independent of whether an
+    acquisition happened to NEST (edge_graph() records only pairs).
+    .copy() is one C-level call that never releases the GIL for str
+    elements, so it is atomic against concurrent _seen.add()."""
+    return _seen.copy()
+
+
+def edge_origins() -> Dict[Tuple[str, str], str]:
+    """(held, acquiring) -> 'file:line' of the first observation of
+    that edge — the acquisition call site, not a racecheck frame."""
+    with _graph_mu:
+        return dict(_edge_origin)

@@ -13,10 +13,10 @@ negative (a burstable group never blocks, mirroring BURSTABLE).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from tidb_tpu.utils import racecheck
 
 class ResourceGroup:
     def __init__(self, name: str, ru_per_sec: Optional[int], burstable: bool):
@@ -43,7 +43,7 @@ class ResourceGroupManager:
     matching the reference's built-in default group."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("resgroup")
         self.groups: Dict[str, ResourceGroup] = {
             "default": ResourceGroup("default", None, True)
         }

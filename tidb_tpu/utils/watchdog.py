@@ -76,7 +76,7 @@ class InstanceWatchdog(threading.Thread):
     """Daemon sampler over one catalog's sessions."""
 
     def __init__(self, catalog, interval: float = 2.0):
-        super().__init__(daemon=True, name="tidb-tpu-watchdog")
+        super().__init__(daemon=True, name="watchdog-instance")
         self.catalog = catalog
         self.interval = interval
         self.stop_flag = threading.Event()
