@@ -1213,6 +1213,35 @@ def main() -> int:
         "0.02 unless --sf <= 1)",
     )
     ap.add_argument(
+        "--serve-load", action="store_true",
+        help="run the serving-tier load scenario instead of the "
+        "single-engine ladder: N concurrent MySQL-protocol sessions "
+        "(--serve-sessions) drive a mixed HIGH_PRIORITY/LOW_PRIORITY "
+        "workload through one coordinator Server routing across a "
+        "worker fleet with admission control; reports p50/p99 latency "
+        "per class + fleet queries/sec, proves >= 2 sessions' "
+        "fragments overlap (flight timelines), shared-plan-cache "
+        "cross-session hits > 0, and kill-a-worker-under-load "
+        "recovery (CPU data-plane scenario)",
+    )
+    ap.add_argument("--serve-sessions", type=int, default=64,
+                    help="concurrent MySQL-protocol sessions (>= 64 "
+                    "for the acceptance run)")
+    ap.add_argument("--serve-statements", type=int, default=6,
+                    help="statements per session")
+    ap.add_argument("--serve-workers", type=int, default=2,
+                    help="worker processes in the fleet")
+    ap.add_argument("--serve-pool-size", type=int, default=4,
+                    help="control connections per worker host")
+    ap.add_argument("--serve-budget-mb", type=int, default=2048,
+                    help="fleet device-memory admission budget (MiB)")
+    ap.add_argument("--serve-kill-worker", action="store_true",
+                    default=True,
+                    help="hard-kill one worker mid-load (default on; "
+                    "--no-serve-kill-worker disables)")
+    ap.add_argument("--no-serve-kill-worker", dest="serve_kill_worker",
+                    action="store_false")
+    ap.add_argument(
         "--racecheck", action="store_true",
         help="with --multihost-shuffle: run the worker processes under "
         "TIDB_TPU_RACECHECK=1 (order-tracked locks, utils/racecheck.py)"
@@ -1223,6 +1252,13 @@ def main() -> int:
     args = ap.parse_args()
     if args.quick:
         args.sf = 0.01
+    if args.serve_load:
+        from tidb_tpu.bench.serve_load import run_serve_load
+
+        # the serving scenario picks its own dryrun scale cap
+        if args.sf == 10.0:  # the ladder default is not a dryrun scale
+            args.sf = 0.005
+        return run_serve_load(args)
     if args.multihost_shuffle:
         return measure_multihost_shuffle(args)
 

@@ -118,11 +118,12 @@ BLOCKING: Dict[str, Tuple[Optional[re.Pattern], str]] = {
 #: generated hierarchy instead of being invisible. Each entry is a
 #: claim about runtime order — keep it current with the path it cites.
 DEEP_EDGES: List[Tuple[str, str, str]] = [
-    # _dispatch/heartbeat/final-stage hold the per-connection stream
-    # lock while _conn() notes a fresh handshake's RTT into the link
-    # registry (flight.py LinkRegistry.note_handshake — two call
-    # levels down)
-    ("dcn.conn", "flight.links", "tidb_tpu/parallel/dcn.py"),
+    # PR 8 removed the last entry (the dcn.conn per-host stream lock —
+    # and with it the held-across-handshake LinkRegistry note — gave
+    # way to the _EndpointPool, which dials and notes the handshake
+    # OUTSIDE its condition). Keep the registry: entries validate
+    # endpoints against LOCK_CLASSES and participate in cycle
+    # detection + the generated hierarchy.
 ]
 
 

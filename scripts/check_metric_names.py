@@ -28,8 +28,10 @@ import sys
 #: the declared subsystem vocabulary. dcn = fragment scheduler,
 #: shuffle = worker-to-worker data plane, engine = TPU engine watch,
 #: flight = the query flight recorder, link = per-peer DCN link health
-#: (both PR 6).
+#: (both PR 6), admission = the serving tier's fleet admission
+#: controller (PR 8, parallel/serving.py).
 SUBSYSTEMS = frozenset({
+    "admission",
     "dcn",
     "engine",
     "executor",

@@ -464,6 +464,98 @@ def test_dcn_flight_recorder_surfaces(tpch_single, tmp_path):
             w.kill()
 
 
+def test_dcn_many_session_serving_dryrun(tpch_single):
+    """PR 8 serving tier: a 2-process x 4-device fleet serves 8+
+    CONCURRENT session threads (each session its own Session object
+    over the shared catalog, scheduler attached, admission-gated).
+    Asserts per-session result parity for a mixed short/scan workload
+    (HIGH_PRIORITY grouped aggregate + LOW_PRIORITY repartition join),
+    that every statement was admitted through the controller, and that
+    the cross-session compiled-plan cache was actually hit (> 0) — the
+    per-connection worker executors and pooled control connections
+    mean two sessions' identical fragments reuse one compile."""
+    import threading
+
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parallel.serving import AdmissionController
+    from tidb_tpu.session import Session
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    admission = AdmissionController(queue_timeout_s=300.0)
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        shuffle_min_rows=1,  # joins ride the tunnels even at dryrun SF
+        admission=admission,
+    )
+    short_q = (
+        "select high_priority l_returnflag, count(*), sum(l_quantity) "
+        "from lineitem group by l_returnflag order by l_returnflag"
+    )
+    scan_q = (
+        "select low_priority o_orderpriority, count(*), "
+        "sum(l_extendedprice) from orders join lineitem "
+        "on o_orderkey = l_orderkey where l_quantity < 24 "
+        "group by o_orderpriority order by o_orderpriority"
+    )
+    exp_short = tpch_single.must_query(short_q).rows
+    exp_scan = tpch_single.must_query(scan_q).rows
+    hits0 = _counter_total(
+        "tidbtpu_executor_shared_plan_cache_cross_session_hits_total"
+    )
+    errors, done = [], []
+
+    def session_thread(i):
+        try:
+            sess = Session(tpch_single.catalog, db="tpch")
+            sess.attach_dcn_scheduler(sched)
+            for rnd in range(2):
+                q, exp = (
+                    (scan_q, exp_scan) if (i + rnd) % 4 == 0
+                    else (short_q, exp_short)
+                )
+                r = sess.execute(q)
+                assert r.rows == exp, (
+                    f"session {i} round {rnd} parity broke"
+                )
+            done.append(i)
+        except Exception as e:
+            errors.append((i, f"{type(e).__name__}: {e}"))
+
+    threads = [
+        threading.Thread(target=session_thread, args=(i,), daemon=True)
+        for i in range(8)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=480)
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, f"session threads hung: {hung}"
+        assert not errors, f"serving dryrun failed: {errors[:3]}"
+        assert sorted(done) == list(range(8))
+        # no statement dodged the gate, none was shed on a healthy fleet
+        outcomes = admission.status()["outcomes"]
+        assert outcomes["admit"] >= 16, outcomes
+        assert outcomes["reject"] == 0 and outcomes["timeout"] == 0
+        # cross-session compile reuse really happened (worker-side
+        # counters ship back on the fenced replies; coordinator-side
+        # final stages share through the same cache)
+        hits1 = _counter_total(
+            "tidbtpu_executor_shared_plan_cache_cross_session_hits_total"
+        )
+        assert hits1 > hits0, (
+            "no cross-session shared-plan-cache hits under 8 sessions"
+        )
+        assert len(sched.alive_endpoints()) == 2
+    finally:
+        sched.close()
+        for w in (w1, w2):
+            w.kill()
+
+
 def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
     """Failpoint-killed worker MID-SHUFFLE with PIPELINING ON: worker 2
     hard-exits on the first partition packet a peer pushes to it (the

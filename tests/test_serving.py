@@ -1,0 +1,420 @@
+"""Serving-tier unit tests: admission controller semantics, the
+strictly-unique qid allocator under thread stress, the cross-session
+shared compiled-plan cache, and statement-priority mapping.
+
+Reference: TiDB resource control's priority queueing and the MinTSO
+scheduler's memory-gated MPP admission; the end-to-end serving proof
+lives in tests/test_multihost.py (2-process fleet, 8 session threads)
+and bench.py --serve-load (64+ MySQL-protocol sessions).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.parallel.serving import (
+    OUTCOMES,
+    AdmissionController,
+    AdmissionRejected,
+    QidAllocator,
+)
+from tidb_tpu.utils import racecheck
+
+
+def _ctl(**kw):
+    kw.setdefault("budget_bytes", 100)
+    kw.setdefault("default_estimate_bytes", 40)
+    kw.setdefault("queue_timeout_s", 5.0)
+    return AdmissionController(**kw)
+
+
+class TestAdmission:
+    def test_admit_within_budget(self):
+        a = _ctl()
+        t1 = a.admit("q1")
+        t2 = a.admit("q2")
+        st = a.status()
+        assert st["running"] == 2 and st["inuse_bytes"] == 80
+        t1.release()
+        t2.release()
+        st = a.status()
+        assert st["running"] == 0 and st["inuse_bytes"] == 0
+        assert st["outcomes"]["admit"] == 2
+        assert st["outcomes"]["queue"] == 0
+
+    def test_oversized_query_runs_alone(self):
+        a = _ctl(budget_bytes=10)
+        t = a.admit("huge")  # nothing running: admitted despite size
+        assert a.status()["running"] == 1
+        t.release()
+
+    def test_queue_then_admit_on_release(self):
+        a = _ctl()
+        t1, t2 = a.admit("q1"), a.admit("q2")
+        admitted = []
+
+        def late():
+            t3 = a.admit("q3")
+            admitted.append(time.monotonic())
+            t3.release()
+
+        th = threading.Thread(target=late, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        assert a.status()["queued"] == 1
+        t_rel = time.monotonic()
+        t1.release()
+        t2.release()
+        th.join(timeout=5)
+        assert admitted and admitted[0] >= t_rel
+        assert a.status()["outcomes"]["queue"] == 1
+
+    def test_full_queue_rejects_with_errno(self):
+        a = _ctl(budget_bytes=10, max_queue=0)
+        hold = a.admit("hold")
+        with pytest.raises(AdmissionRejected) as ei:
+            a.admit("next")
+        assert ei.value.admission_outcome == "reject"
+        assert ei.value.mysql_errno == 8252
+        assert a.status()["outcomes"]["reject"] == 1
+        hold.release()
+
+    def test_queue_wait_timeout(self):
+        a = _ctl(budget_bytes=10, queue_timeout_s=0.2)
+        hold = a.admit("hold")
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            a.admit("next")
+        assert ei.value.admission_outcome == "timeout"
+        assert ei.value.mysql_errno == 8253
+        assert time.monotonic() - t0 >= 0.2
+        # the slot is intact: releasing the holder admits a new query
+        hold.release()
+        a.admit("after").release()
+
+    def test_kill_check_reaches_queued_statement(self):
+        class Killed(RuntimeError):
+            pass
+
+        def kc():
+            raise Killed()
+
+        a = _ctl(budget_bytes=10)
+        hold = a.admit("hold")
+        with pytest.raises(Killed):
+            a.admit("next", kill_check=kc)
+        st = a.status()
+        assert st["queued"] == 0  # waiter cleaned up
+        # the killed statement's wait still counted as "queue" but
+        # got NO terminal admit/reject/timeout outcome — the kill is
+        # the statement's verdict, not an admission decision
+        assert st["outcomes"]["queue"] == 1
+        assert st["outcomes"]["reject"] == 0
+        assert st["outcomes"]["timeout"] == 0
+        assert st["outcomes"]["admit"] == 1  # the holder only
+        hold.release()
+
+    def test_priority_order_and_aging(self):
+        """A queued HIGH query admits before an earlier-queued LOW one;
+        once the LOW one has starved past starvation_s it admits even
+        though fresher HIGH arrivals keep coming (aging promotes it and
+        the starving head blocks leapfrogging)."""
+        a = _ctl(budget_bytes=40, starvation_s=0.4, queue_timeout_s=30.0)
+        hold = a.admit("hold")  # occupies the whole budget
+        order = []
+
+        def waiter(name, prio):
+            t = a.admit(name, priority=prio)
+            order.append(name)
+            time.sleep(0.03)
+            t.release()
+
+        low = threading.Thread(
+            target=waiter, args=("low", "low"), daemon=True
+        )
+        low.start()
+        time.sleep(0.1)  # low is queued first
+        high = threading.Thread(
+            target=waiter, args=("high", "high"), daemon=True
+        )
+        high.start()
+        time.sleep(0.1)
+        hold.release()  # budget frees: high should beat low
+        high.join(timeout=5)
+        low.join(timeout=5)
+        assert order == ["high", "low"], order
+
+    def test_estimates_learn_from_release(self):
+        a = _ctl(default_estimate_bytes=7)
+        assert a.estimate("q") == 7
+        t = a.admit("q")
+        t.release(observed_bytes=123)
+        assert a.estimate("q") == 123
+        # and the next admission of the same shape gates on 123
+        t2 = a.admit("q")
+        assert a.status()["inuse_bytes"] == 123
+        t2.release()
+
+    def test_release_idempotent(self):
+        a = _ctl()
+        t = a.admit("q")
+        t.release()
+        t.release()
+        assert a.status()["running"] == 0
+
+    def test_undeclared_outcome_rejected(self):
+        a = _ctl()
+        with pytest.raises(ValueError, match="undeclared admission"):
+            a._note_outcome("oops")
+        assert set(OUTCOMES) == {"admit", "queue", "reject", "timeout"}
+
+    def test_queue_wait_phase_charged_to_flight(self):
+        from tidb_tpu.obs.flight import FLIGHT
+
+        FLIGHT.begin("select 1", conn_id=1)
+        a = _ctl()
+        a.admit("q").release()
+        rec = FLIGHT.current()
+        assert rec is not None and "queue-wait" in rec.phases
+        FLIGHT.discard()
+
+
+class TestQidAllocator:
+    def test_strictly_unique_under_thread_stress(self):
+        """16 threads x 500 allocations: every id unique, none skipped
+        (the satellite's racecheck-stressed allocator contract — qid
+        collisions would let two queries' shuffle frames admit into
+        one stage)."""
+        racecheck.enable()
+        racecheck.reset()
+        try:
+            alloc = QidAllocator(start=1)
+            got = [[] for _ in range(16)]
+
+            def grab(bucket):
+                for _ in range(500):
+                    bucket.append(alloc.next())
+
+            threads = [
+                threading.Thread(target=grab, args=(b,), daemon=True)
+                for b in got
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            allids = [q for b in got for q in b]
+            assert len(allids) == 16 * 500
+            assert len(set(allids)) == len(allids), "duplicate qid"
+            assert sorted(allids) == list(range(1, 16 * 500 + 1))
+            # each thread's view is strictly increasing (monotone)
+            for b in got:
+                assert b == sorted(b)
+        finally:
+            racecheck.disable()
+            racecheck.reset()
+
+    def test_dcn_allocators_are_locked(self):
+        from tidb_tpu.parallel import dcn
+
+        assert isinstance(dcn._QUERY_ID, QidAllocator)
+        assert isinstance(dcn._STAGED_NONCE, QidAllocator)
+
+
+class TestSharedPlanCache:
+    def test_cross_session_reuse_no_recompile(self):
+        """Two sessions over one catalog: the second session's first
+        run of a shape the first already compiled must hit the shared
+        cache (cross-session counter moves) and add ZERO jit
+        compilations."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage import Catalog
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        def tot(p):
+            return sum(
+                v for n, _k, v in REGISTRY.rows() if n.startswith(p)
+            )
+
+        cat = Catalog()
+        s1 = Session(cat)
+        s1.execute("create table spc (a int, b int)")
+        s1.execute("insert into spc values (1,2),(3,4),(5,6),(1,8)")
+        q = "select a, sum(b), count(*) from spc group by a order by a"
+        exp = s1.must_query(q).rows
+        x0 = tot(
+            "tidbtpu_executor_shared_plan_cache_cross_session_hits_total"
+        )
+        j0 = tot("tidbtpu_engine_jit_compilations")
+        s2 = Session(cat)
+        assert s2.must_query(q).rows == exp
+        assert tot(
+            "tidbtpu_executor_shared_plan_cache_cross_session_hits_total"
+        ) > x0
+        assert tot("tidbtpu_engine_jit_compilations") == j0, (
+            "second session recompiled a shared plan"
+        )
+
+    def test_weak_entries_die_with_their_executors(self):
+        """The shared cache must not pin dead catalogs: once every
+        executor holding a compiled plan is gone, the entry is gone."""
+        import gc
+
+        from tidb_tpu.planner.physical import SHARED_PLAN_CACHE
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage import Catalog
+
+        cat = Catalog()
+        s = Session(cat)
+        s.execute("create table wk (a int)")
+        s.execute("insert into wk values (1),(2)")
+        s.execute("select a, count(*) from wk group by a")
+        keys_with = len(SHARED_PLAN_CACHE._map)
+        assert keys_with >= 1
+        del s, cat
+        gc.collect()
+        # entries for the dead catalog's tables are gone (other tests'
+        # live sessions may keep their own entries; count must drop)
+        assert len(SHARED_PLAN_CACHE._map) < keys_with
+
+    def test_distinct_catalogs_do_not_collide(self):
+        """Same DDL + same SQL over two catalogs must not share
+        compiled programs (table uids key the cache): dictionaries
+        baked for one catalog's data would corrupt the other's."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage import Catalog
+
+        out = []
+        for vals in ("('x'),('y'),('x')", "('p'),('q'),('q')"):
+            cat = Catalog()
+            s = Session(cat)
+            s.execute("create table dd (v varchar(4))")
+            s.execute(f"insert into dd values {vals}")
+            out.append(
+                s.must_query(
+                    "select v, count(*) from dd group by v order by v"
+                ).rows
+            )
+        assert out[0] == [("x", 2), ("y", 1)]
+        assert out[1] == [("p", 1), ("q", 2)]
+
+
+class TestRejectionSurfaces:
+    def test_rejected_statement_errno_and_summary_row(self):
+        """Satellite: an admission verdict must surface as a proper
+        MySQL error (8252 queue-full / 8253 timeout) — never as a
+        local-execution fallback — with the statements_summary row
+        still recorded, its phase breakdown showing the queue-wait
+        that led to the verdict."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+        class StubSched:
+            """Only what the session touches BEFORE the admission
+            gate: the cut choice and the controller itself. A rejected
+            statement must never reach execute_plan."""
+
+            def __init__(self, admission):
+                self.admission = admission
+
+            def _choose_cut(self, plan):
+                return ("frag", None)
+
+            def execute_plan(self, plan, cut_hint=None):
+                raise AssertionError(
+                    "rejected statement reached the fleet"
+                )
+
+        a = AdmissionController(
+            budget_bytes=10, default_estimate_bytes=64,
+            max_queue=0, queue_timeout_s=0.2,
+        )
+        hold = a.admit("hold")  # saturate; max_queue=0 -> reject
+        s = Session()
+        s.execute("create table rejt (a int, b int)")
+        s.execute("insert into rejt values (1,2),(3,4),(1,6)")
+        s.dcn_scheduler = StubSched(a)
+        sql = "select a, count(*), sum(b) from rejt group by a order by a"
+        with pytest.raises(AdmissionRejected) as ei:
+            s.execute(sql)
+        assert ei.value.mysql_errno == 8252
+        assert ei.value.admission_outcome == "reject"
+        # the summary row landed anyway, queue-wait phase attached
+        row = next(
+            r for r in STMT_SUMMARY.rows_full()
+            if r["digest_text"] == sql_digest(sql)
+        )
+        assert row["exec_count"] >= 1
+        assert "queue-wait" in row["phases"]
+        hold.release()
+        # fleet healthy again: the same statement round-trips (local
+        # parity reference — StubSched would fail a real dispatch, so
+        # detach first)
+        s.dcn_scheduler = None
+        assert s.must_query(sql).rows == [(1, 2, 8), (3, 1, 4)]
+
+
+class TestPriorityMapping:
+    def test_select_modifiers_parse(self):
+        from tidb_tpu.parser.sqlparse import parse
+
+        assert parse("select high_priority a from t")[0].priority == "high"
+        assert parse("select low_priority a from t")[0].priority == "low"
+        assert (
+            parse("select distinct high_priority a from t")[0].priority
+            == "high"
+        )
+        assert parse("select high_priority * from t")[0].priority == "high"
+        assert parse("select a from t")[0].priority is None
+
+    def test_column_named_high_priority_still_works(self):
+        """This dialect does NOT reserve high_priority/low_priority
+        (the DDL side accepts them as column names), so the modifier
+        must only consume the identifier when what follows can begin a
+        select item — a column reference keeps working."""
+        from tidb_tpu.parser.sqlparse import parse
+        from tidb_tpu.session import Session
+
+        for sql in (
+            "select high_priority from t",
+            "select high_priority, 1 from t",
+            "select low_priority + 1 from t",
+            "select high_priority * 2 from t",
+        ):
+            assert parse(sql)[0].priority is None, sql
+        s = Session()
+        s.execute("create table prio_col (high_priority int)")
+        s.execute("insert into prio_col values (7),(3)")
+        assert s.must_query(
+            "select high_priority from prio_col order by high_priority"
+        ).rows == [(3,), (7,)]
+        assert s.must_query(
+            "select high_priority * 2 from prio_col order by 1"
+        ).rows == [(6,), (14,)]
+
+    def test_force_priority_sysvar_maps_in(self):
+        from tidb_tpu.parser.sqlparse import parse
+        from tidb_tpu.session import Session
+
+        s = Session()
+        sel = parse("select 1")[0]
+        assert s._priority_for(sel) == "medium"
+        s.execute("set tidb_force_priority = 'LOW_PRIORITY'")
+        assert s._priority_for(sel) == "low"
+        s.execute("set tidb_force_priority = 'HIGH_PRIORITY'")
+        assert s._priority_for(sel) == "high"
+        # the statement's own modifier beats the sysvar
+        assert (
+            s._priority_for(parse("select low_priority 1")[0]) == "low"
+        )
+
+    def test_statement_executes_with_modifier(self):
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table pm (a int)")
+        s.execute("insert into pm values (1),(2)")
+        assert s.must_query(
+            "select high_priority a from pm order by a"
+        ).rows == [(1,), (2,)]

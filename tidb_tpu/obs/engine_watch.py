@@ -145,6 +145,17 @@ class EngineWatch:
                 rec.device_mem_peak_bytes, int(nbytes)
             )
 
+    def current_peak_bytes(self) -> int:
+        """The CURRENT statement's device-mem high-water so far (0
+        when no record is open) — the serving tier's working-set
+        feedback: session routing hands it to
+        AdmissionController.release() so the next admission of the
+        same plan fingerprint gates on what the shape really used
+        (coordinator-side working set; worker slices size the same
+        plan smaller, so the estimate is conservative)."""
+        rec = self.current()
+        return int(rec.device_mem_peak_bytes) if rec is not None else 0
+
     # -- surfaces ------------------------------------------------------
     def rows(self) -> List[tuple]:
         """information_schema.TPU_ENGINE rows, oldest first."""

@@ -62,6 +62,12 @@ from tidb_tpu.utils.metrics import REGISTRY
 PHASES = (
     "parse",
     "plan",
+    # serving-tier waits before dispatch: admission-queue time
+    # (parallel/serving.py AdmissionController) and resource-group RU
+    # throttle waits on DCN-routed statements — how fleet saturation
+    # shows up in a statement's timeline, right next to
+    # fragment-dispatch (PERF_NOTES "reading the admission queue")
+    "queue-wait",
     "compile",
     "execute",
     "final-merge",
@@ -167,6 +173,17 @@ class FlightRecorder:
         self._lock = racecheck.make_lock("flight.ring")
         self._recent = collections.deque(maxlen=capacity)
         self._qid = itertools.count(1)
+
+    def set_ring_capacity(self, capacity: int) -> None:
+        """Resize the finished-flight ring (newest kept). Load
+        harnesses that analyze whole-run timelines (bench --serve-load
+        overlap sweeps) size it to the expected flight count first —
+        at the 256 default a 64-session run evicts most of its own
+        flights before the analysis runs."""
+        with self._lock:
+            self._recent = collections.deque(
+                self._recent, maxlen=max(int(capacity), 1)
+            )
 
     # -- statement scope ----------------------------------------------
     def begin(self, sql: str, conn_id: int = 0) -> QueryFlight:

@@ -33,6 +33,18 @@ Robustness is part of the subsystem:
   exactly once — a late or duplicate delivery after re-dispatch is
   dropped, the work-done-reply-lost ambiguity resolved coordinator-side.
 
+Serving-tier reentrancy (PR 8): the scheduler admits MANY sessions'
+queries concurrently. Each worker host gets a small POOL of control
+connections (the strict request/response stream invariant holds per
+CONNECTION, so k pooled connections serve k concurrent fragments to
+one host instead of serializing them onto one socket), qids/staged
+nonces come from a locked strictly-unique allocator
+(parallel/serving.QidAllocator — qid uniqueness is what fences one
+query's shuffle stages and ledger tokens from another's), and an
+optional AdmissionController gates query start against the fleet
+device-memory budget (session.py consults ``scheduler.admission``
+before dispatch).
+
 Failpoint sites: dcn/dispatch, dcn/dispatch-lost, dcn/redispatch,
 dcn/heartbeat-timeout, dcn/duplicate-redelivery, dcn/final-stage
 (coordinator) and dcn/fragment-execute, dcn/result-send (worker,
@@ -41,13 +53,14 @@ server/engine_rpc.py).
 
 from __future__ import annotations
 
-import itertools
+import contextlib
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from tidb_tpu.dxf.framework import fence_accepts
 from tidb_tpu.obs.flight import FLIGHT, LINKS
+from tidb_tpu.parallel.serving import QidAllocator
 from tidb_tpu.planner import logical as L
 from tidb_tpu.planner.fragmenter import (
     FragmentPlan,
@@ -67,8 +80,10 @@ from tidb_tpu.utils.failpoint import inject
 from tidb_tpu.utils.metrics import REGISTRY, merge_counter_delta
 from tidb_tpu.utils.tracing import Tracer
 
-_STAGED_NONCE = itertools.count(1 << 20)  # disjoint from streamed.py's
-_QUERY_ID = itertools.count(1)
+# strictly-unique under concurrent sessions (see serving.QidAllocator);
+# staged nonces start disjoint from streamed.py's and shuffle.py's
+_STAGED_NONCE = QidAllocator(start=1 << 20)
+_QUERY_ID = QidAllocator(start=1)
 
 
 # -- telemetry (tidbtpu_dcn_*: exported at /metrics, summarized at /dcn) ----
@@ -77,6 +92,16 @@ _QUERY_ID = itertools.count(1)
 def _c_dispatches():
     return REGISTRY.counter(
         "tidbtpu_dcn_dispatches", "fragment dispatches", labels=("host",)
+    )
+
+
+def _g_pool_leased_peak():
+    return REGISTRY.gauge(
+        "tidbtpu_dcn_pool_leased_peak",
+        "high-water of concurrently leased control connections per "
+        "worker host (>= 2: two queries' fragments genuinely "
+        "overlapped on that host)",
+        labels=("host",),
     )
 
 
@@ -217,6 +242,110 @@ class HostHeartbeat:
             self._thread = None
 
 
+class _EndpointPool:
+    """Small pool of control connections to ONE worker host.
+
+    EngineClient's socket protocol is a strict request/response stream,
+    so a connection serves one in-flight RPC at a time — but that
+    invariant is per CONNECTION, not per host. PR 1-7 kept a single
+    connection per host behind a lock, which serialized concurrent
+    queries' fragments onto one socket; the serving tier pools up to
+    ``size`` connections per endpoint so k sessions' fragments genuinely
+    overlap on one worker (the worker side always threaded per
+    connection — socketserver.ThreadingTCPServer). Checkout order:
+    idle connection, else dial a new one (below the cap), else wait on
+    the condition for a checkin. Dead connections (poisoned streams,
+    transport loss) are dropped at checkin and their slot freed.
+    """
+
+    def __init__(self, ep: EngineEndpoint, timeout_s: float,
+                 size: int = 4, on_connect=None):
+        self.ep = ep
+        self.timeout_s = timeout_s
+        self.size = max(int(size), 1)
+        self._on_connect = on_connect
+        self._cv = racecheck.make_condition("dcn.pool")
+        self._idle: List[EngineClient] = []
+        self._total = 0
+
+    def _dial(self) -> EngineClient:
+        """Connect + handshake OUTSIDE the condition (a slow worker
+        must not block other checkouts); the slot was reserved under
+        the cv, so release it on failure."""
+        try:
+            c = EngineClient(
+                self.ep.host, self.ep.port, secret=self.ep.secret,
+                timeout_s=self.timeout_s,
+            )
+        except Exception:
+            with self._cv:
+                self._total -= 1
+                self._cv.notify_all()
+            raise
+        if self._on_connect is not None:
+            try:
+                self._on_connect(self.ep, c)
+            except Exception:
+                pass  # telemetry must never fail a checkout
+        return c
+
+    def _note_leased(self) -> None:
+        """Caller holds the cv. High-water of concurrently leased
+        connections to this host — >= 2 is the direct proof that two
+        queries' fragments genuinely overlapped on one worker (the
+        serve-load acceptance signal; whole-statement flight windows
+        overlap even when dispatches serialize)."""
+        _g_pool_leased_peak().labels(host=self.ep.address).set_max(
+            self._total - len(self._idle)
+        )
+
+    def checkout(self) -> EngineClient:
+        with self._cv:
+            while True:
+                while self._idle:
+                    c = self._idle.pop()
+                    if not c._dead:
+                        self._note_leased()
+                        return c
+                    self._total -= 1
+                if self._total < self.size:
+                    self._total += 1
+                    self._note_leased()
+                    break  # reserved a slot: dial outside the cv
+                self._cv.wait(0.25)
+        return self._dial()
+
+    def checkin(self, conn: EngineClient) -> None:
+        with self._cv:
+            if conn._dead:
+                self._total -= 1
+            else:
+                self._idle.append(conn)
+            self._cv.notify_all()
+
+    @contextlib.contextmanager
+    def lease(self):
+        conn = self.checkout()
+        try:
+            yield conn
+        finally:
+            self.checkin(conn)
+
+    def close_idle(self) -> None:
+        """Drop every idle connection (quarantine/shutdown). In-flight
+        leases keep their connection; a dead worker poisons them on the
+        next round trip and checkin frees the slot."""
+        with self._cv:
+            idle, self._idle = self._idle, []
+            self._total -= len(idle)
+            self._cv.notify_all()
+        for c in idle:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
 class FragmentLedger:
     """Exactly-once fragment accounting for one query — the DXF
     subtask-ledger pattern (dxf/tasks.py staged-file fences,
@@ -323,6 +452,8 @@ class DCNFragmentScheduler:
         shuffle_codec: str = "binary",
         shuffle_pipeline: bool = True,
         shuffle_produce_chunks: Optional[int] = None,
+        conn_pool_size: int = 4,
+        admission=None,
     ):
         if not endpoints:
             raise ValueError("DCN scheduler needs at least one worker host")
@@ -387,18 +518,27 @@ class DCNFragmentScheduler:
         self.tracer = Tracer()
         #: telemetry of the most recent fragmented query:
         #: {"qid", "fragments": [{fid, host, attempt, rows, exec_s,
-        #:  bytes, spans}]}
+        #:  bytes, spans}]}. Scheduler-global (the /dcn endpoint's
+        #: view); concurrent sessions snapshot their OWN query via
+        #: last_query_mine() — the thread-local twin — because this
+        #: field is overwritten by whichever query finishes last.
         self.last_query: Optional[dict] = None
+        self._tls = threading.local()
         self._lock = racecheck.make_lock("dcn.scheduler")
-        self._conns: Dict[EngineEndpoint, EngineClient] = {}
         #: per-host clock offset (host wall clock minus coordinator
         #: wall clock), sampled on each connection's handshake — worker
         #: spans rebase through it instead of the reply-receipt anchor
         self._clock_offsets: Dict[str, float] = {}
-        # strict request/response stream per connection: concurrent
-        # fragments to one host serialize on its lock (same invariant as
-        # PooledEngineClient)
-        self._conn_locks: Dict[EngineEndpoint, threading.Lock] = {}
+        # serving tier: a small control-connection POOL per endpoint
+        # (strict request/response per CONNECTION — k pooled
+        # connections let k concurrent queries' fragments overlap on
+        # one host instead of serializing onto one socket)
+        self.conn_pool_size = max(int(conn_pool_size), 1)
+        self._pools: Dict[EngineEndpoint, _EndpointPool] = {}
+        #: optional serving.AdmissionController: session routing
+        #: (session.py _try_dcn_select) gates query start on it —
+        #: priority/fairness queue + fleet device-memory budget
+        self.admission = admission
         self._rr = 0
 
     # -- host/connection management ------------------------------------
@@ -417,44 +557,31 @@ class DCNFragmentScheduler:
             self._rr += 1
             return ep
 
-    def _ep_lock(self, ep: EngineEndpoint) -> threading.Lock:
+    def _pool(self, ep: EngineEndpoint) -> _EndpointPool:
         with self._lock:
-            lk = self._conn_locks.get(ep)
-            if lk is None:
-                lk = self._conn_locks[ep] = racecheck.make_lock(
-                    "dcn.conn"
+            pool = self._pools.get(ep)
+            if pool is None:
+                pool = self._pools[ep] = _EndpointPool(
+                    ep, self.dispatch_timeout_s,
+                    size=self.conn_pool_size,
+                    on_connect=self._on_connect,
                 )
-            return lk
+            return pool
 
-    def _conn(self, ep: EngineEndpoint) -> EngineClient:
-        c = self._conns.get(ep)
-        if c is None or c._dead:
-            c = EngineClient(
-                ep.host, ep.port, secret=ep.secret,
-                timeout_s=self.dispatch_timeout_s,
-            )
-            self._conns[ep] = c
-            if c.clock_offset_s is not None:
-                self._clock_offsets[ep.address] = c.clock_offset_s
-            # the handshake's RTT/offset sample doubles as the
-            # control-link health reading (cluster_links, /links)
-            LINKS.note_handshake(
-                ep.address, c.clock_rtt_s, c.clock_offset_s
-            )
-        return c
-
-    def _drop_conn(self, ep: EngineEndpoint) -> None:
-        c = self._conns.pop(ep, None)
-        if c is not None:
-            try:
-                c.close()
-            except Exception:
-                pass
+    def _on_connect(self, ep: EngineEndpoint, c: EngineClient) -> None:
+        """Per-connection handshake telemetry (runs OUTSIDE any pool
+        lock): clock-offset sample for span rebasing, and the RTT as
+        the control-link health reading (cluster_links, /links)."""
+        if c.clock_offset_s is not None:
+            self._clock_offsets[ep.address] = c.clock_offset_s
+        LINKS.note_handshake(ep.address, c.clock_rtt_s, c.clock_offset_s)
 
     def close(self) -> None:
         self.heartbeat.stop()
-        for ep in list(self._conns):
-            self._drop_conn(ep)
+        with self._lock:
+            pools = list(self._pools.values())
+        for pool in pools:
+            pool.close_idle()
         self.prober.stop()
 
     # -- dispatch -------------------------------------------------------
@@ -467,26 +594,16 @@ class DCNFragmentScheduler:
         _c_dispatches().labels(host=ep.address).inc()
         if inject("dcn/dispatch-lost"):
             raise ConnectionError("failpoint: dispatch lost in transit")
-        # lock-blocking-ok: the per-connection lock EXISTS to hold
-        # across the RPC round trip — EngineClient's socket protocol is
-        # a strict request/response stream. Lock order: a fresh
-        # connection's handshake note in _conn() acquires flight.links
-        # under this lock, declared as dcn.conn -> flight.links in
-        # check_concurrency.DEEP_EDGES
-        with self._ep_lock(ep):
-            conn = self._conn(ep)
-            try:
-                return conn.execute_plan_full(plan, frag=frag_meta)
-            except (SchemaOutOfDateError, RuntimeError, ValueError,
-                    PermissionError):
-                raise
-            except Exception:
-                self._drop_conn(ep)
-                raise
+        # pooled control connection: the RPC holds ONE pooled stream,
+        # not a per-host lock — concurrent queries' fragments to this
+        # host ride sibling connections (serving-tier reentrancy). A
+        # transport failure poisons the connection (EngineClient marks
+        # _dead) and checkin frees its slot.
+        with self._pool(ep).lease() as conn:
+            return conn.execute_plan_full(plan, frag=frag_meta)
 
     def _quarantine(self, ep: EngineEndpoint) -> None:
-        with self._ep_lock(ep):
-            self._drop_conn(ep)
+        self._pool(ep).close_idle()
         # detect() reports whether THIS call made the alive->failed
         # transition: one host death = one quarantine count, no matter
         # how many fragment threads observed it
@@ -644,7 +761,7 @@ class DCNFragmentScheduler:
         the survivor set at the next attempt — receivers fence stale-
         attempt packets, the per-attempt ledger fences results, so a
         retried stage lands exactly once."""
-        qid = next(_QUERY_ID)
+        qid = _QUERY_ID.next()
         sid = f"{self._sid_prefix}-q{qid}"
         stage = {
             "sid": sid, "qid": qid, "kind": sp.kind, "attempts": 0,
@@ -684,7 +801,7 @@ class DCNFragmentScheduler:
             errs: List[str] = []
             fatal: List[Exception] = []
 
-            def run_part(i: int, ep: EngineEndpoint):
+            def run_part(i: int, ep: EngineEndpoint, conn: EngineClient):
                 token = ledger.claim(i, ep.address)
                 task = {
                     "sid": sid, "qid": qid, "attempt": attempt, "m": m,
@@ -706,13 +823,9 @@ class DCNFragmentScheduler:
                     "trace": bool(self.tracer.enabled),
                 }
                 try:
-                    # lock-blocking-ok: per-connection stream lock —
-                    # held across the RPC by design (see _dispatch)
-                    with self._ep_lock(ep):
-                        conn = self._conn(ep)
-                        resp = conn.call(
-                            {"v": IR_VERSION, "shuffle_task": task}
-                        )
+                    resp = conn.call(
+                        {"v": IR_VERSION, "shuffle_task": task}
+                    )
                 except (SchemaOutOfDateError, RuntimeError, ValueError,
                         PermissionError):
                     # deterministic client-side failures (oversized
@@ -739,23 +852,50 @@ class DCNFragmentScheduler:
                 if ledger.complete(i, token, rows):
                     self._note_partition(infos, i, ep, attempt, resp)
 
-            def runner(i, ep):
+            def runner(i, ep, conn):
                 try:
-                    run_part(i, ep)
+                    run_part(i, ep, conn)
                 except Exception as e:
                     fatal.append(e)
 
-            threads = [
-                threading.Thread(
-                    target=runner, args=(i, ep), daemon=True,
-                    name=f"shuffle-q{qid}-p{i}",
-                )
-                for i, ep in enumerate(hosts)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            # a stage's fragments WAIT on each other's frames across
+            # hosts, so leasing per-fragment inside the runners allows
+            # partial slot allocation across concurrent stages to
+            # cycle (stage X holds host A's last slot waiting on its
+            # host-B fragment queued behind stage Y, which holds B
+            # waiting on A) — broken only by the shuffle wait timeout.
+            # Leasing ALL hosts' connections up front, in the fleet's
+            # fixed endpoint order, makes acquisition cycle-free: a
+            # stage either runs on every host or is still waiting for
+            # its FIRST slot, never holding some while blocking on
+            # others.
+            leases: List[Tuple[EngineEndpoint, EngineClient]] = []
+            try:
+                try:
+                    for ep in hosts:
+                        leases.append((ep, self._pool(ep).checkout()))
+                except Exception as e:
+                    # a checkout failed (endpoint dialed dead): suspect
+                    # it and let the retry loop verify/quarantine
+                    bad = hosts[len(leases)]
+                    with self._lock:
+                        suspects.append(bad.address)
+                        errs.append(f"{bad.address}: {e}")
+                else:
+                    threads = [
+                        threading.Thread(
+                            target=runner, args=(i, ep, conn),
+                            daemon=True, name=f"shuffle-q{qid}-p{i}",
+                        )
+                        for i, (ep, conn) in enumerate(leases)
+                    ]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+            finally:
+                for ep, conn in leases:
+                    self._pool(ep).checkin(conn)
             if fatal:
                 raise fatal[0]
             if ledger.all_done():
@@ -776,11 +916,13 @@ class DCNFragmentScheduler:
                     stage["ttff_s"] = max(
                         stage["ttff_s"], f.get("ttff_s", 0.0)
                     )
+                lq = {
+                    "qid": qid, "fragments": infos,
+                    "shuffle": dict(stage),
+                }
                 with self._lock:
-                    self.last_query = {
-                        "qid": qid, "fragments": infos,
-                        "shuffle": dict(stage),
-                    }
+                    self.last_query = lq
+                self._tls.last = lq
                 _update_host_gauges(self.endpoints)
                 return ledger.rows(), infos, stage
             if errs:
@@ -854,7 +996,7 @@ class DCNFragmentScheduler:
         completed ledger plus per-fragment telemetry (host, attempt,
         rows, exec_s, bytes, spans) — only FENCED deliveries contribute,
         so a retried fragment's stats and spans appear exactly once."""
-        qid = next(_QUERY_ID)
+        qid = _QUERY_ID.next()
         n = max(len(self.alive_endpoints()), 1)
         ledger = FragmentLedger(n)
         infos: List[dict] = []
@@ -940,8 +1082,10 @@ class DCNFragmentScheduler:
                 f"{last_err}"
             )
         infos.sort(key=lambda f: f["fid"])
+        lq = {"qid": qid, "fragments": infos}
         with self._lock:
-            self.last_query = {"qid": qid, "fragments": infos}
+            self.last_query = lq
+        self._tls.last = lq
         _update_host_gauges(self.endpoints)
         return ledger, infos
 
@@ -967,6 +1111,14 @@ class DCNFragmentScheduler:
         self._merge_remote_spans(
             spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
         )
+
+    def last_query_mine(self) -> Optional[dict]:
+        """The most recent query THIS THREAD dispatched. The session
+        routing path snapshots runtime stats from here — the global
+        ``last_query`` is whichever of N concurrent sessions' queries
+        finished last, which would cross-attribute slow-log plan
+        captures between sessions."""
+        return getattr(self._tls, "last", None)
 
     def _merge_remote_spans(
         self, spans, host: str, addr: Optional[str] = None,
@@ -1016,10 +1168,8 @@ class DCNFragmentScheduler:
                 _c_dispatches().labels(host=ep.address).inc()
                 if inject("dcn/dispatch-lost"):
                     raise ConnectionError("failpoint: dispatch lost in transit")
-                # lock-blocking-ok: per-connection stream lock — held
-                # across the RPC by design (see _dispatch)
-                with self._ep_lock(ep):
-                    conn = self._conn(ep)
+                # pooled control connection (see _dispatch)
+                with self._pool(ep).lease() as conn:
                     return conn.execute_plan(plan)
             except (SchemaOutOfDateError, RuntimeError, ValueError,
                     PermissionError):
@@ -1043,7 +1193,7 @@ class DCNFragmentScheduler:
         from tidb_tpu.parallel.shuffle import stage_rows_as_batch
 
         return stage_rows_as_batch(
-            cut.partial_schema, rows, next(_STAGED_NONCE),
+            cut.partial_schema, rows, _STAGED_NONCE.next(),
             key="dcn-final",
         )
 
@@ -1083,7 +1233,7 @@ class DCNFragmentScheduler:
         quarantined = [
             ep.address for ep in self.prober.failed_endpoints()
         ]
-        return {
+        out = {
             "enabled": True,
             "hosts": [
                 {"address": ep.address, "alive": bool(ep.alive)}
@@ -1091,5 +1241,10 @@ class DCNFragmentScheduler:
             ],
             "alive": len(self.alive_endpoints()),
             "quarantined": quarantined,
+            "conn_pool_size": self.conn_pool_size,
             "last_query": last,
         }
+        if self.admission is not None:
+            # serving-tier admission snapshot rides the same endpoint
+            out["admission"] = self.admission.status()
+        return out

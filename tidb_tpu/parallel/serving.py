@@ -1,0 +1,410 @@
+"""Concurrent multi-query serving tier: fleet admission + fairness.
+
+Reference: TiDB resource control's runaway/priority queueing
+(pkg/domain/resourcegroup) and the MPP task scheduler's memory-aware
+admission (tiflash MinTSO scheduler: concurrent MPP queries gate on a
+per-store working-set budget before their tasks start, instead of
+OOMing mid-stage). "Accelerating Presto with GPUs" (PAPERS.md) makes
+the accelerator-serving point this module is built on: at high
+concurrency, throughput is decided by admission control and cross-query
+plan reuse, not raw kernel speed — an accelerator fleet saturates long
+before its ALUs do, on device memory and compile churn.
+
+Two pieces:
+
+- ``AdmissionController`` — gates query START against a fleet
+  device-memory budget. The working-set estimate for a plan is the
+  engine-watch per-query device-mem high-water observed the last time
+  the same plan fingerprint ran (obs/engine_watch.py `note_device_mem`
+  — the same number the quota admission pre-accounts); unseen plans
+  use a declared default. Queries that do not fit wait in a
+  priority/fairness queue (statement ``HIGH_PRIORITY``/``LOW_PRIORITY``
+  and ``tidb_force_priority`` map into it; waiting ages a query's
+  effective priority up so an SF10-class scan is never starved by a
+  stream of interactive statements). Every ``admit()`` resolves to a
+  DECLARED outcome — ``admit``, ``reject`` (queue full), ``timeout``
+  (queue wait exceeded) — with ``queue`` additionally counted for any
+  admission that had to wait; outcomes are the failpoint-SITES
+  pattern: undeclared names raise. Queue time lands on the statement's
+  flight as the ``queue-wait`` phase (obs/flight.py), so admission
+  pressure is visible right next to fragment-dispatch in
+  statements_summary and the slow log.
+
+- ``QidAllocator`` — strictly-unique, thread-safe id allocation for
+  the DCN tier's query ids and staged nonces. Under one-query-at-a-time
+  scheduling a bare ``itertools.count`` sufficed; a serving tier hands
+  qids to MANY session threads concurrently, and qid uniqueness is
+  what fences one query's shuffle stages and ledger tokens from
+  another's — so the allocator is explicit, locked, and stress-tested
+  (tests/test_serving.py, racecheck-on).
+
+Metrics: tidbtpu_admission_outcomes_total{outcome}, _queue_depth,
+_running_queries, _inuse_bytes, _queue_wait_seconds.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, Optional
+
+from tidb_tpu.utils import racecheck
+from tidb_tpu.utils.failpoint import inject
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: declared admission outcomes (the failpoint-SITES pattern): every
+#: admit() the controller itself resolves terminates in exactly one of
+#: admit/reject/timeout; "queue" is additionally counted when the
+#: query had to wait first. A deliberate kill raised from kill_check
+#: propagates WITHOUT a terminal outcome (the kill is the statement's
+#: verdict, not an admission decision) — its queue wait still lands.
+OUTCOMES = ("admit", "queue", "reject", "timeout")
+_OUTCOME_SET = frozenset(OUTCOMES)
+
+#: statement priorities, best first. HIGH_PRIORITY -> "high",
+#: LOW_PRIORITY/DELAYED -> "low", everything else "medium".
+PRIORITIES = ("high", "medium", "low")
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+
+def _c_outcomes():
+    return REGISTRY.counter(
+        "tidbtpu_admission_outcomes_total",
+        "admission decisions by declared outcome",
+        labels=("outcome",),
+    )
+
+
+def _g_queue_depth():
+    return REGISTRY.gauge(
+        "tidbtpu_admission_queue_depth", "queries waiting for admission"
+    )
+
+
+def _g_running():
+    return REGISTRY.gauge(
+        "tidbtpu_admission_running_queries",
+        "admitted queries currently holding fleet budget",
+    )
+
+
+def _g_inuse():
+    return REGISTRY.gauge(
+        "tidbtpu_admission_inuse_bytes",
+        "estimated fleet device-memory working set of admitted queries",
+    )
+
+
+def _h_queue_wait():
+    return REGISTRY.histogram(
+        "tidbtpu_admission_queue_wait_seconds",
+        "time queries spent waiting for admission",
+    )
+
+
+class AdmissionRejected(RuntimeError):
+    """A statement the serving tier refused to start. Surfaces to the
+    client as a MySQL error (server.py maps ``mysql_errno``), never as
+    a local-execution fallback — an overloaded fleet must shed load
+    visibly, not silently re-run rejected scans on the coordinator.
+    ``admission_outcome`` is the declared outcome ("reject" or
+    "timeout"); session.py keys on the attribute (not the class) so the
+    statements_summary row still lands without an import cycle."""
+
+    def __init__(self, msg: str, outcome: str, mysql_errno: int):
+        super().__init__(msg)
+        self.admission_outcome = outcome
+        self.mysql_errno = mysql_errno
+
+
+class _Waiter:
+    __slots__ = ("seq", "rank", "est", "t0")
+
+    def __init__(self, seq: int, rank: int, est: int, t0: float):
+        self.seq = seq
+        self.rank = rank
+        self.est = est
+        self.t0 = t0
+
+
+class AdmissionTicket:
+    """One admitted query's hold on the fleet budget. ``release()``
+    (idempotent) returns the estimated bytes to the pool and feeds the
+    OBSERVED engine-watch high-water back as the next estimate for the
+    same plan fingerprint. ``waited_s`` is the queue time this
+    admission paid — the session excludes it from RU billing (a
+    throttle wait billed as RU would re-overdraw the bucket)."""
+
+    __slots__ = ("_ctl", "key", "est", "waited_s", "_released")
+
+    def __init__(self, ctl: "AdmissionController", key: str, est: int,
+                 waited_s: float = 0.0):
+        self._ctl = ctl
+        self.key = key
+        self.est = est
+        self.waited_s = waited_s
+        self._released = False
+
+    def release(self, observed_bytes: Optional[int] = None) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._ctl._release(self, observed_bytes)
+
+    # context-manager sugar for tests/tools
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class AdmissionController:
+    """Admit-or-queue gate in front of the DCN scheduler.
+
+    Decision rule (documented, deliberately simple):
+
+    - a query ADMITS when its working-set estimate fits the remaining
+      budget, or when nothing is running (an oversized query runs
+      alone rather than wedging forever);
+    - otherwise it queues. Among queued queries, the one with the best
+      (effective priority, arrival seq) admits first; others may only
+      fill budget gaps the best-ranked waiter cannot use itself, so
+      priority order never decays into thread wake-order. Effective
+      priority ages UP one rank per ``starvation_s`` waited, so a
+      starving scan eventually outranks fresh arrivals; and while the
+      best-ranked waiter has waited past ``starvation_s``, ONLY it may
+      admit — gap-filling stops and the fleet drains until it fits;
+    - a full queue REJECTS immediately; a queue wait past the timeout
+      resolves TIMEOUT. Both raise AdmissionRejected.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int = 2 << 30,
+        default_estimate_bytes: int = 64 << 20,
+        max_queue: int = 256,
+        queue_timeout_s: float = 30.0,
+        starvation_s: float = 5.0,
+    ):
+        self._cv = racecheck.make_condition("serving.admission")
+        self.budget_bytes = int(budget_bytes)
+        self.default_estimate_bytes = int(default_estimate_bytes)
+        self.max_queue = int(max_queue)
+        self.queue_timeout_s = float(queue_timeout_s)
+        self.starvation_s = float(starvation_s)
+        self._in_use = 0
+        self._running = 0
+        self._waiters: list = []
+        self._seq = itertools.count(1)
+        #: plan fingerprint -> last observed device-mem high-water
+        self._estimates: Dict[str, int] = {}
+        self._outcome_counts = {o: 0 for o in OUTCOMES}
+
+    # -- estimates ------------------------------------------------------
+    def estimate(self, key: Optional[str]) -> int:
+        """Working-set estimate for one plan: the engine-watch
+        high-water of its last run, else the declared default."""
+        if key is None:
+            return self.default_estimate_bytes
+        with self._cv:
+            return self._estimates.get(key, self.default_estimate_bytes)
+
+    def note_usage(self, key: Optional[str], observed_bytes: int) -> None:
+        if key is None or observed_bytes <= 0:
+            return
+        with self._cv:
+            self._store_estimate(key, observed_bytes)
+
+    def _store_estimate(self, key: str, observed_bytes: int) -> None:
+        """Caller holds the cv — the ONE estimate-learning site
+        (note_usage and ticket release both land here)."""
+        if len(self._estimates) > 4096:
+            self._estimates.clear()  # runaway backstop; re-learns
+        self._estimates[key] = int(observed_bytes)
+
+    # -- outcome accounting (declared vocabulary) -----------------------
+    def _note_outcome(self, name: str) -> None:
+        if name not in _OUTCOME_SET:
+            raise ValueError(
+                f"undeclared admission outcome {name!r} (declare it in "
+                "tidb_tpu/parallel/serving.py OUTCOMES)"
+            )
+        _c_outcomes().labels(outcome=name).inc()
+        with self._cv:
+            self._outcome_counts[name] += 1
+
+    # -- the gate -------------------------------------------------------
+    def _fits(self, est: int) -> bool:
+        return (
+            self._in_use + est <= self.budget_bytes or self._running == 0
+        )
+
+    def _grant(self, est: int) -> None:
+        self._in_use += est
+        self._running += 1
+
+    def _effective_rank(self, w: _Waiter, now: float) -> float:
+        aged = (now - w.t0) / max(self.starvation_s, 1e-9)
+        return w.rank - aged
+
+    def _best_waiter(self, now: float) -> Optional[_Waiter]:
+        if not self._waiters:
+            return None
+        return min(
+            self._waiters,
+            key=lambda w: (self._effective_rank(w, now), w.seq),
+        )
+
+    def _may_admit(self, w: _Waiter, now: float) -> bool:
+        """Caller holds the cv. The best-ranked waiter admits when it
+        fits; others may only fill budget gaps the best-ranked one
+        CANNOT use (work-conserving: a small interactive query passes a
+        queued scan too big for the remaining budget — but never races
+        it for budget both fit, or priority order would decay into
+        wake-order), and not even that once the best has waited past
+        ``starvation_s`` — then the fleet drains for the starver."""
+        best = self._best_waiter(now)
+        if best is None:
+            return False
+        if w is best:
+            return self._fits(w.est)
+        if now - best.t0 >= self.starvation_s:
+            return False  # reserved: drain for the starving head
+        return not self._fits(best.est) and self._fits(w.est)
+
+    def admit(
+        self,
+        key: Optional[str],
+        priority: str = "medium",
+        kill_check=None,
+        timeout_s: Optional[float] = None,
+    ) -> AdmissionTicket:
+        """Block until this query may start on the fleet; returns the
+        ticket to release when it finishes. Raises AdmissionRejected on
+        a full queue or an expired queue wait, and whatever
+        ``kill_check`` raises (KILL QUERY reaches queued statements)."""
+        inject("serving/admit")
+        rank = _PRIORITY_RANK.get(priority, _PRIORITY_RANK["medium"])
+        est = self.estimate(key)
+        t0 = time.monotonic()
+        deadline = t0 + (
+            self.queue_timeout_s if timeout_s is None else float(timeout_s)
+        )
+        queued = False
+        verdict: Optional[AdmissionRejected] = None
+        killed: Optional[BaseException] = None
+        with self._cv:
+            if not self._waiters and self._fits(est):
+                self._grant(est)
+            elif len(self._waiters) >= self.max_queue:
+                verdict = AdmissionRejected(
+                    f"admission queue full ({self.max_queue} queued); "
+                    "fleet is saturated — retry later",
+                    outcome="reject", mysql_errno=8252,
+                )
+            else:
+                queued = True
+                w = _Waiter(next(self._seq), rank, est, t0)
+                self._waiters.append(w)
+                _g_queue_depth().set(len(self._waiters))
+                try:
+                    while True:
+                        now = time.monotonic()
+                        if self._may_admit(w, now):
+                            self._grant(w.est)
+                            break
+                        if now >= deadline:
+                            verdict = AdmissionRejected(
+                                "admission queue wait exceeded "
+                                f"{deadline - t0:.0f}s "
+                                f"(priority={priority}, "
+                                f"estimate={w.est}B)",
+                                outcome="timeout", mysql_errno=8253,
+                            )
+                            break
+                        if kill_check is not None:
+                            try:
+                                kill_check()
+                            except BaseException as e:
+                                # KILL QUERY reached the queued
+                                # statement: propagate AFTER the wait
+                                # accounting below, so the queue time
+                                # it paid still lands on the flight,
+                                # histogram, and "queue" count
+                                killed = e
+                                break
+                        self._cv.wait(min(deadline - now, 0.05))
+                finally:
+                    self._waiters.remove(w)
+                    _g_queue_depth().set(len(self._waiters))
+                    # an admit/raise changes who is next: wake the rest
+                    self._cv.notify_all()
+            # gauges read AND set under the cv: setting outside it
+            # loses the race with a concurrent release and leaves
+            # running/inuse wrong until the next admission event
+            _g_running().set(self._running)
+            _g_inuse().set(self._in_use)
+        waited = time.monotonic() - t0
+        _h_queue_wait().observe(waited)
+        # the queue wait is a flight phase on EVERY exit — admitted,
+        # rejected, timed out, or killed (a rejected statement's
+        # summary row shows the wait that led to the verdict):
+        # admission pressure lands in statements_summary and the slow
+        # log next to fragment-dispatch
+        from tidb_tpu.obs.flight import FLIGHT
+
+        FLIGHT.note_phase("queue-wait", waited)
+        if queued:
+            self._note_outcome("queue")
+        if killed is not None:
+            # a kill is the STATEMENT's verdict, not an admission
+            # decision: no terminal admit/reject/timeout outcome
+            raise killed
+        if verdict is not None:
+            self._note_outcome(verdict.admission_outcome)
+            raise verdict
+        self._note_outcome("admit")
+        return AdmissionTicket(self, key, est, waited_s=waited)
+
+    def _release(self, ticket: AdmissionTicket, observed) -> None:
+        with self._cv:
+            self._in_use = max(self._in_use - ticket.est, 0)
+            self._running = max(self._running - 1, 0)
+            if observed and ticket.key is not None and int(observed) > 0:
+                self._store_estimate(ticket.key, int(observed))
+            self._cv.notify_all()
+            _g_running().set(self._running)
+            _g_inuse().set(self._in_use)
+
+    # -- introspection (the /dcn endpoint + bench) ----------------------
+    def status(self) -> dict:
+        with self._cv:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "inuse_bytes": self._in_use,
+                "running": self._running,
+                "queued": len(self._waiters),
+                "known_plans": len(self._estimates),
+                "outcomes": dict(self._outcome_counts),
+            }
+
+
+class QidAllocator:
+    """Strictly-unique monotone id allocation across threads. The DCN
+    tier's qids key shuffle stage ids (``<prefix>-q<qid>``) and ledger
+    trace contexts; a duplicated qid under concurrent sessions would
+    let two queries' frames admit into one stage. Locked (not a bare
+    ``itertools.count`` — CPython's GIL happens to make that atomic
+    today, but qid uniqueness is a correctness invariant, not an
+    implementation accident), and stress-tested under racecheck."""
+
+    def __init__(self, start: int = 1):
+        self._lock = racecheck.make_lock("serving.qid")
+        self._next = int(start)
+
+    def next(self) -> int:
+        with self._lock:
+            qid = self._next
+            self._next += 1
+            return qid

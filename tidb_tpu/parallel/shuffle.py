@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import itertools
 import json
 import struct
 import threading
@@ -86,6 +87,11 @@ DEFAULT_PACKET_ROWS = 2048
 DEFAULT_PRODUCE_CHUNKS = 2
 #: transport retries per packet before the peer is declared dead
 PUSH_RETRIES = 3
+#: staged-batch nonces for every ShuffleWorker in this process
+#: (disjoint from dcn.py's 1<<20 and streamed.py's ranges): shared so
+#: two in-process workers can never mint the same nonce — non-keyed
+#: Staged plans fingerprint on the nonce alone
+_STAGE_NONCES = itertools.count(1 << 24)
 
 
 # -- telemetry (tidbtpu_shuffle_*) ------------------------------------------
@@ -184,6 +190,15 @@ def _h_ttff():
         "stage-open to first data frame per (side, sender) stream — "
         "low when producers ship chunk-granularly instead of after the "
         "whole side materializes",
+    )
+
+
+def _g_stages_buffered():
+    return REGISTRY.gauge(
+        "tidbtpu_shuffle_stages_buffered",
+        "shuffle stages concurrently buffered in this worker's store — "
+        "the serving tier's per-worker concurrency signal (each "
+        "in-flight query contributes its own sid-keyed stage)",
     )
 
 
@@ -317,6 +332,18 @@ class ShuffleStore:
       fast peer may precede this worker's own task dispatch);
     - within an attempt, a duplicate (side, sender, seq) is dropped —
       retransmits after an ack loss land exactly once.
+
+    Per-QUERY isolation under the concurrent serving tier (PR 8
+    audit): stages key on the coordinator's sid, which embeds a
+    strictly-unique qid (serving.QidAllocator) under a per-coordinator
+    uuid prefix — two concurrent queries (even the same SQL from two
+    sessions) can never share a stage record, so a frame admits into
+    exactly the stage its producer was dispatched for. The eviction
+    window keeps actively-waited stages pinned (waiters counter), so K
+    concurrent queries occupy K stage records and complete
+    independently; tests/test_race.py hammers K distinct concurrent
+    queries through one in-process fleet asserting per-query parity
+    and zero stale/duplicate admits.
     """
 
     def __init__(self):
@@ -356,6 +383,9 @@ class ShuffleStore:
         inject("shuffle/open")
         with self._cv:
             self._stage(sid, attempt, m)
+            # set under the cv: outside it a lost update with a
+            # concurrent open/discard leaves the gauge stale
+            _g_stages_buffered().set(len(self._stages))
 
     def discard(self, sid: str) -> None:
         """Drop a stage's buffered rows (called once the consumer has
@@ -365,6 +395,7 @@ class ShuffleStore:
         ages out of the window."""
         with self._cv:
             self._stages.pop(sid, None)
+            _g_stages_buffered().set(len(self._stages))
 
     def push(
         self,
@@ -1144,9 +1175,11 @@ class ShuffleWorker:
         self.store = ShuffleStore()
         self.self_address = self_address
         self.mesh_devices = mesh_devices
-        import itertools
-
-        self._nonce = itertools.count(1 << 24)  # disjoint from dcn.py's
+        # PROCESS-wide nonce stream (disjoint from dcn.py's and
+        # streamed.py's): nonce-staged plans fingerprint on the nonce
+        # alone, so two in-process workers minting from per-instance
+        # counters would collide in any process-scoped cache
+        self._nonce = _STAGE_NONCES
         # executors persist across tasks so producer plans compile once
         # per (plan, slice) instead of once per dispatch; their plan
         # caches are not thread-safe, so executor phases serialize on

@@ -182,6 +182,11 @@ class Select:
     # SELECT ... FOR UPDATE / LOCK IN SHARE MODE: pessimistic row locks
     # on the read tables (reference: pkg/executor SelectLockExec)
     for_update: bool = False
+    # SELECT HIGH_PRIORITY / LOW_PRIORITY (MySQL statement priority
+    # modifiers): "high" | "low" | None. The serving tier's admission
+    # queue orders on it; tidb_force_priority supplies the default
+    # (session._priority_for)
+    priority: object = None
 
 
 @dataclasses.dataclass

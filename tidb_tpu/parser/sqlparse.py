@@ -886,6 +886,44 @@ class Parser:
             out.append((name, args))
         return tuple(out)
 
+    def _accept_priority(self):
+        """HIGH_PRIORITY / LOW_PRIORITY select modifier -> "high" /
+        "low" / None. MySQL reserves these words, but THIS dialect
+        does not (a column may legally be named high_priority), so
+        the identifier is consumed as a modifier only when the next
+        token can begin a select item: `select high_priority a from t`
+        is a modifier, `select high_priority from t` and
+        `select high_priority, 1 from t` keep reading the column."""
+        if self.cur.kind != "id":
+            return None
+        word = self.cur.text.lower()
+        if word not in ("high_priority", "low_priority"):
+            return None
+        nxt = self.toks[self.i + 1]
+        if nxt.kind == "eof":
+            return None
+        if nxt.kind == "op":
+            if nxt.text == "*":
+                # `high_priority *` is the all-columns item only when
+                # the star is not a multiplication: peek one further
+                after = self.toks[self.i + 2]
+                star_is_item = after.kind == "eof" or (
+                    after.kind == "kw" and after.text == "from"
+                ) or (after.kind == "op" and after.text in (",", ";"))
+                if not star_is_item:
+                    return None
+            elif nxt.text != "(":
+                # ',', '.', ')', arithmetic... — the identifier is a
+                # column reference continuing an expression
+                return None
+        elif nxt.kind == "kw" and nxt.text in (
+            "from", "as", "where", "group", "having", "order", "limit",
+            "union", "for", "into",
+        ):
+            return None
+        self.advance()
+        return "high" if word == "high_priority" else "low"
+
     def parse_select(self) -> ast.Select:
         if not hasattr(self, "_pending_win_refs"):
             self._pending_win_refs = []
@@ -894,11 +932,18 @@ class Parser:
         hints = ()
         if self.cur.kind == "hint":
             hints = self._parse_hints(self.advance().text)
+        # MySQL statement priority modifiers (reserved words in MySQL;
+        # accepted before or after ALL/DISTINCT like the reference's
+        # select-option list): SELECT HIGH_PRIORITY ... maps into the
+        # serving tier's admission queue (parallel/serving.py)
+        priority = self._accept_priority()
         distinct = False
         if self.accept_kw("distinct"):
             distinct = True
         else:
             self.accept_kw("all")
+        if priority is None:
+            priority = self._accept_priority()
         items = [self.parse_select_item()]
         while self.accept_op(","):
             items.append(self.parse_select_item())
@@ -975,7 +1020,7 @@ class Parser:
             items=items, from_=from_, where=where, group_by=group_by,
             having=having, order_by=order_by, limit=limit, offset=offset,
             distinct=distinct, hints=hints, for_update=for_update,
-            outfile=outfile, rollup=rollup,
+            outfile=outfile, rollup=rollup, priority=priority,
         )
         # resolve THIS block's OVER w references in place — refs below
         # _win_mark belong to an enclosing select, refs above it were

@@ -25,6 +25,7 @@ SURVEY.md §7 "hard parts" #3/#7.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -46,6 +47,7 @@ from tidb_tpu.expression import compile_expr
 from tidb_tpu.expression.expr import ColumnRef, Expr
 from tidb_tpu.planner import logical as L
 from tidb_tpu.storage import scan_table
+from tidb_tpu.utils import racecheck
 
 Dicts = Dict[str, np.ndarray]
 # node function: (inputs by scan id, caps by node id) -> (batch, needs dict)
@@ -171,6 +173,16 @@ class CompiledQuery:
     jitted: Optional[Callable] = None
     caps: Optional[Dict[int, int]] = None
     input_shape_key: Optional[tuple] = None
+    # the CONSISTENT steady snapshot: (jitted, caps, input_shape_key)
+    # published as ONE atomic tuple after the post-discovery
+    # verification run passes. Concurrent executors sharing this cq
+    # (the cross-session plan cache) read the tuple, never the three
+    # loose fields above — a reader pairing thread A's program with
+    # thread B's caps could accept a silently-truncated output (the
+    # program's true cardinalities are checked against the caps IT was
+    # compiled for). The loose fields stay as a warm-start hint for
+    # discovery and for the profiling scripts.
+    steady: Optional[tuple] = None
     # set when a post-shrink steady run overflowed (e.g. a probe chain no
     # longer fit the smaller hash table): discovery stops shrinking caps
     # for this plan so grow/shrink cannot oscillate
@@ -301,6 +313,23 @@ def plan_fingerprint(plan: L.LogicalPlan) -> str:
 
     walk(plan)
     return "|".join(parts)
+
+
+def _plan_shareable(plan: L.LogicalPlan) -> bool:
+    """Whether a compiled plan may cross the executor boundary via the
+    process-wide SharedPlanCache. Only DATA-INDEPENDENT compiles may: a
+    non-keyed Staged leaf bakes its batch into the compiled closure
+    under a nonce-only fingerprint, and nonces are unique per
+    ALLOCATOR, not per process — two in-process shuffle workers mint
+    the same nonce and would serve each other's baked partitions as
+    results. Keyed Staged leaves are fine: their batches are runtime
+    inputs (staged_sites) and their fingerprints carry shape + dict
+    content. Scans are fine: data is resolved from the RUNNING
+    executor's catalog per run, and baked string LUTs are keyed by the
+    table's process-unique uid + version."""
+    if isinstance(plan, L.Staged) and plan.key is None:
+        return False
+    return all(_plan_shareable(c) for c in _plan_children(plan))
 
 
 def _worth_sharing(plan) -> bool:
@@ -2104,6 +2133,134 @@ def _cap_tile(n: int) -> int:
     return pad_capacity(n, floor=16, pow2=True)
 
 
+class SharedPlanCache:
+    """Process-wide compiled-plan cache shared across sessions.
+
+    Every PhysicalExecutor keeps its private LRU (below), but executors
+    are per-session / per-connection, so under the serving tier N
+    concurrent sessions would otherwise each pay the XLA compile for
+    the SAME plan shape — the dominant cost "Accelerating Presto with
+    GPUs" (PAPERS.md) identifies at high concurrency. This cache is the
+    cross-session tier: keyed exactly like the private LRU (plan
+    fingerprint + per-scan table-uid/versions — which already folds in
+    the PR 5 keyed-staged fingerprints: staged capacity, logical
+    dtypes, dictionary content) plus the executor's mesh width (a mesh
+    program is not a single-device program). An executor consults it on
+    a private miss and publishes after a compile; entries remember
+    their creating executor so CROSS-session reuse is observable
+    (tidbtpu_executor_shared_plan_cache_cross_session_hits_total — the
+    bench --serve-load acceptance signal).
+
+    Sharing one CompiledQuery across concurrent executors is safe
+    because the steady state is published as one atomic tuple
+    (CompiledQuery.steady) and everything else on the dataclass is
+    written once at compile time.
+
+    Entries are WEAK references: a shared entry lives exactly as long
+    as at least one executor still holds the CompiledQuery in its
+    private LRU. That is the serving scenario (concurrent sessions
+    reuse each other's live compiles) without the pathology of a
+    strong process-global cache — compiled closures capture table
+    readers, so a strong cache would pin whole dead catalogs (every
+    test's, every closed connection's) for the life of the process.
+
+    Misses are SINGLEFLIGHT: the first executor to miss a key CLAIMS
+    it and compiles; concurrent requesters of the same key wait for
+    that one publish instead of stampeding N identical compiles — the
+    flash-crowd case (64 sessions, one dashboard query) pays one
+    compile, and every waiter lands a (cross-session) hit. A claimant
+    that fails releases the claim (abandon, via the caller's finally),
+    and a bounded wait means a wedged claimant degrades a waiter to
+    compiling itself, never to hanging."""
+
+    def __init__(self):
+        import weakref as _wr
+
+        self._cv = racecheck.make_condition("executor.plan_cache")
+        self._map: "_wr.WeakValueDictionary" = _wr.WeakValueDictionary()
+        #: in-flight compiles: (mesh_n, key) -> claiming owner
+        self._pending: Dict[tuple, int] = {}
+
+    def get(self, mesh_n, key: tuple, owner: int, wait_s: float = 120.0):
+        """A hit returns the CompiledQuery. A miss returns None and
+        CLAIMS the key — the caller MUST publish via put() or release
+        via abandon() (exception paths). If another executor holds the
+        claim, block for its publish (same-key waits cannot deadlock:
+        a claimant never re-enters get() for the key it holds)."""
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        k = (mesh_n, key)
+        deadline = None
+        cq = None
+        with self._cv:
+            while True:
+                cq = self._map.get(k)
+                if cq is not None:
+                    break
+                claimant = self._pending.get(k)
+                if claimant is None:
+                    self._pending[k] = owner
+                    break
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + wait_s
+                if now >= deadline:
+                    # claimant wedged: compile ourselves (duplicate
+                    # work, never wrong). No claim taken — the original
+                    # one stands until its publish/abandon.
+                    break
+                self._cv.wait(min(deadline - now, 0.1))
+        if cq is None:
+            REGISTRY.counter(
+                "tidbtpu_executor_shared_plan_cache_misses_total",
+                "shared plan-cache lookups that missed",
+            ).inc()
+            return None
+        REGISTRY.counter(
+            "tidbtpu_executor_shared_plan_cache_hits_total",
+            "compiles avoided via the cross-session plan cache",
+        ).inc()
+        if getattr(cq, "shared_owner", None) != owner:
+            REGISTRY.counter(
+                "tidbtpu_executor_shared_plan_cache_cross_session_hits_total",
+                "shared plan-cache hits on a plan another session compiled",
+            ).inc()
+        return cq
+
+    def put(self, mesh_n, key: tuple, cq, owner: int) -> None:
+        cq.shared_owner = owner  # creator id: cross-session accounting
+        with self._cv:
+            self._map[(mesh_n, key)] = cq
+            self._pending.pop((mesh_n, key), None)
+            self._cv.notify_all()
+
+    def abandon(self, mesh_n, key: tuple, owner: int) -> None:
+        """A claimant's compile failed: release the claim (only the
+        claiming owner's — a waiter that timed out and then failed must
+        not free someone else's live claim) so waiters stop waiting and
+        the next requester claims."""
+        with self._cv:
+            if self._pending.get((mesh_n, key)) == owner:
+                del self._pending[(mesh_n, key)]
+                self._cv.notify_all()
+
+    def invalidate(self, mesh_n, key: tuple) -> None:
+        """Drop one entry (StaleWidthsError: the compiled program's
+        baked bounds no longer cover the data — every session must
+        recompile, not just the one that noticed)."""
+        with self._cv:
+            self._map.pop((mesh_n, key), None)
+
+    def clear(self) -> None:
+        with self._cv:
+            self._map.clear()
+            self._pending.clear()
+            self._cv.notify_all()
+
+
+SHARED_PLAN_CACHE = SharedPlanCache()
+
+
 class PhysicalExecutor:
     """Runs compiled plans. With mesh_devices=N, every plan compiles to a
     single shard_map program over an N-device mesh: scans row-sharded
@@ -2457,6 +2614,25 @@ class PhysicalExecutor:
 
                 key = self._cache_key(plan)
                 cq = None if conservative else self._cache.get(key)
+                shareable = not conservative and _plan_shareable(plan)
+                claimed = False
+                if cq is None and shareable:
+                    # cross-session tier: another session/connection may
+                    # already have compiled this exact plan shape (the
+                    # serving-tier reuse — one compile serves the
+                    # fleet). A miss CLAIMS the key (singleflight):
+                    # publish or abandon below, or waiters stall
+                    cq = SHARED_PLAN_CACHE.get(
+                        self.mesh_n, key, owner=id(self)
+                    )
+                    claimed = cq is None
+                    if cq is not None:
+                        # imported entries honor the same LRU bound as
+                        # compiles, or cross-session hits would grow
+                        # the private cache without limit
+                        while len(self._cache) >= 256:
+                            self._cache.popitem(last=False)
+                        self._cache[key] = cq
                 # flight recorder: plan-cache outcome + plan digest for
                 # the statements_summary attribution (obs/flight.py)
                 from tidb_tpu.obs.flight import FLIGHT
@@ -2467,15 +2643,26 @@ class PhysicalExecutor:
                     REGISTRY.counter("tidbtpu_executor_plan_cache_hits_total").inc()
                 else:
                     REGISTRY.counter("tidbtpu_executor_plan_cache_misses_total").inc()
-                    compiler = PlanCompiler(
-                        self.catalog, resolver=self._resolve,
-                        mesh_n=self.mesh_n, conservative=conservative,
-                    )
-                    cq = compiler.compile(plan)
-                    cq.sig = self.watch_sig(key)
+                    try:
+                        compiler = PlanCompiler(
+                            self.catalog, resolver=self._resolve,
+                            mesh_n=self.mesh_n, conservative=conservative,
+                        )
+                        cq = compiler.compile(plan)
+                        cq.sig = self.watch_sig(key)
+                    except BaseException:
+                        if claimed:
+                            SHARED_PLAN_CACHE.abandon(
+                                self.mesh_n, key, id(self)
+                            )
+                        raise
                     while len(self._cache) >= 256:
                         self._cache.popitem(last=False)
                     self._cache[key] = cq
+                    if shareable:
+                        SHARED_PLAN_CACHE.put(
+                            self.mesh_n, key, cq, owner=id(self)
+                        )
 
                 pins = []
                 try:
@@ -2508,6 +2695,10 @@ class PhysicalExecutor:
             except StaleWidthsError:
                 key = self._cache_key(plan)
                 self._cache.pop(key, None)
+                # stale widths are a property of the PLAN, not of this
+                # executor: evict the shared entry too, or every other
+                # session keeps re-importing the stale program
+                SHARED_PLAN_CACHE.invalidate(self.mesh_n, key)
                 sp = getattr(self, "_stream_plans", {})
                 for k in [k for k in sp if k[0] == key]:
                     sp.pop(k, None)
@@ -2540,27 +2731,36 @@ class PhysicalExecutor:
 
         from tidb_tpu.obs.engine_watch import ENGINE_WATCH, watched_jit
 
-        if cq.jitted is not None and cq.input_shape_key == shape_key:
-            out, needs = cq.jitted(inputs, self._params())
+        # the steady snapshot is read as ONE tuple: under the shared
+        # cross-session plan cache, another executor may republish it
+        # concurrently, and a (program, caps) pair from two different
+        # publishes could accept a truncated output
+        st = cq.steady
+        if st is not None and st[2] == shape_key:
+            st_jitted, st_caps, _sk = st
+            out, needs = st_jitted(inputs, self._params())
             # ONE device->host round trip: output batch + cardinality
             # scalars together. Also warms each array's host-value cache so
             # the session's materialization re-reads are free.
             needs_host = jax.device_get((needs, out))[0]
             ENGINE_WATCH.d2h_batch(out)
-            if not _overflowed(needs_host, cq.caps):
+            if not _overflowed(needs_host, st_caps):
                 return out, cq.out_dicts
-            # data grew past a tile: rediscover
-            cq.jitted = None
+            # data grew past a tile: rediscover (drop the snapshot only
+            # if it is still the one that overflowed)
+            if cq.steady is st:
+                cq.steady = None
+                cq.jitted = None
 
         for _attempt in range(8):
             out, caps = self._discover(cq, inputs)
             nvalid = int(jax.device_get(_count_valid(out.row_valid)))
             out_cap = min(_cap_tile(max(nvalid, 1)), out.capacity)
-            cq.caps = dict(caps)
-            cq.caps[_OUT_NODE] = out_cap
-            cq.input_shape_key = shape_key
+            full_caps = dict(caps)
+            full_caps[_OUT_NODE] = out_cap
+            cq.caps = dict(full_caps)  # warm-start hint for _discover
             program = self._make_program(cq, dict(caps))
-            cq.jitted = watched_jit(
+            jitted = watched_jit(
                 lambda i, pv, _p=program, _oc=out_cap: _steady_step(
                     _p, _oc, i, pv, mesh=self.mesh
                 ),
@@ -2568,14 +2768,18 @@ class PhysicalExecutor:
             )
             # compile + run the steady program now so every later run is a
             # single launch + single fetch
-            out, needs = cq.jitted(inputs, self._params())
+            out, needs = jitted(inputs, self._params())
             needs_host = jax.device_get((needs, out))[0]
             ENGINE_WATCH.d2h_batch(out)
-            if not _overflowed(needs_host, cq.caps):
+            if not _overflowed(needs_host, full_caps):
+                # verified: publish the consistent snapshot atomically
+                # (plus the loose fields for the profiling scripts)
+                cq.jitted = jitted
+                cq.input_shape_key = shape_key
+                cq.steady = (jitted, full_caps, shape_key)
                 return out, cq.out_dicts
             # the post-shrink steady run overflowed: stop shrinking this
             # plan's caps and rediscover from the grown values
-            cq.jitted = None
             cq.no_shrink = True
             for nid, n in needs_host.items():
                 if nid in caps and int(n) > caps[nid]:

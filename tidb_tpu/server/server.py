@@ -39,10 +39,18 @@ class Server:
         host: str = "127.0.0.1",
         port: int = 4000,
         status_port: Optional[int] = None,
+        dcn_scheduler=None,
     ):
         self.catalog = catalog or Catalog()
         self.host = host
         self.port = port
+        # serving tier (PR 8): with a DCNFragmentScheduler attached,
+        # every connection's session routes fragmentable/shuffleable
+        # SELECTs across the worker fleet, gated by the scheduler's
+        # admission controller — the MySQL front end becomes a
+        # multi-tenant entry point to the fleet instead of a funnel
+        # into one local engine
+        self.dcn_scheduler = dcn_scheduler
         self._next_conn_id = [0]
         self._active_conns = 0
         self._lock = racecheck.make_lock("server.conns")
@@ -145,6 +153,8 @@ class Server:
         sess.user = user.lower()
         if db:
             sess.db = db.lower()
+        if self.dcn_scheduler is not None:
+            sess.attach_dcn_scheduler(self.dcn_scheduler)
         io.write_packet(P.ok_packet())
 
         # prepared statements: per-connection registry (reference:
@@ -274,7 +284,12 @@ class Server:
                     )
             except Exception as e:  # error -> ERR packet, connection lives
                 try:
-                    io.write_packet(P.err_packet(1105, str(e)))
+                    # serving-tier admission verdicts (and anything
+                    # else that declares one) carry their own MySQL
+                    # error number — a rejected statement must read as
+                    # a deliberate server verdict, not a generic 1105
+                    errno = int(getattr(e, "mysql_errno", 0) or 1105)
+                    io.write_packet(P.err_packet(errno, str(e)))
                 except OSError:
                     return
 

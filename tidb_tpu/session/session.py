@@ -1396,6 +1396,16 @@ class Session(DDLMixin):
             # host-side blocking builtins (SLEEP) poll this session's
             # killer via the thread-local — KILL/watchdogs reach them
             _sk.set_current(self.killer)
+            # statement priority for the serving tier's admission queue
+            # (parallel/serving.py): HIGH_PRIORITY/LOW_PRIORITY on the
+            # statement, else the tidb_force_priority sysvar
+            self._stmt_priority = self._priority_for(s)
+            # throttle waits paid INSIDE the statement (admission
+            # queue, dispatch-site RU re-acquire) accumulate here and
+            # come off the boundary RU debit — same invariant as the
+            # bill_t0 reset below: billing a wait as RU re-overdraws
+            # the bucket and the group never converges
+            self._bill_exclude_s = 0.0
         bill_t0 = t0
         try:
             if top and self.resource_group != "default":
@@ -1422,6 +1432,19 @@ class Session(DDLMixin):
                 else:
                     self._last_affected = int(getattr(res, "affected", 0) or 0)
             return res
+        except Exception as e:
+            # admission rejections/timeouts (serving.AdmissionRejected,
+            # duck-typed on the attribute to avoid the import) surface
+            # as errors to the client, but the statements_summary row
+            # must still land — with the phase breakdown showing the
+            # queue-wait that led to the verdict, or an operator can
+            # never see WHY the fleet is shedding load
+            if top and getattr(e, "admission_outcome", None):
+                try:
+                    self._observe_stmt(s, time.perf_counter() - t0)
+                except Exception:
+                    pass  # observation must never mask the rejection
+            raise
         finally:
             self._stmt_depth -= 1
             if top:
@@ -1438,10 +1461,36 @@ class Session(DDLMixin):
             if top and bill_t0 is not None:
                 try:
                     self.catalog.resource_groups.debit(
-                        self.resource_group, time.perf_counter() - bill_t0
+                        self.resource_group,
+                        max(
+                            time.perf_counter() - bill_t0
+                            - getattr(self, "_bill_exclude_s", 0.0),
+                            0.0,
+                        ),
                     )
                 except Exception:
                     pass  # billing must never fail the statement
+
+    def _priority_for(self, s) -> str:
+        """Admission priority of one statement: the statement's own
+        HIGH_PRIORITY/LOW_PRIORITY modifier wins, else the
+        tidb_force_priority sysvar maps in (NO_PRIORITY -> medium,
+        DELAYED rides with low, like the reference's mysql.Priority
+        mapping)."""
+        p = getattr(s, "priority", None)
+        if p in ("high", "low"):
+            return p
+        try:
+            forced = str(
+                self.vars.get("tidb_force_priority") or "NO_PRIORITY"
+            ).upper()
+        except Exception:
+            forced = "NO_PRIORITY"
+        return {
+            "HIGH_PRIORITY": "high",
+            "LOW_PRIORITY": "low",
+            "DELAYED": "low",
+        }.get(forced, "medium")
 
     def _maybe_auto_analyze(self, s) -> None:
         """Statement-boundary auto-analyze check (reference: the stats
@@ -3839,37 +3888,101 @@ class Session(DDLMixin):
         from tidb_tpu.utils.memtrack import QuotaExceeded
         from tidb_tpu.utils.sqlkiller import QueryKilled
 
-        try:
-            cols, rows = sched.execute_plan(plan, cut_hint=(kind, cut))
-        except (QueryKilled, QuotaExceeded):
-            # deliberate aborts (KILL QUERY / max_execution_time /
-            # memory quota) raised during the coordinator-local final
-            # stage must surface immediately — re-running the whole
-            # statement locally would delay the abort by a full second
-            # execution and miscount it as a dispatch failure
-            raise
-        except Exception:
-            # the fleet could not serve it (all workers lost, or a
-            # coordinator-only table the workers never loaded): the
-            # local engine still can. Data-currency across the fleet
-            # remains the attach contract (see attach_dcn_scheduler);
-            # this fallback turns hard routing failures into local
-            # execution, not silent wrongness.
-            from tidb_tpu.utils.metrics import REGISTRY
+        # -- serving-tier admission (parallel/serving.py): gate query
+        # START against the fleet device-memory budget, priority/
+        # fairness-queued. The plan fingerprint keys the working-set
+        # estimate (the engine-watch high-water the same shape reached
+        # last time). AdmissionRejected propagates — an overloaded
+        # fleet sheds load as a visible MySQL error (never a local
+        # fallback), and _execute_stmt still records the summary row.
+        ticket = None
+        adm = getattr(sched, "admission", None)
+        if adm is not None:
+            from tidb_tpu.planner.physical import plan_fingerprint
 
-            REGISTRY.counter(
-                "tidbtpu_session_dcn_route_fallbacks_total",
-                "routed SELECTs that fell back to local execution "
-                "after a fleet dispatch failure",
-            ).inc()
-            return None
+            ticket = adm.admit(
+                plan_fingerprint(plan),
+                priority=getattr(self, "_stmt_priority", "medium"),
+                kill_check=self.killer.check,
+            )
+            # queue time is a throttle wait, not engine work: exclude
+            # it from the boundary RU debit (billing it would drive
+            # the group's bucket negative on pure waiting)
+            self._bill_exclude_s = getattr(
+                self, "_bill_exclude_s", 0.0
+            ) + getattr(ticket, "waited_s", 0.0)
+        # -- resource-group RU gate at the DISPATCH site: the statement
+        # boundary already gated once, but under concurrent sessions
+        # the bucket may have been overdrawn while this query sat in
+        # the admission queue — re-acquire so CREATE RESOURCE GROUP
+        # limits govern what actually reaches the fleet. The wait
+        # charges to queue-wait like admission (it IS admission, by
+        # RU instead of bytes).
+        from tidb_tpu.obs.flight import FLIGHT as _FLIGHT
+
+        rg = getattr(self.catalog, "resource_groups", None)
+        throttled = rg is not None and self.resource_group != "default"
+        dispatched = False
+        try:
+            if throttled:
+                waited = rg.acquire(
+                    self.resource_group, kill_check=self.killer.check
+                )
+                if waited > 0:
+                    _FLIGHT.note_phase("queue-wait", waited)
+                    # same exclusion as the admission wait above
+                    self._bill_exclude_s = getattr(
+                        self, "_bill_exclude_s", 0.0
+                    ) + waited
+            try:
+                cols, rows = sched.execute_plan(plan, cut_hint=(kind, cut))
+                dispatched = True
+            except (QueryKilled, QuotaExceeded):
+                # deliberate aborts (KILL QUERY / max_execution_time /
+                # memory quota) raised during the coordinator-local final
+                # stage must surface immediately — re-running the whole
+                # statement locally would delay the abort by a full second
+                # execution and miscount it as a dispatch failure
+                raise
+            except Exception:
+                # the fleet could not serve it (all workers lost, or a
+                # coordinator-only table the workers never loaded): the
+                # local engine still can. Data-currency across the fleet
+                # remains the attach contract (see attach_dcn_scheduler);
+                # this fallback turns hard routing failures into local
+                # execution, not silent wrongness.
+                from tidb_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "tidbtpu_session_dcn_route_fallbacks_total",
+                    "routed SELECTs that fell back to local execution "
+                    "after a fleet dispatch failure",
+                ).inc()
+                return None
+        finally:
+            if ticket is not None:
+                # feed the OBSERVED engine-watch high-water back as
+                # the next admission estimate for this plan shape —
+                # but only from a COMPLETED run: a killed or failed
+                # dispatch's peak is a truncated partial that would
+                # overwrite a learned estimate and let the next N
+                # admissions of this shape overcommit the budget
+                from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+                ticket.release(
+                    observed_bytes=ENGINE_WATCH.current_peak_bytes()
+                    if dispatched else None
+                )
         self._last_dcn_routed = True
-        # snapshot the runtime stats NOW (small dicts, spans elided):
-        # last_query is scheduler-global, so waiting until slow-log
-        # capture would let another session's routed query overwrite
-        # it. Rendering to text stays lazy — _capture_slow_plan runs
-        # only for over-threshold statements.
-        lq = getattr(sched, "last_query", None) or {}
+        # snapshot the runtime stats NOW, from THIS THREAD's query
+        # record (last_query is scheduler-global: under concurrent
+        # sessions another query may already have overwritten it by
+        # the time execute_plan returns). Rendering to text stays lazy
+        # — _capture_slow_plan runs only for over-threshold statements.
+        mine = getattr(sched, "last_query_mine", None)
+        lq = (mine() if callable(mine) else None) or getattr(
+            sched, "last_query", None
+        ) or {}
         snap = {}
         if lq.get("shuffle"):
             snap["shuffle"] = dict(lq["shuffle"])
@@ -3879,6 +3992,25 @@ class Session(DDLMixin):
                 for f in lq["fragments"]
             ]
         self._last_dcn_snapshot = snap
+        if throttled:
+            # RU debit for the FLEET-specific cost the statement
+            # boundary cannot see: the fragment/partition result bytes
+            # that crossed the DCN back to this coordinator (1 RU/KiB,
+            # utils/resgroup.py). Engine-time RU still bills once at
+            # the statement boundary (_execute_stmt's debit) — this
+            # site adds bytes only (count_query=False keeps the
+            # group's query counter at one per statement), so nothing
+            # double-bills.
+            nbytes = sum(
+                int(f.get("bytes", 0)) for f in snap.get("fragments", ())
+            )
+            try:
+                rg.debit(
+                    self.resource_group, 0.0, result_bytes=nbytes,
+                    count_query=False,
+                )
+            except Exception:
+                pass  # billing must never fail the statement
         schema_cols = list(plan.schema)
         types = (
             [c.type for c in schema_cols]

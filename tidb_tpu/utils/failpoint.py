@@ -89,6 +89,7 @@ SITES = frozenset({
     "shuffle/open",
     "shuffle/produce",
     "shuffle/push",
+    "serving/admit",
     "shuffle/push-lost",
     "shuffle/recv",
     "shuffle/recv-ack-lost",

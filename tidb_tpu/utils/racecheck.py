@@ -75,7 +75,12 @@ LOCK_CLASSES: Dict[str, str] = {
     # MPP tier
     "dcn.ledger": "exactly-once fragment ledger records",
     "dcn.scheduler": "scheduler rotation/suspects/last_query telemetry",
-    "dcn.conn": "one coordinator->worker connection's RPC stream",
+    "dcn.pool": "one endpoint's control-connection pool (condition)",
+    "serving.admission": "admission queue/budget state (condition)",
+    "serving.qid": "strictly-unique qid/nonce allocation",
+    "serving.load": "serve-load driver's client latency/error lists",
+    "executor.plan_cache": "process-wide shared compiled-plan cache "
+                           "(condition: singleflight compile claims)",
     "shuffle.store": "receiver stage/stream buffers (condition)",
     "shuffle.tunnel": "one peer tunnel's queue + in-flight window "
                       "(condition)",
@@ -112,6 +117,7 @@ THREAD_NAME_PREFIXES = frozenset({
     "http",
     "logbackup",
     "mysql",
+    "serve",
     "shuffle",
     "stats",
     "ttl",

@@ -119,9 +119,16 @@ class ResourceGroupManager:
                 )
             time.sleep(0.01)
 
-    def debit(self, name: str, elapsed_s: float, result_bytes: int = 0):
+    def debit(
+        self, name: str, elapsed_s: float, result_bytes: int = 0,
+        count_query: bool = True,
+    ):
         """Post-statement RU consumption: the bucket may go negative —
-        the NEXT statement in the group then waits it out."""
+        the NEXT statement in the group then waits it out.
+        ``count_query=False`` bills RU without bumping the group's
+        query counter — for supplemental charges within one statement
+        (the DCN dispatch site's result-bytes debit) that would
+        otherwise double-count it."""
         from tidb_tpu.utils.failpoint import inject
 
         inject("resgroup/debit")
@@ -134,7 +141,8 @@ class ResourceGroupManager:
             if g.ru_per_sec:
                 g.tokens -= ru
             g.consumed_ru += ru
-            g.queries += 1
+            if count_query:
+                g.queries += 1
         return ru
 
     def rows(self):
