@@ -221,6 +221,33 @@ def _emit_metrics(args, result, before: dict, after=None) -> None:
                 f, indent=1,
             )
     _write_flight_out(args)
+    _write_timeline_out(args)
+
+
+def _start_timeline(args) -> bool:
+    """Arm the fleet timeline tracer when --timeline-out asked for a
+    capture (any bench mode). Returns whether a capture is live."""
+    if not getattr(args, "timeline_out", None):
+        return False
+    from tidb_tpu.obs.timeline import TIMELINE
+
+    TIMELINE.start()
+    return True
+
+
+def _write_timeline_out(args) -> None:
+    """--timeline-out: dump the captured fleet timeline as Chrome
+    trace-event JSON (open the file in Perfetto / chrome://tracing).
+    One process track per host, thread tracks per session/worker task,
+    counter tracks from the sampled gauges."""
+    path = getattr(args, "timeline_out", None)
+    if not path:
+        return
+    from tidb_tpu.obs.timeline import TIMELINE
+
+    TIMELINE.stop()
+    with open(path, "w") as f:
+        json.dump(TIMELINE.dump(), f)
 
 
 def _write_flight_out(args) -> None:
@@ -249,6 +276,8 @@ def _write_flight_out(args) -> None:
 def measure(args) -> int:
     if os.environ.get("TIDB_TPU_BENCH_CPU") == "1":
         _force_cpu_in_process()
+
+    _start_timeline(args)
 
     import numpy as np
 
@@ -780,6 +809,8 @@ def measure_multihost_shuffle(args) -> int:
     import re
     import statistics
 
+    timeline_on = _start_timeline(args)
+
     from tidb_tpu.bench import load_tpch
     from tidb_tpu.parallel.dcn import DCNFragmentScheduler
     from tidb_tpu.parser.sqlparse import parse
@@ -1147,10 +1178,36 @@ def measure_multihost_shuffle(args) -> int:
                 },
             },
         }
+        if timeline_on:
+            # the trace PROVES the overlap claim: pipelined tasks'
+            # produce/push windows intersect, the barrier escape
+            # hatch's do not (per-track report from the captured
+            # worker events, PERF_NOTES "reading a timeline")
+            from tidb_tpu.obs.timeline import (
+                TIMELINE,
+                shuffle_overlap_report,
+            )
+
+            rep = shuffle_overlap_report(TIMELINE.events())
+            result["detail"]["timeline"] = {
+                "hosts": TIMELINE.dump()["otherData"]["hosts"],
+                "events": len(TIMELINE),
+                "produce_push_overlap_s_pipelined": round(max(
+                    (r["produce_push_overlap_s"]
+                     for r in rep.values() if r["pipeline"]),
+                    default=0.0,
+                ), 6),
+                "produce_push_overlap_s_barrier": round(max(
+                    (r["produce_push_overlap_s"]
+                     for r in rep.values() if not r["pipeline"]),
+                    default=0.0,
+                ), 6),
+            }
     finally:
         for p in workers:
             p.kill()
     _write_flight_out(args)
+    _write_timeline_out(args)
     rc = 0
     if args.out:
         args.cpu = True  # deliberate CPU scenario: not a fallback
@@ -1198,6 +1255,16 @@ def main() -> int:
         "(p50/p95/p99 + mean phase breakdown + engine columns) and the "
         "DCN link registry — to this JSON file (the information_schema "
         "breakdown, captured for the bench ladder)",
+    )
+    ap.add_argument(
+        "--timeline-out", default=None, metavar="FILE",
+        help="capture the fleet timeline across the run and write it "
+        "as Chrome trace-event JSON (open in Perfetto / "
+        "chrome://tracing): one process track per host, thread tracks "
+        "per session/worker task, counter tracks from existing gauges;"
+        " works in every mode incl. --serve-load and "
+        "--multihost-shuffle (worker events ship back on the fenced "
+        "replies, rebased through the handshake clock offsets)",
     )
     ap.add_argument(
         "--multihost-shuffle", action="store_true",
@@ -1270,6 +1337,8 @@ def main() -> int:
         passthrough += ["--metrics-out", args.metrics_out]
     if args.flight_out:
         passthrough += ["--flight-out", args.flight_out]
+    if args.timeline_out:
+        passthrough += ["--timeline-out", args.timeline_out]
     return supervise(args, passthrough)
 
 

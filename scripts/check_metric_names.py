@@ -29,7 +29,8 @@ import sys
 #: shuffle = worker-to-worker data plane, engine = TPU engine watch,
 #: flight = the query flight recorder, link = per-peer DCN link health
 #: (both PR 6), admission = the serving tier's fleet admission
-#: controller (PR 8, parallel/serving.py).
+#: controller (PR 8, parallel/serving.py), timeline = the fleet
+#: timeline tracer (PR 9, obs/timeline.py).
 SUBSYSTEMS = frozenset({
     "admission",
     "dcn",
@@ -40,6 +41,7 @@ SUBSYSTEMS = frozenset({
     "session",
     "shuffle",
     "stats",
+    "timeline",
     "ttl",
     "watchdog",
 })

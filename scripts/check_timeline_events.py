@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Lint: timeline event categories declared in obs/timeline.py
+EVENT_CATEGORIES must match the literal ``emit_event(...)`` /
+``emit_counter(...)`` call sites, and every declared category must be
+emitted somewhere.
+
+Why: the category vocabulary is an API — the Chrome trace's ``cat``
+field (Perfetto filters on it), the shuffle_overlap_report analysis
+and the /timeline consumers all key on it. ``emit_event`` already
+rejects undeclared categories at runtime, but a dead declaration (a
+category nothing emits) silently rots into an empty track; the same
+pattern as scripts/check_flight_phases.py for flight PHASES. Two
+rules:
+
+  1. every literal ``emit_event("cat", ...)`` / ``emit_counter("cat",
+     ...)`` site in engine code must name a declared category (the
+     runtime check made static);
+  2. every name in EVENT_CATEGORIES must have at least one literal
+     emit site.
+
+Usage: python scripts/check_timeline_events.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+EMIT = re.compile(r"\b(?:emit_event|emit_counter)\(\s*[\"']([^\"']+)[\"']")
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules"}
+#: the lint and its test quote undeclared categories deliberately
+SKIP_FILES = {
+    os.path.join("scripts", "check_timeline_events.py"),
+    os.path.join("tests", "test_timeline.py"),
+}
+
+
+def load_categories(root: str):
+    """The EVENT_CATEGORIES literal, read via the AST (timeline.py
+    imports the package; exec'ing it standalone would need the whole
+    engine importable from the lint)."""
+    path = os.path.join(root, "tidb_tpu", "obs", "timeline.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "EVENT_CATEGORIES"
+            for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise SystemExit(f"EVENT_CATEGORIES assignment not found in {path}")
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check(root: str):
+    cats = load_categories(root)
+    declared = set(cats)
+    if len(cats) != len(declared):
+        return [
+            ("tidb_tpu/obs/timeline.py", 1,
+             "duplicate names in EVENT_CATEGORIES")
+        ]
+    violations = []
+    used = {}
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        if rel in SKIP_FILES:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in EMIT.finditer(text):
+            name = m.group(1)
+            line = text.count("\n", 0, m.start()) + 1
+            used.setdefault(name, (rel, line))
+            if name not in declared:
+                violations.append(
+                    (rel, line,
+                     f"undeclared timeline category {name!r} (declare "
+                     "it in tidb_tpu/obs/timeline.py EVENT_CATEGORIES)")
+                )
+    for name in cats:
+        if name not in used:
+            violations.append(
+                ("tidb_tpu/obs/timeline.py", 1,
+                 f"declared timeline category {name!r} has no "
+                 "emit_event()/emit_counter() call site (dead "
+                 "declaration)")
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} timeline-event violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

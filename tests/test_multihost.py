@@ -556,6 +556,145 @@ def test_dcn_many_session_serving_dryrun(tpch_single):
             w.kill()
 
 
+def test_dcn_timeline_trace_cross_host(tpch_single):
+    """PR 9 acceptance: a 2-process x 4-device shuffle dryrun captured
+    by the fleet timeline tracer produces a VALID Chrome trace with:
+
+    - process tracks for the coordinator AND both worker hosts (worker
+      events ship piggybacked on the fenced replies);
+    - clock-offset monotonicity: no worker event starts before its
+      fragment's dispatch event on the rebased coordinator timeline;
+    - the overlap proof: pipelined tasks' produce/push windows overlap
+      in time, the barrier escape hatch's do not;
+    - compile events carrying non-empty XLA cost_analysis attributes,
+      and the per-digest cost columns populated in statements_summary.
+    """
+    import json as _json
+
+    from tidb_tpu.obs.timeline import (
+        TIMELINE,
+        shuffle_overlap_report,
+    )
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.planner.physical import SHARED_PLAN_CACHE
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    worker_addrs = {f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"}
+    q = SHUFFLE_QUERIES[0]
+    exp = tpch_single.must_query(q).rows
+    # the compile-event assertion needs a REAL coordinator compile
+    # under capture: an earlier test in the session may still pin this
+    # final-stage shape in the process-wide shared plan cache (weak
+    # entries live as long as any executor's LRU does), which would
+    # make the fresh scheduler import instead of compile
+    SHARED_PLAN_CACHE._map.clear()
+    TIMELINE.start(capacity=65536)
+    try:
+        for pipeline in (True, False):
+            sched = DCNFragmentScheduler(
+                [("127.0.0.1", p1), ("127.0.0.1", p2)],
+                catalog=tpch_single.catalog,
+                shuffle_mode="always",
+                shuffle_pipeline=pipeline,
+            )
+            try:
+                for _ in range(2):
+                    _cols, got = sched.execute_plan(
+                        _plan(tpch_single, q)
+                    )
+                    assert got == exp
+            finally:
+                sched.close()
+        TIMELINE.stop()
+
+        # -- valid Chrome trace JSON with both hosts' process tracks --
+        trace = _json.loads(
+            _json.dumps(TIMELINE.dump())  # round-trips (serializable)
+        )
+        evs = trace["traceEvents"]
+        procs = {
+            e["args"]["name"]
+            for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert "coordinator" in procs
+        assert worker_addrs <= procs, (
+            f"missing worker process tracks: {procs}"
+        )
+        for e in evs:
+            if e.get("ph") == "X":
+                assert isinstance(e["ts"], float) and e["ts"] >= 0
+                assert isinstance(e["dur"], float) and e["dur"] >= 0
+                assert e["cat"] and e["name"] and e["pid"]
+
+        # -- clock-offset monotonicity --------------------------------
+        raw = TIMELINE.events()
+        dispatches = {}
+        for ph, cat, name, t0, dur, host, track, args in raw:
+            if ph == "X" and cat == "fragment" and args and (
+                name.startswith("dispatch")
+            ):
+                key = (args["host"], f"q{args['qid']}/{args['unit']}")
+                dispatches[key] = min(
+                    dispatches.get(key, t0), t0
+                )
+        assert dispatches, "no coordinator dispatch events captured"
+        checked = 0
+        for ph, cat, name, t0, dur, host, track, args in raw:
+            if ph != "X" or host not in worker_addrs:
+                continue
+            if cat not in ("shuffle", "fragment"):
+                continue
+            d0 = dispatches.get((host, track))
+            if d0 is None:
+                continue
+            checked += 1
+            assert t0 >= d0 - 0.05, (
+                f"worker event {name} on {host}/{track} starts "
+                f"{d0 - t0:.3f}s before its dispatch (clock rebase "
+                "broke monotonicity)"
+            )
+        assert checked > 0, "no worker events matched a dispatch"
+
+        # -- overlap: pipelined yes, barrier no -----------------------
+        rep = shuffle_overlap_report(raw)
+        pipe_overlap = max(
+            (r["produce_push_overlap_s"]
+             for r in rep.values() if r["pipeline"]),
+            default=0.0,
+        )
+        barrier_tracks = [
+            r for r in rep.values()
+            if not r["pipeline"] and r["push_windows"]
+        ]
+        assert pipe_overlap > 0.0, (
+            f"pipelined produce/push windows never overlapped: {rep}"
+        )
+        # tolerance: event windows mix a wall-clock start with a
+        # perf_counter duration, so strictly-sequential barrier phases
+        # can show microsecond-scale numeric overlap — anything at ms
+        # scale would be REAL overlap and a bug
+        assert barrier_tracks and all(
+            r["produce_push_overlap_s"] < 0.005 for r in barrier_tracks
+        ), f"barrier stage shows overlap: {rep}"
+
+        # -- compile events carry cost analysis -----------------------
+        compile_costs = [
+            (args or {}).get("cost_analysis")
+            for ph, cat, name, t0, dur, host, track, args in raw
+            if ph == "X" and cat == "compile"
+        ]
+        assert any(
+            c and c.get("flops", 0) > 0 for c in compile_costs
+        ), "no compile event carries non-empty cost_analysis"
+    finally:
+        TIMELINE.stop()
+        TIMELINE.clear()
+        for w in (w1, w2):
+            w.kill()
+
+
 def test_dcn_worker_death_mid_shuffle_retry_parity(tpch_single):
     """Failpoint-killed worker MID-SHUFFLE with PIPELINING ON: worker 2
     hard-exits on the first partition packet a peer pushes to it (the

@@ -1,0 +1,593 @@
+"""Fleet timeline tracer (PR 9): recorder semantics, Chrome trace
+export, cross-host merge/rebase, XLA compile cost analysis, the
+declared-category lint, worker-reported admission peaks, and the
+Tracer.add_remote relative-depth fix.
+
+The 2-process trace (trace validity, clock-offset monotonicity,
+pipelined-vs-barrier overlap) lives in tests/test_multihost.py; this
+file covers everything testable in-process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_timeline_events.py")
+
+
+def _reset_recorder():
+    """Stop, clear AND restore the default ring size — a test that
+    shrank the ring (capacity tests, the /timeline?capacity endpoint)
+    must not leave later tests evicting their own events."""
+    from tidb_tpu.obs.timeline import TIMELINE
+
+    TIMELINE.start(capacity=65536)
+    TIMELINE.stop()
+    TIMELINE.clear()
+
+
+@pytest.fixture()
+def timeline():
+    """A started recorder (default-sized ring), fully reset afterwards
+    so the capture (and its cost-analysis harvesting side effect)
+    never leaks into other tests."""
+    from tidb_tpu.obs import engine_watch
+    from tidb_tpu.obs.timeline import TIMELINE
+
+    TIMELINE.stop()
+    TIMELINE.clear()
+    TIMELINE.start(capacity=65536)
+    try:
+        yield TIMELINE
+    finally:
+        _reset_recorder()
+        engine_watch.set_cost_analysis(False)
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_undeclared_category_rejected_even_when_inactive(self):
+        from tidb_tpu.obs.timeline import TIMELINE, TimelineBuffer
+
+        assert not TIMELINE.active() or True  # state-independent check
+        with pytest.raises(ValueError, match="undeclared timeline"):
+            TIMELINE.emit_event("no-such-cat", "x", 0.0, 1.0)
+        with pytest.raises(ValueError, match="undeclared timeline"):
+            TIMELINE.emit_counter("no-such-cat", "x", 1.0)
+        with pytest.raises(ValueError, match="undeclared timeline"):
+            TimelineBuffer().emit_event("no-such-cat", "x", 0.0, 1.0)
+
+    def test_inactive_recorder_drops_events(self):
+        from tidb_tpu.obs.timeline import TIMELINE
+
+        TIMELINE.stop()
+        TIMELINE.clear()
+        TIMELINE.emit_event("phase", "parse", time.time(), 0.1)
+        assert len(TIMELINE) == 0
+
+    def test_ring_bound(self, timeline):
+        timeline.start(capacity=32)
+        for i in range(100):
+            timeline.emit_event("phase", f"e{i}", time.time(), 0.001)
+        assert len(timeline) == 32
+        # newest kept
+        names = [e[2] for e in timeline.events()]
+        assert names[-1] == "e99" and names[0] == "e68"
+
+    def test_dump_is_valid_chrome_trace(self, timeline):
+        t0 = time.time()
+        timeline.emit_event(
+            "statement", "select 1", t0, 0.25, track="conn-7",
+            args={"qid": 1},
+        )
+        timeline.emit_event(
+            "fragment", "execute q1/f0", t0 + 0.05, 0.1,
+            host="worker-a:9000", track="q1/f0",
+        )
+        timeline.emit_counter("counter", "tidbtpu_admission_queue_depth", 3)
+        trace = json.loads(timeline.dump_json())
+        evs = trace["traceEvents"]
+        # process metadata for both hosts, thread metadata for tracks
+        procs = {
+            e["args"]["name"]: e["pid"]
+            for e in evs if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert procs["coordinator"] == 1
+        assert "worker-a:9000" in procs and procs["worker-a:9000"] != 1
+        threads = [
+            e for e in evs if e["ph"] == "M" and e["name"] == "thread_name"
+        ]
+        assert {t["args"]["name"] for t in threads} >= {"conn-7", "q1/f0"}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert len(xs) == 2
+        for e in xs:
+            assert e["ts"] >= 0 and e["dur"] > 0  # microseconds
+        cs = [e for e in evs if e["ph"] == "C"]
+        assert len(cs) == 1 and cs[0]["args"]["value"] == 3.0
+        # the statement event window is where we put it (µs precision)
+        stmt = next(e for e in xs if e["cat"] == "statement")
+        assert abs(stmt["dur"] - 0.25e6) < 1.0
+
+    def test_merge_remote_rebases_and_drops_malformed(self, timeline):
+        from tidb_tpu.obs.timeline import TimelineBuffer
+
+        buf = TimelineBuffer()
+        t_worker = time.time() + 5.0  # worker clock runs 5s ahead
+        buf.emit_event("shuffle", "produce#0", t_worker, 0.1, track="q1/p0")
+        n = timeline.merge_remote(
+            buf.events + [["bogus-cat", "x", 0, 0, "", None], ["short"]],
+            host="w:1", offset_s=5.0,
+        )
+        assert n == 1  # malformed records dropped, not raised
+        ev = [e for e in timeline.events() if e[1] == "shuffle"][0]
+        # rebased back onto the coordinator clock: offset removed
+        assert abs(ev[3] - (t_worker - 5.0)) < 1e-6
+        assert ev[5] == "w:1"
+
+    def test_buffer_bound(self):
+        from tidb_tpu.obs.timeline import TimelineBuffer
+
+        buf = TimelineBuffer(capacity=8)
+        for i in range(20):
+            buf.emit_event("shuffle", f"e{i}", 0.0, 1.0)
+        assert len(buf.events) == 8
+
+    def test_overlap_report_math(self):
+        from tidb_tpu.obs.timeline import (
+            _window_overlap,
+            shuffle_overlap_report,
+        )
+
+        assert _window_overlap([(0.0, 1.0)], [(0.5, 1.0)]) == pytest.approx(0.5)
+        assert _window_overlap([(0.0, 1.0)], [(2.0, 1.0)]) == 0.0
+        # two overlapping pairs over the same region: not double-counted
+        assert _window_overlap(
+            [(0.0, 1.0), (0.2, 0.8)], [(0.5, 1.0)]
+        ) == pytest.approx(0.5)
+        events = [
+            ("X", "shuffle", "produce#0", 0.0, 1.0, "w", "q1/p0",
+             {"pipeline": True}),
+            ("X", "shuffle", "push#0", 0.6, 1.0, "w", "q1/p0",
+             {"pipeline": True}),
+            ("X", "shuffle", "produce#0", 10.0, 1.0, "w", "q2/p0",
+             {"pipeline": False}),
+            ("X", "shuffle", "push#0", 11.5, 1.0, "w", "q2/p0",
+             {"pipeline": False}),
+        ]
+        rep = shuffle_overlap_report(events)
+        assert rep["w/q1/p0"]["pipeline"] is True
+        assert rep["w/q1/p0"]["produce_push_overlap_s"] == pytest.approx(0.4)
+        assert rep["w/q2/p0"]["produce_push_overlap_s"] == 0.0
+
+    def test_sample_gauges_emits_declared_counter_tracks(self, timeline):
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.gauge(
+            "tidbtpu_admission_queue_depth", "queries waiting for admission"
+        ).set(7)
+        timeline.sample_gauges()
+        cs = [e for e in timeline.events() if e[0] == "C"]
+        assert any(
+            e[2] == "tidbtpu_admission_queue_depth" and e[4] == 7.0
+            for e in cs
+        )
+
+
+# ---------------------------------------------------------------------------
+# XLA compile cost analysis
+# ---------------------------------------------------------------------------
+
+
+class TestCompileCost:
+    def test_watched_jit_harvests_cost_once_per_sig(self, timeline):
+        import jax.numpy as jnp
+
+        from tidb_tpu.obs import engine_watch as ew
+
+        sig = ("test-cost", time.time())  # unique per run
+        calls = []
+        orig = ew._harvest_cost
+
+        def counting(j, a, k):
+            calls.append(1)
+            return orig(j, a, k)
+
+        ew._harvest_cost = counting
+        try:
+            j = ew.watched_jit(lambda x: (x * 2 + 1).sum(), sig=sig)
+            j(jnp.arange(16.0))
+            j(jnp.arange(16.0))          # cache hit: no trace
+            j(jnp.arange(32.0))          # retrace: cached cost reused
+        finally:
+            ew._harvest_cost = orig
+        assert len(calls) == 1
+        cost = ew.ENGINE_WATCH.cost_for_sig(sig)
+        assert cost and cost["flops"] > 0 and cost["bytes_accessed"] > 0
+        # the compile landed as a timeline event carrying the cost
+        compiles = [
+            e for e in timeline.events() if e[1] == "compile" and e[7]
+        ]
+        assert any(
+            (e[7].get("cost_analysis") or {}).get("flops", 0) > 0
+            for e in compiles
+        )
+
+    def test_no_harvest_when_disabled(self):
+        import jax.numpy as jnp
+
+        from tidb_tpu.obs import engine_watch as ew
+        from tidb_tpu.obs.timeline import TIMELINE
+
+        TIMELINE.stop()
+        ew.set_cost_analysis(False)
+        assert not ew.cost_analysis_enabled()
+        sig = ("test-cost-off", time.time())
+        j = ew.watched_jit(lambda x: x + 1, sig=sig)
+        j(jnp.arange(4.0))
+        assert ew.ENGINE_WATCH.cost_for_sig(sig) is None
+
+    def test_extract_cost_keys_is_key_guarded(self):
+        from tidb_tpu.obs.engine_watch import extract_cost_keys
+
+        # CPU lowered-analysis shape
+        cpu = {"flops": 23.0, "bytes accessed": 304.0,
+               "bytes accessedout{}": 132.0, "utilization0{}": 5.0}
+        assert extract_cost_keys(cpu) == {
+            "flops": 23.0, "bytes_accessed": 304.0, "output_bytes": 132.0,
+        }
+        # TPU compiled-analysis shape: a list, different key spelling
+        tpu = [{"flops": 9.0, "bytes accessed output": 8.0}]
+        assert extract_cost_keys(tpu) == {
+            "flops": 9.0, "output_bytes": 8.0,
+        }
+        # garbage in, empty out — never raises
+        assert extract_cost_keys(None) == {}
+        assert extract_cost_keys([]) == {}
+        assert extract_cost_keys({"flops": float("nan")}) == {}
+        assert extract_cost_keys({"flops": "x"}) == {}
+
+    def test_cost_lands_in_statements_summary_and_tpu_engine(self, timeline):
+        from tidb_tpu.session import Session
+        from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+        s = Session()
+        s.execute("create table tcost (a int, b int)")
+        s.execute("insert into tcost values (1,2),(3,4),(5,6)")
+        q = "select sum(a * b + 1) from tcost where a > 0"
+        r = s.must_query(q)
+        assert r.rows == [(1 * 2 + 3 * 4 + 5 * 6 + 3,)]
+        ent = next(
+            e for e in STMT_SUMMARY.rows_full()
+            if e["digest_text"] == sql_digest(q)
+        )
+        assert ent["compile_flops"] > 0
+        assert ent["compile_bytes_accessed"] > 0
+        # the SQL surface exposes the columns
+        r = s.must_query(
+            "select compile_flops, compile_bytes_accessed from"
+            " information_schema.statements_summary where digest_text ="
+            f" '{sql_digest(q)}'"
+        )
+        assert r.rows[0][0] > 0 and r.rows[0][1] > 0
+        r = s.must_query(
+            "select compile_flops from information_schema.tpu_engine"
+            " where compile_flops > 0"
+        )
+        assert len(r.rows) >= 1
+
+    def test_explain_analyze_compile_row(self):
+        from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+        from tidb_tpu.session.session import _compile_cost_lines
+
+        ENGINE_WATCH.begin_query("test-explain-cost")
+        try:
+            ENGINE_WATCH.note_compile_cost(
+                ("ea", 1), {"flops": 123.0, "bytes_accessed": 456.0},
+            )
+            ENGINE_WATCH.current().jit_compilations = 2
+            (line,) = _compile_cost_lines()
+            assert line.startswith("XLACompile compiles=2")
+            assert "flops=123" in line and "bytes_accessed=456" in line
+        finally:
+            ENGINE_WATCH.end_query(0.0)
+        # no record open -> no row (a warm run reports nothing)
+        assert _compile_cost_lines() == []
+
+    def test_frag_stats_compile_suffix(self):
+        from tidb_tpu.planner.physical import _compile_cost_suffix
+
+        frags = [
+            {"compile": {"flops": 10.0, "bytes_accessed": 100.0}},
+            {"compile": None},
+            {},
+        ]
+        s = _compile_cost_suffix(frags)
+        assert "compile_flops=10" in s and "compile_bytes_accessed=100" in s
+        assert _compile_cost_suffix([{}, {"compile": None}]) == ""
+
+
+# ---------------------------------------------------------------------------
+# sysvar + endpoint surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_sysvar_starts_and_stops_capture(self):
+        from tidb_tpu.obs.timeline import TIMELINE
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create table tv (a int)")
+        s.execute("insert into tv values (1),(2)")
+        try:
+            s.execute("set tidb_timeline_capture = 1")
+            assert TIMELINE.active()
+            s.must_query("select sum(a) from tv")
+            s.execute("set tidb_timeline_capture = 0")
+            assert not TIMELINE.active()
+            cats = {e[1] for e in TIMELINE.events()}
+            # the statement span and its phase charges were captured
+            assert "statement" in cats and "phase" in cats
+        finally:
+            _reset_recorder()
+
+    def test_http_timeline_endpoint(self):
+        import urllib.request
+
+        from tidb_tpu.obs.timeline import TIMELINE
+        from tidb_tpu.server.http_status import StatusServer
+        from tidb_tpu.storage import Catalog
+
+        http = StatusServer(Catalog(), port=0)
+        http.start_background()
+        try:
+            def get(path):
+                return json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}{path}", timeout=10
+                ).read().decode())
+
+            st = get("/timeline/start?capacity=128")
+            assert st["active"] is True
+            TIMELINE.emit_event("phase", "parse", time.time(), 0.01)
+            trace = get("/timeline")
+            assert any(
+                e.get("ph") == "X" and e.get("name") == "parse"
+                for e in trace["traceEvents"]
+            )
+            st = get("/timeline/stop")
+            assert st["active"] is False and st["events"] >= 1
+        finally:
+            _reset_recorder()
+            http.shutdown()
+
+    def test_admission_controller_from_sysvars(self):
+        from tidb_tpu.parallel.serving import AdmissionController
+        from tidb_tpu.utils.sysvar import SysVars
+
+        sv = SysVars({})
+        sv.set("tidb_tpu_admission_budget_bytes", 123 << 20, "global")
+        sv.set("tidb_tpu_admission_queue_limit", 7, "global")
+        sv.set("tidb_tpu_admission_starvation_s", 2.5, "global")
+        adm = AdmissionController.from_sysvars(sv, queue_timeout_s=1.0)
+        assert adm.budget_bytes == 123 << 20
+        assert adm.max_queue == 7
+        assert adm.starvation_s == 2.5
+        assert adm.queue_timeout_s == 1.0
+        # defaults flow when nothing is set
+        adm2 = AdmissionController.from_sysvars(SysVars({}))
+        assert adm2.budget_bytes == 2 << 30 and adm2.max_queue == 256
+
+    def test_set_admission_sysvar_retunes_attached_controller(self):
+        from tidb_tpu.parallel.serving import AdmissionController
+        from tidb_tpu.session import Session
+
+        class _Sched:
+            admission = AdmissionController()
+
+        s = Session()
+        s.attach_dcn_scheduler(_Sched())
+        try:
+            s.execute(f"set tidb_tpu_admission_budget_bytes = {64 << 20}")
+            assert _Sched.admission.budget_bytes == 64 << 20
+            s.execute("set tidb_tpu_admission_queue_limit = 3")
+            assert _Sched.admission.max_queue == 3
+            s.execute("set tidb_tpu_admission_starvation_s = 1.5")
+            assert _Sched.admission.starvation_s == 1.5
+        finally:
+            s.attach_dcn_scheduler(None)
+
+
+# ---------------------------------------------------------------------------
+# worker-reported device-mem peaks (ROADMAP PR 8 item)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerPeaks:
+    def test_fragment_reply_carries_worker_peak(self):
+        """An in-process EngineServer's fragment reply ships the
+        worker's OWN engine-watch device-mem high-water (and the
+        scheduler folds the max into its per-query snapshot)."""
+        from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+        from tidb_tpu.parser.sqlparse import parse
+        from tidb_tpu.planner.logical import build_query
+        from tidb_tpu.server.engine_rpc import EngineServer
+        from tidb_tpu.session import Session
+
+        sess = Session()
+        sess.execute("create table tw (a int, b int)")
+        sess.execute(
+            "insert into tw values " + ",".join(
+                f"({i},{i % 5})" for i in range(64)
+            )
+        )
+        servers = [EngineServer(sess.catalog, port=0) for _ in range(2)]
+        for srv in servers:
+            srv.start_background()
+        sched = DCNFragmentScheduler(
+            [("127.0.0.1", srv.port) for srv in servers],
+            catalog=sess.catalog,
+        )
+        try:
+            q = "select b, count(*), sum(a) from tw group by b order by b"
+            plan = build_query(
+                parse(q)[0], sess.catalog, "test", sess._scalar_subquery
+            )
+            exp = sess.must_query(q).rows
+            _cols, got = sched.execute_plan(plan)
+            assert got == exp
+            lq = sched.last_query_mine()
+            assert lq["worker_mem_peak"] > 0
+            assert all(
+                f["mem_peak"] > 0 for f in lq["fragments"]
+            )
+        finally:
+            sched.close()
+            for srv in servers:
+                srv.shutdown()
+
+    def test_worker_heavier_plan_raises_learned_estimate(self):
+        """The admission estimate learns max(coordinator peak, worker
+        peaks): a plan whose workers see a bigger working set than the
+        coordinator's final stage must not under-estimate."""
+        from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+        from tidb_tpu.parallel.serving import AdmissionController
+
+        adm = AdmissionController(default_estimate_bytes=1 << 20)
+        # coordinator-eyed release (the pre-PR 9 behavior): 2 MiB
+        t = adm.admit("shape-x")
+        t.release(observed_bytes=2 << 20)
+        assert adm.estimate("shape-x") == 2 << 20
+        # the same shape reports a worker-eyed 32 MiB peak: the
+        # session releases max(coordinator, worker) — the estimate
+        # RISES to the fleet-eyed number
+        infos = [
+            {"mem_peak": 32 << 20}, {"mem_peak": 8 << 20},
+        ]
+        worker_peak = DCNFragmentScheduler._worker_mem_peak(infos)
+        assert worker_peak == 32 << 20
+        t = adm.admit("shape-x")
+        t.release(observed_bytes=max(2 << 20, worker_peak))
+        assert adm.estimate("shape-x") == 32 << 20
+
+
+# ---------------------------------------------------------------------------
+# Tracer.add_remote relative depth (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+class TestAddRemoteDepth:
+    def test_two_level_worker_span_stays_nested(self):
+        from tidb_tpu.utils.tracing import Span, Tracer
+
+        tr = Tracer()
+        tr.enabled = True
+        tr.reset()
+        # a worker whose handler nested spans ships depths 2 and 3;
+        # the old clamp kept them ABSOLUTE (phantom parents in the
+        # merged trace) — relative depth under the host label is what
+        # must survive
+        tr.add_remote(
+            [("outer", 0.0, 1.0, 2), ("inner", 0.1, 0.5, 3)], "w1"
+        )
+        d = {s.name: s.depth for s in tr.spans}
+        assert d["w1:outer"] == 1
+        assert d["w1:inner"] == 2
+        rows = tr.rows()
+        assert rows[0][0] == "w1:outer"           # no indent
+        assert rows[1][0] == "  w1:inner"         # nested one level
+
+    def test_flat_span_and_span_objects(self):
+        from tidb_tpu.utils.tracing import Span, Tracer
+
+        tr = Tracer()
+        tr.add_remote([Span("only", 0.0, 1.0, 4)], "w2", base_s=2.0)
+        (s,) = tr.spans
+        assert s.depth == 1 and s.start_s == 2.0
+        tr.add_remote([], "w3")  # empty list: no-op, no crash
+
+    def test_base_depth_offsets_whole_group(self):
+        from tidb_tpu.utils.tracing import Tracer
+
+        tr = Tracer()
+        tr.add_remote(
+            [("a", 0.0, 1.0, 1), ("b", 0.0, 0.5, 2)], "w", base_depth=3
+        )
+        d = {s.name: s.depth for s in tr.spans}
+        assert d["w:a"] == 3 and d["w:b"] == 4
+
+
+# ---------------------------------------------------------------------------
+# the declared-category lint (tier-1 gate for check_timeline_events.py)
+# ---------------------------------------------------------------------------
+
+
+def run_lint(root):
+    return subprocess.run(
+        [sys.executable, LINT, str(root)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def _fixture_tree(tmp_path, categories, body):
+    obs = tmp_path / "tidb_tpu" / "obs"
+    obs.mkdir(parents=True)
+    (obs / "timeline.py").write_text(
+        f"EVENT_CATEGORIES = {categories!r}\n"
+    )
+    (tmp_path / "tidb_tpu" / "engine.py").write_text(
+        textwrap.dedent(body)
+    )
+    return tmp_path
+
+
+class TestTimelineLint:
+    def test_clean_at_head(self):
+        proc = run_lint(REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_undeclared_category_rejected(self, tmp_path):
+        root = _fixture_tree(
+            tmp_path, ("phase",),
+            """
+            def f(tl):
+                tl.emit_event("phase", "x", 0.0, 1.0)
+                tl.emit_event("mystery", "y", 0.0, 1.0)
+            """,
+        )
+        proc = run_lint(root)
+        assert proc.returncode == 1
+        assert "undeclared timeline category 'mystery'" in proc.stdout
+
+    def test_dead_declaration_rejected(self, tmp_path):
+        root = _fixture_tree(
+            tmp_path, ("phase", "ghost"),
+            """
+            def f(tl):
+                tl.emit_counter("phase", "x", 1.0)
+            """,
+        )
+        proc = run_lint(root)
+        assert proc.returncode == 1
+        assert "'ghost' has no" in proc.stdout
+
+    def test_clean_fixture_passes(self, tmp_path):
+        root = _fixture_tree(
+            tmp_path, ("phase", "stall"),
+            """
+            def f(tl):
+                tl.emit_event("phase", "x", 0.0, 1.0)
+                tl.emit_counter("stall", "y", 2.0)
+            """,
+        )
+        proc = run_lint(root)
+        assert proc.returncode == 0, proc.stdout

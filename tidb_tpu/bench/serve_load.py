@@ -264,7 +264,22 @@ def run_serve_load(args) -> int:
             "scan": _text_rows(ref.execute(SCAN_SQL)),
         }
 
-        admission = AdmissionController(
+        # --timeline-out: capture the whole load run's fleet timeline
+        # (worker events ride the fenced replies; admission waits and
+        # statement spans land coordinator-side)
+        timeline_path = getattr(args, "timeline_out", None)
+        if timeline_path:
+            from tidb_tpu.obs.timeline import TIMELINE
+
+            TIMELINE.start()
+
+        # admission knobs come from the tidb_-style sysvars (ROADMAP
+        # PR 8 item); the bench's --serve-budget-mb overrides the
+        # budget the way a SET GLOBAL would
+        from tidb_tpu.utils.sysvar import SysVars
+
+        admission = AdmissionController.from_sysvars(
+            SysVars(cat.global_sysvars),
             budget_bytes=int(args.serve_budget_mb) << 20,
             queue_timeout_s=600.0,
         )
@@ -466,6 +481,18 @@ def run_serve_load(args) -> int:
                 },
             },
         }
+        if timeline_path:
+            from tidb_tpu.obs.timeline import TIMELINE
+
+            TIMELINE.stop()
+            trace = TIMELINE.dump()
+            with open(timeline_path, "w") as f:
+                json.dump(trace, f)
+            result["detail"]["timeline"] = {
+                "hosts": trace["otherData"]["hosts"],
+                "events": len(trace["traceEvents"]),
+                "path": timeline_path,
+            }
         print(json.dumps(result))
         return 0 if result["detail"]["ok"] else 1
     finally:

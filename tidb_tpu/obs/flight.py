@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from tidb_tpu.obs.timeline import TIMELINE
 from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.metrics import REGISTRY
 
@@ -118,7 +119,8 @@ class QueryFlight:
         "qid", "conn_id", "sql", "start_ts", "duration_s", "phases",
         "plan_cache", "plan_digest", "rows_sent", "plan_text",
         "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
-        "device_mem_peak_bytes",
+        "device_mem_peak_bytes", "compile_flops",
+        "compile_bytes_accessed", "compile_output_bytes",
     )
 
     def __init__(self, qid: int, conn_id: int, sql: str):
@@ -144,6 +146,11 @@ class QueryFlight:
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self.device_mem_peak_bytes = 0
+        # XLA cost analysis summed over this statement's compiles
+        # (obs/engine_watch.py per-signature harvest)
+        self.compile_flops = 0.0
+        self.compile_bytes_accessed = 0.0
+        self.compile_output_bytes = 0.0
 
     def phase_row(self, name: str) -> list:
         row = self.phases.get(name)
@@ -208,6 +215,20 @@ class FlightRecorder:
         _h_query_seconds().observe(rec.duration_s)
         with self._lock:
             self._recent.append(rec)
+        if TIMELINE.active():
+            # one statement span per session thread track, plus a
+            # counter-track sample — the timeline moves at statement
+            # cadence even when nothing else emits
+            TIMELINE.emit_event(
+                "statement", rec.sql[:96], rec.start_ts,
+                rec.duration_s, track=f"conn-{rec.conn_id}",
+                args={
+                    "qid": rec.qid, "plan_digest": rec.plan_digest,
+                    "plan_cache": rec.plan_cache,
+                    "rows_sent": rec.rows_sent,
+                },
+            )
+            TIMELINE.sample_gauges()
         return rec
 
     def discard(self) -> None:
@@ -237,6 +258,14 @@ class FlightRecorder:
         row[0] += max(float(seconds), 0.0)
         row[1] += int(nbytes)
         row[2] += int(retries)
+        if TIMELINE.active() and seconds > 0:
+            # phase charges are noted at the END of the measured wall,
+            # so the event window extends backwards by the charge
+            TIMELINE.emit_event(
+                "phase", name, time.time() - float(seconds),
+                float(seconds), track=f"conn-{rec.conn_id}",
+                args={"qid": rec.qid},
+            )
 
     def phase_seconds(self, name: str) -> float:
         """Seconds charged so far to ``name`` on the CURRENT flight
@@ -284,6 +313,15 @@ class FlightRecorder:
         rec.h2d_bytes = int(engine_rec.h2d_bytes)
         rec.d2h_bytes = int(engine_rec.d2h_bytes)
         rec.device_mem_peak_bytes = int(engine_rec.device_mem_peak_bytes)
+        rec.compile_flops = float(
+            getattr(engine_rec, "compile_flops", 0.0)
+        )
+        rec.compile_bytes_accessed = float(
+            getattr(engine_rec, "compile_bytes_accessed", 0.0)
+        )
+        rec.compile_output_bytes = float(
+            getattr(engine_rec, "compile_output_bytes", 0.0)
+        )
 
     def note_shuffle_stage(self, stage: dict) -> None:
         """Attribute one DCN shuffle stage's worker-reported stats
@@ -326,6 +364,9 @@ class FlightRecorder:
                 "h2d_bytes": r.h2d_bytes,
                 "d2h_bytes": r.d2h_bytes,
                 "device_mem_peak_bytes": r.device_mem_peak_bytes,
+                "compile_flops": r.compile_flops,
+                "compile_bytes_accessed": r.compile_bytes_accessed,
+                "compile_output_bytes": r.compile_output_bytes,
                 "plan_captured": bool(r.plan_text),
             }
             for r in recs

@@ -1,0 +1,421 @@
+"""Fleet timeline tracer: one merged, Perfetto-loadable timeline of a
+query's life across every host it touched.
+
+Every observability surface before this one is an aggregate — flight
+phases (obs/flight.py), span rows (utils/tracing.py), histograms
+(utils/metrics.py). An aggregate can say a statement spent 40ms in
+shuffle-wait; only a timeline can show WHICH host stalled, whether
+shuffle push actually overlapped produce (the PERF_NOTES pipelining
+claim, verified instead of inferred), and where the admission queue ate
+the p99. "Accelerating Presto with GPUs" (PAPERS.md) runs its tuning
+loop off exactly this artifact: operator-level profiles, not counters.
+
+Output format: Chrome trace-event JSON (the `{"traceEvents": [...]}`
+shape) loadable in Perfetto / chrome://tracing — one PROCESS track per
+host (coordinator + every worker), one THREAD track per session /
+worker task, "X" complete events for work windows, "C" counter events
+sampled from existing gauges (device-mem high-water, admission queue
+depth, pooled control-connection leases, shuffle stages buffered).
+
+Event categories are a DECLARED registry (``EVENT_CATEGORIES``, the
+failpoint-SITES pattern): ``emit_event``/``emit_counter`` reject
+undeclared categories at runtime, and scripts/check_timeline_events.py
+cross-checks the declaration against the literal emit sites (tier-1
+via tests/test_timeline.py) so a typo'd category can neither fork the
+trace vocabulary nor rot unused.
+
+Cross-host correctness: worker-side events are recorded into a
+per-task ``TimelineBuffer`` and ship back PIGGYBACKED on the existing
+fenced fragment/shuffle replies (the PR 3 registry-delta pattern) —
+the coordinator merges them behind the exactly-once ledger fence, so a
+retried stage's events land once. Worker wall clocks are rebased onto
+the coordinator clock through the handshake-sampled per-host clock
+offsets (the PR 5 RTT/2 anchor that already rebases TRACE spans), so
+in-flight overlap between hosts renders faithfully.
+
+Capture is ON-DEMAND and bounded (a ring like the flight recorder):
+the ``tidb_timeline_capture`` sysvar, the ``/timeline`` HTTP endpoint
+(start/stop/dump), and ``bench.py --timeline-out`` for any bench mode
+including ``--serve-load`` and ``--multihost-shuffle``. When capture
+is off, every emit path is one predicate check.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tidb_tpu.utils import racecheck
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: every category a timeline event may carry — the closed vocabulary
+#: the /timeline trace and scripts/check_timeline_events.py key on.
+#: statement = one span per top-level SQL statement (session thread);
+#: phase = flight-recorder phase charge windows; compile = watched_jit
+#: trace walls carrying XLA cost-analysis attributes; fragment =
+#: coordinator dispatch windows + worker fragment executions; shuffle =
+#: worker produce/push/wait/stage windows; stall = tunnel backpressure
+#: stall windows; admission = serving-tier queue waits; counter =
+#: gauge-sampled counter tracks.
+EVENT_CATEGORIES = (
+    "statement",
+    "phase",
+    "compile",
+    "fragment",
+    "shuffle",
+    "stall",
+    "admission",
+    "counter",
+)
+
+_CATEGORY_SET = frozenset(EVENT_CATEGORIES)
+
+#: the existing gauges sampled into "C" counter tracks (matched by
+#: metric-name prefix so labeled children — per-host pool leases —
+#: each get their own counter series)
+GAUGE_TRACKS = (
+    "tidbtpu_engine_device_mem_highwater_bytes",
+    "tidbtpu_admission_queue_depth",
+    "tidbtpu_admission_inuse_bytes",
+    "tidbtpu_dcn_pool_leased_peak",
+    "tidbtpu_shuffle_stages_buffered",
+)
+
+#: the coordinator's own process-track label
+COORDINATOR = "coordinator"
+
+
+def _c_events():
+    return REGISTRY.counter(
+        "tidbtpu_timeline_events_total",
+        "events the timeline recorder captured (coordinator + merged "
+        "worker events)",
+    )
+
+
+def _c_dropped():
+    return REGISTRY.counter(
+        "tidbtpu_timeline_dropped_total",
+        "remote events dropped at merge (undeclared category or "
+        "malformed record from a skewed worker)",
+    )
+
+
+def _check_category(cat: str) -> None:
+    if cat not in _CATEGORY_SET:
+        raise ValueError(
+            f"undeclared timeline category {cat!r} (declare it in "
+            "tidb_tpu/obs/timeline.py EVENT_CATEGORIES)"
+        )
+
+
+class TimelineBuffer:
+    """Worker-side event sink for ONE dispatched task: a plain bounded
+    list the reply ships back verbatim (``[cat, name, t0_wall_s,
+    dur_s, track, args]`` records, worker wall clock). No locking — a
+    task's emitters are its own threads and list.append is atomic;
+    the coordinator validates categories again at merge."""
+
+    __slots__ = ("events", "capacity")
+
+    def __init__(self, capacity: int = 4096):
+        self.events: List[list] = []
+        self.capacity = int(capacity)
+
+    def emit_event(
+        self, cat: str, name: str, t0_s: float, dur_s: float,
+        track: str = "", args: Optional[dict] = None,
+    ) -> None:
+        _check_category(cat)
+        if len(self.events) >= self.capacity:
+            return
+        self.events.append(
+            [cat, str(name), float(t0_s), max(float(dur_s), 0.0),
+             str(track), dict(args) if args else None]
+        )
+
+
+class TimelineRecorder:
+    """On-demand fleet event recorder. All events carry COORDINATOR
+    wall-clock timestamps; remote events are rebased at merge through
+    the per-host clock offset their scheduler sampled."""
+
+    def __init__(self, capacity: int = 65536):
+        self._lock = racecheck.make_lock("timeline.ring")
+        self._events: "collections.deque" = collections.deque(
+            maxlen=int(capacity)
+        )
+        self._active = False
+        self._t_start: Optional[float] = None
+
+    # -- capture gate ---------------------------------------------------
+    def start(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None:
+                self._events = collections.deque(
+                    self._events, maxlen=max(int(capacity), 16)
+                )
+            if not self._active:
+                self._events.clear()
+                self._t_start = time.time()
+            self._active = True
+
+    def stop(self) -> None:
+        with self._lock:
+            self._active = False
+
+    def active(self) -> bool:
+        return self._active
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._t_start = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # -- emit -----------------------------------------------------------
+    def emit_event(
+        self, cat: str, name: str, t0_s: float, dur_s: float,
+        host: str = COORDINATOR, track: str = "",
+        args: Optional[dict] = None,
+    ) -> None:
+        """One "X" complete event: work named ``name`` ran on ``host``
+        (its process track) / ``track`` (its thread track) over
+        ``[t0_s, t0_s + dur_s]`` in coordinator wall-clock seconds.
+        Undeclared categories raise — the registry, not the call site,
+        defines the vocabulary."""
+        _check_category(cat)
+        if not self._active:
+            return
+        _c_events().inc()
+        with self._lock:
+            self._events.append(
+                ("X", cat, str(name), float(t0_s),
+                 max(float(dur_s), 0.0), str(host), str(track),
+                 dict(args) if args else None)
+            )
+
+    def emit_counter(
+        self, cat: str, name: str, value: float,
+        host: str = COORDINATOR, t_s: Optional[float] = None,
+    ) -> None:
+        """One "C" counter sample (its own counter track per name)."""
+        _check_category(cat)
+        if not self._active:
+            return
+        _c_events().inc()
+        with self._lock:
+            self._events.append(
+                ("C", cat, str(name),
+                 time.time() if t_s is None else float(t_s),
+                 float(value), str(host), "", None)
+            )
+
+    def sample_gauges(self) -> None:
+        """Sample the declared GAUGE_TRACKS out of the live registry
+        into counter events (labeled children keep their label block in
+        the series name). One REGISTRY.rows() pass; called at statement
+        close and dispatch completion, so counter tracks move at the
+        cadence queries do."""
+        if not self._active:
+            return
+        now = time.time()
+        for name, kind, value in REGISTRY.rows():
+            if kind != "gauge":
+                continue
+            if any(name.startswith(p) for p in GAUGE_TRACKS):
+                self.emit_counter("counter", name, value, t_s=now)
+
+    def merge_remote(
+        self, events, host: str, offset_s: Optional[float]
+    ) -> int:
+        """Fold one fenced reply's piggybacked worker events in,
+        rebasing worker wall clocks onto the coordinator clock
+        (coordinator_wall = worker_wall - offset; offset is the
+        handshake-sampled host-clock minus coordinator-clock). Called
+        only from behind the exactly-once ledger fence, so a retried
+        stage's events merge once. Malformed records from a skewed
+        worker are counted and dropped, never raised — telemetry must
+        not fail the query. Returns the number of events merged."""
+        if not events or not self._active:
+            return 0
+        off = float(offset_s or 0.0)
+        recs = []
+        dropped = 0
+        for ev in events:
+            try:
+                cat, name, t0, dur, track, args = ev
+                if cat not in _CATEGORY_SET:
+                    raise ValueError(cat)
+                recs.append(
+                    ("X", str(cat), str(name), float(t0) - off,
+                     max(float(dur), 0.0), str(host), str(track),
+                     dict(args) if args else None)
+                )
+            except Exception:
+                dropped += 1
+        # one lock acquisition and one counter move per REPLY, not per
+        # event — a fenced reply can carry thousands of events
+        with self._lock:
+            self._events.extend(recs)
+        if recs:
+            _c_events().inc(len(recs))
+        if dropped:
+            _c_dropped().inc(dropped)
+        return len(recs)
+
+    # -- export ---------------------------------------------------------
+    def events(self) -> List[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self) -> dict:
+        """The Chrome trace-event JSON object: process-name metadata
+        per host, thread-name metadata per (host, track), "X" complete
+        events in microseconds relative to capture start, "C" counter
+        samples. Loadable as-is in Perfetto / chrome://tracing."""
+        with self._lock:
+            events = list(self._events)
+            t_start = self._t_start
+        if t_start is None:
+            t_start = min(
+                (e[3] for e in events), default=time.time()
+            )
+        hosts: Dict[str, int] = {}
+        tracks: Dict[Tuple[str, str], int] = {}
+        out: List[dict] = []
+
+        def pid_of(host: str) -> int:
+            pid = hosts.get(host)
+            if pid is None:
+                # coordinator always pid 1: the merged timeline reads
+                # top-down the way the dispatch flows; workers take
+                # 2, 3, ... in first-seen order
+                pid = hosts[host] = (
+                    1 if host == COORDINATOR
+                    else 2 + sum(1 for h in hosts if h != COORDINATOR)
+                )
+                out.append(
+                    {"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": host}}
+                )
+            return pid
+
+        def tid_of(host: str, track: str) -> int:
+            key = (host, track or "main")
+            tid = tracks.get(key)
+            if tid is None:
+                tid = tracks[key] = len(tracks) + 1
+                out.append(
+                    {"ph": "M", "name": "thread_name",
+                     "pid": pid_of(host), "tid": tid,
+                     "args": {"name": key[1]}}
+                )
+            return tid
+
+        for ph, cat, name, t0, v, host, track, args in events:
+            pid = pid_of(host)
+            if ph == "C":
+                out.append(
+                    {"ph": "C", "cat": cat, "name": name, "pid": pid,
+                     "tid": 0, "ts": max((t0 - t_start) * 1e6, 0.0),
+                     "args": {"value": v}}
+                )
+                continue
+            ev = {
+                "ph": "X", "cat": cat, "name": name, "pid": pid,
+                "tid": tid_of(host, track),
+                "ts": max((t0 - t_start) * 1e6, 0.0),
+                "dur": v * 1e6,
+            }
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "tidb-tpu timeline tracer",
+                "capture_start_unix": t_start,
+                "hosts": sorted(hosts),
+            },
+        }
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump())
+
+
+TIMELINE = TimelineRecorder()
+
+
+# -- analysis helpers (bench --timeline-out stamps; tests) -------------------
+
+
+def _window_overlap(a: List[Tuple[float, float]],
+                    b: List[Tuple[float, float]]) -> float:
+    """Total seconds where any window in ``a`` intersects any in ``b``
+    (union of pairwise intersections via a sweep, so overlapping pairs
+    are not double-counted)."""
+    spans = []
+    for t0, d0 in a:
+        for t1, d1 in b:
+            lo = max(t0, t1)
+            hi = min(t0 + d0, t1 + d1)
+            if hi > lo:
+                spans.append((lo, hi))
+    spans.sort()
+    total = 0.0
+    cur_lo = cur_hi = None
+    for lo, hi in spans:
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                total += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        total += cur_hi - cur_lo
+    return total
+
+
+def shuffle_overlap_report(events) -> Dict[str, dict]:
+    """Per worker-task track: seconds of produce/push and push/stage
+    window overlap among the "shuffle" events, split by the pipeline
+    flag the events carry — how a captured trace PROVES the pipelined
+    stage overlapped and the barrier escape hatch did not (the
+    PERF_NOTES claim, measured from the artifact). Accepts recorder
+    event tuples (``TIMELINE.events()``)."""
+    by_track: Dict[tuple, Dict[str, list]] = {}
+    for ev in events:
+        ph, cat, name, t0, dur, host, track, args = ev
+        if ph != "X" or cat != "shuffle":
+            continue
+        pipeline = bool((args or {}).get("pipeline", False))
+        rec = by_track.setdefault(
+            (host, track, pipeline),
+            {"produce": [], "push": [], "stage": []},
+        )
+        for kind in ("produce", "push", "stage"):
+            if name.startswith(kind):
+                rec[kind].append((t0, dur))
+                break
+    out: Dict[str, dict] = {}
+    for (host, track, pipeline), rec in sorted(by_track.items()):
+        out[f"{host}/{track}"] = {
+            "pipeline": pipeline,
+            "produce_push_overlap_s": round(
+                _window_overlap(rec["produce"], rec["push"]), 6
+            ),
+            "push_stage_overlap_s": round(
+                _window_overlap(rec["push"], rec["stage"]), 6
+            ),
+            "produce_windows": len(rec["produce"]),
+            "push_windows": len(rec["push"]),
+            "stage_windows": len(rec["stage"]),
+        }
+    return out

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tidb_tpu.dxf.framework import fence_accepts
 from tidb_tpu.obs.flight import FLIGHT, LINKS
+from tidb_tpu.obs.timeline import TIMELINE
 from tidb_tpu.parallel.serving import QidAllocator
 from tidb_tpu.planner import logical as L
 from tidb_tpu.planner.fragmenter import (
@@ -657,6 +658,21 @@ class DCNFragmentScheduler:
         FLIGHT.note_phase(
             "fragment-dispatch", max(wall - crit, 0.0), retries=retries
         )
+        # counter tracks move at dispatch cadence too (pool leases /
+        # stages buffered peak right here, not at statement close)
+        TIMELINE.sample_gauges()
+
+    @staticmethod
+    def _worker_mem_peak(infos) -> int:
+        """The fleet-eyed device-mem high-water of one query: the max
+        of the workers' OWN per-fragment engine-watch peaks shipped in
+        the fenced replies. The admission estimate learns from
+        max(coordinator peak, this) — a worker-heavier plan (the
+        pre-aggregation runs below the exchange) no longer gates on
+        the coordinator's smaller final-stage shape (ROADMAP PR 8)."""
+        return max(
+            (int(f.get("mem_peak", 0)) for f in infos), default=0
+        )
 
     def _timed_final_stage(self, cut, rows):
         """Run the coordinator-local final stage charging its wall to
@@ -821,7 +837,11 @@ class DCNFragmentScheduler:
                     "pipeline": self.shuffle_pipeline,
                     "produce_chunks": self.shuffle_produce_chunks,
                     "trace": bool(self.tracer.enabled),
+                    # opt the worker into timeline event collection
+                    # only while a coordinator capture is live
+                    "timeline": TIMELINE.active(),
                 }
+                t_d0 = time.time()
                 try:
                     resp = conn.call(
                         {"v": IR_VERSION, "shuffle_task": task}
@@ -850,7 +870,10 @@ class DCNFragmentScheduler:
                     )
                 rows = [tuple(r) for r in resp["rows"]]
                 if ledger.complete(i, token, rows):
-                    self._note_partition(infos, i, ep, attempt, resp)
+                    self._note_partition(
+                        infos, i, ep, attempt, resp, qid=qid,
+                        t_dispatch0=t_d0,
+                    )
 
             def runner(i, ep, conn):
                 try:
@@ -919,6 +942,7 @@ class DCNFragmentScheduler:
                 lq = {
                     "qid": qid, "fragments": infos,
                     "shuffle": dict(stage),
+                    "worker_mem_peak": self._worker_mem_peak(infos),
                 }
                 with self._lock:
                     self.last_query = lq
@@ -942,10 +966,15 @@ class DCNFragmentScheduler:
             f"{len(self.alive_endpoints())} alive); last error: {last_err}"
         )
 
-    def _note_partition(self, infos, part, ep, attempt, resp) -> None:
+    def _note_partition(
+        self, infos, part, ep, attempt, resp, qid=None,
+        t_dispatch0=None,
+    ) -> None:
         """Record one FENCED per-partition shuffle result: counters,
-        telemetry, shipped worker registry deltas, and the host-labeled
-        span merge."""
+        telemetry, shipped worker registry deltas, the host-labeled
+        span merge, and the piggybacked worker timeline events (rebased
+        through the handshake clock offset — behind the ledger fence,
+        so a retried stage's events land once)."""
         stats = resp.get("stats") or {}
         sh = resp.get("shuffle") or {}
         spans = resp.get("spans") or []
@@ -955,10 +984,19 @@ class DCNFragmentScheduler:
         _c_shuffle_result_bytes().inc(nbytes)
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
+        self._note_timeline(
+            resp, ep, qid=qid, unit=f"p{part}", attempt=attempt,
+            t_dispatch0=t_dispatch0,
+        )
         info = {
             "fid": part, "host": host, "attempt": attempt,
             "rows": int(stats.get("rows", 0)), "exec_s": exec_s,
             "bytes": nbytes,
+            # worker-eyed engine accounting (reply stats): the
+            # admission estimate's fleet half + per-fragment compile
+            # cost for distributed EXPLAIN ANALYZE
+            "mem_peak": int(stats.get("mem_peak_bytes", 0) or 0),
+            "compile": stats.get("compile"),
             "pushed_bytes": int(sh.get("pushed_bytes", 0)),
             "pushed_rows": int(sh.get("pushed_rows", 0)),
             "local_rows": int(sh.get("local_rows", 0)),
@@ -986,6 +1024,31 @@ class DCNFragmentScheduler:
                 pass  # malformed per_peer from a skewed worker
         self._merge_remote_spans(
             spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
+        )
+
+    def _note_timeline(
+        self, resp, ep, qid=None, unit="", attempt=1, t_dispatch0=None,
+    ) -> None:
+        """Fleet timeline merge for one FENCED reply: the coordinator
+        dispatch window (an event the cross-host monotonicity check
+        anchors on — worker events must not start before it) plus the
+        worker's piggybacked events, rebased through this host's
+        handshake-sampled clock offset."""
+        if not TIMELINE.active():
+            return
+        if t_dispatch0 is not None:
+            TIMELINE.emit_event(
+                "fragment", f"dispatch q{qid}/{unit}", t_dispatch0,
+                max(time.time() - t_dispatch0, 0.0),
+                track=f"q{qid}",
+                args={
+                    "qid": qid, "unit": unit, "host": ep.address,
+                    "attempt": attempt,
+                },
+            )
+        TIMELINE.merge_remote(
+            resp.get("events"), ep.address,
+            self._clock_offsets.get(ep.address),
         )
 
     def _run_fragments(
@@ -1032,9 +1095,12 @@ class DCNFragmentScheduler:
                     "qid": qid, "fid": fid, "n": n,
                     "attempt": ledger.attempts(fid),
                     # opt the worker into span collection only when the
-                    # coordinator is actually tracing
+                    # coordinator is actually tracing; same opt-in for
+                    # timeline event collection
                     "trace": bool(self.tracer.enabled),
+                    "timeline": TIMELINE.active(),
                 }
+                t_d0 = time.time()
                 try:
                     _cols, rows, resp = self._dispatch(
                         ep, frag.host_plan(fid, n), meta
@@ -1047,7 +1113,9 @@ class DCNFragmentScheduler:
                     errs.append((ep, e))
                     return
                 if ledger.complete(fid, token, rows):
-                    self._note_fragment(infos, fid, ep, meta, resp)
+                    self._note_fragment(
+                        infos, fid, ep, meta, resp, t_dispatch0=t_d0
+                    )
 
             fatal: List[Exception] = []
 
@@ -1082,17 +1150,22 @@ class DCNFragmentScheduler:
                 f"{last_err}"
             )
         infos.sort(key=lambda f: f["fid"])
-        lq = {"qid": qid, "fragments": infos}
+        lq = {
+            "qid": qid, "fragments": infos,
+            "worker_mem_peak": self._worker_mem_peak(infos),
+        }
         with self._lock:
             self.last_query = lq
         self._tls.last = lq
         _update_host_gauges(self.endpoints)
         return ledger, infos
 
-    def _note_fragment(self, infos, fid, ep, meta, resp) -> None:
+    def _note_fragment(
+        self, infos, fid, ep, meta, resp, t_dispatch0=None
+    ) -> None:
         """Record one FENCED fragment delivery: counters, the per-query
-        info list, and the host-labeled span merge into the
-        coordinator's tracer."""
+        info list, the host-labeled span merge into the coordinator's
+        tracer, and the piggybacked worker timeline events."""
         stats = resp.get("stats") or {}
         spans = resp.get("spans") or []
         host = stats.get("host") or ep.address
@@ -1101,10 +1174,16 @@ class DCNFragmentScheduler:
         _c_bytes_staged().inc(nbytes)
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
+        self._note_timeline(
+            resp, ep, qid=meta.get("qid"), unit=f"f{fid}",
+            attempt=meta.get("attempt", 1), t_dispatch0=t_dispatch0,
+        )
         info = {
             "fid": fid, "host": host, "attempt": meta["attempt"],
             "rows": int(stats.get("rows", 0)), "exec_s": exec_s,
             "bytes": nbytes, "spans": spans,
+            "mem_peak": int(stats.get("mem_peak_bytes", 0) or 0),
+            "compile": stats.get("compile"),
         }
         with self._lock:
             infos.append(info)

@@ -201,6 +201,30 @@ class AdmissionController:
         self._estimates: Dict[str, int] = {}
         self._outcome_counts = {o: 0 for o in OUTCOMES}
 
+    @classmethod
+    def from_sysvars(cls, sysvars, **overrides) -> "AdmissionController":
+        """Build a controller from the tidb_-style admission sysvars
+        (utils/sysvar.py): ``tidb_tpu_admission_budget_bytes``,
+        ``tidb_tpu_admission_queue_limit``,
+        ``tidb_tpu_admission_starvation_s``. ``sysvars`` is anything
+        with a ``get(name)`` (a session's SysVars view, or the SysVars
+        over a catalog's global store); explicit keyword overrides win
+        — the ROADMAP PR 8 knobs, surfaced instead of buried in
+        constructor args."""
+        kw = {
+            "budget_bytes": int(
+                sysvars.get("tidb_tpu_admission_budget_bytes")
+            ),
+            "max_queue": int(
+                sysvars.get("tidb_tpu_admission_queue_limit")
+            ),
+            "starvation_s": float(
+                sysvars.get("tidb_tpu_admission_starvation_s")
+            ),
+        }
+        kw.update(overrides)
+        return cls(**kw)
+
     # -- estimates ------------------------------------------------------
     def estimate(self, key: Optional[str]) -> int:
         """Working-set estimate for one plan: the engine-watch
@@ -356,6 +380,24 @@ class AdmissionController:
 
         FLIGHT.note_phase("queue-wait", waited)
         if queued:
+            # the fleet timeline's admission track: one event per
+            # QUEUED admission spanning the wait (obs/timeline.py) —
+            # where the p99 went when the fleet was saturated
+            from tidb_tpu.obs.timeline import TIMELINE
+
+            TIMELINE.emit_event(
+                "admission", "queue-wait", time.time() - waited,
+                waited, track="admission",
+                args={
+                    "priority": priority,
+                    "outcome": (
+                        "killed" if killed is not None
+                        else verdict.admission_outcome
+                        if verdict is not None else "admit"
+                    ),
+                    "estimate_bytes": est,
+                },
+            )
             self._note_outcome("queue")
         if killed is not None:
             # a kill is the STATEMENT's verdict, not an admission

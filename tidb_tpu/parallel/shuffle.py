@@ -666,6 +666,11 @@ class PeerTunnel:
         #: byte window (backpressure stall WALL, not just a count —
         #: information_schema.cluster_links reads this per link)
         self.stall_s = 0.0
+        #: individual stall windows as (wall_t0, dur_s) — the timeline
+        #: tracer's per-link backpressure events (obs/timeline.py).
+        #: Bounded; appended only when a stall actually happened, so
+        #: the un-stalled hot path never touches it.
+        self.stall_windows: List[Tuple[float, float]] = []
         self.retransmits = 0
         self._cv = racecheck.make_condition("shuffle.tunnel")
         self._q: "collections.deque" = collections.deque()
@@ -729,6 +734,7 @@ class PeerTunnel:
         with self._cv:
             stalled = False
             stall_t0 = 0.0
+            stall_wall0 = 0.0
             while (
                 self._dead is None
                 and self._inflight + nbytes > self.max_inflight
@@ -737,12 +743,15 @@ class PeerTunnel:
                 if not stalled:
                     stalled = True
                     stall_t0 = time.perf_counter()
+                    stall_wall0 = time.time()
                     self.stalls += 1
                     _c_stalls().labels(dst=self.address).inc()
                 self._cv.wait(0.05)
             if stalled:
                 dt = time.perf_counter() - stall_t0
                 self.stall_s += dt
+                if len(self.stall_windows) < 256:
+                    self.stall_windows.append((stall_wall0, dt))
                 from tidb_tpu.obs.flight import _c_link_stall_seconds
 
                 _c_link_stall_seconds().labels(
@@ -1235,6 +1244,24 @@ class ShuffleWorker:
             int(spec.get("produce_chunks") or DEFAULT_PRODUCE_CHUNKS), 1
         )
         ctx = f"q{spec.get('qid')}/p{part}"
+        # fleet timeline capture (obs/timeline.py): when the dispatch
+        # asks for it, work windows land in a per-task buffer the reply
+        # ships back piggybacked — the coordinator merges them behind
+        # the ledger fence and rebases through the handshake clock
+        # offset, so a retried stage's events land exactly once
+        buf = None
+        ev_args = {"pipeline": pipeline}
+        if spec.get("timeline"):
+            from tidb_tpu.obs.timeline import TimelineBuffer
+
+            buf = TimelineBuffer()
+
+        def emit(name: str, t0_wall: float, dur_s: float) -> None:
+            if buf is not None:
+                buf.emit_event(
+                    "shuffle", name, t0_wall, dur_s, track=ctx,
+                    args=ev_args,
+                )
 
         self.store.open(sid, attempt, m)
         with self._exec_lock:
@@ -1278,9 +1305,12 @@ class ShuffleWorker:
                     # hatch (shuffle_codec=json) materializes and
                     # partitions Python rows, like PR 3
                     t_prod = time.perf_counter()
+                    t_wall = time.time()
                     with span(f"{ctx}/produce#{tag}"), self._exec_lock:
                         batch, dicts = producer_exec.run(plan)
-                    stats["produce_s"] += time.perf_counter() - t_prod
+                    dt_prod = time.perf_counter() - t_prod
+                    stats["produce_s"] += dt_prod
+                    emit(f"produce#{tag}", t_wall, dt_prod)
                     with self._exec_lock:
                         rows = materialize_rows(batch, schema_cols, dicts)
                     key_idx = [c.internal for c in schema_cols].index(
@@ -1288,6 +1318,8 @@ class ShuffleWorker:
                     )
                     stats["produced_rows"] += len(rows)
                     parts = partition_rows(rows, key_idx, m)
+                    t_push = time.perf_counter()
+                    t_wall = time.time()
                     with span(f"{ctx}/push#{tag}"):
                         for dest, prows in enumerate(parts):
                             self._send_stream(
@@ -1295,6 +1327,10 @@ class ShuffleWorker:
                                 peers, secret, tunnels, packet_rows,
                                 inflight, stats,
                             )
+                    emit(
+                        f"push#{tag}", t_wall,
+                        time.perf_counter() - t_push,
+                    )
                     continue
                 # binary hot path: keep the engine's own columnar
                 # layout end to end — hash the key COLUMN (bit-identical
@@ -1324,7 +1360,7 @@ class ShuffleWorker:
                             sid, attempt, m, tag, part, sq,
                             side["key"], schema_cols, peers, secret,
                             tunnels, tlock, packet_rows, inflight,
-                            stats, ship_errs,
+                            stats, ship_errs, buf, ctx, ev_args,
                         ),
                         daemon=True,
                         name=f"shuffle-ship-{sid}-s{tag}",
@@ -1345,22 +1381,28 @@ class ShuffleWorker:
                             subplans = cand
                     for sp in (subplans or [plan]):
                         t_prod = time.perf_counter()
+                        t_wall = time.time()
                         with span(f"{ctx}/produce#{tag}"), \
                                 self._exec_lock:
                             batch, dicts = producer_exec.run(sp)
-                        stats["produce_s"] += (
-                            time.perf_counter() - t_prod
-                        )
+                        dt_prod = time.perf_counter() - t_prod
+                        stats["produce_s"] += dt_prod
+                        emit(f"produce#{tag}", t_wall, dt_prod)
                         sq.put((batch, types, dicts))
                     sq.put(None)  # side EOF sentinel
                     continue
                 t_prod = time.perf_counter()
+                t_wall = time.time()
                 with span(f"{ctx}/produce#{tag}"), self._exec_lock:
                     batch, dicts = producer_exec.run(plan)
-                stats["produce_s"] += time.perf_counter() - t_prod
+                dt_prod = time.perf_counter() - t_prod
+                stats["produce_s"] += dt_prod
+                emit(f"produce#{tag}", t_wall, dt_prod)
                 block = batch_to_block(batch, types, dicts)
                 stats["produced_rows"] += block.nrows
                 idxs = partition_block(block, side["key"], m)
+                t_push = time.perf_counter()
+                t_wall = time.time()
                 with span(f"{ctx}/push#{tag}"):
                     for dest, idx in enumerate(idxs):
                         self._ship_partition(
@@ -1369,6 +1411,7 @@ class ShuffleWorker:
                             secret, tunnels, packet_rows, inflight,
                             stats,
                         )
+                emit(f"push#{tag}", t_wall, time.perf_counter() - t_push)
             consumer = plan_from_ir(spec["consumer"])
             reads = _shuffle_read_tags(consumer)
             if not pipeline:
@@ -1379,6 +1422,7 @@ class ShuffleWorker:
                 # BOTH the flush block (waiting for peer acks) and the
                 # store wait are exchange idle.
                 t0 = time.perf_counter()
+                t_wall = time.time()
                 for t in tunnels.values():
                     t.flush()
                 with span(f"{ctx}/wait"):
@@ -1387,6 +1431,7 @@ class ShuffleWorker:
                         wait_timeout,
                     )
                 idle = time.perf_counter() - t0
+                emit("wait", t_wall, idle)
                 stats["wait_idle_s"] += idle
                 stats["wait_s"] += idle
                 _c_wait_idle_seconds().inc(idle)
@@ -1403,6 +1448,7 @@ class ShuffleWorker:
                 waited = 0.0
                 while pending:
                     t0 = time.perf_counter()
+                    t_wall = time.time()
                     # the timeout budget charges WAITING only: per-side
                     # staging between waits must not burn it (barrier
                     # mode charged wait_timeout purely to its one wait)
@@ -1415,6 +1461,7 @@ class ShuffleWorker:
                             abort=lambda: bool(ship_errs),
                         )
                     t1 = time.perf_counter()
+                    emit("wait", t_wall, t1 - t0)
                     waited += t1 - t0
                     stats["wait_s"] += t1 - t0
                     # idle = blocked time with our own shippers already
@@ -1432,15 +1479,16 @@ class ShuffleWorker:
                     node = reads.get(done)
                     if node is not None:
                         t_stage = time.perf_counter()
+                        t_wall = time.time()
                         with span(f"{ctx}/stage#{done}"):
                             staged[done] = stage_payloads_incremental(
                                 node.schema, chunks,
                                 next(self._nonce), vocab=vocab,
                                 key=f"shuffle#{done}",
                             )
-                        stats["stage_s"] += (
-                            time.perf_counter() - t_stage
-                        )
+                        dt_stage = time.perf_counter() - t_stage
+                        emit(f"stage#{done}", t_wall, dt_stage)
+                        stats["stage_s"] += dt_stage
                 for th in shippers:
                     th.join()
                 if ship_errs:
@@ -1499,6 +1547,15 @@ class ShuffleWorker:
                 stats["stalls"] += t.stalls
                 stats["stall_s"] += t.stall_s
                 stats["retransmits"] += t.retransmits
+                if buf is not None:
+                    # backpressure stall windows per link — where a
+                    # producer stood blocked on a peer's in-flight
+                    # byte window, on the merged fleet timeline
+                    for w0, wdur in t.stall_windows:
+                        buf.emit_event(
+                            "stall", f"stall->{t.address}", w0, wdur,
+                            track=ctx, args={"dst": t.address},
+                        )
                 stats["per_peer"].append(
                     {
                         "dst": t.address, "bytes": t.bytes_sent,
@@ -1529,6 +1586,7 @@ class ShuffleWorker:
             # reuse; the keyed staged input is incremental-mode
             # machinery)
             t_stage = time.perf_counter()
+            t_wall = time.time()
             staged = {
                 tag: stage_payloads_as_batch(
                     node.schema, by_side.get(tag, []),
@@ -1536,7 +1594,9 @@ class ShuffleWorker:
                 )
                 for tag, node in reads.items()
             }
-            stats["stage_s"] += time.perf_counter() - t_stage
+            dt_stage = time.perf_counter() - t_stage
+            emit("stage", t_wall, dt_stage)
+            stats["stage_s"] += dt_stage
         inject("shuffle/consume")
         with span(f"{ctx}/consume"), self._exec_lock:
             # consumer executes single-device: its sources are Staged
@@ -1553,6 +1613,10 @@ class ShuffleWorker:
             "columns": [c.name for c in consumer.schema],
             "rows": out_rows,
             "shuffle": stats,
+            # piggybacked timeline events (None when capture is off):
+            # the reply ships them, the coordinator merges them behind
+            # the exactly-once ledger fence
+            "events": buf.events if buf is not None else None,
         }
 
     def _tunnel_for(
@@ -1574,7 +1638,7 @@ class ShuffleWorker:
     def _ship_side_stream(
         self, sid, attempt, m, side, sender, sq, key, schema_cols,
         peers, secret, tunnels, tlock, packet_rows, inflight, stats,
-        errs,
+        errs, buf=None, ctx="", ev_args=None,
     ) -> None:
         """Pipelined producer ship (one side, run on a shipper thread,
         fed produced sub-batches through queue ``sq`` until the None
@@ -1613,6 +1677,8 @@ class ShuffleWorker:
                 item = sq.get()
                 if item is None:
                     break
+                t_ship0 = time.perf_counter()
+                t_ship_wall = time.time()
                 batch, types, dicts = item
                 block = batch_to_block(batch, types, dicts)
                 produced += block.nrows
@@ -1676,6 +1742,17 @@ class ShuffleWorker:
                             len(frame)
                         )
                         tun.send(frame, len(frame), sub.nrows)
+                if buf is not None:
+                    # one push window per shipped sub-batch: d2h fetch
+                    # + partition + encode + enqueue — on the timeline
+                    # these windows interleave with the SAME side's
+                    # next produce chunk, which is the overlap the
+                    # pipelined stage claims
+                    buf.emit_event(
+                        "shuffle", f"push#{side}", t_ship_wall,
+                        time.perf_counter() - t_ship0, track=ctx,
+                        args=ev_args,
+                    )
             for dest in range(m):
                 if dest == sender:
                     self.store.push(

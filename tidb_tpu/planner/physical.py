@@ -2856,6 +2856,7 @@ def _merge_frag_stats(lines: List[str], frag_stats) -> List[str]:
         f"avg={(sum(times)/len(times))*1000:.2f}ms "
         f"max={max(times)*1000:.2f}ms"
     )
+    summary += _compile_cost_suffix(frags)
     per_frag = [
         (
             f"Fragment#{f.get('fid')} host={f.get('host', '?')} "
@@ -2866,6 +2867,25 @@ def _merge_frag_stats(lines: List[str], frag_stats) -> List[str]:
         for f in frags
     ]
     return _insert_below_staged(lines, summary, per_frag)
+
+
+def _compile_cost_suffix(frags) -> str:
+    """Worker-reported XLA compile cost summed across the fenced
+    fragment replies (obs/engine_watch.py harvest, shipped in reply
+    stats) — rendered on the exchange summary row when any worker
+    actually compiled during this statement. Empty on warm runs."""
+    flops = sum(
+        float((f.get("compile") or {}).get("flops", 0.0)) for f in frags
+    )
+    nbytes = sum(
+        float((f.get("compile") or {}).get("bytes_accessed", 0.0))
+        for f in frags
+    )
+    if not flops and not nbytes:
+        return ""
+    return (
+        f" compile_flops={flops:.0f} compile_bytes_accessed={nbytes:.0f}"
+    )
 
 
 def _insert_below_staged(
@@ -2924,6 +2944,7 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
         f"wait_idle={idle*1000:.2f}ms "
         f"ttff={float(stage.get('ttff_s', 0.0))*1000:.2f}ms"
     )
+    summary += _compile_cost_suffix(frags)
     per_part = [
         (
             f"ShuffleExchange part={f.get('fid')} "

@@ -302,12 +302,53 @@ class EngineServer:
                 tracer.enabled = True
                 tracer.reset()
             ctx = f"q{frag.get('qid')}/f{frag.get('fid')}"
+            # per-fragment engine-watch record: this worker's OWN
+            # device-mem high-water and compile cost for the slice it
+            # ran — shipped in the reply stats so admission estimates
+            # learn from worker-eyed peaks (the coordinator-side
+            # estimate sees a different, usually smaller, shape)
+            from tidb_tpu.obs.engine_watch import (
+                ENGINE_WATCH,
+                set_cost_wanted,
+            )
+
+            ENGINE_WATCH.begin_query(f"frag {ctx}")
+            # a timeline-captured dispatch asks this worker to harvest
+            # XLA cost analysis for whatever it compiles (thread-scoped)
+            set_cost_wanted(bool(frag.get("timeline")))
             t_exec0 = _time.perf_counter()
-            with tracer.span(f"{ctx}/execute"):
-                batch, dicts = executor.run(plan)
-            with tracer.span(f"{ctx}/materialize"):
-                rows = materialize_rows(batch, list(plan.schema), dicts)
+            t_wall0 = _time.time()
+            try:
+                with tracer.span(f"{ctx}/execute"):
+                    batch, dicts = executor.run(plan)
+                with tracer.span(f"{ctx}/materialize"):
+                    rows = materialize_rows(
+                        batch, list(plan.schema), dicts
+                    )
+            except BaseException:
+                ENGINE_WATCH.end_query(
+                    _time.perf_counter() - t_exec0
+                )
+                raise
+            finally:
+                set_cost_wanted(False)
             exec_s = _time.perf_counter() - t_exec0
+            frag_watch = {
+                "mem_peak_bytes": ENGINE_WATCH.current_peak_bytes(),
+                "compile": ENGINE_WATCH.current_compile_cost() or None,
+            }
+            frag_events = None
+            if frag.get("timeline"):
+                from tidb_tpu.obs.timeline import TimelineBuffer
+
+                tb = TimelineBuffer()
+                tb.emit_event(
+                    "fragment", f"execute {ctx}", t_wall0, exec_s,
+                    track=ctx,
+                    args={"attempt": frag.get("attempt", 1)},
+                )
+                frag_events = tb.events
+            ENGINE_WATCH.end_query(exec_s)
         else:
             batch, dicts = executor.run(plan)
             rows = materialize_rows(batch, list(plan.schema), dicts)
@@ -342,7 +383,12 @@ class EngineServer:
                 "rows": len(rows),
                 "exec_s": exec_s,
                 "host": f"{socket.gethostname()}:{self.port}",
+                # worker-eyed engine accounting for THIS fragment
+                "mem_peak_bytes": frag_watch["mem_peak_bytes"],
+                "compile": frag_watch["compile"],
             }
+            if frag_events:
+                resp["events"] = frag_events
             if self.ship_registry:
                 # fleet observability: this process's counter movement
                 # rides the reply; the coordinator merges it behind the
@@ -471,17 +517,39 @@ class EngineServer:
         if spec.get("trace"):
             tracer.enabled = True
             tracer.reset()
+        # per-task engine-watch record: worker-eyed device-mem peak +
+        # compile cost ride the reply stats (see _execute)
+        from tidb_tpu.obs.engine_watch import (
+            ENGINE_WATCH,
+            set_cost_wanted,
+        )
+
+        ENGINE_WATCH.begin_query(
+            f"shuffle {spec.get('sid')}/p{spec.get('part')}"
+        )
+        set_cost_wanted(bool(spec.get("timeline")))
         t0 = _time.perf_counter()
         try:
             result = self.shuffle_worker().run_task(spec, tracer=tracer)
         except ShuffleAbort as e:
+            ENGINE_WATCH.end_query(_time.perf_counter() - t0)
             return json.dumps(
                 {
                     "id": req.get("id"), "ok": False, "retryable": "shuffle",
                     "suspects": e.suspects, "error": str(e),
                 }
             ).encode()
+        except BaseException:
+            ENGINE_WATCH.end_query(_time.perf_counter() - t0)
+            raise
+        finally:
+            set_cost_wanted(False)
         exec_s = _time.perf_counter() - t0
+        task_watch = {
+            "mem_peak_bytes": ENGINE_WATCH.current_peak_bytes(),
+            "compile": ENGINE_WATCH.current_compile_cost() or None,
+        }
+        ENGINE_WATCH.end_query(exec_s)
         resp = {
             "id": req.get("id"),
             "ok": True,
@@ -492,8 +560,12 @@ class EngineServer:
                 "rows": len(result["rows"]),
                 "exec_s": exec_s,
                 "host": f"{socket.gethostname()}:{self.port}",
+                "mem_peak_bytes": task_watch["mem_peak_bytes"],
+                "compile": task_watch["compile"],
             },
         }
+        if result.get("events"):
+            resp["events"] = result["events"]
         if tracer.enabled:
             resp["spans"] = [
                 [s.name, s.start_s, s.dur_s, s.depth] for s in tracer.spans

@@ -1,8 +1,14 @@
-"""HTTP status API: /status, /metrics, /schema, /settings, /dcn, /links.
+"""HTTP status API: /status, /metrics, /schema, /settings, /dcn,
+/links, /timeline.
 
 `/links` (PR 6) serves the per-peer DCN link health registry
 (obs/flight.py LINKS): handshake RTT, heartbeat age, and tunnel
 bytes/stall seconds/retransmits per link.
+
+`/timeline` (PR 9) drives the fleet timeline tracer (obs/timeline.py):
+GET /timeline dumps the captured Chrome trace-event JSON (save it,
+open in Perfetto / chrome://tracing); /timeline/start and
+/timeline/stop arm/disarm the bounded capture ring on demand.
 
 Reference: pkg/server/http_status.go — the side port serving liveness
 (`/status`), Prometheus metrics (`/metrics`), schema introspection
@@ -88,6 +94,29 @@ class StatusServer:
                         self._send(
                             200, json.dumps({"links": LINKS.snapshot()})
                         )
+                    elif path == "/timeline":
+                        from tidb_tpu.obs.timeline import TIMELINE
+
+                        self._send(200, TIMELINE.dump_json())
+                    elif path in ("/timeline/start", "/timeline/stop"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.obs.timeline import TIMELINE
+
+                        if path.endswith("/start"):
+                            qs = parse_qs(urlparse(self.path).query)
+                            cap = qs.get("capacity", [None])[0]
+                            TIMELINE.start(
+                                int(cap) if cap else None
+                            )
+                        else:
+                            TIMELINE.stop()
+                        self._send(200, json.dumps(
+                            {
+                                "active": TIMELINE.active(),
+                                "events": len(TIMELINE),
+                            }
+                        ))
                     elif path == "/metrics":
                         from tidb_tpu.utils.metrics import REGISTRY
 

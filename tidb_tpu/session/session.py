@@ -2901,6 +2901,31 @@ class Session(DDLMixin):
                     from tidb_tpu.utils.watchdog import ensure_watchdog
 
                     ensure_watchdog(self.catalog)
+                if s.name.lower() == "tidb_timeline_capture":
+                    # the capture gate is engine-wide (one merged
+                    # fleet timeline), armed/disarmed by the sysvar
+                    from tidb_tpu.obs.timeline import TIMELINE
+
+                    if self.vars.get("tidb_timeline_capture"):
+                        TIMELINE.start()
+                    else:
+                        TIMELINE.stop()
+                if s.name.lower().startswith("tidb_tpu_admission_"):
+                    # live re-tune of an attached scheduler's running
+                    # admission controller (construction-time wiring
+                    # is AdmissionController.from_sysvars)
+                    sched = getattr(self, "dcn_scheduler", None)
+                    adm = getattr(sched, "admission", None)
+                    if adm is not None:
+                        adm.budget_bytes = int(
+                            self.vars.get("tidb_tpu_admission_budget_bytes")
+                        )
+                        adm.max_queue = int(
+                            self.vars.get("tidb_tpu_admission_queue_limit")
+                        )
+                        adm.starvation_s = float(
+                            self.vars.get("tidb_tpu_admission_starvation_s")
+                        )
                 if s.name.lower() == "tidb_gc_life_time":
                     # side effect: the storage GC horizon is engine-wide.
                     # The sysvar is GLOBAL-only (set() above enforces
@@ -3969,10 +3994,21 @@ class Session(DDLMixin):
                 # admissions of this shape overcommit the budget
                 from tidb_tpu.obs.engine_watch import ENGINE_WATCH
 
-                ticket.release(
-                    observed_bytes=ENGINE_WATCH.current_peak_bytes()
-                    if dispatched else None
-                )
+                observed = None
+                if dispatched:
+                    # fleet-eyed estimate: workers report their OWN
+                    # per-fragment device-mem peaks in the fenced
+                    # replies (dcn._worker_mem_peak) — a worker-heavier
+                    # plan (pre-aggregation below the exchange) must
+                    # not learn from the coordinator's smaller
+                    # final-stage working set (ROADMAP PR 8 item)
+                    mine_fn = getattr(sched, "last_query_mine", None)
+                    lqm = (mine_fn() if callable(mine_fn) else None) or {}
+                    observed = max(
+                        ENGINE_WATCH.current_peak_bytes(),
+                        int(lqm.get("worker_mem_peak", 0) or 0),
+                    )
+                ticket.release(observed_bytes=observed)
         self._last_dcn_routed = True
         # snapshot the runtime stats NOW, from THIS THREAD's query
         # record (last_query is scheduler-global: under concurrent
@@ -5800,6 +5836,7 @@ class Session(DDLMixin):
 
                 try:
                     _cols, _rows, lines = sched.explain_analyze(plan)
+                    lines = lines + _compile_cost_lines()
                     # the instrumented lines ARE the plan capture: an
                     # over-threshold EXPLAIN ANALYZE's slow-log entry
                     # carries the genuine distributed EXPLAIN ANALYZE
@@ -5811,6 +5848,7 @@ class Session(DDLMixin):
                     # the local instrumented run
                     pass
             _out, _dicts, lines = self.executor.run_analyze(plan)
+            lines = lines + _compile_cost_lines(self.executor, plan)
             FLIGHT.note_plan_text("\n".join(lines))
             return Result(["plan"], [(l,) for l in lines])
         from tidb_tpu.planner.cardinality import est_rows
@@ -5824,6 +5862,43 @@ class Session(DDLMixin):
             resolver=self._resolve_table_for_read,
         )
         return Result(["plan"], [(l,) for l in lines])
+
+
+def _compile_cost_lines(executor=None, plan=None) -> List[str]:
+    """EXPLAIN ANALYZE compile row: the statement's summed XLA compile
+    cost analysis (obs/engine_watch.py — flops, bytes accessed, output
+    bytes harvested from the lowered programs this statement compiled).
+    The instrumented EXPLAIN ANALYZE run itself executes EAGER (no
+    jit), so when this statement compiled nothing the row falls back
+    to the PLAN SIGNATURE's cached per-digest cost (``cached=1``) —
+    the warm-plan case where the interesting compile already happened.
+    Empty when neither exists: the row reports measured analyses,
+    never an estimate."""
+    from tidb_tpu.obs.engine_watch import ENGINE_WATCH
+
+    cost = ENGINE_WATCH.current_compile_cost()
+    cached = False
+    if not cost and executor is not None and plan is not None:
+        try:
+            sig = executor.watch_sig(executor._cache_key(plan))
+            for phase in ("steady", "discover"):
+                c = ENGINE_WATCH.cost_for_sig((phase, sig))
+                if c:
+                    cost, cached = dict(c), True
+                    break
+        except Exception:
+            cost = {}
+    if not cost:
+        return []
+    head = (
+        "XLACompile cached=1" if cached
+        else f"XLACompile compiles={int(cost.get('compiles', 0))}"
+    )
+    parts = [head]
+    for key in ("flops", "bytes_accessed", "output_bytes"):
+        if key in cost:
+            parts.append(f"{key}={cost[key]:.0f}")
+    return [" ".join(parts)]
 
 
 def _dcn_runtime_lines(lq) -> List[str]:

@@ -623,6 +623,11 @@ class Catalog:
                    ("jit_compilations", INT64), ("retraces", INT64),
                    ("h2d_bytes", INT64), ("d2h_bytes", INT64),
                    ("device_mem_peak_bytes", INT64),
+                   # PR 9: per-digest XLA compile cost analysis
+                   # (obs/engine_watch.py watched_jit harvest)
+                   ("compile_flops", FLOAT64),
+                   ("compile_bytes_accessed", FLOAT64),
+                   ("compile_output_bytes", FLOAT64),
                    ("sample_text", STRING)]
             )
             rows = []
@@ -648,7 +653,11 @@ class Catalog:
                        e["rows_sent"], e["plan_cache_hits"],
                        e["plan_cache_misses"], e["jit_compilations"],
                        e["retraces"], e["h2d_bytes"], e["d2h_bytes"],
-                       e["device_mem_peak_bytes"], e["sample_text"])
+                       e["device_mem_peak_bytes"],
+                       e.get("compile_flops", 0.0),
+                       e.get("compile_bytes_accessed", 0.0),
+                       e.get("compile_output_bytes", 0.0),
+                       e["sample_text"])
                 )
         elif name == "cluster_links":
             # PR 6: per-peer DCN link health (obs/flight.py LINKS) —
@@ -690,7 +699,11 @@ class Catalog:
                  ("jit_compilations", INT64), ("retraces", INT64),
                  ("h2d_bytes", INT64), ("d2h_bytes", INT64),
                  ("device_mem_peak_bytes", INT64),
-                 ("duration", FLOAT64)]
+                 ("duration", FLOAT64),
+                 # PR 9: XLA compile cost analysis summed per query
+                 ("compile_flops", FLOAT64),
+                 ("compile_bytes_accessed", FLOAT64),
+                 ("compile_output_bytes", FLOAT64)]
             )
             rows = ENGINE_WATCH.rows()
         elif name == "resource_groups":

@@ -507,7 +507,8 @@ class _StmtEntry:
         "n", "sum_s", "max_s", "sample", "hist", "phases", "rows_sent",
         "plan_digest", "plan_cache_hits", "plan_cache_misses",
         "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
-        "device_mem_peak_bytes",
+        "device_mem_peak_bytes", "compile_flops",
+        "compile_bytes_accessed", "compile_output_bytes",
     )
 
     def __init__(self, sample: str):
@@ -527,6 +528,12 @@ class _StmtEntry:
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self.device_mem_peak_bytes = 0
+        # per-digest XLA compile cost analysis (obs/engine_watch.py):
+        # flops / bytes accessed / output bytes summed over the
+        # digest's compiles — which statement shapes are compile-heavy
+        self.compile_flops = 0.0
+        self.compile_bytes_accessed = 0.0
+        self.compile_output_bytes = 0.0
 
     def absorb_flight(self, flight) -> None:
         """Fold one finished QueryFlight (obs/flight.py) in."""
@@ -548,6 +555,15 @@ class _StmtEntry:
         self.d2h_bytes += int(flight.d2h_bytes)
         self.device_mem_peak_bytes = max(
             self.device_mem_peak_bytes, int(flight.device_mem_peak_bytes)
+        )
+        self.compile_flops += float(
+            getattr(flight, "compile_flops", 0.0)
+        )
+        self.compile_bytes_accessed += float(
+            getattr(flight, "compile_bytes_accessed", 0.0)
+        )
+        self.compile_output_bytes += float(
+            getattr(flight, "compile_output_bytes", 0.0)
         )
 
 
@@ -624,6 +640,9 @@ class StmtSummary:
                         "h2d_bytes": e.h2d_bytes,
                         "d2h_bytes": e.d2h_bytes,
                         "device_mem_peak_bytes": e.device_mem_peak_bytes,
+                        "compile_flops": e.compile_flops,
+                        "compile_bytes_accessed": e.compile_bytes_accessed,
+                        "compile_output_bytes": e.compile_output_bytes,
                         "sample_text": e.sample,
                     }
                 )

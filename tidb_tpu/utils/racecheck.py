@@ -97,6 +97,7 @@ LOCK_CLASSES: Dict[str, str] = {
     "engine_watch": "finished engine-watch records ring",
     "flight.ring": "finished query-flight ring",
     "flight.links": "per-peer DCN link health maps",
+    "timeline.ring": "fleet timeline tracer's bounded event ring",
     # utils
     "failpoint.registry": "armed failpoint actions",
     "failpoint.site": "one after_n() site's invocation counter",

@@ -59,7 +59,10 @@ class Tracer:
                 Span(name, start - self._t0, time.perf_counter() - start, depth)
             )
 
-    def add_remote(self, spans, label: str, base_s: float = 0.0) -> None:
+    def add_remote(
+        self, spans, label: str, base_s: float = 0.0,
+        base_depth: int = 1,
+    ) -> None:
         """Merge spans shipped back from a remote worker (the DCN
         fragment reply's span list), host-labeled so the coordinator's
         trace shows where each fragment ran. Accepts Span objects or
@@ -67,15 +70,36 @@ class Tracer:
         are relative to the worker's own clock; `base_s` rebases them
         onto this tracer's timeline (the caller knows when the reply
         landed) so rows()'s start-sorted output doesn't put every
-        remote span at time zero."""
+        remote span at time zero.
+
+        Depths rebase the same way clocks do: a worker's spans carry
+        depths relative to the WORKER's own nesting (a handler that
+        opened spans inside other spans ships depths 2, 3, ...), and
+        blindly clamping each to >= 1 kept absolute worker depths —
+        the coordinator's TRACE output then indented remote spans
+        under unrelated neighbouring rows (phantom parents) while a
+        worker whose spans all clamped together FLATTENED real
+        nesting. Instead the span list's minimum depth maps to
+        ``base_depth`` and every other span keeps its RELATIVE depth
+        under the host label, so a 2-level worker span renders as two
+        nested rows wherever it lands in the merged trace."""
+        rel = []
         for s in spans:
             if isinstance(s, Span):
-                name, start_s, dur_s, depth = s.name, s.start_s, s.dur_s, s.depth
+                name, start_s, dur_s, depth = (
+                    s.name, s.start_s, s.dur_s, s.depth
+                )
             else:
                 name, start_s, dur_s, depth = s
+            rel.append((name, float(start_s), float(dur_s), int(depth)))
+        if not rel:
+            return
+        dmin = min(d for _n, _s, _d, d in rel)
+        base_depth = max(int(base_depth), 1)
+        for name, start_s, dur_s, depth in rel:
             self.spans.append(
-                Span(f"{label}:{name}", float(start_s) + float(base_s),
-                     float(dur_s), max(int(depth), 1))
+                Span(f"{label}:{name}", start_s + float(base_s),
+                     dur_s, base_depth + (depth - dmin))
             )
 
     def rows(self):
