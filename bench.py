@@ -1216,6 +1216,61 @@ def measure_multihost_shuffle(args) -> int:
     return rc
 
 
+def measure_chaos(args) -> int:
+    """Chaos robustness scenario: N seeded composed-fault episodes
+    (worker crash / hang / frame loss / delay / slow peer / tunnel
+    partition / clock skew) against an in-process 2-server fleet
+    running --multihost-shuffle-shaped workloads (repartition joins +
+    distinct group-bys over the tunnels, grouped aggregates over the
+    partial-agg cut), with the fleet invariants audited after EVERY
+    episode. Stamps detail.chaos — episodes, faults injected,
+    invariant violations (0 is the bar), recovery-wall p50/p95 — so
+    the robustness trajectory is benchable like perf: a regression
+    that slows recovery or leaks a buffer moves a number here."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from tidb_tpu.chaos import ChaosHarness
+
+    episodes = max(int(args.chaos_episodes), 1)
+    seed = int(args.chaos_seed)
+    t0 = time.time()
+    with ChaosHarness(seed=seed, wait_timeout_s=2.0) as h:
+        rep = h.run(episodes)
+    wall = time.time() - t0
+    detail = rep.to_dict()
+    result = {
+        "metric": f"chaos_episodes_seed{seed}_per_sec",
+        "value": round(episodes / max(wall, 1e-9), 4),
+        "unit": "episodes/s",
+        "detail": {
+            "backend": "cpu",
+            "scenario": "chaos",
+            "workers": 2,
+            "wall_seconds": round(wall, 3),
+            "chaos": detail,
+            "backend_provenance": {
+                "backend": "cpu",
+                "pjrt_backend": "cpu",
+                "code_version": _code_version(),
+                "captured_unix": int(time.time()),
+                "fallback": False,
+            },
+        },
+    }
+    rc = 0
+    if args.out:
+        args.cpu = True  # deliberate CPU scenario: not a fallback
+        rc = _write_out(args, result)
+    if detail["invariant_violations"]:
+        # a violated invariant fails the run loudly — AFTER the
+        # capture is written (the violating run's record is exactly
+        # the artifact a robustness regression needs)
+        rc = 1
+    print(json.dumps(result))
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     # SF10 headline: BASELINE.md's ladder runs SF10-SF100 and the north
@@ -1309,6 +1364,21 @@ def main() -> int:
     ap.add_argument("--no-serve-kill-worker", dest="serve_kill_worker",
                     action="store_false")
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="run the chaos robustness scenario instead of the "
+        "single-engine ladder: N seeded composed-fault episodes "
+        "(crash/hang/frame loss/delay/slow peer/tunnel partition/"
+        "clock skew) over an in-process 2-server fleet, auditing "
+        "fleet invariants after every episode; stamps detail.chaos "
+        "(episodes, faults, invariant violations, recovery-wall "
+        "p50/p95). A violated invariant exits nonzero.",
+    )
+    ap.add_argument("--chaos-episodes", type=int, default=20,
+                    help="episodes per chaos run")
+    ap.add_argument("--chaos-seed", type=int, default=1,
+                    help="schedule seed (the same seed replays the "
+                    "same fault schedule exactly)")
+    ap.add_argument(
         "--racecheck", action="store_true",
         help="with --multihost-shuffle: run the worker processes under "
         "TIDB_TPU_RACECHECK=1 (order-tracked locks, utils/racecheck.py)"
@@ -1326,6 +1396,8 @@ def main() -> int:
         if args.sf == 10.0:  # the ladder default is not a dryrun scale
             args.sf = 0.005
         return run_serve_load(args)
+    if args.chaos:
+        return measure_chaos(args)
     if args.multihost_shuffle:
         return measure_multihost_shuffle(args)
 

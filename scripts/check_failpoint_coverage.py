@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Lint: every failpoint site in utils/failpoint.py SITES must be
+EXERCISED — referenced by at least one test (tests/**.py) or chaos
+schedule/sweep (tidb_tpu/chaos/**.py).
+
+Why: check_failpoints.py already guarantees a declared site has an
+inject() call site, but an inject nobody ever arms is untested fault
+handling — the error path it guards has never run. The chaos package
+makes coverage cheap (tidb_tpu/chaos/sweep.py declares a workload per
+site and the tier-1 sweep test asserts every one actually FIRES;
+tidb_tpu/chaos/schedule.py arms the DCN/shuffle sites under composed
+fault storms), so a site with no reference anywhere is dead robustness
+code: either cover it or delete it.
+
+A site counts as covered when its literal name appears ANYWHERE in a
+covered file (enable(...), a sweep SWEEP entry, a schedule fault, an
+assertion message quoting the site). That is deliberately permissive
+at the string level — the runtime sweep test is what keeps the chaos
+references honest (a listed-but-untraversed site fails there).
+
+Usage: python scripts/check_failpoint_coverage.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_failpoints import load_sites  # noqa: E402
+
+#: directories whose *.py files count as coverage
+COVERED_DIRS = (
+    "tests",
+    os.path.join("tidb_tpu", "chaos"),
+)
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules"}
+
+
+def iter_covered(root: str):
+    for sub in COVERED_DIRS:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check(root: str):
+    sites = load_sites(root)
+    pat = re.compile(
+        r"[\"'](" + "|".join(re.escape(s) for s in sorted(sites)) + r")[\"']"
+    )
+    covered = set()
+    for path in sorted(iter_covered(root)):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in pat.finditer(text):
+            covered.add(m.group(1))
+    violations = []
+    for name in sorted(sites - covered):
+        violations.append(
+            (os.path.join("tidb_tpu", "utils", "failpoint.py"), 0,
+             f"site {name!r} is exercised by no test or chaos "
+             "schedule (add it to a tidb_tpu/chaos/sweep.py workload, "
+             "arm it in a test, or delete the dead site)")
+        )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} failpoint-coverage violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,9 +30,11 @@ import sys
 #: flight = the query flight recorder, link = per-peer DCN link health
 #: (both PR 6), admission = the serving tier's fleet admission
 #: controller (PR 8, parallel/serving.py), timeline = the fleet
-#: timeline tracer (PR 9, obs/timeline.py).
+#: timeline tracer (PR 9, obs/timeline.py), chaos = the deterministic
+#: fault-injection harness (PR 10, tidb_tpu/chaos/).
 SUBSYSTEMS = frozenset({
     "admission",
+    "chaos",
     "dcn",
     "engine",
     "executor",

@@ -780,3 +780,210 @@ def test_dcn_worker_death_mid_query_retry_parity(tpch_single):
         sched.close()
         for w in (w1, w2):
             w.kill()
+
+
+def test_dcn_fleet_cancellation_kill_and_max_execution_time(tpch_single):
+    """ISSUE 10 acceptance: KILL and max_execution_time on a routed
+    query cancel WORKER-SIDE fragments and shuffle tasks. Both workers
+    are armed with a worker-side hang failpoint (shuffle/produce
+    sleeps 30s via --chaos-spec); the kill must broadcast cancel_query
+    so worker task threads exit and staged buffers free LONG before
+    the hang would, and the killed statement's flight record still
+    lands in statements_summary with its phase breakdown."""
+    import json as _json
+    import threading
+    import time
+
+    from tidb_tpu.chaos.schedule import Fault
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_rpc import EngineClient
+    from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+    # a 2-hit hang window per worker: the KILL statement consumes the
+    # first hit, the max_execution_time statement the second, and the
+    # final parity query runs against healthy workers
+    spec = _json.dumps([
+        Fault("worker-hang", "shuffle/produce", "hang", n=2,
+              param=30.0).to_dict(),
+    ])
+    w1, p1 = _spawn_dcn_worker(["--chaos-spec", spec])
+    w2, p2 = _spawn_dcn_worker(["--chaos-spec", spec])
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+        shuffle_wait_timeout_s=60.0,
+    )
+    sess = tpch_single
+    q = SHUFFLE_QUERIES[0]
+    sess.attach_dcn_scheduler(sched)
+
+    def assert_workers_clean():
+        """Worker task threads exited and staged buffers freed —
+        polled over the engine_status introspection frame."""
+        deadline = time.monotonic() + 10.0
+        while True:
+            states = []
+            for port in (p1, p2):
+                c = EngineClient("127.0.0.1", port, timeout_s=5.0)
+                try:
+                    states.append(c.engine_status())
+                finally:
+                    c.close()
+            if all(
+                st["stages_buffered"] == 0
+                and not st["shuffle_threads"]
+                for st in states
+            ):
+                return
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"worker-side work outlived the kill: {states}"
+                )
+            time.sleep(0.1)
+
+    try:
+        # -- KILL QUERY mid-hang ---------------------------------------
+        errors = []
+
+        def runner():
+            try:
+                sess.execute(q)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        t = threading.Thread(target=runner, daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        # kill only once the dispatch REACHED the workers (their
+        # stores opened a stage record) — a blind sleep races worker
+        # startup and can kill before/never-reaching the hung produce
+        wait_deadline = time.monotonic() + 30.0
+        while time.monotonic() < wait_deadline:
+            opened = 0
+            for port in (p1, p2):
+                c = EngineClient("127.0.0.1", port, timeout_s=5.0)
+                try:
+                    opened += c.engine_status()["stages_buffered"]
+                finally:
+                    c.close()
+            if opened >= 2:
+                break
+            time.sleep(0.1)
+        assert opened >= 2, "dispatch never reached the workers"
+        time.sleep(0.3)  # both tasks are in the hung produce now
+        sess.killer.kill()
+        t.join(timeout=30)
+        assert not t.is_alive(), "killed statement never returned"
+        wall = time.monotonic() - t0
+        assert errors and "interrupted" in errors[0], errors
+        assert wall < 25.0, (
+            f"kill took {wall:.1f}s — the 30s worker hang was not "
+            "cancelled"
+        )
+        assert_workers_clean()
+        # the killed statement's flight record landed, phases intact
+        ent = next(
+            e for e in STMT_SUMMARY.rows_full()
+            if e["digest_text"] == sql_digest(q)
+        )
+        assert ent["exec_count"] >= 1
+        assert ent["max_latency"] > 0  # the wait it paid is visible
+        assert "parse" in ent["phases"] and "plan" in ent["phases"]
+
+        # -- max_execution_time mid-hang -------------------------------
+        # (the second --chaos-spec hang hit arms each worker's n=1
+        # once; re-arm by statement: the deadline also PROPAGATES so
+        # the worker self-cancels even without the coordinator watch)
+        sess.execute("set max_execution_time = 1200")
+        t0 = time.monotonic()
+        try:
+            sess.execute(q)
+            raise AssertionError("max_execution_time never fired")
+        except Exception as e:
+            assert "interrupted" in str(e), e
+        wall = time.monotonic() - t0
+        assert wall < 20.0, f"deadline abort took {wall:.1f}s"
+        sess.execute("set max_execution_time = 0")
+        assert_workers_clean()
+        # the fleet is healthy after both aborts: same query, parity
+        exp = None
+        sess.attach_dcn_scheduler(None)
+        exp = sess.must_query(q).rows
+        sess.attach_dcn_scheduler(sched)
+        r = sess.execute(q)
+        assert r.rows == exp
+    finally:
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        for w in (w1, w2):
+            w.kill()
+
+
+def test_dcn_multihost_chaos_composed_faults(tpch_single):
+    """ISSUE 10 acceptance: a seeded chaos schedule composing crash +
+    hang + frame loss over the 2-process dryrun — worker 1 hard-exits
+    (os._exit) on a pushed frame, worker 0 hangs a produce and drops
+    frames probabilistically — passes all fleet invariants with exact
+    row parity, and the same seed replays the same fault schedule
+    deterministically."""
+    import json as _json
+    import time
+
+    from tidb_tpu.chaos.schedule import generate_worker_specs
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_pool import FailedEngineProber
+    from tidb_tpu.server.engine_rpc import EngineClient
+
+    SEED = 1310
+    specs = generate_worker_specs(SEED, 2)
+    assert specs == generate_worker_specs(SEED, 2)  # replayable
+    classes = {f["cls"] for spec in specs for f in spec}
+    assert {"worker-crash", "worker-hang", "frame-drop"} <= classes
+    workers, ports = [], []
+    for spec in specs:
+        w, p = _spawn_dcn_worker(
+            ["--chaos-spec", _json.dumps(spec)]
+        )
+        workers.append(w)
+        ports.append(p)
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p) for p in ports],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+        shuffle_wait_timeout_s=15.0,
+        retry_backoff_s=0.05,
+        prober=FailedEngineProber(initial_backoff_s=60),
+    )
+    t0 = time.monotonic()
+    try:
+        for q in SHUFFLE_QUERIES:
+            exp = tpch_single.must_query(q).rows
+            _cols, got = sched.execute_plan(_plan(tpch_single, q))
+            assert got == exp, (
+                f"chaos parity broke (seed {SEED}):\n got={got}\n"
+                f" exp={exp}"
+            )
+        # the crash CLASS really fired: the last worker died via
+        # os._exit(3) and was quarantined; survivors carried parity
+        workers[-1].wait(timeout=30)
+        assert workers[-1].returncode == 3
+        assert [e.port for e in sched.prober.failed_endpoints()] == (
+            [ports[-1]]
+        )
+        # bounded recovery wall for the whole composed run
+        assert time.monotonic() - t0 < 120.0
+        # no leaked coordinator-side leases, no orphaned buffers on
+        # the SURVIVING worker
+        assert all(v == 0 for v in sched.pool_leased().values())
+        c = EngineClient("127.0.0.1", ports[0], timeout_s=5.0)
+        try:
+            st = c.engine_status()
+        finally:
+            c.close()
+        assert st["stages_buffered"] == 0
+        assert not st["shuffle_threads"]
+    finally:
+        sched.close()
+        for w in workers:
+            w.kill()

@@ -283,20 +283,24 @@ def run_serve_load(args) -> int:
             budget_bytes=int(args.serve_budget_mb) << 20,
             queue_timeout_s=600.0,
         )
+        # loopback-scale shuffle wait via the SYSVAR, not a hardcoded
+        # ctor arg (same config plane a SET GLOBAL uses; an operator's
+        # pre-set global wins over the driver's loopback default). The
+        # WAN-scale 120s default makes kill-a-worker recovery
+        # minutes-long here — every straddled stage's SURVIVOR sits
+        # out the full wait for the dead peer's frames before its
+        # retryable reply, and under 64 sessions those waits stack. On
+        # loopback a healthy side arrives in milliseconds, so 10s is
+        # already three orders of magnitude of slack.
+        cat.global_sysvars.setdefault(
+            "tidb_tpu_shuffle_wait_timeout_s", 10.0
+        )
         sched = DCNFragmentScheduler(
             [("127.0.0.1", pt) for pt in ports],
             catalog=cat,
             # route joins over worker-to-worker tunnels even at dryrun
             # scale; grouped aggregates take the partial-agg frag cut
             shuffle_min_rows=1,
-            # loopback-scale timeouts: the WAN defaults (120s shuffle
-            # wait) make kill-a-worker recovery minutes-long here —
-            # every straddled stage's SURVIVOR sits out the full wait
-            # for the dead peer's frames before its retryable reply,
-            # and under 64 sessions those waits stack. On loopback a
-            # healthy side arrives in milliseconds, so 10s is already
-            # three orders of magnitude of slack.
-            shuffle_wait_timeout_s=10.0,
             dispatch_timeout_s=180.0,
             conn_pool_size=int(args.serve_pool_size),
             admission=admission,

@@ -1,0 +1,46 @@
+"""Chaos fleet: deterministic, seed-replayable fault injection.
+
+Reference: the prober/quarantine/cancel loop the reference treats as
+load-bearing for HTAP serving (mpp_probe.go, MPPTask cancellation) and
+chaos-mesh-style composed fault schedules, rebuilt on the engine's own
+declared failpoint registry (utils/failpoint.py) so every injected
+fault is a REAL code path, not a mock.
+
+Three pieces:
+
+- ``schedule``  — declared fault classes (worker crash, worker hang,
+  frame drop/delay, slow peer, asymmetric tunnel partition, clock
+  skew) composed into episodes by a seeded PRNG: the same seed always
+  yields byte-identical schedules, so a failing run replays exactly
+  and becomes a pinned regression test.
+- ``harness``   — drives schedules over an in-process 2-server fleet
+  (and, via worker chaos specs, the multi-process dryrun), asserting
+  fleet invariants after every episode: exact row parity, zero
+  buffered shuffle stages, drained admission budget, zero leased
+  control connections, no leaked shuffle threads, bounded recovery
+  wall.
+- ``sweep``     — the failpoint-coverage sweep: a declared workload
+  per failpoint site, run with a counting hook armed, proving every
+  declared site is actually traversable (scripts/
+  check_failpoint_coverage.py statically enforces that every SITES
+  entry appears in a test or a chaos schedule).
+"""
+
+from tidb_tpu.chaos.harness import ChaosHarness, ChaosReport
+from tidb_tpu.chaos.schedule import (
+    FAULT_CLASSES,
+    ChaosSchedule,
+    Episode,
+    Fault,
+    arm_spec,
+)
+
+__all__ = [
+    "ChaosHarness",
+    "ChaosReport",
+    "ChaosSchedule",
+    "Episode",
+    "Fault",
+    "FAULT_CLASSES",
+    "arm_spec",
+]

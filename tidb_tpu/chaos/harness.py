@@ -1,0 +1,305 @@
+"""Chaos harness: run seeded fault schedules and assert fleet
+invariants after EVERY episode.
+
+The in-process fleet (two EngineServers + a DCNFragmentScheduler +
+AdmissionController over one small deterministic catalog) is the
+tier-1 shape: fast enough to run dozens of composed-fault episodes in
+a test, with full introspection into both workers' shuffle stores.
+Faults arm through the declared failpoint registry
+(tidb_tpu/chaos/schedule.py), so every "chaos" is a real engine code
+path misfiring. The multi-process dryrun reuses the same schedule
+machinery via dcn_worker --chaos-spec (tests/test_multihost.py).
+
+Invariants checked after every episode (ISSUE 10's list):
+
+- exact row parity against the single-engine reference;
+- exactly-once landing — parity IS the proof (a double-admitted frame
+  or replayed ledger delivery would corrupt the rows), with the fence
+  drop counters exported for inspection;
+- the admission budget drains back to zero (running=0, inuse=0);
+- no orphaned shuffle buffers on any worker (stages_buffered == 0);
+- no leaked control-connection leases (pool_leased all zero);
+- no leaked shuffle threads (shuffle-q*/shuffle-ship*/shuffle-tx*
+  all exited);
+- bounded recovery wall (episode wall <= max_wall_s).
+
+A violated invariant lands in the report AND in
+``tidbtpu_chaos_invariant_violations_total`` — and because the
+schedule is a pure function of the seed, the failing episode replays
+exactly: pin the seed in a regression test (README "Chaos testing &
+cancellation" documents the workflow).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tidb_tpu.chaos import schedule as _schedule
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: thread-name prefixes that must not outlive an episode
+_TASK_THREAD_PREFIXES = ("shuffle-q", "shuffle-ship", "shuffle-tx")
+
+
+def _c_episodes():
+    return REGISTRY.counter(
+        "tidbtpu_chaos_episodes_total",
+        "chaos episodes run (composed-fault query executions)",
+    )
+
+
+def _c_faults():
+    return REGISTRY.counter(
+        "tidbtpu_chaos_faults_armed_total",
+        "faults armed by chaos schedules, by declared class",
+        labels=("cls",),
+    )
+
+
+def _c_violations():
+    return REGISTRY.counter(
+        "tidbtpu_chaos_invariant_violations_total",
+        "fleet invariants violated after a chaos episode (0 is the "
+        "acceptance bar)",
+    )
+
+
+class ChaosReport:
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.episodes = 0
+        self.faults: Dict[str, int] = {}
+        self.violations: List[str] = []
+        self.recovery_wall_s: List[float] = []
+
+    def _pct(self, q: float) -> float:
+        if not self.recovery_wall_s:
+            return 0.0
+        xs = sorted(self.recovery_wall_s)
+        i = min(int(q * len(xs)), len(xs) - 1)
+        return round(xs[i], 6)
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "episodes": self.episodes,
+            "faults_injected": dict(self.faults),
+            "invariant_violations": len(self.violations),
+            "violations": list(self.violations),
+            "recovery_wall_p50_s": self._pct(0.50),
+            "recovery_wall_p95_s": self._pct(0.95),
+        }
+
+
+class ChaosHarness:
+    """Seeded chaos over an in-process 2-server fleet."""
+
+    #: the default workload: shapes that exercise BOTH cut kinds
+    #: (repartition join + distinct group-by ride the shuffle tunnels;
+    #: plain group-bys take the partial-agg fragment cut) so crash
+    #: faults on dcn/* and shuffle/* sites both find live traffic
+    QUERIES = (
+        "select b, count(*), sum(v) from t join u on a = k "
+        "group by b order by b",
+        "select b, count(distinct a) from t group by b order by b",
+        "select a, count(*), sum(c) from t join u on a = k "
+        "group by a order by a",
+        "select b, max(c), min(c), count(*) from t group by b "
+        "order by b",
+    )
+
+    def __init__(
+        self,
+        seed: int = 1,
+        wait_timeout_s: float = 2.0,
+        max_wall_s: float = 30.0,
+        max_attempts: int = 6,
+    ):
+        from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+        from tidb_tpu.parallel.serving import AdmissionController
+        from tidb_tpu.server.engine_pool import FailedEngineProber
+        from tidb_tpu.server.engine_rpc import EngineServer
+        from tidb_tpu.parser.sqlparse import parse
+        from tidb_tpu.planner.logical import build_query
+        from tidb_tpu.session.session import Session
+
+        self.seed = int(seed)
+        self.max_wall_s = float(max_wall_s)
+        sess = Session()
+        sess.execute("create table t (a int, b varchar(8), c int)")
+        sess.execute(
+            "insert into t values (1,'x',5),(2,'y',6),(3,'x',7),"
+            "(4,null,8),(2,'x',9),(7,'y',1),(1,'y',2),(3,'z',3)"
+        )
+        sess.execute("create table u (k int, v int)")
+        sess.execute(
+            "insert into u values (1,10),(2,20),(3,30),(4,40),(1,11),"
+            "(7,70),(3,31)"
+        )
+        self.session = sess
+        self.expected = [
+            sess.must_query(q).rows for q in self.QUERIES
+        ]
+        self.plans = [
+            build_query(
+                parse(q)[0], sess.catalog, "test", sess._scalar_subquery
+            )
+            for q in self.QUERIES
+        ]
+        self.servers = [
+            EngineServer(sess.catalog, port=0) for _ in range(2)
+        ]
+        for s in self.servers:
+            s.start_background()
+        self.admission = AdmissionController(queue_timeout_s=120.0)
+        self.sched = DCNFragmentScheduler(
+            [("127.0.0.1", s.port) for s in self.servers],
+            catalog=sess.catalog,
+            shuffle_mode="always",
+            shuffle_wait_timeout_s=float(wait_timeout_s),
+            max_attempts=int(max_attempts),
+            retry_backoff_s=0.02,
+            # in-process "crashes" are dropped replies, not dead
+            # servers: verify pings succeed, so quarantine is rare —
+            # but when a storm does quarantine a host, recover fast
+            prober=FailedEngineProber(initial_backoff_s=0.05),
+            admission=self.admission,
+        )
+
+    def close(self) -> None:
+        from tidb_tpu.utils import failpoint
+
+        failpoint.disable_all()
+        self.sched.close()
+        for s in self.servers:
+            s.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- invariants -----------------------------------------------------
+    def check_invariants(self, note) -> List[str]:
+        """The post-episode fleet-state audit; ``note`` labels the
+        episode in violation messages. Transient states (a checkin or
+        thread exit microseconds away) get a short settle loop before
+        being declared leaks."""
+        out: List[str] = []
+
+        def settle(cond, what: str, timeout_s: float = 5.0):
+            end = time.monotonic() + timeout_s
+            while time.monotonic() < end:
+                if cond():
+                    return
+                time.sleep(0.02)
+            out.append(f"{note}: {what}")
+
+        settle(
+            lambda: all(
+                s._shuffle is None
+                or s._shuffle.store.buffered_stages() == 0
+                for s in self.servers
+            ),
+            "orphaned shuffle buffers (stages_buffered != 0)",
+        )
+        settle(
+            lambda: all(
+                v == 0 for v in self.sched.pool_leased().values()
+            ),
+            f"leaked control-connection leases "
+            f"{self.sched.pool_leased()}",
+        )
+        settle(
+            lambda: (
+                self.admission.status()["running"] == 0
+                and self.admission.status()["inuse_bytes"] == 0
+            ),
+            f"admission budget not drained {self.admission.status()}",
+        )
+        settle(
+            lambda: not [
+                t.name for t in threading.enumerate()
+                if t.is_alive()
+                and t.name.startswith(_TASK_THREAD_PREFIXES)
+            ],
+            "leaked shuffle task/shipper/tunnel threads",
+        )
+        return out
+
+    # -- episodes -------------------------------------------------------
+    def run_episode(self, ep: "_schedule.Episode"):
+        """Arm the episode's faults, run its query through admission +
+        the fleet, disarm, audit. Returns (violations, wall_seconds);
+        an empty violation list is a clean episode."""
+        from tidb_tpu.chaos.schedule import arm_spec, disarm
+
+        _c_episodes().inc()
+        violations: List[str] = []
+        note = f"seed={self.seed} episode={ep.index}"
+        for f in ep.faults:
+            _c_faults().labels(cls=f.cls).inc()
+        armed = arm_spec(ep.faults)
+        t0 = time.perf_counter()
+        try:
+            ticket = self.admission.admit(None)
+            try:
+                _cols, got = self.sched.execute_plan(
+                    self.plans[ep.query]
+                )
+            finally:
+                ticket.release()
+            if got != self.expected[ep.query]:
+                violations.append(
+                    f"{note}: row parity broke (exactly-once "
+                    f"violated?) got={got} "
+                    f"exp={self.expected[ep.query]}"
+                )
+        except Exception as e:
+            # a bounded-fault episode must RECOVER, not error: retry
+            # budgets and suspect verification exist for exactly this
+            violations.append(
+                f"{note}: query failed under faults: "
+                f"{type(e).__name__}: {e}"
+            )
+        finally:
+            disarm(armed)
+            # give any storm-quarantined host its recovery shot before
+            # the next episode (and exercise the readmission path —
+            # tidbtpu_dcn_readmissions_total — under chaos)
+            try:
+                self.sched.prober.probe_once()
+            except Exception:
+                pass
+        wall = time.perf_counter() - t0
+        if wall > self.max_wall_s:
+            violations.append(
+                f"{note}: recovery wall {wall:.2f}s exceeds "
+                f"{self.max_wall_s}s"
+            )
+        violations.extend(self.check_invariants(note))
+        for _ in violations:
+            _c_violations().inc()
+        return violations, wall
+
+    def run(
+        self,
+        n_episodes: int,
+        classes: Optional[List[str]] = None,
+        max_faults: int = 3,
+    ) -> ChaosReport:
+        sched = _schedule.ChaosSchedule.generate(
+            self.seed, n_episodes, len(self.QUERIES),
+            classes=classes, max_faults=max_faults,
+        )
+        report = ChaosReport(self.seed)
+        report.faults = sched.fault_counts()
+        for ep in sched.episodes:
+            violations, wall = self.run_episode(ep)
+            report.episodes += 1
+            report.recovery_wall_s.append(wall)
+            report.violations.extend(violations)
+        return report

@@ -1,0 +1,285 @@
+"""Deterministic fault schedules over the declared failpoint registry.
+
+A ``Fault`` names one failpoint site and HOW it misbehaves (the
+declared action kinds below); an ``Episode`` composes several faults
+over one query; a ``ChaosSchedule`` is the seeded sequence of episodes.
+Generation is a pure function of (seed, episode count, fault classes)
+— ``ChaosSchedule.generate`` called twice with the same arguments
+returns equal schedules (dataclass equality, asserted in
+tests/test_chaos.py), which is what makes a failing seed a pinned
+regression test instead of a flake report.
+
+Fault classes and the real mechanism each exercises:
+
+- ``worker-crash``      — DropConnection on a worker-side dispatch
+  site: the reply is lost mid-flight (the work may or may not have
+  happened), forcing the re-dispatch/ledger-fence path.
+- ``worker-hang``       — an interruptible hang on the produce site:
+  the peer's consumer rides its wait to the timeout, reports the
+  suspect, and the stage retries — unless fleet cancellation aborts
+  the hang first (the hang polls the thread-local killer, so a
+  cancel_query frame lands mid-sleep).
+- ``frame-drop``        — seeded-probabilistic transport loss on the
+  tunnel push site: retransmit + receiver dedupe must stay
+  exactly-once.
+- ``frame-delay``       — seeded-probabilistic extra latency on the
+  push site (a jittery link).
+- ``slow-peer``         — seeded-probabilistic receive-side latency
+  (a GC-pausing peer): backpressure windows fill, producers stall.
+- ``tunnel-partition``  — the first K pushes fail: worker-to-worker
+  tunnels die while the coordinator still reaches both hosts (the
+  asymmetric A<->B partition) — the suspect-verify ping SUCCEEDS, so
+  nothing is quarantined and the stage must recover by retrying over
+  the healed window.
+- ``clock-skew``        — the handshake advertises a shifted wall
+  clock: clock-offset sampling and span/timeline rebasing run under
+  skew (parity must be unaffected; only telemetry geometry shifts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Sequence
+
+from tidb_tpu.utils import failpoint
+
+#: declared fault classes (the failpoint-SITES pattern): a schedule may
+#: only compose classes named here, and scripts/
+#: check_failpoint_coverage.py counts the sites they arm as covered.
+FAULT_CLASSES = (
+    "worker-crash",
+    "worker-hang",
+    "frame-drop",
+    "frame-delay",
+    "slow-peer",
+    "tunnel-partition",
+    "clock-skew",
+)
+
+#: action kinds arm_spec() knows how to build. "exit" hard-kills the
+#: PROCESS (os._exit — real crash semantics) and is only meaningful in
+#: worker processes (dcn_worker --chaos-spec); in-process schedules use
+#: "drop" (DropConnection: the reply vanishes, the server lives).
+KINDS = ("drop", "exit", "hang", "seeded-error", "seeded-delay",
+         "window-error", "value")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    cls: str       # declared fault class
+    site: str      # failpoint site to arm
+    kind: str      # one of KINDS
+    n: int = 1     # after_n hit (drop/exit/hang) or window length
+    p: float = 0.0    # per-invocation probability (seeded-*)
+    seed: int = 0     # PRNG seed for seeded-* kinds
+    param: float = 0.0  # seconds (hang/delay) or value (clock skew)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Episode:
+    index: int
+    query: int            # index into the harness's query list
+    faults: tuple         # Tuple[Fault, ...]
+
+
+def _build_action(fault: Fault):
+    """One armable failpoint action for a Fault — shared by the
+    in-process harness and the worker-process --chaos-spec path so
+    both fleets misbehave identically for the same schedule."""
+    from tidb_tpu.server.engine_rpc import DropConnection
+    from tidb_tpu.utils.sqlkiller import interruptible_sleep
+
+    if fault.kind == "drop":
+        return failpoint.after_n(fault.n, DropConnection("chaos"))
+    if fault.kind == "exit":
+        import os
+
+        return failpoint.after_n(fault.n, lambda: os._exit(3))
+    if fault.kind == "hang":
+        # a WINDOW of hangs (the first n hits each sleep param
+        # seconds), interruptible: the sleep polls the thread-local
+        # killer, so fleet cancellation (cancel_query) aborts a hang
+        # mid-sleep — a hung-but-abortable worker, the exact shape
+        # KILL/max_execution_time must handle
+        return failpoint.times(
+            fault.n, lambda: interruptible_sleep(fault.param)
+        )
+    if fault.kind == "seeded-error":
+        return failpoint.seeded(
+            fault.seed, fault.p,
+            ConnectionError(f"chaos: {fault.cls} on {fault.site}"),
+        )
+    if fault.kind == "seeded-delay":
+        return failpoint.seeded(
+            fault.seed, fault.p,
+            lambda: interruptible_sleep(fault.param),
+        )
+    if fault.kind == "window-error":
+        return failpoint.times(
+            fault.n,
+            ConnectionError(f"chaos: {fault.cls} on {fault.site}"),
+        )
+    if fault.kind == "value":
+        return fault.param
+    raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+
+def arm_spec(faults: Sequence) -> List[str]:
+    """Arm a list of Faults (or their to_dict() forms — the JSON shape
+    dcn_worker --chaos-spec ships); returns the armed site names so
+    the caller can disarm them after the episode."""
+    armed = []
+    for f in faults:
+        if isinstance(f, dict):
+            f = Fault.from_dict(f)
+        failpoint.enable(f.site, _build_action(f))
+        armed.append(f.site)
+    return armed
+
+
+def disarm(sites: Sequence[str]) -> None:
+    for s in sites:
+        failpoint.disable(s)
+
+
+def _make_fault(cls: str, rng: random.Random) -> Fault:
+    """One fault of ``cls`` with seeded parameters. Durations are
+    loopback-scale (the harness's wait timeout is ~2s); probabilities
+    are low enough that retry budgets recover, which is the point —
+    the invariants must hold THROUGH recovery, not because nothing
+    actually failed."""
+    if cls == "worker-crash":
+        site = rng.choice(
+            ["dcn/fragment-execute", "dcn/result-send", "shuffle/recv"]
+        )
+        return Fault(cls, site, "drop", n=rng.randint(1, 3))
+    if cls == "worker-hang":
+        return Fault(
+            cls, "shuffle/produce", "hang", n=rng.randint(1, 2),
+            param=round(rng.uniform(2.5, 4.0), 3),
+        )
+    if cls == "frame-drop":
+        return Fault(
+            cls, "shuffle/push-lost", "seeded-error",
+            p=round(rng.uniform(0.02, 0.08), 4),
+            seed=rng.randint(0, 2 ** 31),
+        )
+    if cls == "frame-delay":
+        return Fault(
+            cls, "shuffle/push", "seeded-delay",
+            p=round(rng.uniform(0.05, 0.2), 4),
+            seed=rng.randint(0, 2 ** 31),
+            param=round(rng.uniform(0.01, 0.05), 4),
+        )
+    if cls == "slow-peer":
+        return Fault(
+            cls, "shuffle/recv", "seeded-delay",
+            p=round(rng.uniform(0.05, 0.2), 4),
+            seed=rng.randint(0, 2 ** 31),
+            param=round(rng.uniform(0.01, 0.05), 4),
+        )
+    if cls == "tunnel-partition":
+        return Fault(
+            cls, "shuffle/push-lost", "window-error",
+            n=rng.randint(2, 6),
+        )
+    if cls == "clock-skew":
+        return Fault(
+            cls, "engine/clock-skew", "value",
+            param=round(rng.uniform(-5.0, 5.0), 3),
+        )
+    raise ValueError(f"unknown fault class {cls!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    seed: int
+    episodes: tuple  # Tuple[Episode, ...]
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        n_episodes: int,
+        n_queries: int,
+        classes: Optional[Sequence[str]] = None,
+        max_faults: int = 3,
+    ) -> "ChaosSchedule":
+        """The pure generation function: (seed, counts, classes) ->
+        schedule. Each episode composes 1..max_faults DISTINCT-site
+        faults — composed failures, not one kill at a time — over a
+        seeded query choice."""
+        classes = tuple(classes or FAULT_CLASSES)
+        for c in classes:
+            if c not in FAULT_CLASSES:
+                raise ValueError(
+                    f"undeclared fault class {c!r} (declare it in "
+                    "tidb_tpu/chaos/schedule.py FAULT_CLASSES)"
+                )
+        rng = random.Random(int(seed))
+        episodes = []
+        for i in range(int(n_episodes)):
+            n_faults = rng.randint(1, max(int(max_faults), 1))
+            picked: Dict[str, Fault] = {}
+            for _ in range(n_faults):
+                f = _make_fault(rng.choice(classes), rng)
+                picked.setdefault(f.site, f)  # one fault per site
+            episodes.append(
+                Episode(
+                    index=i,
+                    query=rng.randrange(max(int(n_queries), 1)),
+                    faults=tuple(
+                        picked[s] for s in sorted(picked)
+                    ),
+                )
+            )
+        return cls(seed=int(seed), episodes=tuple(episodes))
+
+    def fault_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ep in self.episodes:
+            for f in ep.faults:
+                out[f.cls] = out.get(f.cls, 0) + 1
+        return out
+
+
+def generate_worker_specs(
+    seed: int, n_workers: int
+) -> List[List[dict]]:
+    """Per-worker-PROCESS fault specs for the multihost dryrun (JSON
+    for dcn_worker --chaos-spec), composing the acceptance triple:
+    worker 0 gets seeded frame loss + a hang, the LAST worker gets a
+    real crash (os._exit on its first pushed frame — the
+    kill-one-worker shape, now composed WITH the other classes).
+    Deterministic in (seed, n_workers)."""
+    rng = random.Random(int(seed))
+    specs: List[List[dict]] = []
+    for w in range(int(n_workers)):
+        faults = [
+            Fault(
+                "frame-drop", "shuffle/push-lost", "seeded-error",
+                p=round(rng.uniform(0.01, 0.04), 4),
+                seed=rng.randint(0, 2 ** 31),
+            ),
+        ]
+        if w == n_workers - 1:
+            faults.append(
+                Fault("worker-crash", "shuffle/recv", "exit",
+                      n=rng.randint(1, 2))
+            )
+        else:
+            faults.append(
+                Fault("worker-hang", "shuffle/produce", "hang",
+                      n=rng.randint(2, 4),
+                      param=round(rng.uniform(2.5, 4.0), 3))
+            )
+        specs.append([f.to_dict() for f in faults])
+    return specs

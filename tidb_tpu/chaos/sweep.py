@@ -1,0 +1,431 @@
+"""Failpoint-coverage sweep: a declared workload per failpoint site.
+
+``scripts/check_failpoint_coverage.py`` statically requires every site
+in ``failpoint.SITES`` to appear in at least one test or chaos
+schedule; this module is where the chaos half of that coverage LIVES —
+each ``SWEEP`` entry names the sites its workload traverses, and the
+tier-1 runtime check (tests/test_chaos.py::test_failpoint_site_sweep)
+arms a counting hook on every swept site, runs the workloads, and
+asserts each site actually fired. A site whose workload stops
+traversing it fails at runtime, not just in a stale comment — dead
+sites cannot hide.
+
+Entries are (kind, name, payload, sites):
+- kind "sql":    payload is a list of SQL statements run on the shared
+  sweep session;
+- kind "driver": payload is a callable(ctx) — ctx carries the shared
+  session and a tmp dir — for sites that need files, threads, sockets
+  or direct component access.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Tuple
+
+
+# -- drivers ----------------------------------------------------------------
+
+
+def _drv_load_and_import(ctx) -> None:
+    """LOAD DATA (dml/load) and IMPORT INTO (dxf/submit +
+    dxf/heartbeat — the import task runs through the DXF manager's
+    executor heartbeat loop)."""
+    import tidb_tpu.dxf.tasks  # noqa: F401  (register task types)
+    from tidb_tpu.dxf import TaskManager
+
+    sess = ctx["session"]
+    path = os.path.join(ctx["tmp"], "sweep_rows.csv")
+    with open(path, "w") as f:
+        f.write("101\n102\n103\n")
+    sess.execute("create table sw_load (a int)")
+    sess.execute(f"load data infile '{path}' into table sw_load")
+    sess.execute("create table sw_imp (a int)")
+    m = TaskManager(sess.catalog)
+    tid = m.submit(
+        "import",
+        {"db": "test", "table": "sw_imp", "path": path, "sep": ","},
+    )
+    assert m.run_to_completion(tid, executors=2) == "succeed"
+    # the executor's TTL ticker never fires for sub-second subtasks:
+    # beat one finished subtask directly (the exact call it makes)
+    m.heartbeat(next(iter(m.subtasks)))
+
+
+def _drv_modify_column_delta(ctx) -> None:
+    """ddl/modify-column-delta-retry NEEDS concurrent DML between the
+    reorg's snapshot backfill and its commit — force it
+    deterministically by arming the reorg site itself with a hook that
+    inserts one row on its first firing (the version bumps, the reorg
+    observes the delta and retries)."""
+    from tidb_tpu.utils import failpoint
+
+    sess = ctx["session"]
+    sess.execute("create table sw_mod (a int)")
+    sess.execute("insert into sw_mod values (1),(2),(3)")
+    fired = []
+
+    def concurrent_insert():
+        if not fired:
+            fired.append(1)
+            sess.execute("insert into sw_mod values (9)")
+
+    failpoint.enable("ddl/modify-column-reorg", concurrent_insert)
+    try:
+        # int -> decimal REALLY reorgs (int -> bigint is metadata-only
+        # and would never run the backfill loop)
+        sess.execute("alter table sw_mod modify column a decimal(10,2)")
+    finally:
+        failpoint.disable("ddl/modify-column-reorg")
+
+
+def _drv_deadlock(ctx) -> None:
+    """locks/deadlock-detected via the wait-for graph directly: txn 2
+    blocks on txn 1's key from a side thread, then txn 1 requests txn
+    2's key — the DFS finds the cycle."""
+    from tidb_tpu.storage.locks import DeadlockError, LockManager
+
+    lm = LockManager()
+    lm.acquire(1, ("t", "a"))
+    lm.acquire(2, ("t", "b"))
+    t = threading.Thread(
+        target=lambda: lm.acquire(2, ("t", "a"), timeout=10),
+        daemon=True, name="dxf-sweep-waiter",
+    )
+    t.start()
+    for _ in range(200):  # wait until txn 2 registers its wait edge
+        with lm._mu:
+            if lm._waits.get(2) == 1:
+                break
+        time.sleep(0.01)
+    try:
+        lm.acquire(1, ("t", "b"), timeout=10)
+        raise AssertionError("deadlock not detected")
+    except DeadlockError:
+        pass
+    lm.release_all(1)
+    t.join(timeout=10)
+    lm.release_all(2)
+
+
+def _drv_extsort(ctx) -> None:
+    """extsort/merge-round (3 runs force pairwise rounds) and
+    extsort/merge-views (2 sorted views)."""
+    import numpy as np
+
+    from tidb_tpu.dxf.extsort import (
+        merge_runs,
+        merge_sorted_views,
+        sort_run,
+    )
+
+    runs = [
+        sort_run(
+            np.array(vals, dtype=np.int64),
+            np.ones(len(vals), dtype=bool),
+            off,
+        )
+        for off, vals in ((0, [3, 1]), (2, [2, 5]), (4, [4, 0]))
+    ]
+    merged = merge_runs(runs)
+    assert merged is not None and list(merged[0]) == [0, 1, 2, 3, 4, 5]
+    a = np.rec.fromarrays(
+        [np.array([1, 3], dtype=np.int64)], names="k"
+    )
+    b = np.rec.fromarrays(
+        [np.array([2, 4], dtype=np.int64)], names="k"
+    )
+    out = merge_sorted_views([a, b])
+    assert out is not None and len(out) == 4
+
+
+def _drv_watchdog(ctx) -> None:
+    """watchdog/sample: one direct sample pass of the instance
+    watchdog (no background thread)."""
+    from tidb_tpu.utils.watchdog import InstanceWatchdog
+
+    wd = InstanceWatchdog(ctx["session"].catalog, interval=3600.0)
+    wd.sample()
+
+
+def _drv_mesh_exchange(ctx) -> None:
+    """exchange/repartition: a grouped aggregate on a mesh session
+    hash-repartitions rows by group key across the device mesh."""
+    from tidb_tpu.session.session import Session
+
+    sm = Session(mesh_devices=2)
+    sm.execute("create table t (a int, b int)")
+    sm.execute(
+        "insert into t values " + ",".join(
+            f"({i % 5},{i})" for i in range(64)
+        )
+    )
+    r = sm.execute("select a, count(*) from t group by a order by a")
+    assert len(r.rows) == 5
+
+
+def _drv_server_query(ctx) -> None:
+    """server/dispatch-query: one COM_QUERY over the real MySQL
+    wire protocol."""
+    import socket
+    import struct
+
+    from tidb_tpu.server.server import Server
+
+    srv = Server(ctx["session"].catalog, port=0)
+    srv.start_background()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        try:
+            def read_packet():
+                hdr = b""
+                while len(hdr) < 4:
+                    hdr += s.recv(4 - len(hdr))
+                n = struct.unpack("<I", hdr[:3] + b"\0")[0]
+                out = b""
+                while len(out) < n:
+                    out += s.recv(n - len(out))
+                return out
+
+            read_packet()  # server handshake
+            # handshake response 41: utf8, no auth, no database
+            payload = (
+                struct.pack("<IIB23x", 0x0200 | 0x0008 | 0x80000,
+                            1 << 24, 33)
+                + b"root\0" + b"\0"
+            )
+            s.sendall(struct.pack("<I", len(payload))[:3] + b"\x01"
+                      + payload)
+            read_packet()  # OK
+            q = b"\x03select 1"
+            s.sendall(struct.pack("<I", len(q))[:3] + b"\x00" + q)
+            read_packet()  # column count (or ERR — traversal is what
+            # the sweep needs; correctness lives in test_server.py)
+            # COM_QUIT: end the connection cleanly (an abrupt close
+            # makes the handler thread log a reset traceback)
+            s.sendall(struct.pack("<I", 1)[:3] + b"\x00" + b"\x01")
+        finally:
+            s.close()
+    finally:
+        srv.shutdown()
+
+
+def _drv_engine_pool(ctx) -> None:
+    """engine/dispatch + engine/execute: one plan through the pooled
+    engine client over a real RPC server."""
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.server.engine_pool import PooledEngineClient
+    from tidb_tpu.server.engine_rpc import EngineServer
+
+    sess = ctx["session"]
+    srv = EngineServer(sess.catalog, port=0)
+    srv.start_background()
+    pool = PooledEngineClient([("127.0.0.1", srv.port)])
+    try:
+        plan = build_query(
+            parse("select a from sw_dml order by a")[0],
+            sess.catalog, "test", sess._scalar_subquery,
+        )
+        _cols, rows = pool.execute_plan(plan)
+        assert rows
+    finally:
+        pool.close()
+        srv.shutdown()
+
+
+def _drv_admit(ctx) -> None:
+    """serving/admit: one admission through the controller."""
+    from tidb_tpu.parallel.serving import AdmissionController
+
+    AdmissionController().admit(None).release()
+
+
+def _drv_shuffle_fleet(ctx) -> None:
+    """The DCN sites a real 2-server in-process fleet traverses: a
+    repartition-join rides the tunnels (shuffle/open, produce, push,
+    push-lost probe, wait, consume, stage, dcn/dispatch at the task
+    frame... ) and a grouped aggregate takes the partial-agg fragment
+    cut (dcn/dispatch, dcn/final-stage, engine/execute)."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.server.engine_rpc import EngineServer
+
+    sess = ctx["session"]
+    servers = [EngineServer(sess.catalog, port=0) for _ in range(2)]
+    for s in servers:
+        s.start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", s.port) for s in servers],
+        catalog=sess.catalog, shuffle_mode="always",
+        shuffle_wait_timeout_s=30.0,
+    )
+    try:
+        for q in (
+            "select b, count(*), sum(k) from sw_j join sw_k on a = k "
+            "group by b order by b",
+        ):
+            plan = build_query(
+                parse(q)[0], sess.catalog, "test",
+                sess._scalar_subquery,
+            )
+            sched.execute_plan(plan)
+        sched.shuffle_mode = "never"
+        plan = build_query(
+            parse("select b, count(*) from sw_j group by b order by b")[0],
+            sess.catalog, "test", sess._scalar_subquery,
+        )
+        sched.execute_plan(plan)
+    finally:
+        sched.close()
+        for s in servers:
+            s.shutdown()
+
+
+#: the declared sweep: (kind, name, payload, sites traversed).
+#: Sites listed here are what the runtime sweep asserts FIRE; the
+#: static lint additionally counts any literal site mention in this
+#: package as covered.
+SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
+    ("sql", "setup", [
+        "create table sw_dml (a int, b varchar(8))",
+        "insert into sw_dml values (1,'x'),(2,'y'),(3,'z'),(4,'x')",
+        "create table sw_j (a int, b varchar(8))",
+        "insert into sw_j values (1,'x'),(2,'y'),(3,'x'),(2,'z')",
+        "create table sw_k (k int)",
+        "insert into sw_k values (1),(2),(2),(3)",
+    ], ("catalog/create-table", "session/stmt-start",
+        "storage/install-commit", "storage/gc-versions")),
+    ("sql", "query-operators", [
+        "select b, count(*), sum(a) from sw_dml join sw_k on a = k "
+        "group by b order by b, count(*)",
+    ], ("executor/admission", "executor/aggregate", "executor/join",
+        "executor/sort")),
+    ("sql", "streamed", [
+        "set tidb_tpu_stream_rows = 1",
+        "select sum(a), count(*) from sw_dml",
+        "set tidb_tpu_stream_rows = -1",
+    ], ("executor/stream-start",)),
+    ("sql", "cte", [
+        "with recursive c(n) as (select 1 union all select n+1 from c "
+        "where n < 3) select n from c",
+    ], ("cte/iterate",)),
+    ("sql", "collation", [
+        "create table sw_c (s varchar(16) collate utf8mb4_general_ci)",
+        "insert into sw_c values ('b'),('A'),('a')",
+        # a GROUP BY under the non-binary collation builds the rank
+        # LUT ('a' and 'A' are one group)
+        "select s, count(*) from sw_c group by s order by s",
+    ], ("collate/rank-lut",)),
+    ("sql", "ddl", [
+        "create table sw_ddl (a int, g int as (a + 1))",
+        "insert into sw_ddl (a) values (1),(2)",
+        "alter table sw_ddl add column b int",
+        "create index i_sw on sw_ddl (a)",
+        "alter table sw_ddl modify column a bigint",
+        "rename table sw_ddl to sw_ddl2",
+        "drop table sw_ddl2",
+    ], ("ddl/alter-table", "ddl/create-index",
+        "ddl/index-before-public", "ddl/generated-recompute",
+        "ddl/rename-table", "catalog/drop-table")),
+    ("sql", "dml", [
+        "update sw_dml set b = 'w' where a = 2",
+        "delete from sw_dml where a = 4",
+    ], ("dml/update", "dml/delete")),
+    ("sql", "txn", [
+        "begin", "insert into sw_dml values (7,'t')", "commit",
+        "set tidb_txn_mode = 'optimistic'",
+        "begin", "insert into sw_dml values (8,'o')", "commit",
+        "set tidb_txn_mode = 'pessimistic'",
+    ], ("session/begin-txn", "session/commit-conflict-check")),
+    ("sql", "prepared", [
+        "prepare sw_p from 'select 1 + 1'",
+        "execute sw_p",
+    ], ("session/execute-prepared",)),
+    ("sql", "stats", [
+        "analyze table sw_dml",
+    ], ("stats/analyze",)),
+    ("sql", "sequence", [
+        "create sequence sw_seq",
+        "select nextval(sw_seq)",
+    ], ("sequence/nextval",)),
+    ("sql", "resgroup", [
+        "create resource group sw_rg ru_per_sec = 100000",
+        "set resource group sw_rg",
+        "select count(*) from sw_dml",
+        "set resource group default",
+    ], ("resgroup/debit",)),
+    ("sql", "br", [
+        "backup database test to 'memory://sw_bkt'",
+        "restore database test from 'memory://sw_bkt'",
+    ], ("br/statement", "persist/before-manifest",
+        "persist/restore-start")),
+    ("sql", "logbackup", [
+        "backup log to 'memory://sw_log'",
+        "insert into sw_dml values (9,'l')",
+        "backup log stop",
+    ], ("logbackup/write-segment",)),
+    ("driver", "load-import", _drv_load_and_import,
+     ("dml/load", "dxf/submit", "dxf/heartbeat")),
+    ("driver", "modify-column-delta", _drv_modify_column_delta,
+     ("ddl/modify-column-delta-retry",)),
+    ("driver", "deadlock", _drv_deadlock,
+     ("locks/deadlock-detected",)),
+    ("driver", "extsort", _drv_extsort,
+     ("extsort/merge-round", "extsort/merge-views")),
+    ("driver", "watchdog", _drv_watchdog, ("watchdog/sample",)),
+    ("driver", "mesh-exchange", _drv_mesh_exchange,
+     ("exchange/repartition",)),
+    ("driver", "server-query", _drv_server_query,
+     ("server/dispatch-query",)),
+    ("driver", "engine-pool", _drv_engine_pool,
+     ("engine/dispatch", "engine/execute")),
+    ("driver", "admit", _drv_admit, ("serving/admit",)),
+    ("driver", "shuffle-fleet", _drv_shuffle_fleet,
+     ("shuffle/open", "shuffle/produce", "shuffle/push",
+      "shuffle/push-lost", "shuffle/wait", "shuffle/consume",
+      "shuffle/stage", "dcn/dispatch", "dcn/final-stage")),
+]
+
+
+def sweep_sites() -> Tuple[str, ...]:
+    out = []
+    for _kind, _name, _payload, sites in SWEEP:
+        out.extend(sites)
+    return tuple(out)
+
+
+def run_sweep(session, tmp: str, progress: Callable = None) -> dict:
+    """Run every sweep workload with counting hooks armed on every
+    swept site; returns {site: hits}. The caller (the tier-1 test)
+    asserts every count is nonzero."""
+    from tidb_tpu.utils import failpoint
+
+    counts = {s: 0 for s in sweep_sites()}
+
+    def hook_for(site):
+        def hook():
+            counts[site] += 1
+            return None
+
+        return hook
+
+    for site in counts:
+        failpoint.enable(site, hook_for(site))
+    ctx = {"session": session, "tmp": tmp}
+    try:
+        for kind, name, payload, _sites in SWEEP:
+            if progress is not None:
+                progress(name)
+            if kind == "sql":
+                for stmt in payload:
+                    session.execute(stmt)
+            else:
+                payload(ctx)
+    finally:
+        for site in counts:
+            failpoint.disable(site)
+    return counts

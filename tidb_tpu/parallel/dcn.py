@@ -75,7 +75,11 @@ from tidb_tpu.server.engine_pool import (
     FailedEngineProber,
     ping_endpoint,
 )
-from tidb_tpu.server.engine_rpc import EngineClient, SchemaOutOfDateError
+from tidb_tpu.server.engine_rpc import (
+    EngineClient,
+    QueryCancelled,
+    SchemaOutOfDateError,
+)
 from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.failpoint import inject
 from tidb_tpu.utils.metrics import REGISTRY, merge_counter_delta
@@ -157,6 +161,22 @@ def _c_shuffle_stage_retries():
     )
 
 
+def _c_cancels():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_cancels_total",
+        "fleet-wide cancel_query broadcasts (KILL QUERY / "
+        "max_execution_time / propagated statement deadline)",
+    )
+
+
+def _c_retry_backoff():
+    return REGISTRY.counter(
+        "tidbtpu_dcn_retry_backoff_seconds",
+        "jittered exponential backoff slept between stage/fragment "
+        "retry rounds (desynchronizes re-dispatch storms)",
+    )
+
+
 def _c_shuffle_result_bytes():
     return REGISTRY.counter(
         "tidbtpu_shuffle_result_bytes",
@@ -199,10 +219,14 @@ class HostHeartbeat:
         self._misses: Dict[EngineEndpoint, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._interval_s = float(interval_s)
+        # serializes retune() against itself (concurrent sysvar SETs
+        # from many sessions must not leave two beat threads running)
+        self._retune_lock = racecheck.make_lock("dcn.heartbeat")
         if interval_s > 0:
             self._thread = threading.Thread(
-                target=self._loop, args=(interval_s,), daemon=True,
-                name="dcn-heartbeat",
+                target=self._loop, args=(interval_s, self._stop),
+                daemon=True, name="dcn-heartbeat",
             )
             self._thread.start()
 
@@ -229,8 +253,14 @@ class HostHeartbeat:
         _update_host_gauges(self.endpoints)
         return lost
 
-    def _loop(self, interval_s: float) -> None:
-        while not self._stop.wait(interval_s):
+    def _loop(self, interval_s: float, stop: threading.Event) -> None:
+        # the thread loops on ITS OWN stop event (captured at start),
+        # not self._stop: retune() replaces self._stop for the next
+        # thread, and an outgoing thread whose join timed out (wedged
+        # hosts make one beat exceed it) must still see the event that
+        # was set FOR IT — re-reading the attribute would leave it
+        # beating forever on a never-set replacement
+        while not stop.wait(interval_s):
             try:
                 self.beat_once()
             except Exception:
@@ -241,6 +271,40 @@ class HostHeartbeat:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+    def retune(
+        self,
+        interval_s: Optional[float] = None,
+        miss_threshold: Optional[int] = None,
+    ) -> None:
+        """Live re-tune (the tidb_tpu_heartbeat_* sysvar SET hook): a
+        changed miss threshold applies to the next beat; a CHANGED
+        interval restarts the beat thread on the new cadence (0 stops
+        it — manual beat_once only; an unchanged interval is a no-op,
+        not a restart). Serialized: two sessions SETting concurrently
+        must not each replace self._stop and leave an orphan thread
+        beating on a never-set event."""
+        if miss_threshold is not None:
+            self.miss_threshold = int(miss_threshold)
+        if interval_s is None:
+            return
+        interval_s = float(interval_s)
+        with self._retune_lock:
+            if interval_s == self._interval_s:
+                return
+            self._interval_s = interval_s
+            # lock-blocking-ok: stop() joins the outgoing beat thread
+            # under the retune lock ON PURPOSE — the join is what
+            # guarantees at most one beat thread ever runs, and the
+            # lock is leaf-level (beat_once takes no locks of ours)
+            self.stop()
+            self._stop = threading.Event()
+            if interval_s > 0:
+                self._thread = threading.Thread(
+                    target=self._loop, args=(interval_s, self._stop),
+                    daemon=True, name="dcn-heartbeat",
+                )
+                self._thread.start()
 
 
 class _EndpointPool:
@@ -323,6 +387,13 @@ class _EndpointPool:
             else:
                 self._idle.append(conn)
             self._cv.notify_all()
+
+    def leased(self) -> int:
+        """Connections currently checked out — must drain back to 0
+        after every query, aborted ones included (the chaos harness's
+        leak invariant)."""
+        with self._cv:
+            return self._total - len(self._idle)
 
     @contextlib.contextmanager
     def lease(self):
@@ -443,11 +514,12 @@ class DCNFragmentScheduler:
         prober: Optional[FailedEngineProber] = None,
         catalog=None,
         max_attempts: int = 4,
-        heartbeat_interval_s: float = 0.0,
+        heartbeat_interval_s: Optional[float] = None,
+        heartbeat_miss_threshold: Optional[int] = None,
         dispatch_timeout_s: float = 600.0,
         shuffle_mode: str = "auto",
         shuffle_min_rows: int = 100_000,
-        shuffle_wait_timeout_s: float = 120.0,
+        shuffle_wait_timeout_s: Optional[float] = None,
         shuffle_packet_rows: Optional[int] = None,
         shuffle_inflight_bytes: Optional[int] = None,
         shuffle_codec: str = "binary",
@@ -455,6 +527,7 @@ class DCNFragmentScheduler:
         shuffle_produce_chunks: Optional[int] = None,
         conn_pool_size: int = 4,
         admission=None,
+        retry_backoff_s: float = 0.05,
     ):
         if not endpoints:
             raise ValueError("DCN scheduler needs at least one worker host")
@@ -484,7 +557,6 @@ class DCNFragmentScheduler:
         # force the choice (tests, benchmarks)
         self.shuffle_mode = shuffle_mode
         self.shuffle_min_rows = shuffle_min_rows
-        self.shuffle_wait_timeout_s = shuffle_wait_timeout_s
         self.shuffle_packet_rows = shuffle_packet_rows
         self.shuffle_inflight_bytes = shuffle_inflight_bytes
         # stage ids must be unique per COORDINATOR INSTANCE: qids
@@ -496,9 +568,6 @@ class DCNFragmentScheduler:
         self._sid_prefix = uuid.uuid4().hex[:8]
         self.endpoints = [EngineEndpoint(h, p, secret) for h, p in endpoints]
         self.prober = prober or FailedEngineProber()
-        self.heartbeat = HostHeartbeat(
-            self.endpoints, self.prober, interval_s=heartbeat_interval_s
-        )
         self.max_attempts = max_attempts
         # first dispatch on a fresh worker pays the fragment's XLA
         # compile; the RPC read must outlast it
@@ -511,6 +580,38 @@ class DCNFragmentScheduler:
 
             catalog = Catalog()
         self.catalog = catalog
+        # unset timeout/liveness knobs resolve from the tidb_tpu_*
+        # sysvars over this catalog's global store (the admission-knob
+        # pattern, AdmissionController.from_sysvars): the 120s WAN
+        # default is a CONFIG value, not a constant buried in drivers,
+        # and a live SET re-tunes an attached scheduler
+        # (session.py SetVariable hook -> retune()).
+        from tidb_tpu.utils.sysvar import SysVars
+
+        sv = SysVars(getattr(catalog, "global_sysvars", None))
+        if shuffle_wait_timeout_s is None:
+            shuffle_wait_timeout_s = float(
+                sv.get("tidb_tpu_shuffle_wait_timeout_s")
+            )
+        if heartbeat_interval_s is None:
+            heartbeat_interval_s = float(
+                sv.get("tidb_tpu_heartbeat_interval_s")
+            )
+        if heartbeat_miss_threshold is None:
+            heartbeat_miss_threshold = int(
+                sv.get("tidb_tpu_heartbeat_miss_threshold")
+            )
+        self.shuffle_wait_timeout_s = float(shuffle_wait_timeout_s)
+        self.heartbeat = HostHeartbeat(
+            self.endpoints, self.prober,
+            interval_s=heartbeat_interval_s,
+            miss_threshold=heartbeat_miss_threshold,
+        )
+        # jittered exponential backoff base between stage/fragment
+        # retry rounds: a chaos storm quarantining hosts across many
+        # concurrent queries must not re-dispatch them in lockstep
+        # (synchronized retries re-stampede the survivors)
+        self.retry_backoff_s = float(retry_backoff_s)
         from tidb_tpu.planner.physical import PhysicalExecutor
 
         self._executor = PhysicalExecutor(catalog)
@@ -612,9 +713,127 @@ class DCNFragmentScheduler:
             _c_quarantines().labels(host=ep.address).inc()
         _update_host_gauges(self.endpoints)
 
+    # -- fleet-wide cancellation + deadline propagation -----------------
+    @staticmethod
+    def _deadline_left(deadline: Optional[float]) -> Optional[float]:
+        """Remaining seconds of an absolute time.monotonic deadline —
+        what a dispatch carries to the worker (REMAINING time, not a
+        wall-clock instant: wall clocks skew across hosts, durations
+        do not). Floors at 50ms so an already-expired statement still
+        dispatches a frame the worker immediately cancels (keeping the
+        abort path uniform) instead of shipping a negative budget."""
+        if deadline is None:
+            return None
+        return max(deadline - time.monotonic(), 0.05)
+
+    def _cancel_fleet(self, qid, sid=None, reason: str = "") -> None:
+        """Broadcast cancel_query for ``qid`` to every alive worker —
+        the coordinator half of KILL / max_execution_time reaching
+        in-flight fragments and shuffle tasks. Dedicated short-lived
+        connections: the pooled streams are busy carrying the very
+        dispatches being cancelled. One thread per host, joined with a
+        bounded cap — a WEDGED host (accepting TCP, not answering:
+        exactly the shape cancellation exists for) must not delay the
+        healthy hosts' cancel frames by its own timeout, let alone
+        serially sum across hosts. Best-effort per host (a dead host
+        has nothing to cancel); the propagated dispatch deadline is
+        the backstop for hosts the broadcast cannot reach."""
+        inject("dcn/cancel")
+        _c_cancels().inc()
+        if TIMELINE.active():
+            TIMELINE.emit_event(
+                "fragment", f"cancel q{qid}", time.time(), 0.0,
+                track=f"q{qid}", args={"qid": qid, "reason": reason},
+            )
+
+        def one(ep):
+            try:
+                c = EngineClient(
+                    ep.host, ep.port, secret=ep.secret, timeout_s=5.0
+                )
+                try:
+                    c.cancel_query(
+                        qid, sid=sid, reason=reason,
+                        coord=self._sid_prefix,
+                    )
+                finally:
+                    c.close()
+            except Exception:
+                pass
+
+        threads = [
+            threading.Thread(
+                target=one, args=(ep,), daemon=True,
+                name=f"dcn-cancel-{ep.address}",
+            )
+            for ep in self.alive_endpoints()
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+
+    def _join_watch(
+        self, threads, qid, sid=None, kill_check=None, deadline=None
+    ) -> Optional[BaseException]:
+        """Join the dispatch threads while watching for a local kill
+        or deadline expiry; on the FIRST trigger broadcast the fleet
+        cancel (workers abort at their next safepoint, so the joins
+        below return promptly) and keep joining. Returns the kill
+        exception (to raise after cleanup) or None."""
+        killed: Optional[BaseException] = None
+        while True:
+            alive = [t for t in threads if t.is_alive()]
+            if not alive:
+                return killed
+            if killed is None:
+                try:
+                    if kill_check is not None:
+                        kill_check()
+                    if (
+                        deadline is not None
+                        and time.monotonic() > deadline
+                    ):
+                        from tidb_tpu.utils.sqlkiller import QueryKilled
+
+                        raise QueryKilled(
+                            "query interrupted (statement deadline "
+                            "exceeded at the coordinator)"
+                        )
+                except BaseException as e:
+                    killed = e
+                    self._cancel_fleet(qid, sid=sid, reason=str(e))
+            for t in alive:
+                t.join(timeout=0.05)
+
+    def _retry_sleep(self, rnd: int, kill_check=None) -> None:
+        """Jittered exponential backoff between retry rounds: base *
+        2^rnd scaled by a uniform [0.5, 1.0) draw, capped at 2s — a
+        chaos storm failing many queries' stages at once must not
+        re-dispatch them in lockstep onto the survivors. Polls the
+        kill check so KILL still lands mid-backoff."""
+        if self.retry_backoff_s <= 0:
+            return
+        import random
+
+        d = min(self.retry_backoff_s * (2 ** rnd), 2.0) * (
+            0.5 + 0.5 * random.random()
+        )
+        _c_retry_backoff().inc(d)
+        end = time.monotonic() + d
+        while True:
+            if kill_check is not None:
+                kill_check()
+            left = end - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(left, 0.05))
+
     # -- query execution ------------------------------------------------
     def execute_plan(
-        self, plan: L.LogicalPlan, cut_hint=None
+        self, plan: L.LogicalPlan, cut_hint=None, kill_check=None,
+        deadline=None,
     ) -> Tuple[List[str], List[tuple]]:
         """Run a bound logical plan across the worker hosts. Prefers a
         worker-to-worker shuffle cut when the policy says tunnels beat
@@ -623,11 +842,24 @@ class DCNFragmentScheduler:
         loss up to max_attempts. ``cut_hint`` is a precomputed
         (kind, cut) from _choose_cut so a caller that already planned
         the route (session SELECT routing) does not pay the planner
-        pass twice."""
+        pass twice.
+
+        Fleet-wide cancellation: ``kill_check`` (the session killer's
+        check — KILL QUERY and max_execution_time both raise through
+        it) is polled while dispatches are in flight; on the first
+        raise the coordinator broadcasts ``cancel_query`` to every
+        alive worker so in-flight fragments and shuffle tasks abort at
+        their next safepoint instead of burning the fleet to
+        completion. ``deadline`` (absolute time.monotonic, or None) is
+        additionally PROPAGATED: each dispatch carries its remaining
+        seconds, so a worker self-cancels even if the coordinator is
+        wedged."""
         kind, cut = cut_hint if cut_hint is not None else self._choose_cut(plan)
         if kind == "shuffle":
             t0 = time.perf_counter()
-            rows, infos, stage = self._run_shuffle(cut)
+            rows, infos, stage = self._run_shuffle(
+                cut, kill_check=kill_check, deadline=deadline
+            )
             self._note_dispatch(
                 t0, infos,
                 retries=max(int(stage.get("attempts", 1)) - 1, 0),
@@ -636,7 +868,9 @@ class DCNFragmentScheduler:
             return self._timed_final_stage(cut, rows)
         if kind == "frag":
             t0 = time.perf_counter()
-            ledger, infos = self._run_fragments(cut)
+            ledger, infos = self._run_fragments(
+                cut, kill_check=kill_check, deadline=deadline
+            )
             self._note_dispatch(t0, infos, retries=ledger.total_retries())
             # remote engine row work (summed across hosts, like the
             # shuffle phases and the reference's cop-task totals)
@@ -767,7 +1001,7 @@ class DCNFragmentScheduler:
         return cut if kind == "shuffle" else None
 
     def _run_shuffle(
-        self, sp: ShufflePlan
+        self, sp: ShufflePlan, kill_check=None, deadline=None
     ) -> Tuple[List[tuple], List[dict], dict]:
         """Run one shuffle stage to completion: dispatch a produce+
         consume task per alive host, each host pushing hash partitions
@@ -796,6 +1030,11 @@ class DCNFragmentScheduler:
         }
         last_err: Optional[str] = None
         for rnd in range(self.max_attempts):
+            if rnd:
+                # jittered exponential backoff before every re-attempt:
+                # stage retries across concurrent queries desynchronize
+                # instead of stampeding the survivor set together
+                self._retry_sleep(rnd - 1, kill_check)
             if not self.alive_endpoints():
                 self.prober.probe_once()
             hosts = self.alive_endpoints()
@@ -816,12 +1055,21 @@ class DCNFragmentScheduler:
             suspects: List[str] = []
             errs: List[str] = []
             fatal: List[Exception] = []
+            cancelled: List[str] = []
+            killed: Optional[BaseException] = None
 
             def run_part(i: int, ep: EngineEndpoint, conn: EngineClient):
                 token = ledger.claim(i, ep.address)
                 task = {
                     "sid": sid, "qid": qid, "attempt": attempt, "m": m,
                     "part": i, "peers": peers, "secret": ep.secret,
+                    # cancellation scope: (coordinator instance, qid)
+                    # — qids restart with the coordinator, sids don't
+                    "coord": self._sid_prefix,
+                    # propagated statement deadline: REMAINING seconds
+                    # (None = unbounded) — the worker self-cancels its
+                    # produce/wait/consume when it expires
+                    "deadline_s": self._deadline_left(deadline),
                     "sides": [
                         {
                             "tag": s.tag, "key": s.key,
@@ -859,6 +1107,14 @@ class DCNFragmentScheduler:
                         errs.append(f"{ep.address}: {e}")
                     return
                 if not resp.get("ok"):
+                    if resp.get("cancelled"):
+                        # deliberate abort (fleet cancel / propagated
+                        # deadline reached the worker): neither an
+                        # engine error nor a death suspect
+                        ledger.release(i, token)
+                        with self._lock:
+                            cancelled.append(str(resp.get("error", "")))
+                        return
                     if resp.get("retryable"):
                         ledger.release(i, token)
                         with self._lock:
@@ -914,13 +1170,27 @@ class DCNFragmentScheduler:
                     ]
                     for t in threads:
                         t.start()
-                    for t in threads:
-                        t.join()
+                    # join while watching for KILL / deadline: the
+                    # first trigger broadcasts cancel_query fleet-wide
+                    # and the dispatch threads return promptly
+                    killed = self._join_watch(
+                        threads, qid, sid=sid,
+                        kill_check=kill_check, deadline=deadline,
+                    )
             finally:
                 for ep, conn in leases:
                     self._pool(ep).checkin(conn)
             if fatal:
                 raise fatal[0]
+            if killed is not None:
+                raise killed
+            if cancelled:
+                # a worker aborted on the propagated deadline before
+                # the coordinator's own watch fired (clock margins):
+                # same verdict, same exception type as a local kill
+                from tidb_tpu.utils.sqlkiller import QueryKilled
+
+                raise QueryKilled(cancelled[0])
             if ledger.all_done():
                 infos.sort(key=lambda f: f["fid"])
                 for f in infos:
@@ -1052,7 +1322,7 @@ class DCNFragmentScheduler:
         )
 
     def _run_fragments(
-        self, frag: FragmentPlan
+        self, frag: FragmentPlan, kill_check=None, deadline=None
     ) -> Tuple[FragmentLedger, List[dict]]:
         """Dispatch every fragment exactly once onto the alive hosts,
         surviving losses up to max_attempts rounds. Returns the
@@ -1064,10 +1334,13 @@ class DCNFragmentScheduler:
         ledger = FragmentLedger(n)
         infos: List[dict] = []
         last_err: Optional[Exception] = None
+        cancelled: List[str] = []
         for _round in range(self.max_attempts):
             pending = ledger.pending()
             if not pending:
                 break
+            if _round:
+                self._retry_sleep(_round - 1, kill_check)
             # quarantined hosts get their recovery shot before the pool
             # is declared exhausted (probe respects backoff)
             if not self.alive_endpoints():
@@ -1094,6 +1367,10 @@ class DCNFragmentScheduler:
                 meta = {
                     "qid": qid, "fid": fid, "n": n,
                     "attempt": ledger.attempts(fid),
+                    # cancellation scope (coordinator instance, qid)
+                    "coord": self._sid_prefix,
+                    # propagated statement deadline (remaining seconds)
+                    "deadline_s": self._deadline_left(deadline),
                     # opt the worker into span collection only when the
                     # coordinator is actually tracing; same opt-in for
                     # timeline event collection
@@ -1105,6 +1382,14 @@ class DCNFragmentScheduler:
                     _cols, rows, resp = self._dispatch(
                         ep, frag.host_plan(fid, n), meta
                     )
+                except QueryCancelled as e:
+                    # deliberate worker-side abort: neither an engine
+                    # error (no fatal raise) nor a transport loss (no
+                    # quarantine) — before the RuntimeError catch, of
+                    # which QueryCancelled is a subclass
+                    ledger.release(fid, token)
+                    cancelled.append(str(e))
+                    return
                 except (SchemaOutOfDateError, RuntimeError, ValueError,
                         PermissionError):
                     raise  # deterministic: re-raise to the caller thread
@@ -1134,10 +1419,17 @@ class DCNFragmentScheduler:
             ]
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join()
+            killed = self._join_watch(
+                threads, qid, kill_check=kill_check, deadline=deadline
+            )
             if fatal:
                 raise fatal[0]
+            if killed is not None:
+                raise killed
+            if cancelled:
+                from tidb_tpu.utils.sqlkiller import QueryKilled
+
+                raise QueryKilled(cancelled[0])
             for ep, e in errs:
                 last_err = e
                 self._quarantine(ep)
@@ -1290,6 +1582,14 @@ class DCNFragmentScheduler:
         out, out_dicts = self._executor.run(final)
         out_rows = materialize_rows(out, list(final.schema), out_dicts)
         return [c.name for c in final.schema], out_rows
+
+    def pool_leased(self) -> Dict[str, int]:
+        """Per-host count of control connections currently checked out
+        — drains to 0 between queries, aborted ones included (the
+        chaos harness's connection-leak invariant)."""
+        with self._lock:
+            pools = dict(self._pools)
+        return {ep.address: p.leased() for ep, p in pools.items()}
 
     # -- status (the /dcn endpoint's payload) ---------------------------
     def status(self) -> dict:

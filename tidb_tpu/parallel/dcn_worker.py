@@ -75,6 +75,12 @@ def main(argv=None) -> int:
                     help="where to die: fragment execute / reply send, "
                     "or mid-shuffle while pushing a partition packet "
                     "(shuffle-push) / receiving one (shuffle-recv)")
+    ap.add_argument("--chaos-spec", default=None,
+                    help="JSON list of chaos Fault dicts "
+                    "(tidb_tpu/chaos/schedule.py) armed at startup — "
+                    "the multihost chaos dryrun's per-worker fault "
+                    "schedule (crash/hang/frame-loss composed, "
+                    "deterministic per seed)")
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -92,6 +98,13 @@ def main(argv=None) -> int:
             cat, sf=args.tpch_sf, seed=args.seed,
             tables=[t for t in args.tables.split(",") if t],
         )
+
+    if args.chaos_spec:
+        import json
+
+        from tidb_tpu.chaos.schedule import arm_spec
+
+        arm_spec(json.loads(args.chaos_spec))
 
     if args.die_on_fragment > 0:
         site = {

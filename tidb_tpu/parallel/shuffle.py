@@ -346,15 +346,45 @@ class ShuffleStore:
     and zero stale/duplicate admits.
     """
 
+    #: poisoned-sid memory (cancelled queries): bounded — sids are
+    #: strictly unique, so an aged-out entry can only matter if a peer
+    #: still pushes a >256-queries-old cancelled stage, which the
+    #: eviction window then bounds anyway
+    _POISON_CAP = 256
+
     def __init__(self):
         self._cv = racecheck.make_condition("shuffle.store")
         self._stages: "collections.OrderedDict[str, _Stage]" = (
             collections.OrderedDict()
         )
+        self._poisoned: "collections.OrderedDict[str, bool]" = (
+            collections.OrderedDict()
+        )
+
+    def poison(self, sid: str) -> None:
+        """Cancel one stage FOR GOOD: drop its buffered frames and
+        refuse to recreate its record — in-flight frames from peers
+        that have not yet observed the cancellation land as fenced
+        stale drops instead of resurrecting an orphan stage, so the
+        buffered-stages gauge returns to zero immediately (the
+        fleet-cancellation abort path)."""
+        with self._cv:
+            self._stages.pop(sid, None)
+            self._poisoned[sid] = True
+            while len(self._poisoned) > self._POISON_CAP:
+                self._poisoned.popitem(last=False)
+            _g_stages_buffered().set(len(self._stages))
+            self._cv.notify_all()
+
+    def buffered_stages(self) -> int:
+        with self._cv:
+            return len(self._stages)
 
     def _stage(self, sid: str, attempt: int, m: int) -> Optional[_Stage]:
-        """Stage record for (sid, attempt), fencing stale attempts.
-        Caller holds the condition lock."""
+        """Stage record for (sid, attempt), fencing stale attempts and
+        poisoned (cancelled) sids. Caller holds the condition lock."""
+        if sid in self._poisoned:
+            return None  # callers count the drop (stale fence)
         st = self._stages.get(sid)
         if st is None or attempt > st.attempt:
             st = _Stage(attempt, m)
@@ -459,6 +489,9 @@ class ShuffleStore:
         fences authoritatively, so a race between two identical
         retransmits still lands exactly once."""
         with self._cv:
+            if sid in self._poisoned:
+                _c_stale().inc()  # cancelled stage: drop before decode
+                return False
             st = self._stages.get(sid)
             if st is None or attempt > st.attempt:
                 return True  # new stage / newer attempt: will reset
@@ -478,6 +511,7 @@ class ShuffleStore:
         n_sides: int,
         m: int,
         timeout_s: float,
+        abort=None,
     ) -> Dict[int, list]:
         """Block until every (side, sender) stream of the attempt is
         complete; returns side -> payload chunks ordered (sender, seq)
@@ -516,6 +550,11 @@ class ShuffleStore:
                     gone = missing()
                     if not gone:
                         break
+                    if abort is not None and abort():
+                        # same contract as wait_side: a truthy abort
+                        # hands control back (a raising abort — the
+                        # fleet-cancel check — propagates directly)
+                        raise WaitInterrupted()
                     left = deadline - time.monotonic()
                     if left <= 0:
                         raise ShuffleWaitTimeout(gone)
@@ -1197,7 +1236,7 @@ class ShuffleWorker:
         self._producer_exec = None
         self._consumer_exec = None
 
-    def run_task(self, spec: dict, tracer=None) -> dict:
+    def run_task(self, spec: dict, tracer=None, cancel_check=None) -> dict:
         """The worker half of one shuffle stage. Pipelined (the
         default, ``pipeline=True`` + binary codec): producer sides are
         shipped CHUNK-GRANULARLY on shipper threads — each produced
@@ -1220,10 +1259,15 @@ class ShuffleWorker:
            ShuffleRead leaves and execute it.
 
         Returns {"columns", "rows", "shuffle": {...stats}}; raises
-        ShuffleAbort for retryable stage failures."""
+        ShuffleAbort for retryable stage failures and whatever
+        ``cancel_check`` raises (fleet-wide cancellation: the check is
+        polled at every loop point — produce chunks, shipped
+        sub-batches, store waits, consume — and a cancelled task
+        poisons its stage so late peer frames cannot resurrect it)."""
         from tidb_tpu.chunk import materialize_rows
         from tidb_tpu.planner.ir import plan_from_ir
         from tidb_tpu.planner.physical import PhysicalExecutor
+        from tidb_tpu.server.engine_rpc import QueryCancelled
 
         sid = spec["sid"]
         attempt = int(spec["attempt"])
@@ -1294,8 +1338,19 @@ class ShuffleWorker:
         shippers: List[threading.Thread] = []
         ship_errs: List[Exception] = []
         staged: Dict[int, object] = {}
+
+        def poll():
+            """Wait-abort callback: raises on fleet cancellation, else
+            reports whether a shipper failed (the WaitInterrupted
+            hand-back)."""
+            if cancel_check is not None:
+                cancel_check()
+            return bool(ship_errs)
+
         try:
             for side in spec["sides"]:
+                if cancel_check is not None:
+                    cancel_check()
                 tag = int(side["tag"])
                 plan = plan_from_ir(side["plan"])
                 schema_cols = list(plan.schema)
@@ -1361,6 +1416,7 @@ class ShuffleWorker:
                             side["key"], schema_cols, peers, secret,
                             tunnels, tlock, packet_rows, inflight,
                             stats, ship_errs, buf, ctx, ev_args,
+                            cancel_check,
                         ),
                         daemon=True,
                         name=f"shuffle-ship-{sid}-s{tag}",
@@ -1380,6 +1436,8 @@ class ShuffleWorker:
                         if all(c is not None for c in cand):
                             subplans = cand
                     for sp in (subplans or [plan]):
+                        if cancel_check is not None:
+                            cancel_check()
                         t_prod = time.perf_counter()
                         t_wall = time.time()
                         with span(f"{ctx}/produce#{tag}"), \
@@ -1428,7 +1486,7 @@ class ShuffleWorker:
                 with span(f"{ctx}/wait"):
                     by_side = self.store.wait(
                         sid, attempt, len(spec["sides"]), m,
-                        wait_timeout,
+                        wait_timeout, abort=poll,
                     )
                 idle = time.perf_counter() - t0
                 emit("wait", t_wall, idle)
@@ -1458,7 +1516,7 @@ class ShuffleWorker:
                     with span(f"{ctx}/wait"):
                         done, chunks, vocab = self.store.wait_side(
                             sid, attempt, pending, m, deadline,
-                            abort=lambda: bool(ship_errs),
+                            abort=poll,
                         )
                     t1 = time.perf_counter()
                     emit("wait", t_wall, t1 - t0)
@@ -1503,6 +1561,11 @@ class ShuffleWorker:
                 th.join(timeout=30)
             self.store.discard(sid)
             err = ship_errs[0] if ship_errs else None
+            if isinstance(err, QueryCancelled):
+                # a cancelled shipper: poison like the direct-cancel
+                # path (this raise skips the sibling handlers below)
+                self.store.poison(sid)
+                raise err
             if isinstance(err, PeerDeadError):
                 if err.fatal:
                     raise RuntimeError(
@@ -1531,6 +1594,13 @@ class ShuffleWorker:
                     f"shuffle push to {e.address} rejected: {e.cause}"
                 ) from e
             raise ShuffleAbort("push failed", [e.address]) from e
+        except QueryCancelled:
+            # fleet-wide cancellation reached this task: free the
+            # stage's buffers and POISON the sid — frames still in
+            # flight from peers that have not seen the cancel land as
+            # stale drops instead of resurrecting an orphan record
+            self.store.poison(sid)
+            raise
         finally:
             for th in shippers:
                 # an error can escape while shippers run: never close
@@ -1598,6 +1668,8 @@ class ShuffleWorker:
             emit("stage", t_wall, dt_stage)
             stats["stage_s"] += dt_stage
         inject("shuffle/consume")
+        if cancel_check is not None:
+            cancel_check()
         with span(f"{ctx}/consume"), self._exec_lock:
             # consumer executes single-device: its sources are Staged
             # partition batches, not mesh-sharded scans
@@ -1638,7 +1710,7 @@ class ShuffleWorker:
     def _ship_side_stream(
         self, sid, attempt, m, side, sender, sq, key, schema_cols,
         peers, secret, tunnels, tlock, packet_rows, inflight, stats,
-        errs, buf=None, ctx="", ev_args=None,
+        errs, buf=None, ctx="", ev_args=None, cancel_check=None,
     ) -> None:
         """Pipelined producer ship (one side, run on a shipper thread,
         fed produced sub-batches through queue ``sq`` until the None
@@ -1684,6 +1756,12 @@ class ShuffleWorker:
                 produced += block.nrows
                 pmap = partition_map(block, key, m)
                 for a in range(0, block.nrows, step):
+                    if cancel_check is not None:
+                        # fleet cancellation: the shipper stops mid-
+                        # side — its error lands in ``errs`` and the
+                        # waiting consumer's abort poll hands control
+                        # back within a tick
+                        cancel_check()
                     chunk = slice_block(block, a, a + step)
                     cmap = pmap[a : a + step]
                     for dest in range(m):

@@ -2535,9 +2535,18 @@ class PhysicalExecutor:
                 lim = _cap_tile(max(share // (2 * max(w, 1)), 1024))
                 if caps[nid] > lim:
                     caps[nid] = lim
+        from tidb_tpu.utils.sqlkiller import current_check
+
         while True:
             if self.kill_check is not None:
                 self.kill_check()
+            else:
+                # no explicitly-wired killer (worker-side producer/
+                # consumer executors shared across shuffle tasks): the
+                # thread-local current killer — set per dispatched
+                # fragment/shuffle task around execution — makes
+                # fleet-wide cancellation land at the same safepoint
+                current_check()
             self._admit(cq, inputs, caps)
             frozen = dict(caps)
             if jit:

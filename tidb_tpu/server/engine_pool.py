@@ -135,7 +135,11 @@ class FailedEngineProber:
     def probe_once(self, now: Optional[float] = None
                    ) -> List[EngineEndpoint]:
         """Ping due endpoints; recovered ones return to rotation and
-        are returned. Failed pings double the endpoint's backoff."""
+        are returned. Failed pings double the endpoint's backoff.
+        Recovery is VISIBLE: each re-admission counts under
+        tidbtpu_dcn_readmissions_total{host} and lands an
+        admission-category timeline event — before this, only the
+        quarantine half of the detect/recover pair was observable."""
         now = time.time() if now is None else now
         with self._lock:
             due = [ep for ep in self._failed if ep.next_probe <= now]
@@ -143,11 +147,27 @@ class FailedEngineProber:
         for ep in due:
             if self._ping(ep):
                 with self._lock:
+                    down_s = time.time() - (ep.failed_since or now)
                     ep.alive = True
                     ep.failed_since = None
                     ep.recover_count += 1
                     self._failed = [e for e in self._failed if e is not ep]
                 recovered.append(ep)
+                from tidb_tpu.obs.timeline import TIMELINE
+                from tidb_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "tidbtpu_dcn_readmissions_total",
+                    "quarantined worker hosts re-admitted to rotation "
+                    "by the prober (the recovery half of quarantine)",
+                    labels=("host",),
+                ).labels(host=ep.address).inc()
+                TIMELINE.emit_event(
+                    "admission", f"readmit {ep.address}",
+                    time.time(), 0.0, track="admission",
+                    args={"host": ep.address,
+                          "downtime_s": round(max(down_s, 0.0), 3)},
+                )
             else:
                 with self._lock:
                     ep.probe_backoff_s = min(

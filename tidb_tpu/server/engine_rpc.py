@@ -44,6 +44,98 @@ from tidb_tpu.utils import racecheck
 MAX_FRAME = 64 << 20
 
 
+class QueryCancelled(RuntimeError):
+    """Worker-side fragment/shuffle-task abort: the coordinator sent a
+    ``cancel_query`` frame for this qid (KILL QUERY / statement
+    timeout / admission revoke), or the dispatch's propagated deadline
+    expired on this host. The reply carries ``cancelled: true`` so the
+    coordinator distinguishes a deliberate abort from an engine error
+    (no failover, no quarantine — the worker is healthy)."""
+
+
+class CancelRegistry:
+    """Per-server registry of cancelled query ids — the worker half of
+    fleet-wide cancellation (reference: MPPTask cancellation via
+    CancelMPPQuery, tiflash MPPTaskManager::abortMPPQuery). The cancel
+    frame arrives on a DIFFERENT connection than the running dispatch
+    (that stream is busy executing), marks the qid here, and every
+    execution safepoint (PhysicalExecutor.kill_check, ShuffleWorker
+    loop points, ShuffleStore wait aborts) polls it.
+
+    Entries key on (coordinator instance id, qid): qids restart at 1
+    after a coordinator restart (and two coordinators may share a
+    fleet), so a bare qid cancelled by one incarnation would wrongly
+    kill another's query — the same cross-instance collision the
+    shuffle sids fence with their uuid prefix (parallel/dcn.py).
+    Bounded: old entries age out, which is safe — an entry only
+    matters while that exact query's dispatches are in flight."""
+
+    _CAP = 1024
+
+    def __init__(self):
+        self._lock = racecheck.make_lock("engine_rpc.cancel")
+        # (coord, qid) -> reason (insertion-ordered)
+        self._cancelled: "dict" = {}
+
+    def cancel(self, qid, reason: str = "", coord=None) -> None:
+        with self._lock:
+            self._cancelled[(str(coord), int(qid))] = str(
+                reason or "cancelled"
+            )
+            while len(self._cancelled) > self._CAP:
+                self._cancelled.pop(next(iter(self._cancelled)))
+
+    def reason(self, qid, coord=None) -> Optional[str]:
+        if qid is None:
+            return None
+        with self._lock:
+            return self._cancelled.get((str(coord), int(qid)))
+
+    def check(self, qid, coord=None) -> None:
+        r = self.reason(qid, coord=coord)
+        if r is not None:
+            raise QueryCancelled(f"query q{qid} cancelled: {r}")
+
+
+def make_cancel_check(registry: CancelRegistry, qid,
+                      deadline_s: Optional[float] = None,
+                      coord=None):
+    """The worker-side safepoint check for one dispatched fragment or
+    shuffle task: raises QueryCancelled when the coordinator cancelled
+    this qid OR the dispatch-propagated deadline (``deadline_s``
+    REMAINING seconds at dispatch time, converted to a local monotonic
+    deadline here — wall clocks skew across hosts, remaining time does
+    not) has expired. Plugged into PhysicalExecutor.kill_check and
+    sqlkiller.set_current so blocking builtins and chaos hang hooks
+    abort at the same safepoints KILL uses locally."""
+    deadline = (
+        _time.monotonic() + float(deadline_s)
+        if deadline_s is not None else None
+    )
+
+    def check():
+        if registry is not None:
+            registry.check(qid, coord=coord)
+        if deadline is not None and _time.monotonic() > deadline:
+            raise QueryCancelled(
+                f"query q{qid} cancelled: dispatch deadline exceeded"
+            )
+
+    return check
+
+
+class _CheckKiller:
+    """Adapter exposing a cancel check as the sqlkiller 'current
+    killer' protocol (.check()) so utils/sqlkiller.current_check and
+    interruptible_sleep observe fragment cancellation on worker
+    threads exactly like KILL on session threads."""
+
+    __slots__ = ("check",)
+
+    def __init__(self, check):
+        self.check = check
+
+
 class SchemaOutOfDateError(RuntimeError):
     """The frontend planned against a schema version the engine has
     moved past (or not yet reached) — the analog of the domain schema
@@ -122,6 +214,9 @@ class EngineServer:
         # pay nothing
         self._shuffle = None
         self._shuffle_lock = racecheck.make_lock("engine_rpc.shuffle_init")
+        # fleet-wide cancellation: qids cancelled by coordinator
+        # cancel_query frames; every dispatch safepoint polls it
+        self.cancels = CancelRegistry()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -198,6 +293,10 @@ class EngineServer:
                             resp = outer._shuffle_push(req, dec_s)
                         elif "shuffle_task" in req:
                             resp = outer._shuffle_task(req)
+                        elif "cancel_query" in req:
+                            resp = outer._cancel_query(req)
+                        elif "engine_status" in req:
+                            resp = outer._engine_status(req)
                         elif "plan" not in req:
                             # handshake/ping frame — fine whether or not
                             # this server requires a secret (a secreted
@@ -210,11 +309,21 @@ class EngineServer:
                             # timestamps it yields the RTT/2-anchored
                             # clock-offset estimate that rebases worker
                             # spans onto the coordinator timeline
+                            from tidb_tpu.utils.failpoint import inject
+
                             resp = json.dumps(
                                 {
                                     "id": req_id, "ok": True,
                                     "wire": wire.WIRE_VERSION,
-                                    "ts": _time.time(),
+                                    # engine/clock-skew: the chaos
+                                    # harness shifts this host's
+                                    # advertised clock so the offset
+                                    # estimator and span/timeline
+                                    # rebasing run under skew
+                                    "ts": _time.time() + float(
+                                        inject("engine/clock-skew", 0)
+                                        or 0
+                                    ),
                                 }
                             ).encode()
                         else:
@@ -228,12 +337,16 @@ class EngineServer:
                             pass
                         return
                     except Exception as e:
-                        resp = json.dumps(
-                            {
-                                "id": req_id, "ok": False,
-                                "error": f"{type(e).__name__}: {e}",
-                            }
-                        ).encode()
+                        err = {
+                            "id": req_id, "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                        if isinstance(e, QueryCancelled):
+                            # a deliberate abort, not an engine error:
+                            # the coordinator must surface the kill,
+                            # never fail over or quarantine
+                            err["cancelled"] = True
+                        resp = json.dumps(err).encode()
                     try:
                         _send_frame(self.request, resp)
                     except ValueError:
@@ -316,9 +429,23 @@ class EngineServer:
             # a timeline-captured dispatch asks this worker to harvest
             # XLA cost analysis for whatever it compiles (thread-scoped)
             set_cost_wanted(bool(frag.get("timeline")))
+            # fleet cancellation safepoints: the engine's kill_check
+            # polls the cancel registry + the dispatch-propagated
+            # deadline, and sqlkiller's thread-local current killer
+            # makes interruptible waits (and chaos hang hooks) abort
+            # on the same signal
+            from tidb_tpu.utils import sqlkiller as _sk
+
+            check = make_cancel_check(
+                self.cancels, frag.get("qid"), frag.get("deadline_s"),
+                coord=frag.get("coord"),
+            )
+            executor.kill_check = check
+            _sk.set_current(_CheckKiller(check))
             t_exec0 = _time.perf_counter()
             t_wall0 = _time.time()
             try:
+                check()
                 with tracer.span(f"{ctx}/execute"):
                     batch, dicts = executor.run(plan)
                 with tracer.span(f"{ctx}/materialize"):
@@ -332,6 +459,8 @@ class EngineServer:
                 raise
             finally:
                 set_cost_wanted(False)
+                executor.kill_check = None
+                _sk.set_current(None)
             exec_s = _time.perf_counter() - t_exec0
             frag_watch = {
                 "mem_peak_bytes": ENGINE_WATCH.current_peak_bytes(),
@@ -528,9 +657,21 @@ class EngineServer:
             f"shuffle {spec.get('sid')}/p{spec.get('part')}"
         )
         set_cost_wanted(bool(spec.get("timeline")))
+        # fleet cancellation: the task polls this at its loop points
+        # (produce chunks, shipper chunks, store waits, consume) and
+        # the thread-local current killer covers interruptible sleeps
+        from tidb_tpu.utils import sqlkiller as _sk
+
+        check = make_cancel_check(
+            self.cancels, spec.get("qid"), spec.get("deadline_s"),
+            coord=spec.get("coord"),
+        )
+        _sk.set_current(_CheckKiller(check))
         t0 = _time.perf_counter()
         try:
-            result = self.shuffle_worker().run_task(spec, tracer=tracer)
+            result = self.shuffle_worker().run_task(
+                spec, tracer=tracer, cancel_check=check
+            )
         except ShuffleAbort as e:
             ENGINE_WATCH.end_query(_time.perf_counter() - t0)
             return json.dumps(
@@ -544,6 +685,7 @@ class EngineServer:
             raise
         finally:
             set_cost_wanted(False)
+            _sk.set_current(None)
         exec_s = _time.perf_counter() - t0
         task_watch = {
             "mem_peak_bytes": ENGINE_WATCH.current_peak_bytes(),
@@ -574,6 +716,43 @@ class EngineServer:
         if self.ship_registry:
             resp["registry"] = self._registry_delta()
         return json.dumps(resp).encode()
+
+    def _cancel_query(self, req) -> bytes:
+        """Fleet-wide cancellation, worker half: mark the qid in the
+        cancel registry (running fragments/shuffle tasks abort at
+        their next safepoint) and free the query's staged shuffle
+        buffers NOW — the sid is poisoned so in-flight frames from
+        still-pushing peers cannot resurrect an orphan stage record
+        (``tidbtpu_shuffle_stages_buffered`` returns to 0 without
+        waiting for the eviction window)."""
+        c = req["cancel_query"]
+        self.cancels.cancel(
+            c.get("qid"), c.get("reason"), coord=c.get("coord")
+        )
+        sid = c.get("sid")
+        if sid is not None and self._shuffle is not None:
+            self._shuffle.store.poison(str(sid))
+        return json.dumps({"id": req.get("id"), "ok": True}).encode()
+
+    def _engine_status(self, req) -> bytes:
+        """Worker introspection frame (tests + chaos invariants): the
+        shuffle store's buffered-stage count and the live shuffle
+        worker threads on this host — both must return to zero after a
+        cancelled or failed stage (the abort-path leak check)."""
+        stages = 0
+        if self._shuffle is not None:
+            stages = self._shuffle.store.buffered_stages()
+        shuffle_threads = [
+            t.name for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("shuffle-")
+        ]
+        return json.dumps(
+            {
+                "id": req.get("id"), "ok": True,
+                "stages_buffered": stages,
+                "shuffle_threads": shuffle_threads,
+            }
+        ).encode()
 
     def _registry_delta(self):
         from tidb_tpu.utils.metrics import counter_delta
@@ -687,6 +866,27 @@ class EngineClient:
         non-plan frames); the caller interprets the response dict."""
         return self._call(req)
 
+    def cancel_query(self, qid, sid=None, reason: str = "",
+                     coord=None) -> bool:
+        """Fleet-wide cancellation, coordinator half: tell this worker
+        to abort everything it runs for ``qid`` under coordinator
+        instance ``coord`` (and free the stage ``sid``'s shuffle
+        buffers). Control-plane frame on THIS connection — callers use
+        a dedicated short-lived connection, never a stream with a
+        dispatch in flight."""
+        resp = self._call(
+            {"cancel_query": {
+                "qid": qid, "sid": sid, "reason": reason,
+                "coord": coord,
+            }}
+        )
+        return bool(resp.get("ok"))
+
+    def engine_status(self) -> dict:
+        """Worker introspection (tests + chaos invariants): buffered
+        shuffle stages and live shuffle threads on the peer."""
+        return self._call({"engine_status": True})
+
     def shuffle_push(self, packet: dict) -> bool:
         """Push one shuffle partition packet to this peer; returns the
         receiver's accepted flag (False = fenced/deduped, which is fine
@@ -787,6 +987,11 @@ class EngineClient:
         resp = self._call(req)
         if not resp.get("ok"):
             err = str(resp.get("error", ""))
+            if resp.get("cancelled"):
+                # deliberate worker-side abort (fleet cancel /
+                # propagated deadline): typed so the scheduler treats
+                # it as a kill, never an engine error or a death
+                raise QueryCancelled(err)
             if "SchemaOutOfDateError" in err:
                 raise SchemaOutOfDateError(err)
             raise RuntimeError(f"engine error: {err}")

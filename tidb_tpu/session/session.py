@@ -1438,8 +1438,17 @@ class Session(DDLMixin):
             # as errors to the client, but the statements_summary row
             # must still land — with the phase breakdown showing the
             # queue-wait that led to the verdict, or an operator can
-            # never see WHY the fleet is shedding load
-            if top and getattr(e, "admission_outcome", None):
+            # never see WHY the fleet is shedding load. KILLED
+            # statements (KILL QUERY / max_execution_time — now
+            # cancelled fleet-wide, parallel/dcn.py) land for the same
+            # reason: the runaway's phase breakdown and latency are
+            # exactly what an operator tuning max_execution_time needs
+            from tidb_tpu.utils.sqlkiller import QueryKilled
+
+            if top and (
+                getattr(e, "admission_outcome", None)
+                or isinstance(e, QueryKilled)
+            ):
                 try:
                     self._observe_stmt(s, time.perf_counter() - t0)
                 except Exception:
@@ -2926,6 +2935,41 @@ class Session(DDLMixin):
                         adm.starvation_s = float(
                             self.vars.get("tidb_tpu_admission_starvation_s")
                         )
+                if s.name.lower().startswith(
+                    ("tidb_tpu_shuffle_", "tidb_tpu_heartbeat_")
+                ) and s.scope == "global":
+                    # live re-tune of an attached scheduler's shuffle
+                    # wait timeout and heartbeat liveness knobs (the
+                    # admission-knob pattern above; construction-time
+                    # wiring is the scheduler ctor's sysvar
+                    # resolution). GLOBAL scope only, read through a
+                    # session-override-free view: the scheduler is
+                    # SHARED by every attached session — one tenant's
+                    # session-scoped SET must not re-time the whole
+                    # fleet's timeouts
+                    sched = getattr(self, "dcn_scheduler", None)
+                    if sched is not None:
+                        from tidb_tpu.utils.sysvar import SysVars
+
+                        gv = SysVars(self.catalog.global_sysvars)
+                        name = s.name.lower()
+                        if name.startswith("tidb_tpu_shuffle_"):
+                            sched.shuffle_wait_timeout_s = float(
+                                gv.get(
+                                    "tidb_tpu_shuffle_wait_timeout_s"
+                                )
+                            )
+                        else:
+                            sched.heartbeat.retune(
+                                interval_s=float(
+                                    gv.get(
+                                        "tidb_tpu_heartbeat_interval_s"
+                                    )
+                                ),
+                                miss_threshold=int(gv.get(
+                                    "tidb_tpu_heartbeat_miss_threshold"
+                                )),
+                            )
                 if s.name.lower() == "tidb_gc_life_time":
                     # side effect: the storage GC horizon is engine-wide.
                     # The sysvar is GLOBAL-only (set() above enforces
@@ -3960,7 +4004,17 @@ class Session(DDLMixin):
                         self, "_bill_exclude_s", 0.0
                     ) + waited
             try:
-                cols, rows = sched.execute_plan(plan, cut_hint=(kind, cut))
+                # fleet-wide cancellation: the session killer (KILL
+                # QUERY + max_execution_time deadline) is polled while
+                # dispatches are in flight and broadcast as
+                # cancel_query to the workers on the first raise; the
+                # deadline additionally PROPAGATES in each dispatch so
+                # workers self-cancel even if the coordinator wedges
+                cols, rows = sched.execute_plan(
+                    plan, cut_hint=(kind, cut),
+                    kill_check=self.killer.check,
+                    deadline=self.killer.deadline or None,
+                )
                 dispatched = True
             except (QueryKilled, QuotaExceeded):
                 # deliberate aborts (KILL QUERY / max_execution_time /

@@ -30,6 +30,7 @@ SITES = frozenset({
     "cdc/sink-write",
     "collate/rank-lut",
     "cte/iterate",
+    "dcn/cancel",
     "dcn/dispatch",
     "dcn/dispatch-lost",
     "dcn/duplicate-redelivery",
@@ -53,6 +54,7 @@ SITES = frozenset({
     "dml/update",
     "dxf/heartbeat",
     "dxf/submit",
+    "engine/clock-skew",
     "engine/dispatch",
     "engine/execute",
     "engine/probe-fail",
@@ -181,21 +183,67 @@ def is_enabled(name: str) -> bool:
     return name in _active
 
 
+def _gated(action: object, msg: str, due):
+    """The shared shell of every stateful action term: serialize hits
+    on a private lock, ask ``due()`` (which owns/mutates the term's
+    state) whether THIS hit fires, and run the action if so — the
+    thread-safety and dispatch live once for seeded/times/after_n."""
+    slock = racecheck.make_lock("failpoint.site")
+
+    def fire():
+        with slock:
+            hit = due()
+        if not hit:
+            return None
+        return _run_action(action, msg)
+
+    return fire
+
+
+def seeded(seed: int, p: float, action: object):
+    """A PROBABILISTIC action driven by a private seeded PRNG: each
+    invocation of the site draws once and fires `action` when the draw
+    lands under `p`. The draw SEQUENCE is fully determined by the seed
+    — the chaos harness (tidb_tpu/chaos) replays a fault schedule by
+    re-arming the same (seed, p) pair, the analog of the reference's
+    `K%` failpoint term (pingcap/failpoint terms.go) made
+    deterministic. Thread-safe: concurrent hits serialize so every
+    hit consumes exactly one draw."""
+    import random
+
+    rng = random.Random(int(seed))
+    return _gated(
+        action, f"failpoint seeded({seed}, {p})",
+        lambda: rng.random() < float(p),
+    )
+
+
+def _counter(n: int, cmp):
+    state = {"count": 0}
+
+    def due():
+        state["count"] += 1
+        return cmp(state["count"], int(n))
+
+    return due
+
+
+def times(n: int, action: object):
+    """An action that fires on the FIRST n invocations of its site and
+    then goes dormant — a bounded fault WINDOW (the reference's `Nx`
+    term): a tunnel partition that heals after k frames, a crash storm
+    that ends. Thread-safe."""
+    return _gated(
+        action, "failpoint times", _counter(n, lambda c, n: c <= n)
+    )
+
+
 def after_n(n: int, action: object):
     """An action that fires EXACTLY on the n-th invocation of its site
     (dormant before and after) — 'die on the K-th fragment' style
     schedules, the analog of the reference's `Nx`/`xN` failpoint term
     syntax (pingcap/failpoint terms.go). One-shot so a retry of the
     failed operation observes a healthy site. Thread-safe."""
-    state = {"count": 0}
-    slock = racecheck.make_lock("failpoint.site")
-
-    def fire():
-        with slock:
-            state["count"] += 1
-            due = state["count"] == int(n)
-        if not due:
-            return None
-        return _run_action(action, "failpoint after_n")
-
-    return fire
+    return _gated(
+        action, "failpoint after_n", _counter(n, lambda c, n: c == n)
+    )

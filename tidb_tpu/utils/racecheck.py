@@ -69,6 +69,8 @@ LOCK_CLASSES: Dict[str, str] = {
     "server.conns": "MySQL server connection counter/ids",
     "engine_rpc.registry": "per-server shipped-registry delta snapshot",
     "engine_rpc.shuffle_init": "lazy ShuffleWorker construction",
+    "engine_rpc.cancel": "per-server cancelled-qid registry (fleet "
+                         "cancellation)",
     "engine_pool.pool": "engine pool rotation + per-endpoint conn map",
     "engine_pool.prober": "quarantined-endpoint list",
     "engine_pool.conn": "one engine connection's request/response stream",
@@ -76,6 +78,7 @@ LOCK_CLASSES: Dict[str, str] = {
     "dcn.ledger": "exactly-once fragment ledger records",
     "dcn.scheduler": "scheduler rotation/suspects/last_query telemetry",
     "dcn.pool": "one endpoint's control-connection pool (condition)",
+    "dcn.heartbeat": "heartbeat retune serialization (one beat thread)",
     "serving.admission": "admission queue/budget state (condition)",
     "serving.qid": "strictly-unique qid/nonce allocation",
     "serving.load": "serve-load driver's client latency/error lists",

@@ -103,6 +103,28 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "seconds of queue wait that age a query's effective "
                   "priority up one rank (and reserve the fleet for a "
                   "starving head-of-queue)"),
+        # DCN liveness/timeout knobs (parallel/dcn.py resolves unset
+        # constructor args from these; a live SET re-tunes an attached
+        # scheduler — session.py SetVariable hook). The 120s default is
+        # WAN-scale: loopback dryruns and the serve-load driver SET it
+        # down so survivor waits don't stack into minutes (PERF_NOTES).
+        # GLOBAL-only: the scheduler these tune is SHARED by every
+        # attached session — a session scope would validate, succeed,
+        # and silently tune nothing (the fleet reads the global store)
+        SysVarDef("tidb_tpu_shuffle_wait_timeout_s", 120.0, "global",
+                  _float_range(0.1, 3600.0),
+                  "seconds a shuffle consumer waits for its peers' "
+                  "partition streams before reporting them as death "
+                  "suspects (stage retry on the survivor set)"),
+        SysVarDef("tidb_tpu_heartbeat_interval_s", 0.0, "global",
+                  _float_range(0.0, 3600.0),
+                  "worker-host heartbeat cadence for the DCN "
+                  "scheduler's liveness thread (0 = no background "
+                  "thread; beats run manually or at dispatch sites)"),
+        SysVarDef("tidb_tpu_heartbeat_miss_threshold", 2, "global",
+                  _int_range(1, 100),
+                  "consecutive missed heartbeats that quarantine a "
+                  "worker host into the prober"),
         SysVarDef("tidb_txn_mode", "pessimistic", "both",
                   _enum("pessimistic", "optimistic"),
                   "transaction mode: pessimistic takes blocking table "
