@@ -1020,6 +1020,163 @@ def measure_multihost_shuffle(args) -> int:
                     sched.close()
             return out
 
+        def run_dag_ab(pairs):
+            """Shuffle-DAG A/B (ISSUE 11): the join -> RE-KEYED
+            DISTINCT group-by -> ORDER BY LIMIT query runs CHAINED
+            (hash join stage -> held-output re-key stage -> range
+            top-K stage; both sides fragment-sliced) vs the SINGLE-CUT
+            group-by baseline (only lineitem sliced — every host
+            re-scans the whole orders side). Interleaved pairs, same
+            workers; reports wall + per-host scanned base rows +
+            per-host produced exchange bytes."""
+            dag_sql = (
+                "select o_orderpriority, count(distinct l_suppkey), "
+                "sum(l_extendedprice) from orders join lineitem "
+                "on o_orderkey = l_orderkey group by o_orderpriority "
+                "order by sum(l_extendedprice) desc limit 3"
+            )
+            dag_plan = build_query(
+                parse(dag_sql)[0], cat, "tpch", sess._scalar_subquery
+            )
+            scheds = {
+                "chained": DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="always",
+                    shuffle_dag="always",
+                ),
+                "single_cut": DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="always",
+                    shuffle_dag="never",
+                ),
+            }
+            out = {
+                mode: {
+                    "wall": [], "scan_rows_per_host": 0,
+                    "bytes_per_host": 0, "stages": 0,
+                }
+                for mode in scheds
+            }
+
+            def scan_bytes_per_host(sched):
+                """Per-host base-table PRODUCE bytes of this
+                scheduler's chosen cut: every Scan it executes per
+                host (sliced scans read nrows/2, re-scanned unsliced
+                sides read ALL nrows on EVERY host) times the pruned
+                column set at 8 B/col — the scan-work cost the
+                chained DAG removes, priced from the plan the
+                scheduler actually picked."""
+                from tidb_tpu.planner import logical as L
+
+                kind, cut2 = sched._choose_cut(dag_plan)
+                sides = (
+                    [s for st in cut2.stages for s in st.sides]
+                    if kind == "dag" else list(cut2.sides)
+                )
+                total = 0.0
+                for s in sides:
+                    if s.frag_scan is None:
+                        continue  # re-staged held output: no scan
+                    scans = []
+
+                    def walk(p):
+                        if isinstance(p, L.Scan):
+                            scans.append(p)
+                            return
+                        for a in ("child", "left", "right"):
+                            c = getattr(p, a, None)
+                            if c is not None:
+                                walk(c)
+                        for c in getattr(p, "children", []) or []:
+                            walk(c)
+
+                    walk(s.template)
+                    for sc in scans:
+                        nrows = cat.table(sc.db, sc.table).nrows
+                        share = nrows / 2 if sc is s.frag_scan else nrows
+                        total += share * 8 * len(sc.columns)
+                return int(total)
+
+            ref = None
+            try:
+                for sched in scheds.values():  # warm (XLA compiles)
+                    sched.execute_plan(dag_plan)
+                for _ in range(pairs):
+                    for mode, sched in scheds.items():
+                        t0 = time.perf_counter()
+                        _cols, res = sched.execute_plan(dag_plan)
+                        out[mode]["wall"].append(
+                            time.perf_counter() - t0
+                        )
+                        if ref is None:
+                            ref = res
+                        assert res == ref, f"dag A/B parity broke ({mode})"
+                        lq = sched.last_query or {}
+                        frags = lq.get("fragments", [])
+                        by_host = {}
+                        for f in frags:
+                            h = by_host.setdefault(
+                                f.get("host"), [0, 0]
+                            )
+                            h[0] += int(f.get("scan_rows", 0))
+                            h[1] += int(f.get("pushed_bytes", 0))
+                        if by_host:
+                            out[mode]["scan_rows_per_host"] = max(
+                                v[0] for v in by_host.values()
+                            )
+                            out[mode]["bytes_per_host"] = max(
+                                v[1] for v in by_host.values()
+                            )
+                        out[mode]["stages"] = len(
+                            lq.get("shuffle_stages")
+                            or ([lq["shuffle"]] if lq.get("shuffle")
+                                else [])
+                        )
+            finally:
+                for sched in scheds.values():
+                    sched.close()
+            ch, sc = out["chained"], out["single_cut"]
+            produce_ch = scan_bytes_per_host(scheds["chained"])
+            produce_sc = scan_bytes_per_host(scheds["single_cut"])
+            return {
+                "pairs": pairs,
+                "query": dag_sql,
+                # per-host base-table produce bytes (pruned columns x
+                # slice share): the chained DAG slices BOTH sides; the
+                # single cut re-scans the whole unsliced orders side
+                # on every host
+                "produce_bytes_per_host_chained": produce_ch,
+                "produce_bytes_per_host_single_cut": produce_sc,
+                "produce_bytes_ratio": round(
+                    produce_sc / max(produce_ch, 1), 4
+                ),
+                "seconds_chained": round(
+                    statistics.median(ch["wall"]), 6
+                ),
+                "seconds_single_cut": round(
+                    statistics.median(sc["wall"]), 6
+                ),
+                "speedup": round(
+                    statistics.median(sc["wall"])
+                    / max(statistics.median(ch["wall"]), 1e-9), 4
+                ),
+                "stages_chained": ch["stages"],
+                "stages_single_cut": sc["stages"],
+                # the headline: scanned base rows per host — the
+                # chained DAG slices BOTH sides (~ total/N per host);
+                # the single cut re-scans the unsliced orders side on
+                # every host
+                "scan_rows_per_host_chained": ch["scan_rows_per_host"],
+                "scan_rows_per_host_single_cut":
+                    sc["scan_rows_per_host"],
+                "scan_rows_ratio": round(
+                    sc["scan_rows_per_host"]
+                    / max(ch["scan_rows_per_host"], 1), 4
+                ),
+                "bytes_per_host_chained": ch["bytes_per_host"],
+                "bytes_per_host_single_cut": sc["bytes_per_host"],
+            }
+
         # flight-recorder attribution through the session routing path
         # (PR 6): the SAME query executed as SQL with the scheduler
         # ATTACHED — statements_summary picks up the worker-reported
@@ -1065,6 +1222,7 @@ def measure_multihost_shuffle(args) -> int:
         flight_breakdown = run_flight_attributed()
 
         ab = run_pipeline_pairs(pairs=max(args.repeat, 5))
+        dag_ab = run_dag_ab(pairs=max(args.repeat, 3))
         assert tunnel["result"] == staged["result"], "mode parity broke"
         assert tunnel_json["result"] == staged["result"], (
             "codec parity broke"
@@ -1158,6 +1316,9 @@ def measure_multihost_shuffle(args) -> int:
                 },
                 "codec_ab": codec_ab,
                 "pipeline_ab": pipeline_ab,
+                # ISSUE 11: chained shuffle DAG vs single-cut re-scan
+                # (wall + per-host scanned rows + exchange bytes)
+                "dag_ab": dag_ab,
                 # --racecheck: workers ran with TIDB_TPU_RACECHECK=1
                 # (order-tracked locks); a worker inversion raises and
                 # fails the run, so True here means the data plane ran
@@ -1211,6 +1372,186 @@ def measure_multihost_shuffle(args) -> int:
     rc = 0
     if args.out:
         args.cpu = True  # deliberate CPU scenario: not a fallback
+        rc = _write_out(args, result)
+    print(json.dumps(result))
+    return rc
+
+
+def measure_order_by(args) -> int:
+    """Distributed ORDER BY ladder (ISSUE 11): range-partitioned
+    exchanges vs the coordinator-sort baseline on a 2-worker x
+    4-device CPU dryrun. Each rung runs one ORDER BY (LIMIT) query
+    both ways — shuffle_dag="always" (boundary-sampled range exchange,
+    per-partition sort/top-K, order-preserving concat) vs
+    shuffle_mode="never" (the fragment cut ships EVERY row to the
+    coordinator, which re-sorts) — at exact row parity, recording
+    walls, rows shipped to the coordinator, and per-partition top-K
+    row caps. CPU data-plane scenario, provenance-stamped."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import re
+    import statistics
+
+    from tidb_tpu.bench import load_tpch
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+    from tidb_tpu.utils.metrics import REGISTRY
+
+    sf = args.sf if args.sf <= 1.0 else 0.02
+    seed = 3
+    workers = []
+    try:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        ports = []
+        for _ in range(2):
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tidb_tpu.parallel.dcn_worker",
+                    "--port", "0", "--mesh-devices", "4",
+                    "--tpch-sf", str(sf), "--seed", str(seed),
+                    "--tables", "orders,lineitem",
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            workers.append(p)
+            line = p.stdout.readline()
+            m = re.match(r"DCN_WORKER_READY port=(\d+)", line)
+            if not m:
+                try:
+                    rest, _ = p.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rest = ""
+                raise RuntimeError(
+                    f"worker not ready: {line!r}\n{rest[-3000:]}"
+                )
+            ports.append(int(m.group(1)))
+
+        cat = Catalog()
+        load_tpch(cat, sf=sf, seed=seed, tables=["orders", "lineitem"])
+        sess = Session(cat, db="tpch")
+        #: the ladder: top-K, aggregate-then-order, and a full sort
+        RUNGS = [
+            ("topk",
+             "select l_orderkey, l_extendedprice from lineitem "
+             "order by l_extendedprice desc limit 100"),
+            ("agg_topk",
+             "select l_suppkey, count(*), sum(l_quantity) from "
+             "lineitem group by l_suppkey "
+             "order by sum(l_quantity) desc limit 10"),
+            ("full_sort",
+             "select l_extendedprice, l_orderkey from lineitem "
+             "order by l_extendedprice"),
+        ]
+
+        def _reg_total(prefix):
+            return sum(
+                v for n, _k, v in REGISTRY.rows() if n.startswith(prefix)
+            )
+
+        def run_rung(name, sql):
+            plan = build_query(
+                parse(sql)[0], cat, "tpch", sess._scalar_subquery
+            )
+            scheds = {
+                "range": DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="always",
+                    shuffle_dag="always",
+                ),
+                "staged": DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="never",
+                    shuffle_dag="never",
+                ),
+            }
+            out = {}
+            try:
+                kind, cut = scheds["range"]._choose_cut(plan)
+                assert kind == "dag", (
+                    f"rung {name} did not plan a range DAG ({kind})"
+                )
+                ref = None
+                for mode, sched in scheds.items():
+                    sched.execute_plan(plan)  # warm the compiles
+                    staged0 = _reg_total("tidbtpu_dcn_bytes_staged")
+                    walls = []
+                    rows = []
+                    for _ in range(max(args.repeat, 3)):
+                        t0 = time.perf_counter()
+                        _cols, rows = sched.execute_plan(plan)
+                        walls.append(time.perf_counter() - t0)
+                    if ref is None:
+                        ref = rows
+                    assert rows == ref, f"rung {name} parity broke"
+                    lq = sched.last_query or {}
+                    entry = {
+                        "seconds": round(statistics.median(walls), 6),
+                        "rows": len(rows),
+                        "bytes_over_coordinator": _reg_total(
+                            "tidbtpu_dcn_bytes_staged"
+                        ) - staged0,
+                    }
+                    if mode == "range":
+                        st = (lq.get("shuffle_stages") or [{}])[-1]
+                        frags = lq.get("fragments", [])
+                        last_stage = st.get("stage", 0)
+                        entry["boundaries"] = st.get("boundaries")
+                        entry["max_partition_rows"] = max(
+                            (
+                                f.get("rows", 0) for f in frags
+                                if f.get("stage", 0) == last_stage
+                            ),
+                            default=0,
+                        )
+                    out[mode] = entry
+            finally:
+                for sched in scheds.values():
+                    sched.close()
+            out["speedup_vs_staged"] = round(
+                out["staged"]["seconds"]
+                / max(out["range"]["seconds"], 1e-9), 4
+            )
+            out["query"] = sql
+            return name, out
+
+        ladder = dict(run_rung(n, s) for n, s in RUNGS)
+        nrows = cat.table("tpch", "lineitem").nrows
+        result = {
+            "metric": f"order_by_range_exchange_sf{sf:g}_rows_per_sec",
+            "value": round(
+                nrows / ladder["topk"]["range"]["seconds"], 2
+            ),
+            "unit": "rows/s",
+            "vs_baseline": ladder["topk"]["speedup_vs_staged"],
+            "detail": {
+                "backend": "cpu",
+                "scenario": "order_by_range_exchange",
+                "workers": 2,
+                "mesh_devices": 4,
+                "sf": sf,
+                "repeat": args.repeat,
+                "order_by": ladder,
+                "backend_provenance": {
+                    "backend": "cpu",
+                    "pjrt_backend": "cpu",
+                    "code_version": _code_version(),
+                    "captured_unix": int(time.time()),
+                    "fallback": False,
+                },
+            },
+        }
+    finally:
+        for p in workers:
+            p.kill()
+    rc = 0
+    if args.out:
+        args.cpu = True
         rc = _write_out(args, result)
     print(json.dumps(result))
     return rc
@@ -1335,6 +1676,16 @@ def main() -> int:
         "0.02 unless --sf <= 1)",
     )
     ap.add_argument(
+        "--order-by", action="store_true",
+        help="run the distributed ORDER BY range-exchange ladder "
+        "instead of the single-engine ladder: top-K / aggregate-then-"
+        "order / full-sort queries each run range-partitioned "
+        "(boundary-sampled exchange, per-partition sort with pushed-"
+        "down top-K, order-preserving concat) vs the coordinator-sort "
+        "baseline at exact parity; stamps detail.order_by (CPU "
+        "data-plane scenario; SF capped at 0.02 unless --sf <= 1)",
+    )
+    ap.add_argument(
         "--serve-load", action="store_true",
         help="run the serving-tier load scenario instead of the "
         "single-engine ladder: N concurrent MySQL-protocol sessions "
@@ -1400,6 +1751,8 @@ def main() -> int:
         return measure_chaos(args)
     if args.multihost_shuffle:
         return measure_multihost_shuffle(args)
+    if args.order_by:
+        return measure_order_by(args)
 
     if args._measure:
         return measure(args)

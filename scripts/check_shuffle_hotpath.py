@@ -37,7 +37,8 @@ HOTPATH = {
     os.path.join("tidb_tpu", "parallel", "wire.py"): {
         "encode_frame", "decode_frame", "decode_header",
         "splice_id_auth", "column_key_ints", "partition_map",
-        "partition_block",
+        "partition_block", "range_key_values", "range_partition_map",
+        "sample_range_keys",
     },
     os.path.join("tidb_tpu", "parallel", "shuffle.py"): {
         "partition_rows",
@@ -48,6 +49,8 @@ HOTPATH = {
         "PeerTunnel.send", "PeerTunnel._loop",
         "ShuffleWorker.run_task", "ShuffleWorker._ship_side_stream",
         "ShuffleWorker._ship_partition", "ShuffleWorker._send_stream",
+        "ShuffleWorker._ship_block_side",
+        "ShuffleWorker._side_input_block", "ShuffleWorker.run_sample",
     },
     os.path.join("tidb_tpu", "server", "engine_rpc.py"): {
         "EngineServer._shuffle_push", "EngineServer._shuffle_push_binary",
@@ -80,6 +83,27 @@ BANNED = {
             "materialize_rows":
                 "whole-stage row materialization on the binary "
                 "produce path",
+        },
+        "ShuffleWorker._ship_block_side": {
+            "materialize_rows":
+                "whole-side row materialization on the range/"
+                "broadcast/re-staging produce path — DAG edges stay "
+                "columnar end to end (take_block + encode_frame)",
+            "dumps":
+                "JSON on the DAG edge data plane — range/broadcast/"
+                "re-staged partitions ship as binary frames "
+                "(_ship_partition's negotiated fallback is the only "
+                "JSON door)",
+        },
+        "ShuffleWorker._side_input_block": {
+            "materialize_rows":
+                "a held StageInput block re-materialized as Python "
+                "rows — the held HostBlock partitions columnar",
+        },
+        "ShuffleWorker.run_sample": {
+            "materialize_rows":
+                "boundary sampling must read the key COLUMN "
+                "(sample_range_keys), never materialize the side",
         },
         "ShuffleWorker.run_task": {
             "decode_frame":

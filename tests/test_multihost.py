@@ -987,3 +987,187 @@ def test_dcn_multihost_chaos_composed_faults(tpch_single):
         sched.close()
         for w in workers:
             w.kill()
+
+
+#: the ISSUE 11 acceptance shape: join -> RE-KEYED GROUP BY (the group
+#: key is not a join key, and the DISTINCT makes the aggregate
+#: non-decomposable — the single-cut group-by re-scans the unsliced
+#: orders side on every host) -> ORDER BY LIMIT (a range exchange with
+#: per-partition top-K)
+DAG_QUERY = (
+    "select o_orderpriority, count(distinct l_suppkey), "
+    "sum(l_extendedprice) from orders join lineitem "
+    "on o_orderkey = l_orderkey group by o_orderpriority "
+    "order by sum(l_extendedprice) desc limit 3"
+)
+
+
+def test_dcn_shuffle_dag_tpch_parity(tpch_single):
+    """ISSUE 11 acceptance: the join -> re-keyed GROUP BY -> ORDER BY
+    LIMIT query executes as >= 2 chained shuffle stages on the
+    2-process dryrun with BOTH join sides fragment-sliced — per-host
+    scanned base rows ~ total/N, vs the single-cut group-by baseline
+    that re-scans the whole unsliced orders side on every host — the
+    range exchange returns exact global order at row parity, the
+    exchange bytes bypass the coordinator (staged-delta invariant),
+    and the sampled boundaries are deterministic across runs."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_rpc import EngineClient
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    cat = tpch_single.catalog
+    n_orders = cat.table("tpch", "orders").nrows
+    n_lineitem = cat.table("tpch", "lineitem").nrows
+    total = n_orders + n_lineitem
+    exp = tpch_single.must_query(DAG_QUERY).rows
+    plan = _plan(tpch_single, DAG_QUERY)
+
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=cat, shuffle_mode="always", shuffle_dag="always",
+    )
+    try:
+        # the planner really chained stages: hash join -> hash re-key
+        # -> range order-by
+        kind, cut = sched._choose_cut(plan)
+        assert kind == "dag"
+        assert [s.exchange for s in cut.stages] == [
+            "hash", "hash", "range",
+        ]
+        staged0 = _counter_total("tidbtpu_dcn_bytes_staged")
+        _cols, got = sched.execute_plan(plan, cut_hint=(kind, cut))
+        # exact global order parity against local execution (the
+        # order-preserving concat, not a coordinator re-sort)
+        assert got == exp, f"\n got={got}\n exp={exp}"
+        # exchange data rode worker-to-worker tunnels, NOT the
+        # coordinator (the staged-delta invariant of PR 3, now held
+        # across a 3-stage chain)
+        assert _counter_total("tidbtpu_dcn_bytes_staged") == staged0
+        stages = sched.last_query["shuffle_stages"]
+        frags = sched.last_query["fragments"]
+        assert [s["stage"] for s in stages] == [0, 1, 2]
+        # BOTH join sides fragment-sliced: each host scanned ~ total/2
+        # base rows in stage 0 and NOTHING after (stages 1-2 re-stage
+        # held outputs)
+        for f in [f for f in frags if f["stage"] == 0]:
+            assert abs(f["scan_rows"] - total / 2) <= 2, f
+        assert all(
+            f["scan_rows"] == 0 for f in frags if f["stage"] > 0
+        )
+        # per-partition top-K: the range stage shipped at most K rows
+        # per partition
+        for f in [f for f in frags if f["stage"] == 2]:
+            assert f["rows"] <= 3
+        # boundary-sampling determinism: a second run cuts the SAME
+        # boundaries (fixed sample seed)
+        b1 = stages[2]["boundaries"]
+        sched.execute_plan(plan, cut_hint=(kind, cut))
+        b2 = sched.last_query["shuffle_stages"][2]["boundaries"]
+        assert b1 == b2 and b1  # non-trivial and identical
+        # no held stage outputs or buffered stages linger on workers
+        for port in (p1, p2):
+            c = EngineClient("127.0.0.1", port, timeout_s=10.0)
+            try:
+                st = c.engine_status()
+            finally:
+                c.close()
+            assert st["stages_buffered"] == 0
+            assert st["held_outputs"] == 0
+    finally:
+        sched.close()
+
+    # the single-cut BASELINE (shuffle_dag="never"): the DISTINCT
+    # group-by cut slices only lineitem — every host re-scans the
+    # whole orders side (the N x wasted scan work the DAG removes)
+    sched2 = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=cat, shuffle_mode="always", shuffle_dag="never",
+    )
+    try:
+        kind2, cut2 = sched2._choose_cut(plan)
+        assert kind2 == "shuffle" and cut2.kind == "groupby"
+        _cols, got2 = sched2.execute_plan(plan, cut_hint=(kind2, cut2))
+        assert got2 == exp
+        for f in sched2.last_query["fragments"]:
+            # per-host scan = its lineitem slice + ALL of orders
+            assert abs(
+                f["scan_rows"] - (n_lineitem / 2 + n_orders)
+            ) <= 2, f
+    finally:
+        sched2.close()
+        for w in (w1, w2):
+            w.kill()
+
+
+def test_dcn_multihost_chaos_interstage_kill(tpch_single):
+    """ISSUE 11 chaos acceptance: a composed-fault episode killing a
+    worker BETWEEN stage N and stage N+1 of the DAG (os._exit the
+    first time it reads a held StageInput, while every worker also
+    drops pushed frames probabilistically). The coordinator must
+    quarantine the dead worker, restart the WHOLE chain on the
+    survivor under a new attempt (the superseded attempt's held
+    partitions are fenced by the attempt key), and still return exact
+    parity — with no leaked held outputs, buffers, threads, or
+    leases."""
+    import json as _json
+    import time
+
+    from tidb_tpu.chaos.schedule import generate_interstage_kill_specs
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_pool import FailedEngineProber
+    from tidb_tpu.server.engine_rpc import EngineClient
+
+    SEED = 2718
+    specs = generate_interstage_kill_specs(SEED, 2)
+    assert specs == generate_interstage_kill_specs(SEED, 2)
+    assert specs[-1][-1]["site"] == "shuffle/stage-input"
+    assert specs[-1][-1]["kind"] == "exit"
+    workers, ports = [], []
+    for spec in specs:
+        w, p = _spawn_dcn_worker(["--chaos-spec", _json.dumps(spec)])
+        workers.append(w)
+        ports.append(p)
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p) for p in ports],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always", shuffle_dag="always",
+        shuffle_wait_timeout_s=15.0,
+        retry_backoff_s=0.05,
+        prober=FailedEngineProber(initial_backoff_s=60),
+    )
+    t0 = time.monotonic()
+    try:
+        exp = tpch_single.must_query(DAG_QUERY).rows
+        _cols, got = sched.execute_plan(_plan(tpch_single, DAG_QUERY))
+        assert got == exp, (
+            f"interstage-kill parity broke (seed {SEED}):\n"
+            f" got={got}\n exp={exp}"
+        )
+        # the kill really happened BETWEEN stages: worker 2 died via
+        # os._exit(3) on the stage-input site and was quarantined
+        workers[-1].wait(timeout=30)
+        assert workers[-1].returncode == 3
+        assert [e.port for e in sched.prober.failed_endpoints()] == (
+            [ports[-1]]
+        )
+        # the chain retried on the survivor set
+        assert any(
+            s["attempts"] >= 2
+            for s in sched.last_query["shuffle_stages"]
+        )
+        assert time.monotonic() - t0 < 120.0
+        # invariant audit on the survivor: nothing leaked
+        assert all(v == 0 for v in sched.pool_leased().values())
+        c = EngineClient("127.0.0.1", ports[0], timeout_s=5.0)
+        try:
+            st = c.engine_status()
+        finally:
+            c.close()
+        assert st["stages_buffered"] == 0
+        assert st["held_outputs"] == 0
+        assert not st["shuffle_threads"]
+    finally:
+        sched.close()
+        for w in workers:
+            w.kill()

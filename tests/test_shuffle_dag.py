@@ -1,0 +1,563 @@
+"""Shuffle DAGs: multi-stage exchanges, range-partitioned distributed
+ORDER BY, per-partition top-K, and the per-edge broadcast cost model.
+
+Planner shapes (split_plan_dag), the range-partition wire helpers, the
+coordinator's boundary merge, and end-to-end parity against in-process
+EngineServer fleets — including whole-DAG retry after a boundary-sample
+loss and after a worker "dies" between stage N and N+1
+(shuffle/stage-input), with held-output drain audited after every run.
+The multi-process dryruns live in tests/test_multihost.py.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import HostBlock, column_from_values
+from tidb_tpu.dtypes import FLOAT64, INT64, SQLType, Kind
+from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+from tidb_tpu.parallel.wire import (
+    range_key_values,
+    range_partition_map,
+    sample_range_keys,
+)
+from tidb_tpu.parser.sqlparse import parse
+from tidb_tpu.planner import logical as L
+from tidb_tpu.planner.fragmenter import (
+    DagStage,
+    ShuffleSide,
+    choose_edge_modes,
+    split_plan_dag,
+)
+from tidb_tpu.planner.logical import build_query
+from tidb_tpu.server.engine_rpc import EngineServer
+from tidb_tpu.session.session import Session
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table t (a int, b varchar(8), c int)")
+    s.execute(
+        "insert into t values (1,'x',5),(2,'y',null),(3,'x',7),"
+        "(4,null,8),(2,'x',5),(7,'y',null),(1,'y',2),(3,'z',3),"
+        "(5,'w',5)"
+    )
+    s.execute("create table u (k int, v int)")
+    s.execute(
+        "insert into u values (1,10),(2,20),(3,30),(4,40),(1,11),"
+        "(7,70),(3,31),(5,50)"
+    )
+    return s
+
+
+def _plan(sess, q):
+    return build_query(
+        parse(q)[0], sess.catalog, "test", sess._scalar_subquery
+    )
+
+
+def _block(vals, typ=INT64):
+    return HostBlock({"k": column_from_values(vals, typ)}, len(vals))
+
+
+# ---------------------------------------------------------------------------
+# range partitioning (wire helpers)
+# ---------------------------------------------------------------------------
+
+
+class TestRangePartition:
+    def test_partitions_by_boundaries_ties_colocate(self):
+        blk = _block([1, 5, 5, 9, 2, 7, 5])
+        pmap = range_partition_map(blk, "k", [2, 5])
+        # partition p owns (b[p-1], b[p]]: 1,2 -> 0; 5,5,5 -> 1; 9,7 -> 2
+        assert pmap.tolist() == [0, 1, 1, 2, 0, 2, 1]
+
+    def test_null_keys_land_partition_zero(self):
+        blk = _block([None, 9, None, 1])
+        pmap = range_partition_map(blk, "k", [4])
+        assert pmap.tolist() == [0, 1, 0, 0]
+
+    def test_empty_boundaries_collapse_to_partition_zero(self):
+        blk = _block([3, 1, 2])
+        assert range_partition_map(blk, "k", []).tolist() == [0, 0, 0]
+
+    def test_float_and_decimal_domains_order(self):
+        fblk = _block([2.5, -1.0, 0.0], FLOAT64)
+        assert range_partition_map(fblk, "k", [0.0]).tolist() == [1, 0, 0]
+        dec = SQLType(Kind.DECIMAL, scale=2)
+        dblk = _block([1.50, 0.25, 4.75], dec)
+        # scaled-unit ints order like the values; boundaries come from
+        # sample_range_keys so they share the scaled domain
+        b = sample_range_keys(dblk, "k", 3, seed=1, part=0)
+        assert b == sorted(b)
+        assert range_key_values(dblk.columns["k"]).tolist() == [150, 25, 475]
+
+    def test_string_keys_rejected(self):
+        sblk = HostBlock(
+            {"k": column_from_values(["a", "b"], SQLType(Kind.STRING))}, 2
+        )
+        with pytest.raises(ValueError):
+            range_key_values(sblk.columns["k"])
+
+    def test_sampling_deterministic_under_fixed_seed(self):
+        blk = _block(list(range(1000)))
+        a = sample_range_keys(blk, "k", 32, seed=7, part=1)
+        b = sample_range_keys(blk, "k", 32, seed=7, part=1)
+        assert a == b and len(a) == 32
+        c = sample_range_keys(blk, "k", 32, seed=8, part=1)
+        assert a != c  # a different seed draws a different sample
+
+    def test_merge_boundaries_quantile_cut(self):
+        b = DCNFragmentScheduler.merge_boundaries(
+            [[1, 3, 5], [2, 4, 6]], 3
+        )
+        assert b == [3, 5] and len(b) == 2  # thirds of the merged set
+        assert DCNFragmentScheduler.merge_boundaries([[], []], 3) == []
+        assert DCNFragmentScheduler.merge_boundaries([[1, 2]], 1) == []
+
+
+# ---------------------------------------------------------------------------
+# planner shapes
+# ---------------------------------------------------------------------------
+
+
+class TestDagPlanner:
+    def test_pure_order_by_limit_is_one_range_stage(self, sess):
+        dag = split_plan_dag(
+            _plan(sess, "select c, b from t order by c desc limit 3"),
+            sess.catalog,
+        )
+        assert dag is not None and len(dag.stages) == 1
+        (st,) = dag.stages
+        assert st.exchange == "range" and st.limit == 3 and st.desc
+        assert isinstance(st.consumer, L.Limit)  # pushed-down top-K
+        assert dag.merge["kind"] == "concat"
+        assert dag.merge["reverse"] is True
+
+    def test_join_rekeyed_groupby_orderby_chains_three_stages(self, sess):
+        dag = split_plan_dag(
+            _plan(
+                sess,
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b order by count(*) desc, b limit 2",
+            ),
+            sess.catalog,
+        )
+        assert dag is not None
+        assert [s.exchange for s in dag.stages] == ["hash", "hash", "range"]
+        # stage 1 re-stages stage 0's HELD join output (no re-scan)
+        assert isinstance(dag.stages[1].sides[0].template, L.StageInput)
+        assert dag.stages[1].sides[0].template.stage == 0
+        assert dag.stages[1].requires_key_partition
+        # per-partition top-K under the range sort
+        assert dag.stages[2].limit == 2
+
+    def test_group_key_equals_join_key_fuses_agg_into_join_stage(
+        self, sess
+    ):
+        dag = split_plan_dag(
+            _plan(
+                sess,
+                "select a, count(*), sum(v) from t join u on a = k "
+                "group by a order by a",
+            ),
+            sess.catalog,
+        )
+        assert dag is not None
+        assert [s.exchange for s in dag.stages] == ["hash", "range"]
+        assert dag.stages[0].requires_key_partition  # complete groups
+
+    def test_plan_merge_for_chain_without_range_root(self, sess):
+        dag = split_plan_dag(
+            _plan(
+                sess,
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b",
+            ),
+            sess.catalog,
+        )
+        assert dag is not None and dag.merge["kind"] == "plan"
+        assert [s.exchange for s in dag.stages] == ["hash", "hash"]
+
+    def test_no_dag_for_single_stage_shapes(self, sess):
+        # a bare group-by has nothing to chain and nothing to range
+        assert (
+            split_plan_dag(
+                _plan(sess, "select b, count(*) from t group by b"),
+                sess.catalog,
+            )
+            is None
+        )
+        # string first sort key: no range exchange (collation order
+        # lives in per-batch dictionaries) -> coordinator sort
+        assert (
+            split_plan_dag(
+                _plan(sess, "select b, c from t order by b"),
+                sess.catalog,
+            )
+            is None
+        )
+
+    def test_temporal_first_key_distributes(self, sess):
+        # DATE/DATETIME/TIME encodings are chronological int64s
+        # (wire.range_key_values): a date-keyed ORDER BY must range-
+        # partition, not fall back to the coordinator sort
+        sess.execute("create table ev (d date, n int)")
+        sess.execute(
+            "insert into ev values ('2024-01-05',1),('2023-06-01',2),"
+            "(null,3),('2024-01-05',4),('2025-12-31',5)"
+        )
+        dag = split_plan_dag(
+            _plan(sess, "select d, n from ev order by d desc limit 3"),
+            sess.catalog,
+        )
+        assert dag is not None
+        assert dag.stages[-1].exchange == "range"
+
+    def test_window_partition_key_distributes(self, sess):
+        dag = split_plan_dag(
+            _plan(
+                sess,
+                "select a, c, sum(c) over (partition by a order by c) "
+                "from t order by a, c",
+            ),
+            sess.catalog,
+        )
+        assert dag is not None
+        # window stage (complete partitions per hash partition) + a
+        # range stage for the ORDER BY
+        assert [s.exchange for s in dag.stages] == ["hash", "range"]
+        assert dag.stages[0].requires_key_partition
+
+    def test_window_above_aggregate_stays_on_coordinator(self, sess):
+        # a Window between the ORDER BY and the Aggregate computes
+        # over the WHOLE set: it must never fold into a per-partition
+        # stage (the range wrap guard), so no DAG forms here
+        assert (
+            split_plan_dag(
+                _plan(
+                    sess,
+                    "select b, count(*), rank() over (order by "
+                    "count(*)) from t group by b order by b",
+                ),
+                sess.catalog,
+            )
+            is None
+        )
+        # a GLOBAL window (no PARTITION BY) has no distribution key
+        assert (
+            split_plan_dag(
+                _plan(sess, "select a, rank() over (order by c) from t"),
+                sess.catalog,
+            )
+            is None
+        )
+
+    def test_choose_edge_modes_broadcasts_small_inner_side(self):
+        def stage(l_rows, r_rows, kind="inner", requires=False):
+            sides = [
+                ShuffleSide(None, None, "a", 0, l_rows),
+                ShuffleSide(None, None, "k", 1, r_rows),
+            ]
+            return DagStage(
+                "hash", sides, None, join_kind=kind,
+                requires_key_partition=requires,
+            )
+
+        st = stage(100_000, 500)
+        assert choose_edge_modes(st, broadcast_max_rows=1000) == "broadcast"
+        assert [s.mode for s in st.sides] == ["local", "broadcast"]
+        # too big to broadcast / ratio unmet / disabled -> hash
+        assert choose_edge_modes(stage(100_000, 5000), 1000) == "hash"
+        assert choose_edge_modes(stage(1000, 500), 1000) == "hash"
+        assert choose_edge_modes(stage(100_000, 500), 0) == "hash"
+        # key-partition-requiring consumers never trade their edges
+        assert (
+            choose_edge_modes(stage(100_000, 500, requires=True), 1000)
+            == "hash"
+        )
+        # left joins preserve the LEFT side: only the right broadcasts
+        st = stage(500, 100_000, kind="left")
+        assert choose_edge_modes(st, 1000) == "hash"
+        st = stage(100_000, 500, kind="left")
+        assert choose_edge_modes(st, 1000) == "broadcast"
+        assert [s.mode for s in st.sides] == ["local", "broadcast"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: in-process 2-server fleet
+# ---------------------------------------------------------------------------
+
+
+DAG_QUERIES = [
+    # distributed windows: complete PARTITION BY partitions per hash
+    # partition (frames and running aggregates included), then a range
+    # exchange for the ORDER BY
+    "select a, c, sum(c) over (partition by a order by c) from t "
+    "order by a, c",
+    "select a, c, row_number() over (partition by a order by c "
+    "rows between 1 preceding and current row) from t order by a, c",
+    "select c, b from t order by c desc limit 3",
+    "select c, a from t order by c",
+    "select b, count(*), sum(v) from t join u on a = k group by b "
+    "order by count(*) desc, b limit 2",
+    "select a, count(*), sum(v) from t join u on a = k group by a "
+    "order by a",
+    "select b, count(*) from t group by b order by count(*) desc limit 2",
+    "select b, count(*), sum(v) from t join u on a = k group by b",
+    "select a, c from t order by c desc limit 3 offset 2",
+]
+
+
+def _fleet(sess, n=2, **kw):
+    servers = [EngineServer(sess.catalog, port=0) for _ in range(n)]
+    for s in servers:
+        s.start_background()
+    kw.setdefault("shuffle_wait_timeout_s", 30.0)
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", s.port) for s in servers],
+        catalog=sess.catalog, shuffle_mode="always",
+        shuffle_dag="always", **kw,
+    )
+    return servers, sched
+
+
+def _teardown(servers, sched):
+    sched.close()
+    for s in servers:
+        s.shutdown()
+
+
+def _run(sess, sched, q):
+    plan = _plan(sess, q)
+    kind, cut = sched._choose_cut(plan)
+    assert kind == "dag", f"{q} did not plan as a DAG ({kind})"
+    return sched.execute_plan(plan, cut_hint=(kind, cut))
+
+
+class TestDagExecution:
+    def test_dag_parity_and_held_drain(self, sess):
+        servers, sched = _fleet(sess)
+        try:
+            for q in DAG_QUERIES:
+                exp = sess.must_query(q).rows
+                _cols, got = _run(sess, sched, q)
+                if "order by" not in q:
+                    # no ORDER BY = no row-order contract (complete
+                    # groups land in partition order): set parity
+                    # (repr key: NULLs don't compare to strings)
+                    got = sorted(got, key=repr)
+                    exp = sorted(exp, key=repr)
+                assert got == exp, f"{q}\n got={got}\n exp={exp}"
+            for s in servers:
+                assert s._shuffle is not None
+                assert s._shuffle.held_count() == 0
+                assert s._shuffle.store.buffered_stages() == 0
+        finally:
+            _teardown(servers, sched)
+
+    def test_chained_stages_report_stage_index_and_scan_rows(self, sess):
+        servers, sched = _fleet(sess)
+        try:
+            q = (
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b order by count(*) desc, b limit 2"
+            )
+            exp = sess.must_query(q).rows
+            _cols, got = _run(sess, sched, q)
+            assert got == exp
+            stages = sched.last_query["shuffle_stages"]
+            assert [s["stage"] for s in stages] == [0, 1, 2]
+            assert [s["exchange"] for s in stages] == [
+                "hash", "hash", "range",
+            ]
+            # stage 0 scans BOTH sides fragment-sliced: total scanned
+            # rows across hosts == the two tables' row counts exactly
+            # (no unsliced re-scan), and stages 1/2 scan NOTHING
+            nt = sess.catalog.table("test", "t").nrows
+            nu = sess.catalog.table("test", "u").nrows
+            assert stages[0]["scan_rows"] == nt + nu
+            assert stages[1]["scan_rows"] == 0
+            assert stages[2]["scan_rows"] == 0
+            # the range stage recorded its merged boundaries
+            assert stages[2]["boundaries"] is not None
+        finally:
+            _teardown(servers, sched)
+
+    def test_boundaries_deterministic_across_runs(self, sess):
+        servers, sched = _fleet(sess)
+        try:
+            q = "select c, b from t order by c desc limit 3"
+            _run(sess, sched, q)
+            b1 = sched.last_query["shuffle_stages"][-1]["boundaries"]
+            _run(sess, sched, q)
+            b2 = sched.last_query["shuffle_stages"][-1]["boundaries"]
+            assert b1 == b2  # fixed sample seed -> identical cut
+        finally:
+            _teardown(servers, sched)
+
+    def test_per_partition_topk_bounds_returned_rows(self, sess):
+        servers, sched = _fleet(sess)
+        try:
+            q = "select a, c from t order by c desc limit 3 offset 2"
+            exp = sess.must_query(q).rows
+            _cols, got = _run(sess, sched, q)
+            assert got == exp
+            stages = sched.last_query["shuffle_stages"]
+            frags = sched.last_query["fragments"]
+            last = [f for f in frags if f["stage"] == len(stages) - 1]
+            # each partition shipped at most count+offset rows
+            assert all(f["rows"] <= 3 + 2 for f in last)
+        finally:
+            _teardown(servers, sched)
+
+    def test_broadcast_edge_ships_zero_probe_bytes(self, sess):
+        # big probe side, small build side: the cost model broadcasts
+        # the small side; the big side never crosses the wire
+        sess.execute("create table big (a int, c int)")
+        vals = ",".join(f"({i % 7},{i % 13})" for i in range(200))
+        sess.execute(f"insert into big values {vals}")
+        sess.execute("create table dim (k int, v int)")
+        sess.execute(
+            "insert into dim values (0,100),(1,101),(2,102),(3,103),"
+            "(4,104),(5,105),(6,106)"
+        )
+        servers, sched = _fleet(sess, shuffle_broadcast_rows=50)
+        try:
+            q = (
+                "select c, count(*), sum(v) from big join dim on a = k "
+                "group by c order by c"
+            )
+            exp = sess.must_query(q).rows
+            plan = _plan(sess, q)
+            kind, cut = sched._choose_cut(plan)
+            assert kind == "dag"
+            assert [s.mode for s in cut.stages[0].sides] == [
+                "local", "broadcast",
+            ]
+            _cols, got = sched.execute_plan(plan, cut_hint=(kind, cut))
+            assert got == exp
+            st0 = sched.last_query["shuffle_stages"][0]
+            # only the small side's rows tunneled (m-1 copies of <= 7
+            # dictionary rows each); the 200-row side stayed local
+            assert st0["rows_tunneled"] <= 7 * (2 - 1) + 1
+            assert st0["local_rows"] >= 200
+        finally:
+            _teardown(servers, sched)
+
+    def test_sample_loss_retries_to_identical_boundaries(self, sess):
+        from tidb_tpu.server.engine_rpc import DropConnection
+
+        servers, sched = _fleet(sess)
+        try:
+            q = "select c, b from t order by c desc limit 3"
+            exp = sess.must_query(q).rows
+            _run(sess, sched, q)
+            clean = sched.last_query["shuffle_stages"][-1]["boundaries"]
+            # drop the FIRST boundary-sample reply: the coordinator
+            # verifies the suspect (alive), retries the whole DAG, and
+            # the fixed seed reproduces the same cut
+            failpoint.enable(
+                "shuffle/sample-lost",
+                failpoint.after_n(1, DropConnection("test")),
+            )
+            _cols, got = _run(sess, sched, q)
+            assert got == exp
+            st = sched.last_query["shuffle_stages"][-1]
+            assert st["attempts"] > 1  # the DAG really retried
+            assert st["boundaries"] == clean
+            assert len(sched.alive_endpoints()) == 2  # no quarantine
+        finally:
+            _teardown(servers, sched)
+
+    def test_interstage_loss_retries_whole_dag_with_parity(self, sess):
+        from tidb_tpu.server.engine_rpc import DropConnection
+
+        servers, sched = _fleet(sess)
+        try:
+            q = (
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b order by count(*) desc, b limit 2"
+            )
+            exp = sess.must_query(q).rows
+            # the reply vanishes exactly when stage 1 reads stage 0's
+            # held output — the "worker died between stages" shape
+            failpoint.enable(
+                "shuffle/stage-input",
+                failpoint.after_n(1, DropConnection("test")),
+            )
+            _cols, got = _run(sess, sched, q)
+            assert got == exp
+            assert any(
+                s["attempts"] > 1
+                for s in sched.last_query["shuffle_stages"]
+            )
+            for s in servers:
+                assert s._shuffle.held_count() == 0
+                assert s._shuffle.store.buffered_stages() == 0
+        finally:
+            _teardown(servers, sched)
+
+    def test_explain_analyze_renders_stage_dag(self, sess):
+        servers, sched = _fleet(sess)
+        try:
+            q = (
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b order by count(*) desc, b limit 2"
+            )
+            exp = sess.must_query(q).rows
+            _cols, rows, lines = sched.explain_analyze(_plan(sess, q))
+            assert rows == exp
+            text = "\n".join(lines)
+            assert "RangeConcatMerge" in text
+            assert "stage=1/3 exchange=hash" in text
+            assert "stage=2/3 exchange=hash" in text
+            assert "stage=3/3 exchange=range" in text
+            assert "produce=" in text and "wait=" in text
+            # plan-merge DAG: stages render under the Staged node
+            q2 = (
+                "select b, count(*), sum(v) from t join u on a = k "
+                "group by b"
+            )
+            exp2 = sess.must_query(q2).rows
+            _cols2, rows2, lines2 = sched.explain_analyze(
+                _plan(sess, q2)
+            )
+            # no ORDER BY: set parity (rows land in partition order)
+            assert sorted(rows2, key=repr) == sorted(exp2, key=repr)
+            text2 = "\n".join(lines2)
+            assert "stage=1/2 exchange=hash" in text2
+            assert "stage=2/2 exchange=hash" in text2
+        finally:
+            _teardown(servers, sched)
+
+    def test_auto_policy_defers_small_dags_to_single_cut(self, sess):
+        servers, sched = _fleet(sess)
+        sched.shuffle_dag = "auto"  # tiny tables: below min_rows
+        try:
+            kind, _cut = sched._choose_cut(
+                _plan(
+                    sess,
+                    "select b, count(*), sum(v) from t join u on a = k "
+                    "group by b",
+                )
+            )
+            assert kind != "dag"
+            sched.shuffle_min_rows = 1
+            kind2, cut2 = sched._choose_cut(
+                _plan(
+                    sess,
+                    "select b, count(*), sum(v) from t join u on a = k "
+                    "group by b",
+                )
+            )
+            assert kind2 == "dag" and len(cut2.stages) == 2
+        finally:
+            _teardown(servers, sched)

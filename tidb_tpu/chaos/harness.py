@@ -96,10 +96,14 @@ class ChaosReport:
 class ChaosHarness:
     """Seeded chaos over an in-process 2-server fleet."""
 
-    #: the default workload: shapes that exercise BOTH cut kinds
+    #: the default workload: shapes that exercise EVERY cut kind
     #: (repartition join + distinct group-by ride the shuffle tunnels;
-    #: plain group-bys take the partial-agg fragment cut) so crash
-    #: faults on dcn/* and shuffle/* sites both find live traffic
+    #: plain group-bys take the partial-agg fragment cut; the
+    #: scheduler runs shuffle_dag="always" so the join->re-keyed
+    #: group-by chains two hash stages, "order by a/c" rides a range
+    #: exchange, and the pure ORDER BY LIMIT distributes top-K) so
+    #: crash faults on dcn/* and shuffle/* sites — the DAG's
+    #: sample/stage-input sites included — all find live traffic
     QUERIES = (
         "select b, count(*), sum(v) from t join u on a = k "
         "group by b order by b",
@@ -108,6 +112,7 @@ class ChaosHarness:
         "group by a order by a",
         "select b, max(c), min(c), count(*) from t group by b "
         "order by b",
+        "select c, a from t order by c desc limit 3",
     )
 
     def __init__(
@@ -158,6 +163,7 @@ class ChaosHarness:
             [("127.0.0.1", s.port) for s in self.servers],
             catalog=sess.catalog,
             shuffle_mode="always",
+            shuffle_dag="always",
             shuffle_wait_timeout_s=float(wait_timeout_s),
             max_attempts=int(max_attempts),
             retry_backoff_s=0.02,
@@ -205,6 +211,13 @@ class ChaosHarness:
                 for s in self.servers
             ),
             "orphaned shuffle buffers (stages_buffered != 0)",
+        )
+        settle(
+            lambda: all(
+                s._shuffle is None or s._shuffle.held_count() == 0
+                for s in self.servers
+            ),
+            "orphaned held DAG stage outputs (held_count != 0)",
         )
         settle(
             lambda: all(

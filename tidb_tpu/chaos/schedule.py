@@ -34,6 +34,15 @@ Fault classes and the real mechanism each exercises:
 - ``clock-skew``        — the handshake advertises a shifted wall
   clock: clock-offset sampling and span/timeline rebasing run under
   skew (parity must be unaffected; only telemetry geometry shifts).
+- ``sample-loss``       — a range exchange's boundary-sample reply is
+  lost in transit (shuffle/sample-lost): the coordinator must treat it
+  exactly like a dispatch loss — verify the suspect, retry the whole
+  DAG on the survivor set, and recompute identical boundaries (the
+  fixed sample seed).
+- ``interstage-crash``  — the worker dies BETWEEN DAG stages (the
+  shuffle/stage-input site fires as stage N+1 reads stage N's held
+  output): the held partition is gone, the stage aborts retryable,
+  and the whole chain restarts on the survivors under a new attempt.
 """
 
 from __future__ import annotations
@@ -55,6 +64,8 @@ FAULT_CLASSES = (
     "slow-peer",
     "tunnel-partition",
     "clock-skew",
+    "sample-loss",
+    "interstage-crash",
 )
 
 #: action kinds arm_spec() knows how to build. "exit" hard-kills the
@@ -196,6 +207,19 @@ def _make_fault(cls: str, rng: random.Random) -> Fault:
             cls, "engine/clock-skew", "value",
             param=round(rng.uniform(-5.0, 5.0), 3),
         )
+    if cls == "sample-loss":
+        # the boundary-sample reply vanishes for the first n samples:
+        # the coordinator suspects the host, verifies it alive, and
+        # retries the whole DAG — boundaries must come out identical
+        return Fault(
+            cls, "shuffle/sample-lost", "drop", n=rng.randint(1, 2),
+        )
+    if cls == "interstage-crash":
+        # the worker "dies" between stage N and N+1: the reply is lost
+        # exactly when the next stage reads the held output
+        return Fault(
+            cls, "shuffle/stage-input", "drop", n=rng.randint(1, 3),
+        )
     raise ValueError(f"unknown fault class {cls!r}")
 
 
@@ -249,6 +273,35 @@ class ChaosSchedule:
             for f in ep.faults:
                 out[f.cls] = out.get(f.cls, 0) + 1
         return out
+
+
+def generate_interstage_kill_specs(
+    seed: int, n_workers: int
+) -> List[List[dict]]:
+    """Per-worker-PROCESS fault specs for the mid-DAG kill dryrun: the
+    LAST worker hard-exits (os._exit) the first time a DAG stage reads
+    a held StageInput — i.e. BETWEEN stage N and stage N+1, after its
+    stage-N output was held but before stage N+1 exchanges it — while
+    every worker also drops a seeded fraction of pushed frames (a
+    composed fault, not a lone kill). Deterministic in (seed,
+    n_workers)."""
+    rng = random.Random(int(seed))
+    specs: List[List[dict]] = []
+    for w in range(int(n_workers)):
+        faults = [
+            Fault(
+                "frame-drop", "shuffle/push-lost", "seeded-error",
+                p=round(rng.uniform(0.01, 0.04), 4),
+                seed=rng.randint(0, 2 ** 31),
+            ),
+        ]
+        if w == n_workers - 1:
+            faults.append(
+                Fault("interstage-crash", "shuffle/stage-input",
+                      "exit", n=1)
+            )
+        specs.append([f.to_dict() for f in faults])
+    return specs
 
 
 def generate_worker_specs(

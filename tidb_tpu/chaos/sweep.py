@@ -247,8 +247,13 @@ def _drv_shuffle_fleet(ctx) -> None:
     """The DCN sites a real 2-server in-process fleet traverses: a
     repartition-join rides the tunnels (shuffle/open, produce, push,
     push-lost probe, wait, consume, stage, dcn/dispatch at the task
-    frame... ) and a grouped aggregate takes the partial-agg fragment
-    cut (dcn/dispatch, dcn/final-stage, engine/execute)."""
+    frame... ), a grouped aggregate takes the partial-agg fragment
+    cut (dcn/dispatch, dcn/final-stage, engine/execute), and the
+    shuffle-DAG shapes traverse the DAG sites: a join -> re-keyed
+    GROUP BY chains two hash stages (shuffle/stage-input as stage 1
+    reads stage 0's held output) and an ORDER BY LIMIT rides a range
+    exchange (shuffle/sample + the sample-lost probe in the boundary
+    round)."""
     from tidb_tpu.parallel.dcn import DCNFragmentScheduler
     from tidb_tpu.parser.sqlparse import parse
     from tidb_tpu.planner.logical import build_query
@@ -261,18 +266,34 @@ def _drv_shuffle_fleet(ctx) -> None:
     sched = DCNFragmentScheduler(
         [("127.0.0.1", s.port) for s in servers],
         catalog=sess.catalog, shuffle_mode="always",
+        shuffle_dag="always",
         shuffle_wait_timeout_s=30.0,
     )
     try:
         for q in (
+            # shuffle_dag=always: join -> re-keyed GROUP BY chains two
+            # hash stages (stage 1 reads stage 0's HELD output:
+            # shuffle/stage-input) and the ORDER BY LIMIT root adds a
+            # range stage (boundary sampling: shuffle/sample +
+            # shuffle/sample-lost probe)
             "select b, count(*), sum(k) from sw_j join sw_k on a = k "
-            "group by b order by b",
+            "group by b order by count(*) desc, b limit 3",
         ):
             plan = build_query(
                 parse(q)[0], sess.catalog, "test",
                 sess._scalar_subquery,
             )
             sched.execute_plan(plan)
+        # the single-stage shuffle cut (no DAG): the PR 3 join shape
+        sched.shuffle_dag = "never"
+        plan = build_query(
+            parse(
+                "select b, count(*), sum(k) from sw_j join sw_k "
+                "on a = k group by b order by b"
+            )[0],
+            sess.catalog, "test", sess._scalar_subquery,
+        )
+        sched.execute_plan(plan)
         sched.shuffle_mode = "never"
         plan = build_query(
             parse("select b, count(*) from sw_j group by b order by b")[0],
@@ -387,7 +408,8 @@ SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
     ("driver", "shuffle-fleet", _drv_shuffle_fleet,
      ("shuffle/open", "shuffle/produce", "shuffle/push",
       "shuffle/push-lost", "shuffle/wait", "shuffle/consume",
-      "shuffle/stage", "dcn/dispatch", "dcn/final-stage")),
+      "shuffle/stage", "shuffle/sample", "shuffle/sample-lost",
+      "shuffle/stage-input", "dcn/dispatch", "dcn/final-stage")),
 ]
 
 

@@ -54,6 +54,7 @@ server/engine_rpc.py).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -65,8 +66,11 @@ from tidb_tpu.parallel.serving import QidAllocator
 from tidb_tpu.planner import logical as L
 from tidb_tpu.planner.fragmenter import (
     FragmentPlan,
+    ShuffleDAG,
     ShufflePlan,
+    choose_edge_modes,
     split_plan,
+    split_plan_dag,
     split_plan_shuffle,
 )
 from tidb_tpu.planner.ir import IR_VERSION, plan_to_ir
@@ -174,6 +178,31 @@ def _c_retry_backoff():
         "tidbtpu_dcn_retry_backoff_seconds",
         "jittered exponential backoff slept between stage/fragment "
         "retry rounds (desynchronizes re-dispatch storms)",
+    )
+
+
+def _c_stage_exchanges():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_stage_exchanges_total",
+        "shuffle DAG stage exchanges run, by kind (the per-edge "
+        "cost-model outcome: hash, range, or broadcast)",
+        labels=("exchange",),
+    )
+
+
+def _c_stage_sample_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_stage_sample_seconds",
+        "coordinator wall spent in range-exchange boundary sampling "
+        "rounds (produce-and-cache + merged quantile cut)",
+    )
+
+
+def _c_stage_chained():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_stage_chained_total",
+        "multi-stage shuffle DAGs executed (stage N's held output fed "
+        "stage N+1 without re-scanning base tables)",
     )
 
 
@@ -501,6 +530,17 @@ class FragmentLedger:
                 out.extend(self._recs[fid]["rows"] or [])
             return out
 
+    def rows_by_fragment(self) -> List[List[tuple]]:
+        """Per-fragment row lists, fragment order — the range-exchange
+        concat merge needs PARTITION boundaries preserved (partition
+        order is the total order; a descending first key concatenates
+        them reversed)."""
+        with self._lock:
+            return [
+                list(self._recs[fid]["rows"] or [])
+                for fid in sorted(self._recs)
+            ]
+
 
 class DCNFragmentScheduler:
     """Coordinator: split a bound logical plan into per-host fragments,
@@ -519,6 +559,10 @@ class DCNFragmentScheduler:
         dispatch_timeout_s: float = 600.0,
         shuffle_mode: str = "auto",
         shuffle_min_rows: int = 100_000,
+        shuffle_dag: str = "auto",
+        shuffle_broadcast_rows: int = 0,
+        shuffle_sample_k: int = 64,
+        shuffle_sample_seed: int = 7,
         shuffle_wait_timeout_s: Optional[float] = None,
         shuffle_packet_rows: Optional[int] = None,
         shuffle_inflight_bytes: Optional[int] = None,
@@ -533,8 +577,34 @@ class DCNFragmentScheduler:
             raise ValueError("DCN scheduler needs at least one worker host")
         if shuffle_mode not in ("auto", "always", "never"):
             raise ValueError(f"bad shuffle_mode {shuffle_mode!r}")
+        if shuffle_dag not in ("auto", "always", "never"):
+            raise ValueError(f"bad shuffle_dag {shuffle_dag!r}")
         if shuffle_codec not in ("binary", "json"):
             raise ValueError(f"bad shuffle_codec {shuffle_codec!r}")
+        if shuffle_dag == "always" and shuffle_codec == "json":
+            # the DAG data plane is binary-only; silently degrading a
+            # forced "always" to the single-cut path would make a test
+            # or A/B measure the wrong execution path
+            raise ValueError(
+                "shuffle_dag='always' requires shuffle_codec='binary' "
+                "(DAG stages ship columnar frames only)"
+            )
+        # shuffle DAG policy (PERF_NOTES "Shuffle DAGs"): "auto" runs a
+        # multi-stage exchange chain / range ORDER BY only when the
+        # sliced side clears shuffle_min_rows (the same bar as the
+        # repartition-join policy); "always"/"never" force it (tests,
+        # benchmarks). DAG stages need the binary codec.
+        self.shuffle_dag = shuffle_dag
+        # per-edge broadcast threshold (rows): a join side at most
+        # this big may BROADCAST (the other side ships zero bytes) —
+        # 0 disables the edge entirely (opt-in until real-hardware
+        # numbers calibrate the copy-vs-repartition crossover)
+        self.shuffle_broadcast_rows = int(shuffle_broadcast_rows)
+        # range-exchange boundary sampling: per-producer sample size
+        # and the FIXED seed (same data + same seed = identical
+        # boundaries — retries and chaos replays stay deterministic)
+        self.shuffle_sample_k = int(shuffle_sample_k)
+        self.shuffle_sample_seed = int(shuffle_sample_seed)
         # pipeline=on|off (PERF_NOTES "Shuffle pipelining"): on, workers
         # overlap produce/push/on-arrival-decode/stage within a stage;
         # off is the barrier escape hatch (four sequential phases, like
@@ -830,6 +900,34 @@ class DCNFragmentScheduler:
                 return
             time.sleep(min(left, 0.05))
 
+    def _classify_reply(
+        self, resp, suspects, errs, cancelled, release=None
+    ) -> bool:
+        """THE worker-reply taxonomy, shared by fragment, sampling and
+        DAG-stage dispatch: True = ok (the caller lands the result); a
+        deliberate abort (``cancelled`` — fleet cancel / propagated
+        deadline: neither an engine error nor a death suspect, PR 10's
+        rule) or a retryable stage failure calls ``release`` (the
+        ledger-claim return) and records into the caller's
+        attempt-scoped lists, returning False; anything else is a
+        fatal engine error that reproduces everywhere — raise."""
+        if resp.get("ok"):
+            return True
+        if resp.get("cancelled"):
+            if release is not None:
+                release()
+            with self._lock:
+                cancelled.append(str(resp.get("error", "")))
+            return False
+        if resp.get("retryable"):
+            if release is not None:
+                release()
+            with self._lock:
+                suspects.extend(resp.get("suspects") or [])
+                errs.append(str(resp.get("error", "")))
+            return False
+        raise RuntimeError(f"engine error: {resp.get('error', '')}")
+
     # -- query execution ------------------------------------------------
     def execute_plan(
         self, plan: L.LogicalPlan, cut_hint=None, kill_check=None,
@@ -855,6 +953,22 @@ class DCNFragmentScheduler:
         seconds, so a worker self-cancels even if the coordinator is
         wedged."""
         kind, cut = cut_hint if cut_hint is not None else self._choose_cut(plan)
+        if kind == "dag":
+            t0 = time.perf_counter()
+            parts_rows, infos, stages = self._run_dag(
+                cut, kill_check=kill_check, deadline=deadline
+            )
+            retries = max(
+                (int(s.get("attempts", 1)) - 1 for s in stages),
+                default=0,
+            )
+            self._note_dispatch(t0, infos, retries=retries)
+            for s in stages:
+                FLIGHT.note_shuffle_stage(s)
+            if cut.merge.get("kind") == "concat":
+                return self._concat_merge(cut, parts_rows)
+            rows = [r for part in parts_rows for r in part]
+            return self._timed_final_stage(cut, rows)
         if kind == "shuffle":
             t0 = time.perf_counter()
             rows, infos, stage = self._run_shuffle(
@@ -908,19 +1022,30 @@ class DCNFragmentScheduler:
             (int(f.get("mem_peak", 0)) for f in infos), default=0
         )
 
-    def _timed_final_stage(self, cut, rows):
-        """Run the coordinator-local final stage charging its wall to
-        final-merge MINUS any jit traces watched_jit charges to
-        "compile" inside it, so the two phases stay additive."""
+    @staticmethod
+    @contextlib.contextmanager
+    def _final_merge_phase():
+        """Charge the enclosed coordinator-local merge work to the
+        final-merge flight phase MINUS any jit traces watched_jit
+        charges to "compile" inside it, so the two phases stay
+        additive — the ONE definition both the plan-based final stage
+        and the range-concat merge use."""
         t1 = time.perf_counter()
         c0 = FLIGHT.phase_seconds("compile")
-        out = self._final_stage(cut, rows)
-        FLIGHT.note_phase(
-            "final-merge",
-            (time.perf_counter() - t1)
-            - (FLIGHT.phase_seconds("compile") - c0),
-        )
-        return out
+        try:
+            yield
+        finally:
+            FLIGHT.note_phase(
+                "final-merge",
+                (time.perf_counter() - t1)
+                - (FLIGHT.phase_seconds("compile") - c0),
+            )
+
+    def _timed_final_stage(self, cut, rows):
+        """Run the coordinator-local final stage under the final-merge
+        phase accounting."""
+        with self._final_merge_phase():
+            return self._final_stage(cut, rows)
 
     def explain_analyze(
         self, plan: L.LogicalPlan
@@ -936,6 +1061,38 @@ class DCNFragmentScheduler:
         from tidb_tpu.chunk import materialize_rows
 
         kind, cut = self._choose_cut(plan)
+        if kind == "dag":
+            parts_rows, infos, stages = self._run_dag(cut)
+            pairs = [
+                (s, [f for f in infos if f.get("stage", 0) == si])
+                for si, s in enumerate(stages)
+            ]
+            if cut.merge.get("kind") == "concat":
+                cols, rows = self._concat_merge(cut, parts_rows)
+                lim = cut.merge.get("limit")
+                lines = [
+                    "RangeConcatMerge stages="
+                    f"{len(stages)} reverse="
+                    f"{bool(cut.merge.get('reverse'))} "
+                    f"limit={lim[0] if lim else 'none'} "
+                    f"rows={len(rows)}"
+                ]
+                from tidb_tpu.planner.physical import (
+                    _merge_shuffle_stats,
+                )
+
+                for s, fi in pairs:
+                    lines = _merge_shuffle_stats(lines, s, fi)
+                return cols, rows, lines
+            inject("dcn/final-stage")
+            rows = [r for part in parts_rows for r in part]
+            staged = self._stage_rows(cut, rows)
+            final = cut.final_builder(staged)
+            out, dicts, lines = self._executor.run_analyze(
+                final, shuffle_stats=pairs
+            )
+            out_rows = materialize_rows(out, list(final.schema), dicts)
+            return [c.name for c in final.schema], out_rows, lines
         if kind == "shuffle":
             rows, infos, stage = self._run_shuffle(cut)
             inject("dcn/final-stage")
@@ -965,8 +1122,9 @@ class DCNFragmentScheduler:
 
     # -- worker-to-worker shuffle stages --------------------------------
     def _choose_cut(self, plan: L.LogicalPlan):
-        """One planning pass deciding the execution path: ("shuffle",
-        ShufflePlan) | ("frag", FragmentPlan) | ("single", None).
+        """One planning pass deciding the execution path: ("dag",
+        ShuffleDAG) | ("shuffle", ShufflePlan) | ("frag",
+        FragmentPlan) | ("single", None).
 
         The shuffle-vs-staging cost model: staging ships each row
         group TWICE through one box (worker->coordinator, then a
@@ -974,7 +1132,40 @@ class DCNFragmentScheduler:
         exchange to near-nothing first; tunnels ship pre-join rows
         ONCE, peer to peer, which wins when neither join side is small
         or when no partial-agg cut exists at all (DISTINCT/high-
-        cardinality GROUP BY — previously a single-host fallback)."""
+        cardinality GROUP BY — previously a single-host fallback).
+
+        The DAG tier sits above both: a join feeding a DIFFERENT
+        group-key exchange chains two stages (the single-cut group-by
+        re-scans unsliced join sides on every host — N x wasted scan
+        work), and an ORDER BY (LIMIT) root distributes over a range
+        exchange with per-partition top-K. "auto" takes the DAG only
+        when the sliced side clears shuffle_min_rows — at small scale
+        the extra stage dispatch dominates; shuffle_dag="always"
+        forces it (tests, the bench A/B). Each hash join edge then
+        runs the per-edge cost model (choose_edge_modes): a side
+        under shuffle_broadcast_rows broadcasts while the big side
+        ships ZERO bytes."""
+        if (
+            self.shuffle_mode != "never"
+            and self.shuffle_dag != "never"
+            and self.shuffle_codec == "binary"
+        ):
+            dag = split_plan_dag(plan, self.catalog)
+            if dag is not None:
+                for st in dag.stages:
+                    choose_edge_modes(st, self.shuffle_broadcast_rows)
+                if self.shuffle_dag == "always":
+                    return "dag", dag
+                big = max(
+                    (
+                        s.est_rows
+                        for st in dag.stages
+                        for s in st.sides
+                    ),
+                    default=0,
+                )
+                if big >= self.shuffle_min_rows:
+                    return "dag", dag
         sp = None
         if self.shuffle_mode != "never":
             sp = split_plan_shuffle(plan, self.catalog)
@@ -1020,6 +1211,7 @@ class DCNFragmentScheduler:
             "retransmits": 0,
             "codec": self.shuffle_codec, "encode_s": 0.0,
             "produce_s": 0.0, "wait_s": 0.0, "stage_s": 0.0,
+            "scan_rows": 0,
             # what the workers will actually run: the pipeline needs
             # the binary codec, so the json escape hatch forces barrier
             # (mirrors ShuffleWorker.run_task's own gate)
@@ -1106,24 +1298,11 @@ class DCNFragmentScheduler:
                         suspects.append(ep.address)
                         errs.append(f"{ep.address}: {e}")
                     return
-                if not resp.get("ok"):
-                    if resp.get("cancelled"):
-                        # deliberate abort (fleet cancel / propagated
-                        # deadline reached the worker): neither an
-                        # engine error nor a death suspect
-                        ledger.release(i, token)
-                        with self._lock:
-                            cancelled.append(str(resp.get("error", "")))
-                        return
-                    if resp.get("retryable"):
-                        ledger.release(i, token)
-                        with self._lock:
-                            suspects.extend(resp.get("suspects") or [])
-                            errs.append(str(resp.get("error", "")))
-                        return
-                    raise RuntimeError(
-                        f"engine error: {resp.get('error', '')}"
-                    )
+                if not self._classify_reply(
+                    resp, suspects, errs, cancelled,
+                    release=lambda: ledger.release(i, token),
+                ):
+                    return
                 rows = [tuple(r) for r in resp["rows"]]
                 if ledger.complete(i, token, rows):
                     self._note_partition(
@@ -1193,22 +1372,7 @@ class DCNFragmentScheduler:
                 raise QueryKilled(cancelled[0])
             if ledger.all_done():
                 infos.sort(key=lambda f: f["fid"])
-                for f in infos:
-                    stage["bytes_tunneled"] += f["pushed_bytes"]
-                    stage["rows_tunneled"] += f["pushed_rows"]
-                    stage["local_rows"] += f["local_rows"]
-                    stage["stalls"] += f["stalls"]
-                    stage["stall_s"] += f.get("stall_s", 0.0)
-                    stage["retransmits"] += f["retransmits"]
-                    stage["encode_s"] += f.get("encode_s", 0.0)
-                    stage["produce_s"] += f.get("produce_s", 0.0)
-                    stage["wait_s"] += f.get("wait_s", 0.0)
-                    stage["stage_s"] += f.get("stage_s", 0.0)
-                    stage["wait_idle_s"] += f.get("wait_idle_s", 0.0)
-                    stage["exec_s"] += f.get("exec_s", 0.0)
-                    stage["ttff_s"] = max(
-                        stage["ttff_s"], f.get("ttff_s", 0.0)
-                    )
+                self._fold_stage(stage, infos)
                 lq = {
                     "qid": qid, "fragments": infos,
                     "shuffle": dict(stage),
@@ -1235,6 +1399,463 @@ class DCNFragmentScheduler:
             f"{self.max_attempts} attempts ({len(self.endpoints)} hosts, "
             f"{len(self.alive_endpoints())} alive); last error: {last_err}"
         )
+
+    # -- shuffle DAGs: topo-ordered multi-stage exchanges ---------------
+    @staticmethod
+    def merge_boundaries(sample_lists, m: int) -> list:
+        """Coordinator half of range-exchange boundary sampling: merge
+        every producer's deterministic key sample and cut m-1 quantile
+        boundaries (partition p owns keys in (b[p-1], b[p]]). Pure —
+        same samples, same boundaries (the determinism the fixed
+        sample seed buys end to end). Empty samples (all-NULL or
+        empty sides) collapse every row onto partition 0, which is
+        still correct, just unbalanced."""
+        merged = sorted(v for lst in sample_lists for v in lst)
+        if not merged or m <= 1:
+            return []
+        return [merged[(j * len(merged)) // m] for j in range(1, m)]
+
+    def _stage_task(
+        self, dag, si, stage, i, m, attempt, qid, boundaries, peers,
+        secret, deadline,
+    ) -> dict:
+        """The worker task spec for partition ``i`` of DAG stage
+        ``si`` — run_task's single-stage spec plus the DAG fields
+        (stage index, exchange kind, range boundaries, hold/release
+        of the inter-stage held outputs)."""
+        n = len(dag.stages)
+        return {
+            "sid": f"{self._sid_prefix}-q{qid}-s{si}", "qid": qid,
+            "attempt": attempt, "m": m, "part": i, "peers": peers,
+            "secret": secret, "coord": self._sid_prefix,
+            "deadline_s": self._deadline_left(deadline),
+            "stage": si, "n_stages": n,
+            "exchange": stage.exchange,
+            "boundaries": list(boundaries or []),
+            "hold_output": si < n - 1,
+            "release_held": si == n - 1,
+            "sides": [
+                {
+                    "tag": s.tag, "key": s.key, "mode": s.mode,
+                    "plan": plan_to_ir(s.host_plan(i, m)),
+                }
+                for s in stage.sides
+            ],
+            "consumer": plan_to_ir(stage.consumer),
+            "wait_timeout_s": self.shuffle_wait_timeout_s,
+            "packet_rows": self.shuffle_packet_rows,
+            "max_inflight_bytes": self.shuffle_inflight_bytes,
+            "codec": "binary",  # DAG stages require the columnar wire
+            "pipeline": self.shuffle_pipeline,
+            "produce_chunks": self.shuffle_produce_chunks,
+            "trace": bool(self.tracer.enabled),
+            "timeline": TIMELINE.active(),
+        }
+
+    def _sample_stage(
+        self, si, stage, hosts, m, attempt, qid, kill_check, deadline,
+        suspects, errs,
+    ):
+        """Boundary-sampling round of one range stage: every worker
+        produces (and CACHES) its side, replies a deterministic key
+        sample; the coordinator merges the quantile cut. Returns the
+        boundary list, or None when a host failed (suspects/errs
+        filled — the caller verifies and retries the whole DAG on the
+        survivor set). A boundary-sample loss is exactly as retryable
+        as a dispatch loss (shuffle/sample-lost)."""
+        side = stage.sides[0]
+        t0 = time.perf_counter()
+        samples: List[Optional[list]] = [None] * m
+        fatal: List[Exception] = []
+        cancelled: List[str] = []
+
+        def run_one(i: int, ep: EngineEndpoint, conn: EngineClient):
+            spec = {
+                "qid": qid, "attempt": attempt, "m": m, "part": i,
+                "coord": self._sid_prefix, "stage": si,
+                "deadline_s": self._deadline_left(deadline),
+                "sample_k": self.shuffle_sample_k,
+                "sample_seed": self.shuffle_sample_seed,
+                "side": {
+                    "tag": side.tag, "key": side.key,
+                    "plan": plan_to_ir(side.host_plan(i, m)),
+                },
+            }
+            try:
+                resp = conn.call(
+                    {"v": IR_VERSION, "shuffle_sample": spec}
+                )
+            except (SchemaOutOfDateError, RuntimeError, ValueError,
+                    PermissionError):
+                raise
+            except Exception as e:
+                with self._lock:
+                    suspects.append(ep.address)
+                    errs.append(f"{ep.address}: {e}")
+                return
+            if not self._classify_reply(
+                resp, suspects, errs, cancelled
+            ):
+                return
+            samples[i] = list(resp.get("samples") or [])
+
+        def runner(i, ep, conn):
+            try:
+                run_one(i, ep, conn)
+            except Exception as e:
+                fatal.append(e)
+
+        killed = self._leased_rounds(
+            hosts, runner, qid, sid=f"{self._sid_prefix}-q{qid}-s{si}",
+            kill_check=kill_check, deadline=deadline,
+            suspects=suspects, errs=errs,
+        )
+        _c_stage_sample_seconds().inc(time.perf_counter() - t0)
+        if fatal:
+            raise fatal[0]
+        if killed is not None:
+            raise killed
+        if cancelled:
+            from tidb_tpu.utils.sqlkiller import QueryKilled
+
+            raise QueryKilled(cancelled[0])
+        if any(s is None for s in samples):
+            return None
+        return self.merge_boundaries(
+            [s for s in samples if s is not None], m
+        )
+
+    def _leased_rounds(
+        self, hosts, runner, qid, sid=None, kill_check=None,
+        deadline=None, suspects=None, errs=None,
+    ):
+        """Lease one control connection per host UP FRONT in fixed
+        endpoint order (the cycle-free acquisition discipline of
+        _run_shuffle), run ``runner(i, ep, conn)`` per host on named
+        threads, and join under the kill/deadline watch. Returns the
+        kill exception (to raise after cleanup) or None; a failed
+        checkout lands in suspects/errs for the caller's retry loop."""
+        leases: List[Tuple[EngineEndpoint, EngineClient]] = []
+        killed = None
+        try:
+            try:
+                for ep in hosts:
+                    leases.append((ep, self._pool(ep).checkout()))
+            except Exception as e:
+                bad = hosts[len(leases)]
+                with self._lock:
+                    if suspects is not None:
+                        suspects.append(bad.address)
+                    if errs is not None:
+                        errs.append(f"{bad.address}: {e}")
+            else:
+                threads = [
+                    threading.Thread(
+                        target=runner, args=(i, ep, conn),
+                        daemon=True, name=f"dcn-q{qid}-f{i}",
+                    )
+                    for i, (ep, conn) in enumerate(leases)
+                ]
+                for t in threads:
+                    t.start()
+                killed = self._join_watch(
+                    threads, qid, sid=sid,
+                    kill_check=kill_check, deadline=deadline,
+                )
+        finally:
+            for ep, conn in leases:
+                self._pool(ep).checkin(conn)
+        return killed
+
+    @staticmethod
+    def _fold_stage(stage: dict, infos: List[dict]) -> None:
+        """Accumulate the fenced per-partition worker stats into one
+        stage summary (the _run_shuffle fold, shared by the DAG)."""
+        for f in infos:
+            stage["bytes_tunneled"] += f["pushed_bytes"]
+            stage["rows_tunneled"] += f["pushed_rows"]
+            stage["local_rows"] += f["local_rows"]
+            stage["stalls"] += f["stalls"]
+            stage["stall_s"] += f.get("stall_s", 0.0)
+            stage["retransmits"] += f["retransmits"]
+            stage["encode_s"] += f.get("encode_s", 0.0)
+            stage["produce_s"] += f.get("produce_s", 0.0)
+            stage["wait_s"] += f.get("wait_s", 0.0)
+            stage["stage_s"] += f.get("stage_s", 0.0)
+            stage["wait_idle_s"] += f.get("wait_idle_s", 0.0)
+            stage["exec_s"] += f.get("exec_s", 0.0)
+            stage["scan_rows"] += int(f.get("scan_rows", 0))
+            stage["ttff_s"] = max(
+                stage["ttff_s"], f.get("ttff_s", 0.0)
+            )
+
+    def _run_dag(
+        self, dag: ShuffleDAG, kill_check=None, deadline=None
+    ) -> Tuple[List[List[tuple]], List[dict], List[dict]]:
+        """Run a shuffle DAG to completion: stages execute in topo
+        order, each dispatched to every alive host over the
+        per-attempt FragmentLedger; range stages run a boundary-
+        sampling round first. Stage N's consumer output is HELD on
+        its worker as stage N+1's StageInput — a failure anywhere
+        restarts the WHOLE chain on the survivor set under a new
+        attempt (held outputs of the superseded attempt are fenced by
+        the attempt key exactly like stale frames). Deadline and
+        cancel propagate through every stage dispatch. Returns
+        (last-stage rows per partition, fenced per-partition infos of
+        every stage, per-stage summaries)."""
+        qid = _QUERY_ID.next()
+        n = len(dag.stages)
+        if n > 1:
+            _c_stage_chained().inc()
+        stage_summaries: List[dict] = []
+        all_infos: List[dict] = []
+        last_err: Optional[str] = None
+        try:
+            for rnd in range(self.max_attempts):
+                if rnd:
+                    self._retry_sleep(rnd - 1, kill_check)
+                if not self.alive_endpoints():
+                    self.prober.probe_once()
+                hosts = self.alive_endpoints()
+                if not hosts:
+                    break
+                m = len(hosts)
+                attempt = rnd + 1
+                peers = [[ep.host, ep.port] for ep in hosts]
+                stage_summaries = []
+                all_infos = []
+                suspects: List[str] = []
+                errs: List[str] = []
+                parts_rows: Optional[List[List[tuple]]] = None
+                for si, stg in enumerate(dag.stages):
+                    boundaries = None
+                    if stg.exchange == "range":
+                        boundaries = self._sample_stage(
+                            si, stg, hosts, m, attempt, qid,
+                            kill_check, deadline, suspects, errs,
+                        )
+                        if boundaries is None:
+                            break  # suspects filled: verify + retry
+                    sid = f"{self._sid_prefix}-q{qid}-s{si}"
+                    stage = {
+                        "sid": sid, "qid": qid, "kind": "dag",
+                        "stage": si, "n_stages": n,
+                        "exchange": stg.exchange,
+                        # merged quantile boundaries of a range stage
+                        # (None for hash): deterministic under the
+                        # fixed sample seed — tests assert equality
+                        # across runs and retries
+                        "boundaries": (
+                            list(boundaries)
+                            if boundaries is not None else None
+                        ),
+                        "modes": [s.mode for s in stg.sides],
+                        "attempts": attempt, "m": m,
+                        "bytes_tunneled": 0, "rows_tunneled": 0,
+                        "local_rows": 0, "stalls": 0, "stall_s": 0.0,
+                        "retransmits": 0, "codec": "binary",
+                        "encode_s": 0.0, "produce_s": 0.0,
+                        "wait_s": 0.0, "stage_s": 0.0,
+                        "scan_rows": 0,
+                        "pipeline": self.shuffle_pipeline,
+                        "wait_idle_s": 0.0, "ttff_s": 0.0,
+                        "exec_s": 0.0,
+                    }
+                    inject("shuffle/stage")
+                    _c_shuffle_stages().inc()
+                    _c_stage_exchanges().labels(
+                        exchange=(
+                            "broadcast"
+                            if any(
+                                s.mode == "broadcast"
+                                for s in stg.sides
+                            )
+                            else stg.exchange
+                        )
+                    ).inc()
+                    if rnd:
+                        inject("shuffle/stage-retry")
+                        _c_shuffle_stage_retries().inc()
+                    ledger = FragmentLedger(m)
+                    infos: List[dict] = []
+                    fatal: List[Exception] = []
+                    cancelled: List[str] = []
+
+                    def run_part(i, ep, conn, _si=si, _stg=stg,
+                                 _bnd=boundaries, _ledger=ledger,
+                                 _infos=infos, _cancelled=cancelled):
+                        token = _ledger.claim(i, ep.address)
+                        task = self._stage_task(
+                            dag, _si, _stg, i, m, attempt, qid,
+                            _bnd, peers, ep.secret, deadline,
+                        )
+                        t_d0 = time.time()
+                        try:
+                            resp = conn.call(
+                                {"v": IR_VERSION, "shuffle_task": task}
+                            )
+                        except (SchemaOutOfDateError, RuntimeError,
+                                ValueError, PermissionError):
+                            raise
+                        except Exception as e:
+                            _ledger.release(i, token)
+                            with self._lock:
+                                suspects.append(ep.address)
+                                errs.append(f"{ep.address}: {e}")
+                            return
+                        if not self._classify_reply(
+                            resp, suspects, errs, _cancelled,
+                            release=lambda: _ledger.release(i, token),
+                        ):
+                            return
+                        rows = [tuple(r) for r in resp["rows"]]
+                        if _ledger.complete(i, token, rows):
+                            self._note_partition(
+                                _infos, i, ep, attempt, resp,
+                                qid=qid, t_dispatch0=t_d0,
+                            )
+
+                    def runner(i, ep, conn, _run=run_part,
+                               _fatal=fatal):
+                        try:
+                            _run(i, ep, conn)
+                        except Exception as e:
+                            _fatal.append(e)
+
+                    killed = self._leased_rounds(
+                        hosts, runner, qid, sid=sid,
+                        kill_check=kill_check, deadline=deadline,
+                        suspects=suspects, errs=errs,
+                    )
+                    if fatal:
+                        raise fatal[0]
+                    if killed is not None:
+                        raise killed
+                    if cancelled:
+                        from tidb_tpu.utils.sqlkiller import QueryKilled
+
+                        raise QueryKilled(cancelled[0])
+                    if not ledger.all_done():
+                        break  # suspects filled: verify + retry
+                    infos.sort(key=lambda f: f["fid"])
+                    self._fold_stage(stage, infos)
+                    stage_summaries.append(stage)
+                    all_infos.extend(infos)
+                    if si == n - 1:
+                        parts_rows = ledger.rows_by_fragment()
+                if parts_rows is not None:
+                    lq = {
+                        "qid": qid, "fragments": all_infos,
+                        "shuffle": self._dag_shuffle_summary(
+                            stage_summaries
+                        ),
+                        "shuffle_stages": stage_summaries,
+                        "worker_mem_peak": self._worker_mem_peak(
+                            all_infos
+                        ),
+                    }
+                    with self._lock:
+                        self.last_query = lq
+                    self._tls.last = lq
+                    _update_host_gauges(self.endpoints)
+                    return parts_rows, all_infos, stage_summaries
+                if errs:
+                    last_err = errs[0]
+                by_addr = {ep.address: ep for ep in self.endpoints}
+                for addr in sorted(set(suspects)):
+                    ep = by_addr.get(addr)
+                    if (
+                        ep is not None and ep.alive
+                        and not ping_endpoint(ep)
+                    ):
+                        self._quarantine(ep)
+        except BaseException:
+            # the DAG died mid-chain (kill, fatal engine error): free
+            # the workers' held stage outputs now — a best-effort
+            # broadcast; unreachable hosts fall back to the bounded
+            # held-cap eviction
+            self._cancel_fleet(qid, reason="shuffle DAG aborted")
+            raise
+        self._cancel_fleet(qid, reason="shuffle DAG undispatchable")
+        raise ConnectionError(
+            f"shuffle DAG q{qid} undispatchable after "
+            f"{self.max_attempts} attempts ({len(self.endpoints)} "
+            f"hosts, {len(self.alive_endpoints())} alive); "
+            f"last error: {last_err}"
+        )
+
+    @staticmethod
+    def _dag_shuffle_summary(stage_summaries: List[dict]) -> dict:
+        """One roll-up of a DAG's stages in the single-stage summary
+        shape (statements_summary / slow-log / status consumers read
+        ``last_query["shuffle"]`` — additive fields sum, ttff takes
+        the max, attempts the max)."""
+        out = {
+            "kind": "dag", "codec": "binary",
+            "n_stages": len(stage_summaries),
+            "attempts": 0, "m": 0,
+            "bytes_tunneled": 0, "rows_tunneled": 0, "local_rows": 0,
+            "stalls": 0, "stall_s": 0.0, "retransmits": 0,
+            "encode_s": 0.0, "produce_s": 0.0, "wait_s": 0.0,
+            "stage_s": 0.0, "wait_idle_s": 0.0, "ttff_s": 0.0,
+            "exec_s": 0.0, "scan_rows": 0, "pipeline": False,
+        }
+        for s in stage_summaries:
+            out["attempts"] = max(out["attempts"], s.get("attempts", 1))
+            out["m"] = max(out["m"], s.get("m", 0))
+            out["pipeline"] = bool(s.get("pipeline"))
+            for k in (
+                "bytes_tunneled", "rows_tunneled", "local_rows",
+                "stalls", "retransmits", "scan_rows",
+            ):
+                out[k] += int(s.get(k, 0))
+            for k in (
+                "stall_s", "encode_s", "produce_s", "wait_s",
+                "stage_s", "wait_idle_s", "exec_s",
+            ):
+                out[k] += float(s.get(k, 0.0))
+            out["ttff_s"] = max(out["ttff_s"], s.get("ttff_s", 0.0))
+        return out
+
+    def _concat_merge(self, dag: ShuffleDAG, parts_rows):
+        """Order-preserving final merge of a range-exchange DAG: the
+        partitions are each sorted and partition ranges are disjoint,
+        so the coordinator CONCATENATES them in partition order
+        (reversed for a descending first key — NULLs land first ASC /
+        last DESC, matching the engine's sort), slices the global
+        LIMIT/OFFSET, and runs only the row-wise nodes above the
+        limit. No global re-sort."""
+        with self._final_merge_phase():
+            spec = dag.merge
+            seq = (
+                list(reversed(parts_rows))
+                if spec.get("reverse") else parts_rows
+            )
+            rows = [r for part in seq for r in part]
+            lim = spec.get("limit")
+            if lim is not None:
+                count, off = lim
+                rows = rows[off: off + count]
+            above = spec.get("above") or ()
+            if above:
+                inject("dcn/final-stage")
+                from tidb_tpu.chunk import materialize_rows
+                from tidb_tpu.parallel.shuffle import (
+                    stage_rows_as_batch,
+                )
+
+                plan: L.LogicalPlan = stage_rows_as_batch(
+                    dag.partial_schema, rows, _STAGED_NONCE.next(),
+                    key="dcn-final",
+                )
+                for node in reversed(above):
+                    plan = dataclasses.replace(node, child=plan)
+                out, dicts = self._executor.run(plan)
+                rows = materialize_rows(out, list(plan.schema), dicts)
+                cols = [c.name for c in plan.schema]
+            else:
+                cols = list(spec.get("columns") or [])
+            return cols, rows
 
     def _note_partition(
         self, infos, part, ep, attempt, resp, qid=None,
@@ -1281,6 +1902,15 @@ class DCNFragmentScheduler:
             "pipeline": bool(sh.get("pipeline", False)),
             "wait_idle_s": float(sh.get("wait_idle_s", 0.0)),
             "ttff_s": float(sh.get("ttff_s", 0.0)),
+            # shuffle-DAG accounting: stage index/chain length,
+            # exchange kind, base-table rows actually scanned (the
+            # no-unsliced-re-scan proof), rows held for the next stage
+            "stage": int(sh.get("stage", 0)),
+            "n_stages": int(sh.get("n_stages", 1)),
+            "exchange": sh.get("exchange", "hash"),
+            "scan_rows": int(sh.get("scan_rows", 0)),
+            "held_rows": int(sh.get("held_rows", 0)),
+            "produced_rows": int(sh.get("produced_rows", 0)),
             "spans": spans,
         }
         with self._lock:

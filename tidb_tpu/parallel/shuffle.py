@@ -504,6 +504,15 @@ class ShuffleStore:
                 return False
             return True
 
+    @staticmethod
+    def _senders_of(senders, side, m) -> List[int]:
+        """Expected sender set for one side: all m peers unless the
+        stage declared otherwise (a "local"-mode side only ever has
+        its own host's stream; a broadcast side still has all m)."""
+        if senders is None:
+            return list(range(m))
+        return list(senders.get(side, range(m)))
+
     def wait(
         self,
         sid: str,
@@ -512,13 +521,15 @@ class ShuffleStore:
         m: int,
         timeout_s: float,
         abort=None,
+        senders=None,
     ) -> Dict[int, list]:
         """Block until every (side, sender) stream of the attempt is
         complete; returns side -> payload chunks ordered (sender, seq)
         — a deterministic concatenation order, so per-partition
         execution is reproducible across retries. Raises
         ShuffleWaitTimeout with the missing senders (the coordinator's
-        death-suspect list)."""
+        death-suspect list). ``senders`` optionally narrows the
+        expected sender set per side (local-mode DAG edges)."""
         inject("shuffle/wait")
         deadline = time.monotonic() + timeout_s
 
@@ -526,7 +537,7 @@ class ShuffleStore:
             st = self._stages.get(sid)
             out = []
             for side in range(n_sides):
-                for sender in range(m):
+                for sender in self._senders_of(senders, side, m):
                     stream = (
                         st.streams.get((side, sender))
                         if st is not None and st.attempt == attempt
@@ -566,17 +577,18 @@ class ShuffleStore:
             out: Dict[int, list] = {}
             for side in range(n_sides):
                 chunks: list = []
-                for sender in range(m):
+                for sender in self._senders_of(senders, side, m):
                     stream = st.streams[(side, sender)]
                     for seq in range(stream.nseq):
                         chunks.append(stream.seqs[seq])
                 out[side] = chunks
             return out
 
-    def _side_complete(self, st: Optional[_Stage], attempt, side, m):
+    def _side_complete(self, st: Optional[_Stage], attempt, side, m,
+                       senders=None):
         if st is None or st.attempt != attempt:
             return False
-        for sender in range(m):
+        for sender in self._senders_of(senders, side, m):
             stream = st.streams.get((side, sender))
             if stream is None or not stream.complete():
                 return False
@@ -590,6 +602,7 @@ class ShuffleStore:
         m: int,
         deadline: float,
         abort=None,
+        senders=None,
     ) -> Tuple[int, list, Dict[str, set]]:
         """Block until ANY side in ``pending`` has all m streams
         complete; returns (side, payload chunks ordered (sender, seq),
@@ -608,9 +621,13 @@ class ShuffleStore:
                 while True:
                     st = self._stages.get(sid)
                     for side in pending:
-                        if self._side_complete(st, attempt, side, m):
+                        if self._side_complete(
+                            st, attempt, side, m, senders
+                        ):
                             chunks: list = []
-                            for sender in range(m):
+                            for sender in self._senders_of(
+                                senders, side, m
+                            ):
                                 stream = st.streams[(side, sender)]
                                 for seq in range(stream.nseq):
                                     chunks.append(stream.seqs[seq])
@@ -626,7 +643,9 @@ class ShuffleStore:
                     if left <= 0:
                         missing = []
                         for side in pending:
-                            for sender in range(m):
+                            for sender in self._senders_of(
+                                senders, side, m
+                            ):
                                 stream = (
                                     st.streams.get((side, sender))
                                     if st is not None
@@ -1235,6 +1254,116 @@ class ShuffleWorker:
         self._exec_lock = racecheck.make_rlock("shuffle.exec")
         self._producer_exec = None
         self._consumer_exec = None
+        # shuffle-DAG held state: (coord, qid, attempt, stage, tag) ->
+        # HostBlock. tag=None entries are CONSUMER outputs held between
+        # stages (stage N's partition feeds stage N+1's StageInput);
+        # tag>=0 entries are range-side produce blocks cached by the
+        # sampling round so the stage round ships without re-executing
+        # the producer. Pruned when a newer attempt's stage-0 task
+        # arrives, when the last stage releases, on cancel, and by the
+        # bounded-cap backstop.
+        self._held_lock = racecheck.make_lock("shuffle.held")
+        self._held: "collections.OrderedDict" = collections.OrderedDict()
+
+    _HELD_CAP = 128
+
+    def _held_put(self, coord, qid, attempt, stage, tag, block) -> None:
+        with self._held_lock:
+            self._held[(coord, qid, int(attempt), int(stage), tag)] = block
+            while len(self._held) > self._HELD_CAP:
+                self._held.popitem(last=False)
+
+    def _held_get(self, coord, qid, attempt, stage, tag):
+        """Peek (entries live until release/prune: the sampling round
+        and the stage round both read the same cached block)."""
+        with self._held_lock:
+            return self._held.get(
+                (coord, qid, int(attempt), int(stage), tag)
+            )
+
+    def _held_prune(self, coord, qid, before_attempt=None) -> None:
+        """Drop held state for one query — everything (release /
+        cancel), or only attempts older than ``before_attempt`` (a
+        retried DAG restarts from stage 0; the superseded attempt's
+        partitions must not satisfy the new attempt's StageInputs)."""
+        with self._held_lock:
+            for k in list(self._held):
+                if k[0] != coord or k[1] != qid:
+                    continue
+                if before_attempt is None or k[2] < int(before_attempt):
+                    del self._held[k]
+
+    def held_count(self) -> int:
+        """Held DAG blocks on this worker (engine_status introspection;
+        must drain to zero after a completed or cancelled DAG — the
+        chaos harness's held-leak invariant)."""
+        with self._held_lock:
+            return len(self._held)
+
+    def _side_input_block(self, spec, side, plan, cancel_check=None):
+        """The producer input of one DAG side as a complete HostBlock:
+        a StageInput leaf reads the held output of an earlier stage
+        (missing = this worker restarted mid-DAG -> retryable abort),
+        a leaf plan prefers the sampling round's cached produce and
+        executes the plan otherwise."""
+        from tidb_tpu.chunk import batch_to_block
+        from tidb_tpu.planner import logical as L
+        from tidb_tpu.planner.physical import PhysicalExecutor
+
+        coord, qid = spec.get("coord"), spec.get("qid")
+        attempt, stage = int(spec["attempt"]), int(spec.get("stage", 0))
+        tag = int(side["tag"])
+        if isinstance(plan, L.StageInput):
+            # the mid-DAG re-staging seam (and the worker-kill-between-
+            # stages chaos site): stage N's held partition becomes
+            # stage N+1's already-sliced producer input — no re-scan
+            inject("shuffle/stage-input")
+            blk = self._held_get(coord, qid, attempt, plan.stage, None)
+            if blk is None:
+                raise ShuffleAbort(
+                    f"held output of stage {plan.stage} missing "
+                    f"(worker restarted mid-DAG?)", [],
+                )
+            return blk
+        blk = self._held_get(coord, qid, attempt, stage, tag)
+        if blk is not None:
+            return blk
+        if cancel_check is not None:
+            cancel_check()
+        with self._exec_lock:
+            if self._producer_exec is None:
+                self._producer_exec = PhysicalExecutor(
+                    self.catalog, mesh_devices=self.mesh_devices
+                )
+            batch, dicts = self._producer_exec.run(plan)
+            types = {c.internal: c.type for c in plan.schema.cols}
+            return batch_to_block(batch, types, dicts)
+
+    def run_sample(self, spec: dict, cancel_check=None) -> dict:
+        """Boundary-sampling round of a range exchange stage: produce
+        (or read) this worker's side input, CACHE it for the stage
+        round (the produce runs once, not twice), and return a
+        deterministic sample of the partition key for the
+        coordinator-merged quantile cut."""
+        from tidb_tpu.parallel.wire import sample_range_keys
+        from tidb_tpu.planner.ir import plan_from_ir
+
+        inject("shuffle/sample")
+        side = spec["side"]
+        plan = plan_from_ir(side["plan"])
+        blk = self._side_input_block(spec, side, plan, cancel_check)
+        from tidb_tpu.planner import logical as L
+
+        if not isinstance(plan, L.StageInput):
+            self._held_put(
+                spec.get("coord"), spec.get("qid"), spec["attempt"],
+                spec.get("stage", 0), int(side["tag"]), blk,
+            )
+        samples = sample_range_keys(
+            blk, side["key"], int(spec.get("sample_k") or 64),
+            int(spec.get("sample_seed") or 0), int(spec["part"]),
+        )
+        return {"samples": samples, "rows": blk.nrows}
 
     def run_task(self, spec: dict, tracer=None, cancel_check=None) -> dict:
         """The worker half of one shuffle stage. Pipelined (the
@@ -1287,6 +1416,23 @@ class ShuffleWorker:
         produce_chunks = max(
             int(spec.get("produce_chunks") or DEFAULT_PRODUCE_CHUNKS), 1
         )
+        # shuffle-DAG fields (absent = the single-stage shape): stage
+        # index + chain length (telemetry), the exchange kind, range
+        # boundaries, and whether this stage's output is HELD for the
+        # next stage's StageInput instead of returned to the
+        # coordinator
+        stage_idx = int(spec.get("stage", 0))
+        n_stages = int(spec.get("n_stages", 1))
+        exchange = str(spec.get("exchange") or "hash")
+        boundaries = spec.get("boundaries") or []
+        hold_output = bool(spec.get("hold_output"))
+        release_held = bool(spec.get("release_held"))
+        coord, qid = spec.get("coord"), spec.get("qid")
+        if stage_idx == 0:
+            # a retried DAG restarts from stage 0 under a new attempt:
+            # the superseded attempt's held partitions must not
+            # satisfy the new attempt's StageInputs
+            self._held_prune(coord, qid, before_attempt=int(attempt))
         ctx = f"q{spec.get('qid')}/p{part}"
         # fleet timeline capture (obs/timeline.py): when the dispatch
         # asks for it, work windows land in a per-task buffer the reply
@@ -1294,7 +1440,10 @@ class ShuffleWorker:
         # the ledger fence and rebases through the handshake clock
         # offset, so a retried stage's events land exactly once
         buf = None
-        ev_args = {"pipeline": pipeline}
+        ev_args = {
+            "pipeline": pipeline, "stage": stage_idx,
+            "exchange": exchange,
+        }
         if spec.get("timeline"):
             from tidb_tpu.obs.timeline import TimelineBuffer
 
@@ -1322,6 +1471,8 @@ class ShuffleWorker:
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
             "stalls": 0, "stall_s": 0.0, "retransmits": 0,
             "produced_rows": 0,
+            "stage": stage_idx, "n_stages": n_stages,
+            "exchange": exchange, "scan_rows": 0, "held_rows": 0,
             "per_peer": [], "codec": codec, "encode_s": 0.0,
             "pipeline": pipeline, "wait_idle_s": 0.0, "ttff_s": 0.0,
             # flight-recorder phase breakdown (obs/flight.py): engine
@@ -1355,6 +1506,43 @@ class ShuffleWorker:
                 plan = plan_from_ir(side["plan"])
                 schema_cols = list(plan.schema)
                 inject("shuffle/produce")
+                stats["scan_rows"] += self._plan_scan_rows(plan)
+                mode = str(
+                    side.get("mode")
+                    or ("range" if exchange == "range" else "hash")
+                )
+                from tidb_tpu.planner import logical as _L
+
+                if mode != "hash" or isinstance(plan, _L.StageInput):
+                    # DAG edge over a COMPLETE block: a held stage
+                    # output (StageInput), a range side (the sampling
+                    # round already produced and cached it), or a
+                    # broadcast/local edge — partitioned/copied whole,
+                    # shipped through the columnar frame path
+                    t_prod = time.perf_counter()
+                    t_wall = time.time()
+                    with span(f"{ctx}/produce#{tag}"):
+                        blk = self._side_input_block(
+                            spec, side, plan, cancel_check
+                        )
+                    dt_prod = time.perf_counter() - t_prod
+                    stats["produce_s"] += dt_prod
+                    emit(f"produce#{tag}", t_wall, dt_prod)
+                    stats["produced_rows"] += blk.nrows
+                    t_push = time.perf_counter()
+                    t_wall = time.time()
+                    with span(f"{ctx}/push#{tag}"):
+                        self._ship_block_side(
+                            sid, attempt, m, tag, part, blk,
+                            schema_cols, mode, boundaries,
+                            side.get("key"), peers, secret, tunnels,
+                            packet_rows, inflight, stats,
+                        )
+                    emit(
+                        f"push#{tag}", t_wall,
+                        time.perf_counter() - t_push,
+                    )
+                    continue
                 if codec == "json":
                     # shuffle-json-fallback: the row-packet escape
                     # hatch (shuffle_codec=json) materializes and
@@ -1472,6 +1660,17 @@ class ShuffleWorker:
                 emit(f"push#{tag}", t_wall, time.perf_counter() - t_push)
             consumer = plan_from_ir(spec["consumer"])
             reads = _shuffle_read_tags(consumer)
+            # per-side expected sender sets: a "local" DAG edge only
+            # ever has this host's own stream (nothing crosses the
+            # wire), every other mode expects all m producers
+            senders = {
+                int(s["tag"]): (
+                    [part]
+                    if str(s.get("mode") or "") == "local"
+                    else list(range(m))
+                )
+                for s in spec["sides"]
+            }
             if not pipeline:
                 # barrier shape: every push acked before the wait
                 # opens (shipper threads exist only in pipelined mode,
@@ -1486,7 +1685,7 @@ class ShuffleWorker:
                 with span(f"{ctx}/wait"):
                     by_side = self.store.wait(
                         sid, attempt, len(spec["sides"]), m,
-                        wait_timeout, abort=poll,
+                        wait_timeout, abort=poll, senders=senders,
                     )
                 idle = time.perf_counter() - t0
                 emit("wait", t_wall, idle)
@@ -1516,7 +1715,7 @@ class ShuffleWorker:
                     with span(f"{ctx}/wait"):
                         done, chunks, vocab = self.store.wait_side(
                             sid, attempt, pending, m, deadline,
-                            abort=poll,
+                            abort=poll, senders=senders,
                         )
                     t1 = time.perf_counter()
                     emit("wait", t_wall, t1 - t0)
@@ -1565,6 +1764,7 @@ class ShuffleWorker:
                 # a cancelled shipper: poison like the direct-cancel
                 # path (this raise skips the sibling handlers below)
                 self.store.poison(sid)
+                self._held_prune(coord, qid)
                 raise err
             if isinstance(err, PeerDeadError):
                 if err.fatal:
@@ -1598,8 +1798,10 @@ class ShuffleWorker:
             # fleet-wide cancellation reached this task: free the
             # stage's buffers and POISON the sid — frames still in
             # flight from peers that have not seen the cancel land as
-            # stale drops instead of resurrecting an orphan record
+            # stale drops instead of resurrecting an orphan record —
+            # and drop the query's held DAG blocks
             self.store.poison(sid)
+            self._held_prune(coord, qid)
             raise
         finally:
             for th in shippers:
@@ -1678,9 +1880,28 @@ class ShuffleWorker:
             out, out_dicts = self._consumer_exec.run(
                 _substitute_reads(consumer, staged)
             )
-            out_rows = materialize_rows(
-                out, list(consumer.schema), out_dicts
-            )
+            if hold_output:
+                # mid-DAG stage: the partition output stays HERE as
+                # the next stage's StageInput — nothing but stats
+                # returns to the coordinator
+                from tidb_tpu.chunk import batch_to_block
+
+                types = {
+                    c.internal: c.type for c in consumer.schema.cols
+                }
+                blk = batch_to_block(out, types, out_dicts)
+                self._held_put(
+                    coord, qid, attempt, stage_idx, None, blk
+                )
+                stats["held_rows"] = blk.nrows
+                out_rows = []
+            else:
+                out_rows = materialize_rows(
+                    out, list(consumer.schema), out_dicts
+                )
+        if release_held:
+            # last DAG stage done: free every held block of this query
+            self._held_prune(coord, qid)
         return {
             "columns": [c.name for c in consumer.schema],
             "rows": out_rows,
@@ -1873,6 +2094,95 @@ class ShuffleWorker:
                     # all sides shipped: wait time past this point is
                     # TRUE consumer idle (nothing left to overlap)
                     stats["_ship_done"] = time.perf_counter()
+
+    def _plan_scan_rows(self, plan) -> int:
+        """Base-table rows this plan's scans will read, fragment
+        slices honored — the per-host scan-work accounting the DAG A/B
+        cites (a chained DAG slices EVERY side; the single-cut
+        group-by re-scans unsliced join sides on every host)."""
+        from tidb_tpu.planner import logical as L
+
+        total = 0
+
+        def walk(p):
+            nonlocal total
+            if isinstance(p, L.Scan):
+                try:
+                    nrows = int(self.catalog.table(p.db, p.table).nrows)
+                except Exception:
+                    return
+                if p.frag is not None:
+                    i, mm = p.frag
+                    total += len(range(int(i), nrows, int(mm)))
+                else:
+                    total += nrows
+                return
+            for attr in ("child", "left", "right"):
+                c = getattr(p, attr, None)
+                if c is not None:
+                    walk(c)
+            for c in getattr(p, "children", []) or []:
+                walk(c)
+
+        walk(plan)
+        return total
+
+    def _ship_block_side(
+        self, sid, attempt, m, side, sender, block, schema_cols, mode,
+        boundaries, key, peers, secret, tunnels, packet_rows, inflight,
+        stats,
+    ) -> None:
+        """Ship one COMPLETE columnar side under a DAG edge mode:
+
+        - "local": no exchange at all — the producing host is the
+          owning partition (the broadcast join's probe side; zero
+          tunnel bytes);
+        - "broadcast": the whole side goes to EVERY peer (the small
+          join side of a broadcast edge);
+        - "range": rows route by sampled key-range boundaries
+          (wire.range_partition_map — distributed ORDER BY);
+        - "hash": key-hash routing (a held StageInput re-exchange).
+
+        Everything rides the existing columnar frame path
+        (_ship_partition: per-chunk binary frames, JSON only for a
+        peer that negotiated down)."""
+        from tidb_tpu.chunk import take_block
+        from tidb_tpu.parallel.wire import (
+            partition_block,
+            range_partition_map,
+        )
+
+        if mode == "local":
+            # dest == sender: _ship_partition's self-push path lands
+            # the block in the local store with the EOF discipline —
+            # ONE definition of the self-push protocol
+            self._ship_partition(
+                sid, attempt, m, side, sender, sender, block,
+                schema_cols, peers, secret, tunnels, packet_rows,
+                inflight, stats,
+            )
+            return
+        if mode == "broadcast":
+            for dest in range(m):
+                self._ship_partition(
+                    sid, attempt, m, side, sender, dest, block,
+                    schema_cols, peers, secret, tunnels, packet_rows,
+                    inflight, stats,
+                )
+            return
+        if mode == "range":
+            pmap = range_partition_map(block, key, boundaries)
+            idxs = [
+                np.nonzero(pmap == d)[0] for d in range(m)
+            ]
+        else:
+            idxs = partition_block(block, key, m)
+        for dest, idx in enumerate(idxs):
+            self._ship_partition(
+                sid, attempt, m, side, sender, dest,
+                take_block(block, idx), schema_cols, peers, secret,
+                tunnels, packet_rows, inflight, stats,
+            )
 
     def _ship_partition(
         self, sid, attempt, m, side, sender, dest, block, schema_cols,

@@ -453,6 +453,63 @@ def partition_map(block: HostBlock, key: str, m: int) -> np.ndarray:
     return np.where(np.asarray(col.valid, dtype=bool), parts, 0)
 
 
+def range_key_values(col: HostColumn) -> np.ndarray:
+    """Order-comparable image of a range-partition key column: a numpy
+    array whose ``<`` order IS the sort order of the logical values.
+    Integer-family kinds (INT/BOOL/temporals — day/second encodings
+    are chronological) keep their int64 buffers; DECIMAL keeps its
+    scaled-unit ints (scale is uniform per column, so scaled order is
+    value order); FLOAT compares as float64. STRING is rejected —
+    collation order lives in per-batch dictionaries, not a global
+    comparable domain (the planner's _RANGE_KEY_KINDS gate mirrors
+    this). NULL routing is the caller's job (validity mask)."""
+    k = col.type.kind
+    if k == Kind.FLOAT:
+        return np.asarray(col.data).astype(np.float64)
+    if k == Kind.STRING:
+        raise ValueError("string keys do not range-partition")
+    return np.asarray(col.data).astype(np.int64)
+
+
+def range_partition_map(
+    block: HostBlock, key: str, boundaries
+) -> np.ndarray:
+    """Per-row destination partition of column ``key`` under sampled
+    range ``boundaries`` (ascending; partition p owns keys in
+    (boundaries[p-1], boundaries[p]], the last partition is open) —
+    the range-exchange analog of partition_map. Ties never split: an
+    equal key always lands one side of a boundary, so per-partition
+    sorts concatenate into a total order. NULL keys all land on
+    partition 0 (MySQL null order: first ASC — and the coordinator
+    reverses partition order for DESC, putting them last)."""
+    col = block.columns[key]
+    if block.nrows == 0:
+        return np.zeros(0, dtype=np.int64)
+    vals = range_key_values(col)
+    b = np.asarray(list(boundaries), dtype=vals.dtype)
+    parts = np.searchsorted(b, vals, side="left").astype(np.int64)
+    return np.where(np.asarray(col.valid, dtype=bool), parts, 0)
+
+
+def sample_range_keys(
+    block: HostBlock, key: str, k: int, seed: int, part: int
+) -> List:
+    """Deterministic boundary sample of one producer's key column:
+    up to ``k`` non-null values drawn by a PRIVATE PRNG seeded from
+    (seed, part) — the same (data, seed) always yields the same
+    sample, so a retried sampling round (and a replayed chaos seed)
+    computes identical boundaries. Returns sorted plain-Python values
+    (JSON-shippable to the coordinator for the merged quantile cut)."""
+    col = block.columns[key]
+    if block.nrows == 0:
+        return []
+    vals = range_key_values(col)[np.asarray(col.valid, dtype=bool)]
+    if len(vals) > int(k):
+        rng = np.random.default_rng(int(seed) * 1_000_003 + int(part))
+        vals = vals[rng.choice(len(vals), size=int(k), replace=False)]
+    return sorted(v.item() for v in vals)
+
+
 def partition_block(
     block: HostBlock, key: str, m: int
 ) -> List[np.ndarray]:

@@ -321,13 +321,16 @@ def split_plan(plan: L.LogicalPlan, catalog=None) -> Optional[FragmentPlan]:
 @dataclasses.dataclass
 class ShuffleSide:
     """One producer side of a shuffle exchange: a plan every worker
-    executes over its own fragment slice, whose output rows are hash-
+    executes over its own fragment slice, whose output rows are
     partitioned on `key` and pushed to the owning peers."""
 
-    #: producer plan template; per worker the frag_scan gets its slice
+    #: producer plan template; per worker the frag_scan gets its slice.
+    #: A DAG re-staging side is an L.StageInput leaf instead (the
+    #: worker's held output of an earlier stage IS the slice).
     template: L.LogicalPlan
     #: the Scan inside `template` carrying the (idx, n) fragment slice
-    frag_scan: L.Scan
+    #: (None for StageInput sides — already partitioned)
+    frag_scan: Optional[L.Scan]
     #: internal column name of the partition key in template.schema
     key: str
     #: which ShuffleRead leaf of the consumer this side feeds
@@ -336,8 +339,16 @@ class ShuffleSide:
     #: tunnels only beat coordinator staging when the shuffled side is
     #: large — PERF_NOTES "Shuffle vs staging")
     est_rows: int = 0
+    #: how this edge exchanges (the per-edge cost-model output):
+    #: "hash" routes by key hash, "range" by sampled key-range
+    #: boundaries, "broadcast" copies the whole side to every peer,
+    #: "local" keeps the side on its producing host (the broadcast
+    #: join's probe side — zero exchange bytes)
+    mode: str = "hash"
 
     def host_plan(self, idx: int, n_hosts: int) -> L.LogicalPlan:
+        if self.frag_scan is None:
+            return self.template
         sliced = dataclasses.replace(self.frag_scan, frag=(idx, n_hosts))
         return _replace_node(self.template, self.frag_scan, sliced)
 
@@ -533,6 +544,454 @@ def split_plan_shuffle(
 
     return ShufflePlan(
         "groupby", [side], consumer, agg.schema, final_builder
+    )
+
+
+# -- shuffle DAGs (multi-stage exchanges; parallel/dcn.py topo order) -------
+
+
+#: range-partitionable first-sort-key kinds: values whose HostColumn
+#: buffer order IS the sort order (ints, floats, scaled decimals, and
+#: the temporal day/second encodings). Strings are excluded — collation
+#: order lives in per-batch dictionaries, not a global comparable
+#: domain, so a string-first-key ORDER BY keeps the coordinator sort.
+_RANGE_KEY_KINDS = (
+    Kind.INT, Kind.FLOAT, Kind.DECIMAL, Kind.BOOL,
+    Kind.DATE, Kind.DATETIME, Kind.TIME,
+)
+
+
+@dataclasses.dataclass
+class DagStage:
+    """One exchange stage of a shuffle DAG: producer sides (leaf plans
+    fragment-sliced per host, or StageInput re-stagings of the previous
+    stage's held output), the exchange kind, and the per-partition
+    consumer whose output this stage HOLDS for stage N+1 (or returns
+    to the coordinator, for the last stage)."""
+
+    #: "hash" (key-hash partitions) or "range" (sampled key-range
+    #: boundaries; distributed ORDER BY)
+    exchange: str
+    sides: List[ShuffleSide]
+    #: per-partition worker plan with ShuffleRead(tag) exchange leaves
+    consumer: L.LogicalPlan
+    #: join kind when this stage's consumer joins its sides (the
+    #: broadcast-edge legality input: non-inner joins may broadcast
+    #: only the non-preserved right side)
+    join_kind: Optional[str] = None
+    #: True when the consumer's correctness depends on key-colocated
+    #: partitions (complete groups per partition) — such a stage must
+    #: never trade its hash edges for broadcast/local ones
+    requires_key_partition: bool = False
+    #: range stages: first sort key direction (concat order) and the
+    #: per-partition top-K pushed under the partition sort (None =
+    #: unbounded)
+    desc: bool = False
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ShuffleDAG:
+    """A query cut into a topo-ordered chain of exchange stages: the
+    output partitions of stage N are held worker-side and become the
+    fragment-sliced StageInput of stage N+1 — join feeding a
+    DIFFERENT group-key shuffle no longer re-scans unsliced join sides
+    per host, and ORDER BY / top-K distributes over a range exchange.
+    ``merge`` decides the coordinator's final step:
+
+    - {"kind": "plan"}: stage the last stage's rows and run
+      final_builder's plan (the single-stage ShufflePlan discipline);
+    - {"kind": "concat", ...}: the last stage was a range exchange —
+      partitions are each sorted and ship at most K rows, so the
+      coordinator CONCATENATES them in partition order (reversed for a
+      descending first key), slices the global LIMIT/OFFSET, and runs
+      only the row-wise ``above`` nodes — no global re-sort.
+    """
+
+    stages: List[DagStage]
+    #: wire schema of the rows the LAST stage returns
+    partial_schema: Schema
+    #: staged-source plan node -> full coordinator plan (merge kind
+    #: "plan" only)
+    final_builder: Optional[Callable[[L.LogicalPlan], L.LogicalPlan]]
+    #: {"kind": "plan"} or {"kind": "concat", "reverse": bool,
+    #:  "limit": Optional[(count, offset)], "above": tuple of row-wise
+    #:  plan nodes (root-first) re-run on the coordinator}
+    merge: dict
+
+
+def choose_edge_modes(
+    stage: DagStage, broadcast_max_rows: int, ratio: float = 4.0
+) -> str:
+    """The per-edge half of the shuffle_mode cost model: given a
+    two-sided hash join stage, decide whether the SMALL side should
+    broadcast (every peer gets the whole side; the big side stays
+    local and ships ZERO bytes) instead of hash-partitioning both.
+    Broadcast wins when one side is small enough that copying it m
+    ways costs less than repartitioning the big side; it is only legal
+    when (a) the consumer does not require key-colocated partitions
+    (a re-keyed next stage restores any grouping) and (b) for
+    non-inner joins, the small side is the non-preserved RIGHT side.
+    Mutates side.mode in place; returns the chosen shape ("hash" or
+    "broadcast") for telemetry."""
+    if (
+        stage.exchange != "hash"
+        or stage.join_kind is None
+        or stage.requires_key_partition
+        or len(stage.sides) != 2
+        or broadcast_max_rows <= 0
+    ):
+        return "hash"
+    a, b = stage.sides
+    small, big = (a, b) if a.est_rows <= b.est_rows else (b, a)
+    if small.est_rows <= 0 or big.est_rows <= 0:
+        return "hash"
+    if (
+        small.est_rows > broadcast_max_rows
+        or big.est_rows < ratio * small.est_rows
+    ):
+        return "hash"
+    if stage.join_kind != "inner" and small.tag != 1:
+        return "hash"  # left/semi/anti preserve the LEFT side
+    small.mode = "broadcast"
+    big.mode = "local"
+    return "broadcast"
+
+
+def _parse_peeled(peeled):
+    """Recognize a distributable ORDER BY root in the peeled node
+    stack (root-first): ``[*above, Limit?, Sort]`` where ``above`` is
+    row-wise only. Returns (above tuple, (count, offset) or None,
+    Sort) or None when the stack has any other shape (the coordinator
+    re-runs it over the unioned rows, as before)."""
+    nodes = list(peeled)
+    if not nodes or not isinstance(nodes[-1], L.Sort):
+        return None
+    sort = nodes.pop()
+    limit = None
+    if nodes and isinstance(nodes[-1], L.Limit):
+        ln = nodes.pop()
+        if ln.count is None:
+            return None
+        limit = (int(ln.count), int(ln.offset or 0))
+    if any(
+        not isinstance(nd, (L.Projection, L.Selection)) for nd in nodes
+    ):
+        return None
+    return tuple(nodes), limit, sort
+
+
+def _range_sort_key(sort: L.Sort, schema: Schema):
+    """(key internal name, desc) when the first sort key is a bare
+    range-partitionable column of ``schema``, else None."""
+    if not sort.keys:
+        return None
+    e, desc = sort.keys[0]
+    if not isinstance(e, ColumnRef):
+        return None
+    oc = next((c for c in schema.cols if c.internal == e.name), None)
+    if oc is None or oc.type is None:
+        return None
+    if oc.type.kind not in _RANGE_KEY_KINDS:
+        return None
+    return e.name, bool(desc)
+
+
+def _range_stage(prev_schema: Schema, source, sort: L.Sort, limit):
+    """Build the range exchange stage: each partition owns one key
+    range, runs the existing single-host sort (the TopN path when a
+    LIMIT pushes K+offset under it — per-partition top-K) and the
+    coordinator concatenates in partition order."""
+    key_desc = _range_sort_key(sort, prev_schema)
+    if key_desc is None:
+        return None
+    key, desc = key_desc
+    sr = L.ShuffleRead(prev_schema, tag=0)
+    sorted_p = dataclasses.replace(sort, schema=prev_schema, child=sr)
+    k = None
+    consumer: L.LogicalPlan = sorted_p
+    if limit is not None:
+        # push LIMIT under the range exchange: each partition ships at
+        # most count+offset rows before the final concat (the global
+        # offset cannot be split across partitions, so every partition
+        # keeps its own first count+offset candidates)
+        k = int(limit[0]) + int(limit[1])
+        consumer = L.Limit(prev_schema, sorted_p, k, 0)
+    side = ShuffleSide(source, None, key, 0, 0, mode="range")
+    return DagStage(
+        "range", [side], consumer, desc=desc, limit=k,
+    )
+
+
+def _only_rowwise_above(lower: L.LogicalPlan, target) -> bool:
+    """True iff the single-child chain from ``lower`` down to
+    ``target`` crosses only Selection/Projection nodes — the condition
+    for folding those nodes into a per-partition stage consumer.
+    Anything else (a Window between the ORDER BY and the aggregate
+    computes over the WHOLE set, not per partition) must stay on the
+    coordinator."""
+    p = lower
+    while p is not target:
+        if not isinstance(p, (L.Selection, L.Projection)):
+            return False
+        p = p.child
+    return True
+
+
+def _find_windows(p: L.LogicalPlan, out: List[L.Window]) -> None:
+    for attr in ("child", "left", "right"):
+        c = getattr(p, attr, None)
+        if c is not None:
+            _find_windows(c, out)
+    for c in getattr(p, "children", []) or []:
+        _find_windows(c, out)
+    if isinstance(p, L.Window):
+        out.append(p)
+
+
+def _window_stage(lower: L.LogicalPlan, catalog) -> Optional[DagStage]:
+    """Distributed window functions: when ``lower`` is row-wise nodes
+    over EXACTLY ONE Window whose first PARTITION BY key is a bare
+    column of its child, hash-exchange the child rows by that key —
+    every worker then owns COMPLETE window partitions (deeper
+    partition keys are supersets of the first) and evaluates the
+    ORIGINAL window (frames, running aggregates, lag/lead included)
+    with final output, lifting the single-host fallback. Consumer
+    output carries lower.schema (the row-wise nodes fold in)."""
+    wins: List[L.Window] = []
+    _find_windows(lower, wins)
+    if len(wins) != 1:
+        return None  # stacked OVER specs may disagree on keys
+    win = wins[0]
+    if not win.partition_exprs or not _only_rowwise_above(lower, win):
+        return None
+    pk = win.partition_exprs[0]
+    if not isinstance(pk, ColumnRef):
+        return None
+    child_schema = win.child.schema
+    if pk.name not in {c.internal for c in child_schema.cols}:
+        return None
+    frag_scan = _pick_frag_scan(win.child, catalog)
+    if frag_scan is None:
+        return None
+    side = ShuffleSide(
+        win.child, frag_scan, pk.name, 0,
+        _est_rows(frag_scan, catalog),
+    )
+    consumer = _replace_node(
+        lower, win.child, L.ShuffleRead(child_schema, tag=0)
+    )
+    return DagStage(
+        "hash", [side], consumer, requires_key_partition=True,
+    )
+
+
+def split_plan_dag(
+    plan: L.LogicalPlan, catalog=None
+) -> Optional[ShuffleDAG]:
+    """Cut a bound plan into a DAG of worker-to-worker exchange
+    stages. Shapes (deepest first):
+
+    1. repartition join stage — both sides fragment-slice their
+       dominant scan and exchange by the join key; when the first
+       GROUP BY key IS a join key the original aggregate fuses into
+       the join stage (complete groups per partition), otherwise a
+       second hash stage re-exchanges the held join output by the
+       group key (zero re-scan of either side);
+    2. fragment-sliced GROUP BY stage (no suitable join) — the
+       existing group-stack cut as stage 0, only used when a range
+       stage rides above it;
+    3. range ORDER BY stage — the peeled Sort (plus a pushed-down
+       per-partition top-K for LIMIT) runs distributed over a
+       range-partitioned exchange of the previous stage's held output
+       (or of the fragment-sliced base scan when there is no deeper
+       stage), merged by order-preserving concat.
+
+    Returns None when no multi-stage (or range) shape applies — the
+    caller falls back to the single-cut planners. Raises Unschedulable
+    for plans that cannot cross the engine seam."""
+    agg_probe = _find_cut(plan)
+    if agg_probe is not None and agg_probe.gc_meta:
+        raise Unschedulable(
+            "GROUP_CONCAT plans execute host-assisted; they do not "
+            "cross the engine boundary"
+        )
+    peeled, lower = _peel_global_roots(plan)
+    rspec = _parse_peeled(peeled)
+    agg = _find_cut(lower)
+    stages: List[DagStage] = []
+    fused = False  # the original aggregate already ran in a stage
+    window_stage = False  # a distributed-window stage (no aggregate)
+
+    if agg is not None and agg.group_exprs:
+        # descend the WHOLE aggregate stack (DISTINCT aggregates
+        # expand to stacked Aggregates — the shape whose single-cut
+        # group-by re-scans unsliced join sides per host) to its
+        # bottom and the raw-row column the outermost group key
+        # resolves to; the join stage sits UNDER the stack
+        cut = _group_stack_cut(agg)
+        gkey = cut[1] if cut is not None else None
+        cut_child = cut[0] if cut is not None else agg.child
+        path, jp = _find_shuffle_join(cut_child)
+        if (
+            gkey is not None
+            and jp is not None
+            and jp.kind in _SHUFFLE_JOIN_KINDS
+            and not jp.null_aware
+            and jp.equi_keys
+        ):
+            le, re_ = jp.equi_keys[0]
+            lkey = _shuffle_key_of(le, jp.left.schema)
+            rkey = _shuffle_key_of(re_, jp.right.schema)
+            lscan = _pick_frag_scan(jp.left, catalog)
+            rscan = _pick_frag_scan(jp.right, catalog)
+            if (
+                lkey is not None and rkey is not None
+                and lscan is not None and rscan is not None
+            ):
+                sides = [
+                    ShuffleSide(jp.left, lscan, lkey, 0,
+                                _est_rows(lscan, catalog)),
+                    ShuffleSide(jp.right, rscan, rkey, 1,
+                                _est_rows(rscan, catalog)),
+                ]
+                jp2 = dataclasses.replace(
+                    jp,
+                    left=L.ShuffleRead(jp.left.schema, tag=0),
+                    right=L.ShuffleRead(jp.right.schema, tag=1),
+                )
+                mid = _wrap_path(path, jp2)
+                if gkey in (lkey, rkey):
+                    # join-key partitions colocate complete groups:
+                    # the ORIGINAL aggregate stack fuses into the
+                    # join stage (DISTINCT included — every level
+                    # groups by a superset of the outer key)
+                    core = _replace_node(agg, cut_child, mid)
+                    stages.append(DagStage(
+                        "hash", sides, core, join_kind=jp.kind,
+                        requires_key_partition=True,
+                    ))
+                    fused = True
+                else:
+                    # stage 0: join only; stage 1 re-exchanges the
+                    # HELD join output by the group key — no re-scan
+                    # of either side (gkey is in cut_child's schema
+                    # by _group_stack_cut's contract, and mid.schema
+                    # == cut_child.schema)
+                    stages.append(DagStage(
+                        "hash", sides, mid, join_kind=jp.kind,
+                    ))
+                    side2 = ShuffleSide(
+                        L.StageInput(mid.schema, stage=0), None,
+                        gkey, 0, 0,
+                    )
+                    core = _replace_node(
+                        agg, cut_child,
+                        L.ShuffleRead(cut_child.schema, tag=0),
+                    )
+                    stages.append(DagStage(
+                        "hash", [side2], core,
+                        requires_key_partition=True,
+                    ))
+                    fused = True
+        if not stages and rspec is not None and cut is not None:
+            # no join stage: the group-stack cut as stage 0, worth a
+            # DAG only because a range stage rides above it
+            frag_scan = _pick_frag_scan(cut_child, catalog)
+            if frag_scan is not None:
+                side = ShuffleSide(
+                    cut_child, frag_scan, gkey, 0,
+                    _est_rows(frag_scan, catalog),
+                )
+                core = _replace_node(
+                    agg, cut_child,
+                    L.ShuffleRead(cut_child.schema, tag=0),
+                )
+                stages.append(DagStage(
+                    "hash", [side], core,
+                    requires_key_partition=True,
+                ))
+                fused = True
+
+    # ---- distributed window stage (no aggregate below) ----
+    if not stages and agg is None:
+        ws = _window_stage(lower, catalog)
+        if ws is not None:
+            stages.append(ws)
+            window_stage = True
+
+    # ---- range ORDER BY stage on top ----
+    if rspec is not None:
+        above, limit, sort = rspec
+        if stages:
+            # re-wrap the last stage's consumer so its held output
+            # carries the Sort child's schema (the row-wise nodes
+            # between the Sort and the Aggregate fold into the
+            # stage); only legal when that gap is purely row-wise —
+            # a Window there computes over the WHOLE set, so the
+            # coordinator keeps the sort (plan merge below)
+            prev = stages[-1]
+            if window_stage:
+                wrapped = prev.consumer  # already carries lower.schema
+            elif _only_rowwise_above(lower, agg):
+                wrapped = _replace_node(lower, agg, prev.consumer)
+            else:
+                wrapped = None
+            rs = _range_stage(
+                lower.schema,
+                L.StageInput(lower.schema, stage=len(stages) - 1),
+                sort, limit,
+            ) if wrapped is not None else None
+            if rs is not None:
+                stages[-1] = dataclasses.replace(prev, consumer=wrapped)
+                stages.append(rs)
+                out_cols = above[0].schema if above else sort.schema
+                return ShuffleDAG(
+                    stages, sort.schema, None,
+                    {
+                        "kind": "concat", "reverse": rs.desc,
+                        "limit": limit, "above": tuple(above),
+                        "columns": [c.name for c in out_cols.cols],
+                    },
+                )
+        elif agg is None:
+            frag_scan = _pick_frag_scan(lower, catalog)
+            key_desc = _range_sort_key(sort, lower.schema)
+            if frag_scan is not None and key_desc is not None:
+                rs = _range_stage(lower.schema, lower, sort, limit)
+                if rs is not None:
+                    rs.sides[0] = dataclasses.replace(
+                        rs.sides[0], frag_scan=frag_scan,
+                        est_rows=_est_rows(frag_scan, catalog),
+                    )
+                    stages.append(rs)
+                    out_cols = above[0].schema if above else sort.schema
+                    return ShuffleDAG(
+                        stages, sort.schema, None,
+                        {
+                            "kind": "concat", "reverse": rs.desc,
+                            "limit": limit, "above": tuple(above),
+                            "columns": [c.name for c in out_cols.cols],
+                        },
+                    )
+
+    # ---- no range stage: a DAG is worth it when CHAINED, or when a
+    # window stage lifts the single-host fallback outright ----
+    if window_stage:
+        def final_builder(source, _plan=plan, _lower=lower):
+            return _replace_node(_plan, _lower, source)
+
+        return ShuffleDAG(
+            stages, lower.schema, final_builder, {"kind": "plan"},
+        )
+    if len(stages) < 2 or not fused:
+        return None
+
+    def final_builder(source, _plan=plan, _agg=agg):
+        return _replace_node(_plan, _agg, source)
+
+    return ShuffleDAG(
+        stages, agg.schema, final_builder, {"kind": "plan"},
     )
 
 

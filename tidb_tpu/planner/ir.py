@@ -33,7 +33,11 @@ from tidb_tpu.planner import logical as L
 # v3: ShuffleRead — the worker-to-worker shuffle exchange leaf
 # (parallel/shuffle.py); a pre-shuffle engine cannot resolve it, so the
 # version fence keeps mixed fleets from half-executing a shuffle plan
-IR_VERSION = 3
+# v4: StageInput — the shuffle-DAG re-staging leaf (a worker's held
+# output of an earlier exchange stage feeds the next stage's producer);
+# a pre-DAG engine cannot resolve held stage outputs, so the fence
+# keeps it from silently re-scanning base tables instead
+IR_VERSION = 4
 
 
 # -- types ------------------------------------------------------------------
@@ -178,6 +182,8 @@ def plan_to_ir(p: L.LogicalPlan) -> Dict:
         }
     if isinstance(p, L.ShuffleRead):
         return {"n": "shuffle_read", "schema": sch, "tag": int(p.tag)}
+    if isinstance(p, L.StageInput):
+        return {"n": "stage_input", "schema": sch, "stage": int(p.stage)}
     raise ValueError(f"unserializable plan node {type(p).__name__}")
 
 
@@ -240,6 +246,8 @@ def plan_from_ir(d: Dict) -> L.LogicalPlan:
         return L.UnionAll(sch, [plan_from_ir(c) for c in d["children"]])
     if n == "shuffle_read":
         return L.ShuffleRead(sch, tag=int(d.get("tag", 0)))
+    if n == "stage_input":
+        return L.StageInput(sch, stage=int(d.get("stage", 0)))
     raise ValueError(f"bad plan tag {n!r}")
 
 

@@ -239,6 +239,20 @@ class ShuffleRead(LogicalPlan):
 
 
 @dataclasses.dataclass
+class StageInput(LogicalPlan):
+    """Leaf standing for THIS worker's held output of an earlier
+    shuffle-DAG stage (parallel/shuffle.py ShuffleWorker._held): the
+    output partitions of stage N become the fragment-sliced producer
+    input of stage N+1 — no re-scan, no re-exchange of what this host
+    already owns. Serializable (the node carries only the wire schema
+    and the source stage index); the worker substitutes the held
+    HostBlock before execution, so like ShuffleRead the physical
+    compiler never sees it."""
+
+    stage: int = 0
+
+
+@dataclasses.dataclass
 class UnionAll(LogicalPlan):
     """Bag union by position; children are projections onto _u{i} names
     with casts to the common types (reference UnionExec,

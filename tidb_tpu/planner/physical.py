@@ -2843,7 +2843,16 @@ class PhysicalExecutor:
         if frag_stats:
             lines = _merge_frag_stats(lines, frag_stats)
         if shuffle_stats:
-            lines = _merge_shuffle_stats(lines, *shuffle_stats)
+            if isinstance(shuffle_stats, list):
+                # shuffle DAG: one (stage summary, infos) pair per
+                # exchange stage, rendered topo-order under the
+                # Staged node with the same grammar (each insert
+                # lands directly below the anchor, so reversed
+                # iteration leaves stage 0 on top)
+                for stage, infos in reversed(shuffle_stats):
+                    lines = _merge_shuffle_stats(lines, stage, infos)
+            else:
+                lines = _merge_shuffle_stats(lines, *shuffle_stats)
         return out, cq.out_dicts, lines
 
 
@@ -2937,9 +2946,33 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
     )
     idle = float(stage.get("wait_idle_s", 0.0))
     overlap = max(0.0, 1.0 - idle / total_exec) if total_exec > 0 else 0.0
+    # shuffle-DAG stages additionally carry their chain position, the
+    # exchange kind chosen per edge (hash | range | broadcast), and
+    # the per-stage produce/wait/stage phase seconds
+    dag_bits = ""
+    if "exchange" in stage:
+        modes = stage.get("modes") or ()
+        exch = (
+            "broadcast"
+            if "broadcast" in modes
+            else stage.get("exchange", "hash")
+        )
+        pos = (
+            f"stage={int(stage.get('stage', 0)) + 1}/"
+            f"{int(stage.get('n_stages', 1))} "
+            if "stage" in stage else ""
+        )
+        dag_bits = (
+            pos
+            + f"exchange={exch} "
+            f"produce={float(stage.get('produce_s', 0.0))*1000:.2f}ms "
+            f"wait={float(stage.get('wait_s', 0.0))*1000:.2f}ms "
+            f"stage_s={float(stage.get('stage_s', 0.0))*1000:.2f}ms "
+        )
     summary = (
         f"DCNShuffle kind={stage.get('kind')} "
-        f"partitions={stage.get('m')} hosts={len(hosts)} "
+        + dag_bits
+        + f"partitions={stage.get('m')} hosts={len(hosts)} "
         f"attempts={stage.get('attempts')} rows={total_rows} "
         f"bytes_tunneled={stage.get('bytes_tunneled')} "
         f"rows_tunneled={stage.get('rows_tunneled')} "

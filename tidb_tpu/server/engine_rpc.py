@@ -293,6 +293,8 @@ class EngineServer:
                             resp = outer._shuffle_push(req, dec_s)
                         elif "shuffle_task" in req:
                             resp = outer._shuffle_task(req)
+                        elif "shuffle_sample" in req:
+                            resp = outer._shuffle_sample(req)
                         elif "cancel_query" in req:
                             resp = outer._cancel_query(req)
                         elif "engine_status" in req:
@@ -699,7 +701,12 @@ class EngineServer:
             "rows": result["rows"],
             "shuffle": result["shuffle"],
             "stats": {
-                "rows": len(result["rows"]),
+                # a mid-DAG stage HOLDS its output (rows ship nothing
+                # back): report the held partition's row count so
+                # per-stage ShuffleExchange rows stay informative
+                "rows": len(result["rows"]) or int(
+                    result["shuffle"].get("held_rows", 0) or 0
+                ),
                 "exec_s": exec_s,
                 "host": f"{socket.gethostname()}:{self.port}",
                 "mem_peak_bytes": task_watch["mem_peak_bytes"],
@@ -717,6 +724,50 @@ class EngineServer:
             resp["registry"] = self._registry_delta()
         return json.dumps(resp).encode()
 
+    def _shuffle_sample(self, req) -> bytes:
+        """Boundary-sampling round of a range exchange stage
+        (ShuffleWorker.run_sample): produce-and-cache this worker's
+        side, reply a deterministic key sample for the coordinator's
+        merged quantile cut. A lost reply (shuffle/sample-lost) is a
+        transport suspect the coordinator verifies like any dispatch
+        loss; retryable failures (a held StageInput missing after a
+        worker restart) reply with the suspect taxonomy of
+        _shuffle_task so the whole DAG retries on the survivor set."""
+        from tidb_tpu.parallel.shuffle import ShuffleAbort
+        from tidb_tpu.utils import sqlkiller as _sk
+        from tidb_tpu.utils.failpoint import inject
+
+        if req.get("v") != IR_VERSION:
+            raise ValueError(f"unsupported IR version {req.get('v')}")
+        spec = req["shuffle_sample"]
+        check = make_cancel_check(
+            self.cancels, spec.get("qid"), spec.get("deadline_s"),
+            coord=spec.get("coord"),
+        )
+        _sk.set_current(_CheckKiller(check))
+        try:
+            result = self.shuffle_worker().run_sample(
+                spec, cancel_check=check
+            )
+        except ShuffleAbort as e:
+            return json.dumps(
+                {
+                    "id": req.get("id"), "ok": False,
+                    "retryable": "shuffle", "suspects": e.suspects,
+                    "error": str(e),
+                }
+            ).encode()
+        finally:
+            _sk.set_current(None)
+        if inject("shuffle/sample-lost"):
+            raise DropConnection()
+        return json.dumps(
+            {
+                "id": req.get("id"), "ok": True,
+                "samples": result["samples"], "rows": result["rows"],
+            }
+        ).encode()
+
     def _cancel_query(self, req) -> bytes:
         """Fleet-wide cancellation, worker half: mark the qid in the
         cancel registry (running fragments/shuffle tasks abort at
@@ -724,7 +775,8 @@ class EngineServer:
         buffers NOW — the sid is poisoned so in-flight frames from
         still-pushing peers cannot resurrect an orphan stage record
         (``tidbtpu_shuffle_stages_buffered`` returns to 0 without
-        waiting for the eviction window)."""
+        waiting for the eviction window). Held shuffle-DAG blocks of
+        the qid drop with it."""
         c = req["cancel_query"]
         self.cancels.cancel(
             c.get("qid"), c.get("reason"), coord=c.get("coord")
@@ -732,6 +784,8 @@ class EngineServer:
         sid = c.get("sid")
         if sid is not None and self._shuffle is not None:
             self._shuffle.store.poison(str(sid))
+        if self._shuffle is not None:
+            self._shuffle._held_prune(c.get("coord"), c.get("qid"))
         return json.dumps({"id": req.get("id"), "ok": True}).encode()
 
     def _engine_status(self, req) -> bytes:
@@ -740,8 +794,10 @@ class EngineServer:
         worker threads on this host — both must return to zero after a
         cancelled or failed stage (the abort-path leak check)."""
         stages = 0
+        held = 0
         if self._shuffle is not None:
             stages = self._shuffle.store.buffered_stages()
+            held = self._shuffle.held_count()
         shuffle_threads = [
             t.name for t in threading.enumerate()
             if t.is_alive() and t.name.startswith("shuffle-")
@@ -750,6 +806,7 @@ class EngineServer:
             {
                 "id": req.get("id"), "ok": True,
                 "stages_buffered": stages,
+                "held_outputs": held,
                 "shuffle_threads": shuffle_threads,
             }
         ).encode()

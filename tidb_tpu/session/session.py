@@ -4076,6 +4076,10 @@ class Session(DDLMixin):
         snap = {}
         if lq.get("shuffle"):
             snap["shuffle"] = dict(lq["shuffle"])
+        if lq.get("shuffle_stages"):
+            snap["shuffle_stages"] = [
+                dict(s) for s in lq["shuffle_stages"]
+            ]
         if lq.get("fragments"):
             snap["fragments"] = [
                 {k: v for k, v in f.items() if k != "spans"}
@@ -5970,6 +5974,17 @@ def _dcn_runtime_lines(lq) -> List[str]:
     )
 
     lq = lq or {}
+    if lq.get("shuffle_stages"):
+        # shuffle DAG: one DCNShuffle row PER STAGE (stage=i/n,
+        # exchange kind, per-stage phase seconds), same grammar
+        lines: List[str] = []
+        frags = lq.get("fragments") or []
+        for si, stage in enumerate(lq["shuffle_stages"]):
+            lines = _merge_shuffle_stats(
+                lines, stage,
+                [f for f in frags if f.get("stage", 0) == si],
+            )
+        return lines
     if lq.get("shuffle"):
         return _merge_shuffle_stats(
             [], lq["shuffle"], lq.get("fragments") or []

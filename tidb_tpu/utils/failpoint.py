@@ -95,7 +95,10 @@ SITES = frozenset({
     "shuffle/push-lost",
     "shuffle/recv",
     "shuffle/recv-ack-lost",
+    "shuffle/sample",
+    "shuffle/sample-lost",
     "shuffle/stage",
+    "shuffle/stage-input",
     "shuffle/stage-retry",
     "shuffle/wait",
     "session/before-commit",

@@ -84,6 +84,8 @@ LOCK_CLASSES: Dict[str, str] = {
     "serving.load": "serve-load driver's client latency/error lists",
     "executor.plan_cache": "process-wide shared compiled-plan cache "
                            "(condition: singleflight compile claims)",
+    "shuffle.held": "held shuffle-DAG stage outputs + cached range-"
+                    "side produce blocks",
     "shuffle.store": "receiver stage/stream buffers (condition)",
     "shuffle.tunnel": "one peer tunnel's queue + in-flight window "
                       "(condition)",
