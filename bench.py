@@ -1557,6 +1557,13 @@ def measure_order_by(args) -> int:
     return rc
 
 
+def _write_inspect_out(args, detail: dict) -> None:
+    """--inspect-out: snapshot detail.inspection to a JSON file."""
+    from tidb_tpu.obs.inspection import write_inspect_out
+
+    write_inspect_out(getattr(args, "inspect_out", None), detail)
+
+
 def measure_chaos(args) -> int:
     """Chaos robustness scenario: N seeded composed-fault episodes
     (worker crash / hang / frame loss / delay / slow peer / tunnel
@@ -1575,11 +1582,42 @@ def measure_chaos(args) -> int:
 
     episodes = max(int(args.chaos_episodes), 1)
     seed = int(args.chaos_seed)
-    t0 = time.time()
+    false_positive = None
     with ChaosHarness(seed=seed, wait_timeout_s=2.0) as h:
+        # false-positive guard FIRST: a fault-free calibration episode
+        # must not yield a critical inspection finding — a diagnosis
+        # tier that alarms on a healthy fleet fails the bench before
+        # any chaos is injected
+        baseline_viol, (b0, b1) = h.baseline_episode()
+        from tidb_tpu.obs.inspection import run_inspection
+
+        baseline_critical = [
+            f.to_dict() for f in run_inspection(t_lo=b0, t_hi=b1)
+            if f.severity == "critical"
+        ]
+        if baseline_critical:
+            false_positive = baseline_critical
+        # the headline wall starts AFTER calibration: the episodes/s
+        # metric must stay comparable with pre-PR-12 captures that
+        # had no baseline episode or inspection pass in the window
+        t0 = time.time()
         rep = h.run(episodes)
     wall = time.time() - t0
     detail = rep.to_dict()
+    if baseline_viol:
+        # a fleet invariant breached with NOTHING injected is a
+        # stronger red flag than the same breach under faults: count
+        # it into the run's violation total (which fails the bench)
+        detail["invariant_violations"] += len(baseline_viol)
+        detail["violations"] = (
+            list(baseline_viol) + list(detail["violations"])
+        )
+    from tidb_tpu.obs.inspection import inspection_detail
+
+    inspection = inspection_detail(windows=rep.windows)
+    inspection["baseline_critical"] = false_positive or []
+    inspection["baseline_violations"] = list(baseline_viol)
+    _write_inspect_out(args, inspection)
     result = {
         "metric": f"chaos_episodes_seed{seed}_per_sec",
         "value": round(episodes / max(wall, 1e-9), 4),
@@ -1590,6 +1628,7 @@ def measure_chaos(args) -> int:
             "workers": 2,
             "wall_seconds": round(wall, 3),
             "chaos": detail,
+            "inspection": inspection,
             "backend_provenance": {
                 "backend": "cpu",
                 "pjrt_backend": "cpu",
@@ -1607,6 +1646,14 @@ def measure_chaos(args) -> int:
         # a violated invariant fails the run loudly — AFTER the
         # capture is written (the violating run's record is exactly
         # the artifact a robustness regression needs)
+        rc = 1
+    if false_positive:
+        # the false-positive guard: a CRITICAL inspection finding over
+        # the fault-free calibration window means the diagnosis tier
+        # alarms on a healthy fleet — fail loudly, after the capture
+        print(json.dumps({
+            "inspection_false_positive": false_positive
+        }), file=sys.stderr)
         rc = 1
     print(json.dumps(result))
     return rc
@@ -1661,6 +1708,16 @@ def main() -> int:
         " works in every mode incl. --serve-load and "
         "--multihost-shuffle (worker events ship back on the fenced "
         "replies, rebased through the handshake clock offsets)",
+    )
+    ap.add_argument(
+        "--inspect-out", default=None, metavar="FILE",
+        help="with --chaos or --serve-load: run the inspection engine "
+        "(information_schema.inspection_result's evaluator, "
+        "obs/inspection.py) over the run's sampled metric history and "
+        "write the findings + evidence windows to this JSON file; "
+        "detail.inspection is stamped either way. --chaos additionally "
+        "exits nonzero on a critical finding over its fault-free "
+        "calibration episode (false-positive guard)",
     )
     ap.add_argument(
         "--multihost-shuffle", action="store_true",

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Lint: every inspection rule declaration (tidb_tpu/obs/inspection.py
+``@rule(...)``) references only REAL, vocabulary-clean metric names and
+declared flight phases.
+
+Why: a rule is an alert contract — operators trust that
+`inspection_result` rows explain real telemetry. Three rot modes this
+lint closes (the failpoint-SITES pattern, applied to diagnosis):
+
+  1. a rule's ``metrics=(...)`` naming a metric that violates the
+     ``tidbtpu_<subsystem>_<name>`` convention (or an undeclared
+     subsystem, per scripts/check_metric_names.py SUBSYSTEMS) — the
+     rule keys on a series that can never exist;
+  2. a DEAD declaration: a metric no engine code registers (no
+     ``REGISTRY.counter/gauge/histogram("name")`` literal call site
+     anywhere outside tests/) — the rule silently never fires;
+  3. a rule's ``phases=(...)`` naming a flight phase missing from
+     obs/flight.py PHASES — the rule's "where the cost lands"
+     narrative points at a column that doesn't exist.
+
+Also rejected: duplicate rule names, an empty metrics tuple (a rule
+that reads nothing diagnoses nothing), and non-literal declarations
+(the registry must be statically readable — keep it that way).
+
+Usage: python scripts/check_inspection_rules.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+# share the metric-name vocabulary + call-site scanner with the
+# metric-name lint (same scripts/ directory)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_metric_names import CALL, NAME, SUBSYSTEMS, iter_py  # noqa: E402
+
+INSPECTION_REL = os.path.join("tidb_tpu", "obs", "inspection.py")
+FLIGHT_REL = os.path.join("tidb_tpu", "obs", "flight.py")
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules", "tests"}
+
+
+def load_phases(root: str):
+    """obs/flight.py PHASES via the AST (the check_flight_phases.py
+    approach — importing the package would need jax)."""
+    path = os.path.join(root, FLIGHT_REL)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "PHASES"
+            for t in node.targets
+        ):
+            return frozenset(ast.literal_eval(node.value))
+    raise SystemExit(f"PHASES assignment not found in {path}")
+
+
+def registered_metrics(root: str):
+    """Every literal metric name any REGISTRY.counter/gauge/histogram
+    call site registers, engine-wide (tests excluded) — the existence
+    vocabulary rule declarations must draw from."""
+    names = set()
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        parts = rel.split(os.sep)
+        if parts[0] in SKIP_DIRS or parts[0] == "scripts":
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in CALL.finditer(text):
+            names.add(m.group(1))
+    return names
+
+
+def load_rules(root: str):
+    """[(name, metrics, phases, lineno)] from every @rule(...) literal
+    decorator in inspection.py; violations for non-literal shapes."""
+    path = os.path.join(root, INSPECTION_REL)
+    violations = []
+    rules = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except OSError:
+        return [], [(INSPECTION_REL, 1, "inspection.py unreadable")]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if not (
+                isinstance(dec, ast.Call)
+                and isinstance(dec.func, ast.Name)
+                and dec.func.id == "rule"
+            ):
+                continue
+            line = dec.lineno
+            try:
+                name = ast.literal_eval(dec.args[0])
+            except Exception:
+                violations.append(
+                    (INSPECTION_REL, line,
+                     "non-literal rule name (the registry must be "
+                     "statically readable)")
+                )
+                continue
+            metrics = phases = None
+            for kw in dec.keywords:
+                try:
+                    val = ast.literal_eval(kw.value)
+                except Exception:
+                    violations.append(
+                        (INSPECTION_REL, line,
+                         f"rule {name!r}: non-literal {kw.arg}= "
+                         "declaration")
+                    )
+                    val = ()
+                if kw.arg == "metrics":
+                    metrics = tuple(val)
+                elif kw.arg == "phases":
+                    phases = tuple(val)
+            if metrics is None and len(dec.args) > 1:
+                try:
+                    metrics = tuple(ast.literal_eval(dec.args[1]))
+                except Exception:
+                    violations.append(
+                        (INSPECTION_REL, line,
+                         f"rule {name!r}: non-literal metrics "
+                         "declaration")
+                    )
+            rules.append((name, metrics or (), phases or (), line))
+    return rules, violations
+
+
+def check(root: str):
+    rules, violations = load_rules(root)
+    phases = load_phases(root)
+    registered = registered_metrics(root)
+    seen = set()
+    for name, metrics, rphases, line in rules:
+        if name in seen:
+            violations.append(
+                (INSPECTION_REL, line,
+                 f"duplicate inspection rule {name!r}")
+            )
+        seen.add(name)
+        if not metrics:
+            violations.append(
+                (INSPECTION_REL, line,
+                 f"rule {name!r} declares no metrics (a rule that "
+                 "reads nothing diagnoses nothing)")
+            )
+        for metric in metrics:
+            nm = NAME.match(metric)
+            if not nm:
+                violations.append(
+                    (INSPECTION_REL, line,
+                     f"rule {name!r} references metric {metric!r} "
+                     "violating the tidbtpu_<subsystem>_<name> "
+                     "convention")
+                )
+            elif nm.group(1) not in SUBSYSTEMS:
+                violations.append(
+                    (INSPECTION_REL, line,
+                     f"rule {name!r} references metric {metric!r} "
+                     f"with undeclared subsystem {nm.group(1)!r} "
+                     "(scripts/check_metric_names.py SUBSYSTEMS)")
+                )
+            if metric not in registered:
+                violations.append(
+                    (INSPECTION_REL, line,
+                     f"rule {name!r} references metric {metric!r} "
+                     "that no engine code registers (dead rule "
+                     "declaration)")
+                )
+        for ph in rphases:
+            if ph not in phases:
+                violations.append(
+                    (INSPECTION_REL, line,
+                     f"rule {name!r} references undeclared flight "
+                     f"phase {ph!r} (tidb_tpu/obs/flight.py PHASES)")
+                )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} inspection-rule violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

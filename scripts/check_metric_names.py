@@ -31,7 +31,10 @@ import sys
 #: (both PR 6), admission = the serving tier's fleet admission
 #: controller (PR 8, parallel/serving.py), timeline = the fleet
 #: timeline tracer (PR 9, obs/timeline.py), chaos = the deterministic
-#: fault-injection harness (PR 10, tidb_tpu/chaos/).
+#: fault-injection harness (PR 10, tidb_tpu/chaos/), tsdb = the
+#: metric time-series store behind metrics_schema (PR 12,
+#: obs/tsdb.py — sampler overhead self-metrics), inspection = the
+#: declared-rule diagnosis engine (PR 12, obs/inspection.py).
 SUBSYSTEMS = frozenset({
     "admission",
     "chaos",
@@ -39,11 +42,13 @@ SUBSYSTEMS = frozenset({
     "engine",
     "executor",
     "flight",
+    "inspection",
     "link",
     "session",
     "shuffle",
     "stats",
     "timeline",
+    "tsdb",
     "ttl",
     "watchdog",
 })

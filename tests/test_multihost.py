@@ -464,6 +464,85 @@ def test_dcn_flight_recorder_surfaces(tpch_single, tmp_path):
             w.kill()
 
 
+def test_dcn_metrics_schema_fleet_history(tpch_single):
+    """PR 12 acceptance: the 2-process x 4-device dryrun accretes
+    SQL-queryable metric HISTORY for the whole fleet. Worker processes
+    sample their own registries and ship the rows piggybacked on
+    fenced shuffle replies (plus the heartbeat idle-flush);
+    `SELECT ... FROM metrics_schema.tidbtpu_shuffle_codec_bytes WHERE
+    time >= ...` then returns sampled points for BOTH worker hosts
+    with the codec label column intact, under bounded store memory,
+    with the time predicate pushed into the retention rings."""
+    import time as _time
+
+    from tidb_tpu.obs.tsdb import TSDB
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+    )
+    sess = tpch_single
+    t_run0 = _time.time()
+    try:
+        q = SHUFFLE_QUERIES[0]
+        exp = sess.must_query(q).rows
+        for _ in range(2):
+            # >= 2 rounds spaced past the worker's sample cadence so
+            # each host ships at least two time points (history, not
+            # a single snapshot)
+            _cols, got = sched.execute_plan(_plan(sess, q))
+            assert got == exp
+            _time.sleep(1.1)
+        # the heartbeat idle-flush: pending worker samples land even
+        # with no dispatch in flight
+        sched.heartbeat.beat_once()
+
+        worker_addrs = {f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"}
+        r = sess.must_query(
+            "select time, instance, codec, value from "
+            "metrics_schema.tidbtpu_shuffle_codec_bytes "
+            f"where time >= {t_run0 - 5.0}"
+        )
+        assert r.rows, "no sampled shuffle history reached the store"
+        hosts = {row[1] for row in r.rows}
+        assert worker_addrs <= hosts, (
+            f"history missing a worker host: {hosts}"
+        )
+        # label columns intact: the codec label survives as a column
+        assert {row[2] for row in r.rows} <= {"binary", "json"}
+        assert all(row[3] > 0 for row in r.rows)
+        # both hosts shipped HISTORY (>= 2 distinct sample times)
+        for addr in worker_addrs:
+            times = {row[0] for row in r.rows if row[1] == addr}
+            assert len(times) >= 2, (
+                f"{addr} shipped {len(times)} sample time(s)"
+            )
+        # the time predicate genuinely pushed into the store: a
+        # future-bounded scan materializes ZERO points while the
+        # unbounded family is non-empty (were the session's hint
+        # extraction deleted, the store would materialize everything
+        # and last_scan_points would equal the total)
+        r = sess.must_query(
+            "select time from "
+            "metrics_schema.tidbtpu_shuffle_codec_bytes "
+            f"where time >= {t_run0 + 10 ** 6}"
+        )
+        assert r.rows == []
+        assert TSDB.last_scan_points == 0
+        assert len(TSDB.query("tidbtpu_shuffle_codec_bytes")) > 0
+        # bounded memory: every ring respects the retention caps
+        cap = 2 * TSDB.retention_points
+        assert TSDB.point_count() <= TSDB.series_count() * cap
+    finally:
+        sched.close()
+        for w in (w1, w2):
+            w.kill()
+
+
 def test_dcn_many_session_serving_dryrun(tpch_single):
     """PR 8 serving tier: a 2-process x 4-device fleet serves 8+
     CONCURRENT session threads (each session its own Session object

@@ -273,6 +273,15 @@ def run_serve_load(args) -> int:
 
             TIMELINE.start()
 
+        # metric time-series cadence for the run: the inspection stamp
+        # (detail.inspection / --inspect-out) reads this history, and
+        # worker samples ride the fenced replies + heartbeat flushes
+        from tidb_tpu.obs.tsdb import SAMPLER, TSDB
+
+        t_inspect0 = time.time()
+        TSDB.sample_registry(now=t_inspect0)
+        SAMPLER.retune(0.5)
+
         # admission knobs come from the tidb_-style sysvars (ROADMAP
         # PR 8 item); the bench's --serve-budget-mb overrides the
         # budget the way a SET GLOBAL would
@@ -497,9 +506,31 @@ def run_serve_load(args) -> int:
                 "events": len(trace["traceEvents"]),
                 "path": timeline_path,
             }
+        # inspection stamp over the run's window: under a worker kill
+        # the findings narrate the incident (heartbeat gap / retry
+        # storm), under a clean run they should be quiet
+        SAMPLER.stop()
+        t_inspect1 = time.time()
+        TSDB.sample_registry(now=t_inspect1)
+        from tidb_tpu.obs.inspection import (
+            inspection_detail,
+            write_inspect_out,
+        )
+
+        inspection = inspection_detail(
+            t_lo=t_inspect0, t_hi=t_inspect1
+        )
+        result["detail"]["inspection"] = inspection
+        write_inspect_out(getattr(args, "inspect_out", None), inspection)
         print(json.dumps(result))
         return 0 if result["detail"]["ok"] else 1
     finally:
+        try:
+            from tidb_tpu.obs.tsdb import SAMPLER as _S
+
+            _S.stop()  # idempotent; error paths must not leak the thread
+        except Exception:
+            pass
         if server is not None:
             try:
                 server.shutdown()

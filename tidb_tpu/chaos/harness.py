@@ -73,6 +73,12 @@ class ChaosReport:
         self.faults: Dict[str, int] = {}
         self.violations: List[str] = []
         self.recovery_wall_s: List[float] = []
+        #: per-episode evidence windows for the inspection engine:
+        #: (episode index, fault classes, t0, t1) — the tsdb sampler
+        #: brackets every episode, so a window's counter movement is
+        #: attributable to ITS faults (obs/inspection.py
+        #: match_chaos_findings reads these)
+        self.windows: List[tuple] = []
 
     def _pct(self, q: float) -> float:
         if not self.recovery_wall_s:
@@ -173,6 +179,9 @@ class ChaosHarness:
             prober=FailedEngineProber(initial_backoff_s=0.05),
             admission=self.admission,
         )
+        #: (wall_t0, wall_t1) of the most recent episode — the
+        #: inspection evidence window it must overlap
+        self.last_window = (0.0, 0.0)
 
     def close(self) -> None:
         from tidb_tpu.utils import failpoint
@@ -247,15 +256,40 @@ class ChaosHarness:
     def run_episode(self, ep: "_schedule.Episode"):
         """Arm the episode's faults, run its query through admission +
         the fleet, disarm, audit. Returns (violations, wall_seconds);
-        an empty violation list is a clean episode."""
+        an empty violation list is a clean episode.
+
+        The metric time-series store (obs/tsdb.py) samples the fleet
+        registry immediately before and after the episode, and a
+        heartbeat beat runs WHILE the faults are armed (so handshake
+        telemetry — clock offsets under the clock-skew class — is
+        observed inside the window): every injected fault class can
+        then surface as an inspection finding whose evidence window
+        overlaps [wall_t0, wall_t1], the PR 12 acceptance bar."""
         from tidb_tpu.chaos.schedule import arm_spec, disarm
+        from tidb_tpu.obs.tsdb import TSDB
 
         _c_episodes().inc()
         violations: List[str] = []
         note = f"seed={self.seed} episode={ep.index}"
         for f in ep.faults:
             _c_faults().labels(cls=f.cls).inc()
+        try:
+            # refresh handshake telemetry CLEAN before the baseline
+            # sample: a previous episode's skewed clock offset must
+            # not bleed into this window's evidence
+            self.sched.heartbeat.beat_once()
+        except Exception:
+            pass
+        wall_t0 = time.time()
+        TSDB.sample_registry(now=wall_t0)
         armed = arm_spec(ep.faults)
+        try:
+            # handshake telemetry under the armed faults (fresh pings
+            # dial fresh connections, so engine/clock-skew lands in
+            # the offset gauge the clock-skew inspection rule reads)
+            self.sched.heartbeat.beat_once()
+        except Exception:
+            pass
         t0 = time.perf_counter()
         try:
             ticket = self.admission.admit(None)
@@ -296,7 +330,19 @@ class ChaosHarness:
         violations.extend(self.check_invariants(note))
         for _ in violations:
             _c_violations().inc()
+        wall_t1 = time.time()
+        TSDB.sample_registry(now=wall_t1)
+        self.last_window = (wall_t0, wall_t1)
         return violations, wall
+
+    def baseline_episode(self):
+        """One fault-free episode — the false-positive guard's
+        calibration run (bench --chaos exits nonzero when the
+        inspection engine reports a CRITICAL finding over a window in
+        which nothing was injected). Returns (violations, (t0, t1))."""
+        ep = _schedule.Episode(index=-1, query=0, faults=())
+        violations, _wall = self.run_episode(ep)
+        return violations, self.last_window
 
     def run(
         self,
@@ -315,4 +361,8 @@ class ChaosHarness:
             report.episodes += 1
             report.recovery_wall_s.append(wall)
             report.violations.extend(violations)
+            report.windows.append(
+                (ep.index, tuple(f.cls for f in ep.faults),
+                 self.last_window[0], self.last_window[1])
+            )
         return report

@@ -427,6 +427,16 @@ def _g_link_heartbeat_age():
     )
 
 
+def _g_link_clock_offset():
+    return REGISTRY.gauge(
+        "tidbtpu_link_clock_offset_seconds",
+        "handshake-sampled host clock minus coordinator clock (RTT/2 "
+        "anchor) per control link — the inspection engine's clock-skew "
+        "signal",
+        labels=("host",),
+    )
+
+
 class LinkRegistry:
     """Coordinator-side aggregation of per-peer link health.
 
@@ -463,6 +473,9 @@ class LinkRegistry:
                 _g_link_rtt().labels(host=host).set(float(rtt_s))
             if offset_s is not None:
                 ent["offset_s"] = float(offset_s)
+                _g_link_clock_offset().labels(host=host).set(
+                    float(offset_s)
+                )
             ent["last_seen"] = now
             ent["alive"] = True
         # a fresh handshake IS a successful liveness observation

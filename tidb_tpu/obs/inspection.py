@@ -1,0 +1,724 @@
+"""Inspection engine: declared rules that turn the sampled metric
+history (obs/tsdb.py) into findings.
+
+Reference: pkg/executor/inspection_result.go — TiDB's inspection
+framework reads metrics_schema back through SQL and emits
+`information_schema.inspection_result` rows (rule, item, actual value
+vs reference, severity, actionable detail). Same shape here, over the
+in-process time-series store: ``run_inspection`` evaluates every
+declared rule against a time window and returns findings whose
+EVIDENCE WINDOW brackets the offending samples — a chaos episode's
+injected fault must surface as a finding overlapping the episode
+(tidb_tpu/chaos/harness.py is the acceptance test).
+
+``RULES`` is a DECLARED registry (the failpoint-SITES pattern): a rule
+names the metric families it reads and the flight PHASES it
+references; scripts/check_inspection_rules.py cross-checks every
+declaration against the check_metric_names vocabulary, the registered
+metric call sites (a rule reading a metric nothing registers is a dead
+declaration and fails the lint), and obs/flight.py PHASES. Evaluators
+may read ONLY their declared families — ``ctx`` enforces it at
+runtime, so the static contract cannot drift from the code.
+
+Severity ladder: ``info`` < ``warning`` < ``critical``. Thresholds are
+deliberately conservative constants (declared next to each rule):
+inspection exists to EXPLAIN incidents, and a rule that cries wolf on
+a healthy fleet is worse than none — bench --chaos guards exactly that
+(a critical finding with zero injected faults exits nonzero).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tidb_tpu.obs.tsdb import TSDB, TimeSeriesStore
+from tidb_tpu.utils import racecheck
+from tidb_tpu.utils.metrics import REGISTRY
+
+SEVERITIES = ("info", "warning", "critical")
+
+
+def _c_runs():
+    return REGISTRY.counter(
+        "tidbtpu_inspection_runs_total",
+        "inspection engine evaluations (information_schema."
+        "inspection_result reads, /inspection hits, bench stamps)",
+    )
+
+
+def _c_findings():
+    return REGISTRY.counter(
+        "tidbtpu_inspection_findings_total",
+        "findings emitted, by severity",
+        labels=("severity",),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    item: str          # the offending host / link / digest / ""
+    severity: str      # info | warning | critical
+    value: float       # the observed quantity
+    reference: str     # the threshold it tripped, human-readable
+    detail: str        # actionable explanation
+    t0: float          # evidence window: first offending sample
+    t1: float          # evidence window: last offending sample
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class InspectionRule:
+    name: str
+    metrics: tuple     # metric families the evaluator may read
+    phases: tuple      # flight PHASES the rule's semantics reference
+    fn: Callable       # ctx -> List[Finding]
+
+
+RULES: Dict[str, InspectionRule] = {}
+
+
+def rule(name: str, metrics, phases=()):
+    """Declare one inspection rule (decorator). The declaration — not
+    the evaluator body — is the lintable contract."""
+
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate inspection rule {name!r}")
+        if not metrics:
+            raise ValueError(
+                f"inspection rule {name!r} declares no metrics"
+            )
+        RULES[name] = InspectionRule(
+            name, tuple(metrics), tuple(phases), fn
+        )
+        return fn
+
+    return deco
+
+
+class Ctx:
+    """One evaluation's view over the store, restricted to the rule's
+    declared metric families."""
+
+    def __init__(self, store: TimeSeriesStore, allowed: tuple,
+                 t_lo: Optional[float], t_hi: Optional[float]):
+        self._store = store
+        self._allowed = frozenset(allowed)
+        self.t_lo = t_lo
+        self.t_hi = t_hi
+
+    def _check_allowed(self, metric: str) -> None:
+        if metric not in self._allowed:
+            raise ValueError(
+                f"rule read undeclared metric {metric!r} (declare it "
+                "in the @rule(metrics=...) tuple)"
+            )
+
+    def series(self, metric: str) -> Dict[tuple, List[tuple]]:
+        """(host, labelvalues) -> [(ts, value)] time-ascending, inside
+        the window. Undeclared reads raise — the runtime half of the
+        check_inspection_rules contract."""
+        self._check_allowed(metric)
+        out: Dict[tuple, List[tuple]] = {}
+        for t, host, lvalues, v, _res in self._store.query(
+            metric, t_lo=self.t_lo, t_hi=self.t_hi
+        ):
+            out.setdefault((host, lvalues), []).append((t, v))
+        return out
+
+    def increase(self, metric: str) -> Dict[tuple, Tuple[float, float,
+                                                         float]]:
+        """Per-series (in-window increase, t_evidence_start, t_last) —
+        the counter-rate primitive. The baseline is the last sample
+        BEFORE the window (a counter born inside the window counts its
+        whole cumulative value — the movement genuinely happened in
+        the window; a pre-existing counter's standing value does not).
+        Series that never moved are omitted."""
+        self._check_allowed(metric)
+        all_pts: Dict[tuple, List[tuple]] = {}
+        for t, host, lvalues, v, _res in self._store.query(metric):
+            all_pts.setdefault((host, lvalues), []).append((t, v))
+        out = {}
+        for key, pts in all_pts.items():
+            base = None  # last sample before the window
+            win: List[tuple] = []
+            for t, v in pts:
+                if self.t_lo is not None and t < self.t_lo:
+                    base = (t, v)
+                    continue
+                if self.t_hi is not None and t > self.t_hi:
+                    break
+                win.append((t, v))
+            if not win:
+                continue
+            if base is not None:
+                base_v = base[1]
+            elif len(win) >= 2:
+                # no pre-window sample but several in-window ones: the
+                # Prometheus increase() convention (first in-window
+                # sample is the baseline) — a flat long-lived counter
+                # whose history starts mid-window must not read as a
+                # storm
+                base, base_v = win[0], win[0][1]
+            else:
+                # a single sample and no history before it: the series
+                # was BORN inside the window (the sampler passes
+                # bracketing it never saw the name), so its cumulative
+                # value is in-window movement
+                base_v = 0.0
+            delta = win[-1][1] - base_v
+            if delta <= 0:
+                continue
+            # evidence starts at the last sample still at the
+            # pre-movement value
+            seq = ([base] if base is not None else []) + win
+            t_move = seq[0][0]
+            for (t_a, v_a), (_t_b, v_b) in zip(seq, seq[1:]):
+                if v_b > v_a:
+                    t_move = t_a
+                    break
+            out[key] = (delta, t_move, win[-1][0])
+        return out
+
+    def gauge_extremes(self, metric: str) -> Dict[tuple, Tuple[
+            float, float, float, float]]:
+        """Per-series (min, max, t_first, t_last) over the window."""
+        self._check_allowed(metric)
+        out = {}
+        for key, pts in self.series(metric).items():
+            vals = [v for _t, v in pts]
+            out[key] = (min(vals), max(vals), pts[0][0], pts[-1][0])
+        return out
+
+
+def _sum_increase(inc: dict) -> Tuple[float, float, float]:
+    """(total delta, earliest evidence, latest evidence) across all
+    series of one increase() result."""
+    if not inc:
+        return 0.0, 0.0, 0.0
+    total = sum(d for d, _t0, _t1 in inc.values())
+    t0 = min(t0 for _d, t0, _t1 in inc.values())
+    t1 = max(t1 for _d, _t0, t1 in inc.values())
+    return total, t0, t1
+
+
+# ---------------------------------------------------------------------------
+# the declared rules
+# ---------------------------------------------------------------------------
+
+#: heartbeat ages above this many seconds are a liveness gap on a
+#: loopback/test fleet (production cadences re-tune the sysvars; the
+#: rule reads the OBSERVED age, which scales with the real cadence)
+HEARTBEAT_GAP_S = 1.0
+#: fragment/stage retries per window: warning at the first retry,
+#: critical when the retry budget is clearly storming
+RETRY_WARN, RETRY_CRIT = 1, 8
+#: tunnel retransmits per window
+RETRANSMIT_WARN, RETRANSMIT_CRIT = 1, 64
+#: producer backpressure stall seconds per link per window
+STALL_WARN_S = 0.05
+#: mean admission queue wait per window
+QUEUE_WAIT_WARN_S = 0.5
+#: admission queue depth observed at any sample
+QUEUE_DEPTH_WARN = 4
+#: plan-cache misses outnumbering hits by this factor, with at least
+#: this many misses, is thrash; retraces alone trip on growth
+PLAN_CACHE_MIN_MISSES = 8
+RETRACE_WARN = 4
+#: absolute handshake-sampled clock offset
+CLOCK_SKEW_WARN_S, CLOCK_SKEW_CRIT_S = 0.25, 1.0
+
+
+@rule(
+    "heartbeat-gap",
+    metrics=(
+        "tidbtpu_link_heartbeat_age_seconds",
+        "tidbtpu_dcn_heartbeat_misses",
+    ),
+)
+def _r_heartbeat_gap(ctx) -> List[Finding]:
+    """A worker host stopped answering liveness pings: its heartbeat
+    age grew past the gap threshold, or misses accumulated."""
+    out = []
+    misses_inc = ctx.increase("tidbtpu_dcn_heartbeat_misses")
+    missed_hosts = {
+        (lv[0] if lv else h): d
+        for (h, lv), (d, _t0, _t1) in misses_inc.items()
+    }
+    for (host, lv), (lo, hi, t0, t1) in ctx.gauge_extremes(
+        "tidbtpu_link_heartbeat_age_seconds"
+    ).items():
+        if hi >= HEARTBEAT_GAP_S:
+            item = lv[0] if lv else host  # the gauge's host label
+            # escalate only on THIS host's evidence (repeated misses
+            # reaching quarantine territory) — a fleet-wide
+            # quarantined count would misattribute another host's
+            # death to a benign age blip here
+            sev = (
+                "critical"
+                if missed_hosts.get(str(item), 0) >= 2
+                else "warning"
+            )
+            out.append(Finding(
+                "heartbeat-gap", str(item), sev, round(hi, 3),
+                f"heartbeat age < {HEARTBEAT_GAP_S}s",
+                f"host {item} missed liveness pings (max age "
+                f"{hi:.2f}s); check the worker process and the "
+                "control link, then watch "
+                "tidbtpu_dcn_readmissions_total for recovery",
+                t0, t1,
+            ))
+    for (host, lvalues), (delta, t0, t1) in ctx.increase(
+        "tidbtpu_dcn_heartbeat_misses"
+    ).items():
+        item = lvalues[0] if lvalues else host
+        out.append(Finding(
+            "heartbeat-gap", str(item), "warning", delta,
+            "0 missed heartbeats",
+            f"{delta:.0f} heartbeat misses accumulated for {item}; "
+            "sustained misses quarantine the host "
+            "(tidb_tpu_heartbeat_miss_threshold)",
+            t0, t1,
+        ))
+    return out
+
+
+@rule(
+    "retry-storm",
+    metrics=(
+        "tidbtpu_dcn_retries",
+        "tidbtpu_shuffle_stage_retries",
+        "tidbtpu_dcn_retry_backoff_seconds",
+    ),
+)
+def _r_retry_storm(ctx) -> List[Finding]:
+    """Fragment re-dispatches / shuffle stage re-runs accumulated —
+    workers are dying, dropping replies, or timing out mid-stage."""
+    frag, f0, f1 = _sum_increase(ctx.increase("tidbtpu_dcn_retries"))
+    stage, s0, s1 = _sum_increase(
+        ctx.increase("tidbtpu_shuffle_stage_retries")
+    )
+    total = frag + stage
+    if total < RETRY_WARN:
+        return []
+    backoff, _b0, _b1 = _sum_increase(
+        ctx.increase("tidbtpu_dcn_retry_backoff_seconds")
+    )
+    t0 = min(t for t in (f0, s0) if t) if (frag and stage) else (
+        f0 or s0
+    )
+    t1 = max(f1, s1)
+    sev = "critical" if total >= RETRY_CRIT else "warning"
+    return [Finding(
+        "retry-storm", "fleet", sev, total,
+        f"< {RETRY_WARN} retries per window",
+        f"{frag:.0f} fragment re-dispatches + {stage:.0f} shuffle "
+        f"stage re-runs ({backoff:.2f}s spent in retry backoff); "
+        "check tidbtpu_dcn_quarantines{host} and the chaos/worker "
+        "logs for the dying host",
+        t0, t1,
+    )]
+
+
+@rule(
+    "tunnel-backpressure",
+    metrics=(
+        "tidbtpu_link_stall_seconds",
+        "tidbtpu_shuffle_tunnel_stalls",
+    ),
+    phases=("shuffle-push", "shuffle-wait"),
+)
+def _r_tunnel_backpressure(ctx) -> List[Finding]:
+    """Shuffle producers spent wall time blocked on a tunnel's
+    flow-control window — a slow or partitioned peer (the stall lands
+    in the statement's shuffle-push / shuffle-wait phases)."""
+    out = []
+    for (host, lvalues), (delta, t0, t1) in ctx.increase(
+        "tidbtpu_link_stall_seconds"
+    ).items():
+        if delta < STALL_WARN_S:
+            continue
+        link = "->".join(lvalues) if lvalues else host
+        out.append(Finding(
+            "tunnel-backpressure", link, "warning", round(delta, 4),
+            f"< {STALL_WARN_S}s stalled per window",
+            f"producers stalled {delta:.3f}s on tunnel {link} "
+            "backpressure; check the receiving peer's load and the "
+            "link's retransmits in cluster_links",
+            t0, t1,
+        ))
+    return out
+
+
+@rule(
+    "shuffle-retransmit-storm",
+    metrics=(
+        "tidbtpu_shuffle_retransmits",
+        "tidbtpu_link_retransmits_total",
+    ),
+)
+def _r_retransmit_storm(ctx) -> List[Finding]:
+    """Tunnel frames needed retransmission — lossy or flapping links
+    between workers (receiver dedupe keeps landing exactly-once; the
+    cost is wire bytes and producer wall)."""
+    worker, w0, w1 = _sum_increase(
+        ctx.increase("tidbtpu_shuffle_retransmits")
+    )
+    link, l0, l1 = _sum_increase(
+        ctx.increase("tidbtpu_link_retransmits_total")
+    )
+    total = max(worker, link)  # the link registry mirrors the worker
+    if total < RETRANSMIT_WARN:
+        return []
+    t0 = min(t for t in (w0, l0) if t) if (worker and link) else (
+        w0 or l0
+    )
+    t1 = max(w1, l1)
+    sev = "critical" if total >= RETRANSMIT_CRIT else "warning"
+    return [Finding(
+        "shuffle-retransmit-storm", "fleet", sev, total,
+        f"< {RETRANSMIT_WARN} retransmits per window",
+        f"{total:.0f} tunnel frames retransmitted; per-link counts "
+        "are in cluster_links (retransmits column) — a single noisy "
+        "link is a network problem, fleet-wide noise is a frame-drop "
+        "fault or overload",
+        t0, t1,
+    )]
+
+
+@rule(
+    "admission-starvation",
+    metrics=(
+        "tidbtpu_admission_queue_depth",
+        "tidbtpu_admission_queue_wait_seconds",
+        "tidbtpu_admission_outcomes_total",
+    ),
+    phases=("queue-wait",),
+)
+def _r_admission_starvation(ctx) -> List[Finding]:
+    """Queries queued for admission and the mean wait inflated past
+    the threshold (or the controller started rejecting/timing out) —
+    the fleet budget is undersized for the offered load. The wait
+    lands in statements' queue-wait phase."""
+    out = []
+    for (host, lv), (lo, hi, t0, t1) in ctx.gauge_extremes(
+        "tidbtpu_admission_queue_depth"
+    ).items():
+        if hi >= QUEUE_DEPTH_WARN:
+            out.append(Finding(
+                "admission-starvation", "queue", "warning", hi,
+                f"queue depth < {QUEUE_DEPTH_WARN}",
+                f"{hi:.0f} queries were queued for admission at one "
+                "sample; sustained depth means the fleet budget is "
+                "undersized for the offered load",
+                t0, t1,
+            ))
+    waits = ctx.series("tidbtpu_admission_queue_wait_seconds")
+    sums = {k: v for k, v in waits.items() if "sum" in k[1]}
+    counts = {k: v for k, v in waits.items() if "count" in k[1]}
+    for (host, lv), spts in sums.items():
+        cpts = counts.get((host, tuple(
+            "count" if x == "sum" else x for x in lv
+        )))
+        if not cpts or len(spts) < 2 or len(cpts) < 2:
+            continue
+        d_sum = spts[-1][1] - spts[0][1]
+        d_n = cpts[-1][1] - cpts[0][1]
+        if d_n <= 0:
+            continue
+        mean_wait = d_sum / d_n
+        if mean_wait >= QUEUE_WAIT_WARN_S:
+            out.append(Finding(
+                "admission-starvation", host, "warning",
+                round(mean_wait, 4),
+                f"mean queue wait < {QUEUE_WAIT_WARN_S}s",
+                f"admitted queries waited {mean_wait:.2f}s on average "
+                f"({d_n:.0f} waits); raise "
+                "tidb_tpu_admission_budget_bytes or shed load "
+                "(statements' queue-wait phase shows who paid)",
+                spts[0][0], spts[-1][0],
+            ))
+    for (host, lvalues), (delta, t0, t1) in ctx.increase(
+        "tidbtpu_admission_outcomes_total"
+    ).items():
+        if lvalues and lvalues[0] in ("reject", "timeout"):
+            out.append(Finding(
+                "admission-starvation", lvalues[0], "critical", delta,
+                "0 rejected/timed-out admissions",
+                f"{delta:.0f} queries were {lvalues[0]}ed by "
+                "admission; the fleet is shedding load — raise the "
+                "budget or the queue limit, or lower concurrency",
+                t0, t1,
+            ))
+    return out
+
+
+@rule(
+    "plan-cache-thrash",
+    metrics=(
+        "tidbtpu_executor_plan_cache_misses_total",
+        "tidbtpu_executor_plan_cache_hits_total",
+        "tidbtpu_engine_retraces",
+    ),
+    phases=("compile",),
+)
+def _r_plan_cache_thrash(ctx) -> List[Finding]:
+    """Compiled-plan cache misses dominate (every miss pays an XLA
+    trace in the compile phase) or retraces grew — shape churn is
+    defeating the cache."""
+    out = []
+    misses, m0, m1 = _sum_increase(
+        ctx.increase("tidbtpu_executor_plan_cache_misses_total")
+    )
+    hits, _h0, _h1 = _sum_increase(
+        ctx.increase("tidbtpu_executor_plan_cache_hits_total")
+    )
+    if misses >= PLAN_CACHE_MIN_MISSES and misses > hits:
+        out.append(Finding(
+            "plan-cache-thrash", "executor", "warning", misses,
+            f"misses <= hits (>= {PLAN_CACHE_MIN_MISSES} misses)",
+            f"{misses:.0f} plan-cache misses vs {hits:.0f} hits this "
+            "window; statements_summary's jit_compilations column "
+            "shows which digests churn shapes — widen capacity tiles "
+            "or raise tidb_prepared_plan_cache_size",
+            m0, m1,
+        ))
+    retr, r0, r1 = _sum_increase(ctx.increase("tidbtpu_engine_retraces"))
+    if retr >= RETRACE_WARN:
+        out.append(Finding(
+            "plan-cache-thrash", "engine", "warning", retr,
+            f"< {RETRACE_WARN} retraces per window",
+            f"{retr:.0f} jit retraces — input shapes drifted under "
+            "compiled plans; check capacity-tile policy "
+            "(tidb_tpu_min_tile) against the working row counts",
+            r0, r1,
+        ))
+    return out
+
+
+@rule(
+    "clock-skew",
+    metrics=("tidbtpu_link_clock_offset_seconds",),
+)
+def _r_clock_skew(ctx) -> List[Finding]:
+    """A worker's handshake-sampled wall clock diverged from the
+    coordinator's. Parity is unaffected (fences are id-based), but
+    timelines, stale reads and slow-log timestamps from that host are
+    shifted until NTP converges."""
+    out = []
+    for (host, lvalues), (lo, hi, t0, t1) in ctx.gauge_extremes(
+        "tidbtpu_link_clock_offset_seconds"
+    ).items():
+        worst = max(abs(lo), abs(hi))
+        if worst < CLOCK_SKEW_WARN_S:
+            continue
+        item = lvalues[0] if lvalues else host
+        sev = "critical" if worst >= CLOCK_SKEW_CRIT_S else "warning"
+        out.append(Finding(
+            "clock-skew", str(item), sev, round(worst, 4),
+            f"|offset| < {CLOCK_SKEW_WARN_S}s",
+            f"host {item} clock is {worst:.2f}s off the coordinator "
+            "(handshake RTT/2 anchor); telemetry from it is rebased, "
+            "but fix the host clock — skew this large usually means "
+            "a dead NTP daemon",
+            t0, t1,
+        ))
+    return out
+
+
+@rule(
+    "quarantine-flap",
+    metrics=(
+        "tidbtpu_dcn_quarantines",
+        "tidbtpu_dcn_readmissions_total",
+    ),
+)
+def _r_quarantine_flap(ctx) -> List[Finding]:
+    """A host cycled quarantine -> readmission inside one window: it
+    is neither dead nor healthy, and every flap re-runs its in-flight
+    fragments on the survivors."""
+    quar = ctx.increase("tidbtpu_dcn_quarantines")
+    readm = ctx.increase("tidbtpu_dcn_readmissions_total")
+    out = []
+    for (host, lvalues), (dq, q0, q1) in quar.items():
+        item = lvalues[0] if lvalues else host
+        match = next(
+            (v for (h2, lv2), v in readm.items()
+             if (lv2[0] if lv2 else h2) == item),
+            None,
+        )
+        if match is None:
+            continue
+        dr, r0, r1 = match
+        sev = "critical" if min(dq, dr) >= 2 else "warning"
+        out.append(Finding(
+            "quarantine-flap", str(item), sev, min(dq, dr),
+            "0 quarantine->readmission cycles per window",
+            f"host {item} was quarantined {dq:.0f}x and readmitted "
+            f"{dr:.0f}x in one window; a flapping host thrashes the "
+            "retry budget — hold it out (drain) until it is stable",
+            min(q0, r0), max(q1, r1),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class InspectionEngine:
+    """Evaluate every declared rule over a window of the store."""
+
+    def __init__(self, store: TimeSeriesStore = TSDB):
+        self.store = store
+        self._lock = racecheck.make_lock("obs.inspection")
+        self._last: List[Finding] = []
+        self._last_mono = 0.0
+        self._last_window = (None, None)
+
+    def run(
+        self,
+        t_lo: Optional[float] = None,
+        t_hi: Optional[float] = None,
+        rules=None,
+    ) -> List[Finding]:
+        """One evaluation pass; ``rules`` restricts to named rules
+        (None = all). Evaluator exceptions surface as a critical
+        finding on the rule itself rather than failing the read — a
+        diagnosis surface that crashes during an incident is useless."""
+        _c_runs().inc()
+        findings: List[Finding] = []
+        now = time.time()
+        for name in sorted(rules or RULES):
+            r = RULES.get(name)
+            if r is None:
+                raise ValueError(f"unknown inspection rule {name!r}")
+            ctx = Ctx(self.store, r.metrics, t_lo, t_hi)
+            try:
+                findings.extend(r.fn(ctx))
+            except Exception as e:
+                findings.append(Finding(
+                    name, "rule", "critical", 0.0, "rule evaluates",
+                    f"rule evaluator raised {type(e).__name__}: {e}",
+                    t_lo or now, t_hi or now,
+                ))
+        for f in findings:
+            _c_findings().labels(severity=f.severity).inc()
+        with self._lock:
+            self._last = list(findings)
+            self._last_mono = time.monotonic()
+            self._last_window = (t_lo, t_hi)
+        return findings
+
+    def run_cached(
+        self, t_lo=None, t_hi=None, max_age_s: float = 0.5
+    ) -> List[Finding]:
+        """run(), but reuse a just-computed result for the same window
+        — the virtual-table read path resolves inspection_result
+        several times per statement (plan build + execution), and
+        re-running the full engine per resolution quadruples the work
+        AND the tidbtpu_inspection_* self-metrics per SELECT."""
+        with self._lock:
+            if (
+                self._last_window == (t_lo, t_hi)
+                and time.monotonic() - self._last_mono < max_age_s
+            ):
+                return list(self._last)
+        return self.run(t_lo=t_lo, t_hi=t_hi)
+
+    def last(self) -> List[Finding]:
+        with self._lock:
+            return list(self._last)
+
+
+INSPECTION = InspectionEngine()
+
+
+def run_inspection(t_lo=None, t_hi=None, rules=None) -> List[Finding]:
+    return INSPECTION.run(t_lo=t_lo, t_hi=t_hi, rules=rules)
+
+
+def write_inspect_out(path, detail: dict) -> None:
+    """The --inspect-out artifact writer, shared by bench.py's chaos
+    path and the serve-load driver so the file format cannot
+    diverge."""
+    if not path:
+        return
+    import json
+
+    with open(path, "w") as f:
+        json.dump(detail, f, indent=1)
+
+
+def inspection_detail(t_lo=None, t_hi=None, windows=None) -> dict:
+    """One inspection run shaped for bench stamps (detail.inspection /
+    --inspect-out): findings, a severity census, and the chaos
+    harness's per-episode evidence windows when given."""
+    findings = run_inspection(t_lo=t_lo, t_hi=t_hi)
+    by_severity: Dict[str, int] = {}
+    for f in findings:
+        by_severity[f.severity] = by_severity.get(f.severity, 0) + 1
+    out = {
+        "findings": [f.to_dict() for f in findings],
+        "by_severity": by_severity,
+    }
+    if windows:
+        out["episode_windows"] = [
+            {"episode": i, "classes": list(cls), "t0": t0, "t1": t1}
+            for i, cls, t0, t1 in windows
+        ]
+    return out
+
+
+#: which rules a chaos fault class must surface as (ANY listed rule
+#: with an overlapping evidence window counts) — the harness's
+#: fault->finding acceptance map. Classes mapping to () inject pure
+#: latency/loss shapes whose retry budget may absorb them without a
+#: counter moving; they assert nothing.
+CHAOS_EXPECTATIONS: Dict[str, tuple] = {
+    "worker-crash": ("retry-storm", "shuffle-retransmit-storm"),
+    "worker-hang": (
+        "retry-storm", "tunnel-backpressure",
+        "shuffle-retransmit-storm",
+    ),
+    "frame-drop": ("shuffle-retransmit-storm", "retry-storm"),
+    "frame-delay": (),
+    "slow-peer": (),
+    "tunnel-partition": ("shuffle-retransmit-storm", "retry-storm"),
+    "clock-skew": ("clock-skew",),
+    "sample-loss": ("retry-storm", "shuffle-retransmit-storm"),
+    "interstage-crash": ("retry-storm", "shuffle-retransmit-storm"),
+}
+
+
+def match_chaos_findings(
+    fault_classes, findings: List[Finding],
+    window: Optional[Tuple[float, float]] = None,
+) -> Dict[str, bool]:
+    """fault class -> did a matching finding land (evidence window
+    overlapping ``window`` when given). Classes with no declared
+    signature report True (nothing to assert)."""
+    out = {}
+    for cls in fault_classes:
+        expected = CHAOS_EXPECTATIONS.get(cls, ())
+        if not expected:
+            out[cls] = True
+            continue
+        hit = False
+        for f in findings:
+            if f.rule not in expected:
+                continue
+            if window is not None and (
+                f.t1 < window[0] or f.t0 > window[1]
+            ):
+                continue
+            hit = True
+            break
+        out[cls] = hit
+    return out

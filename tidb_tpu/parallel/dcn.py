@@ -1875,6 +1875,7 @@ class DCNFragmentScheduler:
         _c_shuffle_result_bytes().inc(nbytes)
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
+        self._merge_tsdb(resp, ep)
         self._note_timeline(
             resp, ep, qid=qid, unit=f"p{part}", attempt=attempt,
             t_dispatch0=t_dispatch0,
@@ -1925,6 +1926,25 @@ class DCNFragmentScheduler:
         self._merge_remote_spans(
             spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
         )
+
+    def _merge_tsdb(self, resp, ep) -> None:
+        """Fold one FENCED reply's piggybacked worker metric samples
+        into the coordinator time-series store (obs/tsdb.py), rebased
+        through this host's handshake clock offset. Behind the
+        exactly-once ledger fence like the counter deltas, so a
+        retried stage's sample batch lands at most once."""
+        rows = resp.get("tsdb")
+        if not rows:
+            return
+        from tidb_tpu.obs.tsdb import TSDB
+
+        try:
+            TSDB.merge_remote(
+                rows, host=ep.address,
+                offset_s=self._clock_offsets.get(ep.address),
+            )
+        except Exception:
+            pass  # telemetry must never fail the query
 
     def _note_timeline(
         self, resp, ep, qid=None, unit="", attempt=1, t_dispatch0=None,
@@ -2096,6 +2116,7 @@ class DCNFragmentScheduler:
         _c_bytes_staged().inc(nbytes)
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
+        self._merge_tsdb(resp, ep)
         self._note_timeline(
             resp, ep, qid=meta.get("qid"), unit=f"f{fid}",
             attempt=meta.get("attempt", 1), t_dispatch0=t_dispatch0,

@@ -63,7 +63,15 @@ def ping_endpoint(ep: "EngineEndpoint", timeout_s: float = 2.0) -> bool:
     """One liveness ping over the protocol's handshake frame. Shared by
     the quarantine prober (recovery detection) and the DCN scheduler's
     heartbeat (failure detection, parallel/dcn.py) so both sides of the
-    liveness state machine agree on what 'alive' means."""
+    liveness state machine agree on what 'alive' means.
+
+    Each successful ping additionally refreshes the link registry's
+    handshake telemetry (RTT + clock offset — so skew that develops
+    AFTER connect is observed at heartbeat cadence, the inspection
+    engine's clock-skew signal) and drains the worker's pending metric
+    samples (the ``tsdb_flush`` idle-flush: an idle worker's history
+    reaches the coordinator store without waiting for a dispatch).
+    Telemetry merge failures never fail the liveness verdict."""
     if inject("engine/probe-fail"):
         return False
     try:
@@ -73,8 +81,30 @@ def ping_endpoint(ep: "EngineEndpoint", timeout_s: float = 2.0) -> bool:
     except Exception:
         return False
     try:
-        resp = c._call({})  # handshake/ping frame
-        return bool(resp.get("ok"))
+        resp = c._call({"tsdb_flush": True})  # handshake/ping frame
+        ok = bool(resp.get("ok"))
+        if ok:
+            # two INDEPENDENT try blocks: the worker already drained
+            # its pending samples into this reply (at-most-once), so a
+            # link-registry hiccup must not also discard the batch
+            try:
+                from tidb_tpu.obs.flight import LINKS
+
+                LINKS.note_handshake(
+                    ep.address, c.clock_rtt_s, c.clock_offset_s
+                )
+            except Exception:
+                pass
+            try:
+                from tidb_tpu.obs.tsdb import TSDB
+
+                TSDB.merge_remote(
+                    resp.get("tsdb"), host=ep.address,
+                    offset_s=c.clock_offset_s,
+                )
+            except Exception:
+                pass
+        return ok
     except Exception:
         return False
     finally:

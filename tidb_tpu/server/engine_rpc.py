@@ -208,6 +208,19 @@ class EngineServer:
         self.ship_registry = ship_registry
         self._reg_lock = racecheck.make_lock("engine_rpc.registry")
         self._reg_snapshot: dict = {}
+        # worker-side metric time-series shipping (obs/tsdb.py): this
+        # process samples its OWN registry at a bounded cadence and
+        # the pending rows piggyback on the next ship_registry reply —
+        # or on a heartbeat ping (the idle-flush), so a worker with no
+        # dispatches in flight still reports history. Same at-most-
+        # once contract as the counter deltas: the buffer drains into
+        # exactly one reply; a reply lost in transit (or fenced as a
+        # late duplicate) drops its samples.
+        self._tsdb_pending: list = []
+        self._tsdb_last = 0.0
+        #: min seconds between worker-side sample passes (bounds the
+        #: piggyback overhead under rapid dispatch streams)
+        self.tsdb_min_interval_s = 1.0
         # worker-to-worker shuffle service: the store this server's
         # shuffle_push frames land in plus the task runner
         # (parallel/shuffle.py); built lazily so plain engine servers
@@ -313,21 +326,34 @@ class EngineServer:
                             # spans onto the coordinator timeline
                             from tidb_tpu.utils.failpoint import inject
 
-                            resp = json.dumps(
-                                {
-                                    "id": req_id, "ok": True,
-                                    "wire": wire.WIRE_VERSION,
-                                    # engine/clock-skew: the chaos
-                                    # harness shifts this host's
-                                    # advertised clock so the offset
-                                    # estimator and span/timeline
-                                    # rebasing run under skew
-                                    "ts": _time.time() + float(
-                                        inject("engine/clock-skew", 0)
-                                        or 0
-                                    ),
-                                }
-                            ).encode()
+                            ping = {
+                                "id": req_id, "ok": True,
+                                "wire": wire.WIRE_VERSION,
+                                # engine/clock-skew: the chaos
+                                # harness shifts this host's
+                                # advertised clock so the offset
+                                # estimator and span/timeline
+                                # rebasing run under skew
+                                "ts": _time.time() + float(
+                                    inject("engine/clock-skew", 0)
+                                    or 0
+                                ),
+                            }
+                            if outer.ship_registry and req.get(
+                                "tsdb_flush"
+                            ):
+                                # idle-flush: a worker with nothing
+                                # dispatched still ships its sampled
+                                # history on the heartbeat cadence.
+                                # Only EXPLICIT flush pings drain the
+                                # buffer — every fresh connection
+                                # handshakes with this frame shape and
+                                # discards the reply, which would
+                                # silently eat the pending samples
+                                tsdb_rows = outer._tsdb_ship()
+                                if tsdb_rows:
+                                    ping["tsdb"] = tsdb_rows
+                            resp = json.dumps(ping).encode()
                         else:
                             resp = outer._execute(executor, req)
                     except DropConnection:
@@ -526,6 +552,9 @@ class EngineServer:
                 # ledger fence (at-most-once: a lost/fenced reply drops
                 # its delta — see utils/metrics.py fleet-merge notes)
                 resp["registry"] = self._registry_delta()
+                tsdb_rows = self._tsdb_ship()
+                if tsdb_rows:
+                    resp["tsdb"] = tsdb_rows
         return json.dumps(resp).encode()
 
     # -- worker-to-worker shuffle (parallel/shuffle.py) -----------------
@@ -722,6 +751,9 @@ class EngineServer:
             resp["trace_t0"] = tracer.wall_t0
         if self.ship_registry:
             resp["registry"] = self._registry_delta()
+            tsdb_rows = self._tsdb_ship()
+            if tsdb_rows:
+                resp["tsdb"] = tsdb_rows
         return json.dumps(resp).encode()
 
     def _shuffle_sample(self, req) -> bytes:
@@ -817,6 +849,31 @@ class EngineServer:
         with self._reg_lock:
             delta, self._reg_snapshot = counter_delta(self._reg_snapshot)
         return delta
+
+    def _tsdb_ship(self):
+        """Sample this process's registry (bounded cadence) and drain
+        the pending rows into ONE reply: ``[name, [labelnames],
+        [labelvalues], ts, value, kind]`` in this worker's wall clock
+        (the coordinator rebases through the handshake offset at
+        merge). Returns None when nothing is pending — idle pings stay
+        small."""
+        from tidb_tpu.utils.metrics import sample_rows
+
+        now = _time.time()
+        with self._reg_lock:
+            if now - self._tsdb_last >= self.tsdb_min_interval_s:
+                self._tsdb_last = now
+                for name, ln, lv, value, kind in sample_rows():
+                    self._tsdb_pending.append(
+                        [name, list(ln), list(lv), now, value, kind]
+                    )
+                if len(self._tsdb_pending) > 8192:
+                    # bounded buffer: a coordinator that stopped
+                    # draining must not grow worker memory — oldest
+                    # samples drop first
+                    del self._tsdb_pending[:-8192]
+            out, self._tsdb_pending = self._tsdb_pending, []
+        return out or None
 
     def start_background(self) -> threading.Thread:
         th = threading.Thread(

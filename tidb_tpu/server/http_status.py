@@ -1,5 +1,5 @@
 """HTTP status API: /status, /metrics, /schema, /settings, /dcn,
-/links, /timeline.
+/links, /timeline, /tsdb, /inspection.
 
 `/links` (PR 6) serves the per-peer DCN link health registry
 (obs/flight.py LINKS): handshake RTT, heartbeat age, and tunnel
@@ -9,6 +9,15 @@ bytes/stall seconds/retransmits per link.
 GET /timeline dumps the captured Chrome trace-event JSON (save it,
 open in Perfetto / chrome://tracing); /timeline/start and
 /timeline/stop arm/disarm the bounded capture ring on demand.
+
+`/tsdb` (PR 12) introspects the metric time-series store
+(obs/tsdb.py): the sampled family vocabulary + ring occupancy, or —
+with ``?metric=<family>[&since=<epoch>]`` — the stored points of one
+family. `/inspection` (PR 12) runs the declared-rule diagnosis engine
+(obs/inspection.py) over the retained history and returns the
+findings; ``?since=<epoch>`` bounds the evaluation window. Both are
+the HTTP twins of metrics_schema.<family> and
+information_schema.inspection_result.
 
 Reference: pkg/server/http_status.go — the side port serving liveness
 (`/status`), Prometheus metrics (`/metrics`), schema introspection
@@ -117,6 +126,54 @@ class StatusServer:
                                 "events": len(TIMELINE),
                             }
                         ))
+                    elif path == "/tsdb":
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.obs.tsdb import TSDB
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        metric = qs.get("metric", [None])[0]
+                        since = qs.get("since", [None])[0]
+                        if metric:
+                            pts = TSDB.query(
+                                metric,
+                                t_lo=float(since) if since else None,
+                            )
+                            self._send(200, json.dumps({
+                                "metric": metric,
+                                "points": [
+                                    {"time": t, "instance": h,
+                                     "labels": list(lv), "value": v,
+                                     "res": res}
+                                    for t, h, lv, v, res in pts
+                                ],
+                            }))
+                        else:
+                            self._send(200, json.dumps({
+                                "families": {
+                                    name: {"kind": k,
+                                           "labels": list(ln)}
+                                    for name, (k, ln)
+                                    in sorted(TSDB.families().items())
+                                },
+                                "series": TSDB.series_count(),
+                                "points": TSDB.point_count(),
+                            }))
+                    elif path == "/inspection":
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.obs.inspection import (
+                            run_inspection,
+                        )
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        since = qs.get("since", [None])[0]
+                        findings = run_inspection(
+                            t_lo=float(since) if since else None
+                        )
+                        self._send(200, json.dumps({
+                            "findings": [f.to_dict() for f in findings],
+                        }))
                     elif path == "/metrics":
                         from tidb_tpu.utils.metrics import REGISTRY
 

@@ -285,14 +285,16 @@ class Session(DDLMixin):
         # AS OF TIMESTAMP on the table ref, else tidb_read_staleness on
         # read-only autocommit statements
         as_of_ts = self._stmt_as_of.get(key)
-        if db.lower() == "information_schema":
+        if db.lower() in ("information_schema", "metrics_schema"):
             # virtual diagnostic tables are rebuilt fresh per access —
             # staleness would resolve them to their empty version-0
-            # state (the reference never applies staleness to memtables)
+            # state (the reference never applies staleness to
+            # memtables; metrics_schema history is time-addressed
+            # through its OWN time column, not MVCC)
             if as_of_ts is not None:
                 raise ValueError(
-                    "AS OF TIMESTAMP is not supported on "
-                    "information_schema tables"
+                    f"AS OF TIMESTAMP is not supported on "
+                    f"{db.lower()} tables"
                 )
             return t, t.version
         clamp = False
@@ -2781,7 +2783,9 @@ class Session(DDLMixin):
             r = Result([], [])
         elif isinstance(s, ast.UseDatabase):
             dbl = s.name.lower()
-            if dbl != "information_schema" and dbl not in [
+            if dbl not in (
+                "information_schema", "metrics_schema"
+            ) and dbl not in [
                 d.lower() for d in self.catalog.databases()
             ]:
                 raise ValueError(f"unknown database {s.name}")
@@ -2970,6 +2974,56 @@ class Session(DDLMixin):
                                     "tidb_tpu_heartbeat_miss_threshold"
                                 )),
                             )
+                if s.name.lower().startswith("tidb_tpu_tsdb_") and \
+                        s.scope == "global":
+                    # live re-tune of the metric time-series tier
+                    # (obs/tsdb.py): the sampler cadence (0 stops the
+                    # background thread; statement-close passive ticks
+                    # remain) and the retention/downsample ring caps.
+                    # GLOBAL scope like the heartbeat knobs — one
+                    # store serves every session
+                    from tidb_tpu.obs.tsdb import SAMPLER, TSDB
+                    from tidb_tpu.utils.sysvar import SysVars
+
+                    gv = SysVars(self.catalog.global_sysvars)
+                    if s.name.lower() == "tidb_tpu_tsdb_sample_interval_s":
+                        SAMPLER.retune(float(
+                            gv.get("tidb_tpu_tsdb_sample_interval_s")
+                        ))
+                    else:
+                        TSDB.retune_retention(
+                            retention_points=int(gv.get(
+                                "tidb_tpu_tsdb_retention_points"
+                            )),
+                            downsample_every=int(gv.get(
+                                "tidb_tpu_tsdb_downsample_every"
+                            )),
+                        )
+                if s.name.lower() in (
+                    "tidb_stmt_summary_refresh_interval",
+                    "tidb_stmt_summary_history_size",
+                ):
+                    # upgrade the compat knobs to live behavior: the
+                    # statements_summary history store rotates on the
+                    # refresh interval and keeps history_size windows
+                    from tidb_tpu.utils.metrics import STMT_HISTORY
+
+                    try:
+                        if s.name.lower().endswith("refresh_interval"):
+                            STMT_HISTORY.refresh_interval_s = max(
+                                float(self.vars.get(
+                                    "tidb_stmt_summary_refresh_interval"
+                                )), 0.001,
+                            )
+                        else:
+                            STMT_HISTORY.set_capacity(int(
+                                self.vars.get(
+                                    "tidb_stmt_summary_history_size"
+                                )
+                            ))
+                    except (TypeError, ValueError):
+                        pass  # compat knobs accept any value; only
+                        # numeric ones re-tune the store
                 if s.name.lower() == "tidb_gc_life_time":
                     # side effect: the storage GC horizon is engine-wide.
                     # The sysvar is GLOBAL-only (set() above enforces
@@ -3048,6 +3102,16 @@ class Session(DDLMixin):
         flight = FLIGHT.finish(elapsed_s)
         digest = sql_digest(sql)  # computed ONCE for both stores
         STMT_SUMMARY.record(sql, elapsed_s, flight=flight, digest=digest)
+        # metric time-series tier: passive tick — with no background
+        # sampler armed, history still accretes at statement cadence
+        # (bounded by the sampler's passive interval; a no-op when the
+        # tidb_tpu_tsdb_sample_interval_s thread owns the cadence)
+        from tidb_tpu.obs.tsdb import SAMPLER
+
+        try:
+            SAMPLER.maybe_sample()
+        except Exception:
+            pass  # sampling must never fail the statement
         # slow log: threshold from the sysvar registry (no hardcoded
         # fallback — SYSVAR_DEFS owns the default), gated on the
         # slow_query_log on/off switch like the reference
@@ -3838,12 +3902,100 @@ class Session(DDLMixin):
         ).inc()
         return s
 
+    def _metrics_scan_hint(self, s):
+        """Time/label predicate pushdown for metrics_schema scans
+        (reference: metrics_schema tables push their time range into
+        the Prometheus query — pkg/infoschema/metrics_schema.go). For
+        the single-table shape, WHERE conjuncts of the form
+        ``time >= / > / <= / < <num>`` and ``<label> = '<lit>'``
+        become a tsdb scan hint so the virtual table materializes only
+        the covered slice of each retention ring; every predicate is
+        STILL evaluated by the executor (the hint is a superset scan,
+        never the filter itself), so unpushable conjuncts stay exact.
+        Returns (metric, t_lo, t_hi, labels) or None."""
+        if not isinstance(s, ast.Select):
+            return None
+        f = s.from_
+        if not isinstance(f, ast.TableRef):
+            return None
+        if (f.db or self.db).lower() != "metrics_schema":
+            return None
+        # the hint is thread-wide for the statement's whole build +
+        # execute window: if ANY other reference to a metrics_schema
+        # table exists (scalar subquery, IN-subquery), an unbounded
+        # inner scan of the SAME family would silently inherit the
+        # outer bounds — push down only on the strictly single-
+        # reference shape
+        refs = [
+            r for r in ast.iter_table_refs(s)
+            if (r.db or self.db).lower() == "metrics_schema"
+        ]
+        if len(refs) != 1:
+            return None
+        metric = f.name.lower()
+        t_lo = t_hi = None
+        labels = {}
+
+        def conjuncts(e):
+            if isinstance(e, ast.Call) and e.op == "and":
+                for a in e.args:
+                    yield from conjuncts(a)
+            elif e is not None:
+                yield e
+
+        for c in conjuncts(s.where):
+            if not (
+                isinstance(c, ast.Call) and len(c.args) == 2
+                and c.op in ("ge", "gt", "le", "lt", "eq")
+            ):
+                continue
+            lhs, rhs = c.args
+            op = c.op
+            if isinstance(rhs, ast.Name) and isinstance(lhs, ast.Const):
+                # normalize `lit op col` to `col op' lit`
+                lhs, rhs = rhs, lhs
+                op = {"ge": "le", "gt": "lt", "le": "ge",
+                      "lt": "gt", "eq": "eq"}[op]
+            if not (
+                isinstance(lhs, ast.Name) and isinstance(rhs, ast.Const)
+                and rhs.param_index is None
+            ):
+                continue
+            col = lhs.column.lower()
+            v = rhs.value
+            if col == "time" and isinstance(v, (int, float)):
+                if op in ("ge", "gt"):
+                    t_lo = float(v) if t_lo is None else max(
+                        t_lo, float(v)
+                    )
+                elif op in ("le", "lt"):
+                    t_hi = float(v) if t_hi is None else min(
+                        t_hi, float(v)
+                    )
+                elif op == "eq":
+                    t_lo = t_hi = float(v)
+            elif (
+                op == "eq" and isinstance(v, str)
+                and col not in ("time", "instance", "value", "res")
+            ):
+                labels[col] = v
+        if t_lo is None and t_hi is None and not labels:
+            return None
+        return metric, t_lo, t_hi, labels
+
     def _run_select(self, s, ctes=None) -> Result:
         if isinstance(s, ast.With) and s.recursive:
             return self._run_recursive_with(s, ctes)
         if isinstance(s, ast.Select) and s.from_ is None:
             return self._run_tableless(s)
         s = self._apply_binding(s)
+        # metrics_schema pushdown: park the scan hint on this thread
+        # around planning + execution (both resolve the virtual table)
+        mhint = self._metrics_scan_hint(s)
+        if mhint is not None:
+            from tidb_tpu.obs import tsdb as _tsdb
+
+            _tsdb.set_scan_hint(*mhint)
         # per-statement engine hints (session-scoped, reset after)
         old_stream = self.executor.stream_rows
         for name, args in getattr(s, "hints", ()) or ():
@@ -3901,6 +4053,10 @@ class Session(DDLMixin):
             return Result(names, rows, types=[c.type for c in plan.schema])
         finally:
             self.executor.stream_rows = old_stream
+            if mhint is not None:
+                from tidb_tpu.obs import tsdb as _tsdb
+
+                _tsdb.clear_scan_hint()
 
     #: schemas whose virtual tables reflect THIS process's state — a
     #: plan scanning them must never ship to the worker fleet

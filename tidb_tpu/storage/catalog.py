@@ -166,6 +166,8 @@ class Catalog:
     def table(self, db: str, name: str) -> Table:
         if db.lower() == "information_schema":
             return self._infoschema_table(name.lower())
+        if db.lower() == "metrics_schema":
+            return self._metrics_schema_table(name.lower())
         try:
             return self._dbs[db.lower()][name.lower()]
         except KeyError:
@@ -296,16 +298,18 @@ class Catalog:
     # reflect the live catalog)
     _IS_TABLES = (
         "tables", "columns", "schemata", "statistics", "slow_query",
-        "statements_summary", "metrics", "top_sql", "resource_groups",
-        "sequences", "memory_usage", "memory_usage_ops_history",
-        "tpu_engine", "cluster_links",
+        "statements_summary", "statements_summary_history", "metrics",
+        "top_sql", "resource_groups", "sequences", "memory_usage",
+        "memory_usage_ops_history", "tpu_engine", "cluster_links",
+        "inspection_result",
     )
 
     def _infoschema_table(self, name: str) -> Table:
         if name in (
-            "slow_query", "statements_summary", "metrics", "top_sql",
+            "slow_query", "statements_summary",
+            "statements_summary_history", "metrics", "top_sql",
             "resource_groups", "memory_usage", "memory_usage_ops_history",
-            "tpu_engine", "cluster_links",
+            "tpu_engine", "cluster_links", "inspection_result",
         ):
             # live diagnostic views: contents change per statement, so
             # memoizing would serve stale data — rebuilt per access
@@ -659,6 +663,58 @@ class Catalog:
                        e.get("compile_output_bytes", 0.0),
                        e["sample_text"])
                 )
+        elif name == "statements_summary_history":
+            # PR 12: windowed per-digest snapshots (reference:
+            # stmtsummary history read back as statements_summary_
+            # history) — the per-digest runtime TRAJECTORY the
+            # ROADMAP's adaptive-query-execution item seeds its
+            # learned cost model from. Evicted digests survive here:
+            # the live summary folds a victim's final aggregates into
+            # the next window (utils/metrics.py StmtHistory).
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.utils.metrics import STMT_HISTORY
+
+            schema = TableSchema(
+                [("summary_begin_time", FLOAT64),
+                 ("summary_end_time", FLOAT64),
+                 ("digest_text", STRING), ("exec_count", INT64),
+                 ("sum_latency", FLOAT64), ("max_latency", FLOAT64),
+                 ("p50_latency", FLOAT64), ("p95_latency", FLOAT64),
+                 ("p99_latency", FLOAT64), ("plan_digest", STRING),
+                 ("rows_sent", INT64),
+                 ("device_mem_peak_bytes", INT64),
+                 ("sample_text", STRING)]
+            )
+            rows = [
+                (b, e, r["digest_text"], r["exec_count"],
+                 r["sum_latency"], r["max_latency"], r["p50_latency"],
+                 r["p95_latency"], r["p99_latency"], r["plan_digest"],
+                 r["rows_sent"], r["device_mem_peak_bytes"],
+                 r["sample_text"])
+                for b, e, r in STMT_HISTORY.rows()
+            ]
+        elif name == "inspection_result":
+            # PR 12: the declared-rule diagnosis engine
+            # (obs/inspection.py; reference: pkg/executor/
+            # inspection_result.go) evaluated over the FULL retained
+            # history at read time — SELECTing this table IS the
+            # inspection run, exactly like the reference
+            from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.obs.inspection import INSPECTION
+
+            schema = TableSchema(
+                [("rule", STRING), ("item", STRING),
+                 ("severity", STRING), ("value", FLOAT64),
+                 ("reference", STRING), ("details", STRING),
+                 ("start_time", FLOAT64), ("end_time", FLOAT64)]
+            )
+            # run_cached: one SELECT resolves this table several times
+            # (plan build + execution) — one engine run serves them all
+            rows = [
+                (f.rule, f.item, f.severity, f.value, f.reference,
+                 f.detail, f.t0, f.t1)
+                for f in INSPECTION.run_cached()
+            ]
         elif name == "cluster_links":
             # PR 6: per-peer DCN link health (obs/flight.py LINKS) —
             # control links carry the handshake RTT/clock offset and
@@ -803,7 +859,66 @@ class Catalog:
             t.append_rows(rows)
         return t
 
+    # -- metrics_schema virtual tables -------------------------------------
+    # (reference: pkg/infoschema/metrics_schema.go — one table per
+    # metric expression over Prometheus history; here one table per
+    # sampled tidbtpu_* metric family over the in-process time-series
+    # store, obs/tsdb.py). Rebuilt per access like the live diagnostic
+    # views; the session's WHERE-conjunct scan hint pushes time/label
+    # bounds into the store so only the covered slice materializes.
+
+    def _metrics_schema_table(self, name: str) -> Table:
+        from tidb_tpu.dtypes import FLOAT64, STRING
+        from tidb_tpu.obs import tsdb as _tsdb
+
+        fam = _tsdb.TSDB.family(name)
+        if fam is None:
+            known = sorted(_tsdb.TSDB.families())
+            hint = (
+                f"; sampled families: {', '.join(known[:8])}..."
+                if known else
+                " (no samples stored yet — arm "
+                "tidb_tpu_tsdb_sample_interval_s or run statements)"
+            )
+            raise ValueError(
+                f"unknown table metrics_schema.{name}{hint}"
+            )
+        _kind, labelnames = fam
+        hint = _tsdb.scan_hint_for(name)
+        t_lo = t_hi = None
+        labels = None
+        if hint is not None:
+            t_lo, t_hi, labels = hint
+        # "instance" = the sampling process (coordinator / worker
+        # address), the reference's column name — which also keeps
+        # metric labels like {host=...} collision-free as their own
+        # columns; a label that still collides with a fixed column
+        # gets a label_ prefix rather than failing the table
+        fixed = {"time", "instance", "value", "res"}
+        schema = TableSchema(
+            [("time", FLOAT64), ("instance", STRING)]
+            + [
+                (ln if ln not in fixed else f"label_{ln}", STRING)
+                for ln in labelnames
+            ]
+            + [("value", FLOAT64), ("res", STRING)]
+        )
+        rows = [
+            (t, host) + tuple(lvalues) + (v, res)
+            for t, host, lvalues, v, res in _tsdb.TSDB.query(
+                name, t_lo=t_lo, t_hi=t_hi, labels=labels
+            )
+        ]
+        t = Table(name, schema)
+        if rows:
+            t.append_rows(rows)
+        return t
+
     def tables(self, db: str) -> List[str]:
+        if db.lower() == "metrics_schema":
+            from tidb_tpu.obs.tsdb import TSDB
+
+            return sorted(TSDB.families())
         return sorted(self._dbs.get(db.lower(), {}))
 
     def databases(self) -> List[str]:
@@ -812,4 +927,8 @@ class Catalog:
     def has_table(self, db: str, name: str) -> bool:
         if db.lower() == "information_schema":
             return name.lower() in self._IS_TABLES
+        if db.lower() == "metrics_schema":
+            from tidb_tpu.obs.tsdb import TSDB
+
+            return TSDB.family(name.lower()) is not None
         return name.lower() in self._dbs.get(db.lower(), {})

@@ -329,6 +329,43 @@ REGISTRY = Registry()
 # UNDER-count around worker deaths/retries; they never over-count.
 
 
+def sample_rows(registry: Registry = REGISTRY) -> List[tuple]:
+    """One full-registry sample for the time-series store (obs/tsdb.py):
+    ``(name, labelnames, labelvalues, value, kind)`` per counter/gauge
+    child. Histograms sample as TWO cumulative series distinguished by
+    an appended ``stat`` label (``count`` and ``sum``) — the same
+    decomposition Prometheus scrapes, so rate/mean math over the stored
+    history works without per-bucket storage."""
+    with registry._lock:
+        items = sorted(registry._metrics.items())
+    out: List[tuple] = []
+
+    def one(name, lnames, lvalues, m):
+        if isinstance(m, Histogram):
+            hl = tuple(lnames) + ("stat",)
+            out.append(
+                (name, hl, tuple(lvalues) + ("count",),
+                 float(m.total), "histogram")
+            )
+            out.append(
+                (name, hl, tuple(lvalues) + ("sum",),
+                 float(m.sum), "histogram")
+            )
+        else:
+            out.append(
+                (name, tuple(lnames), tuple(lvalues),
+                 float(m.value), m.kind)
+            )
+
+    for name, m in items:
+        if isinstance(m, MetricFamily):
+            for values, child in m.children():
+                one(name, m.labelnames, values, child)
+        else:
+            one(name, (), (), m)
+    return out
+
+
 def counter_snapshot(registry: Registry = REGISTRY) -> Dict[tuple, float]:
     """(name, labelnames, labelvalues) -> value for every counter."""
     with registry._lock:
@@ -567,6 +604,37 @@ class _StmtEntry:
         )
 
 
+def _entry_dict(digest: str, e: "_StmtEntry") -> dict:
+    """One digest's full statements_summary row as a plain dict —
+    shared by rows_full() and the eviction snapshot the history store
+    keeps (an evicted digest's aggregates must survive into
+    statements_summary_history or the AQE feedback loop loses exactly
+    the digests that churned out of the live map)."""
+    return {
+        "digest_text": digest,
+        "exec_count": e.n,
+        "sum_latency": e.sum_s,
+        "max_latency": e.max_s,
+        "p50_latency": e.hist.quantile(0.50),
+        "p95_latency": e.hist.quantile(0.95),
+        "p99_latency": e.hist.quantile(0.99),
+        "plan_digest": e.plan_digest,
+        "phases": {p: list(v) for p, v in e.phases.items()},
+        "rows_sent": e.rows_sent,
+        "plan_cache_hits": e.plan_cache_hits,
+        "plan_cache_misses": e.plan_cache_misses,
+        "jit_compilations": e.jit_compilations,
+        "retraces": e.retraces,
+        "h2d_bytes": e.h2d_bytes,
+        "d2h_bytes": e.d2h_bytes,
+        "device_mem_peak_bytes": e.device_mem_peak_bytes,
+        "compile_flops": e.compile_flops,
+        "compile_bytes_accessed": e.compile_bytes_accessed,
+        "compile_output_bytes": e.compile_output_bytes,
+        "sample_text": e.sample,
+    }
+
+
 class StmtSummary:
     """Per-digest aggregated statement stats (reference:
     statement_summary.go:73). ``record`` optionally takes the finished
@@ -577,6 +645,9 @@ class StmtSummary:
         self._capacity = capacity
         self._map: Dict[str, _StmtEntry] = {}
         self._lock = racecheck.make_lock("metrics.stmt_summary")
+        #: optional StmtHistory absorbing evicted digests (wired to the
+        #: module global below; separable for tests)
+        self.history: Optional["StmtHistory"] = None
 
     def record(
         self, sql: str, seconds: float, flight=None,
@@ -585,12 +656,18 @@ class StmtSummary:
         # callers that already digested the text pass it in (the slow
         # log shares one digest with the summary per statement)
         d = digest if digest is not None else sql_digest(sql)
+        evicted = None
         with self._lock:
             ent = self._map.get(d)
             if ent is None:
                 if len(self._map) >= self._capacity:
                     # evict the least-executed digest
                     victim = min(self._map, key=lambda k: self._map[k].n)
+                    # snapshot the victim BEFORE it is forgotten; the
+                    # history append runs after this lock releases
+                    # (stmt_summary -> stmt_history is the declared
+                    # order — rotate() reads the summary lock first)
+                    evicted = _entry_dict(victim, self._map[victim])
                     del self._map[victim]
                 ent = self._map[d] = _StmtEntry(sql[:256])
             ent.n += 1
@@ -599,6 +676,8 @@ class StmtSummary:
             ent.hist.observe(seconds)
             if flight is not None:
                 ent.absorb_flight(flight)
+        if evicted is not None and self.history is not None:
+            self.history.absorb_evicted(evicted)
 
     def rows(self) -> List[Tuple[str, int, float, float, str]]:
         """The pre-PR 6 contract: (digest, count, sum, max, sample) —
@@ -616,37 +695,9 @@ class StmtSummary:
         percentiles, mean per-phase seconds, plan-cache and engine
         columns."""
         with self._lock:
-            items = sorted(self._map.items())
-            out = []
-            for d, e in items:
-                out.append(
-                    {
-                        "digest_text": d,
-                        "exec_count": e.n,
-                        "sum_latency": e.sum_s,
-                        "max_latency": e.max_s,
-                        "p50_latency": e.hist.quantile(0.50),
-                        "p95_latency": e.hist.quantile(0.95),
-                        "p99_latency": e.hist.quantile(0.99),
-                        "plan_digest": e.plan_digest,
-                        "phases": {
-                            p: list(v) for p, v in e.phases.items()
-                        },
-                        "rows_sent": e.rows_sent,
-                        "plan_cache_hits": e.plan_cache_hits,
-                        "plan_cache_misses": e.plan_cache_misses,
-                        "jit_compilations": e.jit_compilations,
-                        "retraces": e.retraces,
-                        "h2d_bytes": e.h2d_bytes,
-                        "d2h_bytes": e.d2h_bytes,
-                        "device_mem_peak_bytes": e.device_mem_peak_bytes,
-                        "compile_flops": e.compile_flops,
-                        "compile_bytes_accessed": e.compile_bytes_accessed,
-                        "compile_output_bytes": e.compile_output_bytes,
-                        "sample_text": e.sample,
-                    }
-                )
-            return out
+            return [
+                _entry_dict(d, e) for d, e in sorted(self._map.items())
+            ]
 
     def reset(self) -> None:
         """Clear all digests (the statements_summary clear analog,
@@ -655,5 +706,110 @@ class StmtSummary:
             self._map.clear()
 
 
+class StmtHistory:
+    """Windowed statements_summary snapshots (reference:
+    stmtsummary's history ring — tidb_stmt_summary_refresh_interval
+    rotates the live map into a bounded window list read back as
+    information_schema.statements_summary_history). This is the AQE
+    prerequisite: per-digest runtime TRAJECTORIES, not just the
+    current aggregate, survive here — including digests the live
+    summary evicted (absorb_evicted folds the victim's final
+    aggregates into the window that closes next).
+
+    Rotation is driven by the tsdb sampler tick (obs/tsdb.py) and by
+    explicit rotate() calls; ``refresh_interval_s`` and the window
+    capacity are live-retuned by the session's SET hooks for the
+    tidb_stmt_summary_refresh_interval / _history_size sysvars."""
+
+    def __init__(self, max_windows: int = 24,
+                 refresh_interval_s: float = 1800.0):
+        self._lock = racecheck.make_lock("metrics.stmt_history")
+        #: closed windows, oldest first: (begin_ts, end_ts, [row dicts])
+        self._windows: "collections.deque" = collections.deque(
+            maxlen=max(int(max_windows), 1)
+        )
+        #: digests evicted from the live summary since the last rotate
+        self._pending_evicted: List[dict] = []
+        self._open_t0 = time.time()
+        self.refresh_interval_s = float(refresh_interval_s)
+
+    def set_capacity(self, n: int) -> None:
+        with self._lock:
+            self._windows = collections.deque(
+                self._windows, maxlen=max(int(n), 1)
+            )
+
+    def absorb_evicted(self, row: dict) -> None:
+        """A digest the live summary just evicted: its final
+        aggregates land in the window that closes next (bounded — a
+        capacity-thrashing workload must not grow this without limit;
+        beyond the cap the oldest pending eviction drops)."""
+        with self._lock:
+            self._pending_evicted.append(dict(row))
+            if len(self._pending_evicted) > 4096:
+                self._pending_evicted.pop(0)
+
+    def rotate(self, summary: "StmtSummary", now: Optional[float] = None
+               ) -> None:
+        """Close the open window: snapshot every live digest plus the
+        pending evictions. The summary is read BEFORE this store's
+        lock is taken — stmt_summary and stmt_history never nest."""
+        rows = summary.rows_full()
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            rows = rows + self._pending_evicted
+            self._pending_evicted = []
+            self._windows.append((self._open_t0, now, rows))
+            self._open_t0 = now
+
+    def maybe_rotate(self, summary: "StmtSummary",
+                     now: Optional[float] = None) -> bool:
+        """rotate() iff the refresh interval elapsed. The due-check
+        and the window append share one critical section (with the
+        summary snapshot speculatively pre-read outside it, keeping
+        the no-nesting lock contract): two statement-close ticks
+        racing past the interval must not both append — the loser's
+        window would span ~0s and duplicate every digest."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            if now - self._open_t0 < self.refresh_interval_s:
+                return False
+        rows = summary.rows_full()
+        with self._lock:
+            if now - self._open_t0 < self.refresh_interval_s:
+                return False  # another tick rotated meanwhile
+            rows = rows + self._pending_evicted
+            self._pending_evicted = []
+            self._windows.append((self._open_t0, now, rows))
+            self._open_t0 = now
+        return True
+
+    def rows(self) -> List[tuple]:
+        """(begin_ts, end_ts, row_dict) per digest per closed window,
+        oldest window first — the statements_summary_history virtual
+        table's source."""
+        with self._lock:
+            windows = list(self._windows)
+        return [
+            (b, e, dict(r)) for b, e, rows in windows for r in rows
+        ]
+
+    def windows_for(self, digest: str) -> int:
+        """How many closed windows contain this digest (tests; the
+        eviction-boundary retention assertion)."""
+        return sum(
+            1 for _b, _e, r in self.rows()
+            if r.get("digest_text") == digest
+        )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._windows.clear()
+            self._pending_evicted = []
+            self._open_t0 = time.time()
+
+
 SLOW_LOG = SlowLog()
 STMT_SUMMARY = StmtSummary()
+STMT_HISTORY = StmtHistory()
+STMT_SUMMARY.history = STMT_HISTORY

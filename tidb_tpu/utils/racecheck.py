@@ -99,10 +99,16 @@ LOCK_CLASSES: Dict[str, str] = {
     "metrics.slowlog": "slow-query ring buffer",
     "metrics.slowlog_file": "slow-query file sink appends",
     "metrics.stmt_summary": "per-digest statement aggregates",
+    "metrics.stmt_history": "closed statements_summary windows + "
+                            "pending evicted-digest snapshots",
     "engine_watch": "finished engine-watch records ring",
     "flight.ring": "finished query-flight ring",
     "flight.links": "per-peer DCN link health maps",
     "timeline.ring": "fleet timeline tracer's bounded event ring",
+    "obs.tsdb": "metric time-series retention rings + series map",
+    "obs.tsdb_sampler": "sampler cadence state (retune + last-sample "
+                        "stamp)",
+    "obs.inspection": "inspection engine's last-run findings cache",
     # utils
     "failpoint.registry": "armed failpoint actions",
     "failpoint.site": "one after_n() site's invocation counter",
@@ -123,6 +129,7 @@ THREAD_NAME_PREFIXES = frozenset({
     "http",
     "logbackup",
     "mysql",
+    "obs",
     "serve",
     "shuffle",
     "stats",

@@ -125,6 +125,29 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   _int_range(1, 100),
                   "consecutive missed heartbeats that quarantine a "
                   "worker host into the prober"),
+        # metric time-series tier (obs/tsdb.py — the metrics_schema
+        # retention store; a live SET re-tunes the running sampler and
+        # rings, session.py SetVariable hook). GLOBAL-only like the
+        # heartbeat knobs: one store serves every session.
+        SysVarDef("tidb_tpu_tsdb_sample_interval_s", 0.0, "global",
+                  _float_range(0.0, 3600.0),
+                  "background sampler cadence for the metric "
+                  "time-series store behind metrics_schema (0 = no "
+                  "thread; sampling rides statement close instead). "
+                  "While the fleet timeline is capturing, each tick "
+                  "also samples the counter tracks, so gaps between "
+                  "statements stop rendering flat"),
+        SysVarDef("tidb_tpu_tsdb_retention_points", 512, "global",
+                  _int_range(4, 1 << 20),
+                  "newest raw samples retained per metric series "
+                  "(per host x label set); older points downsample "
+                  "into a coarse ring of the same size before being "
+                  "dropped"),
+        SysVarDef("tidb_tpu_tsdb_downsample_every", 8, "global",
+                  _int_range(1, 4096),
+                  "raw points folded into one downsampled point when "
+                  "they age out of the raw retention ring (counters "
+                  "keep the last cumulative value, gauges the mean)"),
         SysVarDef("tidb_txn_mode", "pessimistic", "both",
                   _enum("pessimistic", "optimistic"),
                   "transaction mode: pessimistic takes blocking table "
