@@ -1765,6 +1765,13 @@ def main() -> int:
                     help="control connections per worker host")
     ap.add_argument("--serve-budget-mb", type=int, default=2048,
                     help="fleet device-memory admission budget (MiB)")
+    ap.add_argument("--write-mix", action="store_true",
+                    help="with --serve-load: a concurrent writer "
+                    "session streams INSERTs through the HTAP delta "
+                    "tier (read-your-writes verified per commit) while "
+                    "reader sessions run both freshness modes; stamps "
+                    "detail.delta (depth, per-host sync lag, "
+                    "read-your-writes vs bounded-staleness p99)")
     ap.add_argument("--serve-kill-worker", action="store_true",
                     default=True,
                     help="hard-kill one worker mid-load (default on; "

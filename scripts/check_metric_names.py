@@ -25,7 +25,9 @@ import os
 import re
 import sys
 
-#: the declared subsystem vocabulary. dcn = fragment scheduler,
+#: the declared subsystem vocabulary. delta = the HTAP delta tier
+#: (PR 13, storage/delta.py — coordinator log depth/bytes, delta-sync
+#: shipping, fold barriers, freshness waits), dcn = fragment scheduler,
 #: shuffle = worker-to-worker data plane, engine = TPU engine watch,
 #: flight = the query flight recorder, link = per-peer DCN link health
 #: (both PR 6), admission = the serving tier's fleet admission
@@ -39,6 +41,7 @@ SUBSYSTEMS = frozenset({
     "admission",
     "chaos",
     "dcn",
+    "delta",
     "engine",
     "executor",
     "flight",

@@ -30,6 +30,10 @@ import os
 import sys
 
 MARKER = "shuffle-json-fallback"
+#: the delta-sync data plane has no JSON fallback codec at all — its
+#: only sanctioned JSON is the tiny control-plane ack/error reply,
+#: marked with this sibling marker
+MARKERS = (MARKER, "delta-json-control")
 
 #: file (repo-relative) -> data-plane function/method qualnames whose
 #: bodies must not call json.dumps/json.loads without the marker
@@ -55,10 +59,26 @@ HOTPATH = {
     os.path.join("tidb_tpu", "server", "engine_rpc.py"): {
         "EngineServer._shuffle_push", "EngineServer._shuffle_push_binary",
         "EngineClient.shuffle_push", "EngineClient.shuffle_push_encoded",
+        "EngineServer._delta_sync_binary",
+        "EngineClient.delta_sync_encoded",
     },
     os.path.join("tidb_tpu", "chunk.py"): {
         "concat_host_columns", "take_block", "slice_block",
         "batch_from_padded",
+    },
+    # the HTAP delta-sync data plane (PR 13, storage/delta.py): delta
+    # entries ship as binary columnar frames and merge as staged
+    # blocks — JSON or row materialization here would put a Python row
+    # interpreter on every replicated write
+    os.path.join("tidb_tpu", "storage", "delta.py"): {
+        "encode_entry_frames", "_slice_net_inserts",
+        "_staged_from_block", "merge_scan_plan",
+        "DeltaStore.on_append", "DeltaStore.on_delete",
+        "DeltaStore.on_reload",
+        "DeltaReplicaState.apply_frame",
+        "DeltaReplicaState.apply_compact",
+        "DeltaReplicaState.merge_view",
+        "DeltaReplicator._ship_to",
     },
 }
 
@@ -130,6 +150,36 @@ BANNED = {
             "block_to_batch":
                 "block_to_batch re-pads (a second full copy) — use "
                 "batch_from_padded over capacity-sized buffers",
+        },
+    },
+    # the delta-sync data plane (PR 13): replicated writes stay
+    # columnar end to end — entries encode straight from HostColumn
+    # buffers, replicas buffer decoded blocks, and the read-time merge
+    # stages blocks as keyed Staged leaves. Materializing Python rows
+    # anywhere here would tax every replicated write twice.
+    os.path.join("tidb_tpu", "storage", "delta.py"): {
+        "encode_entry_frames": {
+            "materialize_rows":
+                "delta entries encode straight from HostColumn "
+                "buffers (wire.encode_frame)",
+            "dumps":
+                "the delta-sync data plane is binary-only — there is "
+                "no JSON fallback codec to fall back to",
+        },
+        "_slice_net_inserts": {
+            "materialize_rows":
+                "the net insert window concatenates/slices columnar "
+                "blocks (take_block + concat_host_columns)",
+        },
+        "DeltaReplicaState.apply_frame": {
+            "materialize_rows":
+                "replicas buffer the DECODED HostBlock — rows never "
+                "materialize on the apply path",
+        },
+        "DeltaReplicator._ship_to": {
+            "materialize_rows":
+                "shipping reads the entry's cached binary frames, "
+                "never the rows",
         },
     },
 }
@@ -234,7 +284,7 @@ def check(root: str):
             continue
         for qual, lineno in _json_calls(tree, wanted):
             window = lines[max(lineno - 8, 0) : lineno]
-            if any(MARKER in ln for ln in window):
+            if any(m in ln for ln in window for m in MARKERS):
                 continue
             violations.append(
                 (

@@ -100,6 +100,10 @@ class TestJsonMutation:
 
 class TestCryptoCompress:
     def test_aes_roundtrip(self, sess):
+        # AES lowers through the optional `cryptography` package —
+        # stub-or-gate rule: environments without it skip instead of
+        # failing on the import inside the kernel
+        pytest.importorskip("cryptography")
         assert one(
             sess,
             "select aes_decrypt(aes_encrypt(s, 'key'), 'key') from t where a = 5",

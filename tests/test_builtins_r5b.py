@@ -19,7 +19,10 @@ def sess():
 
 
 class TestVitessHashParity:
-    """Bit-exact against the reference's own test vectors."""
+    """Bit-exact against the reference's own test vectors.
+    vitess_hash keys through single-block DES from the optional
+    `cryptography` package — stub-or-gate rule: environments without
+    it skip instead of failing on the kernel's import."""
 
     VECTORS = [
         (30375298039, 0x031265661E5F1133),
@@ -28,12 +31,14 @@ class TestVitessHashParity:
     ]
 
     def test_vitess_hash_vectors(self, sess):
+        pytest.importorskip("cryptography")
         for v, want in self.VECTORS:
             assert sess.execute(f"select vitess_hash({v})").rows == [
                 (want,)
             ]
 
     def test_tidb_shard_is_hash_mod_256(self, sess):
+        pytest.importorskip("cryptography")
         for v, want in self.VECTORS:
             assert sess.execute(f"select tidb_shard({v})").rows == [
                 (want % 256,)

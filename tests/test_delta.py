@@ -1,0 +1,561 @@
+"""HTAP delta tier (storage/delta.py): fleet-replicated writes,
+snapshot-isolated delta-merge reads, background compaction.
+
+Fleet shape here: in-process EngineServers over SEPARATE catalogs
+loaded with identical data (the deterministic-load model of
+dcn_worker) and delta_replica=True — coordinator DML reaches them only
+through delta-sync frames. The 2-process dryrun lives in
+test_multihost.py; these tests keep the whole protocol observable in
+one process."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+from tidb_tpu.server.engine_rpc import DropConnection, EngineServer
+from tidb_tpu.session.session import Session
+from tidb_tpu.storage import Catalog
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.metrics import REGISTRY
+
+
+def _counter_total(prefix: str) -> float:
+    return sum(
+        v for n, _k, v in REGISTRY.rows() if n.startswith(prefix)
+    )
+
+
+SEED_ROWS = ",".join(
+    f"({i},{i * 10},'s{i % 3}')" for i in range(1, 21)
+)
+
+
+def _mk_catalog():
+    cat = Catalog()
+    s = Session(cat, db="test")
+    s.execute(
+        "create table t (a int primary key, b int, c varchar(8))"
+    )
+    s.execute(f"insert into t values {SEED_ROWS}")
+    return cat, s
+
+
+@pytest.fixture()
+def fleet():
+    """(coordinator session, scheduler, [servers], [worker catalogs])
+    — 2 delta-replica servers over independent identical catalogs."""
+    cat, sess = _mk_catalog()
+    wcats = [_mk_catalog()[0] for _ in range(2)]
+    servers = [
+        EngineServer(wc, port=0, delta_replica=True) for wc in wcats
+    ]
+    for srv in servers:
+        srv.start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", srv.port) for srv in servers], catalog=cat,
+        # folds only when tests ask (compact_now): deterministic
+        # depth/merge assertions
+        retry_backoff_s=0.0,
+    )
+    sess.attach_dcn_scheduler(sched)
+    # tests drive compaction explicitly
+    if sched._compactor is not None:
+        sched._compactor.stop()
+    yield sess, sched, servers, wcats
+    sess.attach_dcn_scheduler(None)
+    sched.close()
+    for srv in servers:
+        srv.shutdown()
+
+
+PARITY_QUERIES = (
+    "select c, count(*), sum(b) from t group by c order by c",
+    "select count(*), sum(b), min(a), max(b) from t",
+    "select c, count(distinct a) from t group by c order by c",
+)
+
+
+_FRESH_SESSIONS: dict = {}
+
+
+def _assert_parity(sess, cat, queries=(PARITY_QUERIES[1],)):
+    """Every parity query agrees EXACTLY with a full reload (a fresh
+    local session over the coordinator base), actually routed, with
+    zero local fallbacks. The reload session is cached per catalog —
+    its executor's plan cache amortizes the local compiles across a
+    test's parity sweeps."""
+    fb0 = _counter_total("tidbtpu_session_dcn_route_fallbacks")
+    key = id(cat)
+    fresh = _FRESH_SESSIONS.get(key)
+    if fresh is None:
+        fresh = _FRESH_SESSIONS[key] = Session(cat, db="test")
+        if len(_FRESH_SESSIONS) > 4:
+            _FRESH_SESSIONS.pop(next(iter(_FRESH_SESSIONS)))
+    for q in queries:
+        got = sess.execute(q)
+        exp = fresh.execute(q)
+        assert got.rows == exp.rows, (q, got.rows, exp.rows)
+        assert sess._last_dcn_routed, q
+    assert _counter_total(
+        "tidbtpu_session_dcn_route_fallbacks"
+    ) == fb0
+
+
+# -- capture ---------------------------------------------------------------
+
+
+def test_capture_kinds_per_dml_path():
+    """The Table mutation primitives capture typed logical deltas:
+    INSERT -> insert block, DELETE by int PK -> delete keys, the
+    UPDATE rewrite path -> reload + insert, TRUNCATE -> reload."""
+    from tidb_tpu.storage.delta import DeltaStore
+
+    cat, sess = _mk_catalog()
+    store = DeltaStore.attach(cat)
+    sess.execute("insert into t values (21, 210, 'x')")
+    assert [e.kind for e in store.entries] == ["insert"]
+    assert store.entries[-1].block.nrows == 1
+    sess.execute("delete from t where a in (2, 4)")
+    assert store.entries[-1].kind == "delete"
+    assert sorted(store.entries[-1].keys.tolist()) == [2, 4]
+    assert store.entries[-1].key_col == "a"
+    sess.execute("update t set c = 'zz' where a = 1")
+    kinds = [e.kind for e in store.entries]
+    assert "reload" in kinds  # rewrite paths resync the whole base
+    n = len(store.entries)
+    sess.execute("truncate table t")
+    assert store.entries[n:][-1].kind == "reload"
+    assert store.entries[-1].blocks == []
+
+
+def test_capture_string_pk_deletes_resync():
+    """A dictionary-coded (string) PK cannot ship delete keys as bare
+    ints (codes shift as the dictionary grows) — those tables resync
+    via reload markers instead of silently mis-keying."""
+    from tidb_tpu.storage.delta import DeltaStore
+
+    cat = Catalog()
+    sess = Session(cat, db="test")
+    sess.execute("create table s (k varchar(8) primary key, v int)")
+    sess.execute("insert into s values ('a', 1), ('b', 2)")
+    store = DeltaStore.attach(cat)
+    sess.execute("delete from s where k = 'a'")
+    assert store.entries[-1].kind == "reload"
+
+
+# -- wire roundtrip --------------------------------------------------------
+
+
+def test_entry_frames_roundtrip_binary():
+    """Delta entries encode as binary columnar frames (no JSON on the
+    data plane) and decode back value-exactly — NULLs and string
+    dictionaries included."""
+    from tidb_tpu.parallel import wire
+    from tidb_tpu.storage.delta import DeltaStore, encode_entry_frames
+
+    cat = Catalog()
+    sess = Session(cat, db="test")
+    sess.execute("create table r (a int primary key, b int, c text)")
+    store = DeltaStore.attach(cat)
+    sess.execute(
+        "insert into r values (1, null, 'x'), (2, 20, null)"
+    )
+    t = cat.table("test", "r")
+    [entry] = store.entries
+    frames = encode_entry_frames(entry, t)
+    assert len(frames) == 1 and wire.is_binary_frame(frames[0])
+    assert wire.peek_sid(frames[0]) == "delta://test/r/insert"
+    pkt = wire.decode_frame(frames[0])
+    blk = pkt["block"]
+    assert blk.nrows == 2
+    assert blk.columns["a"].data.tolist() == [1, 2]
+    assert blk.columns["b"].valid.tolist() == [False, True]
+    c = blk.columns["c"]
+    assert [
+        str(c.dictionary[v]) if ok else None
+        for v, ok in zip(c.data, c.valid)
+    ] == ["x", None]
+    # encode caches on the immutable entry
+    assert encode_entry_frames(entry, t) is frames
+
+
+# -- merge parity ----------------------------------------------------------
+
+
+def test_delta_merge_parity_insert_delete(fleet):
+    sess, sched, _servers, _wcats = fleet
+    cat = sess.catalog
+    sess.execute("insert into t values (21,210,'s0'),(22,220,'s1')")
+    sess.execute("delete from t where a in (3, 7, 21)")
+    _assert_parity(sess, cat, queries=PARITY_QUERIES)
+    # merged plans report their delta stats (the EXPLAIN ANALYZE
+    # DeltaMerge row rides the fragment replies) — read them off a
+    # fragment-cut query's snapshot
+    sess.execute(PARITY_QUERIES[0])
+    d = sess._last_dcn_snapshot.get("delta")
+    assert d is not None and d["depth"] >= 1
+
+
+def test_delta_merge_parity_update_on_dup_null_autoinc(fleet):
+    """The full DML matrix of the parity audit: UPDATE (both the
+    columnar scatter and the rewrite path), REPLACE, INSERT ... ON
+    DUPLICATE KEY UPDATE, NULL values, and AUTO_INCREMENT fill."""
+    sess, sched, _servers, _wcats = fleet
+    cat = sess.catalog
+    one = (PARITY_QUERIES[1],)
+    sess.execute("update t set b = b + 5 where a <= 4")
+    _assert_parity(sess, cat, queries=one)
+    sess.execute("update t set c = 'sx' where a = 9")
+    _assert_parity(sess, cat, queries=one)
+    sess.execute("replace into t values (1, -1, 'rp'), (30, 300, 'rp')")
+    _assert_parity(sess, cat, queries=one)
+    sess.execute(
+        "insert into t values (2, 0, null) "
+        "on duplicate key update b = b * 100"
+    )
+    sess.execute("insert into t values (31, null, null)")
+    _assert_parity(sess, cat, queries=PARITY_QUERIES[:2])
+    # autoinc: ids allocated coordinator-side replicate as plain rows
+    sess.execute(
+        "create table ai (id int primary key auto_increment, v int)"
+    )
+    sess.execute("insert into ai (v) values (7), (8), (9)")
+    got = sess.execute("select count(*), max(id) from ai")
+    assert got.rows == [(3, 3)]
+
+
+def test_delta_merge_shuffle_cut_parity(fleet):
+    """Writes merge under the worker-to-worker shuffle cut too: the
+    producer sides resolve the same routed snapshot (ShuffleWorker
+    _apply_snap), so a repartition join sees the delta."""
+    sess, sched, _servers, _wcats = fleet
+    sess.execute("create table j (k int primary key, c varchar(8))")
+    sess.execute(
+        "insert into j values " + ",".join(
+            f"({i},'s{i % 3}')" for i in range(1, 15)
+        )
+    )
+    sched.shuffle_mode = "always"
+    try:
+        sess.execute("insert into j values (15,'s0'),(16,'s1')")
+        sess.execute("delete from j where k = 2")
+        q = (
+            "select t.c, count(*) from t join j on t.a = j.k "
+            "group by t.c order by t.c"
+        )
+        got = sess.execute(q)
+        exp = Session(sess.catalog, db="test").execute(q)
+        assert got.rows == exp.rows, (got.rows, exp.rows)
+        assert sess._last_dcn_routed
+    finally:
+        sched.shuffle_mode = "auto"
+
+
+# -- freshness (+ new-table replication, + sync-loss retransmit) -----------
+
+
+def test_freshness_read_your_writes_vs_bounded(fleet):
+    sess, sched, _servers, wcats = fleet
+    base = sess.execute("select count(*) from t").rows[0][0]
+    # bounded staleness: nothing shipped since the write -> the
+    # replicas serve their acked floor (stale), with zero wait
+    sess.execute("set tidb_tpu_read_freshness = 'bounded'")
+    sess.execute("insert into t values (40, 400, 's0')")
+    w0 = _counter_total("tidbtpu_delta_ryw_wait_seconds")
+    stale = sess.execute("select count(*) from t")
+    assert stale.rows == [(base,)] and sess._last_dcn_routed
+    assert _counter_total("tidbtpu_delta_ryw_wait_seconds") == w0
+    # read-your-writes: ships + blocks on the session's high-water
+    sess.execute("set tidb_tpu_read_freshness = 'read_your_writes'")
+    fresh = sess.execute("select count(*) from t")
+    assert fresh.rows == [(base + 1,)] and sess._last_dcn_routed
+    # the floor advanced with the acks: bounded now sees the write
+    sess.execute("set tidb_tpu_read_freshness = 'bounded'")
+    again = sess.execute("select count(*) from t")
+    assert again.rows == [(base + 1,)]
+    sess.execute("set tidb_tpu_read_freshness = 'read_your_writes'")
+
+    # CREATE TABLE after attach + INSERT: the replicas materialize the
+    # table from the sync frames' wire schema (_ensure_table), so
+    # routed reads of a table the workers never loaded still serve
+    sess.execute("create table fresh (k bigint primary key, v bigint)")
+    sess.execute("insert into fresh values (1, 100), (2, 200)")
+    got = sess.execute("select count(*), sum(v) from fresh")
+    assert got.rows == [(2, 300)] and sess._last_dcn_routed
+    for wc in wcats:
+        assert "fresh" in wc.tables("test")
+
+    # delta/sync-loss drops the ACK after the replica applied a
+    # frame: the replicator retransmits over a fresh connection and
+    # the worker's seq fence drops the duplicate — exactly once
+    rt0 = _counter_total("tidbtpu_delta_sync_retransmits")
+    failpoint.enable(
+        "delta/sync-loss", failpoint.after_n(1, DropConnection("chaos"))
+    )
+    try:
+        sess.execute("insert into t values (50, 500, 's1')")
+        _assert_parity(sess, sess.catalog)
+    finally:
+        failpoint.disable("delta/sync-loss")
+    assert _counter_total("tidbtpu_delta_sync_retransmits") > rt0
+
+    # a transaction COMMIT (install_commit -> reload capture) moves
+    # the read-your-writes high-water exactly like autocommit DML
+    sess.execute("begin")
+    sess.execute("insert into t values (51, 510, 's2')")
+    sess.execute("commit")
+    _assert_parity(sess, sess.catalog)
+
+
+# -- snapshot pinning (the unpinned routed-read regression) ----------------
+
+
+def test_routed_snapshot_survives_concurrent_write_and_gc():
+    """Routed dispatches used to read Table.blocks() unpinned: a
+    concurrent write + version GC between two fragment executions of
+    ONE query mutated its input mid-flight. Now the coordinator pins
+    the snapshot version for the whole dispatch and ships it, so
+    every fragment reads the SAME pre-write base even while a writer
+    publishes (and GC collects) versions under it."""
+    cat, sess = _mk_catalog()
+    servers = [EngineServer(cat, port=0) for _ in range(2)]
+    for srv in servers:
+        srv.start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", srv.port) for srv in servers], catalog=cat,
+    )
+    sess.attach_dcn_scheduler(sched)
+    writer = Session(cat, db="test")
+    expected = Session(cat, db="test").execute(
+        "select count(*), sum(b) from t"
+    ).rows
+    fired = []
+
+    def concurrent_write():
+        # first fragment execution: land TWO writes (two version
+        # bumps, so unpinned snapshots would be GC'd) before any
+        # fragment scans
+        if not fired:
+            fired.append(1)
+            writer.execute("insert into t values (97, 1000, 'w')")
+            writer.execute("insert into t values (98, 1000, 'w')")
+
+    failpoint.enable("dcn/fragment-execute", concurrent_write)
+    try:
+        got = sess.execute("select count(*), sum(b) from t")
+    finally:
+        failpoint.disable("dcn/fragment-execute")
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        for srv in servers:
+            srv.shutdown()
+    assert sess._last_dcn_routed
+    # snapshot isolation: the routed query read the PRE-write base on
+    # every fragment — not a torn mix, not the post-write state
+    assert got.rows == expected, (got.rows, expected)
+
+
+# -- compaction ------------------------------------------------------------
+
+
+def test_compactor_folds_into_base_and_trims(fleet):
+    sess, sched, _servers, wcats = fleet
+    cat = sess.catalog
+    one = (PARITY_QUERIES[1],)
+    sess.execute("analyze table t")
+    rc0 = cat.table("test", "t").stats["a"].row_count
+    w0 = [wc.table("test", "t") for wc in wcats]
+    v0 = [t.version for t in w0]
+    n0 = [t.nrows for t in w0]
+    sess.execute("insert into t values (60,600,'s0'),(61,610,'s1')")
+    sess.execute("delete from t where a = 1")
+    _assert_parity(sess, cat, queries=one)  # ships
+    store = cat.delta_store
+    assert store.status()["entries"] >= 2
+    assert sched.delta.compact_now(catalog=cat)
+    # the fold ran through the ordinary columnar write path: replica
+    # bases advanced and now hold the post-DML row counts
+    for t, v_before, n_before in zip(w0, v0, n0):
+        assert t.version > v_before
+        assert t.nrows == n_before + 2 - 1
+    # log trimmed; the completed fold boundary advanced
+    st = store.status()
+    assert st["entries"] == 0 and st["completed_fold_seq"] >= 2
+    # incremental stats feed: row_count followed the net delta without
+    # waiting for a full re-analyze
+    assert cat.table("test", "t").stats["a"].row_count == rc0 + 1
+    assert _counter_total("tidbtpu_delta_compactions_total") >= 1
+    # reads after the fold merge nothing and still agree
+    _assert_parity(sess, cat, queries=one)
+
+
+def test_depth_threshold_triggers_background_compactor(fleet):
+    from tidb_tpu.storage.delta import DeltaCompactor
+
+    sess, sched, _servers, _wcats = fleet
+    compactor = DeltaCompactor(
+        sched.delta, sess.catalog, interval_s=0.0, depth_threshold=4
+    )
+    for i in range(3):
+        sess.execute(f"insert into t values ({70 + i}, 1, 's0')")
+    sess.execute("select count(*) from t")  # ship via RYW
+    assert compactor.tick() is False  # depth 3 < 4
+    sess.execute("insert into t values (79, 1, 's0')")
+    sess.execute("select count(*) from t")
+    assert compactor.tick() is True
+    assert sess.catalog.delta_store.status()["entries"] == 0
+    # the delta metric subsystem is live (scripts/check_metric_names
+    # declares it; these are the dashboard series)
+    names = {n for n, _k, _v in REGISTRY.rows()}
+    for want in (
+        "tidbtpu_delta_depth",
+        "tidbtpu_delta_batches_total",
+        "tidbtpu_delta_sync_frames_total",
+        "tidbtpu_delta_sync_lag_entries",
+        "tidbtpu_delta_compactions_total",
+    ):
+        assert any(n.startswith(want) for n in names), want
+
+
+def test_worker_killed_mid_compaction_recovers(fleet):
+    """The chaos episode of the tentpole: one replica DIES exactly as
+    the fold barrier lands (listener closed, no reply frame, nothing
+    folded — the failpoint sits before the mutation). The replicator
+    quarantines it, the barrier completes on the survivor set, routed
+    reads keep exact parity with zero local fallbacks, and the
+    connection-leak invariants hold."""
+    sess, sched, servers, _wcats = fleet
+    cat = sess.catalog
+    one = (PARITY_QUERIES[1],)
+    sess.execute("insert into t values (80,800,'s2'),(81,810,'s0')")
+    sess.execute("delete from t where a = 5")
+    _assert_parity(sess, cat)  # entries shipped + buffered fleet-wide
+    fold0 = cat.delta_store.completed_fold_seq
+
+    def die_mid_fold():
+        servers[0].shutdown()
+        raise DropConnection("chaos: die mid-fold")
+
+    failpoint.enable(
+        "delta/compact-apply", failpoint.after_n(1, die_mid_fold)
+    )
+    try:
+        assert sched.delta.compact_now(catalog=cat, timeout_s=5.0)
+    finally:
+        failpoint.disable("delta/compact-apply")
+    # the dead worker quarantined; the barrier landed on the survivor
+    assert len(sched.alive_endpoints()) == 1
+    assert cat.delta_store.completed_fold_seq > fold0
+    # the survivor keeps serving with exact parity (its fold history
+    # pins the superseded base for any in-flight snapshot)
+    _assert_parity(sess, cat, queries=one)
+    sess.execute("insert into t values (82, 820, 's1')")
+    _assert_parity(sess, cat, queries=one)
+    # drained invariants (the chaos harness's leak checks): no leased
+    # control connections after the dust settles
+    assert all(v == 0 for v in sched.pool_leased().values())
+    # the NEXT barrier also completes on the survivor set
+    assert sched.delta.compact_now(catalog=cat)
+    _assert_parity(sess, cat, queries=one)
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_explain_analyze_delta_merge_row(fleet):
+    sess, sched, _servers, _wcats = fleet
+    sess.execute("insert into t values (90, 900, 's1')")
+    sess.execute("delete from t where a = 2")
+    r = sess.execute(
+        "explain analyze select c, count(*) from t group by c order by c"
+    )
+    text = "\n".join(row[0] for row in r.rows)
+    assert "DeltaMerge depth=" in text
+    assert "ins_rows=1" in text and "delete_keys=1" in text
+
+
+def test_delta_store_disabled_by_sysvar():
+    """tidb_tpu_delta_store = OFF restores the static-snapshot attach
+    contract: no capture, no replication."""
+    cat, sess = _mk_catalog()
+    cat.global_sysvars["tidb_tpu_delta_store"] = False
+    servers = [EngineServer(cat, port=0)]
+    servers[0].start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", servers[0].port)], catalog=cat
+    )
+    try:
+        sess.attach_dcn_scheduler(sched)
+        assert getattr(cat, "delta_store", None) is None
+        assert sched.delta is None
+    finally:
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        servers[0].shutdown()
+
+
+def test_replica_seq_fence_is_at_most_once():
+    """A duplicate (retransmitted) frame must not double-buffer."""
+    from tidb_tpu.parallel import wire
+    from tidb_tpu.storage.delta import (
+        DeltaReplicaState,
+        DeltaStore,
+        encode_entry_frames,
+    )
+
+    cat, sess = _mk_catalog()
+    store = DeltaStore.attach(cat)
+    sess.execute("insert into t values (99, 990, 's0')")
+    [entry] = store.entries
+    wcat, _ = _mk_catalog()
+    state = DeltaReplicaState(wcat)
+    [frame] = encode_entry_frames(entry, cat.table("test", "t"))
+    pkt = wire.decode_frame(frame)
+    assert state.apply_frame(pkt) == entry.seq
+    assert state.apply_frame(wire.decode_frame(frame)) == entry.seq
+    rec = state._rec("test", "t")
+    assert len(rec.buffered) == 1
+    # merge view nets it exactly once
+    ins, alive, dk, _kc, depth = state.merge_view("test", "t", 0, entry.seq)
+    assert depth == 1 and sum(b.nrows for b in ins) == 1
+    assert dk is None
+    # delete of a pending insert nets it out
+    sess.execute("delete from t where a = 99")
+    e2 = store.entries[-1]
+    [f2] = encode_entry_frames(e2, cat.table("test", "t"))
+    state.apply_frame(wire.decode_frame(f2))
+    ins, alive, dk, kc, depth = state.merge_view("test", "t", 0, e2.seq)
+    assert depth == 2 and kc == "a"
+    assert dk.tolist() == [99]
+    assert int(sum(m.sum() for m in alive)) == 0  # netted out
+
+
+def test_resync_covers_every_table(fleet):
+    """A replica whose acked seq fell behind the trimmed log takes a
+    FULL resync — one reload per tracked table at a distinct fresh
+    seq (same-seq reloads would hit the worker's duplicate fence and
+    silently skip every table after the first), and reads after it
+    resolve at-or-past the resync folds."""
+    sess, sched, _servers, _wcats = fleet
+    cat = sess.catalog
+    sess.execute("create table u (k int primary key, v int)")
+    sess.execute("insert into u values (1, 5)")
+    sess.execute("insert into t values (55, 550, 's0')")
+    _assert_parity(sess, cat)  # ship everything
+    assert sched.delta.compact_now(catalog=cat)  # fold + trim
+    assert cat.delta_store.trim_floor > 0
+    # simulate a re-admitted replica that lost its ack history
+    ep = sched.endpoints[0]
+    sched.delta.acked[ep.address] = 0
+    sess.execute("insert into u values (2, 6)")
+    got = sess.execute("select count(*), sum(v) from u")
+    assert got.rows == [(2, 11)] and sess._last_dcn_routed
+    _assert_parity(sess, cat)
+    # BOTH tables resynced (the same-seq fence bug dropped the second)
+    got = sess.execute("select count(*), sum(b) from t")
+    exp = Session(cat, db="test").execute("select count(*), sum(b) from t")
+    assert got.rows == exp.rows and sess._last_dcn_routed

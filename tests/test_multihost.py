@@ -1250,3 +1250,92 @@ def test_dcn_multihost_chaos_interstage_kill(tpch_single):
         sched.close()
         for w in workers:
             w.kill()
+
+
+def test_dcn_delta_writes_mid_run_freshness_modes(tpch_single):
+    """HTAP delta tier on the REAL 2-process x 4-device dryrun
+    (workers are delta replicas): coordinator writes land mid-run —
+    INSERT/DELETE on a loaded table plus a table the workers never
+    loaded — and routed SELECTs honor both freshness modes with zero
+    local fallbacks and exact parity against a full local reload."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.session import Session
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    sess = tpch_single
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=sess.catalog,
+    )
+    sess.attach_dcn_scheduler(sched)
+    fb0 = _counter_total("tidbtpu_session_dcn_route_fallbacks")
+    q_orders = (
+        "select o_orderstatus, count(*), sum(o_shippriority) "
+        "from orders group by o_orderstatus order by o_orderstatus"
+    )
+    q_hot = "select count(*), sum(v) from hot_writes"
+    try:
+        base = sess.must_query(q_orders).rows
+        assert sess._last_dcn_routed
+
+        # writes land mid-run: a loaded table takes typed deltas, a
+        # NEW table materializes on the replicas from the sync frames
+        sess.execute(
+            "insert into orders values "
+            "(4000001, 1, 'O', 123.45, '1995-01-01', '1-URGENT', 7, 'dx'),"
+            "(4000002, 2, 'F', 456.78, '1996-02-02', '2-HIGH', 7, 'dx')"
+        )
+        sess.execute("delete from orders where o_orderkey = 4000002")
+        sess.execute(
+            "create table hot_writes (k bigint primary key, v bigint)"
+        )
+        sess.execute("insert into hot_writes values (1, 10), (2, 20)")
+
+        # read-your-writes: every committed write visible, routed
+        fresh = Session(sess.catalog, db="tpch")
+        for q in (q_orders, q_hot):
+            got = sess.execute(q)
+            assert got.rows == fresh.execute(q).rows, q
+            assert sess._last_dcn_routed, q
+        assert got.rows == [(2, 30)]  # q_hot: exact committed image
+
+        # bounded staleness: still routed, zero waits — and because
+        # the read-your-writes reads above already shipped the log,
+        # the acked floor covers every write
+        sess.execute("set tidb_tpu_read_freshness = 'bounded'")
+        w0 = _counter_total("tidbtpu_delta_ryw_wait_seconds")
+        for q in (q_orders, q_hot):
+            got = sess.execute(q)
+            assert got.rows == fresh.execute(q).rows, q
+            assert sess._last_dcn_routed, q
+        assert _counter_total("tidbtpu_delta_ryw_wait_seconds") == w0
+
+        # bounded lags behind an unshipped write (staleness is real,
+        # not a fresh read in disguise)...
+        sess.execute("insert into hot_writes values (3, 30)")
+        assert sess.execute(q_hot).rows == [(2, 30)]
+        assert sess._last_dcn_routed
+        # ...until read-your-writes ships + waits
+        sess.execute("set tidb_tpu_read_freshness = 'read_your_writes'")
+        assert sess.execute(q_hot).rows == [(3, 60)]
+        assert sess._last_dcn_routed
+
+        # a compaction barrier folds the deltas into BOTH worker
+        # processes' base blocks; parity holds after
+        assert sched.delta.compact_now(catalog=sess.catalog)
+        post = sess.execute(q_orders)
+        assert post.rows == fresh.execute(q_orders).rows
+        assert sess._last_dcn_routed
+        assert post.rows != base  # the writes are visible in the fold
+        assert sess.execute(q_hot).rows == [(3, 60)]
+
+        # ZERO local fallbacks across the whole scenario
+        assert _counter_total(
+            "tidbtpu_session_dcn_route_fallbacks"
+        ) == fb0
+    finally:
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        for w in (w1, w2):
+            w.kill()

@@ -263,6 +263,19 @@ def run_serve_load(args) -> int:
             "short": _text_rows(ref.execute(SHORT_SQL)),
             "scan": _text_rows(ref.execute(SCAN_SQL)),
         }
+        # --write-mix (the HTAP delta tier, storage/delta.py): a
+        # concurrent writer session streams INSERTs into a table the
+        # workers have NEVER loaded (the delta tier materializes it on
+        # the replicas from the sync frames), verifying read-your-
+        # writes after every commit, while reader sessions run the
+        # same aggregate under both freshness modes — detail.delta
+        # stamps depth, per-host sync lag, and the RYW-vs-bounded p99s
+        write_mix = bool(getattr(args, "write_mix", False))
+        if write_mix:
+            ref.execute(
+                "create table serve_writes (k bigint primary key, "
+                "v bigint)"
+            )
 
         # --timeline-out: capture the whole load run's fleet timeline
         # (worker events ride the fenced replies; admission waits and
@@ -340,12 +353,83 @@ def run_serve_load(args) -> int:
         from tidb_tpu.utils import racecheck
 
         lock = racecheck.make_lock("serving.load")
-        lat: Dict[str, List[float]] = {"short": [], "scan": []}
+        lat: Dict[str, List[float]] = (
+            {"ryw": [], "bounded": []}
+            if write_mix else {"short": [], "scan": []}
+        )
         errors: List[str] = []
         started = threading.Barrier(sessions + 1)
         kill_at = threading.Event()
 
+        WMIX_SQL = "select count(*), sum(v) from serve_writes"
+        writer_done = threading.Event()
+
+        def write_mix_thread(idx: int):
+            c = MysqlClient(server.port)
+            c.query("use tpch")
+            started.wait(timeout=120)
+            if idx == 0:
+                # THE writer: interleave commits with read-your-writes
+                # self-verification — acks are contiguous seqs, so a
+                # session that waits for its own high-water observes
+                # every earlier commit too
+                inserted = 0
+                try:
+                    for k in range(stmts_per_session):
+                        c.query(
+                            "insert into serve_writes values "
+                            f"({10 ** 9 + 2 * k}, {k}), "
+                            f"({10 ** 9 + 2 * k + 1}, {k})"
+                        )
+                        inserted += 2
+                        t0 = time.perf_counter()
+                        rows = c.query(WMIX_SQL)
+                        dt = time.perf_counter() - t0
+                        n = int(rows[0][0])
+                        with lock:
+                            if n != inserted:
+                                errors.append(
+                                    f"writer stmt {k}: read-your-"
+                                    f"writes stale: saw {n} rows, "
+                                    f"committed {inserted}"
+                                )
+                            lat["ryw"].append(dt)
+                        if k == 0:
+                            kill_at.set()
+                finally:
+                    writer_done.set()
+                    c.close()
+                return
+            mode = "bounded" if idx % 2 else "ryw"
+            if mode == "bounded":
+                c.query("set tidb_tpu_read_freshness = 'bounded'")
+            last_n = -1
+            for k in range(stmts_per_session):
+                t0 = time.perf_counter()
+                rows = c.query(WMIX_SQL)
+                dt = time.perf_counter() - t0
+                n = int(rows[0][0])
+                with lock:
+                    if n < last_n:
+                        errors.append(
+                            f"session {idx} ({mode}): count went "
+                            f"backwards {last_n} -> {n}"
+                        )
+                    lat[mode].append(dt)
+                last_n = n
+            c.close()
+
         def client_thread(idx: int):
+            if write_mix:
+                try:
+                    write_mix_thread(idx)
+                except Exception as e:
+                    with lock:
+                        errors.append(
+                            f"session {idx}: {type(e).__name__}: {e}"
+                        )
+                    writer_done.set()
+                return
             try:
                 c = MysqlClient(server.port)
                 c.query("use tpch")
@@ -411,7 +495,7 @@ def run_serve_load(args) -> int:
         hung = [t.name for t in threads if t.is_alive()]
         wall = time.perf_counter() - t_load0
 
-        total_stmts = len(lat["short"]) + len(lat["scan"])
+        total_stmts = sum(len(v) for v in lat.values())
         for v in lat.values():
             v.sort()
 
@@ -452,6 +536,49 @@ def run_serve_load(args) -> int:
                 "tidbtpu_executor_shared_plan_cache_cross_session_hits_total"
             ] > 0,
         }
+        delta_detail = None
+        if write_mix:
+            # post-hoc full-reload parity: a FRESH local session reads
+            # the coordinator base directly; one last routed read-your-
+            # writes statement (its own commit orders it after every
+            # writer commit) must match it exactly
+            final = MysqlClient(server.port)
+            final.query("use tpch")
+            final.query(
+                "insert into serve_writes values (999999999, -1)"
+            )
+            routed_rows = final.query(WMIX_SQL)
+            final.close()
+            reload_rows = _text_rows(
+                Session(cat, db="tpch").execute(WMIX_SQL)
+            )
+            parity = [tuple(r) for r in routed_rows] == [
+                tuple(r) for r in reload_rows
+            ]
+            checks["write_mix_reload_parity"] = parity
+            checks.pop("cross_session_plan_cache_hits", None)
+            ds = getattr(cat, "delta_store", None)
+            repl = getattr(sched, "delta", None)
+            lag = {}
+            if ds is not None and repl is not None:
+                high = ds.high_seq()
+                lag = {
+                    host: int(high - acked)
+                    for host, acked in repl.status()["acked"].items()
+                }
+            delta_detail = {
+                "depth": ds.status()["entries"] if ds else 0,
+                "high_seq": ds.high_seq() if ds else 0,
+                "completed_fold_seq": (
+                    ds.completed_fold_seq if ds else 0
+                ),
+                "sync_lag": lag,
+                "ryw_p50_s": round(_pct(lat["ryw"], 0.50), 4),
+                "ryw_p99_s": round(_pct(lat["ryw"], 0.99), 4),
+                "bounded_p50_s": round(_pct(lat["bounded"], 0.50), 4),
+                "bounded_p99_s": round(_pct(lat["bounded"], 0.99), 4),
+                "reload_parity": parity,
+            }
         result = {
             "metric": f"serve_load_{sessions}sess_queries_per_sec",
             "value": round(total_stmts / max(wall, 1e-9), 2),
@@ -486,6 +613,7 @@ def run_serve_load(args) -> int:
                 "counters": {k: round(v, 1) for k, v in delta.items()},
                 "errors": errors[:10],
                 "hung_sessions": hung,
+                "write_mix": write_mix,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",
@@ -494,6 +622,8 @@ def run_serve_load(args) -> int:
                 },
             },
         }
+        if delta_detail is not None:
+            result["detail"]["delta"] = delta_detail
         if timeline_path:
             from tidb_tpu.obs.timeline import TIMELINE
 

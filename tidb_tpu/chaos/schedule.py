@@ -66,6 +66,8 @@ FAULT_CLASSES = (
     "clock-skew",
     "sample-loss",
     "interstage-crash",
+    "delta-sync-loss",
+    "compactor-crash",
 )
 
 #: action kinds arm_spec() knows how to build. "exit" hard-kills the
@@ -219,6 +221,20 @@ def _make_fault(cls: str, rng: random.Random) -> Fault:
         # exactly when the next stage reads the held output
         return Fault(
             cls, "shuffle/stage-input", "drop", n=rng.randint(1, 3),
+        )
+    if cls == "delta-sync-loss":
+        # the delta-sync ACK vanishes AFTER the replica applied the
+        # frame: the replicator retransmits and the worker's seq fence
+        # must drop the duplicate (at-most-once on the write path)
+        return Fault(
+            cls, "delta/sync-loss", "drop", n=rng.randint(1, 2),
+        )
+    if cls == "compactor-crash":
+        # the worker "dies" as the fold barrier lands: the compaction
+        # round aborts, survivors keep serving the previous fold from
+        # their pinned history, and the next tick retries the barrier
+        return Fault(
+            cls, "delta/compact-apply", "drop", n=1,
         )
     raise ValueError(f"unknown fault class {cls!r}")
 

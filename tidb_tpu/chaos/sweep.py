@@ -243,6 +243,52 @@ def _drv_admit(ctx) -> None:
     AdmissionController().admit(None).release()
 
 
+def _drv_delta_fleet(ctx) -> None:
+    """The HTAP delta-tier sites (storage/delta.py): DML on a
+    scheduler-attached session captures delta entries (delta/capture),
+    a read-your-writes routed SELECT ships them to delta-replica
+    workers (delta/ship; the delta/sync-loss probe sits on the
+    receiver's ack; delta/apply buffers them) with exact parity, and a
+    fold barrier compacts them into the replicas' base blocks
+    (delta/compact-apply)."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_rpc import EngineServer
+    from tidb_tpu.session.session import Session
+    from tidb_tpu.storage import Catalog
+
+    def mk():
+        cat = Catalog()
+        s = Session(cat, db="test")
+        s.execute("create table sw_delta (a int primary key, b int)")
+        s.execute("insert into sw_delta values (1,1),(2,2),(3,3),(4,4)")
+        return cat, s
+
+    cat, sess = mk()
+    wcat1, _ = mk()
+    servers = [EngineServer(wcat1, port=0, delta_replica=True)]
+    for srv in servers:
+        srv.start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", srv.port) for srv in servers], catalog=cat,
+    )
+    sess.attach_dcn_scheduler(sched)
+    if sched._compactor is not None:
+        # the sweep drives the fold barrier itself (deterministic
+        # compact-apply traversal, no daemon race)
+        sched._compactor.stop()
+    try:
+        sess.execute("insert into sw_delta values (5,5),(6,6)")
+        sess.execute("delete from sw_delta where a = 2")
+        r = sess.execute("select count(*), sum(b) from sw_delta")
+        assert r.rows == [(5, 19)], r.rows
+        assert sched.delta.compact_now(catalog=cat)
+    finally:
+        sess.attach_dcn_scheduler(None)
+        sched.close()
+        for srv in servers:
+            srv.shutdown()
+
+
 def _drv_shuffle_fleet(ctx) -> None:
     """The DCN sites a real 2-server in-process fleet traverses: a
     repartition-join rides the tunnels (shuffle/open, produce, push,
@@ -353,9 +399,10 @@ SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
         "ddl/index-before-public", "ddl/generated-recompute",
         "ddl/rename-table", "catalog/drop-table")),
     ("sql", "dml", [
+        "insert into sw_dml values (5,'v')",
         "update sw_dml set b = 'w' where a = 2",
         "delete from sw_dml where a = 4",
-    ], ("dml/update", "dml/delete")),
+    ], ("dml/insert", "dml/update", "dml/delete")),
     ("sql", "txn", [
         "begin", "insert into sw_dml values (7,'t')", "commit",
         "set tidb_txn_mode = 'optimistic'",
@@ -405,6 +452,9 @@ SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
     ("driver", "engine-pool", _drv_engine_pool,
      ("engine/dispatch", "engine/execute")),
     ("driver", "admit", _drv_admit, ("serving/admit",)),
+    ("driver", "delta-fleet", _drv_delta_fleet,
+     ("delta/capture", "delta/ship", "delta/sync-loss",
+      "delta/apply", "delta/compact-apply")),
     ("driver", "shuffle-fleet", _drv_shuffle_fleet,
      ("shuffle/open", "shuffle/produce", "shuffle/push",
       "shuffle/push-lost", "shuffle/wait", "shuffle/consume",

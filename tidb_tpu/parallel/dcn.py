@@ -711,7 +711,64 @@ class DCNFragmentScheduler:
         #: (session.py _try_dcn_select) gates query start on it —
         #: priority/fairness queue + fleet device-memory budget
         self.admission = admission
+        #: optional storage.delta.DeltaReplicator (attach_delta): the
+        #: HTAP write path — coordinator DML deltas ship to the fleet
+        #: and routed reads snapshot (fold, seq) against it
+        self.delta = None
+        self._compactor = None
         self._rr = 0
+
+    # -- HTAP delta tier (storage/delta.py) ------------------------------
+    def attach_delta(
+        self, store, compact_interval_s: float = 0.5,
+        compact_depth: int = 32,
+    ):
+        """Attach a coordinator DeltaStore: routed reads gain delta
+        snapshots (freshness modes, worker-side merge) and the
+        background delta-compactor starts folding the log into the
+        fleet's base blocks. Idempotent."""
+        if self.delta is not None:
+            return self.delta
+        from tidb_tpu.storage.delta import DeltaCompactor, DeltaReplicator
+
+        self.delta = DeltaReplicator(store, self)
+        self._compactor = DeltaCompactor(
+            self.delta, self.catalog,
+            interval_s=compact_interval_s,
+            depth_threshold=compact_depth,
+        )
+        self._compactor.start()
+        return self.delta
+
+    def _build_snapshot(self, plan, delta_seq, pins) -> Optional[dict]:
+        """The routed snapshot one query's EVERY dispatch carries:
+        each scanned table's base version pinned for the whole
+        dispatch (a concurrent write + version GC can no longer
+        mutate an in-flight routed query's input — fragment slices
+        index the base block concatenation, so every fragment must
+        read ONE version) plus the delta (fold, seq) window replica
+        workers merge. The caller unpins ``pins`` when the query
+        completes."""
+        from tidb_tpu.storage.delta import scans_in
+
+        tables: Dict[str, int] = {}
+        for s in scans_in(plan):
+            key = f"{s.db.lower()}.{s.table.lower()}"
+            if key in tables:
+                continue
+            try:
+                t = self.catalog.table(s.db, s.table)
+            except Exception:
+                continue
+            v = t.pin_current()
+            pins.append((t, v))
+            tables[key] = v
+        if not tables and self.delta is None:
+            return None
+        snap = {"tables": tables}
+        if self.delta is not None:
+            snap.update(self.delta.build_snapshot(delta_seq))
+        return snap
 
     # -- host/connection management ------------------------------------
     def alive_endpoints(self) -> List[EngineEndpoint]:
@@ -749,6 +806,8 @@ class DCNFragmentScheduler:
         LINKS.note_handshake(ep.address, c.clock_rtt_s, c.clock_offset_s)
 
     def close(self) -> None:
+        if self._compactor is not None:
+            self._compactor.stop()
         self.heartbeat.stop()
         with self._lock:
             pools = list(self._pools.values())
@@ -757,7 +816,7 @@ class DCNFragmentScheduler:
         self.prober.stop()
 
     # -- dispatch -------------------------------------------------------
-    def _dispatch(self, ep, plan, frag_meta):
+    def _dispatch(self, ep, plan, frag_meta, snap=None):
         """One fragment dispatch on one host. Transport failures raise;
         engine-side execution errors raise RuntimeError (no failover —
         they reproduce everywhere). Returns (cols, rows, resp) — the
@@ -772,7 +831,7 @@ class DCNFragmentScheduler:
         # transport failure poisons the connection (EngineClient marks
         # _dead) and checkin frees its slot.
         with self._pool(ep).lease() as conn:
-            return conn.execute_plan_full(plan, frag=frag_meta)
+            return conn.execute_plan_full(plan, frag=frag_meta, snap=snap)
 
     def _quarantine(self, ep: EngineEndpoint) -> None:
         self._pool(ep).close_idle()
@@ -931,7 +990,7 @@ class DCNFragmentScheduler:
     # -- query execution ------------------------------------------------
     def execute_plan(
         self, plan: L.LogicalPlan, cut_hint=None, kill_check=None,
-        deadline=None,
+        deadline=None, delta_seq=None,
     ) -> Tuple[List[str], List[tuple]]:
         """Run a bound logical plan across the worker hosts. Prefers a
         worker-to-worker shuffle cut when the policy says tunnels beat
@@ -953,46 +1012,61 @@ class DCNFragmentScheduler:
         seconds, so a worker self-cancels even if the coordinator is
         wedged."""
         kind, cut = cut_hint if cut_hint is not None else self._choose_cut(plan)
-        if kind == "dag":
-            t0 = time.perf_counter()
-            parts_rows, infos, stages = self._run_dag(
-                cut, kill_check=kill_check, deadline=deadline
-            )
-            retries = max(
-                (int(s.get("attempts", 1)) - 1 for s in stages),
-                default=0,
-            )
-            self._note_dispatch(t0, infos, retries=retries)
-            for s in stages:
-                FLIGHT.note_shuffle_stage(s)
-            if cut.merge.get("kind") == "concat":
-                return self._concat_merge(cut, parts_rows)
-            rows = [r for part in parts_rows for r in part]
-            return self._timed_final_stage(cut, rows)
-        if kind == "shuffle":
-            t0 = time.perf_counter()
-            rows, infos, stage = self._run_shuffle(
-                cut, kill_check=kill_check, deadline=deadline
-            )
-            self._note_dispatch(
-                t0, infos,
-                retries=max(int(stage.get("attempts", 1)) - 1, 0),
-            )
-            FLIGHT.note_shuffle_stage(stage)
-            return self._timed_final_stage(cut, rows)
-        if kind == "frag":
-            t0 = time.perf_counter()
-            ledger, infos = self._run_fragments(
-                cut, kill_check=kill_check, deadline=deadline
-            )
-            self._note_dispatch(t0, infos, retries=ledger.total_retries())
-            # remote engine row work (summed across hosts, like the
-            # shuffle phases and the reference's cop-task totals)
-            FLIGHT.note_phase(
-                "execute", sum(f.get("exec_s", 0.0) for f in infos)
-            )
-            return self._timed_final_stage(cut, ledger.rows())
-        return self._execute_single(plan)
+        # routed snapshot: pin every scanned table's base version for
+        # the WHOLE query (all fragments of all stages read one base —
+        # a concurrent write + version GC cannot mutate an in-flight
+        # routed query's input) and carry the delta (fold, seq) window
+        pins: List[tuple] = []
+        snap = self._build_snapshot(plan, delta_seq, pins)
+        try:
+            if kind == "dag":
+                t0 = time.perf_counter()
+                parts_rows, infos, stages = self._run_dag(
+                    cut, kill_check=kill_check, deadline=deadline,
+                    snap=snap,
+                )
+                retries = max(
+                    (int(s.get("attempts", 1)) - 1 for s in stages),
+                    default=0,
+                )
+                self._note_dispatch(t0, infos, retries=retries)
+                for s in stages:
+                    FLIGHT.note_shuffle_stage(s)
+                if cut.merge.get("kind") == "concat":
+                    return self._concat_merge(cut, parts_rows)
+                rows = [r for part in parts_rows for r in part]
+                return self._timed_final_stage(cut, rows)
+            if kind == "shuffle":
+                t0 = time.perf_counter()
+                rows, infos, stage = self._run_shuffle(
+                    cut, kill_check=kill_check, deadline=deadline,
+                    snap=snap,
+                )
+                self._note_dispatch(
+                    t0, infos,
+                    retries=max(int(stage.get("attempts", 1)) - 1, 0),
+                )
+                FLIGHT.note_shuffle_stage(stage)
+                return self._timed_final_stage(cut, rows)
+            if kind == "frag":
+                t0 = time.perf_counter()
+                ledger, infos = self._run_fragments(
+                    cut, kill_check=kill_check, deadline=deadline,
+                    snap=snap,
+                )
+                self._note_dispatch(
+                    t0, infos, retries=ledger.total_retries()
+                )
+                # remote engine row work (summed across hosts, like the
+                # shuffle phases and the reference's cop-task totals)
+                FLIGHT.note_phase(
+                    "execute", sum(f.get("exec_s", 0.0) for f in infos)
+                )
+                return self._timed_final_stage(cut, ledger.rows())
+            return self._execute_single(plan, snap=snap)
+        finally:
+            for t, v in pins:
+                t.unpin(v)
 
     @staticmethod
     def _note_dispatch(t0: float, infos, retries: int) -> None:
@@ -1047,8 +1121,25 @@ class DCNFragmentScheduler:
         with self._final_merge_phase():
             return self._final_stage(cut, rows)
 
+    @staticmethod
+    def _delta_lines(infos) -> List[str]:
+        """The EXPLAIN ANALYZE DeltaMerge row: summed worker-side
+        merge stats of one routed query (delta depth, merged insert
+        rows, delete keys filtered) — present only when some fragment
+        actually merged buffered deltas."""
+        ds = [f.get("delta") for f in infos if f.get("delta")]
+        if not ds:
+            return []
+        return [
+            "DeltaMerge depth="
+            f"{max(int(d.get('depth', 0)) for d in ds)} "
+            f"ins_rows={sum(int(d.get('ins_rows', 0)) for d in ds)} "
+            f"delete_keys={max(int(d.get('del_keys', 0)) for d in ds)} "
+            f"fragments={len(ds)}"
+        ]
+
     def explain_analyze(
-        self, plan: L.LogicalPlan
+        self, plan: L.LogicalPlan, delta_seq=None
     ) -> Tuple[List[str], List[tuple], List[str]]:
         """Distributed EXPLAIN ANALYZE: run the fragments (or the
         shuffle stage), then the final stage INSTRUMENTED, and merge
@@ -1058,11 +1149,22 @@ class DCNFragmentScheduler:
         plan-tree rows — the reference's cop-task RuntimeStatsColl
         merge, over the engine-RPC seam. Returns (columns, rows, plan
         lines)."""
+        kind, cut = self._choose_cut(plan)
+        pins: List[tuple] = []
+        snap = self._build_snapshot(plan, delta_seq, pins)
+        try:
+            return self._explain_analyze_inner(
+                plan, kind, cut, snap
+            )
+        finally:
+            for t, v in pins:
+                t.unpin(v)
+
+    def _explain_analyze_inner(self, plan, kind, cut, snap):
         from tidb_tpu.chunk import materialize_rows
 
-        kind, cut = self._choose_cut(plan)
         if kind == "dag":
-            parts_rows, infos, stages = self._run_dag(cut)
+            parts_rows, infos, stages = self._run_dag(cut, snap=snap)
             pairs = [
                 (s, [f for f in infos if f.get("stage", 0) == si])
                 for si, s in enumerate(stages)
@@ -1091,32 +1193,35 @@ class DCNFragmentScheduler:
             out, dicts, lines = self._executor.run_analyze(
                 final, shuffle_stats=pairs
             )
+            lines = lines + self._delta_lines(infos)
             out_rows = materialize_rows(out, list(final.schema), dicts)
             return [c.name for c in final.schema], out_rows, lines
         if kind == "shuffle":
-            rows, infos, stage = self._run_shuffle(cut)
+            rows, infos, stage = self._run_shuffle(cut, snap=snap)
             inject("dcn/final-stage")
             staged = self._stage_rows(cut, rows)
             final = cut.final_builder(staged)
             out, dicts, lines = self._executor.run_analyze(
                 final, shuffle_stats=(stage, infos)
             )
+            lines = lines + self._delta_lines(infos)
             out_rows = materialize_rows(out, list(final.schema), dicts)
             return [c.name for c in final.schema], out_rows, lines
         if kind == "single":
-            cols, rows = self._execute_single(plan)
+            cols, rows = self._execute_single(plan, snap=snap)
             return cols, rows, [
                 "SingleHostDispatch (no safe fragment split) "
                 f"rows={len(rows)}"
             ]
         frag = cut
-        ledger, infos = self._run_fragments(frag)
+        ledger, infos = self._run_fragments(frag, snap=snap)
         inject("dcn/final-stage")
         staged = self._stage_rows(frag, ledger.rows())
         final = frag.final_builder(staged)
         out, dicts, lines = self._executor.run_analyze(
             final, frag_stats=infos
         )
+        lines = lines + self._delta_lines(infos)
         out_rows = materialize_rows(out, list(final.schema), dicts)
         return [c.name for c in final.schema], out_rows, lines
 
@@ -1192,7 +1297,8 @@ class DCNFragmentScheduler:
         return cut if kind == "shuffle" else None
 
     def _run_shuffle(
-        self, sp: ShufflePlan, kill_check=None, deadline=None
+        self, sp: ShufflePlan, kill_check=None, deadline=None,
+        snap=None,
     ) -> Tuple[List[tuple], List[dict], dict]:
         """Run one shuffle stage to completion: dispatch a produce+
         consume task per alive host, each host pushing hash partitions
@@ -1280,6 +1386,9 @@ class DCNFragmentScheduler:
                     # opt the worker into timeline event collection
                     # only while a coordinator capture is live
                     "timeline": TIMELINE.active(),
+                    # routed snapshot: producers pin this base and
+                    # merge the delta window (storage/delta.py)
+                    "snap": snap,
                 }
                 t_d0 = time.time()
                 try:
@@ -1417,7 +1526,7 @@ class DCNFragmentScheduler:
 
     def _stage_task(
         self, dag, si, stage, i, m, attempt, qid, boundaries, peers,
-        secret, deadline,
+        secret, deadline, snap=None,
     ) -> dict:
         """The worker task spec for partition ``i`` of DAG stage
         ``si`` — run_task's single-stage spec plus the DAG fields
@@ -1450,11 +1559,12 @@ class DCNFragmentScheduler:
             "produce_chunks": self.shuffle_produce_chunks,
             "trace": bool(self.tracer.enabled),
             "timeline": TIMELINE.active(),
+            "snap": snap,
         }
 
     def _sample_stage(
         self, si, stage, hosts, m, attempt, qid, kill_check, deadline,
-        suspects, errs,
+        suspects, errs, snap=None,
     ):
         """Boundary-sampling round of one range stage: every worker
         produces (and CACHES) its side, replies a deterministic key
@@ -1480,6 +1590,7 @@ class DCNFragmentScheduler:
                     "tag": side.tag, "key": side.key,
                     "plan": plan_to_ir(side.host_plan(i, m)),
                 },
+                "snap": snap,
             }
             try:
                 resp = conn.call(
@@ -1590,7 +1701,8 @@ class DCNFragmentScheduler:
             )
 
     def _run_dag(
-        self, dag: ShuffleDAG, kill_check=None, deadline=None
+        self, dag: ShuffleDAG, kill_check=None, deadline=None,
+        snap=None,
     ) -> Tuple[List[List[tuple]], List[dict], List[dict]]:
         """Run a shuffle DAG to completion: stages execute in topo
         order, each dispatched to every alive host over the
@@ -1633,6 +1745,7 @@ class DCNFragmentScheduler:
                         boundaries = self._sample_stage(
                             si, stg, hosts, m, attempt, qid,
                             kill_check, deadline, suspects, errs,
+                            snap=snap,
                         )
                         if boundaries is None:
                             break  # suspects filled: verify + retry
@@ -1688,6 +1801,7 @@ class DCNFragmentScheduler:
                         task = self._stage_task(
                             dag, _si, _stg, i, m, attempt, qid,
                             _bnd, peers, ep.secret, deadline,
+                            snap=snap,
                         )
                         t_d0 = time.time()
                         try:
@@ -1972,7 +2086,8 @@ class DCNFragmentScheduler:
         )
 
     def _run_fragments(
-        self, frag: FragmentPlan, kill_check=None, deadline=None
+        self, frag: FragmentPlan, kill_check=None, deadline=None,
+        snap=None,
     ) -> Tuple[FragmentLedger, List[dict]]:
         """Dispatch every fragment exactly once onto the alive hosts,
         surviving losses up to max_attempts rounds. Returns the
@@ -2030,7 +2145,7 @@ class DCNFragmentScheduler:
                 t_d0 = time.time()
                 try:
                     _cols, rows, resp = self._dispatch(
-                        ep, frag.host_plan(fid, n), meta
+                        ep, frag.host_plan(fid, n), meta, snap=snap
                     )
                 except QueryCancelled as e:
                     # deliberate worker-side abort: neither an engine
@@ -2128,6 +2243,10 @@ class DCNFragmentScheduler:
             "mem_peak": int(stats.get("mem_peak_bytes", 0) or 0),
             "compile": stats.get("compile"),
         }
+        if stats.get("delta"):
+            # worker-side delta-merge stats (EXPLAIN ANALYZE DeltaMerge
+            # row + the session's routed-stats snapshot)
+            info["delta"] = dict(stats["delta"])
         with self._lock:
             infos.append(info)
         self._merge_remote_spans(
@@ -2175,7 +2294,9 @@ class DCNFragmentScheduler:
             base_s = max(now_rel - extent, 0.0)
         self.tracer.add_remote(spans, label=host, base_s=base_s)
 
-    def _execute_single(self, plan) -> Tuple[List[str], List[tuple]]:
+    def _execute_single(
+        self, plan, snap=None
+    ) -> Tuple[List[str], List[tuple]]:
         """Whole-plan dispatch onto one host (shapes with no safe
         split): the ExecutorWithRetry loop over survivors."""
         last_err: Optional[Exception] = None
@@ -2192,7 +2313,7 @@ class DCNFragmentScheduler:
                     raise ConnectionError("failpoint: dispatch lost in transit")
                 # pooled control connection (see _dispatch)
                 with self._pool(ep).lease() as conn:
-                    return conn.execute_plan(plan)
+                    return conn.execute_plan(plan, snap=snap)
             except (SchemaOutOfDateError, RuntimeError, ValueError,
                     PermissionError):
                 raise
@@ -2277,4 +2398,8 @@ class DCNFragmentScheduler:
         if self.admission is not None:
             # serving-tier admission snapshot rides the same endpoint
             out["admission"] = self.admission.status()
+        if self.delta is not None:
+            # HTAP delta tier: per-host acked seqs, the acked floor,
+            # and the completed fold boundary
+            out["delta"] = self.delta.status()
         return out

@@ -128,6 +128,11 @@ def main(argv=None) -> int:
         # fleet-wide engine activity (never set in-process — see
         # EngineServer.ship_registry)
         ship_registry=True,
+        # worker PROCESS holds its OWN base-table copies: coordinator
+        # DML reaches it only through delta_sync frames, buffered and
+        # folded by the replica state (never set in-process — see
+        # EngineServer delta_replica)
+        delta_replica=True,
     )
     print(f"DCN_WORKER_READY port={srv.port}", flush=True)
     try:

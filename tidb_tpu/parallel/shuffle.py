@@ -1237,11 +1237,16 @@ class ShuffleWorker:
     instance per EngineServer; holds the receive store (tunnel
     endpoint) the server's `shuffle_push` frames land in."""
 
-    def __init__(self, catalog, self_address: str = "?", mesh_devices=None):
+    def __init__(self, catalog, self_address: str = "?", mesh_devices=None,
+                 delta_state=None):
         self.catalog = catalog
         self.store = ShuffleStore()
         self.self_address = self_address
         self.mesh_devices = mesh_devices
+        # HTAP delta replica state of the owning EngineServer (None on
+        # shared-catalog servers): producer plans resolve their routed
+        # snapshot against it (storage/delta.py prepare_worker_plan)
+        self.delta_state = delta_state
         # PROCESS-wide nonce stream (disjoint from dcn.py's and
         # streamed.py's): nonce-staged plans fingerprint on the nonce
         # alone, so two in-process workers minting from per-instance
@@ -1335,9 +1340,49 @@ class ShuffleWorker:
                 self._producer_exec = PhysicalExecutor(
                     self.catalog, mesh_devices=self.mesh_devices
                 )
-            batch, dicts = self._producer_exec.run(plan)
+            batch, dicts = self._run_producer(
+                self._producer_exec, plan, side.get("_snap_hook"),
+                bool(side.get("_snap_merged")),
+            )
             types = {c.internal: c.type for c in plan.schema.cols}
             return batch_to_block(batch, types, dicts)
+
+    def _apply_snap(self, spec, side, plan, pins):
+        """Apply the dispatch's routed snapshot to one producer side:
+        pin the base versions, rewrite the plan to merge this replica's
+        buffered deltas, and stash the resolver hook on the side spec
+        for the run sites. No-op without a snapshot."""
+        snap = spec.get("snap")
+        if not snap:
+            return plan
+        from tidb_tpu.storage import delta as _delta
+
+        plan2, hook, stats = _delta.prepare_worker_plan(
+            self.catalog, self.delta_state, plan, snap, pins
+        )
+        side["_snap_hook"] = hook
+        side["_snap_merged"] = stats is not None
+        return plan2
+
+    def _run_producer(self, exec_, plan, hook, merged):
+        """One producer-plan execution under the exec lock with the
+        snapshot resolver installed. Delta-merged plans mix sharded
+        scans with replicated Staged leaves — they run on a plain
+        (single-device) executor; the SPMD mesh program is a scan
+        throughput optimization, not a correctness requirement."""
+        from tidb_tpu.planner.physical import PhysicalExecutor
+
+        with self._exec_lock:
+            if merged and self.mesh_devices:
+                if getattr(self, "_producer_plain", None) is None:
+                    self._producer_plain = PhysicalExecutor(self.catalog)
+                exec_ = self._producer_plain
+            if hook is not None:
+                exec_.table_hook = hook
+            try:
+                return exec_.run(plan)
+            finally:
+                exec_.table_hook = None
 
     def run_sample(self, spec: dict, cancel_check=None) -> dict:
         """Boundary-sampling round of a range exchange stage: produce
@@ -1351,7 +1396,13 @@ class ShuffleWorker:
         inject("shuffle/sample")
         side = spec["side"]
         plan = plan_from_ir(side["plan"])
-        blk = self._side_input_block(spec, side, plan, cancel_check)
+        pins: list = []
+        try:
+            plan = self._apply_snap(spec, side, plan, pins)
+            blk = self._side_input_block(spec, side, plan, cancel_check)
+        finally:
+            for t, v in pins:
+                t.unpin(v)
         from tidb_tpu.planner import logical as L
 
         if not isinstance(plan, L.StageInput):
@@ -1489,6 +1540,7 @@ class ShuffleWorker:
         shippers: List[threading.Thread] = []
         ship_errs: List[Exception] = []
         staged: Dict[int, object] = {}
+        snap_pins: List[tuple] = []
 
         def poll():
             """Wait-abort callback: raises on fleet cancellation, else
@@ -1504,6 +1556,7 @@ class ShuffleWorker:
                     cancel_check()
                 tag = int(side["tag"])
                 plan = plan_from_ir(side["plan"])
+                plan = self._apply_snap(spec, side, plan, snap_pins)
                 schema_cols = list(plan.schema)
                 inject("shuffle/produce")
                 stats["scan_rows"] += self._plan_scan_rows(plan)
@@ -1549,8 +1602,12 @@ class ShuffleWorker:
                     # partitions Python rows, like PR 3
                     t_prod = time.perf_counter()
                     t_wall = time.time()
-                    with span(f"{ctx}/produce#{tag}"), self._exec_lock:
-                        batch, dicts = producer_exec.run(plan)
+                    with span(f"{ctx}/produce#{tag}"):
+                        batch, dicts = self._run_producer(
+                            producer_exec, plan,
+                            side.get("_snap_hook"),
+                            bool(side.get("_snap_merged")),
+                        )
                     dt_prod = time.perf_counter() - t_prod
                     stats["produce_s"] += dt_prod
                     emit(f"produce#{tag}", t_wall, dt_prod)
@@ -1616,7 +1673,13 @@ class ShuffleWorker:
                     # plan is row-sliceable, so push starts after ONE
                     # chunk instead of after the whole side
                     subplans = None
-                    if produce_chunks > 1:
+                    if produce_chunks > 1 and not side.get(
+                        "_snap_merged"
+                    ):
+                        # a delta-merged side already carries its frag
+                        # slice inside the UnionAll — sub-slicing the
+                        # base scan again would desync it from the
+                        # staged insert slice
                         cand = [
                             _slice_producer(plan, k, produce_chunks)
                             for k in range(produce_chunks)
@@ -1628,9 +1691,12 @@ class ShuffleWorker:
                             cancel_check()
                         t_prod = time.perf_counter()
                         t_wall = time.time()
-                        with span(f"{ctx}/produce#{tag}"), \
-                                self._exec_lock:
-                            batch, dicts = producer_exec.run(sp)
+                        with span(f"{ctx}/produce#{tag}"):
+                            batch, dicts = self._run_producer(
+                                producer_exec, sp,
+                                side.get("_snap_hook"),
+                                bool(side.get("_snap_merged")),
+                            )
                         dt_prod = time.perf_counter() - t_prod
                         stats["produce_s"] += dt_prod
                         emit(f"produce#{tag}", t_wall, dt_prod)
@@ -1639,8 +1705,11 @@ class ShuffleWorker:
                     continue
                 t_prod = time.perf_counter()
                 t_wall = time.time()
-                with span(f"{ctx}/produce#{tag}"), self._exec_lock:
-                    batch, dicts = producer_exec.run(plan)
+                with span(f"{ctx}/produce#{tag}"):
+                    batch, dicts = self._run_producer(
+                        producer_exec, plan, side.get("_snap_hook"),
+                        bool(side.get("_snap_merged")),
+                    )
                 dt_prod = time.perf_counter() - t_prod
                 stats["produce_s"] += dt_prod
                 emit(f"produce#{tag}", t_wall, dt_prod)
@@ -1804,6 +1873,10 @@ class ShuffleWorker:
             self._held_prune(coord, qid)
             raise
         finally:
+            # release the routed snapshot's base-version pins: GC may
+            # collect superseded versions once no dispatch reads them
+            for t, v in snap_pins:
+                t.unpin(v)
             for th in shippers:
                 # an error can escape while shippers run: never close
                 # tunnels under an active sender

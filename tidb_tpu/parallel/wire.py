@@ -352,6 +352,15 @@ def peek_auth(frame: bytes) -> Optional[str]:
     return auth or None
 
 
+def peek_sid(frame: bytes) -> str:
+    """The sid of a binary frame off the header alone — the
+    engine-RPC server's binary-frame router splits delta-sync frames
+    (``delta://`` namespace) from shuffle traffic here without paying
+    any column decode."""
+    r = _Reader(frame, _FIXED.size)
+    return r.take(r.u16()).decode()
+
+
 def splice_id_auth(
     payload: bytes, req_id: int, secret: Optional[str]
 ) -> bytes:

@@ -192,9 +192,22 @@ class EngineServer:
         secret: Optional[str] = None,
         mesh_devices: Optional[int] = None,
         ship_registry: bool = False,
+        delta_replica: bool = False,
     ):
         self.catalog = catalog
         self.secret = secret
+        # delta_replica: this process holds its OWN copy of the base
+        # tables (worker processes — parallel/dcn_worker.py), so
+        # coordinator DML reaches it only through delta_sync frames:
+        # buffered per table, folded on compact barriers, merged into
+        # routed reads (storage/delta.py). In-process servers sharing
+        # the coordinator's catalog must NOT set this — their base IS
+        # the fresh store, and delta frames ack as no-ops.
+        self.delta_state = None
+        if delta_replica:
+            from tidb_tpu.storage.delta import DeltaReplicaState
+
+            self.delta_state = DeltaReplicaState(catalog)
         # mesh_devices: this engine executes plans SPMD over its local
         # device mesh (intra-host ICI exchanges) — the worker-host shape
         # of the hierarchical DCN scheduler (parallel/dcn.py)
@@ -275,7 +288,20 @@ class EngineServer:
                                     )
                                     return
                                 authed = True
-                            resp = outer._shuffle_push_binary(frame)
+                            # route off the sid namespace alone: the
+                            # delta-sync data plane shares the binary
+                            # codec with shuffle but lands in the
+                            # replica state, not the shuffle store
+                            try:
+                                is_delta = wire.peek_sid(
+                                    frame
+                                ).startswith("delta://")
+                            except wire.WireFormatError:
+                                is_delta = False
+                            if is_delta:
+                                resp = outer._delta_sync_binary(frame)
+                            else:
+                                resp = outer._shuffle_push_binary(frame)
                             _send_frame(self.request, resp)
                             continue
                         t_dec0 = _time.perf_counter()
@@ -310,6 +336,10 @@ class EngineServer:
                             resp = outer._shuffle_sample(req)
                         elif "cancel_query" in req:
                             resp = outer._cancel_query(req)
+                        elif "delta_compact" in req:
+                            resp = outer._delta_compact(req)
+                        elif "delta_status" in req:
+                            resp = outer._delta_status(req)
                         elif "engine_status" in req:
                             resp = outer._engine_status(req)
                         elif "plan" not in req:
@@ -426,6 +456,57 @@ class EngineServer:
                     f"client planned at {req['schema_v']}; reload schemas"
                 )
         plan = plan_from_ir(req["plan"])
+        # snapshot isolation for routed dispatches: pin every scanned
+        # table's base version for the WHOLE dispatch (version GC can
+        # never collect an in-flight routed query's input) and, on a
+        # delta replica, merge the snapshot's buffered deltas into the
+        # plan as keyed Staged leaves (storage/delta.py)
+        pins: list = []
+        delta_stats = None
+        snap = req.get("snap")
+        conn_executor = executor
+        if snap:
+            from tidb_tpu.storage import delta as _delta
+
+            plan, hook, delta_stats = _delta.prepare_worker_plan(
+                self.catalog, self.delta_state, plan, snap, pins
+            )
+            if hook is not None:
+                executor.table_hook = hook
+            if delta_stats is not None and executor.mesh is not None:
+                # a merged plan mixes sharded scans with replicated
+                # Staged leaves; run it on this connection's plain
+                # (single-device) executor — the SPMD mesh program is
+                # a scan-throughput optimization, not a correctness
+                # requirement
+                from tidb_tpu.planner.physical import PhysicalExecutor
+
+                plain = getattr(executor, "_delta_plain", None)
+                if plain is None:
+                    plain = PhysicalExecutor(self.catalog)
+                    executor._delta_plain = plain
+                plain.table_hook = executor.table_hook
+                executor = plain
+        try:
+            return self._execute_inner(
+                executor, req, plan, frag, delta_stats
+            )
+        finally:
+            # clear BOTH executors' hooks: a merged dispatch swaps to
+            # the plain executor but the connection executor's hook was
+            # set first — a dangling hook would leak this snapshot's
+            # resolution into the next request on this connection
+            executor.table_hook = None
+            conn_executor.table_hook = None
+            for t, v in pins:
+                t.unpin(v)
+
+    def _execute_inner(
+        self, executor, req, plan, frag, delta_stats
+    ) -> bytes:
+        from tidb_tpu.chunk import materialize_rows
+        from tidb_tpu.utils.failpoint import inject
+
         tracer = None
         if frag is not None:
             # trace context propagated over the RPC seam: the
@@ -544,6 +625,11 @@ class EngineServer:
                 "mem_peak_bytes": frag_watch["mem_peak_bytes"],
                 "compile": frag_watch["compile"],
             }
+            if delta_stats is not None:
+                # this fragment merged buffered deltas: depth / rows /
+                # delete keys ride the reply for the coordinator's
+                # EXPLAIN ANALYZE DeltaMerge row
+                resp["stats"]["delta"] = delta_stats
             if frag_events:
                 resp["events"] = frag_events
             if self.ship_registry:
@@ -567,6 +653,7 @@ class EngineServer:
                     self.catalog,
                     self_address=f"{socket.gethostname()}:{self.port}",
                     mesh_devices=self.mesh_devices,
+                    delta_state=self.delta_state,
                 )
             return self._shuffle
 
@@ -819,6 +906,66 @@ class EngineServer:
         if self._shuffle is not None:
             self._shuffle._held_prune(c.get("coord"), c.get("qid"))
         return json.dumps({"id": req.get("id"), "ok": True}).encode()
+
+    # -- delta tier (storage/delta.py) ----------------------------------
+    def _delta_sync_binary(self, frame: bytes) -> bytes:
+        """One delta-sync frame from the coordinator's replicator:
+        decode (binary columnar codec — the delta data plane never
+        rides JSON) and buffer it in the replica state, seq-fenced so
+        a retransmit can never double-buffer. Servers sharing the
+        coordinator's catalog (no replica state) ack without applying:
+        their base IS the fresh store. The ``delta/sync-loss``
+        failpoint drops the ack AFTER the apply — the chaos frame-loss
+        shape the seq fence exists for."""
+        from tidb_tpu.utils.failpoint import inject
+
+        try:
+            pkt = wire.decode_frame(frame)
+        except Exception as e:
+            # delta-json-control: the error REPLY is control-plane
+            return json.dumps(
+                {
+                    "id": wire.peek_request_id(frame), "ok": False,
+                    "error": f"DeltaDecodeError: {e}",
+                }
+            ).encode()
+        if self.delta_state is not None:
+            acked = self.delta_state.apply_frame(pkt)
+        else:
+            acked = int(pkt["seq"])
+        if inject("delta/sync-loss"):
+            raise DropConnection()
+        # delta-json-control: the tiny ack stays JSON
+        return json.dumps(
+            {"id": pkt["id"], "ok": True, "acked": acked}
+        ).encode()
+
+    def _delta_compact(self, req) -> bytes:
+        """Fold barrier: fold buffered deltas <= up_to into the local
+        base through the existing columnar write path, retaining the
+        previous fold's pinned base version for in-flight snapshots.
+        No-op ack on shared-catalog servers and on re-shipped
+        barriers (idempotent)."""
+        c = req["delta_compact"]
+        if self.delta_state is not None:
+            acked = self.delta_state.apply_compact(
+                int(c["up_to"]), int(c["seq"])
+            )
+        else:
+            acked = int(c["seq"])
+        return json.dumps(
+            {"id": req.get("id"), "ok": True, "acked": acked}
+        ).encode()
+
+    def _delta_status(self, req) -> bytes:
+        """Replica-state introspection (tests + chaos invariants)."""
+        state = (
+            self.delta_state.status()
+            if self.delta_state is not None else None
+        )
+        return json.dumps(
+            {"id": req.get("id"), "ok": True, "delta": state}
+        ).encode()
 
     def _engine_status(self, req) -> bytes:
         """Worker introspection frame (tests + chaos invariants): the
@@ -1076,22 +1223,46 @@ class EngineClient:
             raise
         return accepted
 
+    def delta_sync_encoded(self, payload: bytes) -> int:
+        """Ship one pre-encoded binary delta-sync frame
+        (storage/delta.py encode_entry_frames); returns the worker's
+        acked seq. The correlation id and auth splice in at the byte
+        level — the delta data plane serializes each entry exactly
+        once, like the shuffle push path."""
+        if self._dead:
+            raise ConnectionError("engine connection is poisoned; reconnect")
+        self._next_id += 1
+        frame = wire.splice_id_auth(payload, self._next_id, self._secret)
+        resp = self._roundtrip(frame)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"delta sync rejected: {resp.get('error', '')}"
+            )
+        return int(resp.get("acked", 0))
+
     def execute_plan(
-        self, plan, schema_version: Optional[int] = None, frag=None
+        self, plan, schema_version: Optional[int] = None, frag=None,
+        snap=None,
     ) -> Tuple[List[str], List[tuple]]:
         cols, rows, _resp = self.execute_plan_full(
-            plan, schema_version=schema_version, frag=frag
+            plan, schema_version=schema_version, frag=frag, snap=snap
         )
         return cols, rows
 
     def execute_plan_full(
-        self, plan, schema_version: Optional[int] = None, frag=None
+        self, plan, schema_version: Optional[int] = None, frag=None,
+        snap=None,
     ) -> Tuple[List[str], List[tuple], dict]:
         """execute_plan plus the raw response — fragment dispatches read
-        the worker's span list and runtime stats out of it."""
+        the worker's span list and runtime stats out of it. ``snap``
+        (the routed snapshot: pinned base versions + delta fold/seq)
+        rides every dispatch of one query so all its fragments read
+        one consistent base."""
         req = {"v": IR_VERSION, "plan": plan_to_ir(plan)}
         if schema_version is not None:
             req["schema_v"] = int(schema_version)
+        if snap is not None:
+            req["snap"] = snap
         if frag is not None:
             # fragment metadata (query id / fragment id / attempt): the
             # trace context — echoed in the response for the

@@ -1425,6 +1425,16 @@ class Session(DDLMixin):
                 # the group would never converge to its fill rate
                 bill_t0 = time.perf_counter()
             res = self._execute_stmt_inner(s, bill_t0)
+            if isinstance(s, (
+                ast.Insert, ast.Update, ast.Delete, ast.LoadData,
+                ast.TruncateTable,
+            )) or (
+                isinstance(s, ast.TxnControl) and s.op == "commit"
+            ):
+                # read-your-writes high-water: EVERY statement shape
+                # that can capture delta entries moves it — txn COMMIT
+                # and TRUNCATE land reload markers just like DML
+                self._note_delta_hwm()
             self._maybe_auto_analyze(s)
             if top:
                 # FOUND_ROWS()/ROW_COUNT() session state (builtin_info.go)
@@ -4058,6 +4068,35 @@ class Session(DDLMixin):
 
                 _tsdb.clear_scan_hint()
 
+    def _note_delta_hwm(self) -> None:
+        """Record this session's high-water delta seq after a DML
+        statement — the seq read-your-writes routed reads block on
+        (storage/delta.py prepare_read)."""
+        ds = getattr(self.catalog, "delta_store", None)
+        if ds is not None:
+            self._delta_hwm = ds.high_seq()
+
+    def _delta_read_seq(self, sched):
+        """Resolve a routed read's delta snapshot seq under the
+        session's tidb_tpu_read_freshness mode, or None when the delta
+        tier is not in play. read_your_writes ships + blocks until the
+        fleet acked this session's high-water seq — a timeout raises
+        (never a silent stale read); bounded returns the already-acked
+        floor with zero wait."""
+        ds = getattr(self.catalog, "delta_store", None)
+        repl = getattr(sched, "delta", None)
+        if ds is None or repl is None:
+            return None
+        mode = str(self.vars.get("tidb_tpu_read_freshness"))
+        return repl.prepare_read(
+            mode,
+            hwm=getattr(self, "_delta_hwm", 0),
+            kill_check=self.killer.check,
+            timeout_s=float(
+                self.vars.get("tidb_tpu_delta_sync_timeout_s")
+            ),
+        )
+
     #: schemas whose virtual tables reflect THIS process's state — a
     #: plan scanning them must never ship to the worker fleet
     _LOCAL_ONLY_DBS = frozenset(
@@ -4159,6 +4198,11 @@ class Session(DDLMixin):
                     self._bill_exclude_s = getattr(
                         self, "_bill_exclude_s", 0.0
                     ) + waited
+            # HTAP freshness: resolve the delta snapshot seq BEFORE
+            # the dispatch try — a read-your-writes ack timeout is a
+            # statement error the user sees, never a local fallback
+            # masquerading as fleet execution
+            delta_seq = self._delta_read_seq(sched)
             try:
                 # fleet-wide cancellation: the session killer (KILL
                 # QUERY + max_execution_time deadline) is polled while
@@ -4170,6 +4214,7 @@ class Session(DDLMixin):
                     plan, cut_hint=(kind, cut),
                     kill_check=self.killer.check,
                     deadline=self.killer.deadline or None,
+                    delta_seq=delta_seq,
                 )
                 dispatched = True
             except (QueryKilled, QuotaExceeded):
@@ -4241,6 +4286,23 @@ class Session(DDLMixin):
                 {k: v for k, v in f.items() if k != "spans"}
                 for f in lq["fragments"]
             ]
+            deltas = [
+                f["delta"] for f in lq["fragments"] if f.get("delta")
+            ]
+            if deltas:
+                # worker-side delta-merge stats: the slow-log capture's
+                # DeltaMerge row + detail.delta in serve-load
+                snap["delta"] = {
+                    "depth": max(
+                        int(d.get("depth", 0)) for d in deltas
+                    ),
+                    "ins_rows": sum(
+                        int(d.get("ins_rows", 0)) for d in deltas
+                    ),
+                    "del_keys": max(
+                        int(d.get("del_keys", 0)) for d in deltas
+                    ),
+                }
         self._last_dcn_snapshot = snap
         if throttled:
             # RU debit for the FLEET-specific cost the statement
@@ -6028,14 +6090,40 @@ class Session(DDLMixin):
         distributed plan tree — per-host fragment rows, Shuffle
         exchange rows), and fragmentable/shuffleable SELECTs execute
         across the worker fleet (PR 6, _try_dcn_select). CONTRACT:
-        attaching asserts the workers hold current copies of the
-        scanned user tables (dcn_worker's deterministic-load model);
-        coordinator-local writes are NOT replicated to the fleet, so
-        a diverged table reads the workers' data. Transactions, stale
-        reads, system schemas and internal dbs always run locally,
-        and a fleet dispatch failure falls back to local execution.
-        Pass None to detach."""
+        attaching asserts the workers hold copies of the scanned user
+        tables as of the deterministic load (dcn_worker's model);
+        with the HTAP delta tier enabled (tidb_tpu_delta_store, the
+        default) coordinator DML captures into the catalog's
+        DeltaStore, replicates to delta-replica workers over the
+        engine-RPC seam, and routed reads merge a snapshot-isolated
+        (fold, seq) window under the tidb_tpu_read_freshness mode —
+        so writes no longer silently diverge routed SELECTs.
+        Transactions, stale reads, system schemas and internal dbs
+        always run locally, and a fleet dispatch failure falls back
+        to local execution. Pass None to detach."""
         self.dcn_scheduler = scheduler
+        if scheduler is None:
+            return
+        try:
+            enabled = str(
+                self.vars.get("tidb_tpu_delta_store")
+            ).lower() not in ("0", "off", "false")
+        except KeyError:
+            enabled = True
+        if not enabled or not hasattr(scheduler, "attach_delta"):
+            return
+        from tidb_tpu.storage.delta import DeltaStore
+
+        store = DeltaStore.attach(self.catalog)
+        scheduler.attach_delta(
+            store,
+            compact_interval_s=float(
+                self.vars.get("tidb_tpu_delta_compact_interval_s")
+            ),
+            compact_depth=int(
+                self.vars.get("tidb_tpu_delta_compact_depth")
+            ),
+        )
 
     def _run_explain(self, s: ast.Explain) -> Result:
         if not isinstance(s.stmt, (ast.Select, ast.Union, ast.With)):
@@ -6049,7 +6137,9 @@ class Session(DDLMixin):
                 from tidb_tpu.planner.fragmenter import Unschedulable
 
                 try:
-                    _cols, _rows, lines = sched.explain_analyze(plan)
+                    _cols, _rows, lines = sched.explain_analyze(
+                        plan, delta_seq=self._delta_read_seq(sched)
+                    )
                     lines = lines + _compile_cost_lines()
                     # the instrumented lines ARE the plan capture: an
                     # over-threshold EXPLAIN ANALYZE's slow-log entry
@@ -6130,6 +6220,14 @@ def _dcn_runtime_lines(lq) -> List[str]:
     )
 
     lq = lq or {}
+    delta_lines = []
+    if lq.get("delta"):
+        d = lq["delta"]
+        delta_lines = [
+            f"DeltaMerge depth={int(d.get('depth', 0))} "
+            f"ins_rows={int(d.get('ins_rows', 0))} "
+            f"delete_keys={int(d.get('del_keys', 0))}"
+        ]
     if lq.get("shuffle_stages"):
         # shuffle DAG: one DCNShuffle row PER STAGE (stage=i/n,
         # exchange kind, per-stage phase seconds), same grammar
@@ -6140,14 +6238,14 @@ def _dcn_runtime_lines(lq) -> List[str]:
                 lines, stage,
                 [f for f in frags if f.get("stage", 0) == si],
             )
-        return lines
+        return lines + delta_lines
     if lq.get("shuffle"):
         return _merge_shuffle_stats(
             [], lq["shuffle"], lq.get("fragments") or []
-        )
+        ) + delta_lines
     if lq.get("fragments"):
-        return _merge_frag_stats([], lq["fragments"])
-    return []
+        return _merge_frag_stats([], lq["fragments"]) + delta_lines
+    return delta_lines
 
 
 _cte_scratch_seq = itertools.count(1)

@@ -100,6 +100,12 @@ class Catalog:
                 # (reference: pkg/ddl/sequence.go)
                 raise ValueError(f"sequence {name!r} exists")
             t = Table(name, schema)
+            # HTAP delta capture: a catalog with an attached DeltaStore
+            # (storage/delta.py DeltaStore.attach) wires every NEW
+            # table too — DML on it replicates like the rest
+            ds = getattr(self, "delta_store", None)
+            if ds is not None and not db.startswith("_"):
+                t.delta_log = (ds, db)
             self._dbs[db][name] = t
             self.schema_version += 1
             return t

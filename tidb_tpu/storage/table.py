@@ -173,6 +173,12 @@ class Table:
         # are derived per-version sorted permutations, so deletions
         # never leave stale entries behind.
         self.index_states: Dict[str, str] = {}
+        # HTAP delta capture (storage/delta.py): (DeltaStore, db name)
+        # or None. Every mutation primitive reports its LOGICAL delta
+        # (insert blocks / delete keys / reload marker) AFTER releasing
+        # the table lock — the delta log has its own lock class and the
+        # two must never nest, in either order.
+        self.delta_log = None
         # partitioning (reference: pkg/table/tables/partition.go):
         # ("range", col, [(pname, upper-or-None raw-encoded)]) or
         # ("hash", col, nparts) or None. Appended blocks are SPLIT by
@@ -457,6 +463,42 @@ class Table:
         v, _uids = self.append_block_uids(block)
         return v
 
+    # -- HTAP delta capture (storage/delta.py) -------------------------
+    def _delta_notify(self, kind: str, blocks=None, keys=None,
+                      key_col=None) -> None:
+        """Report one committed mutation's logical delta to the
+        attached DeltaStore. Called with the table lock RELEASED. A
+        failing typed capture escalates to a reload marker (full
+        resync — always correct) rather than silently diverging the
+        fleet's replicas."""
+        log = self.delta_log
+        if log is None:
+            return
+        store, db = log
+        try:
+            if kind == "insert":
+                store.on_append(self, db, blocks)
+            elif kind == "delete":
+                store.on_delete(self, db, keys, key_col)
+            else:
+                store.on_reload(self, db)
+        except Exception:
+            store.on_reload(self, db)
+
+    def _delta_key_col(self):
+        """The delete-key column typed deltas may ship: a single-column
+        integer-encoded PRIMARY KEY. String PKs are dictionary-coded —
+        codes shift as the dictionary grows, so they cannot cross the
+        replica seam as bare ints (those tables resync via reload
+        markers instead)."""
+        pk = self.schema.primary_key
+        if not pk or len(pk) != 1:
+            return None
+        typ = self.schema.types.get(pk[0])
+        if typ is None or typ.kind == Kind.STRING:
+            return None
+        return pk[0]
+
     def append_block_uids(self, block: HostBlock):
         """Append rows; returns (new version id, uids of the landed
         blocks). The uid list lets bulk-ingest finalizers (DXF import)
@@ -478,7 +520,10 @@ class Table:
             self.version += 1
             self._versions[self.version] = new_blocks
             self._gc_versions()
-            return self.version, [b.uid for b in landed]
+            out = (self.version, [b.uid for b in landed])
+        if block.nrows:
+            self._delta_notify("insert", blocks=landed)
+        return out
 
     def _check_not_null(self, block: HostBlock) -> None:
         """NOT NULL enforcement on every block-install path (append,
@@ -714,6 +759,12 @@ class Table:
         appended concurrently after the caller computed its masks are
         kept whole — masks only ever apply to the blocks they were
         computed from (a shorter mask list must never drop the tail)."""
+        kc = (
+            self._delta_key_col() if self.delta_log is not None else None
+        )
+        typed = kc is not None
+        removed_keys: List[np.ndarray] = []
+        removed_any = False
         with self._lock:
             self.modify_count += sum(
                 int((~k).sum()) for k in keep_mask_per_block
@@ -729,6 +780,17 @@ class Table:
                 if keep is None or keep.all():
                     new_blocks.append(block)
                     continue
+                removed_any = True
+                if typed:
+                    c = block.columns.get(kc)
+                    if c is None or not np.issubdtype(
+                        c.data.dtype, np.integer
+                    ):
+                        typed = False
+                    else:
+                        removed_keys.append(
+                            c.data[~keep].astype(np.int64)
+                        )
                 idx = np.nonzero(keep)[0]
                 cols = {
                     n: HostColumn(c.type, c.data[idx], c.valid[idx], c.dictionary)
@@ -740,7 +802,16 @@ class Table:
             self.version += 1
             self._versions[self.version] = [b for b in new_blocks if b.nrows > 0]
             self._gc_versions()
-            return self.version
+            v = self.version
+        if removed_any:
+            if typed and removed_keys:
+                self._delta_notify(
+                    "delete",
+                    keys=np.concatenate(removed_keys), key_col=kc,
+                )
+            else:
+                self._delta_notify("reload")
+        return v
 
     def purge_expired(self, col: str, cutoff: int) -> int:
         """TTL expiry: atomically delete rows whose `col` < cutoff
@@ -776,7 +847,9 @@ class Table:
                 self.version += 1
                 self._versions[self.version] = new_blocks
                 self._gc_versions()
-            return removed
+        if removed:
+            self._delta_notify("reload")
+        return removed
 
     def install_commit(
         self,
@@ -801,7 +874,9 @@ class Table:
             self.dictionaries = dict(dictionaries)
             self.autoinc_next = int(autoinc_next)
             self._gc_versions()
-            return self.version
+            v = self.version
+        self._delta_notify("reload")
+        return v
 
     def replace_blocks(
         self, blocks: List[HostBlock], modified_rows: Optional[int] = None
@@ -822,7 +897,9 @@ class Table:
             self.version += 1
             self._versions[self.version] = blocks
             self._gc_versions()
-            return self.version
+            v = self.version
+        self._delta_notify("reload")
+        return v
 
     def clear_rows(self) -> int:
         """Truncate (new empty version); dictionaries are kept so code
@@ -831,7 +908,9 @@ class Table:
             self.version += 1
             self._versions[self.version] = []
             self._gc_versions()
-            return self.version
+            v = self.version
+        self._delta_notify("reload")
+        return v
 
     # -- partition management (reference: pkg/ddl/partition.go
     # onAddTablePartition / onDropTablePartition /

@@ -13,6 +13,61 @@ import dataclasses
 from typing import Dict, Optional
 
 
+def _toml_scalar(s: str):
+    """One TOML scalar of the subset the config surface uses: quoted
+    strings, booleans, ints, floats."""
+    s = s.strip()
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in ("'", '"'):
+        return s[1:-1]
+    if s in ("true", "false"):
+        return s == "true"
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        raise ValueError(f"unsupported TOML value {s!r}")
+
+
+def _parse_toml_subset(text: str) -> Dict:
+    """Minimal TOML parser for the config file shape (``key = value``
+    scalars plus one-level ``[table]`` sections, ``#`` comments) —
+    the fallback when the interpreter has no tomllib (< 3.11) and the
+    container has no tomli. Anything outside the subset raises, so a
+    fancy config fails loudly instead of half-loading."""
+    out: Dict[str, object] = {}
+    target = out
+    for lineno, line in enumerate(text.splitlines(), 1):
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if s.startswith("[") and s.endswith("]"):
+            name = s[1:-1].strip()
+            if not name or "." in name:
+                raise ValueError(
+                    f"line {lineno}: unsupported TOML table {s!r}"
+                )
+            target = out.setdefault(name, {})
+            continue
+        if "=" not in s:
+            raise ValueError(f"line {lineno}: expected key = value")
+        key, _, val = s.partition("=")
+        val = val.strip()
+        # strip a trailing comment: after the closing quote for quoted
+        # values, anywhere for bare scalars (subset: quoted values
+        # contain no quotes or '#')
+        if val.startswith(('"', "'")):
+            end = val.find(val[0], 1)
+            if end > 0:
+                val = val[: end + 1]
+        elif "#" in val:
+            val = val.split("#", 1)[0]
+        target[key.strip()] = _toml_scalar(val)
+    return out
+
+
 @dataclasses.dataclass
 class Config:
     host: str = "127.0.0.1"
@@ -32,8 +87,15 @@ class Config:
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
-        import tomllib
-
+        try:
+            import tomllib
+        except ModuleNotFoundError:
+            # Python < 3.11 without tomli: the config surface here is
+            # a flat TOML subset (scalars + one-level [tables]) — the
+            # gated fallback parser keeps the server binary bootable
+            # instead of failing --config at import time
+            with open(path, encoding="utf-8") as f:
+                return cls.from_dict(_parse_toml_subset(f.read()))
         with open(path, "rb") as f:
             raw = tomllib.load(f)
         return cls.from_dict(raw)

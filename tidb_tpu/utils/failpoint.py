@@ -48,6 +48,11 @@ SITES = frozenset({
     "ddl/modify-column-delta-retry",
     "ddl/modify-column-reorg",
     "ddl/rename-table",
+    "delta/apply",
+    "delta/capture",
+    "delta/compact-apply",
+    "delta/ship",
+    "delta/sync-loss",
     "dml/delete",
     "dml/insert",
     "dml/load",

@@ -60,6 +60,13 @@ LOCK_CLASSES: Dict[str, str] = {
     "logbackup.advance": "whole-advance serialization per backup task",
     "storage.external": "process-global in-memory object-store buckets",
     "storage.native": "lazy build + load of the native .so",
+    "storage.delta": "HTAP coordinator delta log (capture runs with "
+                     "the table lock RELEASED — no table edge)",
+    "storage.delta_replica": "worker replica delta buffers + fold/"
+                             "resolve serialization (reentrant; folds "
+                             "acquire 'table' beneath it)",
+    "storage.compactor": "delta replicator acked-seq map + compaction "
+                         "barrier state",
     "storage.txn_wait": "pessimistic lock-manager wait state (condition)",
     "storage.txn_id": "global txn id allocator",
     # dxf / sessions
@@ -124,6 +131,7 @@ LOCK_CLASSES: Dict[str, str] = {
 THREAD_NAME_PREFIXES = frozenset({
     "cdc",
     "dcn",
+    "delta",
     "dxf",
     "engine",
     "http",

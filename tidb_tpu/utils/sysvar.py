@@ -125,6 +125,34 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   _int_range(1, 100),
                   "consecutive missed heartbeats that quarantine a "
                   "worker host into the prober"),
+        # HTAP delta tier (storage/delta.py): coordinator DML deltas
+        # replicate to the fleet; routed reads merge a (fold, seq)
+        # snapshot; a background compactor folds the log into the
+        # workers' columnar base blocks.
+        SysVarDef("tidb_tpu_delta_store", True, "global", _bool,
+                  "capture + replicate coordinator DML as delta "
+                  "batches when a DCN scheduler is attached (OFF "
+                  "restores the static-snapshot attach contract: "
+                  "writes silently diverge the fleet)"),
+        SysVarDef("tidb_tpu_read_freshness", "read_your_writes",
+                  "both", _enum("read_your_writes", "bounded"),
+                  "routed-read freshness: read_your_writes blocks "
+                  "dispatch until every alive worker acked the "
+                  "session's high-water delta seq; bounded reads at "
+                  "the fleet's already-acked floor with zero wait"),
+        SysVarDef("tidb_tpu_delta_sync_timeout_s", 30.0, "both",
+                  _float_range(0.1, 3600.0),
+                  "seconds a read-your-writes dispatch waits for "
+                  "fleet delta acks before erroring (never a silent "
+                  "stale read)"),
+        SysVarDef("tidb_tpu_delta_compact_depth", 32, "global",
+                  _int_range(1, 1 << 20),
+                  "buffered delta entries on any one table that "
+                  "trigger a background fold barrier"),
+        SysVarDef("tidb_tpu_delta_compact_interval_s", 0.5, "global",
+                  _float_range(0.0, 3600.0),
+                  "delta-compactor daemon cadence (0 = no background "
+                  "thread; folds run only via explicit compact_now)"),
         # metric time-series tier (obs/tsdb.py — the metrics_schema
         # retention store; a live SET re-tunes the running sampler and
         # rings, session.py SetVariable hook). GLOBAL-only like the
