@@ -36,7 +36,10 @@ import sys
 #: fault-injection harness (PR 10, tidb_tpu/chaos/), tsdb = the
 #: metric time-series store behind metrics_schema (PR 12,
 #: obs/tsdb.py — sampler overhead self-metrics), inspection = the
-#: declared-rule diagnosis engine (PR 12, obs/inspection.py).
+#: declared-rule diagnosis engine (PR 12, obs/inspection.py),
+#: topsql = the fleet-wide Top SQL continuous profiler (PR 14,
+#: obs/profiler.py — per-digest cpu/device/stall attribution series
+#: plus sampler self-metrics).
 SUBSYSTEMS = frozenset({
     "admission",
     "chaos",
@@ -51,6 +54,7 @@ SUBSYSTEMS = frozenset({
     "shuffle",
     "stats",
     "timeline",
+    "topsql",
     "tsdb",
     "ttl",
     "watchdog",

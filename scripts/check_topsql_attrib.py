@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Lint: Top SQL sample-attribution categories declared in
+tidb_tpu/obs/profiler.py CATEGORIES must match the literal
+``begin_task``/``task_context`` registration sites, and every declared
+category must be registered somewhere.
+
+Why: the category vocabulary is an API — the
+tidbtpu_topsql_samples_total{category} series and the attribution
+story ("which tier of the engine was this sample charged through")
+both key on it. ``begin_task`` already rejects undeclared names at
+runtime, but a dead declaration (a category nothing registers)
+silently rots into an always-zero series; the same pattern as
+scripts/check_flight_phases.py for flight PHASES. Three rules:
+
+  1. every literal ``begin_task("name", ...)`` or
+     ``task_context("name", ...)`` site in engine code must name a
+     declared category (the runtime check made static);
+  2. every name in CATEGORIES must have at least one literal
+     registration site OUTSIDE profiler.py itself (the registry
+     module hosting its own call site would trivially satisfy the
+     liveness rule);
+  3. a NON-LITERAL first argument at a registration site fails — the
+     attribution vocabulary must be statically readable.
+
+The AST walk resolves both spellings (``begin_task(...)`` and
+``profiler.begin_task(...)`` / ``_topsql.begin_task(...)``) by
+matching the terminal attribute/function name.
+
+Usage: python scripts/check_topsql_attrib.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+PROFILER_REL = os.path.join("tidb_tpu", "obs", "profiler.py")
+REGISTER_FUNCS = frozenset({"begin_task", "task_context"})
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules",
+             "tests"}
+SKIP_FILES = {
+    os.path.join("scripts", "check_topsql_attrib.py"),
+}
+
+
+def load_categories(root: str):
+    """The CATEGORIES literal via the AST (profiler.py imports the
+    package; exec'ing it standalone would need the engine importable
+    from the lint — the check_flight_phases.py approach)."""
+    path = os.path.join(root, PROFILER_REL)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "CATEGORIES"
+            for t in node.targets
+        ):
+            return tuple(ast.literal_eval(node.value))
+    raise SystemExit(f"CATEGORIES assignment not found in {path}")
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def check(root: str):
+    categories = load_categories(root)
+    declared = set(categories)
+    violations = []
+    if len(categories) != len(declared):
+        violations.append(
+            (PROFILER_REL, 1, "duplicate names in CATEGORIES")
+        )
+    used = {}
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        if rel in SKIP_FILES or rel == PROFILER_REL:
+            # the registry module delegates through its own wrappers
+            # (task_context -> begin_task with a bound variable);
+            # those are the API, not attribution sites
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in REGISTER_FUNCS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+            ):
+                violations.append(
+                    (rel, node.lineno,
+                     "non-literal topsql attribution category (the "
+                     "vocabulary must be statically readable)")
+                )
+                continue
+            name = arg.value
+            used.setdefault(name, (rel, node.lineno))
+            if name not in declared:
+                violations.append(
+                    (rel, node.lineno,
+                     f"undeclared topsql attribution category "
+                     f"{name!r} (declare it in tidb_tpu/obs/"
+                     "profiler.py CATEGORIES)")
+                )
+    for name in categories:
+        if name not in used:
+            violations.append(
+                (PROFILER_REL, 1,
+                 f"declared topsql attribution category {name!r} has "
+                 "no begin_task/task_context registration site "
+                 "outside profiler.py (dead declaration)")
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} topsql-attribution violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

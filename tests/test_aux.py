@@ -204,29 +204,47 @@ class TestTopSQLAndReplayer:
     (reference: pkg/util/topsql; optimizor/plan_replayer.go)."""
 
     def test_top_sql_ranking(self):
+        import time as _time
+
+        from tidb_tpu.obs.profiler import TOPSQL
         from tidb_tpu.session import Session
         from tidb_tpu.utils.metrics import STMT_SUMMARY
 
-        # the summary store is process-global; other suites' heavier
-        # statements can push this one's digest past the top-30 cap in a
-        # full-suite run — start from a clean store for determinism
+        # the summary + profiler stores are process-global; start
+        # clean for determinism in full-suite runs
         STMT_SUMMARY.reset()
+        TOPSQL.stop()
+        TOPSQL.store.reset()
         s = Session()
         s.execute("create database d")
         s.execute("use d")
         s.execute("create table t (a int)")
         s.execute("insert into t values (1), (2)")
-        for _ in range(3):
-            s.execute("select sum(a) from t")
+        # sampler OFF: an informative hint row, never a silent
+        # latency re-ranking (PR 14 — the old stub's behavior)
         rows = s.execute(
-            "select rank, digest_text, exec_count from "
-            "information_schema.top_sql order by rank"
+            "select rank, digest_text from information_schema.top_sql"
         ).rows
-        assert rows and rows[0][0] == 1
-        # the summary store is process-global (other suites' statements
-        # share it): assert presence + rank monotonicity, not position
-        mine = [r for r in rows if "select sum" in r[1]]
-        assert mine and mine[0][2] >= 3
+        assert len(rows) == 1 and rows[0][0] == 0
+        assert "tidb_enable_top_sql" in rows[0][1]
+        s.execute("set global tidb_enable_top_sql = ON")
+        try:
+            t0 = _time.time()
+            while _time.time() - t0 < 0.5:
+                s.execute("select sum(a) from t")
+            rows = s.execute(
+                "select rank, digest_text, exec_count, cpu_ms, "
+                "device_ms from information_schema.top_sql "
+                "order by rank"
+            ).rows
+            assert rows and rows[0][0] == 1
+            mine = [r for r in rows if "select sum" in r[1]]
+            assert mine and mine[0][2] >= 3
+            # sampled attribution is the ranking signal now
+            assert mine[0][3] + mine[0][4] > 0
+        finally:
+            s.execute("set global tidb_enable_top_sql = OFF")
+            TOPSQL.store.reset()
 
     def test_plan_replayer_dump(self, tmp_path, monkeypatch):
         import zipfile

@@ -1339,3 +1339,114 @@ def test_dcn_delta_writes_mid_run_freshness_modes(tpch_single):
         sched.close()
         for w in (w1, w2):
             w.kill()
+
+def test_dcn_topsql_fleet_attribution(tpch_single):
+    """PR 14 acceptance: with ``tidb_enable_top_sql = ON`` the
+    2-process x 4-device dryrun attributes sampled CPU per statement
+    digest on EVERY host — workers arm their samplers from the
+    dispatch-carried config, attribute task samples to the dispatched
+    digest (so a finished/foreign qid can never be charged), and ship
+    windows + collapsed stacks piggybacked on the fenced replies.
+    information_schema.top_sql then shows per-instance rows for both
+    workers, the tsdb series carry clock-rebased worker windows, and
+    the merged /profile export is non-empty."""
+    import time as _time
+
+    from tidb_tpu.obs.profiler import OTHERS_DIGEST, TOPSQL, digest_of
+    from tidb_tpu.obs.tsdb import TSDB
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.utils.metrics import sql_digest
+
+    w1, p1 = _spawn_dcn_worker()
+    w2, p2 = _spawn_dcn_worker()
+    sess = tpch_single
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p1), ("127.0.0.1", p2)],
+        catalog=sess.catalog,
+        shuffle_mode="always",
+    )
+    sess.attach_dcn_scheduler(sched)
+    TOPSQL.store.reset()
+    t_run0 = _time.time()
+    worker_addrs = {f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"}
+    try:
+        sess.execute("set global tidb_enable_top_sql = ON")
+        assert TOPSQL.running()
+        q = SHUFFLE_QUERIES[0]
+        exp = sess.must_query(q).rows
+        # several rounds so worker samplers (armed by the FIRST
+        # dispatch's config) accumulate samples on later tasks
+        for _ in range(4):
+            got = sess.execute(q)
+            assert [tuple(r) for r in got.rows] == exp
+        # the heartbeat idle-flush ships anything still pending
+        sched.heartbeat.beat_once()
+
+        rows = sess.execute(
+            "select rank, instance, digest, cpu_ms, device_ms, "
+            "stall_ms, samples from information_schema.top_sql "
+            "order by rank, instance"
+        ).rows
+        assert rows
+        hosts = {r[1] for r in rows}
+        assert worker_addrs <= hosts, (
+            f"top_sql missing a worker instance: {hosts}"
+        )
+        assert "coordinator" in hosts
+        # every worker row carries real sampled attribution
+        for r in rows:
+            if r[1] in worker_addrs:
+                assert r[6] > 0  # samples
+                assert r[3] + r[4] + r[5] > 0  # cpu+device+stall ms
+
+        # zero attribution to finished/foreign qids: workers learn
+        # digests ONLY from dispatches, so every worker-side digest
+        # must be one this coordinator actually ran (or the fold-in
+        # aggregate) — a foreign coordinator's digest cannot appear
+        ran = {
+            digest_of(sql_digest(stmt))
+            for stmt in (q, "set global tidb_enable_top_sql = ON")
+        }
+        for r in TOPSQL.store.rows():
+            if r["instance"] in worker_addrs:
+                assert r["digest"] in ran | {OTHERS_DIGEST}, (
+                    f"foreign digest {r['digest']} attributed on "
+                    f"{r['instance']}"
+                )
+
+        # worker windows reached the tsdb CLOCK-REBASED: every stored
+        # point of the topsql families sits inside the run's
+        # coordinator-clock window (a skewed/unrebased worker stamp
+        # would land outside)
+        pts = [
+            (t, host)
+            for t, host, _lv, _v, _res in TSDB.query(
+                "tidbtpu_topsql_cpu_seconds"
+            )
+            if host in worker_addrs
+        ]
+        assert pts, "no worker topsql series reached the tsdb"
+        now = _time.time()
+        for t, host in pts:
+            assert t_run0 - 30 <= t <= now + 30, (
+                f"unrebased worker window ts {t} from {host}"
+            )
+
+        # the /profile export half: fleet-merged collapsed stacks are
+        # non-empty and include worker-shipped towers
+        merged = TOPSQL.store.collapsed()
+        assert merged
+        for addr in worker_addrs:
+            assert TOPSQL.store.collapsed(instance=addr), (
+                f"no collapsed stacks shipped from {addr}"
+            )
+        for line in merged:
+            stack, count = line.rsplit(" ", 1)
+            assert int(count) >= 1 and ";" in stack
+    finally:
+        sess.execute("set global tidb_enable_top_sql = OFF")
+        sess.attach_dcn_scheduler(None)
+        TOPSQL.store.reset()
+        sched.close()
+        for w in (w1, w2):
+            w.kill()

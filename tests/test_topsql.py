@@ -1,0 +1,654 @@
+"""Top SQL continuous profiler (obs/profiler.py): sampler lifecycle,
+per-digest attribution, bounded caps with evicted-digest fold-in,
+worker ship/merge round-trip, collapsed-stack export, the live sysvar
+hooks, the rewritten information_schema.top_sql, and the
+check_topsql_attrib house lint."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from tidb_tpu.obs import profiler  # noqa: E402
+from tidb_tpu.obs.profiler import (  # noqa: E402
+    CATEGORIES,
+    OTHERS_DIGEST,
+    TRUNCATED_STACK,
+    TopSqlProfiler,
+    TopSqlStore,
+    digest_of,
+)
+
+
+def _sampler_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name.startswith("obs-topsql-sampler") and t.is_alive()
+    ]
+
+
+class TestDigest:
+    def test_digest_stable_and_short(self):
+        d = digest_of("select sum ( a ) from t")
+        assert len(d) == 16
+        assert d == digest_of("select sum ( a ) from t")
+        assert d != digest_of("select count ( * ) from t")
+        # stable ACROSS PROCESSES (hash() is per-process salted; a
+        # salted digest could never join worker attributions to the
+        # coordinator's)
+        import subprocess
+
+        out = subprocess.run(
+            [
+                sys.executable, "-c",
+                "from tidb_tpu.obs.profiler import digest_of;"
+                "print(digest_of('select sum ( a ) from t'))",
+            ],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONHASHSEED": "77"},
+        )
+        assert out.stdout.strip() == d
+
+
+class TestSamplerLifecycle:
+    def test_start_stop_idempotence(self):
+        p = TopSqlProfiler(TopSqlStore(instance="t-lifecycle"))
+        n0 = len(_sampler_threads())
+        p.retune(0.05)
+        assert p.running()
+        assert len(_sampler_threads()) == n0 + 1
+        # same interval again: a no-op — no second thread
+        p.retune(0.05)
+        assert len(_sampler_threads()) == n0 + 1
+        # re-cadence: still exactly one
+        p.retune(0.01)
+        assert len(_sampler_threads()) == n0 + 1
+        p.stop()
+        p.stop()  # idempotent
+        assert not p.running()
+        deadline = time.time() + 5
+        while _sampler_threads() and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(_sampler_threads()) == n0
+
+    def test_apply_config_idempotent_and_off(self):
+        p = TopSqlProfiler(TopSqlStore(instance="t-cfg"))
+        p.apply_config({"on": True, "interval_s": 0.05,
+                        "max_digests": 7, "max_meta": 99})
+        assert p.running() and p.store.max_digests == 7
+        th = _sampler_threads()
+        p.apply_config({"on": True, "interval_s": 0.05,
+                        "max_digests": 7, "max_meta": 99})
+        assert _sampler_threads() == th  # unchanged config: no churn
+        p.apply_config(None)  # dispatch says profiler is off
+        assert not p.running()
+
+    def test_sample_pass_without_tasks_is_empty(self):
+        p = TopSqlProfiler(TopSqlStore(instance="t-empty"))
+        # no registered thread contexts: nothing attributed, nothing
+        # dropped (unregistered threads are invisible, not errors)
+        assert p.sample_once() == 0
+        assert p.store.status()["dropped"] == 0
+
+
+class TestAttribution:
+    def test_known_hot_digest_attributed(self):
+        p = TopSqlProfiler(TopSqlStore(instance="t-hot"))
+        stop = threading.Event()
+
+        def burn():
+            with profiler.task_context(
+                "statement", digest="feedbeeffeedbeef"
+            ):
+                while not stop.is_set():
+                    sum(i * i for i in range(500))
+
+        th = threading.Thread(target=burn, daemon=True,
+                              name="obs-topsql-test-burn")
+        th.start()
+        try:
+            p._last_pass = time.time()
+            for _ in range(20):
+                time.sleep(0.01)
+                p.sample_once()
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        rows = {
+            r["digest"]: r for r in p.store.rows()
+            if r["instance"] == "t-hot"
+        }
+        assert "feedbeeffeedbeef" in rows
+        r = rows["feedbeeffeedbeef"]
+        assert r["samples"] >= 10
+        assert r["cpu_s"] > 0
+        # the hot frame is the generator expression actually burning
+        assert "burn" in r["top_frame"] or "genexpr" in r["top_frame"]
+
+    def test_stall_classification_on_cv_wait(self):
+        p = TopSqlProfiler(TopSqlStore(instance="t-stall"))
+        ev = threading.Event()
+
+        def park():
+            with profiler.task_context(
+                "shuffle", digest="0123456789abcdef",
+                phase="shuffle-wait",
+            ):
+                ev.wait(timeout=5)
+
+        th = threading.Thread(target=park, daemon=True,
+                              name="obs-topsql-test-park")
+        th.start()
+        try:
+            time.sleep(0.05)
+            p._last_pass = time.time() - 0.02
+            p.sample_once()
+        finally:
+            ev.set()
+            th.join(timeout=5)
+        rows = {r["digest"]: r for r in p.store.rows()}
+        r = rows["0123456789abcdef"]
+        # parked in Event.wait -> stall, charged to the live phase the
+        # task context carries
+        assert r["stall_s"] > 0 and r["cpu_s"] == 0
+        assert "shuffle-wait" in r["by_phase"]
+
+    def test_undeclared_category_raises(self):
+        with pytest.raises(ValueError, match="undeclared"):
+            profiler.begin_task("not-a-category")
+
+    def test_long_statement_digest_matches_summary_digest(self):
+        # regression: the flight record truncates sql to 2048 chars
+        # for display; the attribution digest must come from the FULL
+        # statement or long queries fork from their summary join
+        from tidb_tpu.obs.flight import FLIGHT
+        from tidb_tpu.utils.metrics import sql_digest
+
+        sql = (
+            "select a from t where a in ("
+            + ", ".join(str(i) for i in range(1500))
+            + ")"
+        )
+        assert len(sql) > 2048
+        FLIGHT.begin(sql, 1)
+        try:
+            assert profiler.current_digest() == digest_of(
+                sql_digest(sql)
+            )
+        finally:
+            FLIGHT.finish(0.0)
+
+    def test_nested_task_context_restores(self):
+        with profiler.task_context("statement", digest="a" * 16):
+            assert profiler.current_digest() == "a" * 16
+            with profiler.task_context("fragment", digest="b" * 16):
+                assert profiler.current_digest() == "b" * 16
+            assert profiler.current_digest() == "a" * 16
+        assert profiler.current_digest() is None
+
+
+class TestStoreCaps:
+    def test_digest_cap_evicts_coldest_into_others(self):
+        st = TopSqlStore(instance="t-cap", max_digests=3)
+        # six digests with increasing heat; the cap keeps the hottest
+        for i, heat in enumerate([1, 2, 3, 4, 5, 6]):
+            d = f"{i:016x}"
+            for _ in range(heat):
+                st.record(d, "execute", "cpu", 0.01, f"root;f{i}")
+        local = [
+            r for r in st.rows()
+            if r["instance"] == "t-cap" and r["digest"] != OTHERS_DIGEST
+        ]
+        assert len(local) <= 3
+        others = [
+            r for r in st.rows() if r["digest"] == OTHERS_DIGEST
+        ]
+        assert others and others[0]["samples"] > 0
+        # seconds conserved: every recorded 0.01 is SOMEWHERE
+        total = sum(r["cpu_s"] for r in st.rows())
+        assert total == pytest.approx(0.01 * (1 + 2 + 3 + 4 + 5 + 6))
+
+    def test_retune_caps_live_shrinks(self):
+        st = TopSqlStore(instance="t-retune", max_digests=8)
+        for i in range(8):
+            st.record(f"{i:016x}", "execute", "cpu", 0.01, "r;f")
+        st.retune_caps(max_digests=2)
+        local = [
+            r for r in st.rows()
+            if r["instance"] == "t-retune"
+            and r["digest"] != OTHERS_DIGEST
+        ]
+        assert len(local) <= 2
+        assert st.max_digests == 2
+
+    def test_meta_cap_folds_stacks_into_truncated(self):
+        st = TopSqlStore(instance="t-meta", max_digests=4, max_meta=8)
+        for i in range(40):
+            st.record("d" * 16, "execute", "cpu", 0.001,
+                      f"root;leaf{i}")
+        r = [x for x in st.rows() if x["digest"] == "d" * 16][0]
+        assert r["samples"] == 40  # counts stay exact
+        assert st.status()["meta"] <= 8
+        merged = st.collapsed(digest="d" * 16)
+        assert any(TRUNCATED_STACK in line for line in merged)
+
+    def test_meta_count_stays_exact_under_eviction_churn(self):
+        # regression: _fold_into_others once decremented the cap-
+        # EXEMPT (truncated) bucket and leaked popped text meta —
+        # churn drifted the accountant until the caps lied
+        st = TopSqlStore(instance="t-drift", max_digests=2, max_meta=6)
+        for i in range(30):
+            d = f"{i:016x}"
+            st.note_text(d, f"select {i}")
+            for j in range(3):
+                st.record(d, "execute", "cpu", 0.001,
+                          f"root;leaf{i};{j}")
+        with st._lock:
+            counted = sum(
+                1
+                for (_inst, _d), ent in st._entries.items()
+                for s in ent.stacks
+                if s != TRUNCATED_STACK
+            ) + len(st._texts)
+            assert st._meta_count == counted
+        assert st.status()["meta"] <= st.max_meta
+
+    def test_registry_children_bounded_by_digest_cap(self):
+        # regression: evicting a digest from the store must also drop
+        # its per-digest REGISTRY counter children, or label (and
+        # tsdb series) cardinality grows with every digest EVER seen
+        from tidb_tpu.obs.profiler import _c_cpu_seconds
+
+        fam = _c_cpu_seconds()
+        fam.remove_matching(lambda lv: lv[0].startswith("cafe"))
+        st = TopSqlStore(instance="t-cards", max_digests=3)
+        for i in range(25):
+            st.record(f"cafe{i:012x}", "execute", "cpu", 0.001, "r;f")
+        live = {
+            lv[0] for lv, _c in fam.children()
+            if lv[0].startswith("cafe")
+        }
+        assert len(live) <= st.max_digests
+
+    def test_remote_merge_capped_per_instance(self):
+        st = TopSqlStore(instance="coord", max_digests=3)
+        payload = {
+            "agg": [
+                [f"{i:016x}", "execute", 0.01, 0.0, 0.0, 1]
+                for i in range(10)
+            ],
+            "stacks": [],
+        }
+        st.merge_remote(payload, instance="w1:1")
+        w1 = [
+            r for r in st.rows()
+            if r["instance"] == "w1:1" and r["digest"] != OTHERS_DIGEST
+        ]
+        assert len(w1) <= 3
+        # the overflow folded into the instance's (others), seconds
+        # conserved
+        total = sum(
+            r["cpu_s"] for r in st.rows() if r["instance"] == "w1:1"
+        )
+        assert total == pytest.approx(0.1)
+
+
+class TestShipMerge:
+    def test_ship_merge_roundtrip_and_at_most_once(self):
+        worker = TopSqlStore(instance="local", max_digests=10)
+        worker.record("a" * 16, "execute", "cpu", 0.02, "r;x")
+        worker.record("a" * 16, "shuffle-push", "stall", 0.01, "r;y")
+        worker.record("b" * 16, "execute", "device", 0.03, "r;z")
+        payload = worker.ship()
+        assert payload is not None
+        # at-most-once: the drain is destructive
+        assert worker.ship() is None
+        coord = TopSqlStore(instance="coordinator")
+        merged = coord.merge_remote(payload, instance="w:9")
+        assert merged > 0
+        rows = {
+            (r["instance"], r["digest"]): r for r in coord.rows()
+        }
+        ra = rows[("w:9", "a" * 16)]
+        assert ra["cpu_s"] == pytest.approx(0.02)
+        assert ra["stall_s"] == pytest.approx(0.01)
+        assert ra["by_phase"]["shuffle-push"][2] == pytest.approx(0.01)
+        rb = rows[("w:9", "b" * 16)]
+        assert rb["device_s"] == pytest.approx(0.03)
+        # stacks merged under the worker's instance for /profile
+        assert coord.collapsed(instance="w:9")
+
+    def test_malformed_payload_never_raises(self):
+        coord = TopSqlStore(instance="coordinator")
+        coord.merge_remote(
+            {"agg": [["only-two", "fields"], None, 42],
+             "stacks": [["x"], "nope"]},
+            instance="w:1",
+        )
+        coord.merge_remote(None, instance="w:1")
+        coord.merge_remote({"garbage": True}, instance="w:1")
+
+
+class TestCollapsed:
+    def test_collapsed_stack_roundtrip(self):
+        st = TopSqlStore(instance="t-fg")
+        st.record("e" * 16, "execute", "cpu", 0.120, "main;plan;exec")
+        st.record("e" * 16, "execute", "cpu", 0.080, "main;plan;exec")
+        st.record("e" * 16, "execute", "cpu", 0.050, "main;merge")
+        lines = st.collapsed()
+        # FlameGraph collapsed format: "frame;...;frame <int>", digest
+        # as the root frame; counts are milliseconds
+        parsed = {}
+        for line in lines:
+            stack, count = line.rsplit(" ", 1)
+            parsed[stack] = int(count)
+        key = f"{'e' * 16};main;plan;exec"
+        assert parsed[key] == 200
+        assert parsed[f"{'e' * 16};main;merge"] == 50
+        # filters
+        assert st.collapsed(digest="f" * 16) == []
+        assert st.collapsed(instance="t-fg") != []
+        assert st.collapsed(instance="nope") == []
+
+    def test_collapse_stack_frames_have_no_spaces(self):
+        frame = sys._getframe()
+        s = profiler.collapse_stack(frame)
+        assert " " not in s
+        assert "test_topsql" in s
+
+
+class TestRacecheckHammer:
+    def test_eight_thread_hammer_under_racecheck(self):
+        from tidb_tpu.utils import racecheck
+
+        was = racecheck.enabled()
+        racecheck.enable()
+        try:
+            st = TopSqlStore(instance="t-race", max_digests=8,
+                             max_meta=64)
+            p = TopSqlProfiler(st)
+            coord = TopSqlStore(instance="t-race-coord")
+            errs = []
+            done = []
+
+            def hammer(k):
+                try:
+                    for i in range(120):
+                        with profiler.task_context(
+                            "fragment", digest=f"{k:08x}{i % 12:08x}",
+                        ):
+                            st.record(
+                                f"{k:08x}{i % 12:08x}", "execute",
+                                ("cpu", "device", "stall")[i % 3],
+                                0.001, f"r;h{k};f{i % 5}",
+                            )
+                        if i % 17 == 0:
+                            payload = st.ship()
+                            if payload:
+                                coord.merge_remote(
+                                    payload, instance=f"w{k % 2}"
+                                )
+                        if i % 29 == 0:
+                            st.retune_caps(
+                                max_digests=6 + (i % 3)
+                            )
+                        if i % 13 == 0:
+                            st.rows()
+                            st.collapsed()
+                    done.append(k)
+                except Exception as e:  # pragma: no cover
+                    errs.append(f"{k}: {type(e).__name__}: {e}")
+
+            threads = [
+                threading.Thread(
+                    target=hammer, args=(k,), daemon=True,
+                    name=f"obs-topsql-hammer-{k}",
+                )
+                for k in range(8)
+            ]
+            p.retune(0.005)  # a live sampler walks the hammer threads
+            try:
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert not [t for t in threads if t.is_alive()], (
+                    "hammer thread hung"
+                )
+            finally:
+                p.stop()
+            assert not errs, errs
+            assert len(done) == 8  # every hammer COMPLETED its loop
+            assert "obs.topsql" in racecheck.seen_classes()
+            assert "obs.topsql_sampler" in racecheck.seen_classes()
+        finally:
+            if not was:
+                racecheck.disable()
+
+
+class TestSysvarHooks:
+    def test_live_enable_caps_and_session_scope_errors(self):
+        from tidb_tpu.obs.profiler import TOPSQL
+        from tidb_tpu.session import Session
+
+        s = Session()
+        try:
+            # session-scoped SET errors loudly (the DCN-knob contract)
+            # — values chosen to PASS each knob's validator, so the
+            # raise is the scope check, not a range error
+            for name, val in (
+                ("tidb_enable_top_sql", "1"),
+                ("tidb_top_sql_max_time_series_count", "50"),
+                ("tidb_top_sql_max_meta_count", "500"),
+                ("tidb_tpu_topsql_sample_interval_s", "0.05"),
+            ):
+                with pytest.raises(ValueError, match="global"):
+                    s.execute(f"set {name} = {val}")
+            s.execute("set global tidb_top_sql_max_time_series_count = 41")
+            s.execute("set global tidb_top_sql_max_meta_count = 443")
+            s.execute(
+                "set global tidb_tpu_topsql_sample_interval_s = 0.011"
+            )
+            s.execute("set global tidb_enable_top_sql = ON")
+            assert TOPSQL.running()
+            assert TOPSQL.interval_s() == pytest.approx(0.011)
+            assert TOPSQL.store.max_digests == 41
+            assert TOPSQL.store.max_meta == 443
+            # caps re-tune LIVE while running (the PR 12 pattern)
+            s.execute("set global tidb_top_sql_max_time_series_count = 17")
+            assert TOPSQL.store.max_digests == 17
+            s.execute("set global tidb_enable_top_sql = 0")
+            assert not TOPSQL.running()
+        finally:
+            TOPSQL.stop()
+            TOPSQL.store.retune_caps(100, 5000)
+            TOPSQL.store.reset()
+
+
+class TestTopSqlTable:
+    def test_off_returns_hint_row_not_latency_reranking(self):
+        from tidb_tpu.obs.profiler import TOPSQL
+        from tidb_tpu.session import Session
+
+        TOPSQL.stop()
+        TOPSQL.store.reset()
+        s = Session()
+        rows = s.execute(
+            "select rank, instance, digest_text from "
+            "information_schema.top_sql"
+        ).rows
+        assert len(rows) == 1
+        assert rows[0][0] == 0
+        assert "tidb_enable_top_sql" in rows[0][2]
+
+    def test_on_ranks_hot_digest_first_with_phase_split(self):
+        from tidb_tpu.obs.profiler import TOPSQL
+        from tidb_tpu.session import Session
+
+        TOPSQL.store.reset()
+        s = Session()
+        s.execute("create database tsq")
+        s.execute("use tsq")
+        s.execute("create table t (a int)")
+        s.execute("insert into t values (1), (2), (3)")
+        s.execute("set global tidb_enable_top_sql = ON")
+        try:
+            t0 = time.time()
+            while time.time() - t0 < 0.7:
+                s.execute("select sum(a), count(*) from t where a > 0")
+            rows = s.execute(
+                "select rank, instance, digest, digest_text, cpu_ms, "
+                "device_ms, stall_ms, samples, top_phase, exec_count "
+                "from information_schema.top_sql order by rank"
+            ).rows
+            assert rows
+            top = rows[0]
+            assert top[0] == 1
+            assert top[1] == "coordinator"
+            assert "select sum" in top[3]
+            # the split is measured, nonzero, and attributed
+            assert top[4] + top[5] > 0  # cpu + device
+            assert top[7] >= 5  # samples
+            assert top[8] in (
+                "execute", "compile", "plan", "final-merge",
+            )
+            assert top[9] >= 3  # statements_summary join: exec_count
+        finally:
+            s.execute("set global tidb_enable_top_sql = OFF")
+            TOPSQL.store.reset()
+
+
+class TestAttribLint:
+    def test_head_tree_is_clean(self):
+        from check_topsql_attrib import check
+
+        assert check(REPO) == []
+
+    def test_declared_categories_match_runtime(self):
+        from check_topsql_attrib import load_categories
+
+        assert tuple(load_categories(REPO)) == CATEGORIES
+
+    def _tree(self, tmp_path, engine_src,
+              cats="(\n    \"statement\",\n    \"fragment\",\n)"):
+        obs = tmp_path / "tidb_tpu" / "obs"
+        obs.mkdir(parents=True)
+        (obs / "profiler.py").write_text(
+            f"CATEGORIES = {cats}\n"
+        )
+        (tmp_path / "tidb_tpu" / "engine.py").write_text(engine_src)
+        return str(tmp_path)
+
+    def test_seeded_undeclared_category_fails(self, tmp_path):
+        from check_topsql_attrib import check
+
+        root = self._tree(
+            tmp_path,
+            "from tidb_tpu.obs import profiler\n"
+            "def f():\n"
+            "    with profiler.task_context('statement'):\n"
+            "        pass\n"
+            "    profiler.begin_task('mystery')\n",
+        )
+        v = check(root)
+        assert any("undeclared" in msg for _f, _l, msg in v)
+        # 'fragment' is declared but never registered: dead
+        assert any("dead declaration" in msg for _f, _l, msg in v)
+
+    def test_seeded_nonliteral_category_fails(self, tmp_path):
+        from check_topsql_attrib import check
+
+        root = self._tree(
+            tmp_path,
+            "from tidb_tpu.obs.profiler import begin_task,"
+            " task_context\n"
+            "def f(cat):\n"
+            "    begin_task(cat)\n"
+            "    task_context('statement')\n"
+            "    begin_task('fragment')\n",
+        )
+        v = check(root)
+        assert any("non-literal" in msg for _f, _l, msg in v)
+
+    def test_seeded_clean_tree_passes(self, tmp_path):
+        from check_topsql_attrib import check
+
+        root = self._tree(
+            tmp_path,
+            "from tidb_tpu.obs.profiler import begin_task,"
+            " task_context\n"
+            "def f():\n"
+            "    begin_task('statement')\n"
+            "    with task_context('fragment'):\n"
+            "        pass\n",
+        )
+        assert check(root) == []
+
+    def test_lint_all_discovers_it(self):
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "lint_all.py"), "--list"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert "check_topsql_attrib.py" in out.stdout
+
+
+class TestInspectionRule:
+    def test_cpu_hog_digest_fires_on_synthetic_history(self):
+        from tidb_tpu.obs.inspection import InspectionEngine
+        from tidb_tpu.obs.tsdb import TimeSeriesStore
+
+        store = TimeSeriesStore()
+        now = time.time()
+        hog = "c0ffee0000000000"
+        # a hog burning 90% of the window vs a small background digest
+        for i, t in enumerate([now - 30, now - 20, now - 10, now]):
+            store.merge_remote(
+                [
+                    ["tidbtpu_topsql_cpu_seconds",
+                     ["digest", "phase"], [hog, "execute"],
+                     t, 1.0 * i, "counter"],
+                    ["tidbtpu_topsql_cpu_seconds",
+                     ["digest", "phase"],
+                     ["dead000000000000", "execute"],
+                     t, 0.05 * i, "counter"],
+                ],
+                host="coordinator",
+            )
+        eng = InspectionEngine(store)
+        findings = eng.run(
+            t_lo=now - 35, t_hi=now + 1, rules=["cpu-hog-digest"]
+        )
+        hits = [f for f in findings if f.item == hog]
+        assert hits, findings
+        assert hits[0].severity in ("warning", "critical")
+        assert hits[0].t0 >= now - 35 and hits[0].t1 <= now + 1
+
+    def test_quiet_on_balanced_load(self):
+        from tidb_tpu.obs.inspection import InspectionEngine
+        from tidb_tpu.obs.tsdb import TimeSeriesStore
+
+        store = TimeSeriesStore()
+        now = time.time()
+        for d in ("aa" * 8, "bb" * 8, "cc" * 8):
+            for i, t in enumerate([now - 20, now - 10, now]):
+                store.merge_remote(
+                    [["tidbtpu_topsql_cpu_seconds",
+                      ["digest", "phase"], [d, "execute"],
+                      t, 0.3 * i, "counter"]],
+                    host="coordinator",
+                )
+        eng = InspectionEngine(store)
+        findings = eng.run(
+            t_lo=now - 25, t_hi=now + 1, rules=["cpu-hog-digest"]
+        )
+        assert findings == []

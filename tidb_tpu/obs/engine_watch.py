@@ -379,9 +379,14 @@ def watched_jit(fn, sig=None, **jit_kwargs):
         _TLS.fresh_trace = True
         ENGINE_WATCH.note_trace(watch_sig)
         t0 = _time.perf_counter()
+        # Top SQL live-phase marker: tracing runs synchronously on the
+        # statement's thread, so samples landing here attribute to
+        # compile — restored to the enclosing phase on exit
+        prev_phase = FLIGHT.set_live_phase("compile")
         try:
             return fn(*a, **k)
         finally:
+            FLIGHT.restore_live_phase(prev_phase)
             dt = _time.perf_counter() - t0
             # the SAME wall the flight recorder's compile phase
             # charges — the timeline compile event must not absorb

@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from tidb_tpu.obs import profiler
 from tidb_tpu.obs.timeline import TIMELINE
 from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.metrics import REGISTRY
@@ -120,7 +121,7 @@ class QueryFlight:
         "plan_cache", "plan_digest", "rows_sent", "plan_text",
         "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
         "device_mem_peak_bytes", "compile_flops",
-        "compile_bytes_accessed", "compile_output_bytes",
+        "compile_bytes_accessed", "compile_output_bytes", "live_phase",
     )
 
     def __init__(self, qid: int, conn_id: int, sql: str):
@@ -151,6 +152,13 @@ class QueryFlight:
         self.compile_flops = 0.0
         self.compile_bytes_accessed = 0.0
         self.compile_output_bytes = 0.0
+        #: the phase the executing thread is INSIDE right now — the
+        #: Top SQL sampler (obs/profiler.py) reads it from another
+        #: thread to attribute a sampled instant. note_phase charges
+        #: walls at their END, which a sampler cannot use; this marker
+        #: is set at the few wall STARTS (plan/compile/dispatch/
+        #: final-merge) via FLIGHT.set_live_phase.
+        self.live_phase = "execute"
 
     def phase_row(self, name: str) -> list:
         row = self.phases.get(name)
@@ -196,6 +204,14 @@ class FlightRecorder:
     def begin(self, sql: str, conn_id: int = 0) -> QueryFlight:
         rec = QueryFlight(next(self._qid), int(conn_id), str(sql)[:2048])
         self._tls.rec = rec
+        # Top SQL attribution (obs/profiler.py): register this thread
+        # as a statement context — two dict writes; the digest is
+        # computed lazily by the SAMPLER thread, never here, so the
+        # always-on path stays O(1). The FULL sql is passed (not the
+        # rec's 2048-char display truncation): the digest must match
+        # the one statements_summary/note_statement_text compute from
+        # the untruncated statement, or long statements fork.
+        profiler.begin_task("statement", rec=rec, sql=str(sql))
         return rec
 
     def current(self) -> Optional[QueryFlight]:
@@ -208,6 +224,7 @@ class FlightRecorder:
         session)."""
         rec = self.current()
         self._tls.rec = None
+        profiler.end_task()
         if rec is None:
             return None
         rec.duration_s = float(duration_s)
@@ -236,6 +253,30 @@ class FlightRecorder:
         before observation; a half-charged timeline would pollute the
         per-digest means)."""
         self._tls.rec = None
+        profiler.end_task()
+
+    def set_live_phase(self, name: str) -> Optional[str]:
+        """Mark the phase the current flight's thread is ENTERING
+        (the Top SQL sampler's attribution signal); returns the
+        previous marker so a bracketing caller can restore it. A
+        declared-phase check keeps the marker vocabulary identical to
+        the charged one."""
+        if name not in _PHASE_SET:
+            raise ValueError(
+                f"undeclared flight phase {name!r} (declare it in "
+                "tidb_tpu/obs/flight.py PHASES)"
+            )
+        rec = self.current()
+        if rec is None:
+            return None
+        prev = rec.live_phase
+        rec.live_phase = name
+        return prev
+
+    def restore_live_phase(self, prev: Optional[str]) -> None:
+        rec = self.current()
+        if rec is not None and prev is not None:
+            rec.live_phase = prev
 
     # -- notes ---------------------------------------------------------
     def note_phase(

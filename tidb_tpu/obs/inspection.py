@@ -531,6 +531,64 @@ def _r_clock_skew(ctx) -> List[Finding]:
     return out
 
 
+#: a single digest consuming this share of the window's sampled fleet
+#: CPU, with at least this many absolute seconds, is a hog; the
+#: absolute floor keeps a near-idle fleet (where one tiny query is
+#: trivially 100% of nothing) from crying wolf
+TOPSQL_HOG_SHARE, TOPSQL_HOG_MIN_S = 0.5, 0.25
+TOPSQL_HOG_CRIT_SHARE, TOPSQL_HOG_CRIT_MIN_S = 0.9, 2.0
+
+
+@rule(
+    "cpu-hog-digest",
+    metrics=("tidbtpu_topsql_cpu_seconds",),
+    phases=("execute",),
+)
+def _r_cpu_hog_digest(ctx) -> List[Finding]:
+    """One statement digest is burning a dominant share of the fleet's
+    sampled python-CPU (Top SQL, obs/profiler.py). The series is
+    labeled (digest, phase) per host; the (others) fold-in aggregate
+    is exempt — it is by construction the cold tail."""
+    from tidb_tpu.obs.profiler import OTHERS_DIGEST, TOPSQL
+
+    inc = ctx.increase("tidbtpu_topsql_cpu_seconds")
+    by_digest: Dict[str, list] = {}
+    total = 0.0
+    for (_host, lvalues), (d, t0, t1) in inc.items():
+        digest = lvalues[0] if lvalues else ""
+        total += d
+        if digest == OTHERS_DIGEST:
+            continue
+        ent = by_digest.setdefault(digest, [0.0, t0, t1])
+        ent[0] += d
+        ent[1] = min(ent[1], t0)
+        ent[2] = max(ent[2], t1)
+    out = []
+    for digest, (cpu, t0, t1) in by_digest.items():
+        share = cpu / total if total > 0 else 0.0
+        if share < TOPSQL_HOG_SHARE or cpu < TOPSQL_HOG_MIN_S:
+            continue
+        sev = (
+            "critical"
+            if share >= TOPSQL_HOG_CRIT_SHARE
+            and cpu >= TOPSQL_HOG_CRIT_MIN_S
+            else "warning"
+        )
+        text = TOPSQL.store.text_of(digest)
+        out.append(Finding(
+            "cpu-hog-digest", str(digest), sev, round(share, 4),
+            f"share < {TOPSQL_HOG_SHARE:.0%} of window fleet CPU",
+            f"digest {digest} burned {cpu:.2f}s sampled CPU = "
+            f"{share:.0%} of the fleet's window"
+            + (f" ({text[:96]})" if text else "")
+            + "; pull its flamegraph (/profile?digest=...) and its "
+            "top_sql phase split — a python-CPU-bound execute phase "
+            "usually means a missed compiled path",
+            t0, t1,
+        ))
+    return out
+
+
 @rule(
     "quarantine-flap",
     metrics=(

@@ -1021,6 +1021,7 @@ class DCNFragmentScheduler:
         try:
             if kind == "dag":
                 t0 = time.perf_counter()
+                FLIGHT.set_live_phase("fragment-dispatch")
                 parts_rows, infos, stages = self._run_dag(
                     cut, kill_check=kill_check, deadline=deadline,
                     snap=snap,
@@ -1038,6 +1039,7 @@ class DCNFragmentScheduler:
                 return self._timed_final_stage(cut, rows)
             if kind == "shuffle":
                 t0 = time.perf_counter()
+                FLIGHT.set_live_phase("fragment-dispatch")
                 rows, infos, stage = self._run_shuffle(
                     cut, kill_check=kill_check, deadline=deadline,
                     snap=snap,
@@ -1050,6 +1052,7 @@ class DCNFragmentScheduler:
                 return self._timed_final_stage(cut, rows)
             if kind == "frag":
                 t0 = time.perf_counter()
+                FLIGHT.set_live_phase("fragment-dispatch")
                 ledger, infos = self._run_fragments(
                     cut, kill_check=kill_check, deadline=deadline,
                     snap=snap,
@@ -1077,6 +1080,7 @@ class DCNFragmentScheduler:
         branch's summed execute), so nothing counts twice."""
         wall = time.perf_counter() - t0
         crit = max((f.get("exec_s", 0.0) for f in infos), default=0.0)
+        FLIGHT.set_live_phase("execute")  # dispatch window over
         FLIGHT.note_phase(
             "fragment-dispatch", max(wall - crit, 0.0), retries=retries
         )
@@ -1106,9 +1110,11 @@ class DCNFragmentScheduler:
         and the range-concat merge use."""
         t1 = time.perf_counter()
         c0 = FLIGHT.phase_seconds("compile")
+        prev_phase = FLIGHT.set_live_phase("final-merge")
         try:
             yield
         finally:
+            FLIGHT.restore_live_phase(prev_phase)
             FLIGHT.note_phase(
                 "final-merge",
                 (time.perf_counter() - t1)
@@ -1310,6 +1316,7 @@ class DCNFragmentScheduler:
         retried stage lands exactly once."""
         qid = _QUERY_ID.next()
         sid = f"{self._sid_prefix}-q{qid}"
+        ts_entry = self._topsql_entry()  # statement thread: see helper
         stage = {
             "sid": sid, "qid": qid, "kind": sp.kind, "attempts": 0,
             "m": 0, "bytes_tunneled": 0, "rows_tunneled": 0,
@@ -1389,6 +1396,7 @@ class DCNFragmentScheduler:
                     # routed snapshot: producers pin this base and
                     # merge the delta window (storage/delta.py)
                     "snap": snap,
+                    "topsql": ts_entry,
                 }
                 t_d0 = time.time()
                 try:
@@ -1526,7 +1534,7 @@ class DCNFragmentScheduler:
 
     def _stage_task(
         self, dag, si, stage, i, m, attempt, qid, boundaries, peers,
-        secret, deadline, snap=None,
+        secret, deadline, snap=None, topsql=None,
     ) -> dict:
         """The worker task spec for partition ``i`` of DAG stage
         ``si`` — run_task's single-stage spec plus the DAG fields
@@ -1560,6 +1568,7 @@ class DCNFragmentScheduler:
             "trace": bool(self.tracer.enabled),
             "timeline": TIMELINE.active(),
             "snap": snap,
+            "topsql": topsql,
         }
 
     def _sample_stage(
@@ -1575,6 +1584,7 @@ class DCNFragmentScheduler:
         as a dispatch loss (shuffle/sample-lost)."""
         side = stage.sides[0]
         t0 = time.perf_counter()
+        ts_entry = self._topsql_entry()  # statement thread: see helper
         samples: List[Optional[list]] = [None] * m
         fatal: List[Exception] = []
         cancelled: List[str] = []
@@ -1591,6 +1601,7 @@ class DCNFragmentScheduler:
                     "plan": plan_to_ir(side.host_plan(i, m)),
                 },
                 "snap": snap,
+                "topsql": ts_entry,
             }
             try:
                 resp = conn.call(
@@ -1716,6 +1727,7 @@ class DCNFragmentScheduler:
         (last-stage rows per partition, fenced per-partition infos of
         every stage, per-stage summaries)."""
         qid = _QUERY_ID.next()
+        ts_entry = self._topsql_entry()  # statement thread: see helper
         n = len(dag.stages)
         if n > 1:
             _c_stage_chained().inc()
@@ -1801,7 +1813,7 @@ class DCNFragmentScheduler:
                         task = self._stage_task(
                             dag, _si, _stg, i, m, attempt, qid,
                             _bnd, peers, ep.secret, deadline,
-                            snap=snap,
+                            snap=snap, topsql=ts_entry,
                         )
                         t_d0 = time.time()
                         try:
@@ -1990,6 +2002,7 @@ class DCNFragmentScheduler:
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
         self._merge_tsdb(resp, ep)
+        self._merge_topsql(resp, ep)
         self._note_timeline(
             resp, ep, qid=qid, unit=f"p{part}", attempt=attempt,
             t_dispatch0=t_dispatch0,
@@ -2040,6 +2053,40 @@ class DCNFragmentScheduler:
         self._merge_remote_spans(
             spans, host, addr=ep.address, trace_t0=resp.get("trace_t0")
         )
+
+    @staticmethod
+    def _topsql_entry():
+        """The Top SQL entry every dispatch carries (None while the
+        profiler is off — a worker receiving None stops its sampler):
+        the fleet config plus THIS statement's digest, so worker-side
+        samples attribute to the same digest the coordinator uses.
+        Must be computed on the STATEMENT thread (the digest comes
+        from its registered flight context), then closed over by the
+        dispatch runner threads."""
+        from tidb_tpu.obs.profiler import TOPSQL, current_digest
+
+        cfg = TOPSQL.dispatch_config()
+        if cfg is None:
+            return None
+        cfg = dict(cfg)
+        cfg["digest"] = current_digest()
+        return cfg
+
+    def _merge_topsql(self, resp, ep) -> None:
+        """Fold one FENCED reply's piggybacked Top SQL payload
+        (per-digest aggregates + collapsed stacks) into the
+        coordinator store under this worker's instance label — the
+        _merge_tsdb contract: behind the exactly-once ledger fence,
+        and telemetry never fails the query."""
+        payload = resp.get("topsql")
+        if not payload:
+            return
+        from tidb_tpu.obs.profiler import TOPSQL
+
+        try:
+            TOPSQL.store.merge_remote(payload, instance=ep.address)
+        except Exception:
+            pass
 
     def _merge_tsdb(self, resp, ep) -> None:
         """Fold one FENCED reply's piggybacked worker metric samples
@@ -2095,6 +2142,9 @@ class DCNFragmentScheduler:
         rows, exec_s, bytes, spans) — only FENCED deliveries contribute,
         so a retried fragment's stats and spans appear exactly once."""
         qid = _QUERY_ID.next()
+        # computed on the statement thread (the digest lives in ITS
+        # flight context), closed over by the dispatch runners
+        ts_entry = self._topsql_entry()
         n = max(len(self.alive_endpoints()), 1)
         ledger = FragmentLedger(n)
         infos: List[dict] = []
@@ -2141,6 +2191,9 @@ class DCNFragmentScheduler:
                     # timeline event collection
                     "trace": bool(self.tracer.enabled),
                     "timeline": TIMELINE.active(),
+                    # Top SQL: profiler config + this statement's
+                    # digest for worker-side sample attribution
+                    "topsql": ts_entry,
                 }
                 t_d0 = time.time()
                 try:
@@ -2232,6 +2285,7 @@ class DCNFragmentScheduler:
         _h_fragment_seconds().observe(exec_s)
         merge_counter_delta(resp.get("registry"))
         self._merge_tsdb(resp, ep)
+        self._merge_topsql(resp, ep)
         self._note_timeline(
             resp, ep, qid=meta.get("qid"), unit=f"f{fid}",
             attempt=meta.get("attempt", 1), t_dispatch0=t_dispatch0,

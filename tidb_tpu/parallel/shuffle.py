@@ -63,6 +63,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tidb_tpu.obs import profiler as topsql
 from tidb_tpu.utils import racecheck
 from tidb_tpu.utils.failpoint import inject
 from tidb_tpu.utils.metrics import REGISTRY
@@ -1554,6 +1555,11 @@ class ShuffleWorker:
             for side in spec["sides"]:
                 if cancel_check is not None:
                     cancel_check()
+                # Top SQL live phase (obs/profiler.py): the sampler
+                # attributes this thread's instants to the shuffle
+                # phase it is inside — a no-op when the engine-RPC
+                # handler registered no task context
+                topsql.set_task_phase("shuffle-produce")
                 tag = int(side["tag"])
                 plan = plan_from_ir(side["plan"])
                 plan = self._apply_snap(spec, side, plan, snap_pins)
@@ -1584,6 +1590,7 @@ class ShuffleWorker:
                     stats["produced_rows"] += blk.nrows
                     t_push = time.perf_counter()
                     t_wall = time.time()
+                    topsql.set_task_phase("shuffle-push")
                     with span(f"{ctx}/push#{tag}"):
                         self._ship_block_side(
                             sid, attempt, m, tag, part, blk,
@@ -1620,6 +1627,7 @@ class ShuffleWorker:
                     parts = partition_rows(rows, key_idx, m)
                     t_push = time.perf_counter()
                     t_wall = time.time()
+                    topsql.set_task_phase("shuffle-push")
                     with span(f"{ctx}/push#{tag}"):
                         for dest, prows in enumerate(parts):
                             self._send_stream(
@@ -1662,6 +1670,10 @@ class ShuffleWorker:
                             tunnels, tlock, packet_rows, inflight,
                             stats, ship_errs, buf, ctx, ev_args,
                             cancel_check,
+                            # shipper threads inherit the task's Top
+                            # SQL digest (their samples charge the
+                            # same statement, phase shuffle-push)
+                            topsql.current_digest(),
                         ),
                         daemon=True,
                         name=f"shuffle-ship-{sid}-s{tag}",
@@ -1718,6 +1730,7 @@ class ShuffleWorker:
                 idxs = partition_block(block, side["key"], m)
                 t_push = time.perf_counter()
                 t_wall = time.time()
+                topsql.set_task_phase("shuffle-push")
                 with span(f"{ctx}/push#{tag}"):
                     for dest, idx in enumerate(idxs):
                         self._ship_partition(
@@ -1749,6 +1762,7 @@ class ShuffleWorker:
                 # store wait are exchange idle.
                 t0 = time.perf_counter()
                 t_wall = time.time()
+                topsql.set_task_phase("shuffle-wait")
                 for t in tunnels.values():
                     t.flush()
                 with span(f"{ctx}/wait"):
@@ -1781,6 +1795,7 @@ class ShuffleWorker:
                     deadline = time.monotonic() + max(
                         wait_timeout - waited, 0.0
                     )
+                    topsql.set_task_phase("shuffle-wait")
                     with span(f"{ctx}/wait"):
                         done, chunks, vocab = self.store.wait_side(
                             sid, attempt, pending, m, deadline,
@@ -1806,6 +1821,7 @@ class ShuffleWorker:
                     if node is not None:
                         t_stage = time.perf_counter()
                         t_wall = time.time()
+                        topsql.set_task_phase("shuffle-stage")
                         with span(f"{ctx}/stage#{done}"):
                             staged[done] = stage_payloads_incremental(
                                 node.schema, chunks,
@@ -1932,6 +1948,7 @@ class ShuffleWorker:
             # machinery)
             t_stage = time.perf_counter()
             t_wall = time.time()
+            topsql.set_task_phase("shuffle-stage")
             staged = {
                 tag: stage_payloads_as_batch(
                     node.schema, by_side.get(tag, []),
@@ -1945,6 +1962,7 @@ class ShuffleWorker:
         inject("shuffle/consume")
         if cancel_check is not None:
             cancel_check()
+        topsql.set_task_phase("execute")
         with span(f"{ctx}/consume"), self._exec_lock:
             # consumer executes single-device: its sources are Staged
             # partition batches, not mesh-sharded scans
@@ -2005,6 +2023,7 @@ class ShuffleWorker:
         self, sid, attempt, m, side, sender, sq, key, schema_cols,
         peers, secret, tunnels, tlock, packet_rows, inflight, stats,
         errs, buf=None, ctx="", ev_args=None, cancel_check=None,
+        topsql_digest=None,
     ) -> None:
         """Pipelined producer ship (one side, run on a shipper thread,
         fed produced sub-batches through queue ``sq`` until the None
@@ -2030,6 +2049,14 @@ class ShuffleWorker:
         )
         from tidb_tpu.parallel.wire import encode_frame, partition_map
 
+        # shipper threads carry the task's statement digest so the Top
+        # SQL sampler attributes their encode/push CPU (and tunnel
+        # backpressure stalls) to the same query, phase shuffle-push
+        _ts_prev = None
+        if topsql_digest:
+            _ts_prev = topsql.begin_task(
+                "shuffle", digest=topsql_digest, phase="shuffle-push"
+            )
         try:
             seqs = [0] * m
             local_rows = 0
@@ -2159,6 +2186,8 @@ class ShuffleWorker:
         except Exception as e:
             errs.append(e)
         finally:
+            if topsql_digest:
+                topsql.end_task(_ts_prev)
             with tlock:
                 stats["_live_shippers"] = (
                     stats.get("_live_shippers", 1) - 1

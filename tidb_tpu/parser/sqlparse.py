@@ -710,6 +710,13 @@ class Parser:
             return ast.SetTransaction(scope, iso, access)
         name = self._set_var_name()
         self.expect_op("=")
+        if self.at_kw("on"):
+            # MySQL bareword switch value: ON is a keyword to this
+            # tokenizer (JOIN ... ON), so the expression path would
+            # reject `SET GLOBAL tidb_enable_top_sql = ON`; OFF is a
+            # plain identifier and already rides the bareword branch
+            self.advance()
+            return ast.SetVariable(name, "ON", scope)
         val = self.parse_expr()
         if not isinstance(val, ast.Const):
             if isinstance(val, ast.Name):  # bareword values like utf8mb4

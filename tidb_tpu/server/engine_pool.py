@@ -81,7 +81,18 @@ def ping_endpoint(ep: "EngineEndpoint", timeout_s: float = 2.0) -> bool:
     except Exception:
         return False
     try:
-        resp = c._call({"tsdb_flush": True})  # handshake/ping frame
+        ping = {"tsdb_flush": True}  # handshake/ping frame
+        try:
+            # Top SQL config rides every liveness ping so workers
+            # arm/disarm/re-tune even with no dispatch in flight
+            # (SET GLOBAL tidb_enable_top_sql reaches an idle fleet
+            # at heartbeat cadence)
+            from tidb_tpu.obs.profiler import TOPSQL
+
+            ping["topsql"] = TOPSQL.dispatch_config()
+        except Exception:
+            pass
+        resp = c._call(ping)
         ok = bool(resp.get("ok"))
         if ok:
             # two INDEPENDENT try blocks: the worker already drained
@@ -101,6 +112,14 @@ def ping_endpoint(ep: "EngineEndpoint", timeout_s: float = 2.0) -> bool:
                 TSDB.merge_remote(
                     resp.get("tsdb"), host=ep.address,
                     offset_s=c.clock_offset_s,
+                )
+            except Exception:
+                pass
+            try:
+                from tidb_tpu.obs.profiler import TOPSQL
+
+                TOPSQL.store.merge_remote(
+                    resp.get("topsql"), instance=ep.address
                 )
             except Exception:
                 pass

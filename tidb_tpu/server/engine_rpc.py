@@ -369,6 +369,11 @@ class EngineServer:
                                     or 0
                                 ),
                             }
+                            if "topsql" in req:
+                                # heartbeat-carried Top SQL profiler
+                                # config: workers arm/disarm/re-tune
+                                # even with no dispatch in flight
+                                outer._apply_topsql(req.get("topsql"))
                             if outer.ship_registry and req.get(
                                 "tsdb_flush"
                             ):
@@ -383,6 +388,9 @@ class EngineServer:
                                 tsdb_rows = outer._tsdb_ship()
                                 if tsdb_rows:
                                     ping["tsdb"] = tsdb_rows
+                                topsql = outer._topsql_ship()
+                                if topsql:
+                                    ping["topsql"] = topsql
                             resp = json.dumps(ping).encode()
                         else:
                             resp = outer._execute(executor, req)
@@ -551,6 +559,21 @@ class EngineServer:
             )
             executor.kill_check = check
             _sk.set_current(_CheckKiller(check))
+            # Top SQL (obs/profiler.py): the dispatch carries the
+            # profiler config + the statement digest this fragment
+            # belongs to — arm/retune the local sampler and register
+            # this handler thread so its samples attribute to that
+            # digest (no context, no attribution: a finished or
+            # foreign qid can never be charged)
+            from tidb_tpu.obs import profiler as _topsql
+
+            ts_cfg = frag.get("topsql")
+            self._apply_topsql(ts_cfg)
+            ts_prev = _topsql.begin_task(
+                "fragment",
+                digest=(ts_cfg or {}).get("digest"),
+                phase="execute",
+            )
             t_exec0 = _time.perf_counter()
             t_wall0 = _time.time()
             try:
@@ -567,6 +590,7 @@ class EngineServer:
                 )
                 raise
             finally:
+                _topsql.end_task(ts_prev)
                 set_cost_wanted(False)
                 executor.kill_check = None
                 _sk.set_current(None)
@@ -641,6 +665,9 @@ class EngineServer:
                 tsdb_rows = self._tsdb_ship()
                 if tsdb_rows:
                     resp["tsdb"] = tsdb_rows
+                topsql = self._topsql_ship()
+                if topsql:
+                    resp["topsql"] = topsql
         return json.dumps(resp).encode()
 
     # -- worker-to-worker shuffle (parallel/shuffle.py) -----------------
@@ -785,6 +812,17 @@ class EngineServer:
             coord=spec.get("coord"),
         )
         _sk.set_current(_CheckKiller(check))
+        # Top SQL: dispatch-carried config + digest; run_task updates
+        # the live phase (produce/push/wait/stage) on this context
+        from tidb_tpu.obs import profiler as _topsql
+
+        ts_cfg = spec.get("topsql")
+        self._apply_topsql(ts_cfg)
+        ts_prev = _topsql.begin_task(
+            "shuffle",
+            digest=(ts_cfg or {}).get("digest"),
+            phase="shuffle-produce",
+        )
         t0 = _time.perf_counter()
         try:
             result = self.shuffle_worker().run_task(
@@ -802,6 +840,7 @@ class EngineServer:
             ENGINE_WATCH.end_query(_time.perf_counter() - t0)
             raise
         finally:
+            _topsql.end_task(ts_prev)
             set_cost_wanted(False)
             _sk.set_current(None)
         exec_s = _time.perf_counter() - t0
@@ -841,6 +880,9 @@ class EngineServer:
             tsdb_rows = self._tsdb_ship()
             if tsdb_rows:
                 resp["tsdb"] = tsdb_rows
+            topsql = self._topsql_ship()
+            if topsql:
+                resp["topsql"] = topsql
         return json.dumps(resp).encode()
 
     def _shuffle_sample(self, req) -> bytes:
@@ -864,6 +906,15 @@ class EngineServer:
             coord=spec.get("coord"),
         )
         _sk.set_current(_CheckKiller(check))
+        from tidb_tpu.obs import profiler as _topsql
+
+        ts_cfg = spec.get("topsql")
+        self._apply_topsql(ts_cfg)
+        ts_prev = _topsql.begin_task(
+            "sample",
+            digest=(ts_cfg or {}).get("digest"),
+            phase="shuffle-produce",
+        )
         try:
             result = self.shuffle_worker().run_sample(
                 spec, cancel_check=check
@@ -877,6 +928,7 @@ class EngineServer:
                 }
             ).encode()
         finally:
+            _topsql.end_task(ts_prev)
             _sk.set_current(None)
         if inject("shuffle/sample-lost"):
             raise DropConnection()
@@ -996,6 +1048,30 @@ class EngineServer:
         with self._reg_lock:
             delta, self._reg_snapshot = counter_delta(self._reg_snapshot)
         return delta
+
+    def _apply_topsql(self, cfg) -> None:
+        """Apply a dispatch/ping-carried Top SQL profiler config to
+        THIS process's sampler (obs/profiler.py). Worker processes
+        only (ship_registry): in-process servers share the
+        coordinator's profiler, which the SET GLOBAL hook already
+        configured — a second applier would fight it."""
+        if not self.ship_registry:
+            return
+        from tidb_tpu.obs.profiler import TOPSQL
+
+        try:
+            TOPSQL.apply_config(cfg)
+        except Exception:
+            pass  # profiler config must never fail a dispatch
+
+    def _topsql_ship(self):
+        """Drain this process's pending Top SQL deltas (collapsed
+        stacks + per-digest aggregates) into ONE reply — the
+        _tsdb_ship contract: at-most-once, a lost reply drops its
+        batch, idle replies stay small."""
+        from tidb_tpu.obs.profiler import TOPSQL
+
+        return TOPSQL.store.ship()
 
     def _tsdb_ship(self):
         """Sample this process's registry (bounded cadence) and drain

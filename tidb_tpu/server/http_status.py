@@ -1,5 +1,11 @@
 """HTTP status API: /status, /metrics, /schema, /settings, /dcn,
-/links, /timeline, /tsdb, /inspection.
+/links, /timeline, /tsdb, /inspection, /profile.
+
+`/profile` (Top SQL, obs/profiler.py) exports the fleet-merged
+collapsed-stack profile — one "digest;frame;...;frame <ms>" line per
+sampled tower, loadable by flamegraph.pl and speedscope. `?host=`
+narrows to one instance (coordinator or a worker address), `?digest=`
+to one statement digest.
 
 `/links` (PR 6) serves the per-peer DCN link health registry
 (obs/flight.py LINKS): handshake RTT, heartbeat age, and tunnel
@@ -174,6 +180,26 @@ class StatusServer:
                         self._send(200, json.dumps({
                             "findings": [f.to_dict() for f in findings],
                         }))
+                    elif path == "/profile":
+                        from urllib.parse import parse_qs, urlparse
+
+                        from tidb_tpu.obs.profiler import TOPSQL
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        lines = TOPSQL.store.collapsed(
+                            instance=qs.get("host", [None])[0],
+                            digest=qs.get("digest", [None])[0],
+                        )
+                        # FlameGraph/speedscope collapsed-stack format:
+                        # "frame;frame;frame count" per line, fleet-
+                        # merged (?host= for one instance, ?digest=
+                        # for one statement); load with flamegraph.pl
+                        # or speedscope's "collapsed" importer
+                        self._send(
+                            200,
+                            "\n".join(lines) + ("\n" if lines else ""),
+                            "text/plain",
+                        )
                     elif path == "/metrics":
                         from tidb_tpu.utils.metrics import REGISTRY
 

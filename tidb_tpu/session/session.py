@@ -3010,6 +3010,26 @@ class Session(DDLMixin):
                             )),
                         )
                 if s.name.lower() in (
+                    "tidb_enable_top_sql",
+                    "tidb_top_sql_max_time_series_count",
+                    "tidb_top_sql_max_meta_count",
+                    "tidb_tpu_topsql_sample_interval_s",
+                ) and s.scope == "global":
+                    # live wiring of the Top SQL knobs (obs/profiler
+                    # .py): enable starts/stops THIS process's sampler
+                    # immediately; the caps re-tune the store (the
+                    # PR 12 retune pattern). Worker processes pick the
+                    # same config up from the next dispatch or
+                    # heartbeat ping — the frames carry it. GLOBAL
+                    # scope only, read through a session-override-free
+                    # view: one fleet profiler serves every session.
+                    from tidb_tpu.obs.profiler import TOPSQL
+                    from tidb_tpu.utils.sysvar import SysVars
+
+                    TOPSQL.apply_sysvars(
+                        SysVars(self.catalog.global_sysvars)
+                    )
+                if s.name.lower() in (
                     "tidb_stmt_summary_refresh_interval",
                     "tidb_stmt_summary_history_size",
                 ):
@@ -3112,6 +3132,16 @@ class Session(DDLMixin):
         flight = FLIGHT.finish(elapsed_s)
         digest = sql_digest(sql)  # computed ONCE for both stores
         STMT_SUMMARY.record(sql, elapsed_s, flight=flight, digest=digest)
+        # Top SQL digest->text meta (obs/profiler.py): the sampler
+        # attributes by 16-hex id; this makes top_sql rows readable.
+        # Only while the profiler runs — the meta map must not grow
+        # on an unprofiled fleet.
+        from tidb_tpu.obs import profiler as _topsql
+
+        if _topsql.TOPSQL.running():
+            _topsql.note_statement_text(
+                _topsql.digest_of(digest), digest
+            )
         # metric time-series tier: passive tick — with no background
         # sampler armed, history still accretes at statement cadence
         # (bounded by the sampler's passive interval; a no-op when the
@@ -4027,8 +4057,10 @@ class Session(DDLMixin):
             # spans mirror the reference's (session.ExecuteStmt ->
             # Compiler.Compile -> distsql.Select, pkg/util/tracing/util.go:21)
             t_plan = time.perf_counter()
+            FLIGHT.set_live_phase("plan")
             with self.tracer.span("session.plan"):
                 plan = build_query(s, self.catalog, self.db, self._scalar_subquery, ctes)
+            FLIGHT.set_live_phase("execute")
             FLIGHT.note_phase("plan", time.perf_counter() - t_plan)
             self._last_plan = plan  # prepared-statement plan capture
             routed = self._try_dcn_select(plan)
@@ -4056,6 +4088,7 @@ class Session(DDLMixin):
                 - (FLIGHT.phase_seconds("compile") - c0),
             )
             t_mat = time.perf_counter()
+            FLIGHT.set_live_phase("final-merge")
             with self.tracer.span("session.materialize"):
                 rows = materialize_rows(batch, list(plan.schema), dicts)
             FLIGHT.note_phase("final-merge", time.perf_counter() - t_mat)

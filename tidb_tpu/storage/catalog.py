@@ -839,25 +839,78 @@ class Catalog:
                                  per.get(i, 0))
                             )
         elif name == "top_sql":
-            # TopSQL analog (reference: pkg/util/topsql — per-digest CPU
-            # time ranking shipped to a collector): here, per-digest
-            # cumulative engine time ranked hottest-first. One process =
-            # one "instance"; the collector round-trip is the
-            # statements-summary store itself.
+            # Top SQL (reference: pkg/util/topsql): per-digest sampled
+            # cpu/device/stall attribution from the fleet profiler
+            # (obs/profiler.py — coordinator samples locally, worker
+            # windows ride the fenced replies), ranked hottest-first
+            # by fleet CPU with one row per (instance, digest) so both
+            # worker hosts appear. The latency columns stay for
+            # compatibility (joined from statements_summary by
+            # digest); with the sampler OFF this returns one HINT row
+            # instead of silently re-ranking latency as the old stub
+            # did — an attribution surface that quietly degrades to a
+            # different metric is worse than one that says so.
             from tidb_tpu.dtypes import FLOAT64
+            from tidb_tpu.obs.profiler import TOPSQL, digest_of
             from tidb_tpu.utils.metrics import STMT_SUMMARY
 
             schema = TableSchema(
-                [("rank", INT64), ("digest_text", STRING),
+                [("rank", INT64), ("instance", STRING),
+                 ("digest", STRING), ("digest_text", STRING),
+                 ("cpu_ms", FLOAT64), ("device_ms", FLOAT64),
+                 ("stall_ms", FLOAT64), ("samples", INT64),
+                 ("top_phase", STRING), ("top_frame", STRING),
                  ("exec_count", INT64), ("sum_latency", FLOAT64),
                  ("avg_latency", FLOAT64), ("max_latency", FLOAT64),
                  ("sample_text", STRING)]
             )
-            ranked = sorted(STMT_SUMMARY.rows(), key=lambda r: -r[2])[:30]
-            rows = [
-                (i + 1, d, n, s, s / max(n, 1), m, txt)
-                for i, (d, n, s, m, txt) in enumerate(ranked)
-            ]
+            prof = TOPSQL.store.rows()
+            if not prof and not TOPSQL.running():
+                rows = [
+                    (0, "", "", "top sql is off — SET GLOBAL "
+                     "tidb_enable_top_sql = ON arms the fleet "
+                     "sampler (tidb_tpu_topsql_sample_interval_s "
+                     "tunes the cadence)",
+                     0.0, 0.0, 0.0, 0, "", "", 0, 0.0, 0.0, 0.0, "")
+                ]
+            else:
+                # statements_summary join by stable digest id: texts
+                # (when the store's meta lost them) + the compat
+                # latency columns
+                summary = {
+                    digest_of(d): (d, n, s, m, txt)
+                    for d, n, s, m, txt in STMT_SUMMARY.rows()
+                }
+                fleet_cpu: dict = {}
+                for r in prof:
+                    fleet_cpu[r["digest"]] = (
+                        fleet_cpu.get(r["digest"], 0.0) + r["cpu_s"]
+                    )
+                ranked = {
+                    d: i + 1
+                    for i, d in enumerate(sorted(
+                        fleet_cpu, key=lambda d: -fleet_cpu[d]
+                    ))
+                }
+                rows = []
+                for r in sorted(
+                    prof,
+                    key=lambda r: (ranked[r["digest"]], r["instance"]),
+                )[:200]:
+                    sm = summary.get(r["digest"])
+                    rows.append((
+                        ranked[r["digest"]], r["instance"],
+                        r["digest"],
+                        r["digest_text"] or (sm[0] if sm else ""),
+                        r["cpu_s"] * 1e3, r["device_s"] * 1e3,
+                        r["stall_s"] * 1e3, r["samples"],
+                        r["top_phase"], r["top_frame"],
+                        sm[1] if sm else 0,
+                        sm[2] if sm else 0.0,
+                        (sm[2] / max(sm[1], 1)) if sm else 0.0,
+                        sm[3] if sm else 0.0,
+                        sm[4] if sm else "",
+                    ))
         else:
             raise ValueError(f"unknown table information_schema.{name}")
         t = Table(name, schema)

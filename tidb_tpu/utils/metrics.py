@@ -197,6 +197,23 @@ class MetricFamily:
                 child = self._children[values] = self.cls(self.name, self.help)
             return child
 
+    def remove_matching(self, predicate) -> int:
+        """Drop children whose labelvalues tuple satisfies
+        ``predicate``; returns how many were removed. The eviction
+        half of cap-bounded label cardinality (Top SQL folds an
+        evicted digest's per-digest children out, obs/profiler.py) —
+        safe for the worker counter-delta shipping because
+        counter_delta carries the post-removal snapshot forward: a
+        removed child simply stops shipping, and a re-created one
+        counts from zero with no negative delta."""
+        with self._lock:
+            gone = [
+                k for k in self._children if predicate(k)
+            ]
+            for k in gone:
+                del self._children[k]
+        return len(gone)
+
     def children(self) -> List[Tuple[Tuple[str, ...], object]]:
         with self._lock:
             return sorted(self._children.items())

@@ -116,6 +116,10 @@ LOCK_CLASSES: Dict[str, str] = {
     "obs.tsdb_sampler": "sampler cadence state (retune + last-sample "
                         "stamp)",
     "obs.inspection": "inspection engine's last-run findings cache",
+    "obs.topsql": "Top SQL per-digest sample aggregates + collapsed "
+                  "stacks + ship buffers",
+    "obs.topsql_sampler": "Top SQL sampler cadence state (retune "
+                          "serialization, one thread invariant)",
     # utils
     "failpoint.registry": "armed failpoint actions",
     "failpoint.site": "one after_n() site's invocation counter",

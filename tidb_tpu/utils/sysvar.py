@@ -176,6 +176,34 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "raw points folded into one downsampled point when "
                   "they age out of the raw retention ring (counters "
                   "keep the last cumulative value, gauges the mean)"),
+        # Top SQL continuous profiler (obs/profiler.py): the reference
+        # pkg/util/topsql knobs, LIVE here — SET GLOBAL
+        # tidb_enable_top_sql starts/stops every process's sampler
+        # (workers learn the config from dispatch/heartbeat frames),
+        # the two caps re-tune the store live. GLOBAL-only like the
+        # DCN knobs: one fleet profiler serves every session, so a
+        # session-scoped SET errors loudly instead of silently tuning
+        # nothing.
+        SysVarDef("tidb_enable_top_sql", False, "global", _bool,
+                  "start/stop the fleet-wide Top SQL sampling "
+                  "profiler: per-digest cpu/device/stall attribution "
+                  "into information_schema.top_sql, tidbtpu_topsql_* "
+                  "series and the /profile flamegraph exporter"),
+        SysVarDef("tidb_top_sql_max_time_series_count", 100, "global",
+                  _int_range(1, 1 << 20),
+                  "max DISTINCT statement digests each process's Top "
+                  "SQL store tracks; admitting past the cap folds the "
+                  "coldest digest into the (others) aggregate"),
+        SysVarDef("tidb_top_sql_max_meta_count", 5000, "global",
+                  _int_range(8, 1 << 24),
+                  "max Top SQL meta entries per process (distinct "
+                  "collapsed stacks + digest->text mappings); "
+                  "overflowing stacks fold into (truncated)"),
+        SysVarDef("tidb_tpu_topsql_sample_interval_s", 0.02, "global",
+                  _float_range(0.001, 10.0),
+                  "Top SQL sampler cadence (seconds between "
+                  "sys._current_frames walks) while "
+                  "tidb_enable_top_sql is ON"),
         SysVarDef("tidb_txn_mode", "pessimistic", "both",
                   _enum("pessimistic", "optimistic"),
                   "transaction mode: pessimistic takes blocking table "
@@ -616,9 +644,6 @@ _COMPAT_VARS = [
             ("tidb_rc_write_check_ts", False, "both", _bool),
             ("tidb_sysdate_is_now", False, "both", _bool),
             ("tidb_table_cache_lease", 3, "global", None),
-            ("tidb_top_sql_max_time_series_count", 100, "global", None),
-            ("tidb_top_sql_max_meta_count", 5000, "global", None),
-            ("tidb_enable_top_sql", False, "global", _bool),
             ("tidb_enable_historical_stats", True, "global", _bool),
             ("tidb_enable_plan_replayer_capture", True, "global", _bool),
             ("tidb_enable_resource_control", True, "global", _bool),
