@@ -1221,6 +1221,73 @@ def measure_multihost_shuffle(args) -> int:
 
         flight_breakdown = run_flight_attributed()
 
+        def run_feedback_pair():
+            """AQE feedback warm/cold pair (ISSUE 15): a join whose
+            filtered side collapses far below its static catalog
+            estimate runs twice under tidb_tpu_aqe_feedback=on — the
+            COLD run plans from static stats (repartition) and
+            records the observed side rows; the WARM run's cost model
+            seeds from those actuals and switches the edge to
+            broadcast (adaptive=feedback, fewer tunnel bytes)."""
+            from tidb_tpu.parallel import aqe
+            from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+            from tidb_tpu.utils.metrics import sql_digest
+
+            q = (
+                "select count(*), sum(l_quantity) from lineitem "
+                "join orders on l_orderkey = o_orderkey "
+                "where o_custkey < 5"
+            )
+            digest = sql_digest(q)
+            CARD_FEEDBACK.reset()
+            fb_plan = build_query(
+                parse(q)[0], cat, "tpch", sess._scalar_subquery
+            )
+            sched = DCNFragmentScheduler(
+                [("127.0.0.1", pt) for pt in ports],
+                catalog=cat, shuffle_mode="always",
+                shuffle_dag="never", aqe_feedback=True,
+                shuffle_broadcast_rows=max(
+                    int(cat.table("tpch", "orders").nrows * 0.2), 64
+                ),
+            )
+            out = {}
+            try:
+                sched.execute_plan(fb_plan)  # compile warmup
+                d0 = aqe.decision_counts().get("feedback", 0.0)
+                ref = None
+                for phase in ("cold", "warm"):
+                    kind, cut = sched._choose_cut(
+                        fb_plan, digest=digest
+                    )
+                    t0 = time.perf_counter()
+                    _c, rows = sched.execute_plan(
+                        fb_plan, cut_hint=(kind, cut), digest=digest
+                    )
+                    st = (sched.last_query_mine() or {}).get(
+                        "shuffle", {}
+                    )
+                    if ref is None:
+                        ref = rows
+                    assert rows == ref, "feedback pair parity broke"
+                    out[phase] = {
+                        "seconds": round(time.perf_counter() - t0, 6),
+                        "modes": [s.mode for s in cut.sides],
+                        "adaptive": list(st.get("adaptive") or []),
+                        "bytes_tunneled": st.get("bytes_tunneled"),
+                    }
+                out["feedback_decisions"] = (
+                    aqe.decision_counts().get("feedback", 0.0) - d0
+                )
+                out["changed"] = (
+                    out["cold"]["modes"] != out["warm"]["modes"]
+                )
+                return out
+            finally:
+                sched.close()
+
+        feedback_ab = run_feedback_pair()
+
         ab = run_pipeline_pairs(pairs=max(args.repeat, 5))
         dag_ab = run_dag_ab(pairs=max(args.repeat, 3))
         assert tunnel["result"] == staged["result"], "mode parity broke"
@@ -1328,6 +1395,10 @@ def measure_multihost_shuffle(args) -> int:
                 # (phase means, percentiles) — the information_schema.
                 # statements_summary breakdown as the bench sees it
                 "flight": flight_breakdown,
+                # ISSUE 15: AQE feedback warm/cold pair — the warm
+                # run's seeded cost model flips repartition to
+                # broadcast (adaptive=feedback)
+                "feedback_ab": feedback_ab,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",
@@ -1372,6 +1443,153 @@ def measure_multihost_shuffle(args) -> int:
     rc = 0
     if args.out:
         args.cpu = True  # deliberate CPU scenario: not a fallback
+        rc = _write_out(args, result)
+    print(json.dumps(result))
+    return rc
+
+
+def measure_skew(args) -> int:
+    """AQE skew ladder (ISSUE 15): a zipf-keyed join+group-by runs at
+    2-3 skew exponents over a 4-server in-process fleet, interleaved
+    A/B with salting armed (tidb_tpu_shuffle_skew_ratio) vs off, at
+    EXACT row parity both arms. Stamps detail.aqe per rung: walls,
+    max-partition received rows (the skew the salting removed),
+    decisions taken. CPU data-plane scenario (in-process servers: the
+    fleet shares one catalog; XLA consumer work releases the GIL, so
+    hot-partition serialization is real), provenance-stamped."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import statistics
+
+    import numpy as np
+
+    from tidb_tpu.parallel import aqe
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.server.engine_rpc import EngineServer
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+
+    n_rows = int(50_000 * (args.sf if args.sf <= 1.0 else 0.5))
+    n_keys = max(n_rows // 50, 16)
+    m_hosts = 4
+    rungs = (1.1, 1.5, 2.0)
+    cat = Catalog()
+    sess = Session(cat, db="test")
+    rng = np.random.default_rng(7)
+    sess.execute("create table skew_dim (k int, g int)")
+    sess.execute(
+        "insert into skew_dim values "
+        + ",".join(f"({k},{k % 16})" for k in range(n_keys))
+    )
+    ladder = {}
+    servers = [EngineServer(cat, port=0) for _ in range(m_hosts)]
+    for s in servers:
+        s.start_background()
+    try:
+        for z in rungs:
+            # zipf-ranked keys: rank r gets mass ~ 1/r^z (clipped to
+            # the key domain); z=2.0 puts ~half the rows on rank 1
+            ranks = np.minimum(
+                rng.zipf(z, size=n_rows), n_keys
+            ).astype(np.int64) - 1
+            tbl = f"skew_f_{int(z * 10)}"
+            sess.execute(f"create table {tbl} (k int, v int)")
+            vals = ",".join(
+                f"({int(k)},{i % 97})" for i, k in enumerate(ranks)
+            )
+            sess.execute(f"insert into {tbl} values {vals}")
+            q = (
+                f"select g, count(*), sum(v) from {tbl} f "
+                "join skew_dim d on f.k = d.k "
+                "group by g order by g"
+            )
+            plan = build_query(
+                parse(q)[0], cat, "test", sess._scalar_subquery
+            )
+            mk = lambda ratio: DCNFragmentScheduler(
+                [("127.0.0.1", s.port) for s in servers],
+                catalog=cat, shuffle_mode="always",
+                shuffle_dag="never", shuffle_wait_timeout_s=60.0,
+                shuffle_skew_ratio=ratio, shuffle_skew_salt_k=4,
+            )
+            scheds = {"salted": mk(1.5), "plain": mk(0.0)}
+            entry = {}
+            try:
+                for arm in scheds.values():
+                    arm.execute_plan(plan)  # compile warmup
+                walls = {"salted": [], "plain": []}
+                stats = {}
+                ref = None
+                d0 = aqe.decision_counts().get("salted", 0.0)
+                for _ in range(max(args.repeat, 3)):
+                    for arm, sched in scheds.items():  # interleaved
+                        t0 = time.perf_counter()
+                        _c, rows = sched.execute_plan(plan)
+                        walls[arm].append(time.perf_counter() - t0)
+                        if ref is None:
+                            ref = rows
+                        assert rows == ref, f"z={z} {arm} parity broke"
+                        st = (sched.last_query_mine() or {}).get(
+                            "shuffle", {}
+                        )
+                        stats[arm] = st
+                for arm in scheds:
+                    st = stats[arm]
+                    entry[arm] = {
+                        "seconds": round(
+                            statistics.median(walls[arm]), 6
+                        ),
+                        "max_partition_rows": max(
+                            st.get("part_rows") or [0]
+                        ),
+                        "skew": st.get("skew"),
+                        "adaptive": list(st.get("adaptive") or []),
+                        "salt_k": st.get("salted", 0),
+                    }
+                entry["salted_decisions"] = (
+                    aqe.decision_counts().get("salted", 0.0) - d0
+                )
+                entry["speedup"] = round(
+                    entry["plain"]["seconds"]
+                    / max(entry["salted"]["seconds"], 1e-9), 4
+                )
+                entry["rows"] = len(ref)
+                entry["query"] = q
+            finally:
+                for sched in scheds.values():
+                    sched.close()
+            ladder[f"z{z:g}"] = entry
+    finally:
+        for s in servers:
+            s.shutdown()
+    top = ladder[f"z{rungs[-1]:g}"]
+    result = {
+        "metric": f"aqe_skew_salting_n{n_rows}_rows_per_sec",
+        "value": round(n_rows / top["salted"]["seconds"], 2),
+        "unit": "rows/s",
+        "vs_baseline": top["speedup"],
+        "detail": {
+            "backend": "cpu",
+            "scenario": "aqe_skew_salting",
+            "servers": m_hosts,
+            "rows": n_rows,
+            "keys": n_keys,
+            "repeat": args.repeat,
+            "aqe": ladder,
+            "backend_provenance": {
+                "backend": "cpu",
+                "pjrt_backend": "cpu",
+                "code_version": _code_version(),
+                "captured_unix": int(time.time()),
+                "fallback": False,
+            },
+        },
+    }
+    rc = 0
+    if args.out:
+        args.cpu = True
         rc = _write_out(args, result)
     print(json.dumps(result))
     return rc
@@ -1733,6 +1951,14 @@ def main() -> int:
         "0.02 unless --sf <= 1)",
     )
     ap.add_argument(
+        "--skew", action="store_true",
+        help="AQE skew ladder (ISSUE 15): zipf-keyed join+group-by at "
+        "3 skew exponents over a 4-server in-process fleet, "
+        "interleaved A/B with hot-key salting armed vs off at exact "
+        "row parity; stamps detail.aqe (walls, max-partition rows, "
+        "decisions taken)",
+    )
+    ap.add_argument(
         "--order-by", action="store_true",
         help="run the distributed ORDER BY range-exchange ladder "
         "instead of the single-engine ladder: top-K / aggregate-then-"
@@ -1815,6 +2041,8 @@ def main() -> int:
         return measure_chaos(args)
     if args.multihost_shuffle:
         return measure_multihost_shuffle(args)
+    if args.skew:
+        return measure_skew(args)
     if args.order_by:
         return measure_order_by(args)
 

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Lint: AQE decisions declared in tidb_tpu/parallel/aqe.py
+AQE_DECISIONS must match the literal ``note_decision`` call sites,
+and every declared decision must have at least one site.
+
+Why: the decision vocabulary is an API — the
+``tidbtpu_aqe_decisions_total{decision}`` series, the ``adaptive=``
+field on EXPLAIN ANALYZE DCNShuffle rows and the bench detail.aqe
+stamps all key on it. ``note_decision`` already rejects undeclared
+names at runtime, but a dead declaration (a decision nothing takes)
+silently rots into an always-zero series; the same contract as
+scripts/check_topsql_attrib.py for profiler CATEGORIES. Three rules:
+
+  1. every literal ``note_decision("name", ...)`` site in engine code
+     must name a declared decision (the runtime check made static);
+  2. every key in AQE_DECISIONS must have at least one literal call
+     site OUTSIDE aqe.py itself (the registry module hosting its own
+     call site would trivially satisfy the liveness rule);
+  3. a NON-LITERAL first argument at a call site fails — the decision
+     vocabulary must be statically readable.
+
+The AST walk resolves both spellings (``note_decision(...)`` and
+``aqe.note_decision(...)``) by matching the terminal attribute name.
+
+Usage: python scripts/check_aqe_decisions.py [root]
+Exit 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+AQE_REL = os.path.join("tidb_tpu", "parallel", "aqe.py")
+DECISION_FUNCS = frozenset({"note_decision"})
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "node_modules",
+             "tests"}
+SKIP_FILES = {
+    os.path.join("scripts", "check_aqe_decisions.py"),
+}
+
+
+def load_decisions(root: str):
+    """The AQE_DECISIONS literal via the AST (aqe.py imports the
+    package; exec'ing it standalone would need the engine importable
+    from the lint — the check_topsql_attrib.py approach)."""
+    path = os.path.join(root, AQE_REL)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if any(
+            isinstance(t, ast.Name) and t.id == "AQE_DECISIONS"
+            for t in targets
+        ):
+            return dict(ast.literal_eval(node.value))
+    raise SystemExit(f"AQE_DECISIONS assignment not found in {path}")
+
+
+def iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def check(root: str):
+    decisions = load_decisions(root)
+    declared = set(decisions)
+    violations = []
+    used = {}
+    for path in sorted(iter_py(root)):
+        rel = os.path.relpath(path, root)
+        if rel in SKIP_FILES or rel == AQE_REL:
+            # the registry module's own docstrings/wrappers are the
+            # API, not decision sites
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in DECISION_FUNCS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+            ):
+                violations.append(
+                    (rel, node.lineno,
+                     "non-literal AQE decision name (the vocabulary "
+                     "must be statically readable)")
+                )
+                continue
+            name = arg.value
+            used.setdefault(name, (rel, node.lineno))
+            if name not in declared:
+                violations.append(
+                    (rel, node.lineno,
+                     f"undeclared AQE decision {name!r} (declare it "
+                     "in tidb_tpu/parallel/aqe.py AQE_DECISIONS)")
+                )
+    for name in decisions:
+        if name not in used:
+            violations.append(
+                (AQE_REL, 1,
+                 f"declared AQE decision {name!r} has no "
+                 "note_decision call site outside aqe.py (dead "
+                 "declaration)")
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = check(root)
+    for rel, line, msg in violations:
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"{len(violations)} aqe-decision violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

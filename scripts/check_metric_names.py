@@ -39,9 +39,11 @@ import sys
 #: declared-rule diagnosis engine (PR 12, obs/inspection.py),
 #: topsql = the fleet-wide Top SQL continuous profiler (PR 14,
 #: obs/profiler.py — per-digest cpu/device/stall attribution series
-#: plus sampler self-metrics).
+#: plus sampler self-metrics), aqe = adaptive query execution (PR 15,
+#: parallel/aqe.py — decision counters, probe wall, misestimates).
 SUBSYSTEMS = frozenset({
     "admission",
+    "aqe",
     "chaos",
     "dcn",
     "delta",

@@ -1,0 +1,802 @@
+"""Adaptive query execution (ISSUE 15, parallel/aqe.py): skew-salted
+routing units, the salted/broadcast-switch/feedback decisions end to
+end over an in-process 2-server fleet, the history-seeded cardinality
+feedback store, the statements_summary est/act divergence surface, the
+cardinality-drift inspection rule, the replan-crash chaos class, and
+the check_aqe_decisions house lint.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tidb_tpu.parallel import aqe
+from tidb_tpu.utils import failpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _decisions(name):
+    return aqe.decision_counts().get(name, 0.0)
+
+
+# -- wire-level salted routing ---------------------------------------------
+
+
+def _block(keys, vals=None):
+    from tidb_tpu.chunk import HostBlock, HostColumn
+    from tidb_tpu.dtypes import INT64
+
+    keys = np.asarray(keys, dtype=np.int64)
+    cols = {
+        "t.k": HostColumn(INT64, keys, np.ones(len(keys), dtype=bool)),
+    }
+    if vals is not None:
+        cols["t.v"] = HostColumn(
+            INT64, np.asarray(vals, dtype=np.int64),
+            np.ones(len(keys), dtype=bool),
+        )
+    return HostBlock(cols, len(keys))
+
+
+class TestSaltedRouting:
+    def test_partition_histogram_matches_partition_map(self):
+        from tidb_tpu.parallel.wire import (
+            partition_histogram,
+            partition_map,
+        )
+
+        blk = _block(list(range(100)) + [7] * 40)
+        hist = partition_histogram(blk, "t.k", 4)
+        pmap = partition_map(blk, "t.k", 4)
+        assert hist == np.bincount(pmap, minlength=4).tolist()
+        assert sum(hist) == blk.nrows
+
+    def test_hot_key_ints_ranks_by_count(self):
+        from tidb_tpu.parallel.wire import column_key_ints, hot_key_ints
+
+        blk = _block([5] * 30 + [9] * 10 + list(range(100, 110)))
+        hot = hot_key_ints(blk, "t.k", top=2)
+        assert len(hot) == 2
+        ints = column_key_ints(blk.columns["t.k"])
+        assert hot[0] == [int(ints[0]), 30]
+        assert hot[1][1] == 10
+
+    def test_split_map_scatters_only_flagged_keys(self):
+        from tidb_tpu.parallel.wire import (
+            column_key_ints,
+            partition_map,
+            salt_targets,
+            salted_split_map,
+        )
+
+        m, k = 4, 2
+        blk = _block([7] * 50 + list(range(40)))
+        key_int = int(column_key_ints(blk.columns["t.k"])[0])
+        salt = {"keys": [key_int], "k": k}
+        base = partition_map(blk, "t.k", m)
+        out = salted_split_map(blk, "t.k", m, salt)
+        targets = set(salt_targets(key_int, m, k))
+        assert len(targets) == k
+        # flagged rows land ONLY in the salted target set, spread
+        # across it; unflagged rows keep their hash home
+        assert set(out[:50].tolist()) == targets
+        assert (out[50:] == base[50:]).all()
+
+    def test_replicate_fans_hot_rows_to_every_lane(self):
+        from tidb_tpu.parallel.wire import (
+            column_key_ints,
+            salt_targets,
+            salted_partition_assign,
+        )
+
+        m, k = 4, 3
+        blk = _block([3] * 5 + [100, 101])
+        key_int = int(column_key_ints(blk.columns["t.k"])[0])
+        salt = {"keys": [key_int], "k": k}
+        base, flagged, kk = salted_partition_assign(
+            blk, "t.k", m, salt
+        )
+        assert kk == k and flagged[:5].all() and not flagged[5:].any()
+        # the replicate fan-out: base+j (mod m) covers salt_targets
+        assert sorted(
+            (int(base[0]) + j) % m for j in range(kk)
+        ) == sorted(salt_targets(key_int, m, k))
+
+    def test_salt_k_clamps_to_partition_count(self):
+        from tidb_tpu.parallel.wire import salted_partition_assign
+
+        blk = _block([1] * 8)
+        _b, _f, k = salted_partition_assign(
+            blk, "t.k", 2, {"keys": [123], "k": 16}
+        )
+        assert k == 2  # a wrap past m would duplicate replicate copies
+
+    def test_null_keys_never_flagged(self):
+        from tidb_tpu.chunk import HostBlock, HostColumn
+        from tidb_tpu.dtypes import INT64
+        from tidb_tpu.parallel.wire import salted_partition_assign
+
+        col = HostColumn(
+            INT64, np.asarray([0, 0, 5], dtype=np.int64),
+            np.asarray([False, False, True]),
+        )
+        blk = HostBlock({"t.k": col}, 3)
+        _b, flagged, _k = salted_partition_assign(
+            blk, "t.k", 4, {"keys": [0], "k": 2}
+        )
+        assert not flagged[:2].any()
+
+
+# -- planner shapes ---------------------------------------------------------
+
+
+def _sess():
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage import Catalog
+
+    cat = Catalog()
+    s = Session(cat, db="test")
+    s.execute("create table jl (a int, v int)")
+    s.execute(
+        "insert into jl values "
+        + ",".join(f"({i % 20},{i})" for i in range(60))
+    )
+    s.execute("create table jm (a int, c int)")
+    s.execute("insert into jm values (1,100),(2,200)")
+    s.execute("create table jr (c int, w int)")
+    s.execute(
+        "insert into jr values "
+        + ",".join(f"({i % 10 + 300},{i})" for i in range(80))
+        + ",(100,1),(200,2)"
+    )
+    s.execute("create table gz (b varchar(8), a int)")
+    s.execute(
+        "insert into gz values "
+        + ",".join(f"('h',{i})" for i in range(30))
+        + ","
+        + ",".join(f"('k{i}',{i})" for i in range(10))
+    )
+    return s
+
+
+def _plan(sess, q):
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+
+    return build_query(
+        parse(q)[0], sess.catalog, "test", sess._scalar_subquery
+    )
+
+
+class TestPlannerShapes:
+    def test_salted_groupby_variant_decomposes(self):
+        from tidb_tpu.planner import logical as L
+        from tidb_tpu.planner.fragmenter import (
+            split_plan_shuffle,
+            split_plan_shuffle_salted,
+        )
+
+        sess = _sess()
+        plan = _plan(sess, "select b, count(*), sum(a) from gz group by b")
+        sp = split_plan_shuffle(plan, sess.catalog)
+        assert sp is not None and sp.kind == "groupby"
+        sp2 = split_plan_shuffle_salted(plan, sess.catalog)
+        assert sp2 is not None
+        # the salted consumer is the PARTIAL aggregate — its output
+        # re-merges through the final-agg builder, so a split group
+        # stays exact
+        assert isinstance(sp2.consumer, L.Aggregate)
+        assert sp2.sides[0].key == sp.sides[0].key
+        # same producer plan => the probe's cached block is reusable
+        assert sp2.sides[0].template is sp.sides[0].template
+
+    def test_salted_variant_refuses_distinct(self):
+        from tidb_tpu.planner.fragmenter import split_plan_shuffle_salted
+
+        sess = _sess()
+        plan = _plan(
+            sess, "select b, count(distinct a) from gz group by b"
+        )
+        assert split_plan_shuffle_salted(plan, sess.catalog) is None
+
+    def test_join_chain_dag_two_stages(self):
+        from tidb_tpu.planner import logical as L
+        from tidb_tpu.planner.fragmenter import split_plan_dag
+
+        sess = _sess()
+        plan = _plan(
+            sess,
+            "select count(*), sum(w) from jl join jm on jl.a = jm.a "
+            "join jr on jm.c = jr.c",
+        )
+        dag = split_plan_dag(plan, sess.catalog)
+        assert dag is not None and len(dag.stages) == 2
+        st0, st1 = dag.stages
+        assert st0.join_kind == "inner" and st1.join_kind == "inner"
+        # stage 1 re-exchanges stage 0's HELD output — no re-scan
+        assert isinstance(st1.sides[0].template, L.StageInput)
+        assert st1.sides[0].template.stage == 0
+        assert not st1.requires_key_partition
+        assert dag.merge["kind"] == "plan"
+
+    def test_choose_shuffle_modes_switches_and_resets(self):
+        from tidb_tpu.planner.fragmenter import (
+            choose_shuffle_modes,
+            split_plan_shuffle,
+        )
+
+        sess = _sess()
+        plan = _plan(
+            sess, "select count(*) from jl join jm on jl.a = jm.a"
+        )
+        sp = split_plan_shuffle(plan, sess.catalog)
+        assert sp is not None and sp.join_kind == "inner"
+        # jm (2 rows) collapses under the bar; jl (60) clears ratio
+        assert choose_shuffle_modes(sp, 10) == "broadcast"
+        modes = sorted(s.mode for s in sp.sides)
+        assert modes == ["broadcast", "local"]
+        # re-planning with the bar off RESETS to hash both ways
+        assert choose_shuffle_modes(sp, 0) == "hash"
+        assert all(s.mode == "hash" for s in sp.sides)
+
+    def test_groupby_cut_never_broadcasts(self):
+        from tidb_tpu.planner.fragmenter import (
+            choose_shuffle_modes,
+            split_plan_shuffle,
+        )
+
+        sess = _sess()
+        plan = _plan(sess, "select b, count(*) from gz group by b")
+        sp = split_plan_shuffle(plan, sess.catalog)
+        assert sp is not None and sp.kind == "groupby"
+        assert choose_shuffle_modes(sp, 10 ** 9) == "hash"
+
+
+# -- cardinality feedback store --------------------------------------------
+
+
+class TestCardinalityFeedback:
+    def test_record_and_seed_roundtrip(self):
+        from tidb_tpu.planner.cardinality import CardinalityFeedback
+
+        fb = CardinalityFeedback(capacity=4)
+        fb.record("d1", est=1000.0, act=3.0, sides={"0:0": 3, "0:1": 120})
+        assert fb.sides_for("d1") == {"0:0": 3, "0:1": 120}
+        assert fb.est_act("d1") == (1000.0, 3.0)
+        assert fb.sides_for("unknown") is None
+
+    def test_bounded_capacity_evicts_oldest(self):
+        from tidb_tpu.planner.cardinality import CardinalityFeedback
+
+        fb = CardinalityFeedback(capacity=2)
+        for i in range(4):
+            fb.record(f"d{i}", sides={"0:0": i})
+        assert fb.sides_for("d0") is None and fb.sides_for("d1") is None
+        assert fb.sides_for("d3") == {"0:0": 3}
+
+    def test_warm_from_history_seeds_est_act(self):
+        from tidb_tpu.planner.cardinality import CardinalityFeedback
+        from tidb_tpu.utils.metrics import StmtHistory, StmtSummary
+
+        class _F:
+            phases = {}
+            rows_sent = 5
+            plan_digest = ""
+            plan_cache = ""
+            jit_compilations = retraces = h2d_bytes = d2h_bytes = 0
+            device_mem_peak_bytes = 0
+            est_rows = 500.0
+            act_rows = 5.0
+
+        summ = StmtSummary(capacity=8)
+        hist = StmtHistory(max_windows=4, refresh_interval_s=0.001)
+        summ.history = hist
+        summ.record("select x", 0.01, flight=_F())
+        hist.rotate(summ)
+        fb = CardinalityFeedback()
+        assert fb.warm_from_history(hist) == 1
+        est, act = fb.est_act("select x")
+        assert est == 500.0 and act == 5.0
+
+
+# -- statements_summary est/act surface ------------------------------------
+
+
+class TestCardinalitySummary:
+    def test_divergence_columns_aggregate(self):
+        from tidb_tpu.obs.flight import FlightRecorder
+        from tidb_tpu.utils.metrics import StmtSummary
+
+        fl = FlightRecorder()
+        fl.begin("select z", conn_id=1)
+        fl.note_cardinality(1000.0, 10.0)
+        rec = fl.finish(0.01)
+        summ = StmtSummary(capacity=8)
+        summ.record("select z", 0.01, flight=rec)
+        row = summ.rows_full()[0]
+        assert row["est_rows"] == 1000.0 and row["act_rows"] == 10.0
+        assert row["card_divergence"] == 100.0  # symmetric, >= 1
+
+    def test_information_schema_exposes_columns(self):
+        sess = _sess()
+        r = sess.must_query(
+            "select est_rows, act_rows, card_divergence from "
+            "information_schema.statements_summary limit 1"
+        )
+        assert [c.lower() for c in r.columns] == [
+            "est_rows", "act_rows", "card_divergence",
+        ]
+
+
+# -- inspection rule --------------------------------------------------------
+
+
+class TestCardinalityDriftRule:
+    def _engine(self):
+        from tidb_tpu.obs.inspection import InspectionEngine
+        from tidb_tpu.obs.tsdb import TimeSeriesStore
+
+        store = TimeSeriesStore()
+        return store, InspectionEngine(store)
+
+    def _feed(self, store, series):
+        store.merge_remote(
+            [["tidbtpu_aqe_misestimates_total", [], [], t, v,
+              "counter"] for t, v in series],
+            host="coordinator",
+        )
+
+    def test_fires_on_chronic_misestimates(self):
+        store, eng = self._engine()
+        self._feed(store, [(100.0, 0.0), (200.0, 5.0)])
+        fs = [
+            f for f in eng.run(t_lo=50.0, t_hi=250.0)
+            if f.rule == "cardinality-drift"
+        ]
+        assert fs and fs[0].severity == "warning"
+        assert "aqe_feedback" in fs[0].detail
+
+    def test_quiet_below_threshold(self):
+        store, eng = self._engine()
+        self._feed(store, [(100.0, 0.0), (200.0, 1.0)])
+        assert not [
+            f for f in eng.run(t_lo=50.0, t_hi=250.0)
+            if f.rule == "cardinality-drift"
+        ]
+
+
+# -- decision registry ------------------------------------------------------
+
+
+class TestDecisionRegistry:
+    def test_undeclared_decision_raises(self):
+        with pytest.raises(ValueError, match="undeclared AQE decision"):
+            aqe.note_decision("nope")
+
+    def test_note_returns_token_and_counts(self):
+        before = _decisions("salted")
+        assert aqe.note_decision("salted", "3") == "salted:3"
+        assert _decisions("salted") == before + 1
+
+
+# -- chaos class ------------------------------------------------------------
+
+
+class TestReplanCrashClass:
+    def test_declared_and_deterministic(self):
+        from tidb_tpu.chaos.schedule import (
+            FAULT_CLASSES,
+            ChaosSchedule,
+            generate_replan_kill_specs,
+        )
+
+        assert "replan-crash" in FAULT_CLASSES
+        a = ChaosSchedule.generate(11, 8, 3, classes=("replan-crash",))
+        b = ChaosSchedule.generate(11, 8, 3, classes=("replan-crash",))
+        assert a == b
+        sites = {
+            f.site for ep in a.episodes for f in ep.faults
+        }
+        assert sites == {"aqe/switched-stage"}
+        specs = generate_replan_kill_specs(7, 2)
+        assert len(specs) == 2
+        assert any(
+            f["site"] == "aqe/switched-stage" and f["kind"] == "exit"
+            for f in specs[-1]
+        )
+
+
+# -- the house lint ---------------------------------------------------------
+
+
+class TestAqeDecisionsLint:
+    def _run(self, root):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_aqe_decisions.py"),
+             root],
+            capture_output=True, text=True,
+        )
+
+    def test_clean_at_head(self):
+        r = self._run(REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_seeded_violations(self, tmp_path):
+        pkg = tmp_path / "tidb_tpu" / "parallel"
+        pkg.mkdir(parents=True)
+        (pkg / "aqe.py").write_text(
+            'AQE_DECISIONS = {"good": "x", "dead": "y"}\n'
+        )
+        (tmp_path / "eng.py").write_text(
+            "def f(v):\n"
+            '    note_decision("good")\n'
+            '    note_decision("undeclared")\n'
+            "    note_decision(v)\n"
+        )
+        r = self._run(str(tmp_path))
+        assert r.returncode == 1
+        assert "undeclared AQE decision 'undeclared'" in r.stdout
+        assert "non-literal AQE decision" in r.stdout
+        assert "declared AQE decision 'dead'" in r.stdout
+
+
+# -- end to end over an in-process 2-server fleet ---------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    from tidb_tpu.server.engine_rpc import EngineServer
+
+    sess = _sess()
+    servers = [EngineServer(sess.catalog, port=0) for _ in range(2)]
+    for s in servers:
+        s.start_background()
+    yield sess, servers
+    for s in servers:
+        s.shutdown()
+
+
+def _sched(sess, servers, **kw):
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+
+    kw.setdefault("shuffle_mode", "always")
+    kw.setdefault("shuffle_dag", "never")
+    kw.setdefault("shuffle_wait_timeout_s", 30.0)
+    return DCNFragmentScheduler(
+        [("127.0.0.1", s.port) for s in servers],
+        catalog=sess.catalog, **kw,
+    )
+
+
+class TestAdaptiveE2E:
+    def test_salted_groupby_parity_and_surfaces(self, fleet):
+        sess, servers = fleet
+        q = "select b, count(*), sum(a) from gz group by b order by b"
+        plan = _plan(sess, q)
+        salted = _sched(
+            sess, servers, shuffle_skew_ratio=1.4,
+            shuffle_skew_salt_k=2,
+        )
+        plain = _sched(sess, servers, shuffle_skew_ratio=0.0)
+        try:
+            before = _decisions("salted")
+            _c, r1 = salted.execute_plan(plan)
+            _c, r2 = plain.execute_plan(plan)
+            assert r1 == r2
+            st = salted.last_query["shuffle"]
+            assert st["adaptive"] == ["salted:2"]
+            assert st["salted"] == 2
+            assert _decisions("salted") == before + 1
+            # the plain arm's stage summary still carries the skew
+            # ratio — detection auditable without salting
+            stp = plain.last_query["shuffle"]
+            assert stp.get("skew", 0) > 1.0
+            assert len(stp.get("part_rows") or []) == 2
+            # salting rebalanced the received rows
+            assert st["skew"] < stp["skew"]
+            # EXPLAIN ANALYZE renders both fields
+            _c2, _r, lines = salted.explain_analyze(plan)
+            row = next(l for l in lines if "DCNShuffle" in l)
+            assert "adaptive=salted:2" in row and "skew=" in row
+        finally:
+            salted.close()
+            plain.close()
+
+    def test_broadcast_switch_on_collapsed_side(self, fleet):
+        sess, servers = fleet
+        # static est (jl: 60 rows) clears the 10-row bar, but the
+        # a < 2 filter collapses the observed side to ~6 rows
+        q = (
+            "select count(*), sum(v) from jl "
+            "join jr on jl.a = jr.w where jl.a < 2"
+        )
+        plan = _plan(sess, q)
+        adaptive = _sched(
+            sess, servers, shuffle_skew_ratio=1.4,
+            shuffle_broadcast_rows=10,
+        )
+        plain = _sched(sess, servers, shuffle_skew_ratio=0.0)
+        try:
+            before = _decisions("broadcast-switch")
+            _c, r1 = adaptive.execute_plan(plan)
+            _c, r2 = plain.execute_plan(plan)
+            assert r1 == r2
+            st = adaptive.last_query["shuffle"]
+            assert st["adaptive"] == ["broadcast-switch"]
+            assert _decisions("broadcast-switch") == before + 1
+            # the big side stayed local: fewer bytes than repartition
+            assert (
+                st["bytes_tunneled"]
+                < plain.last_query["shuffle"]["bytes_tunneled"]
+            )
+        finally:
+            adaptive.close()
+            plain.close()
+
+    def test_stage_boundary_replan_on_join_chain(self, fleet):
+        sess, servers = fleet
+        q = (
+            "select count(*), sum(w) from jl join jm on jl.a = jm.a "
+            "join jr on jm.c = jr.c"
+        )
+        plan = _plan(sess, q)
+        adaptive = _sched(
+            sess, servers, shuffle_dag="always",
+            shuffle_broadcast_rows=50,
+        )
+        plain = _sched(sess, servers, shuffle_dag="always")
+        try:
+            kind, cut = adaptive._choose_cut(plan)
+            assert kind == "dag" and len(cut.stages) == 2
+            before = _decisions("broadcast-switch")
+            _c, r1 = adaptive.execute_plan(plan)
+            _c, r2 = plain.execute_plan(plan)
+            assert r1 == r2
+            stages = adaptive.last_query["shuffle_stages"]
+            # stage 1 switched mid-query from stage 0's observed held
+            # rows (6 << the 60-row static estimate)
+            assert "broadcast-switch" in (stages[1].get("adaptive") or [])
+            assert sorted(stages[1]["modes"]) == ["broadcast", "local"]
+            assert _decisions("broadcast-switch") >= before + 1
+            total = lambda lq: sum(
+                s["bytes_tunneled"] for s in lq["shuffle_stages"]
+            )
+            assert total(adaptive.last_query) < total(plain.last_query)
+        finally:
+            adaptive.close()
+            plain.close()
+
+    def test_probe_skipped_when_groupby_cannot_salt(self, fleet):
+        """A DISTINCT aggregate has no salted partial/final variant —
+        the only adaptive action a group-by probe can feed is
+        impossible, so the probe round (produce-and-cache + an RPC
+        round per attempt) must not run at all."""
+        sess, servers = fleet
+        q = "select b, count(distinct a) from gz group by b order by b"
+        plan = _plan(sess, q)
+        sched = _sched(
+            sess, servers, shuffle_skew_ratio=1.4,
+            shuffle_skew_salt_k=2,
+        )
+        plain = _sched(sess, servers, shuffle_skew_ratio=0.0)
+        try:
+            calls = []
+            orig = sched._probe_stage
+
+            def spy(*a, **kw):
+                calls.append(1)
+                return orig(*a, **kw)
+
+            sched._probe_stage = spy
+            _c, r1 = sched.execute_plan(plan)
+            _c, r2 = plain.execute_plan(plan)
+            assert r1 == r2
+            assert not calls
+            # a decomposable aggregate on the same shape still probes
+            plan2 = _plan(
+                sess, "select b, count(*) from gz group by b order by b"
+            )
+            _c, _r = sched.execute_plan(plan2)
+            assert calls
+        finally:
+            sched.close()
+            plain.close()
+
+    def test_replan_token_persists_across_retry_attempts(self, fleet):
+        """A retried DAG attempt re-derives the SAME flipped modes
+        from the stage's already-mutated sides — no NEW decision is
+        taken, but the stashed token must still render on the rebuilt
+        stage summary (adaptive= has to agree with the modes the
+        workers actually ran) and the counter must move exactly
+        once."""
+        from tidb_tpu.planner import logical as L
+
+        sess, servers = fleet
+        q = (
+            "select count(*), sum(w) from jl join jm on jl.a = jm.a "
+            "join jr on jm.c = jr.c"
+        )
+        plan = _plan(sess, q)
+        sched = _sched(
+            sess, servers, shuffle_dag="always",
+            shuffle_broadcast_rows=50,
+        )
+        try:
+            kind, cut = sched._choose_cut(plan)
+            assert kind == "dag" and len(cut.stages) == 2
+            stg = cut.stages[1]
+            held_stage = next(
+                s.template.stage for s in stg.sides
+                if isinstance(s.template, L.StageInput)
+            )
+            infos = [{"stage": held_stage, "held_rows": 3}]
+            before = _decisions("broadcast-switch")
+            t1 = sched._stage_replan(stg, infos)
+            assert t1 == ["broadcast-switch"]
+            assert _decisions("broadcast-switch") == before + 1
+            # attempt 2: same observations, modes already flipped
+            t2 = sched._stage_replan(stg, infos)
+            assert t2 == ["broadcast-switch"]
+            assert _decisions("broadcast-switch") == before + 1
+        finally:
+            sched.close()
+
+    def test_feedback_changes_choice_on_second_run(self, fleet):
+        from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+        from tidb_tpu.utils.metrics import sql_digest
+
+        sess, servers = fleet
+        q = "select count(*) from jl join jr on jl.a = jr.w where jl.a < 2"
+        digest = sql_digest(q)
+        CARD_FEEDBACK.reset()
+        plan = _plan(sess, q)
+        sched = _sched(
+            sess, servers, aqe_feedback=True, shuffle_broadcast_rows=10,
+        )
+        try:
+            before = _decisions("feedback")
+            kind, cut = sched._choose_cut(plan, digest=digest)
+            assert [s.mode for s in cut.sides] == ["hash", "hash"]
+            _c, r1 = sched.execute_plan(
+                plan, cut_hint=(kind, cut), digest=digest
+            )
+            # the observed side rows were recorded for this digest
+            assert CARD_FEEDBACK.sides_for(digest)
+            kind2, cut2 = sched._choose_cut(plan, digest=digest)
+            assert sorted(s.mode for s in cut2.sides) == [
+                "broadcast", "local",
+            ]
+            assert getattr(cut2, "_aqe_tokens", None) == ["feedback"]
+            assert _decisions("feedback") == before + 1
+            _c, r2 = sched.execute_plan(
+                plan, cut_hint=(kind2, cut2), digest=digest
+            )
+            assert r1 == r2
+            assert sched.last_query["shuffle"]["adaptive"] == ["feedback"]
+        finally:
+            sched.close()
+
+    def test_partition_rows_histogram_moves(self, fleet):
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        sess, servers = fleet
+
+        def count():
+            return sum(
+                v for n, _k, v in REGISTRY.rows()
+                if n.startswith("tidbtpu_shuffle_partition_rows_count")
+            )
+
+        sched = _sched(sess, servers)
+        try:
+            c0 = count()
+            sched.execute_plan(
+                _plan(sess, "select b, count(*) from gz group by b")
+            )
+            assert count() >= c0 + 2  # one observation per partition
+        finally:
+            sched.close()
+
+    def test_routed_statement_records_est_act(self, fleet):
+        from tidb_tpu.utils.metrics import STMT_SUMMARY, sql_digest
+
+        sess, servers = fleet
+        sched = _sched(sess, servers)
+        sess.attach_dcn_scheduler(sched)
+        try:
+            q = "select b, count(*) from gz group by b order by b"
+            sess.execute(q)
+            ent = next(
+                e for e in STMT_SUMMARY.rows_full()
+                if e["digest_text"] == sql_digest(q)
+            )
+            assert ent["act_rows"] == 11.0
+            assert ent["est_rows"] > 0
+            assert ent["card_divergence"] >= 1.0
+        finally:
+            sess.attach_dcn_scheduler(None)
+            sched.close()
+
+    def test_sysvars_resolve_and_retune_live(self, fleet):
+        sess, servers = fleet
+        sess.execute("set global tidb_tpu_shuffle_skew_ratio = 2.5")
+        sess.execute("set global tidb_tpu_aqe_feedback = ON")
+        try:
+            sched = _sched(sess, servers)
+            try:
+                # ctor resolves unset args from the globals
+                assert sched.shuffle_skew_ratio == 2.5
+                assert sched.aqe_feedback is True
+                # live SET re-tunes an ATTACHED scheduler
+                sess.attach_dcn_scheduler(sched)
+                sess.execute(
+                    "set global tidb_tpu_shuffle_skew_ratio = 3.5"
+                )
+                sess.execute(
+                    "set global tidb_tpu_shuffle_skew_salt_k = 8"
+                )
+                sess.execute("set global tidb_tpu_aqe_feedback = OFF")
+                sess.execute(
+                    "set global tidb_tpu_aqe_replan_ratio = 9.0"
+                )
+                assert sched.shuffle_skew_ratio == 3.5
+                assert sched.shuffle_skew_salt_k == 8
+                assert sched.aqe_feedback is False
+                assert sched.aqe_replan_ratio == 9.0
+                # session-scoped SET errors loudly (GLOBAL-only)
+                with pytest.raises(Exception):
+                    sess.execute("set tidb_tpu_aqe_feedback = ON")
+            finally:
+                sess.attach_dcn_scheduler(None)
+                sched.close()
+        finally:
+            sess.execute("set global tidb_tpu_shuffle_skew_ratio = 0.0")
+            sess.execute("set global tidb_tpu_aqe_feedback = OFF")
+            sess.execute("set global tidb_tpu_shuffle_skew_salt_k = 4")
+            sess.execute("set global tidb_tpu_aqe_replan_ratio = 4.0")
+
+    def test_salted_stage_survives_worker_loss(self, fleet):
+        """replan-crash, in-process: the salted task's reply is lost
+        on its first dispatch (drop at aqe/switched-stage); the
+        coordinator verifies the suspect (alive: in-process drop is a
+        transport loss, not a death), retries the WHOLE stage — probe
+        round included — and reaches parity with salting re-decided."""
+        from tidb_tpu.server.engine_rpc import DropConnection
+
+        sess, servers = fleet
+        q = "select b, count(*), sum(a) from gz group by b order by b"
+        plan = _plan(sess, q)
+        plain = _sched(sess, servers, shuffle_skew_ratio=0.0)
+        # the dropped task never produces, so the healthy partition's
+        # consumer detects the loss only by wait expiry — a short
+        # loopback budget keeps the fault path from idling 30s
+        salted = _sched(
+            sess, servers, shuffle_skew_ratio=1.4,
+            shuffle_skew_salt_k=2, shuffle_wait_timeout_s=5.0,
+        )
+        try:
+            exp = plain.execute_plan(plan)[1]
+            failpoint.enable(
+                "aqe/switched-stage",
+                failpoint.after_n(1, DropConnection("chaos")),
+            )
+            _c, got = salted.execute_plan(plan)
+            assert got == exp
+            st = salted.last_query["shuffle"]
+            assert st["attempts"] >= 2
+            assert st["adaptive"] == ["salted:2"]
+        finally:
+            failpoint.disable("aqe/switched-stage")
+            plain.close()
+            salted.close()

@@ -1450,3 +1450,88 @@ def test_dcn_topsql_fleet_attribution(tpch_single):
         sched.close()
         for w in (w1, w2):
             w.kill()
+
+
+def test_dcn_aqe_replan_crash_retry_parity(tpch_single):
+    """ISSUE 15 chaos acceptance (replan-crash): worker 2 hard-exits
+    (os._exit) the first time an ADAPTIVE stage task reaches it — the
+    window between the coordinator's re-plan decision (a probe-observed
+    collapsed join side switching repartition to broadcast) and the
+    switched stage's completion — while both workers also drop a
+    seeded fraction of pushed frames. The coordinator must quarantine
+    the dead worker and retry the WHOLE stage, probe round included,
+    on the survivor set (m=1: the probe gate stands down, the stage
+    runs plain) with exact row parity and the adaptive decision
+    counted from the first attempt."""
+    import json as _json
+
+    from tidb_tpu.chaos.schedule import generate_replan_kill_specs
+    from tidb_tpu.parallel import aqe
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_pool import FailedEngineProber
+
+    SEED = 1501
+    specs = generate_replan_kill_specs(SEED, 2)
+    assert specs == generate_replan_kill_specs(SEED, 2)  # replayable
+    assert any(
+        f["site"] == "aqe/switched-stage" and f["kind"] == "exit"
+        for f in specs[-1]
+    )
+    workers, ports = [], []
+    for spec in specs:
+        w, p = _spawn_dcn_worker(["--chaos-spec", _json.dumps(spec)])
+        workers.append(w)
+        ports.append(p)
+    # static est (orders at full table size) says repartition; the
+    # o_custkey filter collapses the observed side under the bar, so
+    # the probe's broadcast-switch decision targets worker 2 with an
+    # adaptive stage task — its armed exit fires exactly there
+    orders_rows = tpch_single.catalog.table("tpch", "orders").nrows
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p) for p in ports],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+        shuffle_dag="never",
+        shuffle_skew_ratio=1.5,
+        shuffle_broadcast_rows=max(orders_rows // 4, 64),
+        # the killed worker dies BEFORE producing, so the survivor
+        # detects the loss only by wait expiry (the serve-load 10s
+        # loopback stance) — the healthy retry is m=1 and never waits
+        shuffle_wait_timeout_s=10.0,
+        prober=FailedEngineProber(initial_backoff_s=60),
+    )
+    try:
+        q = (
+            "select count(*), sum(l_quantity) from lineitem "
+            "join orders on l_orderkey = o_orderkey "
+            "where o_custkey < 5"
+        )
+        exp = tpch_single.must_query(q).rows
+        before = aqe.decision_counts().get("broadcast-switch", 0.0)
+        _cols, got = sched.execute_plan(_plan(tpch_single, q))
+        assert got == exp, f"\n got={got}\n exp={exp}"
+        st = sched.last_query["shuffle"]
+        # the whole stage retried on the survivor set after the kill
+        assert st["attempts"] >= 2
+        assert st["m"] == 1
+        # the decision genuinely fired before the crash
+        assert aqe.decision_counts().get(
+            "broadcast-switch", 0.0
+        ) >= before + 1
+        # ...but the m=1 retry ran the PLAIN cut: the superseded
+        # attempt's token must not linger on the reported summary
+        # (adaptive= has to agree with what the survivor actually
+        # ran; the counter above is the record that it fired)
+        assert not st.get("adaptive")
+        assert [e.port for e in sched.prober.failed_endpoints()] == [
+            ports[-1]
+        ]
+        workers[-1].wait(timeout=30)
+        assert workers[-1].returncode == 3
+        # the survivor keeps serving adaptive-eligible queries alone
+        _cols, got2 = sched.execute_plan(_plan(tpch_single, q))
+        assert got2 == exp
+    finally:
+        sched.close()
+        for w in workers:
+            w.kill()

@@ -529,7 +529,7 @@ class TestSlowQueryCapture:
         sess.execute("insert into obs_rt values (1),(2)")
 
         class TripwireSched:
-            def _choose_cut(self, plan):  # pragma: no cover - tripwire
+            def _choose_cut(self, plan, digest=None):  # pragma: no cover - tripwire
                 raise AssertionError(
                     "local-only statement offered to the fleet"
                 )
@@ -561,7 +561,7 @@ class TestSlowQueryCapture:
         sess.execute("insert into obs_fb values (1),(2),(3)")
 
         class DeadFleetSched:
-            def _choose_cut(self, plan):
+            def _choose_cut(self, plan, digest=None):
                 return "frag", object()
 
             def execute_plan(self, plan, cut_hint=None):

@@ -318,7 +318,7 @@ class TestRejectionSurfaces:
             def __init__(self, admission):
                 self.admission = admission
 
-            def _choose_cut(self, plan):
+            def _choose_cut(self, plan, digest=None):
                 return ("frag", None)
 
             def execute_plan(self, plan, cut_hint=None):

@@ -43,6 +43,12 @@ Fault classes and the real mechanism each exercises:
   shuffle/stage-input site fires as stage N+1 reads stage N's held
   output): the held partition is gone, the stage aborts retryable,
   and the whole chain restarts on the survivors under a new attempt.
+- ``replan-crash``      — the worker dies between an AQE re-plan
+  decision and the switched stage's dispatch (the aqe/switched-stage
+  site fires as the salted / broadcast-switched task arrives): the
+  reply is lost, the coordinator verifies + quarantines, and the
+  WHOLE chain — probe round included — retries on the survivor set
+  with the adaptive decisions re-taken at the new fleet size.
 """
 
 from __future__ import annotations
@@ -66,6 +72,7 @@ FAULT_CLASSES = (
     "clock-skew",
     "sample-loss",
     "interstage-crash",
+    "replan-crash",
     "delta-sync-loss",
     "compactor-crash",
 )
@@ -222,6 +229,14 @@ def _make_fault(cls: str, rng: random.Random) -> Fault:
         return Fault(
             cls, "shuffle/stage-input", "drop", n=rng.randint(1, 3),
         )
+    if cls == "replan-crash":
+        # the worker "dies" between the AQE re-plan decision and the
+        # switched stage's execution: the salted/broadcast-switched
+        # task's reply is lost, and the whole chain (probe included)
+        # must retry on the survivor set with decisions re-taken
+        return Fault(
+            cls, "aqe/switched-stage", "drop", n=rng.randint(1, 2),
+        )
     if cls == "delta-sync-loss":
         # the delta-sync ACK vanishes AFTER the replica applied the
         # frame: the replicator retransmits and the worker's seq fence
@@ -315,6 +330,36 @@ def generate_interstage_kill_specs(
             faults.append(
                 Fault("interstage-crash", "shuffle/stage-input",
                       "exit", n=1)
+            )
+        specs.append([f.to_dict() for f in faults])
+    return specs
+
+
+def generate_replan_kill_specs(
+    seed: int, n_workers: int
+) -> List[List[dict]]:
+    """Per-worker-PROCESS fault specs for the AQE replan-crash dryrun
+    (test_multihost): the LAST worker hard-exits (os._exit) the first
+    time a SWITCHED/SALTED stage task reaches it — i.e. AFTER the
+    coordinator took the re-plan decision, BEFORE the adapted stage
+    completed — while every worker drops a seeded fraction of pushed
+    frames. The whole chain (probe round included) must retry on the
+    survivor set and reach parity with the decisions re-taken.
+    Deterministic in (seed, n_workers)."""
+    rng = random.Random(int(seed))
+    specs: List[List[dict]] = []
+    for w in range(int(n_workers)):
+        faults = [
+            Fault(
+                "frame-drop", "shuffle/push-lost", "seeded-error",
+                p=round(rng.uniform(0.01, 0.04), 4),
+                seed=rng.randint(0, 2 ** 31),
+            ),
+        ]
+        if w == n_workers - 1:
+            faults.append(
+                Fault("replan-crash", "aqe/switched-stage", "exit",
+                      n=1)
             )
         specs.append([f.to_dict() for f in faults])
     return specs

@@ -352,6 +352,67 @@ def _drv_shuffle_fleet(ctx) -> None:
             s.shutdown()
 
 
+def _drv_aqe_fleet(ctx) -> None:
+    """The AQE sites (parallel/aqe.py) over a real 2-server fleet:
+    a skewed GROUP BY (one dominant key) arms the hash-stage probe
+    (aqe/probe fires in run_probe, aqe/probe-lost at the reply seam)
+    and salts the hot partition (aqe/replan at the decision,
+    aqe/switched-stage as the salted task arrives); a join whose
+    filtered side collapses below shuffle_broadcast_rows — while the
+    static catalog estimate says repartition — takes the observed
+    broadcast-switch through the same sites."""
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.parser.sqlparse import parse
+    from tidb_tpu.planner.logical import build_query
+    from tidb_tpu.server.engine_rpc import EngineServer
+
+    sess = ctx["session"]
+    sess.execute("create table sw_aqe_l (a int, b varchar(8))")
+    rows = (
+        [f"({i},'h')" for i in range(30)]
+        + [f"({30 + i},'x')" for i in range(3)]
+        + [f"({40 + i},'k{i}')" for i in range(7)]
+    )
+    sess.execute("insert into sw_aqe_l values " + ",".join(rows))
+    sess.execute("create table sw_aqe_r (k int)")
+    sess.execute(
+        "insert into sw_aqe_r values "
+        + ",".join(f"({i})" for i in range(120))
+    )
+    servers = [EngineServer(sess.catalog, port=0) for _ in range(2)]
+    for s in servers:
+        s.start_background()
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", s.port) for s in servers],
+        catalog=sess.catalog, shuffle_mode="always",
+        shuffle_dag="never", shuffle_wait_timeout_s=30.0,
+        shuffle_skew_ratio=1.4, shuffle_skew_salt_k=2,
+        shuffle_broadcast_rows=30,
+    )
+    try:
+        for q in (
+            # skewed GROUP BY: the 'h' partition holds >= 30 of 40
+            # rows -> probe detects, salts across both hosts, and the
+            # coordinator re-merges the salted partials
+            "select b, count(*), sum(a) from sw_aqe_l group by b "
+            "order by b",
+            # collapsed-side join: static est (40 rows) > the 30-row
+            # broadcast bar, but the b='x' filter collapses the side
+            # to 3 OBSERVED rows -> broadcast-switch
+            "select count(*) from sw_aqe_l join sw_aqe_r on a = k "
+            "where b = 'x'",
+        ):
+            plan = build_query(
+                parse(q)[0], sess.catalog, "test",
+                sess._scalar_subquery,
+            )
+            sched.execute_plan(plan)
+    finally:
+        sched.close()
+        for s in servers:
+            s.shutdown()
+
+
 #: the declared sweep: (kind, name, payload, sites traversed).
 #: Sites listed here are what the runtime sweep asserts FIRE; the
 #: static lint additionally counts any literal site mention in this
@@ -460,6 +521,9 @@ SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
       "shuffle/push-lost", "shuffle/wait", "shuffle/consume",
       "shuffle/stage", "shuffle/sample", "shuffle/sample-lost",
       "shuffle/stage-input", "dcn/dispatch", "dcn/final-stage")),
+    ("driver", "aqe-fleet", _drv_aqe_fleet,
+     ("aqe/probe", "aqe/probe-lost", "aqe/replan",
+      "aqe/switched-stage")),
 ]
 
 

@@ -122,6 +122,7 @@ class QueryFlight:
         "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
         "device_mem_peak_bytes", "compile_flops",
         "compile_bytes_accessed", "compile_output_bytes", "live_phase",
+        "est_rows", "act_rows",
     )
 
     def __init__(self, qid: int, conn_id: int, sql: str):
@@ -152,6 +153,12 @@ class QueryFlight:
         self.compile_flops = 0.0
         self.compile_bytes_accessed = 0.0
         self.compile_output_bytes = 0.0
+        #: planner-estimated vs observed output rows of a routed
+        #: statement (AQE, PR 15): statements_summary exposes the
+        #: per-digest mean divergence, and the cardinality feedback
+        #: store learns from the pair
+        self.est_rows = 0.0
+        self.act_rows = 0.0
         #: the phase the executing thread is INSIDE right now — the
         #: Top SQL sampler (obs/profiler.py) reads it from another
         #: thread to attribute a sampled instant. note_phase charges
@@ -337,6 +344,17 @@ class FlightRecorder:
         rec = self.current()
         if rec is not None:
             rec.rows_sent = int(n)
+
+    def note_cardinality(self, est: float, act: float) -> None:
+        """Planner-estimated vs observed output rows of a routed
+        statement (AQE): feeds the statements_summary est/act
+        divergence columns and the tidbtpu_aqe_misestimates_total
+        signal behind the cardinality-drift inspection rule."""
+        rec = self.current()
+        if rec is None:
+            return
+        rec.est_rows = float(est)
+        rec.act_rows = float(act)
 
     def note_plan_text(self, text: str) -> None:
         rec = self.current()

@@ -501,6 +501,42 @@ def _r_plan_cache_thrash(ctx) -> List[Finding]:
     return out
 
 
+#: routed statements whose observed output rows diverged from the
+#: planner estimate past the replan ratio, per window, before the
+#: drift is chronic (one misestimated ad-hoc query is noise; a digest
+#: re-running misestimated every window is a stats problem)
+CARD_DRIFT_MIN = 3
+
+
+@rule(
+    "cardinality-drift",
+    metrics=("tidbtpu_aqe_misestimates_total",),
+)
+def _r_cardinality_drift(ctx) -> List[Finding]:
+    """Chronic planner misestimates (AQE, parallel/aqe.py): routed
+    statements keep observing output rows far from the estimate —
+    the cost model is flying blind. statements_summary's
+    est_rows/act_rows/card_divergence columns show WHICH digests;
+    ANALYZE the tables, or turn on tidb_tpu_aqe_feedback so the
+    next runs plan from measured actuals."""
+    out = []
+    miss, t0, t1 = _sum_increase(
+        ctx.increase("tidbtpu_aqe_misestimates_total")
+    )
+    if miss >= CARD_DRIFT_MIN:
+        out.append(Finding(
+            "cardinality-drift", "planner", "warning", miss,
+            f"< {CARD_DRIFT_MIN} misestimated statements per window",
+            f"{miss:.0f} routed statements observed output rows "
+            "diverging from the planner estimate past the replan "
+            "ratio; query statements_summary.card_divergence for the "
+            "digests, ANALYZE their tables, or SET GLOBAL "
+            "tidb_tpu_aqe_feedback=ON to plan from observed actuals",
+            t0, t1,
+        ))
+    return out
+
+
 @rule(
     "clock-skew",
     metrics=("tidbtpu_link_clock_offset_seconds",),

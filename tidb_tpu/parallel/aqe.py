@@ -1,0 +1,109 @@
+"""Adaptive query execution: the declared decision registry.
+
+The fleet measures per-partition shuffle row counts, per-side produced
+rows and per-digest plan history — and PR 15 lets those stats re-shape
+a plan mid-query at three points (parallel/dcn.py):
+
+- ``salted``            — a hash exchange's probe showed one partition
+  holding more than ``tidb_tpu_shuffle_skew_ratio`` x the mean row
+  count, so the hot partition's keys are split (salted) across K
+  hosts; join stages replicate the other side's hot-key rows to the
+  salted hosts, group-by stages re-merge the salted partials through
+  the ordinary partial/final aggregate decomposition.
+- ``broadcast-switch``  — observed rows (a probe's exact produce
+  counts, or a completed DAG stage's held outputs) showed one join
+  side collapsed below ``shuffle_broadcast_rows``, so the remaining
+  exchange switches from repartition-join to broadcast small side +
+  local big side (zero probe bytes).
+- ``feedback``          — with ``tidb_tpu_aqe_feedback=on``, per-digest
+  observed side rows recorded from earlier runs (the PR 8
+  admission-estimate learning pattern, fed by statements_summary /
+  statements_summary_history actuals) seeded the cost model and
+  CHANGED a shuffle_mode=auto or edge-mode choice.
+
+``AQE_DECISIONS`` is a DECLARED registry (the failpoint-SITES
+pattern): ``note_decision`` rejects undeclared names at runtime and
+scripts/check_aqe_decisions.py cross-checks the declaration against
+the literal call sites (undeclared / non-literal / dead declarations
+all fail), so a typo'd decision can neither silently fork the
+``tidbtpu_aqe_decisions_total{decision}`` series nor rot unused.
+
+Every taken decision is counted, carried on the stage summary
+(``adaptive=`` on the EXPLAIN ANALYZE DCNShuffle row, visible in the
+slow log's captured plan), and auditable even when nothing triggers
+(the ``skew=`` max/mean ratio field renders from the per-partition
+counts regardless).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tidb_tpu.utils.metrics import REGISTRY
+
+#: every adaptive decision the DCN tier may take: name -> what it
+#: changes. The registry — not the call site — defines the vocabulary.
+AQE_DECISIONS: Dict[str, str] = {
+    "salted": "hot hash partition split across K hosts (join: other "
+              "side's hot keys replicated; group-by: salted partials "
+              "re-merged through the final aggregate)",
+    "broadcast-switch": "repartition-join edge switched to broadcast "
+                        "small side + local big side from OBSERVED "
+                        "row counts (probe produce, or a completed "
+                        "DAG stage's held outputs)",
+    "feedback": "per-digest observed actuals seeded the cost model "
+                "and changed a shuffle_mode=auto / edge-mode choice",
+}
+
+
+def _c_decisions():
+    return REGISTRY.counter(
+        "tidbtpu_aqe_decisions_total",
+        "adaptive execution decisions taken, by declared kind "
+        "(parallel/aqe.py AQE_DECISIONS)",
+        labels=("decision",),
+    )
+
+
+def _c_probe_seconds():
+    return REGISTRY.counter(
+        "tidbtpu_aqe_probe_seconds",
+        "coordinator wall spent in skew/cardinality probe rounds "
+        "(produce-and-cache + per-partition histogram merge)",
+    )
+
+
+def _c_misestimates():
+    return REGISTRY.counter(
+        "tidbtpu_aqe_misestimates_total",
+        "routed statements whose observed output rows diverged from "
+        "the planner estimate by more than the replan ratio (the "
+        "cardinality-drift inspection rule's signal)",
+    )
+
+
+def note_decision(name: str, detail: str = "") -> str:
+    """Record one taken adaptive decision: validates the name against
+    the declared registry (undeclared raises — the failpoint-SITES
+    contract), moves the counter, and returns the ``adaptive=`` token
+    (``name`` or ``name:detail``) the caller appends to the stage
+    summary."""
+    if name not in AQE_DECISIONS:
+        raise ValueError(
+            f"undeclared AQE decision {name!r} (declare it in "
+            "tidb_tpu/parallel/aqe.py AQE_DECISIONS)"
+        )
+    _c_decisions().labels(decision=name).inc()
+    return f"{name}:{detail}" if detail else name
+
+
+def decision_counts() -> Dict[str, float]:
+    """Current per-decision counter values (tests, bench detail)."""
+    out = {}
+    for n, _k, v in REGISTRY.rows():
+        if n.startswith("tidbtpu_aqe_decisions_total"):
+            # tidbtpu_aqe_decisions_total{decision="x"}
+            d = n.split('decision="', 1)
+            if len(d) == 2:
+                out[d[1].rstrip('"}')] = v
+    return out

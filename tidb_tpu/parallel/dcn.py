@@ -215,6 +215,16 @@ def _c_shuffle_result_bytes():
     )
 
 
+def _h_partition_rows():
+    return REGISTRY.histogram(
+        "tidbtpu_shuffle_partition_rows",
+        "rows each shuffle partition's consumer RECEIVED (per "
+        "partition per stage) — _sum/_count give the mean partition "
+        "load; the max/mean skew ratio renders on the EXPLAIN "
+        "ANALYZE DCNShuffle row as skew=",
+    )
+
+
 def _update_host_gauges(endpoints) -> None:
     alive = sum(1 for ep in endpoints if ep.alive)
     REGISTRY.gauge(
@@ -569,6 +579,10 @@ class DCNFragmentScheduler:
         shuffle_codec: str = "binary",
         shuffle_pipeline: bool = True,
         shuffle_produce_chunks: Optional[int] = None,
+        shuffle_skew_ratio: Optional[float] = None,
+        shuffle_skew_salt_k: Optional[int] = None,
+        aqe_feedback: Optional[bool] = None,
+        aqe_replan_ratio: Optional[float] = None,
         conn_pool_size: int = 4,
         admission=None,
         retry_backoff_s: float = 0.05,
@@ -671,6 +685,29 @@ class DCNFragmentScheduler:
             heartbeat_miss_threshold = int(
                 sv.get("tidb_tpu_heartbeat_miss_threshold")
             )
+        # adaptive execution knobs (parallel/aqe.py): skew bar + salt
+        # fan-out arm the hash-exchange probe; aqe_feedback seeds the
+        # cost model from per-digest observed actuals; the replan
+        # ratio gates stage-boundary re-planning. Unset args resolve
+        # from the sysvars like the liveness knobs above.
+        if shuffle_skew_ratio is None:
+            shuffle_skew_ratio = float(
+                sv.get("tidb_tpu_shuffle_skew_ratio")
+            )
+        if shuffle_skew_salt_k is None:
+            shuffle_skew_salt_k = int(
+                sv.get("tidb_tpu_shuffle_skew_salt_k")
+            )
+        if aqe_feedback is None:
+            aqe_feedback = bool(sv.get("tidb_tpu_aqe_feedback"))
+        if aqe_replan_ratio is None:
+            aqe_replan_ratio = float(
+                sv.get("tidb_tpu_aqe_replan_ratio")
+            )
+        self.shuffle_skew_ratio = float(shuffle_skew_ratio)
+        self.shuffle_skew_salt_k = int(shuffle_skew_salt_k)
+        self.aqe_feedback = bool(aqe_feedback)
+        self.aqe_replan_ratio = float(aqe_replan_ratio)
         self.shuffle_wait_timeout_s = float(shuffle_wait_timeout_s)
         self.heartbeat = HostHeartbeat(
             self.endpoints, self.prober,
@@ -990,7 +1027,7 @@ class DCNFragmentScheduler:
     # -- query execution ------------------------------------------------
     def execute_plan(
         self, plan: L.LogicalPlan, cut_hint=None, kill_check=None,
-        deadline=None, delta_seq=None,
+        deadline=None, delta_seq=None, digest=None,
     ) -> Tuple[List[str], List[tuple]]:
         """Run a bound logical plan across the worker hosts. Prefers a
         worker-to-worker shuffle cut when the policy says tunnels beat
@@ -1011,7 +1048,10 @@ class DCNFragmentScheduler:
         additionally PROPAGATED: each dispatch carries its remaining
         seconds, so a worker self-cancels even if the coordinator is
         wedged."""
-        kind, cut = cut_hint if cut_hint is not None else self._choose_cut(plan)
+        kind, cut = (
+            cut_hint if cut_hint is not None
+            else self._choose_cut(plan, digest=digest)
+        )
         # routed snapshot: pin every scanned table's base version for
         # the WHOLE query (all fragments of all stages read one base —
         # a concurrent write + version GC cannot mutate an in-flight
@@ -1024,7 +1064,7 @@ class DCNFragmentScheduler:
                 FLIGHT.set_live_phase("fragment-dispatch")
                 parts_rows, infos, stages = self._run_dag(
                     cut, kill_check=kill_check, deadline=deadline,
-                    snap=snap,
+                    snap=snap, digest=digest,
                 )
                 retries = max(
                     (int(s.get("attempts", 1)) - 1 for s in stages),
@@ -1040,16 +1080,20 @@ class DCNFragmentScheduler:
             if kind == "shuffle":
                 t0 = time.perf_counter()
                 FLIGHT.set_live_phase("fragment-dispatch")
-                rows, infos, stage = self._run_shuffle(
+                rows, infos, stage, used = self._run_shuffle(
                     cut, kill_check=kill_check, deadline=deadline,
-                    snap=snap,
+                    snap=snap, plan=plan, digest=digest,
                 )
                 self._note_dispatch(
                     t0, infos,
                     retries=max(int(stage.get("attempts", 1)) - 1, 0),
                 )
                 FLIGHT.note_shuffle_stage(stage)
-                return self._timed_final_stage(cut, rows)
+                # `used` may be a re-planned cut (the salted group-by
+                # variant re-merges partials through ITS final-agg
+                # builder), so the final stage runs the cut the
+                # workers actually executed
+                return self._timed_final_stage(used, rows)
             if kind == "frag":
                 t0 = time.perf_counter()
                 FLIGHT.set_live_phase("fragment-dispatch")
@@ -1145,7 +1189,7 @@ class DCNFragmentScheduler:
         ]
 
     def explain_analyze(
-        self, plan: L.LogicalPlan, delta_seq=None
+        self, plan: L.LogicalPlan, delta_seq=None, digest=None,
     ) -> Tuple[List[str], List[tuple], List[str]]:
         """Distributed EXPLAIN ANALYZE: run the fragments (or the
         shuffle stage), then the final stage INSTRUMENTED, and merge
@@ -1155,22 +1199,24 @@ class DCNFragmentScheduler:
         plan-tree rows — the reference's cop-task RuntimeStatsColl
         merge, over the engine-RPC seam. Returns (columns, rows, plan
         lines)."""
-        kind, cut = self._choose_cut(plan)
+        kind, cut = self._choose_cut(plan, digest=digest)
         pins: List[tuple] = []
         snap = self._build_snapshot(plan, delta_seq, pins)
         try:
             return self._explain_analyze_inner(
-                plan, kind, cut, snap
+                plan, kind, cut, snap, digest=digest
             )
         finally:
             for t, v in pins:
                 t.unpin(v)
 
-    def _explain_analyze_inner(self, plan, kind, cut, snap):
+    def _explain_analyze_inner(self, plan, kind, cut, snap, digest=None):
         from tidb_tpu.chunk import materialize_rows
 
         if kind == "dag":
-            parts_rows, infos, stages = self._run_dag(cut, snap=snap)
+            parts_rows, infos, stages = self._run_dag(
+                cut, snap=snap, digest=digest
+            )
             pairs = [
                 (s, [f for f in infos if f.get("stage", 0) == si])
                 for si, s in enumerate(stages)
@@ -1203,10 +1249,12 @@ class DCNFragmentScheduler:
             out_rows = materialize_rows(out, list(final.schema), dicts)
             return [c.name for c in final.schema], out_rows, lines
         if kind == "shuffle":
-            rows, infos, stage = self._run_shuffle(cut, snap=snap)
+            rows, infos, stage, used = self._run_shuffle(
+                cut, snap=snap, plan=plan, digest=digest
+            )
             inject("dcn/final-stage")
-            staged = self._stage_rows(cut, rows)
-            final = cut.final_builder(staged)
+            staged = self._stage_rows(used, rows)
+            final = used.final_builder(staged)
             out, dicts, lines = self._executor.run_analyze(
                 final, shuffle_stats=(stage, infos)
             )
@@ -1232,7 +1280,62 @@ class DCNFragmentScheduler:
         return [c.name for c in final.schema], out_rows, lines
 
     # -- worker-to-worker shuffle stages --------------------------------
-    def _choose_cut(self, plan: L.LogicalPlan):
+    def _choose_cut(self, plan: L.LogicalPlan, digest: Optional[str] = None):
+        """One planning pass deciding the execution path — plus the
+        AQE feedback seam (parallel/aqe.py): with
+        ``tidb_tpu_aqe_feedback=on`` and a digest whose observed
+        per-side rows were recorded from an earlier run, the cut is
+        re-planned with the MEASURED side estimates; when that changes
+        the decision (the shuffle_mode=auto gates or an edge mode),
+        the ``feedback`` decision is counted and the cut carries the
+        ``adaptive=feedback`` marker into the stage summary."""
+        base = self._choose_cut_inner(plan)
+        if not self.aqe_feedback or not digest:
+            return base
+        from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+
+        seeds = CARD_FEEDBACK.sides_for(digest)
+        if not seeds:
+            return base
+        seeded = self._choose_cut_inner(plan, seeds=seeds)
+        if self._cut_signature(seeded) != self._cut_signature(base):
+            from tidb_tpu.parallel import aqe
+
+            token = aqe.note_decision("feedback")
+            if seeded[1] is not None:
+                seeded[1]._aqe_tokens = [token]
+        return seeded
+
+    @staticmethod
+    def _cut_signature(cut) -> tuple:
+        """The DECISION content of one planned cut: the path kind plus
+        every side's exchange mode — what the feedback seeding must
+        have changed for the ``feedback`` decision to count."""
+        kind, c = cut
+        if kind == "dag":
+            return ("dag", tuple(
+                tuple(s.mode for s in st.sides) for st in c.stages
+            ))
+        if kind == "shuffle":
+            return ("shuffle", tuple(s.mode for s in c.sides))
+        return (kind,)
+
+    @staticmethod
+    def _seed_sides(sides, stage_idx: int, seeds, kind: str) -> None:
+        """Overwrite static side estimates with recorded actuals
+        (keys ``"<kind>:<stage>:<tag>"`` — per-side produced rows from
+        the fenced stage stats of this digest's last run). Keys are
+        scoped by the cut KIND that executed: a single-stage shuffle
+        run's side totals must not seed a DAG candidate's stages (or
+        vice versa) — same digest, different relations per side."""
+        if not seeds:
+            return
+        for s in sides:
+            v = seeds.get(f"{kind}:{stage_idx}:{s.tag}")
+            if v is not None:
+                s.est_rows = int(v)
+
+    def _choose_cut_inner(self, plan: L.LogicalPlan, seeds=None):
         """One planning pass deciding the execution path: ("dag",
         ShuffleDAG) | ("shuffle", ShufflePlan) | ("frag",
         FragmentPlan) | ("single", None).
@@ -1263,7 +1366,8 @@ class DCNFragmentScheduler:
         ):
             dag = split_plan_dag(plan, self.catalog)
             if dag is not None:
-                for st in dag.stages:
+                for si, st in enumerate(dag.stages):
+                    self._seed_sides(st.sides, si, seeds, "dag")
                     choose_edge_modes(st, self.shuffle_broadcast_rows)
                 if self.shuffle_dag == "always":
                     return "dag", dag
@@ -1281,6 +1385,10 @@ class DCNFragmentScheduler:
         if self.shuffle_mode != "never":
             sp = split_plan_shuffle(plan, self.catalog)
         if sp is not None:
+            from tidb_tpu.planner.fragmenter import choose_shuffle_modes
+
+            self._seed_sides(sp.sides, 0, seeds, "shuffle")
+            choose_shuffle_modes(sp, self.shuffle_broadcast_rows)
             if self.shuffle_mode == "always":
                 return "shuffle", sp
             if sp.kind == "join" and min(
@@ -1288,6 +1396,17 @@ class DCNFragmentScheduler:
             ) >= self.shuffle_min_rows:
                 # neither side small: repartition over tunnels —
                 # decided without paying the staging planner's pass
+                return "shuffle", sp
+            if (
+                sp.kind == "join"
+                and any(s.mode == "broadcast" for s in sp.sides)
+                and max(s.est_rows for s in sp.sides)
+                >= self.shuffle_min_rows
+            ):
+                # one side collapsed under the broadcast bar (static
+                # stats, or the AQE feedback seed): broadcast join
+                # over tunnels ships the big side ZERO bytes — beats
+                # both repartition and the staging cut's re-shipping
                 return "shuffle", sp
         frag = split_plan(plan, self.catalog)
         if frag is not None:
@@ -1304,8 +1423,8 @@ class DCNFragmentScheduler:
 
     def _run_shuffle(
         self, sp: ShufflePlan, kill_check=None, deadline=None,
-        snap=None,
-    ) -> Tuple[List[tuple], List[dict], dict]:
+        snap=None, plan=None, digest=None,
+    ) -> Tuple[List[tuple], List[dict], dict, "ShufflePlan"]:
         """Run one shuffle stage to completion: dispatch a produce+
         consume task per alive host, each host pushing hash partitions
         directly to its peers; on a peer death (transport loss to the
@@ -1313,7 +1432,19 @@ class DCNFragmentScheduler:
         the suspects, quarantine them, and re-run the WHOLE stage on
         the survivor set at the next attempt — receivers fence stale-
         attempt packets, the per-attempt ledger fences results, so a
-        retried stage lands exactly once."""
+        retried stage lands exactly once.
+
+        Adaptive execution (parallel/aqe.py): with
+        ``tidb_tpu_shuffle_skew_ratio`` armed, a PROBE round first
+        produces-and-caches every side and replies exact
+        per-partition histograms + hot keys; the stage then
+        dispatches salted (hot partition split across K hosts) or
+        broadcast-switched (a collapsed side observed under
+        ``shuffle_broadcast_rows``) — the cached produce blocks mean
+        the re-planned stage never re-executes the producers.
+        Returns (rows, infos, stage summary, the ShufflePlan actually
+        executed — the salted group-by variant re-merges through ITS
+        final builder)."""
         qid = _QUERY_ID.next()
         sid = f"{self._sid_prefix}-q{qid}"
         ts_entry = self._topsql_entry()  # statement thread: see helper
@@ -1334,6 +1465,23 @@ class DCNFragmentScheduler:
             "wait_idle_s": 0.0, "ttff_s": 0.0, "exec_s": 0.0,
         }
         last_err: Optional[str] = None
+        # AQE precheck, once per statement: a group-by cut can only
+        # act on a probe through its salted partial/final variant —
+        # when the aggregate does not decompose (DISTINCT,
+        # GROUP_CONCAT) there is NO possible adaptive action, so the
+        # probe round (a produce-and-cache pass + an RPC round per
+        # attempt) would be pure overhead and is skipped entirely
+        salted_sp = None
+        if (
+            self.shuffle_skew_ratio > 1.0
+            and self.shuffle_codec == "binary"
+            and sp.kind == "groupby" and plan is not None
+        ):
+            from tidb_tpu.planner.fragmenter import (
+                split_plan_shuffle_salted,
+            )
+
+            salted_sp = split_plan_shuffle_salted(plan, self.catalog)
         for rnd in range(self.max_attempts):
             if rnd:
                 # jittered exponential backoff before every re-attempt:
@@ -1362,6 +1510,46 @@ class DCNFragmentScheduler:
             fatal: List[Exception] = []
             cancelled: List[str] = []
             killed: Optional[BaseException] = None
+            # -- AQE probe + re-plan (parallel/aqe.py): the feedback
+            # marker from _choose_cut rides along; the probe may add
+            # salted / broadcast-switch on top
+            used_sp = sp
+            salts = None
+            tokens = list(getattr(sp, "_aqe_tokens", None) or [])
+            probe = None
+            if (
+                self.shuffle_skew_ratio > 1.0
+                and self.shuffle_codec == "binary"
+                and m > 1
+                and (sp.kind != "groupby" or salted_sp is not None)
+                and all(s.frag_scan is not None for s in sp.sides)
+            ):
+                probe = self._probe_stage(
+                    sp, hosts, m, attempt, qid, kill_check, deadline,
+                    suspects, errs, snap=snap,
+                )
+                if probe is None:
+                    # a probe reply was lost: exactly as retryable as
+                    # a dispatch loss — verify the suspects, retry the
+                    # stage on the survivor set
+                    if errs:
+                        last_err = errs[0]
+                    self._verify_suspects(suspects)
+                    continue
+                used_sp, salts, toks = self._aqe_decide(
+                    plan, sp, probe, m, salted_sp=salted_sp
+                )
+                tokens = tokens + toks
+            stage["kind"] = used_sp.kind
+            # reflect THIS attempt's decisions: a retry whose probe
+            # stood down (e.g. the survivor set collapsed to m=1) runs
+            # the PLAIN cut, so the superseded attempt's tokens must
+            # not linger on the summary (adaptive= has to agree with
+            # the modes the workers actually ran)
+            if tokens:
+                stage["adaptive"] = list(tokens)
+            else:
+                stage.pop("adaptive", None)
 
             def run_part(i: int, ep: EngineEndpoint, conn: EngineClient):
                 token = ledger.claim(i, ep.address)
@@ -1378,11 +1566,25 @@ class DCNFragmentScheduler:
                     "sides": [
                         {
                             "tag": s.tag, "key": s.key,
+                            "mode": getattr(s, "mode", "hash"),
+                            # salted routing spec (None = plain), and
+                            # whether a probe already produced-and-
+                            # cached this side (the stage round then
+                            # reads the held block instead of
+                            # re-executing the producer)
+                            "salt": (salts or {}).get(s.tag),
+                            "probed": probe is not None,
                             "plan": plan_to_ir(s.host_plan(i, m)),
                         }
-                        for s in sp.sides
+                        for s in used_sp.sides
                     ],
-                    "consumer": plan_to_ir(sp.consumer),
+                    "adaptive": list(tokens) or None,
+                    # single-stage tasks drain this query's held
+                    # state (the probe round CACHES produce blocks
+                    # via _held_put) once the consumer lands — the
+                    # chaos harness's held-leak invariant
+                    "release_held": True,
+                    "consumer": plan_to_ir(used_sp.consumer),
                     "wait_timeout_s": self.shuffle_wait_timeout_s,
                     "packet_rows": self.shuffle_packet_rows,
                     "max_inflight_bytes": self.shuffle_inflight_bytes,
@@ -1490,6 +1692,7 @@ class DCNFragmentScheduler:
             if ledger.all_done():
                 infos.sort(key=lambda f: f["fid"])
                 self._fold_stage(stage, infos)
+                self._record_feedback(digest, [stage], "shuffle")
                 lq = {
                     "qid": qid, "fragments": infos,
                     "shuffle": dict(stage),
@@ -1499,23 +1702,51 @@ class DCNFragmentScheduler:
                     self.last_query = lq
                 self._tls.last = lq
                 _update_host_gauges(self.endpoints)
-                return ledger.rows(), infos, stage
+                return ledger.rows(), infos, stage, used_sp
             if errs:
                 last_err = errs[0]
             # verify the suspects before the next attempt: a reported
             # dead tunnel or missing producer is quarantined only when
             # it really stopped answering (a transient loss retries on
             # the same set)
-            by_addr = {ep.address: ep for ep in self.endpoints}
-            for addr in sorted(set(suspects)):
-                ep = by_addr.get(addr)
-                if ep is not None and ep.alive and not ping_endpoint(ep):
-                    self._quarantine(ep)
+            self._verify_suspects(suspects)
         raise ConnectionError(
             f"shuffle stage {sid} undispatchable after "
             f"{self.max_attempts} attempts ({len(self.endpoints)} hosts, "
             f"{len(self.alive_endpoints())} alive); last error: {last_err}"
         )
+
+    def _verify_suspects(self, suspects) -> None:
+        """Quarantine only suspects that REALLY stopped answering (a
+        transient loss retries on the same set) — the pre-retry
+        verification shared by the shuffle stage, the DAG chain and
+        the AQE probe round."""
+        by_addr = {ep.address: ep for ep in self.endpoints}
+        for addr in sorted(set(suspects)):
+            ep = by_addr.get(addr)
+            if ep is not None and ep.alive and not ping_endpoint(ep):
+                self._quarantine(ep)
+
+    def _record_feedback(self, digest, stage_summaries, kind) -> None:
+        """Record one completed routed statement's OBSERVED per-side
+        produced rows into the cardinality feedback store (keys
+        ``"<kind>:<stage>:<tag>"`` — scoped by the cut kind that
+        executed, so a shuffle run's totals never seed a DAG
+        candidate's unrelated sides) — the actuals a later run of the
+        same digest seeds its cost model from (tidb_tpu_aqe_feedback)."""
+        if not digest:
+            return
+        sides: Dict[str, int] = {}
+        for st in stage_summaries:
+            si = int(st.get("stage", 0))
+            for tag, rows in (st.get("side_rows") or {}).items():
+                key = f"{kind}:{si}:{tag}"
+                sides[key] = sides.get(key, 0) + int(rows)
+        if not sides:
+            return
+        from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+
+        CARD_FEEDBACK.record(digest, sides=sides)
 
     # -- shuffle DAGs: topo-ordered multi-stage exchanges ---------------
     @staticmethod
@@ -1534,7 +1765,7 @@ class DCNFragmentScheduler:
 
     def _stage_task(
         self, dag, si, stage, i, m, attempt, qid, boundaries, peers,
-        secret, deadline, snap=None, topsql=None,
+        secret, deadline, snap=None, topsql=None, adaptive=None,
     ) -> dict:
         """The worker task spec for partition ``i`` of DAG stage
         ``si`` — run_task's single-stage spec plus the DAG fields
@@ -1548,6 +1779,7 @@ class DCNFragmentScheduler:
             "deadline_s": self._deadline_left(deadline),
             "stage": si, "n_stages": n,
             "exchange": stage.exchange,
+            "adaptive": list(adaptive) if adaptive else None,
             "boundaries": list(boundaries or []),
             "hold_output": si < n - 1,
             "release_held": si == n - 1,
@@ -1647,6 +1879,218 @@ class DCNFragmentScheduler:
             [s for s in samples if s is not None], m
         )
 
+    def _probe_stage(
+        self, sp, hosts, m, attempt, qid, kill_check, deadline,
+        suspects, errs, snap=None,
+    ) -> Optional[Dict[int, dict]]:
+        """AQE probe round of one hash stage (parallel/aqe.py): every
+        worker produces-and-CACHES its sides (ShuffleWorker.run_probe
+        — the range-sampling discipline, so the stage round re-reads
+        the blocks instead of re-executing the producers) and replies
+        exact per-partition row histograms + hottest keys. Returns
+        the merged per-side view {tag: {"rows", "part_rows", "hot"}},
+        or None when a host failed (suspects filled — the caller
+        verifies and retries on the survivor set)."""
+        t0 = time.perf_counter()
+        ts_entry = self._topsql_entry()  # statement thread: see helper
+        replies: List[Optional[list]] = [None] * m
+        fatal: List[Exception] = []
+        cancelled: List[str] = []
+
+        def run_one(i: int, ep: EngineEndpoint, conn: EngineClient):
+            spec = {
+                "qid": qid, "attempt": attempt, "m": m, "part": i,
+                "coord": self._sid_prefix, "stage": 0,
+                "deadline_s": self._deadline_left(deadline),
+                "sides": [
+                    {
+                        "tag": s.tag, "key": s.key,
+                        "plan": plan_to_ir(s.host_plan(i, m)),
+                    }
+                    for s in sp.sides
+                ],
+                "snap": snap,
+                "topsql": ts_entry,
+            }
+            try:
+                resp = conn.call(
+                    {"v": IR_VERSION, "shuffle_probe": spec}
+                )
+            except (SchemaOutOfDateError, RuntimeError, ValueError,
+                    PermissionError):
+                raise
+            except Exception as e:
+                with self._lock:
+                    suspects.append(ep.address)
+                    errs.append(f"{ep.address}: {e}")
+                return
+            if not self._classify_reply(
+                resp, suspects, errs, cancelled
+            ):
+                return
+            replies[i] = list(resp.get("sides") or [])
+
+        def runner(i, ep, conn):
+            try:
+                run_one(i, ep, conn)
+            except Exception as e:
+                fatal.append(e)
+
+        killed = self._leased_rounds(
+            hosts, runner, qid,
+            sid=f"{self._sid_prefix}-q{qid}-probe",
+            kill_check=kill_check, deadline=deadline,
+            suspects=suspects, errs=errs,
+        )
+        from tidb_tpu.parallel.aqe import _c_probe_seconds
+
+        _c_probe_seconds().inc(time.perf_counter() - t0)
+        if fatal:
+            raise fatal[0]
+        if killed is not None:
+            raise killed
+        if cancelled:
+            from tidb_tpu.utils.sqlkiller import QueryKilled
+
+            raise QueryKilled(cancelled[0])
+        if any(r is None for r in replies):
+            return None
+        merged: Dict[int, dict] = {}
+        for r in replies:
+            for sd in r:
+                tag = int(sd.get("tag", 0))
+                ent = merged.setdefault(
+                    tag, {"rows": 0, "part_rows": [0] * m, "hot": {}}
+                )
+                ent["rows"] += int(sd.get("rows", 0))
+                for p, n in enumerate(sd.get("part_rows") or ()):
+                    if p < m:
+                        ent["part_rows"][p] += int(n)
+                for kv in sd.get("hot") or ():
+                    k, c = int(kv[0]), int(kv[1])
+                    ent["hot"][k] = ent["hot"].get(k, 0) + c
+        return merged
+
+    def _aqe_decide(self, plan, sp, probe, m, salted_sp=None):
+        """Turn one probe's merged observations into adaptive
+        decisions (parallel/aqe.py). Returns (the ShufflePlan to
+        execute, per-tag salt specs or None, decision tokens).
+        ``salted_sp`` is the caller's precomputed salted group-by
+        variant (_run_shuffle plans it once per statement and skips
+        the probe entirely when it is None).
+
+        Priority: a COLLAPSED side broadcast-switches first (zero
+        big-side bytes beats any salting), then a partition over
+        ``shuffle_skew_ratio`` x mean with identifiable hot keys
+        salts — join stages split the hot side and replicate the
+        other side's hot keys; group-by stages re-plan to the partial/
+        final decomposition so the coordinator re-merges the salted
+        partials."""
+        from tidb_tpu.parallel import aqe
+        from tidb_tpu.parallel.shuffle import mix_hash_np
+        from tidb_tpu.planner.fragmenter import (
+            choose_shuffle_modes,
+            split_plan_shuffle_salted,
+        )
+        import numpy as np
+
+        tokens: List[str] = []
+        # (1) observed collapsed side -> broadcast-switch
+        if (
+            sp.kind == "join" and len(sp.sides) == 2
+            and self.shuffle_broadcast_rows > 0
+        ):
+            prev = tuple(s.mode for s in sp.sides)
+            for s in sp.sides:
+                obs = probe.get(s.tag)
+                if obs is not None:
+                    s.est_rows = int(obs["rows"])
+            shape = choose_shuffle_modes(
+                sp, self.shuffle_broadcast_rows
+            )
+            if shape == "broadcast":
+                if tuple(s.mode for s in sp.sides) != prev:
+                    inject("aqe/replan")
+                    tokens.append(
+                        aqe.note_decision("broadcast-switch")
+                    )
+                return sp, None, tokens
+        # (2) hot partition -> salting
+        if self.shuffle_skew_ratio <= 1.0 or m <= 1:
+            return sp, None, tokens
+        part_tot = [
+            sum(probe[t]["part_rows"][p] for t in probe)
+            for p in range(m)
+        ]
+        total = sum(part_tot)
+        mean = total / m if m else 0.0
+        if mean <= 0:
+            return sp, None, tokens
+        hot_p = max(range(m), key=lambda p: part_tot[p])
+        if part_tot[hot_p] < self.shuffle_skew_ratio * mean:
+            return sp, None, tokens
+        # flag the hot keys HOMED on the hot partition with meaningful
+        # mass (a partition hot from many distinct keys has no key to
+        # salt — splitting by key would not move it)
+        counts: Dict[int, int] = {}
+        for t in probe:
+            for k, c in probe[t]["hot"].items():
+                counts[k] = counts.get(k, 0) + c
+        flagged = [
+            k for k, c in counts.items()
+            if c >= 0.5 * mean
+            and int(
+                mix_hash_np(np.asarray([k], dtype=np.int64))[0]
+                % np.int64(m)
+            ) == hot_p
+        ]
+        if not flagged:
+            return sp, None, tokens
+        k_salt = max(min(self.shuffle_skew_salt_k, m), 2)
+        base_salt = {"keys": sorted(flagged), "k": k_salt}
+        if sp.kind == "join" and len(sp.sides) == 2:
+            # the side carrying the hot mass SPLITS; the other side
+            # REPLICATES its hot-key rows to the salted lanes
+            mass = {
+                s.tag: sum(
+                    probe.get(s.tag, {}).get("hot", {}).get(k, 0)
+                    for k in flagged
+                )
+                for s in sp.sides
+            }
+            split_tag = max(mass, key=lambda t: mass[t])
+            if sp.join_kind != "inner" and split_tag != 0:
+                # left/semi/anti preserve the LEFT side: replicating
+                # it would duplicate preserved rows — skip salting
+                return sp, None, tokens
+            salts = {
+                s.tag: dict(
+                    base_salt,
+                    role="split" if s.tag == split_tag
+                    else "replicate",
+                )
+                for s in sp.sides
+            }
+            inject("aqe/replan")
+            tokens.append(aqe.note_decision("salted", str(k_salt)))
+            return sp, salts, tokens
+        if sp.kind == "groupby" and plan is not None:
+            # a salted hot group SPLITS across K partitions, so the
+            # consumer must produce PARTIAL aggregates and the
+            # coordinator re-merges them — the salted plan variant
+            # (None when the aggregate does not decompose: skip)
+            sp2 = (
+                salted_sp if salted_sp is not None
+                else split_plan_shuffle_salted(plan, self.catalog)
+            )
+            if sp2 is None:
+                return sp, None, tokens
+            salts = {0: dict(base_salt, role="split")}
+            inject("aqe/replan")
+            tokens.append(aqe.note_decision("salted", str(k_salt)))
+            return sp2, salts, tokens
+        return sp, None, tokens
+
     def _leased_rounds(
         self, hosts, runner, qid, sid=None, kill_check=None,
         deadline=None, suspects=None, errs=None,
@@ -1692,7 +2136,13 @@ class DCNFragmentScheduler:
     @staticmethod
     def _fold_stage(stage: dict, infos: List[dict]) -> None:
         """Accumulate the fenced per-partition worker stats into one
-        stage summary (the _run_shuffle fold, shared by the DAG)."""
+        stage summary (the _run_shuffle fold, shared by the DAG).
+        Also derives the AQE observability fields: per-side produced
+        rows (the feedback actuals), the per-partition received-row
+        list and its max/mean skew ratio (the ``skew=`` EXPLAIN
+        field + tidbtpu_shuffle_partition_rows histogram — auditable
+        even when no salting triggered)."""
+        part_recv: Dict[int, int] = {}
         for f in infos:
             stage["bytes_tunneled"] += f["pushed_bytes"]
             stage["rows_tunneled"] += f["pushed_rows"]
@@ -1710,10 +2160,85 @@ class DCNFragmentScheduler:
             stage["ttff_s"] = max(
                 stage["ttff_s"], f.get("ttff_s", 0.0)
             )
+            for t, v in (f.get("side_rows") or {}).items():
+                sr = stage.setdefault("side_rows", {})
+                sr[str(t)] = sr.get(str(t), 0) + int(v)
+            part_recv[int(f["fid"])] = int(f.get("recv_rows", 0))
+            if f.get("salted"):
+                stage["salted"] = max(
+                    int(stage.get("salted", 0)), int(f["salted"])
+                )
+        pr = [part_recv[k] for k in sorted(part_recv)]
+        stage["part_rows"] = pr
+        if pr and sum(pr) > 0:
+            mean = sum(pr) / len(pr)
+            stage["skew"] = round(max(pr) / mean, 2)
+            for v in pr:
+                _h_partition_rows().observe(float(v))
+
+    def _stage_replan(self, stg, prev_infos) -> List[str]:
+        """AQE stage-boundary re-planning (parallel/aqe.py): before
+        dispatching a downstream DAG stage, compare the OBSERVED held
+        rows of its StageInput sides (already attempt-fenced
+        worker-side inputs) against the planner estimate; when a side
+        collapsed below ``shuffle_broadcast_rows`` or diverged past
+        ``tidb_tpu_aqe_replan_ratio``, re-run choose_edge_modes with
+        the observed counts — the switched stage re-plans only this
+        downstream edge (held outputs stay where they are; a
+        broadcast StageInput side ships each worker's held partition
+        to every peer, which IS the full side). Returns the decision
+        tokens. A taken decision PERSISTS on the stage across retry
+        attempts: the flip mutates the DagStage's side modes in
+        place, so a retried attempt re-derives identical modes and
+        takes no NEW decision — the stashed token still renders on
+        the rebuilt stage summary (adaptive= must agree with the
+        modes the workers actually ran; the counter moves once)."""
+        persisted = list(getattr(stg, "_aqe_tokens", None) or [])
+        if (
+            stg.exchange != "hash" or stg.join_kind is None
+            or stg.requires_key_partition or len(stg.sides) != 2
+            or self.shuffle_broadcast_rows <= 0
+        ):
+            return persisted
+        from tidb_tpu.planner.fragmenter import choose_edge_modes
+
+        updated = False
+        for s in stg.sides:
+            if not isinstance(s.template, L.StageInput):
+                continue
+            observed = sum(
+                int(f.get("held_rows", 0)) for f in prev_infos
+                if int(f.get("stage", -1)) == int(s.template.stage)
+            )
+            est0 = int(s.est_rows)
+            div = (
+                max(observed, 1) / max(est0, 1)
+                if est0 > 0 else float("inf")
+            )
+            if (
+                observed <= self.shuffle_broadcast_rows
+                or div >= self.aqe_replan_ratio
+                or div <= 1.0 / self.aqe_replan_ratio
+            ):
+                s.est_rows = int(observed)
+                updated = True
+        if not updated:
+            return persisted
+        prev = tuple(s.mode for s in stg.sides)
+        choose_edge_modes(stg, self.shuffle_broadcast_rows)
+        if tuple(s.mode for s in stg.sides) == prev:
+            return persisted
+        inject("aqe/replan")
+        from tidb_tpu.parallel import aqe
+
+        stg._aqe_tokens = persisted + [
+            aqe.note_decision("broadcast-switch")
+        ]
+        return list(stg._aqe_tokens)
 
     def _run_dag(
         self, dag: ShuffleDAG, kill_check=None, deadline=None,
-        snap=None,
+        snap=None, digest=None,
     ) -> Tuple[List[List[tuple]], List[dict], List[dict]]:
         """Run a shuffle DAG to completion: stages execute in topo
         order, each dispatched to every alive host over the
@@ -1752,6 +2277,17 @@ class DCNFragmentScheduler:
                 errs: List[str] = []
                 parts_rows: Optional[List[List[tuple]]] = None
                 for si, stg in enumerate(dag.stages):
+                    # AQE: the feedback marker rides stage 0; between
+                    # stages, observed held rows may flip the next
+                    # edge to broadcast (stage-boundary re-planning)
+                    stage_tokens = (
+                        list(getattr(dag, "_aqe_tokens", None) or [])
+                        if si == 0 else []
+                    )
+                    if si:
+                        stage_tokens += self._stage_replan(
+                            stg, all_infos
+                        )
                     boundaries = None
                     if stg.exchange == "range":
                         boundaries = self._sample_stage(
@@ -1775,6 +2311,7 @@ class DCNFragmentScheduler:
                             if boundaries is not None else None
                         ),
                         "modes": [s.mode for s in stg.sides],
+                        "adaptive": list(stage_tokens),
                         "attempts": attempt, "m": m,
                         "bytes_tunneled": 0, "rows_tunneled": 0,
                         "local_rows": 0, "stalls": 0, "stall_s": 0.0,
@@ -1808,12 +2345,14 @@ class DCNFragmentScheduler:
 
                     def run_part(i, ep, conn, _si=si, _stg=stg,
                                  _bnd=boundaries, _ledger=ledger,
-                                 _infos=infos, _cancelled=cancelled):
+                                 _infos=infos, _cancelled=cancelled,
+                                 _adaptive=tuple(stage_tokens)):
                         token = _ledger.claim(i, ep.address)
                         task = self._stage_task(
                             dag, _si, _stg, i, m, attempt, qid,
                             _bnd, peers, ep.secret, deadline,
                             snap=snap, topsql=ts_entry,
+                            adaptive=_adaptive,
                         )
                         t_d0 = time.time()
                         try:
@@ -1870,6 +2409,7 @@ class DCNFragmentScheduler:
                     if si == n - 1:
                         parts_rows = ledger.rows_by_fragment()
                 if parts_rows is not None:
+                    self._record_feedback(digest, stage_summaries, "dag")
                     lq = {
                         "qid": qid, "fragments": all_infos,
                         "shuffle": self._dag_shuffle_summary(
@@ -1887,14 +2427,7 @@ class DCNFragmentScheduler:
                     return parts_rows, all_infos, stage_summaries
                 if errs:
                     last_err = errs[0]
-                by_addr = {ep.address: ep for ep in self.endpoints}
-                for addr in sorted(set(suspects)):
-                    ep = by_addr.get(addr)
-                    if (
-                        ep is not None and ep.alive
-                        and not ping_endpoint(ep)
-                    ):
-                        self._quarantine(ep)
+                self._verify_suspects(suspects)
         except BaseException:
             # the DAG died mid-chain (kill, fatal engine error): free
             # the workers' held stage outputs now — a best-effort
@@ -1941,6 +2474,17 @@ class DCNFragmentScheduler:
             ):
                 out[k] += float(s.get(k, 0.0))
             out["ttff_s"] = max(out["ttff_s"], s.get("ttff_s", 0.0))
+            # AQE roll-up: the union of taken decisions plus the
+            # worst per-stage skew ratio (statements_summary / slow-
+            # log consumers read this summary shape)
+            for tok in s.get("adaptive") or ():
+                out.setdefault("adaptive", [])
+                if tok not in out["adaptive"]:
+                    out["adaptive"].append(tok)
+            if s.get("skew"):
+                out["skew"] = max(
+                    float(out.get("skew", 0.0)), float(s["skew"])
+                )
         return out
 
     def _concat_merge(self, dag: ShuffleDAG, parts_rows):
@@ -2039,6 +2583,15 @@ class DCNFragmentScheduler:
             "scan_rows": int(sh.get("scan_rows", 0)),
             "held_rows": int(sh.get("held_rows", 0)),
             "produced_rows": int(sh.get("produced_rows", 0)),
+            # AQE accounting: per-side produced rows (feedback
+            # actuals), rows this partition received (skew ratio),
+            # and the salt fan-out when the stage ran salted
+            "side_rows": {
+                str(k): int(v)
+                for k, v in (sh.get("side_rows") or {}).items()
+            },
+            "recv_rows": int(sh.get("recv_rows", 0)),
+            "salted": int(sh.get("salted", 0)),
             "spans": spans,
         }
         with self._lock:

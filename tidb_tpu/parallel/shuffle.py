@@ -969,6 +969,15 @@ class PeerTunnel:
 # -- the dispatched shuffle task --------------------------------------------
 
 
+def _payload_rows(p) -> int:
+    """Row count of one buffered shuffle payload — a columnar
+    HostBlock on the binary path, a plain row list on the JSON
+    fallback (the per-partition received-rows accounting feeding the
+    skew ratio)."""
+    n = getattr(p, "nrows", None)
+    return int(n) if n is not None else len(p)
+
+
 class ShuffleAbort(RuntimeError):
     """Retryable stage failure a worker reports to the coordinator:
     dead peers during push, or producers that never delivered before
@@ -1417,6 +1426,53 @@ class ShuffleWorker:
         )
         return {"samples": samples, "rows": blk.nrows}
 
+    def run_probe(self, spec: dict, cancel_check=None) -> dict:
+        """AQE skew/cardinality probe of one hash stage (parallel/
+        aqe.py): produce (and CACHE, exactly like the range sampling
+        round) every side's input, reply each side's EXACT
+        per-partition row histogram plus its hottest key values — the
+        coordinator sums histograms across producers, detects a
+        partition over ``tidb_tpu_shuffle_skew_ratio`` x mean, and
+        re-dispatches the stage salted (or broadcast-switched, when a
+        side's observed total collapsed). The produce runs ONCE: the
+        stage round's sides read the cached blocks through
+        _side_input_block."""
+        from tidb_tpu.parallel.wire import hot_key_ints, partition_histogram
+        from tidb_tpu.planner import logical as L
+        from tidb_tpu.planner.ir import plan_from_ir
+
+        inject("aqe/probe")
+        m = int(spec["m"])
+        out = []
+        pins: list = []
+        try:
+            for side in spec["sides"]:
+                if cancel_check is not None:
+                    cancel_check()
+                plan = plan_from_ir(side["plan"])
+                plan = self._apply_snap(spec, side, plan, pins)
+                blk = self._side_input_block(
+                    spec, side, plan, cancel_check
+                )
+                if not isinstance(plan, L.StageInput):
+                    self._held_put(
+                        spec.get("coord"), spec.get("qid"),
+                        spec["attempt"], spec.get("stage", 0),
+                        int(side["tag"]), blk,
+                    )
+                out.append({
+                    "tag": int(side["tag"]),
+                    "rows": int(blk.nrows),
+                    "part_rows": partition_histogram(
+                        blk, side["key"], m
+                    ),
+                    "hot": hot_key_ints(blk, side["key"]),
+                })
+        finally:
+            for t, v in pins:
+                t.unpin(v)
+        return {"sides": out}
+
     def run_task(self, spec: dict, tracer=None, cancel_check=None) -> dict:
         """The worker half of one shuffle stage. Pipelined (the
         default, ``pipeline=True`` + binary codec): producer sides are
@@ -1519,12 +1575,23 @@ class ShuffleWorker:
             producer_exec = self._producer_exec
         tunnels: Dict[int, PeerTunnel] = {}
         tlock = racecheck.make_lock("shuffle.tunnels")  # create + stats
+        # adaptive-stage marker (parallel/aqe.py): the coordinator's
+        # taken decisions ride the task spec so a worker-side chaos
+        # fault can target exactly the window between the re-plan
+        # decision and the switched/salted stage's execution
+        if spec.get("adaptive"):
+            inject("aqe/switched-stage")
         stats = {
             "pushed_bytes": 0, "pushed_rows": 0, "local_rows": 0,
             "stalls": 0, "stall_s": 0.0, "retransmits": 0,
             "produced_rows": 0,
             "stage": stage_idx, "n_stages": n_stages,
             "exchange": exchange, "scan_rows": 0, "held_rows": 0,
+            # AQE observability: per-side produced rows (the
+            # cardinality feedback's exact actuals), rows this
+            # partition RECEIVED (the skew ratio's numerator), and
+            # the salt fan-out if this stage ran salted
+            "side_rows": {}, "recv_rows": 0, "salted": 0,
             "per_peer": [], "codec": codec, "encode_s": 0.0,
             "pipeline": pipeline, "wait_idle_s": 0.0, "ttff_s": 0.0,
             # flight-recorder phase breakdown (obs/flight.py): engine
@@ -1572,10 +1639,18 @@ class ShuffleWorker:
                 )
                 from tidb_tpu.planner import logical as _L
 
-                if mode != "hash" or isinstance(plan, _L.StageInput):
+                salt = side.get("salt")
+                if (
+                    salt or mode != "hash"
+                    or side.get("probed")
+                    or isinstance(plan, _L.StageInput)
+                ):
                     # DAG edge over a COMPLETE block: a held stage
                     # output (StageInput), a range side (the sampling
-                    # round already produced and cached it), or a
+                    # round already produced and cached it), a salted
+                    # or merely PROBED side (the skew probe cached the
+                    # produce — a plain-hash outcome must still read
+                    # the cache, not pay produce twice), or a
                     # broadcast/local edge — partitioned/copied whole,
                     # shipped through the columnar frame path
                     t_prod = time.perf_counter()
@@ -1588,16 +1663,29 @@ class ShuffleWorker:
                     stats["produce_s"] += dt_prod
                     emit(f"produce#{tag}", t_wall, dt_prod)
                     stats["produced_rows"] += blk.nrows
+                    stats["side_rows"][str(tag)] = int(blk.nrows)
                     t_push = time.perf_counter()
                     t_wall = time.time()
                     topsql.set_task_phase("shuffle-push")
                     with span(f"{ctx}/push#{tag}"):
-                        self._ship_block_side(
-                            sid, attempt, m, tag, part, blk,
-                            schema_cols, mode, boundaries,
-                            side.get("key"), peers, secret, tunnels,
-                            packet_rows, inflight, stats,
-                        )
+                        if salt:
+                            stats["salted"] = max(
+                                stats["salted"],
+                                int(salt.get("k", 0)),
+                            )
+                            self._ship_salted_side(
+                                sid, attempt, m, tag, part, blk,
+                                schema_cols, salt, side.get("key"),
+                                peers, secret, tunnels, packet_rows,
+                                inflight, stats,
+                            )
+                        else:
+                            self._ship_block_side(
+                                sid, attempt, m, tag, part, blk,
+                                schema_cols, mode, boundaries,
+                                side.get("key"), peers, secret,
+                                tunnels, packet_rows, inflight, stats,
+                            )
                     emit(
                         f"push#{tag}", t_wall,
                         time.perf_counter() - t_push,
@@ -1624,6 +1712,7 @@ class ShuffleWorker:
                         side["key"]
                     )
                     stats["produced_rows"] += len(rows)
+                    stats["side_rows"][str(tag)] = len(rows)
                     parts = partition_rows(rows, key_idx, m)
                     t_push = time.perf_counter()
                     t_wall = time.time()
@@ -1727,6 +1816,7 @@ class ShuffleWorker:
                 emit(f"produce#{tag}", t_wall, dt_prod)
                 block = batch_to_block(batch, types, dicts)
                 stats["produced_rows"] += block.nrows
+                stats["side_rows"][str(tag)] = int(block.nrows)
                 idxs = partition_block(block, side["key"], m)
                 t_push = time.perf_counter()
                 t_wall = time.time()
@@ -1817,6 +1907,9 @@ class ShuffleWorker:
                     stats["wait_idle_s"] += idle
                     _c_wait_idle_seconds().inc(idle)
                     pending.remove(done)
+                    stats["recv_rows"] += sum(
+                        _payload_rows(c) for c in chunks
+                    )
                     node = reads.get(done)
                     if node is not None:
                         t_stage = time.perf_counter()
@@ -1949,6 +2042,10 @@ class ShuffleWorker:
             t_stage = time.perf_counter()
             t_wall = time.time()
             topsql.set_task_phase("shuffle-stage")
+            stats["recv_rows"] += sum(
+                _payload_rows(c)
+                for payloads in by_side.values() for c in payloads
+            )
             staged = {
                 tag: stage_payloads_as_batch(
                     node.schema, by_side.get(tag, []),
@@ -2183,6 +2280,7 @@ class ShuffleWorker:
                 stats["local_rows"] += local_rows
                 stats["encode_s"] += encode_s
                 stats["produced_rows"] += produced
+                stats.setdefault("side_rows", {})[str(side)] = produced
         except Exception as e:
             errs.append(e)
         finally:
@@ -2279,6 +2377,52 @@ class ShuffleWorker:
             ]
         else:
             idxs = partition_block(block, key, m)
+        for dest, idx in enumerate(idxs):
+            self._ship_partition(
+                sid, attempt, m, side, sender, dest,
+                take_block(block, idx), schema_cols, peers, secret,
+                tunnels, packet_rows, inflight, stats,
+            )
+
+    def _ship_salted_side(
+        self, sid, attempt, m, side, sender, block, schema_cols, salt,
+        key, peers, secret, tunnels, packet_rows, inflight, stats,
+    ) -> None:
+        """Ship one COMPLETE columnar side under a salt spec
+        (``{"keys": [key_ints], "k": K, "role": ...}``): the hot
+        partition's keys route across their K-wide salted target set
+        instead of one home partition.
+
+        - role "split" (the skewed side): each hot-key row goes to ONE
+          salted target, round-robin (staggered by sender so m
+          producers don't all start on lane 0) — the hot partition's
+          work spreads K ways, every row still lands exactly once;
+        - role "replicate" (a join's other side): each hot-key row is
+          COPIED to all K targets, so every salted lane can match its
+          share of the split side (the broadcast-of-hot-keys half of
+          skew-salted joins). Unflagged rows keep the plain hash map
+          either way."""
+        from tidb_tpu.chunk import take_block
+        from tidb_tpu.parallel.wire import (
+            salted_partition_assign,
+            salted_split_map,
+        )
+
+        if str(salt.get("role") or "split") == "split":
+            pmap = salted_split_map(block, key, m, salt, lane0=sender)
+            idxs = [np.nonzero(pmap == d)[0] for d in range(m)]
+        else:
+            base, flagged, k = salted_partition_assign(
+                block, key, m, salt
+            )
+            idxs = []
+            for dest in range(m):
+                sel = [np.nonzero((base == dest) & ~flagged)[0]]
+                for j in range(k):
+                    sel.append(np.nonzero(
+                        flagged & ((base + j) % m == dest)
+                    )[0])
+                idxs.append(np.sort(np.concatenate(sel)))
         for dest, idx in enumerate(idxs):
             self._ship_partition(
                 sid, attempt, m, side, sender, dest,

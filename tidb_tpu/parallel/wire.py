@@ -462,6 +462,89 @@ def partition_map(block: HostBlock, key: str, m: int) -> np.ndarray:
     return np.where(np.asarray(col.valid, dtype=bool), parts, 0)
 
 
+def partition_histogram(block: HostBlock, key: str, m: int) -> List[int]:
+    """Exact per-partition row counts of column ``key`` under the
+    host-tier hash — the skew probe's payload (np.bincount over the
+    partition map; vectorized, no per-row Python). NULL keys count on
+    partition 0 like partition_map routes them."""
+    if block.nrows == 0:
+        return [0] * int(m)
+    parts = partition_map(block, key, m)
+    return np.bincount(parts, minlength=int(m)).astype(int).tolist()
+
+
+def hot_key_ints(
+    block: HostBlock, key: str, top: int = 4
+) -> List[List[int]]:
+    """The ``top`` most frequent non-null key values of one produced
+    block as [[key_int, count], ...] (key_int = the host-tier hash
+    image, column_key_ints — codec-independent, so the coordinator
+    can both sum counts across producers and recompute each key's
+    home partition). The salt flag set is built from these."""
+    col = block.columns[key]
+    if block.nrows == 0:
+        return []
+    ints = column_key_ints(col)[np.asarray(col.valid, dtype=bool)]
+    if not len(ints):
+        return []
+    u, counts = np.unique(ints, return_counts=True)
+    order = np.argsort(counts)[::-1][: int(top)]
+    return [[int(u[i]), int(counts[i])] for i in order]
+
+
+def salt_targets(key_int: int, m: int, k: int) -> List[int]:
+    """THE salted destination set of one flagged key: its home hash
+    partition plus the next k-1 partitions (mod m). One definition —
+    the split side's lane assignment and the replicate side's copy
+    fan-out must agree or hot-key join rows lose their match."""
+    from tidb_tpu.parallel.shuffle import mix_hash_np
+
+    base = int(mix_hash_np(np.asarray([key_int], dtype=np.int64))[0]
+               % np.int64(m))
+    return [(base + j) % int(m) for j in range(max(int(k), 1))]
+
+
+def salted_partition_assign(
+    block: HostBlock, key: str, m: int, salt: dict
+):
+    """Per-row routing of one produced side under a salt spec
+    ``{"keys": [key_ints], "k": K}``: returns (base partition map,
+    flagged row mask, K). Flagged rows (non-null, key in the flag
+    set) are the hot-key rows the caller either SPLITS across the
+    salted target set (lane = running index % K) or REPLICATES to all
+    K targets; everything else routes by the plain hash map."""
+    col = block.columns[key]
+    base = partition_map(block, key, m)
+    # clamped to m: a wrap past m would route duplicate copies of one
+    # replicated row to the SAME destination (a join would double its
+    # matches)
+    k = max(min(int(salt.get("k", 1)), int(m)), 1)
+    keys = np.asarray(list(salt.get("keys") or []), dtype=np.int64)
+    if block.nrows == 0 or not len(keys):
+        return base, np.zeros(block.nrows, dtype=bool), k
+    ints = column_key_ints(col)
+    flagged = np.isin(ints, keys) & np.asarray(col.valid, dtype=bool)
+    return base, flagged, k
+
+
+def salted_split_map(
+    block: HostBlock, key: str, m: int, salt: dict, lane0: int = 0
+) -> np.ndarray:
+    """The SPLIT side's destination map: flagged rows round-robin
+    across their key's salted target set (lane offset ``lane0``
+    staggers senders so m producers don't all start on lane 0);
+    unflagged rows keep the hash map. Any lane assignment is correct
+    — every salted target holds the replicate side's hot-key copies —
+    so the round-robin is purely for balance."""
+    base, flagged, k = salted_partition_assign(block, key, m, salt)
+    if not flagged.any() or k <= 1:
+        return base
+    lanes = (np.arange(int(flagged.sum())) + int(lane0)) % k
+    out = base.copy()
+    out[flagged] = (base[flagged] + lanes) % int(m)
+    return out
+
+
 def range_key_values(col: HostColumn) -> np.ndarray:
     """Order-comparable image of a range-partition key column: a numpy
     array whose ``<`` order IS the sort order of the logical values.

@@ -353,6 +353,104 @@ def est_rows(plan, catalog, smap: Optional[StatsMap] = None) -> float:
     return walk(plan)
 
 
+# ---------------------------------------------------------------------------
+# history-seeded cardinality feedback (AQE, PR 15)
+# ---------------------------------------------------------------------------
+
+
+class CardinalityFeedback:
+    """Per-digest OBSERVED cardinalities fed back into planning — the
+    learned half of the cost model (the PR 8 admission-mem-estimate
+    pattern applied to row counts). The DCN scheduler records each
+    routed statement's per-side produced rows (exact, from the fenced
+    worker stage stats) plus the root est/act pair; the next run of
+    the same digest, with ``tidb_tpu_aqe_feedback=on``, seeds
+    ``ShuffleSide.est_rows`` from the recorded actuals so
+    ``shuffle_mode=auto`` gates and ``choose_edge_modes`` start from
+    measured rather than static stats (parallel/dcn.py _choose_cut).
+
+    ``warm_from_history`` re-seeds the store from
+    information_schema.statements_summary_history rows after a
+    restart of the live summary — the trajectories the StmtHistory
+    fold-in keeps for exactly the digests the live map churned out.
+    Bounded: oldest digest evicted past ``capacity``."""
+
+    def __init__(self, capacity: int = 512):
+        from tidb_tpu.utils import racecheck
+
+        self._lock = racecheck.make_lock("planner.card_feedback")
+        self._capacity = int(capacity)
+        # digest -> {"sides": {tag: rows}, "est": float, "act": float,
+        #            "n": int}
+        self._map: Dict[str, dict] = {}
+
+    def record(
+        self, digest: str, est: float = 0.0, act: float = 0.0,
+        sides: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """``sides`` keys are ``"<kind>:<stage>:<tag>"`` — per-side
+        produced rows from the fenced stage stats, scoped by the cut
+        kind that executed (dcn._record_feedback)."""
+        if not digest:
+            return
+        with self._lock:
+            ent = self._map.pop(digest, None)
+            if ent is None:
+                ent = {"sides": {}, "est": 0.0, "act": 0.0, "n": 0}
+            if sides:
+                for tag, rows in sides.items():
+                    ent["sides"][str(tag)] = int(rows)
+            if est or act:
+                ent["est"] = float(est)
+                ent["act"] = float(act)
+            ent["n"] += 1
+            self._map[digest] = ent  # re-insert: LRU-ish recency
+            while len(self._map) > self._capacity:
+                self._map.pop(next(iter(self._map)))
+
+    def sides_for(self, digest: str) -> Optional[Dict[int, int]]:
+        """Observed per-side produced rows of this digest's last run,
+        or None when nothing was recorded."""
+        with self._lock:
+            ent = self._map.get(digest)
+            return dict(ent["sides"]) if ent and ent["sides"] else None
+
+    def est_act(self, digest: str) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            ent = self._map.get(digest)
+            if ent is None or not (ent["est"] or ent["act"]):
+                return None
+            return ent["est"], ent["act"]
+
+    def warm_from_history(self, history=None) -> int:
+        """Seed root est/act pairs from statements_summary_history
+        rows (per-side detail does not survive the summary fold, so
+        warmed digests seed the divergence only). Returns the number
+        of digests seeded."""
+        if history is None:
+            from tidb_tpu.utils.metrics import STMT_HISTORY
+
+            history = STMT_HISTORY
+        n = 0
+        for _b, _e, row in history.rows():
+            est = float(row.get("est_rows", 0.0) or 0.0)
+            act = float(row.get("act_rows", 0.0) or 0.0)
+            digest = row.get("digest_text", "")
+            if digest and (est or act):
+                self.record(digest, est=est, act=act)
+                n += 1
+        return n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+#: process-wide feedback store (one cost model per coordinator, like
+#: the shared plan cache); tests construct private instances
+CARD_FEEDBACK = CardinalityFeedback()
+
+
 def est_join(nl: float, nr: float, equi_keys, kind: str, smap: StatsMap) -> float:
     if kind == "cross" or not equi_keys:
         return nl * nr

@@ -374,6 +374,10 @@ class ShufflePlan:
     partial_schema: Schema
     #: staged-source plan node -> full coordinator plan
     final_builder: Callable[[L.LogicalPlan], L.LogicalPlan]
+    #: join kind when kind == "join" (the broadcast-edge legality
+    #: input for the adaptive switch — parallel/aqe.py); None for
+    #: group-by cuts, which REQUIRE key-colocated partitions
+    join_kind: Optional[str] = None
 
 
 #: join kinds whose semantics survive hash partitioning on the first
@@ -508,7 +512,8 @@ def split_plan_shuffle(
                     return _replace_node(_plan, _agg, merged)
 
                 return ShufflePlan(
-                    "join", sides, consumer, partial_schema, final_builder
+                    "join", sides, consumer, partial_schema,
+                    final_builder, join_kind=jp.kind,
                 )
 
             def final_builder(source, _peeled=tuple(peeled)):
@@ -519,7 +524,8 @@ def split_plan_shuffle(
 
             consumer = _wrap_path(path, jp2)
             return ShufflePlan(
-                "join", sides, consumer, below.schema, final_builder
+                "join", sides, consumer, below.schema, final_builder,
+                join_kind=jp.kind,
             )
 
     # ---- shape 2: fragment-sliced GROUP BY ----
@@ -620,6 +626,36 @@ class ShuffleDAG:
     merge: dict
 
 
+def _decide_join_modes(
+    sides: List[ShuffleSide], join_kind: str, broadcast_max_rows: int,
+    ratio: float,
+) -> str:
+    """THE broadcast-vs-repartition decision core, shared by the DAG
+    edge chooser and the single-stage adaptive switch. Mutates
+    side.mode in place (including RESETTING a previously-broadcast
+    pair back to hash — re-planning with observed counts must be able
+    to flip either way). Returns "hash" or "broadcast"."""
+    a, b = sides
+    # reset first: a re-run with new estimates starts from the
+    # repartition shape, not whatever the last run chose
+    a.mode = b.mode = "hash"
+    if broadcast_max_rows <= 0:
+        return "hash"
+    small, big = (a, b) if a.est_rows <= b.est_rows else (b, a)
+    if small.est_rows <= 0 or big.est_rows <= 0:
+        return "hash"
+    if (
+        small.est_rows > broadcast_max_rows
+        or big.est_rows < ratio * small.est_rows
+    ):
+        return "hash"
+    if join_kind != "inner" and small.tag != 1:
+        return "hash"  # left/semi/anti preserve the LEFT side
+    small.mode = "broadcast"
+    big.mode = "local"
+    return "broadcast"
+
+
 def choose_edge_modes(
     stage: DagStage, broadcast_max_rows: int, ratio: float = 4.0
 ) -> str:
@@ -632,30 +668,93 @@ def choose_edge_modes(
     when (a) the consumer does not require key-colocated partitions
     (a re-keyed next stage restores any grouping) and (b) for
     non-inner joins, the small side is the non-preserved RIGHT side.
-    Mutates side.mode in place; returns the chosen shape ("hash" or
-    "broadcast") for telemetry."""
+    Mutates side.mode in place (idempotent under re-planning: a
+    re-run with OBSERVED est_rows — AQE stage-boundary re-planning —
+    may flip a previous choice either way); returns the chosen shape
+    ("hash" or "broadcast") for telemetry."""
     if (
         stage.exchange != "hash"
         or stage.join_kind is None
         or stage.requires_key_partition
         or len(stage.sides) != 2
-        or broadcast_max_rows <= 0
     ):
         return "hash"
-    a, b = stage.sides
-    small, big = (a, b) if a.est_rows <= b.est_rows else (b, a)
-    if small.est_rows <= 0 or big.est_rows <= 0:
-        return "hash"
+    return _decide_join_modes(
+        stage.sides, stage.join_kind, broadcast_max_rows, ratio
+    )
+
+
+def choose_shuffle_modes(
+    sp: ShufflePlan, broadcast_max_rows: int, ratio: float = 4.0
+) -> str:
+    """The single-stage twin of choose_edge_modes: a repartition-join
+    ShufflePlan whose small side fits under ``broadcast_max_rows``
+    switches to broadcast small + local big (the adaptive
+    broadcast-switch seam — a probe's observed produce counts, or a
+    feedback-seeded estimate, lands here as updated est_rows).
+    Group-by cuts require key-colocated partitions and never
+    switch."""
     if (
-        small.est_rows > broadcast_max_rows
-        or big.est_rows < ratio * small.est_rows
+        sp.kind != "join"
+        or sp.join_kind is None
+        or len(sp.sides) != 2
     ):
         return "hash"
-    if stage.join_kind != "inner" and small.tag != 1:
-        return "hash"  # left/semi/anti preserve the LEFT side
-    small.mode = "broadcast"
-    big.mode = "local"
-    return "broadcast"
+    return _decide_join_modes(
+        sp.sides, sp.join_kind, broadcast_max_rows, ratio
+    )
+
+
+def split_plan_shuffle_salted(
+    plan: L.LogicalPlan, catalog=None
+) -> Optional[ShufflePlan]:
+    """The SALTED variant of the fragment-sliced GROUP BY cut: rows
+    still shuffle by the first group key, but a salted hot key's
+    group is SPLIT across K partitions — so the consumer must produce
+    PARTIAL aggregates (the split_plan decomposition) and the
+    coordinator's final stage re-merges the salted partials through
+    the plain final-aggregate path. Returns None when the aggregate
+    does not decompose (DISTINCT et al: a split group cannot merge)
+    or the group key is not a bare column of the aggregate's input —
+    the skew probe then skips salting rather than risking a wrong
+    re-merge."""
+    agg = _find_cut(plan)
+    if agg is None or not agg.group_exprs or agg.gc_meta:
+        return None
+    dec = _decompose_aggs(agg)
+    if dec is None:
+        return None
+    first = agg.group_exprs[0][1]
+    if not isinstance(first, ColumnRef):
+        return None
+    key = first.name
+    if key not in {c.internal for c in agg.child.schema.cols}:
+        return None
+    frag_scan = _pick_frag_scan(agg.child, catalog)
+    if frag_scan is None:
+        return None
+    partial_aggs, pcols, final, avg_fix = dec
+    group_cols = [
+        OutCol(None, n, n, e.type) for n, e in agg.group_exprs
+    ]
+    partial_schema = Schema(group_cols + pcols)
+    consumer = L.Aggregate(
+        partial_schema,
+        L.ShuffleRead(agg.child.schema, tag=0),
+        list(agg.group_exprs), partial_aggs,
+    )
+    side = ShuffleSide(
+        agg.child, frag_scan, key, 0, _est_rows(frag_scan, catalog)
+    )
+
+    def final_builder(source, _plan=plan, _agg=agg, _final=final,
+                      _fix=avg_fix):
+        merged = _final_agg_plan(_agg, source, _final, _fix)
+        return _replace_node(_plan, _agg, merged)
+
+    return ShufflePlan(
+        "groupby", [side], consumer, partial_schema, final_builder
+    )
 
 
 def _parse_peeled(peeled):
@@ -784,6 +883,81 @@ def _window_stage(lower: L.LogicalPlan, catalog) -> Optional[DagStage]:
     return DagStage(
         "hash", [side], consumer, requires_key_partition=True,
     )
+
+
+def _join_chain_stages(
+    lower: L.LogicalPlan, catalog
+) -> Optional[List[DagStage]]:
+    """Left-deep join chain cut: when ``lower``'s topmost join's LEFT
+    input is itself a qualifying shuffle join, stage 0 runs the nested
+    join as an ordinary two-sided hash exchange (both scans
+    fragment-sliced) and HOLDS its per-partition output; stage 1
+    re-exchanges the held rows by the OUTER join key against the
+    fragment-sliced outer right side. Keys must pass as bare columns
+    (the held rows re-hash without compute) and both joins must be
+    hash-partitionable kinds. Returns the two stages, or None."""
+    path, jp = _find_shuffle_join(lower)
+    if (
+        jp is None or jp.kind not in _SHUFFLE_JOIN_KINDS
+        or jp.null_aware or not jp.equi_keys
+    ):
+        return None
+    ipath, ijp = _find_shuffle_join(jp.left)
+    if (
+        ijp is None or ijp.kind not in _SHUFFLE_JOIN_KINDS
+        or ijp.null_aware or not ijp.equi_keys
+    ):
+        return None
+    ile, ire = ijp.equi_keys[0]
+    ilk = _shuffle_key_of(ile, ijp.left.schema)
+    irk = _shuffle_key_of(ire, ijp.right.schema)
+    ilscan = _pick_frag_scan(ijp.left, catalog)
+    irscan = _pick_frag_scan(ijp.right, catalog)
+    le, re_ = jp.equi_keys[0]
+    mid_schema = jp.left.schema
+    lkey = _shuffle_key_of(le, mid_schema)
+    rkey = _shuffle_key_of(re_, jp.right.schema)
+    rscan = _pick_frag_scan(jp.right, catalog)
+    if None in (ilk, irk, ilscan, irscan, lkey, rkey, rscan):
+        return None
+    sides0 = [
+        ShuffleSide(ijp.left, ilscan, ilk, 0,
+                    _est_rows(ilscan, catalog)),
+        ShuffleSide(ijp.right, irscan, irk, 1,
+                    _est_rows(irscan, catalog)),
+    ]
+    ijp2 = dataclasses.replace(
+        ijp,
+        left=L.ShuffleRead(ijp.left.schema, tag=0),
+        right=L.ShuffleRead(ijp.right.schema, tag=1),
+    )
+    mid = _wrap_path(ipath, ijp2)
+    st0 = DagStage("hash", sides0, mid, join_kind=ijp.kind)
+    # held-output estimate: the planner's join estimate — the static
+    # baseline AQE's stage-boundary re-plan compares observed held
+    # rows against before flipping the downstream edge
+    try:
+        from tidb_tpu.planner.cardinality import est_rows as _card_est
+
+        held_est = int(_card_est(jp.left, catalog))
+    except Exception:
+        held_est = 0
+    side_held = ShuffleSide(
+        L.StageInput(mid_schema, stage=0), None, lkey, 0, held_est
+    )
+    side_right = ShuffleSide(
+        jp.right, rscan, rkey, 1, _est_rows(rscan, catalog)
+    )
+    jp2 = dataclasses.replace(
+        jp,
+        left=L.ShuffleRead(mid_schema, tag=0),
+        right=L.ShuffleRead(jp.right.schema, tag=1),
+    )
+    consumer = _wrap_path(path, jp2)
+    st1 = DagStage(
+        "hash", [side_held, side_right], consumer, join_kind=jp.kind
+    )
+    return [st0, st1]
 
 
 def split_plan_dag(
@@ -920,8 +1094,36 @@ def split_plan_dag(
             stages.append(ws)
             window_stage = True
 
+    # ---- left-deep join chain (no aggregate): stage 0 exchanges the
+    # nested join by its own key and HOLDS its output, stage 1
+    # re-exchanges the held rows by the outer key against the
+    # fragment-sliced outer side — the single-cut shape re-scans the
+    # whole un-sliced nested side per host; the chain slices every
+    # base scan exactly once. Stage 1 is a plain two-sided hash join
+    # over an attempt-fenced StageInput, which is the seam AQE's
+    # stage-boundary re-planning flips to broadcast when stage 0's
+    # observed held rows collapse (parallel/dcn.py _run_dag). ----
+    chain_stage = False
+    chain_dec = None
+    if not stages:
+        chain_src = None
+        if agg is None:
+            chain_src = lower
+        elif not agg.group_exprs and not agg.gc_meta:
+            # a global (no-group-key) DECOMPOSABLE aggregate rides the
+            # chain as a partial agg fused into the last stage; the
+            # coordinator merges through the ordinary final-agg path
+            chain_dec = _decompose_aggs(agg)
+            if chain_dec is not None:
+                chain_src = agg.child
+        if chain_src is not None:
+            chain = _join_chain_stages(chain_src, catalog)
+            if chain is not None:
+                stages.extend(chain)
+                chain_stage = True
+
     # ---- range ORDER BY stage on top ----
-    if rspec is not None:
+    if rspec is not None and not chain_stage:
         above, limit, sort = rspec
         if stages:
             # re-wrap the last stage's consumer so its held output
@@ -980,6 +1182,43 @@ def split_plan_dag(
     if window_stage:
         def final_builder(source, _plan=plan, _lower=lower):
             return _replace_node(_plan, _lower, source)
+
+        return ShuffleDAG(
+            stages, lower.schema, final_builder, {"kind": "plan"},
+        )
+    if chain_stage:
+        if chain_dec is not None:
+            # fuse the partial half of the global aggregate into the
+            # LAST chain stage's consumer; the coordinator's final
+            # stage re-merges (split_plan's decomposition — also what
+            # makes the chain safe under broadcast-switch: partials
+            # re-aggregate regardless of which partition they ran on)
+            partial_aggs, pcols, final, avg_fix = chain_dec
+            last = stages[-1]
+            partial_schema = Schema(list(pcols))
+            consumer = L.Aggregate(
+                partial_schema, last.consumer, [], partial_aggs
+            )
+            stages[-1] = dataclasses.replace(last, consumer=consumer)
+
+            def final_builder(source, _plan=plan, _agg=agg,
+                              _final=final, _fix=avg_fix):
+                merged = _final_agg_plan(_agg, source, _final, _fix)
+                return _replace_node(_plan, _agg, merged)
+
+            return ShuffleDAG(
+                stages, partial_schema, final_builder,
+                {"kind": "plan"},
+            )
+
+        # coordinator re-runs the peeled root operators (ORDER BY /
+        # LIMIT and row-wise nodes) over the unioned stage-1 rows —
+        # the no-agg ShufflePlan discipline
+        def final_builder(source, _peeled=tuple(peeled)):
+            out = source
+            for node in reversed(_peeled):
+                out = dataclasses.replace(node, child=out)
+            return out
 
         return ShuffleDAG(
             stages, lower.schema, final_builder, {"kind": "plan"},

@@ -2969,6 +2969,16 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
             f"wait={float(stage.get('wait_s', 0.0))*1000:.2f}ms "
             f"stage_s={float(stage.get('stage_s', 0.0))*1000:.2f}ms "
         )
+    # AQE (parallel/aqe.py): every taken adaptive decision renders on
+    # the exchange row (adaptive=salted:3|broadcast-switch|feedback),
+    # and the per-partition received-row skew ratio renders whenever
+    # partition counts exist — detection stays auditable even when
+    # nothing triggered
+    aqe_bits = ""
+    if stage.get("skew"):
+        aqe_bits += f" skew={float(stage['skew']):.2f}"
+    if stage.get("adaptive"):
+        aqe_bits += f" adaptive={'|'.join(stage['adaptive'])}"
     summary = (
         f"DCNShuffle kind={stage.get('kind')} "
         + dag_bits
@@ -2985,6 +2995,7 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
         f"overlap={overlap*100:.0f}% "
         f"wait_idle={idle*1000:.2f}ms "
         f"ttff={float(stage.get('ttff_s', 0.0))*1000:.2f}ms"
+        + aqe_bits
     )
     summary += _compile_cost_suffix(frags)
     per_part = [

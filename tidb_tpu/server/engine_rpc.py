@@ -334,6 +334,8 @@ class EngineServer:
                             resp = outer._shuffle_task(req)
                         elif "shuffle_sample" in req:
                             resp = outer._shuffle_sample(req)
+                        elif "shuffle_probe" in req:
+                            resp = outer._shuffle_probe(req)
                         elif "cancel_query" in req:
                             resp = outer._cancel_query(req)
                         elif "delta_compact" in req:
@@ -936,6 +938,58 @@ class EngineServer:
             {
                 "id": req.get("id"), "ok": True,
                 "samples": result["samples"], "rows": result["rows"],
+            }
+        ).encode()
+
+    def _shuffle_probe(self, req) -> bytes:
+        """AQE skew/cardinality probe round (ShuffleWorker.run_probe,
+        parallel/aqe.py): produce-and-cache every side of a hash
+        stage, reply each side's exact per-partition row histogram +
+        hottest keys. Taxonomy mirrors _shuffle_sample: a lost reply
+        (aqe/probe-lost) is a transport suspect the coordinator
+        verifies; retryable failures carry the suspect list."""
+        from tidb_tpu.parallel.shuffle import ShuffleAbort
+        from tidb_tpu.utils import sqlkiller as _sk
+        from tidb_tpu.utils.failpoint import inject
+
+        if req.get("v") != IR_VERSION:
+            raise ValueError(f"unsupported IR version {req.get('v')}")
+        spec = req["shuffle_probe"]
+        check = make_cancel_check(
+            self.cancels, spec.get("qid"), spec.get("deadline_s"),
+            coord=spec.get("coord"),
+        )
+        _sk.set_current(_CheckKiller(check))
+        from tidb_tpu.obs import profiler as _topsql
+
+        ts_cfg = spec.get("topsql")
+        self._apply_topsql(ts_cfg)
+        ts_prev = _topsql.begin_task(
+            "sample",
+            digest=(ts_cfg or {}).get("digest"),
+            phase="shuffle-produce",
+        )
+        try:
+            result = self.shuffle_worker().run_probe(
+                spec, cancel_check=check
+            )
+        except ShuffleAbort as e:
+            return json.dumps(
+                {
+                    "id": req.get("id"), "ok": False,
+                    "retryable": "shuffle", "suspects": e.suspects,
+                    "error": str(e),
+                }
+            ).encode()
+        finally:
+            _topsql.end_task(ts_prev)
+            _sk.set_current(None)
+        if inject("aqe/probe-lost"):
+            raise DropConnection()
+        return json.dumps(
+            {
+                "id": req.get("id"), "ok": True,
+                "sides": result["sides"],
             }
         ).encode()
 

@@ -2950,7 +2950,8 @@ class Session(DDLMixin):
                             self.vars.get("tidb_tpu_admission_starvation_s")
                         )
                 if s.name.lower().startswith(
-                    ("tidb_tpu_shuffle_", "tidb_tpu_heartbeat_")
+                    ("tidb_tpu_shuffle_", "tidb_tpu_heartbeat_",
+                     "tidb_tpu_aqe_")
                 ) and s.scope == "global":
                     # live re-tune of an attached scheduler's shuffle
                     # wait timeout and heartbeat liveness knobs (the
@@ -2967,11 +2968,41 @@ class Session(DDLMixin):
 
                         gv = SysVars(self.catalog.global_sysvars)
                         name = s.name.lower()
-                        if name.startswith("tidb_tpu_shuffle_"):
+                        if name.startswith("tidb_tpu_aqe_"):
+                            # live re-tune of the AQE knobs (the
+                            # shuffle-timeout pattern): feedback
+                            # seeding and the replan divergence bar
+                            was_fb = sched.aqe_feedback
+                            sched.aqe_feedback = bool(
+                                gv.get("tidb_tpu_aqe_feedback")
+                            )
+                            sched.aqe_replan_ratio = float(
+                                gv.get("tidb_tpu_aqe_replan_ratio")
+                            )
+                            if sched.aqe_feedback and not was_fb:
+                                # feedback just turned ON: re-seed the
+                                # store's est/act pairs from the
+                                # statements_summary_history windows
+                                # (digests the live summary churned
+                                # out keep their divergence signal)
+                                from tidb_tpu.planner.cardinality import (
+                                    CARD_FEEDBACK,
+                                )
+
+                                CARD_FEEDBACK.warm_from_history()
+                        elif name.startswith("tidb_tpu_shuffle_"):
                             sched.shuffle_wait_timeout_s = float(
                                 gv.get(
                                     "tidb_tpu_shuffle_wait_timeout_s"
                                 )
+                            )
+                            # skew knobs ride the same family: a SET
+                            # arms/retunes the probe live
+                            sched.shuffle_skew_ratio = float(
+                                gv.get("tidb_tpu_shuffle_skew_ratio")
+                            )
+                            sched.shuffle_skew_salt_k = int(
+                                gv.get("tidb_tpu_shuffle_skew_salt_k")
                             )
                         else:
                             sched.heartbeat.retune(
@@ -4063,7 +4094,13 @@ class Session(DDLMixin):
             FLIGHT.set_live_phase("execute")
             FLIGHT.note_phase("plan", time.perf_counter() - t_plan)
             self._last_plan = plan  # prepared-statement plan capture
-            routed = self._try_dcn_select(plan)
+            # _source_sql is set only for single-statement texts: a
+            # batch's statements would otherwise share one fallback
+            # digest and cross-contaminate the cardinality feedback
+            # store (no digest = no feedback, routing unaffected)
+            routed = self._try_dcn_select(
+                plan, sql=getattr(s, "_source_sql", None)
+            )
             if routed is not None:
                 return routed
             # the execute wall contains any jit traces watched_jit
@@ -4137,7 +4174,7 @@ class Session(DDLMixin):
          "metrics_schema"}
     )
 
-    def _try_dcn_select(self, plan):
+    def _try_dcn_select(self, plan, sql=None):
         """Route a SELECT through the attached DCN fragment scheduler
         (PR 6: attached schedulers execute fragmentable/shuffleable
         statements across the worker fleet, not just EXPLAIN ANALYZE).
@@ -4146,7 +4183,10 @@ class Session(DDLMixin):
         system-schema scans, and plans the fragmenter declares
         single-host (whole-plan dispatch to a worker would read the
         WORKER's catalog state for shapes the local engine serves
-        fine)."""
+        fine). ``sql`` is the raw statement text; its AQE-feedback
+        digest is computed only after the cheap bail-outs — an
+        unattached (single-node) deployment must not pay a tokenizer
+        pass per SELECT for a route that can never happen."""
         sched = getattr(self, "dcn_scheduler", None)
         self._last_dcn_routed = False
         if sched is None:
@@ -4175,9 +4215,11 @@ class Session(DDLMixin):
         ):
             return None
         from tidb_tpu.planner.fragmenter import Unschedulable
+        from tidb_tpu.utils.metrics import sql_digest as _sqld
 
+        digest = _sqld(sql) if sql else None
         try:
-            kind, cut = sched._choose_cut(plan)
+            kind, cut = sched._choose_cut(plan, digest=digest)
         except Unschedulable:
             return None
         if kind == "single":
@@ -4247,7 +4289,7 @@ class Session(DDLMixin):
                     plan, cut_hint=(kind, cut),
                     kill_check=self.killer.check,
                     deadline=self.killer.deadline or None,
-                    delta_seq=delta_seq,
+                    delta_seq=delta_seq, digest=digest,
                 )
                 dispatched = True
             except (QueryKilled, QuotaExceeded):
@@ -4356,6 +4398,32 @@ class Session(DDLMixin):
                 )
             except Exception:
                 pass  # billing must never fail the statement
+        # AQE cardinality accuracy (PR 15): planner estimate vs the
+        # observed output rows — statements_summary exposes the
+        # per-digest divergence, the misestimate counter feeds the
+        # cardinality-drift inspection rule, and the feedback store
+        # records the pair for history-seeded planning
+        try:
+            est = plan.__dict__.get("est")
+            if est is None:
+                from tidb_tpu.planner.cardinality import est_rows
+
+                est = est_rows(plan, self.catalog)
+            _FLIGHT.note_cardinality(float(est), float(len(rows)))
+            r = max(len(rows), 1.0) / max(float(est), 1.0)
+            div = max(r, 1.0 / r)
+            if div >= float(getattr(sched, "aqe_replan_ratio", 4.0)):
+                from tidb_tpu.parallel.aqe import _c_misestimates
+
+                _c_misestimates().inc()
+            if digest:
+                from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+
+                CARD_FEEDBACK.record(
+                    digest, est=float(est), act=float(len(rows))
+                )
+        except Exception:
+            pass  # accounting must never fail the statement
         schema_cols = list(plan.schema)
         types = (
             [c.type for c in schema_cols]
@@ -6170,8 +6238,16 @@ class Session(DDLMixin):
                 from tidb_tpu.planner.fragmenter import Unschedulable
 
                 try:
+                    from tidb_tpu.utils.metrics import sql_digest
+
                     _cols, _rows, lines = sched.explain_analyze(
-                        plan, delta_seq=self._delta_read_seq(sched)
+                        plan, delta_seq=self._delta_read_seq(sched),
+                        # the INNER statement's digest: feedback-seeded
+                        # planning applies to EXPLAIN ANALYZE too, so
+                        # the adaptive= marker is inspectable
+                        digest=sql_digest(
+                            getattr(s.stmt, "_source_sql", None) or ""
+                        ),
                     )
                     lines = lines + _compile_cost_lines()
                     # the instrumented lines ARE the plan capture: an

@@ -638,6 +638,12 @@ class Catalog:
                    ("compile_flops", FLOAT64),
                    ("compile_bytes_accessed", FLOAT64),
                    ("compile_output_bytes", FLOAT64),
+                   # PR 15 (AQE): mean estimated vs observed output
+                   # rows of routed executions + the symmetric
+                   # divergence ratio (>= 1.0; 1.0 = perfect) — the
+                   # feedback loop's own accuracy, queryable
+                   ("est_rows", FLOAT64), ("act_rows", FLOAT64),
+                   ("card_divergence", FLOAT64),
                    ("sample_text", STRING)]
             )
             rows = []
@@ -667,6 +673,9 @@ class Catalog:
                        e.get("compile_flops", 0.0),
                        e.get("compile_bytes_accessed", 0.0),
                        e.get("compile_output_bytes", 0.0),
+                       e.get("est_rows", 0.0),
+                       e.get("act_rows", 0.0),
+                       e.get("card_divergence", 0.0),
                        e["sample_text"])
                 )
         elif name == "statements_summary_history":
@@ -689,6 +698,8 @@ class Catalog:
                  ("p99_latency", FLOAT64), ("plan_digest", STRING),
                  ("rows_sent", INT64),
                  ("device_mem_peak_bytes", INT64),
+                 ("est_rows", FLOAT64), ("act_rows", FLOAT64),
+                 ("card_divergence", FLOAT64),
                  ("sample_text", STRING)]
             )
             rows = [
@@ -696,6 +707,8 @@ class Catalog:
                  r["sum_latency"], r["max_latency"], r["p50_latency"],
                  r["p95_latency"], r["p99_latency"], r["plan_digest"],
                  r["rows_sent"], r["device_mem_peak_bytes"],
+                 r.get("est_rows", 0.0), r.get("act_rows", 0.0),
+                 r.get("card_divergence", 0.0),
                  r["sample_text"])
                 for b, e, r in STMT_HISTORY.rows()
             ]

@@ -24,6 +24,10 @@ _active: Dict[str, object] = {}
 #: longer silently arm nothing (the reference generates its site list
 #: from the failpoint.Inject rewrite step; we lint instead).
 SITES = frozenset({
+    "aqe/probe",
+    "aqe/probe-lost",
+    "aqe/replan",
+    "aqe/switched-stage",
     "br/statement",
     "catalog/create-table",
     "catalog/drop-table",

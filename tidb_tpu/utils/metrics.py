@@ -563,6 +563,7 @@ class _StmtEntry:
         "jit_compilations", "retraces", "h2d_bytes", "d2h_bytes",
         "device_mem_peak_bytes", "compile_flops",
         "compile_bytes_accessed", "compile_output_bytes",
+        "card_n", "card_est_sum", "card_act_sum", "card_div_sum",
     )
 
     def __init__(self, sample: str):
@@ -588,6 +589,13 @@ class _StmtEntry:
         self.compile_flops = 0.0
         self.compile_bytes_accessed = 0.0
         self.compile_output_bytes = 0.0
+        # AQE cardinality accuracy (PR 15): planner-estimated vs
+        # observed output rows of routed statements — the feedback
+        # loop's own accuracy, queryable per digest
+        self.card_n = 0
+        self.card_est_sum = 0.0
+        self.card_act_sum = 0.0
+        self.card_div_sum = 0.0
 
     def absorb_flight(self, flight) -> None:
         """Fold one finished QueryFlight (obs/flight.py) in."""
@@ -619,6 +627,16 @@ class _StmtEntry:
         self.compile_output_bytes += float(
             getattr(flight, "compile_output_bytes", 0.0)
         )
+        est = float(getattr(flight, "est_rows", 0.0) or 0.0)
+        act = float(getattr(flight, "act_rows", 0.0) or 0.0)
+        if est > 0 or act > 0:
+            self.card_n += 1
+            self.card_est_sum += est
+            self.card_act_sum += act
+            # symmetric divergence >= 1.0 (1.0 = perfect estimate):
+            # over- and under-estimates both count
+            r = max(act, 1.0) / max(est, 1.0)
+            self.card_div_sum += max(r, 1.0 / r)
 
 
 def _entry_dict(digest: str, e: "_StmtEntry") -> dict:
@@ -648,6 +666,18 @@ def _entry_dict(digest: str, e: "_StmtEntry") -> dict:
         "compile_flops": e.compile_flops,
         "compile_bytes_accessed": e.compile_bytes_accessed,
         "compile_output_bytes": e.compile_output_bytes,
+        # AQE cardinality accuracy: mean estimated vs observed output
+        # rows and the mean symmetric divergence ratio (>= 1.0; 1.0 =
+        # perfect) over this digest's routed executions
+        "est_rows": (
+            e.card_est_sum / e.card_n if e.card_n else 0.0
+        ),
+        "act_rows": (
+            e.card_act_sum / e.card_n if e.card_n else 0.0
+        ),
+        "card_divergence": (
+            e.card_div_sum / e.card_n if e.card_n else 0.0
+        ),
         "sample_text": e.sample,
     }
 

@@ -69,6 +69,9 @@ LOCK_CLASSES: Dict[str, str] = {
                          "barrier state",
     "storage.txn_wait": "pessimistic lock-manager wait state (condition)",
     "storage.txn_id": "global txn id allocator",
+    # planner tier
+    "planner.card_feedback": "per-digest observed-cardinality feedback "
+                             "store (AQE history-seeded cost model)",
     # dxf / sessions
     "dxf.manager": "DXF task/subtask tables",
     "session.user_locks": "GET_LOCK advisory-lock registry (condition)",

@@ -125,6 +125,33 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   _int_range(1, 100),
                   "consecutive missed heartbeats that quarantine a "
                   "worker host into the prober"),
+        # Adaptive query execution (PR 15, parallel/aqe.py): runtime
+        # stats re-shape the plan mid-query. GLOBAL-only like the
+        # other scheduler knobs — one shared scheduler serves every
+        # attached session.
+        SysVarDef("tidb_tpu_shuffle_skew_ratio", 0.0, "global",
+                  _float_range(0.0, 1e6),
+                  "hash-exchange skew bar: when a probe's summed "
+                  "per-partition row counts show max > ratio x mean, "
+                  "the hot partition's keys are salted across "
+                  "tidb_tpu_shuffle_skew_salt_k hosts (0 disables "
+                  "detection + salting; > 1 arms it)"),
+        SysVarDef("tidb_tpu_shuffle_skew_salt_k", 4, "global",
+                  _int_range(2, 64),
+                  "hosts a skewed hash partition's hot keys salt "
+                  "across (capped at the alive host count)"),
+        SysVarDef("tidb_tpu_aqe_feedback", False, "global", _bool,
+                  "seed per-digest shuffle-side row estimates from "
+                  "observed actuals (statements_summary_history "
+                  "feedback) so shuffle_mode=auto and edge-mode "
+                  "choices start from measured rather than static "
+                  "stats"),
+        SysVarDef("tidb_tpu_aqe_replan_ratio", 4.0, "global",
+                  _float_range(1.0, 1e6),
+                  "observed-vs-estimated row divergence factor that "
+                  "triggers stage-boundary re-planning (re-running "
+                  "choose_edge_modes with observed counts between "
+                  "shuffle DAG stages)"),
         # HTAP delta tier (storage/delta.py): coordinator DML deltas
         # replicate to the fleet; routed reads merge a (fold, seq)
         # snapshot; a background compactor folds the log into the
