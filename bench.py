@@ -1286,7 +1286,114 @@ def measure_multihost_shuffle(args) -> int:
             finally:
                 sched.close()
 
+        def run_rf_pairs(pairs):
+            """Runtime-filter on/off pairs (ISSUE 19): a repartition
+            join whose build side (orders, o_custkey < 5) rejects
+            nearly every probe-side lineitem row runs INTERLEAVED on
+            two live schedulers — runtime_filter=always vs off — so
+            both arms sample the same machine state. The filtered arm
+            pays a build-side probe round and the filter broadcast;
+            it saves the dropped rows' partition+encode+tunnel bytes.
+            Exact row parity is asserted every pair."""
+            q = (
+                "select count(*), sum(l_extendedprice) from lineitem "
+                "join orders on l_orderkey = o_orderkey "
+                "where o_custkey < 5"
+            )
+            rf_plan = build_query(
+                parse(q)[0], cat, "tpch", sess._scalar_subquery
+            )
+            scheds = {
+                arm: DCNFragmentScheduler(
+                    [("127.0.0.1", pt) for pt in ports],
+                    catalog=cat, shuffle_mode="always",
+                    shuffle_dag="never",
+                    runtime_filter=(
+                        "always" if arm == "filtered" else "off"
+                    ),
+                )
+                for arm in ("filtered", "unfiltered")
+            }
+            out = {
+                arm: {"wall": [], "bytes": [], "encode": [],
+                      "stage": []}
+                for arm in scheds
+            }
+            rf_info = {}
+            try:
+                for sched in scheds.values():  # compile warmup
+                    sched.execute_plan(rf_plan)
+                ref = None
+                for _ in range(pairs):
+                    for arm, sched in scheds.items():
+                        e0 = _reg_total(
+                            "tidbtpu_shuffle_encode_seconds"
+                        )
+                        t0 = time.perf_counter()
+                        _c, rows = sched.execute_plan(rf_plan)
+                        wall = time.perf_counter() - t0
+                        if ref is None:
+                            ref = rows
+                        assert rows == ref, "rf pair parity broke"
+                        lq = sched.last_query_mine() or {}
+                        st = lq.get("shuffle", {})
+                        rec = out[arm]
+                        rec["wall"].append(wall)
+                        rec["bytes"].append(
+                            st.get("bytes_tunneled", 0)
+                        )
+                        rec["encode"].append(
+                            _reg_total(
+                                "tidbtpu_shuffle_encode_seconds"
+                            ) - e0
+                        )
+                        rec["stage"].append(max(
+                            (f.get("exec_s", 0.0)
+                             for f in lq.get("fragments", [])),
+                            default=0.0,
+                        ))
+                        if arm == "filtered" and st.get("rf"):
+                            rf_info = dict(st["rf"])
+                f, u = out["filtered"], out["unfiltered"]
+                med = statistics.median
+                return {
+                    "pairs": pairs,
+                    "filter_kind": rf_info.get("kind"),
+                    "filter_bytes": rf_info.get("nbytes"),
+                    # observed keep-rate at the producers (the rf=
+                    # sel_obs EXPLAIN field): what fraction of probe
+                    # rows the build side actually admitted
+                    "observed_selectivity": rf_info.get("sel_obs"),
+                    "rows_dropped": rf_info.get("dropped"),
+                    "bytes_filtered": med(f["bytes"]),
+                    "bytes_unfiltered": med(u["bytes"]),
+                    "bytes_ratio": round(
+                        med(u["bytes"]) / max(med(f["bytes"]), 1), 4
+                    ),
+                    "encode_seconds_filtered": round(
+                        med(f["encode"]), 6
+                    ),
+                    "encode_seconds_unfiltered": round(
+                        med(u["encode"]), 6
+                    ),
+                    "stage_seconds_filtered": round(
+                        med(f["stage"]), 6
+                    ),
+                    "stage_seconds_unfiltered": round(
+                        med(u["stage"]), 6
+                    ),
+                    "seconds_filtered": round(med(f["wall"]), 6),
+                    "seconds_unfiltered": round(med(u["wall"]), 6),
+                    "speedup": round(
+                        med(u["wall"]) / max(med(f["wall"]), 1e-9), 4
+                    ),
+                }
+            finally:
+                for sched in scheds.values():
+                    sched.close()
+
         feedback_ab = run_feedback_pair()
+        runtime_filter_ab = run_rf_pairs(pairs=max(args.repeat, 5))
 
         ab = run_pipeline_pairs(pairs=max(args.repeat, 5))
         dag_ab = run_dag_ab(pairs=max(args.repeat, 3))
@@ -1399,6 +1506,10 @@ def measure_multihost_shuffle(args) -> int:
                 # run's seeded cost model flips repartition to
                 # broadcast (adaptive=feedback)
                 "feedback_ab": feedback_ab,
+                # ISSUE 19: runtime-filter on/off pairs — build-side
+                # key summary drops probe rows before partition+encode
+                # (tunnel bytes, encode CPU, observed selectivity)
+                "runtime_filter_ab": runtime_filter_ab,
                 "backend_provenance": {
                     "backend": "cpu",
                     "pjrt_backend": "cpu",

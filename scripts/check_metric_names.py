@@ -41,6 +41,11 @@ import sys
 #: obs/profiler.py — per-digest cpu/device/stall attribution series
 #: plus sampler self-metrics), aqe = adaptive query execution (PR 15,
 #: parallel/aqe.py — decision counters, probe wall, misestimates).
+#: The shuffle subsystem additionally carries the PR 19 runtime-filter
+#: families: tidbtpu_shuffle_filter_built_total{kind},
+#: tidbtpu_shuffle_filter_bytes, tidbtpu_shuffle_filter_dropped_rows_total
+#: (parallel/shuffle.py) and the tidbtpu_shuffle_filter_selectivity
+#: histogram (parallel/dcn.py — observed keep-rate per filtered stage).
 SUBSYSTEMS = frozenset({
     "admission",
     "aqe",

@@ -43,6 +43,15 @@ HOTPATH = {
         "splice_id_auth", "column_key_ints", "partition_map",
         "partition_block", "range_key_values", "range_partition_map",
         "sample_range_keys",
+        # the shared keyed-int extraction + runtime-filter kernels
+        # (PR 19) sit directly on the produce path: one extraction
+        # feeds partition map, histogram, hot-key probe AND the
+        # bloom/in-list build/test
+        "key_ints_valid", "partition_map_from_ints",
+        "partition_histogram_from_ints", "hot_key_ints_from_ints",
+        "_rf_bloom_hashes", "build_bloom_filter", "_bloom_test",
+        "build_runtime_filter", "runtime_filter_test",
+        "apply_runtime_filter_block",
     },
     os.path.join("tidb_tpu", "parallel", "shuffle.py"): {
         "partition_rows",
@@ -55,6 +64,7 @@ HOTPATH = {
         "ShuffleWorker._ship_partition", "ShuffleWorker._send_stream",
         "ShuffleWorker._ship_block_side",
         "ShuffleWorker._side_input_block", "ShuffleWorker.run_sample",
+        "ShuffleWorker._apply_side_filter",
     },
     os.path.join("tidb_tpu", "server", "engine_rpc.py"): {
         "EngineServer._shuffle_push", "EngineServer._shuffle_push_binary",
@@ -138,6 +148,25 @@ BANNED = {
             "decode_frame":
                 "post-wait bulk decode — frames decode on arrival",
         },
+        # runtime-filter application (PR 19) runs per produced block
+        # on the binary produce path: it must stay a vectorized
+        # column-level mask (np.isin / packed-bitset probe), never a
+        # per-row Python membership test or a JSON round-trip
+        "ShuffleWorker._apply_side_filter": {
+            "materialize_rows":
+                "runtime-filter application materializing Python rows "
+                "— filtering is a vectorized keep-mask + take_block",
+            "tolist":
+                "per-row Python iteration on the filter application "
+                "path — membership tests stay vectorized (np.isin / "
+                "packed-bitset bloom probe)",
+            "dumps":
+                "JSON on the filter application path — the broadcast "
+                "filter decodes once per task, not per block",
+            "loads":
+                "JSON on the filter application path — the broadcast "
+                "filter decodes once per task, not per block",
+        },
         "stage_payloads_incremental": {
             "decode_frame":
                 "staging must consume already-decoded blocks",
@@ -150,6 +179,32 @@ BANNED = {
             "block_to_batch":
                 "block_to_batch re-pads (a second full copy) — use "
                 "batch_from_padded over capacity-sized buffers",
+        },
+    },
+    # the runtime-filter kernels (PR 19, parallel/wire.py): the
+    # membership test runs per produced block on every filtered side —
+    # a per-row Python loop or JSON round-trip here would cost more
+    # than the bytes the filter saves
+    os.path.join("tidb_tpu", "parallel", "wire.py"): {
+        "runtime_filter_test": {
+            "tolist":
+                "per-row Python membership on the filter probe — "
+                "np.isin / the packed-bitset bloom probe only",
+            "dumps": "JSON inside the vectorized filter probe",
+            "loads": "JSON inside the vectorized filter probe",
+        },
+        "apply_runtime_filter_block": {
+            "materialize_rows":
+                "row materialization while filtering a produced block "
+                "— keep-mask + take_block stays columnar",
+            "tolist":
+                "per-row Python iteration while filtering a produced "
+                "block",
+        },
+        "_bloom_test": {
+            "tolist":
+                "per-row Python iteration in the bloom probe — the "
+                "k-hash membership test is one vectorized gather",
         },
     },
     # the delta-sync data plane (PR 13): replicated writes stay

@@ -1535,3 +1535,78 @@ def test_dcn_aqe_replan_crash_retry_parity(tpch_single):
         sched.close()
         for w in workers:
             w.kill()
+
+
+def test_dcn_runtime_filter_crash_retry_parity(tpch_single):
+    """ISSUE 19 chaos acceptance (filter-crash): worker 2 hard-exits
+    (os._exit) the first time the broadcast runtime filter reaches its
+    produce path — the window between the coordinator's probe-round
+    merge + broadcast and the filtered stage's completion — while both
+    workers also drop a seeded fraction of pushed frames. The
+    coordinator must quarantine the dead worker and retry the whole
+    stage on the survivor set (m=1: the filter stands down, the stage
+    ships unfiltered) with exact row parity and no stale rf= on the
+    reported summary."""
+    import json as _json
+
+    from tidb_tpu.chaos.schedule import generate_filter_kill_specs
+    from tidb_tpu.parallel import aqe
+    from tidb_tpu.parallel.dcn import DCNFragmentScheduler
+    from tidb_tpu.server.engine_pool import FailedEngineProber
+
+    SEED = 1901
+    specs = generate_filter_kill_specs(SEED, 2)
+    assert specs == generate_filter_kill_specs(SEED, 2)  # replayable
+    assert any(
+        f["site"] == "shuffle/filter" and f["kind"] == "exit"
+        for f in specs[-1]
+    )
+    workers, ports = [], []
+    for spec in specs:
+        w, p = _spawn_dcn_worker(["--chaos-spec", _json.dumps(spec)])
+        workers.append(w)
+        ports.append(p)
+    sched = DCNFragmentScheduler(
+        [("127.0.0.1", p) for p in ports],
+        catalog=tpch_single.catalog,
+        shuffle_mode="always",
+        shuffle_dag="never",
+        runtime_filter="always",
+        # the killed worker dies mid-produce, so the survivor detects
+        # the loss only by wait expiry; the healthy retry never waits
+        shuffle_wait_timeout_s=10.0,
+        prober=FailedEngineProber(initial_backoff_s=60),
+    )
+    try:
+        q = (
+            "select count(*), sum(l_quantity) from lineitem "
+            "join orders on l_orderkey = o_orderkey "
+            "where o_custkey < 5"
+        )
+        exp = tpch_single.must_query(q).rows
+        before = aqe.decision_counts().get("runtime-filter", 0.0)
+        _cols, got = sched.execute_plan(_plan(tpch_single, q))
+        assert got == exp, f"\n got={got}\n exp={exp}"
+        st = sched.last_query["shuffle"]
+        # the whole stage retried on the survivor set after the kill
+        assert st["attempts"] >= 2
+        assert st["m"] == 1
+        # the decision genuinely fired before the crash...
+        assert aqe.decision_counts().get(
+            "runtime-filter", 0.0
+        ) >= before + 1
+        # ...but the m=1 retry stood the filter down: the superseded
+        # attempt's rf must not linger on the reported summary
+        assert "rf" not in st
+        assert [e.port for e in sched.prober.failed_endpoints()] == [
+            ports[-1]
+        ]
+        workers[-1].wait(timeout=30)
+        assert workers[-1].returncode == 3
+        # the survivor keeps serving filter-eligible queries alone
+        _cols, got2 = sched.execute_plan(_plan(tpch_single, q))
+        assert got2 == exp
+    finally:
+        sched.close()
+        for w in workers:
+            w.kill()

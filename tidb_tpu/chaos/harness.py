@@ -205,7 +205,12 @@ class ChaosHarness:
         being declared leaks."""
         out: List[str] = []
 
-        def settle(cond, what: str, timeout_s: float = 5.0):
+        # a REAL leak is permanent (the thread/lease/buffer never
+        # goes away), so a generous settle only delays the report —
+        # while a tight one flakes on loaded machines where a
+        # superseded attempt's threads sit out chained 2s shuffle
+        # waits before exiting
+        def settle(cond, what: str, timeout_s: float = 15.0):
             end = time.monotonic() + timeout_s
             while time.monotonic() < end:
                 if cond():

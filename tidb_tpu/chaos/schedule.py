@@ -75,6 +75,8 @@ FAULT_CLASSES = (
     "replan-crash",
     "delta-sync-loss",
     "compactor-crash",
+    "filter-loss",
+    "filter-crash",
 )
 
 #: action kinds arm_spec() knows how to build. "exit" hard-kills the
@@ -244,6 +246,21 @@ def _make_fault(cls: str, rng: random.Random) -> Fault:
         return Fault(
             cls, "delta/sync-loss", "drop", n=rng.randint(1, 2),
         )
+    if cls == "filter-loss":
+        # the broadcast runtime filter is lost/corrupted between the
+        # coordinator's merge and a producer applying it: the producer
+        # degrades to unfiltered shipping (rf_lost counted, parity
+        # unchanged) — the filter is a bytes optimization, never a
+        # correctness dependency
+        return Fault(cls, "shuffle/filter-lost", "value", param=1.0)
+    if cls == "filter-crash":
+        # the worker "dies" between the runtime-filter broadcast and
+        # the stage round's completion — the filtered producer's reply
+        # is lost exactly as it applies the filter, and the retry must
+        # re-decide (standing the filter down at m=1) on the survivors
+        return Fault(
+            cls, "shuffle/filter", "drop", n=rng.randint(1, 2),
+        )
     if cls == "compactor-crash":
         # the worker "dies" as the fold barrier lands: the compaction
         # round aborts, survivors keep serving the previous fold from
@@ -360,6 +377,36 @@ def generate_replan_kill_specs(
             faults.append(
                 Fault("replan-crash", "aqe/switched-stage", "exit",
                       n=1)
+            )
+        specs.append([f.to_dict() for f in faults])
+    return specs
+
+
+def generate_filter_kill_specs(
+    seed: int, n_workers: int
+) -> List[List[dict]]:
+    """Per-worker-PROCESS fault specs for the runtime-filter crash
+    dryrun (test_multihost): the LAST worker hard-exits (os._exit) the
+    first time a broadcast runtime filter reaches its produce path —
+    i.e. AFTER the probe round built and the coordinator merged +
+    broadcast the filter, BEFORE the filtered stage completed — while
+    every worker drops a seeded fraction of pushed frames. The retry
+    on the survivor set must stand the filter down (m=1) and reach
+    exact parity with no stale rf= on the summary. Deterministic in
+    (seed, n_workers)."""
+    rng = random.Random(int(seed))
+    specs: List[List[dict]] = []
+    for w in range(int(n_workers)):
+        faults = [
+            Fault(
+                "frame-drop", "shuffle/push-lost", "seeded-error",
+                p=round(rng.uniform(0.01, 0.04), 4),
+                seed=rng.randint(0, 2 ** 31),
+            ),
+        ]
+        if w == n_workers - 1:
+            faults.append(
+                Fault("filter-crash", "shuffle/filter", "exit", n=1)
             )
         specs.append([f.to_dict() for f in faults])
     return specs

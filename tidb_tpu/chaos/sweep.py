@@ -314,6 +314,11 @@ def _drv_shuffle_fleet(ctx) -> None:
         catalog=sess.catalog, shuffle_mode="always",
         shuffle_dag="always",
         shuffle_wait_timeout_s=30.0,
+        # PR 19: force runtime-filter emission so the join shapes
+        # traverse shuffle/filter (producer-side application) and the
+        # shuffle/filter-lost degrade seam on both the DAG stage-0
+        # join and the single-stage cut
+        runtime_filter="always",
     )
     try:
         for q in (
@@ -520,7 +525,8 @@ SWEEP: List[Tuple[str, str, object, Tuple[str, ...]]] = [
      ("shuffle/open", "shuffle/produce", "shuffle/push",
       "shuffle/push-lost", "shuffle/wait", "shuffle/consume",
       "shuffle/stage", "shuffle/sample", "shuffle/sample-lost",
-      "shuffle/stage-input", "dcn/dispatch", "dcn/final-stage")),
+      "shuffle/stage-input", "shuffle/filter", "shuffle/filter-lost",
+      "dcn/dispatch", "dcn/final-stage")),
     ("driver", "aqe-fleet", _drv_aqe_fleet,
      ("aqe/probe", "aqe/probe-lost", "aqe/replan",
       "aqe/switched-stage")),

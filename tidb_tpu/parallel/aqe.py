@@ -53,6 +53,15 @@ AQE_DECISIONS: Dict[str, str] = {
                         "DAG stage's held outputs)",
     "feedback": "per-digest observed actuals seeded the cost model "
                 "and changed a shuffle_mode=auto / edge-mode choice",
+    "runtime-filter": "build-side key summary (bloom / in-list / "
+                      "min-max) harvested in the probe round, merged "
+                      "across hosts, and broadcast with the stage "
+                      "dispatch so probe-side producers drop "
+                      "non-matching rows before partition+encode",
+    "partial-agg-skip": "probe group-cardinality approached the side's "
+                        "row count, so the producer-side partial "
+                        "aggregation (pure overhead there) is skipped "
+                        "and rows flow straight to the final aggregate",
 }
 
 

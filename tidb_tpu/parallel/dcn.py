@@ -225,6 +225,16 @@ def _h_partition_rows():
     )
 
 
+def _h_filter_selectivity():
+    return REGISTRY.histogram(
+        "tidbtpu_shuffle_filter_selectivity",
+        "observed runtime-filter pass rate per stage (kept/tested "
+        "probe-side rows) — low values mean the filter carried its "
+        "weight; ~1.0 stages are candidates for the auto cost gate "
+        "to stand down (renders as rf= sel_obs on EXPLAIN ANALYZE)",
+    )
+
+
 def _update_host_gauges(endpoints) -> None:
     alive = sum(1 for ep in endpoints if ep.alive)
     REGISTRY.gauge(
@@ -583,6 +593,9 @@ class DCNFragmentScheduler:
         shuffle_skew_salt_k: Optional[int] = None,
         aqe_feedback: Optional[bool] = None,
         aqe_replan_ratio: Optional[float] = None,
+        runtime_filter: Optional[str] = None,
+        rf_bloom_bits: Optional[int] = None,
+        rf_inlist_ndv: Optional[int] = None,
         conn_pool_size: int = 4,
         admission=None,
         retry_backoff_s: float = 0.05,
@@ -704,6 +717,28 @@ class DCNFragmentScheduler:
             aqe_replan_ratio = float(
                 sv.get("tidb_tpu_aqe_replan_ratio")
             )
+        # runtime filters (PERF_NOTES "PR 19: runtime filters"): the
+        # probe round harvests a build-side key summary (bloom /
+        # in-list / min-max) and the stage dispatch carries it so
+        # probe-side producers drop non-matching rows BEFORE
+        # partition+encode. "auto" costs filter build+ship bytes
+        # against CARD_FEEDBACK-predicted probe bytes saved;
+        # "always"/"off" force the choice (tests, benchmarks).
+        if runtime_filter is None:
+            runtime_filter = str(sv.get("tidb_tpu_runtime_filter"))
+        if runtime_filter not in ("auto", "off", "always"):
+            raise ValueError(f"bad runtime_filter {runtime_filter!r}")
+        if rf_bloom_bits is None:
+            rf_bloom_bits = int(
+                sv.get("tidb_tpu_runtime_filter_bloom_bits")
+            )
+        if rf_inlist_ndv is None:
+            rf_inlist_ndv = int(
+                sv.get("tidb_tpu_runtime_filter_inlist_ndv")
+            )
+        self.runtime_filter = runtime_filter
+        self.rf_bloom_bits = int(rf_bloom_bits)
+        self.rf_inlist_ndv = int(rf_inlist_ndv)
         self.shuffle_skew_ratio = float(shuffle_skew_ratio)
         self.shuffle_skew_salt_k = int(shuffle_skew_salt_k)
         self.aqe_feedback = bool(aqe_feedback)
@@ -1482,6 +1517,49 @@ class DCNFragmentScheduler:
             )
 
             salted_sp = split_plan_shuffle_salted(plan, self.catalog)
+        # runtime-filter candidacy (PR 19, once per statement): the
+        # legal build->apply direction plus the coordinator-fixed
+        # bloom geometry (every host builds the same shape, so the
+        # per-host bitsets OR together in the merge)
+        rf_cand = None
+        rf_spec = None
+        if (
+            self.runtime_filter != "off"
+            and self.shuffle_codec == "binary"
+            and sp.kind == "join"
+            and all(s.frag_scan is not None for s in sp.sides)
+        ):
+            rf_cand = self._rf_candidate(sp)
+        if rf_cand is not None:
+            from tidb_tpu.parallel.wire import bloom_geometry
+
+            est_b = int(
+                next(
+                    s for s in sp.sides if s.tag == rf_cand[0]
+                ).est_rows or 0
+            )
+            nbits, kh = bloom_geometry(
+                max(est_b, 1), self.rf_bloom_bits
+            )
+            rf_spec = {
+                "bits": int(nbits), "k": int(kh),
+                "inlist_ndv": int(self.rf_inlist_ndv),
+            }
+        # producer partial-agg skip candidacy (the PR 5 "Partial
+        # Partial Aggregates" item): plan the partial-agg-free join
+        # variant once per statement; the probe's observed group NDV
+        # decides whether the partial agg is pure overhead
+        aggskip_sp = None
+        if (
+            self.shuffle_codec == "binary" and plan is not None
+            and sp.kind == "join"
+            and (self.shuffle_skew_ratio > 1.0 or rf_cand is not None)
+        ):
+            from tidb_tpu.planner.fragmenter import (
+                split_plan_shuffle_aggskip,
+            )
+
+            aggskip_sp = split_plan_shuffle_aggskip(plan, self.catalog)
         for rnd in range(self.max_attempts):
             if rnd:
                 # jittered exponential backoff before every re-attempt:
@@ -1517,16 +1595,39 @@ class DCNFragmentScheduler:
             salts = None
             tokens = list(getattr(sp, "_aqe_tokens", None) or [])
             probe = None
-            if (
+            rf = None
+            probed_tags = None  # None = every side produced-and-cached
+            skew_arm = (
                 self.shuffle_skew_ratio > 1.0
+                and (sp.kind != "groupby" or salted_sp is not None)
+            )
+            rf_arm = (
+                rf_cand is not None
+                and m > 1
+                and self._rf_probe_worth(sp, rf_cand, m, digest)
+            )
+            if not skew_arm and aggskip_sp is None and rf_arm:
+                # rf-only probe: produce-and-cache just the BUILD
+                # side, so the big probe side keeps its pipelined
+                # produce->filter->push overlap in the stage round
+                probed_tags = {rf_cand[0]}
+            if (
+                (skew_arm or rf_arm)
                 and self.shuffle_codec == "binary"
                 and m > 1
-                and (sp.kind != "groupby" or salted_sp is not None)
                 and all(s.frag_scan is not None for s in sp.sides)
             ):
                 probe = self._probe_stage(
                     sp, hosts, m, attempt, qid, kill_check, deadline,
                     suspects, errs, snap=snap,
+                    rf_spec=rf_spec if rf_arm else None,
+                    rf_build_tags=(rf_cand[0],) if rf_arm else (),
+                    gcol_by_tag=(
+                        {aggskip_sp._aggskip_gtag:
+                         aggskip_sp._aggskip_gcol}
+                        if aggskip_sp is not None else None
+                    ),
+                    only_tags=probed_tags,
                 )
                 if probe is None:
                     # a probe reply was lost: exactly as retryable as
@@ -1540,6 +1641,40 @@ class DCNFragmentScheduler:
                     plan, sp, probe, m, salted_sp=salted_sp
                 )
                 tokens = tokens + toks
+                # (3) producer partial-agg skip: the probed group NDV
+                # approached the side's row count, so the partial agg
+                # would barely fold anything — swap to the variant
+                # that ships join rows straight to the final agg (a
+                # broadcast/salt decision wins the conflict: those
+                # re-shape the same sides)
+                if (
+                    aggskip_sp is not None and used_sp is sp
+                    and not salts and not toks
+                ):
+                    from tidb_tpu.parallel import aqe
+
+                    gtag = aggskip_sp._aggskip_gtag
+                    gent = probe.get(gtag) or {}
+                    gndv = int(gent.get("gndv", 0) or 0)
+                    grows = int(gent.get("rows", 0) or 0)
+                    if gndv and grows and gndv >= 0.8 * grows:
+                        used_sp = aggskip_sp
+                        tokens = tokens + [aqe.note_decision(
+                            "partial-agg-skip", f"{gndv}/{grows}"
+                        )]
+                # (4) runtime filter: merge the per-host build-side
+                # filters and attach to the apply side's dispatch
+                if rf_arm:
+                    rf, rtoks = self._rf_decide(
+                        used_sp, probe, m, stage, digest, rf_cand
+                    )
+                    tokens = tokens + rtoks
+            if rf is None:
+                # this attempt runs unfiltered (probe stood down, or
+                # the merge degraded): a previous attempt's rf= must
+                # not linger on the summary — same contract as the
+                # adaptive= reflection below
+                stage.pop("rf", None)
             stage["kind"] = used_sp.kind
             # reflect THIS attempt's decisions: a retry whose probe
             # stood down (e.g. the survivor set collapsed to m=1) runs
@@ -1569,12 +1704,37 @@ class DCNFragmentScheduler:
                             "mode": getattr(s, "mode", "hash"),
                             # salted routing spec (None = plain), and
                             # whether a probe already produced-and-
-                            # cached this side (the stage round then
+                            # cached THIS side (the stage round then
                             # reads the held block instead of
-                            # re-executing the producer)
+                            # re-executing the producer; an rf-only
+                            # probe caches just the build side)
                             "salt": (salts or {}).get(s.tag),
-                            "probed": probe is not None,
-                            "plan": plan_to_ir(s.host_plan(i, m)),
+                            "probed": (
+                                probe is not None
+                                and (probed_tags is None
+                                     or s.tag in probed_tags)
+                            ),
+                            # merged runtime filter for the apply
+                            # side (None = unfiltered shipping)
+                            "rf": (
+                                rf["filter"]
+                                if rf is not None
+                                and s.tag == rf["tag"] else None
+                            ),
+                            "plan": plan_to_ir(
+                                self._rf_pushdown_plan(
+                                    s.host_plan(i, m), s.key,
+                                    rf["filter"],
+                                )
+                                if rf is not None
+                                and s.tag == rf["tag"]
+                                and not (
+                                    probe is not None
+                                    and (probed_tags is None
+                                         or s.tag in probed_tags)
+                                )
+                                else s.host_plan(i, m)
+                            ),
                         }
                         for s in used_sp.sides
                     ],
@@ -1742,6 +1902,16 @@ class DCNFragmentScheduler:
             for tag, rows in (st.get("side_rows") or {}).items():
                 key = f"{kind}:{si}:{tag}"
                 sides[key] = sides.get(key, 0) + int(rows)
+            # observed runtime-filter pass rate, per-mille (the
+            # selectivity a later run of this digest seeds its
+            # emit-or-not cost gate from — _rf_predicted)
+            rf = st.get("rf") or {}
+            rin = int(rf.get("rows_in", 0) or 0)
+            if rin and rf.get("tag") is not None:
+                kept = rin - int(rf.get("dropped", 0) or 0)
+                sides[f"rf:{kind}:{si}:{rf['tag']}"] = int(
+                    round(1000.0 * kept / rin)
+                )
         if not sides:
             return
         from tidb_tpu.planner.cardinality import CARD_FEEDBACK
@@ -1766,11 +1936,15 @@ class DCNFragmentScheduler:
     def _stage_task(
         self, dag, si, stage, i, m, attempt, qid, boundaries, peers,
         secret, deadline, snap=None, topsql=None, adaptive=None,
+        rf=None, probed_tags=(),
     ) -> dict:
         """The worker task spec for partition ``i`` of DAG stage
         ``si`` — run_task's single-stage spec plus the DAG fields
         (stage index, exchange kind, range boundaries, hold/release
-        of the inter-stage held outputs)."""
+        of the inter-stage held outputs). ``rf``/``probed_tags``
+        attach a probed runtime filter exactly like the single-stage
+        dispatch (the probe cached the build side under this stage's
+        held key, so its producer is not re-executed)."""
         n = len(dag.stages)
         return {
             "sid": f"{self._sid_prefix}-q{qid}-s{si}", "qid": qid,
@@ -1786,7 +1960,20 @@ class DCNFragmentScheduler:
             "sides": [
                 {
                     "tag": s.tag, "key": s.key, "mode": s.mode,
-                    "plan": plan_to_ir(s.host_plan(i, m)),
+                    "probed": s.tag in (probed_tags or ()),
+                    "rf": (
+                        rf["filter"]
+                        if rf is not None and s.tag == rf["tag"]
+                        else None
+                    ),
+                    "plan": plan_to_ir(
+                        self._rf_pushdown_plan(
+                            s.host_plan(i, m), s.key, rf["filter"]
+                        )
+                        if rf is not None and s.tag == rf["tag"]
+                        and s.tag not in (probed_tags or ())
+                        else s.host_plan(i, m)
+                    ),
                 }
                 for s in stage.sides
             ],
@@ -1881,16 +2068,24 @@ class DCNFragmentScheduler:
 
     def _probe_stage(
         self, sp, hosts, m, attempt, qid, kill_check, deadline,
-        suspects, errs, snap=None,
+        suspects, errs, snap=None, stage_idx=0, rf_spec=None,
+        rf_build_tags=(), gcol_by_tag=None, only_tags=None,
     ) -> Optional[Dict[int, dict]]:
         """AQE probe round of one hash stage (parallel/aqe.py): every
         worker produces-and-CACHES its sides (ShuffleWorker.run_probe
         — the range-sampling discipline, so the stage round re-reads
         the blocks instead of re-executing the producers) and replies
-        exact per-partition row histograms + hottest keys. Returns
-        the merged per-side view {tag: {"rows", "part_rows", "hot"}},
-        or None when a host failed (suspects filled — the caller
-        verifies and retries on the survivor set)."""
+        exact per-partition row histograms + hottest keys — plus,
+        when requested, a runtime filter over the side's key ints
+        (``rf_spec`` fixes the bloom geometry coordinator-side so the
+        per-host bitsets OR together) and a group-column NDV (the
+        partial-agg-skip signal). ``only_tags`` restricts the probe
+        to a side subset (an rf-only probe caches just the build side
+        so the big probe side keeps its pipelined produce overlap).
+        Returns the merged per-side view {tag: {"rows", "part_rows",
+        "hot"[, "filters", "gndv"]}}, or None when a host failed
+        (suspects filled — the caller verifies and retries on the
+        survivor set)."""
         t0 = time.perf_counter()
         ts_entry = self._topsql_entry()  # statement thread: see helper
         replies: List[Optional[list]] = [None] * m
@@ -1898,17 +2093,26 @@ class DCNFragmentScheduler:
         cancelled: List[str] = []
 
         def run_one(i: int, ep: EngineEndpoint, conn: EngineClient):
+            sides = []
+            for s in sp.sides:
+                if only_tags is not None and s.tag not in only_tags:
+                    continue
+                sd = {
+                    "tag": s.tag, "key": s.key,
+                    "plan": plan_to_ir(s.host_plan(i, m)),
+                }
+                if rf_spec is not None and s.tag in rf_build_tags:
+                    sd["rf_build"] = True
+                gc = (gcol_by_tag or {}).get(s.tag)
+                if gc:
+                    sd["gcol"] = gc
+                sides.append(sd)
             spec = {
                 "qid": qid, "attempt": attempt, "m": m, "part": i,
-                "coord": self._sid_prefix, "stage": 0,
+                "coord": self._sid_prefix, "stage": int(stage_idx),
                 "deadline_s": self._deadline_left(deadline),
-                "sides": [
-                    {
-                        "tag": s.tag, "key": s.key,
-                        "plan": plan_to_ir(s.host_plan(i, m)),
-                    }
-                    for s in sp.sides
-                ],
+                "sides": sides,
+                "rf": rf_spec,
                 "snap": snap,
                 "topsql": ts_entry,
             }
@@ -1969,7 +2173,207 @@ class DCNFragmentScheduler:
                 for kv in sd.get("hot") or ():
                     k, c = int(kv[0]), int(kv[1])
                     ent["hot"][k] = ent["hot"].get(k, 0) + c
+                if "filter" in sd:
+                    # per-host build-side filters: one entry per host
+                    # (merge_runtime_filters ORs same-geometry blooms,
+                    # unions in-lists; a malformed entry merges to
+                    # None and the stage degrades to unfiltered)
+                    ent.setdefault("filters", []).append(
+                        sd.get("filter")
+                    )
+                if "gndv" in sd:
+                    # summed per-host LOCAL group NDV: an upper bound
+                    # on the global NDV — always CORRECT to act on
+                    # (skipping the partial agg never changes results,
+                    # it only trades producer CPU against wire bytes)
+                    ent["gndv"] = (
+                        ent.get("gndv", 0) + int(sd["gndv"])
+                    )
         return merged
+
+    #: which side may BUILD a runtime filter the other side tests,
+    #: per join kind (build tag -> apply tag): dropping a filtered row
+    #: is legal only on the NON-PRESERVED side of the equi-join —
+    #: inner/semi filter either direction, left/anti only the right
+    #: side (their left rows survive regardless of a match), and
+    #: null-aware anti joins are excluded entirely (a dropped NULL /
+    #: unmatched right row CHANGES the result there)
+    _RF_LEGAL = {
+        "inner": {0: 1, 1: 0},
+        "left": {0: 1},
+        "semi": {0: 1, 1: 0},
+        "anti": {0: 1},
+    }
+
+    def _rf_candidate(self, sp):
+        """(build_tag, apply_tag) for a runtime filter on this hash
+        stage, or None when no legal direction exists: two hash-mode
+        sides of a supported equi-join kind, building from the
+        smaller-estimated legal side (the filter ships per host, so
+        the cheap side pays the build)."""
+        sides = {s.tag: s for s in sp.sides}
+        if len(sides) != 2 or getattr(sp, "join_kind", None) is None:
+            return None
+        legal = self._RF_LEGAL.get(sp.join_kind or "")
+        if not legal:
+            return None
+        if any(
+            getattr(s, "mode", "hash") != "hash" for s in sp.sides
+        ):
+            return None
+        b = min(
+            legal, key=lambda t: int(sides[t].est_rows or 0)
+        )
+        return (b, legal[b])
+
+    def _rf_predicted(self, kind, si, apply_tag, digest):
+        """Predicted filter pass rate for this digest's stage/side
+        from a PREVIOUS run's observed selectivity (_record_feedback
+        stores per-mille kept/tested under ``rf:<kind>:<si>:<tag>``),
+        or None when feedback is off / this digest never ran
+        filtered."""
+        if not (self.aqe_feedback and digest):
+            return None
+        from tidb_tpu.planner.cardinality import CARD_FEEDBACK
+
+        obs = CARD_FEEDBACK.sides_for(digest) or {}
+        v = obs.get(f"rf:{kind}:{si}:{apply_tag}")
+        if v is None:
+            return None
+        return max(0.0, min(1.0, int(v) / 1000.0))
+
+    def _rf_probe_worth(self, sp, cand, m, digest, kind="shuffle",
+                        si=0):
+        """Whether arming a PROBE round just for a runtime filter
+        pays: 'always' forces it; 'auto' requires CARD_FEEDBACK
+        evidence from a previous run of this digest that the filter
+        won (predicted probe bytes saved clear the estimated filter
+        build+ship cost) — without history the probe round itself is
+        an unpriced RPC round, so auto stands down rather than tax
+        every cold join (the PERF_NOTES PR 19 cost model)."""
+        if self.runtime_filter == "always":
+            return True
+        sel = self._rf_predicted(kind, si, cand[1], digest)
+        if sel is None:
+            return False
+        from tidb_tpu.parallel.wire import RF_MAX_BLOOM_BYTES
+
+        sides = {s.tag: s for s in sp.sides}
+        est_probe = int(sides[cand[1]].est_rows or 0)
+        est_build = int(sides[cand[0]].est_rows or 0)
+        nbytes = min(
+            est_build * self.rf_bloom_bits // 8 + 64,
+            RF_MAX_BLOOM_BYTES,
+        )
+        # ~32B/row shipped (a few int64 columns after encode) vs the
+        # filter shipped to every host plus one probe RPC round
+        return (1.0 - sel) * est_probe * 32.0 > 2.0 * nbytes * m
+
+    def _rf_decide(self, used_sp, probe, m, stage, digest, cand,
+                   kind="shuffle", si=0, count=True):
+        """Merge the per-host build-side filters and decide emission
+        (the declared 'runtime-filter' AQE decision): 'always' forces
+        the merged filter onto the apply side; 'auto' costs filter
+        ship bytes against predicted probe bytes saved (feedback-
+        seeded selectivity when this digest ran before, build-NDV /
+        probe-rows otherwise). A lost or corrupt per-host filter
+        merges to None and DEGRADES to unfiltered shipping — never
+        wrong results. Returns ({"tag", "filter"} or None, tokens);
+        ``count=False`` rebuilds the token without re-moving the
+        decision counter (DAG retry attempts re-probe to re-cache
+        blocks under the new attempt key, but the decision already
+        counted — the salting-token fencing discipline)."""
+        from tidb_tpu.parallel import aqe
+        from tidb_tpu.parallel.wire import (
+            merge_runtime_filters,
+            runtime_filter_nbytes,
+        )
+
+        build_tag, apply_tag = cand
+        sides = {s.tag: s for s in used_sp.sides}
+        ap = sides.get(apply_tag)
+        if ap is None or getattr(ap, "mode", "hash") != "hash":
+            # a broadcast-switched edge ships whole copies, not
+            # partitions — nothing for a partition filter to drop
+            return None, []
+        ent = probe.get(build_tag) or {}
+        filters = ent.get("filters") or []
+        merged = (
+            merge_runtime_filters(filters)
+            if len(filters) == m else None
+        )
+        if merged is None:
+            return None, []
+        nbytes = runtime_filter_nbytes(merged)
+        obs = probe.get(apply_tag) or {}
+        probe_rows = int(
+            obs.get("rows") or int(ap.est_rows or 0)
+        )
+        sel = self._rf_predicted(kind, si, apply_tag, digest)
+        if sel is None:
+            sel = min(
+                1.0,
+                int(merged.get("ndv", 0)) / max(probe_rows, 1),
+            )
+        if self.runtime_filter != "always":
+            saved = (1.0 - sel) * probe_rows * 32.0
+            if saved <= 2.0 * nbytes * m:
+                return None, []
+        detail = f"{merged['kind']}@t{apply_tag}"
+        tok = (
+            aqe.note_decision("runtime-filter", detail)
+            if count else f"runtime-filter:{detail}"
+        )
+        stage["rf"] = {
+            "kind": merged["kind"], "tag": apply_tag,
+            "nbytes": int(nbytes),
+            "ndv": int(merged.get("ndv", 0)),
+            "sel_pred": round(float(sel), 3),
+        }
+        if merged.get("kind") == "bloom":
+            stage["rf"]["bits"] = int(merged.get("bits", 0))
+        return {"tag": apply_tag, "filter": merged}, [tok]
+
+    @staticmethod
+    def _rf_pushdown_plan(plan_node, key, rf):
+        """Push the merged filter's MIN-MAX bounds below the exchange
+        into the producer plan (a Selection over the Scan.frag
+        slice): rows outside [lo, hi] — and NULL keys, which never
+        match the legal apply side — are pruned by the engine's own
+        predicate path before they are ever materialized for
+        partition+encode. Bounds exist only for order-preserving key
+        kinds (INT/BOOL, wire.build_runtime_filter), so a plain
+        BETWEEN is exact; any failure falls back to the unwrapped
+        plan (the worker-side filter still applies — this is an
+        optimization, never a correctness step)."""
+        if not isinstance(rf, dict) or "lo" not in rf or "hi" not in rf:
+            return plan_node
+        try:
+            from tidb_tpu.expression.expr import (
+                ColumnRef,
+                Func,
+                Literal,
+                bind_expr,
+            )
+            from tidb_tpu.dtypes import INT64
+
+            types = plan_node.schema.types()
+            kt = types.get(key)
+            if kt is None:
+                return plan_node
+            col = ColumnRef(type=kt, name=key)
+            pred = Func(type=None, op="and", args=(
+                Func(type=None, op="ge", args=(
+                    col, Literal(type=INT64, value=int(rf["lo"])),
+                )),
+                Func(type=None, op="le", args=(
+                    col, Literal(type=INT64, value=int(rf["hi"])),
+                )),
+            ))
+            pred = bind_expr(pred, types)
+            return L.Selection(plan_node.schema, plan_node, pred)
+        except Exception:
+            return plan_node
 
     def _aqe_decide(self, plan, sp, probe, m, salted_sp=None):
         """Turn one probe's merged observations into adaptive
@@ -2175,6 +2579,23 @@ class DCNFragmentScheduler:
             stage["skew"] = round(max(pr) / mean, 2)
             for v in pr:
                 _h_partition_rows().observe(float(v))
+        # runtime-filter observability (PR 19): observed selectivity =
+        # kept/tested probe-side rows, folded fleet-wide; rf_lost
+        # counts filter-lost degrades (the chaos site) — renders as
+        # rf= ... sel_obs= on the EXPLAIN ANALYZE DCNShuffle row
+        rin = sum(int(f.get("rf_rows_in", 0)) for f in infos)
+        rdrop = sum(int(f.get("rf_dropped", 0)) for f in infos)
+        rlost = sum(int(f.get("rf_lost", 0)) for f in infos)
+        if rin or rlost:
+            rf = stage.setdefault("rf", {})
+            rf["rows_in"] = rin
+            rf["dropped"] = rdrop
+            if rlost:
+                rf["lost"] = rlost
+            if rin:
+                sel = 1.0 - rdrop / rin
+                rf["sel_obs"] = round(sel, 3)
+                _h_filter_selectivity().observe(sel)
 
     def _stage_replan(self, stg, prev_infos) -> List[str]:
         """AQE stage-boundary re-planning (parallel/aqe.py): before
@@ -2323,6 +2744,80 @@ class DCNFragmentScheduler:
                         "wait_idle_s": 0.0, "ttff_s": 0.0,
                         "exec_s": 0.0,
                     }
+                    # runtime filter on a DAG hash-join stage (PR 19):
+                    # probe-and-cache the legal build side, merge the
+                    # per-host filters, attach to the apply side. The
+                    # DECISION persists on the DagStage across retry
+                    # attempts (the _stage_replan token pattern: the
+                    # counter moves once) while the probe re-runs per
+                    # attempt — held blocks are attempt-fenced, and
+                    # deterministic data rebuilds the identical filter.
+                    rf_dec = None
+                    rf_ptags = ()
+                    rf_cand = None
+                    if (
+                        self.runtime_filter != "off"
+                        and stg.exchange == "hash"
+                        and m > 1
+                        and all(
+                            s.frag_scan is not None
+                            for s in stg.sides
+                        )
+                    ):
+                        rf_cand = self._rf_candidate(stg)
+                    if rf_cand is not None and self._rf_probe_worth(
+                        stg, rf_cand, m, digest, kind="dag", si=si
+                    ):
+                        from tidb_tpu.parallel.wire import (
+                            bloom_geometry,
+                        )
+
+                        est_b = int(
+                            next(
+                                s for s in stg.sides
+                                if s.tag == rf_cand[0]
+                            ).est_rows or 0
+                        )
+                        nbits, kh = bloom_geometry(
+                            max(est_b, 1), self.rf_bloom_bits
+                        )
+                        probe = self._probe_stage(
+                            stg, hosts, m, attempt, qid, kill_check,
+                            deadline, suspects, errs, snap=snap,
+                            stage_idx=si,
+                            rf_spec={
+                                "bits": int(nbits), "k": int(kh),
+                                "inlist_ndv": int(self.rf_inlist_ndv),
+                            },
+                            rf_build_tags=(rf_cand[0],),
+                            only_tags={rf_cand[0]},
+                        )
+                        if probe is None:
+                            break  # suspects filled: verify + retry
+                        persisted_rf = getattr(
+                            stg, "_rf_tokens", None
+                        )
+                        rf_dec, rtoks = self._rf_decide(
+                            stg, probe, m, stage, digest, rf_cand,
+                            kind="dag", si=si,
+                            count=persisted_rf is None,
+                        )
+                        if rf_dec is not None:
+                            rf_ptags = (rf_cand[0],)
+                            if persisted_rf is None:
+                                stg._rf_tokens = list(rtoks)
+                            stage_tokens = (
+                                list(stage_tokens)
+                                + list(stg._rf_tokens)
+                            )
+                            stage["adaptive"] = list(stage_tokens)
+                        else:
+                            # the merge degraded (or auto stood
+                            # down): the build side is still cached —
+                            # dispatch it as probed so the stage
+                            # round reads the held block
+                            rf_ptags = (rf_cand[0],)
+                            stage.pop("rf", None)
                     inject("shuffle/stage")
                     _c_shuffle_stages().inc()
                     _c_stage_exchanges().labels(
@@ -2346,13 +2841,15 @@ class DCNFragmentScheduler:
                     def run_part(i, ep, conn, _si=si, _stg=stg,
                                  _bnd=boundaries, _ledger=ledger,
                                  _infos=infos, _cancelled=cancelled,
-                                 _adaptive=tuple(stage_tokens)):
+                                 _adaptive=tuple(stage_tokens),
+                                 _rf=rf_dec, _ptags=rf_ptags):
                         token = _ledger.claim(i, ep.address)
                         task = self._stage_task(
                             dag, _si, _stg, i, m, attempt, qid,
                             _bnd, peers, ep.secret, deadline,
                             snap=snap, topsql=ts_entry,
                             adaptive=_adaptive,
+                            rf=_rf, probed_tags=_ptags,
                         )
                         t_d0 = time.time()
                         try:
@@ -2485,6 +2982,10 @@ class DCNFragmentScheduler:
                 out["skew"] = max(
                     float(out.get("skew", 0.0)), float(s["skew"])
                 )
+            if s.get("rf"):
+                # a filtered stage's rf= renders on the roll-up too
+                # (one filtered join per chain in practice)
+                out["rf"] = dict(s["rf"])
         return out
 
     def _concat_merge(self, dag: ShuffleDAG, parts_rows):
@@ -2592,6 +3093,13 @@ class DCNFragmentScheduler:
             },
             "recv_rows": int(sh.get("recv_rows", 0)),
             "salted": int(sh.get("salted", 0)),
+            # runtime-filter accounting (PR 19): probe-side rows
+            # tested / dropped by the shipped build-side filter, and
+            # filter-lost degrades (the chaos site's unfiltered
+            # fallback) — folds into the stage rf= observability
+            "rf_rows_in": int(sh.get("rf_rows_in", 0)),
+            "rf_dropped": int(sh.get("rf_dropped", 0)),
+            "rf_lost": int(sh.get("rf_lost", 0)),
             "spans": spans,
         }
         with self._lock:

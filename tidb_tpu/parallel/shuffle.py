@@ -194,6 +194,31 @@ def _h_ttff():
     )
 
 
+def _c_filter_built():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_filter_built_total",
+        "runtime filters built from probe-cached build sides, by kind "
+        "(bloom / inlist — ISSUE 19 sideways information passing)",
+        labels=("kind",),
+    )
+
+
+def _c_filter_bytes():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_filter_bytes",
+        "runtime filter payload bytes shipped coordinator-ward in "
+        "probe replies (the build+ship cost side of the rf cost model)",
+    )
+
+
+def _c_filter_dropped():
+    return REGISTRY.counter(
+        "tidbtpu_shuffle_filter_dropped_rows_total",
+        "probe-side rows dropped by a runtime filter BEFORE "
+        "partitioning and encoding (never shipped, never staged)",
+    )
+
+
 def _g_stages_buffered():
     return REGISTRY.gauge(
         "tidbtpu_shuffle_stages_buffered",
@@ -1436,13 +1461,30 @@ class ShuffleWorker:
         re-dispatches the stage salted (or broadcast-switched, when a
         side's observed total collapsed). The produce runs ONCE: the
         stage round's sides read the cached blocks through
-        _side_input_block."""
-        from tidb_tpu.parallel.wire import hot_key_ints, partition_histogram
+        _side_input_block.
+
+        Runtime filters (ISSUE 19): when the spec carries an ``rf``
+        geometry request, build-flagged sides also reply a compact
+        filter over their key domain (bloom / in-list / min-max) plus
+        the exact distinct key count — harvested from the SAME keyed-
+        int extraction the histogram and hot-key replies use
+        (key_ints_valid: each cached block is hashed ONCE). A side
+        flagged with a ``gcol`` group column replies its distinct
+        group count (``gndv``) for the partial-agg-skip decision."""
+        from tidb_tpu.dtypes import Kind
+        from tidb_tpu.parallel.wire import (
+            build_runtime_filter,
+            hot_key_ints_from_ints,
+            key_ints_valid,
+            partition_histogram_from_ints,
+            runtime_filter_nbytes,
+        )
         from tidb_tpu.planner import logical as L
         from tidb_tpu.planner.ir import plan_from_ir
 
         inject("aqe/probe")
         m = int(spec["m"])
+        rf_spec = spec.get("rf")
         out = []
         pins: list = []
         try:
@@ -1460,18 +1502,64 @@ class ShuffleWorker:
                         spec["attempt"], spec.get("stage", 0),
                         int(side["tag"]), blk,
                     )
-                out.append({
+                ints, valid = key_ints_valid(blk, side["key"])
+                ent = {
                     "tag": int(side["tag"]),
                     "rows": int(blk.nrows),
-                    "part_rows": partition_histogram(
-                        blk, side["key"], m
+                    "part_rows": partition_histogram_from_ints(
+                        ints, valid, m
                     ),
-                    "hot": hot_key_ints(blk, side["key"]),
-                })
+                    "hot": hot_key_ints_from_ints(ints, valid),
+                }
+                if rf_spec and side.get("rf_build"):
+                    # min-max bounds are legal only where the key-int
+                    # image IS the raw value in logical order
+                    kkind = blk.columns[side["key"]].type.kind
+                    rf = build_runtime_filter(
+                        ints, valid, rf_spec,
+                        minmax=kkind in (Kind.INT, Kind.BOOL),
+                    )
+                    ent["filter"] = rf
+                    _c_filter_built().labels(kind=rf["kind"]).inc()
+                    _c_filter_bytes().inc(runtime_filter_nbytes(rf))
+                gcol = side.get("gcol")
+                if gcol and gcol in blk.columns:
+                    gints, gvalid = key_ints_valid(blk, gcol)
+                    ent["gndv"] = int(len(np.unique(gints[gvalid])))
+                out.append(ent)
         finally:
             for t, v in pins:
                 t.unpin(v)
         return {"sides": out}
+
+    def _apply_side_filter(self, blk, key, rf, stats, tlock):
+        """Apply a broadcast runtime filter to one produced block
+        BEFORE partitioning/encoding. The shuffle/filter-lost chaos
+        site models a filter lost or corrupted between broadcast and
+        application: the side degrades to unfiltered shipping — the
+        filter is a pure bytes optimization, never a correctness
+        dependency. Stats merge under ``tlock`` (shipper threads and
+        the task thread share one stats dict)."""
+        from tidb_tpu.parallel.wire import apply_runtime_filter_block
+
+        inject("shuffle/filter")
+        if inject("shuffle/filter-lost", False):
+            with tlock:
+                stats["rf_lost"] = int(stats.get("rf_lost", 0)) + 1
+            return blk
+        blk2, rows_in, dropped = apply_runtime_filter_block(
+            blk, key, rf
+        )
+        with tlock:
+            stats["rf_rows_in"] = (
+                int(stats.get("rf_rows_in", 0)) + rows_in
+            )
+            stats["rf_dropped"] = (
+                int(stats.get("rf_dropped", 0)) + dropped
+            )
+        if dropped:
+            _c_filter_dropped().inc(dropped)
+        return blk2
 
     def run_task(self, spec: dict, tracer=None, cancel_check=None) -> dict:
         """The worker half of one shuffle stage. Pipelined (the
@@ -1664,6 +1752,14 @@ class ShuffleWorker:
                     emit(f"produce#{tag}", t_wall, dt_prod)
                     stats["produced_rows"] += blk.nrows
                     stats["side_rows"][str(tag)] = int(blk.nrows)
+                    if side.get("rf") is not None:
+                        # runtime filter over the complete block (the
+                        # probe-cached / held / range side shape) —
+                        # side_rows above stays the TRUE produce count
+                        # (the cardinality feedback's actuals)
+                        blk = self._apply_side_filter(
+                            blk, side["key"], side["rf"], stats, tlock
+                        )
                     t_push = time.perf_counter()
                     t_wall = time.time()
                     topsql.set_task_phase("shuffle-push")
@@ -1676,15 +1772,16 @@ class ShuffleWorker:
                             self._ship_salted_side(
                                 sid, attempt, m, tag, part, blk,
                                 schema_cols, salt, side.get("key"),
-                                peers, secret, tunnels, packet_rows,
-                                inflight, stats,
+                                peers, secret, tunnels, tlock,
+                                packet_rows, inflight, stats,
                             )
                         else:
                             self._ship_block_side(
                                 sid, attempt, m, tag, part, blk,
                                 schema_cols, mode, boundaries,
                                 side.get("key"), peers, secret,
-                                tunnels, packet_rows, inflight, stats,
+                                tunnels, tlock, packet_rows, inflight,
+                                stats,
                             )
                     emit(
                         f"push#{tag}", t_wall,
@@ -1721,8 +1818,8 @@ class ShuffleWorker:
                         for dest, prows in enumerate(parts):
                             self._send_stream(
                                 sid, attempt, m, tag, part, dest, prows,
-                                peers, secret, tunnels, packet_rows,
-                                inflight, stats,
+                                peers, secret, tunnels, tlock,
+                                packet_rows, inflight, stats,
                             )
                     emit(
                         f"push#{tag}", t_wall,
@@ -1763,6 +1860,10 @@ class ShuffleWorker:
                             # SQL digest (their samples charge the
                             # same statement, phase shuffle-push)
                             topsql.current_digest(),
+                            # broadcast runtime filter (None = off):
+                            # applied per produced sub-block before
+                            # partition/encode
+                            side.get("rf"),
                         ),
                         daemon=True,
                         name=f"shuffle-ship-{sid}-s{tag}",
@@ -1817,6 +1918,10 @@ class ShuffleWorker:
                 block = batch_to_block(batch, types, dicts)
                 stats["produced_rows"] += block.nrows
                 stats["side_rows"][str(tag)] = int(block.nrows)
+                if side.get("rf") is not None:
+                    block = self._apply_side_filter(
+                        block, side["key"], side["rf"], stats, tlock
+                    )
                 idxs = partition_block(block, side["key"], m)
                 t_push = time.perf_counter()
                 t_wall = time.time()
@@ -1826,8 +1931,8 @@ class ShuffleWorker:
                         self._ship_partition(
                             sid, attempt, m, tag, part, dest,
                             take_block(block, idx), schema_cols, peers,
-                            secret, tunnels, packet_rows, inflight,
-                            stats,
+                            secret, tunnels, tlock, packet_rows,
+                            inflight, stats,
                         )
                 emit(f"push#{tag}", t_wall, time.perf_counter() - t_push)
             consumer = plan_from_ir(spec["consumer"])
@@ -2101,26 +2206,34 @@ class ShuffleWorker:
         }
 
     def _tunnel_for(
-        self, dest, peers, sender, secret, tunnels, inflight,
+        self, dest, peers, sender, secret, tunnels, tlock, inflight,
         batch_packets: int = 64,
     ) -> PeerTunnel:
-        if dest not in tunnels:
-            host, port = peers[dest]
-            # src labeled with THIS worker's dial address (peers[sender])
-            # so tidbtpu_shuffle_bytes_total{src,dst} uses one identity
-            # space — a host's inbound and outbound series correlate
-            tunnels[dest] = PeerTunnel(
-                host, port, secret, src="%s:%s" % tuple(peers[sender]),
-                max_inflight_bytes=inflight,
-                batch_packets=batch_packets,
-            )
-        return tunnels[dest]
+        # check-and-create under the shared tunnel lock: the task
+        # thread ships complete blocks (probed/held/range sides) WHILE
+        # shipper threads stream pipelined sides to the same dests — a
+        # racing duplicate PeerTunnel would be overwritten in the dict
+        # and its tx thread leak past the task's close
+        with tlock:
+            if dest not in tunnels:
+                host, port = peers[dest]
+                # src labeled with THIS worker's dial address
+                # (peers[sender]) so tidbtpu_shuffle_bytes_total
+                # {src,dst} uses one identity space — a host's inbound
+                # and outbound series correlate
+                tunnels[dest] = PeerTunnel(
+                    host, port, secret,
+                    src="%s:%s" % tuple(peers[sender]),
+                    max_inflight_bytes=inflight,
+                    batch_packets=batch_packets,
+                )
+            return tunnels[dest]
 
     def _ship_side_stream(
         self, sid, attempt, m, side, sender, sq, key, schema_cols,
         peers, secret, tunnels, tlock, packet_rows, inflight, stats,
         errs, buf=None, ctx="", ev_args=None, cancel_check=None,
-        topsql_digest=None,
+        topsql_digest=None, rf=None,
     ) -> None:
         """Pipelined producer ship (one side, run on a shipper thread,
         fed produced sub-batches through queue ``sq`` until the None
@@ -2172,6 +2285,13 @@ class ShuffleWorker:
                 batch, types, dicts = item
                 block = batch_to_block(batch, types, dicts)
                 produced += block.nrows
+                if rf is not None:
+                    # runtime filter per produced sub-block: dropped
+                    # rows are never partitioned, encoded or shipped
+                    # (``produced`` above stays the true produce count)
+                    block = self._apply_side_filter(
+                        block, key, rf, stats, tlock
+                    )
                 pmap = partition_map(block, key, m)
                 for a in range(0, block.nrows, step):
                     if cancel_check is not None:
@@ -2195,12 +2315,11 @@ class ShuffleWorker:
                             )
                             local_rows += sub.nrows
                             continue
-                        with tlock:
-                            tun = self._tunnel_for(
-                                dest, peers, secret=secret,
-                                sender=sender, tunnels=tunnels,
-                                inflight=inflight,
-                            )
+                        tun = self._tunnel_for(
+                            dest, peers, secret=secret,
+                            sender=sender, tunnels=tunnels,
+                            tlock=tlock, inflight=inflight,
+                        )
                         if tun.negotiated_codec("binary") != "binary":
                             packet = {
                                 "sid": sid, "attempt": attempt, "m": m,
@@ -2256,11 +2375,10 @@ class ShuffleWorker:
                         nseq=seqs[dest],
                     )
                     continue
-                with tlock:
-                    tun = self._tunnel_for(
-                        dest, peers, secret=secret, sender=sender,
-                        tunnels=tunnels, inflight=inflight,
-                    )
+                tun = self._tunnel_for(
+                    dest, peers, secret=secret, sender=sender,
+                    tunnels=tunnels, tlock=tlock, inflight=inflight,
+                )
                 if tun.negotiated_codec("binary") != "binary":
                     eof = {
                         "sid": sid, "attempt": attempt, "m": m,
@@ -2329,8 +2447,8 @@ class ShuffleWorker:
 
     def _ship_block_side(
         self, sid, attempt, m, side, sender, block, schema_cols, mode,
-        boundaries, key, peers, secret, tunnels, packet_rows, inflight,
-        stats,
+        boundaries, key, peers, secret, tunnels, tlock, packet_rows,
+        inflight, stats,
     ) -> None:
         """Ship one COMPLETE columnar side under a DAG edge mode:
 
@@ -2358,16 +2476,16 @@ class ShuffleWorker:
             # ONE definition of the self-push protocol
             self._ship_partition(
                 sid, attempt, m, side, sender, sender, block,
-                schema_cols, peers, secret, tunnels, packet_rows,
-                inflight, stats,
+                schema_cols, peers, secret, tunnels, tlock,
+                packet_rows, inflight, stats,
             )
             return
         if mode == "broadcast":
             for dest in range(m):
                 self._ship_partition(
                     sid, attempt, m, side, sender, dest, block,
-                    schema_cols, peers, secret, tunnels, packet_rows,
-                    inflight, stats,
+                    schema_cols, peers, secret, tunnels, tlock,
+                    packet_rows, inflight, stats,
                 )
             return
         if mode == "range":
@@ -2381,12 +2499,13 @@ class ShuffleWorker:
             self._ship_partition(
                 sid, attempt, m, side, sender, dest,
                 take_block(block, idx), schema_cols, peers, secret,
-                tunnels, packet_rows, inflight, stats,
+                tunnels, tlock, packet_rows, inflight, stats,
             )
 
     def _ship_salted_side(
         self, sid, attempt, m, side, sender, block, schema_cols, salt,
-        key, peers, secret, tunnels, packet_rows, inflight, stats,
+        key, peers, secret, tunnels, tlock, packet_rows, inflight,
+        stats,
     ) -> None:
         """Ship one COMPLETE columnar side under a salt spec
         (``{"keys": [key_ints], "k": K, "role": ...}``): the hot
@@ -2427,12 +2546,12 @@ class ShuffleWorker:
             self._ship_partition(
                 sid, attempt, m, side, sender, dest,
                 take_block(block, idx), schema_cols, peers, secret,
-                tunnels, packet_rows, inflight, stats,
+                tunnels, tlock, packet_rows, inflight, stats,
             )
 
     def _ship_partition(
         self, sid, attempt, m, side, sender, dest, block, schema_cols,
-        peers, secret, tunnels, packet_rows, inflight, stats,
+        peers, secret, tunnels, tlock, packet_rows, inflight, stats,
     ) -> None:
         """Ship one columnar partition: binary frames seq 0..k-1 then
         the EOF frame, each encoded ONCE here in the producer (the
@@ -2458,13 +2577,13 @@ class ShuffleWorker:
         # pre-pipelining wire discipline
         tun = self._tunnel_for(
             dest, peers, secret=secret, sender=sender, tunnels=tunnels,
-            inflight=inflight, batch_packets=1,
+            tlock=tlock, inflight=inflight, batch_packets=1,
         )
         if tun.negotiated_codec("binary") != "binary":
             self._send_stream(
                 sid, attempt, m, side, sender, dest,
                 block_to_rows(block, schema_cols), peers, secret,
-                tunnels, packet_rows, inflight, stats,
+                tunnels, tlock, packet_rows, inflight, stats,
             )
             return
         nchunks = (block.nrows + packet_rows - 1) // packet_rows
@@ -2490,7 +2609,7 @@ class ShuffleWorker:
 
     def _send_stream(
         self, sid, attempt, m, side, sender, dest, rows, peers, secret,
-        tunnels, packet_rows, inflight, stats,
+        tunnels, tlock, packet_rows, inflight, stats,
     ) -> None:
         """Ship one (side, partition) ROW stream — the JSON fallback
         codec (shuffle_codec=json, or a peer that negotiated down):
@@ -2502,7 +2621,8 @@ class ShuffleWorker:
             # stop-and-wait acks, one packet per round trip
             self._tunnel_for(
                 dest, peers, secret=secret, sender=sender,
-                tunnels=tunnels, inflight=inflight, batch_packets=1,
+                tunnels=tunnels, tlock=tlock, inflight=inflight,
+                batch_packets=1,
             )
         chunks = [
             rows[a : a + packet_rows]

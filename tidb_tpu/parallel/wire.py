@@ -48,9 +48,10 @@ the shuffle data plane outside those marked sites.
 
 from __future__ import annotations
 
+import base64
 import json
 import struct
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -444,6 +445,35 @@ def column_key_ints(col: HostColumn) -> np.ndarray:
     return ints_u[inv] if len(u) else np.zeros(0, dtype=np.int64)
 
 
+def key_ints_valid(
+    block: HostBlock, key: str
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The shared keyed-int extraction: (column_key_ints, validity) of
+    column ``key``, computed ONCE and reused by every probe-round
+    consumer — partition histogram, hot-key ranking, and the runtime
+    filter build all take the SAME (ints, valid) pair instead of
+    re-hashing the cached block per use (string/temporal hashing is
+    per-distinct-value Python and must not repeat)."""
+    col = block.columns[key]
+    if block.nrows == 0:
+        return (
+            np.zeros(0, dtype=np.int64), np.zeros(0, dtype=bool)
+        )
+    return column_key_ints(col), np.asarray(col.valid, dtype=bool)
+
+
+def partition_map_from_ints(
+    ints: np.ndarray, valid: np.ndarray, m: int
+) -> np.ndarray:
+    """partition_map over an already-extracted (ints, valid) pair."""
+    from tidb_tpu.parallel.shuffle import mix_hash_np
+
+    if not len(ints):
+        return np.zeros(0, dtype=np.int64)
+    parts = mix_hash_np(ints) % np.int64(m)
+    return np.where(valid, parts, 0)
+
+
 def partition_map(block: HostBlock, key: str, m: int) -> np.ndarray:
     """Per-row destination partition of column ``key`` as one int64
     array (mix_hash_np — the same 64-bit finalizer as
@@ -452,14 +482,19 @@ def partition_map(block: HostBlock, key: str, m: int) -> np.ndarray:
     ONCE per produced side; the pipelined producer slices this map per
     packet chunk instead of re-hashing (string/temporal key hashing is
     per-distinct-value and must not repeat per chunk)."""
-    from tidb_tpu.parallel.shuffle import mix_hash_np
+    ints, valid = key_ints_valid(block, key)
+    return partition_map_from_ints(ints, valid, m)
 
-    col = block.columns[key]
-    if block.nrows == 0:
-        return np.zeros(0, dtype=np.int64)
-    ints = column_key_ints(col)
-    parts = mix_hash_np(ints) % np.int64(m)
-    return np.where(np.asarray(col.valid, dtype=bool), parts, 0)
+
+def partition_histogram_from_ints(
+    ints: np.ndarray, valid: np.ndarray, m: int
+) -> List[int]:
+    """partition_histogram over an already-extracted (ints, valid)
+    pair — the probe round hashes each cached block once."""
+    if not len(ints):
+        return [0] * int(m)
+    parts = partition_map_from_ints(ints, valid, m)
+    return np.bincount(parts, minlength=int(m)).astype(int).tolist()
 
 
 def partition_histogram(block: HostBlock, key: str, m: int) -> List[int]:
@@ -467,10 +502,20 @@ def partition_histogram(block: HostBlock, key: str, m: int) -> List[int]:
     host-tier hash — the skew probe's payload (np.bincount over the
     partition map; vectorized, no per-row Python). NULL keys count on
     partition 0 like partition_map routes them."""
-    if block.nrows == 0:
-        return [0] * int(m)
-    parts = partition_map(block, key, m)
-    return np.bincount(parts, minlength=int(m)).astype(int).tolist()
+    ints, valid = key_ints_valid(block, key)
+    return partition_histogram_from_ints(ints, valid, m)
+
+
+def hot_key_ints_from_ints(
+    ints: np.ndarray, valid: np.ndarray, top: int = 4
+) -> List[List[int]]:
+    """hot_key_ints over an already-extracted (ints, valid) pair."""
+    nn = ints[valid]
+    if not len(nn):
+        return []
+    u, counts = np.unique(nn, return_counts=True)
+    order = np.argsort(counts)[::-1][: int(top)]
+    return [[int(u[i]), int(counts[i])] for i in order]
 
 
 def hot_key_ints(
@@ -481,15 +526,8 @@ def hot_key_ints(
     image, column_key_ints — codec-independent, so the coordinator
     can both sum counts across producers and recompute each key's
     home partition). The salt flag set is built from these."""
-    col = block.columns[key]
-    if block.nrows == 0:
-        return []
-    ints = column_key_ints(col)[np.asarray(col.valid, dtype=bool)]
-    if not len(ints):
-        return []
-    u, counts = np.unique(ints, return_counts=True)
-    order = np.argsort(counts)[::-1][: int(top)]
-    return [[int(u[i]), int(counts[i])] for i in order]
+    ints, valid = key_ints_valid(block, key)
+    return hot_key_ints_from_ints(ints, valid, top)
 
 
 def salt_targets(key_int: int, m: int, k: int) -> List[int]:
@@ -610,3 +648,225 @@ def partition_block(
     fodder)."""
     parts = partition_map(block, key, m)
     return [np.nonzero(parts == d)[0] for d in range(m)]
+
+
+# -- runtime filters (sideways information passing, ISSUE 19) ---------------
+#
+# A compact summary of the BUILD side's join-key domain, harvested in
+# the probe round from the already-cached block, merged across hosts by
+# the coordinator, and shipped with the stage dispatch so the PROBE
+# side drops non-matching rows before partitioning and encoding.
+# Filters operate on the key-int domain (column_key_ints) so one
+# representation covers every key SQLType; the key ints are the raw
+# logical values ONLY for INT/BOOL (order-preserving), which is why
+# min-max bounds ride the filter only for those kinds. The whole
+# payload is a small JSON-shippable dict (control plane — the filter
+# itself never rides the data plane):
+#
+#   {"kind": "inlist", "keys": [int, ...]}            exact, NDV small
+#   {"kind": "bloom", "bits": n, "k": h,
+#    "data": base64(bitset)}                          seeded double-hash
+#   + optional "lo"/"hi" raw-value bounds (INT/BOOL keys only)
+#
+# Bloom geometry (bits, k) is fixed by the COORDINATOR in the probe
+# request, so every host's bitset ORs together; in-list replies union,
+# cutting over to a bloom of the requested geometry on overflow.
+
+#: seeds of the two bloom hash streams — mix_hash_np over (ints ^ S1)
+#: and (ints + S2). Fixed constants: a retried stage must rebuild the
+#: bit-identical filter from the same data (attempt fencing), and
+#: every host must agree so bitsets OR.
+_RF_SEED1 = np.int64(0x5EEDF117E25)
+_RF_SEED2 = np.int64(0x2545F4914F6CDD1D)
+
+#: bitset ceiling — a runtime filter is a control-plane broadcast, so
+#: it must stay small even for huge build sides (past this the FPR
+#: degrades gracefully; it never fails)
+RF_MAX_BLOOM_BYTES = 1 << 21
+
+
+def _rf_bloom_hashes(ints: np.ndarray, nbits: int, k: int):
+    """The k bit indexes of every key under seeded double-hashing:
+    idx_i = (h1 + i*h2) mod nbits, h2 forced odd so the stride walks
+    the whole (power-of-two) table. Returns an (k, n) int64 array."""
+    from tidb_tpu.parallel.shuffle import mix_hash_np
+
+    with np.errstate(over="ignore"):
+        h1 = mix_hash_np(ints ^ _RF_SEED1)
+        h2 = mix_hash_np(ints + _RF_SEED2) | np.int64(1)
+        steps = np.arange(int(k), dtype=np.int64)[:, None] * h2[None, :]
+        return (h1[None, :] + steps) & np.int64(int(nbits) - 1)
+
+
+def bloom_geometry(est_keys: int, bits_per_key: int) -> Tuple[int, int]:
+    """(nbits, k) for an expected distinct-key count: nbits the next
+    power of two >= bits_per_key * est_keys (capped), k the classic
+    ln2 * bits-per-key hash count clamped to [1, 8]."""
+    want = max(int(est_keys), 1) * max(int(bits_per_key), 1)
+    nbits = 64
+    while nbits < want and nbits < RF_MAX_BLOOM_BYTES * 8:
+        nbits *= 2
+    eff_bpk = nbits / max(int(est_keys), 1)
+    k = int(round(eff_bpk * 0.6931))
+    return nbits, max(1, min(k, 8))
+
+
+def build_bloom_filter(
+    keys: np.ndarray, nbits: int, k: int
+) -> np.ndarray:
+    """Packed uint8 bitset with all k bits of every key set
+    (np.bitwise_or.at — vectorized build, no per-row Python)."""
+    bits = np.zeros(int(nbits) // 8, dtype=np.uint8)
+    if len(keys):
+        idx = _rf_bloom_hashes(np.asarray(keys, dtype=np.int64),
+                               nbits, k).ravel()
+        np.bitwise_or.at(
+            bits, idx >> 3,
+            (np.int64(1) << (idx & 7)).astype(np.uint8),
+        )
+    return bits
+
+
+def _bloom_test(
+    ints: np.ndarray, bits: np.ndarray, nbits: int, k: int
+) -> np.ndarray:
+    """Membership mask: True where ALL k bits are set (possible
+    member), False only for definite non-members — zero false
+    negatives by construction."""
+    if not len(ints):
+        return np.zeros(0, dtype=bool)
+    idx = _rf_bloom_hashes(ints, nbits, k)
+    hit = (bits[idx >> 3] >> (idx & 7).astype(np.uint8)) & 1
+    return hit.all(axis=0).astype(bool)
+
+
+def build_runtime_filter(
+    ints: np.ndarray,
+    valid: np.ndarray,
+    spec: dict,
+    minmax: bool = False,
+) -> dict:
+    """One host's filter over its build-side (ints, valid) — built
+    from the SAME extraction the histogram and hot-key replies use.
+    ``spec`` is the coordinator's uniform geometry request
+    ``{"bits": nbits, "k": h, "inlist_ndv": cutover}``; ``minmax``
+    attaches raw-value bounds (caller asserts the key kind is
+    order-preserving). The reply also carries the exact distinct key
+    count (``ndv``) for the coordinator's costing."""
+    keys = np.unique(ints[valid])
+    rf: dict = {"ndv": int(len(keys))}
+    if minmax and len(keys):
+        rf["lo"], rf["hi"] = int(keys[0]), int(keys[-1])
+    if len(keys) <= int(spec.get("inlist_ndv", 0)):
+        rf["kind"] = "inlist"
+        rf["keys"] = [int(v) for v in keys]
+        return rf
+    nbits, k = int(spec["bits"]), int(spec["k"])
+    bits = build_bloom_filter(keys, nbits, k)
+    rf["kind"] = "bloom"
+    rf["bits"] = nbits
+    rf["k"] = k
+    rf["data"] = base64.b64encode(bits.tobytes()).decode("ascii")
+    return rf
+
+
+def merge_runtime_filters(filters: List[Optional[dict]]) -> Optional[dict]:
+    """The coordinator's cross-host merge. Blooms (uniform geometry by
+    construction) OR bytewise; in-lists union, cutting over to a bloom
+    of the shared geometry when any host already bloomed; min-max
+    bounds take min(lo)/max(hi). Any missing/corrupt reply poisons the
+    merge to None — the stage degrades to unfiltered shipping, never
+    wrong results."""
+    if not filters or any(f is None for f in filters):
+        return None
+    keys: List[int] = []
+    blooms = []
+    lo = hi = None
+    geom = None
+    for f in filters:
+        if f.get("kind") == "inlist":
+            keys.extend(int(v) for v in f.get("keys", ()))
+        elif f.get("kind") == "bloom":
+            try:
+                bits = np.frombuffer(
+                    base64.b64decode(f["data"]), dtype=np.uint8
+                )
+                g = (int(f["bits"]), int(f["k"]))
+            except (KeyError, ValueError, TypeError):
+                return None
+            if len(bits) * 8 != g[0] or (geom is not None and g != geom):
+                return None
+            geom = g
+            blooms.append(bits)
+        else:
+            return None
+        if "lo" in f:
+            lo = f["lo"] if lo is None else min(lo, f["lo"])
+            hi = f["hi"] if hi is None else max(hi, f["hi"])
+    ndv = sum(int(f.get("ndv", 0)) for f in filters)
+    out: dict = {"ndv": ndv}
+    if lo is not None:
+        out["lo"], out["hi"] = int(lo), int(hi)
+    if blooms:
+        merged = blooms[0].copy()
+        for b in blooms[1:]:
+            merged |= b
+        if keys:
+            merged |= build_bloom_filter(
+                np.asarray(keys, dtype=np.int64), geom[0], geom[1]
+            )
+        out["kind"] = "bloom"
+        out["bits"], out["k"] = geom
+        out["data"] = base64.b64encode(merged.tobytes()).decode("ascii")
+        return out
+    out["kind"] = "inlist"
+    out["keys"] = sorted(set(keys))
+    return out
+
+
+def runtime_filter_nbytes(rf: dict) -> int:
+    """Shipped size of one filter payload (costing + metrics): the
+    bitset bytes for blooms, 8 bytes per key for in-lists."""
+    if rf.get("kind") == "bloom":
+        return int(rf.get("bits", 0)) // 8
+    return 8 * len(rf.get("keys", ()))
+
+
+def runtime_filter_test(
+    ints: np.ndarray, valid: np.ndarray, rf: dict
+) -> np.ndarray:
+    """Per-row KEEP mask of a probe-side (ints, valid) pair under a
+    merged filter. NULL keys drop too — on every side where filtering
+    is legal (the non-preserved side of an equi-join) a NULL key never
+    matches. Vectorized end to end: np.isin for in-lists, the packed
+    bitset probe for blooms — never a per-row Python membership test."""
+    keep = np.asarray(valid, dtype=bool).copy()
+    if not len(ints):
+        return keep
+    if "lo" in rf:
+        keep &= (ints >= np.int64(rf["lo"])) & (ints <= np.int64(rf["hi"]))
+    if rf.get("kind") == "inlist":
+        keep &= np.isin(
+            ints, np.asarray(rf.get("keys", ()), dtype=np.int64)
+        )
+    elif rf.get("kind") == "bloom":
+        bits = np.frombuffer(base64.b64decode(rf["data"]), dtype=np.uint8)
+        keep &= _bloom_test(ints, bits, int(rf["bits"]), int(rf["k"]))
+    return keep
+
+
+def apply_runtime_filter_block(
+    block: HostBlock, key: str, rf: dict
+) -> Tuple[HostBlock, int, int]:
+    """Drop a produced block's non-matching rows BEFORE partitioning
+    and encoding: (filtered block, rows_in, rows_dropped). The no-drop
+    case returns the input block untouched (no copy)."""
+    from tidb_tpu.chunk import take_block
+
+    ints, valid = key_ints_valid(block, key)
+    keep = runtime_filter_test(ints, valid, rf)
+    n = int(block.nrows)
+    if bool(keep.all()):
+        return block, n, 0
+    idx = np.nonzero(keep)[0]
+    return take_block(block, idx), n, n - len(idx)

@@ -757,6 +757,79 @@ def split_plan_shuffle_salted(
     )
 
 
+def split_plan_shuffle_aggskip(
+    plan: L.LogicalPlan, catalog=None
+) -> Optional[ShufflePlan]:
+    """The PARTIAL-AGG-SKIP variant of the repartition-join cut
+    (parallel/aqe.py, the "Partial Partial Aggregates" decision): the
+    same join shuffle, but each partition's consumer returns the RAW
+    join rows — the coordinator's final stage runs the ORIGINAL
+    aggregate over the staged rows. When the probe observes group
+    cardinality approaching the row count, the per-partition partial
+    aggregation compacts (nearly) nothing, so its hash-agg pass is
+    pure overhead there; skipping it ships the same volume with one
+    less pass. Returns None when the plan is not the join-under-
+    aggregate shape. The first group key's producing side rides along
+    as ``_aggskip_gcol``/``_aggskip_gtag`` (the probe measures that
+    side's distinct group count — a LOWER bound on the join output's
+    group NDV, so the skip only fires when even the bound is high)."""
+    agg = _find_cut(plan)
+    if agg is None or not agg.group_exprs or agg.gc_meta:
+        return None
+    path, jp = _find_shuffle_join(agg.child)
+    if (
+        jp is None or jp.kind not in _SHUFFLE_JOIN_KINDS
+        or jp.null_aware or not jp.equi_keys
+    ):
+        return None
+    le, re_ = jp.equi_keys[0]
+    lkey = _shuffle_key_of(le, jp.left.schema)
+    rkey = _shuffle_key_of(re_, jp.right.schema)
+    lscan = _pick_frag_scan(jp.left, catalog)
+    rscan = _pick_frag_scan(jp.right, catalog)
+    if (
+        lkey is None or rkey is None
+        or lscan is None or rscan is None
+    ):
+        return None
+    first = agg.group_exprs[0][1]
+    if not isinstance(first, ColumnRef):
+        return None
+    gcol = first.name
+    gtag = None
+    if gcol in {c.internal for c in jp.left.schema.cols}:
+        gtag = 0
+    elif gcol in {c.internal for c in jp.right.schema.cols}:
+        gtag = 1
+    if gtag is None:
+        return None
+    sides = [
+        ShuffleSide(jp.left, lscan, lkey, 0,
+                    _est_rows(lscan, catalog)),
+        ShuffleSide(jp.right, rscan, rkey, 1,
+                    _est_rows(rscan, catalog)),
+    ]
+    jp2 = dataclasses.replace(
+        jp,
+        left=L.ShuffleRead(jp.left.schema, tag=0),
+        right=L.ShuffleRead(jp.right.schema, tag=1),
+    )
+    mid = _wrap_path(path, jp2)
+
+    def final_builder(source, _plan=plan, _agg=agg):
+        return _replace_node(
+            _plan, _agg, dataclasses.replace(_agg, child=source)
+        )
+
+    sp = ShufflePlan(
+        "join", sides, mid, agg.child.schema, final_builder,
+        join_kind=jp.kind,
+    )
+    sp._aggskip_gcol = gcol
+    sp._aggskip_gtag = gtag
+    return sp
+
+
 def _parse_peeled(peeled):
     """Recognize a distributable ORDER BY root in the peeled node
     stack (root-first): ``[*above, Limit?, Sort]`` where ``above`` is

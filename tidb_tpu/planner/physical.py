@@ -2979,6 +2979,20 @@ def _merge_shuffle_stats(lines: List[str], stage, infos) -> List[str]:
         aqe_bits += f" skew={float(stage['skew']):.2f}"
     if stage.get("adaptive"):
         aqe_bits += f" adaptive={'|'.join(stage['adaptive'])}"
+    # runtime filter (PR 19): kind + bloom geometry + predicted vs
+    # OBSERVED selectivity (kept/tested probe rows — the auto cost
+    # gate's feedback signal), and filter-lost degrade counts
+    rf = stage.get("rf")
+    if rf:
+        aqe_bits += f" rf={rf.get('kind', '?')}"
+        if rf.get("bits"):
+            aqe_bits += f":{int(rf['bits'])}b"
+        if rf.get("sel_pred") is not None:
+            aqe_bits += f" sel_pred={float(rf['sel_pred']):.3f}"
+        if rf.get("sel_obs") is not None:
+            aqe_bits += f" sel_obs={float(rf['sel_obs']):.3f}"
+        if rf.get("lost"):
+            aqe_bits += f" rf_lost={int(rf['lost'])}"
     summary = (
         f"DCNShuffle kind={stage.get('kind')} "
         + dag_bits

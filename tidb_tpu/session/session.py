@@ -2951,7 +2951,7 @@ class Session(DDLMixin):
                         )
                 if s.name.lower().startswith(
                     ("tidb_tpu_shuffle_", "tidb_tpu_heartbeat_",
-                     "tidb_tpu_aqe_")
+                     "tidb_tpu_aqe_", "tidb_tpu_runtime_filter")
                 ) and s.scope == "global":
                     # live re-tune of an attached scheduler's shuffle
                     # wait timeout and heartbeat liveness knobs (the
@@ -2990,6 +2990,19 @@ class Session(DDLMixin):
                                 )
 
                                 CARD_FEEDBACK.warm_from_history()
+                        elif name.startswith("tidb_tpu_runtime_filter"):
+                            # live re-tune of the runtime-filter mode
+                            # and geometry knobs (same pattern): the
+                            # next probed stage picks them up
+                            sched.runtime_filter = str(
+                                gv.get("tidb_tpu_runtime_filter")
+                            )
+                            sched.rf_bloom_bits = int(gv.get(
+                                "tidb_tpu_runtime_filter_bloom_bits"
+                            ))
+                            sched.rf_inlist_ndv = int(gv.get(
+                                "tidb_tpu_runtime_filter_inlist_ndv"
+                            ))
                         elif name.startswith("tidb_tpu_shuffle_"):
                             sched.shuffle_wait_timeout_s = float(
                                 gv.get(

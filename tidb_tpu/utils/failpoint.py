@@ -97,6 +97,8 @@ SITES = frozenset({
     "server/dispatch-query",
     "shuffle/consume",
     "shuffle/decode",
+    "shuffle/filter",
+    "shuffle/filter-lost",
     "shuffle/open",
     "shuffle/produce",
     "shuffle/push",

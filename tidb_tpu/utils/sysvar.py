@@ -152,6 +152,29 @@ SYSVAR_DEFS: Dict[str, SysVarDef] = {
                   "triggers stage-boundary re-planning (re-running "
                   "choose_edge_modes with observed counts between "
                   "shuffle DAG stages)"),
+        # Runtime filters (PR 19, parallel/wire.py rf kernels): the
+        # AQE probe round harvests a build-side key summary and the
+        # stage dispatch ships it so producers drop non-matching rows
+        # before partition+encode. GLOBAL-only scheduler knobs; a live
+        # SET re-tunes an attached scheduler (session.py hook).
+        SysVarDef("tidb_tpu_runtime_filter", "auto", "global",
+                  _enum("auto", "off", "always"),
+                  "sideways-information-passing runtime filters on "
+                  "repartition joins: auto costs filter build+ship "
+                  "bytes against CARD_FEEDBACK-predicted probe bytes "
+                  "saved; always forces emission on every legal "
+                  "probed join; off disables"),
+        SysVarDef("tidb_tpu_runtime_filter_bloom_bits", 10, "global",
+                  _int_range(2, 64),
+                  "bloom filter bits per distinct build-side key "
+                  "(hash count derives as bits*ln2, clamped to "
+                  "[1, 8]; total size capped at wire.py "
+                  "RF_MAX_BLOOM_BYTES)"),
+        SysVarDef("tidb_tpu_runtime_filter_inlist_ndv", 256, "global",
+                  _int_range(1, 65536),
+                  "build-side NDV at or below which the runtime "
+                  "filter ships an EXACT in-list of key ints (zero "
+                  "false positives) instead of a bloom"),
         # HTAP delta tier (storage/delta.py): coordinator DML deltas
         # replicate to the fleet; routed reads merge a (fold, seq)
         # snapshot; a background compactor folds the log into the
